@@ -1,0 +1,49 @@
+"""Shared DNA / Phred constants.
+
+Parity contract with the reference implementation (fgumi):
+- ``MIN_PHRED``/``NO_CALL_BASE`` mirror /root/reference/crates/fgumi-dna/src/lib.rs:17-24
+- ``MAX_PHRED`` mirrors /root/reference/crates/fgumi-consensus/src/phred.rs:28
+"""
+
+import numpy as np
+
+# Minimum Phred score emitted on consensus bases (fgbio's convention).
+MIN_PHRED = 2
+# Maximum Phred score handled (SAMUtils.MAX_PHRED_SCORE).
+MAX_PHRED = 93
+
+# No-call base characters.
+NO_CALL_BASE = ord("N")
+NO_CALL_BASE_LOWER = ord("n")
+
+# Canonical base order used throughout consensus calling: A, C, G, T.
+DNA_BASES = np.frombuffer(b"ACGT", dtype=np.uint8)
+
+# Base code used for N / invalid bases in packed code arrays.
+N_CODE = 4
+
+# ASCII byte -> base code (0..3 for ACGT upper/lower, 4 for everything else).
+# Mirrors BASE_TO_INDEX (/root/reference/crates/fgumi-consensus/src/base_builder.rs:307-318),
+# with 4 instead of 255 as the invalid sentinel so packed arrays stay uint8-dense.
+BASE_TO_CODE = np.full(256, N_CODE, dtype=np.uint8)
+for _i, _b in enumerate(b"ACGT"):
+    BASE_TO_CODE[_b] = _i
+for _i, _b in enumerate(b"acgt"):
+    BASE_TO_CODE[_b] = _i
+
+# Base code -> ASCII byte (A, C, G, T, N).
+CODE_TO_BASE = np.frombuffer(b"ACGTN", dtype=np.uint8).copy()
+
+# Complement in code space: A<->T, C<->G, N->N.
+CODE_COMPLEMENT = np.array([3, 2, 1, 0, 4], dtype=np.uint8)
+
+
+def reverse_complement_codes(codes: np.ndarray) -> np.ndarray:
+    """Reverse-complement an array of base codes (0..4)."""
+    return CODE_COMPLEMENT[codes[::-1]]
+
+
+def reverse_complement_bytes(seq: bytes) -> bytes:
+    """Reverse-complement an ASCII DNA byte string (non-ACGT -> N)."""
+    codes = BASE_TO_CODE[np.frombuffer(seq, dtype=np.uint8)]
+    return CODE_TO_BASE[CODE_COMPLEMENT[codes[::-1]]].tobytes()

@@ -1,0 +1,193 @@
+"""Batched TPU consensus kernel (JAX/XLA).
+
+Re-expresses the reference's per-position scalar hot loop
+(/root/reference/crates/fgumi-consensus/src/base_builder.rs:612-644,795-852 — the
+reset/add/call loop at vanilla_caller.rs:1396-1437) as one fused XLA computation over a
+whole batch of padded UMI families at once:
+
+    codes (F, R, L) uint8, quals (F, R, L) uint8  ->  per-position consensus
+    winner/qual/depth/errors (F, L)
+
+Numerics strategy (SURVEY.md §7 "architecture stance"): the device computes in f32
+using per-quality tables precomputed in f64 on host, with a *suspect mask*: positions
+whose result could plausibly round to a different integer Phred (or whose winner margin
+is within f32 noise) are flagged and recomputed on host by the f64 oracle
+(fgumi_tpu.ops.oracle). This mirrors the reference's own fast-path-with-margin-gate
+design (base_builder.rs:186-263): a fast path that is exact outside a guard band,
+deferring to the exact computation inside it.
+
+Key algebraic reformulation (device only; guarded by the suspect mask): the four lane
+likelihoods are ll[b] = S_err + C[b], where S_err = sum over valid observations of
+ln(err/3) is lane-independent and C[b] = sum over observations matching b of
+(ln_correct - ln_err) >= 0 is the per-lane match contribution. Winner selection and
+every posterior quantity depend only on lane *differences*, so S_err is never
+materialized: gaps = C_max - C[b], s = sum_losers exp(-gap), and
+ln_consensus_error = ln(s) - log1p(s). This is the same shifted-gap frame the
+reference uses for its unanimous fast path (base_builder.rs:364-385) generalized to
+non-unanimous positions, and it keeps f32 magnitudes at ~|C| (tens per matching read)
+instead of |ll| (hundreds to thousands), which is what makes f32 viable at depth.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import MAX_PHRED, MIN_PHRED, N_CODE
+from .tables import QualityTables
+
+_LN10_F32 = np.float32(np.log(10.0))
+_LN_4_3_F32 = np.float32(np.log(4.0 / 3.0))
+_EPS32 = np.float32(np.finfo(np.float32).eps)
+_PHRED_PER_LN = np.float32(10.0 / np.log(10.0))
+
+# Conservative multipliers for the suspect guard band; calibrated by
+# tests/test_kernel_parity.py (which asserts zero integer mismatches after host
+# fallback AND a bounded fallback rate).
+_GUARD_C_SCALE = 16.0  # multiplier on eps32 * max(C) for the gap error estimate
+_QUAL_GUARD_FLOOR = 3e-4  # minimum guard band in Phred units (< the 1e-3 precision nudge)
+_TIE_GUARD_FLOOR = 1e-5  # minimum winner-margin guard in ln units
+
+
+def _reduce_contributions(codes, quals, correct_tab, err_tab):
+    """Per-position match-contribution + observation-count reduction over reads.
+
+    codes/quals: (..., R, L). Returns C (..., L, 4) f32 (lane match contributions),
+    obs (..., L, 4) int32. N/pad codes contribute nothing (base_builder.rs:616-619).
+    """
+    q_idx = jnp.minimum(quals, MAX_PHRED).astype(jnp.int32)
+    delta_tab = correct_tab - err_tab  # (94,) f32, >= 0 for sane rates
+    valid = codes != N_CODE
+    one_hot = jax.nn.one_hot(jnp.minimum(codes, 3), 4, dtype=jnp.float32)
+    one_hot = one_hot * valid[..., None].astype(jnp.float32)
+    delta = jnp.where(valid, delta_tab[q_idx], 0.0)  # (..., R, L)
+    contrib = jnp.einsum("...rl,...rlb->...lb", delta, one_hot)
+    obs = jnp.sum(one_hot, axis=-3).astype(jnp.int32)  # (..., L, 4)
+    return contrib, obs
+
+
+def _call_epilogue(contrib, obs, ln_error_pre_umi):
+    """Winner/tie/posterior/Phred epilogue over (..., L, 4) lane contributions.
+
+    Returns winner (int32, N_CODE for no-call), qual (int32), depth, errors (int32),
+    suspect (bool): positions requiring f64 host recomputation.
+    """
+    depth = jnp.sum(obs, axis=-1)
+    max_c = jnp.max(contrib, axis=-1)
+    winner = jnp.argmax(contrib, axis=-1).astype(jnp.int32)
+    lane_is_winner = jax.nn.one_hot(winner, 4, dtype=jnp.bool_)
+
+    # Loser-gap frame: s = sum over losing lanes of exp(-(max - C_b)).
+    gaps = max_c[..., None] - contrib  # >= 0; 0 at the winner lane
+    exp_neg = jnp.where(lane_is_winner, 0.0, jnp.exp(-gaps))
+    s = jnp.sum(exp_neg, axis=-1)
+    # ln consensus error = ln(s / (1 + s)); s == 0 underflows to -inf (cap region).
+    ln_cons_err = jnp.log(s) - jnp.log1p(s)
+
+    # two-trials combination with the pre-UMI prior (phred.rs:248-267), f32.
+    pre = jnp.float32(ln_error_pre_umi)
+    hi = jnp.maximum(pre, ln_cons_err)
+    lo = jnp.minimum(pre, ln_cons_err)
+    diff = hi - lo
+    quick = ~(diff < 6.0)  # catches NaN (lo = -inf) as quick
+    safe_diff = jnp.where(quick, 6.0, diff)
+    term1 = hi + jnp.log1p(jnp.exp(-safe_diff))  # ln(exp(hi) + exp(lo))
+    term2_minus_term1 = _LN_4_3_F32 + lo - jnp.log1p(jnp.exp(-safe_diff))
+    full = term1 + jnp.log1p(-jnp.exp(jnp.minimum(term2_minus_term1, -_EPS32)))
+    ln_final = jnp.where(quick, hi, full)
+
+    phred_f = -ln_final * _PHRED_PER_LN + 0.001
+    qual = jnp.clip(jnp.floor(phred_f), MIN_PHRED, MAX_PHRED).astype(jnp.int32)
+
+    # ---- suspect guard band ----
+    eps_gap = _GUARD_C_SCALE * _EPS32 * (1.0 + max_c)
+    # winner margin: distance between best and second-best lane contribution
+    second = jnp.max(jnp.where(lane_is_winner, -jnp.inf, contrib), axis=-1)
+    margin = max_c - second
+    tie_suspect = margin <= (2.0 * eps_gap + _TIE_GUARD_FLOOR)
+    # Phred rounding proximity. The ln_final error is ~eps_gap on the consensus-error
+    # path; when the quick path selected the pre-UMI constant the result is exact.
+    took_pre = quick & (ln_cons_err < pre)
+    err_phred = jnp.where(took_pre, 0.0, _PHRED_PER_LN * 2.0 * eps_gap)
+    frac = phred_f - jnp.floor(phred_f)
+    near_boundary = jnp.minimum(frac, 1.0 - frac) <= (err_phred + _QUAL_GUARD_FLOOR)
+    clamped = (phred_f <= MIN_PHRED) | (phred_f >= MAX_PHRED + 0.5)
+    # The quick-vs-full two-trials branch (diff >= 6) is decided in f32 here but f64
+    # in the oracle; the formulas differ by up to ln(1+e^-6) ≈ 0.0215 Phred at the
+    # boundary, so positions near it must fall back.
+    branch_suspect = jnp.abs(diff - 6.0) <= (2.0 * eps_gap + 1e-4)
+    # Non-finite contributions (a Q0 observation's -inf table entry times the one-hot
+    # zero gives NaN through the einsum) poison every comparison below into False;
+    # force those positions to the exact host path.
+    nonfinite = ~jnp.isfinite(max_c)
+    suspect = tie_suspect | branch_suspect | nonfinite | (near_boundary & ~clamped)
+
+    no_call = depth == 0
+    winner = jnp.where(no_call | tie_suspect, N_CODE, winner)
+    qual = jnp.where(no_call | tie_suspect, MIN_PHRED, qual)
+    suspect = suspect & ~no_call
+
+    winner_obs = jnp.sum(obs * lane_is_winner.astype(jnp.int32), axis=-1)
+    errors = depth - jnp.where(winner == N_CODE, 0, winner_obs)
+    return winner, qual, depth, errors, suspect
+
+
+@jax.jit
+def _consensus_batch_jit(codes, quals, correct_tab, err_tab, ln_error_pre_umi):
+    contrib, obs = _reduce_contributions(codes, quals, correct_tab, err_tab)
+    return _call_epilogue(contrib, obs, ln_error_pre_umi)
+
+
+class ConsensusKernel:
+    """Compiled batched consensus caller for one (pre, post) error-rate pair.
+
+    Call with padded uint8 arrays codes/quals of shape (F, R, L); returns NumPy
+    arrays (winner, qual, depth, errors) with all suspect positions already
+    recomputed on host by the f64 oracle, so results are integer-exact against
+    fgumi_tpu.ops.oracle by construction.
+    """
+
+    def __init__(self, tables: QualityTables):
+        self.tables = tables
+        self._correct_f32 = jnp.asarray(tables.adjusted_correct, dtype=jnp.float32)
+        self._err_f32 = jnp.asarray(tables.adjusted_error_per_alt, dtype=jnp.float32)
+        self._pre = np.float32(tables.ln_error_pre_umi)
+        self.fallback_positions = 0
+        self.total_positions = 0
+
+    def device_call(self, codes, quals):
+        """Raw device outputs (winner, qual, depth, errors, suspect) as jax arrays."""
+        return _consensus_batch_jit(
+            jnp.asarray(codes), jnp.asarray(quals), self._correct_f32, self._err_f32, self._pre
+        )
+
+    def __call__(self, codes: np.ndarray, quals: np.ndarray):
+        winner, qual, depth, errors, suspect = jax.device_get(
+            self.device_call(codes, quals)
+        )
+        winner = winner.astype(np.uint8)
+        qual = qual.astype(np.uint8)
+        depth = depth.astype(np.int64)
+        errors = errors.astype(np.int64)
+        self.total_positions += suspect.size
+        n_suspect = int(suspect.sum())
+        if n_suspect:
+            self.fallback_positions += n_suspect
+            self._host_fallback(codes, quals, winner, qual, depth, errors, suspect)
+        return winner, qual, depth, errors
+
+    def _host_fallback(self, codes, quals, winner, qual, depth, errors, suspect):
+        """Recompute suspect positions exactly with the f64 oracle (in place)."""
+        from . import oracle
+
+        fam_idx, pos_idx = np.nonzero(suspect)
+        for f in np.unique(fam_idx):
+            positions = pos_idx[fam_idx == f]
+            sub_codes = np.ascontiguousarray(codes[f][:, positions])
+            sub_quals = np.ascontiguousarray(quals[f][:, positions])
+            w, q, d, e = oracle.call_family(sub_codes, sub_quals, self.tables)
+            winner[f, positions] = w
+            qual[f, positions] = q
+            depth[f, positions] = d
+            errors[f, positions] = e

@@ -1,0 +1,154 @@
+"""f64 scalar-semantics consensus oracle (NumPy, host).
+
+This is the correctness anchor for the TPU kernel: an exact reimplementation of
+ConsensusBaseBuilder::add / call_full
+(/root/reference/crates/fgumi-consensus/src/base_builder.rs:612-644,795-852), including
+
+- Kahan-compensated accumulation of per-read log-likelihoods, in read order,
+- fgbio's lane-ordered log-sum-exp normalization (phred.rs:324-351),
+- the exact tie rule: a tie exists iff some lane *after* the first-occurrence maximum
+  is within f64 epsilon (absolute) of the maximum (call_full's running-max loop),
+- the posterior -> consensus-error -> two-trials(pre-UMI) -> Phred chain.
+
+The device kernel must agree with this oracle on every integer output; positions the
+kernel flags as numerically suspect are recomputed here.
+"""
+
+import numpy as np
+
+from ..constants import MAX_PHRED, MIN_PHRED, N_CODE
+from . import phred as P
+from .tables import QualityTables
+
+
+def accumulate_likelihoods(codes: np.ndarray, quals: np.ndarray, tables: QualityTables):
+    """Kahan-accumulate per-position log-likelihoods over reads.
+
+    Args:
+      codes: (R, L) uint8 base codes, 0..3 = ACGT, 4 = N/pad (skipped).
+      quals: (R, L) uint8 Phred qualities.
+      tables: QualityTables for the (pre, post) error rates.
+
+    Returns:
+      likelihoods: (L, 4) f64 accumulated log-likelihoods.
+      observations: (L, 4) int64 per-base observation counts.
+    """
+    R, L = codes.shape
+    sums = np.zeros((L, 4), dtype=np.float64)
+    comps = np.zeros((L, 4), dtype=np.float64)
+    observations = np.zeros((L, 4), dtype=np.int64)
+
+    lanes = np.arange(4, dtype=np.uint8)
+    for r in range(R):
+        code_r = codes[r]
+        valid = code_r != N_CODE
+        q_idx = np.minimum(quals[r], MAX_PHRED).astype(np.intp)
+        ln_correct = tables.adjusted_correct[q_idx]
+        ln_err_alt = tables.adjusted_error_per_alt[q_idx]
+        one_hot = code_r[:, None] == lanes[None, :]
+        values = np.where(one_hot, ln_correct[:, None], ln_err_alt[:, None])
+
+        # Kahan step, exactly as base_builder.rs:633-641, masked per position.
+        # (-inf values legitimately produce NaN compensation terms, as in the
+        # reference; see test_q0_pileup_nan_poisoning_matches_reference.)
+        with np.errstate(invalid="ignore"):
+            y = values - comps
+            t = sums + y
+            new_comps = (t - sums) - y
+        sums = np.where(valid[:, None], t, sums)
+        comps = np.where(valid[:, None], new_comps, comps)
+        observations += np.where(valid[:, None], one_hot.astype(np.int64), 0)
+
+    return sums, observations
+
+
+def call_full(likelihoods: np.ndarray, observations: np.ndarray, tables: QualityTables):
+    """The full consensus call for each position (call_full, base_builder.rs:795-852).
+
+    Args:
+      likelihoods: (L, 4) f64.
+      observations: (L, 4) int64.
+
+    Returns:
+      winner: (L,) uint8 base code (0..3, or 4 = N for no-call/tie/no-observations).
+      qual: (L,) uint8 Phred (MIN_PHRED for no-call rows).
+      depth: (L,) int64 total contributing observations.
+      errors: (L,) int64 depth minus winner-base observations (== depth for no-call,
+        matching vanilla_caller.rs:1423 where observations_for_base(N) == 0).
+    """
+    L = likelihoods.shape[0]
+    depth = observations.sum(axis=1)
+
+    # NaN lanes (Kahan poisoning after a -inf ln_correct, i.e. a Q0 observation
+    # followed by more adds on that lane) are *skipped* by the reference's
+    # partial_cmp-based running-max loop (Ordering::None => ignored); mirror that by
+    # treating them as -inf for winner selection. The NaN still flows into the
+    # normalization sum, so the final quality saturates to 0 (see ln_prob_to_phred).
+    ll_for_max = np.where(np.isnan(likelihoods), -np.inf, likelihoods)
+    max_ll = ll_for_max.max(axis=1)
+    winner = ll_for_max.argmax(axis=1)  # first occurrence == strict-> update order
+
+    # Tie iff any lane after the first-occurrence max is within f64 eps (absolute) of
+    # the max; lanes before it were forgotten when the running max last updated.
+    lane_idx = np.arange(4)
+    after = lane_idx[None, :] > winner[:, None]
+    with np.errstate(invalid="ignore"):
+        close = np.abs(likelihoods - max_ll[:, None]) <= P.F64_EPSILON
+    tie = np.any(after & close, axis=1)
+    # All-lanes -inf (every observation had ln_correct == -inf) is a tie at lane 0.
+    tie |= np.isneginf(max_ll)
+
+    ln_sum = P.ln_sum_exp4(likelihoods)
+    ln_posterior = max_ll - ln_sum
+    ln_consensus_error = P.ln_not(ln_posterior)
+    ln_final_error = P.ln_error_prob_two_trials(
+        np.full(L, tables.ln_error_pre_umi), ln_consensus_error
+    )
+    qual = P.ln_prob_to_phred(ln_final_error)
+
+    no_call = tie | (depth == 0)
+    winner = np.where(no_call, N_CODE, winner).astype(np.uint8)
+    qual = np.where(no_call, MIN_PHRED, qual).astype(np.uint8)
+
+    winner_obs = np.where(
+        no_call, 0, np.take_along_axis(observations, np.minimum(winner, 3)[:, None], axis=1)[:, 0]
+    )
+    errors = depth - winner_obs
+    return winner, qual, depth, errors
+
+
+def call_family(codes: np.ndarray, quals: np.ndarray, tables: QualityTables):
+    """Accumulate + call for one family's padded (R, L) arrays. See call_full."""
+    likelihoods, observations = accumulate_likelihoods(codes, quals, tables)
+    return call_full(likelihoods, observations, tables)
+
+
+def apply_consensus_thresholds(winner, qual, depth, min_reads: int, min_consensus_qual: int):
+    """Post-call masking (vanilla_caller.rs:1427-1433).
+
+    depth < min_reads -> (N, 0); qual < min_consensus_base_quality -> (N, MIN_PHRED).
+    Returns (bases_code, quals) after masking.
+    """
+    low_depth = depth < min_reads
+    low_qual = qual < min_consensus_qual
+    out_base = np.where(low_depth | low_qual, N_CODE, winner).astype(np.uint8)
+    out_qual = np.where(low_depth, 0, np.where(low_qual, MIN_PHRED, qual)).astype(np.uint8)
+    return out_base, out_qual
+
+
+def single_read_consensus(codes: np.ndarray, quals: np.ndarray, tables: QualityTables,
+                          min_consensus_qual: int):
+    """Single-read fast path (vanilla_caller.rs:1361-1392).
+
+    Quality is remapped through the single-input table; bases below the consensus
+    threshold mask to (N, MIN_PHRED); depth = 1 where base != N; errors = 0.
+    """
+    codes = np.asarray(codes)
+    q_idx = np.minimum(quals, MAX_PHRED).astype(np.intp)
+    adj = tables.single_input_quals[q_idx]
+    low = adj < min_consensus_qual
+    out_base = np.where(low, N_CODE, codes).astype(np.uint8)
+    out_qual = np.where(low, MIN_PHRED, adj).astype(np.uint8)
+    depth = (codes != N_CODE).astype(np.int64)
+    errors = np.zeros_like(depth)
+    return out_base, out_qual, depth, errors

@@ -1,0 +1,164 @@
+"""Phred / log-probability math, vectorized NumPy f64.
+
+This module is the numerics parity anchor for the whole framework: it reproduces the
+exact operation chains of fgbio's NumericTypes.scala as realized by the reference
+(/root/reference/crates/fgumi-consensus/src/phred.rs), including branch thresholds and
+floating-point evaluation order, so that integer Phred outputs match bit-for-bit.
+
+All functions accept scalars or NumPy arrays (f64) and are branch-free via np.where /
+np.piecewise-style masking, preserving the scalar code's per-element semantics.
+"""
+
+import numpy as np
+
+from ..constants import MAX_PHRED, MIN_PHRED
+
+LN_10 = np.log(10.0)
+LN_TWO = np.log(2.0)
+# ln(4/3), the two-trials cross term (phred.rs:19).
+LN_FOUR_THIRDS = 0.2876820724517809
+# Precision constant in Phred conversion, matching fgbio (phred.rs:31).
+PHRED_PRECISION = 0.001
+# phred_to_ln_error(MAX_PHRED), the Q93 saturation threshold (phred.rs:34).
+MAX_PHRED_AS_LN_ERROR = -float(MAX_PHRED) * LN_10 / 10.0
+
+F64_EPSILON = np.finfo(np.float64).eps
+
+
+def phred_to_ln_error(phred):
+    """ln P(error) for a Phred score: -Q * ln(10) / 10 (phred.rs:66-68)."""
+    return -np.asarray(phred, dtype=np.float64) * LN_10 / 10.0
+
+
+def log1pexp(x):
+    """log(1 + exp(x)) with fgbio's threshold scheme (phred.rs:148-158).
+
+    Thresholds: x<=-37 -> exp(x); x<=18 -> log1p(exp(x)); x<=33.3 -> x+exp(-x); else x.
+    """
+    x = np.asarray(x, dtype=np.float64)
+    return np.where(
+        x <= -37.0,
+        np.exp(np.minimum(x, 0.0)),
+        np.where(
+            x <= 18.0,
+            np.log1p(np.exp(np.minimum(x, 18.0))),
+            np.where(x <= 33.3, x + np.exp(-np.maximum(x, 18.0)), x),
+        ),
+    )
+
+
+def ln_one_minus_exp(x):
+    """ln(1 - exp(x)) for x <= 0, stable (phred.rs:168-181).
+
+    x >= 0 -> -inf; x >= -ln2 -> log(-expm1(x)); else log1p(-exp(x)).
+    """
+    x = np.asarray(x, dtype=np.float64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        near = np.log(-np.expm1(np.minimum(x, 0.0)))
+        far = np.log1p(-np.exp(np.minimum(x, 0.0)))
+    return np.where(x >= 0.0, -np.inf, np.where(x >= -LN_TWO, near, far))
+
+
+def phred_to_ln_correct(phred):
+    """ln P(correct) = ln(1 - P(error)) (phred.rs:89-92)."""
+    return ln_one_minus_exp(phred_to_ln_error(phred))
+
+
+def ln_prob_to_phred(ln_prob):
+    """Log error probability -> integer Phred, fgbio rounding (phred.rs:119-135).
+
+    floor(-10 * ln/LN10 + 0.001) clamped to [MIN_PHRED, MAX_PHRED]; inputs below the
+    Q93-as-ln threshold short-circuit to MAX_PHRED.
+    """
+    ln_prob = np.asarray(ln_prob, dtype=np.float64)
+    phred = np.floor(-10.0 * ln_prob / LN_10 + PHRED_PRECISION)
+    phred = np.clip(phred, float(MIN_PHRED), float(MAX_PHRED))
+    out = np.where(ln_prob < MAX_PHRED_AS_LN_ERROR, float(MAX_PHRED), phred)
+    # NaN input (a NaN-poisoned likelihood chain, e.g. a Q0 observation followed by
+    # further observations) saturates to 0, matching Rust's `NaN as u8` cast in
+    # phred.rs:119-135's clamp-then-cast.
+    out = np.where(np.isnan(out), 0.0, out)
+    return out.astype(np.uint8)
+
+
+def ln_sum_exp(ln_a, ln_b):
+    """log(exp(a) + exp(b)), fgbio's `or` (phred.rs:291-302).
+
+    -inf operands are absorbed; otherwise min + log1pexp(max - min), evaluated with the
+    smaller operand first exactly as the scalar code orders it.
+    """
+    ln_a = np.asarray(ln_a, dtype=np.float64)
+    ln_b = np.asarray(ln_b, dtype=np.float64)
+    lo = np.minimum(ln_a, ln_b)
+    hi = np.maximum(ln_a, ln_b)
+    with np.errstate(invalid="ignore"):
+        combined = lo + log1pexp(hi - lo)
+    a_ninf = np.isneginf(ln_a)
+    b_ninf = np.isneginf(ln_b)
+    return np.where(a_ninf, ln_b, np.where(b_ninf, ln_a, combined))
+
+
+def ln_sum_exp4(values):
+    """log-sum-exp over the last axis of a (..., 4) array, fgbio lane ordering.
+
+    Mirrors ln_sum_exp_array (phred.rs:324-351): the accumulator is seeded with the
+    minimum lane (first occurrence), then the remaining lanes are folded **in index
+    order** via pairwise ln_sum_exp. The fold order affects the final ulp, so it is
+    replicated exactly. All-(-inf) rows return -inf.
+    """
+    values = np.asarray(values, dtype=np.float64)
+    assert values.shape[-1] == 4
+    # First-occurrence argmin matches the scalar loop's strict `<` update.
+    min_idx = np.argmin(values, axis=-1)
+    acc = np.take_along_axis(values, min_idx[..., None], axis=-1)[..., 0]
+    for lane in range(4):
+        lane_vals = values[..., lane]
+        folded = ln_sum_exp(acc, lane_vals)
+        acc = np.where(min_idx == lane, acc, folded)
+    all_ninf = np.all(np.isneginf(values), axis=-1)
+    return np.where(all_ninf, -np.inf, acc)
+
+
+def ln_a_minus_b(a, b):
+    """log(exp(a) - exp(b)) for a >= b (phred.rs:203-215).
+
+    b = -inf -> a; |a-b| < f64 eps -> -inf; genuine a < b is a caller error (asserted).
+    """
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    b_ninf = np.isneginf(b)
+    with np.errstate(invalid="ignore"):
+        near_equal = np.abs(a - b) < F64_EPSILON
+        bad = (a < b) & ~near_equal & ~b_ninf
+    if np.any(bad):
+        raise FloatingPointError("ln_a_minus_b: subtraction would be negative")
+    with np.errstate(invalid="ignore"):
+        diff = a + ln_one_minus_exp(np.minimum(b - a, 0.0))
+    return np.where(b_ninf, a, np.where(near_equal, -np.inf, diff))
+
+
+def ln_error_prob_two_trials(ln_p1, ln_p2):
+    """P(error over two independent trials), f(X,Y) = X + Y - 4/3*X*Y in log space.
+
+    Mirrors phred.rs:248-267: operands ordered so the larger is first; a log-space gap
+    >= 6 short-circuits to the larger; otherwise ln_a_minus_b(ln_sum_exp(p1,p2),
+    ln(4/3)+p1+p2).
+    """
+    ln_p1 = np.asarray(ln_p1, dtype=np.float64)
+    ln_p2 = np.asarray(ln_p2, dtype=np.float64)
+    hi = np.maximum(ln_p1, ln_p2)
+    lo = np.minimum(ln_p1, ln_p2)
+    with np.errstate(invalid="ignore"):
+        quick = (hi - lo) >= 6.0
+    term1 = ln_sum_exp(hi, lo)
+    term2 = LN_FOUR_THIRDS + hi + lo
+    # Where the quick path applies term2 may exceed term1; feed safe values through
+    # ln_a_minus_b there and overwrite with the quick answer afterwards.
+    safe_term2 = np.where(quick, -np.inf, term2)
+    full = ln_a_minus_b(term1, safe_term2)
+    return np.where(quick, hi, full)
+
+
+def ln_not(x):
+    """ln(1 - exp(x)) (phred.rs:365-367)."""
+    return ln_one_minus_exp(x)

@@ -1,0 +1,70 @@
+"""Precomputed per-quality lookup tables for consensus calling.
+
+Mirrors ConsensusBaseBuilder::new (/root/reference/crates/fgumi-consensus/src/base_builder.rs:566-595)
+and VanillaUmiConsensusCaller::compute_single_input_consensus_quals
+(/root/reference/crates/fgumi-consensus/src/vanilla_caller.rs:470-489).
+
+Tables are built once per (pre, post) error-rate pair in f64 on host; the device kernel
+consumes f32 casts of these (the f64 values remain the parity reference).
+"""
+
+from dataclasses import dataclass
+from functools import lru_cache
+
+import numpy as np
+
+from ..constants import MAX_PHRED
+from . import phred as P
+
+LN_3 = np.log(3.0)
+
+
+@dataclass(frozen=True)
+class QualityTables:
+    """Per-quality log-probability tables for one (pre, post) error-rate pair."""
+
+    error_rate_pre_umi: int
+    error_rate_post_umi: int
+    # ln P(observed base | true base), adjusted for post-UMI error; index = Phred 0..93.
+    adjusted_correct: np.ndarray
+    # ln(P(error)/3) for a specific wrong base; index = Phred 0..93.
+    adjusted_error_per_alt: np.ndarray
+    # ln P(pre-UMI error).
+    ln_error_pre_umi: float
+    # Single-read consensus output quality per input quality (u8; vanilla_caller.rs:470-489).
+    single_input_quals: np.ndarray
+
+
+@lru_cache(maxsize=64)
+def quality_tables(error_rate_pre_umi: int, error_rate_post_umi: int) -> QualityTables:
+    """Build (and memoize) the quality tables for one error-rate pair."""
+    quals = np.arange(MAX_PHRED + 1, dtype=np.float64)
+    ln_error_seq = P.phred_to_ln_error(quals)
+    ln_error_post = float(P.phred_to_ln_error(error_rate_post_umi))
+
+    # adjusted error = two-trials(post-UMI, sequencing) (base_builder.rs:574-581)
+    adjusted_error = P.ln_error_prob_two_trials(
+        np.full_like(ln_error_seq, ln_error_post), ln_error_seq
+    )
+    adjusted_correct = P.ln_not(adjusted_error)
+    adjusted_error_per_alt = adjusted_error - LN_3
+
+    ln_error_pre_umi = float(P.phred_to_ln_error(error_rate_pre_umi))
+
+    # Single-input consensus quality: two-trials(seq, min(pre, post)) -> Phred,
+    # capped at MAX_PHRED (vanilla_caller.rs:470-489).
+    labeling = min(error_rate_pre_umi, error_rate_post_umi)
+    ln_labeling = float(P.phred_to_ln_error(labeling))
+    single = P.ln_prob_to_phred(
+        P.ln_error_prob_two_trials(ln_error_seq, np.full_like(ln_error_seq, ln_labeling))
+    )
+    single = np.minimum(single, MAX_PHRED).astype(np.uint8)
+
+    return QualityTables(
+        error_rate_pre_umi=error_rate_pre_umi,
+        error_rate_post_umi=error_rate_post_umi,
+        adjusted_correct=adjusted_correct,
+        adjusted_error_per_alt=adjusted_error_per_alt,
+        ln_error_pre_umi=ln_error_pre_umi,
+        single_input_quals=single,
+    )

@@ -1,0 +1,99 @@
+"""Device kernel vs f64 oracle: integer-exact parity after host fallback.
+
+This is the TPU analog of the reference's fast-path-vs-oracle agreement sweeps
+(base_builder.rs tests `test_unanimous_fast_path_matches_full_calculation` and
+`test_fast_path_matches_call_full_at_deep_cap_region`): the f32 device path plus
+suspect-fallback must reproduce the f64 oracle's integer outputs exactly, and the
+fallback rate must stay small enough not to erase the device win.
+"""
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.ops import oracle
+from fgumi_tpu.ops.kernel import ConsensusKernel
+from fgumi_tpu.ops.tables import quality_tables
+
+TABLES = quality_tables(45, 40)
+
+
+def make_families(rng, F, R, L, err_rate=0.05, n_rate=0.02, qlo=10, qhi=45):
+    """Synthetic UMI families: a true sequence per family + per-read errors."""
+    truth = rng.integers(0, 4, size=(F, 1, L))
+    codes = np.broadcast_to(truth, (F, R, L)).copy()
+    errs = rng.random((F, R, L)) < err_rate
+    codes[errs] = rng.integers(0, 4, size=int(errs.sum()))
+    ns = rng.random((F, R, L)) < n_rate
+    codes[ns] = 4
+    quals = rng.integers(qlo, qhi + 1, size=(F, R, L))
+    return codes.astype(np.uint8), quals.astype(np.uint8)
+
+
+def assert_parity(kernel, codes, quals):
+    w, q, d, e = kernel(codes, quals)
+    F = codes.shape[0]
+    for f in range(F):
+        ow, oq, od, oe = oracle.call_family(codes[f], quals[f], kernel.tables)
+        np.testing.assert_array_equal(w[f], ow, err_msg=f"winner mismatch family {f}")
+        np.testing.assert_array_equal(q[f], oq, err_msg=f"qual mismatch family {f}")
+        np.testing.assert_array_equal(d[f], od, err_msg=f"depth mismatch family {f}")
+        np.testing.assert_array_equal(e[f], oe, err_msg=f"errors mismatch family {f}")
+
+
+@pytest.mark.parametrize("seed,R", [(0, 2), (1, 5), (2, 10), (3, 30), (4, 80)])
+def test_parity_random_families(seed, R):
+    rng = np.random.default_rng(seed)
+    kernel = ConsensusKernel(TABLES)
+    codes, quals = make_families(rng, F=64, R=R, L=48)
+    assert_parity(kernel, codes, quals)
+
+
+def test_parity_high_error_rate():
+    rng = np.random.default_rng(7)
+    kernel = ConsensusKernel(TABLES)
+    codes, quals = make_families(rng, F=48, R=8, L=32, err_rate=0.4, qlo=2, qhi=60)
+    assert_parity(kernel, codes, quals)
+
+
+def test_parity_deep_cap_region():
+    # deep unanimous pileups: the regime where the reference's naive fast path broke
+    rng = np.random.default_rng(11)
+    kernel = ConsensusKernel(TABLES)
+    codes, quals = make_families(rng, F=8, R=500, L=16, err_rate=0.0, n_rate=0.0)
+    assert_parity(kernel, codes, quals)
+
+
+def test_parity_symmetric_ties():
+    # exact symmetric disagreements must resolve identically (tie -> N or ulp winner)
+    kernel = ConsensusKernel(TABLES)
+    codes = np.array([[[0] * 8, [1] * 8]], dtype=np.uint8)  # 1 family, A vs C
+    quals = np.full((1, 2, 8), 30, dtype=np.uint8)
+    assert_parity(kernel, codes, quals)
+
+
+def test_parity_q0_nan_poisoning():
+    # A@Q0 + 2x C@Q30: the -inf table entry NaN-poisons the device contributions;
+    # the nonfinite suspect gate must route the position to the exact host path.
+    kernel = ConsensusKernel(TABLES)
+    codes = np.array([[[0, 0], [1, 1], [1, 1]]], dtype=np.uint8)
+    quals = np.array([[[0, 30], [30, 30], [30, 30]]], dtype=np.uint8)
+    assert_parity(kernel, codes, quals)
+    assert kernel.fallback_positions >= 1
+
+
+def test_parity_other_rates():
+    rng = np.random.default_rng(13)
+    for pre, post in [(30, 30), (60, 50), (45, 10)]:
+        kernel = ConsensusKernel(quality_tables(pre, post))
+        codes, quals = make_families(rng, F=32, R=6, L=24, err_rate=0.1)
+        assert_parity(kernel, codes, quals)
+
+
+def test_fallback_rate_bounded():
+    rng = np.random.default_rng(17)
+    kernel = ConsensusKernel(TABLES)
+    for R in (3, 5, 10, 20, 50):
+        codes, quals = make_families(rng, F=64, R=R, L=64)
+        kernel(codes, quals)
+    rate = kernel.fallback_positions / kernel.total_positions
+    assert rate < 0.05, f"suspect fallback rate too high: {rate:.3%}"

@@ -1,0 +1,165 @@
+"""Vectorized f64 oracle vs the literal scalar builder, plus semantic edge cases."""
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.constants import MIN_PHRED, N_CODE
+from fgumi_tpu.ops import oracle
+from fgumi_tpu.ops.tables import quality_tables
+
+from scalar_ref import ScalarBaseBuilder
+
+TABLES = quality_tables(45, 40)
+
+
+def scalar_call_positions(codes, quals, tables=TABLES):
+    """Run the scalar builder per position over padded (R, L) arrays."""
+    R, L = codes.shape
+    b = ScalarBaseBuilder(tables)
+    out = []
+    for pos in range(L):
+        b.reset()
+        for r in range(R):
+            b.add(int(codes[r, pos]), int(quals[r, pos]))
+        code, qual = b.call()
+        depth = b.contributions()
+        obs_winner = b.observations[code] if code < 4 else 0
+        out.append((code, qual, depth, depth - obs_winner))
+    return out
+
+
+def assert_matches_scalar(codes, quals, tables=TABLES):
+    w, q, d, e = oracle.call_family(codes, quals, tables)
+    expected = scalar_call_positions(codes, quals, tables)
+    for pos, (code, qual, depth, errors) in enumerate(expected):
+        assert int(w[pos]) == code, f"pos {pos}: winner {int(w[pos])} != {code}"
+        assert int(q[pos]) == qual, f"pos {pos}: qual {int(q[pos])} != {qual}"
+        assert int(d[pos]) == depth, f"pos {pos}: depth"
+        assert int(e[pos]) == errors, f"pos {pos}: errors"
+
+
+def test_unanimous_agreement():
+    codes = np.zeros((5, 10), dtype=np.uint8)  # 5 reads, all A
+    quals = np.full((5, 10), 30, dtype=np.uint8)
+    w, q, d, e = oracle.call_family(codes, quals, TABLES)
+    assert np.all(w == 0)
+    assert np.all(d == 5)
+    assert np.all(e == 0)
+    assert np.all(q > 30)  # consensus of five Q30 reads beats one read
+    assert_matches_scalar(codes, quals)
+
+
+def test_empty_position_no_call():
+    codes = np.full((3, 4), N_CODE, dtype=np.uint8)
+    quals = np.full((3, 4), 30, dtype=np.uint8)
+    w, q, d, e = oracle.call_family(codes, quals, TABLES)
+    assert np.all(w == N_CODE)
+    assert np.all(q == MIN_PHRED)
+    assert np.all(d == 0)
+    assert np.all(e == 0)
+
+
+def test_exact_tie_is_no_call():
+    # two reads, same quality, different bases -> symmetric likelihoods -> tie
+    codes = np.array([[0], [1]], dtype=np.uint8)
+    quals = np.full((2, 1), 30, dtype=np.uint8)
+    w, q, d, e = oracle.call_family(codes, quals, TABLES)
+    assert int(w[0]) == N_CODE
+    assert int(q[0]) == MIN_PHRED
+    assert int(d[0]) == 2
+    assert int(e[0]) == 2  # winner N has zero observations
+    assert_matches_scalar(codes, quals)
+
+
+def test_disagreement_quality_drops():
+    # 2 A's and 1 C at Q20 (below the pre-UMI cap regime): winner A, errors 1,
+    # quality strictly below the unanimous 3-read case
+    codes = np.array([[0], [0], [1]], dtype=np.uint8)
+    quals = np.full((3, 1), 20, dtype=np.uint8)
+    w, q, d, e = oracle.call_family(codes, quals, TABLES)
+    assert int(w[0]) == 0
+    assert int(d[0]) == 3
+    assert int(e[0]) == 1
+    codes_u = np.zeros((3, 1), dtype=np.uint8)
+    _, q_u, _, _ = oracle.call_family(codes_u, quals, TABLES)
+    assert int(q[0]) < int(q_u[0])
+    assert_matches_scalar(codes, quals)
+
+
+def test_q0_observation_degenerate():
+    # quality 0 gives adjusted error 1 -> ln_correct = -inf on the matching lane
+    codes = np.array([[0]], dtype=np.uint8)
+    quals = np.array([[0]], dtype=np.uint8)
+    assert_matches_scalar(codes, quals)
+
+
+def test_q0_pileup_nan_poisoning_matches_reference():
+    # A@Q0 then C@Q30, C@Q30: the Q0 add drives lane A's Kahan state to -inf/NaN and
+    # subsequent adds poison it to NaN. The reference's partial_cmp max loop skips the
+    # NaN lane (winner = C) and the NaN normalization sum saturates the quality to 0
+    # (Rust `NaN as u8`). Pin both here.
+    codes = np.array([[0], [1], [1]], dtype=np.uint8)
+    quals = np.array([[0], [30], [30]], dtype=np.uint8)
+    w, q, d, e = oracle.call_family(codes, quals, TABLES)
+    assert int(w[0]) == 1  # C, the best non-NaN lane
+    assert int(q[0]) == 0
+    assert int(d[0]) == 3
+    assert int(e[0]) == 1
+    assert_matches_scalar(codes, quals)
+
+
+def test_pre_umi_cap():
+    # 50 unanimous Q40 reads: quality is capped by the pre-UMI error rate (Q45 -> cap 45)
+    codes = np.zeros((50, 1), dtype=np.uint8)
+    quals = np.full((50, 1), 40, dtype=np.uint8)
+    w, q, d, e = oracle.call_family(codes, quals, TABLES)
+    assert int(q[0]) == 45
+    assert_matches_scalar(codes, quals)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_random_families_match_scalar(seed):
+    rng = np.random.default_rng(seed)
+    R = int(rng.integers(1, 12))
+    L = int(rng.integers(1, 24))
+    codes = rng.integers(0, 5, size=(R, L)).astype(np.uint8)  # includes N
+    quals = rng.integers(2, 45, size=(R, L)).astype(np.uint8)
+    assert_matches_scalar(codes, quals)
+
+
+# post-UMI rate 0 NaN-poisons every lane's Kahan accumulator (the reference behaves
+# the same: -inf compensation terms) and is outside the parity contract — the vanilla
+# caller masks sub-threshold bases to N before the builder ever sees them. Isolated
+# Q0 observations ARE in contract (test_q0_pileup_nan_poisoning_matches_reference).
+@pytest.mark.parametrize("pre,post", [(45, 40), (30, 30), (60, 50), (45, 10), (20, 93)])
+def test_other_error_rates(pre, post):
+    tables = quality_tables(pre, post)
+    rng = np.random.default_rng(99)
+    codes = rng.integers(0, 5, size=(6, 12)).astype(np.uint8)
+    quals = rng.integers(2, 60, size=(6, 12)).astype(np.uint8)
+    assert_matches_scalar(codes, quals, tables)
+
+
+def test_thresholds():
+    winner = np.array([0, 1, 2, 3], dtype=np.uint8)
+    qual = np.array([50, 39, 45, 41], dtype=np.uint8)
+    depth = np.array([5, 5, 1, 2], dtype=np.int64)
+    b, q = oracle.apply_consensus_thresholds(winner, qual, depth, min_reads=2,
+                                             min_consensus_qual=40)
+    assert list(b) == [0, N_CODE, N_CODE, 3]
+    assert list(q) == [50, MIN_PHRED, 0, 41]
+
+
+def test_single_read_consensus():
+    codes = np.array([0, 1, 4, 2], dtype=np.uint8)
+    quals = np.array([93, 30, 50, 93], dtype=np.uint8)
+    b, q, d, e = oracle.single_read_consensus(codes, quals, TABLES, min_consensus_qual=40)
+    # Q93 input: labeling error (min(pre,post)=Q40) dominates via the >=6-gap quick
+    # path -> exactly Q40, which passes the threshold. Q30 input: two-trials pushes it
+    # below Q40 -> masked.
+    assert int(b[0]) == 0 and int(q[0]) == 40
+    assert int(b[1]) == N_CODE and int(q[1]) == MIN_PHRED
+    assert int(d[2]) == 0  # N base contributes no depth
+    assert np.all(e == 0)
+    # single-input qual can never exceed the labeling cap (min(pre,post) = 40)
+    assert int(q[3]) <= 40

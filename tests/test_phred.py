@@ -1,0 +1,106 @@
+"""Unit tests for fgumi_tpu.ops.phred — parity with fgbio/fgumi semantics.
+
+Expected values mirror the doctests and unit tests of
+/root/reference/crates/fgumi-consensus/src/phred.rs.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.ops import phred as P
+
+
+def test_phred_to_ln_error():
+    assert math.isclose(P.phred_to_ln_error(10), math.log(0.1), abs_tol=1e-10)
+    assert math.isclose(P.phred_to_ln_error(20), math.log(0.01), abs_tol=1e-10)
+    assert math.isclose(P.phred_to_ln_error(30), math.log(0.001), abs_tol=1e-10)
+
+
+def test_phred_to_ln_correct():
+    assert math.isclose(P.phred_to_ln_correct(30), math.log(0.999), abs_tol=1e-6)
+    assert math.isclose(P.phred_to_ln_correct(20), math.log(0.99), abs_tol=1e-6)
+
+
+def test_ln_prob_to_phred_round_trip():
+    for q in [2, 10, 20, 30, 40, 50, 60, 93]:
+        assert P.ln_prob_to_phred(P.phred_to_ln_error(q)) == q
+
+
+def test_ln_prob_to_phred_clamps():
+    assert P.ln_prob_to_phred(math.log(1e-20)) == 93
+    assert P.ln_prob_to_phred(0.0) == 2  # P(error)=1 clamps to MIN_PHRED
+    assert P.ln_prob_to_phred(P.phred_to_ln_error(0)) == 2
+    assert P.ln_prob_to_phred(P.phred_to_ln_error(1)) == 2
+
+
+def test_ln_sum_exp_basic():
+    r = P.ln_sum_exp(math.log(0.1), math.log(0.2))
+    assert math.isclose(float(r), math.log(0.3), abs_tol=1e-10)
+    r = P.ln_sum_exp(math.log(1e-100), math.log(2e-100))
+    assert math.isclose(float(r), math.log(3e-100), abs_tol=1e-10)
+
+
+def test_ln_sum_exp_neg_inf_absorbed():
+    assert float(P.ln_sum_exp(-np.inf, math.log(0.5))) == math.log(0.5)
+    assert float(P.ln_sum_exp(math.log(0.5), -np.inf)) == math.log(0.5)
+    assert np.isneginf(P.ln_sum_exp(-np.inf, -np.inf))
+
+
+def test_ln_sum_exp4():
+    vals = np.log(np.array([[0.1, 0.2, 0.25, 0.05]]))
+    r = P.ln_sum_exp4(vals)
+    assert math.isclose(float(r[0]), math.log(0.6), abs_tol=1e-10)
+    # one -inf lane must not sink the sum (phred.rs:324-351 doc)
+    vals = np.array([[math.log(0.1), -np.inf, math.log(0.2), math.log(0.3)]])
+    assert math.isclose(float(P.ln_sum_exp4(vals)[0]), math.log(0.6), abs_tol=1e-10)
+    # all -inf -> -inf
+    assert np.isneginf(P.ln_sum_exp4(np.full((1, 4), -np.inf))[0])
+
+
+def test_two_trials_full_formula():
+    ln_p = math.log(0.1)
+    r = float(P.ln_error_prob_two_trials(ln_p, ln_p))
+    expected = 0.1 + 0.1 - (4.0 / 3.0) * 0.1 * 0.1
+    assert math.isclose(math.exp(r), expected, abs_tol=1e-10)
+
+
+def test_two_trials_quick_path():
+    # gap >= 6 in log space returns the larger error verbatim
+    big, small = math.log(0.1), math.log(0.1) - 7.0
+    assert float(P.ln_error_prob_two_trials(big, small)) == big
+    assert float(P.ln_error_prob_two_trials(small, big)) == big
+
+
+def test_two_trials_neg_inf():
+    assert np.isneginf(P.ln_error_prob_two_trials(-np.inf, -np.inf))
+    # one certain-no-error trial -> the other's error dominates (gap = inf >= 6)
+    assert float(P.ln_error_prob_two_trials(math.log(0.01), -np.inf)) == math.log(0.01)
+
+
+def test_ln_one_minus_exp_branches():
+    # near-zero branch (x >= -ln2)
+    x = math.log(0.9)
+    assert math.isclose(float(P.ln_one_minus_exp(x)), math.log(0.1), abs_tol=1e-12)
+    # far branch
+    x = math.log(0.001)
+    assert math.isclose(float(P.ln_one_minus_exp(x)), math.log(0.999), abs_tol=1e-12)
+    assert np.isneginf(P.ln_one_minus_exp(0.0))
+    assert float(P.ln_one_minus_exp(-np.inf)) == 0.0
+
+
+def test_log1pexp_thresholds():
+    for x in [-50.0, -37.0, -10.0, 0.0, 5.0, 18.0, 20.0, 33.3, 40.0]:
+        got = float(P.log1pexp(x))
+        want = math.log1p(math.exp(x)) if x < 700 else x
+        assert math.isclose(got, want, rel_tol=1e-12, abs_tol=1e-15), x
+
+
+def test_vectorized_matches_scalar():
+    rng = np.random.default_rng(0)
+    a = np.log(rng.uniform(1e-12, 1.0, size=1000))
+    b = np.log(rng.uniform(1e-12, 1.0, size=1000))
+    vec = P.ln_error_prob_two_trials(a, b)
+    for i in range(0, 1000, 97):
+        assert float(P.ln_error_prob_two_trials(a[i], b[i])) == vec[i]

@@ -1,0 +1,101 @@
+"""Benchmark: simplex consensus reads/sec, end-to-end on the real device.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+- value: end-to-end `simplex` pipeline throughput (input reads consumed per second,
+  BAM in -> consensus BAM out) on a simulated mixed-size family workload
+  (BASELINE.md config 1 analog, scaled to bench time budget).
+- vs_baseline: ratio against the best available CPU implementation in this repo —
+  the same pipeline with the consensus inner loop running the vectorized f64 NumPy
+  oracle on host instead of the device kernel. The reference's Rust CPU binary
+  cannot be built in this image (no cargo), so the CPU baseline is measured locally
+  (BASELINE.md notes the reference publishes no absolute numbers).
+"""
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+
+def run_pipeline(in_bam, out_bam, use_device=True):
+    from fgumi_tpu.consensus.vanilla import VanillaConsensusCaller, VanillaOptions
+    from fgumi_tpu.core.grouper import iter_mi_group_batches
+    from fgumi_tpu.io.bam import BamHeader, BamReader, BamWriter
+    from fgumi_tpu.ops import oracle
+
+    opts = VanillaOptions(min_reads=1)
+    caller = VanillaConsensusCaller("fgumi", "A", opts)
+    if not use_device:
+        # CPU baseline: identical pipeline, inner loop = f64 NumPy oracle per family
+        class HostKernel:
+            tables = caller.tables
+            fallback_positions = 0
+            total_positions = 0
+
+            def __call__(self, codes, quals):
+                F = codes.shape[0]
+                outs = [oracle.call_family(codes[f], quals[f], self.tables)
+                        for f in range(F)]
+                return tuple(np.stack([o[i] for o in outs]) for i in range(4))
+
+        caller.kernel = HostKernel()
+
+    t0 = time.monotonic()
+    n_in = n_out = 0
+    with BamReader(in_bam) as reader:
+        header = BamHeader(text="@HD\tVN:1.6\n@RG\tID:A\n", ref_names=[], ref_lengths=[])
+        with BamWriter(out_bam, header) as writer:
+            for batch in iter_mi_group_batches(reader, 2000):
+                n_in += sum(len(recs) for _, recs in batch)
+                for rec_bytes in caller.call_groups(batch):
+                    writer.write_record_bytes(rec_bytes)
+                    n_out += 1
+    dt = time.monotonic() - t0
+    return n_in, n_out, dt
+
+
+def main():
+    from fgumi_tpu.simulate import simulate_grouped_bam
+
+    tmp = tempfile.mkdtemp(prefix="fgumi_bench_")
+    sim = os.path.join(tmp, "sim.bam")
+    n_families = int(os.environ.get("BENCH_FAMILIES", "4000"))
+    simulate_grouped_bam(sim, num_families=n_families, family_size=5,
+                         family_size_distribution="lognormal", read_length=100,
+                         error_rate=0.01, seed=42)
+
+    # warm-up (compile cache) then timed run
+    run_pipeline(sim, os.path.join(tmp, "warm.bam"), use_device=True)
+    n_in, n_out, dt = run_pipeline(sim, os.path.join(tmp, "tpu.bam"), use_device=True)
+    tpu_rps = n_in / dt
+
+    cpu_families = max(n_families // 8, 100)
+    sim_small = os.path.join(tmp, "sim_small.bam")
+    simulate_grouped_bam(sim_small, num_families=cpu_families, family_size=5,
+                         family_size_distribution="lognormal", read_length=100,
+                         error_rate=0.01, seed=42)
+    c_in, _, c_dt = run_pipeline(sim_small, os.path.join(tmp, "cpu.bam"),
+                                 use_device=False)
+    cpu_rps = c_in / c_dt
+
+    print(json.dumps({
+        "metric": "simplex consensus pipeline throughput",
+        "value": round(tpu_rps, 1),
+        "unit": "input reads/sec",
+        "vs_baseline": round(tpu_rps / cpu_rps, 3),
+        "baseline": "same pipeline, f64 NumPy host consensus (reference Rust CPU not buildable in image)",
+        "input_reads": n_in,
+        "consensus_reads": n_out,
+        "wall_s": round(dt, 3),
+        "cpu_reads_per_sec": round(cpu_rps, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+"""fgumi-tpu command-line interface.
+
+CLI layer analog of the reference's clap subcommands (/root/reference/src/main.rs:72-221),
+argparse-based. One subcommand per tool; shared options grouped like commands/common.rs.
+"""
+
+import argparse
+import logging
+import sys
+import time
+
+log = logging.getLogger("fgumi_tpu")
+
+
+def _add_simplex(sub):
+    p = sub.add_parser("simplex", help="Call simplex consensus reads over MI groups")
+    p.add_argument("-i", "--input", required=True, help="grouped BAM (MI tags)")
+    p.add_argument("-o", "--output", required=True, help="output consensus BAM")
+    p.add_argument("--tag", default="MI")
+    p.add_argument("--read-name-prefix", default="fgumi")
+    p.add_argument("--read-group-id", default="A")
+    p.add_argument("--error-rate-pre-umi", type=int, default=45)
+    p.add_argument("--error-rate-post-umi", type=int, default=40)
+    p.add_argument("--min-input-base-quality", type=int, default=10)
+    p.add_argument("--min-reads", type=int, default=1)
+    p.add_argument("--max-reads", type=int, default=None)
+    p.add_argument("--min-consensus-base-quality", type=int, default=40)
+    p.add_argument("--trim", action="store_true")
+    p.add_argument("--no-per-base-tags", action="store_true")
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--batch-groups", type=int, default=2000,
+                   help="MI groups per device batch")
+    p.set_defaults(func=cmd_simplex)
+
+
+def cmd_simplex(args):
+    from .consensus.vanilla import VanillaConsensusCaller, VanillaOptions
+    from .core.grouper import iter_mi_group_batches
+    from .io.bam import BamHeader, BamReader, BamWriter
+
+    # mirrors the reference's argument validation (simplex.rs:521-526)
+    if args.min_reads < 1:
+        log.error("--min-reads must be >= 1 (a value of 0 admits empty groups)")
+        return 2
+    if args.max_reads is not None and args.max_reads < args.min_reads:
+        log.error("--max-reads (%d) must be >= --min-reads (%d)",
+                  args.max_reads, args.min_reads)
+        return 2
+
+    opts = VanillaOptions(
+        tag=args.tag,
+        error_rate_pre_umi=args.error_rate_pre_umi,
+        error_rate_post_umi=args.error_rate_post_umi,
+        min_input_base_quality=args.min_input_base_quality,
+        min_reads=args.min_reads,
+        max_reads=args.max_reads,
+        produce_per_base_tags=not args.no_per_base_tags,
+        seed=args.seed,
+        trim=args.trim,
+        min_consensus_base_quality=args.min_consensus_base_quality,
+    )
+    caller = VanillaConsensusCaller(args.read_name_prefix, args.read_group_id, opts)
+
+    t0 = time.monotonic()
+    with BamReader(args.input) as reader:
+        # consensus output is unmapped: no reference sequences
+        # (consensus_runner.rs:115+ unmapped-consensus header construction)
+        out_header = BamHeader(
+            text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n"
+                 f"@RG\tID:{args.read_group_id}\tSM:sample\n"
+                 "@PG\tID:fgumi-tpu\tPN:fgumi-tpu\tCL:" + " ".join(sys.argv) + "\n",
+            ref_names=[], ref_lengths=[],
+        )
+        with BamWriter(args.output, out_header) as writer:
+            n_out = 0
+            for batch in iter_mi_group_batches(reader, args.batch_groups,
+                                               tag=args.tag.encode()):
+                for rec_bytes in caller.call_groups(batch):
+                    writer.write_record_bytes(rec_bytes)
+                    n_out += 1
+    dt = time.monotonic() - t0
+    s = caller.stats
+    log.info("simplex: %d input reads -> %d consensus reads in %.2fs (%.0f reads/s)",
+             s.input_reads, n_out, dt, s.input_reads / dt if dt else 0)
+    if s.rejected:
+        log.info("rejections: %s", dict(sorted(s.rejected.items())))
+    kf, kt = caller.kernel.fallback_positions, caller.kernel.total_positions
+    if kt:
+        log.info("kernel fallback rate: %.4f%% (%d/%d positions)",
+                 100.0 * kf / kt, kf, kt)
+    return 0
+
+
+def _add_simulate(sub):
+    p = sub.add_parser("simulate", help="Generate synthetic test data")
+    ps = p.add_subparsers(dest="sim_mode", required=True)
+    g = ps.add_parser("grouped-reads", help="MI-grouped BAM (simplex input)")
+    g.add_argument("-o", "--output", required=True)
+    g.add_argument("--num-families", type=int, default=100)
+    g.add_argument("--family-size", type=int, default=5)
+    g.add_argument("--family-size-distribution", default="fixed",
+                   choices=["fixed", "lognormal"])
+    g.add_argument("--read-length", type=int, default=100)
+    g.add_argument("--error-rate", type=float, default=0.01)
+    g.add_argument("--base-quality", type=int, default=35)
+    g.add_argument("--single-end", action="store_true")
+    g.add_argument("--seed", type=int, default=42)
+    g.set_defaults(func=cmd_simulate_grouped)
+
+
+def cmd_simulate_grouped(args):
+    from .simulate import simulate_grouped_bam
+
+    n = simulate_grouped_bam(
+        args.output, num_families=args.num_families, family_size=args.family_size,
+        family_size_distribution=args.family_size_distribution,
+        read_length=args.read_length, error_rate=args.error_rate,
+        base_quality=args.base_quality, paired=not args.single_end, seed=args.seed)
+    log.info("simulate: wrote %d records to %s", n, args.output)
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="fgumi-tpu",
+        description="TPU-native toolkit for UMI-tagged sequencing data",
+    )
+    parser.add_argument("-v", "--verbose", action="store_true")
+    sub = parser.add_subparsers(dest="command", required=True)
+    _add_simplex(sub)
+    _add_simulate(sub)
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    return args.func(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""Simple (equal-quality) UMI-string consensus.
+
+Mirrors /root/reference/crates/fgumi-consensus/src/simple_umi.rs: per-position
+likelihood consensus with flat Q20 observations and Q90/Q90 error rates; non-DNA
+characters (e.g. the '-' separator in duplex UMIs) must be uniform per column and are
+preserved from the first sequence. Used for the consensus RX tag
+(vanilla_caller.rs:1522-1536).
+"""
+
+import numpy as np
+
+from ..constants import BASE_TO_CODE, CODE_TO_BASE
+from ..ops import oracle
+from ..ops.tables import quality_tables
+
+_DNA = frozenset(b"ACGTNacgtn")
+_Q_ERROR = 20
+
+
+def consensus_umis(umis) -> str:
+    """Majority/likelihood consensus over equal-length UMI strings (simple_umi.rs:236-245)."""
+    if not umis:
+        return ""
+    if len(umis) == 1:
+        return umis[0]
+    seq_len = len(umis[0])
+    if any(len(u) != seq_len for u in umis):
+        raise ValueError(f"UMI sequences must all have the same length: {umis}")
+
+    arr = np.array([np.frombuffer(u.encode(), dtype=np.uint8) for u in umis])  # (R, L)
+    is_dna = np.isin(arr, np.frombuffer(bytes(_DNA), dtype=np.uint8))
+    codes = np.where(is_dna, BASE_TO_CODE[arr], 4).astype(np.uint8)
+    quals = np.full_like(codes, _Q_ERROR)
+
+    tables = quality_tables(90, 90)
+    winner, _q, _d, _e = oracle.call_family(codes, quals, tables)
+
+    out = bytearray()
+    first = arr[0]
+    n_dna = is_dna.sum(axis=0)
+    for i in range(seq_len):
+        if n_dna[i] == 0:
+            # all non-DNA: must be the same character, preserved from the first
+            if not (arr[:, i] == first[i]).all():
+                raise ValueError(
+                    f"Sequences must have character {chr(first[i])!r} at position {i}")
+            out.append(first[i])
+        elif n_dna[i] == len(umis):
+            out.append(CODE_TO_BASE[winner[i]])
+        else:
+            raise ValueError(
+                f"Sequences contained a mix of DNA and non-DNA characters at offset {i}")
+    return out.decode()

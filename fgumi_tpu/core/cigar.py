@@ -1,0 +1,118 @@
+"""CIGAR utilities for consensus calling.
+
+Semantics mirror the reference:
+- simplify: S/=/X/H -> M, coalesce adjacent same ops
+  (/root/reference/crates/fgumi-raw-bam/src/noodles_compat.rs:10-55)
+- prefix compatibility (/root/reference/crates/fgumi-sam/src/clipper.rs:2705-2728)
+- truncate-to-query-length (vanilla_caller.rs:893-927)
+
+Simplified CIGARs are lists of (op_char, length) with ops from "MIDNP".
+"""
+
+_CONSUMES_QUERY = frozenset("MIS=X")
+
+
+def simplify(cigar):
+    """S/=/X/H become M; adjacent equal ops coalesce."""
+    out = []
+    for op, length in cigar:
+        if op in "S=XH":
+            op = "M"
+        if out and out[-1][0] == op:
+            out[-1] = (op, out[-1][1] + length)
+        else:
+            out.append((op, length))
+    return out
+
+
+def reverse(cigar):
+    return list(reversed(cigar))
+
+
+def truncate_to_query_length(cigar, query_length: int):
+    """Keep ops until `query_length` query bases are consumed (clipper semantics)."""
+    out = []
+    remaining = query_length
+    for op, length in cigar:
+        if remaining == 0:
+            break
+        if op in _CONSUMES_QUERY:
+            take = min(length, remaining)
+            out.append((op, take))
+            remaining -= take
+        else:
+            out.append((op, length))
+    return out
+
+
+def is_prefix(a, b) -> bool:
+    """True if simplified CIGAR `a` is a prefix of `b`.
+
+    All ops must match; interior lengths exactly, the last op of `a` may be shorter.
+    """
+    if len(a) > len(b):
+        return False
+    last = len(a) - 1
+    for i, (op_a, len_a) in enumerate(a):
+        op_b, len_b = b[i]
+        if op_a != op_b:
+            return False
+        if i == last:
+            if len_a > len_b:
+                return False
+        elif len_a != len_b:
+            return False
+    return True
+
+
+_OP_ORDER = {"M": 0, "I": 1, "D": 2, "N": 3, "S": 4, "H": 5, "P": 6, "=": 7, "X": 8}
+
+
+def compare(a, b) -> int:
+    """Deterministic CIGAR ordering for tie-breaks (vanilla_caller.rs:79-111).
+
+    Element-by-element: length first, then op rank; all-equal prefix -> shorter wins.
+    """
+    for (op_a, len_a), (op_b, len_b) in zip(a, b):
+        if len_a != len_b:
+            return -1 if len_a < len_b else 1
+        ra, rb = _OP_ORDER[op_a], _OP_ORDER[op_b]
+        if ra != rb:
+            return -1 if ra < rb else 1
+    if len(a) != len(b):
+        return -1 if len(a) < len(b) else 1
+    return 0
+
+
+def select_most_common_alignment_group(indexed):
+    """fgbio's filterToMostCommonAlignment core (vanilla_caller.rs:50-122).
+
+    Args:
+      indexed: [(original_index, length, simplified_cigar)] sorted by DESCENDING length.
+    Returns the indices of the winning compatibility group.
+    """
+    if len(indexed) < 2:
+        return [idx for idx, _, _ in indexed]
+
+    groups = []  # (group_cigar, [indices])
+    for idx, _length, cig in indexed:
+        found = False
+        for group_cigar, indices in groups:
+            # a read joins every group whose cigar it prefixes (no break — fgbio quirk)
+            if is_prefix(cig, group_cigar):
+                indices.append(idx)
+                found = True
+        if not found:
+            groups.append((cig, [idx]))
+
+    # larger group wins; tie -> smaller CIGAR wins
+    best = None
+    for group_cigar, indices in groups:
+        if best is None:
+            best = (group_cigar, indices)
+            continue
+        if len(indices) > len(best[1]) or (
+            len(indices) == len(best[1]) and compare(group_cigar, best[0]) < 0
+        ):
+            best = (group_cigar, indices)
+    return best[1] if best else []

@@ -1,0 +1,43 @@
+"""Streaming group formation.
+
+MiGrouper mirrors the reference's MI-tag streaming grouper
+(/root/reference/src/lib/mi_group.rs:54-336): consecutive records sharing an MI tag
+form one group; groups are yielded in input order and batched for device efficiency.
+"""
+
+
+def iter_mi_groups(records, tag: bytes = b"MI"):
+    """Yield (mi_value, [RawRecord]) for consecutive records sharing the tag.
+
+    Records missing the tag raise — simplex input must be grouped (mi_group.rs
+    contract; the reference errors likewise on missing MI).
+    """
+    current_mi = None
+    current = []
+    for rec in records:
+        mi = rec.get_str(tag)
+        if mi is None:
+            raise ValueError(
+                f"record {rec.name!r} missing {tag.decode()} tag; run `group` first"
+            )
+        if mi != current_mi:
+            if current:
+                yield current_mi, current
+            current_mi = mi
+            current = [rec]
+        else:
+            current.append(rec)
+    if current:
+        yield current_mi, current
+
+
+def iter_mi_group_batches(records, batch_size: int = 500, tag: bytes = b"MI"):
+    """Yield lists of (mi, records) of ~batch_size groups (MiGroupBatch analog)."""
+    batch = []
+    for group in iter_mi_groups(records, tag):
+        batch.append(group)
+        if len(batch) >= batch_size:
+            yield batch
+            batch = []
+    if batch:
+        yield batch

@@ -1,0 +1,177 @@
+"""Mate-overlap math: how many bases a read extends past its FR mate.
+
+Port of the semantics of /root/reference/crates/fgumi-raw-bam/src/overlap.rs:
+- is_fr_pair (per-record, htsjdk 5'-position logic, overlap.rs:14-61)
+- mate soft-clip boundary from the MC tag (overlap.rs:233-247, 277-345)
+- bases extending past the mate boundary via CIGAR walks (overlap.rs:172-231, 362-432)
+
+All positions here are 1-based (matching the reference's internal convention).
+"""
+
+from ..io.bam import (FLAG_MATE_REVERSE, FLAG_MATE_UNMAPPED, FLAG_PAIRED,
+                      FLAG_REVERSE, FLAG_UNMAPPED, RawRecord)
+
+_CIGAR_OPS = set("MIDNSHP=X")
+
+
+def parse_soft_clips_and_ref_len(cigar_str: str):
+    """(leading_soft, ref_len, trailing_soft) from a CIGAR string, or None if malformed.
+
+    Soft clips must sit at the ends (inside hard clips); hard clips only first/last;
+    a CIGAR with no reference-consuming op is invalid (overlap.rs:277-345).
+    """
+    tokens = []
+    num = 0
+    have_digits = False
+    for c in cigar_str:
+        # ASCII digits only: str.isdigit() accepts Unicode digits the reference's
+        # is_ascii_digit rejects (and some crash int()); fail closed instead.
+        if "0" <= c <= "9":
+            num = num * 10 + (ord(c) - 48)
+            have_digits = True
+            continue
+        if not have_digits or num == 0 or c not in _CIGAR_OPS:
+            return None
+        tokens.append((num, c))
+        num = 0
+        have_digits = False
+    if have_digits or not tokens:
+        return None
+
+    last = len(tokens) - 1
+    leading_soft = trailing_soft = ref_len = 0
+    saw_ref_op = False
+    for i, (length, op) in enumerate(tokens):
+        if op in "MDN=X":
+            ref_len += length
+            saw_ref_op = True
+        elif op in "IP":
+            pass
+        elif op == "S":
+            leading = all(o == "H" for _, o in tokens[:i])
+            trailing = all(o == "H" for _, o in tokens[i + 1:])
+            if not leading and not trailing:
+                return None
+            if saw_ref_op:
+                trailing_soft += length
+            else:
+                leading_soft += length
+        elif op == "H" and (i == 0 or i == last):
+            pass
+        else:
+            return None
+    if not saw_ref_op:
+        return None
+    return leading_soft, ref_len, trailing_soft
+
+
+def _ref_len_from_cigar(cigar) -> int:
+    return sum(n for op, n in cigar if op in "MDN=X")
+
+
+def _read_len_from_cigar(cigar) -> int:
+    return sum(n for op, n in cigar if op in "MIS=X")
+
+
+def _leading_soft(cigar) -> int:
+    total = 0
+    for op, n in cigar:
+        if op == "S":
+            total += n
+        elif op == "H":
+            continue
+        else:
+            break
+    return total
+
+
+def _trailing_soft(cigar) -> int:
+    return _leading_soft(list(reversed(cigar)))
+
+
+def is_fr_pair(rec: RawRecord) -> bool:
+    """Per-record FR-pair classification (overlap.rs:14-61)."""
+    flg = rec.flag
+    if not flg & FLAG_PAIRED:
+        return False
+    if flg & FLAG_UNMAPPED or flg & FLAG_MATE_UNMAPPED:
+        return False
+    if rec.ref_id != rec.next_ref_id:
+        return False
+    is_reverse = bool(flg & FLAG_REVERSE)
+    if is_reverse == bool(flg & FLAG_MATE_REVERSE):
+        return False
+    start = rec.pos + 1
+    mate_start = rec.next_pos + 1
+    if is_reverse:
+        ref_len = rec.reference_length()
+        end = start + max(ref_len - 1, 0)
+        positive_5p, negative_5p = mate_start, end
+    else:
+        positive_5p, negative_5p = start, start + rec.tlen
+    return positive_5p < negative_5p
+
+
+def _read_pos_at_ref(cigar, alignment_start_1based: int, target: int, before: bool) -> int:
+    """1-based read position at a reference position; 0 if in deletion/outside.
+
+    before=True returns the count of read bases strictly before the position
+    (overlap.rs:362-411).
+    """
+    ref_pos = alignment_start_1based
+    read_pos = 0
+    for op, length in cigar:
+        if op in "M=X":
+            # closed-form version of the reference's per-base walk
+            if target < ref_pos:
+                return 0
+            if target < ref_pos + length:
+                read_pos += target - ref_pos + 1
+                return max(read_pos - 1, 0) if before else read_pos
+            read_pos += length
+            ref_pos += length
+        elif op in "IS":
+            read_pos += length
+        elif op in "DN":
+            if ref_pos <= target < ref_pos + length:
+                return 0
+            ref_pos += length
+    return 0
+
+
+def num_bases_extending_past_mate(rec: RawRecord) -> int:
+    """Bases of `rec` extending past its FR mate's soft-clip boundary, 0 if n/a.
+
+    Requires the MC tag; fails closed to 0 when absent/malformed (overlap.rs:117-140).
+    """
+    if not is_fr_pair(rec):
+        return 0
+    mc = rec.get_str(b"MC")
+    if mc is None:
+        return 0
+    parsed = parse_soft_clips_and_ref_len(mc)
+    if parsed is None:
+        return 0
+    leading_soft, ref_len, trailing_soft = parsed
+    mate_pos = rec.next_pos + 1
+    mate_unclipped_start = mate_pos - leading_soft
+    mate_unclipped_end = mate_pos - 1 + ref_len + trailing_soft
+
+    cigar = rec.cigar()
+    read_length = _read_len_from_cigar(cigar)
+    this_pos = rec.pos + 1
+    if rec.flag & FLAG_REVERSE:
+        if this_pos <= mate_unclipped_start:
+            return _read_pos_at_ref(cigar, this_pos, mate_unclipped_start, before=True)
+        gap = max(this_pos - mate_unclipped_start, 0)
+        return max(_leading_soft(cigar) - gap, 0)
+    alignment_end = this_pos - 1 + _ref_len_from_cigar(cigar)
+    if alignment_end >= mate_unclipped_end:
+        # bases_past == 0 (boundary in a deletion / outside) clips the whole read,
+        # matching the reference's read_length.saturating_sub(0) (overlap.rs:214-217).
+        bases_past = _read_pos_at_ref(cigar, this_pos, mate_unclipped_end, before=False)
+        return max(read_length - bases_past, 0)
+    # Read ends before the mate boundary: only excess trailing soft clip is removed.
+    trailing_sc = _trailing_soft(cigar)
+    gap = max(mate_unclipped_end - alignment_end, 0)
+    return max(trailing_sc - gap, 0)

@@ -1,0 +1,388 @@
+"""Raw-byte BAM record layer.
+
+Record accessors work directly on BAM wire bytes at fixed offsets, mirroring the
+reference's raw-record design (/root/reference/crates/fgumi-raw-bam/src/fields.rs:7-24:
+refID/pos/l_read_name/mapq/bin/n_cigar_op/flag/l_seq/next_refID/next_pos/tlen then
+name, cigar, packed seq, qual, aux TLV) — decoding only what each consumer touches,
+which is what keeps host-side feeding cheap (raw_bam_record.rs:6-13 rationale).
+"""
+
+import struct
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .bgzf import BgzfReader, BgzfWriter
+
+BAM_MAGIC = b"BAM\x01"
+# SAM spec reg2bin(-1, 0) — the unmapped record bin (builder.rs:1-3).
+UNMAPPED_BIN = 4680
+
+# BAM flags (SAM spec).
+FLAG_PAIRED = 0x1
+FLAG_PROPER_PAIR = 0x2
+FLAG_UNMAPPED = 0x4
+FLAG_MATE_UNMAPPED = 0x8
+FLAG_REVERSE = 0x10
+FLAG_MATE_REVERSE = 0x20
+FLAG_FIRST = 0x40
+FLAG_LAST = 0x80
+FLAG_SECONDARY = 0x100
+FLAG_QC_FAIL = 0x200
+FLAG_DUPLICATE = 0x400
+FLAG_SUPPLEMENTARY = 0x800
+
+# 4-bit seq nibble -> ASCII (=ACMGRSVTWYHKDBN).
+NIBBLE_TO_BASE = np.frombuffer(b"=ACMGRSVTWYHKDBN", dtype=np.uint8)
+BASE_TO_NIBBLE = np.full(256, 15, dtype=np.uint8)  # default N
+for _i, _b in enumerate(b"=ACMGRSVTWYHKDBN"):
+    BASE_TO_NIBBLE[_b] = _i
+for _i, _b in enumerate(b"=acmgrsvtwyhkdbn"):
+    BASE_TO_NIBBLE[_b] = _i
+
+CIGAR_OPS = "MIDNSHP=X"
+_CONSUMES_QUERY = frozenset("MIS=X")
+_CONSUMES_REF = frozenset("MDN=X")
+
+
+@dataclass
+class BamHeader:
+    text: str
+    ref_names: list
+    ref_lengths: list
+    _name_to_id: dict = field(default_factory=dict, repr=False)
+
+    def __post_init__(self):
+        if not self._name_to_id:
+            self._name_to_id = {n: i for i, n in enumerate(self.ref_names)}
+
+    def ref_id(self, name: str) -> int:
+        return self._name_to_id.get(name, -1)
+
+    def encode(self) -> bytes:
+        text_b = self.text.encode()
+        out = bytearray(BAM_MAGIC)
+        out += struct.pack("<i", len(text_b))
+        out += text_b
+        out += struct.pack("<i", len(self.ref_names))
+        for name, length in zip(self.ref_names, self.ref_lengths):
+            nb = name.encode() + b"\x00"
+            out += struct.pack("<i", len(nb)) + nb + struct.pack("<i", length)
+        return bytes(out)
+
+    @classmethod
+    def decode_from(cls, read):
+        """Parse from a `read(n)` callable positioned at the stream start."""
+        magic = read(4)
+        if magic != BAM_MAGIC:
+            raise ValueError(f"not a BAM stream (magic {magic!r})")
+        (l_text,) = struct.unpack("<i", read(4))
+        text = read(l_text).decode(errors="replace").rstrip("\x00")
+        (n_ref,) = struct.unpack("<i", read(4))
+        names, lengths = [], []
+        for _ in range(n_ref):
+            (l_name,) = struct.unpack("<i", read(4))
+            names.append(read(l_name)[:-1].decode())
+            (l_ref,) = struct.unpack("<i", read(4))
+            lengths.append(l_ref)
+        return cls(text=text, ref_names=names, ref_lengths=lengths)
+
+
+class RawRecord:
+    """A single BAM record's wire bytes (without the leading block_size)."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes):
+        self.data = data
+
+    # --- fixed-offset fields (fields.rs:7-24) ---
+    @property
+    def ref_id(self) -> int:
+        return int.from_bytes(self.data[0:4], "little", signed=True)
+
+    @property
+    def pos(self) -> int:
+        return int.from_bytes(self.data[4:8], "little", signed=True)
+
+    @property
+    def l_read_name(self) -> int:
+        return self.data[8]
+
+    @property
+    def mapq(self) -> int:
+        return self.data[9]
+
+    @property
+    def n_cigar_op(self) -> int:
+        return int.from_bytes(self.data[12:14], "little")
+
+    @property
+    def flag(self) -> int:
+        return int.from_bytes(self.data[14:16], "little")
+
+    @property
+    def l_seq(self) -> int:
+        return int.from_bytes(self.data[16:20], "little")
+
+    @property
+    def next_ref_id(self) -> int:
+        return int.from_bytes(self.data[20:24], "little", signed=True)
+
+    @property
+    def next_pos(self) -> int:
+        return int.from_bytes(self.data[24:28], "little", signed=True)
+
+    @property
+    def tlen(self) -> int:
+        return int.from_bytes(self.data[28:32], "little", signed=True)
+
+    @property
+    def name(self) -> bytes:
+        return self.data[32 : 32 + self.l_read_name - 1]
+
+    # --- variable sections ---
+    def _cigar_off(self) -> int:
+        return 32 + self.l_read_name
+
+    def _seq_off(self) -> int:
+        return self._cigar_off() + 4 * self.n_cigar_op
+
+    def _qual_off(self) -> int:
+        return self._seq_off() + (self.l_seq + 1) // 2
+
+    def _aux_off(self) -> int:
+        return self._qual_off() + self.l_seq
+
+    def cigar(self):
+        """[(op_char, length)] decoded CIGAR."""
+        off = self._cigar_off()
+        out = []
+        for i in range(self.n_cigar_op):
+            v = int.from_bytes(self.data[off + 4 * i : off + 4 * i + 4], "little")
+            out.append((CIGAR_OPS[v & 0xF], v >> 4))
+        return out
+
+    def seq_bytes(self) -> bytes:
+        """ASCII sequence (unpacked from 4-bit codes)."""
+        n = self.l_seq
+        packed = np.frombuffer(self.data, dtype=np.uint8, count=(n + 1) // 2,
+                               offset=self._seq_off())
+        nibbles = np.empty(2 * len(packed), dtype=np.uint8)
+        nibbles[0::2] = packed >> 4
+        nibbles[1::2] = packed & 0xF
+        return NIBBLE_TO_BASE[nibbles[:n]].tobytes()
+
+    def quals(self) -> np.ndarray:
+        return np.frombuffer(self.data, dtype=np.uint8, count=self.l_seq,
+                             offset=self._qual_off()).copy()
+
+    # --- aux tag TLV scan (tags.rs:8-40) ---
+    def _iter_tags(self):
+        data = self.data
+        off = self._aux_off()
+        end = len(data)
+        while off + 3 <= end:
+            tag = data[off : off + 2]
+            typ = data[off + 2]
+            off += 3
+            yield tag, typ, off
+            off = _skip_tag_value(data, typ, off)
+
+    def find_tag(self, tag: bytes):
+        """Return (type_char, python value) or None."""
+        for t, typ, off in self._iter_tags():
+            if t == tag:
+                return chr(typ), _read_tag_value(self.data, typ, off)
+        return None
+
+    def get_str(self, tag: bytes):
+        got = self.find_tag(tag)
+        if got is None:
+            return None
+        typ, val = got
+        return val if typ in ("Z", "H") else None
+
+    def get_int(self, tag: bytes):
+        got = self.find_tag(tag)
+        if got is None:
+            return None
+        typ, val = got
+        return int(val) if typ in "cCsSiI" else None
+
+    def aux_bytes(self) -> bytes:
+        return self.data[self._aux_off():]
+
+    def read_length_from_cigar(self) -> int:
+        return sum(n for op, n in self.cigar() if op in _CONSUMES_QUERY)
+
+    def reference_length(self) -> int:
+        return sum(n for op, n in self.cigar() if op in _CONSUMES_REF)
+
+    def unclipped_start(self) -> int:
+        """0-based alignment start minus leading clips."""
+        pos = self.pos
+        for op, n in self.cigar():
+            if op in "SH":
+                pos -= n
+            else:
+                break
+        return pos
+
+    def unclipped_end(self) -> int:
+        """0-based inclusive alignment end plus trailing clips."""
+        end = self.pos + self.reference_length() - 1
+        for op, n in reversed(self.cigar()):
+            if op in "SH":
+                end += n
+            else:
+                break
+        return end
+
+
+_TAG_SIZES = {ord("c"): 1, ord("C"): 1, ord("s"): 2, ord("S"): 2, ord("i"): 4,
+              ord("I"): 4, ord("f"): 4, ord("A"): 1}
+_ARRAY_DTYPES = {ord("c"): np.int8, ord("C"): np.uint8, ord("s"): np.int16,
+                 ord("S"): np.uint16, ord("i"): np.int32, ord("I"): np.uint32,
+                 ord("f"): np.float32}
+
+
+def _skip_tag_value(data: bytes, typ: int, off: int) -> int:
+    size = _TAG_SIZES.get(typ)
+    if size is not None:
+        return off + size
+    if typ in (ord("Z"), ord("H")):
+        return data.index(b"\x00", off) + 1
+    if typ == ord("B"):
+        sub = data[off]
+        (count,) = struct.unpack_from("<I", data, off + 1)
+        return off + 5 + count * _TAG_SIZES[sub]
+    raise ValueError(f"unknown aux tag type {typ!r}")
+
+
+def _read_tag_value(data: bytes, typ: int, off: int):
+    c = chr(typ)
+    if c == "A":
+        return chr(data[off])
+    if c in "cCsSiI":
+        fmt = {"c": "<b", "C": "<B", "s": "<h", "S": "<H", "i": "<i", "I": "<I"}[c]
+        return struct.unpack_from(fmt, data, off)[0]
+    if c == "f":
+        return struct.unpack_from("<f", data, off)[0]
+    if c in "ZH":
+        end = data.index(b"\x00", off)
+        return data[off:end].decode(errors="replace")
+    if c == "B":
+        sub = data[off]
+        (count,) = struct.unpack_from("<I", data, off + 1)
+        dt = _ARRAY_DTYPES[sub]
+        return np.frombuffer(data, dtype=dt, count=count, offset=off + 5).copy()
+    raise ValueError(f"unknown aux tag type {c!r}")
+
+
+class RecordBuilder:
+    """Builds raw BAM record bytes (mirrors UnmappedSamBuilder, builder.rs:69-200)."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def start_unmapped(self, name: bytes, flag: int, seq: bytes, quals) -> "RecordBuilder":
+        """Begin an unmapped record: ref_id=-1, pos=-1, mapq=0, bin=4680, no CIGAR."""
+        buf = self._buf
+        buf.clear()
+        l_name = len(name) + 1
+        if l_name > 255:
+            raise ValueError(f"read name too long ({len(name)} bytes): {name[:40]!r}...")
+        n = len(seq)
+        buf += struct.pack("<iiBBHHHiiii", -1, -1, l_name, 0, UNMAPPED_BIN, 0,
+                           flag, n, -1, -1, 0)
+        buf += name
+        buf += b"\x00"
+        # pack sequence to nibbles
+        codes = BASE_TO_NIBBLE[np.frombuffer(seq, dtype=np.uint8)]
+        if n % 2:
+            codes = np.append(codes, 0)
+        buf += ((codes[0::2] << 4) | codes[1::2]).astype(np.uint8).tobytes()
+        buf += np.asarray(quals, dtype=np.uint8).tobytes()
+        return self
+
+    def tag_str(self, tag: bytes, value: bytes) -> "RecordBuilder":
+        self._buf += tag + b"Z" + value + b"\x00"
+        return self
+
+    def tag_int(self, tag: bytes, value: int) -> "RecordBuilder":
+        self._buf += tag + b"i" + struct.pack("<i", value)
+        return self
+
+    def tag_float(self, tag: bytes, value: float) -> "RecordBuilder":
+        self._buf += tag + b"f" + struct.pack("<f", value)
+        return self
+
+    def tag_array_i16(self, tag: bytes, values) -> "RecordBuilder":
+        arr = np.asarray(values, dtype=np.int16)
+        self._buf += tag + b"Bs" + struct.pack("<I", arr.size) + arr.tobytes()
+        return self
+
+    def tag_array_u8(self, tag: bytes, values) -> "RecordBuilder":
+        arr = np.asarray(values, dtype=np.uint8)
+        self._buf += tag + b"BC" + struct.pack("<I", arr.size) + arr.tobytes()
+        return self
+
+    def finish(self) -> bytes:
+        return bytes(self._buf)
+
+
+class BamReader:
+    """Sequential BAM reader yielding RawRecord over a BGZF/gzip stream."""
+
+    def __init__(self, path_or_obj):
+        owns = isinstance(path_or_obj, str)
+        fileobj = open(path_or_obj, "rb") if owns else path_or_obj
+        self._r = BgzfReader(fileobj, owns_fileobj=owns)
+        self.header = BamHeader.decode_from(self._r.read)
+
+    def __iter__(self):
+        read = self._r.read
+        while True:
+            sz = read(4)
+            if len(sz) < 4:
+                return
+            (block_size,) = struct.unpack("<I", sz)
+            data = read(block_size)
+            if len(data) < block_size:
+                raise EOFError("truncated BAM record")
+            yield RawRecord(data)
+
+    def close(self):
+        self._r.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class BamWriter:
+    """Sequential BAM writer over BGZF."""
+
+    def __init__(self, path_or_obj, header: BamHeader, level: int = 1):
+        owns = isinstance(path_or_obj, str)
+        fileobj = open(path_or_obj, "wb") if owns else path_or_obj
+        self._w = BgzfWriter(fileobj, level=level, owns_fileobj=owns)
+        self._w.write(header.encode())
+
+    def write_record_bytes(self, data: bytes):
+        self._w.write(struct.pack("<I", len(data)) + data)
+
+    def write_record(self, rec: RawRecord):
+        self.write_record_bytes(rec.data)
+
+    def close(self):
+        self._w.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,130 @@
+"""BGZF block compression I/O.
+
+BGZF is gzip with fixed-size members carrying a BSIZE extra field, enabling random
+access and parallel compression (reference: /root/reference/crates/fgumi-bgzf/src/lib.rs).
+
+Reading: sequential BGZF is a valid multi-member gzip stream, so decompression is
+delegated to zlib's C streaming decompressor (block boundaries are only needed for
+random access / BAI, handled separately). Writing produces spec-conformant BGZF
+blocks (BC extra subfield + EOF sentinel) so htslib/samtools can read the output.
+"""
+
+import io
+import struct
+import zlib
+
+# Maximum uncompressed payload per BGZF block.
+MAX_BLOCK_DATA = 0xFF00
+
+# The fixed 28-byte BGZF EOF sentinel block (SAM spec §4.1.2).
+BGZF_EOF = bytes.fromhex(
+    "1f8b08040000000000ff0600424302001b0003000000000000000000"
+)
+
+_HEADER = struct.Struct("<4BI2BH2BHH")  # gzip header + XLEN + BC subfield + BSIZE
+
+
+def _block_header(bsize_minus1: int) -> bytes:
+    return _HEADER.pack(
+        0x1F, 0x8B, 0x08, 0x04,  # magic, deflate, FEXTRA
+        0,  # mtime
+        0, 0xFF,  # XFL, OS=unknown
+        6,  # XLEN
+        0x42, 0x43,  # 'B','C'
+        2,  # SLEN
+        bsize_minus1,
+    )
+
+
+def compress_block(data: bytes, level: int = 1) -> bytes:
+    """Compress one <=64KiB chunk into a standalone BGZF block."""
+    assert len(data) <= 0x10000
+    co = zlib.compressobj(level, zlib.DEFLATED, -15)
+    payload = co.compress(data) + co.flush()
+    bsize = len(payload) + _HEADER.size + 8
+    assert bsize <= 0x10000, "BGZF block overflow (incompressible data)"
+    return (
+        _block_header(bsize - 1)
+        + payload
+        + struct.pack("<II", zlib.crc32(data), len(data) & 0xFFFFFFFF)
+    )
+
+
+class BgzfWriter(io.RawIOBase):
+    """Streaming BGZF writer: buffers to MAX_BLOCK_DATA and emits blocks."""
+
+    def __init__(self, fileobj, level: int = 1, owns_fileobj: bool = False):
+        self._f = fileobj
+        self._level = level
+        self._buf = bytearray()
+        self._owns = owns_fileobj
+
+    def write(self, data) -> int:
+        self._buf += data
+        while len(self._buf) >= MAX_BLOCK_DATA:
+            chunk = bytes(self._buf[:MAX_BLOCK_DATA])
+            del self._buf[:MAX_BLOCK_DATA]
+            self._f.write(compress_block(chunk, self._level))
+        return len(data)
+
+    def flush(self):
+        if self._buf:
+            self._f.write(compress_block(bytes(self._buf), self._level))
+            self._buf.clear()
+
+    def close(self):
+        if self.closed:
+            return
+        self.flush()
+        self._f.write(BGZF_EOF)
+        self._f.flush()
+        if self._owns:
+            self._f.close()
+        super().close()
+
+
+class BgzfReader:
+    """Streaming multi-member gzip/BGZF reader over a file object.
+
+    read(n) returns exactly n bytes unless EOF. Uses zlib's C decompressor; also
+    accepts plain gzip input (the reference similarly auto-detects, bam-io reader).
+    """
+
+    def __init__(self, fileobj, chunk_size: int = 1 << 20, owns_fileobj: bool = False):
+        self._f = fileobj
+        self._owns = owns_fileobj
+        self._chunk = chunk_size
+        self._z = zlib.decompressobj(wbits=31)
+        self._buf = bytearray()
+        self._eof = False
+
+    def _fill(self, need: int):
+        while len(self._buf) < need and not self._eof:
+            if self._z.eof:
+                rest = self._z.unused_data
+                self._z = zlib.decompressobj(wbits=31)
+                if rest:
+                    self._buf += self._z.decompress(rest)
+                    continue
+            raw = self._f.read(self._chunk)
+            if not raw:
+                self._eof = True
+                break
+            self._buf += self._z.decompress(raw)
+
+    def read(self, n: int) -> bytes:
+        self._fill(n)
+        out = bytes(self._buf[:n])
+        del self._buf[:n]
+        return out
+
+    def read_into_available(self) -> bytes:
+        """Return whatever is currently buffered plus one more raw chunk's worth."""
+        self._fill(len(self._buf) + 1)
+        out = bytes(self._buf)
+        self._buf.clear()
+        return out
+
+    def close(self):
+        if self._owns:
+            self._f.close()

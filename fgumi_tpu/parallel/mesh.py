@@ -1,0 +1,78 @@
+"""Multi-chip execution: consensus kernel sharded over a device mesh.
+
+Families are embarrassingly parallel (exactly like the reference's per-group Process
+step, SURVEY.md §5.7), so the natural mesh is:
+
+- ``dp``: the family axis F — data parallel, no communication;
+- ``sp``: the read axis R — "sequence parallel" for very deep families: each shard
+  reduces its local reads' likelihood contributions, then a single psum over ``sp``
+  combines them (the only collective in the hot path, riding ICI).
+
+This module provides the shard_map-wrapped kernel plus mesh construction helpers.
+The reference has no distributed backend (single host, SURVEY.md §5.8); this is the
+TPU-native scale-out design the reference's thread pool maps to.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.kernel import _call_epilogue, _reduce_contributions
+
+
+def make_mesh(devices=None, dp: int = None, sp: int = 1) -> Mesh:
+    """Build a (dp, sp) mesh over the given (default: all) devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if dp is None:
+        dp = n // sp
+    if dp * sp != n:
+        raise ValueError(f"dp*sp ({dp}*{sp}) != device count {n}")
+    arr = np.array(devices).reshape(dp, sp)
+    return Mesh(arr, axis_names=("dp", "sp"))
+
+
+def sharded_consensus_fn(mesh: Mesh, correct_tab, err_tab, ln_error_pre_umi):
+    """Returns a jitted fn(codes, quals) sharded over the mesh.
+
+    codes/quals: (F, R, L) with F divisible by dp and R divisible by sp.
+    Outputs are (F, L) arrays sharded along dp.
+    """
+    correct_tab = jnp.asarray(correct_tab, dtype=jnp.float32)
+    err_tab = jnp.asarray(err_tab, dtype=jnp.float32)
+    pre = jnp.float32(ln_error_pre_umi)
+
+    def local(codes, quals):
+        contrib, obs = _reduce_contributions(codes, quals, correct_tab, err_tab)
+        # Combine partial read-axis reductions across the sp axis — the one
+        # collective in the hot path.
+        contrib = jax.lax.psum(contrib, "sp")
+        obs = jax.lax.psum(obs, "sp")
+        return _call_epilogue(contrib, obs, pre)
+
+    mapped = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P("dp", "sp", None), P("dp", "sp", None)),
+        out_specs=(P("dp"), P("dp"), P("dp"), P("dp"), P("dp")),
+    )
+    return jax.jit(mapped)
+
+
+def pad_for_mesh(codes: np.ndarray, quals: np.ndarray, mesh: Mesh):
+    """Pad (F, R, L) arrays so F % dp == 0 and R % sp == 0 (pad = N/qual 0)."""
+    from ..constants import N_CODE
+
+    dp = mesh.shape["dp"]
+    sp = mesh.shape["sp"]
+    F, R, L = codes.shape
+    Fp = -(-F // dp) * dp
+    Rp = -(-R // sp) * sp
+    if (Fp, Rp) != (F, R):
+        pc = np.full((Fp, Rp, L), N_CODE, dtype=np.uint8)
+        pq = np.zeros((Fp, Rp, L), dtype=np.uint8)
+        pc[:F, :R] = codes
+        pq[:F, :R] = quals
+        codes, quals = pc, pq
+    return codes, quals, F

@@ -1,0 +1,115 @@
+"""Synthetic UMI-family data generation (test + benchmark infrastructure).
+
+Analog of the reference's `fgumi simulate` tooling (/root/reference/src/lib/simulate/,
+grouped-reads mode): deterministic, seeded generation of MI-grouped BAM input for the
+consensus callers, so E2E tests compare pipeline outputs without golden files
+(SURVEY.md §4 test strategy).
+"""
+
+import numpy as np
+
+from .constants import CODE_TO_BASE
+from .io.bam import (BamHeader, BamWriter, FLAG_FIRST, FLAG_LAST,
+                     FLAG_MATE_REVERSE, FLAG_PAIRED, FLAG_REVERSE, RecordBuilder)
+import struct
+
+
+def _build_mapped_record(name, flag, ref_id, pos, mapq, cigar_ops, seq, quals,
+                         next_ref_id, next_pos, tlen, tags):
+    """Assemble a mapped BAM record (RecordBuilder only covers unmapped)."""
+    buf = bytearray()
+    l_name = len(name) + 1
+    buf += struct.pack("<iiBBHHHiiii", ref_id, pos, l_name, mapq, 0,
+                       len(cigar_ops), flag, len(seq), next_ref_id, next_pos, tlen)
+    buf += name + b"\x00"
+    op_codes = {"M": 0, "I": 1, "D": 2, "N": 3, "S": 4, "H": 5, "P": 6, "=": 7, "X": 8}
+    for op, length in cigar_ops:
+        buf += struct.pack("<I", (length << 4) | op_codes[op])
+    from .io.bam import BASE_TO_NIBBLE
+    codes = BASE_TO_NIBBLE[np.frombuffer(seq, dtype=np.uint8)]
+    if len(seq) % 2:
+        codes = np.append(codes, 0)
+    buf += ((codes[0::2] << 4) | codes[1::2]).astype(np.uint8).tobytes()
+    buf += np.asarray(quals, dtype=np.uint8).tobytes()
+    for tag, typ, value in tags:
+        if typ == "Z":
+            buf += tag + b"Z" + value + b"\x00"
+        elif typ == "i":
+            buf += tag + b"i" + struct.pack("<i", value)
+    return bytes(buf)
+
+
+def simulate_grouped_bam(path: str, num_families: int = 100, family_size: int = 5,
+                         family_size_distribution: str = "fixed",
+                         read_length: int = 100, error_rate: float = 0.01,
+                         base_quality: int = 35, qual_jitter: int = 5,
+                         paired: bool = True, seed: int = 42,
+                         ref_name: str = "chr1", ref_length: int = 10_000_000):
+    """Write a grouped (MI-tagged) BAM simulating PCR families of reads.
+
+    Returns the number of records written. Families appear consecutively in MI order
+    (the post-`group` layout simplex consumes).
+    """
+    rng = np.random.default_rng(seed)
+    header = BamHeader(
+        text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n"
+             f"@SQ\tSN:{ref_name}\tLN:{ref_length}\n"
+             "@RG\tID:A\tSM:sample\tLB:lib\n",
+        ref_names=[ref_name], ref_lengths=[ref_length],
+    )
+    n_written = 0
+    with BamWriter(path, header) as w:
+        for fam in range(num_families):
+            if family_size_distribution == "fixed":
+                size = family_size
+            elif family_size_distribution == "lognormal":
+                size = max(1, int(rng.lognormal(np.log(max(family_size, 1)), 0.6)))
+            else:
+                raise ValueError(family_size_distribution)
+            start = int(rng.integers(0, ref_length - 3 * read_length))
+            insert = int(rng.integers(int(read_length * 1.5), 3 * read_length))
+            truth_r1 = rng.integers(0, 4, size=read_length).astype(np.uint8)
+            truth_r2 = rng.integers(0, 4, size=read_length).astype(np.uint8)
+            mi = str(fam)
+            cigar = [("M", read_length)]
+            mc = f"{read_length}M".encode()
+            for r in range(size):
+                # per-read errors
+                def mutate(truth):
+                    codes = truth.copy()
+                    errs = rng.random(read_length) < error_rate
+                    n_err = int(errs.sum())
+                    if n_err:
+                        codes[errs] = (codes[errs] + rng.integers(1, 4, n_err)) % 4
+                    return CODE_TO_BASE[codes].tobytes()
+
+                quals = np.clip(
+                    base_quality + rng.integers(-qual_jitter, qual_jitter + 1,
+                                                read_length),
+                    2, 40).astype(np.uint8)
+                name = f"fam{fam}:r{r}".encode()
+                if paired:
+                    r2_pos = start + insert - read_length
+                    rec1 = _build_mapped_record(
+                        name, FLAG_PAIRED | FLAG_FIRST | FLAG_MATE_REVERSE, 0, start,
+                        60, cigar, mutate(truth_r1), quals, 0, r2_pos, insert,
+                        [(b"MC", "Z", mc), (b"RG", "Z", b"A"), (b"MI", "Z", mi.encode())])
+                    quals2 = np.clip(
+                        base_quality + rng.integers(-qual_jitter, qual_jitter + 1,
+                                                    read_length),
+                        2, 40).astype(np.uint8)
+                    rec2 = _build_mapped_record(
+                        name, FLAG_PAIRED | FLAG_LAST | FLAG_REVERSE, 0, r2_pos,
+                        60, cigar, mutate(truth_r2), quals2, 0, start, -insert,
+                        [(b"MC", "Z", mc), (b"RG", "Z", b"A"), (b"MI", "Z", mi.encode())])
+                    w.write_record_bytes(rec1)
+                    w.write_record_bytes(rec2)
+                    n_written += 2
+                else:
+                    rec = _build_mapped_record(
+                        name, 0, 0, start, 60, cigar, mutate(truth_r1), quals,
+                        -1, -1, 0,
+                        [(b"RG", "Z", b"A"), (b"MI", "Z", mi.encode())])
+                    w.write_record_bytes(rec)
+                    n_written += 1
+    return n_written

@@ -7,7 +7,12 @@ here covers every test module. Bench and production runs use the real TPU instea
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU (overriding the environment's JAX_PLATFORMS=axon). NOTE: the axon TPU
+# plugin is injected via PYTHONPATH=/root/.axon_site sitecustomize and can block jax
+# init even under JAX_PLATFORMS=cpu when the TPU tunnel is busy/wedged — run tests as
+#   PYTHONPATH= python -m pytest tests/ -x -q
+# to guarantee a pure-CPU jax.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,130 @@
+"""BAM/BGZF round-trip tests."""
+
+import gzip
+import io
+import struct
+
+import numpy as np
+
+from fgumi_tpu.io.bam import (BamHeader, BamReader, BamWriter, RawRecord,
+                              RecordBuilder, FLAG_PAIRED, FLAG_UNMAPPED, FLAG_FIRST)
+from fgumi_tpu.io.bgzf import BGZF_EOF, BgzfReader, BgzfWriter, compress_block
+
+
+def test_bgzf_round_trip():
+    data = bytes(range(256)) * 1000
+    buf = io.BytesIO()
+    w = BgzfWriter(buf)
+    w.write(data)
+    w.close()
+    raw = buf.getvalue()
+    assert raw.endswith(BGZF_EOF)
+    # BGZF output is valid multi-member gzip
+    assert gzip.decompress(raw) == data
+    r = BgzfReader(io.BytesIO(raw))
+    assert r.read(len(data)) == data
+    assert r.read(10) == b""
+
+
+def test_bgzf_block_structure():
+    blk = compress_block(b"hello world")
+    # gzip magic + FEXTRA, BC subfield
+    assert blk[:4] == b"\x1f\x8b\x08\x04"
+    assert blk[12:14] == b"BC"
+    (bsize,) = struct.unpack_from("<H", blk, 16)
+    assert bsize + 1 == len(blk)
+
+
+def make_header():
+    return BamHeader(text="@HD\tVN:1.6\tSO:unsorted\n", ref_names=["chr1", "chr2"],
+                     ref_lengths=[1000000, 2000000])
+
+
+def build_record(name=b"read1", seq=b"ACGTN", quals=(30, 31, 32, 33, 34), mi="7"):
+    b = RecordBuilder()
+    b.start_unmapped(name, FLAG_PAIRED | FLAG_UNMAPPED | FLAG_FIRST, seq, list(quals))
+    b.tag_str(b"RG", b"A")
+    b.tag_str(b"MI", mi.encode())
+    b.tag_int(b"cD", 5)
+    b.tag_float(b"cE", 0.25)
+    b.tag_array_i16(b"cd", [5, 5, 4, 5, 5])
+    return RawRecord(b.finish())
+
+
+def test_record_builder_and_accessors():
+    rec = build_record()
+    assert rec.ref_id == -1 and rec.pos == -1
+    assert rec.flag == FLAG_PAIRED | FLAG_UNMAPPED | FLAG_FIRST
+    assert rec.name == b"read1"
+    assert rec.l_seq == 5
+    assert rec.seq_bytes() == b"ACGTN"
+    assert list(rec.quals()) == [30, 31, 32, 33, 34]
+    assert rec.get_str(b"RG") == "A"
+    assert rec.get_str(b"MI") == "7"
+    assert rec.get_int(b"cD") == 5
+    typ, val = rec.find_tag(b"cE")
+    assert typ == "f" and abs(val - 0.25) < 1e-7
+    typ, arr = rec.find_tag(b"cd")
+    assert typ == "B" and list(arr) == [5, 5, 4, 5, 5]
+    assert rec.find_tag(b"XX") is None
+
+
+def test_bam_file_round_trip(tmp_path):
+    path = str(tmp_path / "t.bam")
+    hdr = make_header()
+    recs = [build_record(name=f"r{i}".encode(), mi=str(i % 3)) for i in range(100)]
+    with BamWriter(path, hdr) as w:
+        for r in recs:
+            w.write_record(r)
+    with BamReader(path) as rd:
+        assert rd.header.text == hdr.text
+        assert rd.header.ref_names == ["chr1", "chr2"]
+        assert rd.header.ref_lengths == [1000000, 2000000]
+        assert rd.header.ref_id("chr2") == 1
+        got = list(rd)
+    assert len(got) == 100
+    for orig, back in zip(recs, got):
+        assert back.data == orig.data
+
+
+def test_large_record_spanning_blocks(tmp_path):
+    # records larger than one BGZF block must survive the block boundary
+    path = str(tmp_path / "big.bam")
+    seq = np.random.default_rng(0).choice(list(b"ACGT"), size=200000).astype(np.uint8).tobytes()
+    quals = [30] * len(seq)
+    rec_in = RecordBuilder().start_unmapped(b"big", FLAG_UNMAPPED, seq, quals).finish()
+    with BamWriter(path, make_header()) as w:
+        w.write_record_bytes(rec_in)
+    with BamReader(path) as rd:
+        (rec,) = list(rd)
+    assert rec.data == rec_in
+    assert rec.seq_bytes() == seq
+
+
+def test_odd_length_seq_packing():
+    rec = build_record(seq=b"ACG", quals=(10, 20, 30))
+    assert rec.seq_bytes() == b"ACG"
+    assert list(rec.quals()) == [10, 20, 30]
+
+
+def test_cigar_helpers():
+    # hand-assemble a mapped record with CIGAR 3S5M2I4M -> read len 14, ref len 9
+    buf = bytearray()
+    name = b"m1"
+    cigar = [(3, 4), (5, 0), (2, 1), (4, 0)]  # (len, op): S=4, M=0, I=1
+    seq = b"ACGTACGTACGTAC"
+    buf += struct.pack("<iiBBHHHiiii", 0, 100, len(name) + 1, 60, 0, len(cigar),
+                       0, len(seq), -1, -1, 0)
+    buf += name + b"\x00"
+    for ln, op in cigar:
+        buf += struct.pack("<I", (ln << 4) | op)
+    from fgumi_tpu.io.bam import BASE_TO_NIBBLE
+    codes = BASE_TO_NIBBLE[np.frombuffer(seq, dtype=np.uint8)]
+    buf += bytes((codes[0::2] << 4) | codes[1::2])
+    buf += bytes([30] * len(seq))
+    rec = RawRecord(bytes(buf))
+    assert rec.cigar() == [("S", 3), ("M", 5), ("I", 2), ("M", 4)]
+    assert rec.read_length_from_cigar() == 14
+    assert rec.reference_length() == 9
+    assert rec.unclipped_start() == 97
+    assert rec.pos == 100

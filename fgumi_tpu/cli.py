@@ -28,6 +28,7 @@ def _add_simplex(sub):
     p.add_argument("--trim", action="store_true")
     p.add_argument("--no-per-base-tags", action="store_true")
     p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--allow-unmapped", action="store_true")
     p.add_argument("--batch-groups", type=int, default=2000,
                    help="MI groups per device batch")
     p.set_defaults(func=cmd_simplex)
@@ -35,7 +36,7 @@ def _add_simplex(sub):
 
 def cmd_simplex(args):
     from .consensus.vanilla import VanillaConsensusCaller, VanillaOptions
-    from .core.grouper import iter_mi_group_batches
+    from .core.grouper import consensus_pregroup_keep, iter_mi_group_batches
     from .io.bam import BamHeader, BamReader, BamWriter
 
     # mirrors the reference's argument validation (simplex.rs:521-526)
@@ -73,8 +74,11 @@ def cmd_simplex(args):
         )
         with BamWriter(args.output, out_header) as writer:
             n_out = 0
+            allow_unmapped = args.allow_unmapped
+            pregroup = lambda r: consensus_pregroup_keep(r.flag, allow_unmapped)
             for batch in iter_mi_group_batches(reader, args.batch_groups,
-                                               tag=args.tag.encode()):
+                                               tag=args.tag.encode(),
+                                               record_filter=pregroup):
                 for rec_bytes in caller.call_groups(batch):
                     writer.write_record_bytes(rec_bytes)
                     n_out += 1
@@ -88,6 +92,75 @@ def cmd_simplex(args):
     if kt:
         log.info("kernel fallback rate: %.4f%% (%d/%d positions)",
                  100.0 * kf / kt, kf, kt)
+    return 0
+
+
+def _add_duplex(sub):
+    p = sub.add_parser("duplex", help="Call duplex consensus reads over /A+/B MI groups")
+    p.add_argument("-i", "--input", required=True, help="grouped BAM (MI tags with /A,/B)")
+    p.add_argument("-o", "--output", required=True, help="output consensus BAM")
+    p.add_argument("--read-name-prefix", default="fgumi")
+    p.add_argument("--read-group-id", default="A")
+    p.add_argument("--error-rate-pre-umi", type=int, default=45)
+    p.add_argument("--error-rate-post-umi", type=int, default=40)
+    p.add_argument("--min-input-base-quality", type=int, default=10)
+    p.add_argument("--min-reads", type=int, nargs="+", default=[1],
+                   help="1-3 values: total [XY [YX]] (high to low)")
+    p.add_argument("--max-reads-per-strand", type=int, default=None)
+    p.add_argument("--trim", action="store_true")
+    p.add_argument("--no-per-base-tags", action="store_true")
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--allow-unmapped", action="store_true")
+    p.add_argument("--batch-molecules", type=int, default=1000)
+    p.set_defaults(func=cmd_duplex)
+
+
+def cmd_duplex(args):
+    from .consensus.duplex import DuplexConsensusCaller, iter_duplex_groups
+    from .core.grouper import consensus_pregroup_keep
+    from .io.bam import BamHeader, BamReader, BamWriter
+
+    try:
+        caller = DuplexConsensusCaller(
+            args.read_name_prefix, args.read_group_id, min_reads=args.min_reads,
+            min_input_base_quality=args.min_input_base_quality,
+            produce_per_base_tags=not args.no_per_base_tags, trim=args.trim,
+            max_reads_per_strand=args.max_reads_per_strand,
+            error_rate_pre_umi=args.error_rate_pre_umi,
+            error_rate_post_umi=args.error_rate_post_umi, seed=args.seed)
+    except ValueError as e:
+        log.error("%s", e)
+        return 2
+
+    t0 = time.monotonic()
+    allow_unmapped = args.allow_unmapped
+    with BamReader(args.input) as reader:
+        out_header = BamHeader(
+            text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n"
+                 f"@RG\tID:{args.read_group_id}\tSM:sample\n"
+                 "@PG\tID:fgumi-tpu\tPN:fgumi-tpu\tCL:" + " ".join(sys.argv) + "\n",
+            ref_names=[], ref_lengths=[])
+        with BamWriter(args.output, out_header) as writer:
+            n_out = 0
+            pregroup = lambda r: consensus_pregroup_keep(r.flag, allow_unmapped)
+            batch = []
+            for group in iter_duplex_groups(reader, record_filter=pregroup):
+                batch.append(group)
+                if len(batch) >= args.batch_molecules:
+                    for rec_bytes in caller.call_groups(batch):
+                        writer.write_record_bytes(rec_bytes)
+                        n_out += 1
+                    batch = []
+            if batch:
+                for rec_bytes in caller.call_groups(batch):
+                    writer.write_record_bytes(rec_bytes)
+                    n_out += 1
+    dt = time.monotonic() - t0
+    s = caller.merged_stats()
+    log.info("duplex: %d input reads -> %d consensus reads in %.2fs (%.0f reads/s)",
+             s.input_reads, n_out, dt, s.input_reads / dt if dt else 0)
+    if s.rejected:
+        log.info("rejections: %s", dict(sorted(s.rejected.items())))
     return 0
 
 
@@ -106,6 +179,16 @@ def _add_simulate(sub):
     g.add_argument("--single-end", action="store_true")
     g.add_argument("--seed", type=int, default=42)
     g.set_defaults(func=cmd_simulate_grouped)
+    d = ps.add_parser("duplex-reads", help="duplex-grouped BAM (/A,/B MI tags)")
+    d.add_argument("-o", "--output", required=True)
+    d.add_argument("--num-molecules", type=int, default=100)
+    d.add_argument("--reads-per-strand", type=int, default=3)
+    d.add_argument("--read-length", type=int, default=100)
+    d.add_argument("--error-rate", type=float, default=0.01)
+    d.add_argument("--base-quality", type=int, default=35)
+    d.add_argument("--ba-fraction", type=float, default=1.0)
+    d.add_argument("--seed", type=int, default=42)
+    d.set_defaults(func=cmd_simulate_duplex)
 
 
 def cmd_simulate_grouped(args):
@@ -120,6 +203,18 @@ def cmd_simulate_grouped(args):
     return 0
 
 
+def cmd_simulate_duplex(args):
+    from .simulate import simulate_duplex_bam
+
+    n = simulate_duplex_bam(
+        args.output, num_molecules=args.num_molecules,
+        reads_per_strand=args.reads_per_strand, read_length=args.read_length,
+        error_rate=args.error_rate, base_quality=args.base_quality,
+        ba_fraction=args.ba_fraction, seed=args.seed)
+    log.info("simulate: wrote %d records to %s", n, args.output)
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="fgumi-tpu",
@@ -128,6 +223,7 @@ def main(argv=None):
     parser.add_argument("-v", "--verbose", action="store_true")
     sub = parser.add_subparsers(dest="command", required=True)
     _add_simplex(sub)
+    _add_duplex(sub)
     _add_simulate(sub)
     args = parser.parse_args(argv)
     logging.basicConfig(

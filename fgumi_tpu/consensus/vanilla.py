@@ -97,6 +97,22 @@ class ConsensusJob:
     quals: list
     consensus_len: int
     original_raws: list  # RawRecords surviving filtering (for tag extraction)
+    source_reads: list = None  # SourceReads (kept when the caller needs them, e.g. duplex)
+
+
+@dataclass
+class VanillaConsensusRead:
+    """Intermediate single-strand consensus (VanillaConsensusRead, vanilla_caller.rs:153-180)."""
+
+    id: str
+    bases: np.ndarray  # uint8 codes 0..4
+    quals: np.ndarray  # uint8
+    depths: np.ndarray  # int64, already clamped to I16_MAX per base
+    errors: np.ndarray  # int64, already clamped to I16_MAX per base
+    source_reads: list = None
+
+    def max_depth(self) -> int:
+        return int(self.depths.max()) if len(self.depths) else 0
 
 
 def find_quality_trim_point(quals: np.ndarray, trim_qual: int) -> int:
@@ -280,6 +296,39 @@ class VanillaConsensusCaller:
         elif r2 is not None:
             self.stats.reject("OrphanConsensus", len(r2.codes))
         return out
+
+    def job_from_source_reads(self, umi: str, read_type: int, source_reads,
+                              ordinal: int = 0, keep_source_reads: bool = False):
+        """consensus_call analog (vanilla_caller.rs:635-706): build a ConsensusJob
+        from pre-filtered SourceReads. The max_reads cap shapes only the consensus
+        scoring set; the full set is retained on the job when requested (fgbio passes
+        the pre-cap reads to duplexConsensus)."""
+        opts = self.options
+        if not source_reads or len(source_reads) < opts.min_reads:
+            return None
+        capped = source_reads
+        if opts.max_reads is not None and len(source_reads) > opts.max_reads:
+            rng = np.random.Generator(np.random.Philox(key=(opts.seed or 0) + ordinal))
+            capped = self._downsample(source_reads, rng)
+        if len(capped) < opts.min_reads:
+            return None
+        lengths = sorted((len(sr.codes) for sr in capped), reverse=True)
+        consensus_len = lengths[opts.min_reads - 1]
+        return ConsensusJob(
+            umi=umi, read_type=read_type,
+            codes=[sr.codes for sr in capped], quals=[sr.quals for sr in capped],
+            consensus_len=consensus_len, original_raws=[],
+            source_reads=source_reads if keep_source_reads else None)
+
+    def result_to_consensus_read(self, job: ConsensusJob, result) -> VanillaConsensusRead:
+        """Wrap a job's (already thresholded) _run_jobs outputs as a
+        VanillaConsensusRead; per-base depths/errors clamp to fgbio's Short ceiling
+        (vanilla_caller.rs:1414-1424)."""
+        bases, quals, depth, errors = result
+        return VanillaConsensusRead(
+            id=job.umi, bases=np.asarray(bases), quals=np.asarray(quals),
+            depths=np.minimum(depth, I16_MAX), errors=np.minimum(errors, I16_MAX),
+            source_reads=job.source_reads)
 
     # ------------------------------------------------------------------ device
 

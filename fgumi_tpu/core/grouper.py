@@ -6,7 +6,24 @@ form one group; groups are yielded in input order and batched for device efficie
 """
 
 
-def iter_mi_groups(records, tag: bytes = b"MI"):
+from ..io.bam import (FLAG_MATE_UNMAPPED, FLAG_PAIRED, FLAG_SECONDARY,
+                      FLAG_SUPPLEMENTARY, FLAG_UNMAPPED)
+
+
+def consensus_pregroup_keep(flag: int, allow_unmapped: bool = False) -> bool:
+    """fgbio's ConsensusCallingIterator pre-group filter
+    (/root/reference/src/lib/commands/common.rs:259-273): always drop
+    secondary/supplementary; drop unmapped-without-mapped-mate unless allowed."""
+    if flag & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY):
+        return False
+    if allow_unmapped:
+        return True
+    is_mapped = not flag & FLAG_UNMAPPED
+    has_mapped_mate = bool(flag & FLAG_PAIRED) and not flag & FLAG_MATE_UNMAPPED
+    return is_mapped or has_mapped_mate
+
+
+def iter_mi_groups(records, tag: bytes = b"MI", record_filter=None):
     """Yield (mi_value, [RawRecord]) for consecutive records sharing the tag.
 
     Records missing the tag raise — simplex input must be grouped (mi_group.rs
@@ -15,6 +32,8 @@ def iter_mi_groups(records, tag: bytes = b"MI"):
     current_mi = None
     current = []
     for rec in records:
+        if record_filter is not None and not record_filter(rec):
+            continue
         mi = rec.get_str(tag)
         if mi is None:
             raise ValueError(
@@ -31,10 +50,11 @@ def iter_mi_groups(records, tag: bytes = b"MI"):
         yield current_mi, current
 
 
-def iter_mi_group_batches(records, batch_size: int = 500, tag: bytes = b"MI"):
+def iter_mi_group_batches(records, batch_size: int = 500, tag: bytes = b"MI",
+                          record_filter=None):
     """Yield lists of (mi, records) of ~batch_size groups (MiGroupBatch analog)."""
     batch = []
-    for group in iter_mi_groups(records, tag):
+    for group in iter_mi_groups(records, tag, record_filter):
         batch.append(group)
         if len(batch) >= batch_size:
             yield batch

@@ -39,6 +39,82 @@ def _build_mapped_record(name, flag, ref_id, pos, mapq, cigar_ops, seq, quals,
     return bytes(buf)
 
 
+def simulate_duplex_bam(path: str, num_molecules: int = 100, reads_per_strand: int = 3,
+                        read_length: int = 100, error_rate: float = 0.01,
+                        base_quality: int = 35, qual_jitter: int = 5, seed: int = 42,
+                        ref_name: str = "chr1", ref_length: int = 10_000_000,
+                        ba_fraction: float = 1.0):
+    """Write a duplex-grouped BAM: molecules with /A (AB) and /B (BA) strand reads.
+
+    Geometry mirrors real duplex ligation: AB-R1 and BA-R2 sequence the top strand
+    forward; AB-R2 and BA-R1 sequence the bottom strand (stored reverse-complement,
+    FLAG_REVERSE). RX carries the dual UMI, strand-flipped between /A and /B.
+    """
+    rng = np.random.default_rng(seed)
+    header = BamHeader(
+        text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n"
+             f"@SQ\tSN:{ref_name}\tLN:{ref_length}\n"
+             "@RG\tID:A\tSM:sample\tLB:lib\n",
+        ref_names=[ref_name], ref_lengths=[ref_length],
+    )
+    from .constants import CODE_COMPLEMENT
+    n_written = 0
+    with BamWriter(path, header) as w:
+        for mol in range(num_molecules):
+            start = int(rng.integers(0, ref_length - 3 * read_length))
+            insert = int(rng.integers(int(read_length * 1.5), 3 * read_length))
+            r2_pos = start + insert - read_length
+            truth_top = rng.integers(0, 4, size=read_length).astype(np.uint8)
+            truth_bot = rng.integers(0, 4, size=read_length).astype(np.uint8)
+            umi_codes = rng.integers(0, 4, size=8)
+            u1 = CODE_TO_BASE[umi_codes[:4]].tobytes().decode()
+            u2 = CODE_TO_BASE[umi_codes[4:]].tobytes().decode()
+            cigar = [("M", read_length)]
+            mc = f"{read_length}M".encode()
+
+            def mutate(truth):
+                codes = truth.copy()
+                errs = rng.random(read_length) < error_rate
+                n_err = int(errs.sum())
+                if n_err:
+                    codes[errs] = (codes[errs] + rng.integers(1, 4, n_err)) % 4
+                return codes
+
+            def qgen():
+                return np.clip(base_quality + rng.integers(-qual_jitter, qual_jitter + 1,
+                                                           read_length), 2, 40).astype(np.uint8)
+
+            emit_ba = rng.random() < ba_fraction
+            for strand, mi_suffix, rx in (("A", "/A", f"{u1}-{u2}"),
+                                          ("B", "/B", f"{u2}-{u1}")):
+                if strand == "B" and not emit_ba:
+                    continue
+                for r in range(reads_per_strand):
+                    name = f"mol{mol}:{strand}{r}".encode()
+                    tags = [(b"MC", "Z", mc), (b"RG", "Z", b"A"),
+                            (b"MI", "Z", f"{mol}{mi_suffix}".encode()),
+                            (b"RX", "Z", rx.encode())]
+                    # top-strand-forward read (AB-R1 / BA-R2)
+                    fwd_flag = FLAG_PAIRED | FLAG_MATE_REVERSE | (
+                        FLAG_FIRST if strand == "A" else FLAG_LAST)
+                    rec_f = _build_mapped_record(
+                        name, fwd_flag, 0, start, 60, cigar,
+                        CODE_TO_BASE[mutate(truth_top)].tobytes(), qgen(),
+                        0, r2_pos, insert, tags)
+                    # bottom-strand read, stored as reverse-complement (AB-R2 / BA-R1)
+                    rev_flag = FLAG_PAIRED | FLAG_REVERSE | (
+                        FLAG_LAST if strand == "A" else FLAG_FIRST)
+                    stored = CODE_COMPLEMENT[mutate(truth_bot)[::-1]]
+                    rec_r = _build_mapped_record(
+                        name, rev_flag, 0, r2_pos, 60, cigar,
+                        CODE_TO_BASE[stored].tobytes(), qgen(),
+                        0, start, -insert, tags)
+                    w.write_record_bytes(rec_f)
+                    w.write_record_bytes(rec_r)
+                    n_written += 2
+    return n_written
+
+
 def simulate_grouped_bam(path: str, num_families: int = 100, family_size: int = 5,
                          family_size_distribution: str = "fixed",
                          read_length: int = 100, error_rate: float = 0.01,

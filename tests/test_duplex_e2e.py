@@ -1,0 +1,170 @@
+"""Duplex pipeline E2E tests: simulate duplex-reads -> duplex -> verify."""
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.cli import main as cli_main
+from fgumi_tpu.constants import BASE_TO_CODE, MAX_PHRED, MIN_PHRED, N_CODE, reverse_complement_codes
+from fgumi_tpu.consensus.duplex import duplex_combine, parse_min_reads, split_mi
+from fgumi_tpu.consensus.vanilla import VanillaConsensusRead
+from fgumi_tpu.io.bam import BamReader, FLAG_FIRST, FLAG_PAIRED, FLAG_REVERSE
+from fgumi_tpu.ops import oracle
+from fgumi_tpu.ops.tables import quality_tables
+
+
+@pytest.fixture(scope="module")
+def dup_bam(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("dup") / "dup.bam")
+    rc = cli_main(["simulate", "duplex-reads", "-o", path, "--num-molecules", "25",
+                   "--reads-per-strand", "3", "--error-rate", "0.02", "--seed", "5"])
+    assert rc == 0
+    return path
+
+
+def run_duplex(dup_bam, tmp_path, name, extra=()):
+    out = str(tmp_path / name)
+    rc = cli_main(["duplex", "-i", dup_bam, "-o", out, *extra])
+    assert rc == 0
+    return out
+
+
+def test_duplex_output_structure(dup_bam, tmp_path):
+    out = run_duplex(dup_bam, tmp_path, "d.bam")
+    with BamReader(out) as r:
+        recs = list(r)
+    assert len(recs) == 50  # 25 molecules x (R1 + R2)
+    for rec in recs:
+        mi = rec.get_str(b"MI")
+        assert "/" not in mi  # base MI, no strand suffix
+        assert rec.name == b"fgumi:" + mi.encode()
+        assert rec.flag & FLAG_PAIRED
+        for tag in (b"aD", b"aM", b"bD", b"bM", b"cD", b"cM"):
+            assert rec.get_int(tag) is not None, tag
+        assert rec.get_int(b"aD") == 3 and rec.get_int(b"bD") == 3
+        assert rec.get_int(b"cD") == 6
+        ac = rec.get_str(b"ac")
+        bc = rec.get_str(b"bc")
+        aq = rec.get_str(b"aq")
+        assert len(ac) == rec.l_seq == len(bc) == len(aq)
+        _, ad = rec.find_tag(b"ad")
+        assert len(ad) == rec.l_seq
+        rx = rec.get_str(b"RX")
+        assert rx is not None and "-" in rx
+        # duplex quality should mostly exceed SS quality cap at agreeing sites
+        assert int(rec.quals().max()) > 45
+
+
+def test_duplex_deterministic(dup_bam, tmp_path):
+    o1 = run_duplex(dup_bam, tmp_path, "d1.bam")
+    o2 = run_duplex(dup_bam, tmp_path, "d2.bam")
+    with BamReader(o1) as r1, BamReader(o2) as r2:
+        assert [r.data for r in r1] == [r.data for r in r2]
+
+
+def test_duplex_matches_independent_recompute(dup_bam, tmp_path):
+    """Recompute R1 duplex consensus per molecule: SS oracle per strand + combine."""
+    out = run_duplex(dup_bam, tmp_path, "dv.bam")
+    tables = quality_tables(45, 40)
+
+    # gather forward reads (AB-R1 and BA-R2 = duplex R1 inputs) per molecule+strand
+    per_strand = {}
+    with BamReader(dup_bam) as r:
+        for rec in r:
+            base, strand = split_mi(rec.get_str(b"MI"))
+            is_fwd_of_r1_pair = (strand == "A") == bool(rec.flag & FLAG_FIRST)
+            if not is_fwd_of_r1_pair:
+                continue  # this read feeds the R2 duplex
+            codes = BASE_TO_CODE[np.frombuffer(rec.seq_bytes(), dtype=np.uint8)].copy()
+            quals = rec.quals()
+            if rec.flag & FLAG_REVERSE:
+                codes = reverse_complement_codes(codes)
+                quals = quals[::-1].copy()
+            mask = quals < 10
+            codes[mask] = N_CODE
+            quals[mask] = MIN_PHRED
+            per_strand.setdefault((base, strand), []).append((codes, quals))
+
+    def ss(reads):
+        codes = np.stack([c for c, _ in reads])
+        quals = np.stack([q for _, q in reads])
+        w, q, d, e = oracle.call_family(codes, quals, tables)
+        b, qq = oracle.apply_consensus_thresholds(w, q, d, 1, MIN_PHRED)
+        return VanillaConsensusRead(id="x", bases=b, quals=qq,
+                                    depths=np.minimum(d, 32767),
+                                    errors=np.minimum(e, 32767))
+
+    with BamReader(out) as r:
+        outputs = {(rec.get_str(b"MI"), bool(rec.flag & FLAG_FIRST)): rec for rec in r}
+
+    for base in {k[0] for k in per_strand}:
+        ab = ss(per_strand[(base, "A")])
+        ba = ss(per_strand[(base, "B")])
+        dup = duplex_combine(ab, ba)  # approximate errors path: not compared here
+        rec = outputs[(base, True)]
+        got = BASE_TO_CODE[np.frombuffer(rec.seq_bytes(), dtype=np.uint8)]
+        np.testing.assert_array_equal(got, dup.bases, err_msg=f"bases {base}")
+        np.testing.assert_array_equal(rec.quals(), dup.quals, err_msg=f"quals {base}")
+        # strand sequences round-trip through ac/bc tags
+        assert rec.get_str(b"ac").encode() == bytes(
+            bytearray(b"ACGTN"[c] for c in ab.bases))
+
+
+def test_duplex_combine_rules():
+    mk = lambda b, q, d: VanillaConsensusRead(
+        id="m", bases=np.array(b, dtype=np.uint8), quals=np.array(q, dtype=np.uint8),
+        depths=np.array(d, dtype=np.int64), errors=np.zeros(len(b), dtype=np.int64))
+    ab = mk([0, 0, 0, 0, 4], [30, 40, 30, 30, 2], [3, 3, 3, 3, 3])
+    ba = mk([0, 1, 1, 0, 0], [30, 30, 30, 93, 30], [2, 2, 2, 2, 2])
+    dup = duplex_combine(ab, ba)
+    # agreement: sum (30+30=60)
+    assert dup.bases[0] == 0 and dup.quals[0] == 60
+    # disagreement, ab higher: ab base, diff 10
+    assert dup.bases[1] == 0 and dup.quals[1] == 10
+    # equal disagreement -> N/Q2
+    assert dup.bases[2] == N_CODE and dup.quals[2] == MIN_PHRED
+    # agreement capped at Q93: 30+93=123 -> 93
+    assert dup.quals[3] == MAX_PHRED
+    # N on either side -> N/Q2
+    assert dup.bases[4] == N_CODE and dup.quals[4] == MIN_PHRED
+
+
+def test_duplex_single_strand_molecules(tmp_path):
+    sim = str(tmp_path / "ss.bam")
+    cli_main(["simulate", "duplex-reads", "-o", sim, "--num-molecules", "10",
+              "--reads-per-strand", "2", "--ba-fraction", "0.0"])
+    # default min_reads [1] -> min_yx = 1 -> AB-only molecules rejected
+    out = str(tmp_path / "strict.bam")
+    cli_main(["duplex", "-i", sim, "-o", out])
+    with BamReader(out) as r:
+        assert list(r) == []
+    # [1, 1, 0] allows single-strand consensus
+    out2 = str(tmp_path / "loose.bam")
+    cli_main(["duplex", "-i", sim, "-o", out2, "--min-reads", "1", "1", "0"])
+    with BamReader(out2) as r:
+        recs = list(r)
+    assert len(recs) == 20
+    for rec in recs:
+        assert rec.get_int(b"bD") == 0  # no BA strand
+        assert rec.get_str(b"bc") is None
+
+
+def test_parse_min_reads():
+    assert parse_min_reads([3]) == (3, 3, 3)
+    assert parse_min_reads([3, 2]) == (3, 2, 2)
+    assert parse_min_reads([3, 2, 1]) == (3, 2, 1)
+    with pytest.raises(ValueError):
+        parse_min_reads([])
+    with pytest.raises(ValueError):
+        parse_min_reads([1, 2])  # not high-to-low
+    with pytest.raises(ValueError):
+        parse_min_reads([1, 2, 3, 4])
+
+
+def test_duplex_min_reads_filtering(dup_bam, tmp_path):
+    # each strand has 3 R1s; require 4 per smaller strand -> invalid ordering guard
+    out = run_duplex(dup_bam, tmp_path, "f.bam", extra=["--min-reads", "8", "4", "4"])
+    with BamReader(out) as r:
+        assert list(r) == []  # 3 < 4 per strand -> all rejected
+    out = run_duplex(dup_bam, tmp_path, "f2.bam", extra=["--min-reads", "6", "3", "3"])
+    with BamReader(out) as r:
+        assert len(list(r)) == 50  # exactly 3 per strand passes

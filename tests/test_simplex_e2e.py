@@ -169,7 +169,8 @@ def test_rx_tag_consensus(tmp_path):
             b.tag_str(b"RX", b"AAGG" if i < 2 else b"AAGC")
             w.write_record_bytes(b.finish())
     out = str(tmp_path / "rx_cons.bam")
-    assert cli_main(["simplex", "-i", path, "-o", out, "--min-reads", "1"]) == 0
+    assert cli_main(["simplex", "-i", path, "-o", out, "--min-reads", "1",
+                     "--allow-unmapped"]) == 0
     with BamReader(out) as r:
         (rec,) = list(r)
     assert rec.get_str(b"RX") == "AAGG"

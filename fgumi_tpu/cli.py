@@ -164,6 +164,70 @@ def cmd_duplex(args):
     return 0
 
 
+def _add_group(sub):
+    p = sub.add_parser("group", help="Group reads by UMI (GroupReadsByUmi)")
+    p.add_argument("-i", "--input", required=True,
+                   help="template-coordinate sorted BAM with RX tags")
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("-s", "--strategy", default="adjacency",
+                   choices=["identity", "edit", "adjacency", "paired"])
+    p.add_argument("-e", "--edits", type=int, default=1)
+    p.add_argument("-t", "--raw-tag", default="RX")
+    p.add_argument("-T", "--assign-tag", default="MI")
+    p.add_argument("-m", "--min-map-q", type=int, default=1)
+    p.add_argument("-n", "--include-non-pf-reads", action="store_true")
+    p.add_argument("--min-umi-length", type=int, default=None)
+    p.add_argument("--no-umi", action="store_true")
+    p.add_argument("--allow-unmapped", action="store_true")
+    p.add_argument("--family-size-out", default=None,
+                   help="optional TSV of family size counts")
+    p.set_defaults(func=cmd_group)
+
+
+def cmd_group(args):
+    from .commands.group import run_group
+    from .io.bam import BamHeader, BamReader, BamWriter
+
+    from .core.template import is_query_grouped, is_template_coordinate_sorted
+
+    t0 = time.monotonic()
+    with BamReader(args.input) as reader:
+        hdr_text = reader.header.text
+        # classify_input_ordering (group.rs:470-500): template-coordinate, or
+        # query-grouped under --allow-unmapped; anything else is unusable.
+        if not is_template_coordinate_sorted(hdr_text):
+            if not (args.allow_unmapped and is_query_grouped(hdr_text)):
+                log.error(
+                    "group requires template-coordinate sorted input (header must "
+                    "advertise SS:template-coordinate); sort with "
+                    "`fgumi-tpu sort --order template-coordinate` first. "
+                    "--allow-unmapped additionally accepts query-grouped input "
+                    "(GO:query / SO:queryname).")
+                return 2
+        out_header = BamHeader(text=hdr_text, ref_names=reader.header.ref_names,
+                               ref_lengths=reader.header.ref_lengths)
+        with BamWriter(args.output, out_header) as writer:
+            try:
+                result = run_group(
+                    reader, writer, strategy=args.strategy, edits=args.edits,
+                    umi_tag=args.raw_tag.encode(), assigned_tag=args.assign_tag.encode(),
+                    min_mapq=args.min_map_q, include_non_pf=args.include_non_pf_reads,
+                    min_umi_length=args.min_umi_length, no_umi=args.no_umi,
+                    allow_unmapped=args.allow_unmapped)
+            except ValueError as e:
+                log.error("%s", e)
+                return 2
+    dt = time.monotonic() - t0
+    log.info("group: wrote %d records in %.2fs; filter=%s", result["records_out"],
+             dt, result["filter"])
+    if args.family_size_out:
+        with open(args.family_size_out, "w") as f:
+            f.write("family_size\tcount\n")
+            for size, count in result["family_sizes"].items():
+                f.write(f"{size}\t{count}\n")
+    return 0
+
+
 def _add_simulate(sub):
     p = sub.add_parser("simulate", help="Generate synthetic test data")
     ps = p.add_subparsers(dest="sim_mode", required=True)
@@ -189,6 +253,16 @@ def _add_simulate(sub):
     d.add_argument("--ba-fraction", type=float, default=1.0)
     d.add_argument("--seed", type=int, default=42)
     d.set_defaults(func=cmd_simulate_duplex)
+    m = ps.add_parser("mapped-reads", help="template-coordinate BAM with RX tags (group input)")
+    m.add_argument("-o", "--output", required=True)
+    m.add_argument("--num-families", type=int, default=100)
+    m.add_argument("--family-size", type=int, default=5)
+    m.add_argument("--read-length", type=int, default=100)
+    m.add_argument("--umi-length", type=int, default=8)
+    m.add_argument("--umi-error-rate", type=float, default=0.02)
+    m.add_argument("--paired-umis", action="store_true")
+    m.add_argument("--seed", type=int, default=42)
+    m.set_defaults(func=cmd_simulate_mapped)
 
 
 def cmd_simulate_grouped(args):
@@ -215,6 +289,18 @@ def cmd_simulate_duplex(args):
     return 0
 
 
+def cmd_simulate_mapped(args):
+    from .simulate import simulate_mapped_bam
+
+    n = simulate_mapped_bam(
+        args.output, num_families=args.num_families, family_size=args.family_size,
+        read_length=args.read_length, umi_length=args.umi_length,
+        umi_error_rate=args.umi_error_rate, paired_umis=args.paired_umis,
+        seed=args.seed)
+    log.info("simulate: wrote %d records to %s", n, args.output)
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="fgumi-tpu",
@@ -224,6 +310,7 @@ def main(argv=None):
     sub = parser.add_subparsers(dest="command", required=True)
     _add_simplex(sub)
     _add_duplex(sub)
+    _add_group(sub)
     _add_simulate(sub)
     args = parser.parse_args(argv)
     logging.basicConfig(

@@ -1,0 +1,224 @@
+"""GroupReadsByUmi: assign molecule identifiers to templates by position + UMI.
+
+Mirrors /root/reference/src/lib/commands/group.rs:
+- requires template-coordinate sorted input (SO:unsorted GO:query
+  SS:...:template-coordinate), or query-grouped with --allow-unmapped
+  (classify_input_ordering, group.rs:470-500);
+- streaming position groups at ReadInfo key boundaries (RecordPositionGrouper
+  analog, grouper.rs:409-572);
+- template filtering: min-map-q (both reads + MQ tag), non-PF, N-containing UMIs,
+  min-umi-length (filter_template_raw, group.rs:110-270);
+- per-group UMI assignment via the strategy assigners, with templates split by
+  pair orientation for non-paired strategies (assign_umi_groups_impl,
+  group.rs:505-560);
+- MI:Z tags minted from a single global counter in stream order (the
+  deterministic-MI-numbering contract, docs/design/deterministic-mi-numbering.md);
+- family-size and position-group-size metrics.
+"""
+
+import logging
+import struct
+
+from ..core.template import (is_r1_genomically_earlier, iter_templates,
+                             library_lookup_from_header, read_info_key)
+from ..io.bam import (FLAG_MATE_UNMAPPED, FLAG_PAIRED, FLAG_QC_FAIL,
+                      FLAG_REVERSE, FLAG_UNMAPPED, RawRecord)
+from ..umi.assigners import make_assigner
+
+log = logging.getLogger("fgumi_tpu.group")
+
+
+class FilterMetrics:
+    def __init__(self):
+        self.total_templates = 0
+        self.accepted = 0
+        self.poor_alignment = 0
+        self.non_pf = 0
+        self.ns_in_umi = 0
+        self.umi_too_short = 0
+
+    def as_dict(self):
+        return {k: v for k, v in self.__dict__.items() if v}
+
+
+def _umi_base_count(umi: str) -> int:
+    return sum(len(seg) for seg in umi.split("-"))
+
+
+def filter_template(t, *, umi_tag: bytes, min_mapq: int, include_non_pf: bool,
+                    min_umi_length, no_umi: bool, allow_unmapped: bool,
+                    metrics: FilterMetrics) -> bool:
+    """filter_template_raw (group.rs:110-270)."""
+    primaries = t.primary_records()
+    metrics.total_templates += len(primaries)
+    if not primaries:
+        metrics.poor_alignment += len(primaries)
+        return False
+    reads = [r for r in (t.r1, t.r2, t.fragment) if r is not None]
+    both_unmapped = all(r.flag & FLAG_UNMAPPED for r in reads)
+    if both_unmapped and not allow_unmapped:
+        metrics.poor_alignment += len(primaries)
+        return False
+    for r in reads:
+        if not include_non_pf and r.flag & FLAG_QC_FAIL:
+            metrics.non_pf += len(primaries)
+            return False
+        if not r.flag & FLAG_UNMAPPED and r.mapq < min_mapq:
+            metrics.poor_alignment += len(primaries)
+            return False
+    for r in reads:
+        # mate MAPQ (MQ tag) check when the mate is mapped
+        if r.flag & FLAG_PAIRED and not r.flag & FLAG_MATE_UNMAPPED:
+            mq = r.get_int(b"MQ")
+            if mq is not None and mq < min_mapq:
+                metrics.poor_alignment += len(primaries)
+                return False
+        if no_umi:
+            continue
+        umi = r.get_str(umi_tag)
+        if umi is None:
+            metrics.poor_alignment += len(primaries)
+            return False
+        if "N" in umi.upper():
+            metrics.ns_in_umi += len(primaries)
+            return False
+        if min_umi_length is not None and _umi_base_count(umi) < min_umi_length:
+            metrics.umi_too_short += len(primaries)
+            return False
+    return True
+
+
+def iter_position_groups(templates, library_of):
+    """Group consecutive templates by ReadInfo key (RecordPositionGrouper analog)."""
+    current_key = None
+    bucket = []
+    for t in templates:
+        r = t.primary_r1 or t.r2
+        rg = r.get_str(b"RG") if r is not None else None
+        key = read_info_key(t, library_of.get(rg, "unknown"))
+        if key != current_key:
+            if bucket:
+                yield bucket
+            current_key = key
+            bucket = [t]
+        else:
+            bucket.append(t)
+    if bucket:
+        yield bucket
+
+
+def pair_orientation(t):
+    """(r1_positive, r2_positive), None-reads read as positive (group.rs:276-287)."""
+    r1_pos = t.r1 is None or not t.r1.flag & FLAG_REVERSE
+    r2_pos = t.r2 is None or not t.r2.flag & FLAG_REVERSE
+    return (r1_pos, r2_pos)
+
+
+def extract_umi(t, umi_tag: bytes, assigner) -> str:
+    """umi_for_read_impl (group.rs:295-344): uppercase; paired strategies get
+    orientation prefixes by genomic order of R1/R2."""
+    r = t.primary_r1 or t.r2
+    umi = r.get_str(umi_tag)
+    if umi is None:
+        raise ValueError(f"template {t.name!r} missing UMI tag {umi_tag.decode()}")
+    umi = umi.upper()
+    if assigner.split_by_orientation():
+        return umi
+    parts = umi.split("-")
+    if len(parts) != 2:
+        raise ValueError(
+            f"Paired strategy used but UMI did not contain 2 segments "
+            f"delimited by '-': {umi}")
+    if t.r1 is not None and t.r2 is not None:
+        r1_earlier = is_r1_genomically_earlier(t.r1, t.r2)
+    else:
+        r1_earlier = True
+    lo, hi = assigner.lower_prefix, assigner.higher_prefix
+    if r1_earlier:
+        return f"{lo}:{parts[0]}-{hi}:{parts[1]}"
+    return f"{hi}:{parts[0]}-{lo}:{parts[1]}"
+
+
+def truncate_umis(umis, min_umi_length):
+    """truncate_umis_impl (group.rs:346-358)."""
+    if min_umi_length is None:
+        return umis
+    shortest = min((len(u) for u in umis), default=0)
+    if shortest < min_umi_length:
+        raise ValueError(
+            f"UMI found that had shorter length than expected "
+            f"({shortest} < {min_umi_length})")
+    return [u[:min_umi_length] for u in umis]
+
+
+def assign_group(templates, assigner, umi_tag: bytes, min_umi_length, no_umi: bool):
+    """Assign MoleculeIds to one position group's templates (in place)."""
+    if assigner.split_by_orientation():
+        subgroups = {}
+        for idx, t in enumerate(templates):
+            subgroups.setdefault(pair_orientation(t), []).append(idx)
+        ordered = sorted(subgroups.items())
+        index_sets = [idxs for _, idxs in ordered]
+    else:
+        index_sets = [list(range(len(templates)))]
+    for indices in index_sets:
+        if no_umi:
+            umis = [""] * len(indices)
+        else:
+            umis = [extract_umi(templates[i], umi_tag, assigner) for i in indices]
+            umis = truncate_umis(umis, min_umi_length)
+        assignments = assigner.assign(umis)
+        for i, idx in enumerate(indices):
+            templates[idx].mi = assignments[i]
+
+
+def append_mi_tag(rec: RawRecord, mi: str, assigned_tag: bytes = b"MI") -> bytes:
+    """Record bytes with the assigned tag set (pre-existing occurrences removed,
+    so re-running group replaces rather than duplicates the tag)."""
+    return rec.data_without_tag(assigned_tag) + assigned_tag + b"Z" + mi.encode() + b"\x00"
+
+
+def run_group(reader, writer, *, strategy: str = "adjacency", edits: int = 1,
+              umi_tag: bytes = b"RX", assigned_tag: bytes = b"MI", min_mapq: int = 1,
+              include_non_pf: bool = False, min_umi_length=None, no_umi: bool = False,
+              allow_unmapped: bool = False):
+    """Stream reader -> writer assigning MI tags. Returns (metrics dict)."""
+    assigner = make_assigner(strategy, edits)
+    if no_umi and strategy == "paired":
+        raise ValueError("--no-umi cannot be combined with the paired strategy")
+    library_of = library_lookup_from_header(reader.header.text)
+    metrics = FilterMetrics()
+    family_sizes = {}
+    position_group_sizes = {}
+    n_out = 0
+
+    for group in iter_position_groups(iter_templates(reader), library_of):
+        kept = [t for t in group
+                if filter_template(t, umi_tag=umi_tag, min_mapq=min_mapq,
+                                   include_non_pf=include_non_pf,
+                                   min_umi_length=min_umi_length, no_umi=no_umi,
+                                   allow_unmapped=allow_unmapped, metrics=metrics)]
+        if not kept:
+            continue
+        metrics.accepted += sum(len(t.primary_records()) for t in kept)
+        assign_group(kept, assigner, umi_tag, min_umi_length, no_umi)
+        # family sizes: templates per molecule id in this group
+        sizes = {}
+        for t in kept:
+            key = t.mi.render()
+            sizes[key] = sizes.get(key, 0) + 1
+        for size in sizes.values():
+            family_sizes[size] = family_sizes.get(size, 0) + 1
+        pg = sum(sizes.values())
+        position_group_sizes[pg] = position_group_sizes.get(pg, 0) + 1
+        for t in kept:
+            mi = t.mi.render()
+            for rec in t.primary_records():
+                writer.write_record_bytes(append_mi_tag(rec, mi, assigned_tag))
+                n_out += 1
+    return {
+        "records_out": n_out,
+        "filter": metrics.as_dict(),
+        "family_sizes": dict(sorted(family_sizes.items())),
+        "position_group_sizes": dict(sorted(position_group_sizes.items())),
+    }

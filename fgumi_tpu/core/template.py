@@ -1,0 +1,160 @@
+"""Template assembly and template-coordinate position keys.
+
+Mirrors /root/reference/src/lib/template.rs (Template = all records of one QNAME,
+classified primary R1/R2/fragment vs secondary/supplementary) and
+/root/reference/src/lib/read_info.rs (ReadInfo: unclipped 5' positions of both ends,
+lower coordinate first, with unmapped sentinels; library from the RG->LB header map).
+"""
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..io.bam import (FLAG_FIRST, FLAG_LAST, FLAG_PAIRED, FLAG_REVERSE,
+                      FLAG_SECONDARY, FLAG_SUPPLEMENTARY, FLAG_UNMAPPED, RawRecord)
+
+# Sentinels for unmapped ends (read_info.rs: unmapped reads sort after mapped).
+UNKNOWN_REF = 2**31 - 1
+UNKNOWN_POS = 2**31 - 1
+UNKNOWN_STRAND = 2
+
+
+@dataclass
+class Template:
+    """All records sharing one QNAME."""
+
+    name: bytes
+    r1: Optional[RawRecord] = None
+    r2: Optional[RawRecord] = None
+    fragment: Optional[RawRecord] = None
+    other: list = field(default_factory=list)  # secondary/supplementary
+    mi: object = None  # MoleculeId set by group
+
+    def primary_records(self):
+        return [r for r in (self.fragment, self.r1, self.r2) if r is not None]
+
+    def all_records(self):
+        return self.primary_records() + self.other
+
+    @property
+    def primary_r1(self):
+        """The primary first-of-pair read, or the fragment read (template.rs r1 role)."""
+        return self.r1 if self.r1 is not None else self.fragment
+
+
+def classify(records) -> Template:
+    """Build a Template from one QNAME's records."""
+    t = Template(name=records[0].name)
+    for rec in records:
+        flg = rec.flag
+        if flg & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY):
+            t.other.append(rec)
+        elif not flg & FLAG_PAIRED:
+            t.fragment = rec
+        elif flg & FLAG_FIRST:
+            t.r1 = rec
+        elif flg & FLAG_LAST:
+            t.r2 = rec
+        else:
+            t.other.append(rec)
+    return t
+
+
+def iter_templates(records):
+    """Yield Templates from query-grouped records (consecutive same QNAME)."""
+    current_name = None
+    bucket = []
+    for rec in records:
+        name = rec.name
+        if name != current_name:
+            if bucket:
+                yield classify(bucket)
+            current_name = name
+            bucket = [rec]
+        else:
+            bucket.append(rec)
+    if bucket:
+        yield classify(bucket)
+
+
+def unclipped_5prime(rec: RawRecord) -> int:
+    """Unclipped 5' position: unclipped start for forward, unclipped end for reverse."""
+    if rec.flag & FLAG_REVERSE:
+        return rec.unclipped_end()
+    return rec.unclipped_start()
+
+
+def is_r1_genomically_earlier(r1: RawRecord, r2: RawRecord) -> bool:
+    """commands/common.rs:1086-1100: ref, then unclipped 5', then forward-first."""
+    if r1.ref_id != r2.ref_id:
+        return r1.ref_id < r2.ref_id
+    p1, p2 = unclipped_5prime(r1), unclipped_5prime(r2)
+    if p1 != p2:
+        return p1 < p2
+    return not r1.flag & FLAG_REVERSE
+
+
+def _end_info(rec: RawRecord):
+    return (rec.ref_id, unclipped_5prime(rec), 1 if rec.flag & FLAG_REVERSE else 0)
+
+
+def read_info_key(template: Template, library: str):
+    """Position-group key (ReadInfo, read_info.rs:247-360): library + the two ends'
+    (ref, unclipped 5' pos, strand), lower coordinate first; unmapped ends use
+    sentinels that sort after mapped."""
+    r1, r2 = template.r1, template.r2
+    if r1 is None and r2 is None:
+        r1 = template.fragment
+    unknown = (UNKNOWN_REF, UNKNOWN_POS, UNKNOWN_STRAND)
+
+    def mapped(r):
+        return r is not None and not r.flag & FLAG_UNMAPPED
+
+    if r1 is not None and r2 is not None:
+        m1, m2 = mapped(r1), mapped(r2)
+        if not m1 and not m2:
+            a = b = unknown
+        elif m1 and not m2:
+            a, b = _end_info(r1), unknown
+        elif m2 and not m1:
+            a, b = _end_info(r2), unknown
+        else:
+            e1, e2 = _end_info(r1), _end_info(r2)
+            a, b = (e1, e2) if e1 <= e2 else (e2, e1)
+    elif r1 is not None or r2 is not None:
+        r = r1 if r1 is not None else r2
+        a, b = (_end_info(r), unknown) if mapped(r) else (unknown, unknown)
+    else:
+        a = b = unknown
+    return (library, *a, *b)
+
+
+def _hd_fields(header_text: str) -> dict:
+    for line in header_text.splitlines():
+        if line.startswith("@HD"):
+            return dict(f.split(":", 1) for f in line.split("\t")[1:] if ":" in f)
+    return {}
+
+
+def is_template_coordinate_sorted(header_text: str) -> bool:
+    """@HD advertises SS:...template-coordinate (sam.rs is_template_coordinate_sorted)."""
+    ss = _hd_fields(header_text).get("SS", "")
+    return ss.split(":")[-1] == "template-coordinate"
+
+
+def is_query_grouped(header_text: str) -> bool:
+    """@HD advertises GO:query or SO:queryname (sam.rs is_query_grouped)."""
+    hd = _hd_fields(header_text)
+    return hd.get("GO") == "query" or hd.get("SO") == "queryname"
+
+
+def library_lookup_from_header(header_text: str) -> dict:
+    """RG id -> LB library name from @RG lines (read_info.rs:63-77); missing LB
+    maps to 'unknown'."""
+    lookup = {}
+    for line in header_text.splitlines():
+        if not line.startswith("@RG"):
+            continue
+        fields = dict(f.split(":", 1) for f in line.split("\t")[1:] if ":" in f)
+        if "ID" in fields:
+            lookup[fields["ID"]] = fields.get("LB", "unknown")
+    return lookup

@@ -213,6 +213,22 @@ class RawRecord:
     def aux_bytes(self) -> bytes:
         return self.data[self._aux_off():]
 
+    def data_without_tag(self, tag: bytes) -> bytes:
+        """Record bytes with every occurrence of `tag` removed (aux TLV edit)."""
+        spans = []
+        for t, typ, off in self._iter_tags():
+            if t == tag:
+                spans.append((off - 3, _skip_tag_value(self.data, typ, off)))
+        if not spans:
+            return self.data
+        out = bytearray()
+        prev = 0
+        for start, end in spans:
+            out += self.data[prev:start]
+            prev = end
+        out += self.data[prev:]
+        return bytes(out)
+
     def read_length_from_cigar(self) -> int:
         return sum(n for op, n in self.cigar() if op in _CONSUMES_QUERY)
 

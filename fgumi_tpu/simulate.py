@@ -39,6 +39,87 @@ def _build_mapped_record(name, flag, ref_id, pos, mapq, cigar_ops, seq, quals,
     return bytes(buf)
 
 
+def simulate_mapped_bam(path: str, num_families: int = 100, family_size: int = 5,
+                        read_length: int = 100, umi_length: int = 8,
+                        umi_error_rate: float = 0.02, error_rate: float = 0.01,
+                        base_quality: int = 35, seed: int = 42, paired_umis: bool = False,
+                        ref_name: str = "chr1", ref_length: int = 10_000_000):
+    """Write a template-coordinate-ordered mapped BAM with RX UMI tags (pre-`group`).
+
+    Families share a genomic position and a true UMI; per-read UMIs carry errors at
+    ``umi_error_rate`` per base. With ``paired_umis`` the RX is dual ("AAAA-CCCC") and
+    half the reads come from the opposite strand with the flipped UMI — the
+    `group --strategy paired` input shape.
+    """
+    rng = np.random.default_rng(seed)
+    header = BamHeader(
+        text="@HD\tVN:1.6\tSO:unsorted\tGO:query\tSS:unsorted:template-coordinate\n"
+             f"@SQ\tSN:{ref_name}\tLN:{ref_length}\n"
+             "@RG\tID:A\tSM:sample\tLB:lib\n",
+        ref_names=[ref_name], ref_lengths=[ref_length],
+    )
+    # families at distinct positions, emitted in position order (template-coordinate)
+    starts = np.sort(rng.choice(ref_length - 4 * read_length,
+                                size=num_families, replace=False))
+    n_written = 0
+    with BamWriter(path, header) as w:
+        for fam, start in enumerate(starts):
+            start = int(start)
+            insert = 2 * read_length
+            r2_pos = start + insert - read_length
+            half = umi_length // 2
+            u1 = CODE_TO_BASE[rng.integers(0, 4, size=half)].tobytes().decode()
+            u2 = CODE_TO_BASE[rng.integers(0, 4, size=umi_length - half)].tobytes().decode()
+            true_umi = f"{u1}-{u2}" if paired_umis else (u1 + u2)
+            cigar = [("M", read_length)]
+            mc = f"{read_length}M".encode()
+            truth1 = rng.integers(0, 4, size=read_length).astype(np.uint8)
+            truth2 = rng.integers(0, 4, size=read_length).astype(np.uint8)
+
+            def mutate_seq(truth):
+                codes = truth.copy()
+                errs = rng.random(read_length) < error_rate
+                n_err = int(errs.sum())
+                if n_err:
+                    codes[errs] = (codes[errs] + rng.integers(1, 4, n_err)) % 4
+                return CODE_TO_BASE[codes].tobytes()
+
+            for r in range(family_size):
+                # per-read UMI with at most one error (so `--edits 1` strategies
+                # provably re-merge every family; rate = per-base rate * length)
+                def mutate_umi(u):
+                    chars = list(u)
+                    base_positions = [i for i, c in enumerate(chars) if c != "-"]
+                    if rng.random() < umi_error_rate * len(base_positions):
+                        i = int(rng.choice(base_positions))
+                        c = chars[i]
+                        chars[i] = "ACGT"[("ACGT".index(c) + int(rng.integers(1, 4))) % 4]
+                    return "".join(chars)
+
+                is_ba = paired_umis and bool(rng.integers(0, 2))
+                rx = mutate_umi(true_umi)
+                if is_ba:
+                    a, b = rx.split("-")
+                    rx = f"{b}-{a}"
+                seq1 = mutate_seq(truth1)
+                seq2 = mutate_seq(truth2)
+                quals = np.full(read_length, base_quality, dtype=np.uint8)
+                name = f"t{fam}:{r}".encode()
+                tags = [(b"MC", "Z", mc), (b"RG", "Z", b"A"), (b"RX", "Z", rx.encode())]
+                # BA-strand templates flip which physical end is R1
+                first_flag, last_flag = (FLAG_LAST, FLAG_FIRST) if is_ba else (FLAG_FIRST, FLAG_LAST)
+                rec1 = _build_mapped_record(
+                    name, FLAG_PAIRED | first_flag | FLAG_MATE_REVERSE, 0, start, 60,
+                    cigar, seq1, quals, 0, r2_pos, insert, tags)
+                rec2 = _build_mapped_record(
+                    name, FLAG_PAIRED | last_flag | FLAG_REVERSE, 0, r2_pos, 60,
+                    cigar, seq2, quals, 0, start, -insert, tags)
+                w.write_record_bytes(rec1)
+                w.write_record_bytes(rec2)
+                n_written += 2
+    return n_written
+
+
 def simulate_duplex_bam(path: str, num_molecules: int = 100, reads_per_strand: int = 3,
                         read_length: int = 100, error_rate: float = 0.01,
                         base_quality: int = 35, qual_jitter: int = 5, seed: int = 42,

@@ -1,0 +1,375 @@
+"""UMI assignment strategies.
+
+Mirrors /root/reference/crates/fgumi-umi/src/assigner.rs:
+- MoleculeId {None, Single, PairedA, PairedB} rendered "42" / "42/A" / "42/B"
+  (crates/fgumi-umi/src/lib.rs:20-80)
+- identity: exact match on uppercased strings, IDs in sorted order (assigner.rs:915-936)
+- edit: transitive single-linkage within Hamming distance; components get IDs in
+  order of their smallest member (assigner.rs:999-1108)
+- adjacency: UMI-tools directed graph — count-desc (tie: string) order, BFS capture
+  of unassigned children with child_count <= parent_count/2 + 1 within distance
+  (assigner.rs:1552-1640,1174-1420)
+- paired: adjacency over canonicalized dual UMIs (A-B vs B-A), /A-/B strand IDs by
+  orientation vs the root (assigner.rs:1735-2235)
+
+Invalid UMIs (non-ACGT, >32 bases per segment) never join a valid molecule; each
+distinct (uppercased) invalid string gets its own Single id
+(assign_with_invalid_fallback, assigner.rs:692-707).
+
+The all-pairs Hamming distance work — the hot part for large position groups — is
+vectorized over byte matrices; groups above ``DEVICE_THRESHOLD`` unique UMIs compute
+the candidate-distance matrix as an XLA kernel on the accelerator (XOR/compare +
+popcount-style reduction), the "brute-force-on-accelerator" design SURVEY.md §7
+replaces the reference's BK-tree/N-gram indexes with.
+"""
+
+from collections import deque
+from dataclasses import dataclass
+
+import numpy as np
+
+# Unique-UMI count above which the pairwise distance matrix moves to the device.
+DEVICE_THRESHOLD = 1024
+
+_VALID = frozenset(b"ACGT")
+
+
+@dataclass(frozen=True)
+class MoleculeId:
+    """kind: '' (none), 'S' (single), 'A'/'B' (paired strands)."""
+
+    kind: str
+    id: int = -1
+
+    def render(self) -> str:
+        if self.kind == "S":
+            return str(self.id)
+        if self.kind in ("A", "B"):
+            return f"{self.id}/{self.kind}"
+        return ""
+
+
+NONE_ID = MoleculeId("")
+
+
+def _is_encodable(umi: str) -> bool:
+    """BitEnc-encodable: every dash-separated segment is ACGT (case-folded), <=32."""
+    for seg in umi.split("-"):
+        # strip orientation prefix ("aa:"/"bb:") if present
+        seg = seg.rsplit(":", 1)[-1]
+        if len(seg) > 32:
+            return False
+        if not all(b in _VALID for b in seg.upper().encode()):
+            return False
+    return True
+
+
+def _umi_matrix(umis) -> np.ndarray:
+    """(N, L) uint8 byte matrix of equal-length strings."""
+    return np.frombuffer("".join(umis).encode(), dtype=np.uint8).reshape(len(umis), -1)
+
+
+def pairwise_distances(mat_a: np.ndarray, mat_b: np.ndarray = None) -> np.ndarray:
+    """All-pairs Hamming distances between byte matrices (int16).
+
+    Large inputs run as a one-hot einsum on the accelerator — the XLA equivalent
+    of the reference's XOR+popcount BitEnc path (crates/fgumi-dna/src/bitenc.rs:111-124),
+    batched over the whole position group at once.
+    """
+    if mat_b is None:
+        mat_b = mat_a
+    n, m = mat_a.shape[0], mat_b.shape[0]
+    if max(n, m) >= DEVICE_THRESHOLD:
+        return _device_pairwise(mat_a, mat_b)
+    return (mat_a[:, None, :] != mat_b[None, :, :]).sum(axis=2, dtype=np.int16)
+
+
+def _device_pairwise(mat_a: np.ndarray, mat_b: np.ndarray) -> np.ndarray:
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def dist(a, b):
+        # one-hot over the observed byte alphabet -> matches via matmul on the MXU
+        alphabet = jnp.unique(jnp.concatenate([a.ravel(), b.ravel()]), size=8,
+                              fill_value=0)
+        oh_a = (a[..., None] == alphabet).astype(jnp.bfloat16)  # (N, L, K)
+        oh_b = (b[..., None] == alphabet).astype(jnp.bfloat16)
+        matches = jnp.einsum("nlk,mlk->nm", oh_a, oh_b)
+        return (a.shape[1] - matches).astype(jnp.int16)
+
+    return np.asarray(jax.device_get(dist(jnp.asarray(mat_a), jnp.asarray(mat_b))))
+
+
+def _assert_uniform_length(lengths) -> None:
+    it = iter(lengths)
+    first = next(it, None)
+    if first is None:
+        return
+    for ln in it:
+        if ln != first:
+            raise ValueError(f"Multiple UMI lengths: {ln} vs {first}")
+
+
+class _Counter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0
+
+    def next_id(self) -> int:
+        v = self.value
+        self.value += 1
+        return v
+
+
+def _with_invalid_fallback(umis, resolve, counter):
+    """Per-input ids with a per-distinct-invalid-string fallback (assigner.rs:692-707)."""
+    invalid_to_id = {}
+    out = []
+    for i, umi in enumerate(umis):
+        mid = resolve(i, umi)
+        if mid is None:
+            key = umi.upper()
+            if key not in invalid_to_id:
+                invalid_to_id[key] = MoleculeId("S", counter.next_id())
+            mid = invalid_to_id[key]
+        out.append(mid)
+    return out
+
+
+class IdentityUmiAssigner:
+    """Exact-match grouping; IDs assigned over sorted unique uppercased UMIs."""
+
+    def __init__(self):
+        self.counter = _Counter()
+
+    def split_by_orientation(self) -> bool:
+        return True
+
+    def assign(self, raw_umis):
+        if not raw_umis:
+            return []
+        canon = [u.upper() for u in raw_umis]
+        mapping = {c: MoleculeId("S", self.counter.next_id()) for c in sorted(set(canon))}
+        return [mapping[c] for c in canon]
+
+
+class SimpleErrorUmiAssigner:
+    """Transitive single-linkage clustering within ``max_mismatches`` (edit strategy)."""
+
+    def __init__(self, max_mismatches: int = 1):
+        self.max_mismatches = max_mismatches
+        self.counter = _Counter()
+
+    def split_by_orientation(self) -> bool:
+        return True
+
+    def assign(self, raw_umis):
+        if not raw_umis:
+            return []
+        upper = [u.upper() for u in raw_umis]
+        valid = sorted({u for u in set(upper) if _is_encodable(u)})
+        _assert_uniform_length(len(u) for u in valid)
+        umi_to_id = {}
+        if valid:
+            mat = _umi_matrix(valid)
+            within = pairwise_distances(mat) <= self.max_mismatches
+            # connected components = transitive closure of the match graph
+            n = len(valid)
+            comp = np.full(n, -1, dtype=np.int64)
+            n_comp = 0
+            for i in range(n):
+                if comp[i] >= 0:
+                    continue
+                stack = [i]
+                comp[i] = n_comp
+                while stack:
+                    j = stack.pop()
+                    for k in np.nonzero(within[j] & (comp < 0))[0]:
+                        comp[k] = n_comp
+                        stack.append(int(k))
+                n_comp += 1
+            # components ordered by smallest member (valid is sorted, so the
+            # first occurrence order IS smallest-member order)
+            comp_ids = {}
+            for i, u in enumerate(valid):
+                c = comp[i]
+                if c not in comp_ids:
+                    comp_ids[c] = MoleculeId("S", self.counter.next_id())
+                umi_to_id[u] = comp_ids[c]
+        return _with_invalid_fallback(upper, lambda _i, u: umi_to_id.get(u), self.counter)
+
+
+def _count_sorted_unique(upper, keys=None):
+    """(unique_key, count) sorted by (-count, key). keys default to the UMIs."""
+    counts = {}
+    for u in (keys if keys is not None else upper):
+        counts[u] = counts.get(u, 0) + 1
+    return sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
+
+
+def _adjacency_bfs(unique, counts, within):
+    """UMI-tools directed BFS (assigner.rs:1480-1548).
+
+    unique/counts sorted by (-count, string); within[i, j] = candidate match.
+    Returns (roots, parent_of) where parent_of[i] is the component root index.
+    """
+    n = len(unique)
+    counts_arr = np.asarray(counts)
+    assigned = np.zeros(n, dtype=bool)
+    root_of = np.full(n, -1, dtype=np.int64)
+    roots = []
+    for root in range(n):
+        if assigned[root]:
+            continue
+        roots.append(root)
+        assigned[root] = True
+        root_of[root] = root
+        queue = deque([root])
+        while queue:
+            idx = queue.popleft()
+            max_child = counts[idx] // 2 + 1
+            cand = np.nonzero(~assigned & (counts_arr <= max_child) & within[idx])[0]
+            for child in cand:
+                child = int(child)
+                assigned[child] = True
+                root_of[child] = root_of[idx]
+                queue.append(child)
+    return roots, root_of
+
+
+class AdjacencyUmiAssigner:
+    """UMI-tools directed adjacency strategy."""
+
+    def __init__(self, max_mismatches: int = 1):
+        self.max_mismatches = max_mismatches
+        self.counter = _Counter()
+
+    def split_by_orientation(self) -> bool:
+        return True
+
+    def assign(self, raw_umis):
+        if not raw_umis:
+            return []
+        upper = [u.upper() for u in raw_umis]
+        valid_mask = [_is_encodable(u) for u in upper]
+        counted = _count_sorted_unique([u for u, v in zip(upper, valid_mask) if v])
+        if not counted:
+            return _with_invalid_fallback(upper, lambda *_: None, self.counter)
+        _assert_uniform_length(len(u) for u, _ in counted)
+        unique = [u for u, _ in counted]
+        counts = [c for _, c in counted]
+        umi_to_id = {}
+        if len(unique) == 1:
+            umi_to_id[unique[0]] = MoleculeId("S", self.counter.next_id())
+        else:
+            mat = _umi_matrix(unique)
+            within = pairwise_distances(mat) <= self.max_mismatches
+            roots, root_of = _adjacency_bfs(unique, counts, within)
+            root_ids = {r: MoleculeId("S", self.counter.next_id()) for r in roots}
+            for i, u in enumerate(unique):
+                umi_to_id[u] = root_ids[int(root_of[i])]
+        return _with_invalid_fallback(upper, lambda _i, u: umi_to_id.get(u), self.counter)
+
+
+class PairedUmiAssigner:
+    """Dual-UMI (duplex) strategy: A-B and B-A group together with /A-/B strand ids."""
+
+    def __init__(self, max_mismatches: int = 1):
+        self.max_mismatches = max_mismatches
+        self.counter = _Counter()
+        prefix_len = max_mismatches + 1
+        self.lower_prefix = "a" * prefix_len
+        self.higher_prefix = "b" * prefix_len
+
+    def split_by_orientation(self) -> bool:
+        return False
+
+    @staticmethod
+    def _split(umi: str):
+        parts = umi.split("-")
+        if len(parts) != 2:
+            raise ValueError(f"UMI {umi!r} is not a valid paired UMI (expected 'A-B')")
+        return parts[0], parts[1]
+
+    @classmethod
+    def _reverse(cls, umi: str) -> str:
+        a, b = cls._split(umi)
+        return f"{b}-{a}"
+
+    @classmethod
+    def _canonical(cls, umi: str) -> str:
+        a, b = cls._split(umi)
+        return umi if a <= b else f"{b}-{a}"
+
+    def _matches(self, dist_fwd, dist_rev):
+        return (dist_fwd <= self.max_mismatches) | (dist_rev <= self.max_mismatches)
+
+    def assign(self, raw_umis):
+        if not raw_umis:
+            return []
+        for u in raw_umis:
+            self._split(u)  # validates exactly one '-'
+        upper = [u.upper() for u in raw_umis]
+        valid_mask = [_is_encodable(u) for u in upper]
+        canon = [self._canonical(u) if v else None
+                 for u, v in zip(upper, valid_mask)]
+        counted = _count_sorted_unique([c for c in canon if c is not None])
+        if not counted:
+            return _with_invalid_fallback(upper, lambda *_: None, self.counter)
+
+        def underlying_len(u):
+            a, b = self._split(u)
+            return len(a.rsplit(":", 1)[-1]) + len(b.rsplit(":", 1)[-1])
+
+        _assert_uniform_length(underlying_len(u) for u, _ in counted)
+        unique = [u for u, _ in counted]
+        counts = [c for _, c in counted]
+
+        umi_to_id = {}
+        if len(unique) == 1:
+            mid = self.counter.next_id()
+            ab, ba = MoleculeId("A", mid), MoleculeId("B", mid)
+            u = unique[0]
+            umi_to_id[u] = ab
+            umi_to_id[self._reverse(u)] = ba
+        else:
+            mat = _umi_matrix(unique)
+            rev_mat = _umi_matrix([self._reverse(u) for u in unique])
+            within = self._matches(pairwise_distances(mat),
+                                   pairwise_distances(rev_mat, mat))
+            roots, root_of = _adjacency_bfs(unique, counts, within)
+            root_mid = {r: self.counter.next_id() for r in roots}
+            for i, u in enumerate(unique):
+                root = int(root_of[i])
+                mid = root_mid[root]
+                ab, ba = MoleculeId("A", mid), MoleculeId("B", mid)
+                if i == root:
+                    umi_to_id[u] = ab
+                    umi_to_id[self._reverse(u)] = ba
+                else:
+                    root_umi = unique[root]
+                    d_fwd = sum(x != y for x, y in zip(root_umi, u))
+                    d_rev = sum(x != y for x, y in zip(root_umi, self._reverse(u)))
+                    if d_fwd < d_rev:
+                        umi_to_id[u] = ab
+                        umi_to_id[self._reverse(u)] = ba
+                    else:
+                        umi_to_id[u] = ba
+                        umi_to_id[self._reverse(u)] = ab
+        return _with_invalid_fallback(
+            upper, lambda i, u: umi_to_id.get(u) if valid_mask[i] else None, self.counter)
+
+
+def make_assigner(strategy: str, edits: int = 1):
+    """Strategy factory (group.rs Strategy enum)."""
+    if strategy == "identity":
+        return IdentityUmiAssigner()
+    if strategy == "edit":
+        return SimpleErrorUmiAssigner(edits)
+    if strategy == "adjacency":
+        return AdjacencyUmiAssigner(edits)
+    if strategy == "paired":
+        return PairedUmiAssigner(edits)
+    raise ValueError(f"unknown UMI strategy: {strategy}")

@@ -1,0 +1,176 @@
+"""`group` command E2E tests and best-practice pipeline chains."""
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.cli import main as cli_main
+from fgumi_tpu.io.bam import BamReader, FLAG_FIRST
+
+
+@pytest.fixture(scope="module")
+def mapped_bam(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("grp") / "mapped.bam")
+    rc = cli_main(["simulate", "mapped-reads", "-o", path, "--num-families", "30",
+                   "--family-size", "4", "--umi-error-rate", "0.03", "--seed", "11"])
+    assert rc == 0
+    return path
+
+
+def test_group_assigns_families(mapped_bam, tmp_path):
+    out = str(tmp_path / "g.bam")
+    assert cli_main(["group", "-i", mapped_bam, "-o", out]) == 0
+    by_name_mi = {}
+    mis_by_family = {}
+    umis_by_family = {}
+    with BamReader(out) as r:
+        n = 0
+        for rec in r:
+            n += 1
+            mi = rec.get_str(b"MI")
+            assert mi is not None
+            assert rec.get_str(b"RX") is not None  # original tag kept
+            name = rec.name.decode()
+            fam = name.split(":")[0]
+            # both mates of a template get the same MI
+            if name in by_name_mi:
+                assert by_name_mi[name] == mi
+            else:
+                umis_by_family.setdefault(fam, []).append(rec.get_str(b"RX").upper())
+                mis_by_family.setdefault(fam, []).append(mi)
+            by_name_mi[name] = mi
+    assert n == 240
+    # families sit at distinct positions, so MIs never cross families
+    all_mis = [set(v) for v in mis_by_family.values()]
+    for i, a in enumerate(all_mis):
+        for b in all_mis[i + 1:]:
+            assert not a & b
+    # group's partition within each family must equal running the adjacency
+    # assigner directly on that family's observed UMIs
+    from fgumi_tpu.umi.assigners import AdjacencyUmiAssigner
+    for fam, umis in umis_by_family.items():
+        expected = AdjacencyUmiAssigner(1).assign(umis)
+        got = mis_by_family[fam]
+        # compare partition structure (same groups, ignoring id values)
+        def partition(ids):
+            groups = {}
+            for i, x in enumerate(ids):
+                groups.setdefault(str(x), []).append(i)
+            return sorted(map(tuple, groups.values()))
+        assert partition(expected) == partition(got), fam
+
+
+def test_group_identity_splits_umi_errors(mapped_bam, tmp_path):
+    out = str(tmp_path / "gi.bam")
+    assert cli_main(["group", "-i", mapped_bam, "-o", out,
+                     "--strategy", "identity"]) == 0
+    with BamReader(out) as r:
+        fams = {}
+        for rec in r:
+            fam = rec.name.decode().split(":")[0]
+            fams.setdefault(fam, set()).add(rec.get_str(b"MI"))
+    # with 3% per-base UMI error, identity must split at least one family
+    assert any(len(v) > 1 for v in fams.values())
+
+
+def test_group_deterministic(mapped_bam, tmp_path):
+    o1, o2 = str(tmp_path / "d1.bam"), str(tmp_path / "d2.bam")
+    cli_main(["group", "-i", mapped_bam, "-o", o1])
+    cli_main(["group", "-i", mapped_bam, "-o", o2])
+    with BamReader(o1) as r1, BamReader(o2) as r2:
+        assert [r.data for r in r1] == [r.data for r in r2]
+
+
+def test_group_requires_template_coordinate_header(tmp_path):
+    sim = str(tmp_path / "plain.bam")
+    cli_main(["simulate", "grouped-reads", "-o", sim, "--num-families", "2"])
+    out = str(tmp_path / "never.bam")
+    assert cli_main(["group", "-i", sim, "-o", out]) == 2
+
+
+def test_group_min_mapq_filter(mapped_bam, tmp_path):
+    out = str(tmp_path / "mq.bam")
+    assert cli_main(["group", "-i", mapped_bam, "-o", out, "--min-map-q", "61"]) == 0
+    with BamReader(out) as r:
+        assert list(r) == []  # all reads are mapq 60
+
+
+def test_group_family_size_out(mapped_bam, tmp_path):
+    out = str(tmp_path / "fs.bam")
+    tsv = str(tmp_path / "fs.tsv")
+    cli_main(["group", "-i", mapped_bam, "-o", out, "--family-size-out", tsv])
+    lines = open(tsv).read().strip().splitlines()
+    assert lines[0] == "family_size\tcount"
+    sizes = dict(tuple(map(int, l.split("\t"))) for l in lines[1:])
+    # 30 simulated families x 4 templates; most collapse to size-4 molecules,
+    # a few split when every read drew a UMI error at a different position
+    assert sum(size * count for size, count in sizes.items()) == 120
+    assert sizes.get(4, 0) >= 25
+
+
+def test_paired_group_duplex_chain(tmp_path):
+    sim = str(tmp_path / "p.bam")
+    cli_main(["simulate", "mapped-reads", "-o", sim, "--num-families", "15",
+              "--family-size", "8", "--paired-umis", "--umi-error-rate", "0.02",
+              "--seed", "3"])
+    grouped = str(tmp_path / "pg.bam")
+    assert cli_main(["group", "-i", sim, "-o", grouped, "--strategy", "paired"]) == 0
+    with BamReader(grouped) as r:
+        strands = {}
+        for rec in r:
+            mi = rec.get_str(b"MI")
+            assert mi.endswith("/A") or mi.endswith("/B")
+            fam = rec.name.decode().split(":")[0]
+            strands.setdefault(fam, set()).add(mi.split("/")[0])
+        # each family collapses to one base molecule
+        for fam, bases in strands.items():
+            assert len(bases) == 1, f"{fam}: {bases}"
+    dup = str(tmp_path / "pd.bam")
+    assert cli_main(["duplex", "-i", grouped, "-o", dup,
+                     "--min-reads", "1", "1", "0"]) == 0
+    with BamReader(dup) as r:
+        recs = list(r)
+    assert len(recs) == 30  # 15 molecules x R1/R2
+
+
+def test_group_simplex_chain(mapped_bam, tmp_path):
+    grouped = str(tmp_path / "gs.bam")
+    cli_main(["group", "-i", mapped_bam, "-o", grouped])
+    cons = str(tmp_path / "cons.bam")
+    assert cli_main(["simplex", "-i", cons.replace("cons", "gs"), "-o", cons,
+                     "--min-reads", "1"]) == 0
+    with BamReader(grouped) as r:
+        mi_sizes = {}
+        for rec in r:
+            if rec.flag & FLAG_FIRST:
+                mi = rec.get_str(b"MI")
+                mi_sizes[mi] = mi_sizes.get(mi, 0) + 1
+    with BamReader(cons) as r:
+        recs = list(r)
+    assert len(recs) == 2 * len(mi_sizes)  # R1+R2 per molecule
+    for rec in recs:
+        assert rec.get_int(b"cD") == mi_sizes[rec.get_str(b"MI")]
+        assert rec.get_str(b"RX") is not None  # consensus RX propagated from inputs
+
+
+def test_group_replaces_existing_mi_tag(mapped_bam, tmp_path):
+    """Re-running group must replace the MI tag, not append a duplicate."""
+    g1 = str(tmp_path / "r1.bam")
+    g2 = str(tmp_path / "r2.bam")
+    cli_main(["group", "-i", mapped_bam, "-o", g1])
+    cli_main(["group", "-i", g1, "-o", g2, "--strategy", "identity"])
+    with BamReader(g2) as r:
+        for rec in r:
+            aux = rec.aux_bytes()
+            assert aux.count(b"MIZ") == 1, rec.name
+
+
+def test_group_rejects_coordinate_sorted_even_with_allow_unmapped(tmp_path):
+    """--allow-unmapped still requires query grouping (classify_input_ordering)."""
+    from fgumi_tpu.io.bam import BamHeader, BamWriter
+    path = str(tmp_path / "coord.bam")
+    hdr = BamHeader(text="@HD\tVN:1.6\tSO:coordinate\n@SQ\tSN:c\tLN:1000\n",
+                    ref_names=["c"], ref_lengths=[1000])
+    with BamWriter(path, hdr):
+        pass
+    out = str(tmp_path / "x.bam")
+    assert cli_main(["group", "-i", path, "-o", out, "--allow-unmapped"]) == 2

@@ -1,0 +1,146 @@
+"""UMI assigner unit tests — semantics pinned against the reference
+(/root/reference/crates/fgumi-umi/src/assigner.rs test expectations)."""
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.umi.assigners import (AdjacencyUmiAssigner, IdentityUmiAssigner,
+                                     MoleculeId, PairedUmiAssigner,
+                                     SimpleErrorUmiAssigner, make_assigner,
+                                     pairwise_distances, _umi_matrix)
+
+
+def render(ids):
+    return [m.render() for m in ids]
+
+
+def test_molecule_id_render():
+    assert MoleculeId("S", 42).render() == "42"
+    assert MoleculeId("A", 42).render() == "42/A"
+    assert MoleculeId("B", 42).render() == "42/B"
+
+
+def test_identity():
+    a = IdentityUmiAssigner()
+    ids = a.assign(["ACGT", "acgt", "TTTT", "ACGT"])
+    assert ids[0] == ids[1] == ids[3]  # case-insensitive
+    assert ids[2] != ids[0]
+    # deterministic: IDs by sorted order -> ACGT gets 0, TTTT gets 1
+    assert ids[0].id == 0 and ids[2].id == 1
+
+
+def test_identity_keeps_n_umis_distinct():
+    a = IdentityUmiAssigner()
+    ids = a.assign(["ACGN", "ACGN", "ACGT"])
+    assert ids[0] == ids[1]
+    assert ids[0] != ids[2]
+
+
+def test_edit_transitive_clustering():
+    a = SimpleErrorUmiAssigner(1)
+    # AAAA ~ AAAT ~ AATT: chain within distance 1 merges transitively
+    ids = a.assign(["AAAA", "AAAT", "AATT", "GGGG"])
+    assert ids[0] == ids[1] == ids[2]
+    assert ids[3] != ids[0]
+
+
+def test_edit_invalid_umis_isolated():
+    a = SimpleErrorUmiAssigner(1)
+    ids = a.assign(["AAAA", "AAAN", "AAAN"])
+    # invalid UMI never joins a valid molecule, identical invalids share
+    assert ids[1] == ids[2]
+    assert ids[0] != ids[1]
+
+
+def test_adjacency_count_rule():
+    a = AdjacencyUmiAssigner(1)
+    # UMI-tools rule: child captured iff count <= parent/2 + 1
+    # AAAA x10; AAAT x5 (5 <= 6 -> child); GGGG x10, GGGT x7 (7 > 6 -> own root)
+    umis = ["AAAA"] * 10 + ["AAAT"] * 5 + ["GGGG"] * 10 + ["GGGT"] * 7
+    ids = a.assign(umis)
+    assert ids[0] == ids[10]  # AAAT joins AAAA
+    assert ids[15] != ids[25]  # GGGT does NOT join GGGG
+    assert len({m.id for m in ids}) == 3
+
+
+def test_adjacency_deterministic_ordering():
+    a1 = AdjacencyUmiAssigner(1)
+    a2 = AdjacencyUmiAssigner(1)
+    umis = ["CCCC", "AAAA", "CCCC", "AAAA", "AAAT"]
+    assert render(a1.assign(umis)) == render(a2.assign(list(umis)))
+    # equal counts tie-break by string: AAAA root before CCCC
+    ids = a1.assign(["CCCC", "CCCC", "AAAA", "AAAA"])
+    assert ids[2].id < ids[0].id
+
+
+def test_paired_strands():
+    a = PairedUmiAssigner(1)
+    ids = a.assign(["AAAA-CCCC", "CCCC-AAAA", "AAAA-CCCC"])
+    # A-B and B-A group into one molecule with opposite strands
+    assert ids[0].id == ids[1].id == ids[2].id
+    assert ids[0].kind != ids[1].kind
+    assert ids[0] == ids[2]
+    assert {ids[0].kind, ids[1].kind} == {"A", "B"}
+
+
+def test_paired_canonical_orientation():
+    a = PairedUmiAssigner(1)
+    # AAAA-CCCC: first < second so it IS canonical -> /A
+    ids = a.assign(["AAAA-CCCC", "CCCC-AAAA"])
+    assert ids[0].kind == "A" and ids[1].kind == "B"
+
+
+def test_paired_error_correction():
+    a = PairedUmiAssigner(1)
+    # one mismatch in first segment still groups, same strand as the root
+    ids = a.assign(["AAAA-CCCC"] * 5 + ["AATA-CCCC"] + ["CCCC-AAAA"] * 3)
+    assert ids[0].id == ids[5].id == ids[6].id
+    assert ids[5].kind == ids[0].kind
+    assert ids[6].kind != ids[0].kind
+
+
+def test_paired_rejects_malformed():
+    a = PairedUmiAssigner(1)
+    with pytest.raises(ValueError):
+        a.assign(["AAAACCCC"])
+    with pytest.raises(ValueError):
+        a.assign(["AA-AA-AA"])
+
+
+def test_uniform_length_guard():
+    with pytest.raises(ValueError):
+        SimpleErrorUmiAssigner(1).assign(["AAAA", "CCC"])
+
+
+def test_pairwise_distances_matches_bruteforce():
+    rng = np.random.default_rng(0)
+    umis = ["".join("ACGT"[c] for c in rng.integers(0, 4, size=10)) for _ in range(50)]
+    mat = _umi_matrix(umis)
+    d = pairwise_distances(mat)
+    for i in range(0, 50, 7):
+        for j in range(0, 50, 11):
+            expected = sum(x != y for x, y in zip(umis[i], umis[j]))
+            assert d[i, j] == expected
+
+
+def test_device_pairwise_path():
+    # force the device path via the module threshold
+    import fgumi_tpu.umi.assigners as A
+    rng = np.random.default_rng(1)
+    umis = ["".join("ACGT"[c] for c in rng.integers(0, 4, size=8)) for _ in range(64)]
+    mat = _umi_matrix(umis)
+    host = (mat[:, None, :] != mat[None, :, :]).sum(axis=2)
+    old = A.DEVICE_THRESHOLD
+    try:
+        A.DEVICE_THRESHOLD = 1
+        dev = pairwise_distances(mat)
+    finally:
+        A.DEVICE_THRESHOLD = old
+    np.testing.assert_array_equal(dev, host)
+
+
+def test_make_assigner():
+    for s in ("identity", "edit", "adjacency", "paired"):
+        assert make_assigner(s) is not None
+    with pytest.raises(ValueError):
+        make_assigner("bogus")

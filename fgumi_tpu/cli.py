@@ -228,6 +228,180 @@ def cmd_group(args):
     return 0
 
 
+def _add_sort(sub):
+    p = sub.add_parser("sort", help="Sort a BAM (coordinate/queryname/template-coordinate)")
+    p.add_argument("-i", "--input", required=True)
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("--order", default="template-coordinate",
+                   choices=["coordinate", "queryname", "template-coordinate"])
+    p.add_argument("--subsort", default="natural", choices=["natural", "lex"],
+                   help="queryname comparator")
+    p.add_argument("--max-records-in-ram", type=int, default=500_000)
+    p.add_argument("--tmp-dir", default=None)
+    p.set_defaults(func=cmd_sort)
+
+
+def _rewrite_hd(text, so, go, ss):
+    lines = text.splitlines()
+    fields = {"VN": "1.6"}
+    rest = []
+    for line in lines:
+        if line.startswith("@HD"):
+            fields.update(f.split(":", 1) for f in line.split("\t")[1:] if ":" in f)
+        else:
+            rest.append(line)
+    fields["SO"] = so
+    fields.pop("GO", None)
+    fields.pop("SS", None)
+    if go:
+        fields["GO"] = go
+    if ss:
+        fields["SS"] = ss
+    hd = "@HD\t" + "\t".join(f"{k}:{v}" for k, v in fields.items())
+    return "\n".join([hd] + rest) + "\n"
+
+
+def cmd_sort(args):
+    from .io.bam import BamHeader, BamReader, BamWriter
+    from .sort.external import ExternalSorter, header_tags_for_order, make_key_fn
+
+    t0 = time.monotonic()
+    with BamReader(args.input) as reader:
+        key_fn = make_key_fn(args.order, reader.header, args.subsort)
+        so, go, ss = header_tags_for_order(args.order, args.subsort)
+        out_header = BamHeader(
+            text=_rewrite_hd(reader.header.text, so, go, ss),
+            ref_names=reader.header.ref_names, ref_lengths=reader.header.ref_lengths)
+        with ExternalSorter(key_fn, max_records=args.max_records_in_ram,
+                            tmp_dir=args.tmp_dir) as sorter:
+            for rec in reader:
+                sorter.add(rec)
+            with BamWriter(args.output, out_header) as writer:
+                for data in sorter.sorted_records():
+                    writer.write_record_bytes(data)
+    dt = time.monotonic() - t0
+    log.info("sort: %d records (%s) in %.2fs (%.0f rec/s)", sorter.n_records,
+             args.order, dt, sorter.n_records / dt if dt else 0)
+    return 0
+
+
+def _add_merge(sub):
+    p = sub.add_parser("merge", help="Merge same-order sorted BAMs")
+    p.add_argument("-i", "--input", required=True, nargs="+")
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("--order", default="template-coordinate",
+                   choices=["coordinate", "queryname", "template-coordinate"])
+    p.add_argument("--subsort", default="natural", choices=["natural", "lex"])
+    p.set_defaults(func=cmd_merge)
+
+
+def cmd_merge(args):
+    from .io.bam import BamHeader, BamReader, BamWriter
+    from .sort.external import header_tags_for_order, make_key_fn, merge_sorted
+
+    from .core.template import _hd_fields
+
+    readers = [BamReader(path) for path in args.input]
+    try:
+        first = readers[0].header
+        so, go, ss = header_tags_for_order(args.order, args.subsort)
+        for path, r in zip(args.input, readers):
+            if (r.header.ref_names != first.ref_names
+                    or r.header.ref_lengths != first.ref_lengths):
+                log.error("merge: inputs have differing reference sequences")
+                return 2
+            hd = _hd_fields(r.header.text)
+            ok = (hd.get("SO") == so and (go is None or hd.get("GO") == go)
+                  and (ss is None or hd.get("SS") == ss))
+            if not ok:
+                log.error("merge: %s is not sorted by the requested order "
+                          "(--order %s needs SO:%s%s%s; header has %s)",
+                          path, args.order, so,
+                          f" GO:{go}" if go else "", f" SS:{ss}" if ss else "", hd)
+                return 2
+        # union the @RG/@PG/@CO lines across all inputs (first occurrence wins)
+        seen_lines = []
+        seen_set = set()
+        for r in readers:
+            for line in r.header.text.splitlines():
+                if line.startswith(("@RG", "@PG", "@CO")) and line not in seen_set:
+                    seen_set.add(line)
+                    seen_lines.append(line)
+        base_lines = [l for l in first.text.splitlines()
+                      if not l.startswith(("@RG", "@PG", "@CO"))]
+        merged_text = "\n".join(base_lines + seen_lines) + "\n"
+        key_fn = make_key_fn(args.order, first, args.subsort)
+        out_header = BamHeader(text=_rewrite_hd(merged_text, so, go, ss),
+                               ref_names=first.ref_names, ref_lengths=first.ref_lengths)
+        n = 0
+        with BamWriter(args.output, out_header) as writer:
+            for data in merge_sorted(readers, key_fn):
+                writer.write_record_bytes(data)
+                n += 1
+    finally:
+        for r in readers:
+            r.close()
+    log.info("merge: %d records from %d inputs", n, len(args.input))
+    return 0
+
+
+def _add_fastq(sub):
+    p = sub.add_parser("fastq", help="BAM -> mate-paired interleaved FASTQ")
+    p.add_argument("-i", "--input", required=True)
+    p.add_argument("-o", "--output", default="-", help="output FASTQ (- for stdout)")
+    p.set_defaults(func=cmd_fastq)
+
+
+def cmd_fastq(args):
+    from .constants import reverse_complement_bytes
+    from .io.bam import BamReader, FLAG_FIRST, FLAG_REVERSE, FLAG_SECONDARY, FLAG_SUPPLEMENTARY
+
+    from .io.bam import FLAG_LAST, FLAG_PAIRED
+
+    out = sys.stdout.buffer if args.output == "-" else open(args.output, "wb")
+    n = 0
+
+    def emit(rec):
+        nonlocal n
+        seq = rec.seq_bytes()
+        quals = rec.quals()
+        if rec.flag & FLAG_REVERSE:
+            seq = reverse_complement_bytes(seq)
+            quals = quals[::-1]
+        suffix = b"/1" if rec.flag & FLAG_FIRST else (
+            b"/2" if rec.flag & FLAG_LAST else b"")
+        out.write(b"@" + rec.name + suffix + b"\n" + seq + b"\n+\n"
+                  + (quals + 33).tobytes() + b"\n")
+        n += 1
+
+    # R1/R2 are interleaved adjacently by buffering each read until its mate
+    # arrives (mates may be far apart in coordinate-sorted input)
+    pending = {}
+    try:
+        with BamReader(args.input) as reader:
+            for rec in reader:
+                if rec.flag & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY):
+                    continue
+                if not rec.flag & FLAG_PAIRED:
+                    emit(rec)
+                    continue
+                mate = pending.pop(rec.name, None)
+                if mate is None:
+                    pending[rec.name] = rec
+                else:
+                    r1, r2 = (rec, mate) if rec.flag & FLAG_FIRST else (mate, rec)
+                    emit(r1)
+                    emit(r2)
+        for rec in pending.values():  # orphaned mates, in input order
+            emit(rec)
+    finally:
+        out.flush()
+        if out is not sys.stdout.buffer:
+            out.close()
+    log.info("fastq: wrote %d reads", n)
+    return 0
+
+
 def _add_simulate(sub):
     p = sub.add_parser("simulate", help="Generate synthetic test data")
     ps = p.add_subparsers(dest="sim_mode", required=True)
@@ -311,6 +485,9 @@ def main(argv=None):
     _add_simplex(sub)
     _add_duplex(sub)
     _add_group(sub)
+    _add_sort(sub)
+    _add_merge(sub)
+    _add_fastq(sub)
     _add_simulate(sub)
     args = parser.parse_args(argv)
     logging.basicConfig(

@@ -1,0 +1,290 @@
+"""External merge sort for BAM records.
+
+Analog of /root/reference/crates/fgumi-sort (RawExternalSorter, external.rs:1594):
+phase 1 accumulates records to a memory budget, sorts by extracted keys, spills
+compressed runs; phase 2 k-way merges the runs. Three orders (keys.rs:180-241):
+
+- coordinate: (tid, pos) with unmapped-last, SO:coordinate;
+- queryname: natural (digit runs compare numerically) or lexicographic name order
+  with R1-before-R2 within a template, SO:queryname;
+- template-coordinate: both template ends' unclipped 5' (earlier end first), strand
+  (reverse first), library, name, lower-end-record first — SO:unsorted GO:query
+  SS:unsorted:template-coordinate (TemplateKey, fgumi-sort/src/inline.rs:620-694).
+
+Spill runs use raw-deflate frames (zlib level 1), the Python analog of the zstd-1
+spill codec choice (codec.rs:7-8).
+"""
+
+import heapq
+import os
+import re
+import struct
+import tempfile
+import zlib
+
+from ..core.overlap import parse_soft_clips_and_ref_len
+from ..core.template import library_lookup_from_header, unclipped_5prime
+from ..io.bam import (FLAG_FIRST, FLAG_LAST, FLAG_MATE_REVERSE,
+                      FLAG_MATE_UNMAPPED, FLAG_PAIRED, FLAG_REVERSE,
+                      FLAG_SECONDARY, FLAG_SUPPLEMENTARY, FLAG_UNMAPPED, RawRecord)
+
+_DIGITS = re.compile(rb"(\d+)")
+
+
+def natural_name_key(name: bytes):
+    """Natural queryname ordering: digit runs compare numerically (keys.rs natural).
+
+    Elements are type-tagged (digit runs sort before text at the same position) so
+    mixed structures stay comparable."""
+    parts = _DIGITS.split(name)
+    return tuple((0, int(p), b"") if p.isdigit() else (1, 0, p)
+                 for p in parts if p != b"")
+
+
+def _within_name_rank(flag: int) -> tuple:
+    """Sub-order records of one template: primaries first, R1 before R2."""
+    return (
+        bool(flag & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY)),
+        0 if not flag & FLAG_PAIRED else (1 if flag & FLAG_FIRST else 2),
+        flag,
+    )
+
+
+def coordinate_key(rec: RawRecord):
+    """samtools coordinate order: mapped by (tid, pos), unmapped (tid<0) last."""
+    tid = rec.ref_id
+    return (tid < 0, tid, rec.pos)
+
+
+def queryname_key(rec: RawRecord, lexicographic: bool = False):
+    name = rec.name
+    return ((name if lexicographic else natural_name_key(name)),
+            _within_name_rank(rec.flag))
+
+
+_UNMAPPED_SENTINEL = (0xFFFF, 0x7FFFFFFF, False)
+
+
+def _mate_end_info(rec: RawRecord):
+    """Mate's (tid, unclipped 5' pos, reverse) from next_* fields + MC tag."""
+    if not rec.flag & FLAG_PAIRED or rec.flag & FLAG_MATE_UNMAPPED:
+        return _UNMAPPED_SENTINEL
+    mate_rev = bool(rec.flag & FLAG_MATE_REVERSE)
+    mate_pos = rec.next_pos + 1  # 1-based
+    mc = rec.get_str(b"MC")
+    leading = ref_len = trailing = 0
+    if mc is not None:
+        parsed = parse_soft_clips_and_ref_len(mc)
+        if parsed is not None:
+            leading, ref_len, trailing = parsed
+    if mate_rev:
+        pos = mate_pos - 1 + max(ref_len, 1) - 1 + trailing + 1  # unclipped end, 1-based
+    else:
+        pos = mate_pos - leading
+    return (rec.next_ref_id, pos, mate_rev)
+
+
+def template_coordinate_key(rec: RawRecord, library_ord: int, mi: tuple):
+    """TemplateKey analog (inline.rs:620-694): earlier end first; reverse strand
+    sorts before forward; the record at the lower end sorts before its mate."""
+    flag = rec.flag
+    if flag & FLAG_UNMAPPED:
+        own = _UNMAPPED_SENTINEL
+    else:
+        own = (rec.ref_id, unclipped_5prime(rec) + 1, bool(flag & FLAG_REVERSE))
+    mate = _mate_end_info(rec)
+    if own <= mate:
+        lo, hi, is_upper = own, mate, False
+    else:
+        lo, hi, is_upper = mate, own, True
+    tid1, pos1, neg1 = lo
+    tid2, pos2, neg2 = hi
+    # reverse sorts before forward (inverted flags, inline.rs:679-681)
+    return (tid1, tid2, pos1, pos2, not neg1, not neg2, library_ord, mi,
+            rec.name, is_upper)
+
+
+class SortContext:
+    """Header-derived context for key extraction."""
+
+    def __init__(self, header):
+        lookup = library_lookup_from_header(header.text)
+        libs = sorted(set(lookup.values()) | {"unknown"})
+        self._lib_ord = {lib: i for i, lib in enumerate(libs)}
+        self._rg_to_ord = {rg: self._lib_ord[lib] for rg, lib in lookup.items()}
+
+    def library_ordinal(self, rec: RawRecord) -> int:
+        rg = rec.get_str(b"RG")
+        return self._rg_to_ord.get(rg, self._lib_ord["unknown"])
+
+
+def _mi_key(rec: RawRecord) -> tuple:
+    mi = rec.get_str(b"MI")
+    if mi is None:
+        return (0, 0)
+    base, _, suffix = mi.partition("/")
+    try:
+        value = int(base)
+    except ValueError:
+        value = 0
+    return (value, 0 if suffix == "A" else 1)
+
+
+def make_key_fn(order: str, header, subsort: str = "natural"):
+    """Key function for one of coordinate|queryname|template-coordinate."""
+    if order == "coordinate":
+        return coordinate_key
+    if order == "queryname":
+        lex = subsort == "lex"
+        return lambda rec: queryname_key(rec, lexicographic=lex)
+    if order == "template-coordinate":
+        ctx = SortContext(header)
+        return lambda rec: template_coordinate_key(rec, ctx.library_ordinal(rec),
+                                                   _mi_key(rec))
+    raise ValueError(f"unknown sort order: {order}")
+
+
+def header_tags_for_order(order: str, subsort: str = "natural"):
+    """(SO, GO, SS) header values (keys.rs:205-241)."""
+    if order == "coordinate":
+        return "coordinate", None, None
+    if order == "queryname":
+        # SAM-spec sub-sort keywords: "natural" / "lexicographical" (keys.rs SORT3-10)
+        spelled = "lexicographical" if subsort == "lex" else subsort
+        return "queryname", None, f"queryname:{spelled}"
+    return "unsorted", "query", "unsorted:template-coordinate"
+
+
+# Target uncompressed bytes per spill frame: bounds merge-phase memory to
+# O(runs * frame size) instead of O(total), mirroring the reference's block-framed
+# spill streams (zspill_stream.rs).
+_FRAME_BYTES = 4 << 20
+
+
+class _SpillRun:
+    """One sorted run on disk: pickled (key, ordinal, record) frames, deflated.
+
+    Keys are persisted with the records so the merge phase never re-extracts them
+    (the reference serializes keys into spill runs for the same reason, keys.rs:57).
+    """
+
+    def __init__(self, tmp_dir):
+        fd, self.path = tempfile.mkstemp(dir=tmp_dir, suffix=".run")
+        self._f = os.fdopen(fd, "wb")
+
+    def write(self, entries):
+        import pickle
+
+        frame = []
+        frame_bytes = 0
+        for entry in entries:
+            frame.append(entry)
+            frame_bytes += len(entry[2]) + 64
+            if frame_bytes >= _FRAME_BYTES:
+                self._write_frame(frame, pickle)
+                frame = []
+                frame_bytes = 0
+        if frame:
+            self._write_frame(frame, pickle)
+        self._f.close()
+
+    def _write_frame(self, frame, pickle):
+        payload = zlib.compress(pickle.dumps(frame, protocol=4), 1)
+        self._f.write(struct.pack("<I", len(payload)))
+        self._f.write(payload)
+
+    def __iter__(self):
+        import pickle
+
+        with open(self.path, "rb") as f:
+            while True:
+                size_b = f.read(4)
+                if len(size_b) < 4:
+                    break
+                (size,) = struct.unpack("<I", size_b)
+                yield from pickle.loads(zlib.decompress(f.read(size)))
+
+    def unlink(self):
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class ExternalSorter:
+    """Accumulate -> sort -> spill -> k-way merge (RawExternalSorter analog).
+
+    Use as a context manager (or call close()) to guarantee spill cleanup; the
+    temp directory is created lazily on first spill.
+    """
+
+    def __init__(self, key_fn, max_records: int = 500_000, tmp_dir=None):
+        self.key_fn = key_fn
+        self.max_records = max_records
+        self._tmp_dir_arg = tmp_dir
+        self._tmp_dir = None
+        self._own_tmp_dir = False
+        self._chunk = []
+        self._runs = []
+        self.n_records = 0
+
+    def add(self, rec: RawRecord):
+        self._chunk.append((self.key_fn(rec), self.n_records, rec.data))
+        self.n_records += 1
+        if len(self._chunk) >= self.max_records:
+            self._spill()
+
+    def _spill(self):
+        if self._tmp_dir is None:
+            if self._tmp_dir_arg is not None:
+                self._tmp_dir = self._tmp_dir_arg
+            else:
+                self._tmp_dir = tempfile.mkdtemp(prefix="fgumi_sort_")
+                self._own_tmp_dir = True
+        self._chunk.sort(key=lambda t: (t[0], t[1]))
+        run = _SpillRun(self._tmp_dir)
+        run.write(iter(self._chunk))
+        self._runs.append(run)
+        self._chunk = []
+
+    def sorted_records(self):
+        """Yield record bytes in sorted order."""
+        if not self._runs:
+            # in-memory fast path (external.rs single-chunk analog)
+            self._chunk.sort(key=lambda t: (t[0], t[1]))
+            for _, _, data in self._chunk:
+                yield data
+            self._chunk = []
+            return
+        self._spill()
+        # global ingest ordinals make (key, ordinal) a total order, so the merged
+        # stream is identical to what a single in-memory sort would produce
+        for _, _, data in heapq.merge(*self._runs):
+            yield data
+
+    def close(self):
+        for run in self._runs:
+            run.unlink()
+        self._runs = []
+        if self._own_tmp_dir and self._tmp_dir is not None:
+            try:
+                os.rmdir(self._tmp_dir)
+            except OSError:
+                pass
+            self._tmp_dir = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def merge_sorted(readers, key_fn):
+    """K-way merge of already-sorted record streams (fgumi merge, merge.rs:1-8)."""
+    streams = (
+        ((key_fn(rec), idx, rec.data) for rec in reader)
+        for idx, reader in enumerate(readers)
+    )
+    for _, _, data in heapq.merge(*streams):
+        yield data

@@ -1,0 +1,189 @@
+"""sort / merge / fastq command tests."""
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.cli import main as cli_main
+from fgumi_tpu.io.bam import BamHeader, BamReader, BamWriter
+from fgumi_tpu.sort.external import (ExternalSorter, coordinate_key,
+                                     make_key_fn, natural_name_key)
+
+
+def test_natural_name_key():
+    names = [b"r10", b"r2", b"r1", b"r2a", b"q5"]
+    ordered = sorted(names, key=natural_name_key)
+    assert ordered == [b"q5", b"r1", b"r2", b"r2a", b"r10"]
+
+
+def make_shuffled(tmp_path, seed=0, num_families=20):
+    sim = str(tmp_path / "m.bam")
+    cli_main(["simulate", "mapped-reads", "-o", sim, "--num-families",
+              str(num_families), "--family-size", "3", "--seed", str(seed)])
+    with BamReader(sim) as r:
+        hdr, recs = r.header, [x.data for x in r]
+    rng = np.random.default_rng(seed)
+    hdr2 = BamHeader(
+        text="@HD\tVN:1.6\tSO:unsorted\n" + "\n".join(
+            l for l in hdr.text.splitlines() if not l.startswith("@HD")) + "\n",
+        ref_names=hdr.ref_names, ref_lengths=hdr.ref_lengths)
+    shuf = str(tmp_path / "shuf.bam")
+    with BamWriter(shuf, hdr2) as w:
+        for i in rng.permutation(len(recs)):
+            w.write_record_bytes(recs[i])
+    return sim, shuf, len(recs)
+
+
+def test_sort_template_coordinate_restores_grouping(tmp_path):
+    sim, shuf, n = make_shuffled(tmp_path)
+    out = str(tmp_path / "s.bam")
+    # tiny in-RAM budget to force the external spill/merge path
+    assert cli_main(["sort", "-i", shuf, "-o", out,
+                     "--order", "template-coordinate",
+                     "--max-records-in-ram", "32"]) == 0
+    with BamReader(out) as r:
+        hdr = r.header.text
+        recs = list(r)
+    assert "SS:unsorted:template-coordinate" in hdr and "GO:query" in hdr
+    assert len(recs) == n
+    # same-name records adjacent, and each family's templates contiguous
+    seen_names = set()
+    prev = None
+    for rec in recs:
+        name = rec.name
+        if name != prev:
+            assert name not in seen_names, f"{name} not adjacent"
+            seen_names.add(name)
+            prev = name
+    fams_seen = set()
+    prev_fam = None
+    for rec in recs:
+        fam = rec.name.decode().split(":")[0]
+        if fam != prev_fam:
+            assert fam not in fams_seen, f"family {fam} fragmented"
+            fams_seen.add(fam)
+            prev_fam = fam
+
+
+def test_sort_then_group_equals_direct(tmp_path):
+    """sort(shuffled) -> group must equal group on the originally-ordered input."""
+    sim, shuf, _ = make_shuffled(tmp_path, seed=4)
+    sorted_bam = str(tmp_path / "sorted.bam")
+    cli_main(["sort", "-i", shuf, "-o", sorted_bam, "--order", "template-coordinate"])
+    g1, g2 = str(tmp_path / "g1.bam"), str(tmp_path / "g2.bam")
+    assert cli_main(["group", "-i", sorted_bam, "-o", g1]) == 0
+    assert cli_main(["group", "-i", sim, "-o", g2]) == 0
+    def families(path):
+        fams = {}
+        with BamReader(path) as r:
+            for rec in r:
+                fams.setdefault(rec.get_str(b"MI"), set()).add(rec.name)
+        return sorted(map(tuple, (sorted(v) for v in fams.values())))
+    assert families(g1) == families(g2)
+
+
+def test_sort_coordinate(tmp_path):
+    _, shuf, n = make_shuffled(tmp_path, seed=2)
+    out = str(tmp_path / "c.bam")
+    assert cli_main(["sort", "-i", shuf, "-o", out, "--order", "coordinate"]) == 0
+    with BamReader(out) as r:
+        assert "SO:coordinate" in r.header.text
+        keys = [coordinate_key(rec) for rec in r]
+    assert keys == sorted(keys)
+    assert len(keys) == n
+
+
+def test_sort_queryname(tmp_path):
+    _, shuf, n = make_shuffled(tmp_path, seed=3)
+    out = str(tmp_path / "q.bam")
+    assert cli_main(["sort", "-i", shuf, "-o", out, "--order", "queryname"]) == 0
+    with BamReader(out) as r:
+        assert "SO:queryname" in r.header.text
+        names = [rec.name for rec in r]
+    assert names == sorted(names, key=natural_name_key)
+
+
+def test_merge_two_sorted(tmp_path):
+    _, shuf, n = make_shuffled(tmp_path, seed=5)
+    a, b = str(tmp_path / "a.bam"), str(tmp_path / "b.bam")
+    cli_main(["sort", "-i", shuf, "-o", a, "--order", "coordinate"])
+    _, shuf2, n2 = make_shuffled(tmp_path, seed=6)
+    cli_main(["sort", "-i", shuf2, "-o", b, "--order", "coordinate"])
+    out = str(tmp_path / "merged.bam")
+    assert cli_main(["merge", "-i", a, b, "-o", out, "--order", "coordinate"]) == 0
+    with BamReader(out) as r:
+        keys = [coordinate_key(rec) for rec in r]
+    assert len(keys) == n + n2
+    assert keys == sorted(keys)
+
+
+def test_sort_deterministic_with_spill(tmp_path):
+    _, shuf, _ = make_shuffled(tmp_path, seed=7)
+    o1, o2 = str(tmp_path / "d1.bam"), str(tmp_path / "d2.bam")
+    cli_main(["sort", "-i", shuf, "-o", o1, "--max-records-in-ram", "16"])
+    cli_main(["sort", "-i", shuf, "-o", o2, "--max-records-in-ram", "100000"])
+    with BamReader(o1) as r1, BamReader(o2) as r2:
+        assert [r.data for r in r1] == [r.data for r in r2]
+
+
+def test_fastq_output(tmp_path):
+    sim, _, n = make_shuffled(tmp_path, seed=8)
+    fq = str(tmp_path / "out.fq")
+    assert cli_main(["fastq", "-i", sim, "-o", fq]) == 0
+    lines = open(fq, "rb").read().split(b"\n")
+    assert len([l for l in lines if l.startswith(b"@")]) >= n // 2
+    # reverse reads are emitted in original orientation
+    with BamReader(sim) as r:
+        rec = next(x for x in r if x.flag & 0x10)
+    from fgumi_tpu.constants import reverse_complement_bytes
+    expected = reverse_complement_bytes(rec.seq_bytes())
+    idx = lines.index(b"@" + rec.name + b"/2")
+    assert lines[idx + 1] == expected
+
+
+def test_merge_unions_read_groups(tmp_path):
+    from fgumi_tpu.io.bam import BamHeader, BamWriter, RecordBuilder
+    import struct as _s
+    def make(path, rg):
+        hdr = BamHeader(
+            text=f"@HD\tVN:1.6\tSO:coordinate\n@SQ\tSN:c\tLN:1000\n@RG\tID:{rg}\tLB:l{rg}\n",
+            ref_names=["c"], ref_lengths=[1000])
+        with BamWriter(path, hdr):
+            pass
+    a, b = str(tmp_path / "ra.bam"), str(tmp_path / "rb.bam")
+    make(a, "A"); make(b, "B")
+    out = str(tmp_path / "u.bam")
+    assert cli_main(["merge", "-i", a, b, "-o", out, "--order", "coordinate"]) == 0
+    with BamReader(out) as r:
+        assert "ID:A" in r.header.text and "ID:B" in r.header.text
+
+
+def test_merge_rejects_wrong_order_header(tmp_path):
+    _, shuf, _ = make_shuffled(tmp_path, seed=9)
+    a = str(tmp_path / "qn.bam")
+    cli_main(["sort", "-i", shuf, "-o", a, "--order", "queryname"])
+    out = str(tmp_path / "no.bam")
+    assert cli_main(["merge", "-i", a, a, "-o", out, "--order", "coordinate"]) == 2
+
+
+def test_fastq_interleaves_mates(tmp_path):
+    _, shuf, _ = make_shuffled(tmp_path, seed=10)
+    coord = str(tmp_path / "coord.bam")
+    cli_main(["sort", "-i", shuf, "-o", coord, "--order", "coordinate"])
+    fq = str(tmp_path / "il.fq")
+    cli_main(["fastq", "-i", coord, "-o", fq])
+    lines = open(fq, "rb").read().split(b"\n")
+    headers = [l for l in lines if l.startswith(b"@")]
+    # every /1 is immediately followed by its /2 despite coordinate disorder
+    for i in range(0, len(headers) - 1, 2):
+        assert headers[i].endswith(b"/1") and headers[i + 1].endswith(b"/2")
+        assert headers[i][:-2] == headers[i + 1][:-2]
+
+
+def test_sort_cleans_up_spill_dir(tmp_path):
+    import glob, tempfile as _tf
+    _, shuf, _ = make_shuffled(tmp_path, seed=12)
+    before = set(glob.glob(_tf.gettempdir() + "/fgumi_sort_*"))
+    out = str(tmp_path / "cl.bam")
+    cli_main(["sort", "-i", shuf, "-o", out, "--max-records-in-ram", "16"])
+    after = set(glob.glob(_tf.gettempdir() + "/fgumi_sort_*"))
+    assert after == before

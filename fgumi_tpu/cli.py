@@ -402,6 +402,64 @@ def cmd_fastq(args):
     return 0
 
 
+def _add_extract(sub):
+    p = sub.add_parser("extract", help="Extract UMIs from FASTQ into unmapped BAM")
+    p.add_argument("-i", "--input", required=True, nargs="+",
+                   help="FASTQ file per sequencing read (R1 [R2 I1 I2 ...])")
+    p.add_argument("-o", "--output", required=True, help="output unmapped BAM")
+    p.add_argument("-r", "--read-structures", nargs="*", default=[],
+                   help="one per FASTQ, e.g. 8M12S+T (default +T for 1-2 inputs)")
+    p.add_argument("-q", "--store-umi-quals", action="store_true")
+    p.add_argument("-C", "--store-cell-quals", action="store_true")
+    p.add_argument("-Q", "--store-sample-barcode-qualities", action="store_true")
+    p.add_argument("-n", "--extract-umis-from-read-names", action="store_true")
+    p.add_argument("-a", "--annotate-read-names", action="store_true")
+    p.add_argument("-s", "--single-tag", default=None)
+    p.add_argument("--read-group-id", default="A")
+    p.add_argument("--sample", required=True)
+    p.add_argument("--library", required=True)
+    p.add_argument("-b", "--barcode", default=None)
+    p.add_argument("--platform", default="illumina")
+    p.add_argument("--platform-unit", default=None)
+    p.add_argument("--platform-model", default=None)
+    p.add_argument("--sequencing-center", default=None)
+    p.add_argument("--predicted-insert-size", type=int, default=None)
+    p.add_argument("--description", default=None)
+    p.add_argument("--run-date", default=None)
+    p.add_argument("--comment", nargs="*", default=[])
+    p.set_defaults(func=cmd_extract)
+
+
+def cmd_extract(args):
+    from .commands.extract import ExtractError, ExtractOptions, run_extract
+
+    opts = ExtractOptions(
+        read_structures=args.read_structures, sample=args.sample,
+        library=args.library, read_group_id=args.read_group_id,
+        store_umi_quals=args.store_umi_quals,
+        store_cell_quals=args.store_cell_quals,
+        store_sample_barcode_quals=args.store_sample_barcode_qualities,
+        extract_umis_from_read_names=args.extract_umis_from_read_names,
+        annotate_read_names=args.annotate_read_names,
+        single_tag=args.single_tag, barcode=args.barcode,
+        platform=args.platform, platform_unit=args.platform_unit,
+        platform_model=args.platform_model,
+        sequencing_center=args.sequencing_center,
+        predicted_insert_size=args.predicted_insert_size,
+        description=args.description, run_date=args.run_date,
+        comments=args.comment, command_line=" ".join(sys.argv))
+    t0 = time.monotonic()
+    try:
+        n_records, n_sets = run_extract(args.input, args.output, opts)
+    except (ValueError, OSError) as e:  # ExtractError, ReadStructureError, bad I/O
+        log.error("%s", e)
+        return 2
+    dt = time.monotonic() - t0
+    log.info("extract: %d read sets -> %d records in %.2fs (%.0f reads/s)",
+             n_sets, n_records, dt, n_records / dt if dt else 0)
+    return 0
+
+
 def _add_simulate(sub):
     p = sub.add_parser("simulate", help="Generate synthetic test data")
     ps = p.add_subparsers(dest="sim_mode", required=True)
@@ -482,6 +540,7 @@ def main(argv=None):
     )
     parser.add_argument("-v", "--verbose", action="store_true")
     sub = parser.add_subparsers(dest="command", required=True)
+    _add_extract(sub)
     _add_simplex(sub)
     _add_duplex(sub)
     _add_group(sub)

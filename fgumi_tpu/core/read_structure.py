@@ -1,0 +1,142 @@
+"""fgbio-style read structures (e.g. ``8M12S+T``).
+
+Behavioral contract mirrors the reference's local implementation
+(/root/reference/src/lib/read_structure.rs:1-21, itself matching fgbio 4.1.0
+``ReadStructure``):
+
+- Segment kinds: T (template), B (sample barcode), M (molecular barcode),
+  C (cell barcode), S (skip).
+- At most one segment may be the any-length ``+`` segment, and it may sit at any
+  index. Segments strictly after the ``+`` are resolved by walking back from the
+  read end; the ``+`` absorbs ``read_len - fixed_length_sum`` bases
+  (**zero-or-more**).
+- A fully-fixed structure must match the read length exactly; an over-long read
+  is an error rather than a silent truncation (read_structure.rs:63-81).
+"""
+
+from dataclasses import dataclass
+
+SEGMENT_TYPES = frozenset("TBMCS")
+
+TEMPLATE = "T"
+SAMPLE_BARCODE = "B"
+MOLECULAR_BARCODE = "M"
+CELL_BARCODE = "C"
+SKIP = "S"
+
+
+class ReadStructureError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class ReadSegment:
+    kind: str
+    length: int | None  # None == the any-length '+' segment
+
+    def __str__(self):
+        return ("+" if self.length is None else str(self.length)) + self.kind
+
+
+class ReadStructure:
+    """An ordered list of ReadSegments with at most one any-length segment."""
+
+    def __init__(self, segments, rendered=None):
+        if not segments:
+            raise ReadStructureError("Read structure contained no segments")
+        rendered = rendered or "".join(str(s) for s in segments)
+        plus = [i for i, s in enumerate(segments) if s.length is None]
+        if len(plus) > 1:
+            raise ReadStructureError(
+                f"Read structure contains more than one any-length (+) segment: {rendered}")
+        self.segments = list(segments)
+        self.plus_index = plus[0] if plus else None
+        self.fixed_length_sum = sum(s.length or 0 for s in segments)
+        # Bases occupied by fixed segments strictly after the '+'.
+        self.post_plus_len = (
+            sum(s.length or 0 for s in segments[self.plus_index + 1:])
+            if self.plus_index is not None else 0)
+        # Forward offsets up to and including the '+' (or all segments);
+        # distance-from-end offsets for segments strictly after the '+'.
+        n = len(segments)
+        self._offsets = [("start", 0)] * n
+        forward_end = n if self.plus_index is None else self.plus_index + 1
+        off = 0
+        for i in range(forward_end):
+            self._offsets[i] = ("start", off)
+            off += segments[i].length or 0
+        if self.plus_index is not None:
+            dist = 0
+            for i in range(n - 1, self.plus_index, -1):
+                dist += segments[i].length or 0
+                self._offsets[i] = ("end", dist)
+
+    @classmethod
+    def parse(cls, rs: str) -> "ReadStructure":
+        chars = "".join(rs.upper().split())
+        segments = []
+        i = 0
+        n = len(chars)
+        while i < n:
+            if chars[i] == "+":
+                length = None
+                i += 1
+            elif chars[i].isdigit():
+                j = i
+                while j < n and chars[j].isdigit():
+                    j += 1
+                length = int(chars[i:j])
+                i = j
+            else:
+                raise ReadStructureError(
+                    f"Read structure is missing a length before an operator: {chars}")
+            if i >= n:
+                raise ReadStructureError(
+                    f"Read structure is missing a segment operator: {chars}")
+            kind = chars[i]
+            if kind not in SEGMENT_TYPES:
+                raise ReadStructureError(
+                    f"Read structure contains an unknown segment type: {chars}")
+            if length == 0:
+                raise ReadStructureError(
+                    f"Read structure contains a zero-length segment: {chars}")
+            i += 1
+            segments.append(ReadSegment(kind, length))
+        return cls(segments, chars)
+
+    def __str__(self):
+        return "".join(str(s) for s in self.segments)
+
+    def __len__(self):
+        return len(self.segments)
+
+    @property
+    def has_fixed_length(self) -> bool:
+        return self.plus_index is None
+
+    def span_of(self, index: int, read_len: int):
+        """[start, end) span of segment `index` in a read of `read_len` bases."""
+        anchor, v = self._offsets[index]
+        start = v if anchor == "start" else read_len - v
+        if self.plus_index == index:
+            return (start, read_len - self.post_plus_len)
+        return (start, start + self.segments[index].length)
+
+    def check_read_length(self, read_len: int):
+        """Returns None if acceptable, else an error message (fgbio validateReadLength)."""
+        if read_len < self.fixed_length_sum:
+            return (f"read is {read_len}bp but the read structure {self} requires "
+                    f"at least {self.fixed_length_sum}bp")
+        if self.has_fixed_length and read_len > self.fixed_length_sum:
+            return (f"read is {read_len}bp but the fully-fixed read structure {self} "
+                    f"requires exactly {self.fixed_length_sum}bp")
+        return None
+
+    def extract(self, seq: bytes, quals: bytes):
+        """Split a read into per-segment (kind, seq, quals) triples, in order."""
+        read_len = len(seq)
+        out = []
+        for i, seg in enumerate(self.segments):
+            start, end = self.span_of(i, read_len)
+            out.append((seg.kind, seq[start:end], quals[start:end]))
+        return out

@@ -1,0 +1,123 @@
+"""FASTQ input: plain, gzip, or BGZF, auto-detected by magic bytes.
+
+Mirrors the reference's FASTQ front-end behavior (detect_compression_format,
+/root/reference/src/lib/commands/extract.rs:96-150; record shape
+/root/reference/src/lib/fastq_parse.rs). The reference lexes newline boundaries
+with SIMD bitmasks (crates/fgumi-simd-fastq); here boundary finding is delegated
+to C-speed bulk ``bytes.split`` over large decompressed chunks, which serves the
+same purpose: never scan bytes one at a time in the interpreter.
+"""
+
+from dataclasses import dataclass
+
+from .bgzf import BgzfReader
+
+GZIP_MAGIC = b"\x1f\x8b"
+
+
+@dataclass
+class FastqRead:
+    """One FASTQ record. `name` is the header line without the leading '@'."""
+    name: bytes
+    seq: bytes
+    quals: bytes  # ASCII quality bytes as stored in the file (offset NOT removed)
+
+
+def _open_stream(path: str):
+    """Return a read(n)->bytes object for plain/gzip/bgzf FASTQ."""
+    f = open(path, "rb")
+    magic = f.read(2)
+    f.seek(0)
+    if magic == GZIP_MAGIC:
+        return BgzfReader(f, owns_fileobj=True)
+    return f
+
+
+class FastqReader:
+    """Iterates FastqRead over a (possibly compressed) FASTQ file.
+
+    Reads large chunks and splits on newlines in bulk; carries a partial last
+    line between chunks. Handles both \\n and \\r\\n line endings.
+    """
+
+    def __init__(self, path: str, chunk_size: int = 1 << 20):
+        self._path = path
+        self._stream = _open_stream(path)
+        self._chunk = chunk_size
+        self._lines = iter(())
+        self._tail = b""
+        self._done = False
+
+    def _next_line(self):
+        while True:
+            line = next(self._lines, None)
+            if line is not None:
+                return line
+            if self._done:
+                if self._tail:
+                    out, self._tail = self._tail, b""
+                    return out
+                return None
+            raw = self._stream.read(self._chunk)
+            if not raw:
+                self._done = True
+                continue
+            data = self._tail + raw
+            parts = data.split(b"\n")
+            self._tail = parts.pop()
+            self._lines = iter(parts)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> FastqRead:
+        header = self._next_line()
+        # skip blank trailing lines
+        while header is not None and not header.strip():
+            header = self._next_line()
+        if header is None:
+            raise StopIteration
+        seq = self._next_line()
+        plus = self._next_line()
+        quals = self._next_line()
+        if quals is None:
+            raise ValueError(f"{self._path}: truncated FASTQ record at {header!r}")
+        header = header.rstrip(b"\r")
+        seq = seq.rstrip(b"\r")
+        quals = quals.rstrip(b"\r")
+        if not header.startswith(b"@"):
+            raise ValueError(f"{self._path}: FASTQ header must start with '@': {header!r}")
+        if not plus.rstrip(b"\r").startswith(b"+"):
+            raise ValueError(f"{self._path}: FASTQ separator must start with '+': {plus!r}")
+        if len(seq) != len(quals):
+            raise ValueError(
+                f"{self._path}: sequence/quality length mismatch for {header!r} "
+                f"({len(seq)} vs {len(quals)})")
+        return FastqRead(header[1:], seq, quals)
+
+    def close(self):
+        close = getattr(self._stream, "close", None)
+        if close:
+            close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def strip_read_suffix(name: bytes) -> bytes:
+    """Strip a trailing space comment and an old-style ``/1``/``/2`` suffix.
+
+    Matches the reference's strip_read_suffix (src/lib/fastq_parse.rs usage at
+    extract.rs:787-790): only ``/`` followed by a single digit is removed, after
+    first truncating at the first space/tab.
+    """
+    for i, b in enumerate(name):
+        if b in (0x20, 0x09):
+            name = name[:i]
+            break
+    if len(name) >= 2 and name[-2] == ord("/") and name[-1] in b"0123456789":
+        name = name[:-2]
+    return name

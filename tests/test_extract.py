@@ -1,0 +1,280 @@
+"""extract command: read structures, UMI tags, quality detection.
+
+Covers the reference's extract semantics (/root/reference/src/lib/commands/
+extract.rs, read_structure.rs): span arithmetic incl. non-terminal '+',
+length validation, RX/QX joining, read-name UMIs, encoding detection.
+"""
+
+import gzip
+
+import pytest
+
+from fgumi_tpu.commands.extract import (
+    ExtractError, ExtractOptions, detect_quality_encoding,
+    extract_read_name_umi, normalize_read_name_umi, run_extract)
+from fgumi_tpu.core.read_structure import ReadStructure, ReadStructureError
+from fgumi_tpu.io.bam import BamReader
+from fgumi_tpu.io.fastq import FastqReader, strip_read_suffix
+
+
+# ---------- read structures ----------
+
+@pytest.mark.parametrize("s", ["5M+T", "+T", "10T", "8B8B75T", "8B+M10T",
+                               "+M70T", "2M1S2M+T"])
+def test_read_structure_round_trip(s):
+    assert str(ReadStructure.parse(s)) == s
+
+
+@pytest.mark.parametrize("bad", ["++M", "5M++T", "+M+T", "0T", "9R", "T",
+                                 "23T2", "8B+", ""])
+def test_read_structure_rejects_malformed(bad):
+    with pytest.raises(ReadStructureError):
+        ReadStructure.parse(bad)
+
+
+def test_non_terminal_plus_spans():
+    rs = ReadStructure.parse("8B+M10T")
+    assert rs.span_of(0, 30) == (0, 8)
+    assert rs.span_of(1, 30) == (8, 20)
+    assert rs.span_of(2, 30) == (20, 30)
+
+
+def test_terminal_plus_zero_or_more():
+    rs = ReadStructure.parse("4M+T")
+    assert rs.span_of(1, 10) == (4, 10)
+    assert rs.span_of(1, 4) == (4, 4)
+    assert rs.check_read_length(4) is None
+    assert rs.check_read_length(3) is not None
+
+
+def test_fixed_structure_rejects_overlong():
+    rs = ReadStructure.parse("8M2T")
+    assert rs.check_read_length(10) is None
+    assert rs.check_read_length(12) is not None
+    assert rs.check_read_length(8) is not None
+
+
+def test_extract_segments():
+    rs = ReadStructure.parse("3M2S+T")
+    segs = rs.extract(b"AAACCTTTTT", b"IIIIIJJJJJ")
+    assert segs == [("M", b"AAA", b"III"), ("S", b"CC", b"II"),
+                    ("T", b"TTTTT", b"JJJJJ")]
+
+
+# ---------- read-name UMIs ----------
+
+def test_strip_read_suffix():
+    assert strip_read_suffix(b"read1/1") == b"read1"
+    assert strip_read_suffix(b"read1 comment") == b"read1"
+    assert strip_read_suffix(b"read1/1 xx") == b"read1"
+    assert strip_read_suffix(b"read1/a") == b"read1/a"
+
+
+def test_normalize_read_name_umi():
+    assert normalize_read_name_umi(b"acgt") == b"ACGT"
+    assert normalize_read_name_umi(b"AAAA+CCCC") == b"AAAA-CCCC"
+    # r-prefix reverse-complements
+    assert normalize_read_name_umi(b"rAACG") == b"CGTT"
+    # only r-prefixed segments revcomp in dual UMIs
+    assert normalize_read_name_umi(b"rAACG+TTTT") == b"CGTT-TTTT"
+    with pytest.raises(ExtractError):
+        normalize_read_name_umi(b"ACXT")
+
+
+def test_extract_read_name_umi_requires_8_fields():
+    assert extract_read_name_umi(b"a:b:c:d:e:f:g:ACGT") == b"ACGT"
+    assert extract_read_name_umi(b"a:b:c:d:e:f:g:h:ACGT") == b"ACGT"
+    assert extract_read_name_umi(b"a:b:c:ACGT") is None
+
+
+# ---------- quality encoding detection ----------
+
+def _write_fastq(path, records, gz=False):
+    op = gzip.open if gz else open
+    with op(path, "wt") as f:
+        for name, seq, qual in records:
+            f.write(f"@{name}\n{seq}\n+\n{qual}\n")
+
+
+def test_detect_standard_encoding(tmp_path):
+    p = str(tmp_path / "a.fq")
+    _write_fastq(p, [("r1", "ACGT", "II#I")])
+    assert detect_quality_encoding([p]) == 33
+
+
+def test_detect_illumina_encoding(tmp_path):
+    p = str(tmp_path / "a.fq")
+    # min qual 'b'(98) >= 64, max >= 75
+    _write_fastq(p, [("r1", "ACGT", "bbgh")])
+    assert detect_quality_encoding([p]) == 64
+
+
+def test_detect_rejects_out_of_range(tmp_path):
+    p = str(tmp_path / "a.fq")
+    _write_fastq(p, [("r1", "ACGT", 'II"\x1f')])
+    with pytest.raises(ExtractError):
+        detect_quality_encoding([p])
+
+
+# ---------- end-to-end ----------
+
+def test_extract_paired_with_umi(tmp_path):
+    r1 = str(tmp_path / "r1.fq.gz")
+    r2 = str(tmp_path / "r2.fq")
+    out = str(tmp_path / "out.bam")
+    _write_fastq(r1, [("q1", "AAACCGGGTT", "IIIIIIIIII"),
+                      ("q2", "CCCCCGGGTT", "JJJJJJJJJJ")], gz=True)
+    _write_fastq(r2, [("q1", "TTTTGG", "IIIIII"),
+                      ("q2", "AAAAGG", "JJJJJJ")])
+    opts = ExtractOptions(read_structures=["4M+T", "+T"], sample="s",
+                          library="l", store_umi_quals=True)
+    n_records, n_sets = run_extract([r1, r2], out, opts)
+    assert (n_records, n_sets) == (4, 2)
+    with BamReader(out) as reader:
+        recs = list(reader)
+    assert len(recs) == 4
+    rec = recs[0]
+    assert rec.name == b"q1"
+    assert rec.flag & 0x1 and rec.flag & 0x4 and rec.flag & 0x40
+    assert rec.seq_bytes() == b"CGGGTT"  # template after 4M
+    assert rec.get_str(b"RX") == "AAAC"
+    assert rec.get_str(b"QX") == "IIII"
+    assert rec.get_str(b"RG") == "A"
+    r2rec = recs[1]
+    assert r2rec.flag & 0x80
+    assert r2rec.seq_bytes() == b"TTTTGG"
+    assert r2rec.get_str(b"RX") == "AAAC"  # UMI shared across pair
+    # header advertises unsorted query-grouped with RG
+    assert "SO:unsorted" in reader.header.text
+    assert "GO:query" in reader.header.text
+    assert "SM:s" in reader.header.text and "LB:l" in reader.header.text
+
+
+def test_extract_default_plus_t(tmp_path):
+    r1 = str(tmp_path / "r1.fq")
+    out = str(tmp_path / "out.bam")
+    _write_fastq(r1, [("q1", "ACGT", "IIII")])
+    n_records, _ = run_extract([r1], out, ExtractOptions(sample="s", library="l"))
+    assert n_records == 1
+    with BamReader(out) as reader:
+        (rec,) = list(reader)
+    assert rec.seq_bytes() == b"ACGT"
+    assert rec.flag == 0x4  # unmapped, unpaired
+    assert rec.find_tag(b"RX") is None
+
+
+def test_extract_read_name_umi_end_to_end(tmp_path):
+    r1 = str(tmp_path / "r1.fq")
+    out = str(tmp_path / "out.bam")
+    name = "inst:run:fc:1:2:3:4:rAACG+TTTT"
+    _write_fastq(r1, [(name, "ACGT", "IIII")])
+    opts = ExtractOptions(sample="s", library="l",
+                          extract_umis_from_read_names=True,
+                          annotate_read_names=True)
+    run_extract([r1], out, opts)
+    with BamReader(out) as reader:
+        (rec,) = list(reader)
+    assert rec.get_str(b"RX") == "CGTT-TTTT"
+    assert rec.name.endswith(b"+CGTT-TTTT")
+
+
+def test_extract_name_mismatch_fails(tmp_path):
+    r1 = str(tmp_path / "r1.fq")
+    r2 = str(tmp_path / "r2.fq")
+    _write_fastq(r1, [("q1", "ACGT", "IIII")])
+    _write_fastq(r2, [("qX", "ACGT", "IIII")])
+    with pytest.raises(ExtractError, match="do not match"):
+        run_extract([r1, r2], str(r1) + ".bam",
+                    ExtractOptions(sample="s", library="l"))
+
+
+def test_extract_length_validation(tmp_path):
+    r1 = str(tmp_path / "r1.fq")
+    _write_fastq(r1, [("q1", "ACG", "III")])
+    opts = ExtractOptions(read_structures=["8M+T"], sample="s", library="l")
+    with pytest.raises(ExtractError, match="at least 8"):
+        run_extract([r1], str(r1) + ".bam", opts)
+
+
+def test_extract_empty_template_is_single_n(tmp_path):
+    r1 = str(tmp_path / "r1.fq")
+    out = str(tmp_path / "out.bam")
+    _write_fastq(r1, [("q1", "ACGT", "IIII")])
+    opts = ExtractOptions(read_structures=["4M+T"], sample="s", library="l")
+    run_extract([r1], out, opts)
+    with BamReader(out) as reader:
+        (rec,) = list(reader)
+    assert rec.seq_bytes() == b"N"
+    assert list(rec.quals()) == [2]
+    assert rec.get_str(b"RX") == "ACGT"
+
+
+def test_extract_phred64_conversion(tmp_path):
+    r1 = str(tmp_path / "r1.fq")
+    out = str(tmp_path / "out.bam")
+    # Phred+64: 'h' = 104 -> Q40
+    _write_fastq(r1, [("q1", "ACGT", "hhhh")])
+    run_extract([r1], out, ExtractOptions(sample="s", library="l"))
+    with BamReader(out) as reader:
+        (rec,) = list(reader)
+    assert list(rec.quals()) == [40, 40, 40, 40]
+
+
+def test_single_tag_validation(tmp_path):
+    r1 = str(tmp_path / "r1.fq")
+    out = str(tmp_path / "out.bam")
+    _write_fastq(r1, [("q1", "AAAACCCC", "IIIIIIII")])
+    # reserved tags collide with extract's own output
+    with pytest.raises(ExtractError, match="already emits"):
+        run_extract([r1], out, ExtractOptions(read_structures=["4M+T"],
+                                              sample="s", library="l",
+                                              single_tag="RX"))
+    with pytest.raises(ExtractError, match="two-character"):
+        run_extract([r1], out, ExtractOptions(read_structures=["4M+T"],
+                                              sample="s", library="l",
+                                              single_tag="1X"))
+    run_extract([r1], out, ExtractOptions(read_structures=["4M+T"], sample="s",
+                                          library="l", single_tag="BX"))
+    with BamReader(out) as reader:
+        (rec,) = list(reader)
+    assert rec.get_str(b"BX") == "AAAA"
+
+
+def test_phred64_saturating_subtract(tmp_path):
+    r1 = str(tmp_path / "r1.fq")
+    out = str(tmp_path / "out.bam")
+    # 401 Phred+64 records so detection locks offset 64, then one with '#'(35)
+    recs = [(f"q{i}", "ACGT", "hhhh") for i in range(401)]
+    recs.append(("qlow", "ACGT", "#hhh"))
+    _write_fastq(r1, recs)
+    run_extract([r1], out, ExtractOptions(sample="s", library="l"))
+    with BamReader(out) as reader:
+        all_recs = list(reader)
+    assert list(all_recs[-1].quals()) == [0, 40, 40, 40]  # clamped to Q0
+
+
+def test_extract_cli_error_paths(tmp_path):
+    from fgumi_tpu.cli import main
+    r1 = str(tmp_path / "r1.fq")
+    _write_fastq(r1, [("q1", "ACGT", "IIII")])
+    out = str(tmp_path / "out.bam")
+    # bad read structure -> clean rc 2, not a traceback
+    assert main(["extract", "-i", r1, "-o", out, "-r", "BOGUS",
+                 "--sample", "s", "--library", "l"]) == 2
+    # missing input file -> clean rc 2
+    assert main(["extract", "-i", str(tmp_path / "nope.fq"), "-o", out,
+                 "--sample", "s", "--library", "l"]) == 2
+
+
+def test_extract_cli(tmp_path):
+    from fgumi_tpu.cli import main
+    r1 = str(tmp_path / "r1.fq")
+    out = str(tmp_path / "out.bam")
+    _write_fastq(r1, [("q1", "AAAACCCCGGGGTTTT", "IIIIIIIIIIIIIIII")])
+    rc = main(["extract", "-i", r1, "-o", out, "-r", "8M+T",
+               "--sample", "s", "--library", "l", "-q"])
+    assert rc == 0
+    with BamReader(out) as reader:
+        (rec,) = list(reader)
+    assert rec.get_str(b"RX") == "AAAACCCC"
+    assert rec.seq_bytes() == b"GGGGTTTT"

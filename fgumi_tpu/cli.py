@@ -460,6 +460,171 @@ def cmd_extract(args):
     return 0
 
 
+def _parse_bool(v):
+    if isinstance(v, bool):
+        return v
+    if v.lower() in ("true", "t", "yes", "1"):
+        return True
+    if v.lower() in ("false", "f", "no", "0"):
+        return False
+    raise argparse.ArgumentTypeError(f"expected true/false, got {v!r}")
+
+
+def _header_with_pg(header, command_line):
+    """Copy a header, appending an @PG record chained to the last one."""
+    from .io.bam import BamHeader
+
+    lines = header.text.splitlines()
+    pg_ids = set()
+    last_pg = None
+    for line in lines:
+        if line.startswith("@PG"):
+            fields = dict(f.split(":", 1) for f in line.split("\t")[1:] if ":" in f)
+            if "ID" in fields:
+                pg_ids.add(fields["ID"])
+                last_pg = fields["ID"]
+    pg_id = "fgumi-tpu"
+    n = 1
+    while pg_id in pg_ids:
+        pg_id = f"fgumi-tpu.{n}"
+        n += 1
+    pg = f"@PG\tID:{pg_id}\tPN:fgumi-tpu"
+    if last_pg is not None:
+        pg += f"\tPP:{last_pg}"
+    pg += f"\tCL:{command_line}"
+    return BamHeader(text="\n".join(lines + [pg]) + "\n",
+                     ref_names=header.ref_names, ref_lengths=header.ref_lengths)
+
+
+def _add_filter(sub):
+    p = sub.add_parser("filter", help="Filter and mask consensus reads")
+    p.add_argument("-i", "--input", required=True,
+                   help="consensus BAM (queryname sorted or query grouped)")
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("-M", "--min-reads", required=True,
+                   help="1-3 comma-separated values [duplex,AB,BA]")
+    p.add_argument("-E", "--max-read-error-rate", default="0.025",
+                   help="1-3 comma-separated values")
+    p.add_argument("-e", "--max-base-error-rate", default="0.1",
+                   help="1-3 comma-separated values")
+    p.add_argument("-N", "--min-base-quality", type=int, default=None)
+    p.add_argument("-q", "--min-mean-base-quality", type=float, default=None)
+    p.add_argument("-n", "--max-no-call-fraction", type=float, default=0.2,
+                   help="<1.0: fraction of read length; >=1.0: absolute count")
+    p.add_argument("-R", "--reverse-per-base-tags", nargs="?", const=True,
+                   default=False, type=_parse_bool)
+    p.add_argument("--filter-by-template", nargs="?", const=True,
+                   default=True, type=_parse_bool)
+    p.add_argument("-s", "--require-single-strand-agreement", nargs="?",
+                   const=True, default=False, type=_parse_bool)
+    p.add_argument("--rejects", default=None, help="BAM for rejected reads")
+    p.set_defaults(func=cmd_filter)
+
+
+def cmd_filter(args):
+    from .commands.filter import run_filter
+    from .consensus.filter import FilterConfig
+    from .io.bam import BamReader, BamWriter
+
+    try:
+        config = FilterConfig.new(
+            [int(v) for v in args.min_reads.split(",")],
+            [float(v) for v in args.max_read_error_rate.split(",")],
+            [float(v) for v in args.max_base_error_rate.split(",")],
+            min_base_quality=args.min_base_quality,
+            min_mean_base_quality=args.min_mean_base_quality,
+            max_no_call_fraction=args.max_no_call_fraction,
+            require_ss_agreement=args.require_single_strand_agreement)
+    except ValueError as e:
+        log.error("%s", e)
+        return 2
+    t0 = time.monotonic()
+    try:
+        with BamReader(args.input) as reader:
+            from .core.template import is_query_grouped
+            # Template filtering needs mates adjacent; coordinate-sorted input
+            # would silently corrupt the both-primaries-pass rule
+            # (filter.rs:343-349 require_query_grouped).
+            if not is_query_grouped(reader.header.text):
+                log.error(
+                    "filter requires queryname-sorted or query-grouped input "
+                    "(@HD must advertise SO:queryname or GO:query); run "
+                    "`fgumi-tpu sort --order queryname` first")
+                return 2
+            out_header = _header_with_pg(reader.header, " ".join(sys.argv))
+            rejects = (BamWriter(args.rejects, out_header)
+                       if args.rejects else None)
+            try:
+                with BamWriter(args.output, out_header) as writer:
+                    stats = run_filter(
+                        reader, writer, config,
+                        filter_by_template=args.filter_by_template,
+                        reverse_per_base=args.reverse_per_base_tags,
+                        rejects_writer=rejects)
+            finally:
+                if rejects is not None:
+                    rejects.close()
+    except (ValueError, OSError) as e:
+        log.error("%s", e)
+        return 2
+    dt = time.monotonic() - t0
+    log.info("filter: %d records -> kept %d, rejected %d, masked %d bases "
+             "in %.2fs", stats.total_records, stats.passed_records,
+             stats.failed_records, stats.bases_masked, dt)
+    if stats.rejection_reasons:
+        log.info("rejections: %s", dict(stats.rejection_reasons.most_common()))
+    return 0
+
+
+def _add_downsample(sub):
+    p = sub.add_parser("downsample", help="Downsample BAM by UMI family")
+    p.add_argument("-i", "--input", required=True,
+                   help="grouped BAM with MI tags (template-coordinate order)")
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("-f", "--fraction", type=float, required=True,
+                   help="fraction of UMI families to keep, in (0.0, 1.0]")
+    p.add_argument("--seed", type=int, default=None)
+    p.add_argument("--rejects", default=None)
+    p.add_argument("--validate-mi-order", nargs="?", const=True,
+                   default=True, type=_parse_bool)
+    p.add_argument("--histogram-kept", default=None)
+    p.add_argument("--histogram-rejected", default=None)
+    p.set_defaults(func=cmd_downsample)
+
+
+def cmd_downsample(args):
+    from .commands.downsample import run_downsample, write_histogram
+    from .io.bam import BamReader, BamWriter
+
+    t0 = time.monotonic()
+    try:
+        with BamReader(args.input) as reader:
+            out_header = _header_with_pg(reader.header, " ".join(sys.argv))
+            rejects = (BamWriter(args.rejects, out_header)
+                       if args.rejects else None)
+            try:
+                with BamWriter(args.output, out_header) as writer:
+                    stats = run_downsample(
+                        reader, writer, args.fraction, seed=args.seed,
+                        rejects_writer=rejects,
+                        validate_mi_order=args.validate_mi_order)
+            finally:
+                if rejects is not None:
+                    rejects.close()
+    except (ValueError, OSError) as e:
+        log.error("%s", e)
+        return 2
+    if args.histogram_kept:
+        write_histogram(stats.kept_sizes, args.histogram_kept)
+    if args.histogram_rejected:
+        write_histogram(stats.rejected_sizes, args.histogram_rejected)
+    dt = time.monotonic() - t0
+    log.info("downsample: kept %d/%d families (%d/%d records) in %.2fs",
+             stats.families_kept, stats.families_total, stats.records_kept,
+             stats.records_total, dt)
+    return 0
+
+
 def _add_simulate(sub):
     p = sub.add_parser("simulate", help="Generate synthetic test data")
     ps = p.add_subparsers(dest="sim_mode", required=True)
@@ -543,10 +708,12 @@ def main(argv=None):
     _add_extract(sub)
     _add_simplex(sub)
     _add_duplex(sub)
+    _add_filter(sub)
     _add_group(sub)
     _add_sort(sub)
     _add_merge(sub)
     _add_fastq(sub)
+    _add_downsample(sub)
     _add_simulate(sub)
     args = parser.parse_args(argv)
     logging.basicConfig(

@@ -1,0 +1,130 @@
+"""filter: mask and filter consensus reads by quality/depth/error thresholds.
+
+Command-level flow mirrors the reference (/root/reference/src/lib/commands/
+filter.rs): base-level masking (only when per-base tags are present) then
+read-level filtering; with --filter-by-template (default) all primary records
+of a QNAME must pass or the whole template is dropped, while secondary/
+supplementary records are filtered independently (filter.rs:60-75).
+
+NM/UQ/MD regeneration against a reference FASTA is not yet wired in; like the
+reference without --ref (filter.rs:777-785), filtering MAPPED reads therefore
+fails fast, since masking would leave stale NM/UQ/MD tags.
+"""
+
+from collections import Counter
+from dataclasses import dataclass, field
+
+from ..consensus.filter import (
+    EXCESSIVE_ERROR_RATE, FilterConfig, INSUFFICIENT_READS, LOW_QUALITY, PASS,
+    TOO_MANY_NO_CALLS, filter_duplex_read, filter_read, is_duplex_consensus,
+    mask_bases, mask_duplex_bases, mean_base_quality_full_length,
+    no_call_check, template_passes)
+from ..core.tag_reversal import reverse_per_base_tags
+from ..io.bam import (FLAG_SECONDARY, FLAG_SUPPLEMENTARY, FLAG_UNMAPPED,
+                      RawRecord)
+
+
+@dataclass
+class FilterStats:
+    total_records: int = 0
+    passed_records: int = 0
+    failed_records: int = 0
+    bases_masked: int = 0
+    rejection_reasons: Counter = field(default_factory=Counter)
+
+
+def _process_one(data: bytes, config: FilterConfig, reverse_tags: bool):
+    """Mask + judge one record. Returns (new_bytes, result_str, masked_count)."""
+    buf = bytearray(data)
+    # Fail fast on mapped reads: masking would invalidate NM/UQ/MD and there is
+    # no reference-based regeneration yet (filter.rs:774-785).
+    flag = int.from_bytes(buf[14:16], "little")
+    if not flag & FLAG_UNMAPPED:
+        raise ValueError(
+            "filtering mapped reads is not supported without NM/UQ/MD "
+            "regeneration; filter unmapped consensus BAMs (pre-alignment)")
+    if reverse_tags:
+        reverse_per_base_tags(buf)
+    rec = RawRecord(bytes(buf))
+    duplex = is_duplex_consensus(rec)
+
+    # Read-level thresholds on the pre-masking record.
+    if duplex:
+        result = filter_duplex_read(rec, config.cc, config.ab, config.ba)
+    else:
+        result = filter_read(rec, config.single_strand)
+
+    # Mean quality over the full read, prior to masking (filter.rs:668-678).
+    if result == PASS and config.min_mean_base_quality is not None:
+        if mean_base_quality_full_length(buf) < config.min_mean_base_quality:
+            result = LOW_QUALITY
+
+    # Base-level masking (always applied so rejected reads in the rejects file
+    # carry the same masking the kept ones would).
+    if duplex:
+        masked = mask_duplex_bases(buf, config.cc, config.ab, config.ba,
+                                   config.min_base_quality,
+                                   config.require_ss_agreement)
+    else:
+        masked = mask_bases(buf, config.single_strand, config.min_base_quality)
+
+    if result == PASS:
+        result = no_call_check(buf, config.max_no_call_fraction)
+    return bytes(buf), result, masked
+
+
+def run_filter(reader, writer, config: FilterConfig, *,
+               filter_by_template: bool = True,
+               reverse_per_base: bool = False,
+               rejects_writer=None) -> FilterStats:
+    """Stream records, filtering per template (or per record)."""
+    stats = FilterStats()
+
+    def emit_template(records, results, masked_counts):
+        """records: [RawRecord], results: [str] parallel."""
+        pass_flags = [r == PASS for r in results]
+        if filter_by_template:
+            tpl_pass = template_passes(records, pass_flags)
+        else:
+            tpl_pass = True  # records judged independently
+        for rec, ok, result, masked in zip(records, pass_flags, results,
+                                           masked_counts):
+            stats.total_records += 1
+            is_secondary = bool(rec.flag & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY))
+            # Non-primaries need the template to pass AND their own filters
+            # (filter.rs:703-708); primaries ride the template verdict.
+            if not filter_by_template:
+                keep = ok
+            elif is_secondary:
+                keep = tpl_pass and ok
+            else:
+                keep = tpl_pass
+            if keep:
+                stats.passed_records += 1
+                stats.bases_masked += 0 if is_secondary else masked
+                writer.write_record_bytes(rec.data)
+            else:
+                stats.failed_records += 1
+                reason = result if result != PASS else "template_failed"
+                stats.rejection_reasons[reason] += 1
+                if rejects_writer is not None:
+                    rejects_writer.write_record_bytes(rec.data)
+
+    pending_name = None
+    pending = ([], [], [])
+    for rec in reader:
+        data, result, masked = _process_one(rec.data, config, reverse_per_base)
+        new_rec = RawRecord(data)
+        if not filter_by_template:
+            emit_template([new_rec], [result], [masked])
+            continue
+        if pending_name is not None and new_rec.name != pending_name:
+            emit_template(*pending)
+            pending = ([], [], [])
+        pending_name = new_rec.name
+        pending[0].append(new_rec)
+        pending[1].append(result)
+        pending[2].append(masked)
+    if pending[0]:
+        emit_template(*pending)
+    return stats

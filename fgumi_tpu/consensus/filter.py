@@ -1,0 +1,359 @@
+"""Consensus read filtering: per-read thresholds and per-base masking.
+
+Behavioral parity with the reference's consensus filter library
+(/root/reference/crates/fgumi-consensus/src/filter.rs):
+
+- ``FilterThresholds`` {min_reads, max_read_error_rate, max_base_error_rate}
+  with 1->3 expansion filling missing values from the last (filter.rs:20-27).
+- Read-level: cD/cE tags checked against the CC tier; duplex additionally
+  checks per-metric best values against the stricter AB tier and worst values
+  against the lenient BA tier (filter.rs:503-616).
+- Base-level: masks to N @ Q2 when below min quality / min depth / above the
+  per-base error rate; duplex combines ad/bd + ae/be and optionally requires
+  single-strand agreement of ac/bc (filter.rs:745-905).
+- Mean quality is computed over the FULL read length prior to masking
+  (filter.rs:668-696); no-call check runs after masking.
+
+Methylation (cu/ct depth, strand-agreement, conversion-fraction) filters are
+not yet implemented (the methylation subsystem lands separately).
+"""
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..constants import MIN_PHRED
+from ..io.bam import FLAG_SECONDARY, FLAG_SUPPLEMENTARY, RawRecord
+
+# BAM nibble code for N in packed sequence.
+_N_NIBBLE = 15
+
+PASS = "pass"
+INSUFFICIENT_READS = "insufficient_reads"
+EXCESSIVE_ERROR_RATE = "excessive_error_rate"
+LOW_QUALITY = "low_quality"
+TOO_MANY_NO_CALLS = "too_many_no_calls"
+
+
+def expand_three_from_last(values):
+    """Expand a 1-3 element sequence to exactly 3, filling from the last."""
+    if not values:
+        raise ValueError("at least one value required")
+    v = list(values[:3])
+    while len(v) < 3:
+        v.append(v[-1])
+    return v
+
+
+@dataclass(frozen=True)
+class FilterThresholds:
+    min_reads: int
+    max_read_error_rate: float
+    max_base_error_rate: float
+
+
+@dataclass(frozen=True)
+class FilterConfig:
+    cc: FilterThresholds  # final (duplex) consensus tier
+    ab: FilterThresholds  # stricter single-strand tier
+    ba: FilterThresholds  # lenient single-strand tier
+    single_strand: FilterThresholds
+    min_base_quality: int | None
+    min_mean_base_quality: float | None
+    max_no_call_fraction: float
+    require_ss_agreement: bool = False
+
+    @classmethod
+    def new(cls, min_reads, max_read_error_rate, max_base_error_rate,
+            min_base_quality=None, min_mean_base_quality=None,
+            max_no_call_fraction=0.2, require_ss_agreement=False):
+        """Build from 1-3-valued options, validating tier ordering
+        (filter.rs:237-330: depths high->low CC>=AB>=BA; error rates AB<=BA)."""
+        mr = expand_three_from_last(min_reads)
+        re_ = expand_three_from_last(max_read_error_rate or [1.0])
+        be = expand_three_from_last(max_base_error_rate or [1.0])
+        if mr[1] > mr[0]:
+            raise ValueError(
+                f"min-reads values must be specified high to low: "
+                f"AB ({mr[1]}) > CC ({mr[0]})")
+        if mr[2] > mr[1]:
+            raise ValueError(
+                f"min-reads values must be specified high to low: "
+                f"BA ({mr[2]}) > AB ({mr[1]})")
+        if re_[1] > re_[2]:
+            raise ValueError(
+                f"max-read-error-rate for AB ({re_[1]}) must be <= BA ({re_[2]})")
+        if be[1] > be[2]:
+            raise ValueError(
+                f"max-base-error-rate for AB ({be[1]}) must be <= BA ({be[2]})")
+        return cls(
+            cc=FilterThresholds(mr[0], re_[0], be[0]),
+            ab=FilterThresholds(mr[1], re_[1], be[1]),
+            ba=FilterThresholds(mr[2], re_[2], be[2]),
+            single_strand=FilterThresholds(min_reads[0],
+                                           max_read_error_rate[0]
+                                           if max_read_error_rate else 1.0,
+                                           max_base_error_rate[0]
+                                           if max_base_error_rate else 1.0),
+            min_base_quality=min_base_quality,
+            min_mean_base_quality=min_mean_base_quality,
+            max_no_call_fraction=max_no_call_fraction,
+            require_ss_agreement=require_ss_agreement)
+
+
+def is_duplex_consensus(rec: RawRecord) -> bool:
+    """A duplex consensus read carries both aD and bD tags (filter.rs:493-497)."""
+    return rec.find_tag(b"aD") is not None and rec.find_tag(b"bD") is not None
+
+
+def filter_read(rec: RawRecord, t: FilterThresholds) -> str:
+    """Per-read check against cD depth / cE error rate (filter.rs:503-531)."""
+    depth = rec.get_int(b"cD")
+    got_ce = rec.find_tag(b"cE")
+    error_rate = got_ce[1] if got_ce and got_ce[0] == "f" else None
+    if depth is None or error_rate is None:
+        raise ValueError(
+            "read does not appear to have consensus calling tags (cD/cE) "
+            "present; filter requires reads produced by consensus calling")
+    if depth < t.min_reads:
+        return INSUFFICIENT_READS
+    if float(error_rate) > t.max_read_error_rate:
+        return EXCESSIVE_ERROR_RATE
+    return PASS
+
+
+def filter_duplex_read(rec: RawRecord, cc: FilterThresholds,
+                       ab: FilterThresholds, ba: FilterThresholds) -> str:
+    """CC tier, then per-metric best vs AB tier and worst vs BA tier
+    (filter.rs:538-616). best/worst are per-metric extremes across strands,
+    not the biological AB/BA values."""
+    result = filter_read(rec, cc)
+    if result != PASS:
+        return result
+    ab_depth = rec.get_int(b"aD")
+    if ab_depth is None:
+        ab_depth = rec.get_int(b"aM")
+    ba_depth = rec.get_int(b"bD")
+    if ba_depth is None:
+        ba_depth = rec.get_int(b"bM")
+    got = rec.find_tag(b"aE")
+    ab_err = got[1] if got and got[0] == "f" else None
+    got = rec.find_tag(b"bE")
+    ba_err = got[1] if got and got[0] == "f" else None
+
+    if ab_depth is None and ba_depth is None:
+        return PASS
+    depths = sorted(d for d in (ab_depth, ba_depth) if d is not None)
+    if len(depths) == 2:
+        worst_depth, best_depth = depths
+    else:
+        worst_depth, best_depth = 0, depths[0]
+    errs = [e for e in (ab_err, ba_err) if e is not None]
+    if len(errs) == 2:
+        best_err, worst_err = min(errs), max(errs)
+    elif errs:
+        best_err = worst_err = errs[0]
+    else:
+        best_err = worst_err = 0.0
+
+    if best_depth < ab.min_reads:
+        return INSUFFICIENT_READS
+    if float(best_err) > ab.max_read_error_rate:
+        return EXCESSIVE_ERROR_RATE
+    if worst_depth < ba.min_reads:
+        return INSUFFICIENT_READS
+    if float(worst_err) > ba.max_read_error_rate:
+        return EXCESSIVE_ERROR_RATE
+    return PASS
+
+
+def _seq_qual_view(buf):
+    """(seq_offset, qual_offset, l_seq) for a record's wire bytes."""
+    l_read_name = buf[8]
+    n_cigar = int.from_bytes(buf[12:14], "little")
+    l_seq = int.from_bytes(buf[16:20], "little")
+    seq_off = 32 + l_read_name + 4 * n_cigar
+    qual_off = seq_off + (l_seq + 1) // 2
+    return seq_off, qual_off, l_seq
+
+
+def _unpack_nibbles(buf, seq_off, l_seq) -> np.ndarray:
+    packed = np.frombuffer(bytes(buf[seq_off:seq_off + (l_seq + 1) // 2]),
+                           dtype=np.uint8)
+    nib = np.empty(2 * len(packed), dtype=np.uint8)
+    nib[0::2] = packed >> 4
+    nib[1::2] = packed & 0xF
+    return nib[:l_seq]
+
+
+def _write_nibbles(buf, seq_off, nib):
+    n = len(nib)
+    if n % 2:
+        nib = np.append(nib, 0)
+    buf[seq_off:seq_off + (n + 1) // 2] = ((nib[0::2] << 4)
+                                           | nib[1::2]).astype(np.uint8).tobytes()
+
+
+def _per_base_padded(rec: RawRecord, tag: bytes, l_seq: int):
+    """B-array tag as float64 padded/truncated to l_seq with zeros, or None."""
+    got = rec.find_tag(tag)
+    if got is None or got[0] != "B":
+        return None
+    arr = np.asarray(got[1], dtype=np.float64)[:l_seq]
+    if len(arr) < l_seq:
+        arr = np.pad(arr, (0, l_seq - len(arr)))
+    return arr
+
+
+def _string_or_u8_array(rec: RawRecord, tag: bytes):
+    """Tag value as raw bytes from either a Z string or a B:C/B:c array
+    (filter.rs:716-733 find_string_or_uint8_array)."""
+    got = rec.find_tag(tag)
+    if got is None:
+        return None
+    typ, val = got
+    if typ == "Z":
+        return val.encode()
+    if typ == "B" and isinstance(val, np.ndarray) and val.dtype.itemsize == 1:
+        return val.astype(np.uint8).tobytes()
+    return None
+
+
+def mean_base_quality_full_length(buf) -> float:
+    """Sum of all quals / full read length, incl. N bases (filter.rs:668-696)."""
+    _, qual_off, l_seq = _seq_qual_view(buf)
+    if l_seq == 0:
+        return 0.0
+    quals = np.frombuffer(bytes(buf[qual_off:qual_off + l_seq]), dtype=np.uint8)
+    return float(quals.sum()) / l_seq
+
+
+def count_no_calls(buf) -> int:
+    seq_off, _, l_seq = _seq_qual_view(buf)
+    return int((_unpack_nibbles(buf, seq_off, l_seq) == _N_NIBBLE).sum())
+
+
+def mask_bases(buf: bytearray, t: FilterThresholds,
+               min_base_quality: int | None) -> int:
+    """Mask simplex consensus bases in place; returns newly-masked count.
+
+    Per-base depth/error masking applies only when BOTH cd and ce are present
+    (filter.rs:790-794); otherwise only the quality mask applies. Vectorized
+    over the read (no per-base interpreter loop).
+    """
+    rec = RawRecord(bytes(buf))
+    seq_off, qual_off, l_seq = _seq_qual_view(buf)
+    if l_seq == 0:
+        return 0
+    cd = _per_base_padded(rec, b"cd", l_seq)
+    ce = _per_base_padded(rec, b"ce", l_seq)
+    quals = np.frombuffer(bytes(buf[qual_off:qual_off + l_seq]), dtype=np.uint8)
+    mask = np.zeros(l_seq, dtype=bool)
+    if min_base_quality is not None:
+        mask |= quals < min_base_quality
+    if cd is not None and ce is not None:
+        mask |= cd < t.min_reads
+        with np.errstate(divide="ignore", invalid="ignore"):
+            rate = np.where(cd > 0, ce / np.maximum(cd, 1), 0.0)
+        mask |= (cd > 0) & (rate > t.max_base_error_rate)
+    if not mask.any():
+        return 0
+    nib = _unpack_nibbles(buf, seq_off, l_seq).copy()
+    masked = int((mask & (nib != _N_NIBBLE)).sum())
+    nib[mask] = _N_NIBBLE
+    _write_nibbles(buf, seq_off, nib)
+    new_quals = quals.copy()
+    new_quals[mask] = MIN_PHRED
+    buf[qual_off:qual_off + l_seq] = new_quals.tobytes()
+    return masked
+
+
+def mask_duplex_bases(buf: bytearray, cc: FilterThresholds,
+                      ab: FilterThresholds, ba: FilterThresholds,
+                      min_base_quality: int | None,
+                      require_ss_agreement: bool) -> int:
+    """Mask duplex consensus bases in place; returns newly-masked count
+    (filter.rs:804-905). Already-N bases are skipped entirely (neither
+    re-masked nor re-counted, and their quals are left untouched)."""
+    rec = RawRecord(bytes(buf))
+    seq_off, qual_off, l_seq = _seq_qual_view(buf)
+    if l_seq == 0:
+        return 0
+    ad = _per_base_padded(rec, b"ad", l_seq)
+    ae = _per_base_padded(rec, b"ae", l_seq)
+    bd = _per_base_padded(rec, b"bd", l_seq)
+    be = _per_base_padded(rec, b"be", l_seq)
+    zeros = np.zeros(l_seq, dtype=np.float64)
+    ab_depth = ad if ad is not None else zeros
+    ba_depth = bd if bd is not None else zeros
+    ab_errors = ae if ae is not None else zeros
+    ba_errors = be if be is not None else zeros
+
+    best_depth = np.maximum(ab_depth, ba_depth)
+    worst_depth = np.minimum(ab_depth, ba_depth)
+    ab_rate = np.where(ab_depth > 0, ab_errors / np.maximum(ab_depth, 1), 0.0)
+    ba_rate = np.where(ba_depth > 0, ba_errors / np.maximum(ba_depth, 1), 0.0)
+    best_rate = np.minimum(ab_rate, ba_rate)
+    worst_rate = np.maximum(ab_rate, ba_rate)
+    total_depth = ab_depth + ba_depth
+    total_rate = np.where(total_depth > 0,
+                          (ab_errors + ba_errors) / np.maximum(total_depth, 1),
+                          0.0)
+    quals = np.frombuffer(bytes(buf[qual_off:qual_off + l_seq]), dtype=np.uint8)
+
+    mask = (total_depth < cc.min_reads) | (total_rate > cc.max_base_error_rate)
+    mask |= (best_depth < ab.min_reads) | (best_rate > ab.max_base_error_rate)
+    mask |= (worst_depth < ba.min_reads) | (worst_rate > ba.max_base_error_rate)
+    if min_base_quality is not None:
+        mask |= quals < min_base_quality
+    if require_ss_agreement:
+        # ac/bc may be Z strings or B:C arrays; missing/short -> N
+        a_bases = np.full(l_seq, ord("N"), dtype=np.uint8)
+        b_bases = np.full(l_seq, ord("N"), dtype=np.uint8)
+        ac = _string_or_u8_array(rec, b"ac")
+        bc = _string_or_u8_array(rec, b"bc")
+        if ac:
+            n = min(len(ac), l_seq)
+            a_bases[:n] = np.frombuffer(ac[:n], dtype=np.uint8)
+        if bc:
+            n = min(len(bc), l_seq)
+            b_bases[:n] = np.frombuffer(bc[:n], dtype=np.uint8)
+        mask |= (ab_depth > 0) & (ba_depth > 0) & (a_bases != b_bases)
+
+    nib = _unpack_nibbles(buf, seq_off, l_seq).copy()
+    mask &= nib != _N_NIBBLE  # skip already-N positions
+    if not mask.any():
+        return 0
+    masked = int(mask.sum())
+    nib[mask] = _N_NIBBLE
+    _write_nibbles(buf, seq_off, nib)
+    new_quals = quals.copy()
+    new_quals[mask] = MIN_PHRED
+    buf[qual_off:qual_off + l_seq] = new_quals.tobytes()
+    return masked
+
+
+def no_call_check(buf, max_no_call_fraction: float) -> str:
+    """< 1.0 means fraction of read length; >= 1.0 means absolute count
+    (commands/filter.rs:150-155)."""
+    _, _, l_seq = _seq_qual_view(buf)
+    n = count_no_calls(buf)
+    if max_no_call_fraction < 1.0:
+        if l_seq and n / l_seq > max_no_call_fraction:
+            return TOO_MANY_NO_CALLS
+    elif n > max_no_call_fraction:
+        return TOO_MANY_NO_CALLS
+    return PASS
+
+
+def template_passes(records, pass_flags) -> bool:
+    """All primary records must pass; a template with no primaries fails
+    (filter.rs:371-395)."""
+    has_primary = False
+    for rec, ok in zip(records, pass_flags):
+        if rec.flag & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY):
+            continue
+        has_primary = True
+        if not ok:
+            return False
+    return has_primary

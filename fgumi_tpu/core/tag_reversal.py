@@ -1,0 +1,45 @@
+"""Per-base consensus tag reversal for negative-strand reads.
+
+When a consensus read maps to the negative strand, the aligner reverses its
+bases/quals but not its per-base consensus tags; this module re-aligns them
+(reference: /root/reference/src/lib/tag_reversal.rs:1-70).
+
+- Reversed element-wise: B-arrays ``cd ce ad ae bd be`` and Z-strings
+  ``aq bq`` (Phred+33 strings).
+- Reverse-complemented: Z-strings ``ac bc`` (single-strand consensus bases).
+"""
+
+import struct
+
+from ..constants import reverse_complement_bytes
+from ..io.bam import FLAG_REVERSE, RawRecord, _ARRAY_DTYPES, _TAG_SIZES
+
+import numpy as np
+
+TAGS_TO_REVERSE = (b"cd", b"ce", b"ad", b"ae", b"bd", b"be", b"aq", b"bq")
+TAGS_TO_REVERSE_COMPLEMENT = (b"ac", b"bc")
+
+
+def reverse_per_base_tags(buf: bytearray) -> bool:
+    """Reverse/revcomp per-base tags in place; returns True if on reverse strand."""
+    rec = RawRecord(bytes(buf))
+    if not rec.flag & FLAG_REVERSE:
+        return False
+    for tag, typ, off in rec._iter_tags():
+        if tag in TAGS_TO_REVERSE:
+            if typ == ord("B"):
+                sub = buf[off]
+                (count,) = struct.unpack_from("<I", bytes(buf[off + 1:off + 5]))
+                esize = _TAG_SIZES[sub]
+                start = off + 5
+                arr = np.frombuffer(
+                    bytes(buf[start:start + count * esize]),
+                    dtype=_ARRAY_DTYPES[sub])
+                buf[start:start + count * esize] = arr[::-1].tobytes()
+            elif typ == ord("Z"):
+                end = buf.index(b"\x00", off)
+                buf[off:end] = bytes(buf[off:end])[::-1]
+        elif tag in TAGS_TO_REVERSE_COMPLEMENT and typ == ord("Z"):
+            end = buf.index(b"\x00", off)
+            buf[off:end] = reverse_complement_bytes(bytes(buf[off:end]))
+    return True

@@ -1,0 +1,97 @@
+"""downsample command: per-family sampling, MI grouping, validation."""
+
+import pytest
+
+from fgumi_tpu.commands.downsample import (iter_mi_families, run_downsample,
+                                           validate_fraction)
+from fgumi_tpu.io.bam import (FLAG_UNMAPPED, BamHeader, BamReader, BamWriter,
+                              RawRecord, RecordBuilder)
+
+
+def make_rec(name, mi):
+    b = RecordBuilder().start_unmapped(name, FLAG_UNMAPPED, b"ACGT", [30] * 4)
+    if mi is not None:
+        b.tag_str(b"MI", mi)
+    return RawRecord(b.finish())
+
+
+@pytest.mark.parametrize("frac,ok", [(0.5, True), (1.0, True), (0.0, False),
+                                     (-0.1, False), (1.5, False),
+                                     (float("nan"), False),
+                                     (float("inf"), False)])
+def test_validate_fraction(frac, ok):
+    if ok:
+        validate_fraction(frac)
+    else:
+        with pytest.raises(ValueError):
+            validate_fraction(frac)
+
+
+def test_iter_mi_families():
+    recs = [make_rec(b"a", b"1"), make_rec(b"b", b"1"), make_rec(b"c", b"2"),
+            make_rec(b"d", b"3"), make_rec(b"e", b"3")]
+    fams = [(mi, len(rs)) for mi, rs in iter_mi_families(recs)]
+    assert fams == [("1", 2), ("2", 1), ("3", 2)]
+
+
+def test_missing_mi_fails():
+    with pytest.raises(ValueError, match="no MI tag"):
+        list(iter_mi_families([make_rec(b"a", None)]))
+
+
+class _ListWriter:
+    def __init__(self):
+        self.records = []
+
+    def write_record_bytes(self, data):
+        self.records.append(RawRecord(data))
+
+
+def test_fraction_one_keeps_all():
+    recs = [make_rec(b"a", b"1"), make_rec(b"b", b"2"), make_rec(b"c", b"3")]
+    w = _ListWriter()
+    stats = run_downsample(recs, w, 1.0, seed=42)
+    assert stats.families_kept == 3 and len(w.records) == 3
+
+
+def test_seeded_runs_are_reproducible():
+    recs = [make_rec(str(i).encode(), str(i).encode()) for i in range(100)]
+    w1, w2 = _ListWriter(), _ListWriter()
+    s1 = run_downsample(recs, w1, 0.5, seed=7)
+    s2 = run_downsample(recs, w2, 0.5, seed=7)
+    assert [r.name for r in w1.records] == [r.name for r in w2.records]
+    assert 10 < s1.families_kept < 90  # statistically sane
+
+
+def test_non_consecutive_mi_rejected():
+    recs = [make_rec(b"a", b"1"), make_rec(b"b", b"2"), make_rec(b"c", b"1")]
+    with pytest.raises(ValueError, match="non-consecutive"):
+        run_downsample(recs, _ListWriter(), 1.0)
+
+
+def test_downsample_cli(tmp_path):
+    from fgumi_tpu.cli import main
+    inp = str(tmp_path / "in.bam")
+    out = str(tmp_path / "out.bam")
+    rej = str(tmp_path / "rej.bam")
+    hist = str(tmp_path / "hist.tsv")
+    header = BamHeader(text="@HD\tVN:1.6\tGO:query\tSS:template-coordinate\n",
+                       ref_names=[], ref_lengths=[])
+    with BamWriter(inp, header) as w:
+        for i in range(50):
+            for j in range(2):
+                w.write_record_bytes(
+                    make_rec(f"r{i}_{j}".encode(), str(i).encode()).data)
+    rc = main(["downsample", "-i", inp, "-o", out, "-f", "0.5", "--seed", "3",
+               "--rejects", rej, "--histogram-kept", hist])
+    assert rc == 0
+    with BamReader(out) as r:
+        kept = list(r)
+    with BamReader(rej) as r:
+        rejected = list(r)
+    assert len(kept) + len(rejected) == 100
+    assert len(kept) % 2 == 0  # whole families
+    with open(hist) as f:
+        lines = f.read().splitlines()
+    assert lines[0] == "family_size\tcount"
+    assert lines[1].startswith("2\t")

@@ -1,0 +1,299 @@
+"""filter command + consensus filter library.
+
+Covers the reference semantics in crates/fgumi-consensus/src/filter.rs
+(thresholds, 1->3 expansion, duplex best/worst tiers, per-base masking,
+no-call fraction vs count) and commands/filter.rs (template filtering).
+"""
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.consensus.filter import (
+    EXCESSIVE_ERROR_RATE, FilterConfig, FilterThresholds, INSUFFICIENT_READS,
+    PASS, TOO_MANY_NO_CALLS, count_no_calls, expand_three_from_last,
+    filter_duplex_read, filter_read, is_duplex_consensus, mask_bases,
+    mask_duplex_bases, mean_base_quality_full_length, no_call_check)
+from fgumi_tpu.core.tag_reversal import reverse_per_base_tags
+from fgumi_tpu.io.bam import (FLAG_REVERSE, FLAG_UNMAPPED, BamHeader,
+                              BamReader, BamWriter, RawRecord, RecordBuilder)
+
+
+def make_consensus(name=b"c1", seq=b"ACGTACGT", quals=None, flag=FLAG_UNMAPPED,
+                   cD=5, cE=0.01, cd=None, ce=None, duplex=None):
+    """Build a consensus-like record. duplex: dict with aD/bD/aE/bE/ad/ae/bd/be/ac/bc."""
+    if quals is None:
+        quals = [40] * len(seq)
+    b = RecordBuilder().start_unmapped(name, flag, seq, quals)
+    b.tag_int(b"cD", cD)
+    b.tag_float(b"cE", cE)
+    if cd is not None:
+        b.tag_array_i16(b"cd", cd)
+    if ce is not None:
+        b.tag_array_i16(b"ce", ce)
+    if duplex:
+        for tag in ("aD", "bD"):
+            if tag in duplex:
+                b.tag_int(tag.encode(), duplex[tag])
+        for tag in ("aE", "bE"):
+            if tag in duplex:
+                b.tag_float(tag.encode(), duplex[tag])
+        for tag in ("ad", "ae", "bd", "be"):
+            if tag in duplex:
+                b.tag_array_i16(tag.encode(), duplex[tag])
+        for tag in ("ac", "bc", "aq", "bq"):
+            if tag in duplex:
+                b.tag_str(tag.encode(), duplex[tag])
+    return RawRecord(b.finish())
+
+
+def test_expand_three_from_last():
+    assert expand_three_from_last([5]) == [5, 5, 5]
+    assert expand_three_from_last([8, 4]) == [8, 4, 4]
+    assert expand_three_from_last([8, 4, 2]) == [8, 4, 2]
+    with pytest.raises(ValueError):
+        expand_three_from_last([])
+
+
+def test_config_validates_ordering():
+    with pytest.raises(ValueError, match="high to low"):
+        FilterConfig.new([2, 5], [0.1], [0.1])
+    with pytest.raises(ValueError, match="must be <="):
+        FilterConfig.new([5, 3, 1], [0.1, 0.2, 0.1], [0.1])
+    cfg = FilterConfig.new([10, 5, 3], [0.02], [0.1])
+    assert cfg.cc.min_reads == 10 and cfg.ab.min_reads == 5
+    assert cfg.ba.min_reads == 3
+    assert cfg.single_strand.min_reads == 10
+
+
+def test_filter_read_thresholds():
+    t = FilterThresholds(3, 0.05, 0.1)
+    assert filter_read(make_consensus(cD=5, cE=0.01), t) == PASS
+    assert filter_read(make_consensus(cD=2, cE=0.01), t) == INSUFFICIENT_READS
+    assert filter_read(make_consensus(cD=5, cE=0.2), t) == EXCESSIVE_ERROR_RATE
+
+
+def test_filter_read_requires_tags():
+    b = RecordBuilder().start_unmapped(b"x", FLAG_UNMAPPED, b"ACGT", [30] * 4)
+    with pytest.raises(ValueError, match="cD/cE"):
+        filter_read(RawRecord(b.finish()), FilterThresholds(1, 1.0, 1.0))
+
+
+def test_is_duplex():
+    assert not is_duplex_consensus(make_consensus())
+    assert is_duplex_consensus(make_consensus(duplex={"aD": 3, "bD": 2}))
+
+
+def test_filter_duplex_tiers():
+    cc = FilterThresholds(4, 0.05, 0.1)
+    ab = FilterThresholds(3, 0.03, 0.1)
+    ba = FilterThresholds(1, 0.05, 0.1)
+    # best depth 3 >= 3, worst 2 >= 1 -> pass
+    rec = make_consensus(cD=5, cE=0.01,
+                         duplex={"aD": 3, "bD": 2, "aE": 0.01, "bE": 0.02})
+    assert filter_duplex_read(rec, cc, ab, ba) == PASS
+    # best depth below AB tier
+    rec = make_consensus(cD=5, cE=0.01,
+                         duplex={"aD": 2, "bD": 2, "aE": 0.01, "bE": 0.02})
+    assert filter_duplex_read(rec, cc, ab, ba) == INSUFFICIENT_READS
+    # worst error above BA tier (best error passes AB)
+    rec = make_consensus(cD=5, cE=0.01,
+                         duplex={"aD": 3, "bD": 3, "aE": 0.01, "bE": 0.2})
+    assert filter_duplex_read(rec, cc, ab, ba) == EXCESSIVE_ERROR_RATE
+    # per-metric best/worst: higher depth may be on the BA strand
+    rec = make_consensus(cD=5, cE=0.01,
+                         duplex={"aD": 1, "bD": 4, "aE": 0.01, "bE": 0.02})
+    assert filter_duplex_read(rec, cc, ab, ba) == PASS
+
+
+def test_mask_bases_by_quality_depth_error():
+    rec = make_consensus(seq=b"ACGTACGT", quals=[40, 5, 40, 40, 40, 40, 40, 40],
+                         cd=[9, 9, 1, 9, 9, 9, 9, 9],
+                         ce=[0, 0, 0, 5, 0, 0, 0, 0])
+    buf = bytearray(rec.data)
+    t = FilterThresholds(3, 1.0, 0.3)
+    masked = mask_bases(buf, t, min_base_quality=20)
+    out = RawRecord(bytes(buf))
+    # pos1 low qual, pos2 low depth, pos3 error rate 5/9 > 0.3
+    assert out.seq_bytes() == b"ANNNACGT"
+    assert list(out.quals()[:4]) == [40, 2, 2, 2]
+    assert masked == 3
+
+
+def test_mask_bases_no_per_base_tags_only_quality():
+    rec = make_consensus(seq=b"ACGT", quals=[40, 5, 40, 40])
+    buf = bytearray(rec.data)
+    masked = mask_bases(buf, FilterThresholds(3, 1.0, 0.1), min_base_quality=20)
+    assert RawRecord(bytes(buf)).seq_bytes() == b"ANGT"
+    assert masked == 1
+
+
+def test_mask_duplex_bases_and_ss_agreement():
+    rec = make_consensus(
+        seq=b"ACGT", quals=[40] * 4, cD=6, cE=0.0,
+        duplex={"aD": 3, "bD": 3, "aE": 0.0, "bE": 0.0,
+                "ad": [3, 3, 3, 0], "bd": [3, 3, 3, 0],
+                "ae": [0, 0, 0, 0], "be": [0, 3, 0, 0],
+                "ac": b"ACGT", "bc": b"AGGT"})
+    buf = bytearray(rec.data)
+    cc = FilterThresholds(4, 1.0, 0.3)
+    ab = FilterThresholds(2, 1.0, 0.3)
+    ba = FilterThresholds(1, 1.0, 0.3)
+    masked = mask_duplex_bases(buf, cc, ab, ba, min_base_quality=None,
+                               require_ss_agreement=True)
+    out = RawRecord(bytes(buf))
+    # pos1: ba error rate 3/3 > 0.3 AND ac/bc disagree; pos3: total depth 0 < 4
+    assert out.seq_bytes() == b"ANGN"
+    assert masked == 2
+
+
+def test_no_call_fraction_vs_count():
+    rec = make_consensus(seq=b"NNACGTAC", quals=[2, 2, 40, 40, 40, 40, 40, 40])
+    assert count_no_calls(rec.data) == 2
+    assert no_call_check(rec.data, 0.5) == PASS
+    assert no_call_check(rec.data, 0.1) == TOO_MANY_NO_CALLS
+    assert no_call_check(rec.data, 2.0) == PASS  # absolute count >= 1.0
+    # mean quality includes N bases in the denominator
+    assert mean_base_quality_full_length(rec.data) == pytest.approx(
+        (2 * 2 + 6 * 40) / 8)
+
+
+def test_reverse_per_base_tags():
+    rec = make_consensus(
+        seq=b"ACGT", quals=[40] * 4, flag=FLAG_REVERSE,
+        cd=[1, 2, 3, 4], ce=[0, 0, 0, 1],
+        duplex={"aD": 1, "bD": 1, "ac": b"ACGT", "aq": b"IJKL"})
+    buf = bytearray(rec.data)
+    assert reverse_per_base_tags(buf)
+    out = RawRecord(bytes(buf))
+    assert list(out.find_tag(b"cd")[1]) == [4, 3, 2, 1]
+    assert list(out.find_tag(b"ce")[1]) == [1, 0, 0, 0]
+    assert out.get_str(b"ac") == "ACGT"[::-1].translate(
+        str.maketrans("ACGT", "TGCA"))
+    assert out.get_str(b"aq") == "LKJI"
+    # positive strand: no-op
+    rec2 = make_consensus(cd=[1, 2, 3, 4])
+    buf2 = bytearray(rec2.data)
+    assert not reverse_per_base_tags(buf2)
+    assert bytes(buf2) == rec2.data
+
+
+def _write_bam(path, records, text="@HD\tVN:1.6\tSO:queryname\n"):
+    header = BamHeader(text=text, ref_names=[], ref_lengths=[])
+    with BamWriter(path, header) as w:
+        for r in records:
+            w.write_record_bytes(r.data)
+
+
+def test_filter_cli_template_filtering(tmp_path):
+    from fgumi_tpu.cli import main
+    # template t1: R1 passes, R2 fails depth -> both dropped
+    # template t2: both pass -> both kept
+    r1a = make_consensus(name=b"t1", cD=5, flag=FLAG_UNMAPPED | 0x1 | 0x40)
+    r1b = make_consensus(name=b"t1", cD=1, flag=FLAG_UNMAPPED | 0x1 | 0x80)
+    r2a = make_consensus(name=b"t2", cD=5, flag=FLAG_UNMAPPED | 0x1 | 0x40)
+    r2b = make_consensus(name=b"t2", cD=5, flag=FLAG_UNMAPPED | 0x1 | 0x80)
+    inp = str(tmp_path / "in.bam")
+    out = str(tmp_path / "out.bam")
+    rej = str(tmp_path / "rej.bam")
+    _write_bam(inp, [r1a, r1b, r2a, r2b])
+    rc = main(["filter", "-i", inp, "-o", out, "-M", "3", "--rejects", rej])
+    assert rc == 0
+    with BamReader(out) as r:
+        kept = [rec.name for rec in r]
+    assert kept == [b"t2", b"t2"]
+    with BamReader(rej) as r:
+        rejected = [rec.name for rec in r]
+    assert rejected == [b"t1", b"t1"]
+
+
+def test_filter_cli_per_record(tmp_path):
+    from fgumi_tpu.cli import main
+    r1a = make_consensus(name=b"t1", cD=5, flag=FLAG_UNMAPPED | 0x1 | 0x40)
+    r1b = make_consensus(name=b"t1", cD=1, flag=FLAG_UNMAPPED | 0x1 | 0x80)
+    inp = str(tmp_path / "in.bam")
+    out = str(tmp_path / "out.bam")
+    _write_bam(inp, [r1a, r1b])
+    rc = main(["filter", "-i", inp, "-o", out, "-M", "3",
+               "--filter-by-template", "false"])
+    assert rc == 0
+    with BamReader(out) as r:
+        kept = [(rec.name, rec.flag) for rec in r]
+    assert len(kept) == 1  # only the passing R1 survives
+
+
+def test_secondary_needs_template_and_own_pass(tmp_path):
+    from fgumi_tpu.cli import main
+    # t1: primary fails -> its passing supplementary must also be dropped
+    prim = make_consensus(name=b"t1", cD=1, flag=FLAG_UNMAPPED)
+    supp = make_consensus(name=b"t1", cD=5, flag=FLAG_UNMAPPED | 0x800)
+    # t2: primary passes, secondary fails -> secondary dropped, primary kept
+    prim2 = make_consensus(name=b"t2", cD=5, flag=FLAG_UNMAPPED)
+    sec2 = make_consensus(name=b"t2", cD=1, flag=FLAG_UNMAPPED | 0x100)
+    inp = str(tmp_path / "in.bam")
+    out = str(tmp_path / "out.bam")
+    _write_bam(inp, [prim, supp, prim2, sec2])
+    assert main(["filter", "-i", inp, "-o", out, "-M", "3"]) == 0
+    with BamReader(out) as r:
+        kept = [(rec.name, rec.flag) for rec in r]
+    assert kept == [(b"t2", FLAG_UNMAPPED)]
+
+
+def test_filter_rejects_unordered_input(tmp_path):
+    from fgumi_tpu.cli import main
+    inp = str(tmp_path / "in.bam")
+    out = str(tmp_path / "out.bam")
+    _write_bam(inp, [make_consensus()], text="@HD\tVN:1.6\tSO:coordinate\n")
+    assert main(["filter", "-i", inp, "-o", out, "-M", "3"]) == 2
+
+
+def test_filter_rejects_mapped_reads(tmp_path):
+    from fgumi_tpu.cli import main
+    inp = str(tmp_path / "in.bam")
+    out = str(tmp_path / "out.bam")
+    _write_bam(inp, [make_consensus(flag=0)])  # mapped
+    assert main(["filter", "-i", inp, "-o", out, "-M", "3"]) == 2
+
+
+def test_mask_duplex_ac_bc_as_u8_array():
+    # ac/bc may be B:C uint8 arrays instead of Z strings (filter.rs:716-733)
+    b = RecordBuilder().start_unmapped(b"c1", FLAG_UNMAPPED, b"ACGT", [40] * 4)
+    b.tag_int(b"cD", 6)
+    b.tag_float(b"cE", 0.0)
+    b.tag_int(b"aD", 3)
+    b.tag_int(b"bD", 3)
+    b.tag_array_i16(b"ad", [3, 3, 3, 3])
+    b.tag_array_i16(b"bd", [3, 3, 3, 3])
+    b.tag_array_u8(b"ac", list(b"ACGT"))
+    b.tag_array_u8(b"bc", list(b"AGGT"))
+    buf = bytearray(b.finish())
+    t = FilterThresholds(1, 1.0, 1.0)
+    masked = mask_duplex_bases(buf, t, t, t, None, require_ss_agreement=True)
+    assert RawRecord(bytes(buf)).seq_bytes() == b"ANGT"
+    assert masked == 1
+
+
+def test_filter_output_header_has_pg(tmp_path):
+    from fgumi_tpu.cli import main
+    inp = str(tmp_path / "in.bam")
+    out = str(tmp_path / "out.bam")
+    _write_bam(inp, [make_consensus()],
+               text="@HD\tVN:1.6\tSO:queryname\n@PG\tID:prev\tPN:x\n")
+    assert main(["filter", "-i", inp, "-o", out, "-M", "3"]) == 0
+    with BamReader(out) as r:
+        text = r.header.text
+    assert "ID:fgumi-tpu" in text and "PP:prev" in text
+
+
+def test_filter_cli_masking_end_to_end(tmp_path):
+    from fgumi_tpu.cli import main
+    rec = make_consensus(name=b"m1", seq=b"ACGTACGT", cD=5, cE=0.0,
+                         cd=[9, 1, 9, 9, 9, 9, 9, 9],
+                         ce=[0, 0, 0, 0, 0, 0, 0, 0])
+    inp = str(tmp_path / "in.bam")
+    out = str(tmp_path / "out.bam")
+    _write_bam(inp, [rec])
+    rc = main(["filter", "-i", inp, "-o", out, "-M", "3"])
+    assert rc == 0
+    with BamReader(out) as r:
+        (kept,) = list(r)
+    assert kept.seq_bytes() == b"ANGTACGT"

@@ -496,6 +496,60 @@ def _header_with_pg(header, command_line):
                      ref_names=header.ref_names, ref_lengths=header.ref_lengths)
 
 
+def _add_zipper(sub):
+    p = sub.add_parser("zipper", help="Zip unmapped BAM with aligned BAM")
+    p.add_argument("-i", "--input", required=True,
+                   help="mapped BAM from the aligner (queryname ordered)")
+    p.add_argument("-u", "--unmapped", required=True,
+                   help="unmapped BAM with tags to restore (same ordering)")
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("--tags-to-remove", nargs="*", default=[])
+    p.add_argument("--tags-to-reverse", nargs="*", default=[],
+                   help="tags (or the 'Consensus' set) to reverse on negative strand")
+    p.add_argument("--tags-to-revcomp", nargs="*", default=[],
+                   help="tags (or the 'Consensus' set) to revcomp on negative strand")
+    p.add_argument("--skip-tc-tags", nargs="?", const=True, default=False,
+                   type=_parse_bool)
+    p.add_argument("--exclude-missing-reads", nargs="?", const=True,
+                   default=False, type=_parse_bool,
+                   help="drop unmapped-BAM reads the aligner omitted")
+    p.set_defaults(func=cmd_zipper)
+
+
+def cmd_zipper(args):
+    from .commands.zipper import TagInfo, run_zipper
+    from .core.template import is_query_grouped
+    from .io.bam import BamReader, BamWriter
+
+    tag_info = TagInfo.from_options(
+        remove=args.tags_to_remove, reverse=args.tags_to_reverse,
+        revcomp=args.tags_to_revcomp)
+    t0 = time.monotonic()
+    try:
+        with BamReader(args.input) as mapped, \
+                BamReader(args.unmapped) as unmapped:
+            for name, r in (("mapped", mapped), ("unmapped", unmapped)):
+                if not is_query_grouped(r.header.text):
+                    log.error(
+                        "zipper requires queryname-sorted or query-grouped "
+                        "%s input (@HD must advertise SO:queryname or "
+                        "GO:query)", name)
+                    return 2
+            out_header = _header_with_pg(mapped.header, " ".join(sys.argv))
+            with BamWriter(args.output, out_header) as writer:
+                n_templates, n_records = run_zipper(
+                    mapped, unmapped, writer, tag_info,
+                    skip_tc_tags=args.skip_tc_tags,
+                    exclude_missing_reads=args.exclude_missing_reads)
+    except (ValueError, OSError) as e:
+        log.error("%s", e)
+        return 2
+    dt = time.monotonic() - t0
+    log.info("zipper: %d templates (%d records) in %.2fs (%.0f rec/s)",
+             n_templates, n_records, dt, n_records / dt if dt else 0)
+    return 0
+
+
 def _add_filter(sub):
     p = sub.add_parser("filter", help="Filter and mask consensus reads")
     p.add_argument("-i", "--input", required=True,
@@ -706,6 +760,7 @@ def main(argv=None):
     parser.add_argument("-v", "--verbose", action="store_true")
     sub = parser.add_subparsers(dest="command", required=True)
     _add_extract(sub)
+    _add_zipper(sub)
     _add_simplex(sub)
     _add_duplex(sub)
     _add_filter(sub)

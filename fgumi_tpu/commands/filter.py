@@ -20,6 +20,7 @@ from ..consensus.filter import (
     mask_bases, mask_duplex_bases, mean_base_quality_full_length,
     no_call_check, template_passes)
 from ..core.tag_reversal import reverse_per_base_tags
+from ..core.template import iter_name_groups
 from ..io.bam import (FLAG_SECONDARY, FLAG_SUPPLEMENTARY, FLAG_UNMAPPED,
                       RawRecord)
 
@@ -110,21 +111,16 @@ def run_filter(reader, writer, config: FilterConfig, *,
                 if rejects_writer is not None:
                     rejects_writer.write_record_bytes(rec.data)
 
-    pending_name = None
-    pending = ([], [], [])
-    for rec in reader:
-        data, result, masked = _process_one(rec.data, config, reverse_per_base)
-        new_rec = RawRecord(data)
-        if not filter_by_template:
-            emit_template([new_rec], [result], [masked])
-            continue
-        if pending_name is not None and new_rec.name != pending_name:
-            emit_template(*pending)
-            pending = ([], [], [])
-        pending_name = new_rec.name
-        pending[0].append(new_rec)
-        pending[1].append(result)
-        pending[2].append(masked)
-    if pending[0]:
-        emit_template(*pending)
+    if not filter_by_template:
+        for rec in reader:
+            data, result, masked = _process_one(rec.data, config,
+                                                reverse_per_base)
+            emit_template([RawRecord(data)], [result], [masked])
+        return stats
+    for _name, group in iter_name_groups(reader):
+        processed = [_process_one(rec.data, config, reverse_per_base)
+                     for rec in group]
+        emit_template([RawRecord(d) for d, _, _ in processed],
+                      [r for _, r, _ in processed],
+                      [m for _, _, m in processed])
     return stats

@@ -1,0 +1,324 @@
+"""zipper: merge an aligner's output BAM with the original unmapped BAM.
+
+Streaming merge matching the reference (/root/reference/src/lib/commands/
+zipper.rs): both inputs must be queryname-sorted/grouped with identical
+ordering. Per template: fix mate info (MC/MQ/ms, TLEN), remove requested tags,
+copy all tags from the unmapped primaries onto the matching mapped records
+(reverse / reverse-complement per-base tags on negative-strand reads), transfer
+the QC-fail flag, normalize AS/XS to the smallest signed int type, and add a
+``tc`` template-coordinate tag (B:i array) to secondary/supplementary records.
+"""
+
+from dataclasses import dataclass, field
+
+from ..core.record_edit import (append_raw_tag_entry, append_tag_i32_array,
+                                cigar_string, normalize_int_tag_to_smallest_signed,
+                                raw_tag_entries, remove_tag, remove_tags,
+                                set_bin, set_flags, set_mate_pos,
+                                set_mate_ref_id, set_pos, set_ref_id, set_tlen,
+                                update_tag_i32, update_tag_str)
+from ..core.tag_reversal import revcomp_tag_value_at, reverse_tag_value_at
+from ..core.template import iter_name_groups
+from ..io.bam import (FLAG_FIRST, FLAG_MATE_REVERSE, FLAG_MATE_UNMAPPED,
+                      FLAG_PAIRED, FLAG_QC_FAIL, FLAG_REVERSE, FLAG_SECONDARY,
+                      FLAG_SUPPLEMENTARY, FLAG_UNMAPPED, RawRecord)
+
+# The "Consensus" named tag set (umi TagSets; tag_reversal.rs:88-90).
+CONSENSUS_REVERSE_TAGS = ("cd", "ce", "ad", "ae", "bd", "be", "aq", "bq")
+CONSENSUS_REVCOMP_TAGS = ("ac", "bc")
+
+
+@dataclass
+class TagInfo:
+    remove: set = field(default_factory=set)
+    reverse: set = field(default_factory=set)
+    revcomp: set = field(default_factory=set)
+
+    @classmethod
+    def from_options(cls, remove=(), reverse=(), revcomp=()):
+        def expand(names, consensus):
+            out = set()
+            for n in names:
+                if n == "Consensus":
+                    out.update(consensus)
+                else:
+                    out.add(n)
+            return out
+
+        return cls(remove=expand(remove, ()),
+                   reverse=expand(reverse, CONSENSUS_REVERSE_TAGS),
+                   revcomp=expand(revcomp, CONSENSUS_REVCOMP_TAGS))
+
+
+@dataclass
+class MappedTemplate:
+    """One QNAME's mapped records as mutable bytearrays, classified."""
+    name: bytes
+    bufs: list  # bytearray per record, input order
+    r1: int | None = None  # index of primary R1 (or fragment)
+    r2: int | None = None
+    r1_others: list = field(default_factory=list)  # secondary/supp of R1/fragment
+    r2_others: list = field(default_factory=list)
+    r1_supplementals: list = field(default_factory=list)
+    r2_supplementals: list = field(default_factory=list)
+
+    @classmethod
+    def from_records(cls, name, records):
+        t = cls(name=name, bufs=[bytearray(r.data) for r in records])
+        for i, rec in enumerate(records):
+            flg = rec.flag
+            first = (not flg & FLAG_PAIRED) or bool(flg & FLAG_FIRST)
+            if flg & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY):
+                (t.r1_others if first else t.r2_others).append(i)
+                if flg & FLAG_SUPPLEMENTARY:
+                    (t.r1_supplementals if first
+                     else t.r2_supplementals).append(i)
+            elif first:
+                t.r1 = i
+            else:
+                t.r2 = i
+        return t
+
+
+def _flag(buf) -> int:
+    return int.from_bytes(buf[14:16], "little")
+
+
+def _rec(buf) -> RawRecord:
+    return RawRecord(bytes(buf))
+
+
+def _set_mate_flags(buf, mate_reverse: bool, mate_unmapped: bool):
+    f = _flag(buf) & ~(FLAG_MATE_REVERSE | FLAG_MATE_UNMAPPED)
+    if mate_reverse:
+        f |= FLAG_MATE_REVERSE
+    if mate_unmapped:
+        f |= FLAG_MATE_UNMAPPED
+    set_flags(buf, f)
+
+
+def _insert_size(rec1: RawRecord, rec2: RawRecord) -> int:
+    """TLEN via 5'-to-5' distance (template.rs:819-851, htsjdk convention)."""
+    if rec1.flag & FLAG_UNMAPPED or rec2.flag & FLAG_UNMAPPED:
+        return 0
+    if rec1.ref_id != rec2.ref_id:
+        return 0
+    pos1, pos2 = rec1.pos + 1, rec2.pos + 1
+    end1 = pos1 + rec1.reference_length() - 1
+    end2 = pos2 + rec2.reference_length() - 1
+    first_5p = end1 if rec1.flag & FLAG_REVERSE else pos1
+    second_5p = end2 if rec2.flag & FLAG_REVERSE else pos2
+    adjustment = 1 if second_5p >= first_5p else -1
+    return second_5p - first_5p + adjustment
+
+
+def _as_tag(rec: RawRecord):
+    return rec.get_int(b"AS")
+
+
+def _set_mate_from(buf, mate: RawRecord, tlen=None):
+    """Write mate ref/pos/flags/MQ/MC from `mate` onto `buf`."""
+    set_mate_ref_id(buf, mate.ref_id)
+    set_mate_pos(buf, mate.pos)
+    mate_unmapped = bool(mate.flag & FLAG_UNMAPPED)
+    _set_mate_flags(buf, bool(mate.flag & FLAG_REVERSE), mate_unmapped)
+    update_tag_i32(buf, b"MQ", mate.mapq)
+    cig = cigar_string(mate)
+    if cig != "*" and not mate_unmapped:
+        update_tag_str(buf, b"MC", cig.encode())
+    else:
+        remove_tag(buf, b"MC")
+    if tlen is not None:
+        set_tlen(buf, tlen)
+
+
+def fix_mate_info(t: MappedTemplate):
+    """template.rs:459-605: primary pair mate pointers, MQ/MC/ms tags, TLEN,
+    and supplementals pointing at the opposite primary."""
+    if t.r1 is not None and t.r2 is not None:
+        b1, b2 = t.bufs[t.r1], t.bufs[t.r2]
+        r1, r2 = _rec(b1), _rec(b2)
+        r1_unmapped = bool(r1.flag & FLAG_UNMAPPED)
+        r2_unmapped = bool(r2.flag & FLAG_UNMAPPED)
+        r1_as, r2_as = _as_tag(r1), _as_tag(r2)
+        if not r1_unmapped and not r2_unmapped:
+            tlen = _insert_size(r1, r2)
+            _set_mate_from(b1, r2, tlen)
+            _set_mate_from(b2, r1, -tlen)
+        elif r1_unmapped and r2_unmapped:
+            for b, other in ((b1, r2), (b2, r1)):
+                set_ref_id(b, -1)
+                set_pos(b, -1)
+                set_mate_ref_id(b, -1)
+                set_mate_pos(b, -1)
+                _set_mate_flags(b, bool(other.flag & FLAG_REVERSE), True)
+                remove_tag(b, b"MQ")
+                remove_tag(b, b"MC")
+                set_tlen(b, 0)
+                set_bin(b)  # POS moved to -1: bin must be reg2bin(-1,0)=4680
+        else:
+            mapped_b, unmapped_b = (b2, b1) if r1_unmapped else (b1, b2)
+            mapped = _rec(mapped_b)
+            unmapped = _rec(unmapped_b)
+            # place the unmapped read at its mate's coordinates
+            set_ref_id(unmapped_b, mapped.ref_id)
+            set_pos(unmapped_b, mapped.pos)
+            set_mate_ref_id(mapped_b, mapped.ref_id)
+            set_mate_pos(mapped_b, mapped.pos)
+            _set_mate_flags(mapped_b, bool(unmapped.flag & FLAG_REVERSE), True)
+            remove_tag(mapped_b, b"MQ")
+            remove_tag(mapped_b, b"MC")
+            set_tlen(mapped_b, 0)
+            _set_mate_from(unmapped_b, mapped, 0)
+            set_bin(unmapped_b)
+        # ms (mate score) from the mate's AS, both cases
+        if r2_as is not None:
+            update_tag_i32(b1, b"ms", int(r2_as))
+        if r1_as is not None:
+            update_tag_i32(b2, b"ms", int(r1_as))
+
+    # Supplementals point at the opposite primary (template.rs:513-605).
+    for supp_list, primary_i in ((t.r1_supplementals, t.r2),
+                                 (t.r2_supplementals, t.r1)):
+        if primary_i is None or not supp_list:
+            continue
+        pbuf = t.bufs[primary_i]
+        primary = _rec(pbuf)
+        p_tlen = primary.tlen
+        p_as = _as_tag(primary)
+        for i in supp_list:
+            b = t.bufs[i]
+            _set_mate_from(b, primary, -p_tlen)
+            if p_as is not None:
+                update_tag_i32(b, b"ms", int(p_as))
+
+
+def _unclipped_5prime(rec: RawRecord) -> int:
+    if rec.flag & FLAG_REVERSE:
+        return rec.unclipped_end()
+    return rec.unclipped_start()
+
+
+def add_template_coordinate_tags(t: MappedTemplate):
+    """tc tag (B:i [tid1,pos1,neg1,tid2,pos2,neg2], lower coordinate first) on
+    secondary/supplementary records only (zipper.rs:281-357)."""
+    others = t.r1_others + t.r2_others
+    if not others:
+        return
+
+    def info(i):
+        if i is None:
+            return None
+        rec = _rec(t.bufs[i])
+        if rec.flag & FLAG_UNMAPPED:
+            return None
+        return (rec.ref_id, _unclipped_5prime(rec),
+                1 if rec.flag & FLAG_REVERSE else 0)
+
+    i1, i2 = info(t.r1), info(t.r2)
+    if i1 is not None and i2 is not None:
+        a, b = (i1, i2) if (i1[0], i1[1]) <= (i2[0], i2[1]) else (i2, i1)
+    elif i1 is not None or i2 is not None:
+        a = b = i1 if i1 is not None else i2
+    else:
+        return
+    values = [a[0], a[1], a[2], b[0], b[1], b[2]]
+    for i in others:
+        remove_tag(t.bufs[i], b"tc")
+        append_tag_i32_array(t.bufs[i], b"tc", values)
+
+
+def merge_template(unmapped_records, t: MappedTemplate, tag_info: TagInfo,
+                   skip_tc_tags: bool = False):
+    """Transfer tags/flags from an unmapped template onto the mapped one
+    (zipper.rs merge_raw:397-545)."""
+    fix_mate_info(t)
+
+    for buf in t.bufs:
+        for tag in tag_info.remove:
+            if len(tag) == 2:
+                remove_tag(buf, tag.encode())
+
+    primaries = [r for r in unmapped_records
+                 if not r.flag & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY)]
+    for u in primaries:
+        u_flags = u.flag
+        is_unpaired = not u_flags & FLAG_PAIRED
+        is_first = bool(u_flags & FLAG_FIRST)
+        if is_unpaired or is_first:
+            indices = ([t.r1] if t.r1 is not None else []) + t.r1_others
+        else:
+            indices = ([t.r2] if t.r2 is not None else []) + t.r2_others
+        u_tags = [(tag, typ, vb) for tag, typ, vb in raw_tag_entries(u)
+                  if tag.decode(errors="replace") not in tag_info.remove]
+        copy_names = {tag for tag, _, _ in u_tags}
+        for i in indices:
+            buf = t.bufs[i]
+            rec = _rec(buf)
+            has_pg = rec.find_tag(b"PG") is not None
+            negative = bool(rec.flag & FLAG_REVERSE)
+            # single pass: drop every tag we are about to re-append ...
+            remove_tags(buf, copy_names - ({b"PG"} if has_pg else set()))
+            # ... then append them all, tracking offsets for strand transforms
+            for entry in u_tags:
+                tag, typ, _ = entry
+                if tag == b"PG" and has_pg:
+                    continue
+                value_off = len(buf) + 3
+                append_raw_tag_entry(buf, entry)
+                if negative:
+                    tag_str = tag.decode(errors="replace")
+                    if tag_str in tag_info.reverse:
+                        reverse_tag_value_at(buf, typ, value_off)
+                    elif tag_str in tag_info.revcomp:
+                        revcomp_tag_value_at(buf, typ, value_off)
+        # QC pass/fail transfer
+        qc_fail = bool(u_flags & FLAG_QC_FAIL)
+        for i in indices:
+            f = _flag(t.bufs[i])
+            f = (f | FLAG_QC_FAIL) if qc_fail else (f & ~FLAG_QC_FAIL)
+            set_flags(t.bufs[i], f)
+
+    for buf in t.bufs:
+        normalize_int_tag_to_smallest_signed(buf, b"AS")
+        normalize_int_tag_to_smallest_signed(buf, b"XS")
+
+    if not skip_tc_tags:
+        add_template_coordinate_tags(t)
+
+
+def run_zipper(mapped_reader, unmapped_reader, writer, tag_info: TagInfo, *,
+               skip_tc_tags: bool = False, exclude_missing_reads: bool = False):
+    """Lockstep merge by QNAME. Returns (templates, records_out).
+
+    Both inputs must share queryname ordering. An unmapped template absent from
+    the mapped BAM (aligner dropped it) is an error unless
+    exclude_missing_reads; a mapped template absent from the unmapped BAM is
+    always an error (the unmapped BAM is the source of truth).
+    """
+    mapped_groups = iter_name_groups(mapped_reader)
+    n_templates = 0
+    n_records = 0
+    mapped_item = next(mapped_groups, None)
+    for u_name, u_records in iter_name_groups(unmapped_reader):
+        if mapped_item is None or mapped_item[0] != u_name:
+            if exclude_missing_reads:
+                continue
+            raise ValueError(
+                f"read '{u_name.decode(errors='replace')}' present in the "
+                "unmapped BAM but not next in the mapped BAM; inputs must "
+                "share queryname ordering (use --exclude-missing-reads to "
+                "drop reads the aligner omitted)")
+        t = MappedTemplate.from_records(mapped_item[0], mapped_item[1])
+        merge_template(u_records, t, tag_info, skip_tc_tags)
+        for buf in t.bufs:
+            writer.write_record_bytes(bytes(buf))
+            n_records += 1
+        n_templates += 1
+        mapped_item = next(mapped_groups, None)
+    if mapped_item is not None:
+        raise ValueError(
+            f"read '{mapped_item[0].decode(errors='replace')}' present in the "
+            "mapped BAM but not in the unmapped BAM; inputs must share "
+            "queryname ordering")
+    return n_templates, n_records

@@ -1,0 +1,124 @@
+"""In-place edits on raw BAM record bytes (bytearray).
+
+Python analog of the reference's raw-record mutators
+(/root/reference/crates/fgumi-raw-bam: set_* fixed-offset writers, remove_tag /
+update_*_tag aux TLV editing, reg2bin). All functions take the record's wire
+bytes as a bytearray (no block_size prefix) and edit in place where the
+layout permits, or return the replacement bytearray when the length changes.
+"""
+
+import struct
+
+from ..io.bam import RawRecord, _reg2bin, _skip_tag_value
+
+
+def set_flags(buf: bytearray, flags: int):
+    buf[14:16] = struct.pack("<H", flags)
+
+
+def set_ref_id(buf: bytearray, ref_id: int):
+    buf[0:4] = struct.pack("<i", ref_id)
+
+
+def set_pos(buf: bytearray, pos: int):
+    buf[4:8] = struct.pack("<i", pos)
+
+
+def set_mate_ref_id(buf: bytearray, ref_id: int):
+    buf[20:24] = struct.pack("<i", ref_id)
+
+
+def set_mate_pos(buf: bytearray, pos: int):
+    buf[24:28] = struct.pack("<i", pos)
+
+
+def set_tlen(buf: bytearray, tlen: int):
+    buf[28:32] = struct.pack("<i", tlen)
+
+
+def set_bin(buf: bytearray):
+    """Recompute the BAM bin from pos + reference length."""
+    rec = RawRecord(bytes(buf))
+    pos = rec.pos
+    if pos < 0:
+        b = _reg2bin(-1, 0)
+    else:
+        ref_len = rec.reference_length() or 1
+        b = _reg2bin(pos, pos + ref_len)
+    buf[10:12] = struct.pack("<H", b)
+
+
+def cigar_string(rec: RawRecord) -> str:
+    ops = rec.cigar()
+    if not ops:
+        return "*"
+    return "".join(f"{n}{op}" for op, n in ops)
+
+
+def remove_tag(buf: bytearray, tag: bytes):
+    """Remove every occurrence of an aux tag; edits in place."""
+    remove_tags(buf, (tag,))
+
+
+def remove_tags(buf: bytearray, tags):
+    """Remove every occurrence of each tag in `tags` in one aux scan."""
+    rec = RawRecord(bytes(buf))
+    spans = []
+    for t, typ, off in rec._iter_tags():
+        if t in tags:
+            spans.append((off - 3, _skip_tag_value(rec.data, typ, off)))
+    for start, end in reversed(spans):
+        del buf[start:end]
+
+
+def append_tag_i32(buf: bytearray, tag: bytes, value: int):
+    buf += tag + b"i" + struct.pack("<i", value)
+
+
+def update_tag_i32(buf: bytearray, tag: bytes, value: int):
+    remove_tag(buf, tag)
+    append_tag_i32(buf, tag, value)
+
+
+def update_tag_str(buf: bytearray, tag: bytes, value: bytes):
+    remove_tag(buf, tag)
+    buf += tag + b"Z" + value + b"\x00"
+
+
+def append_tag_i32_array(buf: bytearray, tag: bytes, values):
+    buf += tag + b"Bi" + struct.pack("<I", len(values))
+    for v in values:
+        buf += struct.pack("<i", v)
+
+
+def normalize_int_tag_to_smallest_signed(buf: bytearray, tag: bytes):
+    """Rewrite an integer tag using the smallest signed type that holds it
+    (zipper.rs step 5; matches fgbio's AS/XS normalization)."""
+    rec = RawRecord(bytes(buf))
+    got = rec.find_tag(tag)
+    if got is None or got[0] not in "cCsSiI":
+        return
+    value = int(got[1])
+    remove_tag(buf, tag)
+    if -128 <= value <= 127:
+        buf += tag + b"c" + struct.pack("<b", value)
+    elif -32768 <= value <= 32767:
+        buf += tag + b"s" + struct.pack("<h", value)
+    else:
+        buf += tag + b"i" + struct.pack("<i", value)
+
+
+def raw_tag_entries(rec: RawRecord):
+    """[(tag, type_byte, value_bytes)] for every aux tag, pre-encoded."""
+    out = []
+    for tag, typ, off in rec._iter_tags():
+        end = _skip_tag_value(rec.data, typ, off)
+        out.append((tag, typ, rec.data[off:end]))
+    return out
+
+
+def append_raw_tag_entry(buf: bytearray, entry):
+    tag, typ, value_bytes = entry
+    buf += tag
+    buf.append(typ)
+    buf += value_bytes

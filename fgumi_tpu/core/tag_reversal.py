@@ -20,6 +20,45 @@ TAGS_TO_REVERSE = (b"cd", b"ce", b"ad", b"ae", b"bd", b"be", b"aq", b"bq")
 TAGS_TO_REVERSE_COMPLEMENT = (b"ac", b"bc")
 
 
+def reverse_tag_value_at(buf: bytearray, typ: int, off: int):
+    """Reverse one aux tag value in place given its type byte and value offset
+    (B-arrays element-wise, Z-strings byte-wise)."""
+    if typ == ord("B"):
+        sub = buf[off]
+        (count,) = struct.unpack_from("<I", bytes(buf[off + 1:off + 5]))
+        esize = _TAG_SIZES[sub]
+        start = off + 5
+        arr = np.frombuffer(bytes(buf[start:start + count * esize]),
+                            dtype=_ARRAY_DTYPES[sub])
+        buf[start:start + count * esize] = arr[::-1].tobytes()
+    elif typ == ord("Z"):
+        end = buf.index(b"\x00", off)
+        buf[off:end] = bytes(buf[off:end])[::-1]
+
+
+def revcomp_tag_value_at(buf: bytearray, typ: int, off: int):
+    """Reverse-complement one Z-string aux tag value in place."""
+    if typ == ord("Z"):
+        end = buf.index(b"\x00", off)
+        buf[off:end] = reverse_complement_bytes(bytes(buf[off:end]))
+
+
+def reverse_tag_in_place(buf: bytearray, tag: bytes):
+    """Find `tag` and reverse its value in place (first occurrence)."""
+    for t_, typ, off in RawRecord(bytes(buf))._iter_tags():
+        if t_ == tag:
+            reverse_tag_value_at(buf, typ, off)
+            return
+
+
+def revcomp_tag_in_place(buf: bytearray, tag: bytes):
+    """Find `tag` (Z string) and reverse-complement its value in place."""
+    for t_, typ, off in RawRecord(bytes(buf))._iter_tags():
+        if t_ == tag:
+            revcomp_tag_value_at(buf, typ, off)
+            return
+
+
 def reverse_per_base_tags(buf: bytearray) -> bool:
     """Reverse/revcomp per-base tags in place; returns True if on reverse strand."""
     rec = RawRecord(bytes(buf))
@@ -27,19 +66,7 @@ def reverse_per_base_tags(buf: bytearray) -> bool:
         return False
     for tag, typ, off in rec._iter_tags():
         if tag in TAGS_TO_REVERSE:
-            if typ == ord("B"):
-                sub = buf[off]
-                (count,) = struct.unpack_from("<I", bytes(buf[off + 1:off + 5]))
-                esize = _TAG_SIZES[sub]
-                start = off + 5
-                arr = np.frombuffer(
-                    bytes(buf[start:start + count * esize]),
-                    dtype=_ARRAY_DTYPES[sub])
-                buf[start:start + count * esize] = arr[::-1].tobytes()
-            elif typ == ord("Z"):
-                end = buf.index(b"\x00", off)
-                buf[off:end] = bytes(buf[off:end])[::-1]
-        elif tag in TAGS_TO_REVERSE_COMPLEMENT and typ == ord("Z"):
-            end = buf.index(b"\x00", off)
-            buf[off:end] = reverse_complement_bytes(bytes(buf[off:end]))
+            reverse_tag_value_at(buf, typ, off)
+        elif tag in TAGS_TO_REVERSE_COMPLEMENT:
+            revcomp_tag_value_at(buf, typ, off)
     return True

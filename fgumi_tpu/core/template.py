@@ -59,6 +59,19 @@ def classify(records) -> Template:
     return t
 
 
+def iter_name_groups(records):
+    """Yield (name, [records]) for consecutive records sharing a QNAME."""
+    current_name, bucket = None, []
+    for rec in records:
+        if current_name is not None and rec.name != current_name:
+            yield current_name, bucket
+            bucket = []
+        current_name = rec.name
+        bucket.append(rec)
+    if bucket:
+        yield current_name, bucket
+
+
 def iter_templates(records):
     """Yield Templates from query-grouped records (consecutive same QNAME)."""
     current_name = None

@@ -45,6 +45,22 @@ _CONSUMES_QUERY = frozenset("MIS=X")
 _CONSUMES_REF = frozenset("MDN=X")
 
 
+def _reg2bin(beg: int, end: int) -> int:
+    """SAM spec reg2bin over 0-based half-open [beg, end)."""
+    end -= 1
+    if beg >> 14 == end >> 14:
+        return ((1 << 15) - 1) // 7 + (beg >> 14)
+    if beg >> 17 == end >> 17:
+        return ((1 << 12) - 1) // 7 + (beg >> 17)
+    if beg >> 20 == end >> 20:
+        return ((1 << 9) - 1) // 7 + (beg >> 20)
+    if beg >> 23 == end >> 23:
+        return ((1 << 6) - 1) // 7 + (beg >> 23)
+    if beg >> 26 == end >> 26:
+        return ((1 << 3) - 1) // 7 + (beg >> 26)
+    return 0
+
+
 @dataclass
 class BamHeader:
     text: str
@@ -315,6 +331,32 @@ class RecordBuilder:
         buf += name
         buf += b"\x00"
         # pack sequence to nibbles
+        codes = BASE_TO_NIBBLE[np.frombuffer(seq, dtype=np.uint8)]
+        if n % 2:
+            codes = np.append(codes, 0)
+        buf += ((codes[0::2] << 4) | codes[1::2]).astype(np.uint8).tobytes()
+        buf += np.asarray(quals, dtype=np.uint8).tobytes()
+        return self
+
+    def start_mapped(self, name: bytes, flag: int, ref_id: int, pos: int,
+                     mapq: int, cigar, seq: bytes, quals,
+                     next_ref_id: int = -1, next_pos: int = -1,
+                     tlen: int = 0) -> "RecordBuilder":
+        """Begin a mapped record. `cigar` is [(op_char, length)] (builder.rs:356)."""
+        buf = self._buf
+        buf.clear()
+        l_name = len(name) + 1
+        if l_name > 255:
+            raise ValueError(f"read name too long ({len(name)} bytes)")
+        n = len(seq)
+        ref_len = sum(ln for op, ln in cigar if op in _CONSUMES_REF) or 1
+        bin_ = _reg2bin(pos, pos + ref_len) if pos >= 0 else UNMAPPED_BIN
+        buf += struct.pack("<iiBBHHHiiii", ref_id, pos, l_name, mapq, bin_,
+                           len(cigar), flag, n, next_ref_id, next_pos, tlen)
+        buf += name
+        buf += b"\x00"
+        for op, length in cigar:
+            buf += struct.pack("<I", (length << 4) | CIGAR_OPS.index(op))
         codes = BASE_TO_NIBBLE[np.frombuffer(seq, dtype=np.uint8)]
         if n % 2:
             codes = np.append(codes, 0)

@@ -1,0 +1,203 @@
+"""zipper command: mate-info fixing, tag transfer, tc tags.
+
+Covers the reference's merge_raw pipeline (zipper.rs:397-545) and
+Template::fix_mate_info (template.rs:459-605).
+"""
+
+import pytest
+
+from fgumi_tpu.commands.zipper import (MappedTemplate, TagInfo,
+                                       add_template_coordinate_tags,
+                                       fix_mate_info, merge_template,
+                                       run_zipper)
+from fgumi_tpu.io.bam import (FLAG_FIRST, FLAG_LAST, FLAG_MATE_REVERSE,
+                              FLAG_MATE_UNMAPPED, FLAG_PAIRED, FLAG_QC_FAIL,
+                              FLAG_REVERSE, FLAG_SUPPLEMENTARY, FLAG_UNMAPPED,
+                              BamHeader, BamReader, BamWriter, RawRecord,
+                              RecordBuilder)
+
+QG_HEADER = "@HD\tVN:1.6\tSO:queryname\n@SQ\tSN:chr1\tLN:10000\n"
+
+
+def mapped_rec(name=b"q1", flag=FLAG_PAIRED | FLAG_FIRST, ref_id=0, pos=100,
+               mapq=60, cigar=((("M"), 10),), seq=b"A" * 10, tags=()):
+    b = RecordBuilder().start_mapped(name, flag, ref_id, pos, mapq,
+                                     list(cigar), seq, [30] * len(seq))
+    for tag, kind, val in tags:
+        if kind == "Z":
+            b.tag_str(tag, val)
+        elif kind == "i":
+            b.tag_int(tag, val)
+    return RawRecord(b.finish())
+
+
+def unmapped_rec(name=b"q1", flag=FLAG_UNMAPPED | FLAG_PAIRED | FLAG_FIRST,
+                 tags=()):
+    b = RecordBuilder().start_unmapped(name, flag, b"ACGTACGTAC", [30] * 10)
+    for tag, kind, val in tags:
+        if kind == "Z":
+            b.tag_str(tag, val)
+        elif kind == "i":
+            b.tag_int(tag, val)
+        elif kind == "Bs":
+            b.tag_array_i16(tag, val)
+    return RawRecord(b.finish())
+
+
+def test_tag_info_consensus_expansion():
+    ti = TagInfo.from_options(reverse=["Consensus", "xx"],
+                              revcomp=["Consensus"])
+    assert "cd" in ti.reverse and "aq" in ti.reverse and "xx" in ti.reverse
+    assert ti.revcomp == {"ac", "bc"}
+
+
+def test_fix_mate_info_both_mapped():
+    r1 = mapped_rec(flag=FLAG_PAIRED | FLAG_FIRST, pos=100,
+                    cigar=[("M", 10)], tags=[(b"AS", "i", 50)])
+    r2 = mapped_rec(flag=FLAG_PAIRED | FLAG_LAST | FLAG_REVERSE, pos=200,
+                    cigar=[("M", 10)], tags=[(b"AS", "i", 40)])
+    t = MappedTemplate.from_records(b"q1", [r1, r2])
+    fix_mate_info(t)
+    out1, out2 = RawRecord(bytes(t.bufs[0])), RawRecord(bytes(t.bufs[1]))
+    assert out1.next_ref_id == 0 and out1.next_pos == 200
+    assert out2.next_ref_id == 0 and out2.next_pos == 100
+    assert out1.flag & FLAG_MATE_REVERSE
+    assert not out2.flag & FLAG_MATE_REVERSE
+    assert out1.get_int(b"MQ") == 60
+    assert out1.get_str(b"MC") == "10M"
+    assert out1.get_int(b"ms") == 40 and out2.get_int(b"ms") == 50
+    # TLEN: R1 fwd 5'=101, R2 rev 5'=210 -> 110 / -110
+    assert out1.tlen == 110 and out2.tlen == -110
+
+
+def test_fix_mate_info_one_unmapped():
+    r1 = mapped_rec(flag=FLAG_PAIRED | FLAG_FIRST | FLAG_MATE_UNMAPPED,
+                    pos=500)
+    r2 = unmapped_rec(flag=FLAG_UNMAPPED | FLAG_PAIRED | FLAG_LAST)
+    t = MappedTemplate.from_records(b"q1", [r1, r2])
+    fix_mate_info(t)
+    out1, out2 = RawRecord(bytes(t.bufs[0])), RawRecord(bytes(t.bufs[1]))
+    # unmapped mate placed at the mapped read's coordinates
+    assert out2.ref_id == 0 and out2.pos == 500
+    assert out2.next_ref_id == 0 and out2.next_pos == 500
+    assert out2.get_int(b"MQ") == 60 and out2.get_str(b"MC") == "10M"
+    assert out1.flag & FLAG_MATE_UNMAPPED
+    assert out1.find_tag(b"MC") is None
+    assert out1.tlen == 0 and out2.tlen == 0
+
+
+def test_supplementals_get_mate_of_opposite_primary():
+    r1 = mapped_rec(flag=FLAG_PAIRED | FLAG_FIRST, pos=100)
+    r2 = mapped_rec(flag=FLAG_PAIRED | FLAG_LAST, pos=300)
+    supp = mapped_rec(flag=FLAG_PAIRED | FLAG_FIRST | FLAG_SUPPLEMENTARY,
+                      pos=5000)
+    t = MappedTemplate.from_records(b"q1", [r1, r2, supp])
+    fix_mate_info(t)
+    out = RawRecord(bytes(t.bufs[2]))
+    assert out.next_pos == 300  # points at primary R2
+    assert out.get_str(b"MC") == "10M"
+
+
+def test_tc_tags_on_secondaries_only():
+    r1 = mapped_rec(flag=FLAG_PAIRED | FLAG_FIRST, pos=100,
+                    cigar=[("S", 2), ("M", 8)])
+    r2 = mapped_rec(flag=FLAG_PAIRED | FLAG_LAST | FLAG_REVERSE, pos=300,
+                    cigar=[("M", 10)])
+    supp = mapped_rec(flag=FLAG_PAIRED | FLAG_FIRST | FLAG_SUPPLEMENTARY,
+                      pos=5000)
+    t = MappedTemplate.from_records(b"q1", [r1, r2, supp])
+    add_template_coordinate_tags(t)
+    assert RawRecord(bytes(t.bufs[0])).find_tag(b"tc") is None
+    got = RawRecord(bytes(t.bufs[2])).find_tag(b"tc")
+    assert got is not None and got[0] == "B"
+    # R1 fwd: unclipped start = 100-2 = 98; R2 rev: unclipped end = 309
+    assert list(got[1]) == [0, 98, 0, 0, 309, 1]
+
+
+def test_merge_template_tag_transfer_and_revcomp():
+    u = unmapped_rec(tags=[(b"RX", "Z", b"ACGT"), (b"ac", "Z", b"AACC"),
+                           (b"cd", "Bs", [1, 2, 3, 4])],
+                     flag=FLAG_UNMAPPED)  # unpaired fragment
+    pos_rec = mapped_rec(name=b"q1", flag=0, pos=100,
+                         tags=[(b"XX", "Z", b"drop"), (b"AS", "i", 1000)])
+    t = MappedTemplate.from_records(b"q1", [pos_rec])
+    ti = TagInfo.from_options(remove=["XX"], reverse=["Consensus"],
+                              revcomp=["Consensus"])
+    merge_template([u], t, ti)
+    out = RawRecord(bytes(t.bufs[0]))
+    assert out.get_str(b"RX") == "ACGT"
+    assert out.get_str(b"ac") == "AACC"  # positive strand: untouched
+    assert out.find_tag(b"XX") is None
+    # AS normalized to smallest signed type that fits 1000 -> 's'
+    assert out.find_tag(b"AS")[0] == "s" and out.find_tag(b"AS")[1] == 1000
+
+    neg_rec = mapped_rec(name=b"q1", flag=FLAG_REVERSE, pos=100)
+    t2 = MappedTemplate.from_records(b"q1", [neg_rec])
+    merge_template([u], t2, ti)
+    out2 = RawRecord(bytes(t2.bufs[0]))
+    assert out2.get_str(b"ac") == "GGTT"  # revcomp of AACC
+    assert list(out2.find_tag(b"cd")[1]) == [4, 3, 2, 1]
+
+
+def test_merge_transfers_qc_fail():
+    u = unmapped_rec(flag=FLAG_UNMAPPED | FLAG_QC_FAIL)
+    m = mapped_rec(name=b"q1", flag=0)
+    t = MappedTemplate.from_records(b"q1", [m])
+    merge_template([u], t, TagInfo())
+    assert RawRecord(bytes(t.bufs[0])).flag & FLAG_QC_FAIL
+
+
+def _write(path, records, text=QG_HEADER):
+    header = BamHeader(text=text, ref_names=["chr1"], ref_lengths=[10000])
+    with BamWriter(path, header) as w:
+        for r in records:
+            w.write_record_bytes(r.data)
+
+
+def test_zipper_cli_end_to_end(tmp_path):
+    from fgumi_tpu.cli import main
+    unmapped = [
+        unmapped_rec(name=b"q1", flag=FLAG_UNMAPPED | FLAG_PAIRED | FLAG_FIRST,
+                     tags=[(b"RX", "Z", b"AAAA")]),
+        unmapped_rec(name=b"q1", flag=FLAG_UNMAPPED | FLAG_PAIRED | FLAG_LAST,
+                     tags=[(b"RX", "Z", b"AAAA")]),
+        unmapped_rec(name=b"q2", flag=FLAG_UNMAPPED,
+                     tags=[(b"RX", "Z", b"CCCC")]),
+    ]
+    mapped = [
+        mapped_rec(name=b"q1", flag=FLAG_PAIRED | FLAG_FIRST, pos=100),
+        mapped_rec(name=b"q1", flag=FLAG_PAIRED | FLAG_LAST | FLAG_REVERSE,
+                   pos=200),
+        mapped_rec(name=b"q2", flag=0, pos=400),
+    ]
+    ub, mb = str(tmp_path / "u.bam"), str(tmp_path / "m.bam")
+    out = str(tmp_path / "out.bam")
+    _write(ub, unmapped, text="@HD\tVN:1.6\tSO:queryname\n")
+    _write(mb, mapped)
+    rc = main(["zipper", "-i", mb, "-u", ub, "-o", out,
+               "--tags-to-reverse", "Consensus",
+               "--tags-to-revcomp", "Consensus"])
+    assert rc == 0
+    with BamReader(out) as r:
+        recs = list(r)
+    assert len(recs) == 3
+    assert all(rec.get_str(b"RX") for rec in recs)
+    assert recs[0].get_str(b"RX") == "AAAA"
+    assert recs[2].get_str(b"RX") == "CCCC"
+    assert recs[0].next_pos == 200  # mate info fixed
+
+
+def test_zipper_missing_read_errors(tmp_path):
+    from fgumi_tpu.cli import main
+    ub, mb = str(tmp_path / "u.bam"), str(tmp_path / "m.bam")
+    out = str(tmp_path / "out.bam")
+    _write(ub, [unmapped_rec(name=b"q1", flag=FLAG_UNMAPPED),
+                unmapped_rec(name=b"q2", flag=FLAG_UNMAPPED)],
+           text="@HD\tVN:1.6\tSO:queryname\n")
+    _write(mb, [mapped_rec(name=b"q1", flag=0)])
+    assert main(["zipper", "-i", mb, "-u", ub, "-o", out]) == 2
+    # with --exclude-missing-reads the dropped read is skipped
+    assert main(["zipper", "-i", mb, "-u", ub, "-o", out,
+                 "--exclude-missing-reads"]) == 0
+    with BamReader(out) as r:
+        assert [rec.name for rec in r] == [b"q1"]

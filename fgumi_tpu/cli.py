@@ -496,6 +496,39 @@ def _header_with_pg(header, command_line):
                      ref_names=header.ref_names, ref_lengths=header.ref_lengths)
 
 
+def _merge_zipper_headers(mapped, unmapped):
+    """Mapped header plus @RG/@PG/@CO lines only the unmapped header carries
+    (build_output_header, zipper.rs:232-278): the aligner often drops the @RG
+    written by extract, which downstream library lookups need."""
+    from .io.bam import BamHeader
+
+    def ids(lines, kind):
+        out = set()
+        for line in lines:
+            if line.startswith(kind):
+                fields = dict(f.split(":", 1) for f in line.split("\t")[1:] if ":" in f)
+                if "ID" in fields:
+                    out.add(fields["ID"])
+        return out
+
+    mapped_lines = mapped.text.splitlines()
+    extra = []
+    for kind in ("@RG", "@PG"):
+        have = ids(mapped_lines, kind)
+        for line in unmapped.text.splitlines():
+            if line.startswith(kind):
+                fields = dict(f.split(":", 1) for f in line.split("\t")[1:] if ":" in f)
+                if fields.get("ID") not in have:
+                    extra.append(line)
+    mapped_co = {l for l in mapped_lines if l.startswith("@CO")}
+    extra.extend(l for l in unmapped.text.splitlines()
+                 if l.startswith("@CO") and l not in mapped_co)
+    if not extra:
+        return mapped
+    return BamHeader(text="\n".join(mapped_lines + extra) + "\n",
+                     ref_names=mapped.ref_names, ref_lengths=mapped.ref_lengths)
+
+
 def _add_zipper(sub):
     p = sub.add_parser("zipper", help="Zip unmapped BAM with aligned BAM")
     p.add_argument("-i", "--input", required=True,
@@ -535,9 +568,11 @@ def cmd_zipper(args):
                         "%s input (@HD must advertise SO:queryname or "
                         "GO:query)", name)
                     return 2
-            out_header = _header_with_pg(mapped.header, " ".join(sys.argv))
+            out_header = _header_with_pg(
+                _merge_zipper_headers(mapped.header, unmapped.header),
+                " ".join(sys.argv))
             with BamWriter(args.output, out_header) as writer:
-                n_templates, n_records = run_zipper(
+                n_templates, n_records, n_missing = run_zipper(
                     mapped, unmapped, writer, tag_info,
                     skip_tc_tags=args.skip_tc_tags,
                     exclude_missing_reads=args.exclude_missing_reads)
@@ -547,6 +582,10 @@ def cmd_zipper(args):
     dt = time.monotonic() - t0
     log.info("zipper: %d templates (%d records) in %.2fs (%.0f rec/s)",
              n_templates, n_records, dt, n_records / dt if dt else 0)
+    if n_missing:
+        verb = "excluded" if args.exclude_missing_reads else "passed through"
+        log.info("zipper: %d templates not present in the aligned BAM (%s)",
+                 n_missing, verb)
     return 0
 
 
@@ -752,6 +791,85 @@ def cmd_simulate_mapped(args):
     return 0
 
 
+def _add_dedup(sub):
+    p = sub.add_parser("dedup", help="Mark or remove PCR duplicates using UMIs")
+    p.add_argument("-i", "--input", required=True,
+                   help="template-coordinate sorted BAM (zipper + sort)")
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("-m", "--metrics", default=None, help="dedup metrics TSV")
+    p.add_argument("-H", "--family-size-histogram", default=None)
+    p.add_argument("-r", "--remove-duplicates", action="store_true",
+                   help="drop duplicates instead of setting the 0x400 flag")
+    p.add_argument("-q", "--min-map-q", type=int, default=0)
+    p.add_argument("-n", "--include-non-pf-reads", action="store_true")
+    p.add_argument("--include-unmapped", action="store_true",
+                   help="emit no-mapped-read templates untouched instead of dropping")
+    p.add_argument("-s", "--strategy", default="adjacency",
+                   choices=["identity", "edit", "adjacency", "paired"])
+    p.add_argument("-e", "--edits", type=int, default=1)
+    p.add_argument("-l", "--min-umi-length", type=int, default=None)
+    p.add_argument("--no-umi", action="store_true",
+                   help="dedup by position only, orientation-agnostic (Picard-like)")
+    p.set_defaults(func=cmd_dedup)
+
+
+def cmd_dedup(args):
+    from .commands.dedup import (run_dedup, write_family_size_histogram,
+                                 write_metrics)
+    from .core.template import is_template_coordinate_sorted
+    from .io.bam import BamReader, BamWriter
+
+    # argument-combination validation before the output file is touched
+    if args.strategy == "paired" and args.no_umi:
+        log.error("--no-umi cannot be used with --strategy paired")
+        return 2
+    if args.strategy == "paired" and args.min_umi_length is not None:
+        log.error("Paired strategy cannot be used with --min-umi-length")
+        return 2
+
+    t0 = time.monotonic()
+    try:
+        with BamReader(args.input) as reader:
+            hdr_text = reader.header.text
+            if not is_template_coordinate_sorted(hdr_text):
+                log.error(
+                    "dedup requires template-coordinate sorted input (header must "
+                    "advertise SS:template-coordinate). Prepare with:\n"
+                    "  fgumi-tpu zipper ... | fgumi-tpu sort --order template-coordinate")
+                return 2
+            out_header = _header_with_pg(reader.header, " ".join(sys.argv))
+            with BamWriter(args.output, out_header) as writer:
+                metrics, family_sizes = run_dedup(
+                    reader, writer, strategy=args.strategy, edits=args.edits,
+                    min_mapq=args.min_map_q,
+                    include_non_pf=args.include_non_pf_reads,
+                    min_umi_length=args.min_umi_length, no_umi=args.no_umi,
+                    include_unmapped=args.include_unmapped,
+                    remove_duplicates=args.remove_duplicates)
+    except (ValueError, OSError) as e:
+        log.error("%s", e)
+        return 2
+    dt = time.monotonic() - t0
+    log.info("dedup: %d templates (%d unique, %d duplicate, rate %.4f), "
+             "%d reads in %.2fs",
+             metrics.total_templates, metrics.unique_templates,
+             metrics.duplicate_templates, metrics.duplicate_rate(),
+             metrics.total_reads, dt)
+    dropped = metrics.filter.as_dict()
+    dropped.pop("total_templates", None)
+    dropped.pop("accepted", None)
+    if dropped:
+        log.info("dedup: templates dropped by filtering: %s", dropped)
+    if metrics.missing_tc_tag:
+        log.warning("%d secondary/supplementary reads missing the tc tag "
+                    "(run zipper before sort)", metrics.missing_tc_tag)
+    if args.metrics:
+        write_metrics(metrics, args.metrics)
+    if args.family_size_histogram:
+        write_family_size_histogram(family_sizes, args.family_size_histogram)
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="fgumi-tpu",
@@ -765,6 +883,7 @@ def main(argv=None):
     _add_duplex(sub)
     _add_filter(sub)
     _add_group(sub)
+    _add_dedup(sub)
     _add_sort(sub)
     _add_merge(sub)
     _add_fastq(sub)

@@ -17,15 +17,17 @@ from ..core.record_edit import (append_raw_tag_entry, append_tag_i32_array,
                                 set_bin, set_flags, set_mate_pos,
                                 set_mate_ref_id, set_pos, set_ref_id, set_tlen,
                                 update_tag_i32, update_tag_str)
-from ..core.tag_reversal import revcomp_tag_value_at, reverse_tag_value_at
-from ..core.template import iter_name_groups
+from ..core.tag_reversal import (TAGS_TO_REVERSE, TAGS_TO_REVERSE_COMPLEMENT,
+                                 revcomp_tag_value_at, reverse_tag_value_at)
+from ..core.template import iter_name_groups, unclipped_5prime
 from ..io.bam import (FLAG_FIRST, FLAG_MATE_REVERSE, FLAG_MATE_UNMAPPED,
                       FLAG_PAIRED, FLAG_QC_FAIL, FLAG_REVERSE, FLAG_SECONDARY,
                       FLAG_SUPPLEMENTARY, FLAG_UNMAPPED, RawRecord)
 
-# The "Consensus" named tag set (umi TagSets; tag_reversal.rs:88-90).
-CONSENSUS_REVERSE_TAGS = ("cd", "ce", "ad", "ae", "bd", "be", "aq", "bq")
-CONSENSUS_REVCOMP_TAGS = ("ac", "bc")
+# The "Consensus" named tag set (umi TagSets; tag_reversal.rs:88-90), derived
+# from the canonical byte constants in core.tag_reversal.
+CONSENSUS_REVERSE_TAGS = tuple(t.decode() for t in TAGS_TO_REVERSE)
+CONSENSUS_REVCOMP_TAGS = tuple(t.decode() for t in TAGS_TO_REVERSE_COMPLEMENT)
 
 
 @dataclass
@@ -193,12 +195,6 @@ def fix_mate_info(t: MappedTemplate):
                 update_tag_i32(b, b"ms", int(p_as))
 
 
-def _unclipped_5prime(rec: RawRecord) -> int:
-    if rec.flag & FLAG_REVERSE:
-        return rec.unclipped_end()
-    return rec.unclipped_start()
-
-
 def add_template_coordinate_tags(t: MappedTemplate):
     """tc tag (B:i [tid1,pos1,neg1,tid2,pos2,neg2], lower coordinate first) on
     secondary/supplementary records only (zipper.rs:281-357)."""
@@ -212,7 +208,7 @@ def add_template_coordinate_tags(t: MappedTemplate):
         rec = _rec(t.bufs[i])
         if rec.flag & FLAG_UNMAPPED:
             return None
-        return (rec.ref_id, _unclipped_5prime(rec),
+        return (rec.ref_id, unclipped_5prime(rec),
                 1 if rec.flag & FLAG_REVERSE else 0)
 
     i1, i2 = info(t.r1), info(t.r2)
@@ -289,26 +285,30 @@ def merge_template(unmapped_records, t: MappedTemplate, tag_info: TagInfo,
 
 def run_zipper(mapped_reader, unmapped_reader, writer, tag_info: TagInfo, *,
                skip_tc_tags: bool = False, exclude_missing_reads: bool = False):
-    """Lockstep merge by QNAME. Returns (templates, records_out).
+    """Lockstep merge by QNAME. Returns (templates, records_out, missing).
 
     Both inputs must share queryname ordering. An unmapped template absent from
-    the mapped BAM (aligner dropped it) is an error unless
-    exclude_missing_reads; a mapped template absent from the unmapped BAM is
-    always an error (the unmapped BAM is the source of truth).
+    the mapped BAM (aligner dropped it) is written through as unmapped records,
+    or dropped under exclude_missing_reads (zipper.rs:896-928); a mapped
+    template absent from the unmapped BAM is always an error (the unmapped BAM
+    is the source of truth).
     """
     mapped_groups = iter_name_groups(mapped_reader)
     n_templates = 0
     n_records = 0
+    n_missing = 0
     mapped_item = next(mapped_groups, None)
     for u_name, u_records in iter_name_groups(unmapped_reader):
         if mapped_item is None or mapped_item[0] != u_name:
-            if exclude_missing_reads:
-                continue
-            raise ValueError(
-                f"read '{u_name.decode(errors='replace')}' present in the "
-                "unmapped BAM but not next in the mapped BAM; inputs must "
-                "share queryname ordering (use --exclude-missing-reads to "
-                "drop reads the aligner omitted)")
+            # aligner omitted this template: write it through as unmapped
+            # records (zipper.rs:896-928), or drop under exclude_missing_reads
+            n_missing += 1
+            if not exclude_missing_reads:
+                for rec in u_records:
+                    writer.write_record_bytes(rec.data)
+                    n_records += 1
+                n_templates += 1
+            continue
         t = MappedTemplate.from_records(mapped_item[0], mapped_item[1])
         merge_template(u_records, t, tag_info, skip_tc_tags)
         for buf in t.bufs:
@@ -321,4 +321,4 @@ def run_zipper(mapped_reader, unmapped_reader, writer, tag_info: TagInfo, *,
             f"read '{mapped_item[0].decode(errors='replace')}' present in the "
             "mapped BAM but not in the unmapped BAM; inputs must share "
             "queryname ordering")
-    return n_templates, n_records
+    return n_templates, n_records, n_missing

@@ -99,6 +99,9 @@ def normalize_int_tag_to_smallest_signed(buf: bytearray, tag: bytes):
     if got is None or got[0] not in "cCsSiI":
         return
     value = int(got[1])
+    if not -(2**31) <= value < 2**31:
+        # out of i32 range: leave the tag unchanged (tags.rs:995-997)
+        return
     remove_tag(buf, tag)
     if -128 <= value <= 127:
         buf += tag + b"c" + struct.pack("<b", value)

@@ -74,18 +74,7 @@ def iter_name_groups(records):
 
 def iter_templates(records):
     """Yield Templates from query-grouped records (consecutive same QNAME)."""
-    current_name = None
-    bucket = []
-    for rec in records:
-        name = rec.name
-        if name != current_name:
-            if bucket:
-                yield classify(bucket)
-            current_name = name
-            bucket = [rec]
-        else:
-            bucket.append(rec)
-    if bucket:
+    for _name, bucket in iter_name_groups(records):
         yield classify(bucket)
 
 

@@ -1,0 +1,131 @@
+"""`dedup` command E2E tests."""
+
+import pytest
+
+from fgumi_tpu.cli import main as cli_main
+from fgumi_tpu.io.bam import BamReader, FLAG_DUPLICATE, FLAG_FIRST
+
+
+@pytest.fixture(scope="module")
+def mapped_bam(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("dd") / "mapped.bam")
+    rc = cli_main(["simulate", "mapped-reads", "-o", path, "--num-families", "20",
+                   "--family-size", "4", "--umi-error-rate", "0.0", "--seed", "5"])
+    assert rc == 0
+    return path
+
+
+def test_dedup_marks_one_representative_per_family(mapped_bam, tmp_path):
+    out = str(tmp_path / "d.bam")
+    assert cli_main(["dedup", "-i", mapped_bam, "-o", out]) == 0
+    fams = {}
+    with BamReader(out) as r:
+        for rec in r:
+            if not rec.flag & FLAG_FIRST:
+                continue
+            assert rec.get_str(b"MI") is not None
+            fam = rec.name.decode().split(":")[0]
+            fams.setdefault(fam, []).append(bool(rec.flag & FLAG_DUPLICATE))
+    assert len(fams) == 20
+    for fam, dups in fams.items():
+        assert len(dups) == 4
+        assert dups.count(False) == 1, fam  # exactly one representative
+
+
+def test_dedup_mates_share_duplicate_state(mapped_bam, tmp_path):
+    out = str(tmp_path / "d.bam")
+    cli_main(["dedup", "-i", mapped_bam, "-o", out])
+    by_name = {}
+    with BamReader(out) as r:
+        for rec in r:
+            by_name.setdefault(rec.name, set()).add(bool(rec.flag & FLAG_DUPLICATE))
+    for name, states in by_name.items():
+        assert len(states) == 1, name
+
+
+def test_dedup_remove_duplicates(mapped_bam, tmp_path):
+    out = str(tmp_path / "rm.bam")
+    assert cli_main(["dedup", "-i", mapped_bam, "-o", out,
+                     "--remove-duplicates"]) == 0
+    with BamReader(out) as r:
+        recs = list(r)
+    assert len(recs) == 40  # 20 molecules x R1/R2
+    assert all(not rec.flag & FLAG_DUPLICATE for rec in recs)
+
+
+def test_dedup_metrics_and_histogram(mapped_bam, tmp_path):
+    out = str(tmp_path / "m.bam")
+    mpath = str(tmp_path / "m.tsv")
+    hpath = str(tmp_path / "h.tsv")
+    assert cli_main(["dedup", "-i", mapped_bam, "-o", out, "-m", mpath,
+                     "-H", hpath]) == 0
+    header, row = open(mpath).read().strip().splitlines()
+    m = dict(zip(header.split("\t"), row.split("\t")))
+    assert int(m["total_templates"]) == 80
+    assert int(m["unique_templates"]) == 20
+    assert int(m["duplicate_templates"]) == 60
+    assert float(m["duplicate_rate"]) == 0.75
+    assert int(m["total_reads"]) == 160
+    assert int(m["duplicate_reads"]) == 120
+    lines = open(hpath).read().strip().splitlines()
+    assert lines[0] == "family_size\tcount"
+    sizes = dict(tuple(map(int, l.split("\t"))) for l in lines[1:])
+    assert sizes == {4: 20}
+
+
+def test_dedup_deterministic(mapped_bam, tmp_path):
+    o1, o2 = str(tmp_path / "d1.bam"), str(tmp_path / "d2.bam")
+    cli_main(["dedup", "-i", mapped_bam, "-o", o1])
+    cli_main(["dedup", "-i", mapped_bam, "-o", o2])
+    with BamReader(o1) as r1, BamReader(o2) as r2:
+        assert [r.data for r in r1] == [r.data for r in r2]
+
+
+def test_dedup_requires_template_coordinate_header(tmp_path):
+    sim = str(tmp_path / "plain.bam")
+    cli_main(["simulate", "grouped-reads", "-o", sim, "--num-families", "2"])
+    out = str(tmp_path / "never.bam")
+    assert cli_main(["dedup", "-i", sim, "-o", out]) == 2
+
+
+def test_dedup_no_umi_groups_by_position(mapped_bam, tmp_path):
+    out = str(tmp_path / "nu.bam")
+    assert cli_main(["dedup", "-i", mapped_bam, "-o", out, "--no-umi"]) == 0
+    fams = {}
+    with BamReader(out) as r:
+        for rec in r:
+            if rec.flag & FLAG_FIRST:
+                fam = rec.name.decode().split(":")[0]
+                fams.setdefault(fam, []).append(bool(rec.flag & FLAG_DUPLICATE))
+    # families are at distinct positions, so position-only grouping still
+    # keeps exactly one representative per family
+    for fam, dups in fams.items():
+        assert dups.count(False) == 1, fam
+
+
+def test_dedup_no_umi_rejects_paired(mapped_bam, tmp_path):
+    out = str(tmp_path / "x.bam")
+    assert cli_main(["dedup", "-i", mapped_bam, "-o", out, "--no-umi",
+                     "--strategy", "paired"]) == 2
+
+
+def test_dedup_representative_has_best_quality(tmp_path):
+    """The kept template must be the one with the highest summed base quality."""
+    from fgumi_tpu.commands.dedup import score_template
+    from fgumi_tpu.core.template import iter_templates
+    sim = str(tmp_path / "q.bam")
+    cli_main(["simulate", "mapped-reads", "-o", sim, "--num-families", "5",
+              "--family-size", "3", "--umi-error-rate", "0.0", "--seed", "9"])
+    out = str(tmp_path / "q_out.bam")
+    cli_main(["dedup", "-i", sim, "-o", out])
+    with BamReader(out) as r:
+        fams = {}
+        for t in iter_templates(r):
+            fam = t.name.decode().split(":")[0]
+            fams.setdefault(fam, []).append(t)
+    for fam, templates in fams.items():
+        best = max(score_template(t) for t in templates)
+        for t in templates:
+            is_dup = bool(t.r1.flag & FLAG_DUPLICATE)
+            if score_template(t) < best:
+                assert is_dup, t.name

@@ -187,7 +187,9 @@ def test_zipper_cli_end_to_end(tmp_path):
     assert recs[0].next_pos == 200  # mate info fixed
 
 
-def test_zipper_missing_read_errors(tmp_path):
+def test_zipper_missing_read_passthrough(tmp_path):
+    """Templates the aligner omitted are written through as unmapped records
+    by default (zipper.rs:896-928); --exclude-missing-reads drops them."""
     from fgumi_tpu.cli import main
     ub, mb = str(tmp_path / "u.bam"), str(tmp_path / "m.bam")
     out = str(tmp_path / "out.bam")
@@ -195,8 +197,12 @@ def test_zipper_missing_read_errors(tmp_path):
                 unmapped_rec(name=b"q2", flag=FLAG_UNMAPPED)],
            text="@HD\tVN:1.6\tSO:queryname\n")
     _write(mb, [mapped_rec(name=b"q1", flag=0)])
-    assert main(["zipper", "-i", mb, "-u", ub, "-o", out]) == 2
-    # with --exclude-missing-reads the dropped read is skipped
+    assert main(["zipper", "-i", mb, "-u", ub, "-o", out]) == 0
+    with BamReader(out) as r:
+        recs = list(r)
+    assert [rec.name for rec in recs] == [b"q1", b"q2"]
+    assert recs[1].flag & FLAG_UNMAPPED
+    # with --exclude-missing-reads the omitted template is skipped
     assert main(["zipper", "-i", mb, "-u", ub, "-o", out,
                  "--exclude-missing-reads"]) == 0
     with BamReader(out) as r:

@@ -791,6 +791,96 @@ def cmd_simulate_mapped(args):
     return 0
 
 
+def _add_correct(sub):
+    p = sub.add_parser("correct", help="Correct UMIs to a fixed whitelist")
+    p.add_argument("-i", "--input", required=True)
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("-u", "--umis", nargs="*", default=[],
+                   help="whitelist UMI sequences")
+    p.add_argument("-U", "--umi-files", nargs="*", default=[],
+                   help="files with one whitelist UMI per line")
+    p.add_argument("-m", "--metrics", default=None, help="per-UMI metrics TSV")
+    p.add_argument("-r", "--rejects", default=None,
+                   help="BAM for records whose UMI could not be corrected")
+    p.add_argument("--target", choices=["umi", "barcode"], default="umi",
+                   help="umi: RX (original in OX); barcode: BC (original in ob)")
+    p.add_argument("--max-mismatches", type=int, default=2)
+    p.add_argument("--min-distance", type=int, default=2, dest="min_distance_diff")
+    p.add_argument("--dont-store-original", action="store_true")
+    p.add_argument("--cache-size", type=int, default=100_000)
+    p.add_argument("--min-corrected", type=float, default=None,
+                   help="fail if kept/total falls below this fraction")
+    p.add_argument("--revcomp", action="store_true",
+                   help="reverse-complement observed UMIs before matching")
+    p.set_defaults(func=cmd_correct)
+
+
+def cmd_correct(args):
+    from .commands.correct import (UmiMatcher, find_umi_pairs_within_distance,
+                                   load_umi_sequences, run_correct,
+                                   write_correction_metrics)
+    from .io.bam import BamReader, BamWriter
+
+    if args.min_corrected is not None and not 0.0 <= args.min_corrected <= 1.0:
+        log.error("--min-corrected must be between 0 and 1")
+        return 2
+    try:
+        umis, umi_length = load_umi_sequences(args.umis, args.umi_files)
+    except (ValueError, OSError) as e:
+        log.error("%s", e)
+        return 2
+    log.info("correct: loaded %d whitelist UMIs of length %d", len(umis), umi_length)
+    # ambiguity warning (fgbio uses min_distance_diff - 1; 0 reports nothing)
+    if args.min_distance_diff > 0:
+        pairs = find_umi_pairs_within_distance(umis, args.min_distance_diff - 1)
+        for u1, u2, d in pairs:
+            log.warning("whitelist UMIs within min-distance-diff: %s <-> %s "
+                        "(distance %d) — may be ambiguous and fail to match",
+                        u1, u2, d)
+    matcher = UmiMatcher(umis, args.max_mismatches, args.min_distance_diff,
+                         args.cache_size)
+    t0 = time.monotonic()
+    try:
+        with BamReader(args.input) as reader:
+            out_header = _header_with_pg(reader.header, " ".join(sys.argv))
+            import contextlib
+            with contextlib.ExitStack() as stack:
+                writer = stack.enter_context(BamWriter(args.output, out_header))
+                rejects_writer = None
+                if args.rejects:
+                    rejects_writer = stack.enter_context(
+                        BamWriter(args.rejects, out_header))
+                stats = run_correct(
+                    reader, writer, matcher, umi_length, target=args.target,
+                    revcomp=args.revcomp,
+                    store_original=not args.dont_store_original,
+                    rejects_writer=rejects_writer)
+    except (ValueError, OSError) as e:
+        log.error("%s", e)
+        return 2
+    dt = time.monotonic() - t0
+    rejected = stats.missing_umis + stats.wrong_length + stats.mismatched
+    total = stats.records_written + rejected
+    log.info("correct: read %d records; kept %d, rejected %d "
+             "(%d missing, %d wrong length, %d mismatched) in %.2fs",
+             total, stats.records_written, rejected, stats.missing_umis,
+             stats.wrong_length, stats.mismatched, dt)
+    if stats.missing_umis or stats.wrong_length:
+        log.error("%d records missing UMI attributes; %d had UMIs of "
+                  "unexpected length", stats.missing_umis, stats.wrong_length)
+    if args.metrics:
+        write_correction_metrics(stats, umi_length, args.metrics)
+    if args.min_corrected is not None and total:
+        ratio = stats.records_written / total
+        if ratio < args.min_corrected:
+            log.error("Final ratio of reads kept / total was %.2f (minimum "
+                      "%.2f); this could indicate a mismatch between library "
+                      "preparation and the provided UMI whitelist",
+                      ratio, args.min_corrected)
+            return 1
+    return 0
+
+
 def _add_dedup(sub):
     p = sub.add_parser("dedup", help="Mark or remove PCR duplicates using UMIs")
     p.add_argument("-i", "--input", required=True,
@@ -878,6 +968,7 @@ def main(argv=None):
     parser.add_argument("-v", "--verbose", action="store_true")
     sub = parser.add_subparsers(dest="command", required=True)
     _add_extract(sub)
+    _add_correct(sub)
     _add_zipper(sub)
     _add_simplex(sub)
     _add_duplex(sub)

@@ -1,0 +1,151 @@
+"""`correct` command tests: matching semantics + CLI E2E."""
+
+import pytest
+
+from fgumi_tpu.cli import main as cli_main
+from fgumi_tpu.commands.correct import (UmiMatcher, compute_template_correction,
+                                        load_umi_sequences)
+from fgumi_tpu.io.bam import (BamHeader, BamReader, BamWriter, FLAG_UNMAPPED,
+                              RecordBuilder)
+
+
+def matcher(umis, max_mismatches=2, min_distance_diff=2):
+    return UmiMatcher(list(umis), max_mismatches, min_distance_diff)
+
+
+def test_exact_match():
+    m = matcher(["AAAAAA", "CCCCCC"])
+    assert m.find_best(b"AAAAAA") == (True, "AAAAAA", 0)
+
+
+def test_correctable_within_mismatches():
+    m = matcher(["AAAAAA", "CCCCCC"])
+    matched, umi, mm = m.find_best(b"AAAATA")
+    assert (matched, umi, mm) == (True, "AAAAAA", 1)
+
+
+def test_too_many_mismatches_rejected():
+    m = matcher(["AAAAAA", "CCCCCC"])
+    matched, _, mm = m.find_best(b"AATTTA")
+    assert not matched and mm == 3
+
+
+def test_ambiguous_rejected_by_min_distance():
+    # best=1 (AAAAAT), second=2 (AAAAAA): diff 1 < min_distance_diff 2
+    m = matcher(["AAAAAA", "AAAATT"])
+    matched, _, _ = m.find_best(b"AAAATA")
+    assert not matched
+
+
+def test_lowercase_observed_uppercased():
+    m = matcher(["AAAAAA"], min_distance_diff=1)
+    c = compute_template_correction("aaaaaa", 6, False, m)
+    assert c.matched and c.corrected_umi == "AAAAAA"
+    assert not c.has_mismatches
+
+
+def test_dual_umi_segments_and_revcomp():
+    m = matcher(["AAAACC", "GGGTTT"], min_distance_diff=1)
+    c = compute_template_correction("AAAACC-GGGTTT", 6, False, m)
+    assert c.matched and c.corrected_umi == "AAAACC-GGGTTT"
+    # opposite-strand observation of true "AAAACC-GGGTTT" reads as the full
+    # revcomp: RC("GGGTTT")-RC("AAAACC") = "AAACCC-GGTTTT"; --revcomp undoes
+    # it (RC each segment, reverse segment order) before matching
+    c2 = compute_template_correction("AAACCC-GGTTTT", 6, True, m)
+    assert c2.matched and c2.corrected_umi == "AAAACC-GGGTTT"
+    assert c2.needs_correction  # revcomp always rewrites the tag
+
+
+def test_wrong_length_rejected():
+    m = matcher(["AAAAAA"])
+    c = compute_template_correction("AAAA", 6, False, m)
+    assert not c.matched and c.rejection == "wrong_length"
+    assert c.matches == []  # wrong-length templates credit no metrics
+
+
+def test_load_umi_sequences_uniform_length(tmp_path):
+    f = tmp_path / "wl.txt"
+    f.write_text("acgtaa\nTTTTTT\n\n")
+    seqs, n = load_umi_sequences(["GGGGGG"], [str(f)])
+    assert seqs == ["ACGTAA", "GGGGGG", "TTTTTT"] and n == 6
+    with pytest.raises(ValueError):
+        load_umi_sequences(["AAAA", "AAAAAA"])
+    with pytest.raises(ValueError):
+        load_umi_sequences([])
+
+
+def _umi_bam(path, umis, tag=b"RX"):
+    hdr = BamHeader(text="@HD\tVN:1.6\tSO:queryname\n", ref_names=[],
+                    ref_lengths=[])
+    with BamWriter(path, hdr) as w:
+        for i, umi in enumerate(umis):
+            b = (RecordBuilder()
+                 .start_unmapped(f"q{i}".encode(), FLAG_UNMAPPED, b"ACGT",
+                                 [30, 30, 30, 30]))
+            if umi is not None:
+                b.tag_str(tag, umi.encode())
+            w.write_record_bytes(b.finish())
+
+
+def test_correct_cli_e2e(tmp_path):
+    inp = str(tmp_path / "in.bam")
+    out = str(tmp_path / "out.bam")
+    rej = str(tmp_path / "rej.bam")
+    met = str(tmp_path / "m.tsv")
+    _umi_bam(inp, ["AAAAAA", "AAAATA", "CCCCCC", "GGGGGG", None, "AAAA"])
+    rc = cli_main(["correct", "-i", inp, "-o", out, "-u", "AAAAAA", "CCCCCC",
+                   "-m", met, "-r", rej])
+    assert rc == 0
+    with BamReader(out) as r:
+        kept = {rec.name.decode(): rec for rec in r}
+    # AAAAAA exact, AAAATA corrected, CCCCCC exact; GGGGGG too far,
+    # missing UMI and wrong length rejected
+    assert sorted(kept) == ["q0", "q1", "q2"]
+    assert kept["q1"].get_str(b"RX") == "AAAAAA"
+    assert kept["q1"].get_str(b"OX") == "AAAATA"  # original stashed
+    assert kept["q0"].get_str(b"OX") is None  # perfect match untouched
+    with BamReader(rej) as r:
+        assert sorted(rec.name.decode() for rec in r) == ["q3", "q4", "q5"]
+    lines = open(met).read().strip().splitlines()
+    rows = {l.split("\t")[0]: l.split("\t") for l in lines[1:]}
+    assert rows["AAAAAA"][1] == "2"  # total matches
+    assert rows["AAAAAA"][2] == "1"  # perfect
+    assert rows["AAAAAA"][3] == "1"  # one mismatch
+    assert rows["NNNNNN"][1] == "1"  # GGGGGG credited the all-N bucket
+    assert "q3" not in kept
+
+
+def test_correct_min_corrected_fails(tmp_path):
+    inp = str(tmp_path / "in.bam")
+    out = str(tmp_path / "out.bam")
+    _umi_bam(inp, ["TTTTTT", "GGGGGG"])
+    rc = cli_main(["correct", "-i", inp, "-o", out, "-u", "AAAAAA",
+                   "--min-corrected", "0.5"])
+    assert rc == 1
+
+
+def test_correct_barcode_target(tmp_path):
+    inp = str(tmp_path / "in.bam")
+    out = str(tmp_path / "out.bam")
+    _umi_bam(inp, ["AAAATA"], tag=b"BC")
+    rc = cli_main(["correct", "-i", inp, "-o", out, "-u", "AAAAAA",
+                   "--target", "barcode"])
+    assert rc == 0
+    with BamReader(out) as r:
+        rec = next(iter(r))
+    assert rec.get_str(b"BC") == "AAAAAA"
+    assert rec.get_str(b"ob") == "AAAATA"
+
+
+def test_correct_inconsistent_template_umi_errors(tmp_path):
+    inp = str(tmp_path / "in.bam")
+    out = str(tmp_path / "out.bam")
+    hdr = BamHeader(text="@HD\tVN:1.6\tSO:queryname\n", ref_names=[],
+                    ref_lengths=[])
+    with BamWriter(inp, hdr) as w:
+        for umi in ("AAAAAA", "CCCCCC"):  # same QNAME, different UMIs
+            w.write_record_bytes(
+                RecordBuilder()
+                .start_unmapped(b"q0", FLAG_UNMAPPED, b"ACGT", [30] * 4)
+                .tag_str(b"RX", umi.encode()).finish())
+    assert cli_main(["correct", "-i", inp, "-o", out, "-u", "AAAAAA"]) == 2

@@ -611,6 +611,9 @@ def _add_filter(sub):
     p.add_argument("-s", "--require-single-strand-agreement", nargs="?",
                    const=True, default=False, type=_parse_bool)
     p.add_argument("--rejects", default=None, help="BAM for rejected reads")
+    p.add_argument("-r", "--ref", default=None,
+                   help="reference FASTA: regenerate NM/UQ/MD after masking "
+                        "(required for mapped input)")
     p.set_defaults(func=cmd_filter)
 
 
@@ -633,6 +636,10 @@ def cmd_filter(args):
         return 2
     t0 = time.monotonic()
     try:
+        reference = None
+        if args.ref:
+            from .core.reference import ReferenceReader
+            reference = ReferenceReader(args.ref)
         with BamReader(args.input) as reader:
             from .core.template import is_query_grouped
             # Template filtering needs mates adjacent; coordinate-sorted input
@@ -653,11 +660,11 @@ def cmd_filter(args):
                         reader, writer, config,
                         filter_by_template=args.filter_by_template,
                         reverse_per_base=args.reverse_per_base_tags,
-                        rejects_writer=rejects)
+                        rejects_writer=rejects, reference=reference)
             finally:
                 if rejects is not None:
                     rejects.close()
-    except (ValueError, OSError) as e:
+    except (ValueError, OSError, KeyError) as e:
         log.error("%s", e)
         return 2
     dt = time.monotonic() - t0
@@ -788,6 +795,73 @@ def cmd_simulate_mapped(args):
         umi_error_rate=args.umi_error_rate, paired_umis=args.paired_umis,
         seed=args.seed)
     log.info("simulate: wrote %d records to %s", n, args.output)
+    return 0
+
+
+def _add_clip(sub):
+    p = sub.add_parser("clip", help="Clip overlapping reads in BAM files")
+    p.add_argument("-i", "--input", required=True,
+                   help="queryname sorted/grouped BAM")
+    p.add_argument("-o", "--output", required=True)
+    p.add_argument("-r", "--reference", required=True,
+                   help="reference FASTA (for NM/UQ/MD regeneration)")
+    p.add_argument("-c", "--clipping-mode", default="hard",
+                   choices=["soft", "soft-with-mask", "hard"])
+    p.add_argument("--clip-overlapping-reads", action="store_true")
+    p.add_argument("--clip-bases-past-mate", "--clip-extending-past-mate",
+                   dest="clip_extending_past_mate", action="store_true")
+    p.add_argument("--read-one-five-prime", type=int, default=0)
+    p.add_argument("--read-one-three-prime", type=int, default=0)
+    p.add_argument("--read-two-five-prime", type=int, default=0)
+    p.add_argument("--read-two-three-prime", type=int, default=0)
+    p.add_argument("-H", "--upgrade-clipping", action="store_true",
+                   help="upgrade existing clipping to the configured mode")
+    p.add_argument("-a", "--auto-clip-attributes", action="store_true",
+                   help="hard-clip per-base tags matching read length")
+    p.add_argument("-m", "--metrics", default=None)
+    p.set_defaults(func=cmd_clip)
+
+
+def cmd_clip(args):
+    from .commands.clip import ClipParams, run_clip, write_clip_metrics
+    from .core.reference import ReferenceReader
+    from .core.template import is_query_grouped
+    from .io.bam import BamReader, BamWriter
+
+    params = ClipParams(
+        clipping_mode=args.clipping_mode,
+        clip_overlapping_reads=args.clip_overlapping_reads,
+        clip_extending_past_mate=args.clip_extending_past_mate,
+        read_one_five_prime=args.read_one_five_prime,
+        read_one_three_prime=args.read_one_three_prime,
+        read_two_five_prime=args.read_two_five_prime,
+        read_two_three_prime=args.read_two_three_prime,
+        upgrade_clipping=args.upgrade_clipping,
+        auto_clip_attributes=args.auto_clip_attributes)
+    if not params.any_clipping():
+        log.error("At least one clipping option is required")
+        return 2
+    t0 = time.monotonic()
+    try:
+        reference = ReferenceReader(args.reference)
+        with BamReader(args.input) as reader:
+            if not is_query_grouped(reader.header.text):
+                log.error("clip requires queryname sorted or query grouped "
+                          "input (@HD must advertise SO:queryname or GO:query); "
+                          "sort with `fgumi-tpu sort --order queryname` first")
+                return 2
+            out_header = _header_with_pg(reader.header, " ".join(sys.argv))
+            with BamWriter(args.output, out_header) as writer:
+                metrics = run_clip(reader, writer, reference, params)
+    except (ValueError, OSError, KeyError) as e:
+        log.error("%s", e)
+        return 2
+    dt = time.monotonic() - t0
+    log.info("clip: %d templates (%d overlap-clipped, %d extend-clipped) "
+             "in %.2fs", metrics.templates, metrics.overlap_clipped,
+             metrics.extend_clipped, dt)
+    if args.metrics:
+        write_clip_metrics(metrics, args.metrics)
     return 0
 
 
@@ -973,6 +1047,7 @@ def main(argv=None):
     _add_simplex(sub)
     _add_duplex(sub)
     _add_filter(sub)
+    _add_clip(sub)
     _add_group(sub)
     _add_dedup(sub)
     _add_sort(sub)

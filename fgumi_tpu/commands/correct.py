@@ -67,15 +67,17 @@ def load_umi_sequences(umis=(), umi_files=()):
 
 
 def find_umi_pairs_within_distance(umis, distance):
-    """All whitelist pairs within `distance` mismatches (correct.rs:1668-1683)."""
+    """All whitelist pairs within `distance` mismatches (correct.rs:1668-1683).
+    One row of the distance matrix at a time keeps memory at O(N*L) even for
+    barcode whitelists with tens of thousands of entries."""
     pairs = []
     mat = np.frombuffer("".join(umis).encode(), dtype=np.uint8)
     mat = mat.reshape(len(umis), -1)
-    dists = (mat[:, None, :] != mat[None, :, :]).sum(axis=2)
-    for i in range(len(umis)):
-        for j in range(i + 1, len(umis)):
-            if dists[i, j] <= distance:
-                pairs.append((umis[i], umis[j], int(dists[i, j])))
+    for i in range(len(umis) - 1):
+        dists = (mat[i + 1:] != mat[i][None, :]).sum(axis=1)
+        for off in np.nonzero(dists <= distance)[0]:
+            j = i + 1 + int(off)
+            pairs.append((umis[i], umis[j], int(dists[off])))
     return pairs
 
 

@@ -6,9 +6,10 @@ read-level filtering; with --filter-by-template (default) all primary records
 of a QNAME must pass or the whole template is dropped, while secondary/
 supplementary records are filtered independently (filter.rs:60-75).
 
-NM/UQ/MD regeneration against a reference FASTA is not yet wired in; like the
-reference without --ref (filter.rs:777-785), filtering MAPPED reads therefore
-fails fast, since masking would leave stale NM/UQ/MD tags.
+With --ref, NM/UQ/MD are regenerated against the reference FASTA after
+masking (filter.rs:881-883); without it, filtering MAPPED reads fails fast,
+matching the reference (filter.rs:777-785), since masking would leave stale
+NM/UQ/MD tags.
 """
 
 from collections import Counter
@@ -34,16 +35,17 @@ class FilterStats:
     rejection_reasons: Counter = field(default_factory=Counter)
 
 
-def _process_one(data: bytes, config: FilterConfig, reverse_tags: bool):
+def _process_one(data: bytes, config: FilterConfig, reverse_tags: bool,
+                 reference=None, ref_names=()):
     """Mask + judge one record. Returns (new_bytes, result_str, masked_count)."""
     buf = bytearray(data)
-    # Fail fast on mapped reads: masking would invalidate NM/UQ/MD and there is
-    # no reference-based regeneration yet (filter.rs:774-785).
+    # Fail fast on mapped reads without --ref: masking would invalidate
+    # NM/UQ/MD with no way to regenerate them (filter.rs:774-785).
     flag = int.from_bytes(buf[14:16], "little")
-    if not flag & FLAG_UNMAPPED:
+    if reference is None and not flag & FLAG_UNMAPPED:
         raise ValueError(
-            "filtering mapped reads is not supported without NM/UQ/MD "
-            "regeneration; filter unmapped consensus BAMs (pre-alignment)")
+            "--ref is required when filtering mapped reads to keep "
+            "NM/UQ/MD tags consistent")
     if reverse_tags:
         reverse_per_base_tags(buf)
     rec = RawRecord(bytes(buf))
@@ -71,15 +73,23 @@ def _process_one(data: bytes, config: FilterConfig, reverse_tags: bool):
 
     if result == PASS:
         result = no_call_check(buf, config.max_no_call_fraction)
+    if reference is not None:
+        # regenerate NM/UQ/MD after masking (filter.rs:881-883)
+        from ..core.alignment_tags import regenerate_alignment_tags
+        from ..core.clipper import MutableRecord
+        m = MutableRecord.from_raw(RawRecord(bytes(buf)))
+        regenerate_alignment_tags(m, ref_names, reference)
+        return m.encode(), result, masked
     return bytes(buf), result, masked
 
 
 def run_filter(reader, writer, config: FilterConfig, *,
                filter_by_template: bool = True,
                reverse_per_base: bool = False,
-               rejects_writer=None) -> FilterStats:
+               rejects_writer=None, reference=None) -> FilterStats:
     """Stream records, filtering per template (or per record)."""
     stats = FilterStats()
+    ref_names = reader.header.ref_names if reference is not None else ()
 
     def emit_template(records, results, masked_counts):
         """records: [RawRecord], results: [str] parallel."""
@@ -114,11 +124,13 @@ def run_filter(reader, writer, config: FilterConfig, *,
     if not filter_by_template:
         for rec in reader:
             data, result, masked = _process_one(rec.data, config,
-                                                reverse_per_base)
+                                                reverse_per_base,
+                                                reference, ref_names)
             emit_template([RawRecord(data)], [result], [masked])
         return stats
     for _name, group in iter_name_groups(reader):
-        processed = [_process_one(rec.data, config, reverse_per_base)
+        processed = [_process_one(rec.data, config, reverse_per_base,
+                                  reference, ref_names)
                      for rec in group]
         emit_template([RawRecord(d) for d, _, _ in processed],
                       [r for _, r, _ in processed],

@@ -1,0 +1,72 @@
+"""NM / UQ / MD alignment-tag regeneration against a reference FASTA.
+
+Mirrors /root/reference/crates/fgumi-sam/src/alignment_tags.rs
+(regenerate_alignment_tags_raw, :259-440):
+- unmapped (or mapped-but-refless) records get NM/UQ/MD stripped;
+- zero-reference-span CIGARs get NM=0, UQ=0, MD="0";
+- otherwise walk the CIGAR against the fetched reference span: mismatches and
+  read Ns count toward NM and UQ (sum of mismatch quals) and break MD match
+  runs; insertions add to NM only; deletions add to NM and write ^bases in MD;
+  soft clips advance the read, N-skips advance the reference.
+"""
+
+from .clipper import MutableRecord
+
+
+def regenerate_alignment_tags(rec: MutableRecord, ref_names, reference) -> bool:
+    """Update NM/UQ/MD on `rec` in place. Returns True when tags were computed
+    (False = stripped). `reference` is a core.reference.ReferenceReader."""
+    if rec.is_unmapped() or rec.ref_id < 0:
+        for tag in (b"NM", b"UQ", b"MD"):
+            rec.remove_tag(tag)
+        return False
+    chrom = ref_names[rec.ref_id]
+    ref_span = rec.reference_length()
+    if ref_span == 0:
+        rec.set_int_tag(b"NM", 0)
+        rec.set_int_tag(b"UQ", 0)
+        rec.set_str_tag(b"MD", b"0")
+        return True
+    ref_bases = reference.fetch(chrom, rec.pos, rec.pos + ref_span)
+
+    nm = 0
+    uq = 0
+    md = []
+    match_count = 0
+    ref_off = 0
+    seq_pos = 0
+    seq = rec.seq
+    quals = rec.quals
+    for op, ln in rec.cigar:
+        if op in "M=X":
+            for k in range(ln):
+                ref_base = ref_bases[ref_off + k]
+                seq_base = seq[seq_pos]
+                if seq_base in (ord("N"), ord("n")) or (seq_base & ~0x20) != (ref_base & ~0x20):
+                    nm += 1
+                    uq += quals[seq_pos]
+                    md.append(str(match_count))
+                    match_count = 0
+                    md.append(chr(ref_base))
+                else:
+                    match_count += 1
+                seq_pos += 1
+            ref_off += ln
+        elif op == "I":
+            nm += ln
+            seq_pos += ln
+        elif op == "D":
+            nm += ln
+            md.append(str(match_count))
+            match_count = 0
+            md.append("^" + ref_bases[ref_off:ref_off + ln].decode())
+            ref_off += ln
+        elif op == "S":
+            seq_pos += ln
+        elif op == "N":
+            ref_off += ln
+    md.append(str(match_count))
+    rec.set_int_tag(b"NM", nm)
+    rec.set_int_tag(b"UQ", min(uq, 2**31 - 1))
+    rec.set_str_tag(b"MD", "".join(md).encode())
+    return True

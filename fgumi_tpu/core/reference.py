@@ -1,0 +1,94 @@
+"""Whole-genome-in-RAM FASTA reader via the FAI index.
+
+Mirrors /root/reference/src/lib/reference.rs: reads the .fai (name, length,
+offset, linebases, linewidth), slurps each contig's raw bytes stripping
+newlines, and serves uppercase slices with zero per-fetch allocation beyond
+the returned bytes.
+"""
+
+import os
+
+
+class ReferenceReader:
+    """FAI-indexed FASTA with every contig held in RAM (reference.rs:182-290)."""
+
+    def __init__(self, fasta_path: str):
+        fai_path = fasta_path + ".fai"
+        if not os.path.exists(fai_path):
+            _write_fai(fasta_path, fai_path)
+        entries = []
+        with open(fai_path) as f:
+            for line in f:
+                parts = line.rstrip("\n").split("\t")
+                if len(parts) < 5:
+                    continue
+                entries.append((parts[0], int(parts[1]), int(parts[2]),
+                                int(parts[3]), int(parts[4])))
+        self._seqs = {}
+        with open(fasta_path, "rb") as f:
+            data = f.read()
+        for name, length, offset, linebases, linewidth in entries:
+            if linebases == linewidth or length == 0:
+                raw = data[offset:offset + length]
+            else:
+                n_full = length // linebases
+                span = n_full * linewidth + (length - n_full * linebases)
+                raw = data[offset:offset + span].replace(b"\n", b"").replace(b"\r", b"")
+            self._seqs[name] = raw.upper()
+
+    def contigs(self):
+        return list(self._seqs)
+
+    def fetch(self, chrom: str, start: int, end: int) -> bytes:
+        """Uppercase bases for 0-based half-open [start, end)."""
+        seq = self._seqs.get(chrom)
+        if seq is None:
+            raise KeyError(f"contig {chrom!r} not in reference")
+        if start < 0 or end > len(seq):
+            raise ValueError(
+                f"fetch [{start}, {end}) out of bounds for {chrom} "
+                f"(length {len(seq)})")
+        return seq[start:end]
+
+
+def _write_fai(fasta_path: str, fai_path: str):
+    """Generate a .fai for a well-formed FASTA (uniform line lengths)."""
+    entries = []
+    with open(fasta_path, "rb") as f:
+        name = None
+        length = 0
+        offset = 0
+        linebases = linewidth = 0
+        pos = 0
+        for line in f:
+            if line.startswith(b">"):
+                if name is not None:
+                    entries.append((name, length, offset, linebases, linewidth))
+                name = line[1:].split()[0].decode()
+                pos += len(line)
+                offset = pos
+                length = 0
+                linebases = linewidth = 0
+            else:
+                stripped = line.rstrip(b"\r\n")
+                if stripped and linebases == 0:
+                    linebases = len(stripped)
+                    linewidth = len(line)
+                length += len(stripped)
+                pos += len(line)
+        if name is not None:
+            entries.append((name, length, offset, linebases, linewidth))
+    with open(fai_path, "w") as f:
+        for name, length, offset, linebases, linewidth in entries:
+            f.write(f"{name}\t{length}\t{offset}\t{linebases}\t{linewidth}\n")
+
+
+def write_fasta(path: str, contigs: dict, line_width: int = 60):
+    """Write a FASTA (+ .fai) from {name: bytes}; test/simulate helper."""
+    with open(path, "w") as f:
+        for name, seq in contigs.items():
+            f.write(f">{name}\n")
+            s = seq.decode() if isinstance(seq, (bytes, bytearray)) else seq
+            for i in range(0, len(s), line_width):
+                f.write(s[i:i + line_width] + "\n")
+    _write_fai(path, path + ".fai")

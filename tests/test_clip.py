@@ -1,0 +1,284 @@
+"""Clipper library unit tests + `clip` command E2E."""
+
+import pytest
+
+from fgumi_tpu.cli import main as cli_main
+from fgumi_tpu.core.clipper import (MutableRecord, RecordClipper,
+                                    read_pos_at_ref_pos)
+from fgumi_tpu.core.reference import ReferenceReader, write_fasta
+from fgumi_tpu.io.bam import (BamHeader, BamReader, BamWriter, FLAG_FIRST,
+                              FLAG_LAST, FLAG_MATE_REVERSE, FLAG_PAIRED,
+                              FLAG_REVERSE, FLAG_UNMAPPED, RawRecord,
+                              RecordBuilder)
+
+
+def rec(cigar, pos=100, flag=0, seq=None, ref_id=0, name=b"q"):
+    length = sum(ln for op, ln in cigar if op in "MIS=X")
+    seq = seq or b"A" * length
+    return MutableRecord(name=name, flag=flag, ref_id=ref_id, pos=pos, mapq=60,
+                         cigar=list(cigar), seq=seq, quals=b"\x1e" * len(seq),
+                         next_ref_id=-1, next_pos=-1, tlen=0)
+
+
+def test_soft_clip_start():
+    r = rec([("M", 50)])
+    c = RecordClipper("soft")
+    n = c.clip_start_of_alignment(r, 10)
+    assert n == 10
+    assert r.cigar == [("S", 10), ("M", 40)]
+    assert r.pos == 110
+    assert len(r.seq) == 50  # bases kept
+
+
+def test_hard_clip_start():
+    r = rec([("M", 50)])
+    c = RecordClipper("hard")
+    n = c.clip_start_of_alignment(r, 10)
+    assert n == 10
+    assert r.cigar == [("H", 10), ("M", 40)]
+    assert r.pos == 110
+    assert len(r.seq) == 40  # bases removed
+
+
+def test_soft_with_mask_start():
+    r = rec([("M", 20)], seq=b"C" * 20)
+    c = RecordClipper("soft-with-mask")
+    c.clip_start_of_alignment(r, 5)
+    assert r.cigar == [("S", 5), ("M", 15)]
+    assert r.seq[:5] == b"NNNNN" and r.seq[5:] == b"C" * 15
+    assert list(r.quals[:5]) == [2] * 5
+
+
+def test_clip_converts_existing_soft_to_hard():
+    r = rec([("S", 5), ("M", 45)])
+    c = RecordClipper("hard")
+    n = c.clip_start_of_alignment(r, 10)
+    assert n == 10
+    # existing 5S + 10 new clipped all become hard
+    assert r.cigar == [("H", 15), ("M", 35)]
+    assert len(r.seq) == 35
+
+
+def test_clip_end():
+    r = rec([("M", 50)])
+    c = RecordClipper("hard")
+    n = c.clip_end_of_alignment(r, 10)
+    assert n == 10
+    assert r.cigar == [("M", 40), ("H", 10)]
+    assert r.pos == 100  # start unchanged
+
+
+def test_clip_through_insertion_swallows_it():
+    # 10M 5I 10M; clipping 12 bases lands inside the insertion: the whole
+    # insertion is swallowed (clipper.rs boundary rule)
+    r = rec([("M", 10), ("I", 5), ("M", 10)])
+    c = RecordClipper("soft")
+    n = c.clip_start_of_alignment(r, 12)
+    assert n == 15
+    assert r.cigar == [("S", 15), ("M", 10)]
+    assert r.pos == 110
+
+
+def test_clip_removes_boundary_deletion():
+    r = rec([("M", 10), ("D", 4), ("M", 10)])
+    c = RecordClipper("soft")
+    n = c.clip_start_of_alignment(r, 10)
+    assert n == 10
+    assert r.cigar == [("S", 10), ("M", 10)]
+    assert r.pos == 114  # 10M + 4D consumed on reference
+
+
+def test_clip_all_unmaps_read():
+    r = rec([("M", 20)], flag=FLAG_REVERSE, seq=b"ACGT" * 5)
+    c = RecordClipper("soft")
+    n = c.clip_start_of_alignment(r, 20)
+    assert n == 20
+    assert r.is_unmapped() and r.pos == -1 and r.cigar == []
+    assert not r.is_reverse()
+    # reverse-strand read flipped back to read orientation: revcomp applied
+    from fgumi_tpu.constants import reverse_complement_bytes
+    assert r.seq == reverse_complement_bytes(b"ACGT" * 5)
+
+
+def test_clip_5prime_strand_aware():
+    fwd = rec([("M", 30)])
+    rev = rec([("M", 30)], flag=FLAG_REVERSE)
+    c = RecordClipper("soft")
+    c.clip_5_prime_end_of_alignment(fwd, 5)
+    c.clip_5_prime_end_of_alignment(rev, 5)
+    assert fwd.cigar == [("S", 5), ("M", 25)]
+    assert rev.cigar == [("M", 25), ("S", 5)]
+
+
+def test_clip_read_ensures_at_least():
+    # 5 bases already soft-clipped: asking for 5 clips nothing new
+    r = rec([("S", 5), ("M", 45)])
+    c = RecordClipper("soft")
+    assert c.clip_start_of_read(r, 5) == 0
+    assert r.cigar == [("S", 5), ("M", 45)]
+    # asking for 8 clips only the 3 extra
+    assert c.clip_start_of_read(r, 8) == 3
+    assert r.cigar == [("S", 8), ("M", 42)]
+
+
+def test_upgrade_all_clipping_hard():
+    r = rec([("S", 4), ("M", 20), ("S", 6)])
+    c = RecordClipper("hard")
+    lead, trail = c.upgrade_all_clipping(r)
+    assert (lead, trail) == (4, 6)
+    assert r.cigar == [("H", 4), ("M", 20), ("H", 6)]
+    assert len(r.seq) == 20
+
+
+def test_read_pos_at_ref_pos():
+    r = rec([("S", 5), ("M", 10), ("D", 2), ("M", 10)], pos=99)  # 1-based 100
+    assert read_pos_at_ref_pos(r, 100) == 6  # first aligned base
+    assert read_pos_at_ref_pos(r, 109) == 15
+    assert read_pos_at_ref_pos(r, 110) == 0  # in deletion
+    assert read_pos_at_ref_pos(r, 110, True) == 15
+    assert read_pos_at_ref_pos(r, 112) == 16  # after deletion
+
+
+def _fr_pair(r1_pos, r2_pos, length=30):
+    r1 = rec([("M", length)], pos=r1_pos,
+             flag=FLAG_PAIRED | FLAG_FIRST | FLAG_MATE_REVERSE, name=b"p")
+    r2 = rec([("M", length)], pos=r2_pos,
+             flag=FLAG_PAIRED | FLAG_LAST | FLAG_REVERSE, name=b"p")
+    r1.next_ref_id = r2.ref_id
+    r1.next_pos = r2.pos
+    r2.next_ref_id = r1.ref_id
+    r2.next_pos = r1.pos
+    return r1, r2
+
+
+def test_clip_overlapping_reads_midpoint():
+    # R1 100-129, R2 110-139 (0-based): overlap 110-129; midpoint of 5' ends
+    # (101, 140 1-based) = 120 -> R1 keeps 101..120, R2 keeps 121..140
+    r1, r2 = _fr_pair(100, 110)
+    c = RecordClipper("soft")
+    n1, n2 = c.clip_overlapping_reads(r1, r2)
+    assert n1 == 10 and n2 == 10
+    assert r1.cigar == [("M", 20), ("S", 10)]
+    assert r2.cigar == [("S", 10), ("M", 20)]
+    assert r2.pos == 120
+    # no overlap remains
+    assert r1.alignment_end() < r2.pos
+
+
+def test_clip_overlapping_requires_fr():
+    r1, r2 = _fr_pair(100, 110)
+    r2.flag &= ~FLAG_REVERSE  # tandem now
+    c = RecordClipper("soft")
+    assert c.clip_overlapping_reads(r1, r2) == (0, 0)
+
+
+def test_clip_extending_past_mate():
+    # R2 (reverse) extends before R1's start: bases before R1 5' get clipped
+    r1, r2 = _fr_pair(100, 90)
+    c = RecordClipper("soft")
+    n1, n2 = c.clip_extending_past_mate_ends(r1, r2)
+    # r1 forward spans 100-129, r2 reverse spans 90-119
+    # r1 extends past r2's unclipped end (119): clips 130-... none past? r1 end=129 >= 119 -> clip
+    assert n1 > 0 and n2 > 0
+    assert r2.pos == 100  # r2 no longer starts before r1
+
+
+# --- E2E through the CLI ---
+
+@pytest.fixture(scope="module")
+def ref_fasta(tmp_path_factory):
+    import random
+    random.seed(42)
+    path = str(tmp_path_factory.mktemp("clipref") / "ref.fa")
+    seq = "".join(random.choice("ACGT") for _ in range(2000))
+    write_fasta(path, {"chr1": seq})
+    return path
+
+
+def _write_pair_bam(path, ref_fasta, r1_pos=100, r2_pos=120, length=50,
+                    nm_errors=0):
+    ref = ReferenceReader(ref_fasta)
+    hdr = BamHeader(text="@HD\tVN:1.6\tSO:queryname\n@SQ\tSN:chr1\tLN:2000\n",
+                    ref_names=["chr1"], ref_lengths=[2000])
+    with BamWriter(path, hdr) as w:
+        seq1 = bytearray(ref.fetch("chr1", r1_pos, r1_pos + length))
+        seq2 = bytearray(ref.fetch("chr1", r2_pos, r2_pos + length))
+        for i in range(nm_errors):
+            seq1[i * 7] = ord("A") if seq1[i * 7] != ord("A") else ord("C")
+        w.write_record_bytes(
+            RecordBuilder().start_mapped(
+                b"t1", FLAG_PAIRED | FLAG_FIRST | FLAG_MATE_REVERSE, 0, r1_pos,
+                60, [("M", length)], bytes(seq1), [30] * length,
+                next_ref_id=0, next_pos=r2_pos, tlen=r2_pos + length - r1_pos)
+            .finish())
+        w.write_record_bytes(
+            RecordBuilder().start_mapped(
+                b"t1", FLAG_PAIRED | FLAG_LAST | FLAG_REVERSE, 0, r2_pos,
+                60, [("M", length)], bytes(seq2), [30] * length,
+                next_ref_id=0, next_pos=r1_pos,
+                tlen=-(r2_pos + length - r1_pos)).finish())
+
+
+def test_clip_cli_overlap_and_tags(ref_fasta, tmp_path):
+    inp = str(tmp_path / "in.bam")
+    out = str(tmp_path / "out.bam")
+    met = str(tmp_path / "m.tsv")
+    _write_pair_bam(inp, ref_fasta, nm_errors=2)
+    rc = cli_main(["clip", "-i", inp, "-o", out, "-r", ref_fasta,
+                   "--clip-overlapping-reads", "-m", met])
+    assert rc == 0
+    with BamReader(out) as r:
+        recs = list(r)
+    assert len(recs) == 2
+    r1, r2 = recs
+    # overlap removed: hard mode default
+    assert any(op == "H" for op, _ in r1.cigar())
+    assert r1.pos + r1.reference_length() - 1 < r2.pos
+    # mate info repaired
+    assert r1.next_pos == r2.pos and r2.next_pos == r1.pos
+    # NM/MD regenerated: r1 had 2 injected mismatches within the kept region
+    # (positions 0 and 7 < kept length), NM >= 0 and MD present
+    assert r1.get_int(b"NM") is not None
+    assert r1.get_str(b"MD") is not None
+    assert r2.get_int(b"NM") == 0
+    lines = open(met).read().strip().splitlines()
+    assert lines[0].startswith("read_type\t")
+
+
+def test_clip_cli_requires_an_option(ref_fasta, tmp_path):
+    inp = str(tmp_path / "in.bam")
+    _write_pair_bam(inp, ref_fasta)
+    assert cli_main(["clip", "-i", inp, "-o", str(tmp_path / "o.bam"),
+                     "-r", ref_fasta]) == 2
+
+
+def test_clip_cli_fixed_end_clipping(ref_fasta, tmp_path):
+    inp = str(tmp_path / "in.bam")
+    out = str(tmp_path / "out.bam")
+    _write_pair_bam(inp, ref_fasta, r1_pos=100, r2_pos=400)
+    rc = cli_main(["clip", "-i", inp, "-o", out, "-r", ref_fasta,
+                   "--read-one-five-prime", "3", "-c", "soft"])
+    assert rc == 0
+    with BamReader(out) as r:
+        recs = list(r)
+    # R1 forward: 3 bases soft-clipped at start; R2 untouched
+    assert recs[0].cigar()[0] == ("S", 3)
+    assert recs[1].cigar() == [("M", 50)]
+
+
+def test_reference_reader_roundtrip(ref_fasta):
+    ref = ReferenceReader(ref_fasta)
+    assert ref.contigs() == ["chr1"]
+    assert len(ref.fetch("chr1", 0, 60)) == 60
+    assert len(ref.fetch("chr1", 1990, 2000)) == 10
+    with pytest.raises(ValueError):
+        ref.fetch("chr1", 1990, 2001)
+
+
+def test_mutable_record_roundtrip(ref_fasta, tmp_path):
+    inp = str(tmp_path / "in.bam")
+    _write_pair_bam(inp, ref_fasta)
+    with BamReader(inp) as r:
+        for raw in r:
+            m = MutableRecord.from_raw(raw)
+            assert m.encode() == raw.data

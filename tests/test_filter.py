@@ -297,3 +297,55 @@ def test_filter_cli_masking_end_to_end(tmp_path):
     with BamReader(out) as r:
         (kept,) = list(r)
     assert kept.seq_bytes() == b"ANGTACGT"
+
+
+def test_filter_mapped_with_ref_regenerates_tags(tmp_path):
+    """--ref allows mapped input: NM/UQ/MD regenerated after masking
+    (filter.rs:881-883). Masked bases become N -> counted as mismatches."""
+    from fgumi_tpu.cli import main
+    from fgumi_tpu.core.reference import write_fasta
+
+    ref_path = str(tmp_path / "ref.fa")
+    write_fasta(ref_path, {"c1": b"ACGTACGTACGT"})
+    inp = str(tmp_path / "in.bam")
+    out = str(tmp_path / "out.bam")
+    header = BamHeader(text="@HD\tVN:1.6\tSO:queryname\n@SQ\tSN:c1\tLN:12\n",
+                       ref_names=["c1"], ref_lengths=[12])
+    # mapped consensus read matching the reference exactly, with one low-quality
+    # base (index 2) that masking will convert to N
+    b = RecordBuilder().start_mapped(
+        b"m1", 0, 0, 0, 60, [("M", 8)], b"ACGTACGT",
+        [40, 40, 5, 40, 40, 40, 40, 40])
+    b.tag_int(b"cD", 5)
+    b.tag_float(b"cE", 0.01)
+    b.tag_int(b"NM", 7)  # stale tag that must be recomputed
+    with BamWriter(inp, header) as w:
+        w.write_record_bytes(b.finish())
+    rc = main(["filter", "-i", inp, "-o", out, "-M", "3", "-N", "10",
+               "-r", ref_path])
+    assert rc == 0
+    with BamReader(out) as r:
+        rec = next(iter(r))
+    assert rec.seq_bytes()[2:3] == b"N"  # masked
+    assert rec.get_int(b"NM") == 1  # the masked N counts as one mismatch
+    assert rec.get_str(b"MD") == "2G5"
+    assert rec.get_int(b"UQ") == 2  # masked qual (min phred)
+
+
+def test_filter_ref_missing_contig_clean_error(tmp_path):
+    from fgumi_tpu.cli import main
+    from fgumi_tpu.core.reference import write_fasta
+
+    ref_path = str(tmp_path / "ref.fa")
+    write_fasta(ref_path, {"other": b"ACGT" * 10})
+    inp = str(tmp_path / "in.bam")
+    header = BamHeader(text="@HD\tVN:1.6\tSO:queryname\n@SQ\tSN:c1\tLN:40\n",
+                       ref_names=["c1"], ref_lengths=[40])
+    b = RecordBuilder().start_mapped(b"m1", 0, 0, 0, 60, [("M", 4)], b"ACGT",
+                                     [40] * 4)
+    b.tag_int(b"cD", 5)
+    b.tag_float(b"cE", 0.01)
+    with BamWriter(inp, header) as w:
+        w.write_record_bytes(b.finish())
+    assert main(["filter", "-i", inp, "-o", str(tmp_path / "o.bam"),
+                 "-M", "3", "-r", ref_path]) == 2

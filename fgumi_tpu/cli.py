@@ -12,6 +12,18 @@ import time
 log = logging.getLogger("fgumi_tpu")
 
 
+def _unmapped_consensus_header(read_group_id: str):
+    """Unmapped-consensus output header: no reference sequences, single RG,
+    @PG capturing the command line (consensus_runner.rs:115+)."""
+    from .io.bam import BamHeader
+
+    return BamHeader(
+        text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n"
+             f"@RG\tID:{read_group_id}\tSM:sample\n"
+             "@PG\tID:fgumi-tpu\tPN:fgumi-tpu\tCL:" + " ".join(sys.argv) + "\n",
+        ref_names=[], ref_lengths=[])
+
+
 def _add_simplex(sub):
     p = sub.add_parser("simplex", help="Call simplex consensus reads over MI groups")
     p.add_argument("-i", "--input", required=True, help="grouped BAM (MI tags)")
@@ -64,14 +76,7 @@ def cmd_simplex(args):
 
     t0 = time.monotonic()
     with BamReader(args.input) as reader:
-        # consensus output is unmapped: no reference sequences
-        # (consensus_runner.rs:115+ unmapped-consensus header construction)
-        out_header = BamHeader(
-            text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n"
-                 f"@RG\tID:{args.read_group_id}\tSM:sample\n"
-                 "@PG\tID:fgumi-tpu\tPN:fgumi-tpu\tCL:" + " ".join(sys.argv) + "\n",
-            ref_names=[], ref_lengths=[],
-        )
+        out_header = _unmapped_consensus_header(args.read_group_id)
         with BamWriter(args.output, out_header) as writer:
             n_out = 0
             allow_unmapped = args.allow_unmapped
@@ -135,11 +140,7 @@ def cmd_duplex(args):
     t0 = time.monotonic()
     allow_unmapped = args.allow_unmapped
     with BamReader(args.input) as reader:
-        out_header = BamHeader(
-            text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n"
-                 f"@RG\tID:{args.read_group_id}\tSM:sample\n"
-                 "@PG\tID:fgumi-tpu\tPN:fgumi-tpu\tCL:" + " ".join(sys.argv) + "\n",
-            ref_names=[], ref_lengths=[])
+        out_header = _unmapped_consensus_header(args.read_group_id)
         with BamWriter(args.output, out_header) as writer:
             n_out = 0
             pregroup = lambda r: consensus_pregroup_keep(r.flag, allow_unmapped)
@@ -161,6 +162,106 @@ def cmd_duplex(args):
              s.input_reads, n_out, dt, s.input_reads / dt if dt else 0)
     if s.rejected:
         log.info("rejections: %s", dict(sorted(s.rejected.items())))
+    return 0
+
+
+def _add_codec(sub):
+    p = sub.add_parser(
+        "codec",
+        help="Call CODEC consensus (one read-pair covers both strands)")
+    p.add_argument("-i", "--input", required=True,
+                   help="grouped BAM (MI tags, no /A,/B suffixes)")
+    p.add_argument("-o", "--output", required=True, help="output consensus BAM")
+    p.add_argument("-r", "--rejects", default=None,
+                   help="optional BAM for rejected records")
+    p.add_argument("--tag", default="MI")
+    p.add_argument("--read-name-prefix", default="fgumi")
+    p.add_argument("--read-group-id", default="A")
+    p.add_argument("--error-rate-pre-umi", type=int, default=45)
+    p.add_argument("--error-rate-post-umi", type=int, default=40)
+    p.add_argument("--min-input-base-quality", type=int, default=10)
+    p.add_argument("-M", "--min-reads", type=int, default=1,
+                   help="min read pairs per strand")
+    p.add_argument("--max-reads", type=int, default=None,
+                   help="max read pairs per strand (downsample)")
+    p.add_argument("-d", "--min-duplex-length", type=int, default=1)
+    p.add_argument("--single-strand-qual", type=int, default=None)
+    p.add_argument("-Q", "--outer-bases-qual", type=int, default=None)
+    p.add_argument("-O", "--outer-bases-length", type=int, default=5)
+    p.add_argument("-x", "--max-duplex-disagreement-rate", type=float, default=1.0)
+    p.add_argument("-X", "--max-duplex-disagreements", type=int, default=None)
+    p.add_argument("--cell-tag", default=None, help="cell barcode tag (e.g. CB)")
+    p.add_argument("--per-base-tags", action="store_true",
+                   help="emit ad/bd/ae/be/ac/bc/aq/bq tags")
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--batch-groups", type=int, default=1000)
+    p.set_defaults(func=cmd_codec)
+
+
+def cmd_codec(args):
+    from .consensus.codec import CodecConsensusCaller, CodecOptions
+    from .core.grouper import iter_mi_group_batches
+    from .io.bam import BamHeader, BamReader, BamWriter
+
+    if args.min_reads < 1:
+        log.error("--min-reads must be >= 1")
+        return 2
+    if args.max_reads is not None and args.max_reads < args.min_reads:
+        log.error("--max-reads (%d) must be >= --min-reads (%d)",
+                  args.max_reads, args.min_reads)
+        return 2
+
+    opts = CodecOptions(
+        min_input_base_quality=args.min_input_base_quality,
+        error_rate_pre_umi=args.error_rate_pre_umi,
+        error_rate_post_umi=args.error_rate_post_umi,
+        min_reads_per_strand=args.min_reads,
+        max_reads_per_strand=args.max_reads,
+        min_duplex_length=args.min_duplex_length,
+        single_strand_qual=args.single_strand_qual,
+        outer_bases_qual=args.outer_bases_qual,
+        outer_bases_length=args.outer_bases_length,
+        max_duplex_disagreements=args.max_duplex_disagreements,
+        max_duplex_disagreement_rate=args.max_duplex_disagreement_rate,
+        cell_tag=args.cell_tag,
+        produce_per_base_tags=args.per_base_tags,
+        seed=args.seed)
+    caller = CodecConsensusCaller(args.read_name_prefix, args.read_group_id, opts,
+                                  track_rejects=args.rejects is not None)
+
+    t0 = time.monotonic()
+    with BamReader(args.input) as reader:
+        out_header = _unmapped_consensus_header(args.read_group_id)
+        rejects_writer = None
+        if args.rejects is not None:
+            # rejects keep the input header (raw RG/PG/contig metadata preserved)
+            rejects_writer = BamWriter(args.rejects, reader.header)
+        try:
+            with BamWriter(args.output, out_header) as writer:
+                n_out = 0
+                for batch in iter_mi_group_batches(reader, args.batch_groups,
+                                                   tag=args.tag.encode()):
+                    for rec_bytes in caller.call_groups(batch):
+                        writer.write_record_bytes(rec_bytes)
+                        n_out += 1
+                    if rejects_writer is not None and caller.rejected_reads:
+                        for rec in caller.rejected_reads:
+                            rejects_writer.write_record(rec)
+                        caller.rejected_reads.clear()
+        finally:
+            if rejects_writer is not None:
+                rejects_writer.close()
+    dt = time.monotonic() - t0
+    s = caller.stats
+    log.info("codec: %d input reads -> %d consensus reads in %.2fs (%.0f reads/s)",
+             s.total_input_reads, n_out, dt,
+             s.total_input_reads / dt if dt else 0)
+    if s.rejection_reasons:
+        log.info("rejections: %s", dict(sorted(s.rejection_reasons.items())))
+    if s.consensus_duplex_bases_emitted:
+        log.info("duplex disagreement rate: %.6f (%d/%d)",
+                 s.duplex_disagreement_rate(), s.duplex_disagreement_base_count,
+                 s.consensus_duplex_bases_emitted)
     return 0
 
 
@@ -750,6 +851,16 @@ def _add_simulate(sub):
     d.add_argument("--ba-fraction", type=float, default=1.0)
     d.add_argument("--seed", type=int, default=42)
     d.set_defaults(func=cmd_simulate_duplex)
+    c = ps.add_parser("codec-reads", help="CODEC-shaped BAM (overlapping FR pairs, MI tags)")
+    c.add_argument("-o", "--output", required=True)
+    c.add_argument("--num-molecules", type=int, default=100)
+    c.add_argument("--pairs-per-molecule", type=int, default=1)
+    c.add_argument("--read-length", type=int, default=100)
+    c.add_argument("--error-rate", type=float, default=0.01)
+    c.add_argument("--base-quality", type=int, default=35)
+    c.add_argument("--overlap-fraction", type=float, default=0.5)
+    c.add_argument("--seed", type=int, default=42)
+    c.set_defaults(func=cmd_simulate_codec)
     m = ps.add_parser("mapped-reads", help="template-coordinate BAM with RX tags (group input)")
     m.add_argument("-o", "--output", required=True)
     m.add_argument("--num-families", type=int, default=100)
@@ -782,6 +893,18 @@ def cmd_simulate_duplex(args):
         reads_per_strand=args.reads_per_strand, read_length=args.read_length,
         error_rate=args.error_rate, base_quality=args.base_quality,
         ba_fraction=args.ba_fraction, seed=args.seed)
+    log.info("simulate: wrote %d records to %s", n, args.output)
+    return 0
+
+
+def cmd_simulate_codec(args):
+    from .simulate import simulate_codec_bam
+
+    n = simulate_codec_bam(
+        args.output, num_molecules=args.num_molecules,
+        pairs_per_molecule=args.pairs_per_molecule, read_length=args.read_length,
+        error_rate=args.error_rate, base_quality=args.base_quality,
+        overlap_fraction=args.overlap_fraction, seed=args.seed)
     log.info("simulate: wrote %d records to %s", n, args.output)
     return 0
 
@@ -1046,6 +1169,7 @@ def main(argv=None):
     _add_zipper(sub)
     _add_simplex(sub)
     _add_duplex(sub)
+    _add_codec(sub)
     _add_filter(sub)
     _add_clip(sub)
     _add_group(sub)

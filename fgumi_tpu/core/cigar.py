@@ -10,6 +10,137 @@ Simplified CIGARs are lists of (op_char, length) with ops from "MIDNP".
 """
 
 _CONSUMES_QUERY = frozenset("MIS=X")
+_CONSUMES_READ = frozenset("MI=X")  # post-clip-strip read consumption (no S)
+_CONSUMES_REF = frozenset("MDN=X")
+
+
+def reference_length(cigar) -> int:
+    """Reference bases consumed (crates/fgumi-raw-bam/src/cigar.rs:137)."""
+    return sum(n for op, n in cigar if op in _CONSUMES_REF)
+
+
+def _end_clips(cigar, from_start: bool):
+    """(existing_hard, existing_soft, n_clip_ops) at one end, H outside S."""
+    ops = cigar if from_start else list(reversed(cigar))
+    hard = soft = skip = 0
+    for op, n in ops:
+        if op == "H":
+            hard += n
+            skip += 1
+        else:
+            break
+    for op, n in ops[skip:]:
+        if op == "S":
+            soft += n
+            skip += 1
+        else:
+            break
+    return hard, soft, skip
+
+
+def clip_cigar(cigar, clip_amount: int, from_start: bool):
+    """Virtual hard-clip of `clip_amount` query bases from one end.
+
+    Returns (new_cigar, ref_bases_consumed); ref_bases_consumed adjusts
+    alignment_start for start clips. Mirrors clip_cigar_ops_raw
+    (crates/fgumi-raw-bam/src/cigar.rs:404-446): existing S+H at the end absorb
+    the clip first (soft upgraded to hard); the remainder clips into the
+    alignment, splitting ops, swallowing boundary insertions whole, and
+    skipping a deletion that abuts the clip point.
+    """
+    if clip_amount == 0 or not cigar:
+        return list(cigar), 0
+
+    hard, soft, skip = _end_clips(cigar, from_start)
+    if clip_amount <= hard + soft:
+        # upgrade soft clips to hard, no alignment change (cigar.rs:669-745)
+        upgrade = min(soft, max(clip_amount - hard, 0))
+        new_hard = hard + upgrade
+        remaining_soft = soft - upgrade
+        inner = cigar[skip:] if from_start else cigar[: len(cigar) - skip]
+        if from_start:
+            out = [("H", new_hard)]
+            if remaining_soft:
+                out.append(("S", remaining_soft))
+            out.extend(inner)
+        else:
+            out = list(inner)
+            if remaining_soft:
+                out.append(("S", remaining_soft))
+            out.append(("H", new_hard))
+        return out, 0
+
+    alignment_clip = clip_amount - (hard + soft)
+    inner = cigar[skip:] if from_start else cigar[: len(cigar) - skip]
+    if not from_start:
+        inner = list(reversed(inner))
+
+    read_clipped = 0
+    ref_clipped = 0
+    new_ops = []
+    idx = 0
+    while idx < len(inner):
+        op, n = inner[idx]
+        if read_clipped == alignment_clip and not new_ops and op == "D":
+            ref_clipped += n
+            idx += 1
+            continue
+        if read_clipped >= alignment_clip:
+            break
+        is_read = op in _CONSUMES_READ
+        is_ref = op in _CONSUMES_REF
+        if is_read and n > alignment_clip - read_clipped:
+            if op == "I":
+                read_clipped += n  # swallow boundary insertion whole
+            else:
+                take = alignment_clip - read_clipped
+                read_clipped += take
+                if is_ref:
+                    ref_clipped += take
+                new_ops.append((op, n - take))
+        else:
+            if is_read:
+                read_clipped += n
+            if is_ref:
+                ref_clipped += n
+        idx += 1
+    new_ops.extend(inner[idx:])
+
+    total_hard = hard + soft + read_clipped
+    if from_start:
+        out = [("H", total_hard)] + new_ops
+        return out, ref_clipped
+    out = list(reversed(new_ops)) + [("H", total_hard)]
+    return out, 0  # end clips never shift alignment_start
+
+
+def read_pos_at_ref_pos(cigar, alignment_start: int, ref_pos: int,
+                        last_if_deleted: bool):
+    """1-based query position at 1-based `ref_pos`, or None.
+
+    Mirrors read_pos_at_ref_pos_raw (crates/fgumi-raw-bam/src/cigar.rs:461-506):
+    None outside the alignment; inside a deletion returns the last query
+    position before it when `last_if_deleted`, else None.
+    """
+    if ref_pos < alignment_start:
+        return None
+    ref_off = 0
+    query_off = 0
+    for op, n in cigar:
+        consumes_ref = op in _CONSUMES_REF
+        op_ref_start = alignment_start + ref_off
+        if consumes_ref:
+            op_ref_end = op_ref_start + n - 1
+            if op_ref_start <= ref_pos <= op_ref_end:
+                if op in _CONSUMES_QUERY:
+                    return query_off + (ref_pos - op_ref_start) + 1
+                if last_if_deleted:
+                    return query_off if query_off > 0 else 1
+                return None
+            ref_off += n
+        if op in _CONSUMES_QUERY:
+            query_off += n
+    return None
 
 
 def simplify(cigar):

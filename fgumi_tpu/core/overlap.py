@@ -139,24 +139,27 @@ def _read_pos_at_ref(cigar, alignment_start_1based: int, target: int, before: bo
     return 0
 
 
-def num_bases_extending_past_mate(rec: RawRecord) -> int:
-    """Bases of `rec` extending past its FR mate's soft-clip boundary, 0 if n/a.
+def is_primary_fr_pair(a: RawRecord, b: RawRecord) -> bool:
+    """Symmetric per-pair FR classification (overlap.rs:76-101).
 
-    Requires the MC tag; fails closed to 0 when absent/malformed (overlap.rs:117-140).
+    Both reads and mates mapped, same reference, opposite strands; FR
+    orientation evaluated on the reverse-strand record only (the CIGAR-derived
+    branch of is_fr_pair), making the test order-independent for dovetails.
     """
-    if not is_fr_pair(rec):
-        return 0
-    mc = rec.get_str(b"MC")
-    if mc is None:
-        return 0
-    parsed = parse_soft_clips_and_ref_len(mc)
-    if parsed is None:
-        return 0
-    leading_soft, ref_len, trailing_soft = parsed
-    mate_pos = rec.next_pos + 1
-    mate_unclipped_start = mate_pos - leading_soft
-    mate_unclipped_end = mate_pos - 1 + ref_len + trailing_soft
+    fa, fb = a.flag, b.flag
+    if (fa | fb) & (FLAG_UNMAPPED | FLAG_MATE_UNMAPPED):
+        return False
+    if a.ref_id != b.ref_id:
+        return False
+    a_rev = bool(fa & FLAG_REVERSE)
+    if a_rev == bool(fb & FLAG_REVERSE):
+        return False
+    return is_fr_pair(a if a_rev else b)
 
+
+def _bases_extending_past_mate(rec: RawRecord, mate_unclipped_start: int,
+                               mate_unclipped_end: int) -> int:
+    """Shared boundary walk (overlap.rs:172-231); boundaries 1-based soft-only."""
     cigar = rec.cigar()
     read_length = _read_len_from_cigar(cigar)
     this_pos = rec.pos + 1
@@ -175,3 +178,39 @@ def num_bases_extending_past_mate(rec: RawRecord) -> int:
     trailing_sc = _trailing_soft(cigar)
     gap = max(mate_unclipped_end - alignment_end, 0)
     return max(trailing_sc - gap, 0)
+
+
+def num_bases_extending_past_mate(rec: RawRecord) -> int:
+    """Bases of `rec` extending past its FR mate's soft-clip boundary, 0 if n/a.
+
+    Requires the MC tag; fails closed to 0 when absent/malformed (overlap.rs:117-140).
+    """
+    if not is_fr_pair(rec):
+        return 0
+    mc = rec.get_str(b"MC")
+    if mc is None:
+        return 0
+    parsed = parse_soft_clips_and_ref_len(mc)
+    if parsed is None:
+        return 0
+    leading_soft, ref_len, trailing_soft = parsed
+    mate_pos = rec.next_pos + 1
+    return _bases_extending_past_mate(
+        rec, mate_pos - leading_soft, mate_pos - 1 + ref_len + trailing_soft)
+
+
+def num_bases_extending_past_mate_vs_mate(rec: RawRecord, mate: RawRecord) -> int:
+    """Overlap clip with the mate boundary read from the mate record in hand
+    (overlap.rs:156-165), so clipping still happens when MC is absent.
+
+    Used by the CODEC caller (mirrors fgbio updateMateCigars backfill); the
+    soft-only boundary comes from the mate's own CIGAR, and FR classification
+    uses the symmetric per-pair test.
+    """
+    if not is_primary_fr_pair(rec, mate):
+        return 0
+    mate_cigar = mate.cigar()
+    mate_pos = mate.pos + 1
+    start = mate_pos - _leading_soft(mate_cigar)
+    end = mate_pos - 1 + _ref_len_from_cigar(mate_cigar) + _trailing_soft(mate_cigar)
+    return _bases_extending_past_mate(rec, start, end)

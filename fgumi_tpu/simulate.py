@@ -196,6 +196,70 @@ def simulate_duplex_bam(path: str, num_molecules: int = 100, reads_per_strand: i
     return n_written
 
 
+def simulate_codec_bam(path: str, num_molecules: int = 100, pairs_per_molecule: int = 1,
+                       read_length: int = 100, error_rate: float = 0.01,
+                       base_quality: int = 35, qual_jitter: int = 5, seed: int = 42,
+                       overlap_fraction: float = 0.5, umi_length: int = 8,
+                       ref_name: str = "chr1", ref_length: int = 10_000_000):
+    """Write a CODEC-shaped grouped BAM: each FR pair covers both strands.
+
+    One read-pair per duplex molecule (optionally more): R1 forward from the
+    insert start, R2 reverse from the insert end, overlapping on the genome by
+    ``overlap_fraction * read_length`` bases. MI tags carry plain molecule ids
+    (no /A,/B — the `codec` command's input contract), plus RX UMIs.
+    """
+    rng = np.random.default_rng(seed)
+    header = BamHeader(
+        text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n"
+             f"@SQ\tSN:{ref_name}\tLN:{ref_length}\n"
+             "@RG\tID:A\tSM:sample\tLB:lib\n",
+        ref_names=[ref_name], ref_lengths=[ref_length],
+    )
+    overlap = max(1, int(read_length * overlap_fraction))
+    insert = 2 * read_length - overlap
+    n_written = 0
+    with BamWriter(path, header) as w:
+        for mol in range(num_molecules):
+            start = int(rng.integers(0, ref_length - 2 * insert))
+            r2_pos = start + insert - read_length
+            # reference-orientation truth over the whole insert
+            truth = rng.integers(0, 4, size=insert).astype(np.uint8)
+            umi = CODE_TO_BASE[rng.integers(0, 4, size=umi_length)].tobytes().decode()
+            cigar = [("M", read_length)]
+            mc = f"{read_length}M".encode()
+
+            def mutate(segment):
+                codes = segment.copy()
+                errs = rng.random(len(codes)) < error_rate
+                n_err = int(errs.sum())
+                if n_err:
+                    codes[errs] = (codes[errs] + rng.integers(1, 4, n_err)) % 4
+                return CODE_TO_BASE[codes].tobytes()
+
+            def qgen():
+                return np.clip(
+                    base_quality + rng.integers(-qual_jitter, qual_jitter + 1,
+                                                read_length), 2, 40).astype(np.uint8)
+
+            for r in range(pairs_per_molecule):
+                name = f"codec{mol}:{r}".encode()
+                tags = [(b"MC", "Z", mc), (b"RG", "Z", b"A"),
+                        (b"MI", "Z", str(mol).encode()),
+                        (b"RX", "Z", umi.encode())]
+                rec1 = _build_mapped_record(
+                    name, FLAG_PAIRED | FLAG_FIRST | FLAG_MATE_REVERSE, 0, start,
+                    60, cigar, mutate(truth[:read_length]), qgen(),
+                    0, r2_pos, insert, tags)
+                rec2 = _build_mapped_record(
+                    name, FLAG_PAIRED | FLAG_LAST | FLAG_REVERSE, 0, r2_pos,
+                    60, cigar, mutate(truth[insert - read_length:]), qgen(),
+                    0, start, -insert, tags)
+                w.write_record_bytes(rec1)
+                w.write_record_bytes(rec2)
+                n_written += 2
+    return n_written
+
+
 def simulate_grouped_bam(path: str, num_families: int = 100, family_size: int = 5,
                          family_size_distribution: str = "fixed",
                          read_length: int = 100, error_rate: float = 0.01,

@@ -1,0 +1,223 @@
+"""CODEC consensus caller tests (reference: codec_caller.rs behavior)."""
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.consensus.codec import (CodecConsensusCaller, CodecOptions,
+                                       DuplexDisagreementError)
+from fgumi_tpu.io.bam import (BamReader, FLAG_FIRST, FLAG_LAST,
+                              FLAG_MATE_REVERSE, FLAG_PAIRED, FLAG_REVERSE,
+                              RawRecord)
+from fgumi_tpu.simulate import _build_mapped_record, simulate_codec_bam
+
+READ_LEN = 20
+INSERT = 30  # overlap = 10
+
+
+def _pair(name=b"p1", mi=b"m1", seq1=None, seq2=None, q1=30, q2=30,
+          start=1000, insert=INSERT, read_len=READ_LEN, rx=None):
+    """One FR pair: R1 forward at start, R2 reverse overlapping (ref orientation)."""
+    seq1 = seq1 or b"A" * read_len
+    seq2 = seq2 or b"A" * read_len
+    quals1 = np.full(read_len, q1, dtype=np.uint8) if np.isscalar(q1) else np.asarray(q1)
+    quals2 = np.full(read_len, q2, dtype=np.uint8) if np.isscalar(q2) else np.asarray(q2)
+    r2_pos = start + insert - read_len
+    cigar = [("M", read_len)]
+    mc = f"{read_len}M".encode()
+    tags = [(b"MC", "Z", mc), (b"MI", "Z", mi)]
+    if rx:
+        tags.append((b"RX", "Z", rx))
+    rec1 = _build_mapped_record(name, FLAG_PAIRED | FLAG_FIRST | FLAG_MATE_REVERSE,
+                                0, start, 60, cigar, seq1, quals1,
+                                0, r2_pos, insert, tags)
+    rec2 = _build_mapped_record(name, FLAG_PAIRED | FLAG_LAST | FLAG_REVERSE,
+                                0, r2_pos, 60, cigar, seq2, quals2,
+                                0, start, -insert, tags)
+    return [RawRecord(rec1), RawRecord(rec2)]
+
+
+def _parse_tags(data: bytes):
+    rec = RawRecord(data) if isinstance(data, bytes) else data
+    return rec
+
+
+def test_single_pair_perfect_agreement():
+    caller = CodecConsensusCaller("codec", "A", CodecOptions(produce_per_base_tags=True))
+    # R2 covers positions [10, 30) of the molecule; overlap region is [10, 20)
+    recs = _pair(seq1=b"ACGTACGTACGTACGTACGT", seq2=b"GTACGTACGTACGTACGTAC")
+    out = caller.call_groups([("m1", recs)])
+    assert len(out) == 1
+    rec = RawRecord(out[0])
+    assert rec.flag == 0x4  # unmapped fragment
+    assert rec.l_seq == INSERT
+    # molecule = R1's 20bp then R2's trailing 10bp (overlap agrees)
+    assert rec.seq_bytes() == b"ACGTACGTACGTACGTACGT" + b"ACGTACGTAC"
+    # overlap agreement: qualities sum; single-strand regions keep SS quality
+    quals = rec.quals()
+    assert (quals[10:20] > quals[:10]).all()
+    # per-base tags present with lowercase-n padding on the SS consensus strings
+    ac = rec.get_str(b"ac")
+    bc = rec.get_str(b"bc")
+    assert ac is not None and bc is not None
+    assert ac[20:].count("n") == 10  # R1 padded right
+    assert bc[:10].count("n") == 10  # R2 padded left
+    assert rec.get_int(b"cD") == 2  # both strands in the overlap
+    assert rec.get_int(b"cM") == 1
+    assert rec.get_str(b"MI") == "m1"
+
+
+def test_overlap_agreement_sums_quality_capped():
+    caller = CodecConsensusCaller("c", "A", CodecOptions())
+    recs = _pair(q1=60, q2=60)
+    out = caller.call_groups([("m1", recs)])
+    quals = RawRecord(out[0]).quals()
+    # agreement sums the two SS qualities (tails carry the SS quality), cap Q93
+    ss_q = int(quals[0])
+    assert (quals[10:20] == min(93, 2 * ss_q)).all()
+
+
+def test_overlap_disagreement_higher_quality_wins():
+    # R1 has C at molecule position 10 (its index 10), R2 has A there (its index 0)
+    seq1 = bytearray(b"A" * READ_LEN)
+    seq1[10] = ord("C")
+    caller = CodecConsensusCaller("c", "A",
+                                  CodecOptions(produce_per_base_tags=True))
+    recs = _pair(seq1=bytes(seq1), q1=40, q2=20)
+    out = caller.call_groups([("m1", recs)])
+    rec = RawRecord(out[0])
+    assert rec.seq_bytes()[10:11] == b"C"  # higher-quality strand wins
+    # quality is the difference of the two SS qualities at that position
+    aq = ord(rec.get_str(b"aq")[10]) - 33
+    bq = ord(rec.get_str(b"bq")[10]) - 33
+    assert aq > bq
+    assert rec.quals()[10] == aq - bq
+
+
+def test_overlap_equal_quality_disagreement_masks_to_n():
+    seq1 = bytearray(b"A" * READ_LEN)
+    seq1[10] = ord("C")
+    caller = CodecConsensusCaller("c", "A", CodecOptions())
+    recs = _pair(seq1=bytes(seq1), q1=30, q2=30)
+    rec = RawRecord(caller.call_groups([("m1", recs)])[0])
+    assert rec.seq_bytes()[10:11] == b"N"
+    assert rec.quals()[10] == 2
+
+
+def test_fragment_reads_rejected():
+    caller = CodecConsensusCaller("c", "A", CodecOptions())
+    recs = _pair()
+    # strip the PAIRED flag from a copy of R1 -> fragment
+    frag = bytearray(recs[0].data)
+    import struct
+    flag = struct.unpack_from("<H", frag, 14)[0] & ~FLAG_PAIRED
+    struct.pack_into("<H", frag, 14, flag)
+    out = caller.call_groups([("m1", [RawRecord(bytes(frag))])])
+    assert out == []
+    assert caller.stats.rejection_reasons.get("FragmentRead") == 1
+
+
+def test_non_fr_pair_rejected():
+    # both reads forward -> not FR
+    recs = _pair()
+    import struct
+    buf = bytearray(recs[1].data)
+    flag = struct.unpack_from("<H", buf, 14)[0] & ~FLAG_REVERSE
+    struct.pack_into("<H", buf, 14, flag)
+    caller = CodecConsensusCaller("c", "A", CodecOptions())
+    out = caller.call_groups([("m1", [recs[0], RawRecord(bytes(buf))])])
+    assert out == []
+    assert caller.stats.rejection_reasons.get("NotPrimaryFrPair") == 2
+
+
+def test_min_duplex_length_reject():
+    caller = CodecConsensusCaller("c", "A", CodecOptions(min_duplex_length=50))
+    out = caller.call_groups([("m1", _pair())])  # overlap is only 10
+    assert out == []
+    assert caller.stats.rejection_reasons.get("InsufficientOverlap") == 2
+
+
+def test_high_duplex_disagreement_drops_group():
+    seq1 = bytearray(b"A" * READ_LEN)
+    seq1[10] = ord("C")
+    caller = CodecConsensusCaller(
+        "c", "A", CodecOptions(max_duplex_disagreements=0), track_rejects=True)
+    recs = _pair(seq1=bytes(seq1), q1=40, q2=20)
+    out = caller.call_groups([("m1", recs)])
+    assert out == []
+    assert caller.stats.consensus_reads_rejected_hdd == 1
+    assert caller.stats.rejection_reasons.get("HighDuplexDisagreement") == 2
+    assert len(caller.rejected_reads) == 2
+
+
+def test_single_strand_qual_mask():
+    caller = CodecConsensusCaller(
+        "c", "A", CodecOptions(single_strand_qual=5, outer_bases_qual=None))
+    rec = RawRecord(caller.call_groups([("m1", _pair(q1=30, q2=30))])[0])
+    quals = rec.quals()
+    assert (quals[:10] == 5).all() and (quals[20:] == 5).all()
+    assert (quals[10:20] > 5).all()
+
+
+def test_outer_bases_qual_mask():
+    caller = CodecConsensusCaller(
+        "c", "A", CodecOptions(outer_bases_qual=7, outer_bases_length=3))
+    rec = RawRecord(caller.call_groups([("m1", _pair())])[0])
+    quals = rec.quals()
+    assert (quals[:3] == 7).all() and (quals[-3:] == 7).all()
+
+
+def test_rx_consensus_from_all_records():
+    caller = CodecConsensusCaller("c", "A", CodecOptions())
+    rec = RawRecord(caller.call_groups([("m1", _pair(rx=b"ACGTACGT"))])[0])
+    assert rec.get_str(b"RX") == "ACGTACGT"
+
+
+def test_multiple_pairs_deepen_consensus():
+    recs = _pair(name=b"p1") + _pair(name=b"p2")
+    caller = CodecConsensusCaller("c", "A", CodecOptions(produce_per_base_tags=True))
+    rec = RawRecord(caller.call_groups([("m1", recs)])[0])
+    assert rec.get_int(b"cD") == 4  # 2 per strand in the overlap
+    assert rec.get_int(b"cM") == 2
+
+
+def test_min_reads_per_strand():
+    caller = CodecConsensusCaller("c", "A", CodecOptions(min_reads_per_strand=2))
+    out = caller.call_groups([("m1", _pair())])
+    assert out == []
+    assert caller.stats.rejection_reasons.get("InsufficientReads") == 2
+
+
+def test_codec_cli_e2e(tmp_path):
+    from fgumi_tpu.cli import main
+
+    in_bam = str(tmp_path / "in.bam")
+    out_bam = str(tmp_path / "out.bam")
+    rej_bam = str(tmp_path / "rej.bam")
+    simulate_codec_bam(in_bam, num_molecules=30, pairs_per_molecule=2,
+                       read_length=50, error_rate=0.005, seed=7)
+    rc = main(["codec", "-i", in_bam, "-o", out_bam, "-r", rej_bam,
+               "--per-base-tags"])
+    assert rc == 0
+    with BamReader(out_bam) as r:
+        recs = list(r)
+    assert len(recs) == 30
+    for rec in recs:
+        assert rec.flag == 0x4
+        assert rec.l_seq == 75  # insert = 2*50 - 25
+        assert rec.get_str(b"MI") is not None
+        assert rec.get_str(b"RX") is not None
+
+
+def test_codec_deterministic(tmp_path):
+    from fgumi_tpu.cli import main
+
+    in_bam = str(tmp_path / "in.bam")
+    simulate_codec_bam(in_bam, num_molecules=20, pairs_per_molecule=3,
+                       read_length=40, error_rate=0.02, seed=3)
+    outs = []
+    for i in range(2):
+        out = str(tmp_path / f"out{i}.bam")
+        assert main(["codec", "-i", in_bam, "-o", out]) == 0
+        with BamReader(out) as r:
+            outs.append([rec.data for rec in r])
+    assert outs[0] == outs[1]

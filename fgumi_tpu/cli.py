@@ -165,6 +165,40 @@ def cmd_duplex(args):
     return 0
 
 
+def _add_compare(sub):
+    p = sub.add_parser("compare", help="Compare files for testing and validation")
+    ps = p.add_subparsers(dest="compare_mode", required=True)
+    b = ps.add_parser("bams", help="Compare two BAMs (exit 1 on mismatch)")
+    b.add_argument("-a", required=True, help="first BAM")
+    b.add_argument("-b", required=True, help="second BAM")
+    b.add_argument("--mode", choices=["content", "grouping"], default="content",
+                   help="content: exact record compare; grouping: MI-invariant "
+                        "molecule equivalence")
+    b.add_argument("--ignore-order", action="store_true",
+                   help="content mode: compare as multisets")
+    b.add_argument("--ignore-tags", nargs="*", default=[],
+                   help="tags excluded from comparison")
+    b.add_argument("--tag", default="MI", help="grouping tag (grouping mode)")
+    b.set_defaults(func=_cmd_compare_bams)
+    m = ps.add_parser("metrics", help="Compare two metric TSVs (exit 1 on mismatch)")
+    m.add_argument("-a", required=True)
+    m.add_argument("-b", required=True)
+    m.add_argument("--float-tolerance", type=float, default=1e-5)
+    m.set_defaults(func=_cmd_compare_metrics)
+
+
+def _cmd_compare_bams(args):
+    from .commands.compare import run_compare_bams
+
+    return run_compare_bams(args)
+
+
+def _cmd_compare_metrics(args):
+    from .commands.compare import run_compare_metrics
+
+    return run_compare_metrics(args)
+
+
 def _add_codec(sub):
     p = sub.add_parser(
         "codec",
@@ -1170,6 +1204,7 @@ def main(argv=None):
     _add_simplex(sub)
     _add_duplex(sub)
     _add_codec(sub)
+    _add_compare(sub)
     _add_filter(sub)
     _add_clip(sub)
     _add_group(sub)

@@ -65,8 +65,7 @@ def parse_soft_clips_and_ref_len(cigar_str: str):
     return leading_soft, ref_len, trailing_soft
 
 
-def _ref_len_from_cigar(cigar) -> int:
-    return sum(n for op, n in cigar if op in "MDN=X")
+from .cigar import reference_length as _ref_len_from_cigar  # noqa: E402 (shared impl)
 
 
 def _read_len_from_cigar(cigar) -> int:

@@ -1,0 +1,162 @@
+"""compare command tests (reference: src/lib/commands/compare/ semantics)."""
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.cli import main
+from fgumi_tpu.commands.compare import (compare_bams_content,
+                                        compare_bams_grouping, compare_metrics)
+from fgumi_tpu.io.bam import BamHeader, BamReader, BamWriter, RawRecord
+from fgumi_tpu.simulate import simulate_grouped_bam
+
+
+@pytest.fixture
+def grouped_bam(tmp_path):
+    path = str(tmp_path / "a.bam")
+    simulate_grouped_bam(path, num_families=10, family_size=3, read_length=40,
+                         seed=11)
+    return path
+
+
+def _rewrite(src, dst, transform):
+    """Copy records through `transform(index, data)->bytes|None(drop)`."""
+    with BamReader(src) as r:
+        recs = list(r)
+        header = r.header
+    with BamWriter(dst, header) as w:
+        for i, rec in enumerate(recs):
+            data = transform(i, rec.data)
+            if data is not None:
+                w.write_record_bytes(data)
+
+
+def test_identical_bams_match(grouped_bam, tmp_path):
+    other = str(tmp_path / "b.bam")
+    _rewrite(grouped_bam, other, lambda i, d: d)
+    assert compare_bams_content(grouped_bam, other) == []
+    assert main(["compare", "bams", "-a", grouped_bam, "-b", other]) == 0
+
+
+def test_perturbed_base_detected(grouped_bam, tmp_path):
+    other = str(tmp_path / "b.bam")
+
+    def flip(i, d):
+        if i != 4:
+            return d
+        buf = bytearray(d)
+        rec = RawRecord(d)
+        off = rec._seq_off()
+        buf[off] ^= 0xFF  # corrupt packed bases
+        return bytes(buf)
+
+    _rewrite(grouped_bam, other, flip)
+    mismatches = compare_bams_content(grouped_bam, other)
+    assert mismatches and "sequence differs" in mismatches[0]
+    assert main(["compare", "bams", "-a", grouped_bam, "-b", other]) == 1
+
+
+def test_missing_record_detected(grouped_bam, tmp_path):
+    other = str(tmp_path / "b.bam")
+    _rewrite(grouped_bam, other, lambda i, d: None if i == 0 else d)
+    assert any("counts differ" in m for m in compare_bams_content(grouped_bam, other))
+
+
+def test_reordered_records_mismatch_without_ignore_order(grouped_bam, tmp_path):
+    other = str(tmp_path / "b.bam")
+    with BamReader(grouped_bam) as r:
+        recs = [rec.data for rec in r]
+        header = r.header
+    recs[0], recs[1] = recs[1], recs[0]
+    with BamWriter(other, header) as w:
+        for d in recs:
+            w.write_record_bytes(d)
+    assert compare_bams_content(grouped_bam, other) != []
+    assert compare_bams_content(grouped_bam, other, ignore_order=True) == []
+
+
+def test_tag_value_compare_is_order_and_width_independent(tmp_path):
+    header = BamHeader(text="@HD\tVN:1.6\n", ref_names=[], ref_lengths=[])
+    from fgumi_tpu.io.bam import RecordBuilder
+
+    def make(path, tag_order):
+        b = RecordBuilder()
+        with BamWriter(path, header) as w:
+            b.start_unmapped(b"r1", 4, b"ACGT", np.full(4, 30, np.uint8))
+            for tag, val in tag_order:
+                if isinstance(val, bytes):
+                    b.tag_str(tag, val)
+                else:
+                    b.tag_int(tag, val)
+            w.write_record_bytes(b.finish())
+
+    a, c = str(tmp_path / "a.bam"), str(tmp_path / "c.bam")
+    make(a, [(b"RG", b"A"), (b"cD", 7)])
+    make(c, [(b"cD", 7), (b"RG", b"A")])
+    assert compare_bams_content(a, c) == []
+
+
+def test_ignore_tags(grouped_bam, tmp_path):
+    other = str(tmp_path / "b.bam")
+
+    def strip_mi(i, d):
+        return RawRecord(d).data_without_tag(b"MI")
+
+    _rewrite(grouped_bam, other, strip_mi)
+    assert compare_bams_content(grouped_bam, other) != []
+    assert compare_bams_content(grouped_bam, other,
+                                ignore_tags=frozenset([b"MI"])) == []
+
+
+def test_grouping_mode_invariant_to_mi_renumbering(grouped_bam, tmp_path):
+    other = str(tmp_path / "b.bam")
+
+    def renumber(i, d):
+        rec = RawRecord(d)
+        mi = rec.get_str(b"MI")
+        stripped = rec.data_without_tag(b"MI")
+        new_mi = str(int(mi) + 100).encode()
+        return stripped + b"MIZ" + new_mi + b"\x00"
+
+    _rewrite(grouped_bam, other, renumber)
+    # content mode sees the MI difference; grouping mode does not
+    assert compare_bams_content(grouped_bam, other) != []
+    assert compare_bams_grouping(grouped_bam, other) == []
+    assert main(["compare", "bams", "--mode", "grouping",
+                 "-a", grouped_bam, "-b", other]) == 0
+
+
+def test_grouping_mode_detects_split_molecule(grouped_bam, tmp_path):
+    other = str(tmp_path / "b.bam")
+    seen = {"n": 0}
+
+    def split(i, d):
+        rec = RawRecord(d)
+        mi = rec.get_str(b"MI")
+        if mi == "3" and seen["n"] < 2:
+            seen["n"] += 1
+            stripped = rec.data_without_tag(b"MI")
+            return stripped + b"MIZ" + b"999" + b"\x00"
+        return d
+
+    _rewrite(grouped_bam, other, split)
+    assert compare_bams_grouping(grouped_bam, other) != []
+
+
+def test_compare_metrics(tmp_path):
+    a = tmp_path / "a.tsv"
+    b = tmp_path / "b.tsv"
+    a.write_text("name\tcount\trate\nx\t5\t0.123456\ny\t7\t1.0\n")
+    b.write_text("name\tcount\trate\nx\t5\t0.123457\ny\t7\t1.0\n")
+    assert compare_metrics(str(a), str(b)) == []  # within tolerance
+    assert compare_metrics(str(a), str(b), float_tolerance=1e-9) != []
+    b.write_text("name\tcount\trate\nx\t5\t0.123456\ny\t8\t1.0\n")
+    assert compare_metrics(str(a), str(b)) != []
+    assert main(["compare", "metrics", "-a", str(a), "-b", str(b)]) == 1
+
+
+def test_compare_metrics_column_mismatch(tmp_path):
+    a = tmp_path / "a.tsv"
+    b = tmp_path / "b.tsv"
+    a.write_text("name\tcount\nx\t5\n")
+    b.write_text("name\ttotal\nx\t5\n")
+    assert any("columns differ" in m for m in compare_metrics(str(a), str(b)))

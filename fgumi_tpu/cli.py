@@ -24,6 +24,15 @@ def _unmapped_consensus_header(read_group_id: str):
         ref_names=[], ref_lengths=[])
 
 
+def _parse_bool(s: str) -> bool:
+    """fgbio-style boolean flag values (commands/common.rs parse_bool)."""
+    if s.lower() in ("true", "t", "yes", "y", "1"):
+        return True
+    if s.lower() in ("false", "f", "no", "n", "0"):
+        return False
+    raise argparse.ArgumentTypeError(f"expected true/false, got {s!r}")
+
+
 def _add_simplex(sub):
     p = sub.add_parser("simplex", help="Call simplex consensus reads over MI groups")
     p.add_argument("-i", "--input", required=True, help="grouped BAM (MI tags)")
@@ -41,6 +50,10 @@ def _add_simplex(sub):
     p.add_argument("--no-per-base-tags", action="store_true")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--allow-unmapped", action="store_true")
+    p.add_argument("--consensus-call-overlapping-bases", type=_parse_bool,
+                   nargs="?", const=True, default=True, metavar="true|false",
+                   help="pre-correct R1/R2 insert-overlap bases before UMI "
+                        "consensus (default true)")
     p.add_argument("--batch-groups", type=int, default=2000,
                    help="MI groups per device batch")
     p.set_defaults(func=cmd_simplex)
@@ -77,6 +90,11 @@ def cmd_simplex(args):
     t0 = time.monotonic()
     with BamReader(args.input) as reader:
         out_header = _unmapped_consensus_header(args.read_group_id)
+        oc_caller = None
+        if args.consensus_call_overlapping_bases:
+            from .consensus.overlapping import (OverlappingBasesConsensusCaller,
+                                                apply_overlapping_consensus)
+            oc_caller = OverlappingBasesConsensusCaller("consensus", "consensus")
         with BamWriter(args.output, out_header) as writer:
             n_out = 0
             allow_unmapped = args.allow_unmapped
@@ -84,6 +102,9 @@ def cmd_simplex(args):
             for batch in iter_mi_group_batches(reader, args.batch_groups,
                                                tag=args.tag.encode(),
                                                record_filter=pregroup):
+                if oc_caller is not None:
+                    batch = [(umi, apply_overlapping_consensus(recs, oc_caller))
+                             for umi, recs in batch]
                 for rec_bytes in caller.call_groups(batch):
                     writer.write_record_bytes(rec_bytes)
                     n_out += 1
@@ -91,6 +112,11 @@ def cmd_simplex(args):
     s = caller.stats
     log.info("simplex: %d input reads -> %d consensus reads in %.2fs (%.0f reads/s)",
              s.input_reads, n_out, dt, s.input_reads / dt if dt else 0)
+    if oc_caller is not None and oc_caller.stats.overlapping_bases:
+        ocs = oc_caller.stats
+        log.info("overlap correction: %d overlapping bases, %d agree, %d disagree, "
+                 "%d corrected", ocs.overlapping_bases, ocs.bases_agreeing,
+                 ocs.bases_disagreeing, ocs.bases_corrected)
     if s.rejected:
         log.info("rejections: %s", dict(sorted(s.rejected.items())))
     kf, kt = caller.kernel.fallback_positions, caller.kernel.total_positions
@@ -116,6 +142,10 @@ def _add_duplex(sub):
     p.add_argument("--no-per-base-tags", action="store_true")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--allow-unmapped", action="store_true")
+    p.add_argument("--consensus-call-overlapping-bases", type=_parse_bool,
+                   nargs="?", const=True, default=True, metavar="true|false",
+                   help="pre-correct R1/R2 insert-overlap bases before UMI "
+                        "consensus (default true)")
     p.add_argument("--batch-molecules", type=int, default=1000)
     p.set_defaults(func=cmd_duplex)
 
@@ -139,6 +169,11 @@ def cmd_duplex(args):
 
     t0 = time.monotonic()
     allow_unmapped = args.allow_unmapped
+    oc_caller = None
+    if args.consensus_call_overlapping_bases:
+        from .consensus.overlapping import (OverlappingBasesConsensusCaller,
+                                            apply_overlapping_consensus)
+        oc_caller = OverlappingBasesConsensusCaller("consensus", "consensus")
     with BamReader(args.input) as reader:
         out_header = _unmapped_consensus_header(args.read_group_id)
         with BamWriter(args.output, out_header) as writer:
@@ -146,6 +181,11 @@ def cmd_duplex(args):
             pregroup = lambda r: consensus_pregroup_keep(r.flag, allow_unmapped)
             batch = []
             for group in iter_duplex_groups(reader, record_filter=pregroup):
+                if oc_caller is not None:
+                    base_mi, a_recs, b_recs = group
+                    group = (base_mi,
+                             apply_overlapping_consensus(a_recs, oc_caller),
+                             apply_overlapping_consensus(b_recs, oc_caller))
                 batch.append(group)
                 if len(batch) >= args.batch_molecules:
                     for rec_bytes in caller.call_groups(batch):
@@ -160,6 +200,11 @@ def cmd_duplex(args):
     s = caller.merged_stats()
     log.info("duplex: %d input reads -> %d consensus reads in %.2fs (%.0f reads/s)",
              s.input_reads, n_out, dt, s.input_reads / dt if dt else 0)
+    if oc_caller is not None and oc_caller.stats.overlapping_bases:
+        ocs = oc_caller.stats
+        log.info("overlap correction: %d overlapping bases, %d agree, %d disagree, "
+                 "%d corrected", ocs.overlapping_bases, ocs.bases_agreeing,
+                 ocs.bases_disagreeing, ocs.bases_corrected)
     if s.rejected:
         log.info("rejections: %s", dict(sorted(s.rejected.items())))
     return 0

@@ -1,0 +1,212 @@
+"""Overlapping-pair base pre-correction before UMI consensus.
+
+Port of the semantics of /root/reference/crates/fgumi-consensus/src/overlapping.rs:
+R1/R2 of a template that overlap in their insert sequence the same molecule
+positions; those bases are consensus-corrected *in place* before UMI consensus
+so they are not double-counted (overlapping.rs:1-6).
+
+- Aligned positions only (M/=/X), paired by shared reference position via a
+  merge walk (ReadMateAndRefPosIterator, overlapping.rs:560-620) — here a
+  vectorized intersect over each read's aligned (ref_pos, read_offset) arrays.
+- No-call bases (N/n/.) are skipped entirely (overlapping.rs:13-18, 287-289).
+- Agreement strategies (overlapping.rs:20-28): consensus (sum quals, cap Q93),
+  max-qual, pass-through.
+- Disagreement strategies (overlapping.rs:30-39): consensus (higher-quality
+  base wins with the quality difference; equal quality masks both to N/Q2),
+  mask-both, mask-lower-qual (tie masks both).
+- apply_overlapping_consensus pairs primary R1/R2 records by name within a
+  group (overlapping.rs:625-676).
+"""
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..constants import MIN_PHRED, NO_CALL_BASE, NO_CALL_BASE_LOWER
+from ..io.bam import (BASE_TO_NIBBLE, FLAG_FIRST, FLAG_LAST, FLAG_SECONDARY,
+                      FLAG_SUPPLEMENTARY, FLAG_UNMAPPED, RawRecord)
+
+AGREEMENT_STRATEGIES = ("consensus", "max-qual", "pass-through")
+DISAGREEMENT_STRATEGIES = ("consensus", "mask-both", "mask-lower-qual")
+
+
+@dataclass
+class CorrectionStats:
+    """CorrectionStats analog (overlapping.rs:41-77)."""
+
+    overlapping_bases: int = 0
+    bases_agreeing: int = 0
+    bases_disagreeing: int = 0
+    bases_corrected: int = 0
+
+    def merge(self, other: "CorrectionStats"):
+        self.overlapping_bases += other.overlapping_bases
+        self.bases_agreeing += other.bases_agreeing
+        self.bases_disagreeing += other.bases_disagreeing
+        self.bases_corrected += other.bases_corrected
+
+
+def aligned_positions(rec: RawRecord):
+    """(ref_pos 1-based, read_offset 0-based) arrays for M/=/X positions."""
+    refs = []
+    offs = []
+    ref_pos = rec.pos + 1
+    read_off = 0
+    for op, n in rec.cigar():
+        if op in "M=X":
+            refs.append(np.arange(ref_pos, ref_pos + n, dtype=np.int64))
+            offs.append(np.arange(read_off, read_off + n, dtype=np.int64))
+            ref_pos += n
+            read_off += n
+        elif op in "IS":
+            read_off += n
+        elif op in "DN":
+            ref_pos += n
+    if not refs:
+        return (np.empty(0, np.int64), np.empty(0, np.int64))
+    return np.concatenate(refs), np.concatenate(offs)
+
+
+def _write_back(rec: RawRecord, seq: np.ndarray, quals: np.ndarray) -> RawRecord:
+    """New record bytes with sequence (ASCII array) and qualities replaced."""
+    buf = bytearray(rec.data)
+    nibbles = BASE_TO_NIBBLE[seq]
+    if len(seq) % 2:
+        nibbles = np.append(nibbles, 0)
+    packed = ((nibbles[0::2] << 4) | nibbles[1::2]).astype(np.uint8)
+    s_off = rec._seq_off()
+    buf[s_off : s_off + len(packed)] = packed.tobytes()
+    q_off = rec._qual_off()
+    buf[q_off : q_off + len(quals)] = np.asarray(quals, np.uint8).tobytes()
+    return RawRecord(bytes(buf))
+
+
+class OverlappingBasesConsensusCaller:
+    """In-place overlap corrector for one R1/R2 pair (overlapping.rs:80-345)."""
+
+    def __init__(self, agreement: str = "consensus",
+                 disagreement: str = "consensus"):
+        if agreement not in AGREEMENT_STRATEGIES:
+            raise ValueError(f"unknown agreement strategy {agreement!r}")
+        if disagreement not in DISAGREEMENT_STRATEGIES:
+            raise ValueError(f"unknown disagreement strategy {disagreement!r}")
+        self.agreement = agreement
+        self.disagreement = disagreement
+        self.stats = CorrectionStats()
+
+    def call(self, r1: RawRecord, r2: RawRecord):
+        """Returns (r1', r2', processed): corrected records (or the originals)
+        and whether the pair overlapped at all."""
+        if (r1.flag | r2.flag) & FLAG_UNMAPPED or r1.ref_id != r2.ref_id:
+            return r1, r2, False
+        if r1.reference_length() == 0 or r2.reference_length() == 0:
+            return r1, r2, False
+
+        ref1, off1 = aligned_positions(r1)
+        ref2, off2 = aligned_positions(r2)
+        _, i1, i2 = np.intersect1d(ref1, ref2, assume_unique=True,
+                                   return_indices=True)
+        if len(i1) == 0:
+            return r1, r2, False
+        o1, o2 = off1[i1], off2[i2]
+
+        seq1 = np.frombuffer(r1.seq_bytes(), dtype=np.uint8).copy()
+        seq2 = np.frombuffer(r2.seq_bytes(), dtype=np.uint8).copy()
+        q1 = r1.quals().copy()
+        q2 = r2.quals().copy()
+
+        b1, b2 = seq1[o1], seq2[o2]
+        no_call = np.isin(b1, (NO_CALL_BASE, NO_CALL_BASE_LOWER, ord("."))) | \
+            np.isin(b2, (NO_CALL_BASE, NO_CALL_BASE_LOWER, ord(".")))
+        keep = ~no_call
+        o1, o2, b1, b2 = o1[keep], o2[keep], b1[keep], b2[keep]
+        if len(o1) == 0:
+            return r1, r2, True
+        qa = q1[o1].astype(np.int32)
+        qb = q2[o2].astype(np.int32)
+
+        agree = b1 == b2
+        n_agree = int(agree.sum())
+        n_disagree = len(b1) - n_agree
+        self.stats.overlapping_bases += len(b1)
+        self.stats.bases_agreeing += n_agree
+        self.stats.bases_disagreeing += n_disagree
+        modified = False
+
+        if n_agree and self.agreement != "pass-through":
+            ai1, ai2 = o1[agree], o2[agree]
+            if self.agreement == "consensus":
+                new_q = np.minimum(qa[agree] + qb[agree], 93)
+            else:  # max-qual
+                new_q = np.maximum(qa[agree], qb[agree])
+            changed = (new_q != qa[agree]) | (new_q != qb[agree])
+            self.stats.bases_corrected += int(changed.sum())
+            if changed.any():
+                modified = True
+            q1[ai1] = new_q
+            q2[ai2] = new_q
+
+        if n_disagree:
+            modified = True
+            d = ~agree
+            di1, di2 = o1[d], o2[d]
+            da, db = qa[d], qb[d]
+            ba_, bb_ = b1[d], b2[d]
+            if self.disagreement == "consensus":
+                # higher quality wins with the difference; tie -> N/Q2 both
+                win_a = da > db
+                win_b = db > da
+                tie = da == db
+                new_base = np.where(tie, NO_CALL_BASE, np.where(win_a, ba_, bb_))
+                new_q = np.where(
+                    tie, MIN_PHRED,
+                    np.maximum(np.abs(da - db), MIN_PHRED))
+                seq1[di1] = new_base
+                seq2[di2] = new_base
+                q1[di1] = new_q
+                q2[di2] = new_q
+                self.stats.bases_corrected += 2 * n_disagree
+            elif self.disagreement == "mask-both":
+                seq1[di1] = NO_CALL_BASE
+                seq2[di2] = NO_CALL_BASE
+                q1[di1] = MIN_PHRED
+                q2[di2] = MIN_PHRED
+                self.stats.bases_corrected += 2 * n_disagree
+            else:  # mask-lower-qual: lower masked; tie masks both
+                mask1 = da <= db
+                mask2 = db <= da
+                seq1[di1[mask1]] = NO_CALL_BASE
+                q1[di1[mask1]] = MIN_PHRED
+                seq2[di2[mask2]] = NO_CALL_BASE
+                q2[di2[mask2]] = MIN_PHRED
+                self.stats.bases_corrected += int(mask1.sum()) + int(mask2.sum())
+
+        if not modified:
+            return r1, r2, True
+        return _write_back(r1, seq1, q1), _write_back(r2, seq2, q2), True
+
+
+def apply_overlapping_consensus(records: list,
+                                caller: OverlappingBasesConsensusCaller) -> list:
+    """Correct every primary R1/R2 pair (matched by name) within a group.
+
+    Returns the records list with corrected pairs replaced in position
+    (apply_overlapping_consensus, overlapping.rs:625-676).
+    """
+    pairs = {}
+    for idx, rec in enumerate(records):
+        flg = rec.flag
+        if flg & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY):
+            continue
+        slot = pairs.setdefault(rec.name, [None, None])
+        if flg & FLAG_FIRST:
+            slot[0] = idx
+        elif flg & FLAG_LAST:
+            slot[1] = idx
+    out = list(records)
+    for i1, i2 in pairs.values():
+        if i1 is None or i2 is None:
+            continue
+        r1, r2, _ = caller.call(out[i1], out[i2])
+        out[i1], out[i2] = r1, r2
+    return out

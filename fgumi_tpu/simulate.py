@@ -145,8 +145,11 @@ def simulate_duplex_bam(path: str, num_molecules: int = 100, reads_per_strand: i
             start = int(rng.integers(0, ref_length - 3 * read_length))
             insert = int(rng.integers(int(read_length * 1.5), 3 * read_length))
             r2_pos = start + insert - read_length
-            truth_top = rng.integers(0, 4, size=read_length).astype(np.uint8)
-            truth_bot = rng.integers(0, 4, size=read_length).astype(np.uint8)
+            # one duplex molecule truth over the insert (reference orientation);
+            # the bottom-strand read covers the insert end, in its own orientation
+            molecule = rng.integers(0, 4, size=insert).astype(np.uint8)
+            truth_top = molecule[:read_length]
+            truth_bot = CODE_COMPLEMENT[molecule[insert - read_length:][::-1]]
             umi_codes = rng.integers(0, 4, size=8)
             u1 = CODE_TO_BASE[umi_codes[:4]].tobytes().decode()
             u2 = CODE_TO_BASE[umi_codes[4:]].tobytes().decode()
@@ -289,8 +292,10 @@ def simulate_grouped_bam(path: str, num_families: int = 100, family_size: int = 
                 raise ValueError(family_size_distribution)
             start = int(rng.integers(0, ref_length - 3 * read_length))
             insert = int(rng.integers(int(read_length * 1.5), 3 * read_length))
-            truth_r1 = rng.integers(0, 4, size=read_length).astype(np.uint8)
-            truth_r2 = rng.integers(0, 4, size=read_length).astype(np.uint8)
+            # one molecule truth over the insert: R1/R2 agree where they overlap
+            truth = rng.integers(0, 4, size=insert).astype(np.uint8)
+            truth_r1 = truth[:read_length]
+            truth_r2 = truth[insert - read_length:]
             mi = str(fam)
             cigar = [("M", read_length)]
             mc = f"{read_length}M".encode()

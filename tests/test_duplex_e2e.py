@@ -23,7 +23,8 @@ def dup_bam(tmp_path_factory):
 
 def run_duplex(dup_bam, tmp_path, name, extra=()):
     out = str(tmp_path / name)
-    rc = cli_main(["duplex", "-i", dup_bam, "-o", out, *extra])
+    rc = cli_main(["duplex", "-i", dup_bam, "-o", out,
+                   "--consensus-call-overlapping-bases", "false", *extra])
     assert rc == 0
     return out
 

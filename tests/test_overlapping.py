@@ -1,0 +1,190 @@
+"""Overlapping-pair base pre-correction tests (reference: overlapping.rs)."""
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.consensus.overlapping import (OverlappingBasesConsensusCaller,
+                                             aligned_positions,
+                                             apply_overlapping_consensus)
+from fgumi_tpu.io.bam import (FLAG_FIRST, FLAG_LAST, FLAG_MATE_REVERSE,
+                              FLAG_PAIRED, FLAG_REVERSE, RawRecord)
+from fgumi_tpu.simulate import _build_mapped_record
+
+READ_LEN = 12
+INSERT = 18  # overlap = 6 (positions 6..11 of the molecule)
+
+
+def _pair(seq1=b"AAAAAAAAAAAA", seq2=b"AAAAAAAAAAAA", q1=30, q2=30,
+          cigar1=None, cigar2=None, start=500):
+    q1 = np.full(READ_LEN, q1, np.uint8) if np.isscalar(q1) else np.asarray(q1)
+    q2 = np.full(READ_LEN, q2, np.uint8) if np.isscalar(q2) else np.asarray(q2)
+    r2_pos = start + INSERT - READ_LEN
+    c1 = cigar1 or [("M", READ_LEN)]
+    c2 = cigar2 or [("M", READ_LEN)]
+    rec1 = _build_mapped_record(b"t", FLAG_PAIRED | FLAG_FIRST | FLAG_MATE_REVERSE,
+                                0, start, 60, c1, seq1, q1, 0, r2_pos, INSERT, [])
+    rec2 = _build_mapped_record(b"t", FLAG_PAIRED | FLAG_LAST | FLAG_REVERSE,
+                                0, r2_pos, 60, c2, seq2, q2, 0, start, -INSERT, [])
+    return RawRecord(rec1), RawRecord(rec2)
+
+
+def test_aligned_positions_with_indels():
+    rec, _ = _pair(cigar1=[("S", 2), ("M", 4), ("D", 3), ("M", 3), ("I", 2), ("M", 1)])
+    refs, offs = aligned_positions(rec)
+    # S consumes read only; D consumes ref only; I consumes read only
+    assert list(offs) == [2, 3, 4, 5, 6, 7, 8, 11]
+    assert list(refs) == [501, 502, 503, 504, 508, 509, 510, 511]
+
+
+def test_agreement_consensus_sums_quals():
+    r1, r2 = _pair(q1=30, q2=35)
+    caller = OverlappingBasesConsensusCaller("consensus", "consensus")
+    n1, n2, processed = caller.call(r1, r2)
+    assert processed
+    # overlap: r1 offsets 6..11 align with r2 offsets 0..5
+    assert (n1.quals()[6:] == 65).all()
+    assert (n2.quals()[:6] == 65).all()
+    assert (n1.quals()[:6] == 30).all()  # non-overlap untouched
+    assert (n2.quals()[6:] == 35).all()
+    assert caller.stats.overlapping_bases == 6
+    assert caller.stats.bases_agreeing == 6
+    assert caller.stats.bases_corrected == 6
+
+
+def test_agreement_max_qual():
+    r1, r2 = _pair(q1=30, q2=35)
+    caller = OverlappingBasesConsensusCaller("max-qual", "consensus")
+    n1, n2, _ = caller.call(r1, r2)
+    assert (n1.quals()[6:] == 35).all()
+    assert (n2.quals()[:6] == 35).all()
+
+
+def test_agreement_pass_through():
+    r1, r2 = _pair(q1=30, q2=35)
+    caller = OverlappingBasesConsensusCaller("pass-through", "consensus")
+    n1, n2, _ = caller.call(r1, r2)
+    assert n1.data == r1.data and n2.data == r2.data
+    assert caller.stats.bases_corrected == 0
+
+
+def test_disagreement_consensus_higher_wins():
+    seq2 = bytearray(b"A" * READ_LEN)
+    seq2[0] = ord("G")  # molecule position 6; disagrees with r1's A
+    r1, r2 = _pair(seq2=bytes(seq2), q1=40, q2=25)
+    caller = OverlappingBasesConsensusCaller("pass-through", "consensus")
+    n1, n2, _ = caller.call(r1, r2)
+    assert n1.seq_bytes()[6:7] == b"A" and n2.seq_bytes()[0:1] == b"A"
+    assert n1.quals()[6] == 15 and n2.quals()[0] == 15
+    assert caller.stats.bases_disagreeing == 1
+    assert caller.stats.bases_corrected == 2
+
+
+def test_disagreement_consensus_tie_masks_both():
+    seq2 = bytearray(b"A" * READ_LEN)
+    seq2[0] = ord("G")
+    r1, r2 = _pair(seq2=bytes(seq2), q1=30, q2=30)
+    caller = OverlappingBasesConsensusCaller("pass-through", "consensus")
+    n1, n2, _ = caller.call(r1, r2)
+    assert n1.seq_bytes()[6:7] == b"N" and n2.seq_bytes()[0:1] == b"N"
+    assert n1.quals()[6] == 2 and n2.quals()[0] == 2
+
+
+def test_disagreement_mask_both():
+    seq2 = bytearray(b"A" * READ_LEN)
+    seq2[0] = ord("G")
+    r1, r2 = _pair(seq2=bytes(seq2), q1=40, q2=25)
+    caller = OverlappingBasesConsensusCaller("pass-through", "mask-both")
+    n1, n2, _ = caller.call(r1, r2)
+    assert n1.seq_bytes()[6:7] == b"N" and n2.seq_bytes()[0:1] == b"N"
+
+
+def test_disagreement_mask_lower_qual():
+    seq2 = bytearray(b"A" * READ_LEN)
+    seq2[0] = ord("G")
+    r1, r2 = _pair(seq2=bytes(seq2), q1=40, q2=25)
+    caller = OverlappingBasesConsensusCaller("pass-through", "mask-lower-qual")
+    n1, n2, _ = caller.call(r1, r2)
+    assert n1.seq_bytes()[6:7] == b"A"  # higher untouched
+    assert n1.quals()[6] == 40
+    assert n2.seq_bytes()[0:1] == b"N"
+    assert n2.quals()[0] == 2
+    assert caller.stats.bases_corrected == 1
+
+
+def test_no_call_bases_skipped():
+    seq1 = bytearray(b"A" * READ_LEN)
+    seq1[6] = ord("N")
+    r1, r2 = _pair(seq1=bytes(seq1))
+    caller = OverlappingBasesConsensusCaller("consensus", "consensus")
+    n1, n2, _ = caller.call(r1, r2)
+    assert caller.stats.overlapping_bases == 5  # N position excluded
+    assert n1.quals()[6] == 30  # untouched
+
+
+def test_non_overlapping_pair_untouched():
+    r1, r2 = _pair(start=500)
+    # move r2 far away
+    import struct
+    buf = bytearray(r2.data)
+    struct.pack_into("<i", buf, 4, 5000)
+    r2_far = RawRecord(bytes(buf))
+    caller = OverlappingBasesConsensusCaller("consensus", "consensus")
+    n1, n2, processed = caller.call(r1, r2_far)
+    assert not processed
+    assert n1.data == r1.data
+
+
+def test_deletion_in_overlap_pairs_by_ref_pos():
+    # r1 has a deletion inside the overlap: its aligned ref positions skip 3 bases
+    r1, r2 = _pair(cigar1=[("M", 8), ("D", 3), ("M", 4)], q1=20, q2=30)
+    caller = OverlappingBasesConsensusCaller("consensus", "consensus")
+    n1, n2, processed = caller.call(r1, r2)
+    assert processed
+    # r1 ref span is now 500..514; overlap with r2 (506..517) by shared ref pos only
+    refs1, _ = aligned_positions(r1)
+    refs2, _ = aligned_positions(r2)
+    shared = np.intersect1d(refs1, refs2)
+    assert caller.stats.overlapping_bases == len(shared)
+
+
+def test_apply_overlapping_consensus_group():
+    r1, r2 = _pair(q1=30, q2=30)
+    caller = OverlappingBasesConsensusCaller("consensus", "consensus")
+    out = apply_overlapping_consensus([r1, r2], caller)
+    assert (out[0].quals()[6:] == 60).all()
+    assert (out[1].quals()[:6] == 60).all()
+
+
+def test_duplex_cli_default_overlap_on(tmp_path):
+    from fgumi_tpu.cli import main
+    from fgumi_tpu.io.bam import BamReader
+    from fgumi_tpu.simulate import simulate_duplex_bam
+
+    in_bam = str(tmp_path / "in.bam")
+    simulate_duplex_bam(in_bam, num_molecules=8, reads_per_strand=2,
+                        read_length=40, seed=9)
+    out_bam = str(tmp_path / "out.bam")
+    # default path: overlap correction enabled (exercises the duplex wiring)
+    assert main(["duplex", "-i", in_bam, "-o", out_bam]) == 0
+    with BamReader(out_bam) as r:
+        assert sum(1 for _ in r) == 16  # R1+R2 per molecule
+
+
+def test_simplex_cli_overlap_flag(tmp_path):
+    from fgumi_tpu.cli import main
+    from fgumi_tpu.io.bam import BamReader
+    from fgumi_tpu.simulate import simulate_grouped_bam
+
+    in_bam = str(tmp_path / "in.bam")
+    simulate_grouped_bam(in_bam, num_families=10, family_size=3, read_length=40,
+                         seed=5)
+    on_bam = str(tmp_path / "on.bam")
+    off_bam = str(tmp_path / "off.bam")
+    assert main(["simplex", "-i", in_bam, "-o", on_bam, "--min-reads", "1"]) == 0
+    assert main(["simplex", "-i", in_bam, "-o", off_bam, "--min-reads", "1",
+                 "--consensus-call-overlapping-bases", "false"]) == 0
+    with BamReader(on_bam) as r:
+        n_on = sum(1 for _ in r)
+    with BamReader(off_bam) as r:
+        n_off = sum(1 for _ in r)
+    assert n_on == n_off == 20  # R1+R2 consensus per family

@@ -28,7 +28,10 @@ def sim_bam(tmp_path_factory):
 
 def run_simplex(sim_bam, tmp_path, name, extra=()):
     out = str(tmp_path / name)
-    rc = cli_main(["simplex", "-i", sim_bam, "-o", out, "--min-reads", "1", *extra])
+    # overlap pre-correction off: these tests recompute expected consensus
+    # independently from the raw reads (the overlap path has its own tests)
+    rc = cli_main(["simplex", "-i", sim_bam, "-o", out, "--min-reads", "1",
+                   "--consensus-call-overlapping-bases", "false", *extra])
     assert rc == 0
     return out
 

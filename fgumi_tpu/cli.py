@@ -183,9 +183,12 @@ def cmd_duplex(args):
             for group in iter_duplex_groups(reader, record_filter=pregroup):
                 if oc_caller is not None:
                     base_mi, a_recs, b_recs = group
-                    group = (base_mi,
-                             apply_overlapping_consensus(a_recs, oc_caller),
-                             apply_overlapping_consensus(b_recs, oc_caller))
+                    # skip single-strand groups: no duplex possible anyway
+                    # (duplex.rs:496-499 has_both_strands_raw gate)
+                    if a_recs and b_recs:
+                        group = (base_mi,
+                                 apply_overlapping_consensus(a_recs, oc_caller),
+                                 apply_overlapping_consensus(b_recs, oc_caller))
                 batch.append(group)
                 if len(batch) >= args.batch_molecules:
                     for rec_bytes in caller.call_groups(batch):
@@ -208,6 +211,50 @@ def cmd_duplex(args):
     if s.rejected:
         log.info("rejections: %s", dict(sorted(s.rejected.items())))
     return 0
+
+
+def _add_duplex_metrics(sub):
+    p = sub.add_parser("duplex-metrics",
+                       help="Collect QC metrics for duplex sequencing (grouped BAM)")
+    p.add_argument("-i", "--input", required=True,
+                   help="grouped BAM (MI tags with /A,/B, template-coordinate order)")
+    p.add_argument("-o", "--output", required=True,
+                   help="output path prefix for metric files")
+    p.add_argument("--intervals", default=None,
+                   help="BED or Picard interval list restricting analysis")
+    p.add_argument("--min-ab-reads", type=int, default=1,
+                   help="min AB-strand reads for a family to count as duplex")
+    p.add_argument("--min-ba-reads", type=int, default=1,
+                   help="min BA-strand reads for a family to count as duplex")
+    p.add_argument("--duplex-umi-counts", action="store_true",
+                   help="also write duplex UMI pair counts (memory intensive)")
+    p.set_defaults(func=_cmd_duplex_metrics)
+
+
+def _cmd_duplex_metrics(args):
+    from .commands.duplex_metrics import run_duplex_metrics
+
+    return run_duplex_metrics(args)
+
+
+def _add_simplex_metrics(sub):
+    p = sub.add_parser("simplex-metrics",
+                       help="Collect QC metrics for simplex sequencing (grouped BAM)")
+    p.add_argument("-i", "--input", required=True,
+                   help="grouped BAM (MI tags, template-coordinate order)")
+    p.add_argument("-o", "--output", required=True,
+                   help="output path prefix for metric files")
+    p.add_argument("--intervals", default=None,
+                   help="BED or Picard interval list restricting analysis")
+    p.add_argument("--min-reads", type=int, default=1,
+                   help="min family size counted toward ss_consensus_families")
+    p.set_defaults(func=_cmd_simplex_metrics)
+
+
+def _cmd_simplex_metrics(args):
+    from .commands.simplex_metrics import run_simplex_metrics
+
+    return run_simplex_metrics(args)
 
 
 def _add_compare(sub):
@@ -1249,6 +1296,8 @@ def main(argv=None):
     _add_simplex(sub)
     _add_duplex(sub)
     _add_codec(sub)
+    _add_duplex_metrics(sub)
+    _add_simplex_metrics(sub)
     _add_compare(sub)
     _add_filter(sub)
     _add_clip(sub)

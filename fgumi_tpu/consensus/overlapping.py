@@ -23,8 +23,8 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..constants import MIN_PHRED, NO_CALL_BASE, NO_CALL_BASE_LOWER
-from ..io.bam import (BASE_TO_NIBBLE, FLAG_FIRST, FLAG_LAST, FLAG_SECONDARY,
-                      FLAG_SUPPLEMENTARY, FLAG_UNMAPPED, RawRecord)
+from ..io.bam import (FLAG_FIRST, FLAG_LAST, FLAG_SECONDARY,
+                      FLAG_SUPPLEMENTARY, FLAG_UNMAPPED, RawRecord, pack_seq)
 
 AGREEMENT_STRATEGIES = ("consensus", "max-qual", "pass-through")
 DISAGREEMENT_STRATEGIES = ("consensus", "mask-both", "mask-lower-qual")
@@ -70,12 +70,9 @@ def aligned_positions(rec: RawRecord):
 def _write_back(rec: RawRecord, seq: np.ndarray, quals: np.ndarray) -> RawRecord:
     """New record bytes with sequence (ASCII array) and qualities replaced."""
     buf = bytearray(rec.data)
-    nibbles = BASE_TO_NIBBLE[seq]
-    if len(seq) % 2:
-        nibbles = np.append(nibbles, 0)
-    packed = ((nibbles[0::2] << 4) | nibbles[1::2]).astype(np.uint8)
+    packed = pack_seq(seq)
     s_off = rec._seq_off()
-    buf[s_off : s_off + len(packed)] = packed.tobytes()
+    buf[s_off : s_off + len(packed)] = packed
     q_off = rec._qual_off()
     buf[q_off : q_off + len(quals)] = np.asarray(quals, np.uint8).tobytes()
     return RawRecord(bytes(buf))

@@ -312,6 +312,16 @@ def _read_tag_value(data: bytes, typ: int, off: int):
     raise ValueError(f"unknown aux tag type {c!r}")
 
 
+def pack_seq(seq) -> bytes:
+    """ASCII sequence (bytes or uint8 array) -> BAM 4-bit packed bytes."""
+    codes = BASE_TO_NIBBLE[np.frombuffer(seq, dtype=np.uint8)
+                           if isinstance(seq, (bytes, bytearray))
+                           else np.asarray(seq, dtype=np.uint8)]
+    if len(codes) % 2:
+        codes = np.append(codes, 0)
+    return ((codes[0::2] << 4) | codes[1::2]).astype(np.uint8).tobytes()
+
+
 class RecordBuilder:
     """Builds raw BAM record bytes (mirrors UnmappedSamBuilder, builder.rs:69-200)."""
 
@@ -330,11 +340,7 @@ class RecordBuilder:
                            flag, n, -1, -1, 0)
         buf += name
         buf += b"\x00"
-        # pack sequence to nibbles
-        codes = BASE_TO_NIBBLE[np.frombuffer(seq, dtype=np.uint8)]
-        if n % 2:
-            codes = np.append(codes, 0)
-        buf += ((codes[0::2] << 4) | codes[1::2]).astype(np.uint8).tobytes()
+        buf += pack_seq(seq)
         buf += np.asarray(quals, dtype=np.uint8).tobytes()
         return self
 
@@ -357,10 +363,7 @@ class RecordBuilder:
         buf += b"\x00"
         for op, length in cigar:
             buf += struct.pack("<I", (length << 4) | CIGAR_OPS.index(op))
-        codes = BASE_TO_NIBBLE[np.frombuffer(seq, dtype=np.uint8)]
-        if n % 2:
-            codes = np.append(codes, 0)
-        buf += ((codes[0::2] << 4) | codes[1::2]).astype(np.uint8).tobytes()
+        buf += pack_seq(seq)
         buf += np.asarray(quals, dtype=np.uint8).tobytes()
         return self
 

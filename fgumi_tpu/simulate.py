@@ -25,11 +25,8 @@ def _build_mapped_record(name, flag, ref_id, pos, mapq, cigar_ops, seq, quals,
     op_codes = {"M": 0, "I": 1, "D": 2, "N": 3, "S": 4, "H": 5, "P": 6, "=": 7, "X": 8}
     for op, length in cigar_ops:
         buf += struct.pack("<I", (length << 4) | op_codes[op])
-    from .io.bam import BASE_TO_NIBBLE
-    codes = BASE_TO_NIBBLE[np.frombuffer(seq, dtype=np.uint8)]
-    if len(seq) % 2:
-        codes = np.append(codes, 0)
-    buf += ((codes[0::2] << 4) | codes[1::2]).astype(np.uint8).tobytes()
+    from .io.bam import pack_seq
+    buf += pack_seq(seq)
     buf += np.asarray(quals, dtype=np.uint8).tobytes()
     for tag, typ, value in tags:
         if typ == "Z":

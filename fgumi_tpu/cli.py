@@ -257,6 +257,36 @@ def _cmd_simplex_metrics(args):
     return run_simplex_metrics(args)
 
 
+def _add_review(sub):
+    p = sub.add_parser("review",
+                       help="Extract data to review variant calls from "
+                            "consensus reads")
+    p.add_argument("-i", "--input", required=True,
+                   help="VCF or interval list of variant positions")
+    p.add_argument("-c", "--consensus-bam", required=True,
+                   help="coordinate-sorted consensus BAM")
+    p.add_argument("-g", "--grouped-bam", required=True,
+                   help="coordinate-sorted grouped raw-read BAM")
+    p.add_argument("-r", "--ref", default=None,
+                   help="reference FASTA (required for interval-list input)")
+    p.add_argument("-o", "--output", required=True,
+                   help="output prefix (.consensus.bam/.grouped.bam/.txt)")
+    p.add_argument("-s", "--sample", default=None,
+                   help="sample name for VCF genotype extraction")
+    p.add_argument("-N", "--ignore-ns", type=_parse_bool, nargs="?",
+                   const=True, default=False, metavar="true|false",
+                   help="ignore N bases in consensus reads")
+    p.add_argument("-m", "--maf", type=float, default=0.05,
+                   help="only review variants at or below this MAF")
+    p.set_defaults(func=_cmd_review)
+
+
+def _cmd_review(args):
+    from .commands.review import run_review
+
+    return run_review(args)
+
+
 def _add_compare(sub):
     p = sub.add_parser("compare", help="Compare files for testing and validation")
     ps = p.add_subparsers(dest="compare_mode", required=True)
@@ -1298,6 +1328,7 @@ def main(argv=None):
     _add_codec(sub)
     _add_duplex_metrics(sub)
     _add_simplex_metrics(sub)
+    _add_review(sub)
     _add_compare(sub)
     _add_filter(sub)
     _add_clip(sub)

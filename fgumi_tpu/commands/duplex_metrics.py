@@ -130,14 +130,12 @@ def _safe_consensus(umis: list) -> str:
         return Counter(umis).most_common(1)[0][0]
 
 
-def _update_umi_metrics(collector, group_pairs, base_umi, duplex_umi_counts):
+def _update_umi_metrics(collector, group_pairs, duplex_umi_counts):
     """Per-DS-family UMI consensus + observation counting
-    (duplex_metrics.rs:564-668): RX halves oriented F1R2 by the R1 strand."""
+    (duplex_metrics.rs:564-668): RX halves oriented F1R2 by the R1 strand.
+    `group_pairs` is already one base-UMI family (built per ds_groups entry)."""
     umi1s, umi2s = [], []
     for mi, rx, r1_positive in group_pairs:
-        mi_base = mi[:-2] if mi.endswith(("/A", "/B")) else mi
-        if mi_base != base_umi:
-            continue
         parts = rx.split("-")
         if len(parts) != 2:
             raise ValueError(
@@ -162,11 +160,7 @@ def _update_umi_metrics(collector, group_pairs, base_umi, duplex_umi_counts):
     if duplex_umi_counts and len(consensus) == 2:
         duplex_umi = f"{consensus[0]}-{consensus[1]}"
         expected = {duplex_umi, f"{consensus[1]}-{consensus[0]}"}
-        errors = 0
-        for mi, rx, _pos in group_pairs:
-            mi_base = mi[:-2] if mi.endswith(("/A", "/B")) else mi
-            if mi_base == base_umi and rx not in expected:
-                errors += 1
+        errors = sum(1 for _mi, rx, _pos in group_pairs if rx not in expected)
         collector.duplex_umi_counts.record(duplex_umi, len(umi1s), errors, True)
 
 
@@ -264,7 +258,7 @@ def run_duplex_metrics(args) -> int:
                 collectors[idx].record_ds_family(a_count + b_count)
                 collectors[idx].record_duplex_family(a_count, b_count)
                 if is_full:
-                    _update_umi_metrics(collectors[idx], pairs, base_umi,
+                    _update_umi_metrics(collectors[idx], pairs,
                                         args.duplex_umi_counts)
 
     try:

@@ -1,0 +1,207 @@
+"""review command tests (reference: commands/review.rs semantics)."""
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.cli import main
+from fgumi_tpu.commands.review import (BaseCounts, extract_mi_base,
+                                       format_genotype, format_insert_string,
+                                       load_variants_from_vcf,
+                                       read_number_suffix)
+from fgumi_tpu.io.bam import (BamHeader, BamReader, BamWriter, FLAG_FIRST,
+                              FLAG_LAST, FLAG_MATE_REVERSE, FLAG_PAIRED,
+                              FLAG_REVERSE, RawRecord)
+from fgumi_tpu.simulate import _build_mapped_record
+
+REF_LEN = 10_000
+
+
+def _header():
+    return BamHeader(
+        text="@HD\tVN:1.6\tSO:coordinate\n@SQ\tSN:chr1\tLN:10000\n"
+             "@RG\tID:A\tSM:s\n",
+        ref_names=["chr1"], ref_lengths=[REF_LEN])
+
+
+def _mapped(name, seq, pos, mi, flag=FLAG_PAIRED | FLAG_FIRST | FLAG_MATE_REVERSE,
+            qual=30, mate_pos=None, tlen=None):
+    n = len(seq)
+    mate_pos = mate_pos if mate_pos is not None else pos + 50
+    tlen = tlen if tlen is not None else 50 + n
+    return RawRecord(_build_mapped_record(
+        name, flag, 0, pos, 60, [("M", n)], seq,
+        np.full(n, qual, np.uint8), 0, mate_pos, tlen,
+        [(b"MI", "Z", mi), (b"RG", "Z", b"A")]))
+
+
+def _write_bam(path, recs):
+    with BamWriter(str(path), _header()) as w:
+        for r in recs:
+            w.write_record(r)
+
+
+def _vcf(path, rows, sample=None):
+    lines = ["##fileformat=VCFv4.2"]
+    header = "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO"
+    if sample:
+        header += f"\tFORMAT\t{sample}"
+    lines.append(header)
+    lines.extend(rows)
+    path.write_text("\n".join(lines) + "\n")
+
+
+def test_helpers():
+    assert extract_mi_base("1/A") == "1"
+    assert extract_mi_base("2") == "2"
+    assert format_genotype("0/1", "A", ["T"]) == "A/T"
+    assert format_genotype("1|0", "A", ["T"]) == "T|A"
+    assert format_genotype("./1", "A", ["T"]) == "./T"
+    c = BaseCounts()
+    for b in "AACGTN x":
+        c.add(b)
+    assert (c.a, c.c, c.g, c.t, c.n) == (2, 1, 1, 1, 1)
+
+
+def test_read_number_suffix():
+    r1 = _mapped(b"q", b"ACGT", 100, b"1")
+    assert read_number_suffix(r1) == "/1"
+    r2 = _mapped(b"q", b"ACGT", 100, b"1",
+                 flag=FLAG_PAIRED | FLAG_LAST | FLAG_REVERSE)
+    assert read_number_suffix(r2) == "/2"
+
+
+def test_format_insert_string():
+    rec = _mapped(b"q", b"A" * 20, 99, b"1", tlen=70, mate_pos=149)
+    assert format_insert_string(rec, ["chr1"]) == "chr1:100-169 | F1R2"
+    # unpaired -> NA
+    frag = _mapped(b"q", b"A" * 20, 99, b"1", flag=0)
+    assert format_insert_string(frag, ["chr1"]) == "NA"
+
+
+def test_vcf_snp_selection_and_maf(tmp_path):
+    vcf = tmp_path / "v.vcf"
+    _vcf(vcf, [
+        "chr1\t100\t.\tA\tT\t50\tPASS\t.\tGT:AD\t0/1:90,10",   # kept (maf .1? no — threshold)
+        "chr1\t200\t.\tA\tT\t50\tPASS\t.\tGT:AD\t0/1:50,50",   # maf 0.5 > 0.2 -> dropped
+        "chr1\t300\t.\tAC\tT\t50\tPASS\t.\tGT:AD\t0/1:90,10",  # not a SNP
+        "chr1\t400\t.\tA\tT\tq10\tq10\t.\tGT:AD\t1/1:95,5",    # filters kept
+    ], sample="s1")
+    variants = load_variants_from_vcf(str(vcf), None, 0.2)
+    assert [(v.pos, v.ref_base) for v in variants] == [(100, "A"), (400, "A")]
+    assert variants[0].genotype == "A/T"
+    assert variants[0].filters is None  # PASS
+    assert variants[1].filters == "q10"
+    assert variants[1].genotype == "T/T"
+
+
+def test_review_e2e(tmp_path):
+    # variant at chr1:110 (1-based), ref A alt T
+    vcf = tmp_path / "v.vcf"
+    _vcf(vcf, ["chr1\t110\t.\tA\tT\t50\tPASS\t."])
+
+    # consensus reads: mol 1 carries T at the site, mol 2 carries ref A
+    cons = [
+        _mapped(b"cons1", b"A" * 9 + b"T" + b"A" * 10, 100, b"1"),
+        _mapped(b"cons2", b"A" * 20, 100, b"2"),
+    ]
+    # raw reads: three for molecule 1 (two T, one C at the site), two for mol 2
+    raws = [
+        _mapped(b"r1", b"A" * 9 + b"T" + b"A" * 10, 100, b"1/A"),
+        _mapped(b"r2", b"A" * 9 + b"T" + b"A" * 10, 100, b"1/A"),
+        _mapped(b"r3", b"A" * 9 + b"C" + b"A" * 10, 100, b"1/B"),
+        _mapped(b"r4", b"A" * 20, 100, b"2"),
+        _mapped(b"r5", b"A" * 20, 100, b"2"),
+    ]
+    cons_bam, grouped_bam = tmp_path / "c.bam", tmp_path / "g.bam"
+    _write_bam(cons_bam, cons)
+    _write_bam(grouped_bam, raws)
+
+    out = str(tmp_path / "rev")
+    rc = main(["review", "-i", str(vcf), "-c", str(cons_bam),
+               "-g", str(grouped_bam), "-o", out])
+    assert rc == 0
+
+    with BamReader(out + ".consensus.bam") as r:
+        names = [rec.name for rec in r]
+    assert names == [b"cons1"]  # only the non-ref consensus read
+    with BamReader(out + ".grouped.bam") as r:
+        raw_names = [rec.name for rec in r]
+    assert raw_names == [b"r1", b"r2", b"r3"]  # molecule 1 only
+
+    with open(out + ".txt") as fh:
+        lines = [l.rstrip("\n").split("\t") for l in fh]
+    header, rows = lines[0], lines[1:]
+    assert header[:5] == ["chrom", "pos", "ref", "genotype", "filters"]
+    assert len(rows) == 1
+    row = dict(zip(header, rows[0]))
+    assert row["chrom"] == "chr1" and row["pos"] == "110"
+    assert row["ref"] == "A" and row["filters"] == "PASS"
+    assert row["consensus_call"] == "T"
+    assert row["consensus_read"] == "cons1/1"
+    # consensus counts are a pileup over ALL consensus reads at the site
+    # (cons2 carries the reference A), not just the extracted ones
+    assert row["T"] == "1" and row["A"] == "1"
+    # raw counts for molecule 1, read number /1: T=2, C=1
+    assert row["t"] == "2" and row["c"] == "1"
+    assert row["consensus_insert"].startswith("chr1:")
+
+
+def test_review_spanning_deletion_extracted_but_no_row(tmp_path):
+    vcf = tmp_path / "v.vcf"
+    _vcf(vcf, ["chr1\t110\t.\tA\tT\t50\tPASS\t."])
+    # consensus read with a deletion spanning the variant site
+    rec = RawRecord(_build_mapped_record(
+        b"cdel", FLAG_PAIRED | FLAG_FIRST | FLAG_MATE_REVERSE, 0, 100, 60,
+        [("M", 5), ("D", 10), ("M", 5)], b"A" * 10, np.full(10, 30, np.uint8),
+        0, 200, 120, [(b"MI", "Z", b"5"), (b"RG", "Z", b"A")]))
+    cons_bam, grouped_bam = tmp_path / "c.bam", tmp_path / "g.bam"
+    _write_bam(cons_bam, [rec])
+    _write_bam(grouped_bam, [_mapped(b"r1", b"A" * 20, 100, b"5")])
+    out = str(tmp_path / "rev")
+    assert main(["review", "-i", str(vcf), "-c", str(cons_bam),
+                 "-g", str(grouped_bam), "-o", out]) == 0
+    with BamReader(out + ".consensus.bam") as r:
+        assert [rec.name for rec in r] == [b"cdel"]  # extracted
+    with open(out + ".txt") as fh:
+        assert len(fh.readlines()) == 1  # header only, no detail row
+
+
+def test_review_ignore_ns(tmp_path):
+    vcf = tmp_path / "v.vcf"
+    _vcf(vcf, ["chr1\t110\t.\tA\tT\t50\tPASS\t."])
+    rec = _mapped(b"cn", b"A" * 9 + b"N" + b"A" * 10, 100, b"7")
+    cons_bam, grouped_bam = tmp_path / "c.bam", tmp_path / "g.bam"
+    _write_bam(cons_bam, [rec])
+    _write_bam(grouped_bam, [])
+    out1 = str(tmp_path / "keep")
+    assert main(["review", "-i", str(vcf), "-c", str(cons_bam),
+                 "-g", str(grouped_bam), "-o", out1]) == 0
+    with BamReader(out1 + ".consensus.bam") as r:
+        assert sum(1 for _ in r) == 1  # N is non-reference by default
+    out2 = str(tmp_path / "skip")
+    assert main(["review", "-i", str(vcf), "-c", str(cons_bam),
+                 "-g", str(grouped_bam), "-o", out2, "--ignore-ns"]) == 0
+    with BamReader(out2 + ".consensus.bam") as r:
+        assert sum(1 for _ in r) == 0
+
+
+def test_review_interval_input(tmp_path):
+    from fgumi_tpu.core.reference import write_fasta
+
+    fasta = str(tmp_path / "ref.fa")
+    write_fasta(fasta, {"chr1": b"A" * REF_LEN})
+    intervals = tmp_path / "iv.txt"
+    intervals.write_text("chr1\t110\t110\n")
+    rec = _mapped(b"ci", b"A" * 9 + b"G" + b"A" * 10, 100, b"3")
+    cons_bam, grouped_bam = tmp_path / "c.bam", tmp_path / "g.bam"
+    _write_bam(cons_bam, [rec])
+    _write_bam(grouped_bam, [_mapped(b"r1", b"A" * 9 + b"G" + b"A" * 10, 100, b"3")])
+    out = str(tmp_path / "rev")
+    assert main(["review", "-i", str(intervals), "-c", str(cons_bam),
+                 "-g", str(grouped_bam), "-r", fasta, "-o", out]) == 0
+    with open(out + ".txt") as fh:
+        lines = fh.readlines()
+    assert len(lines) == 2
+    row = dict(zip(lines[0].split("\t"), lines[1].split("\t")))
+    assert row["consensus_call"] == "G"
+    assert row["g"] == "1"

@@ -54,6 +54,13 @@ def _add_simplex(sub):
                    nargs="?", const=True, default=True, metavar="true|false",
                    help="pre-correct R1/R2 insert-overlap bases before UMI "
                         "consensus (default true)")
+    p.add_argument("--em-seq", action="store_true",
+                   help="EM-Seq methylation-aware calling (requires --ref); "
+                        "emits MM/ML and cu/ct tags")
+    p.add_argument("--taps", action="store_true",
+                   help="TAPS methylation-aware calling (requires --ref)")
+    p.add_argument("--ref", default=None,
+                   help="reference FASTA (required for --em-seq/--taps)")
     p.add_argument("--batch-groups", type=int, default=2000,
                    help="MI groups per device batch")
     p.set_defaults(func=cmd_simplex)
@@ -85,10 +92,29 @@ def cmd_simplex(args):
         trim=args.trim,
         min_consensus_base_quality=args.min_consensus_base_quality,
     )
-    caller = VanillaConsensusCaller(args.read_name_prefix, args.read_group_id, opts)
+    if args.em_seq and args.taps:
+        log.error("--em-seq and --taps are mutually exclusive")
+        return 2
+    reference = None
+    if args.em_seq or args.taps:
+        if args.ref is None:
+            log.error("--ref is required with --em-seq/--taps")
+            return 2
+        from .core.reference import ReferenceReader
+
+        opts.methylation_mode = "em-seq" if args.em_seq else "taps"
+        try:
+            reference = ReferenceReader(args.ref)
+        except OSError as e:
+            log.error("cannot read reference %s: %s", args.ref, e)
+            return 2
 
     t0 = time.monotonic()
     with BamReader(args.input) as reader:
+        caller = VanillaConsensusCaller(args.read_name_prefix,
+                                        args.read_group_id, opts,
+                                        reference=reference,
+                                        ref_names=reader.header.ref_names)
         out_header = _unmapped_consensus_header(args.read_group_id)
         oc_caller = None
         if args.consensus_call_overlapping_bases:

@@ -151,7 +151,8 @@ def _maf_from_fields(fields: dict):
     ad = fields.get("AD")
     if ad and ad != ".":
         try:
-            counts = [int(x) for x in ad.split(",")]
+            # a missing ('.') AD entry counts as 0, matching the reference
+            counts = [0 if x == "." else int(x) for x in ad.split(",")]
         except ValueError:
             return None
         total = sum(counts)
@@ -329,10 +330,12 @@ def run_review(args) -> int:
                             consensus_site_counts[id(v)].add(base)
                         non_ref = base != v.ref_base and \
                             not (args.ignore_ns and base == "N")
+                        detail = (base, got[1])  # drives the TSV row later
                     else:
                         non_ref = True  # spanning deletion
+                        detail = None  # extracted, but no detail row
                     if non_ref:
-                        hits.append(v)
+                        hits.append((v, detail))
                 if not hits:
                     continue
                 mi = rec.get_str(b"MI")
@@ -344,8 +347,8 @@ def run_review(args) -> int:
                 selected_mis.add(mi_base)
                 writer.write_record(rec)
                 n_consensus_out += 1
-                for v in hits:
-                    per_variant_consensus[id(v)].append(rec)
+                for v, detail in hits:
+                    per_variant_consensus[id(v)].append((rec, detail))
 
     # Pass 2: grouped BAM — extract raw reads of the selected molecules and
     # accumulate per-(variant, mi, read-number) base counts.
@@ -390,15 +393,10 @@ def run_review(args) -> int:
         consensus_counts = consensus_site_counts[id(v)]
 
         variant_rows = []
-        for rec in cons_reads:
-            got = _base_at_position(rec, v.pos)
-            if got is None:
+        for rec, detail in cons_reads:
+            if detail is None:
                 continue  # spanning deletion: extracted but no detail row
-            base = _normalize(got[0], v.ref_base)
-            if base == v.ref_base:
-                continue
-            if args.ignore_ns and base == "N":
-                continue
+            base, qual = detail
             mi_base = extract_mi_base(rec.get_str(b"MI"))
             suffix = read_number_suffix(rec)
             rc = raw_counts.get((id(v), mi_base, suffix), BaseCounts())
@@ -411,7 +409,7 @@ def run_review(args) -> int:
                 "N": consensus_counts.n,
                 "consensus_read": rec.name.decode(errors="replace") + suffix,
                 "consensus_insert": format_insert_string(rec, cons_ref_names),
-                "consensus_call": base, "consensus_qual": got[1],
+                "consensus_call": base, "consensus_qual": qual,
                 "a": rc.a, "c": rc.c, "g": rc.g, "t": rc.t, "n": rc.n,
             }))
         variant_rows.sort(key=lambda t: t[0])

@@ -1,0 +1,159 @@
+"""Methylation-aware consensus support (EM-Seq / TAPS).
+
+Port of /root/reference/crates/fgumi-consensus/src/methylation.rs semantics,
+in base-code space (0..4 = ACGTN):
+
+- EM-Seq converts unmethylated C to T before PCR: at a reference-C position,
+  C = methylated, T = converted (methylation.rs:1-11).
+- TAPS converts methylated C to T: same counting, inverted MM/ML probability.
+- Top strand tracks ref C with C/T evidence; bottom strand (reads stored
+  reverse-complemented into read orientation) tracks ref G with G/A evidence.
+- Consensus scoring sees normalized reads: converted bases are rewritten to the
+  unconverted form at ref-C positions so conversions are not counted as errors
+  (vanilla_caller.rs annotate_and_normalize).
+- Output tags: MM:Z ("C+m,skips;" / "G-m,skips;") + ML:B:C probabilities,
+  plus dense cu/ct i16 count arrays (methylation.rs:246-345).
+"""
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..constants import N_CODE
+
+I16_MAX = 32767
+
+# base codes
+A, C, G, T = 0, 1, 2, 3
+
+EM_SEQ = "em-seq"
+TAPS = "taps"
+
+
+@dataclass
+class MethylationAnnotation:
+    """Per-consensus-position evidence (methylation.rs:23-80)."""
+
+    is_ref_c: np.ndarray  # bool
+    unconverted: np.ndarray  # int64
+    converted: np.ndarray  # int64
+
+    def truncate(self, length: int) -> "MethylationAnnotation":
+        return MethylationAnnotation(self.is_ref_c[:length],
+                                     self.unconverted[:length],
+                                     self.converted[:length])
+
+    def cu(self) -> np.ndarray:
+        return np.minimum(self.unconverted, I16_MAX).astype(np.int16)
+
+    def ct(self) -> np.ndarray:
+        return np.minimum(self.converted, I16_MAX).astype(np.int16)
+
+
+def is_top_strand(flags: int) -> bool:
+    """Top strand iff R1 forward or R2 reverse (methylation.rs:370-383)."""
+    from ..io.bam import FLAG_LAST, FLAG_REVERSE
+
+    is_reverse = bool(flags & FLAG_REVERSE)
+    is_r2 = bool(flags & FLAG_LAST)
+    return is_reverse == is_r2
+
+
+def query_to_ref_positions(simplified_cigar, alignment_start: int,
+                           is_reverse: bool, original_cigar) -> list:
+    """Per-query-position 0-based reference position (None = insertion).
+
+    Reversed reads walk backward from the original CIGAR's alignment end
+    (methylation.rs:105-185).
+    """
+    positions = []
+    if is_reverse:
+        ref_span = sum(n for op, n in original_cigar if op in "MDN=X")
+        ref_pos = alignment_start + ref_span - 1
+        step = -1
+    else:
+        ref_pos = alignment_start
+        step = 1
+    for op, n in simplified_cigar:
+        if op in "M=X":
+            for _ in range(n):
+                positions.append(ref_pos)
+                ref_pos += step
+        elif op in "IS":
+            positions.extend([None] * n)
+        elif op in "DN":
+            ref_pos += step * n
+    return positions
+
+
+def ref_codes_at_positions(ref_positions, ref_seq: bytes) -> np.ndarray:
+    """uint8 base codes at mapped positions; N for insertions/out-of-range."""
+    from ..constants import BASE_TO_CODE
+
+    out = np.full(len(ref_positions), N_CODE, dtype=np.uint8)
+    for i, p in enumerate(ref_positions):
+        if p is not None and 0 <= p < len(ref_seq):
+            out[i] = BASE_TO_CODE[ref_seq[p]]
+    return out
+
+
+def annotate(source_reads, ref_codes: np.ndarray,
+             is_top: bool) -> MethylationAnnotation:
+    """Count unconverted/converted evidence at ref-C positions
+    (annotate_simplex_methylation, methylation.rs:186-244)."""
+    length = len(ref_codes)
+    ref_target, unconv, conv = (C, C, T) if is_top else (G, G, A)
+    is_ref_c = ref_codes == ref_target
+    unconverted = np.zeros(length, dtype=np.int64)
+    converted = np.zeros(length, dtype=np.int64)
+    for sr in source_reads:
+        n = min(len(sr.codes), length)
+        codes = sr.codes[:n]
+        mask = is_ref_c[:n]
+        unconverted[:n] += mask & (codes == unconv)
+        converted[:n] += mask & (codes == conv)
+    return MethylationAnnotation(is_ref_c=is_ref_c, unconverted=unconverted,
+                                 converted=converted)
+
+
+def normalize_source_reads(source_reads, annotation: MethylationAnnotation,
+                           is_top: bool):
+    """Rewrite converted bases to unconverted form at ref-C positions so
+    consensus scoring treats conversion as agreement (vanilla_caller.rs
+    annotate_and_normalize). Mutates the source reads' code arrays."""
+    unconv, conv = (C, T) if is_top else (G, A)
+    for sr in source_reads:
+        n = min(len(sr.codes), len(annotation.is_ref_c))
+        mask = annotation.is_ref_c[:n] & (sr.codes[:n] == conv)
+        sr.codes[:n][mask] = unconv
+
+
+def build_mm_ml(consensus_codes: np.ndarray, annotation: MethylationAnnotation,
+                is_top: bool, mode: str):
+    """SAM MM:Z + ML:B:C tags, or None when no ref-C position carries evidence
+    (methylation.rs:246-325)."""
+    track = C if is_top else G
+    skips = []
+    probs = []
+    skip = 0
+    length = min(len(consensus_codes), len(annotation.is_ref_c))
+    for i in range(length):
+        if consensus_codes[i] != track:
+            continue
+        if annotation.is_ref_c[i]:
+            total = int(annotation.unconverted[i]) + int(annotation.converted[i])
+            if total > 0:
+                num = int(annotation.unconverted[i]) if mode == EM_SEQ \
+                    else int(annotation.converted[i])
+                skips.append(skip)
+                probs.append(min(num * 255 // total, 255))
+                skip = 0
+            else:
+                skip += 1
+        else:
+            skip += 1
+    if not skips:
+        return None
+    base_char, strand = ("C", "+") if is_top else ("G", "-")
+    mm = f"{base_char}{strand}m," + ",".join(str(s) for s in skips) + ";"
+    return mm, bytes(probs)

@@ -56,6 +56,8 @@ class VanillaOptions:
     seed: Optional[int] = 42
     trim: bool = False
     min_consensus_base_quality: int = 40
+    # None | "em-seq" | "taps" (methylation.rs MethylationMode)
+    methylation_mode: Optional[str] = None
 
 
 @dataclass
@@ -85,6 +87,9 @@ class SourceRead:
     quals: np.ndarray  # uint8
     simplified_cigar: list
     flags: int
+    ref_id: int = -1
+    alignment_start: int = -1  # 0-based
+    original_cigar: list = None  # simplified, un-reversed (methylation anchor)
 
 
 @dataclass
@@ -98,6 +103,7 @@ class ConsensusJob:
     consensus_len: int
     original_raws: list  # RawRecords surviving filtering (for tag extraction)
     source_reads: list = None  # SourceReads (kept when the caller needs them, e.g. duplex)
+    methylation: object = None  # (MethylationAnnotation, is_top) when enabled
 
 
 @dataclass
@@ -137,8 +143,14 @@ class VanillaConsensusCaller:
     """Simplex consensus caller over MI groups, batched onto the TPU kernel."""
 
     def __init__(self, read_name_prefix: str, read_group_id: str,
-                 options: VanillaOptions = None, kernel: ConsensusKernel = None):
+                 options: VanillaOptions = None, kernel: ConsensusKernel = None,
+                 reference=None, ref_names=None):
+        """`reference`: chrom -> bytes mapping (or any .get-able) and
+        `ref_names`: BAM ref_id -> name list; both required only for
+        methylation-aware calling."""
         self.options = options or VanillaOptions()
+        self.reference = reference
+        self.ref_names = ref_names or []
         self.prefix = read_name_prefix
         self.read_group_id = read_group_id
         self.tables = quality_tables(self.options.error_rate_pre_umi,
@@ -183,14 +195,17 @@ class VanillaConsensusCaller:
         if final_len == 0:
             return None
 
-        simplified = cigar_utils.simplify(rec.cigar())
+        original_simplified = cigar_utils.simplify(rec.cigar())
+        simplified = original_simplified
         if is_negative:
             simplified = cigar_utils.reverse(simplified)
         simplified = cigar_utils.truncate_to_query_length(simplified, final_len)
 
         return SourceRead(original_idx=idx, codes=codes[:final_len],
                           quals=quals[:final_len], simplified_cigar=simplified,
-                          flags=rec.flag)
+                          flags=rec.flag, ref_id=rec.ref_id,
+                          alignment_start=rec.pos,
+                          original_cigar=original_simplified)
 
     def _filter_by_alignment(self, source_reads):
         """Most-common-alignment filter (vanilla_caller.rs:1038-1089)."""
@@ -205,6 +220,48 @@ class VanillaConsensusCaller:
         if rejected:
             self.stats.reject("MinorityAlignment", rejected)
         return [sr for i, sr in enumerate(source_reads) if i in keep]
+
+    def _annotate_methylation(self, source_reads):
+        """EM-Seq/TAPS annotate + normalize (vanilla_caller.rs
+        annotate_and_normalize): maps the longest read's query positions to the
+        reference, counts conversion evidence at ref-C positions, and rewrites
+        converted bases so scoring treats conversion as agreement.
+
+        Returns (annotation, is_top) or None when disabled/unmappable.
+        """
+        if not self.options.methylation_mode or self.reference is None:
+            return None
+        if not source_reads:
+            return None
+        from . import methylation
+
+        anchor = max(source_reads, key=lambda sr: len(sr.codes))
+        if anchor.ref_id < 0 or anchor.alignment_start < 0 \
+                or anchor.ref_id >= len(self.ref_names):
+            return None
+        ref_name = self.ref_names[anchor.ref_id]
+        ref_seq = self.reference.get(ref_name) \
+            if hasattr(self.reference, "get") else None
+        if ref_seq is None:
+            # warn once: a BAM/FASTA contig-name mismatch (chr1 vs 1) would
+            # otherwise silently disable methylation for the whole run
+            if not getattr(self, "_warned_missing_contig", False):
+                self._warned_missing_contig = True
+                import logging
+
+                logging.getLogger("fgumi_tpu").warning(
+                    "contig %r not found in the reference FASTA; methylation "
+                    "annotation is skipped for reads on missing contigs",
+                    ref_name)
+            return None
+        is_top = methylation.is_top_strand(anchor.flags)
+        ref_positions = methylation.query_to_ref_positions(
+            anchor.simplified_cigar, anchor.alignment_start,
+            bool(anchor.flags & FLAG_REVERSE), anchor.original_cigar or [])
+        ref_codes = methylation.ref_codes_at_positions(ref_positions, ref_seq)
+        annotation = methylation.annotate(source_reads, ref_codes, is_top)
+        methylation.normalize_source_reads(source_reads, annotation, is_top)
+        return annotation, is_top
 
     def _downsample(self, items: list, rng) -> list:
         """Seeded shuffle-take-max_reads (vanilla_caller.rs:799-845)."""
@@ -274,6 +331,7 @@ class VanillaConsensusCaller:
                 if source_reads:
                     self.stats.reject("InsufficientReads", len(source_reads))
                 continue
+            meth = self._annotate_methylation(source_reads)
             lengths = sorted((len(sr.codes) for sr in source_reads), reverse=True)
             consensus_len = lengths[opts.min_reads - 1]
             jobs[read_type] = ConsensusJob(
@@ -282,6 +340,7 @@ class VanillaConsensusCaller:
                 quals=[sr.quals for sr in source_reads],
                 consensus_len=consensus_len,
                 original_raws=[group_reads[sr.original_idx] for sr in source_reads],
+                methylation=meth,
             )
 
         # orphan R1/R2 handling (vanilla_caller.rs:1166-1185): both or neither
@@ -403,6 +462,23 @@ class VanillaConsensusCaller:
                    if u is not None]
         if rx_umis:
             b.tag_str(b"RX", consensus_umis(rx_umis).encode())
+        # methylation tags (EM-Seq/TAPS; vanilla_caller.rs:1538-1560)
+        if job.methylation is not None:
+            from . import methylation as meth_mod
+
+            annotation, anchor_is_top = job.methylation
+            annotation = annotation.truncate(len(bases_codes))
+            is_top = anchor_is_top
+            if job.original_raws:
+                is_top = meth_mod.is_top_strand(job.original_raws[0].flag)
+            got = meth_mod.build_mm_ml(np.asarray(bases_codes), annotation,
+                                       is_top, self.options.methylation_mode)
+            if got is not None:
+                mm, ml = got
+                b.tag_str(b"MM", mm.encode())
+                b.tag_array_u8(b"ML", np.frombuffer(ml, dtype=np.uint8))
+            b.tag_array_i16(b"cu", annotation.cu())
+            b.tag_array_i16(b"ct", annotation.ct())
         self.stats.consensus_reads += 1
         return b.finish()
 

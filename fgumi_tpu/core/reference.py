@@ -39,6 +39,10 @@ class ReferenceReader:
     def contigs(self):
         return list(self._seqs)
 
+    def get(self, chrom: str):
+        """Full contig bytes, or None (dict-like access for consensus callers)."""
+        return self._seqs.get(chrom)
+
     def fetch(self, chrom: str, start: int, end: int) -> bytes:
         """Uppercase bases for 0-based half-open [start, end)."""
         seq = self._seqs.get(chrom)

@@ -1,0 +1,156 @@
+"""Methylation-aware consensus tests (reference: methylation.rs semantics)."""
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.consensus import methylation as meth
+from fgumi_tpu.consensus.vanilla import (SourceRead, VanillaConsensusCaller,
+                                         VanillaOptions)
+from fgumi_tpu.io.bam import (BamHeader, BamReader, BamWriter, FLAG_FIRST,
+                              FLAG_LAST, FLAG_MATE_REVERSE, FLAG_PAIRED,
+                              FLAG_REVERSE, RawRecord)
+from fgumi_tpu.simulate import _build_mapped_record
+
+A, C, G, T = 0, 1, 2, 3
+
+
+def codes(s):
+    return np.array([{"A": A, "C": C, "G": G, "T": T, "N": 4}[c] for c in s],
+                    dtype=np.uint8)
+
+
+def _sr(seq, flags=FLAG_PAIRED | FLAG_FIRST, start=0, cigar=None):
+    c = codes(seq)
+    cig = cigar or [("M", len(c))]
+    return SourceRead(original_idx=0, codes=c,
+                      quals=np.full(len(c), 30, np.uint8),
+                      simplified_cigar=cig, flags=flags, ref_id=0,
+                      alignment_start=start, original_cigar=cig)
+
+
+def test_is_top_strand():
+    assert meth.is_top_strand(FLAG_PAIRED | FLAG_FIRST)            # R1 fwd
+    assert not meth.is_top_strand(FLAG_PAIRED | FLAG_FIRST | FLAG_REVERSE)
+    assert meth.is_top_strand(FLAG_PAIRED | FLAG_LAST | FLAG_REVERSE)  # R2 rev
+    assert not meth.is_top_strand(FLAG_PAIRED | FLAG_LAST)
+
+
+def test_query_to_ref_positions_forward():
+    cig = [("M", 3), ("I", 2), ("M", 2), ("D", 1), ("M", 1)]
+    pos = meth.query_to_ref_positions(cig, 100, False, cig)
+    assert pos == [100, 101, 102, None, None, 103, 104, 106]
+
+
+def test_query_to_ref_positions_reverse():
+    # reversed cigar walk: starts at alignment end, decrements
+    orig = [("M", 5)]
+    pos = meth.query_to_ref_positions([("M", 5)], 100, True, orig)
+    assert pos == [104, 103, 102, 101, 100]
+
+
+def test_annotate_counts_top_strand():
+    # reference: A C G T C  (ref-C at positions 1 and 4)
+    ref_codes = codes("ACGTC")
+    reads = [_sr("ACGTC"), _sr("ATGTC"), _sr("ACGTT")]
+    ann = meth.annotate(reads, ref_codes, is_top=True)
+    assert list(ann.is_ref_c) == [False, True, False, False, True]
+    assert list(ann.unconverted) == [0, 2, 0, 0, 2]  # C stayed C
+    assert list(ann.converted) == [0, 1, 0, 0, 1]    # C -> T
+
+
+def test_annotate_counts_bottom_strand():
+    # bottom strand after RC: ref G tracked, evidence G (unconverted) / A
+    ref_codes = codes("AGGTA")
+    reads = [_sr("AGGTA"), _sr("AAGTA")]
+    ann = meth.annotate(reads, ref_codes, is_top=False)
+    assert list(ann.is_ref_c) == [False, True, True, False, False]
+    assert list(ann.unconverted) == [0, 1, 2, 0, 0]
+    assert list(ann.converted) == [0, 1, 0, 0, 0]
+
+
+def test_normalize_rewrites_converted():
+    ref_codes = codes("CC")
+    reads = [_sr("CT"), _sr("TT")]
+    ann = meth.annotate(reads, ref_codes, is_top=True)
+    meth.normalize_source_reads(reads, ann, is_top=True)
+    assert list(reads[0].codes) == [C, C]
+    assert list(reads[1].codes) == [C, C]
+
+
+def test_build_mm_ml_em_seq():
+    # consensus C C A C; ref-C at 0,1,3; evidence: pos0 3/0 meth, pos1 1/2, pos3 0/0
+    ann = meth.MethylationAnnotation(
+        is_ref_c=np.array([True, True, False, True]),
+        unconverted=np.array([3, 1, 0, 0], dtype=np.int64),
+        converted=np.array([0, 2, 0, 0], dtype=np.int64))
+    mm, ml = meth.build_mm_ml(codes("CCAC"), ann, True, meth.EM_SEQ)
+    # third C has no evidence -> skipped (skip count bumps but no entry)
+    assert mm == "C+m,0,0;"
+    assert list(ml) == [255, 85]  # 3/3 and 1/3 of 255
+
+
+def test_build_mm_ml_taps_inverts():
+    ann = meth.MethylationAnnotation(
+        is_ref_c=np.array([True]), unconverted=np.array([3], dtype=np.int64),
+        converted=np.array([1], dtype=np.int64))
+    _, ml_em = meth.build_mm_ml(codes("C"), ann, True, meth.EM_SEQ)
+    _, ml_taps = meth.build_mm_ml(codes("C"), ann, True, meth.TAPS)
+    assert list(ml_em) == [3 * 255 // 4]
+    assert list(ml_taps) == [255 // 4]
+
+
+def test_build_mm_bottom_strand_marker():
+    ann = meth.MethylationAnnotation(
+        is_ref_c=np.array([True]), unconverted=np.array([2], dtype=np.int64),
+        converted=np.array([0], dtype=np.int64))
+    mm, _ = meth.build_mm_ml(codes("G"), ann, False, meth.EM_SEQ)
+    assert mm.startswith("G-m")
+
+
+def test_simplex_em_seq_cli_e2e(tmp_path):
+    """Reads with C->T conversion at a ref-C: consensus keeps C, emits tags."""
+    from fgumi_tpu.cli import main
+    from fgumi_tpu.core.reference import write_fasta
+
+    ref_seq = b"ACGTACGTACCGTACGTACG"  # CpG at positions 9-10 (0-based 9='C')
+    fasta = str(tmp_path / "ref.fa")
+    write_fasta(fasta, {"chr1": ref_seq})
+
+    header = BamHeader(
+        text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n@SQ\tSN:chr1\tLN:20\n"
+             "@RG\tID:A\tSM:s\n",
+        ref_names=["chr1"], ref_lengths=[20])
+    in_bam = str(tmp_path / "in.bam")
+    # 3 reads of molecule 1: 2 keep C at ref pos 9 (methylated), 1 converted to T
+    seqs = [b"ACGTACGTACCGTACGTACG",
+            b"ACGTACGTACCGTACGTACG",
+            b"ACGTACGTATCGTACGTACG"]
+    with BamWriter(in_bam, header) as w:
+        for i, seq in enumerate(seqs):
+            # unpaired fragments (orphan R1s without R2s would be rejected)
+            w.write_record_bytes(_build_mapped_record(
+                f"r{i}".encode(), 0, 0, 0, 60, [("M", 20)], seq,
+                np.full(20, 30, np.uint8), -1, -1, 0,
+                [(b"MI", "Z", b"1"), (b"RG", "Z", b"A")]))
+
+    out_bam = str(tmp_path / "out.bam")
+    rc = main(["simplex", "-i", in_bam, "-o", out_bam, "--min-reads", "1",
+               "--em-seq", "--ref", fasta,
+               "--consensus-call-overlapping-bases", "false"])
+    assert rc == 0
+    with BamReader(out_bam) as r:
+        recs = list(r)
+    assert len(recs) == 1
+    rec = recs[0]
+    # conversion normalized away: consensus shows C at position 9
+    assert rec.seq_bytes() == b"ACGTACGTACCGTACGTACG"
+    mm = rec.get_str(b"MM")
+    assert mm is not None and mm.startswith("C+m")
+    typ, ml = rec.find_tag(b"ML")
+    assert typ == "B"
+    _, cu = rec.find_tag(b"cu")
+    _, ct = rec.find_tag(b"ct")
+    assert cu[9] == 2 and ct[9] == 1  # 2 unconverted, 1 converted at ref-C 9
+    # error counts do not include the normalized conversion
+    _, ce = rec.find_tag(b"ce")
+    assert ce[9] == 0

@@ -37,8 +37,17 @@ def _block_header(bsize_minus1: int) -> bytes:
 
 
 def compress_block(data: bytes, level: int = 1) -> bytes:
-    """Compress one <=64KiB chunk into a standalone BGZF block."""
+    """Compress one <=64KiB chunk into a standalone BGZF block.
+
+    Uses the C++/libdeflate codec when available (fgumi_tpu.native, the
+    InlineBgzfCompressor analog); zlib otherwise.
+    """
     assert len(data) <= 0x10000
+    from .. import native
+
+    blk = native.bgzf_compress_block(data, level)
+    if blk is not None:
+        return blk
     co = zlib.compressobj(level, zlib.DEFLATED, -15)
     payload = co.compress(data) + co.flush()
     bsize = len(payload) + _HEADER.size + 8
@@ -97,19 +106,107 @@ class BgzfReader:
         self._z = zlib.decompressobj(wbits=31)
         self._buf = bytearray()
         self._eof = False
+        # native batch path state: None = undecided, False = zlib fallback
+        self._native = None
+        self._raw = bytearray()
+
+    def _decide_native(self, first_chunk: bytes):
+        """Engage the C++ batch decompressor only for genuine BGZF input
+        (BGZF magic + FEXTRA); plain gzip keeps the zlib streaming path."""
+        from .. import native
+
+        if len(first_chunk) >= 18 and first_chunk[:4] == b"\x1f\x8b\x08\x04" \
+                and native.get_lib() is not None:
+            self._native = True
+        else:
+            self._native = False
+
+    def _demote_to_zlib(self):
+        """Switch to the zlib streaming path mid-stream (e.g. a plain-gzip
+        member concatenated after BGZF blocks): replay the undecoded raw
+        bytes through a fresh decompressor."""
+        self._native = False
+        self._z = zlib.decompressobj(wbits=31)
+        if self._raw:
+            self._buf += self._z.decompress(bytes(self._raw))
+            self._raw.clear()
+
+    @staticmethod
+    def _is_bgzf_member(buf) -> bool:
+        """True iff buf starts with a BGZF member header (gzip + BC subfield).
+        Call with >=18 bytes."""
+        if buf[:4] != b"\x1f\x8b\x08\x04":
+            return False
+        xlen = int.from_bytes(buf[10:12], "little")
+        extra = buf[12 : 12 + xlen]
+        off = 0
+        while off + 4 <= len(extra):
+            slen = int.from_bytes(extra[off + 2 : off + 4], "little")
+            if extra[off : off + 2] == b"BC" and slen == 2:
+                return True
+            off += 4 + slen
+        return False
+
+    def _fill_native(self, need: int):
+        from .. import native
+
+        while len(self._buf) < need and not (self._eof and not self._raw):
+            if not self._eof:
+                raw = self._f.read(self._chunk)
+                if raw:
+                    self._raw += raw
+                else:
+                    self._eof = True
+            if not self._raw:
+                continue
+            try:
+                decoded, consumed = native.bgzf_decompress(self._raw)
+            except ValueError:
+                # garbage where a member should start: let zlib report it
+                self._demote_to_zlib()
+                self._fill(need)
+                return
+            self._buf += decoded
+            del self._raw[:consumed]
+            if consumed == 0 and self._raw:
+                if len(self._raw) >= 18 and not self._is_bgzf_member(self._raw):
+                    # a non-BGZF gzip member concatenated mid-stream: the
+                    # zlib streaming path handles it (docstring contract)
+                    self._demote_to_zlib()
+                    self._fill(need)
+                    return
+                if self._eof:
+                    raise ValueError(
+                        "truncated BGZF stream (partial block at EOF)")
 
     def _fill(self, need: int):
-        while len(self._buf) < need and not self._eof:
+        if self._native is None:
+            first = self._f.read(self._chunk)
+            if not first:
+                self._eof = True
+                return
+            self._decide_native(first)
+            if self._native:
+                self._raw += first
+            else:
+                self._buf += self._z.decompress(first)
+        if self._native:
+            self._fill_native(need)
+            return
+        while len(self._buf) < need:
             if self._z.eof:
+                # recycle pending concatenated members even after file EOF
                 rest = self._z.unused_data
                 self._z = zlib.decompressobj(wbits=31)
                 if rest:
                     self._buf += self._z.decompress(rest)
                     continue
+            if self._eof:
+                break
             raw = self._f.read(self._chunk)
             if not raw:
                 self._eof = True
-                break
+                continue
             self._buf += self._z.decompress(raw)
 
     def read(self, n: int) -> bytes:

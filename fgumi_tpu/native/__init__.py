@@ -1,0 +1,124 @@
+"""ctypes bindings for the C++ native runtime (libdeflate BGZF codec).
+
+The shared library is built lazily from the bundled source on first use
+(g++ -O3 against the system libdeflate) and cached next to this module;
+every consumer degrades gracefully to the pure-Python/zlib path when the
+toolchain or libdeflate is unavailable (set FGUMI_TPU_NO_NATIVE=1 to force
+the fallback). Mirrors the reference's native layering (SURVEY.md §2 intro:
+C++ equivalents for the L1-L4 hot paths).
+"""
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger("fgumi_tpu")
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "libfgumi_native.so")
+_SRC_PATH = os.path.join(_HERE, "fgumi_native.cc")
+
+_lock = threading.Lock()
+_lib = None
+_lib_failed = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", _SO_PATH, _SRC_PATH,
+           "-ldeflate"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        log.debug("native build failed to launch: %s", e)
+        return False
+    if proc.returncode != 0:
+        log.debug("native build failed:\n%s", proc.stderr)
+        return False
+    return True
+
+
+def get_lib():
+    """The loaded native library, or None (pure-Python fallback)."""
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        if os.environ.get("FGUMI_TPU_NO_NATIVE"):
+            _lib_failed = True
+            return None
+        if not os.path.exists(_SO_PATH) or (
+                os.path.exists(_SRC_PATH)
+                and os.path.getmtime(_SRC_PATH) > os.path.getmtime(_SO_PATH)):
+            if not _build():
+                _lib_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            log.debug("native library load failed: %s", e)
+            _lib_failed = True
+            return None
+        lib.fgumi_bgzf_decompress.restype = ctypes.c_long
+        lib.fgumi_bgzf_decompress.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long)]
+        lib.fgumi_bgzf_compress_block.restype = ctypes.c_long
+        lib.fgumi_bgzf_compress_block.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_long]
+        lib.fgumi_find_record_boundaries.restype = ctypes.c_long
+        lib.fgumi_find_record_boundaries.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int64)]
+        _lib = lib
+        log.debug("native library loaded from %s", _SO_PATH)
+        return _lib
+
+
+def bgzf_decompress(data, out_cap: int = None):
+    """Decompress complete BGZF blocks from `data` (bytes).
+
+    Returns (decoded_bytes, consumed) or None when the native library is
+    unavailable. Raises ValueError on malformed input.
+    """
+    lib = get_lib()
+    if lib is None:
+        return None
+    data = bytes(data)
+    n = len(data)
+    # Spec bound: each block is >=26 bytes and expands to at most 64 KiB, so
+    # the true output can never exceed this cap. An ISIZE claiming more is
+    # corrupt — the codec returns -2 and we report it rather than growing.
+    max_cap = (n // 26 + 1) * (1 << 16)
+    if out_cap is None:
+        out_cap = min(max(4 * n + (1 << 16), 1 << 16), max_cap)
+    out = ctypes.create_string_buffer(out_cap)
+    consumed = ctypes.c_long(0)
+    produced = lib.fgumi_bgzf_decompress(data, n, out, out_cap,
+                                         ctypes.byref(consumed))
+    if produced == -2:
+        if out_cap >= max_cap:
+            raise ValueError("malformed BGZF block (ISIZE exceeds spec bound)")
+        return bgzf_decompress(data, min(out_cap * 2, max_cap))
+    if produced < 0:
+        raise ValueError("malformed BGZF block")
+    return ctypes.string_at(out, produced), consumed.value
+
+
+def bgzf_compress_block(data: bytes, level: int = 1):
+    """One BGZF block for <=0xFF00 input bytes, or None (fallback)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    cap = len(data) + (1 << 12) + 64
+    out = ctypes.create_string_buffer(cap)
+    size = lib.fgumi_bgzf_compress_block(bytes(data), len(data), level, out,
+                                         cap)
+    if size < 0:
+        raise ValueError("BGZF block compression failed")
+    return out.raw[:size]

@@ -1,0 +1,178 @@
+// Native runtime hot paths for fgumi-tpu.
+//
+// C++ equivalents of the reference's native Rust layers (SURVEY.md §2 intro):
+// BGZF block codec on libdeflate (reference: crates/fgumi-bgzf/src/lib.rs —
+// libdeflater block read/decompress + InlineBgzfCompressor) and BAM record
+// boundary scanning (reference: src/lib/unified_pipeline/bam.rs FindBoundaries).
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this image).
+
+#include <libdeflate.h>
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+thread_local libdeflate_decompressor* tls_decompressor = nullptr;
+thread_local libdeflate_compressor* tls_compressor = nullptr;
+thread_local int tls_compressor_level = -1;
+
+libdeflate_decompressor* decompressor() {
+  if (tls_decompressor == nullptr) {
+    tls_decompressor = libdeflate_alloc_decompressor();
+  }
+  return tls_decompressor;
+}
+
+libdeflate_compressor* compressor(int level) {
+  if (tls_compressor == nullptr || tls_compressor_level != level) {
+    if (tls_compressor != nullptr) {
+      libdeflate_free_compressor(tls_compressor);
+    }
+    tls_compressor = libdeflate_alloc_compressor(level);
+    tls_compressor_level = level;
+  }
+  return tls_compressor;
+}
+
+inline uint16_t read_u16(const uint8_t* p) {
+  return static_cast<uint16_t>(p[0]) | (static_cast<uint16_t>(p[1]) << 8);
+}
+
+inline uint32_t read_u32(const uint8_t* p) {
+  return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+         (static_cast<uint32_t>(p[2]) << 16) |
+         (static_cast<uint32_t>(p[3]) << 24);
+}
+
+// Parse one BGZF block header at src[0..len): returns the total block size
+// (BSIZE+1) and sets *data_off to the deflate payload offset, or 0 on
+// malformed / truncated header. BGZF = gzip member with an FEXTRA "BC"
+// subfield carrying BSIZE (SAM spec §4.1).
+long parse_bgzf_header(const uint8_t* src, long len, long* data_off) {
+  if (len < 18) return 0;
+  if (src[0] != 0x1F || src[1] != 0x8B || src[2] != 0x08 ||
+      (src[3] & 0x04) == 0) {
+    return 0;
+  }
+  const uint16_t xlen = read_u16(src + 10);
+  if (12 + static_cast<long>(xlen) > len) return 0;
+  long off = 12;
+  const long extra_end = 12 + xlen;
+  long bsize = -1;
+  while (off + 4 <= extra_end) {
+    const uint8_t si1 = src[off];
+    const uint8_t si2 = src[off + 1];
+    const uint16_t slen = read_u16(src + off + 2);
+    if (si1 == 0x42 && si2 == 0x43 && slen == 2 && off + 6 <= extra_end) {
+      bsize = static_cast<long>(read_u16(src + off + 4)) + 1;
+    }
+    off += 4 + slen;
+  }
+  if (bsize < 0) return 0;
+  *data_off = extra_end;
+  return bsize;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decompress as many complete BGZF blocks from src as fit in dst.
+// Returns bytes produced; sets *consumed to the input bytes consumed (whole
+// blocks only — a trailing partial block is left for the caller's next call).
+// Returns -1 on a malformed block, -2 when dst has no room for the next
+// block's payload (caller grows dst or flushes first).
+long fgumi_bgzf_decompress(const uint8_t* src, long src_len, uint8_t* dst,
+                           long dst_cap, long* consumed) {
+  long in_off = 0;
+  long out_off = 0;
+  while (in_off < src_len) {
+    long data_off = 0;
+    const long bsize = parse_bgzf_header(src + in_off, src_len - in_off,
+                                         &data_off);
+    if (bsize == 0) {
+      // either truncated (partial tail) or malformed; distinguish by whether
+      // at least a full header could have been present
+      if (src_len - in_off >= 18 &&
+          (src[in_off] != 0x1F || src[in_off + 1] != 0x8B)) {
+        if (out_off == 0 && in_off == 0) return -1;
+      }
+      break;  // partial block: wait for more input
+    }
+    if (in_off + bsize > src_len) break;  // partial block
+    const uint8_t* payload = src + in_off + data_off;
+    const long payload_len = bsize - data_off - 8;
+    if (payload_len < 0) return -1;
+    const uint32_t isize = read_u32(src + in_off + bsize - 4);
+    if (isize > 0x10000) return -1;  // a BGZF block holds at most 64 KiB
+    if (static_cast<long>(isize) > dst_cap - out_off) {
+      if (out_off == 0) return -2;
+      break;  // no room: return what we have
+    }
+    size_t actual = 0;
+    const libdeflate_result r = libdeflate_deflate_decompress(
+        decompressor(), payload, static_cast<size_t>(payload_len),
+        dst + out_off, static_cast<size_t>(isize), &actual);
+    if (r != LIBDEFLATE_SUCCESS || actual != isize) return -1;
+    out_off += static_cast<long>(isize);
+    in_off += bsize;
+  }
+  *consumed = in_off;
+  return out_off;
+}
+
+// Compress src (<= 0xFF00 bytes) into one complete BGZF block at dst.
+// Returns the block size, or -1 on failure / insufficient dst capacity.
+long fgumi_bgzf_compress_block(const uint8_t* src, long src_len, int level,
+                               uint8_t* dst, long dst_cap) {
+  if (src_len > 0xFF00 || dst_cap < 64) return -1;
+  static const uint8_t header[18] = {
+      0x1F, 0x8B, 0x08, 0x04, 0, 0, 0, 0, 0, 0xFF,  // gzip, FEXTRA, OS=unknown
+      6,    0,                                       // XLEN
+      0x42, 0x43, 2, 0,                              // "BC", SLEN=2
+      0,    0,                                       // BSIZE placeholder
+  };
+  std::memcpy(dst, header, 18);
+  const size_t cap = static_cast<size_t>(dst_cap) - 18 - 8;
+  size_t payload = libdeflate_deflate_compress(
+      compressor(level), src, static_cast<size_t>(src_len), dst + 18, cap);
+  if (payload == 0) return -1;  // didn't fit
+  const long bsize = static_cast<long>(payload) + 18 + 8;
+  if (bsize > 0x10000) return -1;
+  dst[16] = static_cast<uint8_t>((bsize - 1) & 0xFF);
+  dst[17] = static_cast<uint8_t>(((bsize - 1) >> 8) & 0xFF);
+  const uint32_t crc = libdeflate_crc32(0, src, static_cast<size_t>(src_len));
+  uint8_t* tail = dst + 18 + payload;
+  tail[0] = crc & 0xFF;
+  tail[1] = (crc >> 8) & 0xFF;
+  tail[2] = (crc >> 16) & 0xFF;
+  tail[3] = (crc >> 24) & 0xFF;
+  const uint32_t isize = static_cast<uint32_t>(src_len);
+  tail[4] = isize & 0xFF;
+  tail[5] = (isize >> 8) & 0xFF;
+  tail[6] = (isize >> 16) & 0xFF;
+  tail[7] = (isize >> 24) & 0xFF;
+  return bsize;
+}
+
+// Scan decoded BAM bytes for record boundaries: offsets[i] = start of record i
+// (the 4-byte block_size prefix). Returns the number of complete records
+// found; sets *scanned to the byte offset just past the last complete record.
+// Mirrors the FindBoundaries step (unified_pipeline/bam.rs:180).
+long fgumi_find_record_boundaries(const uint8_t* buf, long len,
+                                  int64_t* offsets, long max_records,
+                                  int64_t* scanned) {
+  long off = 0;
+  long n = 0;
+  while (off + 4 <= len && n < max_records) {
+    const uint32_t block_size = read_u32(buf + off);
+    if (off + 4 + static_cast<long>(block_size) > len) break;
+    offsets[n++] = off;
+    off += 4 + static_cast<long>(block_size);
+  }
+  *scanned = off;
+  return n;
+}
+
+}  // extern "C"

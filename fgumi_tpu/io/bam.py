@@ -439,6 +439,11 @@ class BamWriter:
     def write_record(self, rec: RawRecord):
         self.write_record_bytes(rec.data)
 
+    def write_serialized(self, blob: bytes):
+        """Append records already carrying their block_size prefixes
+        (the native batch serializer's output)."""
+        self._w.write(blob)
+
     def close(self):
         self._w.close()
 

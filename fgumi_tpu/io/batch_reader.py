@@ -1,0 +1,126 @@
+"""Batched BAM reading: SoA record batches over contiguous chunk buffers.
+
+The per-batch analog of the reference's Decode step
+(/root/reference/src/lib/unified_pipeline/bam.rs:180,329: FindBoundaries +
+parallel Decode into cached GroupKeys): decompressed bytes are scanned for
+record boundaries and field-decoded natively (fgumi_tpu.native.batch), so the
+Python layer holds numpy arrays per batch instead of objects per record.
+"""
+
+import numpy as np
+
+from ..native import batch as nb
+from .bam import BamHeader, RawRecord
+from .bgzf import BgzfReader
+
+# Smallest possible BAM record on the wire: 4-byte block_size + 32 fixed +
+# 1-byte name (NUL only); guards the boundary-array allocation.
+_MIN_RECORD_WIRE = 37
+
+
+class RecordBatch:
+    """A contiguous run of BAM records decoded struct-of-arrays.
+
+    `buf` is a writable uint8 view of the chunk (overlap correction mutates
+    seq/qual bytes in place, consensus/overlapping.py semantics). All offset
+    arrays index into `buf`.
+    """
+
+    __slots__ = ("buf", "rec_off", "n", "ref_id", "pos", "mapq", "flag",
+                 "l_seq", "n_cigar", "l_read_name", "next_ref_id", "next_pos",
+                 "tlen", "data_off", "data_end", "cigar_off", "seq_off",
+                 "qual_off", "aux_off", "_tag_locs")
+
+    def __init__(self, chunk: bytearray, rec_off: np.ndarray):
+        self.buf = np.frombuffer(chunk, dtype=np.uint8)
+        self.rec_off = rec_off
+        self.n = len(rec_off)
+        f = nb.decode_fields(self.buf, rec_off)
+        for k, v in f.items():
+            setattr(self, k, v)
+        self.cigar_off = self.data_off + 32 + self.l_read_name
+        self.seq_off = self.cigar_off + 4 * self.n_cigar.astype(np.int64)
+        self.qual_off = self.seq_off + (self.l_seq + 1) // 2
+        self.aux_off = self.qual_off + self.l_seq
+        self._tag_locs = {}
+
+    def tag_locs(self, tag: bytes):
+        """(val_off int64[n], val_len int32[n], val_type uint8[n]) for one tag;
+        val_off -1 where absent. Cached per batch."""
+        got = self._tag_locs.get(tag)
+        if got is None:
+            vo, vl, vt = nb.scan_tags(self.buf, self.aux_off, self.data_end,
+                                      [tag])
+            got = (np.ascontiguousarray(vo[:, 0]),
+                   np.ascontiguousarray(vl[:, 0]),
+                   np.ascontiguousarray(vt[:, 0]))
+            self._tag_locs[tag] = got
+        return got
+
+    def tag_bytes(self, tag: bytes, i: int):
+        """One record's tag value bytes (Z/H string, no NUL), or None."""
+        vo, vl, _ = self.tag_locs(tag)
+        if vo[i] < 0:
+            return None
+        return self.buf[vo[i]: vo[i] + vl[i]].tobytes()
+
+    def name(self, i: int) -> bytes:
+        off = self.data_off[i] + 32
+        return self.buf[off: off + self.l_read_name[i] - 1].tobytes()
+
+    def raw_record(self, i: int) -> RawRecord:
+        """Materialize one record as a RawRecord (slow-path interop)."""
+        return RawRecord(self.buf[self.data_off[i]: self.data_end[i]].tobytes())
+
+    def raw_records(self, indices) -> list:
+        return [self.raw_record(int(i)) for i in indices]
+
+
+class BamBatchReader:
+    """Yields RecordBatch objects of ~target_bytes decompressed payload."""
+
+    def __init__(self, path_or_obj, target_bytes: int = 16 << 20):
+        owns = isinstance(path_or_obj, str)
+        fileobj = open(path_or_obj, "rb") if owns else path_or_obj
+        self._r = BgzfReader(fileobj, owns_fileobj=owns)
+        self.header = BamHeader.decode_from(self._r.read)
+        self._target = target_bytes
+        self._acc = bytearray()
+        self._eof = False
+
+    def _fill(self):
+        while len(self._acc) < self._target and not self._eof:
+            chunk = self._r.read_into_available()
+            if not chunk:
+                self._eof = True
+                break
+            self._acc += chunk
+
+    def __iter__(self):
+        while True:
+            self._fill()
+            if not self._acc:
+                return
+            buf = np.frombuffer(bytes(self._acc), dtype=np.uint8)
+            max_records = len(buf) // _MIN_RECORD_WIRE + 1
+            offsets, scanned = nb.find_boundaries(buf, max_records)
+            if len(offsets) == 0:
+                if self._eof:
+                    raise EOFError("truncated BAM record at end of stream")
+                # a single record larger than the accumulated bytes: grow
+                self._target *= 2
+                continue
+            chunk = self._acc[:scanned]
+            del self._acc[:scanned]
+            # a trailing partial record at EOF surfaces as an empty scan on the
+            # next iteration and raises there, after this chunk is consumed
+            yield RecordBatch(chunk, offsets.copy())
+
+    def close(self):
+        self._r.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

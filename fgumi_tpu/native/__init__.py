@@ -75,6 +75,28 @@ def get_lib():
             ctypes.c_char_p, ctypes.c_long,
             ctypes.POINTER(ctypes.c_int64), ctypes.c_long,
             ctypes.POINTER(ctypes.c_int64)]
+        # batch record layer: all pointers passed as raw addresses (numpy
+        # array .ctypes.data); see fgumi_tpu/native/batch.py wrappers.
+        p = ctypes.c_void_p
+        lib.fgumi_decode_fields.restype = None
+        lib.fgumi_decode_fields.argtypes = [p, p, ctypes.c_long] + [p] * 12
+        lib.fgumi_scan_tags.restype = None
+        lib.fgumi_scan_tags.argtypes = [p, p, p, ctypes.c_long, p,
+                                        ctypes.c_long, p, p, p]
+        lib.fgumi_group_starts.restype = ctypes.c_long
+        lib.fgumi_group_starts.argtypes = [p, p, p, ctypes.c_long, p]
+        lib.fgumi_pack_reads.restype = None
+        lib.fgumi_pack_reads.argtypes = [p, p, p, p, p, p, ctypes.c_long,
+                                         ctypes.c_int, ctypes.c_long, p, p, p]
+        lib.fgumi_mate_clips.restype = None
+        lib.fgumi_mate_clips.argtypes = [p] * 11 + [ctypes.c_long, p]
+        lib.fgumi_overlap_correct_pairs.restype = None
+        lib.fgumi_overlap_correct_pairs.argtypes = [
+            p, p, p, ctypes.c_long, ctypes.c_int, ctypes.c_int, p]
+        lib.fgumi_build_consensus_records.restype = ctypes.c_long
+        lib.fgumi_build_consensus_records.argtypes = (
+            [p] * 6 + [ctypes.c_long, p, ctypes.c_int, p, p, p, p, p, p, p,
+                       ctypes.c_int, ctypes.c_int, p, ctypes.c_long, p])
         _lib = lib
         log.debug("native library loaded from %s", _SO_PATH)
         return _lib

@@ -1,0 +1,195 @@
+"""Numpy-facing wrappers for the native batch record layer.
+
+Each call hands whole numpy arrays to C++ (fgumi_native.cc batch section), so
+Python cost is per-batch, not per-record — the discipline the reference keeps
+with its raw-record design (crates/fgumi-raw-bam/src/raw_bam_record.rs:6-13).
+
+All wrappers require the native library; callers check `available()` once and
+fall back to the pure-Python record path when it is False.
+"""
+
+import numpy as np
+
+from . import get_lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def _addr(arr: np.ndarray) -> int:
+    assert arr.flags["C_CONTIGUOUS"]
+    return arr.ctypes.data
+
+
+def find_boundaries(buf: np.ndarray, max_records: int):
+    """(offsets int64[n], scanned) — record starts in decompressed BAM bytes."""
+    import ctypes
+
+    lib = get_lib()
+    offsets = np.empty(max_records, dtype=np.int64)
+    scanned = ctypes.c_int64(0)
+    n = lib.fgumi_find_record_boundaries(
+        buf.ctypes.data_as(ctypes.c_char_p), len(buf),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), max_records,
+        ctypes.byref(scanned))
+    return offsets[:n], scanned.value
+
+
+def decode_fields(buf: np.ndarray, rec_off: np.ndarray) -> dict:
+    """Struct-of-arrays fixed-field decode (fields.rs:7-24 layout)."""
+    lib = get_lib()
+    n = len(rec_off)
+    i32 = {k: np.empty(n, dtype=np.int32)
+           for k in ("ref_id", "pos", "mapq", "flag", "l_seq", "n_cigar",
+                     "l_read_name", "next_ref_id", "next_pos", "tlen")}
+    data_off = np.empty(n, dtype=np.int64)
+    data_end = np.empty(n, dtype=np.int64)
+    lib.fgumi_decode_fields(
+        _addr(buf), _addr(rec_off), n,
+        _addr(i32["ref_id"]), _addr(i32["pos"]), _addr(i32["mapq"]),
+        _addr(i32["flag"]), _addr(i32["l_seq"]), _addr(i32["n_cigar"]),
+        _addr(i32["l_read_name"]), _addr(i32["next_ref_id"]),
+        _addr(i32["next_pos"]), _addr(i32["tlen"]), _addr(data_off),
+        _addr(data_end))
+    i32["data_off"] = data_off
+    i32["data_end"] = data_end
+    return i32
+
+
+def scan_tags(buf: np.ndarray, aux_off: np.ndarray, aux_end: np.ndarray,
+              tags: list):
+    """Per-record aux-tag locations for k tags.
+
+    Returns (val_off int64[n,k], val_len int32[n,k], val_type uint8[n,k]);
+    val_off -1 where the tag is absent.
+    """
+    lib = get_lib()
+    n = len(aux_off)
+    k = len(tags)
+    tag_bytes = np.frombuffer(b"".join(tags), dtype=np.uint8)
+    val_off = np.empty((n, k), dtype=np.int64)
+    val_len = np.empty((n, k), dtype=np.int32)
+    val_type = np.empty((n, k), dtype=np.uint8)
+    lib.fgumi_scan_tags(_addr(buf), _addr(aux_off), _addr(aux_end), n,
+                        _addr(tag_bytes), k, _addr(val_off), _addr(val_len),
+                        _addr(val_type))
+    return val_off, val_len, val_type
+
+
+def group_starts(buf: np.ndarray, off: np.ndarray, length: np.ndarray):
+    """Group indices by byte-range equality; raises if any off < 0 (missing)."""
+    lib = get_lib()
+    n = len(off)
+    starts = np.empty(n, dtype=np.int64)
+    # converted arrays must stay referenced until the foreign call returns
+    length = np.ascontiguousarray(length, np.int32)
+    g = lib.fgumi_group_starts(_addr(buf), _addr(off), _addr(length),
+                               n, _addr(starts))
+    if g < 0:
+        raise ValueError(f"record {-g - 1} missing grouping tag; run `group` first")
+    return starts[:g]
+
+
+def pack_reads(buf: np.ndarray, seq_off: np.ndarray, qual_off: np.ndarray,
+               l_seq: np.ndarray, reverse: np.ndarray, clip: np.ndarray,
+               min_q: int, stride: int):
+    """Batch SourceRead conversion into (n, stride) code/qual rows.
+
+    Returns (codes uint8[n,stride], quals uint8[n,stride], final_len int32[n]);
+    final_len -1 marks rejected reads (empty / all-0xFF quals).
+    """
+    lib = get_lib()
+    n = len(seq_off)
+    codes = np.empty((n, stride), dtype=np.uint8)
+    quals = np.empty((n, stride), dtype=np.uint8)
+    final_len = np.empty(n, dtype=np.int32)
+    # converted arrays must stay referenced until the foreign call returns
+    l_seq = np.ascontiguousarray(l_seq, np.int32)
+    reverse = np.ascontiguousarray(reverse, np.uint8)
+    clip = np.ascontiguousarray(clip, np.int32)
+    lib.fgumi_pack_reads(
+        _addr(buf), _addr(seq_off), _addr(qual_off), _addr(l_seq),
+        _addr(reverse), _addr(clip),
+        n, min_q, stride, _addr(codes), _addr(quals), _addr(final_len))
+    return codes, quals, final_len
+
+
+def mate_clips(buf: np.ndarray, cigar_off: np.ndarray, n_cigar: np.ndarray,
+               flag: np.ndarray, ref_id: np.ndarray, pos: np.ndarray,
+               next_ref_id: np.ndarray, next_pos: np.ndarray,
+               tlen: np.ndarray, mc_off: np.ndarray, mc_len: np.ndarray):
+    """Batch num_bases_extending_past_mate (overlap.rs:117-140) -> int32[n]."""
+    lib = get_lib()
+    n = len(cigar_off)
+    clip = np.empty(n, dtype=np.int32)
+    # converted arrays must stay referenced until the foreign call returns
+    keep = [np.ascontiguousarray(a, np.int32)
+            for a in (n_cigar, flag, ref_id, pos, next_ref_id, next_pos, tlen,
+                      mc_len)]
+    n_cigar, flag, ref_id, pos, next_ref_id, next_pos, tlen, mc_len = keep
+    lib.fgumi_mate_clips(
+        _addr(buf), _addr(cigar_off), _addr(n_cigar), _addr(flag),
+        _addr(ref_id), _addr(pos), _addr(next_ref_id), _addr(next_pos),
+        _addr(tlen), _addr(mc_off), _addr(mc_len), n, _addr(clip))
+    return clip
+
+
+def build_consensus_records(code_addr, qual_addr, depth_addr, err_addr, lens,
+                            flags, prefix: bytes, mi_blob, mi_off, mi_len,
+                            rx_blob, rx_off, rx_len, rg: bytes,
+                            per_base_tags: bool):
+    """Serialize J consensus records into one block_size-prefixed wire blob.
+
+    The *_addr arrays are raw element addresses (int64) into caller-owned
+    arrays, which MUST stay referenced for the duration of the call.
+    Returns bytes (the concatenated records, ready for BamWriter raw append).
+    """
+    lib = get_lib()
+    J = len(lens)
+    lens = np.ascontiguousarray(lens, np.int32)
+    flags = np.ascontiguousarray(flags, np.int32)
+    mi_len = np.ascontiguousarray(mi_len, np.int32)
+    rx_len = np.ascontiguousarray(rx_len, np.int32)
+    # exact per-record size bound (mirrors the C size computation)
+    per_rec = (4 + 32 + len(prefix) + 1 + mi_len.astype(np.int64) + 1
+               + (lens + 1) // 2 + lens + (3 + len(rg) + 1) + 21
+               + (3 + mi_len.astype(np.int64) + 1)
+               + np.where(rx_off >= 0, 3 + rx_len.astype(np.int64) + 1, 0))
+    if per_base_tags:
+        per_rec = per_rec + 2 * (8 + 2 * lens.astype(np.int64))
+    out_cap = int(per_rec.sum())
+    out = np.empty(out_cap, dtype=np.uint8)
+    rec_end = np.empty(J, dtype=np.int64)
+    mi_blob = np.ascontiguousarray(mi_blob, np.uint8)
+    rx_blob = np.ascontiguousarray(rx_blob, np.uint8)
+    prefix_arr = np.frombuffer(prefix, dtype=np.uint8)
+    rg_arr = np.frombuffer(rg, dtype=np.uint8)
+    total = lib.fgumi_build_consensus_records(
+        _addr(code_addr), _addr(qual_addr), _addr(depth_addr),
+        _addr(err_addr), _addr(lens), _addr(flags), J,
+        _addr(prefix_arr), len(prefix), _addr(mi_blob), _addr(mi_off),
+        _addr(mi_len), _addr(rx_blob), _addr(rx_off), _addr(rx_len),
+        _addr(rg_arr), len(rg), int(per_base_tags), _addr(out), out_cap,
+        _addr(rec_end))
+    if total < 0:
+        raise RuntimeError("consensus record serialization overflow")
+    return out[:total].tobytes(), rec_end
+
+
+def overlap_correct_pairs(buf: np.ndarray, r1_off: np.ndarray,
+                          r2_off: np.ndarray, agreement: int,
+                          disagreement: int) -> np.ndarray:
+    """In-place R1/R2 overlap correction on a WRITABLE buffer.
+
+    agreement: 0=consensus 1=max-qual 2=pass-through; disagreement:
+    0=consensus 1=mask-both 2=mask-lower-qual. Returns int64[4] stats
+    (overlapping, agreeing, disagreeing, corrected).
+    """
+    lib = get_lib()
+    assert buf.flags["WRITEABLE"]
+    stats = np.zeros(4, dtype=np.int64)
+    lib.fgumi_overlap_correct_pairs(_addr(buf), _addr(r1_off), _addr(r2_off),
+                                    len(r1_off), agreement, disagreement,
+                                    _addr(stats))
+    return stats

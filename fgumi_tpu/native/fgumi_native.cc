@@ -10,6 +10,8 @@
 
 #include <cstdint>
 #include <cstring>
+#include <utility>
+#include <vector>
 
 namespace {
 
@@ -173,6 +175,753 @@ long fgumi_find_record_boundaries(const uint8_t* buf, long len,
   }
   *scanned = off;
   return n;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Batch consensus-record serializer.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+// consensus base code -> BAM seq nibble (A,C,G,T,N -> 1,2,4,8,15).
+const uint8_t kCode2Nib[5] = {1, 2, 4, 8, 15};
+
+inline void put_u16(uint8_t* p, uint16_t v) {
+  p[0] = v & 0xFF;
+  p[1] = v >> 8;
+}
+
+inline void put_u32(uint8_t* p, uint32_t v) {
+  p[0] = v & 0xFF;
+  p[1] = (v >> 8) & 0xFF;
+  p[2] = (v >> 16) & 0xFF;
+  p[3] = (v >> 24) & 0xFF;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Serialize J unmapped consensus records (block_size-prefixed BAM wire bytes)
+// into `out`. Mirrors VanillaConsensusCaller._build_record
+// (consensus/vanilla.py:439-483; reference build_consensus_record_into,
+// vanilla_caller.rs:1452-1540): header + name + packed seq + quals, then tags
+// RG:Z, cD:i, cM:i, cE:f, [cd:B:s, ce:B:s], MI:Z, [RX:Z]. depth/errors clamp
+// to i16::MAX (fgbio Short semantics). Names are prefix + ':' + MI value.
+// Per-record data arrives as raw addresses (code_addr[j] -> uint8[lens[j]],
+// depth_addr[j] -> int32[lens[j]], ...) so callers can point straight into
+// their bucket tensors without gathering a dense (J, L) copy.
+// Returns total bytes written, or -1 when out_cap is insufficient.
+long fgumi_build_consensus_records(
+    const int64_t* code_addr, const int64_t* qual_addr,
+    const int64_t* depth_addr, const int64_t* err_addr, const int32_t* lens,
+    const int32_t* flags, long J, const uint8_t* prefix, int prefix_len,
+    const uint8_t* mi_blob, const int64_t* mi_off, const int32_t* mi_len,
+    const uint8_t* rx_blob, const int64_t* rx_off, const int32_t* rx_len,
+    const uint8_t* rg, int rg_len, int per_base_tags, uint8_t* out,
+    long out_cap, int64_t* rec_end) {
+  long off = 0;
+  for (long j = 0; j < J; ++j) {
+    const int32_t L = lens[j];
+    const uint8_t* crow = reinterpret_cast<const uint8_t*>(code_addr[j]);
+    const uint8_t* qrow = reinterpret_cast<const uint8_t*>(qual_addr[j]);
+    const int32_t* drow = reinterpret_cast<const int32_t*>(depth_addr[j]);
+    const int32_t* erow = reinterpret_cast<const int32_t*>(err_addr[j]);
+    const int32_t name_len = prefix_len + 1 + mi_len[j];
+    long need = 4 + 32 + name_len + 1 + (L + 1) / 2 + L;
+    need += 3 + rg_len + 1;        // RG:Z
+    need += (7 + 7 + 7);           // cD cM cE
+    if (per_base_tags) need += 2 * (8 + 2 * static_cast<long>(L));
+    need += 3 + mi_len[j] + 1;     // MI:Z
+    if (rx_off[j] >= 0) need += 3 + rx_len[j] + 1;
+    if (off + need > out_cap) return -1;
+
+    uint8_t* rec = out + off + 4;  // past block_size prefix
+    // fixed header (io/bam.py start_unmapped): refID -1, pos -1, l_read_name,
+    // mapq 0, bin 4680, n_cigar 0, flag, l_seq, next_refID -1, next_pos -1,
+    // tlen 0
+    put_u32(rec + 0, 0xFFFFFFFFu);
+    put_u32(rec + 4, 0xFFFFFFFFu);
+    rec[8] = static_cast<uint8_t>(name_len + 1);
+    rec[9] = 0;
+    put_u16(rec + 10, 4680);
+    put_u16(rec + 12, 0);
+    put_u16(rec + 14, static_cast<uint16_t>(flags[j]));
+    put_u32(rec + 16, static_cast<uint32_t>(L));
+    put_u32(rec + 20, 0xFFFFFFFFu);
+    put_u32(rec + 24, 0xFFFFFFFFu);
+    put_u32(rec + 28, 0);
+    uint8_t* p = rec + 32;
+    std::memcpy(p, prefix, static_cast<size_t>(prefix_len));
+    p += prefix_len;
+    *p++ = ':';
+    std::memcpy(p, mi_blob + mi_off[j], static_cast<size_t>(mi_len[j]));
+    p += mi_len[j];
+    *p++ = 0;
+    // packed seq
+    for (int32_t i = 0; i + 1 < L; i += 2) {
+      const uint8_t hi = kCode2Nib[crow[i] < 4 ? crow[i] : 4];
+      const uint8_t lo = kCode2Nib[crow[i + 1] < 4 ? crow[i + 1] : 4];
+      *p++ = static_cast<uint8_t>((hi << 4) | lo);
+    }
+    if (L & 1) {
+      *p++ = static_cast<uint8_t>(kCode2Nib[crow[L - 1] < 4 ? crow[L - 1] : 4]
+                                  << 4);
+    }
+    std::memcpy(p, qrow, static_cast<size_t>(L));
+    p += L;
+    // RG:Z
+    p[0] = 'R'; p[1] = 'G'; p[2] = 'Z';
+    std::memcpy(p + 3, rg, static_cast<size_t>(rg_len));
+    p += 3 + rg_len;
+    *p++ = 0;
+    // depth/error aggregates over clamped i16 values
+    int32_t max_d = 0, min_d = 0;
+    int64_t tot_d = 0, tot_e = 0;
+    if (L > 0) {
+      max_d = -1;
+      min_d = 0x7FFFFFFF;
+      for (int32_t i = 0; i < L; ++i) {
+        const int32_t d16 = drow[i] < 32767 ? drow[i] : 32767;
+        const int32_t e16 = erow[i] < 32767 ? erow[i] : 32767;
+        if (d16 > max_d) max_d = d16;
+        if (d16 < min_d) min_d = d16;
+        tot_d += d16;
+        tot_e += e16;
+      }
+    }
+    p[0] = 'c'; p[1] = 'D'; p[2] = 'i';
+    put_u32(p + 3, static_cast<uint32_t>(L > 0 ? max_d : 0));
+    p += 7;
+    p[0] = 'c'; p[1] = 'M'; p[2] = 'i';
+    put_u32(p + 3, static_cast<uint32_t>(L > 0 ? min_d : 0));
+    p += 7;
+    const float rate =
+        tot_d ? static_cast<float>(tot_e) / static_cast<float>(tot_d) : 0.0f;
+    p[0] = 'c'; p[1] = 'E'; p[2] = 'f';
+    uint32_t rate_bits;
+    std::memcpy(&rate_bits, &rate, 4);
+    put_u32(p + 3, rate_bits);
+    p += 7;
+    if (per_base_tags) {
+      p[0] = 'c'; p[1] = 'd'; p[2] = 'B'; p[3] = 's';
+      put_u32(p + 4, static_cast<uint32_t>(L));
+      p += 8;
+      for (int32_t i = 0; i < L; ++i) {
+        const int32_t d16 = drow[i] < 32767 ? drow[i] : 32767;
+        put_u16(p, static_cast<uint16_t>(static_cast<int16_t>(d16)));
+        p += 2;
+      }
+      p[0] = 'c'; p[1] = 'e'; p[2] = 'B'; p[3] = 's';
+      put_u32(p + 4, static_cast<uint32_t>(L));
+      p += 8;
+      for (int32_t i = 0; i < L; ++i) {
+        const int32_t e16 = erow[i] < 32767 ? erow[i] : 32767;
+        put_u16(p, static_cast<uint16_t>(static_cast<int16_t>(e16)));
+        p += 2;
+      }
+    }
+    p[0] = 'M'; p[1] = 'I'; p[2] = 'Z';
+    std::memcpy(p + 3, mi_blob + mi_off[j], static_cast<size_t>(mi_len[j]));
+    p += 3 + mi_len[j];
+    *p++ = 0;
+    if (rx_off[j] >= 0) {
+      p[0] = 'R'; p[1] = 'X'; p[2] = 'Z';
+      std::memcpy(p + 3, rx_blob + rx_off[j], static_cast<size_t>(rx_len[j]));
+      p += 3 + rx_len[j];
+      *p++ = 0;
+    }
+    const long rec_size = p - rec;
+    put_u32(out + off, static_cast<uint32_t>(rec_size));
+    off += 4 + rec_size;
+    rec_end[j] = off;
+  }
+  return off;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Batch record decode / pack layer.
+//
+// C++ equivalents of the reference's raw-record hot path
+// (crates/fgumi-raw-bam/src/fields.rs:1-43, raw_bam_record.rs:6-13): Python
+// touches per-*batch* numpy arrays, never per-record objects. All offsets are
+// into one decompressed chunk buffer; fixed BAM field layout per SAM spec §4.2.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+inline int32_t read_i32(const uint8_t* p) {
+  return static_cast<int32_t>(read_u32(p));
+}
+
+// BAM nibble -> consensus base code (A,C,G,T -> 0..3, everything else 4/N),
+// composing NIBBLE_TO_BASE ("=ACMGRSVTWYHKDBN") with BASE_TO_CODE
+// (fgumi_tpu/constants.py; reference BASE_TO_INDEX base_builder.rs:307-318).
+const uint8_t kNib2Code[16] = {4, 0, 1, 4, 2, 4, 4, 4, 3, 4, 4, 4, 4, 4, 4, 4};
+
+// CIGAR op index (MIDNSHP=X) predicates.
+inline bool op_consumes_query(uint32_t op) {
+  // M I S = X
+  return op == 0 || op == 1 || op == 4 || op == 7 || op == 8;
+}
+inline bool op_consumes_ref(uint32_t op) {
+  // M D N = X
+  return op == 0 || op == 2 || op == 3 || op == 7 || op == 8;
+}
+inline bool op_is_align(uint32_t op) {  // M = X
+  return op == 0 || op == 7 || op == 8;
+}
+
+struct CigarView {
+  const uint8_t* p;
+  int32_t n;
+  inline uint32_t op(int32_t i) const { return read_u32(p + 4 * i) & 0xF; }
+  inline int64_t len(int32_t i) const { return read_u32(p + 4 * i) >> 4; }
+};
+
+int64_t cigar_ref_len(const CigarView& c) {
+  int64_t total = 0;
+  for (int32_t i = 0; i < c.n; ++i) {
+    if (op_consumes_ref(c.op(i))) total += c.len(i);
+  }
+  return total;
+}
+
+int64_t cigar_read_len(const CigarView& c) {
+  int64_t total = 0;
+  for (int32_t i = 0; i < c.n; ++i) {
+    if (op_consumes_query(c.op(i))) total += c.len(i);
+  }
+  return total;
+}
+
+int64_t cigar_leading_soft(const CigarView& c) {
+  int64_t total = 0;
+  for (int32_t i = 0; i < c.n; ++i) {
+    const uint32_t op = c.op(i);
+    if (op == 4) {       // S
+      total += c.len(i);
+    } else if (op == 5) {  // H
+      continue;
+    } else {
+      break;
+    }
+  }
+  return total;
+}
+
+int64_t cigar_trailing_soft(const CigarView& c) {
+  int64_t total = 0;
+  for (int32_t i = c.n - 1; i >= 0; --i) {
+    const uint32_t op = c.op(i);
+    if (op == 4) {
+      total += c.len(i);
+    } else if (op == 5) {
+      continue;
+    } else {
+      break;
+    }
+  }
+  return total;
+}
+
+// 1-based read position at reference position `target`; 0 if in a
+// deletion/outside. Mirrors fgumi_tpu/core/overlap.py::_read_pos_at_ref
+// (reference overlap.rs:362-411).
+int64_t read_pos_at_ref(const CigarView& c, int64_t start_1based,
+                        int64_t target, bool before) {
+  int64_t ref_pos = start_1based;
+  int64_t read_pos = 0;
+  for (int32_t i = 0; i < c.n; ++i) {
+    const uint32_t op = c.op(i);
+    const int64_t length = c.len(i);
+    if (op_is_align(op)) {
+      if (target < ref_pos) return 0;
+      if (target < ref_pos + length) {
+        read_pos += target - ref_pos + 1;
+        if (before) {
+          const int64_t b = read_pos - 1;
+          return b > 0 ? b : 0;
+        }
+        return read_pos;
+      }
+      read_pos += length;
+      ref_pos += length;
+    } else if (op == 1 || op == 4) {  // I S
+      read_pos += length;
+    } else if (op == 2 || op == 3) {  // D N
+      if (ref_pos <= target && target < ref_pos + length) return 0;
+      ref_pos += length;
+    }
+  }
+  return 0;
+}
+
+// Parse an MC-tag CIGAR string: (leading_soft, ref_len, trailing_soft).
+// Mirrors overlap.py::parse_soft_clips_and_ref_len (overlap.rs:277-345).
+bool parse_mc_cigar(const uint8_t* s, int64_t len, int64_t* leading_soft,
+                    int64_t* ref_len, int64_t* trailing_soft) {
+  std::vector<std::pair<int64_t, char>> tokens;
+  int64_t num = 0;
+  bool have_digits = false;
+  for (int64_t i = 0; i < len; ++i) {
+    const char ch = static_cast<char>(s[i]);
+    if (ch >= '0' && ch <= '9') {
+      num = num * 10 + (ch - '0');
+      have_digits = true;
+      continue;
+    }
+    if (!have_digits || num == 0 ||
+        std::strchr("MIDNSHP=X", ch) == nullptr) {
+      return false;
+    }
+    tokens.emplace_back(num, ch);
+    num = 0;
+    have_digits = false;
+  }
+  if (have_digits || tokens.empty()) return false;
+
+  const size_t last = tokens.size() - 1;
+  int64_t lead = 0, trail = 0, rlen = 0;
+  bool saw_ref_op = false;
+  for (size_t i = 0; i < tokens.size(); ++i) {
+    const int64_t length = tokens[i].first;
+    const char op = tokens[i].second;
+    if (op == 'M' || op == 'D' || op == 'N' || op == '=' || op == 'X') {
+      rlen += length;
+      saw_ref_op = true;
+    } else if (op == 'I' || op == 'P') {
+      // no-op
+    } else if (op == 'S') {
+      bool leading = true;
+      for (size_t j = 0; j < i; ++j) {
+        if (tokens[j].second != 'H') { leading = false; break; }
+      }
+      bool trailing = true;
+      for (size_t j = i + 1; j < tokens.size(); ++j) {
+        if (tokens[j].second != 'H') { trailing = false; break; }
+      }
+      if (!leading && !trailing) return false;
+      if (saw_ref_op) {
+        trail += length;
+      } else {
+        lead += length;
+      }
+    } else if (op == 'H') {
+      if (i != 0 && i != last) return false;
+    } else {
+      return false;
+    }
+  }
+  if (!saw_ref_op) return false;
+  *leading_soft = lead;
+  *ref_len = rlen;
+  *trailing_soft = trail;
+  return true;
+}
+
+// BAM flag bits.
+constexpr int32_t kFlagPaired = 0x1;
+constexpr int32_t kFlagUnmapped = 0x4;
+constexpr int32_t kFlagMateUnmapped = 0x8;
+constexpr int32_t kFlagReverse = 0x10;
+constexpr int32_t kFlagMateReverse = 0x20;
+
+// Mirrors overlap.py::is_fr_pair (overlap.rs:14-61).
+bool is_fr_pair(int32_t flag, int32_t ref_id, int32_t next_ref_id, int32_t pos,
+                int32_t next_pos, int32_t tlen, const CigarView& c) {
+  if (!(flag & kFlagPaired)) return false;
+  if (flag & (kFlagUnmapped | kFlagMateUnmapped)) return false;
+  if (ref_id != next_ref_id) return false;
+  const bool is_rev = flag & kFlagReverse;
+  if (is_rev == static_cast<bool>(flag & kFlagMateReverse)) return false;
+  const int64_t start = static_cast<int64_t>(pos) + 1;
+  const int64_t mate_start = static_cast<int64_t>(next_pos) + 1;
+  int64_t positive_5p, negative_5p;
+  if (is_rev) {
+    const int64_t rl = cigar_ref_len(c);
+    positive_5p = mate_start;
+    negative_5p = start + (rl - 1 > 0 ? rl - 1 : 0);
+  } else {
+    positive_5p = start;
+    negative_5p = start + tlen;
+  }
+  return positive_5p < negative_5p;
+}
+
+// Mirrors overlap.py::_bases_extending_past_mate (overlap.rs:172-231).
+int64_t bases_extending_past_mate(const CigarView& c, int32_t flag, int32_t pos,
+                                  int64_t mate_unclipped_start,
+                                  int64_t mate_unclipped_end) {
+  const int64_t read_length = cigar_read_len(c);
+  const int64_t this_pos = static_cast<int64_t>(pos) + 1;
+  if (flag & kFlagReverse) {
+    if (this_pos <= mate_unclipped_start) {
+      return read_pos_at_ref(c, this_pos, mate_unclipped_start, true);
+    }
+    const int64_t gap = this_pos - mate_unclipped_start;
+    const int64_t v = cigar_leading_soft(c) - (gap > 0 ? gap : 0);
+    return v > 0 ? v : 0;
+  }
+  const int64_t alignment_end = this_pos - 1 + cigar_ref_len(c);
+  if (alignment_end >= mate_unclipped_end) {
+    const int64_t bases_past =
+        read_pos_at_ref(c, this_pos, mate_unclipped_end, false);
+    const int64_t v = read_length - bases_past;
+    return v > 0 ? v : 0;
+  }
+  const int64_t gap = mate_unclipped_end - alignment_end;
+  const int64_t v = cigar_trailing_soft(c) - (gap > 0 ? gap : 0);
+  return v > 0 ? v : 0;
+}
+
+// Size of a fixed-width aux value type, or 0 when variable/unknown.
+inline int64_t tag_fixed_size(uint8_t typ) {
+  switch (typ) {
+    case 'A': case 'c': case 'C': return 1;
+    case 's': case 'S': return 2;
+    case 'i': case 'I': case 'f': return 4;
+    default: return 0;
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decode fixed-offset fields for n records into struct-of-arrays outputs.
+// rec_off[i] points at record i's 4-byte block_size prefix.
+void fgumi_decode_fields(const uint8_t* buf, const int64_t* rec_off, long n,
+                         int32_t* ref_id, int32_t* pos, int32_t* mapq,
+                         int32_t* flag, int32_t* l_seq, int32_t* n_cigar,
+                         int32_t* l_read_name, int32_t* next_ref_id,
+                         int32_t* next_pos, int32_t* tlen, int64_t* data_off,
+                         int64_t* data_end) {
+  for (long i = 0; i < n; ++i) {
+    const uint8_t* r = buf + rec_off[i];
+    const uint32_t block_size = read_u32(r);
+    const uint8_t* d = r + 4;
+    ref_id[i] = read_i32(d);
+    pos[i] = read_i32(d + 4);
+    l_read_name[i] = d[8];
+    mapq[i] = d[9];
+    n_cigar[i] = read_u16(d + 12);
+    flag[i] = read_u16(d + 14);
+    l_seq[i] = read_i32(d + 16);
+    next_ref_id[i] = read_i32(d + 20);
+    next_pos[i] = read_i32(d + 24);
+    tlen[i] = read_i32(d + 28);
+    data_off[i] = rec_off[i] + 4;
+    data_end[i] = rec_off[i] + 4 + block_size;
+  }
+}
+
+// Scan each record's aux TLV region for k 2-byte tags (tags = k*2 bytes).
+// Outputs are row-major (n, k): val_off = byte offset of the value (-1 when
+// missing), val_len = value length in bytes (Z/H: strlen excluding NUL),
+// val_type = type char. A malformed TLV entry stops that record's scan
+// (already-found tags are kept). Mirrors io/bam.py::_iter_tags (tags.rs:8-40).
+void fgumi_scan_tags(const uint8_t* buf, const int64_t* aux_off,
+                     const int64_t* aux_end, long n, const uint8_t* tags,
+                     long k, int64_t* val_off, int32_t* val_len,
+                     uint8_t* val_type) {
+  for (long i = 0; i < n; ++i) {
+    int64_t* vo = val_off + i * k;
+    int32_t* vl = val_len + i * k;
+    uint8_t* vt = val_type + i * k;
+    for (long j = 0; j < k; ++j) {
+      vo[j] = -1;
+      vl[j] = 0;
+      vt[j] = 0;
+    }
+    int64_t off = aux_off[i];
+    const int64_t end = aux_end[i];
+    long found = 0;
+    while (off + 3 <= end && found < k) {
+      const uint8_t t1 = buf[off];
+      const uint8_t t2 = buf[off + 1];
+      const uint8_t typ = buf[off + 2];
+      off += 3;
+      int64_t size = tag_fixed_size(typ);
+      if (size == 0) {
+        if (typ == 'Z' || typ == 'H') {
+          const uint8_t* nul = static_cast<const uint8_t*>(
+              std::memchr(buf + off, 0, static_cast<size_t>(end - off)));
+          if (nul == nullptr) break;  // malformed: unterminated string
+          size = (nul - (buf + off)) + 1;
+        } else if (typ == 'B') {
+          if (off + 5 > end) break;
+          const int64_t esize = tag_fixed_size(buf[off]);
+          if (esize == 0) break;
+          size = 5 + esize * static_cast<int64_t>(read_u32(buf + off + 1));
+        } else {
+          break;  // unknown type: stop scanning this record
+        }
+      }
+      if (off + size > end) break;
+      for (long j = 0; j < k; ++j) {
+        if (vo[j] < 0 && tags[2 * j] == t1 && tags[2 * j + 1] == t2) {
+          vo[j] = off;
+          vl[j] = static_cast<int32_t>(
+              (typ == 'Z' || typ == 'H') ? size - 1 : size);
+          vt[j] = typ;
+          ++found;
+        }
+      }
+      off += size;
+    }
+  }
+}
+
+// Group n records by equality of a byte range (e.g. an MI tag value or the
+// CIGAR region): starts[g] = first record index of group g; returns the group
+// count. A record with off < 0 (missing tag) returns -(i+1) so the caller can
+// raise (iter_mi_groups raises on missing MI, core/grouper.py:38-41).
+long fgumi_group_starts(const uint8_t* buf, const int64_t* off,
+                        const int32_t* len, long n, int64_t* starts) {
+  long g = 0;
+  for (long i = 0; i < n; ++i) {
+    if (off[i] < 0) return -(i + 1);
+    if (i == 0 || len[i] != len[i - 1] ||
+        std::memcmp(buf + off[i], buf + off[i - 1],
+                    static_cast<size_t>(len[i])) != 0) {
+      starts[g++] = i;
+    }
+  }
+  return g;
+}
+
+// Batch SourceRead conversion (vanilla_caller.rs:940-1032 semantics; mirrors
+// consensus/vanilla.py::_create_source_read with trim disabled): unpack 4-bit
+// seq into base codes 0..4 + quals at codes/quals + i*stride, reverse-
+// complement reverse-strand reads, mask q<min_q to N/Q2, clip `clip[i]` bases
+// from the (oriented) end, trim trailing Ns. final_len[i] = surviving length,
+// -1 for rejected reads (empty or all-0xFF quals). Row tails are padded N/0.
+void fgumi_pack_reads(const uint8_t* buf, const int64_t* seq_off,
+                      const int64_t* qual_off, const int32_t* l_seq,
+                      const uint8_t* reverse, const int32_t* clip, long n,
+                      int min_q, long stride, uint8_t* codes, uint8_t* quals,
+                      int32_t* final_len) {
+  for (long i = 0; i < n; ++i) {
+    uint8_t* crow = codes + i * stride;
+    uint8_t* qrow = quals + i * stride;
+    int64_t read_len = l_seq[i];
+    if (read_len > stride) read_len = stride;
+    if (read_len <= 0) {
+      final_len[i] = -1;
+      std::memset(crow, 4, static_cast<size_t>(stride));
+      std::memset(qrow, 0, static_cast<size_t>(stride));
+      continue;
+    }
+    const uint8_t* packed = buf + seq_off[i];
+    const uint8_t* q = buf + qual_off[i];
+    bool all_ff = true;
+    for (int64_t j = 0; j < read_len; ++j) {
+      if (q[j] != 0xFF) { all_ff = false; break; }
+    }
+    if (all_ff) {
+      final_len[i] = -1;
+      std::memset(crow, 4, static_cast<size_t>(stride));
+      std::memset(qrow, 0, static_cast<size_t>(stride));
+      continue;
+    }
+    if (reverse[i]) {
+      // write reverse-complemented: output j <- input read_len-1-j
+      for (int64_t j = 0; j < read_len; ++j) {
+        const int64_t src = read_len - 1 - j;
+        const uint8_t nib =
+            (src & 1) ? (packed[src >> 1] & 0xF) : (packed[src >> 1] >> 4);
+        const uint8_t code = kNib2Code[nib];
+        crow[j] = code < 4 ? static_cast<uint8_t>(3 - code) : 4;
+        qrow[j] = q[src];
+      }
+    } else {
+      for (int64_t j = 0; j < read_len; ++j) {
+        const uint8_t nib =
+            (j & 1) ? (packed[j >> 1] & 0xF) : (packed[j >> 1] >> 4);
+        crow[j] = kNib2Code[nib];
+        qrow[j] = q[j];
+      }
+    }
+    for (int64_t j = 0; j < read_len; ++j) {
+      if (qrow[j] < min_q) {
+        crow[j] = 4;
+        qrow[j] = 2;
+      }
+    }
+    int64_t final_n = read_len - clip[i];
+    if (final_n < 0) final_n = 0;
+    while (final_n > 0 && crow[final_n - 1] == 4) --final_n;
+    final_len[i] = static_cast<int32_t>(final_n);
+    if (final_n < stride) {
+      std::memset(crow + final_n, 4, static_cast<size_t>(stride - final_n));
+      std::memset(qrow + final_n, 0, static_cast<size_t>(stride - final_n));
+    }
+  }
+}
+
+// Batch mate-overlap clip counts (overlap.rs:117-140 via the MC tag; mirrors
+// core/overlap.py::num_bases_extending_past_mate). mc_off/mc_len locate each
+// record's MC tag value (-1 = absent -> clip 0).
+void fgumi_mate_clips(const uint8_t* buf, const int64_t* cigar_off,
+                      const int32_t* n_cigar, const int32_t* flag,
+                      const int32_t* ref_id, const int32_t* pos,
+                      const int32_t* next_ref_id, const int32_t* next_pos,
+                      const int32_t* tlen, const int64_t* mc_off,
+                      const int32_t* mc_len, long n, int32_t* clip) {
+  for (long i = 0; i < n; ++i) {
+    clip[i] = 0;
+    const CigarView c{buf + cigar_off[i], n_cigar[i]};
+    if (!is_fr_pair(flag[i], ref_id[i], next_ref_id[i], pos[i], next_pos[i],
+                    tlen[i], c)) {
+      continue;
+    }
+    if (mc_off[i] < 0) continue;
+    int64_t lead = 0, rlen = 0, trail = 0;
+    if (!parse_mc_cigar(buf + mc_off[i], mc_len[i], &lead, &rlen, &trail)) {
+      continue;
+    }
+    const int64_t mate_pos = static_cast<int64_t>(next_pos[i]) + 1;
+    clip[i] = static_cast<int32_t>(bases_extending_past_mate(
+        c, flag[i], pos[i], mate_pos - lead, mate_pos - 1 + rlen + trail));
+  }
+}
+
+// In-place overlapping-pair base correction on the chunk buffer (mirrors
+// consensus/overlapping.py::OverlappingBasesConsensusCaller.call; reference
+// overlapping.rs:80-345). r1_off/r2_off are the paired records' data offsets
+// (post-block_size). agreement: 0=consensus 1=max-qual 2=pass-through;
+// disagreement: 0=consensus 1=mask-both 2=mask-lower-qual. stats (int64[4]):
+// overlapping, agreeing, disagreeing, corrected.
+void fgumi_overlap_correct_pairs(uint8_t* buf, const int64_t* r1_off,
+                                 const int64_t* r2_off, long n_pairs,
+                                 int agreement, int disagreement,
+                                 int64_t* stats) {
+  for (long p = 0; p < n_pairs; ++p) {
+    const uint8_t* d1 = buf + r1_off[p];
+    const uint8_t* d2 = buf + r2_off[p];
+    const int32_t flag1 = read_u16(d1 + 14), flag2 = read_u16(d2 + 14);
+    if ((flag1 | flag2) & kFlagUnmapped) continue;
+    if (read_i32(d1) != read_i32(d2)) continue;  // ref_id mismatch
+    const int32_t n_cig1 = read_u16(d1 + 12), n_cig2 = read_u16(d2 + 12);
+    const int32_t l_seq1 = read_i32(d1 + 16), l_seq2 = read_i32(d2 + 16);
+    const int64_t cig1_off = 32 + d1[8], cig2_off = 32 + d2[8];
+    const CigarView c1{d1 + cig1_off, n_cig1};
+    const CigarView c2{d2 + cig2_off, n_cig2};
+    if (cigar_ref_len(c1) == 0 || cigar_ref_len(c2) == 0) continue;
+    uint8_t* seq1 = buf + r1_off[p] + cig1_off + 4 * n_cig1;
+    uint8_t* seq2 = buf + r2_off[p] + cig2_off + 4 * n_cig2;
+    uint8_t* q1 = seq1 + (l_seq1 + 1) / 2;
+    uint8_t* q2 = seq2 + (l_seq2 + 1) / 2;
+
+    // Merge-walk the two reads' aligned (ref_pos, read_off) streams
+    // (ReadMateAndRefPosIterator, overlapping.rs:560-620).
+    int32_t i1 = 0, i2 = 0;            // cigar op indices
+    int64_t ref1 = read_i32(d1 + 4) + 1, ref2 = read_i32(d2 + 4) + 1;
+    int64_t off1 = 0, off2 = 0;        // read offsets
+    int64_t rem1 = 0, rem2 = 0;        // remaining bases in current align op
+
+    auto advance = [](const CigarView& c, int32_t& i, int64_t& ref_pos,
+                      int64_t& read_off, int64_t& rem) {
+      // position at the next aligned base; rem = bases left in this op
+      while (rem == 0 && i < c.n) {
+        const uint32_t op = c.op(i);
+        const int64_t len = c.len(i);
+        if (op_is_align(op)) {
+          rem = len;
+        } else if (op == 1 || op == 4) {  // I S
+          read_off += len;
+        } else if (op == 2 || op == 3) {  // D N
+          ref_pos += len;
+        }
+        ++i;
+      }
+      return rem > 0;
+    };
+
+    while (true) {
+      if (!advance(c1, i1, ref1, off1, rem1)) break;
+      if (!advance(c2, i2, ref2, off2, rem2)) break;
+      if (ref1 < ref2) {
+        const int64_t skip = ref2 - ref1 < rem1 ? ref2 - ref1 : rem1;
+        ref1 += skip; off1 += skip; rem1 -= skip;
+        continue;
+      }
+      if (ref2 < ref1) {
+        const int64_t skip = ref1 - ref2 < rem2 ? ref1 - ref2 : rem2;
+        ref2 += skip; off2 += skip; rem2 -= skip;
+        continue;
+      }
+      // ref1 == ref2: one overlapping aligned base
+      const int64_t o1 = off1, o2 = off2;
+      ref1 += 1; off1 += 1; rem1 -= 1;
+      ref2 += 1; off2 += 1; rem2 -= 1;
+      const uint8_t nib1 =
+          (o1 & 1) ? (seq1[o1 >> 1] & 0xF) : (seq1[o1 >> 1] >> 4);
+      const uint8_t nib2 =
+          (o2 & 1) ? (seq2[o2 >> 1] & 0xF) : (seq2[o2 >> 1] >> 4);
+      if (nib1 == 15 || nib2 == 15) continue;  // no-call skipped entirely
+      ++stats[0];
+      const int32_t qa = q1[o1], qb = q2[o2];
+      auto write_nib = [](uint8_t* seq, int64_t o, uint8_t nib) {
+        if (o & 1) {
+          seq[o >> 1] = (seq[o >> 1] & 0xF0) | nib;
+        } else {
+          seq[o >> 1] = (seq[o >> 1] & 0x0F) | (nib << 4);
+        }
+      };
+      if (nib1 == nib2) {
+        ++stats[1];
+        if (agreement == 2) continue;  // pass-through
+        const int32_t new_q =
+            agreement == 0 ? (qa + qb < 93 ? qa + qb : 93)
+                           : (qa > qb ? qa : qb);
+        if (new_q != qa || new_q != qb) ++stats[3];
+        q1[o1] = static_cast<uint8_t>(new_q);
+        q2[o2] = static_cast<uint8_t>(new_q);
+      } else {
+        ++stats[2];
+        if (disagreement == 0) {  // consensus: higher qual wins by difference
+          if (qa == qb) {
+            write_nib(seq1, o1, 15);
+            write_nib(seq2, o2, 15);
+            q1[o1] = 2;
+            q2[o2] = 2;
+          } else {
+            const uint8_t win_nib = qa > qb ? nib1 : nib2;
+            const int32_t dq = qa > qb ? qa - qb : qb - qa;
+            const uint8_t new_q = static_cast<uint8_t>(dq > 2 ? dq : 2);
+            write_nib(seq1, o1, win_nib);
+            write_nib(seq2, o2, win_nib);
+            q1[o1] = new_q;
+            q2[o2] = new_q;
+          }
+          stats[3] += 2;
+        } else if (disagreement == 1) {  // mask-both
+          write_nib(seq1, o1, 15);
+          write_nib(seq2, o2, 15);
+          q1[o1] = 2;
+          q2[o2] = 2;
+          stats[3] += 2;
+        } else {  // mask-lower-qual; tie masks both
+          if (qa <= qb) {
+            write_nib(seq1, o1, 15);
+            q1[o1] = 2;
+            ++stats[3];
+          }
+          if (qb <= qa) {
+            write_nib(seq2, o2, 15);
+            q2[o2] = 2;
+            ++stats[3];
+          }
+        }
+      }
+    }
+  }
 }
 
 }  // extern "C"

@@ -1,0 +1,144 @@
+"""Fixed-role threaded host pipeline.
+
+The simplified unified-pipeline analog SURVEY §7 step 9 calls for (reference:
+/root/reference/src/lib/unified_pipeline/base.rs:1123-1150 9-step pool;
+worker loop base.rs:4439-4600): fixed-role stages — reader (BGZF decompress +
+boundary scan, native), processor (decode/group/pack/device, main thread),
+writer (BGZF compress, native) — joined by bounded queues for backpressure.
+The native calls release the GIL, so stages genuinely overlap; the
+14-scheduler zoo is deliberately skipped (fixed roles saturate a device-fed
+pipeline).
+
+`threads <= 1` runs everything inline on the caller thread — the
+single-threaded fast path every command keeps as its semantic reference
+(reference bam.rs:3301, performance-tuning.md:28-40).
+"""
+
+import queue
+import threading
+import time
+
+
+class StageTimes:
+    """Per-stage busy/blocked wall time (PipelineStats-lite, base.rs:2853)."""
+
+    def __init__(self):
+        self.busy = {}
+        self.blocked = {}
+
+    def add_busy(self, stage: str, dt: float):
+        self.busy[stage] = self.busy.get(stage, 0.0) + dt
+
+    def add_blocked(self, stage: str, dt: float):
+        self.blocked[stage] = self.blocked.get(stage, 0.0) + dt
+
+    def format_table(self) -> str:
+        stages = sorted(set(self.busy) | set(self.blocked))
+        lines = ["stage        busy_s   blocked_s"]
+        for s in stages:
+            lines.append(f"{s:<12} {self.busy.get(s, 0.0):7.3f}   "
+                         f"{self.blocked.get(s, 0.0):7.3f}")
+        return "\n".join(lines)
+
+
+class _Err:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+_DONE = object()
+
+
+def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
+               queue_items: int = 4, stats: StageTimes = None):
+    """source -> process -> sink, optionally with reader/writer threads.
+
+    - source_iter: yields work items (e.g. RecordBatch)
+    - process_fn(item) -> iterable of outputs
+    - sink_fn(output)
+
+    threads <= 1: fully inline. threads >= 2: reader thread + writer thread
+    around the processing caller thread. Exceptions from any stage propagate
+    to the caller; the first exception wins and the pipeline drains.
+    """
+    if stats is None:
+        stats = StageTimes()
+    if threads <= 1:
+        t_last = time.monotonic()
+        for item in source_iter:
+            now = time.monotonic()
+            stats.add_busy("read", now - t_last)
+            for out in process_fn(item):
+                sink_fn(out)
+            t_last = time.monotonic()
+            stats.add_busy("process+write", t_last - now)
+        return stats
+
+    q_in = queue.Queue(maxsize=queue_items)
+    q_out = queue.Queue(maxsize=queue_items * 4)
+    writer_exc = []
+
+    def reader():
+        try:
+            t_last = time.monotonic()
+            for item in source_iter:
+                now = time.monotonic()
+                stats.add_busy("read", now - t_last)
+                q_in.put(item)
+                t_last = time.monotonic()
+                stats.add_blocked("read", t_last - now)
+            q_in.put(_DONE)
+        except BaseException as e:  # noqa: BLE001 - relayed to caller
+            q_in.put(_Err(e))
+
+    def writer():
+        try:
+            while True:
+                t0 = time.monotonic()
+                out = q_out.get()
+                now = time.monotonic()
+                stats.add_blocked("write", now - t0)
+                if out is _DONE:
+                    return
+                sink_fn(out)
+                stats.add_busy("write", time.monotonic() - now)
+        except BaseException as e:  # noqa: BLE001 - relayed to caller
+            writer_exc.append(e)
+            # drain so the processor never blocks on a dead writer
+            while q_out.get() is not _DONE:
+                pass
+
+    rt = threading.Thread(target=reader, name="fgumi-reader", daemon=True)
+    wt = threading.Thread(target=writer, name="fgumi-writer", daemon=True)
+    rt.start()
+    wt.start()
+    try:
+        while True:
+            t0 = time.monotonic()
+            item = q_in.get()
+            now = time.monotonic()
+            stats.add_blocked("process", now - t0)
+            if item is _DONE:
+                break
+            if isinstance(item, _Err):
+                raise item.exc
+            for out in process_fn(item):
+                q_out.put(out)
+            stats.add_busy("process", time.monotonic() - now)
+            if writer_exc:
+                raise writer_exc[0]
+    finally:
+        q_out.put(_DONE)
+        wt.join()
+        # unblock a reader stuck on a full input queue after an error
+        try:
+            while True:
+                q_in.get_nowait()
+        except queue.Empty:
+            pass
+        rt.join(timeout=1.0)
+    if writer_exc:
+        raise writer_exc[0]
+    return stats

@@ -1,0 +1,247 @@
+"""Parity: FastSimplexCaller (vectorized batch path) vs the slow path.
+
+The fast path must produce byte-identical consensus records, identical
+statistics, and identical rejection counts to the VanillaConsensusCaller flow
+used by cmd_simplex, across batch-boundary-spanning groups, downsampling,
+overlap correction, and non-uniform CIGARs.
+"""
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.consensus.fast import FastSimplexCaller
+from fgumi_tpu.consensus.overlapping import (OverlappingBasesConsensusCaller,
+                                             apply_overlapping_consensus)
+from fgumi_tpu.consensus.vanilla import VanillaConsensusCaller, VanillaOptions
+from fgumi_tpu.core.grouper import consensus_pregroup_keep, iter_mi_group_batches
+from fgumi_tpu.io.bam import BamReader, BamWriter, BamHeader, RecordBuilder
+from fgumi_tpu.io.batch_reader import BamBatchReader
+from fgumi_tpu.native import batch as nb
+from fgumi_tpu.simulate import simulate_grouped_bam
+
+pytestmark = pytest.mark.skipif(not nb.available(),
+                                reason="native library unavailable")
+
+
+def run_slow(path, opts, overlap=False, allow_unmapped=False):
+    """The cmd_simplex flow (cli.py:112-136) without the writer."""
+    caller = VanillaConsensusCaller("fgumi", "A", opts)
+    oc = OverlappingBasesConsensusCaller() if overlap else None
+    out = []
+    with BamReader(path) as reader:
+        pregroup = lambda r: consensus_pregroup_keep(r.flag, allow_unmapped)
+        for batch in iter_mi_group_batches(reader, 50, record_filter=pregroup):
+            if oc is not None:
+                batch = [(umi, apply_overlapping_consensus(recs, oc))
+                         for umi, recs in batch]
+            out.extend(caller.call_groups(batch))
+    return out, caller, oc
+
+
+def split_chunks(chunks):
+    """Wire chunks (block_size-prefixed record runs) -> per-record bytes."""
+    recs = []
+    for blob in chunks:
+        off = 0
+        while off < len(blob):
+            n = int.from_bytes(blob[off:off + 4], "little")
+            recs.append(blob[off + 4:off + 4 + n])
+            off += 4 + n
+        assert off == len(blob), "misaligned wire chunk"
+    return recs
+
+
+def run_fast(path, opts, overlap=False, allow_unmapped=False,
+             target_bytes=4096):
+    """Fast path with tiny batches to force boundary-spanning groups."""
+    caller = VanillaConsensusCaller("fgumi", "A", opts)
+    oc = OverlappingBasesConsensusCaller() if overlap else None
+    fast = FastSimplexCaller(caller, b"MI", overlap_caller=oc)
+    chunks = []
+    with BamBatchReader(path, target_bytes=target_bytes) as reader:
+        for batch in reader:
+            chunks.extend(fast.process_batch(batch, allow_unmapped))
+    chunks.extend(fast.flush())
+    return split_chunks(chunks), caller, oc
+
+
+def assert_parity(path, opts, overlap=False, allow_unmapped=False,
+                  target_bytes=4096):
+    slow_out, slow_caller, slow_oc = run_slow(path, opts, overlap,
+                                              allow_unmapped)
+    fast_out, fast_caller, fast_oc = run_fast(path, opts, overlap,
+                                              allow_unmapped, target_bytes)
+    assert len(fast_out) == len(slow_out)
+    for i, (f, s) in enumerate(zip(fast_out, slow_out)):
+        assert f == s, f"consensus record {i} differs"
+    assert fast_caller.stats.input_reads == slow_caller.stats.input_reads
+    assert fast_caller.stats.consensus_reads == slow_caller.stats.consensus_reads
+    assert fast_caller.stats.rejected == slow_caller.stats.rejected
+    if overlap:
+        assert fast_oc.stats.overlapping_bases == slow_oc.stats.overlapping_bases
+        assert fast_oc.stats.bases_agreeing == slow_oc.stats.bases_agreeing
+        assert fast_oc.stats.bases_disagreeing == slow_oc.stats.bases_disagreeing
+        assert fast_oc.stats.bases_corrected == slow_oc.stats.bases_corrected
+    return slow_out
+
+
+@pytest.fixture(scope="module")
+def grouped_bam(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("fs") / "grouped.bam")
+    simulate_grouped_bam(path, num_families=80, family_size=5,
+                         family_size_distribution="lognormal", read_length=90,
+                         error_rate=0.02, seed=17)
+    return path
+
+
+@pytest.mark.parametrize("min_reads", [1, 2])
+def test_parity_simulated(grouped_bam, min_reads):
+    out = assert_parity(grouped_bam, VanillaOptions(min_reads=min_reads))
+    assert len(out) > 50
+
+
+def test_parity_with_overlap_correction(grouped_bam):
+    assert_parity(grouped_bam, VanillaOptions(min_reads=1), overlap=True)
+
+
+def test_parity_with_downsampling(grouped_bam):
+    assert_parity(grouped_bam, VanillaOptions(min_reads=1, max_reads=3))
+
+
+def test_parity_large_batches(grouped_bam):
+    """No boundary-spanning groups at all (single batch)."""
+    assert_parity(grouped_bam, VanillaOptions(min_reads=1),
+                  target_bytes=64 << 20)
+
+
+@pytest.fixture(scope="module")
+def adversarial_bam(tmp_path_factory):
+    """Groups exercising: mixed strands, non-uniform and non-palindromic
+    CIGARs (alignment filter), overlapping FR pairs with MC tags (mate
+    clips + overlap correction), low quals (masking), unmapped fragments."""
+    path = str(tmp_path_factory.mktemp("fs") / "adv.bam")
+    rng = np.random.default_rng(23)
+    header = BamHeader(
+        text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n@SQ\tSN:chr1\tLN:100000\n"
+             "@RG\tID:A\n",
+        ref_names=["chr1"], ref_lengths=[100000])
+
+    def seq(n):
+        return rng.choice(np.frombuffer(b"ACGTN", np.uint8), size=n,
+                          p=[0.24, 0.24, 0.24, 0.24, 0.04]).tobytes()
+
+    def quals(n, lo=2, hi=41):
+        return rng.integers(lo, hi, size=n).astype(np.uint8)
+
+    records = []
+    mi = 0
+
+    def add_family(recs):
+        nonlocal mi
+        for b in recs:
+            b.tag_str(b"MI", str(mi).encode())
+            b.tag_str(b"RX", b"ACGTACGT")
+            records.append(b.finish())
+        mi += 1
+
+    # family 1: mixed strands, same palindromic cigar (fast uniform path)
+    fam = []
+    for r in range(4):
+        flag = 0x10 if r % 2 else 0
+        fam.append(RecordBuilder().start_mapped(
+            b"f1r%d" % r, flag, 0, 1000, 60, [("M", 80)], seq(80), quals(80)))
+    add_family(fam)
+
+    # family 2: mixed strands, NON-palindromic cigar (filter must engage)
+    fam = []
+    for r in range(4):
+        flag = 0x10 if r >= 2 else 0
+        fam.append(RecordBuilder().start_mapped(
+            b"f2r%d" % r, flag, 0, 2000, 60,
+            [("M", 30), ("D", 2), ("M", 50)], seq(80), quals(80)))
+    add_family(fam)
+
+    # family 3: non-uniform cigars (minority alignment rejection)
+    fam = []
+    for r in range(5):
+        cig = [("M", 80)] if r < 3 else [("M", 40), ("I", 2), ("M", 38)]
+        fam.append(RecordBuilder().start_mapped(
+            b"f3r%d" % r, 0, 0, 3000, 60, cig, seq(80), quals(80)))
+    add_family(fam)
+
+    # family 4: overlapping FR pairs with MC tags (clips + correction)
+    fam = []
+    for t in range(3):
+        name = b"f4t%d" % t
+        p1, insert = 4000, 60  # 80bp reads, 60bp insert: dovetail overlap
+        p2 = p1 + insert - 80
+        b1 = RecordBuilder().start_mapped(
+            name, 0x1 | 0x2 | 0x20 | 0x40, 0, p1, 60, [("M", 80)], seq(80),
+            quals(80), next_ref_id=0, next_pos=p2, tlen=insert)
+        b1.tag_str(b"MC", b"80M")
+        b2 = RecordBuilder().start_mapped(
+            name, 0x1 | 0x2 | 0x10 | 0x80, 0, p2, 60, [("M", 80)], seq(80),
+            quals(80), next_ref_id=0, next_pos=p1, tlen=-insert)
+        b2.tag_str(b"MC", b"80M")
+        fam.extend([b1, b2])
+    add_family(fam)
+
+    # family 5: very low quals (mask everything -> zero-length rejects)
+    fam = []
+    for r in range(3):
+        fam.append(RecordBuilder().start_mapped(
+            b"f5r%d" % r, 0, 0, 5000, 60, [("M", 40)], seq(40),
+            quals(40, lo=2, hi=9)))
+    add_family(fam)
+
+    # family 6: unmapped fragments (pregroup filter drops unless allowed)
+    fam = []
+    for r in range(3):
+        fam.append(RecordBuilder().start_unmapped(
+            b"f6r%d" % r, 0x4, seq(50), quals(50)))
+    add_family(fam)
+
+    # family 7: single read (host single-read path)
+    add_family([RecordBuilder().start_mapped(
+        b"f7r0", 0, 0, 7000, 60, [("M", 60)], seq(60), quals(60))])
+
+    # family 8: secondary/supplementary mixed in (pre-group filtered)
+    fam = []
+    for r in range(4):
+        flag = 0x100 if r == 1 else (0x800 if r == 2 else 0)
+        fam.append(RecordBuilder().start_mapped(
+            b"f8r%d" % r, flag, 0, 8000, 60, [("M", 70)], seq(70), quals(70)))
+    add_family(fam)
+
+    # family 9: all-0xFF quals read among normal ones
+    fam = [RecordBuilder().start_mapped(
+        b"f9r0", 0, 0, 9000, 60, [("M", 50)], seq(50),
+        np.full(50, 0xFF, np.uint8))]
+    for r in range(2):
+        fam.append(RecordBuilder().start_mapped(
+            b"f9r%d" % (r + 1), 0, 0, 9000, 60, [("M", 50)], seq(50),
+            quals(50)))
+    add_family(fam)
+
+    with BamWriter(path, header) as w:
+        for rec in records:
+            w.write_record_bytes(rec)
+    return path
+
+
+@pytest.mark.parametrize("overlap", [False, True])
+@pytest.mark.parametrize("allow_unmapped", [False, True])
+def test_parity_adversarial(adversarial_bam, overlap, allow_unmapped):
+    assert_parity(adversarial_bam, VanillaOptions(min_reads=1),
+                  overlap=overlap, allow_unmapped=allow_unmapped,
+                  target_bytes=2048)
+
+
+def test_parity_adversarial_min_reads2(adversarial_bam):
+    assert_parity(adversarial_bam, VanillaOptions(min_reads=2),
+                  target_bytes=2048)
+
+
+def test_parity_trim_falls_back(grouped_bam):
+    """trim=True routes whole groups through the slow path; still identical."""
+    assert_parity(grouped_bam, VanillaOptions(min_reads=1, trim=True))

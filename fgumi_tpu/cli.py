@@ -62,7 +62,18 @@ def _add_simplex(sub):
     p.add_argument("--ref", default=None,
                    help="reference FASTA (required for --em-seq/--taps)")
     p.add_argument("--batch-groups", type=int, default=2000,
-                   help="MI groups per device batch")
+                   help="MI groups per device batch (classic engine)")
+    p.add_argument("--batch-bytes", type=int, default=16 << 20,
+                   help="decompressed bytes per record batch (fast engine)")
+    p.add_argument("--threads", type=int, default=0,
+                   help=">=2 adds reader/writer threads around the "
+                        "processing thread (pipeline.run_stages); 0/1 runs "
+                        "inline (single-threaded fast path)")
+    p.add_argument("--stats", action="store_true",
+                   help="print per-stage busy/blocked timing table")
+    p.add_argument("--classic", action="store_true",
+                   help="force the per-record Python engine (the semantic "
+                        "reference for the vectorized fast engine)")
     p.set_defaults(func=cmd_simplex)
 
 
@@ -109,34 +120,68 @@ def cmd_simplex(args):
             log.error("cannot read reference %s: %s", args.ref, e)
             return 2
 
+    from .native import batch as nb
+
+    use_fast = nb.available() and not args.classic
+    oc_caller = None
+    if args.consensus_call_overlapping_bases:
+        from .consensus.overlapping import OverlappingBasesConsensusCaller
+
+        oc_caller = OverlappingBasesConsensusCaller("consensus", "consensus")
+    out_header = _unmapped_consensus_header(args.read_group_id)
+
     t0 = time.monotonic()
-    with BamReader(args.input) as reader:
-        caller = VanillaConsensusCaller(args.read_name_prefix,
-                                        args.read_group_id, opts,
-                                        reference=reference,
-                                        ref_names=reader.header.ref_names)
-        out_header = _unmapped_consensus_header(args.read_group_id)
-        oc_caller = None
-        if args.consensus_call_overlapping_bases:
-            from .consensus.overlapping import (OverlappingBasesConsensusCaller,
-                                                apply_overlapping_consensus)
-            oc_caller = OverlappingBasesConsensusCaller("consensus", "consensus")
-        with BamWriter(args.output, out_header) as writer:
-            n_out = 0
+    if use_fast:
+        from .consensus.fast import FastSimplexCaller
+        from .io.batch_reader import BamBatchReader
+        from .pipeline import StageTimes, run_stages
+
+        stats = StageTimes()
+        with BamBatchReader(args.input, target_bytes=args.batch_bytes) as reader:
+            caller = VanillaConsensusCaller(args.read_name_prefix,
+                                            args.read_group_id, opts,
+                                            reference=reference,
+                                            ref_names=reader.header.ref_names)
+            fast = FastSimplexCaller(caller, args.tag.encode(),
+                                     overlap_caller=oc_caller)
             allow_unmapped = args.allow_unmapped
-            pregroup = lambda r: consensus_pregroup_keep(r.flag, allow_unmapped)
-            for batch in iter_mi_group_batches(reader, args.batch_groups,
-                                               tag=args.tag.encode(),
-                                               record_filter=pregroup):
-                if oc_caller is not None:
-                    batch = [(umi, apply_overlapping_consensus(recs, oc_caller))
-                             for umi, recs in batch]
-                for rec_bytes in caller.call_groups(batch):
-                    writer.write_record_bytes(rec_bytes)
-                    n_out += 1
+            with BamWriter(args.output, out_header) as writer:
+                run_stages(
+                    iter(reader),
+                    lambda batch: fast.process_batch(batch, allow_unmapped),
+                    writer.write_serialized, threads=args.threads, stats=stats)
+                for blob in fast.flush():
+                    writer.write_serialized(blob)
+        n_out = caller.stats.consensus_reads
+        if args.stats:
+            print(stats.format_table())
+    else:
+        from .consensus.overlapping import apply_overlapping_consensus
+
+        with BamReader(args.input) as reader:
+            caller = VanillaConsensusCaller(args.read_name_prefix,
+                                            args.read_group_id, opts,
+                                            reference=reference,
+                                            ref_names=reader.header.ref_names)
+            with BamWriter(args.output, out_header) as writer:
+                n_out = 0
+                allow_unmapped = args.allow_unmapped
+                pregroup = lambda r: consensus_pregroup_keep(r.flag,
+                                                             allow_unmapped)
+                for batch in iter_mi_group_batches(reader, args.batch_groups,
+                                                   tag=args.tag.encode(),
+                                                   record_filter=pregroup):
+                    if oc_caller is not None:
+                        batch = [(umi,
+                                  apply_overlapping_consensus(recs, oc_caller))
+                                 for umi, recs in batch]
+                    for rec_bytes in caller.call_groups(batch):
+                        writer.write_record_bytes(rec_bytes)
+                        n_out += 1
     dt = time.monotonic() - t0
     s = caller.stats
-    log.info("simplex: %d input reads -> %d consensus reads in %.2fs (%.0f reads/s)",
+    log.info("simplex[%s]: %d input reads -> %d consensus reads in %.2fs "
+             "(%.0f reads/s)", "fast" if use_fast else "classic",
              s.input_reads, n_out, dt, s.input_reads / dt if dt else 0)
     if oc_caller is not None and oc_caller.stats.overlapping_bases:
         ocs = oc_caller.stats

@@ -132,7 +132,7 @@ def cmd_simplex(args):
 
     t0 = time.monotonic()
     if use_fast:
-        from .consensus.fast import FastSimplexCaller
+        from .consensus.fast import FastSimplexCaller, resolve_chunk
         from .io.batch_reader import BamBatchReader
         from .pipeline import StageTimes, run_stages
 
@@ -146,12 +146,15 @@ def cmd_simplex(args):
                                      overlap_caller=oc_caller)
             allow_unmapped = args.allow_unmapped
             with BamWriter(args.output, out_header) as writer:
+                # device fetch + serialize resolve on the sink stage, so with
+                # --threads they overlap the next batch's host prep
                 run_stages(
                     iter(reader),
                     lambda batch: fast.process_batch(batch, allow_unmapped),
-                    writer.write_serialized, threads=args.threads, stats=stats)
+                    lambda chunk: writer.write_serialized(resolve_chunk(chunk)),
+                    threads=args.threads, stats=stats)
                 for blob in fast.flush():
-                    writer.write_serialized(blob)
+                    writer.write_serialized(resolve_chunk(blob))
         n_out = caller.stats.consensus_reads
         if args.stats:
             print(stats.format_table())

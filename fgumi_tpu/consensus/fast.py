@@ -13,7 +13,6 @@ tests/test_fast_simplex.py). Families the vectorized path cannot express
 most-common-alignment filter) fall back to the slow path per group.
 """
 
-import jax
 import numpy as np
 
 from ..core import cigar as cigar_utils
@@ -27,6 +26,55 @@ from .vanilla import (FRAGMENT, R1, R2, _TYPE_FLAGS, VanillaConsensusCaller)
 
 _AGREEMENT_CODES = {"consensus": 0, "max-qual": 1, "pass-through": 2}
 _DISAGREEMENT_CODES = {"consensus": 0, "mask-both": 1, "mask-lower-qual": 2}
+
+# Families per device dispatch are chunked to at most _F_CAP and padded to the
+# next pow2: tight padding minimizes device->host result bytes (the scarce
+# direction), while the persistent XLA compile cache (ops/kernel.py) makes the
+# pow2 shape vocabulary a once-per-machine compile cost. Sentinel families
+# (-1 gather rows) are all-N no-calls whose results are simply not read.
+_F_CAP = 4096
+
+
+def resolve_chunk(chunk) -> bytes:
+    """Wire bytes of a process_batch output item (resolving deferred device
+    work — the fetch+serialize half of a batch runs here, typically on the
+    writer stage so transfers overlap the next batch's host prep)."""
+    return chunk if isinstance(chunk, bytes) else chunk.resolve()
+
+
+class _PendingChunk:
+    """Deferred half of a batch: fetch packed device results, recompute
+    depth/errors on host, apply thresholds, serialize (SURVEY §7 step 4
+    double-buffering: dispatch happens in process_batch, this completes it)."""
+
+    __slots__ = ("fast", "batch", "jobs", "pending")
+
+    def __init__(self, fast, batch, jobs, pending):
+        self.fast = fast
+        self.batch = batch
+        self.jobs = jobs
+        self.pending = pending
+
+    def resolve(self) -> bytes:
+        fast = self.fast
+        caller = fast.caller
+        opts = caller.options
+        kernel = caller.kernel
+        for idxs, call_codes, call_quals, dev in self.pending:
+            winner, qual, depth, errors = kernel.resolve_packed(
+                dev, call_codes, call_quals)
+            # thresholds are elementwise: one vectorized pass per dispatch
+            bases_b, quals_b = oracle.apply_consensus_thresholds(
+                winner, qual, depth, opts.min_reads,
+                opts.min_consensus_base_quality)
+            depth32 = depth.astype(np.int32)
+            errors32 = errors.astype(np.int32)
+            for fi, j in enumerate(idxs):
+                job = self.jobs[j]
+                L = job.consensus_len
+                job.result = (bases_b[fi, :L], quals_b[fi, :L],
+                              depth32[fi, :L], errors32[fi, :L])
+        return fast._serialize_jobs(self.batch, self.jobs)
 
 
 class _FastJob:
@@ -252,8 +300,8 @@ class FastSimplexCaller:
 
         if not jobs:
             return []
-        self._run_jobs_async(codes, quals, jobs)
-        return [self._serialize_jobs(batch, jobs)]
+        pending = self._dispatch_jobs(codes, quals, jobs)
+        return [_PendingChunk(self, batch, jobs, pending)]
 
     def _prepare_group_fast(self, batch, span, s, e, rtype, final_len, jobs,
                             group_uniform=False):
@@ -373,13 +421,14 @@ class FastSimplexCaller:
 
     # ------------------------------------------------------------------ device
 
-    def _run_jobs_async(self, codes, quals, jobs):
-        """Bucketed kernel dispatch with deferred device_get.
+    def _dispatch_jobs(self, codes, quals, jobs):
+        """Bucketed async kernel dispatch; returns the pending fetch list.
 
         Single-read jobs run vectorized on host (table lookup); multi-read
-        jobs gather rows into pow2-padded buckets and dispatch asynchronously,
-        fetching results at the batch horizon so host prep overlaps device
-        compute (SURVEY §7 step 4).
+        jobs gather rows into pow2-padded (Rb, Lb) buckets, chunked to at
+        most _F_CAP families per dispatch, and launch asynchronously. The
+        fetch + threshold + serialize half runs in _PendingChunk.resolve()
+        (SURVEY §7 step 4: host prep overlaps device compute and transfer).
         """
         caller = self.caller
         opts = caller.options
@@ -393,7 +442,9 @@ class FastSimplexCaller:
                 singles.append(j)
                 continue
             Rb = 1 << (R - 1).bit_length()
-            Lb = -(-job.consensus_len // 32) * 32
+            # 16-multiple L: tighter than the pack stride's 32 (less result
+            # traffic); stride is a 32-multiple >= max len, so Lb <= stride
+            Lb = -(-job.consensus_len // 16) * 16
             buckets.setdefault((Rb, Lb), []).append(j)
 
         # single-read host fast path, vectorized over all single jobs
@@ -408,7 +459,7 @@ class FastSimplexCaller:
                 job.result = (b, q, d.astype(np.int32), e.astype(np.int32))
 
         if not buckets:
-            return
+            return []
         # one extended copy of the packed rows; row -1 = all-N sentinel
         stride = codes.shape[1]
         codes_ext = np.concatenate(
@@ -417,43 +468,21 @@ class FastSimplexCaller:
             [quals, np.zeros((1, stride), dtype=np.uint8)])
 
         pending = []
-        for (Rb, Lb), idxs in buckets.items():
-            F = 1 << (len(idxs) - 1).bit_length()
-            # gather: row index matrix (F, Rb) with -1 -> all-N sentinel row
-            gather = np.full((F, Rb), -1, dtype=np.int64)
-            for fi, j in enumerate(idxs):
-                rows = jobs[j].rows
-                gather[fi, :len(rows)] = rows
-            # stride is a multiple of 32 >= every consensus_len, so Lb <= stride
-            call_codes = codes_ext[gather][:, :, :Lb]
-            call_quals = quals_ext[gather][:, :, :Lb]
-            dev = kernel.device_call(call_codes, call_quals)
-            pending.append(((Rb, Lb), idxs, call_codes, call_quals, dev))
-
-        # batch horizon: fetch all device results, then host-fix suspects
-        for (Rb, Lb), idxs, call_codes, call_quals, dev in pending:
-            winner, qual, depth, errors, suspect = jax.device_get(dev)
-            winner = winner.astype(np.uint8)
-            qual = qual.astype(np.uint8)
-            depth = depth.astype(np.int64)
-            errors = errors.astype(np.int64)
-            kernel.total_positions += suspect.size
-            n_suspect = int(suspect.sum())
-            if n_suspect:
-                kernel.fallback_positions += n_suspect
-                kernel._host_fallback(call_codes, call_quals, winner, qual,
-                                      depth, errors, suspect)
-            # thresholds are elementwise: one vectorized pass per bucket
-            bases_b, quals_b = oracle.apply_consensus_thresholds(
-                winner, qual, depth, opts.min_reads,
-                opts.min_consensus_base_quality)
-            depth32 = depth.astype(np.int32)
-            errors32 = errors.astype(np.int32)
-            for fi, j in enumerate(idxs):
-                job = jobs[j]
-                L = job.consensus_len
-                job.result = (bases_b[fi, :L], quals_b[fi, :L],
-                              depth32[fi, :L], errors32[fi, :L])
+        for (Rb, Lb), all_idxs in buckets.items():
+            for c0 in range(0, len(all_idxs), _F_CAP):
+                idxs = all_idxs[c0:c0 + _F_CAP]
+                F = 1 << (len(idxs) - 1).bit_length()
+                # gather: row index matrix (F, Rb); -1 -> all-N sentinel row
+                gather = np.full((F, Rb), -1, dtype=np.int64)
+                for fi, j in enumerate(idxs):
+                    rows = jobs[j].rows
+                    gather[fi, :len(rows)] = rows
+                # stride is a 32-multiple >= every consensus_len, so Lb <= stride
+                call_codes = codes_ext[gather][:, :, :Lb]
+                call_quals = quals_ext[gather][:, :, :Lb]
+                dev = kernel.device_call_packed(call_codes, call_quals)
+                pending.append((idxs, call_codes, call_quals, dev))
+        return pending
 
     # ------------------------------------------------------------------ output
 
@@ -512,7 +541,7 @@ class FastSimplexCaller:
             caller.prefix.encode(), mi_blob, mi_off, mi_len, rx_blob, rx_off,
             rx_len, caller.read_group_id.encode(),
             opts.produce_per_base_tags)
-        caller.stats.consensus_reads += J
+        caller.stats.add_consensus_reads(J)
         del keep_alive
         return blob
 

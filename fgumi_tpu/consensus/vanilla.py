@@ -14,6 +14,7 @@ deterministic-per-seed but not byte-identical to fgbio (vanilla_caller.rs:829-83
 this build makes the same promise with its own pinned stream.
 """
 
+import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -62,11 +63,21 @@ class VanillaOptions:
 
 @dataclass
 class CallerStats:
-    """Aggregate statistics (ConsensusCallingStats analog)."""
+    """Aggregate statistics (ConsensusCallingStats analog).
+
+    `add_consensus_reads` takes the lock because that counter is bumped from
+    whichever thread resolves a deferred batch (the pipeline's writer stage)
+    while input_reads/rejected stay on the processing thread.
+    """
 
     input_reads: int = 0
     consensus_reads: int = 0
     rejected: dict = field(default_factory=dict)
+    lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+
+    def add_consensus_reads(self, count: int):
+        with self.lock:
+            self.consensus_reads += count
 
     def reject(self, reason: str, count: int):
         self.rejected[reason] = self.rejected.get(reason, 0) + count
@@ -479,7 +490,7 @@ class VanillaConsensusCaller:
                 b.tag_array_u8(b"ML", np.frombuffer(ml, dtype=np.uint8))
             b.tag_array_i16(b"cu", annotation.cu())
             b.tag_array_i16(b"ct", annotation.ct())
-        self.stats.consensus_reads += 1
+        self.stats.add_consensus_reads(1)
         return b.finish()
 
     def call_groups(self, groups) -> list:

@@ -77,7 +77,9 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
         return stats
 
     q_in = queue.Queue(maxsize=queue_items)
-    q_out = queue.Queue(maxsize=queue_items * 4)
+    # the sink queue may carry deferred work holding whole padded batches
+    # (consensus _PendingChunk), so its depth bounds in-flight memory too
+    q_out = queue.Queue(maxsize=queue_items * 2)
     writer_exc = []
 
     def reader():

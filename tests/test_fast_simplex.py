@@ -40,8 +40,10 @@ def run_slow(path, opts, overlap=False, allow_unmapped=False):
 
 def split_chunks(chunks):
     """Wire chunks (block_size-prefixed record runs) -> per-record bytes."""
+    from fgumi_tpu.consensus.fast import resolve_chunk
+
     recs = []
-    for blob in chunks:
+    for blob in map(resolve_chunk, chunks):
         off = 0
         while off < len(blob):
             n = int.from_bytes(blob[off:off + 4], "little")

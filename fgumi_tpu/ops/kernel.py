@@ -49,8 +49,9 @@ def _enable_persistent_compile_cache():
     never mutates global jax config. Opt out with FGUMI_TPU_NO_XLA_CACHE=1;
     an explicit JAX_COMPILATION_CACHE_DIR is left entirely alone."""
     global _cache_enabled
-    if _cache_enabled or os.environ.get("FGUMI_TPU_NO_XLA_CACHE") \
-            or os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    opt_out = os.environ.get("FGUMI_TPU_NO_XLA_CACHE", "").lower() \
+        not in ("", "0", "false")
+    if _cache_enabled or opt_out or os.environ.get("JAX_COMPILATION_CACHE_DIR"):
         _cache_enabled = True
         return
     try:

@@ -27,14 +27,6 @@ from .vanilla import (FRAGMENT, R1, R2, _TYPE_FLAGS, VanillaConsensusCaller)
 _AGREEMENT_CODES = {"consensus": 0, "max-qual": 1, "pass-through": 2}
 _DISAGREEMENT_CODES = {"consensus": 0, "mask-both": 1, "mask-lower-qual": 2}
 
-# Families per device dispatch are chunked to at most _F_CAP and padded to the
-# next pow2: tight padding minimizes device->host result bytes (the scarce
-# direction), while the persistent XLA compile cache (ops/kernel.py) makes the
-# pow2 shape vocabulary a once-per-machine compile cost. Sentinel families
-# (-1 gather rows) are all-N no-calls whose results are simply not read.
-_F_CAP = 4096
-
-
 def resolve_chunk(chunk) -> bytes:
     """Wire bytes of a process_batch output item (resolving deferred device
     work — the fetch+serialize half of a batch runs here, typically on the
@@ -60,9 +52,10 @@ class _PendingChunk:
         caller = fast.caller
         opts = caller.options
         kernel = caller.kernel
-        for idxs, call_codes, call_quals, dev in self.pending:
-            winner, qual, depth, errors = kernel.resolve_packed(
-                dev, call_codes, call_quals)
+        if self.pending is not None:
+            idxs, starts, codes_d, quals_d, dev = self.pending
+            winner, qual, depth, errors = kernel.resolve_segments(
+                dev, codes_d, quals_d, starts)
             # thresholds are elementwise: one vectorized pass per dispatch
             bases_b, quals_b = oracle.apply_consensus_thresholds(
                 winner, qual, depth, opts.min_reads,
@@ -422,67 +415,64 @@ class FastSimplexCaller:
     # ------------------------------------------------------------------ device
 
     def _dispatch_jobs(self, codes, quals, jobs):
-        """Bucketed async kernel dispatch; returns the pending fetch list.
+        """One dense segment-sum kernel dispatch for the whole batch.
 
         Single-read jobs run vectorized on host (table lookup); multi-read
-        jobs gather rows into pow2-padded (Rb, Lb) buckets, chunked to at
-        most _F_CAP families per dispatch, and launch asynchronously. The
+        jobs concatenate their packed read rows into a dense (N, L) layout
+        with sorted segment ids — one device execution and one uint16 fetch
+        per record batch, independent of family-size mix (per-execution relay
+        overhead dominates the compute on the tunnel-attached device). The
         fetch + threshold + serialize half runs in _PendingChunk.resolve()
         (SURVEY §7 step 4: host prep overlaps device compute and transfer).
+        Returns the pending tuple or None.
         """
         caller = self.caller
         opts = caller.options
         kernel = caller.kernel
 
-        buckets = {}
-        singles = []
+        multi = []
         for j, job in enumerate(jobs):
-            R = len(job.rows)
-            if R == 1:
-                singles.append(j)
-                continue
-            Rb = 1 << (R - 1).bit_length()
-            # 16-multiple L: tighter than the pack stride's 32 (less result
-            # traffic); stride is a 32-multiple >= max len, so Lb <= stride
-            Lb = -(-job.consensus_len // 16) * 16
-            buckets.setdefault((Rb, Lb), []).append(j)
-
-        # single-read host fast path, vectorized over all single jobs
-        if singles:
-            for j in singles:
-                job = jobs[j]
+            if len(job.rows) == 1:
                 row = job.rows[0]
                 L = job.consensus_len
                 b, q, d, e = oracle.single_read_consensus(
                     codes[row, :L], quals[row, :L], caller.tables,
                     opts.min_consensus_base_quality)
                 job.result = (b, q, d.astype(np.int32), e.astype(np.int32))
+            else:
+                multi.append(j)
+        if not multi:
+            return None
 
-        if not buckets:
-            return []
-        # one extended copy of the packed rows; row -1 = all-N sentinel
-        stride = codes.shape[1]
-        codes_ext = np.concatenate(
-            [codes, np.full((1, stride), 4, dtype=np.uint8)])
-        quals_ext = np.concatenate(
-            [quals, np.zeros((1, stride), dtype=np.uint8)])
+        counts = np.array([len(jobs[j].rows) for j in multi], dtype=np.int64)
+        starts = np.concatenate(([0], np.cumsum(counts)))
+        rows_all = np.concatenate([jobs[j].rows for j in multi])
+        # 16-multiple L >= every job's consensus length (<= the pack stride)
+        L_max = -(-max(jobs[j].consensus_len for j in multi) // 16) * 16
+        codes_d = np.ascontiguousarray(codes[rows_all, :L_max])
+        quals_d = np.ascontiguousarray(quals[rows_all, :L_max])
+        seg_ids = np.repeat(np.arange(len(multi), dtype=np.int32), counts)
 
-        pending = []
-        for (Rb, Lb), all_idxs in buckets.items():
-            for c0 in range(0, len(all_idxs), _F_CAP):
-                idxs = all_idxs[c0:c0 + _F_CAP]
-                F = 1 << (len(idxs) - 1).bit_length()
-                # gather: row index matrix (F, Rb); -1 -> all-N sentinel row
-                gather = np.full((F, Rb), -1, dtype=np.int64)
-                for fi, j in enumerate(idxs):
-                    rows = jobs[j].rows
-                    gather[fi, :len(rows)] = rows
-                # stride is a 32-multiple >= every consensus_len, so Lb <= stride
-                call_codes = codes_ext[gather][:, :, :Lb]
-                call_quals = quals_ext[gather][:, :, :Lb]
-                dev = kernel.device_call_packed(call_codes, call_quals)
-                pending.append((idxs, call_codes, call_quals, dev))
-        return pending
+        # pow2 pads bound the XLA shape vocabulary (persistent compile cache
+        # makes each shape a once-per-machine cost); pad rows are all-N
+        # no-ops assigned to the last pad segment, pad segments are never read
+        N = len(rows_all)
+        N_pad = 1 << (N - 1).bit_length()
+        J = len(multi)
+        F_pad = 1 << (J - 1).bit_length() if J > 1 else 1
+        if N_pad != N:
+            pad = np.full((N_pad - N, L_max), 4, dtype=np.uint8)
+            codes_dev = np.concatenate([codes_d, pad])
+            quals_dev = np.concatenate(
+                [quals_d, np.zeros((N_pad - N, L_max), dtype=np.uint8)])
+            # all-N pad rows contribute zero wherever they land; the last real
+            # segment's id keeps seg_ids sorted without growing F_pad
+            seg_ids = np.concatenate(
+                [seg_ids, np.full(N_pad - N, J - 1, dtype=np.int32)])
+        else:
+            codes_dev, quals_dev = codes_d, quals_d
+        dev = kernel.device_call_segments(codes_dev, quals_dev, seg_ids, F_pad)
+        return (multi, starts, codes_d, quals_d, dev)
 
     # ------------------------------------------------------------------ output
 

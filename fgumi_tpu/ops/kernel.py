@@ -77,21 +77,39 @@ _QUAL_GUARD_FLOOR = 3e-4  # minimum guard band in Phred units (< the 1e-3 precis
 _TIE_GUARD_FLOOR = 1e-5  # minimum winner-margin guard in ln units
 
 
-def _reduce_contributions(codes, quals, correct_tab, err_tab):
-    """Per-position match-contribution + observation-count reduction over reads.
+def _observation_terms(codes, quals, correct_tab, err_tab):
+    """Per-observation lane one-hot + match-contribution delta.
 
-    codes/quals: (..., R, L). Returns C (..., L, 4) f32 (lane match contributions),
-    obs (..., L, 4) int32. N/pad codes contribute nothing (base_builder.rs:616-619).
+    codes/quals: any shape. Returns one_hot (..., 4) f32 (zeroed at N/pad
+    observations) and delta (...,) f32 — the shared per-observation math of
+    both the uniform-R and ragged-segment reductions.
     """
     q_idx = jnp.minimum(quals, MAX_PHRED).astype(jnp.int32)
     delta_tab = correct_tab - err_tab  # (94,) f32, >= 0 for sane rates
     valid = codes != N_CODE
     one_hot = jax.nn.one_hot(jnp.minimum(codes, 3), 4, dtype=jnp.float32)
     one_hot = one_hot * valid[..., None].astype(jnp.float32)
-    delta = jnp.where(valid, delta_tab[q_idx], 0.0)  # (..., R, L)
+    delta = jnp.where(valid, delta_tab[q_idx], 0.0)
+    return one_hot, delta
+
+
+def _reduce_contributions(codes, quals, correct_tab, err_tab):
+    """Per-position match-contribution + observation-count reduction over reads.
+
+    codes/quals: (..., R, L). Returns C (..., L, 4) f32 (lane match contributions),
+    obs (..., L, 4) int32. N/pad codes contribute nothing (base_builder.rs:616-619).
+    """
+    one_hot, delta = _observation_terms(codes, quals, correct_tab, err_tab)
     contrib = jnp.einsum("...rl,...rlb->...lb", delta, one_hot)
     obs = jnp.sum(one_hot, axis=-3).astype(jnp.int32)  # (..., L, 4)
     return contrib, obs
+
+
+def _pack_result(winner, qual, suspect):
+    """The (qual | winner<<7 | suspect<<10) uint16 wire word (see
+    _unpack_device_result for the inverse)."""
+    packed = qual | (winner << 7) | (suspect.astype(jnp.int32) << 10)
+    return packed.astype(jnp.uint16)
 
 
 def _call_epilogue(contrib, obs, ln_error_pre_umi):
@@ -166,6 +184,31 @@ def _consensus_batch_jit(codes, quals, correct_tab, err_tab, ln_error_pre_umi):
     return _call_epilogue(contrib, obs, ln_error_pre_umi)
 
 
+@partial(jax.jit, static_argnames=("num_segments",))
+def _consensus_segments_packed_jit(codes, quals, seg_ids, correct_tab,
+                                   err_tab, ln_error_pre_umi, num_segments):
+    """Ragged-family variant: dense (N, L) read rows + sorted segment ids.
+
+    One execution covers every family of a record batch regardless of family
+    size — the per-execution relay overhead (~hundreds of ms through the
+    tunnel) dwarfs the compute, so the hot path runs exactly one dispatch and
+    one uint16 fetch per batch. Rows are the packed reads in job order;
+    segment_sum (sorted ids) forms the per-family lane reductions that the
+    uniform-shape path computes with an einsum over the R axis. Pad rows are
+    all-N (zero contribution) and may use any in-range id.
+    """
+    one_hot, delta = _observation_terms(codes, quals, correct_tab, err_tab)
+    row_contrib = delta[..., None] * one_hot  # (N, L, 4)
+    contrib = jax.ops.segment_sum(row_contrib, seg_ids,
+                                  num_segments=num_segments,
+                                  indices_are_sorted=True)
+    obs = jax.ops.segment_sum(one_hot, seg_ids, num_segments=num_segments,
+                              indices_are_sorted=True).astype(jnp.int32)
+    winner, qual, _depth, _errors, suspect = _call_epilogue(
+        contrib, obs, ln_error_pre_umi)
+    return _pack_result(winner, qual, suspect)
+
+
 @jax.jit
 def _consensus_batch_packed_jit(codes, quals, correct_tab, err_tab,
                                 ln_error_pre_umi):
@@ -179,8 +222,7 @@ def _consensus_batch_packed_jit(codes, quals, correct_tab, err_tab,
     """
     winner, qual, _depth, _errors, suspect = _consensus_batch_jit(
         codes, quals, correct_tab, err_tab, ln_error_pre_umi)
-    packed = qual | (winner << 7) | (suspect.astype(jnp.int32) << 10)
-    return packed.astype(jnp.uint16)
+    return _pack_result(winner, qual, suspect)
 
 
 def _unpack_device_result(packed: np.ndarray):
@@ -254,28 +296,78 @@ class ConsensusKernel:
         depth, errors = self._host_counts(codes, winner)
         depth = depth.astype(np.int64)
         errors = errors.astype(np.int64)
-        n_suspect = int(suspect.sum())
-        with self._counter_lock:
-            self.total_positions += suspect.size
-            self.fallback_positions += n_suspect
-        if n_suspect:
-            self._host_fallback(codes, quals, winner, qual, depth, errors, suspect)
+        self._count_suspects(suspect)
+        if suspect.any():
+            self._oracle_patch(suspect, winner, qual, depth, errors,
+                               lambda f: (codes[f], quals[f]))
         return winner, qual, depth, errors
 
     def __call__(self, codes: np.ndarray, quals: np.ndarray):
         return self.resolve_packed(self.device_call_packed(codes, quals),
                                    codes, quals)
 
-    def _host_fallback(self, codes, quals, winner, qual, depth, errors, suspect):
-        """Recompute suspect positions exactly with the f64 oracle (in place)."""
+    # ------------------------------------------------------- ragged (segment)
+
+    def device_call_segments(self, codes2d, quals2d, seg_ids,
+                             num_segments: int):
+        """Dispatch dense (N, L) read rows with sorted per-row segment ids."""
+        return _consensus_segments_packed_jit(
+            jnp.asarray(codes2d), jnp.asarray(quals2d), jnp.asarray(seg_ids),
+            self._correct_f32, self._err_f32, self._pre, num_segments)
+
+    def resolve_segments(self, dev, codes2d: np.ndarray, quals2d: np.ndarray,
+                         starts: np.ndarray):
+        """Fetch + complete a device_call_segments result.
+
+        `starts` is the (J+1,) row-boundary array of the J real segments (the
+        device result may be padded to more segments; extras are dropped).
+        Returns (winner, qual, depth, errors) as (J, L) arrays with suspect
+        positions recomputed exactly by the f64 oracle.
+        """
+        packed = jax.device_get(dev)
+        winner, qual, suspect = _unpack_device_result(packed)
+        J = len(starts) - 1
+        winner = winner[:J]
+        qual = qual[:J]
+        suspect = suspect[:J]
+        # depth/errors per segment: int32 reduceat over the row axis (int32,
+        # not int16: reduceat wraps rather than clamps; the i16 clamp happens
+        # at tag-write time downstream, matching the reference)
+        valid = (codes2d != N_CODE).astype(np.int32)
+        depth = np.add.reduceat(valid, starts[:-1], axis=0).astype(np.int64)
+        counts = np.diff(starts)
+        winner_rows = np.repeat(winner, counts, axis=0)
+        match = ((codes2d == winner_rows) & (codes2d != N_CODE)).astype(np.int32)
+        errors = depth - np.add.reduceat(match, starts[:-1], axis=0)
+        self._count_suspects(suspect)
+        if suspect.any():
+            self._oracle_patch(
+                suspect, winner, qual, depth, errors,
+                lambda f: (codes2d[starts[f]:starts[f + 1]],
+                           quals2d[starts[f]:starts[f + 1]]))
+        return winner, qual, depth, errors
+
+    def _count_suspects(self, suspect: np.ndarray):
+        with self._counter_lock:
+            self.total_positions += suspect.size
+            self.fallback_positions += int(suspect.sum())
+
+    def _oracle_patch(self, suspect, winner, qual, depth, errors, family_rows):
+        """Recompute suspect positions exactly with the f64 oracle (in place).
+
+        `family_rows(f) -> (codes (R, L), quals (R, L))` abstracts the layout
+        difference between the uniform-R batch and the ragged segment path.
+        """
         from . import oracle
 
         fam_idx, pos_idx = np.nonzero(suspect)
         for f in np.unique(fam_idx):
             positions = pos_idx[fam_idx == f]
-            sub_codes = np.ascontiguousarray(codes[f][:, positions])
-            sub_quals = np.ascontiguousarray(quals[f][:, positions])
-            w, q, d, e = oracle.call_family(sub_codes, sub_quals, self.tables)
+            fam_codes, fam_quals = family_rows(f)
+            w, q, d, e = oracle.call_family(
+                np.ascontiguousarray(fam_codes[:, positions]),
+                np.ascontiguousarray(fam_quals[:, positions]),
+                self.tables)
             winner[f, positions] = w
             qual[f, positions] = q
             depth[f, positions] = d

@@ -24,6 +24,34 @@ def _unmapped_consensus_header(read_group_id: str):
         ref_names=[], ref_lengths=[])
 
 
+def _build_dp_mesh(devices_arg):
+    """A dp-only mesh over the requested device count, or None (<=1 device).
+
+    "auto" uses every visible device; sharding is transparent — single-device
+    output is byte-identical (tests/test_mesh.py, test_cli_fast_parity.py).
+    """
+    import jax
+
+    devs = jax.devices()
+    n = len(devs) if devices_arg in (None, "auto") else int(devices_arg)
+    n = max(1, min(n, len(devs)))
+    if n <= 1:
+        return None
+    from .parallel.mesh import make_mesh
+
+    return make_mesh(devs[:n], sp=1)
+
+
+def _devices_arg(s: str):
+    if s == "auto":
+        return s
+    try:
+        return int(s)
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"expected 'auto' or an integer device count, got {s!r}")
+
+
 def _parse_bool(s: str) -> bool:
     """fgbio-style boolean flag values (commands/common.rs parse_bool)."""
     if s.lower() in ("true", "t", "yes", "y", "1"):
@@ -74,6 +102,10 @@ def _add_simplex(sub):
     p.add_argument("--classic", action="store_true",
                    help="force the per-record Python engine (the semantic "
                         "reference for the vectorized fast engine)")
+    p.add_argument("--devices", default="auto", type=_devices_arg,
+                   help="device count for data-parallel consensus dispatch: "
+                        "auto (all visible), or an explicit N; 1 disables "
+                        "sharding (fast engine only)")
     p.set_defaults(func=cmd_simplex)
 
 
@@ -137,13 +169,14 @@ def cmd_simplex(args):
         from .pipeline import StageTimes, run_stages
 
         stats = StageTimes()
+        mesh = _build_dp_mesh(getattr(args, "devices", "auto"))
         with BamBatchReader(args.input, target_bytes=args.batch_bytes) as reader:
             caller = VanillaConsensusCaller(args.read_name_prefix,
                                             args.read_group_id, opts,
                                             reference=reference,
                                             ref_names=reader.header.ref_names)
             fast = FastSimplexCaller(caller, args.tag.encode(),
-                                     overlap_caller=oc_caller)
+                                     overlap_caller=oc_caller, mesh=mesh)
             allow_unmapped = args.allow_unmapped
             with BamWriter(args.output, out_header) as writer:
                 # device fetch + serialize resolve on the sink stage, so with

@@ -13,6 +13,7 @@ tests/test_fast_simplex.py). Families the vectorized path cannot express
 most-common-alignment filter) fall back to the slow path per group.
 """
 
+import jax
 import numpy as np
 
 from ..core import cigar as cigar_utils
@@ -50,24 +51,38 @@ class _PendingChunk:
     def resolve(self) -> bytes:
         fast = self.fast
         caller = fast.caller
-        opts = caller.options
         kernel = caller.kernel
-        if self.pending is not None:
-            idxs, starts, codes_d, quals_d, dev = self.pending
+        if self.pending is None:
+            pass
+        elif self.pending[0] == "seg":
+            _, idxs, starts, codes_d, quals_d, dev = self.pending
             winner, qual, depth, errors = kernel.resolve_segments(
                 dev, codes_d, quals_d, starts)
-            # thresholds are elementwise: one vectorized pass per dispatch
-            bases_b, quals_b = oracle.apply_consensus_thresholds(
-                winner, qual, depth, opts.min_reads,
-                opts.min_consensus_base_quality)
-            depth32 = depth.astype(np.int32)
-            errors32 = errors.astype(np.int32)
-            for fi, j in enumerate(idxs):
-                job = self.jobs[j]
-                L = job.consensus_len
-                job.result = (bases_b[fi, :L], quals_b[fi, :L],
-                              depth32[fi, :L], errors32[fi, :L])
+            self._assign(idxs, winner, qual, depth, errors)
+        else:  # "shard": (dp, F_local, L) packed, one family shard per device
+            _, shard_jobs, shard_starts, codes3d, quals3d, dev = self.pending
+            packed = np.asarray(jax.device_get(dev))
+            for d, (jlist, starts_d) in enumerate(zip(shard_jobs,
+                                                      shard_starts)):
+                n = starts_d[-1]
+                winner, qual, depth, errors = kernel._finish_segments(
+                    packed[d], codes3d[d, :n], quals3d[d, :n], starts_d)
+                self._assign(jlist, winner, qual, depth, errors)
         return fast._serialize_jobs(self.batch, self.jobs)
+
+    def _assign(self, idxs, winner, qual, depth, errors):
+        """Thresholds (one vectorized pass) + per-job result slices."""
+        opts = self.fast.caller.options
+        bases_b, quals_b = oracle.apply_consensus_thresholds(
+            winner, qual, depth, opts.min_reads,
+            opts.min_consensus_base_quality)
+        depth32 = depth.astype(np.int32)
+        errors32 = errors.astype(np.int32)
+        for fi, j in enumerate(idxs):
+            job = self.jobs[j]
+            L = job.consensus_len
+            job.result = (bases_b[fi, :L], quals_b[fi, :L],
+                          depth32[fi, :L], errors32[fi, :L])
 
 
 class _FastJob:
@@ -96,10 +111,14 @@ class FastSimplexCaller:
     """
 
     def __init__(self, caller: VanillaConsensusCaller, tag: bytes = b"MI",
-                 overlap_caller=None):
+                 overlap_caller=None, mesh=None):
+        """`mesh`: optional jax Mesh with a "dp" axis — multi-read jobs are
+        split into contiguous balanced family shards, one per device (data
+        parallel, no collectives; SURVEY §5.8). None = single device."""
         self.caller = caller
         self.tag = tag
         self.overlap_caller = overlap_caller  # OverlappingBasesConsensusCaller
+        self.mesh = mesh if mesh is not None and mesh.size > 1 else None
         opts = caller.options
         # conditions the vectorized conversion cannot express
         self._vector_ok = (not opts.trim and not opts.methylation_mode)
@@ -453,6 +472,10 @@ class FastSimplexCaller:
         quals_d = np.ascontiguousarray(quals[rows_all, :L_max])
         seg_ids = np.repeat(np.arange(len(multi), dtype=np.int32), counts)
 
+        if self.mesh is not None:
+            return self._dispatch_sharded(multi, counts, starts, codes_d,
+                                          quals_d, L_max)
+
         # pow2 pads bound the XLA shape vocabulary (persistent compile cache
         # makes each shape a once-per-machine cost); pad rows are all-N
         # no-ops assigned to the last pad segment, pad segments are never read
@@ -472,7 +495,54 @@ class FastSimplexCaller:
         else:
             codes_dev, quals_dev = codes_d, quals_d
         dev = kernel.device_call_segments(codes_dev, quals_dev, seg_ids, F_pad)
-        return (multi, starts, codes_d, quals_d, dev)
+        return ("seg", multi, starts, codes_d, quals_d, dev)
+
+    def _dispatch_sharded(self, multi, counts, starts, codes_d, quals_d,
+                          L_max):
+        """Split jobs into dp contiguous row-balanced shards, one per device.
+
+        Shards stay contiguous so each device's rows are a slice of the dense
+        layout; all shards pad to common (N_max, F_local) pow2 shapes (the
+        stacked (dp, N_max, L) array shards over the mesh's dp axis).
+        """
+        mesh = self.mesh
+        dp = mesh.size
+        cum = np.cumsum(counts)
+        total = int(cum[-1])
+        targets = (np.arange(1, dp) * total) // dp
+        # the target-crossing job goes to whichever side leaves the row split
+        # closer to the target (plain searchsorted+1 can collapse a 2-job
+        # batch onto one device)
+        i = np.searchsorted(cum, targets, side="left")
+        prev = np.where(i > 0, cum[np.maximum(i - 1, 0)], 0)
+        jb = i + ((cum[np.minimum(i, len(cum) - 1)] - targets)
+                  <= (targets - prev))
+        jb = np.concatenate(([0], jb, [len(multi)]))
+        jb = np.minimum(np.maximum.accumulate(jb), len(multi))
+
+        shard_jobs = [multi[jb[d]:jb[d + 1]] for d in range(dp)]
+        shard_starts = [starts[jb[d]:jb[d + 1] + 1] - starts[jb[d]]
+                        for d in range(dp)]
+        n_rows = [int(s[-1]) for s in shard_starts]
+        n_jobs = [len(sj) for sj in shard_jobs]
+        N_max = 1 << (max(max(n_rows), 1) - 1).bit_length()
+        F_loc = 1 << (max(max(n_jobs), 1) - 1).bit_length()
+
+        codes3d = np.full((dp, N_max, L_max), 4, dtype=np.uint8)
+        quals3d = np.zeros((dp, N_max, L_max), dtype=np.uint8)
+        seg2d = np.zeros((dp, N_max), dtype=np.int32)
+        for d in range(dp):
+            lo, hi = starts[jb[d]], starts[jb[d + 1]]
+            n = n_rows[d]
+            codes3d[d, :n] = codes_d[lo:hi]
+            quals3d[d, :n] = quals_d[lo:hi]
+            seg2d[d, :n] = np.repeat(
+                np.arange(n_jobs[d], dtype=np.int32),
+                np.diff(shard_starts[d]))
+            seg2d[d, n:] = max(n_jobs[d] - 1, 0)
+        dev = self.caller.kernel.device_call_segments_sharded(
+            codes3d, quals3d, seg2d, F_loc, mesh)
+        return ("shard", shard_jobs, shard_starts, codes3d, quals3d, dev)
 
     # ------------------------------------------------------------------ output
 

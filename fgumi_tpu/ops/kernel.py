@@ -184,19 +184,11 @@ def _consensus_batch_jit(codes, quals, correct_tab, err_tab, ln_error_pre_umi):
     return _call_epilogue(contrib, obs, ln_error_pre_umi)
 
 
-@partial(jax.jit, static_argnames=("num_segments",))
-def _consensus_segments_packed_jit(codes, quals, seg_ids, correct_tab,
-                                   err_tab, ln_error_pre_umi, num_segments):
-    """Ragged-family variant: dense (N, L) read rows + sorted segment ids.
-
-    One execution covers every family of a record batch regardless of family
-    size — the per-execution relay overhead (~hundreds of ms through the
-    tunnel) dwarfs the compute, so the hot path runs exactly one dispatch and
-    one uint16 fetch per batch. Rows are the packed reads in job order;
-    segment_sum (sorted ids) forms the per-family lane reductions that the
-    uniform-shape path computes with an einsum over the R axis. Pad rows are
-    all-N (zero contribution) and may use any in-range id.
-    """
+def _segments_body(codes, quals, seg_ids, correct_tab, err_tab,
+                   ln_error_pre_umi, num_segments):
+    """Ragged-family consensus body: dense (N, L) read rows + sorted segment
+    ids -> packed (num_segments, L) uint16. Shared by the single-device jit
+    and the shard_map-per-device sharded variant."""
     one_hot, delta = _observation_terms(codes, quals, correct_tab, err_tab)
     row_contrib = delta[..., None] * one_hot  # (N, L, 4)
     contrib = jax.ops.segment_sum(row_contrib, seg_ids,
@@ -207,6 +199,45 @@ def _consensus_segments_packed_jit(codes, quals, seg_ids, correct_tab,
     winner, qual, _depth, _errors, suspect = _call_epilogue(
         contrib, obs, ln_error_pre_umi)
     return _pack_result(winner, qual, suspect)
+
+
+@partial(jax.jit, static_argnames=("num_segments",))
+def _consensus_segments_packed_jit(codes, quals, seg_ids, correct_tab,
+                                   err_tab, ln_error_pre_umi, num_segments):
+    """Ragged-family variant: dense (N, L) read rows + sorted segment ids.
+
+    One execution covers every family of a record batch regardless of family
+    size — the per-execution relay overhead (~hundreds of ms through the
+    tunnel) dwarfs the compute, so the hot path runs exactly one dispatch and
+    one uint16 fetch per batch. Pad rows are all-N (zero contribution) and
+    may use any in-range id.
+    """
+    return _segments_body(codes, quals, seg_ids, correct_tab, err_tab,
+                          ln_error_pre_umi, num_segments)
+
+
+@partial(jax.jit, static_argnames=("num_segments", "mesh"))
+def _consensus_segments_sharded_jit(codes, quals, seg_ids, correct_tab,
+                                    err_tab, ln_error_pre_umi, num_segments,
+                                    mesh):
+    """dp-sharded ragged variant: (dp, N, L) rows -> (dp, num_segments, L).
+
+    Families are embarrassingly parallel (SURVEY §5.7), so each device runs
+    the segment body on its own contiguous slice of families — data parallel
+    over the dp mesh axis with zero collectives in the hot path; the host
+    splits jobs into balanced contiguous shards (consensus/fast.py).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def local(c, q, s):
+        return _segments_body(c[0], q[0], s[0], correct_tab, err_tab,
+                              ln_error_pre_umi, num_segments)[None]
+
+    # shard the leading axis over every mesh axis (a dp-only mesh has sp=1)
+    spec = P(tuple(mesh.axis_names))
+    mapped = jax.shard_map(local, mesh=mesh,
+                           in_specs=(spec, spec, spec), out_specs=spec)
+    return mapped(codes, quals, seg_ids)
 
 
 @jax.jit
@@ -315,6 +346,13 @@ class ConsensusKernel:
             jnp.asarray(codes2d), jnp.asarray(quals2d), jnp.asarray(seg_ids),
             self._correct_f32, self._err_f32, self._pre, num_segments)
 
+    def device_call_segments_sharded(self, codes3d, quals3d, seg_ids2d,
+                                     num_segments: int, mesh):
+        """Dispatch (dp, N, L) rows, one contiguous family shard per device."""
+        return _consensus_segments_sharded_jit(
+            jnp.asarray(codes3d), jnp.asarray(quals3d), jnp.asarray(seg_ids2d),
+            self._correct_f32, self._err_f32, self._pre, num_segments, mesh)
+
     def resolve_segments(self, dev, codes2d: np.ndarray, quals2d: np.ndarray,
                          starts: np.ndarray):
         """Fetch + complete a device_call_segments result.
@@ -325,8 +363,16 @@ class ConsensusKernel:
         positions recomputed exactly by the f64 oracle.
         """
         packed = jax.device_get(dev)
-        winner, qual, suspect = _unpack_device_result(packed)
+        return self._finish_segments(packed, codes2d, quals2d, starts)
+
+    def _finish_segments(self, packed: np.ndarray, codes2d, quals2d, starts):
         J = len(starts) - 1
+        if J == 0:  # empty shard (more devices than jobs)
+            L = packed.shape[-1]
+            z = np.zeros((0, L))
+            return (z.astype(np.uint8), z.astype(np.uint8),
+                    z.astype(np.int64), z.astype(np.int64))
+        winner, qual, suspect = _unpack_device_result(packed)
         winner = winner[:J]
         qual = qual[:J]
         suspect = suspect[:J]

@@ -65,3 +65,20 @@ def test_stats_flag_runs(sim_bam, tmp_path, capsys):
     _run(sim_bam, tmp_path, "stats.bam", ("--stats", "--threads", "2"))
     out = capsys.readouterr().out
     assert "busy_s" in out
+
+
+def test_sharded_matches_single_device(sim_bam, tmp_path):
+    """8-device dp-sharded dispatch == single device, byte-identical
+    (VERDICT r1 item 4: mesh wired into the simplex caller transparently)."""
+    one = _run(sim_bam, tmp_path, "dev1.bam", ("--devices", "1"))
+    eight = _run(sim_bam, tmp_path, "dev8.bam", ("--devices", "8"))
+    assert _payload(one) == _payload(eight)
+
+
+def test_sharded_more_devices_than_jobs(sim_bam, tmp_path):
+    """Tiny batches: some shards get zero jobs; output still identical."""
+    one = _run(sim_bam, tmp_path, "sdev1.bam",
+               ("--devices", "1", "--batch-bytes", "4096"))
+    eight = _run(sim_bam, tmp_path, "sdev8.bam",
+                 ("--devices", "8", "--batch-bytes", "4096"))
+    assert _payload(one) == _payload(eight)

@@ -600,8 +600,19 @@ def _add_sort(sub):
                    choices=["coordinate", "queryname", "template-coordinate"])
     p.add_argument("--subsort", default="natural", choices=["natural", "lex"],
                    help="queryname comparator")
-    p.add_argument("--max-records-in-ram", type=int, default=500_000)
+    p.add_argument("--max-memory", default="auto",
+                   help="sort accumulation budget: MiB count, human size "
+                        "(512M, 2G), or auto (cgroup-aware available minus "
+                        "reserve)")
+    p.add_argument("--memory-reserve", default="1G",
+                   help="held back from auto-detected memory")
+    p.add_argument("--max-records-in-ram", type=int, default=None,
+                   help="optional additional record-count cap on the in-RAM "
+                        "chunk (the primary budget is --max-memory bytes)")
     p.add_argument("--tmp-dir", default=None)
+    p.add_argument("--write-index", type=_parse_bool, nargs="?", const=True,
+                   default=True, metavar="true|false",
+                   help="write a .bai alongside coordinate-sorted output")
     p.set_defaults(func=cmd_sort)
 
 
@@ -626,26 +637,53 @@ def _rewrite_hd(text, so, go, ss):
 
 
 def cmd_sort(args):
-    from .io.bam import BamHeader, BamReader, BamWriter
-    from .sort.external import ExternalSorter, header_tags_for_order, make_key_fn
+    from .io.bam import FLAG_UNMAPPED, BamHeader, BamReader, BamWriter, RawRecord
+    from .sort.external import ExternalSorter, header_tags_for_order
+    from .sort.keys import make_key_bytes_fn
+    from .utils.memory import resolve_budget
 
+    from .utils.memory import parse_size
+
+    try:
+        budget = resolve_budget(args.max_memory, parse_size(args.memory_reserve))
+    except ValueError as e:
+        log.error("%s", e)
+        return 2
     t0 = time.monotonic()
     with BamReader(args.input) as reader:
-        key_fn = make_key_fn(args.order, reader.header, args.subsort)
+        key_fn = make_key_bytes_fn(args.order, reader.header, args.subsort)
         so, go, ss = header_tags_for_order(args.order, args.subsort)
         out_header = BamHeader(
             text=_rewrite_hd(reader.header.text, so, go, ss),
             ref_names=reader.header.ref_names, ref_lengths=reader.header.ref_lengths)
-        with ExternalSorter(key_fn, max_records=args.max_records_in_ram,
-                            tmp_dir=args.tmp_dir) as sorter:
+        bai = None
+        if args.order == "coordinate" and args.write_index:
+            from .io.bai import BaiBuilder
+
+            bai = BaiBuilder(len(reader.header.ref_names))
+        with ExternalSorter(key_fn, max_bytes=budget, tmp_dir=args.tmp_dir,
+                            max_records=args.max_records_in_ram) as sorter:
             for rec in reader:
                 sorter.add(rec)
             with BamWriter(args.output, out_header) as writer:
-                for data in sorter.sorted_records():
-                    writer.write_record_bytes(data)
+                if bai is None:
+                    for data in sorter.sorted_records():
+                        writer.write_record_bytes(data)
+                else:
+                    for data in sorter.sorted_records():
+                        rec = RawRecord(data)
+                        vo0 = writer.tell_virtual()
+                        writer.write_record_bytes(data)
+                        bai.add(rec.ref_id, rec.pos,
+                                rec.pos + max(rec.reference_length(), 1),
+                                vo0, writer.tell_virtual(),
+                                not rec.flag & FLAG_UNMAPPED)
+        if bai is not None:
+            bai.write(args.output + ".bai")
     dt = time.monotonic() - t0
-    log.info("sort: %d records (%s) in %.2fs (%.0f rec/s)", sorter.n_records,
-             args.order, dt, sorter.n_records / dt if dt else 0)
+    log.info("sort: %d records (%s, budget %dMB) in %.2fs (%.0f rec/s)",
+             sorter.n_records, args.order, budget >> 20, dt,
+             sorter.n_records / dt if dt else 0)
     return 0
 
 

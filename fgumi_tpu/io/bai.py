@@ -1,0 +1,167 @@
+"""BAI index: writer (coordinate sort / standalone index) and reader.
+
+Implements the BAM index format from the SAM spec (binning index with 16 KiB
+linear windows), the analog of the reference's BAI write on coordinate sort
+(/root/reference/src/lib/commands/sort.rs BAI output) and its indexed reader
+(/root/reference/crates/fgumi-raw-bam/src/indexed_reader.rs).
+
+Virtual offsets are (compressed_block_offset << 16) | within_block_offset,
+provided by BgzfWriter.tell_virtual().
+"""
+
+import struct
+
+_BAI_MAGIC = b"BAI\x01"
+_LINEAR_SHIFT = 14  # 16 KiB windows
+_PSEUDO_BIN = 37450
+
+
+def reg2bin(beg: int, end: int) -> int:
+    """SAM spec bin for a [beg, end) zero-based interval."""
+    end -= 1
+    if beg >> 14 == end >> 14:
+        return ((1 << 15) - 1) // 7 + (beg >> 14)
+    if beg >> 17 == end >> 17:
+        return ((1 << 12) - 1) // 7 + (beg >> 17)
+    if beg >> 20 == end >> 20:
+        return ((1 << 9) - 1) // 7 + (beg >> 20)
+    if beg >> 23 == end >> 23:
+        return ((1 << 6) - 1) // 7 + (beg >> 23)
+    if beg >> 26 == end >> 26:
+        return ((1 << 3) - 1) // 7 + (beg >> 26)
+    return 0
+
+
+def reg2bins(beg: int, end: int):
+    """All bins overlapping [beg, end) (spec loop, for the reader)."""
+    end -= 1
+    bins = [0]
+    for shift, offset in ((26, 1), (23, 9), (20, 73), (17, 585), (14, 4681)):
+        bins.extend(range(offset + (beg >> shift), offset + (end >> shift) + 1))
+    return bins
+
+
+class BaiBuilder:
+    """Accumulates (tid, beg, end, vo_start, vo_end) of coordinate-ordered
+    records and writes the .bai file."""
+
+    def __init__(self, n_refs: int):
+        self.n_refs = n_refs
+        self._bins = [dict() for _ in range(n_refs)]  # bin -> [chunks]
+        self._linear = [dict() for _ in range(n_refs)]  # window -> min voffset
+        self._stats = [[None, None, 0, 0] for _ in range(n_refs)]
+        self.n_no_coor = 0
+
+    def add(self, tid: int, beg: int, end: int, vo_start: int, vo_end: int,
+            mapped: bool):
+        """Record one placed record; call with tid < 0 for unplaced ones."""
+        if tid < 0:
+            self.n_no_coor += 1
+            return
+        end = max(end, beg + 1)
+        b = reg2bin(beg, end)
+        chunks = self._bins[tid].setdefault(b, [])
+        if chunks and chunks[-1][1] == vo_start:
+            chunks[-1][1] = vo_end  # coalesce adjacent chunks
+        else:
+            chunks.append([vo_start, vo_end])
+        linear = self._linear[tid]
+        for win in range(beg >> _LINEAR_SHIFT, ((end - 1) >> _LINEAR_SHIFT) + 1):
+            if win not in linear or vo_start < linear[win]:
+                linear[win] = vo_start
+        st = self._stats[tid]
+        st[0] = vo_start if st[0] is None else min(st[0], vo_start)
+        st[1] = vo_end if st[1] is None else max(st[1], vo_end)
+        st[2 if mapped else 3] += 1
+
+    def write(self, path: str):
+        with open(path, "wb") as f:
+            f.write(_BAI_MAGIC)
+            f.write(struct.pack("<i", self.n_refs))
+            for tid in range(self.n_refs):
+                bins = self._bins[tid]
+                st = self._stats[tid]
+                n_bin = len(bins) + (1 if st[0] is not None else 0)
+                f.write(struct.pack("<i", n_bin))
+                for b in sorted(bins):
+                    chunks = bins[b]
+                    f.write(struct.pack("<Ii", b, len(chunks)))
+                    for beg, end in chunks:
+                        f.write(struct.pack("<QQ", beg, end))
+                if st[0] is not None:  # samtools-style pseudo-bin metadata
+                    f.write(struct.pack("<Ii", _PSEUDO_BIN, 2))
+                    f.write(struct.pack("<QQ", st[0], st[1]))
+                    f.write(struct.pack("<QQ", st[2], st[3]))
+                linear = self._linear[tid]
+                n_intv = max(linear) + 1 if linear else 0
+                f.write(struct.pack("<i", n_intv))
+                filled = 0
+                for win in range(n_intv):
+                    filled = linear.get(win, filled)
+                    f.write(struct.pack("<Q", filled))
+            f.write(struct.pack("<Q", self.n_no_coor))
+
+
+class BaiIndex:
+    """Parsed .bai: per-ref bins/chunks + linear index, for region queries."""
+
+    def __init__(self, path: str):
+        with open(path, "rb") as f:
+            data = f.read()
+        if data[:4] != _BAI_MAGIC:
+            raise ValueError(f"not a BAI file: {path}")
+        off = 4
+        (n_ref,) = struct.unpack_from("<i", data, off)
+        off += 4
+        self.bins = []
+        self.linear = []
+        self.stats = []
+        for _ in range(n_ref):
+            (n_bin,) = struct.unpack_from("<i", data, off)
+            off += 4
+            bins = {}
+            stats = None
+            for _ in range(n_bin):
+                b, n_chunk = struct.unpack_from("<Ii", data, off)
+                off += 8
+                chunks = []
+                for _ in range(n_chunk):
+                    beg, end = struct.unpack_from("<QQ", data, off)
+                    off += 16
+                    chunks.append((beg, end))
+                if b == _PSEUDO_BIN:
+                    stats = chunks
+                else:
+                    bins[b] = chunks
+            (n_intv,) = struct.unpack_from("<i", data, off)
+            off += 4
+            intv = list(struct.unpack_from(f"<{n_intv}Q", data, off))
+            off += 8 * n_intv
+            self.bins.append(bins)
+            self.linear.append(intv)
+            self.stats.append(stats)
+        self.n_no_coor = struct.unpack_from("<Q", data, off)[0] \
+            if off + 8 <= len(data) else 0
+
+    def query_chunks(self, tid: int, beg: int, end: int):
+        """Merged, linear-index-filtered chunk list overlapping [beg, end)."""
+        if tid < 0 or tid >= len(self.bins):
+            return []
+        bins = self.bins[tid]
+        linear = self.linear[tid]
+        win = beg >> _LINEAR_SHIFT
+        min_vo = linear[win] if win < len(linear) else (
+            linear[-1] if linear else 0)
+        chunks = []
+        for b in reg2bins(beg, end):
+            for c_beg, c_end in bins.get(b, ()):
+                if c_end > min_vo:
+                    chunks.append((max(c_beg, min_vo), c_end))
+        chunks.sort()
+        merged = []
+        for c in chunks:
+            if merged and c[0] <= merged[-1][1]:
+                merged[-1] = (merged[-1][0], max(merged[-1][1], c[1]))
+            else:
+                merged.append(c)
+        return merged

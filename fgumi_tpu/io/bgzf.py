@@ -67,18 +67,28 @@ class BgzfWriter(io.RawIOBase):
         self._level = level
         self._buf = bytearray()
         self._owns = owns_fileobj
+        self._coffset = 0  # compressed bytes emitted so far
 
     def write(self, data) -> int:
         self._buf += data
         while len(self._buf) >= MAX_BLOCK_DATA:
             chunk = bytes(self._buf[:MAX_BLOCK_DATA])
             del self._buf[:MAX_BLOCK_DATA]
-            self._f.write(compress_block(chunk, self._level))
+            block = compress_block(chunk, self._level)
+            self._coffset += len(block)
+            self._f.write(block)
         return len(data)
+
+    def tell_virtual(self) -> int:
+        """BGZF virtual offset of the next byte to be written:
+        (compressed offset of the current block) << 16 | in-block offset."""
+        return (self._coffset << 16) | len(self._buf)
 
     def flush(self):
         if self._buf:
-            self._f.write(compress_block(bytes(self._buf), self._level))
+            block = compress_block(bytes(self._buf), self._level)
+            self._coffset += len(block)
+            self._f.write(block)
             self._buf.clear()
 
     def close(self):

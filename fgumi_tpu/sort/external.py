@@ -62,12 +62,15 @@ def queryname_key(rec: RawRecord, lexicographic: bool = False):
             _within_name_rank(rec.flag))
 
 
-_UNMAPPED_SENTINEL = (0xFFFF, 0x7FFFFFFF, False)
+# tid sentinel above any real reference id (tids are int32 < 2^31); a 16-bit
+# sentinel would misorder assemblies with >65k contigs
+_UNMAPPED_SENTINEL = (1 << 31, 0x7FFFFFFF, False)
 
 
 def _mate_end_info(rec: RawRecord):
     """Mate's (tid, unclipped 5' pos, reverse) from next_* fields + MC tag."""
-    if not rec.flag & FLAG_PAIRED or rec.flag & FLAG_MATE_UNMAPPED:
+    if not rec.flag & FLAG_PAIRED or rec.flag & FLAG_MATE_UNMAPPED \
+            or rec.next_ref_id < 0:
         return _UNMAPPED_SENTINEL
     mate_rev = bool(rec.flag & FLAG_MATE_REVERSE)
     mate_pos = rec.next_pos + 1  # 1-based
@@ -160,12 +163,18 @@ def header_tags_for_order(order: str, subsort: str = "natural"):
 # spill streams (zspill_stream.rs).
 _FRAME_BYTES = 4 << 20
 
+# Per-entry bookkeeping overhead charged against the byte budget (tuple +
+# bytes objects + list slot).
+_ENTRY_OVERHEAD = 120
+
 
 class _SpillRun:
-    """One sorted run on disk: pickled (key, ordinal, record) frames, deflated.
+    """One sorted run on disk: raw length-prefixed frames, deflate-1.
 
-    Keys are persisted with the records so the merge phase never re-extracts them
-    (the reference serializes keys into spill runs for the same reason, keys.rs:57).
+    Frame payload is a sequence of [<HQI> header (klen, ordinal, rlen) | key |
+    record] — keys are the packed memcmp-ordered byte strings of sort/keys.py,
+    persisted verbatim so the merge phase never re-extracts or unpickles
+    (the reference serializes keys into spill runs the same way, keys.rs:57).
     """
 
     def __init__(self, tmp_dir):
@@ -173,36 +182,40 @@ class _SpillRun:
         self._f = os.fdopen(fd, "wb")
 
     def write(self, entries):
-        import pickle
-
-        frame = []
-        frame_bytes = 0
-        for entry in entries:
-            frame.append(entry)
-            frame_bytes += len(entry[2]) + 64
-            if frame_bytes >= _FRAME_BYTES:
-                self._write_frame(frame, pickle)
-                frame = []
-                frame_bytes = 0
+        frame = bytearray()
+        for key, ordinal, data in entries:
+            frame += struct.pack("<HQI", len(key), ordinal, len(data))
+            frame += key
+            frame += data
+            if len(frame) >= _FRAME_BYTES:
+                self._write_frame(frame)
+                frame = bytearray()
         if frame:
-            self._write_frame(frame, pickle)
+            self._write_frame(frame)
         self._f.close()
 
-    def _write_frame(self, frame, pickle):
-        payload = zlib.compress(pickle.dumps(frame, protocol=4), 1)
+    def _write_frame(self, frame):
+        payload = zlib.compress(bytes(frame), 1)
         self._f.write(struct.pack("<I", len(payload)))
         self._f.write(payload)
 
     def __iter__(self):
-        import pickle
-
         with open(self.path, "rb") as f:
             while True:
                 size_b = f.read(4)
                 if len(size_b) < 4:
                     break
                 (size,) = struct.unpack("<I", size_b)
-                yield from pickle.loads(zlib.decompress(f.read(size)))
+                frame = zlib.decompress(f.read(size))
+                off = 0
+                end = len(frame)
+                while off < end:
+                    klen, ordinal, rlen = struct.unpack_from("<HQI", frame, off)
+                    off += 14
+                    key = frame[off:off + klen]
+                    off += klen
+                    yield (key, ordinal, frame[off:off + rlen])
+                    off += rlen
 
     def unlink(self):
         try:
@@ -214,24 +227,36 @@ class _SpillRun:
 class ExternalSorter:
     """Accumulate -> sort -> spill -> k-way merge (RawExternalSorter analog).
 
-    Use as a context manager (or call close()) to guarantee spill cleanup; the
-    temp directory is created lazily on first spill.
+    `key_fn` must return packed bytes (sort/keys.py); the memory budget is
+    byte-based (`max_bytes`, keys + records + bookkeeping), matching the
+    reference's byte-accounted RecordBuffer (external.rs Phase 1) rather than
+    a record count. Use as a context manager (or call close()) to guarantee
+    spill cleanup; the temp directory is created lazily on first spill.
     """
 
-    def __init__(self, key_fn, max_records: int = 500_000, tmp_dir=None):
+    def __init__(self, key_fn, max_bytes: int = 256 << 20, tmp_dir=None,
+                 max_records: int = None):
         self.key_fn = key_fn
-        self.max_records = max_records
+        self.max_bytes = max_bytes
+        self.max_records = max_records  # optional extra cap (tests)
         self._tmp_dir_arg = tmp_dir
         self._tmp_dir = None
         self._own_tmp_dir = False
         self._chunk = []
+        self._chunk_bytes = 0
         self._runs = []
         self.n_records = 0
 
     def add(self, rec: RawRecord):
-        self._chunk.append((self.key_fn(rec), self.n_records, rec.data))
+        self.add_entry(self.key_fn(rec), rec.data)
+
+    def add_entry(self, key: bytes, data: bytes):
+        self._chunk.append((key, self.n_records, data))
         self.n_records += 1
-        if len(self._chunk) >= self.max_records:
+        self._chunk_bytes += len(key) + len(data) + _ENTRY_OVERHEAD
+        if self._chunk_bytes >= self.max_bytes or (
+                self.max_records is not None
+                and len(self._chunk) >= self.max_records):
             self._spill()
 
     def _spill(self):
@@ -241,17 +266,18 @@ class ExternalSorter:
             else:
                 self._tmp_dir = tempfile.mkdtemp(prefix="fgumi_sort_")
                 self._own_tmp_dir = True
-        self._chunk.sort(key=lambda t: (t[0], t[1]))
+        self._chunk.sort()
         run = _SpillRun(self._tmp_dir)
         run.write(iter(self._chunk))
         self._runs.append(run)
         self._chunk = []
+        self._chunk_bytes = 0
 
     def sorted_records(self):
         """Yield record bytes in sorted order."""
         if not self._runs:
             # in-memory fast path (external.rs single-chunk analog)
-            self._chunk.sort(key=lambda t: (t[0], t[1]))
+            self._chunk.sort()
             for _, _, data in self._chunk:
                 yield data
             self._chunk = []

@@ -1,0 +1,139 @@
+"""Packed binary sort keys: memcmp order == semantic order.
+
+The reference packs sort keys into fixed-width integers so an unstable radix
+sort becomes total (/root/reference/crates/fgumi-sort/src/keys.rs, radix.rs:35).
+Python's analog of that discipline is byte-string keys whose lexicographic
+(memcmp) comparison reproduces the tuple-key semantics of sort/external.py:
+bytes compare in C, spill frames carry the key verbatim (no pickling), and the
+merge phase never re-extracts.
+
+Encodings (all big-endian so memcmp == numeric):
+- coordinate: tid(4) pos+1(4); unmapped tid -> 0x80000000 (above any real
+  int32 tid, sorts last; matches external._UNMAPPED_SENTINEL).
+- natural queryname: per element, digit runs as 0x01 + u8 digit-count +
+  stripped digits (fewer digits = smaller number; same count compares
+  lexicographically == numerically), text runs as 0x02 + text + 0x00; a name
+  that is a prefix of another terminates first and sorts first (tags > 0x00).
+- lexicographic queryname: raw name + 0x00 terminator (QNAME has no NUL).
+- template-coordinate: tid1(4) tid2(4) pos1(4) pos2(4) !neg1 !neg2 lib(2)
+  mi-value(8) mi-sub name-natural 0x00 is_upper — the TemplateKey field order
+  (fgumi-sort/src/inline.rs:620-694).
+"""
+
+import re
+import struct
+
+from ..core.overlap import parse_soft_clips_and_ref_len
+from ..core.template import unclipped_5prime
+from ..io.bam import (FLAG_FIRST, FLAG_MATE_REVERSE, FLAG_MATE_UNMAPPED,
+                      FLAG_PAIRED, FLAG_REVERSE, FLAG_SECONDARY,
+                      FLAG_SUPPLEMENTARY, FLAG_UNMAPPED, RawRecord)
+
+_DIGITS = re.compile(rb"(\d+)")
+
+# Bias keeping template-coordinate positions non-negative in u32: unclipped
+# starts can go below zero on heavily clipped leading alignments.
+_POS_BIAS = 0x4000_0000
+# above any real reference id (tids are int32 < 2^31); matches
+# external._UNMAPPED_SENTINEL so packed and tuple keys order identically
+_TID_UNMAPPED = 1 << 31
+_POS_SENTINEL = 0x7FFF_FFFF
+
+
+def coordinate_key_bytes(rec: RawRecord) -> bytes:
+    """samtools coordinate order: mapped by (tid, pos), unmapped (tid<0) last."""
+    tid = rec.ref_id
+    return struct.pack(">II", _TID_UNMAPPED if tid < 0 else tid, rec.pos + 1)
+
+
+def encode_natural_name(name: bytes) -> bytes:
+    """Byte-comparable natural (digit-aware) name encoding."""
+    out = bytearray()
+    for part in _DIGITS.split(name):
+        if not part:
+            continue
+        if part.isdigit():
+            sig = part.lstrip(b"0")
+            out += b"\x01" + bytes([len(sig)]) + sig
+        else:
+            out += b"\x02" + part + b"\x00"
+    return bytes(out)
+
+
+def _rank_bytes(flag: int) -> bytes:
+    """Sub-order within one template: primaries first, R1 before R2, then flag."""
+    sec = 1 if flag & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY) else 0
+    r12 = 0 if not flag & FLAG_PAIRED else (1 if flag & FLAG_FIRST else 2)
+    return struct.pack(">BBH", sec, r12, flag)
+
+
+def queryname_key_bytes(rec: RawRecord, lexicographic: bool = False) -> bytes:
+    name = rec.name
+    enc = (name + b"\x00") if lexicographic else (encode_natural_name(name)
+                                                 + b"\x00")
+    return enc + _rank_bytes(rec.flag)
+
+
+def _own_end(rec: RawRecord, flag: int):
+    if flag & FLAG_UNMAPPED:
+        return (_TID_UNMAPPED, _POS_SENTINEL, False)
+    return (rec.ref_id, unclipped_5prime(rec) + 1, bool(flag & FLAG_REVERSE))
+
+
+def _mate_end(rec: RawRecord, flag: int):
+    if not flag & FLAG_PAIRED or flag & FLAG_MATE_UNMAPPED \
+            or rec.next_ref_id < 0:
+        return (_TID_UNMAPPED, _POS_SENTINEL, False)
+    mate_rev = bool(flag & FLAG_MATE_REVERSE)
+    mate_pos = rec.next_pos + 1  # 1-based
+    mc = rec.get_str(b"MC")
+    leading = ref_len = trailing = 0
+    if mc is not None:
+        parsed = parse_soft_clips_and_ref_len(mc)
+        if parsed is not None:
+            leading, ref_len, trailing = parsed
+    if mate_rev:
+        pos = mate_pos - 1 + max(ref_len, 1) - 1 + trailing + 1
+    else:
+        pos = mate_pos - leading
+    return (rec.next_ref_id, pos, mate_rev)
+
+
+def template_coordinate_key_bytes(rec: RawRecord, library_ord: int,
+                                  mi: tuple) -> bytes:
+    """TemplateKey analog: earlier end first; reverse strand sorts before
+    forward (inverted flag); the lower-end record sorts before its mate."""
+    flag = rec.flag
+    own = _own_end(rec, flag)
+    mate = _mate_end(rec, flag)
+    if own <= mate:
+        lo, hi, is_upper = own, mate, 0
+    else:
+        lo, hi, is_upper = mate, own, 1
+    tid1, pos1, neg1 = lo
+    tid2, pos2, neg2 = hi
+    return (struct.pack(">IIII", tid1, tid2, pos1 + _POS_BIAS,
+                        pos2 + _POS_BIAS)
+            + bytes([0 if neg1 else 1, 0 if neg2 else 1])
+            + struct.pack(">HQB", library_ord,
+                          max(0, min(mi[0], 0xFFFF_FFFF_FFFF_FFFF)), mi[1])
+            # raw name bytes: template-coordinate name order only needs to be
+            # deterministic grouping (the reference hashes names here,
+            # inline.rs TemplateKey name_hash_upper)
+            + rec.name + b"\x00" + bytes([is_upper]))
+
+
+def make_key_bytes_fn(order: str, header, subsort: str = "natural"):
+    """Packed-key function for coordinate|queryname|template-coordinate."""
+    from .external import SortContext, _mi_key
+
+    if order == "coordinate":
+        return coordinate_key_bytes
+    if order == "queryname":
+        lex = subsort == "lex"
+        return lambda rec: queryname_key_bytes(rec, lexicographic=lex)
+    if order == "template-coordinate":
+        ctx = SortContext(header)
+        return lambda rec: template_coordinate_key_bytes(
+            rec, ctx.library_ordinal(rec), _mi_key(rec))
+    raise ValueError(f"unknown sort order: {order}")

@@ -1,0 +1,77 @@
+"""Memory budget parsing and cgroup-aware detection.
+
+Analog of the reference's --max-memory handling
+(/root/reference/src/lib/commands/common.rs:759-993 parse + `auto`, and
+src/lib/system.rs:1-26 cgroup-aware totals): accepts plain MiB counts, human
+sizes (K/M/G/T, binary), or "auto" = detected available memory minus a
+reserve, clamped to a sane floor.
+"""
+
+import os
+import re
+
+_SIZE = re.compile(r"^(\d+(?:\.\d+)?)\s*([KMGT]i?B?|B)?$", re.IGNORECASE)
+_UNIT = {"b": 1, "k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+
+_FLOOR = 64 << 20  # never budget below 64 MiB
+_DEFAULT_RESERVE = 1 << 30
+
+
+def parse_size(value: str) -> int:
+    """Human size -> bytes. A bare number means MiB (reference convention)."""
+    s = str(value).strip()
+    m = _SIZE.match(s)
+    if not m:
+        raise ValueError(f"unparseable size: {value!r}")
+    num = float(m.group(1))
+    unit = m.group(2)
+    if unit is None:
+        return int(num * (1 << 20))
+    return int(num * _UNIT[unit[0].lower()])
+
+
+def _cgroup_limit():
+    """Container memory limit in bytes, or None (v2 then v1 paths)."""
+    for path in ("/sys/fs/cgroup/memory.max",
+                 "/sys/fs/cgroup/memory/memory.limit_in_bytes"):
+        try:
+            with open(path) as f:
+                raw = f.read().strip()
+        except OSError:
+            continue
+        if raw == "max":
+            return None
+        try:
+            limit = int(raw)
+        except ValueError:
+            continue
+        if 0 < limit < 1 << 50:  # v1 reports ~2^63 for "unlimited"
+            return limit
+    return None
+
+
+def _mem_available():
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) << 10
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+def auto_budget(reserve: int = _DEFAULT_RESERVE) -> int:
+    """Detected usable memory minus `reserve` (>= the floor)."""
+    candidates = [v for v in (_cgroup_limit(), _mem_available()) if v]
+    total = min(candidates) if candidates else 4 << 30
+    return max(total - reserve, _FLOOR)
+
+
+def resolve_budget(value, reserve: int = _DEFAULT_RESERVE) -> int:
+    """CLI --max-memory value ("auto" | human size | MiB count) -> bytes."""
+    if value is None:
+        return auto_budget(reserve)
+    if str(value).strip().lower() == "auto":
+        return auto_budget(reserve)
+    return max(parse_size(value), _FLOOR)

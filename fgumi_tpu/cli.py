@@ -1172,6 +1172,92 @@ def _add_simulate(sub):
     m.add_argument("--paired-umis", action="store_true")
     m.add_argument("--seed", type=int, default=42)
     m.set_defaults(func=cmd_simulate_mapped)
+    f = ps.add_parser("fastq-reads",
+                      help="paired gzip FASTQ with UMI prefixes (extract input)")
+    f.add_argument("-1", "--r1", required=True, dest="r1")
+    f.add_argument("-2", "--r2", required=True, dest="r2")
+    f.add_argument("--truth", default=None, help="truth TSV output")
+    f.add_argument("--num-families", type=int, default=100)
+    f.add_argument("--family-size", type=int, default=5)
+    f.add_argument("--family-size-distribution", default="fixed",
+                   choices=["fixed", "lognormal"])
+    f.add_argument("--read-length", type=int, default=100)
+    f.add_argument("--umi-length", type=int, default=8)
+    f.add_argument("--error-rate", type=float, default=0.0)
+    f.add_argument("--base-quality", type=int, default=35)
+    f.add_argument("--duplex", action="store_true",
+                   help="UMI prefix on both reads (duplex extraction)")
+    f.add_argument("--includelist", default=None,
+                   help="sample UMIs from this file (one per line)")
+    f.add_argument("--seed", type=int, default=42)
+    f.set_defaults(func=cmd_simulate_fastq)
+    cr = ps.add_parser("consensus-reads",
+                       help="mapped BAM shaped like consensus output (filter input)")
+    cr.add_argument("-o", "--output", required=True)
+    cr.add_argument("--truth", default=None)
+    cr.add_argument("-n", "--num-reads", type=int, default=1000)
+    cr.add_argument("-l", "--read-length", type=int, default=150)
+    cr.add_argument("--min-depth", type=int, default=1)
+    cr.add_argument("--max-depth", type=int, default=10)
+    cr.add_argument("--depth-mean", type=float, default=5.0)
+    cr.add_argument("--depth-stddev", type=float, default=2.0)
+    cr.add_argument("--error-rate-mean", type=float, default=0.01)
+    cr.add_argument("--no-per-base-tags", action="store_true")
+    cr.add_argument("--seed", type=int, default=42)
+    cr.set_defaults(func=cmd_simulate_consensus)
+    co = ps.add_parser("correct-reads",
+                       help="unmapped BAM + UMI includelist (correct input)")
+    co.add_argument("-o", "--output", required=True)
+    co.add_argument("-i", "--includelist", required=True,
+                    help="includelist file to write")
+    co.add_argument("--truth", default=None)
+    co.add_argument("-n", "--num-reads", type=int, default=10000)
+    co.add_argument("--num-umis", type=int, default=1000)
+    co.add_argument("-u", "--umi-length", type=int, default=8)
+    co.add_argument("-l", "--read-length", type=int, default=100)
+    co.add_argument("--max-errors", type=int, default=2)
+    co.add_argument("--seed", type=int, default=42)
+    co.set_defaults(func=cmd_simulate_correct)
+
+
+def cmd_simulate_fastq(args):
+    from .simulate import simulate_fastq_reads
+
+    n = simulate_fastq_reads(
+        args.r1, args.r2, truth_path=args.truth,
+        num_families=args.num_families, family_size=args.family_size,
+        family_size_distribution=args.family_size_distribution,
+        read_length=args.read_length, umi_length=args.umi_length,
+        error_rate=args.error_rate, base_quality=args.base_quality,
+        duplex=args.duplex, includelist=args.includelist, seed=args.seed)
+    log.info("simulate: wrote %d read pairs to %s / %s", n, args.r1, args.r2)
+    return 0
+
+
+def cmd_simulate_consensus(args):
+    from .simulate import simulate_consensus_bam
+
+    n = simulate_consensus_bam(
+        args.output, truth_path=args.truth, num_reads=args.num_reads,
+        read_length=args.read_length, min_depth=args.min_depth,
+        max_depth=args.max_depth, depth_mean=args.depth_mean,
+        depth_stddev=args.depth_stddev, error_rate_mean=args.error_rate_mean,
+        per_base_tags=not args.no_per_base_tags, seed=args.seed)
+    log.info("simulate: wrote %d consensus records to %s", n, args.output)
+    return 0
+
+
+def cmd_simulate_correct(args):
+    from .simulate import simulate_correct_reads
+
+    n = simulate_correct_reads(
+        args.output, args.includelist, truth_path=args.truth,
+        num_reads=args.num_reads, num_umis=args.num_umis,
+        umi_length=args.umi_length, read_length=args.read_length,
+        max_errors=args.max_errors, seed=args.seed)
+    log.info("simulate: wrote %d reads to %s (includelist %s)", n,
+             args.output, args.includelist)
+    return 0
 
 
 def cmd_simulate_grouped(args):

@@ -8,7 +8,7 @@ consensus callers, so E2E tests compare pipeline outputs without golden files
 
 import numpy as np
 
-from .constants import CODE_TO_BASE
+from .constants import BASE_TO_CODE, CODE_TO_BASE
 from .io.bam import (BamHeader, BamWriter, FLAG_FIRST, FLAG_LAST,
                      FLAG_MATE_REVERSE, FLAG_PAIRED, FLAG_REVERSE, RecordBuilder)
 import struct
@@ -336,3 +336,209 @@ def simulate_grouped_bam(path: str, num_families: int = 100, family_size: int = 
                     w.write_record_bytes(rec)
                     n_written += 1
     return n_written
+
+
+def _random_umi(rng, length):
+    return CODE_TO_BASE[rng.integers(0, 4, size=length)].tobytes()
+
+
+def _mutate_bases(rng, seq_bytes, error_rate):
+    """Substitute bases at `error_rate` (ACGT only)."""
+    if error_rate <= 0:
+        return seq_bytes
+    codes = BASE_TO_CODE[np.frombuffer(seq_bytes, dtype=np.uint8)].copy()
+    errs = rng.random(len(codes)) < error_rate
+    n_err = int(errs.sum())
+    if n_err:
+        codes[errs] = (codes[errs] + rng.integers(1, 4, n_err)) % 4
+    return CODE_TO_BASE[codes].tobytes()
+
+
+def simulate_fastq_reads(r1_path: str, r2_path: str, truth_path: str = None,
+                         num_families: int = 100, family_size: int = 5,
+                         family_size_distribution: str = "fixed",
+                         read_length: int = 100, umi_length: int = 8,
+                         error_rate: float = 0.0, base_quality: int = 35,
+                         qual_jitter: int = 5, duplex: bool = False,
+                         includelist: str = None, seed: int = 42):
+    """Paired gzip FASTQ with UMI prefixes (simulate fastq-reads analog,
+    /root/reference/src/lib/commands/simulate/fastq_reads.rs:40-99).
+
+    R1 = UMI + template-forward (read structure f"{umi_length}M+T"); R2 =
+    template-reverse-complement (+T), or UMI + body when duplex=True. The
+    truth TSV records family -> UMI(s) and size for validation. Returns the
+    number of read pairs written.
+    """
+    import gzip
+
+    from .constants import reverse_complement_bytes
+
+    rng = np.random.default_rng(seed)
+    whitelist = None
+    if includelist is not None:
+        with open(includelist) as f:
+            whitelist = [line.strip().encode() for line in f if line.strip()]
+        if not whitelist:
+            raise ValueError(f"includelist {includelist!r} contains no UMIs")
+        umi_length = len(whitelist[0])
+
+    def qline(n, umi_prefix=0):
+        q = np.clip(base_quality + rng.integers(-qual_jitter, qual_jitter + 1,
+                                                n), 2, 40)
+        if umi_prefix:
+            q[:umi_prefix] = 37  # UMI bases kept high-quality
+        return (q + 33).astype(np.uint8).tobytes()
+
+    n_pairs = 0
+    truth_f = open(truth_path, "w") if truth_path else None
+    try:
+        if truth_f:
+            truth_f.write("family\tumi\tsize\n")
+        with gzip.open(r1_path, "wb", compresslevel=1) as f1, \
+                gzip.open(r2_path, "wb", compresslevel=1) as f2:
+            for fam in range(num_families):
+                if family_size_distribution == "fixed":
+                    size = family_size
+                else:
+                    size = max(1, int(rng.lognormal(
+                        np.log(max(family_size, 1)), 0.6)))
+                if whitelist:
+                    umi1 = whitelist[int(rng.integers(len(whitelist)))]
+                    umi2 = whitelist[int(rng.integers(len(whitelist)))]
+                else:
+                    umi1 = _random_umi(rng, umi_length)
+                    umi2 = _random_umi(rng, umi_length)
+                insert = int(read_length * 1.8)
+                template = CODE_TO_BASE[rng.integers(0, 4, size=insert)].tobytes()
+                body1 = template[:read_length]
+                body2 = reverse_complement_bytes(template[-read_length:])
+                umi_str = (umi1 + b"-" + umi2).decode() if duplex \
+                    else umi1.decode()
+                if truth_f:
+                    truth_f.write(f"{fam}\t{umi_str}\t{size}\n")
+                for r in range(size):
+                    name = f"fam{fam}:r{r}".encode()
+                    r1_seq = umi1 + _mutate_bases(rng, body1, error_rate)
+                    r2_body = _mutate_bases(rng, body2, error_rate)
+                    r2_seq = (umi2 + r2_body) if duplex else r2_body
+                    f1.write(b"@" + name + b"/1\n" + r1_seq + b"\n+\n"
+                             + qline(len(r1_seq), umi_length) + b"\n")
+                    f2.write(b"@" + name + b"/2\n" + r2_seq + b"\n+\n"
+                             + qline(len(r2_seq),
+                                     umi_length if duplex else 0) + b"\n")
+                    n_pairs += 1
+    finally:
+        if truth_f:
+            truth_f.close()
+    return n_pairs
+
+
+def simulate_consensus_bam(path: str, truth_path: str = None,
+                           num_reads: int = 1000, read_length: int = 150,
+                           min_depth: int = 1, max_depth: int = 10,
+                           depth_mean: float = 5.0, depth_stddev: float = 2.0,
+                           error_rate_mean: float = 0.01,
+                           per_base_tags: bool = True, seed: int = 42,
+                           ref_name: str = "chr1",
+                           ref_length: int = 10_000_000):
+    """Unmapped query-grouped BAM shaped like simplex consensus output
+    (cD/cM/cE + cd/ce per-base tags), the `filter` command's input (simulate
+    consensus-reads analog, consensus_reads.rs:43-90; unmapped like this
+    build's pre-zipper consensus stream). Returns records written."""
+    del ref_name, ref_length  # consensus records are unmapped here
+    rng = np.random.default_rng(seed)
+    header = BamHeader(
+        text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n"
+             "@RG\tID:A\tSM:sample\tLB:lib\n",
+        ref_names=[], ref_lengths=[])
+    truth_f = open(truth_path, "w") if truth_path else None
+    n = 0
+    try:
+        if truth_f:
+            truth_f.write("name\tdepth\terror_rate\n")
+        with BamWriter(path, header) as w:
+            for i in range(num_reads):
+                depth = int(np.clip(round(rng.normal(depth_mean, depth_stddev)),
+                                    min_depth, max_depth))
+                err = float(np.clip(rng.exponential(error_rate_mean), 0, 0.5))
+                seq = CODE_TO_BASE[rng.integers(0, 4, size=read_length)].tobytes()
+                quals = np.clip(rng.integers(25, 60, size=read_length), 2,
+                                93).astype(np.uint8)
+                name = f"fgumi:{i}".encode()
+                per_base = np.maximum(
+                    depth - (rng.random(read_length) < 0.2), 1).astype(np.int16)
+                errors = (rng.random(read_length) < err).astype(np.int16)
+                b = RecordBuilder().start_unmapped(name, 0x4, seq, quals)
+                b.tag_str(b"RG", b"A")
+                b.tag_str(b"MI", str(i).encode())
+                b.tag_str(b"RX", _random_umi(rng, 8))
+                b.tag_int(b"cD", depth)
+                b.tag_int(b"cM", int(per_base.min()))
+                b.tag_float(b"cE", err)
+                if per_base_tags:
+                    b.tag_array_i16(b"cd", per_base)
+                    b.tag_array_i16(b"ce", errors)
+                w.write_record_bytes(b.finish())
+                n += 1
+                if truth_f:
+                    truth_f.write(f"{name.decode()}\t{depth}\t{err:.6f}\n")
+    finally:
+        if truth_f:
+            truth_f.close()
+    return n
+
+
+def simulate_correct_reads(path: str, includelist_path: str,
+                           truth_path: str = None, num_reads: int = 10000,
+                           num_umis: int = 1000, umi_length: int = 8,
+                           read_length: int = 100, max_errors: int = 2,
+                           base_quality: int = 35, seed: int = 42):
+    """Unmapped BAM with RX UMIs drawn from a generated includelist, plus the
+    includelist file and a truth TSV (simulate correct-reads analog,
+    correct_reads.rs:36-76). Returns records written."""
+    rng = np.random.default_rng(seed)
+    umis = set()
+    while len(umis) < num_umis:
+        umis.add(_random_umi(rng, umi_length))
+    whitelist = sorted(umis)
+    with open(includelist_path, "w") as f:
+        for u in whitelist:
+            f.write(u.decode() + "\n")
+    header = BamHeader(text="@HD\tVN:1.6\tSO:unsorted\n"
+                            "@RG\tID:A\tSM:sample\tLB:lib\n",
+                       ref_names=[], ref_lengths=[])
+    truth_f = open(truth_path, "w") if truth_path else None
+    try:
+        if truth_f:
+            truth_f.write("name\ttrue_umi\tobserved_umi\terrors\n")
+        with BamWriter(path, header) as w:
+            for i in range(num_reads):
+                true_umi = whitelist[int(rng.integers(len(whitelist)))]
+                n_err = int(rng.integers(0, min(max_errors,
+                                                umi_length) + 1))
+                if n_err:
+                    # exact error count at random positions
+                    codes = BASE_TO_CODE[
+                        np.frombuffer(true_umi, np.uint8)].copy()
+                    pos = rng.choice(umi_length, size=n_err, replace=False)
+                    codes[pos] = (codes[pos] + rng.integers(1, 4, n_err)) % 4
+                    observed = CODE_TO_BASE[codes].tobytes()
+                else:
+                    observed = true_umi
+                seq = CODE_TO_BASE[
+                    rng.integers(0, 4, size=read_length)].tobytes()
+                quals = np.clip(base_quality + rng.integers(-5, 6,
+                                                            read_length),
+                                2, 40)
+                b = RecordBuilder().start_unmapped(
+                    f"r{i}".encode(), 0x4, seq, quals.astype(np.uint8))
+                b.tag_str(b"RG", b"A")
+                b.tag_str(b"RX", observed)
+                w.write_record_bytes(b.finish())
+                if truth_f:
+                    truth_f.write(f"r{i}\t{true_umi.decode()}\t"
+                                  f"{observed.decode()}\t{n_err}\n")
+    finally:
+        if truth_f:
+            truth_f.close()
+    return num_reads

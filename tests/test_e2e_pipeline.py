@@ -1,0 +1,151 @@
+"""Full best-practice pipeline E2E: fastq-reads -> extract -> group ->
+simplex -> filter, with double-run determinism via `compare bams`.
+
+Mirrors the reference's golden-file-free E2E regression strategy
+(/root/reference/tests/integration/test_e2e_regression.rs:1-27): seeded
+simulate drives the whole pipeline, determinism is asserted by running twice
+and comparing, correctness by checking outputs against the simulate truth TSV
+(BASELINE.md config 5 analog)."""
+
+import csv
+import gzip
+
+import pytest
+
+from fgumi_tpu.cli import main as cli_main
+from fgumi_tpu.io.bam import BamReader
+
+
+@pytest.fixture(scope="module")
+def fastq_inputs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("e2e_fq")
+    r1, r2 = str(d / "r1.fq.gz"), str(d / "r2.fq.gz")
+    truth = str(d / "truth.tsv")
+    rc = cli_main(["simulate", "fastq-reads", "-1", r1, "-2", r2,
+                   "--truth", truth, "--num-families", "60",
+                   "--family-size", "4", "--read-length", "80",
+                   "--error-rate", "0.005", "--seed", "31"])
+    assert rc == 0
+    return r1, r2, truth
+
+
+def run_pipeline(r1, r2, outdir, tag):
+    unmapped = str(outdir / f"unmapped_{tag}.bam")
+    grouped = str(outdir / f"grouped_{tag}.bam")
+    cons = str(outdir / f"cons_{tag}.bam")
+    filt = str(outdir / f"filt_{tag}.bam")
+    assert cli_main(["extract", "-i", r1, r2, "-r", "8M+T", "+T",
+                     "--sample", "s", "--library", "l",
+                     "-o", unmapped]) == 0
+    assert cli_main(["group", "-i", unmapped, "-o", grouped,
+                     "--allow-unmapped", "--strategy", "adjacency"]) == 0
+    assert cli_main(["simplex", "-i", grouped, "-o", cons,
+                     "--allow-unmapped", "--min-reads", "1"]) == 0
+    assert cli_main(["filter", "-i", cons, "-o", filt, "-M", "1"]) == 0
+    return unmapped, grouped, cons, filt
+
+
+def test_full_pipeline_deterministic(fastq_inputs, tmp_path):
+    r1, r2, _ = fastq_inputs
+    out1 = run_pipeline(r1, r2, tmp_path, "a")
+    out2 = run_pipeline(r1, r2, tmp_path, "b")
+    for a, b in zip(out1, out2):
+        assert cli_main(["compare", "bams", "-a", a, "-b", b]) == 0, \
+            f"{a} vs {b} differ between identical runs"
+
+
+def test_pipeline_matches_truth(fastq_inputs, tmp_path):
+    r1, r2, truth = fastq_inputs
+    _, grouped, cons, filt = run_pipeline(r1, r2, tmp_path, "t")
+    with open(truth) as f:
+        families = list(csv.DictReader(f, delimiter="\t"))
+    # error-free UMIs: adjacency grouping must recover exactly the simulated
+    # families -> one R1 + one R2 consensus each
+    with BamReader(cons) as r:
+        recs = list(r)
+    assert len(recs) == 2 * len(families)
+    # consensus depth == family size for every family (MI minted in order of
+    # first appearance; map via RX = true UMI)
+    by_umi = {f["umi"]: int(f["size"]) for f in families}
+    for rec in recs:
+        rx = rec.get_str(b"RX")
+        assert rx in by_umi
+        assert rec.get_int(b"cD") == by_umi[rx]
+        assert rec.get_int(b"cM") == by_umi[rx]
+    # filter with -M 1 keeps everything here
+    with BamReader(filt) as r:
+        assert sum(1 for _ in r) == len(recs)
+
+
+def test_extract_reads_expected_structure(fastq_inputs, tmp_path):
+    r1, r2, truth = fastq_inputs
+    unmapped = str(tmp_path / "u.bam")
+    assert cli_main(["extract", "-i", r1, r2, "-r", "8M+T", "+T",
+                     "--sample", "s", "--library", "l", "-o", unmapped]) == 0
+    with open(truth) as f:
+        families = {f_["family"]: f_ for f_ in
+                    csv.DictReader(f, delimiter="\t")}
+    n_pairs = sum(int(f["size"]) for f in families.values())
+    with BamReader(unmapped) as r:
+        recs = list(r)
+    assert len(recs) == 2 * n_pairs
+    # RX carries the 8bp UMI; template bases lose the prefix
+    rec = recs[0]
+    fam = rec.name.decode().split(":")[0].removeprefix("fam")
+    assert rec.get_str(b"RX") == families[fam]["umi"]
+    assert rec.l_seq == 80
+
+
+def test_correct_reads_roundtrip(tmp_path):
+    """simulate correct-reads -> correct: known-truth UMIs are recovered."""
+    bam = str(tmp_path / "cr.bam")
+    wl = str(tmp_path / "wl.txt")
+    truth = str(tmp_path / "cr_truth.tsv")
+    assert cli_main(["simulate", "correct-reads", "-o", bam, "-i", wl,
+                     "--truth", truth, "-n", "400", "--num-umis", "40",
+                     "--max-errors", "1", "--seed", "5"]) == 0
+    out = str(tmp_path / "corrected.bam")
+    assert cli_main(["correct", "-i", bam, "-o", out, "-U", wl]) == 0
+    with open(truth) as f:
+        rows = {r["name"]: r for r in csv.DictReader(f, delimiter="\t")}
+    ok = total = 0
+    with BamReader(out) as r:
+        for rec in r:
+            row = rows[rec.name.decode()]
+            total += 1
+            if rec.get_str(b"RX") == row["true_umi"]:
+                ok += 1
+    assert total > 350  # near-everything correctable at <=1 error
+    assert ok / total > 0.95
+
+
+def test_consensus_reads_filterable(tmp_path):
+    """simulate consensus-reads -> filter: depth threshold drops low families."""
+    bam = str(tmp_path / "consin.bam")
+    truth = str(tmp_path / "ct.tsv")
+    assert cli_main(["simulate", "consensus-reads", "-o", bam, "--truth",
+                     truth, "-n", "300", "--min-depth", "1",
+                     "--max-depth", "9", "--seed", "8"]) == 0
+    out = str(tmp_path / "consout.bam")
+    assert cli_main(["filter", "-i", bam, "-o", out, "-M", "3",
+                     "--filter-by-template", "false"]) == 0
+    with open(truth) as f:
+        rows = {r["name"]: int(r["depth"]) for r in
+                csv.DictReader(f, delimiter="\t")}
+    with BamReader(out) as r:
+        kept = [rec.name.decode() for rec in r]
+    assert kept, "filter dropped everything"
+    assert all(rows[n] >= 3 for n in kept)
+
+
+def test_fastq_reads_duplex_mode(tmp_path):
+    r1, r2 = str(tmp_path / "d1.fq.gz"), str(tmp_path / "d2.fq.gz")
+    assert cli_main(["simulate", "fastq-reads", "-1", r1, "-2", r2,
+                     "--num-families", "10", "--family-size", "3",
+                     "--duplex", "--seed", "3"]) == 0
+    with gzip.open(r1, "rb") as f:
+        lines1 = f.read().split(b"\n")
+    with gzip.open(r2, "rb") as f:
+        lines2 = f.read().split(b"\n")
+    # both reads carry an 8bp UMI prefix + 100bp body
+    assert len(lines1[1]) == 108 and len(lines2[1]) == 108

@@ -69,12 +69,55 @@ _LN_4_3_F32 = np.float32(np.log(4.0 / 3.0))
 _EPS32 = np.float32(np.finfo(np.float32).eps)
 _PHRED_PER_LN = np.float32(10.0 / np.log(10.0))
 
-# Conservative multipliers for the suspect guard band; calibrated by
-# tests/test_kernel_parity.py (which asserts zero integer mismatches after host
-# fallback AND a bounded fallback rate).
-_GUARD_C_SCALE = 16.0  # multiplier on eps32 * max(C) for the gap error estimate
-_QUAL_GUARD_FLOOR = 3e-4  # minimum guard band in Phred units (< the 1e-3 precision nudge)
-_TIE_GUARD_FLOOR = 1e-5  # minimum winner-margin guard in ln units
+# ---------------------------------------------------------------------------
+# Suspect guard band — derivation (the analog of the reference's fast-path
+# margin proof, base_builder.rs:186-301).
+#
+# Sources of f32 error in a lane contribution C[b] = sum over matching
+# observations of delta[q] (delta = ln_correct - ln_err >= 0, from tables
+# computed in f64 and rounded once to f32):
+#
+#   (1) table rounding:  |fl(delta) - delta| <= eps32/2 * delta per term;
+#   (2) accumulation:    summing n nonnegative terms in ANY order (XLA may
+#       reduce sequentially or as a tree) has error <= eps32 * n * sum(x_i)
+#       to first order, since every partial sum is <= the final sum for
+#       nonnegative terms. sum(x_i) = C[b] <= max_c.
+#
+# A position's lane has at most `depth` matching observations, so
+#   |C_err| <= eps32 * (depth + 1) * max_c.
+# The gap g = max_c - C[b] adds one subtraction (a half-ulp of max_c) and is
+# computed from two such sums, giving the per-gap bound used below:
+#   |g_err| <= eps_gap = eps32 * (depth + 2) * (1 + max_c),
+# where the "+1" inside the parenthesis covers max_c < 1 (absolute floor).
+# This is depth-aware on purpose: a fixed multiplier is unsound for deep
+# families (n grows) and wastefully wide for shallow ones.
+#
+# Downstream of the gaps:
+#   s = sum over losing lanes of exp(-g): |ds| <= s * eps_gap + O(eps32)*s
+#       (exp is 1-ulp; d exp(-g) = exp(-g) |dg|);
+#   ln_cons_err = ln(s) - log1p(s): |d| <= |ds|/s + |ds|/(1+s) + 2 ulp
+#       <= 2 * eps_gap + O(eps32).
+# So the Phred-scale error is  err_phred <= PHRED_PER_LN * 2 * eps_gap plus
+# a handful of 1-ulp function evaluations; PHRED_PER_LN * 5 * eps32 ~ 2.6e-6,
+# absorbed by _QUAL_GUARD_FLOOR = 3e-4 (kept < the 0.001 fgbio precision
+# nudge so the floor can never mask the intended rounding offset).
+#
+# Guard gates (any triggers the exact f64 host recompute):
+#   tie:      winner margin <= 2 * eps_gap + _TIE_GUARD_FLOOR  (the margin is
+#             a difference of two gap-accurate quantities; the floor covers
+#             exact-tie ulp jitter);
+#   quality:  distance of phred_f to the nearest integer boundary <=
+#             err_phred + _QUAL_GUARD_FLOOR;
+#   branch:   |diff - 6| within the gap error of the f32/f64 quick-path
+#             disagreement region of the two-trials combination;
+#   NaN:      any non-finite contribution (Q0 -inf table entries).
+#
+# tests/test_kernel_parity.py + the adversarial edge sweep in
+# tests/test_guard_band.py assert the safety property this analysis promises:
+# no non-suspect position ever disagrees with the f64 oracle.
+# ---------------------------------------------------------------------------
+_QUAL_GUARD_FLOOR = 3e-4  # Phred units; absorbs O(eps32) evaluation error
+_TIE_GUARD_FLOOR = 1e-5  # ln units; exact-tie ulp jitter
 
 
 def _observation_terms(codes, quals, correct_tab, err_tab):
@@ -100,7 +143,11 @@ def _reduce_contributions(codes, quals, correct_tab, err_tab):
     obs (..., L, 4) int32. N/pad codes contribute nothing (base_builder.rs:616-619).
     """
     one_hot, delta = _observation_terms(codes, quals, correct_tab, err_tab)
-    contrib = jnp.einsum("...rl,...rlb->...lb", delta, one_hot)
+    # HIGHEST precision: the guard-band derivation assumes true f32 products;
+    # TPU MXU default precision multiplies in bf16 (~2e-3 relative), which
+    # would blow straight through an eps32-scale band undetected.
+    contrib = jnp.einsum("...rl,...rlb->...lb", delta, one_hot,
+                         precision=jax.lax.Precision.HIGHEST)
     obs = jnp.sum(one_hot, axis=-3).astype(jnp.int32)  # (..., L, 4)
     return contrib, obs
 
@@ -145,8 +192,8 @@ def _call_epilogue(contrib, obs, ln_error_pre_umi):
     phred_f = -ln_final * _PHRED_PER_LN + 0.001
     qual = jnp.clip(jnp.floor(phred_f), MIN_PHRED, MAX_PHRED).astype(jnp.int32)
 
-    # ---- suspect guard band ----
-    eps_gap = _GUARD_C_SCALE * _EPS32 * (1.0 + max_c)
+    # ---- suspect guard band (derivation in the module-level comment) ----
+    eps_gap = _EPS32 * (depth.astype(jnp.float32) + 2.0) * (1.0 + max_c)
     # winner margin: distance between best and second-best lane contribution
     second = jnp.max(jnp.where(lane_is_winner, -jnp.inf, contrib), axis=-1)
     margin = max_c - second

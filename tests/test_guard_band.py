@@ -1,0 +1,163 @@
+"""Adversarial sweep of the f32 suspect guard band (VERDICT r1 item 7).
+
+The safety property promised by the derivation in ops/kernel.py: a position
+the device does NOT flag suspect always matches the f64 oracle's integer
+(winner, qual) exactly. These tests *search* for violations near the band
+edges instead of sampling blindly: constructed near-ties, mined
+near-Phred-boundary positions, and depth extremes where a fixed guard
+multiplier would be unsound.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from fgumi_tpu.ops import oracle
+from fgumi_tpu.ops.kernel import ConsensusKernel, _unpack_device_result
+from fgumi_tpu.ops.tables import quality_tables
+
+TABLES = quality_tables(45, 40)
+
+
+def raw_device(kernel, codes, quals):
+    """Raw device results WITHOUT host fallback: (winner, qual, suspect)."""
+    packed = jax.device_get(kernel.device_call_packed(codes, quals))
+    return _unpack_device_result(packed)
+
+
+def assert_safety(kernel, codes, quals):
+    """Every non-suspect position must equal the oracle exactly."""
+    winner, qual, suspect = raw_device(kernel, codes, quals)
+    bad = []
+    for f in range(codes.shape[0]):
+        ow, oq, _, _ = oracle.call_family(codes[f], quals[f], kernel.tables)
+        ok = suspect[f]
+        mism = (~ok) & ((winner[f] != ow) | (qual[f] != oq))
+        if mism.any():
+            bad.append((f, np.nonzero(mism)[0][:5], winner[f][mism][:5],
+                        ow[mism][:5], qual[f][mism][:5], oq[mism][:5]))
+    assert not bad, f"non-suspect positions diverged from oracle: {bad[:3]}"
+    return suspect
+
+
+def test_near_ties_across_depths():
+    """Half/half split votes with tiny qual imbalances: margins near zero at
+    every depth, including depths where a fixed 16x guard would be too thin."""
+    rng = np.random.default_rng(0)
+    fams_codes, fams_quals = [], []
+    for R in (2, 4, 16, 64, 256):
+        for _ in range(20):
+            L = 16
+            codes = np.zeros((R, L), dtype=np.uint8)
+            codes[R // 2:] = 1  # half A, half C
+            quals = np.full((R, L), 30, dtype=np.uint8)
+            # jitter one or two observations by +-1..2 quals: near-tie margins
+            for _ in range(int(rng.integers(0, 3))):
+                r = int(rng.integers(R))
+                quals[r] = np.clip(
+                    30 + rng.integers(-2, 3, size=L), 2, 93)
+            pad = np.full((256 - R, L), 4, dtype=np.uint8)
+            fams_codes.append(np.concatenate([codes, pad]))
+            fams_quals.append(np.concatenate(
+                [quals, np.zeros((256 - R, L), np.uint8)]))
+    kernel = ConsensusKernel(TABLES)
+    codes = np.stack(fams_codes)
+    quals = np.stack(fams_quals)
+    suspect = assert_safety(kernel, codes, quals)
+    # ties must actually be flagged (sanity that the search hits the band)
+    assert suspect.any()
+
+
+def test_mined_phred_boundary_positions():
+    """Mine random families whose oracle Phred fraction lands within 2e-3 of
+    an integer boundary, then assert the device flags or matches them."""
+    rng = np.random.default_rng(1)
+    kernel = ConsensusKernel(TABLES)
+    mined_c, mined_q = [], []
+    for _ in range(30):
+        R = int(rng.integers(2, 12))
+        L = 64
+        truth = rng.integers(0, 4, size=(1, L))
+        codes = np.broadcast_to(truth, (R, L)).copy()
+        errs = rng.random((R, L)) < 0.15
+        codes[errs] = rng.integers(0, 4, size=int(errs.sum()))
+        quals = rng.integers(5, 45, size=(R, L)).astype(np.uint8)
+        codes = codes.astype(np.uint8)
+        # oracle fractions: keep families containing near-boundary positions
+        _, _, _, _ = oracle.call_family(codes, quals, TABLES)
+        frac = _oracle_phred_fracs(codes, quals)
+        if np.any(np.minimum(frac, 1 - frac) < 2e-3):
+            mined_c.append(codes)
+            mined_q.append(quals)
+    if not mined_c:
+        pytest.skip("mining found no near-boundary families (rare)")
+    R_max = max(c.shape[0] for c in mined_c)
+    F = len(mined_c)
+    codes = np.full((F, R_max, 64), 4, dtype=np.uint8)
+    quals = np.zeros((F, R_max, 64), dtype=np.uint8)
+    for i, (c, q) in enumerate(zip(mined_c, mined_q)):
+        codes[i, :c.shape[0]] = c
+        quals[i, :q.shape[0]] = q
+    assert_safety(kernel, codes, quals)
+
+
+def _oracle_phred_fracs(codes, quals):
+    """Unclamped oracle Phred values' fractional parts per position."""
+    from fgumi_tpu.ops import phred as ph
+
+    L = codes.shape[1]
+    fracs = np.ones(L)
+    for pos in range(L):
+        obs_c = codes[:, pos]
+        obs_q = quals[:, pos]
+        valid = obs_c != 4
+        if not valid.any():
+            continue
+        ll = np.zeros(4)
+        for b in range(4):
+            match = TABLES.adjusted_correct[np.minimum(obs_q[valid], 93)]
+            err = TABLES.adjusted_error_per_alt[np.minimum(obs_q[valid], 93)]
+            ll[b] = np.sum(np.where(obs_c[valid] == b, match, err))
+        order = np.sort(ll)[::-1]
+        s = np.sum(np.exp(order[1:] - order[0]))
+        if s <= 0:
+            continue
+        ln_err = np.log(s) - np.log1p(s)
+        combined = ph.ln_error_prob_two_trials(TABLES.ln_error_pre_umi, ln_err)
+        val = -combined * 10 / np.log(10) + 0.001
+        if np.isfinite(val):
+            fracs[pos] = min(val - np.floor(val), fracs[pos])
+    return fracs
+
+
+def test_deep_family_guard_scales():
+    """Depth-600 mixed pileups: the depth-aware band must stay safe where a
+    fixed multiplier (16x eps) would understate the accumulation error."""
+    rng = np.random.default_rng(2)
+    kernel = ConsensusKernel(TABLES)
+    R, L = 600, 16
+    fams = []
+    for frac_err in (0.0, 0.05, 0.3, 0.45, 0.49):
+        truth = rng.integers(0, 4, size=(1, L))
+        codes = np.broadcast_to(truth, (R, L)).copy()
+        errs = rng.random((R, L)) < frac_err
+        codes[errs] = (codes[errs] + 1) % 4  # systematic second allele
+        fams.append(codes.astype(np.uint8))
+    codes = np.stack(fams)
+    quals = rng.integers(8, 41, size=codes.shape).astype(np.uint8)
+    assert_safety(kernel, codes, quals)
+
+
+def test_fallback_rate_stays_bounded():
+    """The widened-by-depth band must not blow up the fallback rate on a
+    realistic workload (the perf contract of the suspect-mask design)."""
+    rng = np.random.default_rng(3)
+    kernel = ConsensusKernel(TABLES)
+    truth = rng.integers(0, 4, size=(512, 1, 64))
+    codes = np.broadcast_to(truth, (512, 5, 64)).copy()
+    errs = rng.random(codes.shape) < 0.01
+    codes[errs] = rng.integers(0, 4, size=int(errs.sum()))
+    quals = rng.integers(20, 41, size=codes.shape).astype(np.uint8)
+    _, _, suspect = raw_device(kernel, codes.astype(np.uint8), quals)
+    rate = suspect.mean()
+    assert rate < 0.01, f"fallback rate {rate:.4%} exceeds 1%"

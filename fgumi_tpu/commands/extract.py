@@ -12,6 +12,8 @@ pooling the heads of all inputs (extract.rs:210-338).
 import re
 from dataclasses import dataclass, field
 
+import numpy as np
+
 from ..core.read_structure import ReadStructure, TEMPLATE
 from ..io.bam import FLAG_FIRST, FLAG_LAST, FLAG_MATE_UNMAPPED, FLAG_PAIRED, \
     FLAG_UNMAPPED, BamHeader, BamWriter, RecordBuilder
@@ -245,7 +247,8 @@ class Extractor:
                 # saturating subtract (to_standard_numeric, extract.rs:256-261):
                 # a sub-offset byte past the detection sample clamps to Q0.
                 off = self.qual_offset
-                numeric = bytearray(q - off if q >= off else 0 for q in quals)
+                qarr = np.frombuffer(quals, dtype=np.uint8)
+                numeric = np.where(qarr >= off, qarr - off, 0).astype(np.uint8)
             else:
                 # empty template segment -> single N @ Q2 (extract.rs:947-948)
                 seq, numeric = b"N", bytearray([2])
@@ -268,6 +271,122 @@ class Extractor:
             yield b.finish()
 
 
+_SEG_KIND_CODE = {TEMPLATE: 0, "M": 1, "S": 2}
+
+
+def _fast_extract_ok(structures, opts) -> bool:
+    """The native batch path covers the common option surface: T/M/S segments
+    with any '+' only in last position, and none of the exotic output options
+    (cell/sample barcodes, single-tag, name annotation, read-name UMIs)."""
+    from ..native import batch as nb
+
+    if not nb.available():
+        return False
+    if (opts.extract_umis_from_read_names or opts.annotate_read_names
+            or opts.single_tag):
+        return False
+    for rs in structures:
+        # every structure must END with a '+' segment: a fully-fixed
+        # structure errors on over-long reads in the Python path, which the
+        # native walk cannot reproduce
+        if rs.segments[-1].length is not None:
+            return False
+        for i, seg in enumerate(rs.segments):
+            if seg.kind not in _SEG_KIND_CODE:
+                return False
+            if seg.length is None and i != len(rs.segments) - 1:
+                return False
+            # UMI segments must be fixed-length (bounded native join buffer)
+            if seg.kind == "M" and seg.length is None:
+                return False
+    umi_total = sum((seg.length or 0) + 1 for rs in structures
+                    for seg in rs.segments if seg.kind == "M")
+    return umi_total < 1000  # native join buffer is 1024 bytes
+
+
+def _run_extract_fast(inputs, output, structures, opts, offset, header):
+    """Batched native extraction (fgumi_extract_records): vectorized FASTQ
+    lexing + C record assembly, byte-identical to make_records on the
+    supported option surface (tests/test_extract_fast.py)."""
+    from ..io.fastq import FastqBatchReader
+    from ..native import batch as nb
+
+    segments = []
+    for k, rs in enumerate(structures):
+        for seg in rs.segments:
+            segments.append((k, _SEG_KIND_CODE[seg.kind],
+                             -1 if seg.length is None else seg.length))
+    rg = opts.read_group_id.encode()
+
+    n_records = 0
+    n_sets = 0
+    readers = [FastqBatchReader(p) for p in inputs]
+    try:
+        with BamWriter(output, header) as writer:
+            iters = [iter(r) for r in readers]
+            cur = [None] * len(readers)  # (arrays tuple, consumed)
+            while True:
+                for i, it in enumerate(iters):
+                    if cur[i] is None or cur[i][1] >= len(cur[i][0][1]):
+                        nxt = next(it, None)
+                        cur[i] = (nxt, 0) if nxt is not None else None
+                if all(c is None for c in cur):
+                    break
+                if any(c is None for c in cur):
+                    short = [inputs[i] for i, c in enumerate(cur) if c is None]
+                    raise ExtractError(
+                        f"FASTQ inputs have differing record counts; "
+                        f"{short} ended early")
+                take = min(len(c[0][1]) - c[1] for c in cur)
+                bufs = []
+                name_off = []
+                name_len = []
+                seq_off = []
+                seq_len = []
+                qual_off = []
+                for i, (batch, pos) in enumerate(cur):
+                    buf, no, nl, so, sl, qo = batch
+                    bufs.append(buf)
+                    name_off.append(no[pos:pos + take])
+                    name_len.append(nl[pos:pos + take])
+                    seq_off.append(so[pos:pos + take])
+                    seq_len.append(sl[pos:pos + take])
+                    qual_off.append(qo[pos:pos + take])
+                    cur[i] = (batch, pos + take)
+                try:
+                    blob = nb.extract_records(
+                        bufs, np.stack(name_off), np.stack(name_len),
+                        np.stack(seq_off), np.stack(seq_len),
+                        np.stack(qual_off), segments, offset, rg,
+                        opts.store_umi_quals)
+                except nb.NativeExtractError as e:
+                    # canonical error path: rebuild the offending record as
+                    # FastqReads and let make_records raise its ExtractError
+                    from ..io.fastq import FastqRead
+
+                    r = e.record_index
+                    reads = []
+                    for i, buf in enumerate(bufs):
+                        bb = buf.tobytes()
+                        reads.append(FastqRead(
+                            bb[name_off[i][r]:name_off[i][r] + name_len[i][r]],
+                            bb[seq_off[i][r]:seq_off[i][r] + seq_len[i][r]],
+                            bb[qual_off[i][r]:qual_off[i][r] + seq_len[i][r]]))
+                    extractor = Extractor(structures, opts, offset)
+                    list(extractor.make_records(reads))
+                    raise ExtractError(str(e))  # native-only failure
+                writer.write_serialized(blob)
+                n_sets += take
+    finally:
+        for r in readers:
+            r.close()
+    # records per set = number of template segments (prefix counting is
+    # wrong for arbitrary blobs; each set emits exactly n_template records)
+    n_templates = sum(1 for s in segments if s[1] == 0)
+    n_records = n_sets * n_templates
+    return n_records, n_sets
+
+
 def run_extract(inputs, output, opts: ExtractOptions):
     """Full extract: detect encoding, zip FASTQs, write unmapped BAM.
 
@@ -288,6 +407,10 @@ def run_extract(inputs, output, opts: ExtractOptions):
     offset = detect_quality_encoding(inputs)
     extractor = Extractor(structures, opts, offset)
     header = build_header(opts)
+
+    if _fast_extract_ok(structures, opts):
+        return _run_extract_fast(inputs, output, structures, opts, offset,
+                                 header)
 
     n_records = 0
     n_sets = 0

@@ -107,6 +107,117 @@ class FastqReader:
         self.close()
 
 
+class FastqBatchReader:
+    """Batched FASTQ reading: numpy newline scan -> per-record offset arrays.
+
+    The fast-lexer analog of the reference's SIMD FASTQ front-end
+    (crates/fgumi-simd-fastq/src/lib.rs:1-13): decompressed chunks are scanned
+    for line boundaries in one vectorized pass, and each batch exposes
+    (buf, name_off, name_len, seq_off, seq_len, qual_off) arrays that the
+    native record assembler consumes without per-record Python.
+
+    Yields one batch per decompressed chunk; a trailing partial record
+    carries into the next chunk. Blank lines at record boundaries are
+    skipped, matching FastqReader's header-position blank handling.
+    """
+
+    def __init__(self, path: str, chunk_size: int = 8 << 20):
+        import numpy as np
+
+        self._np = np
+        self._stream = _open_stream(path)
+        self._path = path
+        self._chunk = chunk_size
+        self._tail = b""
+        self._done = False
+
+    def __iter__(self):
+        np = self._np
+        while True:
+            raw = self._stream.read(self._chunk) if not self._done else b""
+            if not raw:
+                self._done = True
+                if not self._tail:
+                    return
+                data = self._tail
+                if not data.endswith(b"\n"):
+                    data += b"\n"  # final unterminated line
+                self._tail = b""
+            else:
+                data = self._tail + raw
+            buf = np.frombuffer(data, dtype=np.uint8)
+            nl = np.flatnonzero(buf == 10)
+            all_start = np.empty(len(nl), dtype=np.int64)
+            if len(nl):
+                all_start[0] = 0
+                all_start[1:] = nl[:-1] + 1
+            all_end = nl.astype(np.int64)
+            all_end = all_end - (buf[np.maximum(all_end - 1, 0)] == 13)
+            empty = all_end <= all_start
+            if empty.any():
+                # rare path: skip blank lines occurring at record boundaries
+                # (FastqReader skips blanks at the header position)
+                keep = []
+                for i in range(len(nl)):
+                    if empty[i] and len(keep) % 4 == 0:
+                        continue
+                    keep.append(i)
+                keep = np.asarray(keep, dtype=np.int64)
+            else:
+                keep = None
+            n_lines = len(nl) if keep is None else len(keep)
+            n_rec = n_lines // 4
+            if n_rec == 0:
+                if self._done and data.strip():
+                    raise ValueError(
+                        f"{self._path}: truncated FASTQ record at EOF")
+                self._tail = data
+                if self._done:
+                    return
+                continue
+            if keep is None:
+                used = int(nl[4 * n_rec - 1]) + 1
+                line_start = all_start[:4 * n_rec]
+                line_end = all_end[:4 * n_rec]
+            else:
+                last = int(keep[4 * n_rec - 1])
+                used = int(nl[last]) + 1
+                line_start = all_start[keep[:4 * n_rec]]
+                line_end = all_end[keep[:4 * n_rec]]
+            self._tail = data[used:]
+            name_off = line_start[0::4] + 1  # past '@'
+            name_len = (line_end[0::4] - name_off).astype(np.int32)
+            seq_off = line_start[1::4]
+            seq_len = (line_end[1::4] - seq_off).astype(np.int32)
+            qual_off = line_start[3::4]
+            qual_len = (line_end[3::4] - qual_off).astype(np.int32)
+            # structural validation (cheap, vectorized)
+            if not (buf[line_start[0::4]] == ord("@")).all():
+                raise ValueError(f"{self._path}: FASTQ header must start "
+                                 "with '@'")
+            if not (buf[line_start[2::4]] == ord("+")).all():
+                raise ValueError(f"{self._path}: FASTQ separator must start "
+                                 "with '+'")
+            if not (seq_len == qual_len).all():
+                bad = int(np.nonzero(seq_len != qual_len)[0][0])
+                raise ValueError(f"{self._path}: sequence/quality length "
+                                 f"mismatch at batch record {bad}")
+            yield buf, name_off, name_len, seq_off, seq_len, qual_off
+            if self._done and not self._tail:
+                return
+
+    def close(self):
+        close = getattr(self._stream, "close", None)
+        if close:
+            close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 def strip_read_suffix(name: bytes) -> bytes:
     """Strip a trailing space comment and an old-style ``/1``/``/2`` suffix.
 

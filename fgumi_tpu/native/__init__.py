@@ -97,6 +97,11 @@ def get_lib():
         lib.fgumi_build_consensus_records.argtypes = (
             [p] * 6 + [ctypes.c_long, p, ctypes.c_int, p, p, p, p, p, p, p,
                        ctypes.c_int, ctypes.c_int, p, ctypes.c_long, p])
+        lib.fgumi_extract_records.restype = ctypes.c_long
+        lib.fgumi_extract_records.argtypes = (
+            [ctypes.c_long, ctypes.c_long] + [p] * 6 + [ctypes.c_long]
+            + [p] * 3 + [ctypes.c_int, p, ctypes.c_int, ctypes.c_int, p,
+                         ctypes.c_long, p])
         _lib = lib
         log.debug("native library loaded from %s", _SO_PATH)
         return _lib

@@ -193,3 +193,62 @@ def overlap_correct_pairs(buf: np.ndarray, r1_off: np.ndarray,
                                     len(r1_off), agreement, disagreement,
                                     _addr(stats))
     return stats
+
+
+def extract_records(bufs, name_off, name_len, seq_off, seq_len, qual_off,
+                    segments, qual_offset: int, rg: bytes,
+                    store_umi_quals: bool):
+    """Batched FASTQ -> unmapped-BAM record assembly (fgumi_extract_records).
+
+    bufs: list of per-input uint8 chunk buffers; the offset/len arrays are
+    (n_inputs, n) int64/int32; segments: flattened [(input, kind, len)] with
+    kind 0=template 1=UMI 2=skip and len -1 = rest-of-read.
+    Returns the block_size-prefixed wire blob (bytes).
+    """
+    lib = get_lib()
+    n_inputs = len(bufs)
+    n = name_off.shape[1]
+    buf_addr = np.array([b.ctypes.data for b in bufs], dtype=np.int64)
+    name_off = np.ascontiguousarray(name_off, np.int64)
+    name_len = np.ascontiguousarray(name_len, np.int32)
+    seq_off = np.ascontiguousarray(seq_off, np.int64)
+    seq_len = np.ascontiguousarray(seq_len, np.int32)
+    qual_off = np.ascontiguousarray(qual_off, np.int64)
+    seg_input = np.array([s[0] for s in segments], dtype=np.int32)
+    seg_kind = np.array([s[1] for s in segments], dtype=np.int32)
+    seg_len = np.array([s[2] for s in segments], dtype=np.int32)
+    # capacity: every read byte appears at most twice (packed seq + quals,
+    # UMI segments again in RX+QX), plus per emitted record header+name+tags
+    n_templates = max(1, int((seg_kind == 0).sum()))
+    max_name = int(name_len.max()) if n else 0
+    # packed seq + quals appear once per read byte; the joined UMI (fixed M
+    # segments only on this path, _fast_extract_ok) repeats in every emitted
+    # record's RX and QX
+    umi_total = int(seg_len[seg_kind == 1].sum()) + int((seg_kind == 1).sum())
+    out_cap = (int(2 * seq_len.astype(np.int64).sum())
+               + n * n_templates * (104 + max_name + len(rg) + 2 * umi_total)
+               + 4096)
+    out = np.empty(out_cap, dtype=np.uint8)
+    state = np.zeros(2, dtype=np.int64)
+    rg_arr = np.frombuffer(rg, dtype=np.uint8)
+    rc = lib.fgumi_extract_records(
+        n_inputs, n, _addr(buf_addr), _addr(name_off), _addr(name_len),
+        _addr(seq_off), _addr(seq_len), _addr(qual_off), len(segments),
+        _addr(seg_input), _addr(seg_kind), _addr(seg_len), qual_offset,
+        _addr(rg_arr), len(rg), int(store_umi_quals), _addr(out), out_cap,
+        _addr(state))
+    if rc == -1:
+        raise RuntimeError("extract output capacity overflow")
+    if rc in (-2, -3, -4):
+        raise NativeExtractError(int(rc), int(state[1]))
+    return out[:int(state[0])].tobytes()
+
+
+class NativeExtractError(ValueError):
+    """Record-level extract failure; the caller re-runs the offending record
+    through the Python path to produce the canonical error message."""
+
+    def __init__(self, code: int, record_index: int):
+        super().__init__(f"extract error {code} at batch record {record_index}")
+        self.code = code
+        self.record_index = record_index

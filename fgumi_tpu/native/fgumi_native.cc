@@ -925,3 +925,206 @@ void fgumi_overlap_correct_pairs(uint8_t* buf, const int64_t* r1_off,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Batched FASTQ -> unmapped-BAM extraction (the hot half of `extract`).
+// Reference analog: the SIMD FASTQ lexer + parallel Decode of the FASTQ
+// pipeline (crates/fgumi-simd-fastq/src/lib.rs:1-13;
+// src/lib/unified_pipeline/fastq.rs Decode step) and the UnmappedSamBuilder
+// record assembly (extract.rs:887-980). One call consumes one aligned batch
+// of records across all FASTQ inputs and emits block_size-prefixed BAM wire
+// bytes covering the common tag set (RG:Z, RX:Z, QX:Z); exotic options
+// (cell/sample barcodes, single-tag, name annotation) stay on the Python
+// path (commands/extract.py make_records).
+//
+// Segments: flattened read-structure ops in emission order. kind: 0=template
+// 1=UMI(M) 2=skip(S). seg_len -1 means "rest of the read". UMI segments join
+// with '-' (quals with ' ') across all inputs, fgbio style.
+//
+// Returns records written; negative = error: -1 out_cap too small,
+// -2 read-name mismatch at state[1], -3 read too short at state[1].
+// state[0] = bytes written.
+
+namespace {
+
+struct NibInit {
+  uint8_t t[256];
+  NibInit() {
+    // full BAM nibble alphabet "=ACMGRSVTWYHKDBN" (matches io/bam.py
+    // BASE_TO_NIBBLE; unknown bytes encode as N)
+    const char* alpha = "=ACMGRSVTWYHKDBN";
+    for (int i = 0; i < 256; ++i) t[i] = 15;
+    for (int v = 0; v < 16; ++v) {
+      t[static_cast<uint8_t>(alpha[v])] = static_cast<uint8_t>(v);
+      t[static_cast<uint8_t>(alpha[v] | 0x20)] = static_cast<uint8_t>(v);
+    }
+  }
+};
+const NibInit kNib;
+
+inline long strip_name(const uint8_t* name, long len) {
+  long n = len;
+  for (long i = 0; i < n; ++i) {
+    if (name[i] == ' ' || name[i] == '\t') { n = i; break; }
+  }
+  if (n >= 2 && name[n - 2] == '/' && name[n - 1] >= '0' && name[n - 1] <= '9')
+    n -= 2;
+  return n;
+}
+
+}  // namespace
+
+extern "C" {
+
+long fgumi_extract_records(
+    long n_inputs, long n_records, const int64_t* buf_addr,
+    const int64_t* name_off, const int32_t* name_len, const int64_t* seq_off,
+    const int32_t* seq_len, const int64_t* qual_off, long n_segs,
+    const int32_t* seg_input, const int32_t* seg_kind, const int32_t* seg_len,
+    int qual_offset, const uint8_t* rg, int rg_len, int store_umi_quals,
+    uint8_t* out, long out_cap, int64_t* state) {
+  long off = 0;
+  uint8_t umi[1024];
+  uint8_t umiq[1024];
+  const uint8_t* tmpl_seq[8];
+  const uint8_t* tmpl_qual[8];
+  long tmpl_len[8];
+
+  for (long r = 0; r < n_records; ++r) {
+    // stripped-name agreement across inputs
+    const uint8_t* name0 =
+        reinterpret_cast<const uint8_t*>(buf_addr[0]) + name_off[r];
+    long n0 = strip_name(name0, name_len[r]);
+    for (long k = 1; k < n_inputs; ++k) {
+      const uint8_t* nk = reinterpret_cast<const uint8_t*>(buf_addr[k]) +
+                          name_off[k * n_records + r];
+      long lk = strip_name(nk, name_len[k * n_records + r]);
+      if (lk != n0 || memcmp(nk, name0, n0) != 0) {
+        state[1] = r;
+        return -2;
+      }
+    }
+
+    // walk segments
+    long umi_len = 0, umiq_len = 0, n_tmpl = 0;
+    long cursor[8] = {0};
+    for (long s = 0; s < n_segs; ++s) {
+      const long k = seg_input[s];
+      const long idx = k * n_records + r;
+      const uint8_t* sbuf =
+          reinterpret_cast<const uint8_t*>(buf_addr[k]) + seq_off[idx];
+      const uint8_t* qbuf =
+          reinterpret_cast<const uint8_t*>(buf_addr[k]) + qual_off[idx];
+      const long total = seq_len[idx];
+      long len = seg_len[s];
+      if (len < 0) {
+        len = total - cursor[k];
+        if (len < 0) len = 0;
+      } else if (cursor[k] + len > total) {
+        state[1] = r;
+        return -3;
+      }
+      const long at = cursor[k];
+      cursor[k] += len;
+      if (seg_kind[s] == 1) {  // UMI
+        if (umi_len + len + 1 > static_cast<long>(sizeof(umi))) {
+          state[1] = r;
+          return -3;
+        }
+        if (umi_len) { umi[umi_len++] = '-'; umiq[umiq_len++] = ' '; }
+        memcpy(umi + umi_len, sbuf + at, len);
+        umi_len += len;
+        memcpy(umiq + umiq_len, qbuf + at, len);
+        umiq_len += len;
+      } else if (seg_kind[s] == 0) {  // template
+        if (n_tmpl >= 8) { state[1] = r; return -3; }
+        tmpl_seq[n_tmpl] = sbuf + at;
+        tmpl_qual[n_tmpl] = qbuf + at;
+        tmpl_len[n_tmpl] = len;
+        ++n_tmpl;
+      }  // skip: nothing
+    }
+
+    // emit one record per template
+    for (long t = 0; t < n_tmpl; ++t) {
+      const uint8_t* seq = tmpl_seq[t];
+      const uint8_t* qual = tmpl_qual[t];
+      long L = tmpl_len[t];
+      const uint8_t one_n[1] = {'N'};
+      int empty = (L == 0);
+      if (empty) { seq = one_n; L = 1; }  // qual emitted as literal Q2 below
+
+      uint32_t flag = 0x4;  // unmapped
+      if (n_tmpl == 2)
+        flag |= 0x1u | 0x8u | (t == 0 ? 0x40u : 0x80u);
+
+      const long nlen = n0;
+      if (nlen + 1 > 255) {  // l_read_name is u8 (RecordBuilder parity)
+        state[1] = r;
+        return -4;
+      }
+      long need = 4 + 32 + nlen + 1 + (L + 1) / 2 + L;
+      need += 3 + rg_len + 1;
+      if (umi_len) need += 3 + umi_len + 1;
+      if (umi_len && store_umi_quals) need += 3 + umiq_len + 1;
+      if (off + need > out_cap) return -1;
+
+      uint8_t* rec = out + off + 4;
+      put_u32(rec + 0, 0xFFFFFFFFu);
+      put_u32(rec + 4, 0xFFFFFFFFu);
+      rec[8] = static_cast<uint8_t>(nlen + 1);
+      rec[9] = 0;                    // mapq
+      rec[10] = 0x48;                // bin 4680 lo
+      rec[11] = 0x12;                // bin 4680 hi
+      rec[12] = 0;                   // n_cigar lo
+      rec[13] = 0;
+      rec[14] = static_cast<uint8_t>(flag & 0xFF);
+      rec[15] = static_cast<uint8_t>(flag >> 8);
+      put_u32(rec + 16, static_cast<uint32_t>(L));
+      put_u32(rec + 20, 0xFFFFFFFFu);
+      put_u32(rec + 24, 0xFFFFFFFFu);
+      put_u32(rec + 28, 0);
+      uint8_t* p = rec + 32;
+      memcpy(p, name0, nlen);
+      p += nlen;
+      *p++ = 0;
+      // 4-bit packed sequence
+      for (long i = 0; i + 1 < L; i += 2)
+        *p++ = static_cast<uint8_t>((kNib.t[seq[i]] << 4) | kNib.t[seq[i + 1]]);
+      if (L & 1) *p++ = static_cast<uint8_t>(kNib.t[seq[L - 1]] << 4);
+      // saturating qual subtract (extract.rs:256-261)
+      if (empty) {
+        *p++ = 2;
+      } else {
+        for (long i = 0; i < L; ++i)
+          *p++ = qual[i] >= qual_offset
+                     ? static_cast<uint8_t>(qual[i] - qual_offset)
+                     : 0;
+      }
+      // tags
+      p[0] = 'R'; p[1] = 'G'; p[2] = 'Z';
+      memcpy(p + 3, rg, rg_len);
+      p += 3 + rg_len;
+      *p++ = 0;
+      if (umi_len) {
+        p[0] = 'R'; p[1] = 'X'; p[2] = 'Z';
+        memcpy(p + 3, umi, umi_len);
+        p += 3 + umi_len;
+        *p++ = 0;
+        if (store_umi_quals) {
+          p[0] = 'Q'; p[1] = 'X'; p[2] = 'Z';
+          memcpy(p + 3, umiq, umiq_len);
+          p += 3 + umiq_len;
+          *p++ = 0;
+        }
+      }
+      const long rec_len = p - rec;
+      put_u32(out + off, static_cast<uint32_t>(rec_len));
+      off += 4 + rec_len;
+    }
+  }
+  state[0] = off;
+  return n_records;
+}
+
+}  // extern "C"

@@ -1,0 +1,143 @@
+"""Native batch extract vs the per-read Python path: byte-identical BAMs.
+
+The fast path (fgumi_extract_records + FastqBatchReader) must reproduce
+make_records exactly on its supported option surface, across read structures,
+quality encodings, gzip/plain inputs, and chunk-boundary-spanning records.
+"""
+
+import gzip
+
+import pytest
+
+from fgumi_tpu.cli import main as cli_main
+from fgumi_tpu.commands.extract import (ExtractOptions, _fast_extract_ok,
+                                        run_extract)
+from fgumi_tpu.core.read_structure import ReadStructure
+from fgumi_tpu.native import batch as nb
+
+pytestmark = pytest.mark.skipif(not nb.available(),
+                                reason="native library unavailable")
+
+
+@pytest.fixture(scope="module")
+def fq_pair(tmp_path_factory):
+    d = tmp_path_factory.mktemp("xf")
+    r1, r2 = str(d / "r1.fq.gz"), str(d / "r2.fq.gz")
+    cli_main(["simulate", "fastq-reads", "-1", r1, "-2", r2,
+              "--num-families", "200", "--family-size", "3",
+              "--family-size-distribution", "lognormal",
+              "--read-length", "90", "--error-rate", "0.01", "--seed", "77"])
+    return r1, r2
+
+
+def _payload(path):
+    with gzip.open(path, "rb") as f:
+        return f.read()
+
+
+def _run_both(inputs, tmp_path, opts):
+    fast = str(tmp_path / "fast.bam")
+    slow = str(tmp_path / "slow.bam")
+    structures = [ReadStructure.parse(rs) for rs in opts.read_structures]
+    assert _fast_extract_ok(structures, opts)
+    run_extract(inputs, fast, opts)
+    import fgumi_tpu.commands.extract as ex
+
+    orig = ex._fast_extract_ok
+    ex._fast_extract_ok = lambda *a: False
+    try:
+        run_extract(inputs, slow, opts)
+    finally:
+        ex._fast_extract_ok = orig
+    assert _payload(fast) == _payload(slow)
+    return fast
+
+
+def _opts(**kw):
+    kw.setdefault("sample", "s")
+    kw.setdefault("library", "l")
+    return ExtractOptions(**kw)
+
+
+def test_paired_umi_structure(fq_pair, tmp_path):
+    _run_both(list(fq_pair), tmp_path,
+              _opts(read_structures=["8M+T", "+T"]))
+
+
+def test_skip_segment_structure(fq_pair, tmp_path):
+    _run_both(list(fq_pair), tmp_path,
+              _opts(read_structures=["4M4S+T", "+T"]))
+
+
+def test_umi_quals_stored(fq_pair, tmp_path):
+    _run_both(list(fq_pair), tmp_path,
+              _opts(read_structures=["8M+T", "8M+T"],
+                    store_umi_quals=True))
+
+
+def test_single_end(fq_pair, tmp_path):
+    _run_both([fq_pair[0]], tmp_path, _opts(read_structures=["8M+T"]))
+
+
+def test_plain_fastq_and_small_chunks(fq_pair, tmp_path, monkeypatch):
+    """Uncompressed input + tiny batch chunks (records span chunk edges)."""
+    import fgumi_tpu.io.fastq as fq
+
+    plain1 = str(tmp_path / "r1.fq")
+    plain2 = str(tmp_path / "r2.fq")
+    for src, dst in zip(fq_pair, (plain1, plain2)):
+        with gzip.open(src, "rb") as f, open(dst, "wb") as o:
+            o.write(f.read())
+    orig = fq.FastqBatchReader.__init__
+
+    def tiny(self, path, chunk_size=777, max_records=None):
+        orig(self, path, chunk_size=chunk_size)
+    monkeypatch.setattr(fq.FastqBatchReader, "__init__", tiny)
+    _run_both([plain1, plain2], tmp_path,
+              _opts(read_structures=["8M+T", "+T"]))
+
+
+def test_exotic_options_fall_back(fq_pair):
+    structures = [ReadStructure.parse("8M+T"), ReadStructure.parse("+T")]
+    assert not _fast_extract_ok(structures, _opts(
+        read_structures=["8M+T", "+T"], annotate_read_names=True))
+    assert not _fast_extract_ok(
+        [ReadStructure.parse("8B+T"), ReadStructure.parse("+T")],
+        _opts(read_structures=["8B+T", "+T"]))
+    assert not _fast_extract_ok(
+        [ReadStructure.parse("+T8M"), ReadStructure.parse("+T")],
+        _opts(read_structures=["+T8M", "+T"]))
+
+
+def test_blank_lines_between_records(tmp_path):
+    """Blank lines at record boundaries are skipped like FastqReader does."""
+    a = str(tmp_path / "bl.fq")
+    open(a, "w").write("@r1\nACGTACGTAA\n+\nIIIIIIIIII\n\n\n"
+                       "@r2\nACGTACGTCC\n+\nIIIIIIIIII\n")
+    out = _run_both([a], tmp_path, _opts(read_structures=["4M+T"]))
+    from fgumi_tpu.io.bam import BamReader
+
+    with BamReader(out) as r:
+        names = [rec.name for rec in r]
+    assert names == [b"r1", b"r2"]
+
+
+def test_iupac_bases_preserved(tmp_path):
+    """Ambiguity bases must round-trip identically on both paths."""
+    a = str(tmp_path / "iupac.fq")
+    open(a, "w").write("@r1\nACGTRYSWKMBDHVN\n+\nIIIIIIIIIIIIIII\n")
+    out = _run_both([a], tmp_path, _opts(read_structures=["+T"]))
+    from fgumi_tpu.io.bam import BamReader
+
+    with BamReader(out) as r:
+        rec = next(iter(r))
+    assert rec.seq_bytes() == b"ACGTRYSWKMBDHVN"
+
+
+def test_name_mismatch_raises(tmp_path):
+    a, b = str(tmp_path / "a.fq"), str(tmp_path / "b.fq")
+    open(a, "w").write("@r1/1\nACGT\n+\nIIII\n")
+    open(b, "w").write("@DIFFERENT/2\nACGT\n+\nIIII\n")
+    with pytest.raises(Exception, match="[Nn]ames do not match"):
+        run_extract([a, b], str(tmp_path / "o.bam"),
+                    _opts(read_structures=["+T", "+T"]))

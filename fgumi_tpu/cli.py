@@ -178,16 +178,24 @@ def cmd_simplex(args):
             fast = FastSimplexCaller(caller, args.tag.encode(),
                                      overlap_caller=oc_caller, mesh=mesh)
             allow_unmapped = args.allow_unmapped
+            from .utils.progress import ProgressTracker
+
+            progress = ProgressTracker("simplex")
+
+            def _process(batch):
+                progress.add(batch.n)
+                return fast.process_batch(batch, allow_unmapped)
+
             with BamWriter(args.output, out_header) as writer:
                 # device fetch + serialize resolve on the sink stage, so with
                 # --threads they overlap the next batch's host prep
                 run_stages(
-                    iter(reader),
-                    lambda batch: fast.process_batch(batch, allow_unmapped),
+                    iter(reader), _process,
                     lambda chunk: writer.write_serialized(resolve_chunk(chunk)),
                     threads=args.threads, stats=stats)
                 for blob in fast.flush():
                     writer.write_serialized(resolve_chunk(blob))
+            progress.finish()
         n_out = caller.stats.consensus_reads
         if args.stats:
             print(stats.format_table())
@@ -661,23 +669,32 @@ def cmd_sort(args):
             from .io.bai import BaiBuilder
 
             bai = BaiBuilder(len(reader.header.ref_names))
+        from .utils.progress import ProgressTracker
+
+        progress = ProgressTracker("sort")
+        wprogress = ProgressTracker("sort-write")
         with ExternalSorter(key_fn, max_bytes=budget, tmp_dir=args.tmp_dir,
                             max_records=args.max_records_in_ram) as sorter:
             for rec in reader:
                 sorter.add(rec)
+                progress.add()
+            progress.finish()
             with BamWriter(args.output, out_header) as writer:
                 if bai is None:
                     for data in sorter.sorted_records():
                         writer.write_record_bytes(data)
+                        wprogress.add()
                 else:
                     for data in sorter.sorted_records():
                         rec = RawRecord(data)
                         vo0 = writer.tell_virtual()
                         writer.write_record_bytes(data)
+                        wprogress.add()
                         bai.add(rec.ref_id, rec.pos,
                                 rec.pos + max(rec.reference_length(), 1),
                                 vo0, writer.tell_virtual(),
                                 not rec.flag & FLAG_UNMAPPED)
+            wprogress.finish()
         if bai is not None:
             bai.write(args.output + ".bai")
     dt = time.monotonic() - t0

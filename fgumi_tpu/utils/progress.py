@@ -1,0 +1,37 @@
+"""Every-N-records progress logging.
+
+Analog of the reference's ProgressTracker
+(/root/reference/crates/fgumi-bam-io/src/progress.rs:130): long-running
+commands log a heartbeat with cumulative count and rate every `every`
+records, so operators can distinguish slow from stuck.
+"""
+
+import logging
+import time
+
+log = logging.getLogger("fgumi_tpu")
+
+
+class ProgressTracker:
+    def __init__(self, label: str, every: int = 1_000_000):
+        self.label = label
+        self.every = every
+        self.count = 0
+        self._next = every
+        self._t0 = time.monotonic()
+
+    def add(self, n: int = 1):
+        self.count += n
+        if self.count >= self._next:
+            dt = time.monotonic() - self._t0
+            log.info("%s: %d records processed (%.0f/s)", self.label,
+                     self.count, self.count / dt if dt else 0)
+            while self._next <= self.count:
+                self._next += self.every
+
+    def finish(self):
+        """Final summary line (only when at least one heartbeat fired)."""
+        if self.count >= self.every:
+            dt = time.monotonic() - self._t0
+            log.info("%s: done, %d records in %.1fs (%.0f/s)", self.label,
+                     self.count, dt, self.count / dt if dt else 0)

@@ -178,3 +178,18 @@ def test_sort_1m_scale_smoke(tmp_path):
     with BamIndexedReader(out) as ir:
         got = sum(1 for _ in ir.query(0, 0, 1 << 29))
     assert got == expected
+
+
+def test_progress_tracker(caplog):
+    import logging
+
+    from fgumi_tpu.utils.progress import ProgressTracker
+
+    with caplog.at_level(logging.INFO, logger="fgumi_tpu"):
+        p = ProgressTracker("unit", every=100)
+        for _ in range(5):
+            p.add(60)
+        p.finish()
+    heartbeats = [r for r in caplog.records if "records processed" in r.message]
+    assert len(heartbeats) == 3  # crossings at 120, 240, 300 (every=100)
+    assert any("done" in r.message for r in caplog.records)

@@ -99,6 +99,10 @@ def _add_simplex(sub):
                         "inline (single-threaded fast path)")
     p.add_argument("--stats", action="store_true",
                    help="print per-stage busy/blocked timing table")
+    p.add_argument("--max-memory", default="auto",
+                   help="pipeline working-set budget (MiB count, human size, "
+                        "or auto): governs queue depths relative to "
+                        "--batch-bytes")
     p.add_argument("--classic", action="store_true",
                    help="force the per-record Python engine (the semantic "
                         "reference for the vectorized fast engine)")
@@ -168,6 +172,16 @@ def cmd_simplex(args):
         from .io.batch_reader import BamBatchReader
         from .pipeline import StageTimes, run_stages
 
+        from .utils.memory import resolve_budget
+
+        try:
+            budget = resolve_budget(args.max_memory)
+        except ValueError as e:
+            log.error("%s", e)
+            return 2
+        # each queued item holds ~3x batch-bytes (decompressed chunk + padded
+        # device gathers); two queues bound the in-flight working set
+        queue_items = int(max(1, min(8, budget // (6 * args.batch_bytes))))
         stats = StageTimes()
         mesh = _build_dp_mesh(getattr(args, "devices", "auto"))
         with BamBatchReader(args.input, target_bytes=args.batch_bytes) as reader:
@@ -192,7 +206,7 @@ def cmd_simplex(args):
                 run_stages(
                     iter(reader), _process,
                     lambda chunk: writer.write_serialized(resolve_chunk(chunk)),
-                    threads=args.threads, stats=stats)
+                    threads=args.threads, queue_items=queue_items, stats=stats)
                 for blob in fast.flush():
                     writer.write_serialized(resolve_chunk(blob))
             progress.finish()

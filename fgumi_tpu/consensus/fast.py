@@ -470,30 +470,15 @@ class FastSimplexCaller:
         L_max = -(-max(jobs[j].consensus_len for j in multi) // 16) * 16
         codes_d = np.ascontiguousarray(codes[rows_all, :L_max])
         quals_d = np.ascontiguousarray(quals[rows_all, :L_max])
-        seg_ids = np.repeat(np.arange(len(multi), dtype=np.int32), counts)
 
         if self.mesh is not None:
             return self._dispatch_sharded(multi, counts, starts, codes_d,
                                           quals_d, L_max)
 
-        # pow2 pads bound the XLA shape vocabulary (persistent compile cache
-        # makes each shape a once-per-machine cost); pad rows are all-N
-        # no-ops assigned to the last pad segment, pad segments are never read
-        N = len(rows_all)
-        N_pad = 1 << (N - 1).bit_length()
-        J = len(multi)
-        F_pad = 1 << (J - 1).bit_length() if J > 1 else 1
-        if N_pad != N:
-            pad = np.full((N_pad - N, L_max), 4, dtype=np.uint8)
-            codes_dev = np.concatenate([codes_d, pad])
-            quals_dev = np.concatenate(
-                [quals_d, np.zeros((N_pad - N, L_max), dtype=np.uint8)])
-            # all-N pad rows contribute zero wherever they land; the last real
-            # segment's id keeps seg_ids sorted without growing F_pad
-            seg_ids = np.concatenate(
-                [seg_ids, np.full(N_pad - N, J - 1, dtype=np.int32)])
-        else:
-            codes_dev, quals_dev = codes_d, quals_d
+        from ..ops.kernel import pad_segments
+
+        codes_dev, quals_dev, seg_ids, _, F_pad = pad_segments(
+            codes_d, quals_d, counts)
         dev = kernel.device_call_segments(codes_dev, quals_dev, seg_ids, F_pad)
         return ("seg", multi, starts, codes_d, quals_d, dev)
 

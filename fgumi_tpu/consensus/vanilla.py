@@ -403,46 +403,56 @@ class VanillaConsensusCaller:
     # ------------------------------------------------------------------ device
 
     def _run_jobs(self, jobs):
-        """Execute jobs: single-read on host, multi-read bucketed onto the kernel.
+        """Execute jobs: single-read on host, multi-read via ONE ragged
+        segment-sum dispatch (kernel.device_call_segments) per call.
 
-        Returns per-job (bases_codes, quals, depths, errors) pre-threshold clamped
-        arrays trimmed to consensus_len.
+        One device execution per job batch regardless of family-size mix —
+        the same dense layout the fast simplex engine uses (consensus/fast.py
+        _dispatch_jobs), so duplex/CODEC/classic callers share its economics.
+        Returns per-job (bases_codes, quals, depths, errors) pre-threshold
+        clamped arrays trimmed to consensus_len.
         """
         results = [None] * len(jobs)
-        buckets = {}
+        multi = []
         for j, job in enumerate(jobs):
-            R = len(job.codes)
-            if R == 1:
+            if len(job.codes) == 1:
                 b, q, d, e = oracle.single_read_consensus(
                     job.codes[0][: job.consensus_len],
                     job.quals[0][: job.consensus_len],
                     self.tables, self.options.min_consensus_base_quality)
                 results[j] = (b, q, d, e)
-                continue
-            Rb = 1 << (R - 1).bit_length()  # next pow2 bucket
-            Lb = -(-job.consensus_len // 32) * 32  # multiple of 32
-            buckets.setdefault((Rb, Lb), []).append(j)
+            else:
+                multi.append(j)
+        if not multi:
+            return results
 
-        for (Rb, Lb), idxs in buckets.items():
-            # Pad the family axis to a power of two as well: every distinct (F, R, L)
-            # triple is a separate XLA compilation, and per-batch bucket occupancies
-            # vary; padded families are all-N rows the kernel treats as depth 0.
-            F = 1 << (len(idxs) - 1).bit_length() if idxs else 0
-            codes = np.full((F, Rb, Lb), N_CODE, dtype=np.uint8)
-            quals = np.zeros((F, Rb, Lb), dtype=np.uint8)
-            for fi, j in enumerate(idxs):
-                job = jobs[j]
-                for ri, (c, q) in enumerate(zip(job.codes, job.quals)):
-                    n = min(len(c), Lb)
-                    codes[fi, ri, :n] = c[:n]
-                    quals[fi, ri, :n] = q[:n]
-            w, q_, d, e = self.kernel(codes, quals)
-            for fi, j in enumerate(idxs):
-                L = jobs[j].consensus_len
-                b_j, q_j = oracle.apply_consensus_thresholds(
-                    w[fi, :L], q_[fi, :L], d[fi, :L],
-                    self.options.min_reads, self.options.min_consensus_base_quality)
-                results[j] = (b_j, q_j, d[fi, :L], e[fi, :L])
+        from ..ops.kernel import pad_segments
+
+        L_max = -(-max(jobs[j].consensus_len for j in multi) // 16) * 16
+        counts = np.array([len(jobs[j].codes) for j in multi], dtype=np.int64)
+        N = int(counts.sum())
+        codes2d = np.full((N, L_max), N_CODE, dtype=np.uint8)
+        quals2d = np.zeros((N, L_max), dtype=np.uint8)
+        row = 0
+        for j in multi:
+            job = jobs[j]
+            for c, q in zip(job.codes, job.quals):
+                n = min(len(c), L_max)
+                codes2d[row, :n] = c[:n]
+                quals2d[row, :n] = q[:n]
+                row += 1
+        codes_dev, quals_dev, seg_ids, starts, F_pad = pad_segments(
+            codes2d, quals2d, counts)
+        dev = self.kernel.device_call_segments(codes_dev, quals_dev, seg_ids,
+                                               F_pad)
+        w, q_, d, e = self.kernel.resolve_segments(
+            dev, codes2d, quals2d, starts)
+        for fi, j in enumerate(multi):
+            L = jobs[j].consensus_len
+            b_j, q_j = oracle.apply_consensus_thresholds(
+                w[fi, :L], q_[fi, :L], d[fi, :L],
+                self.options.min_reads, self.options.min_consensus_base_quality)
+            results[j] = (b_j, q_j, d[fi, :L], e[fi, :L])
         return results
 
     # ------------------------------------------------------------------ output

@@ -303,6 +303,38 @@ def _consensus_batch_packed_jit(codes, quals, correct_tab, err_tab,
     return _pack_result(winner, qual, suspect)
 
 
+def pad_segments(codes2d: np.ndarray, quals2d: np.ndarray,
+                 counts: np.ndarray):
+    """pow2-pad a dense (N, L) row layout for device_call_segments.
+
+    Returns (codes_dev, quals_dev, seg_ids, starts, num_segments): rows pad
+    to the next pow2 with all-N no-op rows carrying the LAST real segment's
+    id (keeps seg_ids sorted without growing num_segments — kernel pad
+    invariant), and num_segments pads to pow2 so the XLA shape vocabulary
+    stays tiny under the persistent compile cache. Shared by the fast
+    simplex engine and the classic callers (VERDICT r2: one copy of this
+    subtle pad logic).
+    """
+    counts = np.asarray(counts, dtype=np.int64)
+    starts = np.concatenate(([0], np.cumsum(counts)))
+    N = int(starts[-1])
+    J = len(counts)
+    N_pad = 1 << (N - 1).bit_length() if N > 1 else 1
+    F_pad = 1 << (J - 1).bit_length() if J > 1 else 1
+    seg_ids = np.repeat(np.arange(J, dtype=np.int32), counts)
+    if N_pad != N:
+        L = codes2d.shape[1]
+        pad_c = np.full((N_pad - N, L), N_CODE, dtype=np.uint8)
+        pad_q = np.zeros((N_pad - N, L), dtype=np.uint8)
+        codes_dev = np.concatenate([codes2d[:N], pad_c])
+        quals_dev = np.concatenate([quals2d[:N], pad_q])
+        seg_ids = np.concatenate(
+            [seg_ids, np.full(N_pad - N, J - 1, dtype=np.int32)])
+    else:
+        codes_dev, quals_dev = codes2d, quals2d
+    return codes_dev, quals_dev, seg_ids, starts, F_pad
+
+
 def _unpack_device_result(packed: np.ndarray):
     """(winner uint8, qual uint8, suspect bool) from the packed uint16."""
     qual = (packed & 0x7F).astype(np.uint8)

@@ -22,11 +22,10 @@ from ..io.bam import (FLAG_FIRST, FLAG_LAST, FLAG_MATE_UNMAPPED, FLAG_PAIRED,
                       FLAG_UNMAPPED)
 from ..native import batch as nb
 from ..ops import oracle
+from .overlapping import (AGREEMENT_CODES, DISAGREEMENT_CODES,
+                          add_native_overlap_stats)
 from .simple_umi import consensus_umis
 from .vanilla import (FRAGMENT, R1, R2, _TYPE_FLAGS, VanillaConsensusCaller)
-
-_AGREEMENT_CODES = {"consensus": 0, "max-qual": 1, "pass-through": 2}
-_DISAGREEMENT_CODES = {"consensus": 0, "mask-both": 1, "mask-lower-qual": 2}
 
 def resolve_chunk(chunk) -> bytes:
     """Wire bytes of a process_batch output item (resolving deferred device
@@ -240,11 +239,8 @@ class FastSimplexCaller:
         stats = nb.overlap_correct_pairs(
             batch.buf, np.asarray(r1_offs, dtype=np.int64),
             np.asarray(r2_offs, dtype=np.int64),
-            _AGREEMENT_CODES[oc.agreement], _DISAGREEMENT_CODES[oc.disagreement])
-        oc.stats.overlapping_bases += int(stats[0])
-        oc.stats.bases_agreeing += int(stats[1])
-        oc.stats.bases_disagreeing += int(stats[2])
-        oc.stats.bases_corrected += int(stats[3])
+            AGREEMENT_CODES[oc.agreement], DISAGREEMENT_CODES[oc.disagreement])
+        add_native_overlap_stats(oc.stats, stats)
 
     # ------------------------------------------------------------------ groups
 

@@ -188,7 +188,11 @@ def apply_overlapping_consensus(records: list,
     """Correct every primary R1/R2 pair (matched by name) within a group.
 
     Returns the records list with corrected pairs replaced in position
-    (apply_overlapping_consensus, overlapping.rs:625-676).
+    (apply_overlapping_consensus, overlapping.rs:625-676). When the native
+    runtime is available, all pairs of the group run in one C call over a
+    concatenated buffer (the same fgumi_overlap_correct_pairs the fast
+    simplex engine uses); the per-pair numpy path is the fallback and the
+    semantic reference (tests/test_overlapping.py parity test).
     """
     pairs = {}
     for idx, rec in enumerate(records):
@@ -200,10 +204,63 @@ def apply_overlapping_consensus(records: list,
             slot[0] = idx
         elif flg & FLAG_LAST:
             slot[1] = idx
+    complete = [(i1, i2) for i1, i2 in pairs.values()
+                if i1 is not None and i2 is not None]
+    if not complete:
+        return list(records)
+
+    from ..native import batch as nb
+
+    if nb.available():
+        return _apply_native(records, complete, caller)
+    return apply_overlapping_consensus_python(records, complete, caller)
+
+
+def apply_overlapping_consensus_python(records, complete, caller):
+    """The per-pair pure-Python correction (the native path's semantic
+    reference; forced directly by the parity tests)."""
     out = list(records)
-    for i1, i2 in pairs.values():
-        if i1 is None or i2 is None:
-            continue
+    for i1, i2 in complete:
         r1, r2, _ = caller.call(out[i1], out[i2])
         out[i1], out[i2] = r1, r2
     return out
+
+
+def add_native_overlap_stats(stats_obj, stats_arr):
+    """Fold a fgumi_overlap_correct_pairs stats array into CorrectionStats
+    (shared by this module and the fast simplex engine)."""
+    stats_obj.overlapping_bases += int(stats_arr[0])
+    stats_obj.bases_agreeing += int(stats_arr[1])
+    stats_obj.bases_disagreeing += int(stats_arr[2])
+    stats_obj.bases_corrected += int(stats_arr[3])
+
+
+def _apply_native(records, complete, caller):
+    """One fgumi_overlap_correct_pairs call over the paired records only."""
+    from ..native import batch as nb
+
+    # concatenate just the touched records; untouched ones pass through
+    touched = sorted({i for pair in complete for i in pair})
+    offsets = {}
+    off = 0
+    parts = []
+    for i in touched:
+        parts.append(records[i].data)
+        offsets[i] = off
+        off += len(records[i].data)
+    buf = np.frombuffer(bytearray(b"".join(parts)), dtype=np.uint8)
+    r1_offs = np.array([offsets[i1] for i1, _ in complete], dtype=np.int64)
+    r2_offs = np.array([offsets[i2] for _, i2 in complete], dtype=np.int64)
+    stats = nb.overlap_correct_pairs(
+        buf, r1_offs, r2_offs, AGREEMENT_CODES[caller.agreement],
+        DISAGREEMENT_CODES[caller.disagreement])
+    add_native_overlap_stats(caller.stats, stats)
+    out = list(records)
+    for i in touched:
+        end = offsets[i] + len(records[i].data)
+        out[i] = RawRecord(bytes(buf[offsets[i]:end]))
+    return out
+
+
+AGREEMENT_CODES = {"consensus": 0, "max-qual": 1, "pass-through": 2}
+DISAGREEMENT_CODES = {"consensus": 0, "mask-both": 1, "mask-lower-qual": 2}

@@ -28,7 +28,7 @@ from ..io.bam import (FLAG_FIRST, FLAG_LAST, FLAG_MATE_UNMAPPED, FLAG_PAIRED,
                       FLAG_REVERSE, FLAG_SECONDARY, FLAG_SUPPLEMENTARY,
                       FLAG_UNMAPPED, RawRecord, RecordBuilder)
 from ..ops import oracle
-from ..ops.kernel import ConsensusKernel
+from ..ops.kernel import ConsensusKernel, pad_segments
 from ..ops.tables import quality_tables
 from .simple_umi import consensus_umis
 
@@ -425,8 +425,6 @@ class VanillaConsensusCaller:
                 multi.append(j)
         if not multi:
             return results
-
-        from ..ops.kernel import pad_segments
 
         L_max = -(-max(jobs[j].consensus_len for j in multi) // 16) * 16
         counts = np.array([len(jobs[j].codes) for j in multi], dtype=np.int64)

@@ -9,8 +9,8 @@ import numpy as np
 import pytest
 
 from fgumi_tpu.constants import BASE_TO_CODE, N_CODE, reverse_complement_codes
-from fgumi_tpu.consensus.overlapping import (OverlappingBasesConsensusCaller,
-                                             apply_overlapping_consensus)
+from fgumi_tpu.consensus.overlapping import (
+    OverlappingBasesConsensusCaller, apply_overlapping_consensus_python)
 from fgumi_tpu.core.overlap import num_bases_extending_past_mate
 from fgumi_tpu.io.bam import FLAG_REVERSE, BamReader, RawRecord
 from fgumi_tpu.native import batch
@@ -298,7 +298,8 @@ def test_overlap_correct_matches_python_random_pairs(agreement, disagreement):
     stats = batch.overlap_correct_pairs(mutable, r1_off, r2_off, ag, dg)
 
     caller = OverlappingBasesConsensusCaller(agreement, disagreement)
-    corrected = apply_overlapping_consensus(list(recs), caller)
+    corrected = apply_overlapping_consensus_python(
+        list(recs), [(i, i + 1) for i in range(0, len(recs), 2)], caller)
     for i in range(len(recs)):
         got = bytes(mutable[f["data_off"][i]:f["data_end"][i]])
         assert got == corrected[i].data, f"record {i} mismatch"
@@ -403,7 +404,8 @@ def test_overlap_correct_matches_python(mapped_bam, agreement, disagreement):
         {"consensus": 0, "mask-both": 1, "mask-lower-qual": 2}[disagreement])
 
     caller = OverlappingBasesConsensusCaller(agreement, disagreement)
-    corrected = apply_overlapping_consensus(list(recs), caller)
+    corrected = apply_overlapping_consensus_python(list(recs), idx_pairs,
+                                                  caller)
 
     for i, rec in enumerate(corrected):
         got = bytes(mutable[f["data_off"][i]:f["data_end"][i]])

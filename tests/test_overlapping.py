@@ -188,3 +188,46 @@ def test_simplex_cli_overlap_flag(tmp_path):
     with BamReader(off_bam) as r:
         n_off = sum(1 for _ in r)
     assert n_on == n_off == 20  # R1+R2 consensus per family
+
+
+import pytest as _pytest
+
+
+@_pytest.mark.parametrize("agreement,disagreement", [
+    ("consensus", "consensus"), ("max-qual", "mask-both"),
+    ("pass-through", "mask-lower-qual"), ("consensus", "mask-lower-qual")])
+def test_apply_native_matches_python(tmp_path, agreement, disagreement):
+    """The one-call native group correction == the per-pair Python path,
+    across every strategy combination and all four stats counters."""
+    from fgumi_tpu.consensus import overlapping as ov
+    from fgumi_tpu.io.bam import BamReader
+    from fgumi_tpu.native import batch as nb
+    from fgumi_tpu.simulate import simulate_grouped_bam
+
+    if not nb.available():
+        _pytest.skip("native library unavailable")
+    path = str(tmp_path / "ov.bam")
+    simulate_grouped_bam(path, num_families=40, family_size=4,
+                         read_length=90, error_rate=0.03, seed=29)
+    with BamReader(path) as r:
+        recs = list(r)
+    groups = [recs[i:i + 8] for i in range(0, len(recs), 8)]
+    for group in groups:
+        oc_n = ov.OverlappingBasesConsensusCaller(agreement, disagreement)
+        oc_p = ov.OverlappingBasesConsensusCaller(agreement, disagreement)
+        native = ov.apply_overlapping_consensus(group, oc_n)
+        pairs = {}
+        for idx, rec in enumerate(group):
+            slot = pairs.setdefault(rec.name, [None, None])
+            if rec.flag & 0x40:
+                slot[0] = idx
+            elif rec.flag & 0x80:
+                slot[1] = idx
+        complete = [(a, b) for a, b in pairs.values()
+                    if a is not None and b is not None]
+        python = ov.apply_overlapping_consensus_python(group, complete, oc_p)
+        assert [r.data for r in native] == [r.data for r in python]
+        assert oc_n.stats.overlapping_bases == oc_p.stats.overlapping_bases
+        assert oc_n.stats.bases_agreeing == oc_p.stats.bases_agreeing
+        assert oc_n.stats.bases_disagreeing == oc_p.stats.bases_disagreeing
+        assert oc_n.stats.bases_corrected == oc_p.stats.bases_corrected

@@ -14,9 +14,12 @@ single-threaded fast path every command keeps as its semantic reference
 (reference bam.rs:3301, performance-tuning.md:28-40).
 """
 
+import logging
 import queue
 import threading
 import time
+
+log = logging.getLogger("fgumi_tpu")
 
 
 class StageTimes:
@@ -51,8 +54,48 @@ class _Err:
 _DONE = object()
 
 
+class _Watchdog:
+    """Stall detector for the threaded pipeline (deadlock-watchdog-lite,
+    reference deadlock.rs:1-60): a daemon timer samples the stage counters
+    every `interval` seconds; when no stage made progress between samples
+    while work remains, it logs a queue/stage snapshot so a wedged run is
+    diagnosable from the log instead of silent."""
+
+    def __init__(self, counters, q_in, q_out, interval: float):
+        self._counters = counters
+        self._q_in = q_in
+        self._q_out = q_out
+        self._interval = interval
+        # (0,0,0) start: a pipeline wedged on its very first item reports at
+        # t=interval, not 2x
+        self._last = (0, 0, 0)
+        self._stop = threading.Event()
+        self._t = None
+        if interval > 0:  # <= 0 disables the watchdog entirely
+            self._t = threading.Thread(target=self._loop,
+                                       name="fgumi-watchdog", daemon=True)
+            self._t.start()
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            snap = tuple(self._counters)
+            if snap == self._last:
+                log.warning(
+                    "pipeline stalled for %.0fs: read=%d processed=%d "
+                    "written=%d q_in=%d/%d q_out=%d/%d — no stage progressed "
+                    "(device hang or downstream block?)",
+                    self._interval, snap[0], snap[1], snap[2],
+                    self._q_in.qsize(), self._q_in.maxsize,
+                    self._q_out.qsize(), self._q_out.maxsize)
+            self._last = snap
+
+    def stop(self):
+        self._stop.set()
+
+
 def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
-               queue_items: int = 4, stats: StageTimes = None):
+               queue_items: int = 4, stats: StageTimes = None,
+               watchdog_interval: float = 120.0):
     """source -> process -> sink, optionally with reader/writer threads.
 
     - source_iter: yields work items (e.g. RecordBatch)
@@ -60,8 +103,9 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
     - sink_fn(output)
 
     threads <= 1: fully inline. threads >= 2: reader thread + writer thread
-    around the processing caller thread. Exceptions from any stage propagate
-    to the caller; the first exception wins and the pipeline drains.
+    around the processing caller thread, plus a stall watchdog. Exceptions
+    from any stage propagate to the caller; the first exception wins and the
+    pipeline drains.
     """
     if stats is None:
         stats = StageTimes()
@@ -81,6 +125,7 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
     # (consensus _PendingChunk), so its depth bounds in-flight memory too
     q_out = queue.Queue(maxsize=queue_items * 2)
     writer_exc = []
+    counters = [0, 0, 0]  # read, processed, written
 
     def reader():
         try:
@@ -89,6 +134,7 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
                 now = time.monotonic()
                 stats.add_busy("read", now - t_last)
                 q_in.put(item)
+                counters[0] += 1
                 t_last = time.monotonic()
                 stats.add_blocked("read", t_last - now)
             q_in.put(_DONE)
@@ -105,6 +151,7 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
                 if out is _DONE:
                     return
                 sink_fn(out)
+                counters[2] += 1
                 stats.add_busy("write", time.monotonic() - now)
         except BaseException as e:  # noqa: BLE001 - relayed to caller
             writer_exc.append(e)
@@ -114,6 +161,7 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
 
     rt = threading.Thread(target=reader, name="fgumi-reader", daemon=True)
     wt = threading.Thread(target=writer, name="fgumi-writer", daemon=True)
+    watchdog = _Watchdog(counters, q_in, q_out, watchdog_interval)
     rt.start()
     wt.start()
     try:
@@ -128,12 +176,14 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
                 raise item.exc
             for out in process_fn(item):
                 q_out.put(out)
+            counters[1] += 1
             stats.add_busy("process", time.monotonic() - now)
             if writer_exc:
                 raise writer_exc[0]
     finally:
         q_out.put(_DONE)
-        wt.join()
+        wt.join()  # watchdog stays armed while the writer drains
+        watchdog.stop()
         # unblock a reader stuck on a full input queue after an error
         try:
             while True:

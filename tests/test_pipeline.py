@@ -1,0 +1,67 @@
+"""Pipeline runtime tests: stage overlap, error propagation, stall watchdog."""
+
+import logging
+import time
+
+import pytest
+
+from fgumi_tpu.pipeline import StageTimes, run_stages
+
+
+def test_inline_and_threaded_equal():
+    for threads in (0, 2):
+        out = []
+        run_stages(iter(range(20)), lambda x: [x * 2], out.append,
+                   threads=threads)
+        assert out == [x * 2 for x in range(20)]
+
+
+def test_source_error_propagates():
+    def bad_source():
+        yield 1
+        raise RuntimeError("reader broke")
+
+    with pytest.raises(RuntimeError, match="reader broke"):
+        run_stages(bad_source(), lambda x: [x], lambda x: None, threads=2)
+
+
+def test_sink_error_propagates():
+    def bad_sink(x):
+        raise ValueError("writer broke")
+
+    with pytest.raises(ValueError, match="writer broke"):
+        run_stages(iter(range(50)), lambda x: [x], bad_sink, threads=2)
+
+
+def test_process_error_propagates():
+    def bad(x):
+        raise KeyError("process broke")
+
+    with pytest.raises(KeyError):
+        run_stages(iter(range(5)), bad, lambda x: None, threads=2)
+
+
+def test_watchdog_logs_stall(caplog):
+    """A sink that hangs longer than the interval triggers the stall log."""
+    def slow_sink(x):
+        time.sleep(0.5)
+
+    with caplog.at_level(logging.WARNING, logger="fgumi_tpu"):
+        run_stages(iter(range(2)), lambda x: [x], slow_sink, threads=2,
+                   watchdog_interval=0.1)
+    assert any("pipeline stalled" in r.message for r in caplog.records)
+
+
+def test_watchdog_quiet_when_progressing(caplog):
+    with caplog.at_level(logging.WARNING, logger="fgumi_tpu"):
+        run_stages(iter(range(200)), lambda x: [x], lambda x: None,
+                   threads=2, watchdog_interval=5.0)
+    assert not any("pipeline stalled" in r.message for r in caplog.records)
+
+
+def test_stats_collected():
+    stats = StageTimes()
+    run_stages(iter(range(10)), lambda x: [x], lambda x: None, threads=2,
+               stats=stats)
+    table = stats.format_table()
+    assert "read" in table and "process" in table

@@ -15,15 +15,31 @@ from ..ops.tables import quality_tables
 
 _DNA = frozenset(b"ACGTNacgtn")
 _Q_ERROR = 20
+_tables = None
 
 
 def consensus_umis(umis) -> str:
-    """Majority/likelihood consensus over equal-length UMI strings (simple_umi.rs:236-245)."""
+    """Majority/likelihood consensus over equal-length UMI strings (simple_umi.rs:236-245).
+
+    Unanimous inputs (the overwhelmingly common case — UMI errors are rare
+    within a family) short-circuit: the flat-quality likelihood winner of R
+    identical strings is trivially that string. Non-unanimous inputs run the
+    f64 oracle with flat Q20 observations; near-exact likelihood ties there
+    resolve by accumulation-order rounding, which is pinned implementation
+    behavior a counting shortcut cannot reproduce, so the oracle stays the
+    source of truth (tests/test_simple_umi.py).
+    """
     if not umis:
         return ""
+    first = umis[0]
     if len(umis) == 1:
-        return umis[0]
-    seq_len = len(umis[0])
+        return first  # single-sequence passthrough (verbatim, original casing)
+    if all(u == first for u in umis):
+        # match the oracle path's output casing exactly: DNA characters come
+        # back uppercased (CODE_TO_BASE), non-DNA characters pass through
+        return "".join(c.upper() if c.upper() in "ACGTN" else c
+                       for c in first)
+    seq_len = len(first)
     if any(len(u) != seq_len for u in umis):
         raise ValueError(f"UMI sequences must all have the same length: {umis}")
 
@@ -32,19 +48,21 @@ def consensus_umis(umis) -> str:
     codes = np.where(is_dna, BASE_TO_CODE[arr], 4).astype(np.uint8)
     quals = np.full_like(codes, _Q_ERROR)
 
-    tables = quality_tables(90, 90)
-    winner, _q, _d, _e = oracle.call_family(codes, quals, tables)
+    global _tables
+    if _tables is None:
+        _tables = quality_tables(90, 90)
+    winner, _q, _d, _e = oracle.call_family(codes, quals, _tables)
 
     out = bytearray()
-    first = arr[0]
+    first_arr = arr[0]
     n_dna = is_dna.sum(axis=0)
     for i in range(seq_len):
         if n_dna[i] == 0:
             # all non-DNA: must be the same character, preserved from the first
-            if not (arr[:, i] == first[i]).all():
+            if not (arr[:, i] == first_arr[i]).all():
                 raise ValueError(
-                    f"Sequences must have character {chr(first[i])!r} at position {i}")
-            out.append(first[i])
+                    f"Sequences must have character {chr(first_arr[i])!r} at position {i}")
+            out.append(first_arr[i])
         elif n_dna[i] == len(umis):
             out.append(CODE_TO_BASE[winner[i]])
         else:

@@ -69,6 +69,131 @@ def _umi_matrix(umis) -> np.ndarray:
     return np.frombuffer("".join(umis).encode(), dtype=np.uint8).reshape(len(umis), -1)
 
 
+# Above this many unique UMIs, dense all-pairs matrices become untenable
+# (O(U^2) memory and transfer) and candidate pairs come from pigeonhole
+# chunk indexing instead — the analog of the reference's NgramIndex
+# (crates/fgumi-umi/src/assigner.rs:228,267,394: exact-match on one of
+# d+1 chunks is necessary for Hamming distance <= d).
+SPARSE_THRESHOLD = 8192
+
+
+class NeighborGraph:
+    """Match-graph adjacency: neighbors(i) -> ascending indices j != i.
+
+    Dense mode wraps a boolean within-matrix (small groups); sparse mode
+    holds per-node neighbor lists from pigeonhole candidate generation."""
+
+    def __init__(self, n, within=None, lists=None):
+        self.n = n
+        self._within = within
+        self._lists = lists
+
+    def neighbors(self, i: int) -> np.ndarray:
+        if self._within is not None:
+            row = np.nonzero(self._within[i])[0]
+            return row[row != i]
+        return self._lists[i]
+
+
+def build_neighbor_graph(mat: np.ndarray, max_mismatches: int,
+                         rev_mat: np.ndarray = None) -> NeighborGraph:
+    """Graph of pairs with hamming(mat[i], mat[j]) <= d (or, with rev_mat,
+    additionally hamming(rev_mat[i], mat[j]) <= d — the paired-UMI cross
+    condition, symmetric because strand reversal is an involution)."""
+    n = mat.shape[0]
+    # pigeonhole completeness needs d+1 disjoint chunks: with d+1 > L a pair
+    # can differ everywhere yet still be within distance d, so stay dense
+    if n < SPARSE_THRESHOLD or max_mismatches + 1 > mat.shape[1]:
+        within = pairwise_distances(mat) <= max_mismatches
+        if rev_mat is not None:
+            within |= pairwise_distances(rev_mat, mat) <= max_mismatches
+        return NeighborGraph(n, within=within)
+    pair_sets = [_pigeonhole_pairs(mat, mat, max_mismatches)]
+    if rev_mat is not None:
+        pair_sets.append(_pigeonhole_pairs(rev_mat, mat, max_mismatches))
+    return _lists_from_pairs(n, pair_sets)
+
+
+def _pigeonhole_pairs(A: np.ndarray, B: np.ndarray, d: int):
+    """Candidate (i, j) index arrays with hamming(A[i], B[j]) <= d, i != j.
+
+    Split columns into d+1 chunks; any pair within distance d agrees exactly
+    on at least one chunk, so exact-match buckets per chunk generate a
+    complete candidate set which is then distance-verified in bulk."""
+    n, L = A.shape
+    out_i = []
+    out_j = []
+    chunks = np.array_split(np.arange(L), min(d + 1, L))
+    same = A is B
+    for cols in chunks:
+        if len(cols) == 0:
+            continue
+        kb = np.ascontiguousarray(B[:, cols])
+        key_b = kb.view([("", np.uint8, kb.shape[1])]).ravel()
+        order_b = np.argsort(key_b, kind="stable")
+        sb = key_b[order_b]
+        bounds = np.flatnonzero(np.concatenate(
+            ([True], sb[1:] != sb[:-1], [True])))
+        if same:
+            for s, e in zip(bounds[:-1], bounds[1:]):
+                if e - s < 2:
+                    continue
+                idxs = np.sort(order_b[s:e])
+                dm = pairwise_distances(np.ascontiguousarray(B[idxs]))
+                ii, jj = np.nonzero(dm <= d)
+                keep = ii < jj
+                out_i.append(idxs[ii[keep]])
+                out_j.append(idxs[jj[keep]])
+        else:
+            ka = np.ascontiguousarray(A[:, cols])
+            key_a = ka.view([("", np.uint8, ka.shape[1])]).ravel()
+            order_a = np.argsort(key_a, kind="stable")
+            sa = key_a[order_a]
+            a_bounds = np.flatnonzero(np.concatenate(
+                ([True], sa[1:] != sa[:-1], [True])))
+            # probe B buckets by key bytes (void-dtype ordering comparisons
+            # are unreliable; equality via bytes is exact)
+            b_index = {sb[bounds[k]].tobytes(): (bounds[k], bounds[k + 1])
+                       for k in range(len(bounds) - 1)}
+            for s, e in zip(a_bounds[:-1], a_bounds[1:]):
+                got = b_index.get(sa[s].tobytes())
+                if got is None:
+                    continue
+                ai = order_a[s:e]
+                bj = order_b[got[0]:got[1]]
+                dm = pairwise_distances(np.ascontiguousarray(A[ai]),
+                                        np.ascontiguousarray(B[bj]))
+                ii, jj = np.nonzero(dm <= d)
+                gi, gj = ai[ii], bj[jj]
+                keep = gi != gj
+                out_i.append(gi[keep])
+                out_j.append(gj[keep])
+    if not out_i:
+        return (np.empty(0, np.int64), np.empty(0, np.int64))
+    return (np.concatenate(out_i).astype(np.int64),
+            np.concatenate(out_j).astype(np.int64))
+
+
+def _lists_from_pairs(n: int, pair_sets) -> NeighborGraph:
+    """Symmetrize + dedupe pair arrays into sorted per-node neighbor lists."""
+    all_i = []
+    all_j = []
+    for pi, pj in pair_sets:
+        all_i.append(pi)
+        all_j.append(pj)
+    i = np.concatenate(all_i) if all_i else np.empty(0, np.int64)
+    j = np.concatenate(all_j) if all_j else np.empty(0, np.int64)
+    # undirected: add both directions, dedupe on i*n+j
+    src = np.concatenate([i, j])
+    dst = np.concatenate([j, i])
+    enc = np.unique(src * n + dst)
+    src = enc // n
+    dst = enc % n
+    splits = np.searchsorted(src, np.arange(1, n))
+    lists = np.split(dst, splits)
+    return NeighborGraph(n, lists=lists)
+
+
 def pairwise_distances(mat_a: np.ndarray, mat_b: np.ndarray = None) -> np.ndarray:
     """All-pairs Hamming distances between byte matrices (int16).
 
@@ -174,7 +299,7 @@ class SimpleErrorUmiAssigner:
         umi_to_id = {}
         if valid:
             mat = _umi_matrix(valid)
-            within = pairwise_distances(mat) <= self.max_mismatches
+            graph = build_neighbor_graph(mat, self.max_mismatches)
             # connected components = transitive closure of the match graph
             n = len(valid)
             comp = np.full(n, -1, dtype=np.int64)
@@ -186,7 +311,8 @@ class SimpleErrorUmiAssigner:
                 comp[i] = n_comp
                 while stack:
                     j = stack.pop()
-                    for k in np.nonzero(within[j] & (comp < 0))[0]:
+                    nbrs = graph.neighbors(j)
+                    for k in nbrs[comp[nbrs] < 0]:
                         comp[k] = n_comp
                         stack.append(int(k))
                 n_comp += 1
@@ -209,11 +335,12 @@ def _count_sorted_unique(upper, keys=None):
     return sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
 
 
-def _adjacency_bfs(unique, counts, within):
+def _adjacency_bfs(unique, counts, graph: NeighborGraph):
     """UMI-tools directed BFS (assigner.rs:1480-1548).
 
-    unique/counts sorted by (-count, string); within[i, j] = candidate match.
-    Returns (roots, parent_of) where parent_of[i] is the component root index.
+    unique/counts sorted by (-count, string); graph.neighbors(i) = ascending
+    candidate matches. Returns (roots, parent_of) where parent_of[i] is the
+    component root index.
     """
     n = len(unique)
     counts_arr = np.asarray(counts)
@@ -230,7 +357,8 @@ def _adjacency_bfs(unique, counts, within):
         while queue:
             idx = queue.popleft()
             max_child = counts[idx] // 2 + 1
-            cand = np.nonzero(~assigned & (counts_arr <= max_child) & within[idx])[0]
+            nbrs = graph.neighbors(idx)
+            cand = nbrs[~assigned[nbrs] & (counts_arr[nbrs] <= max_child)]
             for child in cand:
                 child = int(child)
                 assigned[child] = True
@@ -265,8 +393,8 @@ class AdjacencyUmiAssigner:
             umi_to_id[unique[0]] = MoleculeId("S", self.counter.next_id())
         else:
             mat = _umi_matrix(unique)
-            within = pairwise_distances(mat) <= self.max_mismatches
-            roots, root_of = _adjacency_bfs(unique, counts, within)
+            graph = build_neighbor_graph(mat, self.max_mismatches)
+            roots, root_of = _adjacency_bfs(unique, counts, graph)
             root_ids = {r: MoleculeId("S", self.counter.next_id()) for r in roots}
             for i, u in enumerate(unique):
                 umi_to_id[u] = root_ids[int(root_of[i])]
@@ -303,9 +431,6 @@ class PairedUmiAssigner:
         a, b = cls._split(umi)
         return umi if a <= b else f"{b}-{a}"
 
-    def _matches(self, dist_fwd, dist_rev):
-        return (dist_fwd <= self.max_mismatches) | (dist_rev <= self.max_mismatches)
-
     def assign(self, raw_umis):
         if not raw_umis:
             return []
@@ -337,9 +462,9 @@ class PairedUmiAssigner:
         else:
             mat = _umi_matrix(unique)
             rev_mat = _umi_matrix([self._reverse(u) for u in unique])
-            within = self._matches(pairwise_distances(mat),
-                                   pairwise_distances(rev_mat, mat))
-            roots, root_of = _adjacency_bfs(unique, counts, within)
+            graph = build_neighbor_graph(mat, self.max_mismatches,
+                                         rev_mat=rev_mat)
+            roots, root_of = _adjacency_bfs(unique, counts, graph)
             root_mid = {r: self.counter.next_id() for r in roots}
             for i, u in enumerate(unique):
                 root = int(root_of[i])

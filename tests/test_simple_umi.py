@@ -1,0 +1,69 @@
+"""consensus_umis: unanimous shortcut + oracle path vs the reference formulation.
+
+The unanimous shortcut must be invisible (identical to running the oracle),
+and non-unanimous inputs must match the flat-Q20 oracle formulation exactly
+(simple_umi.rs semantics, including accumulation-order tie resolution).
+"""
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.consensus.simple_umi import consensus_umis
+from fgumi_tpu.constants import BASE_TO_CODE, CODE_TO_BASE
+from fgumi_tpu.ops import oracle
+from fgumi_tpu.ops.tables import quality_tables
+
+
+def oracle_reference(umis):
+    """The original flat-Q20 oracle formulation (semantic reference)."""
+    arr = np.array([np.frombuffer(u.encode(), dtype=np.uint8) for u in umis])
+    codes = BASE_TO_CODE[arr].astype(np.uint8)
+    quals = np.full_like(codes, 20)
+    tables = quality_tables(90, 90)
+    winner, _q, _d, _e = oracle.call_family(codes, quals, tables)
+    return "".join(chr(CODE_TO_BASE[w]) for w in winner)
+
+
+@pytest.mark.parametrize("seed", range(6))
+def test_matches_oracle_reference(seed):
+    rng = np.random.default_rng(seed)
+    for _ in range(40):
+        R = int(rng.integers(2, 9))
+        L = int(rng.integers(4, 13))
+        umis = ["".join(rng.choice(list("ACGTN"), size=L,
+                                   p=[0.23, 0.23, 0.23, 0.23, 0.08]))
+                for _ in range(R)]
+        assert consensus_umis(umis) == oracle_reference(umis)
+
+
+def test_unanimous_shortcut():
+    assert consensus_umis(["ACGT"] * 5 ) == "ACGT"
+    assert consensus_umis(["ACGT"]) == "ACGT"
+    assert consensus_umis([]) == ""
+
+
+def test_symmetric_two_way_disagreement():
+    # equal-count two-string case: winner per oracle semantics
+    assert consensus_umis(["AAAA", "CCCC"]) == oracle_reference(["AAAA", "CCCC"])
+
+
+def test_duplex_separator_preserved():
+    assert consensus_umis(["ACGT-TTTT", "ACGT-TTTA", "ACGT-TTTA"]) \
+        == "ACGT-TTTA"
+
+
+def test_separator_mismatch_raises():
+    with pytest.raises(ValueError):
+        consensus_umis(["ACGT-TT", "ACGTATT"])
+
+
+def test_length_mismatch_raises():
+    with pytest.raises(ValueError):
+        consensus_umis(["ACGT", "ACG"])
+
+
+def test_lowercase_casing_matches_oracle_path():
+    # unanimous lowercase: uppercased like the oracle path would
+    assert consensus_umis(["acgt", "acgt"]) == "ACGT"
+    # single sequence: verbatim passthrough (original behavior)
+    assert consensus_umis(["acgt"]) == "acgt"

@@ -144,3 +144,61 @@ def test_make_assigner():
         assert make_assigner(s) is not None
     with pytest.raises(ValueError):
         make_assigner("bogus")
+
+
+def test_sparse_graph_matches_dense(monkeypatch):
+    """Pigeonhole candidate generation == dense all-pairs, for all users."""
+    import numpy as np
+
+    from fgumi_tpu.umi import assigners as ua
+
+    rng = np.random.default_rng(3)
+    umis = ["".join(rng.choice(list("ACGT"), size=8)) for _ in range(600)]
+    unique = sorted(set(umis))
+    mat = ua._umi_matrix(unique)
+    dense = ua.build_neighbor_graph(mat, 1)
+    monkeypatch.setattr(ua, "SPARSE_THRESHOLD", 10)
+    sparse = ua.build_neighbor_graph(mat, 1)
+    for i in range(len(unique)):
+        assert np.array_equal(dense.neighbors(i), sparse.neighbors(i)), i
+
+
+def test_sparse_graph_matches_dense_paired(monkeypatch):
+    import numpy as np
+
+    from fgumi_tpu.umi import assigners as ua
+
+    rng = np.random.default_rng(5)
+    halves = ["".join(rng.choice(list("ACGT"), size=4)) for _ in range(400)]
+    unique = sorted({f"{a}-{b}" for a, b in zip(halves[::2], halves[1::2])})
+    mat = ua._umi_matrix(unique)
+    rev = ua._umi_matrix(["-".join(reversed(u.split("-"))) for u in unique])
+    dense = ua.build_neighbor_graph(mat, 1, rev_mat=rev)
+    monkeypatch.setattr(ua, "SPARSE_THRESHOLD", 10)
+    sparse = ua.build_neighbor_graph(mat, 1, rev_mat=rev)
+    for i in range(len(unique)):
+        assert np.array_equal(dense.neighbors(i), sparse.neighbors(i)), i
+
+
+def test_assigners_identical_across_threshold(monkeypatch):
+    """Full assign() output must not depend on the dense/sparse crossover."""
+    import numpy as np
+
+    from fgumi_tpu.umi import assigners as ua
+
+    rng = np.random.default_rng(7)
+    base = ["".join(rng.choice(list("ACGT"), size=8)) for _ in range(120)]
+    raw = []
+    for u in base:
+        raw.extend([u] * int(rng.integers(1, 5)))
+        if rng.random() < 0.5:  # 1-mismatch child
+            pos = int(rng.integers(8))
+            child = u[:pos] + "ACGT"[(("ACGT".index(u[pos])) + 1) % 4] + u[pos + 1:]
+            raw.append(child)
+    rng.shuffle(raw)
+    for cls in (ua.AdjacencyUmiAssigner, ua.SimpleErrorUmiAssigner):
+        dense_ids = [str(m) for m in cls(1).assign(list(raw))]
+        monkeypatch.setattr(ua, "SPARSE_THRESHOLD", 4)
+        sparse_ids = [str(m) for m in cls(1).assign(list(raw))]
+        monkeypatch.undo()
+        assert dense_ids == sparse_ids

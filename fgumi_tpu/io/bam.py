@@ -96,10 +96,11 @@ class BamHeader:
 class RawRecord:
     """A single BAM record's wire bytes (without the leading block_size)."""
 
-    __slots__ = ("data",)
+    __slots__ = ("data", "_tag_idx")
 
     def __init__(self, data: bytes):
         self.data = data
+        self._tag_idx = None  # lazy {tag: (typ, value_off)} built on first lookup
 
     # --- fixed-offset fields (fields.rs:7-24) ---
     @property
@@ -195,11 +196,24 @@ class RawRecord:
             off = _skip_tag_value(data, typ, off)
 
     def find_tag(self, tag: bytes):
-        """Return (type_char, python value) or None."""
-        for t, typ, off in self._iter_tags():
-            if t == tag:
-                return chr(typ), _read_tag_value(self.data, typ, off)
-        return None
+        """Return (type_char, python value) or None.
+
+        The TLV scan runs once per record and caches {tag: (typ, off)} —
+        commands typically probe several tags per record (filter reads 5+),
+        and rescanning the aux region per probe dominated their profiles.
+        """
+        idx = self._tag_idx
+        if idx is None:
+            idx = {}
+            for t, typ, off in self._iter_tags():
+                if t not in idx:  # first occurrence wins, like the linear scan
+                    idx[t] = (typ, off)
+            self._tag_idx = idx
+        got = idx.get(tag)
+        if got is None:
+            return None
+        typ, off = got
+        return chr(typ), _read_tag_value(self.data, typ, off)
 
     def get_str(self, tag: bytes):
         got = self.find_tag(tag)

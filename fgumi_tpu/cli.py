@@ -634,7 +634,9 @@ def _add_sort(sub):
     p.add_argument("--tmp-dir", default=None)
     p.add_argument("--write-index", type=_parse_bool, nargs="?", const=True,
                    default=True, metavar="true|false",
-                   help="write a .bai alongside coordinate-sorted output")
+                   help="write an index alongside coordinate-sorted output")
+    p.add_argument("--index-format", default="bai", choices=["bai", "csi"],
+                   help="index flavor (csi handles references > 512 Mbp)")
     p.set_defaults(func=cmd_sort)
 
 
@@ -680,9 +682,17 @@ def cmd_sort(args):
             ref_names=reader.header.ref_names, ref_lengths=reader.header.ref_lengths)
         bai = None
         if args.order == "coordinate" and args.write_index:
-            from .io.bai import BaiBuilder
+            from .io.bai import BaiBuilder, CsiBuilder, depth_for_length
 
-            bai = BaiBuilder(len(reader.header.ref_names))
+            if args.index_format == "csi":
+                # depth sized to the longest reference (htslib rule) so
+                # >512 Mbp chromosomes get valid bins
+                bai = CsiBuilder(
+                    len(reader.header.ref_names),
+                    depth=depth_for_length(
+                        max(reader.header.ref_lengths, default=0)))
+            else:
+                bai = BaiBuilder(len(reader.header.ref_names))
         from .utils.progress import ProgressTracker
 
         progress = ProgressTracker("sort")
@@ -710,7 +720,7 @@ def cmd_sort(args):
                                 not rec.flag & FLAG_UNMAPPED)
             wprogress.finish()
         if bai is not None:
-            bai.write(args.output + ".bai")
+            bai.write(args.output + "." + args.index_format)
     dt = time.monotonic() - t0
     log.info("sort: %d records (%s, budget %dMB) in %.2fs (%.0f rec/s)",
              sorter.n_records, args.order, budget >> 20, dt,

@@ -147,21 +147,174 @@ class BaiIndex:
         """Merged, linear-index-filtered chunk list overlapping [beg, end)."""
         if tid < 0 or tid >= len(self.bins):
             return []
-        bins = self.bins[tid]
         linear = self.linear[tid]
         win = beg >> _LINEAR_SHIFT
         min_vo = linear[win] if win < len(linear) else (
             linear[-1] if linear else 0)
-        chunks = []
-        for b in reg2bins(beg, end):
-            for c_beg, c_end in bins.get(b, ()):
-                if c_end > min_vo:
-                    chunks.append((max(c_beg, min_vo), c_end))
-        chunks.sort()
-        merged = []
-        for c in chunks:
-            if merged and c[0] <= merged[-1][1]:
-                merged[-1] = (merged[-1][0], max(merged[-1][1], c[1]))
-            else:
-                merged.append(c)
-        return merged
+        return _filter_merge_chunks(self.bins[tid], reg2bins(beg, end), min_vo)
+
+
+def _filter_merge_chunks(bins: dict, bin_ids, min_vo: int):
+    """Chunk overlap filter + clamp + sort + adjacent merge (shared by the
+    BAI and CSI readers)."""
+    chunks = []
+    for b in bin_ids:
+        for c_beg, c_end in bins.get(b, ()):
+            if c_end > min_vo:
+                chunks.append((max(c_beg, min_vo), c_end))
+    chunks.sort()
+    merged = []
+    for c in chunks:
+        if merged and c[0] <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], c[1]))
+        else:
+            merged.append(c)
+    return merged
+
+
+def depth_for_length(max_ref_length: int, min_shift: int = 14) -> int:
+    """Smallest CSI depth whose bin tree covers max_ref_length (htslib rule)."""
+    depth = 5
+    while max_ref_length > 1 << (min_shift + 3 * depth):
+        depth += 1
+    return depth
+
+
+# ---------------------------------------------------------------------------
+# CSI (.csi): the generalized binning index (BGZF-compressed, configurable
+# min_shift/depth, so references longer than 2^29 index correctly). Same
+# bin/chunk structures as BAI with loffset per bin replacing the linear
+# index. Reference analog: indexed_reader.rs CSI support.
+
+_CSI_MAGIC = b"CSI\x01"
+
+
+def reg2bin_ext(beg: int, end: int, min_shift: int = 14, depth: int = 5) -> int:
+    """Generalized reg2bin (CSI spec) over [beg, end)."""
+    end -= 1
+    level = depth
+    s = min_shift
+    t = ((1 << depth * 3) - 1) // 7
+    while level > 0:
+        if beg >> s == end >> s:
+            return t + (beg >> s)
+        level -= 1
+        s += 3
+        t -= 1 << level * 3
+    return 0
+
+
+def reg2bins_ext(beg: int, end: int, min_shift: int = 14, depth: int = 5):
+    """All bins overlapping [beg, end) for arbitrary min_shift/depth
+    (CSI spec loop: level 0 is the root bin at shift min_shift + depth*3)."""
+    end -= 1
+    bins = []
+    s = min_shift + depth * 3
+    t = 0
+    for level in range(depth + 1):
+        bins.extend(range(t + (beg >> s), t + (end >> s) + 1))
+        t += 1 << (level * 3)
+        s -= 3
+    return bins
+
+
+class CsiIndex:
+    """Parsed .csi: bins/chunks + per-bin loffset, for region queries."""
+
+    def __init__(self, path: str):
+        import gzip
+
+        with gzip.open(path, "rb") as f:
+            data = f.read()
+        if data[:4] != _CSI_MAGIC:
+            raise ValueError(f"not a CSI file: {path}")
+        self.min_shift, self.depth, l_aux = struct.unpack_from("<iii", data, 4)
+        off = 16 + l_aux
+        (n_ref,) = struct.unpack_from("<i", data, off)
+        off += 4
+        self.bins = []
+        self.loffsets = []
+        for _ in range(n_ref):
+            (n_bin,) = struct.unpack_from("<i", data, off)
+            off += 4
+            bins = {}
+            loff = {}
+            for _ in range(n_bin):
+                b, l_off, n_chunk = struct.unpack_from("<IQi", data, off)
+                off += 16
+                chunks = []
+                for _ in range(n_chunk):
+                    cb, ce = struct.unpack_from("<QQ", data, off)
+                    off += 16
+                    chunks.append((cb, ce))
+                bins[b] = chunks
+                loff[b] = l_off
+            self.bins.append(bins)
+            self.loffsets.append(loff)
+
+    def query_chunks(self, tid: int, beg: int, end: int):
+        """Merged chunk list overlapping [beg, end).
+
+        min_vo is deliberately 0: a bin's loffset only reflects records
+        *assigned* to it (not every record overlapping its interval), so
+        using it to prune can drop boundary-spanning records stored in
+        ancestor bins; correctness over the micro-optimization.
+        """
+        if tid < 0 or tid >= len(self.bins):
+            return []
+        return _filter_merge_chunks(
+            self.bins[tid],
+            reg2bins_ext(beg, end, self.min_shift, self.depth), 0)
+
+
+class CsiBuilder:
+    """Accumulates placed records and writes a .csi index."""
+
+    def __init__(self, n_refs: int, min_shift: int = 14, depth: int = 5):
+        self.n_refs = n_refs
+        self.min_shift = min_shift
+        self.depth = depth
+        self._bins = [dict() for _ in range(n_refs)]
+        self._loff = [dict() for _ in range(n_refs)]
+        self.n_no_coor = 0
+
+    def add(self, tid: int, beg: int, end: int, vo_start: int, vo_end: int,
+            mapped: bool = True):
+        if tid < 0:
+            self.n_no_coor += 1
+            return
+        end = max(end, beg + 1)
+        b = reg2bin_ext(beg, end, self.min_shift, self.depth)
+        chunks = self._bins[tid].setdefault(b, [])
+        if chunks and chunks[-1][1] == vo_start:
+            chunks[-1][1] = vo_end
+        else:
+            chunks.append([vo_start, vo_end])
+        # loffset propagates to ancestors too: a record overlapping bin b
+        # overlaps every ancestor's interval (external readers prune on it)
+        loff = self._loff[tid]
+        bb = b
+        while True:
+            if bb not in loff or vo_start < loff[bb]:
+                loff[bb] = vo_start
+            if bb == 0:
+                break
+            bb = (bb - 1) >> 3
+
+    def write(self, path: str):
+        import gzip
+
+        out = bytearray(_CSI_MAGIC)
+        out += struct.pack("<iii", self.min_shift, self.depth, 0)
+        out += struct.pack("<i", self.n_refs)
+        for tid in range(self.n_refs):
+            bins = self._bins[tid]
+            out += struct.pack("<i", len(bins))
+            for b in sorted(bins):
+                chunks = bins[b]
+                out += struct.pack("<IQi", b, self._loff[tid][b], len(chunks))
+                for beg, end in chunks:
+                    out += struct.pack("<QQ", beg, end)
+        out += struct.pack("<Q", self.n_no_coor)
+        with gzip.open(path, "wb", compresslevel=1) as f:
+            f.write(bytes(out))

@@ -468,12 +468,20 @@ class BamIndexedReader:
     each chunk's virtual offset, and records are filtered by actual overlap.
     """
 
-    def __init__(self, path: str, bai_path: str = None):
+    def __init__(self, path: str, index_path: str = None):
+        """`index_path`: explicit .bai/.csi path; by default .bai is tried
+        first, then .csi (both expose the same query_chunks interface)."""
+        import os
+
         with BamReader(path) as r:
             self.header = r.header
-        from .bai import BaiIndex
+        from .bai import BaiIndex, CsiIndex
 
-        self.index = BaiIndex(bai_path or path + ".bai")
+        if index_path is None:
+            index_path = path + ".bai" if os.path.exists(path + ".bai") \
+                else path + ".csi"
+        self.index = CsiIndex(index_path) if index_path.endswith(".csi") \
+            else BaiIndex(index_path)
         self._f = open(path, "rb")
 
     def query(self, tid: int, beg: int, end: int):

@@ -193,3 +193,65 @@ def test_progress_tracker(caplog):
     heartbeats = [r for r in caplog.records if "records processed" in r.message]
     assert len(heartbeats) == 3  # crossings at 120, 240, 300 (every=100)
     assert any("done" in r.message for r in caplog.records)
+
+
+def test_csi_binning_matches_bai_at_default_params():
+    from fgumi_tpu.io.bai import reg2bin_ext, reg2bins_ext, reg2bins
+
+    import random
+    rng = random.Random(21)
+    for _ in range(300):
+        beg = rng.randrange(0, 1 << 29)
+        end = beg + rng.randrange(1, 10000)
+        assert reg2bin_ext(beg, end) == reg2bin(beg, end)
+        assert sorted(reg2bins_ext(beg, end)) == sorted(reg2bins(beg, end))
+
+
+def test_csi_sort_and_query(tmp_path):
+    """sort --index-format csi -> queryable via BamIndexedReader, same
+    results as the BAI index on the identical BAM."""
+    from fgumi_tpu.io.bam import BamIndexedReader
+
+    sim = str(tmp_path / "m3.bam")
+    cli_main(["simulate", "mapped-reads", "-o", sim, "--num-families", "60",
+              "--family-size", "3", "--seed", "19"])
+    out_csi = str(tmp_path / "csi.bam")
+    cli_main(["sort", "-i", sim, "-o", out_csi, "--order", "coordinate",
+              "--index-format", "csi"])
+    out_bai = str(tmp_path / "bai.bam")
+    cli_main(["sort", "-i", sim, "-o", out_bai, "--order", "coordinate"])
+    import os
+    assert os.path.exists(out_csi + ".csi")
+    with BamReader(out_csi) as r:
+        recs = [rec for rec in r if rec.ref_id == 0]
+    mid = recs[len(recs) // 2].pos
+    with BamIndexedReader(out_csi) as ir_c, BamIndexedReader(out_bai) as ir_b:
+        got_c = {rec.data for rec in ir_c.query(0, mid, mid + 2000)}
+        got_b = {rec.data for rec in ir_b.query(0, mid, mid + 2000)}
+    assert got_c == got_b
+    assert got_c
+
+
+def test_csi_deep_coordinates():
+    """CSI handles positions beyond the BAI 2^29 ceiling."""
+    from fgumi_tpu.io.bai import CsiBuilder, CsiIndex, reg2bin_ext
+    import tempfile, os
+
+    pos = (1 << 31) + 12345
+    b = CsiBuilder(1, min_shift=14, depth=6)
+    b.add(0, pos, pos + 100, 7 << 16, 8 << 16)
+    path = os.path.join(tempfile.mkdtemp(), "deep.csi")
+    b.write(path)
+    idx = CsiIndex(path)
+    assert idx.min_shift == 14 and idx.depth == 6
+    chunks = idx.query_chunks(0, pos + 10, pos + 20)
+    assert chunks == [(7 << 16, 8 << 16)]
+    assert idx.query_chunks(0, 0, 1000) == []
+
+
+def test_csi_depth_sizing():
+    from fgumi_tpu.io.bai import depth_for_length
+
+    assert depth_for_length(1 << 29) == 5
+    assert depth_for_length((1 << 29) + 1) == 6
+    assert depth_for_length(3_100_000_000) == 6  # hg38-scale

@@ -35,23 +35,26 @@ import jax  # noqa: init the backend before timing anything
 
 from fgumi_tpu.cli import main
 
-in_bam, out_dir, threads = sys.argv[1], sys.argv[2], sys.argv[3]
+in_bam, out_dir, threads, cmd = sys.argv[1:5]
 platform = jax.devices()[0].platform
-base = ["simplex", "-i", in_bam, "--min-reads", "1", "--threads", threads]
+if cmd == "simplex":
+    base = ["simplex", "-i", in_bam, "--min-reads", "1", "--threads", threads]
+else:
+    base = ["duplex", "-i", in_bam, "--min-reads", "1"]
 t0 = time.monotonic()
 rc = main(base + ["-o", os.path.join(out_dir, "warm.bam")])
 warm_s = time.monotonic() - t0
-assert rc == 0, "warm-up simplex run failed"
+assert rc == 0, "warm-up run failed"
 t0 = time.monotonic()
 rc = main(base + ["-o", os.path.join(out_dir, "timed.bam")])
 wall_s = time.monotonic() - t0
-assert rc == 0, "timed simplex run failed"
+assert rc == 0, "timed run failed"
 print(json.dumps({"platform": platform, "device": str(jax.devices()[0]),
                   "warm_s": round(warm_s, 3), "wall_s": round(wall_s, 3)}))
 """
 
 
-def run_worker(in_bam, threads, env_overrides, timeout_s):
+def run_worker(in_bam, threads, env_overrides, timeout_s, cmd="simplex"):
     """One timed pipeline run in a subprocess. Returns (result|None, error)."""
     env = dict(os.environ)
     env.update(env_overrides)
@@ -59,7 +62,7 @@ def run_worker(in_bam, threads, env_overrides, timeout_s):
         try:
             proc = subprocess.run(
                 [sys.executable, "-c", _WORKER % {"repo": REPO}, in_bam,
-                 out_dir, str(threads)],
+                 out_dir, str(threads), cmd],
                 capture_output=True, text=True, timeout=timeout_s, env=env)
         except subprocess.TimeoutExpired:
             return None, f"timeout after {timeout_s}s (wedged device init?)"
@@ -98,17 +101,26 @@ def main():
     n_reads = count_records(sim)
 
     diagnostics = []
-    # TPU run: ambient env (the driver provides the TPU backend). Retry once —
-    # the tunnel occasionally wedges on first contact.
+    # TPU run: ambient env (the driver provides the TPU backend). Retry once
+    # on non-timeout errors; a timeout means the tunnel is wedged and further
+    # device attempts would only burn the bench budget.
+    device_dead = False
     tpu, err = run_worker(sim, threads, {}, timeout_s)
     if tpu is None:
         diagnostics.append(f"device attempt 1: {err}")
-        tpu, err = run_worker(sim, threads, {}, timeout_s)
-        if tpu is None:
-            diagnostics.append(f"device attempt 2: {err}")
+        if (err or "").startswith("timeout after"):
+            device_dead = True
+        else:
+            tpu, err = run_worker(sim, threads, {}, timeout_s)
+            if tpu is None:
+                diagnostics.append(f"device attempt 2: {err}")
+                device_dead = (err or "").startswith("timeout after")
 
     # CPU baseline: identical pipeline, jax pinned to CPU.
-    cpu, err = run_worker(sim, threads, {"JAX_PLATFORMS": "cpu"}, timeout_s)
+    # PYTHONPATH cleared: the injected axon sitecustomize can block jax init
+    # even under JAX_PLATFORMS=cpu while the tunnel is wedged
+    cpu_env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
+    cpu, err = run_worker(sim, threads, cpu_env, timeout_s)
     if cpu is None:
         diagnostics.append(f"cpu baseline: {err}")
 
@@ -146,6 +158,32 @@ def main():
             result["note"] = "device run failed; value measured on CPU"
         if diagnostics:
             result["diagnostics"] = diagnostics
+
+    # secondary metric: duplex consensus throughput (BASELINE eval config 3)
+    if os.environ.get("BENCH_DUPLEX", "1") not in ("0", "false"):
+        from fgumi_tpu.simulate import simulate_duplex_bam
+
+        dup = os.path.join(tmp, "duplex.bam")
+        n_dup = simulate_duplex_bam(dup, num_molecules=max(n_families // 8, 500),
+                                    reads_per_strand=3, seed=42)
+        d_tpu, derr = (None, "device wedged (skipped)") if device_dead \
+            else run_worker(dup, threads, {}, timeout_s, cmd="duplex")
+        d_cpu, d_cpu_err = run_worker(dup, threads, cpu_env, timeout_s,
+                                      cmd="duplex")
+        d_timed = d_tpu or d_cpu
+        dup_diag = []
+        if derr:
+            dup_diag.append(f"duplex device: {derr}")
+        if d_cpu_err:
+            dup_diag.append(f"duplex cpu: {d_cpu_err}")
+        if d_timed is not None:
+            result["duplex_reads_per_sec"] = round(n_dup / d_timed["wall_s"], 1)
+            result["duplex_platform"] = d_timed["platform"]
+            if d_cpu is not None and d_tpu is not None:
+                result["duplex_vs_baseline"] = round(
+                    d_cpu["wall_s"] / d_tpu["wall_s"], 3)
+        if dup_diag:
+            result["duplex_diagnostics"] = dup_diag
     print(json.dumps(result))
     return 0
 

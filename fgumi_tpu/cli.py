@@ -78,6 +78,9 @@ def _add_simplex(sub):
     p.add_argument("--no-per-base-tags", action="store_true")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--allow-unmapped", action="store_true")
+    p.add_argument("--rejects", default=None,
+                   help="optional BAM for raw reads that contribute to no "
+                        "consensus (secondary output stream)")
     p.add_argument("--consensus-call-overlapping-bases", type=_parse_bool,
                    nargs="?", const=True, default=True, metavar="true|false",
                    help="pre-correct R1/R2 insert-overlap bases before UMI "
@@ -185,30 +188,38 @@ def cmd_simplex(args):
         stats = StageTimes()
         mesh = _build_dp_mesh(getattr(args, "devices", "auto"))
         with BamBatchReader(args.input, target_bytes=args.batch_bytes) as reader:
-            caller = VanillaConsensusCaller(args.read_name_prefix,
-                                            args.read_group_id, opts,
-                                            reference=reference,
-                                            ref_names=reader.header.ref_names)
+            caller = VanillaConsensusCaller(
+                args.read_name_prefix, args.read_group_id, opts,
+                reference=reference, ref_names=reader.header.ref_names,
+                track_rejects=args.rejects is not None)
             fast = FastSimplexCaller(caller, args.tag.encode(),
                                      overlap_caller=oc_caller, mesh=mesh)
             allow_unmapped = args.allow_unmapped
             from .utils.progress import ProgressTracker
 
             progress = ProgressTracker("simplex")
+            from .consensus.rejects import RejectsSink
 
-            def _process(batch):
-                progress.add(batch.n)
-                return fast.process_batch(batch, allow_unmapped)
+            with RejectsSink(args.rejects, reader.header) as rejects:
 
-            with BamWriter(args.output, out_header) as writer:
-                # device fetch + serialize resolve on the sink stage, so with
-                # --threads they overlap the next batch's host prep
-                run_stages(
-                    iter(reader), _process,
-                    lambda chunk: writer.write_serialized(resolve_chunk(chunk)),
-                    threads=args.threads, queue_items=queue_items, stats=stats)
-                for blob in fast.flush():
-                    writer.write_serialized(resolve_chunk(blob))
+                def _process(batch):
+                    progress.add(batch.n)
+                    out = fast.process_batch(batch, allow_unmapped)
+                    rejects.drain(caller)
+                    return out
+
+                with BamWriter(args.output, out_header) as writer:
+                    # device fetch + serialize resolve on the sink stage, so
+                    # with --threads they overlap the next batch's host prep
+                    run_stages(
+                        iter(reader), _process,
+                        lambda chunk: writer.write_serialized(
+                            resolve_chunk(chunk)),
+                        threads=args.threads, queue_items=queue_items,
+                        stats=stats)
+                    for blob in fast.flush():
+                        writer.write_serialized(resolve_chunk(blob))
+                    rejects.drain(caller)
             progress.finish()
         n_out = caller.stats.consensus_reads
         if args.stats:
@@ -217,25 +228,28 @@ def cmd_simplex(args):
         from .consensus.overlapping import apply_overlapping_consensus
 
         with BamReader(args.input) as reader:
-            caller = VanillaConsensusCaller(args.read_name_prefix,
-                                            args.read_group_id, opts,
-                                            reference=reference,
-                                            ref_names=reader.header.ref_names)
-            with BamWriter(args.output, out_header) as writer:
+            caller = VanillaConsensusCaller(
+                args.read_name_prefix, args.read_group_id, opts,
+                reference=reference, ref_names=reader.header.ref_names,
+                track_rejects=args.rejects is not None)
+            from .consensus.rejects import RejectsSink
+
+            with RejectsSink(args.rejects, reader.header) as rejects, \
+                    BamWriter(args.output, out_header) as writer:
                 n_out = 0
                 allow_unmapped = args.allow_unmapped
                 pregroup = lambda r: consensus_pregroup_keep(r.flag,
                                                              allow_unmapped)
-                for batch in iter_mi_group_batches(reader, args.batch_groups,
-                                                   tag=args.tag.encode(),
-                                                   record_filter=pregroup):
+                for batch in iter_mi_group_batches(
+                        reader, args.batch_groups, tag=args.tag.encode(),
+                        record_filter=pregroup):
                     if oc_caller is not None:
-                        batch = [(umi,
-                                  apply_overlapping_consensus(recs, oc_caller))
-                                 for umi, recs in batch]
+                        batch = [(umi, apply_overlapping_consensus(
+                            recs, oc_caller)) for umi, recs in batch]
                     for rec_bytes in caller.call_groups(batch):
                         writer.write_record_bytes(rec_bytes)
                         n_out += 1
+                    rejects.drain(caller)
     dt = time.monotonic() - t0
     s = caller.stats
     log.info("simplex[%s]: %d input reads -> %d consensus reads in %.2fs "
@@ -275,6 +289,9 @@ def _add_duplex(sub):
                    nargs="?", const=True, default=True, metavar="true|false",
                    help="pre-correct R1/R2 insert-overlap bases before UMI "
                         "consensus (default true)")
+    p.add_argument("--rejects", default=None,
+                   help="optional BAM for raw reads that contribute to no "
+                        "consensus (secondary output stream)")
     p.add_argument("--batch-molecules", type=int, default=1000)
     p.set_defaults(func=cmd_duplex)
 
@@ -291,7 +308,8 @@ def cmd_duplex(args):
             produce_per_base_tags=not args.no_per_base_tags, trim=args.trim,
             max_reads_per_strand=args.max_reads_per_strand,
             error_rate_pre_umi=args.error_rate_pre_umi,
-            error_rate_post_umi=args.error_rate_post_umi, seed=args.seed)
+            error_rate_post_umi=args.error_rate_post_umi, seed=args.seed,
+            track_rejects=args.rejects is not None)
     except ValueError as e:
         log.error("%s", e)
         return 2
@@ -305,7 +323,10 @@ def cmd_duplex(args):
         oc_caller = OverlappingBasesConsensusCaller("consensus", "consensus")
     with BamReader(args.input) as reader:
         out_header = _unmapped_consensus_header(args.read_group_id)
-        with BamWriter(args.output, out_header) as writer:
+        from .consensus.rejects import RejectsSink
+
+        with RejectsSink(args.rejects, reader.header) as rejects, \
+                BamWriter(args.output, out_header) as writer:
             n_out = 0
             pregroup = lambda r: consensus_pregroup_keep(r.flag, allow_unmapped)
             batch = []
@@ -323,11 +344,13 @@ def cmd_duplex(args):
                     for rec_bytes in caller.call_groups(batch):
                         writer.write_record_bytes(rec_bytes)
                         n_out += 1
+                    rejects.drain(caller)
                     batch = []
             if batch:
                 for rec_bytes in caller.call_groups(batch):
                     writer.write_record_bytes(rec_bytes)
                     n_out += 1
+                rejects.drain(caller)
     dt = time.monotonic() - t0
     s = caller.merged_stats()
     log.info("duplex: %d input reads -> %d consensus reads in %.2fs (%.0f reads/s)",

@@ -29,6 +29,7 @@ from ..core.overlap import num_bases_extending_past_mate
 from ..io.bam import (FLAG_FIRST, FLAG_LAST, FLAG_PAIRED, FLAG_REVERSE,
                       RawRecord, RecordBuilder)
 from .simple_umi import consensus_umis
+from .rejects import RejectTracking
 from .vanilla import (CallerStats, I16_MAX, R1, R2, VanillaConsensusCaller,
                       VanillaConsensusRead, VanillaOptions, _TYPE_FLAGS)
 
@@ -141,14 +142,15 @@ def duplex_combine(ab: Optional[VanillaConsensusRead], ba: Optional[VanillaConse
                                ab_consensus=truncate(ab), ba_consensus=truncate(ba))
 
 
-class DuplexConsensusCaller:
+class DuplexConsensusCaller(RejectTracking):
     """Duplex caller over base-MI groups carrying /A and /B strand reads."""
 
     def __init__(self, read_name_prefix: str, read_group_id: str, min_reads=(1,),
                  min_input_base_quality: int = 10, produce_per_base_tags: bool = True,
                  trim: bool = False, max_reads_per_strand: Optional[int] = None,
                  error_rate_pre_umi: int = 45, error_rate_post_umi: int = 40,
-                 seed: Optional[int] = 42, kernel=None):
+                 seed: Optional[int] = 42, kernel=None,
+                 track_rejects: bool = False):
         self.prefix = read_name_prefix
         self.read_group_id = read_group_id
         self.min_total, self.min_xy, self.min_yx = parse_min_reads(min_reads)
@@ -165,6 +167,7 @@ class DuplexConsensusCaller:
                                          kernel=kernel)
         self.kernel = self.ss.kernel
         self.stats = CallerStats()
+        self._init_rejects(track_rejects)
         self._builder = RecordBuilder()
         self._ordinal = 0
 
@@ -191,6 +194,8 @@ class DuplexConsensusCaller:
         frags = sum(1 for r in a_records + b_records if not r.flag & FLAG_PAIRED)
         if frags:
             self.stats.reject("FragmentRead", frags)
+            self._reject_records(r for r in a_records + b_records
+                                 if not r.flag & FLAG_PAIRED)
             a_records = [r for r in a_records if r.flag & FLAG_PAIRED]
             b_records = [r for r in b_records if r.flag & FLAG_PAIRED]
 
@@ -209,6 +214,8 @@ class DuplexConsensusCaller:
         if not (self.min_total <= num_xy + num_yx and self.min_xy <= num_xy
                 and self.min_yx <= num_yx):
             self.stats.reject("InsufficientReads", len(a_records) + len(b_records))
+            self._reject_records(a_records)
+            self._reject_records(b_records)
             return None
 
         ab_r1 = [r for r in a_records if is_r1(r)]
@@ -225,21 +232,44 @@ class DuplexConsensusCaller:
             if not same_strand(ab_r1 + ba_r2) or not same_strand(ab_r2 + ba_r1):
                 self.stats.reject("PotentialCollision",
                                   len(a_records) + len(b_records))
+                self._reject_records(a_records)
+                self._reject_records(b_records)
                 return None
 
-        # X = AB-R1 + BA-R2, Y = AB-R2 + BA-R1: convert + filter together
+        # X = AB-R1 + BA-R2, Y = AB-R2 + BA-R1: convert + filter together.
+        # Reads dropped here contribute to no consensus even when the
+        # molecule succeeds, so they are rejected immediately; prep_ids keeps
+        # a later molecule-level failure from double-rejecting them.
+        prep_ids = set()
+
+        def prep_reject(recs):
+            if self.track_rejects:
+                recs = list(recs)
+                prep_ids.update(map(id, recs))
+                self._reject_records(recs)
+
         def to_sources(recs):
             out = []
             for i, r in enumerate(recs):
                 sr = self.ss._create_source_read(r, i, num_bases_extending_past_mate(r))
                 if sr is not None:
                     out.append(sr)
+                else:  # unconvertible: 0xFF quals / zero length
+                    prep_reject([r])
             return out
+
+        def filter_alignment(sources, raws_list):
+            kept = self.ss._filter_by_alignment(sources)
+            if len(kept) < len(sources):
+                kept_idx = {sr.original_idx for sr in kept}
+                prep_reject(raws_list[sr.original_idx] for sr in sources
+                            if sr.original_idx not in kept_idx)
+            return kept
 
         x_raws = ab_r1 + ba_r2
         y_raws = ab_r2 + ba_r1
-        filtered_x = self.ss._filter_by_alignment(to_sources(x_raws))
-        filtered_y = self.ss._filter_by_alignment(to_sources(y_raws))
+        filtered_x = filter_alignment(to_sources(x_raws), x_raws)
+        filtered_y = filter_alignment(to_sources(y_raws), y_raws)
 
         f_ab_r1 = [sr for sr in filtered_x if sr.flags & FLAG_FIRST]
         f_ba_r2 = [sr for sr in filtered_x if not sr.flags & FLAG_FIRST]
@@ -262,7 +292,12 @@ class DuplexConsensusCaller:
             "ba_r1": [y_raws[sr.original_idx] for sr in f_ba_r1],
         }
         return {"base_mi": base_mi, "jobs": jobs, "raws": raws,
-                "n_records": len(a_records) + len(b_records)}
+                "n_records": len(a_records) + len(b_records),
+                # molecule-failure rejects: only reads not already rejected
+                # during prep (built only when tracking)
+                "all_records": [r for r in list(a_records) + list(b_records)
+                                if id(r) not in prep_ids]
+                if self.track_rejects else ()}
 
     # ---------------------------------------------------------------- stage 2
 
@@ -296,6 +331,7 @@ class DuplexConsensusCaller:
                     self.stats.consensus_reads += 2
                     return recs
                 self.stats.reject("InsufficientReads", mol["n_records"])
+                self._reject_records(mol.get("all_records", ()))
                 return None
         elif ab_r1 is not None and ab_r2 is not None and ba_r1 is None \
                 and ba_r2 is None:
@@ -323,6 +359,7 @@ class DuplexConsensusCaller:
                     self.stats.consensus_reads += 2
                     return recs
         self.stats.reject("InsufficientReads", mol["n_records"])
+        self._reject_records(mol.get("all_records", ()))
         return None
 
     # ---------------------------------------------------------------- output

@@ -322,10 +322,16 @@ class FastSimplexCaller:
         ordinal = caller._group_ordinal
         caller._group_ordinal += 1
 
+        def rej(rows_arr):
+            # rejects materialize as RawRecords only when tracking is on
+            if caller.track_rejects and len(rows_arr):
+                caller.rejected_reads.extend(batch.raw_records(span[rows_arr]))
+
         # secondary/supplementary were pre-filtered from idx; prepare_group's
         # first filter is a no-op here, so `reads` == all group records
         if n_records < opts.min_reads:
             stats.reject("InsufficientReads", int(n_records))
+            rej(np.arange(s, e))
             return
 
         rows = np.arange(s, e)
@@ -343,17 +349,20 @@ class FastSimplexCaller:
                 continue
             if len(t_rows) < opts.min_reads:
                 stats.reject("InsufficientReads", int(len(t_rows)))
+                rej(t_rows)
                 continue
             lens = final_len[t_rows]
             ok = lens > 0
             zero_len = int((~ok).sum())
             if zero_len:
                 stats.reject("ZeroLengthAfterTrimming", zero_len)
+                rej(t_rows[~ok])
                 t_rows = t_rows[ok]
                 lens = lens[ok]
             if len(t_rows) < opts.min_reads:
                 if len(t_rows):
                     stats.reject("InsufficientReads", int(len(t_rows)))
+                    rej(t_rows)
                 continue
             # most-common-alignment filter (vanilla.py:210-222): identical
             # simplified CIGARs always form a single compatibility group ->
@@ -378,11 +387,15 @@ class FastSimplexCaller:
                 rejected = len(t_rows) - len(keep_rows)
                 if rejected:
                     stats.reject("MinorityAlignment", rejected)
+                    keep_set = set(keep_rows.tolist())
+                    rej(np.array([r for r in t_rows if r not in keep_set],
+                                 dtype=np.int64))
                 t_rows = keep_rows
                 lens = final_len[t_rows]
                 if len(t_rows) < opts.min_reads:
                     if len(t_rows):
                         stats.reject("InsufficientReads", int(len(t_rows)))
+                        rej(t_rows)
                     continue
             lens_sorted = np.sort(lens)[::-1]
             consensus_len = int(lens_sorted[opts.min_reads - 1])
@@ -397,8 +410,10 @@ class FastSimplexCaller:
             jobs.extend([r1, r2])
         elif r1 is not None:
             stats.reject("OrphanConsensus", len(r1.rows))
+            rej(r1.rows)
         elif r2 is not None:
             stats.reject("OrphanConsensus", len(r2.rows))
+            rej(r2.rows)
 
     def _alignment_filter(self, batch, span, t_rows, lens):
         """Non-uniform CIGARs: decode + simplify + truncate per read, then the

@@ -1,0 +1,54 @@
+"""Rejects-stream plumbing shared by the consensus callers and commands.
+
+The reference treats the rejects BAM as a first-class secondary output of
+the pipeline (base.rs:1838, used by simplex/duplex/codec/filter/correct);
+here the callers accumulate rejected RawRecords via RejectTracking and the
+commands drain them through a RejectsSink.
+"""
+
+
+class RejectTracking:
+    """Mixin: rejected-raw-record accumulation (no-op unless enabled)."""
+
+    def _init_rejects(self, track_rejects: bool):
+        self.track_rejects = track_rejects
+        self.rejected_reads = []
+
+    def _reject_records(self, records):
+        if self.track_rejects:
+            self.rejected_reads.extend(records)
+
+    def take_rejects(self):
+        out = self.rejected_reads
+        self.rejected_reads = []
+        return out
+
+
+class RejectsSink:
+    """Optional rejects BAM writer: no-ops when no path was requested.
+
+    Rejects keep the INPUT header (raw RG/PG/contig metadata preserved),
+    matching the reference's secondary-output convention.
+    """
+
+    def __init__(self, path, header):
+        self._writer = None
+        if path is not None:
+            from ..io.bam import BamWriter
+
+            self._writer = BamWriter(path, header)
+
+    def drain(self, caller):
+        if self._writer is not None:
+            for rec in caller.take_rejects():
+                self._writer.write_record(rec)
+
+    def close(self):
+        if self._writer is not None:
+            self._writer.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -30,6 +30,7 @@ from ..io.bam import (FLAG_FIRST, FLAG_LAST, FLAG_MATE_UNMAPPED, FLAG_PAIRED,
 from ..ops import oracle
 from ..ops.kernel import ConsensusKernel, pad_segments
 from ..ops.tables import quality_tables
+from .rejects import RejectTracking
 from .simple_umi import consensus_umis
 
 I16_MAX = 32767
@@ -150,15 +151,17 @@ def find_quality_trim_point(quals: np.ndarray, trim_qual: int) -> int:
     return trim_point
 
 
-class VanillaConsensusCaller:
+class VanillaConsensusCaller(RejectTracking):
     """Simplex consensus caller over MI groups, batched onto the TPU kernel."""
 
     def __init__(self, read_name_prefix: str, read_group_id: str,
                  options: VanillaOptions = None, kernel: ConsensusKernel = None,
-                 reference=None, ref_names=None):
+                 reference=None, ref_names=None, track_rejects: bool = False):
         """`reference`: chrom -> bytes mapping (or any .get-able) and
         `ref_names`: BAM ref_id -> name list; both required only for
-        methylation-aware calling."""
+        methylation-aware calling. With `track_rejects`, raw records that do
+        not contribute to any consensus accumulate in `rejected_reads` (the
+        reference's secondary rejects stream, base.rs:1838)."""
         self.options = options or VanillaOptions()
         self.reference = reference
         self.ref_names = ref_names or []
@@ -168,6 +171,7 @@ class VanillaConsensusCaller:
                                      self.options.error_rate_post_umi)
         self.kernel = kernel or ConsensusKernel(self.tables)
         self.stats = CallerStats()
+        self._init_rejects(track_rejects)
         self._builder = RecordBuilder()
         self._group_ordinal = 0
 
@@ -293,10 +297,14 @@ class VanillaConsensusCaller:
                  if not r.flag & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY)]
         if len(reads) < len(records):
             self.stats.reject("SecondaryOrSupplementary", len(records) - len(reads))
+            self._reject_records(
+                r for r in records
+                if r.flag & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY))
         if not reads:
             return []
         if len(reads) < opts.min_reads:
             self.stats.reject("InsufficientReads", len(reads))
+            self._reject_records(reads)
             return []
 
         if opts.max_reads is not None and len(reads) > opts.max_reads:
@@ -321,6 +329,7 @@ class VanillaConsensusCaller:
                 continue
             if len(group_reads) < opts.min_reads:
                 self.stats.reject("InsufficientReads", len(group_reads))
+                self._reject_records(group_reads)
                 continue
             source_reads = []
             zero_len = 0
@@ -329,6 +338,7 @@ class VanillaConsensusCaller:
                 sr = self._create_source_read(rec, idx, clip)
                 if sr is None:
                     zero_len += 1
+                    self._reject_records([rec])
                 else:
                     source_reads.append(sr)
             if zero_len:
@@ -336,11 +346,21 @@ class VanillaConsensusCaller:
             if len(source_reads) < opts.min_reads:
                 if source_reads:
                     self.stats.reject("InsufficientReads", len(source_reads))
+                    self._reject_records(
+                        group_reads[sr.original_idx] for sr in source_reads)
                 continue
+            before = source_reads
             source_reads = self._filter_by_alignment(source_reads)
+            if len(source_reads) < len(before):
+                kept_idx = {sr.original_idx for sr in source_reads}
+                self._reject_records(group_reads[sr.original_idx]
+                                     for sr in before
+                                     if sr.original_idx not in kept_idx)
             if len(source_reads) < opts.min_reads:
                 if source_reads:
                     self.stats.reject("InsufficientReads", len(source_reads))
+                    self._reject_records(
+                        group_reads[sr.original_idx] for sr in source_reads)
                 continue
             meth = self._annotate_methylation(source_reads)
             lengths = sorted((len(sr.codes) for sr in source_reads), reverse=True)
@@ -363,8 +383,10 @@ class VanillaConsensusCaller:
             out.extend([r1, r2])
         elif r1 is not None:
             self.stats.reject("OrphanConsensus", len(r1.codes))
+            self._reject_records(r1.original_raws)
         elif r2 is not None:
             self.stats.reject("OrphanConsensus", len(r2.codes))
+            self._reject_records(r2.original_raws)
         return out
 
     def job_from_source_reads(self, umi: str, read_type: int, source_reads,

@@ -169,3 +169,62 @@ def test_duplex_min_reads_filtering(dup_bam, tmp_path):
     out = run_duplex(dup_bam, tmp_path, "f2.bam", extra=["--min-reads", "6", "3", "3"])
     with BamReader(out) as r:
         assert len(list(r)) == 50  # exactly 3 per strand passes
+
+
+def test_duplex_rejects_stream(tmp_path):
+    """--rejects captures raw reads of molecules that yield no consensus."""
+    from fgumi_tpu.cli import main as cli_main
+    from fgumi_tpu.io.bam import BamReader
+
+    sim = str(tmp_path / "dj.bam")
+    cli_main(["simulate", "duplex-reads", "-o", sim, "--num-molecules", "50",
+              "--reads-per-strand", "2", "--ba-fraction", "0.5", "--seed", "9"])
+    out = str(tmp_path / "djc.bam")
+    rej = str(tmp_path / "djr.bam")
+    assert cli_main(["duplex", "-i", sim, "-o", out, "--min-reads", "2", "2",
+                     "2", "--rejects", rej]) == 0
+    with BamReader(sim) as r:
+        n_in = sum(1 for _ in r)
+    with BamReader(rej) as r:
+        rejected = [rec.name for rec in r]
+    with BamReader(out) as r:
+        consumed = sum(rec.get_int(b"cD") for rec in r)
+    assert rejected, "ba-fraction 0.5 with min [2,2,2] must reject molecules"
+    # every input read is accounted for: either rejected or inside a consensus
+    assert len(rejected) + consumed == n_in
+
+
+def test_duplex_rejects_alignment_filtered_read(tmp_path):
+    """A read dropped by the alignment filter while the molecule still
+    succeeds must land in the rejects stream (contributes to no consensus)."""
+    import numpy as np
+
+    from fgumi_tpu.consensus.duplex import DuplexConsensusCaller
+    from fgumi_tpu.io.bam import RawRecord
+    from fgumi_tpu.simulate import _build_mapped_record
+
+    def rec(name, flag, pos, cigar, mi):
+        seq = b"ACGT" * 20
+        quals = np.full(80, 35, dtype=np.uint8)
+        return RawRecord(_build_mapped_record(
+            name.encode(), flag, 0, pos, 60, cigar, seq, quals, 0,
+            pos + 100, 180, [(b"RG", "Z", b"A"), (b"MI", "Z", mi)]))
+
+    F, L, P = 0x1 | 0x40, 0x1 | 0x80, 0x10
+    a_records = [
+        rec("a1", F, 1000, [("M", 80)], b"7/A"),
+        rec("a2", F, 1000, [("M", 80)], b"7/A"),
+        rec("a3", F, 1000, [("M", 40), ("I", 2), ("M", 38)], b"7/A"),  # minority
+        rec("a1", L | P, 1100, [("M", 80)], b"7/A"),
+        rec("a2", L | P, 1100, [("M", 80)], b"7/A"),
+        rec("a3", L | P, 1100, [("M", 80)], b"7/A"),
+    ]
+    b_records = [
+        rec("b1", F | P, 1100, [("M", 80)], b"7/B"),
+        rec("b1", L, 1000, [("M", 80)], b"7/B"),
+    ]
+    caller = DuplexConsensusCaller("x", "A", min_reads=[1], track_rejects=True)
+    out = caller.call_groups([("7", a_records, b_records)])
+    assert len(out) == 2  # molecule succeeded (R1 + R2)
+    rejected_names = {r.name for r in caller.take_rejects()}
+    assert b"a3" in rejected_names  # the minority-alignment read

@@ -216,24 +216,59 @@ class FastSimplexCaller:
     def _overlap_correct(self, batch, idx, bounds, g0, g1):
         """Pair primary R1/R2 by name within each group; one native call."""
         flag = batch.flag
-        r1_offs = []
-        r2_offs = []
-        for g in range(g0, g1):
-            members = idx[bounds[g]:bounds[g + 1]]
-            pairs = {}
-            for i in members:
-                f = int(flag[i])
-                # secondary/supplementary were already filtered out of idx
-                slot = pairs.setdefault(batch.name(int(i)), [None, None])
-                if f & FLAG_FIRST:
-                    slot[0] = int(i)
-                elif f & FLAG_LAST:
-                    slot[1] = int(i)
-            for a, b in pairs.values():
-                if a is not None and b is not None:
-                    r1_offs.append(batch.data_off[a])
-                    r2_offs.append(batch.data_off[b])
-        if not r1_offs:
+        span = idx[bounds[g0]:bounds[g1]]
+        # fast path: the grouped-BAM layout keeps each template's primary R1
+        # immediately followed by its R2 (group output preserves template
+        # adjacency); vectorized detection of (FIRST, LAST) runs with equal
+        # names covers it, the per-group dict pairing is the general fallback
+        f_span = flag[span]
+        # candidate adjacency: FIRST record followed by a LAST-and-not-FIRST
+        # one (a FIRST|LAST record sorts into the R1 slot in the dict/
+        # reference pairing, overlapping.py:203-206, and never completes a
+        # pair — it must not complete one here either)
+        is_first = (f_span[:-1] & FLAG_FIRST) != 0
+        next_last = ((f_span[1:] & FLAG_LAST) != 0) \
+            & ((f_span[1:] & FLAG_FIRST) == 0)
+        cand = np.nonzero(is_first & next_last)[0]
+        adjacent_ok = False
+        # flag-level completeness precheck (no name comparisons): every
+        # FIRST/LAST-flagged record must sit in some candidate adjacency,
+        # else an orphan exists somewhere and the dict scan runs anyway
+        first_or_last = (f_span & (FLAG_FIRST | FLAG_LAST)) != 0
+        if len(cand):
+            used = np.zeros(len(span), dtype=bool)
+            keep = []
+            for c in cand:
+                if not used[c] and not used[c + 1]:
+                    used[c] = used[c + 1] = True
+                    keep.append(c)
+            if bool(used[first_or_last].all()):
+                same_name = [batch.name(int(span[c]))
+                             == batch.name(int(span[c + 1])) for c in keep]
+                if all(same_name):
+                    adjacent_ok = True
+                    keep = np.asarray(keep, dtype=np.int64)
+                    r1_offs = batch.data_off[span[keep]]
+                    r2_offs = batch.data_off[span[keep + 1]]
+        if not adjacent_ok:
+            r1_offs = []
+            r2_offs = []
+            for g in range(g0, g1):
+                members = idx[bounds[g]:bounds[g + 1]]
+                pairs = {}
+                for i in members:
+                    f = int(flag[i])
+                    # secondary/supplementary were already filtered from idx
+                    slot = pairs.setdefault(batch.name(int(i)), [None, None])
+                    if f & FLAG_FIRST:
+                        slot[0] = int(i)
+                    elif f & FLAG_LAST:
+                        slot[1] = int(i)
+                for a, b in pairs.values():
+                    if a is not None and b is not None:
+                        r1_offs.append(batch.data_off[a])
+                        r2_offs.append(batch.data_off[b])
+        if len(r1_offs) == 0:
             return
         oc = self.overlap_caller
         stats = nb.overlap_correct_pairs(

@@ -215,6 +215,21 @@ def adversarial_bam(tmp_path_factory):
             b"f8r%d" % r, flag, 0, 8000, 60, [("M", 70)], seq(70), quals(70)))
     add_family(fam)
 
+    # family 8b: a FIRST|LAST-flagged record adjacent to a FIRST record of
+    # the same name — the dict/reference pairing never completes this pair,
+    # so the adjacency fast path must not either
+    fam = []
+    b1 = RecordBuilder().start_mapped(
+        b"f8b", 0x1 | 0x40, 0, 8500, 60, [("M", 60)], seq(60), quals(60),
+        next_ref_id=0, next_pos=8520, tlen=80)
+    b1.tag_str(b"MC", b"60M")
+    b2 = RecordBuilder().start_mapped(
+        b"f8b", 0x1 | 0x40 | 0x80, 0, 8520, 60, [("M", 60)], seq(60),
+        quals(60), next_ref_id=0, next_pos=8500, tlen=-80)
+    b2.tag_str(b"MC", b"60M")
+    fam.extend([b1, b2])
+    add_family(fam)
+
     # family 9: all-0xFF quals read among normal ones
     fam = [RecordBuilder().start_mapped(
         b"f9r0", 0, 0, 9000, 60, [("M", 50)], seq(50),

@@ -230,6 +230,14 @@ class FastSimplexCaller:
         next_last = ((f_span[1:] & FLAG_LAST) != 0) \
             & ((f_span[1:] & FLAG_FIRST) == 0)
         cand = np.nonzero(is_first & next_last)[0]
+        # a pair must not straddle an MI-group boundary: the dict pairing is
+        # per group, so a FIRST ending group g adjacent to a LAST opening
+        # group g+1 (same-name duplicates across groups in a malformed BAM)
+        # must stay two orphans, not become a cross-family correction
+        if len(cand) and g1 - g0 > 1:
+            boundary = np.zeros(len(span) + 1, dtype=bool)
+            boundary[bounds[g0 + 1:g1] - bounds[g0]] = True
+            cand = cand[~boundary[cand + 1]]
         adjacent_ok = False
         # flag-level completeness precheck (no name comparisons): every
         # FIRST/LAST-flagged record must sit in some candidate adjacency,
@@ -243,9 +251,12 @@ class FastSimplexCaller:
                     used[c] = used[c + 1] = True
                     keep.append(c)
             if bool(used[first_or_last].all()):
-                same_name = [batch.name(int(span[c]))
-                             == batch.name(int(span[c + 1])) for c in keep]
-                if all(same_name):
+                names = [batch.name(int(span[c])) for c in keep]
+                same_name = [n == batch.name(int(span[c + 1]))
+                             for n, c in zip(names, keep)]
+                # repeated names among kept pairs diverge from the dict
+                # pairing (last-writer-wins slots correct only one pair)
+                if all(same_name) and len(set(names)) == len(names):
                     adjacent_ok = True
                     keep = np.asarray(keep, dtype=np.int64)
                     r1_offs = batch.data_off[span[keep]]

@@ -1,21 +1,25 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before any jax import — pytest imports conftest first, so setting the env
-here covers every test module. Bench and production runs use the real TPU instead.
+Runs before any *test* imports jax — but NOT necessarily before jax itself is
+imported: the ambient axon sitecustomize pre-imports jax into every interpreter
+with jax_platforms=axon baked into jax.config, so setting JAX_PLATFORMS here
+would be too late. jax.config.update() still works at this point because no
+backend has been initialized yet; without it, a wedged TPU tunnel hangs the
+whole suite at the first jax.devices() call (and with a live tunnel the suite
+would silently run on the 1-chip TPU, skipping every mesh test).
 """
 
 import os
 import sys
 
-# Force CPU (overriding the environment's JAX_PLATFORMS=axon). NOTE: the axon TPU
-# plugin is injected via PYTHONPATH=/root/.axon_site sitecustomize and can block jax
-# init even under JAX_PLATFORMS=cpu when the TPU tunnel is busy/wedged — run tests as
-#   PYTHONPATH= python -m pytest tests/ -x -q
-# to guarantee a pure-CPU jax.
-os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for subprocesses spawned by tests
+
+import jax  # noqa: E402  (usually already pre-imported by the sitecustomize)
+
+jax.config.update("jax_platforms", "cpu")
 
 # Make the repo root importable regardless of pytest invocation directory.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

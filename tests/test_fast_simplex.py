@@ -262,3 +262,68 @@ def test_parity_adversarial_min_reads2(adversarial_bam):
 def test_parity_trim_falls_back(grouped_bam):
     """trim=True routes whole groups through the slow path; still identical."""
     assert_parity(grouped_bam, VanillaOptions(min_reads=1, trim=True))
+
+
+def _paired_builder(name, first, pos, mate_pos, rng):
+    """A mapped 60bp primary R1 or R2 with an MC tag (overlap-correctable)."""
+    sq = rng.choice(np.frombuffer(b"ACGT", np.uint8), size=60).tobytes()
+    qs = rng.integers(10, 41, size=60).astype(np.uint8)
+    flag = 0x1 | (0x40 if first else (0x80 | 0x10))
+    tlen = (mate_pos - pos + 60) if first else -(pos - mate_pos + 60)
+    b = RecordBuilder().start_mapped(name, flag, 0, pos, 60, [("M", 60)],
+                                     sq, qs, next_ref_id=0,
+                                     next_pos=mate_pos, tlen=tlen)
+    b.tag_str(b"MC", b"60M")
+    return b
+
+
+def _frag_builder(name, pos, rng):
+    sq = rng.choice(np.frombuffer(b"ACGT", np.uint8), size=60).tobytes()
+    qs = rng.integers(10, 41, size=60).astype(np.uint8)
+    return RecordBuilder().start_mapped(name, 0, 0, pos, 60, [("M", 60)],
+                                        sq, qs)
+
+
+def _write_mi_bam(path, families):
+    """families: list of lists of RecordBuilders; MI assigned by index."""
+    header = BamHeader(
+        text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n@SQ\tSN:chr1\tLN:100000\n",
+        ref_names=["chr1"], ref_lengths=[100000])
+    with BamWriter(path, header) as w:
+        for mi, fam in enumerate(families):
+            for b in fam:
+                b.tag_str(b"MI", str(mi).encode())
+                b.tag_str(b"RX", b"ACGTACGT")
+                w.write_record_bytes(b.finish())
+
+
+def test_overlap_pair_must_not_straddle_groups(tmp_path):
+    """A FIRST orphan ending group g adjacent to a same-name LAST orphan
+    opening group g+1 must stay two uncorrected orphans (the dict pairing is
+    per group); the adjacency fast path must not pair across the boundary."""
+    rng = np.random.default_rng(5)
+    path = str(tmp_path / "straddle.bam")
+    _write_mi_bam(path, [
+        [_frag_builder(b"ga", 8600, rng),
+         _paired_builder(b"xg", True, 8610, 8630, rng)],
+        [_paired_builder(b"xg", False, 8630, 8610, rng),
+         _frag_builder(b"gb", 8640, rng)],
+    ])
+    assert_parity(path, VanillaOptions(min_reads=1), overlap=True,
+                  target_bytes=1 << 20)
+
+
+def test_overlap_duplicate_name_pairs_fall_back(tmp_path):
+    """Two adjacent (FIRST, LAST) pairs sharing one read name in one group:
+    dict pairing last-writer-wins corrects only the second pair, so the
+    adjacency fast path must fall back rather than correct both."""
+    rng = np.random.default_rng(6)
+    path = str(tmp_path / "dup.bam")
+    _write_mi_bam(path, [
+        [_paired_builder(b"dup", True, 8700, 8720, rng),
+         _paired_builder(b"dup", False, 8720, 8700, rng),
+         _paired_builder(b"dup", True, 8700, 8720, rng),
+         _paired_builder(b"dup", False, 8720, 8700, rng)],
+    ])
+    assert_parity(path, VanillaOptions(min_reads=1), overlap=True,
+                  target_bytes=1 << 20)

@@ -122,6 +122,7 @@ class FastSimplexCaller:
         # conditions the vectorized conversion cannot express
         self._vector_ok = (not opts.trim and not opts.methylation_mode)
         self._carry = None  # (mi_bytes, [RawRecord]) spanning batch boundary
+        self._palin_cache = {}  # cigar bytes -> simplified-CIGAR palindromicity
 
     # ------------------------------------------------------------------ driver
 
@@ -251,16 +252,20 @@ class FastSimplexCaller:
                     used[c] = used[c + 1] = True
                     keep.append(c)
             if bool(used[first_or_last].all()):
-                names = [batch.name(int(span[c])) for c in keep]
-                same_name = [n == batch.name(int(span[c + 1]))
-                             for n, c in zip(names, keep)]
+                keep = np.asarray(keep, dtype=np.int64)
+                a, b = span[keep], span[keep + 1]
+                name_off = batch.data_off + 32
+                name_len = (batch.l_read_name - 1).astype(np.int32)
+                same = nb.ranges_equal(batch.buf, name_off[a], name_len[a],
+                                       name_off[b], name_len[b])
                 # repeated names among kept pairs diverge from the dict
-                # pairing (last-writer-wins slots correct only one pair)
-                if all(same_name) and len(set(names)) == len(names):
+                # pairing (last-writer-wins slots correct only one pair);
+                # hash-collision false positives only cause a safe fallback
+                hashes = nb.hash_ranges(batch.buf, name_off[a], name_len[a])
+                if same.all() and len(np.unique(hashes)) == len(hashes):
                     adjacent_ok = True
-                    keep = np.asarray(keep, dtype=np.int64)
-                    r1_offs = batch.data_off[span[keep]]
-                    r2_offs = batch.data_off[span[keep + 1]]
+                    r1_offs = batch.data_off[a]
+                    r2_offs = batch.data_off[b]
         if not adjacent_ok:
             r1_offs = []
             r2_offs = []
@@ -345,28 +350,207 @@ class FastSimplexCaller:
                                   side="left")
         group_uniform = runs_hi == runs_lo
 
-        # per-group loop on index slices
+        # per-group preparation: vectorized common path; the per-group Python
+        # scan remains for rejects-tracking mode and for groups needing
+        # downsampling or the most-common-alignment filter
         jobs = []
-        for g in range(g0, g1):
-            s, e = rel_bounds[g], rel_bounds[g + 1]
-            self._prepare_group_fast(batch, span, s, e, rtype, final_len,
-                                     jobs, bool(group_uniform[g - g0]))
+        if caller.track_rejects:
+            for g in range(g0, g1):
+                s, e = rel_bounds[g], rel_bounds[g + 1]
+                self._prepare_group_fast(batch, span, s, e, rtype, final_len,
+                                         jobs, bool(group_uniform[g - g0]))
+        else:
+            # rel_bounds is already span-relative (rel_bounds[g0] == 0)
+            gb = rel_bounds[g0:g1 + 1]
+            self._prepare_groups_vec(batch, span, gb, rtype, final_len,
+                                     group_uniform, jobs)
 
         if not jobs:
             return []
         pending = self._dispatch_jobs(codes, quals, jobs)
         return [_PendingChunk(self, batch, jobs, pending)]
 
+    def _prepare_groups_vec(self, batch, span, gb, rtype, final_len,
+                            group_uniform, jobs):
+        """Vectorized _prepare_group_fast over all groups of the span.
+
+        gb: (nG+1,) span-relative group boundaries. Groups that need the
+        seeded downsample or the most-common-alignment filter fall back to
+        the per-group scan (identical semantics); everything else — type
+        subgrouping, min-reads/zero-length rejection, consensus length,
+        orphan handling — happens in whole-span array passes.
+        """
+        caller = self.caller
+        opts = caller.options
+        stats = caller.stats
+        min_reads = opts.min_reads
+        nG = len(gb) - 1
+        sizes = np.diff(gb)
+        ord0 = caller._group_ordinal
+        caller._group_ordinal += nG
+
+        small = sizes < min_reads
+        downs = (np.zeros(nG, dtype=bool) if opts.max_reads is None
+                 else sizes > opts.max_reads)
+
+        # candidate rows: valid type, in a group subject to seg analysis
+        g_of_row = np.repeat(np.arange(nG), sizes)
+        row_ok = (~small & ~downs)[g_of_row] & (rtype >= 0)
+        er = np.nonzero(row_ok)[0]
+        legacy_g = downs.copy()
+        nseg = 0
+        if len(er):
+            key = g_of_row[er] * 4 + rtype[er]
+            order = np.argsort(key, kind="stable")
+            srows = er[order]          # seg-grouped; original order within seg
+            skey = key[order]
+            seg_first = np.concatenate(([True], skey[1:] != skey[:-1]))
+            seg_of_row = np.cumsum(seg_first) - 1
+            seg_key = skey[seg_first]
+            nseg = len(seg_key)
+            seg_g = seg_key >> 2
+            seg_t = (seg_key & 3).astype(np.int8)
+            c0 = np.bincount(seg_of_row, minlength=nseg)
+
+            valid_row = final_len[srows] > 0
+            c1 = np.bincount(seg_of_row[valid_row], minlength=nseg)
+            alive0 = c0 >= min_reads
+            alive = alive0 & (c1 >= min_reads)
+
+            vrows = srows[valid_row]   # compacted valid rows, seg-grouped
+            vstarts = np.concatenate(([0], np.cumsum(c1)))
+            span_v = span[vrows]
+            vlens = final_len[vrows]
+
+            # need-filter analysis (matches _prepare_group_fast): a seg needs
+            # the alignment filter when its valid rows' CIGARs differ, or are
+            # uniform but mixed-strand with a non-palindromic simplified CIGAR
+            guniform_seg = group_uniform[seg_g]
+            need = np.zeros(nseg, dtype=bool)
+            nonempty = c1 > 0
+            first_valid = np.zeros(nseg, dtype=np.int64)
+            first_valid[nonempty] = vrows[vstarts[:-1][nonempty]]
+            check = alive & ~guniform_seg
+            if check.any():
+                co = batch.cigar_off
+                cl = (4 * batch.n_cigar).astype(np.int32)
+                rep_first = np.repeat(span[first_valid], c1)
+                eq = nb.ranges_equal(batch.buf, co[span_v], cl[span_v],
+                                     co[rep_first], cl[rep_first])
+                seg_cig_uniform = np.ones(nseg, dtype=bool)
+                seg_cig_uniform[nonempty] = np.minimum.reduceat(
+                    eq, vstarts[:-1][nonempty]).astype(bool)
+                need = check & ~seg_cig_uniform
+            rev8 = ((batch.flag[span_v] & FLAG_REVERSE) != 0).astype(np.uint8)
+            mixed = np.zeros(nseg, dtype=bool)
+            if nonempty.any():
+                mn = np.minimum.reduceat(rev8, vstarts[:-1][nonempty])
+                mx = np.maximum.reduceat(rev8, vstarts[:-1][nonempty])
+                mixed[nonempty] = (mn == 0) & (mx == 1)
+            strand_check = alive & ~need & mixed & (c1 >= 2)
+            if strand_check.any():
+                # single-op CIGARs simplify to one run: always palindromic
+                n1 = batch.n_cigar[span[first_valid]]
+                for s in np.nonzero(strand_check & (n1 != 1))[0]:
+                    rec_i = int(span[first_valid[s]])
+                    cig_bytes = batch.buf[
+                        batch.cigar_off[rec_i]:
+                        batch.cigar_off[rec_i]
+                        + 4 * batch.n_cigar[rec_i]].tobytes()
+                    palin = self._palin_cache.get(cig_bytes)
+                    if palin is None:
+                        cig = cigar_utils.simplify(
+                            self._decode_cigar(batch, rec_i))
+                        palin = cig == list(reversed(cig))
+                        if len(self._palin_cache) >= 4096:
+                            self._palin_cache.clear()
+                        self._palin_cache[cig_bytes] = palin
+                    if not palin:
+                        need[s] = True
+            legacy_g[seg_g[need]] = True
+
+        vec_g = ~legacy_g
+        stats.input_reads += int(sizes[vec_g].sum())
+        n_small = int(sizes[small & vec_g].sum())
+        if n_small:
+            stats.reject("InsufficientReads", n_small)
+
+        seg_map = None
+        if nseg:
+            seg_vec = vec_g[seg_g]
+            dead0 = seg_vec & ~alive0
+            if dead0.any():
+                stats.reject("InsufficientReads", int(c0[dead0].sum()))
+            zl = int((c0 - c1)[seg_vec & alive0].sum())
+            if zl:
+                stats.reject("ZeroLengthAfterTrimming", zl)
+            dead1 = seg_vec & alive0 & ~alive & (c1 > 0)
+            if dead1.any():
+                stats.reject("InsufficientReads", int(c1[dead1].sum()))
+
+            # consensus length: min_reads-th longest valid len per seg
+            ord2 = np.lexsort((-vlens.astype(np.int64), seg_of_row[valid_row]))
+            lens_sorted = vlens[ord2]
+            pick = np.minimum(vstarts[:-1] + (min_reads - 1),
+                              np.maximum(len(lens_sorted) - 1, 0))
+            cons_len = (lens_sorted[pick] if len(lens_sorted)
+                        else np.zeros(nseg, dtype=vlens.dtype))
+
+            live = alive & seg_vec
+            seg_map = np.full((nG, 3), -1, dtype=np.int64)
+            seg_map[seg_g[live], seg_t[live]] = np.nonzero(live)[0]
+            # orphan R1/R2 rejection, aggregated (vanilla.py:346-357)
+            have_r1 = seg_map[:, R1] >= 0
+            have_r2 = seg_map[:, R2] >= 0
+            lone_r1 = seg_map[:, R1][have_r1 & ~have_r2]
+            lone_r2 = seg_map[:, R2][have_r2 & ~have_r1]
+            n_orphan = int(c1[lone_r1].sum() + c1[lone_r2].sum())
+            if n_orphan:
+                stats.reject("OrphanConsensus", n_orphan)
+
+        mi_vo, mi_vl, _ = batch.tag_locs(self.tag)
+        buf = batch.buf
+
+        def seg_job(s, umi):
+            lo, hi = vstarts[s], vstarts[s + 1]
+            return _FastJob(umi, int(seg_t[s]), vrows[lo:hi], vlens[lo:hi],
+                            int(cons_len[s]), span_v[lo:hi])
+
+        for g in range(nG):
+            if legacy_g[g]:
+                self._prepare_group_fast(batch, span, gb[g], gb[g + 1], rtype,
+                                         final_len, jobs,
+                                         bool(group_uniform[g]),
+                                         ordinal=ord0 + g)
+                continue
+            if seg_map is None:
+                continue
+            f, s1, s2 = seg_map[g]
+            if f < 0 and s1 < 0 and s2 < 0:
+                continue
+            i = int(span[gb[g]])
+            umi = buf[mi_vo[i]: mi_vo[i] + mi_vl[i]].tobytes()
+            if f >= 0:
+                jobs.append(seg_job(f, umi))
+            if s1 >= 0 and s2 >= 0:
+                jobs.append(seg_job(s1, umi))
+                jobs.append(seg_job(s2, umi))
+
     def _prepare_group_fast(self, batch, span, s, e, rtype, final_len, jobs,
-                            group_uniform=False):
-        """prepare_group analog on array slices (vanilla.py:274-357)."""
+                            group_uniform=False, ordinal=None):
+        """prepare_group analog on array slices (vanilla.py:274-357).
+
+        `ordinal` is the group's downsample-RNG ordinal; None allocates the
+        next one (the vectorized path pre-allocates a span's worth and passes
+        each group's explicitly)."""
         caller = self.caller
         opts = caller.options
         stats = caller.stats
         n_records = e - s
         stats.input_reads += int(n_records)
-        ordinal = caller._group_ordinal
-        caller._group_ordinal += 1
+        if ordinal is None:
+            ordinal = caller._group_ordinal
+            caller._group_ordinal += 1
 
         def rej(rows_arr):
             # rejects materialize as RawRecords only when tracking is on
@@ -600,16 +784,14 @@ class FastSimplexCaller:
         qual_addr = np.empty(J, dtype=np.int64)
         depth_addr = np.empty(J, dtype=np.int64)
         err_addr = np.empty(J, dtype=np.int64)
-        mi_off = np.empty(J, dtype=np.int64)
+        mi_addr = np.empty(J, dtype=np.int64)
         mi_len = np.empty(J, dtype=np.int32)
-        rx_off = np.empty(J, dtype=np.int64)
-        rx_len = np.empty(J, dtype=np.int32)
         mi_parts = []
-        rx_parts = []
         keep_alive = []
-        m_off = r_off = 0
+        m_off = 0
         rx_vo, rx_vl, _ = batch.tag_locs(b"RX")
         buf = batch.buf
+        surv_counts = np.empty(J, dtype=np.int64)
         for j, job in enumerate(jobs):
             b, q, d, e = job.result
             keep_alive.append(job.result)
@@ -621,28 +803,39 @@ class FastSimplexCaller:
             err_addr[j] = e.ctypes.data
             mi = job.umi_bytes
             mi_parts.append(mi)
-            mi_off[j] = m_off
+            mi_addr[j] = m_off
             mi_len[j] = len(mi)
             m_off += len(mi)
-            # consensus RX from the surviving reads' RX tags (vanilla.py:460-464)
+            surv_counts[j] = len(job.surviving_idx)
+        mi_blob = np.frombuffer(b"".join(mi_parts) or b"\x00", dtype=np.uint8)
+        mi_addr += mi_blob.ctypes.data
+
+        # consensus RX from the surviving reads' RX tags (vanilla.py:460-464):
+        # unanimity (the overwhelmingly common case) resolves natively to a
+        # pointer into the batch buffer; only divergent families run the
+        # Python likelihood consensus
+        surv_starts = np.concatenate(([0], np.cumsum(surv_counts)))
+        surv_all = (np.concatenate([j.surviving_idx for j in jobs])
+                    if J else np.empty(0, dtype=np.int64))
+        rxo, rxl = nb.rx_unanimous(buf, rx_vo[surv_all], rx_vl[surv_all],
+                                   surv_starts)
+        buf_base = buf.ctypes.data
+        rx_addr = np.where(rxo >= 0, buf_base + rxo, 0)
+        rx_len = np.where(rxo >= 0, rxl, 0).astype(np.int32)
+        for j in np.nonzero(rxo == -2)[0]:
+            job = jobs[j]
             umis = [buf[rx_vo[i]: rx_vo[i] + rx_vl[i]].tobytes().decode()
                     for i in job.surviving_idx if rx_vo[i] >= 0]
-            if umis:
-                rx = consensus_umis(umis).encode()
-                rx_parts.append(rx)
-                rx_off[j] = r_off
-                rx_len[j] = len(rx)
-                r_off += len(rx)
-            else:
-                rx_off[j] = -1
-                rx_len[j] = 0
-        mi_blob = np.frombuffer(b"".join(mi_parts) or b"\x00", dtype=np.uint8)
-        rx_blob = np.frombuffer(b"".join(rx_parts) or b"\x00", dtype=np.uint8)
+            rx_arr = np.frombuffer(consensus_umis(umis).encode(),
+                                   dtype=np.uint8)
+            keep_alive.append(rx_arr)
+            rx_addr[j] = rx_arr.ctypes.data
+            rx_len[j] = len(rx_arr)
+
         blob, _ = nb.build_consensus_records(
             code_addr, qual_addr, depth_addr, err_addr, lens, flags,
-            caller.prefix.encode(), mi_blob, mi_off, mi_len, rx_blob, rx_off,
-            rx_len, caller.read_group_id.encode(),
-            opts.produce_per_base_tags)
+            caller.prefix.encode(), mi_addr, mi_len, rx_addr, rx_len,
+            caller.read_group_id.encode(), opts.produce_per_base_tags)
         caller.stats.add_consensus_reads(J)
         del keep_alive
         return blob

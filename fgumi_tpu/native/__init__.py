@@ -95,8 +95,17 @@ def get_lib():
             p, p, p, ctypes.c_long, ctypes.c_int, ctypes.c_int, p]
         lib.fgumi_build_consensus_records.restype = ctypes.c_long
         lib.fgumi_build_consensus_records.argtypes = (
-            [p] * 6 + [ctypes.c_long, p, ctypes.c_int, p, p, p, p, p, p, p,
+            [p] * 6 + [ctypes.c_long, p, ctypes.c_int, p, p, p, p, p,
                        ctypes.c_int, ctypes.c_int, p, ctypes.c_long, p])
+        lib.fgumi_segment_depth_errors.restype = None
+        lib.fgumi_segment_depth_errors.argtypes = (
+            [p, p, p, ctypes.c_long, ctypes.c_long, p, p])
+        lib.fgumi_ranges_equal.restype = None
+        lib.fgumi_ranges_equal.argtypes = [p] * 5 + [ctypes.c_long, p]
+        lib.fgumi_hash_ranges.restype = None
+        lib.fgumi_hash_ranges.argtypes = [p, p, p, ctypes.c_long, p]
+        lib.fgumi_rx_unanimous.restype = None
+        lib.fgumi_rx_unanimous.argtypes = [p, p, p, p, ctypes.c_long, p, p]
         lib.fgumi_extract_records.restype = ctypes.c_long
         lib.fgumi_extract_records.argtypes = (
             [ctypes.c_long, ctypes.c_long] + [p] * 6 + [ctypes.c_long]

@@ -136,13 +136,14 @@ def mate_clips(buf: np.ndarray, cigar_off: np.ndarray, n_cigar: np.ndarray,
 
 
 def build_consensus_records(code_addr, qual_addr, depth_addr, err_addr, lens,
-                            flags, prefix: bytes, mi_blob, mi_off, mi_len,
-                            rx_blob, rx_off, rx_len, rg: bytes,
+                            flags, prefix: bytes, mi_addr, mi_len,
+                            rx_addr, rx_len, rg: bytes,
                             per_base_tags: bool):
     """Serialize J consensus records into one block_size-prefixed wire blob.
 
     The *_addr arrays are raw element addresses (int64) into caller-owned
-    arrays, which MUST stay referenced for the duration of the call.
+    arrays, which MUST stay referenced for the duration of the call; MI/RX
+    values are addresses too (rx_addr 0 = absent tag).
     Returns bytes (the concatenated records, ready for BamWriter raw append).
     """
     lib = get_lib()
@@ -151,30 +152,93 @@ def build_consensus_records(code_addr, qual_addr, depth_addr, err_addr, lens,
     flags = np.ascontiguousarray(flags, np.int32)
     mi_len = np.ascontiguousarray(mi_len, np.int32)
     rx_len = np.ascontiguousarray(rx_len, np.int32)
+    mi_addr = np.ascontiguousarray(mi_addr, np.int64)
+    rx_addr = np.ascontiguousarray(rx_addr, np.int64)
     # exact per-record size bound (mirrors the C size computation)
     per_rec = (4 + 32 + len(prefix) + 1 + mi_len.astype(np.int64) + 1
                + (lens + 1) // 2 + lens + (3 + len(rg) + 1) + 21
                + (3 + mi_len.astype(np.int64) + 1)
-               + np.where(rx_off >= 0, 3 + rx_len.astype(np.int64) + 1, 0))
+               + np.where(rx_addr != 0, 3 + rx_len.astype(np.int64) + 1, 0))
     if per_base_tags:
         per_rec = per_rec + 2 * (8 + 2 * lens.astype(np.int64))
     out_cap = int(per_rec.sum())
     out = np.empty(out_cap, dtype=np.uint8)
     rec_end = np.empty(J, dtype=np.int64)
-    mi_blob = np.ascontiguousarray(mi_blob, np.uint8)
-    rx_blob = np.ascontiguousarray(rx_blob, np.uint8)
     prefix_arr = np.frombuffer(prefix, dtype=np.uint8)
     rg_arr = np.frombuffer(rg, dtype=np.uint8)
     total = lib.fgumi_build_consensus_records(
         _addr(code_addr), _addr(qual_addr), _addr(depth_addr),
         _addr(err_addr), _addr(lens), _addr(flags), J,
-        _addr(prefix_arr), len(prefix), _addr(mi_blob), _addr(mi_off),
-        _addr(mi_len), _addr(rx_blob), _addr(rx_off), _addr(rx_len),
+        _addr(prefix_arr), len(prefix), _addr(mi_addr), _addr(mi_len),
+        _addr(rx_addr), _addr(rx_len),
         _addr(rg_arr), len(rg), int(per_base_tags), _addr(out), out_cap,
         _addr(rec_end))
     if total < 0:
         raise RuntimeError("consensus record serialization overflow")
     return out[:total].tobytes(), rec_end
+
+
+def segment_depth_errors(codes2d: np.ndarray, winner: np.ndarray,
+                         starts: np.ndarray):
+    """Per-segment depth/error counts: (J, L) int32 pair.
+
+    codes2d: dense (N, L) uint8 read rows; winner: (J, L) uint8 called bases;
+    starts: (J+1,) row boundaries.
+    """
+    lib = get_lib()
+    J, L = winner.shape
+    depth = np.empty((J, L), dtype=np.int32)
+    errors = np.empty((J, L), dtype=np.int32)
+    codes2d = np.ascontiguousarray(codes2d, np.uint8)
+    winner = np.ascontiguousarray(winner, np.uint8)
+    starts = np.ascontiguousarray(starts, np.int64)
+    lib.fgumi_segment_depth_errors(_addr(codes2d), _addr(winner),
+                                   _addr(starts), J, L, _addr(depth),
+                                   _addr(errors))
+    return depth, errors
+
+
+def ranges_equal(buf: np.ndarray, off_a, len_a, off_b, len_b):
+    """uint8[n] mask: byte ranges (off_a, len_a) == (off_b, len_b) in buf."""
+    lib = get_lib()
+    n = len(off_a)
+    out = np.empty(n, dtype=np.uint8)
+    off_a = np.ascontiguousarray(off_a, np.int64)
+    off_b = np.ascontiguousarray(off_b, np.int64)
+    len_a = np.ascontiguousarray(len_a, np.int32)
+    len_b = np.ascontiguousarray(len_b, np.int32)
+    lib.fgumi_ranges_equal(_addr(buf), _addr(off_a), _addr(len_a),
+                           _addr(off_b), _addr(len_b), n, _addr(out))
+    return out
+
+
+def hash_ranges(buf: np.ndarray, off, length):
+    """FNV-1a 64-bit hash per byte range (off < 0 -> 0)."""
+    lib = get_lib()
+    n = len(off)
+    out = np.empty(n, dtype=np.uint64)
+    off = np.ascontiguousarray(off, np.int64)
+    length = np.ascontiguousarray(length, np.int32)
+    lib.fgumi_hash_ranges(_addr(buf), _addr(off), _addr(length), n, _addr(out))
+    return out
+
+
+def rx_unanimous(buf: np.ndarray, off, length, starts):
+    """Per-segment RX unanimity: (out_off int64[J], out_len int32[J]).
+
+    out_off -1 = no tag anywhere in the segment; -2 = caller must run the
+    Python consensus; >= 0 = verbatim unanimous value at that buffer range.
+    """
+    lib = get_lib()
+    J = len(starts) - 1
+    out_off = np.empty(J, dtype=np.int64)
+    out_len = np.empty(J, dtype=np.int32)
+    off = np.ascontiguousarray(off, np.int64)
+    length = np.ascontiguousarray(length, np.int32)
+    starts = np.ascontiguousarray(starts, np.int64)
+    lib.fgumi_rx_unanimous(_addr(buf), _addr(off), _addr(length),
+                           _addr(starts), J, _addr(out_off), _addr(out_len))
+    return out_off, out_len
 
 
 def overlap_correct_pairs(buf: np.ndarray, r1_off: np.ndarray,

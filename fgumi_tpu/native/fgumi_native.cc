@@ -212,14 +212,17 @@ extern "C" {
 // to i16::MAX (fgbio Short semantics). Names are prefix + ':' + MI value.
 // Per-record data arrives as raw addresses (code_addr[j] -> uint8[lens[j]],
 // depth_addr[j] -> int32[lens[j]], ...) so callers can point straight into
-// their bucket tensors without gathering a dense (J, L) copy.
+// their bucket tensors without gathering a dense (J, L) copy. MI/RX values
+// are absolute addresses too (mi_addr[j] -> uint8[mi_len[j]]) so they can
+// reference the decoded batch buffer directly (no per-job gather blob);
+// rx_addr[j] == 0 marks an absent RX tag.
 // Returns total bytes written, or -1 when out_cap is insufficient.
 long fgumi_build_consensus_records(
     const int64_t* code_addr, const int64_t* qual_addr,
     const int64_t* depth_addr, const int64_t* err_addr, const int32_t* lens,
     const int32_t* flags, long J, const uint8_t* prefix, int prefix_len,
-    const uint8_t* mi_blob, const int64_t* mi_off, const int32_t* mi_len,
-    const uint8_t* rx_blob, const int64_t* rx_off, const int32_t* rx_len,
+    const int64_t* mi_addr, const int32_t* mi_len,
+    const int64_t* rx_addr, const int32_t* rx_len,
     const uint8_t* rg, int rg_len, int per_base_tags, uint8_t* out,
     long out_cap, int64_t* rec_end) {
   long off = 0;
@@ -235,8 +238,9 @@ long fgumi_build_consensus_records(
     need += (7 + 7 + 7);           // cD cM cE
     if (per_base_tags) need += 2 * (8 + 2 * static_cast<long>(L));
     need += 3 + mi_len[j] + 1;     // MI:Z
-    if (rx_off[j] >= 0) need += 3 + rx_len[j] + 1;
+    if (rx_addr[j] != 0) need += 3 + rx_len[j] + 1;
     if (off + need > out_cap) return -1;
+    const uint8_t* mi_p = reinterpret_cast<const uint8_t*>(mi_addr[j]);
 
     uint8_t* rec = out + off + 4;  // past block_size prefix
     // fixed header (io/bam.py start_unmapped): refID -1, pos -1, l_read_name,
@@ -257,7 +261,7 @@ long fgumi_build_consensus_records(
     std::memcpy(p, prefix, static_cast<size_t>(prefix_len));
     p += prefix_len;
     *p++ = ':';
-    std::memcpy(p, mi_blob + mi_off[j], static_cast<size_t>(mi_len[j]));
+    std::memcpy(p, mi_p, static_cast<size_t>(mi_len[j]));
     p += mi_len[j];
     *p++ = 0;
     // packed seq
@@ -324,12 +328,13 @@ long fgumi_build_consensus_records(
       }
     }
     p[0] = 'M'; p[1] = 'I'; p[2] = 'Z';
-    std::memcpy(p + 3, mi_blob + mi_off[j], static_cast<size_t>(mi_len[j]));
+    std::memcpy(p + 3, mi_p, static_cast<size_t>(mi_len[j]));
     p += 3 + mi_len[j];
     *p++ = 0;
-    if (rx_off[j] >= 0) {
+    if (rx_addr[j] != 0) {
       p[0] = 'R'; p[1] = 'X'; p[2] = 'Z';
-      std::memcpy(p + 3, rx_blob + rx_off[j], static_cast<size_t>(rx_len[j]));
+      std::memcpy(p + 3, reinterpret_cast<const uint8_t*>(rx_addr[j]),
+                  static_cast<size_t>(rx_len[j]));
       p += 3 + rx_len[j];
       *p++ = 0;
     }
@@ -339,6 +344,129 @@ long fgumi_build_consensus_records(
     rec_end[j] = off;
   }
   return off;
+}
+
+// Per-segment depth/error counts for the ragged consensus layout: codes is
+// the dense (N, L) read-row array (N = starts[J]), winner the (J, L) called
+// bases; depth[j,i] = valid (non-N) observations, errors[j,i] = valid
+// observations disagreeing with the winner (all of them when the winner is
+// N). Integer-exact replacement for the numpy reduceat path in
+// ops/kernel.py::_finish_segments (reference _call_epilogue obs arithmetic).
+void fgumi_segment_depth_errors(const uint8_t* codes, const uint8_t* winner,
+                                const int64_t* starts, long J, long L,
+                                int32_t* depth, int32_t* errors) {
+  for (long j = 0; j < J; ++j) {
+    int32_t* drow = depth + j * L;
+    int32_t* erow = errors + j * L;
+    const uint8_t* wrow = winner + j * L;
+    std::memset(drow, 0, static_cast<size_t>(L) * 4);
+    std::memset(erow, 0, static_cast<size_t>(L) * 4);
+    for (int64_t r = starts[j]; r < starts[j + 1]; ++r) {
+      const uint8_t* crow = codes + r * L;
+      for (long i = 0; i < L; ++i) {
+        const uint8_t c = crow[i];
+        if (c != 4) {
+          ++drow[i];
+          erow[i] += (c != wrow[i]);
+        }
+      }
+    }
+  }
+}
+
+// Batch byte-range equality within one buffer: out[i] = 1 iff both ranges
+// are present (offset >= 0), equal length, and byte-identical. Used for
+// read-name pair checks without per-record Python slicing.
+void fgumi_ranges_equal(const uint8_t* buf, const int64_t* off_a,
+                        const int32_t* len_a, const int64_t* off_b,
+                        const int32_t* len_b, long n, uint8_t* out) {
+  for (long i = 0; i < n; ++i) {
+    out[i] = (off_a[i] >= 0 && off_b[i] >= 0 && len_a[i] == len_b[i] &&
+              std::memcmp(buf + off_a[i], buf + off_b[i],
+                          static_cast<size_t>(len_a[i])) == 0)
+                 ? 1
+                 : 0;
+  }
+}
+
+// FNV-1a 64-bit hash per byte range (off < 0 hashes to 0); for duplicate
+// detection over read names without materializing Python bytes.
+void fgumi_hash_ranges(const uint8_t* buf, const int64_t* off,
+                       const int32_t* len, long n, uint64_t* out) {
+  for (long i = 0; i < n; ++i) {
+    if (off[i] < 0) {
+      out[i] = 0;
+      continue;
+    }
+    uint64_t h = 1469598103934665603ULL;
+    const uint8_t* p = buf + off[i];
+    for (int32_t k = 0; k < len[i]; ++k) {
+      h = (h ^ p[k]) * 1099511628211ULL;
+    }
+    out[i] = h;
+  }
+}
+
+// Per-segment RX-tag unanimity (consensus/simple_umi.py::consensus_umis fast
+// cases). Rows [starts[j], starts[j+1]) with (off, len) per row (off < 0 =
+// tag absent). Per segment:
+//   out_off[j] = -1  when no row has the tag (emit no RX)
+//   out_off[j] = -2  when present values differ, or are unanimous but a
+//                    multi-row value needs uppercasing (acgtn present) —
+//                    caller runs the Python consensus for these
+//   otherwise        out_off/out_len reference the verbatim unanimous value
+//                    (single present row, or multi-row already-uppercase)
+void fgumi_rx_unanimous(const uint8_t* buf, const int64_t* off,
+                        const int32_t* len, const int64_t* starts, long J,
+                        int64_t* out_off, int32_t* out_len) {
+  for (long j = 0; j < J; ++j) {
+    int64_t first = -1;
+    int32_t flen = 0;
+    long present = 0;
+    bool equal = true;
+    for (int64_t r = starts[j]; r < starts[j + 1]; ++r) {
+      if (off[r] < 0) continue;
+      if (present == 0) {
+        first = off[r];
+        flen = len[r];
+      } else if (len[r] != flen ||
+                 std::memcmp(buf + off[r], buf + first,
+                             static_cast<size_t>(flen)) != 0) {
+        equal = false;
+        break;
+      }
+      ++present;
+    }
+    if (present == 0) {
+      out_off[j] = -1;
+      out_len[j] = 0;
+      continue;
+    }
+    if (!equal) {
+      out_off[j] = -2;
+      out_len[j] = 0;
+      continue;
+    }
+    if (present > 1) {
+      // multi-read unanimous output is uppercased for a/c/g/t/n only
+      bool lower = false;
+      const uint8_t* p = buf + first;
+      for (int32_t k = 0; k < flen; ++k) {
+        const uint8_t c = p[k];
+        if (c == 'a' || c == 'c' || c == 'g' || c == 't' || c == 'n') {
+          lower = true;
+          break;
+        }
+      }
+      if (lower) {
+        out_off[j] = -2;
+        out_len[j] = 0;
+        continue;
+      }
+    }
+    out_off[j] = first;
+    out_len[j] = flen;
+  }
 }
 
 }  // extern "C"

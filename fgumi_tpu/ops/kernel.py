@@ -455,15 +455,23 @@ class ConsensusKernel:
         winner = winner[:J]
         qual = qual[:J]
         suspect = suspect[:J]
-        # depth/errors per segment: int32 reduceat over the row axis (int32,
-        # not int16: reduceat wraps rather than clamps; the i16 clamp happens
-        # at tag-write time downstream, matching the reference)
-        valid = (codes2d != N_CODE).astype(np.int32)
-        depth = np.add.reduceat(valid, starts[:-1], axis=0).astype(np.int64)
-        counts = np.diff(starts)
-        winner_rows = np.repeat(winner, counts, axis=0)
-        match = ((codes2d == winner_rows) & (codes2d != N_CODE)).astype(np.int32)
-        errors = depth - np.add.reduceat(match, starts[:-1], axis=0)
+        # depth/errors per segment: one native pass over the dense rows when
+        # available (i32, not i16: the i16 clamp happens at tag-write time
+        # downstream, matching the reference); numpy reduceat fallback
+        from ..native import batch as nb
+
+        if nb.available():
+            d32, e32 = nb.segment_depth_errors(codes2d, winner, starts)
+            depth = d32.astype(np.int64)
+            errors = e32.astype(np.int64)
+        else:
+            valid = (codes2d != N_CODE).astype(np.int32)
+            depth = np.add.reduceat(valid, starts[:-1], axis=0).astype(np.int64)
+            counts = np.diff(starts)
+            winner_rows = np.repeat(winner, counts, axis=0)
+            match = ((codes2d == winner_rows)
+                     & (codes2d != N_CODE)).astype(np.int32)
+            errors = depth - np.add.reduceat(match, starts[:-1], axis=0)
         self._count_suspects(suspect)
         if suspect.any():
             self._oracle_patch(

@@ -291,8 +291,18 @@ def _add_duplex(sub):
                         "consensus (default true)")
     p.add_argument("--rejects", default=None,
                    help="optional BAM for raw reads that contribute to no "
-                        "consensus (secondary output stream)")
+                        "consensus (secondary output stream; uses the classic "
+                        "engine)")
     p.add_argument("--batch-molecules", type=int, default=1000)
+    p.add_argument("--threads", type=int, default=0,
+                   help="reader/writer threads around the vectorized engine "
+                        "(0/1 = inline)")
+    p.add_argument("--batch-bytes", type=int, default=16 << 20,
+                   help="decompressed bytes per record batch (fast engine)")
+    p.add_argument("--stats", action="store_true",
+                   help="print per-stage pipeline timing table")
+    p.add_argument("--classic", action="store_true",
+                   help="force the per-molecule engine (no batch vectorization)")
     p.set_defaults(func=cmd_duplex)
 
 
@@ -302,18 +312,27 @@ def cmd_duplex(args):
     from .io.bam import BamHeader, BamReader, BamWriter
 
     try:
-        caller = DuplexConsensusCaller(
-            args.read_name_prefix, args.read_group_id, min_reads=args.min_reads,
+        caller_kw = dict(
+            min_reads=args.min_reads,
             min_input_base_quality=args.min_input_base_quality,
             produce_per_base_tags=not args.no_per_base_tags, trim=args.trim,
             max_reads_per_strand=args.max_reads_per_strand,
             error_rate_pre_umi=args.error_rate_pre_umi,
             error_rate_post_umi=args.error_rate_post_umi, seed=args.seed,
             track_rejects=args.rejects is not None)
+        caller = DuplexConsensusCaller(args.read_name_prefix,
+                                       args.read_group_id, **caller_kw)
     except ValueError as e:
         log.error("%s", e)
         return 2
 
+    from .native import batch as nb
+
+    # the vectorized engine cannot express quality trimming; rejects tracking
+    # routes every molecule through the slow fallback, so use the classic
+    # loop directly there
+    use_fast = (nb.available() and not getattr(args, "classic", False)
+                and not args.trim and args.rejects is None)
     t0 = time.monotonic()
     allow_unmapped = args.allow_unmapped
     oc_caller = None
@@ -321,39 +340,74 @@ def cmd_duplex(args):
         from .consensus.overlapping import (OverlappingBasesConsensusCaller,
                                             apply_overlapping_consensus)
         oc_caller = OverlappingBasesConsensusCaller("consensus", "consensus")
-    with BamReader(args.input) as reader:
-        out_header = _unmapped_consensus_header(args.read_group_id)
-        from .consensus.rejects import RejectsSink
+    out_header = _unmapped_consensus_header(args.read_group_id)
+    if use_fast:
+        from .consensus.fast import resolve_chunk
+        from .consensus.fast_duplex import FastDuplexCaller
+        from .io.batch_reader import BamBatchReader
+        from .pipeline import StageTimes, run_stages
+        from .utils.progress import ProgressTracker
 
-        with RejectsSink(args.rejects, reader.header) as rejects, \
-                BamWriter(args.output, out_header) as writer:
-            n_out = 0
-            pregroup = lambda r: consensus_pregroup_keep(r.flag, allow_unmapped)
-            batch = []
-            for group in iter_duplex_groups(reader, record_filter=pregroup):
-                if oc_caller is not None:
-                    base_mi, a_recs, b_recs = group
-                    # skip single-strand groups: no duplex possible anyway
-                    # (duplex.rs:496-499 has_both_strands_raw gate)
-                    if a_recs and b_recs:
-                        group = (base_mi,
-                                 apply_overlapping_consensus(a_recs, oc_caller),
-                                 apply_overlapping_consensus(b_recs, oc_caller))
-                batch.append(group)
-                if len(batch) >= args.batch_molecules:
+        stats_t = StageTimes()
+        fast = FastDuplexCaller(caller, b"MI", overlap_caller=oc_caller)
+        progress = ProgressTracker("duplex")
+        with BamBatchReader(args.input,
+                            target_bytes=args.batch_bytes) as reader:
+
+            def _process(batch):
+                progress.add(batch.n)
+                return fast.process_batch(batch, allow_unmapped)
+
+            with BamWriter(args.output, out_header) as writer:
+                run_stages(
+                    iter(reader), _process,
+                    lambda chunk: writer.write_serialized(
+                        resolve_chunk(chunk)),
+                    threads=args.threads, stats=stats_t)
+                for blob in fast.flush():
+                    writer.write_serialized(resolve_chunk(blob))
+        progress.finish()
+        n_out = caller.stats.consensus_reads
+        if args.stats:
+            print(stats_t.format_table())
+    else:
+        with BamReader(args.input) as reader:
+            from .consensus.rejects import RejectsSink
+
+            with RejectsSink(args.rejects, reader.header) as rejects, \
+                    BamWriter(args.output, out_header) as writer:
+                n_out = 0
+                pregroup = lambda r: consensus_pregroup_keep(r.flag,
+                                                             allow_unmapped)
+                batch = []
+                for group in iter_duplex_groups(reader,
+                                                record_filter=pregroup):
+                    if oc_caller is not None:
+                        base_mi, a_recs, b_recs = group
+                        # skip single-strand groups: no duplex possible anyway
+                        # (duplex.rs:496-499 has_both_strands_raw gate)
+                        if a_recs and b_recs:
+                            group = (base_mi,
+                                     apply_overlapping_consensus(a_recs,
+                                                                 oc_caller),
+                                     apply_overlapping_consensus(b_recs,
+                                                                 oc_caller))
+                    batch.append(group)
+                    if len(batch) >= args.batch_molecules:
+                        for rec_bytes in caller.call_groups(batch):
+                            writer.write_record_bytes(rec_bytes)
+                            n_out += 1
+                        rejects.drain(caller)
+                        batch = []
+                if batch:
                     for rec_bytes in caller.call_groups(batch):
                         writer.write_record_bytes(rec_bytes)
                         n_out += 1
                     rejects.drain(caller)
-                    batch = []
-            if batch:
-                for rec_bytes in caller.call_groups(batch):
-                    writer.write_record_bytes(rec_bytes)
-                    n_out += 1
-                rejects.drain(caller)
     dt = time.monotonic() - t0
     s = caller.merged_stats()
-    log.info("duplex: %d input reads -> %d consensus reads in %.2fs (%.0f reads/s)",
+    log.info("duplex[%s]: %d input reads -> %d consensus reads in %.2fs "
+             "(%.0f reads/s)", "fast" if use_fast else "classic",
              s.input_reads, n_out, dt, s.input_reads / dt if dt else 0)
     if oc_caller is not None and oc_caller.stats.overlapping_bases:
         ocs = oc_caller.stats

@@ -215,83 +215,7 @@ class FastSimplexCaller:
     # ------------------------------------------------------------ overlap corr
 
     def _overlap_correct(self, batch, idx, bounds, g0, g1):
-        """Pair primary R1/R2 by name within each group; one native call."""
-        flag = batch.flag
-        span = idx[bounds[g0]:bounds[g1]]
-        # fast path: the grouped-BAM layout keeps each template's primary R1
-        # immediately followed by its R2 (group output preserves template
-        # adjacency); vectorized detection of (FIRST, LAST) runs with equal
-        # names covers it, the per-group dict pairing is the general fallback
-        f_span = flag[span]
-        # candidate adjacency: FIRST record followed by a LAST-and-not-FIRST
-        # one (a FIRST|LAST record sorts into the R1 slot in the dict/
-        # reference pairing, overlapping.py:203-206, and never completes a
-        # pair — it must not complete one here either)
-        is_first = (f_span[:-1] & FLAG_FIRST) != 0
-        next_last = ((f_span[1:] & FLAG_LAST) != 0) \
-            & ((f_span[1:] & FLAG_FIRST) == 0)
-        cand = np.nonzero(is_first & next_last)[0]
-        # a pair must not straddle an MI-group boundary: the dict pairing is
-        # per group, so a FIRST ending group g adjacent to a LAST opening
-        # group g+1 (same-name duplicates across groups in a malformed BAM)
-        # must stay two orphans, not become a cross-family correction
-        if len(cand) and g1 - g0 > 1:
-            boundary = np.zeros(len(span) + 1, dtype=bool)
-            boundary[bounds[g0 + 1:g1] - bounds[g0]] = True
-            cand = cand[~boundary[cand + 1]]
-        adjacent_ok = False
-        # flag-level completeness precheck (no name comparisons): every
-        # FIRST/LAST-flagged record must sit in some candidate adjacency,
-        # else an orphan exists somewhere and the dict scan runs anyway
-        first_or_last = (f_span & (FLAG_FIRST | FLAG_LAST)) != 0
-        if len(cand):
-            used = np.zeros(len(span), dtype=bool)
-            keep = []
-            for c in cand:
-                if not used[c] and not used[c + 1]:
-                    used[c] = used[c + 1] = True
-                    keep.append(c)
-            if bool(used[first_or_last].all()):
-                keep = np.asarray(keep, dtype=np.int64)
-                a, b = span[keep], span[keep + 1]
-                name_off = batch.data_off + 32
-                name_len = (batch.l_read_name - 1).astype(np.int32)
-                same = nb.ranges_equal(batch.buf, name_off[a], name_len[a],
-                                       name_off[b], name_len[b])
-                # repeated names among kept pairs diverge from the dict
-                # pairing (last-writer-wins slots correct only one pair);
-                # hash-collision false positives only cause a safe fallback
-                hashes = nb.hash_ranges(batch.buf, name_off[a], name_len[a])
-                if same.all() and len(np.unique(hashes)) == len(hashes):
-                    adjacent_ok = True
-                    r1_offs = batch.data_off[a]
-                    r2_offs = batch.data_off[b]
-        if not adjacent_ok:
-            r1_offs = []
-            r2_offs = []
-            for g in range(g0, g1):
-                members = idx[bounds[g]:bounds[g + 1]]
-                pairs = {}
-                for i in members:
-                    f = int(flag[i])
-                    # secondary/supplementary were already filtered from idx
-                    slot = pairs.setdefault(batch.name(int(i)), [None, None])
-                    if f & FLAG_FIRST:
-                        slot[0] = int(i)
-                    elif f & FLAG_LAST:
-                        slot[1] = int(i)
-                for a, b in pairs.values():
-                    if a is not None and b is not None:
-                        r1_offs.append(batch.data_off[a])
-                        r2_offs.append(batch.data_off[b])
-        if len(r1_offs) == 0:
-            return
-        oc = self.overlap_caller
-        stats = nb.overlap_correct_pairs(
-            batch.buf, np.asarray(r1_offs, dtype=np.int64),
-            np.asarray(r2_offs, dtype=np.int64),
-            AGREEMENT_CODES[oc.agreement], DISAGREEMENT_CODES[oc.disagreement])
-        add_native_overlap_stats(oc.stats, stats)
+        overlap_correct_span(batch, idx, bounds, g0, g1, self.overlap_caller)
 
     # ------------------------------------------------------------------ groups
 
@@ -842,3 +766,87 @@ class FastSimplexCaller:
 
 
 _CIGAR_OPS = "MIDNSHP=X"
+
+
+def overlap_correct_span(batch, idx, bounds, g0, g1, oc):
+    """In-place R1/R2 overlap correction over groups [g0, g1) of `idx`.
+
+    Pairs primary R1/R2 by name within each group; one native call. Shared by
+    the fast simplex engine (MI groups) and the fast duplex engine
+    ((molecule, strand) subgroups).
+    """
+    flag = batch.flag
+    span = idx[bounds[g0]:bounds[g1]]
+    # fast path: the grouped-BAM layout keeps each template's primary R1
+    # immediately followed by its R2 (group output preserves template
+    # adjacency); vectorized detection of (FIRST, LAST) runs with equal
+    # names covers it, the per-group dict pairing is the general fallback
+    f_span = flag[span]
+    # candidate adjacency: FIRST record followed by a LAST-and-not-FIRST
+    # one (a FIRST|LAST record sorts into the R1 slot in the dict/
+    # reference pairing, overlapping.py:203-206, and never completes a
+    # pair — it must not complete one here either)
+    is_first = (f_span[:-1] & FLAG_FIRST) != 0
+    next_last = ((f_span[1:] & FLAG_LAST) != 0) \
+        & ((f_span[1:] & FLAG_FIRST) == 0)
+    cand = np.nonzero(is_first & next_last)[0]
+    # a pair must not straddle an MI-group boundary: the dict pairing is
+    # per group, so a FIRST ending group g adjacent to a LAST opening
+    # group g+1 (same-name duplicates across groups in a malformed BAM)
+    # must stay two orphans, not become a cross-family correction
+    if len(cand) and g1 - g0 > 1:
+        boundary = np.zeros(len(span) + 1, dtype=bool)
+        boundary[bounds[g0 + 1:g1] - bounds[g0]] = True
+        cand = cand[~boundary[cand + 1]]
+    adjacent_ok = False
+    # flag-level completeness precheck (no name comparisons): every
+    # FIRST/LAST-flagged record must sit in some candidate adjacency,
+    # else an orphan exists somewhere and the dict scan runs anyway
+    first_or_last = (f_span & (FLAG_FIRST | FLAG_LAST)) != 0
+    if len(cand):
+        used = np.zeros(len(span), dtype=bool)
+        keep = []
+        for c in cand:
+            if not used[c] and not used[c + 1]:
+                used[c] = used[c + 1] = True
+                keep.append(c)
+        if bool(used[first_or_last].all()):
+            keep = np.asarray(keep, dtype=np.int64)
+            a, b = span[keep], span[keep + 1]
+            name_off = batch.data_off + 32
+            name_len = (batch.l_read_name - 1).astype(np.int32)
+            same = nb.ranges_equal(batch.buf, name_off[a], name_len[a],
+                                   name_off[b], name_len[b])
+            # repeated names among kept pairs diverge from the dict
+            # pairing (last-writer-wins slots correct only one pair);
+            # hash-collision false positives only cause a safe fallback
+            hashes = nb.hash_ranges(batch.buf, name_off[a], name_len[a])
+            if same.all() and len(np.unique(hashes)) == len(hashes):
+                adjacent_ok = True
+                r1_offs = batch.data_off[a]
+                r2_offs = batch.data_off[b]
+    if not adjacent_ok:
+        r1_offs = []
+        r2_offs = []
+        for g in range(g0, g1):
+            members = idx[bounds[g]:bounds[g + 1]]
+            pairs = {}
+            for i in members:
+                f = int(flag[i])
+                # secondary/supplementary were already filtered from idx
+                slot = pairs.setdefault(batch.name(int(i)), [None, None])
+                if f & FLAG_FIRST:
+                    slot[0] = int(i)
+                elif f & FLAG_LAST:
+                    slot[1] = int(i)
+            for a, b in pairs.values():
+                if a is not None and b is not None:
+                    r1_offs.append(batch.data_off[a])
+                    r2_offs.append(batch.data_off[b])
+    if len(r1_offs) == 0:
+        return
+    stats = nb.overlap_correct_pairs(
+        batch.buf, np.asarray(r1_offs, dtype=np.int64),
+        np.asarray(r2_offs, dtype=np.int64),
+        AGREEMENT_CODES[oc.agreement], DISAGREEMENT_CODES[oc.disagreement])
+    add_native_overlap_stats(oc.stats, stats)

@@ -1,0 +1,705 @@
+"""Vectorized duplex consensus path over RecordBatch inputs.
+
+The duplex analog of consensus/fast.py: per-record work happens natively in
+batch (fgumi_tpu.native.batch), per-molecule work on numpy index slices, the
+single-strand likelihood loop on the device kernel, stage-2 strand
+combination as whole-batch array math, and record serialization in one
+native call (fgumi_build_duplex_records).
+
+Semantics contract: byte-identical output and identical rejection statistics
+to DuplexConsensusCaller.call_groups on the same stream (reference
+duplex_caller.rs:1755-2268; tested in tests/test_fast_duplex.py). Molecules
+the vectorized path cannot express (FIRST|LAST-flagged reads, per-strand
+downsampling, most-common-alignment filtering) fall back to the slow caller
+per molecule, in stream order.
+"""
+
+import numpy as np
+
+from ..constants import MAX_PHRED, MIN_PHRED, N_CODE
+from ..io.bam import (FLAG_FIRST, FLAG_LAST, FLAG_MATE_UNMAPPED, FLAG_PAIRED,
+                      FLAG_REVERSE, FLAG_SECONDARY, FLAG_SUPPLEMENTARY,
+                      FLAG_UNMAPPED)
+from ..native import batch as nb
+from ..ops import oracle
+from .fast import overlap_correct_span
+from .simple_umi import consensus_umis
+from .vanilla import I16_MAX, R1, R2, _TYPE_FLAGS
+
+# seg types within a molecule: (strand, read-type) -> 0..3
+AB_R1, AB_R2, BA_R1, BA_R2 = 0, 1, 2, 3
+
+
+def _flip_umi(value: str) -> str:
+    """Dual-UMI strand reorientation (duplex_caller.rs:1226-1231)."""
+    return "-".join(reversed(value.split("-")))
+
+
+class FastDuplexCaller:
+    """Batch-vectorized duplex caller wrapping a DuplexConsensusCaller.
+
+    The wrapped caller owns options/stats/kernel and serves as the
+    per-molecule fallback, so statistics and output bytes are shared across
+    both paths.
+    """
+
+    def __init__(self, caller, tag: bytes = b"MI", overlap_caller=None):
+        self.caller = caller
+        self.ss = caller.ss
+        self.kernel = caller.ss.kernel
+        self.tag = tag
+        self.overlap_caller = overlap_caller
+        self._carry = None  # (base_mi, [RawRecord] a, [RawRecord] b)
+
+    # ------------------------------------------------------------------ driver
+
+    def process_batch(self, batch, allow_unmapped: bool = False,
+                      final: bool = False):
+        """Consume one RecordBatch -> list of wire chunks (block_size-prefixed
+        record runs). The molecule spanning the batch boundary is carried as
+        RawRecords and processed via the slow path when it completes."""
+        flag = batch.flag
+        keep = (flag & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY)) == 0
+        if not allow_unmapped:
+            is_mapped = (flag & FLAG_UNMAPPED) == 0
+            mapped_mate = ((flag & FLAG_PAIRED) != 0) \
+                & ((flag & FLAG_MATE_UNMAPPED) == 0)
+            keep &= is_mapped | mapped_mate
+        idx = np.nonzero(keep)[0]
+        if len(idx) == 0:
+            return self.flush() if final else []
+
+        mi_off, mi_len, _ = batch.tag_locs(self.tag)
+        mo, ml = mi_off[idx], mi_len[idx]
+        if (mo < 0).any():
+            bad = int(idx[np.nonzero(mo < 0)[0][0]])
+            raise ValueError(
+                f"record {batch.name(bad)!r} missing {self.tag.decode()} tag")
+        buf = batch.buf
+        ok = (ml >= 3) & (buf[mo + ml - 2] == ord("/")) \
+            & ((buf[mo + ml - 1] == ord("A")) | (buf[mo + ml - 1] == ord("B")))
+        if not ok.all():
+            bad = int(idx[np.nonzero(~ok)[0][0]])
+            mi = batch.tag_bytes(self.tag, bad).decode()
+            raise ValueError(
+                f"Read has MI tag {mi!r} without /A or /B suffix. Duplex "
+                "consensus requires input from `group --strategy paired`, "
+                "which marks the source strand.")
+
+        starts = nb.group_starts(buf, np.ascontiguousarray(mo),
+                                 (ml - 2).astype(np.int32))
+        bounds = np.append(starts, len(idx))
+        n_total = len(bounds) - 1
+        strand_b = buf[mo + ml - 1] == ord("B")  # per kept row
+
+        def materialize(lo, hi):
+            rows = idx[lo:hi]
+            a = batch.raw_records(rows[~strand_b[lo:hi]])
+            b = batch.raw_records(rows[strand_b[lo:hi]])
+            return a, b
+
+        first_base = self._base_mi(batch, int(idx[bounds[0]]))
+        merge_carry = self._carry is not None and self._carry[0] == first_base
+        if merge_carry:
+            a, b = materialize(bounds[0], bounds[1])
+            self._carry[1].extend(a)
+            self._carry[2].extend(b)
+
+        g0 = 1 if merge_carry else 0
+        g1 = n_total if final else max(n_total - 1, g0)
+        deferred = None
+        if not final and n_total - 1 >= g0:
+            a, b = materialize(bounds[n_total - 1], bounds[n_total])
+            deferred = (self._base_mi(batch, int(idx[bounds[n_total - 1]])),
+                        a, b)
+
+        out = []
+        if self._carry is not None:
+            if (not merge_carry) or final or n_total >= 2:
+                out.extend(self._call_slow_molecule(*self._carry))
+                self._carry = None
+
+        if g1 > g0:
+            if self.overlap_caller is not None:
+                self._overlap_correct(batch, idx, bounds, strand_b, g0, g1)
+            out.extend(self._process_molecules(batch, idx, bounds, strand_b,
+                                               g0, g1))
+
+        if deferred is not None:
+            self._carry = deferred
+        if final:
+            out.extend(self.flush())
+        return out
+
+    def flush(self):
+        if self._carry is None:
+            return []
+        base_mi, a, b = self._carry
+        self._carry = None
+        return self._call_slow_molecule(base_mi, a, b)
+
+    def _base_mi(self, batch, i: int) -> str:
+        return batch.tag_bytes(self.tag, i)[:-2].decode()
+
+    # ------------------------------------------------------------ slow interop
+
+    def _call_slow_molecule(self, base_mi, a_records, b_records,
+                            corrected=False):
+        """One molecule through DuplexConsensusCaller (the semantic
+        reference). Overlap correction applies here unless the records were
+        already corrected in place natively."""
+        if self.overlap_caller is not None and not corrected \
+                and a_records and b_records:
+            from .overlapping import apply_overlapping_consensus
+
+            a_records = apply_overlapping_consensus(a_records,
+                                                    self.overlap_caller)
+            b_records = apply_overlapping_consensus(b_records,
+                                                    self.overlap_caller)
+        recs = self.caller.call_groups([(base_mi, a_records, b_records)])
+        if not recs:
+            return []
+        return [b"".join(len(r).to_bytes(4, "little") + r for r in recs)]
+
+    # ------------------------------------------------------------ overlap corr
+
+    def _overlap_correct(self, batch, idx, bounds, strand_b, g0, g1):
+        """Per (molecule, strand) correction for molecules with both strands
+        (the cmd-level `a_recs and b_recs` gate, duplex.rs has_both_strands)."""
+        nG = g1 - g0
+        lo, hi = bounds[g0], bounds[g1]
+        g_of_row = np.repeat(np.arange(nG), np.diff(bounds[g0:g1 + 1]))
+        sb = strand_b[lo:hi]
+        n_b = np.bincount(g_of_row, weights=sb, minlength=nG)
+        n_a = np.bincount(g_of_row, weights=~sb, minlength=nG)
+        both = (n_a > 0) & (n_b > 0)
+        if not both.any():
+            return
+        rows_ok = both[g_of_row]
+        er = np.nonzero(rows_ok)[0]
+        key = g_of_row[er] * 2 + sb[er]
+        order = np.argsort(key, kind="stable")
+        idx2 = idx[lo:hi][er[order]]
+        skey = key[order]
+        seg_first = np.concatenate(([True], skey[1:] != skey[:-1]))
+        bounds2 = np.append(np.nonzero(seg_first)[0], len(idx2))
+        overlap_correct_span(batch, idx2, bounds2, 0, len(bounds2) - 1,
+                             self.overlap_caller)
+
+    # ------------------------------------------------------------- stage 1 + 2
+
+    def _process_molecules(self, batch, idx, bounds, strand_b, g0, g1):
+        caller = self.caller
+        stats = caller.stats
+        span = idx[bounds[g0]:bounds[g1]]
+        nG = g1 - g0
+        gb = bounds[g0:g1 + 1] - bounds[g0]
+        sizes = np.diff(gb)
+        g_of_row = np.repeat(np.arange(nG), sizes)
+        sb = strand_b[bounds[g0]:bounds[g1]]
+
+        flag_s = batch.flag[span]
+        paired = (flag_s & FLAG_PAIRED) != 0
+        first = (flag_s & FLAG_FIRST) != 0
+        last = (flag_s & FLAG_LAST) != 0
+
+        # molecule-level fallback: FIRST|LAST reads (belong to both X and Y
+        # sets) and per-strand downsampling
+        fallback = np.zeros(nG, dtype=bool)
+        fl_both = paired & first & last
+        fallback[g_of_row[fl_both]] = True
+        max_rs = self.ss.options.max_reads
+        if self.caller.track_rejects:
+            fallback[:] = True
+
+        # per-row seg type (AB_R1..BA_R2); fragments and paired-but-neither
+        # get -1
+        t = np.full(len(span), -1, dtype=np.int8)
+        r1 = paired & first
+        r2 = paired & last & ~first
+        t[~sb & r1] = AB_R1
+        t[~sb & r2] = AB_R2
+        t[sb & r1] = BA_R1
+        t[sb & r2] = BA_R2
+
+        frag = ~paired
+        n_frag = np.bincount(g_of_row[frag], minlength=nG)
+        n_paired = sizes - n_frag
+        num_a_r1 = np.bincount(g_of_row[~sb & r1], minlength=nG)
+        num_b_r1 = np.bincount(g_of_row[sb & r1], minlength=nG)
+        num_xy = np.maximum(num_a_r1, num_b_r1)
+        num_yx = np.minimum(num_a_r1, num_b_r1)
+        gate_ok = (caller.min_total <= num_xy + num_yx) \
+            & (caller.min_xy <= num_xy) & (caller.min_yx <= num_yx)
+
+        # strand-orientation validation (duplex_caller.rs:1830-1860): only for
+        # molecules with paired rows on both strands; X = AB-R1 + BA-R2 and
+        # Y = AB-R2 + BA-R1 must each be strand-uniform
+        n_pa = np.bincount(g_of_row[~sb & paired], minlength=nG)
+        n_pb = np.bincount(g_of_row[sb & paired], minlength=nG)
+        both_strands = (n_pa > 0) & (n_pb > 0)
+        rev = (flag_s & FLAG_REVERSE) != 0
+        is_x = (t == AB_R1) | (t == BA_R2)
+        is_y = (t == AB_R2) | (t == BA_R1)
+        coll = np.zeros(nG, dtype=bool)
+        for setm in (is_x, is_y):
+            gr = g_of_row[setm]
+            rv = rev[setm]
+            mn = np.full(nG, 2, dtype=np.int8)
+            mx = np.full(nG, -1, dtype=np.int8)
+            np.minimum.at(mn, gr, rv.astype(np.int8))
+            np.maximum.at(mx, gr, rv.astype(np.int8))
+            coll |= (mx - mn) > 0
+        coll &= both_strands
+
+        # native pack over all rows (clip/trim/RC/mask; fast.py discipline)
+        mc_off, mc_len, _ = batch.tag_locs(b"MC")
+        clips = nb.mate_clips(
+            batch.buf, np.ascontiguousarray(batch.cigar_off[span]),
+            batch.n_cigar[span], batch.flag[span], batch.ref_id[span],
+            batch.pos[span], batch.next_ref_id[span], batch.next_pos[span],
+            batch.tlen[span], np.ascontiguousarray(mc_off[span]),
+            mc_len[span])
+        stride = max(-(-int(batch.l_seq[span].max()) // 32) * 32, 32)
+        codes, quals, final_len = nb.pack_reads(
+            batch.buf, np.ascontiguousarray(batch.seq_off[span]),
+            np.ascontiguousarray(batch.qual_off[span]), batch.l_seq[span],
+            rev.astype(np.uint8), clips,
+            self.ss.options.min_input_base_quality, stride)
+
+        # seg construction over valid rows of live molecules (dead molecules
+        # -- failed gates/validation -- need no conversion at all)
+        live_mol = gate_ok & ~coll & (n_paired > 0) & ~fallback
+        valid = (final_len > 0) & (t >= 0) & live_mol[g_of_row]
+        er = np.nonzero(valid)[0]
+        key = g_of_row[er] * 4 + t[er]
+        order = np.argsort(key, kind="stable")
+        vrows = er[order]
+        skey = key[order]
+        seg_first = np.concatenate(([True], skey[1:] != skey[:-1])) \
+            if len(skey) else np.empty(0, dtype=bool)
+        seg_of_row = (np.cumsum(seg_first) - 1) if len(skey) else skey
+        seg_key = skey[seg_first] if len(skey) else skey
+        nseg = len(seg_key)
+        seg_g = seg_key >> 2
+        seg_t = (seg_key & 3).astype(np.int8)
+        c1 = np.bincount(seg_of_row, minlength=nseg).astype(np.int64)
+        vstarts = np.concatenate(([0], np.cumsum(c1))).astype(np.int64)
+        if max_rs is not None and nseg and (c1 > max_rs).any():
+            fallback[seg_g[c1 > max_rs]] = True
+
+        # alignment-filter analysis per X/Y set of each live molecule:
+        # uniform CIGARs over the set's valid rows, with the mixed-strand
+        # palindrome rule (fast.py _prepare_groups_vec)
+        if nseg:
+            self._need_filter_fallback(batch, span, vrows, g_of_row, t,
+                                       fallback, nG)
+        live_mol &= ~fallback
+
+        # rejection tallies for non-fallback molecules
+        vec = ~fallback
+        stats.input_reads += int(sizes[vec].sum())
+        n_fr = int(n_frag[vec].sum())
+        if n_fr:
+            stats.reject("FragmentRead", n_fr)
+        gate_dead = vec & ~gate_ok & (n_paired > 0)
+        if gate_dead.any():
+            stats.reject("InsufficientReads", int(n_paired[gate_dead].sum()))
+        coll_dead = vec & gate_ok & coll
+        if coll_dead.any():
+            stats.reject("PotentialCollision", int(n_paired[coll_dead].sum()))
+
+        # molecule -> seg map for live molecules
+        seg_map = np.full((nG, 4), -1, dtype=np.int64)
+        if nseg:
+            lm = live_mol[seg_g]
+            seg_map[seg_g[lm], seg_t[lm]] = np.nonzero(lm)[0]
+
+        # SS consensus for every seg: one kernel dispatch for multi-read
+        # segs, one vectorized host pass for single-read segs
+        L_max = stride
+        tb, tq, d16, e16, codes2d = self._ss_consensus(codes, quals, vrows,
+                                                       c1, vstarts, nseg,
+                                                       L_max)
+        seg_len = np.zeros(nseg, dtype=np.int64)
+        if nseg:
+            fl = final_len[vrows]
+            np.maximum.at(seg_len, seg_of_row, fl)
+
+        return self._stage2(batch, span, gb, sizes, n_paired, fallback, sb,
+                            live_mol, seg_map, seg_len, tb, tq, d16, e16,
+                            codes2d, vrows, vstarts, L_max)
+
+    def _need_filter_fallback(self, batch, span, vrows, g_of_row, t, fallback,
+                              nG):
+        """Mark molecules whose X or Y set would engage the alignment filter."""
+        tt = t[vrows]
+        setid = np.where((tt == AB_R1) | (tt == BA_R2), 0, 1)
+        key = g_of_row[vrows] * 2 + setid
+        order = np.argsort(key, kind="stable")
+        srows = vrows[order]
+        skey = key[order]
+        if not len(skey):
+            return
+        sfirst = np.concatenate(([True], skey[1:] != skey[:-1]))
+        sstarts = np.append(np.nonzero(sfirst)[0], len(skey))
+        set_g = skey[sfirst] >> 1
+        co = batch.cigar_off
+        cl = (4 * batch.n_cigar).astype(np.int32)
+        firsts = srows[sstarts[:-1]]
+        counts = np.diff(sstarts)
+        rep_first = np.repeat(firsts, counts)
+        eq = nb.ranges_equal(batch.buf, co[span[srows]], cl[span[srows]],
+                             co[span[rep_first]], cl[span[rep_first]])
+        uniform = np.minimum.reduceat(eq, sstarts[:-1]).astype(bool)
+        rev8 = ((batch.flag[span[srows]] & FLAG_REVERSE) != 0).astype(np.uint8)
+        mn = np.minimum.reduceat(rev8, sstarts[:-1])
+        mx = np.maximum.reduceat(rev8, sstarts[:-1])
+        mixed = (mn == 0) & (mx == 1) & (counts >= 2)
+        need = ~uniform
+        for s in np.nonzero(uniform & mixed)[0]:
+            rec_i = int(span[firsts[s]])
+            if batch.n_cigar[rec_i] == 1:
+                continue  # single-op simplified CIGARs are palindromic
+            from ..core import cigar as cigar_utils
+            from .fast import FastSimplexCaller
+
+            cig = FastSimplexCaller._decode_cigar(batch, rec_i)
+            simplified = cigar_utils.simplify(cig)
+            if simplified != list(reversed(simplified)):
+                need[s] = True
+        fallback[set_g[need]] = True
+
+    def _ss_consensus(self, codes, quals, vrows, c1, vstarts, nseg, L_max):
+        """All segs' single-strand consensus: thresholded bases/quals and
+        i16-clamped depth/error arrays, (nseg, L_max) each."""
+        opts = self.ss.options
+        tb = np.zeros((nseg, L_max), dtype=np.uint8)
+        tq = np.zeros((nseg, L_max), dtype=np.uint8)
+        d16 = np.zeros((nseg, L_max), dtype=np.int32)
+        e16 = np.zeros((nseg, L_max), dtype=np.int32)
+        if not nseg:
+            return tb, tq, d16, e16, np.zeros((0, L_max), dtype=np.uint8)
+        codes2d = np.ascontiguousarray(codes[vrows])
+        quals2d = np.ascontiguousarray(quals[vrows])
+
+        single = c1 == 1
+        if single.any():
+            rows = vrows[vstarts[:-1][single]]
+            b, q, d, e = oracle.single_read_consensus(
+                codes[rows], quals[rows], self.ss.tables,
+                opts.min_consensus_base_quality)
+            tb[single] = b
+            tq[single] = q
+            d16[single] = np.minimum(d, I16_MAX).astype(np.int32)
+            # errors are zero for single-read consensus
+        multi = np.nonzero(~single)[0]
+        if len(multi):
+            from ..ops.kernel import pad_segments
+
+            rows_m = np.concatenate(
+                [np.arange(vstarts[s], vstarts[s + 1]) for s in multi])
+            cm = np.ascontiguousarray(codes2d[rows_m])
+            qm = np.ascontiguousarray(quals2d[rows_m])
+            counts_m = c1[multi]
+            starts_m = np.concatenate(([0], np.cumsum(counts_m)))
+            codes_dev, quals_dev, seg_ids, _, F_pad = pad_segments(
+                cm, qm, counts_m)
+            dev = self.kernel.device_call_segments(codes_dev, quals_dev,
+                                                   seg_ids, F_pad)
+            w, q_, d, e = self.kernel.resolve_segments(dev, cm, qm, starts_m)
+            b_m, q_m = oracle.apply_consensus_thresholds(
+                w, q_, d, opts.min_reads, opts.min_consensus_base_quality)
+            tb[multi] = b_m
+            tq[multi] = q_m
+            d16[multi] = np.minimum(d, I16_MAX).astype(np.int32)
+            e16[multi] = np.minimum(e, I16_MAX).astype(np.int32)
+        return tb, tq, d16, e16, codes2d
+
+    # ---------------------------------------------------------------- stage 2
+
+    def _stage2(self, batch, span, gb, sizes, n_paired, fallback, sb,
+                live_mol, seg_map, seg_len, tb, tq, d16, e16, codes2d,
+                vrows, vstarts, L_max):
+        """Strand combination + serialization, molecule order preserved."""
+        caller = self.caller
+        stats = caller.stats
+        nG = len(sizes)
+
+        p = seg_map >= 0
+        full = p.all(axis=1) & live_mol
+        ab_only = p[:, AB_R1] & p[:, AB_R2] & ~p[:, BA_R1] & ~p[:, BA_R2] \
+            & live_mol & (caller.min_yx == 0)
+        ba_only = ~p[:, AB_R1] & ~p[:, AB_R2] & p[:, BA_R1] & p[:, BA_R2] \
+            & live_mol & (caller.min_yx == 0)
+
+        # per-seg aliveness: any positive depth within a length limit is
+        # evaluated lazily per output read (lengths differ per pairing)
+        def seg_alive(s, limit):
+            return bool((d16[s, :limit] > 0).any())
+
+        # build output reads in molecule order: 2 per emitted molecule
+        out_specs = []   # (mol, flags, aseg, bseg, kind) kind: 2=combined,
+        #                   1=a-passthrough, 0=b-passthrough(is_ba_only)
+        emitted = np.zeros(nG, dtype=bool)
+        col = np.arange(L_max)
+
+        def classify(mol, a_s, b_s):
+            """One output read's effective sides; None = dead molecule."""
+            La, Lb = int(seg_len[a_s]) if a_s >= 0 else 0, \
+                int(seg_len[b_s]) if b_s >= 0 else 0
+            if a_s >= 0 and b_s >= 0:
+                length = min(La, Lb)
+                aa = seg_alive(a_s, length)
+                ba = seg_alive(b_s, length)
+                if aa and ba:
+                    return (2, a_s, b_s, length)
+                if aa:
+                    return (1, a_s, -1, La)
+                if ba:
+                    return (0, b_s, -1, Lb)
+                return None
+            if a_s >= 0:
+                return (1, a_s, -1, La) if seg_alive(a_s, La) else None
+            if b_s >= 0:
+                return (0, b_s, -1, Lb) if seg_alive(b_s, Lb) else None
+            return None
+
+        for g in np.nonzero(full | ab_only | ba_only)[0]:
+            # rx1/rx2: the AB and BA segs contributing RX values per output
+            # read — the reference folds in raws of BOTH segs even when one
+            # strand's consensus is depth-dead (duplex.py:421-434 iterates
+            # raws_a + raws_b of the branch taken)
+            if full[g]:
+                spec1 = classify(g, seg_map[g, AB_R1], seg_map[g, BA_R2])
+                spec2 = classify(g, seg_map[g, AB_R2], seg_map[g, BA_R1])
+                rx1 = (seg_map[g, AB_R1], seg_map[g, BA_R2])
+                rx2 = (seg_map[g, AB_R2], seg_map[g, BA_R1])
+                if spec1 is None or spec2 is None:
+                    continue
+                # _has_min_reads on both output reads (duplex.py:304-308)
+                okmin = True
+                for spec in (spec1, spec2):
+                    kind, s1, s2, length = spec
+                    na = int(d16[s1, :length].max()) if length else 0
+                    nb_ = int(d16[s2, :length].max()) if kind == 2 and length \
+                        else 0
+                    xy, yx = max(na, nb_), min(na, nb_)
+                    if not (caller.min_total <= xy + yx
+                            and caller.min_xy <= xy and caller.min_yx <= yx):
+                        okmin = False
+                if not okmin:
+                    continue
+            elif ab_only[g]:
+                spec1 = classify(g, seg_map[g, AB_R1], -1)
+                spec2 = classify(g, seg_map[g, AB_R2], -1)
+                rx1 = (seg_map[g, AB_R1], -1)
+                rx2 = (seg_map[g, AB_R2], -1)
+                if spec1 is None or spec2 is None:
+                    continue
+            else:
+                spec1 = classify(g, -1, seg_map[g, BA_R2])
+                spec2 = classify(g, -1, seg_map[g, BA_R1])
+                rx1 = (-1, seg_map[g, BA_R2])
+                rx2 = (-1, seg_map[g, BA_R1])
+                if spec1 is None or spec2 is None:
+                    continue
+            emitted[g] = True
+            out_specs.append((g, _TYPE_FLAGS[R1]) + spec1 + rx1)
+            out_specs.append((g, _TYPE_FLAGS[R2]) + spec2 + rx2)
+
+        # InsufficientReads for live-but-unemitted molecules (the fallthrough
+        # reject in _combine_molecule, duplex.py:361-363)
+        dead = live_mol & ~emitted
+        if dead.any():
+            stats.reject("InsufficientReads", int(n_paired[dead].sum()))
+
+        K = len(out_specs)
+        chunks = []
+        fast_blob = b""
+        rec_end = np.zeros(0, dtype=np.int64)
+        if K:
+            fast_blob, rec_end = self._serialize_outputs(
+                batch, span, gb, out_specs, seg_map, seg_len, tb, tq, d16,
+                e16, codes2d, vrows, vstarts, L_max, col)
+            stats.consensus_reads += K
+
+        # assemble in molecule order, interleaving fallback molecules
+        ord0 = caller._ordinal
+        fb_set = set(np.nonzero(fallback)[0].tolist())
+        if not fb_set:
+            caller._ordinal = ord0 + nG
+            return [fast_blob] if fast_blob else []
+        out_i = 0
+        pending_fast_start = 0
+        for g in sorted(fb_set):
+            # flush the fast run before this molecule
+            while out_i < len(out_specs) and out_specs[out_i][0] < g:
+                out_i += 2
+            run_end = int(rec_end[out_i - 1]) if out_i else 0
+            if run_end > pending_fast_start:
+                chunks.append(fast_blob[pending_fast_start:run_end])
+                pending_fast_start = run_end
+            rows = span[gb[g]:gb[g + 1]]
+            sb_g = sb[gb[g]:gb[g + 1]]
+            a = batch.raw_records(rows[~sb_g])
+            b = batch.raw_records(rows[sb_g])
+            caller._ordinal = ord0 + g
+            chunks.extend(self._call_slow_molecule(
+                self._base_mi(batch, int(rows[0])), a, b, corrected=True))
+        caller._ordinal = ord0 + nG
+        if len(fast_blob) > pending_fast_start:
+            chunks.append(fast_blob[pending_fast_start:])
+        return chunks
+
+    def _serialize_outputs(self, batch, span, gb, out_specs, seg_map, seg_len,
+                           tb, tq, d16, e16, codes2d, vrows, vstarts, L_max,
+                           col):
+        """Combine + native-serialize the K fast output reads (order kept)."""
+        caller = self.caller
+        K = len(out_specs)
+        mols = np.array([s[0] for s in out_specs], dtype=np.int64)
+        flags = np.array([s[1] for s in out_specs], dtype=np.int32)
+        kinds = np.array([s[2] for s in out_specs], dtype=np.int8)
+        aseg = np.array([s[3] for s in out_specs], dtype=np.int64)
+        bseg = np.array([s[4] for s in out_specs], dtype=np.int64)
+        lens = np.array([s[5] for s in out_specs], dtype=np.int32)
+
+        out_b = np.zeros((K, L_max), dtype=np.uint8)
+        out_q = np.zeros((K, L_max), dtype=np.uint8)
+        out_e = np.zeros((K, L_max), dtype=np.int32)
+
+        comb = np.nonzero(kinds == 2)[0]
+        if len(comb):
+            ca, cb = aseg[comb], bseg[comb]
+            a_b = tb[ca].astype(np.int32)
+            b_b = tb[cb].astype(np.int32)
+            a_q = tq[ca].astype(np.int32)
+            b_q = tq[cb].astype(np.int32)
+            agree = a_b == b_b
+            a_wins = (~agree) & (a_q > b_q)
+            b_wins = (~agree) & (b_q > a_q)
+            tie = (~agree) & (a_q == b_q)
+            raw_base = np.where(agree | a_wins, a_b, b_b)
+            raw_qual = np.where(
+                agree, np.clip(a_q + b_q, MIN_PHRED, MAX_PHRED),
+                np.where(a_wins, np.clip(a_q - b_q, MIN_PHRED, MAX_PHRED),
+                         np.where(b_wins, np.clip(b_q - a_q, MIN_PHRED,
+                                                  MAX_PHRED), MIN_PHRED)))
+            either_n = (a_b == N_CODE) | (b_b == N_CODE)
+            mask = either_n | (raw_qual == MIN_PHRED) | tie
+            in_len = col[None, :] < lens[comb, None]
+            out_b[comb] = np.where(in_len & ~mask, raw_base, N_CODE)
+            out_q[comb] = np.where(in_len & ~mask, raw_qual, MIN_PHRED)
+            out_b[comb] = np.where(in_len, out_b[comb], 0)
+            out_q[comb] = np.where(in_len, out_q[comb], 0)
+            # exact per-base errors vs the pre-mask raw duplex base over both
+            # segs' packed source rows (duplex.py:118-126), with positions at
+            # or beyond the combined length excluded per source read
+            rb8 = np.ascontiguousarray(raw_base.astype(np.uint8))
+            errs = np.zeros((len(comb), L_max), dtype=np.int32)
+            for side in (ca, cb):
+                # one native pass per side over each output's seg row range
+                _, e_side = nb.segment_depth_errors_ranges(
+                    codes2d, rb8, vstarts[:-1][side], vstarts[1:][side])
+                errs += e_side
+            errs[rb8 == N_CODE] = 0
+            errs[~in_len] = 0
+            out_e[comb] = np.minimum(errs, I16_MAX)
+
+        passthrough = np.nonzero(kinds != 2)[0]
+        for k in passthrough:
+            s = aseg[k]
+            L = lens[k]
+            out_b[k, :L] = tb[s, :L]
+            out_q[k, :L] = tq[s, :L]
+            out_e[k, :L] = e16[s, :L]
+
+        # serializer strand inputs: 'a' side = dup.ab_consensus (the alive /
+        # AB side, truncated to the combined length), 'b' side =
+        # ba_consensus (combined case only)
+        a_rows = aseg
+        a_len = lens.astype(np.int32)
+        b_present = (kinds == 2).astype(np.uint8)
+        b_rows = np.where(kinds == 2, bseg, 0)
+        b_len = np.where(kinds == 2, lens, 0).astype(np.int32)
+
+        def row_addrs(arr, rows):
+            return arr.ctypes.data + rows * arr.shape[1] * arr.itemsize
+
+        # RX per output read (strand-reoriented consensus, duplex.py:421-434)
+        rx_addr, rx_len, keep_alive = self._output_rx(
+            batch, span, out_specs, seg_map, vrows, vstarts)
+
+        mi_off, mi_len, _ = batch.tag_locs(self.tag)
+        first_rows = span[gb[mols]]
+        mi_addr = batch.buf.ctypes.data + mi_off[first_rows]
+        mi_l = (mi_len[first_rows] - 2).astype(np.int32)  # base MI, no /A|/B
+
+        blob, rec_end = nb.build_duplex_records(
+            row_addrs(out_b, np.arange(K)), row_addrs(out_q, np.arange(K)),
+            row_addrs(out_e, np.arange(K)), lens, flags,
+            caller.prefix.encode(), mi_addr, mi_l,
+            row_addrs(tb, a_rows), row_addrs(tq, a_rows),
+            row_addrs(d16, a_rows), row_addrs(e16, a_rows), a_len,
+            row_addrs(tb, b_rows), row_addrs(tq, b_rows),
+            row_addrs(d16, b_rows), row_addrs(e16, b_rows), b_len, b_present,
+            rx_addr, rx_len, caller.read_group_id.encode(),
+            caller.produce_per_base_tags)
+        del keep_alive
+        return blob, rec_end
+
+    def _output_rx(self, batch, span, out_specs, seg_map, vrows, vstarts):
+        """RX tag per output read: a-side values verbatim, b-side values
+        strand-flipped, then the UMI consensus (unanimous fast path)."""
+        rx_vo, rx_vl, _ = batch.tag_locs(b"RX")
+        buf = batch.buf
+        K = len(out_specs)
+        rx_addr = np.zeros(K, dtype=np.int64)
+        rx_len = np.zeros(K, dtype=np.int32)
+        keep_alive = []
+
+        span_v = span[vrows]
+        una_off, una_len = nb.rx_unanimous(buf, rx_vo[span_v], rx_vl[span_v],
+                                           vstarts)
+        present = (rx_vo[span_v] >= 0).astype(np.int64)
+        cnt = np.add.reduceat(present, vstarts[:-1]) \
+            if len(span_v) else np.zeros(0, dtype=np.int64)
+
+        def seg_values(s):
+            """Ordered present RX strings of seg s."""
+            rows = span_v[vstarts[s]:vstarts[s + 1]]
+            vals = []
+            for i in rows:
+                if rx_vo[i] >= 0:
+                    vals.append(buf[rx_vo[i]:rx_vo[i] + rx_vl[i]]
+                                .tobytes().decode())
+            return vals
+
+        for k, spec in enumerate(out_specs):
+            # AB-seg values verbatim, BA-seg values flipped — BOTH segs of
+            # the branch contribute, independent of consensus aliveness
+            a_s, b_s = spec[6], spec[7]
+            vals = []
+            for s, flip in ((a_s, False), (b_s, True)):
+                if s < 0:
+                    continue
+                if una_off[s] == -2 or (flip and una_off[s] >= 0):
+                    # divergent, or flipped (verbatim pointer unusable)
+                    vs = seg_values(s)
+                elif una_off[s] >= 0:
+                    vs = [buf[una_off[s]:una_off[s] + una_len[s]]
+                          .tobytes().decode()] * int(cnt[s])
+                else:
+                    continue
+                if flip:
+                    vs = [_flip_umi(v) for v in vs]
+                vals.extend(vs)
+            if not vals:
+                continue
+            rx = consensus_umis(vals).encode()
+            arr = np.frombuffer(rx, dtype=np.uint8)
+            keep_alive.append(arr)
+            rx_addr[k] = arr.ctypes.data
+            rx_len[k] = len(rx)
+        return rx_addr, rx_len, keep_alive

@@ -97,9 +97,17 @@ def get_lib():
         lib.fgumi_build_consensus_records.argtypes = (
             [p] * 6 + [ctypes.c_long, p, ctypes.c_int, p, p, p, p, p,
                        ctypes.c_int, ctypes.c_int, p, ctypes.c_long, p])
+        lib.fgumi_build_duplex_records.restype = ctypes.c_long
+        lib.fgumi_build_duplex_records.argtypes = (
+            [p] * 5 + [ctypes.c_long, p, ctypes.c_int, p, p]
+            + [p] * 5 + [p] * 6 + [p, p, p, ctypes.c_int, ctypes.c_int,
+                                   p, ctypes.c_long, p])
         lib.fgumi_segment_depth_errors.restype = None
         lib.fgumi_segment_depth_errors.argtypes = (
             [p, p, p, ctypes.c_long, ctypes.c_long, p, p])
+        lib.fgumi_segment_depth_errors_ranges.restype = None
+        lib.fgumi_segment_depth_errors_ranges.argtypes = (
+            [p, p, p, p, ctypes.c_long, ctypes.c_long, p, p])
         lib.fgumi_ranges_equal.restype = None
         lib.fgumi_ranges_equal.argtypes = [p] * 5 + [ctypes.c_long, p]
         lib.fgumi_hash_ranges.restype = None

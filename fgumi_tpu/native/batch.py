@@ -173,8 +173,67 @@ def build_consensus_records(code_addr, qual_addr, depth_addr, err_addr, lens,
         _addr(rx_addr), _addr(rx_len),
         _addr(rg_arr), len(rg), int(per_base_tags), _addr(out), out_cap,
         _addr(rec_end))
+    if total == -2:
+        raise ValueError("read name too long (prefix + MI exceeds 254 bytes)")
     if total < 0:
         raise RuntimeError("consensus record serialization overflow")
+    return out[:total].tobytes(), rec_end
+
+
+def build_duplex_records(code_addr, qual_addr, err_addr, lens, flags,
+                         prefix: bytes, mi_addr, mi_len,
+                         a_code, a_qual, a_depth, a_err, a_len,
+                         b_code, b_qual, b_depth, b_err, b_len, b_present,
+                         rx_addr, rx_len, rg: bytes, per_base_tags: bool):
+    """Serialize J duplex consensus records into one wire blob.
+
+    All *_addr / strand arrays are raw element addresses (int64) into
+    caller-owned arrays that MUST stay referenced for the call duration;
+    b_present 0 = BA strand absent, rx_addr 0 = no RX tag.
+    """
+    lib = get_lib()
+    J = len(lens)
+    lens = np.ascontiguousarray(lens, np.int32)
+    flags = np.ascontiguousarray(flags, np.int32)
+    mi_len = np.ascontiguousarray(mi_len, np.int32)
+    a_len = np.ascontiguousarray(a_len, np.int32)
+    b_len = np.ascontiguousarray(b_len, np.int32)
+    b_present = np.ascontiguousarray(b_present, np.uint8)
+    rx_len = np.ascontiguousarray(rx_len, np.int32)
+    addrs = [np.ascontiguousarray(a, np.int64)
+             for a in (code_addr, qual_addr, err_addr, mi_addr, a_code, a_qual,
+                       a_depth, a_err, b_code, b_qual, b_depth, b_err,
+                       rx_addr)]
+    (code_addr, qual_addr, err_addr, mi_addr, a_code, a_qual, a_depth, a_err,
+     b_code, b_qual, b_depth, b_err, rx_addr) = addrs
+    L64 = lens.astype(np.int64)
+    aL64 = a_len.astype(np.int64)
+    bL64 = np.where(b_present != 0, b_len, 0).astype(np.int64)
+    per_rec = (4 + 32 + len(prefix) + 1 + mi_len.astype(np.int64) + 1
+               + (L64 + 1) // 2 + L64
+               + (3 + mi_len.astype(np.int64) + 1) + (3 + len(rg) + 1)
+               + 9 * 7
+               + np.where(rx_addr != 0, 3 + rx_len.astype(np.int64) + 1, 0))
+    if per_base_tags:
+        per_rec = per_rec + 2 * (4 + aL64) + 16 + 4 * aL64 \
+            + np.where(b_present != 0, 2 * (4 + bL64) + 16 + 4 * bL64, 0)
+    out_cap = int(per_rec.sum())
+    out = np.empty(out_cap, dtype=np.uint8)
+    rec_end = np.empty(J, dtype=np.int64)
+    prefix_arr = np.frombuffer(prefix, dtype=np.uint8)
+    rg_arr = np.frombuffer(rg, dtype=np.uint8)
+    total = lib.fgumi_build_duplex_records(
+        _addr(code_addr), _addr(qual_addr), _addr(err_addr), _addr(lens),
+        _addr(flags), J, _addr(prefix_arr), len(prefix), _addr(mi_addr),
+        _addr(mi_len), _addr(a_code), _addr(a_qual), _addr(a_depth),
+        _addr(a_err), _addr(a_len), _addr(b_code), _addr(b_qual),
+        _addr(b_depth), _addr(b_err), _addr(b_len), _addr(b_present),
+        _addr(rx_addr), _addr(rx_len), _addr(rg_arr), len(rg),
+        int(per_base_tags), _addr(out), out_cap, _addr(rec_end))
+    if total == -2:
+        raise ValueError("read name too long (prefix + MI exceeds 254 bytes)")
+    if total < 0:
+        raise RuntimeError("duplex record serialization overflow")
     return out[:total].tobytes(), rec_end
 
 
@@ -195,6 +254,23 @@ def segment_depth_errors(codes2d: np.ndarray, winner: np.ndarray,
     lib.fgumi_segment_depth_errors(_addr(codes2d), _addr(winner),
                                    _addr(starts), J, L, _addr(depth),
                                    _addr(errors))
+    return depth, errors
+
+
+def segment_depth_errors_ranges(codes2d: np.ndarray, winner: np.ndarray,
+                                lo, hi):
+    """segment_depth_errors over explicit [lo[j], hi[j]) row ranges."""
+    lib = get_lib()
+    J, L = winner.shape
+    depth = np.empty((J, L), dtype=np.int32)
+    errors = np.empty((J, L), dtype=np.int32)
+    codes2d = np.ascontiguousarray(codes2d, np.uint8)
+    winner = np.ascontiguousarray(winner, np.uint8)
+    lo = np.ascontiguousarray(lo, np.int64)
+    hi = np.ascontiguousarray(hi, np.int64)
+    lib.fgumi_segment_depth_errors_ranges(
+        _addr(codes2d), _addr(winner), _addr(lo), _addr(hi), J, L,
+        _addr(depth), _addr(errors))
     return depth, errors
 
 

@@ -233,6 +233,7 @@ long fgumi_build_consensus_records(
     const int32_t* drow = reinterpret_cast<const int32_t*>(depth_addr[j]);
     const int32_t* erow = reinterpret_cast<const int32_t*>(err_addr[j]);
     const int32_t name_len = prefix_len + 1 + mi_len[j];
+    if (name_len + 1 > 255) return -2;  // l_read_name is a u8 (caller raises)
     long need = 4 + 32 + name_len + 1 + (L + 1) / 2 + L;
     need += 3 + rg_len + 1;        // RG:Z
     need += (7 + 7 + 7);           // cD cM cE
@@ -346,6 +347,206 @@ long fgumi_build_consensus_records(
   return off;
 }
 
+// Serialize J unmapped duplex consensus records. Byte-exact analog of
+// DuplexConsensusCaller._build_record (consensus/duplex.py:367-435; reference
+// duplex_read_into, duplex_caller.rs:1056-1249): header + name + packed seq +
+// quals, then tags MI:Z, RG:Z, aD/aE/aM [+ac/ad/ae/aq], bD/bE/bM
+// [+bc/bd/be/bq], cD/cE/cM, [RX:Z]. All per-record data arrives as raw
+// addresses; b_present[j] == 0 marks a missing BA strand (bD/bE/bM still
+// written as zeros, per-base b tags skipped); rx_addr[j] == 0 marks no RX.
+// a_* arrays have a_len[j] entries (full strand length), code/qual/err have
+// lens[j] (the combined length). Returns total bytes, or -1 on overflow.
+long fgumi_build_duplex_records(
+    const int64_t* code_addr, const int64_t* qual_addr, const int64_t* err_addr,
+    const int32_t* lens, const int32_t* flags, long J, const uint8_t* prefix,
+    int prefix_len, const int64_t* mi_addr, const int32_t* mi_len,
+    const int64_t* a_code, const int64_t* a_qual, const int64_t* a_depth,
+    const int64_t* a_err, const int32_t* a_len,
+    const int64_t* b_code, const int64_t* b_qual, const int64_t* b_depth,
+    const int64_t* b_err, const int32_t* b_len, const uint8_t* b_present,
+    const int64_t* rx_addr, const int32_t* rx_len, const uint8_t* rg,
+    int rg_len, int per_base_tags, uint8_t* out, long out_cap,
+    int64_t* rec_end) {
+  const uint8_t kBase[5] = {'A', 'C', 'G', 'T', 'N'};
+  long off = 0;
+  for (long j = 0; j < J; ++j) {
+    const int32_t L = lens[j];
+    const int32_t aL = a_len[j];
+    const int32_t bL = b_present[j] ? b_len[j] : 0;
+    const uint8_t* crow = reinterpret_cast<const uint8_t*>(code_addr[j]);
+    const uint8_t* qrow = reinterpret_cast<const uint8_t*>(qual_addr[j]);
+    const int32_t* erow = reinterpret_cast<const int32_t*>(err_addr[j]);
+    const uint8_t* mi_p = reinterpret_cast<const uint8_t*>(mi_addr[j]);
+    const int32_t name_len = prefix_len + 1 + mi_len[j];
+    if (name_len + 1 > 255) return -2;  // l_read_name is a u8 (caller raises)
+    long need = 4 + 32 + name_len + 1 + (L + 1) / 2 + L;
+    need += (3 + mi_len[j] + 1) + (3 + rg_len + 1);  // MI RG
+    need += 6 * 7 + 3 * 7;  // aD/aM/bD/bM/cD/cM + aE/bE/cE (7 bytes each)
+    if (per_base_tags) {
+      need += (3 + aL + 1) + 2 * (8 + 2 * static_cast<long>(aL)) + (3 + aL + 1);
+      if (b_present[j]) {
+        need += (3 + bL + 1) + 2 * (8 + 2 * static_cast<long>(bL))
+                + (3 + bL + 1);
+      }
+    }
+    if (rx_addr[j] != 0) need += 3 + rx_len[j] + 1;
+    if (off + need > out_cap) return -1;
+
+    uint8_t* rec = out + off + 4;
+    put_u32(rec + 0, 0xFFFFFFFFu);
+    put_u32(rec + 4, 0xFFFFFFFFu);
+    rec[8] = static_cast<uint8_t>(name_len + 1);
+    rec[9] = 0;
+    put_u16(rec + 10, 4680);
+    put_u16(rec + 12, 0);
+    put_u16(rec + 14, static_cast<uint16_t>(flags[j]));
+    put_u32(rec + 16, static_cast<uint32_t>(L));
+    put_u32(rec + 20, 0xFFFFFFFFu);
+    put_u32(rec + 24, 0xFFFFFFFFu);
+    put_u32(rec + 28, 0);
+    uint8_t* p = rec + 32;
+    std::memcpy(p, prefix, static_cast<size_t>(prefix_len));
+    p += prefix_len;
+    *p++ = ':';
+    std::memcpy(p, mi_p, static_cast<size_t>(mi_len[j]));
+    p += mi_len[j];
+    *p++ = 0;
+    for (int32_t i = 0; i + 1 < L; i += 2) {
+      const uint8_t hi = kCode2Nib[crow[i] < 4 ? crow[i] : 4];
+      const uint8_t lo = kCode2Nib[crow[i + 1] < 4 ? crow[i + 1] : 4];
+      *p++ = static_cast<uint8_t>((hi << 4) | lo);
+    }
+    if (L & 1) {
+      *p++ = static_cast<uint8_t>(kCode2Nib[crow[L - 1] < 4 ? crow[L - 1] : 4]
+                                  << 4);
+    }
+    std::memcpy(p, qrow, static_cast<size_t>(L));
+    p += L;
+    p[0] = 'M'; p[1] = 'I'; p[2] = 'Z';
+    std::memcpy(p + 3, mi_p, static_cast<size_t>(mi_len[j]));
+    p += 3 + mi_len[j];
+    *p++ = 0;
+    p[0] = 'R'; p[1] = 'G'; p[2] = 'Z';
+    std::memcpy(p + 3, rg, static_cast<size_t>(rg_len));
+    p += 3 + rg_len;
+    *p++ = 0;
+
+    // one strand's aggregate + optional per-base tags (strand_metrics +
+    // the ac/ad/ae/aq block, duplex.py:379-407)
+    auto strand_tags = [&](char sc, const uint8_t* scode, const uint8_t* squal,
+                           const int32_t* sdep, const int32_t* serr,
+                           int32_t sl, bool present, bool base_tags) {
+      int32_t mx = 0, mn = 0;
+      float rate = 0.0f;
+      if (sl > 0) {
+        mx = -1;
+        mn = 0x7FFFFFFF;
+        int64_t td = 0, te = 0;
+        for (int32_t i = 0; i < sl; ++i) {
+          const int32_t d16 = sdep[i] < 32767 ? sdep[i] : 32767;
+          const int32_t e16 = serr[i] < 32767 ? serr[i] : 32767;
+          if (d16 > mx) mx = d16;
+          if (d16 < mn) mn = d16;
+          td += d16;
+          te += e16;
+        }
+        rate = td ? static_cast<float>(te) / static_cast<float>(td) : 0.0f;
+      }
+      p[0] = sc; p[1] = 'D'; p[2] = 'i';
+      put_u32(p + 3, static_cast<uint32_t>(sl > 0 ? mx : 0));
+      p += 7;
+      uint32_t bits;
+      std::memcpy(&bits, &rate, 4);
+      p[0] = sc; p[1] = 'E'; p[2] = 'f';
+      put_u32(p + 3, bits);
+      p += 7;
+      p[0] = sc; p[1] = 'M'; p[2] = 'i';
+      put_u32(p + 3, static_cast<uint32_t>(sl > 0 ? mn : 0));
+      p += 7;
+      if (base_tags && present) {
+        p[0] = sc; p[1] = 'c'; p[2] = 'Z';
+        p += 3;
+        for (int32_t i = 0; i < sl; ++i) *p++ = kBase[scode[i] < 4 ? scode[i] : 4];
+        *p++ = 0;
+        p[0] = sc; p[1] = 'd'; p[2] = 'B'; p[3] = 's';
+        put_u32(p + 4, static_cast<uint32_t>(sl));
+        p += 8;
+        for (int32_t i = 0; i < sl; ++i) {
+          put_u16(p, static_cast<uint16_t>(
+                         static_cast<int16_t>(sdep[i] < 32767 ? sdep[i] : 32767)));
+          p += 2;
+        }
+        p[0] = sc; p[1] = 'e'; p[2] = 'B'; p[3] = 's';
+        put_u32(p + 4, static_cast<uint32_t>(sl));
+        p += 8;
+        for (int32_t i = 0; i < sl; ++i) {
+          put_u16(p, static_cast<uint16_t>(
+                         static_cast<int16_t>(serr[i] < 32767 ? serr[i] : 32767)));
+          p += 2;
+        }
+        p[0] = sc; p[1] = 'q'; p[2] = 'Z';
+        p += 3;
+        for (int32_t i = 0; i < sl; ++i) *p++ = static_cast<uint8_t>(squal[i] + 33);
+        *p++ = 0;
+      }
+    };
+    strand_tags('a', reinterpret_cast<const uint8_t*>(a_code[j]),
+                reinterpret_cast<const uint8_t*>(a_qual[j]),
+                reinterpret_cast<const int32_t*>(a_depth[j]),
+                reinterpret_cast<const int32_t*>(a_err[j]), aL, true,
+                per_base_tags != 0);
+    strand_tags('b', reinterpret_cast<const uint8_t*>(b_code[j]),
+                reinterpret_cast<const uint8_t*>(b_qual[j]),
+                reinterpret_cast<const int32_t*>(b_depth[j]),
+                reinterpret_cast<const int32_t*>(b_err[j]), bL,
+                b_present[j] != 0, per_base_tags != 0);
+
+    // combined cD/cE/cM: per-strand per-base i16 clamp before summing
+    // (duplex.py:409-419, duplex_caller.rs:1188-1215)
+    const int32_t* adp = reinterpret_cast<const int32_t*>(a_depth[j]);
+    const int32_t* bdp = reinterpret_cast<const int32_t*>(b_depth[j]);
+    int64_t comb_max = 0, comb_min = 0, total_d = 0, total_e = 0;
+    if (L > 0) {
+      comb_max = -1;
+      comb_min = 0x7FFFFFFFFFFFLL;
+      for (int32_t i = 0; i < L; ++i) {
+        int64_t c = adp[i] < 32767 ? adp[i] : 32767;
+        if (b_present[j]) c += bdp[i] < 32767 ? bdp[i] : 32767;
+        if (c > comb_max) comb_max = c;
+        if (c < comb_min) comb_min = c;
+        total_d += c;
+        total_e += erow[i] < 32767 ? erow[i] : 32767;
+      }
+    }
+    const float crate =
+        total_d ? static_cast<float>(total_e) / static_cast<float>(total_d)
+                : 0.0f;
+    p[0] = 'c'; p[1] = 'D'; p[2] = 'i';
+    put_u32(p + 3, static_cast<uint32_t>(L > 0 ? comb_max : 0));
+    p += 7;
+    uint32_t crate_bits;
+    std::memcpy(&crate_bits, &crate, 4);
+    p[0] = 'c'; p[1] = 'E'; p[2] = 'f';
+    put_u32(p + 3, crate_bits);
+    p += 7;
+    p[0] = 'c'; p[1] = 'M'; p[2] = 'i';
+    put_u32(p + 3, static_cast<uint32_t>(L > 0 ? comb_min : 0));
+    p += 7;
+    if (rx_addr[j] != 0) {
+      p[0] = 'R'; p[1] = 'X'; p[2] = 'Z';
+      std::memcpy(p + 3, reinterpret_cast<const uint8_t*>(rx_addr[j]),
+                  static_cast<size_t>(rx_len[j]));
+      p += 3 + rx_len[j];
+      *p++ = 0;
+    }
+    const long rec_size = p - rec;
+    put_u32(out + off, static_cast<uint32_t>(rec_size));
+    off += 4 + rec_size;
+    rec_end[j] = off;
+  }
+  return off;
+}
+
 // Per-segment depth/error counts for the ragged consensus layout: codes is
 // the dense (N, L) read-row array (N = starts[J]), winner the (J, L) called
 // bases; depth[j,i] = valid (non-N) observations, errors[j,i] = valid
@@ -362,6 +563,33 @@ void fgumi_segment_depth_errors(const uint8_t* codes, const uint8_t* winner,
     std::memset(drow, 0, static_cast<size_t>(L) * 4);
     std::memset(erow, 0, static_cast<size_t>(L) * 4);
     for (int64_t r = starts[j]; r < starts[j + 1]; ++r) {
+      const uint8_t* crow = codes + r * L;
+      for (long i = 0; i < L; ++i) {
+        const uint8_t c = crow[i];
+        if (c != 4) {
+          ++drow[i];
+          erow[i] += (c != wrow[i]);
+        }
+      }
+    }
+  }
+}
+
+// fgumi_segment_depth_errors with explicit, possibly non-contiguous row
+// ranges [lo[j], hi[j]) per segment (the duplex exact-error pass sums a
+// molecule's two strand segs, which are not adjacent in the dense layout).
+void fgumi_segment_depth_errors_ranges(const uint8_t* codes,
+                                       const uint8_t* winner,
+                                       const int64_t* lo, const int64_t* hi,
+                                       long J, long L, int32_t* depth,
+                                       int32_t* errors) {
+  for (long j = 0; j < J; ++j) {
+    int32_t* drow = depth + j * L;
+    int32_t* erow = errors + j * L;
+    const uint8_t* wrow = winner + j * L;
+    std::memset(drow, 0, static_cast<size_t>(L) * 4);
+    std::memset(erow, 0, static_cast<size_t>(L) * 4);
+    for (int64_t r = lo[j]; r < hi[j]; ++r) {
       const uint8_t* crow = codes + r * L;
       for (long i = 0; i < L; ++i) {
         const uint8_t c = crow[i];

@@ -774,11 +774,29 @@ def cmd_sort(args):
 
         progress = ProgressTracker("sort")
         wprogress = ProgressTracker("sort-write")
+        from .sort.keys import make_batch_keys_fn
+
+        batch_keys_fn = make_batch_keys_fn(args.order, reader.header,
+                                           args.subsort)
         with ExternalSorter(key_fn, max_bytes=budget, tmp_dir=args.tmp_dir,
                             max_records=args.max_records_in_ram) as sorter:
-            for rec in reader:
-                sorter.add(rec)
-                progress.add()
+            if batch_keys_fn is not None:
+                # native batch path: decode + key extraction per batch
+                from .io.batch_reader import BamBatchReader
+
+                with BamBatchReader(args.input) as breader:
+                    add_entry = sorter.add_entry
+                    for batch in breader:
+                        keys = batch_keys_fn(batch)
+                        buf = batch.buf
+                        do, de = batch.data_off, batch.data_end
+                        for i in range(batch.n):
+                            add_entry(keys[i], buf[do[i]:de[i]].tobytes())
+                        progress.add(batch.n)
+            else:
+                for rec in reader:
+                    sorter.add(rec)
+                    progress.add()
             progress.finish()
             with BamWriter(args.output, out_header) as writer:
                 if bai is None:

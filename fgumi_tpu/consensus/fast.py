@@ -237,7 +237,7 @@ class FastSimplexCaller:
 
         # batch-wide native prep over the kept records of the processed groups
         span = idx[bounds[g0]:bounds[g1]]
-        mc_off, mc_len, _ = batch.tag_locs(b"MC")
+        mc_off, mc_len, _ = batch.tag_locs_str(b"MC")
         clips = nb.mate_clips(
             batch.buf, np.ascontiguousarray(batch.cigar_off[span]),
             batch.n_cigar[span], batch.flag[span], batch.ref_id[span],
@@ -713,7 +713,7 @@ class FastSimplexCaller:
         mi_parts = []
         keep_alive = []
         m_off = 0
-        rx_vo, rx_vl, _ = batch.tag_locs(b"RX")
+        rx_vo, rx_vl, _ = batch.tag_locs_str(b"RX")
         buf = batch.buf
         surv_counts = np.empty(J, dtype=np.int64)
         for j, job in enumerate(jobs):

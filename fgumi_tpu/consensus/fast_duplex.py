@@ -253,7 +253,7 @@ class FastDuplexCaller:
         coll &= both_strands
 
         # native pack over all rows (clip/trim/RC/mask; fast.py discipline)
-        mc_off, mc_len, _ = batch.tag_locs(b"MC")
+        mc_off, mc_len, _ = batch.tag_locs_str(b"MC")
         clips = nb.mate_clips(
             batch.buf, np.ascontiguousarray(batch.cigar_off[span]),
             batch.n_cigar[span], batch.flag[span], batch.ref_id[span],
@@ -652,7 +652,7 @@ class FastDuplexCaller:
     def _output_rx(self, batch, span, out_specs, seg_map, vrows, vstarts):
         """RX tag per output read: a-side values verbatim, b-side values
         strand-flipped, then the UMI consensus (unanimous fast path)."""
-        rx_vo, rx_vl, _ = batch.tag_locs(b"RX")
+        rx_vo, rx_vl, _ = batch.tag_locs_str(b"RX")
         buf = batch.buf
         K = len(out_specs)
         rx_addr = np.zeros(K, dtype=np.int64)

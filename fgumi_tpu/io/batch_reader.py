@@ -57,6 +57,13 @@ class RecordBatch:
             self._tag_locs[tag] = got
         return got
 
+    def tag_locs_str(self, tag: bytes):
+        """tag_locs with non-string-typed (not Z/H) tags masked to absent,
+        matching RawRecord.get_str's type gate."""
+        vo, vl, vt = self.tag_locs(tag)
+        ok = (vt == ord("Z")) | (vt == ord("H"))
+        return np.where(ok, vo, -1), vl, vt
+
     def tag_bytes(self, tag: bytes, i: int):
         """One record's tag value bytes (Z/H string, no NUL), or None."""
         vo, vl, _ = self.tag_locs(tag)

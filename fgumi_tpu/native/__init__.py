@@ -112,6 +112,12 @@ def get_lib():
         lib.fgumi_ranges_equal.argtypes = [p] * 5 + [ctypes.c_long, p]
         lib.fgumi_hash_ranges.restype = None
         lib.fgumi_hash_ranges.argtypes = [p, p, p, ctypes.c_long, p]
+        lib.fgumi_template_coord_keys.restype = ctypes.c_long
+        lib.fgumi_template_coord_keys.argtypes = (
+            [p] * 15 + [ctypes.c_long, p, p])
+        lib.fgumi_natural_name_keys.restype = ctypes.c_long
+        lib.fgumi_natural_name_keys.argtypes = (
+            [p] * 4 + [ctypes.c_long, p, p, p])
         lib.fgumi_rx_unanimous.restype = None
         lib.fgumi_rx_unanimous.argtypes = [p, p, p, p, ctypes.c_long, p, p]
         lib.fgumi_extract_records.restype = ctypes.c_long

@@ -288,6 +288,52 @@ def ranges_equal(buf: np.ndarray, off_a, len_a, off_b, len_b):
     return out
 
 
+def template_coord_keys(batch, lib_ord: np.ndarray):
+    """Packed template-coordinate sort keys for a whole RecordBatch.
+
+    Returns (out uint8 blob, out_off int64[n+1]) — record i's key is
+    out[out_off[i]:out_off[i+1]].
+    """
+    lib = get_lib()
+    n = batch.n
+    # only Z/H-typed tags count as present (RawRecord.get_str semantics);
+    # e.g. an MI:i: tag must fall back to (0, 0) like the per-record path
+    mc_off, mc_len, _ = batch.tag_locs_str(b"MC")
+    mi_off, mi_len, _ = batch.tag_locs_str(b"MI")
+    key_len = (30 + batch.l_read_name).astype(np.int64)  # 29 + name + NUL + up
+    out_off = np.concatenate(([0], np.cumsum(key_len)))
+    out = np.empty(int(out_off[-1]), dtype=np.uint8)
+    args = [np.ascontiguousarray(a) for a in (
+        batch.data_off, batch.l_read_name, batch.cigar_off, batch.n_cigar,
+        batch.flag, batch.ref_id, batch.pos, batch.next_ref_id,
+        batch.next_pos, mc_off, mc_len, mi_off, mi_len)]
+    lib_ord = np.ascontiguousarray(lib_ord, np.int32)
+    lib.fgumi_template_coord_keys(
+        _addr(batch.buf), *(map(_addr, args)), _addr(lib_ord), n, _addr(out),
+        _addr(out_off))
+    return out, out_off
+
+
+def natural_name_keys(batch):
+    """Packed natural-queryname sort keys for a whole RecordBatch.
+
+    Returns (out uint8 blob, out_off int64[n], out_len int32[n]).
+    """
+    lib = get_lib()
+    n = batch.n
+    # worst case 3 bytes per name char (alternating single-char digit/text
+    # runs) + NUL + 4-byte rank
+    cap = (3 * batch.l_read_name + 2).astype(np.int64)
+    out_off = np.concatenate(([0], np.cumsum(cap)))[:-1]
+    out = np.empty(int(cap.sum()), dtype=np.uint8)
+    out_len = np.empty(n, dtype=np.int32)
+    args = [np.ascontiguousarray(a) for a in (
+        batch.data_off, batch.l_read_name, batch.flag)]
+    lib.fgumi_natural_name_keys(_addr(batch.buf), *(map(_addr, args)), n,
+                                _addr(out), _addr(out_off), _addr(out_len))
+    return out, out_off, out_len
+
+
 def hash_ranges(buf: np.ndarray, off, length):
     """FNV-1a 64-bit hash per byte range (off < 0 -> 0)."""
     lib = get_lib()

@@ -602,6 +602,216 @@ void fgumi_segment_depth_errors_ranges(const uint8_t* codes,
   }
 }
 
+namespace {
+
+inline void put_u32_be(uint8_t* p, uint32_t v) {
+  p[0] = v >> 24;
+  p[1] = (v >> 16) & 0xFF;
+  p[2] = (v >> 8) & 0xFF;
+  p[3] = v & 0xFF;
+}
+
+inline void put_u64_be(uint8_t* p, uint64_t v) {
+  for (int i = 0; i < 8; ++i) p[i] = (v >> (56 - 8 * i)) & 0xFF;
+}
+
+// defined below (overlap section)
+bool parse_mc_cigar(const uint8_t* s, int64_t len, int64_t* leading_soft,
+                    int64_t* ref_len, int64_t* trailing_soft);
+
+}  // namespace
+
+// Batch template-coordinate sort keys (sort/keys.py::
+// template_coordinate_key_bytes; reference fgumi-sort/src/inline.rs
+// TemplateKey). Writes each record's packed key at out + out_off[i]
+// (28 + name_len bytes: 16B ends, 2B strand, 2B library, 8B MI value,
+// 1B MI sub, name, NUL, is_upper). Returns 0.
+long fgumi_template_coord_keys(
+    const uint8_t* buf, const int64_t* data_off, const int32_t* l_read_name,
+    const int64_t* cigar_off, const int32_t* n_cigar, const int32_t* flag,
+    const int32_t* ref_id, const int32_t* pos, const int32_t* next_ref_id,
+    const int32_t* next_pos, const int64_t* mc_off, const int32_t* mc_len,
+    const int64_t* mi_off, const int32_t* mi_len, const int32_t* lib_ord,
+    long n, uint8_t* out, const int64_t* out_off) {
+  const int64_t kTidUnmapped = 1LL << 31;
+  const int64_t kPosSentinel = 0x7FFFFFFFLL;
+  const uint32_t kPosBias = 0x40000000u;
+  for (long i = 0; i < n; ++i) {
+    const int32_t f = flag[i];
+    // own end (keys.py::_own_end): unclipped 5' position, 1-based
+    int64_t own_tid, own_pos;
+    bool own_rev = false;
+    if (f & 0x4) {
+      own_tid = kTidUnmapped;
+      own_pos = kPosSentinel;
+    } else {
+      own_tid = ref_id[i];
+      own_rev = (f & 0x10) != 0;
+      const uint8_t* cp = buf + cigar_off[i];
+      const int32_t nc = n_cigar[i];
+      int64_t lead = 0, trail = 0, rlen = 0;
+      for (int32_t k = 0; k < nc; ++k) {
+        uint32_t v;
+        std::memcpy(&v, cp + 4 * k, 4);
+        const uint32_t op = v & 0xF;
+        const int64_t ln = v >> 4;
+        if (op == 0 || op == 2 || op == 3 || op == 7 || op == 8) rlen += ln;
+      }
+      for (int32_t k = 0; k < nc; ++k) {
+        uint32_t v;
+        std::memcpy(&v, cp + 4 * k, 4);
+        const uint32_t op = v & 0xF;
+        if (op == 4 || op == 5) lead += v >> 4; else break;
+      }
+      for (int32_t k = nc - 1; k >= 0; --k) {
+        uint32_t v;
+        std::memcpy(&v, cp + 4 * k, 4);
+        const uint32_t op = v & 0xF;
+        if (op == 4 || op == 5) trail += v >> 4; else break;
+      }
+      const int64_t un_start = pos[i] - lead;
+      const int64_t un_end = pos[i] + rlen - 1 + trail;
+      own_pos = (own_rev ? un_end : un_start) + 1;
+    }
+    // mate end (keys.py::_mate_end) via the MC tag
+    int64_t mate_tid, mate_pos;
+    bool mate_rev = false;
+    if (!(f & 0x1) || (f & 0x8) || next_ref_id[i] < 0) {
+      mate_tid = kTidUnmapped;
+      mate_pos = kPosSentinel;
+    } else {
+      mate_tid = next_ref_id[i];
+      mate_rev = (f & 0x20) != 0;
+      int64_t lead = 0, rlen = 0, trail = 0;
+      if (mc_off[i] >= 0) {
+        int64_t l2, r2, t2;
+        if (parse_mc_cigar(buf + mc_off[i], mc_len[i], &l2, &r2, &t2)) {
+          lead = l2;
+          rlen = r2;
+          trail = t2;
+        }
+      }
+      const int64_t mp1 = next_pos[i] + 1;
+      mate_pos = mate_rev ? (mp1 - 1 + (rlen > 1 ? rlen : 1) - 1 + trail + 1)
+                          : (mp1 - lead);
+    }
+    // tuple compare (tid, pos, rev): lower end first
+    bool own_low =
+        (own_tid != mate_tid) ? (own_tid < mate_tid)
+        : (own_pos != mate_pos) ? (own_pos < mate_pos)
+                                : (own_rev <= mate_rev);
+    int64_t tid1, tid2, pos1, pos2;
+    bool neg1, neg2;
+    uint8_t is_upper;
+    if (own_low) {
+      tid1 = own_tid; pos1 = own_pos; neg1 = own_rev;
+      tid2 = mate_tid; pos2 = mate_pos; neg2 = mate_rev;
+      is_upper = 0;
+    } else {
+      tid1 = mate_tid; pos1 = mate_pos; neg1 = mate_rev;
+      tid2 = own_tid; pos2 = own_pos; neg2 = own_rev;
+      is_upper = 1;
+    }
+    // MI value (external.py::_mi_key): int() of the prefix before '/'
+    // (optional surrounding ASCII whitespace and sign; negatives clamp to
+    // 0), suffix 'A' -> 0, anything else (incl. no suffix) -> 1; absent or
+    // non-string tag -> (0, 0)
+    uint64_t mi_val = 0;
+    uint8_t mi_sub = 0;
+    if (mi_off[i] >= 0) {
+      const uint8_t* mp = buf + mi_off[i];
+      const int32_t ml = mi_len[i];
+      int32_t slash = 0;
+      while (slash < ml && mp[slash] != '/') ++slash;
+      int32_t b0 = 0, b1 = slash;  // int() strips whitespace both ends
+      while (b0 < b1 && (mp[b0] == ' ' || (mp[b0] >= '\t' && mp[b0] <= '\r')))
+        ++b0;
+      while (b1 > b0 && (mp[b1 - 1] == ' '
+                         || (mp[b1 - 1] >= '\t' && mp[b1 - 1] <= '\r')))
+        --b1;
+      bool negative = false;
+      if (b0 < b1 && (mp[b0] == '+' || mp[b0] == '-')) {
+        negative = mp[b0] == '-';
+        ++b0;
+      }
+      bool digits_ok = b0 < b1;
+      uint64_t v = 0;
+      const uint64_t kU64Max = ~0ULL;
+      for (int32_t k = b0; k < b1; ++k) {
+        if (mp[k] < '0' || mp[k] > '9') {
+          digits_ok = false;
+          break;
+        }
+        if (v > (kU64Max - (mp[k] - '0')) / 10) {
+          v = kU64Max;  // saturate like the Python min(value, u64::MAX)
+        } else {
+          v = v * 10 + (mp[k] - '0');
+        }
+      }
+      mi_val = (digits_ok && !negative) ? v : 0;  // max(0, ...) clamps sign
+      mi_sub = (slash + 2 == ml && mp[slash + 1] == 'A') ? 0 : 1;
+    }
+    uint8_t* p = out + out_off[i];
+    put_u32_be(p + 0, static_cast<uint32_t>(tid1));
+    put_u32_be(p + 4, static_cast<uint32_t>(tid2));
+    put_u32_be(p + 8, static_cast<uint32_t>(pos1) + kPosBias);
+    put_u32_be(p + 12, static_cast<uint32_t>(pos2) + kPosBias);
+    p[16] = neg1 ? 0 : 1;
+    p[17] = neg2 ? 0 : 1;
+    p[18] = (lib_ord[i] >> 8) & 0xFF;
+    p[19] = lib_ord[i] & 0xFF;
+    put_u64_be(p + 20, mi_val);
+    p[28] = mi_sub;
+    const int32_t nl = l_read_name[i] - 1;
+    std::memcpy(p + 29, buf + data_off[i] + 32, static_cast<size_t>(nl));
+    p[29 + nl] = 0;
+    p[30 + nl] = is_upper;
+  }
+  return 0;
+}
+
+// Batch natural-queryname sort keys (sort/keys.py::queryname_key_bytes):
+// digit runs as 0x01 + count + stripped digits, text runs as 0x02 + text +
+// 0x00, then NUL + 4-byte rank (secondary flag, R1/R2, flag BE). Writes at
+// out + out_off[i]; out_len[i] receives the actual key length (the caller
+// sizes out_off for the worst case 2 + 2*name_len + 5).
+long fgumi_natural_name_keys(const uint8_t* buf, const int64_t* data_off,
+                             const int32_t* l_read_name, const int32_t* flag,
+                             long n, uint8_t* out, const int64_t* out_off,
+                             int32_t* out_len) {
+  for (long i = 0; i < n; ++i) {
+    const uint8_t* name = buf + data_off[i] + 32;
+    const int32_t nl = l_read_name[i] - 1;
+    uint8_t* p = out + out_off[i];
+    uint8_t* q = p;
+    int32_t k = 0;
+    while (k < nl) {
+      if (name[k] >= '0' && name[k] <= '9') {
+        int32_t s = k;
+        while (k < nl && name[k] >= '0' && name[k] <= '9') ++k;
+        while (s < k && name[s] == '0') ++s;  // lstrip('0'): "000" -> ""
+        const int32_t sig = k - s;
+        *q++ = 0x01;
+        *q++ = static_cast<uint8_t>(sig);
+        std::memcpy(q, name + s, static_cast<size_t>(sig));
+        q += sig;
+      } else {
+        *q++ = 0x02;
+        while (k < nl && (name[k] < '0' || name[k] > '9')) *q++ = name[k++];
+        *q++ = 0x00;
+      }
+    }
+    *q++ = 0x00;
+    const int32_t f = flag[i];
+    *q++ = (f & 0x900) ? 1 : 0;
+    *q++ = !(f & 0x1) ? 0 : ((f & 0x40) ? 1 : 2);
+    *q++ = (f >> 8) & 0xFF;
+    *q++ = f & 0xFF;
+    out_len[i] = static_cast<int32_t>(q - p);
+  }
+  return 0;
+}
+
 // Batch byte-range equality within one buffer: out[i] = 1 iff both ranges
 // are present (offset >= 0), equal length, and byte-identical. Used for
 // read-name pair checks without per-record Python slicing.

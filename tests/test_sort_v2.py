@@ -255,3 +255,63 @@ def test_csi_depth_sizing():
     assert depth_for_length(1 << 29) == 5
     assert depth_for_length((1 << 29) + 1) == 6
     assert depth_for_length(3_100_000_000) == 6  # hg38-scale
+
+
+def test_batch_keys_adversarial(tmp_path):
+    """Native key parity on hostile inputs: alternating digit/text names
+    (worst-case key expansion), signed/whitespace/huge/non-numeric MI
+    values, non-Z MI tags, non-UTF8 RG values."""
+    import numpy as np
+
+    from fgumi_tpu.io.bam import BamHeader, BamReader, BamWriter, RecordBuilder
+    from fgumi_tpu.io.batch_reader import BamBatchReader
+    from fgumi_tpu.sort.keys import make_batch_keys_fn, make_key_bytes_fn
+
+    header = BamHeader(
+        text="@HD\tVN:1.6\n@SQ\tSN:c\tLN:99999\n"
+             "@RG\tID:A\tLB:libA\n@RG\tID:B\tLB:libB\n",
+        ref_names=["c"], ref_lengths=[99999])
+    path = str(tmp_path / "adv.bam")
+    names = [b"A1B2C", b"1:2:3", b"007x08", b"0", b"zz", b"A" * 120,
+             b"9" * 60, b"x1y" * 40]
+    mis = [(b"MI", "str", b"42/A"), (b"MI", "str", b"42/B"),
+           (b"MI", "str", b"+7"), (b"MI", "str", b" 9 /A"),
+           (b"MI", "str", b"-3"), (b"MI", "str", b"0042"),
+           (b"MI", "str", b"9" * 25), (b"MI", "int", 7),
+           (b"MI", "str", b"x7/A"), (None, None, None)]
+    rgs = [b"A", b"B", b"\xffgrp", None]
+    rng = np.random.default_rng(8)
+    with BamWriter(path, header) as w:
+        i = 0
+        for name in names:
+            for mi in mis:
+                b = RecordBuilder().start_mapped(
+                    name + b".%d" % i, 0x1 | 0x40 | (0x10 if i % 3 else 0),
+                    0, 100 + i, 60, [("S", 2), ("M", 28)],
+                    bytes(rng.choice(np.frombuffer(b"ACGT", np.uint8),
+                                     size=30)),
+                    np.full(30, 30, np.uint8), next_ref_id=0,
+                    next_pos=200 + i, tlen=130)
+                if mi[0] is not None:
+                    if mi[1] == "str":
+                        b.tag_str(b"MI", mi[2])
+                    else:
+                        b.tag_int(b"MI", mi[2])
+                rg = rgs[i % len(rgs)]
+                if rg is not None:
+                    b.tag_str(b"RG", rg)
+                if i % 2:
+                    b.tag_str(b"MC", b"5S20M3S")
+                w.write_record_bytes(b.finish())
+                i += 1
+    for order, subsort in (("queryname", "natural"),
+                           ("template-coordinate", "natural")):
+        with BamReader(path) as r:
+            key_fn = make_key_bytes_fn(order, r.header, subsort)
+            expected = [key_fn(rec) for rec in r]
+        with BamBatchReader(path) as br:
+            fn = make_batch_keys_fn(order, br.header, subsort)
+            got = []
+            for batch in br:
+                got.extend(fn(batch))
+        assert got == expected, (order, subsort)

@@ -644,6 +644,8 @@ def _add_group(sub):
     p.add_argument("--allow-unmapped", action="store_true")
     p.add_argument("--family-size-out", default=None,
                    help="optional TSV of family size counts")
+    p.add_argument("--classic", action="store_true",
+                   help="force the per-template engine (no batch vectorization)")
     p.set_defaults(func=cmd_group)
 
 
@@ -653,8 +655,17 @@ def cmd_group(args):
 
     from .core.template import is_query_grouped, is_template_coordinate_sorted
 
+    from .native import batch as nbat
+
+    use_fast = nbat.available() and not getattr(args, "classic", False)
     t0 = time.monotonic()
-    with BamReader(args.input) as reader:
+    if use_fast:
+        from .io.batch_reader import BamBatchReader
+
+        reader = BamBatchReader(args.input)
+    else:
+        reader = BamReader(args.input)
+    with reader:
         hdr_text = reader.header.text
         # classify_input_ordering (group.rs:470-500): template-coordinate, or
         # query-grouped under --allow-unmapped; anything else is unusable.
@@ -671,12 +682,39 @@ def cmd_group(args):
                                ref_lengths=reader.header.ref_lengths)
         with BamWriter(args.output, out_header) as writer:
             try:
-                result = run_group(
-                    reader, writer, strategy=args.strategy, edits=args.edits,
-                    umi_tag=args.raw_tag.encode(), assigned_tag=args.assign_tag.encode(),
-                    min_mapq=args.min_map_q, include_non_pf=args.include_non_pf_reads,
-                    min_umi_length=args.min_umi_length, no_umi=args.no_umi,
-                    allow_unmapped=args.allow_unmapped)
+                if use_fast:
+                    from .commands.fast_group import FastGrouper
+                    from .umi.assigners import make_assigner
+
+                    if args.no_umi and args.strategy == "paired":
+                        raise ValueError(
+                            "--no-umi cannot be combined with the paired "
+                            "strategy")
+                    grouper = FastGrouper(
+                        reader.header, make_assigner(args.strategy, args.edits),
+                        umi_tag=args.raw_tag.encode(),
+                        assigned_tag=args.assign_tag.encode(),
+                        min_mapq=args.min_map_q,
+                        include_non_pf=args.include_non_pf_reads,
+                        min_umi_length=args.min_umi_length,
+                        no_umi=args.no_umi,
+                        allow_unmapped=args.allow_unmapped)
+                    for batch in reader:
+                        for chunk in grouper.process_batch(batch):
+                            writer.write_serialized(chunk)
+                    for chunk in grouper.flush():
+                        writer.write_serialized(chunk)
+                    result = grouper.result()
+                else:
+                    result = run_group(
+                        reader, writer, strategy=args.strategy,
+                        edits=args.edits, umi_tag=args.raw_tag.encode(),
+                        assigned_tag=args.assign_tag.encode(),
+                        min_mapq=args.min_map_q,
+                        include_non_pf=args.include_non_pf_reads,
+                        min_umi_length=args.min_umi_length,
+                        no_umi=args.no_umi,
+                        allow_unmapped=args.allow_unmapped)
             except ValueError as e:
                 log.error("%s", e)
                 return 2

@@ -1,0 +1,580 @@
+"""Vectorized GroupReadsByUmi host path over RecordBatch inputs.
+
+The group-command analog of consensus/fast.py: template formation, position
+keys, filtering, and MI-tag record rewriting happen in whole-batch array
+passes (native ops from fgumi_tpu.native.batch); only the per-position-group
+UMI assignment (strings + the strategy assigner) remains Python, matching
+the reference's split where assigners are the algorithmic core
+(/root/reference/src/lib/commands/group.rs:505-560) and everything around
+them is raw-byte plumbing.
+
+Semantics contract: byte-identical output records, identical filter metrics
+and family-size histograms to commands/group.py::run_group on the same
+stream (tested in tests/test_fast_group.py). The position group spanning a
+batch boundary is carried as Python Templates and runs the per-template
+reference path, sharing the assigner (and so the global molecule counter).
+"""
+
+import numpy as np
+
+from ..core.template import (UNKNOWN_POS, UNKNOWN_REF, UNKNOWN_STRAND,
+                             classify, library_lookup_from_header,
+                             read_info_key)
+from ..io.bam import (FLAG_FIRST, FLAG_LAST, FLAG_MATE_UNMAPPED, FLAG_PAIRED,
+                      FLAG_QC_FAIL, FLAG_REVERSE, FLAG_SECONDARY,
+                      FLAG_SUPPLEMENTARY, FLAG_UNMAPPED)
+from ..native import batch as nb
+from .group import (FilterMetrics, append_mi_tag, assign_group,
+                    filter_template)
+
+_ACCEPT, _POOR, _NONPF, _NS, _SHORT = 0, 1, 2, 3, 4
+
+
+class FastGrouper:
+    """Batch GroupReadsByUmi engine. Feed RecordBatches; collect wire chunks."""
+
+    def __init__(self, header, assigner, *, umi_tag=b"RX", assigned_tag=b"MI",
+                 min_mapq=1, include_non_pf=False, min_umi_length=None,
+                 no_umi=False, allow_unmapped=False):
+        self.assigner = assigner
+        self.umi_tag = umi_tag
+        self.assigned_tag = assigned_tag
+        self.min_mapq = min_mapq
+        self.include_non_pf = include_non_pf
+        self.min_umi_length = min_umi_length
+        self.no_umi = no_umi
+        self.allow_unmapped = allow_unmapped
+        self.library_of = library_lookup_from_header(header.text)
+        libs = sorted(set(self.library_of.values()) | {"unknown"})
+        self._lib_ord = {lib: i for i, lib in enumerate(libs)}
+        self._rg_to_ord = {rg: self._lib_ord[lib]
+                           for rg, lib in self.library_of.items()}
+        self.metrics = FilterMetrics()
+        self.family_sizes = {}
+        self.position_group_sizes = {}
+        self.records_out = 0
+        self._carry = []        # python Templates of the open position group
+        self._carry_key = None  # their read_info_key
+        self._tail = None       # the held-back, possibly-split last template
+
+    # ------------------------------------------------------------------ slow
+
+    def _template_key(self, t):
+        r = t.primary_r1 or t.r2
+        rg = r.get_str(b"RG") if r is not None else None
+        return read_info_key(t, self.library_of.get(rg, "unknown"))
+
+    def _emit_slow_group(self, templates):
+        """One position group through the reference per-template path."""
+        m = self.metrics
+        kept = [t for t in templates
+                if filter_template(t, umi_tag=self.umi_tag,
+                                   min_mapq=self.min_mapq,
+                                   include_non_pf=self.include_non_pf,
+                                   min_umi_length=self.min_umi_length,
+                                   no_umi=self.no_umi,
+                                   allow_unmapped=self.allow_unmapped,
+                                   metrics=m)]
+        if not kept:
+            return []
+        m.accepted += sum(len(t.primary_records()) for t in kept)
+        assign_group(kept, self.assigner, self.umi_tag, self.min_umi_length,
+                     self.no_umi)
+        self._tally(kept)
+        out = bytearray()
+        for t in kept:
+            mi = t.mi.render()
+            for rec in t.primary_records():
+                data = append_mi_tag(rec, mi, self.assigned_tag)
+                out += len(data).to_bytes(4, "little") + data
+                self.records_out += 1
+        return [bytes(out)] if out else []
+
+    def _tally(self, kept):
+        sizes = {}
+        for t in kept:
+            key = t.mi.render()
+            sizes[key] = sizes.get(key, 0) + 1
+        for size in sizes.values():
+            self.family_sizes[size] = self.family_sizes.get(size, 0) + 1
+        pg = sum(sizes.values())
+        self.position_group_sizes[pg] = \
+            self.position_group_sizes.get(pg, 0) + 1
+
+    def _flush_carry(self):
+        if not self._carry:
+            return []
+        templates, self._carry, self._carry_key = self._carry, [], None
+        return self._emit_slow_group(templates)
+
+    def _resolve_tail(self):
+        """The held-back template is now known complete: join the open group
+        or close it and start a new one."""
+        if self._tail is None:
+            return []
+        tail, self._tail = self._tail, None
+        tk = self._template_key(tail)
+        if self._carry and tk == self._carry_key:
+            self._carry.append(tail)
+            return []
+        out = self._flush_carry()
+        self._carry = [tail]
+        self._carry_key = tk
+        return out
+
+    def flush(self):
+        """End of stream: resolve the held template and close the open group."""
+        out = self._resolve_tail()
+        out.extend(self._flush_carry())
+        return out
+
+    # ----------------------------------------------------------------- driver
+
+    def process_batch(self, batch):
+        """The last template of a batch may be SPLIT across the batch
+        boundary, making its position key unreliable; it is held back
+        (`_tail`) until the next batch proves it complete, and the last
+        complete position group stays open (`_carry`) since the tail may
+        belong to it. Both run the reference per-template path; call
+        flush() after the last batch."""
+        n = batch.n
+        if n == 0:
+            return []
+        buf = batch.buf
+        name_off = batch.data_off + 32
+        name_len = (batch.l_read_name - 1).astype(np.int32)
+        tstarts = nb.group_starts(buf, np.ascontiguousarray(name_off),
+                                  name_len)
+        tbounds = np.append(tstarts, n)
+        nT = len(tbounds) - 1
+
+        # merge a template split across the batch boundary into the tail
+        t0 = 0
+        if self._tail is not None and buf[
+                name_off[0]:name_off[0] + name_len[0]] \
+                .tobytes() == self._tail.name:
+            merged = classify(self._tail.all_records()
+                              + [batch.raw_record(int(i))
+                                 for i in range(tbounds[0], tbounds[1])])
+            self._tail = merged
+            t0 = 1
+        if t0 >= nT:
+            return []  # the whole batch merged into the (still open) tail
+
+        # the tail is complete now (a later template exists in this batch)
+        out = self._resolve_tail()
+
+        keys = self._template_keys(batch, tbounds, nT)
+        nC = nT - 1  # complete templates; the last may continue
+
+        # absorb batch-leading templates continuing the open group
+        if self._carry and t0 < nC \
+                and self._python_key(batch, tbounds, keys, t0) \
+                == self._carry_key:
+            run_end = t0 + 1
+            while run_end < nC and self._key_eq(keys, run_end - 1, run_end):
+                run_end += 1
+            for t in range(t0, run_end):
+                self._carry.append(self._materialize(batch, tbounds, t))
+            t0 = run_end
+        if self._carry and t0 < nC:
+            out.extend(self._flush_carry())  # a differing template follows
+
+        if t0 < nC:
+            # position-group boundaries among complete templates [t0, nC)
+            diff = (keys[t0 + 1:nC] != keys[t0:nC - 1]).any(axis=1)
+            gb = [t0] + (np.nonzero(diff)[0] + t0 + 1).tolist() + [nC]
+            # the last complete group becomes the new open group
+            if len(gb) > 2:
+                out.extend(self._process_groups(batch, tbounds, keys,
+                                                gb[:-1]))
+            last_start = gb[-2]
+            assert not self._carry
+            for t in range(last_start, nC):
+                self._carry.append(self._materialize(batch, tbounds, t))
+            self._carry_key = self._python_key(batch, tbounds, keys,
+                                               last_start)
+
+        self._tail = self._materialize(batch, tbounds, nT - 1)
+        return out
+
+    def _materialize(self, batch, tbounds, t):
+        return classify(batch.raw_records(
+            np.arange(tbounds[t], tbounds[t + 1])))
+
+    # ------------------------------------------------------------------- keys
+
+    def _template_keys(self, batch, tbounds, nT):
+        """Per-template position-key fields, (nT, 7) int64:
+        lib_ord, a_tid, a_pos, a_strand, b_tid, b_pos, b_strand."""
+        n = batch.n
+        flag = batch.flag
+        secsup = (flag & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY)) != 0
+        paired = (flag & FLAG_PAIRED) != 0
+        first = (flag & FLAG_FIRST) != 0
+        last = (flag & FLAG_LAST) != 0
+        role_r1 = ~secsup & paired & first            # classify() elif order
+        role_r2 = ~secsup & paired & ~first & last
+        role_fr = ~secsup & ~paired
+        t_of = np.repeat(np.arange(nT), np.diff(tbounds))
+
+        # last-wins role selection (classify overwrites on duplicates)
+        def pick(mask):
+            sel = np.full(nT, -1, dtype=np.int64)
+            rows = np.nonzero(mask)[0]
+            sel[t_of[rows]] = rows  # ascending rows: later assignment wins
+            return sel
+
+        self._r1_of = pick(role_r1)
+        self._r2_of = pick(role_r2)
+        self._fr_of = pick(role_fr)
+        self._t_of = t_of
+
+        u5 = self._u5_cache(batch)
+        unmapped = (flag & FLAG_UNMAPPED) != 0
+        rev = ((flag & FLAG_REVERSE) != 0).astype(np.int64)
+
+        def end_of(sel):
+            """(tid, pos, strand) per template for one role; sentinel when
+            the role is absent or the read unmapped."""
+            has = sel >= 0
+            idx = np.where(has, sel, 0)
+            ok = has & ~unmapped[idx]
+            tid = np.where(ok, batch.ref_id[idx], UNKNOWN_REF)
+            pos = np.where(ok, u5[idx], UNKNOWN_POS)
+            strand = np.where(ok, rev[idx], UNKNOWN_STRAND)
+            return np.stack([tid, pos, strand], axis=1).astype(np.int64), has
+
+        e1, has1 = end_of(self._r1_of)
+        e2, has2 = end_of(self._r2_of)
+        ef, _ = end_of(self._fr_of)
+        # read_info_key: r1/r2 when either exists, else the fragment
+        use_frag = ~has1 & ~has2
+        e1 = np.where(use_frag[:, None], ef, e1)
+        unknown = np.array([UNKNOWN_REF, UNKNOWN_POS, UNKNOWN_STRAND],
+                           dtype=np.int64)
+        e2 = np.where(use_frag[:, None], unknown[None, :], e2)
+        # order ends: lower tuple first (sentinels already sort last)
+        swap = ((e1[:, 0] > e2[:, 0])
+                | ((e1[:, 0] == e2[:, 0]) & (e1[:, 1] > e2[:, 1]))
+                | ((e1[:, 0] == e2[:, 0]) & (e1[:, 1] == e2[:, 1])
+                   & (e1[:, 2] > e2[:, 2])))
+        a = np.where(swap[:, None], e2, e1)
+        b = np.where(swap[:, None], e1, e2)
+
+        # library ordinal from the primary r1 (or fragment, or r2)'s RG
+        key_read = np.where(self._r1_of >= 0, self._r1_of,
+                            np.where(self._fr_of >= 0, self._fr_of,
+                                     self._r2_of))
+        lib = np.full(nT, self._lib_ord["unknown"], dtype=np.int64)
+        rg_off, rg_len, _ = batch.tag_locs_str(b"RG")
+        kr = np.where(key_read >= 0, key_read, 0)
+        ro = np.where(key_read >= 0, rg_off[kr], -1)
+        rl = rg_len[kr]
+        present = ro >= 0
+        if present.any():
+            hashes = nb.hash_ranges(batch.buf, ro, rl)
+            uniq, first_idx, inv = np.unique(hashes, return_index=True,
+                                             return_inverse=True)
+            reps = first_idx[inv]
+            eq = nb.ranges_equal(batch.buf, ro, rl, ro[reps], rl[reps])
+            if eq[present].all():
+                ords = np.empty(len(uniq), dtype=np.int64)
+                for u, fi in enumerate(first_idx):
+                    if ro[fi] < 0:
+                        ords[u] = self._lib_ord["unknown"]
+                        continue
+                    rg = batch.buf[ro[fi]:ro[fi] + rl[fi]].tobytes() \
+                        .decode(errors="replace")
+                    ords[u] = self._rg_to_ord.get(rg,
+                                                  self._lib_ord["unknown"])
+                lib = ords[inv].copy()
+                lib[~present] = self._lib_ord["unknown"]
+            else:
+                for t in np.nonzero(present)[0]:
+                    rg = batch.buf[ro[t]:ro[t] + rl[t]].tobytes() \
+                        .decode(errors="replace")
+                    lib[t] = self._rg_to_ord.get(rg,
+                                                 self._lib_ord["unknown"])
+        return np.concatenate([lib[:, None], a, b], axis=1)
+
+    @staticmethod
+    def _key_eq(keys, t1, t2):
+        return bool((keys[t1] == keys[t2]).all())
+
+    def _python_key(self, batch, tbounds, keys, t):
+        """The canonical python read_info_key of template t (for cross-batch
+        carry comparisons; within-batch equality uses the int key rows)."""
+        return self._template_key(self._materialize(batch, tbounds, t))
+
+    # ----------------------------------------------------------------- filter
+
+    def _filter_codes(self, batch, tbounds, nT, t_lo, t_hi):
+        """Per-template accept/reject category, replicating the reference's
+        first-failing-check attribution (filter_template evaluation order)."""
+        flag = batch.flag
+        m = self.min_mapq
+
+        def arr(sel, field, default):
+            idx = np.where(sel >= 0, sel, 0)
+            return np.where(sel >= 0, field[idx], default)
+
+        roles = [self._r1_of, self._r2_of, self._fr_of]
+        # reads order in filter_template: r1, r2, fragment
+        unmapped = (flag & FLAG_UNMAPPED) != 0
+        qcfail = (flag & FLAG_QC_FAIL) != 0
+        paired = (flag & FLAG_PAIRED) != 0
+        mate_unmapped = (flag & FLAG_MATE_UNMAPPED) != 0
+
+        mq_val = self._mq_values(batch)
+        uo, ul, _ = batch.tag_locs_str(self.umi_tag)
+        has_n, bases, ascii_ok = nb.umi_scan(batch.buf, uo, ul)
+
+        conds = []
+        codes = []
+
+        def add(cond, code):
+            conds.append(cond)
+            codes.append(code)
+
+        # primaries empty -> poor (no primary records at all)
+        n_prim = np.zeros(nT, dtype=np.int64)
+        for sel in roles:
+            n_prim += sel >= 0
+        add(n_prim == 0, _POOR)
+
+        # both_unmapped (over present reads) and not allow_unmapped
+        if not self.allow_unmapped:
+            all_unmapped = np.ones(nT, dtype=bool)
+            for sel in roles:
+                r_unmapped = arr(sel, unmapped, True)
+                all_unmapped &= np.where(sel >= 0, r_unmapped, True)
+            add((n_prim > 0) & all_unmapped, _POOR)
+
+        # loop 1 per read: qc-fail then mapq
+        for sel in roles:
+            present = sel >= 0
+            if not self.include_non_pf:
+                add(present & arr(sel, qcfail, False), _NONPF)
+            r_unmapped = arr(sel, unmapped, True)
+            mapq = arr(sel, batch.mapq.astype(np.int64), m)
+            add(present & ~r_unmapped & (mapq < m), _POOR)
+
+        # loop 2 per read: MQ tag, then UMI checks
+        for sel in roles:
+            present = sel >= 0
+            r_paired = arr(sel, paired, False)
+            r_mu = arr(sel, mate_unmapped, True)
+            mq = arr(sel, mq_val, np.int64(1 << 40))
+            add(present & r_paired & ~r_mu & (mq < m), _POOR)
+            if not self.no_umi:
+                u_off = arr(sel, uo, -1)
+                add(present & (u_off < 0), _POOR)
+                add(present & arr(sel, has_n.astype(bool), False), _NS)
+                if self.min_umi_length is not None:
+                    add(present
+                        & (arr(sel, bases.astype(np.int64), 1 << 40)
+                           < self.min_umi_length), _SHORT)
+
+        cat = np.select(conds, codes, default=_ACCEPT)[t_lo:t_hi]
+
+        # non-ASCII UMI bytes route the group through the python path (their
+        # decoded character count can differ from the byte count)
+        weird = np.zeros(nT, dtype=bool)
+        if not self.no_umi:
+            for sel in roles:
+                weird |= (sel >= 0) & ~arr(sel, ascii_ok.astype(bool), True)
+        return cat, weird[t_lo:t_hi]
+
+    def _mq_values(self, batch):
+        """Per-record MQ tag as int64 (absent/non-integer -> huge sentinel,
+        which never fails the < min_mapq check — get_int None semantics)."""
+        vo, vl, vt = batch.tag_locs(b"MQ")
+        buf = batch.buf
+        val = np.full(batch.n, 1 << 40, dtype=np.int64)
+        for code, width, signed in (("c", 1, True), ("C", 1, False),
+                                    ("s", 2, True), ("S", 2, False),
+                                    ("i", 4, True), ("I", 4, False)):
+            mask = (vt == ord(code)) & (vo >= 0)
+            if not mask.any():
+                continue
+            offs = vo[mask]
+            v = np.zeros(len(offs), dtype=np.int64)
+            for j in range(width):
+                v |= buf[offs + j].astype(np.int64) << (8 * j)
+            if signed:
+                sign_bit = np.int64(1) << (8 * width - 1)
+                v = (v ^ sign_bit) - sign_bit
+            val[mask] = v
+        return val
+
+    # ----------------------------------------------------------------- groups
+
+    def _process_groups(self, batch, tbounds, keys, gb):
+        """Vectorized filter + python assignment + native MI rewrite for
+        complete groups gb[0]..gb[-1]."""
+        m = self.metrics
+        t_lo, t_hi = gb[0], gb[-1]
+        cat, weird = self._filter_codes(batch, tbounds, len(tbounds) - 1,
+                                        t_lo, t_hi)
+        sizes_prim = np.zeros(t_hi - t_lo, dtype=np.int64)
+        for sel in (self._r1_of, self._r2_of, self._fr_of):
+            sizes_prim += sel[t_lo:t_hi] >= 0
+
+        out = []
+        pending_rows = []
+        pending_values = []
+
+        for gi in range(len(gb) - 1):
+            lo, hi = gb[gi] - t_lo, gb[gi + 1] - t_lo
+            g_cat = cat[lo:hi]
+            if weird[lo:hi].any():
+                # rare: python path for the whole group, after flushing the
+                # pending fast output to preserve stream order
+                out.extend(self._flush_pending(batch, pending_rows,
+                                               pending_values))
+                pending_rows, pending_values = [], []
+                out.extend(self._emit_slow_group(
+                    [self._materialize(batch, tbounds, t)
+                     for t in range(gb[gi], gb[gi + 1])]))
+                continue
+            # metrics: total per template; category counters
+            g_sizes = sizes_prim[lo:hi]
+            m.total_templates += int(g_sizes.sum())
+            for code, attr in ((_POOR, "poor_alignment"), (_NONPF, "non_pf"),
+                               (_NS, "ns_in_umi"), (_SHORT, "umi_too_short")):
+                c = int(g_sizes[g_cat == code].sum())
+                if c:
+                    setattr(m, attr, getattr(m, attr) + c)
+            kept_t = np.nonzero(g_cat == _ACCEPT)[0] + gb[gi]
+            if not len(kept_t):
+                continue
+            m.accepted += int(g_sizes[g_cat == _ACCEPT].sum())
+
+            assignments = self._assign_light(batch, kept_t)
+
+            # tally + output
+            sizes = {}
+            for mi in assignments:
+                sizes[mi] = sizes.get(mi, 0) + 1
+            for size in sizes.values():
+                self.family_sizes[size] = self.family_sizes.get(size, 0) + 1
+            pg = len(assignments)
+            self.position_group_sizes[pg] = \
+                self.position_group_sizes.get(pg, 0) + 1
+
+            for k, t in enumerate(kept_t):
+                mi_b = assignments[k].encode()
+                for sel in (self._fr_of, self._r1_of, self._r2_of):
+                    r = sel[t]
+                    if r >= 0:
+                        pending_rows.append(r)
+                        pending_values.append(mi_b)
+
+        out.extend(self._flush_pending(batch, pending_rows, pending_values))
+        return out
+
+    def _flush_pending(self, batch, rows, values):
+        if not rows:
+            return []
+        try:
+            blob = nb.rewrite_tag_records(
+                batch, np.asarray(rows, dtype=np.int64), self.assigned_tag,
+                values)
+        except ValueError:
+            # malformed aux region somewhere in the run: per-record python
+            # editor (identical output, tolerant TLV walk)
+            parts = []
+            for r, v in zip(rows, values):
+                data = append_mi_tag(batch.raw_record(int(r)),
+                                     v.decode(), self.assigned_tag)
+                parts.append(len(data).to_bytes(4, "little") + data)
+            blob = b"".join(parts)
+        self.records_out += len(rows)
+        return [blob]
+
+    def _assign_light(self, batch, kept_t):
+        """UMI extraction + strategy assignment for one group's kept
+        templates; returns rendered MI strings in template order."""
+        assigner = self.assigner
+        uo, ul, _ = batch.tag_locs_str(self.umi_tag)
+        buf = batch.buf
+
+        def umi_of(t):
+            r = self._r1_of[t] if self._r1_of[t] >= 0 else (
+                self._fr_of[t] if self._fr_of[t] >= 0 else self._r2_of[t])
+            return buf[uo[r]:uo[r] + ul[r]].tobytes().decode().upper()
+
+        if assigner.split_by_orientation():
+            # orientation subgroups, ordered by (r1_pos, r2_pos) tuple
+            flag = batch.flag
+            subgroups = {}
+            for k, t in enumerate(kept_t):
+                r1, r2 = self._r1_of[t], self._r2_of[t]
+                r1_pos = r1 < 0 or not flag[r1] & FLAG_REVERSE
+                r2_pos = r2 < 0 or not flag[r2] & FLAG_REVERSE
+                subgroups.setdefault((r1_pos, r2_pos), []).append(k)
+            rendered = [None] * len(kept_t)
+            for _, idxs in sorted(subgroups.items()):
+                if self.no_umi:
+                    umis = [""] * len(idxs)
+                else:
+                    umis = [umi_of(kept_t[k]) for k in idxs]
+                    umis = self._truncate(umis)
+                for k, mi in zip(idxs, assigner.assign(umis)):
+                    rendered[k] = mi.render()
+            return rendered
+
+        # paired strategy: orientation prefixes by genomic order of r1/r2
+        u5 = self._u5_cache(batch)
+        flag = batch.flag
+        lo_p, hi_p = assigner.lower_prefix, assigner.higher_prefix
+        umis = []
+        for t in kept_t:
+            umi = umi_of(t)
+            parts = umi.split("-")
+            if len(parts) != 2:
+                raise ValueError(
+                    "Paired strategy used but UMI did not contain 2 segments "
+                    f"delimited by '-': {umi}")
+            r1, r2 = self._r1_of[t], self._r2_of[t]
+            if r1 >= 0 and r2 >= 0:
+                if batch.ref_id[r1] != batch.ref_id[r2]:
+                    r1_earlier = batch.ref_id[r1] < batch.ref_id[r2]
+                elif u5[r1] != u5[r2]:
+                    r1_earlier = u5[r1] < u5[r2]
+                else:
+                    r1_earlier = not flag[r1] & FLAG_REVERSE
+            else:
+                r1_earlier = True
+            if r1_earlier:
+                umis.append(f"{lo_p}:{parts[0]}-{hi_p}:{parts[1]}")
+            else:
+                umis.append(f"{hi_p}:{parts[0]}-{lo_p}:{parts[1]}")
+        umis = self._truncate(umis)
+        return [mi.render() for mi in assigner.assign(umis)]
+
+    def _truncate(self, umis):
+        if self.min_umi_length is None:
+            return umis
+        shortest = min((len(u) for u in umis), default=0)
+        if shortest < self.min_umi_length:
+            raise ValueError(
+                f"UMI found that had shorter length than expected "
+                f"({shortest} < {self.min_umi_length})")
+        return [u[:self.min_umi_length] for u in umis]
+
+    def _u5_cache(self, batch):
+        if getattr(self, "_u5_batch", None) is not batch:
+            self._u5_arr = nb.unclipped_5prime(batch)
+            self._u5_batch = batch
+        return self._u5_arr
+
+    def result(self):
+        return {
+            "records_out": self.records_out,
+            "filter": self.metrics.as_dict(),
+            "family_sizes": dict(sorted(self.family_sizes.items())),
+            "position_group_sizes": dict(
+                sorted(self.position_group_sizes.items())),
+        }

@@ -59,10 +59,14 @@ class RecordBatch:
 
     def tag_locs_str(self, tag: bytes):
         """tag_locs with non-string-typed (not Z/H) tags masked to absent,
-        matching RawRecord.get_str's type gate."""
-        vo, vl, vt = self.tag_locs(tag)
-        ok = (vt == ord("Z")) | (vt == ord("H"))
-        return np.where(ok, vo, -1), vl, vt
+        matching RawRecord.get_str's type gate. Cached per batch."""
+        got = self._tag_locs.get((tag, "str"))
+        if got is None:
+            vo, vl, vt = self.tag_locs(tag)
+            ok = (vt == ord("Z")) | (vt == ord("H"))
+            got = (np.where(ok, vo, -1), vl, vt)
+            self._tag_locs[(tag, "str")] = got
+        return got
 
     def tag_bytes(self, tag: bytes, i: int):
         """One record's tag value bytes (Z/H string, no NUL), or None."""

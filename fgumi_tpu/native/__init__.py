@@ -118,6 +118,14 @@ def get_lib():
         lib.fgumi_natural_name_keys.restype = ctypes.c_long
         lib.fgumi_natural_name_keys.argtypes = (
             [p] * 4 + [ctypes.c_long, p, p, p])
+        lib.fgumi_unclipped_5prime.restype = None
+        lib.fgumi_unclipped_5prime.argtypes = [p] * 5 + [ctypes.c_long, p]
+        lib.fgumi_umi_scan.restype = None
+        lib.fgumi_umi_scan.argtypes = [p, p, p, ctypes.c_long, p, p, p]
+        lib.fgumi_rewrite_tag_records.restype = ctypes.c_long
+        lib.fgumi_rewrite_tag_records.argtypes = (
+            [p] * 4 + [ctypes.c_long, ctypes.c_ubyte, ctypes.c_ubyte]
+            + [p] * 4)
         lib.fgumi_rx_unanimous.restype = None
         lib.fgumi_rx_unanimous.argtypes = [p, p, p, p, ctypes.c_long, p, p]
         lib.fgumi_extract_records.restype = ctypes.c_long

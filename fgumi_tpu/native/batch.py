@@ -334,6 +334,62 @@ def natural_name_keys(batch):
     return out, out_off, out_len
 
 
+def unclipped_5prime(batch):
+    """Per-record unclipped 5' positions (int64[n]; meaningful for mapped)."""
+    lib = get_lib()
+    out = np.empty(batch.n, dtype=np.int64)
+    args = [np.ascontiguousarray(a) for a in (
+        batch.cigar_off, batch.n_cigar, batch.flag, batch.pos)]
+    lib.fgumi_unclipped_5prime(_addr(batch.buf), *(map(_addr, args)), batch.n,
+                               _addr(out))
+    return out
+
+
+def umi_scan(buf: np.ndarray, off, length):
+    """(has_n uint8[n], bases int32[n], ascii uint8[n]) per byte range;
+    off < 0 -> (0, -1, 1)."""
+    lib = get_lib()
+    n = len(off)
+    has_n = np.empty(n, dtype=np.uint8)
+    bases = np.empty(n, dtype=np.int32)
+    ascii_ = np.empty(n, dtype=np.uint8)
+    off = np.ascontiguousarray(off, np.int64)
+    length = np.ascontiguousarray(length, np.int32)
+    lib.fgumi_umi_scan(_addr(buf), _addr(off), _addr(length), n,
+                       _addr(has_n), _addr(bases), _addr(ascii_))
+    return has_n, bases, ascii_
+
+
+def rewrite_tag_records(batch, rows, tag: bytes, values):
+    """Wire blob for `rows` with `tag` replaced by per-row Z values.
+
+    values: list of bytes, parallel to rows. Returns the contiguous
+    block_size-prefixed wire blob with every prior occurrence of the tag
+    removed and the new value appended per record. Raises ValueError on a
+    malformed aux region (callers fall back to the Python record editor).
+    """
+    lib = get_lib()
+    rows = np.ascontiguousarray(rows, np.int64)
+    k = len(rows)
+    val_blob = np.frombuffer(b"".join(values) or b"\x00", dtype=np.uint8)
+    val_len = np.array([len(v) for v in values], dtype=np.int32)
+    val_off = np.concatenate(
+        ([0], np.cumsum(val_len, dtype=np.int64)))[:-1] \
+        if k else np.empty(0, dtype=np.int64)
+    data_off = np.ascontiguousarray(batch.data_off[rows])
+    data_end = np.ascontiguousarray(batch.data_end[rows])
+    aux_off = np.ascontiguousarray(batch.aux_off[rows])
+    cap = int(((data_end - data_off) + 8 + val_len).sum())
+    out = np.empty(cap, dtype=np.uint8)
+    total = lib.fgumi_rewrite_tag_records(
+        _addr(batch.buf), _addr(data_off), _addr(data_end), _addr(aux_off),
+        k, tag[0], tag[1], _addr(val_blob), _addr(val_off), _addr(val_len),
+        _addr(out))
+    if total < 0:
+        raise ValueError(f"malformed aux region in record {-(total + 1)}")
+    return out[:total].tobytes()
+
+
 def hash_ranges(buf: np.ndarray, off, length):
     """FNV-1a 64-bit hash per byte range (off < 0 -> 0)."""
     lib = get_lib()

@@ -618,6 +618,8 @@ inline void put_u64_be(uint8_t* p, uint64_t v) {
 // defined below (overlap section)
 bool parse_mc_cigar(const uint8_t* s, int64_t len, int64_t* leading_soft,
                     int64_t* ref_len, int64_t* trailing_soft);
+// defined below (tag scan section)
+inline int64_t tag_fixed_size(uint8_t typ);
 
 }  // namespace
 
@@ -768,6 +770,139 @@ long fgumi_template_coord_keys(
     p[30 + nl] = is_upper;
   }
   return 0;
+}
+
+// Batch unclipped 5' positions (core/template.py::unclipped_5prime):
+// forward reads -> unclipped start (pos - leading S/H), reverse -> unclipped
+// end (pos + ref_len - 1 + trailing S/H). Unmapped records get pos as-is
+// (callers sentinel them by flag).
+void fgumi_unclipped_5prime(const uint8_t* buf, const int64_t* cigar_off,
+                            const int32_t* n_cigar, const int32_t* flag,
+                            const int32_t* pos, long n, int64_t* out) {
+  for (long i = 0; i < n; ++i) {
+    const uint8_t* cp = buf + cigar_off[i];
+    const int32_t nc = n_cigar[i];
+    if (flag[i] & 0x10) {
+      int64_t rlen = 0, trail = 0;
+      for (int32_t k = 0; k < nc; ++k) {
+        uint32_t v;
+        std::memcpy(&v, cp + 4 * k, 4);
+        const uint32_t op = v & 0xF;
+        if (op == 0 || op == 2 || op == 3 || op == 7 || op == 8)
+          rlen += v >> 4;
+      }
+      for (int32_t k = nc - 1; k >= 0; --k) {
+        uint32_t v;
+        std::memcpy(&v, cp + 4 * k, 4);
+        const uint32_t op = v & 0xF;
+        if (op == 4 || op == 5) trail += v >> 4; else break;
+      }
+      out[i] = pos[i] + rlen - 1 + trail;
+    } else {
+      int64_t lead = 0;
+      for (int32_t k = 0; k < nc; ++k) {
+        uint32_t v;
+        std::memcpy(&v, cp + 4 * k, 4);
+        const uint32_t op = v & 0xF;
+        if (op == 4 || op == 5) lead += v >> 4; else break;
+      }
+      out[i] = pos[i] - lead;
+    }
+  }
+}
+
+// Rewrite records with one tag replaced: every existing occurrence of `tag`
+// is removed from the aux region (any type; RawRecord.data_without_tag
+// semantics) and a fresh Z-typed value appended, each record emitted as
+// block_size-prefixed wire bytes, packed contiguously into `out` (sized for
+// the worst case sum(data_len + 8 + val_len)). Returns total bytes written,
+// or -1 - i on a malformed record's aux region (caller reroutes through the
+// Python editor).
+long fgumi_rewrite_tag_records(
+    const uint8_t* buf, const int64_t* data_off, const int64_t* data_end,
+    const int64_t* aux_off, long n, uint8_t t1, uint8_t t2,
+    const uint8_t* val_blob, const int64_t* val_off, const int32_t* val_len,
+    uint8_t* out) {
+  int64_t total = 0;
+  for (long i = 0; i < n; ++i) {
+    uint8_t* dst = out + total + 4;
+    const uint8_t* src = buf + data_off[i];
+    const int64_t aux0 = aux_off[i] - data_off[i];
+    const int64_t dlen = data_end[i] - data_off[i];
+    // fixed header + name/cigar/seq/qual copied verbatim
+    std::memcpy(dst, src, static_cast<size_t>(aux0));
+    int64_t w = aux0;
+    int64_t off = aux0;
+    bool ok = true;
+    while (off + 3 <= dlen) {
+      const uint8_t a = src[off];
+      const uint8_t b = src[off + 1];
+      const uint8_t typ = src[off + 2];
+      int64_t size = tag_fixed_size(typ);
+      if (size == 0) {
+        if (typ == 'Z' || typ == 'H') {
+          const uint8_t* nul = static_cast<const uint8_t*>(
+              std::memchr(src + off + 3, 0, static_cast<size_t>(dlen - off - 3)));
+          if (nul == nullptr) { ok = false; break; }
+          size = (nul - (src + off + 3)) + 1;
+        } else if (typ == 'B') {
+          if (off + 8 > dlen) { ok = false; break; }
+          const int64_t esize = tag_fixed_size(src[off + 3]);
+          if (esize == 0) { ok = false; break; }
+          size = 5 + esize * static_cast<int64_t>(read_u32(src + off + 4));
+        } else {
+          ok = false;
+          break;
+        }
+      }
+      if (off + 3 + size > dlen) { ok = false; break; }
+      if (!(a == t1 && b == t2)) {
+        std::memcpy(dst + w, src + off, static_cast<size_t>(3 + size));
+        w += 3 + size;
+      }
+      off += 3 + size;
+    }
+    if (!ok || off != dlen) return -1 - i;
+    dst[w] = t1;
+    dst[w + 1] = t2;
+    dst[w + 2] = 'Z';
+    std::memcpy(dst + w + 3, val_blob + val_off[i],
+                static_cast<size_t>(val_len[i]));
+    w += 3 + val_len[i];
+    dst[w++] = 0;
+    put_u32(out + total, static_cast<uint32_t>(w));
+    total += 4 + w;
+  }
+  return total;
+}
+
+// Per-range UMI scan: has_n = contains 'N'/'n', bases = byte length minus
+// '-' separators (group.py::_umi_base_count), ascii = no high-bit bytes
+// (non-ASCII UMIs route through the Python path: their decoded character
+// count can differ from the byte count). off < 0 -> (-1 bases, 0, 1).
+void fgumi_umi_scan(const uint8_t* buf, const int64_t* off,
+                    const int32_t* len, long n, uint8_t* has_n,
+                    int32_t* bases, uint8_t* ascii) {
+  for (long i = 0; i < n; ++i) {
+    if (off[i] < 0) {
+      has_n[i] = 0;
+      bases[i] = -1;
+      ascii[i] = 1;
+      continue;
+    }
+    const uint8_t* p = buf + off[i];
+    uint8_t nn = 0, asc = 1;
+    int32_t dashes = 0;
+    for (int32_t k = 0; k < len[i]; ++k) {
+      const uint8_t c = p[k];
+      nn |= (c == 'N') | (c == 'n');
+      asc &= c < 0x80;
+      dashes += c == '-';
+    }
+    has_n[i] = nn;
+    bases[i] = len[i] - dashes;
+    ascii[i] = asc;
+  }
 }
 
 // Batch natural-queryname sort keys (sort/keys.py::queryname_key_bytes):

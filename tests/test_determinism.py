@@ -1,0 +1,81 @@
+"""Determinism under host parallelism (SURVEY §5.2 analog).
+
+The reference asserts multi-threaded runs produce identical output to
+single-threaded ones (test_group_determinism.rs, deterministic MI numbering
+design doc). Here: the threaded fixed-role pipeline must emit byte-identical
+consensus streams to the inline path, and repeated runs must be identical.
+"""
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.cli import main
+from fgumi_tpu.io.bam import BamReader
+from fgumi_tpu.native import batch as nb
+from fgumi_tpu.simulate import simulate_duplex_bam, simulate_grouped_bam
+
+pytestmark = pytest.mark.skipif(not nb.available(),
+                                reason="native library unavailable")
+
+
+def records_of(path):
+    with BamReader(path) as r:
+        return [rec.data for rec in r]
+
+
+@pytest.fixture(scope="module")
+def grouped(tmp_path_factory):
+    p = str(tmp_path_factory.mktemp("det") / "grouped.bam")
+    simulate_grouped_bam(p, num_families=300, family_size=4,
+                         family_size_distribution="lognormal", seed=31)
+    return p
+
+
+@pytest.fixture(scope="module")
+def duplexed(tmp_path_factory):
+    p = str(tmp_path_factory.mktemp("det") / "duplex.bam")
+    simulate_duplex_bam(p, num_molecules=120, reads_per_strand=3, seed=32)
+    return p
+
+
+def test_simplex_threads_deterministic(grouped, tmp_path):
+    outs = []
+    for i, threads in enumerate((0, 4, 4)):
+        out = str(tmp_path / f"c{i}.bam")
+        # small batches force carries and queue churn under threads
+        assert main(["simplex", "-i", grouped, "-o", out, "--min-reads", "1",
+                     "--threads", str(threads),
+                     "--batch-bytes", str(64 << 10)]) == 0
+        outs.append(records_of(out))
+    assert outs[0] == outs[1] == outs[2]
+
+
+def test_duplex_threads_deterministic(duplexed, tmp_path):
+    outs = []
+    for i, threads in enumerate((0, 4, 4)):
+        out = str(tmp_path / f"d{i}.bam")
+        assert main(["duplex", "-i", duplexed, "-o", out, "--min-reads", "1",
+                     "--threads", str(threads),
+                     "--batch-bytes", str(64 << 10)]) == 0
+        outs.append(records_of(out))
+    assert outs[0] == outs[1] == outs[2]
+
+
+def test_simplex_fast_vs_classic(grouped, tmp_path):
+    fast = str(tmp_path / "fast.bam")
+    classic = str(tmp_path / "classic.bam")
+    assert main(["simplex", "-i", grouped, "-o", fast,
+                 "--min-reads", "1"]) == 0
+    assert main(["simplex", "-i", grouped, "-o", classic, "--min-reads", "1",
+                 "--classic"]) == 0
+    assert records_of(fast) == records_of(classic)
+
+
+def test_duplex_fast_vs_classic(duplexed, tmp_path):
+    fast = str(tmp_path / "fast.bam")
+    classic = str(tmp_path / "classic.bam")
+    assert main(["duplex", "-i", duplexed, "-o", fast,
+                 "--min-reads", "1"]) == 0
+    assert main(["duplex", "-i", duplexed, "-o", classic, "--min-reads", "1",
+                 "--classic"]) == 0
+    assert records_of(fast) == records_of(classic)

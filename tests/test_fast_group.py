@@ -1,0 +1,206 @@
+"""Parity: FastGrouper (vectorized batch path) vs commands/group.py.
+
+Byte-identical output records, identical filter metrics and family-size
+histograms, across strategies, batch-boundary-spanning groups and
+split templates, filtering categories, and MI-tag replacement.
+"""
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.cli import main
+from fgumi_tpu.commands.fast_group import FastGrouper
+from fgumi_tpu.commands.group import run_group
+from fgumi_tpu.io.bam import (BamHeader, BamReader, BamWriter, RawRecord,
+                              RecordBuilder)
+from fgumi_tpu.io.batch_reader import BamBatchReader
+from fgumi_tpu.native import batch as nb
+from fgumi_tpu.simulate import simulate_mapped_bam
+from fgumi_tpu.umi.assigners import make_assigner
+
+pytestmark = pytest.mark.skipif(not nb.available(),
+                                reason="native library unavailable")
+
+
+class ListWriter:
+    def __init__(self):
+        self.records = []
+
+    def write_record_bytes(self, data):
+        self.records.append(bytes(data))
+
+
+def run_slow(path, **kw):
+    with BamReader(path) as reader:
+        w = ListWriter()
+        result = run_group(reader, w, **kw)
+    return w.records, result
+
+
+def run_fast(path, target_bytes=4096, *, strategy="adjacency", edits=1,
+             **kw):
+    with BamBatchReader(path, target_bytes=target_bytes) as reader:
+        grouper = FastGrouper(reader.header,
+                              make_assigner(strategy, edits), **kw)
+        chunks = []
+        for batch in reader:
+            chunks.extend(grouper.process_batch(batch))
+        chunks.extend(grouper.flush())
+    recs = []
+    for blob in chunks:
+        off = 0
+        while off < len(blob):
+            n = int.from_bytes(blob[off:off + 4], "little")
+            recs.append(blob[off + 4:off + 4 + n])
+            off += 4 + n
+        assert off == len(blob)
+    return recs, grouper.result()
+
+
+def assert_parity(path, target_bytes=4096, **kw):
+    slow_recs, slow_res = run_slow(path, **kw)
+    fast_recs, fast_res = run_fast(path, target_bytes, **kw)
+    assert len(fast_recs) == len(slow_recs)
+    for i, (f, s) in enumerate(zip(fast_recs, slow_recs)):
+        assert f == s, f"record {i}: {RawRecord(f).name} vs {RawRecord(s).name}"
+    assert fast_res == slow_res
+    return slow_res
+
+
+@pytest.fixture(scope="module")
+def grouped_input(tmp_path_factory):
+    """Template-coordinate sorted mapped BAM with UMI errors."""
+    tmp = tmp_path_factory.mktemp("fg")
+    raw = str(tmp / "mapped.bam")
+    simulate_mapped_bam(raw, num_families=400, family_size=4,
+                        umi_error_rate=0.05, seed=13)
+    out = str(tmp / "sorted.bam")
+    assert main(["sort", "-i", raw, "-o", out,
+                 "--order", "template-coordinate"]) == 0
+    return out
+
+
+@pytest.fixture(scope="module")
+def paired_input(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("fg")
+    raw = str(tmp / "mapped.bam")
+    simulate_mapped_bam(raw, num_families=200, family_size=4,
+                        paired_umis=True, umi_error_rate=0.05, seed=14)
+    out = str(tmp / "sorted.bam")
+    assert main(["sort", "-i", raw, "-o", out,
+                 "--order", "template-coordinate"]) == 0
+    return out
+
+
+@pytest.mark.parametrize("strategy", ["identity", "edit", "adjacency"])
+def test_parity_strategies(grouped_input, strategy):
+    res = assert_parity(grouped_input, strategy=strategy)
+    assert res["records_out"] > 0
+
+
+def test_parity_paired(paired_input):
+    res = assert_parity(paired_input, strategy="paired")
+    assert res["records_out"] > 0
+
+
+def test_parity_tiny_batches(grouped_input):
+    """Split templates and carried groups at every batch boundary."""
+    assert_parity(grouped_input, target_bytes=600)
+
+
+def test_parity_min_mapq_and_umi_filters(grouped_input):
+    assert_parity(grouped_input, min_mapq=45, min_umi_length=4)
+
+
+@pytest.fixture(scope="module")
+def adversarial_input(tmp_path_factory):
+    """Hand-built template-coordinate stream: QC-fail, low mapq, MQ tags,
+    N-UMIs, missing UMIs, secondary/supplementary records, fragments,
+    existing MI tags to replace, multi-library RGs."""
+    tmp = tmp_path_factory.mktemp("fg")
+    path = str(tmp / "adv.bam")
+    header = BamHeader(
+        text="@HD\tVN:1.6\tSO:unsorted\tGO:query\t"
+             "SS:unsorted:template-coordinate\n@SQ\tSN:c\tLN:99999\n"
+             "@RG\tID:A\tLB:libA\n@RG\tID:B\tLB:libB\n",
+        ref_names=["c"], ref_lengths=[99999])
+    rng = np.random.default_rng(15)
+
+    def rec(name, flag, pos, umi=b"ACGT", mapq=60, mq=None, rg=b"A",
+            mi=None, next_pos=None, cigar=(("M", 40),)):
+        b = RecordBuilder().start_mapped(
+            name, flag, 0, pos, mapq, list(cigar),
+            bytes(rng.choice(np.frombuffer(b"ACGT", np.uint8), size=40)),
+            np.full(40, 30, np.uint8),
+            next_ref_id=0 if next_pos is not None else -1,
+            next_pos=next_pos if next_pos is not None else -1)
+        if umi is not None:
+            b.tag_str(b"RX", umi)
+        if mq is not None:
+            b.tag_int(b"MQ", mq)
+        if rg is not None:
+            b.tag_str(b"RG", rg)
+        if mi is not None:
+            b.tag_str(b"MI", mi)
+        return b.finish()
+
+    records = []
+    # pos group 1: normal pairs + a qc-fail template + low-mapq template
+    for i, (extra_flag, mapq, umi) in enumerate([
+            (0, 60, b"ACGT"), (0, 60, b"ACGA"), (0x200, 60, b"ACGT"),
+            (0, 0, b"ACGT"), (0, 60, b"ANGT"), (0, 60, None),
+            (0, 60, b"AC")]):
+        name = b"t1_%d" % i
+        records.append(rec(name, 0x1 | 0x40 | 0x20 | extra_flag, 1000,
+                           umi=umi, mapq=mapq, mq=60, next_pos=1100))
+        records.append(rec(name, 0x1 | 0x80 | 0x10 | extra_flag, 1100,
+                           umi=umi, mapq=mapq, mq=mapq, next_pos=1000))
+    # a secondary + supplementary record inside a template
+    records.append(rec(b"t1_0", 0x1 | 0x40 | 0x100, 1000, next_pos=1100))
+    # pos group 2: fragments with existing MI tags (replacement), libB
+    for i in range(3):
+        records.append(rec(b"t2_%d" % i, 0, 2000, umi=b"TTCC", rg=b"B",
+                           mi=b"old%d" % i))
+    # pos group 3: MQ-tag failures
+    for i in range(2):
+        name = b"t3_%d" % i
+        records.append(rec(name, 0x1 | 0x40 | 0x20, 3000, mq=0,
+                           next_pos=3100))
+        records.append(rec(name, 0x1 | 0x80 | 0x10, 3100, mq=0,
+                           next_pos=3000))
+    # pos group 4: soft-clipped cigars shifting unclipped 5'
+    for i in range(2):
+        name = b"t4_%d" % i
+        records.append(rec(name, 0x1 | 0x40 | 0x20, 4000 + i * 3,
+                           next_pos=4100,
+                           cigar=(("S", 3 * i), ("M", 40 - 3 * i))))
+        records.append(rec(name, 0x1 | 0x80 | 0x10, 4100, next_pos=4000 + i * 3,
+                           cigar=(("M", 37), ("S", 3))))
+    with BamWriter(path, header) as w:
+        for r in records:
+            w.write_record_bytes(r)
+    return path
+
+
+@pytest.mark.parametrize("target_bytes", [4096, 300])
+def test_parity_adversarial(adversarial_input, target_bytes):
+    res = assert_parity(adversarial_input, target_bytes=target_bytes,
+                        min_mapq=20, min_umi_length=3)
+    assert res["filter"].get("non_pf", 0) > 0
+    assert res["filter"].get("poor_alignment", 0) > 0
+    assert res["filter"].get("ns_in_umi", 0) > 0
+    assert res["filter"].get("umi_too_short", 0) > 0
+
+
+def test_cli_fast_vs_classic(grouped_input, tmp_path):
+    fast = str(tmp_path / "fast.bam")
+    classic = str(tmp_path / "classic.bam")
+    assert main(["group", "-i", grouped_input, "-o", fast]) == 0
+    assert main(["group", "-i", grouped_input, "-o", classic,
+                 "--classic"]) == 0
+
+    def recs(p):
+        with BamReader(p) as r:
+            return [x.data for x in r]
+
+    assert recs(fast) == recs(classic)

@@ -24,7 +24,7 @@ from ..native import batch as nb
 from ..ops import oracle
 from .overlapping import (AGREEMENT_CODES, DISAGREEMENT_CODES,
                           add_native_overlap_stats)
-from .simple_umi import consensus_umis
+from .simple_umi import consensus_umis_batch
 from .vanilla import (FRAGMENT, R1, R2, _TYPE_FLAGS, VanillaConsensusCaller)
 
 def resolve_chunk(chunk) -> bytes:
@@ -746,15 +746,16 @@ class FastSimplexCaller:
         buf_base = buf.ctypes.data
         rx_addr = np.where(rxo >= 0, buf_base + rxo, 0)
         rx_len = np.where(rxo >= 0, rxl, 0).astype(np.int32)
-        for j in np.nonzero(rxo == -2)[0]:
-            job = jobs[j]
-            umis = [buf[rx_vo[i]: rx_vo[i] + rx_vl[i]].tobytes().decode()
-                    for i in job.surviving_idx if rx_vo[i] >= 0]
-            rx_arr = np.frombuffer(consensus_umis(umis).encode(),
-                                   dtype=np.uint8)
-            keep_alive.append(rx_arr)
-            rx_addr[j] = rx_arr.ctypes.data
-            rx_len[j] = len(rx_arr)
+        divergent = np.nonzero(rxo == -2)[0]
+        if len(divergent):
+            fams = [[buf[rx_vo[i]: rx_vo[i] + rx_vl[i]].tobytes().decode()
+                     for i in jobs[j].surviving_idx if rx_vo[i] >= 0]
+                    for j in divergent]
+            for j, rx in zip(divergent, consensus_umis_batch(fams)):
+                rx_arr = np.frombuffer(rx.encode(), dtype=np.uint8)
+                keep_alive.append(rx_arr)
+                rx_addr[j] = rx_arr.ctypes.data
+                rx_len[j] = len(rx_arr)
 
         blob, _ = nb.build_consensus_records(
             code_addr, qual_addr, depth_addr, err_addr, lens, flags,

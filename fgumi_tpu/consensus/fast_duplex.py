@@ -23,7 +23,7 @@ from ..io.bam import (FLAG_FIRST, FLAG_LAST, FLAG_MATE_UNMAPPED, FLAG_PAIRED,
 from ..native import batch as nb
 from ..ops import oracle
 from .fast import overlap_correct_span
-from .simple_umi import consensus_umis
+from .simple_umi import consensus_umis_batch
 from .vanilla import I16_MAX, R1, R2, _TYPE_FLAGS
 
 # seg types within a molecule: (strand, read-type) -> 0..3
@@ -676,6 +676,8 @@ class FastDuplexCaller:
                                 .tobytes().decode())
             return vals
 
+        fams = []
+        fam_ks = []
         for k, spec in enumerate(out_specs):
             # AB-seg values verbatim, BA-seg values flipped — BOTH segs of
             # the branch contribute, independent of consensus aliveness
@@ -697,8 +699,10 @@ class FastDuplexCaller:
                 vals.extend(vs)
             if not vals:
                 continue
-            rx = consensus_umis(vals).encode()
-            arr = np.frombuffer(rx, dtype=np.uint8)
+            fams.append(vals)
+            fam_ks.append(k)
+        for k, rx in zip(fam_ks, consensus_umis_batch(fams)):
+            arr = np.frombuffer(rx.encode(), dtype=np.uint8)
             keep_alive.append(arr)
             rx_addr[k] = arr.ctypes.data
             rx_len[k] = len(rx)

@@ -18,6 +18,92 @@ _Q_ERROR = 20
 _tables = None
 
 
+def consensus_umis_batch(families) -> list:
+    """[consensus_umis(f) for f in families], with all non-trivial families
+    resolved in ONE oracle pass.
+
+    Exactness: every oracle op (Kahan accumulation, log-sum-exp, tie rule) is
+    positionwise, and N-padded rows are skipped by the masked Kahan update,
+    so concatenating families along the position axis (rows padded to the
+    common R with N) yields bit-identical results to per-family calls —
+    including the accumulation-order-pinned near-tie behavior.
+    """
+    results = [None] * len(families)
+    work = []
+    for i, umis in enumerate(families):
+        if not umis:
+            results[i] = ""
+            continue
+        first = umis[0]
+        if len(umis) == 1:
+            results[i] = first
+            continue
+        if all(u == first for u in umis):
+            results[i] = "".join(c.upper() if c.upper() in "ACGTN" else c
+                                 for c in first)
+            continue
+        work.append(i)
+    if not work:
+        return results
+
+    dna_set = np.frombuffer(bytes(_DNA), dtype=np.uint8)
+    arrs, dnas, codes_list = [], [], []
+    R_max = 0
+    for i in work:
+        umis = families[i]
+        seq_len = len(umis[0])
+        if any(len(u) != seq_len for u in umis):
+            raise ValueError(
+                f"UMI sequences must all have the same length: {umis}")
+        arr = np.array([np.frombuffer(u.encode(), dtype=np.uint8)
+                        for u in umis])
+        is_dna = np.isin(arr, dna_set)
+        codes = np.where(is_dna, BASE_TO_CODE[arr], 4).astype(np.uint8)
+        arrs.append(arr)
+        dnas.append(is_dna)
+        codes_list.append(codes)
+        R_max = max(R_max, arr.shape[0])
+
+    cat = np.concatenate(
+        [np.pad(c, ((0, R_max - c.shape[0]), (0, 0)), constant_values=4)
+         for c in codes_list], axis=1)
+    quals = np.full_like(cat, _Q_ERROR)
+    global _tables
+    if _tables is None:
+        _tables = quality_tables(90, 90)
+    winner_cat, _q, _d, _e = oracle.call_family(cat, quals, _tables)
+
+    off = 0
+    for i, arr, is_dna in zip(work, arrs, dnas):
+        seq_len = arr.shape[1]
+        winner = winner_cat[off:off + seq_len]
+        off += seq_len
+        results[i] = _assemble(arr, is_dna, winner, len(families[i]))
+    return results
+
+
+def _assemble(arr, is_dna, winner, n_umis) -> str:
+    """Winner codes + non-DNA column rules -> consensus string."""
+    seq_len = arr.shape[1]
+    out = bytearray()
+    first_arr = arr[0]
+    n_dna = is_dna.sum(axis=0)
+    for i in range(seq_len):
+        if n_dna[i] == 0:
+            if not (arr[:, i] == first_arr[i]).all():
+                raise ValueError(
+                    f"Sequences must have character {chr(first_arr[i])!r} "
+                    f"at position {i}")
+            out.append(first_arr[i])
+        elif n_dna[i] == n_umis:
+            out.append(CODE_TO_BASE[winner[i]])
+        else:
+            raise ValueError(
+                f"Sequences contained a mix of DNA and non-DNA characters "
+                f"at offset {i}")
+    return out.decode()
+
+
 def consensus_umis(umis) -> str:
     """Majority/likelihood consensus over equal-length UMI strings (simple_umi.rs:236-245).
 
@@ -52,20 +138,4 @@ def consensus_umis(umis) -> str:
     if _tables is None:
         _tables = quality_tables(90, 90)
     winner, _q, _d, _e = oracle.call_family(codes, quals, _tables)
-
-    out = bytearray()
-    first_arr = arr[0]
-    n_dna = is_dna.sum(axis=0)
-    for i in range(seq_len):
-        if n_dna[i] == 0:
-            # all non-DNA: must be the same character, preserved from the first
-            if not (arr[:, i] == first_arr[i]).all():
-                raise ValueError(
-                    f"Sequences must have character {chr(first_arr[i])!r} at position {i}")
-            out.append(first_arr[i])
-        elif n_dna[i] == len(umis):
-            out.append(CODE_TO_BASE[winner[i]])
-        else:
-            raise ValueError(
-                f"Sequences contained a mix of DNA and non-DNA characters at offset {i}")
-    return out.decode()
+    return _assemble(arr, is_dna, winner, len(umis))

@@ -67,3 +67,34 @@ def test_lowercase_casing_matches_oracle_path():
     assert consensus_umis(["acgt", "acgt"]) == "ACGT"
     # single sequence: verbatim passthrough (original behavior)
     assert consensus_umis(["acgt"]) == "acgt"
+
+
+def test_consensus_umis_batch_parity():
+    """consensus_umis_batch == per-family consensus_umis on a mixed bag:
+    unanimous, single, empty, divergent, varying R and L, near-tie
+    compositions, lowercase, dash separators."""
+    import numpy as np
+
+    from fgumi_tpu.consensus.simple_umi import (consensus_umis,
+                                                consensus_umis_batch)
+
+    rng = np.random.default_rng(44)
+    bases = "ACGT"
+    fams = [
+        [],
+        ["ACGT"],
+        ["acgt", "acgt"],
+        ["AAAA", "AAAA", "AAAT"],
+        ["AAAA", "AAAT"],          # 1-1 near-tie
+        ["AC-GT", "AC-GA", "AC-GT"],
+        ["TTTT"] * 7 + ["TTTA"] * 3,
+    ]
+    for _ in range(60):
+        r = int(rng.integers(2, 9))
+        length = int(rng.integers(3, 12))
+        fam = ["".join(rng.choice(list(bases), size=length))
+               for _ in range(r)]
+        fams.append(fam)
+    expected = [consensus_umis(f) for f in fams]
+    got = consensus_umis_batch(fams)
+    assert got == expected

@@ -115,27 +115,4 @@ def consensus_umis(umis) -> str:
     behavior a counting shortcut cannot reproduce, so the oracle stays the
     source of truth (tests/test_simple_umi.py).
     """
-    if not umis:
-        return ""
-    first = umis[0]
-    if len(umis) == 1:
-        return first  # single-sequence passthrough (verbatim, original casing)
-    if all(u == first for u in umis):
-        # match the oracle path's output casing exactly: DNA characters come
-        # back uppercased (CODE_TO_BASE), non-DNA characters pass through
-        return "".join(c.upper() if c.upper() in "ACGTN" else c
-                       for c in first)
-    seq_len = len(first)
-    if any(len(u) != seq_len for u in umis):
-        raise ValueError(f"UMI sequences must all have the same length: {umis}")
-
-    arr = np.array([np.frombuffer(u.encode(), dtype=np.uint8) for u in umis])  # (R, L)
-    is_dna = np.isin(arr, np.frombuffer(bytes(_DNA), dtype=np.uint8))
-    codes = np.where(is_dna, BASE_TO_CODE[arr], 4).astype(np.uint8)
-    quals = np.full_like(codes, _Q_ERROR)
-
-    global _tables
-    if _tables is None:
-        _tables = quality_tables(90, 90)
-    winner, _q, _d, _e = oracle.call_family(codes, quals, _tables)
-    return _assemble(arr, is_dna, winner, len(umis))
+    return consensus_umis_batch([umis])[0]

@@ -1658,6 +1658,8 @@ def _add_dedup(sub):
     p.add_argument("-l", "--min-umi-length", type=int, default=None)
     p.add_argument("--no-umi", action="store_true",
                    help="dedup by position only, orientation-agnostic (Picard-like)")
+    p.add_argument("--classic", action="store_true",
+                   help="force the per-template engine (no batch vectorization)")
     p.set_defaults(func=cmd_dedup)
 
 
@@ -1675,9 +1677,18 @@ def cmd_dedup(args):
         log.error("Paired strategy cannot be used with --min-umi-length")
         return 2
 
+    from .native import batch as nbat
+
+    use_fast = nbat.available() and not getattr(args, "classic", False)
     t0 = time.monotonic()
     try:
-        with BamReader(args.input) as reader:
+        if use_fast:
+            from .io.batch_reader import BamBatchReader
+
+            reader = BamBatchReader(args.input)
+        else:
+            reader = BamReader(args.input)
+        with reader:
             hdr_text = reader.header.text
             if not is_template_coordinate_sorted(hdr_text):
                 log.error(
@@ -1687,13 +1698,36 @@ def cmd_dedup(args):
                 return 2
             out_header = _header_with_pg(reader.header, " ".join(sys.argv))
             with BamWriter(args.output, out_header) as writer:
-                metrics, family_sizes = run_dedup(
-                    reader, writer, strategy=args.strategy, edits=args.edits,
-                    min_mapq=args.min_map_q,
-                    include_non_pf=args.include_non_pf_reads,
-                    min_umi_length=args.min_umi_length, no_umi=args.no_umi,
-                    include_unmapped=args.include_unmapped,
-                    remove_duplicates=args.remove_duplicates)
+                if use_fast:
+                    from .commands.fast_group import FastDedup
+                    from .umi.assigners import make_assigner
+
+                    strategy, edits = args.strategy, args.edits
+                    if args.no_umi:
+                        strategy, edits = "identity", 0
+                    dd = FastDedup(
+                        reader.header, make_assigner(strategy, edits),
+                        min_mapq=args.min_map_q,
+                        include_non_pf=args.include_non_pf_reads,
+                        min_umi_length=args.min_umi_length,
+                        no_umi=args.no_umi,
+                        include_unmapped=args.include_unmapped,
+                        remove_duplicates=args.remove_duplicates)
+                    for batch in reader:
+                        for chunk in dd.process_batch(batch):
+                            writer.write_serialized(chunk)
+                    for chunk in dd.flush():
+                        writer.write_serialized(chunk)
+                    metrics, family_sizes = dd.result()
+                else:
+                    metrics, family_sizes = run_dedup(
+                        reader, writer, strategy=args.strategy,
+                        edits=args.edits, min_mapq=args.min_map_q,
+                        include_non_pf=args.include_non_pf_reads,
+                        min_umi_length=args.min_umi_length,
+                        no_umi=args.no_umi,
+                        include_unmapped=args.include_unmapped,
+                        remove_duplicates=args.remove_duplicates)
     except (ValueError, OSError) as e:
         log.error("%s", e)
         return 2

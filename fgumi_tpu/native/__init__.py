@@ -125,7 +125,10 @@ def get_lib():
         lib.fgumi_rewrite_tag_records.restype = ctypes.c_long
         lib.fgumi_rewrite_tag_records.argtypes = (
             [p] * 4 + [ctypes.c_long, ctypes.c_ubyte, ctypes.c_ubyte]
-            + [p] * 4)
+            + [p] * 5)
+        lib.fgumi_qual_scores.restype = None
+        lib.fgumi_qual_scores.argtypes = (
+            [p, p, p, ctypes.c_long, ctypes.c_int, ctypes.c_long, p])
         lib.fgumi_rx_unanimous.restype = None
         lib.fgumi_rx_unanimous.argtypes = [p, p, p, p, ctypes.c_long, p, p]
         lib.fgumi_extract_records.restype = ctypes.c_long

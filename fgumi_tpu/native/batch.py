@@ -360,10 +360,11 @@ def umi_scan(buf: np.ndarray, off, length):
     return has_n, bases, ascii_
 
 
-def rewrite_tag_records(batch, rows, tag: bytes, values):
+def rewrite_tag_records(batch, rows, tag: bytes, values, new_flags=None):
     """Wire blob for `rows` with `tag` replaced by per-row Z values.
 
-    values: list of bytes, parallel to rows. Returns the contiguous
+    values: list of bytes, parallel to rows. new_flags: optional int32 array
+    (per row; -1 = keep the record's flag). Returns the contiguous
     block_size-prefixed wire blob with every prior occurrence of the tag
     removed and the new value appended per record. Raises ValueError on a
     malformed aux region (callers fall back to the Python record editor).
@@ -381,13 +382,28 @@ def rewrite_tag_records(batch, rows, tag: bytes, values):
     aux_off = np.ascontiguousarray(batch.aux_off[rows])
     cap = int(((data_end - data_off) + 8 + val_len).sum())
     out = np.empty(cap, dtype=np.uint8)
+    flags_arg = 0
+    if new_flags is not None:
+        new_flags = np.ascontiguousarray(new_flags, np.int32)
+        flags_arg = _addr(new_flags)
     total = lib.fgumi_rewrite_tag_records(
         _addr(batch.buf), _addr(data_off), _addr(data_end), _addr(aux_off),
         k, tag[0], tag[1], _addr(val_blob), _addr(val_off), _addr(val_len),
-        _addr(out))
+        flags_arg, _addr(out))
     if total < 0:
         raise ValueError(f"malformed aux region in record {-(total + 1)}")
     return out[:total].tobytes()
+
+
+def qual_scores(batch, min_q: int, cap: int):
+    """Per-record Picard base-quality score (sum of quals >= min_q, capped)."""
+    lib = get_lib()
+    out = np.empty(batch.n, dtype=np.int32)
+    qual_off = np.ascontiguousarray(batch.qual_off)
+    l_seq = np.ascontiguousarray(batch.l_seq)
+    lib.fgumi_qual_scores(_addr(batch.buf), _addr(qual_off), _addr(l_seq),
+                          batch.n, min_q, cap, _addr(out))
+    return out
 
 
 def hash_ranges(buf: np.ndarray, off, length):

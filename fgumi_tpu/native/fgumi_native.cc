@@ -822,7 +822,7 @@ long fgumi_rewrite_tag_records(
     const uint8_t* buf, const int64_t* data_off, const int64_t* data_end,
     const int64_t* aux_off, long n, uint8_t t1, uint8_t t2,
     const uint8_t* val_blob, const int64_t* val_off, const int32_t* val_len,
-    uint8_t* out) {
+    const int32_t* new_flag, uint8_t* out) {
   int64_t total = 0;
   for (long i = 0; i < n; ++i) {
     uint8_t* dst = out + total + 4;
@@ -870,10 +870,28 @@ long fgumi_rewrite_tag_records(
                 static_cast<size_t>(val_len[i]));
     w += 3 + val_len[i];
     dst[w++] = 0;
+    if (new_flag != nullptr && new_flag[i] >= 0) {
+      put_u16(dst + 14, static_cast<uint16_t>(new_flag[i]));
+    }
     put_u32(out + total, static_cast<uint32_t>(w));
     total += 4 + w;
   }
   return total;
+}
+
+// Picard SUM_OF_BASE_QUALITIES per read (dedup.rs:246-290): sum of qualities
+// >= min_q, capped at `cap` per read.
+void fgumi_qual_scores(const uint8_t* buf, const int64_t* qual_off,
+                       const int32_t* l_seq, long n, int min_q, long cap,
+                       int32_t* out) {
+  for (long i = 0; i < n; ++i) {
+    const uint8_t* q = buf + qual_off[i];
+    int64_t s = 0;
+    for (int32_t k = 0; k < l_seq[i]; ++k) {
+      if (q[k] >= min_q) s += q[k];
+    }
+    out[i] = static_cast<int32_t>(s < cap ? s : cap);
+  }
 }
 
 // Per-range UMI scan: has_n = contains 'N'/'n', bases = byte length minus

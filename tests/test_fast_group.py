@@ -204,3 +204,89 @@ def test_cli_fast_vs_classic(grouped_input, tmp_path):
             return [x.data for x in r]
 
     assert recs(fast) == recs(classic)
+
+
+# --------------------------------------------------------------------- dedup
+
+def run_slow_dedup(path, **kw):
+    from fgumi_tpu.commands.dedup import run_dedup
+
+    with BamReader(path) as reader:
+        w = ListWriter()
+        metrics, fam = run_dedup(reader, w, **kw)
+    return w.records, metrics.__dict__ | {"filter": metrics.filter.as_dict()}, fam
+
+
+def run_fast_dedup(path, target_bytes=4096, *, strategy="adjacency", edits=1,
+                   **kw):
+    from fgumi_tpu.commands.fast_group import FastDedup
+
+    no_umi = kw.get("no_umi", False)
+    s, e = ("identity", 0) if no_umi else (strategy, edits)
+    with BamBatchReader(path, target_bytes=target_bytes) as reader:
+        dd = FastDedup(reader.header, make_assigner(s, e), **kw)
+        chunks = []
+        for batch in reader:
+            chunks.extend(dd.process_batch(batch))
+        chunks.extend(dd.flush())
+    recs = []
+    for blob in chunks:
+        off = 0
+        while off < len(blob):
+            n = int.from_bytes(blob[off:off + 4], "little")
+            recs.append(blob[off + 4:off + 4 + n])
+            off += 4 + n
+        assert off == len(blob)
+    metrics, fam = dd.result()
+    return recs, metrics.__dict__ | {"filter": metrics.filter.as_dict()}, fam
+
+
+def assert_dedup_parity(path, target_bytes=4096, **kw):
+    slow_recs, slow_m, slow_fam = run_slow_dedup(path, **kw)
+    fast_recs, fast_m, fast_fam = run_fast_dedup(path, target_bytes, **kw)
+    assert len(fast_recs) == len(slow_recs)
+    for i, (f, s) in enumerate(zip(fast_recs, slow_recs)):
+        assert f == s, f"record {i}: {RawRecord(f).name} vs {RawRecord(s).name}"
+    slow_m.pop("filter_obj", None)
+    sf, ff = slow_m.pop("filter"), fast_m.pop("filter")
+    slow_m = {k: v for k, v in slow_m.items() if not hasattr(v, "as_dict")}
+    fast_m = {k: v for k, v in fast_m.items() if not hasattr(v, "as_dict")}
+    assert fast_m == slow_m
+    assert ff == sf
+    assert fast_fam == slow_fam
+    return slow_m
+
+
+@pytest.mark.parametrize("strategy", ["identity", "adjacency"])
+@pytest.mark.parametrize("target_bytes", [4096, 700])
+def test_dedup_parity(grouped_input, strategy, target_bytes):
+    m = assert_dedup_parity(grouped_input, target_bytes, strategy=strategy)
+    assert m["duplicate_templates"] > 0
+
+
+def test_dedup_parity_remove_and_unmapped(grouped_input):
+    assert_dedup_parity(grouped_input, remove_duplicates=True)
+    assert_dedup_parity(grouped_input, include_unmapped=True)
+
+
+def test_dedup_parity_no_umi(grouped_input):
+    assert_dedup_parity(grouped_input, no_umi=True)
+
+
+def test_dedup_parity_adversarial(adversarial_input):
+    assert_dedup_parity(adversarial_input, target_bytes=300, min_mapq=20,
+                        min_umi_length=3)
+
+
+def test_dedup_cli_fast_vs_classic(grouped_input, tmp_path):
+    fast = str(tmp_path / "fast.bam")
+    classic = str(tmp_path / "classic.bam")
+    assert main(["dedup", "-i", grouped_input, "-o", fast]) == 0
+    assert main(["dedup", "-i", grouped_input, "-o", classic,
+                 "--classic"]) == 0
+
+    def recs(p):
+        with BamReader(p) as r:
+            return [x.data for x in r]
+
+    assert recs(fast) == recs(classic)

@@ -644,6 +644,9 @@ def _add_group(sub):
     p.add_argument("--allow-unmapped", action="store_true")
     p.add_argument("--family-size-out", default=None,
                    help="optional TSV of family size counts")
+    p.add_argument("--threads", type=int, default=0,
+                   help="reader/writer threads around the batch engine "
+                        "(0/1 = inline)")
     p.add_argument("--classic", action="store_true",
                    help="force the per-template engine (no batch vectorization)")
     p.set_defaults(func=cmd_group)
@@ -690,6 +693,8 @@ def cmd_group(args):
                         raise ValueError(
                             "--no-umi cannot be combined with the paired "
                             "strategy")
+                    from .pipeline import run_stages
+
                     grouper = FastGrouper(
                         reader.header, make_assigner(args.strategy, args.edits),
                         umi_tag=args.raw_tag.encode(),
@@ -699,9 +704,9 @@ def cmd_group(args):
                         min_umi_length=args.min_umi_length,
                         no_umi=args.no_umi,
                         allow_unmapped=args.allow_unmapped)
-                    for batch in reader:
-                        for chunk in grouper.process_batch(batch):
-                            writer.write_serialized(chunk)
+                    run_stages(iter(reader), grouper.process_batch,
+                               writer.write_serialized,
+                               threads=args.threads)
                     for chunk in grouper.flush():
                         writer.write_serialized(chunk)
                     result = grouper.result()
@@ -1658,6 +1663,9 @@ def _add_dedup(sub):
     p.add_argument("-l", "--min-umi-length", type=int, default=None)
     p.add_argument("--no-umi", action="store_true",
                    help="dedup by position only, orientation-agnostic (Picard-like)")
+    p.add_argument("--threads", type=int, default=0,
+                   help="reader/writer threads around the batch engine "
+                        "(0/1 = inline)")
     p.add_argument("--classic", action="store_true",
                    help="force the per-template engine (no batch vectorization)")
     p.set_defaults(func=cmd_dedup)
@@ -1705,6 +1713,8 @@ def cmd_dedup(args):
                     strategy, edits = args.strategy, args.edits
                     if args.no_umi:
                         strategy, edits = "identity", 0
+                    from .pipeline import run_stages
+
                     dd = FastDedup(
                         reader.header, make_assigner(strategy, edits),
                         min_mapq=args.min_map_q,
@@ -1713,9 +1723,9 @@ def cmd_dedup(args):
                         no_umi=args.no_umi,
                         include_unmapped=args.include_unmapped,
                         remove_duplicates=args.remove_duplicates)
-                    for batch in reader:
-                        for chunk in dd.process_batch(batch):
-                            writer.write_serialized(chunk)
+                    run_stages(iter(reader), dd.process_batch,
+                               writer.write_serialized,
+                               threads=args.threads)
                     for chunk in dd.flush():
                         writer.write_serialized(chunk)
                     metrics, family_sizes = dd.result()

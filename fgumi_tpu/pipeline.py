@@ -126,6 +126,16 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
     q_out = queue.Queue(maxsize=queue_items * 2)
     writer_exc = []
     counters = [0, 0, 0]  # read, processed, written
+    stop = threading.Event()  # error path: tell the reader to die promptly
+
+    def put_in(item) -> bool:
+        while not stop.is_set():
+            try:
+                q_in.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
 
     def reader():
         try:
@@ -133,13 +143,14 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
             for item in source_iter:
                 now = time.monotonic()
                 stats.add_busy("read", now - t_last)
-                q_in.put(item)
+                if not put_in(item):
+                    return
                 counters[0] += 1
                 t_last = time.monotonic()
                 stats.add_blocked("read", t_last - now)
-            q_in.put(_DONE)
+            put_in(_DONE)
         except BaseException as e:  # noqa: BLE001 - relayed to caller
-            q_in.put(_Err(e))
+            put_in(_Err(e))
 
     def writer():
         try:
@@ -184,13 +195,17 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
         q_out.put(_DONE)
         wt.join()  # watchdog stays armed while the writer drains
         watchdog.stop()
-        # unblock a reader stuck on a full input queue after an error
-        try:
-            while True:
-                q_in.get_nowait()
-        except queue.Empty:
-            pass
-        rt.join(timeout=1.0)
+        # stop + drain until the reader exits: it re-checks the stop event on
+        # every bounded put, so it cannot re-block and leak (with its open
+        # source) past this join
+        stop.set()
+        while rt.is_alive():
+            try:
+                while True:
+                    q_in.get_nowait()
+            except queue.Empty:
+                pass
+            rt.join(timeout=0.2)
     if writer_exc:
         raise writer_exc[0]
     return stats

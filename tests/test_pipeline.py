@@ -65,3 +65,35 @@ def test_stats_collected():
                stats=stats)
     table = stats.format_table()
     assert "read" in table and "process" in table
+
+
+def test_reader_thread_exits_after_process_error():
+    """A mid-stream processing error must not leak a blocked reader thread
+    (it would hold the input source open past the caller's with-block)."""
+    import threading
+    import time as _time
+
+    from fgumi_tpu.pipeline import run_stages
+
+    before = {t.ident for t in threading.enumerate()}
+
+    def source():
+        for i in range(1000):
+            yield i
+
+    def process(item):
+        if item == 3:
+            raise ValueError("boom")
+        return [item]
+
+    with pytest.raises(ValueError, match="boom"):
+        run_stages(source(), process, lambda x: None, threads=2,
+                   queue_items=2)
+    deadline = _time.monotonic() + 2.0
+    while _time.monotonic() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t.ident not in before and t.name.startswith("fgumi-")]
+        if not leaked:
+            break
+        _time.sleep(0.02)
+    assert not leaked, f"leaked pipeline threads: {leaked}"

@@ -377,11 +377,14 @@ class CodecConsensusCaller:
         tie = both & ~agree & (qa == qb)
 
         raw_base = np.where(b_wins, bb, ba)  # agree/a_wins/tie keep base A
-        raw_qual = np.select(
-            [agree, a_wins, b_wins, tie],
-            [np.minimum(93, qa + qb), np.maximum(MIN_PHRED, qa - qb),
-             np.maximum(MIN_PHRED, qb - qa),
-             np.full(length, MIN_PHRED, np.int32)], 0)
+        # np.where chains, not np.select: select's broadcast machinery
+        # dominated the per-molecule combine cost
+        raw_qual = np.where(
+            agree, np.minimum(93, qa + qb),
+            np.where(a_wins, np.maximum(MIN_PHRED, qa - qb),
+                     np.where(b_wins, np.maximum(MIN_PHRED, qb - qa),
+                              np.where(tie, np.int32(MIN_PHRED),
+                                       np.int32(0)))))
         # min-quality masking inside the duplex region (rs:1185-1190)
         q_masked = both & (raw_qual == MIN_PHRED)
         dup_base = np.where(q_masked, NO_CALL_BASE, raw_base)
@@ -400,16 +403,20 @@ class CodecConsensusCaller:
         a_q2 = qa == MIN_PHRED
         b_q2 = qb == MIN_PHRED
 
-        base = np.select(
-            [both, only_a & a_q2, only_a, only_b & b_q2, only_b],
-            [dup_base, np.full(length, NO_CALL_BASE), ba,
-             np.full(length, NO_CALL_BASE), bb], NO_CALL_BASE)
-        qual = np.select(
-            [both, only_a & ~a_q2, only_b & ~b_q2],
-            [dup_qual, qa, qb], MIN_PHRED)
-        depth = np.select([both, only_a, only_b], [dup_depth, da, db], 0)
-        errors = np.select([both, only_a, only_b],
-                           [dup_err, ea, eb], cap(ea + eb))
+        base = np.where(
+            both, dup_base,
+            np.where(only_a, np.where(a_q2, NO_CALL_BASE, ba),
+                     np.where(only_b, np.where(b_q2, NO_CALL_BASE, bb),
+                              NO_CALL_BASE)))
+        qual = np.where(
+            both, dup_qual,
+            np.where(only_a & ~a_q2, qa,
+                     np.where(only_b & ~b_q2, qb, MIN_PHRED)))
+        depth = np.where(both, dup_depth,
+                         np.where(only_a, da, np.where(only_b, db, 0)))
+        errors = np.where(both, dup_err,
+                          np.where(only_a, ea,
+                                   np.where(only_b, eb, cap(ea + eb))))
 
         # either-strand uppercase-N mask, applied after rawBase math (rs:1253-1260)
         n_mask = (ba == NO_CALL_BASE) | (bb == NO_CALL_BASE)

@@ -40,7 +40,7 @@ platform = jax.devices()[0].platform
 if cmd == "simplex":
     base = ["simplex", "-i", in_bam, "--min-reads", "1", "--threads", threads]
 else:
-    base = ["duplex", "-i", in_bam, "--min-reads", "1"]
+    base = ["duplex", "-i", in_bam, "--min-reads", "1", "--threads", threads]
 t0 = time.monotonic()
 rc = main(base + ["-o", os.path.join(out_dir, "warm.bam")])
 warm_s = time.monotonic() - t0

@@ -48,7 +48,7 @@ def _process_one(data: bytes, config: FilterConfig, reverse_tags: bool,
             "NM/UQ/MD tags consistent")
     if reverse_tags:
         reverse_per_base_tags(buf)
-    rec = RawRecord(bytes(buf))
+    rec = RawRecord(bytes(buf))  # one parse; masking mutates only seq/qual
     duplex = is_duplex_consensus(rec)
 
     # Read-level thresholds on the pre-masking record.
@@ -67,9 +67,10 @@ def _process_one(data: bytes, config: FilterConfig, reverse_tags: bool,
     if duplex:
         masked = mask_duplex_bases(buf, config.cc, config.ab, config.ba,
                                    config.min_base_quality,
-                                   config.require_ss_agreement)
+                                   config.require_ss_agreement, rec=rec)
     else:
-        masked = mask_bases(buf, config.single_strand, config.min_base_quality)
+        masked = mask_bases(buf, config.single_strand,
+                            config.min_base_quality, rec=rec)
 
     if result == PASS:
         result = no_call_check(buf, config.max_no_call_fraction)

@@ -178,8 +178,8 @@ def _seq_qual_view(buf):
 
 
 def _unpack_nibbles(buf, seq_off, l_seq) -> np.ndarray:
-    packed = np.frombuffer(bytes(buf[seq_off:seq_off + (l_seq + 1) // 2]),
-                           dtype=np.uint8)
+    packed = np.frombuffer(buf, dtype=np.uint8, count=(l_seq + 1) // 2,
+                           offset=seq_off)
     nib = np.empty(2 * len(packed), dtype=np.uint8)
     nib[0::2] = packed >> 4
     nib[1::2] = packed & 0xF
@@ -224,7 +224,7 @@ def mean_base_quality_full_length(buf) -> float:
     _, qual_off, l_seq = _seq_qual_view(buf)
     if l_seq == 0:
         return 0.0
-    quals = np.frombuffer(bytes(buf[qual_off:qual_off + l_seq]), dtype=np.uint8)
+    quals = np.frombuffer(buf, dtype=np.uint8, count=l_seq, offset=qual_off)
     return float(quals.sum()) / l_seq
 
 
@@ -234,20 +234,23 @@ def count_no_calls(buf) -> int:
 
 
 def mask_bases(buf: bytearray, t: FilterThresholds,
-               min_base_quality: int | None) -> int:
+               min_base_quality: int | None, rec: RawRecord = None) -> int:
     """Mask simplex consensus bases in place; returns newly-masked count.
 
     Per-base depth/error masking applies only when BOTH cd and ce are present
     (filter.rs:790-794); otherwise only the quality mask applies. Vectorized
-    over the read (no per-base interpreter loop).
+    over the read (no per-base interpreter loop). `rec` may carry the
+    caller's already-parsed view of the same bytes (tag index reuse); the
+    mutation below touches only seq/qual, never the aux region it indexes.
     """
-    rec = RawRecord(bytes(buf))
+    if rec is None:
+        rec = RawRecord(bytes(buf))
     seq_off, qual_off, l_seq = _seq_qual_view(buf)
     if l_seq == 0:
         return 0
     cd = _per_base_padded(rec, b"cd", l_seq)
     ce = _per_base_padded(rec, b"ce", l_seq)
-    quals = np.frombuffer(bytes(buf[qual_off:qual_off + l_seq]), dtype=np.uint8)
+    quals = np.frombuffer(buf, dtype=np.uint8, count=l_seq, offset=qual_off)
     mask = np.zeros(l_seq, dtype=bool)
     if min_base_quality is not None:
         mask |= quals < min_base_quality
@@ -271,11 +274,13 @@ def mask_bases(buf: bytearray, t: FilterThresholds,
 def mask_duplex_bases(buf: bytearray, cc: FilterThresholds,
                       ab: FilterThresholds, ba: FilterThresholds,
                       min_base_quality: int | None,
-                      require_ss_agreement: bool) -> int:
+                      require_ss_agreement: bool,
+                      rec: RawRecord = None) -> int:
     """Mask duplex consensus bases in place; returns newly-masked count
     (filter.rs:804-905). Already-N bases are skipped entirely (neither
     re-masked nor re-counted, and their quals are left untouched)."""
-    rec = RawRecord(bytes(buf))
+    if rec is None:
+        rec = RawRecord(bytes(buf))
     seq_off, qual_off, l_seq = _seq_qual_view(buf)
     if l_seq == 0:
         return 0
@@ -299,7 +304,7 @@ def mask_duplex_bases(buf: bytearray, cc: FilterThresholds,
     total_rate = np.where(total_depth > 0,
                           (ab_errors + ba_errors) / np.maximum(total_depth, 1),
                           0.0)
-    quals = np.frombuffer(bytes(buf[qual_off:qual_off + l_seq]), dtype=np.uint8)
+    quals = np.frombuffer(buf, dtype=np.uint8, count=l_seq, offset=qual_off)
 
     mask = (total_depth < cc.min_reads) | (total_rate > cc.max_base_error_rate)
     mask |= (best_depth < ab.min_reads) | (best_rate > ab.max_base_error_rate)

@@ -39,13 +39,14 @@ class _PendingChunk:
     depth/errors on host, apply thresholds, serialize (SURVEY §7 step 4
     double-buffering: dispatch happens in process_batch, this completes it)."""
 
-    __slots__ = ("fast", "batch", "jobs", "pending")
+    __slots__ = ("fast", "batch", "jobs", "pending", "blocks")
 
     def __init__(self, fast, batch, jobs, pending):
         self.fast = fast
         self.batch = batch
         self.jobs = jobs
         self.pending = pending
+        self.blocks = []  # (job_idxs, bases, quals, depth32, errors32) rows
 
     def resolve(self) -> bytes:
         fast = self.fast
@@ -67,21 +68,21 @@ class _PendingChunk:
                 winner, qual, depth, errors = kernel._finish_segments(
                     packed[d], codes3d[d, :n], quals3d[d, :n], starts_d)
                 self._assign(jlist, winner, qual, depth, errors)
-        return fast._serialize_jobs(self.batch, self.jobs)
+        return fast._serialize_jobs(self.batch, self.jobs, self.blocks)
 
     def _assign(self, idxs, winner, qual, depth, errors):
-        """Thresholds (one vectorized pass) + per-job result slices."""
+        """Thresholds in one vectorized pass; rows are handed to the
+        serializer as whole blocks (addresses computed per block, not per
+        job — job.result stays None for block-backed jobs)."""
         opts = self.fast.caller.options
         bases_b, quals_b = oracle.apply_consensus_thresholds(
             winner, qual, depth, opts.min_reads,
             opts.min_consensus_base_quality)
-        depth32 = depth.astype(np.int32)
-        errors32 = errors.astype(np.int32)
-        for fi, j in enumerate(idxs):
-            job = self.jobs[j]
-            L = job.consensus_len
-            job.result = (bases_b[fi, :L], quals_b[fi, :L],
-                          depth32[fi, :L], errors32[fi, :L])
+        self.blocks.append((np.asarray(idxs, dtype=np.int64),
+                            np.ascontiguousarray(bases_b),
+                            np.ascontiguousarray(quals_b),
+                            np.ascontiguousarray(depth.astype(np.int32)),
+                            np.ascontiguousarray(errors.astype(np.int32))))
 
 
 class _FastJob:
@@ -696,9 +697,11 @@ class FastSimplexCaller:
 
     # ------------------------------------------------------------------ output
 
-    def _serialize_jobs(self, batch, jobs) -> bytes:
+    def _serialize_jobs(self, batch, jobs, blocks=()) -> bytes:
         """Native batch serializer: all jobs -> one block_size-prefixed wire
-        blob (fgumi_build_consensus_records; _build_record semantics)."""
+        blob (fgumi_build_consensus_records; _build_record semantics).
+        `blocks` carries kernel-result rows for multi-read jobs (addresses
+        computed per block); host-path jobs carry per-job result arrays."""
         caller = self.caller
         opts = caller.options
         J = len(jobs)
@@ -717,20 +720,29 @@ class FastSimplexCaller:
         buf = batch.buf
         surv_counts = np.empty(J, dtype=np.int64)
         for j, job in enumerate(jobs):
-            b, q, d, e = job.result
-            keep_alive.append(job.result)
             lens[j] = job.consensus_len
             flags[j] = _TYPE_FLAGS[job.read_type]
-            code_addr[j] = b.ctypes.data
-            qual_addr[j] = q.ctypes.data
-            depth_addr[j] = d.ctypes.data
-            err_addr[j] = e.ctypes.data
+            res = job.result
+            if res is not None:  # single-read / host-path arrays
+                b, q, d, e = res
+                keep_alive.append(res)
+                code_addr[j] = b.ctypes.data
+                qual_addr[j] = q.ctypes.data
+                depth_addr[j] = d.ctypes.data
+                err_addr[j] = e.ctypes.data
             mi = job.umi_bytes
             mi_parts.append(mi)
             mi_addr[j] = m_off
             mi_len[j] = len(mi)
             m_off += len(mi)
             surv_counts[j] = len(job.surviving_idx)
+        for idxs, b, q, d, e in blocks:
+            keep_alive.append((b, q, d, e))
+            fi = np.arange(len(idxs), dtype=np.int64)
+            code_addr[idxs] = b.ctypes.data + fi * b.shape[1]
+            qual_addr[idxs] = q.ctypes.data + fi * q.shape[1]
+            depth_addr[idxs] = d.ctypes.data + fi * (4 * d.shape[1])
+            err_addr[idxs] = e.ctypes.data + fi * (4 * e.shape[1])
         mi_blob = np.frombuffer(b"".join(mi_parts) or b"\x00", dtype=np.uint8)
         mi_addr += mi_blob.ctypes.data
 

@@ -96,11 +96,12 @@ class BamHeader:
 class RawRecord:
     """A single BAM record's wire bytes (without the leading block_size)."""
 
-    __slots__ = ("data", "_tag_idx")
+    __slots__ = ("data", "_tag_idx", "_aux")
 
     def __init__(self, data: bytes):
         self.data = data
         self._tag_idx = None  # lazy {tag: (typ, value_off)} built on first lookup
+        self._aux = None      # lazy cached aux-region offset
 
     # --- fixed-offset fields (fields.rs:7-24) ---
     @property
@@ -158,7 +159,12 @@ class RawRecord:
         return self._seq_off() + (self.l_seq + 1) // 2
 
     def _aux_off(self) -> int:
-        return self._qual_off() + self.l_seq
+        # cached: tag scans and record edits probe this repeatedly, and the
+        # record's bytes are immutable
+        aux = self._aux
+        if aux is None:
+            aux = self._aux = self._qual_off() + self.l_seq
+        return aux
 
     def cigar(self):
         """[(op_char, length)] decoded CIGAR."""

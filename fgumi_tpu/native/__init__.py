@@ -62,6 +62,22 @@ def get_lib():
             log.debug("native library load failed: %s", e)
             _lib_failed = True
             return None
+        # stale-.so guard: a cached build whose mtime ties the source (e.g.
+        # archive extraction) passes the rebuild check but may lack newer
+        # symbols; probe the newest export and rebuild once if absent
+        if not hasattr(lib, "fgumi_zlib_compress"):
+            if not _build():
+                _lib_failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(_SO_PATH)
+            except OSError as e:
+                log.debug("native library reload failed: %s", e)
+                _lib_failed = True
+                return None
+            if not hasattr(lib, "fgumi_zlib_compress"):
+                _lib_failed = True
+                return None
         lib.fgumi_bgzf_decompress.restype = ctypes.c_long
         lib.fgumi_bgzf_decompress.argtypes = [
             ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long,
@@ -70,6 +86,13 @@ def get_lib():
         lib.fgumi_bgzf_compress_block.argtypes = [
             ctypes.c_char_p, ctypes.c_long, ctypes.c_int, ctypes.c_char_p,
             ctypes.c_long]
+        lib.fgumi_zlib_compress.restype = ctypes.c_long
+        lib.fgumi_zlib_compress.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_long]
+        lib.fgumi_zlib_decompress.restype = ctypes.c_long
+        lib.fgumi_zlib_decompress.argtypes = [
+            ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long]
         lib.fgumi_find_record_boundaries.restype = ctypes.c_long
         lib.fgumi_find_record_boundaries.argtypes = [
             ctypes.c_char_p, ctypes.c_long,
@@ -169,6 +192,31 @@ def bgzf_decompress(data, out_cap: int = None):
     if produced < 0:
         raise ValueError("malformed BGZF block")
     return ctypes.string_at(out, produced), consumed.value
+
+
+def zlib_compress(data: bytes, level: int = 1):
+    """zlib-format compression via libdeflate, or None (fallback to zlib)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    cap = len(data) + len(data) // 8 + 256
+    out = ctypes.create_string_buffer(cap)
+    n = lib.fgumi_zlib_compress(bytes(data), len(data), level, out, cap)
+    if n < 0:
+        raise ValueError("zlib compression failed")
+    return out.raw[:n]
+
+
+def zlib_decompress(data: bytes, out_size: int):
+    """Decompress a zlib-format buffer of known output size, or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    out = ctypes.create_string_buffer(out_size)
+    n = lib.fgumi_zlib_decompress(bytes(data), len(data), out, out_size)
+    if n < 0:
+        raise ValueError("malformed zlib frame")
+    return out.raw[:n]
 
 
 def bgzf_compress_block(data: bytes, level: int = 1):

@@ -124,6 +124,26 @@ long fgumi_bgzf_decompress(const uint8_t* src, long src_len, uint8_t* dst,
   return out_off;
 }
 
+// zlib-format whole-buffer codec (sort spill frames; the reference uses
+// zstd-1 for the same role, codec.rs:7-8 — libdeflate level 1 is the
+// closest native analog available here, ~2-4x Python zlib).
+long fgumi_zlib_compress(const uint8_t* src, long src_len, int level,
+                         uint8_t* dst, long dst_cap) {
+  const size_t n = libdeflate_zlib_compress(
+      compressor(level), src, static_cast<size_t>(src_len), dst,
+      static_cast<size_t>(dst_cap));
+  return n == 0 ? -1 : static_cast<long>(n);
+}
+
+long fgumi_zlib_decompress(const uint8_t* src, long src_len, uint8_t* dst,
+                           long dst_cap) {
+  size_t actual = 0;
+  const libdeflate_result r = libdeflate_zlib_decompress(
+      decompressor(), src, static_cast<size_t>(src_len), dst,
+      static_cast<size_t>(dst_cap), &actual);
+  return r == LIBDEFLATE_SUCCESS ? static_cast<long>(actual) : -1;
+}
+
 // Compress src (<= 0xFF00 bytes) into one complete BGZF block at dst.
 // Returns the block size, or -1 on failure / insufficient dst capacity.
 long fgumi_bgzf_compress_block(const uint8_t* src, long src_len, int level,

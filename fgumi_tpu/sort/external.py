@@ -169,12 +169,15 @@ _ENTRY_OVERHEAD = 120
 
 
 class _SpillRun:
-    """One sorted run on disk: raw length-prefixed frames, deflate-1.
+    """One sorted run on disk: raw length-prefixed frames, zlib-format
+    deflate-1 (native libdeflate when available — the closest analog of the
+    reference's zstd-1 spill codec, fgumi-sort/src/codec.rs:7-8).
 
     Frame payload is a sequence of [<HQI> header (klen, ordinal, rlen) | key |
     record] — keys are the packed memcmp-ordered byte strings of sort/keys.py,
     persisted verbatim so the merge phase never re-extracts or unpickles
     (the reference serializes keys into spill runs the same way, keys.rs:57).
+    Frame header: <II> (compressed size, uncompressed size).
     """
 
     def __init__(self, tmp_dir):
@@ -195,18 +198,27 @@ class _SpillRun:
         self._f.close()
 
     def _write_frame(self, frame):
-        payload = zlib.compress(bytes(frame), 1)
-        self._f.write(struct.pack("<I", len(payload)))
+        from ..native import zlib_compress
+
+        payload = zlib_compress(bytes(frame), 1)
+        if payload is None:
+            payload = zlib.compress(frame, 1)
+        self._f.write(struct.pack("<II", len(payload), len(frame)))
         self._f.write(payload)
 
     def __iter__(self):
+        from ..native import zlib_decompress
+
         with open(self.path, "rb") as f:
             while True:
-                size_b = f.read(4)
-                if len(size_b) < 4:
+                size_b = f.read(8)
+                if len(size_b) < 8:
                     break
-                (size,) = struct.unpack("<I", size_b)
-                frame = zlib.decompress(f.read(size))
+                size, usize = struct.unpack("<II", size_b)
+                payload = f.read(size)
+                frame = zlib_decompress(payload, usize)
+                if frame is None:
+                    frame = zlib.decompress(payload)
                 off = 0
                 end = len(frame)
                 while off < end:

@@ -1138,10 +1138,16 @@ def cmd_zipper(args):
     tag_info = TagInfo.from_options(
         remove=args.tags_to_remove, reverse=args.tags_to_reverse,
         revcomp=args.tags_to_revcomp)
+    from .native import batch as nbat
+
+    if nbat.available():
+        from .io.batch_reader import BatchedRecordReader as _Reader
+    else:
+        _Reader = BamReader
     t0 = time.monotonic()
     try:
-        with BamReader(args.input) as mapped, \
-                BamReader(args.unmapped) as unmapped:
+        with _Reader(args.input) as mapped, \
+                _Reader(args.unmapped) as unmapped:
             for name, r in (("mapped", mapped), ("unmapped", unmapped)):
                 if not is_query_grouped(r.header.text):
                     log.error(

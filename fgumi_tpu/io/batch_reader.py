@@ -87,6 +87,33 @@ class RecordBatch:
         return [self.raw_record(int(i)) for i in indices]
 
 
+class BatchedRecordReader:
+    """BamReader-compatible record iterator backed by BamBatchReader.
+
+    Yields RawRecords, but the decompress/boundary-scan path runs natively
+    per batch instead of per record — a drop-in accelerator for streaming
+    commands that still consume records one at a time (zipper, merge, ...).
+    """
+
+    def __init__(self, path_or_obj, target_bytes: int = 8 << 20):
+        self._r = BamBatchReader(path_or_obj, target_bytes=target_bytes)
+        self.header = self._r.header
+
+    def __iter__(self):
+        for batch in self._r:
+            for i in range(batch.n):
+                yield batch.raw_record(i)
+
+    def close(self):
+        self._r.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
 class BamBatchReader:
     """Yields RecordBatch objects of ~target_bytes decompressed payload."""
 

@@ -1201,6 +1201,8 @@ def _add_filter(sub):
     p.add_argument("-r", "--ref", default=None,
                    help="reference FASTA: regenerate NM/UQ/MD after masking "
                         "(required for mapped input)")
+    p.add_argument("--classic", action="store_true",
+                   help="force the per-record engine (no batch vectorization)")
     p.set_defaults(func=cmd_filter)
 
 
@@ -1221,36 +1223,85 @@ def cmd_filter(args):
     except ValueError as e:
         log.error("%s", e)
         return 2
+    from .native import batch as nbat
+
+    use_fast = (nbat.available() and not args.ref
+                and not args.reverse_per_base_tags
+                and not args.require_single_strand_agreement
+                and not getattr(args, "classic", False))
     t0 = time.monotonic()
     try:
         reference = None
         if args.ref:
             from .core.reference import ReferenceReader
             reference = ReferenceReader(args.ref)
-        with BamReader(args.input) as reader:
-            from .core.template import is_query_grouped
-            # Template filtering needs mates adjacent; coordinate-sorted input
-            # would silently corrupt the both-primaries-pass rule
-            # (filter.rs:343-349 require_query_grouped).
-            if not is_query_grouped(reader.header.text):
-                log.error(
-                    "filter requires queryname-sorted or query-grouped input "
-                    "(@HD must advertise SO:queryname or GO:query); run "
-                    "`fgumi-tpu sort --order queryname` first")
-                return 2
-            out_header = _header_with_pg(reader.header, " ".join(sys.argv))
-            rejects = (BamWriter(args.rejects, out_header)
-                       if args.rejects else None)
+
+        _SORT_ERR = (
+            "filter requires queryname-sorted or query-grouped input "
+            "(@HD must advertise SO:queryname or GO:query); run "
+            "`fgumi-tpu sort --order queryname` first")
+
+        def classic_run():
+            with BamReader(args.input) as reader:
+                from .core.template import is_query_grouped
+                if not is_query_grouped(reader.header.text):
+                    return None
+                out_header = _header_with_pg(reader.header,
+                                             " ".join(sys.argv))
+                rejects = (BamWriter(args.rejects, out_header)
+                           if args.rejects else None)
+                try:
+                    with BamWriter(args.output, out_header) as writer:
+                        return run_filter(
+                            reader, writer, config,
+                            filter_by_template=args.filter_by_template,
+                            reverse_per_base=args.reverse_per_base_tags,
+                            rejects_writer=rejects, reference=reference)
+                finally:
+                    if rejects is not None:
+                        rejects.close()
+
+        stats = None
+        if use_fast:
+            from .commands.fast_filter import FastFilter, _OddSubtype
+            from .io.batch_reader import BamBatchReader
+
             try:
-                with BamWriter(args.output, out_header) as writer:
-                    stats = run_filter(
-                        reader, writer, config,
-                        filter_by_template=args.filter_by_template,
-                        reverse_per_base=args.reverse_per_base_tags,
-                        rejects_writer=rejects, reference=reference)
-            finally:
-                if rejects is not None:
-                    rejects.close()
+                with BamBatchReader(args.input) as reader:
+                    from .core.template import is_query_grouped
+                    # Template filtering needs mates adjacent
+                    # (filter.rs:343-349 require_query_grouped).
+                    if not is_query_grouped(reader.header.text):
+                        log.error("%s", _SORT_ERR)
+                        return 2
+                    out_header = _header_with_pg(reader.header,
+                                                 " ".join(sys.argv))
+                    rejects = (BamWriter(args.rejects, out_header)
+                               if args.rejects else None)
+                    try:
+                        with BamWriter(args.output, out_header) as writer:
+                            ff = FastFilter(
+                                config,
+                                filter_by_template=args.filter_by_template)
+                            emit_rej = (rejects.write_serialized
+                                        if rejects else None)
+                            for batch in reader:
+                                ff.process_batch(
+                                    batch, writer.write_serialized, emit_rej)
+                            ff.flush(writer.write_serialized, emit_rej)
+                            stats = ff.stats
+                    finally:
+                        if rejects is not None:
+                            rejects.close()
+            except _OddSubtype:
+                log.info("filter: unexpected per-base tag subtype; "
+                         "re-running with the classic engine")
+                stats = None
+        if stats is None:
+            stats = classic_run()
+            if stats is None:
+                log.error("%s", _SORT_ERR)
+                return 2
     except (ValueError, OSError, KeyError) as e:
         log.error("%s", e)
         return 2

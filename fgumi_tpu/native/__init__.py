@@ -152,6 +152,13 @@ def get_lib():
         lib.fgumi_qual_scores.restype = None
         lib.fgumi_qual_scores.argtypes = (
             [p, p, p, ctypes.c_long, ctypes.c_int, ctypes.c_long, p])
+        lib.fgumi_gather_u16_arrays.restype = None
+        lib.fgumi_gather_u16_arrays.argtypes = (
+            [p, p, ctypes.c_long, ctypes.c_long, p, p])
+        lib.fgumi_apply_masks.restype = None
+        lib.fgumi_apply_masks.argtypes = (
+            [p, p, p, p, ctypes.c_long, p, ctypes.c_long, ctypes.c_int,
+             p, p])
         lib.fgumi_rx_unanimous.restype = None
         lib.fgumi_rx_unanimous.argtypes = [p, p, p, p, ctypes.c_long, p, p]
         lib.fgumi_extract_records.restype = ctypes.c_long

@@ -406,6 +406,43 @@ def qual_scores(batch, min_q: int, cap: int):
     return out
 
 
+def gather_u16_arrays(buf: np.ndarray, val_off, L: int):
+    """Dense (n, L) uint16 matrix from B:s/B:S tag values (zero-padded).
+
+    Returns (values, counts): counts -1 = tag absent, -2 = non-16-bit
+    subtype (caller reroutes that record).
+    """
+    lib = get_lib()
+    n = len(val_off)
+    out = np.empty((n, L), dtype=np.uint16)
+    counts = np.empty(n, dtype=np.int32)
+    val_off = np.ascontiguousarray(val_off, np.int64)
+    lib.fgumi_gather_u16_arrays(_addr(buf), _addr(val_off), n, L, _addr(out),
+                                _addr(counts))
+    return out, counts
+
+
+def apply_masks(batch, rows, mask: np.ndarray, skip_existing_n: bool):
+    """In-place N/Q2 masking of `rows`' seq/qual regions.
+
+    mask: (len(rows), L) uint8 over each record's first l_seq positions.
+    Returns (newly_masked int32[k], n_after int32[k]).
+    """
+    lib = get_lib()
+    rows = np.ascontiguousarray(rows, np.int64)
+    k = len(rows)
+    mask = np.ascontiguousarray(mask, np.uint8)
+    seq_off = np.ascontiguousarray(batch.seq_off[rows])
+    qual_off = np.ascontiguousarray(batch.qual_off[rows])
+    l_seq = np.ascontiguousarray(batch.l_seq[rows])
+    newly = np.empty(k, dtype=np.int32)
+    n_after = np.empty(k, dtype=np.int32)
+    lib.fgumi_apply_masks(_addr(batch.buf), _addr(seq_off), _addr(qual_off),
+                          _addr(l_seq), k, _addr(mask), mask.shape[1],
+                          int(skip_existing_n), _addr(newly), _addr(n_after))
+    return newly, n_after
+
+
 def hash_ranges(buf: np.ndarray, off, length):
     """FNV-1a 64-bit hash per byte range (off < 0 -> 0)."""
     lib = get_lib()

@@ -985,6 +985,70 @@ long fgumi_natural_name_keys(const uint8_t* buf, const int64_t* data_off,
   return 0;
 }
 
+// Gather B:s/B:S per-base tag arrays into a dense (n, L) uint16 matrix,
+// zero-padded/truncated to L (consensus/filter.py::_per_base_padded
+// semantics). val_off points at the B-tag value (subtype byte); -1 or a
+// non-16-bit subtype yields count -1 (caller falls back / treats absent).
+void fgumi_gather_u16_arrays(const uint8_t* buf, const int64_t* val_off,
+                             long n, long L, uint16_t* out,
+                             int32_t* out_count) {
+  std::memset(out, 0, static_cast<size_t>(n) * L * 2);
+  for (long i = 0; i < n; ++i) {
+    if (val_off[i] < 0) {
+      out_count[i] = -1;
+      continue;
+    }
+    const uint8_t* p = buf + val_off[i];
+    const uint8_t sub = p[0];
+    if (sub != 's' && sub != 'S') {
+      out_count[i] = -2;  // unexpected subtype: caller reroutes
+      continue;
+    }
+    const uint32_t count = read_u32(p + 1);
+    const long take = static_cast<long>(count) < L ? count : L;
+    uint16_t* row = out + i * L;
+    for (long k = 0; k < take; ++k) {
+      row[k] = static_cast<uint16_t>(p[5 + 2 * k] | (p[6 + 2 * k] << 8));
+    }
+    out_count[i] = static_cast<int32_t>(count);
+  }
+}
+
+// Apply per-record base masks in place: masked positions become N (nibble
+// 15) with quality 2. mask is a dense (n, L) uint8 matrix over each
+// record's first l_seq positions. skip_existing_n=1 skips already-N
+// positions entirely (duplex semantics: no re-mask, quals untouched);
+// 0 re-writes quals on already-N positions too (simplex mask_bases).
+// newly[i] = newly-masked (previously non-N) count; n_after[i] = total N
+// count post-mask (the no-call check input).
+void fgumi_apply_masks(uint8_t* buf, const int64_t* seq_off,
+                       const int64_t* qual_off, const int32_t* l_seq, long n,
+                       const uint8_t* mask, long L, int skip_existing_n,
+                       int32_t* newly, int32_t* n_after) {
+  for (long i = 0; i < n; ++i) {
+    uint8_t* seq = buf + seq_off[i];
+    uint8_t* quals = buf + qual_off[i];
+    const uint8_t* mrow = mask + i * L;
+    const int32_t len = l_seq[i];
+    int32_t fresh = 0, total_n = 0;
+    for (int32_t k = 0; k < len; ++k) {
+      const int shift = (k & 1) ? 0 : 4;
+      uint8_t nib = (seq[k >> 1] >> shift) & 0xF;
+      const bool was_n = nib == 15;
+      if (mrow[k] && !(skip_existing_n && was_n)) {
+        if (!was_n) ++fresh;
+        seq[k >> 1] = static_cast<uint8_t>(
+            (seq[k >> 1] & (0xF << ((k & 1) ? 4 : 0))) | (15u << shift));
+        quals[k] = 2;
+        nib = 15;
+      }
+      total_n += nib == 15;
+    }
+    newly[i] = fresh;
+    n_after[i] = total_n;
+  }
+}
+
 // Batch byte-range equality within one buffer: out[i] = 1 iff both ranges
 // are present (offset >= 0), equal length, and byte-identical. Used for
 // read-name pair checks without per-record Python slicing.

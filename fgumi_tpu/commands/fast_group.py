@@ -24,10 +24,33 @@ from ..io.bam import (FLAG_FIRST, FLAG_LAST, FLAG_MATE_UNMAPPED, FLAG_PAIRED,
                       FLAG_QC_FAIL, FLAG_REVERSE, FLAG_SECONDARY,
                       FLAG_SUPPLEMENTARY, FLAG_UNMAPPED)
 from ..native import batch as nb
-from .group import (FilterMetrics, append_mi_tag, assign_group,
-                    filter_template)
+from .group import (FilterMetrics, append_mi_tag, assign_group, extract_umi,
+                    filter_template, pair_orientation)
 
 _ACCEPT, _POOR, _NONPF, _NS, _SHORT = 0, 1, 2, 3, 4
+
+
+class _PySeg:
+    """Carried-group segment of python Templates (tail merges, weird UMIs);
+    filtered and tallied at group closure."""
+
+    __slots__ = ("templates",)
+
+    def __init__(self, templates):
+        self.templates = templates
+
+
+class _ArrSeg:
+    """Carried-group segment backed by a retained RecordBatch: templates
+    were filtered/tallied at batch time; closure only assigns + rewrites."""
+
+    __slots__ = ("batch", "umis", "okeys", "out_rows")
+
+    def __init__(self, batch, umis, okeys, out_rows):
+        self.batch = batch
+        self.umis = umis        # list[str], kept templates in order
+        self.okeys = okeys      # list[orientation key | None]
+        self.out_rows = out_rows  # list[list[int]] primary rows per template
 
 
 class FastGrouper:
@@ -101,12 +124,6 @@ class FastGrouper:
         self.position_group_sizes[pg] = \
             self.position_group_sizes.get(pg, 0) + 1
 
-    def _flush_carry(self):
-        if not self._carry:
-            return []
-        templates, self._carry, self._carry_key = self._carry, [], None
-        return self._emit_slow_group(templates)
-
     def _resolve_tail(self):
         """The held-back template is now known complete: join the open group
         or close it and start a new one."""
@@ -115,11 +132,92 @@ class FastGrouper:
         tail, self._tail = self._tail, None
         tk = self._template_key(tail)
         if self._carry and tk == self._carry_key:
-            self._carry.append(tail)
+            self._carry.append(_PySeg([tail]))
             return []
         out = self._flush_carry()
-        self._carry = [tail]
+        self._carry = [_PySeg([tail])]
         self._carry_key = tk
+        return out
+
+    def _flush_carry(self):
+        """Close the open position group: one assignment over every carried
+        segment's templates, then per-segment emission (native rewrite for
+        array segments). Groups spanning many batches — the degenerate
+        all-unmapped single-group input the reference's parallel assigners
+        exist for (group.rs:366-498) — stay vectorized end to end."""
+        segs, self._carry, self._carry_key = self._carry, [], None
+        if not segs:
+            return []
+        # per-template entries in stream order: (umi, okey, emitter info)
+        umis = []
+        okeys = []
+        emit_plan = []  # per seg: ("arr", seg) | ("py", kept templates)
+        m = self.metrics
+        for seg in segs:
+            if isinstance(seg, _PySeg):
+                kept = [t for t in seg.templates
+                        if filter_template(
+                            t, umi_tag=self.umi_tag, min_mapq=self.min_mapq,
+                            include_non_pf=self.include_non_pf,
+                            min_umi_length=self.min_umi_length,
+                            no_umi=self.no_umi,
+                            allow_unmapped=self.allow_unmapped, metrics=m)]
+                m.accepted += sum(len(t.primary_records()) for t in kept)
+                for t in kept:
+                    if self.no_umi:
+                        umis.append("")
+                    else:
+                        umis.append(extract_umi(t, self.umi_tag,
+                                                self.assigner))
+                    okeys.append(pair_orientation(t)
+                                 if self.assigner.split_by_orientation()
+                                 else None)
+                emit_plan.append(("py", kept))
+            else:
+                umis.extend(seg.umis)
+                okeys.extend(seg.okeys)
+                emit_plan.append(("arr", seg))
+        total = len(umis)
+        if total == 0:
+            return []
+
+        # orientation subgrouping + truncation + assignment (assign_group)
+        rendered = [mi.render() for mi in self._assign_umis(umis, okeys)]
+
+        sizes = {}
+        for r in rendered:
+            sizes[r] = sizes.get(r, 0) + 1
+        for size in sizes.values():
+            self.family_sizes[size] = self.family_sizes.get(size, 0) + 1
+        self.position_group_sizes[total] = \
+            self.position_group_sizes.get(total, 0) + 1
+
+        out = []
+        pos = 0
+        for plan in emit_plan:
+            if plan[0] == "py":
+                blob = bytearray()
+                for t in plan[1]:
+                    mi = rendered[pos]
+                    pos += 1
+                    for rec in t.primary_records():
+                        data = append_mi_tag(rec, mi, self.assigned_tag)
+                        blob += len(data).to_bytes(4, "little") + data
+                        self.records_out += 1
+                if blob:
+                    out.append(bytes(blob))
+            else:
+                seg = plan[1]
+                k = len(seg.umis)
+                rows = []
+                values = []
+                for j in range(k):
+                    mi_b = rendered[pos].encode()
+                    pos += 1
+                    for r in seg.out_rows[j]:
+                        rows.append(r)
+                        values.append(mi_b)
+                out.extend(self._flush_pending(seg.batch, rows, values))
         return out
 
     def flush(self):
@@ -174,8 +272,8 @@ class FastGrouper:
             run_end = t0 + 1
             while run_end < nC and self._key_eq(keys, run_end - 1, run_end):
                 run_end += 1
-            for t in range(t0, run_end):
-                self._carry.append(self._materialize(batch, tbounds, t))
+            self._defer_templates(batch, tbounds,
+                                  np.arange(t0, run_end, dtype=np.int64))
             t0 = run_end
         if self._carry and t0 < nC:
             out.extend(self._flush_carry())  # a differing template follows
@@ -190,13 +288,115 @@ class FastGrouper:
                                                 gb[:-1]))
             last_start = gb[-2]
             assert not self._carry
-            for t in range(last_start, nC):
-                self._carry.append(self._materialize(batch, tbounds, t))
+            self._defer_templates(batch, tbounds,
+                                  np.arange(last_start, nC, dtype=np.int64))
             self._carry_key = self._python_key(batch, tbounds, keys,
                                                last_start)
 
         self._tail = self._materialize(batch, tbounds, nT - 1)
         return out
+
+    def _defer_templates(self, batch, tbounds, ts):
+        """Append templates of the open group to the carry: filter + tally
+        now (vectorized), carry only the kept templates' UMI strings and
+        output rows; non-ASCII-UMI templates carry as python Templates,
+        interleaved in stream order (MI numbering is order-sensitive)."""
+        if not len(ts):
+            return
+        cat, weird = self._filter_codes_cached(batch, tbounds)
+        cat, weird = cat[ts], weird[ts]
+        m = self.metrics
+        n_prim = np.zeros(len(ts), dtype=np.int64)
+        for sel in (self._r1_of, self._r2_of, self._fr_of):
+            n_prim += sel[ts] >= 0
+        ok = ~weird
+        m.total_templates += int(n_prim[ok].sum())
+        for code, attr in ((_POOR, "poor_alignment"), (_NONPF, "non_pf"),
+                           (_NS, "ns_in_umi"), (_SHORT, "umi_too_short")):
+            c = int(n_prim[ok & (cat == code)].sum())
+            if c:
+                setattr(m, attr, getattr(m, attr) + c)
+        keep = ok & (cat == _ACCEPT)
+        m.accepted += int(n_prim[keep].sum())
+
+        def flush_run(run):
+            if not run:
+                return
+            kept_t = np.asarray(run, dtype=np.int64)
+            umis, okeys = self._umi_strings(batch, kept_t)
+            out_rows = [[int(sel[t]) for sel in (self._fr_of, self._r1_of,
+                                                 self._r2_of) if sel[t] >= 0]
+                        for t in kept_t]
+            self._carry.append(_ArrSeg(batch, umis, okeys, out_rows))
+
+        run = []
+        for li, t in enumerate(ts):
+            if weird[li]:
+                flush_run(run)
+                run = []
+                self._carry.append(
+                    _PySeg([self._materialize(batch, tbounds, int(t))]))
+            elif keep[li]:
+                run.append(int(t))
+        flush_run(run)
+
+    def _filter_codes_cached(self, batch, tbounds):
+        """Full-batch filter categories, computed once per batch (both the
+        group processor and the defer path consume slices)."""
+        if getattr(self, "_fc_batch", None) is not batch:
+            nT = len(tbounds) - 1
+            self._fc = self._filter_codes(batch, tbounds, nT, 0, nT)
+            self._fc_batch = batch
+        return self._fc
+
+    def _umi_strings(self, batch, kept_t):
+        """(umis, okeys) for kept templates: the strings assign_group would
+        hand the assigner (uppercased; paired-prefix applied), plus the
+        orientation subgroup key (None for the paired strategy)."""
+        assigner = self.assigner
+        uo, ul, _ = batch.tag_locs_str(self.umi_tag)
+        buf = batch.buf
+        flag = batch.flag
+
+        def raw_umi(t):
+            r = self._r1_of[t] if self._r1_of[t] >= 0 else (
+                self._fr_of[t] if self._fr_of[t] >= 0 else self._r2_of[t])
+            return buf[uo[r]:uo[r] + ul[r]].tobytes().decode().upper()
+
+        umis = []
+        okeys = []
+        if assigner.split_by_orientation():
+            for t in kept_t:
+                umis.append("" if self.no_umi else raw_umi(t))
+                r1, r2 = self._r1_of[t], self._r2_of[t]
+                okeys.append((r1 < 0 or not flag[r1] & FLAG_REVERSE,
+                              r2 < 0 or not flag[r2] & FLAG_REVERSE))
+            return umis, okeys
+        u5 = self._u5_cache(batch)
+        lo_p, hi_p = assigner.lower_prefix, assigner.higher_prefix
+        for t in kept_t:
+            umi = raw_umi(t)
+            parts = umi.split("-")
+            if len(parts) != 2:
+                raise ValueError(
+                    "Paired strategy used but UMI did not contain 2 segments "
+                    f"delimited by '-': {umi}")
+            r1, r2 = self._r1_of[t], self._r2_of[t]
+            if r1 >= 0 and r2 >= 0:
+                if batch.ref_id[r1] != batch.ref_id[r2]:
+                    r1_earlier = batch.ref_id[r1] < batch.ref_id[r2]
+                elif u5[r1] != u5[r2]:
+                    r1_earlier = u5[r1] < u5[r2]
+                else:
+                    r1_earlier = not flag[r1] & FLAG_REVERSE
+            else:
+                r1_earlier = True
+            if r1_earlier:
+                umis.append(f"{lo_p}:{parts[0]}-{hi_p}:{parts[1]}")
+            else:
+                umis.append(f"{hi_p}:{parts[0]}-{lo_p}:{parts[1]}")
+            okeys.append(None)
+        return umis, okeys
 
     def _materialize(self, batch, tbounds, t):
         return classify(batch.raw_records(
@@ -415,8 +615,8 @@ class FastGrouper:
         complete groups gb[0]..gb[-1]."""
         m = self.metrics
         t_lo, t_hi = gb[0], gb[-1]
-        cat, weird = self._filter_codes(batch, tbounds, len(tbounds) - 1,
-                                        t_lo, t_hi)
+        cat, weird = self._filter_codes_cached(batch, tbounds)
+        cat, weird = cat[t_lo:t_hi], weird[t_lo:t_hi]
         sizes_prim = np.zeros(t_hi - t_lo, dtype=np.int64)
         for sel in (self._r1_of, self._r2_of, self._fr_of):
             sizes_prim += sel[t_lo:t_hi] >= 0
@@ -497,63 +697,26 @@ class FastGrouper:
     def _assign_light(self, batch, kept_t):
         """UMI extraction + strategy assignment for one group's kept
         templates; returns MoleculeIds in template order."""
+        umis, okeys = self._umi_strings(batch, kept_t)
+        return self._assign_umis(umis, okeys)
+
+    def _assign_umis(self, umis, okeys):
+        """assign_group's subgroup/truncate/assign tail over prepared UMI
+        strings; returns MoleculeIds in entry order."""
         assigner = self.assigner
-        uo, ul, _ = batch.tag_locs_str(self.umi_tag)
-        buf = batch.buf
-
-        def umi_of(t):
-            r = self._r1_of[t] if self._r1_of[t] >= 0 else (
-                self._fr_of[t] if self._fr_of[t] >= 0 else self._r2_of[t])
-            return buf[uo[r]:uo[r] + ul[r]].tobytes().decode().upper()
-
-        if assigner.split_by_orientation():
-            # orientation subgroups, ordered by (r1_pos, r2_pos) tuple
-            flag = batch.flag
-            subgroups = {}
-            for k, t in enumerate(kept_t):
-                r1, r2 = self._r1_of[t], self._r2_of[t]
-                r1_pos = r1 < 0 or not flag[r1] & FLAG_REVERSE
-                r2_pos = r2 < 0 or not flag[r2] & FLAG_REVERSE
-                subgroups.setdefault((r1_pos, r2_pos), []).append(k)
-            mids = [None] * len(kept_t)
-            for _, idxs in sorted(subgroups.items()):
-                if self.no_umi:
-                    umis = [""] * len(idxs)
-                else:
-                    umis = [umi_of(kept_t[k]) for k in idxs]
-                    umis = self._truncate(umis)
-                for k, mi in zip(idxs, assigner.assign(umis)):
-                    mids[k] = mi
-            return mids
-
-        # paired strategy: orientation prefixes by genomic order of r1/r2
-        u5 = self._u5_cache(batch)
-        flag = batch.flag
-        lo_p, hi_p = assigner.lower_prefix, assigner.higher_prefix
-        umis = []
-        for t in kept_t:
-            umi = umi_of(t)
-            parts = umi.split("-")
-            if len(parts) != 2:
-                raise ValueError(
-                    "Paired strategy used but UMI did not contain 2 segments "
-                    f"delimited by '-': {umi}")
-            r1, r2 = self._r1_of[t], self._r2_of[t]
-            if r1 >= 0 and r2 >= 0:
-                if batch.ref_id[r1] != batch.ref_id[r2]:
-                    r1_earlier = batch.ref_id[r1] < batch.ref_id[r2]
-                elif u5[r1] != u5[r2]:
-                    r1_earlier = u5[r1] < u5[r2]
-                else:
-                    r1_earlier = not flag[r1] & FLAG_REVERSE
-            else:
-                r1_earlier = True
-            if r1_earlier:
-                umis.append(f"{lo_p}:{parts[0]}-{hi_p}:{parts[1]}")
-            else:
-                umis.append(f"{hi_p}:{parts[0]}-{lo_p}:{parts[1]}")
-        umis = self._truncate(umis)
-        return assigner.assign(umis)
+        if not assigner.split_by_orientation():
+            return assigner.assign(self._truncate(umis))
+        subgroups = {}
+        for i, ok in enumerate(okeys):
+            subgroups.setdefault(ok, []).append(i)
+        mids = [None] * len(umis)
+        for _, idxs in sorted(subgroups.items()):
+            sub = [umis[i] for i in idxs]
+            if not self.no_umi:
+                sub = self._truncate(sub)
+            for i, mi in zip(idxs, assigner.assign(sub)):
+                mids[i] = mi
+        return mids
 
     def _truncate(self, umis):
         if self.min_umi_length is None:
@@ -609,6 +772,16 @@ class FastDedup(FastGrouper):
         self.metrics = self.dmetrics.filter  # FilterMetrics slot
 
     # ------------------------------------------------------------------ slow
+
+    def _defer_templates(self, batch, tbounds, ts):
+        for t in ts:
+            self._carry.append(
+                _PySeg([self._materialize(batch, tbounds, int(t))]))
+
+    def _flush_carry(self):
+        segs, self._carry, self._carry_key = self._carry, [], None
+        templates = [t for seg in segs for t in seg.templates]
+        return self._emit_slow_group(templates) if templates else []
 
     def _emit_slow_group(self, templates):
         from .dedup import (_record_with_flag_and_mi, filter_dedup_template,
@@ -681,8 +854,8 @@ class FastDedup(FastGrouper):
         dm = self.dmetrics
         m = dm.filter
         t_lo, t_hi = gb[0], gb[-1]
-        cat, weird = self._filter_codes(batch, tbounds, len(tbounds) - 1,
-                                        t_lo, t_hi)
+        cat, weird = self._filter_codes_cached(batch, tbounds)
+        cat, weird = cat[t_lo:t_hi], weird[t_lo:t_hi]
         flag = batch.flag
         unmapped = (flag & FLAG_UNMAPPED) != 0
         qcfail = (flag & FLAG_QC_FAIL) != 0

@@ -176,6 +176,10 @@ def adversarial_input(tmp_path_factory):
                            cigar=(("S", 3 * i), ("M", 40 - 3 * i))))
         records.append(rec(name, 0x1 | 0x80 | 0x10, 4100, next_pos=4000 + i * 3,
                            cigar=(("M", 37), ("S", 3))))
+    # pos group 5: a non-ASCII UMI template BETWEEN normal ones (stream
+    # order must survive the carry's python/array segment interleaving)
+    for i, umi in enumerate([b"ACGT", b"AC\xc3\x9cT", b"ACGA", b"ACGT"]):
+        records.append(rec(b"t5_%d" % i, 0, 5000, umi=umi))
     with BamWriter(path, header) as w:
         for r in records:
             w.write_record_bytes(r)

@@ -50,7 +50,7 @@ class _ArrSeg:
         self.batch = batch
         self.umis = umis        # list[str], kept templates in order
         self.okeys = okeys      # list[orientation key | None]
-        self.out_rows = out_rows  # list[list[int]] primary rows per template
+        self.out_rows = out_rows  # (rows_flat int64[], counts int64[])
 
 
 class FastGrouper:
@@ -208,16 +208,15 @@ class FastGrouper:
                     out.append(bytes(blob))
             else:
                 seg = plan[1]
+                rows_flat, counts = seg.out_rows
                 k = len(seg.umis)
-                rows = []
                 values = []
                 for j in range(k):
                     mi_b = rendered[pos].encode()
                     pos += 1
-                    for r in seg.out_rows[j]:
-                        rows.append(r)
-                        values.append(mi_b)
-                out.extend(self._flush_pending(seg.batch, rows, values))
+                    values.extend([mi_b] * int(counts[j]))
+                out.extend(self._flush_pending(seg.batch, rows_flat,
+                                               values))
         return out
 
     def flush(self):
@@ -269,9 +268,9 @@ class FastGrouper:
         if self._carry and t0 < nC \
                 and self._python_key(batch, tbounds, keys, t0) \
                 == self._carry_key:
-            run_end = t0 + 1
-            while run_end < nC and self._key_eq(keys, run_end - 1, run_end):
-                run_end += 1
+            diffs = np.nonzero(
+                (keys[t0 + 1:nC] != keys[t0:nC - 1]).any(axis=1))[0]
+            run_end = (t0 + 1 + int(diffs[0])) if len(diffs) else nC
             self._defer_templates(batch, tbounds,
                                   np.arange(t0, run_end, dtype=np.int64))
             t0 = run_end
@@ -324,10 +323,13 @@ class FastGrouper:
                 return
             kept_t = np.asarray(run, dtype=np.int64)
             umis, okeys = self._umi_strings(batch, kept_t)
-            out_rows = [[int(sel[t]) for sel in (self._fr_of, self._r1_of,
-                                                 self._r2_of) if sel[t] >= 0]
-                        for t in kept_t]
-            self._carry.append(_ArrSeg(batch, umis, okeys, out_rows))
+            picks = np.stack([self._fr_of[kept_t], self._r1_of[kept_t],
+                              self._r2_of[kept_t]], axis=1)
+            rows_flat = picks.ravel()
+            rows_flat = rows_flat[rows_flat >= 0]
+            counts = (picks >= 0).sum(axis=1)
+            self._carry.append(_ArrSeg(batch, umis, okeys,
+                                       (rows_flat, counts)))
 
         run = []
         for li, t in enumerate(ts):
@@ -497,10 +499,6 @@ class FastGrouper:
                     lib[t] = self._rg_to_ord.get(rg,
                                                  self._lib_ord["unknown"])
         return np.concatenate([lib[:, None], a, b], axis=1)
-
-    @staticmethod
-    def _key_eq(keys, t1, t2):
-        return bool((keys[t1] == keys[t2]).all())
 
     def _python_key(self, batch, tbounds, keys, t):
         """The canonical python read_info_key of template t (for cross-batch
@@ -676,7 +674,7 @@ class FastGrouper:
         return out
 
     def _flush_pending(self, batch, rows, values):
-        if not rows:
+        if len(rows) == 0:
             return []
         try:
             blob = nb.rewrite_tag_records(

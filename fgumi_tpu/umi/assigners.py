@@ -31,7 +31,6 @@ import numpy as np
 # Unique-UMI count above which the pairwise distance matrix moves to the device.
 DEVICE_THRESHOLD = 1024
 
-_VALID = frozenset(b"ACGT")
 
 
 @dataclass(frozen=True)
@@ -52,6 +51,9 @@ class MoleculeId:
 NONE_ID = MoleculeId("")
 
 
+_VALID_SET = frozenset("ACGTacgt")
+
+
 def _is_encodable(umi: str) -> bool:
     """BitEnc-encodable: every dash-separated segment is ACGT (case-folded), <=32."""
     for seg in umi.split("-"):
@@ -59,7 +61,7 @@ def _is_encodable(umi: str) -> bool:
         seg = seg.rsplit(":", 1)[-1]
         if len(seg) > 32:
             return False
-        if not all(b in _VALID for b in seg.upper().encode()):
+        if not _VALID_SET.issuperset(seg):
             return False
     return True
 

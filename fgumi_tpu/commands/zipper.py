@@ -11,12 +11,10 @@ the QC-fail flag, normalize AS/XS to the smallest signed int type, and add a
 
 from dataclasses import dataclass, field
 
-from ..core.record_edit import (append_raw_tag_entry, append_tag_i32_array,
-                                cigar_string, normalize_int_tag_to_smallest_signed,
-                                raw_tag_entries, remove_tag, remove_tags,
+from ..core.record_edit import (TagEditor, cigar_string, raw_tag_entries,
                                 set_bin, set_flags, set_mate_pos,
-                                set_mate_ref_id, set_pos, set_ref_id, set_tlen,
-                                update_tag_i32, update_tag_str)
+                                set_mate_ref_id, set_pos, set_ref_id,
+                                set_tlen)
 from ..core.tag_reversal import (TAGS_TO_REVERSE, TAGS_TO_REVERSE_COMPLEMENT,
                                  revcomp_tag_value_at, reverse_tag_value_at)
 from ..core.template import iter_name_groups, unclipped_5prime
@@ -118,48 +116,54 @@ def _as_tag(rec: RawRecord):
     return rec.get_int(b"AS")
 
 
-def _set_mate_from(buf, mate: RawRecord, tlen=None):
-    """Write mate ref/pos/flags/MQ/MC from `mate` onto `buf`."""
+def _set_mate_from(buf, ed: TagEditor, mate: RawRecord, tlen=None):
+    """Write mate ref/pos/flags/MQ/MC from `mate` onto `buf`/its editor."""
     set_mate_ref_id(buf, mate.ref_id)
     set_mate_pos(buf, mate.pos)
     mate_unmapped = bool(mate.flag & FLAG_UNMAPPED)
     _set_mate_flags(buf, bool(mate.flag & FLAG_REVERSE), mate_unmapped)
-    update_tag_i32(buf, b"MQ", mate.mapq)
+    ed.set_i32(b"MQ", mate.mapq)
     cig = cigar_string(mate)
     if cig != "*" and not mate_unmapped:
-        update_tag_str(buf, b"MC", cig.encode())
+        ed.set_str(b"MC", cig.encode())
     else:
-        remove_tag(buf, b"MC")
+        ed.remove(b"MC")
     if tlen is not None:
         set_tlen(buf, tlen)
 
 
-def fix_mate_info(t: MappedTemplate):
+def fix_mate_info(t: MappedTemplate, editors=None):
     """template.rs:459-605: primary pair mate pointers, MQ/MC/ms tags, TLEN,
-    and supplementals pointing at the opposite primary."""
+    and supplementals pointing at the opposite primary. With editors=None
+    (standalone use) the staged aux edits apply back into t.bufs."""
+    standalone = editors is None
+    if standalone:
+        editors = [TagEditor(buf) for buf in t.bufs]
     if t.r1 is not None and t.r2 is not None:
         b1, b2 = t.bufs[t.r1], t.bufs[t.r2]
+        e1, e2 = editors[t.r1], editors[t.r2]
         r1, r2 = _rec(b1), _rec(b2)
         r1_unmapped = bool(r1.flag & FLAG_UNMAPPED)
         r2_unmapped = bool(r2.flag & FLAG_UNMAPPED)
         r1_as, r2_as = _as_tag(r1), _as_tag(r2)
         if not r1_unmapped and not r2_unmapped:
             tlen = _insert_size(r1, r2)
-            _set_mate_from(b1, r2, tlen)
-            _set_mate_from(b2, r1, -tlen)
+            _set_mate_from(b1, e1, r2, tlen)
+            _set_mate_from(b2, e2, r1, -tlen)
         elif r1_unmapped and r2_unmapped:
-            for b, other in ((b1, r2), (b2, r1)):
+            for b, ed, other in ((b1, e1, r2), (b2, e2, r1)):
                 set_ref_id(b, -1)
                 set_pos(b, -1)
                 set_mate_ref_id(b, -1)
                 set_mate_pos(b, -1)
                 _set_mate_flags(b, bool(other.flag & FLAG_REVERSE), True)
-                remove_tag(b, b"MQ")
-                remove_tag(b, b"MC")
+                ed.remove(b"MQ")
+                ed.remove(b"MC")
                 set_tlen(b, 0)
                 set_bin(b)  # POS moved to -1: bin must be reg2bin(-1,0)=4680
         else:
-            mapped_b, unmapped_b = (b2, b1) if r1_unmapped else (b1, b2)
+            mapped_i, unmapped_i = (t.r2, t.r1) if r1_unmapped                 else (t.r1, t.r2)
+            mapped_b, unmapped_b = t.bufs[mapped_i], t.bufs[unmapped_i]
             mapped = _rec(mapped_b)
             unmapped = _rec(unmapped_b)
             # place the unmapped read at its mate's coordinates
@@ -168,16 +172,16 @@ def fix_mate_info(t: MappedTemplate):
             set_mate_ref_id(mapped_b, mapped.ref_id)
             set_mate_pos(mapped_b, mapped.pos)
             _set_mate_flags(mapped_b, bool(unmapped.flag & FLAG_REVERSE), True)
-            remove_tag(mapped_b, b"MQ")
-            remove_tag(mapped_b, b"MC")
+            editors[mapped_i].remove(b"MQ")
+            editors[mapped_i].remove(b"MC")
             set_tlen(mapped_b, 0)
-            _set_mate_from(unmapped_b, mapped, 0)
+            _set_mate_from(unmapped_b, editors[unmapped_i], mapped, 0)
             set_bin(unmapped_b)
         # ms (mate score) from the mate's AS, both cases
         if r2_as is not None:
-            update_tag_i32(b1, b"ms", int(r2_as))
+            e1.set_i32(b"ms", int(r2_as))
         if r1_as is not None:
-            update_tag_i32(b2, b"ms", int(r1_as))
+            e2.set_i32(b"ms", int(r1_as))
 
     # Supplementals point at the opposite primary (template.rs:513-605).
     for supp_list, primary_i in ((t.r1_supplementals, t.r2),
@@ -189,18 +193,26 @@ def fix_mate_info(t: MappedTemplate):
         p_tlen = primary.tlen
         p_as = _as_tag(primary)
         for i in supp_list:
-            b = t.bufs[i]
-            _set_mate_from(b, primary, -p_tlen)
+            _set_mate_from(t.bufs[i], editors[i], primary, -p_tlen)
             if p_as is not None:
-                update_tag_i32(b, b"ms", int(p_as))
+                editors[i].set_i32(b"ms", int(p_as))
+    if standalone:
+        for i, ed in enumerate(editors):
+            t.bufs[i][:] = ed.finish()
 
 
-def add_template_coordinate_tags(t: MappedTemplate):
+def add_template_coordinate_tags(t: MappedTemplate, editors=None):
     """tc tag (B:i [tid1,pos1,neg1,tid2,pos2,neg2], lower coordinate first) on
-    secondary/supplementary records only (zipper.rs:281-357)."""
+    secondary/supplementary records only (zipper.rs:281-357). With
+    editors=None (standalone use) the edits apply back into t.bufs."""
     others = t.r1_others + t.r2_others
     if not others:
         return
+    standalone = editors is None
+    if standalone:
+        others_set = set(others)
+        editors = [TagEditor(t.bufs[i]) if i in others_set else None
+                   for i in range(len(t.bufs))]
 
     def info(i):
         if i is None:
@@ -220,20 +232,24 @@ def add_template_coordinate_tags(t: MappedTemplate):
         return
     values = [a[0], a[1], a[2], b[0], b[1], b[2]]
     for i in others:
-        remove_tag(t.bufs[i], b"tc")
-        append_tag_i32_array(t.bufs[i], b"tc", values)
+        editors[i].set_i32_array(b"tc", values)
+    if standalone:
+        for i in others:
+            t.bufs[i][:] = editors[i].finish()
 
 
 def merge_template(unmapped_records, t: MappedTemplate, tag_info: TagInfo,
                    skip_tc_tags: bool = False):
     """Transfer tags/flags from an unmapped template onto the mapped one
-    (zipper.rs merge_raw:397-545)."""
-    fix_mate_info(t)
+    (zipper.rs merge_raw:397-545). Returns the rebuilt record bytes (one
+    aux-region rebuild per record via TagEditor)."""
+    editors = [TagEditor(buf) for buf in t.bufs]
+    fix_mate_info(t, editors)
 
-    for buf in t.bufs:
+    for ed in editors:
         for tag in tag_info.remove:
             if len(tag) == 2:
-                remove_tag(buf, tag.encode())
+                ed.remove(tag.encode())
 
     primaries = [r for r in unmapped_records
                  if not r.flag & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY)]
@@ -247,27 +263,26 @@ def merge_template(unmapped_records, t: MappedTemplate, tag_info: TagInfo,
             indices = ([t.r2] if t.r2 is not None else []) + t.r2_others
         u_tags = [(tag, typ, vb) for tag, typ, vb in raw_tag_entries(u)
                   if tag.decode(errors="replace") not in tag_info.remove]
-        copy_names = {tag for tag, _, _ in u_tags}
         for i in indices:
-            buf = t.bufs[i]
-            rec = _rec(buf)
-            has_pg = rec.find_tag(b"PG") is not None
-            negative = bool(rec.flag & FLAG_REVERSE)
-            # single pass: drop every tag we are about to re-append ...
-            remove_tags(buf, copy_names - ({b"PG"} if has_pg else set()))
-            # ... then append them all, tracking offsets for strand transforms
+            ed = editors[i]
+            has_pg = ed.find(b"PG") is not None
+            negative = bool(_flag(t.bufs[i]) & FLAG_REVERSE)
             for entry in u_tags:
-                tag, typ, _ = entry
+                tag, typ, vb = entry
                 if tag == b"PG" and has_pg:
                     continue
-                value_off = len(buf) + 3
-                append_raw_tag_entry(buf, entry)
+                ed.remove(tag)
                 if negative:
                     tag_str = tag.decode(errors="replace")
                     if tag_str in tag_info.reverse:
-                        reverse_tag_value_at(buf, typ, value_off)
+                        vb = bytearray(vb)
+                        reverse_tag_value_at(vb, typ, 0)
+                        vb = bytes(vb)
                     elif tag_str in tag_info.revcomp:
-                        revcomp_tag_value_at(buf, typ, value_off)
+                        vb = bytearray(vb)
+                        revcomp_tag_value_at(vb, typ, 0)
+                        vb = bytes(vb)
+                ed.append_entry(tag, typ, vb)
         # QC pass/fail transfer
         qc_fail = bool(u_flags & FLAG_QC_FAIL)
         for i in indices:
@@ -275,12 +290,13 @@ def merge_template(unmapped_records, t: MappedTemplate, tag_info: TagInfo,
             f = (f | FLAG_QC_FAIL) if qc_fail else (f & ~FLAG_QC_FAIL)
             set_flags(t.bufs[i], f)
 
-    for buf in t.bufs:
-        normalize_int_tag_to_smallest_signed(buf, b"AS")
-        normalize_int_tag_to_smallest_signed(buf, b"XS")
+    for ed in editors:
+        ed.normalize_int_smallest(b"AS")
+        ed.normalize_int_smallest(b"XS")
 
     if not skip_tc_tags:
-        add_template_coordinate_tags(t)
+        add_template_coordinate_tags(t, editors)
+    return [ed.finish() for ed in editors]
 
 
 def run_zipper(mapped_reader, unmapped_reader, writer, tag_info: TagInfo, *,
@@ -310,9 +326,9 @@ def run_zipper(mapped_reader, unmapped_reader, writer, tag_info: TagInfo, *,
                 n_templates += 1
             continue
         t = MappedTemplate.from_records(mapped_item[0], mapped_item[1])
-        merge_template(u_records, t, tag_info, skip_tc_tags)
-        for buf in t.bufs:
-            writer.write_record_bytes(bytes(buf))
+        out_bytes = merge_template(u_records, t, tag_info, skip_tc_tags)
+        for data in out_bytes:
+            writer.write_record_bytes(data)
             n_records += 1
         n_templates += 1
         mapped_item = next(mapped_groups, None)

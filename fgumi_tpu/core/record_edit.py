@@ -9,7 +9,8 @@ layout permits, or return the replacement bytearray when the length changes.
 
 import struct
 
-from ..io.bam import RawRecord, _reg2bin, _skip_tag_value
+from ..io.bam import (RawRecord, _read_tag_value, _reg2bin,
+                      _skip_tag_value)
 
 
 def set_flags(buf: bytearray, flags: int):
@@ -55,62 +56,6 @@ def cigar_string(rec: RawRecord) -> str:
     return "".join(f"{n}{op}" for op, n in ops)
 
 
-def remove_tag(buf: bytearray, tag: bytes):
-    """Remove every occurrence of an aux tag; edits in place."""
-    remove_tags(buf, (tag,))
-
-
-def remove_tags(buf: bytearray, tags):
-    """Remove every occurrence of each tag in `tags` in one aux scan."""
-    rec = RawRecord(bytes(buf))
-    spans = []
-    for t, typ, off in rec._iter_tags():
-        if t in tags:
-            spans.append((off - 3, _skip_tag_value(rec.data, typ, off)))
-    for start, end in reversed(spans):
-        del buf[start:end]
-
-
-def append_tag_i32(buf: bytearray, tag: bytes, value: int):
-    buf += tag + b"i" + struct.pack("<i", value)
-
-
-def update_tag_i32(buf: bytearray, tag: bytes, value: int):
-    remove_tag(buf, tag)
-    append_tag_i32(buf, tag, value)
-
-
-def update_tag_str(buf: bytearray, tag: bytes, value: bytes):
-    remove_tag(buf, tag)
-    buf += tag + b"Z" + value + b"\x00"
-
-
-def append_tag_i32_array(buf: bytearray, tag: bytes, values):
-    buf += tag + b"Bi" + struct.pack("<I", len(values))
-    for v in values:
-        buf += struct.pack("<i", v)
-
-
-def normalize_int_tag_to_smallest_signed(buf: bytearray, tag: bytes):
-    """Rewrite an integer tag using the smallest signed type that holds it
-    (zipper.rs step 5; matches fgbio's AS/XS normalization)."""
-    rec = RawRecord(bytes(buf))
-    got = rec.find_tag(tag)
-    if got is None or got[0] not in "cCsSiI":
-        return
-    value = int(got[1])
-    if not -(2**31) <= value < 2**31:
-        # out of i32 range: leave the tag unchanged (tags.rs:995-997)
-        return
-    remove_tag(buf, tag)
-    if -128 <= value <= 127:
-        buf += tag + b"c" + struct.pack("<b", value)
-    elif -32768 <= value <= 32767:
-        buf += tag + b"s" + struct.pack("<h", value)
-    else:
-        buf += tag + b"i" + struct.pack("<i", value)
-
-
 def raw_tag_entries(rec: RawRecord):
     """[(tag, type_byte, value_bytes)] for every aux tag, pre-encoded."""
     out = []
@@ -120,8 +65,107 @@ def raw_tag_entries(rec: RawRecord):
     return out
 
 
-def append_raw_tag_entry(buf: bytearray, entry):
-    tag, typ, value_bytes = entry
-    buf += tag
-    buf.append(typ)
-    buf += value_bytes
+class TagEditor:
+    """Single-pass aux-tag editor for one record's wire bytes.
+
+    The TLV region parses once; removals and updates stage against the
+    parsed entries plus staged appends, and finish() rebuilds the record in
+    one concatenation — replacing chains of per-helper full-region scans
+    (each remove_tag/update_* call above walks the whole aux region).
+    Fixed-field edits keep going directly to the underlying bytearray; the
+    prefix (header/name/cigar/seq/qual) is copied verbatim at finish time.
+
+    Ordering semantics match the in-place helpers exactly: removals drop
+    every original occurrence, updates re-append at the end, and find()
+    returns the first surviving original, else the first staged append —
+    what find_tag would see on the rebuilt record.
+    """
+
+    __slots__ = ("buf", "aux0", "entries", "_removed", "_appends")
+
+    def __init__(self, buf: bytearray):
+        self.buf = buf
+        l_read_name = buf[8]
+        n_cigar = int.from_bytes(buf[12:14], "little")
+        l_seq = int.from_bytes(buf[16:20], "little")
+        self.aux0 = 32 + l_read_name + 4 * n_cigar + (l_seq + 1) // 2 + l_seq
+        entries = []
+        off = self.aux0
+        end = len(buf)
+        while off + 3 <= end:
+            tag = bytes(buf[off:off + 2])
+            typ = buf[off + 2]
+            nxt = _skip_tag_value(buf, typ, off + 3)
+            entries.append((tag, typ, off, nxt))
+            off = nxt
+        self.entries = entries
+        self._removed = set()
+        self._appends = []  # (tag, typ_byte, value_bytes)
+
+    def find(self, tag: bytes):
+        """(type_char, python value) like RawRecord.find_tag, or None."""
+        for t, typ, off, _nxt in self.entries:
+            if t == tag and t not in self._removed:
+                return chr(typ), _read_tag_value(self.buf, typ, off + 3)
+        for t, typ, vb in self._appends:
+            if t == tag:
+                return chr(typ), _read_tag_value(vb, typ, 0)
+        return None
+
+    def get_int(self, tag: bytes):
+        got = self.find(tag)
+        if got is None or got[0] not in "cCsSiI":
+            return None
+        return int(got[1])
+
+    def remove(self, tag: bytes):
+        self._removed.add(tag)
+        self._appends = [a for a in self._appends if a[0] != tag]
+
+    def append_entry(self, tag: bytes, typ: int, value_bytes: bytes):
+        self._appends.append((tag, typ, value_bytes))
+
+    def set_i32(self, tag: bytes, value: int):
+        self.remove(tag)
+        self.append_entry(tag, ord("i"), struct.pack("<i", value))
+
+    def set_str(self, tag: bytes, value: bytes):
+        self.remove(tag)
+        self.append_entry(tag, ord("Z"), value + b"\x00")
+
+    def set_i32_array(self, tag: bytes, values):
+        self.remove(tag)
+        self.append_entry(
+            tag, ord("B"),
+            b"i" + struct.pack("<I", len(values))
+            + b"".join(struct.pack("<i", v) for v in values))
+
+    def normalize_int_smallest(self, tag: bytes):
+        """AS/XS smallest-signed-type normalization: the tag is always
+        removed and re-appended at the end, even when already smallest
+        (reference tags.rs:995-1001 removes + re-appends unconditionally,
+        so tag ORDER must shift too)."""
+        got = self.find(tag)
+        if got is None or got[0] not in "cCsSiI":
+            return
+        value = int(got[1])
+        if not -(2**31) <= value < 2**31:
+            return
+        self.remove(tag)
+        if -128 <= value <= 127:
+            self.append_entry(tag, ord("c"), struct.pack("<b", value))
+        elif -32768 <= value <= 32767:
+            self.append_entry(tag, ord("s"), struct.pack("<h", value))
+        else:
+            self.append_entry(tag, ord("i"), struct.pack("<i", value))
+
+    def finish(self) -> bytes:
+        buf = self.buf
+        parts = [bytes(buf[:self.aux0])]
+        for tag, typ, off, nxt in self.entries:
+            if tag in self._removed:
+                continue
+            parts.append(bytes(buf[off:nxt]))
+        for tag, typ, vb in self._appends:
+            parts.append(tag + bytes([typ]) + vb)
+        return b"".join(parts)

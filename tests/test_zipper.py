@@ -123,8 +123,7 @@ def test_merge_template_tag_transfer_and_revcomp():
     t = MappedTemplate.from_records(b"q1", [pos_rec])
     ti = TagInfo.from_options(remove=["XX"], reverse=["Consensus"],
                               revcomp=["Consensus"])
-    merge_template([u], t, ti)
-    out = RawRecord(bytes(t.bufs[0]))
+    out = RawRecord(merge_template([u], t, ti)[0])
     assert out.get_str(b"RX") == "ACGT"
     assert out.get_str(b"ac") == "AACC"  # positive strand: untouched
     assert out.find_tag(b"XX") is None
@@ -133,8 +132,7 @@ def test_merge_template_tag_transfer_and_revcomp():
 
     neg_rec = mapped_rec(name=b"q1", flag=FLAG_REVERSE, pos=100)
     t2 = MappedTemplate.from_records(b"q1", [neg_rec])
-    merge_template([u], t2, ti)
-    out2 = RawRecord(bytes(t2.bufs[0]))
+    out2 = RawRecord(merge_template([u], t2, ti)[0])
     assert out2.get_str(b"ac") == "GGTT"  # revcomp of AACC
     assert list(out2.find_tag(b"cd")[1]) == [4, 3, 2, 1]
 
@@ -143,8 +141,8 @@ def test_merge_transfers_qc_fail():
     u = unmapped_rec(flag=FLAG_UNMAPPED | FLAG_QC_FAIL)
     m = mapped_rec(name=b"q1", flag=0)
     t = MappedTemplate.from_records(b"q1", [m])
-    merge_template([u], t, TagInfo())
-    assert RawRecord(bytes(t.bufs[0])).flag & FLAG_QC_FAIL
+    out_bytes = merge_template([u], t, TagInfo())
+    assert RawRecord(out_bytes[0]).flag & FLAG_QC_FAIL
 
 
 def _write(path, records, text=QG_HEADER):
@@ -207,3 +205,20 @@ def test_zipper_missing_read_passthrough(tmp_path):
                  "--exclude-missing-reads"]) == 0
     with BamReader(out) as r:
         assert [rec.name for rec in r] == [b"q1"]
+
+
+def test_as_normalization_moves_tag_even_when_already_smallest():
+    """AS/XS normalization removes + re-appends unconditionally (reference
+    tags.rs:995-1001), so an already-c-typed AS still moves to the end."""
+    u = unmapped_rec(flag=FLAG_UNMAPPED)
+    b = RecordBuilder().start_mapped(b"q1", 0, 0, 100, 60, [("M", 10)],
+                                     b"A" * 10, [30] * 10)
+    b._buf += b"ASc" + bytes([50])  # already-smallest c-typed AS
+    b.tag_int(b"NM", 2)
+    m = RawRecord(b.finish())
+    t = MappedTemplate.from_records(b"q1", [m])
+    out = RawRecord(merge_template([u], t, TagInfo())[0])
+    tags = [tag for tag, _typ, _off in out._iter_tags()]
+    assert tags.index(b"AS") > tags.index(b"NM")
+    got = out.find_tag(b"AS")
+    assert got[0] == "c" and got[1] == 50

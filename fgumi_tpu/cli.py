@@ -825,17 +825,12 @@ def cmd_sort(args):
                             max_records=args.max_records_in_ram) as sorter:
             if batch_keys_fn is not None:
                 # native batch path: decode + key extraction per batch
-                from .io.batch_reader import BamBatchReader
+                from .sort.keys import iter_keyed_records
 
-                with BamBatchReader(args.input) as breader:
-                    add_entry = sorter.add_entry
-                    for batch in breader:
-                        keys = batch_keys_fn(batch)
-                        buf = batch.buf
-                        do, de = batch.data_off, batch.data_end
-                        for i in range(batch.n):
-                            add_entry(keys[i], buf[do[i]:de[i]].tobytes())
-                        progress.add(batch.n)
+                add_entry = sorter.add_entry
+                for key, data in iter_keyed_records(args.input, batch_keys_fn,
+                                                    progress.add):
+                    add_entry(key, data)
             else:
                 for rec in reader:
                     sorter.add(rec)
@@ -911,14 +906,36 @@ def cmd_merge(args):
         base_lines = [l for l in first.text.splitlines()
                       if not l.startswith(("@RG", "@PG", "@CO"))]
         merged_text = "\n".join(base_lines + seen_lines) + "\n"
-        key_fn = make_key_fn(args.order, first, args.subsort)
         out_header = BamHeader(text=_rewrite_hd(merged_text, so, go, ss),
                                ref_names=first.ref_names, ref_lengths=first.ref_lengths)
+        from .sort.keys import make_batch_keys_fn
+
+        batch_keys_fn = make_batch_keys_fn(args.order, first, args.subsort)
         n = 0
         with BamWriter(args.output, out_header) as writer:
-            for data in merge_sorted(readers, key_fn):
-                writer.write_record_bytes(data)
-                n += 1
+            if batch_keys_fn is not None:
+                # native path: packed byte keys extracted per batch; memcmp
+                # order == semantic order, so heapq merges the byte keys.
+                # The header-validation readers close first (the batch
+                # readers re-open each path).
+                import heapq
+
+                from .sort.keys import iter_keyed_records
+
+                for r in readers:
+                    r.close()
+                streams = [
+                    ((key, idx, data)
+                     for key, data in iter_keyed_records(p, batch_keys_fn))
+                    for idx, p in enumerate(args.input)]
+                for _, _, data in heapq.merge(*streams):
+                    writer.write_record_bytes(data)
+                    n += 1
+            else:
+                key_fn = make_key_fn(args.order, first, args.subsort)
+                for data in merge_sorted(readers, key_fn):
+                    writer.write_record_bytes(data)
+                    n += 1
     finally:
         for r in readers:
             r.close()

@@ -218,6 +218,25 @@ def make_batch_keys_fn(order: str, header, subsort: str = "natural"):
     raise ValueError(f"unknown sort order: {order}")
 
 
+def iter_keyed_records(path_or_obj, batch_keys_fn, on_batch=None):
+    """(packed key bytes, record wire bytes) per record, batch-extracted.
+
+    The shared consumer loop for sort accumulation and k-way merge;
+    `on_batch(n)` fires once per decoded batch (progress reporting).
+    """
+    from ..io.batch_reader import BamBatchReader
+
+    with BamBatchReader(path_or_obj) as br:
+        for batch in br:
+            keys = batch_keys_fn(batch)
+            buf = batch.buf
+            do, de = batch.data_off, batch.data_end
+            if on_batch is not None:
+                on_batch(batch.n)
+            for i in range(batch.n):
+                yield keys[i], bytes(buf[do[i]:de[i]])
+
+
 def make_key_bytes_fn(order: str, header, subsort: str = "natural"):
     """Packed-key function for coordinate|queryname|template-coordinate."""
     from .external import SortContext, _mi_key

@@ -96,12 +96,13 @@ class BamHeader:
 class RawRecord:
     """A single BAM record's wire bytes (without the leading block_size)."""
 
-    __slots__ = ("data", "_tag_idx", "_aux")
+    __slots__ = ("data", "_tag_idx", "_aux", "_cigar")
 
     def __init__(self, data: bytes):
         self.data = data
         self._tag_idx = None  # lazy {tag: (typ, value_off)} built on first lookup
         self._aux = None      # lazy cached aux-region offset
+        self._cigar = None    # lazy cached decoded CIGAR
 
     # --- fixed-offset fields (fields.rs:7-24) ---
     @property
@@ -167,12 +168,18 @@ class RawRecord:
         return aux
 
     def cigar(self):
-        """[(op_char, length)] decoded CIGAR."""
-        off = self._cigar_off()
-        out = []
-        for i in range(self.n_cigar_op):
-            v = int.from_bytes(self.data[off + 4 * i : off + 4 * i + 4], "little")
-            out.append((CIGAR_OPS[v & 0xF], v >> 4))
+        """[(op_char, length)] decoded CIGAR (cached; the record's bytes are
+        immutable and consumers probe the CIGAR several times per record)."""
+        out = self._cigar
+        if out is None:
+            off = self._cigar_off()
+            data = self.data
+            out = []
+            for i in range(self.n_cigar_op):
+                v = int.from_bytes(data[off + 4 * i: off + 4 * i + 4],
+                                   "little")
+                out.append((CIGAR_OPS[v & 0xF], v >> 4))
+            self._cigar = out
         return out
 
     def seq_bytes(self) -> bytes:

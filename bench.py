@@ -184,6 +184,72 @@ def main():
                     d_cpu["wall_s"] / d_tpu["wall_s"], 3)
         if dup_diag:
             result["duplex_diagnostics"] = dup_diag
+
+    # tertiary metrics: host-side stage throughputs + the full best-practice
+    # chain (BASELINE config 5 analog), all on CPU jax in one subprocess —
+    # breadth evidence independent of the device tunnel's health
+    if os.environ.get("BENCH_STAGES", "1") not in ("0", "false"):
+        stage_script = r"""
+import json, os, sys, time
+sys.path.insert(0, %(repo)r)
+from fgumi_tpu.cli import main
+
+tmp = sys.argv[1]
+out = {}
+
+def run(tag, argv):
+    t0 = time.monotonic()
+    rc = main(argv)
+    dt = time.monotonic() - t0
+    assert rc == 0, f"{tag} failed rc={rc}"
+    out[tag] = round(dt, 3)
+
+j = lambda *p: os.path.join(tmp, *p)
+n_fam = int(sys.argv[2])
+run("e2e_simulate_s", ["simulate", "fastq-reads", "-1", j("r1.fq.gz"),
+                       "-2", j("r2.fq.gz"), "--num-families", str(n_fam),
+                       "--family-size", "5", "--read-length", "100",
+                       "--seed", "7"])
+run("extract_s", ["extract", "-i", j("r1.fq.gz"), j("r2.fq.gz"),
+                  "-r", "8M+T", "+T", "-o", j("un.bam"),
+                  "--sample", "s", "--library", "l"])
+run("sort_s", ["sort", "-i", j("un.bam"), "-o", j("sorted.bam"),
+               "--order", "template-coordinate"])
+run("group_s", ["group", "-i", j("sorted.bam"), "-o", j("grouped.bam"),
+                "--allow-unmapped"])
+run("simplex_chain_s", ["simplex", "-i", j("grouped.bam"), "-o",
+                        j("cons.bam"), "--min-reads", "1",
+                        "--threads", sys.argv[3], "--allow-unmapped"])
+run("filter_s", ["filter", "-i", j("cons.bam"), "-o", j("filt.bam"),
+                 "--min-reads", "3"])
+print(json.dumps(out))
+"""
+        stage_fam = int(os.environ.get("BENCH_STAGE_FAMILIES", "40000"))
+        with tempfile.TemporaryDirectory(
+                prefix="fgumi_bench_stages_") as stage_tmp:
+            try:
+                proc = subprocess.run(
+                    [sys.executable, "-c", stage_script % {"repo": REPO},
+                     stage_tmp, str(stage_fam), str(threads)],
+                    capture_output=True, text=True,
+                    timeout=timeout_s * 3,  # a 6-stage chain, not one run
+                    env={**os.environ, **cpu_env})
+                if proc.returncode == 0:
+                    stages = json.loads(proc.stdout.strip().splitlines()[-1])
+                    n_stage_reads = stage_fam * 10  # pairs * family size 5
+                    total = sum(v for k, v in stages.items()
+                                if k != "e2e_simulate_s")
+                    result["pipeline_stage_seconds"] = stages
+                    result["pipeline_e2e_reads_per_sec"] = round(
+                        n_stage_reads / total, 1) if total else 0.0
+                    result["pipeline_e2e_input_reads"] = n_stage_reads
+                else:
+                    tail = (proc.stderr or "").strip().splitlines()[-3:]
+                    result["pipeline_diagnostics"] = \
+                        [f"rc={proc.returncode}"] + tail
+            except (subprocess.TimeoutExpired, ValueError, OSError) as e:
+                result["pipeline_diagnostics"] = [f"stage bench failed: {e}"]
+
     print(json.dumps(result))
     return 0
 

@@ -647,6 +647,8 @@ def _add_group(sub):
     p.add_argument("--threads", type=int, default=0,
                    help="reader/writer threads around the batch engine "
                         "(0/1 = inline)")
+    p.add_argument("--stats", action="store_true",
+                   help="print per-stage pipeline timing table")
     p.add_argument("--classic", action="store_true",
                    help="force the per-template engine (no batch vectorization)")
     p.set_defaults(func=cmd_group)
@@ -693,8 +695,9 @@ def cmd_group(args):
                         raise ValueError(
                             "--no-umi cannot be combined with the paired "
                             "strategy")
-                    from .pipeline import run_stages
+                    from .pipeline import StageTimes, run_stages
 
+                    stats_t = StageTimes()
                     grouper = FastGrouper(
                         reader.header, make_assigner(args.strategy, args.edits),
                         umi_tag=args.raw_tag.encode(),
@@ -706,10 +709,12 @@ def cmd_group(args):
                         allow_unmapped=args.allow_unmapped)
                     run_stages(iter(reader), grouper.process_batch,
                                writer.write_serialized,
-                               threads=args.threads)
+                               threads=args.threads, stats=stats_t)
                     for chunk in grouper.flush():
                         writer.write_serialized(chunk)
                     result = grouper.result()
+                    if getattr(args, "stats", False):
+                        print(stats_t.format_table())
                 else:
                     result = run_group(
                         reader, writer, strategy=args.strategy,
@@ -1740,6 +1745,8 @@ def _add_dedup(sub):
     p.add_argument("--threads", type=int, default=0,
                    help="reader/writer threads around the batch engine "
                         "(0/1 = inline)")
+    p.add_argument("--stats", action="store_true",
+                   help="print per-stage pipeline timing table")
     p.add_argument("--classic", action="store_true",
                    help="force the per-template engine (no batch vectorization)")
     p.set_defaults(func=cmd_dedup)
@@ -1787,8 +1794,9 @@ def cmd_dedup(args):
                     strategy, edits = args.strategy, args.edits
                     if args.no_umi:
                         strategy, edits = "identity", 0
-                    from .pipeline import run_stages
+                    from .pipeline import StageTimes, run_stages
 
+                    stats_t = StageTimes()
                     dd = FastDedup(
                         reader.header, make_assigner(strategy, edits),
                         min_mapq=args.min_map_q,
@@ -1799,10 +1807,12 @@ def cmd_dedup(args):
                         remove_duplicates=args.remove_duplicates)
                     run_stages(iter(reader), dd.process_batch,
                                writer.write_serialized,
-                               threads=args.threads)
+                               threads=args.threads, stats=stats_t)
                     for chunk in dd.flush():
                         writer.write_serialized(chunk)
                     metrics, family_sizes = dd.result()
+                    if getattr(args, "stats", False):
+                        print(stats_t.format_table())
                 else:
                     metrics, family_sizes = run_dedup(
                         reader, writer, strategy=args.strategy,

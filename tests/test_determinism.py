@@ -79,3 +79,38 @@ def test_duplex_fast_vs_classic(duplexed, tmp_path):
     assert main(["duplex", "-i", duplexed, "-o", classic, "--min-reads", "1",
                  "--classic"]) == 0
     assert records_of(fast) == records_of(classic)
+
+
+def test_group_threads_deterministic(tmp_path):
+    from fgumi_tpu.simulate import simulate_mapped_bam
+
+    raw = str(tmp_path / "m.bam")
+    simulate_mapped_bam(raw, num_families=200, family_size=4,
+                        umi_error_rate=0.05, seed=41)
+    srt = str(tmp_path / "s.bam")
+    assert main(["sort", "-i", raw, "-o", srt,
+                 "--order", "template-coordinate"]) == 0
+    outs = []
+    for i, threads in enumerate((0, 4, 4)):
+        out = str(tmp_path / f"g{i}.bam")
+        assert main(["group", "-i", srt, "-o", out,
+                     "--threads", str(threads)]) == 0
+        outs.append(records_of(out))
+    assert outs[0] == outs[1] == outs[2]
+
+
+def test_dedup_threads_deterministic(tmp_path):
+    from fgumi_tpu.simulate import simulate_mapped_bam
+
+    raw = str(tmp_path / "m.bam")
+    simulate_mapped_bam(raw, num_families=200, family_size=4, seed=42)
+    srt = str(tmp_path / "s.bam")
+    assert main(["sort", "-i", raw, "-o", srt,
+                 "--order", "template-coordinate"]) == 0
+    outs = []
+    for i, threads in enumerate((0, 4)):
+        out = str(tmp_path / f"d{i}.bam")
+        assert main(["dedup", "-i", srt, "-o", out,
+                     "--threads", str(threads)]) == 0
+        outs.append(records_of(out))
+    assert outs[0] == outs[1]

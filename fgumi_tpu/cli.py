@@ -591,8 +591,14 @@ def cmd_codec(args):
     caller = CodecConsensusCaller(args.read_name_prefix, args.read_group_id, opts,
                                   track_rejects=args.rejects is not None)
 
+    from .native import batch as nbat
+
+    if nbat.available():
+        from .io.batch_reader import BatchedRecordReader as _CodecReader
+    else:
+        _CodecReader = BamReader
     t0 = time.monotonic()
-    with BamReader(args.input) as reader:
+    with _CodecReader(args.input) as reader:
         out_header = _unmapped_consensus_header(args.read_group_id)
         rejects_writer = None
         if args.rejects is not None:

@@ -125,3 +125,52 @@ def test_filter_random_parity(tmp_path, seed):
     assert main(["filter", "-i", cons, "-o", classic, "--classic"]
                 + extra) == 0
     assert _records_of(fast) == _records_of(classic)
+
+
+def _random_duplex_stream(rng, n_mols):
+    """MI-grouped /A-/B records with hostile shape mixes."""
+    records = []
+    for mi in range(n_mols):
+        pos = int(rng.integers(1000, 400000))
+        length = int(rng.integers(40, 110))
+        for strand in ("A", "B"):
+            n_pairs = int(rng.integers(0, 4))
+            for t in range(n_pairs):
+                rev1 = strand == "B"
+                for first, rev in ((True, rev1), (False, not rev1)):
+                    flag = 0x1 | (0x40 if first else 0x80) \
+                        | (0x10 if rev else 0)
+                    sq = rng.choice(np.frombuffer(b"ACGTN", np.uint8),
+                                    size=length,
+                                    p=[0.24, 0.24, 0.24, 0.24, 0.04]).tobytes()
+                    qs = rng.integers(2, 60, size=length).astype(np.uint8)
+                    b = RecordBuilder().start_mapped(
+                        b"m%d%s%d" % (mi, strand.encode(), t), flag,
+                        0, pos, 60, [("M", length)], sq, qs)
+                    b.tag_str(b"MI", b"%d/%s" % (mi, strand.encode()))
+                    if rng.random() < 0.9:
+                        b.tag_str(b"RX", bytes(rng.choice(
+                            np.frombuffer(b"ACGT", np.uint8), size=4))
+                            + b"-" + bytes(rng.choice(
+                                np.frombuffer(b"ACGT", np.uint8), size=4)))
+                    records.append(b.finish())
+        if not any(r for r in records):
+            continue
+    return records
+
+
+@pytest.mark.parametrize("seed", [808, 909])
+def test_duplex_random_parity(tmp_path, seed):
+    rng = np.random.default_rng(seed)
+    src = str(tmp_path / "in.bam")
+    recs = _random_duplex_stream(rng, 60)
+    if not recs:
+        pytest.skip("empty stream")
+    _write(src, recs)
+    fast = str(tmp_path / "fast.bam")
+    classic = str(tmp_path / "classic.bam")
+    mr = ["--min-reads", str(int(rng.integers(1, 3)))]
+    bb = ["--batch-bytes", str(int(rng.integers(800, 8000)))]
+    assert main(["duplex", "-i", src, "-o", fast] + mr + bb) == 0
+    assert main(["duplex", "-i", src, "-o", classic, "--classic"] + mr) == 0
+    assert _records_of(fast) == _records_of(classic)

@@ -557,6 +557,8 @@ def _add_codec(sub):
                    help="emit ad/bd/ae/be/ac/bc/aq/bq tags")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--batch-groups", type=int, default=1000)
+    p.add_argument("--classic", action="store_true",
+                   help="force the per-molecule engine (no batch vectorization)")
     p.set_defaults(func=cmd_codec)
 
 
@@ -593,32 +595,55 @@ def cmd_codec(args):
 
     from .native import batch as nbat
 
-    if nbat.available():
-        from .io.batch_reader import BatchedRecordReader as _CodecReader
-    else:
-        _CodecReader = BamReader
+    # the batch engine shares the classic caller's stage 2 but cannot feed
+    # the rejects stream (records stay array-resident); rejects -> classic
+    use_fast = (nbat.available() and args.rejects is None
+                and not getattr(args, "classic", False))
     t0 = time.monotonic()
-    with _CodecReader(args.input) as reader:
-        out_header = _unmapped_consensus_header(args.read_group_id)
-        rejects_writer = None
-        if args.rejects is not None:
-            # rejects keep the input header (raw RG/PG/contig metadata preserved)
-            rejects_writer = BamWriter(args.rejects, reader.header)
-        try:
+    if use_fast:
+        from .consensus.fast_codec import FastCodecCaller
+        from .io.batch_reader import BamBatchReader
+
+        with BamBatchReader(args.input) as reader:
+            out_header = _unmapped_consensus_header(args.read_group_id)
+            fast = FastCodecCaller(caller, args.tag.encode())
             with BamWriter(args.output, out_header) as writer:
                 n_out = 0
-                for batch in iter_mi_group_batches(reader, args.batch_groups,
-                                                   tag=args.tag.encode()):
-                    for rec_bytes in caller.call_groups(batch):
+                for batch in reader:
+                    for rec_bytes in fast.process_batch(batch):
                         writer.write_record_bytes(rec_bytes)
                         n_out += 1
-                    if rejects_writer is not None and caller.rejected_reads:
-                        for rec in caller.rejected_reads:
-                            rejects_writer.write_record(rec)
-                        caller.rejected_reads.clear()
-        finally:
-            if rejects_writer is not None:
-                rejects_writer.close()
+                for rec_bytes in fast.flush():
+                    writer.write_record_bytes(rec_bytes)
+                    n_out += 1
+    else:
+        if nbat.available():
+            from .io.batch_reader import BatchedRecordReader as _CodecReader
+        else:
+            _CodecReader = BamReader
+        with _CodecReader(args.input) as reader:
+            out_header = _unmapped_consensus_header(args.read_group_id)
+            rejects_writer = None
+            if args.rejects is not None:
+                # rejects keep the input header (raw RG/PG/contig metadata
+                # preserved)
+                rejects_writer = BamWriter(args.rejects, reader.header)
+            try:
+                with BamWriter(args.output, out_header) as writer:
+                    n_out = 0
+                    for batch in iter_mi_group_batches(
+                            reader, args.batch_groups, tag=args.tag.encode()):
+                        for rec_bytes in caller.call_groups(batch):
+                            writer.write_record_bytes(rec_bytes)
+                            n_out += 1
+                        if rejects_writer is not None \
+                                and caller.rejected_reads:
+                            for rec in caller.rejected_reads:
+                                rejects_writer.write_record(rec)
+                            caller.rejected_reads.clear()
+            finally:
+                if rejects_writer is not None:
+                    rejects_writer.close()
     dt = time.monotonic() - t0
     s = caller.stats
     log.info("codec: %d input reads -> %d consensus reads in %.2fs (%.0f reads/s)",

@@ -1,0 +1,376 @@
+"""Vectorized CODEC host prep over RecordBatch inputs.
+
+Replaces CodecConsensusCaller.prepare()'s record-level work (phases 1-5 of
+codec_caller.rs:589-836) with batch arrays for the dominant CODEC shape —
+every paired primary a single-op M CIGAR — where clip amounts, adjusted
+positions, overlap geometry, and the phase checks are closed-form
+arithmetic and the SourceRead conversion is one native pack. Molecules with
+any other CIGAR shape run the classic prepare() unchanged, in stream order
+(sharing the caller's stats and downsample RNG stream).
+
+Stage 2 (the SS device pass, geometry finish, combine/masks, record build)
+IS the classic caller's `_run_jobs` + `_finish`, so outputs are identical
+by construction; tests/test_fast_codec.py asserts byte parity end to end.
+"""
+
+import numpy as np
+
+from ..io.bam import (FLAG_FIRST, FLAG_MATE_UNMAPPED, FLAG_PAIRED,
+                      FLAG_REVERSE, FLAG_SECONDARY, FLAG_SUPPLEMENTARY,
+                      FLAG_UNMAPPED)
+from ..native import batch as nb
+from .codec import DuplexDisagreementError
+from .vanilla import R1, SourceRead
+
+
+class FastCodecCaller:
+    """Batch CODEC engine wrapping a CodecConsensusCaller."""
+
+    def __init__(self, caller, tag: bytes = b"MI"):
+        self.caller = caller
+        self.tag = tag
+        self._carry = None  # (mi string, [RawRecord])
+
+    # ----------------------------------------------------------------- driver
+
+    def process_batch(self, batch, final: bool = False):
+        """Consume one RecordBatch -> list of consensus record bytes."""
+        n = batch.n
+        if n == 0:
+            return self.flush() if final else []
+        buf = batch.buf
+        # Z/H-typed presence gate matches the classic get_str-based grouping
+        mi_off, mi_len, _ = batch.tag_locs_str(self.tag)
+        if (mi_off < 0).any():
+            bad = int(np.nonzero(mi_off < 0)[0][0])
+            raise ValueError(
+                f"record {batch.name(bad)!r} missing {self.tag.decode()} tag")
+        starts = nb.group_starts(buf, np.ascontiguousarray(mi_off),
+                                 mi_len)
+        bounds = np.append(starts, n)
+        n_total = len(bounds) - 1
+
+        first_mi = batch.tag_bytes(self.tag, int(bounds[0])).decode()
+        merge_carry = self._carry is not None and self._carry[0] == first_mi
+        if merge_carry:
+            self._carry[1].extend(
+                batch.raw_records(np.arange(bounds[0], bounds[1])))
+
+        g0 = 1 if merge_carry else 0
+        g1 = n_total if final else max(n_total - 1, g0)
+        deferred = None
+        if not final and n_total - 1 >= g0:
+            lo, hi = bounds[n_total - 1], bounds[n_total]
+            deferred = (batch.tag_bytes(self.tag, int(lo)).decode(),
+                        batch.raw_records(np.arange(lo, hi)))
+
+        molecules = []
+        if self._carry is not None:
+            if (not merge_carry) or final or n_total >= 2:
+                mi, recs = self._carry
+                self._carry = None
+                mol = self.caller.prepare(recs, umi=mi)
+                if mol is not None:
+                    molecules.append(mol)
+
+        if g1 > g0:
+            molecules.extend(self._prepare_span(batch, bounds, g0, g1))
+
+        if deferred is not None:
+            self._carry = deferred
+
+        out = self._run(molecules)
+        if final:
+            out.extend(self.flush())
+        return out
+
+    def flush(self):
+        if self._carry is None:
+            return []
+        mi, recs = self._carry
+        self._carry = None
+        mol = self.caller.prepare(recs, umi=mi)
+        return self._run([mol] if mol is not None else [])
+
+    def _run(self, molecules):
+        """The classic call_groups tail: one device pass + per-molecule
+        finish (codec.py:566-599)."""
+        caller = self.caller
+        if not molecules:
+            return []
+        jobs = []
+        for mol in molecules:
+            jobs.extend([mol["job_r1"], mol["job_r2"]])
+        results = caller.ss._run_jobs(jobs)
+        out = []
+        for i, mol in enumerate(molecules):
+            vcr_r1 = caller.ss.result_to_consensus_read(mol["job_r1"],
+                                                        results[2 * i])
+            vcr_r2 = caller.ss.result_to_consensus_read(mol["job_r2"],
+                                                        results[2 * i + 1])
+            try:
+                rec = caller._finish(mol, vcr_r1, vcr_r2)
+            except DuplexDisagreementError:
+                rec = None
+            if rec is not None:
+                out.append(rec)
+        return out
+
+    # ---------------------------------------------------------------- prepare
+
+    def _prepare_span(self, batch, bounds, g0, g1):
+        """Vectorized prepare for complete groups [g0, g1); shape-ineligible
+        molecules run the classic prepare in stream order."""
+        caller = self.caller
+        buf = batch.buf
+        lo, hi = int(bounds[g0]), int(bounds[g1])
+        span = np.arange(lo, hi)
+        flag = batch.flag
+        l_seq = batch.l_seq
+
+        # single-op all-M CIGAR covering the whole read
+        co = batch.cigar_off
+        v = np.zeros(len(span), dtype=np.uint32)
+        for j in range(4):
+            v |= buf[co[span] + j].astype(np.uint32) << (8 * j)
+        m_only = ((batch.n_cigar[span] == 1) & ((v & 0xF) == 0)
+                  & ((v >> 4) == l_seq[span]) & (l_seq[span] > 0))
+        fl = flag[span]
+        paired_primary = ((fl & FLAG_PAIRED) != 0) \
+            & ((fl & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY)) == 0)
+        row_ok = m_only | ~paired_primary
+        g_of_row = np.repeat(np.arange(g1 - g0),
+                             np.diff(bounds[g0:g1 + 1]))
+        grp_ok = np.ones(g1 - g0, dtype=bool)
+        np.logical_and.at(grp_ok, g_of_row, row_ok)
+
+        # clip/pack pass shared by every eligible molecule of the span:
+        # pairing fills clips, then one native pack covers all kept rows
+        mols = []
+        pack_rows = []     # absolute rows, in job order per molecule
+        pack_clips = []
+        pending = []       # (kind, payload) preserving stream order
+        for g in range(g0, g1):
+            rows = np.arange(int(bounds[g]), int(bounds[g + 1]))
+            mi = batch.tag_bytes(self.tag, int(rows[0])).decode()
+            if not grp_ok[g - g0]:
+                # classic prepare runs HERE, in stream order — the shared
+                # downsample RNG stream must see molecules in input order
+                mol = caller.prepare(batch.raw_records(rows), umi=mi)
+                pending.append(("mol", mol) if mol is not None
+                               else ("none", None))
+                continue
+            prep = self._prepare_molecule_vec(batch, rows, mi, pack_rows,
+                                              pack_clips)
+            pending.append(("vec", prep) if prep is not None
+                           else ("none", None))
+
+        codes_pk = quals_pk = None
+        if pack_rows:
+            rows_arr = np.asarray(pack_rows, dtype=np.int64)
+            stride = max(-(-int(l_seq[rows_arr].max()) // 32) * 32, 32)
+            rev = ((flag[rows_arr] & FLAG_REVERSE) != 0).astype(np.uint8)
+            codes_pk, quals_pk, _ = nb.pack_reads(
+                buf, np.ascontiguousarray(batch.seq_off[rows_arr]),
+                np.ascontiguousarray(batch.qual_off[rows_arr]),
+                l_seq[rows_arr], rev,
+                np.asarray(pack_clips, dtype=np.int32), 0, stride, mode=3)
+
+        for item in pending:
+            if item[0] == "mol":
+                mols.append(item[1])
+            elif item[0] == "vec":
+                mols.append(self._finalize_vec(batch, item[1], codes_pk,
+                                               quals_pk))
+        return [m for m in mols if m is not None]
+
+    def _prepare_molecule_vec(self, batch, rows, mi, pack_rows, pack_clips):
+        """Phases 1-4 on arrays; returns a partial mol (pack indices staged)
+        or None (rejected, reasons recorded like classic prepare)."""
+        caller = self.caller
+        st = caller.stats
+        opts = caller.options
+        flag = batch.flag
+        l_seq = batch.l_seq
+        pos = batch.pos
+        st.total_input_reads += len(rows)
+
+        fl = flag[rows]
+        frag = int(((fl & FLAG_PAIRED) == 0).sum())
+        if frag:
+            st.reject("FragmentRead", frag)
+        pp = rows[((fl & FLAG_PAIRED) != 0)
+                  & ((fl & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY)) == 0)]
+        if len(pp) == 0:
+            return None
+
+        # phase 2: first-appearance name buckets, one FR pair per template
+        by_name = {}
+        for k in range(len(pp)):
+            by_name.setdefault(batch.name(int(pp[k])), []).append(k)
+        pairs = []  # (r1_row, r2_row)
+        for name, bucket in by_name.items():
+            if len(bucket) != 2 or not self._is_primary_fr_pair(
+                    batch, int(pp[bucket[0]]), int(pp[bucket[1]])):
+                st.reject("NotPrimaryFrPair", len(bucket))
+                continue
+            ra, rb = int(pp[bucket[0]]), int(pp[bucket[1]])
+            pairs.append((ra, rb) if flag[ra] & FLAG_FIRST else (rb, ra))
+        if not pairs:
+            return None
+        if len(pairs) < opts.min_reads_per_strand:
+            st.reject("InsufficientReads", 2 * len(pairs))
+            return None
+
+        max_pairs = opts.max_reads_per_strand
+        if max_pairs is not None and len(pairs) > max_pairs:
+            idxs = sorted(caller._rng.permutation(len(pairs))[:max_pairs])
+            pairs = [pairs[i] for i in idxs]
+
+        # clip + adjusted position + clipped length (all-M closed forms)
+        def clip_vs(ra, rb):
+            ms = pos[rb] + 1
+            me = pos[rb] + l_seq[rb]
+            p1 = pos[ra] + 1
+            L = int(l_seq[ra])
+            if flag[ra] & FLAG_REVERSE:
+                if p1 <= ms:
+                    d = int(ms - p1)
+                    return d if d < L else 0
+                return 0
+            end1 = p1 - 1 + L
+            if end1 >= me:
+                if me < p1 or me >= p1 + L:
+                    bp = 0
+                else:
+                    bp = int(me - p1 + 1)
+                return max(L - bp, 0)
+            return 0
+
+        def info(r, clip):
+            rev = bool(flag[r] & FLAG_REVERSE)
+            ref_consumed = min(clip, int(l_seq[r]))
+            adj = int(pos[r]) + 1 + (ref_consumed if rev else 0)
+            return (r, clip, rev, max(int(l_seq[r]) - clip, 0), adj)
+
+        r1i = []
+        r2i = []
+        for ra, rb in pairs:
+            r1i.append(info(ra, clip_vs(ra, rb)))
+            r2i.append(info(rb, clip_vs(rb, ra)))
+        # phase 3 (most-common-alignment filter): single-op M CIGARs always
+        # form one prefix-compatible group -> keep all, no rejects
+        n_filtered = len(r1i) + len(r2i)
+
+        # phase 4: overlap geometry on the longest strands (first max)
+        cl1 = np.array([i[3] for i in r1i])
+        cl2 = np.array([i[3] for i in r2i])
+        L1 = r1i[int(np.argmax(cl1))]
+        L2 = r2i[int(np.argmax(cl2))]
+        r1_neg, r2_neg = L1[2], L2[2]
+        Lpos, Lneg = (L2, L1) if r1_neg else (L1, L2)
+        overlap_start = Lneg[4]
+        pos_end = Lpos[4] + max(Lpos[3] - 1, 0)
+        duplex_length = pos_end - overlap_start + 1
+        if duplex_length < opts.min_duplex_length:
+            st.reject("InsufficientOverlap", n_filtered)
+            return None
+
+        def rp(i, p):
+            adj, cl = i[4], i[3]
+            if adj <= p <= adj + cl - 1:
+                return p - adj + 1
+            return None
+
+        r1s, r2s = rp(L1, overlap_start), rp(L2, overlap_start)
+        r1e, r2e = rp(L1, pos_end), rp(L2, pos_end)
+        if None in (r1s, r2s, r1e, r2e) or (r1s - r2s) != (r1e - r2e):
+            st.reject("IndelErrorBetweenStrands", n_filtered)
+            return None
+        p = rp(Lpos, pos_end)
+        n_ = rp(Lneg, pos_end)
+        if p is None or n_ is None:
+            st.reject("IndelErrorBetweenStrands", n_filtered)
+            return None
+        consensus_length = p + Lneg[3] - n_
+
+        # stage the pack rows (r1 strand then r2 strand, pair order)
+        pk0 = len(pack_rows)
+        for i in r1i:
+            pack_rows.append(i[0])
+            pack_clips.append(i[1])
+        for i in r2i:
+            pack_rows.append(i[0])
+            pack_clips.append(i[1])
+        return {
+            "mi": mi, "rows": rows, "r1i": r1i, "r2i": r2i, "pk0": pk0,
+            "r1_neg": r1_neg, "r2_neg": r2_neg,
+            "consensus_length": consensus_length,
+        }
+
+    def _finalize_vec(self, batch, prep, codes_pk, quals_pk):
+        """Phase 5: SourceReads from the packed rows + SS jobs + mol dict."""
+        caller = self.caller
+        flag = batch.flag
+        r1i, r2i = prep["r1i"], prep["r2i"]
+        pk = prep["pk0"]
+        umi = prep["mi"]
+
+        def sources(infos, base):
+            out = []
+            for k, i in enumerate(infos):
+                flen = i[3]
+                out.append(SourceRead(
+                    original_idx=k,
+                    codes=codes_pk[base + k, :flen],
+                    quals=quals_pk[base + k, :flen],
+                    simplified_cigar=[("M", flen)] if flen else [],
+                    flags=int(flag[i[0]])))
+            return out
+
+        r1_sources = sources(r1i, pk)
+        r2_sources = sources(r2i, pk + len(r1i))
+        umi_str = umi or ""
+        job_r1 = caller.ss.job_from_source_reads(umi_str, R1, r1_sources)
+        job_r2 = caller.ss.job_from_source_reads(umi_str, R1, r2_sources)
+        if job_r1 is None or job_r2 is None:
+            return None
+        records = batch.raw_records(prep["rows"])
+        row_to_rec = {int(r): rec for r, rec in zip(prep["rows"], records)}
+        return {
+            "umi": umi, "records": records,
+            "job_r1": job_r1, "job_r2": job_r2,
+            "n_r1": len(r1i), "n_r2": len(r2i),
+            "r1_is_negative": prep["r1_neg"],
+            "r2_is_negative": prep["r2_neg"],
+            "consensus_length": prep["consensus_length"],
+            "source_raws": [row_to_rec[i[0]] for i in r1i + r2i],
+        }
+
+    @staticmethod
+    def _is_primary_fr_pair(batch, ia, ib):
+        """is_primary_fr_pair + is_fr_pair for all-M records (overlap.py:96-156)."""
+        flag = batch.flag
+        fa, fb = int(flag[ia]), int(flag[ib])
+        if (fa | fb) & (FLAG_UNMAPPED | FLAG_MATE_UNMAPPED):
+            return False
+        if batch.ref_id[ia] != batch.ref_id[ib]:
+            return False
+        a_rev = bool(fa & FLAG_REVERSE)
+        if a_rev == bool(fb & FLAG_REVERSE):
+            return False
+        r = ia if a_rev else ib
+        rf = int(flag[r])
+        if batch.ref_id[r] != batch.next_ref_id[r]:
+            return False
+        if bool(rf & FLAG_REVERSE) == bool(rf & 0x20):  # mate-reverse flag
+            return False
+        # is_fr_pair on the reverse-strand record (M-only: ref_len == l_seq)
+        start = int(batch.pos[r]) + 1
+        mate_start = int(batch.next_pos[r]) + 1
+        if rf & FLAG_REVERSE:
+            end = start + max(int(batch.l_seq[r]) - 1, 0)
+            positive_5p, negative_5p = mate_start, end
+        else:
+            positive_5p, negative_5p = start, start + int(batch.tlen[r])
+        return positive_5p < negative_5p

@@ -23,6 +23,8 @@ _SRC_PATH = os.path.join(_HERE, "fgumi_native.cc")
 _lock = threading.Lock()
 _lib = None
 _lib_failed = False
+# must equal fgumi_abi_version() in fgumi_native.cc (stale-.so guard)
+_ABI_VERSION = 4
 
 
 def _build() -> bool:
@@ -63,9 +65,16 @@ def get_lib():
             _lib_failed = True
             return None
         # stale-.so guard: a cached build whose mtime ties the source (e.g.
-        # archive extraction) passes the rebuild check but may lack newer
-        # symbols; probe the newest export and rebuild once if absent
-        if not hasattr(lib, "fgumi_zlib_compress"):
+        # archive extraction) passes the rebuild check but may predate newer
+        # symbols OR carry old signatures; check the versioned ABI export
+        # (bumped in fgumi_native.cc on any signature change) and rebuild
+        def _abi_ok(candidate):
+            if not hasattr(candidate, "fgumi_abi_version"):
+                return False
+            candidate.fgumi_abi_version.restype = ctypes.c_long
+            return candidate.fgumi_abi_version() == _ABI_VERSION
+
+        if not _abi_ok(lib):
             if not _build():
                 _lib_failed = True
                 return None
@@ -75,7 +84,7 @@ def get_lib():
                 log.debug("native library reload failed: %s", e)
                 _lib_failed = True
                 return None
-            if not hasattr(lib, "fgumi_zlib_compress"):
+            if not _abi_ok(lib):
                 _lib_failed = True
                 return None
         lib.fgumi_bgzf_decompress.restype = ctypes.c_long
@@ -110,7 +119,8 @@ def get_lib():
         lib.fgumi_group_starts.argtypes = [p, p, p, ctypes.c_long, p]
         lib.fgumi_pack_reads.restype = None
         lib.fgumi_pack_reads.argtypes = [p, p, p, p, p, p, ctypes.c_long,
-                                         ctypes.c_int, ctypes.c_long, p, p, p]
+                                         ctypes.c_int, ctypes.c_long,
+                                         ctypes.c_int, p, p, p]
         lib.fgumi_mate_clips.restype = None
         lib.fgumi_mate_clips.argtypes = [p] * 11 + [ctypes.c_long, p]
         lib.fgumi_overlap_correct_pairs.restype = None

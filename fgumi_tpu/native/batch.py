@@ -93,11 +93,12 @@ def group_starts(buf: np.ndarray, off: np.ndarray, length: np.ndarray):
 
 def pack_reads(buf: np.ndarray, seq_off: np.ndarray, qual_off: np.ndarray,
                l_seq: np.ndarray, reverse: np.ndarray, clip: np.ndarray,
-               min_q: int, stride: int):
+               min_q: int, stride: int, mode: int = 0):
     """Batch SourceRead conversion into (n, stride) code/qual rows.
 
     Returns (codes uint8[n,stride], quals uint8[n,stride], final_len int32[n]);
-    final_len -1 marks rejected reads (empty / all-0xFF quals).
+    final_len -1 marks rejected reads (empty / all-0xFF quals). mode bit0
+    keeps all-0xFF reads, bit1 keeps trailing Ns (the CODEC conversion).
     """
     lib = get_lib()
     n = len(seq_off)
@@ -111,7 +112,8 @@ def pack_reads(buf: np.ndarray, seq_off: np.ndarray, qual_off: np.ndarray,
     lib.fgumi_pack_reads(
         _addr(buf), _addr(seq_off), _addr(qual_off), _addr(l_seq),
         _addr(reverse), _addr(clip),
-        n, min_q, stride, _addr(codes), _addr(quals), _addr(final_len))
+        n, min_q, stride, mode, _addr(codes), _addr(quals),
+        _addr(final_len))
     return codes, quals, final_len
 
 

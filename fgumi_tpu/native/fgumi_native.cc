@@ -80,6 +80,11 @@ long parse_bgzf_header(const uint8_t* src, long len, long* data_off) {
 
 extern "C" {
 
+// ABI version for the stale-.so guard in __init__.py: bump whenever any
+// exported signature changes (a symbol probe alone cannot detect an
+// argument-list change in an existing function).
+long fgumi_abi_version() { return 4; }
+
 // Decompress as many complete BGZF blocks from src as fit in dst.
 // Returns bytes produced; sets *consumed to the input bytes consumed (whole
 // blocks only — a trailing partial block is left for the caller's next call).
@@ -1507,8 +1512,11 @@ long fgumi_group_starts(const uint8_t* buf, const int64_t* off,
 void fgumi_pack_reads(const uint8_t* buf, const int64_t* seq_off,
                       const int64_t* qual_off, const int32_t* l_seq,
                       const uint8_t* reverse, const int32_t* clip, long n,
-                      int min_q, long stride, uint8_t* codes, uint8_t* quals,
-                      int32_t* final_len) {
+                      int min_q, long stride, int mode, uint8_t* codes,
+                      uint8_t* quals, int32_t* final_len) {
+  // mode bit0: keep all-0xFF-quality reads (no -1 rejection); bit1: keep
+  // trailing Ns (no final-length trim) — the CODEC SourceRead conversion
+  // (codec_caller.rs:467-532) does neither of the vanilla post-steps.
   for (long i = 0; i < n; ++i) {
     uint8_t* crow = codes + i * stride;
     uint8_t* qrow = quals + i * stride;
@@ -1522,9 +1530,9 @@ void fgumi_pack_reads(const uint8_t* buf, const int64_t* seq_off,
     }
     const uint8_t* packed = buf + seq_off[i];
     const uint8_t* q = buf + qual_off[i];
-    bool all_ff = true;
-    for (int64_t j = 0; j < read_len; ++j) {
-      if (q[j] != 0xFF) { all_ff = false; break; }
+    bool all_ff = (mode & 1) == 0;
+    for (int64_t j = 0; all_ff && j < read_len; ++j) {
+      if (q[j] != 0xFF) all_ff = false;
     }
     if (all_ff) {
       final_len[i] = -1;
@@ -1558,7 +1566,9 @@ void fgumi_pack_reads(const uint8_t* buf, const int64_t* seq_off,
     }
     int64_t final_n = read_len - clip[i];
     if (final_n < 0) final_n = 0;
-    while (final_n > 0 && crow[final_n - 1] == 4) --final_n;
+    if (!(mode & 2)) {
+      while (final_n > 0 && crow[final_n - 1] == 4) --final_n;
+    }
     final_len[i] = static_cast<int32_t>(final_n);
     if (final_n < stride) {
       std::memset(crow + final_n, 4, static_cast<size_t>(stride - final_n));

@@ -1,0 +1,161 @@
+"""Parity: FastCodecCaller (vectorized prepare) vs classic CODEC engine."""
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.cli import main
+from fgumi_tpu.io.bam import BamHeader, BamReader, BamWriter, RecordBuilder
+from fgumi_tpu.native import batch as nb
+from fgumi_tpu.simulate import simulate_codec_bam
+
+pytestmark = pytest.mark.skipif(not nb.available(),
+                                reason="native library unavailable")
+
+
+def records_of(path):
+    with BamReader(path) as r:
+        return [rec.data for rec in r]
+
+
+def assert_cli_parity(src, tmp_path, extra=()):
+    fast = str(tmp_path / "fast.bam")
+    classic = str(tmp_path / "classic.bam")
+    assert main(["codec", "-i", src, "-o", fast] + list(extra)) == 0
+    assert main(["codec", "-i", src, "-o", classic, "--classic"]
+                + list(extra)) == 0
+    assert records_of(fast) == records_of(classic)
+
+
+@pytest.fixture(scope="module")
+def codec_bam(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("fc") / "codec.bam")
+    simulate_codec_bam(path, num_molecules=300, pairs_per_molecule=3, seed=9)
+    return path
+
+
+@pytest.mark.parametrize("extra", [
+    ["--min-reads", "1"],
+    ["--min-reads", "2"],
+    ["--min-reads", "1", "--min-duplex-length", "120"],
+    ["--min-reads", "1", "--max-reads", "2"],
+    ["--min-reads", "1", "--outer-bases-qual", "10",
+     "--outer-bases-length", "4"],
+])
+def test_parity_simulated(codec_bam, tmp_path, extra):
+    assert_cli_parity(codec_bam, tmp_path, extra)
+
+
+@pytest.fixture(scope="module")
+def adversarial_bam(tmp_path_factory):
+    """Hand-built MI groups: fragments, secondary/supp, non-FR pairs,
+    soft-clipped CIGARs (classic fallback), name triplets, dovetails,
+    missing mates, 0-length overlap."""
+    path = str(tmp_path_factory.mktemp("fc") / "adv.bam")
+    rng = np.random.default_rng(33)
+    header = BamHeader(
+        text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n@SQ\tSN:c\tLN:100000\n",
+        ref_names=["c"], ref_lengths=[100000])
+
+    def rec(name, flag, pos, length=60, mi=b"0", cigar=None, next_pos=None,
+            tlen=0):
+        cigar = cigar or [("M", length)]
+        sq = bytes(rng.choice(np.frombuffer(b"ACGT", np.uint8), size=length))
+        b = RecordBuilder().start_mapped(
+            name, flag, 0, pos, 60, cigar, sq,
+            rng.integers(10, 41, size=length).astype(np.uint8),
+            next_ref_id=0 if next_pos is not None else -1,
+            next_pos=next_pos if next_pos is not None else -1, tlen=tlen)
+        b.tag_str(b"MI", mi)
+        b.tag_str(b"RX", b"ACGTAC")
+        return b.finish()
+
+    def fr_pair(name, mi, p1, p2, length=60):
+        tlen = p2 + length - p1
+        return [rec(name, 0x1 | 0x40 | 0x20, p1, length, mi,
+                    next_pos=p2, tlen=tlen),
+                rec(name, 0x1 | 0x80 | 0x10, p2, length, mi,
+                    next_pos=p1, tlen=-tlen)]
+
+    records = []
+    # mol 0: clean overlapping FR pairs
+    for t in range(3):
+        records += fr_pair(b"m0t%d" % t, b"0", 1000, 1020)
+    # mol 1: dovetailing pairs (reads extend past mate ends -> clips)
+    for t in range(2):
+        records += fr_pair(b"m1t%d" % t, b"1", 2000, 1980)
+    # mol 2: a fragment + a secondary + one good pair
+    records.append(rec(b"m2f", 0, 3000, mi=b"2"))
+    records.append(rec(b"m2s", 0x1 | 0x40 | 0x100, 3000, mi=b"2",
+                       next_pos=3020))
+    records += fr_pair(b"m2t0", b"2", 3000, 3020)
+    # mol 3: same-strand pair (NotPrimaryFrPair)
+    records.append(rec(b"m3t0", 0x1 | 0x40, 4000, mi=b"3", next_pos=4020,
+                       tlen=80))
+    records.append(rec(b"m3t0", 0x1 | 0x80, 4020, mi=b"3", next_pos=4000,
+                       tlen=-80))
+    # mol 4: soft-clipped pair (classic fallback path)
+    records.append(rec(b"m4t0", 0x1 | 0x40 | 0x20, 5000, mi=b"4",
+                       cigar=[("S", 4), ("M", 56)], next_pos=5010, tlen=70))
+    records.append(rec(b"m4t0", 0x1 | 0x80 | 0x10, 5010, mi=b"4",
+                       cigar=[("M", 56), ("S", 4)], next_pos=5000, tlen=-70))
+    records += fr_pair(b"m4t1", b"4", 5000, 5010)
+    # mol 5: widely separated pair (no overlap)
+    records += fr_pair(b"m5t0", b"5", 6000, 9000)
+    # mol 6: name triplet (rejected bucket)
+    records += fr_pair(b"m6t0", b"6", 7000, 7020)
+    records.append(rec(b"m6t0", 0x1 | 0x40, 7000, mi=b"6", next_pos=7020,
+                       tlen=80))
+    records += fr_pair(b"m6t1", b"6", 7000, 7020)
+    with BamWriter(path, header) as w:
+        for r in records:
+            w.write_record_bytes(r)
+    return path
+
+
+@pytest.mark.parametrize("extra", [["--min-reads", "1"],
+                                   ["--min-reads", "2"],
+                                   ["--min-reads", "1", "--max-reads", "1"]])
+def test_parity_adversarial(adversarial_bam, tmp_path, extra):
+    # --max-reads on the mixed-shape fixture exercises the shared downsample
+    # RNG stream across interleaved classic/vector molecules
+    assert_cli_parity(adversarial_bam, tmp_path, extra)
+
+
+def test_all_m_filter_keeps_all():
+    """Single-op M CIGARs of any length mix form one prefix-compatible
+    group (the vector path's keep-all assumption for phase 3)."""
+    from fgumi_tpu.core.cigar import select_most_common_alignment_group
+
+    entries = [(i, L, [("M", L)]) for i, L in
+               enumerate([60, 55, 60, 40, 58, 60, 1])]
+    entries.sort(key=lambda t: -t[1])
+    keep = select_most_common_alignment_group(entries)
+    assert sorted(keep) == list(range(7))
+
+
+def test_parity_tiny_batches(codec_bam):
+    """Molecules spanning batch boundaries: carry merge + deferred flush."""
+    from fgumi_tpu.consensus.codec import CodecConsensusCaller, CodecOptions
+    from fgumi_tpu.consensus.fast_codec import FastCodecCaller
+    from fgumi_tpu.core.grouper import iter_mi_group_batches
+    from fgumi_tpu.io.batch_reader import BamBatchReader
+
+    def run_fast(tb):
+        caller = CodecConsensusCaller("fgumi", "A", CodecOptions())
+        fast = FastCodecCaller(caller, b"MI")
+        out = []
+        with BamBatchReader(codec_bam, target_bytes=tb) as r:
+            for batch in r:
+                out.extend(fast.process_batch(batch))
+        out.extend(fast.flush())
+        return out, caller.stats.rejection_reasons
+
+    caller = CodecConsensusCaller("fgumi", "A", CodecOptions())
+    with BamReader(codec_bam) as r:
+        expected = []
+        for batch in iter_mi_group_batches(r, 50, tag=b"MI"):
+            expected.extend(caller.call_groups(batch))
+    for tb in (600, 5000):
+        got, rej = run_fast(tb)
+        assert got == expected, tb
+        assert rej == caller.stats.rejection_reasons

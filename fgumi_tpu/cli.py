@@ -6,10 +6,96 @@ argparse-based. One subcommand per tool; shared options grouped like commands/co
 
 import argparse
 import logging
+import os
 import sys
 import time
 
 log = logging.getLogger("fgumi_tpu")
+
+
+_DEFAULT_SCHEDULER = "balanced-chase-drain"
+
+
+def _add_pipeline_compat(p):
+    """Reference pipeline-tuning flags, accepted for CLI compatibility.
+
+    The batch engines replace the reference's adaptive worker scheduler
+    (scheduler/mod.rs:70-178) and deadlock watchdog (deadlock.rs:1-60) with a
+    fixed reader->process->writer stage pipeline over bounded queues, so most
+    of these knobs have no behavior to tune here; they parse cleanly (a
+    migrating user's scripts keep working) and `_apply_pipeline_compat` maps
+    the ones that do have a counterpart (common.rs:625-646,954).
+    """
+    p.add_argument("--scheduler", default=_DEFAULT_SCHEDULER,
+                   metavar="NAME",
+                   help="accepted for compatibility; the batch engine uses a "
+                        "fixed stage schedule")
+    p.add_argument("--pipeline-stats", action="store_true",
+                   help="alias for --stats on commands that report a "
+                        "per-stage timing table")
+    p.add_argument("--deadlock-timeout", type=float, default=60.0,
+                   metavar="SECONDS",
+                   help="accepted for compatibility (bounded queues with a "
+                        "stop event cannot deadlock)")
+    p.add_argument("--deadlock-recover", action="store_true",
+                   help="accepted for compatibility")
+    p.add_argument("--async-reader", action="store_true",
+                   help="accepted for compatibility (the reader thread is "
+                        "already asynchronous when --threads >= 2)")
+    p.add_argument("--memory-per-thread", default=None, metavar="SIZE",
+                   help="per-thread working-set budget; multiplied by the "
+                        "thread count into --max-memory when that knob exists")
+
+
+def _apply_pipeline_compat(args):
+    """Map accepted compat flags onto this engine's knobs (called once after
+    parse_args; commands without the flags are untouched). Returns an exit
+    code: 0, or 2 on an unparseable value."""
+    if getattr(args, "memory_per_thread", None):
+        from .utils.memory import parse_size
+
+        try:
+            per = parse_size(args.memory_per_thread)
+        except ValueError as e:
+            log.error("--memory-per-thread: %s", e)
+            return 2
+        # reference semantics are per-worker x worker-count (common.rs:954);
+        # with no explicit --threads the reference defaults to the core
+        # count, so mirror that rather than collapsing to x1
+        threads = int(getattr(args, "threads", 0) or 0)
+        n = threads if threads > 0 else (os.cpu_count() or 1)
+        mm = getattr(args, "max_memory", None)
+        if mm is not None and str(mm).strip().lower() != "auto":
+            log.info("--memory-per-thread: --max-memory %s set explicitly "
+                     "and takes precedence", args.max_memory)
+        elif hasattr(args, "max_memory"):
+            # explicit byte suffix: a bare number means MiB to parse_size
+            args.max_memory = f"{per * n}B"
+        else:
+            log.info("--memory-per-thread: no memory knob on this command; "
+                     "ignored")
+    if getattr(args, "scheduler", _DEFAULT_SCHEDULER) != _DEFAULT_SCHEDULER:
+        log.info("--scheduler %s: accepted for compatibility; the batch "
+                 "engine uses a fixed reader->process->writer schedule",
+                 args.scheduler)
+    if getattr(args, "deadlock_recover", False):
+        log.info("--deadlock-recover: accepted for compatibility; bounded "
+                 "queues with a stop event cannot deadlock")
+    if getattr(args, "pipeline_stats", False):
+        if hasattr(args, "stats"):
+            args.stats = True
+        else:
+            log.info("--pipeline-stats: this command reports no per-stage "
+                     "timing table; ignored")
+    if getattr(args, "async_reader", False) \
+            and int(getattr(args, "threads", 0) or 0) < 2:
+        if hasattr(args, "threads"):
+            log.info("--async-reader: accepted for compatibility; add "
+                     "--threads >= 2 for an asynchronous reader thread")
+        else:
+            log.info("--async-reader: accepted for compatibility (this "
+                     "command reads inline)")
+    return 0
 
 
 def _unmapped_consensus_header(read_group_id: str):
@@ -113,6 +199,7 @@ def _add_simplex(sub):
                    help="device count for data-parallel consensus dispatch: "
                         "auto (all visible), or an explicit N; 1 disables "
                         "sharding (fast engine only)")
+    _add_pipeline_compat(p)
     p.set_defaults(func=cmd_simplex)
 
 
@@ -303,6 +390,7 @@ def _add_duplex(sub):
                    help="print per-stage pipeline timing table")
     p.add_argument("--classic", action="store_true",
                    help="force the per-molecule engine (no batch vectorization)")
+    _add_pipeline_compat(p)
     p.set_defaults(func=cmd_duplex)
 
 
@@ -559,6 +647,7 @@ def _add_codec(sub):
     p.add_argument("--batch-groups", type=int, default=1000)
     p.add_argument("--classic", action="store_true",
                    help="force the per-molecule engine (no batch vectorization)")
+    _add_pipeline_compat(p)
     p.set_defaults(func=cmd_codec)
 
 
@@ -682,6 +771,7 @@ def _add_group(sub):
                    help="print per-stage pipeline timing table")
     p.add_argument("--classic", action="store_true",
                    help="force the per-template engine (no batch vectorization)")
+    _add_pipeline_compat(p)
     p.set_defaults(func=cmd_group)
 
 
@@ -793,6 +883,7 @@ def _add_sort(sub):
                    help="write an index alongside coordinate-sorted output")
     p.add_argument("--index-format", default="bai", choices=["bai", "csi"],
                    help="index flavor (csi handles references > 512 Mbp)")
+    _add_pipeline_compat(p)
     p.set_defaults(func=cmd_sort)
 
 
@@ -904,6 +995,7 @@ def _add_merge(sub):
     p.add_argument("--order", default="template-coordinate",
                    choices=["coordinate", "queryname", "template-coordinate"])
     p.add_argument("--subsort", default="natural", choices=["natural", "lex"])
+    _add_pipeline_compat(p)
     p.set_defaults(func=cmd_merge)
 
 
@@ -983,6 +1075,7 @@ def _add_fastq(sub):
     p = sub.add_parser("fastq", help="BAM -> mate-paired interleaved FASTQ")
     p.add_argument("-i", "--input", required=True)
     p.add_argument("-o", "--output", default="-", help="output FASTQ (- for stdout)")
+    _add_pipeline_compat(p)
     p.set_defaults(func=cmd_fastq)
 
 
@@ -1061,6 +1154,7 @@ def _add_extract(sub):
     p.add_argument("--description", default=None)
     p.add_argument("--run-date", default=None)
     p.add_argument("--comment", nargs="*", default=[])
+    _add_pipeline_compat(p)
     p.set_defaults(func=cmd_extract)
 
 
@@ -1180,6 +1274,7 @@ def _add_zipper(sub):
     p.add_argument("--exclude-missing-reads", nargs="?", const=True,
                    default=False, type=_parse_bool,
                    help="drop unmapped-BAM reads the aligner omitted")
+    _add_pipeline_compat(p)
     p.set_defaults(func=cmd_zipper)
 
 
@@ -1256,6 +1351,7 @@ def _add_filter(sub):
                         "(required for mapped input)")
     p.add_argument("--classic", action="store_true",
                    help="force the per-record engine (no batch vectorization)")
+    _add_pipeline_compat(p)
     p.set_defaults(func=cmd_filter)
 
 
@@ -1380,6 +1476,7 @@ def _add_downsample(sub):
                    default=True, type=_parse_bool)
     p.add_argument("--histogram-kept", default=None)
     p.add_argument("--histogram-rejected", default=None)
+    _add_pipeline_compat(p)
     p.set_defaults(func=cmd_downsample)
 
 
@@ -1618,6 +1715,7 @@ def _add_clip(sub):
     p.add_argument("-a", "--auto-clip-attributes", action="store_true",
                    help="hard-clip per-base tags matching read length")
     p.add_argument("-m", "--metrics", default=None)
+    _add_pipeline_compat(p)
     p.set_defaults(func=cmd_clip)
 
 
@@ -1685,6 +1783,7 @@ def _add_correct(sub):
                    help="fail if kept/total falls below this fraction")
     p.add_argument("--revcomp", action="store_true",
                    help="reverse-complement observed UMIs before matching")
+    _add_pipeline_compat(p)
     p.set_defaults(func=cmd_correct)
 
 
@@ -1780,6 +1879,7 @@ def _add_dedup(sub):
                    help="print per-stage pipeline timing table")
     p.add_argument("--classic", action="store_true",
                    help="force the per-template engine (no batch vectorization)")
+    _add_pipeline_compat(p)
     p.set_defaults(func=cmd_dedup)
 
 
@@ -1877,7 +1977,7 @@ def cmd_dedup(args):
     return 0
 
 
-def main(argv=None):
+def build_parser():
     parser = argparse.ArgumentParser(
         prog="fgumi-tpu",
         description="TPU-native toolkit for UMI-tagged sequencing data",
@@ -1903,11 +2003,19 @@ def main(argv=None):
     _add_fastq(sub)
     _add_downsample(sub)
     _add_simulate(sub)
+    return parser
+
+
+def main(argv=None):
+    parser = build_parser()
     args = parser.parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    rc = _apply_pipeline_compat(args)
+    if rc:
+        return rc
     return args.func(args)
 
 

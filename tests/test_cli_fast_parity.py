@@ -120,3 +120,89 @@ def test_sharded_more_devices_than_jobs(sim_bam, tmp_path):
     eight = _run(sim_bam, tmp_path, "sdev8.bam",
                  ("--devices", "8", "--batch-bytes", "4096"))
     assert _payload(one) == _payload(eight)
+
+
+def test_reference_compat_flags_accepted(sim_bam, tmp_path):
+    """The reference's pipeline-tuning flags (common.rs:625-646,954) don't
+    perturb simplex output; test_compat_flags_parse_everywhere covers the
+    other streaming commands' parsers."""
+    plain = _run(sim_bam, tmp_path, "compat_plain.bam")
+    compat = _run(sim_bam, tmp_path, "compat_full.bam",
+                  ("--scheduler", "thompson-sampling",
+                   "--deadlock-timeout", "30", "--deadlock-recover",
+                   "--async-reader", "--threads", "2",
+                   "--memory-per-thread", "256M"))
+    assert _payload(plain) == _payload(compat)
+
+
+def test_memory_per_thread_maps_to_bytes():
+    """--memory-per-thread SIZE x threads lands in --max-memory as an exact
+    byte count (a bare number would be misread as MiB)."""
+    from fgumi_tpu.cli import _apply_pipeline_compat
+    from fgumi_tpu.utils.memory import parse_size
+    import argparse
+
+    args = argparse.Namespace(memory_per_thread="256M", threads=4,
+                              max_memory="auto", scheduler="balanced-chase-drain",
+                              deadlock_recover=False)
+    _apply_pipeline_compat(args)
+    assert parse_size(args.max_memory) == 4 * (256 << 20)
+    # AUTO (any case) is the default, not an explicit override
+    args = argparse.Namespace(memory_per_thread="256M", threads=2,
+                              max_memory="AUTO", scheduler="balanced-chase-drain",
+                              deadlock_recover=False)
+    _apply_pipeline_compat(args)
+    assert parse_size(args.max_memory) == 2 * (256 << 20)
+
+
+def test_pipeline_stats_alias(sim_bam, tmp_path, capsys):
+    _run(sim_bam, tmp_path, "pstats.bam", ("--pipeline-stats", "--threads", "2"))
+    assert "busy_s" in capsys.readouterr().out
+
+
+def test_memory_per_thread_bad_value(sim_bam, tmp_path):
+    """Unparseable --memory-per-thread -> clean exit 2, same as --max-memory."""
+    rc = cli_main(["simplex", "-i", sim_bam, "-o", str(tmp_path / "x.bam"),
+                   "--min-reads", "1", "--memory-per-thread", "256Q"])
+    assert rc == 2
+
+
+def test_explicit_max_memory_wins_over_compat():
+    from fgumi_tpu.cli import _apply_pipeline_compat
+    import argparse
+
+    args = argparse.Namespace(memory_per_thread="64M", threads=0,
+                              max_memory="8G",
+                              scheduler="balanced-chase-drain",
+                              deadlock_recover=False)
+    assert _apply_pipeline_compat(args) == 0
+    assert args.max_memory == "8G"
+
+
+def test_compat_flags_parse_everywhere():
+    """Every streaming command accepts the full reference compat-flag set
+    (a dropped _add_pipeline_compat call or a conflicting new option on any
+    of them fails here)."""
+    from fgumi_tpu.cli import build_parser
+
+    parser = build_parser()
+    compat = ["--scheduler", "ucb", "--pipeline-stats",
+              "--deadlock-timeout", "30", "--deadlock-recover",
+              "--async-reader", "--memory-per-thread", "256M"]
+    io = ["-i", "in.bam", "-o", "out.bam"]
+    minimal = {
+        "extract": io + ["--sample", "s", "--library", "l",
+                         "--read-structures", "8M+T"],
+        "fastq": ["-i", "in.bam"],
+        "zipper": io + ["-u", "un.bam"],
+        "downsample": io + ["-f", "0.5"],
+        "filter": io + ["-M", "1"],
+        "clip": io + ["-r", "ref.fa"],
+    }
+    for cmd in ["extract", "correct", "zipper", "simplex", "duplex", "codec",
+                "filter", "clip", "group", "dedup", "sort", "merge", "fastq",
+                "downsample"]:
+        argv = [cmd] + minimal.get(cmd, io) + compat
+        args = parser.parse_args(argv)
+        assert args.scheduler == "ucb", cmd
+        assert args.memory_per_thread == "256M", cmd

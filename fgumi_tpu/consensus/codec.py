@@ -123,6 +123,75 @@ def _rc_ss(ss: _SS) -> _SS:
                errors=ss.errors[::-1].copy(), raw_read_count=ss.raw_read_count)
 
 
+def combine_arrays(bases_a, bases_b, quals_a, quals_b, da, db, ea, eb):
+    """Elementwise duplex-combine (rs:1127-1296), shared by the classic
+    per-molecule `_combine` and the batch engine's concatenated pass
+    (fast_codec.py `_finish_batch`) so the rules live in one place.
+
+    Inputs are ASCII-base uint8 / qual uint8 / int64 depth+error arrays of
+    equal length; returns (base u8, qual u8, depth, errors, both, disag)
+    with the either-strand N mask and the I16 caps applied.
+    """
+    ba, bb = bases_a.astype(np.int32), bases_b.astype(np.int32)
+    qa, qb = quals_a.astype(np.int32), quals_b.astype(np.int32)
+
+    a_has = (ba != NO_CALL_BASE) & (ba != NO_CALL_BASE_LOWER)
+    b_has = (bb != NO_CALL_BASE) & (bb != NO_CALL_BASE_LOWER)
+    both = a_has & b_has
+    agree = both & (ba == bb)
+    a_wins = both & ~agree & (qa > qb)
+    b_wins = both & ~agree & (qb > qa)
+    tie = both & ~agree & (qa == qb)
+
+    raw_base = np.where(b_wins, bb, ba)  # agree/a_wins/tie keep base A
+    # np.where chains, not np.select: select's broadcast machinery
+    # dominated the per-molecule combine cost
+    raw_qual = np.where(
+        agree, np.minimum(93, qa + qb),
+        np.where(a_wins, np.maximum(MIN_PHRED, qa - qb),
+                 np.where(b_wins, np.maximum(MIN_PHRED, qb - qa),
+                          np.where(tie, np.int32(MIN_PHRED),
+                                   np.int32(0)))))
+    # min-quality masking inside the duplex region (rs:1185-1190)
+    q_masked = both & (raw_qual == MIN_PHRED)
+    dup_base = np.where(q_masked, NO_CALL_BASE, raw_base)
+    dup_qual = np.where(q_masked, MIN_PHRED, raw_qual)
+
+    cap = lambda x: np.minimum(x, I16_MAX)
+    dup_depth = cap(da) + cap(db)
+    chose_a = agree | a_wins | tie
+    dup_err = np.where(agree, ea + eb,
+                       np.where(chose_a, ea + np.maximum(db - eb, 0),
+                                eb + np.maximum(da - ea, 0)))
+
+    only_a = a_has & ~b_has
+    only_b = b_has & ~a_has
+    a_q2 = qa == MIN_PHRED
+    b_q2 = qb == MIN_PHRED
+
+    base = np.where(
+        both, dup_base,
+        np.where(only_a, np.where(a_q2, NO_CALL_BASE, ba),
+                 np.where(only_b, np.where(b_q2, NO_CALL_BASE, bb),
+                          NO_CALL_BASE)))
+    qual = np.where(
+        both, dup_qual,
+        np.where(only_a & ~a_q2, qa,
+                 np.where(only_b & ~b_q2, qb, MIN_PHRED)))
+    depth = np.where(both, dup_depth,
+                     np.where(only_a, da, np.where(only_b, db, 0)))
+    errors = np.where(both, dup_err,
+                      np.where(only_a, ea,
+                               np.where(only_b, eb, cap(ea + eb))))
+
+    # either-strand uppercase-N mask, applied after rawBase math (rs:1253-1260)
+    n_mask = (ba == NO_CALL_BASE) | (bb == NO_CALL_BASE)
+    base = np.where(n_mask, NO_CALL_BASE, base).astype(np.uint8)
+    qual = np.where(n_mask, MIN_PHRED, qual).astype(np.uint8)
+    return (base, qual, np.minimum(depth, 2 * I16_MAX),
+            np.minimum(errors, I16_MAX), both, a_wins | b_wins | tie)
+
+
 def _pad_ss(ss: _SS, new_length: int, pad_left: bool) -> _SS:
     """Pad with lowercase 'n' / Q0 / depth 0 (rs:1064-1116)."""
     cur = len(ss.bases)
@@ -362,69 +431,12 @@ class CodecConsensusCaller:
 
         Returns _SS; raises DuplexDisagreementError on threshold breach.
         """
-        length = len(a.bases)
-        ba, bb = a.bases.astype(np.int32), b.bases.astype(np.int32)
-        qa, qb = a.quals.astype(np.int32), b.quals.astype(np.int32)
-        da, db = a.depths, b.depths
-        ea, eb = a.errors, b.errors
-
-        a_has = (ba != NO_CALL_BASE) & (ba != NO_CALL_BASE_LOWER)
-        b_has = (bb != NO_CALL_BASE) & (bb != NO_CALL_BASE_LOWER)
-        both = a_has & b_has
-        agree = both & (ba == bb)
-        a_wins = both & ~agree & (qa > qb)
-        b_wins = both & ~agree & (qb > qa)
-        tie = both & ~agree & (qa == qb)
-
-        raw_base = np.where(b_wins, bb, ba)  # agree/a_wins/tie keep base A
-        # np.where chains, not np.select: select's broadcast machinery
-        # dominated the per-molecule combine cost
-        raw_qual = np.where(
-            agree, np.minimum(93, qa + qb),
-            np.where(a_wins, np.maximum(MIN_PHRED, qa - qb),
-                     np.where(b_wins, np.maximum(MIN_PHRED, qb - qa),
-                              np.where(tie, np.int32(MIN_PHRED),
-                                       np.int32(0)))))
-        # min-quality masking inside the duplex region (rs:1185-1190)
-        q_masked = both & (raw_qual == MIN_PHRED)
-        dup_base = np.where(q_masked, NO_CALL_BASE, raw_base)
-        dup_qual = np.where(q_masked, MIN_PHRED, raw_qual)
-
-        cap = lambda x: np.minimum(x, I16_MAX)
-        dup_depth = cap(da) + cap(db)
-        chose_a = agree | a_wins | tie
-        dup_err = np.where(agree, ea + eb,
-                           np.where(chose_a, ea + np.maximum(db - eb, 0),
-                                    eb + np.maximum(da - ea, 0)))
-
-        only_a = a_has & ~b_has
-        only_b = b_has & ~a_has
-        neither = ~a_has & ~b_has
-        a_q2 = qa == MIN_PHRED
-        b_q2 = qb == MIN_PHRED
-
-        base = np.where(
-            both, dup_base,
-            np.where(only_a, np.where(a_q2, NO_CALL_BASE, ba),
-                     np.where(only_b, np.where(b_q2, NO_CALL_BASE, bb),
-                              NO_CALL_BASE)))
-        qual = np.where(
-            both, dup_qual,
-            np.where(only_a & ~a_q2, qa,
-                     np.where(only_b & ~b_q2, qb, MIN_PHRED)))
-        depth = np.where(both, dup_depth,
-                         np.where(only_a, da, np.where(only_b, db, 0)))
-        errors = np.where(both, dup_err,
-                          np.where(only_a, ea,
-                                   np.where(only_b, eb, cap(ea + eb))))
-
-        # either-strand uppercase-N mask, applied after rawBase math (rs:1253-1260)
-        n_mask = (ba == NO_CALL_BASE) | (bb == NO_CALL_BASE)
-        base = np.where(n_mask, NO_CALL_BASE, base).astype(np.uint8)
-        qual = np.where(n_mask, MIN_PHRED, qual).astype(np.uint8)
+        base, qual, depth, errors, both, disag = combine_arrays(
+            a.bases, b.bases, a.quals, b.quals, a.depths, b.depths,
+            a.errors, b.errors)
 
         duplex_bases = int(both.sum())
-        disagreements = int((a_wins | b_wins | tie).sum())
+        disagreements = int(disag.sum())
         if duplex_bases:
             self.stats.consensus_duplex_bases_emitted += duplex_bases
             self.stats.duplex_disagreement_base_count += disagreements
@@ -435,8 +447,7 @@ class CodecConsensusCaller:
             if rate > self.options.max_duplex_disagreement_rate:
                 raise DuplexDisagreementError("rate", rate)
 
-        return _SS(bases=base, quals=qual, depths=np.minimum(depth, 2 * I16_MAX),
-                   errors=np.minimum(errors, I16_MAX),
+        return _SS(bases=base, quals=qual, depths=depth, errors=errors,
                    raw_read_count=a.raw_read_count + b.raw_read_count)
 
     def _mask_quals(self, consensus: _SS, padded_r1: _SS, padded_r2: _SS) -> _SS:
@@ -459,8 +470,12 @@ class CodecConsensusCaller:
 
     def _build_record(self, consensus: _SS, ss_a: _SS, ss_b: _SS,
                       umi: Optional[str], source_raws: list,
-                      all_records: list) -> bytes:
-        """build_output_record_into (rs:1374-1539); tag order preserved."""
+                      all_records: list, rx_umis=None) -> bytes:
+        """build_output_record_into (rs:1374-1539); tag order preserved.
+
+        rx_umis: precomputed per-record RX strings (batch engine); None means
+        scan all_records here.
+        """
         self._counter += 1
         name = (f"{self.prefix}:{umi}" if umi
                 else f"{self.prefix}:{self._counter}").encode()
@@ -510,7 +525,8 @@ class CodecConsensusCaller:
                     break
 
         # RX consensus over ALL records in the MI group (rs:1513-1532).
-        umis = [u for u in (r.get_str(b"RX") for r in all_records) if u]
+        umis = (rx_umis if rx_umis is not None else
+                [u for u in (r.get_str(b"RX") for r in all_records) if u])
         if umis:
             cu = consensus_umis(umis)
             if cu:
@@ -559,7 +575,8 @@ class CodecConsensusCaller:
             ss_for_ac, ss_for_bc = padded_r1, padded_r2
 
         return self._build_record(consensus, ss_for_ac, ss_for_bc, mol["umi"],
-                                  mol["source_raws"], mol["records"])
+                                  mol["source_raws"], mol["records"],
+                                  rx_umis=mol.get("rx_umis"))
 
     # ------------------------------------------------------------ driver
 

@@ -15,11 +15,13 @@ by construction; tests/test_fast_codec.py asserts byte parity end to end.
 
 import numpy as np
 
+from ..constants import (CODE_TO_BASE, N_CODE, NO_CALL_BASE,
+                         NO_CALL_BASE_LOWER)
 from ..io.bam import (FLAG_FIRST, FLAG_MATE_UNMAPPED, FLAG_PAIRED,
                       FLAG_REVERSE, FLAG_SECONDARY, FLAG_SUPPLEMENTARY,
                       FLAG_UNMAPPED)
 from ..native import batch as nb
-from .codec import DuplexDisagreementError
+from .codec import _ASCII_COMPLEMENT, _SS, combine_arrays
 from .vanilla import R1, SourceRead
 
 
@@ -93,8 +95,7 @@ class FastCodecCaller:
         return self._run([mol] if mol is not None else [])
 
     def _run(self, molecules):
-        """The classic call_groups tail: one device pass + per-molecule
-        finish (codec.py:566-599)."""
+        """The classic call_groups tail: one device pass + batched finish."""
         caller = self.caller
         if not molecules:
             return []
@@ -102,18 +103,133 @@ class FastCodecCaller:
         for mol in molecules:
             jobs.extend([mol["job_r1"], mol["job_r2"]])
         results = caller.ss._run_jobs(jobs)
+        vcrs = [(caller.ss.result_to_consensus_read(m["job_r1"],
+                                                    results[2 * i]),
+                 caller.ss.result_to_consensus_read(m["job_r2"],
+                                                    results[2 * i + 1]))
+                for i, m in enumerate(molecules)]
+        return self._finish_batch(molecules, vcrs)
+
+    def _finish_batch(self, molecules, vcrs):
+        """Batched `_finish` (codec.py:527-568): strand geometry lands in
+        concatenated position arrays, the duplex combine + quality-mask math
+        of codec.py:360-456 runs once over all molecules (each molecule's
+        slice is element-identical to the per-molecule version), and records
+        serialize per molecule. Stats totals match the sequential path."""
+        caller = self.caller
+        st, opts = caller.stats, caller.options
+        keep = []
+        for mol, (v1, v2) in zip(molecules, vcrs):
+            L = mol["consensus_length"]
+            if L < len(v1.bases) or L < len(v2.bases):
+                st.reject("ClipOverlapFailed", mol["n_r1"] + mol["n_r2"])
+                continue
+            keep.append((mol, v1, v2))
+        if not keep:
+            return []
+        J = len(keep)
+        Ls = np.array([m["consensus_length"] for m, _, _ in keep],
+                      dtype=np.int64)
+        offs = np.zeros(J + 1, dtype=np.int64)
+        np.cumsum(Ls, out=offs[1:])
+        T = int(offs[-1])
+
+        # oriented + padded strands (pad = lowercase n / Q0 / depth 0)
+        b1 = np.full(T, NO_CALL_BASE_LOWER, np.uint8)
+        b2 = np.full(T, NO_CALL_BASE_LOWER, np.uint8)
+        q1 = np.zeros(T, np.uint8)
+        q2 = np.zeros(T, np.uint8)
+        d1 = np.zeros(T, np.int64)
+        d2 = np.zeros(T, np.int64)
+        e1 = np.zeros(T, np.int64)
+        e2 = np.zeros(T, np.int64)
+
+        def place(v, rc, pad_left, o, L, b, q, d, e):
+            bases = CODE_TO_BASE[np.minimum(v.bases, N_CODE)]
+            quals = np.asarray(v.quals, np.uint8)
+            dep = np.asarray(v.depths, np.int64)
+            err = np.asarray(v.errors, np.int64)
+            k = len(bases)
+            sl = slice(o + L - k, o + L) if pad_left else slice(o, o + k)
+            if rc:
+                b[sl] = _ASCII_COMPLEMENT[bases[::-1]]
+                q[sl] = quals[::-1]
+                d[sl] = dep[::-1]
+                e[sl] = err[::-1]
+            else:
+                b[sl] = bases
+                q[sl] = quals
+                d[sl] = dep
+                e[sl] = err
+
+        for j, (mol, v1, v2) in enumerate(keep):
+            o, L = int(offs[j]), int(Ls[j])
+            r1_neg, r2_neg = mol["r1_is_negative"], mol["r2_is_negative"]
+            place(v1, r1_neg, r1_neg, o, L, b1, q1, d1, e1)
+            place(v2, not r1_neg, r2_neg, o, L, b2, q2, d2, e2)
+
+        # ---- duplex combine, one pass over the concatenated strands
+        cb, cq, cd, ce, both, disag = combine_arrays(b1, b2, q1, q2,
+                                                     d1, d2, e1, e2)
+
+        # per-molecule disagreement thresholds (recoverable rejects)
+        def seg_sum(x):
+            cs = np.zeros(T + 1, np.int64)
+            np.cumsum(x, out=cs[1:])
+            return cs[offs[1:]] - cs[offs[:-1]]
+
+        duplex_bases = seg_sum(both)
+        disagreements = seg_sum(disag)
+        st.consensus_duplex_bases_emitted += int(duplex_bases.sum())
+        st.duplex_disagreement_base_count += int(disagreements.sum())
+        nz = duplex_bases > 0
+        bad = np.zeros(J, dtype=bool)
+        if opts.max_duplex_disagreements is not None:
+            bad |= nz & (disagreements > opts.max_duplex_disagreements)
+        rate = np.divide(disagreements.astype(np.float64), duplex_bases,
+                         out=np.zeros(J, np.float64), where=nz)
+        bad |= nz & (rate > opts.max_duplex_disagreement_rate)
+
+        # ---- quality masks (codec.py _mask_quals: outer bands, then SS)
+        if (opts.outer_bases_length > 0
+                and opts.outer_bases_qual is not None) \
+                or opts.single_strand_qual is not None:
+            if opts.outer_bases_length > 0 \
+                    and opts.outer_bases_qual is not None:
+                pos = np.arange(T, dtype=np.int64) \
+                    - np.repeat(offs[:-1], Ls)
+                l_rep = np.repeat(Ls, Ls)
+                n_rep = np.minimum(opts.outer_bases_length, l_rep)
+                cq[(pos < n_rep) | (pos >= l_rep - n_rep)] = \
+                    opts.outer_bases_qual
+            if opts.single_strand_qual is not None:
+                is_n = lambda x: ((x == NO_CALL_BASE)
+                                  | (x == NO_CALL_BASE_LOWER))
+                cq[is_n(b1) | is_n(b2)] = opts.single_strand_qual
+
+        # ---- per-molecule record build (final rc via reversed views)
         out = []
-        for i, mol in enumerate(molecules):
-            vcr_r1 = caller.ss.result_to_consensus_read(mol["job_r1"],
-                                                        results[2 * i])
-            vcr_r2 = caller.ss.result_to_consensus_read(mol["job_r2"],
-                                                        results[2 * i + 1])
-            try:
-                rec = caller._finish(mol, vcr_r1, vcr_r2)
-            except DuplexDisagreementError:
-                rec = None
-            if rec is not None:
-                out.append(rec)
+        for j, (mol, _, _) in enumerate(keep):
+            n_filtered = mol["n_r1"] + mol["n_r2"]
+            if bad[j]:
+                st.reject("HighDuplexDisagreement", n_filtered)
+                st.consensus_reads_rejected_hdd += 1
+                continue
+            sl = slice(int(offs[j]), int(offs[j] + Ls[j]))
+            rc = mol["r1_is_negative"]
+
+            def ss_of(b, q, d, e, count):
+                if rc:
+                    return _SS(_ASCII_COMPLEMENT[b[sl][::-1]], q[sl][::-1],
+                               d[sl][::-1], e[sl][::-1], count)
+                return _SS(b[sl], q[sl], d[sl], e[sl], count)
+
+            cons = ss_of(cb, cq, cd, ce, n_filtered)
+            ssa = ss_of(b1, q1, d1, e1, mol["n_r1"])
+            ssb = ss_of(b2, q2, d2, e2, mol["n_r2"])
+            out.append(caller._build_record(
+                cons, ssa, ssb, mol["umi"], mol["source_raws"],
+                mol["records"], rx_umis=mol.get("rx_umis")))
         return out
 
     # ---------------------------------------------------------------- prepare
@@ -337,6 +453,15 @@ class FastCodecCaller:
             return None
         records = batch.raw_records(prep["rows"])
         row_to_rec = {int(r): rec for r, rec in zip(prep["rows"], records)}
+        # RX strings for the whole group from the batch tag scan (same Z/H
+        # gate and lenient decode as RawRecord.get_str; codec.py RX consensus)
+        rx_off, rx_len, _ = batch.tag_locs_str(b"RX")
+        buf = batch.buf
+        rx_umis = []
+        for r in prep["rows"]:
+            o, ln = int(rx_off[r]), int(rx_len[r])
+            if o >= 0 and ln > 0:
+                rx_umis.append(buf[o:o + ln].tobytes().decode(errors="replace"))
         return {
             "umi": umi, "records": records,
             "job_r1": job_r1, "job_r2": job_r2,
@@ -345,6 +470,7 @@ class FastCodecCaller:
             "r2_is_negative": prep["r2_neg"],
             "consensus_length": prep["consensus_length"],
             "source_raws": [row_to_rec[i[0]] for i in r1i + r2i],
+            "rx_umis": rx_umis,
         }
 
     @staticmethod

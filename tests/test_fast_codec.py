@@ -159,3 +159,26 @@ def test_parity_tiny_batches(codec_bam):
         got, rej = run_fast(tb)
         assert got == expected, tb
         assert rej == caller.stats.rejection_reasons
+
+
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_parity_randomized(tmp_path, seed):
+    """Randomized simulate params + disagreement thresholds sweep the batched
+    finish (combine / masks / thresholds run concatenated across molecules)."""
+    rng = np.random.default_rng(seed)
+    src = str(tmp_path / "r.bam")
+    simulate_codec_bam(src, num_molecules=int(rng.integers(40, 120)),
+                       pairs_per_molecule=int(rng.integers(1, 5)),
+                       read_length=int(rng.integers(40, 120)),
+                       error_rate=float(rng.uniform(0, 0.06)),
+                       overlap_fraction=float(rng.uniform(0.2, 1.0)),
+                       seed=seed)
+    extra = ["--min-reads", str(int(rng.integers(1, 3))),
+             "--max-duplex-disagreement-rate", str(float(rng.uniform(0.001, 0.05))),
+             "--single-strand-qual", str(int(rng.integers(0, 20)))]
+    if rng.integers(0, 2):
+        extra += ["--per-base-tags"]
+    if rng.integers(0, 2):
+        extra += ["--outer-bases-qual", "5", "--outer-bases-length",
+                  str(int(rng.integers(1, 12)))]
+    assert_cli_parity(src, tmp_path, extra)

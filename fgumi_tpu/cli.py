@@ -697,14 +697,12 @@ def cmd_codec(args):
             out_header = _unmapped_consensus_header(args.read_group_id)
             fast = FastCodecCaller(caller, args.tag.encode())
             with BamWriter(args.output, out_header) as writer:
-                n_out = 0
                 for batch in reader:
-                    for rec_bytes in fast.process_batch(batch):
-                        writer.write_record_bytes(rec_bytes)
-                        n_out += 1
-                for rec_bytes in fast.flush():
-                    writer.write_record_bytes(rec_bytes)
-                    n_out += 1
+                    for chunk in fast.process_batch(batch):
+                        writer.write_serialized(chunk)
+                for chunk in fast.flush():
+                    writer.write_serialized(chunk)
+                n_out = caller.stats.consensus_reads_generated
     else:
         if nbat.available():
             from .io.batch_reader import BatchedRecordReader as _CodecReader

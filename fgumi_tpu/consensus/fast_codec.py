@@ -13,6 +13,8 @@ IS the classic caller's `_run_jobs` + `_finish`, so outputs are identical
 by construction; tests/test_fast_codec.py asserts byte parity end to end.
 """
 
+import struct
+
 import numpy as np
 
 from ..constants import (CODE_TO_BASE, N_CODE, NO_CALL_BASE,
@@ -36,7 +38,10 @@ class FastCodecCaller:
     # ----------------------------------------------------------------- driver
 
     def process_batch(self, batch, final: bool = False):
-        """Consume one RecordBatch -> list of consensus record bytes."""
+        """Consume one RecordBatch -> serialized consensus blobs.
+
+        Each returned chunk carries its records' block_size prefixes
+        (BamWriter.write_serialized framing)."""
         n = batch.n
         if n == 0:
             return self.flush() if final else []
@@ -207,30 +212,148 @@ class FastCodecCaller:
                                   | (x == NO_CALL_BASE_LOWER))
                 cq[is_n(b1) | is_n(b2)] = opts.single_strand_qual
 
-        # ---- per-molecule record build (final rc via reversed views)
-        out = []
+        # ---- record serialization
+        good = []
         for j, (mol, _, _) in enumerate(keep):
-            n_filtered = mol["n_r1"] + mol["n_r2"]
             if bad[j]:
-                st.reject("HighDuplexDisagreement", n_filtered)
+                st.reject("HighDuplexDisagreement",
+                          mol["n_r1"] + mol["n_r2"])
                 st.consensus_reads_rejected_hdd += 1
-                continue
-            sl = slice(int(offs[j]), int(offs[j] + Ls[j]))
-            rc = mol["r1_is_negative"]
+            else:
+                good.append(j)
+        if not good:
+            return []
 
-            def ss_of(b, q, d, e, count):
-                if rc:
-                    return _SS(_ASCII_COMPLEMENT[b[sl][::-1]], q[sl][::-1],
-                               d[sl][::-1], e[sl][::-1], count)
-                return _SS(b[sl], q[sl], d[sl], e[sl], count)
+        if opts.cell_tag is not None:
+            # rare option: the cell tag needs per-record raw scans, so build
+            # through the classic RecordBuilder path
+            out = []
+            for j in good:
+                mol = keep[j][0]
+                sl = slice(int(offs[j]), int(offs[j] + Ls[j]))
+                rc = mol["r1_is_negative"]
 
-            cons = ss_of(cb, cq, cd, ce, n_filtered)
-            ssa = ss_of(b1, q1, d1, e1, mol["n_r1"])
-            ssb = ss_of(b2, q2, d2, e2, mol["n_r2"])
-            out.append(caller._build_record(
-                cons, ssa, ssb, mol["umi"], mol["source_raws"],
-                mol["records"], rx_umis=mol.get("rx_umis")))
-        return out
+                def ss_of(b, q, d, e, count):
+                    if rc:
+                        return _SS(_ASCII_COMPLEMENT[b[sl][::-1]],
+                                   q[sl][::-1], d[sl][::-1], e[sl][::-1],
+                                   count)
+                    return _SS(b[sl], q[sl], d[sl], e[sl], count)
+
+                rec = caller._build_record(
+                    ss_of(cb, cq, cd, ce, mol["n_r1"] + mol["n_r2"]),
+                    ss_of(b1, q1, d1, e1, mol["n_r1"]),
+                    ss_of(b2, q2, d2, e2, mol["n_r2"]),
+                    mol["umi"], mol["source_raws"], mol["records"],
+                    rx_umis=mol.get("rx_umis"))
+                out.append(struct.pack("<I", len(rec)) + rec)
+            return out
+
+        return self._serialize_native(keep, good, offs, Ls, cb, cq, ce,
+                                      b1, q1, d1, e1, b2, q2, d2, e2)
+
+    def _serialize_native(self, keep, good, offs, Ls, cb, cq, ce,
+                          b1, q1, d1, e1, b2, q2, d2, e2):
+        """One native serialization pass (codec.py _build_record byte-exact).
+
+        The final reverse-complement for r1-negative molecules is a single
+        vectorized gather (consensus errors stay unreversed: they only feed
+        the cE sum and have no per-base tag); names/MI/RX pack into one blob
+        and all rows pass to C as raw addresses.
+        """
+        from .simple_umi import consensus_umis_batch
+
+        caller = self.caller
+        st, opts = caller.stats, caller.options
+        T = int(offs[-1])
+        pos = np.arange(T, dtype=np.int64) - np.repeat(offs[:-1], Ls)
+        rc_flags = np.fromiter((m["r1_is_negative"] for m, _, _ in keep),
+                               dtype=bool, count=len(keep))
+        rc_rep = np.repeat(rc_flags, Ls)
+        src = np.where(rc_rep,
+                       np.repeat(offs[:-1] + Ls - 1, Ls) - pos,
+                       np.arange(T, dtype=np.int64))
+
+        def gath(a, comp=False):
+            g = np.ascontiguousarray(a[src])
+            if comp:
+                g[rc_rep] = _ASCII_COMPLEMENT[g[rc_rep]]
+            return g
+
+        seq = gath(cb, comp=True)
+        qual = gath(cq)
+        a_b = gath(b1, comp=True)
+        a_q = gath(q1)
+        a_d = gath(d1)
+        a_e = gath(e1)
+        b_b = gath(b2, comp=True)
+        b_q = gath(q2)
+        b_d = gath(d2)
+        b_e = gath(e2)
+
+        # RX consensus per molecule, all non-trivial families in one pass
+        fams = []
+        for j in good:
+            mol = keep[j][0]
+            ru = mol.get("rx_umis")
+            if ru is None:  # classic-prepared molecule: scan its records
+                ru = [u for u in (r.get_str(b"RX") for r in mol["records"])
+                      if u]
+            fams.append(ru)
+        nonempty = [i for i, f in enumerate(fams) if f]
+        consensi = consensus_umis_batch([fams[i] for i in nonempty]) \
+            if nonempty else []
+        rx_strs = [None] * len(fams)
+        for i, cu in zip(nonempty, consensi):
+            if cu:
+                rx_strs[i] = cu.encode()
+
+        # names / MI / RX share one blob; addresses point into it
+        G = len(good)
+        blob = bytearray()
+        name_off = np.empty(G, np.int64)
+        name_len = np.empty(G, np.int32)
+        mi_off = np.zeros(G, np.int64)
+        mi_len = np.full(G, -1, np.int32)
+        rx_off = np.zeros(G, np.int64)
+        rx_len = np.zeros(G, np.int32)
+        prefix = caller.prefix
+        for k, j in enumerate(good):
+            umi = keep[j][0]["umi"]
+            caller._counter += 1
+            name = (f"{prefix}:{umi}" if umi
+                    else f"{prefix}:{caller._counter}").encode()
+            name_off[k] = len(blob)
+            name_len[k] = len(name)
+            blob.extend(name)
+            if umi:
+                mi = umi.encode()
+                mi_off[k] = len(blob)
+                mi_len[k] = len(mi)
+                blob.extend(mi)
+            if rx_strs[k] is not None:
+                rx_off[k] = len(blob)
+                rx_len[k] = len(rx_strs[k])
+                blob.extend(rx_strs[k])
+        blob_arr = np.frombuffer(bytes(blob), dtype=np.uint8)
+        base = blob_arr.ctypes.data if len(blob_arr) else 0
+
+        gi = np.asarray(good, dtype=np.int64)
+        og = offs[:-1][gi]
+        wire, rec_end = nb.build_codec_records(
+            seq.ctypes.data + og, qual.ctypes.data + og,
+            ce.ctypes.data + 8 * og,
+            a_b.ctypes.data + og, a_q.ctypes.data + og,
+            a_d.ctypes.data + 8 * og, a_e.ctypes.data + 8 * og,
+            b_b.ctypes.data + og, b_q.ctypes.data + og,
+            b_d.ctypes.data + 8 * og, b_e.ctypes.data + 8 * og,
+            Ls[gi], base + name_off, name_len,
+            np.where(mi_len >= 0, base + mi_off, 0), mi_len,
+            np.where(rx_len > 0, base + rx_off, 0), rx_len,
+            caller.read_group_id.encode(), FLAG_UNMAPPED,
+            opts.produce_per_base_tags)
+        st.consensus_reads_generated += G
+        return [wire]  # records carry their block_size prefixes
 
     # ---------------------------------------------------------------- prepare
 

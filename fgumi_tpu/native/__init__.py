@@ -24,7 +24,7 @@ _lock = threading.Lock()
 _lib = None
 _lib_failed = False
 # must equal fgumi_abi_version() in fgumi_native.cc (stale-.so guard)
-_ABI_VERSION = 4
+_ABI_VERSION = 5
 
 
 def _build() -> bool:
@@ -135,6 +135,11 @@ def get_lib():
             [p] * 5 + [ctypes.c_long, p, ctypes.c_int, p, p]
             + [p] * 5 + [p] * 6 + [p, p, p, ctypes.c_int, ctypes.c_int,
                                    p, ctypes.c_long, p])
+        lib.fgumi_build_codec_records.restype = ctypes.c_long
+        lib.fgumi_build_codec_records.argtypes = (
+            [p] * 11 + [p, ctypes.c_long] + [p] * 6
+            + [p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+               p, ctypes.c_long, p])
         lib.fgumi_segment_depth_errors.restype = None
         lib.fgumi_segment_depth_errors.argtypes = (
             [p, p, p, ctypes.c_long, ctypes.c_long, p, p])

@@ -549,3 +549,55 @@ class NativeExtractError(ValueError):
         super().__init__(f"extract error {code} at batch record {record_index}")
         self.code = code
         self.record_index = record_index
+
+
+def build_codec_records(seq_addr, qual_addr, cons_err_addr,
+                        a_base, a_qual, a_depth, a_err,
+                        b_base, b_qual, b_depth, b_err,
+                        lens, name_addr, name_len, mi_addr, mi_len,
+                        rx_addr, rx_len, rg: bytes, flags: int,
+                        per_base_tags: bool):
+    """Serialize J CODEC consensus records into one wire blob.
+
+    Byte-exact analog of CodecConsensusCaller._build_record (codec.py; ref
+    codec_caller.rs:1374-1539). All *_addr arrays are raw element addresses
+    (int64) into caller-owned arrays that MUST stay referenced for the call;
+    seq/qual/strand base+qual rows are uint8, cons_err/depth/error rows are
+    int64, all of length lens[j]. mi_len[j] < 0 skips MI; rx_addr[j] == 0
+    skips RX.
+    """
+    lib = get_lib()
+    J = len(lens)
+    lens = np.ascontiguousarray(lens, np.int32)
+    name_len = np.ascontiguousarray(name_len, np.int32)
+    mi_len = np.ascontiguousarray(mi_len, np.int32)
+    rx_len = np.ascontiguousarray(rx_len, np.int32)
+    addrs = [np.ascontiguousarray(a, np.int64)
+             for a in (seq_addr, qual_addr, cons_err_addr, a_base, a_qual,
+                       a_depth, a_err, b_base, b_qual, b_depth, b_err,
+                       name_addr, mi_addr, rx_addr)]
+    (seq_addr, qual_addr, cons_err_addr, a_base, a_qual, a_depth, a_err,
+     b_base, b_qual, b_depth, b_err, name_addr, mi_addr, rx_addr) = addrs
+    L64 = lens.astype(np.int64)
+    per_rec = (4 + 32 + name_len.astype(np.int64) + 1 + (L64 + 1) // 2 + L64
+               + (3 + len(rg) + 1) + 9 * 7
+               + np.where(mi_len >= 0, 3 + mi_len.astype(np.int64) + 1, 0)
+               + np.where(rx_addr != 0, 3 + rx_len.astype(np.int64) + 1, 0))
+    if per_base_tags:
+        per_rec = per_rec + 4 * (8 + 2 * L64) + 4 * (3 + L64 + 1)
+    out_cap = int(per_rec.sum())
+    out = np.empty(out_cap, dtype=np.uint8)
+    rec_end = np.empty(J, dtype=np.int64)
+    rg_arr = np.frombuffer(rg, dtype=np.uint8)
+    total = lib.fgumi_build_codec_records(
+        _addr(seq_addr), _addr(qual_addr), _addr(cons_err_addr),
+        _addr(a_base), _addr(a_qual), _addr(a_depth), _addr(a_err),
+        _addr(b_base), _addr(b_qual), _addr(b_depth), _addr(b_err),
+        _addr(lens), J, _addr(name_addr), _addr(name_len), _addr(mi_addr),
+        _addr(mi_len), _addr(rx_addr), _addr(rx_len), _addr(rg_arr), len(rg),
+        int(flags), int(per_base_tags), _addr(out), out_cap, _addr(rec_end))
+    if total == -2:
+        raise ValueError("read name too long (exceeds 254 bytes)")
+    if total < 0:
+        raise RuntimeError("codec record serialization overflow")
+    return out[:total].tobytes(), rec_end

@@ -83,7 +83,7 @@ extern "C" {
 // ABI version for the stale-.so guard in __init__.py: bump whenever any
 // exported signature changes (a symbol probe alone cannot detect an
 // argument-list change in an existing function).
-long fgumi_abi_version() { return 4; }
+long fgumi_abi_version() { return 5; }
 
 // Decompress as many complete BGZF blocks from src as fit in dst.
 // Returns bytes produced; sets *consumed to the input bytes consumed (whole
@@ -557,6 +557,211 @@ long fgumi_build_duplex_records(
     p[0] = 'c'; p[1] = 'M'; p[2] = 'i';
     put_u32(p + 3, static_cast<uint32_t>(L > 0 ? comb_min : 0));
     p += 7;
+    if (rx_addr[j] != 0) {
+      p[0] = 'R'; p[1] = 'X'; p[2] = 'Z';
+      std::memcpy(p + 3, reinterpret_cast<const uint8_t*>(rx_addr[j]),
+                  static_cast<size_t>(rx_len[j]));
+      p += 3 + rx_len[j];
+      *p++ = 0;
+    }
+    const long rec_size = p - rec;
+    put_u32(out + off, static_cast<uint32_t>(rec_size));
+    off += 4 + rec_size;
+    rec_end[j] = off;
+  }
+  return off;
+}
+
+// Full case-insensitive IUPAC base -> BAM nibble table (io/bam.py
+// BASE_TO_NIBBLE: "=ACMGRSVTWYHKDBN" both cases, everything else 15/N).
+static const uint8_t* iupac_nibble_table() {
+  static uint8_t t[256];
+  static bool init = false;
+  if (!init) {
+    const char* order = "=ACMGRSVTWYHKDBN";
+    for (int i = 0; i < 256; ++i) t[i] = 15;
+    for (int i = 0; i < 16; ++i) {
+      const char c = order[i];
+      t[static_cast<uint8_t>(c)] = static_cast<uint8_t>(i);
+      if (c >= 'A' && c <= 'Z')
+        t[static_cast<uint8_t>(c - 'A' + 'a')] = static_cast<uint8_t>(i);
+    }
+    init = true;
+  }
+  return t;
+}
+
+// Serialize J unmapped CODEC consensus records. Byte-exact analog of
+// CodecConsensusCaller._build_record (consensus/codec.py; reference
+// build_output_record_into, codec_caller.rs:1374-1539): header + name +
+// packed seq + quals, then tags RG:Z, [MI:Z], cD/cM/cE, aD/aM/aE, bD/bM/bE,
+// [ad/bd/ae/be:B,s ac/bc:Z aq/bq:Z], [RX:Z]. Per-record data arrives as raw
+// addresses: seq/qual/strand-base/strand-qual rows are uint8 of length
+// lens[j]; cons_err/strand depth+error rows are int64. mi_len[j] < 0 skips
+// MI; rx_addr[j] == 0 skips RX. Returns total bytes, -2 on an over-long
+// name, -1 on overflow.
+long fgumi_build_codec_records(
+    const int64_t* seq_addr, const int64_t* qual_addr,
+    const int64_t* cons_err_addr,
+    const int64_t* a_base, const int64_t* a_qual, const int64_t* a_depth,
+    const int64_t* a_err,
+    const int64_t* b_base, const int64_t* b_qual, const int64_t* b_depth,
+    const int64_t* b_err,
+    const int32_t* lens, long J,
+    const int64_t* name_addr, const int32_t* name_len,
+    const int64_t* mi_addr, const int32_t* mi_len,
+    const int64_t* rx_addr, const int32_t* rx_len,
+    const uint8_t* rg, int rg_len, int flags, int per_base_tags,
+    uint8_t* out, long out_cap, int64_t* rec_end) {
+  const uint8_t* nib = iupac_nibble_table();
+  long off = 0;
+  for (long j = 0; j < J; ++j) {
+    const int32_t L = lens[j];
+    const int32_t nl = name_len[j];
+    if (nl + 1 > 255) return -2;
+    long need = 4 + 32 + nl + 1 + (L + 1) / 2 + L;
+    need += 3 + rg_len + 1;
+    if (mi_len[j] >= 0) need += 3 + mi_len[j] + 1;
+    need += 9 * 7;  // cD cM cE aD aM aE bD bM bE
+    if (per_base_tags)
+      need += 4 * (8 + 2 * static_cast<long>(L)) + 4 * (3 + L + 1);
+    if (rx_addr[j] != 0) need += 3 + rx_len[j] + 1;
+    if (off + need > out_cap) return -1;
+
+    const uint8_t* seq = reinterpret_cast<const uint8_t*>(seq_addr[j]);
+    const uint8_t* qual = reinterpret_cast<const uint8_t*>(qual_addr[j]);
+    const int64_t* cerr = reinterpret_cast<const int64_t*>(cons_err_addr[j]);
+    uint8_t* rec = out + off + 4;
+    put_u32(rec + 0, 0xFFFFFFFFu);
+    put_u32(rec + 4, 0xFFFFFFFFu);
+    rec[8] = static_cast<uint8_t>(nl + 1);
+    rec[9] = 0;
+    put_u16(rec + 10, 4680);
+    put_u16(rec + 12, 0);
+    put_u16(rec + 14, static_cast<uint16_t>(flags));
+    put_u32(rec + 16, static_cast<uint32_t>(L));
+    put_u32(rec + 20, 0xFFFFFFFFu);
+    put_u32(rec + 24, 0xFFFFFFFFu);
+    put_u32(rec + 28, 0);
+    uint8_t* p = rec + 32;
+    std::memcpy(p, reinterpret_cast<const uint8_t*>(name_addr[j]),
+                static_cast<size_t>(nl));
+    p += nl;
+    *p++ = 0;
+    for (int32_t i = 0; i + 1 < L; i += 2)
+      *p++ = static_cast<uint8_t>((nib[seq[i]] << 4) | nib[seq[i + 1]]);
+    if (L & 1) *p++ = static_cast<uint8_t>(nib[seq[L - 1]] << 4);
+    std::memcpy(p, qual, static_cast<size_t>(L));
+    p += L;
+    p[0] = 'R'; p[1] = 'G'; p[2] = 'Z';
+    std::memcpy(p + 3, rg, static_cast<size_t>(rg_len));
+    p += 3 + rg_len;
+    *p++ = 0;
+    if (mi_len[j] >= 0) {
+      p[0] = 'M'; p[1] = 'I'; p[2] = 'Z';
+      std::memcpy(p + 3, reinterpret_cast<const uint8_t*>(mi_addr[j]),
+                  static_cast<size_t>(mi_len[j]));
+      p += 3 + mi_len[j];
+      *p++ = 0;
+    }
+
+    const int64_t* adp = reinterpret_cast<const int64_t*>(a_depth[j]);
+    const int64_t* aer = reinterpret_cast<const int64_t*>(a_err[j]);
+    const int64_t* bdp = reinterpret_cast<const int64_t*>(b_depth[j]);
+    const int64_t* ber = reinterpret_cast<const int64_t*>(b_err[j]);
+    auto cap16 = [](int64_t v) -> int64_t { return v < 32767 ? v : 32767; };
+
+    // cD/cM over cap(a)+cap(b); cE = sum(cap(cons_err)) / sum(total_depth)
+    int64_t td_max = 0, td_min = 0, td_sum = 0, ce_sum = 0;
+    if (L > 0) {
+      td_max = -1;
+      td_min = 0x7FFFFFFFFFFFLL;
+      for (int32_t i = 0; i < L; ++i) {
+        const int64_t td = cap16(adp[i]) + cap16(bdp[i]);
+        if (td > td_max) td_max = td;
+        if (td < td_min) td_min = td;
+        td_sum += td;
+        ce_sum += cap16(cerr[i]);
+      }
+    }
+    const float crate = td_sum
+        ? static_cast<float>(ce_sum) / static_cast<float>(td_sum) : 0.0f;
+    p[0] = 'c'; p[1] = 'D'; p[2] = 'i';
+    put_u32(p + 3, static_cast<uint32_t>(L > 0 ? td_max : 0));
+    p += 7;
+    p[0] = 'c'; p[1] = 'M'; p[2] = 'i';
+    put_u32(p + 3, static_cast<uint32_t>(L > 0 ? td_min : 0));
+    p += 7;
+    uint32_t bits;
+    std::memcpy(&bits, &crate, 4);
+    p[0] = 'c'; p[1] = 'E'; p[2] = 'f';
+    put_u32(p + 3, bits);
+    p += 7;
+
+    // aD/aM/aE then bD/bM/bE (strand aggregates over capped values)
+    const int64_t* deps[2] = {adp, bdp};
+    const int64_t* errs[2] = {aer, ber};
+    const char sc[2] = {'a', 'b'};
+    for (int s = 0; s < 2; ++s) {
+      int64_t mx = 0, mn = 0, dsum = 0, esum = 0;
+      if (L > 0) {
+        mx = -1;
+        mn = 0x7FFFFFFFFFFFLL;
+        for (int32_t i = 0; i < L; ++i) {
+          const int64_t d = cap16(deps[s][i]);
+          if (d > mx) mx = d;
+          if (d < mn) mn = d;
+          dsum += d;
+          esum += cap16(errs[s][i]);
+        }
+      }
+      const float srate = dsum
+          ? static_cast<float>(esum) / static_cast<float>(dsum) : 0.0f;
+      p[0] = sc[s]; p[1] = 'D'; p[2] = 'i';
+      put_u32(p + 3, static_cast<uint32_t>(L > 0 ? mx : 0));
+      p += 7;
+      p[0] = sc[s]; p[1] = 'M'; p[2] = 'i';
+      put_u32(p + 3, static_cast<uint32_t>(L > 0 ? mn : 0));
+      p += 7;
+      std::memcpy(&bits, &srate, 4);
+      p[0] = sc[s]; p[1] = 'E'; p[2] = 'f';
+      put_u32(p + 3, bits);
+      p += 7;
+    }
+
+    if (per_base_tags) {
+      // ad bd ae be (B,s of capped values), then ac bc (Z), aq bq (Z +33)
+      const int64_t* rows[4] = {adp, bdp, aer, ber};
+      const char tag0[4] = {'a', 'b', 'a', 'b'};
+      const char tag1[4] = {'d', 'd', 'e', 'e'};
+      for (int t = 0; t < 4; ++t) {
+        p[0] = tag0[t]; p[1] = tag1[t]; p[2] = 'B'; p[3] = 's';
+        put_u32(p + 4, static_cast<uint32_t>(L));
+        p += 8;
+        for (int32_t i = 0; i < L; ++i) {
+          put_u16(p, static_cast<uint16_t>(
+                         static_cast<int16_t>(cap16(rows[t][i]))));
+          p += 2;
+        }
+      }
+      const uint8_t* sb[2] = {reinterpret_cast<const uint8_t*>(a_base[j]),
+                              reinterpret_cast<const uint8_t*>(b_base[j])};
+      const uint8_t* sq[2] = {reinterpret_cast<const uint8_t*>(a_qual[j]),
+                              reinterpret_cast<const uint8_t*>(b_qual[j])};
+      for (int s = 0; s < 2; ++s) {
+        p[0] = sc[s]; p[1] = 'c'; p[2] = 'Z';
+        std::memcpy(p + 3, sb[s], static_cast<size_t>(L));
+        p += 3 + L;
+        *p++ = 0;
+      }
+      for (int s = 0; s < 2; ++s) {
+        p[0] = sc[s]; p[1] = 'q'; p[2] = 'Z';
+        p += 3;
+        for (int32_t i = 0; i < L; ++i)
+          *p++ = static_cast<uint8_t>(sq[s][i] + 33);
+        *p++ = 0;
+      }
+    }
     if (rx_addr[j] != 0) {
       p[0] = 'R'; p[1] = 'X'; p[2] = 'Z';
       std::memcpy(p + 3, reinterpret_cast<const uint8_t*>(rx_addr[j]),

@@ -150,14 +150,17 @@ def test_parity_tiny_batches(codec_bam):
         out.extend(fast.flush())
         return out, caller.stats.rejection_reasons
 
+    import struct
+
     caller = CodecConsensusCaller("fgumi", "A", CodecOptions())
     with BamReader(codec_bam) as r:
         expected = []
         for batch in iter_mi_group_batches(r, 50, tag=b"MI"):
             expected.extend(caller.call_groups(batch))
+    expected_wire = b"".join(struct.pack("<I", len(r)) + r for r in expected)
     for tb in (600, 5000):
         got, rej = run_fast(tb)
-        assert got == expected, tb
+        assert b"".join(got) == expected_wire, tb
         assert rej == caller.stats.rejection_reasons
 
 
@@ -182,3 +185,18 @@ def test_parity_randomized(tmp_path, seed):
         extra += ["--outer-bases-qual", "5", "--outer-bases-length",
                   str(int(rng.integers(1, 12)))]
     assert_cli_parity(src, tmp_path, extra)
+
+
+def test_parity_cell_tag(codec_bam, tmp_path):
+    """--cell-tag takes the RecordBuilder fallback branch in _finish_batch."""
+    assert_cli_parity(codec_bam, tmp_path, ["--min-reads", "1",
+                                            "--cell-tag", "CB"])
+
+
+def test_parity_count_threshold(codec_bam, tmp_path):
+    """--max-duplex-disagreements exercises the vectorized count-threshold
+    reject (classic raises DuplexDisagreementError('count'))."""
+    assert_cli_parity(codec_bam, tmp_path,
+                      ["--min-reads", "1", "--max-duplex-disagreements", "1"])
+    assert_cli_parity(codec_bam, tmp_path,
+                      ["--min-reads", "1", "--max-duplex-disagreements", "0"])

@@ -24,7 +24,7 @@ from ..io.bam import (FLAG_FIRST, FLAG_MATE_UNMAPPED, FLAG_PAIRED,
                       FLAG_UNMAPPED)
 from ..native import batch as nb
 from .codec import _ASCII_COMPLEMENT, _SS, combine_arrays
-from .vanilla import R1, SourceRead
+from .vanilla import ConsensusJob, R1
 
 
 class FastCodecCaller:
@@ -548,34 +548,39 @@ class FastCodecCaller:
         }
 
     def _finalize_vec(self, batch, prep, codes_pk, quals_pk):
-        """Phase 5: SourceReads from the packed rows + SS jobs + mol dict."""
+        """Phase 5: SS jobs directly over the packed rows + mol dict.
+
+        The SS caller is constructed with min_reads=1 / max_reads=None
+        (codec.py ss_opts), so job_from_source_reads reduces to
+        consensus_len = longest clipped read; ConsensusJobs are built
+        straight from the pack rows with no SourceRead materialization.
+        """
         caller = self.caller
-        flag = batch.flag
         r1i, r2i = prep["r1i"], prep["r2i"]
         pk = prep["pk0"]
         umi = prep["mi"]
-
-        def sources(infos, base):
-            out = []
-            for k, i in enumerate(infos):
-                flen = i[3]
-                out.append(SourceRead(
-                    original_idx=k,
-                    codes=codes_pk[base + k, :flen],
-                    quals=quals_pk[base + k, :flen],
-                    simplified_cigar=[("M", flen)] if flen else [],
-                    flags=int(flag[i[0]])))
-            return out
-
-        r1_sources = sources(r1i, pk)
-        r2_sources = sources(r2i, pk + len(r1i))
         umi_str = umi or ""
-        job_r1 = caller.ss.job_from_source_reads(umi_str, R1, r1_sources)
-        job_r2 = caller.ss.job_from_source_reads(umi_str, R1, r2_sources)
-        if job_r1 is None or job_r2 is None:
-            return None
-        records = batch.raw_records(prep["rows"])
-        row_to_rec = {int(r): rec for r, rec in zip(prep["rows"], records)}
+
+        def job(infos, base):
+            flens = [i[3] for i in infos]
+            return ConsensusJob(
+                umi=umi_str, read_type=R1,
+                codes=[codes_pk[base + k, :fl]
+                       for k, fl in enumerate(flens)],
+                quals=[quals_pk[base + k, :fl]
+                       for k, fl in enumerate(flens)],
+                consensus_len=max(flens), original_raws=[])
+
+        job_r1 = job(r1i, pk)
+        job_r2 = job(r2i, pk + len(r1i))
+        if caller.options.cell_tag is not None:
+            # only the cell-tag fallback reads raw records back
+            records = batch.raw_records(prep["rows"])
+            row_to_rec = {int(r): rec
+                          for r, rec in zip(prep["rows"], records)}
+            source_raws = [row_to_rec[i[0]] for i in r1i + r2i]
+        else:
+            records, source_raws = None, None
         # RX strings for the whole group from the batch tag scan (same Z/H
         # gate and lenient decode as RawRecord.get_str; codec.py RX consensus)
         rx_off, rx_len, _ = batch.tag_locs_str(b"RX")
@@ -592,7 +597,7 @@ class FastCodecCaller:
             "r1_is_negative": prep["r1_neg"],
             "r2_is_negative": prep["r2_neg"],
             "consensus_length": prep["consensus_length"],
-            "source_raws": [row_to_rec[i[0]] for i in r1i + r2i],
+            "source_raws": source_raws,
             "rx_umis": rx_umis,
         }
 

@@ -19,9 +19,9 @@ import numpy as np
 
 from ..constants import (CODE_TO_BASE, N_CODE, NO_CALL_BASE,
                          NO_CALL_BASE_LOWER)
-from ..io.bam import (FLAG_FIRST, FLAG_MATE_UNMAPPED, FLAG_PAIRED,
-                      FLAG_REVERSE, FLAG_SECONDARY, FLAG_SUPPLEMENTARY,
-                      FLAG_UNMAPPED)
+from ..io.bam import (FLAG_FIRST, FLAG_MATE_REVERSE, FLAG_MATE_UNMAPPED,
+                      FLAG_PAIRED, FLAG_REVERSE, FLAG_SECONDARY,
+                      FLAG_SUPPLEMENTARY, FLAG_UNMAPPED)
 from ..native import batch as nb
 from .codec import _ASCII_COMPLEMENT, _SS, combine_arrays
 from .vanilla import ConsensusJob, R1
@@ -383,6 +383,12 @@ class FastCodecCaller:
         grp_ok = np.ones(g1 - g0, dtype=bool)
         np.logical_and.at(grp_ok, g_of_row, row_ok)
 
+        # phases 1-2 (primary-pair formation by name + clip closed forms)
+        # run once over the whole eligible span; hash-collision groups fall
+        # back to the per-molecule python pairing
+        pair_of_group, py_groups = self._pair_span(
+            batch, span, g_of_row, grp_ok, fl, paired_primary)
+
         # clip/pack pass shared by every eligible molecule of the span:
         # pairing fills clips, then one native pack covers all kept rows
         mols = []
@@ -399,8 +405,13 @@ class FastCodecCaller:
                 pending.append(("mol", mol) if mol is not None
                                else ("none", None))
                 continue
-            prep = self._prepare_molecule_vec(batch, rows, mi, pack_rows,
-                                              pack_clips)
+            if (g - g0) in py_groups:
+                prep = self._prepare_molecule_vec(batch, rows, mi, pack_rows,
+                                                  pack_clips)
+            else:
+                prep = self._finish_molecule_vec(
+                    rows, mi, pair_of_group.get(g - g0), pack_rows,
+                    pack_clips)
             pending.append(("vec", prep) if prep is not None
                            else ("none", None))
 
@@ -422,6 +433,206 @@ class FastCodecCaller:
                 mols.append(self._finalize_vec(batch, item[1], codes_pk,
                                                quals_pk))
         return [m for m in mols if m is not None]
+
+    def _pair_span(self, batch, span, g_of_row, grp_ok, fl_span, pp_span):
+        """Phases 1-2 for every eligible group in one pass: primary FR
+        pairing by read name (FNV hash buckets, byte-verified) plus the
+        clip/adjusted-position closed forms, all as span-wide array math.
+        fl_span / pp_span are the caller's per-span flag values and
+        paired-primary mask (shared, not recomputed).
+
+        Returns ({local_g: per-pair arrays}, {local_g needing the python
+        pairing}). The second set holds groups where two distinct names
+        share a hash (byte-verify failed) — their stats are untouched here
+        so the per-molecule path recounts them exactly.
+        """
+        st = self.caller.stats
+        flag = batch.flag
+        l_seq = batch.l_seq
+        pos = batch.pos
+        buf = batch.buf
+        elig = grp_ok[g_of_row]
+        rows = span[elig]
+        g_of = g_of_row[elig]
+        if len(rows) == 0:
+            return {}, set()
+
+        paired = (fl_span[elig] & FLAG_PAIRED) != 0
+        ppm = pp_span[elig]
+        pr = rows[ppm]
+        pg = g_of[ppm]
+
+        # name buckets within each group (classic by_name first-appearance
+        # dict, fast_codec _prepare_molecule_vec phase 2)
+        noff = (batch.data_off[pr] + 32).astype(np.int64)
+        nlen = batch.l_read_name[pr].astype(np.int32) - 1
+        h = nb.hash_ranges(buf, noff, nlen)
+        order = np.lexsort((np.arange(len(pr)), h, pg))
+        sp, sg, sh = pr[order], pg[order], h[order]
+        so, sno, snl = order, noff[order], nlen[order]
+        new_b = np.ones(len(sp), dtype=bool)
+        if len(sp) > 1:
+            new_b[1:] = (sg[1:] != sg[:-1]) | (sh[1:] != sh[:-1])
+        b_start = np.nonzero(new_b)[0]
+        b_size = np.diff(np.append(b_start, len(sp)))
+        # collision guard: every bucket member must byte-match its head
+        head = np.repeat(b_start, b_size)
+        same = nb.ranges_equal(buf, sno, snl, sno[head], snl[head])
+        py_groups = set(int(g) for g in np.unique(sg[same == 0]))
+
+        ok_mask = np.ones(len(b_start), dtype=bool)
+        if py_groups:
+            bg_all = sg[b_start]
+            ok_mask = ~np.isin(bg_all, np.fromiter(py_groups, dtype=sg.dtype,
+                                                   count=len(py_groups)))
+        # stats for the groups resolved here (python-fallback groups excluded)
+        if py_groups:
+            keep_rows = ~np.isin(g_of, np.fromiter(py_groups, dtype=g_of.dtype,
+                                                   count=len(py_groups)))
+            st.total_input_reads += int(keep_rows.sum())
+            frag = int((~paired[keep_rows]).sum())
+        else:
+            st.total_input_reads += len(rows)
+            frag = int((~paired).sum())
+        if frag:
+            st.reject("FragmentRead", frag)
+
+        two = ok_mask & (b_size == 2)
+        odd_total = int(b_size[ok_mask & ~two].sum())
+
+        ia = sp[b_start[two]]
+        ib = sp[b_start[two] + 1]
+        bg = sg[b_start[two]]
+        first_orig = so[b_start[two]]  # classic bucket order: name appearance
+
+        # is_primary_fr_pair, vectorized (overlap.py:96-156 for all-M rows)
+        fa, fb = flag[ia], flag[ib]
+        ok = ((fa | fb) & (FLAG_UNMAPPED | FLAG_MATE_UNMAPPED)) == 0
+        ok &= batch.ref_id[ia] == batch.ref_id[ib]
+        a_rev = (fa & FLAG_REVERSE) != 0
+        ok &= a_rev != ((fb & FLAG_REVERSE) != 0)
+        r = np.where(a_rev, ia, ib)
+        rf = flag[r]
+        ok &= batch.ref_id[r] == batch.next_ref_id[r]
+        ok &= ((rf & FLAG_REVERSE) != 0) != ((rf & FLAG_MATE_REVERSE) != 0)
+        start = pos[r].astype(np.int64) + 1
+        mate_start = batch.next_pos[r].astype(np.int64) + 1
+        rrev = (rf & FLAG_REVERSE) != 0
+        end = start + np.maximum(l_seq[r].astype(np.int64) - 1, 0)
+        pos5 = np.where(rrev, mate_start, start)
+        neg5 = np.where(rrev, end, start + batch.tlen[r].astype(np.int64))
+        ok &= pos5 < neg5
+
+        n_failed = int((~ok).sum())
+        if odd_total or n_failed:
+            st.reject("NotPrimaryFrPair", odd_total + 2 * n_failed)
+
+        ia, ib, bg, first_orig = ia[ok], ib[ok], bg[ok], first_orig[ok]
+        a_first = (flag[ia] & FLAG_FIRST) != 0
+        r1 = np.where(a_first, ia, ib)
+        r2 = np.where(a_first, ib, ia)
+
+        # clip_vs closed forms, both directions (all-M geometry)
+        def clips(ra, rb):
+            ms = pos[rb].astype(np.int64) + 1
+            me = pos[rb].astype(np.int64) + l_seq[rb]
+            p1 = pos[ra].astype(np.int64) + 1
+            L = l_seq[ra].astype(np.int64)
+            d = ms - p1
+            c_rev = np.where((p1 <= ms) & (d < L), d, 0)
+            end1 = p1 - 1 + L
+            bp = np.where((me < p1) | (me >= p1 + L), 0, me - p1 + 1)
+            c_fwd = np.where(end1 >= me, np.maximum(L - bp, 0), 0)
+            return np.where((flag[ra] & FLAG_REVERSE) != 0, c_rev, c_fwd)
+
+        def info(rr, clip):
+            rev = (flag[rr] & FLAG_REVERSE) != 0
+            L = l_seq[rr].astype(np.int64)
+            flen = np.maximum(L - clip, 0)
+            adj = pos[rr].astype(np.int64) + 1 \
+                + np.where(rev, np.minimum(clip, L), 0)
+            return clip.astype(np.int64), rev, flen, adj
+
+        c1, rev1, flen1, adj1 = info(r1, clips(r1, r2))
+        c2, rev2, flen2, adj2 = info(r2, clips(r2, r1))
+
+        # classic pair order within a group = first appearance of the name
+        po = np.lexsort((first_orig, bg))
+        arrs = (r1[po], c1[po], rev1[po], flen1[po], adj1[po],
+                r2[po], c2[po], rev2[po], flen2[po], adj2[po])
+        bg = bg[po]
+        out = {}
+        starts = np.nonzero(np.concatenate(([True], bg[1:] != bg[:-1])))[0] \
+            if len(bg) else np.zeros(0, np.int64)
+        ends = np.append(starts[1:], len(bg))
+        for s, e in zip(starts, ends):
+            out[int(bg[s])] = tuple(a[s:e] for a in arrs)
+        return out, py_groups
+
+    def _finish_molecule_vec(self, rows, mi, pairs, pack_rows, pack_clips):
+        """Phases 3-5 for one group given its span-paired arrays; returns a
+        partial mol (pack rows staged) or None with classic reject stats."""
+        caller = self.caller
+        st = caller.stats
+        opts = caller.options
+        if pairs is None:  # no surviving FR pair in this group
+            return None
+        (r1, c1, rev1, flen1, adj1, r2, c2, rev2, flen2, adj2) = pairs
+        n = len(r1)
+        if n < opts.min_reads_per_strand:
+            st.reject("InsufficientReads", 2 * n)
+            return None
+        max_pairs = opts.max_reads_per_strand
+        if max_pairs is not None and n > max_pairs:
+            idxs = np.sort(caller._rng.permutation(n)[:max_pairs])
+            (r1, c1, rev1, flen1, adj1, r2, c2, rev2, flen2, adj2) = (
+                a[idxs] for a in pairs)
+            n = max_pairs
+        n_filtered = 2 * n
+
+        # phase 4: overlap geometry on the longest strands (first max)
+        i1, i2 = int(np.argmax(flen1)), int(np.argmax(flen2))
+        r1_neg, r2_neg = bool(rev1[i1]), bool(rev2[i2])
+        L1 = (int(flen1[i1]), int(adj1[i1]))
+        L2 = (int(flen2[i2]), int(adj2[i2]))
+        Lpos, Lneg = (L2, L1) if r1_neg else (L1, L2)
+        overlap_start = Lneg[1]
+        pos_end = Lpos[1] + max(Lpos[0] - 1, 0)
+        duplex_length = pos_end - overlap_start + 1
+        if duplex_length < opts.min_duplex_length:
+            st.reject("InsufficientOverlap", n_filtered)
+            return None
+
+        def rp(i, p):
+            flen, adj = i
+            if adj <= p <= adj + flen - 1:
+                return p - adj + 1
+            return None
+
+        r1s, r2s = rp(L1, overlap_start), rp(L2, overlap_start)
+        r1e, r2e = rp(L1, pos_end), rp(L2, pos_end)
+        if None in (r1s, r2s, r1e, r2e) or (r1s - r2s) != (r1e - r2e):
+            st.reject("IndelErrorBetweenStrands", n_filtered)
+            return None
+        p = rp(Lpos, pos_end)
+        n_ = rp(Lneg, pos_end)
+        if p is None or n_ is None:
+            st.reject("IndelErrorBetweenStrands", n_filtered)
+            return None
+        consensus_length = p + Lneg[0] - n_
+
+        pk0 = len(pack_rows)
+        pack_rows.extend(r1.tolist())
+        pack_clips.extend(c1.tolist())
+        pack_rows.extend(r2.tolist())
+        pack_clips.extend(c2.tolist())
+        return {
+            "mi": mi, "rows": rows, "pk0": pk0,
+            "r1_rows": r1, "r2_rows": r2,
+            "r1_flens": flen1, "r2_flens": flen2,
+            "r1_neg": r1_neg, "r2_neg": r2_neg,
+            "consensus_length": consensus_length,
+        }
 
     def _prepare_molecule_vec(self, batch, rows, mi, pack_rows, pack_clips):
         """Phases 1-4 on arrays; returns a partial mol (pack indices staged)
@@ -542,7 +753,11 @@ class FastCodecCaller:
             pack_rows.append(i[0])
             pack_clips.append(i[1])
         return {
-            "mi": mi, "rows": rows, "r1i": r1i, "r2i": r2i, "pk0": pk0,
+            "mi": mi, "rows": rows, "pk0": pk0,
+            "r1_rows": np.array([i[0] for i in r1i], dtype=np.int64),
+            "r2_rows": np.array([i[0] for i in r2i], dtype=np.int64),
+            "r1_flens": np.array([i[3] for i in r1i], dtype=np.int64),
+            "r2_flens": np.array([i[3] for i in r2i], dtype=np.int64),
             "r1_neg": r1_neg, "r2_neg": r2_neg,
             "consensus_length": consensus_length,
         }
@@ -556,29 +771,29 @@ class FastCodecCaller:
         straight from the pack rows with no SourceRead materialization.
         """
         caller = self.caller
-        r1i, r2i = prep["r1i"], prep["r2i"]
+        f1, f2 = prep["r1_flens"], prep["r2_flens"]
         pk = prep["pk0"]
         umi = prep["mi"]
         umi_str = umi or ""
 
-        def job(infos, base):
-            flens = [i[3] for i in infos]
+        def job(flens, base):
             return ConsensusJob(
                 umi=umi_str, read_type=R1,
-                codes=[codes_pk[base + k, :fl]
+                codes=[codes_pk[base + k, :int(fl)]
                        for k, fl in enumerate(flens)],
-                quals=[quals_pk[base + k, :fl]
+                quals=[quals_pk[base + k, :int(fl)]
                        for k, fl in enumerate(flens)],
-                consensus_len=max(flens), original_raws=[])
+                consensus_len=int(flens.max()), original_raws=[])
 
-        job_r1 = job(r1i, pk)
-        job_r2 = job(r2i, pk + len(r1i))
+        job_r1 = job(f1, pk)
+        job_r2 = job(f2, pk + len(f1))
         if caller.options.cell_tag is not None:
             # only the cell-tag fallback reads raw records back
             records = batch.raw_records(prep["rows"])
             row_to_rec = {int(r): rec
                           for r, rec in zip(prep["rows"], records)}
-            source_raws = [row_to_rec[i[0]] for i in r1i + r2i]
+            source_raws = [row_to_rec[int(r)] for r in
+                           np.concatenate([prep["r1_rows"], prep["r2_rows"]])]
         else:
             records, source_raws = None, None
         # RX strings for the whole group from the batch tag scan (same Z/H
@@ -593,7 +808,7 @@ class FastCodecCaller:
         return {
             "umi": umi, "records": records,
             "job_r1": job_r1, "job_r2": job_r2,
-            "n_r1": len(r1i), "n_r2": len(r2i),
+            "n_r1": len(f1), "n_r2": len(f2),
             "r1_is_negative": prep["r1_neg"],
             "r2_is_negative": prep["r2_neg"],
             "consensus_length": prep["consensus_length"],
@@ -617,7 +832,7 @@ class FastCodecCaller:
         rf = int(flag[r])
         if batch.ref_id[r] != batch.next_ref_id[r]:
             return False
-        if bool(rf & FLAG_REVERSE) == bool(rf & 0x20):  # mate-reverse flag
+        if bool(rf & FLAG_REVERSE) == bool(rf & FLAG_MATE_REVERSE):
             return False
         # is_fr_pair on the reverse-strand record (M-only: ref_len == l_seq)
         start = int(batch.pos[r]) + 1

@@ -24,7 +24,6 @@ from ..io.bam import (FLAG_FIRST, FLAG_MATE_REVERSE, FLAG_MATE_UNMAPPED,
                       FLAG_SUPPLEMENTARY, FLAG_UNMAPPED)
 from ..native import batch as nb
 from .codec import _ASCII_COMPLEMENT, _SS, combine_arrays
-from .vanilla import ConsensusJob, R1
 
 
 class FastCodecCaller:
@@ -80,13 +79,16 @@ class FastCodecCaller:
                 if mol is not None:
                     molecules.append(mol)
 
+        codes_pk = quals_pk = None
         if g1 > g0:
-            molecules.extend(self._prepare_span(batch, bounds, g0, g1))
+            span_mols, codes_pk, quals_pk = self._prepare_span(batch, bounds,
+                                                               g0, g1)
+            molecules.extend(span_mols)
 
         if deferred is not None:
             self._carry = deferred
 
-        out = self._run(molecules)
+        out = self._run(molecules, codes_pk, quals_pk)
         if final:
             out.extend(self.flush())
         return out
@@ -99,19 +101,109 @@ class FastCodecCaller:
         mol = self.caller.prepare(recs, umi=mi)
         return self._run([mol] if mol is not None else [])
 
-    def _run(self, molecules):
-        """The classic call_groups tail: one device pass + batched finish."""
+    def _run(self, molecules, codes_pk=None, quals_pk=None):
+        """One SS device pass + batched finish.
+
+        Vec-prepared molecules (strand rows resident in the pack arrays)
+        land in the dense layout via ONE gather from codes_pk/quals_pk —
+        the same pad_segments/device_call_segments/thresholds sequence as
+        VanillaConsensusCaller._run_jobs, minus the per-read row repack.
+        Classic-prepared molecules (carry/fallback ConsensusJobs) repack
+        their few rows into the same layout, so every batch costs exactly
+        one device execution.
+        """
+        from ..ops import oracle
+        from ..ops.kernel import pad_segments
+        from .vanilla import I16_MAX, VanillaConsensusRead
+
         caller = self.caller
+        ss = caller.ss
         if not molecules:
             return []
-        jobs = []
-        for mol in molecules:
-            jobs.extend([mol["job_r1"], mol["job_r2"]])
-        results = caller.ss._run_jobs(jobs)
-        vcrs = [(caller.ss.result_to_consensus_read(m["job_r1"],
-                                                    results[2 * i]),
-                 caller.ss.result_to_consensus_read(m["job_r2"],
-                                                    results[2 * i + 1]))
+        strand_res = {}  # (mol_idx, strand) -> (bases, quals, depths, errs)
+
+        vec_multi = []       # (mol_idx, strand, base_row, count, cl)
+        classic_multi = []   # (mol_idx, strand, job)
+        for i, m in enumerate(molecules):
+            if "job_r1" in m:
+                # carry/fallback molecules: the same dispatch, rows repacked
+                # below (a separate _run_jobs call would cost a second
+                # device execution on essentially every streamed batch)
+                for s, job in enumerate((m["job_r1"], m["job_r2"])):
+                    cl = job.consensus_len
+                    if len(job.codes) == 1:
+                        strand_res[(i, s)] = oracle.single_read_consensus(
+                            job.codes[0][:cl], job.quals[0][:cl], ss.tables,
+                            ss.options.min_consensus_base_quality)
+                    else:
+                        classic_multi.append((i, s, job))
+                continue
+            base = m["pk0"]
+            for s, (b0, cnt, flens) in enumerate(
+                    ((base, m["n_r1"], m["r1_flens"]),
+                     (base + m["n_r1"], m["n_r2"], m["r2_flens"]))):
+                cl = int(flens.max())
+                if cnt == 1:
+                    strand_res[(i, s)] = oracle.single_read_consensus(
+                        codes_pk[b0, :cl], quals_pk[b0, :cl], ss.tables,
+                        ss.options.min_consensus_base_quality)
+                else:
+                    vec_multi.append((i, s, b0, cnt, cl))
+
+        if vec_multi or classic_multi:
+            cls = [(i, s, job.consensus_len, job)
+                   for i, s, job in classic_multi]
+            all_cl = [v[4] for v in vec_multi] + [c[2] for c in cls]
+            L_max = max(-(-max(all_cl) // 16) * 16, 16)
+            counts = np.array([v[3] for v in vec_multi]
+                              + [len(c[3].codes) for c in cls],
+                              dtype=np.int64)
+            n_vec_rows = int(sum(v[3] for v in vec_multi))
+            N = int(counts.sum())
+            codes2d = np.full((N, L_max), N_CODE, dtype=np.uint8)
+            quals2d = np.zeros((N, L_max), dtype=np.uint8)
+            if vec_multi:
+                rows_idx = np.concatenate(
+                    [np.arange(b0, b0 + cnt)
+                     for _, _, b0, cnt, _ in vec_multi])
+                # pack rows are N/Q0-padded past each read's final length,
+                # so a single fancy-index gather IS the dense job layout.
+                # A carry molecule's longer reads can push L_max past the
+                # span's pack stride; vec flens never exceed the stride, so
+                # clamping the gather width keeps the tail at N/Q0.
+                wv = min(L_max, codes_pk.shape[1])
+                codes2d[:n_vec_rows, :wv] = codes_pk[rows_idx, :wv]
+                quals2d[:n_vec_rows, :wv] = quals_pk[rows_idx, :wv]
+            row = n_vec_rows
+            for _, _, _, job in cls:
+                for c, q in zip(job.codes, job.quals):
+                    k = min(len(c), L_max)
+                    codes2d[row, :k] = c[:k]
+                    quals2d[row, :k] = q[:k]
+                    row += 1
+            codes_dev, quals_dev, seg_ids, starts, F_pad = pad_segments(
+                codes2d, quals2d, counts)
+            dev = ss.kernel.device_call_segments(codes_dev, quals_dev,
+                                                 seg_ids, F_pad)
+            w, q_, d, e = ss.kernel.resolve_segments(dev, codes2d, quals2d,
+                                                     starts)
+            slots = [(v[0], v[1], v[4]) for v in vec_multi] \
+                + [(c[0], c[1], c[2]) for c in cls]
+            for fi, (i, s, cl) in enumerate(slots):
+                b_j, q_j = oracle.apply_consensus_thresholds(
+                    w[fi, :cl], q_[fi, :cl], d[fi, :cl],
+                    ss.options.min_reads,
+                    ss.options.min_consensus_base_quality)
+                strand_res[(i, s)] = (b_j, q_j, d[fi, :cl], e[fi, :cl])
+
+        def vcr(i, s, m):
+            b, q, d, e = strand_res[(i, s)]
+            return VanillaConsensusRead(
+                id=m["umi"] or "", bases=np.asarray(b), quals=np.asarray(q),
+                depths=np.minimum(d, I16_MAX),
+                errors=np.minimum(e, I16_MAX), source_reads=None)
+
+        vcrs = [(vcr(i, 0, m), vcr(i, 1, m))
                 for i, m in enumerate(molecules)]
         return self._finish_batch(molecules, vcrs)
 
@@ -430,9 +522,8 @@ class FastCodecCaller:
             if item[0] == "mol":
                 mols.append(item[1])
             elif item[0] == "vec":
-                mols.append(self._finalize_vec(batch, item[1], codes_pk,
-                                               quals_pk))
-        return [m for m in mols if m is not None]
+                mols.append(self._finalize_vec(batch, item[1]))
+        return [m for m in mols if m is not None], codes_pk, quals_pk
 
     def _pair_span(self, batch, span, g_of_row, grp_ok, fl_span, pp_span):
         """Phases 1-2 for every eligible group in one pass: primary FR
@@ -762,31 +853,17 @@ class FastCodecCaller:
             "consensus_length": consensus_length,
         }
 
-    def _finalize_vec(self, batch, prep, codes_pk, quals_pk):
-        """Phase 5: SS jobs directly over the packed rows + mol dict.
+    def _finalize_vec(self, batch, prep):
+        """Phase 5: the mol dict for the dense dispatch in _run.
 
-        The SS caller is constructed with min_reads=1 / max_reads=None
-        (codec.py ss_opts), so job_from_source_reads reduces to
-        consensus_len = longest clipped read; ConsensusJobs are built
-        straight from the pack rows with no SourceRead materialization.
+        No SS jobs are materialized — the strand rows stay resident in the
+        span's pack arrays and _run gathers them directly (the SS caller's
+        min_reads=1 / max_reads=None construction makes per-strand
+        consensus_len = longest clipped read, carried via the flens).
         """
         caller = self.caller
         f1, f2 = prep["r1_flens"], prep["r2_flens"]
-        pk = prep["pk0"]
         umi = prep["mi"]
-        umi_str = umi or ""
-
-        def job(flens, base):
-            return ConsensusJob(
-                umi=umi_str, read_type=R1,
-                codes=[codes_pk[base + k, :int(fl)]
-                       for k, fl in enumerate(flens)],
-                quals=[quals_pk[base + k, :int(fl)]
-                       for k, fl in enumerate(flens)],
-                consensus_len=int(flens.max()), original_raws=[])
-
-        job_r1 = job(f1, pk)
-        job_r2 = job(f2, pk + len(f1))
         if caller.options.cell_tag is not None:
             # only the cell-tag fallback reads raw records back
             records = batch.raw_records(prep["rows"])
@@ -807,7 +884,7 @@ class FastCodecCaller:
                 rx_umis.append(buf[o:o + ln].tobytes().decode(errors="replace"))
         return {
             "umi": umi, "records": records,
-            "job_r1": job_r1, "job_r2": job_r2,
+            "pk0": prep["pk0"], "r1_flens": f1, "r2_flens": f2,
             "n_r1": len(f1), "n_r2": len(f2),
             "r1_is_negative": prep["r1_neg"],
             "r2_is_negative": prep["r2_neg"],

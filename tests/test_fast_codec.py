@@ -200,3 +200,79 @@ def test_parity_count_threshold(codec_bam, tmp_path):
                       ["--min-reads", "1", "--max-duplex-disagreements", "1"])
     assert_cli_parity(codec_bam, tmp_path,
                       ["--min-reads", "1", "--max-duplex-disagreements", "0"])
+
+
+def test_carry_reads_longer_than_span(tmp_path):
+    """A carried molecule's reads can be longer than every read in the next
+    batch's span, pushing the dispatch L_max past the span's pack stride;
+    the dense gather must clamp its width (N/Q0 tails) instead of crashing.
+    Drives _run directly with a mixed vec + classic molecule list and checks
+    it against the same molecules run classic-only."""
+    from fgumi_tpu.consensus.codec import CodecConsensusCaller, CodecOptions
+    from fgumi_tpu.consensus.fast_codec import FastCodecCaller
+    from fgumi_tpu.consensus.vanilla import ConsensusJob, R1
+
+    rng = np.random.default_rng(8)
+
+    def strand_rows(n, length, stride):
+        codes = np.full((n, stride), 4, dtype=np.uint8)
+        quals = np.zeros((n, stride), dtype=np.uint8)
+        codes[:, :length] = rng.integers(0, 4, size=(n, length))
+        quals[:, :length] = rng.integers(10, 41, size=(n, length))
+        return codes, quals
+
+    stride = 64          # short span: 40bp reads
+    long_len = 200       # carried molecule: 200bp reads -> L_max 208 > 64
+    c1, q1 = strand_rows(2, 40, stride)
+    c2, q2 = strand_rows(2, 40, stride)
+    codes_pk = np.vstack([c1, c2])
+    quals_pk = np.vstack([q1, q2])
+    vec_mol = {
+        "umi": "7", "records": None, "source_raws": None, "rx_umis": [],
+        "pk0": 0, "n_r1": 2, "n_r2": 2,
+        "r1_flens": np.array([40, 40], dtype=np.int64),
+        "r2_flens": np.array([40, 40], dtype=np.int64),
+        "r1_is_negative": False, "r2_is_negative": True,
+        "consensus_length": 40,
+    }
+    lc, lq = strand_rows(4, long_len, long_len)
+
+    def long_mol():
+        def job(rows):
+            return ConsensusJob(
+                umi="9", read_type=R1,
+                codes=[lc[r, :long_len] for r in rows],
+                quals=[lq[r, :long_len] for r in rows],
+                consensus_len=long_len, original_raws=[])
+
+        return {
+            "umi": "9", "records": [], "source_raws": [], "rx_umis": [],
+            "job_r1": job([0, 1]), "job_r2": job([2, 3]),
+            "n_r1": 2, "n_r2": 2,
+            "r1_is_negative": False, "r2_is_negative": True,
+            "consensus_length": long_len,
+        }
+
+    caller = CodecConsensusCaller("fgumi", "A", CodecOptions())
+    fast = FastCodecCaller(caller, b"MI")
+    mixed = b"".join(fast._run([long_mol(), vec_mol], codes_pk, quals_pk))
+
+    # reference: the same two molecules, both via the classic-job path
+    def vec_as_classic():
+        def job(base):
+            return ConsensusJob(
+                umi="7", read_type=R1,
+                codes=[codes_pk[base + k, :40] for k in range(2)],
+                quals=[quals_pk[base + k, :40] for k in range(2)],
+                consensus_len=40, original_raws=[])
+
+        m = dict(vec_mol)
+        for k in ("pk0", "r1_flens", "r2_flens"):
+            del m[k]
+        m["job_r1"], m["job_r2"] = job(0), job(2)
+        return m
+
+    caller2 = CodecConsensusCaller("fgumi", "A", CodecOptions())
+    fast2 = FastCodecCaller(caller2, b"MI")
+    ref = b"".join(fast2._run([long_mol(), vec_as_classic()], None, None))
+    assert mixed == ref

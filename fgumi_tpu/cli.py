@@ -645,6 +645,13 @@ def _add_codec(sub):
                    help="emit ad/bd/ae/be/ac/bc/aq/bq tags")
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--batch-groups", type=int, default=1000)
+    p.add_argument("--batch-bytes", type=int, default=16 << 20,
+                   help="decompressed bytes per record batch (fast engine)")
+    p.add_argument("--threads", type=int, default=0,
+                   help="reader/writer threads around the batch engine "
+                        "(0/1 = inline)")
+    p.add_argument("--stats", action="store_true",
+                   help="print per-stage pipeline timing table")
     p.add_argument("--classic", action="store_true",
                    help="force the per-molecule engine (no batch vectorization)")
     _add_pipeline_compat(p)
@@ -688,21 +695,39 @@ def cmd_codec(args):
     # the rejects stream (records stay array-resident); rejects -> classic
     use_fast = (nbat.available() and args.rejects is None
                 and not getattr(args, "classic", False))
+    if not use_fast and (args.threads or args.stats):
+        log.info("--threads/--stats apply to the batch engine only; this "
+                 "run uses the classic per-molecule engine (%s)",
+                 "--rejects set" if args.rejects is not None
+                 else "--classic" if getattr(args, "classic", False)
+                 else "native runtime unavailable")
     t0 = time.monotonic()
     if use_fast:
         from .consensus.fast_codec import FastCodecCaller
         from .io.batch_reader import BamBatchReader
+        from .pipeline import StageTimes, run_stages
+        from .utils.progress import ProgressTracker
 
-        with BamBatchReader(args.input) as reader:
+        stats_t = StageTimes()
+        progress = ProgressTracker("codec")
+        with BamBatchReader(args.input,
+                            target_bytes=args.batch_bytes) as reader:
             out_header = _unmapped_consensus_header(args.read_group_id)
             fast = FastCodecCaller(caller, args.tag.encode())
+
+            def _process(batch):
+                progress.add(batch.n)
+                return fast.process_batch(batch)
+
             with BamWriter(args.output, out_header) as writer:
-                for batch in reader:
-                    for chunk in fast.process_batch(batch):
-                        writer.write_serialized(chunk)
+                run_stages(iter(reader), _process, writer.write_serialized,
+                           threads=args.threads, stats=stats_t)
                 for chunk in fast.flush():
                     writer.write_serialized(chunk)
                 n_out = caller.stats.consensus_reads_generated
+        progress.finish()
+        if args.stats:
+            print(stats_t.format_table())
     else:
         if nbat.available():
             from .io.batch_reader import BatchedRecordReader as _CodecReader

@@ -122,7 +122,9 @@ class BamBatchReader:
         fileobj = open(path_or_obj, "rb") if owns else path_or_obj
         self._r = BgzfReader(fileobj, owns_fileobj=owns)
         self.header = BamHeader.decode_from(self._r.read)
-        self._target = target_bytes
+        # a non-positive target would make _fill yield nothing and the
+        # command silently write an empty output; clamp to "one chunk"
+        self._target = max(int(target_bytes), 1)
         self._acc = bytearray()
         self._eof = False
 

@@ -276,3 +276,23 @@ def test_carry_reads_longer_than_span(tmp_path):
     fast2 = FastCodecCaller(caller2, b"MI")
     ref = b"".join(fast2._run([long_mol(), vec_as_classic()], None, None))
     assert mixed == ref
+
+
+def test_threaded_matches_inline(codec_bam, tmp_path):
+    """--threads pipeline output is byte-identical to the inline run."""
+    inline = str(tmp_path / "inl.bam")
+    threaded = str(tmp_path / "thr.bam")
+    assert main(["codec", "-i", codec_bam, "-o", inline,
+                 "--min-reads", "1"]) == 0
+    assert main(["codec", "-i", codec_bam, "-o", threaded, "--min-reads",
+                 "1", "--threads", "4", "--batch-bytes", "20000"]) == 0
+    assert records_of(inline) == records_of(threaded)
+
+
+def test_batch_bytes_zero_not_silent(codec_bam, tmp_path):
+    """--batch-bytes 0 must not silently produce an empty BAM (reader clamps
+    to one chunk)."""
+    out = str(tmp_path / "z.bam")
+    assert main(["codec", "-i", codec_bam, "-o", out, "--min-reads", "1",
+                 "--batch-bytes", "0"]) == 0
+    assert len(records_of(out)) > 0

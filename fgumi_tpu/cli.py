@@ -390,6 +390,10 @@ def _add_duplex(sub):
                    help="print per-stage pipeline timing table")
     p.add_argument("--classic", action="store_true",
                    help="force the per-molecule engine (no batch vectorization)")
+    p.add_argument("--devices", default="auto", type=_devices_arg,
+                   help="device count for data-parallel SS dispatch: auto "
+                        "(all visible) or an explicit N; 1 disables sharding "
+                        "(fast engine only)")
     _add_pipeline_compat(p)
     p.set_defaults(func=cmd_duplex)
 
@@ -437,7 +441,9 @@ def cmd_duplex(args):
         from .utils.progress import ProgressTracker
 
         stats_t = StageTimes()
-        fast = FastDuplexCaller(caller, b"MI", overlap_caller=oc_caller)
+        mesh = _build_dp_mesh(getattr(args, "devices", "auto"))
+        fast = FastDuplexCaller(caller, b"MI", overlap_caller=oc_caller,
+                                mesh=mesh)
         progress = ProgressTracker("duplex")
         with BamBatchReader(args.input,
                             target_bytes=args.batch_bytes) as reader:

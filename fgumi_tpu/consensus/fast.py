@@ -34,6 +34,59 @@ def resolve_chunk(chunk) -> bytes:
     return chunk if isinstance(chunk, bytes) else chunk.resolve()
 
 
+def split_row_balanced(counts, dp):
+    """Job boundaries for dp contiguous row-balanced shards over segments of
+    `counts` rows each: (dp+1,) indices into the job list.
+
+    The target-crossing job goes to whichever side leaves the row split
+    closer to the target (plain searchsorted+1 can collapse a 2-job batch
+    onto one device). Shared by the simplex and duplex sharded dispatches.
+    """
+    n_jobs = len(counts)
+    cum = np.cumsum(counts)
+    total = int(cum[-1])
+    targets = (np.arange(1, dp) * total) // dp
+    i = np.searchsorted(cum, targets, side="left")
+    prev = np.where(i > 0, cum[np.maximum(i - 1, 0)], 0)
+    jb = i + ((cum[np.minimum(i, n_jobs - 1)] - targets)
+              <= (targets - prev))
+    jb = np.concatenate(([0], jb, [n_jobs]))
+    return np.minimum(np.maximum.accumulate(jb), n_jobs)
+
+
+def pack_shards(codes_d, quals_d, starts, jb, L_max):
+    """Pack dense (rows, L) segment data into the (dp, N_max, L) sharded
+    layout for device_call_segments_sharded.
+
+    Returns (codes3d, quals3d, seg2d, shard_starts, n_jobs, F_loc). One copy
+    of the subtle pad invariants — rows pad with N/Q0, pad rows carry the
+    shard's LAST real segment id (so they fold into an existing segment and
+    cannot mint phantom families), and N_max/F_loc round up to pow2 for the
+    compile cache. Shared by the simplex and duplex sharded dispatches.
+    """
+    dp = len(jb) - 1
+    shard_starts = [starts[jb[d]:jb[d + 1] + 1] - starts[jb[d]]
+                    for d in range(dp)]
+    n_rows = [int(s[-1]) for s in shard_starts]
+    n_jobs = [int(jb[d + 1] - jb[d]) for d in range(dp)]
+    N_max = 1 << (max(max(n_rows), 1) - 1).bit_length()
+    F_loc = 1 << (max(max(n_jobs), 1) - 1).bit_length()
+
+    codes3d = np.full((dp, N_max, L_max), 4, dtype=np.uint8)
+    quals3d = np.zeros((dp, N_max, L_max), dtype=np.uint8)
+    seg2d = np.zeros((dp, N_max), dtype=np.int32)
+    for d in range(dp):
+        lo, hi = int(starts[jb[d]]), int(starts[jb[d + 1]])
+        n = n_rows[d]
+        codes3d[d, :n] = codes_d[lo:hi]
+        quals3d[d, :n] = quals_d[lo:hi]
+        seg2d[d, :n] = np.repeat(
+            np.arange(n_jobs[d], dtype=np.int32),
+            np.diff(shard_starts[d]))
+        seg2d[d, n:] = max(n_jobs[d] - 1, 0)
+    return codes3d, quals3d, seg2d, shard_starts, n_jobs, F_loc
+
+
 class _PendingChunk:
     """Deferred half of a batch: fetch packed device results, recompute
     depth/errors on host, apply thresholds, serialize (SURVEY §7 step 4
@@ -658,39 +711,10 @@ class FastSimplexCaller:
         """
         mesh = self.mesh
         dp = mesh.size
-        cum = np.cumsum(counts)
-        total = int(cum[-1])
-        targets = (np.arange(1, dp) * total) // dp
-        # the target-crossing job goes to whichever side leaves the row split
-        # closer to the target (plain searchsorted+1 can collapse a 2-job
-        # batch onto one device)
-        i = np.searchsorted(cum, targets, side="left")
-        prev = np.where(i > 0, cum[np.maximum(i - 1, 0)], 0)
-        jb = i + ((cum[np.minimum(i, len(cum) - 1)] - targets)
-                  <= (targets - prev))
-        jb = np.concatenate(([0], jb, [len(multi)]))
-        jb = np.minimum(np.maximum.accumulate(jb), len(multi))
-
+        jb = split_row_balanced(counts, dp)
         shard_jobs = [multi[jb[d]:jb[d + 1]] for d in range(dp)]
-        shard_starts = [starts[jb[d]:jb[d + 1] + 1] - starts[jb[d]]
-                        for d in range(dp)]
-        n_rows = [int(s[-1]) for s in shard_starts]
-        n_jobs = [len(sj) for sj in shard_jobs]
-        N_max = 1 << (max(max(n_rows), 1) - 1).bit_length()
-        F_loc = 1 << (max(max(n_jobs), 1) - 1).bit_length()
-
-        codes3d = np.full((dp, N_max, L_max), 4, dtype=np.uint8)
-        quals3d = np.zeros((dp, N_max, L_max), dtype=np.uint8)
-        seg2d = np.zeros((dp, N_max), dtype=np.int32)
-        for d in range(dp):
-            lo, hi = starts[jb[d]], starts[jb[d + 1]]
-            n = n_rows[d]
-            codes3d[d, :n] = codes_d[lo:hi]
-            quals3d[d, :n] = quals_d[lo:hi]
-            seg2d[d, :n] = np.repeat(
-                np.arange(n_jobs[d], dtype=np.int32),
-                np.diff(shard_starts[d]))
-            seg2d[d, n:] = max(n_jobs[d] - 1, 0)
+        codes3d, quals3d, seg2d, shard_starts, _, F_loc = pack_shards(
+            codes_d, quals_d, starts, jb, L_max)
         dev = self.caller.kernel.device_call_segments_sharded(
             codes3d, quals3d, seg2d, F_loc, mesh)
         return ("shard", shard_jobs, shard_starts, codes3d, quals3d, dev)

@@ -43,12 +43,17 @@ class FastDuplexCaller:
     both paths.
     """
 
-    def __init__(self, caller, tag: bytes = b"MI", overlap_caller=None):
+    def __init__(self, caller, tag: bytes = b"MI", overlap_caller=None,
+                 mesh=None):
+        """`mesh`: optional jax Mesh with a "dp" axis — multi-read SS
+        segments split into contiguous row-balanced shards, one per device
+        (same dp dispatch as the simplex caller). None = single device."""
         self.caller = caller
         self.ss = caller.ss
         self.kernel = caller.ss.kernel
         self.tag = tag
         self.overlap_caller = overlap_caller
+        self.mesh = mesh if mesh is not None and mesh.size > 1 else None
         self._carry = None  # (base_mi, [RawRecord] a, [RawRecord] b)
 
     # ------------------------------------------------------------------ driver
@@ -395,19 +400,24 @@ class FastDuplexCaller:
             # errors are zero for single-read consensus
         multi = np.nonzero(~single)[0]
         if len(multi):
-            from ..ops.kernel import pad_segments
-
             rows_m = np.concatenate(
                 [np.arange(vstarts[s], vstarts[s + 1]) for s in multi])
             cm = np.ascontiguousarray(codes2d[rows_m])
             qm = np.ascontiguousarray(quals2d[rows_m])
             counts_m = c1[multi]
             starts_m = np.concatenate(([0], np.cumsum(counts_m)))
-            codes_dev, quals_dev, seg_ids, _, F_pad = pad_segments(
-                cm, qm, counts_m)
-            dev = self.kernel.device_call_segments(codes_dev, quals_dev,
-                                                   seg_ids, F_pad)
-            w, q_, d, e = self.kernel.resolve_segments(dev, cm, qm, starts_m)
+            if self.mesh is not None:
+                w, q_, d, e = self._dispatch_sharded(cm, qm, counts_m,
+                                                     starts_m, L_max)
+            else:
+                from ..ops.kernel import pad_segments
+
+                codes_dev, quals_dev, seg_ids, _, F_pad = pad_segments(
+                    cm, qm, counts_m)
+                dev = self.kernel.device_call_segments(codes_dev, quals_dev,
+                                                       seg_ids, F_pad)
+                w, q_, d, e = self.kernel.resolve_segments(dev, cm, qm,
+                                                           starts_m)
             b_m, q_m = oracle.apply_consensus_thresholds(
                 w, q_, d, opts.min_reads, opts.min_consensus_base_quality)
             tb[multi] = b_m
@@ -415,6 +425,38 @@ class FastDuplexCaller:
             d16[multi] = np.minimum(d, I16_MAX).astype(np.int32)
             e16[multi] = np.minimum(e, I16_MAX).astype(np.int32)
         return tb, tq, d16, e16, codes2d
+
+    def _dispatch_sharded(self, cm, qm, counts_m, starts_m, L_max):
+        """dp contiguous row-balanced shards over the multi-read segments,
+        one device execution, per-shard exact resolution — the duplex twin of
+        FastSimplexCaller._dispatch_sharded (byte-identical to the
+        single-device path; tests/test_fast_duplex.py)."""
+        import jax
+
+        from .fast import pack_shards, split_row_balanced
+
+        mesh = self.mesh
+        dp = mesh.size
+        jb = split_row_balanced(counts_m, dp)
+        codes3d, quals3d, seg2d, shard_starts, n_jobs, F_loc = pack_shards(
+            cm, qm, starts_m, jb, L_max)
+        dev = self.kernel.device_call_segments_sharded(codes3d, quals3d,
+                                                       seg2d, F_loc, mesh)
+        packed = np.asarray(jax.device_get(dev))
+        J = len(counts_m)
+        w = np.zeros((J, L_max), dtype=np.uint8)
+        q_ = np.zeros((J, L_max), dtype=np.uint8)
+        d_ = np.zeros((J, L_max), dtype=np.int64)
+        e_ = np.zeros((J, L_max), dtype=np.int64)
+        for d in range(dp):
+            if n_jobs[d] == 0:
+                continue
+            n = int(shard_starts[d][-1])
+            wd, qd, dd, ed = self.kernel._finish_segments(
+                packed[d], codes3d[d, :n], quals3d[d, :n], shard_starts[d])
+            sl = slice(int(jb[d]), int(jb[d + 1]))
+            w[sl], q_[sl], d_[sl], e_[sl] = wd, qd, dd, ed
+        return w, q_, d_, e_
 
     # ---------------------------------------------------------------- stage 2
 

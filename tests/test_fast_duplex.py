@@ -238,3 +238,28 @@ def test_missing_suffix_raises(tmp_path):
         w.write_record_bytes(b.finish())
     with pytest.raises(ValueError, match="without /A or /B"):
         run_fast(path)
+
+
+def test_sharded_matches_single_device(tmp_path):
+    """8-device dp-sharded SS dispatch == single device, byte-identical
+    (VERDICT r1 item 4: mesh wired into the duplex caller too)."""
+    from fgumi_tpu.parallel.mesh import make_mesh
+
+    path = str(tmp_path / "dup.bam")
+    simulate_duplex_bam(path, num_molecules=120, reads_per_strand=4, seed=77)
+
+    def run(mesh, tb):
+        caller = make_caller((1,))
+        fast = FastDuplexCaller(caller, b"MI", mesh=mesh)
+        chunks = []
+        with BamBatchReader(path, target_bytes=tb) as reader:
+            for batch in reader:
+                chunks.extend(fast.process_batch(batch))
+        chunks.extend(fast.flush())
+        return b"".join(map(resolve_chunk, chunks))
+
+    import jax
+
+    mesh = make_mesh(dp=min(8, len(jax.devices())))
+    for tb in (4096, 1 << 20):
+        assert run(None, tb) == run(mesh, tb), tb

@@ -13,9 +13,17 @@ exit code stays 0 so a number is recorded either way).
   reference's Rust binary cannot be built in this image (no cargo), and the
   reference publishes no absolute numbers (BASELINE.md).
 
-Each measurement runs in a subprocess with a timeout, so a wedged TPU plugin
-(the r1 failure mode: jax init hanging under the injected axon backend) cannot
-take the bench down with it.
+Wedge-proofing (round 3): the TPU tunnel in this environment can wedge so that
+ANY backend init hangs forever or fails fast. Every device interaction
+therefore runs in a killable subprocess, gated by a cheap ~2-minute probe
+(jax init + one tiny matmul). Probes are retried on a schedule across the
+whole bench budget — before the CPU baselines, between them, and in a tail
+loop afterwards — because wedges are intermittent across minutes. The first
+healthy probe immediately triggers (a) a kernel-only device microbench
+(arrays already in RAM -> one dispatch per batch -> fetch) that records a TPU
+number + achieved FLOP/s + bandwidth in well under a minute of device health,
+then (b) the full pipeline runs. CPU numbers and stage timings never depend
+on device health.
 """
 
 import json
@@ -27,6 +35,103 @@ import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
+
+# --------------------------------------------------------------------------
+# subprocess payloads
+# --------------------------------------------------------------------------
+
+_PROBE = r"""
+import json, sys, time
+t0 = time.monotonic()
+import jax, jax.numpy as jnp
+d = jax.devices()[0]
+x = jnp.ones((256, 256), dtype=jnp.float32)
+y = (x @ x).block_until_ready()
+print(json.dumps({"platform": d.platform, "device": str(d),
+                  "device_kind": getattr(d, "device_kind", ""),
+                  "probe_s": round(time.monotonic() - t0, 2)}))
+"""
+
+_KERNEL_BENCH = r"""
+import json, sys, time
+sys.path.insert(0, sys.argv[1])
+import numpy as np
+import jax
+
+from fgumi_tpu.ops.tables import quality_tables
+from fgumi_tpu.ops.kernel import ConsensusKernel, pad_segments
+
+n_reads, L, fam = (int(a) for a in sys.argv[2:5])
+n_fam = n_reads // fam
+rng = np.random.default_rng(7)
+true = rng.integers(0, 4, size=(n_fam, L), dtype=np.uint8)
+codes2d = np.repeat(true, fam, axis=0)
+err = rng.random(codes2d.shape) < 0.01
+codes2d[err] = (codes2d[err] + rng.integers(1, 4, size=int(err.sum()))) % 4
+quals2d = rng.integers(25, 41, size=codes2d.shape, dtype=np.uint8)
+counts = np.full(n_fam, fam, dtype=np.int64)
+
+kernel = ConsensusKernel(quality_tables(45, 40))
+codes_dev, quals_dev, seg_ids, starts, F_pad = pad_segments(
+    codes2d, quals2d, counts)
+d = jax.devices()[0]
+
+t0 = time.monotonic()
+dev = kernel.device_call_segments(codes_dev, quals_dev, seg_ids, F_pad)
+jax.block_until_ready(dev)
+warm_s = time.monotonic() - t0
+
+iters = 10
+t0 = time.monotonic()
+for _ in range(iters):
+    dev = kernel.device_call_segments(codes_dev, quals_dev, seg_ids, F_pad)
+    jax.block_until_ready(dev)
+compute_s = (time.monotonic() - t0) / iters
+
+# end-to-end: dispatch -> fetch -> host depth/errors + oracle patch
+t0 = time.monotonic()
+dev = kernel.device_call_segments(codes_dev, quals_dev, seg_ids, F_pad)
+w, q, de, er = kernel.resolve_segments(dev, codes2d, quals2d, starts)
+e2e_s = time.monotonic() - t0
+
+# FLOP model for _segments_body (counting f32 mul/add on the padded rows):
+# one_hot*valid mask (4), delta*one_hot (4 mul), two segment_sum adds (8),
+# ~16/obs-position; epilogue ~= 40 flops per (segment, position, done over
+# F_pad*L). Memory traffic lower bound: uint8 codes+quals up, uint16 down.
+N_pad = codes_dev.shape[0]
+flops = N_pad * L * 16 + F_pad * L * 40
+bytes_moved = N_pad * L * 2 + seg_ids.nbytes + F_pad * L * 2
+fallback = kernel.fallback_positions / max(kernel.total_positions, 1)
+out = {
+    "platform": d.platform,
+    "device": str(d),
+    "device_kind": getattr(d, "device_kind", ""),
+    "n_reads": n_reads,
+    "read_len": L,
+    "families": n_fam,
+    "warm_s": round(warm_s, 3),
+    "compute_s_per_dispatch": round(compute_s, 4),
+    "e2e_s_per_dispatch": round(e2e_s, 4),
+    "kernel_reads_per_sec": round(n_reads / compute_s, 1),
+    "kernel_e2e_reads_per_sec": round(n_reads / e2e_s, 1),
+    "model_gflops": round(flops / 1e9, 3),
+    "achieved_gflops_per_s": round(flops / compute_s / 1e9, 2),
+    "achieved_gbytes_per_s": round(bytes_moved / compute_s / 1e9, 3),
+    "suspect_fallback_rate": round(fallback, 6),
+}
+# MFU vs known peaks (bf16 systolic peak per chip; this kernel is
+# VPU/elementwise-dominated so low MFU is expected — bandwidth is the
+# honest utilization axis, also reported).
+peaks = {"v5e": (197e12, 819e9), "v5p": (459e12, 2765e9),
+         "v4": (275e12, 1228e9), "v6": (918e12, 1640e9)}
+kind = out["device_kind"].lower()
+for key, (pf, pb) in peaks.items():
+    if key in kind:
+        out["mfu_pct"] = round(100.0 * flops / compute_s / pf, 4)
+        out["hbm_bw_util_pct"] = round(100.0 * bytes_moved / compute_s / pb, 2)
+        break
+print(json.dumps(out))
+"""
 
 _WORKER = r"""
 import json, os, sys, time
@@ -54,20 +159,18 @@ print(json.dumps({"platform": platform, "device": str(jax.devices()[0]),
 """
 
 
-def run_worker(in_bam, threads, env_overrides, timeout_s, cmd="simplex"):
-    """One timed pipeline run in a subprocess. Returns (result|None, error)."""
+def _run_script(script, argv, env_overrides, timeout_s):
+    """Run a python -c payload in a killable subprocess. -> (dict|None, err)."""
     env = dict(os.environ)
     env.update(env_overrides)
-    with tempfile.TemporaryDirectory(prefix="fgumi_bench_out_") as out_dir:
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", _WORKER % {"repo": REPO}, in_bam,
-                 out_dir, str(threads), cmd],
-                capture_output=True, text=True, timeout=timeout_s, env=env)
-        except subprocess.TimeoutExpired:
-            return None, f"timeout after {timeout_s}s (wedged device init?)"
-        except OSError as e:
-            return None, f"spawn failed: {e}"
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", script] + [str(a) for a in argv],
+            capture_output=True, text=True, timeout=timeout_s, env=env)
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {int(timeout_s)}s (wedged device init?)"
+    except OSError as e:
+        return None, f"spawn failed: {e}"
     if proc.returncode != 0:
         tail = (proc.stderr or "").strip().splitlines()[-8:]
         return None, f"rc={proc.returncode}: " + " | ".join(tail)
@@ -75,6 +178,14 @@ def run_worker(in_bam, threads, env_overrides, timeout_s, cmd="simplex"):
         return json.loads(proc.stdout.strip().splitlines()[-1]), None
     except (ValueError, IndexError):
         return None, f"unparseable worker output: {proc.stdout[-300:]!r}"
+
+
+def run_worker(in_bam, threads, env_overrides, timeout_s, cmd="simplex"):
+    """One timed pipeline run in a subprocess. Returns (result|None, error)."""
+    with tempfile.TemporaryDirectory(prefix="fgumi_bench_out_") as out_dir:
+        return _run_script(_WORKER % {"repo": REPO},
+                           [in_bam, out_dir, threads, cmd],
+                           env_overrides, timeout_s)
 
 
 def count_records(path):
@@ -87,116 +198,152 @@ def count_records(path):
     return n
 
 
-def main():
-    from fgumi_tpu.simulate import simulate_grouped_bam
+# CPU env: jax pinned to CPU. PYTHONPATH + PALLAS_AXON_POOL_IPS cleared: the
+# injected axon sitecustomize pre-imports jax with the tunnel backend and can
+# block or fail init even under JAX_PLATFORMS=cpu while the tunnel is wedged.
+CPU_ENV = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
+           "PALLAS_AXON_POOL_IPS": ""}
 
+
+class DeviceTrier:
+    """Probe-gated device measurements, retryable across the bench window.
+
+    Each call to attempt() costs at most one probe when the device is down,
+    and finishes the remaining device measurements (kernel microbench, then
+    simplex pipeline, then duplex pipeline) when it is up. Wedges are
+    intermittent, so failed probes are cheap by design and retried later.
+    """
+
+    def __init__(self, deadline, probe_timeout, run_timeout, t_start):
+        self.deadline = deadline
+        self.probe_timeout = probe_timeout
+        self.run_timeout = run_timeout
+        self.t_start = t_start
+        self.probes = []
+        self.kernel = None
+        self.simplex = None
+        self.duplex = None
+        self.diagnostics = []
+
+    def _remaining(self):
+        return self.deadline - time.monotonic()
+
+    def done(self, want_duplex):
+        return (self.kernel is not None and self.simplex is not None
+                and (not want_duplex or self.duplex is not None))
+
+    def probe(self):
+        t = round(time.monotonic() - self.t_start, 1)  # offset into the bench
+        timeout = min(self.probe_timeout, max(self._remaining(), 10))
+        res, err = _run_script(_PROBE, [], {}, timeout)
+        if res is not None and res.get("platform") == "cpu":
+            res, err = None, f"probe got CPU backend ({res.get('device')})"
+        self.probes.append({"t": t, "ok": res is not None,
+                            **({k: res[k] for k in ("platform", "probe_s",
+                                                    "device_kind")}
+                               if res else {"err": err})})
+        return res
+
+    def attempt(self, sim_bam, dup_bam, threads):
+        """One probe-gated pass over the unfinished device measurements."""
+        if self._remaining() < 30:
+            return
+        if self.probe() is None:
+            return
+        if self.kernel is None and self._remaining() > 60:
+            res, err = _run_script(
+                _KERNEL_BENCH, [REPO, 65536, 100, 5], {},
+                min(420, max(self._remaining(), 30)))
+            if res is not None:
+                self.kernel = res
+            else:
+                self.diagnostics.append(f"kernel microbench: {err}")
+        if self.simplex is None and self._remaining() > 120:
+            res, err = run_worker(
+                sim_bam, threads, {},
+                min(self.run_timeout, max(self._remaining(), 60)))
+            if res is not None:
+                self.simplex = res
+            else:
+                self.diagnostics.append(f"simplex device: {err}")
+        if (self.duplex is None and dup_bam is not None
+                and self._remaining() > 120):
+            res, err = run_worker(
+                dup_bam, threads, {},
+                min(self.run_timeout, max(self._remaining(), 60)),
+                cmd="duplex")
+            if res is not None:
+                self.duplex = res
+            else:
+                self.diagnostics.append(f"duplex device: {err}")
+
+
+def main():
+    from fgumi_tpu.simulate import simulate_duplex_bam, simulate_grouped_bam
+
+    t_start = time.monotonic()
     n_families = int(os.environ.get("BENCH_FAMILIES", "40000"))
     threads = int(os.environ.get("BENCH_THREADS", "4"))
-    timeout_s = int(os.environ.get("BENCH_TIMEOUT", "900"))
+    budget_s = int(os.environ.get("BENCH_BUDGET", "2400"))
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+    run_timeout = int(os.environ.get("BENCH_TIMEOUT", "600"))
+    want_duplex = os.environ.get("BENCH_DUPLEX", "1") not in ("0", "false")
+    deadline = t_start + budget_s
+
     tmp = tempfile.mkdtemp(prefix="fgumi_bench_")
     sim = os.path.join(tmp, "sim.bam")
     simulate_grouped_bam(sim, num_families=n_families, family_size=5,
                          family_size_distribution="lognormal", read_length=100,
                          error_rate=0.01, seed=42)
     n_reads = count_records(sim)
+    dup = None
+    n_dup = 0
+    if want_duplex:
+        dup = os.path.join(tmp, "duplex.bam")
+        n_dup = simulate_duplex_bam(dup, num_molecules=max(n_families // 8, 500),
+                                    reads_per_strand=3, seed=42)
 
-    diagnostics = []
-    # TPU run: ambient env (the driver provides the TPU backend). Retry once
-    # on non-timeout errors; a timeout means the tunnel is wedged and further
-    # device attempts would only burn the bench budget.
-    device_dead = False
-    tpu, err = run_worker(sim, threads, {}, timeout_s)
-    if tpu is None:
-        diagnostics.append(f"device attempt 1: {err}")
-        if (err or "").startswith("timeout after"):
-            device_dead = True
-        else:
-            tpu, err = run_worker(sim, threads, {}, timeout_s)
-            if tpu is None:
-                diagnostics.append(f"device attempt 2: {err}")
-                device_dead = (err or "").startswith("timeout after")
+    trier = DeviceTrier(deadline, probe_timeout, run_timeout, t_start)
+
+    # Device attempt 1 (upfront: a healthy tunnel yields a TPU number in the
+    # first minutes, before any CPU work).
+    trier.attempt(sim, dup, threads)
 
     # CPU baseline: identical pipeline, jax pinned to CPU. Inline mode often
     # beats reader/writer threads on CPU jax (XLA's own thread pool competes
     # for the cores the pipeline threads would use), so the baseline takes
     # the best of both — it claims to be the best host-only path.
-    # PYTHONPATH cleared: the injected axon sitecustomize can block jax init
-    # even under JAX_PLATFORMS=cpu while the tunnel is wedged
-    cpu_env = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": ""}
-    cpu, err = run_worker(sim, threads, cpu_env, timeout_s)
+    diagnostics = []
+    cpu, err = run_worker(sim, threads, CPU_ENV, run_timeout)
     if cpu is None:
         diagnostics.append(f"cpu baseline: {err}")
-    cpu0, err0 = run_worker(sim, 0, cpu_env, timeout_s)
-    if cpu0 is not None and (cpu is None
-                             or cpu0["wall_s"] < cpu["wall_s"]):
+    cpu0, err0 = run_worker(sim, 0, CPU_ENV, run_timeout)
+    if cpu0 is not None and (cpu is None or cpu0["wall_s"] < cpu["wall_s"]):
         cpu = dict(cpu0, threads=0)
     elif err0:
         diagnostics.append(f"cpu inline baseline: {err0}")
 
-    result = {
-        "metric": "simplex consensus pipeline throughput",
-        "unit": "input reads/sec",
-        "baseline": "same pipeline, jax on CPU (best host-only path; "
-                    "reference Rust CPU binary not buildable in this image)",
-        "input_reads": n_reads,
-        "threads": threads,
-    }
-    timed = tpu or cpu
-    if timed is None:
-        # nothing ran: report a zero measurement with full diagnostics, rc=0
-        result.update({"value": 0.0, "vs_baseline": 0.0,
-                       "error": "; ".join(diagnostics)})
-    else:
-        rps = n_reads / timed["wall_s"]
-        result.update({
-            "value": round(rps, 1),
-            "platform": timed["platform"],
-            "device": timed.get("device"),
-            "wall_s": timed["wall_s"],
-            "warm_s": timed["warm_s"],
-        })
-        if cpu is not None:
-            cpu_rps = n_reads / cpu["wall_s"]
-            result["cpu_reads_per_sec"] = round(cpu_rps, 1)
-            # a CPU-only measurement is not a device-vs-CPU ratio: report the
-            # sentinel rather than a fabricated 1.0
-            result["vs_baseline"] = round(rps / cpu_rps, 3) if tpu else 0.0
-        else:
-            result["vs_baseline"] = 0.0
-        if tpu is None:
-            result["note"] = "device run failed; value measured on CPU"
-        if diagnostics:
-            result["diagnostics"] = diagnostics
+    # CPU kernel microbench (same shapes as the device one -> clean ratio).
+    kernel_cpu, kerr = _run_script(_KERNEL_BENCH, [REPO, 65536, 100, 5],
+                                   CPU_ENV, run_timeout)
+    if kernel_cpu is None:
+        diagnostics.append(f"kernel cpu microbench: {kerr}")
 
-    # secondary metric: duplex consensus throughput (BASELINE eval config 3)
-    if os.environ.get("BENCH_DUPLEX", "1") not in ("0", "false"):
-        from fgumi_tpu.simulate import simulate_duplex_bam
+    trier.attempt(sim, dup, threads)  # device attempt 2
 
-        dup = os.path.join(tmp, "duplex.bam")
-        n_dup = simulate_duplex_bam(dup, num_molecules=max(n_families // 8, 500),
-                                    reads_per_strand=3, seed=42)
-        d_tpu, derr = (None, "device wedged (skipped)") if device_dead \
-            else run_worker(dup, threads, {}, timeout_s, cmd="duplex")
-        d_cpu, d_cpu_err = run_worker(dup, threads, cpu_env, timeout_s,
+    d_cpu = None
+    if want_duplex:
+        d_cpu, d_cpu_err = run_worker(dup, threads, CPU_ENV, run_timeout,
                                       cmd="duplex")
-        d_timed = d_tpu or d_cpu
-        dup_diag = []
-        if derr:
-            dup_diag.append(f"duplex device: {derr}")
         if d_cpu_err:
-            dup_diag.append(f"duplex cpu: {d_cpu_err}")
-        if d_timed is not None:
-            result["duplex_reads_per_sec"] = round(n_dup / d_timed["wall_s"], 1)
-            result["duplex_platform"] = d_timed["platform"]
-            if d_cpu is not None and d_tpu is not None:
-                result["duplex_vs_baseline"] = round(
-                    d_cpu["wall_s"] / d_tpu["wall_s"], 3)
-        if dup_diag:
-            result["duplex_diagnostics"] = dup_diag
+            diagnostics.append(f"duplex cpu: {d_cpu_err}")
+
+    trier.attempt(sim, dup, threads)  # device attempt 3
 
     # tertiary metrics: host-side stage throughputs + the full best-practice
     # chain (BASELINE config 5 analog), all on CPU jax in one subprocess —
     # breadth evidence independent of the device tunnel's health
+    stages_result = {}
     if os.environ.get("BENCH_STAGES", "1") not in ("0", "false"):
         stage_script = r"""
 import json, os, sys, time
@@ -236,29 +383,99 @@ print(json.dumps(out))
         stage_fam = int(os.environ.get("BENCH_STAGE_FAMILIES", "40000"))
         with tempfile.TemporaryDirectory(
                 prefix="fgumi_bench_stages_") as stage_tmp:
-            try:
-                proc = subprocess.run(
-                    [sys.executable, "-c", stage_script % {"repo": REPO},
-                     stage_tmp, str(stage_fam), str(threads)],
-                    capture_output=True, text=True,
-                    timeout=timeout_s * 3,  # a 6-stage chain, not one run
-                    env={**os.environ, **cpu_env})
-                if proc.returncode == 0:
-                    stages = json.loads(proc.stdout.strip().splitlines()[-1])
-                    n_stage_reads = stage_fam * 10  # pairs * family size 5
-                    total = sum(v for k, v in stages.items()
-                                if k != "e2e_simulate_s")
-                    result["pipeline_stage_seconds"] = stages
-                    result["pipeline_e2e_reads_per_sec"] = round(
-                        n_stage_reads / total, 1) if total else 0.0
-                    result["pipeline_e2e_input_reads"] = n_stage_reads
-                else:
-                    tail = (proc.stderr or "").strip().splitlines()[-3:]
-                    result["pipeline_diagnostics"] = \
-                        [f"rc={proc.returncode}"] + tail
-            except (subprocess.TimeoutExpired, ValueError, OSError) as e:
-                result["pipeline_diagnostics"] = [f"stage bench failed: {e}"]
+            stages, serr = _run_script(
+                stage_script % {"repo": REPO}, [stage_tmp, stage_fam, threads],
+                CPU_ENV, run_timeout * 3)  # a 6-stage chain, not one run
+            if stages is not None:
+                n_stage_reads = stage_fam * 10  # pairs * family size 5
+                total = sum(v for k, v in stages.items()
+                            if k != "e2e_simulate_s")
+                stages_result["pipeline_stage_seconds"] = stages
+                stages_result["pipeline_e2e_reads_per_sec"] = round(
+                    n_stage_reads / total, 1) if total else 0.0
+                stages_result["pipeline_e2e_input_reads"] = n_stage_reads
+            else:
+                stages_result["pipeline_diagnostics"] = [
+                    f"stage bench failed: {serr}"]
 
+    # Tail loop: keep probing across the remaining budget until the device
+    # measurements complete or 8 spaced probes have failed (conclusive
+    # evidence of a full-window wedge). A wedge can clear at any minute; the
+    # first minute of health is enough for the kernel microbench. The CPU
+    # phases above may have eaten the nominal budget (each is itself
+    # timeout-bounded) — guarantee the tail loop a reserved probe window
+    # regardless, so the retry schedule survives slow CPU baselines.
+    trier.deadline = max(trier.deadline,
+                         time.monotonic() + min(600, budget_s // 4))
+    while (not trier.done(want_duplex)
+           and trier.deadline - time.monotonic() > 180
+           and sum(1 for p in trier.probes if not p["ok"]) < 8):
+        wait = min(45.0, max(trier.deadline - time.monotonic() - 150, 0))
+        time.sleep(wait)
+        trier.attempt(sim, dup, threads)
+
+    diagnostics.extend(trier.diagnostics)
+    tpu = trier.simplex
+    result = {
+        "metric": "simplex consensus pipeline throughput",
+        "unit": "input reads/sec",
+        "baseline": "same pipeline, jax on CPU (best host-only path; "
+                    "reference Rust CPU binary not buildable in this image)",
+        "input_reads": n_reads,
+        "threads": threads,
+    }
+    timed = tpu or cpu
+    if timed is None:
+        result.update({"value": 0.0, "vs_baseline": 0.0,
+                       "error": "; ".join(diagnostics)})
+    else:
+        rps = n_reads / timed["wall_s"]
+        result.update({
+            "value": round(rps, 1),
+            "platform": timed["platform"],
+            "device": timed.get("device"),
+            "wall_s": timed["wall_s"],
+            "warm_s": timed["warm_s"],
+        })
+        if cpu is not None:
+            cpu_rps = n_reads / cpu["wall_s"]
+            result["cpu_reads_per_sec"] = round(cpu_rps, 1)
+            # a CPU-only measurement is not a device-vs-CPU ratio: report the
+            # sentinel rather than a fabricated 1.0
+            result["vs_baseline"] = round(rps / cpu_rps, 3) if tpu else 0.0
+        else:
+            result["vs_baseline"] = 0.0
+        if tpu is None:
+            result["note"] = "device run failed; value measured on CPU"
+
+    # kernel microbench results (device + CPU) — the TPU number that survives
+    # a mostly-wedged window, plus MFU/bandwidth accounting
+    if trier.kernel is not None:
+        result["kernel_tpu"] = trier.kernel
+        if kernel_cpu is not None:
+            result["kernel_vs_cpu"] = round(
+                trier.kernel["kernel_reads_per_sec"]
+                / kernel_cpu["kernel_reads_per_sec"], 3)
+    if kernel_cpu is not None:
+        result["kernel_cpu_reads_per_sec"] = \
+            kernel_cpu["kernel_reads_per_sec"]
+        result["kernel_cpu_e2e_reads_per_sec"] = \
+            kernel_cpu["kernel_e2e_reads_per_sec"]
+
+    if want_duplex:
+        d_timed = trier.duplex or d_cpu
+        if d_timed is not None:
+            result["duplex_reads_per_sec"] = round(n_dup / d_timed["wall_s"], 1)
+            result["duplex_platform"] = d_timed["platform"]
+            if d_cpu is not None and trier.duplex is not None:
+                result["duplex_vs_baseline"] = round(
+                    d_cpu["wall_s"] / trier.duplex["wall_s"], 3)
+
+    result.update(stages_result)
+    result["device_probes"] = trier.probes
+    if diagnostics:
+        result["diagnostics"] = diagnostics
+    result["bench_wall_s"] = round(time.monotonic() - t_start, 1)
     print(json.dumps(result))
     return 0
 

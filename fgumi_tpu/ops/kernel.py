@@ -490,18 +490,47 @@ class ConsensusKernel:
 
         `family_rows(f) -> (codes (R, L), quals (R, L))` abstracts the layout
         difference between the uniform-R batch and the ragged segment path.
+
+        Suspect (family, position) pairs are stacked as columns of a shared
+        (R_bucket, C) pileup and recomputed in one oracle call per pow2
+        family-depth bucket — accumulate_likelihoods is already vectorized
+        over its position axis, and end-padding with N rows is a no-op for
+        it, so this is semantically identical to the per-family loop it
+        replaces while doing ~C fewer Python/NumPy round trips (the patch
+        showed up at ~20% of simplex CPU wall time as a per-family loop).
+        Bucketing by depth class caps pad waste at 2x, so one deep family
+        cannot inflate every other column to its row count.
         """
         from . import oracle
 
         fam_idx, pos_idx = np.nonzero(suspect)
-        for f in np.unique(fam_idx):
-            positions = pos_idx[fam_idx == f]
+        fams, first = np.unique(fam_idx, return_index=True)
+        bounds = np.append(first, len(fam_idx))  # fam_idx is sorted (nonzero)
+        buckets = {}  # depth class -> [(R_f, P_f) codes, quals, col pair idxs]
+        for i, f in enumerate(fams):
+            sel = slice(bounds[i], bounds[i + 1])
+            positions = pos_idx[sel]
             fam_codes, fam_quals = family_rows(f)
-            w, q, d, e = oracle.call_family(
-                np.ascontiguousarray(fam_codes[:, positions]),
-                np.ascontiguousarray(fam_quals[:, positions]),
-                self.tables)
-            winner[f, positions] = w
-            qual[f, positions] = q
-            depth[f, positions] = d
-            errors[f, positions] = e
+            cls = max(int(fam_codes.shape[0]) - 1, 0).bit_length()
+            buckets.setdefault(cls, []).append(
+                (fam_codes[:, positions], fam_quals[:, positions], sel))
+        for cols in buckets.values():
+            r_max = max(cc.shape[0] for cc, _, _ in cols)
+            c_tot = sum(cc.shape[1] for cc, _, _ in cols)
+            col_codes = np.full((r_max, c_tot), N_CODE, dtype=np.uint8)
+            col_quals = np.zeros((r_max, c_tot), dtype=np.uint8)
+            c0 = 0
+            for cc, cq, _ in cols:
+                col_codes[:cc.shape[0], c0:c0 + cc.shape[1]] = cc
+                col_quals[:cq.shape[0], c0:c0 + cq.shape[1]] = cq
+                c0 += cc.shape[1]
+            w, q, d, e = oracle.call_family(col_codes, col_quals, self.tables)
+            c0 = 0
+            for cc, _, sel in cols:
+                c1 = c0 + cc.shape[1]
+                fi, pi = fam_idx[sel], pos_idx[sel]
+                winner[fi, pi] = w[c0:c1]
+                qual[fi, pi] = q[c0:c1]
+                depth[fi, pi] = d[c0:c1]
+                errors[fi, pi] = e[c0:c1]
+                c0 = c1

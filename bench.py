@@ -150,12 +150,18 @@ t0 = time.monotonic()
 rc = main(base + ["-o", os.path.join(out_dir, "warm.bam")])
 warm_s = time.monotonic() - t0
 assert rc == 0, "warm-up run failed"
+from fgumi_tpu.ops.kernel import DEVICE_STATS
+DEVICE_STATS.reset()
 t0 = time.monotonic()
 rc = main(base + ["-o", os.path.join(out_dir, "timed.bam")])
 wall_s = time.monotonic() - t0
 assert rc == 0, "timed run failed"
+dstats = DEVICE_STATS.snapshot()
 print(json.dumps({"platform": platform, "device": str(jax.devices()[0]),
-                  "warm_s": round(warm_s, 3), "wall_s": round(wall_s, 3)}))
+                  "warm_s": round(warm_s, 3), "wall_s": round(wall_s, 3),
+                  "device_fraction": round(
+                      dstats["fetch_wait_s"] / wall_s, 4) if wall_s else 0.0,
+                  "device_stats": dstats}))
 """
 
 
@@ -437,6 +443,9 @@ print(json.dumps(out))
             "wall_s": timed["wall_s"],
             "warm_s": timed["warm_s"],
         })
+        if "device_fraction" in timed:
+            result["device_fraction"] = timed["device_fraction"]
+            result["device_stats"] = timed.get("device_stats")
         if cpu is not None:
             cpu_rps = n_reads / cpu["wall_s"]
             result["cpu_reads_per_sec"] = round(cpu_rps, 1)

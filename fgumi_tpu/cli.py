@@ -98,6 +98,17 @@ def _apply_pipeline_compat(args):
     return 0
 
 
+def _print_stats(stats, wall_s=None):
+    """--stats output: per-stage busy/blocked table plus the device-boundary
+    accounting (dispatches, fetch-wait, GFLOP/s, MFU estimate, device
+    fraction of wall) when any kernel dispatched this run."""
+    print(stats.format_table())
+    from .ops.kernel import DEVICE_STATS
+
+    if DEVICE_STATS.dispatches:
+        print(DEVICE_STATS.format_summary(wall_s))
+
+
 def _unmapped_consensus_header(read_group_id: str):
     """Unmapped-consensus output header: no reference sequences, single RG,
     @PG capturing the command line (consensus_runner.rs:115+)."""
@@ -310,7 +321,7 @@ def cmd_simplex(args):
             progress.finish()
         n_out = caller.stats.consensus_reads
         if args.stats:
-            print(stats.format_table())
+            _print_stats(stats, time.monotonic() - t0)
     else:
         from .consensus.overlapping import apply_overlapping_consensus
 
@@ -463,7 +474,7 @@ def cmd_duplex(args):
         progress.finish()
         n_out = caller.stats.consensus_reads
         if args.stats:
-            print(stats_t.format_table())
+            _print_stats(stats_t, time.monotonic() - t0)
     else:
         with BamReader(args.input) as reader:
             from .consensus.rejects import RejectsSink
@@ -733,7 +744,7 @@ def cmd_codec(args):
                 n_out = caller.stats.consensus_reads_generated
         progress.finish()
         if args.stats:
-            print(stats_t.format_table())
+            _print_stats(stats_t, time.monotonic() - t0)
     else:
         if nbat.available():
             from .io.batch_reader import BatchedRecordReader as _CodecReader
@@ -864,7 +875,7 @@ def cmd_group(args):
                         writer.write_serialized(chunk)
                     result = grouper.result()
                     if getattr(args, "stats", False):
-                        print(stats_t.format_table())
+                        _print_stats(stats_t)
                 else:
                     result = run_group(
                         reader, writer, strategy=args.strategy,
@@ -1972,7 +1983,7 @@ def cmd_dedup(args):
                         writer.write_serialized(chunk)
                     metrics, family_sizes = dd.result()
                     if getattr(args, "stats", False):
-                        print(stats_t.format_table())
+                        _print_stats(stats_t)
                 else:
                     metrics, family_sizes = run_dedup(
                         reader, writer, strategy=args.strategy,

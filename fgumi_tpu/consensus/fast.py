@@ -114,7 +114,9 @@ class _PendingChunk:
             self._assign(idxs, winner, qual, depth, errors)
         else:  # "shard": (dp, F_local, L) packed, one family shard per device
             _, shard_jobs, shard_starts, codes3d, quals3d, dev = self.pending
-            packed = np.asarray(jax.device_get(dev))
+            from ..ops.kernel import DEVICE_STATS
+
+            packed = DEVICE_STATS.fetch(dev)
             for d, (jlist, starts_d) in enumerate(zip(shard_jobs,
                                                       shard_starts)):
                 n = starts_d[-1]

@@ -442,7 +442,9 @@ class FastDuplexCaller:
             cm, qm, starts_m, jb, L_max)
         dev = self.kernel.device_call_segments_sharded(codes3d, quals3d,
                                                        seg2d, F_loc, mesh)
-        packed = np.asarray(jax.device_get(dev))
+        from ..ops.kernel import DEVICE_STATS
+
+        packed = DEVICE_STATS.fetch(dev)
         J = len(counts_m)
         w = np.zeros((J, L_max), dtype=np.uint8)
         q_ = np.zeros((J, L_max), dtype=np.uint8)

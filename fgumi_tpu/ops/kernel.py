@@ -30,6 +30,7 @@ instead of |ll| (hundreds to thousands), which is what makes f32 viable at depth
 
 import os
 import threading
+import time
 from functools import partial
 
 import jax
@@ -118,6 +119,86 @@ _PHRED_PER_LN = np.float32(10.0 / np.log(10.0))
 # ---------------------------------------------------------------------------
 _QUAL_GUARD_FLOOR = 3e-4  # Phred units; absorbs O(eps32) evaluation error
 _TIE_GUARD_FLOOR = 1e-5  # ln units; exact-tie ulp jitter
+
+# bf16 systolic peak FLOP/s and HBM GB/s per chip, keyed by substrings of
+# jax device_kind — for the MFU/bandwidth utilization estimate below. The
+# consensus kernel is VPU/elementwise-dominated, so low MFU is expected and
+# bandwidth is the honest utilization axis; both are reported.
+_DEVICE_PEAKS = {"v5e": (197e12, 819e9), "v5p": (459e12, 2765e9),
+                 "v4": (275e12, 1228e9), "v6": (918e12, 1640e9)}
+
+
+class DeviceStats:
+    """Device-interaction accounting (the §5.1 analog of PipelineStats'
+    per-step timers, scoped to the device boundary): dispatch count, host
+    time blocked on fetch (dispatch-to-fetch on an async backend ==
+    remaining compute + transfer), bytes fetched, and a model-FLOP tally
+    from the dispatched shapes. Thread-safe; one module-wide instance
+    aggregates across kernels so a CLI run can report a single device
+    fraction regardless of how many callers it built."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self):
+        """Zero the counters (e.g. between a warm-up and a timed run)."""
+        self.dispatches = 0
+        self.fetch_wait_s = 0.0
+        self.bytes_fetched = 0
+        self.model_flops = 0
+
+    def add_dispatch(self, flops: int):
+        with self._lock:
+            self.dispatches += 1
+            self.model_flops += int(flops)
+
+    def fetch(self, dev):
+        """Timed jax.device_get — route every device->host fetch through
+        here so fetch_wait_s captures all host time blocked on the device."""
+        t0 = time.monotonic()
+        out = np.asarray(jax.device_get(dev))
+        with self._lock:
+            self.fetch_wait_s += time.monotonic() - t0
+            self.bytes_fetched += out.nbytes
+        return out
+
+    def snapshot(self):
+        with self._lock:
+            return {"dispatches": self.dispatches,
+                    "fetch_wait_s": round(self.fetch_wait_s, 3),
+                    "bytes_fetched": self.bytes_fetched,
+                    "model_gflops": round(self.model_flops / 1e9, 3)}
+
+    def format_summary(self, wall_s: float = None) -> str:
+        s = self.snapshot()
+        parts = [f"device: {s['dispatches']} dispatches, "
+                 f"fetch-wait {s['fetch_wait_s']:.3f}s, "
+                 f"{s['bytes_fetched'] / 1e6:.1f} MB fetched, "
+                 f"model {s['model_gflops']:.2f} GFLOP"]
+        if self.fetch_wait_s > 0:
+            gfs = self.model_flops / self.fetch_wait_s / 1e9
+            parts.append(f"~{gfs:.1f} GFLOP/s incl. transfer")
+            kind = getattr(jax.devices()[0], "device_kind", "").lower()
+            for key, (peak_f, _peak_b) in _DEVICE_PEAKS.items():
+                if key in kind:
+                    parts.append(
+                        f"MFU ~{100.0 * gfs * 1e9 / peak_f:.4f}%")
+                    break
+        if wall_s:
+            parts.append(f"device fraction {self.fetch_wait_s / wall_s:.2%} "
+                         f"of {wall_s:.2f}s wall")
+        return "; ".join(parts)
+
+
+DEVICE_STATS = DeviceStats()
+
+
+def segments_flops(n_rows: int, length: int, num_segments: int) -> int:
+    """Model FLOPs for one _segments_body execution (counting f32 mul/add):
+    one_hot*valid mask (4) + delta*one_hot (4) + two segment_sum adds (8)
+    per (row, position), ~40 epilogue flops per (segment, position)."""
+    return n_rows * length * 16 + num_segments * length * 40
 
 
 def _observation_terms(codes, quals, correct_tab, err_tab):
@@ -376,6 +457,8 @@ class ConsensusKernel:
         2 bytes/position crosses the link instead of 17 (4 x int32 + bool), and
         one fetch instead of five; depth/errors come from _host_counts.
         """
+        F, R, L = codes.shape
+        DEVICE_STATS.add_dispatch(segments_flops(F * R, L, F))
         return _consensus_batch_packed_jit(
             jnp.asarray(codes), jnp.asarray(quals), self._correct_f32, self._err_f32, self._pre
         )
@@ -401,7 +484,7 @@ class ConsensusKernel:
         Thread-safe; this is the single completion path shared by the direct
         __call__ and the pipeline's deferred (writer-stage) resolution.
         """
-        packed = jax.device_get(dev)
+        packed = DEVICE_STATS.fetch(dev)
         winner, qual, suspect = _unpack_device_result(packed)
         depth, errors = self._host_counts(codes, winner)
         depth = depth.astype(np.int64)
@@ -421,6 +504,8 @@ class ConsensusKernel:
     def device_call_segments(self, codes2d, quals2d, seg_ids,
                              num_segments: int):
         """Dispatch dense (N, L) read rows with sorted per-row segment ids."""
+        DEVICE_STATS.add_dispatch(segments_flops(
+            codes2d.shape[0], codes2d.shape[1], num_segments))
         return _consensus_segments_packed_jit(
             jnp.asarray(codes2d), jnp.asarray(quals2d), jnp.asarray(seg_ids),
             self._correct_f32, self._err_f32, self._pre, num_segments)
@@ -428,6 +513,8 @@ class ConsensusKernel:
     def device_call_segments_sharded(self, codes3d, quals3d, seg_ids2d,
                                      num_segments: int, mesh):
         """Dispatch (dp, N, L) rows, one contiguous family shard per device."""
+        dp, N, L = codes3d.shape
+        DEVICE_STATS.add_dispatch(segments_flops(dp * N, L, dp * num_segments))
         return _consensus_segments_sharded_jit(
             jnp.asarray(codes3d), jnp.asarray(quals3d), jnp.asarray(seg_ids2d),
             self._correct_f32, self._err_f32, self._pre, num_segments, mesh)
@@ -441,7 +528,7 @@ class ConsensusKernel:
         Returns (winner, qual, depth, errors) as (J, L) arrays with suspect
         positions recomputed exactly by the f64 oracle.
         """
-        packed = jax.device_get(dev)
+        packed = DEVICE_STATS.fetch(dev)
         return self._finish_segments(packed, codes2d, quals2d, starts)
 
     def _finish_segments(self, packed: np.ndarray, codes2d, quals2d, starts):

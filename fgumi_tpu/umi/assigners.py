@@ -225,7 +225,11 @@ def _device_pairwise(mat_a: np.ndarray, mat_b: np.ndarray) -> np.ndarray:
         matches = jnp.einsum("nlk,mlk->nm", oh_a, oh_b)
         return (a.shape[1] - matches).astype(jnp.int16)
 
-    return np.asarray(jax.device_get(dist(jnp.asarray(mat_a), jnp.asarray(mat_b))))
+    from ..ops.kernel import DEVICE_STATS
+
+    DEVICE_STATS.add_dispatch(2 * mat_a.shape[0] * mat_b.shape[0]
+                              * mat_a.shape[1] * 8)  # one-hot matmul (K=8)
+    return DEVICE_STATS.fetch(dist(jnp.asarray(mat_a), jnp.asarray(mat_b)))
 
 
 def _assert_uniform_length(lengths) -> None:

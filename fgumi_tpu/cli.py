@@ -949,7 +949,7 @@ def _rewrite_hd(text, so, go, ss):
 
 def cmd_sort(args):
     from .io.bam import FLAG_UNMAPPED, BamHeader, BamReader, BamWriter, RawRecord
-    from .sort.external import ExternalSorter, header_tags_for_order
+    from .sort.external import header_tags_for_order
     from .sort.keys import make_key_bytes_fn
     from .utils.memory import resolve_budget
 
@@ -988,10 +988,21 @@ def cmd_sort(args):
 
         batch_keys_fn = make_batch_keys_fn(args.order, reader.header,
                                            args.subsort)
-        with ExternalSorter(key_fn, max_bytes=budget, tmp_dir=args.tmp_dir,
-                            max_records=args.max_records_in_ram) as sorter:
-            if batch_keys_fn is not None:
-                # native batch path: decode + key extraction per batch
+        from .sort.external import NativeExternalSorter, create_sorter
+
+        with create_sorter(key_fn, max_bytes=budget, tmp_dir=args.tmp_dir,
+                           max_records=args.max_records_in_ram) as sorter:
+            if isinstance(sorter, NativeExternalSorter) \
+                    and batch_keys_fn is not None:
+                # whole-batch path: native key extraction + two pool memcpys
+                # per batch, native sort/spill/merge
+                from .io.batch_reader import BamBatchReader
+
+                with BamBatchReader(args.input) as br:
+                    for b in br:
+                        sorter.add_record_batch(b, batch_keys_fn)
+                        progress.add(b.n)
+            elif batch_keys_fn is not None:
                 from .sort.keys import iter_keyed_records
 
                 add_entry = sorter.add_entry
@@ -1004,10 +1015,36 @@ def cmd_sort(args):
                     progress.add()
             progress.finish()
             with BamWriter(args.output, out_header) as writer:
-                if bai is None:
+                if bai is None and isinstance(sorter, NativeExternalSorter):
+                    for blob, lens in sorter.sorted_chunks_with_lens():
+                        writer.write_serialized(blob)
+                        wprogress.add(len(lens))
+                elif bai is None:
                     for data in sorter.sorted_records():
                         writer.write_record_bytes(data)
                         wprogress.add()
+                elif isinstance(sorter, NativeExternalSorter):
+                    # indexed blob path: one multi-block write per chunk,
+                    # virtual offsets reconstructed from the block table,
+                    # record geometry decoded natively
+                    import numpy as np
+
+                    from .native import batch as nbat
+
+                    for blob, lens in sorter.sorted_chunks_with_lens():
+                        starts = np.zeros(len(lens) + 1, dtype=np.int64)
+                        np.cumsum(lens, out=starts[1:])
+                        voffs = writer._w.write_indexed(blob, starts)
+                        buf = np.frombuffer(blob, dtype=np.uint8)
+                        f = nbat.decode_fields(buf, starts[:-1])
+                        cigar_off = (f["data_off"] + 32
+                                     + f["l_read_name"].astype(np.int64))
+                        ends = nbat.ref_spans(buf, cigar_off, f["n_cigar"],
+                                              f["pos"])
+                        bai.add_many(
+                            f["ref_id"], f["pos"], ends, voffs[:-1],
+                            voffs[1:], (f["flag"] & FLAG_UNMAPPED) == 0)
+                        wprogress.add(len(lens))
                 else:
                     for data in sorter.sorted_records():
                         rec = RawRecord(data)

@@ -41,6 +41,59 @@ def reg2bins(beg: int, end: int):
     return bins
 
 
+def _reg2bin_vec(beg, end):
+    """Vectorized reg2bin over int64 arrays (identical to the scalar spec
+    loop: smallest containing bin wins)."""
+    import numpy as np
+
+    e = end - 1
+    b = np.zeros(len(beg), dtype=np.int64)
+    unset = np.ones(len(beg), dtype=bool)
+    for shift, off in ((14, 4681), (17, 585), (20, 73), (23, 9), (26, 1)):
+        hit = unset & ((beg >> shift) == (e >> shift))
+        b[hit] = off + (beg[hit] >> shift)
+        unset &= ~hit
+    return b
+
+
+class _ChunkMerger:
+    """Shared vectorized core of BaiBuilder.add_many / CsiBuilder.add_many:
+    groups records by bin, builds coalesced [vo_start, vo_end] chunk lists,
+    and merges them into the per-tid bin dicts (continuing coalescing across
+    calls). Records must arrive in file order (the builders' add contract)."""
+
+    @staticmethod
+    def merge(bins_dict, bins, vo_starts, vo_ends):
+        import numpy as np
+
+        n = len(bins)
+        order = np.lexsort((np.arange(n), bins))  # stable by bin, file order
+        bs = bins[order]
+        vs = vo_starts[order]
+        ve = vo_ends[order]
+        new_chunk = np.ones(n, dtype=bool)
+        new_chunk[1:] = (bs[1:] != bs[:-1]) | (vs[1:] != ve[:-1])
+        starts_idx = np.nonzero(new_chunk)[0]
+        ends_idx = np.append(starts_idx[1:], n) - 1
+        c_bin = bs[starts_idx]
+        c_vs = vs[starts_idx]
+        c_ve = ve[ends_idx]
+        # bin boundaries among the chunk list
+        bin_start = np.ones(len(c_bin), dtype=bool)
+        bin_start[1:] = c_bin[1:] != c_bin[:-1]
+        bin_pos = np.nonzero(bin_start)[0]
+        bin_end = np.append(bin_pos[1:], len(c_bin))
+        for p, q in zip(bin_pos, bin_end):
+            b = int(c_bin[p])
+            chunks = bins_dict.setdefault(b, [])
+            i = int(p)
+            if chunks and chunks[-1][1] == c_vs[i]:
+                chunks[-1][1] = int(c_ve[i])
+                i += 1
+            chunks.extend([int(c_vs[k]), int(c_ve[k])]
+                          for k in range(i, int(q)))
+
+
 class BaiBuilder:
     """Accumulates (tid, beg, end, vo_start, vo_end) of coordinate-ordered
     records and writes the .bai file."""
@@ -73,6 +126,53 @@ class BaiBuilder:
         st[0] = vo_start if st[0] is None else min(st[0], vo_start)
         st[1] = vo_end if st[1] is None else max(st[1], vo_end)
         st[2 if mapped else 3] += 1
+
+    def add_many(self, tids, begs, ends, vo_starts, vo_ends, mapped):
+        """Vectorized add() over coordinate-ordered arrays (identical index
+        output to the per-record loop; the fast BAI path of cmd_sort)."""
+        import numpy as np
+
+        tids = np.asarray(tids, dtype=np.int64)
+        placed = tids >= 0
+        self.n_no_coor += int((~placed).sum())
+        if not placed.any():
+            return
+        t = tids[placed]
+        beg = np.asarray(begs, dtype=np.int64)[placed]
+        end = np.maximum(np.asarray(ends, dtype=np.int64)[placed], beg + 1)
+        vs = np.asarray(vo_starts, dtype=np.int64)[placed]
+        ve = np.asarray(vo_ends, dtype=np.int64)[placed]
+        mp = np.asarray(mapped, dtype=bool)[placed]
+        bins = _reg2bin_vec(beg, end)
+        uniq, first = np.unique(t, return_index=True)
+        bounds = np.append(first, len(t))  # t ascending (coordinate order)
+        for u, lo, hi in zip(uniq, bounds[:-1], bounds[1:]):
+            tid = int(u)
+            sl = slice(int(lo), int(hi))
+            _ChunkMerger.merge(self._bins[tid], bins[sl], vs[sl], ve[sl])
+            # linear index: min vo_start per 16 KiB window
+            win_lo = beg[sl] >> _LINEAR_SHIFT
+            win_hi = (end[sl] - 1) >> _LINEAR_SHIFT
+            dense = np.full(int(win_hi.max()) + 1, np.iinfo(np.int64).max,
+                            dtype=np.int64)
+            np.minimum.at(dense, win_lo, vs[sl])
+            multi = np.nonzero(win_hi > win_lo)[0]
+            for i in multi:  # rare: records spanning >1 window
+                dense[win_lo[i] + 1:win_hi[i] + 1] = np.minimum(
+                    dense[win_lo[i] + 1:win_hi[i] + 1], vs[sl][i])
+            linear = self._linear[tid]
+            for w in np.nonzero(dense != np.iinfo(np.int64).max)[0]:
+                v = int(dense[w])
+                w = int(w)
+                if w not in linear or v < linear[w]:
+                    linear[w] = v
+            st = self._stats[tid]
+            v0, v1 = int(vs[sl].min()), int(ve[sl].max())
+            st[0] = v0 if st[0] is None else min(st[0], v0)
+            st[1] = v1 if st[1] is None else max(st[1], v1)
+            n_mapped = int(mp[sl].sum())
+            st[2] += n_mapped
+            st[3] += int(hi - lo) - n_mapped
 
     def write(self, path: str):
         with open(path, "wb") as f:
@@ -300,6 +400,60 @@ class CsiBuilder:
             if bb == 0:
                 break
             bb = (bb - 1) >> 3
+
+    def add_many(self, tids, begs, ends, vo_starts, vo_ends, mapped=None):
+        """Vectorized add() over coordinate-ordered arrays (same output)."""
+        import numpy as np
+
+        tids = np.asarray(tids, dtype=np.int64)
+        placed = tids >= 0
+        self.n_no_coor += int((~placed).sum())
+        if not placed.any():
+            return
+        t = tids[placed]
+        beg = np.asarray(begs, dtype=np.int64)[placed]
+        end = np.maximum(np.asarray(ends, dtype=np.int64)[placed], beg + 1)
+        vs = np.asarray(vo_starts, dtype=np.int64)[placed]
+        ve = np.asarray(vo_ends, dtype=np.int64)[placed]
+        # generalized reg2bin, vectorized: deepest level whose window
+        # contains [beg, end) wins (reg2bin_ext loop)
+        e = end - 1
+        bins = np.zeros(len(beg), dtype=np.int64)
+        unset = np.ones(len(beg), dtype=bool)
+        s = self.min_shift
+        t_off = ((1 << self.depth * 3) - 1) // 7
+        level = self.depth
+        while level > 0:
+            hit = unset & ((beg >> s) == (e >> s))
+            bins[hit] = t_off + (beg[hit] >> s)
+            unset &= ~hit
+            level -= 1
+            s += 3
+            t_off -= 1 << level * 3
+        uniq, first = np.unique(t, return_index=True)
+        bounds = np.append(first, len(t))
+        for u, lo, hi in zip(uniq, bounds[:-1], bounds[1:]):
+            tid = int(u)
+            sl = slice(int(lo), int(hi))
+            _ChunkMerger.merge(self._bins[tid], bins[sl], vs[sl], ve[sl])
+            loff = self._loff[tid]
+            # per unique bin: groupwise min vo_start, propagated to ancestors
+            order = np.argsort(bins[sl], kind="stable")
+            bsrt = bins[sl][order]
+            vsrt = vs[sl][order]
+            grp = np.ones(len(bsrt), dtype=bool)
+            grp[1:] = bsrt[1:] != bsrt[:-1]
+            mins = np.minimum.reduceat(vsrt, np.nonzero(grp)[0]) \
+                if len(bsrt) else vsrt
+            for b, v in zip(bsrt[grp], mins):
+                bb = int(b)
+                v = int(v)
+                while True:
+                    if bb not in loff or v < loff[bb]:
+                        loff[bb] = v
+                    if bb == 0:
+                        break
+                    bb = (bb - 1) >> 3
 
     def write(self, path: str):
         import gzip

@@ -71,6 +71,25 @@ class BgzfWriter(io.RawIOBase):
 
     def write(self, data) -> int:
         self._buf += data
+        n_full = len(self._buf) // MAX_BLOCK_DATA
+        if n_full == 0:
+            return len(data)
+        if n_full > 1:
+            # multi-block: one native call compresses all complete blocks
+            # (parallel across blocks — the reference's parallel Compress
+            # step, base.rs:1123-1150); identical output bytes to the
+            # block-at-a-time loop below
+            from .. import native
+
+            chunk_len = n_full * MAX_BLOCK_DATA
+            got = native.bgzf_compress_many(
+                memoryview(self._buf)[:chunk_len], self._level)
+            if got is not None:
+                blob, _ = got
+                del self._buf[:chunk_len]
+                self._coffset += len(blob)
+                self._f.write(blob)
+                return len(data)
         while len(self._buf) >= MAX_BLOCK_DATA:
             chunk = bytes(self._buf[:MAX_BLOCK_DATA])
             del self._buf[:MAX_BLOCK_DATA]
@@ -83,6 +102,54 @@ class BgzfWriter(io.RawIOBase):
         """BGZF virtual offset of the next byte to be written:
         (compressed offset of the current block) << 16 | in-block offset."""
         return (self._coffset << 16) | len(self._buf)
+
+    def write_indexed(self, blob, starts):
+        """Write `blob` and return the BGZF virtual offset of each position
+        in `starts` (uncompressed offsets relative to blob, ascending; pass
+        len(blob) as the final entry to get the end offset).
+
+        Equivalent to interleaving tell_virtual() with per-record write()
+        calls, but with one multi-block compression per blob — the offsets
+        are reconstructed from the block-offset table (a record at
+        uncompressed offset u lands in block u // MAX_BLOCK_DATA of this
+        flush, at in-block offset u % MAX_BLOCK_DATA).
+        """
+        import numpy as np
+
+        from .. import native
+
+        base = len(self._buf)
+        self._buf += blob
+        u = np.asarray(starts, dtype=np.int64) + base
+        total = len(self._buf)
+        n_full = total // MAX_BLOCK_DATA
+        chunk_len = n_full * MAX_BLOCK_DATA
+        coff0 = self._coffset
+        if n_full == 0:
+            return (coff0 << 16) | u
+        got = native.bgzf_compress_many(
+            memoryview(self._buf)[:chunk_len], self._level) \
+            if native.get_lib() is not None else None
+        if got is not None:
+            cblob, block_off = got
+            self._f.write(cblob)
+            self._coffset += len(cblob)
+            del self._buf[:chunk_len]
+        else:  # pure-python fallback: per block, recording offsets
+            block_off = np.zeros(n_full + 1, dtype=np.int64)
+            for i in range(n_full):
+                block = compress_block(
+                    bytes(self._buf[i * MAX_BLOCK_DATA:(i + 1)
+                                    * MAX_BLOCK_DATA]), self._level)
+                self._f.write(block)
+                self._coffset += len(block)
+                block_off[i + 1] = block_off[i] + len(block)
+            del self._buf[:chunk_len]
+        in_full = u < chunk_len
+        blk = np.minimum(u // MAX_BLOCK_DATA, n_full - 1)
+        vo_full = ((coff0 + block_off[blk]) << 16) | (u % MAX_BLOCK_DATA)
+        vo_tail = (self._coffset << 16) | np.maximum(u - chunk_len, 0)
+        return np.where(in_full, vo_full, vo_tail)
 
     def flush(self):
         if self._buf:

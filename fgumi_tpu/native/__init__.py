@@ -24,12 +24,12 @@ _lock = threading.Lock()
 _lib = None
 _lib_failed = False
 # must equal fgumi_abi_version() in fgumi_native.cc (stale-.so guard)
-_ABI_VERSION = 5
+_ABI_VERSION = 6
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", _SO_PATH, _SRC_PATH,
-           "-ldeflate"]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", _SO_PATH,
+           _SRC_PATH, "-ldeflate"]
     try:
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired) as e:
@@ -181,6 +181,29 @@ def get_lib():
             [ctypes.c_long, ctypes.c_long] + [p] * 6 + [ctypes.c_long]
             + [p] * 3 + [ctypes.c_int, p, ctypes.c_int, ctypes.c_int, p,
                          ctypes.c_long, p])
+        lib.fgumi_ref_spans.restype = None
+        lib.fgumi_ref_spans.argtypes = [p, p, p, p, ctypes.c_long, p]
+        lib.fgumi_bgzf_compress_many.restype = ctypes.c_long
+        lib.fgumi_bgzf_compress_many.argtypes = [
+            p, ctypes.c_long, ctypes.c_int, ctypes.c_int, p, ctypes.c_long,
+            ctypes.c_long, p, ctypes.POINTER(ctypes.c_long)]
+        lib.fgumi_sort_spans.restype = None
+        lib.fgumi_sort_spans.argtypes = [p, p, p, ctypes.c_long, p]
+        lib.fgumi_gather_spans.restype = ctypes.c_long
+        lib.fgumi_gather_spans.argtypes = [p, p, p, p, ctypes.c_long, p]
+        lib.fgumi_write_run.restype = ctypes.c_long
+        lib.fgumi_write_run.argtypes = (
+            [ctypes.c_char_p] + [p] * 7 + [ctypes.c_long, ctypes.c_long,
+                                           ctypes.c_int])
+        lib.fgumi_merge_open.restype = ctypes.c_void_p
+        lib.fgumi_merge_open.argtypes = [ctypes.c_char_p, ctypes.c_long,
+                                         ctypes.c_long]
+        lib.fgumi_merge_next.restype = ctypes.c_long
+        lib.fgumi_merge_next.argtypes = [
+            ctypes.c_void_p, p, ctypes.c_long, p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long)]
+        lib.fgumi_merge_close.restype = None
+        lib.fgumi_merge_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         log.debug("native library loaded from %s", _SO_PATH)
         return _lib
@@ -239,6 +262,49 @@ def zlib_decompress(data: bytes, out_size: int):
     if n < 0:
         raise ValueError("malformed zlib frame")
     return out.raw[:n]
+
+
+_COMPRESS_THREADS = None
+
+
+def compress_threads() -> int:
+    """Worker threads for multi-block BGZF compression. Default: min(4,
+    cpus//2) — enough to keep the writer off the critical path without
+    oversubscribing XLA's pool; override with FGUMI_TPU_COMPRESS_THREADS."""
+    global _COMPRESS_THREADS
+    if _COMPRESS_THREADS is None:
+        env = os.environ.get("FGUMI_TPU_COMPRESS_THREADS", "")
+        if env.isdigit():
+            _COMPRESS_THREADS = max(int(env), 1)
+        else:
+            _COMPRESS_THREADS = max(min(4, (os.cpu_count() or 2) // 2), 1)
+    return _COMPRESS_THREADS
+
+
+def bgzf_compress_many(data, level: int = 1, threads: int = None):
+    """Compress `data` into consecutive complete BGZF blocks (one native
+    call, optionally multi-threaded). Returns the block stream bytes and the
+    (n_blocks+1,) int64 block-offset table, or None (fallback)."""
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    if threads is None:
+        threads = compress_threads()
+    data = bytes(data)
+    n = len(data)
+    n_blocks = (n + 0xFEFF) // 0xFF00
+    bound = 0xFF00 + (0xFF00 >> 2) + 64  # >= deflate bound + BGZF framing
+    out = np.empty(max(n_blocks, 1) * bound, dtype=np.uint8)
+    block_off = np.empty(n_blocks + 1, dtype=np.int64)
+    n_out = ctypes.c_long(0)
+    total = lib.fgumi_bgzf_compress_many(
+        data, n, level, threads, out.ctypes.data, len(out), bound,
+        block_off.ctypes.data, ctypes.byref(n_out))
+    if total < 0:
+        raise ValueError("BGZF multi-block compression failed")
+    return out[:total].tobytes(), block_off
 
 
 def bgzf_compress_block(data: bytes, level: int = 1):

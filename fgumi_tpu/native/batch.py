@@ -601,3 +601,16 @@ def build_codec_records(seq_addr, qual_addr, cons_err_addr,
     if total < 0:
         raise RuntimeError("codec record serialization overflow")
     return out[:total].tobytes(), rec_end
+
+
+def ref_spans(buf: np.ndarray, cigar_off, n_cigar, pos):
+    """Per-record reference-span end (pos + ref-consumed CIGAR length, min 1)."""
+    lib = get_lib()
+    n = len(pos)
+    out = np.empty(n, dtype=np.int32)
+    co = np.ascontiguousarray(cigar_off, np.int64)
+    nc = np.ascontiguousarray(n_cigar, np.int32)
+    ps = np.ascontiguousarray(pos, np.int32)
+    lib.fgumi_ref_spans(_addr(buf), _addr(co), _addr(nc), _addr(ps), n,
+                        _addr(out))
+    return out

@@ -8,8 +8,12 @@
 
 #include <libdeflate.h>
 
+#include <algorithm>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
+#include <string>
+#include <thread>
 #include <utility>
 #include <vector>
 
@@ -83,7 +87,7 @@ extern "C" {
 // ABI version for the stale-.so guard in __init__.py: bump whenever any
 // exported signature changes (a symbol probe alone cannot detect an
 // argument-list change in an existing function).
-long fgumi_abi_version() { return 5; }
+long fgumi_abi_version() { return 6; }
 
 // Decompress as many complete BGZF blocks from src as fit in dst.
 // Returns bytes produced; sets *consumed to the input bytes consumed (whole
@@ -2143,6 +2147,371 @@ long fgumi_extract_records(
   }
   state[0] = off;
   return n_records;
+}
+
+// Reference-span end (pos + reference-consumed CIGAR length, min 1) per
+// record — the BAI builder's per-record geometry without RawRecord
+// round-trips (reference_length semantics of sort.rs BAI output).
+void fgumi_ref_spans(const uint8_t* buf, const int64_t* cigar_off,
+                     const int32_t* n_cigar, const int32_t* pos, long n,
+                     int32_t* end_out) {
+  for (long i = 0; i < n; ++i) {
+    const uint8_t* c = buf + cigar_off[i];
+    int64_t ref_len = 0;
+    for (int32_t k = 0; k < n_cigar[i]; ++k) {
+      const uint32_t v = read_u32(c + 4 * k);
+      const uint32_t op = v & 0xF;
+      // M, D, N, =, X consume reference
+      if (op == 0 || op == 2 || op == 3 || op == 7 || op == 8) {
+        ref_len += v >> 4;
+      }
+    }
+    if (ref_len < 1) ref_len = 1;
+    end_out[i] = pos[i] + static_cast<int32_t>(ref_len);
+  }
+}
+
+// Compress src into consecutive complete BGZF blocks (0xFF00-byte payloads,
+// reference InlineBgzfCompressor + the workers' parallel Compress step,
+// base.rs:1123-1150). Blocks are independent, so n_threads > 1 compresses
+// them in parallel into per-block bound-sized slots, then compacts. Returns
+// total bytes written to dst; block_off receives n_blocks+1 offsets.
+// dst must hold n_blocks * (compress_bound(0xFF00) + 26).
+long fgumi_bgzf_compress_many(const uint8_t* src, long src_len, int level,
+                              int n_threads, uint8_t* dst, long dst_cap,
+                              long slot_bound, int64_t* block_off,
+                              long* n_blocks_out) {
+  constexpr long kBlock = 0xFF00;
+  const long nb = (src_len + kBlock - 1) / kBlock;
+  *n_blocks_out = nb;
+  if (nb == 0) {
+    block_off[0] = 0;
+    return 0;
+  }
+  const long bound = slot_bound;  // caller-allocated per-block slot spacing
+  if (bound < static_cast<long>(libdeflate_deflate_compress_bound(
+                  nullptr, kBlock)) + 26 ||
+      dst_cap < nb * bound) {
+    return -2;
+  }
+  std::vector<long> sizes(static_cast<size_t>(nb), -1);
+  auto work = [&](long t, long stride) {
+    for (long i = t; i < nb; i += stride) {
+      const long off = i * kBlock;
+      const long len = src_len - off < kBlock ? src_len - off : kBlock;
+      sizes[static_cast<size_t>(i)] = fgumi_bgzf_compress_block(
+          src + off, len, level, dst + i * bound, bound);
+    }
+  };
+  long threads = n_threads < 1 ? 1 : n_threads;
+  if (threads > nb) threads = nb;
+  if (threads <= 1) {
+    work(0, 1);
+  } else {
+    std::vector<std::thread> pool;
+    pool.reserve(static_cast<size_t>(threads));
+    for (long t = 0; t < threads; ++t) pool.emplace_back(work, t, threads);
+    for (auto& th : pool) th.join();
+  }
+  // compact the bound-spaced slots into a contiguous stream
+  long o = 0;
+  block_off[0] = 0;
+  for (long i = 0; i < nb; ++i) {
+    const long s = sizes[static_cast<size_t>(i)];
+    if (s < 0) return -1;
+    if (o != i * bound) memmove(dst + o, dst + i * bound,
+                                static_cast<size_t>(s));
+    o += s;
+    block_off[i + 1] = o;
+  }
+  return o;
+}
+
+// --------------------------------------------------------------- sort engine
+//
+// Native internals of the external merge sort (reference:
+// crates/fgumi-sort/src/radix.rs:35 MSD/LSD radix over packed keys,
+// loser_tree.rs:34 k-way merge, codec.rs:7-8 spill codec). Keys here are the
+// memcmp-ordered packed byte strings of fgumi_tpu/sort/keys.py; records are
+// BAM wire bytes (block_size-prefixed). The Python layer holds contiguous
+// key/record pools + span tables and calls:
+//   fgumi_sort_spans  — argsort spans by (memcmp, ingest order)
+//   fgumi_gather_spans — permute spans into one output blob
+//   fgumi_write_run   — serialize a sorted run to disk (framed, deflate-1)
+//   fgumi_merge_open/next/close — streaming k-way merge of runs
+
+// argsort of n byte spans by (memcmp, index). A precomputed 8-byte
+// big-endian prefix settles most comparisons in one u64 compare (the packed
+// keys front-load tid/pos exactly so this works — keys.py's analog of the
+// reference packing sort keys into fixed-width integers, keys.rs).
+void fgumi_sort_spans(const uint8_t* keys, const int64_t* off,
+                      const int32_t* len, long n, int64_t* perm) {
+  std::vector<uint64_t> pfx(static_cast<size_t>(n));
+  for (long i = 0; i < n; ++i) {
+    const uint8_t* p = keys + off[i];
+    const int l = len[i] < 8 ? len[i] : 8;
+    uint64_t v = 0;
+    for (int j = 0; j < l; ++j) v |= static_cast<uint64_t>(p[j]) << (56 - 8 * j);
+    pfx[static_cast<size_t>(i)] = v;
+  }
+  for (long i = 0; i < n; ++i) perm[i] = i;
+  std::sort(perm, perm + n, [&](int64_t a, int64_t b) {
+    const uint64_t pa = pfx[static_cast<size_t>(a)];
+    const uint64_t pb = pfx[static_cast<size_t>(b)];
+    if (pa != pb) return pa < pb;
+    const int32_t la = len[a], lb = len[b];
+    if (la > 8 || lb > 8) {
+      const int32_t l = la < lb ? la : lb;
+      // first 8 bytes already known equal when both spans reach 8
+      const int32_t skip = (la >= 8 && lb >= 8) ? 8 : 0;
+      const int c = memcmp(keys + off[a] + skip, keys + off[b] + skip,
+                           static_cast<size_t>(l - skip));
+      if (c != 0) return c < 0;
+      if (la != lb) return la < lb;
+    }
+    return a < b;  // ingest-order tiebreak makes the sort total (radix.rs:35)
+  });
+}
+
+// Concatenate spans in permutation order into out (caller sizes out to
+// sum(len)). Returns bytes written.
+long fgumi_gather_spans(const uint8_t* src, const int64_t* off,
+                        const int32_t* len, const int64_t* perm, long n,
+                        uint8_t* out) {
+  long o = 0;
+  for (long i = 0; i < n; ++i) {
+    const int64_t j = perm[i];
+    memcpy(out + o, src + off[j], static_cast<size_t>(len[j]));
+    o += len[j];
+  }
+  return o;
+}
+
+namespace {
+
+// Spill-run entry header: [u16 klen][u32 rlen] then key bytes, record wire
+// bytes. Frame header: [u32 compressed][u32 uncompressed]; zlib container
+// (matches fgumi_zlib_* and the Python fallback codec).
+constexpr long kRunEntryHeader = 6;
+
+bool write_frame(FILE* f, const uint8_t* buf, long n, int level,
+                 std::vector<uint8_t>* scratch) {
+  const size_t bound = libdeflate_zlib_compress_bound(
+      compressor(level), static_cast<size_t>(n));
+  if (scratch->size() < bound) scratch->resize(bound);
+  const size_t c = libdeflate_zlib_compress(compressor(level), buf,
+                                            static_cast<size_t>(n),
+                                            scratch->data(), bound);
+  if (c == 0) return false;
+  uint8_t hdr[8];
+  hdr[0] = c & 0xFF; hdr[1] = (c >> 8) & 0xFF;
+  hdr[2] = (c >> 16) & 0xFF; hdr[3] = (c >> 24) & 0xFF;
+  hdr[4] = n & 0xFF; hdr[5] = (n >> 8) & 0xFF;
+  hdr[6] = (n >> 16) & 0xFF; hdr[7] = (n >> 24) & 0xFF;
+  return fwrite(hdr, 1, 8, f) == 8 &&
+         fwrite(scratch->data(), 1, c, f) == c;
+}
+
+}  // namespace
+
+// Write one sorted spill run: entries in perm order, framed and compressed.
+// Returns 0 on success, -1 on I/O or compression failure.
+long fgumi_write_run(const uint8_t* path, const uint8_t* keys,
+                     const int64_t* koff, const int32_t* klen,
+                     const uint8_t* recs, const int64_t* roff,
+                     const int32_t* rlen, const int64_t* perm, long n,
+                     long frame_bytes, int level) {
+  FILE* f = fopen(reinterpret_cast<const char*>(path), "wb");
+  if (f == nullptr) return -1;
+  std::vector<uint8_t> frame;
+  std::vector<uint8_t> scratch;
+  frame.reserve(static_cast<size_t>(frame_bytes) + (64 << 10));
+  bool ok = true;
+  for (long i = 0; i < n && ok; ++i) {
+    const int64_t j = perm[i];
+    const uint32_t kl = static_cast<uint32_t>(klen[j]);
+    const uint32_t rl = static_cast<uint32_t>(rlen[j]);
+    uint8_t hdr[kRunEntryHeader];
+    hdr[0] = kl & 0xFF; hdr[1] = (kl >> 8) & 0xFF;
+    hdr[2] = rl & 0xFF; hdr[3] = (rl >> 8) & 0xFF;
+    hdr[4] = (rl >> 16) & 0xFF; hdr[5] = (rl >> 24) & 0xFF;
+    frame.insert(frame.end(), hdr, hdr + kRunEntryHeader);
+    frame.insert(frame.end(), keys + koff[j], keys + koff[j] + kl);
+    frame.insert(frame.end(), recs + roff[j], recs + roff[j] + rl);
+    if (static_cast<long>(frame.size()) >= frame_bytes) {
+      ok = write_frame(f, frame.data(), static_cast<long>(frame.size()),
+                       level, &scratch);
+      frame.clear();
+    }
+  }
+  if (ok && !frame.empty()) {
+    ok = write_frame(f, frame.data(), static_cast<long>(frame.size()), level,
+                     &scratch);
+  }
+  if (fclose(f) != 0) ok = false;
+  return ok ? 0 : -1;
+}
+
+namespace {
+
+// One spill run being merged: streams frames, exposes the current entry.
+struct RunReader {
+  FILE* f = nullptr;
+  std::vector<uint8_t> frame;
+  size_t pos = 0;
+  bool eof = false;
+  const uint8_t* key = nullptr;
+  uint32_t klen = 0;
+  const uint8_t* rec = nullptr;
+  uint32_t rlen = 0;
+
+  bool load_frame() {
+    uint8_t hdr[8];
+    if (fread(hdr, 1, 8, f) != 8) {
+      eof = true;
+      return true;  // clean EOF
+    }
+    const uint32_t c = read_u32(hdr);
+    const uint32_t u = read_u32(hdr + 4);
+    std::vector<uint8_t> comp(c);
+    if (fread(comp.data(), 1, c, f) != c) return false;
+    frame.resize(u);
+    size_t actual = 0;
+    const libdeflate_result r = libdeflate_zlib_decompress(
+        decompressor(), comp.data(), c, frame.data(), u, &actual);
+    if (r != LIBDEFLATE_SUCCESS || actual != u) return false;
+    pos = 0;
+    return true;
+  }
+
+  // Advance to the next entry; false on corrupt input (eof flag on clean end).
+  bool next() {
+    if (pos >= frame.size()) {
+      if (!load_frame()) return false;
+      if (eof) return true;
+    }
+    if (pos + kRunEntryHeader > frame.size()) return false;
+    const uint8_t* p = frame.data() + pos;
+    klen = read_u16(p);
+    rlen = read_u32(p + 2);
+    pos += kRunEntryHeader;
+    if (pos + klen + rlen > frame.size()) return false;
+    key = frame.data() + pos;
+    rec = frame.data() + pos + klen;
+    pos += klen + rlen;
+    return true;
+  }
+};
+
+struct MergeState {
+  std::vector<RunReader> runs;
+  std::vector<int> heap;  // indices into runs, min-heap by (key, run index)
+
+  // (key, run index) — runs are ingest-ordered chunks, so the run-index
+  // tiebreak reproduces the global ingest-ordinal total order the Python
+  // sorter used (external.py sorted_records)
+  bool less(int a, int b) const {
+    const RunReader& ra = runs[a];
+    const RunReader& rb = runs[b];
+    const uint32_t l = ra.klen < rb.klen ? ra.klen : rb.klen;
+    const int c = memcmp(ra.key, rb.key, l);
+    if (c != 0) return c < 0;
+    if (ra.klen != rb.klen) return ra.klen < rb.klen;
+    return a < b;
+  }
+
+  void sift_down(size_t i) {
+    const size_t n = heap.size();
+    while (true) {
+      size_t best = i;
+      const size_t l = 2 * i + 1, r = 2 * i + 2;
+      if (l < n && less(heap[l], heap[best])) best = l;
+      if (r < n && less(heap[r], heap[best])) best = r;
+      if (best == i) return;
+      std::swap(heap[i], heap[best]);
+      i = best;
+    }
+  }
+
+  void sift_up(size_t i) {
+    while (i > 0) {
+      const size_t p = (i - 1) / 2;
+      if (!less(heap[i], heap[p])) return;
+      std::swap(heap[i], heap[p]);
+      i = p;
+    }
+  }
+};
+
+}  // namespace
+
+void fgumi_merge_close(void* handle);  // forward (used on open failure)
+
+// Open a k-way merge over '\n'-joined run paths. Returns nullptr on failure.
+void* fgumi_merge_open(const uint8_t* paths, long paths_len, long n_runs) {
+  MergeState* st = new MergeState();
+  st->runs.resize(static_cast<size_t>(n_runs));
+  long start = 0;
+  long run = 0;
+  for (long i = 0; i <= paths_len && run < n_runs; ++i) {
+    if (i == paths_len || paths[i] == '\n') {
+      std::string path(reinterpret_cast<const char*>(paths + start),
+                       static_cast<size_t>(i - start));
+      st->runs[static_cast<size_t>(run)].f = fopen(path.c_str(), "rb");
+      if (st->runs[static_cast<size_t>(run)].f == nullptr) {
+        fgumi_merge_close(st);
+        return nullptr;
+      }
+      ++run;
+      start = i + 1;
+    }
+  }
+  for (int i = 0; i < static_cast<int>(st->runs.size()); ++i) {
+    RunReader& r = st->runs[static_cast<size_t>(i)];
+    if (!r.next()) {
+      fgumi_merge_close(st);
+      return nullptr;
+    }
+    if (!r.eof) {
+      st->heap.push_back(i);
+      st->sift_up(st->heap.size() - 1);
+    }
+  }
+  return st;
+}
+
+// Emit merged records (wire bytes, concatenated) into out, up to cap bytes
+// or max_recs records; per-record wire lengths land in rec_lens. Returns
+// bytes written (0 = merge complete), -1 on corrupt input.
+long fgumi_merge_next(void* handle, uint8_t* out, long cap, int32_t* rec_lens,
+                      long max_recs, long* n_recs) {
+  MergeState* st = static_cast<MergeState*>(handle);
+  long o = 0;
+  long emitted = 0;
+  while (!st->heap.empty() && emitted < max_recs) {
+    const int top = st->heap[0];
+    RunReader& r = st->runs[static_cast<size_t>(top)];
+    if (o + static_cast<long>(r.rlen) > cap) break;
+    memcpy(out + o, r.rec, r.rlen);
+    o += r.rlen;
+    rec_lens[emitted++] = static_cast<int32_t>(r.rlen);
+    if (!r.next()) return -1;
+    if (r.eof) {
+      st->heap[0] = st->heap.back();
+      st->heap.pop_back();
+    }
+    if (!st->heap.empty()) st->sift_down(0);
+  }
+  *n_recs = emitted;
+  return o;
+}
+
+void fgumi_merge_close(void* handle) {
+  MergeState* st = static_cast<MergeState*>(handle);
+  for (RunReader& r : st->runs) {
+    if (r.f != nullptr) fclose(r.f);
+  }
+  delete st;
 }
 
 }  // extern "C"

@@ -326,3 +326,272 @@ def merge_sorted(readers, key_fn):
     )
     for _, _, data in heapq.merge(*streams):
         yield data
+
+
+class NativeExternalSorter:
+    """ExternalSorter with native phase internals (VERDICT r2 item 4).
+
+    Same external contract as ExternalSorter, but records/keys accumulate in
+    two contiguous byte pools with span tables and the hot phases run in C++
+    (fgumi_native.cc sort engine): argsort by (memcmp, ingest order) over
+    spans, permutation gather, framed deflate-1 spill runs written natively,
+    and a heap k-way merge streaming wire chunks back (the analog of
+    radix_sort_record_refs + LoserTree, fgumi-sort/src/inline.rs:1642,
+    loser_tree.rs:34). Records are stored block_size-prefixed (BAM wire
+    form), so sorted output can go straight to BamWriter.write_serialized.
+
+    `add_batch` appends a whole RecordBatch in two memcpys; `add_entry`
+    remains for per-record callers. sorted_records() yields per-record bytes
+    (prefix stripped) for compatibility; sorted_wire_chunks() yields large
+    concatenated wire blobs and per-record lengths.
+    """
+
+    _GATHER_CHUNK = 8 << 20  # target bytes per emitted wire blob
+
+    def __init__(self, key_fn, max_bytes: int = 256 << 20, tmp_dir=None,
+                 max_records: int = None):
+        import numpy as np
+
+        from ..native import get_lib
+
+        self._np = np
+        self._lib = get_lib()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self.key_fn = key_fn
+        self.max_bytes = max_bytes
+        self.max_records = max_records
+        self._tmp_dir_arg = tmp_dir
+        self._tmp_dir = None
+        self._own_tmp_dir = False
+        self._reset_pools()
+        self._run_paths = []
+        self.n_records = 0
+
+    def _reset_pools(self):
+        self._keys = bytearray()
+        self._recs = bytearray()
+        # span chunks: (koff i64, klen i32, roff i64, rlen i32) absolute
+        self._chunks = []
+        self._ent_keys = []  # pending per-record add_entry spans
+        self._chunk_records = 0
+        self._chunk_bytes = 0
+
+    # ------------------------------------------------------------------ add
+
+    def add(self, rec: RawRecord):
+        self.add_entry(self.key_fn(rec), rec.data)
+
+    def add_entry(self, key: bytes, data: bytes):
+        ko = len(self._keys)
+        self._keys += key
+        ro = len(self._recs)
+        self._recs += struct.pack("<I", len(data))
+        self._recs += data
+        self._ent_keys.append((ko, len(key), ro, 4 + len(data)))
+        self._after_add(1, len(key) + len(data) + 36)
+
+    def add_batch(self, keys_blob, key_off, key_len, wire, rec_off, rec_len):
+        """Append a whole batch: key spans from make_batch_keys_fn (blob +
+        off/len tables), `wire` the contiguous block_size-prefixed record
+        region, rec_off/rec_len spans relative to `wire`."""
+        np = self._np
+        base_k = len(self._keys)
+        self._keys += keys_blob
+        base_r = len(self._recs)
+        # memoryview: numpy slices append through the buffer protocol (a
+        # plain += would dispatch to ndarray broadcasting)
+        self._recs += memoryview(wire)
+        koff = key_off.astype(np.int64) + base_k
+        klen = np.asarray(key_len, dtype=np.int32)
+        roff = rec_off.astype(np.int64) + base_r
+        rlen = np.asarray(rec_len, dtype=np.int32)
+        self._chunks.append((koff, klen, roff, rlen))
+        n = len(klen)
+        self._after_add(n, len(keys_blob) + len(wire) + 32 * n)
+
+    def add_record_batch(self, batch, batch_keys_fn):
+        """Append one decoded RecordBatch: native key extraction + two pool
+        memcpys (the whole-batch fast path for cmd_sort)."""
+        if batch.n == 0:
+            return
+        blob, koff, klen = batch_keys_fn(batch)
+        base = int(batch.rec_off[0])
+        wire = batch.buf[base:int(batch.data_end[-1])]
+        self.add_batch(blob, koff, klen, wire,
+                       batch.rec_off - base,
+                       (batch.data_end - batch.rec_off))
+
+    def _after_add(self, n: int, nbytes: int):
+        self.n_records += n
+        self._chunk_records += n
+        self._chunk_bytes += nbytes
+        if self._chunk_bytes >= self.max_bytes or (
+                self.max_records is not None
+                and self._chunk_records >= self.max_records):
+            self._spill()
+
+    # ---------------------------------------------------------------- phases
+
+    def _spans(self):
+        """Concatenated span arrays for the current pools."""
+        np = self._np
+        chunks = list(self._chunks)
+        if self._ent_keys:
+            arr = np.asarray(self._ent_keys, dtype=np.int64)
+            chunks.append((arr[:, 0], arr[:, 1].astype(np.int32),
+                           arr[:, 2], arr[:, 3].astype(np.int32)))
+        if not chunks:
+            z64 = np.zeros(0, np.int64)
+            z32 = np.zeros(0, np.int32)
+            return z64, z32, z64, z32
+        koff = np.ascontiguousarray(np.concatenate([c[0] for c in chunks]))
+        klen = np.ascontiguousarray(np.concatenate([c[1] for c in chunks]))
+        roff = np.ascontiguousarray(np.concatenate([c[2] for c in chunks]))
+        rlen = np.ascontiguousarray(np.concatenate([c[3] for c in chunks]))
+        return koff, klen, roff, rlen
+
+    def _sort_perm(self, koff, klen):
+        np = self._np
+        n = len(klen)
+        perm = np.empty(n, dtype=np.int64)
+        keys = np.frombuffer(self._keys, dtype=np.uint8)
+        self._lib.fgumi_sort_spans(keys.ctypes.data, koff.ctypes.data,
+                                   klen.ctypes.data, n, perm.ctypes.data)
+        return perm
+
+    def _ensure_tmp_dir(self):
+        if self._tmp_dir is None:
+            if self._tmp_dir_arg is not None:
+                self._tmp_dir = self._tmp_dir_arg
+            else:
+                self._tmp_dir = tempfile.mkdtemp(prefix="fgumi_sort_")
+                self._own_tmp_dir = True
+
+    def _spill(self):
+        if self._chunk_records == 0:
+            return
+        self._ensure_tmp_dir()
+        koff, klen, roff, rlen = self._spans()
+        perm = self._sort_perm(koff, klen)
+        fd, path = tempfile.mkstemp(dir=self._tmp_dir, suffix=".run")
+        os.close(fd)
+        np = self._np
+        keys = np.frombuffer(self._keys, dtype=np.uint8)
+        recs = np.frombuffer(self._recs, dtype=np.uint8)
+        rc = self._lib.fgumi_write_run(
+            path.encode(), keys.ctypes.data, koff.ctypes.data,
+            klen.ctypes.data, recs.ctypes.data, roff.ctypes.data,
+            rlen.ctypes.data, perm.ctypes.data, len(perm), _FRAME_BYTES, 1)
+        if rc != 0:
+            raise OSError(f"native spill write failed: {path}")
+        self._run_paths.append(path)
+        self._reset_pools()
+
+    def _chunked(self, with_lens):
+        """Yield sorted output as (wire blob, rec_lens|None) chunks."""
+        np = self._np
+        if not self._run_paths:
+            koff, klen, roff, rlen = self._spans()
+            perm = self._sort_perm(koff, klen)
+            recs = np.frombuffer(self._recs, dtype=np.uint8)
+            lens_sorted = rlen[perm]
+            n = len(perm)
+            # chunk boundaries in one vectorized pass: first index where the
+            # cumulative size clears each successive _GATHER_CHUNK multiple
+            csum = np.cumsum(lens_sorted, dtype=np.int64)
+            total_bytes = int(csum[-1]) if n else 0
+            targets = np.arange(self._GATHER_CHUNK, total_bytes,
+                                self._GATHER_CHUNK, dtype=np.int64)
+            bounds = np.concatenate((
+                [0], np.searchsorted(csum, targets, side="left") + 1, [n]))
+            bounds = np.unique(bounds)
+            for i, j in zip(bounds[:-1], bounds[1:]):
+                i, j = int(i), int(j)
+                out = np.empty(int(csum[j - 1] - (csum[i - 1] if i else 0)),
+                               dtype=np.uint8)
+                self._lib.fgumi_gather_spans(
+                    recs.ctypes.data, roff.ctypes.data, rlen.ctypes.data,
+                    perm[i:j].ctypes.data, j - i, out.ctypes.data)
+                yield out.tobytes(), (lens_sorted[i:j] if with_lens else None)
+            self._reset_pools()
+            return
+        self._spill()
+        import ctypes as ct
+
+        paths = b"\n".join(p.encode() for p in self._run_paths)
+        h = self._lib.fgumi_merge_open(paths, len(paths),
+                                       len(self._run_paths))
+        if not h:
+            raise OSError("native merge open failed")
+        try:
+            cap = self._GATHER_CHUNK
+            max_recs = max(cap // 64, 1024)
+            out = np.empty(cap, dtype=np.uint8)
+            lens = np.empty(max_recs, dtype=np.int32)
+            n_out = ct.c_long(0)
+            while True:
+                n_bytes = self._lib.fgumi_merge_next(
+                    h, out.ctypes.data, cap, lens.ctypes.data, max_recs,
+                    ct.byref(n_out))
+                if n_bytes < 0:
+                    raise OSError("corrupt spill run during merge")
+                if n_bytes == 0:
+                    break
+                yield (out[:n_bytes].tobytes(),
+                       (lens[:n_out.value].copy() if with_lens else None))
+        finally:
+            self._lib.fgumi_merge_close(h)
+
+    def sorted_wire_chunks(self):
+        """Yield large blobs of block_size-prefixed records in sorted order
+        (feed straight to BamWriter.write_serialized)."""
+        for blob, _ in self._chunked(with_lens=False):
+            yield blob
+
+    def sorted_chunks_with_lens(self):
+        """(wire blob, int32 per-record wire lengths) chunks in sorted order
+        (the BAI path needs record boundaries for virtual offsets)."""
+        return self._chunked(with_lens=True)
+
+    def sorted_records(self):
+        """Per-record bytes (no block_size prefix) in sorted order."""
+        for blob, lens in self._chunked(with_lens=True):
+            off = 0
+            for ln in lens:
+                yield blob[off + 4:off + int(ln)]
+                off += int(ln)
+
+    def close(self):
+        for path in self._run_paths:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        self._run_paths = []
+        if self._own_tmp_dir and self._tmp_dir is not None:
+            try:
+                os.rmdir(self._tmp_dir)
+            except OSError:
+                pass
+            self._tmp_dir = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def create_sorter(key_fn, max_bytes: int = 256 << 20, tmp_dir=None,
+                  max_records: int = None):
+    """NativeExternalSorter when the native library is available, else the
+    pure-Python ExternalSorter (identical output contract; tested against
+    each other in tests/test_sort_v2.py)."""
+    from ..native import get_lib
+
+    if get_lib() is not None:
+        return NativeExternalSorter(key_fn, max_bytes=max_bytes,
+                                    tmp_dir=tmp_dir, max_records=max_records)
+    return ExternalSorter(key_fn, max_bytes=max_bytes, tmp_dir=tmp_dir,
+                          max_records=max_records)

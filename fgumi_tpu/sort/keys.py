@@ -124,12 +124,16 @@ def template_coordinate_key_bytes(rec: RawRecord, library_ord: int,
 
 
 def make_batch_keys_fn(order: str, header, subsort: str = "natural"):
-    """Whole-RecordBatch packed-key extraction: fn(batch) -> list[bytes].
+    """Whole-RecordBatch packed-key extraction: fn(batch) -> (blob, off, len).
 
     The native analog of make_key_bytes_fn: key semantics are identical
     byte-for-byte (tested in tests/test_sort_v2.py), but extraction runs one
-    native pass per batch instead of Python per record. Returns None when the
-    native layer is unavailable (callers fall back to the per-record path).
+    native pass per batch instead of Python per record, and the keys stay in
+    one blob with int64 offset / int32 length span tables — record i's key
+    is blob[off[i]:off[i]+len[i]] (spans may carry allocation gaps) — so the
+    native sorter ingests them without materializing per-record bytes
+    objects. Returns None when the native layer is unavailable (callers
+    fall back to the per-record path).
     """
     import numpy as np
 
@@ -145,8 +149,8 @@ def make_batch_keys_fn(order: str, header, subsort: str = "natural"):
             tid = batch.ref_id.astype(np.int64)
             arr[:, 0] = np.where(tid < 0, _TID_UNMAPPED, tid)
             arr[:, 1] = batch.pos.astype(np.int64) + 1
-            blob = arr.tobytes()
-            return [blob[8 * i:8 * i + 8] for i in range(batch.n)]
+            off = np.arange(batch.n, dtype=np.int64) * 8
+            return arr.tobytes(), off, np.full(batch.n, 8, dtype=np.int32)
 
         return coord_keys
 
@@ -157,18 +161,20 @@ def make_batch_keys_fn(order: str, header, subsort: str = "natural"):
                 buf = batch.buf
                 name_off = batch.data_off + 32
                 name_len = batch.l_read_name - 1
-                return [
+                parts = [
                     buf[name_off[i]:name_off[i] + name_len[i]].tobytes()
                     + b"\x00" + _rank_bytes(int(batch.flag[i]))
                     for i in range(batch.n)]
+                lens = np.array([len(p) for p in parts], dtype=np.int32)
+                off = np.zeros(batch.n, dtype=np.int64)
+                np.cumsum(lens[:-1], out=off[1:])
+                return b"".join(parts), off, lens
 
             return lex_keys
 
         def natural_keys(batch):
             out, out_off, out_len = nb.natural_name_keys(batch)
-            blob = out.tobytes()
-            return [blob[out_off[i]:out_off[i] + out_len[i]]
-                    for i in range(batch.n)]
+            return out.tobytes(), out_off, out_len
 
         return natural_keys
 
@@ -210,8 +216,8 @@ def make_batch_keys_fn(order: str, header, subsort: str = "natural"):
                             .tobytes().decode(errors="replace")
                         lib_ord[i] = ctx._rg_to_ord.get(rg, unknown_ord)
             out, out_off = nb.template_coord_keys(batch, lib_ord)
-            blob = out.tobytes()
-            return [blob[out_off[i]:out_off[i + 1]] for i in range(batch.n)]
+            return (out.tobytes(), out_off[:-1],
+                    np.diff(out_off).astype(np.int32))
 
         return tc_keys
 
@@ -221,20 +227,21 @@ def make_batch_keys_fn(order: str, header, subsort: str = "natural"):
 def iter_keyed_records(path_or_obj, batch_keys_fn, on_batch=None):
     """(packed key bytes, record wire bytes) per record, batch-extracted.
 
-    The shared consumer loop for sort accumulation and k-way merge;
-    `on_batch(n)` fires once per decoded batch (progress reporting).
+    The per-record consumer loop for the k-way merge and the pure-Python
+    sorter fallback; `on_batch(n)` fires once per decoded batch (progress
+    reporting). The native sorter bypasses this via add_record_batch.
     """
     from ..io.batch_reader import BamBatchReader
 
     with BamBatchReader(path_or_obj) as br:
         for batch in br:
-            keys = batch_keys_fn(batch)
+            blob, koff, klen = batch_keys_fn(batch)
             buf = batch.buf
             do, de = batch.data_off, batch.data_end
             if on_batch is not None:
                 on_batch(batch.n)
             for i in range(batch.n):
-                yield keys[i], bytes(buf[do[i]:de[i]])
+                yield blob[koff[i]:koff[i] + klen[i]], bytes(buf[do[i]:de[i]])
 
 
 def make_key_bytes_fn(order: str, header, subsort: str = "natural"):

@@ -313,5 +313,170 @@ def test_batch_keys_adversarial(tmp_path):
             fn = make_batch_keys_fn(order, br.header, subsort)
             got = []
             for batch in br:
-                got.extend(fn(batch))
+                blob, koff, klen = fn(batch)
+                got.extend(blob[koff[i]:koff[i] + klen[i]]
+                           for i in range(batch.n))
         assert got == expected, (order, subsort)
+
+
+# ---------------------------------------------------------------------------
+# NativeExternalSorter parity: the pure-Python sorter is the semantic oracle
+# (byte-identical output, in-memory and spilled; VERDICT r2 item 4)
+
+
+@pytest.mark.parametrize("order,subsort,max_bytes", [
+    ("coordinate", "natural", 1 << 30),
+    ("coordinate", "natural", 8 << 10),
+    ("queryname", "natural", 8 << 10),
+    ("queryname", "lex", 1 << 30),
+    ("template-coordinate", "natural", 8 << 10),
+    ("template-coordinate", "natural", 1 << 30),
+])
+def test_native_sorter_matches_python(order, subsort, max_bytes):
+    from fgumi_tpu.native import get_lib
+
+    if get_lib() is None:
+        pytest.skip("native library unavailable")
+    recs = _random_records(700, seed=11)
+    key_fn = pk.make_key_bytes_fn(order, HEADER, subsort)
+    with ext.NativeExternalSorter(key_fn, max_bytes=max_bytes) as a, \
+            ext.ExternalSorter(key_fn, max_bytes=max_bytes) as b:
+        for r in recs:
+            a.add(r)
+            b.add(r)
+        got_a = list(a.sorted_records())
+        got_b = list(b.sorted_records())
+    assert got_a == got_b
+    assert len(got_a) == len(recs)
+
+
+def test_native_sorter_batch_path_matches_python(tmp_path):
+    """add_record_batch (whole-batch pools) vs per-record oracle, both spill
+    and in-memory, through the real BAM write/read cycle."""
+    from fgumi_tpu.io.batch_reader import BamBatchReader
+    from fgumi_tpu.native import get_lib
+
+    if get_lib() is None:
+        pytest.skip("native library unavailable")
+    recs = _random_records(900, seed=12)
+    path = str(tmp_path / "in.bam")
+    with BamWriter(path, HEADER) as w:
+        for r in recs:
+            w.write_record_bytes(r.data)
+    for order, max_bytes in (("template-coordinate", 1 << 30),
+                             ("template-coordinate", 16 << 10),
+                             ("coordinate", 16 << 10)):
+        key_fn = pk.make_key_bytes_fn(order, HEADER, "natural")
+        batch_fn = pk.make_batch_keys_fn(order, HEADER, "natural")
+        with ext.NativeExternalSorter(key_fn, max_bytes=max_bytes) as a:
+            with BamBatchReader(path) as br:
+                for batch in br:
+                    a.add_record_batch(batch, batch_fn)
+            wire = b"".join(a.sorted_wire_chunks())
+        with ext.ExternalSorter(key_fn, max_bytes=max_bytes) as b:
+            for r in recs:
+                b.add(r)
+            expect = b"".join(struct.pack("<I", len(d)) + d
+                              for d in b.sorted_records())
+        assert wire == expect, (order, max_bytes)
+
+
+def test_native_sorter_mixed_add_paths():
+    """add_entry and add_batch interleave; ingest order must be preserved
+    for equal keys (the stable total-order contract, radix.rs:35)."""
+    from fgumi_tpu.native import get_lib
+
+    if get_lib() is None:
+        pytest.skip("native library unavailable")
+    # many records with IDENTICAL keys: output must be ingest order
+    recs = []
+    for i in range(50):
+        b = RecordBuilder().start_mapped(
+            b"same", 0, 1, 777, 60, [("M", 8)], b"ACGTACGT",
+            [i % 40 + 2] * 8)
+        recs.append(RawRecord(b.finish()))
+    key_fn = pk.make_key_bytes_fn("coordinate", HEADER)
+    with ext.NativeExternalSorter(key_fn, max_bytes=1 << 30) as s:
+        for r in recs:
+            s.add(r)
+        got = list(s.sorted_records())
+    assert got == [r.data for r in recs]
+
+
+def test_write_indexed_matches_tell_virtual(tmp_path):
+    """BgzfWriter.write_indexed's reconstructed virtual offsets must equal
+    the per-record tell_virtual() sequence, across block boundaries and a
+    pre-existing partial buffer."""
+    import io as _io
+
+    import numpy as np
+
+    from fgumi_tpu.io.bgzf import BgzfWriter
+
+    rng = random.Random(3)
+    recs = [bytes([rng.randrange(256) for _ in range(rng.randrange(40, 400))])
+            for _ in range(3000)]
+    blob = b"".join(recs)
+    starts = np.zeros(len(recs) + 1, dtype=np.int64)
+    np.cumsum([len(r) for r in recs], out=starts[1:])
+
+    a = BgzfWriter(_io.BytesIO(), level=1)
+    a.write(b"H" * 1000)  # partial pre-existing buffer
+    expect = []
+    for r in recs:
+        expect.append(a.tell_virtual())
+        a.write(r)
+    expect.append(a.tell_virtual())
+
+    b = BgzfWriter(_io.BytesIO(), level=1)
+    b.write(b"H" * 1000)
+    got = b.write_indexed(blob, starts)
+    assert list(map(int, got)) == expect
+    # and the compressed streams decode identically
+    a._f.seek(0), b._f.seek(0)
+
+
+def test_bai_add_many_matches_add(tmp_path):
+    """add_many (vectorized) must produce byte-identical .bai/.csi files to
+    the per-record add() loop."""
+    import numpy as np
+
+    from fgumi_tpu.io.bai import BaiBuilder, CsiBuilder
+
+    rng = random.Random(5)
+    n = 4000
+    tids = np.sort(np.array([rng.choice([-1, 0, 0, 0, 1, 2])
+                             for _ in range(n)]))
+    # within each tid, ascending positions (coordinate order)
+    begs = np.zeros(n, dtype=np.int64)
+    for t in (0, 1, 2):
+        m = tids == t
+        begs[m] = np.sort(np.array([rng.randrange(0, 1 << 22)
+                                    for _ in range(int(m.sum()))]))
+    ends = begs + np.array([rng.choice([1, 30, 100, 20000])
+                            for _ in range(n)])
+    vo = np.cumsum(np.array([rng.randrange(50, 300) for _ in range(n + 1)]))
+    vs, ve = vo[:-1], vo[1:]
+    mapped = np.array([rng.random() < 0.9 for _ in range(n)])
+
+    for cls, suffix in ((BaiBuilder, "bai"), (CsiBuilder, "csi")):
+        one = cls(3)
+        for i in range(n):
+            one.add(int(tids[i]), int(begs[i]), int(ends[i]), int(vs[i]),
+                    int(ve[i]), bool(mapped[i]))
+        many = cls(3)
+        # split into several calls to exercise cross-call chunk coalescing
+        for lo in range(0, n, 1234):
+            hi = min(lo + 1234, n)
+            many.add_many(tids[lo:hi], begs[lo:hi], ends[lo:hi], vs[lo:hi],
+                          ve[lo:hi], mapped[lo:hi])
+        p1 = str(tmp_path / f"one.{suffix}")
+        p2 = str(tmp_path / f"many.{suffix}")
+        one.write(p1)
+        many.write(p2)
+        if suffix == "bai":
+            assert open(p1, "rb").read() == open(p2, "rb").read()
+        else:  # csi is gzip-wrapped; compare decompressed payload
+            import gzip
+
+            assert gzip.open(p1).read() == gzip.open(p2).read()

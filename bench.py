@@ -429,6 +429,10 @@ print(json.dumps(out))
                     "reference Rust CPU binary not buildable in this image)",
         "input_reads": n_reads,
         "threads": threads,
+        # context for thread-scaling numbers: this container exposes a
+        # single CPU (os.cpu_count()), so host-side parallelism cannot
+        # reduce wall clock here — only device offload can
+        "host_cpus": os.cpu_count(),
     }
     timed = tpu or cpu
     if timed is None:

@@ -307,14 +307,14 @@ def cmd_simplex(args):
                     return out
 
                 with BamWriter(args.output, out_header) as writer:
-                    # device fetch + serialize resolve on the sink stage, so
-                    # with --threads they overlap the next batch's host prep
+                    # device fetch + thresholds + serialize run as the
+                    # parallel resolve stage (threads >= 4: a worker pool
+                    # with ordered output; 2-3: on the writer thread), so
+                    # they overlap the next batch's host prep
                     run_stages(
-                        iter(reader), _process,
-                        lambda chunk: writer.write_serialized(
-                            resolve_chunk(chunk)),
+                        iter(reader), _process, writer.write_serialized,
                         threads=args.threads, queue_items=queue_items,
-                        stats=stats)
+                        stats=stats, resolve_fn=resolve_chunk)
                     for blob in fast.flush():
                         writer.write_serialized(resolve_chunk(blob))
                     rejects.drain(caller)
@@ -465,10 +465,9 @@ def cmd_duplex(args):
 
             with BamWriter(args.output, out_header) as writer:
                 run_stages(
-                    iter(reader), _process,
-                    lambda chunk: writer.write_serialized(
-                        resolve_chunk(chunk)),
-                    threads=args.threads, stats=stats_t)
+                    iter(reader), _process, writer.write_serialized,
+                    threads=args.threads, stats=stats_t,
+                    resolve_fn=resolve_chunk)
                 for blob in fast.flush():
                     writer.write_serialized(resolve_chunk(blob))
         progress.finish()

@@ -95,31 +95,43 @@ class _Watchdog:
 
 def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
                queue_items: int = 4, stats: StageTimes = None,
-               watchdog_interval: float = 120.0):
-    """source -> process -> sink, optionally with reader/writer threads.
+               watchdog_interval: float = 120.0, resolve_fn=None):
+    """source -> process [-> resolve workers] -> sink, with optional threads.
 
     - source_iter: yields work items (e.g. RecordBatch)
-    - process_fn(item) -> iterable of outputs
-    - sink_fn(output)
+    - process_fn(item) -> iterable of outputs (serial stage: carry/group
+      state lives here, like the reference's exclusive Group step,
+      base.rs:1123-1150)
+    - resolve_fn(output) -> resolved output (optional PARALLEL stage: must be
+      thread-safe and pure per item — e.g. consensus _PendingChunk.resolve,
+      whose shared counters are lock-guarded). With threads >= 4 a pool of
+      (threads - 3) workers applies it concurrently; outputs are re-ordered
+      by serial number before the sink (the reference's Q7 write-reorder,
+      base.rs:1724-1920).
+    - sink_fn(resolved output) (serial, input order)
 
-    threads <= 1: fully inline. threads >= 2: reader thread + writer thread
-    around the processing caller thread, plus a stall watchdog. Exceptions
-    from any stage propagate to the caller; the first exception wins and the
-    pipeline drains.
+    threads <= 1: fully inline. threads 2..3: reader + writer threads around
+    the processing caller thread (resolve_fn runs on the writer). threads >=
+    4 with resolve_fn: reader + workers + writer. Exceptions from any stage
+    propagate to the caller; the first exception wins and the pipeline
+    drains. A stall watchdog logs a queue snapshot if no stage progresses.
     """
     if stats is None:
         stats = StageTimes()
+    if resolve_fn is None:
+        resolve_fn = lambda out: out  # noqa: E731
     if threads <= 1:
         t_last = time.monotonic()
         for item in source_iter:
             now = time.monotonic()
             stats.add_busy("read", now - t_last)
             for out in process_fn(item):
-                sink_fn(out)
+                sink_fn(resolve_fn(out))
             t_last = time.monotonic()
             stats.add_busy("process+write", t_last - now)
         return stats
 
+    n_workers = max(threads - 3, 0)
     q_in = queue.Queue(maxsize=queue_items)
     # the sink queue may carry deferred work holding whole padded batches
     # (consensus _PendingChunk), so its depth bounds in-flight memory too
@@ -152,7 +164,64 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
         except BaseException as e:  # noqa: BLE001 - relayed to caller
             put_in(_Err(e))
 
-    def writer():
+    # ---- resolve worker pool (threads >= 4): q_out carries (serial, item);
+    # workers push (serial, resolved | _Err) to q_done; the writer restores
+    # serial order with a holdback map (bounded by in-flight = q_out depth +
+    # n_workers, so memory stays bounded by queue_items)
+    q_done = queue.Queue() if n_workers else None
+
+    def worker(widx):
+        while True:
+            got = q_out.get()
+            if got is _DONE:
+                q_done.put(_DONE)
+                return
+            serial, item = got
+            t0 = time.monotonic()
+            try:
+                q_done.put((serial, resolve_fn(item)))
+            except BaseException as e:  # noqa: BLE001 - relayed via writer
+                q_done.put((serial, _Err(e)))
+            stats.add_busy(f"resolve[{widx}]", time.monotonic() - t0)
+
+    def writer_pooled():
+        next_serial = 0
+        holdback = {}
+        done_workers = 0
+        try:
+            while done_workers < n_workers:
+                t0 = time.monotonic()
+                got = q_done.get()
+                now = time.monotonic()
+                stats.add_blocked("write", now - t0)
+                if got is _DONE:
+                    done_workers += 1
+                    continue
+                serial, resolved = got
+                holdback[serial] = resolved
+                while next_serial in holdback:
+                    out = holdback.pop(next_serial)
+                    next_serial += 1
+                    if isinstance(out, _Err):
+                        raise out.exc
+                    sink_fn(out)
+                    counters[2] += 1
+                stats.add_busy("write", time.monotonic() - now)
+            # workers exited; flush any stragglers in serial order
+            while next_serial in holdback:
+                out = holdback.pop(next_serial)
+                next_serial += 1
+                if isinstance(out, _Err):
+                    raise out.exc
+                sink_fn(out)
+                counters[2] += 1
+        except BaseException as e:  # noqa: BLE001 - relayed to caller
+            writer_exc.append(e)
+            while done_workers < n_workers:
+                if q_done.get() is _DONE:
+                    done_workers += 1
+
+    def writer_direct():
         try:
             while True:
                 t0 = time.monotonic()
@@ -161,7 +230,7 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
                 stats.add_blocked("write", now - t0)
                 if out is _DONE:
                     return
-                sink_fn(out)
+                sink_fn(resolve_fn(out))
                 counters[2] += 1
                 stats.add_busy("write", time.monotonic() - now)
         except BaseException as e:  # noqa: BLE001 - relayed to caller
@@ -171,10 +240,16 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
                 pass
 
     rt = threading.Thread(target=reader, name="fgumi-reader", daemon=True)
-    wt = threading.Thread(target=writer, name="fgumi-writer", daemon=True)
+    wt = threading.Thread(target=writer_pooled if n_workers else writer_direct,
+                          name="fgumi-writer", daemon=True)
+    wts = [threading.Thread(target=worker, args=(i,), name=f"fgumi-worker-{i}",
+                            daemon=True) for i in range(n_workers)]
     watchdog = _Watchdog(counters, q_in, q_out, watchdog_interval)
     rt.start()
     wt.start()
+    for t in wts:
+        t.start()
+    serial = 0
     try:
         while True:
             t0 = time.monotonic()
@@ -186,13 +261,20 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
             if isinstance(item, _Err):
                 raise item.exc
             for out in process_fn(item):
-                q_out.put(out)
+                if n_workers:
+                    q_out.put((serial, out))
+                    serial += 1
+                else:
+                    q_out.put(out)
             counters[1] += 1
             stats.add_busy("process", time.monotonic() - now)
             if writer_exc:
                 raise writer_exc[0]
     finally:
-        q_out.put(_DONE)
+        for _ in range(max(n_workers, 1)):
+            q_out.put(_DONE)
+        for t in wts:
+            t.join()
         wt.join()  # watchdog stays armed while the writer drains
         watchdog.stop()
         # stop + drain until the reader exits: it re-checks the stop event on

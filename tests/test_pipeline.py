@@ -97,3 +97,102 @@ def test_reader_thread_exits_after_process_error():
             break
         _time.sleep(0.02)
     assert not leaked, f"leaked pipeline threads: {leaked}"
+
+
+# ---------------------------------------------------------------------------
+# resolve worker pool (threads >= 4): ordered output, error propagation,
+# adversarial tiny-queue/slow-sink stress (reference nightly stress suite
+# analog, test_pipeline_concurrency.rs)
+
+
+def _pool_run(n_items, threads, queue_items=2, jitter=0.0, fail_at=None):
+    import random as _random
+
+    import fgumi_tpu.pipeline as pl
+
+    rng = _random.Random(42)
+    out = []
+
+    def process(x):
+        return [x * 10 + k for k in range(3)]
+
+    def resolve(y):
+        if jitter:
+            time.sleep(rng.random() * jitter)
+        if fail_at is not None and y == fail_at:
+            raise RuntimeError(f"boom {y}")
+        return ("r", y)
+
+    pl.run_stages(iter(range(n_items)), process, out.append,
+                  threads=threads, queue_items=queue_items,
+                  resolve_fn=resolve)
+    return out
+
+
+def test_pool_ordered_output():
+    expect = _pool_run(40, threads=0)
+    for threads in (2, 4, 6, 10):
+        assert _pool_run(40, threads=threads) == expect, threads
+
+
+def test_pool_ordered_under_jitter():
+    """Random resolve delays scramble completion order; the reorder buffer
+    must restore serial order exactly."""
+    expect = _pool_run(25, threads=0)
+    got = _pool_run(25, threads=8, queue_items=1, jitter=0.01)
+    assert got == expect
+
+
+def test_pool_worker_error_propagates():
+    with pytest.raises(RuntimeError, match="boom 71"):
+        _pool_run(30, threads=6, fail_at=71)
+
+
+def test_pool_tiny_queue_slow_sink():
+    """queue_items=1 with a slow sink: backpressure everywhere, no deadlock,
+    order preserved."""
+    import fgumi_tpu.pipeline as pl
+
+    out = []
+
+    def slow_sink(y):
+        time.sleep(0.002)
+        out.append(y)
+
+    pl.run_stages(iter(range(30)), lambda x: [x], slow_sink,
+                  threads=5, queue_items=1, resolve_fn=lambda y: y * 2)
+    assert out == [x * 2 for x in range(30)]
+
+
+def test_pool_sink_error_drains():
+    import fgumi_tpu.pipeline as pl
+
+    def sink(y):
+        if y == 12:
+            raise ValueError("sink died")
+
+    with pytest.raises(ValueError, match="sink died"):
+        pl.run_stages(iter(range(50)), lambda x: [x], sink,
+                      threads=6, queue_items=1, resolve_fn=lambda y: y)
+
+
+def test_pool_resolve_thread_safety_counter():
+    """Resolve runs concurrently; a lock-guarded shared counter must see
+    every item exactly once."""
+    import threading as _threading
+
+    import fgumi_tpu.pipeline as pl
+
+    lock = _threading.Lock()
+    seen = []
+
+    def resolve(y):
+        with lock:
+            seen.append(y)
+        return y
+
+    out = []
+    pl.run_stages(iter(range(200)), lambda x: [x], out.append,
+                  threads=8, queue_items=2, resolve_fn=resolve)
+    assert sorted(seen) == list(range(200))
+    assert out == list(range(200))

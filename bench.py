@@ -404,6 +404,43 @@ print(json.dumps(out))
                 stages_result["pipeline_diagnostics"] = [
                     f"stage bench failed: {serr}"]
 
+    # Huge-position-group UMI assignment (VERDICT r3 item 6): warm adjacency/
+    # paired times at 4k and 16k templates, CPU env (host algorithm + XLA
+    # pairwise kernel; on TPU the same code path dispatches to the chip).
+    umi_script = r"""
+import json, sys, time
+sys.path.insert(0, sys.argv[1])
+import numpy as np
+from fgumi_tpu.umi.assigners import AdjacencyUmiAssigner, PairedUmiAssigner
+
+rng = np.random.default_rng(0)
+def gen(n, paired=False):
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    true = rng.choice(bases, size=(max(n // 10, 1), 8))
+    arr = true[rng.integers(0, len(true), size=n)]
+    err = rng.random(arr.shape) < 0.01
+    arr = np.where(err, rng.choice(bases, size=arr.shape), arr)
+    u = ["".join(chr(c) for c in row) for row in arr]
+    if paired:
+        arr2 = rng.choice(bases, size=arr.shape)
+        u = [f"{a}-{''.join(chr(c) for c in r)}" for a, r in zip(u, arr2)]
+    return u
+
+out = {}
+for tag, cls, paired in (("adjacency", AdjacencyUmiAssigner, False),
+                         ("paired", PairedUmiAssigner, True)):
+    for n in (4000, 16000):
+        umis = gen(n, paired)
+        cls(1).assign(umis)  # warm (jit compile)
+        t0 = time.monotonic()
+        cls(1).assign(umis)
+        out[f"{tag}_{n}_s"] = round(time.monotonic() - t0, 4)
+print(json.dumps(out))
+"""
+    umi_times, uerr = _run_script(umi_script, [REPO], CPU_ENV, run_timeout)
+    if uerr:
+        diagnostics.append(f"umi assign bench: {uerr}")
+
     # Tail loop: keep probing across the remaining budget until the device
     # measurements complete or 8 spaced probes have failed (conclusive
     # evidence of a full-window wedge). A wedge can clear at any minute; the
@@ -485,6 +522,8 @@ print(json.dumps(out))
                     d_cpu["wall_s"] / trier.duplex["wall_s"], 3)
 
     result.update(stages_result)
+    if umi_times is not None:
+        result["umi_assign_seconds"] = umi_times
     result["device_probes"] = trier.probes
     if diagnostics:
         result["diagnostics"] = diagnostics

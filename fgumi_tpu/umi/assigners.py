@@ -211,25 +211,69 @@ def pairwise_distances(mat_a: np.ndarray, mat_b: np.ndarray = None) -> np.ndarra
     return (mat_a[:, None, :] != mat_b[None, :, :]).sum(axis=2, dtype=np.int16)
 
 
+def _pow2_pad_rows(mat: np.ndarray) -> np.ndarray:
+    """Pad rows up to the next power of two with an unused byte value.
+
+    Real position groups arrive in every size; without padding each distinct
+    (n, m) pair would trigger a fresh XLA compile (~2s — measured as the
+    entire 16k-group 'cliff'). Pow2 bucketing keeps the compiled-shape
+    vocabulary logarithmic, exactly as the consensus kernel pads its
+    segment batches (ops/kernel.py pad_segments)."""
+    n = mat.shape[0]
+    n_pad = 1 << (n - 1).bit_length() if n > 1 else 1
+    if n_pad == n:
+        return mat
+    pad = np.zeros((n_pad - n, mat.shape[1]), dtype=mat.dtype)
+    return np.concatenate([mat, pad])
+
+
+_dist_jit = None
+
+
+def _get_dist_jit():
+    """Module-level jitted pairwise kernel: one compile per padded shape for
+    the process lifetime (a per-call jax.jit closure would recompile every
+    call — measured at ~0.5s per group)."""
+    global _dist_jit
+    if _dist_jit is None:
+        import jax
+        import jax.numpy as jnp
+
+        # group/dedup runs reach the device only through this kernel, so the
+        # persistent XLA cache must be enabled here too (first 16k-UMI group
+        # otherwise pays the ~2s compile in every CLI invocation)
+        from ..ops.kernel import _enable_persistent_compile_cache
+
+        _enable_persistent_compile_cache()
+
+        @jax.jit
+        def dist(a, b):
+            # one-hot over the observed byte alphabet -> matmul on the MXU
+            alphabet = jnp.unique(jnp.concatenate([a.ravel(), b.ravel()]),
+                                  size=8, fill_value=0)
+            oh_a = (a[..., None] == alphabet).astype(jnp.bfloat16)  # (N, L, K)
+            oh_b = (b[..., None] == alphabet).astype(jnp.bfloat16)
+            matches = jnp.einsum("nlk,mlk->nm", oh_a, oh_b)
+            return (a.shape[1] - matches).astype(jnp.int16)
+
+        _dist_jit = dist
+    return _dist_jit
+
+
 def _device_pairwise(mat_a: np.ndarray, mat_b: np.ndarray) -> np.ndarray:
-    import jax
     import jax.numpy as jnp
 
-    @jax.jit
-    def dist(a, b):
-        # one-hot over the observed byte alphabet -> matches via matmul on the MXU
-        alphabet = jnp.unique(jnp.concatenate([a.ravel(), b.ravel()]), size=8,
-                              fill_value=0)
-        oh_a = (a[..., None] == alphabet).astype(jnp.bfloat16)  # (N, L, K)
-        oh_b = (b[..., None] == alphabet).astype(jnp.bfloat16)
-        matches = jnp.einsum("nlk,mlk->nm", oh_a, oh_b)
-        return (a.shape[1] - matches).astype(jnp.int16)
+    dist = _get_dist_jit()
 
     from ..ops.kernel import DEVICE_STATS
 
-    DEVICE_STATS.add_dispatch(2 * mat_a.shape[0] * mat_b.shape[0]
-                              * mat_a.shape[1] * 8)  # one-hot matmul (K=8)
-    return DEVICE_STATS.fetch(dist(jnp.asarray(mat_a), jnp.asarray(mat_b)))
+    n, m = mat_a.shape[0], mat_b.shape[0]
+    pad_a = _pow2_pad_rows(mat_a)
+    pad_b = _pow2_pad_rows(mat_b)
+    DEVICE_STATS.add_dispatch(2 * pad_a.shape[0] * pad_b.shape[0]
+                              * pad_a.shape[1] * 8)  # one-hot matmul (K=8)
+    full = DEVICE_STATS.fetch(dist(jnp.asarray(pad_a), jnp.asarray(pad_b)))
+    return full[:n, :m]
 
 
 def _assert_uniform_length(lengths) -> None:

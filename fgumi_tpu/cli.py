@@ -122,10 +122,12 @@ def _unmapped_consensus_header(read_group_id: str):
 
 
 def _build_dp_mesh(devices_arg):
-    """A dp-only mesh over the requested device count, or None (<=1 device).
+    """A (dp, sp) mesh over the requested device count, or None (<=1 device).
 
     "auto" uses every visible device; sharding is transparent — single-device
     output is byte-identical (tests/test_mesh.py, test_cli_fast_parity.py).
+    FGUMI_TPU_SP=<k> splits the read axis over k of the devices (sequence
+    parallelism for deep families; dp = n // k), default 1 (dp-only).
     """
     import jax
 
@@ -134,9 +136,15 @@ def _build_dp_mesh(devices_arg):
     n = max(1, min(n, len(devs)))
     if n <= 1:
         return None
+    sp_env = os.environ.get("FGUMI_TPU_SP", "1")
+    sp = max(int(sp_env), 1) if sp_env.isdigit() else 1
+    if n % sp != 0:
+        log.warning("FGUMI_TPU_SP=%d does not divide device count %d; "
+                    "using sp=1", sp, n)
+        sp = 1
     from .parallel.mesh import make_mesh
 
-    return make_mesh(devs[:n], sp=1)
+    return make_mesh(devs[:n], sp=sp)
 
 
 def _devices_arg(s: str):

@@ -87,6 +87,44 @@ def pack_shards(codes_d, quals_d, starts, jb, L_max):
     return codes3d, quals3d, seg2d, shard_starts, n_jobs, F_loc
 
 
+def pack_shards_sp(codes_d, quals_d, starts, jb, L_max, sp):
+    """Pack dense segment data into the (dp, sp, N_sp, L) layout for
+    device_call_segments_dp_sp.
+
+    Each dp shard's rows split into sp contiguous chunks (segments may span
+    chunk boundaries — partial segment sums psum exactly); every chunk pads
+    to the common pow2 N_sp with all-N rows carrying the chunk's last real
+    segment id (or 0 for empty chunks). Segment ids stay shard-global so the
+    psum-combined output is (dp, F_loc, L) exactly like the sp=1 layout."""
+    dp = len(jb) - 1
+    shard_starts = [starts[jb[d]:jb[d + 1] + 1] - starts[jb[d]]
+                    for d in range(dp)]
+    n_rows = [int(s[-1]) for s in shard_starts]
+    n_jobs = [int(jb[d + 1] - jb[d]) for d in range(dp)]
+    chunk = [-(-max(n, 1) // sp) for n in n_rows]
+    N_sp = 1 << (max(chunk) - 1).bit_length() if max(chunk) > 1 else 1
+    F_loc = 1 << (max(max(n_jobs), 1) - 1).bit_length()
+
+    codes4 = np.full((dp, sp, N_sp, L_max), 4, dtype=np.uint8)
+    quals4 = np.zeros((dp, sp, N_sp, L_max), dtype=np.uint8)
+    seg3 = np.zeros((dp, sp, N_sp), dtype=np.int32)
+    for d in range(dp):
+        base = int(starts[jb[d]])
+        n = n_rows[d]
+        seg_local = np.repeat(np.arange(n_jobs[d], dtype=np.int32),
+                              np.diff(shard_starts[d]))
+        for s in range(sp):
+            lo = min(s * chunk[d], n)
+            hi = min(lo + chunk[d], n)
+            m = hi - lo
+            if m:
+                codes4[d, s, :m] = codes_d[base + lo:base + hi]
+                quals4[d, s, :m] = quals_d[base + lo:base + hi]
+                seg3[d, s, :m] = seg_local[lo:hi]
+                seg3[d, s, m:] = seg_local[hi - 1]
+    return codes4, quals4, seg3, shard_starts, n_jobs, F_loc
+
+
 class _PendingChunk:
     """Deferred half of a batch: fetch packed device results, recompute
     depth/errors on host, apply thresholds, serialize (SURVEY §7 step 4
@@ -112,7 +150,8 @@ class _PendingChunk:
             winner, qual, depth, errors = kernel.resolve_segments(
                 dev, codes_d, quals_d, starts)
             self._assign(idxs, winner, qual, depth, errors)
-        else:  # "shard": (dp, F_local, L) packed, one family shard per device
+        elif self.pending[0] == "shard":
+            # (dp, F_local, L) packed, one family shard per device
             _, shard_jobs, shard_starts, codes3d, quals3d, dev = self.pending
             from ..ops.kernel import DEVICE_STATS
 
@@ -122,6 +161,16 @@ class _PendingChunk:
                 n = starts_d[-1]
                 winner, qual, depth, errors = kernel._finish_segments(
                     packed[d], codes3d[d, :n], quals3d[d, :n], starts_d)
+                self._assign(jlist, winner, qual, depth, errors)
+        else:  # "shard_rows": dp x sp packed; host rows kept 2D per shard
+            _, shard_jobs, shard_starts, shard_rows, dev = self.pending
+            from ..ops.kernel import DEVICE_STATS
+
+            packed = DEVICE_STATS.fetch(dev)
+            for d, (jlist, starts_d, (c2, q2)) in enumerate(
+                    zip(shard_jobs, shard_starts, shard_rows)):
+                winner, qual, depth, errors = kernel._finish_segments(
+                    packed[d], c2, q2, starts_d)
                 self._assign(jlist, winner, qual, depth, errors)
         return fast._serialize_jobs(self.batch, self.jobs, self.blocks)
 
@@ -712,6 +761,20 @@ class FastSimplexCaller:
         stacked (dp, N_max, L) array shards over the mesh's dp axis).
         """
         mesh = self.mesh
+        sp = dict(mesh.shape).get("sp", 1)
+        if sp > 1:
+            dp = mesh.shape["dp"]
+            jb = split_row_balanced(counts, dp)
+            shard_jobs = [multi[jb[d]:jb[d + 1]] for d in range(dp)]
+            codes4, quals4, seg3, shard_starts, _, F_loc = pack_shards_sp(
+                codes_d, quals_d, starts, jb, L_max, sp)
+            dev = self.caller.kernel.device_call_segments_dp_sp(
+                codes4, quals4, seg3, F_loc, mesh)
+            # shard resolve reads rows per dp shard from the dense 2D layout
+            shard_rows = [(codes_d[starts[jb[d]]:starts[jb[d + 1]]],
+                           quals_d[starts[jb[d]]:starts[jb[d + 1]]])
+                          for d in range(dp)]
+            return ("shard_rows", shard_jobs, shard_starts, shard_rows, dev)
         dp = mesh.size
         jb = split_row_balanced(counts, dp)
         shard_jobs = [multi[jb[d]:jb[d + 1]] for d in range(dp)]

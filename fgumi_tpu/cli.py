@@ -1358,6 +1358,9 @@ def _add_zipper(sub):
     p.add_argument("--exclude-missing-reads", nargs="?", const=True,
                    default=False, type=_parse_bool,
                    help="drop unmapped-BAM reads the aligner omitted")
+    p.add_argument("--classic", action="store_true",
+                   help="force the per-template engine (no batch "
+                        "vectorization)")
     _add_pipeline_compat(p)
     p.set_defaults(func=cmd_zipper)
 
@@ -1372,29 +1375,55 @@ def cmd_zipper(args):
         revcomp=args.tags_to_revcomp)
     from .native import batch as nbat
 
+    # the batch engine's staged-append model cannot express static removal
+    # of the tags it itself appends (MQ/MC/ms/AS/XS) -> classic engine there
+    use_fast = (nbat.available() and not getattr(args, "classic", False)
+                and not (tag_info.remove & {"MQ", "MC", "ms", "AS", "XS"}))
     if nbat.available():
         from .io.batch_reader import BatchedRecordReader as _Reader
     else:
         _Reader = BamReader
     t0 = time.monotonic()
     try:
-        with _Reader(args.input) as mapped, \
-                _Reader(args.unmapped) as unmapped:
-            for name, r in (("mapped", mapped), ("unmapped", unmapped)):
-                if not is_query_grouped(r.header.text):
-                    log.error(
-                        "zipper requires queryname-sorted or query-grouped "
-                        "%s input (@HD must advertise SO:queryname or "
-                        "GO:query)", name)
-                    return 2
-            out_header = _header_with_pg(
-                _merge_zipper_headers(mapped.header, unmapped.header),
-                " ".join(sys.argv))
-            with BamWriter(args.output, out_header) as writer:
-                n_templates, n_records, n_missing = run_zipper(
-                    mapped, unmapped, writer, tag_info,
-                    skip_tc_tags=args.skip_tc_tags,
-                    exclude_missing_reads=args.exclude_missing_reads)
+        if use_fast:
+            from .commands.fast_zipper import run_zipper_fast
+            from .io.batch_reader import BamBatchReader
+
+            with BamBatchReader(args.input) as mapped, \
+                    BamBatchReader(args.unmapped) as unmapped:
+                for name, r in (("mapped", mapped), ("unmapped", unmapped)):
+                    if not is_query_grouped(r.header.text):
+                        log.error(
+                            "zipper requires queryname-sorted or "
+                            "query-grouped %s input (@HD must advertise "
+                            "SO:queryname or GO:query)", name)
+                        return 2
+                out_header = _header_with_pg(
+                    _merge_zipper_headers(mapped.header, unmapped.header),
+                    " ".join(sys.argv))
+                with BamWriter(args.output, out_header) as writer:
+                    n_templates, n_records, n_missing = run_zipper_fast(
+                        mapped, unmapped, writer, tag_info,
+                        skip_tc_tags=args.skip_tc_tags,
+                        exclude_missing_reads=args.exclude_missing_reads)
+        else:
+            with _Reader(args.input) as mapped, \
+                    _Reader(args.unmapped) as unmapped:
+                for name, r in (("mapped", mapped), ("unmapped", unmapped)):
+                    if not is_query_grouped(r.header.text):
+                        log.error(
+                            "zipper requires queryname-sorted or "
+                            "query-grouped %s input (@HD must advertise "
+                            "SO:queryname or GO:query)", name)
+                        return 2
+                out_header = _header_with_pg(
+                    _merge_zipper_headers(mapped.header, unmapped.header),
+                    " ".join(sys.argv))
+                with BamWriter(args.output, out_header) as writer:
+                    n_templates, n_records, n_missing = run_zipper(
+                        mapped, unmapped, writer, tag_info,
+                        skip_tc_tags=args.skip_tc_tags,
+                        exclude_missing_reads=args.exclude_missing_reads)
     except (ValueError, OSError) as e:
         log.error("%s", e)
         return 2

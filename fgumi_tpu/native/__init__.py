@@ -183,6 +183,16 @@ def get_lib():
                          ctypes.c_long, p])
         lib.fgumi_ref_spans.restype = None
         lib.fgumi_ref_spans.argtypes = [p, p, p, p, ctypes.c_long, p]
+        lib.fgumi_concat_spans.restype = ctypes.c_long
+        lib.fgumi_concat_spans.argtypes = [p, p, p, p, ctypes.c_long, p, p]
+        lib.fgumi_tag_name_list.restype = None
+        lib.fgumi_tag_name_list.argtypes = [p, p, p, ctypes.c_long,
+                                            ctypes.c_long, p, p]
+        lib.fgumi_cigar_strings.restype = ctypes.c_long
+        lib.fgumi_cigar_strings.argtypes = [p, p, p, ctypes.c_long, p, p]
+        lib.fgumi_rebuild_aux_records.restype = ctypes.c_long
+        lib.fgumi_rebuild_aux_records.argtypes = [p] * 4 + [ctypes.c_long] \
+            + [p] * 6
         lib.fgumi_bgzf_compress_many.restype = ctypes.c_long
         lib.fgumi_bgzf_compress_many.argtypes = [
             p, ctypes.c_long, ctypes.c_int, ctypes.c_int, p, ctypes.c_long,

@@ -619,6 +619,9 @@ def _add_compare(sub):
     b.add_argument("--ignore-tags", nargs="*", default=[],
                    help="tags excluded from comparison")
     b.add_argument("--tag", default="MI", help="grouping tag (grouping mode)")
+    b.add_argument("--verify-sort", action="store_true",
+                   help="also verify each input satisfies its header's "
+                        "declared sort order (sort_verify engine)")
     b.set_defaults(func=_cmd_compare_bams)
     m = ps.add_parser("metrics", help="Compare two metric TSVs (exit 1 on mismatch)")
     m.add_argument("-a", required=True)

@@ -282,10 +282,80 @@ def compare_metrics(path_a: str, path_b: str, float_tolerance: float = 1e-5) -> 
     return mismatches
 
 
+def verify_sort_order(path: str) -> list:
+    """Check that records actually satisfy the header's DECLARED sort order
+    (the in-pipeline sort-verification engine of the reference's compare,
+    engines/sort_verify.rs:810-870): coordinate, queryname
+    (natural/lexicographical sub-sort), or template-coordinate, via the
+    packed byte keys (memcmp order == semantic order, sort/keys.py). Headers
+    declaring no verifiable order produce no findings."""
+    from ..core.template import _hd_fields
+    from ..sort.keys import make_batch_keys_fn, make_key_bytes_fn
+
+    mismatches = []
+    with BamReader(path) as reader:
+        header = reader.header
+        hd = _hd_fields(header.text)
+    so = hd.get("SO", "")
+    ss = hd.get("SS", "")
+    if so == "coordinate":
+        order, subsort = "coordinate", "natural"
+    elif so == "queryname":
+        order = "queryname"
+        subsort = "lex" if ss.endswith("lexicographical") else "natural"
+    elif ss.endswith("template-coordinate"):
+        order, subsort = "template-coordinate", "natural"
+    else:
+        return []
+
+    def report(i, prev_i):
+        if len(mismatches) < MAX_REPORTED:
+            mismatches.append(f"{path}: record {i} out of declared {order} "
+                              f"order (violates record {prev_i})")
+
+    prev = b""
+    prev_i = -1
+    batch_fn = make_batch_keys_fn(order, header, subsort)
+    if batch_fn is not None:
+        from ..io.batch_reader import BamBatchReader
+
+        i = 0
+        with BamBatchReader(path) as br:
+            for batch in br:
+                blob, koff, klen = batch_fn(batch)
+                for j in range(batch.n):
+                    key = blob[koff[j]:koff[j] + klen[j]]
+                    if key < prev:
+                        report(i + j, prev_i)
+                    else:
+                        prev, prev_i = key, i + j
+                i += batch.n
+    else:
+        key_fn = make_key_bytes_fn(order, header, subsort)
+        with BamReader(path) as reader:
+            for i, rec in enumerate(reader):
+                key = key_fn(rec)
+                if key < prev:
+                    report(i, prev_i)
+                else:
+                    prev, prev_i = key, i
+    return mismatches
+
+
 # ------------------------------------------------------------------ CLI glue
 
 def run_compare_bams(args) -> int:
     ignore_tags = frozenset(t.encode() for t in (args.ignore_tags or []))
+    if getattr(args, "verify_sort", False):
+        sort_mismatches = []
+        for path in (args.a, args.b):
+            sort_mismatches.extend(verify_sort_order(path))
+        if sort_mismatches:
+            for m in sort_mismatches:
+                log.error("compare: %s", m)
+            log.error("compare: declared sort order VIOLATED "
+                      "(%d findings)", len(sort_mismatches))
+            return 1
     if args.mode == "grouping":
         try:
             mismatches = compare_bams_grouping(args.a, args.b, tag=args.tag.encode(),

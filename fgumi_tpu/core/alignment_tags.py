@@ -10,7 +10,16 @@ Mirrors /root/reference/crates/fgumi-sam/src/alignment_tags.rs
   soft clips advance the read, N-skips advance the reference.
 """
 
+import numpy as np
+
 from .clipper import MutableRecord
+
+_SMALL_STR = [str(i) for i in range(512)]
+_CHR = [chr(i) for i in range(256)]
+
+
+def _int_str(v: int) -> str:
+    return _SMALL_STR[v] if 0 <= v < 512 else str(v)
 
 
 def regenerate_alignment_tags(rec: MutableRecord, ref_names, reference) -> bool:
@@ -37,20 +46,33 @@ def regenerate_alignment_tags(rec: MutableRecord, ref_names, reference) -> bool:
     seq_pos = 0
     seq = rec.seq
     quals = rec.quals
+    seq_arr = np.frombuffer(seq, dtype=np.uint8)
+    qual_arr = np.frombuffer(bytes(quals), dtype=np.uint8)
+    ref_arr = np.frombuffer(ref_bases, dtype=np.uint8)
     for op, ln in rec.cigar:
         if op in "M=X":
-            for k in range(ln):
-                ref_base = ref_bases[ref_off + k]
-                seq_base = seq[seq_pos]
-                if seq_base in (ord("N"), ord("n")) or (seq_base & ~0x20) != (ref_base & ~0x20):
-                    nm += 1
-                    uq += quals[seq_pos]
-                    md.append(str(match_count))
-                    match_count = 0
-                    md.append(chr(ref_base))
-                else:
-                    match_count += 1
-                seq_pos += 1
+            # vectorized per-segment mismatch scan (the per-base Python loop
+            # here was ~60% of clip wall time): case-folded compare, read N/n
+            # always mismatching, MD assembled from the few mismatch indices
+            sseg = seq_arr[seq_pos:seq_pos + ln]
+            rseg = ref_arr[ref_off:ref_off + ln]
+            mism = ((sseg & np.uint8(0xDF)) != (rseg & np.uint8(0xDF))) \
+                | (sseg == ord("N")) | (sseg == ord("n"))
+            idx = np.nonzero(mism)[0]
+            if len(idx):
+                nm += len(idx)
+                uq += int(qual_arr[seq_pos:seq_pos + ln][mism].sum())
+                gaps = np.diff(idx, prepend=-1) - 1
+                chars = rseg[idx]
+                md.append(_int_str(match_count + int(gaps[0])))
+                md.append(_CHR[chars[0]])
+                for g, c in zip(gaps[1:].tolist(), chars[1:].tolist()):
+                    md.append(_int_str(g))
+                    md.append(_CHR[c])
+                match_count = ln - int(idx[-1]) - 1
+            else:
+                match_count += ln
+            seq_pos += ln
             ref_off += ln
         elif op == "I":
             nm += ln

@@ -360,24 +360,37 @@ class FastGrouper:
         buf = batch.buf
         flag = batch.flag
 
-        def raw_umi(t):
-            r = self._r1_of[t] if self._r1_of[t] >= 0 else (
-                self._fr_of[t] if self._fr_of[t] >= 0 else self._r2_of[t])
-            return buf[uo[r]:uo[r] + ul[r]].tobytes().decode().upper()
+        # representative row per kept template (r1 > fragment > r2) and one
+        # blob gather + single upper/decode for every UMI string — the
+        # per-template slice/tobytes/decode/upper loop here was ~20% of
+        # group wall time
+        kt = np.asarray(kept_t, dtype=np.int64)
+        r1s, r2s, frs = self._r1_of[kt], self._r2_of[kt], self._fr_of[kt]
+        rep = np.where(r1s >= 0, r1s, np.where(frs >= 0, frs, r2s))
+        offs = uo[rep]
+        lens = np.where(offs >= 0, ul[rep], 0).astype(np.int64)
+        if self.no_umi:
+            all_umis = [""] * len(kt)
+        else:
+            from ..native import batch as _nb
 
+            blob, boff = _nb.concat_spans(
+                [buf], np.zeros(len(kt), np.int32), offs, lens)
+            s = blob.tobytes().upper().decode()
+            bo = boff.tolist()
+            all_umis = [s[bo[i]:bo[i + 1]] for i in range(len(kt))]
+
+        if assigner.split_by_orientation():
+            ok1 = (r1s < 0) | ((flag[np.maximum(r1s, 0)] & FLAG_REVERSE) == 0)
+            ok2 = (r2s < 0) | ((flag[np.maximum(r2s, 0)] & FLAG_REVERSE) == 0)
+            okeys = list(zip(ok1.tolist(), ok2.tolist()))
+            return all_umis, okeys
         umis = []
         okeys = []
-        if assigner.split_by_orientation():
-            for t in kept_t:
-                umis.append("" if self.no_umi else raw_umi(t))
-                r1, r2 = self._r1_of[t], self._r2_of[t]
-                okeys.append((r1 < 0 or not flag[r1] & FLAG_REVERSE,
-                              r2 < 0 or not flag[r2] & FLAG_REVERSE))
-            return umis, okeys
         u5 = self._u5_cache(batch)
         lo_p, hi_p = assigner.lower_prefix, assigner.higher_prefix
-        for t in kept_t:
-            umi = raw_umi(t)
+        for i, t in enumerate(kept_t):
+            umi = all_umis[i]
             parts = umi.split("-")
             if len(parts) != 2:
                 raise ValueError(

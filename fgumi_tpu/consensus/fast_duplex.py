@@ -730,16 +730,18 @@ class FastDuplexCaller:
             for s, flip in ((a_s, False), (b_s, True)):
                 if s < 0:
                     continue
-                if una_off[s] == -2 or (flip and una_off[s] >= 0):
-                    # divergent, or flipped (verbatim pointer unusable)
+                if una_off[s] == -2:  # divergent: materialize + flip each
                     vs = seg_values(s)
-                elif una_off[s] >= 0:
-                    vs = [buf[una_off[s]:una_off[s] + una_len[s]]
-                          .tobytes().decode()] * int(cnt[s])
+                    if flip:
+                        vs = [_flip_umi(v) for v in vs]
+                elif una_off[s] >= 0:  # unanimous: decode (and flip) ONCE
+                    v = buf[una_off[s]:una_off[s] + una_len[s]] \
+                        .tobytes().decode()
+                    if flip:
+                        v = _flip_umi(v)
+                    vs = [v] * int(cnt[s])
                 else:
                     continue
-                if flip:
-                    vs = [_flip_umi(v) for v in vs]
                 vals.extend(vs)
             if not vals:
                 continue

@@ -14,6 +14,15 @@ log = logging.getLogger("fgumi_tpu")
 
 
 _DEFAULT_SCHEDULER = "balanced-chase-drain"
+# the reference's 14 selectable strategies (scheduler/mod.rs:70-178): known
+# names are accepted (logged as no-ops); anything else is a loud error so a
+# typo cannot silently change nothing
+_KNOWN_SCHEDULERS = frozenset({
+    "fixed-priority", "chase-bottleneck", "thompson-sampling", "ucb",
+    "epsilon-greedy", "thompson-with-priors", "hybrid-adaptive",
+    "backpressure-proportional", "two-phase", "sticky-work-stealing",
+    "learned-affinity", "optimized-chase", "balanced-chase",
+    "balanced-chase-drain"})
 
 
 def _add_pipeline_compat(p):
@@ -75,6 +84,11 @@ def _apply_pipeline_compat(args):
             log.info("--memory-per-thread: no memory knob on this command; "
                      "ignored")
     if getattr(args, "scheduler", _DEFAULT_SCHEDULER) != _DEFAULT_SCHEDULER:
+        if args.scheduler not in _KNOWN_SCHEDULERS:
+            log.error("--scheduler %s: unknown strategy (the reference "
+                      "accepts: %s)", args.scheduler,
+                      ", ".join(sorted(_KNOWN_SCHEDULERS)))
+            return 2
         log.info("--scheduler %s: accepted for compatibility; the batch "
                  "engine uses a fixed reader->process->writer schedule",
                  args.scheduler)

@@ -54,6 +54,15 @@ def test_threaded_matches_inline(sim_bam, tmp_path):
     assert _payload(inline) == _payload(threaded)
 
 
+def test_resolve_pool_matches_inline(sim_bam, tmp_path):
+    """threads >= 4 engages the resolve worker pool with reordered output;
+    tiny batches multiply in-flight chunks across the workers."""
+    inline = _run(sim_bam, tmp_path, "inline8.bam")
+    pooled = _run(sim_bam, tmp_path, "pooled8.bam",
+                  ("--threads", "8", "--batch-bytes", "16384"))
+    assert _payload(inline) == _payload(pooled)
+
+
 def test_small_batches_match(sim_bam, tmp_path):
     """Tiny record batches force carry groups across batch boundaries."""
     big = _run(sim_bam, tmp_path, "big.bam")

@@ -1913,6 +1913,9 @@ def _add_correct(sub):
                    help="fail if kept/total falls below this fraction")
     p.add_argument("--revcomp", action="store_true",
                    help="reverse-complement observed UMIs before matching")
+    p.add_argument("--classic", action="store_true",
+                   help="force the per-template engine (no batch "
+                        "vectorization)")
     _add_pipeline_compat(p)
     p.set_defaults(func=cmd_correct)
 
@@ -1941,9 +1944,19 @@ def cmd_correct(args):
                         u1, u2, d)
     matcher = UmiMatcher(umis, args.max_mismatches, args.min_distance_diff,
                          args.cache_size)
+    from .native import batch as nbat
+
+    use_fast = nbat.available() and not getattr(args, "classic", False)
     t0 = time.monotonic()
     try:
-        with BamReader(args.input) as reader:
+        if use_fast:
+            from .commands.fast_correct import run_correct_fast
+            from .io.batch_reader import BamBatchReader
+
+            _Reader, _run = BamBatchReader, run_correct_fast
+        else:
+            _Reader, _run = BamReader, run_correct
+        with _Reader(args.input) as reader:
             out_header = _header_with_pg(reader.header, " ".join(sys.argv))
             import contextlib
             with contextlib.ExitStack() as stack:
@@ -1952,7 +1965,7 @@ def cmd_correct(args):
                 if args.rejects:
                     rejects_writer = stack.enter_context(
                         BamWriter(args.rejects, out_header))
-                stats = run_correct(
+                stats = _run(
                     reader, writer, matcher, umi_length, target=args.target,
                     revcomp=args.revcomp,
                     store_original=not args.dont_store_original,

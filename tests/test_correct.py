@@ -149,3 +149,60 @@ def test_correct_inconsistent_template_umi_errors(tmp_path):
                 .start_unmapped(b"q0", FLAG_UNMAPPED, b"ACGT", [30] * 4)
                 .tag_str(b"RX", umi.encode()).finish())
     assert cli_main(["correct", "-i", inp, "-o", out, "-u", "AAAAAA"]) == 2
+
+
+def test_fast_correct_matches_classic(tmp_path):
+    """Batch engine vs per-template oracle: byte-identical output, rejects,
+    metrics, across revcomp/store-original/tiny-batch variations."""
+    import numpy as np
+
+    from fgumi_tpu.cli import main
+    from fgumi_tpu.io.bam import BamHeader, BamReader, BamWriter, RecordBuilder
+
+    rng = np.random.default_rng(5)
+    header = BamHeader(text="@HD\tVN:1.6\tSO:queryname\n@SQ\tSN:c\tLN:9999\n",
+                       ref_names=["c"], ref_lengths=[9999])
+    wl = ["ACGTACGT", "TTTTACGT", "GGGGCCCC", "AAAACCCC"]
+    path = str(tmp_path / "in.bam")
+    with BamWriter(path, header) as w:
+        for i in range(300):
+            name = f"t{i:05d}".encode()
+            base = wl[i % len(wl)]
+            u = list(base)
+            if i % 3 == 0:  # one mismatch
+                u[i % 8] = "ACGT"[(("ACGT".index(u[i % 8])) + 1) % 4]
+            if i % 17 == 0:  # hopeless
+                u = list("TTTTTTTT")
+            if i % 23 == 0:  # wrong length
+                u = list("ACG")
+            umi = "".join(u)
+            n_recs = 1 + i % 3
+            for k in range(n_recs):
+                fl = 0x4 | (0x1 | (0x40 if k == 0 else 0x80)
+                            if n_recs > 1 else 0)
+                b = RecordBuilder().start_unmapped(name, fl, b"ACGT" * 8,
+                                                   [30] * 32)
+                if i % 29 != 1:  # some templates lack the tag entirely
+                    b.tag_str(b"RX", umi.encode())
+                b.tag_str(b"RG", b"A")
+                w.write_record_bytes(b.finish())
+    wl_path = str(tmp_path / "wl.txt")
+    open(wl_path, "w").write("\n".join(wl))
+
+    def run(tag, extra):
+        out = str(tmp_path / f"{tag}.bam")
+        rej = str(tmp_path / f"{tag}.rej.bam")
+        met = str(tmp_path / f"{tag}.tsv")
+        assert main(["correct", "-i", path, "-o", out, "--umi-files", wl_path,
+                     "--rejects", rej, "--metrics", met] + extra) == 0
+        with BamReader(out) as r:
+            recs = [x.data for x in r]
+        with BamReader(rej) as r:
+            rejs = [x.data for x in r]
+        return recs, rejs, open(met).read()
+
+    for extra in ([], ["--revcomp"], ["--dont-store-original"],
+                  ["--max-mismatches", "2"]):
+        fast = run("fast", extra)
+        slow = run("slow", extra + ["--classic"])
+        assert fast == slow, extra

@@ -106,7 +106,10 @@ def count_records(path):
 # injected axon sitecustomize pre-imports jax with the tunnel backend and can
 # block or fail init even under JAX_PLATFORMS=cpu while the tunnel is wedged.
 CPU_ENV = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
-           "PALLAS_AXON_POOL_IPS": ""}
+           "PALLAS_AXON_POOL_IPS": "",
+           # suppress XLA:CPU AOT-load feature-mismatch error spam when
+           # executables come from the persistent compilation cache
+           "TF_CPP_MIN_LOG_LEVEL": "3"}
 
 
 class DeviceTrier:
@@ -422,13 +425,19 @@ print(json.dumps(out))
 
     # Merge evidence captured by the in-session probe loop (devprobe.py
     # --loop): a momentary tunnel wake-up earlier in the round still yields a
-    # committed TPU number even if the tunnel is wedged right now.
+    # committed TPU number even if the tunnel is wedged right now. Evidence
+    # older than ~16h is from a previous round's code and is only annotated,
+    # never merged into this round's keys.
     evidence_path = os.path.join(REPO, "TPU_EVIDENCE.json")
     if os.path.exists(evidence_path):
         try:
             with open(evidence_path) as f:
                 evidence = json.load(f)
         except ValueError:
+            evidence = None
+        if evidence and (time.time() - evidence.get("captured_unix", 0)
+                         > 16 * 3600):
+            result["tpu_evidence_stale"] = evidence.get("captured_iso", "?")
             evidence = None
         if evidence:
             result["tpu_evidence_session"] = evidence
@@ -453,25 +462,32 @@ print(json.dumps(out))
                         ev["reads_per_sec"] / (n_reads / cpu["wall_s"]), 3)
 
     # Session probe history (every probe the background loop ran): failing-
-    # stage distribution is the wedge diagnosis a human can act on.
+    # stage distribution is the wedge diagnosis a human can act on. Entries
+    # older than ~16h belong to a previous round and are skipped.
     hist_path = os.path.join(REPO, ".probe_history.jsonl")
     if os.path.exists(hist_path):
         by_stage = {}
         n_hist = ok_hist = 0
+        cutoff = time.time() - 16 * 3600
         with open(hist_path) as f:
             for line in f:
                 try:
                     p = json.loads(line)
                 except ValueError:
                     continue
+                if p.get("t_unix", 0) < cutoff:
+                    continue
                 n_hist += 1
                 ok_hist += bool(p.get("ok"))
                 if not p.get("ok"):
                     # 'stage' = last stage that COMPLETED before the failure
-                    key = "hung after " + p.get("stage", "?")
+                    mode = ("hung" if "timeout" in p.get("err", "")
+                            else "failed")
+                    key = f"{mode} after " + p.get("stage", "?")
                     by_stage[key] = by_stage.get(key, 0) + 1
-        result["session_probe_history"] = {
-            "probes": n_hist, "ok": ok_hist, "failing_stage": by_stage}
+        if n_hist:
+            result["session_probe_history"] = {
+                "probes": n_hist, "ok": ok_hist, "failing_stage": by_stage}
 
     if diagnostics:
         result["diagnostics"] = diagnostics

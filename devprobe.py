@@ -44,24 +44,25 @@ RELAY_ADDR = ("127.0.0.1", 2024)
 # Stage-marked probe payload. argv[1] = mark file path. Marks survive a
 # parent-side kill, unlike captured stdout.
 STAGED_PROBE = r"""
-import sys, time
+import json, sys, time
 mark_path = sys.argv[1]
-def mark(line):
+def mark(stage, secs, **info):
     with open(mark_path, "a") as f:
-        f.write(line + "\n")
+        f.write(json.dumps({"stage": stage, "s": round(secs, 1), **info}))
+        f.write("\n")
         f.flush()
 t0 = time.monotonic()
 import jax
-mark("import %.1f" % (time.monotonic() - t0))
+mark("import", time.monotonic() - t0)
 t0 = time.monotonic()
 d = jax.devices()[0]
-mark("init %.1f platform=%s kind=%s dev=%s" % (
-    time.monotonic() - t0, d.platform, getattr(d, "device_kind", ""), d))
+mark("init", time.monotonic() - t0, platform=d.platform,
+     kind=getattr(d, "device_kind", ""), dev=str(d))
 import jax.numpy as jnp
 t0 = time.monotonic()
 x = jnp.ones((128, 128), dtype=jnp.float32)
 (x @ x).block_until_ready()
-mark("dispatch %.1f" % (time.monotonic() - t0))
+mark("dispatch", time.monotonic() - t0)
 """
 
 # Kernel-only device microbench (shared with bench.py): arrays in RAM -> one
@@ -190,13 +191,18 @@ def staged_probe(timeout_s=120, env_overrides=None):
         env.update(env_overrides)
     fd, mark_path = tempfile.mkstemp(prefix="fgumi_probe_", suffix=".marks")
     os.close(fd)
+    fd, err_path = tempfile.mkstemp(prefix="fgumi_probe_", suffix=".stderr")
+    os.close(fd)
     try:
-        proc = subprocess.Popen(
-            [sys.executable, "-u", "-c", STAGED_PROBE, mark_path],
-            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
-            env=env)
+        # stderr goes to a file, not a PIPE: a chatty init filling an
+        # undrained pipe would block the child and read as a bogus timeout
+        with open(err_path, "w") as err_f:
+            proc = subprocess.Popen(
+                [sys.executable, "-u", "-c", STAGED_PROBE, mark_path],
+                stdout=subprocess.DEVNULL, stderr=err_f, env=env)
     except OSError as e:
         os.unlink(mark_path)
+        os.unlink(err_path)
         out.update({"ok": False, "stage": "spawn", "stages": {},
                     "err": f"spawn failed: {e}"})
         return out
@@ -209,27 +215,25 @@ def staged_probe(timeout_s=120, env_overrides=None):
             out["hung_threads"] = _sample_child_threads(proc.pid)
             proc.kill()
             timed_out = True
+            proc.wait()
             break
         time.sleep(0.5)
-    try:
-        stderr_tail = (proc.communicate(timeout=10)[1] or "")
-    except subprocess.TimeoutExpired:
-        stderr_tail = ""
     stages = {}
     info = {}
     try:
+        with open(err_path) as f:
+            stderr_tail = f.read()[-4000:]
         with open(mark_path) as f:
             for line in f:
-                parts = line.split()
                 try:  # a killed child can leave a torn final line
-                    stages[parts[0]] = float(parts[1])
-                except (IndexError, ValueError):
+                    m = json.loads(line)
+                except ValueError:
                     continue
-                for tok in parts[2:]:
-                    k, _, v = tok.partition("=")
-                    info[k] = v
+                stages[m.pop("stage")] = m.pop("s")
+                info.update(m)
     finally:
         os.unlink(mark_path)
+        os.unlink(err_path)
     out["stages"] = stages
     out.update({k: v for k, v in info.items()
                 if k in ("platform", "kind", "dev")})
@@ -305,10 +309,20 @@ print(json.dumps({"platform": d.platform, "device": str(d),
 
 
 def capture_evidence(out_path, n_families=20000):
-    """Device is (momentarily) healthy: grab numbers, persisting partials."""
-    evidence = {"captured_unix": int(time.time()),
-                "captured_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                              time.gmtime())}
+    """Device is (momentarily) healthy: grab numbers, persisting partials.
+
+    Seeds from any existing evidence file so a later partial capture can only
+    add or refresh sections, never lose an earlier successful one."""
+    evidence = {}
+    if os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                evidence = json.load(f)
+        except ValueError:
+            evidence = {}
+    evidence.update({"captured_unix": int(time.time()),
+                     "captured_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                   time.gmtime())})
 
     def flush():
         with open(out_path + ".tmp", "w") as f:

@@ -2156,6 +2156,9 @@ def main(argv=None):
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    from fgumi_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
     rc = _apply_pipeline_compat(args)
     if rc:
         return rc

@@ -27,6 +27,10 @@ from .overlapping import (AGREEMENT_CODES, DISAGREEMENT_CODES,
 from .simple_umi import consensus_umis_batch
 from .vanilla import (FRAGMENT, R1, R2, _TYPE_FLAGS, VanillaConsensusCaller)
 
+# read-type -> record flags as an indexable array (serialize is table-driven)
+_TYPE_FLAGS_ARR = np.array([_TYPE_FLAGS[FRAGMENT], _TYPE_FLAGS[R1],
+                            _TYPE_FLAGS[R2]], dtype=np.int32)
+
 def resolve_chunk(chunk) -> bytes:
     """Wire bytes of a process_batch output item (resolving deferred device
     work — the fetch+serialize half of a batch runs here, typically on the
@@ -69,7 +73,9 @@ def pack_shards(codes_d, quals_d, starts, jb, L_max):
                     for d in range(dp)]
     n_rows = [int(s[-1]) for s in shard_starts]
     n_jobs = [int(jb[d + 1] - jb[d]) for d in range(dp)]
-    N_max = 1 << (max(max(n_rows), 1) - 1).bit_length()
+    from ..ops.kernel import _pad_rows
+
+    N_max = _pad_rows(max(max(n_rows), 1))
     F_loc = 1 << (max(max(n_jobs), 1) - 1).bit_length()
 
     codes3d = np.full((dp, N_max, L_max), 4, dtype=np.uint8)
@@ -102,7 +108,9 @@ def pack_shards_sp(codes_d, quals_d, starts, jb, L_max, sp):
     n_rows = [int(s[-1]) for s in shard_starts]
     n_jobs = [int(jb[d + 1] - jb[d]) for d in range(dp)]
     chunk = [-(-max(n, 1) // sp) for n in n_rows]
-    N_sp = 1 << (max(chunk) - 1).bit_length() if max(chunk) > 1 else 1
+    from ..ops.kernel import _pad_rows
+
+    N_sp = _pad_rows(max(chunk)) if max(chunk) > 1 else 1
     F_loc = 1 << (max(max(n_jobs), 1) - 1).bit_length()
 
     codes4 = np.full((dp, sp, N_sp, L_max), 4, dtype=np.uint8)
@@ -125,6 +133,70 @@ def pack_shards_sp(codes_d, quals_d, starts, jb, L_max, sp):
     return codes4, quals4, seg3, shard_starts, n_jobs, F_loc
 
 
+def _ranges(lo, counts):
+    """Concatenated arange(lo_i, lo_i + counts_i) without a Python loop."""
+    counts = np.asarray(counts, dtype=np.int64)
+    total = int(counts.sum())
+    if total == 0:
+        return np.empty(0, dtype=np.int64)
+    lo = np.asarray(lo, dtype=np.int64)
+    keep = counts > 0
+    lo_k = lo[keep]
+    c_k = counts[keep]
+    step = np.ones(total, dtype=np.int64)
+    firsts = np.concatenate(([0], np.cumsum(c_k)[:-1]))
+    step[firsts] = lo_k
+    # later range-starts jump from the previous range's last value
+    step[firsts[1:]] -= lo_k[:-1] + c_k[:-1] - 1
+    return np.cumsum(step)
+
+
+class _JobTable:
+    """Array-form job list for one batch span — no per-job Python objects.
+
+    Jobs (consensus outputs) are rows of parallel arrays, in output order:
+    per group, fragment first, then the R1/R2 pair (vanilla.py:377-386).
+    `vlo`/`count` slice the shared row pool: `pool_rows` holds span-relative
+    row indices into the packed code/qual arrays, `pool_span` the same rows
+    as absolute batch record indices (for RX lookups). `mi_rec` is the batch
+    record whose MI tag value provides the job's UMI bytes (the group's
+    first record) — serialization reads it straight out of the batch buffer.
+    """
+
+    __slots__ = ("count", "vlo", "read_type", "cons_len", "mi_rec",
+                 "pool_rows", "pool_span")
+
+    def __init__(self, count, vlo, read_type, cons_len, mi_rec, pool_rows,
+                 pool_span):
+        self.count = count
+        self.vlo = vlo
+        self.read_type = read_type
+        self.cons_len = cons_len
+        self.mi_rec = mi_rec
+        self.pool_rows = pool_rows
+        self.pool_span = pool_span
+
+    def __len__(self):
+        return len(self.count)
+
+
+def _table_from_legacy(entries, span):
+    """_JobTable from (key, group_start, (read_type, rows, cons_len)) tuples
+    already in output order (the rejects-tracking all-scan path)."""
+    J = len(entries)
+    if J == 0:
+        e64 = np.empty(0, dtype=np.int64)
+        return _JobTable(e64, e64, np.empty(0, dtype=np.int8),
+                         np.empty(0, dtype=np.int32), e64, e64, e64)
+    counts = np.fromiter((len(jg[1]) for _, _, jg in entries), np.int64, J)
+    vlo = np.concatenate(([0], np.cumsum(counts)[:-1]))
+    rt = np.fromiter((jg[0] for _, _, jg in entries), np.int8, J)
+    cl = np.fromiter((jg[2] for _, _, jg in entries), np.int32, J)
+    mi = np.fromiter((span[s] for _, s, _ in entries), np.int64, J)
+    pool = np.concatenate([jg[1] for _, _, jg in entries]).astype(np.int64)
+    return _JobTable(counts, vlo, rt, cl, mi, pool, span[pool])
+
+
 class _PendingChunk:
     """Deferred half of a batch: fetch packed device results, recompute
     depth/errors on host, apply thresholds, serialize (SURVEY §7 step 4
@@ -132,12 +204,14 @@ class _PendingChunk:
 
     __slots__ = ("fast", "batch", "jobs", "pending", "blocks")
 
-    def __init__(self, fast, batch, jobs, pending):
+    def __init__(self, fast, batch, jobs, pending, blocks0=()):
         self.fast = fast
         self.batch = batch
-        self.jobs = jobs
+        self.jobs = jobs  # a _JobTable
         self.pending = pending
-        self.blocks = []  # (job_idxs, bases, quals, depth32, errors32) rows
+        # (job_idxs, bases, quals, depth32, errors32) row blocks; starts with
+        # the host-path blocks (single-read jobs) from _dispatch_jobs
+        self.blocks = list(blocks0)
 
     def resolve(self) -> bytes:
         fast = self.fast
@@ -187,23 +261,6 @@ class _PendingChunk:
                             np.ascontiguousarray(quals_b),
                             np.ascontiguousarray(depth.astype(np.int32)),
                             np.ascontiguousarray(errors.astype(np.int32))))
-
-
-class _FastJob:
-    """One subgroup's device work unit (ConsensusJob analog, array-indexed)."""
-
-    __slots__ = ("umi_bytes", "read_type", "rows", "lens", "consensus_len",
-                 "surviving_idx", "result")
-
-    def __init__(self, umi_bytes, read_type, rows, lens, consensus_len,
-                 surviving_idx):
-        self.umi_bytes = umi_bytes
-        self.read_type = read_type
-        self.rows = rows                  # row indices into the packed arrays
-        self.lens = lens                  # per-read final lengths
-        self.consensus_len = consensus_len
-        self.surviving_idx = surviving_idx  # batch record indices (RX lookup)
-        self.result = None
 
 
 class FastSimplexCaller:
@@ -382,25 +439,30 @@ class FastSimplexCaller:
         # per-group preparation: vectorized common path; the per-group Python
         # scan remains for rejects-tracking mode and for groups needing
         # downsampling or the most-common-alignment filter
-        jobs = []
         if caller.track_rejects:
+            legacy = []
             for g in range(g0, g1):
                 s, e = rel_bounds[g], rel_bounds[g + 1]
+                jobs_g = []
                 self._prepare_group_fast(batch, span, s, e, rtype, final_len,
-                                         jobs, bool(group_uniform[g - g0]))
+                                         jobs_g,
+                                         bool(group_uniform[g - g0]))
+                legacy.extend(((g - g0) * 3 + i, int(s), jg)
+                              for i, jg in enumerate(jobs_g))
+            table = _table_from_legacy(legacy, span)
         else:
             # rel_bounds is already span-relative (rel_bounds[g0] == 0)
             gb = rel_bounds[g0:g1 + 1]
-            self._prepare_groups_vec(batch, span, gb, rtype, final_len,
-                                     group_uniform, jobs)
+            table = self._prepare_groups_vec(batch, span, gb, rtype,
+                                             final_len, group_uniform)
 
-        if not jobs:
+        if len(table) == 0:
             return []
-        pending = self._dispatch_jobs(codes, quals, jobs)
-        return [_PendingChunk(self, batch, jobs, pending)]
+        pending, blocks0 = self._dispatch_jobs(codes, quals, table)
+        return [_PendingChunk(self, batch, table, pending, blocks0)]
 
     def _prepare_groups_vec(self, batch, span, gb, rtype, final_len,
-                            group_uniform, jobs):
+                            group_uniform):
         """Vectorized _prepare_group_fast over all groups of the span.
 
         gb: (nG+1,) span-relative group boundaries. Groups that need the
@@ -408,6 +470,8 @@ class FastSimplexCaller:
         the per-group scan (identical semantics); everything else — type
         subgrouping, min-reads/zero-length rejection, consensus length,
         orphan handling — happens in whole-span array passes.
+
+        Returns a _JobTable (jobs in output order, arrays only).
         """
         caller = self.caller
         opts = caller.options
@@ -537,33 +601,67 @@ class FastSimplexCaller:
             if n_orphan:
                 stats.reject("OrphanConsensus", n_orphan)
 
-        mi_vo, mi_vl, _ = batch.tag_locs(self.tag)
-        buf = batch.buf
+        # legacy groups (downsample / alignment-filter / strand cases): the
+        # per-group scan, collected as (order-key, group-start, job-tuple)
+        legacy = []
+        for g in np.nonzero(legacy_g)[0]:
+            jobs_g = []
+            self._prepare_group_fast(batch, span, gb[g], gb[g + 1], rtype,
+                                     final_len, jobs_g,
+                                     bool(group_uniform[g]),
+                                     ordinal=ord0 + int(g))
+            legacy.extend((int(g) * 3 + i, int(gb[g]), jg)
+                          for i, jg in enumerate(jobs_g))
 
-        def seg_job(s, umi):
-            lo, hi = vstarts[s], vstarts[s + 1]
-            return _FastJob(umi, int(seg_t[s]), vrows[lo:hi], vlens[lo:hi],
-                            int(cons_len[s]), span_v[lo:hi])
+        # vectorized emission: seg_map columns are already in output order
+        # (fragment, R1, R2 per group; vanilla.py:377-386), so the row-major
+        # flatten index IS the (group, slot) order key
+        if nseg:
+            flat = seg_map.copy()
+            pair = (flat[:, R1] >= 0) & (flat[:, R2] >= 0)
+            flat[~pair, R1] = -1
+            flat[~pair, R2] = -1
+            flat = flat.ravel()
+            key_vec = np.nonzero(flat >= 0)[0]
+            vseg = flat[key_vec]
+        else:
+            key_vec = np.empty(0, dtype=np.int64)
+            vseg = np.empty(0, dtype=np.int64)
+            vrows = np.empty(0, dtype=np.int64)
+            span_v = np.empty(0, dtype=np.int64)
+            c1 = np.empty(0, dtype=np.int64)
+            vstarts = np.zeros(1, dtype=np.int64)
+            seg_t = np.empty(0, dtype=np.int8)
+            seg_g = np.empty(0, dtype=np.int64)
+            cons_len = np.empty(0, dtype=np.int64)
 
-        for g in range(nG):
-            if legacy_g[g]:
-                self._prepare_group_fast(batch, span, gb[g], gb[g + 1], rtype,
-                                         final_len, jobs,
-                                         bool(group_uniform[g]),
-                                         ordinal=ord0 + g)
-                continue
-            if seg_map is None:
-                continue
-            f, s1, s2 = seg_map[g]
-            if f < 0 and s1 < 0 and s2 < 0:
-                continue
-            i = int(span[gb[g]])
-            umi = buf[mi_vo[i]: mi_vo[i] + mi_vl[i]].tobytes()
-            if f >= 0:
-                jobs.append(seg_job(f, umi))
-            if s1 >= 0 and s2 >= 0:
-                jobs.append(seg_job(s1, umi))
-                jobs.append(seg_job(s2, umi))
+        cnt_v = c1[vseg].astype(np.int64)
+        vlo_v = vstarts[:-1][vseg].astype(np.int64)
+        typ_v = seg_t[vseg].astype(np.int8)
+        len_v = cons_len[vseg].astype(np.int32)
+        mi_v = span[gb[seg_g[vseg]]].astype(np.int64)
+
+        if not legacy:
+            return _JobTable(cnt_v, vlo_v, typ_v, len_v, mi_v, vrows, span_v)
+
+        nleg = len(legacy)
+        cnt_l = np.fromiter((len(jg[1]) for _, _, jg in legacy),
+                            np.int64, nleg)
+        vlo_l = len(vrows) + np.concatenate(([0], np.cumsum(cnt_l)[:-1]))
+        typ_l = np.fromiter((jg[0] for _, _, jg in legacy), np.int8, nleg)
+        len_l = np.fromiter((jg[2] for _, _, jg in legacy), np.int32, nleg)
+        mi_l = np.fromiter((span[s] for _, s, _ in legacy), np.int64, nleg)
+        key_l = np.fromiter((k for k, _, _ in legacy), np.int64, nleg)
+        aux = np.concatenate([jg[1] for _, _, jg in legacy])
+        order = np.argsort(np.concatenate((key_vec, key_l)), kind="stable")
+        return _JobTable(
+            np.concatenate((cnt_v, cnt_l))[order],
+            np.concatenate((vlo_v, vlo_l))[order],
+            np.concatenate((typ_v, typ_l))[order],
+            np.concatenate((len_v, len_l))[order],
+            np.concatenate((mi_v, mi_l))[order],
+            np.concatenate((vrows, aux)),
+            np.concatenate((span_v, span[aux])))
 
     def _prepare_group_fast(self, batch, span, s, e, rtype, final_len, jobs,
                             group_uniform=False, ordinal=None):
@@ -594,7 +692,6 @@ class FastSimplexCaller:
             return
 
         rows = np.arange(s, e)
-        umi = batch.tag_bytes(self.tag, int(span[s]))
         if opts.max_reads is not None and n_records > opts.max_reads:
             rng = np.random.Generator(
                 np.random.Philox(key=(opts.seed or 0) + ordinal))
@@ -658,8 +755,7 @@ class FastSimplexCaller:
                     continue
             lens_sorted = np.sort(lens)[::-1]
             consensus_len = int(lens_sorted[opts.min_reads - 1])
-            group_jobs[read_type] = _FastJob(
-                umi, read_type, t_rows, lens, consensus_len, span[t_rows])
+            group_jobs[read_type] = (read_type, t_rows, consensus_len)
 
         # orphan R1/R2 handling (vanilla.py:346-357)
         if FRAGMENT in group_jobs:
@@ -668,11 +764,11 @@ class FastSimplexCaller:
         if r1 is not None and r2 is not None:
             jobs.extend([r1, r2])
         elif r1 is not None:
-            stats.reject("OrphanConsensus", len(r1.rows))
-            rej(r1.rows)
+            stats.reject("OrphanConsensus", len(r1[1]))
+            rej(r1[1])
         elif r2 is not None:
-            stats.reject("OrphanConsensus", len(r2.rows))
-            rej(r2.rows)
+            stats.reject("OrphanConsensus", len(r2[1]))
+            rej(r2[1])
 
     def _alignment_filter(self, batch, span, t_rows, lens):
         """Non-uniform CIGARs: decode + simplify + truncate per read, then the
@@ -703,54 +799,59 @@ class FastSimplexCaller:
 
     # ------------------------------------------------------------------ device
 
-    def _dispatch_jobs(self, codes, quals, jobs):
+    def _dispatch_jobs(self, codes, quals, table):
         """One dense segment-sum kernel dispatch for the whole batch.
 
-        Single-read jobs run vectorized on host (table lookup); multi-read
-        jobs concatenate their packed read rows into a dense (N, L) layout
-        with sorted segment ids — one device execution and one uint16 fetch
-        per record batch, independent of family-size mix (per-execution relay
-        overhead dominates the compute on the tunnel-attached device). The
-        fetch + threshold + serialize half runs in _PendingChunk.resolve()
-        (SURVEY §7 step 4: host prep overlaps device compute and transfer).
-        Returns the pending tuple or None.
+        Single-read jobs run vectorized on host (one (S, L) gather + table
+        lookup); multi-read jobs concatenate their packed read rows into a
+        dense (N, L) layout with sorted segment ids — one device execution
+        and one uint16 fetch per record batch, independent of family-size
+        mix (per-execution relay overhead dominates the compute on the
+        tunnel-attached device). The fetch + threshold + serialize half runs
+        in _PendingChunk.resolve() (SURVEY §7 step 4: host prep overlaps
+        device compute and transfer). Returns (pending-or-None, host_blocks).
         """
         caller = self.caller
         opts = caller.options
         kernel = caller.kernel
+        count = table.count
+        blocks0 = []
 
-        multi = []
-        for j, job in enumerate(jobs):
-            if len(job.rows) == 1:
-                row = job.rows[0]
-                L = job.consensus_len
-                b, q, d, e = oracle.single_read_consensus(
-                    codes[row, :L], quals[row, :L], caller.tables,
-                    opts.min_consensus_base_quality)
-                job.result = (b, q, d.astype(np.int32), e.astype(np.int32))
-            else:
-                multi.append(j)
-        if not multi:
-            return None
+        single = np.nonzero(count == 1)[0]
+        if len(single):
+            rows1 = table.pool_rows[table.vlo[single]]
+            Lm = int(table.cons_len[single].max())
+            b, q, d, e = oracle.single_read_consensus(
+                codes[rows1, :Lm], quals[rows1, :Lm], caller.tables,
+                opts.min_consensus_base_quality)
+            blocks0.append((single, np.ascontiguousarray(b),
+                            np.ascontiguousarray(q),
+                            np.ascontiguousarray(d.astype(np.int32)),
+                            np.ascontiguousarray(e.astype(np.int32))))
 
-        counts = np.array([len(jobs[j].rows) for j in multi], dtype=np.int64)
-        starts = np.concatenate(([0], np.cumsum(counts)))
-        rows_all = np.concatenate([jobs[j].rows for j in multi])
+        multi = np.nonzero(count > 1)[0]
+        if len(multi) == 0:
+            return None, blocks0
+
+        counts = count[multi]
+        rows_all = table.pool_rows[_ranges(table.vlo[multi], counts)]
         # 16-multiple L >= every job's consensus length (<= the pack stride)
-        L_max = -(-max(jobs[j].consensus_len for j in multi) // 16) * 16
-        codes_d = np.ascontiguousarray(codes[rows_all, :L_max])
-        quals_d = np.ascontiguousarray(quals[rows_all, :L_max])
+        L_max = -(-int(table.cons_len[multi].max()) // 16) * 16
 
         if self.mesh is not None:
-            return self._dispatch_sharded(multi, counts, starts, codes_d,
-                                          quals_d, L_max)
+            starts = np.concatenate(([0], np.cumsum(counts)))
+            codes_d = np.ascontiguousarray(codes[rows_all, :L_max])
+            quals_d = np.ascontiguousarray(quals[rows_all, :L_max])
+            return (self._dispatch_sharded(multi, counts, starts, codes_d,
+                                           quals_d, L_max), blocks0)
 
-        from ..ops.kernel import pad_segments
+        from ..ops.kernel import pad_segments_gather
 
-        codes_dev, quals_dev, seg_ids, _, F_pad = pad_segments(
-            codes_d, quals_d, counts)
+        codes_dev, quals_dev, seg_ids, starts, F_pad, N = pad_segments_gather(
+            codes, quals, rows_all, L_max, counts)
         dev = kernel.device_call_segments(codes_dev, quals_dev, seg_ids, F_pad)
-        return ("seg", multi, starts, codes_d, quals_d, dev)
+        return ("seg", multi, starts, codes_dev[:N], quals_dev[:N],
+                dev), blocks0
 
     def _dispatch_sharded(self, multi, counts, starts, codes_d, quals_d,
                           L_max):
@@ -786,45 +887,22 @@ class FastSimplexCaller:
 
     # ------------------------------------------------------------------ output
 
-    def _serialize_jobs(self, batch, jobs, blocks=()) -> bytes:
+    def _serialize_jobs(self, batch, table, blocks=()) -> bytes:
         """Native batch serializer: all jobs -> one block_size-prefixed wire
         blob (fgumi_build_consensus_records; _build_record semantics).
-        `blocks` carries kernel-result rows for multi-read jobs (addresses
-        computed per block); host-path jobs carry per-job result arrays."""
+        `blocks` carries every job's result rows as whole blocks (addresses
+        computed per block); MI bytes resolve to pointers straight into the
+        batch buffer (table.mi_rec), no per-job copies."""
         caller = self.caller
         opts = caller.options
-        J = len(jobs)
-        lens = np.empty(J, dtype=np.int32)
-        flags = np.empty(J, dtype=np.int32)
+        J = len(table)
+        lens = np.ascontiguousarray(table.cons_len, dtype=np.int32)
+        flags = _TYPE_FLAGS_ARR[table.read_type]
         code_addr = np.empty(J, dtype=np.int64)
         qual_addr = np.empty(J, dtype=np.int64)
         depth_addr = np.empty(J, dtype=np.int64)
         err_addr = np.empty(J, dtype=np.int64)
-        mi_addr = np.empty(J, dtype=np.int64)
-        mi_len = np.empty(J, dtype=np.int32)
-        mi_parts = []
         keep_alive = []
-        m_off = 0
-        rx_vo, rx_vl, _ = batch.tag_locs_str(b"RX")
-        buf = batch.buf
-        surv_counts = np.empty(J, dtype=np.int64)
-        for j, job in enumerate(jobs):
-            lens[j] = job.consensus_len
-            flags[j] = _TYPE_FLAGS[job.read_type]
-            res = job.result
-            if res is not None:  # single-read / host-path arrays
-                b, q, d, e = res
-                keep_alive.append(res)
-                code_addr[j] = b.ctypes.data
-                qual_addr[j] = q.ctypes.data
-                depth_addr[j] = d.ctypes.data
-                err_addr[j] = e.ctypes.data
-            mi = job.umi_bytes
-            mi_parts.append(mi)
-            mi_addr[j] = m_off
-            mi_len[j] = len(mi)
-            m_off += len(mi)
-            surv_counts[j] = len(job.surviving_idx)
         for idxs, b, q, d, e in blocks:
             keep_alive.append((b, q, d, e))
             fi = np.arange(len(idxs), dtype=np.int64)
@@ -832,26 +910,35 @@ class FastSimplexCaller:
             qual_addr[idxs] = q.ctypes.data + fi * q.shape[1]
             depth_addr[idxs] = d.ctypes.data + fi * (4 * d.shape[1])
             err_addr[idxs] = e.ctypes.data + fi * (4 * e.shape[1])
-        mi_blob = np.frombuffer(b"".join(mi_parts) or b"\x00", dtype=np.uint8)
-        mi_addr += mi_blob.ctypes.data
+
+        buf = batch.buf
+        buf_base = buf.ctypes.data
+        mi_vo, mi_vl, _ = batch.tag_locs(self.tag)
+        mi_addr = np.ascontiguousarray(buf_base + mi_vo[table.mi_rec],
+                                       dtype=np.int64)
+        mi_len = np.ascontiguousarray(mi_vl[table.mi_rec], dtype=np.int32)
 
         # consensus RX from the surviving reads' RX tags (vanilla.py:460-464):
         # unanimity (the overwhelmingly common case) resolves natively to a
         # pointer into the batch buffer; only divergent families run the
         # Python likelihood consensus
+        rx_vo, rx_vl, _ = batch.tag_locs_str(b"RX")
+        surv_counts = table.count
         surv_starts = np.concatenate(([0], np.cumsum(surv_counts)))
-        surv_all = (np.concatenate([j.surviving_idx for j in jobs])
-                    if J else np.empty(0, dtype=np.int64))
+        surv_all = table.pool_span[_ranges(table.vlo, surv_counts)]
         rxo, rxl = nb.rx_unanimous(buf, rx_vo[surv_all], rx_vl[surv_all],
                                    surv_starts)
-        buf_base = buf.ctypes.data
         rx_addr = np.where(rxo >= 0, buf_base + rxo, 0)
         rx_len = np.where(rxo >= 0, rxl, 0).astype(np.int32)
         divergent = np.nonzero(rxo == -2)[0]
         if len(divergent):
-            fams = [[buf[rx_vo[i]: rx_vo[i] + rx_vl[i]].tobytes().decode()
-                     for i in jobs[j].surviving_idx if rx_vo[i] >= 0]
-                    for j in divergent]
+            fams = []
+            for j in divergent:
+                lo = int(table.vlo[j])
+                hi = lo + int(table.count[j])
+                fams.append(
+                    [buf[rx_vo[i]: rx_vo[i] + rx_vl[i]].tobytes().decode()
+                     for i in table.pool_span[lo:hi] if rx_vo[i] >= 0])
             for j, rx in zip(divergent, consensus_umis_batch(fams)):
                 rx_arr = np.frombuffer(rx.encode(), dtype=np.uint8)
                 keep_alive.append(rx_arr)

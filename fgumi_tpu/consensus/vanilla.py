@@ -451,6 +451,30 @@ class VanillaConsensusCaller(RejectTracking):
         L_max = -(-max(jobs[j].consensus_len for j in multi) // 16) * 16
         counts = np.array([len(jobs[j].codes) for j in multi], dtype=np.int64)
         N = int(counts.sum())
+
+        if N <= 64:
+            # Tiny workload (typically a batch-boundary carry group): call the
+            # f64 oracle on host. The device result is defined as oracle-
+            # integer-exact (guard band + suspect patch), so this is the same
+            # bytes — without a micro dispatch that would serialize behind the
+            # in-flight big batch on the device queue (round-4 profile: 0.6s
+            # of queue wait per boundary group, ~10% of simplex wall).
+            for j in multi:
+                job = jobs[j]
+                L = job.consensus_len
+                R = len(job.codes)
+                codes = np.full((R, L), N_CODE, dtype=np.uint8)
+                quals = np.zeros((R, L), dtype=np.uint8)
+                for r, (c, q) in enumerate(zip(job.codes, job.quals)):
+                    n = min(len(c), L)
+                    codes[r, :n] = c[:n]
+                    quals[r, :n] = q[:n]
+                w, q_, d, e = oracle.call_family(codes, quals, self.tables)
+                b_j, q_j = oracle.apply_consensus_thresholds(
+                    w, q_, d, self.options.min_reads,
+                    self.options.min_consensus_base_quality)
+                results[j] = (b_j, q_j, d, e)
+            return results
         codes2d = np.full((N, L_max), N_CODE, dtype=np.uint8)
         quals2d = np.zeros((N, L_max), dtype=np.uint8)
         row = 0

@@ -281,6 +281,12 @@ run("simplex_chain_s", ["simplex", "-i", j("grouped.bam"), "-o",
                         "--threads", sys.argv[3], "--allow-unmapped"])
 run("filter_s", ["filter", "-i", j("cons.bam"), "-o", j("filt.bam"),
                  "--min-reads", "3"])
+# the chained command (one process, level-0 intermediates) — how a user
+# would actually run BASELINE config 5 with this tool
+run("pipeline_cmd_s", ["pipeline", "-i", j("r1.fq.gz"), j("r2.fq.gz"),
+                       "-r", "8M+T", "+T", "-o", j("filt2.bam"),
+                       "--sample", "s", "--library", "l",
+                       "--threads", sys.argv[3]])
 print(json.dumps(out))
 """
         stage_fam = int(os.environ.get("BENCH_STAGE_FAMILIES", "40000"))
@@ -292,11 +298,14 @@ print(json.dumps(out))
             if stages is not None:
                 n_stage_reads = stage_fam * 10  # pairs * family size 5
                 total = sum(v for k, v in stages.items()
-                            if k != "e2e_simulate_s")
+                            if k not in ("e2e_simulate_s", "pipeline_cmd_s"))
                 stages_result["pipeline_stage_seconds"] = stages
                 stages_result["pipeline_e2e_reads_per_sec"] = round(
                     n_stage_reads / total, 1) if total else 0.0
                 stages_result["pipeline_e2e_input_reads"] = n_stage_reads
+                if stages.get("pipeline_cmd_s"):
+                    stages_result["pipeline_cmd_reads_per_sec"] = round(
+                        n_stage_reads / stages["pipeline_cmd_s"], 1)
             else:
                 stages_result["pipeline_diagnostics"] = [
                     f"stage bench failed: {serr}"]

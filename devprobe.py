@@ -320,9 +320,14 @@ def capture_evidence(out_path, n_families=20000):
                 evidence = json.load(f)
         except ValueError:
             evidence = {}
-    evidence.update({"captured_unix": int(time.time()),
-                     "captured_iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
-                                                   time.gmtime())})
+
+    def stamp():
+        # captured_unix marks the newest SUCCESSFUL section, so a later
+        # failed attempt cannot relabel old evidence as fresh (bench.py
+        # gates on this timestamp)
+        evidence["captured_unix"] = int(time.time())
+        evidence["captured_iso"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                 time.gmtime())
 
     def flush():
         with open(out_path + ".tmp", "w") as f:
@@ -332,6 +337,7 @@ def capture_evidence(out_path, n_families=20000):
     res, err = run_payload(KERNEL_BENCH, [REPO, 65536, 100, 5], 420)
     if res is not None and res.get("platform") != "cpu":
         evidence["kernel_tpu"] = res
+        stamp()
     else:
         evidence["kernel_err"] = err or f"cpu fallback: {res}"
     flush()
@@ -355,6 +361,7 @@ def capture_evidence(out_path, n_families=20000):
             evidence["simplex"] = dict(res, n_reads=n_reads,
                                        reads_per_sec=round(
                                            n_reads / res["wall_s"], 1))
+            stamp()
         else:
             evidence["simplex_err"] = err or f"cpu fallback: {res}"
         flush()
@@ -368,6 +375,7 @@ def capture_evidence(out_path, n_families=20000):
             evidence["duplex"] = dict(res, n_reads=n_dup,
                                       reads_per_sec=round(
                                           n_dup / res["wall_s"], 1))
+            stamp()
         else:
             evidence["duplex_err"] = err or f"cpu fallback: {res}"
         flush()

@@ -54,12 +54,26 @@ def _add_pipeline_compat(p):
     p.add_argument("--memory-per-thread", default=None, metavar="SIZE",
                    help="per-thread working-set budget; multiplied by the "
                         "thread count into --max-memory when that knob exists")
+    p.add_argument("--compression-level", type=int, default=None,
+                   metavar="N",
+                   help="BGZF level for BAM outputs, 0-12 (reference "
+                        "CompressionOptions, default 1; 0 = stored blocks)")
 
 
 def _apply_pipeline_compat(args):
     """Map accepted compat flags onto this engine's knobs (called once after
     parse_args; commands without the flags are untouched). Returns an exit
     code: 0, or 2 on an unparseable value."""
+    from .io import bam as bam_io
+
+    lvl = getattr(args, "compression_level", None)
+    if lvl is not None and not 0 <= lvl <= 12:
+        log.error("--compression-level %d: must be 0-12", lvl)
+        return 2
+    # set unconditionally: main() may be called many times in one process
+    # (the `pipeline` command chains stages), so a prior stage's level must
+    # not leak into the next
+    bam_io.DEFAULT_COMPRESSION_LEVEL = 1 if lvl is None else lvl
     if getattr(args, "memory_per_thread", None):
         from .utils.memory import parse_size
 
@@ -2120,6 +2134,107 @@ def cmd_dedup(args):
     return 0
 
 
+def _add_pipeline(sub):
+    p = sub.add_parser(
+        "pipeline",
+        help="FASTQ -> filtered consensus BAM: extract, sort, group, "
+             "simplex, filter chained in one process")
+    p.add_argument("-i", "--input", required=True, nargs="+",
+                   help="FASTQ file per sequencing read (R1 [R2 ...])")
+    p.add_argument("-r", "--read-structures", nargs="*", default=[],
+                   help="one per FASTQ, e.g. 8M12S+T (default +T)")
+    p.add_argument("-o", "--output", required=True,
+                   help="filtered consensus BAM")
+    p.add_argument("--sample", required=True)
+    p.add_argument("--library", required=True)
+    p.add_argument("-s", "--strategy", default="adjacency",
+                   help="UMI assignment strategy (group -s)")
+    p.add_argument("--consensus-min-reads", type=int, default=1,
+                   help="simplex --min-reads")
+    p.add_argument("--filter-min-reads", type=int, default=3,
+                   help="filter --min-reads")
+    p.add_argument("--threads", type=int, default=0,
+                   help="stage threads (simplex)")
+    p.add_argument("--keep-intermediates", default=None, metavar="DIR",
+                   help="write stage outputs here and keep them (default: "
+                        "temp dir, deleted as each stage is consumed)")
+    _add_pipeline_compat(p)
+    p.set_defaults(func=cmd_pipeline)
+
+
+def cmd_pipeline(args):
+    """FastqToConsensus best-practice chain in one process.
+
+    The reference ships this as a Snakemake workflow over separate fgumi
+    invocations (/root/reference/docs/FastqToConsensus-RnD.smk:1-40); running
+    the stages chained in-process keeps the JIT/compile caches warm across
+    stages and writes intermediate BAMs as stored (level-0) BGZF — each is
+    deleted as soon as the next stage has consumed it.
+    """
+    import shutil
+    import tempfile
+
+    out_dir = os.path.dirname(os.path.abspath(args.output)) or "."
+    keep = args.keep_intermediates
+    tmp = keep or tempfile.mkdtemp(prefix="fgumi_pipeline_", dir=out_dir)
+    if keep:
+        os.makedirs(tmp, exist_ok=True)
+
+    def j(name):
+        return os.path.join(tmp, name)
+
+    thr = ["--threads", str(args.threads)] if args.threads else []
+    lvl0 = ["--compression-level", "0"]
+    # user-facing compat flags forward to every stage; the user's
+    # --compression-level applies to the FINAL output only (intermediates
+    # stay level 0 by design — they are deleted as soon as they are read)
+    fwd = []
+    if args.memory_per_thread:
+        fwd += ["--memory-per-thread", args.memory_per_thread]
+    out_lvl = ([] if args.compression_level is None
+               else ["--compression-level", str(args.compression_level)])
+    rs = (["-r"] + args.read_structures) if args.read_structures else []
+    stages = [
+        ("extract", ["extract", "-i"] + args.input + rs +
+         ["-o", j("unmapped.bam"), "--sample", args.sample,
+          "--library", args.library] + lvl0 + fwd),
+        ("sort", ["sort", "-i", j("unmapped.bam"), "-o", j("sorted.bam"),
+                  "--order", "template-coordinate"] + lvl0 + fwd),
+        ("group", ["group", "-i", j("sorted.bam"), "-o", j("grouped.bam"),
+                   "-s", args.strategy, "--allow-unmapped"] + lvl0 + fwd),
+        ("simplex", ["simplex", "-i", j("grouped.bam"), "-o", j("cons.bam"),
+                     "--min-reads", str(args.consensus_min_reads),
+                     "--allow-unmapped"] + lvl0 + thr + fwd),
+        ("filter", ["filter", "-i", j("cons.bam"), "-o", args.output,
+                    "--min-reads", str(args.filter_min_reads)] + out_lvl
+         + fwd),
+    ]
+    consumed = {"sort": "unmapped.bam", "group": "sorted.bam",
+                "simplex": "grouped.bam", "filter": "cons.bam"}
+    try:
+        t00 = time.monotonic()
+        for name, argv in stages:
+            t0 = time.monotonic()
+            rc = main(argv)
+            if rc:
+                log.error("pipeline: stage %s failed (rc=%d)", name, rc)
+                return rc
+            log.info("pipeline: %s done in %.2fs", name,
+                     time.monotonic() - t0)
+            prev = consumed.get(name)
+            if prev and not keep:
+                try:
+                    os.unlink(j(prev))
+                except OSError:
+                    pass
+        log.info("pipeline: total %.2fs -> %s", time.monotonic() - t00,
+                 args.output)
+    finally:
+        if not keep:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return 0
+
+
 def build_parser():
     parser = argparse.ArgumentParser(
         prog="fgumi-tpu",
@@ -2146,6 +2261,7 @@ def build_parser():
     _add_fastq(sub)
     _add_downsample(sub)
     _add_simulate(sub)
+    _add_pipeline(sub)
     return parser
 
 
@@ -2156,9 +2272,6 @@ def main(argv=None):
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
-    from fgumi_tpu.utils.compile_cache import enable_persistent_cache
-
-    enable_persistent_cache()
     rc = _apply_pipeline_compat(args)
     if rc:
         return rc

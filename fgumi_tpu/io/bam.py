@@ -570,10 +570,19 @@ class BamIndexedReader:
         self.close()
 
 
+# process-wide default BGZF level for BamWriter (reference CompressionOptions
+# default 1, commands/common.rs); the CLI's --compression-level sets it per
+# invocation. Level 0 = stored blocks — used by the `pipeline` command for
+# intermediates that are read back immediately.
+DEFAULT_COMPRESSION_LEVEL = 1
+
+
 class BamWriter:
     """Sequential BAM writer over BGZF."""
 
-    def __init__(self, path_or_obj, header: BamHeader, level: int = 1):
+    def __init__(self, path_or_obj, header: BamHeader, level: int = None):
+        if level is None:
+            level = DEFAULT_COMPRESSION_LEVEL
         owns = isinstance(path_or_obj, str)
         fileobj = open(path_or_obj, "wb") if owns else path_or_obj
         self._w = BgzfWriter(fileobj, level=level, owns_fileobj=owns)

@@ -239,6 +239,10 @@ def _get_dist_jit():
         import jax
         import jax.numpy as jnp
 
+        from ..utils.compile_cache import enable_persistent_cache
+
+        enable_persistent_cache()  # cross-process reuse of the compiles
+
         # group/dedup runs reach the device only through this kernel, so the
         # persistent XLA cache must be enabled here too (first 16k-UMI group
         # otherwise pays the ~2s compile in every CLI invocation)
@@ -379,9 +383,9 @@ class SimpleErrorUmiAssigner:
 
 def _count_sorted_unique(upper, keys=None):
     """(unique_key, count) sorted by (-count, key). keys default to the UMIs."""
-    counts = {}
-    for u in (keys if keys is not None else upper):
-        counts[u] = counts.get(u, 0) + 1
+    from collections import Counter
+
+    counts = Counter(keys if keys is not None else upper)
     return sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))
 
 
@@ -431,8 +435,11 @@ class AdjacencyUmiAssigner:
         if not raw_umis:
             return []
         upper = [u.upper() for u in raw_umis]
-        valid_mask = [_is_encodable(u) for u in upper]
-        counted = _count_sorted_unique([u for u, v in zip(upper, valid_mask) if v])
+        # count first, validate per DISTINCT string: distinct UMIs are a
+        # small fraction of reads in large position groups, and the filtered
+        # list keeps the (-count, umi) order _count_sorted_unique establishes
+        counted = [(u, c) for u, c in _count_sorted_unique(upper)
+                   if _is_encodable(u)]
         if not counted:
             return _with_invalid_fallback(upper, lambda *_: None, self.counter)
         _assert_uniform_length(len(u) for u, _ in counted)
@@ -484,13 +491,21 @@ class PairedUmiAssigner:
     def assign(self, raw_umis):
         if not raw_umis:
             return []
-        for u in raw_umis:
-            self._split(u)  # validates exactly one '-'
         upper = [u.upper() for u in raw_umis]
-        valid_mask = [_is_encodable(u) for u in upper]
-        canon = [self._canonical(u) if v else None
-                 for u, v in zip(upper, valid_mask)]
-        counted = _count_sorted_unique([c for c in canon if c is not None])
+        # structure-validate, BitEnc-validate, and canonicalize per DISTINCT
+        # string (the '-' split is case-invariant, so distinct uppers cover
+        # every raw input); counts aggregate per canonical form exactly as
+        # the per-read pass did
+        counted_all = _count_sorted_unique(upper)
+        for u, _ in counted_all:
+            self._split(u)  # validates exactly one '-'
+        dvalid = {u for u, _ in counted_all if _is_encodable(u)}
+        canon_counts = {}
+        for u, c in counted_all:
+            if u in dvalid:
+                k = self._canonical(u)
+                canon_counts[k] = canon_counts.get(k, 0) + c
+        counted = sorted(canon_counts.items(), key=lambda kv: (-kv[1], kv[0]))
         if not counted:
             return _with_invalid_fallback(upper, lambda *_: None, self.counter)
 
@@ -534,7 +549,8 @@ class PairedUmiAssigner:
                         umi_to_id[u] = ba
                         umi_to_id[self._reverse(u)] = ab
         return _with_invalid_fallback(
-            upper, lambda i, u: umi_to_id.get(u) if valid_mask[i] else None, self.counter)
+            upper, lambda i, u: umi_to_id.get(u) if u in dvalid else None,
+            self.counter)
 
 
 def make_assigner(strategy: str, edits: int = 1):

@@ -149,3 +149,41 @@ def test_fastq_reads_duplex_mode(tmp_path):
         lines2 = f.read().split(b"\n")
     # both reads carry an 8bp UMI prefix + 100bp body
     assert len(lines1[1]) == 108 and len(lines2[1]) == 108
+
+
+def test_pipeline_command_matches_stage_chain(fastq_inputs, tmp_path):
+    """`pipeline` (one process, level-0 intermediates) produces the same
+    records as the equivalent separate-stage chain (sort included on both
+    sides; only @PG header lines may differ)."""
+    r1, r2, _ = fastq_inputs
+    # stage chain WITH sort, mirroring the pipeline command's stages
+    unmapped = str(tmp_path / "sc_unmapped.bam")
+    srt = str(tmp_path / "sc_sorted.bam")
+    grouped = str(tmp_path / "sc_grouped.bam")
+    cons = str(tmp_path / "sc_cons.bam")
+    filt = str(tmp_path / "sc_filt.bam")
+    assert cli_main(["extract", "-i", r1, r2, "-r", "8M+T", "+T",
+                     "--sample", "s", "--library", "l", "-o", unmapped]) == 0
+    assert cli_main(["sort", "-i", unmapped, "-o", srt,
+                     "--order", "template-coordinate"]) == 0
+    assert cli_main(["group", "-i", srt, "-o", grouped,
+                     "--allow-unmapped"]) == 0
+    assert cli_main(["simplex", "-i", grouped, "-o", cons,
+                     "--allow-unmapped", "--min-reads", "1"]) == 0
+    assert cli_main(["filter", "-i", cons, "-o", filt, "-M", "2"]) == 0
+
+    out = str(tmp_path / "pl_filt.bam")
+    keep = str(tmp_path / "pl_keep")
+    assert cli_main(["pipeline", "-i", r1, r2, "-r", "8M+T", "+T",
+                     "--sample", "s", "--library", "l", "-o", out,
+                     "--filter-min-reads", "2",
+                     "--keep-intermediates", keep]) == 0
+
+    with BamReader(filt) as a, BamReader(out) as b:
+        recs_a = [r.data for r in a]
+        recs_b = [r.data for r in b]
+    assert len(recs_a) == len(recs_b) and recs_a == recs_b
+
+    # intermediates kept on request, and final output is level-1 (not stored)
+    import os
+    assert os.path.exists(os.path.join(keep, "grouped.bam"))

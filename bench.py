@@ -444,13 +444,29 @@ print(json.dumps(out))
                 evidence = json.load(f)
         except ValueError:
             evidence = None
-        if evidence and (time.time() - evidence.get("captured_unix", 0)
-                         > 16 * 3600):
-            result["tpu_evidence_stale"] = evidence.get("captured_iso", "?")
-            evidence = None
+        if evidence:
+            # freshness is PER SECTION (each successful capture stamps its
+            # own t_unix): a stale section from a previous round must not be
+            # relabeled by a later partial capture
+            cutoff = time.time() - 16 * 3600
+
+            def fresh(section):
+                sec = evidence.get(section)
+                return (sec is not None
+                        and sec.get("t_unix",
+                                    evidence.get("captured_unix", 0))
+                        >= cutoff)
+
+            stale = [s for s in ("kernel_tpu", "simplex", "duplex")
+                     if s in evidence and not fresh(s)]
+            if stale:
+                result["tpu_evidence_stale_sections"] = stale
+            if not any(fresh(s) for s in ("kernel_tpu", "simplex",
+                                          "duplex")):
+                evidence = None
         if evidence:
             result["tpu_evidence_session"] = evidence
-            if trier.kernel is None and "kernel_tpu" in evidence:
+            if trier.kernel is None and fresh("kernel_tpu"):
                 result["kernel_tpu"] = dict(
                     evidence["kernel_tpu"],
                     note="captured by in-session probe loop at "
@@ -459,7 +475,7 @@ print(json.dumps(out))
                     result["kernel_vs_cpu"] = round(
                         result["kernel_tpu"]["kernel_reads_per_sec"]
                         / kernel_cpu["kernel_reads_per_sec"], 3)
-            if tpu is None and "simplex" in evidence:
+            if tpu is None and fresh("simplex"):
                 # distinct keys, NOT the headline value/vs_baseline: the
                 # session run used its own (smaller) workload and thread
                 # count, so the ratio is indicative, not the metric

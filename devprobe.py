@@ -336,7 +336,7 @@ def capture_evidence(out_path, n_families=20000):
 
     res, err = run_payload(KERNEL_BENCH, [REPO, 65536, 100, 5], 420)
     if res is not None and res.get("platform") != "cpu":
-        evidence["kernel_tpu"] = res
+        evidence["kernel_tpu"] = dict(res, t_unix=int(time.time()))
         stamp()
     else:
         evidence["kernel_err"] = err or f"cpu fallback: {res}"
@@ -359,6 +359,7 @@ def capture_evidence(out_path, n_families=20000):
         res, err = run_payload(_PIPELINE_RUN, [REPO, sim, tmp, "simplex"], 600)
         if res is not None and res.get("platform") != "cpu":
             evidence["simplex"] = dict(res, n_reads=n_reads,
+                                       t_unix=int(time.time()),
                                        reads_per_sec=round(
                                            n_reads / res["wall_s"], 1))
             stamp()
@@ -373,6 +374,7 @@ def capture_evidence(out_path, n_families=20000):
         res, err = run_payload(_PIPELINE_RUN, [REPO, dup, tmp, "duplex"], 600)
         if res is not None and res.get("platform") != "cpu":
             evidence["duplex"] = dict(res, n_reads=n_dup,
+                                      t_unix=int(time.time()),
                                       reads_per_sec=round(
                                           n_dup / res["wall_s"], 1))
             stamp()

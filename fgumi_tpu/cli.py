@@ -44,10 +44,10 @@ def _add_pipeline_compat(p):
                         "per-stage timing table")
     p.add_argument("--deadlock-timeout", type=float, default=60.0,
                    metavar="SECONDS",
-                   help="accepted for compatibility (bounded queues with a "
-                        "stop event cannot deadlock)")
+                   help="stall-watchdog check interval for threaded runs")
     p.add_argument("--deadlock-recover", action="store_true",
-                   help="accepted for compatibility")
+                   help="double queue/byte limits when the watchdog detects "
+                        "a stall (reference deadlock.rs:409)")
     p.add_argument("--async-reader", action="store_true",
                    help="accepted for compatibility (the reader thread is "
                         "already asynchronous when --threads >= 2)")
@@ -107,8 +107,8 @@ def _apply_pipeline_compat(args):
                  "engine uses a fixed reader->process->writer schedule",
                  args.scheduler)
     if getattr(args, "deadlock_recover", False):
-        log.info("--deadlock-recover: accepted for compatibility; bounded "
-                 "queues with a stop event cannot deadlock")
+        log.info("--deadlock-recover: stall watchdog will double queue/byte "
+                 "limits on each stall (reference deadlock.rs:409)")
     if getattr(args, "pipeline_stats", False):
         if hasattr(args, "stats"):
             args.stats = True
@@ -124,6 +124,30 @@ def _apply_pipeline_compat(args):
             log.info("--async-reader: accepted for compatibility (this "
                      "command reads inline)")
     return 0
+
+
+def _stage_kwargs(args):
+    """run_stages kwargs from the shared pipeline flags: byte-accurate input
+    queue governance from --max-memory (reference QueueMemoryOptions,
+    commands/common.rs:759-993) and watchdog interval/recovery (deadlock.rs).
+    """
+    wi = getattr(args, "deadlock_timeout", None)
+    kw = {
+        # 0 means "watchdog off" (run_stages contract), so no `or`-defaulting
+        "watchdog_interval": 120.0 if wi is None else wi,
+        "deadlock_recover": getattr(args, "deadlock_recover", False),
+    }
+    mm = getattr(args, "max_memory", None)
+    if mm is not None:
+        from .utils.memory import resolve_budget
+
+        # half the budget governs queued input batches; the rest covers the
+        # process stage's padded device arrays and pending output chunks
+        kw["max_bytes"] = max(resolve_budget(mm) // 2, 1 << 20)
+        # a queued batch's working set: decompressed buffer + decoded SoA
+        # columns + padded device gathers ~= 3x the raw bytes
+        kw["item_bytes"] = lambda b: 3 * b.buf.nbytes
+    return kw
 
 
 def _print_stats(stats, wall_s=None):
@@ -350,7 +374,8 @@ def cmd_simplex(args):
                     run_stages(
                         iter(reader), _process, writer.write_serialized,
                         threads=args.threads, queue_items=queue_items,
-                        stats=stats, resolve_fn=resolve_chunk)
+                        stats=stats, resolve_fn=resolve_chunk,
+                        **_stage_kwargs(args))
                     for blob in fast.flush():
                         writer.write_serialized(resolve_chunk(blob))
                     rejects.drain(caller)
@@ -503,7 +528,7 @@ def cmd_duplex(args):
                 run_stages(
                     iter(reader), _process, writer.write_serialized,
                     threads=args.threads, stats=stats_t,
-                    resolve_fn=resolve_chunk)
+                    resolve_fn=resolve_chunk, **_stage_kwargs(args))
                 for blob in fast.flush():
                     writer.write_serialized(resolve_chunk(blob))
         progress.finish()
@@ -776,7 +801,8 @@ def cmd_codec(args):
 
             with BamWriter(args.output, out_header) as writer:
                 run_stages(iter(reader), _process, writer.write_serialized,
-                           threads=args.threads, stats=stats_t)
+                           threads=args.threads, stats=stats_t,
+                           **_stage_kwargs(args))
                 for chunk in fast.flush():
                     writer.write_serialized(chunk)
                 n_out = caller.stats.consensus_reads_generated
@@ -830,6 +856,10 @@ def _add_group(sub):
     p.add_argument("-i", "--input", required=True,
                    help="template-coordinate sorted BAM with RX tags")
     p.add_argument("-o", "--output", required=True)
+    p.add_argument("--max-memory", default="auto",
+                   help="pipeline working-set budget (MiB count, human "
+                        "size, or auto): bytes-in-flight bound on queued "
+                        "batches in threaded runs")
     p.add_argument("-s", "--strategy", default="adjacency",
                    choices=["identity", "edit", "adjacency", "paired"])
     p.add_argument("-e", "--edits", type=int, default=1)
@@ -908,7 +938,8 @@ def cmd_group(args):
                         allow_unmapped=args.allow_unmapped)
                     run_stages(iter(reader), grouper.process_batch,
                                writer.write_serialized,
-                               threads=args.threads, stats=stats_t)
+                               threads=args.threads, stats=stats_t,
+                               **_stage_kwargs(args))
                     for chunk in grouper.flush():
                         writer.write_serialized(chunk)
                     result = grouper.result()
@@ -2015,6 +2046,10 @@ def _add_dedup(sub):
     p.add_argument("-i", "--input", required=True,
                    help="template-coordinate sorted BAM (zipper + sort)")
     p.add_argument("-o", "--output", required=True)
+    p.add_argument("--max-memory", default="auto",
+                   help="pipeline working-set budget (MiB count, human "
+                        "size, or auto): bytes-in-flight bound on queued "
+                        "batches in threaded runs")
     p.add_argument("-m", "--metrics", default=None, help="dedup metrics TSV")
     p.add_argument("-H", "--family-size-histogram", default=None)
     p.add_argument("-r", "--remove-duplicates", action="store_true",
@@ -2095,7 +2130,8 @@ def cmd_dedup(args):
                         remove_duplicates=args.remove_duplicates)
                     run_stages(iter(reader), dd.process_batch,
                                writer.write_serialized,
-                               threads=args.threads, stats=stats_t)
+                               threads=args.threads, stats=stats_t,
+                               **_stage_kwargs(args))
                     for chunk in dd.flush():
                         writer.write_serialized(chunk)
                     metrics, family_sizes = dd.result()

@@ -41,6 +41,139 @@ def _build() -> bool:
     return True
 
 
+def _declare(lib):
+    """ctypes restype/argtypes for every export (one copy, used by both
+    the cached-build path and the FGUMI_TPU_NATIVE_SO override)."""
+    lib.fgumi_bgzf_decompress.restype = ctypes.c_long
+    lib.fgumi_bgzf_decompress.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_long)]
+    lib.fgumi_bgzf_compress_block.restype = ctypes.c_long
+    lib.fgumi_bgzf_compress_block.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_long]
+    lib.fgumi_zlib_compress.restype = ctypes.c_long
+    lib.fgumi_zlib_compress.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_long]
+    lib.fgumi_zlib_decompress.restype = ctypes.c_long
+    lib.fgumi_zlib_decompress.argtypes = [
+        ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long]
+    lib.fgumi_find_record_boundaries.restype = ctypes.c_long
+    lib.fgumi_find_record_boundaries.argtypes = [
+        ctypes.c_char_p, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_long,
+        ctypes.POINTER(ctypes.c_int64)]
+    # batch record layer: all pointers passed as raw addresses (numpy
+    # array .ctypes.data); see fgumi_tpu/native/batch.py wrappers.
+    p = ctypes.c_void_p
+    lib.fgumi_decode_fields.restype = None
+    lib.fgumi_decode_fields.argtypes = [p, p, ctypes.c_long] + [p] * 12
+    lib.fgumi_scan_tags.restype = None
+    lib.fgumi_scan_tags.argtypes = [p, p, p, ctypes.c_long, p,
+                                    ctypes.c_long, p, p, p]
+    lib.fgumi_group_starts.restype = ctypes.c_long
+    lib.fgumi_group_starts.argtypes = [p, p, p, ctypes.c_long, p]
+    lib.fgumi_pack_reads.restype = None
+    lib.fgumi_pack_reads.argtypes = [p, p, p, p, p, p, ctypes.c_long,
+                                     ctypes.c_int, ctypes.c_long,
+                                     ctypes.c_int, p, p, p]
+    lib.fgumi_mate_clips.restype = None
+    lib.fgumi_mate_clips.argtypes = [p] * 11 + [ctypes.c_long, p]
+    lib.fgumi_overlap_correct_pairs.restype = None
+    lib.fgumi_overlap_correct_pairs.argtypes = [
+        p, p, p, ctypes.c_long, ctypes.c_int, ctypes.c_int, p]
+    lib.fgumi_build_consensus_records.restype = ctypes.c_long
+    lib.fgumi_build_consensus_records.argtypes = (
+        [p] * 6 + [ctypes.c_long, p, ctypes.c_int, p, p, p, p, p,
+                   ctypes.c_int, ctypes.c_int, p, ctypes.c_long, p])
+    lib.fgumi_build_duplex_records.restype = ctypes.c_long
+    lib.fgumi_build_duplex_records.argtypes = (
+        [p] * 5 + [ctypes.c_long, p, ctypes.c_int, p, p]
+        + [p] * 5 + [p] * 6 + [p, p, p, ctypes.c_int, ctypes.c_int,
+                               p, ctypes.c_long, p])
+    lib.fgumi_build_codec_records.restype = ctypes.c_long
+    lib.fgumi_build_codec_records.argtypes = (
+        [p] * 11 + [p, ctypes.c_long] + [p] * 6
+        + [p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+           p, ctypes.c_long, p])
+    lib.fgumi_segment_depth_errors.restype = None
+    lib.fgumi_segment_depth_errors.argtypes = (
+        [p, p, p, ctypes.c_long, ctypes.c_long, p, p])
+    lib.fgumi_segment_depth_errors_ranges.restype = None
+    lib.fgumi_segment_depth_errors_ranges.argtypes = (
+        [p, p, p, p, ctypes.c_long, ctypes.c_long, p, p])
+    lib.fgumi_ranges_equal.restype = None
+    lib.fgumi_ranges_equal.argtypes = [p] * 5 + [ctypes.c_long, p]
+    lib.fgumi_hash_ranges.restype = None
+    lib.fgumi_hash_ranges.argtypes = [p, p, p, ctypes.c_long, p]
+    lib.fgumi_template_coord_keys.restype = ctypes.c_long
+    lib.fgumi_template_coord_keys.argtypes = (
+        [p] * 15 + [ctypes.c_long, p, p])
+    lib.fgumi_natural_name_keys.restype = ctypes.c_long
+    lib.fgumi_natural_name_keys.argtypes = (
+        [p] * 4 + [ctypes.c_long, p, p, p])
+    lib.fgumi_unclipped_5prime.restype = None
+    lib.fgumi_unclipped_5prime.argtypes = [p] * 5 + [ctypes.c_long, p]
+    lib.fgumi_umi_scan.restype = None
+    lib.fgumi_umi_scan.argtypes = [p, p, p, ctypes.c_long, p, p, p]
+    lib.fgumi_rewrite_tag_records.restype = ctypes.c_long
+    lib.fgumi_rewrite_tag_records.argtypes = (
+        [p] * 4 + [ctypes.c_long, ctypes.c_ubyte, ctypes.c_ubyte]
+        + [p] * 5)
+    lib.fgumi_qual_scores.restype = None
+    lib.fgumi_qual_scores.argtypes = (
+        [p, p, p, ctypes.c_long, ctypes.c_int, ctypes.c_long, p])
+    lib.fgumi_gather_u16_arrays.restype = None
+    lib.fgumi_gather_u16_arrays.argtypes = (
+        [p, p, ctypes.c_long, ctypes.c_long, p, p])
+    lib.fgumi_apply_masks.restype = None
+    lib.fgumi_apply_masks.argtypes = (
+        [p, p, p, p, ctypes.c_long, p, ctypes.c_long, ctypes.c_int,
+         p, p])
+    lib.fgumi_rx_unanimous.restype = None
+    lib.fgumi_rx_unanimous.argtypes = [p, p, p, p, ctypes.c_long, p, p]
+    lib.fgumi_extract_records.restype = ctypes.c_long
+    lib.fgumi_extract_records.argtypes = (
+        [ctypes.c_long, ctypes.c_long] + [p] * 6 + [ctypes.c_long]
+        + [p] * 3 + [ctypes.c_int, p, ctypes.c_int, ctypes.c_int, p,
+                     ctypes.c_long, p])
+    lib.fgumi_ref_spans.restype = None
+    lib.fgumi_ref_spans.argtypes = [p, p, p, p, ctypes.c_long, p]
+    lib.fgumi_concat_spans.restype = ctypes.c_long
+    lib.fgumi_concat_spans.argtypes = [p, p, p, p, ctypes.c_long, p, p]
+    lib.fgumi_tag_name_list.restype = None
+    lib.fgumi_tag_name_list.argtypes = [p, p, p, ctypes.c_long,
+                                        ctypes.c_long, p, p]
+    lib.fgumi_cigar_strings.restype = ctypes.c_long
+    lib.fgumi_cigar_strings.argtypes = [p, p, p, ctypes.c_long, p, p]
+    lib.fgumi_rebuild_aux_records.restype = ctypes.c_long
+    lib.fgumi_rebuild_aux_records.argtypes = [p] * 4 + [ctypes.c_long] \
+        + [p] * 6
+    lib.fgumi_bgzf_compress_many.restype = ctypes.c_long
+    lib.fgumi_bgzf_compress_many.argtypes = [
+        p, ctypes.c_long, ctypes.c_int, ctypes.c_int, p, ctypes.c_long,
+        ctypes.c_long, p, ctypes.POINTER(ctypes.c_long)]
+    lib.fgumi_sort_spans.restype = None
+    lib.fgumi_sort_spans.argtypes = [p, p, p, ctypes.c_long, p]
+    lib.fgumi_gather_spans.restype = ctypes.c_long
+    lib.fgumi_gather_spans.argtypes = [p, p, p, p, ctypes.c_long, p]
+    lib.fgumi_write_run.restype = ctypes.c_long
+    lib.fgumi_write_run.argtypes = (
+        [ctypes.c_char_p] + [p] * 7 + [ctypes.c_long, ctypes.c_long,
+                                       ctypes.c_int])
+    lib.fgumi_merge_open.restype = ctypes.c_void_p
+    lib.fgumi_merge_open.argtypes = [ctypes.c_char_p, ctypes.c_long,
+                                     ctypes.c_long]
+    lib.fgumi_merge_next.restype = ctypes.c_long
+    lib.fgumi_merge_next.argtypes = [
+        ctypes.c_void_p, p, ctypes.c_long, p, ctypes.c_long,
+        ctypes.POINTER(ctypes.c_long)]
+    lib.fgumi_merge_close.restype = None
+    lib.fgumi_merge_close.argtypes = [ctypes.c_void_p]
+
+
+
 def get_lib():
     """The loaded native library, or None (pure-Python fallback)."""
     global _lib, _lib_failed
@@ -52,6 +185,31 @@ def get_lib():
         if os.environ.get("FGUMI_TPU_NO_NATIVE"):
             _lib_failed = True
             return None
+        override = os.environ.get("FGUMI_TPU_NATIVE_SO")
+        if override:
+            # explicit prebuilt library (e.g. the ASAN/UBSAN test lane):
+            # load it as-is — no rebuild fallback, loud failure
+            try:
+                lib = ctypes.CDLL(override)
+            except OSError as e:
+                log.warning("FGUMI_TPU_NATIVE_SO=%s failed to load: %s",
+                            override, e)
+                _lib_failed = True
+                return None
+            if not hasattr(lib, "fgumi_abi_version"):
+                log.warning("FGUMI_TPU_NATIVE_SO=%s lacks fgumi_abi_version",
+                            override)
+                _lib_failed = True
+                return None
+            lib.fgumi_abi_version.restype = ctypes.c_long
+            if lib.fgumi_abi_version() != _ABI_VERSION:
+                log.warning("FGUMI_TPU_NATIVE_SO=%s ABI %d != expected %d",
+                            override, lib.fgumi_abi_version(), _ABI_VERSION)
+                _lib_failed = True
+                return None
+            _declare(lib)
+            _lib = lib
+            return _lib
         if not os.path.exists(_SO_PATH) or (
                 os.path.exists(_SRC_PATH)
                 and os.path.getmtime(_SRC_PATH) > os.path.getmtime(_SO_PATH)):
@@ -87,133 +245,7 @@ def get_lib():
             if not _abi_ok(lib):
                 _lib_failed = True
                 return None
-        lib.fgumi_bgzf_decompress.restype = ctypes.c_long
-        lib.fgumi_bgzf_decompress.argtypes = [
-            ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long,
-            ctypes.POINTER(ctypes.c_long)]
-        lib.fgumi_bgzf_compress_block.restype = ctypes.c_long
-        lib.fgumi_bgzf_compress_block.argtypes = [
-            ctypes.c_char_p, ctypes.c_long, ctypes.c_int, ctypes.c_char_p,
-            ctypes.c_long]
-        lib.fgumi_zlib_compress.restype = ctypes.c_long
-        lib.fgumi_zlib_compress.argtypes = [
-            ctypes.c_char_p, ctypes.c_long, ctypes.c_int, ctypes.c_char_p,
-            ctypes.c_long]
-        lib.fgumi_zlib_decompress.restype = ctypes.c_long
-        lib.fgumi_zlib_decompress.argtypes = [
-            ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long]
-        lib.fgumi_find_record_boundaries.restype = ctypes.c_long
-        lib.fgumi_find_record_boundaries.argtypes = [
-            ctypes.c_char_p, ctypes.c_long,
-            ctypes.POINTER(ctypes.c_int64), ctypes.c_long,
-            ctypes.POINTER(ctypes.c_int64)]
-        # batch record layer: all pointers passed as raw addresses (numpy
-        # array .ctypes.data); see fgumi_tpu/native/batch.py wrappers.
-        p = ctypes.c_void_p
-        lib.fgumi_decode_fields.restype = None
-        lib.fgumi_decode_fields.argtypes = [p, p, ctypes.c_long] + [p] * 12
-        lib.fgumi_scan_tags.restype = None
-        lib.fgumi_scan_tags.argtypes = [p, p, p, ctypes.c_long, p,
-                                        ctypes.c_long, p, p, p]
-        lib.fgumi_group_starts.restype = ctypes.c_long
-        lib.fgumi_group_starts.argtypes = [p, p, p, ctypes.c_long, p]
-        lib.fgumi_pack_reads.restype = None
-        lib.fgumi_pack_reads.argtypes = [p, p, p, p, p, p, ctypes.c_long,
-                                         ctypes.c_int, ctypes.c_long,
-                                         ctypes.c_int, p, p, p]
-        lib.fgumi_mate_clips.restype = None
-        lib.fgumi_mate_clips.argtypes = [p] * 11 + [ctypes.c_long, p]
-        lib.fgumi_overlap_correct_pairs.restype = None
-        lib.fgumi_overlap_correct_pairs.argtypes = [
-            p, p, p, ctypes.c_long, ctypes.c_int, ctypes.c_int, p]
-        lib.fgumi_build_consensus_records.restype = ctypes.c_long
-        lib.fgumi_build_consensus_records.argtypes = (
-            [p] * 6 + [ctypes.c_long, p, ctypes.c_int, p, p, p, p, p,
-                       ctypes.c_int, ctypes.c_int, p, ctypes.c_long, p])
-        lib.fgumi_build_duplex_records.restype = ctypes.c_long
-        lib.fgumi_build_duplex_records.argtypes = (
-            [p] * 5 + [ctypes.c_long, p, ctypes.c_int, p, p]
-            + [p] * 5 + [p] * 6 + [p, p, p, ctypes.c_int, ctypes.c_int,
-                                   p, ctypes.c_long, p])
-        lib.fgumi_build_codec_records.restype = ctypes.c_long
-        lib.fgumi_build_codec_records.argtypes = (
-            [p] * 11 + [p, ctypes.c_long] + [p] * 6
-            + [p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-               p, ctypes.c_long, p])
-        lib.fgumi_segment_depth_errors.restype = None
-        lib.fgumi_segment_depth_errors.argtypes = (
-            [p, p, p, ctypes.c_long, ctypes.c_long, p, p])
-        lib.fgumi_segment_depth_errors_ranges.restype = None
-        lib.fgumi_segment_depth_errors_ranges.argtypes = (
-            [p, p, p, p, ctypes.c_long, ctypes.c_long, p, p])
-        lib.fgumi_ranges_equal.restype = None
-        lib.fgumi_ranges_equal.argtypes = [p] * 5 + [ctypes.c_long, p]
-        lib.fgumi_hash_ranges.restype = None
-        lib.fgumi_hash_ranges.argtypes = [p, p, p, ctypes.c_long, p]
-        lib.fgumi_template_coord_keys.restype = ctypes.c_long
-        lib.fgumi_template_coord_keys.argtypes = (
-            [p] * 15 + [ctypes.c_long, p, p])
-        lib.fgumi_natural_name_keys.restype = ctypes.c_long
-        lib.fgumi_natural_name_keys.argtypes = (
-            [p] * 4 + [ctypes.c_long, p, p, p])
-        lib.fgumi_unclipped_5prime.restype = None
-        lib.fgumi_unclipped_5prime.argtypes = [p] * 5 + [ctypes.c_long, p]
-        lib.fgumi_umi_scan.restype = None
-        lib.fgumi_umi_scan.argtypes = [p, p, p, ctypes.c_long, p, p, p]
-        lib.fgumi_rewrite_tag_records.restype = ctypes.c_long
-        lib.fgumi_rewrite_tag_records.argtypes = (
-            [p] * 4 + [ctypes.c_long, ctypes.c_ubyte, ctypes.c_ubyte]
-            + [p] * 5)
-        lib.fgumi_qual_scores.restype = None
-        lib.fgumi_qual_scores.argtypes = (
-            [p, p, p, ctypes.c_long, ctypes.c_int, ctypes.c_long, p])
-        lib.fgumi_gather_u16_arrays.restype = None
-        lib.fgumi_gather_u16_arrays.argtypes = (
-            [p, p, ctypes.c_long, ctypes.c_long, p, p])
-        lib.fgumi_apply_masks.restype = None
-        lib.fgumi_apply_masks.argtypes = (
-            [p, p, p, p, ctypes.c_long, p, ctypes.c_long, ctypes.c_int,
-             p, p])
-        lib.fgumi_rx_unanimous.restype = None
-        lib.fgumi_rx_unanimous.argtypes = [p, p, p, p, ctypes.c_long, p, p]
-        lib.fgumi_extract_records.restype = ctypes.c_long
-        lib.fgumi_extract_records.argtypes = (
-            [ctypes.c_long, ctypes.c_long] + [p] * 6 + [ctypes.c_long]
-            + [p] * 3 + [ctypes.c_int, p, ctypes.c_int, ctypes.c_int, p,
-                         ctypes.c_long, p])
-        lib.fgumi_ref_spans.restype = None
-        lib.fgumi_ref_spans.argtypes = [p, p, p, p, ctypes.c_long, p]
-        lib.fgumi_concat_spans.restype = ctypes.c_long
-        lib.fgumi_concat_spans.argtypes = [p, p, p, p, ctypes.c_long, p, p]
-        lib.fgumi_tag_name_list.restype = None
-        lib.fgumi_tag_name_list.argtypes = [p, p, p, ctypes.c_long,
-                                            ctypes.c_long, p, p]
-        lib.fgumi_cigar_strings.restype = ctypes.c_long
-        lib.fgumi_cigar_strings.argtypes = [p, p, p, ctypes.c_long, p, p]
-        lib.fgumi_rebuild_aux_records.restype = ctypes.c_long
-        lib.fgumi_rebuild_aux_records.argtypes = [p] * 4 + [ctypes.c_long] \
-            + [p] * 6
-        lib.fgumi_bgzf_compress_many.restype = ctypes.c_long
-        lib.fgumi_bgzf_compress_many.argtypes = [
-            p, ctypes.c_long, ctypes.c_int, ctypes.c_int, p, ctypes.c_long,
-            ctypes.c_long, p, ctypes.POINTER(ctypes.c_long)]
-        lib.fgumi_sort_spans.restype = None
-        lib.fgumi_sort_spans.argtypes = [p, p, p, ctypes.c_long, p]
-        lib.fgumi_gather_spans.restype = ctypes.c_long
-        lib.fgumi_gather_spans.argtypes = [p, p, p, p, ctypes.c_long, p]
-        lib.fgumi_write_run.restype = ctypes.c_long
-        lib.fgumi_write_run.argtypes = (
-            [ctypes.c_char_p] + [p] * 7 + [ctypes.c_long, ctypes.c_long,
-                                           ctypes.c_int])
-        lib.fgumi_merge_open.restype = ctypes.c_void_p
-        lib.fgumi_merge_open.argtypes = [ctypes.c_char_p, ctypes.c_long,
-                                         ctypes.c_long]
-        lib.fgumi_merge_next.restype = ctypes.c_long
-        lib.fgumi_merge_next.argtypes = [
-            ctypes.c_void_p, p, ctypes.c_long, p, ctypes.c_long,
-            ctypes.POINTER(ctypes.c_long)]
-        lib.fgumi_merge_close.restype = None
-        lib.fgumi_merge_close.argtypes = [ctypes.c_void_p]
+        _declare(lib)
         _lib = lib
         log.debug("native library loaded from %s", _SO_PATH)
         return _lib

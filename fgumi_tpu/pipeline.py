@@ -54,18 +54,62 @@ class _Err:
 _DONE = object()
 
 
+class _ByteBudget:
+    """Bytes-in-flight governor for the input queue (the byte-accurate
+    analog of the reference's MemoryTracker hysteresis, base.rs:466-625):
+    producers block while admitting another item would exceed the limit,
+    except that one item is always admitted (an oversized batch degrades to
+    serial flow instead of deadlocking). limit <= 0 disables accounting."""
+
+    def __init__(self, limit: int):
+        self.limit = int(limit)
+        self.used = 0
+        self.peak = 0
+        self._cv = threading.Condition()
+
+    def acquire(self, n: int, stop) -> bool:
+        if self.limit <= 0:
+            return True
+        with self._cv:
+            while self.used > 0 and self.used + n > self.limit:
+                if stop.is_set():
+                    return False
+                self._cv.wait(0.1)
+            self.used += n
+            self.peak = max(self.peak, self.used)
+            return True
+
+    def release(self, n: int):
+        if self.limit <= 0:
+            return
+        with self._cv:
+            self.used -= n
+            self._cv.notify_all()
+
+    def widen(self, factor: int = 2):
+        with self._cv:
+            self.limit *= factor
+            self._cv.notify_all()
+
+
 class _Watchdog:
     """Stall detector for the threaded pipeline (deadlock-watchdog-lite,
     reference deadlock.rs:1-60): a daemon timer samples the stage counters
     every `interval` seconds; when no stage made progress between samples
     while work remains, it logs a queue/stage snapshot so a wedged run is
-    diagnosable from the log instead of silent."""
+    diagnosable from the log instead of silent. With recover=True it also
+    doubles the queue and byte limits on each stall (the reference's
+    --deadlock-recover adaptive widening, deadlock.rs:409)."""
 
-    def __init__(self, counters, q_in, q_out, interval: float):
+    def __init__(self, counters, q_in, q_out, interval: float,
+                 recover: bool = False, budget: "_ByteBudget" = None):
         self._counters = counters
         self._q_in = q_in
         self._q_out = q_out
         self._interval = interval
+        self._recover = recover
+        self._budget = budget
+        self._widenings_left = 4  # a deadlock-breaking nudge, not unbounded
         # (0,0,0) start: a pipeline wedged on its very first item reports at
         # t=interval, not 2x
         self._last = (0, 0, 0)
@@ -87,7 +131,34 @@ class _Watchdog:
                     self._interval, snap[0], snap[1], snap[2],
                     self._q_in.qsize(), self._q_in.maxsize,
                     self._q_out.qsize(), self._q_out.maxsize)
+                if self._recover and self._widenings_left > 0 \
+                        and self._capacity_bound():
+                    self._widenings_left -= 1
+                    self._widen()
             self._last = snap
+
+    def _capacity_bound(self):
+        """Only widen when a limit is actually saturated — a stall with idle
+        queues (device hang, slow stage) is not a capacity deadlock, and
+        widening there just unbounds memory."""
+        full_in = 0 < self._q_in.maxsize <= self._q_in.qsize()
+        full_out = 0 < self._q_out.maxsize <= self._q_out.qsize()
+        b = self._budget
+        saturated = b is not None and b.limit > 0 and b.used >= b.limit
+        return full_in or full_out or saturated
+
+    def _widen(self):
+        for q in (self._q_in, self._q_out):
+            with q.mutex:
+                if q.maxsize > 0:
+                    q.maxsize *= 2
+                q.not_full.notify_all()
+        if self._budget is not None:
+            self._budget.widen()
+        log.warning("deadlock-recover: queue limits doubled to "
+                    "q_in=%d q_out=%d bytes=%s", self._q_in.maxsize,
+                    self._q_out.maxsize,
+                    self._budget.limit if self._budget else "n/a")
 
     def stop(self):
         self._stop.set()
@@ -95,7 +166,9 @@ class _Watchdog:
 
 def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
                queue_items: int = 4, stats: StageTimes = None,
-               watchdog_interval: float = 120.0, resolve_fn=None):
+               watchdog_interval: float = 120.0, resolve_fn=None,
+               max_bytes: int = 0, item_bytes=None,
+               deadlock_recover: bool = False):
     """source -> process [-> resolve workers] -> sink, with optional threads.
 
     - source_iter: yields work items (e.g. RecordBatch)
@@ -109,6 +182,14 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
       by serial number before the sink (the reference's Q7 write-reorder,
       base.rs:1724-1920).
     - sink_fn(resolved output) (serial, input order)
+    - max_bytes + item_bytes(item): byte-accurate input-queue governance —
+      the reader blocks while admitting another item would exceed max_bytes
+      (one item always admits, so an oversized batch serializes instead of
+      deadlocking). Items vary widely in bytes, so this is what makes
+      --max-memory actually bound a streaming command's working set
+      (reference MemoryTracker, base.rs:466-625).
+    - deadlock_recover: the stall watchdog doubles queue/byte limits on each
+      stall instead of only logging (reference deadlock.rs:409).
 
     threads <= 1: fully inline. threads 2..3: reader + writer threads around
     the processing caller thread (resolve_fn runs on the writer). threads >=
@@ -139,6 +220,7 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
     writer_exc = []
     counters = [0, 0, 0]  # read, processed, written
     stop = threading.Event()  # error path: tell the reader to die promptly
+    budget = _ByteBudget(max_bytes if item_bytes is not None else 0)
 
     def put_in(item) -> bool:
         while not stop.is_set():
@@ -150,12 +232,20 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
         return False
 
     def reader():
+        # real items travel as (charged_bytes, item) pairs so the charge is
+        # released exactly once per admission (keying a side table by
+        # id(item) would double-charge duplicate/interned objects)
         try:
             t_last = time.monotonic()
             for item in source_iter:
                 now = time.monotonic()
                 stats.add_busy("read", now - t_last)
-                if not put_in(item):
+                nb = 0
+                if budget.limit > 0:
+                    nb = int(item_bytes(item))
+                    if not budget.acquire(nb, stop):
+                        return
+                if not put_in((nb, item)):
                     return
                 counters[0] += 1
                 t_last = time.monotonic()
@@ -244,7 +334,8 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
                           name="fgumi-writer", daemon=True)
     wts = [threading.Thread(target=worker, args=(i,), name=f"fgumi-worker-{i}",
                             daemon=True) for i in range(n_workers)]
-    watchdog = _Watchdog(counters, q_in, q_out, watchdog_interval)
+    watchdog = _Watchdog(counters, q_in, q_out, watchdog_interval,
+                         recover=deadlock_recover, budget=budget)
     rt.start()
     wt.start()
     for t in wts:
@@ -260,12 +351,17 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
                 break
             if isinstance(item, _Err):
                 raise item.exc
-            for out in process_fn(item):
-                if n_workers:
-                    q_out.put((serial, out))
-                    serial += 1
-                else:
-                    q_out.put(out)
+            nb, item = item
+            try:
+                for out in process_fn(item):
+                    if n_workers:
+                        q_out.put((serial, out))
+                        serial += 1
+                    else:
+                        q_out.put(out)
+            finally:
+                if nb:
+                    budget.release(nb)
             counters[1] += 1
             stats.add_busy("process", time.monotonic() - now)
             if writer_exc:
@@ -290,4 +386,6 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
             rt.join(timeout=0.2)
     if writer_exc:
         raise writer_exc[0]
+    if budget.limit > 0:
+        stats.peak_in_flight_bytes = budget.peak
     return stats

@@ -184,6 +184,18 @@ def test_pipeline_command_matches_stage_chain(fastq_inputs, tmp_path):
         recs_b = [r.data for r in b]
     assert len(recs_a) == len(recs_b) and recs_a == recs_b
 
-    # intermediates kept on request, and final output is level-1 (not stored)
+    # intermediates kept on request
     import os
     assert os.path.exists(os.path.join(keep, "grouped.bam"))
+
+    def first_deflate_btype(path):
+        # BGZF block: 18-byte header, then the deflate stream; BTYPE is
+        # bits 1-2 of its first byte (0 = stored)
+        with open(path, "rb") as f:
+            block = f.read(32)
+        return (block[18] >> 1) & 3
+
+    # the compression-level contract: intermediates are stored (level 0),
+    # the final output is actually deflate-compressed (default level 1)
+    assert first_deflate_btype(os.path.join(keep, "grouped.bam")) == 0
+    assert first_deflate_btype(out) != 0

@@ -241,6 +241,31 @@ def main():
         if d_cpu_err:
             diagnostics.append(f"duplex cpu: {d_cpu_err}")
 
+    # Mixed-family config (BASELINE eval config 2 analog): long-tail family
+    # sizes 1-50, ragged read lengths, 3' quality decay — exercises the
+    # ragged-batch padding economics the fixed-size config hides; the fast
+    # engine's padding waste comes back in device_stats
+    mixed = os.path.join(tmp, "mixed.bam")
+    simulate_grouped_bam(mixed, num_families=max(n_families // 2, 1000),
+                         family_size=4, family_size_distribution="longtail",
+                         read_length=100, read_length_jitter=30,
+                         qual_slope=0.05, error_rate=0.01, seed=43)
+    n_mixed = count_records(mixed)
+    mixed_cpu, merr = run_worker(mixed, threads, CPU_ENV, run_timeout)
+    if mixed_cpu is not None:
+        result_mixed = {
+            "mixed_family_reads_per_sec": round(
+                n_mixed / mixed_cpu["wall_s"], 1),
+            "mixed_family_input_reads": n_mixed,
+            "mixed_family_platform": mixed_cpu["platform"],
+        }
+        ds = mixed_cpu.get("device_stats") or {}
+        if "padding_waste" in ds:
+            result_mixed["mixed_family_padding_waste"] = ds["padding_waste"]
+    else:
+        result_mixed = {}
+        diagnostics.append(f"mixed-family bench: {merr}")
+
     trier.attempt(sim, dup, threads)  # device attempt 3
 
     # tertiary metrics: host-side stage throughputs + the full best-practice
@@ -310,42 +335,20 @@ print(json.dumps(out))
                 stages_result["pipeline_diagnostics"] = [
                     f"stage bench failed: {serr}"]
 
-    # Huge-position-group UMI assignment (VERDICT r3 item 6): warm adjacency/
-    # paired times at 4k and 16k templates, CPU env (host algorithm + XLA
-    # pairwise kernel; on TPU the same code path dispatches to the chip).
-    umi_script = r"""
-import json, sys, time
-sys.path.insert(0, sys.argv[1])
-import numpy as np
-from fgumi_tpu.umi.assigners import AdjacencyUmiAssigner, PairedUmiAssigner
-
-rng = np.random.default_rng(0)
-def gen(n, paired=False):
-    bases = np.frombuffer(b"ACGT", np.uint8)
-    true = rng.choice(bases, size=(max(n // 10, 1), 8))
-    arr = true[rng.integers(0, len(true), size=n)]
-    err = rng.random(arr.shape) < 0.01
-    arr = np.where(err, rng.choice(bases, size=arr.shape), arr)
-    u = ["".join(chr(c) for c in row) for row in arr]
-    if paired:
-        arr2 = rng.choice(bases, size=arr.shape)
-        u = [f"{a}-{''.join(chr(c) for c in r)}" for a, r in zip(u, arr2)]
-    return u
-
-out = {}
-for tag, cls, paired in (("adjacency", AdjacencyUmiAssigner, False),
-                         ("paired", PairedUmiAssigner, True)):
-    for n in (4000, 16000):
-        umis = gen(n, paired)
-        cls(1).assign(umis)  # warm (jit compile)
-        t0 = time.monotonic()
-        cls(1).assign(umis)
-        out[f"{tag}_{n}_s"] = round(time.monotonic() - t0, 4)
-print(json.dumps(out))
-"""
-    umi_times, uerr = _run_script(umi_script, [REPO], CPU_ENV, run_timeout)
-    if uerr:
-        diagnostics.append(f"umi assign bench: {uerr}")
+    # Micro-benchmarks (VERDICT r4 item 8): per-primitive timings emitted
+    # every round so a component regression is visible even when the macro
+    # numbers move the other way. Includes the 4k/16k assigner timings the
+    # r3 bench reported as umi_assign_seconds (same key names).
+    with open(os.path.join(REPO, "microbench.py")) as f:
+        micro_script = f.read()
+    micro, merr2 = _run_script(micro_script, [REPO], CPU_ENV,
+                               run_timeout * 2)
+    if merr2:
+        diagnostics.append(f"microbench: {merr2}")
+    umi_times = ({k: micro[k] for k in ("adjacency_4000_s",
+                                        "adjacency_16000_s",
+                                        "paired_4000_s", "paired_16000_s")
+                  if k in micro} if micro else None)
 
     # Tail loop: keep probing across the remaining budget until the device
     # measurements complete or 8 spaced probes have failed (conclusive
@@ -427,8 +430,11 @@ print(json.dumps(out))
                 result["duplex_vs_baseline"] = round(
                     d_cpu["wall_s"] / trier.duplex["wall_s"], 3)
 
+    result.update(result_mixed)
     result.update(stages_result)
-    if umi_times is not None:
+    if micro:
+        result["micro"] = micro
+    if umi_times:
         result["umi_assign_seconds"] = umi_times
     result["device_probes"] = trier.probes
 

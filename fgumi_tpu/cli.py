@@ -109,6 +109,16 @@ def _apply_pipeline_compat(args):
     if getattr(args, "deadlock_recover", False):
         log.info("--deadlock-recover: stall watchdog will double queue/byte "
                  "limits on each stall (reference deadlock.rs:409)")
+    if getattr(args, "max_memory", None) is not None:
+        # validate once here so every command fails with rc=2 and a clean
+        # message, not a traceback from deep inside _stage_kwargs
+        from .utils.memory import resolve_budget
+
+        try:
+            resolve_budget(args.max_memory)
+        except ValueError as e:
+            log.error("--max-memory: %s", e)
+            return 2
     if getattr(args, "pipeline_stats", False):
         if hasattr(args, "stats"):
             args.stats = True
@@ -1696,8 +1706,19 @@ def _add_simulate(sub):
     g.add_argument("--num-families", type=int, default=100)
     g.add_argument("--family-size", type=int, default=5)
     g.add_argument("--family-size-distribution", default="fixed",
-                   choices=["fixed", "lognormal"])
+                   choices=["fixed", "lognormal", "longtail"],
+                   help="longtail = Pareto-tailed 1-50 mixture (BASELINE "
+                        "eval config 2 shape)")
     g.add_argument("--read-length", type=int, default=100)
+    g.add_argument("--read-length-jitter", type=int, default=0,
+                   help="per-read 3' truncation up to N bases (ragged "
+                        "consensus-length stress)")
+    g.add_argument("--qual-slope", type=float, default=0.0,
+                   help="per-position Phred decay along the read")
+    g.add_argument("--insert-size-mean", type=int, default=None,
+                   help="normal insert-size model (default: uniform "
+                        "1.5-3x read length)")
+    g.add_argument("--insert-size-sd", type=int, default=0)
     g.add_argument("--error-rate", type=float, default=0.01)
     g.add_argument("--base-quality", type=int, default=35)
     g.add_argument("--single-end", action="store_true")
@@ -1741,7 +1762,7 @@ def _add_simulate(sub):
     f.add_argument("--num-families", type=int, default=100)
     f.add_argument("--family-size", type=int, default=5)
     f.add_argument("--family-size-distribution", default="fixed",
-                   choices=["fixed", "lognormal"])
+                   choices=["fixed", "lognormal", "longtail"])
     f.add_argument("--read-length", type=int, default=100)
     f.add_argument("--umi-length", type=int, default=8)
     f.add_argument("--error-rate", type=float, default=0.0)
@@ -1828,7 +1849,11 @@ def cmd_simulate_grouped(args):
         args.output, num_families=args.num_families, family_size=args.family_size,
         family_size_distribution=args.family_size_distribution,
         read_length=args.read_length, error_rate=args.error_rate,
-        base_quality=args.base_quality, paired=not args.single_end, seed=args.seed)
+        base_quality=args.base_quality, paired=not args.single_end,
+        read_length_jitter=args.read_length_jitter,
+        qual_slope=args.qual_slope,
+        insert_size_mean=args.insert_size_mean,
+        insert_size_sd=args.insert_size_sd, seed=args.seed)
     log.info("simulate: wrote %d records to %s", n, args.output)
     return 0
 

@@ -73,10 +73,11 @@ def pack_shards(codes_d, quals_d, starts, jb, L_max):
                     for d in range(dp)]
     n_rows = [int(s[-1]) for s in shard_starts]
     n_jobs = [int(jb[d + 1] - jb[d]) for d in range(dp)]
-    from ..ops.kernel import _pad_rows
+    from ..ops.kernel import DEVICE_STATS, _pad_rows
 
     N_max = _pad_rows(max(max(n_rows), 1))
     F_loc = 1 << (max(max(n_jobs), 1) - 1).bit_length()
+    DEVICE_STATS.add_pad(sum(n_rows), dp * N_max)
 
     codes3d = np.full((dp, N_max, L_max), 4, dtype=np.uint8)
     quals3d = np.zeros((dp, N_max, L_max), dtype=np.uint8)
@@ -108,10 +109,11 @@ def pack_shards_sp(codes_d, quals_d, starts, jb, L_max, sp):
     n_rows = [int(s[-1]) for s in shard_starts]
     n_jobs = [int(jb[d + 1] - jb[d]) for d in range(dp)]
     chunk = [-(-max(n, 1) // sp) for n in n_rows]
-    from ..ops.kernel import _pad_rows
+    from ..ops.kernel import DEVICE_STATS, _pad_rows
 
     N_sp = _pad_rows(max(chunk)) if max(chunk) > 1 else 1
     F_loc = 1 << (max(max(n_jobs), 1) - 1).bit_length()
+    DEVICE_STATS.add_pad(sum(n_rows), dp * sp * N_sp)
 
     codes4 = np.full((dp, sp, N_sp, L_max), 4, dtype=np.uint8)
     quals4 = np.zeros((dp, sp, N_sp, L_max), dtype=np.uint8)

@@ -185,6 +185,15 @@ def get_lib():
         if os.environ.get("FGUMI_TPU_NO_NATIVE"):
             _lib_failed = True
             return None
+        def _abi_ok(candidate):
+            # one copy of the versioned-ABI check (bumped in fgumi_native.cc
+            # on any signature change), shared by the override and
+            # cached-build paths
+            if not hasattr(candidate, "fgumi_abi_version"):
+                return False
+            candidate.fgumi_abi_version.restype = ctypes.c_long
+            return candidate.fgumi_abi_version() == _ABI_VERSION
+
         override = os.environ.get("FGUMI_TPU_NATIVE_SO")
         if override:
             # explicit prebuilt library (e.g. the ASAN/UBSAN test lane):
@@ -196,15 +205,9 @@ def get_lib():
                             override, e)
                 _lib_failed = True
                 return None
-            if not hasattr(lib, "fgumi_abi_version"):
-                log.warning("FGUMI_TPU_NATIVE_SO=%s lacks fgumi_abi_version",
-                            override)
-                _lib_failed = True
-                return None
-            lib.fgumi_abi_version.restype = ctypes.c_long
-            if lib.fgumi_abi_version() != _ABI_VERSION:
-                log.warning("FGUMI_TPU_NATIVE_SO=%s ABI %d != expected %d",
-                            override, lib.fgumi_abi_version(), _ABI_VERSION)
+            if not _abi_ok(lib):
+                log.warning("FGUMI_TPU_NATIVE_SO=%s missing or mismatched "
+                            "ABI (expected %d)", override, _ABI_VERSION)
                 _lib_failed = True
                 return None
             _declare(lib)
@@ -224,14 +227,7 @@ def get_lib():
             return None
         # stale-.so guard: a cached build whose mtime ties the source (e.g.
         # archive extraction) passes the rebuild check but may predate newer
-        # symbols OR carry old signatures; check the versioned ABI export
-        # (bumped in fgumi_native.cc on any signature change) and rebuild
-        def _abi_ok(candidate):
-            if not hasattr(candidate, "fgumi_abi_version"):
-                return False
-            candidate.fgumi_abi_version.restype = ctypes.c_long
-            return candidate.fgumi_abi_version() == _ABI_VERSION
-
+        # symbols OR carry old signatures; rebuild on ABI mismatch
         if not _abi_ok(lib):
             if not _build():
                 _lib_failed = True

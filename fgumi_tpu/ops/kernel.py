@@ -131,11 +131,20 @@ class DeviceStats:
         self.fetch_wait_s = 0.0
         self.bytes_fetched = 0
         self.model_flops = 0
+        self.rows_real = 0
+        self.rows_padded = 0
 
     def add_dispatch(self, flops: int):
         with self._lock:
             self.dispatches += 1
             self.model_flops += int(flops)
+
+    def add_pad(self, real_rows: int, padded_rows: int):
+        """Padding-waste accounting: real vs device-layout rows per dispatch
+        (ragged-batch economics, SURVEY hard-part #2)."""
+        with self._lock:
+            self.rows_real += int(real_rows)
+            self.rows_padded += int(padded_rows)
 
     def fetch(self, dev):
         """Timed jax.device_get — route every device->host fetch through
@@ -149,10 +158,16 @@ class DeviceStats:
 
     def snapshot(self):
         with self._lock:
-            return {"dispatches": self.dispatches,
-                    "fetch_wait_s": round(self.fetch_wait_s, 3),
-                    "bytes_fetched": self.bytes_fetched,
-                    "model_gflops": round(self.model_flops / 1e9, 3)}
+            out = {"dispatches": self.dispatches,
+                   "fetch_wait_s": round(self.fetch_wait_s, 3),
+                   "bytes_fetched": self.bytes_fetched,
+                   "model_gflops": round(self.model_flops / 1e9, 3)}
+            if self.rows_padded:
+                out["pad_rows_real"] = self.rows_real
+                out["pad_rows_device"] = self.rows_padded
+                out["padding_waste"] = round(
+                    self.rows_padded / max(self.rows_real, 1) - 1.0, 4)
+            return out
 
     def format_summary(self, wall_s: float = None) -> str:
         s = self.snapshot()
@@ -437,6 +452,7 @@ def pad_segments(codes2d: np.ndarray, quals2d: np.ndarray,
     N_pad = _pad_rows(N)
     F_pad = 1 << (J - 1).bit_length() if J > 1 else 1
     seg_ids = np.repeat(np.arange(J, dtype=np.int32), counts)
+    DEVICE_STATS.add_pad(N, N_pad)
     if N_pad != N:
         L = codes2d.shape[1]
         pad_c = np.full((N_pad - N, L), N_CODE, dtype=np.uint8)
@@ -465,6 +481,7 @@ def pad_segments_gather(codes: np.ndarray, quals: np.ndarray,
     J = len(counts)
     N_pad = _pad_rows(N)
     F_pad = 1 << (J - 1).bit_length() if J > 1 else 1
+    DEVICE_STATS.add_pad(N, N_pad)
     codes_dev = np.full((N_pad, L_max), N_CODE, dtype=np.uint8)
     quals_dev = np.zeros((N_pad, L_max), dtype=np.uint8)
     codes_dev[:N] = codes[rows, :L_max]

@@ -265,11 +265,22 @@ def simulate_grouped_bam(path: str, num_families: int = 100, family_size: int = 
                          read_length: int = 100, error_rate: float = 0.01,
                          base_quality: int = 35, qual_jitter: int = 5,
                          paired: bool = True, seed: int = 42,
+                         read_length_jitter: int = 0,
+                         qual_slope: float = 0.0,
+                         insert_size_mean: int = None,
+                         insert_size_sd: int = 0,
                          ref_name: str = "chr1", ref_length: int = 10_000_000):
     """Write a grouped (MI-tagged) BAM simulating PCR families of reads.
 
-    Returns the number of records written. Families appear consecutively in MI order
-    (the post-`group` layout simplex consumes).
+    Models (reference src/lib/simulate/mod.rs:41-47 analogs): family sizes
+    fixed/lognormal/longtail (_family_size), per-READ length variation
+    (`read_length_jitter` bases truncated from the 3' end — stresses the
+    ragged consensus-length rule), normal insert sizes
+    (`insert_size_mean`/`insert_size_sd`; default uniform 1.5-3x read), and
+    a per-position quality decay (`qual_slope`, _read_quals).
+
+    Returns the number of records written. Families appear consecutively in
+    MI order (the post-`group` layout simplex consumes).
     """
     rng = np.random.default_rng(seed)
     header = BamHeader(
@@ -281,50 +292,65 @@ def simulate_grouped_bam(path: str, num_families: int = 100, family_size: int = 
     n_written = 0
     with BamWriter(path, header) as w:
         for fam in range(num_families):
-            if family_size_distribution == "fixed":
-                size = family_size
-            elif family_size_distribution == "lognormal":
-                size = max(1, int(rng.lognormal(np.log(max(family_size, 1)), 0.6)))
+            size = _family_size(rng, family_size_distribution, family_size)
+            if insert_size_mean:
+                insert = int(rng.normal(insert_size_mean,
+                                        insert_size_sd or 1))
+                # keep the molecule on the contig; generous ceiling so a
+                # requested N(mean, sd) well beyond 3x read length is honored
+                insert = max(read_length + 1,
+                             min(insert, 10 * read_length,
+                                 ref_length // 2))
             else:
-                raise ValueError(family_size_distribution)
-            start = int(rng.integers(0, ref_length - 3 * read_length))
-            insert = int(rng.integers(int(read_length * 1.5), 3 * read_length))
+                insert = int(rng.integers(int(read_length * 1.5),
+                                          3 * read_length))
+            start = int(rng.integers(0, ref_length - insert - 1))
             # one molecule truth over the insert: R1/R2 agree where they overlap
             truth = rng.integers(0, 4, size=insert).astype(np.uint8)
-            truth_r1 = truth[:read_length]
-            truth_r2 = truth[insert - read_length:]
             mi = str(fam)
-            cigar = [("M", read_length)]
-            mc = f"{read_length}M".encode()
+
+            # never truncate below 20 bases (or below 1 for tiny reads)
+            jit = max(min(read_length_jitter, read_length - 20), 0)
+
+            def rlen():
+                if not jit:
+                    return read_length
+                return read_length - int(rng.integers(0, jit + 1))
+
             for r in range(size):
+                ln1 = rlen()
+                ln2 = rlen()
+                truth_r1 = truth[:ln1]
+                truth_r2 = truth[insert - ln2:]
+
                 # per-read errors
                 def mutate(truth):
                     codes = truth.copy()
-                    errs = rng.random(read_length) < error_rate
+                    errs = rng.random(len(codes)) < error_rate
                     n_err = int(errs.sum())
                     if n_err:
                         codes[errs] = (codes[errs] + rng.integers(1, 4, n_err)) % 4
                     return CODE_TO_BASE[codes].tobytes()
 
-                quals = np.clip(
-                    base_quality + rng.integers(-qual_jitter, qual_jitter + 1,
-                                                read_length),
-                    2, 40).astype(np.uint8)
+                cigar = [("M", ln1)]
+                quals = _read_quals(rng, ln1, base_quality, qual_jitter,
+                                    qual_slope)
                 name = f"fam{fam}:r{r}".encode()
                 if paired:
-                    r2_pos = start + insert - read_length
+                    cigar2 = [("M", ln2)]
+                    mc1 = f"{ln2}M".encode()   # mate (R2) cigar
+                    mc2 = f"{ln1}M".encode()   # mate (R1) cigar
+                    r2_pos = start + insert - ln2
                     rec1 = _build_mapped_record(
                         name, FLAG_PAIRED | FLAG_FIRST | FLAG_MATE_REVERSE, 0, start,
                         60, cigar, mutate(truth_r1), quals, 0, r2_pos, insert,
-                        [(b"MC", "Z", mc), (b"RG", "Z", b"A"), (b"MI", "Z", mi.encode())])
-                    quals2 = np.clip(
-                        base_quality + rng.integers(-qual_jitter, qual_jitter + 1,
-                                                    read_length),
-                        2, 40).astype(np.uint8)
+                        [(b"MC", "Z", mc1), (b"RG", "Z", b"A"), (b"MI", "Z", mi.encode())])
+                    quals2 = _read_quals(rng, ln2, base_quality, qual_jitter,
+                                         qual_slope)
                     rec2 = _build_mapped_record(
                         name, FLAG_PAIRED | FLAG_LAST | FLAG_REVERSE, 0, r2_pos,
-                        60, cigar, mutate(truth_r2), quals2, 0, start, -insert,
-                        [(b"MC", "Z", mc), (b"RG", "Z", b"A"), (b"MI", "Z", mi.encode())])
+                        60, cigar2, mutate(truth_r2), quals2, 0, start, -insert,
+                        [(b"MC", "Z", mc2), (b"RG", "Z", b"A"), (b"MI", "Z", mi.encode())])
                     w.write_record_bytes(rec1)
                     w.write_record_bytes(rec2)
                     n_written += 2
@@ -336,6 +362,35 @@ def simulate_grouped_bam(path: str, num_families: int = 100, family_size: int = 
                     w.write_record_bytes(rec)
                     n_written += 1
     return n_written
+
+
+def _family_size(rng, distribution: str, mean: int) -> int:
+    """Family-size model (the reference's family-size distributions,
+    /root/reference/src/lib/simulate/mod.rs:41-47):
+
+    - fixed:     every family has `mean` members
+    - lognormal: lognormal around `mean` (sigma 0.6)
+    - longtail:  Pareto-tailed mixture capped at 50 — mostly singletons and
+      small families with a heavy tail, the BASELINE eval-config-2 shape
+      ("real targeted panel, mixed family sizes 1-50")
+    """
+    if distribution == "fixed":
+        return mean
+    if distribution == "lognormal":
+        return max(1, int(rng.lognormal(np.log(max(mean, 1)), 0.6)))
+    if distribution == "longtail":
+        return min(50, 1 + int(rng.pareto(1.3) * max(mean, 1) * 0.5))
+    raise ValueError(distribution)
+
+
+def _read_quals(rng, n: int, base_quality: int, qual_jitter: int,
+                qual_slope: float = 0.0):
+    """Per-position quality model: linear 3'-decay (`qual_slope` Phred per
+    base, the Illumina-like degradation profile) plus uniform jitter."""
+    q = base_quality - qual_slope * np.arange(n)
+    if qual_jitter:
+        q = q + rng.integers(-qual_jitter, qual_jitter + 1, n)
+    return np.clip(q, 2, 40).astype(np.uint8)
 
 
 def _random_umi(rng, length):
@@ -397,11 +452,8 @@ def simulate_fastq_reads(r1_path: str, r2_path: str, truth_path: str = None,
         with gzip.open(r1_path, "wb", compresslevel=1) as f1, \
                 gzip.open(r2_path, "wb", compresslevel=1) as f2:
             for fam in range(num_families):
-                if family_size_distribution == "fixed":
-                    size = family_size
-                else:
-                    size = max(1, int(rng.lognormal(
-                        np.log(max(family_size, 1)), 0.6)))
+                size = _family_size(rng, family_size_distribution,
+                                    family_size)
                 if whitelist:
                     umi1 = whitelist[int(rng.integers(len(whitelist)))]
                     umi2 = whitelist[int(rng.integers(len(whitelist)))]

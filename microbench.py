@@ -1,0 +1,198 @@
+"""Per-kernel micro-benchmarks with per-round JSON (VERDICT r4 item 8).
+
+The reference gates perf-sensitive choices with criterion benches
+(/root/reference/benches/core_functions.rs:36-1426); this is the analog for
+the hot host/device primitives, emitted as one JSON dict so the driver's
+BENCH_r{N}.json files are comparable across rounds (an engine win that
+regresses a primitive shows up here even when the macro number moves the
+other way — exactly what round 3 lacked).
+
+Covers: consensus kernel (two shapes), native record decode/tag-scan/pack,
+sort key extraction, BGZF codec, and the UMI assigners at 4k/16k.
+
+Run directly (`python microbench.py`) or via bench.py (micro section).
+"""
+
+import json
+import os
+import sys
+import time
+
+# bench.py executes this file's text via `python -c` (no __file__) and
+# passes the repo root as argv[1]; standalone runs locate it from __file__
+if len(sys.argv) > 1 and os.path.isdir(sys.argv[1]):
+    REPO = sys.argv[1]
+elif "__file__" in globals():
+    REPO = os.path.dirname(os.path.abspath(__file__))
+else:
+    REPO = os.getcwd()
+sys.path.insert(0, REPO)
+
+
+def _timeit(fn, *, repeat=3, warmup=1):
+    for _ in range(warmup):
+        fn()
+    best = float("inf")
+    for _ in range(repeat):
+        t0 = time.monotonic()
+        fn()
+        best = min(best, time.monotonic() - t0)
+    return best
+
+
+def bench_kernel(out):
+    import jax
+    import numpy as np
+
+    from fgumi_tpu.ops.kernel import ConsensusKernel, pad_segments
+    from fgumi_tpu.ops.tables import quality_tables
+
+    kernel = ConsensusKernel(quality_tables(45, 40))
+    rng = np.random.default_rng(7)
+    for tag, (n_fam, fam, L) in (("kernel_small_8k_rows", (1638, 5, 64)),
+                                 ("kernel_64k_rows", (13107, 5, 128))):
+        codes = rng.integers(0, 4, size=(n_fam * fam, L), dtype=np.uint8)
+        quals = rng.integers(25, 41, size=codes.shape, dtype=np.uint8)
+        counts = np.full(n_fam, fam, dtype=np.int64)
+        cd, qd, seg, starts, F = pad_segments(codes, quals, counts)
+
+        def run():
+            jax.block_until_ready(
+                kernel.device_call_segments(cd, qd, seg, F))
+
+        dt = _timeit(run)
+        out[f"{tag}_s"] = round(dt, 4)
+        out[f"{tag}_reads_per_sec"] = round(n_fam * fam / dt, 1)
+
+
+def bench_native_batch(out, bam_path):
+    import numpy as np
+
+    from fgumi_tpu.io.batch_reader import BamBatchReader
+    from fgumi_tpu.native import batch as nb
+
+    with BamBatchReader(bam_path, target_bytes=64 << 20) as r:
+        batch = next(iter(r))
+    out["batch_records"] = int(batch.n)
+
+    out["scan_tags_s"] = round(_timeit(
+        lambda: nb.scan_tags(batch.buf, batch.aux_off, batch.data_end,
+                             [b"MI", b"MC", b"RX"])), 4)
+
+    span = np.arange(batch.n, dtype=np.int64)
+    reverse = np.zeros(batch.n, dtype=np.uint8)
+    clips = np.zeros((batch.n, 2), dtype=np.int32)
+    stride = max(-(-int(batch.l_seq.max()) // 32) * 32, 32)
+
+    def pack():
+        nb.pack_reads(batch.buf, np.ascontiguousarray(batch.seq_off),
+                      np.ascontiguousarray(batch.qual_off), batch.l_seq,
+                      reverse, clips, 10, stride)
+
+    out["pack_reads_s"] = round(_timeit(pack), 4)
+    out["pack_reads_mrec_per_sec"] = round(
+        batch.n / out["pack_reads_s"] / 1e6, 3)
+
+
+def bench_sort_keys(out, bam_path):
+    from fgumi_tpu.io.batch_reader import BamBatchReader
+    from fgumi_tpu.sort.keys import make_batch_keys_fn
+
+    with BamBatchReader(bam_path, target_bytes=64 << 20) as r:
+        keys_fn = make_batch_keys_fn("template-coordinate", r.header)
+        batch = next(iter(r))
+        dt = _timeit(lambda: keys_fn(batch))
+    out["sort_keys_s"] = round(dt, 4)
+    out["sort_keys_mrec_per_sec"] = round(batch.n / dt / 1e6, 3)
+
+
+def bench_bgzf(out):
+    import numpy as np
+
+    from fgumi_tpu import native
+
+    if native.get_lib() is None:
+        out["bgzf"] = "native unavailable"
+        return
+    rng = np.random.default_rng(3)
+    # compressible-ish payload (4-letter alphabet like SEQ bytes)
+    data = rng.choice(np.frombuffer(b"ACGT", np.uint8),
+                      size=16 << 20).tobytes()
+    blob = None
+
+    def compress():
+        nonlocal blob
+        blob, _ = native.bgzf_compress_many(data, level=1)
+
+    dt_c = _timeit(compress)
+    out["bgzf_compress_mb_per_sec"] = round(len(data) / dt_c / 1e6, 1)
+
+    import io as _io
+
+    from fgumi_tpu.io.bgzf import BgzfReader
+
+    def decompress():
+        r = BgzfReader(_io.BytesIO(blob))
+        while r.read(4 << 20):
+            pass
+
+    dt_d = _timeit(decompress)
+    out["bgzf_decompress_mb_per_sec"] = round(len(data) / dt_d / 1e6, 1)
+
+
+def bench_assigners(out):
+    import numpy as np
+
+    from fgumi_tpu.umi.assigners import (AdjacencyUmiAssigner,
+                                         PairedUmiAssigner)
+
+    rng = np.random.default_rng(0)
+
+    def gen(n, paired=False):
+        bases = np.frombuffer(b"ACGT", np.uint8)
+        true = rng.choice(bases, size=(max(n // 10, 1), 8))
+        arr = true[rng.integers(0, len(true), size=n)]
+        err = rng.random(arr.shape) < 0.01
+        arr = np.where(err, rng.choice(bases, size=arr.shape), arr)
+        umis = ["".join(chr(c) for c in row) for row in arr]
+        if paired:
+            arr2 = rng.choice(bases, size=arr.shape)
+            umis = [f"{u}-{''.join(chr(c) for c in r)}"
+                    for u, r in zip(umis, arr2)]
+        return umis
+
+    for tag, cls, paired in (("adjacency", AdjacencyUmiAssigner, False),
+                             ("paired", PairedUmiAssigner, True)):
+        for n in (4000, 16000):
+            umis = gen(n, paired)
+            cls(1).assign(umis)  # warm (jit compile)
+            out[f"{tag}_{n}_s"] = round(_timeit(
+                lambda: cls(1).assign(umis), repeat=2, warmup=0), 4)
+
+
+def main():
+    import tempfile
+
+    from fgumi_tpu.simulate import simulate_grouped_bam
+
+    out = {}
+    with tempfile.TemporaryDirectory(prefix="fgumi_micro_") as tmp:
+        bam = os.path.join(tmp, "micro.bam")
+        simulate_grouped_bam(bam, num_families=20000, family_size=5,
+                             read_length=100, seed=17)
+        for section in (bench_kernel,
+                        lambda o: bench_native_batch(o, bam),
+                        lambda o: bench_sort_keys(o, bam),
+                        bench_bgzf,
+                        bench_assigners):
+            try:
+                section(out)
+            except Exception as e:  # a broken section must not hide others
+                out[f"error_{getattr(section, '__name__', 'section')}"] = \
+                    repr(e)[:200]
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,6 +120,15 @@ class BamBatchReader:
     def __init__(self, path_or_obj, target_bytes: int = 16 << 20):
         owns = isinstance(path_or_obj, str)
         fileobj = open(path_or_obj, "rb") if owns else path_or_obj
+        if owns:
+            from .prefetch import PrefetchFile, prefetch_enabled
+
+            if prefetch_enabled():
+                # async read-ahead + POSIX_FADV_SEQUENTIAL (reference
+                # PrefetchReader, prefetch_reader.rs:93 + os_hints.rs):
+                # overlaps disk latency with decompress/decode even when
+                # the command runs without a reader stage thread
+                fileobj = PrefetchFile(fileobj)
         self._r = BgzfReader(fileobj, owns_fileobj=owns)
         self.header = BamHeader.decode_from(self._r.read)
         # a non-positive target would make _fill yield nothing and the

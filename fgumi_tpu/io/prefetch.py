@@ -1,0 +1,123 @@
+"""Async file read-ahead + sequential-access OS hints.
+
+Analog of the reference's PrefetchReader
+(/root/reference/crates/fgumi-bam-io/src/prefetch_reader.rs:93) and
+POSIX_FADV_SEQUENTIAL hints (src/os_hints.rs): a daemon thread reads
+fixed-size chunks ahead of the consumer into a bounded queue, so disk
+latency overlaps decompress/decode work even in single-threaded command
+mode (where there is no separate reader stage to hide it).
+
+Disable with FGUMI_TPU_NO_PREFETCH=1.
+"""
+
+import os
+import queue
+import threading
+
+_EOF = object()
+
+
+def advise_sequential(fileobj):
+    """Best-effort POSIX_FADV_SEQUENTIAL on a real file (os_hints.rs)."""
+    try:
+        os.posix_fadvise(fileobj.fileno(), 0, 0, os.POSIX_FADV_SEQUENTIAL)
+    except (AttributeError, OSError, ValueError):
+        pass  # not a real file / platform without fadvise
+
+
+def prefetch_enabled() -> bool:
+    return os.environ.get("FGUMI_TPU_NO_PREFETCH", "").lower() \
+        not in ("1", "true", "yes")
+
+
+class PrefetchFile:
+    """Read-only file wrapper with a background read-ahead thread.
+
+    Serves `read(n)` from an internal queue of `chunk`-sized blocks fetched
+    ahead by a daemon thread (at most `depth` blocks in flight, so memory
+    stays bounded at depth * chunk). A read error in the thread is re-raised
+    on the consumer's next read() — errors are never swallowed.
+    """
+
+    def __init__(self, fileobj, chunk: int = 1 << 20, depth: int = 4,
+                 owns_fileobj: bool = True):
+        self._f = fileobj
+        self._owns = owns_fileobj
+        self._q = queue.Queue(maxsize=depth)
+        self._buf = memoryview(b"")
+        self._eof = False
+        self._exc = None
+        self._stop = threading.Event()
+        advise_sequential(fileobj)
+        self._t = threading.Thread(target=self._loop, args=(chunk,),
+                                   name="fgumi-prefetch", daemon=True)
+        self._t.start()
+
+    def _loop(self, chunk):
+        try:
+            while not self._stop.is_set():
+                data = self._f.read(chunk)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(data if data else _EOF, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if not data:
+                    return
+        except BaseException as e:  # noqa: BLE001 - re-raised on read()
+            self._exc = e
+            while not self._stop.is_set():
+                try:
+                    self._q.put(_EOF, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+    def read(self, n: int = -1) -> bytes:
+        if n is None or n < 0:
+            parts = []
+            while True:
+                got = self.read(1 << 20)
+                if not got:
+                    return b"".join(parts)
+                parts.append(got)
+        out = bytearray()
+        while len(out) < n:
+            if self._buf:
+                take = min(n - len(out), len(self._buf))
+                out += self._buf[:take]
+                self._buf = self._buf[take:]
+                continue
+            if self._eof:
+                break
+            got = self._q.get()
+            if got is _EOF:
+                self._eof = True
+                if self._exc is not None:
+                    exc, self._exc = self._exc, None
+                    raise exc
+                break
+            self._buf = memoryview(got)
+        return bytes(out)
+
+    def fileno(self):
+        return self._f.fileno()
+
+    def close(self):
+        self._stop.set()
+        # drain so the thread can't be wedged on a full queue
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._t.join(timeout=5)
+        if self._owns:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -130,7 +130,13 @@ class BamBatchReader:
                 # the command runs without a reader stage thread
                 fileobj = PrefetchFile(fileobj)
         self._r = BgzfReader(fileobj, owns_fileobj=owns)
-        self.header = BamHeader.decode_from(self._r.read)
+        try:
+            self.header = BamHeader.decode_from(self._r.read)
+        except BaseException:
+            # stop the prefetch thread + close the fd even when the header
+            # is corrupt — an unreferenced running thread never gets GC'd
+            self._r.close()
+            raise
         # a non-positive target would make _fill yield nothing and the
         # command silently write an empty output; clamp to "one chunk"
         self._target = max(int(target_bytes), 1)

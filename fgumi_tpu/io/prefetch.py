@@ -98,6 +98,10 @@ class PrefetchFile:
                     exc, self._exc = self._exc, None
                     raise exc
                 break
+            if not out and len(got) <= n:
+                # common steady state (consumer chunk == producer chunk):
+                # hand the queued bytes over without copying
+                return got
             self._buf = memoryview(got)
         return bytes(out)
 

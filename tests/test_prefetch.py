@@ -70,9 +70,33 @@ def test_batch_reader_uses_prefetch_for_paths(tmp_path, monkeypatch):
                 recs.append(bytes(b.buf))
         return b"".join(recs)
 
+    # the wrapper must actually be in the read path when enabled
+    with BamBatchReader(bam) as r:
+        assert isinstance(r._r._f, PrefetchFile)
     base = read_all(bam)
     monkeypatch.setenv("FGUMI_TPU_NO_PREFETCH", "1")
     assert not prefetch_enabled()
     assert read_all(bam) == base
     monkeypatch.delenv("FGUMI_TPU_NO_PREFETCH")
     assert prefetch_enabled()
+
+
+def test_corrupt_header_stops_prefetch_thread(tmp_path):
+    """A failed BamBatchReader open must not leak the read-ahead thread."""
+    import gzip
+    import threading
+
+    from fgumi_tpu.io.batch_reader import BamBatchReader
+
+    p = tmp_path / "corrupt.bam.gz"
+    p.write_bytes(gzip.compress(b"not a bam header" * 500_000))
+    before = {t.name for t in threading.enumerate()}
+    with pytest.raises(Exception):
+        BamBatchReader(str(p))
+    leaked = [t for t in threading.enumerate()
+              if t.name == "fgumi-prefetch" and t.name not in before
+              and t.is_alive()]
+    # give a just-stopped thread a beat to exit
+    for t in leaked:
+        t.join(timeout=2)
+    assert not any(t.is_alive() for t in leaked)

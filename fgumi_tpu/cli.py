@@ -191,6 +191,12 @@ def _build_dp_mesh(devices_arg):
     FGUMI_TPU_SP=<k> splits the read axis over k of the devices (sequence
     parallelism for deep families; dp = n // k), default 1 (dp-only).
     """
+    # CPU pinned without a forced virtual device count => exactly one device:
+    # skip the jax import/backend init entirely (host-engine cold-start path)
+    if (os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+            and "host_platform_device_count"
+            not in os.environ.get("XLA_FLAGS", "")):
+        return None
     import jax
 
     devs = jax.devices()

@@ -13,7 +13,6 @@ tests/test_fast_simplex.py). Families the vectorized path cannot express
 most-common-alignment filter) fall back to the slow path per group.
 """
 
-import jax
 import numpy as np
 
 from ..core import cigar as cigar_utils
@@ -846,6 +845,17 @@ class FastSimplexCaller:
             quals_d = np.ascontiguousarray(quals[rows_all, :L_max])
             return (self._dispatch_sharded(multi, counts, starts, codes_d,
                                            quals_d, L_max), blocks0)
+
+        if kernel.host_mode():
+            # no pad, no device layout: the native f64 engine consumes the
+            # ragged rows directly at resolve time (ops/host_kernel.py)
+            from ..ops.kernel import HOST_DISPATCH
+
+            starts = np.concatenate(([0], np.cumsum(counts)))
+            return ("seg", multi, starts,
+                    np.ascontiguousarray(codes[rows_all, :L_max]),
+                    np.ascontiguousarray(quals[rows_all, :L_max]),
+                    HOST_DISPATCH), blocks0
 
         from ..ops.kernel import pad_segments_gather
 

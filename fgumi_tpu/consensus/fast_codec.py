@@ -113,7 +113,6 @@ class FastCodecCaller:
         one device execution.
         """
         from ..ops import oracle
-        from ..ops.kernel import pad_segments
         from .vanilla import I16_MAX, VanillaConsensusRead
 
         caller = self.caller
@@ -181,10 +180,8 @@ class FastCodecCaller:
                     codes2d[row, :k] = c[:k]
                     quals2d[row, :k] = q[:k]
                     row += 1
-            codes_dev, quals_dev, seg_ids, starts, F_pad = pad_segments(
-                codes2d, quals2d, counts)
-            dev = ss.kernel.device_call_segments(codes_dev, quals_dev,
-                                                 seg_ids, F_pad)
+            dev, starts = ss.kernel.dispatch_segments(codes2d, quals2d,
+                                                      counts)
             w, q_, d, e = ss.kernel.resolve_segments(dev, codes2d, quals2d,
                                                      starts)
             slots = [(v[0], v[1], v[4]) for v in vec_multi] \

@@ -410,12 +410,7 @@ class FastDuplexCaller:
                 w, q_, d, e = self._dispatch_sharded(cm, qm, counts_m,
                                                      starts_m, L_max)
             else:
-                from ..ops.kernel import pad_segments
-
-                codes_dev, quals_dev, seg_ids, _, F_pad = pad_segments(
-                    cm, qm, counts_m)
-                dev = self.kernel.device_call_segments(codes_dev, quals_dev,
-                                                       seg_ids, F_pad)
+                dev, _ = self.kernel.dispatch_segments(cm, qm, counts_m)
                 w, q_, d, e = self.kernel.resolve_segments(dev, cm, qm,
                                                            starts_m)
             b_m, q_m = oracle.apply_consensus_thresholds(

@@ -28,7 +28,7 @@ from ..io.bam import (FLAG_FIRST, FLAG_LAST, FLAG_MATE_UNMAPPED, FLAG_PAIRED,
                       FLAG_REVERSE, FLAG_SECONDARY, FLAG_SUPPLEMENTARY,
                       FLAG_UNMAPPED, RawRecord, RecordBuilder)
 from ..ops import oracle
-from ..ops.kernel import ConsensusKernel, pad_segments
+from ..ops.kernel import ConsensusKernel
 from ..ops.tables import quality_tables
 from .rejects import RejectTracking
 from .simple_umi import consensus_umis
@@ -485,10 +485,7 @@ class VanillaConsensusCaller(RejectTracking):
                 codes2d[row, :n] = c[:n]
                 quals2d[row, :n] = q[:n]
                 row += 1
-        codes_dev, quals_dev, seg_ids, starts, F_pad = pad_segments(
-            codes2d, quals2d, counts)
-        dev = self.kernel.device_call_segments(codes_dev, quals_dev, seg_ids,
-                                               F_pad)
+        dev, starts = self.kernel.dispatch_segments(codes2d, quals2d, counts)
         w, q_, d, e = self.kernel.resolve_segments(
             dev, codes2d, quals2d, starts)
         for fi, j in enumerate(multi):

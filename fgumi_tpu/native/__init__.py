@@ -24,7 +24,7 @@ _lock = threading.Lock()
 _lib = None
 _lib_failed = False
 # must equal fgumi_abi_version() in fgumi_native.cc (stale-.so guard)
-_ABI_VERSION = 6
+_ABI_VERSION = 7
 
 
 def _build() -> bool:
@@ -103,6 +103,10 @@ def _declare(lib):
     lib.fgumi_segment_depth_errors_ranges.restype = None
     lib.fgumi_segment_depth_errors_ranges.argtypes = (
         [p, p, p, p, ctypes.c_long, ctypes.c_long, p, p])
+    lib.fgumi_consensus_segments.restype = ctypes.c_long
+    lib.fgumi_consensus_segments.argtypes = (
+        [p, p, p, ctypes.c_long, ctypes.c_long, p, p, ctypes.c_double,
+         ctypes.c_int, ctypes.c_int] + [p] * 8 + [p, p, p, ctypes.c_long])
     lib.fgumi_ranges_equal.restype = None
     lib.fgumi_ranges_equal.argtypes = [p] * 5 + [ctypes.c_long, p]
     lib.fgumi_hash_ranges.restype = None

@@ -30,11 +30,65 @@ instead of |ll| (hundreds to thousands), which is what makes f32 viable at depth
 
 import threading
 import time
-from functools import partial
+from functools import wraps
 
-import jax
-import jax.numpy as jnp
 import numpy as np
+
+# jax is imported lazily (_ensure_jax): a CPU-pinned run that routes every
+# dispatch to the native f64 host engine (host_kernel.py) never pays the
+# ~2s jax import — which lands on every stage of a multi-process chain.
+# The module globals `jax`/`jnp` start as import-on-first-touch proxies and
+# are rebound to the real modules by _ensure_jax, so traced bodies resolve
+# them normally at trace time — including when an external module (e.g.
+# parallel/mesh.py) wraps this module's body functions in its own jit
+# without ever calling a lazy-jit entry point here.
+_jax_ready = False
+
+
+class _LazyJaxProxy:
+    def __init__(self, which):
+        self._which = which
+
+    def __getattr__(self, attr):
+        _ensure_jax()
+        return getattr(jax if self._which == "jax" else jnp, attr)
+
+
+jax = _LazyJaxProxy("jax")
+jnp = _LazyJaxProxy("jnp")
+
+
+def _ensure_jax():
+    global jax, jnp, _jax_ready
+    if not _jax_ready:
+        import jax as _jax
+        import jax.numpy as _jnp
+
+        jax = _jax
+        jnp = _jnp
+        _jax_ready = True
+        # before the first jit compile so device executables land on disk
+        _enable_persistent_compile_cache()
+    return jax
+
+
+def _lazy_jit(fn=None, *, static_argnames=()):
+    """@jax.jit that defers both the jax import and the jit wrapping to the
+    first call (same compiled-function caching afterwards)."""
+    def deco(f):
+        box = []
+
+        @wraps(f)
+        def wrapper(*a, **k):
+            if not box:
+                _ensure_jax()
+                box.append(jax.jit(f, static_argnames=static_argnames)
+                           if static_argnames else jax.jit(f))
+            return box[0](*a, **k)
+
+        return wrapper
+
+    return deco(fn) if fn is not None else deco
 
 from ..constants import MAX_PHRED, MIN_PHRED, N_CODE
 from .tables import QualityTables
@@ -104,6 +158,10 @@ _PHRED_PER_LN = np.float32(10.0 / np.log(10.0))
 _QUAL_GUARD_FLOOR = 3e-4  # Phred units; absorbs O(eps32) evaluation error
 _TIE_GUARD_FLOOR = 1e-5  # ln units; exact-tie ulp jitter
 
+# sentinel returned by device_call_segments in host mode: the resolve half
+# runs the native f64 engine on the rows it receives (no device round-trip)
+HOST_DISPATCH = ("host-dispatch",)
+
 # bf16 systolic peak FLOP/s and HBM GB/s per chip, keyed by substrings of
 # jax device_kind — for the MFU/bandwidth utilization estimate below. The
 # consensus kernel is VPU/elementwise-dominated, so low MFU is expected and
@@ -149,6 +207,7 @@ class DeviceStats:
     def fetch(self, dev):
         """Timed jax.device_get — route every device->host fetch through
         here so fetch_wait_s captures all host time blocked on the device."""
+        _ensure_jax()
         t0 = time.monotonic()
         out = np.asarray(jax.device_get(dev))
         with self._lock:
@@ -175,7 +234,7 @@ class DeviceStats:
                  f"fetch-wait {s['fetch_wait_s']:.3f}s, "
                  f"{s['bytes_fetched'] / 1e6:.1f} MB fetched, "
                  f"model {s['model_gflops']:.2f} GFLOP"]
-        if self.fetch_wait_s > 0:
+        if self.fetch_wait_s > 0 and _jax_ready:
             gfs = self.model_flops / self.fetch_wait_s / 1e9
             parts.append(f"~{gfs:.1f} GFLOP/s incl. transfer")
             kind = getattr(jax.devices()[0], "device_kind", "").lower()
@@ -305,7 +364,7 @@ def _call_epilogue(contrib, obs, ln_error_pre_umi):
     return winner, qual, depth, errors, suspect
 
 
-@jax.jit
+@_lazy_jit
 def _consensus_batch_jit(codes, quals, correct_tab, err_tab, ln_error_pre_umi):
     contrib, obs = _reduce_contributions(codes, quals, correct_tab, err_tab)
     return _call_epilogue(contrib, obs, ln_error_pre_umi)
@@ -328,7 +387,7 @@ def _segments_body(codes, quals, seg_ids, correct_tab, err_tab,
     return _pack_result(winner, qual, suspect)
 
 
-@partial(jax.jit, static_argnames=("num_segments",))
+@_lazy_jit(static_argnames=("num_segments",))
 def _consensus_segments_packed_jit(codes, quals, seg_ids, correct_tab,
                                    err_tab, ln_error_pre_umi, num_segments):
     """Ragged-family variant: dense (N, L) read rows + sorted segment ids.
@@ -343,7 +402,7 @@ def _consensus_segments_packed_jit(codes, quals, seg_ids, correct_tab,
                           ln_error_pre_umi, num_segments)
 
 
-@partial(jax.jit, static_argnames=("num_segments", "mesh"))
+@_lazy_jit(static_argnames=("num_segments", "mesh"))
 def _consensus_segments_sharded_jit(codes, quals, seg_ids, correct_tab,
                                     err_tab, ln_error_pre_umi, num_segments,
                                     mesh):
@@ -367,7 +426,7 @@ def _consensus_segments_sharded_jit(codes, quals, seg_ids, correct_tab,
     return mapped(codes, quals, seg_ids)
 
 
-@partial(jax.jit, static_argnames=("num_segments", "mesh"))
+@_lazy_jit(static_argnames=("num_segments", "mesh"))
 def _consensus_segments_dp_sp_jit(codes, quals, seg_ids, correct_tab,
                                   err_tab, ln_error_pre_umi, num_segments,
                                   mesh):
@@ -403,7 +462,7 @@ def _consensus_segments_dp_sp_jit(codes, quals, seg_ids, correct_tab,
     return mapped(codes, quals, seg_ids)
 
 
-@jax.jit
+@_lazy_jit
 def _consensus_batch_packed_jit(codes, quals, correct_tab, err_tab,
                                 ln_error_pre_umi):
     """Packed variant: one (F, L) uint16 output, qual | winner<<7 | suspect<<10.
@@ -509,21 +568,62 @@ class ConsensusKernel:
     """
 
     def __init__(self, tables: QualityTables):
-        _enable_persistent_compile_cache()
+        # f32 table casts stay host-side numpy: jit accepts them directly
+        # (tiny per-dispatch transfer), and building jnp arrays here would
+        # force backend init even when every dispatch routes to the host
+        # engine. The persistent compile cache is enabled at first device
+        # dispatch for the same reason.
         self.tables = tables
-        self._correct_f32 = jnp.asarray(tables.adjusted_correct, dtype=jnp.float32)
-        self._err_f32 = jnp.asarray(tables.adjusted_error_per_alt, dtype=jnp.float32)
+        self._correct_f32 = np.asarray(tables.adjusted_correct, dtype=np.float32)
+        self._err_f32 = np.asarray(tables.adjusted_error_per_alt, dtype=np.float32)
         self._pre = np.float32(tables.ln_error_pre_umi)
         self.fallback_positions = 0
         self.total_positions = 0
         # fallback counters are updated from whichever thread resolves a
         # dispatch (the pipeline's writer stage as well as the caller thread)
         self._counter_lock = threading.Lock()
+        self._host_engine = None
+        self._use_host = None
+
+    def host_mode(self) -> bool:
+        """True when segment dispatches should run on the native f64 host
+        engine instead of XLA (ops/host_kernel.py): no accelerator attached
+        (jax backend == cpu) and the native library is available.
+        FGUMI_TPU_HOST_ENGINE=1/0 forces either way (parity tests run both)."""
+        if self._use_host is None:
+            import os
+
+            env = os.environ.get("FGUMI_TPU_HOST_ENGINE", "auto").lower()
+            if env in ("1", "true", "force"):
+                self._use_host = True
+            elif env in ("0", "false", "off"):
+                self._use_host = False
+            else:
+                from ..native import batch as nb
+
+                if not nb.available():
+                    self._use_host = False
+                elif os.environ.get("JAX_PLATFORMS",
+                                    "").strip().lower() == "cpu":
+                    # CPU explicitly pinned: decide without importing jax
+                    # (the whole point of host mode on a multi-process chain)
+                    self._use_host = True
+                else:
+                    _ensure_jax()
+                    self._use_host = jax.default_backend() == "cpu"
+        return self._use_host
+
+    def _host(self):
+        if self._host_engine is None:
+            from .host_kernel import HostConsensusEngine
+
+            self._host_engine = HostConsensusEngine(self.tables)
+        return self._host_engine
 
     def device_call(self, codes, quals):
         """Raw device outputs (winner, qual, depth, errors, suspect) as jax arrays."""
         return _consensus_batch_jit(
-            jnp.asarray(codes), jnp.asarray(quals), self._correct_f32, self._err_f32, self._pre
+            np.asarray(codes), np.asarray(quals), self._correct_f32, self._err_f32, self._pre
         )
 
     def device_call_packed(self, codes, quals):
@@ -535,7 +635,7 @@ class ConsensusKernel:
         F, R, L = codes.shape
         DEVICE_STATS.add_dispatch(segments_flops(F * R, L, F))
         return _consensus_batch_packed_jit(
-            jnp.asarray(codes), jnp.asarray(quals), self._correct_f32, self._err_f32, self._pre
+            np.asarray(codes), np.asarray(quals), self._correct_f32, self._err_f32, self._pre
         )
 
     @staticmethod
@@ -578,12 +678,35 @@ class ConsensusKernel:
 
     def device_call_segments(self, codes2d, quals2d, seg_ids,
                              num_segments: int):
-        """Dispatch dense (N, L) read rows with sorted per-row segment ids."""
+        """Dispatch dense (N, L) read rows with sorted per-row segment ids.
+
+        In host mode this is a no-op returning HOST_DISPATCH: the matching
+        resolve_segments call runs the native f64 engine on the unpadded
+        rows it receives, so callers that pre-padded simply wasted the pad
+        (the hot simplex path skips padding entirely in host mode)."""
+        if self.host_mode():
+            return HOST_DISPATCH
         DEVICE_STATS.add_dispatch(segments_flops(
             codes2d.shape[0], codes2d.shape[1], num_segments))
         return _consensus_segments_packed_jit(
-            jnp.asarray(codes2d), jnp.asarray(quals2d), jnp.asarray(seg_ids),
+            np.asarray(codes2d), np.asarray(quals2d), np.asarray(seg_ids),
             self._correct_f32, self._err_f32, self._pre, num_segments)
+
+    def dispatch_segments(self, codes2d, quals2d, counts):
+        """Pad + dispatch ragged segments, or skip both in host mode.
+
+        The one-stop shop for single-device callers holding dense (N, L)
+        rows and per-segment counts: returns (dev, starts) for the matching
+        resolve_segments(dev, codes2d, quals2d, starts) call. In host mode
+        no padded copies are built and no DEVICE_STATS pad rows are charged
+        — the native f64 engine reads the dense rows directly."""
+        if self.host_mode():
+            starts = np.concatenate(([0], np.cumsum(counts)))
+            return HOST_DISPATCH, starts
+        codes_dev, quals_dev, seg_ids, starts, F_pad = pad_segments(
+            codes2d, quals2d, counts)
+        return (self.device_call_segments(codes_dev, quals_dev, seg_ids,
+                                          F_pad), starts)
 
     def device_call_segments_sharded(self, codes3d, quals3d, seg_ids2d,
                                      num_segments: int, mesh):
@@ -591,7 +714,7 @@ class ConsensusKernel:
         dp, N, L = codes3d.shape
         DEVICE_STATS.add_dispatch(segments_flops(dp * N, L, dp * num_segments))
         return _consensus_segments_sharded_jit(
-            jnp.asarray(codes3d), jnp.asarray(quals3d), jnp.asarray(seg_ids2d),
+            np.asarray(codes3d), np.asarray(quals3d), np.asarray(seg_ids2d),
             self._correct_f32, self._err_f32, self._pre, num_segments, mesh)
 
     def device_call_segments_dp_sp(self, codes4, quals4, seg3,
@@ -602,7 +725,7 @@ class ConsensusKernel:
         DEVICE_STATS.add_dispatch(segments_flops(dp * sp * N, L,
                                                  dp * num_segments))
         return _consensus_segments_dp_sp_jit(
-            jnp.asarray(codes4), jnp.asarray(quals4), jnp.asarray(seg3),
+            np.asarray(codes4), np.asarray(quals4), np.asarray(seg3),
             self._correct_f32, self._err_f32, self._pre, num_segments, mesh)
 
     def resolve_segments(self, dev, codes2d: np.ndarray, quals2d: np.ndarray,
@@ -614,6 +737,14 @@ class ConsensusKernel:
         Returns (winner, qual, depth, errors) as (J, L) arrays with suspect
         positions recomputed exactly by the f64 oracle.
         """
+        if dev is HOST_DISPATCH:
+            engine = self._host()
+            winner, qual, depth, errors, n_slow = engine.call_segments_counted(
+                codes2d, quals2d, np.asarray(starts, dtype=np.int64))
+            with self._counter_lock:
+                self.total_positions += winner.size
+                self.fallback_positions += n_slow
+            return winner, qual, depth, errors
         packed = DEVICE_STATS.fetch(dev)
         return self._finish_segments(packed, codes2d, quals2d, starts)
 

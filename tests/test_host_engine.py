@@ -1,0 +1,168 @@
+"""Native f64 host consensus engine (ops/host_kernel.py) parity.
+
+The engine's contract is *bit-exactness* with the f64 oracle on every integer
+output — not closeness. These tests hammer exactly the seams where the design
+could leak: the depth-1/2 lookup tables (Q0/Q1 argmax weirdness), the
+saturation fast path boundary (g_min vs g_sat), Kahan -inf/NaN poisoning
+flows, and the oracle epilogue scatter. The CLI-level test pins the stronger
+end-to-end property: the host engine and the XLA device kernel produce
+byte-identical BAM output.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.native import batch as nb
+from fgumi_tpu.ops import oracle
+from fgumi_tpu.ops.host_kernel import HostConsensusEngine
+from fgumi_tpu.ops.tables import quality_tables
+
+pytestmark = pytest.mark.skipif(not nb.available(),
+                                reason="native library unavailable")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _check_segments(eng, tables, codes2d, quals2d, starts):
+    w, q, d, e = eng.call_segments(codes2d, quals2d, starts)
+    for j in range(len(starts) - 1):
+        ow, oq, od, oe = oracle.call_family(
+            codes2d[starts[j]:starts[j + 1]],
+            quals2d[starts[j]:starts[j + 1]], tables)
+        np.testing.assert_array_equal(w[j], ow)
+        np.testing.assert_array_equal(q[j], oq)
+        np.testing.assert_array_equal(d[j], od)
+        np.testing.assert_array_equal(e[j], oe)
+
+
+def test_adversarial_randomized_parity():
+    """Random ragged segments with hostile quals (0/1/2 heavy, Ns, clamping
+    above 93) never disagree with the oracle on any output."""
+    t = quality_tables(45, 40)
+    eng = HostConsensusEngine(t)
+    rng = np.random.default_rng(7)
+    pool = np.array([0, 0, 1, 1, 2, 3, 5, 10, 20, 30, 40, 60, 93, 94, 255],
+                    dtype=np.uint8)
+    for _ in range(60):
+        J = int(rng.integers(1, 12))
+        L = int(rng.integers(1, 40))
+        counts = rng.integers(1, 9, size=J)
+        starts = np.concatenate(([0], np.cumsum(counts))).astype(np.int64)
+        codes = rng.integers(0, 5, size=(int(starts[-1]), L)).astype(np.uint8)
+        quals = pool[rng.integers(0, len(pool), size=codes.shape)]
+        _check_segments(eng, t, codes, quals, starts)
+    assert eng.total_positions > 0
+
+
+def test_depth_tables_exhaustive():
+    """Every depth-1 pileup and a q>=1 depth-2 sweep match the oracle —
+    including the q<=1 inversions where the wrong lanes outscore the observed
+    base and the tie rule emits N."""
+    t = quality_tables(45, 40)
+    eng = HostConsensusEngine(t)
+    # depth 1: all 4 bases x all 94 quals as 376 one-read segments
+    b = np.repeat(np.arange(4, dtype=np.uint8), 94)
+    q = np.tile(np.arange(94, dtype=np.uint8), 4)
+    _check_segments(eng, t, b[:, None], q[:, None],
+                    np.arange(377, dtype=np.int64))
+    # depth 2: both orders of a (base, qual) grid slice, incl. q=0 (slow path)
+    rng = np.random.default_rng(1)
+    pairs = [(b1, q1, b2, q2)
+             for b1 in range(4) for b2 in range(4)
+             for q1 in (0, 1, 2, 17, 40, 93)
+             for q2 in (0, 1, 30, 93)]
+    codes = np.array([[p[0], p[2]] for p in pairs], dtype=np.uint8).reshape(-1, 1)
+    quals = np.array([[p[1], p[3]] for p in pairs], dtype=np.uint8).reshape(-1, 1)
+    starts = (np.arange(len(pairs) + 1) * 2).astype(np.int64)
+    _check_segments(eng, t, codes, quals, starts)
+
+
+def test_saturation_boundary_sweep():
+    """Families engineered to land near g_sat (uniform low quals at depths
+    2..6) straddle the fast/slow decision; both sides must stay oracle-exact."""
+    t = quality_tables(45, 40)
+    eng = HostConsensusEngine(t)
+    segs = []
+    for depth in range(2, 7):
+        for qv in range(2, 30):
+            segs.append((depth, qv))
+    counts = np.array([d for d, _ in segs])
+    starts = np.concatenate(([0], np.cumsum(counts))).astype(np.int64)
+    codes = np.zeros((int(starts[-1]), 3), dtype=np.uint8)  # unanimous A
+    quals = np.concatenate(
+        [np.full((d, 3), qv, dtype=np.uint8) for d, qv in segs])
+    _check_segments(eng, t, codes, quals, starts)
+    assert eng.slow_positions > 0  # the sweep must actually cross the band
+
+
+def test_q0_poisoning_orders():
+    """Q0 first / Q0 last / Q0 middle produce different Kahan -inf/NaN flows;
+    all must route to the slow path and match the oracle bit-for-bit."""
+    t = quality_tables(45, 40)
+    eng = HostConsensusEngine(t)
+    layouts = [
+        [(0, 0), (0, 30)], [(0, 30), (0, 0)],
+        [(0, 30), (0, 0), (0, 30)], [(0, 0), (0, 0)],
+        [(0, 0), (1, 30), (2, 30)], [(1, 30), (0, 0), (1, 35)],
+    ]
+    counts = np.array([len(x) for x in layouts])
+    starts = np.concatenate(([0], np.cumsum(counts))).astype(np.int64)
+    codes = np.array([b for lay in layouts for b, _ in lay],
+                     dtype=np.uint8)[:, None]
+    quals = np.array([q for lay in layouts for _, q in lay],
+                     dtype=np.uint8)[:, None]
+    _check_segments(eng, t, codes, quals, starts)
+
+
+def test_all_n_column_and_empty_tail():
+    """Columns with zero observations emit the no-call row the oracle does."""
+    t = quality_tables(45, 40)
+    eng = HostConsensusEngine(t)
+    codes = np.full((3, 4), 4, dtype=np.uint8)
+    codes[:, 0] = 1  # one real column
+    quals = np.full((3, 4), 30, dtype=np.uint8)
+    _check_segments(eng, t, codes, quals, np.array([0, 3], dtype=np.int64))
+
+
+def test_other_error_rate_pairs():
+    """g_sat/qual_const derive from the tables; sweep several (pre, post)."""
+    rng = np.random.default_rng(3)
+    for pre, post in [(90, 90), (10, 40), (30, 10), (93, 93)]:
+        t = quality_tables(pre, post)
+        eng = HostConsensusEngine(t)
+        counts = rng.integers(1, 7, size=8)
+        starts = np.concatenate(([0], np.cumsum(counts))).astype(np.int64)
+        codes = rng.integers(0, 5, size=(int(starts[-1]), 10)).astype(np.uint8)
+        quals = rng.integers(0, 94, size=codes.shape).astype(np.uint8)
+        _check_segments(eng, t, codes, quals, starts)
+
+
+def test_cli_host_vs_device_bytes(tmp_path):
+    """The full simplex CLI produces byte-identical BAMs with the host engine
+    forced on and forced off (XLA f32 + guard band + oracle patch)."""
+    sim = tmp_path / "grouped.bam"
+    subprocess.run(
+        [sys.executable, "-m", "fgumi_tpu", "simulate", "grouped-reads",
+         "-o", str(sim), "--num-families", "300",
+         "--family-size-distribution", "longtail",
+         "--read-length", "80", "--seed", "11"],
+        check=True, cwd=REPO, env={**os.environ, "PYTHONPATH": REPO})
+    outs = {}
+    for mode in ("1", "0"):
+        # same relative output path both times: the @PG CL header line
+        # embeds the command line, so the file names must match exactly
+        d = tmp_path / mode
+        d.mkdir()
+        out = d / "cons.bam"
+        subprocess.run(
+            [sys.executable, "-m", "fgumi_tpu", "simplex", "-i", str(sim),
+             "-o", "cons.bam", "--min-reads", "1", "--allow-unmapped"],
+            check=True, cwd=d,
+            env={**os.environ, "PYTHONPATH": REPO,
+                 "FGUMI_TPU_HOST_ENGINE": mode})
+        outs[mode] = out.read_bytes()
+    assert outs["1"] == outs["0"]

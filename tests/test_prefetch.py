@@ -90,11 +90,12 @@ def test_corrupt_header_stops_prefetch_thread(tmp_path):
 
     p = tmp_path / "corrupt.bam.gz"
     p.write_bytes(gzip.compress(b"not a bam header" * 500_000))
-    before = {t.name for t in threading.enumerate()}
+    before = set(threading.enumerate())  # objects, not names: any number of
+    # same-named prefetch threads may predate this test
     with pytest.raises(Exception):
         BamBatchReader(str(p))
     leaked = [t for t in threading.enumerate()
-              if t.name == "fgumi-prefetch" and t.name not in before
+              if t.name == "fgumi-prefetch" and t not in before
               and t.is_alive()]
     # give a just-stopped thread a beat to exit
     for t in leaked:

@@ -88,6 +88,10 @@ quals2d = rng.integers(25, 41, size=codes2d.shape, dtype=np.uint8)
 counts = np.full(n_fam, fam, dtype=np.int64)
 
 kernel = ConsensusKernel(quality_tables(45, 40))
+# this payload measures the XLA device kernel (TPU, or XLA-CPU as the
+# comparison baseline); never let the CPU fallback route to the host engine,
+# where the timed dispatch would be a no-op sentinel
+kernel._use_host = False
 codes_dev, quals_dev, seg_ids, starts, F_pad = pad_segments(
     codes2d, quals2d, counts)
 d = jax.devices()[0]

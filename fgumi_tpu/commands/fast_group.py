@@ -184,10 +184,9 @@ class FastGrouper:
         # orientation subgrouping + truncation + assignment (assign_group)
         rendered = [mi.render() for mi in self._assign_umis(umis, okeys)]
 
-        sizes = {}
-        for r in rendered:
-            sizes[r] = sizes.get(r, 0) + 1
-        for size in sizes.values():
+        from collections import Counter
+
+        for size in Counter(rendered).values():
             self.family_sizes[size] = self.family_sizes.get(size, 0) + 1
         self.position_group_sizes[total] = \
             self.position_group_sizes.get(total, 0) + 1
@@ -717,16 +716,29 @@ class FastGrouper:
         assigner = self.assigner
         if not assigner.split_by_orientation():
             return assigner.assign(self._truncate(umis))
-        subgroups = {}
-        for i, ok in enumerate(okeys):
-            subgroups.setdefault(ok, []).append(i)
+        # okeys are (r1_positive, r2_positive) bool pairs over (possibly)
+        # hundreds of thousands of templates: one numpy unique+argsort beats
+        # a per-template dict walk. Encoding the pair as r1*2+r2 preserves
+        # tuple lexicographic order (False < True), so the subgroup
+        # assignment order matches the scalar sorted(subgroups.items())
+        ok_arr = np.asarray(okeys, dtype=bool)
+        inv_raw = (ok_arr[:, 0].astype(np.int8) << 1) | ok_arr[:, 1]
+        uniq_ok, inv_ok = np.unique(inv_raw, return_inverse=True)
         mids = [None] * len(umis)
-        for _, idxs in sorted(subgroups.items()):
+        if len(uniq_ok) == 1:
+            sub = umis if self.no_umi else self._truncate(umis)
+            for i, mi in enumerate(assigner.assign(sub)):
+                mids[i] = mi
+            return mids
+        order = np.argsort(inv_ok, kind="stable")
+        bounds = np.searchsorted(inv_ok[order], np.arange(len(uniq_ok) + 1))
+        for g in range(len(uniq_ok)):
+            idxs = order[bounds[g]:bounds[g + 1]]
             sub = [umis[i] for i in idxs]
             if not self.no_umi:
                 sub = self._truncate(sub)
             for i, mi in zip(idxs, assigner.assign(sub)):
-                mids[i] = mi
+                mids[int(i)] = mi
         return mids
 
     def _truncate(self, umis):

@@ -243,7 +243,9 @@ class BgzfReader:
                 self._demote_to_zlib()
                 self._fill(need)
                 return
-            self._buf += decoded
+            # memoryview: bytearray += ndarray would dispatch to numpy's
+            # broadcasting __radd__ instead of a buffer append
+            self._buf += memoryview(decoded)
             del self._raw[:consumed]
             if consumed == 0 and self._raw:
                 if len(self._raw) >= 18 and not self._is_bgzf_member(self._raw):

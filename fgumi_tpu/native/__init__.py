@@ -46,7 +46,7 @@ def _declare(lib):
     the cached-build path and the FGUMI_TPU_NATIVE_SO override)."""
     lib.fgumi_bgzf_decompress.restype = ctypes.c_long
     lib.fgumi_bgzf_decompress.argtypes = [
-        ctypes.c_char_p, ctypes.c_long, ctypes.c_char_p, ctypes.c_long,
+        ctypes.c_void_p, ctypes.c_long, ctypes.c_void_p, ctypes.c_long,
         ctypes.POINTER(ctypes.c_long)]
     lib.fgumi_bgzf_compress_block.restype = ctypes.c_long
     lib.fgumi_bgzf_compress_block.argtypes = [
@@ -252,33 +252,43 @@ def get_lib():
 
 
 def bgzf_decompress(data, out_cap: int = None):
-    """Decompress complete BGZF blocks from `data` (bytes).
+    """Decompress complete BGZF blocks from `data` (bytes/bytearray/view).
 
-    Returns (decoded_bytes, consumed) or None when the native library is
-    unavailable. Raises ValueError on malformed input.
+    Returns (decoded, consumed) or None when the native library is
+    unavailable; `decoded` is a uint8 numpy array view over a fresh buffer
+    (callers append it to their own buffers — returning bytes would add a
+    full extra copy, and ctypes string buffers would add a zero-fill on top:
+    both showed up as ~0.3s/stage on chain profiles). Raises ValueError on
+    malformed input.
     """
+    import numpy as np
+
     lib = get_lib()
     if lib is None:
         return None
-    data = bytes(data)
-    n = len(data)
+    src = np.frombuffer(memoryview(data), dtype=np.uint8)  # zero-copy
+    n = len(src)
     # Spec bound: each block is >=26 bytes and expands to at most 64 KiB, so
     # the true output can never exceed this cap. An ISIZE claiming more is
     # corrupt — the codec returns -2 and we report it rather than growing.
     max_cap = (n // 26 + 1) * (1 << 16)
     if out_cap is None:
         out_cap = min(max(4 * n + (1 << 16), 1 << 16), max_cap)
-    out = ctypes.create_string_buffer(out_cap)
+    out = np.empty(out_cap, dtype=np.uint8)
     consumed = ctypes.c_long(0)
-    produced = lib.fgumi_bgzf_decompress(data, n, out, out_cap,
-                                         ctypes.byref(consumed))
+    produced = lib.fgumi_bgzf_decompress(src.ctypes.data, n, out.ctypes.data,
+                                         out_cap, ctypes.byref(consumed))
+    # release the caller's buffer BEFORE any raise: a ValueError traceback
+    # would otherwise pin this frame's view and turn the caller's recovery
+    # (`self._raw.clear()` in BgzfReader._demote_to_zlib) into a BufferError
+    src = None
     if produced == -2:
         if out_cap >= max_cap:
             raise ValueError("malformed BGZF block (ISIZE exceeds spec bound)")
         return bgzf_decompress(data, min(out_cap * 2, max_cap))
     if produced < 0:
         raise ValueError("malformed BGZF block")
-    return ctypes.string_at(out, produced), consumed.value
+    return out[:produced], consumed.value
 
 
 def zlib_compress(data: bytes, level: int = 1):
@@ -334,19 +344,21 @@ def bgzf_compress_many(data, level: int = 1, threads: int = None):
         return None
     if threads is None:
         threads = compress_threads()
-    data = bytes(data)
-    n = len(data)
+    src = np.frombuffer(memoryview(data), dtype=np.uint8)  # zero-copy
+    n = len(src)
     n_blocks = (n + 0xFEFF) // 0xFF00
     bound = 0xFF00 + (0xFF00 >> 2) + 64  # >= deflate bound + BGZF framing
     out = np.empty(max(n_blocks, 1) * bound, dtype=np.uint8)
     block_off = np.empty(n_blocks + 1, dtype=np.int64)
     n_out = ctypes.c_long(0)
     total = lib.fgumi_bgzf_compress_many(
-        data, n, level, threads, out.ctypes.data, len(out), bound,
+        src.ctypes.data, n, level, threads, out.ctypes.data, len(out), bound,
         block_off.ctypes.data, ctypes.byref(n_out))
+    src = None  # release the caller's buffer before any raise (see above)
     if total < 0:
         raise ValueError("BGZF multi-block compression failed")
-    return out[:total].tobytes(), block_off
+    # a view, not .tobytes(): callers hand it straight to file.write()
+    return out[:total], block_off
 
 
 def bgzf_compress_block(data: bytes, level: int = 1):

@@ -595,7 +595,15 @@ class ConsensusKernel:
 
             env = os.environ.get("FGUMI_TPU_HOST_ENGINE", "auto").lower()
             if env in ("1", "true", "force"):
-                self._use_host = True
+                from ..native import batch as nb
+
+                if not nb.available():
+                    import logging
+
+                    logging.getLogger("fgumi_tpu").warning(
+                        "FGUMI_TPU_HOST_ENGINE=1 but the native library is "
+                        "unavailable; using the device kernel")
+                self._use_host = nb.available()
             elif env in ("0", "false", "off"):
                 self._use_host = False
             else:

@@ -424,6 +424,13 @@ def _adjacency_bfs(unique, counts, graph: NeighborGraph):
 class AdjacencyUmiAssigner:
     """UMI-tools directed adjacency strategy."""
 
+    # above this many input strings, per-read Python loops (upper, Counter,
+    # fallback dict walk) dominate the whole group command; the vectorized
+    # path does uppercase/unique/count/map-back as numpy C passes over the
+    # full input and runs Python only per DISTINCT UMI. Byte-parity with the
+    # scalar path is pinned by tests/test_umi_assigners.py.
+    _VEC_THRESHOLD = 2048
+
     def __init__(self, max_mismatches: int = 1):
         self.max_mismatches = max_mismatches
         self.counter = _Counter()
@@ -431,9 +438,25 @@ class AdjacencyUmiAssigner:
     def split_by_orientation(self) -> bool:
         return True
 
+    def _assign_uniques(self, unique, counts):
+        """Molecule ids for (-count, string)-sorted valid unique UMIs.
+
+        Returns a list of MoleculeIds aligned with `unique`; id minting
+        order (roots in BFS-root order) is the shared contract of both the
+        scalar and vectorized assign paths."""
+        if len(unique) == 1:
+            return [MoleculeId("S", self.counter.next_id())]
+        mat = _umi_matrix(unique)
+        graph = build_neighbor_graph(mat, self.max_mismatches)
+        roots, root_of = _adjacency_bfs(unique, counts, graph)
+        root_ids = {r: MoleculeId("S", self.counter.next_id()) for r in roots}
+        return [root_ids[int(root_of[i])] for i in range(len(unique))]
+
     def assign(self, raw_umis):
         if not raw_umis:
             return []
+        if len(raw_umis) >= self._VEC_THRESHOLD:
+            return self._assign_vectorized(raw_umis)
         upper = [u.upper() for u in raw_umis]
         # count first, validate per DISTINCT string: distinct UMIs are a
         # small fraction of reads in large position groups, and the filtered
@@ -445,17 +468,41 @@ class AdjacencyUmiAssigner:
         _assert_uniform_length(len(u) for u, _ in counted)
         unique = [u for u, _ in counted]
         counts = [c for _, c in counted]
-        umi_to_id = {}
-        if len(unique) == 1:
-            umi_to_id[unique[0]] = MoleculeId("S", self.counter.next_id())
-        else:
-            mat = _umi_matrix(unique)
-            graph = build_neighbor_graph(mat, self.max_mismatches)
-            roots, root_of = _adjacency_bfs(unique, counts, graph)
-            root_ids = {r: MoleculeId("S", self.counter.next_id()) for r in roots}
-            for i, u in enumerate(unique):
-                umi_to_id[u] = root_ids[int(root_of[i])]
+        umi_to_id = dict(zip(unique, self._assign_uniques(unique, counts)))
         return _with_invalid_fallback(upper, lambda _i, u: umi_to_id.get(u), self.counter)
+
+    def _assign_vectorized(self, raw_umis):
+        """Large-group assign: numpy passes over the input, Python per
+        distinct UMI only. Semantics identical to the scalar path:
+
+        - valid uniques sorted by (-count, string) — np.unique returns
+          string-ascending uniques, so a stable sort by -count reproduces
+          _count_sorted_unique's order (filter-then-sort == sort-then-filter);
+        - valid molecule ids minted first (BFS-root order), then one id per
+          distinct invalid string in first-occurrence input order, exactly
+          as _with_invalid_fallback's forward walk mints them."""
+        arr = np.char.upper(np.asarray(raw_umis, dtype=np.str_))
+        uniq, first_idx, inverse, ucounts = np.unique(
+            arr, return_index=True, return_inverse=True, return_counts=True)
+        valid_mask = np.fromiter((_is_encodable(u) for u in uniq),
+                                 bool, len(uniq))
+        mids_u = np.empty(len(uniq), dtype=object)
+        valid_idx = np.nonzero(valid_mask)[0]
+        if len(valid_idx):
+            order = np.argsort(-ucounts[valid_idx], kind="stable")
+            sorted_idx = valid_idx[order]
+            unique = [str(uniq[i]) for i in sorted_idx]
+            _assert_uniform_length(len(u) for u in unique)
+            counts = ucounts[sorted_idx].tolist()
+            for i, mid in zip(sorted_idx,
+                              self._assign_uniques(unique, counts)):
+                mids_u[i] = mid
+        invalid_idx = np.nonzero(~valid_mask)[0]
+        if len(invalid_idx):
+            for i in invalid_idx[np.argsort(first_idx[invalid_idx],
+                                            kind="stable")]:
+                mids_u[i] = MoleculeId("S", self.counter.next_id())
+        return list(mids_u[inverse])
 
 
 class PairedUmiAssigner:

@@ -1,0 +1,31 @@
+"""docs/cli-reference.md is generated from the argparse tree and must not
+drift (the reference enforces the same via its xtask doc generation in CI,
+/root/reference/xtask/)."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_cli_reference_up_to_date():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import gen_cli_docs
+    finally:
+        sys.path.pop(0)
+    on_disk = open(os.path.join(REPO, "docs", "cli-reference.md")).read()
+    assert on_disk == gen_cli_docs.render(), (
+        "docs/cli-reference.md is stale; run python tools/gen_cli_docs.py")
+
+
+def test_every_command_documented():
+    from fgumi_tpu.cli import build_parser
+    import argparse
+
+    parser = build_parser()
+    sub = next(a for a in parser._actions
+               if isinstance(a, argparse._SubParsersAction))
+    text = open(os.path.join(REPO, "docs", "cli-reference.md")).read()
+    for name in sub.choices:
+        assert f"## fgumi-tpu {name}" in text

@@ -29,7 +29,7 @@ def test_decompress_multi_block_with_partial_tail():
     b = native.bgzf_compress_block(b"B" * 2000)
     stream = a + b
     decoded, consumed = native.bgzf_decompress(stream + b[:10])
-    assert decoded == b"A" * 1000 + b"B" * 2000
+    assert bytes(decoded) == b"A" * 1000 + b"B" * 2000
     assert consumed == len(stream)  # partial tail untouched
 
 
@@ -40,7 +40,7 @@ def test_decompress_malformed_raises():
 
 def test_decompress_eof_sentinel():
     decoded, consumed = native.bgzf_decompress(BGZF_EOF)
-    assert decoded == b""
+    assert bytes(decoded) == b""
     assert consumed == len(BGZF_EOF)
 
 
@@ -56,7 +56,7 @@ def test_native_and_zlib_blocks_interoperate():
     raw = buf.getvalue()
     assert zlib.decompress(raw, wbits=31) == data  # zlib side
     decoded, consumed = native.bgzf_decompress(raw)  # native side
-    assert decoded == data and consumed == len(raw)
+    assert bytes(decoded) == data and consumed == len(raw)
 
 
 def test_reader_uses_native_for_bgzf(tmp_path):
@@ -133,3 +133,19 @@ def test_truncated_stream_raises(tmp_path):
         r = BgzfReader(fh)
         with pytest.raises(ValueError):
             r.read(500)
+
+
+def test_corrupt_block_demotes_without_buffererror():
+    """A ValueError from the native decompressor must not pin the reader's
+    bytearray (zero-copy frombuffer view in a traceback frame): the
+    documented recovery path demotes to zlib, which clears self._raw."""
+    good = native.bgzf_compress_block(b"x" * 100)
+    bad = bytearray(native.bgzf_compress_block(b"y" * 5000))
+    bad[30:40] = b"\xff" * 10  # garbage deflate payload, valid header
+    stream = good + bytes(bad)
+    from fgumi_tpu.io.bgzf import BgzfReader
+
+    r = BgzfReader(io.BytesIO(stream))
+    with pytest.raises((ValueError, zlib.error, EOFError)):
+        while r.read(4096):
+            pass

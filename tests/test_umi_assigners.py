@@ -247,3 +247,44 @@ def test_adjacency_16k_group_matches_small_path():
     finally:
         A.DEVICE_THRESHOLD = old
     assert [m.render() for m in dev] == [m.render() for m in host]
+
+
+def test_adjacency_vectorized_matches_scalar_path():
+    """The >= _VEC_THRESHOLD numpy assign path must reproduce the scalar
+    path's MoleculeIds exactly — including the (-count, string) unique
+    order, BFS-root id minting, and first-occurrence invalid-UMI ids."""
+    import numpy as np
+
+    from fgumi_tpu.umi.assigners import AdjacencyUmiAssigner
+
+    rng = np.random.default_rng(11)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    true = rng.choice(bases, size=(300, 8))
+    arr = true[rng.integers(0, 300, size=4000)]
+    err = rng.random(arr.shape) < 0.02
+    arr = np.where(err, rng.choice(bases, size=arr.shape), arr)
+    umis = ["".join(chr(c) for c in row) for row in arr]
+    # sprinkle invalid + lowercase + tie-prone entries through the stream
+    umis[5] = "NNNNNNNN"
+    umis[17] = "acgtacgt"
+    umis[100] = "NNNNNNNN"
+    umis[2500] = "NNNNNNNA"
+    a = AdjacencyUmiAssigner(1)
+    a._VEC_THRESHOLD = 1  # force vectorized
+    vec = a.assign(umis)
+    b = AdjacencyUmiAssigner(1)
+    b._VEC_THRESHOLD = 1 << 30  # force scalar
+    scalar = b.assign(umis)
+    assert [m.render() for m in vec] == [m.render() for m in scalar]
+
+
+def test_adjacency_vectorized_all_invalid():
+    from fgumi_tpu.umi.assigners import AdjacencyUmiAssigner
+
+    umis = ["NNNNNNNN", "NNNNNNNA", "NNNNNNNN", "NNNNNNNB"] * 600
+    a = AdjacencyUmiAssigner(1)
+    a._VEC_THRESHOLD = 1
+    vec = a.assign(umis)
+    b = AdjacencyUmiAssigner(1)
+    b._VEC_THRESHOLD = 1 << 30
+    assert [m.render() for m in vec] == [m.render() for m in b.assign(umis)]

@@ -1,0 +1,140 @@
+#!/usr/bin/env python3
+"""Render docs/cli-reference.md from the live argparse tree.
+
+The reference generates its command docs from the CLI definitions via an
+xtask (/root/reference/xtask/, wired into CI so the docs cannot drift); this
+is the same discipline for fgumi-tpu: one source of truth (cli.build_parser),
+one generated artifact, and tests/test_cli_docs.py asserting the checked-in
+file matches a fresh render.
+
+Usage:  python tools/gen_cli_docs.py            # rewrite docs/cli-reference.md
+        python tools/gen_cli_docs.py --check    # exit 1 if out of date
+"""
+
+import argparse
+import io
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OUT = os.path.join(REPO, "docs", "cli-reference.md")
+
+HEADER = """\
+# fgumi-tpu CLI reference
+
+<!-- GENERATED FILE — do not edit. Rebuild with `python tools/gen_cli_docs.py`;
+     tests/test_cli_docs.py fails when this file drifts from the CLI. -->
+"""
+
+
+def _actions_table(parser):
+    """One markdown table of a parser's visible optional arguments."""
+    rows = []
+    for a in parser._actions:
+        if a.help == argparse.SUPPRESS:
+            continue
+        if isinstance(a, (argparse._SubParsersAction, argparse._HelpAction)):
+            continue
+        if a.option_strings:
+            name = ", ".join(f"`{o}`" for o in a.option_strings)
+        else:
+            name = f"`{a.dest}`"
+        meta = ""
+        if a.choices is not None:
+            meta = "{" + ", ".join(str(c) for c in a.choices) + "}"
+        elif a.nargs not in (0, None) or (a.option_strings
+                                          and a.const is None
+                                          and not isinstance(
+                                              a, argparse._StoreTrueAction)):
+            meta = (a.metavar or a.dest or "").upper() if not isinstance(
+                a, (argparse._StoreTrueAction,
+                    argparse._StoreFalseAction)) else ""
+        default = ""
+        if a.default not in (None, argparse.SUPPRESS, False) \
+                and a.option_strings:
+            default = f"`{a.default}`"
+        req = "yes" if getattr(a, "required", False) else ""
+        help_text = (a.help or "").replace("|", "\\|").replace("\n", " ")
+        rows.append((name, meta, req, default, help_text))
+    if not rows:
+        return ""
+    buf = io.StringIO()
+    buf.write("| option | value | required | default | description |\n")
+    buf.write("|---|---|---|---|---|\n")
+    for name, meta, req, default, help_text in rows:
+        buf.write(f"| {name} | {meta} | {req} | {default} | {help_text} |\n")
+    return buf.getvalue()
+
+
+def _walk(parser, title, depth, buf):
+    buf.write(f"\n{'#' * depth} {title}\n\n")
+    if parser.description:
+        buf.write(parser.description.strip() + "\n\n")
+    buf.write(f"```\n{parser.format_usage().strip()}\n```\n\n")
+    table = _actions_table(parser)
+    if table:
+        buf.write(table)
+    for a in parser._actions:
+        if isinstance(a, argparse._SubParsersAction):
+            for name, sub in a.choices.items():
+                _walk(sub, f"{title} {name}", min(depth + 1, 5), buf)
+
+
+def render() -> str:
+    from fgumi_tpu.cli import build_parser
+
+    # argparse wraps usage lines to the terminal width; pin it so the
+    # generated file (and the drift test) are environment-independent
+    prev = os.environ.get("COLUMNS")
+    os.environ["COLUMNS"] = "100"
+    try:
+        parser = build_parser()
+        return _render_with(parser)
+    finally:
+        if prev is None:
+            os.environ.pop("COLUMNS", None)
+        else:
+            os.environ["COLUMNS"] = prev
+
+
+def _render_with(parser) -> str:
+    buf = io.StringIO()
+    buf.write(HEADER)
+    buf.write("\nGenerated from `fgumi_tpu.cli.build_parser()`. "
+              "Every tool below is also documented by `fgumi-tpu <tool> -h`.\n")
+    sub = next(a for a in parser._actions
+               if isinstance(a, argparse._SubParsersAction))
+    # one-line summaries live in add_parser(help=...), not .description
+    helps = {ca.dest: (ca.help or "") for ca in sub._choices_actions}
+    buf.write("\n## Tools\n\n")
+    for name, p in sub.choices.items():
+        desc = (helps.get(name) or (p.description or "")).strip()
+        desc = desc.split("\n")[0]
+        buf.write(f"- [`{name}`](#fgumi-tpu-{name}) — {desc}\n")
+    for name, p in sub.choices.items():
+        _walk(p, f"fgumi-tpu {name}", 2, buf)
+    return buf.getvalue()
+
+
+def main():
+    check = "--check" in sys.argv[1:]
+    text = render()
+    if check:
+        on_disk = open(OUT).read() if os.path.exists(OUT) else ""
+        if on_disk != text:
+            print(f"{OUT} is out of date; run python tools/gen_cli_docs.py",
+                  file=sys.stderr)
+            return 1
+        print("cli-reference.md up to date")
+        return 0
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        f.write(text)
+    print(f"wrote {OUT} ({len(text)} bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

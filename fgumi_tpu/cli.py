@@ -680,10 +680,21 @@ def _add_compare(sub):
     b = ps.add_parser("bams", help="Compare two BAMs (exit 1 on mismatch)")
     b.add_argument("-a", required=True, help="first BAM")
     b.add_argument("-b", required=True, help="second BAM")
-    b.add_argument("--mode", choices=["content", "grouping"], default="content",
+    b.add_argument("--mode", choices=["content", "grouping"], default=None,
                    help="content: exact record compare; grouping: MI-invariant "
-                        "molecule equivalence")
-    b.add_argument("--ignore-order", action="store_true",
+                        "molecule equivalence (default: content, or the "
+                        "--command preset's mode)")
+    b.add_argument("--command", default=None, dest="preset",
+                   choices=["extract", "zipper", "sort", "correct", "dedup",
+                            "clip", "filter", "group", "simplex", "duplex",
+                            "codec"],
+                   help="canonical mode/ignore-order defaults for comparing "
+                        "the output of one pipeline stage (reference "
+                        "compare/bams.rs CommandPreset): group -> grouping "
+                        "mode; sort -> the sort-verify engine; everything "
+                        "else -> exact content. Explicit --mode/"
+                        "--ignore-order override the preset")
+    b.add_argument("--ignore-order", action="store_true", default=None,
                    help="content mode: compare as multisets")
     b.add_argument("--ignore-tags", nargs="*", default=[],
                    help="tags excluded from comparison")

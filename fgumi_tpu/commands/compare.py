@@ -156,12 +156,23 @@ def compare_bams_content(path_a: str, path_b: str, ignore_order: bool = False,
     return mismatches
 
 
+def _mi_of(rec, tag: bytes):
+    """Group-tag value as a string: string aux, or the integer aux form some
+    tools emit (reference record_key.rs get_mi_tag_raw parses both)."""
+    mi = rec.get_str(tag)
+    if mi is None:
+        v = rec.get_int(tag)
+        if v is not None:
+            return str(v)
+    return mi
+
+
 def _iter_molecules(reader, tag: bytes):
     """Yield (records,) runs of consecutive equal group-tag values."""
     current = None
     run = []
     for rec in reader:
-        mi = rec.get_str(tag)
+        mi = _mi_of(rec, tag)
         if mi is None:
             raise ValueError(f"record {rec.name!r} missing {tag.decode()} tag")
         base = mi[:-2] if mi.endswith(("/A", "/B")) else mi
@@ -191,7 +202,7 @@ def _molecule_summary(records, ignore_tags, tag: bytes):
     content = Counter(record_fingerprint(r, ignore) for r in records)
     strands = {}
     for r in records:
-        mi = r.get_str(tag) or ""
+        mi = _mi_of(r, tag) or ""
         strand = mi[-1] if mi.endswith(("/A", "/B")) else None
         strands[(r.name, r.flag & (FLAG_FIRST | FLAG_LAST))] = strand
     return canonical, membership, content, strands
@@ -344,7 +355,42 @@ def verify_sort_order(path: str) -> list:
 
 # ------------------------------------------------------------------ CLI glue
 
+# --command preset -> (mode, ignore_order, also_verify_sort): canonical
+# comparison settings per pipeline stage (reference compare/bams.rs
+# CommandPreset::resolve, bams.rs:178-206). group is the only preset that
+# verifies grouping equivalence instead of positional content; sort verifies
+# each input's declared order and compares content as a multiset (tie
+# reordering within equal sort keys is legitimate); every other stage is
+# deterministic exact content.
+_PRESETS = {
+    "extract": ("content", False, False),
+    "zipper": ("content", False, False),
+    "correct": ("content", False, False),
+    "dedup": ("content", False, False),
+    "clip": ("content", False, False),
+    "filter": ("content", False, False),
+    "simplex": ("content", False, False),
+    "duplex": ("content", False, False),
+    "codec": ("content", False, False),
+    "group": ("grouping", True, False),
+    "sort": ("content", True, True),
+}
+
+
 def run_compare_bams(args) -> int:
+    preset = getattr(args, "preset", None)
+    if preset is not None:
+        p_mode, p_ignore, p_verify = _PRESETS[preset]
+        if args.mode is None:
+            args.mode = p_mode
+        if args.ignore_order is None:
+            args.ignore_order = p_ignore
+        if p_verify:
+            args.verify_sort = True
+    if args.mode is None:
+        args.mode = "content"
+    if args.ignore_order is None:
+        args.ignore_order = False
     ignore_tags = frozenset(t.encode() for t in (args.ignore_tags or []))
     if getattr(args, "verify_sort", False):
         sort_mismatches = []

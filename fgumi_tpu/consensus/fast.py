@@ -1027,23 +1027,85 @@ def overlap_correct_span(batch, idx, bounds, g0, g1, oc):
                 r1_offs = batch.data_off[a]
                 r2_offs = batch.data_off[b]
     if not adjacent_ok:
+        # vectorized (group, name-hash) pairing: keys with exactly one
+        # FIRST and one LAST row pair directly (names confirmed by one
+        # batched ranges_equal — a hash collision or any odd key shape
+        # sends just that group to the per-record dict pairing, whose
+        # last-writer-wins semantics stay the reference for weird inputs)
         r1_offs = []
         r2_offs = []
-        for g in range(g0, g1):
-            members = idx[bounds[g]:bounds[g + 1]]
-            pairs = {}
-            for i in members:
-                f = int(flag[i])
-                # secondary/supplementary were already filtered from idx
-                slot = pairs.setdefault(batch.name(int(i)), [None, None])
-                if f & FLAG_FIRST:
-                    slot[0] = int(i)
-                elif f & FLAG_LAST:
-                    slot[1] = int(i)
-            for a, b in pairs.values():
-                if a is not None and b is not None:
-                    r1_offs.append(batch.data_off[a])
-                    r2_offs.append(batch.data_off[b])
+        bad_groups = set()
+        rel_bounds = bounds[g0:g1 + 1] - bounds[g0]
+        g_of = np.repeat(np.arange(g1 - g0), np.diff(rel_bounds))
+        fl_first = (f_span & FLAG_FIRST) != 0
+        fl_last = ((f_span & FLAG_LAST) != 0) & ~fl_first
+        rid = np.nonzero(fl_first | fl_last)[0]
+        if len(rid):
+            name_off_s = batch.data_off[span[rid]] + 32
+            name_len_s = (batch.l_read_name[span[rid]] - 1).astype(np.int32)
+            h = nb.hash_ranges(batch.buf, name_off_s, name_len_s)
+            o = np.lexsort((h, g_of[rid]))
+            gg, hh = g_of[rid][o], h[o]
+            newkey = np.concatenate(
+                ([True], (gg[1:] != gg[:-1]) | (hh[1:] != hh[:-1])))
+            kb = np.nonzero(np.concatenate((newkey, [True])))[0]
+            sizes = np.diff(kb)
+            two = np.nonzero(sizes == 2)[0]
+            big = np.nonzero(sizes > 2)[0]
+            if len(big):
+                bad_groups.update(np.unique(gg[kb[big]]).tolist())
+            if len(two):
+                ra = rid[o[kb[two]]]
+                rb = rid[o[kb[two] + 1]]
+                one_first = fl_first[ra] ^ fl_first[rb]
+                # orient: FIRST -> a slot, LAST -> b slot
+                swap = ~fl_first[ra]
+                ra2 = np.where(swap, rb, ra)
+                rb2 = np.where(swap, ra, rb)
+                a_rows = span[ra2]
+                b_rows = span[rb2]
+                same_name = nb.ranges_equal(
+                    batch.buf, batch.data_off[a_rows] + 32,
+                    (batch.l_read_name[a_rows] - 1).astype(np.int32),
+                    batch.data_off[b_rows] + 32,
+                    (batch.l_read_name[b_rows] - 1).astype(np.int32)
+                ).astype(bool)
+                ok = one_first & same_name
+                pair_g = g_of[ra]
+                bad_groups.update(np.unique(pair_g[~ok]).tolist())
+                # a bad group's rows pair in the dict fallback below —
+                # keeping its vectorized pairs would correct them twice
+                if bad_groups:
+                    bad_arr = np.fromiter(bad_groups, dtype=np.int64,
+                                          count=len(bad_groups))
+                    ok &= ~np.isin(pair_g, bad_arr)
+                r1_offs = batch.data_off[a_rows[ok]]
+                r2_offs = batch.data_off[b_rows[ok]]
+        if bad_groups:
+            extra_a = []
+            extra_b = []
+            for g_rel in sorted(bad_groups):
+                g = g0 + int(g_rel)
+                members = idx[bounds[g]:bounds[g + 1]]
+                pairs = {}
+                for i in members:
+                    f = int(flag[i])
+                    # secondary/supplementary were already filtered from idx
+                    slot = pairs.setdefault(batch.name(int(i)), [None, None])
+                    if f & FLAG_FIRST:
+                        slot[0] = int(i)
+                    elif f & FLAG_LAST:
+                        slot[1] = int(i)
+                for a, b in pairs.values():
+                    if a is not None and b is not None:
+                        extra_a.append(batch.data_off[a])
+                        extra_b.append(batch.data_off[b])
+            r1_offs = np.concatenate(
+                [np.asarray(r1_offs, dtype=np.int64),
+                 np.asarray(extra_a, dtype=np.int64)])
+            r2_offs = np.concatenate(
+                [np.asarray(r2_offs, dtype=np.int64),
+                 np.asarray(extra_b, dtype=np.int64)])
     if len(r1_offs) == 0:
         return
     stats = nb.overlap_correct_pairs(

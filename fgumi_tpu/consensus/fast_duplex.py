@@ -715,12 +715,47 @@ class FastDuplexCaller:
                                 .tobytes().decode())
             return vals
 
+        def emit(k, rx):
+            arr = np.frombuffer(rx.encode(), dtype=np.uint8)
+            keep_alive.append(arr)
+            rx_addr[k] = arr.ctypes.data
+            rx_len[k] = len(rx)
+
         fams = []
         fam_ks = []
         for k, spec in enumerate(out_specs):
             # AB-seg values verbatim, BA-seg values flipped — BOTH segs of
             # the branch contribute, independent of consensus aliveness
             a_s, b_s = spec[6], spec[7]
+            # fast path: when every contributing seg is unanimous, the
+            # family holds at most two distinct values — if they agree, the
+            # consensus is that value (simple_umi's all-equal rule: verbatim
+            # for a single read, ACGTN-uppercased otherwise), with no
+            # per-read list or likelihood call. This is ~every real duplex
+            # molecule (a-strand RX == flip(b-strand RX)).
+            svals = []
+            simple = True
+            for s, flip in ((a_s, False), (b_s, True)):
+                if s < 0 or una_off[s] == -1:
+                    continue
+                if una_off[s] == -2:
+                    simple = False
+                    break
+                v = buf[una_off[s]:una_off[s] + una_len[s]].tobytes().decode()
+                if flip:
+                    v = _flip_umi(v)
+                svals.append((v, int(cnt[s])))
+            if simple:
+                if not svals:
+                    continue
+                total = sum(c for _, c in svals)
+                if total == 1:
+                    emit(k, svals[0][0])
+                    continue
+                if all(v == svals[0][0] for v, _ in svals):
+                    emit(k, "".join(c.upper() if c.upper() in "ACGTN" else c
+                                    for c in svals[0][0]))
+                    continue
             vals = []
             for s, flip in ((a_s, False), (b_s, True)):
                 if s < 0:
@@ -743,8 +778,5 @@ class FastDuplexCaller:
             fams.append(vals)
             fam_ks.append(k)
         for k, rx in zip(fam_ks, consensus_umis_batch(fams)):
-            arr = np.frombuffer(rx.encode(), dtype=np.uint8)
-            keep_alive.append(arr)
-            rx_addr[k] = arr.ctypes.data
-            rx_len[k] = len(rx)
+            emit(k, rx)
         return rx_addr, rx_len, keep_alive

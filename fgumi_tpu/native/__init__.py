@@ -354,7 +354,11 @@ def bgzf_compress_many(data, level: int = 1, threads: int = None):
     total = lib.fgumi_bgzf_compress_many(
         src.ctypes.data, n, level, threads, out.ctypes.data, len(out), bound,
         block_off.ctypes.data, ctypes.byref(n_out))
-    src = None  # release the caller's buffer before any raise (see above)
+    # release the caller's buffer before any raise (see bgzf_decompress) —
+    # including `data` itself, which is typically the caller's memoryview
+    # export over a bytearray it will resize during cleanup
+    src = None
+    data = None
     if total < 0:
         raise ValueError("BGZF multi-block compression failed")
     # a view, not .tobytes(): callers hand it straight to file.write()

@@ -162,6 +162,37 @@ _TIE_GUARD_FLOOR = 1e-5  # ln units; exact-tie ulp jitter
 # runs the native f64 engine on the rows it receives (no device round-trip)
 HOST_DISPATCH = ("host-dispatch",)
 
+
+def use_host_engine() -> bool:
+    """Whether consensus dispatches route to the native f64 host engine.
+
+    Uncached on purpose (kernel instances cache per-instance): tests flip
+    FGUMI_TPU_HOST_ENGINE between in-process CLI runs. Env semantics as in
+    ConsensusKernel.host_mode."""
+    import os
+
+    env = os.environ.get("FGUMI_TPU_HOST_ENGINE", "auto").lower()
+    from ..native import batch as nb
+
+    if env in ("1", "true", "force"):
+        if not nb.available():
+            import logging
+
+            logging.getLogger("fgumi_tpu").warning(
+                "FGUMI_TPU_HOST_ENGINE=1 but the native library is "
+                "unavailable; using the device kernel")
+        return nb.available()
+    if env in ("0", "false", "off"):
+        return False
+    if not nb.available():
+        return False
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        # CPU explicitly pinned: decide without importing jax (the whole
+        # point of host mode on a multi-process chain)
+        return True
+    _ensure_jax()
+    return jax.default_backend() == "cpu"
+
 # bf16 systolic peak FLOP/s and HBM GB/s per chip, keyed by substrings of
 # jax device_kind — for the MFU/bandwidth utilization estimate below. The
 # consensus kernel is VPU/elementwise-dominated, so low MFU is expected and
@@ -591,34 +622,7 @@ class ConsensusKernel:
         (jax backend == cpu) and the native library is available.
         FGUMI_TPU_HOST_ENGINE=1/0 forces either way (parity tests run both)."""
         if self._use_host is None:
-            import os
-
-            env = os.environ.get("FGUMI_TPU_HOST_ENGINE", "auto").lower()
-            if env in ("1", "true", "force"):
-                from ..native import batch as nb
-
-                if not nb.available():
-                    import logging
-
-                    logging.getLogger("fgumi_tpu").warning(
-                        "FGUMI_TPU_HOST_ENGINE=1 but the native library is "
-                        "unavailable; using the device kernel")
-                self._use_host = nb.available()
-            elif env in ("0", "false", "off"):
-                self._use_host = False
-            else:
-                from ..native import batch as nb
-
-                if not nb.available():
-                    self._use_host = False
-                elif os.environ.get("JAX_PLATFORMS",
-                                    "").strip().lower() == "cpu":
-                    # CPU explicitly pinned: decide without importing jax
-                    # (the whole point of host mode on a multi-process chain)
-                    self._use_host = True
-                else:
-                    _ensure_jax()
-                    self._use_host = jax.default_backend() == "cpu"
+            self._use_host = use_host_engine()
         return self._use_host
 
     def _host(self):

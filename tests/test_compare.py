@@ -235,3 +235,64 @@ def test_verify_sort_template_coordinate_and_queryname(tmp_path):
         assert verify_sort_order(out) == [], order
     # the unsorted simulate output declares no verifiable order -> no findings
     assert verify_sort_order(sim) == []
+
+
+def test_command_presets(grouped_bam, tmp_path):
+    """--command applies the stage's canonical mode/ignore-order defaults
+    (reference compare/bams.rs CommandPreset)."""
+    same = str(tmp_path / "same.bam")
+    _rewrite(grouped_bam, same, lambda i, d: d)
+    # exact-content presets pass on identical files
+    for preset in ("extract", "filter", "simplex", "clip"):
+        assert main(["compare", "bams", "-a", grouped_bam, "-b", same,
+                     "--command", preset]) == 0
+    # group preset: MI renumbering is accepted (grouping mode)...
+    renum = str(tmp_path / "renum.bam")
+
+    def bump_mi(i, d):
+        rec = RawRecord(d)
+        mi = rec.get_str(b"MI")
+        return rec.data_without_tag(b"MI") + b"MIZ" + \
+            str(int(mi) + 1000).encode() + b"\x00"
+
+    _rewrite(grouped_bam, renum, bump_mi)
+    assert main(["compare", "bams", "-a", grouped_bam, "-b", renum,
+                 "--command", "group"]) == 0
+    # ...but the simplex preset (exact content) rejects it
+    assert main(["compare", "bams", "-a", grouped_bam, "-b", renum,
+                 "--command", "simplex"]) == 1
+    # explicit --mode overrides the preset
+    assert main(["compare", "bams", "-a", grouped_bam, "-b", renum,
+                 "--command", "group", "--mode", "content"]) == 1
+
+
+def test_sort_preset_verifies_order_and_tolerates_tie_swaps(tmp_path):
+    sim = str(tmp_path / "s.bam")
+    simulate_grouped_bam(sim, num_families=8, family_size=3, read_length=40,
+                         seed=5)
+    a = str(tmp_path / "a.bam")
+    b = str(tmp_path / "b.bam")
+    main(["sort", "-i", sim, "-o", a, "--order", "coordinate"])
+    main(["sort", "-i", sim, "-o", b, "--order", "coordinate"])
+    assert main(["compare", "bams", "-a", a, "-b", b,
+                 "--command", "sort"]) == 0
+
+
+def test_grouping_mode_accepts_integer_mi(tmp_path):
+    """Integer-typed MI aux values parse like their string form
+    (reference record_key.rs get_mi_tag_raw)."""
+    import struct
+
+    sim = str(tmp_path / "g.bam")
+    simulate_grouped_bam(sim, num_families=6, family_size=3, read_length=40,
+                         seed=3)
+    as_int = str(tmp_path / "int_mi.bam")
+
+    def to_int_mi(i, d):
+        rec = RawRecord(d)
+        mi = int(rec.get_str(b"MI"))
+        return rec.data_without_tag(b"MI") + b"MIi" + struct.pack("<i", mi)
+
+    _rewrite(sim, as_int, to_int_mi)
+    assert main(["compare", "bams", "-a", sim, "-b", as_int,
+                 "--mode", "grouping"]) == 0

@@ -52,8 +52,10 @@ def _actions_table(parser):
                 a, (argparse._StoreTrueAction,
                     argparse._StoreFalseAction)) else ""
         default = ""
-        if a.default not in (None, argparse.SUPPRESS, False) \
-                and a.option_strings:
+        # identity checks: `0 in (..., False)` is True, which would hide
+        # legitimate numeric-zero defaults (--threads 0, --qual-slope 0.0)
+        if (a.default is not None and a.default is not argparse.SUPPRESS
+                and a.default is not False and a.option_strings):
             default = f"`{a.default}`"
         req = "yes" if getattr(a, "required", False) else ""
         help_text = (a.help or "").replace("|", "\\|").replace("\n", " ")

@@ -195,17 +195,39 @@ def _build_dp_mesh(devices_arg):
     # skip the jax import/backend init entirely (host-engine cold-start path)
     if (os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
             and "host_platform_device_count"
-            not in os.environ.get("XLA_FLAGS", "")):
+            not in os.environ.get("XLA_FLAGS", "")
+            and not os.environ.get("FGUMI_TPU_COORDINATOR")):
         return None
+    # multi-host: join the process group BEFORE the first backend touch so
+    # jax.devices() below is the global device list (parallel/distributed.py)
+    from .parallel.distributed import initialize_from_env
+
+    dist = initialize_from_env()
     import jax
 
     devs = jax.devices()
+    sp_env = os.environ.get("FGUMI_TPU_SP", "1")
+    sp = max(int(sp_env), 1) if sp_env.isdigit() else 1
+    if dist:
+        # every process must participate with all of its local devices
+        # (shard_map cannot run on a mesh missing the caller's devices),
+        # and sp groups must stay on one host's ICI — make_global_mesh
+        # enforces both; an explicit --devices count cannot apply here
+        if devices_arg not in (None, "auto") and int(devices_arg) != len(devs):
+            log.warning("--devices %s ignored in multi-host mode: the mesh "
+                        "uses all %d global devices", devices_arg, len(devs))
+        local = len(jax.local_devices())
+        if local % sp != 0:
+            log.warning("FGUMI_TPU_SP=%d does not divide the per-host "
+                        "device count %d; using sp=1", sp, local)
+            sp = 1
+        from .parallel.distributed import make_global_mesh
+
+        return make_global_mesh(sp=sp)
     n = len(devs) if devices_arg in (None, "auto") else int(devices_arg)
     n = max(1, min(n, len(devs)))
     if n <= 1:
         return None
-    sp_env = os.environ.get("FGUMI_TPU_SP", "1")
-    sp = max(int(sp_env), 1) if sp_env.isdigit() else 1
     if n % sp != 0:
         log.warning("FGUMI_TPU_SP=%d does not divide device count %d; "
                     "using sp=1", sp, n)
@@ -694,8 +716,11 @@ def _add_compare(sub):
                         "mode; sort -> the sort-verify engine; everything "
                         "else -> exact content. Explicit --mode/"
                         "--ignore-order override the preset")
-    b.add_argument("--ignore-order", action="store_true", default=None,
-                   help="content mode: compare as multisets")
+    b.add_argument("--ignore-order", type=_parse_bool, nargs="?",
+                   const=True, default=None,
+                   help="content mode: compare as multisets (true/false; "
+                        "an explicit value overrides a --command preset in "
+                        "either direction)")
     b.add_argument("--ignore-tags", nargs="*", default=[],
                    help="tags excluded from comparison")
     b.add_argument("--tag", default="MI", help="grouping tag (grouping mode)")

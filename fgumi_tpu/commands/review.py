@@ -300,19 +300,32 @@ def run_review(args) -> int:
         return 2
 
     log.info("review: %d variant sites loaded", len(variants))
-    variant_index = _index_variants(variants)
 
     # Pass 1: consensus BAM — select non-reference reads per variant, and
     # pileup site base counts over ALL consensus reads covering each variant
     # (dedup by (base, read name), review.rs:989-1002 / REV3-02).
-    per_variant_consensus = {id(v): [] for v in variants}
-    consensus_site_counts = {id(v): BaseCounts() for v in variants}
     site_seen = set()
     selected_mis = set()
     n_consensus_out = 0
     with BamReader(args.consensus_bam) as reader:
         ref_names = reader.header.ref_names
         header = reader.header
+        # reference parity (review.rs:283-298, fgumi issue #497): variants
+        # process — and TSV rows emit — in sequence-dictionary coordinate
+        # order regardless of the input file's order; a variant on a contig
+        # absent from the dictionary is an error, as in fgbio
+        dict_order = {n.decode() if isinstance(n, bytes) else n: i
+                      for i, n in enumerate(ref_names)}
+        missing = sorted({v.chrom for v in variants
+                          if v.chrom not in dict_order})
+        if missing:
+            log.error("review: variant contig(s) %s not in the BAM "
+                      "sequence dictionary", ", ".join(missing))
+            return 2
+        variants.sort(key=lambda v: (dict_order[v.chrom], v.pos))
+        variant_index = _index_variants(variants)
+        per_variant_consensus = {id(v): [] for v in variants}
+        consensus_site_counts = {id(v): BaseCounts() for v in variants}
         with BamWriter(args.output + ".consensus.bam", header) as writer:
             for rec in reader:
                 overlapping = _variants_overlapping(variant_index, rec,

@@ -1,0 +1,99 @@
+"""Multi-host execution: the jax.distributed backend for fgumi-tpu.
+
+The reference scales with an in-process thread pool on one machine
+(/root/reference/src/lib/unified_pipeline/scheduler/mod.rs:70-178); the
+TPU-native analog of "more workers" is more chips, and past one host that
+means a jax.distributed process group: one Python process per host, a
+coordinator address, and a GLOBAL device mesh whose collectives are placed
+by XLA onto ICI within a host/slice and DCN across hosts.
+
+Axis placement policy (the scaling-book recipe applied to this workload):
+
+- ``dp`` (families) carries NO collectives — families are independent — so
+  it is the axis allowed to span hosts: the only cross-host traffic is the
+  initial shard distribution, which rides DCN regardless.
+- ``sp`` (reads within a family) carries the hot-path ``psum`` of partial
+  likelihood reductions, so sp groups are always built from one process's
+  LOCAL devices: the psum stays on ICI, never DCN.
+
+`device_grid` is pure (testable on any device list); `initialize_from_env`
+wires the standard JAX coordinator env contract so a Snakemake/sbatch-style
+launcher can start N identical processes:
+
+    FGUMI_TPU_COORDINATOR=host0:8476 FGUMI_TPU_NUM_PROCESSES=4 \\
+    FGUMI_TPU_PROCESS_ID=$RANK fgumi-tpu simplex ... --devices auto
+"""
+
+import logging
+import os
+
+log = logging.getLogger("fgumi_tpu")
+
+_initialized = False
+
+
+def initialize_from_env() -> bool:
+    """jax.distributed.initialize from FGUMI_TPU_COORDINATOR /
+    _NUM_PROCESSES / _PROCESS_ID (idempotent; False = single-process run).
+
+    Must run before the first backend touch in each process; _build_dp_mesh
+    calls it ahead of jax.devices().
+    """
+    global _initialized
+    coord = os.environ.get("FGUMI_TPU_COORDINATOR")
+    if _initialized or not coord:
+        return _initialized
+    num = int(os.environ.get("FGUMI_TPU_NUM_PROCESSES", "0"))
+    pid = int(os.environ.get("FGUMI_TPU_PROCESS_ID", "-1"))
+    if num <= 0 or pid < 0:
+        raise ValueError(
+            "FGUMI_TPU_COORDINATOR requires FGUMI_TPU_NUM_PROCESSES and "
+            "FGUMI_TPU_PROCESS_ID")
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=num, process_id=pid)
+    _initialized = True
+    log.info("distributed: process %d/%d via %s; %d global / %d local "
+             "devices", pid, num, coord, len(jax.devices()),
+             len(jax.local_devices()))
+    return True
+
+
+def device_grid(devices, local_count: int, sp: int = 1):
+    """Arrange a host-major global device list into a (dp, sp) grid where
+    every sp group lies within one host's `local_count` block.
+
+    jax.devices() orders devices by process, so rows of the returned
+    (dp, sp) array that split the read axis never cross a host boundary —
+    the construction that keeps the sp psum on ICI. Raises when sp does not
+    divide the per-host device count.
+    """
+    import numpy as np
+
+    n = len(devices)
+    if local_count <= 0 or n % local_count != 0:
+        raise ValueError(f"{n} devices not a multiple of per-host "
+                         f"count {local_count}")
+    if sp <= 0 or local_count % sp != 0:
+        raise ValueError(f"sp={sp} does not divide the per-host device "
+                         f"count {local_count}")
+    hosts = n // local_count
+    arr = np.array(devices, dtype=object).reshape(hosts, local_count // sp,
+                                                  sp)
+    return arr.reshape(hosts * (local_count // sp), sp)
+
+
+def make_global_mesh(sp: int = 1):
+    """A (dp, sp) Mesh over every device of every participating process.
+
+    Single-process: identical to parallel.mesh.make_mesh. Multi-process
+    (after initialize_from_env): dp spans hosts, sp stays on-host (ICI).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    local = len(jax.local_devices())
+    grid = device_grid(devs, local, sp)
+    return Mesh(grid, axis_names=("dp", "sp"))

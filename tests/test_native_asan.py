@@ -24,7 +24,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SRC = os.path.join(REPO, "fgumi_tpu", "native", "fgumi_native.cc")
 
 # the suites that exercise every native entry point with real data
-SANITIZED_SUITES = ["tests/test_native.py", "tests/test_native_batch.py"]
+# (test_host_engine drives fgumi_consensus_segments, the f64 engine, with
+# adversarial pileups — Q0 NaN flows, depth tables, saturation boundary)
+SANITIZED_SUITES = ["tests/test_native.py", "tests/test_native_batch.py",
+                    "tests/test_host_engine.py"]
 
 
 def _runtime(name):

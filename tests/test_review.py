@@ -205,3 +205,38 @@ def test_review_interval_input(tmp_path):
     row = dict(zip(lines[0].split("\t"), lines[1].split("\t")))
     assert row["consensus_call"] == "G"
     assert row["g"] == "1"
+
+
+def test_review_variants_emitted_in_dict_coordinate_order(tmp_path):
+    """Out-of-order VCF input: rows come out in sequence-dictionary
+    coordinate order (review.rs:283-298, fgumi issue #497 parity)."""
+    vcf = tmp_path / "v.vcf"
+    _vcf(vcf, ["chr1\t210\t.\tA\tT\t50\tPASS\t.",
+               "chr1\t110\t.\tA\tT\t50\tPASS\t."])
+    cons = [
+        _mapped(b"c1", b"A" * 9 + b"T" + b"A" * 10, 100, b"1"),
+        _mapped(b"c2", b"A" * 9 + b"T" + b"A" * 10, 200, b"2"),
+    ]
+    raws = [
+        _mapped(b"r1", b"A" * 9 + b"T" + b"A" * 10, 100, b"1/A"),
+        _mapped(b"r2", b"A" * 9 + b"T" + b"A" * 10, 200, b"2/A"),
+    ]
+    cons_bam, grouped_bam = tmp_path / "c.bam", tmp_path / "g.bam"
+    _write_bam(cons_bam, cons)
+    _write_bam(grouped_bam, raws)
+    out = str(tmp_path / "rev")
+    assert main(["review", "-i", str(vcf), "-c", str(cons_bam),
+                 "-g", str(grouped_bam), "-o", out]) == 0
+    with open(out + ".txt") as fh:
+        rows = [l.split("\t") for l in fh][1:]
+    assert [r[1] for r in rows] == ["110", "210"]
+
+
+def test_review_unknown_contig_errors(tmp_path):
+    vcf = tmp_path / "v.vcf"
+    _vcf(vcf, ["chrUn\t110\t.\tA\tT\t50\tPASS\t."])
+    cons_bam, grouped_bam = tmp_path / "c.bam", tmp_path / "g.bam"
+    _write_bam(cons_bam, [_mapped(b"c1", b"A" * 20, 100, b"1")])
+    _write_bam(grouped_bam, [_mapped(b"r1", b"A" * 20, 100, b"1/A")])
+    assert main(["review", "-i", str(vcf), "-c", str(cons_bam),
+                 "-g", str(grouped_bam), "-o", str(tmp_path / "o")]) == 2

@@ -186,12 +186,15 @@ class FastCodecCaller:
                                                      starts)
             slots = [(v[0], v[1], v[4]) for v in vec_multi] \
                 + [(c[0], c[1], c[2]) for c in cls]
+            # thresholds are elementwise: one vectorized pass over the whole
+            # (F, L) batch, then per-slot length slicing (positions past a
+            # slot's consensus length are computed and discarded)
+            b_all, q_all = oracle.apply_consensus_thresholds(
+                w, q_, d, ss.options.min_reads,
+                ss.options.min_consensus_base_quality)
             for fi, (i, s, cl) in enumerate(slots):
-                b_j, q_j = oracle.apply_consensus_thresholds(
-                    w[fi, :cl], q_[fi, :cl], d[fi, :cl],
-                    ss.options.min_reads,
-                    ss.options.min_consensus_base_quality)
-                strand_res[(i, s)] = (b_j, q_j, d[fi, :cl], e[fi, :cl])
+                strand_res[(i, s)] = (b_all[fi, :cl], q_all[fi, :cl],
+                                      d[fi, :cl], e[fi, :cl])
 
         def vcr(i, s, m):
             b, q, d, e = strand_res[(i, s)]

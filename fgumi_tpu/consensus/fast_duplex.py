@@ -23,7 +23,7 @@ from ..io.bam import (FLAG_FIRST, FLAG_LAST, FLAG_MATE_UNMAPPED, FLAG_PAIRED,
 from ..native import batch as nb
 from ..ops import oracle
 from .fast import overlap_correct_span
-from .simple_umi import consensus_umis_batch
+from .simple_umi import _ACGTN_UPPER, consensus_umis_batch
 from .vanilla import I16_MAX, R1, R2, _TYPE_FLAGS
 
 # seg types within a molecule: (strand, read-type) -> 0..3
@@ -753,8 +753,7 @@ class FastDuplexCaller:
                     emit(k, svals[0][0])
                     continue
                 if all(v == svals[0][0] for v, _ in svals):
-                    emit(k, "".join(c.upper() if c.upper() in "ACGTN" else c
-                                    for c in svals[0][0]))
+                    emit(k, svals[0][0].translate(_ACGTN_UPPER))
                     continue
             vals = []
             for s, flip in ((a_s, False), (b_s, True)):

@@ -17,6 +17,11 @@ _DNA = frozenset(b"ACGTNacgtn")
 _Q_ERROR = 20
 _tables = None
 
+# the all-equal rule "uppercase a char iff its uppercase is in ACGTN" is
+# exactly an acgtn->ACGTN translation: only those five lowercase letters
+# have an uppercase image in the set, everything else passes through
+_ACGTN_UPPER = str.maketrans("acgtn", "ACGTN")
+
 
 def consensus_umis_batch(families) -> list:
     """[consensus_umis(f) for f in families], with all non-trivial families
@@ -39,8 +44,7 @@ def consensus_umis_batch(families) -> list:
             results[i] = first
             continue
         if all(u == first for u in umis):
-            results[i] = "".join(c.upper() if c.upper() in "ACGTN" else c
-                                 for c in first)
+            results[i] = first.translate(_ACGTN_UPPER)
             continue
         work.append(i)
     if not work:

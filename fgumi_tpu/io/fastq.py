@@ -8,6 +8,7 @@ to C-speed bulk ``bytes.split`` over large decompressed chunks, which serves the
 same purpose: never scan bytes one at a time in the interpreter.
 """
 
+import os
 from dataclasses import dataclass
 
 from .bgzf import BgzfReader
@@ -23,12 +24,60 @@ class FastqRead:
     quals: bytes  # ASCII quality bytes as stored in the file (offset NOT removed)
 
 
+class _BufferStream:
+    """read(n) over an in-memory buffer (memoryview slices, no copies)."""
+
+    def __init__(self, buf):
+        self._mv = memoryview(buf)
+        self._pos = 0
+
+    def read(self, n: int = -1):
+        if n is None or n < 0:
+            n = len(self._mv) - self._pos
+        out = self._mv[self._pos:self._pos + n]
+        self._pos += len(out)
+        # bytes, not a view: consumers concatenate with carried tails
+        return bytes(out)
+
+    def close(self):
+        self._mv = memoryview(b"")
+        self._pos = 0
+
+
+# plain-gzip inputs up to this compressed size decompress whole-buffer via
+# libdeflate (~2-3x streaming zlib); larger files stream to bound memory
+_GZIP_WHOLE_LIMIT = int(os.environ.get("FGUMI_TPU_GZIP_WHOLE_LIMIT",
+                                       str(512 << 20)))
+
+
 def _open_stream(path: str):
     """Return a read(n)->bytes object for plain/gzip/bgzf FASTQ."""
     f = open(path, "rb")
-    magic = f.read(2)
+    head = f.read(18)
     f.seek(0)
-    if magic == GZIP_MAGIC:
+    if head[:2] == GZIP_MAGIC:
+        from .bgzf import BgzfReader as _BR
+
+        from .. import native
+
+        is_bgzf = len(head) >= 18 and head[:4] == b"\x1f\x8b\x08\x04" \
+            and _BR._is_bgzf_member(head)
+        if (not is_bgzf and native.get_lib() is not None
+                and os.fstat(f.fileno()).st_size <= _GZIP_WHOLE_LIMIT):
+            raw = f.read()
+            f.close()
+            decoded = None
+            try:
+                # 8x the limit bounds the DECOMPRESSED side too: past that,
+                # stream with bounded memory (gzip_decompress_all -> None)
+                decoded = native.gzip_decompress_all(
+                    raw, max_out=8 * _GZIP_WHOLE_LIMIT)
+            except ValueError:
+                decoded = None  # let the streaming path report the error
+            raw = None
+            if decoded is not None:
+                return _BufferStream(decoded)
+            f = open(path, "rb")
         return BgzfReader(f, owns_fileobj=True)
     return f
 

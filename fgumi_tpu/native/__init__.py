@@ -24,7 +24,7 @@ _lock = threading.Lock()
 _lib = None
 _lib_failed = False
 # must equal fgumi_abi_version() in fgumi_native.cc (stale-.so guard)
-_ABI_VERSION = 7
+_ABI_VERSION = 8
 
 
 def _build() -> bool:
@@ -48,6 +48,9 @@ def _declare(lib):
     lib.fgumi_bgzf_decompress.argtypes = [
         ctypes.c_void_p, ctypes.c_long, ctypes.c_void_p, ctypes.c_long,
         ctypes.POINTER(ctypes.c_long)]
+    lib.fgumi_gzip_decompress.restype = ctypes.c_long
+    lib.fgumi_gzip_decompress.argtypes = [
+        ctypes.c_void_p, ctypes.c_long, ctypes.c_void_p, ctypes.c_long]
     lib.fgumi_bgzf_compress_block.restype = ctypes.c_long
     lib.fgumi_bgzf_compress_block.argtypes = [
         ctypes.c_char_p, ctypes.c_long, ctypes.c_int, ctypes.c_char_p,
@@ -289,6 +292,40 @@ def bgzf_decompress(data, out_cap: int = None):
     if produced < 0:
         raise ValueError("malformed BGZF block")
     return out[:produced], consumed.value
+
+
+def gzip_decompress_all(data, max_out: int = None) -> "object":
+    """Whole-buffer (multi-member) gzip decompression via libdeflate.
+
+    Returns a uint8 numpy array; None when the native library is unavailable
+    OR the output would exceed `max_out` (the caller's cue to stream with
+    bounded memory instead — a highly compressible input can expand far past
+    any compressed-size heuristic). Raises ValueError on malformed input.
+    """
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None:
+        return None
+    src = np.frombuffer(memoryview(data), dtype=np.uint8)
+    n = len(src)
+    cap = max(4 * n, 1 << 16)
+    if max_out is not None:
+        cap = min(cap, max_out)
+    while True:
+        out = np.empty(cap, dtype=np.uint8)
+        produced = lib.fgumi_gzip_decompress(src.ctypes.data, n,
+                                             out.ctypes.data, cap)
+        if produced == -2:
+            if max_out is not None and cap >= max_out:
+                return None  # too big to materialize: stream instead
+            cap = cap * 2 if max_out is None else min(cap * 2, max_out)
+            continue
+        src = None
+        data = None
+        if produced < 0:
+            raise ValueError("malformed gzip stream")
+        return out[:produced]
 
 
 def zlib_compress(data: bytes, level: int = 1):

@@ -89,11 +89,22 @@ def make_global_mesh(sp: int = 1):
 
     Single-process: identical to parallel.mesh.make_mesh. Multi-process
     (after initialize_from_env): dp spans hosts, sp stays on-host (ICI).
+    Devices are explicitly grouped by process_index first — jax.devices()
+    orders by device id, which is NOT guaranteed process-contiguous on
+    every topology, and the sp-on-ICI invariant depends on grouping, not
+    on id order.
     """
     import jax
     from jax.sharding import Mesh
 
-    devs = jax.devices()
-    local = len(jax.local_devices())
-    grid = device_grid(devs, local, sp)
+    devs = sorted(jax.devices(),
+                  key=lambda d: (d.process_index, getattr(d, "id", 0)))
+    per_host = {}
+    for d in devs:
+        per_host[d.process_index] = per_host.get(d.process_index, 0) + 1
+    counts = set(per_host.values())
+    if len(counts) > 1:
+        raise ValueError(f"uneven per-process device counts {per_host}; "
+                         "cannot build a uniform (dp, sp) grid")
+    grid = device_grid(devs, counts.pop(), sp)
     return Mesh(grid, axis_names=("dp", "sp"))

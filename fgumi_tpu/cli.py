@@ -1774,6 +1774,11 @@ def _add_simulate(sub):
     d.add_argument("--error-rate", type=float, default=0.01)
     d.add_argument("--base-quality", type=int, default=35)
     d.add_argument("--ba-fraction", type=float, default=1.0)
+    d.add_argument("--strand-bias-alpha", type=float, default=None,
+                   help="Beta(alpha, beta) A/B strand read split (PCR "
+                        "amplification bias model); default: symmetric "
+                        "fixed split")
+    d.add_argument("--strand-bias-beta", type=float, default=None)
     d.add_argument("--seed", type=int, default=42)
     d.set_defaults(func=cmd_simulate_duplex)
     c = ps.add_parser("codec-reads", help="CODEC-shaped BAM (overlapping FR pairs, MI tags)")
@@ -1903,11 +1908,16 @@ def cmd_simulate_grouped(args):
 def cmd_simulate_duplex(args):
     from .simulate import simulate_duplex_bam
 
+    if args.strand_bias_beta is not None and args.strand_bias_alpha is None:
+        log.error("--strand-bias-beta requires --strand-bias-alpha")
+        return 2
     n = simulate_duplex_bam(
         args.output, num_molecules=args.num_molecules,
         reads_per_strand=args.reads_per_strand, read_length=args.read_length,
         error_rate=args.error_rate, base_quality=args.base_quality,
-        ba_fraction=args.ba_fraction, seed=args.seed)
+        ba_fraction=args.ba_fraction, seed=args.seed,
+        strand_bias_alpha=args.strand_bias_alpha,
+        strand_bias_beta=args.strand_bias_beta)
     log.info("simulate: wrote %d records to %s", n, args.output)
     return 0
 

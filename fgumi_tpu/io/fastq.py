@@ -61,7 +61,7 @@ def _open_stream(path: str):
         from .. import native
 
         is_bgzf = len(head) >= 18 and head[:4] == b"\x1f\x8b\x08\x04" \
-            and _BR._is_bgzf_member(head)
+            and BgzfReader._is_bgzf_member(head)
         if (not is_bgzf and native.get_lib() is not None
                 and os.fstat(f.fileno()).st_size <= _GZIP_WHOLE_LIMIT):
             raw = f.read()

@@ -309,7 +309,13 @@ def gzip_decompress_all(data, max_out: int = None) -> "object":
         return None
     src = np.frombuffer(memoryview(data), dtype=np.uint8)
     n = len(src)
-    cap = max(4 * n, 1 << 16)
+    # seed the capacity from the ISIZE footer (uncompressed size of the
+    # LAST member mod 2^32 — exact for the single-member files `gzip`
+    # produces), so the common case never pays a wasted full decompression
+    # before an INSUFFICIENT_SPACE retry; multi-member or lying footers
+    # fall back to the retry loop
+    isize = int.from_bytes(bytes(src[-4:]), "little") if n >= 18 else 0
+    cap = max(isize + 64, 4 * n, 1 << 16)
     if max_out is not None:
         cap = min(cap, max_out)
     while True:
@@ -325,6 +331,10 @@ def gzip_decompress_all(data, max_out: int = None) -> "object":
         data = None
         if produced < 0:
             raise ValueError("malformed gzip stream")
+        if cap - produced > (32 << 20):
+            # a view would pin the whole over-allocation for the stream's
+            # lifetime; copy down when the slack is significant
+            return out[:produced].copy()
         return out[:produced]
 
 

@@ -121,12 +121,19 @@ def simulate_duplex_bam(path: str, num_molecules: int = 100, reads_per_strand: i
                         read_length: int = 100, error_rate: float = 0.01,
                         base_quality: int = 35, qual_jitter: int = 5, seed: int = 42,
                         ref_name: str = "chr1", ref_length: int = 10_000_000,
-                        ba_fraction: float = 1.0):
+                        ba_fraction: float = 1.0, strand_bias_alpha: float = None,
+                        strand_bias_beta: float = None):
     """Write a duplex-grouped BAM: molecules with /A (AB) and /B (BA) strand reads.
 
     Geometry mirrors real duplex ligation: AB-R1 and BA-R2 sequence the top strand
     forward; AB-R2 and BA-R1 sequence the bottom strand (stored reverse-complement,
     FLAG_REVERSE). RX carries the dual UMI, strand-flipped between /A and /B.
+
+    strand_bias_alpha/beta: Beta-distributed A/B read split (the reference's
+    PCR amplification bias model, simulate/strand_bias.rs): each molecule's
+    2*reads_per_strand total reads split by a Beta(alpha, beta) ratio draw
+    (possibly leaving one strand empty — single-strand families are real
+    duplex rejects). None (default) keeps the symmetric fixed split.
     """
     rng = np.random.default_rng(seed)
     header = BamHeader(
@@ -166,11 +173,21 @@ def simulate_duplex_bam(path: str, num_molecules: int = 100, reads_per_strand: i
                                                            read_length), 2, 40).astype(np.uint8)
 
             emit_ba = rng.random() < ba_fraction
+            if strand_bias_alpha is not None:
+                ratio = rng.beta(strand_bias_alpha,
+                                 strand_bias_beta
+                                 if strand_bias_beta is not None
+                                 else strand_bias_alpha)
+                total = 2 * reads_per_strand
+                n_a = int(round(ratio * total))
+                strand_reads = {"A": n_a, "B": total - n_a}
+            else:
+                strand_reads = {"A": reads_per_strand, "B": reads_per_strand}
             for strand, mi_suffix, rx in (("A", "/A", f"{u1}-{u2}"),
                                           ("B", "/B", f"{u2}-{u1}")):
                 if strand == "B" and not emit_ba:
                     continue
-                for r in range(reads_per_strand):
+                for r in range(strand_reads[strand]):
                     name = f"mol{mol}:{strand}{r}".encode()
                     tags = [(b"MC", "Z", mc), (b"RG", "Z", b"A"),
                             (b"MI", "Z", f"{mol}{mi_suffix}".encode()),

@@ -1911,6 +1911,11 @@ def cmd_simulate_duplex(args):
     if args.strand_bias_beta is not None and args.strand_bias_alpha is None:
         log.error("--strand-bias-beta requires --strand-bias-alpha")
         return 2
+    for name, v in (("--strand-bias-alpha", args.strand_bias_alpha),
+                    ("--strand-bias-beta", args.strand_bias_beta)):
+        if v is not None and v <= 0:
+            log.error("%s must be > 0 (Beta distribution parameter)", name)
+            return 2
     n = simulate_duplex_bam(
         args.output, num_molecules=args.num_molecules,
         reads_per_strand=args.reads_per_strand, read_length=args.read_length,

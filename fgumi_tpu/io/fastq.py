@@ -56,8 +56,6 @@ def _open_stream(path: str):
     head = f.read(18)
     f.seek(0)
     if head[:2] == GZIP_MAGIC:
-        from .bgzf import BgzfReader as _BR
-
         from .. import native
 
         is_bgzf = len(head) >= 18 and head[:4] == b"\x1f\x8b\x08\x04" \
@@ -72,7 +70,7 @@ def _open_stream(path: str):
                 # stream with bounded memory (gzip_decompress_all -> None)
                 decoded = native.gzip_decompress_all(
                     raw, max_out=8 * _GZIP_WHOLE_LIMIT)
-            except ValueError:
+            except (ValueError, MemoryError):
                 decoded = None  # let the streaming path report the error
             raw = None
             if decoded is not None:

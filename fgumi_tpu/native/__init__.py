@@ -24,7 +24,7 @@ _lock = threading.Lock()
 _lib = None
 _lib_failed = False
 # must equal fgumi_abi_version() in fgumi_native.cc (stale-.so guard)
-_ABI_VERSION = 8
+_ABI_VERSION = 9
 
 
 def _build() -> bool:
@@ -44,6 +44,7 @@ def _build() -> bool:
 def _declare(lib):
     """ctypes restype/argtypes for every export (one copy, used by both
     the cached-build path and the FGUMI_TPU_NATIVE_SO override)."""
+    p = ctypes.c_void_p
     lib.fgumi_bgzf_decompress.restype = ctypes.c_long
     lib.fgumi_bgzf_decompress.argtypes = [
         ctypes.c_void_p, ctypes.c_long, ctypes.c_void_p, ctypes.c_long,
@@ -51,6 +52,12 @@ def _declare(lib):
     lib.fgumi_gzip_decompress.restype = ctypes.c_long
     lib.fgumi_gzip_decompress.argtypes = [
         ctypes.c_void_p, ctypes.c_long, ctypes.c_void_p, ctypes.c_long]
+    lib.fgumi_umi_neighbor_pairs.restype = ctypes.c_long
+    lib.fgumi_umi_neighbor_pairs.argtypes = [
+        p, ctypes.c_long, p, ctypes.c_long, ctypes.c_long, ctypes.c_int,
+        p, p, ctypes.c_long]
+    lib.fgumi_adjacency_bfs.restype = None
+    lib.fgumi_adjacency_bfs.argtypes = [p, p, p, ctypes.c_long, p]
     lib.fgumi_bgzf_compress_block.restype = ctypes.c_long
     lib.fgumi_bgzf_compress_block.argtypes = [
         ctypes.c_char_p, ctypes.c_long, ctypes.c_int, ctypes.c_char_p,
@@ -69,7 +76,6 @@ def _declare(lib):
         ctypes.POINTER(ctypes.c_int64)]
     # batch record layer: all pointers passed as raw addresses (numpy
     # array .ctypes.data); see fgumi_tpu/native/batch.py wrappers.
-    p = ctypes.c_void_p
     lib.fgumi_decode_fields.restype = None
     lib.fgumi_decode_fields.argtypes = [p, p, ctypes.c_long] + [p] * 12
     lib.fgumi_scan_tags.restype = None
@@ -315,7 +321,9 @@ def gzip_decompress_all(data, max_out: int = None) -> "object":
     # before an INSUFFICIENT_SPACE retry; multi-member or lying footers
     # fall back to the retry loop
     isize = int.from_bytes(bytes(src[-4:]), "little") if n >= 18 else 0
-    cap = max(isize + 64, 4 * n, 1 << 16)
+    # clamp the footer-seeded guess to a sane expansion ratio: a corrupt or
+    # truncated footer is arbitrary bytes and must not size the allocation
+    cap = max(min(isize + 64, 1024 * n), 4 * n, 1 << 16)
     if max_out is not None:
         cap = min(cap, max_out)
     while True:

@@ -282,6 +282,47 @@ def consensus_segments(codes2d: np.ndarray, quals2d: np.ndarray,
         cap = n_slow  # adversarial input: every position borderline
 
 
+def umi_neighbor_pairs(mat_a: np.ndarray, mat_b, d: int):
+    """Candidate (i, j) pairs with hamming <= d (fgumi_umi_neighbor_pairs).
+
+    mat_b None means the symmetric same-matrix case (pairs emitted once,
+    i < j); otherwise all cross pairs with i != j. Returns (i, j) int64
+    arrays, duplicate-free.
+    """
+    lib = get_lib()
+    mat_a = np.ascontiguousarray(mat_a, np.uint8)
+    n, L = mat_a.shape
+    if mat_b is None:
+        b_ptr, m = _addr(mat_a), n
+    else:
+        mat_b = np.ascontiguousarray(mat_b, np.uint8)
+        b_ptr, m = _addr(mat_b), mat_b.shape[0]
+    cap = max(4 * max(n, m), 4096)
+    while True:
+        out_i = np.empty(cap, dtype=np.int64)
+        out_j = np.empty(cap, dtype=np.int64)
+        count = lib.fgumi_umi_neighbor_pairs(
+            _addr(mat_a), n, b_ptr, m, L, int(d), _addr(out_i), _addr(out_j),
+            cap)
+        if count <= cap:
+            return out_i[:count], out_j[:count]
+        cap = count
+
+
+def adjacency_bfs(nbr_flat: np.ndarray, nbr_start: np.ndarray,
+                  counts: np.ndarray):
+    """Directed adjacency BFS roots (fgumi_adjacency_bfs): root_of int64[n]."""
+    lib = get_lib()
+    n = len(nbr_start) - 1
+    nbr_flat = np.ascontiguousarray(nbr_flat, np.int64)
+    nbr_start = np.ascontiguousarray(nbr_start, np.int64)
+    counts = np.ascontiguousarray(counts, np.int64)
+    root_of = np.empty(n, dtype=np.int64)
+    lib.fgumi_adjacency_bfs(_addr(nbr_flat), _addr(nbr_start), _addr(counts),
+                            n, _addr(root_of))
+    return root_of
+
+
 def segment_depth_errors(codes2d: np.ndarray, winner: np.ndarray,
                          starts: np.ndarray):
     """Per-segment depth/error counts: (J, L) int32 pair.

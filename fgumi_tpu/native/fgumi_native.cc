@@ -88,7 +88,180 @@ extern "C" {
 // ABI version for the stale-.so guard in __init__.py: bump whenever any
 // exported signature changes (a symbol probe alone cannot detect an
 // argument-list change in an existing function).
-long fgumi_abi_version() { return 8; }
+long fgumi_abi_version() { return 9; }
+
+// Candidate UMI pairs with hamming(A[i], B[j]) <= d over (n, L)/(m, L) byte
+// matrices, via the d+1-part pigeonhole (umi/assigners.py
+// _pigeonhole_pairs, reference BK-tree/n-gram analog): any pair within
+// distance d agrees exactly on at least one of d+1 disjoint column chunks.
+// B == A (same pointer) emits each unordered pair once (i < j); otherwise
+// all cross pairs with i != j. First-matching-part dedup keeps the output
+// duplicate-free. Returns the pair count; only the first `cap` pairs are
+// written (caller retries with a larger buffer when count > cap).
+long fgumi_umi_neighbor_pairs(const uint8_t* A, long n, const uint8_t* B,
+                              long m, long L, int d, int64_t* out_i,
+                              int64_t* out_j, long cap) {
+  const bool same = (A == B);
+  const int parts = d + 1 <= static_cast<int>(L) ? d + 1 : static_cast<int>(L);
+  if (parts <= 0) return 0;
+  // np.array_split sizing: first (L % parts) chunks get one extra column
+  std::vector<long> p_lo(static_cast<size_t>(parts) + 1, 0);
+  {
+    const long base = L / parts;
+    const long extra = L % parts;
+    for (int p = 0; p < parts; ++p) {
+      p_lo[static_cast<size_t>(p) + 1] =
+          p_lo[static_cast<size_t>(p)] + base + (p < extra ? 1 : 0);
+    }
+  }
+  auto ham_le = [&](const uint8_t* a, const uint8_t* b) {
+    int miss = 0;
+    for (long c = 0; c < L; ++c) {
+      miss += (a[c] != b[c]);
+      if (miss > d) return false;
+    }
+    return true;
+  };
+  auto chunk_eq = [&](const uint8_t* a, const uint8_t* b, int p) {
+    return std::memcmp(a + p_lo[static_cast<size_t>(p)],
+                       b + p_lo[static_cast<size_t>(p)],
+                       static_cast<size_t>(p_lo[static_cast<size_t>(p) + 1] -
+                                           p_lo[static_cast<size_t>(p)])) == 0;
+  };
+  long count = 0;
+  auto emit = [&](long i, long j) {
+    if (count < cap) {
+      out_i[count] = i;
+      out_j[count] = j;
+    }
+    ++count;
+  };
+  std::vector<int64_t> ob(static_cast<size_t>(m));
+  std::vector<int64_t> oa;
+  for (int p = 0; p < parts; ++p) {
+    const long clo = p_lo[static_cast<size_t>(p)];
+    const long clen = p_lo[static_cast<size_t>(p) + 1] - clo;
+    for (long r = 0; r < m; ++r) ob[static_cast<size_t>(r)] = r;
+    auto key_less = [&](int64_t x, int64_t y) {
+      const int c = std::memcmp(B + x * L + clo, B + y * L + clo,
+                                static_cast<size_t>(clen));
+      return c < 0 || (c == 0 && x < y);
+    };
+    std::sort(ob.begin(), ob.end(), key_less);
+    if (same) {
+      for (long s = 0; s < m;) {
+        long e = s + 1;
+        while (e < m && std::memcmp(B + ob[static_cast<size_t>(s)] * L + clo,
+                                    B + ob[static_cast<size_t>(e)] * L + clo,
+                                    static_cast<size_t>(clen)) == 0) {
+          ++e;
+        }
+        for (long x = s; x < e; ++x) {
+          for (long y = x + 1; y < e; ++y) {
+            const long i = static_cast<long>(ob[static_cast<size_t>(x)]);
+            const long j = static_cast<long>(ob[static_cast<size_t>(y)]);
+            const uint8_t* ra = A + i * L;
+            const uint8_t* rb = A + j * L;
+            if (!ham_le(ra, rb)) continue;
+            bool seen = false;
+            for (int q = 0; q < p; ++q) {
+              if (chunk_eq(ra, rb, q)) {
+                seen = true;
+                break;
+              }
+            }
+            if (!seen) emit(i < j ? i : j, i < j ? j : i);
+          }
+        }
+        s = e;
+      }
+    } else {
+      // cross case (paired-UMI reversal): bucket B, probe with each A row
+      oa.resize(static_cast<size_t>(n));
+      for (long r = 0; r < n; ++r) oa[static_cast<size_t>(r)] = r;
+      auto akey_less = [&](int64_t x, int64_t y) {
+        const int c = std::memcmp(A + x * L + clo, A + y * L + clo,
+                                  static_cast<size_t>(clen));
+        return c < 0 || (c == 0 && x < y);
+      };
+      std::sort(oa.begin(), oa.end(), akey_less);
+      long bs = 0;
+      for (long as = 0; as < n;) {
+        long ae = as + 1;
+        const uint8_t* akey = A + oa[static_cast<size_t>(as)] * L + clo;
+        while (ae < n && std::memcmp(akey,
+                                     A + oa[static_cast<size_t>(ae)] * L + clo,
+                                     static_cast<size_t>(clen)) == 0) {
+          ++ae;
+        }
+        while (bs < m && std::memcmp(B + ob[static_cast<size_t>(bs)] * L + clo,
+                                     akey,
+                                     static_cast<size_t>(clen)) < 0) {
+          ++bs;
+        }
+        long be = bs;
+        while (be < m && std::memcmp(B + ob[static_cast<size_t>(be)] * L + clo,
+                                     akey,
+                                     static_cast<size_t>(clen)) == 0) {
+          ++be;
+        }
+        for (long x = as; x < ae; ++x) {
+          for (long y = bs; y < be; ++y) {
+            const long i = static_cast<long>(oa[static_cast<size_t>(x)]);
+            const long j = static_cast<long>(ob[static_cast<size_t>(y)]);
+            if (i == j) continue;
+            const uint8_t* ra = A + i * L;
+            const uint8_t* rb = B + j * L;
+            if (!ham_le(ra, rb)) continue;
+            bool seen = false;
+            for (int q = 0; q < p; ++q) {
+              if (chunk_eq(ra, rb, q)) {
+                seen = true;
+                break;
+              }
+            }
+            if (!seen) emit(i, j);
+          }
+        }
+        as = ae;
+      }
+    }
+  }
+  return count;
+}
+
+// UMI-tools directed adjacency BFS over flattened neighbor lists
+// (umi/assigners.py _adjacency_bfs; reference assigner.rs:1480-1548).
+// Nodes are pre-sorted by (-count, string); neighbors(i) =
+// nbr_flat[nbr_start[i]:nbr_start[i+1]] in ascending order. root_of[i]
+// receives the component root index.
+void fgumi_adjacency_bfs(const int64_t* nbr_flat, const int64_t* nbr_start,
+                         const int64_t* counts, long n, int64_t* root_of) {
+  std::vector<uint8_t> assigned(static_cast<size_t>(n), 0);
+  std::vector<int64_t> queue;
+  queue.reserve(64);
+  for (long root = 0; root < n; ++root) {
+    if (assigned[static_cast<size_t>(root)]) continue;
+    assigned[static_cast<size_t>(root)] = 1;
+    root_of[root] = root;
+    queue.clear();
+    queue.push_back(root);
+    size_t head = 0;
+    while (head < queue.size()) {
+      const int64_t idx = queue[head++];
+      const int64_t max_child = counts[idx] / 2 + 1;
+      for (int64_t t = nbr_start[idx]; t < nbr_start[idx + 1]; ++t) {
+        const int64_t child = nbr_flat[t];
+        if (!assigned[static_cast<size_t>(child)] &&
+            counts[child] <= max_child) {
+          assigned[static_cast<size_t>(child)] = 1;
+          root_of[child] = root_of[idx];
+          queue.push_back(child);
+        }
+      }
+    }
+  }
+}
 
 // Decompress a whole (possibly multi-member) plain-gzip buffer with
 // libdeflate. Streaming inflate (zlib) runs ~180 MB/s on the bench host;

@@ -77,6 +77,9 @@ def _umi_matrix(umis) -> np.ndarray:
 # (crates/fgumi-umi/src/assigner.rs:228,267,394: exact-match on one of
 # d+1 chunks is necessary for Hamming distance <= d).
 SPARSE_THRESHOLD = 8192
+# unique-UMI count above which the directed BFS runs natively
+# (fgumi_adjacency_bfs); tests force the Python loop by raising this
+_NATIVE_BFS_THRESHOLD = 512
 
 
 class NeighborGraph:
@@ -96,6 +99,17 @@ class NeighborGraph:
             return row[row != i]
         return self._lists[i]
 
+    def flat(self):
+        """(nbr_flat, nbr_start) arrays for the native BFS: neighbors of i
+        are nbr_flat[nbr_start[i]:nbr_start[i+1]], ascending."""
+        lists = (self._lists if self._lists is not None
+                 else [self.neighbors(i) for i in range(self.n)])
+        starts = np.zeros(self.n + 1, dtype=np.int64)
+        np.cumsum([len(x) for x in lists], out=starts[1:])
+        flat = (np.concatenate(lists).astype(np.int64)
+                if self.n else np.empty(0, np.int64))
+        return flat, starts
+
 
 def build_neighbor_graph(mat: np.ndarray, max_mismatches: int,
                          rev_mat: np.ndarray = None) -> NeighborGraph:
@@ -110,6 +124,14 @@ def build_neighbor_graph(mat: np.ndarray, max_mismatches: int,
         if rev_mat is not None:
             within |= pairwise_distances(rev_mat, mat) <= max_mismatches
         return NeighborGraph(n, within=within)
+    from ..native import batch as nb
+
+    if nb.available():
+        pair_sets = [nb.umi_neighbor_pairs(mat, None, max_mismatches)]
+        if rev_mat is not None:
+            pair_sets.append(
+                nb.umi_neighbor_pairs(rev_mat, mat, max_mismatches))
+        return _lists_from_pairs(n, pair_sets)
     pair_sets = [_pigeonhole_pairs(mat, mat, max_mismatches)]
     if rev_mat is not None:
         pair_sets.append(_pigeonhole_pairs(rev_mat, mat, max_mismatches))
@@ -398,6 +420,15 @@ def _adjacency_bfs(unique, counts, graph: NeighborGraph):
     """
     n = len(unique)
     counts_arr = np.asarray(counts)
+    from ..native import batch as nb
+
+    if n >= _NATIVE_BFS_THRESHOLD and nb.available():
+        flat, starts = graph.flat()
+        root_of = nb.adjacency_bfs(flat, starts,
+                                   counts_arr.astype(np.int64))
+        # roots in discovery order == ascending root index (each root is
+        # its own first-assigned node), exactly the scalar loop's order
+        return np.unique(root_of).tolist(), root_of
     assigned = np.zeros(n, dtype=bool)
     root_of = np.full(n, -1, dtype=np.int64)
     roots = []

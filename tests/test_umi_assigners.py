@@ -288,3 +288,59 @@ def test_adjacency_vectorized_all_invalid():
     b = AdjacencyUmiAssigner(1)
     b._VEC_THRESHOLD = 1 << 30
     assert [m.render() for m in vec] == [m.render() for m in b.assign(umis)]
+
+
+def test_native_neighbor_pairs_match_numpy_pigeonhole():
+    """fgumi_umi_neighbor_pairs == the numpy pigeonhole candidate set, as
+    canonical undirected pair sets, for same-matrix and cross cases."""
+    import numpy as np
+
+    from fgumi_tpu.native import batch as nb
+    from fgumi_tpu.umi.assigners import _pigeonhole_pairs
+
+    if not nb.available():
+        import pytest
+        pytest.skip("native unavailable")
+    rng = np.random.default_rng(2)
+    for L, d in ((8, 1), (8, 2), (12, 1), (5, 3)):
+        base = rng.integers(65, 69, size=(300, L)).astype(np.uint8)
+        mat = base[rng.integers(0, 300, size=3000)].copy()
+        errs = rng.random(mat.shape) < 0.03
+        mat[errs] = rng.integers(65, 69, size=int(errs.sum()))
+        ni, nj = nb.umi_neighbor_pairs(mat, None, d)
+        pi, pj = _pigeonhole_pairs(mat, mat, d)
+        native_set = set(zip(ni.tolist(), nj.tolist()))
+        ref_set = set(zip(np.minimum(pi, pj).tolist(),
+                          np.maximum(pi, pj).tolist()))
+        assert native_set == ref_set
+        # cross case (paired reversal analog): rev rows vs rows
+        rev = mat[:, ::-1].copy()
+        ci, cj = nb.umi_neighbor_pairs(rev, mat, d)
+        qi, qj = _pigeonhole_pairs(rev, mat, d)
+        assert set(zip(ci.tolist(), cj.tolist())) \
+            == set(zip(qi.tolist(), qj.tolist()))
+
+
+def test_native_bfs_matches_python(monkeypatch):
+    import numpy as np
+
+    from fgumi_tpu.native import batch as nb
+    from fgumi_tpu.umi import assigners as A
+
+    if not nb.available():
+        import pytest
+        pytest.skip("native unavailable")
+    rng = np.random.default_rng(5)
+    bases = np.frombuffer(b"ACGT", np.uint8)
+    true = rng.choice(bases, size=(200, 8))
+    arr = true[rng.integers(0, 200, size=6000)]
+    errs = rng.random(arr.shape) < 0.02
+    arr = np.where(errs, rng.choice(bases, size=arr.shape), arr)
+    umis = ["".join(chr(c) for c in row) for row in arr]
+    a = A.AdjacencyUmiAssigner(1)
+    native = [m.render() for m in a.assign(umis)]  # native BFS (>= 512)
+    # force the PYTHON BFS on identical input: raise the native threshold
+    monkeypatch.setattr(A, "_NATIVE_BFS_THRESHOLD", 1 << 30)
+    b = A.AdjacencyUmiAssigner(1)
+    python = [m.render() for m in b.assign(umis)]
+    assert native == python

@@ -140,23 +140,29 @@ class BamBatchReader:
         # a non-positive target would make _fill yield nothing and the
         # command silently write an empty output; clamp to "one chunk"
         self._target = max(int(target_bytes), 1)
-        self._acc = bytearray()
+        # decoded chunks accumulate as arrays and concatenate ONCE per
+        # batch: appending into a bytearray and re-wrapping cost several
+        # full copies of every decompressed byte (chain profiles)
+        self._parts = []
+        self._parts_len = 0
         self._eof = False
 
     def _fill(self):
-        while len(self._acc) < self._target and not self._eof:
-            chunk = self._r.read_into_available()
-            if not chunk:
+        while self._parts_len < self._target and not self._eof:
+            arr = self._r.read_decoded()
+            if not len(arr):
                 self._eof = True
                 break
-            self._acc += chunk
+            self._parts.append(arr)
+            self._parts_len += len(arr)
 
     def __iter__(self):
         while True:
             self._fill()
-            if not self._acc:
+            if not self._parts_len:
                 return
-            buf = np.frombuffer(bytes(self._acc), dtype=np.uint8)
+            buf = (self._parts[0] if len(self._parts) == 1
+                   else np.concatenate(self._parts))
             max_records = len(buf) // _MIN_RECORD_WIRE + 1
             offsets, scanned = nb.find_boundaries(buf, max_records)
             if len(offsets) == 0:
@@ -164,12 +170,17 @@ class BamBatchReader:
                     raise EOFError("truncated BAM record at end of stream")
                 # a single record larger than the accumulated bytes: grow
                 self._target *= 2
+                self._parts = [buf]
+                self._parts_len = len(buf)
                 continue
-            chunk = self._acc[:scanned]
-            del self._acc[:scanned]
+            # tail: copy the (at most one partial record) remainder so the
+            # next batch doesn't pin this batch's full buffer
+            tail = buf[scanned:].copy()
+            self._parts = [tail] if len(tail) else []
+            self._parts_len = len(tail)
             # a trailing partial record at EOF surfaces as an empty scan on the
             # next iteration and raises there, after this chunk is consumed
-            yield RecordBatch(chunk, offsets.copy())
+            yield RecordBatch(buf[:scanned], offsets.copy())
 
     def close(self):
         self._r.close()

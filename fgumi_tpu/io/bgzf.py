@@ -301,6 +301,65 @@ class BgzfReader:
         self._buf.clear()
         return out
 
+    def read_decoded(self):
+        """One decoded chunk as a uint8 numpy array (empty at EOF).
+
+        The zero-copy variant of read_into_available for the native BGZF
+        path: the decompressor's output buffer is handed over directly
+        instead of round-tripping through the bytearray (whose append +
+        bytes() drain cost two full copies per decompressed byte). Buffered
+        bytes (header residue) and the zlib fallback go through the classic
+        path.
+        """
+        import numpy as np
+
+        if self._native is not True or self._buf:
+            data = self.read_into_available()
+            return np.frombuffer(bytearray(data), dtype=np.uint8)
+        from .. import native
+
+        while True:
+            if not self._raw:
+                if self._eof:
+                    return np.empty(0, dtype=np.uint8)
+                raw = self._f.read(self._chunk)
+                if raw:
+                    self._raw += raw
+                else:
+                    self._eof = True
+                continue
+            try:
+                decoded, consumed = native.bgzf_decompress(self._raw)
+            except ValueError:
+                self._demote_to_zlib()
+                data = self.read_into_available()
+                return np.frombuffer(bytearray(data), dtype=np.uint8)
+            del self._raw[:consumed]
+            if consumed == 0:
+                # _raw holds a partial block (the steady state between
+                # reads): pull more input and retry the native decode —
+                # delegating to the copying fill here would make every
+                # steady-state call take the slow path
+                if len(self._raw) >= 18 and not self._is_bgzf_member(
+                        self._raw):
+                    # concatenated plain-gzip member mid-stream: the
+                    # general fill demotes to zlib
+                    self._fill(len(self._buf) + 1)
+                    data = bytes(self._buf)
+                    self._buf.clear()
+                    return np.frombuffer(bytearray(data), dtype=np.uint8)
+                if self._eof:
+                    raise ValueError(
+                        "truncated BGZF stream (partial block at EOF)")
+                raw = self._f.read(self._chunk)
+                if raw:
+                    self._raw += raw
+                else:
+                    self._eof = True
+                continue
+            if len(decoded):
+                return decoded
+
     def close(self):
         if self._owns:
             self._f.close()

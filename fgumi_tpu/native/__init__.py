@@ -297,6 +297,10 @@ def bgzf_decompress(data, out_cap: int = None):
         return bgzf_decompress(data, min(out_cap * 2, max_cap))
     if produced < 0:
         raise ValueError("malformed BGZF block")
+    if out_cap - produced > produced // 2 + (1 << 20):
+        # poorly-compressible input: a view would pin the 4x over-allocation
+        # in callers that retain the chunk (batch_reader accumulation)
+        return out[:produced].copy(), consumed.value
     return out[:produced], consumed.value
 
 

@@ -535,6 +535,19 @@ class FastSimplexCaller:
                 seg_cig_uniform[nonempty] = np.minimum.reduceat(
                     eq, vstarts[:-1][nonempty]).astype(bool)
                 need = check & ~seg_cig_uniform
+                if need.any():
+                    # all-single-op-M segs (ragged read lengths, e.g. 80M vs
+                    # 100M) are mutually prefix-compatible after simplify:
+                    # the most-common-alignment filter provably keeps every
+                    # read, so skip it (the dominant cost on length-jittered
+                    # inputs — one Python CIGAR decode per read otherwise)
+                    row_sm = (batch.n_cigar[span_v] == 1) \
+                        & ((batch.buf[co[span_v]] & 0xF) == 0)
+                    seg_sm = np.zeros(nseg, dtype=bool)
+                    seg_sm[nonempty] = np.minimum.reduceat(
+                        row_sm.astype(np.uint8),
+                        vstarts[:-1][nonempty]).astype(bool)
+                    need &= ~seg_sm
             rev8 = ((batch.flag[span_v] & FLAG_REVERSE) != 0).astype(np.uint8)
             mixed = np.zeros(nseg, dtype=bool)
             if nonempty.any():
@@ -733,6 +746,12 @@ class FastSimplexCaller:
                 cig_len = (4 * batch.n_cigar[span[t_rows]]).astype(np.int32)
                 runs = nb.group_starts(batch.buf, cig_off, cig_len)
                 need_filter = len(runs) > 1
+                if need_filter \
+                        and (batch.n_cigar[span[t_rows]] == 1).all() \
+                        and ((batch.buf[cig_off] & 0xF) == 0).all():
+                    # all-single-op-M: mutually prefix-compatible, the
+                    # filter keeps everything (see _prepare_groups_vec)
+                    need_filter = False
             if not need_filter and len(t_rows) >= 2:
                 revs = (batch.flag[span[t_rows]] & FLAG_REVERSE) != 0
                 if revs.any() and not revs.all():

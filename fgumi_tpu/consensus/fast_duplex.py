@@ -362,6 +362,16 @@ class FastDuplexCaller:
         mx = np.maximum.reduceat(rev8, sstarts[:-1])
         mixed = (mn == 0) & (mx == 1) & (counts >= 2)
         need = ~uniform
+        if need.any():
+            # all-single-op-M sets (ragged read lengths) are mutually
+            # prefix-compatible after simplify: the alignment filter
+            # provably keeps every read, so non-uniform bytes alone do not
+            # require the fallback (fast.py _prepare_groups_vec twin)
+            row_sm = (batch.n_cigar[span[srows]] == 1) \
+                & ((batch.buf[co[span[srows]]] & 0xF) == 0)
+            set_sm = np.minimum.reduceat(
+                row_sm.astype(np.uint8), sstarts[:-1]).astype(bool)
+            need &= ~set_sm
         for s in np.nonzero(uniform & mixed)[0]:
             rec_i = int(span[firsts[s]])
             if batch.n_cigar[rec_i] == 1:

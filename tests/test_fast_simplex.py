@@ -327,3 +327,53 @@ def test_overlap_duplicate_name_pairs_fall_back(tmp_path):
     ])
     assert_parity(path, VanillaOptions(min_reads=1), overlap=True,
                   target_bytes=1 << 20)
+
+
+def test_parity_ragged_single_op_m_with_indel_families(tmp_path):
+    """The single-op-M alignment-filter skip (ragged 80M/100M families keep
+    every read) must stay byte-identical to the classic engine, including a
+    family whose indel CIGARs DO engage the filter and reject a minority."""
+    from fgumi_tpu.simulate import _build_mapped_record
+
+    header = BamHeader(
+        text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n@SQ\tSN:c1\tLN:100000\n"
+             "@RG\tID:A\tSM:s\n",
+        ref_names=["c1"], ref_lengths=[100000])
+    rng = np.random.default_rng(3)
+    path = str(tmp_path / "mixed_cigar.bam")
+    with BamWriter(path, header) as w:
+        mi = 0
+        # 40 ragged all-M families (lengths 60..100): filter provably keeps all
+        for f in range(40):
+            mi += 1
+            truth = rng.integers(0, 4, size=100)
+            for r in range(4):
+                L = int(rng.integers(60, 101))
+                codes = truth[:L].copy()
+                errs = rng.random(L) < 0.02
+                codes[errs] = (codes[errs] + 1) % 4
+                seq = b"ACGT"[0:0].join(
+                    bytes([b"ACGT"[c]]) for c in codes)
+                w.write_record_bytes(_build_mapped_record(
+                    f"m{mi}r{r}".encode(), 0, 0, 500 + f, 60, [("M", L)],
+                    seq, np.full(L, 35, np.uint8), -1, -1, 0,
+                    [(b"MI", "Z", str(mi).encode()), (b"RG", "Z", b"A")]))
+        # 10 families with a minority indel CIGAR: the filter must REJECT it
+        for f in range(10):
+            mi += 1
+            truth = rng.integers(0, 4, size=100)
+            for r in range(4):
+                if r == 3:
+                    cig = [("M", 50), ("I", 2), ("M", 48)]
+                else:
+                    cig = [("M", 100)]
+                seq = bytes(b"ACGT"[c] for c in truth)
+                w.write_record_bytes(_build_mapped_record(
+                    f"i{mi}r{r}".encode(), 0, 0, 900 + f, 60, cig,
+                    seq, np.full(100, 35, np.uint8), -1, -1, 0,
+                    [(b"MI", "Z", str(mi).encode()), (b"RG", "Z", b"A")]))
+    opts = VanillaOptions(min_reads=1)
+    assert_parity(path, opts)
+    # the skip must not suppress genuine minority-alignment rejections
+    caller = run_slow(path, VanillaOptions(min_reads=1))[1]
+    assert caller.stats.rejected.get("MinorityAlignment", 0) > 0

@@ -361,7 +361,8 @@ print(json.dumps(out))
                          time.monotonic() + min(600, budget_s // 4))
     while (not trier.done(want_duplex)
            and trier.deadline - time.monotonic() > 180
-           and sum(1 for p in trier.probes if not p["ok"]) < 8):
+           and sum(1 for p in trier.probes
+                   if not p["ok"] and not p.get("skipped")) < 8):
         wait = min(45.0, max(trier.deadline - time.monotonic() - 150, 0))
         time.sleep(wait)
         trier.attempt(sim, dup, threads)
@@ -511,10 +512,15 @@ print(json.dumps(out))
                 n_hist += 1
                 ok_hist += bool(p.get("ok"))
                 if not p.get("ok"):
-                    # 'stage' = last stage that COMPLETED before the failure
-                    mode = ("hung" if "timeout" in p.get("err", "")
-                            else "failed")
-                    key = f"{mode} after " + p.get("stage", "?")
+                    if p.get("skipped"):
+                        # another session process held the device lock —
+                        # contention, not a wedge (round-4 root cause)
+                        key = "skipped (device busy)"
+                    else:
+                        # 'stage' = last COMPLETED stage before the failure
+                        mode = ("hung" if "timeout" in p.get("err", "")
+                                else "failed")
+                        key = f"{mode} after " + p.get("stage", "?")
                     by_stage[key] = by_stage.get(key, 0) + 1
         if n_hist:
             result["session_probe_history"] = {

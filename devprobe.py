@@ -91,7 +91,7 @@ kernel = ConsensusKernel(quality_tables(45, 40))
 # this payload measures the XLA device kernel (TPU, or XLA-CPU as the
 # comparison baseline); never let the CPU fallback route to the host engine,
 # where the timed dispatch would be a no-op sentinel
-kernel._use_host = False
+kernel.set_force_device()
 codes_dev, quals_dev, seg_ids, starts, F_pad = pad_segments(
     codes2d, quals2d, counts)
 d = jax.devices()[0]
@@ -183,13 +183,94 @@ def _sample_child_threads(pid):
     return sorted(threads)
 
 
-def staged_probe(timeout_s=120, env_overrides=None):
+DEVICE_LOCK_PATH = os.path.join(tempfile.gettempdir(), "fgumi_tpu.lock")
+
+
+class DeviceLock:
+    """Session-wide exclusive lock around TPU access.
+
+    Round-4 diagnosis of the 0/9 in-session probe history: every probe hung
+    at `init` with the relay TCP-reachable — the grant-less-wait signature —
+    because some OTHER process of the same session already held the single
+    tunnel-attached chip (the bench, an evidence capture, a long manual
+    run). The chip is single-tenant; a second client blocks indefinitely.
+    All probes and device payloads therefore serialize on one flock; a
+    busy lock is reported as `skipped: device busy`, not as a wedge.
+    """
+
+    def __init__(self, path=DEVICE_LOCK_PATH):
+        self._path = path
+        self._f = None
+
+    def acquire(self, timeout_s: float = 0.0) -> bool:
+        import fcntl
+
+        self._f = open(self._path, "a+")
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                fcntl.flock(self._f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                self._f.seek(0)
+                self._f.truncate()
+                self._f.write(f"{os.getpid()} {int(time.time())}\n")
+                self._f.flush()
+                return True
+            except OSError:
+                if time.monotonic() >= deadline:
+                    self._f.close()
+                    self._f = None
+                    return False
+                time.sleep(0.5)
+
+    def holder(self) -> str:
+        try:
+            with open(self._path) as f:
+                return f.read().strip() or "?"
+        except OSError:
+            return "?"
+
+    def release(self):
+        if self._f is not None:
+            import fcntl
+
+            fcntl.flock(self._f, fcntl.LOCK_UN)
+            self._f.close()
+            self._f = None
+
+    def __enter__(self):
+        # block until acquired: a context-managed section must actually
+        # hold the lock (a silent no-acquire would reintroduce the
+        # two-clients-one-chip hang this class exists to prevent)
+        while not self.acquire(timeout_s=3600.0):
+            pass
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
+def staged_probe(timeout_s=120, env_overrides=None, lock_wait_s=15.0):
     """Run the staged probe. Returns a dict that always says how far we got.
 
     Keys: ok (bool), relay_tcp, stage (last completed), stages {name: secs},
     platform/device_kind when init completed, err/hung_threads on failure.
+    Skips (ok=False, skipped=True) without burning the timeout when another
+    process of this session holds the device lock.
     """
     out = {"t_unix": int(time.time()), "relay_tcp": relay_tcp_check()}
+    lock = DeviceLock()
+    if not lock.acquire(timeout_s=lock_wait_s):
+        out.update({"ok": False, "skipped": True, "stage": "lock",
+                    "stages": {},
+                    "err": f"device busy: lock held by {lock.holder()}"})
+        return out
+    try:
+        return _staged_probe_locked(out, timeout_s, env_overrides)
+    finally:
+        lock.release()
+
+
+def _staged_probe_locked(out, timeout_s, env_overrides):
     env = dict(os.environ)
     if env_overrides:
         env.update(env_overrides)
@@ -262,10 +343,19 @@ def staged_probe(timeout_s=120, env_overrides=None):
 
 
 def run_payload(payload, argv, timeout_s, env_overrides=None):
-    """Run a python -c payload, parse last stdout line as JSON."""
+    """Run a python -c payload, parse last stdout line as JSON.
+
+    Payloads not pinned to the CPU backend attach the (single-tenant)
+    device, so they serialize on the session device lock; a busy lock is a
+    fast explicit error instead of an init hang."""
     env = dict(os.environ)
     if env_overrides:
         env.update(env_overrides)
+    lock = None
+    if env.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
+        lock = DeviceLock()
+        if not lock.acquire(timeout_s=min(60.0, timeout_s / 4)):
+            return None, f"device busy: lock held by {lock.holder()}"
     try:
         proc = subprocess.run(
             [sys.executable, "-c", payload] + [str(a) for a in argv],
@@ -274,6 +364,9 @@ def run_payload(payload, argv, timeout_s, env_overrides=None):
         return None, f"timeout after {int(timeout_s)}s"
     except OSError as e:
         return None, f"spawn failed: {e}"
+    finally:
+        if lock is not None:
+            lock.release()
     if proc.returncode != 0:
         tail = (proc.stderr or "").strip().splitlines()[-8:]
         return None, f"rc={proc.returncode}: " + " | ".join(tail)

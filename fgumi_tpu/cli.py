@@ -160,6 +160,21 @@ def _stage_kwargs(args):
     return kw
 
 
+def _consensus_stage_kwargs(args):
+    """_stage_kwargs + resolve-pool sizing for device-attached consensus
+    runs: >=2 resolve workers so a worker blocked on a device fetch never
+    starves a host-engine (hybrid) chunk queued behind it. Host-only runs
+    keep the threads-3 default (no point oversubscribing pure CPU work).
+    Only for commands that pass a real resolve_fn (simplex/duplex) — a
+    pool applying the identity is pure queue overhead."""
+    kw = _stage_kwargs(args)
+    from .ops.kernel import use_host_engine
+
+    if not use_host_engine():
+        kw["resolve_workers"] = max(getattr(args, "threads", 0) - 3, 2)
+    return kw
+
+
 def _print_stats(stats, wall_s=None):
     """--stats output: per-stage busy/blocked table plus the device-boundary
     accounting (dispatches, fetch-wait, GFLOP/s, MFU estimate, device
@@ -413,7 +428,7 @@ def cmd_simplex(args):
                         iter(reader), _process, writer.write_serialized,
                         threads=args.threads, queue_items=queue_items,
                         stats=stats, resolve_fn=resolve_chunk,
-                        **_stage_kwargs(args))
+                        **_consensus_stage_kwargs(args))
                     for blob in fast.flush():
                         writer.write_serialized(resolve_chunk(blob))
                     rejects.drain(caller)
@@ -566,7 +581,7 @@ def cmd_duplex(args):
                 run_stages(
                     iter(reader), _process, writer.write_serialized,
                     threads=args.threads, stats=stats_t,
-                    resolve_fn=resolve_chunk, **_stage_kwargs(args))
+                    resolve_fn=resolve_chunk, **_consensus_stage_kwargs(args))
                 for blob in fast.flush():
                     writer.write_serialized(resolve_chunk(blob))
         progress.finish()

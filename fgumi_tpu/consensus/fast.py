@@ -225,6 +225,11 @@ class _PendingChunk:
             winner, qual, depth, errors = kernel.resolve_segments(
                 dev, codes_d, quals_d, starts)
             self._assign(idxs, winner, qual, depth, errors)
+        elif self.pending[0] == "segw":
+            _, idxs, starts, codes_d, quals_d, ticket = self.pending
+            winner, qual, depth, errors = kernel.resolve_segments_wire(
+                ticket, codes_d, quals_d, starts)
+            self._assign(idxs, winner, qual, depth, errors)
         elif self.pending[0] == "shard":
             # (dp, F_local, L) packed, one family shard per device
             _, shard_jobs, shard_starts, codes3d, quals3d, dev = self.pending
@@ -281,6 +286,12 @@ class FastSimplexCaller:
         self.tag = tag
         self.overlap_caller = overlap_caller  # OverlappingBasesConsensusCaller
         self.mesh = mesh if mesh is not None and mesh.size > 1 else None
+        import os
+
+        # hybrid routing: device dispatches in flight beyond this cap route
+        # to the host f64 engine instead (the link is saturated; queueing
+        # more just delays the writer). ~3 batches ≈ 1-1.5 s of link backlog.
+        self.max_inflight = int(os.environ.get("FGUMI_TPU_MAX_INFLIGHT", "3"))
         opts = caller.options
         # conditions the vectorized conversion cannot express
         self._vector_ok = (not opts.trim and not opts.methylation_mode)
@@ -855,8 +866,10 @@ class FastSimplexCaller:
 
         counts = count[multi]
         rows_all = table.pool_rows[_ranges(table.vlo[multi], counts)]
-        # 16-multiple L >= every job's consensus length (<= the pack stride)
-        L_max = -(-int(table.cons_len[multi].max()) // 16) * 16
+        # 4-multiple L >= every job's consensus length (<= the pack stride);
+        # 4 (not 16) because every padded position is an uploaded wire byte
+        # and the 2-bit winner output packs 4 positions per byte
+        L_max = -(-int(table.cons_len[multi].max()) // 4) * 4
 
         if self.mesh is not None:
             starts = np.concatenate(([0], np.cumsum(counts)))
@@ -865,11 +878,16 @@ class FastSimplexCaller:
             return (self._dispatch_sharded(multi, counts, starts, codes_d,
                                            quals_d, L_max), blocks0)
 
-        if kernel.host_mode():
-            # no pad, no device layout: the native f64 engine consumes the
-            # ragged rows directly at resolve time (ops/host_kernel.py)
-            from ..ops.kernel import HOST_DISPATCH
+        from ..ops.kernel import DEVICE_STATS, HOST_DISPATCH
 
+        if kernel.host_mode() or (kernel.hybrid_mode()
+                                  and DEVICE_STATS.in_flight_count()
+                                  >= self.max_inflight):
+            # host f64 engine path: either no device at all, or (hybrid) the
+            # device pipe is full — the link absorbs what it can, the host
+            # engine eats the overflow CONCURRENTLY on the resolve pool, so
+            # e2e throughput is device + host, not min of the two. No pad,
+            # no device layout: the native engine consumes ragged rows.
             starts = np.concatenate(([0], np.cumsum(counts)))
             return ("seg", multi, starts,
                     np.ascontiguousarray(codes[rows_all, :L_max]),
@@ -880,9 +898,10 @@ class FastSimplexCaller:
 
         codes_dev, quals_dev, seg_ids, starts, F_pad, N = pad_segments_gather(
             codes, quals, rows_all, L_max, counts)
-        dev = kernel.device_call_segments(codes_dev, quals_dev, seg_ids, F_pad)
-        return ("seg", multi, starts, codes_dev[:N], quals_dev[:N],
-                dev), blocks0
+        ticket = kernel.device_call_segments_wire(
+            codes_dev, quals_dev, seg_ids, F_pad, len(multi))
+        return ("segw", multi, starts, codes_dev[:N], quals_dev[:N],
+                ticket), blocks0
 
     def _dispatch_sharded(self, multi, counts, starts, codes_d, quals_d,
                           L_max):

@@ -168,7 +168,7 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
                queue_items: int = 4, stats: StageTimes = None,
                watchdog_interval: float = 120.0, resolve_fn=None,
                max_bytes: int = 0, item_bytes=None,
-               deadlock_recover: bool = False):
+               deadlock_recover: bool = False, resolve_workers: int = None):
     """source -> process [-> resolve workers] -> sink, with optional threads.
 
     - source_iter: yields work items (e.g. RecordBatch)
@@ -212,7 +212,14 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
             stats.add_busy("process+write", t_last - now)
         return stats
 
-    n_workers = max(threads - 3, 0)
+    # resolve_workers overrides the threads-3 pool size (device-attached
+    # runs want >=2 so a worker blocked on a device fetch never starves a
+    # host-engine chunk queued behind it; fetch waits hold no GIL, so
+    # oversubscribing a 1-core host is free)
+    if resolve_workers is not None and threads >= 2:
+        n_workers = max(int(resolve_workers), 0)
+    else:
+        n_workers = max(threads - 3, 0)
     q_in = queue.Queue(maxsize=queue_items)
     # the sink queue may carry deferred work holding whole padded batches
     # (consensus _PendingChunk), so its depth bounds in-flight memory too

@@ -52,7 +52,7 @@ def bench_kernel(out):
     # production path is the native f64 host engine (measured separately
     # below), so force the device engine or the timed dispatch is a no-op
     # HOST_DISPATCH sentinel
-    kernel._use_host = False
+    kernel.set_force_device()
     rng = np.random.default_rng(7)
     for tag, (n_fam, fam, L) in (("kernel_small_8k_rows", (1638, 5, 64)),
                                  ("kernel_64k_rows", (13107, 5, 128))):

@@ -1,0 +1,239 @@
+"""Round-5 device wire formats: 1-byte upload dictionary, split packed
+output with fetch slicing, refined pad buckets, and hybrid routing.
+
+Parity contract: the wire dispatch path (device_call_segments_wire +
+resolve_segments_wire) must reproduce the f64 oracle integer-exactly, same
+as resolve_segments (tests/test_kernel_parity.py) — the wire format is a
+lossless re-encoding, not an approximation.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.ops import oracle
+from fgumi_tpu.ops.kernel import (ConsensusKernel, _pad_out_segments,
+                                  _pad_rows, build_wire, pad_segments_gather,
+                                  unpack_result_split, DEVICE_STATS,
+                                  WIRE_INVALID)
+from fgumi_tpu.ops.tables import quality_tables
+
+TABLES = quality_tables(45, 40)
+
+
+def make_ragged(rng, J, L, max_r=7, err=0.1, n_rate=0.03, qlo=10, qhi=45):
+    counts = rng.integers(2, max_r, size=J)
+    starts = np.concatenate(([0], np.cumsum(counts))).astype(np.int64)
+    N = int(starts[-1])
+    truth = rng.integers(0, 4, size=(J, L))
+    codes = np.repeat(truth, counts, axis=0)
+    errs = rng.random((N, L)) < err
+    codes[errs] = rng.integers(0, 4, size=int(errs.sum()))
+    ns = rng.random((N, L)) < n_rate
+    codes[ns] = 4
+    quals = rng.integers(qlo, qhi + 1, size=(N, L)).astype(np.uint8)
+    return codes.astype(np.uint8), quals, counts, starts
+
+
+def wire_roundtrip(kernel, codes, quals, counts):
+    """Dispatch via the wire path (forced XLA-CPU) and resolve."""
+    rows = np.arange(codes.shape[0], dtype=np.int64)
+    L = codes.shape[1]
+    cd, qd, seg_ids, starts, F_pad, N = pad_segments_gather(
+        codes, quals, rows, L, counts)
+    ticket = kernel.device_call_segments_wire(cd, qd, seg_ids, F_pad,
+                                              len(counts))
+    return kernel.resolve_segments_wire(ticket, cd[:N], qd[:N], starts)
+
+
+def assert_oracle_parity(codes, quals, starts, w, q, d, e):
+    for j in range(len(starts) - 1):
+        fam = slice(starts[j], starts[j + 1])
+        ow, oq, od, oe = oracle.call_family(codes[fam], quals[fam], TABLES)
+        np.testing.assert_array_equal(w[j], ow, err_msg=f"winner fam {j}")
+        np.testing.assert_array_equal(q[j], oq, err_msg=f"qual fam {j}")
+        np.testing.assert_array_equal(d[j], od, err_msg=f"depth fam {j}")
+        np.testing.assert_array_equal(e[j], oe, err_msg=f"errors fam {j}")
+
+
+@pytest.fixture
+def device_kernel(monkeypatch):
+    monkeypatch.setenv("FGUMI_TPU_HOST_ENGINE", "0")
+    k = ConsensusKernel(TABLES)
+    k.set_force_device()
+    return k
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_wire_parity_ragged(device_kernel, seed):
+    rng = np.random.default_rng(seed)
+    codes, quals, counts, starts = make_ragged(rng, J=40, L=32)
+    w, q, d, e = wire_roundtrip(device_kernel, codes, quals, counts)
+    assert_oracle_parity(codes, quals, starts, w, q, d, e)
+
+
+def test_wire_parity_edge_quals(device_kernel):
+    """Q0 (-inf table entries), Q2 floor, very high quals — the suspect /
+    nonfinite guard paths through the dictionary encoding."""
+    rng = np.random.default_rng(9)
+    codes, quals, counts, starts = make_ragged(rng, J=24, L=16, err=0.4,
+                                               qlo=0, qhi=8)
+    w, q, d, e = wire_roundtrip(device_kernel, codes, quals, counts)
+    assert_oracle_parity(codes, quals, starts, w, q, d, e)
+
+
+def test_wire_fallback_many_quals(device_kernel):
+    """>63 distinct quals forces the packed-codes fallback; same parity."""
+    rng = np.random.default_rng(5)
+    codes, quals, counts, starts = make_ragged(rng, J=40, L=16,
+                                               qlo=2, qhi=88)
+    assert len(np.unique(quals)) > 63
+    w, q, d, e = wire_roundtrip(device_kernel, codes, quals, counts)
+    assert_oracle_parity(codes, quals, starts, w, q, d, e)
+
+
+def test_build_wire_encoding():
+    rng = np.random.default_rng(3)
+    codes = rng.integers(0, 5, size=(20, 12)).astype(np.uint8)
+    quals = rng.choice([2, 11, 25, 37, 40], size=(20, 12)).astype(np.uint8)
+    delta94 = np.arange(94, dtype=np.float32) * 0.25
+    wire, dict32 = build_wire(codes, quals, delta94)
+    # invalid sentinel exactly where codes are N
+    np.testing.assert_array_equal(wire == WIRE_INVALID, codes == 4)
+    # code bits survive where valid
+    valid = codes != 4
+    np.testing.assert_array_equal((wire & 3)[valid], codes[valid])
+    # the dictionary maps each wire qidx back to the right delta
+    qidx = (wire >> 2)[valid]
+    np.testing.assert_array_equal(dict32[qidx], delta94[quals[valid]])
+    assert dict32[63] == 0.0
+
+
+def test_build_wire_declines_wide_qual_sets():
+    codes = np.zeros((2, 40), dtype=np.uint8)
+    quals = np.arange(80, dtype=np.uint8).reshape(2, 40)
+    assert build_wire(codes, quals, np.zeros(94, np.float32)) is None
+
+
+def test_pack_codes2_roundtrip():
+    from fgumi_tpu.ops.kernel import QUAL_INVALID, pack_codes2
+
+    rng = np.random.default_rng(4)
+    codes = rng.integers(0, 5, size=(9, 24)).astype(np.uint8)
+    quals = rng.integers(0, 94, size=(9, 24)).astype(np.uint8)
+    cp, q = pack_codes2(codes, quals)
+    assert cp.shape == (9, 6)
+    shifts = np.arange(0, 8, 2, dtype=np.uint8)
+    un = ((cp[:, :, None] >> shifts) & 3).reshape(9, 24)
+    valid = codes != 4
+    np.testing.assert_array_equal(un[valid], codes[valid])
+    np.testing.assert_array_equal(q == QUAL_INVALID, ~valid)
+    np.testing.assert_array_equal(q[valid], quals[valid])
+
+
+def test_unpack_result_split_roundtrip():
+    rng = np.random.default_rng(1)
+    J, L = 7, 16
+    winner = rng.integers(0, 4, size=(J, L)).astype(np.int64)
+    qual = rng.integers(2, 94, size=(J, L)).astype(np.int64)
+    suspect = rng.random((J, L)) < 0.2
+    qs = (qual | suspect.astype(np.int64) << 7).astype(np.uint8)
+    w4 = winner.reshape(J, L // 4, 4)
+    wp = (w4[..., 0] | w4[..., 1] << 2 | w4[..., 2] << 4
+          | w4[..., 3] << 6).astype(np.uint8)
+    w2, q2, s2 = unpack_result_split(qs, wp, J)
+    np.testing.assert_array_equal(w2, winner)
+    np.testing.assert_array_equal(q2, qual)
+    np.testing.assert_array_equal(s2, suspect)
+
+
+def test_pad_rows_buckets():
+    # monotonic, >= n, and waste within the documented caps per regime
+    prev = 0
+    for n in [1, 16, 17, 100, 8192, 8193, 20000, 65536, 65537, 100000,
+              300000, 441242]:
+        p = _pad_rows(n)
+        assert p >= n
+        assert p >= prev
+        prev = p
+        # waste bounded by one bucket, which is a pow2 fraction of the octave
+        if n > 16:
+            shift = 2 if n <= 8192 else (3 if n <= 65536 else 4)
+            m = 1 << max((n - 1).bit_length() - shift, 0)
+            assert p - n < m
+
+
+def test_pad_out_segments():
+    for f_pad in [1, 8, 64, 1024, 65536]:
+        for j in [1, f_pad // 3 + 1, f_pad - 1, f_pad]:
+            out = _pad_out_segments(j, f_pad)
+            assert j <= out <= f_pad
+            # waste <= 1/8 of the pow2 ceiling
+            assert out - j <= max(f_pad // 8, 1)
+
+
+def test_hybrid_routes_overflow_to_host(monkeypatch):
+    """When in-flight dispatches exceed the cap, _dispatch_jobs must route
+    the batch to the host f64 engine (HOST_DISPATCH pending)."""
+    monkeypatch.setenv("FGUMI_TPU_HOST_ENGINE", "0")
+    monkeypatch.setenv("FGUMI_TPU_HYBRID", "1")
+    from fgumi_tpu.ops.kernel import HOST_DISPATCH
+
+    k = ConsensusKernel(TABLES)
+    k.set_force_device()
+    assert k.hybrid_mode()
+    # simulate a saturated device pipe
+    monkeypatch.setattr(DEVICE_STATS, "in_flight", 99)
+    assert DEVICE_STATS.in_flight_count() == 99
+
+    class FakeFast:
+        max_inflight = 3
+        mesh = None
+
+    # distill the routing condition _dispatch_jobs applies
+    route_host = k.host_mode() or (
+        k.hybrid_mode()
+        and DEVICE_STATS.in_flight_count() >= FakeFast.max_inflight)
+    assert route_host
+    monkeypatch.setattr(DEVICE_STATS, "in_flight", 0)
+    route_host = k.host_mode() or (
+        k.hybrid_mode()
+        and DEVICE_STATS.in_flight_count() >= FakeFast.max_inflight)
+    assert not route_host
+    assert HOST_DISPATCH is not None
+
+
+def test_fast_simplex_hybrid_cli_bytes(tmp_path):
+    """Threaded hybrid run (device pipe cap 0 => everything routes host;
+    cap huge => everything routes device/XLA) produce identical bytes."""
+    import subprocess
+    import sys
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sim = tmp_path / "grouped.bam"
+    subprocess.run(
+        [sys.executable, "-m", "fgumi_tpu", "simulate", "grouped-reads",
+         "-o", str(sim), "--num-families", "400",
+         "--family-size-distribution", "longtail",
+         "--read-length", "60", "--seed", "23"],
+        check=True, cwd=REPO, env={**os.environ, "PYTHONPATH": REPO})
+    outs = {}
+    for label, env in (
+            ("host", {"FGUMI_TPU_MAX_INFLIGHT": "0",
+                      "FGUMI_TPU_HOST_ENGINE": "0"}),
+            ("device", {"FGUMI_TPU_MAX_INFLIGHT": "1000000",
+                        "FGUMI_TPU_HOST_ENGINE": "0"}),
+            ("mixed", {"FGUMI_TPU_MAX_INFLIGHT": "1",
+                       "FGUMI_TPU_HOST_ENGINE": "0"})):
+        d = tmp_path / label
+        d.mkdir()
+        subprocess.run(
+            [sys.executable, "-m", "fgumi_tpu", "simplex", "-i", str(sim),
+             "-o", "cons.bam", "--min-reads", "1", "--allow-unmapped",
+             "--threads", "4"],
+            check=True, cwd=d,
+            env={**os.environ, "PYTHONPATH": REPO, **env})
+        outs[label] = (d / "cons.bam").read_bytes()
+    assert outs["host"] == outs["device"]
+    assert outs["host"] == outs["mixed"]

@@ -1,0 +1,143 @@
+"""One-shot tunnel characterization: upload/fetch bandwidth, duplex overlap,
+and dispatch pipelining on the axon-attached TPU.
+
+Run standalone (python tools/tunnel_probe.py); prints one JSON dict. The
+round-5 overlap design (pipeline double-buffering, packed wire formats) is
+sized from these numbers — see docs/device-feeding.md.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    out = {}
+    t0 = time.monotonic()
+    dev = jax.devices()[0]
+    out["init_s"] = round(time.monotonic() - t0, 2)
+    out["device"] = str(dev)
+
+    MB = 1 << 20
+    up8 = np.random.randint(0, 250, size=(16 * MB,), dtype=np.uint8)
+
+    # --- upload bandwidth (16 MB) ---
+    for _ in range(2):
+        t0 = time.monotonic()
+        d = jax.device_put(up8)
+        d.block_until_ready()
+        up_s = time.monotonic() - t0
+    out["upload_16mb_s"] = round(up_s, 3)
+    out["upload_mb_per_s"] = round(16 / up_s, 1)
+
+    # --- fetch bandwidth (16 MB) ---
+    for _ in range(2):
+        t0 = time.monotonic()
+        h = np.asarray(jax.device_get(d))
+        fe_s = time.monotonic() - t0
+    out["fetch_16mb_s"] = round(fe_s, 3)
+    out["fetch_mb_per_s"] = round(16 / fe_s, 1)
+    assert h[0] == up8[0]
+
+    # --- duplex: concurrent upload + fetch of 16 MB each ---
+    res = {}
+
+    def up_thread():
+        t0 = time.monotonic()
+        dd = jax.device_put(up8[: 16 * MB])
+        dd.block_until_ready()
+        res["up"] = time.monotonic() - t0
+
+    def down_thread():
+        t0 = time.monotonic()
+        np.asarray(jax.device_get(d))
+        res["down"] = time.monotonic() - t0
+
+    t0 = time.monotonic()
+    ts = [threading.Thread(target=up_thread), threading.Thread(target=down_thread)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    both = time.monotonic() - t0
+    out["duplex_both_16mb_s"] = round(both, 3)
+    out["duplex_up_s"] = round(res["up"], 3)
+    out["duplex_down_s"] = round(res["down"], 3)
+    # full duplex: both ~= max(up, fetch); half duplex: both ~= up + fetch
+    out["duplex_ratio"] = round(both / (up_s + fe_s), 2)
+
+    # --- dispatch pipelining: 2 jitted calls in flight vs sequential ---
+    @jax.jit
+    def burn(x):
+        # enough compute to be visible: a few passes of elementwise math
+        y = x.astype(jnp.float32)
+        for _ in range(8):
+            y = jnp.sin(y) * 1.0001 + 0.1
+        return jnp.sum(y, axis=0)
+
+    a = np.random.rand(2048, 2048).astype(np.float32)
+    burn(a).block_until_ready()  # compile
+    t0 = time.monotonic()
+    burn(a).block_until_ready()
+    one = time.monotonic() - t0
+    t0 = time.monotonic()
+    r1 = burn(a)
+    r2 = burn(a)
+    r1.block_until_ready()
+    r2.block_until_ready()
+    two = time.monotonic() - t0
+    out["one_dispatch_s"] = round(one, 3)
+    out["two_dispatch_s"] = round(two, 3)
+    out["dispatch_overlap_ratio"] = round(two / (2 * one), 2)
+
+    # --- does a jit call with big numpy args block on the upload? ---
+    big = np.random.randint(0, 250, size=(32 * MB,), dtype=np.uint8)
+
+    @jax.jit
+    def touch(x):
+        return x[:16].astype(jnp.int32) * 2
+
+    touch(big[: 1024]).block_until_ready()
+    t0 = time.monotonic()
+    r = touch(big)
+    enq = time.monotonic() - t0
+    r.block_until_ready()
+    tot = time.monotonic() - t0
+    out["enqueue_32mb_arg_s"] = round(enq, 3)
+    out["complete_32mb_arg_s"] = round(tot, 3)
+
+    # --- device_put async? ---
+    t0 = time.monotonic()
+    dd = jax.device_put(big)
+    enq = time.monotonic() - t0
+    dd.block_until_ready()
+    tot = time.monotonic() - t0
+    out["device_put_enqueue_s"] = round(enq, 3)
+    out["device_put_complete_s"] = round(tot, 3)
+
+    # --- overlapped device_put from 2 threads (split halves) vs one ---
+    halves = [big[: 16 * MB], big[16 * MB:]]
+    t0 = time.monotonic()
+    devs = [None, None]
+
+    def putter(i):
+        devs[i] = jax.device_put(halves[i])
+        devs[i].block_until_ready()
+
+    ts = [threading.Thread(target=putter, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    out["parallel_put_2x16mb_s"] = round(time.monotonic() - t0, 3)
+
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -238,11 +238,12 @@ class DeviceLock:
             self._f = None
 
     def __enter__(self):
-        # block until acquired: a context-managed section must actually
-        # hold the lock (a silent no-acquire would reintroduce the
-        # two-clients-one-chip hang this class exists to prevent)
-        while not self.acquire(timeout_s=3600.0):
-            pass
+        # a context-managed section must actually hold the lock (a silent
+        # no-acquire would reintroduce the two-clients-one-chip hang this
+        # class exists to prevent); bounded wait, explicit failure
+        if not self.acquire(timeout_s=600.0):
+            raise TimeoutError(
+                f"device lock still held by {self.holder()} after 600s")
         return self
 
     def __exit__(self, *exc):

@@ -225,6 +225,11 @@ class _PendingChunk:
             winner, qual, depth, errors = kernel.resolve_segments(
                 dev, codes_d, quals_d, starts)
             self._assign(idxs, winner, qual, depth, errors)
+        elif self.pending[0] == "cols":
+            _, idxs, pending = self.pending
+            winner, qual, depth, errors = kernel.resolve_hard_columns(
+                pending)
+            self._assign(idxs, winner, qual, depth, errors)
         elif self.pending[0] == "segw":
             _, idxs, starts, codes_d, quals_d, ticket = self.pending
             winner, qual, depth, errors = kernel.resolve_segments_wire(
@@ -880,6 +885,9 @@ class FastSimplexCaller:
 
         from ..ops.kernel import DEVICE_STATS, HOST_DISPATCH
 
+        starts = np.concatenate(([0], np.cumsum(counts)))
+        codes_d = np.ascontiguousarray(codes[rows_all, :L_max])
+        quals_d = np.ascontiguousarray(quals[rows_all, :L_max])
         if kernel.host_mode() or (kernel.hybrid_mode()
                                   and DEVICE_STATS.in_flight_count()
                                   >= self.max_inflight):
@@ -888,20 +896,27 @@ class FastSimplexCaller:
             # engine eats the overflow CONCURRENTLY on the resolve pool, so
             # e2e throughput is device + host, not min of the two. No pad,
             # no device layout: the native engine consumes ragged rows.
-            starts = np.concatenate(([0], np.cumsum(counts)))
-            return ("seg", multi, starts,
-                    np.ascontiguousarray(codes[rows_all, :L_max]),
-                    np.ascontiguousarray(quals[rows_all, :L_max]),
+            return ("seg", multi, starts, codes_d, quals_d,
                     HOST_DISPATCH), blocks0
 
-        from ..ops.kernel import pad_segments_gather
+        if not kernel.hybrid_mode():
+            # FGUMI_TPU_HYBRID=0 (or no native library): whole batches ship
+            # to the device in the 1 B/position wire layout — the raw-device
+            # benchmark/debug mode documented in performance-tuning.md
+            from ..ops.kernel import pad_segments_gather
 
-        codes_dev, quals_dev, seg_ids, starts, F_pad, N = pad_segments_gather(
-            codes, quals, rows_all, L_max, counts)
-        ticket = kernel.device_call_segments_wire(
-            codes_dev, quals_dev, seg_ids, F_pad, len(multi))
-        return ("segw", multi, starts, codes_dev[:N], quals_dev[:N],
-                ticket), blocks0
+            codes_dev, quals_dev, seg_ids, starts_p, F_pad, N = \
+                pad_segments_gather(codes, quals, rows_all, L_max, counts)
+            ticket = kernel.device_call_segments_wire(
+                codes_dev, quals_dev, seg_ids, F_pad, len(multi))
+            return ("segw", multi, starts_p, codes_dev[:N], quals_dev[:N],
+                    ticket), blocks0
+
+        # device path: native classify resolves the easy columns on host;
+        # only the hard few percent cross the link as a compact observation
+        # stream (ops/kernel.py dispatch_hard_columns)
+        pending = kernel.dispatch_hard_columns(codes_d, quals_d, starts)
+        return ("cols", multi, pending), blocks0
 
     def _dispatch_sharded(self, multi, counts, starts, codes_d, quals_d,
                           L_max):

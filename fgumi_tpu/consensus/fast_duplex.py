@@ -419,10 +419,16 @@ class FastDuplexCaller:
             if self.mesh is not None:
                 w, q_, d, e = self._dispatch_sharded(cm, qm, counts_m,
                                                      starts_m, L_max)
-            else:
+            elif self.kernel.host_mode():
                 dev, _ = self.kernel.dispatch_segments(cm, qm, counts_m)
                 w, q_, d, e = self.kernel.resolve_segments(dev, cm, qm,
                                                            starts_m)
+            else:
+                # device: classify + compact hard-column dispatch — the
+                # synchronous round trip shrinks to the hard few percent of
+                # observations (ops/kernel.py dispatch_hard_columns)
+                pending = self.kernel.dispatch_hard_columns(cm, qm, starts_m)
+                w, q_, d, e = self.kernel.resolve_hard_columns(pending)
             b_m, q_m = oracle.apply_consensus_thresholds(
                 w, q_, d, opts.min_reads, opts.min_consensus_base_quality)
             tb[multi] = b_m

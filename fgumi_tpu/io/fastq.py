@@ -45,9 +45,13 @@ class _BufferStream:
 
 
 # plain-gzip inputs up to this compressed size decompress whole-buffer via
-# libdeflate (~2-3x streaming zlib); larger files stream to bound memory
+# libdeflate (~2-3x streaming zlib); larger files stream to bound memory.
+# Peak transient footprint on this path is compressed + decompressed
+# simultaneously, i.e. up to ~9x this limit (ADVICE r4) — the 128 MB
+# default keeps that ~1.2 GB worst-case; tune with FGUMI_TPU_GZIP_WHOLE_LIMIT
+# (documented in docs/performance-tuning.md).
 _GZIP_WHOLE_LIMIT = int(os.environ.get("FGUMI_TPU_GZIP_WHOLE_LIMIT",
-                                       str(512 << 20)))
+                                       str(128 << 20)))
 
 
 def _open_stream(path: str):
@@ -66,10 +70,11 @@ def _open_stream(path: str):
             f.close()
             decoded = None
             try:
-                # 8x the limit bounds the DECOMPRESSED side too: past that,
-                # stream with bounded memory (gzip_decompress_all -> None)
+                # 8x the FILE size bounds the DECOMPRESSED side (FASTQ gzip
+                # compresses ~3-4x): past that, stream with bounded memory
+                # (gzip_decompress_all -> None)
                 decoded = native.gzip_decompress_all(
-                    raw, max_out=8 * _GZIP_WHOLE_LIMIT)
+                    raw, max_out=8 * max(len(raw), 1 << 20))
             except (ValueError, MemoryError):
                 decoded = None  # let the streaming path report the error
             raw = None

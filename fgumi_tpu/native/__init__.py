@@ -24,7 +24,7 @@ _lock = threading.Lock()
 _lib = None
 _lib_failed = False
 # must equal fgumi_abi_version() in fgumi_native.cc (stale-.so guard)
-_ABI_VERSION = 9
+_ABI_VERSION = 10
 
 
 def _build() -> bool:
@@ -116,6 +116,11 @@ def _declare(lib):
     lib.fgumi_consensus_segments.argtypes = (
         [p, p, p, ctypes.c_long, ctypes.c_long, p, p, ctypes.c_double,
          ctypes.c_int, ctypes.c_int] + [p] * 8 + [p, p, p, ctypes.c_long])
+    lib.fgumi_consensus_classify.restype = ctypes.c_long
+    lib.fgumi_consensus_classify.argtypes = (
+        [p, p, p, ctypes.c_long, ctypes.c_long, p, ctypes.c_double,
+         ctypes.c_int, ctypes.c_int] + [p] * 8
+        + [p, p, p, p, p, ctypes.c_long, ctypes.c_long, p])
     lib.fgumi_ranges_equal.restype = None
     lib.fgumi_ranges_equal.argtypes = [p] * 5 + [ctypes.c_long, p]
     lib.fgumi_hash_ranges.restype = None
@@ -328,16 +333,20 @@ def gzip_decompress_all(data, max_out: int = None) -> "object":
     # clamp the footer-seeded guess to a sane expansion ratio: a corrupt or
     # truncated footer is arbitrary bytes and must not size the allocation
     cap = max(min(isize + 64, 1024 * n), 4 * n, 1 << 16)
-    if max_out is not None:
-        cap = min(cap, max_out)
+    # hard retry ceiling even without an explicit max_out: deflate expands
+    # at most ~1032x, so a crafted multi-member stream with lying ISIZE
+    # footers cannot drive the doubling loop to MemoryError (ADVICE r4)
+    hard_cap = 1040 * n + (1 << 16)
+    max_out = hard_cap if max_out is None else min(max_out, hard_cap)
+    cap = min(cap, max_out)
     while True:
         out = np.empty(cap, dtype=np.uint8)
         produced = lib.fgumi_gzip_decompress(src.ctypes.data, n,
                                              out.ctypes.data, cap)
         if produced == -2:
-            if max_out is not None and cap >= max_out:
+            if cap >= max_out:
                 return None  # too big to materialize: stream instead
-            cap = cap * 2 if max_out is None else min(cap * 2, max_out)
+            cap = min(cap * 2, max_out)
             continue
         src = None
         data = None

@@ -671,6 +671,59 @@ def pack_codes2(codes2d: np.ndarray, quals2d: np.ndarray):
     return np.ascontiguousarray(cp), q
 
 
+@_lazy_jit(static_argnames=("num_segments", "out_segments"))
+def _consensus_columns_wire_jit(wire_obs, depths, dict_tab, ln_error_pre_umi,
+                                num_segments, out_segments):
+    """Hard-column consensus: a flat wire-format observation stream with
+    per-column depths -> per-column (qual|suspect u8, 2-bit winner) packed.
+
+    The device never sees easy columns (the native classify resolved them
+    at byte-scan cost, fgumi_native.cc fgumi_consensus_classify); this
+    kernel gets only the compute-worthy pileup columns, so the upload is
+    ~1 byte per OBSERVATION of the hard few percent instead of 1 byte per
+    position of everything. Segment ids are reconstructed on device from
+    the depths (saves 4 B/obs of seg-id upload)."""
+    n_rows = wire_obs.shape[0]
+    seg_ids = jnp.repeat(jnp.arange(num_segments, dtype=jnp.int32), depths,
+                         total_repeat_length=n_rows)
+    one_hot, delta = _wire_terms(wire_obs, dict_tab)
+    contrib = jax.ops.segment_sum(delta[:, None] * one_hot, seg_ids,
+                                  num_segments=num_segments,
+                                  indices_are_sorted=True)
+    obs = jax.ops.segment_sum(one_hot, seg_ids, num_segments=num_segments,
+                              indices_are_sorted=True).astype(jnp.int32)
+    winner, qual, _depth, _errors, suspect = _call_epilogue(
+        contrib, obs, ln_error_pre_umi)
+    qs = (qual | (suspect.astype(jnp.int32) << 7))[:out_segments]
+    w4 = jnp.where(winner > 3, 0, winner)[:out_segments].reshape(-1, 4)
+    wp = w4[:, 0] | (w4[:, 1] << 2) | (w4[:, 2] << 4) | (w4[:, 3] << 6)
+    return qs.astype(jnp.uint8), wp.astype(jnp.uint8)
+
+
+@_lazy_jit(static_argnames=("num_segments", "out_segments"))
+def _consensus_columns_raw_jit(codes_obs, quals_obs, depths, correct_tab,
+                               err_tab, ln_error_pre_umi, num_segments,
+                               out_segments):
+    """2 B/observation fallback of the hard-column kernel (>63 distinct
+    quals in the stream): raw codes+quals, N_CODE marks pad rows."""
+    n_rows = codes_obs.shape[0]
+    seg_ids = jnp.repeat(jnp.arange(num_segments, dtype=jnp.int32), depths,
+                         total_repeat_length=n_rows)
+    one_hot, delta = _observation_terms(codes_obs, quals_obs, correct_tab,
+                                        err_tab)
+    contrib = jax.ops.segment_sum(delta[:, None] * one_hot, seg_ids,
+                                  num_segments=num_segments,
+                                  indices_are_sorted=True)
+    obs = jax.ops.segment_sum(one_hot, seg_ids, num_segments=num_segments,
+                              indices_are_sorted=True).astype(jnp.int32)
+    winner, qual, _depth, _errors, suspect = _call_epilogue(
+        contrib, obs, ln_error_pre_umi)
+    qs = (qual | (suspect.astype(jnp.int32) << 7))[:out_segments]
+    w4 = jnp.where(winner > 3, 0, winner)[:out_segments].reshape(-1, 4)
+    wp = w4[:, 0] | (w4[:, 1] << 2) | (w4[:, 2] << 4) | (w4[:, 3] << 6)
+    return qs.astype(jnp.uint8), wp.astype(jnp.uint8)
+
+
 @_lazy_jit(static_argnames=("num_segments",))
 def _consensus_segments_packed_jit(codes, quals, seg_ids, correct_tab,
                                    err_tab, ln_error_pre_umi, num_segments):
@@ -1113,6 +1166,155 @@ class ConsensusKernel:
                 lambda f: (codes2d[starts[f]:starts[f + 1]],
                            quals2d[starts[f]:starts[f + 1]]))
         return winner, qual, depth, errors
+
+    # --------------------------------------------------- hard-column hybrid
+
+    def dispatch_hard_columns(self, codes2d: np.ndarray, quals2d: np.ndarray,
+                              starts: np.ndarray):
+        """Classify + async-dispatch: the production device path (round 5).
+
+        The native classify (fgumi_consensus_classify) resolves easy
+        columns on host at byte-scan cost and exports the hard few percent
+        as a compact observation stream; only that stream crosses the link
+        (~2 orders of magnitude fewer bytes than whole pileups), so the
+        device offload stays profitable at any tunnel speed. Returns an
+        opaque pending resolved by resolve_hard_columns (possibly with no
+        device work at all when every column was easy)."""
+        from ..native import batch as nb
+
+        host = self._host()
+        if host._tab1 is None:
+            host._build_tables()
+        t = self.tables
+        with np.errstate(invalid="ignore"):
+            delta64 = np.asarray(t.adjusted_correct, np.float64) - \
+                np.asarray(t.adjusted_error_per_alt, np.float64)
+        winner, qual, depth, errors, hard_idx, hard_depth, hard_counts, \
+            hc, hq = nb.consensus_classify(
+                codes2d, quals2d, starts, delta64, host.g_sat,
+                host.qual_const, MIN_PHRED, host._tab1[0], host._tab1[1],
+                host._tab2[0], host._tab2[1])
+        easy = (winner, qual, depth.astype(np.int64),
+                errors.astype(np.int64))
+        C = len(hard_idx)
+        if C == 0:
+            with self._counter_lock:
+                self.total_positions += winner.size
+            return ("cols_done", easy)
+        M = len(hc)
+        N_pad = _pad_rows(M)
+        C_pad = max(4, 1 << (C - 1).bit_length() if C > 1 else 1)
+        m_out = max(C_pad // 8, 4)
+        C_out = -(-C // m_out) * m_out
+        depths_dev = np.zeros(C_pad, dtype=np.int32)
+        depths_dev[:C] = hard_depth
+        depths_dev[C_pad - 1] += N_pad - M  # pad obs fold into the last id
+        DEVICE_STATS.add_dispatch(M * 16 + C_pad * 40)
+        DEVICE_STATS.add_pad(M, N_pad)
+        pre = self._pre
+        w = build_wire(hc.reshape(1, -1), hq.reshape(1, -1), self._delta94)
+        if w is not None:
+            wire, dict64 = w
+            wire_pad = np.full(N_pad, WIRE_INVALID, dtype=np.uint8)
+            wire_pad[:M] = wire.ravel()
+            upload = wire_pad.nbytes + depths_dev.nbytes
+
+            def _dispatch():
+                _ensure_jax()
+                wd = jax.device_put(wire_pad)
+                dd = jax.device_put(depths_dev)
+                return _consensus_columns_wire_jit(wd, dd, dict64, pre,
+                                                   C_pad, C_out)
+        else:
+            correct, err = self._correct_f32, self._err_f32
+            codes_pad = np.full(N_pad, N_CODE, dtype=np.uint8)
+            codes_pad[:M] = hc
+            quals_pad = np.zeros(N_pad, dtype=np.uint8)
+            quals_pad[:M] = hq
+            upload = codes_pad.nbytes + quals_pad.nbytes + depths_dev.nbytes
+
+            def _dispatch():
+                _ensure_jax()
+                cd = jax.device_put(codes_pad)
+                qd = jax.device_put(quals_pad)
+                dd = jax.device_put(depths_dev)
+                return _consensus_columns_raw_jit(cd, qd, dd, correct, err,
+                                                  pre, C_pad, C_out)
+        ticket = DEVICE_FEEDER.submit(_dispatch)
+        ticket.slot = DEVICE_STATS.begin_in_flight(upload)
+        return ("cols_dev", easy, hard_idx, hard_depth, hard_counts, hc, hq,
+                ticket)
+
+    def resolve_hard_columns(self, pending):
+        """Fetch + scatter a dispatch_hard_columns pending.
+
+        Returns (winner, qual, depth, errors) (J, L) with hard columns
+        filled from the device result and suspects recomputed exactly by
+        the f64 oracle over the exported observation stream."""
+        if pending[0] == "cols_done":
+            return pending[1]
+        _, easy, hard_idx, hard_depth, hard_counts, hc, hq, ticket = pending
+        winner, qual, depth, errors = easy
+        C = len(hard_idx)
+        t0 = time.monotonic()
+        fetched = 0
+        try:
+            dev = ticket.wait()
+            qs, wp = DEVICE_STATS.fetch(dev)
+            fetched = qs.nbytes + wp.nbytes
+        finally:
+            DEVICE_STATS.end_in_flight(ticket.slot, fetched,
+                                       time.monotonic() - t0)
+        w_col, q_col, suspect = unpack_result_split(
+            qs.reshape(1, -1), wp.reshape(1, -1), 1)
+        w_col = w_col.ravel()[:C].astype(np.uint8)
+        q_col = q_col.ravel()[:C].astype(np.uint8)
+        suspect = suspect.ravel()[:C]
+        e_col = hard_depth - hard_counts[np.arange(C), w_col]
+        wf = winner.ravel()
+        qf = qual.ravel()
+        df = depth.ravel()
+        ef = errors.ravel()
+        wf[hard_idx] = w_col
+        qf[hard_idx] = q_col
+        df[hard_idx] = hard_depth
+        ef[hard_idx] = e_col
+        with self._counter_lock:
+            self.total_positions += winner.size
+            self.fallback_positions += int(suspect.sum())
+        if suspect.any():
+            self._patch_hard_columns(suspect, hard_idx, hard_depth, hc, hq,
+                                     wf, qf, df, ef)
+        return winner, qual, depth, errors
+
+    def _patch_hard_columns(self, suspect, hard_idx, hard_depth, hc, hq,
+                            wf, qf, df, ef):
+        """Exact f64 recompute of suspect hard columns from the exported
+        observation stream (the column-major analog of _oracle_patch,
+        bucketed by pow2 depth class so one deep column cannot inflate
+        every other column's pad rows)."""
+        from . import oracle
+
+        obs_starts = np.concatenate(([0], np.cumsum(hard_depth)))
+        sus = np.nonzero(suspect)[0]
+        buckets = {}
+        for s in sus:
+            cls = max(int(hard_depth[s]) - 1, 0).bit_length()
+            buckets.setdefault(cls, []).append(int(s))
+        for cols in buckets.values():
+            r_max = max(int(hard_depth[s]) for s in cols)
+            col_codes = np.full((r_max, len(cols)), N_CODE, dtype=np.uint8)
+            col_quals = np.zeros((r_max, len(cols)), dtype=np.uint8)
+            for k, s in enumerate(cols):
+                lo, hi = obs_starts[s], obs_starts[s + 1]
+                col_codes[:hi - lo, k] = hc[lo:hi]
+                col_quals[:hi - lo, k] = hq[lo:hi]
+            w, q, d, e = oracle.call_family(col_codes, col_quals, self.tables)
+            flat = hard_idx[cols]
+            wf[flat] = w
+            qf[flat] = q
+            df[flat] = d
+            ef[flat] = e
 
     def device_call_segments_sharded(self, codes3d, quals3d, seg_ids2d,
                                      num_segments: int, mesh):

@@ -134,6 +134,16 @@ def simulate_duplex_bam(path: str, num_molecules: int = 100, reads_per_strand: i
     2*reads_per_strand total reads split by a Beta(alpha, beta) ratio draw
     (possibly leaving one strand empty — single-strand families are real
     duplex rejects). None (default) keeps the symmetric fixed split.
+
+    Interaction with ba_fraction (deliberate, ADVICE r4): the Beta draw
+    splits the molecule's total yield FIRST; a molecule suppressed by
+    ba_fraction then loses its B-share reads entirely, so its surviving A
+    family carries only the Beta share n_a, not a full 2*reads_per_strand.
+    This models amplification bias and strand dropout as independent
+    physical processes on one fixed molecular yield (the dropped strand's
+    reads existed and were lost), which is why single-strand families are
+    systematically smaller under bias — matching how real dropout skews
+    family-size distributions rather than re-normalizing them.
     """
     rng = np.random.default_rng(seed)
     header = BamHeader(

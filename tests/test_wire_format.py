@@ -173,6 +173,70 @@ def test_pad_out_segments():
             assert out - j <= max(f_pad // 8, 1)
 
 
+def hard_roundtrip(kernel, codes, quals, starts):
+    pending = kernel.dispatch_hard_columns(codes, quals, starts)
+    return kernel.resolve_hard_columns(pending)
+
+
+@pytest.mark.parametrize("seed,err", [(0, 0.1), (1, 0.4), (2, 0.02)])
+def test_hard_columns_parity(device_kernel, seed, err):
+    """The classify+export device path must match the oracle exactly on
+    every column — easy (native tables/saturation) and hard (device f32 +
+    guard band + oracle patch) alike."""
+    rng = np.random.default_rng(seed)
+    codes, quals, counts, starts = make_ragged(rng, J=40, L=32, err=err)
+    w, q, d, e = hard_roundtrip(device_kernel, codes, quals, starts)
+    assert_oracle_parity(codes, quals, starts, w, q, d, e)
+
+
+def test_hard_columns_parity_edge_quals(device_kernel):
+    """Q0 observations (NaN-poisoned lanes -> hard -> suspect -> oracle)."""
+    rng = np.random.default_rng(9)
+    codes, quals, counts, starts = make_ragged(rng, J=24, L=16, err=0.4,
+                                               qlo=0, qhi=8)
+    w, q, d, e = hard_roundtrip(device_kernel, codes, quals, starts)
+    assert_oracle_parity(codes, quals, starts, w, q, d, e)
+
+
+def test_hard_columns_all_easy(device_kernel):
+    """A clean unanimous pileup never dispatches (cols_done path)."""
+    rng = np.random.default_rng(2)
+    codes, quals, counts, starts = make_ragged(rng, J=16, L=20, err=0.0,
+                                               n_rate=0.0, qlo=30, qhi=40)
+    pending = device_kernel.dispatch_hard_columns(codes, quals, starts)
+    assert pending[0] == "cols_done"
+    w, q, d, e = device_kernel.resolve_hard_columns(pending)
+    assert_oracle_parity(codes, quals, starts, w, q, d, e)
+
+
+def test_hard_columns_wide_qual_fallback(device_kernel):
+    """>63 distinct quals in the hard stream takes the raw 2 B/obs jit."""
+    rng = np.random.default_rng(7)
+    codes, quals, counts, starts = make_ragged(rng, J=40, L=16, err=0.5,
+                                               qlo=2, qhi=88)
+    assert len(np.unique(quals)) > 63
+    w, q, d, e = hard_roundtrip(device_kernel, codes, quals, starts)
+    assert_oracle_parity(codes, quals, starts, w, q, d, e)
+
+
+def test_hard_columns_deep_family(device_kernel):
+    """One deep family (256 reads) among shallow ones: depth-class
+    bucketing in the suspect patch, saturation on the deep column."""
+    rng = np.random.default_rng(5)
+    counts = np.array([256, 3, 5, 2])
+    starts = np.concatenate(([0], np.cumsum(counts))).astype(np.int64)
+    N = int(starts[-1])
+    L = 12
+    truth = rng.integers(0, 4, size=(4, L))
+    codes = np.repeat(truth, counts, axis=0)
+    errs = rng.random((N, L)) < 0.3
+    codes[errs] = rng.integers(0, 4, size=int(errs.sum()))
+    codes = codes.astype(np.uint8)
+    quals = rng.integers(5, 45, size=(N, L)).astype(np.uint8)
+    w, q, d, e = hard_roundtrip(device_kernel, codes, quals, starts)
+    assert_oracle_parity(codes, quals, starts, w, q, d, e)
+
+
 def test_hybrid_routes_overflow_to_host(monkeypatch):
     """When in-flight dispatches exceed the cap, _dispatch_jobs must route
     the batch to the host f64 engine (HOST_DISPATCH pending)."""
@@ -225,7 +289,9 @@ def test_fast_simplex_hybrid_cli_bytes(tmp_path):
             ("device", {"FGUMI_TPU_MAX_INFLIGHT": "1000000",
                         "FGUMI_TPU_HOST_ENGINE": "0"}),
             ("mixed", {"FGUMI_TPU_MAX_INFLIGHT": "1",
-                       "FGUMI_TPU_HOST_ENGINE": "0"})):
+                       "FGUMI_TPU_HOST_ENGINE": "0"}),
+            ("wholebatch", {"FGUMI_TPU_HYBRID": "0",
+                            "FGUMI_TPU_HOST_ENGINE": "0"})):
         d = tmp_path / label
         d.mkdir()
         subprocess.run(
@@ -237,3 +303,4 @@ def test_fast_simplex_hybrid_cli_bytes(tmp_path):
         outs[label] = (d / "cons.bam").read_bytes()
     assert outs["host"] == outs["device"]
     assert outs["host"] == outs["mixed"]
+    assert outs["host"] == outs["wholebatch"]

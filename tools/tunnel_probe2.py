@@ -5,6 +5,7 @@ infinite), duplex overlap, and dispatch pipelining with compute-only args.
 
 import json
 import threading
+import sys
 import time
 
 import numpy as np
@@ -131,4 +132,11 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import os
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from devprobe import DeviceLock
+
+    # the chip is single-tenant: serialize with every other session probe
+    # and payload on the shared flock (devprobe.DeviceLock)
+    with DeviceLock():
+        main()

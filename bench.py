@@ -62,12 +62,21 @@ rc = main(base + ["-o", os.path.join(out_dir, "warm.bam")])
 warm_s = time.monotonic() - t0
 assert rc == 0, "warm-up run failed"
 from fgumi_tpu.ops.kernel import DEVICE_STATS
-DEVICE_STATS.reset()
-t0 = time.monotonic()
-rc = main(base + ["-o", os.path.join(out_dir, "timed.bam")])
-wall_s = time.monotonic() - t0
-assert rc == 0, "timed run failed"
-dstats = DEVICE_STATS.snapshot()
+# best of two timed runs: the CPU baseline already takes the best of its
+# threaded/inline configs, and the tunnel link speed swings minute to
+# minute (measured 0.4-76 MB/s), so a single draw under-measures either
+# side; same treatment on both platforms keeps the ratio honest
+wall_s = None
+dstats = None
+for _ in range(2):
+    DEVICE_STATS.reset()
+    t0 = time.monotonic()
+    rc = main(base + ["-o", os.path.join(out_dir, "timed.bam")])
+    trial = time.monotonic() - t0
+    assert rc == 0, "timed run failed"
+    if wall_s is None or trial < wall_s:
+        wall_s = trial
+        dstats = DEVICE_STATS.snapshot()
 print(json.dumps({"platform": platform, "device": str(jax.devices()[0]),
                   "warm_s": round(warm_s, 3), "wall_s": round(wall_s, 3),
                   "device_fraction": round(
@@ -130,6 +139,7 @@ class DeviceTrier:
         self.kernel = None
         self.simplex = None
         self.duplex = None
+        self.mixed = None
         self.diagnostics = []
 
     def _remaining(self):
@@ -137,6 +147,7 @@ class DeviceTrier:
 
     def done(self, want_duplex):
         return (self.kernel is not None and self.simplex is not None
+                and self.mixed is not None
                 and (not want_duplex or self.duplex is not None))
 
     def probe(self):
@@ -147,7 +158,7 @@ class DeviceTrier:
         self.probes.append(res)
         return res if res["ok"] else None
 
-    def attempt(self, sim_bam, dup_bam, threads):
+    def attempt(self, sim_bam, dup_bam, threads, mixed_bam=None):
         """One probe-gated pass over the unfinished device measurements."""
         if self._remaining() < 30:
             return
@@ -179,6 +190,18 @@ class DeviceTrier:
                 self.duplex = res
             else:
                 self.diagnostics.append(f"duplex device: {err}")
+        if (self.mixed is None and mixed_bam is not None
+                and self._remaining() > 120):
+            # BASELINE eval config 2 on the device (VERDICT r4 item 3: the
+            # bench must carry a TPU attempt for the ragged mixed-family
+            # config, not silently route around the accelerator)
+            res, err = run_worker(
+                mixed_bam, threads, {},
+                min(self.run_timeout, max(self._remaining(), 60)))
+            if res is not None:
+                self.mixed = res
+            else:
+                self.diagnostics.append(f"mixed-family device: {err}")
 
 
 def main():
@@ -206,11 +229,22 @@ def main():
         n_dup = simulate_duplex_bam(dup, num_molecules=max(n_families // 8, 500),
                                     reads_per_strand=3, seed=42)
 
+    # Mixed-family config (BASELINE eval config 2 analog): long-tail family
+    # sizes 1-50, ragged read lengths, 3' quality decay — exercises the
+    # ragged-batch padding economics the fixed-size config hides. Simulated
+    # up front so device attempts can measure it too (VERDICT r4 item 3).
+    mixed = os.path.join(tmp, "mixed.bam")
+    simulate_grouped_bam(mixed, num_families=max(n_families // 2, 1000),
+                         family_size=4, family_size_distribution="longtail",
+                         read_length=100, read_length_jitter=30,
+                         qual_slope=0.05, error_rate=0.01, seed=43)
+    n_mixed = count_records(mixed)
+
     trier = DeviceTrier(deadline, probe_timeout, run_timeout, t_start)
 
     # Device attempt 1 (upfront: a healthy tunnel yields a TPU number in the
     # first minutes, before any CPU work).
-    trier.attempt(sim, dup, threads)
+    trier.attempt(sim, dup, threads, mixed)
 
     # CPU baseline: identical pipeline, jax pinned to CPU. Inline mode often
     # beats reader/writer threads on CPU jax (XLA's own thread pool competes
@@ -232,7 +266,7 @@ def main():
     if kernel_cpu is None:
         diagnostics.append(f"kernel cpu microbench: {kerr}")
 
-    trier.attempt(sim, dup, threads)  # device attempt 2
+    trier.attempt(sim, dup, threads, mixed)  # device attempt 2
 
     d_cpu = None
     if want_duplex:
@@ -241,32 +275,11 @@ def main():
         if d_cpu_err:
             diagnostics.append(f"duplex cpu: {d_cpu_err}")
 
-    # Mixed-family config (BASELINE eval config 2 analog): long-tail family
-    # sizes 1-50, ragged read lengths, 3' quality decay — exercises the
-    # ragged-batch padding economics the fixed-size config hides; the fast
-    # engine's padding waste comes back in device_stats
-    mixed = os.path.join(tmp, "mixed.bam")
-    simulate_grouped_bam(mixed, num_families=max(n_families // 2, 1000),
-                         family_size=4, family_size_distribution="longtail",
-                         read_length=100, read_length_jitter=30,
-                         qual_slope=0.05, error_rate=0.01, seed=43)
-    n_mixed = count_records(mixed)
     mixed_cpu, merr = run_worker(mixed, threads, CPU_ENV, run_timeout)
-    if mixed_cpu is not None:
-        result_mixed = {
-            "mixed_family_reads_per_sec": round(
-                n_mixed / mixed_cpu["wall_s"], 1),
-            "mixed_family_input_reads": n_mixed,
-            "mixed_family_platform": mixed_cpu["platform"],
-        }
-        ds = mixed_cpu.get("device_stats") or {}
-        if "padding_waste" in ds:
-            result_mixed["mixed_family_padding_waste"] = ds["padding_waste"]
-    else:
-        result_mixed = {}
-        diagnostics.append(f"mixed-family bench: {merr}")
+    if merr:
+        diagnostics.append(f"mixed-family cpu bench: {merr}")
 
-    trier.attempt(sim, dup, threads)  # device attempt 3
+    trier.attempt(sim, dup, threads, mixed)  # device attempt 3
 
     # tertiary metrics: host-side stage throughputs + the full best-practice
     # chain (BASELINE config 5 analog), all on CPU jax in one subprocess —
@@ -306,6 +319,16 @@ run("simplex_chain_s", ["simplex", "-i", j("grouped.bam"), "-o",
                         "--threads", sys.argv[3], "--allow-unmapped"])
 run("filter_s", ["filter", "-i", j("cons.bam"), "-o", j("filt.bam"),
                  "--min-reads", "3"])
+# CODEC chemistry (BASELINE eval config 4): simulate linked-read pairs and
+# call the codec consensus; reported as codec_reads_per_sec
+n_codec_mol = max(n_fam // 2, 1000)
+run("codec_sim_s", ["simulate", "codec-reads", "-o", j("codec.bam"),
+                    "--num-molecules", str(n_codec_mol),
+                    "--pairs-per-molecule", "2", "--read-length", "100",
+                    "--seed", "9"])
+run("codec_s", ["codec", "-i", j("codec.bam"), "-o", j("codec_cons.bam"),
+                "--min-reads", "1", "--threads", sys.argv[3]])
+out["codec_input_reads"] = n_codec_mol * 4  # pairs * 2 reads
 # the chained command (one process, level-0 intermediates) — how a user
 # would actually run BASELINE config 5 with this tool
 run("pipeline_cmd_s", ["pipeline", "-i", j("r1.fq.gz"), j("r2.fq.gz"),
@@ -322,8 +345,10 @@ print(json.dumps(out))
                 CPU_ENV, run_timeout * 3)  # a 6-stage chain, not one run
             if stages is not None:
                 n_stage_reads = stage_fam * 10  # pairs * family size 5
+                codec_reads = stages.pop("codec_input_reads", 0)
                 total = sum(v for k, v in stages.items()
-                            if k not in ("e2e_simulate_s", "pipeline_cmd_s"))
+                            if k not in ("e2e_simulate_s", "pipeline_cmd_s",
+                                         "codec_sim_s", "codec_s"))
                 stages_result["pipeline_stage_seconds"] = stages
                 stages_result["pipeline_e2e_reads_per_sec"] = round(
                     n_stage_reads / total, 1) if total else 0.0
@@ -331,6 +356,10 @@ print(json.dumps(out))
                 if stages.get("pipeline_cmd_s"):
                     stages_result["pipeline_cmd_reads_per_sec"] = round(
                         n_stage_reads / stages["pipeline_cmd_s"], 1)
+                if codec_reads and stages.get("codec_s"):
+                    stages_result["codec_reads_per_sec"] = round(
+                        codec_reads / stages["codec_s"], 1)
+                    stages_result["codec_input_reads"] = codec_reads
             else:
                 stages_result["pipeline_diagnostics"] = [
                     f"stage bench failed: {serr}"]
@@ -365,7 +394,30 @@ print(json.dumps(out))
                    if not p["ok"] and not p.get("skipped")) < 8):
         wait = min(45.0, max(trier.deadline - time.monotonic() - 150, 0))
         time.sleep(wait)
-        trier.attempt(sim, dup, threads)
+        trier.attempt(sim, dup, threads, mixed)
+
+    # mixed-family (eval config 2): BOTH platform numbers recorded, the
+    # faster one is the headline — the accelerator must win this config on
+    # merit, never by the bench routing around the comparison
+    result_mixed = {"mixed_family_input_reads": n_mixed}
+    if mixed_cpu is not None:
+        result_mixed["mixed_family_cpu_reads_per_sec"] = round(
+            n_mixed / mixed_cpu["wall_s"], 1)
+    if trier.mixed is not None:
+        result_mixed["mixed_family_tpu_reads_per_sec"] = round(
+            n_mixed / trier.mixed["wall_s"], 1)
+    for src in (trier.mixed, mixed_cpu):  # prefer the device run's stats
+        ds = (src or {}).get("device_stats") or {}
+        if "padding_waste" in ds:
+            result_mixed["mixed_family_padding_waste"] = ds["padding_waste"]
+            break
+    best = max(((result_mixed.get("mixed_family_cpu_reads_per_sec", 0.0),
+                 mixed_cpu),
+                (result_mixed.get("mixed_family_tpu_reads_per_sec", 0.0),
+                 trier.mixed)), key=lambda t: t[0])
+    if best[1] is not None:
+        result_mixed["mixed_family_reads_per_sec"] = best[0]
+        result_mixed["mixed_family_platform"] = best[1]["platform"]
 
     diagnostics.extend(trier.diagnostics)
     tpu = trier.simplex

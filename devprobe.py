@@ -343,6 +343,14 @@ def _staged_probe_locked(out, timeout_s, env_overrides):
     return out
 
 
+def locked_main(fn):
+    """Run fn() holding the session device lock — the one-line wrapper for
+    standalone diagnostics (tools/tunnel_probe*.py) that attach the
+    single-tenant chip outside the probe/payload harness."""
+    with DeviceLock():
+        return fn()
+
+
 def run_payload(payload, argv, timeout_s, env_overrides=None):
     """Run a python -c payload, parse last stdout line as JSON.
 

@@ -10,8 +10,13 @@ writes a per-variant per-consensus-read TSV <output>.txt with consensus and
 raw-read base counts (variant_review.rs ConsensusVariantReviewInfo columns).
 
 Reads are correlated by the MI tag truncated at the last '/'
-(review.rs:30-42 to_mi). This build streams both coordinate-sorted BAMs
-sequentially (two passes) instead of BAI random access.
+(review.rs:30-42 to_mi). Consensus-read selection uses BAI/CSI random
+access over the variant windows when an index exists next to the consensus
+BAM (io/bam.py BamIndexedReader — the indexed_reader.rs analog; a sparse
+variant list touches only candidate BGZF blocks), falling back to one
+streaming pass otherwise. The grouped-BAM pass always streams: it selects
+by molecule id over the whole file (every read of a selected molecule is
+extracted, wherever it maps), which no coordinate index can answer.
 """
 
 import logging
@@ -250,6 +255,33 @@ def load_variants_from_intervals(path: str, reference) -> list:
 
 # ------------------------------------------------------------------ main flow
 
+def _open_indexed(path: str):
+    """BamIndexedReader over `path` when a .bai/.csi sits next to it, else
+    None (streaming fallback). Tagged with index_kind for the log line."""
+    import os
+
+    from ..io.bam import BamIndexedReader
+
+    for ext in (".bai", ".csi"):
+        ipath = path + ext
+        if os.path.exists(ipath):
+            try:
+                if os.path.getmtime(ipath) < os.path.getmtime(path):
+                    # stale index (BAM rewritten after indexing): virtual
+                    # offsets would silently fetch garbage — stream instead
+                    log.warning("review: %s is older than %s; ignoring the "
+                                "stale index and streaming", ipath, path)
+                    return None
+                r = BamIndexedReader(path, ipath)
+            except (OSError, ValueError) as e:
+                log.warning("review: index %s unusable (%s); streaming",
+                            ipath, e)
+                return None
+            r.index_kind = ext[1:]
+            return r
+    return None
+
+
 def _index_variants(variants) -> dict:
     """chrom -> (sorted positions array, variants sorted by pos)."""
     by_chrom = {}
@@ -326,42 +358,72 @@ def run_review(args) -> int:
         variant_index = _index_variants(variants)
         per_variant_consensus = {id(v): [] for v in variants}
         consensus_site_counts = {id(v): BaseCounts() for v in variants}
+
+        rc_code = []  # error code escape from the visitor
+
+        def visit(rec, writer):
+            """Shared per-record selection for both access paths."""
+            nonlocal n_consensus_out
+            overlapping = _variants_overlapping(variant_index, rec,
+                                                ref_names)
+            if not overlapping:
+                return
+            hits = []
+            for v in overlapping:
+                got = _base_at_position(rec, v.pos)
+                if got is not None:
+                    base = _normalize(got[0], v.ref_base)
+                    key = (id(v), base, rec.name)
+                    if key not in site_seen:
+                        site_seen.add(key)
+                        consensus_site_counts[id(v)].add(base)
+                    non_ref = base != v.ref_base and \
+                        not (args.ignore_ns and base == "N")
+                    detail = (base, got[1])  # drives the TSV row later
+                else:
+                    non_ref = True  # spanning deletion
+                    detail = None  # extracted, but no detail row
+                if non_ref:
+                    hits.append((v, detail))
+            if not hits:
+                return
+            mi = rec.get_str(b"MI")
+            if mi is None:
+                log.error("consensus read %s has no MI tag",
+                          rec.name.decode(errors="replace"))
+                rc_code.append(2)
+                return
+            mi_base = extract_mi_base(mi)
+            selected_mis.add(mi_base)
+            writer.write_record(rec)
+            n_consensus_out += 1
+            for v, detail in hits:
+                per_variant_consensus[id(v)].append((rec, detail))
+
+        indexed = _open_indexed(args.consensus_bam)
         with BamWriter(args.output + ".consensus.bam", header) as writer:
-            for rec in reader:
-                overlapping = _variants_overlapping(variant_index, rec,
-                                                    ref_names)
-                if not overlapping:
-                    continue
-                hits = []
-                for v in overlapping:
-                    got = _base_at_position(rec, v.pos)
-                    if got is not None:
-                        base = _normalize(got[0], v.ref_base)
-                        key = (id(v), base, rec.name)
-                        if key not in site_seen:
-                            site_seen.add(key)
-                            consensus_site_counts[id(v)].add(base)
-                        non_ref = base != v.ref_base and \
-                            not (args.ignore_ns and base == "N")
-                        detail = (base, got[1])  # drives the TSV row later
-                    else:
-                        non_ref = True  # spanning deletion
-                        detail = None  # extracted, but no detail row
-                    if non_ref:
-                        hits.append((v, detail))
-                if not hits:
-                    continue
-                mi = rec.get_str(b"MI")
-                if mi is None:
-                    log.error("consensus read %s has no MI tag",
-                              rec.name.decode(errors="replace"))
-                    return 2
-                mi_base = extract_mi_base(mi)
-                selected_mis.add(mi_base)
-                writer.write_record(rec)
-                n_consensus_out += 1
-                for v, detail in hits:
-                    per_variant_consensus[id(v)].append((rec, detail))
+            if indexed is not None:
+                # BAI/CSI fast path: only blocks overlapping variant windows
+                # are touched. A read spanning several variants appears in
+                # several queries; dedup keeps the first (lowest-coordinate)
+                # visit so record handling matches the streaming order.
+                with indexed:
+                    visited = set()
+                    for v in variants:
+                        tid = dict_order[v.chrom]
+                        for rec in indexed.query(tid, v.pos - 1, v.pos):
+                            rkey = (rec.name, rec.flag, rec.ref_id, rec.pos)
+                            if rkey in visited:
+                                continue
+                            visited.add(rkey)
+                            visit(rec, writer)
+                log.info("review: consensus pass used the %s index",
+                         "CSI" if indexed.index_kind == "csi" else "BAI")
+            else:
+                for rec in reader:
+                    visit(rec, writer)
+        if rc_code:
+            return rc_code[0]
 
     # Pass 2: grouped BAM — extract raw reads of the selected molecules and
     # accumulate per-(variant, mi, read-number) base counts.

@@ -885,9 +885,6 @@ class FastSimplexCaller:
 
         from ..ops.kernel import DEVICE_STATS, HOST_DISPATCH
 
-        starts = np.concatenate(([0], np.cumsum(counts)))
-        codes_d = np.ascontiguousarray(codes[rows_all, :L_max])
-        quals_d = np.ascontiguousarray(quals[rows_all, :L_max])
         if kernel.host_mode() or (kernel.hybrid_mode()
                                   and DEVICE_STATS.in_flight_count()
                                   >= self.max_inflight):
@@ -896,7 +893,10 @@ class FastSimplexCaller:
             # engine eats the overflow CONCURRENTLY on the resolve pool, so
             # e2e throughput is device + host, not min of the two. No pad,
             # no device layout: the native engine consumes ragged rows.
-            return ("seg", multi, starts, codes_d, quals_d,
+            starts = np.concatenate(([0], np.cumsum(counts)))
+            return ("seg", multi, starts,
+                    np.ascontiguousarray(codes[rows_all, :L_max]),
+                    np.ascontiguousarray(quals[rows_all, :L_max]),
                     HOST_DISPATCH), blocks0
 
         if not kernel.hybrid_mode():
@@ -915,7 +915,10 @@ class FastSimplexCaller:
         # device path: native classify resolves the easy columns on host;
         # only the hard few percent cross the link as a compact observation
         # stream (ops/kernel.py dispatch_hard_columns)
-        pending = kernel.dispatch_hard_columns(codes_d, quals_d, starts)
+        starts = np.concatenate(([0], np.cumsum(counts)))
+        pending = kernel.dispatch_hard_columns(
+            np.ascontiguousarray(codes[rows_all, :L_max]),
+            np.ascontiguousarray(quals[rows_all, :L_max]), starts)
         return ("cols", multi, pending), blocks0
 
     def _dispatch_sharded(self, multi, counts, starts, codes_d, quals_d,
@@ -1058,14 +1061,16 @@ def overlap_correct_span(batch, idx, bounds, g0, g1, oc):
     # else an orphan exists somewhere and the dict scan runs anyway
     first_or_last = (f_span & (FLAG_FIRST | FLAG_LAST)) != 0
     if len(cand):
+        # candidates are never adjacent: cand i requires row i+1 to be
+        # LAST-and-not-FIRST while cand i+1 would require that same row to
+        # be FIRST — so every candidate pair is conflict-free and the
+        # greedy keep reduces to the whole candidate set (vectorized; this
+        # was a 184k-iteration Python loop per run)
         used = np.zeros(len(span), dtype=bool)
-        keep = []
-        for c in cand:
-            if not used[c] and not used[c + 1]:
-                used[c] = used[c + 1] = True
-                keep.append(c)
+        used[cand] = True
+        used[cand + 1] = True
         if bool(used[first_or_last].all()):
-            keep = np.asarray(keep, dtype=np.int64)
+            keep = cand
             a, b = span[keep], span[keep + 1]
             name_off = batch.data_off + 32
             name_len = (batch.l_read_name - 1).astype(np.int32)

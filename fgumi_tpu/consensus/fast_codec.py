@@ -180,10 +180,19 @@ class FastCodecCaller:
                     codes2d[row, :k] = c[:k]
                     quals2d[row, :k] = q[:k]
                     row += 1
-            dev, starts = ss.kernel.dispatch_segments(codes2d, quals2d,
-                                                      counts)
-            w, q_, d, e = ss.kernel.resolve_segments(dev, codes2d, quals2d,
-                                                     starts)
+            if ss.kernel.host_mode() or not ss.kernel.hybrid_mode():
+                dev, starts = ss.kernel.dispatch_segments(codes2d, quals2d,
+                                                          counts)
+                w, q_, d, e = ss.kernel.resolve_segments(dev, codes2d,
+                                                         quals2d, starts)
+            else:
+                # device: classify + compact hard-column dispatch (the
+                # synchronous round trip ships only the hard few percent —
+                # same routing as the duplex SS stage)
+                starts = np.concatenate(([0], np.cumsum(counts)))
+                pending = ss.kernel.dispatch_hard_columns(codes2d, quals2d,
+                                                          starts)
+                w, q_, d, e = ss.kernel.resolve_hard_columns(pending)
             slots = [(v[0], v[1], v[4]) for v in vec_multi] \
                 + [(c[0], c[1], c[2]) for c in cls]
             # thresholds are elementwise: one vectorized pass over the whole

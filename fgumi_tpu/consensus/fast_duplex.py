@@ -419,7 +419,9 @@ class FastDuplexCaller:
             if self.mesh is not None:
                 w, q_, d, e = self._dispatch_sharded(cm, qm, counts_m,
                                                      starts_m, L_max)
-            elif self.kernel.host_mode():
+            elif self.kernel.host_mode() or not self.kernel.hybrid_mode():
+                # host engine, or FGUMI_TPU_HYBRID=0 whole-batch device mode
+                # (same flag semantics as the simplex path)
                 dev, _ = self.kernel.dispatch_segments(cm, qm, counts_m)
                 w, q_, d, e = self.kernel.resolve_segments(dev, cm, qm,
                                                            starts_m)

@@ -671,6 +671,30 @@ def pack_codes2(codes2d: np.ndarray, quals2d: np.ndarray):
     return np.ascontiguousarray(cp), q
 
 
+def _columns_body(one_hot, delta, depths, ln_error_pre_umi, num_segments,
+                  out_segments):
+    """Shared hard-column reduction: per-observation (one_hot, delta) ->
+    sliced split-packed per-column result. Segment ids are reconstructed on
+    device from the depths (saves 4 B/obs of seg-id upload); the output
+    packing delegates to _pack_result_split so the suspect-bit/2-bit-winner
+    wire word has exactly one encoder."""
+    n_rows = one_hot.shape[0]
+    seg_ids = jnp.repeat(jnp.arange(num_segments, dtype=jnp.int32), depths,
+                         total_repeat_length=n_rows)
+    contrib = jax.ops.segment_sum(delta[:, None] * one_hot, seg_ids,
+                                  num_segments=num_segments,
+                                  indices_are_sorted=True)
+    obs = jax.ops.segment_sum(one_hot, seg_ids, num_segments=num_segments,
+                              indices_are_sorted=True).astype(jnp.int32)
+    winner, qual, _depth, _errors, suspect = _call_epilogue(
+        contrib, obs, ln_error_pre_umi)
+    # (C,) columns pack as one L=4-wide pseudo-row group: same wire word
+    qs, wp = _pack_result_split(winner.reshape(-1, 4),
+                                qual.reshape(-1, 4),
+                                suspect.reshape(-1, 4), out_segments // 4)
+    return qs.reshape(-1)[:out_segments], wp.reshape(-1)
+
+
 @_lazy_jit(static_argnames=("num_segments", "out_segments"))
 def _consensus_columns_wire_jit(wire_obs, depths, dict_tab, ln_error_pre_umi,
                                 num_segments, out_segments):
@@ -681,23 +705,10 @@ def _consensus_columns_wire_jit(wire_obs, depths, dict_tab, ln_error_pre_umi,
     at byte-scan cost, fgumi_native.cc fgumi_consensus_classify); this
     kernel gets only the compute-worthy pileup columns, so the upload is
     ~1 byte per OBSERVATION of the hard few percent instead of 1 byte per
-    position of everything. Segment ids are reconstructed on device from
-    the depths (saves 4 B/obs of seg-id upload)."""
-    n_rows = wire_obs.shape[0]
-    seg_ids = jnp.repeat(jnp.arange(num_segments, dtype=jnp.int32), depths,
-                         total_repeat_length=n_rows)
+    position of everything."""
     one_hot, delta = _wire_terms(wire_obs, dict_tab)
-    contrib = jax.ops.segment_sum(delta[:, None] * one_hot, seg_ids,
-                                  num_segments=num_segments,
-                                  indices_are_sorted=True)
-    obs = jax.ops.segment_sum(one_hot, seg_ids, num_segments=num_segments,
-                              indices_are_sorted=True).astype(jnp.int32)
-    winner, qual, _depth, _errors, suspect = _call_epilogue(
-        contrib, obs, ln_error_pre_umi)
-    qs = (qual | (suspect.astype(jnp.int32) << 7))[:out_segments]
-    w4 = jnp.where(winner > 3, 0, winner)[:out_segments].reshape(-1, 4)
-    wp = w4[:, 0] | (w4[:, 1] << 2) | (w4[:, 2] << 4) | (w4[:, 3] << 6)
-    return qs.astype(jnp.uint8), wp.astype(jnp.uint8)
+    return _columns_body(one_hot, delta, depths, ln_error_pre_umi,
+                         num_segments, out_segments)
 
 
 @_lazy_jit(static_argnames=("num_segments", "out_segments"))
@@ -706,22 +717,10 @@ def _consensus_columns_raw_jit(codes_obs, quals_obs, depths, correct_tab,
                                out_segments):
     """2 B/observation fallback of the hard-column kernel (>63 distinct
     quals in the stream): raw codes+quals, N_CODE marks pad rows."""
-    n_rows = codes_obs.shape[0]
-    seg_ids = jnp.repeat(jnp.arange(num_segments, dtype=jnp.int32), depths,
-                         total_repeat_length=n_rows)
     one_hot, delta = _observation_terms(codes_obs, quals_obs, correct_tab,
                                         err_tab)
-    contrib = jax.ops.segment_sum(delta[:, None] * one_hot, seg_ids,
-                                  num_segments=num_segments,
-                                  indices_are_sorted=True)
-    obs = jax.ops.segment_sum(one_hot, seg_ids, num_segments=num_segments,
-                              indices_are_sorted=True).astype(jnp.int32)
-    winner, qual, _depth, _errors, suspect = _call_epilogue(
-        contrib, obs, ln_error_pre_umi)
-    qs = (qual | (suspect.astype(jnp.int32) << 7))[:out_segments]
-    w4 = jnp.where(winner > 3, 0, winner)[:out_segments].reshape(-1, 4)
-    wp = w4[:, 0] | (w4[:, 1] << 2) | (w4[:, 2] << 4) | (w4[:, 3] << 6)
-    return qs.astype(jnp.uint8), wp.astype(jnp.uint8)
+    return _columns_body(one_hot, delta, depths, ln_error_pre_umi,
+                         num_segments, out_segments)
 
 
 @_lazy_jit(static_argnames=("num_segments",))

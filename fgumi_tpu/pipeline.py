@@ -23,11 +23,18 @@ log = logging.getLogger("fgumi_tpu")
 
 
 class StageTimes:
-    """Per-stage busy/blocked wall time (PipelineStats-lite, base.rs:2853)."""
+    """Per-stage busy/blocked wall time + queue-occupancy samples
+    (PipelineStats-lite, reference base.rs:2853-3379: per-step timers and
+    QueueSample history; VERDICT r4 item 9)."""
 
     def __init__(self):
         self.busy = {}
         self.blocked = {}
+        self.q_samples = 0
+        self.q_in_sum = 0
+        self.q_in_max = 0
+        self.q_out_sum = 0
+        self.q_out_max = 0
 
     def add_busy(self, stage: str, dt: float):
         self.busy[stage] = self.busy.get(stage, 0.0) + dt
@@ -35,12 +42,27 @@ class StageTimes:
     def add_blocked(self, stage: str, dt: float):
         self.blocked[stage] = self.blocked.get(stage, 0.0) + dt
 
+    def sample_queues(self, q_in_depth: int, q_out_depth: int):
+        """One occupancy sample per processed item (the analog of the
+        reference's QueueSample monitor history, bam.rs:3640-3690)."""
+        self.q_samples += 1
+        self.q_in_sum += q_in_depth
+        self.q_in_max = max(self.q_in_max, q_in_depth)
+        self.q_out_sum += q_out_depth
+        self.q_out_max = max(self.q_out_max, q_out_depth)
+
     def format_table(self) -> str:
         stages = sorted(set(self.busy) | set(self.blocked))
         lines = ["stage        busy_s   blocked_s"]
         for s in stages:
             lines.append(f"{s:<12} {self.busy.get(s, 0.0):7.3f}   "
                          f"{self.blocked.get(s, 0.0):7.3f}")
+        if self.q_samples:
+            lines.append(
+                f"queues       in avg {self.q_in_sum / self.q_samples:.1f} "
+                f"max {self.q_in_max}; out avg "
+                f"{self.q_out_sum / self.q_samples:.1f} max {self.q_out_max} "
+                f"({self.q_samples} samples)")
         return "\n".join(lines)
 
 
@@ -371,6 +393,7 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
                     budget.release(nb)
             counters[1] += 1
             stats.add_busy("process", time.monotonic() - now)
+            stats.sample_queues(q_in.qsize(), q_out.qsize())
             if writer_exc:
                 raise writer_exc[0]
     finally:

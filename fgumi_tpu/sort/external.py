@@ -349,7 +349,19 @@ class NativeExternalSorter:
     _GATHER_CHUNK = 8 << 20  # target bytes per emitted wire blob
 
     def __init__(self, key_fn, max_bytes: int = 256 << 20, tmp_dir=None,
-                 max_records: int = None):
+                 max_records: int = None, spill_workers: int = 0):
+        """spill_workers > 0 overlaps Phase 1: completed pools are sorted,
+        compressed, and written by background threads (the native calls
+        release the GIL) while the caller keeps ingesting into fresh pools —
+        the fixed-role analog of the reference's phase-aware worker pool
+        (fgumi-sort/src/worker_pool.rs:1-35,669: DecompressInput >
+        ReadInputBlocks > CompressSpill). In-flight spills are bounded by
+        the worker count so memory stays ~ (1 + workers) * max_bytes. Tie
+        determinism is preserved: each spill is assigned its run slot at
+        submission, so the k-way merge sees runs in ingest order no matter
+        which worker finishes first. On a single-core host this only
+        overlaps I/O waits — wall-clock scaling needs real cores
+        (docs/performance-tuning.md)."""
         import numpy as np
 
         from ..native import get_lib
@@ -367,6 +379,9 @@ class NativeExternalSorter:
         self._reset_pools()
         self._run_paths = []
         self.n_records = 0
+        self._spill_workers = max(int(spill_workers), 0)
+        self._executor = None
+        self._futures = []
 
     def _reset_pools(self):
         self._keys = bytearray()
@@ -468,25 +483,54 @@ class NativeExternalSorter:
                 self._tmp_dir = tempfile.mkdtemp(prefix="fgumi_sort_")
                 self._own_tmp_dir = True
 
+    def _build_run(self, path, keys_b, recs_b, spans):
+        """Sort + compress + write one frozen pool to `path` (runs on a
+        spill worker or inline; touches no mutable sorter state)."""
+        np = self._np
+        koff, klen, roff, rlen = spans
+        n = len(klen)
+        perm = np.empty(n, dtype=np.int64)
+        keys = np.frombuffer(keys_b, dtype=np.uint8)
+        recs = np.frombuffer(recs_b, dtype=np.uint8)
+        self._lib.fgumi_sort_spans(keys.ctypes.data, koff.ctypes.data,
+                                   klen.ctypes.data, n, perm.ctypes.data)
+        rc = self._lib.fgumi_write_run(
+            path.encode(), keys.ctypes.data, koff.ctypes.data,
+            klen.ctypes.data, recs.ctypes.data, roff.ctypes.data,
+            rlen.ctypes.data, perm.ctypes.data, n, _FRAME_BYTES, 1)
+        if rc != 0:
+            raise OSError(f"native spill write failed: {path}")
+
     def _spill(self):
         if self._chunk_records == 0:
             return
         self._ensure_tmp_dir()
-        koff, klen, roff, rlen = self._spans()
-        perm = self._sort_perm(koff, klen)
+        spans = self._spans()
+        keys_b, recs_b = self._keys, self._recs
         fd, path = tempfile.mkstemp(dir=self._tmp_dir, suffix=".run")
         os.close(fd)
-        np = self._np
-        keys = np.frombuffer(self._keys, dtype=np.uint8)
-        recs = np.frombuffer(self._recs, dtype=np.uint8)
-        rc = self._lib.fgumi_write_run(
-            path.encode(), keys.ctypes.data, koff.ctypes.data,
-            klen.ctypes.data, recs.ctypes.data, roff.ctypes.data,
-            rlen.ctypes.data, perm.ctypes.data, len(perm), _FRAME_BYTES, 1)
-        if rc != 0:
-            raise OSError(f"native spill write failed: {path}")
-        self._run_paths.append(path)
+        self._run_paths.append(path)  # slot fixed at submission: ingest-order
         self._reset_pools()
+        if self._spill_workers:
+            from concurrent.futures import ThreadPoolExecutor
+
+            if self._executor is None:
+                self._executor = ThreadPoolExecutor(
+                    max_workers=self._spill_workers,
+                    thread_name_prefix="fgumi-spill")
+            # bound in-flight pools: wait for the oldest when every worker
+            # is busy (memory ~ (1 + workers) * max_bytes)
+            while len(self._futures) >= self._spill_workers:
+                self._futures.pop(0).result()
+            self._futures.append(self._executor.submit(
+                self._build_run, path, keys_b, recs_b, spans))
+        else:
+            self._build_run(path, keys_b, recs_b, spans)
+
+    def _drain_spills(self):
+        """Complete every in-flight spill (first exception wins)."""
+        while self._futures:
+            self._futures.pop(0).result()
 
     def _chunked(self, with_lens):
         """Yield sorted output as (wire blob, rec_lens|None) chunks."""
@@ -517,6 +561,7 @@ class NativeExternalSorter:
             self._reset_pools()
             return
         self._spill()
+        self._drain_spills()
         import ctypes as ct
 
         paths = b"\n".join(p.encode() for p in self._run_paths)
@@ -563,6 +608,13 @@ class NativeExternalSorter:
                 off += int(ln)
 
     def close(self):
+        try:
+            self._drain_spills()
+        except Exception:  # noqa: BLE001 - close() must still clean up
+            pass
+        if self._executor is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
         for path in self._run_paths:
             try:
                 os.unlink(path)
@@ -584,14 +636,16 @@ class NativeExternalSorter:
 
 
 def create_sorter(key_fn, max_bytes: int = 256 << 20, tmp_dir=None,
-                  max_records: int = None):
+                  max_records: int = None, spill_workers: int = 0):
     """NativeExternalSorter when the native library is available, else the
     pure-Python ExternalSorter (identical output contract; tested against
-    each other in tests/test_sort_v2.py)."""
+    each other in tests/test_sort_v2.py). spill_workers applies only to the
+    native engine (background Phase-1 spill overlap)."""
     from ..native import get_lib
 
     if get_lib() is not None:
         return NativeExternalSorter(key_fn, max_bytes=max_bytes,
-                                    tmp_dir=tmp_dir, max_records=max_records)
+                                    tmp_dir=tmp_dir, max_records=max_records,
+                                    spill_workers=spill_workers)
     return ExternalSorter(key_fn, max_bytes=max_bytes, tmp_dir=tmp_dir,
                           max_records=max_records)

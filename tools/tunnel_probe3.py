@@ -93,9 +93,6 @@ def main():
 if __name__ == "__main__":
     import os
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-    from devprobe import DeviceLock
+    import devprobe
 
-    # the chip is single-tenant: serialize with every other session probe
-    # and payload on the shared flock (devprobe.DeviceLock)
-    with DeviceLock():
-        main()
+    devprobe.locked_main(main)  # the chip is single-tenant: hold the flock

@@ -268,15 +268,15 @@ def _open_indexed(path: str):
             try:
                 if os.path.getmtime(ipath) < os.path.getmtime(path):
                     # stale index (BAM rewritten after indexing): virtual
-                    # offsets would silently fetch garbage — stream instead
+                    # offsets would silently fetch garbage — try the next
+                    # flavor, else stream
                     log.warning("review: %s is older than %s; ignoring the "
-                                "stale index and streaming", ipath, path)
-                    return None
+                                "stale index", ipath, path)
+                    continue
                 r = BamIndexedReader(path, ipath)
             except (OSError, ValueError) as e:
-                log.warning("review: index %s unusable (%s); streaming",
-                            ipath, e)
-                return None
+                log.warning("review: index %s unusable (%s)", ipath, e)
+                continue
             r.index_kind = ext[1:]
             return r
     return None
@@ -359,7 +359,8 @@ def run_review(args) -> int:
         per_variant_consensus = {id(v): [] for v in variants}
         consensus_site_counts = {id(v): BaseCounts() for v in variants}
 
-        rc_code = []  # error code escape from the visitor
+        class _MissingMi(Exception):
+            pass
 
         def visit(rec, writer):
             """Shared per-record selection for both access paths."""
@@ -389,10 +390,7 @@ def run_review(args) -> int:
                 return
             mi = rec.get_str(b"MI")
             if mi is None:
-                log.error("consensus read %s has no MI tag",
-                          rec.name.decode(errors="replace"))
-                rc_code.append(2)
-                return
+                raise _MissingMi(rec.name.decode(errors="replace"))
             mi_base = extract_mi_base(mi)
             selected_mis.add(mi_base)
             writer.write_record(rec)
@@ -400,30 +398,38 @@ def run_review(args) -> int:
             for v, detail in hits:
                 per_variant_consensus[id(v)].append((rec, detail))
 
-        indexed = _open_indexed(args.consensus_bam)
-        with BamWriter(args.output + ".consensus.bam", header) as writer:
-            if indexed is not None:
-                # BAI/CSI fast path: only blocks overlapping variant windows
-                # are touched. A read spanning several variants appears in
-                # several queries; dedup keeps the first (lowest-coordinate)
-                # visit so record handling matches the streaming order.
-                with indexed:
-                    visited = set()
-                    for v in variants:
-                        tid = dict_order[v.chrom]
-                        for rec in indexed.query(tid, v.pos - 1, v.pos):
-                            rkey = (rec.name, rec.flag, rec.ref_id, rec.pos)
-                            if rkey in visited:
-                                continue
-                            visited.add(rkey)
-                            visit(rec, writer)
-                log.info("review: consensus pass used the %s index",
-                         "CSI" if indexed.index_kind == "csi" else "BAI")
-            else:
-                for rec in reader:
-                    visit(rec, writer)
-        if rc_code:
-            return rc_code[0]
+        # a dense variant list touches essentially every block, where
+        # per-variant queries would re-decompress shared BGZF chunks — the
+        # index only wins when the list is sparse
+        indexed = _open_indexed(args.consensus_bam) \
+            if len(variants) <= 20000 else None
+        try:
+            with BamWriter(args.output + ".consensus.bam", header) as writer:
+                if indexed is not None:
+                    # BAI/CSI fast path: only blocks overlapping variant
+                    # windows are touched. A read spanning several variants
+                    # appears in several queries; dedup keeps the first
+                    # (lowest-coordinate) visit so record handling matches
+                    # the streaming order.
+                    with indexed:
+                        visited = set()
+                        for v in variants:
+                            tid = dict_order[v.chrom]
+                            for rec in indexed.query(tid, v.pos - 1, v.pos):
+                                rkey = (rec.name, rec.flag, rec.ref_id,
+                                        rec.pos)
+                                if rkey in visited:
+                                    continue
+                                visited.add(rkey)
+                                visit(rec, writer)
+                    log.info("review: consensus pass used the %s index",
+                             "CSI" if indexed.index_kind == "csi" else "BAI")
+                else:
+                    for rec in reader:
+                        visit(rec, writer)
+        except _MissingMi as e:
+            log.error("consensus read %s has no MI tag", e)
+            return 2
 
     # Pass 2: grouped BAM — extract raw reads of the selected molecules and
     # accumulate per-(variant, mi, read-number) base counts.

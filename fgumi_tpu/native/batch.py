@@ -510,11 +510,20 @@ def rewrite_tag_records(batch, rows, tag: bytes, values, new_flags=None):
     lib = get_lib()
     rows = np.ascontiguousarray(rows, np.int64)
     k = len(rows)
-    val_blob = np.frombuffer(b"".join(values) or b"\x00", dtype=np.uint8)
-    val_len = np.array([len(v) for v in values], dtype=np.int32)
-    val_off = np.concatenate(
-        ([0], np.cumsum(val_len, dtype=np.int64)))[:-1] \
-        if k else np.empty(0, dtype=np.int64)
+    if isinstance(values, np.ndarray) and values.dtype.kind == "S":
+        # fixed-stride S-array fast path: true lengths + stride offsets
+        # into the array's own buffer (NUL padding is simply never read)
+        val_len = np.char.str_len(values).astype(np.int32)
+        stride = values.dtype.itemsize
+        val_off = np.arange(k, dtype=np.int64) * stride
+        v = np.ascontiguousarray(values)
+        val_blob = v.view(np.uint8) if k else np.zeros(1, np.uint8)
+    else:
+        val_blob = np.frombuffer(b"".join(values) or b"\x00", dtype=np.uint8)
+        val_len = np.array([len(v) for v in values], dtype=np.int32)
+        val_off = np.concatenate(
+            ([0], np.cumsum(val_len, dtype=np.int64)))[:-1] \
+            if k else np.empty(0, dtype=np.int64)
     data_off = np.ascontiguousarray(batch.data_off[rows])
     data_end = np.ascontiguousarray(batch.data_end[rows])
     aux_off = np.ascontiguousarray(batch.aux_off[rows])

@@ -174,7 +174,7 @@ class DeviceTrier:
             else:
                 self.diagnostics.append(f"kernel microbench: {err}")
         others_done = (self.kernel is not None and self.mixed is not None
-                       and self.duplex is not None)
+                       and (dup_bam is None or self.duplex is not None))
         want_simplex = self.simplex is None or (
             # the link speed swings minute to minute: with budget to spare
             # AND every other device measurement banked (retries must never

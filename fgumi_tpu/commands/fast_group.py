@@ -185,13 +185,7 @@ class FastGrouper:
         from ..umi.assigners import render_mis_array
 
         rendered = render_mis_array(self._assign_umis(umis, okeys))
-
-        # family sizes: molecule multiplicities via two unique passes
-        # (vectorized Counter-of-Counter)
-        _, fam_counts = np.unique(rendered, return_counts=True)
-        for size, cnt in zip(*np.unique(fam_counts, return_counts=True)):
-            self.family_sizes[int(size)] = \
-                self.family_sizes.get(int(size), 0) + int(cnt)
+        self._tally_family_sizes(rendered)
         self.position_group_sizes[total] = \
             self.position_group_sizes.get(total, 0) + 1
 
@@ -649,13 +643,9 @@ class FastGrouper:
             from ..umi.assigners import render_mis_array
 
             rend = render_mis_array(acc_mols)
-            # family multiplicities: MI values are globally unique per
-            # family (the global deterministic counter), so one unique
-            # pass tallies every group in the accumulation at once
-            _, fam_counts = np.unique(rend, return_counts=True)
-            for size, cnt in zip(*np.unique(fam_counts, return_counts=True)):
-                self.family_sizes[int(size)] = \
-                    self.family_sizes.get(int(size), 0) + int(cnt)
+            # MI values are globally unique per family (the deterministic
+            # counter), so one tally covers every group in the accumulation
+            self._tally_family_sizes(rend)
             kept_all = np.concatenate(acc_kept)
             acc_mols.clear()
             acc_kept.clear()
@@ -698,6 +688,15 @@ class FastGrouper:
 
         out.extend(flush_fast())
         return out
+
+    def _tally_family_sizes(self, rendered):
+        """Family multiplicities from rendered MI values: two unique passes
+        (vectorized Counter-of-Counter). Safe across position groups — MI
+        values are globally unique per family."""
+        _, fam_counts = np.unique(rendered, return_counts=True)
+        for size, cnt in zip(*np.unique(fam_counts, return_counts=True)):
+            self.family_sizes[int(size)] = \
+                self.family_sizes.get(int(size), 0) + int(cnt)
 
     def _flush_pending(self, batch, rows, values):
         if len(rows) == 0:

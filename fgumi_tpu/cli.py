@@ -2360,7 +2360,20 @@ def cmd_pipeline(args):
                 # clear headroom, else intermediates stay disk-backed
                 need = 8 * sum(os.path.getsize(p) for p in args.input)
                 st = os.statvfs(shm)
-                if st.f_bavail * st.f_frsize > 2 * need:
+                headroom = st.f_bavail * st.f_frsize
+                # tmpfs "free" is the mount quota, not free RAM: tmpfs
+                # pages consume physical memory, so also require real
+                # MemAvailable headroom or risk inviting the OOM killer
+                try:
+                    with open("/proc/meminfo") as f:
+                        for line in f:
+                            if line.startswith("MemAvailable"):
+                                headroom = min(headroom,
+                                               int(line.split()[1]) * 1024)
+                                break
+                except OSError:
+                    pass
+                if headroom > 2 * need:
                     tmp_parent = shm
             except OSError:
                 pass

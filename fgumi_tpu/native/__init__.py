@@ -24,7 +24,7 @@ _lock = threading.Lock()
 _lib = None
 _lib_failed = False
 # must equal fgumi_abi_version() in fgumi_native.cc (stale-.so guard)
-_ABI_VERSION = 10
+_ABI_VERSION = 11
 
 
 def _build() -> bool:
@@ -54,6 +54,10 @@ def _declare(lib):
         ctypes.c_void_p, ctypes.c_long, ctypes.c_void_p, ctypes.c_long]
     lib.fgumi_umi_neighbor_pairs.restype = ctypes.c_long
     lib.fgumi_umi_neighbor_pairs.argtypes = [
+        p, ctypes.c_long, p, ctypes.c_long, ctypes.c_long, ctypes.c_int,
+        p, p, ctypes.c_long]
+    lib.fgumi_umi_bktree_pairs.restype = ctypes.c_long
+    lib.fgumi_umi_bktree_pairs.argtypes = [
         p, ctypes.c_long, p, ctypes.c_long, ctypes.c_long, ctypes.c_int,
         p, p, ctypes.c_long]
     lib.fgumi_adjacency_bfs.restype = None

@@ -88,7 +88,7 @@ extern "C" {
 // ABI version for the stale-.so guard in __init__.py: bump whenever any
 // exported signature changes (a symbol probe alone cannot detect an
 // argument-list change in an existing function).
-long fgumi_abi_version() { return 10; }
+long fgumi_abi_version() { return 11; }
 
 // Candidate UMI pairs with hamming(A[i], B[j]) <= d over (n, L)/(m, L) byte
 // matrices, via the d+1-part pigeonhole (umi/assigners.py
@@ -226,6 +226,94 @@ long fgumi_umi_neighbor_pairs(const uint8_t* A, long n, const uint8_t* B,
         as = ae;
       }
     }
+  }
+  return count;
+}
+
+// BK-tree candidate search over fixed-length byte UMIs (Hamming metric) —
+// the reference's second index flavor (assigner.rs:228,267) beside the
+// pigeonhole partition search above. Children prune by the triangle
+// inequality |dist(child) - dist(query, node)| <= d. Measured (see
+// native/batch.py umi_neighbor_pairs): at UMI lengths 8-12 the pigeonhole
+// wins 3-6x at every d=1..4 — short random UMIs sit near distance 0.75*L,
+// so the triangle bound prunes little — hence this is the verification
+// alternative (FGUMI_TPU_UMI_INDEX=bktree), not the default.
+// Same output contract as fgumi_umi_neighbor_pairs: unique pairs with
+// hamming <= d; A == B emits i < j once, otherwise (A row, B row) cross
+// pairs with i == j skipped. The tree is built over B; A rows query it.
+long fgumi_umi_bktree_pairs(const uint8_t* A, long n, const uint8_t* B,
+                            long m, long L, int d, int64_t* out_i,
+                            int64_t* out_j, long cap) {
+  if (m <= 0 || n <= 0) return 0;
+  const bool same = (A == B);
+  std::vector<long> first_child(static_cast<size_t>(m), -1);
+  std::vector<long> next_sib(static_cast<size_t>(m), -1);
+  std::vector<int> cdist(static_cast<size_t>(m), 0);
+  auto ham = [&](const uint8_t* a, const uint8_t* b) {
+    int miss = 0;
+    for (long c = 0; c < L; ++c) miss += (a[c] != b[c]);
+    return miss;
+  };
+  long count = 0;
+  auto emit = [&](long i, long j) {
+    if (count < cap) {
+      out_i[count] = i;
+      out_j[count] = j;
+    }
+    ++count;
+  };
+  auto insert = [&](long v) {  // v > 0; root is row 0 of B
+    long u = 0;
+    for (;;) {
+      const int duv = ham(B + u * L, B + v * L);
+      long c = first_child[static_cast<size_t>(u)];
+      while (c != -1 && cdist[static_cast<size_t>(c)] != duv) {
+        c = next_sib[static_cast<size_t>(c)];
+      }
+      if (c == -1) {
+        cdist[static_cast<size_t>(v)] = duv;
+        next_sib[static_cast<size_t>(v)] =
+            first_child[static_cast<size_t>(u)];
+        first_child[static_cast<size_t>(u)] = v;
+        return;
+      }
+      u = c;
+    }
+  };
+  std::vector<long> stack;
+  auto query = [&](const uint8_t* q, long tree_hi, long qi, bool as_same) {
+    // all tree nodes u < tree_hi with hamming(q, B[u]) <= d
+    stack.clear();
+    stack.push_back(0);
+    while (!stack.empty()) {
+      const long u = stack.back();
+      stack.pop_back();
+      const int duq = ham(B + u * L, q);
+      if (duq <= d && u != qi) {  // u == qi: self (same) / same-template
+        if (as_same) {            // (cross, pigeonhole i == j contract)
+          emit(u < qi ? u : qi, u < qi ? qi : u);
+        } else {
+          emit(qi, u);
+        }
+      }
+      for (long c = first_child[static_cast<size_t>(u)]; c != -1;
+           c = next_sib[static_cast<size_t>(c)]) {
+        if (c >= tree_hi) continue;  // not yet inserted (same-matrix mode)
+        const int cd = cdist[static_cast<size_t>(c)];
+        if (cd >= duq - d && cd <= duq + d) stack.push_back(c);
+      }
+    }
+  };
+  if (same) {
+    // incremental: query the tree of rows < v, then insert v — each
+    // unordered pair is found exactly once
+    for (long v = 1; v < m; ++v) {
+      query(B + v * L, v, v, true);
+      insert(v);
+    }
+  } else {
+    for (long v = 1; v < m; ++v) insert(v);
+    for (long i = 0; i < n; ++i) query(A + i * L, m, i, false);
   }
   return count;
 }

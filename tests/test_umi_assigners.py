@@ -344,3 +344,61 @@ def test_native_bfs_matches_python(monkeypatch):
     b = A.AdjacencyUmiAssigner(1)
     python = [m.render() for m in b.assign(umis)]
     assert native == python
+
+
+def test_bktree_matches_pigeonhole_and_bruteforce():
+    """The BK-tree index (reference assigner.rs:228,267 second flavor) must
+    produce the identical candidate pair set as the pigeonhole partition
+    search and the brute-force truth, same-matrix and cross, d=1..4."""
+    import numpy as np
+
+    from fgumi_tpu.native import batch as nb
+    from fgumi_tpu.native import get_lib
+
+    if get_lib() is None:
+        import pytest
+
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(11)
+    for _ in range(4):
+        n = int(rng.integers(2, 150))
+        L = int(rng.integers(4, 14))
+        mat = rng.integers(0, 4, size=(n, L)).astype(np.uint8)
+        for d in (1, 2, 3, 4):
+            truth = set()
+            for i in range(n):
+                for j in range(i + 1, n):
+                    if int((mat[i] != mat[j]).sum()) <= d:
+                        truth.add((i, j))
+            for index in ("pigeonhole", "bktree"):
+                pi, pj = nb.umi_neighbor_pairs(mat, None, d, index=index)
+                assert set(zip(pi.tolist(), pj.tolist())) == truth, (d, index)
+            m2 = rng.integers(0, 4, size=(int(rng.integers(1, 80)), L)) \
+                .astype(np.uint8)
+            a = nb.umi_neighbor_pairs(m2, mat, d, index="pigeonhole")
+            b = nb.umi_neighbor_pairs(m2, mat, d, index="bktree")
+            assert set(zip(*map(np.ndarray.tolist, a))) \
+                == set(zip(*map(np.ndarray.tolist, b))), (d, "cross")
+
+
+def test_assign_identical_across_umi_index(monkeypatch):
+    """End-to-end grouping must be identical whichever index found the
+    candidate pairs (edge sets are equal; BFS order is index-independent)."""
+    import numpy as np
+
+    from fgumi_tpu.native import get_lib
+
+    if get_lib() is None:
+        import pytest
+
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(5)
+    bases = "ACGT"
+    umis = ["".join(rng.choice(list(bases), 8)) for _ in range(300)]
+    umis = umis + [u[:3] + "T" + u[4:] for u in umis[:50]]  # near-dupes
+    results = {}
+    for index in ("pigeonhole", "bktree"):
+        monkeypatch.setenv("FGUMI_TPU_UMI_INDEX", index)
+        a = AdjacencyUmiAssigner(max_mismatches=3)
+        results[index] = [m.render() for m in a.assign(list(umis))]
+    assert results["pigeonhole"] == results["bktree"]

@@ -2358,21 +2358,17 @@ def cmd_pipeline(args):
                 # stored (level-0) intermediates expand gzip inputs ~4x and
                 # up to two are alive at once; only use tmpfs when it has
                 # clear headroom, else intermediates stay disk-backed
+                from .utils.memory import _mem_available
+
                 need = 8 * sum(os.path.getsize(p) for p in args.input)
                 st = os.statvfs(shm)
                 headroom = st.f_bavail * st.f_frsize
                 # tmpfs "free" is the mount quota, not free RAM: tmpfs
                 # pages consume physical memory, so also require real
                 # MemAvailable headroom or risk inviting the OOM killer
-                try:
-                    with open("/proc/meminfo") as f:
-                        for line in f:
-                            if line.startswith("MemAvailable"):
-                                headroom = min(headroom,
-                                               int(line.split()[1]) * 1024)
-                                break
-                except OSError:
-                    pass
+                avail = _mem_available()
+                if avail is not None:
+                    headroom = min(headroom, avail)
                 if headroom > 2 * need:
                     tmp_parent = shm
             except OSError:

@@ -202,35 +202,42 @@ class FastCodecCaller:
                 w, q_, d, ss.options.min_reads,
                 ss.options.min_consensus_base_quality)
             for fi, (i, s, cl) in enumerate(slots):
-                strand_res[(i, s)] = (b_all[fi, :cl], q_all[fi, :cl],
-                                      d[fi, :cl], e[fi, :cl])
+                strand_res[(i, s)] = ("slot", fi, cl)
+            slot_mats = (b_all, q_all, d, e)
+        else:
+            slot_mats = None
+        return self._finish_batch(molecules, strand_res, slot_mats)
 
-        def vcr(i, s, m):
-            b, q, d, e = strand_res[(i, s)]
-            return VanillaConsensusRead(
-                id=m["umi"] or "", bases=np.asarray(b), quals=np.asarray(q),
-                depths=np.minimum(d, I16_MAX),
-                errors=np.minimum(e, I16_MAX), source_reads=None)
+    @staticmethod
+    def _strand_len(entry) -> int:
+        # slot refs are ("slot", row, len) 3-tuples; materialized strands
+        # are (bases, quals, depths, errors) 4-tuples of arrays
+        return entry[2] if len(entry) == 3 else len(entry[0])
 
-        vcrs = [(vcr(i, 0, m), vcr(i, 1, m))
-                for i, m in enumerate(molecules)]
-        return self._finish_batch(molecules, vcrs)
-
-    def _finish_batch(self, molecules, vcrs):
+    def _finish_batch(self, molecules, strand_res, slot_mats):
         """Batched `_finish` (codec.py:527-568): strand geometry lands in
         concatenated position arrays, the duplex combine + quality-mask math
         of codec.py:360-456 runs once over all molecules (each molecule's
         slice is element-identical to the per-molecule version), and records
-        serialize per molecule. Stats totals match the sequential path."""
+        serialize per molecule. Stats totals match the sequential path.
+
+        Strand results arrive either as ("slot", row, len) references into
+        the batch (F, L) result matrices (the common case — the whole
+        orient/pad placement runs as ONE gather+scatter instead of 2 numpy
+        calls per molecule) or as materialized arrays (single-read and
+        classic-carry strands), placed scalarly."""
+        from .vanilla import I16_MAX
+
         caller = self.caller
         st, opts = caller.stats, caller.options
         keep = []
-        for mol, (v1, v2) in zip(molecules, vcrs):
+        for i, mol in enumerate(molecules):
+            en1, en2 = strand_res[(i, 0)], strand_res[(i, 1)]
             L = mol["consensus_length"]
-            if L < len(v1.bases) or L < len(v2.bases):
+            if L < self._strand_len(en1) or L < self._strand_len(en2):
                 st.reject("ClipOverlapFailed", mol["n_r1"] + mol["n_r2"])
                 continue
-            keep.append((mol, v1, v2))
+            keep.append((mol, en1, en2))
         if not keep:
             return []
         J = len(keep)
@@ -250,29 +257,72 @@ class FastCodecCaller:
         e1 = np.zeros(T, np.int64)
         e2 = np.zeros(T, np.int64)
 
-        def place(v, rc, pad_left, o, L, b, q, d, e):
-            bases = CODE_TO_BASE[np.minimum(v.bases, N_CODE)]
-            quals = np.asarray(v.quals, np.uint8)
-            dep = np.asarray(v.depths, np.int64)
-            err = np.asarray(v.errors, np.int64)
+        def place_arr(bases_c, quals, dep, err, rc, pad_left, o, L,
+                      b, q, d, e):
+            bases = CODE_TO_BASE[np.minimum(bases_c, N_CODE)]
             k = len(bases)
             sl = slice(o + L - k, o + L) if pad_left else slice(o, o + k)
             if rc:
                 b[sl] = _ASCII_COMPLEMENT[bases[::-1]]
                 q[sl] = quals[::-1]
-                d[sl] = dep[::-1]
-                e[sl] = err[::-1]
+                d[sl] = np.minimum(dep[::-1], I16_MAX)
+                e[sl] = np.minimum(err[::-1], I16_MAX)
             else:
                 b[sl] = bases
                 q[sl] = quals
-                d[sl] = dep
-                e[sl] = err
+                d[sl] = np.minimum(dep, I16_MAX)
+                e[sl] = np.minimum(err, I16_MAX)
 
-        for j, (mol, v1, v2) in enumerate(keep):
-            o, L = int(offs[j]), int(Ls[j])
-            r1_neg, r2_neg = mol["r1_is_negative"], mol["r2_is_negative"]
-            place(v1, r1_neg, r1_neg, o, L, b1, q1, d1, e1)
-            place(v2, not r1_neg, r2_neg, o, L, b2, q2, d2, e2)
+        def place_side(side, bt, qt, dt, et):
+            """One side's placement: slot-backed strands in one vectorized
+            gather+scatter; array-backed strands scalarly."""
+            rows = []
+            ks = []
+            os_ = []
+            rcs = []
+            pls = []
+            ls = []
+            for j, (mol, en1, en2) in enumerate(keep):
+                en = en1 if side == 0 else en2
+                r1n = mol["r1_is_negative"]
+                rc = r1n if side == 0 else not r1n
+                pl = r1n if side == 0 else mol["r2_is_negative"]
+                if len(en) == 3:
+                    rows.append(en[1])
+                    ks.append(en[2])
+                    os_.append(int(offs[j]))
+                    rcs.append(rc)
+                    pls.append(pl)
+                    ls.append(int(Ls[j]))
+                else:
+                    place_arr(en[0], en[1], en[2], en[3], rc, pl,
+                              int(offs[j]), int(Ls[j]), bt, qt, dt, et)
+            if not rows:
+                return
+            b_all, q_all, dmat, emat = slot_mats
+            rows = np.asarray(rows, np.int64)
+            ks = np.asarray(ks, np.int64)
+            os_ = np.asarray(os_, np.int64)
+            rcs = np.asarray(rcs, bool)
+            pls = np.asarray(pls, bool)
+            base = os_ + np.where(pls, np.asarray(ls, np.int64) - ks, 0)
+            n_obs = int(ks.sum())
+            within = np.arange(n_obs, dtype=np.int64) \
+                - np.repeat(np.concatenate(([0], np.cumsum(ks)[:-1]))
+                            if len(ks) else np.zeros(0, np.int64), ks)
+            tgt = np.repeat(base, ks) + within
+            rc_rep = np.repeat(rcs, ks)
+            src_col = np.where(rc_rep, np.repeat(ks, ks) - 1 - within,
+                               within)
+            src_row = np.repeat(rows, ks)
+            bb = CODE_TO_BASE[np.minimum(b_all[src_row, src_col], N_CODE)]
+            bt[tgt] = np.where(rc_rep, _ASCII_COMPLEMENT[bb], bb)
+            qt[tgt] = q_all[src_row, src_col]
+            dt[tgt] = np.minimum(dmat[src_row, src_col], I16_MAX)
+            et[tgt] = np.minimum(emat[src_row, src_col], I16_MAX)
+
+        place_side(0, b1, q1, d1, e1)
+        place_side(1, b2, q2, d2, e2)
 
         # ---- duplex combine, one pass over the concatenated strands
         cb, cq, cd, ce, both, disag = combine_arrays(b1, b2, q1, q2,

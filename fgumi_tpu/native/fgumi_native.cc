@@ -244,7 +244,7 @@ long fgumi_umi_neighbor_pairs(const uint8_t* A, long n, const uint8_t* B,
 long fgumi_umi_bktree_pairs(const uint8_t* A, long n, const uint8_t* B,
                             long m, long L, int d, int64_t* out_i,
                             int64_t* out_j, long cap) {
-  if (m <= 0 || n <= 0) return 0;
+  if (m <= 0 || n <= 0 || L <= 0) return 0;  // L==0: match pigeonhole
   const bool same = (A == B);
   std::vector<long> first_child(static_cast<size_t>(m), -1);
   std::vector<long> next_sib(static_cast<size_t>(m), -1);

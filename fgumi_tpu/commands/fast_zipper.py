@@ -17,11 +17,18 @@ array passes plus three native ops:
   fgumi_rebuild_aux_records, whose output order IS TagEditor.finish's order:
   surviving originals in place, appends at the end in staged order.
 
-Anything else — templates spanning batch buffers, secondary/supplementary
-records, half-mapped pairs, tag-name collisions with MQ/MC/ms/AS/XS, active
-reverse/revcomp tag sets on negative-strand reads — falls back to the classic
-engine per template, preserving byte-exact semantics (tests/test_zipper.py
-parity suite runs both engines on adversarial inputs).
+Secondary/supplementary rows vectorize too (round 5): supplementaries get
+the opposite primary's mate pointers/MQ/MC/ms and the same-side tlen,
+secondaries keep their mate fields, and both get the `tc`
+template-coordinate B:i tag from the primaries' unclipped 5' coordinates
+(zipper.rs:281-357, template.rs:459-605). Aligner-dropped templates queue
+INSIDE the window so scattered passthroughs cannot fragment it.
+
+Anything else — templates spanning batch buffers, half-mapped or unmapped
+pairs, tag-name collisions with MQ/MC/ms/AS/XS/tc, active reverse/revcomp
+tag sets on negative-strand reads — falls back to the classic engine per
+template, preserving byte-exact semantics (tests/test_zipper.py parity
+suite runs both engines on adversarial inputs).
 """
 
 import numpy as np
@@ -34,8 +41,8 @@ from .zipper import MappedTemplate, merge_template
 
 _SEC_SUPP = FLAG_SECONDARY | FLAG_SUPPLEMENTARY
 # tag names whose presence on the unmapped record collides with the staged
-# MQ/MC/ms appends or the AS/XS normalization ordering -> classic fallback
-_RESERVED_U_TAGS = {b"MQ", b"MC", b"ms", b"AS", b"XS"}
+# MQ/MC/ms/tc appends or the AS/XS normalization ordering -> classic fallback
+_RESERVED_U_TAGS = {b"MQ", b"MC", b"ms", b"AS", b"XS", b"tc"}
 _INT_TYPES = frozenset(b"cCsSiI")
 
 
@@ -123,8 +130,17 @@ class FastZipper:
     # ------------------------------------------------------------- dispatch
 
     def passthrough(self, u):
-        self._flush()
+        """Aligner-dropped template: the unmapped records pass through.
+
+        Queued INSIDE an open window when it shares the unmapped batch —
+        flushing here would fragment windows into ~20-template slivers on
+        inputs with scattered dropped templates, multiplying the fixed
+        vectorization overhead ~100x (round-5 zoo profile)."""
         name, ub, lo, hi, recs = u
+        if recs is None and self._win and self._win_batches[1] is ub:
+            self._win.append(("pass", u))
+            return
+        self._flush()
         if recs is None:
             w = b"".join(self._wire_rows(ub, lo, hi))
         else:
@@ -142,7 +158,7 @@ class FastZipper:
         if self._win_batches != (m[1], u[1]):
             self._flush()
             self._win_batches = (m[1], u[1])
-        self._win.append((u, m))
+        self._win.append(("pair", u, m))
         if len(self._win) >= 8192:
             self._flush()
 
@@ -179,17 +195,30 @@ class FastZipper:
     # ----------------------------------------------------------- vectorized
 
     def _flush(self):
-        win, self._win = self._win, []
+        items, self._win = self._win, []
         mb, ub = self._win_batches
         self._win_batches = (None, None)
-        if not win:
+        if not items:
             return
-        simple, order = self._classify(win, mb, ub)
+        win = [(it[1], it[2]) for it in items if it[0] == "pair"]
+        if win:
+            simple, order = self._classify(win, mb, ub)
+        else:
+            simple, order = None, ()
         blob = pos = None
         if simple is not None:
             blob, pos, row_of = simple
-        # emit in template order
-        for k, (u, m) in enumerate(win):
+        # emit in stream order, interleaving queued passthroughs
+        k = 0
+        for it in items:
+            if it[0] == "pass":
+                _, pub, lo, hi, _ = it[1]
+                self.writer.write_serialized(
+                    b"".join(self._wire_rows(pub, lo, hi)))
+                self.n_templates += 1
+                self.n_records += hi - lo
+                continue
+            u, m = it[1], it[2]
             if order[k] >= 0:
                 j0 = order[k]
                 n_rows = m[3] - m[2]
@@ -199,6 +228,7 @@ class FastZipper:
                 self.n_records += n_rows
             else:
                 self._classic(u, m)
+            k += 1
 
     def _classify(self, win, mb, ub):
         """Split the window into vectorizable rows and fallbacks.
@@ -212,7 +242,6 @@ class FastZipper:
         u_hi = np.array([u[3] for u, _ in win])
         m_cnt = m_hi - m_lo
         u_cnt = u_hi - u_lo
-        ok = (m_cnt == u_cnt) & ((m_cnt == 1) | (m_cnt == 2))
 
         # per-template screens, vectorized with reduceat: a window's
         # templates are CONTIGUOUS runs on both batches (flush on any
@@ -228,17 +257,28 @@ class FastZipper:
 
         mflag = mb.flag.astype(np.int64)
         uflag = ub.flag.astype(np.int64)
-        bad_m = (mflag & (_SEC_SUPP | FLAG_UNMAPPED)) != 0
+        # secondary/supplementary mapped rows are vectorizable (round 5):
+        # per-row routing below covers their mate/MQ/MC/ms/tc semantics;
+        # only UNMAPPED mapped-side rows force the classic path
+        bad_m = (mflag & FLAG_UNMAPPED) != 0
         bad_u = (uflag & _SEC_SUPP) != 0
+        is_ss = (mflag & _SEC_SUPP) != 0
+        n_ss = seg_count(is_ss, m_lo, m_hi)
+        n_prim = m_cnt - n_ss
+        ok = (n_prim == u_cnt) & ((n_prim == 1) | (n_prim == 2))
         ok &= ~seg_any(bad_m, m_lo, m_hi) & ~seg_any(bad_u, u_lo, u_hi)
+        is_prim = ~is_ss
         m_paired = seg_count((mflag & FLAG_PAIRED) != 0, m_lo, m_hi)
         u_paired = seg_count((uflag & FLAG_PAIRED) != 0, u_lo, u_hi)
-        m_first = seg_count((mflag & FLAG_FIRST) != 0, m_lo, m_hi)
+        m_first_p = seg_count(((mflag & FLAG_FIRST) != 0) & is_prim,
+                              m_lo, m_hi)
         u_first = seg_count((uflag & FLAG_FIRST) != 0, u_lo, u_hi)
-        pair_ok = (m_paired == 2) & (u_paired == 2) & (m_first == 1) \
-            & (u_first == 1)
+        # paired: both primaries present, one FIRST, and EVERY mapped row
+        # (incl. sec/supp) paired so per-row FIRST/LAST routing is defined
+        pair_ok = (u_paired == 2) & (m_first_p == 1) & (u_first == 1) \
+            & (m_paired == m_cnt)
         frag_ok = (m_paired == 0) & (u_paired == 0)
-        ok &= np.where(m_cnt == 2, pair_ok, frag_ok)
+        ok &= np.where(n_prim == 2, pair_ok, frag_ok)
         if self._has_transforms:
             ok &= ~seg_any((mflag & FLAG_REVERSE) != 0, m_lo, m_hi)
 
@@ -264,6 +304,14 @@ class FastZipper:
             return None, np.full(K, -1, dtype=np.int64)
         return (blob, pos, rows), order
 
+    def _u5_of(self, mb):
+        """Per-record unclipped 5' positions, cached per mapped batch."""
+        cache = getattr(self, "_u5_cache", None)
+        if cache is None or cache[0] is not mb:
+            cache = (mb, nb.unclipped_5prime(mb))
+            self._u5_cache = cache
+        return cache[1]
+
     def _u_names(self, ub):
         cache = self._names_cache
         if cache is None or cache[0] is not ub:  # RecordBatch has __slots__
@@ -288,20 +336,37 @@ class FastZipper:
         flag = mb.flag[rows].astype(np.int64)
         paired = (flag & FLAG_PAIRED) != 0
         first = ((flag & FLAG_FIRST) != 0) | ~paired
+        is_sec = (flag & FLAG_SECONDARY) != 0
+        is_supp = (flag & FLAG_SUPPLEMENTARY) != 0
+        ts = np.unique(row_t)
+        big = np.int64(1 << 60)
 
-        # mate row per output row (-1 for fragments): rows are grouped per
-        # template in order, so a 2-row template's mate is the adjacent row
-        mate = np.full(n, -1, dtype=np.int64)
-        adj = np.nonzero(row_t[1:] == row_t[:-1])[0]
-        mate[adj] = adj + 1
-        mate[adj + 1] = adj
-        has_mate = mate >= 0
+        # primary FIRST/LAST rows per template (absolute ids) via
+        # reduceat-min over the mapped run — the mate of every primary AND
+        # supplementary row is the OPPOSITE side's primary
+        # (template.rs:459-605); secondaries keep their mate fields
+        m_base = int(m_lo[ts[0]])
+        m_end = int(m_hi[ts[-1]])
+        run = np.arange(m_base, m_end)
+        rf = mb.flag[m_base:m_end].astype(np.int64)
+        run_prim = (rf & _SEC_SUPP) == 0
+        run_first = ((rf & FLAG_FIRST) != 0) | ((rf & FLAG_PAIRED) == 0)
+        mseg = np.stack([m_lo[ts], m_hi[ts]], axis=1).ravel() - m_base
+        p1_cand = np.append(np.where(run_prim & run_first, run, big), big)
+        p2_cand = np.append(np.where(run_prim & ~run_first, run, big), big)
+        p1_abs = np.minimum.reduceat(p1_cand, mseg)[::2]
+        p2_abs = np.minimum.reduceat(p2_cand, mseg)[::2]  # big: fragment
+        t_pos_m = np.searchsorted(ts, row_t)
+        opp_abs = np.where(first, p2_abs[t_pos_m], p1_abs[t_pos_m])
+        has_mate = (opp_abs < big) & ~is_sec
+        mate = np.where(has_mate,
+                        np.searchsorted(rows, np.minimum(opp_abs, big - 1)),
+                        -1)
 
         # u primary row per output row: FIRST (or unpaired) -> u's
         # FIRST/unpaired record, else u's LAST record. Selected templates'
         # u rows form a contiguous run, but only SELECTED templates count,
         # so reduceat runs over the selected segments explicitly.
-        ts = np.unique(row_t)
         u_base = int(u_lo[ts[0]])
         u_end = int(u_hi[ts[-1]])
         uf_run = ub.flag[u_base:u_end].astype(np.int64)
@@ -337,6 +402,10 @@ class FastZipper:
         tlen = np.where(raw_t >= 0, raw_t + 1, raw_t - 1)
         tlen = np.where(mb.ref_id[rows] == mate_ref, tlen, 0)
         tlen = np.where(has_mate, tlen, mb.tlen[rows])
+        # supplementaries carry -(opposite primary's tlen) — which equals
+        # the same-side primary's formula tlen (template.rs:513-605)
+        tlen = np.where(is_supp & has_mate,
+                        -tlen[np.maximum(mate, 0)], tlen)
 
         new_flag = flag.copy()
         nf = (flag & ~(FLAG_MATE_REVERSE | FLAG_MATE_UNMAPPED)) \
@@ -359,10 +428,11 @@ class FastZipper:
         buf[(do + 14)[:, None] + np.arange(2)] = \
             new_flag.astype("<u2").view(np.uint8).reshape(-1, 2)
 
-        # ---- appends: scratch slots [MQ 0:7 | ms 7:14 | AS 14:21 | XS 21:28]
-        scratch = np.zeros(4 + n * 28, dtype=np.uint8)
+        # ---- appends: scratch slots
+        # [MQ 0:7 | ms 7:14 | AS 14:21 | XS 21:28 | tc 28:60]
+        scratch = np.zeros(4 + n * 60, dtype=np.uint8)
         scratch[0:4] = np.frombuffer(b"MCZ\x00", dtype=np.uint8)
-        slots = scratch[4:].reshape(n, 28)
+        slots = scratch[4:].reshape(n, 60)
         slots[:, 0:2] = np.frombuffer(b"MQ", np.uint8)
         slots[:, 2] = ord("i")
         slots[:, 3:7] = mb.mapq[mate_rows].astype("<i4").view(
@@ -378,6 +448,41 @@ class FastZipper:
 
         as_len = self._norm_entry(slots[:, 14:21], b"AS", as_val, as_present)
         xs_len = self._norm_entry(slots[:, 21:28], b"XS", xs_val, xs_present)
+
+        # tc (B:i [tid1,pos1,neg1,tid2,pos2,neg2], lower coordinate first)
+        # on secondary/supplementary rows (zipper.rs:281-357): values are
+        # per template from the primaries' unclipped 5' coordinates
+        tc_on = (is_sec | is_supp) if not self.skip_tc \
+            else np.zeros(n, dtype=bool)
+        if tc_on.any():
+            u5 = self._u5_of(mb)
+            p1t = np.minimum(p1_abs, len(u5) - 1).astype(np.int64)
+            p2t = np.minimum(p2_abs, len(u5) - 1).astype(np.int64)
+            have2 = p2_abs < big
+
+            def pinfo(pt):
+                return (mb.ref_id[pt].astype(np.int64), u5[pt],
+                        ((mb.flag[pt] & FLAG_REVERSE) != 0).astype(np.int64))
+            tid1, p51, ng1 = pinfo(p1t)
+            tid2, p52, ng2 = pinfo(p2t)
+            tid2 = np.where(have2, tid2, tid1)
+            p52 = np.where(have2, p52, p51)
+            ng2 = np.where(have2, ng2, ng1)
+            swap = (tid2 < tid1) | ((tid2 == tid1) & (p52 < p51))
+            vals = np.stack([np.where(swap, tid2, tid1),
+                             np.where(swap, p52, p51),
+                             np.where(swap, ng2, ng1),
+                             np.where(swap, tid1, tid2),
+                             np.where(swap, p51, p52),
+                             np.where(swap, ng1, ng2)], axis=1)
+            slots[:, 28:30] = np.frombuffer(b"tc", np.uint8)
+            slots[:, 30] = ord("B")
+            slots[:, 31] = ord("i")
+            slots[:, 32:36] = np.frombuffer(
+                np.array([6], dtype="<i4").tobytes(), np.uint8)
+            slots[:, 36:60] = vals[t_pos_m].astype("<i4").view(
+                np.uint8).reshape(-1, 24)
+        tc_len = np.where(tc_on, 32, 0)
 
         # MC: mate cigar strings (omit when the mate has no cigar)
         cig_blob, cig_off = nb.cigar_strings(buf, mb.cigar_off[mate_rows],
@@ -402,9 +507,9 @@ class FastZipper:
         uB_off = np.where(split, upg_end, 0)
         uB_len = np.where(split, u_auxE - upg_end, 0)
 
-        # span table: 9 parts per row, sources 0=scratch 1=cig blob 2=u buf
-        base = (np.arange(n, dtype=np.int64) * 28) + 4
-        part_src = np.tile(np.array([0, 0, 1, 0, 0, 2, 2, 0, 0],
+        # span table: 10 parts per row, sources 0=scratch 1=cig blob 2=u buf
+        base = (np.arange(n, dtype=np.int64) * 60) + 4
+        part_src = np.tile(np.array([0, 0, 1, 0, 0, 2, 2, 0, 0, 0],
                                     dtype=np.int32), n)
         part_off = np.stack([
             base + 0,                                   # MQ slot
@@ -413,7 +518,8 @@ class FastZipper:
             np.full(n, 3, dtype=np.int64),              # NUL const
             base + 7,                                   # ms slot
             uA_off, uB_off,
-            base + 14, base + 21], axis=1).ravel()
+            base + 14, base + 21,
+            base + 28], axis=1).ravel()                 # tc slot
         cig_len = (cig_off[1:] - cig_off[:-1])
         part_len = np.stack([
             np.where(mq_on, 7, 0),
@@ -422,12 +528,12 @@ class FastZipper:
             np.where(mc_on, 1, 0),
             np.where(mate_as_present, 7, 0),
             uA_len, uB_len,
-            as_len, xs_len], axis=1).ravel().astype(np.int64)
+            as_len, xs_len, tc_len], axis=1).ravel().astype(np.int64)
         if (part_len < 0).any():
             raise _FallbackBatch()
         appends, app_all = nb.concat_spans(
             [scratch, cig_blob, ub.buf], part_src, part_off, part_len)
-        app_off = app_all[::9]
+        app_off = app_all[::10]
 
         # ---- drop lists: fixed-width per-record matrices (a zero cell
         # matches no real tag name, so unused slots need no compaction):
@@ -435,18 +541,21 @@ class FastZipper:
         # unmapped tag names (minus the skipped PG)
         ns = len(self._static_drop16)
         max_u = u_names.shape[1]
-        width = ns + 5 + max_u
+        width = ns + 6 + max_u
         dmat = np.zeros((n, width), dtype=np.uint16)
         if ns:
             dmat[:, :ns] = self._static_drop16
         dmat[:, ns + 0] = np.where(mq_on, _tag16(b"MQ"), 0)
         dmat[:, ns + 1] = np.where(has_mate, _tag16(b"MC"), 0)
-        dmat[:, ns + 2] = np.where(has_mate, _tag16(b"ms"), 0)
+        # ms is REPLACED only when the mate has an AS tag — classic keeps a
+        # stale ms otherwise (fix_mate_info only calls set_i32 under p_as)
+        dmat[:, ns + 2] = np.where(mate_as_present, _tag16(b"ms"), 0)
         dmat[:, ns + 3] = np.where(as_len > 0, _tag16(b"AS"), 0)
         dmat[:, ns + 4] = np.where(xs_len > 0, _tag16(b"XS"), 0)
+        dmat[:, ns + 5] = np.where(tc_len > 0, _tag16(b"tc"), 0)
         ublock = u_names[u_row]  # (n, max_u), already zero-padded past count
         ublock = np.where(split[:, None] & (ublock == _PG16), 0, ublock)
-        dmat[:, ns + 5:] = ublock
+        dmat[:, ns + 6:] = ublock
         drop = dmat.ravel()
         drop_off = np.arange(n + 1, dtype=np.int64) * width
 

@@ -271,6 +271,10 @@ def _zip_pair_bams(tmp_path, seed, n_templates=300):
             b.tag_str(b"MC", b"10M")  # stale MC to be replaced
         if i % 6 == 0:
             b.tag_int(b"NM", i % 9)
+        if i % 5 == 2:
+            # stale ms with no AS on the mate: classic KEEPS it (fix_mate_info
+            # only replaces ms under mate-AS) — pins the drop-gating parity
+            b.tag_int(b"ms", 5 + (i % 30))
 
     with BamWriter(m_path, header) as mw, BamWriter(u_path, header) as uw:
         for i in range(n_templates):

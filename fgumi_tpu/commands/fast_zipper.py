@@ -243,10 +243,11 @@ class FastZipper:
         m_cnt = m_hi - m_lo
         u_cnt = u_hi - u_lo
 
-        # per-template screens, vectorized with reduceat: a window's
-        # templates are CONTIGUOUS runs on both batches (flush on any
-        # passthrough/py item guarantees it), so [lo, hi) segments tile the
-        # run exactly
+        # per-template screens, vectorized over cumulative sums with
+        # EXPLICIT [lo, hi) boundaries: template segments are monotone
+        # within each batch but may have gaps (queued passthrough rows sit
+        # between pair templates), so nothing here may assume the segments
+        # tile the run
         def seg_any(values, lo, hi):
             csum = np.concatenate(([0], np.cumsum(values[lo[0]:hi[-1]])))
             return (csum[hi - lo[0]] - csum[lo - lo[0]]) > 0
@@ -372,7 +373,6 @@ class FastZipper:
         uf_run = ub.flag[u_base:u_end].astype(np.int64)
         is_first = ((uf_run & FLAG_FIRST) != 0) | ((uf_run & FLAG_PAIRED) == 0)
         idx = np.arange(u_base, u_end)
-        big = np.int64(1 << 60)
         # selected templates may be non-contiguous (classic ones interleave)
         # -> reduceat over explicit [lo, hi) boundary pairs, sentinel-padded
         # so hi == len is a valid index
@@ -382,9 +382,7 @@ class FastZipper:
         fidx = np.minimum.reduceat(f_cand, seg)[::2]
         oidx = np.minimum.reduceat(o_cand, seg)[::2]
         oidx = np.where(oidx == big, fidx, oidx)
-        # map each output row's template to its position in ts
-        t_pos = np.searchsorted(ts, row_t)
-        u_row = np.where(first, fidx[t_pos], oidx[t_pos])
+        u_row = np.where(first, fidx[t_pos_m], oidx[t_pos_m])
 
         # ---- field patches (in place on the mapped batch buffer; the
         # classic fallback recomputes identical values from the mate
@@ -399,7 +397,12 @@ class FastZipper:
                           ends.astype(np.int64), mb.pos[rows] + 1)
         mate_5p = own_5p[np.maximum(mate, 0)]
         raw_t = mate_5p - own_5p
-        tlen = np.where(raw_t >= 0, raw_t + 1, raw_t - 1)
+        # sign adjustment is decided from the FIRST read's perspective
+        # (_insert_size: second_5p >= first_5p -> +1; R2 takes the negative)
+        # so at an exact 5' tie R1 gets +1 and R2 gets -1
+        adj = np.where(raw_t > 0, 1, np.where(raw_t < 0, -1,
+                                              np.where(first, 1, -1)))
+        tlen = raw_t + adj
         tlen = np.where(mb.ref_id[rows] == mate_ref, tlen, 0)
         tlen = np.where(has_mate, tlen, mb.tlen[rows])
         # supplementaries carry -(opposite primary's tlen) — which equals

@@ -490,10 +490,16 @@ class FastDuplexCaller:
         ba_only = ~p[:, AB_R1] & ~p[:, AB_R2] & p[:, BA_R1] & p[:, BA_R2] \
             & live_mol & (caller.min_yx == 0)
 
-        # per-seg aliveness: any positive depth within a length limit is
-        # evaluated lazily per output read (lengths differ per pairing)
+        # per-seg aliveness: any positive depth within a length limit.
+        # One vector pass finds each seg's first positive-depth column;
+        # the per-output-read check (lengths differ per pairing) is then a
+        # scalar compare instead of a numpy any() per molecule
+        pos_depth = d16 > 0
+        has_depth = pos_depth.any(axis=1)
+        first_nz = np.where(has_depth, np.argmax(pos_depth, axis=1), 1 << 30)
+
         def seg_alive(s, limit):
-            return bool((d16[s, :limit] > 0).any())
+            return first_nz[s] < limit
 
         # build output reads in molecule order: 2 per emitted molecule
         out_specs = []   # (mol, flags, aseg, bseg, kind) kind: 2=combined,
@@ -712,9 +718,9 @@ class FastDuplexCaller:
         rx_vo, rx_vl, _ = batch.tag_locs_str(b"RX")
         buf = batch.buf
         K = len(out_specs)
-        rx_addr = np.zeros(K, dtype=np.int64)
+        rx_off_in_blob = np.zeros(K, dtype=np.int64)
         rx_len = np.zeros(K, dtype=np.int32)
-        keep_alive = []
+        blob = bytearray()  # one allocation for all values, not one per emit
 
         span_v = span[vrows]
         una_off, una_len = nb.rx_unanimous(buf, rx_vo[span_v], rx_vl[span_v],
@@ -734,9 +740,8 @@ class FastDuplexCaller:
             return vals
 
         def emit(k, rx):
-            arr = np.frombuffer(rx.encode(), dtype=np.uint8)
-            keep_alive.append(arr)
-            rx_addr[k] = arr.ctypes.data
+            rx_off_in_blob[k] = len(blob)
+            blob.extend(rx.encode())
             rx_len[k] = len(rx)
 
         fams = []
@@ -796,4 +801,7 @@ class FastDuplexCaller:
             fam_ks.append(k)
         for k, rx in zip(fam_ks, consensus_umis_batch(fams)):
             emit(k, rx)
-        return rx_addr, rx_len, keep_alive
+        blob_arr = np.frombuffer(bytes(blob) or b"\x00", dtype=np.uint8)
+        rx_addr = np.where(rx_len > 0,
+                           blob_arr.ctypes.data + rx_off_in_blob, 0)
+        return rx_addr, rx_len, [blob_arr]

@@ -322,6 +322,18 @@ def _zip_pair_bams(tmp_path, seed, n_templates=300):
             elif r < 0.12:  # both unmapped but aligner emitted them
                 f1 |= 0x4
                 f2 |= 0x4
+            elif r < 0.17:
+                # exact unclipped-5' tie: R1 forward at p (5' = p+1), R2
+                # reverse ending at p+1 — TLEN sign must split +1/-1 from
+                # the FIRST read's perspective (classic _insert_size)
+                p = rng.randrange(100, 50000)
+                mw.write_record_bytes(
+                    mapped_rec(0x1 | 0x40, tid=0, pos=p,
+                               cig=[("M", 32)]).finish())
+                mw.write_record_bytes(
+                    mapped_rec(0x1 | 0x80 | 0x10, tid=0, pos=p - 31,
+                               cig=[("M", 32)]).finish())
+                continue
             mw.write_record_bytes(mapped_rec(f1).finish())
             if rng.random() < 0.12:  # secondary of R1
                 mw.write_record_bytes(mapped_rec(f1 | 0x100).finish())

@@ -141,6 +141,7 @@ class DeviceTrier:
         self.duplex = None
         self.mixed = None
         self._simplex_tries = 0
+        self._duplex_tries = 0
         self.diagnostics = []
 
     def _remaining(self):
@@ -192,15 +193,21 @@ class DeviceTrier:
                 self.simplex = res
             elif res is None:
                 self.diagnostics.append(f"simplex device: {err}")
-        if (self.duplex is None and dup_bam is not None
-                and self._remaining() > 120):
+        want_duplex = dup_bam is not None and (
+            self.duplex is None
+            or (self.kernel is not None and self.mixed is not None
+                and self.simplex is not None and self._duplex_tries < 3
+                and self._remaining() > 300))
+        if want_duplex and self._remaining() > 120:
             res, err = run_worker(
                 dup_bam, threads, {},
                 min(self.run_timeout, max(self._remaining(), 60)),
                 cmd="duplex")
-            if res is not None:
+            self._duplex_tries += 1
+            if res is not None and (self.duplex is None
+                                    or res["wall_s"] < self.duplex["wall_s"]):
                 self.duplex = res
-            else:
+            elif res is None:
                 self.diagnostics.append(f"duplex device: {err}")
         if (self.mixed is None and mixed_bam is not None
                 and self._remaining() > 120):

@@ -304,3 +304,56 @@ def test_fast_simplex_hybrid_cli_bytes(tmp_path):
     assert outs["host"] == outs["device"]
     assert outs["host"] == outs["mixed"]
     assert outs["host"] == outs["wholebatch"]
+
+
+def test_feeder_error_propagates_cleanly(tmp_path):
+    """A device dispatch failure inside the feeder thread must surface as a
+    command error (no hang, no leaked in-flight count silently disabling
+    the device for later batches)."""
+    import subprocess
+    import sys
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sim = tmp_path / "g.bam"
+    subprocess.run(
+        [sys.executable, "-m", "fgumi_tpu", "simulate", "grouped-reads",
+         "-o", str(sim), "--num-families", "200", "--read-length", "50",
+         "--error-rate", "0.2", "--seed", "3"],
+        check=True, cwd=REPO, env={**os.environ, "PYTHONPATH": REPO})
+    code = r"""
+import sys
+sys.path.insert(0, %(repo)r)
+import numpy as np
+from fgumi_tpu.ops import kernel as K
+
+def boom(*a, **kw):
+    raise RuntimeError("injected device failure")
+
+K._consensus_columns_wire_jit = boom
+K._consensus_columns_raw_jit = boom
+from fgumi_tpu.cli import main
+try:
+    rc = main(["simplex", "-i", %(sim)r, "-o", %(out)r, "--min-reads", "1",
+               "--allow-unmapped", "--threads", "4"])
+    print("RC", rc)
+except RuntimeError as e:
+    print("RAISED", e)
+# the in-flight accounting must be balanced no matter how the command died
+assert K.DEVICE_STATS.in_flight_count() == 0, "in-flight leak"
+print("INFLIGHT-OK")
+""" % {"repo": REPO, "sim": str(sim), "out": str(tmp_path / "o.bam")}
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=300,
+        env={**os.environ, "PYTHONPATH": REPO,
+             "FGUMI_TPU_HOST_ENGINE": "0", "JAX_PLATFORMS": "cpu",
+             # conftest exports an 8-device XLA_FLAGS: without clearing it
+             # the CLI auto-meshes and takes the sharded (unpatched) path
+             "XLA_FLAGS": "",
+             "PALLAS_AXON_POOL_IPS": ""})
+    out = proc.stdout + proc.stderr
+    assert "INFLIGHT-OK" in out, out
+    assert "in-flight leak" not in out, out
+    # the failure must have been VISIBLE (raised or nonzero rc), not
+    # silently swallowed into a success
+    assert "RAISED" in out or "RC 0" not in out, out

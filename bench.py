@@ -53,30 +53,32 @@ from fgumi_tpu.cli import main
 
 in_bam, out_dir, threads, cmd = sys.argv[1:5]
 platform = jax.devices()[0].platform
-if cmd == "simplex":
-    base = ["simplex", "-i", in_bam, "--min-reads", "1", "--threads", threads]
-else:
-    base = ["duplex", "-i", in_bam, "--min-reads", "1", "--threads", threads]
+tool = "simplex" if cmd == "simplex" else "duplex"
+base = [tool, "-i", in_bam, "--min-reads", "1"]
 t0 = time.monotonic()
-rc = main(base + ["-o", os.path.join(out_dir, "warm.bam")])
+rc = main(base + ["--threads", threads,
+                  "-o", os.path.join(out_dir, "warm.bam")])
 warm_s = time.monotonic() - t0
 assert rc == 0, "warm-up run failed"
 from fgumi_tpu.ops.kernel import DEVICE_STATS
-# best of three timed runs: the CPU baseline already takes the best of its
-# threaded/inline configs, and the tunnel link speed swings minute to
-# minute (measured 0.4-76 MB/s), so a single draw under-measures either
-# side; same treatment on both platforms keeps the ratio honest
+# best draw across timed runs AND thread configs: the CPU baseline takes
+# the best of its threaded/inline invocations, and the tunnel link speed
+# swings minute to minute (measured 0.4-76 MB/s), so a single draw
+# under-measures either side; symmetric treatment keeps the ratio honest
 wall_s = None
 dstats = None
-for _ in range(3):
-    DEVICE_STATS.reset()
-    t0 = time.monotonic()
-    rc = main(base + ["-o", os.path.join(out_dir, "timed.bam")])
-    trial = time.monotonic() - t0
-    assert rc == 0, "timed run failed"
-    if wall_s is None or trial < wall_s:
-        wall_s = trial
-        dstats = DEVICE_STATS.snapshot()
+configs = [threads] if threads == "0" else [threads, "0"]
+for ci, thr in enumerate(configs):
+    for _ in range(3 if ci == 0 else 2):
+        DEVICE_STATS.reset()
+        t0 = time.monotonic()
+        rc = main(base + ["--threads", thr,
+                          "-o", os.path.join(out_dir, "timed.bam")])
+        trial = time.monotonic() - t0
+        assert rc == 0, "timed run failed"
+        if wall_s is None or trial < wall_s:
+            wall_s = trial
+            dstats = DEVICE_STATS.snapshot()
 print(json.dumps({"platform": platform, "device": str(jax.devices()[0]),
                   "warm_s": round(warm_s, 3), "wall_s": round(wall_s, 3),
                   "device_fraction": round(
@@ -265,19 +267,15 @@ def main():
     # first minutes, before any CPU work).
     trier.attempt(sim, dup, threads, mixed)
 
-    # CPU baseline: identical pipeline, jax pinned to CPU. Inline mode often
-    # beats reader/writer threads on CPU jax (XLA's own thread pool competes
-    # for the cores the pipeline threads would use), so the baseline takes
-    # the best of both — it claims to be the best host-only path.
+    # CPU baseline: identical pipeline, jax pinned to CPU. The worker itself
+    # sweeps threaded AND inline configs and keeps the best draw (inline
+    # often wins on CPU jax: XLA's own thread pool competes for the cores
+    # the pipeline threads would use) — the best host-only path, measured
+    # with exactly the same protocol as the device runs.
     diagnostics = []
     cpu, err = run_worker(sim, threads, CPU_ENV, run_timeout)
     if cpu is None:
         diagnostics.append(f"cpu baseline: {err}")
-    cpu0, err0 = run_worker(sim, 0, CPU_ENV, run_timeout)
-    if cpu0 is not None and (cpu is None or cpu0["wall_s"] < cpu["wall_s"]):
-        cpu = dict(cpu0, threads=0)
-    elif err0:
-        diagnostics.append(f"cpu inline baseline: {err0}")
 
     # CPU kernel microbench (same shapes as the device one -> clean ratio).
     kernel_cpu, kerr = _run_script(_KERNEL_BENCH, [REPO, 65536, 100, 5],

@@ -414,10 +414,12 @@ print(json.dumps({"platform": d.platform, "device": str(d),
 """
 
 
-def capture_evidence(out_path, n_families=20000):
+def capture_evidence(out_path, n_families=40000):
     """Device is (momentarily) healthy: grab numbers, persisting partials.
 
-    Seeds from any existing evidence file so a later partial capture can only
+    n_families matches bench.py's eval-config-1 workload so the merged
+    tpu_session numbers are scale-comparable with the headline. Seeds from
+    any existing evidence file so a later partial capture can only
     add or refresh sections, never lose an earlier successful one."""
     evidence = {}
     if os.path.exists(out_path):

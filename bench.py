@@ -553,14 +553,24 @@ print(json.dumps(out))
                         / kernel_cpu["kernel_reads_per_sec"], 3)
             if tpu is None and fresh("simplex"):
                 # distinct keys, NOT the headline value/vs_baseline: the
-                # session run used its own (smaller) workload and thread
-                # count, so the ratio is indicative, not the metric
+                # session run used its own workload and thread count, so
+                # the ratio is indicative, not the metric
                 ev = evidence["simplex"]
                 result["tpu_session_reads_per_sec"] = ev.get("reads_per_sec")
                 result["tpu_session_platform"] = ev.get("platform")
+                ev_n = ev.get("n_reads", 0)
                 if cpu is not None and ev.get("reads_per_sec"):
-                    result["tpu_session_vs_baseline"] = round(
-                        ev["reads_per_sec"] / (n_reads / cpu["wall_s"]), 3)
+                    if abs(ev_n - n_reads) <= 0.2 * n_reads:
+                        result["tpu_session_vs_baseline"] = round(
+                            ev["reads_per_sec"] / (n_reads / cpu["wall_s"]),
+                            3)
+                    else:
+                        # reads/sec on a much smaller input under-measures
+                        # (fixed per-run costs) — a cross-size ratio would
+                        # be noise presented as signal
+                        result["tpu_session_note"] = (
+                            f"session workload {ev_n} reads vs bench "
+                            f"{n_reads}: sizes differ, ratio omitted")
 
     # Session probe history (every probe the background loop ran): failing-
     # stage distribution is the wedge diagnosis a human can act on. Entries

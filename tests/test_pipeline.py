@@ -196,3 +196,89 @@ def test_pool_resolve_thread_safety_counter():
                   threads=8, queue_items=2, resolve_fn=resolve)
     assert sorted(seen) == list(range(200))
     assert out == list(range(200))
+
+
+def test_inline_double_buffer_defers_resolve(monkeypatch):
+    """Inline mode with a resolve stage holds one output in flight: the
+    resolve of output N runs only after item N+1 has been processed
+    (dispatch/fetch overlap on the device path), and outputs stay FIFO."""
+    import fgumi_tpu.pipeline as pl
+
+    monkeypatch.delenv("FGUMI_TPU_INLINE_FLIGHT", raising=False)
+    events = []
+    out = []
+
+    def process(x):
+        events.append(("process", x))
+        return [x]
+
+    def resolve(y):
+        events.append(("resolve", y))
+        return y
+
+    pl.run_stages(iter(range(4)), process, out.append,
+                  threads=0, resolve_fn=resolve)
+    assert out == list(range(4))
+    # depth 2: process(1) precedes resolve(0), etc.; the tail flushes in order
+    assert events == [
+        ("process", 0), ("process", 1), ("resolve", 0),
+        ("process", 2), ("resolve", 1), ("process", 3), ("resolve", 2),
+        ("resolve", 3)]
+
+
+def test_inline_flight_depth_one_is_serial(monkeypatch):
+    """FGUMI_TPU_INLINE_FLIGHT=1 restores the strictly serial inline order
+    (the A/B lever used to measure the overlap win)."""
+    import fgumi_tpu.pipeline as pl
+
+    monkeypatch.setenv("FGUMI_TPU_INLINE_FLIGHT", "1")
+    events = []
+    pl.run_stages(iter(range(3)), lambda x: [(events.append(("p", x)), x)[1]],
+                  lambda y: None, threads=0,
+                  resolve_fn=lambda y: (events.append(("r", y)), y)[1])
+    assert events == [("p", 0), ("r", 0), ("p", 1), ("r", 1),
+                      ("p", 2), ("r", 2)]
+
+
+def test_inline_double_buffer_drains_on_error(monkeypatch):
+    """A process error must not lose the output already in flight: the
+    serial path wrote output N before touching item N+1, so the deferred
+    path drains its pend before propagating."""
+    import pytest as _pytest
+
+    import fgumi_tpu.pipeline as pl
+
+    monkeypatch.delenv("FGUMI_TPU_INLINE_FLIGHT", raising=False)
+    out = []
+
+    def process(x):
+        if x == 2:
+            raise RuntimeError("batch 2 corrupt")
+        return [x]
+
+    with _pytest.raises(RuntimeError, match="batch 2 corrupt"):
+        pl.run_stages(iter(range(4)), process, out.append,
+                      threads=0, resolve_fn=lambda y: y)
+    assert out == [0, 1]
+
+
+def test_inline_double_buffer_no_drain_on_resolve_error(monkeypatch):
+    """When the resolve/sink half itself fails, in-flight outputs are
+    DROPPED (like the threaded error path) — draining would write outputs
+    past the failed one and produce a holed file."""
+    import pytest as _pytest
+
+    import fgumi_tpu.pipeline as pl
+
+    monkeypatch.delenv("FGUMI_TPU_INLINE_FLIGHT", raising=False)
+    out = []
+
+    def resolve(y):
+        if y == 1:
+            raise RuntimeError("chunk 1 resolve failed")
+        return y
+
+    with _pytest.raises(RuntimeError, match="chunk 1 resolve failed"):
+        pl.run_stages(iter(range(4)), lambda x: [x], out.append,
+                      threads=0, resolve_fn=resolve)
+    assert out == [0]  # nothing written past the failed chunk

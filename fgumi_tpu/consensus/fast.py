@@ -270,8 +270,8 @@ class _PendingChunk:
         self.blocks.append((np.asarray(idxs, dtype=np.int64),
                             np.ascontiguousarray(bases_b),
                             np.ascontiguousarray(quals_b),
-                            np.ascontiguousarray(depth.astype(np.int32)),
-                            np.ascontiguousarray(errors.astype(np.int32))))
+                            np.ascontiguousarray(depth, dtype=np.int32),
+                            np.ascontiguousarray(errors, dtype=np.int32)))
 
 
 class FastSimplexCaller:

@@ -397,7 +397,19 @@ class DeviceFeeder:
                     self._cv.wait()
                 fn, ticket = self._q.pop(0)
             try:
-                ticket._set(result=fn())
+                result = fn()
+                # start the device->host copy NOW (non-blocking): by the
+                # time the resolve stage calls device_get, the result bytes
+                # are already on host (or in flight), so the fetch costs a
+                # wait-for-arrival instead of a full round trip. Backends
+                # without copy_to_host_async just fetch at resolve time.
+                try:
+                    for leaf in jax.tree_util.tree_leaves(result):
+                        if hasattr(leaf, "copy_to_host_async"):
+                            leaf.copy_to_host_async()
+                except Exception:  # noqa: BLE001 - fetch-time path still works
+                    pass
+                ticket._set(result=result)
             except BaseException as e:  # noqa: BLE001 - relayed to waiter
                 ticket._set(exc=e)
 
@@ -1193,8 +1205,7 @@ class ConsensusKernel:
                 codes2d, quals2d, starts, delta64, host.g_sat,
                 host.qual_const, MIN_PHRED, host._tab1[0], host._tab1[1],
                 host._tab2[0], host._tab2[1])
-        easy = (winner, qual, depth.astype(np.int64),
-                errors.astype(np.int64))
+        easy = (winner, qual, depth, errors)  # int32 end to end
         C = len(hard_idx)
         if C == 0:
             with self._counter_lock:
@@ -1286,34 +1297,44 @@ class ConsensusKernel:
                                      wf, qf, df, ef)
         return winner, qual, depth, errors
 
+    @staticmethod
+    def _concat_aranges(counts):
+        """Concatenated arange(0, c_i) for each count, no Python loop."""
+        counts = np.asarray(counts, dtype=np.int64)
+        total = int(counts.sum())
+        offs = np.repeat(np.concatenate(([0], np.cumsum(counts)[:-1])),
+                         counts)
+        return np.arange(total, dtype=np.int64) - offs
+
     def _patch_hard_columns(self, suspect, hard_idx, hard_depth, hc, hq,
                             wf, qf, df, ef):
         """Exact f64 recompute of suspect hard columns from the exported
-        observation stream (the column-major analog of _oracle_patch,
-        bucketed by pow2 depth class so one deep column cannot inflate
-        every other column's pad rows)."""
-        from . import oracle
+        observation stream.
 
+        Each suspect column becomes one length-1 segment of the native f64
+        host engine (its observations are a run of depth-R "reads" of
+        length 1, in the original read order, so the Kahan accumulation
+        order matches the oracle exactly — the engine's bit-exactness
+        contract covers this shape like any other). The engine resolves
+        them in one native pass + one vectorized oracle epilogue for its
+        own borderline positions, replacing a per-read Python loop that
+        dominated the patch cost. The native library is guaranteed here:
+        every pending came from dispatch_hard_columns, whose classify pass
+        already required it."""
         obs_starts = np.concatenate(([0], np.cumsum(hard_depth)))
         sus = np.nonzero(suspect)[0]
-        buckets = {}
-        for s in sus:
-            cls = max(int(hard_depth[s]) - 1, 0).bit_length()
-            buckets.setdefault(cls, []).append(int(s))
-        for cols in buckets.values():
-            r_max = max(int(hard_depth[s]) for s in cols)
-            col_codes = np.full((r_max, len(cols)), N_CODE, dtype=np.uint8)
-            col_quals = np.zeros((r_max, len(cols)), dtype=np.uint8)
-            for k, s in enumerate(cols):
-                lo, hi = obs_starts[s], obs_starts[s + 1]
-                col_codes[:hi - lo, k] = hc[lo:hi]
-                col_quals[:hi - lo, k] = hq[lo:hi]
-            w, q, d, e = oracle.call_family(col_codes, col_quals, self.tables)
-            flat = hard_idx[cols]
-            wf[flat] = w
-            qf[flat] = q
-            df[flat] = d
-            ef[flat] = e
+        lo = obs_starts[sus]
+        counts = obs_starts[sus + 1] - lo
+        total = int(counts.sum())
+        rows = np.repeat(lo, counts) + self._concat_aranges(counts)
+        starts = np.concatenate(([0], np.cumsum(counts)))
+        w, q, d, e = self._host().call_segments(
+            hc[rows].reshape(total, 1), hq[rows].reshape(total, 1), starts)
+        flat = hard_idx[sus]
+        wf[flat] = w.ravel()
+        qf[flat] = q.ravel()
+        df[flat] = d.ravel()
+        ef[flat] = e.ravel()
 
     def device_call_segments_sharded(self, codes3d, quals3d, seg_ids2d,
                                      num_segments: int, mesh):

@@ -1152,12 +1152,13 @@ class ConsensusKernel:
         from ..native import batch as nb
 
         if nb.available():
-            d32, e32 = nb.segment_depth_errors(codes2d, winner, starts)
-            depth = d32.astype(np.int64)
-            errors = e32.astype(np.int64)
+            # int32 end to end (host_kernel.call_segments_counted keeps the
+            # same dtype): every consumer is dtype-agnostic, so the old
+            # whole-(J,L) int64 casts were pure memory traffic
+            depth, errors = nb.segment_depth_errors(codes2d, winner, starts)
         else:
             valid = (codes2d != N_CODE).astype(np.int32)
-            depth = np.add.reduceat(valid, starts[:-1], axis=0).astype(np.int64)
+            depth = np.add.reduceat(valid, starts[:-1], axis=0)
             counts = np.diff(starts)
             winner_rows = np.repeat(winner, counts, axis=0)
             match = ((codes2d == winner_rows)
@@ -1382,7 +1383,7 @@ class ConsensusKernel:
             L = packed.shape[-1]
             z = np.zeros((0, L))
             return (z.astype(np.uint8), z.astype(np.uint8),
-                    z.astype(np.int64), z.astype(np.int64))
+                    z.astype(np.int32), z.astype(np.int32))
         winner, qual, suspect = _unpack_device_result(packed)
         winner = winner[:J]
         qual = qual[:J]
@@ -1393,12 +1394,13 @@ class ConsensusKernel:
         from ..native import batch as nb
 
         if nb.available():
-            d32, e32 = nb.segment_depth_errors(codes2d, winner, starts)
-            depth = d32.astype(np.int64)
-            errors = e32.astype(np.int64)
+            # int32 end to end (host_kernel.call_segments_counted keeps the
+            # same dtype): every consumer is dtype-agnostic, so the old
+            # whole-(J,L) int64 casts were pure memory traffic
+            depth, errors = nb.segment_depth_errors(codes2d, winner, starts)
         else:
             valid = (codes2d != N_CODE).astype(np.int32)
-            depth = np.add.reduceat(valid, starts[:-1], axis=0).astype(np.int64)
+            depth = np.add.reduceat(valid, starts[:-1], axis=0)
             counts = np.diff(starts)
             winner_rows = np.repeat(winner, counts, axis=0)
             match = ((codes2d == winner_rows)

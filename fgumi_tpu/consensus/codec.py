@@ -128,9 +128,11 @@ def combine_arrays(bases_a, bases_b, quals_a, quals_b, da, db, ea, eb):
     per-molecule `_combine` and the batch engine's concatenated pass
     (fast_codec.py `_finish_batch`) so the rules live in one place.
 
-    Inputs are ASCII-base uint8 / qual uint8 / int64 depth+error arrays of
-    equal length; returns (base u8, qual u8, depth, errors, both, disag)
-    with the either-strand N mask and the I16 caps applied.
+    Inputs are ASCII-base uint8 / qual uint8 / integer depth+error arrays of
+    equal length (int64 on the classic path; the batch engine passes int32
+    with values pre-capped at I16_MAX — sums here stay ~2x I16_MAX, so any
+    int dtype >= int32 is safe); returns (base u8, qual u8, depth, errors,
+    both, disag) with the either-strand N mask and the I16 caps applied.
     """
     ba, bb = bases_a.astype(np.int32), bases_b.astype(np.int32)
     qa, qb = quals_a.astype(np.int32), quals_b.astype(np.int32)

@@ -252,10 +252,12 @@ class FastCodecCaller:
         b2 = np.full(T, NO_CALL_BASE_LOWER, np.uint8)
         q1 = np.zeros(T, np.uint8)
         q2 = np.zeros(T, np.uint8)
-        d1 = np.zeros(T, np.int64)
-        d2 = np.zeros(T, np.int64)
-        e1 = np.zeros(T, np.int64)
-        e2 = np.zeros(T, np.int64)
+        # int32: every value here is pre-capped at I16_MAX, and the combine's
+        # sums stay well under 2^31 — int64 was pure memory traffic
+        d1 = np.zeros(T, np.int32)
+        d2 = np.zeros(T, np.int32)
+        e1 = np.zeros(T, np.int32)
+        e2 = np.zeros(T, np.int32)
 
         def place_arr(bases_c, quals, dep, err, rc, pad_left, o, L,
                       b, q, d, e):
@@ -400,7 +402,9 @@ class FastCodecCaller:
                 out.append(struct.pack("<I", len(rec)) + rec)
             return out
 
-        return self._serialize_native(keep, good, offs, Ls, cb, cq, ce,
+        return self._serialize_native(keep, good, offs, Ls, cb, cq,
+                                      np.ascontiguousarray(ce,
+                                                           dtype=np.int64),
                                       b1, q1, d1, e1, b2, q2, d2, e2)
 
     def _serialize_native(self, keep, good, offs, Ls, cb, cq, ce,
@@ -425,8 +429,11 @@ class FastCodecCaller:
                        np.repeat(offs[:-1] + Ls - 1, Ls) - pos,
                        np.arange(T, dtype=np.int64))
 
-        def gath(a, comp=False):
-            g = np.ascontiguousarray(a[src])
+        def gath(a, comp=False, dtype=None):
+            # dtype=int64 where the native builder reads 8-byte elements
+            # (the combine math upstream runs in int32; widening costs a
+            # second copy of the gathered temp, cheap next to the combine)
+            g = np.ascontiguousarray(a[src], dtype=dtype)
             if comp:
                 g[rc_rep] = _ASCII_COMPLEMENT[g[rc_rep]]
             return g
@@ -435,12 +442,12 @@ class FastCodecCaller:
         qual = gath(cq)
         a_b = gath(b1, comp=True)
         a_q = gath(q1)
-        a_d = gath(d1)
-        a_e = gath(e1)
+        a_d = gath(d1, dtype=np.int64)
+        a_e = gath(e1, dtype=np.int64)
         b_b = gath(b2, comp=True)
         b_q = gath(q2)
-        b_d = gath(d2)
-        b_e = gath(e2)
+        b_d = gath(d2, dtype=np.int64)
+        b_e = gath(e2, dtype=np.int64)
 
         # RX consensus per molecule, all non-trivial families in one pass
         fams = []

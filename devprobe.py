@@ -444,10 +444,19 @@ def capture_evidence(out_path, n_families=40000):
     def stamp():
         # captured_unix marks the newest SUCCESSFUL section, so a later
         # failed attempt cannot relabel old evidence as fresh (bench.py
-        # gates on this timestamp)
+        # gates on this timestamp). git_head records which code produced
+        # the numbers — an early-session capture can lag later perf work.
         evidence["captured_unix"] = int(time.time())
         evidence["captured_iso"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                                  time.gmtime())
+        try:
+            import subprocess
+            evidence["git_head"] = subprocess.run(
+                ["git", "-C", REPO, "rev-parse", "--short", "HEAD"],
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip() or None
+        except Exception:
+            pass
 
     def flush():
         with open(out_path + ".tmp", "w") as f:

@@ -451,10 +451,16 @@ def capture_evidence(out_path, n_families=40000):
                                                  time.gmtime())
         try:
             import subprocess
-            evidence["git_head"] = subprocess.run(
+            head = subprocess.run(
                 ["git", "-C", REPO, "rev-parse", "--short", "HEAD"],
                 capture_output=True, text=True, timeout=10,
-            ).stdout.strip() or None
+            ).stdout.strip()
+            dirty = subprocess.run(
+                ["git", "-C", REPO, "status", "--porcelain"],
+                capture_output=True, text=True, timeout=10,
+            ).stdout.strip()
+            evidence["git_head"] = (head + ("-dirty" if dirty else "")) \
+                if head else None
         except Exception:
             pass
 

@@ -35,6 +35,20 @@ def _flip_umi(value: str) -> str:
     return "-".join(reversed(value.split("-")))
 
 
+class _DuplexPending:
+    """Deferred half of a duplex batch: the SS device fetch + stage-2
+    combine + serialization run at resolve time (pipeline.resolve_chunk),
+    after the NEXT batch's dispatch is in flight."""
+
+    __slots__ = ("_fn",)
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def resolve(self) -> bytes:
+        return self._fn()
+
+
 class FastDuplexCaller:
     """Batch-vectorized duplex caller wrapping a DuplexConsensusCaller.
 
@@ -55,6 +69,13 @@ class FastDuplexCaller:
         self.overlap_caller = overlap_caller
         self.mesh = mesh if mesh is not None and mesh.size > 1 else None
         self._carry = None  # (base_mi, [RawRecord] a, [RawRecord] b)
+        # With threads<=1 the CLI sets this True: the SS device round trip is
+        # then deferred into a pending chunk resolved AFTER the next batch's
+        # dispatch (pipeline.run_stages double buffering), hiding the fetch
+        # behind host prep. Ordinals are pre-reserved at process time so MI
+        # numbering is identical either way. Must stay False when resolve_fn
+        # runs on another thread: stage-2 mutates shared stats/ordinals.
+        self.defer_device = False
 
     # ------------------------------------------------------------------ driver
 
@@ -320,20 +341,37 @@ class FastDuplexCaller:
             lm = live_mol[seg_g]
             seg_map[seg_g[lm], seg_t[lm]] = np.nonzero(lm)[0]
 
-        # SS consensus for every seg: one kernel dispatch for multi-read
-        # segs, one vectorized host pass for single-read segs
-        L_max = stride
-        tb, tq, d16, e16, codes2d = self._ss_consensus(codes, quals, vrows,
-                                                       c1, vstarts, nseg,
-                                                       L_max)
+        # reserve this span's ordinal range NOW (stream order), so deferred
+        # stage-2 resolution cannot shift the classic fallback numbering —
+        # the simplex engine's _group_ordinal discipline (fast.py:499)
+        ord0 = caller._ordinal
+        caller._ordinal = ord0 + nG
+
         seg_len = np.zeros(nseg, dtype=np.int64)
         if nseg:
             fl = final_len[vrows]
             np.maximum.at(seg_len, seg_of_row, fl)
 
+        # SS consensus for every seg: one kernel dispatch for multi-read
+        # segs, one vectorized host pass for single-read segs
+        L_max = stride
+        ss_res = self._ss_consensus(codes, quals, vrows, c1, vstarts, nseg,
+                                    L_max, defer=self.defer_device)
+        if len(ss_res) == 2 and ss_res[0] == "defer":
+            finish_ss = ss_res[1]
+
+            def _finish():
+                tb, tq, d16, e16, codes2d = finish_ss()
+                return b"".join(self._stage2(
+                    batch, span, gb, sizes, n_paired, fallback, sb,
+                    live_mol, seg_map, seg_len, tb, tq, d16, e16,
+                    codes2d, vrows, vstarts, L_max, ord0))
+
+            return [_DuplexPending(_finish)]
+        tb, tq, d16, e16, codes2d = ss_res
         return self._stage2(batch, span, gb, sizes, n_paired, fallback, sb,
                             live_mol, seg_map, seg_len, tb, tq, d16, e16,
-                            codes2d, vrows, vstarts, L_max)
+                            codes2d, vrows, vstarts, L_max, ord0)
 
     def _need_filter_fallback(self, batch, span, vrows, g_of_row, t, fallback,
                               nG):
@@ -385,9 +423,15 @@ class FastDuplexCaller:
                 need[s] = True
         fallback[set_g[need]] = True
 
-    def _ss_consensus(self, codes, quals, vrows, c1, vstarts, nseg, L_max):
+    def _ss_consensus(self, codes, quals, vrows, c1, vstarts, nseg, L_max,
+                      defer=False):
         """All segs' single-strand consensus: thresholded bases/quals and
-        i16-clamped depth/error arrays, (nseg, L_max) each."""
+        i16-clamped depth/error arrays, (nseg, L_max) each.
+
+        defer=True + the hybrid device path: returns ("defer", finish)
+        right after the dispatch; finish() -> the 5-tuple. Every other
+        path stays synchronous (host compute has nothing to overlap; the
+        sharded path fetches per shard)."""
         opts = self.ss.options
         tb = np.zeros((nseg, L_max), dtype=np.uint8)
         tq = np.zeros((nseg, L_max), dtype=np.uint8)
@@ -426,10 +470,24 @@ class FastDuplexCaller:
                 w, q_, d, e = self.kernel.resolve_segments(dev, cm, qm,
                                                            starts_m)
             else:
-                # device: classify + compact hard-column dispatch — the
-                # synchronous round trip shrinks to the hard few percent of
-                # observations (ops/kernel.py dispatch_hard_columns)
+                # device: classify + compact hard-column dispatch — only
+                # the hard few percent of observations cross the link
+                # (ops/kernel.py dispatch_hard_columns)
                 pending = self.kernel.dispatch_hard_columns(cm, qm, starts_m)
+                if defer:
+                    def finish():
+                        w, q_, d, e = self.kernel.resolve_hard_columns(
+                            pending)
+                        b_m, q_m = oracle.apply_consensus_thresholds(
+                            w, q_, d, opts.min_reads,
+                            opts.min_consensus_base_quality)
+                        tb[multi] = b_m
+                        tq[multi] = q_m
+                        d16[multi] = np.minimum(d, I16_MAX).astype(np.int32)
+                        e16[multi] = np.minimum(e, I16_MAX).astype(np.int32)
+                        return tb, tq, d16, e16, codes2d
+
+                    return ("defer", finish)
                 w, q_, d, e = self.kernel.resolve_hard_columns(pending)
             b_m, q_m = oracle.apply_consensus_thresholds(
                 w, q_, d, opts.min_reads, opts.min_consensus_base_quality)
@@ -477,8 +535,13 @@ class FastDuplexCaller:
 
     def _stage2(self, batch, span, gb, sizes, n_paired, fallback, sb,
                 live_mol, seg_map, seg_len, tb, tq, d16, e16, codes2d,
-                vrows, vstarts, L_max):
-        """Strand combination + serialization, molecule order preserved."""
+                vrows, vstarts, L_max, ord0):
+        """Strand combination + serialization, molecule order preserved.
+
+        ord0: the first ordinal of this span's pre-reserved range (set in
+        _process_molecules before any deferral) — the global counter may
+        already be past ord0 + nG when resolution is deferred, so it is
+        save/restored around the classic fallback calls, never rewound."""
         caller = self.caller
         stats = caller.stats
         nG = len(sizes)
@@ -588,13 +651,12 @@ class FastDuplexCaller:
             stats.consensus_reads += K
 
         # assemble in molecule order, interleaving fallback molecules
-        ord0 = caller._ordinal
         fb_set = set(np.nonzero(fallback)[0].tolist())
         if not fb_set:
-            caller._ordinal = ord0 + nG
             return [fast_blob] if fast_blob else []
         out_i = 0
         pending_fast_start = 0
+        saved_ordinal = caller._ordinal
         for g in sorted(fb_set):
             # flush the fast run before this molecule
             while out_i < len(out_specs) and out_specs[out_i][0] < g:
@@ -610,7 +672,7 @@ class FastDuplexCaller:
             caller._ordinal = ord0 + g
             chunks.extend(self._call_slow_molecule(
                 self._base_mi(batch, int(rows[0])), a, b, corrected=True))
-        caller._ordinal = ord0 + nG
+        caller._ordinal = saved_ordinal
         if len(fast_blob) > pending_fast_start:
             chunks.append(fast_blob[pending_fast_start:])
         return chunks

@@ -357,3 +357,69 @@ print("INFLIGHT-OK")
     # the failure must have been VISIBLE (raised or nonzero rc), not
     # silently swallowed into a success
     assert "RAISED" in out or "RC 0" not in out, out
+
+
+def test_duplex_deferred_hybrid_cli_bytes(tmp_path):
+    """Duplex inline (threads 0) defers its SS device round trip into the
+    double-buffer window (fast_duplex._DuplexPending); threaded mode stays
+    synchronous. All hybrid configurations must produce byte-identical
+    output — including the MI/ordinal numbering of classic-fallback
+    molecules, whose range is pre-reserved at process time."""
+    import subprocess
+    import sys
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sim = tmp_path / "dup.bam"
+    subprocess.run(
+        [sys.executable, "-m", "fgumi_tpu", "simulate", "duplex-reads",
+         "-o", str(sim), "--num-molecules", "300", "--reads-per-strand", "3",
+         "--seed", "11"],
+        check=True, cwd=REPO, env={**os.environ, "PYTHONPATH": REPO})
+    outs = {}
+    # pin every knob that could collapse the configs into one path: an
+    # ambient FGUMI_TPU_HYBRID=0 or leftover FGUMI_TPU_INLINE_FLIGHT would
+    # otherwise make all four runs synchronous and the test vacuous
+    base_env = {k: v for k, v in os.environ.items()
+                if k not in ("FGUMI_TPU_HYBRID", "FGUMI_TPU_INLINE_FLIGHT",
+                             "FGUMI_TPU_HOST_ENGINE",
+                             "FGUMI_TPU_MAX_INFLIGHT")}
+    for label, threads, env in (
+            ("inline_deferred", "0", {"FGUMI_TPU_HOST_ENGINE": "0"}),
+            ("inline_serial", "0", {"FGUMI_TPU_HOST_ENGINE": "0",
+                                    "FGUMI_TPU_INLINE_FLIGHT": "1"}),
+            ("threaded_sync", "4", {"FGUMI_TPU_HOST_ENGINE": "0"}),
+            ("host_engine", "0", {"FGUMI_TPU_HOST_ENGINE": "1"})):
+        d = tmp_path / label
+        d.mkdir()
+        subprocess.run(
+            [sys.executable, "-m", "fgumi_tpu", "duplex", "-i", str(sim),
+             "-o", "cons.bam", "--min-reads", "1", "--threads", threads],
+            check=True, cwd=d,
+            env={**base_env, "PYTHONPATH": REPO, **env})
+        outs[label] = (d / "cons.bam").read_bytes()
+    # same write path -> compressed bytes identical
+    assert outs["inline_deferred"] == outs["inline_serial"]
+
+    def records(raw):
+        """Decoded record stream, header stripped (the @PG CL field records
+        the differing --threads value)."""
+        import gzip
+        import io
+        import struct as st
+
+        data = gzip.GzipFile(fileobj=io.BytesIO(raw)).read()
+        assert data[:4] == b"BAM\x01"
+        l_text = st.unpack("<I", data[4:8])[0]
+        o = 8 + l_text
+        n_ref = st.unpack("<I", data[o:o + 4])[0]
+        o += 4
+        for _ in range(n_ref):
+            l_name = st.unpack("<I", data[o:o + 4])[0]
+            o += 4 + l_name + 4
+        return data[o:]
+
+    # threaded mode delivers different chunk sizes to the writer (BGZF
+    # framing differs) and a different @PG CL — the record stream itself
+    # must still be byte-identical
+    assert records(outs["inline_deferred"]) == records(outs["threaded_sync"])
+    assert records(outs["inline_deferred"]) == records(outs["host_engine"])

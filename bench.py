@@ -256,9 +256,7 @@ def main():
     # up front so device attempts can measure it too (VERDICT r4 item 3).
     mixed = os.path.join(tmp, "mixed.bam")
     simulate_grouped_bam(mixed, num_families=max(n_families // 2, 1000),
-                         family_size=4, family_size_distribution="longtail",
-                         read_length=100, read_length_jitter=30,
-                         qual_slope=0.05, error_rate=0.01, seed=43)
+                         **devprobe.MIXED_SIM_KWARGS)
     n_mixed = count_records(mixed)
 
     trier = DeviceTrier(deadline, probe_timeout, run_timeout, t_start)
@@ -533,12 +531,13 @@ print(json.dumps(out))
                                     evidence.get("captured_unix", 0))
                         >= cutoff)
 
-            stale = [s for s in ("kernel_tpu", "simplex", "duplex")
+            stale = [s for s in ("kernel_tpu", "simplex", "duplex",
+                                 "mixed_family")
                      if s in evidence and not fresh(s)]
             if stale:
                 result["tpu_evidence_stale_sections"] = stale
             if not any(fresh(s) for s in ("kernel_tpu", "simplex",
-                                          "duplex")):
+                                          "duplex", "mixed_family")):
                 evidence = None
         if evidence:
             result["tpu_evidence_session"] = evidence
@@ -571,6 +570,22 @@ print(json.dumps(out))
                         result["tpu_session_note"] = (
                             f"session workload {ev_n} reads vs bench "
                             f"{n_reads}: sizes differ, ratio omitted")
+            if want_duplex and trier.duplex is None and fresh("duplex"):
+                ev = evidence["duplex"]
+                result["duplex_session_reads_per_sec"] = \
+                    ev.get("reads_per_sec")
+                # rate ratio, not wall ratio: the workloads may differ by
+                # up to the 20% the guard admits
+                if (d_cpu is not None and ev.get("reads_per_sec")
+                        and n_dup
+                        and abs(ev.get("n_reads", 0) - n_dup)
+                        <= 0.2 * n_dup):
+                    result["duplex_session_vs_baseline"] = round(
+                        ev["reads_per_sec"] / (n_dup / d_cpu["wall_s"]), 3)
+            if trier.mixed is None and fresh("mixed_family"):
+                ev = evidence["mixed_family"]
+                result["mixed_family_session_tpu_reads_per_sec"] = \
+                    ev.get("reads_per_sec")
 
     # Session probe history (every probe the background loop ran): failing-
     # stage distribution is the wedge diagnosis a human can act on. Entries

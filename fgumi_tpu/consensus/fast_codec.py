@@ -542,15 +542,24 @@ class FastCodecCaller:
         np.logical_and.at(grp_ok, g_of_row, row_ok)
 
         # phases 1-2 (primary-pair formation by name + clip closed forms)
-        # run once over the whole eligible span; hash-collision groups fall
-        # back to the per-molecule python pairing
-        pair_of_group, py_groups = self._pair_span(
+        # run once over the whole eligible span, then phases 3-4 (overlap
+        # geometry + verdicts) as one array pass (_geometry_vec);
+        # hash-collision groups fall back to the per-molecule python
+        # pairing, downsampled groups to the per-molecule geometry
+        pair_of_group, py_groups, geom = self._pair_span(
             batch, span, g_of_row, grp_ok, fl, paired_primary)
 
-        # clip/pack pass shared by every eligible molecule of the span:
-        # pairing fills clips, then one native pack covers all kept rows
+        # bulk pack layout of the geometry-ok groups occupies [0, pk_base);
+        # per-molecule fallbacks append after it
+        st = caller.stats
+        nG = g1 - g0
+        loc = np.full(nG, -1, dtype=np.int64)
+        if geom is not None:
+            loc[geom["gid"]] = np.arange(len(geom["gid"]))
+        pk_base = len(geom["pack0"]) if geom is not None else 0
+
         mols = []
-        pack_rows = []     # absolute rows, in job order per molecule
+        pack_rows = []     # per-molecule fallback rows, after the bulk block
         pack_clips = []
         pending = []       # (kind, payload) preserving stream order
         for g in range(g0, g1):
@@ -565,24 +574,63 @@ class FastCodecCaller:
                 continue
             if (g - g0) in py_groups:
                 prep = self._prepare_molecule_vec(batch, rows, mi, pack_rows,
-                                                  pack_clips)
+                                                  pack_clips, pk_base)
             else:
-                prep = self._finish_molecule_vec(
-                    rows, mi, pair_of_group.get(g - g0), pack_rows,
-                    pack_clips)
+                k = int(loc[g - g0])
+                if k < 0:
+                    prep = None  # no surviving FR pair in this group
+                elif geom["small"][k]:
+                    st.reject("InsufficientReads", 2 * int(geom["n_g"][k]))
+                    prep = None
+                elif geom["downs"][k]:
+                    # downsample consumes the shared RNG stream — the
+                    # per-molecule reference path runs, in stream order
+                    prep = self._finish_molecule_vec(
+                        rows, mi, pair_of_group.get(g - g0), pack_rows,
+                        pack_clips, pk_base)
+                elif geom["short"][k]:
+                    st.reject("InsufficientOverlap", 2 * int(geom["n_g"][k]))
+                    prep = None
+                elif geom["indel"][k]:
+                    st.reject("IndelErrorBetweenStrands",
+                              2 * int(geom["n_g"][k]))
+                    prep = None
+                else:
+                    s_, e_ = int(geom["starts"][k]), int(geom["ends"][k])
+                    prep = {
+                        "mi": mi, "rows": rows,
+                        "pk0": int(geom["pk0_seg"][k]),
+                        "r1_rows": geom["r1"][s_:e_],
+                        "r2_rows": geom["r2"][s_:e_],
+                        "r1_flens": geom["flen1"][s_:e_],
+                        "r2_flens": geom["flen2"][s_:e_],
+                        "r1_neg": bool(geom["r1_neg"][k]),
+                        "r2_neg": bool(geom["r2_neg"][k]),
+                        "consensus_length":
+                            int(geom["consensus_length"][k]),
+                    }
             pending.append(("vec", prep) if prep is not None
                            else ("none", None))
 
         codes_pk = quals_pk = None
-        if pack_rows:
-            rows_arr = np.asarray(pack_rows, dtype=np.int64)
+        if pk_base or pack_rows:
+            parts_r = []
+            parts_c = []
+            if pk_base:
+                parts_r.append(geom["pack0"])
+                parts_c.append(geom["clips0"])
+            if pack_rows:
+                parts_r.append(np.asarray(pack_rows, dtype=np.int64))
+                parts_c.append(np.asarray(pack_clips, dtype=np.int64))
+            rows_arr = np.concatenate(parts_r)
+            clips_arr = np.concatenate(parts_c)
             stride = max(-(-int(l_seq[rows_arr].max()) // 32) * 32, 32)
             rev = ((flag[rows_arr] & FLAG_REVERSE) != 0).astype(np.uint8)
             codes_pk, quals_pk, _ = nb.pack_reads(
                 buf, np.ascontiguousarray(batch.seq_off[rows_arr]),
                 np.ascontiguousarray(batch.qual_off[rows_arr]),
                 l_seq[rows_arr], rev,
-                np.asarray(pack_clips, dtype=np.int32), 0, stride, mode=3)
+                clips_arr.astype(np.int32), 0, stride, mode=3)
 
         for item in pending:
             if item[0] == "mol":
@@ -612,7 +660,7 @@ class FastCodecCaller:
         rows = span[elig]
         g_of = g_of_row[elig]
         if len(rows) == 0:
-            return {}, set()
+            return {}, set(), None
 
         paired = (fl_span[elig] & FLAG_PAIRED) != 0
         ppm = pp_span[elig]
@@ -718,15 +766,107 @@ class FastCodecCaller:
         arrs = (r1[po], c1[po], rev1[po], flen1[po], adj1[po],
                 r2[po], c2[po], rev2[po], flen2[po], adj2[po])
         bg = bg[po]
+        geom = self._geometry_vec(arrs, bg)
+        # per-group pair tuples only for the groups that still take the
+        # per-molecule path (downsampling consumes the shared RNG stream);
+        # slicing them for every group was a measurable per-group loop
         out = {}
-        starts = np.nonzero(np.concatenate(([True], bg[1:] != bg[:-1])))[0] \
-            if len(bg) else np.zeros(0, np.int64)
-        ends = np.append(starts[1:], len(bg))
-        for s, e in zip(starts, ends):
-            out[int(bg[s])] = tuple(a[s:e] for a in arrs)
-        return out, py_groups
+        if geom is not None and geom["downs"].any():
+            starts, ends, gid = geom["starts"], geom["ends"], geom["gid"]
+            for k in np.nonzero(geom["downs"])[0]:
+                out[int(gid[k])] = tuple(a[starts[k]:ends[k]] for a in arrs)
+        return out, py_groups, geom
 
-    def _finish_molecule_vec(self, rows, mi, pairs, pack_rows, pack_clips):
+    def _geometry_vec(self, arrs, bg):
+        """Phases 3-4 for EVERY paired group in one array pass: the
+        per-group verdict (ok / too-small / short-overlap / indel /
+        needs-per-molecule-downsample), the overlap geometry of the ok
+        groups, and their bulk pack layout (r1 block then r2 block per
+        group, group order) — semantically identical to running
+        _finish_molecule_vec per group, which remains the reference
+        implementation used by the downsample fallback."""
+        P = len(bg)
+        if P == 0:
+            return None
+        opts = self.caller.options
+        (r1, c1, rev1, flen1, adj1, r2, c2, rev2, flen2, adj2) = arrs
+        starts = np.nonzero(np.concatenate(([True], bg[1:] != bg[:-1])))[0]
+        ends = np.append(starts[1:], P)
+        gid = bg[starts]
+        nseg = len(gid)
+        n_g = ends - starts
+        seg_of_pair = np.repeat(np.arange(nseg), n_g)
+
+        # first-occurrence argmax of each strand's clipped lengths
+        pidx = np.arange(P)
+        m1 = np.maximum.reduceat(flen1, starts)
+        i1 = np.minimum.reduceat(
+            np.where(flen1 == m1[seg_of_pair], pidx, P), starts)
+        m2 = np.maximum.reduceat(flen2, starts)
+        i2 = np.minimum.reduceat(
+            np.where(flen2 == m2[seg_of_pair], pidx, P), starts)
+
+        r1_neg = rev1[i1]
+        r2_neg = rev2[i2]
+        L1f, L1a = flen1[i1], adj1[i1]
+        L2f, L2a = flen2[i2], adj2[i2]
+        Lpf = np.where(r1_neg, L2f, L1f)
+        Lpa = np.where(r1_neg, L2a, L1a)
+        Lnf = np.where(r1_neg, L1f, L2f)
+        Lna = np.where(r1_neg, L1a, L2a)
+        overlap_start = Lna
+        pos_end = Lpa + np.maximum(Lpf - 1, 0)
+        duplex_length = pos_end - overlap_start + 1
+
+        def rp(adj, cl, p):
+            return p - adj + 1, (adj <= p) & (p <= adj + cl - 1)
+
+        r1s, ok1s = rp(L1a, L1f, overlap_start)
+        r2s, ok2s = rp(L2a, L2f, overlap_start)
+        r1e, ok1e = rp(L1a, L1f, pos_end)
+        r2e, ok2e = rp(L2a, L2f, pos_end)
+        pv, okp = rp(Lpa, Lpf, pos_end)
+        nv, okn = rp(Lna, Lnf, pos_end)
+        indel = ~(ok1s & ok2s & ok1e & ok2e) \
+            | ((r1s - r2s) != (r1e - r2e)) | ~okp | ~okn
+        consensus_length = pv + Lnf - nv
+
+        small = n_g < opts.min_reads_per_strand
+        max_pairs = opts.max_reads_per_strand
+        downs = (n_g > max_pairs) & ~small if max_pairs is not None \
+            else np.zeros(nseg, dtype=bool)
+        short = duplex_length < opts.min_duplex_length
+        okg = ~small & ~downs & ~short & ~indel
+
+        # bulk pack layout for ok groups: [r1 block, r2 block] per group
+        n_s = n_g[okg]
+        excl = (np.concatenate(([0], np.cumsum(n_s)[:-1]))
+                if len(n_s) else np.zeros(0, np.int64)).astype(np.int64)
+        off = 2 * excl
+        pk0_seg = np.full(nseg, -1, dtype=np.int64)
+        pk0_seg[okg] = off
+        total = int(2 * n_s.sum())
+        sel = okg[seg_of_pair]
+        within = np.arange(int(n_s.sum()), dtype=np.int64) \
+            - np.repeat(excl, n_s)
+        r1_t = np.repeat(off, n_s) + within
+        r2_t = np.repeat(off + n_s, n_s) + within
+        pack0 = np.empty(total, dtype=np.int64)
+        clips0 = np.empty(total, dtype=np.int64)
+        pack0[r1_t] = r1[sel]
+        pack0[r2_t] = r2[sel]
+        clips0[r1_t] = c1[sel]
+        clips0[r2_t] = c2[sel]
+
+        return {"gid": gid, "starts": starts, "ends": ends,
+                "n_g": n_g, "small": small, "downs": downs, "short": short,
+                "indel": indel, "okg": okg, "r1_neg": r1_neg,
+                "r2_neg": r2_neg, "consensus_length": consensus_length,
+                "pk0_seg": pk0_seg, "pack0": pack0, "clips0": clips0,
+                "r1": r1, "r2": r2, "flen1": flen1, "flen2": flen2}
+
+    def _finish_molecule_vec(self, rows, mi, pairs, pack_rows, pack_clips,
+                             pk_base=0):
         """Phases 3-5 for one group given its span-paired arrays; returns a
         partial mol (pack rows staged) or None with classic reject stats."""
         caller = self.caller
@@ -778,7 +918,7 @@ class FastCodecCaller:
             return None
         consensus_length = p + Lneg[0] - n_
 
-        pk0 = len(pack_rows)
+        pk0 = pk_base + len(pack_rows)
         pack_rows.extend(r1.tolist())
         pack_clips.extend(c1.tolist())
         pack_rows.extend(r2.tolist())
@@ -791,7 +931,8 @@ class FastCodecCaller:
             "consensus_length": consensus_length,
         }
 
-    def _prepare_molecule_vec(self, batch, rows, mi, pack_rows, pack_clips):
+    def _prepare_molecule_vec(self, batch, rows, mi, pack_rows, pack_clips,
+                              pk_base=0):
         """Phases 1-4 on arrays; returns a partial mol (pack indices staged)
         or None (rejected, reasons recorded like classic prepare)."""
         caller = self.caller
@@ -902,7 +1043,7 @@ class FastCodecCaller:
         consensus_length = p + Lneg[3] - n_
 
         # stage the pack rows (r1 strand then r2 strand, pair order)
-        pk0 = len(pack_rows)
+        pk0 = pk_base + len(pack_rows)
         for i in r1i:
             pack_rows.append(i[0])
             pack_clips.append(i[1])

@@ -296,3 +296,40 @@ def test_batch_bytes_zero_not_silent(codec_bam, tmp_path):
     assert main(["codec", "-i", codec_bam, "-o", out, "--min-reads", "1",
                  "--batch-bytes", "0"]) == 0
     assert len(records_of(out)) > 0
+
+
+def test_all_groups_shape_ineligible(tmp_path):
+    """A span where EVERY group is shape-ineligible (soft-clipped CIGARs)
+    drives _pair_span's empty-eligible early return — it must hand back a
+    3-tuple (None geometry), not crash, and match the classic engine."""
+    path = str(tmp_path / "allsoft.bam")
+    rng = np.random.default_rng(7)
+    header = BamHeader(
+        text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n@SQ\tSN:c\tLN:100000\n",
+        ref_names=["c"], ref_lengths=[100000])
+
+    def rec(name, flag, pos, mi, cigar, next_pos, tlen):
+        length = sum(n for _, n in cigar)
+        sq = bytes(rng.choice(np.frombuffer(b"ACGT", np.uint8), size=length))
+        b = RecordBuilder().start_mapped(
+            name, flag, 0, pos, 60, cigar, sq,
+            rng.integers(10, 41, size=length).astype(np.uint8),
+            next_ref_id=0, next_pos=next_pos, tlen=tlen)
+        b.tag_str(b"MI", mi)
+        b.tag_str(b"RX", b"ACGTAC")
+        return b.finish()
+
+    records = []
+    for g in range(4):
+        mi = str(g).encode()
+        p1, p2 = 1000 + g * 500, 1012 + g * 500
+        for t in range(2):
+            name = b"g%dt%d" % (g, t)
+            records.append(rec(name, 0x1 | 0x40 | 0x20, p1, mi,
+                               [("S", 5), ("M", 55)], p2, p2 + 60 - p1))
+            records.append(rec(name, 0x1 | 0x80 | 0x10, p2, mi,
+                               [("M", 55), ("S", 5)], p1, -(p2 + 60 - p1)))
+    with BamWriter(path, header) as w:
+        for r in records:
+            w.write_record_bytes(r)
+    assert_cli_parity(path, tmp_path, ["--min-reads", "1"])

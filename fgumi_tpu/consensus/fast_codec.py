@@ -241,8 +241,28 @@ class FastCodecCaller:
         if not keep:
             return []
         J = len(keep)
-        Ls = np.array([m["consensus_length"] for m, _, _ in keep],
-                      dtype=np.int64)
+        # ONE pass over the kept molecules collects every per-molecule
+        # scalar the batched placement/serialization needs (this loop ran
+        # five times before: lengths, two placement passes, rc flags,
+        # rejects)
+        Ls = np.empty(J, dtype=np.int64)
+        r1n = np.empty(J, dtype=bool)
+        r2n = np.empty(J, dtype=bool)
+        slot_j = ([], [])
+        slot_row = ([], [])
+        slot_k = ([], [])
+        arr_items = []  # (side, j, en) — materialized strands, placed scalarly
+        for j, (mol, en1, en2) in enumerate(keep):
+            Ls[j] = mol["consensus_length"]
+            r1n[j] = mol["r1_is_negative"]
+            r2n[j] = mol["r2_is_negative"]
+            for side, en in ((0, en1), (1, en2)):
+                if len(en) == 3:
+                    slot_j[side].append(j)
+                    slot_row[side].append(en[1])
+                    slot_k[side].append(en[2])
+                else:
+                    arr_items.append((side, j, en))
         offs = np.zeros(J + 1, dtype=np.int64)
         np.cumsum(Ls, out=offs[1:])
         T = int(offs[-1])
@@ -277,37 +297,25 @@ class FastCodecCaller:
 
         def place_side(side, bt, qt, dt, et):
             """One side's placement: slot-backed strands in one vectorized
-            gather+scatter; array-backed strands scalarly."""
-            rows = []
-            ks = []
-            os_ = []
-            rcs = []
-            pls = []
-            ls = []
-            for j, (mol, en1, en2) in enumerate(keep):
-                en = en1 if side == 0 else en2
-                r1n = mol["r1_is_negative"]
-                rc = r1n if side == 0 else not r1n
-                pl = r1n if side == 0 else mol["r2_is_negative"]
-                if len(en) == 3:
-                    rows.append(en[1])
-                    ks.append(en[2])
-                    os_.append(int(offs[j]))
-                    rcs.append(rc)
-                    pls.append(pl)
-                    ls.append(int(Ls[j]))
-                else:
-                    place_arr(en[0], en[1], en[2], en[3], rc, pl,
-                              int(offs[j]), int(Ls[j]), bt, qt, dt, et)
-            if not rows:
+            gather+scatter; array-backed strands scalarly (collected by the
+            single pass above)."""
+            for aside, j, en in arr_items:
+                if aside != side:
+                    continue
+                rc = r1n[j] if side == 0 else not r1n[j]
+                pl = r1n[j] if side == 0 else r2n[j]
+                place_arr(en[0], en[1], en[2], en[3], bool(rc), bool(pl),
+                          int(offs[j]), int(Ls[j]), bt, qt, dt, et)
+            if not slot_j[side]:
                 return
             b_all, q_all, dmat, emat = slot_mats
-            rows = np.asarray(rows, np.int64)
-            ks = np.asarray(ks, np.int64)
-            os_ = np.asarray(os_, np.int64)
-            rcs = np.asarray(rcs, bool)
-            pls = np.asarray(pls, bool)
-            base = os_ + np.where(pls, np.asarray(ls, np.int64) - ks, 0)
+            jarr = np.asarray(slot_j[side], np.int64)
+            rows = np.asarray(slot_row[side], np.int64)
+            ks = np.asarray(slot_k[side], np.int64)
+            os_ = offs[jarr]
+            rcs = r1n[jarr] if side == 0 else ~r1n[jarr]
+            pls = r1n[jarr] if side == 0 else r2n[jarr]
+            base = os_ + np.where(pls, Ls[jarr] - ks, 0)
             n_obs = int(ks.sum())
             within = np.arange(n_obs, dtype=np.int64) \
                 - np.repeat(np.concatenate(([0], np.cumsum(ks)[:-1]))
@@ -402,12 +410,12 @@ class FastCodecCaller:
                 out.append(struct.pack("<I", len(rec)) + rec)
             return out
 
-        return self._serialize_native(keep, good, offs, Ls, cb, cq,
+        return self._serialize_native(keep, good, offs, Ls, r1n, cb, cq,
                                       np.ascontiguousarray(ce,
                                                            dtype=np.int64),
                                       b1, q1, d1, e1, b2, q2, d2, e2)
 
-    def _serialize_native(self, keep, good, offs, Ls, cb, cq, ce,
+    def _serialize_native(self, keep, good, offs, Ls, r1n, cb, cq, ce,
                           b1, q1, d1, e1, b2, q2, d2, e2):
         """One native serialization pass (codec.py _build_record byte-exact).
 
@@ -422,9 +430,7 @@ class FastCodecCaller:
         st, opts = caller.stats, caller.options
         T = int(offs[-1])
         pos = np.arange(T, dtype=np.int64) - np.repeat(offs[:-1], Ls)
-        rc_flags = np.fromiter((m["r1_is_negative"] for m, _, _ in keep),
-                               dtype=bool, count=len(keep))
-        rc_rep = np.repeat(rc_flags, Ls)
+        rc_rep = np.repeat(r1n, Ls)
         src = np.where(rc_rep,
                        np.repeat(offs[:-1] + Ls - 1, Ls) - pos,
                        np.arange(T, dtype=np.int64))

@@ -17,7 +17,7 @@ import struct
 
 import numpy as np
 
-from ..constants import (CODE_TO_BASE, N_CODE, NO_CALL_BASE,
+from ..constants import (CODE_TO_BASE, MIN_PHRED, N_CODE, NO_CALL_BASE,
                          NO_CALL_BASE_LOWER)
 from ..io.bam import (FLAG_FIRST, FLAG_MATE_REVERSE, FLAG_MATE_UNMAPPED,
                       FLAG_PAIRED, FLAG_REVERSE, FLAG_SECONDARY,
@@ -334,9 +334,16 @@ class FastCodecCaller:
         place_side(0, b1, q1, d1, e1)
         place_side(1, b2, q2, d2, e2)
 
-        # ---- duplex combine, one pass over the concatenated strands
-        cb, cq, cd, ce, both, disag = combine_arrays(b1, b2, q1, q2,
-                                                     d1, d2, e1, e2)
+        # ---- duplex combine, one pass over the concatenated strands:
+        # native single C pass when available (byte-identical to
+        # combine_arrays, which the classic path keeps as the oracle)
+        if nb.available():
+            cb, cq, cd, ce, both, disag = nb.codec_combine(
+                b1, b2, q1, q2, d1, d2, e1, e2, MIN_PHRED, NO_CALL_BASE,
+                NO_CALL_BASE_LOWER, I16_MAX)
+        else:
+            cb, cq, cd, ce, both, disag = combine_arrays(b1, b2, q1, q2,
+                                                         d1, d2, e1, e2)
 
         # per-molecule disagreement thresholds (recoverable rejects)
         def seg_sum(x):

@@ -854,3 +854,37 @@ def concat_spans(srcs, src_id, off, length):
                            _addr(out), _addr(out_off))
     del keep
     return out, out_off
+
+
+def codec_combine(b1, b2, q1, q2, d1, d2, e1, e2, min_phred: int,
+                  no_call: int, no_call_lower: int, i16_max: int):
+    """Single-pass CODEC duplex combine (fgumi_codec_combine).
+
+    The native form of consensus/codec.py combine_arrays plus the
+    both/disagree flag derivation — one C pass instead of ~25 whole-array
+    numpy passes. Inputs: uint8 base/qual arrays and int32 depth/error
+    arrays of equal length. Returns (base u8, qual u8, depth i32,
+    errors i32, both bool, disag bool).
+    """
+    lib = get_lib()
+    n = len(b1)
+    b1 = np.ascontiguousarray(b1, np.uint8)
+    b2 = np.ascontiguousarray(b2, np.uint8)
+    q1 = np.ascontiguousarray(q1, np.uint8)
+    q2 = np.ascontiguousarray(q2, np.uint8)
+    d1 = np.ascontiguousarray(d1, np.int32)
+    d2 = np.ascontiguousarray(d2, np.int32)
+    e1 = np.ascontiguousarray(e1, np.int32)
+    e2 = np.ascontiguousarray(e2, np.int32)
+    cb = np.empty(n, dtype=np.uint8)
+    cq = np.empty(n, dtype=np.uint8)
+    cd = np.empty(n, dtype=np.int32)
+    ce = np.empty(n, dtype=np.int32)
+    both = np.empty(n, dtype=np.uint8)
+    disag = np.empty(n, dtype=np.uint8)
+    lib.fgumi_codec_combine(
+        _addr(b1), _addr(b2), _addr(q1), _addr(q2), _addr(d1), _addr(d2),
+        _addr(e1), _addr(e2), n, int(min_phred), int(no_call),
+        int(no_call_lower), int(i16_max), _addr(cb), _addr(cq), _addr(cd),
+        _addr(ce), _addr(both), _addr(disag))
+    return cb, cq, cd, ce, both.view(np.bool_), disag.view(np.bool_)

@@ -88,7 +88,7 @@ extern "C" {
 // ABI version for the stale-.so guard in __init__.py: bump whenever any
 // exported signature changes (a symbol probe alone cannot detect an
 // argument-list change in an existing function).
-long fgumi_abi_version() { return 11; }
+long fgumi_abi_version() { return 12; }
 
 // Candidate UMI pairs with hamming(A[i], B[j]) <= d over (n, L)/(m, L) byte
 // matrices, via the d+1-part pigeonhole (umi/assigners.py
@@ -3251,6 +3251,107 @@ long fgumi_consensus_classify(
   }
   *n_obs_out = n_obs;
   return n_hard;
+}
+
+// Elementwise CODEC duplex combine over the concatenated strand arrays —
+// the single-pass form of consensus/codec.py combine_arrays (which mirrors
+// the reference's codec_caller.rs:1127-1296 and stays the Python-side
+// parity oracle on the classic path). Also accumulates the per-position
+// both/disagree flags the caller previously derived with two extra passes.
+// Depth/error inputs are int32; error sums run in int64 so extreme inputs
+// cannot overflow (bit-parity with the numpy oracle holds for any inputs
+// whose int32 sums don't wrap — the batch path pre-caps at I16_MAX, far
+// inside that domain).
+void fgumi_codec_combine(const uint8_t* b1, const uint8_t* b2,
+                         const uint8_t* q1, const uint8_t* q2,
+                         const int32_t* d1, const int32_t* d2,
+                         const int32_t* e1, const int32_t* e2, int64_t n,
+                         int32_t min_phred, uint8_t no_call,
+                         uint8_t no_call_lower, int32_t i16_max,
+                         uint8_t* cb, uint8_t* cq, int32_t* cd, int32_t* ce,
+                         uint8_t* both_out, uint8_t* disag_out) {
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t ba = b1[i], bb = b2[i];
+    const int32_t qa = q1[i], qb = q2[i];
+    const bool a_has = ba != no_call && ba != no_call_lower;
+    const bool b_has = bb != no_call && bb != no_call_lower;
+    const bool both = a_has && b_has;
+    const bool agree = both && ba == bb;
+    const bool a_wins = both && !agree && qa > qb;
+    const bool b_wins = both && !agree && qb > qa;
+    const bool tie = both && !agree && qa == qb;
+
+    int32_t raw_base = b_wins ? bb : ba;
+    int32_t raw_qual;
+    if (agree) {
+      raw_qual = qa + qb > 93 ? 93 : qa + qb;
+    } else if (a_wins) {
+      raw_qual = qa - qb > min_phred ? qa - qb : min_phred;
+    } else if (b_wins) {
+      raw_qual = qb - qa > min_phred ? qb - qa : min_phred;
+    } else if (tie) {
+      raw_qual = min_phred;
+    } else {
+      raw_qual = 0;
+    }
+    const bool q_masked = both && raw_qual == min_phred;
+    const int32_t dup_base = q_masked ? no_call : raw_base;
+    const int32_t dup_qual = q_masked ? min_phred : raw_qual;
+
+    const int32_t ca = d1[i] > i16_max ? i16_max : d1[i];
+    const int32_t cbd = d2[i] > i16_max ? i16_max : d2[i];
+    const int32_t dup_depth = ca + cbd;
+    const bool chose_a = agree || a_wins || tie;
+    int64_t dup_err;
+    if (agree) {
+      dup_err = static_cast<int64_t>(e1[i]) + e2[i];
+    } else if (chose_a) {
+      const int64_t t = static_cast<int64_t>(d2[i]) - e2[i];
+      dup_err = e1[i] + (t > 0 ? t : 0);
+    } else {
+      const int64_t t = static_cast<int64_t>(d1[i]) - e1[i];
+      dup_err = e2[i] + (t > 0 ? t : 0);
+    }
+
+    const bool only_a = a_has && !b_has;
+    const bool only_b = b_has && !a_has;
+    const bool a_q2 = qa == min_phred;
+    const bool b_q2 = qb == min_phred;
+
+    int32_t base, qual, depth;
+    int64_t errors;
+    if (both) {
+      base = dup_base;
+      qual = dup_qual;
+      depth = dup_depth;
+      errors = dup_err;
+    } else if (only_a) {
+      base = a_q2 ? no_call : ba;
+      qual = a_q2 ? min_phred : qa;
+      depth = d1[i];
+      errors = e1[i];
+    } else if (only_b) {
+      base = b_q2 ? no_call : bb;
+      qual = b_q2 ? min_phred : qb;
+      depth = d2[i];
+      errors = e2[i];
+    } else {
+      base = no_call;
+      qual = min_phred;
+      depth = 0;
+      const int64_t s = static_cast<int64_t>(e1[i]) + e2[i];
+      errors = s > i16_max ? i16_max : s;
+    }
+
+    const bool n_mask = ba == no_call || bb == no_call;
+    cb[i] = static_cast<uint8_t>(n_mask ? no_call : base);
+    cq[i] = static_cast<uint8_t>(n_mask ? min_phred : qual);
+    cd[i] = depth > 2 * i16_max ? 2 * i16_max : depth;
+    ce[i] = static_cast<int32_t>(errors > i16_max ? i16_max
+                                                               : errors);
+    both_out[i] = both ? 1 : 0;
+    disag_out[i] = (a_wins || b_wins || tie) ? 1 : 0;
+  }
 }
 
 }  // extern "C"

@@ -475,3 +475,32 @@ def test_consensus_classify_native_easy_hard():
         assert (hc[os_[k]:os_[k + 1]] == col[v]).all()
         assert (hq[os_[k]:os_[k + 1]] == np.minimum(cq[v], 93)).all()
         assert (hcnt[k] == np.bincount(col[v], minlength=4)[:4]).all()
+
+
+def test_codec_combine_matches_numpy_oracle():
+    """fgumi_codec_combine must be bit-exact with consensus/codec.py
+    combine_arrays (the classic-path oracle) across adversarial inputs:
+    lowercase pads, N masks, Q0/Q2 edges, and depths past I16_MAX."""
+    from fgumi_tpu.consensus.codec import combine_arrays
+    from fgumi_tpu.constants import (MIN_PHRED, NO_CALL_BASE,
+                                     NO_CALL_BASE_LOWER)
+    from fgumi_tpu.native import batch as nb
+
+    rng = np.random.default_rng(5)
+    letters = np.array([ord(c) for c in "ACGTNn"], dtype=np.uint8)
+    for trial in range(20):
+        n = int(rng.integers(1, 2000))
+        b1 = rng.choice(letters, size=n)
+        b2 = rng.choice(letters, size=n)
+        q1 = rng.choice([0, 2, 3, 20, 93], size=n).astype(np.uint8)
+        q2 = rng.choice([0, 2, 3, 20, 93], size=n).astype(np.uint8)
+        d1 = rng.integers(0, 70000, size=n).astype(np.int32)
+        d2 = rng.integers(0, 70000, size=n).astype(np.int32)
+        e1 = rng.integers(0, 40000, size=n).astype(np.int32)
+        e2 = rng.integers(0, 40000, size=n).astype(np.int32)
+        ref = combine_arrays(b1, b2, q1, q2, d1, d2, e1, e2)
+        got = nb.codec_combine(b1, b2, q1, q2, d1, d2, e1, e2, MIN_PHRED,
+                               NO_CALL_BASE, NO_CALL_BASE_LOWER, 32767)
+        for k, (r, g) in enumerate(zip(ref, got)):
+            np.testing.assert_array_equal(np.asarray(r), np.asarray(g),
+                                          err_msg=f"trial {trial} output {k}")

@@ -791,6 +791,24 @@ class FastDuplexCaller:
         cnt = np.add.reduceat(present, vstarts[:-1]) \
             if len(span_v) else np.zeros(0, dtype=np.int64)
 
+        # native fast path: every output whose contributing segs are
+        # unanimous/absent resolves in one C pass (single-read verbatim /
+        # all-equal uppercased, b-side flip on bytes); only divergent or
+        # disagreeing outputs fall through to the Python likelihood loop
+        fb_set = None
+        if K and nb.available():
+            a_arr = np.fromiter((s[6] for s in out_specs), np.int64, K)
+            b_arr = np.fromiter((s[7] for s in out_specs), np.int64, K)
+            n_off, n_len, n_blob, fb = nb.duplex_rx_fast(
+                buf, una_off, una_len, cnt, a_arr, b_arr)
+            if len(fb) == 0:
+                blob_arr = n_blob if len(n_blob) else \
+                    np.zeros(1, dtype=np.uint8)
+                rx_addr = np.where(n_len > 0,
+                                   blob_arr.ctypes.data + n_off, 0)
+                return rx_addr, n_len, [blob_arr]
+            fb_set = set(int(x) for x in fb)
+
         def seg_values(s):
             """Ordered present RX strings of seg s."""
             rows = span_v[vstarts[s]:vstarts[s + 1]]
@@ -809,6 +827,8 @@ class FastDuplexCaller:
         fams = []
         fam_ks = []
         for k, spec in enumerate(out_specs):
+            if fb_set is not None and k not in fb_set:
+                continue  # resolved by the native fast path
             # AB-seg values verbatim, BA-seg values flipped — BOTH segs of
             # the branch contribute, independent of consensus aliveness
             a_s, b_s = spec[6], spec[7]
@@ -864,6 +884,17 @@ class FastDuplexCaller:
         for k, rx in zip(fam_ks, consensus_umis_batch(fams)):
             emit(k, rx)
         blob_arr = np.frombuffer(bytes(blob) or b"\x00", dtype=np.uint8)
+        if fb_set is not None:
+            # merge: python-resolved (fallback) outputs override the
+            # native arena's entries; both arenas stay alive via the
+            # returned keepalive list
+            n_blob_arr = n_blob if len(n_blob) else np.zeros(1, np.uint8)
+            py_mask = rx_len > 0
+            rx_addr = np.where(
+                py_mask, blob_arr.ctypes.data + rx_off_in_blob,
+                np.where(n_len > 0, n_blob_arr.ctypes.data + n_off, 0))
+            return (rx_addr, np.where(py_mask, rx_len, n_len),
+                    [blob_arr, n_blob_arr])
         rx_addr = np.where(rx_len > 0,
                            blob_arr.ctypes.data + rx_off_in_blob, 0)
         return rx_addr, rx_len, [blob_arr]

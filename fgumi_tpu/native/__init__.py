@@ -24,7 +24,7 @@ _lock = threading.Lock()
 _lib = None
 _lib_failed = False
 # must equal fgumi_abi_version() in fgumi_native.cc (stale-.so guard)
-_ABI_VERSION = 12
+_ABI_VERSION = 13
 
 
 def _build() -> bool:
@@ -45,6 +45,9 @@ def _declare(lib):
     """ctypes restype/argtypes for every export (one copy, used by both
     the cached-build path and the FGUMI_TPU_NATIVE_SO override)."""
     p = ctypes.c_void_p
+    lib.fgumi_duplex_rx_fast.restype = ctypes.c_long
+    lib.fgumi_duplex_rx_fast.argtypes = [
+        p, p, p, p, p, p, ctypes.c_long, p, ctypes.c_long, p, p, p, p]
     lib.fgumi_codec_combine.restype = None
     lib.fgumi_codec_combine.argtypes = [
         p, p, p, p, p, p, p, p, ctypes.c_long, ctypes.c_int, ctypes.c_ubyte,

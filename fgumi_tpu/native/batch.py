@@ -888,3 +888,37 @@ def codec_combine(b1, b2, q1, q2, d1, d2, e1, e2, min_phred: int,
         int(no_call_lower), int(i16_max), _addr(cb), _addr(cq), _addr(cd),
         _addr(ce), _addr(both), _addr(disag))
     return cb, cq, cd, ce, both.view(np.bool_), disag.view(np.bool_)
+
+
+def duplex_rx_fast(buf, una_off, una_len, cnt, a_seg, b_seg):
+    """Duplex consensus-RX fast path (fgumi_duplex_rx_fast).
+
+    Resolves every output whose contributing segs are unanimous (or
+    absent) entirely in C — single-read verbatim / all-equal uppercased,
+    with the b-side strand flip done on bytes. Returns (rx_off i64,
+    rx_len i32, blob u8, fb_idx i64): outputs listed in fb_idx (divergent
+    segs or disagreeing values) are untouched and need the Python
+    likelihood path.
+    """
+    lib = get_lib()
+    K = len(a_seg)
+    una_off = np.ascontiguousarray(una_off, np.int64)
+    una_len = np.ascontiguousarray(una_len, np.int32)
+    cnt = np.ascontiguousarray(cnt, np.int64)
+    a_seg = np.ascontiguousarray(a_seg, np.int64)
+    b_seg = np.ascontiguousarray(b_seg, np.int64)
+    # exact bound: each output emits at most one contributing value
+    pos_len = np.where(una_off >= 0, una_len.astype(np.int64), 0)
+    cap = int(pos_len[a_seg[a_seg >= 0]].sum()
+              + pos_len[b_seg[b_seg >= 0]].sum()) + 1
+    blob = np.empty(cap, dtype=np.uint8)
+    rx_off = np.empty(K, dtype=np.int64)
+    rx_len = np.empty(K, dtype=np.int32)
+    fb_idx = np.empty(max(K, 1), dtype=np.int64)
+    used = np.zeros(1, dtype=np.int64)
+    n_fb = lib.fgumi_duplex_rx_fast(
+        _addr(buf), _addr(una_off), _addr(una_len), _addr(cnt),
+        _addr(a_seg), _addr(b_seg), K, _addr(blob), cap, _addr(rx_off),
+        _addr(rx_len), _addr(fb_idx), _addr(used))
+    assert n_fb >= 0, "duplex_rx_fast blob overflow (sizing bug)"
+    return rx_off, rx_len, blob[:int(used[0])], fb_idx[:n_fb]

@@ -88,7 +88,7 @@ extern "C" {
 // ABI version for the stale-.so guard in __init__.py: bump whenever any
 // exported signature changes (a symbol probe alone cannot detect an
 // argument-list change in an existing function).
-long fgumi_abi_version() { return 12; }
+long fgumi_abi_version() { return 13; }
 
 // Candidate UMI pairs with hamming(A[i], B[j]) <= d over (n, L)/(m, L) byte
 // matrices, via the d+1-part pigeonhole (umi/assigners.py
@@ -3352,6 +3352,96 @@ void fgumi_codec_combine(const uint8_t* b1, const uint8_t* b2,
     both_out[i] = both ? 1 : 0;
     disag_out[i] = (a_wins || b_wins || tie) ? 1 : 0;
   }
+}
+
+// Duplex consensus-RX fast path (fast_duplex.py _output_rx): per output
+// read, combine the a-seg RX (verbatim) and b-seg RX (strand-flipped =
+// '-'-separated fields reversed) when BOTH contributing segs are unanimous
+// (una_off >= 0) or absent (-1). Emits into `blob`:
+//   total-present == 1  -> the single value verbatim
+//   values all equal    -> the value with acgtn uppercased
+// Anything else (divergent seg una_off == -2, or disagreeing values) is a
+// python-fallback output: its index lands in fb_idx and rx_len stays 0.
+// Returns the fallback count, or -1 if blob_cap would overflow (caller
+// sizes blob_cap as the sum of both contributing lengths per output, so
+// this is a programming-error guard, not a retry protocol).
+int64_t fgumi_duplex_rx_fast(const uint8_t* buf, const int64_t* una_off,
+                             const int32_t* una_len, const int64_t* cnt,
+                             const int64_t* a_seg, const int64_t* b_seg,
+                             int64_t K, uint8_t* blob, int64_t blob_cap,
+                             int64_t* rx_off, int32_t* rx_len,
+                             int64_t* fb_idx, int64_t* blob_used_out) {
+  int64_t used = 0;
+  int64_t n_fb = 0;
+  uint8_t val[2][512];
+  int32_t vlen[2];
+  int64_t vcnt[2];
+  for (int64_t k = 0; k < K; ++k) {
+    rx_off[k] = 0;
+    rx_len[k] = 0;
+    int nv = 0;
+    bool fallback = false;
+    for (int side = 0; side < 2; ++side) {
+      const int64_t s = side == 0 ? a_seg[k] : b_seg[k];
+      if (s < 0 || una_off[s] == -1) continue;
+      if (una_off[s] == -2 || una_len[s] > 512) {
+        fallback = true;
+        break;
+      }
+      const int32_t L = una_len[s];
+      const uint8_t* src = buf + una_off[s];
+      if (side == 0) {
+        for (int32_t i = 0; i < L; ++i) val[nv][i] = src[i];
+      } else {
+        // strand flip: reverse the '-'-separated fields
+        int32_t w = 0;
+        int32_t end = L;
+        for (int32_t i = L - 1; i >= -1; --i) {
+          if (i == -1 || src[i] == '-') {
+            for (int32_t j = i + 1; j < end; ++j) val[nv][w++] = src[j];
+            if (i >= 0) val[nv][w++] = '-';
+            end = i;
+          }
+        }
+      }
+      vlen[nv] = L;
+      vcnt[nv] = cnt[s];
+      ++nv;
+    }
+    if (fallback) {
+      fb_idx[n_fb++] = k;
+      continue;
+    }
+    if (nv == 0) continue;  // nothing to emit (rx_len stays 0)
+    const int64_t total = nv == 2 ? vcnt[0] + vcnt[1] : vcnt[0];
+    bool emit_upper;
+    if (total == 1) {
+      emit_upper = false;  // single read: verbatim
+    } else if (nv == 2 && (vlen[0] != vlen[1] ||
+                           memcmp(val[0], val[1], vlen[0]) != 0)) {
+      fb_idx[n_fb++] = k;  // disagreeing unanimous values: likelihood call
+      continue;
+    } else {
+      emit_upper = true;
+    }
+    const int32_t L = vlen[0];
+    if (used + L > blob_cap) return -1;
+    rx_off[k] = used;
+    rx_len[k] = L;
+    if (emit_upper) {
+      for (int32_t i = 0; i < L; ++i) {
+        const uint8_t c = val[0][i];
+        blob[used + i] =
+            (c == 'a' || c == 'c' || c == 'g' || c == 't' || c == 'n')
+                ? c - 32 : c;
+      }
+    } else {
+      memcpy(blob + used, val[0], L);
+    }
+    used += L;
+  }
+  *blob_used_out = used;
+  return n_fb;
 }
 
 }  // extern "C"

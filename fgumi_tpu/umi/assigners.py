@@ -55,11 +55,20 @@ def render_mis_array(mols) -> np.ndarray:
     """Vectorized MoleculeId.render over a list: one S-dtype numpy array
     (itemsize covers the longest value; consumers read true lengths via
     np.char.str_len). Replaces 100k+ per-object render()/encode() calls in
-    the group emission path with three array passes."""
+    the group emission path with three array passes.
+
+    Assigners return ONE MoleculeId object per molecule (repeated by
+    reference across its templates), so the attribute reads run on the
+    identity-deduped uniques and the full-size result is a single gather."""
     n = len(mols)
-    ids = np.fromiter((m.id for m in mols), np.int64, n)
-    kinds = np.fromiter((ord(m.kind) if m.kind else 0 for m in mols),
-                        np.uint8, n)
+    obj = np.fromiter(map(id, mols), np.int64, n)
+    uniq, first, inverse = np.unique(obj, return_index=True,
+                                     return_inverse=True)
+    umols = [mols[int(i)] for i in first]
+    m = len(umols)
+    ids = np.fromiter((mo.id for mo in umols), np.int64, m)
+    kinds = np.fromiter((ord(mo.kind) if mo.kind else 0 for mo in umols),
+                        np.uint8, m)
     s = ids.astype("S20")
     out = np.where(kinds == 0, np.bytes_(b""), s)
     ab = (kinds == ord("A")) | (kinds == ord("B"))
@@ -67,7 +76,7 @@ def render_mis_array(mols) -> np.ndarray:
         suffix = np.where(kinds == ord("A"), np.bytes_(b"/A"),
                           np.bytes_(b"/B"))
         out = np.where(ab, np.char.add(s, suffix), out)
-    return out
+    return out[inverse]
 
 
 _VALID_SET = frozenset("ACGTacgt")

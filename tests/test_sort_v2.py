@@ -263,6 +263,11 @@ def test_batch_keys_adversarial(tmp_path):
     values, non-Z MI tags, non-UTF8 RG values."""
     import numpy as np
 
+    from fgumi_tpu.native import get_lib
+
+    if get_lib() is None:
+        pytest.skip("native library unavailable")
+
     from fgumi_tpu.io.bam import BamHeader, BamReader, BamWriter, RecordBuilder
     from fgumi_tpu.io.batch_reader import BamBatchReader
     from fgumi_tpu.sort.keys import make_batch_keys_fn, make_key_bytes_fn

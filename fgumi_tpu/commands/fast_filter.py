@@ -130,6 +130,10 @@ class FastFilter:
         buf = batch.buf
         n = len(rows)
         lo = rows[0]
+        # every tag this pass reads, one native aux scan for all of them
+        batch.prefetch_tags([b"cD", b"cE", b"aD", b"aM", b"bD", b"bM",
+                             b"aE", b"bE", b"cd", b"ce", b"ad", b"ae",
+                             b"bd", b"be"])
         l_seq = batch.l_seq[rows].astype(np.int64)
         L = max(int(l_seq.max()), 1) if n else 1
 

@@ -56,6 +56,10 @@ class _ArrSeg:
 class FastGrouper:
     """Batch GroupReadsByUmi engine. Feed RecordBatches; collect wire chunks."""
 
+    # per-batch tags beyond umi_tag fetched in ONE fused aux scan;
+    # subclasses extend with their own lookups
+    _PREFETCH_TAGS = [b"RG", b"MQ"]
+
     def __init__(self, header, assigner, *, umi_tag=b"RX", assigned_tag=b"MI",
                  min_mapq=1, include_non_pf=False, min_umi_length=None,
                  no_umi=False, allow_unmapped=False):
@@ -234,6 +238,9 @@ class FastGrouper:
         if n == 0:
             return []
         buf = batch.buf
+        # one native aux scan covers every tag the phases of this engine
+        # read (FastDedup extends the list with its tc/CB lookups)
+        batch.prefetch_tags([self.umi_tag] + self._PREFETCH_TAGS)
         name_off = batch.data_off + 32
         name_len = (batch.l_read_name - 1).astype(np.int32)
         tstarts = nb.group_starts(buf, np.ascontiguousarray(name_off),
@@ -790,6 +797,10 @@ class FastDedup(FastGrouper):
     Groups with CB cell barcodes or --no-umi run the reference per-template
     path (rare); so does the batch-boundary carry.
     """
+
+    # the dedup phases additionally read tc (template-coordinate keys from
+    # zipper) and CB (cell partitions) — same fused scan
+    _PREFETCH_TAGS = FastGrouper._PREFETCH_TAGS + [b"tc", b"CB"]
 
     def __init__(self, header, assigner, *, umi_tag=b"RX", assigned_tag=b"MI",
                  min_mapq=0, include_non_pf=False, min_umi_length=None,

@@ -326,6 +326,8 @@ class FastSimplexCaller:
         if len(idx) == 0:
             return self.flush() if final else []
 
+        # every tag this engine reads for the batch, one native aux scan
+        batch.prefetch_tags([self.tag, b"MC", b"RX"])
         mi_off, mi_len, _ = batch.tag_locs(self.tag)
         starts = nb.group_starts(batch.buf, np.ascontiguousarray(mi_off[idx]),
                                  mi_len[idx])

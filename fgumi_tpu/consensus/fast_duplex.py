@@ -95,6 +95,7 @@ class FastDuplexCaller:
         if len(idx) == 0:
             return self.flush() if final else []
 
+        batch.prefetch_tags([self.tag, b"MC", b"RX"])
         mi_off, mi_len, _ = batch.tag_locs(self.tag)
         mo, ml = mi_off[idx], mi_len[idx]
         if (mo < 0).any():

@@ -44,17 +44,32 @@ class RecordBatch:
         self.aux_off = self.qual_off + self.l_seq
         self._tag_locs = {}
 
+    def prefetch_tags(self, tags):
+        """Seed the per-batch tag cache with ONE native aux scan for every
+        not-yet-cached tag (the C scan takes k tags per pass; commands that
+        read many tags were paying one full-batch scan per tag)."""
+        need = [t for t in tags if t not in self._tag_locs]
+        if not need:
+            return
+        # the fused scan packs tags at 2-byte stride; a stray non-2-byte
+        # tag would silently misalign every LATER tag's column
+        bad = [t for t in need if len(t) != 2]
+        if bad:
+            raise ValueError(f"SAM tags must be exactly 2 bytes: {bad!r}")
+        vo, vl, vt = nb.scan_tags(self.buf, self.aux_off, self.data_end,
+                                  need)
+        for j, t in enumerate(need):
+            self._tag_locs[t] = (np.ascontiguousarray(vo[:, j]),
+                                 np.ascontiguousarray(vl[:, j]),
+                                 np.ascontiguousarray(vt[:, j]))
+
     def tag_locs(self, tag: bytes):
         """(val_off int64[n], val_len int32[n], val_type uint8[n]) for one tag;
         val_off -1 where absent. Cached per batch."""
         got = self._tag_locs.get(tag)
         if got is None:
-            vo, vl, vt = nb.scan_tags(self.buf, self.aux_off, self.data_end,
-                                      [tag])
-            got = (np.ascontiguousarray(vo[:, 0]),
-                   np.ascontiguousarray(vl[:, 0]),
-                   np.ascontiguousarray(vt[:, 0]))
-            self._tag_locs[tag] = got
+            self.prefetch_tags([tag])
+            got = self._tag_locs[tag]
         return got
 
     def tag_locs_str(self, tag: bytes):

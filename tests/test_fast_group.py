@@ -294,3 +294,30 @@ def test_dedup_cli_fast_vs_classic(grouped_input, tmp_path):
             return [x.data for x in r]
 
     assert recs(fast) == recs(classic)
+
+
+def test_prefetch_rejects_bad_tag_length(tmp_path):
+    """A non-2-byte tag must fail loudly: the fused aux scan packs tags at
+    2-byte stride, so silently accepting it would misalign every later
+    tag's column in the same scan."""
+    import numpy as np
+    import pytest as _pytest
+
+    from fgumi_tpu.io.bam import BamHeader, BamWriter, RecordBuilder
+    from fgumi_tpu.io.batch_reader import BamBatchReader
+
+    path = str(tmp_path / "t.bam")
+    header = BamHeader(text="@HD\tVN:1.6\n@SQ\tSN:c\tLN:1000\n",
+                       ref_names=["c"], ref_lengths=[1000])
+    b = RecordBuilder().start_mapped(b"r", 0, 0, 10, 60, [("M", 4)],
+                                     b"ACGT", np.array([30] * 4, np.uint8))
+    b.tag_str(b"RX", b"AAAA")
+    with BamWriter(path, header) as w:
+        w.write_record_bytes(b.finish())
+    with BamBatchReader(path) as r:
+        batch = next(iter(r))
+    with _pytest.raises(ValueError, match="exactly 2 bytes"):
+        batch.prefetch_tags([b"RXY", b"RG"])
+    # and the good tags still work afterwards
+    batch.prefetch_tags([b"RX", b"RG"])
+    assert batch.tag_locs(b"RX")[0][0] >= 0

@@ -453,6 +453,7 @@ def template_coord_keys(batch, lib_ord: np.ndarray):
     n = batch.n
     # only Z/H-typed tags count as present (RawRecord.get_str semantics);
     # e.g. an MI:i: tag must fall back to (0, 0) like the per-record path
+    batch.prefetch_tags([b"MC", b"MI", b"RG"])  # one fused aux scan
     mc_off, mc_len, _ = batch.tag_locs_str(b"MC")
     mi_off, mi_len, _ = batch.tag_locs_str(b"MI")
     key_len = (30 + batch.l_read_name).astype(np.int64)  # 29 + name + NUL + up

@@ -185,6 +185,9 @@ def make_batch_keys_fn(order: str, header, subsort: str = "natural"):
         unknown_ord = ctx._lib_ord["unknown"]
 
         def tc_keys(batch):
+            # one fused aux scan for everything this key fn + the native
+            # key extractor read
+            batch.prefetch_tags([b"RG", b"MC", b"MI"])
             # vectorized RG -> library ordinal: resolve each distinct RG
             # value once (hash-deduplicated, byte-verified)
             rg_off, rg_len, _ = batch.tag_locs_str(b"RG")

@@ -213,12 +213,22 @@ def test_pure_python_fallback_chain_matches_native(tmp_path):
     import subprocess
     import sys
 
+    import pytest as _pytest
+
+    from fgumi_tpu.native import batch as _nb
+
+    if not _nb.available():
+        _pytest.skip("native library unavailable: parity would be pure-vs-pure")
     REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
     def chain(sub, env_extra):
         d = tmp_path / sub
         d.mkdir()
         env = {**os.environ, "PYTHONPATH": REPO, **env_extra}
+        # ambient FGUMI_TPU_NO_NATIVE would degrade the native chain to
+        # pure-vs-pure; only the explicit env_extra may set it
+        env.pop("FGUMI_TPU_NO_NATIVE", None)
+        env.update(env_extra)
 
         def run(args):
             subprocess.run([sys.executable, "-m", "fgumi_tpu"] + args,

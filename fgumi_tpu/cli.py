@@ -967,8 +967,19 @@ def _add_group(sub):
     p.add_argument("--min-umi-length", type=int, default=None)
     p.add_argument("--no-umi", action="store_true")
     p.add_argument("--allow-unmapped", action="store_true")
+    p.add_argument("-f", "--family-size-histogram", default=None,
+                   help="optional TSV of the family size distribution "
+                        "(fgbio format: count/fraction/cumulative)")
+    p.add_argument("-g", "--grouping-metrics", default=None,
+                   help="optional TSV of UMI grouping metrics (fgbio's "
+                        "5-column UmiGroupingMetric)")
+    p.add_argument("-M", "--metrics", default=None, metavar="PREFIX",
+                   help="write PREFIX.family_sizes.txt, "
+                        "PREFIX.grouping_metrics.txt and "
+                        "PREFIX.position_group_sizes.txt")
     p.add_argument("--family-size-out", default=None,
-                   help="optional TSV of family size counts")
+                   help="deprecated: plain size/count TSV (use "
+                        "--family-size-histogram)")
     p.add_argument("--threads", type=int, default=0,
                    help="reader/writer threads around the batch engine "
                         "(0/1 = inline)")
@@ -1063,6 +1074,29 @@ def cmd_group(args):
             f.write("family_size\tcount\n")
             for size, count in result["family_sizes"].items():
                 f.write(f"{size}\t{count}\n")
+    if (args.family_size_histogram or args.grouping_metrics or args.metrics):
+        from .metrics import (size_distribution_fields,
+                              size_distribution_rows,
+                              umi_grouping_metrics_row, write_metrics)
+
+        dist_fields = size_distribution_fields
+        fam_rows = size_distribution_rows(result["family_sizes"],
+                                          "family_size")
+        group_row = [umi_grouping_metrics_row(result["filter"])]
+        if args.family_size_histogram:
+            write_metrics(args.family_size_histogram, fam_rows,
+                          fieldnames=dist_fields("family_size"))
+        if args.grouping_metrics:
+            write_metrics(args.grouping_metrics, group_row)
+        if args.metrics:
+            write_metrics(args.metrics + ".family_sizes.txt", fam_rows,
+                          fieldnames=dist_fields("family_size"))
+            write_metrics(args.metrics + ".grouping_metrics.txt", group_row)
+            write_metrics(
+                args.metrics + ".position_group_sizes.txt",
+                size_distribution_rows(result["position_group_sizes"],
+                                       "position_group_size"),
+                fieldnames=dist_fields("position_group_size"))
     return 0
 
 

@@ -79,6 +79,47 @@ def family_size_rows(histograms: dict) -> list:
     return rows
 
 
+def size_distribution_fields(size_field: str) -> list:
+    """Column schema of size_distribution_rows (one place: callers pass
+    this as write_metrics' fieldnames so empty inputs still write the
+    correct header)."""
+    return [size_field, "count", "fraction",
+            f"fraction_gt_or_eq_{size_field}"]
+
+
+def size_distribution_rows(counts: dict, size_field: str) -> list:
+    """fgbio-format size distribution over one {size: count} map: ascending
+    `size_field` rows with `count`, `fraction`, and the reverse-cumulative
+    `fraction_gt_or_eq_<size_field>` (fgumi-metrics group.rs
+    build_size_distribution: the family-size and position-group-size
+    files of the `group` command)."""
+    total = sum(counts.values())
+    rows = []
+    for size in sorted(counts):
+        rows.append({size_field: size, "count": counts[size],
+                     "fraction": frac(counts[size], total),
+                     f"fraction_gt_or_eq_{size_field}": 0.0})
+    running = 0.0
+    for row in reversed(rows):
+        running += row["fraction"]
+        row[f"fraction_gt_or_eq_{size_field}"] = running
+    return rows
+
+
+def umi_grouping_metrics_row(filter_metrics: dict) -> dict:
+    """The 5-column fgbio `UmiGroupingMetric` row (fgumi-metrics
+    group.rs:55-77, incl. fgbio's `discarded_umis_to_short` spelling),
+    from the group engines' filter-metrics dict (zero-valued counters are
+    dropped by as_dict, so absent keys read as 0)."""
+    return {
+        "accepted_sam_records": filter_metrics.get("accepted", 0),
+        "discarded_non_pf": filter_metrics.get("non_pf", 0),
+        "discarded_poor_alignment": filter_metrics.get("poor_alignment", 0),
+        "discarded_ns_in_umi": filter_metrics.get("ns_in_umi", 0),
+        "discarded_umis_to_short": filter_metrics.get("umi_too_short", 0),
+    }
+
+
 class UmiCountTracker:
     """Raw/error/unique observation counts per UMI (shared.rs:61-140)."""
 

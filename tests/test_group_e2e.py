@@ -174,3 +174,53 @@ def test_group_rejects_coordinate_sorted_even_with_allow_unmapped(tmp_path):
         pass
     out = str(tmp_path / "x.bam")
     assert cli_main(["group", "-i", path, "-o", out, "--allow-unmapped"]) == 2
+
+
+def test_group_metric_files(tmp_path):
+    """-f/-g/-M write fgbio-format metric files: the 5-column
+    UmiGroupingMetric row (incl. fgbio's `discarded_umis_to_short`
+    spelling), and ascending size distributions whose reverse-cumulative
+    fraction column starts at 1.0 (group.rs:754-766, fgumi-metrics
+    group.rs:55-208)."""
+    import os
+    import subprocess
+    import sys
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    d = tmp_path
+    env = {**os.environ, "PYTHONPATH": REPO}
+
+    def run(args):
+        subprocess.run([sys.executable, "-m", "fgumi_tpu"] + args,
+                       check=True, cwd=str(d), env=env)
+
+    run(["simulate", "fastq-reads", "-1", "r1.fq.gz", "-2", "r2.fq.gz",
+         "--num-families", "300", "--family-size", "4",
+         "--read-length", "60", "--seed", "3"])
+    run(["extract", "-i", "r1.fq.gz", "r2.fq.gz", "-r", "8M+T", "+T",
+         "-o", "un.bam", "--sample", "s", "--library", "l"])
+    run(["sort", "-i", "un.bam", "-o", "s.bam",
+         "--order", "template-coordinate"])
+    run(["group", "-i", "s.bam", "-o", "g.bam", "--allow-unmapped",
+         "-f", "fam.txt", "-g", "gm.txt", "-M", "pre"])
+
+    gm = (d / "gm.txt").read_text().splitlines()
+    assert gm[0].split("\t") == [
+        "accepted_sam_records", "discarded_non_pf",
+        "discarded_poor_alignment", "discarded_ns_in_umi",
+        "discarded_umis_to_short"]
+    assert int(gm[1].split("\t")[0]) == 2400  # 300 fam x 4 pairs x 2
+
+    for path, field in ((d / "fam.txt", "family_size"),
+                        (d / "pre.family_sizes.txt", "family_size"),
+                        (d / "pre.position_group_sizes.txt",
+                         "position_group_size")):
+        lines = path.read_text().splitlines()
+        assert lines[0].split("\t") == [
+            field, "count", "fraction", f"fraction_gt_or_eq_{field}"]
+        first = lines[1].split("\t")
+        assert abs(float(first[3]) - 1.0) < 1e-9  # cumulative starts at 1
+    assert (d / "fam.txt").read_text() \
+        == (d / "pre.family_sizes.txt").read_text()
+    assert (d / "pre.grouping_metrics.txt").read_text() \
+        == (d / "gm.txt").read_text()

@@ -325,6 +325,9 @@ def _add_simplex(sub):
                         "emits MM/ML and cu/ct tags")
     p.add_argument("--taps", action="store_true",
                    help="TAPS methylation-aware calling (requires --ref)")
+    p.add_argument("--methylation-mode", choices=["em-seq", "taps"],
+                   default=None,
+                   help="reference spelling of --em-seq/--taps")
     p.add_argument("--ref", default=None,
                    help="reference FASTA (required for --em-seq/--taps)")
     p.add_argument("--batch-groups", type=int, default=2000,
@@ -378,6 +381,10 @@ def cmd_simplex(args):
         trim=args.trim,
         min_consensus_base_quality=args.min_consensus_base_quality,
     )
+    if args.methylation_mode == "em-seq":
+        args.em_seq = True
+    elif args.methylation_mode == "taps":
+        args.taps = True
     if args.em_seq and args.taps:
         log.error("--em-seq and --taps are mutually exclusive")
         return 2
@@ -544,6 +551,13 @@ def _add_duplex(sub):
                    help="device count for data-parallel SS dispatch: auto "
                         "(all visible) or an explicit N; 1 disables sharding "
                         "(fast engine only)")
+    p.add_argument("--methylation-mode", choices=["em-seq", "taps"],
+                   default=None,
+                   help="EM-Seq/TAPS methylation-aware duplex calling "
+                        "(requires --ref); emits per-strand am/au/at + "
+                        "bm/bu/bt and combined MM/ML + cu/ct tags")
+    p.add_argument("--ref", default=None,
+                   help="reference FASTA (required with --methylation-mode)")
     _add_pipeline_compat(p)
     p.set_defaults(func=cmd_duplex)
 
@@ -553,6 +567,25 @@ def cmd_duplex(args):
     from .core.grouper import consensus_pregroup_keep
     from .io.bam import BamHeader, BamReader, BamWriter
 
+    reference = None
+    ref_names = None
+    if args.methylation_mode:
+        if args.ref is None:
+            log.error("--ref is required with --methylation-mode")
+            return 2
+        from .core.reference import ReferenceReader
+        from .io.bam import BamReader as _BR
+
+        try:
+            reference = ReferenceReader(args.ref)
+        except OSError as e:
+            log.error("cannot read reference %s: %s", args.ref, e)
+            return 2
+        with _BR(args.input) as _r:
+            ref_names = _r.header.ref_names
+    elif args.ref is not None:
+        log.error("--ref requires --methylation-mode to be set")
+        return 2
     try:
         caller_kw = dict(
             min_reads=args.min_reads,
@@ -561,7 +594,9 @@ def cmd_duplex(args):
             max_reads_per_strand=args.max_reads_per_strand,
             error_rate_pre_umi=args.error_rate_pre_umi,
             error_rate_post_umi=args.error_rate_post_umi, seed=args.seed,
-            track_rejects=args.rejects is not None)
+            track_rejects=args.rejects is not None,
+            methylation_mode=args.methylation_mode,
+            reference=reference, ref_names=ref_names)
         caller = DuplexConsensusCaller(args.read_name_prefix,
                                        args.read_group_id, **caller_kw)
     except ValueError as e:
@@ -1659,6 +1694,22 @@ def _add_filter(sub):
                    default=True, type=_parse_bool)
     p.add_argument("-s", "--require-single-strand-agreement", nargs="?",
                    const=True, default=False, type=_parse_bool)
+    p.add_argument("--min-methylation-depth", default=None,
+                   help="EM-Seq/TAPS: mask bases whose methylation evidence "
+                        "(cu+ct) is below this; 1-3 comma values "
+                        "[duplex,AB,BA] (duplex also checks au+at / bu+bt)")
+    p.add_argument("--require-strand-methylation-agreement", nargs="?",
+                   const=True, default=False, type=_parse_bool,
+                   help="mask both positions of a CpG when top/bottom strand "
+                        "methylation calls disagree (duplex; requires --ref)")
+    p.add_argument("--min-conversion-fraction", type=float, default=None,
+                   help="reject reads whose conversion fraction at non-CpG "
+                        "ref-C positions is below this (requires --ref and "
+                        "--methylation-mode)")
+    p.add_argument("--methylation-mode", choices=["em-seq", "taps"],
+                   default=None,
+                   help="numerator convention for --min-conversion-fraction "
+                        "(em-seq: converted, taps: unconverted)")
     p.add_argument("--rejects", default=None, help="BAM for rejected reads")
     p.add_argument("-r", "--ref", default=None,
                    help="reference FASTA: regenerate NM/UQ/MD after masking "
@@ -1674,6 +1725,14 @@ def cmd_filter(args):
     from .consensus.filter import FilterConfig
     from .io.bam import BamReader, BamWriter
 
+    if args.min_conversion_fraction is not None and not args.methylation_mode:
+        log.error("--min-conversion-fraction requires --methylation-mode")
+        return 2
+    if (args.require_strand_methylation_agreement
+            or args.min_conversion_fraction is not None) and not args.ref:
+        log.error("--require-strand-methylation-agreement and "
+                  "--min-conversion-fraction require --ref")
+        return 2
     try:
         config = FilterConfig.new(
             [int(v) for v in args.min_reads.split(",")],
@@ -1682,7 +1741,13 @@ def cmd_filter(args):
             min_base_quality=args.min_base_quality,
             min_mean_base_quality=args.min_mean_base_quality,
             max_no_call_fraction=args.max_no_call_fraction,
-            require_ss_agreement=args.require_single_strand_agreement)
+            require_ss_agreement=args.require_single_strand_agreement,
+            methylation_depth=(args.min_methylation_depth.split(",")
+                               if args.min_methylation_depth else None),
+            require_strand_methylation_agreement=(
+                args.require_strand_methylation_agreement),
+            min_conversion_fraction=args.min_conversion_fraction,
+            methylation_mode=args.methylation_mode)
     except ValueError as e:
         log.error("%s", e)
         return 2

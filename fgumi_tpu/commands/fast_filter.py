@@ -240,6 +240,30 @@ class FastFilter:
             dmask = self._duplex_base_mask(ad, ae_b, bd, be_b, quals)
             mask |= duplex[:, None] & dmask & in_len
 
+        # EM-Seq/TAPS depth masking (filter.rs:952-1043): cu+ct below the
+        # first threshold; duplex rows additionally au+at / bu+bt. Rows
+        # without any cu/ct tag are untouched (no-tags no-op).
+        mdt = cfg.methylation_depth
+        simplex_meth = None
+        if mdt is not None:
+            cu, cu_p = per_base(b"cu")
+            ct, ct_p = per_base(b"ct")
+            has_meth = (cu_p | ct_p)[:, None] & in_len
+            meth_mask = has_meth & ((cu + ct) < mdt.duplex)
+            if duplex.any():
+                au, _ = per_base(b"au")
+                at, _ = per_base(b"at")
+                bu, _ = per_base(b"bu")
+                bt, _ = per_base(b"bt")
+                meth_mask |= has_meth & duplex[:, None] \
+                    & (((au + at) < mdt.ab) | ((bu + bt) < mdt.ba))
+            # duplex rows ride the skip-N pass below; simplex rows get a
+            # SECOND skip-N pass after the base mask (the reference's
+            # methylation masking always skips already-N positions,
+            # filter.rs:969-971, while simplex base masking does not)
+            mask |= meth_mask & duplex[:, None]
+            simplex_meth = meth_mask & ~duplex[:, None]
+
         skip_n = duplex  # duplex masking skips already-N positions
         newly = np.empty(n, dtype=np.int32)
         n_after = np.empty(n, dtype=np.int32)
@@ -249,6 +273,13 @@ class FastFilter:
                 nw, na = nb.apply_masks(batch, rows[group], mask[group], skip)
                 newly[group] = nw
                 n_after[group] = na
+        if simplex_meth is not None and simplex_meth.any():
+            g = np.nonzero(~duplex)[0]
+            if len(g):
+                nw2, na2 = nb.apply_masks(batch, rows[g], simplex_meth[g],
+                                          True)
+                newly[g] += nw2
+                n_after[g] = na2
         # simplex semantics: only mask when any bit set (mask_bases returns
         # early otherwise) — apply_masks is equivalent since no-bit rows
         # write nothing

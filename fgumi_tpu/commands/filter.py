@@ -72,8 +72,31 @@ def _process_one(data: bytes, config: FilterConfig, reverse_tags: bool,
         masked = mask_bases(buf, config.single_strand,
                             config.min_base_quality, rec=rec)
 
+    # EM-Seq/TAPS masking (filter.rs:827-880): depth first, then the
+    # reference-dependent CpG strand-agreement (duplex only)
+    if config.methylation_depth is not None:
+        from ..consensus.filter import mask_methylation_depth
+        masked += mask_methylation_depth(buf, rec, config.methylation_depth,
+                                         duplex)
+    ref_codes = None
+    needs_ref_codes = ((config.require_strand_methylation_agreement and duplex)
+                       or config.min_conversion_fraction is not None)
+    if needs_ref_codes and reference is not None:
+        from ..consensus.filter import resolve_ref_codes
+        ref_codes = resolve_ref_codes(rec, reference, ref_names)
+    if config.require_strand_methylation_agreement and duplex:
+        from ..consensus.filter import mask_strand_methylation_agreement
+        masked += mask_strand_methylation_agreement(buf, rec, ref_codes)
+
     if result == PASS:
         result = no_call_check(buf, config.max_no_call_fraction)
+    # read-level conversion-fraction filter (filter.rs:915-930)
+    if result == PASS and config.min_conversion_fraction is not None:
+        from ..consensus.filter import check_conversion_fraction
+        if not check_conversion_fraction(rec, config.min_conversion_fraction,
+                                         ref_codes,
+                                         config.methylation_mode):
+            result = "low_conversion"
     if reference is not None:
         # regenerate NM/UQ/MD after masking (filter.rs:881-883)
         from ..core.alignment_tags import regenerate_alignment_tags

@@ -45,6 +45,7 @@ class DuplexConsensusRead:
     ab_consensus: VanillaConsensusRead
     ba_consensus: Optional[VanillaConsensusRead]
     is_ba_only: bool = False
+    methylation: object = None  # combined MethylationAnnotation when enabled
 
 
 def parse_min_reads(values) -> tuple:
@@ -84,15 +85,19 @@ def duplex_combine(ab: Optional[VanillaConsensusRead], ba: Optional[VanillaConse
     if ba is not None and not (ba.depths[:length] > 0).any():
         ba = None
 
+    def strand_ann(c):
+        return c.methylation[0] if c is not None and c.methylation else None
+
     if ab is None and ba is None:
         return None
     if ba is None:
         return DuplexConsensusRead(id=ab.id, bases=ab.bases, quals=ab.quals,
-                                   errors=ab.errors, ab_consensus=ab, ba_consensus=None)
+                                   errors=ab.errors, ab_consensus=ab, ba_consensus=None,
+                                   methylation=strand_ann(ab))
     if ab is None:
         return DuplexConsensusRead(id=ba.id, bases=ba.bases, quals=ba.quals,
                                    errors=ba.errors, ab_consensus=ba, ba_consensus=None,
-                                   is_ba_only=True)
+                                   is_ba_only=True, methylation=strand_ann(ba))
 
     a_b = ab.bases[:length].astype(np.int32)
     b_b = ba.bases[:length].astype(np.int32)
@@ -104,14 +109,35 @@ def duplex_combine(ab: Optional[VanillaConsensusRead], ba: Optional[VanillaConse
     b_wins = (~agree) & (b_q > a_q)
     tie = (~agree) & (a_q == b_q)
 
-    raw_base = np.where(agree | a_wins, a_b, b_b)  # tie keeps a's base pre-mask
+    # EM-Seq/TAPS conversion artifact (duplex_caller.rs:897-925): a C/T (or
+    # G/A) cross-strand pair at a ref-C position is expected conversion, not
+    # a disagreement — call the unconverted base with summed quality and no
+    # error contribution
+    is_conv = np.zeros(length, dtype=bool)
+    unconv = np.zeros(length, dtype=np.int32)
+    if ab.methylation is not None or ba.methylation is not None:
+        from .methylation import A as _A, C as _C, G as _G, T as _T
+
+        is_ref_c = np.zeros(length, dtype=bool)
+        for strand in (ab, ba):
+            if strand.methylation is not None:
+                ann = strand.methylation[0]
+                n = min(length, len(ann.is_ref_c))
+                is_ref_c[:n] |= ann.is_ref_c[:n]
+        ct_pair = ((a_b == _C) & (b_b == _T)) | ((a_b == _T) & (b_b == _C))
+        ga_pair = ((a_b == _G) & (b_b == _A)) | ((a_b == _A) & (b_b == _G))
+        is_conv = (~agree) & is_ref_c & (ct_pair | ga_pair)
+        unconv = np.where(ct_pair, _C, _G).astype(np.int32)
+
+    raw_base = np.where(is_conv, unconv,
+                        np.where(agree | a_wins, a_b, b_b))
     raw_qual = np.where(
-        agree, np.clip(a_q + b_q, MIN_PHRED, MAX_PHRED),
+        (agree | is_conv), np.clip(a_q + b_q, MIN_PHRED, MAX_PHRED),
         np.where(a_wins, np.clip(a_q - b_q, MIN_PHRED, MAX_PHRED),
                  np.where(b_wins, np.clip(b_q - a_q, MIN_PHRED, MAX_PHRED), MIN_PHRED)))
 
     either_n = (a_b == N_CODE) | (b_b == N_CODE)
-    mask = either_n | (raw_qual == MIN_PHRED) | tie
+    mask = either_n | (raw_qual == MIN_PHRED) | (tie & ~is_conv)
     bases = np.where(mask, N_CODE, raw_base).astype(np.uint8)
     quals = np.where(mask, MIN_PHRED, raw_qual).astype(np.uint8)
 
@@ -134,12 +160,28 @@ def duplex_combine(ab: Optional[VanillaConsensusRead], ba: Optional[VanillaConse
                           np.where(raw_base == a_b, a_e + (b_d - b_e),
                                    b_e + (a_d - a_e)))
         errors = np.minimum(errors, I16_MAX)
+    # conversion artifacts count as agreement: no errors (rs:948-951)
+    if is_conv.any():
+        errors = np.where(is_conv, 0, errors)
 
-    truncate = lambda c: VanillaConsensusRead(
-        id=c.id, bases=c.bases[:length], quals=c.quals[:length],
-        depths=c.depths[:length], errors=c.errors[:length])
+    def truncate(c):
+        meth = c.methylation
+        if meth is not None:
+            meth = (meth[0].truncate(length), meth[1])
+        return VanillaConsensusRead(
+            id=c.id, bases=c.bases[:length], quals=c.quals[:length],
+            depths=c.depths[:length], errors=c.errors[:length],
+            methylation=meth)
+
+    combined = None
+    if ab.methylation is not None or ba.methylation is not None:
+        from . import methylation as meth_mod
+
+        combined = meth_mod.combine_annotations(strand_ann(ab), strand_ann(ba),
+                                                length)
     return DuplexConsensusRead(id=ab.id, bases=bases, quals=quals, errors=errors,
-                               ab_consensus=truncate(ab), ba_consensus=truncate(ba))
+                               ab_consensus=truncate(ab), ba_consensus=truncate(ba),
+                               methylation=combined)
 
 
 class DuplexConsensusCaller(RejectTracking):
@@ -150,21 +192,26 @@ class DuplexConsensusCaller(RejectTracking):
                  trim: bool = False, max_reads_per_strand: Optional[int] = None,
                  error_rate_pre_umi: int = 45, error_rate_post_umi: int = 40,
                  seed: Optional[int] = 42, kernel=None,
-                 track_rejects: bool = False):
+                 track_rejects: bool = False, methylation_mode=None,
+                 reference=None, ref_names=None):
         self.prefix = read_name_prefix
         self.read_group_id = read_group_id
         self.min_total, self.min_xy, self.min_yx = parse_min_reads(min_reads)
         self.produce_per_base_tags = produce_per_base_tags
         # SS caller: min_reads=1, min_consensus_qual=Q2 (duplex_caller.rs:400-420)
+        # methylation rides the SS caller's options/reference, exactly like
+        # the reference's with_methylation (duplex_caller.rs:437-448)
         ss_opts = VanillaOptions(
             error_rate_pre_umi=error_rate_pre_umi,
             error_rate_post_umi=error_rate_post_umi,
             min_input_base_quality=min_input_base_quality,
             min_reads=1, max_reads=max_reads_per_strand,
             produce_per_base_tags=produce_per_base_tags, seed=seed, trim=trim,
-            min_consensus_base_quality=MIN_PHRED)
+            min_consensus_base_quality=MIN_PHRED,
+            methylation_mode=methylation_mode)
         self.ss = VanillaConsensusCaller(read_name_prefix, read_group_id, ss_opts,
-                                         kernel=kernel)
+                                         kernel=kernel, reference=reference,
+                                         ref_names=ref_names)
         self.kernel = self.ss.kernel
         self.stats = CallerStats()
         self._init_rejects(track_rejects)
@@ -432,6 +479,39 @@ class DuplexConsensusCaller(RejectTracking):
                 all_umis.append("-".join(reversed(rx.split("-"))))
         if all_umis:
             b.tag_str(b"RX", consensus_umis(all_umis).encode())
+
+        # methylation tags (EM-Seq/TAPS; duplex_caller.rs:1251-1312): per
+        # strand am/au/at (top) / bm/bu/bt (bottom), then combined MM/ML +
+        # cu/ct. BA-only molecules store their strand in ab_consensus, so
+        # per-strand tags switch to bottom orientation.
+        if dup.methylation is not None:
+            from . import methylation as meth_mod
+
+            mode = self.ss.options.methylation_mode
+            is_top = not dup.is_ba_only
+            ab_meth = ab.methylation
+            if ab_meth is not None:
+                mm_tag, u_tag, t_tag = (b"am", b"au", b"at") if is_top \
+                    else (b"bm", b"bu", b"bt")
+                got = meth_mod.build_mm_ml(ab.bases, ab_meth[0], is_top, mode)
+                if got is not None:
+                    b.tag_str(mm_tag, got[0].encode())
+                b.tag_array_i16(u_tag, ab_meth[0].cu())
+                b.tag_array_i16(t_tag, ab_meth[0].ct())
+            if ba is not None and ba.methylation is not None:
+                ba_ann = ba.methylation[0]
+                got = meth_mod.build_mm_ml(ba.bases, ba_ann, False, mode)
+                if got is not None:
+                    b.tag_str(b"bm", got[0].encode())
+                b.tag_array_i16(b"bu", ba_ann.cu())
+                b.tag_array_i16(b"bt", ba_ann.ct())
+            got = meth_mod.build_mm_ml(dup.bases, dup.methylation, is_top,
+                                       mode)
+            if got is not None:
+                b.tag_str(b"MM", got[0].encode())
+                b.tag_array_u8(b"ML", np.frombuffer(got[1], dtype=np.uint8))
+            b.tag_array_i16(b"cu", dup.methylation.cu())
+            b.tag_array_i16(b"ct", dup.methylation.ct())
         return b.finish()
 
     # ---------------------------------------------------------------- driver

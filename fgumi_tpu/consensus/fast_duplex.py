@@ -236,7 +236,11 @@ class FastDuplexCaller:
         fl_both = paired & first & last
         fallback[g_of_row[fl_both]] = True
         max_rs = self.ss.options.max_reads
-        if self.caller.track_rejects:
+        if self.caller.track_rejects or self.ss.options.methylation_mode:
+            # methylation needs each read's CIGAR/position context for the
+            # reference annotation — the packed batch path strips it, so
+            # every molecule runs the classic per-molecule path (the same
+            # engineering choice as the simplex engine's _vector_ok gate)
             fallback[:] = True
 
         # per-row seg type (AB_R1..BA_R2); fragments and paired-but-neither

@@ -62,11 +62,19 @@ class FilterConfig:
     min_mean_base_quality: float | None
     max_no_call_fraction: float
     require_ss_agreement: bool = False
+    # EM-Seq/TAPS filters (filter.rs 905-1320); see the module tail
+    methylation_depth: object = None  # MethylationDepthThresholds | None
+    require_strand_methylation_agreement: bool = False
+    min_conversion_fraction: float | None = None
+    methylation_mode: str | None = None  # "em-seq" | "taps"
 
     @classmethod
     def new(cls, min_reads, max_read_error_rate, max_base_error_rate,
             min_base_quality=None, min_mean_base_quality=None,
-            max_no_call_fraction=0.2, require_ss_agreement=False):
+            max_no_call_fraction=0.2, require_ss_agreement=False,
+            methylation_depth=None,
+            require_strand_methylation_agreement=False,
+            min_conversion_fraction=None, methylation_mode=None):
         """Build from 1-3-valued options, validating tier ordering
         (filter.rs:237-330: depths high->low CC>=AB>=BA; error rates AB<=BA)."""
         mr = expand_three_from_last(min_reads)
@@ -98,7 +106,13 @@ class FilterConfig:
             min_base_quality=min_base_quality,
             min_mean_base_quality=min_mean_base_quality,
             max_no_call_fraction=max_no_call_fraction,
-            require_ss_agreement=require_ss_agreement)
+            require_ss_agreement=require_ss_agreement,
+            methylation_depth=(MethylationDepthThresholds.from_values(
+                methylation_depth) if methylation_depth else None),
+            require_strand_methylation_agreement=(
+                require_strand_methylation_agreement),
+            min_conversion_fraction=min_conversion_fraction,
+            methylation_mode=methylation_mode)
 
 
 def is_duplex_consensus(rec: RawRecord) -> bool:
@@ -362,3 +376,173 @@ def template_passes(records, pass_flags) -> bool:
         if not ok:
             return False
     return has_primary
+
+
+# ---------------------------------------------------------------------------
+# Methylation (EM-Seq/TAPS) filters — filter.rs (fgumi-consensus) 905-1320
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class MethylationDepthThresholds:
+    """1-3 values [duplex(cu+ct), AB(au+at), BA(bu+bt)], missing filled from
+    the last (MethylationDepthThresholds::from_values)."""
+
+    duplex: int
+    ab: int
+    ba: int
+
+    @classmethod
+    def from_values(cls, values):
+        d, a, b = expand_three_from_last([int(v) for v in values])
+        return cls(d, a, b)
+
+
+def _mask_positions(buf: bytearray, mask: np.ndarray) -> int:
+    """Apply a boolean mask to seq/qual in place; returns newly-masked
+    count — the shared tail of the methylation masking passes. Already-N
+    positions are skipped ENTIRELY (seq and qual untouched), matching the
+    reference's is_base_n continue (filter.rs:969,1024,1213) and the fast
+    engine's skip-N duplex masking."""
+    if not mask.any():
+        return 0
+    seq_off, qual_off, l_seq = _seq_qual_view(buf)
+    nib = _unpack_nibbles(buf, seq_off, l_seq).copy()
+    mask = mask & (nib != _N_NIBBLE)
+    if not mask.any():
+        return 0
+    masked = int(mask.sum())
+    nib[mask] = _N_NIBBLE
+    _write_nibbles(buf, seq_off, nib)
+    quals = np.frombuffer(buf, dtype=np.uint8, count=l_seq,
+                          offset=qual_off).copy()
+    quals[mask] = MIN_PHRED
+    buf[qual_off:qual_off + l_seq] = quals.tobytes()
+    return masked
+
+
+def mask_methylation_depth(buf: bytearray, rec: RawRecord,
+                           thresholds: MethylationDepthThresholds,
+                           duplex: bool) -> int:
+    """Mask bases whose methylation evidence depth is too low
+    (mask_methylation_depth_{simplex,duplex}_raw_with_tags): simplex checks
+    cu+ct against the first threshold; duplex additionally checks au+at and
+    bu+bt. No cu/ct tags at all -> no-op. Returns newly-masked count."""
+    _, _, l_seq = _seq_qual_view(buf)
+    if l_seq == 0:
+        return 0
+    cu = _per_base_padded(rec, b"cu", l_seq)
+    ct = _per_base_padded(rec, b"ct", l_seq)
+    if cu is None and ct is None:
+        return 0
+    z = np.zeros(l_seq)
+    total = (cu if cu is not None else z) + (ct if ct is not None else z)
+    mask = total < thresholds.duplex
+    if duplex:
+        for u_tag, t_tag, thr in ((b"au", b"at", thresholds.ab),
+                                  (b"bu", b"bt", thresholds.ba)):
+            u = _per_base_padded(rec, u_tag, l_seq)
+            t = _per_base_padded(rec, t_tag, l_seq)
+            mask |= ((u if u is not None else z)
+                     + (t if t is not None else z)) < thr
+    return _mask_positions(buf, mask)
+
+
+def resolve_ref_codes(rec: RawRecord, reference, ref_names):
+    """Per-query-position UPPERCASE reference base (bytes values) or None
+    for insertions/soft-clips; None for unmapped/unresolvable records
+    (resolve_ref_bases_for_record)."""
+    from ..io.bam import FLAG_UNMAPPED
+
+    if rec.flag & FLAG_UNMAPPED or rec.ref_id < 0 \
+            or rec.ref_id >= len(ref_names):
+        return None
+    ref_seq = reference.get(ref_names[rec.ref_id]) \
+        if hasattr(reference, "get") else None
+    if ref_seq is None:
+        return None
+    _, _, l_seq = _seq_qual_view(rec.data)
+    out = []
+    ref_pos = rec.pos  # 0-based
+    for op, n in rec.cigar():
+        if op in "M=X":
+            for _ in range(n):
+                b = ref_seq[ref_pos] if 0 <= ref_pos < len(ref_seq) else None
+                out.append(b & ~0x20 if isinstance(b, int) and 0x61 <= b <= 0x7a
+                           else b)
+                ref_pos += 1
+        elif op in "IS":
+            out.extend([None] * n)
+        elif op in "DN":
+            ref_pos += n
+    del out[l_seq:]
+    while len(out) < l_seq:
+        out.append(None)
+    return out
+
+
+def mask_strand_methylation_agreement(buf: bytearray, rec: RawRecord,
+                                      ref_codes) -> int:
+    """Mask BOTH positions of a CpG dinucleotide when the top strand's
+    methylation call (au/at at the C) disagrees with the bottom strand's
+    (bu/bt at the G); majority rule unconverted>converted, positions with
+    no evidence on either strand are skipped
+    (mask_strand_methylation_agreement_raw_with_ref_bases_and_tags)."""
+    if ref_codes is None:
+        return 0
+    _, _, l_seq = _seq_qual_view(buf)
+    au = _per_base_padded(rec, b"au", l_seq)
+    at = _per_base_padded(rec, b"at", l_seq)
+    bu = _per_base_padded(rec, b"bu", l_seq)
+    bt = _per_base_padded(rec, b"bt", l_seq)
+    if au is None and bu is None:
+        return 0
+    z = np.zeros(l_seq)
+    au = au if au is not None else z
+    at = at if at is not None else z
+    bu = bu if bu is not None else z
+    bt = bt if bt is not None else z
+    mask = np.zeros(l_seq, dtype=bool)
+    for i in range(l_seq - 1):
+        if ref_codes[i] != ord("C") or ref_codes[i + 1] != ord("G"):
+            continue
+        top_total = au[i] + at[i]
+        bot_total = bu[i + 1] + bt[i + 1]
+        if top_total == 0 or bot_total == 0:
+            continue
+        if (au[i] > at[i]) != (bu[i + 1] > bt[i + 1]):
+            mask[i] = True
+            mask[i + 1] = True
+    return _mask_positions(buf, mask)
+
+
+def check_conversion_fraction(rec: RawRecord, min_fraction: float,
+                              ref_codes, mode: str) -> bool:
+    """Read-level conversion check over non-CpG ref-C positions with cu/ct
+    evidence: EM-Seq requires converted/total >= threshold (complete
+    conversion = good library), TAPS unconverted/total (non-CpG Cs should
+    stay; check_conversion_fraction_raw_with_ref_bases_and_tags). Records
+    without tags / reference mapping pass."""
+    if not mode or ref_codes is None:
+        return True
+    _, _, l_seq = _seq_qual_view(rec.data)
+    cu = _per_base_padded(rec, b"cu", l_seq)
+    ct = _per_base_padded(rec, b"ct", l_seq)
+    if cu is None and ct is None:
+        return True
+    z = np.zeros(l_seq)
+    cu = cu if cu is not None else z
+    ct = ct if ct is not None else z
+    num = 0.0
+    tot = 0.0
+    for i in range(l_seq):
+        if ref_codes[i] != ord("C"):
+            continue
+        if i + 1 < l_seq and ref_codes[i + 1] == ord("G"):
+            continue  # CpG sites are where real methylation lives — skip
+        ev = cu[i] + ct[i]
+        if ev > 0:
+            num += cu[i] if mode == "taps" else ct[i]
+            tot += ev
+    if tot == 0:
+        return True
+    return num / tot >= min_fraction

@@ -128,6 +128,24 @@ def normalize_source_reads(source_reads, annotation: MethylationAnnotation,
         sr.codes[:n][mask] = unconv
 
 
+def combine_annotations(ab, ba, length: int) -> MethylationAnnotation:
+    """Duplex combine: per-position count sums with OR'd ref-C flags over
+    the truncated strand annotations; an absent strand contributes zeros
+    (combine_methylation_annotations, methylation.rs:400-427)."""
+    is_ref_c = np.zeros(length, dtype=bool)
+    unconverted = np.zeros(length, dtype=np.int64)
+    converted = np.zeros(length, dtype=np.int64)
+    for ann in (ab, ba):
+        if ann is None:
+            continue
+        n = min(length, len(ann.is_ref_c))
+        is_ref_c[:n] |= ann.is_ref_c[:n]
+        unconverted[:n] += ann.unconverted[:n]
+        converted[:n] += ann.converted[:n]
+    return MethylationAnnotation(is_ref_c=is_ref_c, unconverted=unconverted,
+                                 converted=converted)
+
+
 def build_mm_ml(consensus_codes: np.ndarray, annotation: MethylationAnnotation,
                 is_top: bool, mode: str):
     """SAM MM:Z + ML:B:C tags, or None when no ref-C position carries evidence

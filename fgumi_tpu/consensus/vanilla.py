@@ -128,6 +128,7 @@ class VanillaConsensusRead:
     depths: np.ndarray  # int64, already clamped to I16_MAX per base
     errors: np.ndarray  # int64, already clamped to I16_MAX per base
     source_reads: list = None
+    methylation: object = None  # (MethylationAnnotation, is_top) when enabled
 
     def max_depth(self) -> int:
         return int(self.depths.max()) if len(self.depths) else 0
@@ -404,13 +405,18 @@ class VanillaConsensusCaller(RejectTracking):
             capped = self._downsample(source_reads, rng)
         if len(capped) < opts.min_reads:
             return None
+        # methylation annotate + normalize on the scoring set (the duplex
+        # SS stage's analog of prepare_group's annotation; duplex_caller.rs
+        # routes methylation through ss_caller.options)
+        meth = self._annotate_methylation(capped)
         lengths = sorted((len(sr.codes) for sr in capped), reverse=True)
         consensus_len = lengths[opts.min_reads - 1]
         return ConsensusJob(
             umi=umi, read_type=read_type,
             codes=[sr.codes for sr in capped], quals=[sr.quals for sr in capped],
             consensus_len=consensus_len, original_raws=[],
-            source_reads=source_reads if keep_source_reads else None)
+            source_reads=source_reads if keep_source_reads else None,
+            methylation=meth)
 
     def result_to_consensus_read(self, job: ConsensusJob, result) -> VanillaConsensusRead:
         """Wrap a job's (already thresholded) _run_jobs outputs as a
@@ -420,7 +426,7 @@ class VanillaConsensusCaller(RejectTracking):
         return VanillaConsensusRead(
             id=job.umi, bases=np.asarray(bases), quals=np.asarray(quals),
             depths=np.minimum(depth, I16_MAX), errors=np.minimum(errors, I16_MAX),
-            source_reads=job.source_reads)
+            source_reads=job.source_reads, methylation=job.methylation)
 
     # ------------------------------------------------------------------ device
 

@@ -33,6 +33,18 @@ def _build_mapped_record(name, flag, ref_id, pos, mapq, cigar_ops, seq, quals,
             buf += tag + b"Z" + value + b"\x00"
         elif typ == "i":
             buf += tag + b"i" + struct.pack("<i", value)
+        elif typ == "f":
+            buf += tag + b"f" + struct.pack("<f", value)
+        elif typ == "B":
+            arr = np.asarray(value)
+            sub = {np.dtype(np.int16): b"s", np.dtype(np.uint16): b"S",
+                   np.dtype(np.int8): b"c", np.dtype(np.uint8): b"C",
+                   np.dtype(np.int32): b"i", np.dtype(np.uint32): b"I",
+                   np.dtype(np.float32): b"f"}[arr.dtype]
+            buf += tag + b"B" + sub + struct.pack("<I", len(arr))
+            buf += arr.tobytes()
+        else:
+            raise ValueError(f"unsupported tag type {typ!r}")
     return bytes(buf)
 
 

@@ -154,3 +154,197 @@ def test_simplex_em_seq_cli_e2e(tmp_path):
     # error counts do not include the normalized conversion
     _, ce = rec.find_tag(b"ce")
     assert ce[9] == 0
+
+
+def test_duplex_em_seq_cli_e2e(tmp_path):
+    """Duplex methylation (duplex_caller.rs:1251-1312): per-strand am/au/at
+    (top) + bm/bu/bt (bottom) and combined MM/ML + cu/ct on the duplex
+    consensus; conversion evidence from each strand lands in the combined
+    counts."""
+    from fgumi_tpu.cli import main
+    from fgumi_tpu.core.reference import write_fasta
+
+    ref_seq = b"ACGTACGTACCGTACGTACG"
+    fasta = str(tmp_path / "ref.fa")
+    write_fasta(fasta, {"chr1": ref_seq})
+
+    header = BamHeader(
+        text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n@SQ\tSN:chr1\tLN:20\n"
+             "@RG\tID:A\tSM:s\n",
+        ref_names=["chr1"], ref_lengths=[20])
+    in_bam = str(tmp_path / "in.bam")
+    L = 20
+    q = np.full(L, 30, np.uint8)
+    conv9 = bytearray(ref_seq)
+    conv9[9] = ord("T")   # top-strand C->T conversion at ref-C 9
+    conv11 = bytearray(ref_seq)
+    conv11[11] = ord("A")  # bottom-strand G->A conversion at ref-G 11
+
+    def rec(name, flags, seq, mi):
+        return _build_mapped_record(
+            name, flags, 0, 0, 60, [("M", L)], bytes(seq), q, 0, 0, L,
+            [(b"MI", "Z", mi), (b"RG", "Z", b"A")])
+
+    R1F = FLAG_PAIRED | FLAG_FIRST
+    R2R = FLAG_PAIRED | FLAG_LAST | FLAG_REVERSE
+    R1R = FLAG_PAIRED | FLAG_FIRST | FLAG_REVERSE
+    R2F = FLAG_PAIRED | FLAG_LAST
+    with BamWriter(in_bam, header) as w:
+        # A strand (top): two templates; one R1 carries the C->T conversion
+        w.write_record_bytes(rec(b"a0", R1F, ref_seq, b"1/A"))
+        w.write_record_bytes(rec(b"a0", R2R, ref_seq, b"1/A"))
+        w.write_record_bytes(rec(b"a1", R1F, conv9, b"1/A"))
+        w.write_record_bytes(rec(b"a1", R2R, ref_seq, b"1/A"))
+        # B strand (bottom): one R2 carries the G->A conversion
+        w.write_record_bytes(rec(b"b0", R1R, ref_seq, b"1/B"))
+        w.write_record_bytes(rec(b"b0", R2F, ref_seq, b"1/B"))
+        w.write_record_bytes(rec(b"b1", R1R, ref_seq, b"1/B"))
+        w.write_record_bytes(rec(b"b1", R2F, conv11, b"1/B"))
+
+    out_bam = str(tmp_path / "out.bam")
+    rc = main(["duplex", "-i", in_bam, "-o", out_bam, "--min-reads", "1",
+               "--methylation-mode", "em-seq", "--ref", fasta,
+               "--consensus-call-overlapping-bases", "false"])
+    assert rc == 0
+    with BamReader(out_bam) as r:
+        recs = list(r)
+    assert len(recs) == 2  # R1 + R2 duplex consensus
+    r1 = next(r for r in recs if r.flag & FLAG_FIRST)
+    # conversions normalized away: consensus equals the reference
+    assert r1.seq_bytes() == ref_seq
+    # per-strand tags: AB (top) am/au/at, BA (bottom) bm/bu/bt
+    am = r1.get_str(b"am")
+    bm = r1.get_str(b"bm")
+    assert am is not None and am.startswith("C+m")
+    assert bm is not None and bm.startswith("G-m")
+    _, au = r1.find_tag(b"au")
+    _, at = r1.find_tag(b"at")
+    _, bu = r1.find_tag(b"bu")
+    _, bt = r1.find_tag(b"bt")
+    # AB_R1 strand: one of two reads converted at ref-C 9
+    assert au[9] == 1 and at[9] == 1 and au[5] == 2 and at[5] == 0
+    # BA_R2 strand: one of two reads converted at ref-G 11
+    assert bu[11] == 1 and bt[11] == 1 and bu[6] == 2 and bt[6] == 0
+    # combined: sums of the two strands at each position
+    _, cu = r1.find_tag(b"cu")
+    _, ct = r1.find_tag(b"ct")
+    assert cu[9] == 1 and ct[9] == 1
+    assert cu[11] == 1 and ct[11] == 1
+    assert cu[5] == 2 and ct[5] == 0
+    mm = r1.get_str(b"MM")
+    assert mm is not None and mm.startswith("C+m")
+    typ, ml = r1.find_tag(b"ML")
+    assert typ == "B"
+
+
+def test_filter_methylation_depth_and_conversion(tmp_path):
+    """--min-methylation-depth masks low-evidence bases (fast==classic on
+    unmapped input); --min-conversion-fraction rejects poorly converted
+    reads using non-CpG ref-C positions (classic path, mapped + --ref)."""
+    import hashlib
+
+    from fgumi_tpu.cli import main
+    from fgumi_tpu.core.reference import write_fasta
+    from fgumi_tpu.io.bam import RecordBuilder
+
+    # --- unmapped simplex consensus with cu/ct: depth mask parity
+    header = BamHeader(
+        text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n@RG\tID:A\tSM:s\n",
+        ref_names=[], ref_lengths=[])
+    in_bam = str(tmp_path / "in.bam")
+    L = 8
+    with BamWriter(in_bam, header) as w:
+        b = RecordBuilder().start_unmapped(b"c0", 0x4, b"ACGTACGT",
+                                           np.full(L, 30, np.uint8))
+        b.tag_str(b"MI", b"1")
+        b.tag_str(b"RG", b"A")
+        b.tag_int(b"cD", 3)
+        b.tag_float(b"cE", 0.0)
+        b.tag_array_i16(b"cu", np.array([2, 2, 0, 1, 2, 2, 2, 2], np.int16))
+        b.tag_array_i16(b"ct", np.array([0, 0, 0, 0, 0, 1, 0, 0], np.int16))
+        w.write_record_bytes(b.finish())
+    outs = {}
+    for label, extra in (("fast", []), ("classic", ["--classic"])):
+        out = str(tmp_path / f"{label}.bam")
+        rc = main(["filter", "-i", in_bam, "-o", out, "--min-reads", "1",
+                   "--max-no-call-fraction", "0.5",
+                   "--min-methylation-depth", "2"] + extra)
+        assert rc == 0
+        with BamReader(out) as r:
+            recs = list(r)
+        assert len(recs) == 1
+        # positions 2 (cu+ct=0) and 3 (=1) masked to N/Q2
+        assert recs[0].seq_bytes() == b"ACNNACGT", label
+        outs[label] = hashlib.sha256(open(out, "rb").read()).hexdigest()
+    assert outs["fast"] == outs["classic"]
+
+    # --- mapped consensus with low conversion at non-CpG Cs -> rejected
+    ref_seq = b"AACTACTTACCGTTTTTTTT"  # non-CpG Cs at 2,5,9; CpG C at 10
+    fasta = str(tmp_path / "ref.fa")
+    write_fasta(fasta, {"chr1": ref_seq})
+    header2 = BamHeader(
+        text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n@SQ\tSN:chr1\tLN:20\n"
+             "@RG\tID:A\tSM:s\n",
+        ref_names=["chr1"], ref_lengths=[20])
+    in2 = str(tmp_path / "in2.bam")
+    with BamWriter(in2, header2) as w:
+        for name, cu_noncpg in ((b"good", 0), (b"bad", 2)):
+            # good: non-CpG Cs fully converted (ct=2, cu=0); bad: unconverted
+            cu = np.zeros(20, np.int16)
+            ct = np.zeros(20, np.int16)
+            for p in (2, 5, 9):
+                cu[p] = cu_noncpg
+                ct[p] = 2 - cu_noncpg
+            cu[10] = 2  # CpG C: methylated, must NOT count against the read
+            from fgumi_tpu.simulate import _build_mapped_record
+            w.write_record_bytes(_build_mapped_record(
+                name, 0, 0, 0, 60, [("M", 20)], ref_seq,
+                np.full(20, 30, np.uint8), -1, -1, 0,
+                [(b"MI", "Z", b"1"), (b"RG", "Z", b"A"),
+                 (b"cD", "i", 3), (b"cE", "f", 0.0),
+                 (b"cu", "B", cu), (b"ct", "B", ct)]))
+    out2 = str(tmp_path / "out2.bam")
+    rc = main(["filter", "-i", in2, "-o", out2, "--min-reads", "1",
+               "--ref", fasta, "--methylation-mode", "em-seq",
+               "--min-conversion-fraction", "0.8",
+               "--filter-by-template", "false"])
+    assert rc == 0
+    with BamReader(out2) as r:
+        kept = [r_.name for r_ in r]
+    assert kept == [b"good"]
+
+
+def test_duplex_combine_conversion_pair():
+    """Cross-strand C/T at a ref-C position is expected conversion, not a
+    disagreement (duplex_caller.rs:897-925): the unconverted base is called
+    with summed quality and zero errors; without annotation the same pair
+    is an equal-quality tie -> N."""
+    from fgumi_tpu.consensus.duplex import duplex_combine
+    from fgumi_tpu.consensus.methylation import MethylationAnnotation
+    from fgumi_tpu.consensus.vanilla import VanillaConsensusRead
+
+    L = 4
+    # position 1: AB=C, BA=T (equal qual); position 2: real disagreement A/G
+    ab_bases = codes("ACAT")
+    ba_bases = codes("ATGT")
+
+    def vcr(bases, ann):
+        return VanillaConsensusRead(
+            id="1", bases=bases, quals=np.full(L, 30, np.uint8),
+            depths=np.full(L, 2, np.int64), errors=np.zeros(L, np.int64),
+            methylation=ann)
+
+    ann = (MethylationAnnotation(
+        is_ref_c=np.array([False, True, False, False]),
+        unconverted=np.array([0, 1, 0, 0]), converted=np.array([0, 1, 0, 0])),
+        True)
+    dup = duplex_combine(vcr(ab_bases, ann), vcr(ba_bases, ann))
+    assert dup.bases[1] == C          # unconverted base wins
+    assert dup.quals[1] == 60         # summed quality
+    assert dup.errors[1] == 0         # conversion is not an error
+    assert dup.bases[2] == 4          # A/G tie without ref-C -> N
+    assert dup.quals[2] == 2
+
+    # same pair WITHOUT annotation: ordinary tie -> N
+    dup2 = duplex_combine(vcr(ab_bases, None), vcr(ba_bases, None))
+    assert dup2.bases[1] == 4 and dup2.quals[1] == 2

@@ -1002,6 +1002,17 @@ def _add_group(sub):
     p.add_argument("--min-umi-length", type=int, default=None)
     p.add_argument("--no-umi", action="store_true")
     p.add_argument("--allow-unmapped", action="store_true")
+    p.add_argument("--index-threshold", type=int, default=None,
+                   help="minimum distinct UMIs per group before the indexed "
+                        "candidate search (pigeonhole/BK-tree) replaces the "
+                        "dense pairwise scan; 0 = always dense. Default is "
+                        "measured for the vectorized scan (8192)")
+    p.add_argument("--parallel-group-min-templates", default=None,
+                   metavar="N|auto",
+                   help="accepted for compatibility: this engine "
+                        "auto-selects its vectorized/device assigner by "
+                        "group size, so the parallel-assigner cutover knob "
+                        "has no separate schedule to tune")
     p.add_argument("-f", "--family-size-histogram", default=None,
                    help="optional TSV of the family size distribution "
                         "(fgbio format: count/fraction/cumulative)")
@@ -1034,6 +1045,10 @@ def cmd_group(args):
 
     from .native import batch as nbat
 
+    if getattr(args, "index_threshold", None) is not None:
+        from .umi.assigners import set_index_threshold
+
+        set_index_threshold(args.index_threshold)
     use_fast = nbat.available() and not getattr(args, "classic", False)
     t0 = time.monotonic()
     if use_fast:
@@ -1138,7 +1153,36 @@ def cmd_group(args):
 def _add_sort(sub):
     p = sub.add_parser("sort", help="Sort a BAM (coordinate/queryname/template-coordinate)")
     p.add_argument("-i", "--input", required=True)
-    p.add_argument("-o", "--output", required=True)
+    p.add_argument("-o", "--output", default=None,
+                   help="output BAM (not needed with --verify)")
+    p.add_argument("--verify", nargs="?", const=True, default=False,
+                   type=_parse_bool,
+                   help="verify the input satisfies --order (no output "
+                        "written); exits non-zero on the first out-of-order "
+                        "record")
+    p.add_argument("--sort-threads", type=int, default=None,
+                   help="threads for the sort/spill phase (defaults to "
+                        "--threads; scheduling only, output byte-identical)")
+    p.add_argument("--merge-threads", type=int, default=None,
+                   help="threads for the merge/output phase (defaults to "
+                        "--threads; scheduling only, output byte-identical)")
+    p.add_argument("--max-temp-files", type=int, default=None,
+                   help="advisory cap on spill runs (the k-way merge here "
+                        "streams any run count; values < 2 are rejected)")
+    p.add_argument("--temp-codec", default="deflate",
+                   help="spill codec: deflate (libdeflate). zstd is not "
+                        "available in this build and is rejected loudly")
+    p.add_argument("--temp-compression", type=int, default=1,
+                   help="accepted for compatibility (0-9 validated): spill "
+                        "frames here always use deflate level 1, the "
+                        "measured throughput/size sweet spot for "
+                        "merge-once temporaries")
+    p.add_argument("--key-types", default="full",
+                   help="sort-key lanes: full (default; library+MI lanes, "
+                        "the layout this engine always builds). Lane "
+                        "subsetting is not supported here — any other value "
+                        "is rejected loudly rather than silently changing "
+                        "grouping semantics")
     p.add_argument("--threads", type=int, default=0,
                    help="N > 1 runs N-1 background spill workers: Phase-1 "
                         "sort/compress/write overlaps ingest "
@@ -1194,6 +1238,47 @@ def cmd_sort(args):
 
     from .utils.memory import parse_size
 
+    if args.key_types.strip().lower() not in ("full", "library,mi",
+                                              "library mi", "mi,library"):
+        log.error("--key-types %s: this engine always builds the full "
+                  "library+MI key layout; lane subsetting would silently "
+                  "change grouping semantics and is not supported",
+                  args.key_types)
+        return 2
+    if args.temp_codec.strip().lower() not in ("deflate", "libdeflate"):
+        log.error("--temp-codec %s: only deflate (libdeflate) is available "
+                  "in this build (zstd is not in the image)", args.temp_codec)
+        return 2
+    if not 0 <= args.temp_compression <= 9:
+        log.error("--temp-compression must be 0-9")
+        return 2
+    if args.max_temp_files is not None and args.max_temp_files < 2:
+        log.error("--max-temp-files must be >= 2")
+        return 2
+    if args.verify:
+        # verify-only mode (sort.rs:207-212): key monotonicity against the
+        # REQUESTED --order over the packed byte keys, no output written
+        with BamReader(args.input) as reader:
+            key_fn = make_key_bytes_fn(args.order, reader.header,
+                                       args.subsort)
+            prev = b""
+            for i, rec in enumerate(reader):
+                k = key_fn(rec)
+                if k < prev:
+                    log.error("sort --verify: record %d out of %s order",
+                              i, args.order)
+                    return 1
+                prev = k
+        log.info("sort --verify: input satisfies %s order", args.order)
+        return 0
+    if args.output is None:
+        log.error("-o/--output is required (unless --verify)")
+        return 2
+    if args.sort_threads is not None or args.merge_threads is not None:
+        # scheduling-only knobs: this engine's worker pool serves both
+        # phases, so the wider of the two sizes it
+        args.threads = max(args.threads, args.sort_threads or 0,
+                           args.merge_threads or 0)
     try:
         budget = resolve_budget(args.max_memory, parse_size(args.memory_reserve))
     except ValueError as e:
@@ -1310,7 +1395,10 @@ def cmd_sort(args):
 
 def _add_merge(sub):
     p = sub.add_parser("merge", help="Merge same-order sorted BAMs")
-    p.add_argument("-i", "--input", required=True, nargs="+")
+    p.add_argument("-i", "--input", nargs="+", default=[])
+    p.add_argument("--input-list", default=None,
+                   help="file with one input BAM path per line (combined "
+                        "with -i)")
     p.add_argument("-o", "--output", required=True)
     p.add_argument("--order", default="template-coordinate",
                    choices=["coordinate", "queryname", "template-coordinate"])
@@ -1325,6 +1413,23 @@ def cmd_merge(args):
 
     from .core.template import _hd_fields
 
+    if args.input_list:
+        try:
+            with open(args.input_list) as f:
+                stripped = (line.strip() for line in f)
+                args.input = list(args.input) + [
+                    s for s in stripped if s and not s.startswith("#")]
+        except OSError as e:
+            log.error("cannot read --input-list %s: %s", args.input_list, e)
+            return 2
+        missing = [p for p in args.input if not os.path.exists(p)]
+        if missing:
+            log.error("--input-list names missing file(s): %s",
+                      ", ".join(missing[:5]))
+            return 2
+    if not args.input:
+        log.error("no inputs: pass -i and/or --input-list")
+        return 2
     readers = [BamReader(path) for path in args.input]
     try:
         first = readers[0].header
@@ -1392,21 +1497,62 @@ def cmd_merge(args):
 
 
 def _add_fastq(sub):
+    def _flags(s):
+        return int(s, 16) if s.lower().startswith("0x") else int(s)
+
     p = sub.add_parser("fastq", help="BAM -> mate-paired interleaved FASTQ")
     p.add_argument("-i", "--input", required=True)
     p.add_argument("-o", "--output", default="-", help="output FASTQ (- for stdout)")
+    p.add_argument("-n", "--no-read-suffix", nargs="?", const=True,
+                   default=False, type=_parse_bool,
+                   help="don't append /1 and /2 to read names")
+    p.add_argument("-F", "--exclude-flags", type=_flags, default=0x900,
+                   help="exclude reads with ANY of these flags "
+                        "(default 0x900 = secondary|supplementary)")
+    p.add_argument("-f", "--require-flags", type=_flags, default=0,
+                   help="only include reads with ALL of these flags")
+    p.add_argument("-a", "-U", "--annotate-read-names", nargs="?", const=True,
+                   default=False, type=_parse_bool,
+                   help="append the UMI to the read name before any /1 "
+                        "suffix (samtools fastq -U / DRAGEN layout)")
+    p.add_argument("--umi-tag", default="RX,OX",
+                   help="comma list of tags to read the UMI from, first "
+                        "present wins")
+    p.add_argument("--umi-name-delim", default=":",
+                   help="delimiter between read name and UMI")
+    p.add_argument("--umi-sep", default="+",
+                   help="duplex-UMI half separator in the read name "
+                        "(stored '-' is rewritten to this)")
+    p.add_argument("-K", "--bwa-chunk-size", type=int, default=150000000,
+                   help="accepted for compatibility (bwa -K output buffer "
+                        "sizing hint)")
     _add_pipeline_compat(p)
     p.set_defaults(func=cmd_fastq)
 
 
 def cmd_fastq(args):
     from .constants import reverse_complement_bytes
-    from .io.bam import BamReader, FLAG_FIRST, FLAG_REVERSE, FLAG_SECONDARY, FLAG_SUPPLEMENTARY
+    from .io.bam import BamReader, FLAG_FIRST, FLAG_REVERSE
 
     from .io.bam import FLAG_LAST, FLAG_PAIRED
 
     out = sys.stdout.buffer if args.output == "-" else open(args.output, "wb")
     n = 0
+    umi_tags = [t.strip().encode() for t in args.umi_tag.split(",")
+                if t.strip()]
+    name_delim = args.umi_name_delim.encode()
+    umi_sep = args.umi_sep.encode()
+    exclude = args.exclude_flags
+    require = args.require_flags
+
+    def umi_of(rec):
+        for tag in umi_tags:
+            v = rec.get_str(tag)
+            if v:
+                # stored duplex UMIs use '-' between halves; aligner-facing
+                # names use --umi-sep (DRAGEN/samtools '+')
+                return v.replace("-", umi_sep.decode()).encode()
+        return None
 
     def emit(rec):
         nonlocal n
@@ -1415,19 +1561,36 @@ def cmd_fastq(args):
         if rec.flag & FLAG_REVERSE:
             seq = reverse_complement_bytes(seq)
             quals = quals[::-1]
-        suffix = b"/1" if rec.flag & FLAG_FIRST else (
-            b"/2" if rec.flag & FLAG_LAST else b"")
-        out.write(b"@" + rec.name + suffix + b"\n" + seq + b"\n+\n"
+        name = rec.name
+        if args.annotate_read_names:
+            umi = umi_of(rec)
+            if umi:
+                name = name + name_delim + umi
+        suffix = b""
+        if not args.no_read_suffix:
+            suffix = b"/1" if rec.flag & FLAG_FIRST else (
+                b"/2" if rec.flag & FLAG_LAST else b"")
+        out.write(b"@" + name + suffix + b"\n" + seq + b"\n+\n"
                   + (quals + 33).tobytes() + b"\n")
         n += 1
 
     # R1/R2 are interleaved adjacently by buffering each read until its mate
     # arrives (mates may be far apart in coordinate-sorted input)
+    from .io.bam import FLAG_SECONDARY, FLAG_SUPPLEMENTARY
+
     pending = {}
     try:
         with BamReader(args.input) as reader:
             for rec in reader:
+                if (rec.flag & exclude) or (rec.flag & require) != require:
+                    continue
                 if rec.flag & (FLAG_SECONDARY | FLAG_SUPPLEMENTARY):
+                    # a non-default -F may admit secondary/supplementary
+                    # records: they are emitted verbatim but NEVER enter the
+                    # name-keyed mate pairing (a supplementary R1 would
+                    # otherwise pair with its own primary and corrupt the
+                    # interleaving)
+                    emit(rec)
                     continue
                 if not rec.flag & FLAG_PAIRED:
                     emit(rec)
@@ -1594,6 +1757,18 @@ def _add_zipper(sub):
     p.add_argument("--exclude-missing-reads", nargs="?", const=True,
                    default=False, type=_parse_bool,
                    help="drop unmapped-BAM reads the aligner omitted")
+    p.add_argument("--restore-unconverted-bases", nargs="?", const=True,
+                   default=False, type=_parse_bool,
+                   help="EM-Seq: rewrite converted bases back to the "
+                        "unconverted reference form at aligned ref-C/ref-G "
+                        "positions after bwameth re-alignment (uses the "
+                        "bwameth YD strand tag; requires --ref)")
+    p.add_argument("-r", "--ref", default=None,
+                   help="reference FASTA (required with "
+                        "--restore-unconverted-bases)")
+    p.add_argument("-K", "--bwa-chunk-size", type=int, default=150000000,
+                   help="accepted for compatibility (bwa -K stdin buffer "
+                        "sizing hint; this reader sizes buffers adaptively)")
     p.add_argument("--classic", action="store_true",
                    help="force the per-template engine (no batch "
                         "vectorization)")
@@ -1611,9 +1786,25 @@ def cmd_zipper(args):
         revcomp=args.tags_to_revcomp)
     from .native import batch as nbat
 
+    restore = None
+    if args.restore_unconverted_bases:
+        if args.ref is None:
+            log.error("--restore-unconverted-bases requires --ref")
+            return 2
+        from .core.reference import ReferenceReader
+
+        try:
+            restore_ref = ReferenceReader(args.ref)
+        except OSError as e:
+            log.error("cannot read reference %s: %s", args.ref, e)
+            return 2
+        with BamReader(args.input) as _r:
+            restore = (restore_ref, _r.header.ref_names)
     # the batch engine's staged-append model cannot express static removal
-    # of the tags it itself appends (MQ/MC/ms/AS/XS) -> classic engine there
+    # of the tags it itself appends (MQ/MC/ms/AS/XS) -> classic engine
+    # there; the EM-Seq restore also runs per record in the classic engine
     use_fast = (nbat.available() and not getattr(args, "classic", False)
+                and restore is None
                 and not (tag_info.remove & {"MQ", "MC", "ms", "AS", "XS"}))
     if nbat.available():
         from .io.batch_reader import BatchedRecordReader as _Reader
@@ -1659,7 +1850,8 @@ def cmd_zipper(args):
                     n_templates, n_records, n_missing = run_zipper(
                         mapped, unmapped, writer, tag_info,
                         skip_tc_tags=args.skip_tc_tags,
-                        exclude_missing_reads=args.exclude_missing_reads)
+                        exclude_missing_reads=args.exclude_missing_reads,
+                        restore_unconverted=restore)
     except (ValueError, OSError) as e:
         log.error("%s", e)
         return 2
@@ -2298,6 +2490,10 @@ def _add_dedup(sub):
     p.add_argument("-l", "--min-umi-length", type=int, default=None)
     p.add_argument("--no-umi", action="store_true",
                    help="dedup by position only, orientation-agnostic (Picard-like)")
+    p.add_argument("--index-threshold", type=int, default=None,
+                   help="minimum distinct UMIs per group before the indexed "
+                        "candidate search replaces the dense pairwise scan; "
+                        "0 = always dense")
     p.add_argument("--threads", type=int, default=0,
                    help="reader/writer threads around the batch engine "
                         "(0/1 = inline)")
@@ -2315,6 +2511,10 @@ def cmd_dedup(args):
     from .core.template import is_template_coordinate_sorted
     from .io.bam import BamReader, BamWriter
 
+    if getattr(args, "index_threshold", None) is not None:
+        from .umi.assigners import set_index_threshold
+
+        set_index_threshold(args.index_threshold)
     # argument-combination validation before the output file is touched
     if args.strategy == "paired" and args.no_umi:
         log.error("--no-umi cannot be used with --strategy paired")

@@ -300,7 +300,8 @@ def merge_template(unmapped_records, t: MappedTemplate, tag_info: TagInfo,
 
 
 def run_zipper(mapped_reader, unmapped_reader, writer, tag_info: TagInfo, *,
-               skip_tc_tags: bool = False, exclude_missing_reads: bool = False):
+               skip_tc_tags: bool = False, exclude_missing_reads: bool = False,
+               restore_unconverted=None):
     """Lockstep merge by QNAME. Returns (templates, records_out, missing).
 
     Both inputs must share queryname ordering. An unmapped template absent from
@@ -328,6 +329,9 @@ def run_zipper(mapped_reader, unmapped_reader, writer, tag_info: TagInfo, *,
         t = MappedTemplate.from_records(mapped_item[0], mapped_item[1])
         out_bytes = merge_template(u_records, t, tag_info, skip_tc_tags)
         for data in out_bytes:
+            if restore_unconverted is not None:
+                data = restore_unconverted_bases_record(
+                    data, restore_unconverted[0], restore_unconverted[1])
             writer.write_record_bytes(data)
             n_records += 1
         n_templates += 1
@@ -338,3 +342,71 @@ def run_zipper(mapped_reader, unmapped_reader, writer, tag_info: TagInfo, *,
             "mapped BAM but not in the unmapped BAM; inputs must share "
             "queryname ordering")
     return n_templates, n_records, n_missing
+
+
+def restore_unconverted_bases_record(data: bytes, reference,
+                                     ref_names) -> bytes:
+    """EM-Seq post-bwameth restore (zipper.rs:629-760): for a mapped record
+    carrying the bwameth YD strand tag ('f' top / 'r' bottom), rewrite
+    converted bases back to the unconverted reference form at aligned
+    ref-C (top) / ref-G (bottom) positions. SEQ is stored in reference
+    orientation, so the (strand, reverse-flag) pair picks the target:
+    (top, fwd) and (bottom, rev) restore C<-T; the other two G<-A.
+    Methylation state stays in the MM/ML/cu/ct tags."""
+    import numpy as np
+
+    from ..constants import BASE_TO_CODE
+    from ..io.bam import FLAG_REVERSE, FLAG_UNMAPPED
+
+    rec = RawRecord(data)
+    if rec.flag & FLAG_UNMAPPED or rec.ref_id < 0 \
+            or rec.ref_id >= len(ref_names):
+        return data
+    yd = rec.get_str(b"YD")
+    if yd == "f":
+        is_top = True
+    elif yd == "r":
+        is_top = False
+    else:
+        return data
+    ref_seq = reference.get(ref_names[rec.ref_id]) \
+        if hasattr(reference, "get") else None
+    if ref_seq is None:
+        return data
+    is_reverse = bool(rec.flag & FLAG_REVERSE)
+    if is_top != is_reverse:  # (top, fwd) / (bottom, rev)
+        target, conv, unconv = ord("C"), ord("T"), ord("C")
+    else:
+        target, conv, unconv = ord("G"), ord("A"), ord("G")
+
+    # per-query-position ref byte (uppercased), -1 for I/S — the shared
+    # resolver used by the methylation filters too
+    from ..consensus.methylation import ref_bytes_for_alignment
+
+    l_seq = rec.l_seq
+    ref_at = ref_bytes_for_alignment(rec.cigar(), rec.pos, ref_seq, l_seq)
+
+    seq = rec.seq_bytes()
+    codes = np.frombuffer(seq, dtype=np.uint8)
+    hit = (ref_at[:len(codes)] == target) & (codes == conv)
+    if not hit.any():
+        return data
+    # rewrite the packed nibbles in place
+    buf = bytearray(data)
+    l_read_name = buf[8]
+    n_cigar = int.from_bytes(buf[12:14], "little")
+    seq_off = 32 + l_read_name + 4 * n_cigar
+    packed = np.frombuffer(bytes(buf[seq_off:seq_off + (l_seq + 1) // 2]),
+                           dtype=np.uint8)
+    nib = np.empty(2 * len(packed), dtype=np.uint8)
+    nib[0::2] = packed >> 4
+    nib[1::2] = packed & 0xF
+    nib = nib[:l_seq].copy()
+    # BAM nibble code for the unconverted base (A=1 C=2 G=4 T=8 in SAM spec
+    # 16-code space; BASE_TO_CODE is our 0..4 space, so map via seq chars)
+    nib[hit] = 2 if unconv == ord("C") else 4
+    out = np.zeros(((l_seq + 1) // 2) * 2, dtype=np.uint8)
+    out[:l_seq] = nib
+    buf[seq_off:seq_off + (l_seq + 1) // 2] = \
+        ((out[0::2] << 4) | out[1::2]).astype(np.uint8).tobytes()
+    return bytes(buf)

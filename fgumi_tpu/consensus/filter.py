@@ -448,10 +448,11 @@ def mask_methylation_depth(buf: bytearray, rec: RawRecord,
 
 
 def resolve_ref_codes(rec: RawRecord, reference, ref_names):
-    """Per-query-position UPPERCASE reference base (bytes values) or None
-    for insertions/soft-clips; None for unmapped/unresolvable records
-    (resolve_ref_bases_for_record)."""
+    """Per-query-position UPPERCASE reference byte as int32 (-1 for
+    insertions/soft-clips), or None for unmapped/unresolvable records
+    (resolve_ref_bases_for_record; shared walker in methylation.py)."""
     from ..io.bam import FLAG_UNMAPPED
+    from .methylation import ref_bytes_for_alignment
 
     if rec.flag & FLAG_UNMAPPED or rec.ref_id < 0 \
             or rec.ref_id >= len(ref_names):
@@ -461,23 +462,7 @@ def resolve_ref_codes(rec: RawRecord, reference, ref_names):
     if ref_seq is None:
         return None
     _, _, l_seq = _seq_qual_view(rec.data)
-    out = []
-    ref_pos = rec.pos  # 0-based
-    for op, n in rec.cigar():
-        if op in "M=X":
-            for _ in range(n):
-                b = ref_seq[ref_pos] if 0 <= ref_pos < len(ref_seq) else None
-                out.append(b & ~0x20 if isinstance(b, int) and 0x61 <= b <= 0x7a
-                           else b)
-                ref_pos += 1
-        elif op in "IS":
-            out.extend([None] * n)
-        elif op in "DN":
-            ref_pos += n
-    del out[l_seq:]
-    while len(out) < l_seq:
-        out.append(None)
-    return out
+    return ref_bytes_for_alignment(rec.cigar(), rec.pos, ref_seq, l_seq)
 
 
 def mask_strand_methylation_agreement(buf: bytearray, rec: RawRecord,

@@ -128,6 +128,36 @@ def normalize_source_reads(source_reads, annotation: MethylationAnnotation,
         sr.codes[:n][mask] = unconv
 
 
+def ref_bytes_for_alignment(cigar, pos: int, ref_seq, l_seq: int):
+    """Per-query-position UPPERCASE reference byte as int32 (-1 for
+    insertions/soft-clips/out-of-range), vectorized per CIGAR op — the one
+    shared query->reference base resolver (resolve_ref_bases_for_record,
+    fgumi-consensus filter.rs:1045-1118; also the zipper restore's walk)."""
+    out = np.full(l_seq, -1, dtype=np.int32)
+    qpos = 0
+    rpos = pos
+    for op, n in cigar:
+        if op in "M=X":
+            lo = max(rpos, 0)
+            hi = min(rpos + n, len(ref_seq))
+            if hi > lo and qpos + (lo - rpos) < l_seq:
+                got = np.frombuffer(ref_seq[lo:hi],
+                                    dtype=np.uint8).astype(np.int32)
+                got = np.where((got >= 0x61) & (got <= 0x7a), got - 0x20, got)
+                dst = qpos + (lo - rpos)
+                take = min(len(got), l_seq - dst)
+                out[dst:dst + take] = got[:take]
+            qpos += n
+            rpos += n
+        elif op in "IS":
+            qpos += n
+        elif op in "DN":
+            rpos += n
+        if qpos >= l_seq:
+            break
+    return out
+
+
 def combine_annotations(ab, ba, length: int) -> MethylationAnnotation:
     """Duplex combine: per-position count sums with OR'd ref-C flags over
     the truncated strand annotations; an absent strand contributes zeros

@@ -105,6 +105,20 @@ def _umi_matrix(umis) -> np.ndarray:
 # (crates/fgumi-umi/src/assigner.rs:228,267,394: exact-match on one of
 # d+1 chunks is necessary for Hamming distance <= d).
 SPARSE_THRESHOLD = 8192
+
+
+def set_index_threshold(n):
+    """--index-threshold mapping (group.rs:860-863): below the threshold the
+    neighbor graph is built by the dense pairwise scan, at/above it by the
+    indexed candidate search (pigeonhole n-gram / BK-tree). 0 = always
+    dense (linear-scan semantics); None restores the measured default.
+
+    The default here (8192) is far above the reference's 100 because the
+    dense path is a vectorized array scan, not a per-pair loop — it wins
+    until well past the reference's crossover."""
+    global SPARSE_THRESHOLD
+    SPARSE_THRESHOLD = (8192 if n is None
+                        else (1 << 62) if int(n) == 0 else int(n))
 # unique-UMI count above which the directed BFS runs natively
 # (fgumi_adjacency_bfs); tests force the Python loop by raising this
 _NATIVE_BFS_THRESHOLD = 512

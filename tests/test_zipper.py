@@ -388,3 +388,43 @@ def test_fast_zipper_tiny_batches(tmp_path):
                  "--classic"]) == 0
     with BamReader(fast_out) as a, BamReader(slow_out) as b:
         assert [r.data for r in a] == [r.data for r in b]
+
+
+def test_restore_unconverted_bases_record():
+    """EM-Seq restore (zipper.rs:629-760): YD:f forward reads restore C<-T at
+    ref-C; YD:f reverse reads restore G<-A at ref-G (SEQ is stored in
+    reference orientation); YD:r inverts; no YD -> untouched."""
+    import numpy as np
+
+    from fgumi_tpu.commands.zipper import restore_unconverted_bases_record
+    from fgumi_tpu.io.bam import FLAG_REVERSE, RawRecord
+    from fgumi_tpu.simulate import _build_mapped_record
+
+    ref = {"chr1": b"ACGTACGTAC"}
+    names = ["chr1"]
+    q = np.full(10, 30, np.uint8)
+
+    def build(seq, flags, yd):
+        tags = [(b"RG", "Z", b"A")]
+        if yd is not None:
+            tags.append((b"YD", "Z", yd))
+        return _build_mapped_record(b"r", flags, 0, 0, 60, [("M", 10)], seq,
+                                    q, -1, -1, 0, tags)
+
+    # top strand, forward: T at ref-C positions 1,5,9 -> restored to C;
+    # T at ref-T position 3 stays
+    data = build(b"ATGTATGTAT", 0, b"f")
+    out = RawRecord(restore_unconverted_bases_record(data, ref, names))
+    assert out.seq_bytes() == b"ACGTACGTAC"
+    # top strand, reverse flag: G<-A at ref-G positions 2,6
+    data = build(b"ACATACATAC", FLAG_REVERSE, b"f")
+    out = RawRecord(restore_unconverted_bases_record(data, ref, names))
+    assert out.seq_bytes() == b"ACGTACGTAC"
+    # bottom strand, forward: G<-A too
+    data = build(b"ACATACATAC", 0, b"r")
+    out = RawRecord(restore_unconverted_bases_record(data, ref, names))
+    assert out.seq_bytes() == b"ACGTACGTAC"
+    # no YD tag: untouched
+    data = build(b"ATGTATGTAT", 0, None)
+    out = RawRecord(restore_unconverted_bases_record(data, ref, names))
+    assert out.seq_bytes() == b"ATGTATGTAT"

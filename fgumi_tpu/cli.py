@@ -717,6 +717,11 @@ def _add_duplex_metrics(sub):
                    help="min BA-strand reads for a family to count as duplex")
     p.add_argument("--duplex-umi-counts", action="store_true",
                    help="also write duplex UMI pair counts (memory intensive)")
+    p.add_argument("--description", default=None,
+                   help="accepted for compatibility: the reference uses this "
+                        "only to title its optional R plot PDFs, which this "
+                        "build does not generate (metrics TSVs carry no "
+                        "title)")
     p.set_defaults(func=_cmd_duplex_metrics)
 
 
@@ -737,6 +742,11 @@ def _add_simplex_metrics(sub):
                    help="BED or Picard interval list restricting analysis")
     p.add_argument("--min-reads", type=int, default=1,
                    help="min family size counted toward ss_consensus_families")
+    p.add_argument("--description", default=None,
+                   help="accepted for compatibility: the reference uses this "
+                        "only to title its optional R plot PDFs, which this "
+                        "build does not generate (metrics TSVs carry no "
+                        "title)")
     p.set_defaults(func=_cmd_simplex_metrics)
 
 

@@ -963,6 +963,7 @@ def cmd_codec(args):
                 # rejects keep the input header (raw RG/PG/contig metadata
                 # preserved)
                 rejects_writer = BamWriter(args.rejects, reader.header)
+            ok = False
             try:
                 with BamWriter(args.output, out_header) as writer:
                     n_out = 0
@@ -976,9 +977,11 @@ def cmd_codec(args):
                             for rec in caller.rejected_reads:
                                 rejects_writer.write_record(rec)
                             caller.rejected_reads.clear()
+                ok = True
             finally:
                 if rejects_writer is not None:
-                    rejects_writer.close()
+                    (rejects_writer.close if ok
+                     else rejects_writer.discard)()
     dt = time.monotonic() - t0
     s = caller.stats
     log.info("codec: %d input reads -> %d consensus reads in %.2fs (%.0f reads/s)",
@@ -1130,10 +1133,10 @@ def cmd_group(args):
     log.info("group: wrote %d records in %.2fs; filter=%s", result["records_out"],
              dt, result["filter"])
     if args.family_size_out:
-        with open(args.family_size_out, "w") as f:
-            f.write("family_size\tcount\n")
-            for size, count in result["family_sizes"].items():
-                f.write(f"{size}\t{count}\n")
+        from .commands.dedup import write_family_size_histogram
+
+        write_family_size_histogram(result["family_sizes"],
+                                    args.family_size_out)
     if (args.family_size_histogram or args.grouping_metrics or args.metrics):
         from .metrics import (size_distribution_fields,
                               size_distribution_rows,
@@ -1546,7 +1549,9 @@ def cmd_fastq(args):
 
     from .io.bam import FLAG_LAST, FLAG_PAIRED
 
-    out = sys.stdout.buffer if args.output == "-" else open(args.output, "wb")
+    from .utils.atomic import discard_output, open_output
+
+    out = sys.stdout.buffer if args.output == "-" else open_output(args.output)
     n = 0
     umi_tags = [t.strip().encode() for t in args.umi_tag.split(",")
                 if t.strip()]
@@ -1614,7 +1619,11 @@ def cmd_fastq(args):
                     emit(r2)
         for rec in pending.values():  # orphaned mates, in input order
             emit(rec)
-    finally:
+    except BaseException:
+        if out is not sys.stdout.buffer:
+            discard_output(out)
+        raise
+    else:
         out.flush()
         if out is not sys.stdout.buffer:
             out.close()
@@ -1980,16 +1989,19 @@ def cmd_filter(args):
                                              " ".join(sys.argv))
                 rejects = (BamWriter(args.rejects, out_header)
                            if args.rejects else None)
+                ok = False
                 try:
                     with BamWriter(args.output, out_header) as writer:
-                        return run_filter(
+                        stats_ = run_filter(
                             reader, writer, config,
                             filter_by_template=args.filter_by_template,
                             reverse_per_base=args.reverse_per_base_tags,
                             rejects_writer=rejects, reference=reference)
+                    ok = True
+                    return stats_
                 finally:
                     if rejects is not None:
-                        rejects.close()
+                        (rejects.close if ok else rejects.discard)()
 
         stats = None
         if use_fast:
@@ -2008,6 +2020,7 @@ def cmd_filter(args):
                                                  " ".join(sys.argv))
                     rejects = (BamWriter(args.rejects, out_header)
                                if args.rejects else None)
+                    ok = False
                     try:
                         with BamWriter(args.output, out_header) as writer:
                             ff = FastFilter(
@@ -2020,9 +2033,10 @@ def cmd_filter(args):
                                     batch, writer.write_serialized, emit_rej)
                             ff.flush(writer.write_serialized, emit_rej)
                             stats = ff.stats
+                        ok = True
                     finally:
                         if rejects is not None:
-                            rejects.close()
+                            (rejects.close if ok else rejects.discard)()
             except _OddSubtype:
                 log.info("filter: unexpected per-base tag subtype; "
                          "re-running with the classic engine")
@@ -2071,15 +2085,17 @@ def cmd_downsample(args):
             out_header = _header_with_pg(reader.header, " ".join(sys.argv))
             rejects = (BamWriter(args.rejects, out_header)
                        if args.rejects else None)
+            ok = False
             try:
                 with BamWriter(args.output, out_header) as writer:
                     stats = run_downsample(
                         reader, writer, args.fraction, seed=args.seed,
                         rejects_writer=rejects,
                         validate_mi_order=args.validate_mi_order)
+                ok = True
             finally:
                 if rejects is not None:
-                    rejects.close()
+                    (rejects.close if ok else rejects.discard)()
     except (ValueError, OSError) as e:
         log.error("%s", e)
         return 2
@@ -2700,6 +2716,9 @@ def cmd_pipeline(args):
     fwd = []
     if args.memory_per_thread:
         fwd += ["--memory-per-thread", args.memory_per_thread]
+    # each stage re-enters main(), which resets the atomic-commit global
+    # from its own flags — so an outer --no-atomic-output must travel
+    pre = ["--no-atomic-output"] if args.no_atomic_output else []
     out_lvl = ([] if args.compression_level is None
                else ["--compression-level", str(args.compression_level)])
     rs = (["-r"] + args.read_structures) if args.read_structures else []
@@ -2724,7 +2743,7 @@ def cmd_pipeline(args):
         t00 = time.monotonic()
         for name, argv in stages:
             t0 = time.monotonic()
-            rc = main(argv)
+            rc = main(pre + argv)
             if rc:
                 log.error("pipeline: stage %s failed (rc=%d)", name, rc)
                 return rc
@@ -2750,6 +2769,11 @@ def build_parser():
         description="TPU-native toolkit for UMI-tagged sequencing data",
     )
     parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument(
+        "--no-atomic-output", action="store_true",
+        help="write outputs directly to their final names instead of the "
+             "crash-safe temp-file + atomic-rename commit (escape hatch "
+             "for FIFO outputs; also FGUMI_TPU_NO_ATOMIC=1)")
     sub = parser.add_subparsers(dest="command", required=True)
     _add_extract(sub)
     _add_correct(sub)
@@ -2781,10 +2805,38 @@ def main(argv=None):
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s: %(message)s",
     )
+    from .utils.atomic import set_atomic_enabled
+
+    set_atomic_enabled(not args.no_atomic_output)
     rc = _apply_pipeline_compat(args)
     if rc:
         return rc
-    return args.func(args)
+    from .io.errors import InputFormatError
+    from .utils.faults import InjectedFault
+
+    try:
+        return args.func(args)
+    except (InputFormatError, EOFError) as e:
+        # a diagnosed input problem (truncated/corrupt stream, torn record):
+        # one line with path + offset, nonzero exit — not a traceback
+        log.error("%s", e)
+        return 2
+    except InjectedFault as e:
+        # chaos testing: an injected fault that propagated to the top is a
+        # *clean* failure (distinct rc so the harness can tell it apart)
+        log.error("%s", e)
+        return 3
+    except BrokenPipeError:
+        # detach stdout so the interpreter's exit-time flush of the
+        # still-buffered stream doesn't print "Exception ignored" noise
+        try:
+            os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        except OSError:
+            pass
+        return 1
+    except KeyboardInterrupt:
+        log.error("interrupted")
+        return 130
 
 
 if __name__ == "__main__":

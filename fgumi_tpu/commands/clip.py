@@ -293,7 +293,9 @@ _METRIC_COLUMNS = [
 
 
 def write_clip_metrics(metrics: ClipMetrics, path: str):
-    with open(path, "w") as f:
+    from ..utils.atomic import open_output
+
+    with open_output(path, "w") as f:
         f.write("\t".join(_METRIC_COLUMNS) + "\n")
         for read_type, m in (("fragment", metrics.fragment),
                              ("read_one", metrics.read_one),

@@ -265,7 +265,9 @@ def write_correction_metrics(stats: CorrectStats, umi_length: int, path: str):
     matched_total = sum(row[0] for umi, row in metrics.items() if umi != unmatched)
     umi_count = sum(1 for umi in metrics if umi != unmatched)
     mean = matched_total / umi_count if umi_count else float("nan")
-    with open(path, "w") as f:
+    from ..utils.atomic import open_output
+
+    with open_output(path, "w") as f:
         f.write("\t".join(_METRIC_COLUMNS) + "\n")
         for umi in sorted(metrics):
             row = metrics[umi]

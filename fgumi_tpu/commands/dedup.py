@@ -285,13 +285,17 @@ def write_metrics(metrics: DedupMetrics, path: str):
     """DedupMetricsOutput TSV (dedup.rs:119-152)."""
     row = {c: getattr(metrics, c) for c in _METRIC_COLUMNS if c != "duplicate_rate"}
     row["duplicate_rate"] = f"{metrics.duplicate_rate():.6f}"
-    with open(path, "w") as f:
+    from ..utils.atomic import open_output
+
+    with open_output(path, "w") as f:
         f.write("\t".join(_METRIC_COLUMNS) + "\n")
         f.write("\t".join(str(row[c]) for c in _METRIC_COLUMNS) + "\n")
 
 
 def write_family_size_histogram(family_sizes: dict, path: str):
-    with open(path, "w") as f:
+    from ..utils.atomic import open_output
+
+    with open_output(path, "w") as f:
         f.write("family_size\tcount\n")
         for size, count in family_sizes.items():
             f.write(f"{size}\t{count}\n")

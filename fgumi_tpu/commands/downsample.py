@@ -91,7 +91,9 @@ def run_downsample(reader, writer, fraction: float, *, seed=None,
 
 def write_histogram(sizes: Counter, path: str):
     """family_size -> count TSV (downsample.rs:286-297)."""
-    with open(path, "w") as f:
+    from ..utils.atomic import open_output
+
+    with open_output(path, "w") as f:
         f.write("family_size\tcount\n")
         for size in sorted(sizes):
             f.write(f"{size}\t{sizes[size]}\n")

@@ -47,8 +47,17 @@ class RejectsSink:
         if self._writer is not None:
             self._writer.close()
 
+    def discard(self):
+        """Error path: drop the temp file instead of committing a partial
+        rejects BAM under the final name (same contract as BamWriter)."""
+        if self._writer is not None:
+            self._writer.discard()
+
     def __enter__(self):
         return self
 
-    def __exit__(self, *exc):
-        self.close()
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.close()
+        else:
+            self.discard()

@@ -175,7 +175,9 @@ class BaiBuilder:
             st[3] += int(hi - lo) - n_mapped
 
     def write(self, path: str):
-        with open(path, "wb") as f:
+        from ..utils.atomic import open_output
+
+        with open_output(path) as f:
             f.write(_BAI_MAGIC)
             f.write(struct.pack("<i", self.n_refs))
             for tid in range(self.n_refs):
@@ -470,5 +472,9 @@ class CsiBuilder:
                 for beg, end in chunks:
                     out += struct.pack("<QQ", beg, end)
         out += struct.pack("<Q", self.n_no_coor)
-        with gzip.open(path, "wb", compresslevel=1) as f:
-            f.write(bytes(out))
+        from ..utils.atomic import open_output
+
+        with open_output(path) as raw:
+            with gzip.GzipFile(fileobj=raw, mode="wb",
+                               compresslevel=1, mtime=0) as f:
+                f.write(bytes(out))

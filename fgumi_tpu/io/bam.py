@@ -415,7 +415,8 @@ class BamReader:
     def __init__(self, path_or_obj):
         owns = isinstance(path_or_obj, str)
         fileobj = open(path_or_obj, "rb") if owns else path_or_obj
-        self._r = BgzfReader(fileobj, owns_fileobj=owns)
+        self._path = path_or_obj if owns else getattr(fileobj, "name", None)
+        self._r = BgzfReader(fileobj, owns_fileobj=owns, name=self._path)
         self.header = BamHeader.decode_from(self._r.read)
 
     def __iter__(self):
@@ -427,7 +428,10 @@ class BamReader:
             (block_size,) = struct.unpack("<I", sz)
             data = read(block_size)
             if len(data) < block_size:
-                raise EOFError("truncated BAM record")
+                where = f" in {self._path}" if self._path else ""
+                raise EOFError(
+                    f"truncated BAM record{where} (expected {block_size} "
+                    f"bytes, got {len(data)} before EOF)")
             yield RawRecord(data)
 
     def close(self):
@@ -584,9 +588,22 @@ class BamWriter:
         if level is None:
             level = DEFAULT_COMPRESSION_LEVEL
         owns = isinstance(path_or_obj, str)
-        fileobj = open(path_or_obj, "wb") if owns else path_or_obj
+        if owns:
+            # crash-safe commit: write .<name>.tmp.<pid>, atomic-rename on
+            # close so an interrupted run never leaves a torn BAM under the
+            # final name (utils/atomic.py; --no-atomic-output disables)
+            from ..utils.atomic import open_output
+
+            fileobj = open_output(path_or_obj)
+        else:
+            fileobj = path_or_obj
         self._w = BgzfWriter(fileobj, level=level, owns_fileobj=owns)
-        self._w.write(header.encode())
+        try:
+            self._w.write(header.encode())
+        except BaseException:
+            # construction failed: drop the temp eagerly rather than at GC
+            self._w.discard()
+            raise
 
     def write_record_bytes(self, data: bytes):
         self._w.write(struct.pack("<I", len(data)) + data)
@@ -606,8 +623,16 @@ class BamWriter:
     def close(self):
         self._w.close()
 
+    def discard(self):
+        """Abandon the output (error path): no EOF sentinel is written and
+        an atomic temp file is removed instead of renamed."""
+        self._w.discard()
+
     def __enter__(self):
         return self
 
-    def __exit__(self, *exc):
-        self.close()
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.close()
+        else:
+            self.discard()

@@ -144,7 +144,8 @@ class BamBatchReader:
                 # overlaps disk latency with decompress/decode even when
                 # the command runs without a reader stage thread
                 fileobj = PrefetchFile(fileobj)
-        self._r = BgzfReader(fileobj, owns_fileobj=owns)
+        self._r = BgzfReader(fileobj, owns_fileobj=owns,
+                             name=path_or_obj if owns else None)
         try:
             self.header = BamHeader.decode_from(self._r.read)
         except BaseException:

@@ -13,6 +13,9 @@ import io
 import struct
 import zlib
 
+from ..utils import faults
+from .errors import InputFormatError
+
 # Maximum uncompressed payload per BGZF block.
 MAX_BLOCK_DATA = 0xFF00
 
@@ -68,8 +71,25 @@ class BgzfWriter(io.RawIOBase):
         self._buf = bytearray()
         self._owns = owns_fileobj
         self._coffset = 0  # compressed bytes emitted so far
+        # a failed write/flush poisons the stream: close() then discards
+        # instead of committing — otherwise GC-driven IOBase.__del__ would
+        # atomically rename a half-written file under the final name
+        self._broken = False
+        # fire() costs a lock + env read; write() runs once per BAM record,
+        # so the armed check is hoisted to construction time (chaos tests
+        # arm FGUMI_TPU_FAULT before the writer exists)
+        self._fault_armed = faults.armed("writer.compress")
 
     def write(self, data) -> int:
+        try:
+            return self._write(data)
+        except BaseException:
+            self._broken = True
+            raise
+
+    def _write(self, data) -> int:
+        if self._fault_armed:
+            data = faults.fire("writer.compress", data)
         self._buf += data
         n_full = len(self._buf) // MAX_BLOCK_DATA
         if n_full == 0:
@@ -114,6 +134,13 @@ class BgzfWriter(io.RawIOBase):
         uncompressed offset u lands in block u // MAX_BLOCK_DATA of this
         flush, at in-block offset u % MAX_BLOCK_DATA).
         """
+        try:
+            return self._write_indexed(blob, starts)
+        except BaseException:
+            self._broken = True
+            raise
+
+    def _write_indexed(self, blob, starts):
         import numpy as np
 
         from .. import native
@@ -152,20 +179,46 @@ class BgzfWriter(io.RawIOBase):
         return np.where(in_full, vo_full, vo_tail)
 
     def flush(self):
-        if self._buf:
-            block = compress_block(bytes(self._buf), self._level)
-            self._coffset += len(block)
-            self._f.write(block)
-            self._buf.clear()
+        try:
+            # fire only when there is buffered data to flush: IOBase.close
+            # re-invokes flush() (from both close() and discard()), and an
+            # unconditional fire there would consume count-limited fault
+            # budgets — or raise out of the error-path cleanup itself
+            if self._fault_armed and self._buf:
+                faults.fire("writer.compress")
+            if self._buf:
+                block = compress_block(bytes(self._buf), self._level)
+                self._coffset += len(block)
+                self._f.write(block)
+                self._buf.clear()
+        except BaseException:
+            self._broken = True
+            raise
 
     def close(self):
         if self.closed:
+            return
+        if self._broken:
+            self.discard()
             return
         self.flush()
         self._f.write(BGZF_EOF)
         self._f.flush()
         if self._owns:
             self._f.close()
+        super().close()
+
+    def discard(self):
+        """Abandon the stream: drop buffered data and discard (atomic
+        outputs) or close the underlying file without writing the EOF
+        sentinel — the error-path counterpart of close()."""
+        if self.closed:
+            return
+        self._buf.clear()
+        if self._owns:
+            from ..utils.atomic import discard_output
+
+            discard_output(self._f)
         super().close()
 
 
@@ -176,7 +229,8 @@ class BgzfReader:
     accepts plain gzip input (the reference similarly auto-detects, bam-io reader).
     """
 
-    def __init__(self, fileobj, chunk_size: int = 1 << 20, owns_fileobj: bool = False):
+    def __init__(self, fileobj, chunk_size: int = 1 << 20,
+                 owns_fileobj: bool = False, name: str = None):
         self._f = fileobj
         self._owns = owns_fileobj
         self._chunk = chunk_size
@@ -186,6 +240,33 @@ class BgzfReader:
         # native batch path state: None = undecided, False = zlib fallback
         self._native = None
         self._raw = bytearray()
+        # diagnostics: source path (when known) + compressed bytes consumed,
+        # so a corrupt/truncated stream reports *where*, not just *that*
+        self.name = name if name is not None \
+            else getattr(fileobj, "name", None)
+        self._in_off = 0
+        self._z_started = False  # current zlib member got any input
+
+    def _read_raw(self, n: int) -> bytes:
+        """One raw chunk off the underlying file, offset-tracked and
+        routed through the reader.decompress fault point."""
+        raw = self._f.read(n)
+        if raw:
+            self._in_off += len(raw)
+            raw = faults.fire("reader.decompress", raw)
+        return raw
+
+    def _input_error(self, message: str) -> InputFormatError:
+        # the undecoded residue starts at in_off - len(_raw)
+        return InputFormatError(message, path=self.name,
+                                offset=self._in_off - len(self._raw))
+
+    def _zdecomp(self, data) -> bytes:
+        self._z_started = True
+        try:
+            return self._z.decompress(data)
+        except zlib.error as e:
+            raise self._input_error(f"corrupt gzip/BGZF data: {e}") from e
 
     def _decide_native(self, first_chunk: bytes):
         """Engage the C++ batch decompressor only for genuine BGZF input
@@ -205,7 +286,7 @@ class BgzfReader:
         self._native = False
         self._z = zlib.decompressobj(wbits=31)
         if self._raw:
-            self._buf += self._z.decompress(bytes(self._raw))
+            self._buf += self._zdecomp(bytes(self._raw))
             self._raw.clear()
 
     @staticmethod
@@ -229,7 +310,7 @@ class BgzfReader:
 
         while len(self._buf) < need and not (self._eof and not self._raw):
             if not self._eof:
-                raw = self._f.read(self._chunk)
+                raw = self._read_raw(self._chunk)
                 if raw:
                     self._raw += raw
                 else:
@@ -255,12 +336,12 @@ class BgzfReader:
                     self._fill(need)
                     return
                 if self._eof:
-                    raise ValueError(
+                    raise self._input_error(
                         "truncated BGZF stream (partial block at EOF)")
 
     def _fill(self, need: int):
         if self._native is None:
-            first = self._f.read(self._chunk)
+            first = self._read_raw(self._chunk)
             if not first:
                 self._eof = True
                 return
@@ -268,7 +349,7 @@ class BgzfReader:
             if self._native:
                 self._raw += first
             else:
-                self._buf += self._z.decompress(first)
+                self._buf += self._zdecomp(first)
         if self._native:
             self._fill_native(need)
             return
@@ -277,16 +358,23 @@ class BgzfReader:
                 # recycle pending concatenated members even after file EOF
                 rest = self._z.unused_data
                 self._z = zlib.decompressobj(wbits=31)
+                self._z_started = False
                 if rest:
-                    self._buf += self._z.decompress(rest)
+                    self._buf += self._zdecomp(rest)
                     continue
             if self._eof:
+                # a member that consumed input but never reached its gzip
+                # trailer is a torn download / chopped file: report it
+                # instead of silently handing back a short stream
+                if self._z_started and not self._z.eof:
+                    raise self._input_error(
+                        "truncated gzip stream (unexpected EOF mid-member)")
                 break
-            raw = self._f.read(self._chunk)
+            raw = self._read_raw(self._chunk)
             if not raw:
                 self._eof = True
                 continue
-            self._buf += self._z.decompress(raw)
+            self._buf += self._zdecomp(raw)
 
     def read(self, n: int) -> bytes:
         self._fill(n)
@@ -322,7 +410,7 @@ class BgzfReader:
             if not self._raw:
                 if self._eof:
                     return np.empty(0, dtype=np.uint8)
-                raw = self._f.read(self._chunk)
+                raw = self._read_raw(self._chunk)
                 if raw:
                     self._raw += raw
                 else:
@@ -349,9 +437,9 @@ class BgzfReader:
                     self._buf.clear()
                     return np.frombuffer(bytearray(data), dtype=np.uint8)
                 if self._eof:
-                    raise ValueError(
+                    raise self._input_error(
                         "truncated BGZF stream (partial block at EOF)")
-                raw = self._f.read(self._chunk)
+                raw = self._read_raw(self._chunk)
                 if raw:
                     self._raw += raw
                 else:

@@ -1,0 +1,28 @@
+"""Typed input-format errors carrying file path + byte offset context.
+
+Subclasses ValueError so existing ``except ValueError`` callers (and
+tests) keep working, while the CLI's top-level handler can recognize a
+*diagnosed input problem* — truncated BGZF stream, corrupt block,
+malformed record — and exit with a one-line message instead of a Python
+traceback.
+"""
+
+
+class InputFormatError(ValueError):
+    """Corrupt, truncated, or malformed input.
+
+    `path` and `offset` (compressed-stream byte offset, when known) are
+    kept as attributes and folded into the message so a single str() is
+    the full diagnostic.
+    """
+
+    def __init__(self, message: str, path: str = None, offset: int = None):
+        self.path = path
+        self.offset = offset
+        loc = ""
+        if path is not None:
+            loc = f"{path}: "
+        suffix = ""
+        if offset is not None:
+            suffix = f" (near byte offset {offset})"
+        super().__init__(f"{loc}{message}{suffix}")

@@ -81,7 +81,7 @@ def _open_stream(path: str):
             if decoded is not None:
                 return _BufferStream(decoded)
             f = open(path, "rb")
-        return BgzfReader(f, owns_fileobj=True)
+        return BgzfReader(f, owns_fileobj=True, name=path)
     return f
 
 

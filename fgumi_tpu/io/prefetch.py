@@ -10,9 +10,12 @@ mode (where there is no separate reader stage to hide it).
 Disable with FGUMI_TPU_NO_PREFETCH=1.
 """
 
+import logging
 import os
 import queue
 import threading
+
+log = logging.getLogger("fgumi_tpu")
 
 _EOF = object()
 
@@ -43,6 +46,7 @@ class PrefetchFile:
                  owns_fileobj: bool = True):
         self._f = fileobj
         self._owns = owns_fileobj
+        self.name = getattr(fileobj, "name", None)  # diagnostics passthrough
         self._q = queue.Queue(maxsize=depth)
         self._buf = memoryview(b"")
         self._eof = False
@@ -117,6 +121,13 @@ class PrefetchFile:
         except queue.Empty:
             pass
         self._t.join(timeout=5)
+        if self._exc is not None:
+            # a producer error the consumer never read() far enough to hit:
+            # surface it instead of dropping it silently (the data already
+            # delivered may be short)
+            exc, self._exc = self._exc, None
+            log.warning("prefetch: pending read error discarded on close "
+                        "of %s: %r", getattr(self._f, "name", "<file>"), exc)
         if self._owns:
             self._f.close()
 

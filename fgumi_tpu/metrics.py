@@ -38,7 +38,9 @@ def write_metrics(path: str, rows: list, fieldnames=None):
         first = rows[0]
         fieldnames = [f.name for f in fields(first)] if is_dataclass(first) \
             else list(first.keys())
-    with open(path, "w") as fh:
+    from .utils.atomic import open_output
+
+    with open_output(path, "w") as fh:
         fh.write("\t".join(fieldnames) + "\n")
         for row in rows:
             get = (lambda r, k: getattr(r, k)) if is_dataclass(row) \

@@ -11,6 +11,7 @@ fall back to the pure-Python record path when it is False.
 import numpy as np
 
 from . import get_lib
+from ..utils import faults
 
 
 def available() -> bool:
@@ -26,6 +27,7 @@ def find_boundaries(buf: np.ndarray, max_records: int):
     """(offsets int64[n], scanned) — record starts in decompressed BAM bytes."""
     import ctypes
 
+    faults.fire("native.batch")
     lib = get_lib()
     offsets = np.empty(max_records, dtype=np.int64)
     scanned = ctypes.c_int64(0)
@@ -253,6 +255,7 @@ def consensus_segments(codes2d: np.ndarray, quals2d: np.ndarray,
     positions carry their bit-exact lane sums and observation counts for the
     caller's oracle epilogue.
     """
+    faults.fire("native.batch")
     lib = get_lib()
     J = len(starts) - 1
     L = codes2d.shape[1] if codes2d.ndim == 2 else 0
@@ -296,6 +299,7 @@ def consensus_classify(codes2d: np.ndarray, quals2d: np.ndarray,
     (flat indices, ascending) carry their valid observations concatenated
     in hard_codes/hard_quals (M = hard_depth.sum()).
     """
+    faults.fire("native.batch")
     lib = get_lib()
     J = len(starts) - 1
     L = codes2d.shape[1] if codes2d.ndim == 2 else 0

@@ -28,11 +28,14 @@ non-unanimous positions, and it keeps f32 magnitudes at ~|C| (tens per matching 
 instead of |ll| (hundreds to thousands), which is what makes f32 viable at depth.
 """
 
+import logging
 import threading
 import time
 from functools import wraps
 
 import numpy as np
+
+log = logging.getLogger("fgumi_tpu")
 
 # jax is imported lazily (_ensure_jax): a CPU-pinned run that routes every
 # dispatch to the native f64 host engine (host_kernel.py) never pays the
@@ -224,8 +227,26 @@ class DeviceStats:
         self.rows_real = 0
         self.rows_padded = 0
         self.in_flight = 0
+        # resilience accounting (retry / degrade path, docs/resilience.md):
+        # transient-dispatch retries, RESOURCE_EXHAUSTED batch halvings, and
+        # whole-batch falls back to the native f64 host engine
+        self.retries = 0
+        self.batch_splits = 0
+        self.host_fallbacks = 0
         self.timeline = []  # per-dispatch dicts (capped; --stats report)
         self._t0 = time.monotonic()
+
+    def add_retry(self):
+        with self._lock:
+            self.retries += 1
+
+    def add_split(self):
+        with self._lock:
+            self.batch_splits += 1
+
+    def add_host_fallback(self):
+        with self._lock:
+            self.host_fallbacks += 1
 
     def add_dispatch(self, flops: int):
         with self._lock:
@@ -297,6 +318,12 @@ class DeviceStats:
                 out["pad_rows_device"] = self.rows_padded
                 out["padding_waste"] = round(
                     self.rows_padded / max(self.rows_real, 1) - 1.0, 4)
+            if self.retries:
+                out["dispatch_retries"] = self.retries
+            if self.batch_splits:
+                out["batch_splits"] = self.batch_splits
+            if self.host_fallbacks:
+                out["host_fallbacks"] = self.host_fallbacks
             return out
 
     def timeline_snapshot(self):
@@ -415,6 +442,71 @@ class DeviceFeeder:
 
 
 DEVICE_FEEDER = DeviceFeeder()
+
+
+# ---------------------------------------------------------------------------
+# Device resilience: bounded retry on transient XLA failures, batch halving
+# on RESOURCE_EXHAUSTED, final whole-batch fallback to the native f64 host
+# engine. All three preserve output bytes exactly — the host engine and the
+# device+oracle path share the same integer-exactness contract — so a flaky
+# device degrades throughput, never correctness (docs/resilience.md).
+# ---------------------------------------------------------------------------
+
+def _is_oom(exc) -> bool:
+    """An XLA out-of-memory (batch too big for device HBM): halve, don't
+    retry — re-dispatching the same shape fails the same way."""
+    return "RESOURCE_EXHAUSTED" in str(exc)
+
+
+# XLA status codes that a retry can plausibly fix (link hiccup, preempted
+# device, transient runtime state); INVALID_ARGUMENT-class failures are
+# programming errors and re-raise immediately.
+_TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED",
+                      "INTERNAL", "CANCELLED", "UNKNOWN",
+                      "connection", "socket", "reset by peer")
+
+
+def _is_transient(exc) -> bool:
+    from ..utils.faults import InjectedFault
+
+    if isinstance(exc, InjectedFault):
+        return not _is_oom(exc)
+    if type(exc).__name__ != "XlaRuntimeError":
+        return False
+    s = str(exc)
+    return any(m in s for m in _TRANSIENT_MARKERS)
+
+
+def _retry_budget():
+    import os
+
+    tries = max(int(os.environ.get("FGUMI_TPU_DEVICE_RETRIES", "3")), 0)
+    base = float(os.environ.get("FGUMI_TPU_DEVICE_BACKOFF_S", "0.05"))
+    return tries, base
+
+
+def device_retry_call(fn, what: str = "dispatch"):
+    """Run fn() (device upload + jit dispatch) with bounded exponential
+    backoff on transient errors. Non-transient errors and OOM re-raise
+    immediately (OOM is handled by batch splitting at resolve time). The
+    device.dispatch fault point fires on every attempt, so chaos tests
+    exercise exactly this loop."""
+    from ..utils import faults
+
+    retries, delay = _retry_budget()
+    for attempt in range(retries + 1):
+        try:
+            faults.fire("device.dispatch")
+            return fn()
+        except BaseException as e:  # noqa: BLE001 - classified below
+            if _is_oom(e) or not _is_transient(e) or attempt >= retries:
+                raise
+            DEVICE_STATS.add_retry()
+            log.warning("device %s failed (%s: %s); retry %d/%d in %.2fs",
+                        what, type(e).__name__, e, attempt + 1, retries,
+                        delay)
+            time.sleep(delay)
+            delay = min(delay * 2, 2.0)
 
 
 def segments_flops(n_rows: int, length: int, num_segments: int) -> int:
@@ -1007,9 +1099,9 @@ class ConsensusKernel:
         """
         F, R, L = codes.shape
         DEVICE_STATS.add_dispatch(segments_flops(F * R, L, F))
-        return _consensus_batch_packed_jit(
-            np.asarray(codes), np.asarray(quals), self._correct_f32, self._err_f32, self._pre
-        )
+        return device_retry_call(lambda: _consensus_batch_packed_jit(
+            np.asarray(codes), np.asarray(quals), self._correct_f32,
+            self._err_f32, self._pre), "batch dispatch")
 
     @staticmethod
     def _host_counts(codes: np.ndarray, winner: np.ndarray):
@@ -1032,7 +1124,12 @@ class ConsensusKernel:
         Thread-safe; this is the single completion path shared by the direct
         __call__ and the pipeline's deferred (writer-stage) resolution.
         """
-        packed = DEVICE_STATS.fetch(dev)
+        try:
+            packed = DEVICE_STATS.fetch(dev)
+        except BaseException as e:  # noqa: BLE001 - classified below
+            if not (_is_oom(e) or _is_transient(e)):
+                raise
+            return self._recover_packed(e, codes, quals)
         winner, qual, suspect = _unpack_device_result(packed)
         depth, errors = self._host_counts(codes, winner)
         depth = depth.astype(np.int64)
@@ -1043,9 +1140,41 @@ class ConsensusKernel:
                                lambda f: (codes[f], quals[f]))
         return winner, qual, depth, errors
 
+    def _recover_packed(self, exc, codes: np.ndarray, quals: np.ndarray):
+        """Host-engine completion of a failed uniform-batch fetch: the
+        (F, R, L) batch is one R-row segment per family for the native f64
+        engine. Re-raises when the native library is unavailable."""
+        from ..native import batch as nb
+
+        if not nb.available():
+            raise exc
+        F, R, L = codes.shape
+        DEVICE_STATS.add_host_fallback()
+        log.warning(
+            "device fetch failed after retries (%s: %s); computing %d "
+            "families on the native f64 host engine",
+            type(exc).__name__, exc, F)
+        starts = np.arange(F + 1, dtype=np.int64) * R
+        engine = self._host()
+        winner, qual, depth, errors, n_slow = engine.call_segments_counted(
+            codes.reshape(F * R, L), quals.reshape(F * R, L), starts)
+        with self._counter_lock:
+            self.total_positions += winner.size
+            self.fallback_positions += n_slow
+        return (winner, qual, depth.astype(np.int64),
+                errors.astype(np.int64))
+
     def __call__(self, codes: np.ndarray, quals: np.ndarray):
-        return self.resolve_packed(self.device_call_packed(codes, quals),
-                                   codes, quals)
+        try:
+            dev = self.device_call_packed(codes, quals)
+        except BaseException as e:  # noqa: BLE001 - classified below
+            # dispatch-time failure (sync path): same degradation contract
+            # as the resolve paths — OOM or exhausted retries run the batch
+            # on the native f64 host engine rather than aborting the run
+            if not (_is_oom(e) or _is_transient(e)):
+                raise
+            return self._recover_packed(e, codes, quals)
+        return self.resolve_packed(dev, codes, quals)
 
     # ------------------------------------------------------- ragged (segment)
 
@@ -1061,9 +1190,10 @@ class ConsensusKernel:
             return HOST_DISPATCH
         DEVICE_STATS.add_dispatch(segments_flops(
             codes2d.shape[0], codes2d.shape[1], num_segments))
-        return _consensus_segments_packed_jit(
+        return device_retry_call(lambda: _consensus_segments_packed_jit(
             np.asarray(codes2d), np.asarray(quals2d), np.asarray(seg_ids),
-            self._correct_f32, self._err_f32, self._pre, num_segments)
+            self._correct_f32, self._err_f32, self._pre, num_segments),
+            "segment dispatch")
 
     def dispatch_segments(self, codes2d, quals2d, counts):
         """Pad + dispatch ragged segments, or skip both in host mode.
@@ -1120,28 +1250,45 @@ class ConsensusKernel:
                     out_segments)
         DEVICE_STATS.add_dispatch(segments_flops(
             codes2d_padded.shape[0], codes2d_padded.shape[1], num_segments))
-        ticket = DEVICE_FEEDER.submit(_dispatch)
+        ticket = DEVICE_FEEDER.submit(
+            lambda: device_retry_call(_dispatch, "wire dispatch"))
         ticket.slot = DEVICE_STATS.begin_in_flight(upload)
         return ticket
 
     def resolve_segments_wire(self, ticket, codes2d: np.ndarray,
-                              quals2d: np.ndarray, starts: np.ndarray):
+                              quals2d: np.ndarray, starts: np.ndarray,
+                              _split_depth: int = 0):
         """Fetch + complete a device_call_segments_wire ticket.
 
         Same contract as resolve_segments: (winner, qual, depth, errors)
-        (J, L) arrays, suspects recomputed exactly by the f64 oracle."""
+        (J, L) arrays, suspects recomputed exactly by the f64 oracle. A
+        dispatch/fetch failure that survived the feeder's bounded retry
+        degrades instead of raising: RESOURCE_EXHAUSTED batches are halved
+        and re-dispatched (output order preserved), anything else falls
+        back to the native f64 host engine for this batch."""
         t0 = time.monotonic()
         fetched = 0
+        failure = None
         try:
             dev = ticket.wait()
             qs, wp = DEVICE_STATS.fetch(dev)
             fetched = qs.nbytes + wp.nbytes
+        except BaseException as e:  # noqa: BLE001 - recovered below
+            failure = e
         finally:
             # decrement even when the feeder/fetch raised — a leaked
             # in-flight count would silently route every later hybrid batch
             # to the host engine while the run still claims platform=tpu
             DEVICE_STATS.end_in_flight(ticket.slot, fetched,
                                        time.monotonic() - t0)
+        if failure is not None:
+            # only device weather is recoverable; KeyboardInterrupt /
+            # SystemExit and INVALID_ARGUMENT-class programming errors
+            # propagate (in-flight accounting above already balanced)
+            if not (_is_oom(failure) or _is_transient(failure)):
+                raise failure
+            return self._recover_segments(failure, codes2d, quals2d,
+                                          starts, _split_depth)
         J = len(starts) - 1
         if J == 0:
             L = qs.shape[-1]
@@ -1177,6 +1324,76 @@ class ConsensusKernel:
                 suspect, winner, qual, depth, errors,
                 lambda f: (codes2d[starts[f]:starts[f + 1]],
                            quals2d[starts[f]:starts[f + 1]]))
+        return winner, qual, depth, errors
+
+    def _recover_segments(self, exc, codes2d: np.ndarray,
+                          quals2d: np.ndarray, starts, split_depth: int):
+        """Degraded completion of a failed segment dispatch (never changes
+        output bytes — both recovery paths share the exactness contract).
+
+        RESOURCE_EXHAUSTED with more than one segment: halve at a segment
+        boundary and re-dispatch both halves through the wire path (depth
+        bounded by FGUMI_TPU_MAX_SPLITS, default 4), concatenating results
+        in order. Everything else — transient errors that exhausted the
+        bounded retry, OOM on a single segment, or split-depth exhaustion —
+        runs this batch on the native f64 host engine. Re-raises only when
+        the native library is unavailable."""
+        import os
+
+        starts = np.asarray(starts, dtype=np.int64)
+        J = len(starts) - 1
+        max_splits = int(os.environ.get("FGUMI_TPU_MAX_SPLITS", "4"))
+        # the wire layout packs 4 positions/byte, so halving re-dispatches
+        # only layouts the wire path can express (L % 4 == 0)
+        can_split = (_is_oom(exc) and J > 1 and split_depth < max_splits
+                     and codes2d.ndim == 2 and codes2d.shape[1] % 4 == 0)
+        if can_split:
+            DEVICE_STATS.add_split()
+            mid = J // 2
+            log.warning(
+                "device batch exhausted memory (%s); halving %d segments "
+                "into %d + %d and re-dispatching", exc, J, mid, J - mid)
+            halves = []
+            for lo, hi in ((0, mid), (mid, J)):
+                row_lo, row_hi = int(starts[lo]), int(starts[hi])
+                c = codes2d[row_lo:row_hi]
+                q = quals2d[row_lo:row_hi]
+                counts = np.diff(starts[lo:hi + 1])
+                cd, qd, seg_ids, sub_starts, f_pad = pad_segments(
+                    c, q, counts)
+                ticket = self.device_call_segments_wire(
+                    cd, qd, seg_ids, f_pad, hi - lo)
+                halves.append((ticket, c, q, sub_starts))
+            # resolve BOTH halves even if the first raises: an unresolved
+            # ticket would leak its in-flight slot (and silently route
+            # every later hybrid batch to the host engine)
+            parts, first_exc = [], None
+            for t, c, q, s in halves:
+                try:
+                    parts.append(self.resolve_segments_wire(
+                        t, c, q, s, _split_depth=split_depth + 1))
+                except BaseException as e:  # noqa: BLE001 - re-raised below
+                    if first_exc is None:
+                        first_exc = e
+            if first_exc is not None:
+                raise first_exc
+            return tuple(np.concatenate([p[i] for p in parts], axis=0)
+                         for i in range(4))
+        from ..native import batch as nb
+
+        if not nb.available():
+            raise exc
+        DEVICE_STATS.add_host_fallback()
+        log.warning(
+            "device dispatch failed after retries (%s: %s); computing "
+            "batch of %d segments on the native f64 host engine",
+            type(exc).__name__, exc, J)
+        engine = self._host()
+        winner, qual, depth, errors, n_slow = engine.call_segments_counted(
+            codes2d, quals2d, starts)
+        with self._counter_lock:
+            self.total_positions += winner.size
+            self.fallback_positions += n_slow
         return winner, qual, depth, errors
 
     # --------------------------------------------------- hard-column hybrid
@@ -1251,7 +1468,8 @@ class ConsensusKernel:
                 dd = jax.device_put(depths_dev)
                 return _consensus_columns_raw_jit(cd, qd, dd, correct, err,
                                                   pre, C_pad, C_out)
-        ticket = DEVICE_FEEDER.submit(_dispatch)
+        ticket = DEVICE_FEEDER.submit(
+            lambda: device_retry_call(_dispatch, "hard-column dispatch"))
         ticket.slot = DEVICE_STATS.begin_in_flight(upload)
         return ("cols_dev", easy, hard_idx, hard_depth, hard_counts, hc, hq,
                 ticket)
@@ -1269,13 +1487,34 @@ class ConsensusKernel:
         C = len(hard_idx)
         t0 = time.monotonic()
         fetched = 0
+        failure = None
         try:
             dev = ticket.wait()
             qs, wp = DEVICE_STATS.fetch(dev)
             fetched = qs.nbytes + wp.nbytes
+        except BaseException as e:  # noqa: BLE001 - recovered below
+            failure = e
         finally:
             DEVICE_STATS.end_in_flight(ticket.slot, fetched,
                                        time.monotonic() - t0)
+        if failure is not None:
+            if not (_is_oom(failure) or _is_transient(failure)):
+                raise failure
+            # degrade: the exported observation stream is exactly what the
+            # host f64 patch path consumes — recompute every hard column
+            # there (native guaranteed: classify already required it)
+            DEVICE_STATS.add_host_fallback()
+            log.warning(
+                "device dispatch failed after retries (%s: %s); resolving "
+                "%d hard columns on the native f64 host engine",
+                type(failure).__name__, failure, C)
+            self._patch_hard_columns(
+                np.ones(C, dtype=bool), hard_idx, hard_depth, hc, hq,
+                winner.ravel(), qual.ravel(), depth.ravel(), errors.ravel())
+            with self._counter_lock:
+                self.total_positions += winner.size
+                self.fallback_positions += C
+            return winner, qual, depth, errors
         w_col, q_col, suspect = unpack_result_split(
             qs.reshape(1, -1), wp.reshape(1, -1), 1)
         w_col = w_col.ravel()[:C].astype(np.uint8)
@@ -1374,7 +1613,13 @@ class ConsensusKernel:
                 self.total_positions += winner.size
                 self.fallback_positions += n_slow
             return winner, qual, depth, errors
-        packed = DEVICE_STATS.fetch(dev)
+        try:
+            packed = DEVICE_STATS.fetch(dev)
+        except BaseException as e:  # noqa: BLE001 - classified below
+            if not (_is_oom(e) or _is_transient(e)):
+                raise
+            return self._recover_segments(e, codes2d, quals2d,
+                                          np.asarray(starts, np.int64), 0)
         return self._finish_segments(packed, codes2d, quals2d, starts)
 
     def _finish_segments(self, packed: np.ndarray, codes2d, quals2d, starts):

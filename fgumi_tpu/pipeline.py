@@ -186,7 +186,12 @@ class _Watchdog:
                     self._budget.limit if self._budget else "n/a")
 
     def stop(self):
+        """Stop AND join the timer thread: a failed command must not leave
+        a daemon watchdog sampling dead queues behind it (the error path
+        out of run_stages calls this in its finally)."""
         self._stop.set()
+        if self._t is not None:
+            self._t.join(timeout=5)
 
 
 def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
@@ -227,6 +232,14 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
     """
     if stats is None:
         stats = StageTimes()
+    from .utils import faults
+
+    if faults.armed("pipeline.process"):
+        inner_process = process_fn
+
+        def process_fn(item):
+            faults.fire("pipeline.process")
+            return inner_process(item)
     has_resolve = resolve_fn is not None
     if resolve_fn is None:
         resolve_fn = lambda out: out  # noqa: E731

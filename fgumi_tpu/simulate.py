@@ -14,6 +14,15 @@ from .io.bam import (BamHeader, BamWriter, FLAG_FIRST, FLAG_LAST,
 import struct
 
 
+
+def _open_truth(truth_path):
+    """Truth-table output, crash-safe committed like every other output."""
+    if not truth_path:
+        return None
+    from .utils.atomic import open_output
+
+    return open_output(truth_path, "w")
+
 def _build_mapped_record(name, flag, ref_id, pos, mapq, cigar_ops, seq, quals,
                          next_ref_id, next_pos, tlen, tags):
     """Assemble a mapped BAM record (RecordBuilder only covers unmapped)."""
@@ -484,12 +493,20 @@ def simulate_fastq_reads(r1_path: str, r2_path: str, truth_path: str = None,
         return (q + 33).astype(np.uint8).tobytes()
 
     n_pairs = 0
-    truth_f = open(truth_path, "w") if truth_path else None
+    truth_f = _open_truth(truth_path)
     try:
         if truth_f:
             truth_f.write("family\tumi\tsize\n")
-        with gzip.open(r1_path, "wb", compresslevel=1) as f1, \
-                gzip.open(r2_path, "wb", compresslevel=1) as f2:
+        from .utils.atomic import open_output
+
+        # crash-safe like every other output: GzipFile closes (trailer)
+        # before the atomic wrapper commits; an exception discards both
+        with open_output(r1_path) as raw1, \
+                open_output(r2_path) as raw2, \
+                gzip.GzipFile(fileobj=raw1, mode="wb", compresslevel=1,
+                              mtime=0) as f1, \
+                gzip.GzipFile(fileobj=raw2, mode="wb", compresslevel=1,
+                              mtime=0) as f2:
             for fam in range(num_families):
                 size = _family_size(rng, family_size_distribution,
                                     family_size)
@@ -518,7 +535,13 @@ def simulate_fastq_reads(r1_path: str, r2_path: str, truth_path: str = None,
                              + qline(len(r2_seq),
                                      umi_length if duplex else 0) + b"\n")
                     n_pairs += 1
-    finally:
+    except BaseException:
+        if truth_f:
+            from .utils.atomic import discard_output
+
+            discard_output(truth_f)  # never commit a partial truth table
+        raise
+    else:
         if truth_f:
             truth_f.close()
     return n_pairs
@@ -542,7 +565,7 @@ def simulate_consensus_bam(path: str, truth_path: str = None,
         text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n"
              "@RG\tID:A\tSM:sample\tLB:lib\n",
         ref_names=[], ref_lengths=[])
-    truth_f = open(truth_path, "w") if truth_path else None
+    truth_f = _open_truth(truth_path)
     n = 0
     try:
         if truth_f:
@@ -573,7 +596,13 @@ def simulate_consensus_bam(path: str, truth_path: str = None,
                 n += 1
                 if truth_f:
                     truth_f.write(f"{name.decode()}\t{depth}\t{err:.6f}\n")
-    finally:
+    except BaseException:
+        if truth_f:
+            from .utils.atomic import discard_output
+
+            discard_output(truth_f)  # never commit a partial truth table
+        raise
+    else:
         if truth_f:
             truth_f.close()
     return n
@@ -598,7 +627,7 @@ def simulate_correct_reads(path: str, includelist_path: str,
     header = BamHeader(text="@HD\tVN:1.6\tSO:unsorted\n"
                             "@RG\tID:A\tSM:sample\tLB:lib\n",
                        ref_names=[], ref_lengths=[])
-    truth_f = open(truth_path, "w") if truth_path else None
+    truth_f = _open_truth(truth_path)
     try:
         if truth_f:
             truth_f.write("name\ttrue_umi\tobserved_umi\terrors\n")
@@ -629,7 +658,13 @@ def simulate_correct_reads(path: str, includelist_path: str,
                 if truth_f:
                     truth_f.write(f"r{i}\t{true_umi.decode()}\t"
                                   f"{observed.decode()}\t{n_err}\n")
-    finally:
+    except BaseException:
+        if truth_f:
+            from .utils.atomic import discard_output
+
+            discard_output(truth_f)  # never commit a partial truth table
+        raise
+    else:
         if truth_f:
             truth_f.close()
     return num_reads

@@ -1,0 +1,203 @@
+"""Crash-safe output commit: write to a temp file, fsync, atomic rename.
+
+A killed process (OOM killer, SIGKILL, node preemption) must never leave a
+truncated BAM/FASTQ/metrics file under the final output name — a torn BGZF
+tail *looks* valid to a consumer until it hits the missing EOF sentinel
+mid-analysis. Every command output therefore goes to a same-directory
+``.<name>.tmp.<pid>`` and is fsync'd + atomically renamed over the final
+name only on successful close (the rename is atomic on POSIX because the
+temp lives in the same directory, hence the same filesystem).
+
+Escape hatch: the ``--no-atomic-output`` CLI flag or
+``FGUMI_TPU_NO_ATOMIC=1`` writes directly to the final name (e.g. for
+FIFO/special-file outputs, or filesystems where the extra rename matters).
+
+Stale temps from crashed runs are swept opportunistically: opening an
+atomic output for ``name`` removes ``.name.tmp.<pid>`` leftovers whose pid
+is no longer alive.
+"""
+
+import errno
+import glob
+import logging
+import os
+
+log = logging.getLogger("fgumi_tpu")
+
+_flag_disabled = False  # set by the CLI's --no-atomic-output
+
+
+def set_atomic_enabled(enabled: bool):
+    """CLI hook for --no-atomic-output (process-wide, per invocation)."""
+    global _flag_disabled
+    _flag_disabled = not enabled
+
+
+def atomic_enabled() -> bool:
+    if _flag_disabled:
+        return False
+    return os.environ.get("FGUMI_TPU_NO_ATOMIC", "").lower() \
+        not in ("1", "true", "yes")
+
+
+def _tmp_path(path: str) -> str:
+    d, base = os.path.split(os.path.abspath(path))
+    return os.path.join(d, f".{base}.tmp.{os.getpid()}")
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    except (OSError, OverflowError):
+        return False
+    return True
+
+
+def cleanup_stale_temps(path: str):
+    """Remove ``.<name>.tmp.<pid>`` leftovers (for this target) whose
+    writing process is gone. Best-effort: unlink races are ignored."""
+    d, base = os.path.split(os.path.abspath(path))
+    pattern = os.path.join(glob.escape(d), f".{glob.escape(base)}.tmp.*")
+    for p in glob.glob(pattern):
+        pid_s = p.rsplit(".", 1)[-1]
+        try:
+            pid = int(pid_s)
+        except ValueError:
+            continue
+        if pid == os.getpid() or _pid_alive(pid):
+            continue
+        try:
+            os.unlink(p)
+            log.info("removed stale temp output %s (pid %d is gone)", p, pid)
+        except OSError:
+            pass
+
+
+def _fsync_dir(d: str):
+    """Persist the rename itself (the directory entry), best-effort."""
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+class AtomicOutputFile:
+    """File-like write target committed by atomic rename.
+
+    ``close()`` commits (flush + fsync + rename to the final name);
+    ``discard()`` abandons the temp file. As a context manager, a clean
+    exit commits and an exception discards — an interrupted run can never
+    leave a partial file under the final name either way.
+    """
+
+    def __init__(self, path: str, mode: str = "wb"):
+        self.name = path
+        self._tmp = _tmp_path(path)
+        cleanup_stale_temps(path)
+        self._f = open(self._tmp, mode)
+        self._done = False
+
+    # -- the file-object surface the writers actually use ------------------
+    def write(self, data):
+        return self._f.write(data)
+
+    def flush(self):
+        self._f.flush()
+
+    def fileno(self):
+        return self._f.fileno()
+
+    def tell(self):
+        return self._f.tell()
+
+    def writable(self):
+        return True
+
+    @property
+    def closed(self):
+        return self._done
+
+    # -- commit protocol ---------------------------------------------------
+    def commit(self):
+        if self._done:
+            return
+        try:
+            self._f.flush()
+            try:
+                os.fsync(self._f.fileno())
+            except OSError as e:
+                # only targets that cannot fsync (pipes, /dev/null) are
+                # ignorable; a real write-back failure (EIO, ENOSPC) must
+                # NOT commit — that would rename data the kernel just
+                # reported as unwritten over the final name
+                if e.errno not in (errno.EINVAL, errno.ENOTSUP,
+                                   errno.EBADF, errno.EROFS):
+                    raise
+            self._f.close()
+            os.replace(self._tmp, self.name)
+        except BaseException:
+            # ANY commit failure (flush ENOSPC, close, rename) discards:
+            # the temp must not linger with an open fd, and _done must not
+            # be set early or the discard would no-op
+            self.discard()
+            raise
+        self._done = True
+        _fsync_dir(os.path.dirname(self.name) or ".")
+
+    def discard(self):
+        """Abandon the output: close and remove the temp file."""
+        if self._done:
+            return
+        self._done = True
+        try:
+            self._f.close()
+        finally:
+            try:
+                os.unlink(self._tmp)
+            except OSError:
+                pass
+
+    # plain close == successful completion (matches every writer's
+    # success-path close() call); error paths use discard()/__exit__
+    close = commit
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.commit()
+        else:
+            self.discard()
+
+
+def open_output(path: str, mode: str = "wb"):
+    """Open a command output for writing, atomically unless disabled.
+
+    Returns an :class:`AtomicOutputFile` (or a plain file when atomic
+    commit is disabled). Both support the context-manager protocol and
+    ``discard()`` is present only on the atomic variant — error paths use
+    :func:`discard_output` which handles either.
+    """
+    if atomic_enabled():
+        return AtomicOutputFile(path, mode)
+    return open(path, mode)
+
+
+def discard_output(fileobj):
+    """Abandon an open_output() object: discard if atomic, else close."""
+    disc = getattr(fileobj, "discard", None)
+    if disc is not None:
+        disc()
+    else:
+        fileobj.close()

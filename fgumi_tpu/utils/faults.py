@@ -1,0 +1,193 @@
+"""Deterministic fault injection for chaos testing.
+
+The pipeline's watchdog, error plumbing, device retry, and crash-safe
+output commit all claim to handle specific failure modes; this registry
+makes every one of them *provable* by injecting those failures on demand
+at named points in the real code paths (the analog of a failpoint
+framework: each point is a one-line `faults.fire(...)` call that is a
+cheap no-op unless armed).
+
+Arm via the environment::
+
+    FGUMI_TPU_FAULT=point:kind:prob[:count][,point:kind:prob[:count]...]
+
+- ``point``: one of :data:`FAULT_POINTS` (unknown names are a loud
+  ``ValueError`` at the first fire — a typo must not silently disarm a
+  chaos test).
+- ``kind``: ``raise`` (an :class:`InjectedFault`), ``hang`` (sleep for
+  ``FGUMI_TPU_FAULT_HANG_S`` seconds, default 30 — what the stall
+  watchdog exists to diagnose), ``corrupt-bytes`` (deterministically flip
+  bytes in the payload passing through the point), or ``oom`` (an
+  :class:`InjectedOom` whose message carries ``RESOURCE_EXHAUSTED``, the
+  XLA out-of-memory status the device retry path batch-splits on).
+- ``prob``: trigger probability per fire, drawn from a
+  ``random.Random`` seeded by ``FGUMI_TPU_FAULT_SEED`` (default 0) xor
+  the point name, so single-threaded runs are exactly reproducible.
+- ``count``: optional cap on total triggers (default unlimited). With
+  ``prob`` 1.0 this makes multi-threaded runs deterministic too: the
+  first ``count`` arrivals trigger, every later one passes.
+
+Faults are re-parsed whenever the env var's value changes, so tests can
+arm/disarm between in-process CLI runs without touching this module.
+"""
+
+import logging
+import os
+import random
+import threading
+import time
+import zlib
+
+log = logging.getLogger("fgumi_tpu")
+
+#: Named fault points threaded through the codebase.
+FAULT_POINTS = frozenset({
+    "reader.decompress",   # BGZF/gzip reader raw-chunk ingest (io/bgzf.py)
+    "pipeline.process",    # per-item process stage (pipeline.run_stages)
+    "device.dispatch",     # XLA upload+dispatch attempt (ops/kernel.py)
+    "writer.compress",     # BGZF writer block emit (io/bgzf.py)
+    "native.batch",        # native batch-op entry (native/batch.py)
+})
+
+KINDS = frozenset({"raise", "hang", "corrupt-bytes", "oom"})
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised on purpose by the injection registry."""
+
+
+class InjectedOom(InjectedFault):
+    """Injected out-of-memory; message carries RESOURCE_EXHAUSTED so the
+    device retry path classifies it exactly like a real XLA OOM."""
+
+
+class _Fault:
+    __slots__ = ("point", "kind", "prob", "remaining", "rng", "fired")
+
+    def __init__(self, point, kind, prob, count, seed):
+        self.point = point
+        self.kind = kind
+        self.prob = prob
+        self.remaining = count  # -1 = unlimited
+        self.fired = 0
+        # per-point stream: arming two points never couples their coins.
+        # crc32, not hash(): str hash is salted per process (PYTHONHASHSEED)
+        # and the whole contract here is cross-process reproducibility.
+        self.rng = random.Random((seed << 32) ^ zlib.crc32(point.encode()))
+
+
+_lock = threading.Lock()
+_env_cache = None  # last-parsed value of FGUMI_TPU_FAULT
+_armed = {}  # point -> _Fault
+
+
+def _parse(env: str) -> dict:
+    seed = int(os.environ.get("FGUMI_TPU_FAULT_SEED", "0"))
+    armed = {}
+    for spec in env.split(","):
+        spec = spec.strip()
+        if not spec:
+            continue
+        parts = spec.split(":")
+        if len(parts) not in (3, 4):
+            raise ValueError(
+                f"FGUMI_TPU_FAULT spec {spec!r}: expected "
+                "point:kind:prob[:count]")
+        point, kind, prob = parts[0], parts[1], float(parts[2])
+        count = int(parts[3]) if len(parts) == 4 else -1
+        if point not in FAULT_POINTS:
+            raise ValueError(
+                f"FGUMI_TPU_FAULT: unknown fault point {point!r} "
+                f"(known: {', '.join(sorted(FAULT_POINTS))})")
+        if kind not in KINDS:
+            raise ValueError(
+                f"FGUMI_TPU_FAULT: unknown kind {kind!r} "
+                f"(known: {', '.join(sorted(KINDS))})")
+        armed[point] = _Fault(point, kind, prob, count, seed)
+        log.warning("fault injection armed: %s kind=%s prob=%g count=%s",
+                    point, kind, prob, count if count >= 0 else "inf")
+    return armed
+
+
+def _refresh_locked():
+    global _env_cache, _armed
+    env = os.environ.get("FGUMI_TPU_FAULT", "")
+    if env == _env_cache:
+        return
+    _env_cache = env
+    _armed = _parse(env) if env else {}
+
+
+def reset():
+    """Drop parsed state so the next fire() re-reads the environment (and
+    trigger budgets restart). Tests use this between in-process runs that
+    reuse an identical FGUMI_TPU_FAULT value."""
+    global _env_cache
+    with _lock:
+        _env_cache = None
+        _armed.clear()
+
+
+def armed(point: str) -> bool:
+    """True when `point` has an armed fault with trigger budget left."""
+    with _lock:
+        _refresh_locked()
+        f = _armed.get(point)
+        return f is not None and f.remaining != 0
+
+
+def fire(point: str, data=None):
+    """Trigger the fault armed at `point`, if any.
+
+    Returns `data` (possibly corrupted for kind ``corrupt-bytes``); raises
+    for kinds ``raise``/``oom``; sleeps for kind ``hang``. A cheap no-op
+    (one env read + dict lookup) when nothing is armed.
+    """
+    with _lock:
+        _refresh_locked()
+        f = _armed.get(point)
+        if f is None or f.remaining == 0:
+            return data
+        if f.prob < 1.0 and f.rng.random() >= f.prob:
+            return data
+        if f.remaining > 0:
+            f.remaining -= 1
+        f.fired += 1
+        kind = f.kind
+        if kind == "corrupt-bytes":
+            if data is None:
+                return None
+            out = _corrupt(f.rng, data)
+            log.warning("fault injection: corrupted %d bytes at %s",
+                        len(out), point)
+            return out
+    # act outside the lock: a hang must not wedge every other fire()
+    if kind == "raise":
+        log.warning("fault injection: raising at %s", point)
+        raise InjectedFault(f"injected fault at {point}")
+    if kind == "oom":
+        log.warning("fault injection: injected OOM at %s", point)
+        raise InjectedOom(
+            f"RESOURCE_EXHAUSTED: injected out-of-memory at {point}")
+    # hang
+    t = float(os.environ.get("FGUMI_TPU_FAULT_HANG_S", "30"))
+    log.warning("fault injection: hanging %.1fs at %s", t, point)
+    time.sleep(t)
+    return data
+
+
+def _corrupt(rng, data):
+    """Flip a deterministic handful of bytes (~1 per KiB, max 16)."""
+    b = bytearray(data)
+    if not b:
+        return bytes(b)
+    for _ in range(min(max(len(b) // 1024, 1), 16)):
+        b[rng.randrange(len(b))] ^= 0xFF
+    return bytes(b)
+
+
+def snapshot():
+    """{point: fired count} for armed faults (chaos-test assertions)."""
+    with _lock:
+        _refresh_locked()
+        return {p: f.fired for p, f in _armed.items()}

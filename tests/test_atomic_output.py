@@ -1,0 +1,178 @@
+"""Crash-safe output commit tests (utils/atomic.py + writer wiring).
+
+The contract: an interrupted run — Python exception, SIGKILL, anything —
+never leaves a partial file under the final output name; a successful run
+always leaves exactly the final file (temp renamed, fsync'd)."""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fgumi_tpu.io.bam import BamHeader, BamReader, BamWriter
+from fgumi_tpu.utils import atomic
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HDR = BamHeader(text="@HD\tVN:1.6\n@SQ\tSN:chr1\tLN:1000\n",
+                ref_names=["chr1"], ref_lengths=[1000])
+
+
+def _temps(path):
+    d, base = os.path.split(os.path.abspath(str(path)))
+    return [p for p in os.listdir(d) if p.startswith(f".{base}.tmp.")]
+
+
+def test_commit_renames_and_cleans(tmp_path):
+    out = tmp_path / "x.txt"
+    f = atomic.AtomicOutputFile(str(out), "w")
+    f.write("hello")
+    assert not out.exists()  # nothing under the final name mid-write
+    assert _temps(out)
+    f.close()
+    assert out.read_text() == "hello"
+    assert not _temps(out)
+
+
+def test_discard_removes_temp(tmp_path):
+    out = tmp_path / "x.txt"
+    f = atomic.AtomicOutputFile(str(out), "w")
+    f.write("partial")
+    f.discard()
+    assert not out.exists()
+    assert not _temps(out)
+
+
+def test_context_manager_discards_on_exception(tmp_path):
+    out = tmp_path / "x.bin"
+    with pytest.raises(RuntimeError):
+        with atomic.AtomicOutputFile(str(out)) as f:
+            f.write(b"partial")
+            raise RuntimeError("boom")
+    assert not out.exists()
+    assert not _temps(out)
+
+
+def test_stale_temp_cleanup(tmp_path):
+    out = tmp_path / "y.bam"
+    # a dead pid: spawn-and-reap a real process so the pid genuinely existed
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait()
+    stale = tmp_path / f".y.bam.tmp.{dead.pid}"
+    stale.write_bytes(b"leftover")
+    # opening an atomic output for the same target sweeps it
+    f = atomic.AtomicOutputFile(str(out))
+    try:
+        assert not stale.exists()
+    finally:
+        f.discard()
+
+
+def test_escape_hatch_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("FGUMI_TPU_NO_ATOMIC", "1")
+    out = tmp_path / "direct.txt"
+    f = atomic.open_output(str(out), "w")
+    try:
+        f.write("x")
+        assert out.exists()  # written directly under the final name
+    finally:
+        f.close()
+    assert not isinstance(f, atomic.AtomicOutputFile)
+
+
+def test_bam_writer_exception_leaves_no_final_file(tmp_path):
+    out = tmp_path / "torn.bam"
+    with pytest.raises(RuntimeError):
+        with BamWriter(str(out), HDR) as w:
+            w.write_record_bytes(b"\x00" * 64)
+            raise RuntimeError("mid-write failure")
+    assert not out.exists()
+    assert not _temps(out)
+
+
+def test_bam_writer_success_roundtrip(tmp_path):
+    out = tmp_path / "ok.bam"
+    with BamWriter(str(out), HDR) as w:
+        pass
+    with BamReader(str(out)) as r:
+        assert "chr1" in r.header.text
+    assert not _temps(out)
+
+
+def test_write_metrics_atomic(tmp_path):
+    from fgumi_tpu.metrics import write_metrics
+
+    out = tmp_path / "m.txt"
+    write_metrics(str(out), [{"a": 1, "b": 2}])
+    assert out.read_text() == "a\tb\n1\t2\n"
+    assert not _temps(out)
+
+
+def test_failed_writer_never_commits_via_gc(tmp_path, monkeypatch):
+    """Regression: a writer whose write() raised must DISCARD on close —
+    including the implicit close from IOBase.__del__ at GC — never rename
+    its half-written temp under the final name."""
+    import gc
+
+    from fgumi_tpu.utils import faults
+
+    monkeypatch.setenv("FGUMI_TPU_FAULT", "writer.compress:raise:1.0:1")
+    faults.reset()
+    out = tmp_path / "poisoned.bam"
+    with pytest.raises(faults.InjectedFault):
+        BamWriter(str(out), HDR)  # header write hits the injected fault
+    monkeypatch.delenv("FGUMI_TPU_FAULT")
+    faults.reset()
+    gc.collect()
+    assert not out.exists()
+    assert not _temps(out)
+
+
+def test_sigkill_mid_write_leaves_no_partial_file(tmp_path):
+    """Acceptance: SIGKILL while a BAM is being written leaves nothing
+    under the final output name; the orphaned temp is swept by the next
+    atomic open of the same target."""
+    out = tmp_path / "victim.bam"
+    code = f"""
+import sys, time
+sys.path.insert(0, {REPO!r})
+from fgumi_tpu.io.bam import BamHeader, BamWriter
+hdr = BamHeader(text="@HD\\tVN:1.6\\n@SQ\\tSN:chr1\\tLN:1000\\n",
+                ref_names=["chr1"], ref_lengths=[1000])
+w = BamWriter({str(out)!r}, hdr, level=0)
+print("WRITING", flush=True)
+i = 0
+while True:
+    w.write_record_bytes(b"\\x00" * 4096)
+    if i % 64 == 0:
+        w._w.flush(); w._w._f.flush()
+    i += 1
+    time.sleep(0.001)
+"""
+    p = subprocess.Popen([sys.executable, "-c", code],
+                         stdout=subprocess.PIPE, text=True)
+    try:
+        assert p.stdout.readline().strip() == "WRITING"
+        deadline = time.monotonic() + 10
+        while not _temps(out) and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert _temps(out), "writer never created its temp file"
+        time.sleep(0.2)  # let some record bytes land
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=10)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert not out.exists(), "SIGKILL left a partial file under the final name"
+    leftovers = _temps(out)
+    assert leftovers, "temp should remain after SIGKILL (to be swept later)"
+    # next atomic open of the same target sweeps the dead-pid temp
+    f = atomic.AtomicOutputFile(str(out))
+    try:
+        assert not _temps(out) or _temps(out) == [
+            f".victim.bam.tmp.{os.getpid()}"]
+    finally:
+        f.discard()

@@ -354,9 +354,11 @@ print("INFLIGHT-OK")
     out = proc.stdout + proc.stderr
     assert "INFLIGHT-OK" in out, out
     assert "in-flight leak" not in out, out
-    # the failure must have been VISIBLE (raised or nonzero rc), not
-    # silently swallowed into a success
-    assert "RAISED" in out or "RC 0" not in out, out
+    # the failure must have been VISIBLE — raised, nonzero rc, or (since
+    # the resilience layer) loudly recovered onto the host f64 engine with
+    # a warning — never silently swallowed into an unexplained success
+    recovered = "host engine" in out and "failed" in out
+    assert "RAISED" in out or "RC 0" not in out or recovered, out
 
 
 def test_duplex_deferred_hybrid_cli_bytes(tmp_path):

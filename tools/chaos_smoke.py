@@ -1,0 +1,153 @@
+#!/usr/bin/env python3
+"""Chaos smoke: run one command under each injected fault and check the
+exit-code contract, then SIGKILL a run mid-write and check crash-safe
+commit (no partial file under the final output name).
+
+Usage:  python tools/chaos_smoke.py [--keep]
+
+Exit 0 when every scenario holds; prints a one-line PASS/FAIL per
+scenario. Used as the fast out-of-pytest resilience gate (ROADMAP: chaos
+tooling satellite); the equivalent in-pytest coverage lives in
+tests/test_faults.py / tests/test_atomic_output.py.
+"""
+
+import argparse
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+BASE_ENV = {
+    **os.environ,
+    "PYTHONPATH": REPO,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "",
+    "PALLAS_AXON_POOL_IPS": "",
+}
+
+
+def run(args, env=None, timeout=300, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "fgumi_tpu", *args], cwd=cwd,
+        env={**BASE_ENV, **(env or {})}, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def check(name, ok, detail=""):
+    print(f"{'PASS' if ok else 'FAIL'}  {name}" + (f"  ({detail})"
+                                                   if detail else ""))
+    return ok
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch directory")
+    opts = ap.parse_args()
+    tmp = tempfile.mkdtemp(prefix="fgumi_chaos_")
+    ok = True
+    try:
+        sim = os.path.join(tmp, "sim.bam")
+        p = run(["simulate", "grouped-reads", "-o", sim,
+                 "--num-families", "25", "--family-size", "4",
+                 "--seed", "11"])
+        assert p.returncode == 0, p.stderr
+
+        # clean reference run (device path). Each parity run uses its own
+        # cwd with a RELATIVE -o so argv — and hence the @PG CL header
+        # line — is byte-identical across runs.
+        clean_dir = os.path.join(tmp, "clean")
+        os.mkdir(clean_dir)
+        p = run(["simplex", "-i", sim, "-o", "out.bam", "--min-reads", "1"],
+                env={"FGUMI_TPU_HOST_ENGINE": "0"}, cwd=clean_dir)
+        assert p.returncode == 0, p.stderr
+        clean = open(os.path.join(clean_dir, "out.bam"), "rb").read()
+
+        # 1) host-side faults: clean nonzero exit, no partial final file
+        for point in ("reader.decompress", "writer.compress",
+                      "native.batch", "pipeline.process"):
+            d = os.path.join(tmp, point.replace(".", "_"))
+            os.mkdir(d)
+            out = os.path.join(d, "out.bam")
+            extra = (["--threads", "4"] if point == "pipeline.process"
+                     else [])
+            p = run(["simplex", "-i", sim, "-o", out, "--min-reads", "1",
+                     *extra],
+                    env={"FGUMI_TPU_FAULT": f"{point}:raise:1.0:1"})
+            failed_clean = p.returncode != 0 and not os.path.exists(out) \
+                and "Traceback" not in p.stderr
+            completed = p.returncode == 0 and os.path.exists(out)
+            ok &= check(f"{point}:raise -> clean error or completion",
+                        failed_clean or completed,
+                        f"rc={p.returncode}")
+
+        # 2) device retry: two injected failures absorbed, byte-identical
+        for spec, name in (
+                ("device.dispatch:raise:1.0:2", "retry"),
+                ("device.dispatch:raise:1.0", "host-fallback"),
+                ("device.dispatch:oom:1.0:1", "oom-split")):
+            d = os.path.join(tmp, name)
+            os.mkdir(d)
+            env = {"FGUMI_TPU_HOST_ENGINE": "0", "FGUMI_TPU_FAULT": spec,
+                   "FGUMI_TPU_DEVICE_BACKOFF_S": "0.01"}
+            if name == "oom-split":
+                env["FGUMI_TPU_HYBRID"] = "0"
+            p = run(["simplex", "-i", sim, "-o", "out.bam",
+                     "--min-reads", "1"], env=env, cwd=d)
+            got = (open(os.path.join(d, "out.bam"), "rb").read()
+                   if p.returncode == 0 else b"")
+            if name == "oom-split":
+                # the wire path (HYBRID=0) has its own clean reference
+                d2 = os.path.join(tmp, "oom_clean")
+                os.mkdir(d2)
+                p2 = run(["simplex", "-i", sim, "-o", "out.bam",
+                          "--min-reads", "1"],
+                         env={"FGUMI_TPU_HOST_ENGINE": "0",
+                              "FGUMI_TPU_HYBRID": "0"}, cwd=d2)
+                ref = open(os.path.join(d2, "out.bam"), "rb").read() \
+                    if p2.returncode == 0 else b"?"
+            else:
+                ref = clean
+            ok &= check(f"device.dispatch {name} -> byte-identical",
+                        p.returncode == 0 and got == ref,
+                        f"rc={p.returncode}")
+
+        # 3) SIGKILL mid-write: no partial file under the final name
+        victim = os.path.join(tmp, "victim.bam")
+        code = (
+            "import sys, time\n"
+            f"sys.path.insert(0, {REPO!r})\n"
+            "from fgumi_tpu.io.bam import BamHeader, BamWriter\n"
+            "hdr = BamHeader(text='@HD\\tVN:1.6\\n@SQ\\tSN:c\\tLN:9\\n',\n"
+            "                ref_names=['c'], ref_lengths=[9])\n"
+            f"w = BamWriter({victim!r}, hdr, level=0)\n"
+            "print('WRITING', flush=True)\n"
+            "while True:\n"
+            "    w.write_record_bytes(b'\\x00' * 4096)\n"
+            "    w._w.flush(); w._w._f.flush()\n"
+            "    time.sleep(0.002)\n")
+        child = subprocess.Popen([sys.executable, "-c", code],
+                                 stdout=subprocess.PIPE, text=True,
+                                 env=BASE_ENV)
+        child.stdout.readline()
+        time.sleep(0.5)
+        child.send_signal(signal.SIGKILL)
+        child.wait(timeout=10)
+        ok &= check("SIGKILL mid-write -> no partial final file",
+                    not os.path.exists(victim))
+    finally:
+        if opts.keep:
+            print("scratch kept at", tmp)
+        else:
+            shutil.rmtree(tmp, ignore_errors=True)
+    print("chaos smoke:", "OK" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1096,8 +1096,10 @@ def cmd_group(args):
                             "--no-umi cannot be combined with the paired "
                             "strategy")
                     from .pipeline import StageTimes, run_stages
+                    from .utils.progress import ProgressTracker
 
                     stats_t = StageTimes()
+                    progress = ProgressTracker("group")
                     grouper = FastGrouper(
                         reader.header, make_assigner(args.strategy, args.edits),
                         umi_tag=args.raw_tag.encode(),
@@ -1107,12 +1109,21 @@ def cmd_group(args):
                         min_umi_length=args.min_umi_length,
                         no_umi=args.no_umi,
                         allow_unmapped=args.allow_unmapped)
-                    run_stages(iter(reader), grouper.process_batch,
-                               writer.write_serialized,
-                               threads=args.threads, stats=stats_t,
-                               **_stage_kwargs(args))
-                    for chunk in grouper.flush():
-                        writer.write_serialized(chunk)
+
+                    def _process(batch):
+                        progress.add(batch.n)
+                        return grouper.process_batch(batch)
+
+                    try:
+                        run_stages(iter(reader), _process,
+                                   writer.write_serialized,
+                                   threads=args.threads, stats=stats_t,
+                                   **_stage_kwargs(args))
+                        for chunk in grouper.flush():
+                            writer.write_serialized(chunk)
+                    finally:
+                        # failure reports still carry records.group
+                        progress.finish()
                     result = grouper.result()
                     if getattr(args, "stats", False):
                         _print_stats(stats_t)
@@ -2578,8 +2589,10 @@ def cmd_dedup(args):
                     if args.no_umi:
                         strategy, edits = "identity", 0
                     from .pipeline import StageTimes, run_stages
+                    from .utils.progress import ProgressTracker
 
                     stats_t = StageTimes()
+                    progress = ProgressTracker("dedup")
                     dd = FastDedup(
                         reader.header, make_assigner(strategy, edits),
                         min_mapq=args.min_map_q,
@@ -2588,12 +2601,21 @@ def cmd_dedup(args):
                         no_umi=args.no_umi,
                         include_unmapped=args.include_unmapped,
                         remove_duplicates=args.remove_duplicates)
-                    run_stages(iter(reader), dd.process_batch,
-                               writer.write_serialized,
-                               threads=args.threads, stats=stats_t,
-                               **_stage_kwargs(args))
-                    for chunk in dd.flush():
-                        writer.write_serialized(chunk)
+
+                    def _process(batch):
+                        progress.add(batch.n)
+                        return dd.process_batch(batch)
+
+                    try:
+                        run_stages(iter(reader), _process,
+                                   writer.write_serialized,
+                                   threads=args.threads, stats=stats_t,
+                                   **_stage_kwargs(args))
+                        for chunk in dd.flush():
+                            writer.write_serialized(chunk)
+                    finally:
+                        # failure reports still carry records.dedup
+                        progress.finish()
                     metrics, family_sizes = dd.result()
                     if getattr(args, "stats", False):
                         _print_stats(stats_t)
@@ -2768,12 +2790,34 @@ def build_parser():
         prog="fgumi-tpu",
         description="TPU-native toolkit for UMI-tagged sequencing data",
     )
-    parser.add_argument("-v", "--verbose", action="store_true")
+    parser.add_argument("-v", "--verbose", action="store_true",
+                        help="alias for --log-level debug (superseded by an "
+                             "explicit --log-level)")
+    parser.add_argument(
+        "--log-level", choices=["debug", "info", "warning", "error"],
+        default=None,
+        help="log verbosity (also FGUMI_TPU_LOG); every line carries "
+             "elapsed time and the emitting thread's name")
     parser.add_argument(
         "--no-atomic-output", action="store_true",
         help="write outputs directly to their final names instead of the "
              "crash-safe temp-file + atomic-rename commit (escape hatch "
              "for FIFO outputs; also FGUMI_TPU_NO_ATOMIC=1)")
+    parser.add_argument(
+        "--trace", default=None, metavar="PATH",
+        help="record pipeline/IO/device spans and write a Chrome "
+             "trace-event JSON loadable in Perfetto (also FGUMI_TPU_TRACE)")
+    parser.add_argument(
+        "--run-report", default=None, metavar="PATH",
+        help="write a schema-versioned JSON run report (wall time, "
+             "per-stage busy/blocked, queue occupancy, device + I/O "
+             "counters, exit status) at command end "
+             "(also FGUMI_TPU_RUN_REPORT)")
+    parser.add_argument(
+        "--heartbeat", type=float, default=None, metavar="SECONDS",
+        help="log a one-line progress heartbeat (stage counters, queue "
+             "depths, device activity, RSS) every N seconds "
+             "(also FGUMI_TPU_HEARTBEAT_S; 0 = off, the default)")
     sub = parser.add_subparsers(dest="command", required=True)
     _add_extract(sub)
     _add_correct(sub)
@@ -2798,19 +2842,14 @@ def build_parser():
     return parser
 
 
-def main(argv=None):
-    parser = build_parser()
-    args = parser.parse_args(argv)
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
-    )
-    from .utils.atomic import set_atomic_enabled
+# nesting depth of in-process main() calls: the `pipeline` command re-enters
+# main() per stage, and the telemetry lifecycle (trace export, run report,
+# per-command counter reset) belongs to the OUTERMOST invocation only
+_main_depth = 0
 
-    set_atomic_enabled(not args.no_atomic_output)
-    rc = _apply_pipeline_compat(args)
-    if rc:
-        return rc
+
+def _run_command(args):
+    """Dispatch to the subcommand with the top-level exception contract."""
     from .io.errors import InputFormatError
     from .utils.faults import InjectedFault
 
@@ -2837,6 +2876,99 @@ def main(argv=None):
     except KeyboardInterrupt:
         log.error("interrupted")
         return 130
+
+
+def _telemetry_config(args):
+    """(trace_path, report_path, heartbeat_s) from flags + environment."""
+    trace_path = args.trace or os.environ.get("FGUMI_TPU_TRACE") or None
+    report_path = (args.run_report
+                   or os.environ.get("FGUMI_TPU_RUN_REPORT") or None)
+    hb_s = args.heartbeat
+    if hb_s is None:
+        try:
+            hb_s = float(os.environ.get("FGUMI_TPU_HEARTBEAT_S", "0") or 0)
+        except ValueError:
+            log.warning("FGUMI_TPU_HEARTBEAT_S=%s: not a number; heartbeat "
+                        "off", os.environ["FGUMI_TPU_HEARTBEAT_S"])
+            hb_s = 0.0
+    return trace_path, report_path, hb_s
+
+
+def main(argv=None):
+    global _main_depth
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    from .observe.logs import setup_logging
+
+    # nested stages of a chained command (depth > 0) inherit the outer
+    # invocation's level unless they carry an explicit flag: re-running
+    # setup at the default would reset an operator's --log-level debug
+    # back to info after the first `pipeline` stage
+    if _main_depth == 0 or args.log_level or args.verbose:
+        setup_logging(args.log_level, args.verbose)
+    from .utils.atomic import set_atomic_enabled
+
+    set_atomic_enabled(not args.no_atomic_output)
+    rc = _apply_pipeline_compat(args)
+    if rc:
+        return rc
+    if _main_depth > 0:
+        # nested stage of a chained command: the outer invocation owns the
+        # telemetry lifecycle; this stage just accumulates into it
+        return _run_command(args)
+
+    trace_path, report_path, hb_s = _telemetry_config(args)
+    from .observe.metrics import METRICS
+
+    # per-command isolation: back-to-back CLI invocations in one process
+    # (tests, the chained `pipeline` driver) must not cross-contaminate
+    # device or metric counters across reports. The kernel module is only
+    # reset when already imported — a fresh import starts zeroed, and
+    # importing it here would tax numpy-free commands with its import
+    kern = sys.modules.get("fgumi_tpu.ops.kernel")
+    METRICS.reset()
+    if kern is not None:
+        kern.DEVICE_STATS.reset()
+    tracer = hb = None
+    if trace_path:
+        from .observe.trace import start_trace
+
+        tracer = start_trace()
+    if hb_s > 0:
+        from .observe.heartbeat import Heartbeat
+
+        hb = Heartbeat(hb_s)
+    t0 = time.monotonic()
+    t0_unix = time.time()
+    rc = 1  # report value when the command dies on an unmapped exception
+    _main_depth += 1
+    try:
+        rc = _run_command(args)
+        return rc
+    finally:
+        _main_depth -= 1
+        if hb is not None:
+            hb.stop()
+        if tracer is not None:
+            from .observe.trace import stop_trace, write_trace
+
+            stop_trace()
+            try:
+                write_trace(trace_path, tracer)
+                log.info("trace: %d spans -> %s (open in "
+                         "https://ui.perfetto.dev)",
+                         len(tracer.snapshot()), trace_path)
+            except OSError as e:
+                log.error("failed to write trace %s: %s", trace_path, e)
+        if report_path:
+            from .observe.report import emit, fold_device_stats
+
+            fold_device_stats()
+            report = emit(report_path, args.command,
+                          list(argv) if argv is not None else sys.argv[1:],
+                          t0_unix, time.monotonic() - t0, rc, trace_path)
+            if report is not None:
+                log.info("run report -> %s", report_path)
 
 
 if __name__ == "__main__":

@@ -13,6 +13,8 @@ import io
 import struct
 import zlib
 
+from ..observe import trace as _trace
+from ..observe.metrics import METRICS
 from ..utils import faults
 from .errors import InputFormatError
 
@@ -77,8 +79,11 @@ class BgzfWriter(io.RawIOBase):
         self._broken = False
         # fire() costs a lock + env read; write() runs once per BAM record,
         # so the armed check is hoisted to construction time (chaos tests
-        # arm FGUMI_TPU_FAULT before the writer exists)
+        # arm FGUMI_TPU_FAULT before the writer exists) — the tracing
+        # check is hoisted for the same reason
         self._fault_armed = faults.armed("writer.compress")
+        self._trace_on = _trace.tracing_enabled()
+        self._counted = False
 
     def write(self, data) -> int:
         try:
@@ -102,20 +107,24 @@ class BgzfWriter(io.RawIOBase):
             from .. import native
 
             chunk_len = n_full * MAX_BLOCK_DATA
-            got = native.bgzf_compress_many(
-                memoryview(self._buf)[:chunk_len], self._level)
+            with _trace.span("bgzf.compress", blocks=n_full) \
+                    if self._trace_on else _trace.NULL_SPAN:
+                got = native.bgzf_compress_many(
+                    memoryview(self._buf)[:chunk_len], self._level)
             if got is not None:
                 blob, _ = got
                 del self._buf[:chunk_len]
                 self._coffset += len(blob)
                 self._f.write(blob)
                 return len(data)
-        while len(self._buf) >= MAX_BLOCK_DATA:
-            chunk = bytes(self._buf[:MAX_BLOCK_DATA])
-            del self._buf[:MAX_BLOCK_DATA]
-            block = compress_block(chunk, self._level)
-            self._coffset += len(block)
-            self._f.write(block)
+        with _trace.span("bgzf.compress", blocks=n_full) \
+                if self._trace_on else _trace.NULL_SPAN:
+            while len(self._buf) >= MAX_BLOCK_DATA:
+                chunk = bytes(self._buf[:MAX_BLOCK_DATA])
+                del self._buf[:MAX_BLOCK_DATA]
+                block = compress_block(chunk, self._level)
+                self._coffset += len(block)
+                self._f.write(block)
         return len(data)
 
     def tell_virtual(self) -> int:
@@ -187,9 +196,11 @@ class BgzfWriter(io.RawIOBase):
             if self._fault_armed and self._buf:
                 faults.fire("writer.compress")
             if self._buf:
-                block = compress_block(bytes(self._buf), self._level)
-                self._coffset += len(block)
-                self._f.write(block)
+                with _trace.span("bgzf.compress", blocks=1) \
+                        if self._trace_on else _trace.NULL_SPAN:
+                    block = compress_block(bytes(self._buf), self._level)
+                    self._coffset += len(block)
+                    self._f.write(block)
                 self._buf.clear()
         except BaseException:
             self._broken = True
@@ -204,6 +215,10 @@ class BgzfWriter(io.RawIOBase):
         self.flush()
         self._f.write(BGZF_EOF)
         self._f.flush()
+        self._coffset += len(BGZF_EOF)
+        if not self._counted:
+            self._counted = True
+            METRICS.inc("io.bytes_written", self._coffset)
         if self._owns:
             self._f.close()
         super().close()
@@ -246,6 +261,8 @@ class BgzfReader:
             else getattr(fileobj, "name", None)
         self._in_off = 0
         self._z_started = False  # current zlib member got any input
+        self._trace_on = _trace.tracing_enabled()
+        self._counted = False
 
     def _read_raw(self, n: int) -> bytes:
         """One raw chunk off the underlying file, offset-tracked and
@@ -318,7 +335,9 @@ class BgzfReader:
             if not self._raw:
                 continue
             try:
-                decoded, consumed = native.bgzf_decompress(self._raw)
+                with _trace.span("bgzf.decompress") \
+                        if self._trace_on else _trace.NULL_SPAN:
+                    decoded, consumed = native.bgzf_decompress(self._raw)
             except ValueError:
                 # garbage where a member should start: let zlib report it
                 self._demote_to_zlib()
@@ -417,7 +436,9 @@ class BgzfReader:
                     self._eof = True
                 continue
             try:
-                decoded, consumed = native.bgzf_decompress(self._raw)
+                with _trace.span("bgzf.decompress") \
+                        if self._trace_on else _trace.NULL_SPAN:
+                    decoded, consumed = native.bgzf_decompress(self._raw)
             except ValueError:
                 self._demote_to_zlib()
                 data = self.read_into_available()
@@ -449,5 +470,9 @@ class BgzfReader:
                 return decoded
 
     def close(self):
+        if not self._counted:
+            self._counted = True
+            if self._in_off:
+                METRICS.inc("io.bytes_read", self._in_off)
         if self._owns:
             self._f.close()

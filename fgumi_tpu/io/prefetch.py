@@ -58,9 +58,14 @@ class PrefetchFile:
         self._t.start()
 
     def _loop(self, chunk):
+        from ..observe import trace as _trace
+
+        trace_on = _trace.tracing_enabled()
         try:
             while not self._stop.is_set():
-                data = self._f.read(chunk)
+                with _trace.span("io.prefetch.read") \
+                        if trace_on else _trace.NULL_SPAN:
+                    data = self._f.read(chunk)
                 while not self._stop.is_set():
                     try:
                         self._q.put(data if data else _EOF, timeout=0.1)

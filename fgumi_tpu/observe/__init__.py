@@ -1,0 +1,31 @@
+"""Unified telemetry: span tracing, metrics registry, run reports, heartbeat.
+
+The reference fgumi is obsessive about operator visibility — per-step
+pipeline timers and queue-occupancy history (base.rs:2853-3379), progress
+heartbeats, per-command metric files. This package is that discipline for
+fgumi-tpu, as one layer with a zero-overhead-when-disabled contract:
+
+- :mod:`.trace` — thread-aware ``span("name", **attrs)`` context manager
+  recording begin/end events across the pipeline stages, BGZF/prefetch
+  workers, external-sort spills, and device dispatch/fetch; exported as
+  Chrome trace-event JSON loadable in Perfetto (``--trace`` /
+  ``FGUMI_TPU_TRACE``).
+- :mod:`.metrics` — a process-wide :class:`MetricsRegistry` aggregating the
+  scattered ``DeviceStats``, ``StageTimes``, fault/retry counters, and I/O
+  byte counts under stable dotted names.
+- :mod:`.report` — a schema-versioned machine-readable run report emitted
+  atomically at the end of every command (``--run-report`` /
+  ``FGUMI_TPU_RUN_REPORT``).
+- :mod:`.heartbeat` — a periodic one-line progress heartbeat on the
+  standard log stream (``--heartbeat`` / ``FGUMI_TPU_HEARTBEAT_S``).
+- :mod:`.logs` — ``--log-level`` logging setup with elapsed time and
+  thread name, so multi-threaded stage logs are attributable.
+
+Disabled is the default and costs nothing on the hot path: ``span`` returns
+a shared no-op context manager, metric folding happens once per command at
+report time, and no background thread starts unless asked for.
+"""
+
+from .metrics import METRICS, MetricsRegistry  # noqa: F401
+from .trace import (NULL_SPAN, instant, span, start_trace, stop_trace,  # noqa: F401
+                    tracing_enabled, write_trace)

@@ -1,0 +1,112 @@
+"""Periodic one-line progress heartbeat on the standard log stream.
+
+The stall watchdog (:class:`fgumi_tpu.pipeline._Watchdog`) only speaks when
+nothing moves; operators of long runs also want the inverse — a regular
+"still alive, here's where I am" line. The heartbeat folds the watchdog's
+view (pipeline counters, queue depths) together with device activity and
+record totals into one INFO line every ``interval`` seconds::
+
+    heartbeat: +120s read=48 processed=47 written=45 q_in=2/4 q_out=1/8 \
+device(dispatches=47 in-flight=1 retries=0) records=4700000 rss=812MB
+
+Components publish live state by registering a gauge callable returning a
+``{label: value}`` dict (:func:`register_gauge`); run_stages registers its
+counters/queues for the duration of the pipeline and unregisters in its
+``finally``. Enabled by ``--heartbeat SECONDS`` / ``FGUMI_TPU_HEARTBEAT_S``;
+off (0) by default — no thread starts.
+"""
+
+import logging
+import threading
+import time
+
+log = logging.getLogger("fgumi_tpu")
+
+_lock = threading.Lock()
+_gauges = {}  # token -> callable() -> {label: value}
+_next_token = [0]
+
+
+def register_gauge(fn):
+    """Register a live-state callable; returns a token for unregister."""
+    with _lock:
+        _next_token[0] += 1
+        token = _next_token[0]
+        _gauges[token] = fn
+    return token
+
+
+def unregister_gauge(token):
+    with _lock:
+        _gauges.pop(token, None)
+
+
+def _gauge_text():
+    with _lock:
+        fns = list(_gauges.values())
+    parts = []
+    for fn in fns:
+        try:
+            state = fn()
+        except Exception:  # noqa: BLE001 - a gauge must never kill the beat
+            continue
+        parts.extend(f"{k}={v}" for k, v in state.items())
+    return " ".join(parts)
+
+
+def _rss_mb():
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS"):
+                    return int(line.split()[1]) // 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+class Heartbeat:
+    """Daemon timer logging one progress line every ``interval`` seconds."""
+
+    def __init__(self, interval: float):
+        self.interval = interval
+        self._t0 = time.monotonic()
+        self._stop = threading.Event()
+        self._t = None
+        if interval > 0:
+            self._t = threading.Thread(target=self._loop,
+                                       name="fgumi-heartbeat", daemon=True)
+            self._t.start()
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            self.beat()
+
+    def beat(self):
+        """Log one heartbeat line (also callable directly from tests)."""
+        from .report import _device_stats
+
+        parts = [f"heartbeat: +{time.monotonic() - self._t0:.0f}s"]
+        gauges = _gauge_text()
+        if gauges:
+            parts.append(gauges)
+        stats = _device_stats()  # None while ops.kernel is unimported
+        snap = stats.snapshot() if stats is not None else {}
+        if snap.get("dispatches"):
+            parts.append(
+                f"device(dispatches={snap['dispatches']}"
+                f" in-flight={stats.in_flight_count()}"
+                f" retries={snap.get('dispatch_retries', 0)}"
+                f" host-fallbacks={snap.get('host_fallbacks', 0)})")
+        rss = _rss_mb()
+        if rss is not None:
+            parts.append(f"rss={rss}MB")
+        log.info(" ".join(parts))
+
+    def stop(self):
+        """Stop AND join (same discipline as the watchdog: a finished
+        command must not leave a daemon timer logging behind it)."""
+        self._stop.set()
+        if self._t is not None:
+            self._t.join(timeout=5)
+            self._t = None

@@ -1,0 +1,79 @@
+"""Logging setup: ``--log-level`` / ``FGUMI_TPU_LOG`` with a consistent
+format carrying elapsed time and thread name.
+
+Supersedes the bare ``-v`` flag (kept as an alias for ``--log-level
+debug``): multi-threaded stage logs were unattributable with the old
+``asctime level name: message`` format — a stall warning from the watchdog
+thread and a retry warning from a resolve worker looked identical. Every
+line now reads::
+
+    12:34:56 INFO fgumi_tpu [fgumi-writer +3.214s]: ...
+
+where ``+3.214s`` is seconds since logging setup (process-relative, so
+stage lines across a chained ``pipeline`` run share one clock).
+"""
+
+import logging
+import os
+import time
+
+LEVELS = ("debug", "info", "warning", "error")
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s [%(threadName)s %(elapsed)s]: %(message)s"
+
+
+class ElapsedFormatter(logging.Formatter):
+    """Formatter injecting ``%(elapsed)s`` = +seconds since construction."""
+
+    default_time_format = "%H:%M:%S"
+    default_msec_format = None
+
+    def __init__(self, fmt=_FORMAT):
+        super().__init__(fmt)
+        self._t0 = time.monotonic()
+
+    def format(self, record):
+        record.elapsed = f"+{time.monotonic() - self._t0:.3f}s"
+        return super().format(record)
+
+
+def resolve_level(log_level: str = None, verbose: bool = False) -> int:
+    """Effective logging level: explicit --log-level wins, then the
+    FGUMI_TPU_LOG environment, then -v (debug), else info. Unknown env
+    values fall back to info (loudly, once logging is up)."""
+    name = log_level or os.environ.get("FGUMI_TPU_LOG", "").strip().lower()
+    if name not in LEVELS:
+        if name:
+            logging.getLogger("fgumi_tpu").warning(
+                "FGUMI_TPU_LOG=%s: unknown level (expected one of %s); "
+                "using info", name, "/".join(LEVELS))
+        name = "debug" if verbose else "info"
+    return getattr(logging, name.upper())
+
+
+def setup_logging(log_level: str = None, verbose: bool = False) -> int:
+    """Install the elapsed/thread-aware format on the root logger.
+
+    Safe to call repeatedly in one process (the chained ``pipeline``
+    command re-enters main() per stage): the handler is installed once and
+    the level is updated each call. Returns the effective level."""
+    level = resolve_level(log_level, verbose)
+    root = logging.getLogger()
+    handler = None
+    for h in root.handlers:
+        if getattr(h, "_fgumi_observe", False):
+            handler = h
+            break
+    if handler is None:
+        if root.handlers:
+            # e.g. pytest or an embedding app configured logging first:
+            # respect their handlers, only adjust the level
+            root.setLevel(min(root.level or level, level))
+            logging.getLogger("fgumi_tpu").setLevel(level)
+            return level
+        handler = logging.StreamHandler()
+        handler.setFormatter(ElapsedFormatter())
+        handler._fgumi_observe = True
+        root.addHandler(handler)
+    root.setLevel(level)
+    return level

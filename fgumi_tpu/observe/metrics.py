@@ -1,0 +1,100 @@
+"""Process-wide metrics registry under stable dotted names.
+
+PR 1 grew rich internal counters — ``DeviceStats`` dispatch/retry/fallback
+tallies, ``StageTimes`` busy/blocked/queue samples, fault-injection fire
+counts, BGZF byte offsets — but each lived inside its owning object. The
+registry is the single aggregation point: components fold their counters in
+(cheaply, at end-of-run or close time, never per record) and the run report
+/ telemetry smoke read one flat ``{dotted.name: number}`` mapping.
+
+Naming convention (stable API — the run-report schema and CI smoke rely on
+these prefixes):
+
+- ``pipeline.stage.<stage>.busy_s`` / ``.blocked_s`` — run_stages timings
+- ``pipeline.queue.{in,out}.{mean,max}``, ``pipeline.queue.samples``
+- ``device.*`` — DeviceStats snapshot (dispatches, retries, batch_splits,
+  host_fallbacks, bytes_uploaded, bytes_fetched, fetch_wait_s, ...)
+- ``io.bytes_read`` / ``io.bytes_written`` — compressed bytes through the
+  BGZF reader/writer (and raw bytes for plain streams)
+- ``records.<label>`` — ProgressTracker totals per command label
+- ``faults.<point>`` — injected-fault fire counts
+"""
+
+import threading
+
+
+class MetricsRegistry:
+    """Thread-safe flat registry of numeric metrics under dotted names."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values = {}
+
+    def inc(self, name: str, n=1):
+        """Add ``n`` to a counter (creating it at 0)."""
+        with self._lock:
+            self._values[name] = self._values.get(name, 0) + n
+
+    def set(self, name: str, value):
+        """Set a gauge to ``value`` (last write wins)."""
+        with self._lock:
+            self._values[name] = value
+
+    def max(self, name: str, value):
+        """Raise a high-water-mark gauge to ``value`` if larger."""
+        with self._lock:
+            if value > self._values.get(name, value - 1):
+                self._values[name] = value
+
+    def update(self, mapping, prefix: str = ""):
+        """Fold a ``{name: number}`` mapping in under an optional prefix.
+
+        Numeric values accumulate (so two pipeline stages or two CLI
+        sub-stages of one chained command sum rather than clobber);
+        non-numeric values overwrite."""
+        p = prefix + "." if prefix and not prefix.endswith(".") else prefix
+        with self._lock:
+            for k, v in mapping.items():
+                key = p + k
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    self._values[key] = v
+                else:
+                    self._values[key] = self._values.get(key, 0) + v
+
+    def get(self, name: str, default=None):
+        with self._lock:
+            return self._values.get(name, default)
+
+    def snapshot(self) -> dict:
+        """Name-sorted copy of every metric."""
+        with self._lock:
+            return dict(sorted(self._values.items()))
+
+    def reset(self):
+        with self._lock:
+            self._values.clear()
+
+
+#: The process-wide registry every component folds into.
+METRICS = MetricsRegistry()
+
+
+def record_stage_times(stats) -> None:
+    """Fold a :class:`fgumi_tpu.pipeline.StageTimes` into :data:`METRICS`.
+
+    Called once per run_stages completion (success or failure path), so
+    every command that ran a pipeline contributes its per-stage busy/blocked
+    seconds and queue-occupancy statistics to the run report."""
+    for stage, dt in stats.busy.items():
+        METRICS.inc(f"pipeline.stage.{stage}.busy_s", round(dt, 6))
+    for stage, dt in stats.blocked.items():
+        METRICS.inc(f"pipeline.stage.{stage}.blocked_s", round(dt, 6))
+    if stats.q_samples:
+        METRICS.inc("pipeline.queue.samples", stats.q_samples)
+        METRICS.inc("pipeline.queue.in.sum", stats.q_in_sum)
+        METRICS.inc("pipeline.queue.out.sum", stats.q_out_sum)
+        METRICS.max("pipeline.queue.in.max", stats.q_in_max)
+        METRICS.max("pipeline.queue.out.max", stats.q_out_max)
+    peak = getattr(stats, "peak_in_flight_bytes", None)
+    if peak:
+        METRICS.max("pipeline.peak_in_flight_bytes", peak)

@@ -1,0 +1,202 @@
+"""Schema-versioned machine-readable run report.
+
+One JSON artifact per command (``--run-report out.json`` /
+``FGUMI_TPU_RUN_REPORT``), committed atomically via ``utils/atomic`` at
+command exit — success or failure — so a benchmark harness or CI gate can
+answer "where did the time go, and did the device degrade?" without parsing
+logs: wall time, per-stage busy/blocked seconds, queue occupancy mean/max,
+device dispatches/retries/batch-splits/host-fallbacks, bytes in/out,
+records processed, and exit status.
+
+The schema is versioned (:data:`SCHEMA_VERSION`) and validated structurally
+by :func:`validate_report` — the same function the golden-file test and
+``tools/telemetry_smoke.py`` gate on, so the shape cannot drift silently.
+"""
+
+import json
+import os
+import sys
+
+SCHEMA_VERSION = 1
+
+
+def _device_stats():
+    """The module-wide DeviceStats, or None when ops.kernel was never
+    imported this run — an unimported kernel has nothing to report, and
+    importing it here would tax numpy-free commands (sort, fastq, ...)
+    with the kernel import at exit."""
+    kern = sys.modules.get("fgumi_tpu.ops.kernel")
+    return kern.DEVICE_STATS if kern is not None else None
+
+#: Structural schema: top-level field -> required type (None = any JSON).
+#: Sections marked optional may be absent when the command produced no such
+#: activity (e.g. no device dispatch, no threaded pipeline).
+_REQUIRED = {
+    "schema_version": int,
+    "tool": str,
+    "command": str,
+    "argv": list,
+    "started_unix": (int, float),
+    "wall_s": (int, float),
+    "exit_status": int,
+    "pid": int,
+    "metrics": dict,
+}
+_OPTIONAL = {
+    "stages": dict,     # stage -> {"busy_s": f, "blocked_s": f}
+    "queues": dict,     # {"in_mean","in_max","out_mean","out_max","samples"}
+    "device": dict,     # DeviceStats.snapshot()
+    "io": dict,         # {"bytes_read","bytes_written"}
+    "records": dict,    # progress label -> count
+    "faults": dict,     # fault point -> fired count
+    "trace_path": str,
+    "hostname": str,
+}
+
+
+def validate_report(obj) -> list:
+    """Return a list of human-readable schema violations (empty == valid)."""
+    errors = []
+    if not isinstance(obj, dict):
+        return ["report is not a JSON object"]
+    for key, typ in _REQUIRED.items():
+        if key not in obj:
+            errors.append(f"missing required field {key!r}")
+        elif not isinstance(obj[key], typ):
+            errors.append(f"field {key!r} has type {type(obj[key]).__name__}")
+    for key, typ in _OPTIONAL.items():
+        if key in obj and not isinstance(obj[key], typ):
+            errors.append(f"field {key!r} has type {type(obj[key]).__name__}")
+    unknown = set(obj) - set(_REQUIRED) - set(_OPTIONAL)
+    if unknown:
+        errors.append(f"unknown fields: {sorted(unknown)}")
+    if isinstance(obj.get("schema_version"), int) \
+            and obj["schema_version"] != SCHEMA_VERSION:
+        errors.append(f"schema_version {obj['schema_version']} != "
+                      f"{SCHEMA_VERSION}")
+    if isinstance(obj.get("metrics"), dict):
+        for k in obj["metrics"]:
+            if not isinstance(k, str) or not k:
+                errors.append(f"metrics key {k!r} is not a dotted name")
+    return errors
+
+
+def _stage_sections(metrics: dict):
+    """Derive the stages/queues sections from the flat dotted metrics."""
+    stages = {}
+    for name, v in metrics.items():
+        if name.startswith("pipeline.stage.") and name.count(".") >= 3:
+            _, _, stage, field = name.split(".", 3)
+            stages.setdefault(stage, {})[field] = v
+    queues = None
+    samples = metrics.get("pipeline.queue.samples")
+    if samples:
+        queues = {
+            "samples": samples,
+            "in_mean": round(metrics.get("pipeline.queue.in.sum", 0)
+                             / samples, 3),
+            "in_max": metrics.get("pipeline.queue.in.max", 0),
+            "out_mean": round(metrics.get("pipeline.queue.out.sum", 0)
+                              / samples, 3),
+            "out_max": metrics.get("pipeline.queue.out.max", 0),
+        }
+    return stages, queues
+
+
+def build_report(command: str, argv, started_unix: float, wall_s: float,
+                 exit_status: int, trace_path: str = None) -> dict:
+    """Assemble the report dict from the global registries.
+
+    Reads :data:`fgumi_tpu.observe.metrics.METRICS`, the module-wide
+    ``DEVICE_STATS`` (when the kernel module is loaded), and the fault
+    registry; pure read — folding raw counters into METRICS is each
+    component's job."""
+    from ..utils import faults
+    from .metrics import METRICS
+
+    metrics = METRICS.snapshot()
+    report = {
+        "schema_version": SCHEMA_VERSION,
+        "tool": "fgumi-tpu",
+        "command": command,
+        "argv": list(argv),
+        "started_unix": round(started_unix, 3),
+        "wall_s": round(wall_s, 4),
+        "exit_status": int(exit_status),
+        "pid": os.getpid(),
+        "metrics": metrics,
+    }
+    try:
+        import socket
+
+        report["hostname"] = socket.gethostname()
+    except OSError:
+        pass
+    stages, queues = _stage_sections(metrics)
+    if stages:
+        report["stages"] = stages
+    if queues:
+        report["queues"] = queues
+    stats = _device_stats()
+    dev = stats.snapshot() if stats is not None else {}
+    if dev.get("dispatches"):
+        report["device"] = dev
+    io_sec = {k.split(".", 1)[1]: v for k, v in metrics.items()
+              if k.startswith("io.")}
+    if io_sec:
+        report["io"] = io_sec
+    records = {k.split(".", 1)[1]: v for k, v in metrics.items()
+               if k.startswith("records.")}
+    if records:
+        report["records"] = records
+    fired = {p: n for p, n in faults.snapshot().items() if n}
+    if fired:
+        report["faults"] = fired
+    if trace_path:
+        report["trace_path"] = trace_path
+    return report
+
+
+def write_report(path: str, report: dict):
+    """Commit the report atomically (crash-safe like every other output)."""
+    from ..utils.atomic import discard_output, open_output
+
+    out = open_output(path, "w")
+    try:
+        json.dump(report, out, indent=1, sort_keys=False)
+        out.write("\n")
+    except BaseException:
+        discard_output(out)
+        raise
+    out.close()
+
+
+def emit(path: str, command: str, argv, started_unix: float, wall_s: float,
+         exit_status: int, trace_path: str = None) -> dict:
+    """Build + write in one step; never raises out of an exiting command
+    (a telemetry failure must not turn a successful run into a failed one —
+    it logs and returns None instead)."""
+    import logging
+
+    try:
+        report = build_report(command, argv, started_unix, wall_s,
+                              exit_status, trace_path)
+        write_report(path, report)
+        return report
+    except Exception:
+        logging.getLogger("fgumi_tpu").exception(
+            "failed to write run report %s", path)
+        return None
+
+
+def fold_device_stats():
+    """Fold the module-wide DeviceStats into METRICS under ``device.*``.
+
+    Called once at command exit (before the report is built) so the flat
+    metrics view carries the same numbers as the ``device`` section."""
+    from .metrics import METRICS
+
+    stats = _device_stats()
+    snap = stats.snapshot() if stats is not None else {}
+    if snap.get("dispatches"):
+        METRICS.update(snap, prefix="device")

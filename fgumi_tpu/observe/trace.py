@@ -1,0 +1,214 @@
+"""Thread-aware span tracing with Chrome trace-event JSON export.
+
+``span("stage.process", batch=3)`` times a region of one thread and records
+it as a Chrome trace-event *complete* event (``ph: "X"``), so a run traced
+with ``--trace out.json`` opens directly in Perfetto (or
+``chrome://tracing``) with one timeline row per thread — a pipeline stall
+is visible as a gap, a device round trip as a block on the feeder row.
+
+Design constraints (the acceptance contract of the telemetry layer):
+
+- **Zero overhead when disabled.** ``span()`` with tracing off returns one
+  shared no-op context manager — no allocation, no lock, no time call.
+  Hot loops that want even the dict-build of attrs gone should hoist
+  ``tracing_enabled()`` once and skip their span calls entirely (the
+  pipeline does this).
+- **Thread attribution.** Events carry the OS thread id and the trace
+  names each thread once via ``thread_name`` metadata events, so the
+  fgumi-reader / fgumi-writer / fgumi-worker-N / fgumi-device-feeder rows
+  are labelled.
+- **Bounded memory.** The event buffer is capped (:data:`MAX_EVENTS`,
+  override ``FGUMI_TPU_TRACE_MAX_EVENTS``); overflow drops further spans
+  and reports the dropped count in the export rather than growing without
+  bound on a long run.
+"""
+
+import json
+import os
+import threading
+import time
+
+# ---------------------------------------------------------------------------
+# no-op fast path
+
+
+class _NullSpan:
+    """Shared do-nothing context manager returned while tracing is off."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        """No-op attr update (mirrors the live span's API)."""
+
+
+NULL_SPAN = _NullSpan()
+
+_tracer = None  # the single active _Tracer, or None (tracing disabled)
+
+
+def tracing_enabled() -> bool:
+    return _tracer is not None
+
+
+# ---------------------------------------------------------------------------
+# live tracer
+
+MAX_EVENTS = 500_000
+
+
+class _Span:
+    """One in-flight span: records a complete ("X") event on exit."""
+
+    __slots__ = ("_tracer", "name", "_t0", "args")
+
+    def __init__(self, tracer, name, args):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+        self._t0 = time.monotonic()
+
+    def set(self, **attrs):
+        """Attach attrs discovered mid-span (recorded at exit)."""
+        if self.args is None:
+            self.args = attrs
+        else:
+            self.args.update(attrs)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.monotonic()
+        self._tracer._complete(self.name, self._t0, t1, self.args,
+                               error=exc_type.__name__ if exc_type else None)
+        return False
+
+
+class _Tracer:
+    def __init__(self, max_events: int = None):
+        if max_events is None:
+            try:
+                max_events = int(os.environ.get(
+                    "FGUMI_TPU_TRACE_MAX_EVENTS", str(MAX_EVENTS)))
+            except ValueError:
+                max_events = MAX_EVENTS
+        self.max_events = max_events
+        self.t_zero = time.monotonic()
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._events = []
+        self._named_tids = set()
+
+    def _thread_meta_locked(self):
+        """Emit a thread_name metadata event for the calling thread once."""
+        tid = threading.get_ident()
+        if tid not in self._named_tids:
+            self._named_tids.add(tid)
+            self._events.append({
+                "name": "thread_name", "ph": "M", "pid": os.getpid(),
+                "tid": tid,
+                "args": {"name": threading.current_thread().name}})
+        return tid
+
+    def _complete(self, name, t0, t1, args, error=None):
+        ev = {"name": name, "ph": "X", "pid": os.getpid(),
+              "ts": round((t0 - self.t_zero) * 1e6, 1),
+              "dur": round((t1 - t0) * 1e6, 1)}
+        if error is not None:
+            args = dict(args or ())
+            args["error"] = error
+        if args:
+            ev["args"] = args
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            ev["tid"] = self._thread_meta_locked()
+            self._events.append(ev)
+
+    def instant(self, name, args=None):
+        ev = {"name": name, "ph": "i", "s": "t", "pid": os.getpid(),
+              "ts": round((time.monotonic() - self.t_zero) * 1e6, 1)}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            ev["tid"] = self._thread_meta_locked()
+            self._events.append(ev)
+
+    def snapshot(self):
+        with self._lock:
+            return list(self._events)
+
+    def to_json_obj(self):
+        obj = {"traceEvents": self.snapshot(),
+               "displayTimeUnit": "ms"}
+        if self.dropped:
+            obj["otherData"] = {"dropped_events": self.dropped}
+        return obj
+
+
+# ---------------------------------------------------------------------------
+# module API
+
+
+def span(name: str, **attrs):
+    """Time a region of the current thread as a named trace span.
+
+    With tracing disabled this returns the shared :data:`NULL_SPAN` (no
+    allocation); enabled, a complete event is recorded when the context
+    exits, tagged with ``attrs`` and the thread's id/name. Exceptions
+    propagate (the span records ``error: <type>``)."""
+    t = _tracer
+    if t is None:
+        return NULL_SPAN
+    return _Span(t, name, attrs or None)
+
+
+def instant(name: str, **attrs):
+    """Record a zero-duration instant event (a timeline marker)."""
+    t = _tracer
+    if t is not None:
+        t.instant(name, attrs or None)
+
+
+def start_trace(max_events: int = None):
+    """Enable tracing process-wide. Idempotent (keeps the active tracer)."""
+    global _tracer
+    if _tracer is None:
+        _tracer = _Tracer(max_events)
+    return _tracer
+
+
+def stop_trace():
+    """Disable tracing and return the tracer (caller may still export it)."""
+    global _tracer
+    t, _tracer = _tracer, None
+    return t
+
+
+def write_trace(path: str, tracer=None):
+    """Export the trace as Chrome trace-event JSON, committed atomically.
+
+    Writes the active tracer by default; pass the object returned by
+    :func:`stop_trace` to export after disabling."""
+    t = tracer if tracer is not None else _tracer
+    if t is None:
+        return
+    from ..utils.atomic import discard_output, open_output
+
+    out = open_output(path, "w")
+    try:
+        json.dump(t.to_json_obj(), out, separators=(",", ":"))
+    except BaseException:
+        discard_output(out)
+        raise
+    out.close()

@@ -290,16 +290,20 @@ class DeviceStats:
         """Timed jax.device_get — route every device->host fetch through
         here so fetch_wait_s captures all host time blocked on the device.
         Accepts a single array or a tuple (fetched in one device_get)."""
+        from ..observe.trace import span
+
         _ensure_jax()
         t0 = time.monotonic()
-        got = jax.device_get(dev)
+        with span("device.fetch") as sp:
+            got = jax.device_get(dev)
+            if isinstance(got, (tuple, list)):
+                out = tuple(np.asarray(g) for g in got)
+                nbytes = sum(g.nbytes for g in out)
+            else:
+                out = np.asarray(got)
+                nbytes = out.nbytes
+            sp.set(bytes=nbytes)
         dt = time.monotonic() - t0
-        if isinstance(got, (tuple, list)):
-            out = tuple(np.asarray(g) for g in got)
-            nbytes = sum(g.nbytes for g in out)
-        else:
-            out = np.asarray(got)
-            nbytes = out.nbytes
         with self._lock:
             self.fetch_wait_s += dt
             self.bytes_fetched += nbytes
@@ -491,13 +495,17 @@ def device_retry_call(fn, what: str = "dispatch"):
     immediately (OOM is handled by batch splitting at resolve time). The
     device.dispatch fault point fires on every attempt, so chaos tests
     exercise exactly this loop."""
+    from ..observe.trace import span
     from ..utils import faults
 
     retries, delay = _retry_budget()
     for attempt in range(retries + 1):
         try:
             faults.fire("device.dispatch")
-            return fn()
+            # one span per attempt, on whichever thread runs the dispatch
+            # (the caller for sync paths, fgumi-device-feeder for async)
+            with span("device.dispatch", what=what, attempt=attempt):
+                return fn()
         except BaseException as e:  # noqa: BLE001 - classified below
             if _is_oom(e) or not _is_transient(e) or attempt >= retries:
                 raise

@@ -194,6 +194,40 @@ class _Watchdog:
             self._t.join(timeout=5)
 
 
+def _traced_source(source_iter):
+    """Wrap a source iterator so each pull is a pipeline.read span (runs on
+    whichever thread drives the iterator — the reader thread when threaded,
+    the caller inline — so thread attribution is automatic)."""
+    from .observe.trace import span
+
+    it = iter(source_iter)
+    while True:
+        with span("pipeline.read"):
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+        yield item
+
+
+def _traced_stage(name, fn, materialize=False):
+    """Wrap a stage callable in a named span. ``materialize`` forces lazy
+    process outputs into a list so the span covers the actual work, not
+    just generator construction (tracing is opt-in diagnostics; the small
+    buffering change is acceptable there)."""
+    from .observe.trace import span
+
+    if materialize:
+        def wrapped(item):
+            with span(name):
+                return list(fn(item))
+    else:
+        def wrapped(item):
+            with span(name):
+                return fn(item)
+    return wrapped
+
+
 def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
                queue_items: int = 4, stats: StageTimes = None,
                watchdog_interval: float = 120.0, resolve_fn=None,
@@ -232,6 +266,35 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
     """
     if stats is None:
         stats = StageTimes()
+    from .observe import trace as _trace
+
+    if _trace.tracing_enabled():
+        # wrap only when tracing is on: with flags off the hot path runs
+        # the caller's bare callables (zero telemetry overhead, no new
+        # per-item allocations — the acceptance contract of observe/)
+        source_iter = _traced_source(source_iter)
+        process_fn = _traced_stage("pipeline.process", process_fn,
+                                   materialize=True)
+        if resolve_fn is not None:
+            resolve_fn = _traced_stage("pipeline.resolve", resolve_fn)
+        sink_fn = _traced_stage("pipeline.sink", sink_fn)
+    try:
+        return _run_stages_impl(
+            source_iter, process_fn, sink_fn, threads, queue_items, stats,
+            watchdog_interval, resolve_fn, max_bytes, item_bytes,
+            deadlock_recover, resolve_workers)
+    finally:
+        # fold per-stage timings into the metrics registry on every exit
+        # path (success AND failure) so the run report can always answer
+        # "where did the time go"
+        from .observe.metrics import record_stage_times
+
+        record_stage_times(stats)
+
+
+def _run_stages_impl(source_iter, process_fn, sink_fn, threads, queue_items,
+                     stats, watchdog_interval, resolve_fn, max_bytes,
+                     item_bytes, deadlock_recover, resolve_workers):
     from .utils import faults
 
     if faults.armed("pipeline.process"):
@@ -440,6 +503,15 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
                             daemon=True) for i in range(n_workers)]
     watchdog = _Watchdog(counters, q_in, q_out, watchdog_interval,
                          recover=deadlock_recover, budget=budget)
+    # publish the watchdog's view (stage counters + queue depths) to the
+    # periodic heartbeat for the lifetime of this pipeline
+    from .observe import heartbeat as _hb
+
+    hb_token = _hb.register_gauge(lambda: {
+        "read": counters[0], "processed": counters[1],
+        "written": counters[2],
+        "q_in": f"{q_in.qsize()}/{q_in.maxsize}",
+        "q_out": f"{q_out.qsize()}/{q_out.maxsize}"})
     rt.start()
     wt.start()
     for t in wts:
@@ -489,6 +561,7 @@ def run_stages(source_iter, process_fn, sink_fn, threads: int = 0,
             except queue.Empty:
                 pass
             rt.join(timeout=0.2)
+        _hb.unregister_gauge(hb_token)
     if writer_exc:
         raise writer_exc[0]
     if budget.limit > 0:

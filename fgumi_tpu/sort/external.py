@@ -272,15 +272,21 @@ class ExternalSorter:
             self._spill()
 
     def _spill(self):
+        from ..observe.metrics import METRICS
+        from ..observe.trace import span
+
         if self._tmp_dir is None:
             if self._tmp_dir_arg is not None:
                 self._tmp_dir = self._tmp_dir_arg
             else:
                 self._tmp_dir = tempfile.mkdtemp(prefix="fgumi_sort_")
                 self._own_tmp_dir = True
-        self._chunk.sort()
-        run = _SpillRun(self._tmp_dir)
-        run.write(iter(self._chunk))
+        METRICS.inc("sort.spills")
+        METRICS.inc("sort.spill_records", len(self._chunk))
+        with span("sort.spill", records=len(self._chunk)):
+            self._chunk.sort()
+            run = _SpillRun(self._tmp_dir)
+            run.write(iter(self._chunk))
         self._runs.append(run)
         self._chunk = []
         self._chunk_bytes = 0
@@ -486,9 +492,18 @@ class NativeExternalSorter:
     def _build_run(self, path, keys_b, recs_b, spans):
         """Sort + compress + write one frozen pool to `path` (runs on a
         spill worker or inline; touches no mutable sorter state)."""
+        from ..observe.metrics import METRICS
+        from ..observe.trace import span
+
+        n = len(spans[1])
+        METRICS.inc("sort.spills")
+        METRICS.inc("sort.spill_records", n)
+        with span("sort.spill", records=n):
+            return self._build_run_traced(path, keys_b, recs_b, spans, n)
+
+    def _build_run_traced(self, path, keys_b, recs_b, spans, n):
         np = self._np
         koff, klen, roff, rlen = spans
-        n = len(klen)
         perm = np.empty(n, dtype=np.int64)
         keys = np.frombuffer(keys_b, dtype=np.uint8)
         recs = np.frombuffer(recs_b, dtype=np.uint8)

@@ -30,8 +30,19 @@ class ProgressTracker:
                 self._next += self.every
 
     def finish(self):
-        """Final summary line (only when at least one heartbeat fired)."""
-        if self.count >= self.every:
-            dt = time.monotonic() - self._t0
-            log.info("%s: done, %d records in %.1fs (%.0f/s)", self.label,
-                     self.count, dt, self.count / dt if dt else 0)
+        """Final summary line — always emitted when anything was counted.
+
+        Runs shorter than `every` used to drop the done-line entirely, so a
+        short run reported no rate at all; they now log it at debug level
+        (long runs keep the info-level line). Totals also fold into the
+        metrics registry so the run report carries records-processed counts.
+        """
+        if self.count <= 0:
+            return
+        dt = time.monotonic() - self._t0
+        level = logging.INFO if self.count >= self.every else logging.DEBUG
+        log.log(level, "%s: done, %d records in %.1fs (%.0f/s)", self.label,
+                self.count, dt, self.count / dt if dt else 0)
+        from ..observe.metrics import METRICS
+
+        METRICS.inc(f"records.{self.label}", self.count)

@@ -1,0 +1,309 @@
+"""Telemetry layer unit tests: span tracing (nesting, thread attribution,
+disabled fast path), MetricsRegistry aggregation, StageTimes queue-occupancy
+sampling, ProgressTracker finish behavior, heartbeat gauges, log setup."""
+
+import json
+import logging
+import threading
+
+import pytest
+
+from fgumi_tpu.observe import heartbeat as hb
+from fgumi_tpu.observe import trace
+from fgumi_tpu.observe.metrics import METRICS, MetricsRegistry, record_stage_times
+from fgumi_tpu.pipeline import StageTimes
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracer():
+    trace.stop_trace()
+    yield
+    trace.stop_trace()
+
+
+# ---------------------------------------------------------------------------
+# span tracing
+
+
+def test_span_disabled_is_shared_noop():
+    assert not trace.tracing_enabled()
+    s = trace.span("anything", key="value")
+    assert s is trace.NULL_SPAN
+    assert trace.span("other") is s  # one shared object, no allocation
+    with s:
+        s.set(extra=1)  # API parity with the live span
+    trace.instant("marker")  # no-op, no error
+
+
+def test_span_records_complete_events_with_nesting():
+    t = trace.start_trace()
+    with trace.span("outer", batch=3):
+        with trace.span("inner"):
+            pass
+    events = [e for e in t.snapshot() if e["ph"] == "X"]
+    by_name = {e["name"]: e for e in events}
+    assert set(by_name) == {"outer", "inner"}
+    outer, inner = by_name["outer"], by_name["inner"]
+    assert outer["args"] == {"batch": 3}
+    # nesting: the inner complete event lies within the outer's interval
+    assert outer["ts"] <= inner["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 0.1
+    assert outer["tid"] == inner["tid"]
+
+
+def test_span_thread_attribution():
+    t = trace.start_trace()
+
+    def work():
+        with trace.span("in-thread"):
+            pass
+
+    th = threading.Thread(target=work, name="obs-test-thread")
+    with trace.span("on-main"):
+        pass
+    th.start()
+    th.join()
+    events = t.snapshot()
+    spans = {e["name"]: e for e in events if e["ph"] == "X"}
+    metas = {e["tid"]: e["args"]["name"] for e in events if e["ph"] == "M"}
+    assert spans["on-main"]["tid"] != spans["in-thread"]["tid"]
+    # each thread named itself exactly once via thread_name metadata
+    assert metas[spans["in-thread"]["tid"]] == "obs-test-thread"
+    assert metas[spans["on-main"]["tid"]] == threading.current_thread().name
+
+
+def test_span_records_error_type_and_propagates():
+    t = trace.start_trace()
+    with pytest.raises(ValueError):
+        with trace.span("failing"):
+            raise ValueError("boom")
+    (ev,) = [e for e in t.snapshot() if e["ph"] == "X"]
+    assert ev["args"]["error"] == "ValueError"
+
+
+def test_span_set_attaches_mid_span_attrs():
+    t = trace.start_trace()
+    with trace.span("fetch") as sp:
+        sp.set(bytes=480)
+    (ev,) = [e for e in t.snapshot() if e["ph"] == "X"]
+    assert ev["args"] == {"bytes": 480}
+
+
+def test_trace_event_cap_drops_not_grows():
+    t = trace.start_trace(max_events=3)
+    for i in range(10):
+        with trace.span(f"s{i}"):
+            pass
+    assert len(t.snapshot()) <= 3
+    assert t.dropped >= 7
+    assert t.to_json_obj()["otherData"]["dropped_events"] == t.dropped
+
+
+def test_write_trace_is_valid_chrome_json(tmp_path):
+    t = trace.start_trace()
+    with trace.span("a"):
+        pass
+    out = tmp_path / "trace.json"
+    trace.write_trace(str(out), t)
+    obj = json.loads(out.read_text())
+    assert isinstance(obj["traceEvents"], list)
+    assert any(e["ph"] == "X" and e["name"] == "a"
+               for e in obj["traceEvents"])
+    for ev in obj["traceEvents"]:
+        assert {"name", "ph", "pid", "tid"} <= set(ev)
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+
+
+def test_metrics_inc_set_max_and_snapshot_sorted():
+    m = MetricsRegistry()
+    m.inc("b.count")
+    m.inc("b.count", 4)
+    m.set("a.gauge", 7)
+    m.max("c.peak", 10)
+    m.max("c.peak", 3)  # lower value does not regress the high-water mark
+    m.max("c.peak", 12)
+    snap = m.snapshot()
+    assert snap == {"a.gauge": 7, "b.count": 5, "c.peak": 12}
+    assert list(snap) == ["a.gauge", "b.count", "c.peak"]
+
+
+def test_metrics_update_accumulates_numbers_under_prefix():
+    m = MetricsRegistry()
+    m.update({"dispatches": 2, "mode": "wire"}, prefix="device")
+    m.update({"dispatches": 3}, prefix="device")
+    snap = m.snapshot()
+    assert snap["device.dispatches"] == 5  # numeric values sum
+    assert snap["device.mode"] == "wire"   # non-numeric overwrite
+    m.reset()
+    assert m.snapshot() == {}
+
+
+def test_record_stage_times_folds_into_global_registry():
+    METRICS.reset()
+    st = StageTimes()
+    st.add_busy("read", 1.5)
+    st.add_busy("read", 0.5)
+    st.add_blocked("write", 0.25)
+    st.sample_queues(2, 4)
+    st.sample_queues(4, 0)
+    record_stage_times(st)
+    snap = METRICS.snapshot()
+    assert snap["pipeline.stage.read.busy_s"] == 2.0
+    assert snap["pipeline.stage.write.blocked_s"] == 0.25
+    assert snap["pipeline.queue.samples"] == 2
+    assert snap["pipeline.queue.in.sum"] == 6
+    assert snap["pipeline.queue.in.max"] == 4
+    assert snap["pipeline.queue.out.max"] == 4
+    METRICS.reset()
+
+
+# ---------------------------------------------------------------------------
+# StageTimes queue-occupancy sampling (previously untested)
+
+
+def test_stage_times_queue_sampling_mean_and_max():
+    st = StageTimes()
+    for q_in, q_out in ((0, 1), (2, 3), (4, 2)):
+        st.sample_queues(q_in, q_out)
+    assert st.q_samples == 3
+    assert st.q_in_sum == 6 and st.q_in_max == 4
+    assert st.q_out_sum == 6 and st.q_out_max == 3
+    table = st.format_table()
+    assert "in avg 2.0 max 4" in table
+    assert "out avg 2.0 max 3" in table
+    assert "(3 samples)" in table
+
+
+def test_stage_times_no_samples_no_queue_line():
+    st = StageTimes()
+    st.add_busy("read", 0.1)
+    assert "queues" not in st.format_table()
+
+
+# ---------------------------------------------------------------------------
+# ProgressTracker.finish
+
+
+def test_progress_finish_short_run_emits_debug_done_line(caplog):
+    from fgumi_tpu.utils.progress import ProgressTracker
+
+    METRICS.reset()
+    p = ProgressTracker("shortcmd", every=1000)
+    p.add(5)
+    with caplog.at_level(logging.DEBUG, logger="fgumi_tpu"):
+        p.finish()
+    done = [r for r in caplog.records if "done, 5 records" in r.message]
+    assert done and done[0].levelno == logging.DEBUG
+    assert METRICS.get("records.shortcmd") == 5
+    METRICS.reset()
+
+
+def test_progress_finish_long_run_stays_info(caplog):
+    from fgumi_tpu.utils.progress import ProgressTracker
+
+    METRICS.reset()
+    p = ProgressTracker("longcmd", every=10)
+    with caplog.at_level(logging.INFO, logger="fgumi_tpu"):
+        p.add(25)
+        p.finish()
+    done = [r for r in caplog.records if "done, 25 records" in r.message]
+    assert done and done[0].levelno == logging.INFO
+    METRICS.reset()
+
+
+def test_progress_finish_zero_records_silent(caplog):
+    from fgumi_tpu.utils.progress import ProgressTracker
+
+    p = ProgressTracker("emptycmd", every=10)
+    with caplog.at_level(logging.DEBUG, logger="fgumi_tpu"):
+        p.finish()
+    assert not [r for r in caplog.records if "emptycmd" in r.message]
+
+
+# ---------------------------------------------------------------------------
+# heartbeat
+
+
+def test_heartbeat_beat_includes_registered_gauges(caplog):
+    token = hb.register_gauge(lambda: {"read": 7, "q_in": "2/4"})
+    try:
+        beat = hb.Heartbeat(0)  # interval 0: no thread; beat manually
+        with caplog.at_level(logging.INFO, logger="fgumi_tpu"):
+            beat.beat()
+        line = [r.message for r in caplog.records
+                if r.message.startswith("heartbeat:")][0]
+        assert "read=7" in line and "q_in=2/4" in line
+    finally:
+        hb.unregister_gauge(token)
+    beat.stop()
+
+
+def test_heartbeat_gauge_errors_do_not_kill_the_beat(caplog):
+    def bad():
+        raise RuntimeError("gauge broke")
+
+    token = hb.register_gauge(bad)
+    try:
+        beat = hb.Heartbeat(0)
+        with caplog.at_level(logging.INFO, logger="fgumi_tpu"):
+            beat.beat()
+        assert any(r.message.startswith("heartbeat:")
+                   for r in caplog.records)
+    finally:
+        hb.unregister_gauge(token)
+
+
+def test_heartbeat_thread_stops_and_joins():
+    before = {t.name for t in threading.enumerate()}
+    beat = hb.Heartbeat(60)
+    assert any(t.name == "fgumi-heartbeat" for t in threading.enumerate())
+    beat.stop()
+    alive = {t.name for t in threading.enumerate()
+             if t.name == "fgumi-heartbeat"}
+    assert not alive or "fgumi-heartbeat" in before
+
+
+# ---------------------------------------------------------------------------
+# pipeline span integration
+
+
+def test_run_stages_emits_stage_spans_when_tracing():
+    from fgumi_tpu.pipeline import run_stages
+
+    t = trace.start_trace()
+    sunk = []
+    run_stages(iter([1, 2, 3]), lambda x: [x * 2], sunk.append,
+               threads=0, resolve_fn=lambda x: x + 1)
+    assert sunk == [3, 5, 7]
+    names = {e["name"] for e in t.snapshot() if e["ph"] == "X"}
+    assert {"pipeline.read", "pipeline.process", "pipeline.resolve",
+            "pipeline.sink"} <= names
+
+
+def test_run_stages_no_spans_when_disabled():
+    from fgumi_tpu.pipeline import run_stages
+
+    assert not trace.tracing_enabled()
+    sunk = []
+    run_stages(iter([1, 2]), lambda x: [x], sunk.append, threads=0)
+    assert sunk == [1, 2]
+
+
+def test_run_stages_threaded_spans_attribute_to_stage_threads():
+    from fgumi_tpu.pipeline import run_stages
+
+    t = trace.start_trace()
+    sunk = []
+    run_stages(iter(range(8)), lambda x: [x], sunk.append, threads=2)
+    assert sorted(sunk) == list(range(8))
+    events = t.snapshot()
+    metas = {e["tid"]: e["args"]["name"] for e in events if e["ph"] == "M"}
+    read_tids = {e["tid"] for e in events
+                 if e["ph"] == "X" and e["name"] == "pipeline.read"}
+    sink_tids = {e["tid"] for e in events
+                 if e["ph"] == "X" and e["name"] == "pipeline.sink"}
+    assert {metas[tid] for tid in read_tids} == {"fgumi-reader"}
+    assert {metas[tid] for tid in sink_tids} == {"fgumi-writer"}

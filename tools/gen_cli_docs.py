@@ -110,6 +110,12 @@ def _render_with(parser) -> str:
                if isinstance(a, argparse._SubParsersAction))
     # one-line summaries live in add_parser(help=...), not .description
     helps = {ca.dest: (ca.help or "") for ca in sub._choices_actions}
+    glob = _actions_table(parser)
+    if glob:
+        buf.write("\n## Global options\n\n"
+                  "Given before the tool name (`fgumi-tpu --trace t.json "
+                  "dedup ...`); every tool inherits them.\n\n")
+        buf.write(glob)
     buf.write("\n## Tools\n\n")
     for name, p in sub.choices.items():
         desc = (helps.get(name) or (p.description or "")).strip()

@@ -345,8 +345,8 @@ def _staged_probe_locked(out, timeout_s, env_overrides):
 
 def locked_main(fn):
     """Run fn() holding the session device lock — the one-line wrapper for
-    standalone diagnostics (tools/tunnel_probe*.py) that attach the
-    single-tenant chip outside the probe/payload harness."""
+    standalone diagnostics that attach the single-tenant chip outside the
+    probe/payload harness."""
     with DeviceLock():
         return fn()
 
@@ -557,16 +557,120 @@ def capture_evidence(out_path, n_families=40000):
     return evidence
 
 
+# Consolidated tunnel characterization (the useful core of the retired
+# tools/tunnel_probe{,2,3}.py scratch scripts): fetch bandwidth of
+# device-COMPUTED arrays (a fetch of a device_put array reads from a
+# host-side cache and looks infinite), upload bandwidth, duplex overlap,
+# and the put->jit->fetch pipelining shape the hybrid feeder
+# (ops/kernel.DeviceFeeder) relies on. Run via --tunnel; prints one JSON
+# dict, serialized on the session device lock like every other payload.
+TUNNEL_PROBE = r"""
+import json, threading, time
+import numpy as np
+import jax, jax.numpy as jnp
+
+out = {}
+MB = 1 << 20
+t0 = time.monotonic()
+dev = jax.devices()[0]
+out["init_s"] = round(time.monotonic() - t0, 2)
+out["device"] = str(dev)
+
+@jax.jit
+def make(x):
+    return (jnp.zeros((16 * MB,), dtype=jnp.uint8) + x).astype(jnp.uint8)
+
+y = make(np.uint8(3)); y.block_until_ready()
+for i in (5, 7):
+    t0 = time.monotonic()
+    h = np.asarray(jax.device_get(y))
+    fe_s = time.monotonic() - t0
+    y = make(np.uint8(i)); y.block_until_ready()  # defeat fetch caches
+out["fetch_16mb_s"] = round(fe_s, 3)
+out["fetch_mb_per_s"] = round(16 / fe_s, 1)
+
+up8 = np.random.randint(0, 250, size=(16 * MB,), dtype=np.uint8)
+for _ in range(2):
+    t0 = time.monotonic()
+    d = jax.device_put(up8); d.block_until_ready()
+    up_s = time.monotonic() - t0
+out["upload_16mb_s"] = round(up_s, 3)
+out["upload_mb_per_s"] = round(16 / up_s, 1)
+
+# duplex: upload 16MB while fetching a computed 16MB
+res = {}
+def up_thread():
+    t0 = time.monotonic()
+    dd = jax.device_put(up8); dd.block_until_ready()
+    res["up"] = time.monotonic() - t0
+def down_thread():
+    t0 = time.monotonic()
+    np.asarray(jax.device_get(y))
+    res["down"] = time.monotonic() - t0
+t0 = time.monotonic()
+ts = [threading.Thread(target=up_thread), threading.Thread(target=down_thread)]
+for t in ts: t.start()
+for t in ts: t.join()
+out["duplex_both_s"] = round(time.monotonic() - t0, 3)
+out["duplex_vs_serial"] = round((time.monotonic() - t0) / (up_s + fe_s), 2)
+
+# put->jit->fetch pipelining: feeder thread puts+dispatches, fetcher drains
+@jax.jit
+def kernelish(x):
+    return x + jnp.uint8(1)
+datas = [np.random.randint(0, 200, size=(16 * MB,), dtype=np.uint8)
+         for _ in range(6)]
+r = kernelish(jax.device_put(datas[0])); r.block_until_ready()
+t0 = time.monotonic()
+for i in range(3):
+    np.asarray(jax.device_get(kernelish(jax.device_put(datas[i]))))
+serial3 = time.monotonic() - t0
+out["serial3_s"] = round(serial3, 3)
+q, lock = [], threading.Lock()
+def feeder():
+    for i in range(3):
+        rr = kernelish(jax.device_put(datas[3 + i]))
+        with lock: q.append(rr)
+def fetcher():
+    got = 0
+    while got < 3:
+        with lock: rr = q.pop(0) if q else None
+        if rr is None:
+            time.sleep(0.002); continue
+        np.asarray(jax.device_get(rr)); got += 1
+t0 = time.monotonic()
+ts = [threading.Thread(target=feeder), threading.Thread(target=fetcher)]
+for t in ts: t.start()
+for t in ts: t.join()
+pipe3 = time.monotonic() - t0
+out["pipelined3_s"] = round(pipe3, 3)
+out["pipeline_speedup"] = round(serial3 / pipe3, 2)
+print(json.dumps(out))
+"""
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--loop", action="store_true",
                     help="probe repeatedly; capture evidence on success")
+    ap.add_argument("--tunnel", action="store_true",
+                    help="run the tunnel characterization payload (upload/"
+                         "fetch bandwidth, duplex overlap, dispatch "
+                         "pipelining) and print its JSON")
     ap.add_argument("--interval", type=float, default=480.0)
     ap.add_argument("--timeout", type=float, default=120.0)
     ap.add_argument("--out", default=os.path.join(REPO, "TPU_EVIDENCE.json"))
     ap.add_argument("--history",
                     default=os.path.join(REPO, ".probe_history.jsonl"))
     args = ap.parse_args(argv)
+
+    if args.tunnel:
+        res, err = run_payload(TUNNEL_PROBE, [], args.timeout)
+        if err:
+            print(json.dumps({"ok": False, "err": err}, indent=1))
+            return 1
+        print(json.dumps(res, indent=1))
+        return 0
 
     if not args.loop:
         res = staged_probe(args.timeout)

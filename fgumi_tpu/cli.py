@@ -72,8 +72,9 @@ def _apply_pipeline_compat(args):
         return 2
     # set unconditionally: main() may be called many times in one process
     # (the `pipeline` command chains stages), so a prior stage's level must
-    # not leak into the next
-    bam_io.DEFAULT_COMPRESSION_LEVEL = 1 if lvl is None else lvl
+    # not leak into the next (context-scoped, so concurrent daemon jobs
+    # with different levels stay independent)
+    bam_io.set_default_compression_level(lvl)
     if getattr(args, "memory_per_thread", None):
         from .utils.memory import parse_size
 
@@ -211,6 +212,17 @@ def _print_stats(stats, wall_s=None):
                 print(f"  ... {len(done) - 12} more")
 
 
+def _cmdline() -> str:
+    """The command line recorded in output provenance (@PG CL, metric
+    headers): the serve daemon overrides it per job with the *client's*
+    argv (observe.scope.command_argv) so daemon-run outputs are
+    byte-identical to the same command run standalone; outside a job it is
+    plain ``sys.argv``."""
+    from .observe.scope import current_argv
+
+    return " ".join(current_argv())
+
+
 def _unmapped_consensus_header(read_group_id: str):
     """Unmapped-consensus output header: no reference sequences, single RG,
     @PG capturing the command line (consensus_runner.rs:115+)."""
@@ -219,7 +231,7 @@ def _unmapped_consensus_header(read_group_id: str):
     return BamHeader(
         text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n"
              f"@RG\tID:{read_group_id}\tSM:sample\n"
-             "@PG\tID:fgumi-tpu\tPN:fgumi-tpu\tCL:" + " ".join(sys.argv) + "\n",
+             "@PG\tID:fgumi-tpu\tPN:fgumi-tpu\tCL:" + _cmdline() + "\n",
         ref_names=[], ref_lengths=[])
 
 
@@ -1688,7 +1700,7 @@ def cmd_extract(args):
         sequencing_center=args.sequencing_center,
         predicted_insert_size=args.predicted_insert_size,
         description=args.description, run_date=args.run_date,
-        comments=args.comment, command_line=" ".join(sys.argv))
+        comments=args.comment, command_line=_cmdline())
     t0 = time.monotonic()
     try:
         n_records, n_sets = run_extract(args.input, args.output, opts)
@@ -1857,7 +1869,7 @@ def cmd_zipper(args):
                         return 2
                 out_header = _header_with_pg(
                     _merge_zipper_headers(mapped.header, unmapped.header),
-                    " ".join(sys.argv))
+                    _cmdline())
                 with BamWriter(args.output, out_header) as writer:
                     n_templates, n_records, n_missing = run_zipper_fast(
                         mapped, unmapped, writer, tag_info,
@@ -1875,7 +1887,7 @@ def cmd_zipper(args):
                         return 2
                 out_header = _header_with_pg(
                     _merge_zipper_headers(mapped.header, unmapped.header),
-                    " ".join(sys.argv))
+                    _cmdline())
                 with BamWriter(args.output, out_header) as writer:
                     n_templates, n_records, n_missing = run_zipper(
                         mapped, unmapped, writer, tag_info,
@@ -1997,7 +2009,7 @@ def cmd_filter(args):
                 if not is_query_grouped(reader.header.text):
                     return None
                 out_header = _header_with_pg(reader.header,
-                                             " ".join(sys.argv))
+                                             _cmdline())
                 rejects = (BamWriter(args.rejects, out_header)
                            if args.rejects else None)
                 ok = False
@@ -2028,7 +2040,7 @@ def cmd_filter(args):
                         log.error("%s", _SORT_ERR)
                         return 2
                     out_header = _header_with_pg(reader.header,
-                                                 " ".join(sys.argv))
+                                                 _cmdline())
                     rejects = (BamWriter(args.rejects, out_header)
                                if args.rejects else None)
                     ok = False
@@ -2093,7 +2105,7 @@ def cmd_downsample(args):
     t0 = time.monotonic()
     try:
         with BamReader(args.input) as reader:
-            out_header = _header_with_pg(reader.header, " ".join(sys.argv))
+            out_header = _header_with_pg(reader.header, _cmdline())
             rejects = (BamWriter(args.rejects, out_header)
                        if args.rejects else None)
             ok = False
@@ -2385,7 +2397,7 @@ def cmd_clip(args):
                           "input (@HD must advertise SO:queryname or GO:query); "
                           "sort with `fgumi-tpu sort --order queryname` first")
                 return 2
-            out_header = _header_with_pg(reader.header, " ".join(sys.argv))
+            out_header = _header_with_pg(reader.header, _cmdline())
             with BamWriter(args.output, out_header) as writer:
                 metrics = run_clip(reader, writer, reference, params)
     except (ValueError, OSError, KeyError) as e:
@@ -2465,7 +2477,7 @@ def cmd_correct(args):
         else:
             _Reader, _run = BamReader, run_correct
         with _Reader(args.input) as reader:
-            out_header = _header_with_pg(reader.header, " ".join(sys.argv))
+            out_header = _header_with_pg(reader.header, _cmdline())
             import contextlib
             with contextlib.ExitStack() as stack:
                 writer = stack.enter_context(BamWriter(args.output, out_header))
@@ -2579,7 +2591,7 @@ def cmd_dedup(args):
                     "advertise SS:template-coordinate). Prepare with:\n"
                     "  fgumi-tpu zipper ... | fgumi-tpu sort --order template-coordinate")
                 return 2
-            out_header = _header_with_pg(reader.header, " ".join(sys.argv))
+            out_header = _header_with_pg(reader.header, _cmdline())
             with BamWriter(args.output, out_header) as writer:
                 if use_fast:
                     from .commands.fast_group import FastDedup
@@ -2785,6 +2797,226 @@ def cmd_pipeline(args):
     return 0
 
 
+def _add_serve(sub):
+    p = sub.add_parser(
+        "serve",
+        help="Run the persistent job-service daemon (warm-kernel serving)")
+    p.add_argument("--socket", required=True, metavar="PATH",
+                   help="Unix-domain socket path to listen on (docs/"
+                        "serving.md; relative job paths resolve against "
+                        "the daemon's working directory)")
+    p.add_argument("--workers", type=int, default=2,
+                   help="concurrent job slots (bounded worker pool)")
+    p.add_argument("--queue-limit", type=int, default=8,
+                   help="queued jobs admitted beyond the running ones; "
+                        "submissions past workers+queue-limit are rejected "
+                        "with an explicit reason")
+    p.add_argument("--report-dir", default=None, metavar="DIR",
+                   help="write per-job run reports (<job>.report.json) and "
+                        "on-request traces here (created if missing)")
+    p.add_argument("--compile-cache", default=None, metavar="DIR",
+                   help="persistent XLA compile-cache directory for warm "
+                        "serving (default: the standard cache under "
+                        "~/.cache/fgumi_tpu)")
+    p.add_argument("--max-frame-bytes", type=int, default=None,
+                   help="protocol frame size cap (default 1 MiB); larger "
+                        "frames are rejected and the connection closed")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip the startup jax import/device touch (first "
+                        "job pays cold start instead)")
+    p.set_defaults(func=cmd_serve)
+
+
+def cmd_serve(args):
+    import signal
+
+    from .serve.daemon import JobService, SocketBusy
+
+    if args.workers < 1:
+        log.error("--workers must be >= 1")
+        return 2
+    if args.queue_limit < 0:
+        log.error("--queue-limit must be >= 0")
+        return 2
+    if args.max_frame_bytes is not None and args.max_frame_bytes < 1024:
+        # a sub-1KiB cap cannot carry a realistic submit frame, and 0 or a
+        # negative value would defeat the size limit entirely
+        log.error("--max-frame-bytes must be >= 1024")
+        return 2
+    if args.report_dir:
+        try:
+            os.makedirs(args.report_dir, exist_ok=True)
+        except OSError as e:
+            log.error("cannot create --report-dir %s: %s", args.report_dir, e)
+            return 2
+    from .serve import protocol as _proto
+
+    service = JobService(
+        args.socket, workers=args.workers, queue_limit=args.queue_limit,
+        report_dir=args.report_dir,
+        max_frame_bytes=args.max_frame_bytes or _proto.MAX_FRAME_BYTES)
+    # claim the socket BEFORE the device warm-up: an accidental duplicate
+    # start must fail fast without touching the single-tenant chip
+    try:
+        service.bind()
+    except SocketBusy as e:
+        log.error("%s", e)
+        return 2
+    except OSError as e:
+        log.error("cannot bind %s: %s", args.socket, e)
+        return 2
+    service.warm_up(compile_cache_dir=args.compile_cache,
+                    touch_device=not args.no_warmup)
+    service.start()
+
+    def _on_signal(signum, frame):
+        # SIGTERM drain contract: stop admitting, finish queued + running.
+        # Event-set only — no locks or logging in signal context; the main
+        # loop below performs (and logs) the actual drain
+        service.request_shutdown()
+
+    old = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            old[sig] = signal.signal(sig, _on_signal)
+        except (ValueError, OSError):
+            pass  # not the main thread (in-process test harness)
+    try:
+        service.wait_until_shutdown()
+    finally:
+        for sig, handler in old.items():
+            signal.signal(sig, handler)
+        service.close()
+    return 0
+
+
+def _add_submit(sub):
+    p = sub.add_parser(
+        "submit",
+        help="Submit a command to a running serve daemon (warm execution)")
+    p.add_argument("--socket", required=True, metavar="PATH",
+                   help="daemon socket (serve --socket)")
+    p.add_argument("--priority", default="normal",
+                   choices=["high", "normal", "low"],
+                   help="scheduling class (FIFO within a class)")
+    p.add_argument("--tag", default=None,
+                   help="free-form label kept on the job record")
+    p.add_argument("--job-trace", action="store_true",
+                   help="ask the daemon for a per-job Perfetto trace next "
+                        "to the job's run report (needs serve --report-dir)")
+    p.add_argument("--no-wait", action="store_true",
+                   help="return immediately after admission (poll later "
+                        "with `fgumi-tpu jobs`)")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="max seconds to wait for completion (with waiting)")
+    p.add_argument("job_argv", nargs=argparse.REMAINDER, metavar="COMMAND",
+                   help="the fgumi-tpu command to run, e.g. "
+                        "`submit --socket S simplex -i in.bam -o out.bam` "
+                        "(everything after the submit options, verbatim)")
+    p.set_defaults(func=cmd_submit)
+
+
+def cmd_submit(args):
+    from .serve.client import ServeClient, ServeError
+
+    job_argv = list(args.job_argv)
+    if job_argv and job_argv[0] == "--":
+        job_argv = job_argv[1:]
+    if not job_argv:
+        log.error("submit: no command given (usage: fgumi-tpu submit "
+                  "--socket S <command> [args...])")
+        return 2
+    client = ServeClient(args.socket)
+    try:
+        job = client.submit(job_argv, priority=args.priority, tag=args.tag,
+                            trace=args.job_trace)
+    except ServeError as e:
+        log.error("submit: %s", e)
+        return 2
+    log.info("submitted %s (%s): %s", job["id"], job["state"],
+             " ".join(job["argv"]))
+    if args.no_wait:
+        print(job["id"])
+        return 0
+    try:
+        job = client.wait(job["id"], timeout=args.timeout)
+    except ServeError as e:
+        log.error("submit: %s", e)
+        return 2
+    rc = job["exit_status"]
+    if job["state"] == "done":
+        log.info("job %s done in %.2fs", job["id"],
+                 job["finished_unix"] - job["started_unix"])
+        return 0
+    if job["state"] == "cancelled":
+        log.error("job %s was cancelled", job["id"])
+        return 130
+    log.error("job %s failed: %s", job["id"], job["error"])
+    return rc if isinstance(rc, int) and rc else 1
+
+
+def _add_jobs(sub):
+    p = sub.add_parser(
+        "jobs", help="Inspect or manage a serve daemon's job queue")
+    p.add_argument("--socket", required=True, metavar="PATH",
+                   help="daemon socket (serve --socket)")
+    g = p.add_mutually_exclusive_group()
+    g.add_argument("--id", default=None, help="show one job as JSON")
+    g.add_argument("--cancel", default=None, metavar="ID",
+                   help="cancel a queued job")
+    g.add_argument("--drain", action="store_true",
+                   help="close admission (running/queued jobs finish; the "
+                        "daemon keeps answering status)")
+    g.add_argument("--shutdown", action="store_true",
+                   help="drain, finish queued+running jobs, then exit")
+    g.add_argument("--ping", action="store_true",
+                   help="print daemon liveness/config as JSON")
+    p.set_defaults(func=cmd_jobs)
+
+
+def cmd_jobs(args):
+    import json as _json
+
+    from .serve.client import ServeClient, ServeError
+
+    client = ServeClient(args.socket)
+    try:
+        if args.ping:
+            print(_json.dumps(client.ping(), indent=1, sort_keys=True))
+            return 0
+        if args.id:
+            print(_json.dumps(client.job(args.id), indent=1, sort_keys=True))
+            return 0
+        if args.cancel:
+            job = client.cancel(args.cancel)
+            log.info("job %s cancelled", job["id"])
+            return 0
+        if args.drain:
+            depth = client.drain()
+            log.info("draining: %d running, %d queued",
+                     depth["running"], depth["queued"])
+            return 0
+        if args.shutdown:
+            depth = client.shutdown()
+            log.info("shutdown requested: %d running, %d queued to finish",
+                     depth["running"], depth["queued"])
+            return 0
+        status = client.status()
+        jobs = status["jobs"]
+        if not jobs:
+            print("no jobs")
+            return 0
+        print(f"{'id':<8} {'state':<10} {'prio':<7} {'rc':<4} command")
+        for j in jobs:
+            rc = "" if j["exit_status"] is None else str(j["exit_status"])
+            print(f"{j['id']:<8} {j['state']:<10} {j['priority']:<7} "
+                  f"{rc:<4} {' '.join(j['argv'])}")
+        return 0
+    except ServeError as e:
+        log.error("jobs: %s", e)
+        return 2
+
+
 def build_parser():
     parser = argparse.ArgumentParser(
         prog="fgumi-tpu",
@@ -2839,13 +3071,20 @@ def build_parser():
     _add_downsample(sub)
     _add_simulate(sub)
     _add_pipeline(sub)
+    _add_serve(sub)
+    _add_submit(sub)
+    _add_jobs(sub)
     return parser
 
 
 # nesting depth of in-process main() calls: the `pipeline` command re-enters
 # main() per stage, and the telemetry lifecycle (trace export, run report,
-# per-command counter reset) belongs to the OUTERMOST invocation only
-_main_depth = 0
+# per-command scope) belongs to the OUTERMOST invocation only. A contextvar,
+# not a module global: the serve daemon runs several top-level commands
+# concurrently on worker threads, and each must see its own depth
+import contextvars
+
+_main_depth = contextvars.ContextVar("fgumi_tpu_main_depth", default=0)
 
 
 def _run_command(args):
@@ -2895,7 +3134,6 @@ def _telemetry_config(args):
 
 
 def main(argv=None):
-    global _main_depth
     parser = build_parser()
     args = parser.parse_args(argv)
     from .observe.logs import setup_logging
@@ -2904,7 +3142,8 @@ def main(argv=None):
     # invocation's level unless they carry an explicit flag: re-running
     # setup at the default would reset an operator's --log-level debug
     # back to info after the first `pipeline` stage
-    if _main_depth == 0 or args.log_level or args.verbose:
+    depth = _main_depth.get()
+    if depth == 0 or args.log_level or args.verbose:
         setup_logging(args.log_level, args.verbose)
     from .utils.atomic import set_atomic_enabled
 
@@ -2912,23 +3151,35 @@ def main(argv=None):
     rc = _apply_pipeline_compat(args)
     if rc:
         return rc
-    if _main_depth > 0:
+    if depth > 0:
         # nested stage of a chained command: the outer invocation owns the
         # telemetry lifecycle; this stage just accumulates into it
         return _run_command(args)
 
-    trace_path, report_path, hb_s = _telemetry_config(args)
-    from .observe.metrics import METRICS
+    # per-command isolation: every top-level invocation gets its own
+    # telemetry scope (metrics + DeviceStats + tracer), so back-to-back or
+    # *concurrent* in-process commands — tests, the chained `pipeline`
+    # driver, serve-daemon jobs on worker threads — never cross-contaminate
+    # counters. Nested stages (depth > 0 above) inherit this scope through
+    # the contextvar and accumulate into it, exactly like the old global
+    # registries did under the outermost reset.
+    from .observe.scope import publish_to_global, scoped_telemetry
 
-    # per-command isolation: back-to-back CLI invocations in one process
-    # (tests, the chained `pipeline` driver) must not cross-contaminate
-    # device or metric counters across reports. The kernel module is only
-    # reset when already imported — a fresh import starts zeroed, and
-    # importing it here would tax numpy-free commands with its import
-    kern = sys.modules.get("fgumi_tpu.ops.kernel")
-    METRICS.reset()
-    if kern is not None:
-        kern.DEVICE_STATS.reset()
+    with scoped_telemetry(args.command) as scope:
+        try:
+            return _main_scoped(args, argv)
+        finally:
+            # legacy surface: leave the finished command's counters visible
+            # on the process-global METRICS/DEVICE_STATS, exactly like the
+            # old reset-at-entry globals did (bench/probe harnesses read
+            # them right after cli_main returns)
+            publish_to_global(scope)
+
+
+def _main_scoped(args, argv):
+    """The depth-0 command body: telemetry lifecycle around the dispatch
+    (runs inside this invocation's telemetry scope)."""
+    trace_path, report_path, hb_s = _telemetry_config(args)
     tracer = hb = None
     if trace_path:
         from .observe.trace import start_trace
@@ -2941,12 +3192,12 @@ def main(argv=None):
     t0 = time.monotonic()
     t0_unix = time.time()
     rc = 1  # report value when the command dies on an unmapped exception
-    _main_depth += 1
+    token = _main_depth.set(_main_depth.get() + 1)
     try:
         rc = _run_command(args)
         return rc
     finally:
-        _main_depth -= 1
+        _main_depth.reset(token)
         if hb is not None:
             hb.stop()
         if tracer is not None:

@@ -7,6 +7,7 @@ name, cigar, packed seq, qual, aux TLV) — decoding only what each consumer tou
 which is what keeps host-side feeding cheap (raw_bam_record.rs:6-13 rationale).
 """
 
+import contextvars
 import struct
 import zlib
 from dataclasses import dataclass, field
@@ -574,11 +575,25 @@ class BamIndexedReader:
         self.close()
 
 
-# process-wide default BGZF level for BamWriter (reference CompressionOptions
-# default 1, commands/common.rs); the CLI's --compression-level sets it per
-# invocation. Level 0 = stored blocks — used by the `pipeline` command for
-# intermediates that are read back immediately.
+# default BGZF level for BamWriter (reference CompressionOptions default 1,
+# commands/common.rs); the CLI's --compression-level sets it per invocation.
+# Level 0 = stored blocks — used by the `pipeline` command for intermediates
+# that are read back immediately. Context-scoped (not a bare module global)
+# so two serve-daemon jobs with different levels in one process cannot
+# clobber each other; the module constant is the fallback.
 DEFAULT_COMPRESSION_LEVEL = 1
+
+_level_var = contextvars.ContextVar("fgumi_tpu_bgzf_level", default=None)
+
+
+def set_default_compression_level(level):
+    """Set the context's default BGZF level (None = module default)."""
+    _level_var.set(level)
+
+
+def default_compression_level() -> int:
+    lvl = _level_var.get()
+    return DEFAULT_COMPRESSION_LEVEL if lvl is None else lvl
 
 
 class BamWriter:
@@ -586,7 +601,7 @@ class BamWriter:
 
     def __init__(self, path_or_obj, header: BamHeader, level: int = None):
         if level is None:
-            level = DEFAULT_COMPRESSION_LEVEL
+            level = default_compression_level()
         owns = isinstance(path_or_obj, str)
         if owns:
             # crash-safe commit: write .<name>.tmp.<pid>, atomic-rename on

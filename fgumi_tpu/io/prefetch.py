@@ -53,8 +53,12 @@ class PrefetchFile:
         self._exc = None
         self._stop = threading.Event()
         advise_sequential(fileobj)
-        self._t = threading.Thread(target=self._loop, args=(chunk,),
-                                   name="fgumi-prefetch", daemon=True)
+        # context-carrying spawn: prefetch spans/metrics attribute to the
+        # owning command's telemetry scope (observe.scope)
+        from ..observe.scope import spawn_thread
+
+        self._t = spawn_thread(self._loop, args=(chunk,),
+                               name="fgumi-prefetch")
         self._t.start()
 
     def _loop(self, chunk):

@@ -20,6 +20,13 @@ fgumi-tpu, as one layer with a zero-overhead-when-disabled contract:
   standard log stream (``--heartbeat`` / ``FGUMI_TPU_HEARTBEAT_S``).
 - :mod:`.logs` — ``--log-level`` logging setup with elapsed time and
   thread name, so multi-threaded stage logs are attributable.
+- :mod:`.scope` — job-scoped telemetry: a contextvar-resolved
+  :class:`TelemetryScope` gives every top-level command (and every serve-
+  daemon job) its own metrics/DeviceStats/tracer, propagated through the
+  pipeline's helper threads; replaces the old per-command global reset.
+- :mod:`.compilewatch` — folds jax compile/cache-hit monitoring events
+  into the owning scope's metrics (``device.backend_compiles``), the
+  warm-kernel evidence the serve smoke gate asserts on.
 
 Disabled is the default and costs nothing on the hot path: ``span`` returns
 a shared no-op context manager, metric folding happens once per command at

@@ -1,0 +1,71 @@
+"""Fold XLA compilation activity into the metrics registry.
+
+The serve daemon's whole value proposition is that the second job on a warm
+process *does not compile anything* — but "it felt faster" is not evidence.
+jax publishes monitoring events for exactly this: every real backend compile
+records ``/jax/core/compile/backend_compile_duration`` and every persistent
+compile-cache load records ``/jax/compilation_cache/cache_hits``; an
+in-memory jit cache hit records neither. A process-wide listener (installed
+once, at first jax use) forwards those events into ``METRICS`` under::
+
+    device.backend_compiles      count of real XLA compilations
+    device.backend_compile_s     seconds spent in them
+    device.compile_cache_hits    executables loaded from the persistent cache
+
+``METRICS`` is the scope-resolving proxy, and the listener fires on the
+thread that triggered the compile (the job thread or its context-carrying
+device feeder), so in the daemon these counters land in the *owning job's*
+registry — ``tools/serve_smoke.py`` and the run reports assert warm-kernel
+behaviour from them: job 1 reports ``backend_compiles > 0``, the identical
+job 2 reports none.
+
+Failure tolerant by design: an old jax without ``jax.monitoring`` simply
+means no compile telemetry.
+"""
+
+import logging
+
+log = logging.getLogger("fgumi_tpu")
+
+_installed = False
+
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+
+
+def _on_duration(event: str, duration: float, **_kw):
+    if event == _BACKEND_COMPILE_EVENT:
+        from .metrics import METRICS
+
+        METRICS.inc("device.backend_compiles")
+        METRICS.inc("device.backend_compile_s", round(duration, 4))
+
+
+def _on_event(event: str, **_kw):
+    if event == _CACHE_HIT_EVENT:
+        from .metrics import METRICS
+
+        METRICS.inc("device.compile_cache_hits")
+
+
+def install() -> bool:
+    """Register the jax monitoring listeners (idempotent).
+
+    Called from ``ops.kernel._ensure_jax`` so any code path that can compile
+    has the watch in place first. Returns True when listening."""
+    global _installed
+    if _installed:
+        return True
+    try:
+        from jax import monitoring
+    except Exception as e:  # pragma: no cover - jax without monitoring
+        log.debug("compile watch unavailable: %s", e)
+        return False
+    try:
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
+    except Exception as e:  # pragma: no cover - API drift tolerated
+        log.debug("compile watch not installed: %s", e)
+        return False
+    _installed = True
+    return True

@@ -74,8 +74,11 @@ class Heartbeat:
         self._stop = threading.Event()
         self._t = None
         if interval > 0:
-            self._t = threading.Thread(target=self._loop,
-                                       name="fgumi-heartbeat", daemon=True)
+            # carry the caller's telemetry scope so the beat reads the
+            # owning command's DeviceStats, not the process-global fallback
+            from .scope import spawn_thread
+
+            self._t = spawn_thread(self._loop, name="fgumi-heartbeat")
             self._t.start()
 
     def _loop(self):

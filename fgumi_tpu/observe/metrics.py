@@ -74,9 +74,59 @@ class MetricsRegistry:
         with self._lock:
             self._values.clear()
 
+    def replace(self, mapping: dict):
+        """Overwrite this registry's whole content (scope publishing)."""
+        with self._lock:
+            self._values = dict(mapping)
 
-#: The process-wide registry every component folds into.
-METRICS = MetricsRegistry()
+
+#: Fallback registry used when no telemetry scope is active (library use,
+#: tests, plain single-command CLI runs).
+_GLOBAL_REGISTRY = MetricsRegistry()
+
+
+def current_registry() -> MetricsRegistry:
+    """The registry writes should land in: the active scope's (observe.scope)
+    when one is entered — one per daemon job / top-level command — else the
+    process-global fallback."""
+    from .scope import current_scope
+
+    scope = current_scope()
+    return scope.metrics if scope is not None else _GLOBAL_REGISTRY
+
+
+class _RegistryProxy:
+    """Drop-in stand-in for the old module-global registry: every call
+    resolves the active scope first, so ``from ..observe.metrics import
+    METRICS`` keeps working at every existing fold site while two scoped
+    jobs in one process stay isolated."""
+
+    __slots__ = ()
+
+    def inc(self, name: str, n=1):
+        current_registry().inc(name, n)
+
+    def set(self, name: str, value):
+        current_registry().set(name, value)
+
+    def max(self, name: str, value):
+        current_registry().max(name, value)
+
+    def update(self, mapping, prefix: str = ""):
+        current_registry().update(mapping, prefix)
+
+    def get(self, name: str, default=None):
+        return current_registry().get(name, default)
+
+    def snapshot(self) -> dict:
+        return current_registry().snapshot()
+
+    def reset(self):
+        current_registry().reset()
+
+
+#: The registry every component folds into (scope-resolving proxy).
+METRICS = _RegistryProxy()
 
 
 def record_stage_times(stats) -> None:

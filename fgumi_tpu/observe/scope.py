@@ -1,0 +1,157 @@
+"""Job-scoped telemetry: per-command registries resolved through a contextvar.
+
+PR 2 gave every top-level CLI command clean counters by *resetting* the
+process-global ``METRICS``/``DEVICE_STATS`` singletons at command entry.
+That is correct for one command at a time but wrong the moment two commands
+share a process concurrently — the serve daemon runs jobs on a worker pool,
+and one job's reset would zero a neighbour's live counters mid-run.
+
+This module replaces the reset with scoping: a :class:`TelemetryScope`
+bundles one ``MetricsRegistry``, one ``DeviceStats``, and (optionally) one
+tracer, and a :data:`contextvars.ContextVar` names the active scope. The
+singletons in ``observe.metrics`` / ``ops.kernel`` / ``observe.trace``
+become thin proxies that resolve the active scope on every call and fall
+back to the old process-global objects when none is active — so library
+users, tests, and single-command CLI runs see exactly the old behaviour,
+while the daemon gets per-job isolation by entering one scope per job.
+
+Contextvars do not cross ``threading.Thread`` boundaries on their own, so
+every helper thread that contributes telemetry (pipeline reader/writer/
+workers, BGZF prefetch, the device feeder, the heartbeat) is spawned
+through :func:`spawn_thread` / a captured :func:`contextvars.copy_context`
+— a job's counters follow its whole thread tree, not just the submitting
+thread.
+"""
+
+import contextvars
+import threading
+
+_SCOPE = contextvars.ContextVar("fgumi_tpu_telemetry_scope", default=None)
+#: Effective command line (argv list) override for output provenance (@PG
+#: CL lines). The serve daemon sets this to the *client's* command line so a
+#: job's outputs are byte-identical to the same command run standalone.
+_ARGV = contextvars.ContextVar("fgumi_tpu_command_argv", default=None)
+
+
+class TelemetryScope:
+    """One command's telemetry world: metrics + device stats + tracer.
+
+    Registries are created lazily: the ``DeviceStats`` in particular lives
+    in ``ops.kernel`` and is only materialized when a kernel actually
+    touches it, so numpy-free commands never pay that import."""
+
+    __slots__ = ("label", "metrics", "tracer", "_device_stats", "_lock")
+
+    def __init__(self, label: str = None):
+        from .metrics import MetricsRegistry
+
+        self.label = label
+        self.metrics = MetricsRegistry()
+        self.tracer = None  # set by trace.start_trace inside the scope
+        self._device_stats = None
+        self._lock = threading.Lock()
+
+    def device_stats(self, factory):
+        """This scope's DeviceStats, created on first use via ``factory``
+        (the class object, passed in to avoid an import cycle with
+        ops.kernel)."""
+        with self._lock:
+            if self._device_stats is None:
+                self._device_stats = factory()
+            return self._device_stats
+
+    def device_stats_if_any(self):
+        with self._lock:
+            return self._device_stats
+
+
+def current_scope():
+    """The active :class:`TelemetryScope`, or None (process-global mode)."""
+    return _SCOPE.get()
+
+
+class scoped_telemetry:
+    """Context manager entering a fresh (or given) telemetry scope.
+
+    ``with scoped_telemetry("simplex"):`` gives the body — and every thread
+    it spawns through :func:`spawn_thread` — its own metrics/device/trace
+    registries, isolated from any other scope and from the process globals.
+    """
+
+    def __init__(self, label: str = None, scope: TelemetryScope = None):
+        self.scope = scope if scope is not None else TelemetryScope(label)
+        self._token = None
+
+    def __enter__(self):
+        self._token = _SCOPE.set(self.scope)
+        return self.scope
+
+    def __exit__(self, *exc):
+        _SCOPE.reset(self._token)
+        return False
+
+
+class command_argv:
+    """Context manager overriding the provenance command line (@PG CL).
+
+    Outputs written inside the context record ``" ".join(argv)`` instead of
+    the process's ``sys.argv`` — how a daemon job reproduces the exact
+    header bytes of a standalone invocation."""
+
+    def __init__(self, argv):
+        self._argv = list(argv)
+        self._token = None
+
+    def __enter__(self):
+        self._token = _ARGV.set(self._argv)
+        return self._argv
+
+    def __exit__(self, *exc):
+        _ARGV.reset(self._token)
+        return False
+
+
+def current_argv():
+    """The effective command line for provenance: the override set by
+    :class:`command_argv` when inside one, else ``sys.argv``."""
+    override = _ARGV.get()
+    if override is not None:
+        return override
+    import sys
+
+    return sys.argv
+
+
+def publish_to_global(scope: TelemetryScope):
+    """Copy a finished scope's counters onto the process-global fallbacks.
+
+    The CLI calls this as each top-level command exits so the legacy
+    inspection surface — ``METRICS`` / ``DEVICE_STATS`` read *after*
+    ``cli_main`` returns by bench harnesses, probes, and tests — shows the
+    finished command's numbers exactly as the old reset-at-entry globals
+    did. Concurrent daemon jobs race here by design (last finisher wins):
+    the per-job truth lives in each job's own scope and run report."""
+    from . import metrics as _metrics
+
+    _metrics._GLOBAL_REGISTRY.replace(scope.metrics.snapshot())
+    import sys
+
+    kern = sys.modules.get("fgumi_tpu.ops.kernel")
+    if kern is not None:
+        stats = scope.device_stats_if_any()
+        if stats is not None:
+            kern._GLOBAL_DEVICE_STATS.load_from(stats)
+        else:
+            # the command never touched the device: the legacy surface must
+            # read zero, exactly like the old reset-at-entry did — leaving a
+            # previous command's dispatches visible would misattribute them
+            kern._GLOBAL_DEVICE_STATS.reset()
+
+
+def spawn_thread(target, *, name=None, daemon=True, args=()):
+    """A ``threading.Thread`` whose target runs in a copy of the caller's
+    context — the one-line way to keep a job's telemetry scope attached to
+    its helper threads. Returned un-started (call ``.start()``)."""
+    ctx = contextvars.copy_context()
+    return threading.Thread(target=lambda: ctx.run(target, *args),
+                            name=name, daemon=daemon)

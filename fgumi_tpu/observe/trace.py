@@ -49,11 +49,24 @@ class _NullSpan:
 
 NULL_SPAN = _NullSpan()
 
-_tracer = None  # the single active _Tracer, or None (tracing disabled)
+_tracer = None  # process-global _Tracer, or None (used when no scope active)
+
+
+def _current_tracer():
+    """The tracer spans should record into: the active telemetry scope's
+    (one per daemon job) when inside one, else the process-global tracer.
+    A scope with tracing off shades the global tracer on purpose — job A
+    tracing must not collect job B's spans."""
+    from .scope import current_scope
+
+    scope = current_scope()
+    if scope is not None:
+        return scope.tracer
+    return _tracer
 
 
 def tracing_enabled() -> bool:
-    return _tracer is not None
+    return _current_tracer() is not None
 
 
 # ---------------------------------------------------------------------------
@@ -167,7 +180,7 @@ def span(name: str, **attrs):
     allocation); enabled, a complete event is recorded when the context
     exits, tagged with ``attrs`` and the thread's id/name. Exceptions
     propagate (the span records ``error: <type>``)."""
-    t = _tracer
+    t = _current_tracer()
     if t is None:
         return NULL_SPAN
     return _Span(t, name, attrs or None)
@@ -175,22 +188,38 @@ def span(name: str, **attrs):
 
 def instant(name: str, **attrs):
     """Record a zero-duration instant event (a timeline marker)."""
-    t = _tracer
+    t = _current_tracer()
     if t is not None:
         t.instant(name, attrs or None)
 
 
 def start_trace(max_events: int = None):
-    """Enable tracing process-wide. Idempotent (keeps the active tracer)."""
+    """Enable tracing for the active telemetry scope (one per daemon job),
+    or process-wide when no scope is entered. Idempotent (keeps the active
+    tracer)."""
     global _tracer
+    from .scope import current_scope
+
+    scope = current_scope()
+    if scope is not None:
+        if scope.tracer is None:
+            scope.tracer = _Tracer(max_events)
+        return scope.tracer
     if _tracer is None:
         _tracer = _Tracer(max_events)
     return _tracer
 
 
 def stop_trace():
-    """Disable tracing and return the tracer (caller may still export it)."""
+    """Disable tracing (scope-local when inside a scope) and return the
+    tracer (caller may still export it)."""
     global _tracer
+    from .scope import current_scope
+
+    scope = current_scope()
+    if scope is not None:
+        t, scope.tracer = scope.tracer, None
+        return t
     t, _tracer = _tracer, None
     return t
 

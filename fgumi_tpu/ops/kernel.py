@@ -71,7 +71,12 @@ def _ensure_jax():
         jnp = _jnp
         _jax_ready = True
         # before the first jit compile so device executables land on disk
+        # and compile events are counted (device.backend_compiles — the
+        # warm-kernel evidence the serve daemon's smoke gate asserts on)
         _enable_persistent_compile_cache()
+        from ..observe import compilewatch
+
+        compilewatch.install()
     return jax
 
 
@@ -336,6 +341,22 @@ class DeviceStats:
         with self._lock:
             return [dict(t) for t in self.timeline]
 
+    def load_from(self, other: "DeviceStats"):
+        """Adopt another instance's counters wholesale (scope publishing:
+        a finished command's per-scope stats become the process-global view
+        that bench/probe harnesses read after cli_main)."""
+        with other._lock:
+            state = {k: getattr(other, k) for k in (
+                "dispatches", "fetch_wait_s", "bytes_fetched",
+                "bytes_uploaded", "model_flops", "rows_real", "rows_padded",
+                "in_flight", "retries", "batch_splits", "host_fallbacks",
+                "_t0")}
+            timeline = [dict(t) for t in other.timeline]
+        with self._lock:
+            for k, v in state.items():
+                setattr(self, k, v)
+            self.timeline = timeline
+
     def format_summary(self, wall_s: float = None) -> str:
         s = self.snapshot()
         parts = [f"device: {s['dispatches']} dispatches, "
@@ -357,7 +378,40 @@ class DeviceStats:
         return "; ".join(parts)
 
 
-DEVICE_STATS = DeviceStats()
+#: Fallback instance used when no telemetry scope is active (library use,
+#: tests, plain single-command CLI runs).
+_GLOBAL_DEVICE_STATS = DeviceStats()
+
+
+class _DeviceStatsProxy:
+    """Scope-resolving stand-in for the old module-wide DeviceStats.
+
+    Every attribute access (method or counter) resolves the active
+    telemetry scope (observe.scope) first — one DeviceStats per daemon job
+    — and falls back to the process-global instance, so the dozens of
+    existing ``DEVICE_STATS.xxx`` call sites keep working unchanged while
+    two concurrent jobs in one process never share counters."""
+
+    __slots__ = ()
+
+    @staticmethod
+    def _target() -> DeviceStats:
+        from ..observe.scope import current_scope
+
+        scope = current_scope()
+        if scope is not None:
+            return scope.device_stats(DeviceStats)
+        return _GLOBAL_DEVICE_STATS
+
+    def __getattr__(self, name):
+        return getattr(self._target(), name)
+
+    def __setattr__(self, name, value):
+        # tests monkeypatch counters (e.g. in_flight) straight through
+        setattr(self._target(), name, value)
+
+
+DEVICE_STATS = _DeviceStatsProxy()
 
 
 class DispatchTicket:
@@ -413,11 +467,20 @@ class DeviceFeeder:
             self._thread.start()
 
     def submit(self, fn) -> DispatchTicket:
-        """Run fn() (puts + jit dispatch) on the feeder thread."""
+        """Run fn() (puts + jit dispatch) on the feeder thread.
+
+        The submitter's context travels with the work item: the feeder is
+        one process-wide thread shared by every job, so retry counters,
+        dispatch spans, and compile events raised inside fn() must resolve
+        the *submitting* job's telemetry scope, not the feeder's empty
+        one."""
+        import contextvars
+
         ticket = DispatchTicket()
+        ctx = contextvars.copy_context()
         with self._cv:
             self._ensure_thread()
-            self._q.append((fn, ticket))
+            self._q.append((fn, ctx, ticket))
             self._cv.notify()
         return ticket
 
@@ -426,9 +489,9 @@ class DeviceFeeder:
             with self._cv:
                 while not self._q:
                     self._cv.wait()
-                fn, ticket = self._q.pop(0)
+                fn, ctx, ticket = self._q.pop(0)
             try:
-                result = fn()
+                result = ctx.run(fn)
                 # start the device->host copy NOW (non-blocking): by the
                 # time the resolve stage calls device_get, the result bytes
                 # are already on host (or in flight), so the fetch costs a

@@ -141,8 +141,9 @@ class _Watchdog:
         self._stop = threading.Event()
         self._t = None
         if interval > 0:  # <= 0 disables the watchdog entirely
-            self._t = threading.Thread(target=self._loop,
-                                       name="fgumi-watchdog", daemon=True)
+            from .observe.scope import spawn_thread
+
+            self._t = spawn_thread(self._loop, name="fgumi-watchdog")
             self._t.start()
 
     def _loop(self):
@@ -496,11 +497,16 @@ def _run_stages_impl(source_iter, process_fn, sink_fn, threads, queue_items,
             while q_out.get() is not _DONE:
                 pass
 
-    rt = threading.Thread(target=reader, name="fgumi-reader", daemon=True)
-    wt = threading.Thread(target=writer_pooled if n_workers else writer_direct,
-                          name="fgumi-writer", daemon=True)
-    wts = [threading.Thread(target=worker, args=(i,), name=f"fgumi-worker-{i}",
-                            daemon=True) for i in range(n_workers)]
+    # stage threads run in a copy of the caller's context so a scoped
+    # command's telemetry (metrics/trace/device stats — one scope per serve
+    # daemon job) follows its whole thread tree (observe.scope)
+    from .observe.scope import spawn_thread
+
+    rt = spawn_thread(reader, name="fgumi-reader")
+    wt = spawn_thread(writer_pooled if n_workers else writer_direct,
+                      name="fgumi-writer")
+    wts = [spawn_thread(worker, args=(i,), name=f"fgumi-worker-{i}")
+           for i in range(n_workers)]
     watchdog = _Watchdog(counters, q_in, q_out, watchdog_interval,
                          recover=deadlock_recover, budget=budget)
     # publish the watchdog's view (stage counters + queue depths) to the

@@ -1,0 +1,31 @@
+"""Persistent job service: warm-kernel serving over a Unix-domain socket.
+
+fgumi started life as a one-shot CLI: every invocation pays process
+startup, the ~2s jax import, and XLA compilation before the first batch
+moves. That cost model is wrong for repeated runs — the exact workload a
+production deployment serves. This package keeps one long-lived process
+holding the JAX device, the persistent compile cache, and every warmed jit
+executable, and runs pipeline jobs submitted over a newline-delimited JSON
+protocol on a Unix-domain socket:
+
+- :mod:`.protocol` — the schema-versioned wire protocol
+  (``submit`` / ``status`` / ``cancel`` / ``drain`` / ``shutdown`` /
+  ``ping``), frame limits, and validation.
+- :mod:`.jobs` — the job registry and per-job state machine
+  (queued -> running -> done/failed/cancelled).
+- :mod:`.scheduler` — bounded worker pool, FIFO within priority classes,
+  admission control with explicit rejection reasons, graceful drain.
+- :mod:`.daemon` — the socket server (``fgumi-tpu serve``); executes each
+  job by re-entering the ordinary CLI inside its own telemetry scope, so a
+  job's metrics/trace/run-report are exactly what the standalone command
+  would have produced — and its output bytes are identical too.
+- :mod:`.client` — the thin client used by ``fgumi-tpu submit`` and
+  ``fgumi-tpu jobs``.
+
+Every job is byte-parity-committed: the daemon overrides provenance
+(@PG CL) with the submitting client's command line, and all execution-state
+that used to be process-global (metrics, device stats, atomic-output flag,
+BGZF level, CLI re-entry depth) is context-scoped, so two concurrent jobs
+in one process behave like two processes. ``tools/serve_smoke.py`` gates
+this end to end.
+"""

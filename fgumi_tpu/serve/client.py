@@ -1,0 +1,112 @@
+"""Thin job-service client: one connection per request, blocking waits.
+
+Used by ``fgumi-tpu submit`` / ``fgumi-tpu jobs`` and by the smoke gate.
+Deliberately dependency-free and synchronous — the protocol is one JSON
+frame each way, and reconnect-per-request makes the client robust to a
+daemon restart between polls.
+"""
+
+import socket
+import sys
+import time
+
+from . import protocol
+
+
+class ServeError(RuntimeError):
+    """Transport failure or an ``ok: false`` response (reason in str())."""
+
+
+class ServeClient:
+    def __init__(self, socket_path: str, timeout: float = 30.0,
+                 max_frame_bytes: int = protocol.MAX_FRAME_BYTES):
+        self.socket_path = socket_path
+        self.timeout = timeout
+        self.max_frame_bytes = max_frame_bytes
+
+    # -- transport ----------------------------------------------------------
+
+    def request(self, obj: dict) -> dict:
+        """One request -> one response. Raises ServeError on transport
+        failure; returns the response frame verbatim (check ``ok``)."""
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        conn.settimeout(self.timeout)
+        try:
+            try:
+                conn.connect(self.socket_path)
+            except OSError as e:
+                raise ServeError(
+                    f"cannot reach daemon at {self.socket_path}: {e}")
+            try:
+                conn.sendall(protocol.encode_frame(obj))
+                stream = conn.makefile("rb")
+                resp = protocol.read_frame(stream, self.max_frame_bytes)
+            except (OSError, protocol.ProtocolError) as e:
+                raise ServeError(f"daemon connection failed: {e}")
+            if resp is None:
+                raise ServeError("daemon closed the connection mid-request")
+            return resp
+        finally:
+            conn.close()
+
+    def _checked(self, obj: dict) -> dict:
+        resp = self.request(obj)
+        if not resp.get("ok"):
+            raise ServeError(resp.get("error", "daemon refused the request"))
+        return resp
+
+    # -- operations ---------------------------------------------------------
+
+    def ping(self) -> dict:
+        return self._checked({"v": protocol.PROTOCOL_VERSION, "op": "ping"})
+
+    def submit(self, argv, priority: str = protocol.DEFAULT_PRIORITY,
+               argv0: str = None, tag: str = None,
+               trace: bool = False) -> dict:
+        """Submit a command; returns the accepted job record. An admission
+        rejection (queue full / draining) raises ServeError with the
+        daemon's reason."""
+        req = {"v": protocol.PROTOCOL_VERSION, "op": "submit",
+               "argv": list(argv), "priority": priority,
+               "argv0": argv0 if argv0 is not None else sys.argv[0],
+               "trace": bool(trace)}
+        if tag is not None:
+            req["tag"] = tag
+        return self._checked(req)["job"]
+
+    def status(self, job_id: str = None) -> dict:
+        req = {"v": protocol.PROTOCOL_VERSION, "op": "status"}
+        if job_id is not None:
+            req["id"] = job_id
+        return self._checked(req)
+
+    def job(self, job_id: str) -> dict:
+        return self.status(job_id)["job"]
+
+    def cancel(self, job_id: str) -> dict:
+        return self._checked({"v": protocol.PROTOCOL_VERSION, "op": "cancel",
+                              "id": job_id})["job"]
+
+    def drain(self) -> dict:
+        return self._checked({"v": protocol.PROTOCOL_VERSION, "op": "drain"})
+
+    def shutdown(self) -> dict:
+        return self._checked({"v": protocol.PROTOCOL_VERSION,
+                              "op": "shutdown"})
+
+    def wait(self, job_id: str, timeout: float = None,
+             poll_s: float = 0.2) -> dict:
+        """Poll until the job reaches a terminal state; returns the record.
+        Raises ServeError on timeout (the job keeps running)."""
+        from .jobs import TERMINAL
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            job = self.job(job_id)
+            if job["state"] in TERMINAL:
+                return job
+            if deadline is not None and time.monotonic() >= deadline:
+                raise ServeError(
+                    f"timed out waiting for job {job_id} "
+                    f"(still {job['state']})")
+            time.sleep(poll_s)

@@ -1,0 +1,320 @@
+"""The job-service daemon: socket server + warm-process job execution.
+
+One :class:`JobService` owns the Unix-domain listener, the scheduler, and
+the registry. Each admitted job is executed by re-entering the ordinary
+CLI (``cli.main``) on a worker thread — the whole point of the daemon is
+that this re-entry is *warm*: jax is imported, the persistent compile
+cache is enabled, and every jit executable compiled by an earlier job is
+still in memory, so repeated jobs skip straight to data movement.
+
+Per-job isolation rides on the context-scoped execution state introduced
+with this subsystem: the CLI gives every top-level invocation its own
+telemetry scope (metrics, DeviceStats, tracer), the atomic-output flag and
+BGZF level are contextvars, and provenance (@PG CL) is overridden with the
+submitting client's command line — so a job's output is byte-identical to
+the same command run standalone, and two concurrent jobs cannot see each
+other's counters.
+
+Lifecycle: ``drain`` (op) closes admission but keeps answering status;
+``shutdown`` (op) or SIGTERM/SIGINT additionally exits once queued and
+running jobs finish. The socket file is unlinked on exit; a stale socket
+from a crashed daemon is detected (connect fails) and replaced on start.
+"""
+
+import errno
+import json
+import logging
+import os
+import socket
+import threading
+import time
+
+from . import protocol
+from .jobs import JobRegistry
+from .scheduler import Scheduler
+
+log = logging.getLogger("fgumi_tpu")
+
+
+class SocketBusy(RuntimeError):
+    """Another live daemon already serves this socket path."""
+
+
+class JobService:
+    def __init__(self, socket_path: str, workers: int = 2,
+                 queue_limit: int = 8, report_dir: str = None,
+                 max_frame_bytes: int = protocol.MAX_FRAME_BYTES,
+                 keep_finished: int = 1000):
+        self.socket_path = socket_path
+        self.max_frame_bytes = max_frame_bytes
+        self.report_dir = report_dir
+        self.registry = JobRegistry(keep_finished=keep_finished)
+        self.scheduler = Scheduler(self._execute, self.registry,
+                                   workers=workers, queue_limit=queue_limit)
+        self.started_unix = time.time()
+        self._sock = None
+        self._accept_thread = None
+        self._shutdown = threading.Event()
+        self._closed = False
+
+    # -- warm-up ------------------------------------------------------------
+
+    def warm_up(self, compile_cache_dir: str = None, touch_device: bool = True):
+        """Pay the cold-start costs once, before the first job.
+
+        Enables the persistent XLA compile cache (optionally at an explicit
+        directory), imports jax, and touches the backend so device
+        discovery/claiming happens now — not inside job 1's latency."""
+        from ..utils.compile_cache import enable_persistent_cache
+
+        cache = enable_persistent_cache(compile_cache_dir)
+        if cache:
+            log.info("serve: persistent compile cache at %s", cache)
+        if not touch_device:
+            return
+        try:
+            t0 = time.monotonic()
+            from ..ops.kernel import _ensure_jax
+
+            jax = _ensure_jax()
+            devs = jax.devices()
+            log.info("serve: warm backend %s (%d device(s)) in %.2fs",
+                     devs[0].platform if devs else "none", len(devs),
+                     time.monotonic() - t0)
+        except Exception as e:  # noqa: BLE001 - serving still works cold
+            log.warning("serve: device warm-up failed (%s); jobs will pay "
+                        "cold start", e)
+
+    # -- job execution ------------------------------------------------------
+
+    def _job_argv(self, job):
+        """The argv actually passed to cli.main: the job's command plus the
+        daemon-injected per-job artifact flags (which must precede the
+        subcommand; the job's own later flags win on conflict)."""
+        pre = []
+        if self.report_dir:
+            job.report_path = os.path.join(self.report_dir,
+                                           f"{job.id}.report.json")
+            pre += ["--run-report", job.report_path]
+            if job.trace:
+                job.trace_path = os.path.join(self.report_dir,
+                                              f"{job.id}.trace.json")
+                pre += ["--trace", job.trace_path]
+        return pre + job.argv
+
+    def _execute(self, job) -> int:
+        """Run one job in-process; never raises (outcome on the record)."""
+        from ..cli import main as cli_main
+        from ..observe.scope import command_argv
+        from ..utils import faults
+
+        log.info("serve: job %s starting: %s", job.id, " ".join(job.argv))
+        t0 = time.monotonic()
+        try:
+            # chaos point: serve.dispatch:raise proves a failed job reports
+            # `failed` with a diagnostic while the daemon keeps serving
+            faults.fire("serve.dispatch")
+            # provenance override: outputs record the CLIENT's command line,
+            # making daemon runs byte-identical to standalone ones
+            with command_argv([job.argv0] + job.argv):
+                rc = cli_main(self._job_argv(job))
+        except BaseException as e:  # noqa: BLE001 - job outcome, not crash
+            self.registry.mark_failed(job, f"{type(e).__name__}: {e}")
+            log.warning("serve: job %s failed after %.2fs: %s", job.id,
+                        time.monotonic() - t0, job.error)
+            return 1
+        self.registry.mark_done(job, rc)
+        log.info("serve: job %s %s (rc=%d) in %.2fs", job.id, job.state,
+                 rc, time.monotonic() - t0)
+        return rc
+
+    # -- socket server ------------------------------------------------------
+
+    def _claim_socket(self):
+        """Bind the listener, replacing a *dead* daemon's socket file only.
+
+        Stale means the connect is actively refused (no listener behind the
+        file). A timeout or transient error (daemon stopped in a debugger,
+        backlog full under a client burst) is treated as BUSY — unlinking a
+        live daemon's socket would split-brain the service and that
+        daemon's exit would then delete *our* socket file."""
+        if os.path.exists(self.socket_path):
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                probe.settimeout(1.0)
+                probe.connect(self.socket_path)
+            except (ConnectionRefusedError, FileNotFoundError):
+                log.info("serve: replacing stale socket %s", self.socket_path)
+                try:
+                    os.unlink(self.socket_path)
+                except FileNotFoundError:
+                    pass
+            except OSError as e:
+                raise SocketBusy(
+                    f"daemon at {self.socket_path} did not answer ({e}); "
+                    "not replacing a possibly-live socket")
+            else:
+                raise SocketBusy(
+                    f"another daemon is already serving {self.socket_path}")
+            finally:
+                probe.close()
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.bind(self.socket_path)
+        sock.listen(16)
+        return sock
+
+    def bind(self):
+        """Claim the socket WITHOUT starting to serve. Raises SocketBusy.
+
+        Split from :meth:`start` so the CLI can fail fast on a busy socket
+        *before* paying (and disturbing) the single-tenant device warm-up."""
+        if self._sock is None:
+            self._sock = self._claim_socket()
+
+    def start(self):
+        """Bind (if not already), start workers and the accept loop."""
+        self.bind()
+        self.scheduler.start()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="fgumi-serve-accept", daemon=True)
+        self._accept_thread.start()
+        log.info("serve: listening on %s (%d workers, queue limit %d)",
+                 self.socket_path, self.scheduler.workers,
+                 self.scheduler.queue_limit)
+
+    def _accept_loop(self):
+        # keep accepting through a drain: clients must be able to poll
+        # status while queued/running jobs finish (the documented drain
+        # contract); the loop ends when close() closes the listener
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return  # listener closed during shutdown
+            t = threading.Thread(target=self._serve_connection, args=(conn,),
+                                 name="fgumi-serve-conn", daemon=True)
+            t.start()
+
+    def _serve_connection(self, conn: socket.socket):
+        stream = conn.makefile("rb")
+        try:
+            while True:
+                try:
+                    req = protocol.read_frame(stream, self.max_frame_bytes)
+                except protocol.ProtocolError as e:
+                    self._send(conn, protocol.error_response(str(e)))
+                    return  # framing is gone; close rather than resync
+                if req is None:
+                    return
+                resp = self.handle_request(req)
+                self._send(conn, resp)
+                # arm shutdown only AFTER the reply is on the wire: the
+                # main thread exits the process once the pool quiesces,
+                # which on an idle daemon can beat this thread's sendall
+                # and reset the client mid-response
+                if req.get("op") == "shutdown" and resp.get("ok"):
+                    self._shutdown.set()
+        except OSError:
+            pass  # peer went away mid-frame; nothing to answer
+        finally:
+            try:
+                stream.close()
+            except OSError:
+                pass
+            conn.close()
+
+    @staticmethod
+    def _send(conn, resp: dict):
+        try:
+            conn.sendall(protocol.encode_frame(resp))
+        except OSError:
+            pass
+
+    # -- request dispatch (transport-independent; tests call it directly) ---
+
+    def handle_request(self, req: dict) -> dict:
+        err = protocol.validate_request(req)
+        if err is not None:
+            return protocol.error_response(err)
+        op = req["op"]
+        if op == "ping":
+            return protocol.ok_response(
+                tool="fgumi-tpu", pid=os.getpid(),
+                uptime_s=round(time.time() - self.started_unix, 1),
+                jobs=self.registry.counts(), **self.scheduler.depth())
+        if op == "submit":
+            job = self.registry.create(
+                req["argv"], req.get("priority", protocol.DEFAULT_PRIORITY),
+                argv0=req.get("argv0"), tag=req.get("tag"),
+                trace=bool(req.get("trace")))
+            admitted, reason = self.scheduler.submit(job)
+            if not admitted:
+                # the response still carries the (cancelled) record so the
+                # client sees what was refused, but the registry forgets it:
+                # a rejection storm must not evict finished-job history
+                self.registry.mark_cancelled(job)
+                self.registry.discard(job.id)
+                return protocol.error_response(reason, job=job.to_wire())
+            return protocol.ok_response(job=job.to_wire())
+        if op == "status":
+            job_id = req.get("id")
+            if job_id is None:
+                return protocol.ok_response(
+                    jobs=[j.to_wire() for j in self.registry.list()],
+                    **self.scheduler.depth())
+            job = self.registry.get(job_id)
+            if job is None:
+                return protocol.error_response(f"unknown job {job_id}")
+            return protocol.ok_response(job=job.to_wire())
+        if op == "cancel":
+            ok, reason = self.scheduler.cancel(req["id"])
+            if not ok:
+                return protocol.error_response(reason)
+            job = self.registry.get(req["id"])
+            return protocol.ok_response(job=job.to_wire())
+        if op == "drain":
+            self.scheduler.drain()
+            return protocol.ok_response(**self.scheduler.depth())
+        if op == "shutdown":
+            # drain here; the socket layer arms the exit event after the
+            # response is sent (direct handle_request callers — tests, an
+            # embedding app — follow with request_shutdown themselves)
+            self.scheduler.drain()
+            return protocol.ok_response(**self.scheduler.depth())
+        raise AssertionError(f"unhandled op {op}")  # validate() covers this
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def request_shutdown(self):
+        """Graceful exit: flag shutdown. Genuinely signal-handler safe —
+        sets one event, no locks, no logging; the waiting main loop does
+        the drain (and its logging) outside signal context."""
+        self._shutdown.set()
+
+    def wait_until_shutdown(self, poll_s: float = 0.2):
+        """Block until a shutdown is requested AND the pool is quiescent.
+        Closes admission (idempotent drain) once the flag is seen."""
+        while not self._shutdown.wait(poll_s):
+            pass
+        self.scheduler.drain()
+        self.scheduler.join()
+
+    def close(self):
+        """Tear the listener down and remove the socket file (idempotent)."""
+        if self._closed:
+            return
+        self._closed = True
+        self._shutdown.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        try:
+            os.unlink(self.socket_path)
+        except OSError as e:
+            if e.errno != errno.ENOENT:
+                log.debug("serve: could not remove socket %s: %s",
+                          self.socket_path, e)
+        log.info("serve: stopped (%s)",
+                 json.dumps(self.registry.counts(), sort_keys=True))

@@ -1,0 +1,174 @@
+"""Job registry: per-job state machine and wire representation.
+
+Each submitted command becomes a :class:`Job` with a strict lifecycle::
+
+    queued ----> running ----> done     (exit status 0)
+      |             \\-------> failed   (nonzero exit / exception; the
+      |                                 diagnostic is kept on the record)
+      \\----> cancelled                 (queued jobs only — running jobs
+                                        are never preempted)
+
+Transitions outside this graph raise, so a scheduler bug can never
+resurrect a finished job or mark a cancelled one done. The registry is the
+daemon's single source of truth for ``status`` responses and keeps every
+terminal job until the daemon exits (bounded by ``keep_finished``, oldest
+evicted first) so a client can poll a job that finished between polls.
+"""
+
+import collections
+import itertools
+import threading
+import time
+
+STATES = ("queued", "running", "done", "failed", "cancelled")
+_ALLOWED = {
+    "queued": {"running", "cancelled"},
+    "running": {"done", "failed"},
+    "done": set(),
+    "failed": set(),
+    "cancelled": set(),
+}
+
+TERMINAL = frozenset(("done", "failed", "cancelled"))
+
+
+class Job:
+    """One submitted command and its lifecycle bookkeeping."""
+
+    __slots__ = ("id", "argv", "argv0", "priority", "tag", "trace",
+                 "state", "submitted_unix", "started_unix", "finished_unix",
+                 "exit_status", "error", "report_path", "trace_path")
+
+    def __init__(self, job_id: str, argv, priority: str, argv0: str = None,
+                 tag: str = None, trace: bool = False):
+        self.id = job_id
+        self.argv = list(argv)
+        self.argv0 = argv0 or "fgumi-tpu"
+        self.priority = priority
+        self.tag = tag
+        self.trace = bool(trace)
+        self.state = "queued"
+        self.submitted_unix = time.time()
+        self.started_unix = None
+        self.finished_unix = None
+        self.exit_status = None
+        self.error = None
+        self.report_path = None
+        self.trace_path = None
+
+    def to_wire(self) -> dict:
+        """The JSON-safe record sent in submit/status responses."""
+        return {
+            "id": self.id,
+            "state": self.state,
+            "argv": list(self.argv),
+            "priority": self.priority,
+            "tag": self.tag,
+            "submitted_unix": round(self.submitted_unix, 3),
+            "started_unix": (round(self.started_unix, 3)
+                             if self.started_unix else None),
+            "finished_unix": (round(self.finished_unix, 3)
+                              if self.finished_unix else None),
+            "exit_status": self.exit_status,
+            "error": self.error,
+            "report_path": self.report_path,
+            "trace_path": self.trace_path,
+        }
+
+
+class InvalidTransition(RuntimeError):
+    """A state change outside the job lifecycle graph."""
+
+
+class JobRegistry:
+    """Thread-safe id -> :class:`Job` store enforcing the state machine."""
+
+    def __init__(self, keep_finished: int = 1000):
+        self._lock = threading.Lock()
+        self._jobs = {}
+        self._order = []  # insertion order, for stable listing
+        # terminal ids in completion order: O(1) eviction on create instead
+        # of rescanning the whole history per submission
+        self._finished = collections.deque()
+        self._ids = itertools.count(1)
+        self._keep_finished = keep_finished
+
+    def create(self, argv, priority: str, argv0: str = None,
+               tag: str = None, trace: bool = False) -> Job:
+        with self._lock:
+            job = Job(f"j-{next(self._ids)}", argv, priority, argv0=argv0,
+                      tag=tag, trace=trace)
+            self._jobs[job.id] = job
+            self._order.append(job.id)
+            self._evict_locked()
+            return job
+
+    def _evict_locked(self):
+        while len(self._finished) > self._keep_finished:
+            jid = self._finished.popleft()
+            if jid in self._jobs:  # may already be discard()ed
+                del self._jobs[jid]
+                self._order.remove(jid)
+
+    def _note_terminal(self, job: Job):
+        with self._lock:
+            self._finished.append(job.id)
+
+    def discard(self, job_id: str):
+        """Forget a job entirely (admission-rejected submissions: keeping
+        them would let a rejection storm evict real finished-job history)."""
+        with self._lock:
+            if job_id in self._jobs:
+                del self._jobs[job_id]
+                self._order.remove(job_id)
+
+    def get(self, job_id: str) -> Job:
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def list(self):
+        with self._lock:
+            return [self._jobs[j] for j in self._order]
+
+    def counts(self) -> dict:
+        with self._lock:
+            out = dict.fromkeys(STATES, 0)
+            for job in self._jobs.values():
+                out[job.state] += 1
+            return out
+
+    # -- transitions --------------------------------------------------------
+
+    def _transition(self, job: Job, new_state: str):
+        with self._lock:
+            if new_state not in _ALLOWED[job.state]:
+                raise InvalidTransition(
+                    f"job {job.id}: {job.state} -> {new_state} is not a "
+                    "legal transition")
+            job.state = new_state
+
+    def mark_running(self, job: Job):
+        self._transition(job, "running")
+        job.started_unix = time.time()
+
+    def mark_done(self, job: Job, exit_status: int):
+        job.exit_status = int(exit_status)
+        if exit_status == 0:
+            self._transition(job, "done")
+        else:
+            job.error = job.error or f"command exited {exit_status}"
+            self._transition(job, "failed")
+        job.finished_unix = time.time()
+        self._note_terminal(job)
+
+    def mark_failed(self, job: Job, error: str):
+        job.error = str(error)
+        job.exit_status = job.exit_status if job.exit_status else 1
+        self._transition(job, "failed")
+        job.finished_unix = time.time()
+        self._note_terminal(job)
+
+    def mark_cancelled(self, job: Job):
+        self._transition(job, "cancelled")
+        job.finished_unix = time.time()
+        self._note_terminal(job)

@@ -1,0 +1,125 @@
+"""Job-service wire protocol: newline-delimited JSON frames, schema v1.
+
+One request frame per line, one response frame per line, UTF-8 JSON with a
+trailing ``\\n``. A connection may carry any number of request/response
+pairs (the client library opens one connection per request for simplicity;
+the daemon supports either). The schema is versioned exactly like the run
+report: every frame carries ``"v": PROTOCOL_VERSION`` and the daemon
+rejects mismatches loudly instead of guessing.
+
+Requests::
+
+    {"v": 1, "op": "submit", "argv": ["simplex", "-i", ...],
+     "priority": "normal", "argv0": "fgumi-tpu", "trace": false,
+     "tag": "optional-label"}
+    {"v": 1, "op": "status"}           # all jobs
+    {"v": 1, "op": "status", "id": "j-3"}
+    {"v": 1, "op": "cancel", "id": "j-3"}
+    {"v": 1, "op": "drain"}            # stop admitting, keep serving status
+    {"v": 1, "op": "shutdown"}         # drain, finish queued+running, exit
+    {"v": 1, "op": "ping"}             # daemon liveness + config echo
+
+Responses are ``{"v": 1, "ok": true, ...}`` or
+``{"v": 1, "ok": false, "error": "<reason>"}``. Submit acceptance returns
+the job record; admission rejection is ``ok: false`` with the reason
+(queue full / draining) so a load balancer can tell backpressure from
+breakage.
+
+Malformed frames (bad JSON, not an object, unknown op, missing fields) get
+an error response; oversized frames (> ``max_frame_bytes``, default 1 MiB)
+get an error response and the connection is closed — the daemon must never
+buffer unbounded garbage from a confused client.
+"""
+
+import json
+
+PROTOCOL_VERSION = 1
+
+#: Hard cap on one frame's bytes (newline included). Large enough for any
+#: realistic argv, small enough that a garbage stream cannot balloon the
+#: daemon's memory. Override with serve --max-frame-bytes.
+MAX_FRAME_BYTES = 1 << 20
+
+OPS = frozenset({"submit", "status", "cancel", "drain", "shutdown", "ping"})
+
+#: Priority classes, best-first. FIFO within a class.
+PRIORITIES = ("high", "normal", "low")
+DEFAULT_PRIORITY = "normal"
+
+
+class ProtocolError(ValueError):
+    """A frame this protocol refuses to act on (reason in str())."""
+
+
+def encode_frame(obj: dict) -> bytes:
+    """One JSON object as a newline-terminated wire frame."""
+    return json.dumps(obj, separators=(",", ":"), sort_keys=True).encode() \
+        + b"\n"
+
+
+def decode_frame(line: bytes) -> dict:
+    """Parse one frame; raises :class:`ProtocolError` with a diagnostic."""
+    try:
+        obj = json.loads(line)
+    except ValueError as e:
+        raise ProtocolError(f"malformed frame: not valid JSON ({e})")
+    if not isinstance(obj, dict):
+        raise ProtocolError(
+            f"malformed frame: expected a JSON object, got "
+            f"{type(obj).__name__}")
+    return obj
+
+
+def read_frame(stream, max_bytes: int = MAX_FRAME_BYTES):
+    """Read one frame from a binary stream (``socket.makefile('rb')``).
+
+    Returns the decoded dict, or None on clean EOF (peer closed between
+    frames). Raises :class:`ProtocolError` for an oversized frame or a
+    stream that ends mid-frame."""
+    line = stream.readline(max_bytes + 1)
+    if not line:
+        return None
+    if len(line) > max_bytes:
+        raise ProtocolError(
+            f"oversized frame: > {max_bytes} bytes (limit includes the "
+            "trailing newline)")
+    if not line.endswith(b"\n"):
+        raise ProtocolError("truncated frame: stream ended before newline")
+    return decode_frame(line)
+
+
+def validate_request(obj: dict):
+    """Return None for a well-formed request, else the rejection reason."""
+    v = obj.get("v")
+    if v != PROTOCOL_VERSION:
+        return (f"unsupported protocol version {v!r} "
+                f"(this daemon speaks v{PROTOCOL_VERSION})")
+    op = obj.get("op")
+    if op not in OPS:
+        return f"unknown op {op!r} (known: {', '.join(sorted(OPS))})"
+    if op == "submit":
+        argv = obj.get("argv")
+        if (not isinstance(argv, list) or not argv
+                or not all(isinstance(a, str) for a in argv)):
+            return "submit requires argv: a non-empty list of strings"
+        prio = obj.get("priority", DEFAULT_PRIORITY)
+        if prio not in PRIORITIES:
+            return (f"unknown priority {prio!r} "
+                    f"(known: {', '.join(PRIORITIES)})")
+        argv0 = obj.get("argv0")
+        if argv0 is not None and not isinstance(argv0, str):
+            return "argv0 must be a string"
+    if op in ("cancel",) and not isinstance(obj.get("id"), str):
+        return f"{op} requires id: a job id string"
+    if "id" in obj and obj["id"] is not None \
+            and not isinstance(obj["id"], str):
+        return "id must be a string"
+    return None
+
+
+def ok_response(**fields) -> dict:
+    return {"v": PROTOCOL_VERSION, "ok": True, **fields}
+
+
+def error_response(reason: str, **fields) -> dict:
+    return {"v": PROTOCOL_VERSION, "ok": False, "error": reason, **fields}

@@ -13,28 +13,40 @@ Escape hatch: the ``--no-atomic-output`` CLI flag or
 FIFO/special-file outputs, or filesystems where the extra rename matters).
 
 Stale temps from crashed runs are swept opportunistically: opening an
-atomic output for ``name`` removes ``.name.tmp.<pid>`` leftovers whose pid
-is no longer alive.
+atomic output for ``name`` removes ``.name.tmp.<pid>[.<seq>]`` leftovers
+whose *owning pid* is no longer alive. The sweep parses the pid out of the
+component right after ``tmp`` — never the trailing token — so a temp
+created by a live process can never be mistaken for a dead one's, and this
+process's own temps are always skipped (two concurrent daemon jobs share a
+pid; the per-open ``<seq>`` keeps their temp names distinct).
 """
 
+import contextvars
 import errno
 import glob
+import itertools
 import logging
 import os
 
 log = logging.getLogger("fgumi_tpu")
 
-_flag_disabled = False  # set by the CLI's --no-atomic-output
+# context-scoped so concurrent daemon jobs in one process can differ (a job
+# running with --no-atomic-output must not turn its neighbour's commit off);
+# plain CLI runs set it once per invocation like before
+_flag_disabled = contextvars.ContextVar("fgumi_tpu_no_atomic", default=False)
+
+# per-open uniquifier: two writers in one process targeting the same path
+# (daemon jobs) must never share a temp file
+_seq = itertools.count(1)
 
 
 def set_atomic_enabled(enabled: bool):
-    """CLI hook for --no-atomic-output (process-wide, per invocation)."""
-    global _flag_disabled
-    _flag_disabled = not enabled
+    """CLI hook for --no-atomic-output (per invocation, context-scoped)."""
+    _flag_disabled.set(not enabled)
 
 
 def atomic_enabled() -> bool:
-    if _flag_disabled:
+    if _flag_disabled.get():
         return False
     return os.environ.get("FGUMI_TPU_NO_ATOMIC", "").lower() \
         not in ("1", "true", "yes")
@@ -42,7 +54,23 @@ def atomic_enabled() -> bool:
 
 def _tmp_path(path: str) -> str:
     d, base = os.path.split(os.path.abspath(path))
-    return os.path.join(d, f".{base}.tmp.{os.getpid()}")
+    return os.path.join(d, f".{base}.tmp.{os.getpid()}.{next(_seq)}")
+
+
+def _owning_pid(temp_name: str, base: str):
+    """The pid embedded in a temp file name, or None when unparseable.
+
+    Reads the component immediately after ``.tmp.`` — both the current
+    ``.<base>.tmp.<pid>.<seq>`` and the legacy ``.<base>.tmp.<pid>`` form —
+    rather than the last dot token, which in the current form is the
+    sequence number (treating *that* as the pid is exactly the bug that let
+    a sweep delete a live writer's temp)."""
+    suffix = temp_name[len(f".{base}.tmp."):]
+    pid_s = suffix.split(".", 1)[0]
+    try:
+        return int(pid_s)
+    except ValueError:
+        return None
 
 
 def _pid_alive(pid: int) -> bool:
@@ -58,17 +86,16 @@ def _pid_alive(pid: int) -> bool:
 
 
 def cleanup_stale_temps(path: str):
-    """Remove ``.<name>.tmp.<pid>`` leftovers (for this target) whose
-    writing process is gone. Best-effort: unlink races are ignored."""
+    """Remove ``.<name>.tmp.<pid>[.<seq>]`` leftovers (for this target)
+    whose *owning* process is dead. Temps owned by any live pid — this
+    process included, which may have several jobs writing near this target
+    concurrently — are never touched. Best-effort: unlink races are
+    ignored."""
     d, base = os.path.split(os.path.abspath(path))
     pattern = os.path.join(glob.escape(d), f".{glob.escape(base)}.tmp.*")
     for p in glob.glob(pattern):
-        pid_s = p.rsplit(".", 1)[-1]
-        try:
-            pid = int(pid_s)
-        except ValueError:
-            continue
-        if pid == os.getpid() or _pid_alive(pid):
+        pid = _owning_pid(os.path.basename(p), base)
+        if pid is None or pid == os.getpid() or _pid_alive(pid):
             continue
         try:
             os.unlink(p)

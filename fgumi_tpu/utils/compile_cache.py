@@ -28,21 +28,32 @@ import os
 log = logging.getLogger("fgumi_tpu.compile_cache")
 
 _enabled = False
+_cache_dir = None
 
 
-def enable_persistent_cache():
+def cache_dir():
+    """The directory the persistent cache was enabled with, or None."""
+    return _cache_dir
+
+
+def enable_persistent_cache(path: str = None):
     """Point jax at an on-disk compilation cache (idempotent).
 
-    Returns the cache dir, or None when disabled/unavailable.
+    ``path`` pins an explicit directory (the serve daemon's
+    ``--compile-cache DIR``, also how the smoke gate gets a countable cache
+    to assert warm-kernel behaviour from); default is the env contract
+    above. Returns the cache dir, or None when disabled/unavailable/already
+    configured elsewhere.
     """
-    global _enabled
+    global _enabled, _cache_dir
     opt_out = os.environ.get("FGUMI_TPU_NO_XLA_CACHE", "").lower() \
         not in ("", "0", "false")
     if _enabled or opt_out or os.environ.get("JAX_COMPILATION_CACHE_DIR"):
         _enabled = True
-        return None
-    path = os.path.join(
-        os.path.expanduser("~"), ".cache", "fgumi_tpu", "xla_cache")
+        return _cache_dir
+    if path is None:
+        path = os.path.join(
+            os.path.expanduser("~"), ".cache", "fgumi_tpu", "xla_cache")
     try:
         os.makedirs(path, exist_ok=True)
         import jax
@@ -57,4 +68,5 @@ def enable_persistent_cache():
         log.debug("persistent compile cache unavailable: %s", e)
         return None
     _enabled = True
+    _cache_dir = path
     return path

@@ -47,6 +47,7 @@ FAULT_POINTS = frozenset({
     "device.dispatch",     # XLA upload+dispatch attempt (ops/kernel.py)
     "writer.compress",     # BGZF writer block emit (io/bgzf.py)
     "native.batch",        # native batch-op entry (native/batch.py)
+    "serve.dispatch",      # job-service worker dispatch (serve/daemon.py)
 })
 
 KINDS = frozenset({"raise", "hang", "corrupt-bytes", "oom"})

@@ -71,6 +71,47 @@ def test_stale_temp_cleanup(tmp_path):
         f.discard()
 
 
+def test_sweep_keeps_live_pid_temps_with_seq_suffix(tmp_path):
+    """Regression (serve daemon): the sweep must parse the OWNING pid —
+    the component right after `.tmp.` — not the trailing token. A live
+    process's `.name.tmp.<livepid>.<seq>` temp must survive a sweep even
+    when <seq> happens to look like a dead pid."""
+    out = tmp_path / "z.bam"
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait()
+    # another LIVE process's temp whose seq equals the dead pid: under the
+    # old last-token parse this was classified dead and deleted
+    live = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(30)"])
+    try:
+        victim = tmp_path / f".z.bam.tmp.{live.pid}.{dead.pid}"
+        victim.write_bytes(b"live job data")
+        stale = tmp_path / f".z.bam.tmp.{dead.pid}.7"
+        stale.write_bytes(b"dead leftover")
+        atomic.cleanup_stale_temps(str(out))
+        assert victim.exists(), "sweep deleted a live process's temp"
+        assert not stale.exists(), "sweep kept a dead process's temp"
+    finally:
+        live.kill()
+        live.wait()
+
+
+def test_concurrent_same_target_writers_do_not_collide(tmp_path):
+    """Two writers in ONE process targeting the same path (daemon jobs)
+    get distinct temps; each commit lands intact (last close wins)."""
+    out = tmp_path / "same.txt"
+    a = atomic.AtomicOutputFile(str(out), "w")
+    b = atomic.AtomicOutputFile(str(out), "w")
+    assert a._tmp != b._tmp
+    a.write("from-a")
+    b.write("from-b")
+    a.close()
+    assert out.read_text() == "from-a"
+    b.close()
+    assert out.read_text() == "from-b"
+    assert not _temps(out)
+
+
 def test_escape_hatch_env(tmp_path, monkeypatch):
     monkeypatch.setenv("FGUMI_TPU_NO_ATOMIC", "1")
     out = tmp_path / "direct.txt"
@@ -169,10 +210,12 @@ while True:
     assert not out.exists(), "SIGKILL left a partial file under the final name"
     leftovers = _temps(out)
     assert leftovers, "temp should remain after SIGKILL (to be swept later)"
-    # next atomic open of the same target sweeps the dead-pid temp
+    # next atomic open of the same target sweeps the dead-pid temp; the
+    # only temp left (if any) is this live process's own, uniquely
+    # suffixed .<pid>.<seq>
     f = atomic.AtomicOutputFile(str(out))
     try:
-        assert not _temps(out) or _temps(out) == [
-            f".victim.bam.tmp.{os.getpid()}"]
+        mine = f".victim.bam.tmp.{os.getpid()}."
+        assert all(t.startswith(mine) for t in _temps(out))
     finally:
         f.discard()

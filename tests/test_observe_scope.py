@@ -1,0 +1,199 @@
+"""Telemetry-scope tests: the contextvar-scoped registries that replaced
+the per-command global reset in cli.main — isolation between interleaved
+and concurrent in-process commands, thread propagation through pipeline
+helper threads, provenance argv override, and the global publish-at-exit
+surface legacy harnesses read."""
+
+import json
+import threading
+
+from fgumi_tpu.cli import main as cli_main
+from fgumi_tpu.observe.metrics import METRICS, current_registry
+from fgumi_tpu.observe.scope import (TelemetryScope, command_argv,
+                                     current_argv, current_scope,
+                                     scoped_telemetry, spawn_thread)
+
+
+# ---------------------------------------------------------------------------
+# scope primitives
+
+
+def test_no_scope_falls_back_to_global_registry():
+    assert current_scope() is None
+    METRICS.reset()
+    METRICS.inc("x.count", 2)
+    assert current_registry().get("x.count") == 2
+    METRICS.reset()
+
+
+def test_scoped_registry_isolated_from_global_and_restored():
+    METRICS.reset()
+    METRICS.inc("outside", 1)
+    with scoped_telemetry("cmd") as scope:
+        assert current_scope() is scope
+        METRICS.inc("inside", 5)
+        assert METRICS.get("inside") == 5
+        assert METRICS.get("outside") is None  # global is shaded
+    assert current_scope() is None
+    assert METRICS.get("inside") is None
+    assert METRICS.get("outside") == 1
+    METRICS.reset()
+
+
+def test_interleaved_scopes_do_not_cross_contaminate():
+    """The regression the satellite asks for: two commands interleaved in
+    one process each keep their own counters — under the old global reset,
+    B's entry would have zeroed A's live counters."""
+    a_started = threading.Event()
+    b_done = threading.Event()
+    results = {}
+
+    def command_a():
+        with scoped_telemetry("a") as scope:
+            METRICS.inc("records.a", 10)
+            a_started.set()
+            assert b_done.wait(10)  # B runs completely while A is live
+            METRICS.inc("records.a", 5)
+            results["a"] = scope.metrics.snapshot()
+
+    def command_b():
+        assert a_started.wait(10)
+        with scoped_telemetry("b") as scope:
+            METRICS.reset()  # the old cli reset, now scope-local
+            METRICS.inc("records.b", 7)
+            results["b"] = scope.metrics.snapshot()
+        b_done.set()
+
+    ta = threading.Thread(target=command_a)
+    tb = threading.Thread(target=command_b)
+    ta.start()
+    tb.start()
+    ta.join(15)
+    tb.join(15)
+    assert results["a"] == {"records.a": 15}
+    assert results["b"] == {"records.b": 7}
+
+
+def test_scope_propagates_to_spawned_threads():
+    with scoped_telemetry("cmd") as scope:
+        def helper():
+            METRICS.inc("from.helper", 3)
+
+        t = spawn_thread(helper, name="scope-helper")
+        t.start()
+        t.join(10)
+        assert scope.metrics.get("from.helper") == 3
+    # a PLAIN thread started inside a scope does NOT inherit it
+    leaked = {}
+    with scoped_telemetry("cmd2") as scope2:
+        def plain():
+            leaked["scope"] = current_scope()
+
+        t = threading.Thread(target=plain)
+        t.start()
+        t.join(10)
+    assert leaked["scope"] is None
+    assert scope2.metrics.snapshot() == {}
+
+
+def test_device_stats_scope_isolation():
+    from fgumi_tpu.ops.kernel import DEVICE_STATS, _GLOBAL_DEVICE_STATS
+
+    _GLOBAL_DEVICE_STATS.reset()
+    with scoped_telemetry("devcmd"):
+        DEVICE_STATS.add_dispatch(1000)
+        assert DEVICE_STATS.dispatches == 1
+        assert _GLOBAL_DEVICE_STATS.dispatches == 0
+    assert DEVICE_STATS.dispatches == 0  # back on the global fallback
+
+
+def test_publish_resets_global_device_stats_for_deviceless_command():
+    """A command that never touched the device must leave the legacy
+    global DEVICE_STATS at zero — not showing the previous command's
+    dispatches (reset-at-entry equivalence)."""
+    from fgumi_tpu.observe.scope import publish_to_global
+    from fgumi_tpu.ops.kernel import DEVICE_STATS, _GLOBAL_DEVICE_STATS
+
+    with scoped_telemetry("devcmd") as dev_scope:
+        DEVICE_STATS.add_dispatch(500)
+    publish_to_global(dev_scope)
+    assert _GLOBAL_DEVICE_STATS.dispatches == 1
+    with scoped_telemetry("hostcmd") as host_scope:
+        pass  # no device activity
+    publish_to_global(host_scope)
+    assert _GLOBAL_DEVICE_STATS.dispatches == 0
+
+
+def test_tracer_is_scope_local():
+    from fgumi_tpu.observe import trace
+
+    trace.stop_trace()
+    with scoped_telemetry("tracecmd"):
+        t = trace.start_trace()
+        with trace.span("inside"):
+            pass
+        assert trace.tracing_enabled()
+        assert {e["name"] for e in t.snapshot() if e["ph"] == "X"} \
+            == {"inside"}
+    # scope gone: its tracer is not the process tracer
+    assert not trace.tracing_enabled()
+
+
+def test_command_argv_override_and_default():
+    import sys
+
+    assert current_argv() is sys.argv
+    with command_argv(["fgumi-tpu", "sort", "-i", "x"]):
+        assert current_argv() == ["fgumi-tpu", "sort", "-i", "x"]
+    assert current_argv() is sys.argv
+
+
+def test_scope_device_stats_lazy_and_single():
+    scope = TelemetryScope("lazy")
+    assert scope.device_stats_if_any() is None
+
+    class Fake:
+        pass
+
+    one = scope.device_stats(Fake)
+    two = scope.device_stats(Fake)
+    assert one is two and isinstance(one, Fake)
+
+
+# ---------------------------------------------------------------------------
+# CLI end-to-end: concurrent in-process commands
+
+
+def test_concurrent_cli_commands_keep_separate_reports(tmp_path):
+    """Two cli_main invocations overlapping on two threads produce run
+    reports identical to what each would report alone."""
+    src = str(tmp_path / "grouped.bam")
+    assert cli_main(["simulate", "grouped-reads", "-o", src,
+                     "--num-families", "12", "--family-size", "3",
+                     "--seed", "3"]) == 0
+    solo_rpt = str(tmp_path / "solo.json")
+    assert cli_main(["--run-report", solo_rpt, "simplex", "-i", src,
+                     "-o", str(tmp_path / "solo.bam"), "--min-reads", "1",
+                     "--devices", "1"]) == 0
+    solo = json.load(open(solo_rpt))
+
+    rcs = {}
+
+    def run(tag):
+        rpt = str(tmp_path / f"{tag}.json")
+        rcs[tag] = cli_main(
+            ["--run-report", rpt, "simplex", "-i", src,
+             "-o", str(tmp_path / f"{tag}.bam"), "--min-reads", "1",
+             "--devices", "1"])
+
+    threads = [threading.Thread(target=run, args=(t,)) for t in ("p", "q")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert rcs == {"p": 0, "q": 0}
+    for tag in ("p", "q"):
+        report = json.load(open(str(tmp_path / f"{tag}.json")))
+        assert report["records"] == solo["records"]
+        assert report["metrics"]["io.bytes_read"] \
+            == solo["metrics"]["io.bytes_read"]

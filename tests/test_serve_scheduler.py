@@ -1,0 +1,218 @@
+"""Scheduler + job-registry tests: state machine enforcement, priority
+ordering, admission control, cancel semantics, and drain quiescence."""
+
+import threading
+import time
+
+import pytest
+
+from fgumi_tpu.serve.jobs import InvalidTransition, JobRegistry
+from fgumi_tpu.serve.scheduler import Scheduler
+
+
+# ---------------------------------------------------------------------------
+# registry state machine
+
+
+def test_job_lifecycle_done():
+    reg = JobRegistry()
+    job = reg.create(["sort"], "normal")
+    assert job.state == "queued"
+    reg.mark_running(job)
+    assert job.state == "running" and job.started_unix is not None
+    reg.mark_done(job, 0)
+    assert job.state == "done" and job.exit_status == 0
+    assert job.finished_unix is not None
+
+
+def test_job_lifecycle_failed_keeps_diagnostic():
+    reg = JobRegistry()
+    job = reg.create(["sort"], "normal")
+    reg.mark_running(job)
+    reg.mark_done(job, 2)
+    assert job.state == "failed"
+    assert job.exit_status == 2
+    assert "exited 2" in job.error
+
+
+def test_illegal_transitions_raise():
+    reg = JobRegistry()
+    job = reg.create(["sort"], "normal")
+    with pytest.raises(InvalidTransition):
+        reg.mark_done(job, 0)  # queued -> done skips running
+    reg.mark_cancelled(job)
+    with pytest.raises(InvalidTransition):
+        reg.mark_running(job)  # cancelled is terminal
+
+
+def test_registry_counts_and_wire_shape():
+    reg = JobRegistry()
+    a = reg.create(["sort"], "high", tag="t1")
+    reg.create(["dedup"], "low")
+    reg.mark_running(a)
+    counts = reg.counts()
+    assert counts["running"] == 1 and counts["queued"] == 1
+    wire = a.to_wire()
+    assert wire["id"] == a.id and wire["state"] == "running"
+    assert wire["tag"] == "t1" and wire["priority"] == "high"
+
+
+def test_registry_evicts_oldest_finished():
+    reg = JobRegistry(keep_finished=2)
+    done = []
+    for _ in range(4):
+        j = reg.create(["sort"], "normal")
+        reg.mark_running(j)
+        reg.mark_done(j, 0)
+        done.append(j.id)
+    live = reg.create(["sort"], "normal")  # create() triggers eviction
+    kept = {j.id for j in reg.list()}
+    assert live.id in kept
+    assert done[0] not in kept and done[1] not in kept
+    assert done[2] in kept and done[3] in kept
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+
+
+class _GatedExecutor:
+    """Executor whose jobs block until released (deterministic occupancy)."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.order = []
+        self.started = threading.Semaphore(0)
+
+    def __call__(self, job):
+        self.order.append(job.id)
+        self.started.release()
+        assert self.gate.wait(10)
+        return 0
+
+
+def _wait_until(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_admission_control_rejects_over_capacity():
+    reg = JobRegistry()
+    ex = _GatedExecutor()
+    sched = Scheduler(ex, reg, workers=1, queue_limit=1)
+    sched.start()
+    try:
+        j1 = reg.create(["a"], "normal")
+        j2 = reg.create(["b"], "normal")
+        j3 = reg.create(["c"], "normal")
+        assert sched.submit(j1) == (True, None)
+        assert ex.started.acquire(timeout=5)  # j1 occupies the worker
+        assert sched.submit(j2) == (True, None)  # fills the queue slot
+        admitted, reason = sched.submit(j3)
+        assert not admitted
+        assert "queue full" in reason and "capacity 2" in reason
+    finally:
+        ex.gate.set()
+        sched.drain()
+        assert sched.join(timeout=10)
+
+
+def test_priority_classes_order_fifo_within_class():
+    reg = JobRegistry()
+    ex = _GatedExecutor()
+    sched = Scheduler(ex, reg, workers=1, queue_limit=10)
+    sched.start()
+    try:
+        blocker = reg.create(["blocker"], "normal")
+        sched.submit(blocker)
+        assert ex.started.acquire(timeout=5)  # worker busy; rest queue up
+        lo1 = reg.create(["lo1"], "low")
+        hi1 = reg.create(["hi1"], "high")
+        no1 = reg.create(["no1"], "normal")
+        hi2 = reg.create(["hi2"], "high")
+        for j in (lo1, hi1, no1, hi2):
+            assert sched.submit(j)[0]
+        ex.gate.set()
+        assert _wait_until(sched.idle, timeout=10)
+        # high before normal before low; FIFO inside the high class
+        assert ex.order == [blocker.id, hi1.id, hi2.id, no1.id, lo1.id]
+    finally:
+        ex.gate.set()
+        sched.drain()
+        sched.join(timeout=10)
+
+
+def test_cancel_queued_only():
+    reg = JobRegistry()
+    ex = _GatedExecutor()
+    sched = Scheduler(ex, reg, workers=1, queue_limit=5)
+    sched.start()
+    try:
+        running = reg.create(["r"], "normal")
+        queued = reg.create(["q"], "normal")
+        sched.submit(running)
+        assert ex.started.acquire(timeout=5)
+        sched.submit(queued)
+        ok, reason = sched.cancel(queued.id)
+        assert ok and queued.state == "cancelled"
+        ok, reason = sched.cancel(running.id)
+        assert not ok and "never preempted" in reason
+        ok, reason = sched.cancel("j-404")
+        assert not ok and "unknown job" in reason
+        ex.gate.set()
+        assert _wait_until(sched.idle, timeout=10)
+        # the cancelled job never ran
+        assert queued.id not in ex.order
+    finally:
+        ex.gate.set()
+        sched.drain()
+        sched.join(timeout=10)
+
+
+def test_drain_closes_admission_but_finishes_queued():
+    reg = JobRegistry()
+    ex = _GatedExecutor()
+    sched = Scheduler(ex, reg, workers=1, queue_limit=5)
+    sched.start()
+    try:
+        first = reg.create(["one"], "normal")
+        second = reg.create(["two"], "normal")
+        sched.submit(first)
+        assert ex.started.acquire(timeout=5)
+        sched.submit(second)
+        sched.drain()
+        late = reg.create(["late"], "normal")
+        admitted, reason = sched.submit(late)
+        assert not admitted and "draining" in reason
+        ex.gate.set()
+        assert sched.join(timeout=10)
+        # drain ran BOTH admitted jobs to completion, never the late one
+        assert ex.order == [first.id, second.id]
+        assert first.state == "done" and second.state == "done"
+    finally:
+        ex.gate.set()
+
+
+def test_executor_exception_marks_job_failed_worker_survives():
+    reg = JobRegistry()
+    boom = {"left": 1}
+
+    def execute(job):
+        if boom["left"]:
+            boom["left"] -= 1
+            raise RuntimeError("executor exploded")
+        return 0
+
+    sched = Scheduler(execute, reg, workers=1, queue_limit=5)
+    sched.start()
+    bad = reg.create(["bad"], "normal")
+    good = reg.create(["good"], "normal")
+    sched.submit(bad)
+    sched.submit(good)
+    assert _wait_until(sched.idle, timeout=10)
+    assert bad.state == "failed" and "executor exploded" in bad.error
+    assert good.state == "done"

@@ -1,0 +1,237 @@
+#!/usr/bin/env python3
+"""Serve smoke: daemon round-trip parity, warm-kernel reuse, capacity
+rejection, and SIGTERM drain — the CI gate for the job-service subsystem.
+
+Scenarios (exit 0 when every check holds, one PASS/FAIL line each):
+
+1. Two jobs submitted concurrently to a 2-worker daemon produce outputs
+   byte-identical to the same commands run standalone (the daemon resolves
+   relative paths against its own working directory, so both runs use the
+   same literal argv — provenance lines included — and land in different
+   directories).
+2. One submission over capacity (workers + queue-limit) is rejected with an
+   explicit reason while the admitted jobs complete.
+3. Every admitted job leaves a schema-valid per-job run report.
+4. Warm-kernel serving: the first device-kernel job reports real XLA
+   compilations (``device.backend_compiles``); resubmitting the identical
+   command on the warm daemon reports none (and the persistent compile
+   cache gained no new entries).
+5. SIGTERM drain: a running job finishes and commits its output, new
+   submissions are refused, and the daemon exits 0.
+
+Usage:  python tools/serve_smoke.py [--keep]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASE_ENV = {
+    **os.environ,
+    "PYTHONPATH": REPO,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "",
+    "PALLAS_AXON_POOL_IPS": "",
+    # force the device kernel so warm-vs-cold compile evidence exists even
+    # on a CPU-only host
+    "FGUMI_TPU_HOST_ENGINE": "0",
+}
+
+
+def run(args, cwd, env=None, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "fgumi_tpu", *args], cwd=cwd,
+        env={**BASE_ENV, **(env or {})}, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def check(name, ok, detail=""):
+    print(f"{'PASS' if ok else 'FAIL'}  {name}" + (f"  ({detail})"
+                                                   if detail else ""))
+    return ok
+
+
+def wait_for_socket(path, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(0.1)
+    return False
+
+
+def cache_entries(d):
+    if not os.path.isdir(d):
+        return 0
+    return sum(len(files) for _, _, files in os.walk(d))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch directory")
+    opts = ap.parse_args()
+    from fgumi_tpu.observe.report import validate_report
+    from fgumi_tpu.serve.client import ServeClient, ServeError
+
+    tmp = tempfile.mkdtemp(prefix="fgumi_serve_")
+    ok = True
+    daemon = None
+    try:
+        wd_std = os.path.join(tmp, "standalone")
+        wd_srv = os.path.join(tmp, "daemon")
+        rpt = os.path.join(tmp, "reports")
+        cache = os.path.join(tmp, "xla_cache")
+        for d in (wd_std, wd_srv, rpt):
+            os.makedirs(d)
+        inp = os.path.join(tmp, "grouped.bam")
+        p = run(["simulate", "grouped-reads", "-o", inp,
+                 "--num-families", "600", "--family-size", "4",
+                 "--seed", "7"], cwd=tmp)
+        assert p.returncode == 0, p.stderr
+
+        # job argvs use relative outputs: same literal command line in both
+        # worlds (provenance bytes included); directories keep them apart
+        job1 = ["simplex", "-i", inp, "-o", "out1.bam", "--min-reads", "1"]
+        job2 = ["sort", "-i", inp, "-o", "out2.bam",
+                "--order", "template-coordinate"]
+
+        # --- standalone references -------------------------------------
+        for argv in (job1, job2):
+            p = run(argv, cwd=wd_std)
+            assert p.returncode == 0, p.stderr
+
+        # --- daemon up --------------------------------------------------
+        sock = os.path.join(tmp, "serve.sock")
+        daemon = subprocess.Popen(
+            [sys.executable, "-m", "fgumi_tpu", "serve", "--socket", sock,
+             "--workers", "2", "--queue-limit", "0", "--report-dir", rpt,
+             "--compile-cache", cache],
+            cwd=wd_srv, env=BASE_ENV, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        ok &= check("daemon socket appears", wait_for_socket(sock))
+        client = ServeClient(sock, timeout=30)
+
+        # argv0 matching the standalone invocations (python -m fgumi_tpu)
+        argv0 = os.path.join(REPO, "fgumi_tpu", "__main__.py")
+
+        # --- two concurrent jobs + one rejected over capacity -----------
+        j1 = client.submit(job1, argv0=argv0)
+        j2 = client.submit(job2, argv0=argv0)
+        over_reason = None
+        try:
+            client.submit(job1, argv0=argv0)
+        except ServeError as e:
+            over_reason = str(e)
+        ok &= check("over-capacity submission rejected with reason",
+                    over_reason is not None and "queue full" in over_reason,
+                    over_reason or "admitted!")
+        j1 = client.wait(j1["id"], timeout=240)
+        j2 = client.wait(j2["id"], timeout=240)
+        ok &= check("both concurrent jobs done",
+                    j1["state"] == "done" and j2["state"] == "done",
+                    f"{j1['state']}/{j2['state']} "
+                    f"{j1.get('error')}/{j2.get('error')}")
+
+        for name in ("out1.bam", "out2.bam"):
+            a = open(os.path.join(wd_std, name), "rb").read()
+            b = open(os.path.join(wd_srv, name), "rb").read()
+            ok &= check(f"{name} byte-identical to standalone", a == b,
+                        f"{len(a)} vs {len(b)} bytes")
+
+        # --- per-job run reports ----------------------------------------
+        reports = {}
+        for j in (j1, j2):
+            try:
+                reports[j["id"]] = json.load(open(j["report_path"]))
+            except (OSError, ValueError, TypeError):
+                reports[j["id"]] = None
+            errs = (validate_report(reports[j["id"]])
+                    if reports[j["id"]] else ["unreadable"])
+            ok &= check(f"job {j['id']} run report schema-valid", not errs,
+                        "; ".join(errs[:3]))
+
+        # --- warm-kernel evidence ---------------------------------------
+        r1 = reports.get(j1["id"]) or {}
+        cold_compiles = r1.get("metrics", {}).get("device.backend_compiles",
+                                                  0)
+        ok &= check("cold job reports XLA compilations",
+                    cold_compiles > 0, f"compiles={cold_compiles}")
+        entries_before = cache_entries(cache)
+        j3 = client.submit(job1, argv0=argv0)  # identical shapes, warm now
+        j3 = client.wait(j3["id"], timeout=240)
+        ok &= check("warm resubmission done", j3["state"] == "done",
+                    str(j3.get("error")))
+        r3 = json.load(open(j3["report_path"]))
+        warm_compiles = r3.get("metrics", {}).get("device.backend_compiles",
+                                                  0)
+        ok &= check("warm job skips recompilation",
+                    warm_compiles == 0 and r3.get("device", {})
+                    .get("dispatches", 0) > 0,
+                    f"compiles={warm_compiles} "
+                    f"dispatches={r3.get('device', {}).get('dispatches')}")
+        ok &= check("compile cache gained no entries on the warm job",
+                    cache_entries(cache) == entries_before,
+                    f"{entries_before} -> {cache_entries(cache)}")
+        a = open(os.path.join(wd_std, "out1.bam"), "rb").read()
+        b = open(os.path.join(wd_srv, "out1.bam"), "rb").read()
+        ok &= check("warm rerun output still byte-identical", a == b)
+
+        # --- SIGTERM drain ----------------------------------------------
+        j4 = client.submit(job1, argv0=argv0)
+        daemon.send_signal(signal.SIGTERM)
+        # admission must close; allow for signal-delivery latency (a submit
+        # racing the handler may still be admitted — it just runs to
+        # completion during the drain, which is the documented contract)
+        refused = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                client.submit(job2, argv0=argv0)
+                time.sleep(0.1)
+            except ServeError as e:
+                refused = str(e)
+                # require the DRAIN refusal (or the daemon already gone):
+                # accepting any rejection would let a "queue full" bounce
+                # satisfy this check without drain ever engaging
+                if "draining" in refused or "cannot reach" in refused:
+                    break
+        ok &= check("post-SIGTERM submission refused by drain",
+                    refused is not None
+                    and ("draining" in refused or "cannot reach" in refused),
+                    refused or "still admitting")
+        daemon_rc = daemon.wait(timeout=240)
+        ok &= check("daemon exits 0 after drain", daemon_rc == 0,
+                    f"rc={daemon_rc}")
+        daemon = None
+        j4_report = os.path.join(rpt, f"{j4['id']}.report.json")
+        r4 = json.load(open(j4_report))
+        ok &= check("in-flight job finished during drain",
+                    r4["exit_status"] == 0 and not validate_report(r4))
+        ok &= check("drained job committed its output",
+                    open(os.path.join(wd_srv, "out1.bam"), "rb").read()
+                    == open(os.path.join(wd_std, "out1.bam"), "rb").read())
+        ok &= check("socket removed on exit", not os.path.exists(sock))
+    finally:
+        if daemon is not None and daemon.poll() is None:
+            daemon.kill()
+            daemon.wait(timeout=10)
+        if opts.keep:
+            print("scratch kept at", tmp)
+        else:
+            shutil.rmtree(tmp, ignore_errors=True)
+    print("serve smoke:", "OK" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

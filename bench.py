@@ -67,6 +67,33 @@ from fgumi_tpu.ops.kernel import DEVICE_STATS
 # under-measures either side; symmetric treatment keeps the ratio honest
 wall_s = None
 dstats = None
+breakdown = None
+
+def dispatch_breakdown():
+    # Per-dispatch attribution from the DeviceStats timeline
+    # (docs/observability.md "Dispatch breakdown"): pack_s = host packing
+    # (gather/pad/wire build), upload_s = device_put wall time on the
+    # feeder thread, compute_s = upload-done to fetch-start (device
+    # compute overlapped with host work), fetch_s = host time blocked
+    # waiting for result bytes. Plus the constant-cache hit/upload
+    # counters that prove tables cross the link once, not per dispatch.
+    tl = DEVICE_STATS.timeline_snapshot()
+    agg = {"dispatches": len(tl), "pack_s": 0.0, "upload_s": 0.0,
+           "compute_s": 0.0, "fetch_s": 0.0}
+    for t in tl:
+        agg["pack_s"] += t.get("pack_s", 0.0)
+        agg["upload_s"] += t.get("upload_s", 0.0)
+        agg["fetch_s"] += t.get("fetch_wait_s", 0.0)
+        if "t_fetched" in t and "t_exec" in t:
+            agg["compute_s"] += max(
+                t["t_fetched"] - t.get("fetch_wait_s", 0.0) - t["t_exec"],
+                0.0)
+    for k in ("pack_s", "upload_s", "compute_s", "fetch_s"):
+        agg[k] = round(agg[k], 4)
+    agg["const_cache_hits"] = DEVICE_STATS.const_hits
+    agg["const_cache_uploads"] = DEVICE_STATS.const_uploads
+    return agg
+
 configs = [threads] if threads == "0" else [threads, "0"]
 for ci, thr in enumerate(configs):
     for _ in range(3 if ci == 0 else 2):
@@ -79,11 +106,13 @@ for ci, thr in enumerate(configs):
         if wall_s is None or trial < wall_s:
             wall_s = trial
             dstats = DEVICE_STATS.snapshot()
+            breakdown = dispatch_breakdown()
 print(json.dumps({"platform": platform, "device": str(jax.devices()[0]),
                   "warm_s": round(warm_s, 3), "wall_s": round(wall_s, 3),
                   "device_fraction": round(
                       dstats["fetch_wait_s"] / wall_s, 4) if wall_s else 0.0,
-                  "device_stats": dstats}))
+                  "device_stats": dstats,
+                  "dispatch_breakdown": breakdown}))
 """
 
 

@@ -3050,6 +3050,14 @@ def build_parser():
         help="log a one-line progress heartbeat (stage counters, queue "
              "depths, device activity, RSS) every N seconds "
              "(also FGUMI_TPU_HEARTBEAT_S; 0 = off, the default)")
+    parser.add_argument(
+        "--shape-buckets", type=_shape_buckets_arg, default=None,
+        metavar="GROWTH[:CAP]",
+        help="device padded-shape bucket ladder: geometric growth factor "
+             "in [1.01, 2.0] between adjacent buckets (default 1.0625) and "
+             "optional ladder cap (default 2^24); bounds the XLA "
+             "executable vocabulary and the padding waste "
+             "(also FGUMI_TPU_SHAPE_BUCKETS; docs/device-datapath.md)")
     sub = parser.add_subparsers(dest="command", required=True)
     _add_extract(sub)
     _add_correct(sub)
@@ -3117,6 +3125,45 @@ def _run_command(args):
         return 130
 
 
+def _shape_buckets_arg(value: str) -> str:
+    """argparse validator for --shape-buckets: loud parse errors at the
+    command line instead of at first device dispatch."""
+    import argparse as _ap
+
+    from .ops.datapath import parse_shape_buckets
+
+    try:
+        parse_shape_buckets(value)
+    except ValueError as e:
+        raise _ap.ArgumentTypeError(str(e)) from None
+    return value
+
+
+def _apply_shape_buckets(args):
+    """Reconfigure the process-global shape-bucket ladder for this
+    invocation; returns a zero-arg restore callable (or None).
+
+    The environment is deliberately left untouched and the ladder reverts
+    at command exit: in the serve daemon one job's flag must not leak into
+    every later job (the ladder is still a process-wide property while
+    jobs overlap — daemon operators set FGUMI_TPU_SHAPE_BUCKETS on the
+    daemon itself instead). Nested ``pipeline`` stages run in-process at
+    depth > 0 and inherit the configured registry."""
+    spec = getattr(args, "shape_buckets", None)
+    if not spec:
+        return None
+    from .ops.datapath import SHAPE_REGISTRY
+
+    gen = SHAPE_REGISTRY.reconfigure(spec)
+
+    def restore():
+        # back to env/defaults — unless a concurrent invocation (daemon
+        # job) reconfigured since, in which case its ladder wins
+        SHAPE_REGISTRY.reconfigure(only_if_gen=gen)
+
+    return restore
+
+
 def _telemetry_config(args):
     """(trace_path, report_path, heartbeat_s) from flags + environment."""
     trace_path = args.trace or os.environ.get("FGUMI_TPU_TRACE") or None
@@ -3165,15 +3212,24 @@ def main(argv=None):
     # registries did under the outermost reset.
     from .observe.scope import publish_to_global, scoped_telemetry
 
-    with scoped_telemetry(args.command) as scope:
-        try:
-            return _main_scoped(args, argv)
-        finally:
-            # legacy surface: leave the finished command's counters visible
-            # on the process-global METRICS/DEVICE_STATS, exactly like the
-            # old reset-at-entry globals did (bench/probe harnesses read
-            # them right after cli_main returns)
-            publish_to_global(scope)
+    restore_buckets = None
+    try:
+        restore_buckets = _apply_shape_buckets(args)
+        with scoped_telemetry(args.command) as scope:
+            try:
+                return _main_scoped(args, argv)
+            finally:
+                # legacy surface: leave the finished command's counters
+                # visible on the process-global METRICS/DEVICE_STATS,
+                # exactly like the old reset-at-entry globals did (bench/
+                # probe harnesses read them right after cli_main returns)
+                publish_to_global(scope)
+    finally:
+        # outside scoped_telemetry: the per-invocation ladder must revert
+        # even when entering the scope itself raises, or a daemon job's
+        # --shape-buckets would leak into every later job
+        if restore_buckets is not None:
+            restore_buckets()
 
 
 def _main_scoped(args, argv):

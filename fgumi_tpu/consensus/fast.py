@@ -291,12 +291,13 @@ class FastSimplexCaller:
         self.tag = tag
         self.overlap_caller = overlap_caller  # OverlappingBasesConsensusCaller
         self.mesh = mesh if mesh is not None and mesh.size > 1 else None
-        import os
-
         # hybrid routing: device dispatches in flight beyond this cap route
         # to the host f64 engine instead (the link is saturated; queueing
-        # more just delays the writer). ~3 batches ≈ 1-1.5 s of link backlog.
-        self.max_inflight = int(os.environ.get("FGUMI_TPU_MAX_INFLIGHT", "3"))
+        # more just delays the writer) — policy shared with the duplex and
+        # codec engines (ops/kernel.default_max_inflight)
+        from ..ops.kernel import default_max_inflight
+
+        self.max_inflight = default_max_inflight()
         opts = caller.options
         # conditions the vectorized conversion cannot express
         self._vector_ok = (not opts.trim and not opts.methylation_mode)
@@ -885,11 +886,10 @@ class FastSimplexCaller:
             return (self._dispatch_sharded(multi, counts, starts, codes_d,
                                            quals_d, L_max), blocks0)
 
-        from ..ops.kernel import DEVICE_STATS, HOST_DISPATCH
+        from ..ops.kernel import HOST_DISPATCH, device_backlogged
 
         if kernel.host_mode() or (kernel.hybrid_mode()
-                                  and DEVICE_STATS.in_flight_count()
-                                  >= self.max_inflight):
+                                  and device_backlogged(self.max_inflight)):
             # host f64 engine path: either no device at all, or (hybrid) the
             # device pipe is full — the link absorbs what it can, the host
             # engine eats the overflow CONCURRENTLY on the resolve pool, so
@@ -905,12 +905,16 @@ class FastSimplexCaller:
             # FGUMI_TPU_HYBRID=0 (or no native library): whole batches ship
             # to the device in the 1 B/position wire layout — the raw-device
             # benchmark/debug mode documented in performance-tuning.md
+            import time
+
             from ..ops.kernel import pad_segments_gather
 
+            t_pack0 = time.monotonic()  # gather+pad+wire == this batch's pack
             codes_dev, quals_dev, seg_ids, starts_p, F_pad, N = \
                 pad_segments_gather(codes, quals, rows_all, L_max, counts)
             ticket = kernel.device_call_segments_wire(
-                codes_dev, quals_dev, seg_ids, F_pad, len(multi))
+                codes_dev, quals_dev, seg_ids, F_pad, len(multi),
+                pack_t0=t_pack0)
             return ("segw", multi, starts_p, codes_dev[:N], quals_dev[:N],
                     ticket), blocks0
 

@@ -31,6 +31,12 @@ class FastCodecCaller:
 
     def __init__(self, caller, tag: bytes = b"MI"):
         self.caller = caller
+        # hybrid backlog cap shared with the simplex/duplex engines
+        # (ops/kernel.default_max_inflight): a backlogged upload pipeline
+        # routes this batch to the native f64 host engine
+        from ..ops.kernel import default_max_inflight
+
+        self.max_inflight = default_max_inflight()
         self.tag = tag
         self._carry = None  # (mi string, [RawRecord])
 
@@ -180,11 +186,19 @@ class FastCodecCaller:
                     codes2d[row, :k] = c[:k]
                     quals2d[row, :k] = q[:k]
                     row += 1
+            from ..ops.kernel import HOST_DISPATCH, device_backlogged
+
             if ss.kernel.host_mode() or not ss.kernel.hybrid_mode():
                 dev, starts = ss.kernel.dispatch_segments(codes2d, quals2d,
                                                           counts)
                 w, q_, d, e = ss.kernel.resolve_segments(dev, codes2d,
                                                          quals2d, starts)
+            elif device_backlogged(self.max_inflight):
+                # upload pipeline full: host f64 engine absorbs this batch
+                # concurrently (device + host, not min of the two)
+                starts = np.concatenate(([0], np.cumsum(counts)))
+                w, q_, d, e = ss.kernel.resolve_segments(
+                    HOST_DISPATCH, codes2d, quals2d, starts)
             else:
                 # device: classify + compact hard-column dispatch (the
                 # synchronous round trip ships only the hard few percent —

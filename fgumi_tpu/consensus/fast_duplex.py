@@ -68,6 +68,12 @@ class FastDuplexCaller:
         self.tag = tag
         self.overlap_caller = overlap_caller
         self.mesh = mesh if mesh is not None and mesh.size > 1 else None
+        # hybrid backlog cap shared with the simplex/codec engines
+        # (ops/kernel.default_max_inflight): when the upload pipeline is
+        # full, this batch runs on the native f64 host engine instead
+        from ..ops.kernel import default_max_inflight
+
+        self.max_inflight = default_max_inflight()
         self._carry = None  # (base_mi, [RawRecord] a, [RawRecord] b)
         # With threads<=1 the CLI sets this True: the SS device round trip is
         # then deferred into a pending chunk resolved AFTER the next batch's
@@ -428,6 +434,13 @@ class FastDuplexCaller:
                 need[s] = True
         fallback[set_g[need]] = True
 
+    def _device_backlogged(self) -> bool:
+        """True when the upload pipeline already holds max_inflight
+        dispatches — this batch should run on the host engine instead."""
+        from ..ops.kernel import device_backlogged
+
+        return device_backlogged(self.max_inflight)
+
     def _ss_consensus(self, codes, quals, vrows, c1, vstarts, nseg, L_max,
                       defer=False):
         """All segs' single-strand consensus: thresholded bases/quals and
@@ -474,6 +487,14 @@ class FastDuplexCaller:
                 dev, _ = self.kernel.dispatch_segments(cm, qm, counts_m)
                 w, q_, d, e = self.kernel.resolve_segments(dev, cm, qm,
                                                            starts_m)
+            elif self._device_backlogged():
+                # device pipe full (feeder depth reached): the host f64
+                # engine absorbs this batch concurrently — throughput is
+                # device + host, not min of the two
+                from ..ops.kernel import HOST_DISPATCH
+
+                w, q_, d, e = self.kernel.resolve_segments(
+                    HOST_DISPATCH, cm, qm, starts_m)
             else:
                 # device: classify + compact hard-column dispatch — only
                 # the hard few percent of observations cross the link

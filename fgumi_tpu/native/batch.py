@@ -11,6 +11,11 @@ fall back to the pure-Python record path when it is False.
 import numpy as np
 
 from . import get_lib
+# operand-for-a-C++-entry-point: same object when already a C-contiguous
+# ndarray of the requested dtype (the common case on the dispatch hot
+# path), one conversion copy otherwise — the shared no-copy rule lives in
+# ops/datapath.as_device_operand
+from ..ops.datapath import as_device_operand as _as_c
 from ..utils import faults
 
 
@@ -259,9 +264,9 @@ def consensus_segments(codes2d: np.ndarray, quals2d: np.ndarray,
     lib = get_lib()
     J = len(starts) - 1
     L = codes2d.shape[1] if codes2d.ndim == 2 else 0
-    codes2d = np.ascontiguousarray(codes2d, np.uint8)
-    quals2d = np.ascontiguousarray(quals2d, np.uint8)
-    starts = np.ascontiguousarray(starts, np.int64)
+    codes2d = _as_c(codes2d, np.uint8)
+    quals2d = _as_c(quals2d, np.uint8)
+    starts = _as_c(starts, np.int64)
     winner = np.empty((J, L), dtype=np.uint8)
     qual = np.empty((J, L), dtype=np.uint8)
     depth = np.empty((J, L), dtype=np.int32)
@@ -303,10 +308,10 @@ def consensus_classify(codes2d: np.ndarray, quals2d: np.ndarray,
     lib = get_lib()
     J = len(starts) - 1
     L = codes2d.shape[1] if codes2d.ndim == 2 else 0
-    codes2d = np.ascontiguousarray(codes2d, np.uint8)
-    quals2d = np.ascontiguousarray(quals2d, np.uint8)
-    starts = np.ascontiguousarray(starts, np.int64)
-    delta_tab = np.ascontiguousarray(delta_tab, np.float64)
+    codes2d = _as_c(codes2d, np.uint8)
+    quals2d = _as_c(quals2d, np.uint8)
+    starts = _as_c(starts, np.int64)
+    delta_tab = _as_c(delta_tab, np.float64)
     winner = np.empty((J, L), dtype=np.uint8)
     qual = np.empty((J, L), dtype=np.uint8)
     depth = np.empty((J, L), dtype=np.int32)
@@ -407,9 +412,9 @@ def segment_depth_errors(codes2d: np.ndarray, winner: np.ndarray,
     J, L = winner.shape
     depth = np.empty((J, L), dtype=np.int32)
     errors = np.empty((J, L), dtype=np.int32)
-    codes2d = np.ascontiguousarray(codes2d, np.uint8)
-    winner = np.ascontiguousarray(winner, np.uint8)
-    starts = np.ascontiguousarray(starts, np.int64)
+    codes2d = _as_c(codes2d, np.uint8)
+    winner = _as_c(winner, np.uint8)
+    starts = _as_c(starts, np.int64)
     lib.fgumi_segment_depth_errors(_addr(codes2d), _addr(winner),
                                    _addr(starts), J, L, _addr(depth),
                                    _addr(errors))
@@ -423,10 +428,10 @@ def segment_depth_errors_ranges(codes2d: np.ndarray, winner: np.ndarray,
     J, L = winner.shape
     depth = np.empty((J, L), dtype=np.int32)
     errors = np.empty((J, L), dtype=np.int32)
-    codes2d = np.ascontiguousarray(codes2d, np.uint8)
-    winner = np.ascontiguousarray(winner, np.uint8)
-    lo = np.ascontiguousarray(lo, np.int64)
-    hi = np.ascontiguousarray(hi, np.int64)
+    codes2d = _as_c(codes2d, np.uint8)
+    winner = _as_c(winner, np.uint8)
+    lo = _as_c(lo, np.int64)
+    hi = _as_c(hi, np.int64)
     lib.fgumi_segment_depth_errors_ranges(
         _addr(codes2d), _addr(winner), _addr(lo), _addr(hi), J, L,
         _addr(depth), _addr(errors))

@@ -39,6 +39,18 @@ def _on_duration(event: str, duration: float, **_kw):
 
         METRICS.inc("device.backend_compiles")
         METRICS.inc("device.backend_compile_s", round(duration, 4))
+        # shape-bucket attribution: the dispatch machinery flags (via a
+        # contextvar that rides the feeder's context copy) dispatches
+        # whose bucketed shape is new this process; a real backend
+        # compile landing inside one is a shape-ladder recompile, which
+        # is what device.shape_bucket.recompiles counts (ops/datapath.py)
+        try:
+            from ..ops.datapath import compile_is_shape_miss
+
+            if compile_is_shape_miss():
+                METRICS.inc("device.shape_bucket.recompiles")
+        except Exception:  # pragma: no cover - attribution is best-effort
+            pass
 
 
 def _on_event(event: str, **_kw):

@@ -13,7 +13,16 @@ these prefixes):
 - ``pipeline.stage.<stage>.busy_s`` / ``.blocked_s`` — run_stages timings
 - ``pipeline.queue.{in,out}.{mean,max}``, ``pipeline.queue.samples``
 - ``device.*`` — DeviceStats snapshot (dispatches, retries, batch_splits,
-  host_fallbacks, bytes_uploaded, bytes_fetched, fetch_wait_s, ...)
+  host_fallbacks, bytes_uploaded, bytes_fetched, fetch_wait_s,
+  upload_overlap_s, feeder_queue_depth, const_uploads/const_hits, ...)
+- ``device.shape_bucket.{hits,misses,recompiles,shapes}`` — bucketed
+  shape-registry lookups (ops/datapath.py): hit = padded shape already
+  seen this process (guaranteed jit-cache hit), miss = first sighting,
+  recompile = a miss whose dispatch triggered a real XLA backend compile
+  (persistent-cache miss too), shapes = distinct-shape gauge
+- ``device.const_cache.{hits,misses,bytes_uploaded}`` — device-resident
+  constant-table cache traffic (quality tables / wire dictionaries are
+  uploaded once per (device, content), not per dispatch)
 - ``io.bytes_read`` / ``io.bytes_written`` — compressed bytes through the
   BGZF reader/writer (and raw bytes for plain streams)
 - ``records.<label>`` — ProgressTracker totals per command label

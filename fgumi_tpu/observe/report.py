@@ -5,8 +5,11 @@ One JSON artifact per command (``--run-report out.json`` /
 command exit — success or failure — so a benchmark harness or CI gate can
 answer "where did the time go, and did the device degrade?" without parsing
 logs: wall time, per-stage busy/blocked seconds, queue occupancy mean/max,
-device dispatches/retries/batch-splits/host-fallbacks, bytes in/out,
-records processed, and exit status.
+device dispatches/retries/batch-splits/host-fallbacks, upload-pipeline
+overlap + constant-cache traffic (``device.upload_overlap_s``,
+``device.const_*``, ``device.shape_bucket.*`` — the data-path counters
+``tools/perf_smoke.py`` gates on), bytes in/out, records processed, and
+exit status.
 
 The schema is versioned (:data:`SCHEMA_VERSION`) and validated structurally
 by :func:`validate_report` — the same function the golden-file test and

@@ -28,6 +28,7 @@ non-unanimous positions, and it keeps f32 magnitudes at ~|C| (tens per matching 
 instead of |ll| (hundreds to thousands), which is what makes f32 viable at depth.
 """
 
+import collections
 import logging
 import threading
 import time
@@ -99,6 +100,7 @@ def _lazy_jit(fn=None, *, static_argnames=()):
     return deco(fn) if fn is not None else deco
 
 from ..constants import MAX_PHRED, MIN_PHRED, N_CODE
+from .datapath import CONST_CACHE, SHAPE_REGISTRY, as_device_operand
 from .tables import QualityTables
 
 def _enable_persistent_compile_cache():
@@ -238,6 +240,14 @@ class DeviceStats:
         self.retries = 0
         self.batch_splits = 0
         self.host_fallbacks = 0
+        # pipelined-upload accounting (docs/device-datapath.md): feeder-fn
+        # seconds that overlapped an earlier dispatch's device compute, the
+        # feeder queue's high-water mark, and constant-cache traffic
+        self.upload_overlap_s = 0.0
+        self.feeder_queue_peak = 0
+        self.const_uploads = 0
+        self.const_hits = 0
+        self.const_upload_bytes = 0
         self.timeline = []  # per-dispatch dicts (capped; --stats report)
         self._t0 = time.monotonic()
 
@@ -253,12 +263,30 @@ class DeviceStats:
         with self._lock:
             self.host_fallbacks += 1
 
+    def add_upload_overlap(self, dt: float):
+        with self._lock:
+            self.upload_overlap_s += dt
+
+    def note_queue_depth(self, depth: int):
+        with self._lock:
+            if depth > self.feeder_queue_peak:
+                self.feeder_queue_peak = depth
+
+    def add_const_upload(self, nbytes: int):
+        with self._lock:
+            self.const_uploads += 1
+            self.const_upload_bytes += int(nbytes)
+
+    def add_const_hit(self):
+        with self._lock:
+            self.const_hits += 1
+
     def add_dispatch(self, flops: int):
         with self._lock:
             self.dispatches += 1
             self.model_flops += int(flops)
 
-    def begin_in_flight(self, upload_bytes: int) -> int:
+    def begin_in_flight(self, upload_bytes: int, pack_s: float = 0.0) -> int:
         """Count a dispatch in flight (host->device submitted, result not
         yet fetched). Returns a timeline slot id for end_in_flight."""
         with self._lock:
@@ -268,8 +296,23 @@ class DeviceStats:
             if slot < 4096:
                 self.timeline.append(
                     {"t_dispatch": round(time.monotonic() - self._t0, 4),
-                     "up_bytes": int(upload_bytes)})
+                     "up_bytes": int(upload_bytes),
+                     "pack_s": round(pack_s, 4)})
             return slot
+
+    def note_upload(self, slot: int, upload_s: float):
+        """Record a dispatch's device_put wall time (feeder thread)."""
+        with self._lock:
+            if 0 <= slot < len(self.timeline):
+                self.timeline[slot]["upload_s"] = round(upload_s, 4)
+
+    def note_exec(self, slot: int):
+        """Stamp upload+enqueue completion: the window from here to fetch
+        start is device compute overlapped with host work."""
+        with self._lock:
+            if 0 <= slot < len(self.timeline):
+                self.timeline[slot]["t_exec"] = round(
+                    time.monotonic() - self._t0, 4)
 
     def end_in_flight(self, slot: int, fetched_bytes: int, wait_s: float):
         with self._lock:
@@ -333,6 +376,14 @@ class DeviceStats:
                 out["batch_splits"] = self.batch_splits
             if self.host_fallbacks:
                 out["host_fallbacks"] = self.host_fallbacks
+            if self.upload_overlap_s:
+                out["upload_overlap_s"] = round(self.upload_overlap_s, 3)
+            if self.feeder_queue_peak:
+                out["feeder_queue_depth"] = self.feeder_queue_peak
+            if self.const_uploads or self.const_hits:
+                out["const_uploads"] = self.const_uploads
+                out["const_hits"] = self.const_hits
+                out["const_upload_bytes"] = self.const_upload_bytes
             return out
 
     def timeline_snapshot(self):
@@ -350,7 +401,8 @@ class DeviceStats:
                 "dispatches", "fetch_wait_s", "bytes_fetched",
                 "bytes_uploaded", "model_flops", "rows_real", "rows_padded",
                 "in_flight", "retries", "batch_splits", "host_fallbacks",
-                "_t0")}
+                "upload_overlap_s", "feeder_queue_peak", "const_uploads",
+                "const_hits", "const_upload_bytes", "_t0")}
             timeline = [dict(t) for t in other.timeline]
         with self._lock:
             for k, v in state.items():
@@ -420,13 +472,16 @@ class DispatchTicket:
     wait() returns the device result handle (or re-raises the feeder
     exception); the fetch itself stays with the caller (resolve worker)."""
 
-    __slots__ = ("_event", "_result", "_exc", "slot")
+    __slots__ = ("_event", "_result", "_exc", "slot", "upload_bytes",
+                 "_released")
 
     def __init__(self):
         self._event = threading.Event()
         self._result = None
         self._exc = None
         self.slot = -1
+        self.upload_bytes = 0
+        self._released = False
 
     def _set(self, result=None, exc=None):
         self._result = result
@@ -441,74 +496,267 @@ class DispatchTicket:
 
 
 class DeviceFeeder:
-    """Single background thread that owns all host->device uploads.
+    """Depth-N upload pipeline on one background thread.
 
     jax.device_put blocks the calling thread for the whole transfer on the
     tunnel-attached device (probe: 16 MB put blocks 0.2-0.9 s, while a jit
     dispatch on device-resident args returns in 0.1 ms), so uploads must not
-    run on the processing thread. The feeder serializes puts+dispatches in
-    submission order on its own thread; device->host fetches run on the
-    resolve workers and DO overlap the feeder's uploads (the link carries
-    both directions concurrently — measured 32 MB bidirectional in the time
-    of 20 MB one-way). This is the Q4->Process double-buffering analog
-    (reference base.rs:1724-1920) at the device boundary.
+    run on the processing thread. The feeder runs puts+dispatches in
+    submission order on its own thread, keeping up to ``depth`` dispatches
+    (default 2, ``FGUMI_TPU_FEEDER_DEPTH``) in flight — submitted to the
+    device but not yet resolved — within a byte budget
+    (``FGUMI_TPU_FEEDER_BYTES``, default 256 MiB of upload payload), so
+    batch k+1's upload overlaps batch k's device compute while queued
+    uploads can never pile unbounded input buffers onto the device.
+    Device->host fetches run on the resolve workers and overlap the
+    feeder's uploads from the other side (the link carries both directions
+    concurrently — measured 32 MB bidirectional in the time of 20 MB
+    one-way), with ``copy_to_host_async`` started the moment a dispatch is
+    enqueued. This is the Q4->Process double-buffering analog (reference
+    base.rs:1724-1920) lifted to the device boundary.
+
+    Resolve sites MUST call :meth:`mark_resolved` (their ``finally``
+    blocks do, next to the in-flight accounting) or the pipeline stalls at
+    ``depth`` outstanding dispatches. Resolution must follow submission
+    order per process — every caller already resolves in order, and
+    ``depth >= 2`` tolerates the split-halving path's nested tickets.
     """
 
     def __init__(self):
-        self._q = []
+        self._q = collections.deque()
         self._cv = threading.Condition()
         self._thread = None
+        self._exit = False
+        self._active = False  # an item is currently executing
+        self._inflight = 0  # dispatched to device, not yet resolved
+        self._inflight_bytes = 0
+        self._depth = None
+        self._byte_budget = None
+        self._async_copy_warned = set()  # leaf types logged once (debug)
+
+    def _config(self):
+        if self._depth is None:
+            import os
+
+            try:
+                # floor 2, not 1: the OOM-recovery path resolves a failed
+                # ticket and then dispatches+resolves its two halves in
+                # order, which needs one slot of headroom past the batch
+                # a deferred-resolve caller may still hold (the class
+                # invariant above: depth >= 2 tolerates nested tickets)
+                depth = max(
+                    int(os.environ.get("FGUMI_TPU_FEEDER_DEPTH", "2")), 2)
+            except ValueError:
+                depth = 2
+            try:
+                budget = max(
+                    int(os.environ.get("FGUMI_TPU_FEEDER_BYTES",
+                                       str(256 << 20))), 1 << 20)
+            except ValueError:
+                budget = 256 << 20
+            # publish the _depth sentinel LAST: concurrent readers gate on
+            # it, so budget must already be visible when they proceed
+            self._byte_budget = budget
+            self._depth = depth
+        return self._depth, self._byte_budget
+
+    @property
+    def depth(self) -> int:
+        """Configured in-flight pipeline depth (>= 2)."""
+        return self._config()[0]
 
     def _ensure_thread(self):
         if self._thread is None or not self._thread.is_alive():
+            self._exit = False
             self._thread = threading.Thread(target=self._loop,
                                             name="fgumi-device-feeder",
                                             daemon=True)
             self._thread.start()
 
-    def submit(self, fn) -> DispatchTicket:
+    def submit(self, fn, upload_bytes: int = 0,
+               slot: int = -1) -> DispatchTicket:
         """Run fn() (puts + jit dispatch) on the feeder thread.
 
         The submitter's context travels with the work item: the feeder is
         one process-wide thread shared by every job, so retry counters,
         dispatch spans, and compile events raised inside fn() must resolve
         the *submitting* job's telemetry scope, not the feeder's empty
-        one."""
+        one. ``upload_bytes`` feeds the byte budget; ``slot`` is the
+        DeviceStats timeline slot (set before submission so the feeder can
+        stamp upload/exec times into it without racing the caller)."""
         import contextvars
 
         ticket = DispatchTicket()
+        ticket.upload_bytes = int(upload_bytes)
+        ticket.slot = slot
         ctx = contextvars.copy_context()
         with self._cv:
             self._ensure_thread()
             self._q.append((fn, ctx, ticket))
-            self._cv.notify()
+            depth_now = len(self._q) + (1 if self._active else 0)
+            self._cv.notify_all()
+        DEVICE_STATS.note_queue_depth(depth_now)
         return ticket
+
+    def mark_resolved(self, ticket: DispatchTicket):
+        """Release a dispatch's in-flight pipeline slot + bytes
+        (idempotent; resolve paths call it in their ``finally``)."""
+        with self._cv:
+            if ticket._released:
+                return
+            ticket._released = True
+            self._inflight -= 1
+            self._inflight_bytes -= ticket.upload_bytes
+            self._cv.notify_all()
+
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._q) + (1 if self._active else 0)
+
+    def drain(self, timeout: float = None) -> bool:
+        """Run the queue dry, then let the feeder thread exit when idle.
+
+        The serve daemon's SIGTERM drain calls this after the scheduler
+        quiesces so the process never leaves a dispatch half-uploaded.
+        Returns True when the queue emptied (and the thread, if any,
+        exited) within ``timeout`` seconds (None = wait indefinitely).
+        The feeder restarts transparently on the next submit() — the
+        worker clears ``_thread`` under the lock when it commits to exit,
+        so a racing submit either lands on the live worker before that
+        point or starts a fresh one."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            self._exit = True
+            self._cv.notify_all()
+            while self._q or self._active:
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    return False
+                self._cv.wait(left if left is not None else 0.5)
+            thread = self._thread
+        if thread is not None and thread.is_alive():
+            left = None if deadline is None else \
+                max(deadline - time.monotonic(), 0.0)
+            thread.join(left)
+            return not thread.is_alive()
+        return True
+
+    def _run_item(self, fn, ticket, overlapped, t0):
+        """Execute one work item inside the submitter's context (so
+        DEVICE_STATS / METRICS resolve the submitting job's scope)."""
+        result = fn()
+        dt = time.monotonic() - t0
+        if overlapped:
+            # this fn ran while an earlier dispatch was still UNRESOLVED —
+            # an upper bound on upload/compute overlap (in deferred-resolve
+            # modes the earlier result may already sit on host), which is
+            # how docs/observability.md defines upload_overlap_s
+            DEVICE_STATS.add_upload_overlap(dt)
+        if ticket.slot >= 0:
+            DEVICE_STATS.note_exec(ticket.slot)
+        # start the device->host copy NOW (non-blocking): by the time the
+        # resolve stage calls device_get, the result bytes are already on
+        # host (or in flight), so the fetch costs a wait-for-arrival
+        # instead of a full round trip. Backends without
+        # copy_to_host_async just fetch at resolve time.
+        try:
+            for leaf in jax.tree_util.tree_leaves(result):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
+        except Exception as e:  # noqa: BLE001 - fetch-time path still works
+            # once per leaf/exception type, at debug: a silently dead
+            # fetch-overlap path regresses e2e latency with zero signal
+            key = type(e).__name__
+            if key not in self._async_copy_warned:
+                self._async_copy_warned.add(key)
+                log.debug("copy_to_host_async failed (%s: %s); results "
+                          "will be fetched synchronously at resolve time",
+                          key, e)
+        return result
 
     def _loop(self):
         while True:
             with self._cv:
+                self._active = False
+                self._cv.notify_all()
                 while not self._q:
+                    if self._exit:
+                        # commit to exit UNDER the lock: a concurrent
+                        # submit() sees _thread is None and starts a fresh
+                        # worker instead of queueing onto a dying one
+                        self._thread = None
+                        return
                     self._cv.wait()
-                fn, ctx, ticket = self._q.pop(0)
+                depth, budget = self._config()
+                # depth/byte gate: hold the NEXT dispatch until an earlier
+                # one resolves. Skipped in drain mode — the queue must run
+                # dry even if no resolver is coming back for stragglers.
+                # Bounded wait: a caller that died without resolving its
+                # ticket (dropped pending chunk on a crashed pipeline)
+                # must degrade to the old unpipelined behavior, never
+                # freeze every later dispatch in the process.
+                ticket = self._q[0][2]
+                deadline = None
+                while (not self._exit and self._q
+                       and (self._inflight >= depth
+                            or (self._inflight > 0
+                                and self._inflight_bytes
+                                + ticket.upload_bytes > budget))):
+                    if deadline is None:
+                        deadline = time.monotonic() + 60.0
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        log.warning(
+                            "device feeder depth gate timed out with %d "
+                            "dispatch(es) unresolved; proceeding (a "
+                            "dispatch ticket was likely dropped without "
+                            "resolution)", self._inflight)
+                        break
+                    self._cv.wait(min(left, 1.0))
+                    ticket = self._q[0][2] if self._q else None
+                if not self._q:
+                    continue
+                fn, ctx, ticket = self._q.popleft()
+                self._inflight += 1
+                self._inflight_bytes += ticket.upload_bytes
+                overlapped = self._inflight > 1
+                self._active = True
+            t0 = time.monotonic()
             try:
-                result = ctx.run(fn)
-                # start the device->host copy NOW (non-blocking): by the
-                # time the resolve stage calls device_get, the result bytes
-                # are already on host (or in flight), so the fetch costs a
-                # wait-for-arrival instead of a full round trip. Backends
-                # without copy_to_host_async just fetch at resolve time.
-                try:
-                    for leaf in jax.tree_util.tree_leaves(result):
-                        if hasattr(leaf, "copy_to_host_async"):
-                            leaf.copy_to_host_async()
-                except Exception:  # noqa: BLE001 - fetch-time path still works
-                    pass
+                result = ctx.run(self._run_item, fn, ticket, overlapped, t0)
                 ticket._set(result=result)
             except BaseException as e:  # noqa: BLE001 - relayed to waiter
                 ticket._set(exc=e)
 
 
 DEVICE_FEEDER = DeviceFeeder()
+
+
+def default_max_inflight() -> int:
+    """Hybrid backlog cap shared by the consensus engines (simplex /
+    duplex / codec): dispatches in flight at or beyond it route to the
+    native f64 host engine instead of queueing behind the link. Explicit
+    ``FGUMI_TPU_MAX_INFLIGHT`` wins (``0`` = always host); the default
+    tracks the feeder's pipeline depth + 1 (``depth`` uploads in flight
+    plus one packed in its queue)."""
+    import os
+
+    env_cap = os.environ.get("FGUMI_TPU_MAX_INFLIGHT", "").strip()
+    if env_cap:
+        try:
+            return int(env_cap)
+        except ValueError:
+            log.warning("FGUMI_TPU_MAX_INFLIGHT=%r is not an integer; "
+                        "using the default", env_cap)
+    return DEVICE_FEEDER.depth + 1
+
+
+def device_backlogged(max_inflight: int) -> bool:
+    """True when the upload pipeline already holds ``max_inflight``
+    dispatches — the one backlog test behind every hybrid engine's
+    route-to-host-engine decision (simplex / duplex / codec)."""
+    return DEVICE_STATS.in_flight_count() >= max_inflight
 
 
 # ---------------------------------------------------------------------------
@@ -573,6 +821,9 @@ def device_retry_call(fn, what: str = "dispatch"):
             if _is_oom(e) or not _is_transient(e) or attempt >= retries:
                 raise
             DEVICE_STATS.add_retry()
+            # the device runtime may have restarted under us; resident
+            # constants died with it, so the retry re-uploads fresh
+            CONST_CACHE.invalidate()
             log.warning("device %s failed (%s: %s); retry %d/%d in %.2fs",
                         what, type(e).__name__, e, attempt + 1, retries,
                         delay)
@@ -990,33 +1241,28 @@ def _consensus_batch_packed_jit(codes, quals, correct_tab, err_tab,
 
 
 def _pad_rows(n: int) -> int:
-    """Row-count bucket: next multiple of a pow2 fraction of n's octave.
+    """Row-count bucket: smallest shape-registry ladder value >= n.
 
-    pow2 rounding wastes up to 2x kernel time (and, worse here, up to 2x
-    *upload bytes* on a ~17 MB/s link) on the padded rows. Buckets refine
-    with size — quarter-octave below 8k rows, eighth-octave to 64k,
-    sixteenth-octave above. Waste is bounded by ONE bucket (a pow2 fraction
-    of the octave TOP), so the worst case sits at the octave bottom:
-    41%/25%/12.5% respectively, falling to half that at the octave top and
-    ~2x less in expectation (measured 2.4% on the bench workload). Big
-    dispatches are where padding costs real transfer seconds while the XLA
-    shape vocabulary stays small (the persistent compile cache absorbs the
-    variants across processes; VERDICT r4 item 5).
+    The geometric ladder (ops/datapath.py, default x1.0625 steps aligned
+    to 16, configurable via --shape-buckets / FGUMI_TPU_SHAPE_BUCKETS)
+    replaces the old per-octave pow2-fraction scheme: waste is bounded by
+    one ladder step (<= 6.25% worst case, ~3% expected, vs 41%/25%/12.5%
+    at the old octave bottoms), the vocabulary of XLA row shapes is fixed
+    per process AND per fleet — every run quantizes to the same ladder,
+    so the persistent compile cache hits across runs instead of each
+    run's batch sizes minting private shapes (VERDICT r4 item 5,
+    BENCH_r05 padding_waste 7-10%).
     """
-    if n <= 16:
-        return 16
-    shift = 2 if n <= 8192 else (3 if n <= 65536 else 4)
-    m = 1 << max((n - 1).bit_length() - shift, 0)
-    return -(-n // m) * m
+    return SHAPE_REGISTRY.bucket_rows(n)
 
 
 def _pad_out_segments(j: int, f_pad: int) -> int:
     """Fetch-slice bucket for the real segment count: multiple of f_pad/8.
 
-    segment_sum still runs over the pow2 f_pad, but only the first
-    j-rounded-up segments cross the link — the pow2 tail was up to half the
-    fetched bytes (VERDICT r4 items 4/5). <=8 slice shapes per pow2 keeps
-    the jit vocabulary bounded."""
+    segment_sum still runs over the bucketed f_pad, but only the first
+    j-rounded-up segments cross the link — the padded tail was up to half
+    the fetched bytes (VERDICT r4 items 4/5). <=8 slice shapes per f_pad
+    keeps the jit vocabulary bounded."""
     m = max(f_pad // 8, 1)
     return min(-(-j // m) * m, f_pad)
 
@@ -1026,19 +1272,20 @@ def pad_segments(codes2d: np.ndarray, quals2d: np.ndarray,
     """Bucket-pad a dense (N, L) row layout for device_call_segments.
 
     Returns (codes_dev, quals_dev, seg_ids, starts, num_segments): rows pad
-    to the next quarter-octave bucket (_pad_rows) with all-N no-op rows
-    carrying the LAST real segment's id (keeps seg_ids sorted without
+    to the next shape-registry ladder bucket (_pad_rows) with all-N no-op
+    rows carrying the LAST real segment's id (keeps seg_ids sorted without
     growing num_segments — kernel pad invariant), and num_segments pads to
-    pow2 so the XLA shape vocabulary stays tiny under the persistent compile
-    cache. Shared by the fast simplex engine and the classic callers
-    (VERDICT r2: one copy of this subtle pad logic).
+    the registry's segment ladder so the XLA shape vocabulary stays tiny
+    under the persistent compile cache. Shared by the fast simplex engine
+    and the classic callers (VERDICT r2: one copy of this subtle pad
+    logic).
     """
     counts = np.asarray(counts, dtype=np.int64)
     starts = np.concatenate(([0], np.cumsum(counts)))
     N = int(starts[-1])
     J = len(counts)
     N_pad = _pad_rows(N)
-    F_pad = 1 << (J - 1).bit_length() if J > 1 else 1
+    F_pad = SHAPE_REGISTRY.bucket_segments(J)
     seg_ids = np.repeat(np.arange(J, dtype=np.int32), counts)
     DEVICE_STATS.add_pad(N, N_pad)
     if N_pad != N:
@@ -1068,7 +1315,7 @@ def pad_segments_gather(codes: np.ndarray, quals: np.ndarray,
     N = int(starts[-1])
     J = len(counts)
     N_pad = _pad_rows(N)
-    F_pad = 1 << (J - 1).bit_length() if J > 1 else 1
+    F_pad = SHAPE_REGISTRY.bucket_segments(J)
     DEVICE_STATS.add_pad(N, N_pad)
     codes_dev = np.full((N_pad, L_max), N_CODE, dtype=np.uint8)
     quals_dev = np.zeros((N_pad, L_max), dtype=np.uint8)
@@ -1156,11 +1403,21 @@ class ConsensusKernel:
             self._host_engine = HostConsensusEngine(self.tables)
         return self._host_engine
 
+    def _tables_dev(self):
+        """Device-resident quality tables via the process-wide constant
+        cache: uploaded once per (device, content), reused by every later
+        dispatch of any kernel instance with the same error rates. Callers
+        run inside dispatch closures, after jax init."""
+        return (CONST_CACHE.put("correct_tab", self._correct_f32),
+                CONST_CACHE.put("err_tab", self._err_f32))
+
     def device_call(self, codes, quals):
         """Raw device outputs (winner, qual, depth, errors, suspect) as jax arrays."""
-        return _consensus_batch_jit(
-            np.asarray(codes), np.asarray(quals), self._correct_f32, self._err_f32, self._pre
-        )
+        codes = as_device_operand(codes)
+        quals = as_device_operand(quals)
+        _ensure_jax()
+        ct, et = self._tables_dev()
+        return _consensus_batch_jit(codes, quals, ct, et, self._pre)
 
     def device_call_packed(self, codes, quals):
         """One (F, L) uint16 device output (see _consensus_batch_packed_jit).
@@ -1170,9 +1427,18 @@ class ConsensusKernel:
         """
         F, R, L = codes.shape
         DEVICE_STATS.add_dispatch(segments_flops(F * R, L, F))
-        return device_retry_call(lambda: _consensus_batch_packed_jit(
-            np.asarray(codes), np.asarray(quals), self._correct_f32,
-            self._err_f32, self._pre), "batch dispatch")
+        codes = as_device_operand(codes)
+        quals = as_device_operand(quals)
+        new = SHAPE_REGISTRY.observe("batch", F, R, L)
+
+        def _dispatch():
+            _ensure_jax()
+            ct, et = self._tables_dev()
+            return _consensus_batch_packed_jit(codes, quals, ct, et,
+                                               self._pre)
+
+        with SHAPE_REGISTRY.attribute_compiles(new):
+            return device_retry_call(_dispatch, "batch dispatch")
 
     @staticmethod
     def _host_counts(codes: np.ndarray, winner: np.ndarray):
@@ -1261,10 +1527,20 @@ class ConsensusKernel:
             return HOST_DISPATCH
         DEVICE_STATS.add_dispatch(segments_flops(
             codes2d.shape[0], codes2d.shape[1], num_segments))
-        return device_retry_call(lambda: _consensus_segments_packed_jit(
-            np.asarray(codes2d), np.asarray(quals2d), np.asarray(seg_ids),
-            self._correct_f32, self._err_f32, self._pre, num_segments),
-            "segment dispatch")
+        codes2d = as_device_operand(codes2d)
+        quals2d = as_device_operand(quals2d)
+        seg_ids = as_device_operand(seg_ids)
+        new = SHAPE_REGISTRY.observe("seg", codes2d.shape[0],
+                                     codes2d.shape[1], num_segments)
+
+        def _dispatch():
+            _ensure_jax()
+            ct, et = self._tables_dev()
+            return _consensus_segments_packed_jit(
+                codes2d, quals2d, seg_ids, ct, et, self._pre, num_segments)
+
+        with SHAPE_REGISTRY.attribute_compiles(new):
+            return device_retry_call(_dispatch, "segment dispatch")
 
     def dispatch_segments(self, codes2d, quals2d, counts):
         """Pad + dispatch ragged segments, or skip both in host mode.
@@ -1283,47 +1559,70 @@ class ConsensusKernel:
                                           F_pad), starts)
 
     def device_call_segments_wire(self, codes2d_padded, quals2d_padded,
-                                  seg_ids, num_segments: int, J: int):
-        """Async wire-format dispatch via the feeder thread.
+                                  seg_ids, num_segments: int, J: int,
+                                  pack_t0: float = None):
+        """Async wire-format dispatch via the feeder pipeline.
 
         codes2d_padded/quals2d_padded: the full padded (N_pad, L) row layout
         (L % 4 == 0). Builds the 1-byte wire (or the 1.25 B/position
         packed-codes fallback when the batch has >63 distinct quals),
         submits the upload + jit dispatch
         to the feeder thread, and returns a DispatchTicket immediately —
-        the processing thread never blocks on the link. Resolve with
+        the processing thread never blocks on the link, and with feeder
+        depth >= 2 this batch's upload overlaps the previous batch's
+        device compute. The wire dictionary rides the constant cache (a
+        stable sequencer qual set re-uploads nothing). ``pack_t0``: when
+        the caller timed its own gather/pad start, the timeline's pack_s
+        covers it too. Resolve with
         resolve_segments_wire(ticket, dense_codes, dense_quals, starts)."""
+        t_pack0 = pack_t0 if pack_t0 is not None else time.monotonic()
         out_segments = _pad_out_segments(J, num_segments)
         w = build_wire(codes2d_padded, quals2d_padded, self._delta94)
         pre = self._pre
+        tables_dev = self._tables_dev
         if w is not None:
             wire, dict32 = w
             upload = wire.nbytes + seg_ids.nbytes
+            new = SHAPE_REGISTRY.observe(
+                "segw", wire.shape[0], wire.shape[1], num_segments,
+                out_segments)
 
-            def _dispatch():
+            def _dispatch(slot):
                 _ensure_jax()
+                t0 = time.monotonic()
                 wd = jax.device_put(wire)
                 sd = jax.device_put(seg_ids)
+                dtab = CONST_CACHE.put("dict_tab", dict32)
+                DEVICE_STATS.note_upload(slot, time.monotonic() - t0)
                 return _consensus_segments_wire_jit(
-                    wd, sd, dict32, pre, num_segments, out_segments)
+                    wd, sd, dtab, pre, num_segments, out_segments)
         else:
-            correct, err = self._correct_f32, self._err_f32
             cp, qsent = pack_codes2(codes2d_padded, quals2d_padded)
             upload = cp.nbytes + qsent.nbytes + seg_ids.nbytes
+            new = SHAPE_REGISTRY.observe(
+                "segp2", cp.shape[0], cp.shape[1], num_segments,
+                out_segments)
 
-            def _dispatch():
+            def _dispatch(slot):
                 _ensure_jax()
+                t0 = time.monotonic()
                 cd = jax.device_put(cp)
                 qd = jax.device_put(qsent)
                 sd = jax.device_put(seg_ids)
+                ct, et = tables_dev()
+                DEVICE_STATS.note_upload(slot, time.monotonic() - t0)
                 return _consensus_segments_packed2_jit(
-                    cd, qd, sd, correct, err, pre, num_segments,
+                    cd, qd, sd, ct, et, pre, num_segments,
                     out_segments)
         DEVICE_STATS.add_dispatch(segments_flops(
             codes2d_padded.shape[0], codes2d_padded.shape[1], num_segments))
-        ticket = DEVICE_FEEDER.submit(
-            lambda: device_retry_call(_dispatch, "wire dispatch"))
-        ticket.slot = DEVICE_STATS.begin_in_flight(upload)
+        slot = DEVICE_STATS.begin_in_flight(
+            upload, pack_s=time.monotonic() - t_pack0)
+        with SHAPE_REGISTRY.attribute_compiles(new):
+            ticket = DEVICE_FEEDER.submit(
+                lambda: device_retry_call(lambda: _dispatch(slot),
+                                          "wire dispatch"),
+                upload_bytes=upload, slot=slot)
         return ticket
 
     def resolve_segments_wire(self, ticket, codes2d: np.ndarray,
@@ -1349,9 +1648,12 @@ class ConsensusKernel:
         finally:
             # decrement even when the feeder/fetch raised — a leaked
             # in-flight count would silently route every later hybrid batch
-            # to the host engine while the run still claims platform=tpu
+            # to the host engine while the run still claims platform=tpu,
+            # and a leaked feeder slot would stall the upload pipeline at
+            # depth outstanding dispatches
             DEVICE_STATS.end_in_flight(ticket.slot, fetched,
                                        time.monotonic() - t0)
+            DEVICE_FEEDER.mark_resolved(ticket)
         if failure is not None:
             # only device weather is recoverable; KeyboardInterrupt /
             # SystemExit and INVALID_ARGUMENT-class programming errors
@@ -1482,6 +1784,7 @@ class ConsensusKernel:
         device work at all when every column was easy)."""
         from ..native import batch as nb
 
+        t_pack0 = time.monotonic()  # classify + wire build == pack time
         host = self._host()
         if host._tab1 is None:
             host._build_tables()
@@ -1502,46 +1805,60 @@ class ConsensusKernel:
             return ("cols_done", easy)
         M = len(hc)
         N_pad = _pad_rows(M)
-        C_pad = max(4, 1 << (C - 1).bit_length() if C > 1 else 1)
-        m_out = max(C_pad // 8, 4)
-        C_out = -(-C // m_out) * m_out
+        C_pad = max(8, SHAPE_REGISTRY.bucket_segments(C))
+        # fetch-slice step: a multiple of 4 (the 2-bit winner packs 4
+        # columns per byte) that divides the fetch into <= ~8 slice shapes
+        m_out = max(4 * (C_pad // 32), 4)
+        C_out = min(-(-C // m_out) * m_out, C_pad)
         depths_dev = np.zeros(C_pad, dtype=np.int32)
         depths_dev[:C] = hard_depth
         depths_dev[C_pad - 1] += N_pad - M  # pad obs fold into the last id
         DEVICE_STATS.add_dispatch(M * 16 + C_pad * 40)
         DEVICE_STATS.add_pad(M, N_pad)
         pre = self._pre
+        tables_dev = self._tables_dev
         w = build_wire(hc.reshape(1, -1), hq.reshape(1, -1), self._delta94)
         if w is not None:
             wire, dict64 = w
             wire_pad = np.full(N_pad, WIRE_INVALID, dtype=np.uint8)
             wire_pad[:M] = wire.ravel()
             upload = wire_pad.nbytes + depths_dev.nbytes
+            new = SHAPE_REGISTRY.observe("colsw", N_pad, C_pad, C_out)
 
-            def _dispatch():
+            def _dispatch(slot):
                 _ensure_jax()
+                t0 = time.monotonic()
                 wd = jax.device_put(wire_pad)
                 dd = jax.device_put(depths_dev)
-                return _consensus_columns_wire_jit(wd, dd, dict64, pre,
+                dtab = CONST_CACHE.put("dict_tab", dict64)
+                DEVICE_STATS.note_upload(slot, time.monotonic() - t0)
+                return _consensus_columns_wire_jit(wd, dd, dtab, pre,
                                                    C_pad, C_out)
         else:
-            correct, err = self._correct_f32, self._err_f32
             codes_pad = np.full(N_pad, N_CODE, dtype=np.uint8)
             codes_pad[:M] = hc
             quals_pad = np.zeros(N_pad, dtype=np.uint8)
             quals_pad[:M] = hq
             upload = codes_pad.nbytes + quals_pad.nbytes + depths_dev.nbytes
+            new = SHAPE_REGISTRY.observe("colsr", N_pad, C_pad, C_out)
 
-            def _dispatch():
+            def _dispatch(slot):
                 _ensure_jax()
+                t0 = time.monotonic()
                 cd = jax.device_put(codes_pad)
                 qd = jax.device_put(quals_pad)
                 dd = jax.device_put(depths_dev)
-                return _consensus_columns_raw_jit(cd, qd, dd, correct, err,
+                ct, et = tables_dev()
+                DEVICE_STATS.note_upload(slot, time.monotonic() - t0)
+                return _consensus_columns_raw_jit(cd, qd, dd, ct, et,
                                                   pre, C_pad, C_out)
-        ticket = DEVICE_FEEDER.submit(
-            lambda: device_retry_call(_dispatch, "hard-column dispatch"))
-        ticket.slot = DEVICE_STATS.begin_in_flight(upload)
+        slot = DEVICE_STATS.begin_in_flight(
+            upload, pack_s=time.monotonic() - t_pack0)
+        with SHAPE_REGISTRY.attribute_compiles(new):
+            ticket = DEVICE_FEEDER.submit(
+                lambda: device_retry_call(lambda: _dispatch(slot),
+                                          "hard-column dispatch"),
+                upload_bytes=upload, slot=slot)
         return ("cols_dev", easy, hard_idx, hard_depth, hard_counts, hc, hq,
                 ticket)
 
@@ -1568,6 +1885,7 @@ class ConsensusKernel:
         finally:
             DEVICE_STATS.end_in_flight(ticket.slot, fetched,
                                        time.monotonic() - t0)
+            DEVICE_FEEDER.mark_resolved(ticket)
         if failure is not None:
             if not (_is_oom(failure) or _is_transient(failure)):
                 raise failure
@@ -1652,8 +1970,10 @@ class ConsensusKernel:
         """Dispatch (dp, N, L) rows, one contiguous family shard per device."""
         dp, N, L = codes3d.shape
         DEVICE_STATS.add_dispatch(segments_flops(dp * N, L, dp * num_segments))
+        SHAPE_REGISTRY.observe("shard", dp, N, L, num_segments)
         return _consensus_segments_sharded_jit(
-            np.asarray(codes3d), np.asarray(quals3d), np.asarray(seg_ids2d),
+            as_device_operand(codes3d), as_device_operand(quals3d),
+            as_device_operand(seg_ids2d),
             self._correct_f32, self._err_f32, self._pre, num_segments, mesh)
 
     def device_call_segments_dp_sp(self, codes4, quals4, seg3,
@@ -1663,8 +1983,10 @@ class ConsensusKernel:
         dp, sp, N, L = codes4.shape
         DEVICE_STATS.add_dispatch(segments_flops(dp * sp * N, L,
                                                  dp * num_segments))
+        SHAPE_REGISTRY.observe("shard_sp", dp, sp, N, L, num_segments)
         return _consensus_segments_dp_sp_jit(
-            np.asarray(codes4), np.asarray(quals4), np.asarray(seg3),
+            as_device_operand(codes4), as_device_operand(quals4),
+            as_device_operand(seg3),
             self._correct_f32, self._err_f32, self._pre, num_segments, mesh)
 
     def resolve_segments(self, dev, codes2d: np.ndarray, quals2d: np.ndarray,

@@ -36,6 +36,20 @@ from .scheduler import Scheduler
 log = logging.getLogger("fgumi_tpu")
 
 
+def _drain_device_feeder(timeout: float = 30.0):
+    """Run the device upload pipeline dry before the process exits.
+
+    Looked up via sys.modules so a daemon that never dispatched to the
+    device doesn't pay the kernel (and jax) import at shutdown."""
+    import sys
+
+    kern = sys.modules.get("fgumi_tpu.ops.kernel")
+    if kern is None:
+        return
+    if not kern.DEVICE_FEEDER.drain(timeout=timeout):
+        log.warning("device feeder did not drain within %.0fs", timeout)
+
+
 class SocketBusy(RuntimeError):
     """Another live daemon already serves this socket path."""
 
@@ -298,6 +312,7 @@ class JobService:
             pass
         self.scheduler.drain()
         self.scheduler.join()
+        _drain_device_feeder()
 
     def close(self):
         """Tear the listener down and remove the socket file (idempotent)."""

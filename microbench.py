@@ -7,8 +7,9 @@ BENCH_r{N}.json files are comparable across rounds (an engine win that
 regresses a primitive shows up here even when the macro number moves the
 other way — exactly what round 3 lacked).
 
-Covers: consensus kernel (two shapes), native record decode/tag-scan/pack,
-sort key extraction, BGZF codec, and the UMI assigners at 4k/16k.
+Covers: consensus kernel (two shapes), dispatch-prep/shape-bucket data-path
+primitives, native record decode/tag-scan/pack, sort key extraction, BGZF
+codec, and the UMI assigners at 4k/16k.
 
 Run directly (`python microbench.py`) or via bench.py (micro section).
 """
@@ -83,6 +84,43 @@ def _family_pileup(rng, n_fam, fam, L):
     codes = codes.reshape(n_fam * fam, L)
     quals = rng.integers(25, 41, size=codes.shape, dtype=np.uint8)
     return codes, quals
+
+
+def bench_datapath(out):
+    """Dispatch-prep regression bench: operand preparation must be a no-op
+    for the common already-contiguous case (the old unconditional
+    np.asarray/np.ascontiguousarray habit was free only by accident), and
+    the shape-bucket lookup must stay in the nanoseconds.
+
+    dispatch_prep_contig_s: 1000 preps of an already-dense 32 MB operand —
+    regression-fails visibly (1000x jump) if someone reintroduces a copy.
+    dispatch_prep_copy_s: one genuinely strided operand, the legitimate
+    copy cost for scale. shape_bucket_lookup_s: 100k ladder lookups."""
+    import numpy as np
+
+    from fgumi_tpu.ops.datapath import SHAPE_REGISTRY, as_device_operand
+
+    big = np.zeros((262144, 128), dtype=np.uint8)  # 32 MB, C-contiguous
+
+    def prep_contig():
+        for _ in range(1000):
+            a = as_device_operand(big)
+            assert a is big  # the no-copy contract this bench guards
+
+    out["dispatch_prep_contig_s"] = round(_timeit(prep_contig), 5)
+
+    strided = big[:, ::2]  # forces one real copy
+
+    def prep_copy():
+        assert as_device_operand(strided) is not strided
+
+    out["dispatch_prep_copy_s"] = round(_timeit(prep_copy), 5)
+
+    def lookups():
+        for n in range(1, 100001):
+            SHAPE_REGISTRY.bucket_rows(n)
+
+    out["shape_bucket_lookup_s"] = round(_timeit(lookups), 4)
 
 
 def bench_host_engine(out):
@@ -221,6 +259,7 @@ def main():
         simulate_grouped_bam(bam, num_families=20000, family_size=5,
                              read_length=100, seed=17)
         for section in (bench_kernel,
+                        bench_datapath,
                         bench_host_engine,
                         lambda o: bench_native_batch(o, bam),
                         lambda o: bench_sort_keys(o, bam),

@@ -241,6 +241,50 @@ def test_device_dispatch_oom_splits_batch(device_parity_runs):
     _assert_same_bam(d0 / "out.bam", d1 / "out.bam")
 
 
+@pytest.fixture(scope="module")
+def deep_grouped_bam(tmp_path_factory):
+    """A larger grouped BAM so the threaded wire path keeps the upload
+    pipeline occupied (multiple dispatches in flight at depth 2)."""
+    path = str(tmp_path_factory.mktemp("chaos_deep") / "sim.bam")
+    rc = cli_main(["simulate", "grouped-reads", "-o", path,
+                   "--num-families", "300",
+                   "--family-size-distribution", "longtail",
+                   "--read-length", "60", "--error-rate", "0.02",
+                   "--seed", "13"])
+    assert rc == 0
+    return path
+
+
+@pytest.mark.parametrize("fault,marker", [
+    ("device.dispatch:raise:1.0:2", "retry"),
+    ("device.dispatch:oom:1.0:1", "halving"),
+    ("device.dispatch:raise:1.0", "host engine"),
+])
+def test_pipelined_dispatch_faults_byte_identical(deep_grouped_bam,
+                                                  tmp_path, fault, marker):
+    """Depth-2 upload pipeline (FGUMI_TPU_FEEDER_DEPTH=2, wire path,
+    threaded resolve): injected device.dispatch faults still retry / halve
+    / fall back per dispatch, and the output never reorders or drops a
+    batch — byte-identical to the clean run."""
+    env = {"FGUMI_TPU_HOST_ENGINE": "0", "FGUMI_TPU_HYBRID": "0",
+           "FGUMI_TPU_FEEDER_DEPTH": "2",
+           "FGUMI_TPU_DEVICE_BACKOFF_S": "0.01"}
+    clean = tmp_path / "clean"
+    clean.mkdir()
+    p = _run_cli(["simplex", "-i", deep_grouped_bam,
+                  "-o", str(clean / "out.bam"), "--min-reads", "1",
+                  "--threads", "4"], env)
+    assert p.returncode == 0, p.stderr
+    faulty = tmp_path / "faulty"
+    faulty.mkdir()
+    p = _run_cli(["simplex", "-i", deep_grouped_bam,
+                  "-o", str(faulty / "out.bam"), "--min-reads", "1",
+                  "--threads", "4"], {**env, "FGUMI_TPU_FAULT": fault})
+    assert p.returncode == 0, p.stderr
+    assert marker in p.stderr  # the targeted degradation path engaged
+    _assert_same_bam(clean / "out.bam", faulty / "out.bam")
+
+
 def _assert_same_bam(path_a, path_b):
     """Byte-identical records + header (modulo the @PG CL argv line, which
     legitimately embeds each run's own -o path)."""

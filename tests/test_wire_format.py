@@ -149,19 +149,19 @@ def test_unpack_result_split_roundtrip():
 
 
 def test_pad_rows_buckets():
-    # monotonic, >= n, and waste within the documented caps per regime
+    # monotonic, >= n, 16-aligned, and waste within one geometric ladder
+    # step (ops/datapath.py ShapeBucketRegistry; default growth 1.0625)
+    from fgumi_tpu.ops.datapath import DEFAULT_GROWTH
+
     prev = 0
     for n in [1, 16, 17, 100, 8192, 8193, 20000, 65536, 65537, 100000,
               300000, 441242]:
         p = _pad_rows(n)
         assert p >= n
         assert p >= prev
+        assert p % 16 == 0
         prev = p
-        # waste bounded by one bucket, which is a pow2 fraction of the octave
-        if n > 16:
-            shift = 2 if n <= 8192 else (3 if n <= 65536 else 4)
-            m = 1 << max((n - 1).bit_length() - shift, 0)
-            assert p - n < m
+        assert p - n <= (DEFAULT_GROWTH - 1.0) * n + 16
 
 
 def test_pad_out_segments():

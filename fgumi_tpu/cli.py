@@ -367,7 +367,7 @@ def _add_simplex(sub):
     p.set_defaults(func=cmd_simplex)
 
 
-def cmd_simplex(args):
+def cmd_simplex(args, source=None, sink=None):
     from .consensus.vanilla import VanillaConsensusCaller, VanillaOptions
     from .core.grouper import consensus_pregroup_keep, iter_mi_group_batches
     from .io.bam import BamHeader, BamReader, BamWriter
@@ -417,6 +417,9 @@ def cmd_simplex(args):
     from .native import batch as nb
 
     use_fast = nb.available() and not args.classic
+    if source is not None and not use_fast:
+        log.error("simplex: fused chain requires the native batch engine")
+        return 2
     oc_caller = None
     if args.consensus_call_overlapping_bases:
         from .consensus.overlapping import OverlappingBasesConsensusCaller
@@ -442,7 +445,8 @@ def cmd_simplex(args):
         queue_items = int(max(1, min(8, budget // (6 * args.batch_bytes))))
         stats = StageTimes()
         mesh = _build_dp_mesh(getattr(args, "devices", "auto"))
-        with BamBatchReader(args.input, target_bytes=args.batch_bytes) as reader:
+        with (BamBatchReader(args.input, target_bytes=args.batch_bytes)
+              if source is None else source) as reader:
             caller = VanillaConsensusCaller(
                 args.read_name_prefix, args.read_group_id, opts,
                 reference=reference, ref_names=reader.header.ref_names,
@@ -463,7 +467,8 @@ def cmd_simplex(args):
                     rejects.drain(caller)
                     return out
 
-                with BamWriter(args.output, out_header) as writer:
+                with (BamWriter(args.output, out_header) if sink is None
+                      else sink(out_header)) as writer:
                     # device fetch + thresholds + serialize run as the
                     # parallel resolve stage (threads >= 4: a worker pool
                     # with ordered output; 2-3: on the writer thread), so
@@ -1062,7 +1067,7 @@ def _add_group(sub):
     p.set_defaults(func=cmd_group)
 
 
-def cmd_group(args):
+def cmd_group(args, source=None, sink=None):
     from .commands.group import run_group
     from .io.bam import BamHeader, BamReader, BamWriter
 
@@ -1075,8 +1080,13 @@ def cmd_group(args):
 
         set_index_threshold(args.index_threshold)
     use_fast = nbat.available() and not getattr(args, "classic", False)
+    if source is not None and not use_fast:
+        log.error("group: fused chain requires the native batch engine")
+        return 2
     t0 = time.monotonic()
-    if use_fast:
+    if source is not None:
+        reader = source
+    elif use_fast:
         from .io.batch_reader import BamBatchReader
 
         reader = BamBatchReader(args.input)
@@ -1097,8 +1107,14 @@ def cmd_group(args):
                 return 2
         out_header = BamHeader(text=hdr_text, ref_names=reader.header.ref_names,
                                ref_lengths=reader.header.ref_lengths)
-        with BamWriter(args.output, out_header) as writer:
-            try:
+        # the ValueError catch wraps the writer context (not the other way
+        # around) so a mid-run failure exits through writer.__exit__ with
+        # the exception in hand: the output is discarded/aborted, never
+        # committed — in the fused chain a clean close here would hand the
+        # downstream stage a valid-looking EOF of a truncated stream
+        try:
+            with (BamWriter(args.output, out_header) if sink is None
+                  else sink(out_header)) as writer:
                 if use_fast:
                     from .commands.fast_group import FastGrouper
                     from .umi.assigners import make_assigner
@@ -1149,9 +1165,9 @@ def cmd_group(args):
                         min_umi_length=args.min_umi_length,
                         no_umi=args.no_umi,
                         allow_unmapped=args.allow_unmapped)
-            except ValueError as e:
-                log.error("%s", e)
-                return 2
+        except ValueError as e:
+            log.error("%s", e)
+            return 2
     dt = time.monotonic() - t0
     log.info("group: wrote %d records in %.2fs; filter=%s", result["records_out"],
              dt, result["filter"])
@@ -1266,7 +1282,7 @@ def _rewrite_hd(text, so, go, ss):
     return "\n".join([hd] + rest) + "\n"
 
 
-def cmd_sort(args):
+def cmd_sort(args, source=None, sink=None):
     from .io.bam import FLAG_UNMAPPED, BamHeader, BamReader, BamWriter, RawRecord
     from .sort.external import header_tags_for_order
     from .sort.keys import make_key_bytes_fn
@@ -1320,6 +1336,8 @@ def cmd_sort(args):
     except ValueError as e:
         log.error("%s", e)
         return 2
+    if source is not None:
+        return _cmd_sort_chain(args, source, sink, budget)
     t0 = time.monotonic()
     with BamReader(args.input) as reader:
         key_fn = make_key_bytes_fn(args.order, reader.header, args.subsort)
@@ -1422,6 +1440,55 @@ def cmd_sort(args):
             wprogress.finish()
         if bai is not None:
             bai.write(args.output + "." + args.index_format)
+    dt = time.monotonic() - t0
+    log.info("sort: %d records (%s, budget %dMB) in %.2fs (%.0f rec/s)",
+             sorter.n_records, args.order, budget >> 20, dt,
+             sorter.n_records / dt if dt else 0)
+    return 0
+
+
+def _cmd_sort_chain(args, source, sink, budget):
+    """Channel-fed sort stage for the fused pipeline: ingest RecordBatches
+    from `source` as the upstream stage produces them (Phase-1 spill
+    workers overlap the producer), k-way merge, stream sorted wire chunks
+    into `sink`. Native engine only — the fused chain is gated on native
+    availability, so the pure-Python fallback never lands here."""
+    from .io.bam import BamHeader
+    from .sort.external import (NativeExternalSorter, create_sorter,
+                                header_tags_for_order)
+    from .sort.keys import make_batch_keys_fn, make_key_bytes_fn
+    from .utils.progress import ProgressTracker
+
+    t0 = time.monotonic()
+    with source:
+        in_header = source.header
+        batch_keys_fn = make_batch_keys_fn(args.order, in_header,
+                                           args.subsort)
+        key_fn = make_key_bytes_fn(args.order, in_header, args.subsort)
+        if batch_keys_fn is None:
+            log.error("sort: fused chain requires the native batch engine")
+            return 2
+        so, go, ss = header_tags_for_order(args.order, args.subsort)
+        out_header = BamHeader(
+            text=_rewrite_hd(in_header.text, so, go, ss),
+            ref_names=in_header.ref_names,
+            ref_lengths=in_header.ref_lengths)
+        progress = ProgressTracker("sort")
+        spill_workers = max(getattr(args, "threads", 0) - 1, 0)
+        with create_sorter(key_fn, max_bytes=budget, tmp_dir=args.tmp_dir,
+                           max_records=args.max_records_in_ram,
+                           spill_workers=spill_workers) as sorter:
+            if not isinstance(sorter, NativeExternalSorter):
+                log.error("sort: fused chain requires the native sorter")
+                return 2
+            sorter.ingest_batches(iter(source), batch_keys_fn, progress.add)
+            progress.finish()
+            wprogress = ProgressTracker("sort-write")
+            with sink(out_header) as writer:
+                for arr in sorter.iter_sorted_wire():
+                    writer.write_serialized(arr)
+                    wprogress.add()
+            wprogress.finish()
     dt = time.monotonic() - t0
     log.info("sort: %d records (%s, budget %dMB) in %.2fs (%.0f rec/s)",
              sorter.n_records, args.order, budget >> 20, dt,
@@ -1683,7 +1750,7 @@ def _add_extract(sub):
     p.set_defaults(func=cmd_extract)
 
 
-def cmd_extract(args):
+def cmd_extract(args, sink=None):
     from .commands.extract import ExtractError, ExtractOptions, run_extract
 
     opts = ExtractOptions(
@@ -1703,7 +1770,8 @@ def cmd_extract(args):
         comments=args.comment, command_line=_cmdline())
     t0 = time.monotonic()
     try:
-        n_records, n_sets = run_extract(args.input, args.output, opts)
+        n_records, n_sets = run_extract(args.input, args.output, opts,
+                                        sink=sink)
     except (ValueError, OSError) as e:  # ExtractError, ReadStructureError, bad I/O
         log.error("%s", e)
         return 2
@@ -1954,7 +2022,7 @@ def _add_filter(sub):
     p.set_defaults(func=cmd_filter)
 
 
-def cmd_filter(args):
+def cmd_filter(args, source=None):
     from .commands.filter import run_filter
     from .consensus.filter import FilterConfig
     from .io.bam import BamReader, BamWriter
@@ -1991,6 +2059,9 @@ def cmd_filter(args):
                 and not args.reverse_per_base_tags
                 and not args.require_single_strand_agreement
                 and not getattr(args, "classic", False))
+    if source is not None and not use_fast:
+        log.error("filter: fused chain requires the native batch engine")
+        return 2
     t0 = time.monotonic()
     try:
         reference = None
@@ -2032,7 +2103,8 @@ def cmd_filter(args):
             from .io.batch_reader import BamBatchReader
 
             try:
-                with BamBatchReader(args.input) as reader:
+                with (BamBatchReader(args.input) if source is None
+                      else source) as reader:
                     from .core.template import is_query_grouped
                     # Template filtering needs mates adjacent
                     # (filter.rs:343-349 require_query_grouped).
@@ -2061,6 +2133,13 @@ def cmd_filter(args):
                         if rejects is not None:
                             (rejects.close if ok else rejects.discard)()
             except _OddSubtype:
+                if source is not None:
+                    # a channel cannot be re-read; the fused driver gates on
+                    # the standard consensus tag surface so this is a bug,
+                    # not a user-reachable state
+                    log.error("filter: unexpected per-base tag subtype on a "
+                              "fused stream (cannot re-run classic)")
+                    return 2
                 log.info("filter: unexpected per-base tag subtype; "
                          "re-running with the classic engine")
                 stats = None
@@ -2684,23 +2763,246 @@ def _add_pipeline(sub):
     p.add_argument("--filter-min-reads", type=int, default=3,
                    help="filter --min-reads")
     p.add_argument("--threads", type=int, default=0,
-                   help="stage threads (simplex)")
+                   help="stage threads, forwarded to every stage that "
+                        "accepts them (sort spill workers, group, simplex)")
     p.add_argument("--keep-intermediates", default=None, metavar="DIR",
-                   help="write stage outputs here and keep them (default: "
-                        "temp dir, deleted as each stage is consumed)")
+                   help="write stage outputs here and keep them (forces the "
+                        "classic staged path; default without it: fused "
+                        "in-memory chain, no intermediate files)")
+    p.add_argument("--no-fuse", action="store_true",
+                   help="run the classic staged path (intermediate BAMs in "
+                        "a temp dir) instead of the fused in-memory chain; "
+                        "output is byte-identical either way")
     _add_pipeline_compat(p)
     p.set_defaults(func=cmd_pipeline)
+
+
+def _pipeline_stage_argvs(args, j):
+    """The five stage argv lists of the FastqToConsensus chain, shared by
+    the staged and fused drivers (identical argv in both modes, so flag
+    handling and any argv-derived behavior cannot drift between them).
+    ``j(name)`` maps an intermediate file name to its path — a real temp
+    path in staged mode, an unused placeholder in fused mode."""
+    thr = ["--threads", str(args.threads)] if args.threads else []
+    lvl0 = ["--compression-level", "0"]
+    # user-facing compat flags forward to every stage; the user's
+    # --compression-level applies to the FINAL output only (intermediates
+    # stay level 0 by design — they are deleted as soon as they are read)
+    fwd = []
+    if args.memory_per_thread:
+        fwd += ["--memory-per-thread", args.memory_per_thread]
+    out_lvl = ([] if args.compression_level is None
+               else ["--compression-level", str(args.compression_level)])
+    rs = (["-r"] + args.read_structures) if args.read_structures else []
+    # --threads reaches every stage with threaded internals: sort's Phase-1
+    # spill workers and group's reader/writer stages are deterministic
+    # (byte-identical output), not just simplex
+    return [
+        ("extract", ["extract", "-i"] + args.input + rs +
+         ["-o", j("unmapped.bam"), "--sample", args.sample,
+          "--library", args.library] + lvl0 + fwd),
+        ("sort", ["sort", "-i", j("unmapped.bam"), "-o", j("sorted.bam"),
+                  "--order", "template-coordinate"] + lvl0 + thr + fwd),
+        ("group", ["group", "-i", j("sorted.bam"), "-o", j("grouped.bam"),
+                   "-s", args.strategy, "--allow-unmapped"] + lvl0 + thr
+         + fwd),
+        ("simplex", ["simplex", "-i", j("grouped.bam"), "-o", j("cons.bam"),
+                     "--min-reads", str(args.consensus_min_reads),
+                     "--allow-unmapped"] + lvl0 + thr + fwd),
+        ("filter", ["filter", "-i", j("cons.bam"), "-o", args.output,
+                    "--min-reads", str(args.filter_min_reads)] + out_lvl
+         + fwd),
+    ]
 
 
 def cmd_pipeline(args):
     """FastqToConsensus best-practice chain in one process.
 
     The reference ships this as a Snakemake workflow over separate fgumi
-    invocations (/root/reference/docs/FastqToConsensus-RnD.smk:1-40); running
-    the stages chained in-process keeps the JIT/compile caches warm across
-    stages and writes intermediate BAMs as stored (level-0) BGZF — each is
-    deleted as soon as the next stage has consumed it.
+    invocations (/root/reference/docs/FastqToConsensus-RnD.smk:1-40). Two
+    in-process drivers, byte-identical outputs:
+
+    - **fused** (default when the native engine is available): adjacent
+      stages hand decoded record batches through bounded in-memory channels
+      (``pipeline_chain``) — no intermediate files, no BGZF encode/decode
+      between stages, and the stages genuinely overlap (extract feeds
+      sort's Phase-1 spill ingest as it produces; the sort merge is the
+      natural barrier; group ⇒ simplex ⇒ filter stream as one segment).
+    - **staged** (``--no-fuse``, ``--keep-intermediates``, or no native
+      runtime): each stage re-enters main() and writes a stored (level-0)
+      intermediate BAM, deleted as soon as the next stage has consumed it.
     """
+    from .native import batch as nbat
+
+    fuse = (not args.no_fuse and args.keep_intermediates is None
+            and nbat.available())
+    if fuse:
+        return _pipeline_fused(args)
+    if not args.no_fuse and args.keep_intermediates is None:
+        log.info("pipeline: native batch engine unavailable; running the "
+                 "staged chain")
+    return _pipeline_staged(args)
+
+
+def _pipeline_fused(args):
+    """The fused in-memory chain driver: one thread per stage, adjacent
+    stages joined by byte-budgeted channels. Failure in any stage aborts
+    the chain (channels cascade ``ChainAborted`` both ways); the first
+    stage in chain order with a real error decides the exit code, exactly
+    like the staged driver's first-failing-stage contract."""
+    import threading as _threading
+
+    from .observe import heartbeat as _hb
+    from .observe.metrics import METRICS
+    from .observe.scope import spawn_thread
+    from .pipeline_chain import (ChainAborted, ChainChannel,
+                                 ChannelBamWriter, ChannelBatchReader)
+
+    stages = _pipeline_stage_argvs(args, lambda name: f"<fused:{name}>")
+    # nested-stage flag travel, exactly like the staged driver's `pre`
+    pre = ["--no-atomic-output"] if args.no_atomic_output else []
+    parser = build_parser()
+    ns = {name: parser.parse_args(pre + argv) for name, argv in stages}
+
+    c1 = ChainChannel("extract.sort")
+    c2 = ChainChannel("sort.group")
+    c3 = ChainChannel("group.simplex")
+    c4 = ChainChannel("simplex.filter")
+    chans = [c1, c2, c3, c4]
+
+    def _sink(chan):
+        return lambda header: ChannelBamWriter(chan, header)
+
+    # writable=False only where the consumer provably never writes its
+    # batches (sort ingest memcpys into pools, group builds fresh records).
+    # simplex (overlap correction) and filter (native in-place N/Q2
+    # masking via apply_masks, which writes through the raw pointer and
+    # would bypass numpy's read-only guard entirely) need writable input
+    calls = {
+        "extract": lambda a: cmd_extract(a, sink=_sink(c1)),
+        "sort": lambda a: cmd_sort(
+            a, source=ChannelBatchReader(c1, writable=False),
+            sink=_sink(c2)),
+        "group": lambda a: cmd_group(
+            a, source=ChannelBatchReader(c2, writable=False),
+            sink=_sink(c3)),
+        "simplex": lambda a: cmd_simplex(
+            a, source=ChannelBatchReader(
+                c3, target_bytes=ns["simplex"].batch_bytes),
+            sink=_sink(c4)),
+        "filter": lambda a: cmd_filter(a, source=ChannelBatchReader(c4)),
+    }
+    ins = {"extract": [], "sort": [c1], "group": [c2], "simplex": [c3],
+           "filter": [c4]}
+    outs = {"extract": [c1], "sort": [c2], "group": [c3], "simplex": [c4],
+            "filter": []}
+
+    lock = _threading.Lock()
+    results = {}
+    active = {}
+
+    def runner(name):
+        sargs = ns[name]
+        t0 = time.monotonic()
+        rc = None
+        err = None
+        aborted = False
+        with lock:
+            active[name] = True
+        try:
+            # per-stage compat mapping (BGZF level contextvar etc.) runs in
+            # this thread's context copy, so stages stay isolated exactly
+            # like the staged driver's per-main() invocations
+            rc = _apply_pipeline_compat(sargs)
+            if rc == 0:
+                sargs.func = calls[name]
+                rc = _run_command(sargs)
+        except ChainAborted:
+            aborted = True  # cascade victim; the root cause is elsewhere
+        except BaseException as e:  # noqa: BLE001 - relayed to the driver
+            err = e
+        finally:
+            wall = time.monotonic() - t0
+            with lock:
+                active.pop(name, None)
+                results[name] = {"rc": rc, "error": err, "aborted": aborted}
+            METRICS.inc(f"pipeline.stage.{name}.wall_s", round(wall, 6))
+            ok = rc == 0 and err is None and not aborted
+            if ok:
+                # the stage's writer already closed its channel; this close
+                # is an idempotent backstop
+                for c in outs[name]:
+                    c.close()
+                log.info("pipeline: %s done in %.2fs", name, wall)
+            else:
+                for c in outs[name]:
+                    c.abort(f"pipeline stage {name} failed")
+                for c in ins[name]:
+                    c.cancel()
+
+    METRICS.set("pipeline.chain.fused", 1)
+
+    def _running_stages():
+        # a started stage parked in its input-header wait (group/simplex/
+        # filter until the sort merge opens the segment) is not "running"
+        # yet — the heartbeat should show the stages actually doing work,
+        # e.g. stage=extract+sort during the ingest-overlap phase
+        with lock:
+            started = [n for n, _ in stages if n in active]
+        return {"stage": "+".join(
+            n for n in started
+            if all(c.has_header for c in ins[n])) or "-"}
+
+    gauge_token = _hb.register_gauge(_running_stages)
+    t00 = time.monotonic()
+    threads = []
+    try:
+        for name, _ in stages:
+            t = spawn_thread(runner, args=(name,),
+                             name=f"fgumi-chain-{name}")
+            threads.append(t)
+            t.start()
+        try:
+            for t in threads:
+                while t.is_alive():
+                    t.join(timeout=0.2)
+        except BaseException:
+            # KeyboardInterrupt (or anything else) on the driver thread:
+            # tear the chain down so every stage unwinds, then re-raise for
+            # the top-level exit-code mapping
+            for c in chans:
+                c.abort("pipeline interrupted")
+                c.cancel()
+            for t in threads:
+                t.join(timeout=10)
+            raise
+    finally:
+        _hb.unregister_gauge(gauge_token)
+        for c in chans:
+            c.fold_metrics()
+    for name, _ in stages:
+        r = results.get(name)
+        if r is None:
+            continue
+        if r["error"] is not None:
+            raise r["error"]
+        if r["rc"] not in (0, None):
+            log.error("pipeline: stage %s failed (rc=%d)", name, r["rc"])
+            return r["rc"]
+    aborted = [n for n, _ in stages if results.get(n, {}).get("aborted")]
+    if aborted:
+        log.error("pipeline: stage(s) %s aborted with no root cause "
+                  "recorded", ",".join(aborted))
+        return 1
+    log.info("pipeline: total %.2fs (fused) -> %s", time.monotonic() - t00,
+             args.output)
+    return 0
+
+
+def _pipeline_staged(args):
+    """The classic staged driver: each stage re-enters main() and writes a
+    level-0 intermediate BAM (tmpfs-backed when the host has headroom),
+    deleted as soon as the next stage has consumed it."""
     import shutil
     import tempfile
 
@@ -2742,47 +3044,29 @@ def cmd_pipeline(args):
     def j(name):
         return os.path.join(tmp, name)
 
-    thr = ["--threads", str(args.threads)] if args.threads else []
-    lvl0 = ["--compression-level", "0"]
-    # user-facing compat flags forward to every stage; the user's
-    # --compression-level applies to the FINAL output only (intermediates
-    # stay level 0 by design — they are deleted as soon as they are read)
-    fwd = []
-    if args.memory_per_thread:
-        fwd += ["--memory-per-thread", args.memory_per_thread]
     # each stage re-enters main(), which resets the atomic-commit global
     # from its own flags — so an outer --no-atomic-output must travel
     pre = ["--no-atomic-output"] if args.no_atomic_output else []
-    out_lvl = ([] if args.compression_level is None
-               else ["--compression-level", str(args.compression_level)])
-    rs = (["-r"] + args.read_structures) if args.read_structures else []
-    stages = [
-        ("extract", ["extract", "-i"] + args.input + rs +
-         ["-o", j("unmapped.bam"), "--sample", args.sample,
-          "--library", args.library] + lvl0 + fwd),
-        ("sort", ["sort", "-i", j("unmapped.bam"), "-o", j("sorted.bam"),
-                  "--order", "template-coordinate"] + lvl0 + fwd),
-        ("group", ["group", "-i", j("sorted.bam"), "-o", j("grouped.bam"),
-                   "-s", args.strategy, "--allow-unmapped"] + lvl0 + fwd),
-        ("simplex", ["simplex", "-i", j("grouped.bam"), "-o", j("cons.bam"),
-                     "--min-reads", str(args.consensus_min_reads),
-                     "--allow-unmapped"] + lvl0 + thr + fwd),
-        ("filter", ["filter", "-i", j("cons.bam"), "-o", args.output,
-                    "--min-reads", str(args.filter_min_reads)] + out_lvl
-         + fwd),
-    ]
+    stages = _pipeline_stage_argvs(args, j)
     consumed = {"sort": "unmapped.bam", "group": "sorted.bam",
                 "simplex": "grouped.bam", "filter": "cons.bam"}
+    from .observe import heartbeat as _hb
+    from .observe.metrics import METRICS
+
+    current = {"stage": "-"}
+    gauge_token = _hb.register_gauge(lambda: dict(current))
     try:
         t00 = time.monotonic()
         for name, argv in stages:
+            current["stage"] = name
             t0 = time.monotonic()
             rc = main(pre + argv)
+            dt = time.monotonic() - t0
+            METRICS.inc(f"pipeline.stage.{name}.wall_s", round(dt, 6))
             if rc:
                 log.error("pipeline: stage %s failed (rc=%d)", name, rc)
                 return rc
-            log.info("pipeline: %s done in %.2fs", name,
-                     time.monotonic() - t0)
+            log.info("pipeline: %s done in %.2fs", name, dt)
             prev = consumed.get(name)
             if prev and not keep:
                 try:
@@ -2792,6 +3076,7 @@ def cmd_pipeline(args):
         log.info("pipeline: total %.2fs -> %s", time.monotonic() - t00,
                  args.output)
     finally:
+        _hb.unregister_gauge(gauge_token)
         if not keep:
             shutil.rmtree(tmp, ignore_errors=True)
     return 0

@@ -304,7 +304,8 @@ def _fast_extract_ok(structures, opts) -> bool:
     return umi_total < 1000  # native join buffer is 1024 bytes
 
 
-def _run_extract_fast(inputs, output, structures, opts, offset, header):
+def _run_extract_fast(inputs, output, structures, opts, offset, header,
+                      sink=None):
     """Batched native extraction (fgumi_extract_records): vectorized FASTQ
     lexing + C record assembly, byte-identical to make_records on the
     supported option surface (tests/test_extract_fast.py)."""
@@ -325,7 +326,8 @@ def _run_extract_fast(inputs, output, structures, opts, offset, header):
     n_sets = 0
     readers = [FastqBatchReader(p) for p in inputs]
     try:
-        with BamWriter(output, header) as writer:
+        with (BamWriter(output, header) if sink is None
+              else sink(header)) as writer:
             iters = [iter(r) for r in readers]
             cur = [None] * len(readers)  # (arrays tuple, consumed)
             while True:
@@ -391,8 +393,13 @@ def _run_extract_fast(inputs, output, structures, opts, offset, header):
     return n_records, n_sets
 
 
-def run_extract(inputs, output, opts: ExtractOptions):
+def run_extract(inputs, output, opts: ExtractOptions, sink=None):
     """Full extract: detect encoding, zip FASTQs, write unmapped BAM.
+
+    ``sink`` (optional) replaces the file output: a callable taking the
+    output BamHeader and returning a BamWriter-compatible context manager —
+    the fused pipeline chain passes a channel-backed writer here so
+    extract's records stream straight into sort with no intermediate file.
 
     Returns (records_written, read_pairs_processed).
     """
@@ -414,13 +421,14 @@ def run_extract(inputs, output, opts: ExtractOptions):
 
     if _fast_extract_ok(structures, opts):
         return _run_extract_fast(inputs, output, structures, opts, offset,
-                                 header)
+                                 header, sink=sink)
 
     n_records = 0
     n_sets = 0
     readers = [FastqReader(p) for p in inputs]
     try:
-        with BamWriter(output, header) as writer:
+        with (BamWriter(output, header) if sink is None
+              else sink(header)) as writer:
             iters = [iter(r) for r in readers]
             while True:
                 reads = []

@@ -94,6 +94,20 @@ class BamHeader:
         return cls(text=text, ref_names=names, ref_lengths=lengths)
 
 
+def header_roundtrip(header: BamHeader) -> BamHeader:
+    """The header exactly as a file round trip would deliver it.
+
+    The fused pipeline chain (``pipeline_chain``) hands headers between
+    stages in memory; downstream stages derive provenance from the header
+    *text* (@HD rewrites in sort, @PG chaining in filter), so the handoff
+    must replicate what ``encode()`` → ``decode_from()`` produces — byte
+    for byte — or the fused run's headers could drift from the staged
+    run's (e.g. trailing-NUL stripping)."""
+    import io as _io
+
+    return BamHeader.decode_from(_io.BytesIO(header.encode()).read)
+
+
 class RawRecord:
     """A single BAM record's wire bytes (without the leading block_size)."""
 

@@ -129,30 +129,18 @@ class BatchedRecordReader:
         self.close()
 
 
-class BamBatchReader:
-    """Yields RecordBatch objects of ~target_bytes decompressed payload."""
+class _BatchAssembler:
+    """Accumulate → boundary-scan → tail-carry loop shared by every batch
+    source: ``read_chunk()`` returns the next decoded uint8 array (empty at
+    end of stream) — the BGZF reader for file-backed batches, a fused-chain
+    channel for in-memory handoff (``pipeline_chain.ChannelBatchReader``) —
+    and iteration yields :class:`RecordBatch` objects of ~``target_bytes``
+    payload. Factoring it here keeps the re-chunking behavior (single-part
+    no-copy wrap, concatenate-once, partial-record tail carry, oversized-
+    record target growth) identical across sources."""
 
-    def __init__(self, path_or_obj, target_bytes: int = 16 << 20):
-        owns = isinstance(path_or_obj, str)
-        fileobj = open(path_or_obj, "rb") if owns else path_or_obj
-        if owns:
-            from .prefetch import PrefetchFile, prefetch_enabled
-
-            if prefetch_enabled():
-                # async read-ahead + POSIX_FADV_SEQUENTIAL (reference
-                # PrefetchReader, prefetch_reader.rs:93 + os_hints.rs):
-                # overlaps disk latency with decompress/decode even when
-                # the command runs without a reader stage thread
-                fileobj = PrefetchFile(fileobj)
-        self._r = BgzfReader(fileobj, owns_fileobj=owns,
-                             name=path_or_obj if owns else None)
-        try:
-            self.header = BamHeader.decode_from(self._r.read)
-        except BaseException:
-            # stop the prefetch thread + close the fd even when the header
-            # is corrupt — an unreferenced running thread never gets GC'd
-            self._r.close()
-            raise
+    def __init__(self, read_chunk, target_bytes: int):
+        self._read_chunk = read_chunk
         # a non-positive target would make _fill yield nothing and the
         # command silently write an empty output; clamp to "one chunk"
         self._target = max(int(target_bytes), 1)
@@ -165,7 +153,7 @@ class BamBatchReader:
 
     def _fill(self):
         while self._parts_len < self._target and not self._eof:
-            arr = self._r.read_decoded()
+            arr = self._read_chunk()
             if not len(arr):
                 self._eof = True
                 break
@@ -197,6 +185,36 @@ class BamBatchReader:
             # a trailing partial record at EOF surfaces as an empty scan on the
             # next iteration and raises there, after this chunk is consumed
             yield RecordBatch(buf[:scanned], offsets.copy())
+
+
+class BamBatchReader:
+    """Yields RecordBatch objects of ~target_bytes decompressed payload."""
+
+    def __init__(self, path_or_obj, target_bytes: int = 16 << 20):
+        owns = isinstance(path_or_obj, str)
+        fileobj = open(path_or_obj, "rb") if owns else path_or_obj
+        if owns:
+            from .prefetch import PrefetchFile, prefetch_enabled
+
+            if prefetch_enabled():
+                # async read-ahead + POSIX_FADV_SEQUENTIAL (reference
+                # PrefetchReader, prefetch_reader.rs:93 + os_hints.rs):
+                # overlaps disk latency with decompress/decode even when
+                # the command runs without a reader stage thread
+                fileobj = PrefetchFile(fileobj)
+        self._r = BgzfReader(fileobj, owns_fileobj=owns,
+                             name=path_or_obj if owns else None)
+        try:
+            self.header = BamHeader.decode_from(self._r.read)
+        except BaseException:
+            # stop the prefetch thread + close the fd even when the header
+            # is corrupt — an unreferenced running thread never gets GC'd
+            self._r.close()
+            raise
+        self._asm = _BatchAssembler(self._r.read_decoded, target_bytes)
+
+    def __iter__(self):
+        return iter(self._asm)
 
     def close(self):
         self._r.close()

@@ -11,6 +11,13 @@ Naming convention (stable API — the run-report schema and CI smoke rely on
 these prefixes):
 
 - ``pipeline.stage.<stage>.busy_s`` / ``.blocked_s`` — run_stages timings
+- ``pipeline.stage.<name>.wall_s`` — the `pipeline` command's per-stage
+  wall clock (extract/sort/group/simplex/filter), both drivers
+- ``pipeline.chain.fused`` — 1 when the fused in-memory chain ran;
+  ``pipeline.chain.<producer>.<consumer>.{batches,bytes,peak_bytes,
+  put_wait_s,get_wait_s,copies}`` — per-channel handoff traffic and
+  backpressure of the fused chain (pipeline_chain.py; the CI gate
+  ``tools/chain_smoke.py`` reads these)
 - ``pipeline.queue.{in,out}.{mean,max}``, ``pipeline.queue.samples``
 - ``device.*`` — DeviceStats snapshot (dispatches, retries, batch_splits,
   host_fallbacks, bytes_uploaded, bytes_fetched, fetch_wait_s,

@@ -27,9 +27,12 @@ def _device_stats():
     """The module-wide DeviceStats, or None when ops.kernel was never
     imported this run — an unimported kernel has nothing to report, and
     importing it here would tax numpy-free commands (sort, fastq, ...)
-    with the kernel import at exit."""
+    with the kernel import at exit. getattr-with-default also covers a
+    *partially initialized* module: the heartbeat thread can observe
+    sys.modules mid-import while another stage thread (fused chain,
+    serve job) is still executing the kernel module body."""
     kern = sys.modules.get("fgumi_tpu.ops.kernel")
-    return kern.DEVICE_STATS if kern is not None else None
+    return getattr(kern, "DEVICE_STATS", None)
 
 #: Structural schema: top-level field -> required type (None = any JSON).
 #: Sections marked optional may be absent when the command produced no such

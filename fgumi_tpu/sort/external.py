@@ -443,6 +443,20 @@ class NativeExternalSorter:
                        batch.rec_off - base,
                        (batch.data_end - batch.rec_off))
 
+    def ingest_batches(self, batches, batch_keys_fn, on_batch=None):
+        """Phase-1 ingest from any RecordBatch iterable — a file reader or a
+        fused-chain channel (``pipeline_chain.ChannelBatchReader``).
+
+        Batches are keyed and pooled as they arrive, so with spill workers
+        the sort/compress/write of completed pools overlaps the *producer*
+        (in the fused chain: extract emits while sort spills — the sort
+        merge is the chain's natural barrier). ``on_batch(n)`` fires per
+        batch for progress reporting."""
+        for b in batches:
+            self.add_record_batch(b, batch_keys_fn)
+            if on_batch is not None:
+                on_batch(b.n)
+
     def _after_add(self, n: int, nbytes: int):
         self.n_records += n
         self._chunk_records += n
@@ -547,8 +561,14 @@ class NativeExternalSorter:
         while self._futures:
             self._futures.pop(0).result()
 
-    def _chunked(self, with_lens):
-        """Yield sorted output as (wire blob, rec_lens|None) chunks."""
+    def _chunked(self, with_lens, as_bytes=True):
+        """Yield sorted output as (wire blob, rec_lens|None) chunks.
+
+        ``as_bytes=False`` yields writable uint8 arrays instead of bytes:
+        the in-memory path hands over its freshly gathered buffer with no
+        extra copy, the merge path copies out of its reused read buffer
+        (same cost as the ``tobytes`` it replaces). The fused chain uses
+        this so downstream batches can mutate records in place."""
         np = self._np
         if not self._run_paths:
             koff, klen, roff, rlen = self._spans()
@@ -572,7 +592,8 @@ class NativeExternalSorter:
                 self._lib.fgumi_gather_spans(
                     recs.ctypes.data, roff.ctypes.data, rlen.ctypes.data,
                     perm[i:j].ctypes.data, j - i, out.ctypes.data)
-                yield out.tobytes(), (lens_sorted[i:j] if with_lens else None)
+                yield ((out.tobytes() if as_bytes else out),
+                       (lens_sorted[i:j] if with_lens else None))
             self._reset_pools()
             return
         self._spill()
@@ -598,7 +619,8 @@ class NativeExternalSorter:
                     raise OSError("corrupt spill run during merge")
                 if n_bytes == 0:
                     break
-                yield (out[:n_bytes].tobytes(),
+                yield ((out[:n_bytes].tobytes() if as_bytes
+                        else out[:n_bytes].copy()),
                        (lens[:n_out.value].copy() if with_lens else None))
         finally:
             self._lib.fgumi_merge_close(h)
@@ -608,6 +630,13 @@ class NativeExternalSorter:
         (feed straight to BamWriter.write_serialized)."""
         for blob, _ in self._chunked(with_lens=False):
             yield blob
+
+    def iter_sorted_wire(self):
+        """Sorted wire chunks as WRITABLE uint8 arrays (the fused-chain
+        output path: downstream RecordBatches mutate seq/qual in place, and
+        the in-memory sort path hands its buffers over with no copy)."""
+        for arr, _ in self._chunked(with_lens=False, as_bytes=False):
+            yield arr
 
     def sorted_chunks_with_lens(self):
         """(wire blob, int32 per-record wire lengths) chunks in sorted order

@@ -48,6 +48,7 @@ FAULT_POINTS = frozenset({
     "writer.compress",     # BGZF writer block emit (io/bgzf.py)
     "native.batch",        # native batch-op entry (native/batch.py)
     "serve.dispatch",      # job-service worker dispatch (serve/daemon.py)
+    "chain.handoff",       # fused-pipeline channel put (pipeline_chain.py)
 })
 
 KINDS = frozenset({"raise", "hang", "corrupt-bytes", "oom"})

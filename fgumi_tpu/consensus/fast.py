@@ -291,13 +291,10 @@ class FastSimplexCaller:
         self.tag = tag
         self.overlap_caller = overlap_caller  # OverlappingBasesConsensusCaller
         self.mesh = mesh if mesh is not None and mesh.size > 1 else None
-        # hybrid routing: device dispatches in flight beyond this cap route
-        # to the host f64 engine instead (the link is saturated; queueing
-        # more just delays the writer) — policy shared with the duplex and
-        # codec engines (ops/kernel.default_max_inflight)
-        from ..ops.kernel import default_max_inflight
-
-        self.max_inflight = default_max_inflight()
+        # device/host routing is per batch via the adaptive cost model
+        # (ops/router.py; FGUMI_TPU_ROUTE forces a side; the explicit
+        # FGUMI_TPU_MAX_INFLIGHT escape hatch is honored inside
+        # ROUTER.decide)
         opts = caller.options
         # conditions the vectorized conversion cannot express
         self._vector_ok = (not opts.trim and not opts.methylation_mode)
@@ -886,46 +883,57 @@ class FastSimplexCaller:
             return (self._dispatch_sharded(multi, counts, starts, codes_d,
                                            quals_d, L_max), blocks0)
 
-        from ..ops.kernel import HOST_DISPATCH, device_backlogged
+        from ..ops.kernel import HOST_DISPATCH, device_path
+        from ..ops.router import ROUTER
 
-        if kernel.host_mode() or (kernel.hybrid_mode()
-                                  and device_backlogged(self.max_inflight)):
-            # host f64 engine path: either no device at all, or (hybrid) the
-            # device pipe is full — the link absorbs what it can, the host
-            # engine eats the overflow CONCURRENTLY on the resolve pool, so
-            # e2e throughput is device + host, not min of the two. No pad,
-            # no device layout: the native engine consumes ragged rows.
+        N = len(rows_all)
+        if kernel.host_mode():
+            side = "host"
+        else:
+            # adaptive offload: price this batch on both sides from
+            # measured EWMAs (ops/router.py decide_batch)
+            side = ROUTER.decide_batch(kernel, N, len(multi), L_max)
+        if side == "host":
+            # host f64 engine path: either no device at all, or the cost
+            # model priced this batch host-side — the native engine eats it
+            # CONCURRENTLY on the resolve pool, so e2e throughput is
+            # device + host, not min of the two. No pad, no device layout:
+            # the native engine consumes ragged rows.
             starts = np.concatenate(([0], np.cumsum(counts)))
             return ("seg", multi, starts,
                     np.ascontiguousarray(codes[rows_all, :L_max]),
                     np.ascontiguousarray(quals[rows_all, :L_max]),
                     HOST_DISPATCH), blocks0
 
-        if not kernel.hybrid_mode():
-            # FGUMI_TPU_HYBRID=0 (or no native library): whole batches ship
-            # to the device in the 1 B/position wire layout — the raw-device
-            # benchmark/debug mode documented in performance-tuning.md
-            import time
+        if device_path() == "columns":
+            # round-5 comparison route (FGUMI_TPU_DEVICE_PATH=columns):
+            # native classify resolves the easy columns on host; only the
+            # hard few percent cross the link as a compact observation
+            # stream (ops/kernel.py dispatch_hard_columns)
+            starts = np.concatenate(([0], np.cumsum(counts)))
+            pending = kernel.dispatch_hard_columns(
+                np.ascontiguousarray(codes[rows_all, :L_max]),
+                np.ascontiguousarray(quals[rows_all, :L_max]), starts)
+            return ("cols", multi, pending), blocks0
 
-            from ..ops.kernel import pad_segments_gather
+        # full-column device route (the round-6 default): the whole batch
+        # crosses the link once in the 1 B/position wire layout and the
+        # device resolves every column — winner/qual/depth/errors per
+        # position, no host re-walk of the dense rows at resolve time
+        import time
 
-            t_pack0 = time.monotonic()  # gather+pad+wire == this batch's pack
-            codes_dev, quals_dev, seg_ids, starts_p, F_pad, N = \
-                pad_segments_gather(codes, quals, rows_all, L_max, counts)
-            ticket = kernel.device_call_segments_wire(
-                codes_dev, quals_dev, seg_ids, F_pad, len(multi),
-                pack_t0=t_pack0)
-            return ("segw", multi, starts_p, codes_dev[:N], quals_dev[:N],
-                    ticket), blocks0
+        from ..ops.kernel import pad_segments_gather
 
-        # device path: native classify resolves the easy columns on host;
-        # only the hard few percent cross the link as a compact observation
-        # stream (ops/kernel.py dispatch_hard_columns)
-        starts = np.concatenate(([0], np.cumsum(counts)))
-        pending = kernel.dispatch_hard_columns(
-            np.ascontiguousarray(codes[rows_all, :L_max]),
-            np.ascontiguousarray(quals[rows_all, :L_max]), starts)
-        return ("cols", multi, pending), blocks0
+        t_pack0 = time.monotonic()  # gather+pad+wire == this batch's pack
+        codes_dev, quals_dev, seg_ids, starts_p, F_pad, N_real = \
+            pad_segments_gather(codes, quals, rows_all, L_max, counts)
+        pred = ROUTER.last_prediction()
+        ticket = kernel.device_call_segments_wire(
+            codes_dev, quals_dev, seg_ids, F_pad, len(multi),
+            pack_t0=t_pack0, full=bool(counts.max() < 65536),
+            pred_s=pred[0] if pred else None)
+        return ("segw", multi, starts_p, codes_dev[:N_real],
+                quals_dev[:N_real], ticket), blocks0
 
     def _dispatch_sharded(self, multi, counts, starts, codes_d, quals_d,
                           L_max):

@@ -31,12 +31,9 @@ class FastCodecCaller:
 
     def __init__(self, caller, tag: bytes = b"MI"):
         self.caller = caller
-        # hybrid backlog cap shared with the simplex/duplex engines
-        # (ops/kernel.default_max_inflight): a backlogged upload pipeline
-        # routes this batch to the native f64 host engine
-        from ..ops.kernel import default_max_inflight
-
-        self.max_inflight = default_max_inflight()
+        # device/host routing is per batch via the adaptive cost model
+        # (ops/router.py; FGUMI_TPU_ROUTE / FGUMI_TPU_MAX_INFLIGHT handled
+        # inside ROUTER.decide)
         self.tag = tag
         self._carry = None  # (mi string, [RawRecord])
 
@@ -186,27 +183,13 @@ class FastCodecCaller:
                     codes2d[row, :k] = c[:k]
                     quals2d[row, :k] = q[:k]
                     row += 1
-            from ..ops.kernel import HOST_DISPATCH, device_backlogged
+            # adaptive offload: host f64 engine / hard-column export /
+            # full-column wire, decided per batch (ops/kernel helper)
+            from ..ops.kernel import route_and_call_segments
 
-            if ss.kernel.host_mode() or not ss.kernel.hybrid_mode():
-                dev, starts = ss.kernel.dispatch_segments(codes2d, quals2d,
-                                                          counts)
-                w, q_, d, e = ss.kernel.resolve_segments(dev, codes2d,
-                                                         quals2d, starts)
-            elif device_backlogged(self.max_inflight):
-                # upload pipeline full: host f64 engine absorbs this batch
-                # concurrently (device + host, not min of the two)
-                starts = np.concatenate(([0], np.cumsum(counts)))
-                w, q_, d, e = ss.kernel.resolve_segments(
-                    HOST_DISPATCH, codes2d, quals2d, starts)
-            else:
-                # device: classify + compact hard-column dispatch (the
-                # synchronous round trip ships only the hard few percent —
-                # same routing as the duplex SS stage)
-                starts = np.concatenate(([0], np.cumsum(counts)))
-                pending = ss.kernel.dispatch_hard_columns(codes2d, quals2d,
-                                                          starts)
-                w, q_, d, e = ss.kernel.resolve_hard_columns(pending)
+            starts = np.concatenate(([0], np.cumsum(counts)))
+            w, q_, d, e = route_and_call_segments(ss.kernel, codes2d,
+                                                  quals2d, counts, starts)
             slots = [(v[0], v[1], v[4]) for v in vec_multi] \
                 + [(c[0], c[1], c[2]) for c in cls]
             # thresholds are elementwise: one vectorized pass over the whole
@@ -349,15 +332,35 @@ class FastCodecCaller:
         place_side(1, b2, q2, d2, e2)
 
         # ---- duplex combine, one pass over the concatenated strands:
-        # native single C pass when available (byte-identical to
-        # combine_arrays, which the classic path keeps as the oracle)
-        if nb.available():
-            cb, cq, cd, ce, both, disag = nb.codec_combine(
-                b1, b2, q1, q2, d1, d2, e1, e2, MIN_PHRED, NO_CALL_BASE,
-                NO_CALL_BASE_LOWER, I16_MAX)
+        # device jit (ops/kernel._codec_combine_jit), native C pass, or
+        # numpy — all byte-identical (the classic combine_arrays stays the
+        # oracle). The concordance stage routes per batch through the
+        # shared adaptive-stage runner (FGUMI_TPU_CODEC_COMBINE).
+        import os
+
+        kernel = caller.ss.kernel
+        comb_env = os.environ.get("FGUMI_TPU_CODEC_COMBINE",
+                                  "auto").strip().lower()
+
+        def _host_combine():
+            if nb.available():
+                return nb.codec_combine(
+                    b1, b2, q1, q2, d1, d2, e1, e2, MIN_PHRED, NO_CALL_BASE,
+                    NO_CALL_BASE_LOWER, I16_MAX)
+            return combine_arrays(b1, b2, q1, q2, d1, d2, e1, e2)
+
+        if T > 0 and comb_env != "host" and not kernel.host_mode():
+            from ..ops.kernel import codec_combine_device
+            from ..ops.router import CODEC_COMBINE, run_adaptive_stage
+
+            res, _side = run_adaptive_stage(
+                CODEC_COMBINE, T, comb_env,
+                lambda: codec_combine_device(b1, b2, q1, q2, d1, d2,
+                                             e1, e2),
+                _host_combine)
         else:
-            cb, cq, cd, ce, both, disag = combine_arrays(b1, b2, q1, q2,
-                                                         d1, d2, e1, e2)
+            res = _host_combine()
+        cb, cq, cd, ce, both, disag = res
 
         # per-molecule disagreement thresholds (recoverable rejects)
         def seg_sum(x):

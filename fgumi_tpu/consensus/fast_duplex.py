@@ -68,12 +68,9 @@ class FastDuplexCaller:
         self.tag = tag
         self.overlap_caller = overlap_caller
         self.mesh = mesh if mesh is not None and mesh.size > 1 else None
-        # hybrid backlog cap shared with the simplex/codec engines
-        # (ops/kernel.default_max_inflight): when the upload pipeline is
-        # full, this batch runs on the native f64 host engine instead
-        from ..ops.kernel import default_max_inflight
-
-        self.max_inflight = default_max_inflight()
+        # device/host routing is per batch via the adaptive cost model
+        # (ops/router.py; FGUMI_TPU_ROUTE / FGUMI_TPU_MAX_INFLIGHT handled
+        # inside ROUTER.decide)
         self._carry = None  # (base_mi, [RawRecord] a, [RawRecord] b)
         # With threads<=1 the CLI sets this True: the SS device round trip is
         # then deferred into a pending chunk resolved AFTER the next batch's
@@ -372,17 +369,17 @@ class FastDuplexCaller:
             finish_ss = ss_res[1]
 
             def _finish():
-                tb, tq, d16, e16, codes2d = finish_ss()
+                tb, tq, d16, e16, codes2d, ctx = finish_ss()
                 return b"".join(self._stage2(
                     batch, span, gb, sizes, n_paired, fallback, sb,
                     live_mol, seg_map, seg_len, tb, tq, d16, e16,
-                    codes2d, vrows, vstarts, L_max, ord0))
+                    codes2d, vrows, vstarts, L_max, ord0, ctx))
 
             return [_DuplexPending(_finish)]
-        tb, tq, d16, e16, codes2d = ss_res
+        tb, tq, d16, e16, codes2d, ctx = ss_res
         return self._stage2(batch, span, gb, sizes, n_paired, fallback, sb,
                             live_mol, seg_map, seg_len, tb, tq, d16, e16,
-                            codes2d, vrows, vstarts, L_max, ord0)
+                            codes2d, vrows, vstarts, L_max, ord0, ctx)
 
     def _need_filter_fallback(self, batch, span, vrows, g_of_row, t, fallback,
                               nG):
@@ -434,29 +431,25 @@ class FastDuplexCaller:
                 need[s] = True
         fallback[set_g[need]] = True
 
-    def _device_backlogged(self) -> bool:
-        """True when the upload pipeline already holds max_inflight
-        dispatches — this batch should run on the host engine instead."""
-        from ..ops.kernel import device_backlogged
-
-        return device_backlogged(self.max_inflight)
-
     def _ss_consensus(self, codes, quals, vrows, c1, vstarts, nseg, L_max,
                       defer=False):
         """All segs' single-strand consensus: thresholded bases/quals and
-        i16-clamped depth/error arrays, (nseg, L_max) each.
+        i16-clamped depth/error arrays, (nseg, L_max) each, plus the fused
+        strand-combine context (None unless the full-column device route
+        kept stage-1 outputs resident).
 
-        defer=True + the hybrid device path: returns ("defer", finish)
-        right after the dispatch; finish() -> the 5-tuple. Every other
-        path stays synchronous (host compute has nothing to overlap; the
-        sharded path fetches per shard)."""
+        defer=True + a device route: returns ("defer", finish) right after
+        the dispatch; finish() -> the 6-tuple. Every other path stays
+        synchronous (host compute has nothing to overlap; the sharded path
+        fetches per shard)."""
         opts = self.ss.options
         tb = np.zeros((nseg, L_max), dtype=np.uint8)
         tq = np.zeros((nseg, L_max), dtype=np.uint8)
         d16 = np.zeros((nseg, L_max), dtype=np.int32)
         e16 = np.zeros((nseg, L_max), dtype=np.int32)
         if not nseg:
-            return tb, tq, d16, e16, np.zeros((0, L_max), dtype=np.uint8)
+            return tb, tq, d16, e16, np.zeros((0, L_max), dtype=np.uint8), \
+                None
         codes2d = np.ascontiguousarray(codes[vrows])
         quals2d = np.ascontiguousarray(quals[vrows])
 
@@ -471,57 +464,93 @@ class FastDuplexCaller:
             d16[single] = np.minimum(d, I16_MAX).astype(np.int32)
             # errors are zero for single-read consensus
         multi = np.nonzero(~single)[0]
-        if len(multi):
-            rows_m = np.concatenate(
-                [np.arange(vstarts[s], vstarts[s + 1]) for s in multi])
-            cm = np.ascontiguousarray(codes2d[rows_m])
-            qm = np.ascontiguousarray(quals2d[rows_m])
-            counts_m = c1[multi]
-            starts_m = np.concatenate(([0], np.cumsum(counts_m)))
-            if self.mesh is not None:
-                w, q_, d, e = self._dispatch_sharded(cm, qm, counts_m,
-                                                     starts_m, L_max)
-            elif self.kernel.host_mode() or not self.kernel.hybrid_mode():
-                # host engine, or FGUMI_TPU_HYBRID=0 whole-batch device mode
-                # (same flag semantics as the simplex path)
-                dev, _ = self.kernel.dispatch_segments(cm, qm, counts_m)
-                w, q_, d, e = self.kernel.resolve_segments(dev, cm, qm,
-                                                           starts_m)
-            elif self._device_backlogged():
-                # device pipe full (feeder depth reached): the host f64
-                # engine absorbs this batch concurrently — throughput is
-                # device + host, not min of the two
-                from ..ops.kernel import HOST_DISPATCH
+        if not len(multi):
+            return tb, tq, d16, e16, codes2d, None
+        rows_m = np.concatenate(
+            [np.arange(vstarts[s], vstarts[s + 1]) for s in multi])
+        cm = np.ascontiguousarray(codes2d[rows_m])
+        qm = np.ascontiguousarray(quals2d[rows_m])
+        counts_m = c1[multi]
+        starts_m = np.concatenate(([0], np.cumsum(counts_m)))
 
-                w, q_, d, e = self.kernel.resolve_segments(
-                    HOST_DISPATCH, cm, qm, starts_m)
-            else:
-                # device: classify + compact hard-column dispatch — only
-                # the hard few percent of observations cross the link
-                # (ops/kernel.py dispatch_hard_columns)
-                pending = self.kernel.dispatch_hard_columns(cm, qm, starts_m)
-                if defer:
-                    def finish():
-                        w, q_, d, e = self.kernel.resolve_hard_columns(
-                            pending)
-                        b_m, q_m = oracle.apply_consensus_thresholds(
-                            w, q_, d, opts.min_reads,
-                            opts.min_consensus_base_quality)
-                        tb[multi] = b_m
-                        tq[multi] = q_m
-                        d16[multi] = np.minimum(d, I16_MAX).astype(np.int32)
-                        e16[multi] = np.minimum(e, I16_MAX).astype(np.int32)
-                        return tb, tq, d16, e16, codes2d
-
-                    return ("defer", finish)
-                w, q_, d, e = self.kernel.resolve_hard_columns(pending)
+        def finish_with(w, q_, d, e, ctx):
             b_m, q_m = oracle.apply_consensus_thresholds(
                 w, q_, d, opts.min_reads, opts.min_consensus_base_quality)
             tb[multi] = b_m
             tq[multi] = q_m
             d16[multi] = np.minimum(d, I16_MAX).astype(np.int32)
             e16[multi] = np.minimum(e, I16_MAX).astype(np.int32)
-        return tb, tq, d16, e16, codes2d
+            return tb, tq, d16, e16, codes2d, ctx
+
+        if self.mesh is not None:
+            w, q_, d, e = self._dispatch_sharded(cm, qm, counts_m,
+                                                 starts_m, L_max)
+            return finish_with(w, q_, d, e, None)
+        route = "host"
+        if not self.kernel.host_mode():
+            # adaptive offload: same pricing as the simplex engine
+            from ..ops.router import ROUTER
+
+            route = ROUTER.decide_batch(self.kernel, cm.shape[0],
+                                        len(multi), L_max)
+        if route == "host":
+            # no device, or the cost model priced this batch host-side:
+            # the native f64 engine absorbs it concurrently
+            from ..ops.kernel import HOST_DISPATCH
+
+            w, q_, d, e = self.kernel.resolve_segments(HOST_DISPATCH, cm,
+                                                       qm, starts_m)
+            return finish_with(w, q_, d, e, None)
+        from ..ops.kernel import device_path
+
+        if device_path() == "columns":
+            # round-5 comparison route: classify + compact hard-column
+            # export (FGUMI_TPU_DEVICE_PATH=columns)
+            pending = self.kernel.dispatch_hard_columns(cm, qm, starts_m)
+
+            def resolve_cols():
+                w, q_, d, e = self.kernel.resolve_hard_columns(pending)
+                return finish_with(w, q_, d, e, None)
+
+            return ("defer", resolve_cols) if defer else resolve_cols()
+        # full-column wire route (round-6 default): the whole multi-seg
+        # pileup crosses the link once; with the resident variant the
+        # thresholded outputs stay on device for the fused strand combine
+        import os
+        import time as _time
+
+        from ..ops.kernel import pad_segments
+        from ..ops.router import ROUTER
+
+        comb_env = os.environ.get("FGUMI_TPU_DUPLEX_COMBINE",
+                                  "auto").strip().lower()
+        full_ok = bool(counts_m.max() < 65536)
+        want_res = full_ok and comb_env != "host"
+        t_pack0 = _time.monotonic()
+        cd, qd, seg_ids, _sp, F_pad = pad_segments(cm, qm, counts_m)
+        pred = ROUTER.last_prediction()
+        ticket = self.kernel.device_call_segments_wire(
+            cd, qd, seg_ids, F_pad, len(multi), pack_t0=t_pack0,
+            full=full_ok,
+            resident_thresholds=(opts.min_reads,
+                                 opts.min_consensus_base_quality)
+            if want_res else None,
+            pred_s=pred[0] if pred else None)
+
+        def resolve_wire():
+            w, q_, d, e, extras = self.kernel.resolve_segments_wire(
+                ticket, cm, qm, starts_m, want_extras=True)
+            ctx = None
+            if extras["resident"] is not None:
+                seg_to_multi = np.full(nseg, -1, dtype=np.int64)
+                seg_to_multi[multi] = np.arange(len(multi))
+                ctx = {"resident": extras["resident"],
+                       "suspect": extras["suspect"],
+                       "seg_to_multi": seg_to_multi,
+                       "override": comb_env}
+            return finish_with(w, q_, d, e, ctx)
+
+        return ("defer", resolve_wire) if defer else resolve_wire()
 
     def _dispatch_sharded(self, cm, qm, counts_m, starts_m, L_max):
         """dp contiguous row-balanced shards over the multi-read segments,
@@ -561,7 +590,7 @@ class FastDuplexCaller:
 
     def _stage2(self, batch, span, gb, sizes, n_paired, fallback, sb,
                 live_mol, seg_map, seg_len, tb, tq, d16, e16, codes2d,
-                vrows, vstarts, L_max, ord0):
+                vrows, vstarts, L_max, ord0, combine_ctx=None):
         """Strand combination + serialization, molecule order preserved.
 
         ord0: the first ordinal of this span's pre-reserved range (set in
@@ -673,7 +702,7 @@ class FastDuplexCaller:
         if K:
             fast_blob, rec_end = self._serialize_outputs(
                 batch, span, gb, out_specs, seg_map, seg_len, tb, tq, d16,
-                e16, codes2d, vrows, vstarts, L_max, col)
+                e16, codes2d, vrows, vstarts, L_max, col, combine_ctx)
             stats.consensus_reads += K
 
         # assemble in molecule order, interleaving fallback molecules
@@ -705,8 +734,15 @@ class FastDuplexCaller:
 
     def _serialize_outputs(self, batch, span, gb, out_specs, seg_map, seg_len,
                            tb, tq, d16, e16, codes2d, vrows, vstarts, L_max,
-                           col):
-        """Combine + native-serialize the K fast output reads (order kept)."""
+                           col, combine_ctx=None):
+        """Combine + native-serialize the K fast output reads (order kept).
+
+        The strand combine runs either as numpy (the semantic reference) or
+        as the fused device stage over the stage-1 resident SS arrays
+        (``combine_ctx``; ops/kernel._duplex_combine_jit) — integer-exact
+        twins, chosen per batch by the adaptive cost model. Output rows
+        whose inputs carry an oracle patch (suspect positions) always take
+        the host combine: the resident arrays are pre-patch."""
         caller = self.caller
         K = len(out_specs)
         mols = np.array([s[0] for s in out_specs], dtype=np.int64)
@@ -721,8 +757,11 @@ class FastDuplexCaller:
         out_e = np.zeros((K, L_max), dtype=np.int32)
 
         comb = np.nonzero(kinds == 2)[0]
-        if len(comb):
-            ca, cb = aseg[comb], bseg[comb]
+
+        def combine_host(sel):
+            """Numpy strand combine for output rows `sel` (the semantic
+            reference the device stage must match bit-for-bit)."""
+            ca, cb = aseg[sel], bseg[sel]
             a_b = tb[ca].astype(np.int32)
             b_b = tb[cb].astype(np.int32)
             a_q = tq[ca].astype(np.int32)
@@ -739,16 +778,16 @@ class FastDuplexCaller:
                                                   MAX_PHRED), MIN_PHRED)))
             either_n = (a_b == N_CODE) | (b_b == N_CODE)
             mask = either_n | (raw_qual == MIN_PHRED) | tie
-            in_len = col[None, :] < lens[comb, None]
-            out_b[comb] = np.where(in_len & ~mask, raw_base, N_CODE)
-            out_q[comb] = np.where(in_len & ~mask, raw_qual, MIN_PHRED)
-            out_b[comb] = np.where(in_len, out_b[comb], 0)
-            out_q[comb] = np.where(in_len, out_q[comb], 0)
+            in_len = col[None, :] < lens[sel, None]
+            out_b[sel] = np.where(in_len & ~mask, raw_base, N_CODE)
+            out_q[sel] = np.where(in_len & ~mask, raw_qual, MIN_PHRED)
+            out_b[sel] = np.where(in_len, out_b[sel], 0)
+            out_q[sel] = np.where(in_len, out_q[sel], 0)
             # exact per-base errors vs the pre-mask raw duplex base over both
             # segs' packed source rows (duplex.py:118-126), with positions at
             # or beyond the combined length excluded per source read
             rb8 = np.ascontiguousarray(raw_base.astype(np.uint8))
-            errs = np.zeros((len(comb), L_max), dtype=np.int32)
+            errs = np.zeros((len(sel), L_max), dtype=np.int32)
             for side in (ca, cb):
                 # one native pass per side over each output's seg row range
                 _, e_side = nb.segment_depth_errors_ranges(
@@ -756,7 +795,46 @@ class FastDuplexCaller:
                 errs += e_side
             errs[rb8 == N_CODE] = 0
             errs[~in_len] = 0
-            out_e[comb] = np.minimum(errs, I16_MAX)
+            out_e[sel] = np.minimum(errs, I16_MAX)
+
+        done_rows = np.empty(0, dtype=np.int64)
+        if len(comb) and combine_ctx is not None:
+            s2m = combine_ctx["seg_to_multi"]
+            ma = s2m[aseg[comb]]
+            mb = s2m[bseg[comb]]
+            eligible = (ma >= 0) & (mb >= 0)  # single-read segs: host-only
+            sus = combine_ctx["suspect"]
+            if sus is not None and eligible.any():
+                # any oracle-patched position on either strand sends the
+                # whole output row to the host combine (resident arrays
+                # are pre-patch; conservative over the full row width)
+                sus_row = sus.any(axis=1)
+                eligible &= ~(sus_row[np.maximum(ma, 0)]
+                              | sus_row[np.maximum(mb, 0)])
+            cand = comb[eligible]
+            if len(cand):
+                from ..ops.kernel import duplex_combine_device
+                from ..ops.router import DUPLEX_COMBINE, run_adaptive_stage
+
+                def _device_combine():
+                    ob, oq, oe = duplex_combine_device(
+                        combine_ctx["resident"], s2m[aseg[cand]],
+                        s2m[bseg[cand]], lens[cand])
+                    out_b[cand] = ob
+                    out_q[cand] = oq
+                    out_e[cand] = oe
+
+                run_adaptive_stage(DUPLEX_COMBINE, len(cand) * L_max,
+                                   combine_ctx.get("override", "auto"),
+                                   _device_combine,
+                                   lambda: combine_host(cand))
+                done_rows = cand
+        rest = np.setdiff1d(comb, done_rows)
+        if len(rest):
+            # suspect-touched / single-seg / no-resident rows: always the
+            # host combine (not a chooser sample — the cand subset is the
+            # measured apples-to-apples comparison)
+            combine_host(rest)
 
         passthrough = np.nonzero(kinds != 2)[0]
         for k in passthrough:

@@ -491,18 +491,13 @@ class VanillaConsensusCaller(RejectTracking):
                 codes2d[row, :n] = c[:n]
                 quals2d[row, :n] = q[:n]
                 row += 1
-        if self.kernel.host_mode() or not self.kernel.hybrid_mode():
-            dev, starts = self.kernel.dispatch_segments(codes2d, quals2d,
-                                                        counts)
-            w, q_, d, e = self.kernel.resolve_segments(
-                dev, codes2d, quals2d, starts)
-        else:
-            # device: compact hard-column dispatch (same routing as the fast
-            # engines — classic/--classic runs share its link economics)
-            starts = np.concatenate(([0], np.cumsum(counts)))
-            pending = self.kernel.dispatch_hard_columns(codes2d, quals2d,
-                                                        starts)
-            w, q_, d, e = self.kernel.resolve_hard_columns(pending)
+        # same adaptive routing as the fast engines (ops/router.py) —
+        # classic/--classic runs share their link economics
+        from ..ops.kernel import route_and_call_segments
+
+        starts = np.concatenate(([0], np.cumsum(counts)))
+        w, q_, d, e = route_and_call_segments(self.kernel, codes2d, quals2d,
+                                              counts, starts)
         for fi, j in enumerate(multi):
             L = jobs[j].consensus_len
             b_j, q_j = oracle.apply_consensus_thresholds(

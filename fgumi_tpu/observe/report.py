@@ -145,7 +145,15 @@ def build_report(command: str, argv, started_unix: float, wall_s: float,
         report["queues"] = queues
     stats = _device_stats()
     dev = stats.snapshot() if stats is not None else {}
-    if dev.get("dispatches"):
+    # offload cost-model state (link/host EWMAs + last decision) rides
+    # along whenever batches were routed, so a wrong crossover is
+    # diagnosable from the report alone (ISSUE 6 satellite) — including
+    # the all-host case, where dispatches stays 0 but route_host > 0
+    if dev.get("route_device") or dev.get("route_host"):
+        router = sys.modules.get("fgumi_tpu.ops.router")
+        if router is not None:
+            dev["routing"] = router.ROUTER.snapshot()
+    if dev.get("dispatches") or dev.get("route_host"):
         report["device"] = dev
     io_sec = {k.split(".", 1)[1]: v for k, v in metrics.items()
               if k.startswith("io.")}

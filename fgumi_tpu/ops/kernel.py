@@ -203,6 +203,17 @@ def use_host_engine() -> bool:
     _ensure_jax()
     return jax.default_backend() == "cpu"
 
+def device_path() -> str:
+    """Which device route the engines use for whole batches: ``"full"``
+    (the 1-byte-wire full-column kernel; round-6 default) or ``"columns"``
+    (the round-5 classify-and-export-hard-columns path, kept for A/B
+    comparison via FGUMI_TPU_DEVICE_PATH=columns)."""
+    import os
+
+    v = os.environ.get("FGUMI_TPU_DEVICE_PATH", "full").strip().lower()
+    return v if v in ("full", "columns") else "full"
+
+
 # bf16 systolic peak FLOP/s and HBM GB/s per chip, keyed by substrings of
 # jax device_kind — for the MFU/bandwidth utilization estimate below. The
 # consensus kernel is VPU/elementwise-dominated, so low MFU is expected and
@@ -248,6 +259,10 @@ class DeviceStats:
         self.const_uploads = 0
         self.const_hits = 0
         self.const_upload_bytes = 0
+        # adaptive-offload accounting (ops/router.py): batches routed to
+        # the device vs the native f64 host engine
+        self.route_device = 0
+        self.route_host = 0
         self.timeline = []  # per-dispatch dicts (capped; --stats report)
         self._t0 = time.monotonic()
 
@@ -281,6 +296,13 @@ class DeviceStats:
         with self._lock:
             self.const_hits += 1
 
+    def add_route(self, side: str):
+        with self._lock:
+            if side == "device":
+                self.route_device += 1
+            else:
+                self.route_host += 1
+
     def add_dispatch(self, flops: int):
         with self._lock:
             self.dispatches += 1
@@ -313,6 +335,20 @@ class DeviceStats:
             if 0 <= slot < len(self.timeline):
                 self.timeline[slot]["t_exec"] = round(
                     time.monotonic() - self._t0, 4)
+
+    def note_pred(self, slot: int, pred_s: float):
+        """Stamp the cost model's predicted dispatch time (ops/router.py)
+        so BENCH artifacts carry predicted vs actual per dispatch."""
+        with self._lock:
+            if 0 <= slot < len(self.timeline):
+                self.timeline[slot]["pred_s"] = round(pred_s, 4)
+
+    def timeline_entry(self, slot: int):
+        """Copy of one timeline slot (router feedback at resolve time)."""
+        with self._lock:
+            if 0 <= slot < len(self.timeline):
+                return dict(self.timeline[slot])
+        return None
 
     def end_in_flight(self, slot: int, fetched_bytes: int, wait_s: float):
         with self._lock:
@@ -384,6 +420,9 @@ class DeviceStats:
                 out["const_uploads"] = self.const_uploads
                 out["const_hits"] = self.const_hits
                 out["const_upload_bytes"] = self.const_upload_bytes
+            if self.route_device or self.route_host:
+                out["route_device"] = self.route_device
+                out["route_host"] = self.route_host
             return out
 
     def timeline_snapshot(self):
@@ -402,7 +441,8 @@ class DeviceStats:
                 "bytes_uploaded", "model_flops", "rows_real", "rows_padded",
                 "in_flight", "retries", "batch_splits", "host_fallbacks",
                 "upload_overlap_s", "feeder_queue_peak", "const_uploads",
-                "const_hits", "const_upload_bytes", "_t0")}
+                "const_hits", "const_upload_bytes", "route_device",
+                "route_host", "_t0")}
             timeline = [dict(t) for t in other.timeline]
         with self._lock:
             for k, v in state.items():
@@ -1022,12 +1062,9 @@ def _wire_terms(wire, dict_tab):
     return one_hot, delta
 
 
-@_lazy_jit(static_argnames=("num_segments", "out_segments"))
-def _consensus_segments_wire_jit(wire, seg_ids, dict_tab, ln_error_pre_umi,
-                                 num_segments, out_segments):
-    """Ragged-family consensus over the 1-byte wire layout with split packed
-    output: (N, L) wire rows -> (out_segments, L) qs + (out_segments, L/4) wp.
-    """
+def _wire_epilogue(wire, seg_ids, dict_tab, ln_error_pre_umi, num_segments):
+    """Shared reduction+epilogue of every wire-layout segment kernel:
+    (N, L) wire rows -> (winner, qual, depth, errors, suspect, obs)."""
     one_hot, delta = _wire_terms(wire, dict_tab)
     row_contrib = delta[..., None] * one_hot
     contrib = jax.ops.segment_sum(row_contrib, seg_ids,
@@ -1035,18 +1072,14 @@ def _consensus_segments_wire_jit(wire, seg_ids, dict_tab, ln_error_pre_umi,
                                   indices_are_sorted=True)
     obs = jax.ops.segment_sum(one_hot, seg_ids, num_segments=num_segments,
                               indices_are_sorted=True).astype(jnp.int32)
-    winner, qual, _depth, _errors, suspect = _call_epilogue(
-        contrib, obs, ln_error_pre_umi)
-    return _pack_result_split(winner, qual, suspect, out_segments)
+    return _call_epilogue(contrib, obs, ln_error_pre_umi) + (obs,)
 
 
-@_lazy_jit(static_argnames=("num_segments", "out_segments"))
-def _consensus_segments_packed2_jit(codes_packed, quals, seg_ids, correct_tab,
-                                    err_tab, ln_error_pre_umi, num_segments,
-                                    out_segments):
-    """1.25 B/position fallback of the wire dispatch (batches with >63
-    distinct quals): 2-bit packed codes + sentinel quals, split packed
-    output + fetch slice. Device-side unpack is a shift-and-mask."""
+def _packed2_epilogue(codes_packed, quals, seg_ids, correct_tab, err_tab,
+                      ln_error_pre_umi, num_segments):
+    """Shared reduction+epilogue of the 1.25 B/position fallback layout
+    (>63 distinct quals): 2-bit packed codes + sentinel quals. Device-side
+    unpack is a shift-and-mask."""
     shifts = jnp.arange(0, 8, 2, dtype=jnp.uint8)
     c4 = (codes_packed[..., None] >> shifts) & 3
     codes = c4.reshape(codes_packed.shape[0], -1)
@@ -1062,9 +1095,228 @@ def _consensus_segments_packed2_jit(codes_packed, quals, seg_ids, correct_tab,
                                   indices_are_sorted=True)
     obs = jax.ops.segment_sum(one_hot, seg_ids, num_segments=num_segments,
                               indices_are_sorted=True).astype(jnp.int32)
-    winner, qual, _depth, _errors, suspect = _call_epilogue(
-        contrib, obs, ln_error_pre_umi)
+    return _call_epilogue(contrib, obs, ln_error_pre_umi) + (obs,)
+
+
+@_lazy_jit(static_argnames=("num_segments", "out_segments"))
+def _consensus_segments_wire_jit(wire, seg_ids, dict_tab, ln_error_pre_umi,
+                                 num_segments, out_segments):
+    """Ragged-family consensus over the 1-byte wire layout with split packed
+    output: (N, L) wire rows -> (out_segments, L) qs + (out_segments, L/4) wp.
+    """
+    winner, qual, _depth, _errors, suspect, _obs = _wire_epilogue(
+        wire, seg_ids, dict_tab, ln_error_pre_umi, num_segments)
     return _pack_result_split(winner, qual, suspect, out_segments)
+
+
+@_lazy_jit(static_argnames=("num_segments", "out_segments"))
+def _consensus_segments_wire_full_jit(wire, seg_ids, dict_tab,
+                                      ln_error_pre_umi, num_segments,
+                                      out_segments):
+    """Full-column wire kernel: winner/qual AND depth/errors per column.
+
+    The device computes the integer depth/error counts it already holds as
+    lane observation sums (exact in f32 below 2^24 observations), so the
+    host never re-walks the dense rows at resolve time — the family's data
+    crosses the link once, as wire bytes. depth/errors fetch as uint16
+    (+4 B/column); callers gate on max family size < 65536 (ROADMAP item 1,
+    round 6)."""
+    winner, qual, depth, errors, suspect, _obs = _wire_epilogue(
+        wire, seg_ids, dict_tab, ln_error_pre_umi, num_segments)
+    qs, wp = _pack_result_split(winner, qual, suspect, out_segments)
+    return (qs, wp, depth[:out_segments].astype(jnp.uint16),
+            errors[:out_segments].astype(jnp.uint16))
+
+
+_I16_MAX = 32767  # fgbio Short tag clamp (vanilla.py I16_MAX twin)
+
+
+class ResidentHandles:
+    """Device-resident stage-1 outputs kept for a fused follow-up stage.
+
+    NOT a jax pytree on purpose: the feeder's fetch-overlap pass
+    (copy_to_host_async over tree leaves) must never start copying these —
+    they exist precisely so their bytes never cross the link."""
+
+    __slots__ = ("arrays",)
+
+    def __init__(self, arrays):
+        self.arrays = arrays
+
+
+@_lazy_jit(static_argnames=("num_segments", "out_segments"))
+def _consensus_segments_wire_resident_jit(wire, seg_ids, dict_tab,
+                                          ln_error_pre_umi, min_reads,
+                                          min_qual, num_segments,
+                                          out_segments):
+    """Full-column wire kernel + device-resident thresholded outputs.
+
+    Beyond the full fetch tuple, returns (tb, tq, obs) sliced to
+    out_segments and kept on device for the fused duplex strand-combine
+    stage (_duplex_combine_jit): tb/tq apply the consensus thresholds
+    (oracle.apply_consensus_thresholds twin — depth < min_reads -> (N, 0),
+    qual < min_qual -> (N, MIN_PHRED)) and obs holds the per-lane
+    observation counts the combine's exact error recount needs. Suspect
+    positions differ from the host's oracle-patched values; the combine
+    resolve recomputes any output row touching one on host."""
+    winner, qual, depth, errors, suspect, obs = _wire_epilogue(
+        wire, seg_ids, dict_tab, ln_error_pre_umi, num_segments)
+    qs, wp = _pack_result_split(winner, qual, suspect, out_segments)
+    w_sl = winner[:out_segments]
+    q_sl = qual[:out_segments]
+    d_sl = depth[:out_segments]
+    low_depth = d_sl < min_reads
+    low_qual = q_sl < min_qual
+    tb = jnp.where(low_depth | low_qual, N_CODE, w_sl).astype(jnp.uint8)
+    tq = jnp.where(low_depth, 0,
+                   jnp.where(low_qual, MIN_PHRED, q_sl)).astype(jnp.uint8)
+    return (qs, wp, d_sl.astype(jnp.uint16),
+            errors[:out_segments].astype(jnp.uint16), tb, tq,
+            obs[:out_segments])
+
+
+@_lazy_jit(static_argnames=("out_rows",))
+def _duplex_combine_jit(tb, tq, obs, a_idx, b_idx, lens, out_rows):
+    """Fused duplex strand-combine over stage-1 resident SS arrays.
+
+    Integer-exact twin of the numpy combine in
+    fast_duplex._serialize_outputs (every op is int32 select/clip
+    arithmetic, so device and host agree bit-for-bit): gathers the AB/BA
+    thresholded rows by index, combines base/qual, and recounts the
+    per-position errors against the raw combined base from the resident
+    per-lane observation sums — the SS pileups never re-cross the link;
+    only the (K, L) combined outputs are fetched."""
+    a_b = tb[a_idx].astype(jnp.int32)
+    b_b = tb[b_idx].astype(jnp.int32)
+    a_q = tq[a_idx].astype(jnp.int32)
+    b_q = tq[b_idx].astype(jnp.int32)
+    agree = a_b == b_b
+    a_wins = (~agree) & (a_q > b_q)
+    b_wins = (~agree) & (b_q > a_q)
+    tie = (~agree) & (a_q == b_q)
+    raw_base = jnp.where(agree | a_wins, a_b, b_b)
+    raw_qual = jnp.where(
+        agree, jnp.clip(a_q + b_q, MIN_PHRED, MAX_PHRED),
+        jnp.where(a_wins, jnp.clip(a_q - b_q, MIN_PHRED, MAX_PHRED),
+                  jnp.where(b_wins, jnp.clip(b_q - a_q, MIN_PHRED, MAX_PHRED),
+                            MIN_PHRED)))
+    either_n = (a_b == N_CODE) | (b_b == N_CODE)
+    mask = either_n | (raw_qual == MIN_PHRED) | tie
+    L = tb.shape[1]
+    in_len = jnp.arange(L, dtype=jnp.int32)[None, :] < lens[:, None]
+    out_b = jnp.where(in_len & ~mask, raw_base, N_CODE)
+    out_b = jnp.where(in_len, out_b, 0)
+    out_q = jnp.where(in_len & ~mask, raw_qual, MIN_PHRED)
+    out_q = jnp.where(in_len, out_q, 0)
+    # exact per-base errors vs the pre-mask raw duplex base: per side,
+    # (valid obs) - (obs matching raw_base) == segment_depth_errors_ranges
+    rb_l = jnp.minimum(raw_base, 3)[..., None]
+    errs = jnp.zeros(a_b.shape, dtype=jnp.int32)
+    for idx in (a_idx, b_idx):
+        side = obs[idx]
+        depth = jnp.sum(side, axis=-1)
+        match = jnp.take_along_axis(side, rb_l, axis=-1)[..., 0]
+        errs = errs + (depth - match)
+    errs = jnp.where((raw_base == N_CODE) | ~in_len, 0, errs)
+    return (out_b[:out_rows].astype(jnp.uint8),
+            out_q[:out_rows].astype(jnp.uint8),
+            jnp.minimum(errs, _I16_MAX)[:out_rows].astype(jnp.int32))
+
+
+@_lazy_jit(static_argnames=("out_rows",))
+def _codec_combine_jit(ba, bb, qa, qb, da, db, ea, eb, out_rows):
+    """CODEC concordance/duplex combine as a device stage.
+
+    Integer-exact twin of consensus/codec.combine_arrays (int32 select
+    arithmetic end to end) over the batch engine's concatenated position
+    arrays; inputs arrive post-oracle, so there is no suspect surface —
+    device output equals the numpy combine bit-for-bit."""
+    from ..constants import NO_CALL_BASE, NO_CALL_BASE_LOWER
+
+    ba = ba.astype(jnp.int32)
+    bb = bb.astype(jnp.int32)
+    qa = qa.astype(jnp.int32)
+    qb = qb.astype(jnp.int32)
+    da = da.astype(jnp.int32)
+    db = db.astype(jnp.int32)
+    ea = ea.astype(jnp.int32)
+    eb = eb.astype(jnp.int32)
+    a_has = (ba != NO_CALL_BASE) & (ba != NO_CALL_BASE_LOWER)
+    b_has = (bb != NO_CALL_BASE) & (bb != NO_CALL_BASE_LOWER)
+    both = a_has & b_has
+    agree = both & (ba == bb)
+    a_wins = both & ~agree & (qa > qb)
+    b_wins = both & ~agree & (qb > qa)
+    tie = both & ~agree & (qa == qb)
+    raw_base = jnp.where(b_wins, bb, ba)
+    raw_qual = jnp.where(
+        agree, jnp.minimum(93, qa + qb),
+        jnp.where(a_wins, jnp.maximum(MIN_PHRED, qa - qb),
+                  jnp.where(b_wins, jnp.maximum(MIN_PHRED, qb - qa),
+                            jnp.where(tie, MIN_PHRED, 0))))
+    q_masked = both & (raw_qual == MIN_PHRED)
+    dup_base = jnp.where(q_masked, NO_CALL_BASE, raw_base)
+    dup_qual = jnp.where(q_masked, MIN_PHRED, raw_qual)
+    cap = lambda x: jnp.minimum(x, _I16_MAX)  # noqa: E731
+    dup_depth = cap(da) + cap(db)
+    chose_a = agree | a_wins | tie
+    dup_err = jnp.where(agree, ea + eb,
+                        jnp.where(chose_a, ea + jnp.maximum(db - eb, 0),
+                                  eb + jnp.maximum(da - ea, 0)))
+    only_a = a_has & ~b_has
+    only_b = b_has & ~a_has
+    a_q2 = qa == MIN_PHRED
+    b_q2 = qb == MIN_PHRED
+    base = jnp.where(
+        both, dup_base,
+        jnp.where(only_a, jnp.where(a_q2, NO_CALL_BASE, ba),
+                  jnp.where(only_b, jnp.where(b_q2, NO_CALL_BASE, bb),
+                            NO_CALL_BASE)))
+    qual = jnp.where(
+        both, dup_qual,
+        jnp.where(only_a & ~a_q2, qa,
+                  jnp.where(only_b & ~b_q2, qb, MIN_PHRED)))
+    depth = jnp.where(both, dup_depth,
+                      jnp.where(only_a, da, jnp.where(only_b, db, 0)))
+    errors = jnp.where(both, dup_err,
+                       jnp.where(only_a, ea,
+                                 jnp.where(only_b, eb, cap(ea + eb))))
+    n_mask = (ba == NO_CALL_BASE) | (bb == NO_CALL_BASE)
+    base = jnp.where(n_mask, NO_CALL_BASE, base)
+    qual = jnp.where(n_mask, MIN_PHRED, qual)
+    sl = slice(None, out_rows)
+    return (base[sl].astype(jnp.uint8), qual[sl].astype(jnp.uint8),
+            jnp.minimum(depth, 2 * _I16_MAX)[sl].astype(jnp.int32),
+            jnp.minimum(errors, _I16_MAX)[sl].astype(jnp.int32),
+            both[sl], (a_wins | b_wins | tie)[sl])
+
+
+@_lazy_jit(static_argnames=("num_segments", "out_segments"))
+def _consensus_segments_packed2_jit(codes_packed, quals, seg_ids, correct_tab,
+                                    err_tab, ln_error_pre_umi, num_segments,
+                                    out_segments):
+    """1.25 B/position fallback of the wire dispatch (batches with >63
+    distinct quals): 2-bit packed codes + sentinel quals, split packed
+    output + fetch slice."""
+    winner, qual, _depth, _errors, suspect, _obs = _packed2_epilogue(
+        codes_packed, quals, seg_ids, correct_tab, err_tab,
+        ln_error_pre_umi, num_segments)
+    return _pack_result_split(winner, qual, suspect, out_segments)
+
+
+@_lazy_jit(static_argnames=("num_segments", "out_segments"))
+def _consensus_segments_packed2_full_jit(codes_packed, quals, seg_ids,
+                                         correct_tab, err_tab,
+                                         ln_error_pre_umi, num_segments,
+                                         out_segments):
+    """Full-column variant of the >63-distinct-quals fallback: same
+    on-device depth/error counts as _consensus_segments_wire_full_jit."""
+    winner, qual, depth, errors, suspect, _obs = _packed2_epilogue(
+        codes_packed, quals, seg_ids, correct_tab, err_tab,
+        ln_error_pre_umi, num_segments)
+    qs, wp = _pack_result_split(winner, qual, suspect, out_segments)
+    return (qs, wp, depth[:out_segments].astype(jnp.uint16),
+            errors[:out_segments].astype(jnp.uint16))
 
 
 def build_wire(codes2d: np.ndarray, quals2d: np.ndarray, delta94: np.ndarray):
@@ -1560,7 +1812,9 @@ class ConsensusKernel:
 
     def device_call_segments_wire(self, codes2d_padded, quals2d_padded,
                                   seg_ids, num_segments: int, J: int,
-                                  pack_t0: float = None):
+                                  pack_t0: float = None, full: bool = False,
+                                  resident_thresholds=None,
+                                  pred_s: float = None):
         """Async wire-format dispatch via the feeder pipeline.
 
         codes2d_padded/quals2d_padded: the full padded (N_pad, L) row layout
@@ -1574,7 +1828,17 @@ class ConsensusKernel:
         stable sequencer qual set re-uploads nothing). ``pack_t0``: when
         the caller timed its own gather/pad start, the timeline's pack_s
         covers it too. Resolve with
-        resolve_segments_wire(ticket, dense_codes, dense_quals, starts)."""
+        resolve_segments_wire(ticket, dense_codes, dense_quals, starts).
+
+        ``full=True`` selects the full-column kernels: depth/errors are
+        computed on device and fetched as uint16 (+4 B/column), so the
+        resolve never re-walks the dense rows — callers must gate on max
+        family size < 65536 (the engines do, from their counts arrays).
+        ``resident_thresholds=(min_reads, min_qual)`` additionally keeps
+        thresholded (tb, tq) + per-lane obs device-resident for the fused
+        duplex combine stage (wire layout only; the rare >63-qual fallback
+        ignores it and the combine runs on host). ``pred_s``: the cost
+        model's predicted dispatch seconds, stamped into the timeline."""
         t_pack0 = pack_t0 if pack_t0 is not None else time.monotonic()
         out_segments = _pad_out_segments(J, num_segments)
         w = build_wire(codes2d_padded, quals2d_padded, self._delta94)
@@ -1583,9 +1847,14 @@ class ConsensusKernel:
         if w is not None:
             wire, dict32 = w
             upload = wire.nbytes + seg_ids.nbytes
+            resident = resident_thresholds is not None
+            kind = "segwr" if resident else ("segwf" if full else "segw")
             new = SHAPE_REGISTRY.observe(
-                "segw", wire.shape[0], wire.shape[1], num_segments,
+                kind, wire.shape[0], wire.shape[1], num_segments,
                 out_segments)
+            if resident:
+                mr, mq = (np.int32(resident_thresholds[0]),
+                          np.int32(resident_thresholds[1]))
 
             def _dispatch(slot):
                 _ensure_jax()
@@ -1594,14 +1863,22 @@ class ConsensusKernel:
                 sd = jax.device_put(seg_ids)
                 dtab = CONST_CACHE.put("dict_tab", dict32)
                 DEVICE_STATS.note_upload(slot, time.monotonic() - t0)
+                if resident:
+                    out = _consensus_segments_wire_resident_jit(
+                        wd, sd, dtab, pre, mr, mq, num_segments,
+                        out_segments)
+                    return out[:4] + (ResidentHandles(out[4:]),)
+                if full:
+                    return _consensus_segments_wire_full_jit(
+                        wd, sd, dtab, pre, num_segments, out_segments)
                 return _consensus_segments_wire_jit(
                     wd, sd, dtab, pre, num_segments, out_segments)
         else:
             cp, qsent = pack_codes2(codes2d_padded, quals2d_padded)
             upload = cp.nbytes + qsent.nbytes + seg_ids.nbytes
             new = SHAPE_REGISTRY.observe(
-                "segp2", cp.shape[0], cp.shape[1], num_segments,
-                out_segments)
+                "segp2f" if full else "segp2", cp.shape[0], cp.shape[1],
+                num_segments, out_segments)
 
             def _dispatch(slot):
                 _ensure_jax()
@@ -1611,13 +1888,16 @@ class ConsensusKernel:
                 sd = jax.device_put(seg_ids)
                 ct, et = tables_dev()
                 DEVICE_STATS.note_upload(slot, time.monotonic() - t0)
-                return _consensus_segments_packed2_jit(
-                    cd, qd, sd, ct, et, pre, num_segments,
-                    out_segments)
+                fn = (_consensus_segments_packed2_full_jit if full
+                      else _consensus_segments_packed2_jit)
+                return fn(cd, qd, sd, ct, et, pre, num_segments,
+                          out_segments)
         DEVICE_STATS.add_dispatch(segments_flops(
             codes2d_padded.shape[0], codes2d_padded.shape[1], num_segments))
         slot = DEVICE_STATS.begin_in_flight(
             upload, pack_s=time.monotonic() - t_pack0)
+        if pred_s is not None:
+            DEVICE_STATS.note_pred(slot, pred_s)
         with SHAPE_REGISTRY.attribute_compiles(new):
             ticket = DEVICE_FEEDER.submit(
                 lambda: device_retry_call(lambda: _dispatch(slot),
@@ -1627,11 +1907,17 @@ class ConsensusKernel:
 
     def resolve_segments_wire(self, ticket, codes2d: np.ndarray,
                               quals2d: np.ndarray, starts: np.ndarray,
-                              _split_depth: int = 0):
+                              _split_depth: int = 0,
+                              want_extras: bool = False):
         """Fetch + complete a device_call_segments_wire ticket.
 
         Same contract as resolve_segments: (winner, qual, depth, errors)
         (J, L) arrays, suspects recomputed exactly by the f64 oracle. A
+        full-column dispatch carries device-computed depth/errors (no host
+        re-walk of the dense rows); a classic 2-tuple recomputes them here.
+        ``want_extras=True`` appends a 5th element: a dict with the raw
+        ``suspect`` mask and the ``resident`` device handles (both None on
+        any degraded path) for the fused duplex combine stage. A
         dispatch/fetch failure that survived the feeder's bounded retry
         degrades instead of raising: RESOURCE_EXHAUSTED batches are halved
         and re-dispatched (output order preserved), anything else falls
@@ -1639,10 +1925,18 @@ class ConsensusKernel:
         t0 = time.monotonic()
         fetched = 0
         failure = None
+        d16 = e16 = resident = None
         try:
             dev = ticket.wait()
-            qs, wp = DEVICE_STATS.fetch(dev)
-            fetched = qs.nbytes + wp.nbytes
+            if isinstance(dev[-1], ResidentHandles):
+                resident = dev[-1]
+                dev = dev[:-1]
+            got = DEVICE_STATS.fetch(dev)
+            if len(got) == 4:
+                qs, wp, d16, e16 = got
+            else:
+                qs, wp = got
+            fetched = sum(g.nbytes for g in got)
         except BaseException as e:  # noqa: BLE001 - recovered below
             failure = e
         finally:
@@ -1660,32 +1954,61 @@ class ConsensusKernel:
             # propagate (in-flight accounting above already balanced)
             if not (_is_oom(failure) or _is_transient(failure)):
                 raise failure
-            return self._recover_segments(failure, codes2d, quals2d,
-                                          starts, _split_depth)
+            out = self._recover_segments(failure, codes2d, quals2d,
+                                         starts, _split_depth)
+            if want_extras:
+                return out + ({"suspect": None, "resident": None},)
+            return out
+        # feed the offload cost model with this dispatch's measured pieces
+        # (docs/device-datapath.md "Adaptive offload policy"). Slots past
+        # the timeline cap have no entry — skip the feed rather than
+        # polluting the EWMAs with degenerate zero samples.
+        tl = DEVICE_STATS.timeline_entry(ticket.slot)
+        if tl is not None:
+            up_s = tl.get("upload_s", 0.0)
+            wait_s = tl.get("fetch_wait_s", 0.0)
+            from .router import ROUTER
+
+            # service time = upload + fetch wait (the dispatch's serial
+            # occupancy of the feeder+link); queue wait is priced
+            # separately by decide()'s in_flight term, so it must not be
+            # folded in here
+            ROUTER.observe_device(ticket.upload_bytes, fetched, up_s,
+                                  wait_s, up_s + wait_s)
         J = len(starts) - 1
         if J == 0:
             L = qs.shape[-1]
             z = np.zeros((0, L))
-            return (z.astype(np.uint8), z.astype(np.uint8),
-                    z.astype(np.int64), z.astype(np.int64))
+            out = (z.astype(np.uint8), z.astype(np.uint8),
+                   z.astype(np.int64), z.astype(np.int64))
+            if want_extras:
+                return out + ({"suspect": None, "resident": resident},)
+            return out
         winner, qual, suspect = unpack_result_split(qs, wp, J)
-        from ..native import batch as nb
-
-        if nb.available():
-            # int32 end to end (host_kernel.call_segments_counted keeps the
-            # same dtype): every consumer is dtype-agnostic, so the old
-            # whole-(J,L) int64 casts were pure memory traffic
-            depth, errors = nb.segment_depth_errors(codes2d, winner, starts)
+        if d16 is not None:
+            # full-column dispatch: the device already counted depth/errors
+            # (exact integer lane sums); the dense rows are not re-walked
+            depth = d16[:J].astype(np.int32)
+            errors = e16[:J].astype(np.int32)
         else:
-            valid = (codes2d != N_CODE).astype(np.int32)
-            depth = np.add.reduceat(valid, starts[:-1], axis=0)
-            counts = np.diff(starts)
-            winner_rows = np.repeat(winner, counts, axis=0)
-            match = ((codes2d == winner_rows)
-                     & (codes2d != N_CODE)).astype(np.int32)
-            errors = depth - np.add.reduceat(match, starts[:-1], axis=0)
+            from ..native import batch as nb
+
+            if nb.available():
+                # int32 end to end (host_kernel.call_segments_counted keeps
+                # the same dtype): every consumer is dtype-agnostic, so the
+                # old whole-(J,L) int64 casts were pure memory traffic
+                depth, errors = nb.segment_depth_errors(codes2d, winner,
+                                                        starts)
+            else:
+                valid = (codes2d != N_CODE).astype(np.int32)
+                depth = np.add.reduceat(valid, starts[:-1], axis=0)
+                counts = np.diff(starts)
+                winner_rows = np.repeat(winner, counts, axis=0)
+                match = ((codes2d == winner_rows)
+                         & (codes2d != N_CODE)).astype(np.int32)
+                errors = depth - np.add.reduceat(match, starts[:-1], axis=0)
         # no-call: depth==0 is not encodable in the 2-bit winner — restore it
-        # from the host-side depth (device guaranteed qual=MIN_PHRED there)
+        # from the depth counts (device guaranteed qual=MIN_PHRED there)
         no_call = depth == 0
         if no_call.any():
             winner[no_call] = N_CODE
@@ -1697,6 +2020,9 @@ class ConsensusKernel:
                 suspect, winner, qual, depth, errors,
                 lambda f: (codes2d[starts[f]:starts[f + 1]],
                            quals2d[starts[f]:starts[f + 1]]))
+        if want_extras:
+            return winner, qual, depth, errors, {"suspect": suspect,
+                                                 "resident": resident}
         return winner, qual, depth, errors
 
     def _recover_segments(self, exc, codes2d: np.ndarray,
@@ -1762,8 +2088,12 @@ class ConsensusKernel:
             "batch of %d segments on the native f64 host engine",
             type(exc).__name__, exc, J)
         engine = self._host()
+        t0 = time.monotonic()
         winner, qual, depth, errors, n_slow = engine.call_segments_counted(
             codes2d, quals2d, starts)
+        from .router import ROUTER
+
+        ROUTER.observe_host(codes2d.size, time.monotonic() - t0)
         with self._counter_lock:
             self.total_positions += winner.size
             self.fallback_positions += n_slow
@@ -2000,8 +2330,12 @@ class ConsensusKernel:
         """
         if dev is HOST_DISPATCH:
             engine = self._host()
+            t0 = time.monotonic()
             winner, qual, depth, errors, n_slow = engine.call_segments_counted(
                 codes2d, quals2d, np.asarray(starts, dtype=np.int64))
+            from .router import ROUTER
+
+            ROUTER.observe_host(codes2d.size, time.monotonic() - t0)
             with self._counter_lock:
                 self.total_positions += winner.size
                 self.fallback_positions += n_slow
@@ -2106,3 +2440,124 @@ class ConsensusKernel:
                 depth[fi, pi] = d[c0:c1]
                 errors[fi, pi] = e[c0:c1]
                 c0 = c1
+
+
+def route_and_call_segments(kernel: "ConsensusKernel", codes2d, quals2d,
+                            counts, starts):
+    """Route one dense (N, L) segment batch through the adaptive offload
+    policy and resolve it synchronously: the host f64 engine, the round-5
+    hard-column export (FGUMI_TPU_DEVICE_PATH=columns), or the full-column
+    wire kernel (default device route). The one shared implementation of
+    the decide -> dispatch -> resolve sequence for the synchronous callers
+    (fast_codec, the classic vanilla path); the async engines (simplex
+    pending chunks, duplex defer/resident) keep their specialized flows
+    but share ROUTER.decide_batch and the same dispatch entry points."""
+    from .router import ROUTER
+
+    route = "host"
+    if not kernel.host_mode():
+        route = ROUTER.decide_batch(kernel, codes2d.shape[0], len(counts),
+                                    codes2d.shape[1])
+    if route == "host":
+        return kernel.resolve_segments(HOST_DISPATCH, codes2d, quals2d,
+                                       starts)
+    if device_path() == "columns":
+        pending = kernel.dispatch_hard_columns(codes2d, quals2d, starts)
+        return kernel.resolve_hard_columns(pending)
+    t_pack0 = time.monotonic()
+    cd, qd, seg_ids, _sp, f_pad = pad_segments(codes2d, quals2d, counts)
+    pred = ROUTER.last_prediction()
+    ticket = kernel.device_call_segments_wire(
+        cd, qd, seg_ids, f_pad, len(counts), pack_t0=t_pack0,
+        full=bool(np.max(counts) < 65536),
+        pred_s=pred[0] if pred else None)
+    return kernel.resolve_segments_wire(ticket, codes2d, quals2d, starts)
+
+
+# ------------------------------------------------------ fused device stages
+
+def duplex_combine_device(resident: "ResidentHandles", a_idx, b_idx, lens):
+    """Fused duplex strand-combine dispatch on stage-1 resident SS arrays.
+
+    a_idx/b_idx index rows of the resident (out_segments, L) arrays; lens
+    are the per-output combined lengths. Returns host
+    (out_b u8, out_q u8, out_e i32) arrays, byte-identical to the numpy
+    combine for rows whose inputs carry no oracle patch (the caller routes
+    suspect-touched rows to the host combine). Upload is just the three
+    index vectors; raises on device failure (caller falls back to host)."""
+    tb, tq, obs = resident.arrays
+    K = len(a_idx)
+    K_pad = SHAPE_REGISTRY.bucket(K, 8)
+    K_out = _pad_out_segments(K, K_pad)
+    ai = np.zeros(K_pad, dtype=np.int32)
+    bi = np.zeros(K_pad, dtype=np.int32)
+    ln = np.zeros(K_pad, dtype=np.int32)
+    ai[:K] = a_idx
+    bi[:K] = b_idx
+    ln[:K] = lens
+    L = int(tb.shape[1])
+    new = SHAPE_REGISTRY.observe("dupcomb", K_pad, L, K_out)
+    DEVICE_STATS.add_dispatch(K_pad * L * 24)
+    slot = DEVICE_STATS.begin_in_flight(ai.nbytes * 3)
+    t0 = time.monotonic()
+    try:
+        def _dispatch():
+            _ensure_jax()
+            return _duplex_combine_jit(tb, tq, obs, ai, bi, ln, K_out)
+
+        # attribute a first-sight-shape compile to the bucket miss, like
+        # every other dispatch site (warm-serve compiles==0 evidence)
+        with SHAPE_REGISTRY.attribute_compiles(new):
+            dev = device_retry_call(_dispatch, "duplex combine")
+        out_b, out_q, out_e = DEVICE_STATS.fetch(dev)
+        fetched = out_b.nbytes + out_q.nbytes + out_e.nbytes
+    except BaseException:
+        fetched = 0
+        raise
+    finally:
+        DEVICE_STATS.end_in_flight(slot, fetched, time.monotonic() - t0)
+    return out_b[:K], out_q[:K], out_e[:K]
+
+
+def codec_combine_device(ba, bb, qa, qb, da, db, ea, eb):
+    """CODEC concordance combine as a device dispatch.
+
+    Same contract as consensus/codec.combine_arrays over the batch
+    engine's concatenated 1-D position arrays (int32-capped inputs);
+    integer-exact vs the numpy version. Raises on device failure — the
+    caller falls back to the host combine."""
+    T = len(ba)
+    T_pad = SHAPE_REGISTRY.bucket(T, 16)
+    T_out = _pad_out_segments(T, T_pad)
+
+    def pad(a, dtype):
+        out = np.zeros(T_pad, dtype=dtype)
+        out[:T] = a
+        return out
+
+    ops = (pad(ba, np.uint8), pad(bb, np.uint8), pad(qa, np.uint8),
+           pad(qb, np.uint8), pad(da, np.int32), pad(db, np.int32),
+           pad(ea, np.int32), pad(eb, np.int32))
+    new = SHAPE_REGISTRY.observe("codeccomb", T_pad, T_out)
+    DEVICE_STATS.add_dispatch(T_pad * 40)
+    slot = DEVICE_STATS.begin_in_flight(sum(o.nbytes for o in ops))
+    t0 = time.monotonic()
+    try:
+        def _dispatch():
+            _ensure_jax()
+            return _codec_combine_jit(*ops, T_out)
+
+        with SHAPE_REGISTRY.attribute_compiles(new):
+            dev = device_retry_call(_dispatch, "codec combine")
+        got = DEVICE_STATS.fetch(dev)
+        fetched = sum(g.nbytes for g in got)
+    except BaseException:
+        fetched = 0
+        raise
+    finally:
+        DEVICE_STATS.end_in_flight(slot, fetched, time.monotonic() - t0)
+    # .copy(): device_get may hand back read-only buffers and the codec
+    # quality-mask pass writes into cq in place
+    base, qual, depth, errors, both, disag = got
+    return (base[:T].copy(), qual[:T].copy(), depth[:T].copy(),
+            errors[:T].copy(), both[:T].copy(), disag[:T].copy())

@@ -1,0 +1,365 @@
+"""Adaptive host/device offload policy (ROADMAP item 1, round 6).
+
+The classify-and-export-hard-columns design answered a 0.4-76 MB/s tunnel
+by keeping ~86% of the consensus arithmetic on the host; with the constant
+cache, the shape-bucket ladder, and the pipelined feeder in place the right
+split is no longer a compile-time constant — it is a per-batch economic
+decision. This module holds that decision in one place:
+
+- :class:`OffloadRouter` — routes each consensus batch ``device`` (the
+  full-column 1-byte-wire kernel) or ``host`` (the native f64 engine) from
+  an online cost model: EWMAs of the measured upload link rate, the
+  per-dispatch device overhead (compute + transfer + relay latency, the
+  part that does not scale with bytes), and the host engine's measured
+  throughput in pileup cells/s. The predicted times
+
+      t_device = up_bytes/link + down_bytes/link + overhead
+                 + in_flight * ewma_dispatch_wall          (queue delay)
+      t_host   = cells / host_cells_per_s
+
+  are compared per batch, so a mixed-family config lands on the winning
+  side of its crossover automatically instead of by a static threshold.
+  Every route is byte-identical by construction (the device path patches
+  its suspects through the f64 oracle; the host path IS the f64 engine),
+  so routing is a pure performance decision — including the probe batches
+  the model occasionally sends to the losing side to keep both EWMAs live.
+
+- :class:`AdaptiveChooser` — the same idea for cheap elementwise stages
+  (the duplex strand-combine / CODEC concordance device stages): EWMA of
+  seconds-per-cell on each side, alternate probes until both sides are
+  measured, then pick the predicted winner with a periodic refresh probe.
+
+Env contract (docs/performance-tuning.md):
+
+- ``FGUMI_TPU_ROUTE=device|host|auto`` — force every batch to one side, or
+  (default ``auto``) let the cost model decide. ``host`` falls back to
+  ``device`` with a warning when the native engine is unavailable.
+- ``FGUMI_TPU_MAX_INFLIGHT`` — when set explicitly, the pre-round-6 static
+  backlog policy is honored verbatim (``0`` = always host; otherwise
+  device unless that many dispatches are already in flight). Unset =
+  adaptive (the backlog folds into the queue-delay term instead).
+- ``FGUMI_TPU_ROUTE_PROBE`` — probe period (default 64): after this many
+  consecutive same-side routes one batch goes to the other side so its
+  EWMA tracks the link weather. ``0`` disables probing.
+
+Like the datapath singletons, the measured rates are per-process facts
+(the link and the host are shared by every job); the per-scope route
+*counters* land in METRICS/DeviceStats via the callers.
+"""
+
+import os
+import threading
+
+import numpy as np  # noqa: F401  (kept: callers pass numpy scalars)
+
+#: EWMA smoothing for rate estimates: ~the last dozen batches dominate.
+ALPHA = 0.2
+#: default probe period (batches of one side before sampling the other)
+DEFAULT_PROBE = 64
+
+
+def _env_route():
+    v = os.environ.get("FGUMI_TPU_ROUTE", "auto").strip().lower()
+    return v if v in ("device", "host", "auto") else "auto"
+
+
+class _Ewma:
+    __slots__ = ("value", "samples")
+
+    def __init__(self):
+        self.value = None
+        self.samples = 0
+
+    def add(self, x: float):
+        x = float(x)
+        self.value = x if self.value is None else \
+            (1.0 - ALPHA) * self.value + ALPHA * x
+        self.samples += 1
+
+    def get(self, default: float):
+        return self.value if self.value is not None else default
+
+
+class OffloadRouter:
+    """Per-batch device/host routing for the consensus engines."""
+
+    # priors used before the first measurement lands: a mid-range tunnel
+    # (10 MB/s) and the host engine's order of magnitude (20M cells/s) —
+    # they only steer the first handful of batches, after which measured
+    # EWMAs take over.
+    PRIOR_LINK_BPS = 10e6
+    PRIOR_HOST_CELLS_PER_S = 20e6
+    PRIOR_OVERHEAD_S = 0.05
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._tls = threading.local()  # per-thread last prediction
+        self._warned_no_host = False
+        self.reset()
+
+    def reset(self):
+        with self._lock:
+            self._link_bps = _Ewma()       # upload bytes/s (device_put wall)
+            self._overhead_s = _Ewma()     # per-dispatch non-byte-scaling s
+            self._dispatch_wall_s = _Ewma()  # per-dispatch service time
+            self._host_cps = _Ewma()       # host engine cells/s
+            self._streak_side = None
+            self._streak = 0
+            self._last = {}                # last decision detail (snapshot)
+
+    # ------------------------------------------------------------ feeding
+
+    def observe_device(self, up_bytes: int, down_bytes: int,
+                       upload_s: float, other_s: float, service_s: float):
+        """One resolved device dispatch. ``other_s`` is the non-upload,
+        non-queue remainder (host fetch wait in practice); the download
+        time it contains is netted out against the link estimate before
+        feeding the overhead EWMA, since decide() prices down_bytes/link
+        as its own term — without the subtraction the download would be
+        charged twice and the device systematically overpriced near the
+        crossover. ``service_s`` is the dispatch's serial occupancy of the
+        feeder+link (upload + fetch wait), NOT including queue wait —
+        decide() multiplies it by the in-flight count for the queue-delay
+        term, so queue time must not be baked in twice."""
+        with self._lock:
+            if upload_s > 1e-6 and up_bytes > 0:
+                self._link_bps.add(up_bytes / upload_s)
+            link = self._link_bps.value
+            if other_s >= 0:
+                if link and down_bytes > 0:
+                    other_s = max(other_s - down_bytes / link, 0.0)
+                self._overhead_s.add(other_s)
+            if service_s > 0:
+                self._dispatch_wall_s.add(service_s)
+
+    def observe_host(self, cells: int, seconds: float):
+        """One host-engine batch (cells = rows * positions of the pileup)."""
+        if seconds > 1e-6 and cells > 0:
+            with self._lock:
+                self._host_cps.add(cells / seconds)
+
+    # ----------------------------------------------------------- deciding
+
+    @staticmethod
+    def _probe_period():
+        try:
+            return max(int(os.environ.get("FGUMI_TPU_ROUTE_PROBE",
+                                          str(DEFAULT_PROBE))), 0)
+        except ValueError:
+            return DEFAULT_PROBE
+
+    def decide_batch(self, kernel, n_rows: int, n_segments: int,
+                     L: int) -> str:
+        """Route one consensus batch from its shape — the one place that
+        knows the wire-path economics: upload is 1 B/position of dense rows
+        plus 4 B/row of segment ids; the full-column fetch is 5.25 B/column
+        (qual|suspect byte + 2-bit winner + uint16 depth + uint16 errors);
+        host cost scales with the pileup cells (rows x positions)."""
+        return self.decide(kernel, n_rows * L + 4 * n_rows,
+                           (21 * n_segments * L) // 4, n_rows * L)
+
+    def decide(self, kernel, up_bytes: int, down_bytes: int,
+               cells: int) -> str:
+        """Route one batch: ``"device"`` or ``"host"``.
+
+        ``kernel`` supplies the mode gates (hybrid/native availability);
+        callers have already excluded host_mode(). The decision and its
+        inputs are stamped into METRICS (``device.route.*``) so a wrong
+        crossover is diagnosable from any run report.
+        """
+        from ..native import batch as nb
+        from .kernel import DEVICE_STATS, default_max_inflight, log
+
+        self._tls.pred = None  # only the cost branch produces a prediction
+        forced = _env_route()
+        if forced == "host":
+            # an explicit ROUTE=host wins over FGUMI_TPU_HYBRID=0 (the
+            # newer, more specific knob); only a missing native engine can
+            # override it, and loudly
+            if nb.available():
+                return self._stamp("host", forced=True, why="forced")
+            if not self._warned_no_host:  # once, not per batch
+                self._warned_no_host = True
+                log.warning("FGUMI_TPU_ROUTE=host but the native f64 engine "
+                            "is unavailable; routing to the device")
+            forced = "device"
+        can_host = nb.available() and kernel.hybrid_mode()
+        if forced == "device" or not can_host:
+            return self._stamp("device", forced=forced != "auto",
+                               why="forced" if forced == "device"
+                               else "no-host-engine")
+
+        env_cap = os.environ.get("FGUMI_TPU_MAX_INFLIGHT", "").strip()
+        if env_cap:
+            # legacy static policy, honored verbatim when explicitly set
+            cap = default_max_inflight()
+            side = "host" if (cap <= 0
+                              or DEVICE_STATS.in_flight_count() >= cap) \
+                else "device"
+            return self._stamp(side, why="max-inflight")
+
+        with self._lock:
+            link = self._link_bps.get(self.PRIOR_LINK_BPS)
+            overhead = self._overhead_s.get(self.PRIOR_OVERHEAD_S)
+            host_cps = self._host_cps.get(self.PRIOR_HOST_CELLS_PER_S)
+            wall = self._dispatch_wall_s.get(overhead)
+            host_samples = self._host_cps.samples
+            dev_samples = self._overhead_s.samples
+        in_flight = DEVICE_STATS.in_flight_count()
+        t_dev = (up_bytes + down_bytes) / link + overhead + in_flight * wall
+        t_host = cells / host_cps
+        self._tls.pred = (t_dev, t_host)
+        side = "device" if t_dev <= t_host else "host"
+        why = "cost"
+        # keep both EWMAs alive: sample the unmeasured/stale side
+        probe = self._probe_period()
+        if side == "device" and host_samples == 0 and dev_samples >= 2:
+            side, why = "host", "probe-unmeasured"
+        elif probe:
+            with self._lock:
+                streak = self._streak if self._streak_side == side else 0
+            if streak >= probe:
+                side = "host" if side == "device" else "device"
+                why = "probe-refresh"
+        return self._stamp(side, why=why, t_dev=t_dev, t_host=t_host,
+                           link_bps=link, host_cps=host_cps,
+                           overhead_s=overhead, in_flight=in_flight)
+
+    def _stamp(self, side, forced=False, why="", t_dev=None, t_host=None,
+               link_bps=None, host_cps=None, overhead_s=None, in_flight=0):
+        from ..observe.metrics import METRICS
+
+        with self._lock:
+            if self._streak_side == side:
+                self._streak += 1
+            else:
+                self._streak_side, self._streak = side, 1
+            self._last = {"side": side, "why": why, "forced": forced}
+            if t_dev is not None:
+                self._last.update(pred_device_s=round(t_dev, 5),
+                                  pred_host_s=round(t_host, 5))
+        from .kernel import DEVICE_STATS
+
+        METRICS.inc(f"device.route.{side}")
+        DEVICE_STATS.add_route(side)
+        if t_dev is not None:
+            METRICS.set("device.route.pred_device_ms", round(t_dev * 1e3, 3))
+            METRICS.set("device.route.pred_host_ms", round(t_host * 1e3, 3))
+        if link_bps is not None:
+            METRICS.set("device.route.link_mbps", round(link_bps / 1e6, 3))
+            METRICS.set("device.route.host_mcells_per_s",
+                        round(host_cps / 1e6, 3))
+        return side
+
+    def last_prediction(self):
+        """(pred_device_s, pred_host_s) of THIS THREAD's latest cost-model
+        decision, or None when it was forced/stamp-free — thread-local so a
+        concurrent engine thread's decision cannot be paired with the wrong
+        dispatch in the predicted-vs-actual timeline stamps."""
+        return getattr(self._tls, "pred", None)
+
+    # ----------------------------------------------------------- snapshot
+
+    def snapshot(self):
+        """Cost-model state for run reports / bench stamps."""
+        with self._lock:
+            out = {
+                "link_mbps": round(self._link_bps.get(0.0) / 1e6, 3),
+                "link_samples": self._link_bps.samples,
+                "overhead_s": round(self._overhead_s.get(0.0), 5),
+                "dispatch_wall_s": round(self._dispatch_wall_s.get(0.0), 5),
+                "host_mcells_per_s": round(self._host_cps.get(0.0) / 1e6, 3),
+                "host_samples": self._host_cps.samples,
+            }
+            if self._last:
+                out["last_decision"] = dict(self._last)
+            return out
+
+
+class AdaptiveChooser:
+    """Two-sided seconds-per-cell chooser for elementwise device stages.
+
+    Used by the duplex strand-combine and CODEC concordance stages: both
+    sides produce byte-identical output, so the chooser alternates probes
+    until each side has two samples, then picks the predicted winner with
+    a refresh probe every ``FGUMI_TPU_ROUTE_PROBE`` decisions. An env
+    override (passed per call: ``"device"``/``"host"``) always wins."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._spc = {"device": _Ewma(), "host": _Ewma()}
+        self._streak_side = None
+        self._streak = 0
+
+    def observe(self, side: str, cells: int, seconds: float):
+        if cells > 0 and seconds >= 0:
+            with self._lock:
+                self._spc[side].add(seconds / cells)
+
+    def decide(self, cells: int, override: str = "auto") -> str:
+        from ..observe.metrics import METRICS
+
+        if override in ("device", "host"):
+            METRICS.inc(f"device.route.{self.name}.{override}")
+            return override
+        probe = OffloadRouter._probe_period()
+        with self._lock:
+            d, h = self._spc["device"], self._spc["host"]
+            if d.samples < 2 or h.samples < 2:
+                # alternate until both sides are measured
+                side = "device" if d.samples <= h.samples else "host"
+            else:
+                side = "device" if d.value <= h.value else "host"
+                if probe and self._streak_side == side \
+                        and self._streak >= probe:
+                    side = "host" if side == "device" else "device"
+            if self._streak_side == side:
+                self._streak += 1
+            else:
+                self._streak_side, self._streak = side, 1
+        METRICS.inc(f"device.route.{self.name}.{side}")
+        return side
+
+    def snapshot(self):
+        with self._lock:
+            return {side: {"s_per_mcell": round(e.get(0.0) * 1e6, 6),
+                           "samples": e.samples}
+                    for side, e in self._spc.items()}
+
+
+def run_adaptive_stage(chooser: AdaptiveChooser, cells: int, override: str,
+                       device_fn, host_fn):
+    """Run one elementwise stage on the chooser's preferred side under the
+    shared degrade contract: whichever side runs is timed and fed to its
+    EWMA; a transient/OOM device failure is charged to the device side
+    (including its retry/backoff time — the chooser must learn, not
+    re-try a dead stage every batch), warned once per occurrence, and
+    falls back to ``host_fn``; non-device-weather errors re-raise.
+    Returns (result, side-that-produced-it)."""
+    import time
+
+    from .kernel import _is_oom, _is_transient, log
+
+    if cells > 0 and chooser.decide(cells, override) == "device":
+        t0 = time.monotonic()
+        try:
+            out = device_fn()
+            chooser.observe("device", cells, time.monotonic() - t0)
+            return out, "device"
+        except BaseException as e:  # noqa: BLE001 - classified below
+            if not (_is_oom(e) or _is_transient(e)):
+                raise
+            chooser.observe("device", cells, time.monotonic() - t0)
+            log.warning("%s device stage failed (%s: %s); using the host "
+                        "path", chooser.name, type(e).__name__, e)
+    t0 = time.monotonic()
+    out = host_fn()
+    chooser.observe("host", cells, time.monotonic() - t0)
+    return out, "host"
+
+
+#: process-wide singletons (measured rates are per-process facts)
+ROUTER = OffloadRouter()
+DUPLEX_COMBINE = AdaptiveChooser("duplex_combine")
+CODEC_COMBINE = AdaptiveChooser("codec_combine")

@@ -86,6 +86,48 @@ def _family_pileup(rng, n_fam, fam, L):
     return codes, quals
 
 
+def bench_full_column(out):
+    """Full-column wire kernel vs native host engine at 3 family-size
+    profiles (ISSUE 6 satellite): the measured rows/s on each side are the
+    crossover constants the offload cost model's EWMAs converge to, made
+    reproducible from one command. wire = pad + 1 B/position dispatch +
+    full resolve (device depth/errors, no host re-walk); host = the native
+    f64 engine on the same pileups."""
+    import numpy as np
+
+    from fgumi_tpu.native import batch as nb
+    from fgumi_tpu.ops.host_kernel import HostConsensusEngine
+    from fgumi_tpu.ops.kernel import ConsensusKernel, pad_segments
+    from fgumi_tpu.ops.tables import quality_tables
+
+    tabs = quality_tables(45, 40)
+    kernel = ConsensusKernel(tabs)
+    kernel.set_force_device()
+    host = HostConsensusEngine(tabs) if nb.available() else None
+    rng = np.random.default_rng(11)
+    L = 100
+    for fam, n_fam in ((3, 4000), (10, 1600), (30, 600)):
+        codes, quals = _family_pileup(rng, n_fam, fam, L)
+        counts = np.full(n_fam, fam, dtype=np.int64)
+        starts = (np.arange(n_fam + 1) * fam).astype(np.int64)
+
+        def wire():
+            cd, qd, seg, _st, F = pad_segments(codes, quals, counts)
+            t = kernel.device_call_segments_wire(cd, qd, seg, F, n_fam,
+                                                 full=True)
+            kernel.resolve_segments_wire(t, codes, quals, starts)
+
+        dt = _timeit(wire)
+        rows = n_fam * fam
+        out[f"full_column_fam{fam}_wire_s"] = round(dt, 4)
+        out[f"full_column_fam{fam}_wire_rows_per_sec"] = round(rows / dt, 1)
+        if host is not None:
+            dth = _timeit(lambda: host.call_segments(codes, quals, starts))
+            out[f"full_column_fam{fam}_host_rows_per_sec"] = round(
+                rows / dth, 1)
+            out[f"full_column_fam{fam}_device_vs_host"] = round(dth / dt, 3)
+
+
 def bench_datapath(out):
     """Dispatch-prep regression bench: operand preparation must be a no-op
     for the common already-contiguous case (the old unconditional
@@ -329,6 +371,7 @@ def main():
         simulate_grouped_bam(bam, num_families=20000, family_size=5,
                              read_length=100, seed=17)
         for section in (bench_kernel,
+                        bench_full_column,
                         bench_datapath,
                         bench_chain,
                         bench_host_engine,

@@ -179,7 +179,9 @@ def device_parity_runs(grouped_bam, tmp_path_factory):
     base = tmp_path_factory.mktemp("parity")
     d = base / "clean"
     d.mkdir()
-    env = {"FGUMI_TPU_HOST_ENGINE": "0"}
+    # FGUMI_TPU_ROUTE=device: the adaptive cost model would price these
+    # small workloads host-side and the device fault points would not fire
+    env = {"FGUMI_TPU_HOST_ENGINE": "0", "FGUMI_TPU_ROUTE": "device"}
     p = _run_cli(["simplex", "-i", grouped_bam, "-o", str(d / "out.bam"),
                   "--min-reads", "1"], env)
     assert p.returncode == 0, p.stderr
@@ -194,7 +196,7 @@ def test_device_dispatch_retry_byte_identical(device_parity_runs):
     d.mkdir()
     p = _run_cli(["simplex", "-i", inp, "-o", str(d / "out.bam"),
                   "--min-reads", "1"],
-                 {"FGUMI_TPU_HOST_ENGINE": "0",
+                 {"FGUMI_TPU_HOST_ENGINE": "0", "FGUMI_TPU_ROUTE": "device",
                   "FGUMI_TPU_FAULT": "device.dispatch:raise:1.0:2"})
     assert p.returncode == 0, p.stderr
     assert "retry" in p.stderr  # the retry path actually engaged
@@ -213,7 +215,7 @@ def test_device_dispatch_exhausted_falls_back_to_host(device_parity_runs):
     d.mkdir()
     p = _run_cli(["simplex", "-i", inp, "-o", str(d / "out.bam"),
                   "--min-reads", "1"],
-                 {"FGUMI_TPU_HOST_ENGINE": "0",
+                 {"FGUMI_TPU_HOST_ENGINE": "0", "FGUMI_TPU_ROUTE": "device",
                   "FGUMI_TPU_DEVICE_BACKOFF_S": "0.01",
                   "FGUMI_TPU_FAULT": "device.dispatch:raise:1.0"})
     assert p.returncode == 0, p.stderr

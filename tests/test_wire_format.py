@@ -329,8 +329,15 @@ from fgumi_tpu.ops import kernel as K
 def boom(*a, **kw):
     raise RuntimeError("injected device failure")
 
+# break every whole-batch device kernel the engines can route to: the
+# full-column wire kernels (round-6 default) and the hard-column export
 K._consensus_columns_wire_jit = boom
 K._consensus_columns_raw_jit = boom
+K._consensus_segments_wire_jit = boom
+K._consensus_segments_wire_full_jit = boom
+K._consensus_segments_wire_resident_jit = boom
+K._consensus_segments_packed2_jit = boom
+K._consensus_segments_packed2_full_jit = boom
 from fgumi_tpu.cli import main
 try:
     rc = main(["simplex", "-i", %(sim)r, "-o", %(out)r, "--min-reads", "1",
@@ -347,6 +354,9 @@ print("INFLIGHT-OK")
         timeout=300,
         env={**os.environ, "PYTHONPATH": REPO,
              "FGUMI_TPU_HOST_ENGINE": "0", "JAX_PLATFORMS": "cpu",
+             # force the device route: the adaptive cost model would price
+             # this tiny workload host-side and never hit the broken kernels
+             "FGUMI_TPU_ROUTE": "device",
              # conftest exports an 8-device XLA_FLAGS: without clearing it
              # the CLI auto-meshes and takes the sharded (unpatched) path
              "XLA_FLAGS": "",

@@ -177,6 +177,64 @@ def report_scenario(tmp):
     return ok
 
 
+def full_column_scenario(tmp):
+    """Round-6 gates: the full-column device route is the default device
+    path (one link crossing per family batch), routing counters land in
+    the run report, both forced routes are byte-identical, and a faulting
+    device degrades to the host engine cleanly (exit 0, same bytes)."""
+    grouped = os.path.join(tmp, "fc_grouped.bam")
+    p = run_cli(["simulate", "grouped-reads", "-o", grouped,
+                 "--num-families", "200", "--family-size", "4",
+                 "--seed", "11"])
+    assert p.returncode == 0, p.stderr
+    out_bam = os.path.join(tmp, "fc_cons.bam")
+    rpt = os.path.join(tmp, "fc.report.json")
+    # hybrid on (native host engine available) so routing is a real choice
+    hybrid = {"FGUMI_TPU_HYBRID": "1"}
+
+    p = run_cli(["--run-report", rpt, "simplex", "-i", grouped, "-o",
+                 out_bam, "--min-reads", "1"],
+                {**hybrid, "FGUMI_TPU_ROUTE": "device"})
+    ok = check("full-column device run exits 0", p.returncode == 0,
+               f"rc={p.returncode}")
+    if not ok:
+        return False
+    dev_bytes = open(out_bam, "rb").read()
+    report = json.load(open(rpt))
+    dev = report.get("device", {})
+    m = report.get("metrics", {})
+    ok &= check("one link crossing per routed family batch",
+                dev.get("dispatches", 0) >= 1
+                and dev.get("dispatches") == dev.get("route_device"),
+                f"dispatches={dev.get('dispatches')} "
+                f"route_device={dev.get('route_device')}")
+    ok &= check("report metrics carry device.route.*",
+                m.get("device.route.device", 0) >= 1)
+    ok &= check("device section carries cost-model snapshot",
+                isinstance(dev.get("routing"), dict)
+                and "link_mbps" in dev.get("routing", {}))
+
+    # identical argv (the @PG CL header line records it) — only env differs
+    p = run_cli(["--run-report", rpt, "simplex", "-i", grouped, "-o",
+                 out_bam, "--min-reads", "1"],
+                {**hybrid, "FGUMI_TPU_ROUTE": "host"})
+    ok &= check("forced-host run exits 0", p.returncode == 0)
+    ok &= check("forced device/host routes byte-identical",
+                open(out_bam, "rb").read() == dev_bytes)
+
+    p = run_cli(["--run-report", rpt, "simplex", "-i", grouped, "-o",
+                 out_bam, "--min-reads", "1"],
+                {**hybrid, "FGUMI_TPU_ROUTE": "device",
+                 "FGUMI_TPU_DEVICE_BACKOFF_S": "0.01",
+                 "FGUMI_TPU_FAULT": "device.dispatch:raise:1.0"})
+    ok &= check("faulting device degrades cleanly (exit 0)",
+                p.returncode == 0, f"rc={p.returncode}")
+    ok &= check("fallback engaged loudly", "host engine" in p.stderr)
+    ok &= check("degraded run byte-identical",
+                open(out_bam, "rb").read() == dev_bytes)
+    return ok
+
+
 def bad_spec_scenario(tmp):
     p = run_cli(["--shape-buckets", "0.5", "sort", "-i", "x", "-o",
                  os.path.join(tmp, "never.bam")])
@@ -195,6 +253,7 @@ def main():
     try:
         ok &= two_dispatch_scenario()
         ok &= report_scenario(tmp)
+        ok &= full_column_scenario(tmp)
         ok &= bad_spec_scenario(tmp)
     finally:
         if opts.keep:

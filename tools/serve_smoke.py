@@ -41,9 +41,11 @@ BASE_ENV = {
     "JAX_PLATFORMS": "cpu",
     "XLA_FLAGS": "",
     "PALLAS_AXON_POOL_IPS": "",
-    # force the device kernel so warm-vs-cold compile evidence exists even
-    # on a CPU-only host
+    # force the device kernel AND the device route so warm-vs-cold compile
+    # evidence exists even on a CPU-only host (the adaptive offload policy
+    # would price these tiny jobs host-side and dispatch nothing)
     "FGUMI_TPU_HOST_ENGINE": "0",
+    "FGUMI_TPU_ROUTE": "device",
 }
 
 

@@ -132,7 +132,10 @@ def main():
         p = run(["--trace", trace2, "--run-report", rpt2, "simplex",
                  "-i", grouped, "-o", os.path.join(tmp, "cons.bam"),
                  "--min-reads", "1", "--threads", "4"],
-                env={"FGUMI_TPU_HOST_ENGINE": "0"})
+                # force the device route: the adaptive offload policy would
+                # price this tiny workload host-side and emit no device spans
+                env={"FGUMI_TPU_HOST_ENGINE": "0",
+                     "FGUMI_TPU_ROUTE": "device"})
         ok &= check("simplex (device) exits 0", p.returncode == 0,
                     f"rc={p.returncode}")
         got = load_trace(trace2)

@@ -98,6 +98,12 @@ def dispatch_breakdown():
     agg["route_host"] = DEVICE_STATS.route_host
     from fgumi_tpu.ops.router import ROUTER
     agg["routing"] = ROUTER.snapshot()
+    # self-healing evidence (ISSUE 7): dispatches abandoned at their
+    # deadline and the breaker's state/transition history — a wedged-chip
+    # capture now explains its own degradation instead of timing out
+    agg["deadline_fallbacks"] = DEVICE_STATS.deadline_fallbacks
+    from fgumi_tpu.ops.breaker import BREAKER
+    agg["breaker"] = BREAKER.snapshot()
     pva = []
     for t in tl:
         if "pred_s" in t and "t_fetched" in t:
@@ -165,6 +171,15 @@ CPU_ENV = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
            # executables come from the persistent compilation cache
            "TF_CPP_MIN_LOG_LEVEL": "3"}
 
+# Device-attempt env: the dispatch-deadline/breaker layer armed tight.
+# Round 5 lost its whole bench window to two 600 s device timeouts; with a
+# deadline, a wedged dispatch is abandoned in <=90 s, the batch completes
+# byte-identically on the host engine, and the capture records
+# deadline_fallbacks + breaker transitions instead of vanishing into a
+# subprocess timeout. An explicit FGUMI_TPU_DISPATCH_DEADLINE_S wins.
+DEVICE_ENV = {"FGUMI_TPU_DISPATCH_DEADLINE_S":
+              os.environ.get("FGUMI_TPU_DISPATCH_DEADLINE_S", "20:90")}
+
 
 class DeviceTrier:
     """Probe-gated device measurements, retryable across the bench window.
@@ -231,7 +246,7 @@ class DeviceTrier:
             and self._remaining() > 300)
         if want_simplex and self._remaining() > 120:
             res, err = run_worker(
-                sim_bam, threads, {},
+                sim_bam, threads, DEVICE_ENV,
                 min(self.run_timeout, max(self._remaining(), 60)))
             self._simplex_tries += 1
             if res is not None and (self.simplex is None
@@ -266,7 +281,7 @@ class DeviceTrier:
                 and self._remaining() > 300))
         if want_duplex and self._remaining() > 120:
             res, err = run_worker(
-                dup_bam, threads, {},
+                dup_bam, threads, DEVICE_ENV,
                 min(self.run_timeout, max(self._remaining(), 60)),
                 cmd="duplex")
             self._duplex_tries += 1
@@ -281,7 +296,7 @@ class DeviceTrier:
             # bench must carry a TPU attempt for the ragged mixed-family
             # config, not silently route around the accelerator)
             res, err = run_worker(
-                mixed_bam, threads, {},
+                mixed_bam, threads, DEVICE_ENV,
                 min(self.run_timeout, max(self._remaining(), 60)))
             if res is not None:
                 self.mixed = res

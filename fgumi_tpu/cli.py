@@ -3109,6 +3109,17 @@ def _add_serve(sub):
     p.add_argument("--no-warmup", action="store_true",
                    help="skip the startup jax import/device touch (first "
                         "job pays cold start instead)")
+    p.add_argument("--journal", default=None, metavar="PATH",
+                   help="append-only job journal (JSONL WAL): submits and "
+                        "state transitions are fsync'd here, and on "
+                        "restart incomplete jobs are requeued in order "
+                        "(docs/serving.md crash recovery). Unset = "
+                        "in-memory only, the pre-journal behavior")
+    p.add_argument("--health-period", type=float, default=None,
+                   metavar="S",
+                   help="run a tiny device canary every S seconds feeding "
+                        "the wedge circuit breaker (default: "
+                        "FGUMI_TPU_HEALTH_PERIOD_S, else off)")
     p.set_defaults(func=cmd_serve)
 
 
@@ -3134,12 +3145,19 @@ def cmd_serve(args):
         except OSError as e:
             log.error("cannot create --report-dir %s: %s", args.report_dir, e)
             return 2
+    from .ops.breaker import monitor_period_s
     from .serve import protocol as _proto
 
+    health = args.health_period if args.health_period is not None \
+        else monitor_period_s()
+    if health < 0:
+        log.error("--health-period must be >= 0")
+        return 2
     service = JobService(
         args.socket, workers=args.workers, queue_limit=args.queue_limit,
         report_dir=args.report_dir,
-        max_frame_bytes=args.max_frame_bytes or _proto.MAX_FRAME_BYTES)
+        max_frame_bytes=args.max_frame_bytes or _proto.MAX_FRAME_BYTES,
+        journal_path=args.journal, health_period_s=health)
     # claim the socket BEFORE the device warm-up: an accidental duplicate
     # start must fail fast without touching the single-tenant chip
     try:
@@ -3189,6 +3207,11 @@ def _add_submit(sub):
     p.add_argument("--job-trace", action="store_true",
                    help="ask the daemon for a per-job Perfetto trace next "
                         "to the job's run report (needs serve --report-dir)")
+    p.add_argument("--dedupe", default=None, metavar="KEY",
+                   help="idempotency key: resubmitting the same key "
+                        "returns the original job (even across a daemon "
+                        "restart with serve --journal) instead of running "
+                        "it twice")
     p.add_argument("--no-wait", action="store_true",
                    help="return immediately after admission (poll later "
                         "with `fgumi-tpu jobs`)")
@@ -3214,7 +3237,7 @@ def cmd_submit(args):
     client = ServeClient(args.socket)
     try:
         job = client.submit(job_argv, priority=args.priority, tag=args.tag,
-                            trace=args.job_trace)
+                            trace=args.job_trace, dedupe=args.dedupe)
     except ServeError as e:
         log.error("submit: %s", e)
         return 2

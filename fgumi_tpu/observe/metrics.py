@@ -30,6 +30,13 @@ these prefixes):
 - ``device.const_cache.{hits,misses,bytes_uploaded}`` — device-resident
   constant-table cache traffic (quality tables / wire dictionaries are
   uploaded once per (device, content), not per dispatch)
+- ``device.breaker.state`` (gauge: closed/open/half-open),
+  ``device.breaker.{transitions,opened}``, ``device.canary.{ok,failed}``
+  — wedge circuit breaker + health canary (ops/breaker.py);
+  ``device.deadline_fallbacks`` folds in from DeviceStats when a
+  dispatch was abandoned at its deadline
+- ``serve.journal.{replayed,requeued,truncated_bytes}`` — crash-recovery
+  accounting from the serve daemon's journal replay (serve/journal.py)
 - ``io.bytes_read`` / ``io.bytes_written`` — compressed bytes through the
   BGZF reader/writer (and raw bytes for plain streams)
 - ``records.<label>`` — ProgressTracker totals per command label

@@ -153,7 +153,18 @@ def build_report(command: str, argv, started_unix: float, wall_s: float,
         router = sys.modules.get("fgumi_tpu.ops.router")
         if router is not None:
             dev["routing"] = router.ROUTER.snapshot()
-    if dev.get("dispatches") or dev.get("route_host"):
+    # wedge circuit breaker (ops/breaker.py): anything beyond pristine
+    # closed rides along, so a degraded run's artifact explains itself —
+    # the ISSUE 7 acceptance reads device.breaker.state transitions +
+    # deadline_fallbacks straight out of the report
+    breaker = sys.modules.get("fgumi_tpu.ops.breaker")
+    if breaker is not None:
+        bsnap = breaker.BREAKER.snapshot()
+        if bsnap["transitions"] or bsnap["state"] != "closed" \
+                or bsnap["deadline_overruns"]:
+            dev["breaker"] = bsnap
+    if dev.get("dispatches") or dev.get("route_host") \
+            or dev.get("breaker"):
         report["device"] = dev
     io_sec = {k.split(".", 1)[1]: v for k, v in metrics.items()
               if k.startswith("io.")}

@@ -81,6 +81,17 @@ def _ensure_jax():
     return jax
 
 
+def shard_map_compat(*args, **kwargs):
+    """jax.shard_map across the API move: the public alias appears in
+    jax >= 0.5; on 0.4.x only jax.experimental.shard_map exists. One
+    shim so every sharded kernel keeps working on both."""
+    _ensure_jax()
+    fn = getattr(jax, "shard_map", None)
+    if fn is None:
+        from jax.experimental.shard_map import shard_map as fn
+    return fn(*args, **kwargs)
+
+
 def _lazy_jit(fn=None, *, static_argnames=()):
     """@jax.jit that defers both the jax import and the jit wrapping to the
     first call (same compiled-function caching afterwards)."""
@@ -173,6 +184,67 @@ _TIE_GUARD_FLOOR = 1e-5  # ln units; exact-tie ulp jitter
 HOST_DISPATCH = ("host-dispatch",)
 
 
+class DeadlineExceeded(Exception):
+    """A device dispatch overran its deadline and was abandoned.
+
+    Raised by the deadline-aware waits in the resolve paths — never by the
+    device itself. The batch reroutes to the native f64 host engine
+    (byte-identical by construction) and the breaker records a wedge."""
+
+
+def _deadline_bounds():
+    """(floor_s, ceiling_s) from ``FGUMI_TPU_DISPATCH_DEADLINE_S``, or
+    (None, None) when dispatch deadlines are disabled.
+
+    Accepted forms: ``""`` (defaults 30:300), ``"CEILING"``,
+    ``"FLOOR:CEILING"``, or ``0``/``off``/``inf`` to disable. The floor
+    absorbs first-dispatch XLA compiles (which run inside the dispatch
+    wall); the ceiling bounds what a wedged chip can cost even when the
+    cost model has no prediction yet."""
+    import os
+
+    spec = os.environ.get("FGUMI_TPU_DISPATCH_DEADLINE_S", "").strip().lower()
+    if spec in ("off", "none", "inf"):
+        return None, None
+    floor, ceil = 30.0, 300.0
+    if spec:
+        try:
+            parts = [float(p) for p in spec.split(":", 1)]
+        except ValueError:
+            log.warning("FGUMI_TPU_DISPATCH_DEADLINE_S=%r is not "
+                        "S or FLOOR:CEILING; using the default", spec)
+            return floor, ceil
+        if len(parts) == 1:
+            ceil = parts[0]
+            floor = min(floor, ceil)
+        else:
+            floor, ceil = parts
+        if ceil <= 0:
+            return None, None
+        floor = min(max(floor, 0.01), ceil)
+    return floor, ceil
+
+
+def dispatch_deadline_s(pred_s=None):
+    """Deadline (seconds) for one dispatch's resolve wait, or None when
+    disabled. ``pred_s``: the router cost model's predicted dispatch wall
+    — the deadline is predicted wall x safety factor
+    (``FGUMI_TPU_DEADLINE_FACTOR``, default 20), clamped to the
+    floor/ceiling; with no prediction the ceiling applies."""
+    import os
+
+    floor, ceil = _deadline_bounds()
+    if ceil is None:
+        return None
+    if pred_s is None or pred_s <= 0:
+        return ceil
+    try:
+        factor = float(os.environ.get("FGUMI_TPU_DEADLINE_FACTOR", "20"))
+    except ValueError:
+        factor = 20.0
+    return min(max(pred_s * factor, floor), ceil)
+
+
 def use_host_engine() -> bool:
     """Whether consensus dispatches route to the native f64 host engine.
 
@@ -246,11 +318,13 @@ class DeviceStats:
         self.rows_padded = 0
         self.in_flight = 0
         # resilience accounting (retry / degrade path, docs/resilience.md):
-        # transient-dispatch retries, RESOURCE_EXHAUSTED batch halvings, and
-        # whole-batch falls back to the native f64 host engine
+        # transient-dispatch retries, RESOURCE_EXHAUSTED batch halvings,
+        # whole-batch falls back to the native f64 host engine, and batches
+        # abandoned at their dispatch deadline (self-healing layer)
         self.retries = 0
         self.batch_splits = 0
         self.host_fallbacks = 0
+        self.deadline_fallbacks = 0
         # pipelined-upload accounting (docs/device-datapath.md): feeder-fn
         # seconds that overlapped an earlier dispatch's device compute, the
         # feeder queue's high-water mark, and constant-cache traffic
@@ -277,6 +351,10 @@ class DeviceStats:
     def add_host_fallback(self):
         with self._lock:
             self.host_fallbacks += 1
+
+    def add_deadline_fallback(self):
+        with self._lock:
+            self.deadline_fallbacks += 1
 
     def add_upload_overlap(self, dt: float):
         with self._lock:
@@ -412,6 +490,8 @@ class DeviceStats:
                 out["batch_splits"] = self.batch_splits
             if self.host_fallbacks:
                 out["host_fallbacks"] = self.host_fallbacks
+            if self.deadline_fallbacks:
+                out["deadline_fallbacks"] = self.deadline_fallbacks
             if self.upload_overlap_s:
                 out["upload_overlap_s"] = round(self.upload_overlap_s, 3)
             if self.feeder_queue_peak:
@@ -440,6 +520,7 @@ class DeviceStats:
                 "dispatches", "fetch_wait_s", "bytes_fetched",
                 "bytes_uploaded", "model_flops", "rows_real", "rows_padded",
                 "in_flight", "retries", "batch_splits", "host_fallbacks",
+                "deadline_fallbacks",
                 "upload_overlap_s", "feeder_queue_peak", "const_uploads",
                 "const_hits", "const_upload_bytes", "route_device",
                 "route_host", "_t0")}
@@ -510,10 +591,13 @@ class DispatchTicket:
     """Future for a device dispatch submitted to the feeder thread.
 
     wait() returns the device result handle (or re-raises the feeder
-    exception); the fetch itself stays with the caller (resolve worker)."""
+    exception); the fetch itself stays with the caller (resolve worker).
+    A ticket whose wait timed out must be handed to
+    :meth:`DeviceFeeder.abandon` — the late result is discarded and the
+    feeder slot reclaimed whenever the wedged dispatch finally returns."""
 
     __slots__ = ("_event", "_result", "_exc", "slot", "upload_bytes",
-                 "_released")
+                 "_released", "_abandoned")
 
     def __init__(self):
         self._event = threading.Event()
@@ -522,14 +606,20 @@ class DispatchTicket:
         self.slot = -1
         self.upload_bytes = 0
         self._released = False
+        self._abandoned = False
 
     def _set(self, result=None, exc=None):
         self._result = result
         self._exc = exc
         self._event.set()
 
-    def wait(self):
-        self._event.wait()
+    def wait(self, timeout: float = None):
+        """Result handle, or raise. ``timeout`` seconds (None = forever);
+        on expiry raises :class:`DeadlineExceeded` WITHOUT abandoning —
+        deciding what to do with the wedged slot is the caller's call."""
+        if not self._event.wait(timeout):
+            raise DeadlineExceeded(
+                f"device dispatch did not complete within {timeout:.1f}s")
         if self._exc is not None:
             raise self._exc
         return self._result
@@ -649,6 +739,24 @@ class DeviceFeeder:
             self._inflight_bytes -= ticket.upload_bytes
             self._cv.notify_all()
 
+    def abandon(self, ticket: DispatchTicket):
+        """Give up on a dispatch that overran its deadline.
+
+        The resolver walks away NOW; whenever the wedged dispatch finally
+        completes (or fails), its result is discarded and the feeder slot
+        reclaimed through the ordinary :meth:`mark_resolved` path — so a
+        single wedge degrades one batch, never wedges the pipeline's
+        depth gate permanently. Safe against every interleaving with the
+        worker loop: completion state is read under the lock, and
+        ``mark_resolved`` is idempotent."""
+        with self._cv:
+            ticket._abandoned = True
+            completed = ticket._event.is_set()
+        if completed:
+            # raced the completion: the result exists but the caller is
+            # not going to fetch it — reclaim the slot here
+            self.mark_resolved(ticket)
+
     def queue_depth(self) -> int:
         with self._cv:
             return len(self._q) + (1 if self._active else 0)
@@ -758,6 +866,15 @@ class DeviceFeeder:
                 if not self._q:
                     continue
                 fn, ctx, ticket = self._q.popleft()
+                if ticket._abandoned:
+                    # abandoned while still queued (a deadline fired on a
+                    # batch stuck behind a wedged dispatch): never start
+                    # work nobody will fetch — especially not work that
+                    # may hang this thread too
+                    ticket._released = True  # never held a slot
+                    ticket._set(exc=DeadlineExceeded(
+                        "dispatch abandoned before it started"))
+                    continue
                 self._inflight += 1
                 self._inflight_bytes += ticket.upload_bytes
                 overlapped = self._inflight > 1
@@ -765,12 +882,81 @@ class DeviceFeeder:
             t0 = time.monotonic()
             try:
                 result = ctx.run(self._run_item, fn, ticket, overlapped, t0)
-                ticket._set(result=result)
+                exc = None
             except BaseException as e:  # noqa: BLE001 - relayed to waiter
-                ticket._set(exc=e)
+                result, exc = None, e
+            with self._cv:
+                ticket._set(result=result, exc=exc)
+                late = ticket._abandoned
+            if late:
+                # the resolver gave up at its deadline while this dispatch
+                # was running: discard the late result, reclaim the slot
+                log.warning("device dispatch completed %.1fs after its "
+                            "deadline; late result discarded",
+                            time.monotonic() - t0)
+                self.mark_resolved(ticket)
 
 
 DEVICE_FEEDER = DeviceFeeder()
+
+
+@_lazy_jit
+def _canary_sum_jit(x):
+    return jnp.sum(x.astype(jnp.int32))
+
+
+#: canary payload size: big enough that the upload wall is a usable link
+#: sample, small enough to cost <3s even on the slowest observed tunnel.
+_CANARY_BYTES = 1 << 20
+
+
+def device_canary(timeout_s: float = 10.0):
+    """One tiny end-to-end device round trip under its own deadline.
+
+    Returns ``(ok, wall_s, error)``. Goes through the ordinary feeder
+    submit + bounded ticket wait, so a wedged feeder/link shows up as a
+    timeout (the canary is abandoned like any other dispatch, never
+    hangs the caller), and a healthy round trip feeds the router's
+    link-rate EWMA. Used by the health monitor
+    (:class:`fgumi_tpu.ops.breaker.HealthMonitor`); callers feed the
+    breaker from the result."""
+    t0 = time.monotonic()
+    payload = np.zeros(_CANARY_BYTES, dtype=np.uint8)
+
+    def _fn():
+        # t_start is captured ON the feeder thread so the router sample
+        # below excludes time spent queued behind real dispatches — the
+        # resolve paths price queue wait via decide()'s in_flight term,
+        # and a canary in a busy daemon must not fold it into the
+        # overhead EWMA (it would overprice a healthy device)
+        _ensure_jax()
+        t_start = time.monotonic()
+        dev = jax.device_put(payload)
+        return _canary_sum_jit(dev), time.monotonic() - t_start, t_start
+
+    ticket = DEVICE_FEEDER.submit(_fn, upload_bytes=payload.nbytes)
+    try:
+        dev_out, up_s, t_start = ticket.wait(timeout_s)
+        left = max(timeout_s - (time.monotonic() - t0), 0.5)
+        got = _fetch_with_deadline(dev_out, left)
+    except DeadlineExceeded as e:
+        DEVICE_FEEDER.abandon(ticket)
+        return False, time.monotonic() - t0, str(e)
+    except BaseException as e:  # noqa: BLE001 - canary outcome, not crash
+        DEVICE_FEEDER.mark_resolved(ticket)
+        if not (_is_oom(e) or _is_transient(e)):
+            raise
+        return False, time.monotonic() - t0, f"{type(e).__name__}: {e}"
+    DEVICE_FEEDER.mark_resolved(ticket)
+    wall = time.monotonic() - t0
+    if int(got) != 0:  # payload is zeros; anything else is corruption
+        return False, wall, f"canary sum mismatch: {int(got)}"
+    from .router import ROUTER
+
+    active_s = max(time.monotonic() - t_start, up_s)
+    ROUTER.observe_device(payload.nbytes, 4, up_s,
+                          max(active_s - up_s, 0.0), active_s)
+    return True, wall, None
 
 
 def default_max_inflight() -> int:
@@ -849,6 +1035,12 @@ def device_retry_call(fn, what: str = "dispatch"):
     from ..observe.trace import span
     from ..utils import faults
 
+    # chaos point for the wedge class of failure (kind `hang`, stall via
+    # FGUMI_TPU_FAULT_HANG_S): fires ONCE per dispatch, before the retry
+    # loop, on whichever thread runs the dispatch — for the async paths
+    # that is the feeder thread, exactly where a wedged device_put stalls,
+    # so the deadline/breaker machinery is exercised end to end
+    faults.fire("device.wedge")
     retries, delay = _retry_budget()
     for attempt in range(retries + 1):
         try:
@@ -869,6 +1061,81 @@ def device_retry_call(fn, what: str = "dispatch"):
                         delay)
             time.sleep(delay)
             delay = min(delay * 2, 2.0)
+
+
+class _DeadlineRunner:
+    """Reusable helper threads for deadline-bounded calls into jax.
+
+    ``jax.device_get`` (and, on a wedged runtime, even ``device_put``/jit
+    dispatch) can block indefinitely, so a bounded call runs on a helper
+    thread (with the caller's context, so scope-resolved stats land
+    correctly) while the caller waits at most the deadline. Workers are
+    kept on a free list between calls — the deadline default is *on*, so
+    every hot-path fetch comes through here and must not pay a
+    thread-create — and each concurrent call gets its own worker, so
+    bounding adds no serialization. A worker that blows its deadline is
+    simply not returned to the free list: it is left to die with the
+    wedge (daemon thread), and the next call starts a fresh one."""
+
+    def __init__(self, name: str):
+        self._name = name
+        self._lock = threading.Lock()
+        self._free = []     # idle worker queues
+        self._seq = 0
+
+    def run(self, fn, deadline_s, what: str):
+        if deadline_s is None:
+            return fn()
+        import contextvars
+        import queue as _queue
+
+        ctx = contextvars.copy_context()
+        box = {}
+        done = threading.Event()
+        with self._lock:
+            if self._free:
+                q = self._free.pop()
+            else:
+                q = _queue.SimpleQueue()
+                self._seq += 1
+                threading.Thread(target=self._loop, args=(q,),
+                                 name=f"{self._name}-{self._seq}",
+                                 daemon=True).start()
+        q.put((ctx, fn, box, done))
+        if not done.wait(deadline_s):
+            # wedged: the worker is abandoned with its call (never reused;
+            # if the wedge ever clears it parks in q.get() forever)
+            raise DeadlineExceeded(
+                f"{what} did not complete within {deadline_s:.1f}s")
+        with self._lock:
+            self._free.append(q)
+        if "exc" in box:
+            raise box["exc"]
+        return box["result"]
+
+    @staticmethod
+    def _loop(q):
+        while True:
+            ctx, fn, box, done = q.get()
+            try:
+                box["result"] = ctx.run(fn)
+            except BaseException as e:  # noqa: BLE001 - relayed to waiter
+                box["exc"] = e
+            finally:
+                done.set()
+
+
+_FETCH_RUNNER = _DeadlineRunner("fgumi-device-fetch")
+_DISPATCH_RUNNER = _DeadlineRunner("fgumi-device-dispatch")
+
+
+def _fetch_with_deadline(dev, deadline_s):
+    """DEVICE_STATS.fetch(dev) bounded by ``deadline_s`` seconds (None =
+    plain inline fetch); raises :class:`DeadlineExceeded` on expiry."""
+    if deadline_s is None:
+        return DEVICE_STATS.fetch(dev)
+    return _FETCH_RUNNER.run(lambda: DEVICE_STATS.fetch(dev), deadline_s,
+                             "device fetch")
 
 
 def segments_flops(n_rows: int, length: int, num_segments: int) -> int:
@@ -1435,8 +1702,8 @@ def _consensus_segments_sharded_jit(codes, quals, seg_ids, correct_tab,
 
     # shard the leading axis over every mesh axis (a dp-only mesh has sp=1)
     spec = P(tuple(mesh.axis_names))
-    mapped = jax.shard_map(local, mesh=mesh,
-                           in_specs=(spec, spec, spec), out_specs=spec)
+    mapped = shard_map_compat(local, mesh=mesh,
+                              in_specs=(spec, spec, spec), out_specs=spec)
     return mapped(codes, quals, seg_ids)
 
 
@@ -1471,8 +1738,9 @@ def _consensus_segments_dp_sp_jit(codes, quals, seg_ids, correct_tab,
         return _pack_result(winner, qual, suspect)[None]
 
     spec = P("dp", "sp")
-    mapped = jax.shard_map(local, mesh=mesh,
-                           in_specs=(spec, spec, spec), out_specs=P("dp"))
+    mapped = shard_map_compat(local, mesh=mesh,
+                              in_specs=(spec, spec, spec),
+                              out_specs=P("dp"))
     return mapped(codes, quals, seg_ids)
 
 
@@ -1689,8 +1957,16 @@ class ConsensusKernel:
             return _consensus_batch_packed_jit(codes, quals, ct, et,
                                                self._pre)
 
-        with SHAPE_REGISTRY.attribute_compiles(new):
-            return device_retry_call(_dispatch, "batch dispatch")
+        def _bounded():
+            with SHAPE_REGISTRY.attribute_compiles(new):
+                return device_retry_call(_dispatch, "batch dispatch")
+
+        # sync path: the dispatch itself runs under the deadline (a wedged
+        # device_put/jit call would otherwise hang the CALLER thread
+        # unboundedly — the async paths get the same protection from the
+        # feeder's bounded ticket wait). __call__ degrades the overrun.
+        return _DISPATCH_RUNNER.run(_bounded, dispatch_deadline_s(),
+                                    "batch dispatch")
 
     @staticmethod
     def _host_counts(codes: np.ndarray, winner: np.ndarray):
@@ -1714,11 +1990,16 @@ class ConsensusKernel:
         __call__ and the pipeline's deferred (writer-stage) resolution.
         """
         try:
-            packed = DEVICE_STATS.fetch(dev)
+            packed = _fetch_with_deadline(dev, dispatch_deadline_s())
+        except DeadlineExceeded as e:
+            return self._recover_packed(e, codes, quals, overran=True)
         except BaseException as e:  # noqa: BLE001 - classified below
             if not (_is_oom(e) or _is_transient(e)):
                 raise
             return self._recover_packed(e, codes, quals)
+        from .breaker import BREAKER
+
+        BREAKER.record_success()  # clean resolve: resets the failure score
         winner, qual, suspect = _unpack_device_result(packed)
         depth, errors = self._host_counts(codes, winner)
         depth = depth.astype(np.int64)
@@ -1729,19 +2010,31 @@ class ConsensusKernel:
                                lambda f: (codes[f], quals[f]))
         return winner, qual, depth, errors
 
-    def _recover_packed(self, exc, codes: np.ndarray, quals: np.ndarray):
+    def _recover_packed(self, exc, codes: np.ndarray, quals: np.ndarray,
+                        overran: bool = False):
         """Host-engine completion of a failed uniform-batch fetch: the
         (F, R, L) batch is one R-row segment per family for the native f64
-        engine. Re-raises when the native library is unavailable."""
+        engine. Re-raises when the native library is unavailable.
+        ``overran``: the fetch hit its dispatch deadline rather than
+        erroring — counted and breaker-fed as a wedge, not a failure."""
         from ..native import batch as nb
 
         if not nb.available():
             raise exc
+        from .breaker import BREAKER
+
         F, R, L = codes.shape
-        DEVICE_STATS.add_host_fallback()
+        if overran:
+            DEVICE_STATS.add_deadline_fallback()
+            BREAKER.record_deadline_overrun()
+        else:
+            DEVICE_STATS.add_host_fallback()
+            if not _is_oom(exc):
+                BREAKER.record_transient_failure()
         log.warning(
-            "device fetch failed after retries (%s: %s); computing %d "
+            "device fetch %s (%s: %s); computing %d "
             "families on the native f64 host engine",
+            "overran its deadline" if overran else "failed after retries",
             type(exc).__name__, exc, F)
         starts = np.arange(F + 1, dtype=np.int64) * R
         engine = self._host()
@@ -1756,6 +2049,8 @@ class ConsensusKernel:
     def __call__(self, codes: np.ndarray, quals: np.ndarray):
         try:
             dev = self.device_call_packed(codes, quals)
+        except DeadlineExceeded as e:
+            return self._recover_packed(e, codes, quals, overran=True)
         except BaseException as e:  # noqa: BLE001 - classified below
             # dispatch-time failure (sync path): same degradation contract
             # as the resolve paths — OOM or exhausted retries run the batch
@@ -1791,8 +2086,30 @@ class ConsensusKernel:
             return _consensus_segments_packed_jit(
                 codes2d, quals2d, seg_ids, ct, et, self._pre, num_segments)
 
-        with SHAPE_REGISTRY.attribute_compiles(new):
-            return device_retry_call(_dispatch, "segment dispatch")
+        def _bounded():
+            with SHAPE_REGISTRY.attribute_compiles(new):
+                return device_retry_call(_dispatch, "segment dispatch")
+
+        try:
+            # sync path: the dispatch itself runs under the deadline (see
+            # device_call_packed) — a wedge here must not hang the caller
+            return _DISPATCH_RUNNER.run(_bounded, dispatch_deadline_s(),
+                                        "segment dispatch")
+        except DeadlineExceeded as e:
+            from ..native import batch as nb
+
+            if not nb.available():
+                raise  # nothing to degrade to
+            from .breaker import BREAKER
+
+            DEVICE_STATS.add_deadline_fallback()
+            BREAKER.record_deadline_overrun()
+            log.warning(
+                "device dispatch overran its deadline (%s); completing on "
+                "the native f64 host engine", e)
+            # the matching resolve_segments call completes byte-identically
+            # on the unpadded rows it receives
+            return HOST_DISPATCH
 
     def dispatch_segments(self, codes2d, quals2d, counts):
         """Pad + dispatch ragged segments, or skip both in host mode.
@@ -1926,12 +2243,16 @@ class ConsensusKernel:
         fetched = 0
         failure = None
         d16 = e16 = resident = None
+        tl0 = DEVICE_STATS.timeline_entry(ticket.slot)
+        deadline = dispatch_deadline_s((tl0 or {}).get("pred_s"))
         try:
-            dev = ticket.wait()
+            dev = ticket.wait(deadline)
             if isinstance(dev[-1], ResidentHandles):
                 resident = dev[-1]
                 dev = dev[:-1]
-            got = DEVICE_STATS.fetch(dev)
+            left = None if deadline is None else \
+                max(deadline - (time.monotonic() - t0), 1.0)
+            got = _fetch_with_deadline(dev, left)
             if len(got) == 4:
                 qs, wp, d16, e16 = got
             else:
@@ -1944,21 +2265,33 @@ class ConsensusKernel:
             # in-flight count would silently route every later hybrid batch
             # to the host engine while the run still claims platform=tpu,
             # and a leaked feeder slot would stall the upload pipeline at
-            # depth outstanding dispatches
+            # depth outstanding dispatches. A deadline overrun abandons
+            # instead: the slot is reclaimed when (if) the wedged dispatch
+            # finally returns, and its late result is discarded.
             DEVICE_STATS.end_in_flight(ticket.slot, fetched,
                                        time.monotonic() - t0)
-            DEVICE_FEEDER.mark_resolved(ticket)
+            if isinstance(failure, DeadlineExceeded):
+                DEVICE_FEEDER.abandon(ticket)
+            else:
+                DEVICE_FEEDER.mark_resolved(ticket)
         if failure is not None:
             # only device weather is recoverable; KeyboardInterrupt /
             # SystemExit and INVALID_ARGUMENT-class programming errors
             # propagate (in-flight accounting above already balanced)
-            if not (_is_oom(failure) or _is_transient(failure)):
+            if isinstance(failure, DeadlineExceeded):
+                out = self._deadline_fallback_segments(failure, codes2d,
+                                                       quals2d, starts)
+            elif not (_is_oom(failure) or _is_transient(failure)):
                 raise failure
-            out = self._recover_segments(failure, codes2d, quals2d,
-                                         starts, _split_depth)
+            else:
+                out = self._recover_segments(failure, codes2d, quals2d,
+                                             starts, _split_depth)
             if want_extras:
                 return out + ({"suspect": None, "resident": None},)
             return out
+        from .breaker import BREAKER
+
+        BREAKER.record_success()
         # feed the offload cost model with this dispatch's measured pieces
         # (docs/device-datapath.md "Adaptive offload policy"). Slots past
         # the timeline cap have no entry — skip the feed rather than
@@ -2083,14 +2416,27 @@ class ConsensusKernel:
         if not nb.available():
             raise exc
         DEVICE_STATS.add_host_fallback()
+        if not _is_oom(exc):
+            # repeated permanent transient failures are breaker fuel; an
+            # OOM is a sizing problem, not device weather
+            from .breaker import BREAKER
+
+            BREAKER.record_transient_failure()
         log.warning(
             "device dispatch failed after retries (%s: %s); computing "
             "batch of %d segments on the native f64 host engine",
             type(exc).__name__, exc, J)
+        return self._host_engine_complete(codes2d, quals2d, starts)
+
+    def _host_engine_complete(self, codes2d, quals2d, starts):
+        """Native-f64-host-engine completion of one segment batch (the
+        shared tail of every degraded path: transient-failure fallback,
+        deadline abandonment). Byte-identical to the device path by the
+        engines' shared exactness contract."""
         engine = self._host()
         t0 = time.monotonic()
         winner, qual, depth, errors, n_slow = engine.call_segments_counted(
-            codes2d, quals2d, starts)
+            codes2d, quals2d, np.asarray(starts, dtype=np.int64))
         from .router import ROUTER
 
         ROUTER.observe_host(codes2d.size, time.monotonic() - t0)
@@ -2098,6 +2444,25 @@ class ConsensusKernel:
             self.total_positions += winner.size
             self.fallback_positions += n_slow
         return winner, qual, depth, errors
+
+    def _deadline_fallback_segments(self, exc, codes2d, quals2d, starts):
+        """Degraded completion of a dispatch abandoned at its deadline:
+        count it, feed the breaker (a wedge is categorical evidence), and
+        complete on the native f64 host engine. Re-raises only when the
+        native library is unavailable — there is nothing to degrade to."""
+        from ..native import batch as nb
+
+        if not nb.available():
+            raise exc
+        from .breaker import BREAKER
+
+        DEVICE_STATS.add_deadline_fallback()
+        BREAKER.record_deadline_overrun()
+        log.warning(
+            "%s; abandoning the in-flight dispatch and computing batch of "
+            "%d segments on the native f64 host engine",
+            exc, len(starts) - 1)
+        return self._host_engine_complete(codes2d, quals2d, starts)
 
     # --------------------------------------------------- hard-column hybrid
 
@@ -2206,26 +2571,45 @@ class ConsensusKernel:
         t0 = time.monotonic()
         fetched = 0
         failure = None
+        tl0 = DEVICE_STATS.timeline_entry(ticket.slot)
+        deadline = dispatch_deadline_s((tl0 or {}).get("pred_s"))
         try:
-            dev = ticket.wait()
-            qs, wp = DEVICE_STATS.fetch(dev)
+            dev = ticket.wait(deadline)
+            left = None if deadline is None else \
+                max(deadline - (time.monotonic() - t0), 1.0)
+            qs, wp = _fetch_with_deadline(dev, left)
             fetched = qs.nbytes + wp.nbytes
         except BaseException as e:  # noqa: BLE001 - recovered below
             failure = e
         finally:
             DEVICE_STATS.end_in_flight(ticket.slot, fetched,
                                        time.monotonic() - t0)
-            DEVICE_FEEDER.mark_resolved(ticket)
+            if isinstance(failure, DeadlineExceeded):
+                DEVICE_FEEDER.abandon(ticket)
+            else:
+                DEVICE_FEEDER.mark_resolved(ticket)
         if failure is not None:
-            if not (_is_oom(failure) or _is_transient(failure)):
+            from .breaker import BREAKER
+
+            overran = isinstance(failure, DeadlineExceeded)
+            if not overran and not (_is_oom(failure)
+                                    or _is_transient(failure)):
                 raise failure
             # degrade: the exported observation stream is exactly what the
             # host f64 patch path consumes — recompute every hard column
             # there (native guaranteed: classify already required it)
-            DEVICE_STATS.add_host_fallback()
+            if overran:
+                DEVICE_STATS.add_deadline_fallback()
+                BREAKER.record_deadline_overrun()
+            else:
+                DEVICE_STATS.add_host_fallback()
+                if not _is_oom(failure):
+                    BREAKER.record_transient_failure()
             log.warning(
-                "device dispatch failed after retries (%s: %s); resolving "
+                "device dispatch %s (%s: %s); resolving "
                 "%d hard columns on the native f64 host engine",
+                "overran its deadline" if overran
+                else "failed after retries",
                 type(failure).__name__, failure, C)
             self._patch_hard_columns(
                 np.ones(C, dtype=bool), hard_idx, hard_depth, hc, hq,
@@ -2234,6 +2618,9 @@ class ConsensusKernel:
                 self.total_positions += winner.size
                 self.fallback_positions += C
             return winner, qual, depth, errors
+        from .breaker import BREAKER
+
+        BREAKER.record_success()
         w_col, q_col, suspect = unpack_result_split(
             qs.reshape(1, -1), wp.reshape(1, -1), 1)
         w_col = w_col.ravel()[:C].astype(np.uint8)
@@ -2341,12 +2728,18 @@ class ConsensusKernel:
                 self.fallback_positions += n_slow
             return winner, qual, depth, errors
         try:
-            packed = DEVICE_STATS.fetch(dev)
+            packed = _fetch_with_deadline(dev, dispatch_deadline_s())
+        except DeadlineExceeded as e:
+            return self._deadline_fallback_segments(e, codes2d, quals2d,
+                                                    starts)
         except BaseException as e:  # noqa: BLE001 - classified below
             if not (_is_oom(e) or _is_transient(e)):
                 raise
             return self._recover_segments(e, codes2d, quals2d,
                                           np.asarray(starts, np.int64), 0)
+        from .breaker import BREAKER
+
+        BREAKER.record_success()  # clean resolve: resets the failure score
         return self._finish_segments(packed, codes2d, quals2d, starts)
 
     def _finish_segments(self, packed: np.ndarray, codes2d, quals2d, starts):

@@ -184,10 +184,25 @@ class OffloadRouter:
                             "is unavailable; routing to the device")
             forced = "device"
         can_host = nb.available() and kernel.hybrid_mode()
-        if forced == "device" or not can_host:
+        if not can_host:
+            # nothing to degrade to: the device runs the batch regardless
+            # of breaker state (the retry/fallback machinery still applies)
             return self._stamp("device", forced=forced != "auto",
                                why="forced" if forced == "device"
                                else "no-host-engine")
+        # circuit breaker (ops/breaker.py): with the device declared
+        # wedged, every batch routes host with ZERO device waits — the
+        # feeder thread may be hung inside a dispatch, so queueing more
+        # work behind it would stack deadlines. This overrides even an
+        # explicit FGUMI_TPU_ROUTE=device (disable via FGUMI_TPU_BREAKER=0
+        # to reproduce raw-device behavior); in half-open, allow() admits
+        # one probe batch at a time and the resolve outcome feeds back.
+        from .breaker import BREAKER
+
+        if forced == "device":
+            if not BREAKER.allow():
+                return self._stamp("host", why="breaker-open")
+            return self._stamp("device", forced=True, why="forced")
 
         env_cap = os.environ.get("FGUMI_TPU_MAX_INFLIGHT", "").strip()
         if env_cap:
@@ -196,6 +211,9 @@ class OffloadRouter:
             side = "host" if (cap <= 0
                               or DEVICE_STATS.in_flight_count() >= cap) \
                 else "device"
+            if side == "device" and not BREAKER.allow():
+                side = "host"
+                return self._stamp(side, why="breaker-open")
             return self._stamp(side, why="max-inflight")
 
         with self._lock:
@@ -221,6 +239,8 @@ class OffloadRouter:
             if streak >= probe:
                 side = "host" if side == "device" else "device"
                 why = "probe-refresh"
+        if side == "device" and not BREAKER.allow():
+            side, why = "host", "breaker-open"
         return self._stamp(side, why=why, t_dev=t_dev, t_host=t_host,
                            link_bps=link, host_cps=host_cps,
                            overhead_s=overhead, in_flight=in_flight)
@@ -339,9 +359,11 @@ def run_adaptive_stage(chooser: AdaptiveChooser, cells: int, override: str,
     Returns (result, side-that-produced-it)."""
     import time
 
+    from .breaker import BREAKER
     from .kernel import _is_oom, _is_transient, log
 
-    if cells > 0 and chooser.decide(cells, override) == "device":
+    if cells > 0 and not BREAKER.blocked() \
+            and chooser.decide(cells, override) == "device":
         t0 = time.monotonic()
         try:
             out = device_fn()

@@ -18,7 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.kernel import _call_epilogue, _reduce_contributions
+from ..ops.kernel import (_call_epilogue, _reduce_contributions,
+                          shard_map_compat)
 
 
 def make_mesh(devices=None, dp: int = None, sp: int = 1) -> Mesh:
@@ -51,7 +52,7 @@ def sharded_consensus_fn(mesh: Mesh, correct_tab, err_tab, ln_error_pre_umi):
         obs = jax.lax.psum(obs, "sp")
         return _call_epilogue(contrib, obs, pre)
 
-    mapped = jax.shard_map(
+    mapped = shard_map_compat(
         local,
         mesh=mesh,
         in_specs=(P("dp", "sp", None), P("dp", "sp", None)),

@@ -20,7 +20,12 @@ protocol on a Unix-domain socket:
   job's metrics/trace/run-report are exactly what the standalone command
   would have produced — and its output bytes are identical too.
 - :mod:`.client` — the thin client used by ``fgumi-tpu submit`` and
-  ``fgumi-tpu jobs``.
+  ``fgumi-tpu jobs``; reconnects once on a reset mid-request so a daemon
+  restart doesn't surface as a raw traceback.
+- :mod:`.journal` — the append-only job WAL behind ``serve --journal``:
+  fsync'd submit/state records, torn-tail truncation on replay, and the
+  requeue-on-restart + dedupe-key recovery semantics that make serving
+  crash-recoverable (a SIGKILL'd daemon forgets nothing).
 
 Every job is byte-parity-committed: the daemon overrides provenance
 (@PG CL) with the submitting client's command line, and all execution-state

@@ -3,9 +3,16 @@
 Used by ``fgumi-tpu submit`` / ``fgumi-tpu jobs`` and by the smoke gate.
 Deliberately dependency-free and synchronous — the protocol is one JSON
 frame each way, and reconnect-per-request makes the client robust to a
-daemon restart between polls.
+daemon restart between polls. Within a request, a connection torn down
+under the client (``ECONNRESET``/``EPIPE``/mid-frame close — exactly what
+a daemon SIGKILL or restart looks like from this side) gets one bounded
+reconnect attempt for idempotent operations before surfacing a
+:class:`ServeError`; a ``dedupe``-keyed submit is idempotent by the
+daemon's contract and retries the same way. Daemon refusals (``ok:
+false``) are surfaced with the daemon's reason verbatim.
 """
 
+import errno
 import socket
 import sys
 import time
@@ -17,20 +24,52 @@ class ServeError(RuntimeError):
     """Transport failure or an ``ok: false`` response (reason in str())."""
 
 
+#: errnos that mean "the peer vanished mid-conversation" — the retryable
+#: class (vs. connection *refused*, which means no daemon is listening).
+_RESET_ERRNOS = frozenset({errno.ECONNRESET, errno.EPIPE})
+
+#: pause before the one reconnect attempt: long enough for a restarting
+#: daemon to re-claim its socket, short enough not to matter to a human.
+RECONNECT_DELAY_S = 0.5
+
+
+def _is_reset(exc: OSError) -> bool:
+    return isinstance(exc, (ConnectionResetError, BrokenPipeError)) \
+        or getattr(exc, "errno", None) in _RESET_ERRNOS
+
+
 class ServeClient:
     def __init__(self, socket_path: str, timeout: float = 30.0,
-                 max_frame_bytes: int = protocol.MAX_FRAME_BYTES):
+                 max_frame_bytes: int = protocol.MAX_FRAME_BYTES,
+                 reconnects: int = 1):
         self.socket_path = socket_path
         self.timeout = timeout
         self.max_frame_bytes = max_frame_bytes
+        self.reconnects = max(int(reconnects), 0)
 
     # -- transport ----------------------------------------------------------
 
-    def request(self, obj: dict) -> dict:
+    def request(self, obj: dict, timeout: float = None,
+                retry: bool = True) -> dict:
         """One request -> one response. Raises ServeError on transport
-        failure; returns the response frame verbatim (check ``ok``)."""
+        failure; returns the response frame verbatim (check ``ok``).
+        ``timeout`` overrides the client default for this request;
+        ``retry=False`` disables the reconnect-on-reset attempt (for
+        non-idempotent operations)."""
+        attempts = (self.reconnects if retry else 0) + 1
+        last = None
+        for attempt in range(attempts):
+            if attempt:
+                time.sleep(RECONNECT_DELAY_S)
+            try:
+                return self._request_once(obj, timeout)
+            except _Retryable as e:
+                last = e.error
+        raise last
+
+    def _request_once(self, obj: dict, timeout: float = None) -> dict:
         conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        conn.settimeout(self.timeout)
+        conn.settimeout(self.timeout if timeout is None else timeout)
         try:
             try:
                 conn.connect(self.socket_path)
@@ -41,17 +80,27 @@ class ServeClient:
                 conn.sendall(protocol.encode_frame(obj))
                 stream = conn.makefile("rb")
                 resp = protocol.read_frame(stream, self.max_frame_bytes)
-            except (OSError, protocol.ProtocolError) as e:
+            except protocol.ProtocolError as e:
                 raise ServeError(f"daemon connection failed: {e}")
+            except OSError as e:
+                err = ServeError(f"daemon connection failed: {e}")
+                if _is_reset(e):
+                    raise _Retryable(err)  # daemon restarting: retry once
+                raise err
             if resp is None:
-                raise ServeError("daemon closed the connection mid-request")
+                # clean close mid-request: the SIGKILL/restart signature
+                raise _Retryable(ServeError(
+                    "daemon closed the connection mid-request"))
             return resp
         finally:
             conn.close()
 
-    def _checked(self, obj: dict) -> dict:
-        resp = self.request(obj)
+    def _checked(self, obj: dict, timeout: float = None,
+                 retry: bool = True) -> dict:
+        resp = self.request(obj, timeout=timeout, retry=retry)
         if not resp.get("ok"):
+            # the daemon's reason verbatim — "queue full: ..." vs
+            # "draining: ..." is how callers tell backpressure from refusal
             raise ServeError(resp.get("error", "daemon refused the request"))
         return resp
 
@@ -61,38 +110,50 @@ class ServeClient:
         return self._checked({"v": protocol.PROTOCOL_VERSION, "op": "ping"})
 
     def submit(self, argv, priority: str = protocol.DEFAULT_PRIORITY,
-               argv0: str = None, tag: str = None,
-               trace: bool = False) -> dict:
+               argv0: str = None, tag: str = None, trace: bool = False,
+               dedupe: str = None) -> dict:
         """Submit a command; returns the accepted job record. An admission
         rejection (queue full / draining) raises ServeError with the
-        daemon's reason."""
+        daemon's reason. ``dedupe``: idempotency key — resubmitting the
+        same key returns the original job instead of running it twice,
+        which also makes the reconnect retry safe for submits; without a
+        key, a submit whose connection resets is NOT retried (the daemon
+        may already have admitted it)."""
         req = {"v": protocol.PROTOCOL_VERSION, "op": "submit",
                "argv": list(argv), "priority": priority,
                "argv0": argv0 if argv0 is not None else sys.argv[0],
                "trace": bool(trace)}
         if tag is not None:
             req["tag"] = tag
-        return self._checked(req)["job"]
+        if dedupe is not None:
+            req["dedupe"] = dedupe
+        return self._checked(req, retry=dedupe is not None)["job"]
 
-    def status(self, job_id: str = None) -> dict:
+    def status(self, job_id: str = None, timeout: float = None) -> dict:
         req = {"v": protocol.PROTOCOL_VERSION, "op": "status"}
         if job_id is not None:
             req["id"] = job_id
-        return self._checked(req)
+        return self._checked(req, timeout=timeout)
 
     def job(self, job_id: str) -> dict:
         return self.status(job_id)["job"]
 
     def cancel(self, job_id: str) -> dict:
+        # no reconnect retry: if the daemon acted before the reset, the
+        # retry would be answered "already cancelled" (ok: false) and a
+        # cancel that succeeded would surface as a failure
         return self._checked({"v": protocol.PROTOCOL_VERSION, "op": "cancel",
-                              "id": job_id})["job"]
+                              "id": job_id}, retry=False)["job"]
 
     def drain(self) -> dict:
+        # idempotent (re-draining a draining daemon is a no-op): retry ok
         return self._checked({"v": protocol.PROTOCOL_VERSION, "op": "drain"})
 
     def shutdown(self) -> dict:
+        # no retry: after a successful shutdown the reconnect would hit
+        # connection-refused and report failure for an op that succeeded
         return self._checked({"v": protocol.PROTOCOL_VERSION,
-                              "op": "shutdown"})
+                              "op": "shutdown"}, retry=False)
 
     def wait(self, job_id: str, timeout: float = None,
              poll_s: float = 0.2) -> dict:
@@ -110,3 +171,11 @@ class ServeClient:
                     f"timed out waiting for job {job_id} "
                     f"(still {job['state']})")
             time.sleep(poll_s)
+
+
+class _Retryable(Exception):
+    """Internal: wraps a ServeError the transport may retry once."""
+
+    def __init__(self, error: ServeError):
+        super().__init__(str(error))
+        self.error = error

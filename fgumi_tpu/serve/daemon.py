@@ -29,8 +29,9 @@ import socket
 import threading
 import time
 
+from . import journal as journal_mod
 from . import protocol
-from .jobs import JobRegistry
+from .jobs import TERMINAL, Job, JobRegistry
 from .scheduler import Scheduler
 
 log = logging.getLogger("fgumi_tpu")
@@ -58,18 +59,31 @@ class JobService:
     def __init__(self, socket_path: str, workers: int = 2,
                  queue_limit: int = 8, report_dir: str = None,
                  max_frame_bytes: int = protocol.MAX_FRAME_BYTES,
-                 keep_finished: int = 1000):
+                 keep_finished: int = 1000, journal_path: str = None,
+                 health_period_s: float = 0.0):
         self.socket_path = socket_path
         self.max_frame_bytes = max_frame_bytes
         self.report_dir = report_dir
-        self.registry = JobRegistry(keep_finished=keep_finished)
+        self.registry = JobRegistry(keep_finished=keep_finished,
+                                    on_transition=self._on_transition)
         self.scheduler = Scheduler(self._execute, self.registry,
                                    workers=workers, queue_limit=queue_limit)
         self.started_unix = time.time()
+        self.journal_path = journal_path
+        self.journal = None
+        self.health_period_s = float(health_period_s or 0.0)
+        self._monitor = None
+        self._dedupe = {}          # dedupe key -> job id (journal-durable)
+        self._dedupe_lock = threading.Lock()
+        self._recovered = False
         self._sock = None
         self._accept_thread = None
         self._shutdown = threading.Event()
         self._closed = False
+
+    def _on_transition(self, job):
+        if self.journal is not None:
+            self.journal.record_state(job)
 
     # -- warm-up ------------------------------------------------------------
 
@@ -142,6 +156,114 @@ class JobService:
                  rc, time.monotonic() - t0)
         return rc
 
+    # -- crash recovery -----------------------------------------------------
+
+    def recover(self):
+        """Replay the journal (if configured) and requeue incomplete jobs.
+
+        Idempotent; runs once, before the worker pool starts so a
+        requeued job cannot race a fresh submission for its original
+        position. Terminal jobs are restored read-only (clients polling
+        an id from before the crash get its final record), dedupe keys
+        are rebuilt, and non-terminal jobs — queued or running when the
+        previous daemon died — are requeued in original submission order.
+        That re-run is byte-identical to a single run: atomic output
+        commit (PR 1) guarantees the killed attempt published nothing.
+        Also sweeps report-dir temp leftovers owned by dead pids and
+        older than the journal's last entry."""
+        if self._recovered:
+            return
+        self._recovered = True
+        if not self.journal_path:
+            return
+        from ..observe.metrics import METRICS
+
+        rep = journal_mod.replay(self.journal_path)
+        self.journal = journal_mod.JobJournal(self.journal_path)
+        self._sweep_report_temps(rep.last_entry_unix)
+        requeued = 0
+        for rec in rep.jobs:
+            job = Job(rec["id"], rec["argv"], rec["priority"],
+                      argv0=rec["argv0"], tag=rec["tag"],
+                      trace=rec["trace"])
+            if rec.get("submitted_unix"):
+                job.submitted_unix = rec["submitted_unix"]
+            terminal = rec["state"] in TERMINAL
+            if terminal:
+                job.state = rec["state"]
+                job.exit_status = rec["exit_status"]
+                job.error = rec["error"]
+                job.finished_unix = rec.get("finished_unix")
+            try:
+                self.registry.restore(job)
+            except ValueError:
+                continue  # duplicate record; first wins
+            if rec.get("dedupe") and rec["state"] != "cancelled":
+                # cancelled jobs never rebind their key: an
+                # admission-rejected submit releases its key on the live
+                # daemon (see the submit handler), and the journal records
+                # it only as submit+cancelled — rebinding here would answer
+                # a post-restart retry with the rejected record instead of
+                # executing it. (A user-cancelled job re-running on
+                # resubmit is the safe direction of the same rule.)
+                self._dedupe[rec["dedupe"]] = job.id
+            if not terminal:
+                self.journal.record_requeued(job.id)
+                admitted, reason = self.scheduler.submit(job)
+                if admitted:
+                    requeued += 1
+                else:  # shrunken capacity on restart: record the loss
+                    self.registry.mark_cancelled(job)
+                    if rec.get("dedupe") \
+                            and self._dedupe.get(rec["dedupe"]) == job.id:
+                        # same contract as a live admission reject: the
+                        # key is released so a retry executes instead of
+                        # being answered with the cancelled record
+                        del self._dedupe[rec["dedupe"]]
+                    log.warning("serve: could not requeue %s: %s",
+                                job.id, reason)
+        if rep.records or requeued:
+            log.info("serve: journal replayed %d record(s); %d job(s) "
+                     "requeued", rep.records, requeued)
+        METRICS.inc("serve.journal.replayed", rep.records)
+        METRICS.inc("serve.journal.requeued", requeued)
+        if rep.truncated_bytes:
+            METRICS.inc("serve.journal.truncated_bytes", rep.truncated_bytes)
+
+    def _sweep_report_temps(self, before_unix):
+        """Remove dead-pid atomic-output temps from the report dir.
+
+        A SIGKILL'd predecessor can leave ``.<name>.tmp.<pid>.<seq>``
+        leftovers next to per-job reports; anything owned by a dead pid
+        and not newer than the journal's last entry (i.e. provably from
+        before the crash) is swept. Live pids — including this process —
+        are never touched."""
+        if not self.report_dir or not os.path.isdir(self.report_dir):
+            return
+        from ..utils.atomic import _pid_alive
+
+        swept = 0
+        for name in os.listdir(self.report_dir):
+            if not name.startswith(".") or ".tmp." not in name:
+                continue
+            pid_s = name.split(".tmp.", 1)[1].split(".", 1)[0]
+            if not pid_s.isdigit():
+                continue
+            pid = int(pid_s)
+            if pid == os.getpid() or _pid_alive(pid):
+                continue
+            path = os.path.join(self.report_dir, name)
+            try:
+                if before_unix is not None \
+                        and os.stat(path).st_mtime > before_unix:
+                    continue  # newer than the crash horizon; leave it
+                os.unlink(path)
+                swept += 1
+            except OSError:
+                pass
+        if swept:
+            log.info("serve: swept %d stale report temp(s)", swept)
+
     # -- socket server ------------------------------------------------------
 
     def _claim_socket(self):
@@ -186,15 +308,26 @@ class JobService:
             self._sock = self._claim_socket()
 
     def start(self):
-        """Bind (if not already), start workers and the accept loop."""
+        """Bind (if not already), recover, start workers and the accept
+        loop. Recovery runs before the pool so requeued jobs hold their
+        original queue positions ahead of any fresh submission."""
         self.bind()
+        self.recover()
         self.scheduler.start()
+        if self.health_period_s > 0:
+            from ..ops.breaker import BREAKER, HealthMonitor
+
+            self._monitor = HealthMonitor(BREAKER,
+                                          period_s=self.health_period_s)
+            self._monitor.start()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="fgumi-serve-accept", daemon=True)
         self._accept_thread.start()
-        log.info("serve: listening on %s (%d workers, queue limit %d)",
+        log.info("serve: listening on %s (%d workers, queue limit %d%s)",
                  self.socket_path, self.scheduler.workers,
-                 self.scheduler.queue_limit)
+                 self.scheduler.queue_limit,
+                 f", journal {self.journal_path}" if self.journal_path
+                 else "")
 
     def _accept_loop(self):
         # keep accepting through a drain: clients must be able to poll
@@ -257,17 +390,46 @@ class JobService:
                 uptime_s=round(time.time() - self.started_unix, 1),
                 jobs=self.registry.counts(), **self.scheduler.depth())
         if op == "submit":
-            job = self.registry.create(
-                req["argv"], req.get("priority", protocol.DEFAULT_PRIORITY),
-                argv0=req.get("argv0"), tag=req.get("tag"),
-                trace=bool(req.get("trace")))
+            dedupe = req.get("dedupe")
+            with self._dedupe_lock:
+                if dedupe:
+                    existing = self._dedupe.get(dedupe)
+                    if existing is not None:
+                        prior = self.registry.get(existing)
+                        if prior is not None:
+                            # idempotent resubmit: same key -> the SAME
+                            # job (running, queued, or finished), never a
+                            # second execution — the contract that makes
+                            # client retry-after-reconnect safe
+                            return protocol.ok_response(
+                                job=prior.to_wire(), deduped=True)
+                        # job evicted from history: key is stale, reissue
+                job = self.registry.create(
+                    req["argv"],
+                    req.get("priority", protocol.DEFAULT_PRIORITY),
+                    argv0=req.get("argv0"), tag=req.get("tag"),
+                    trace=bool(req.get("trace")))
+                if dedupe:
+                    self._dedupe[dedupe] = job.id
+            # journal BEFORE admission: a crash between the two requeues a
+            # job the client believes submitted — the safe direction (the
+            # reverse silently loses it); a rejection is journaled as the
+            # cancelled transition right below
+            if self.journal is not None:
+                self.journal.record_submit(job, dedupe)
             admitted, reason = self.scheduler.submit(job)
             if not admitted:
                 # the response still carries the (cancelled) record so the
                 # client sees what was refused, but the registry forgets it:
-                # a rejection storm must not evict finished-job history
+                # a rejection storm must not evict finished-job history —
+                # and the dedupe key is released so a later retry of the
+                # same request is not answered with the rejected record
                 self.registry.mark_cancelled(job)
                 self.registry.discard(job.id)
+                if dedupe:
+                    with self._dedupe_lock:
+                        if self._dedupe.get(dedupe) == job.id:
+                            del self._dedupe[dedupe]
                 return protocol.error_response(reason, job=job.to_wire())
             return protocol.ok_response(job=job.to_wire())
         if op == "status":
@@ -320,6 +482,10 @@ class JobService:
             return
         self._closed = True
         self._shutdown.set()
+        if self._monitor is not None:
+            self._monitor.stop()
+        if self.journal is not None:
+            self.journal.close()
         if self._sock is not None:
             try:
                 self._sock.close()

@@ -16,7 +16,6 @@ evicted first) so a client can poll a job that finished between polls.
 """
 
 import collections
-import itertools
 import threading
 import time
 
@@ -83,25 +82,46 @@ class InvalidTransition(RuntimeError):
 class JobRegistry:
     """Thread-safe id -> :class:`Job` store enforcing the state machine."""
 
-    def __init__(self, keep_finished: int = 1000):
+    def __init__(self, keep_finished: int = 1000, on_transition=None):
         self._lock = threading.Lock()
         self._jobs = {}
         self._order = []  # insertion order, for stable listing
         # terminal ids in completion order: O(1) eviction on create instead
         # of rescanning the whole history per submission
         self._finished = collections.deque()
-        self._ids = itertools.count(1)
+        self._next_id = 1
         self._keep_finished = keep_finished
+        #: called as on_transition(job) after every state change — the
+        #: daemon's journal hook (fires outside the registry lock, after
+        #: the record's fields are final)
+        self.on_transition = on_transition
 
     def create(self, argv, priority: str, argv0: str = None,
                tag: str = None, trace: bool = False) -> Job:
         with self._lock:
-            job = Job(f"j-{next(self._ids)}", argv, priority, argv0=argv0,
+            job = Job(f"j-{self._next_id}", argv, priority, argv0=argv0,
                       tag=tag, trace=trace)
+            self._next_id += 1
             self._jobs[job.id] = job
             self._order.append(job.id)
             self._evict_locked()
             return job
+
+    def restore(self, job: Job):
+        """Insert a pre-built job (journal replay): the id is preserved so
+        clients polling across a daemon restart still resolve it, and the
+        id counter skips past it so new submissions never collide."""
+        with self._lock:
+            if job.id in self._jobs:
+                raise ValueError(f"job id {job.id} already registered")
+            self._jobs[job.id] = job
+            self._order.append(job.id)
+            suffix = job.id.rsplit("-", 1)[-1]
+            if suffix.isdigit():
+                self._next_id = max(self._next_id, int(suffix) + 1)
+            if job.state in TERMINAL:
+                self._finished.append(job.id)
+            self._evict_locked()
 
     def _evict_locked(self):
         while len(self._finished) > self._keep_finished:
@@ -147,9 +167,21 @@ class JobRegistry:
                     "legal transition")
             job.state = new_state
 
+    def _notify(self, job: Job):
+        cb = self.on_transition
+        if cb is not None:
+            try:
+                cb(job)
+            except Exception:  # noqa: BLE001 - journal loss != daemon loss
+                import logging
+
+                logging.getLogger("fgumi_tpu").exception(
+                    "job transition hook failed for %s", job.id)
+
     def mark_running(self, job: Job):
         self._transition(job, "running")
         job.started_unix = time.time()
+        self._notify(job)
 
     def mark_done(self, job: Job, exit_status: int):
         job.exit_status = int(exit_status)
@@ -160,6 +192,7 @@ class JobRegistry:
             self._transition(job, "failed")
         job.finished_unix = time.time()
         self._note_terminal(job)
+        self._notify(job)
 
     def mark_failed(self, job: Job, error: str):
         job.error = str(error)
@@ -167,8 +200,10 @@ class JobRegistry:
         self._transition(job, "failed")
         job.finished_unix = time.time()
         self._note_terminal(job)
+        self._notify(job)
 
     def mark_cancelled(self, job: Job):
         self._transition(job, "cancelled")
         job.finished_unix = time.time()
         self._note_terminal(job)
+        self._notify(job)

@@ -11,7 +11,7 @@ Requests::
 
     {"v": 1, "op": "submit", "argv": ["simplex", "-i", ...],
      "priority": "normal", "argv0": "fgumi-tpu", "trace": false,
-     "tag": "optional-label"}
+     "tag": "optional-label", "dedupe": "optional-idempotency-key"}
     {"v": 1, "op": "status"}           # all jobs
     {"v": 1, "op": "status", "id": "j-3"}
     {"v": 1, "op": "cancel", "id": "j-3"}
@@ -23,7 +23,10 @@ Responses are ``{"v": 1, "ok": true, ...}`` or
 ``{"v": 1, "ok": false, "error": "<reason>"}``. Submit acceptance returns
 the job record; admission rejection is ``ok: false`` with the reason
 (queue full / draining) so a load balancer can tell backpressure from
-breakage.
+breakage. A ``dedupe`` key makes submission idempotent: resubmitting the
+same key — e.g. a client retrying across a daemon restart — returns the
+original job record (``"deduped": true``) instead of running the command
+twice; keys survive restarts via the job journal (docs/serving.md).
 
 Malformed frames (bad JSON, not an object, unknown op, missing fields) get
 an error response; oversized frames (> ``max_frame_bytes``, default 1 MiB)
@@ -109,6 +112,10 @@ def validate_request(obj: dict):
         argv0 = obj.get("argv0")
         if argv0 is not None and not isinstance(argv0, str):
             return "argv0 must be a string"
+        dedupe = obj.get("dedupe")
+        if dedupe is not None and (not isinstance(dedupe, str)
+                                   or not dedupe):
+            return "dedupe must be a non-empty string"
     if op in ("cancel",) and not isinstance(obj.get("id"), str):
         return f"{op} requires id: a job id string"
     if "id" in obj and obj["id"] is not None \

@@ -45,6 +45,10 @@ FAULT_POINTS = frozenset({
     "reader.decompress",   # BGZF/gzip reader raw-chunk ingest (io/bgzf.py)
     "pipeline.process",    # per-item process stage (pipeline.run_stages)
     "device.dispatch",     # XLA upload+dispatch attempt (ops/kernel.py)
+    "device.wedge",        # dispatch entry, fires once per dispatch — arm
+                           # kind `hang` (stall via FGUMI_TPU_FAULT_HANG_S)
+                           # to simulate a dispatch that never returns; the
+                           # deadline/breaker layer must absorb it
     "writer.compress",     # BGZF writer block emit (io/bgzf.py)
     "native.batch",        # native batch-op entry (native/batch.py)
     "serve.dispatch",      # job-service worker dispatch (serve/daemon.py)

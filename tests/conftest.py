@@ -23,3 +23,17 @@ jax.config.update("jax_platforms", "cpu")
 
 # Make the repo root importable regardless of pytest invocation directory.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_device_breaker():
+    """The wedge circuit breaker (ops/breaker.py) is process-global on
+    purpose — but a test that trips it must not route every later test's
+    dispatches to the host engine. Reset after each test, lazily (never
+    import the ops stack for tests that don't touch it)."""
+    yield
+    mod = sys.modules.get("fgumi_tpu.ops.breaker")
+    if mod is not None:
+        mod.BREAKER.reset()

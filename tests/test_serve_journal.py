@@ -1,0 +1,317 @@
+"""Job-journal units (serve/journal.py) + daemon crash recovery: replay
+and requeue order, duplicate-submit dedupe (in-session and across a
+simulated restart), and corrupt-tail truncation. CPU-only and fast — the
+one end-to-end case runs a tiny in-process job."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from fgumi_tpu.serve import journal as journal_mod
+from fgumi_tpu.serve.daemon import JobService
+from fgumi_tpu.serve.jobs import Job, JobRegistry
+
+
+def _mk_job(jid, argv=("sort", "-i", "x")):
+    return Job(jid, list(argv), "normal", argv0="fgumi-tpu")
+
+
+# ------------------------------------------------------------------ append
+
+def test_append_and_replay_roundtrip(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = journal_mod.JobJournal(path)
+    a, b = _mk_job("j-1"), _mk_job("j-2", argv=["simplex", "-i", "y"])
+    j.record_submit(a, dedupe="key-a")
+    j.record_submit(b)
+    a.state = "running"
+    j.record_state(a)
+    a.state = "done"
+    a.exit_status = 0
+    j.record_state(a)
+    j.close()
+
+    rep = journal_mod.replay(path)
+    assert rep.records == 4
+    assert rep.truncated_bytes == 0
+    assert [r["id"] for r in rep.jobs] == ["j-1", "j-2"]
+    assert rep.by_id["j-1"]["state"] == "done"
+    assert rep.by_id["j-1"]["exit_status"] == 0
+    assert rep.by_id["j-2"]["state"] == "queued"
+    assert rep.dedupe == {"key-a": "j-1"}
+    assert rep.max_job_num == 2
+    # requeue set: only the incomplete job, in submission order
+    assert [r["id"] for r in rep.incomplete()] == ["j-2"]
+
+
+def test_requeue_order_preserved(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = journal_mod.JobJournal(path)
+    for i in range(1, 6):
+        j.record_submit(_mk_job(f"j-{i}"))
+    # j-2 finished, j-4 cancelled; 1, 3, 5 were in flight or queued
+    done = _mk_job("j-2")
+    done.state = "done"
+    done.exit_status = 0
+    j.record_state(done)
+    cancelled = _mk_job("j-4")
+    cancelled.state = "cancelled"
+    j.record_state(cancelled)
+    running = _mk_job("j-1")
+    running.state = "running"
+    j.record_state(running)
+    j.close()
+    rep = journal_mod.replay(path)
+    assert [r["id"] for r in rep.incomplete()] == ["j-1", "j-3", "j-5"]
+
+
+def test_corrupt_tail_truncated_and_appendable(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = journal_mod.JobJournal(path)
+    j.record_submit(_mk_job("j-1"))
+    j.record_submit(_mk_job("j-2"))
+    j.close()
+    good_size = os.path.getsize(path)
+    with open(path, "ab") as f:
+        f.write(b'{"v": 1, "ev": "state", "id": "j-2", "sta')  # torn write
+    rep = journal_mod.replay(path)
+    assert rep.records == 2
+    assert rep.truncated_bytes > 0
+    assert os.path.getsize(path) == good_size  # file physically truncated
+    # the log continues cleanly after truncation
+    j2 = journal_mod.JobJournal(path)
+    j2.record_requeued("j-2")
+    j2.close()
+    rep2 = journal_mod.replay(path)
+    assert rep2.records == 3
+    assert rep2.truncated_bytes == 0
+
+
+def test_corrupt_tail_garbage_line(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = journal_mod.JobJournal(path)
+    j.record_submit(_mk_job("j-1"))
+    j.close()
+    with open(path, "ab") as f:
+        f.write(b"\x00\xff garbage not json\n")
+        f.write(json.dumps({"v": 1, "ev": "state", "id": "j-1",
+                            "state": "done", "exit_status": 0,
+                            "error": None}).encode() + b"\n")
+    rep = journal_mod.replay(path)
+    # the tail starts at the first bad line; the good-looking record
+    # after it is untrusted and dropped with it
+    assert rep.records == 1
+    assert rep.by_id["j-1"]["state"] == "queued"
+
+
+def test_replay_missing_file(tmp_path):
+    rep = journal_mod.replay(str(tmp_path / "absent.jsonl"))
+    assert rep.records == 0 and rep.jobs == [] and rep.dedupe == {}
+
+
+def test_version_mismatch_is_tail(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    with open(path, "wb") as f:
+        f.write(json.dumps({"v": 99, "ev": "submit", "id": "j-1",
+                            "argv": ["sort"]}).encode() + b"\n")
+    rep = journal_mod.replay(path)
+    assert rep.records == 0
+    assert rep.truncated_bytes > 0
+
+
+# ---------------------------------------------------------------- registry
+
+def test_registry_restore_preserves_and_skips_ids():
+    reg = JobRegistry()
+    done = _mk_job("j-7")
+    done.state = "done"
+    reg.restore(done)
+    assert reg.get("j-7").state == "done"
+    fresh = reg.create(["sort"], "normal")
+    assert fresh.id == "j-8"  # counter skipped past the restored id
+    with pytest.raises(ValueError):
+        reg.restore(_mk_job("j-7"))
+
+
+def test_registry_transition_hook_fires():
+    seen = []
+    reg = JobRegistry(on_transition=lambda job: seen.append(job.state))
+    job = reg.create(["sort"], "normal")
+    reg.mark_running(job)
+    reg.mark_done(job, 0)
+    assert seen == ["running", "done"]
+
+
+# ------------------------------------------------------ daemon integration
+
+@pytest.fixture
+def grouped_bam(tmp_path_factory):
+    from fgumi_tpu.cli import main as cli_main
+
+    path = str(tmp_path_factory.mktemp("journal") / "grouped.bam")
+    assert cli_main(["simulate", "grouped-reads", "-o", path,
+                     "--num-families", "10", "--family-size", "3",
+                     "--seed", "5"]) == 0
+    return path
+
+
+def test_daemon_requeues_incomplete_and_dedupes(tmp_path, grouped_bam):
+    """A journal left by a 'crashed' daemon drives requeue on start; the
+    requeued job runs to completion under its ORIGINAL id, and its dedupe
+    key answers resubmits with the finished record."""
+    jpath = str(tmp_path / "journal.jsonl")
+    out = str(tmp_path / "out.bam")
+    argv = ["sort", "-i", grouped_bam, "-o", out,
+            "--order", "template-coordinate"]
+    # simulate the dead daemon's journal: submitted + running, no terminal
+    j = journal_mod.JobJournal(jpath)
+    job = Job("j-3", argv, "normal", argv0="fgumi-tpu")
+    j.record_submit(job, dedupe="run-42")
+    job.state = "running"
+    j.record_state(job)
+    j.close()
+
+    svc = JobService(str(tmp_path / "s.sock"), workers=1,
+                     journal_path=jpath)
+    try:
+        svc.recover()
+        svc.scheduler.start()
+        restored = svc.registry.get("j-3")
+        assert restored is not None
+        done = threading.Event()
+        deadline = 60
+        import time
+
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline:
+            if svc.registry.get("j-3").state in ("done", "failed"):
+                done.set()
+                break
+            time.sleep(0.05)
+        assert done.is_set()
+        assert svc.registry.get("j-3").state == "done"
+        assert os.path.exists(out)
+        # idempotent resubmit: same dedupe key -> the finished job, and
+        # nothing is executed twice
+        resp = svc.handle_request({"v": 1, "op": "submit", "argv": argv,
+                                   "dedupe": "run-42"})
+        assert resp["ok"] and resp.get("deduped") is True
+        assert resp["job"]["id"] == "j-3"
+        # a NEW submission gets an id past the replayed ones
+        resp2 = svc.handle_request({"v": 1, "op": "submit",
+                                    "argv": ["sort", "-i", grouped_bam,
+                                             "-o", str(tmp_path / "o2.bam"),
+                                             "--order",
+                                             "template-coordinate"]})
+        assert resp2["ok"] and resp2["job"]["id"] == "j-4"
+        # ... and the journal recorded all of it for the NEXT restart
+        svc.close()
+        rep = journal_mod.replay(jpath)
+        assert rep.by_id["j-3"]["state"] == "done"
+        assert rep.dedupe["run-42"] == "j-3"
+    finally:
+        svc.close()
+
+
+def test_replay_does_not_rebind_cancelled_dedupe_key(tmp_path):
+    """An admission-rejected submit journals as submit+cancelled and its
+    key is released on the live daemon — replay must not rebind it, or a
+    post-restart retry would be answered with the rejected record instead
+    of executing."""
+    jpath = str(tmp_path / "journal.jsonl")
+    j = journal_mod.JobJournal(jpath)
+    rejected = _mk_job("j-1")
+    j.record_submit(rejected, dedupe="key-r")
+    rejected.state = "cancelled"
+    j.record_state(rejected)
+    done = _mk_job("j-2")
+    j.record_submit(done, dedupe="key-d")
+    done.state = "done"
+    done.exit_status = 0
+    j.record_state(done)
+    j.close()
+    svc = JobService(str(tmp_path / "s.sock"), journal_path=jpath)
+    try:
+        svc.recover()
+        assert "key-r" not in svc._dedupe       # released, like live
+        assert svc._dedupe.get("key-d") == "j-2"  # finished jobs keep theirs
+    finally:
+        svc.close()
+
+
+def test_recover_releases_dedupe_key_when_requeue_rejected(tmp_path):
+    """A requeue rejected by shrunken capacity on restart must release its
+    dedupe key (same contract as a live admission reject) — otherwise a
+    retry is answered with the cancelled record instead of executing."""
+    jpath = str(tmp_path / "journal.jsonl")
+    j = journal_mod.JobJournal(jpath)
+    j.record_submit(_mk_job("j-1"), dedupe="key-1")
+    j.record_submit(_mk_job("j-2"), dedupe="key-2")
+    j.close()
+    # capacity 1 (workers=1, queue_limit=0): only the first requeues
+    svc = JobService(str(tmp_path / "s.sock"), workers=1, queue_limit=0,
+                     journal_path=jpath)
+    try:
+        svc.recover()
+        assert svc._dedupe.get("key-1") == "j-1"
+        assert "key-2" not in svc._dedupe
+        assert svc.registry.get("j-2").state == "cancelled"
+    finally:
+        svc.close()
+
+
+def test_client_cancel_and_shutdown_never_retry(monkeypatch):
+    """cancel/shutdown responses are not idempotent: a reconnect after the
+    daemon already acted would surface a spurious failure. Idempotent ops
+    (status) keep the one bounded retry."""
+    from fgumi_tpu.serve import client as client_mod
+    from fgumi_tpu.serve.client import ServeClient, ServeError, _Retryable
+
+    monkeypatch.setattr(client_mod, "RECONNECT_DELAY_S", 0.0)
+    c = ServeClient("/nonexistent.sock")
+    calls = []
+
+    def once(obj, timeout=None):
+        calls.append(obj["op"])
+        raise _Retryable(ServeError("connection reset"))
+
+    monkeypatch.setattr(c, "_request_once", once)
+    for op in (lambda: c.cancel("j-1"), c.shutdown):
+        calls.clear()
+        with pytest.raises(ServeError):
+            op()
+        assert calls == [calls[0]]  # exactly one attempt
+    calls.clear()
+    with pytest.raises(ServeError):
+        c.status()
+    assert len(calls) == 2  # idempotent: one reconnect attempt
+
+
+def test_daemon_sweeps_stale_report_temps(tmp_path):
+    rpt = tmp_path / "reports"
+    rpt.mkdir()
+    jpath = str(tmp_path / "journal.jsonl")
+    j = journal_mod.JobJournal(jpath)
+    j.record_submit(_mk_job("j-1"))
+    mark = _mk_job("j-1")
+    mark.state = "done"
+    mark.exit_status = 0
+    j.record_state(mark)
+    j.close()
+    # dead-pid temp from "before the crash" (mtime predates the journal's
+    # last entry) is swept; live-pid temp survives
+    stale = rpt / ".j-1.report.json.tmp.999999.1"
+    stale.write_bytes(b"{")
+    os.utime(stale, (1, 1))
+    live = rpt / f".j-2.report.json.tmp.{os.getpid()}.1"
+    live.write_bytes(b"{")
+    svc = JobService(str(tmp_path / "s.sock"), report_dir=str(rpt),
+                     journal_path=jpath)
+    try:
+        svc.recover()
+        assert not stale.exists()
+        assert live.exists()
+    finally:
+        svc.close()

@@ -90,8 +90,12 @@ def test_padding_waste_reported_on_mixed_input(tmp_path, monkeypatch):
     from fgumi_tpu.ops.kernel import DEVICE_STATS
 
     # pad accounting only exists on the device path: the host engine
-    # (ops/host_kernel.py) consumes ragged rows with no padding at all
+    # (ops/host_kernel.py) consumes ragged rows with no padding at all.
+    # ROUTE=device: the adaptive cost model's process-global EWMAs (fed
+    # by every earlier test in the session) may otherwise price these
+    # small batches host-side and dispatch nothing
     monkeypatch.setenv("FGUMI_TPU_HOST_ENGINE", "0")
+    monkeypatch.setenv("FGUMI_TPU_ROUTE", "device")
     src = str(tmp_path / "mixed.bam")
     simulate_grouped_bam(src, num_families=200, family_size=4,
                          family_size_distribution="longtail",

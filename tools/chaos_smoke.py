@@ -117,7 +117,58 @@ def main():
                         p.returncode == 0 and got == ref,
                         f"rc={p.returncode}")
 
-        # 3) SIGKILL mid-write: no partial file under the final name
+        # 3) device wedge: a dispatch that never returns is abandoned at
+        # its deadline, the batch completes byte-identically on the host
+        # engine, the whole run costs seconds (bounded by the deadline,
+        # not the hang), and the run report records the breaker opening
+        # (ISSUE 7 acceptance)
+        # relative --run-report keeps argv — and hence @PG CL provenance —
+        # byte-identical between the wedged run and its pure-host twin
+        wedge_argv = ["--run-report", "report.json", "simplex", "-i", sim,
+                      "-o", "out.bam", "--min-reads", "1"]
+        d_host = os.path.join(tmp, "wedge_host_ref")
+        os.mkdir(d_host)
+        p = run(wedge_argv, env={"FGUMI_TPU_HOST_ENGINE": "1"}, cwd=d_host)
+        assert p.returncode == 0, p.stderr
+        host_ref = open(os.path.join(d_host, "out.bam"), "rb").read()
+        d = os.path.join(tmp, "wedge")
+        os.mkdir(d)
+        rpt = os.path.join(d, "report.json")
+        t0 = time.monotonic()
+        p = run(wedge_argv,
+                env={"FGUMI_TPU_HOST_ENGINE": "0",
+                     "FGUMI_TPU_ROUTE": "device",
+                     "FGUMI_TPU_FAULT": "device.wedge:hang:1.0:1",
+                     "FGUMI_TPU_FAULT_HANG_S": "30",
+                     "FGUMI_TPU_DISPATCH_DEADLINE_S": "2:5"},
+                cwd=d)
+        wedge_wall = time.monotonic() - t0
+        got = (open(os.path.join(d, "out.bam"), "rb").read()
+               if p.returncode == 0 else b"")
+        ok &= check("device.wedge -> degraded (exit 0), byte-identical "
+                    "to the pure host-engine run",
+                    p.returncode == 0 and got == host_ref,
+                    f"rc={p.returncode}")
+        # the wedge cost is the deadline, not the 30 s hang (generous
+        # bound: pipeline + interpreter startup ride along)
+        ok &= check("wedge cost bounded by the deadline",
+                    wedge_wall < 25, f"{wedge_wall:.1f}s")
+        try:
+            report = __import__("json").load(open(rpt))
+            dev = report.get("device", {})
+            br = dev.get("breaker", {})
+            ok &= check(
+                "report records deadline fallback + breaker opening",
+                dev.get("deadline_fallbacks", 0) >= 1
+                and any(t.get("to") == "open"
+                        for t in br.get("transitions", [])),
+                f"deadline_fallbacks={dev.get('deadline_fallbacks')} "
+                f"breaker={br.get('state')}")
+        except (OSError, ValueError) as e:
+            ok &= check("report records deadline fallback + breaker "
+                        "opening", False, str(e))
+
+        # 4) SIGKILL mid-write: no partial file under the final name
         victim = os.path.join(tmp, "victim.bam")
         code = (
             "import sys, time\n"

@@ -18,6 +18,12 @@ Scenarios (exit 0 when every check holds, one PASS/FAIL line each):
    cache gained no new entries).
 5. SIGTERM drain: a running job finishes and commits its output, new
    submissions are refused, and the daemon exits 0.
+6. SIGKILL + journal-driven restart (crash recovery): a daemon with
+   --journal is SIGKILL'd mid-job; the restarted daemon replaces the stale
+   socket, replays the journal, requeues the job under its ORIGINAL id,
+   and the output is byte-identical to the standalone run; an idempotent
+   resubmit with the same dedupe key returns the finished job instead of
+   running it twice.
 
 Usage:  python tools/serve_smoke.py [--keep]
 """
@@ -68,6 +74,21 @@ def wait_for_socket(path, timeout=60):
         if os.path.exists(path):
             return True
         time.sleep(0.1)
+    return False
+
+
+def wait_for_ping(client, timeout=120):
+    """Socket-file existence is not enough after a SIGKILL restart (the
+    stale file lingers until the new daemon claims it); ping instead."""
+    from fgumi_tpu.serve.client import ServeError
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            client.ping()
+            return True
+        except ServeError:
+            time.sleep(0.2)
     return False
 
 
@@ -223,6 +244,68 @@ def main():
                     open(os.path.join(wd_srv, "out1.bam"), "rb").read()
                     == open(os.path.join(wd_std, "out1.bam"), "rb").read())
         ok &= check("socket removed on exit", not os.path.exists(sock))
+
+        # --- SIGKILL + journal-driven restart (crash recovery) ----------
+        kill_job = ["simplex", "-i", inp, "-o", "out_kill.bam",
+                    "--min-reads", "1"]
+        p = run(kill_job, cwd=wd_std)
+        assert p.returncode == 0, p.stderr
+        wd_kill = os.path.join(tmp, "daemon_kill")
+        os.makedirs(wd_kill)
+        jr = os.path.join(tmp, "journal.jsonl")
+        sock2 = os.path.join(tmp, "serve2.sock")
+        serve_argv = [sys.executable, "-m", "fgumi_tpu", "serve",
+                      "--socket", sock2, "--workers", "1",
+                      "--report-dir", rpt, "--compile-cache", cache,
+                      "--journal", jr]
+        daemon = subprocess.Popen(serve_argv, cwd=wd_kill, env=BASE_ENV,
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True)
+        client2 = ServeClient(sock2, timeout=30)
+        ok &= check("journaled daemon up", wait_for_ping(client2))
+        jk = client2.submit(kill_job, argv0=argv0, dedupe="kill-restart")
+        # kill mid-job: wait until the journal records it running
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if client2.job(jk["id"])["state"] == "running":
+                break
+            time.sleep(0.05)
+        daemon.kill()  # SIGKILL: no drain, no cleanup, socket left behind
+        daemon.wait(timeout=30)
+        ok &= check("SIGKILL leaves the stale socket behind",
+                    os.path.exists(sock2))
+        ok &= check("killed job never published output",
+                    not os.path.exists(os.path.join(wd_kill,
+                                                    "out_kill.bam")))
+        # restart: stale socket replaced, journal replayed, job requeued
+        daemon = subprocess.Popen(serve_argv, cwd=wd_kill, env=BASE_ENV,
+                                  stdout=subprocess.PIPE,
+                                  stderr=subprocess.STDOUT, text=True)
+        ok &= check("restarted daemon claims the stale socket",
+                    wait_for_ping(client2))
+        try:
+            jk2 = client2.wait(jk["id"], timeout=240)
+        except ServeError as e:
+            jk2 = {"state": f"lost ({e})"}
+        ok &= check("requeued job finishes under its original id",
+                    jk2.get("state") == "done", str(jk2.get("state")))
+        a = open(os.path.join(wd_std, "out_kill.bam"), "rb").read()
+        b_path = os.path.join(wd_kill, "out_kill.bam")
+        b = open(b_path, "rb").read() if os.path.exists(b_path) else b""
+        ok &= check("recovered output byte-identical to standalone",
+                    a == b, f"{len(a)} vs {len(b)} bytes")
+        leftovers = [n for n in os.listdir(wd_kill) if ".tmp." in n]
+        ok &= check("no temp leftovers after recovery", not leftovers,
+                    ",".join(leftovers))
+        # idempotent resubmit: the dedupe key survived the restart
+        jk3 = client2.submit(kill_job, argv0=argv0, dedupe="kill-restart")
+        ok &= check("dedupe key resolves to the recovered job",
+                    jk3["id"] == jk["id"] and jk3["state"] == "done",
+                    f"{jk3['id']} ({jk3['state']})")
+        client2.shutdown()
+        rc2 = daemon.wait(timeout=240)
+        ok &= check("journaled daemon exits 0", rc2 == 0, f"rc={rc2}")
+        daemon = None
     finally:
         if daemon is not None and daemon.poll() is None:
             daemon.kill()

@@ -3096,6 +3096,12 @@ def _add_serve(sub):
                    help="queued jobs admitted beyond the running ones; "
                         "submissions past workers+queue-limit are rejected "
                         "with an explicit reason")
+    p.add_argument("--max-per-client", type=int, default=0,
+                   help="per-submitter admission quota: a `submit "
+                        "--client ID` may hold at most this many active "
+                        "(queued+running) jobs; over-quota submits are "
+                        "rejected with an explicit reason (0 = unlimited; "
+                        "anonymous submits are never limited)")
     p.add_argument("--report-dir", default=None, metavar="DIR",
                    help="write per-job run reports (<job>.report.json) and "
                         "on-request traces here (created if missing)")
@@ -3134,6 +3140,9 @@ def cmd_serve(args):
     if args.queue_limit < 0:
         log.error("--queue-limit must be >= 0")
         return 2
+    if args.max_per_client < 0:
+        log.error("--max-per-client must be >= 0")
+        return 2
     if args.max_frame_bytes is not None and args.max_frame_bytes < 1024:
         # a sub-1KiB cap cannot carry a realistic submit frame, and 0 or a
         # negative value would defeat the size limit entirely
@@ -3157,7 +3166,8 @@ def cmd_serve(args):
         args.socket, workers=args.workers, queue_limit=args.queue_limit,
         report_dir=args.report_dir,
         max_frame_bytes=args.max_frame_bytes or _proto.MAX_FRAME_BYTES,
-        journal_path=args.journal, health_period_s=health)
+        journal_path=args.journal, health_period_s=health,
+        max_per_client=args.max_per_client)
     # claim the socket BEFORE the device warm-up: an accidental duplicate
     # start must fail fast without touching the single-tenant chip
     try:
@@ -3212,6 +3222,10 @@ def _add_submit(sub):
                         "returns the original job (even across a daemon "
                         "restart with serve --journal) instead of running "
                         "it twice")
+    p.add_argument("--client", default=None, metavar="ID",
+                   help="submitter identity for the daemon's per-client "
+                        "admission quota (serve --max-per-client); "
+                        "omitted = anonymous, never quota-limited")
     p.add_argument("--no-wait", action="store_true",
                    help="return immediately after admission (poll later "
                         "with `fgumi-tpu jobs`)")
@@ -3237,7 +3251,8 @@ def cmd_submit(args):
     client = ServeClient(args.socket)
     try:
         job = client.submit(job_argv, priority=args.priority, tag=args.tag,
-                            trace=args.job_trace, dedupe=args.dedupe)
+                            trace=args.job_trace, dedupe=args.dedupe,
+                            client=args.client)
     except ServeError as e:
         log.error("submit: %s", e)
         return 2
@@ -3405,8 +3420,11 @@ _main_depth = contextvars.ContextVar("fgumi_tpu_main_depth", default=0)
 
 def _run_command(args):
     """Dispatch to the subcommand with the top-level exception contract."""
+    import errno as _errno
+
     from .io.errors import InputFormatError
     from .utils.faults import InjectedFault
+    from .utils.governor import GOVERNOR, ResourceExhausted
 
     try:
         return args.func(args)
@@ -3420,14 +3438,30 @@ def _run_command(args):
         # *clean* failure (distinct rc so the harness can tell it apart)
         log.error("%s", e)
         return 3
+    except ResourceExhausted as e:
+        # resource hard limit (disk full, RSS hard watermark): atomic temps
+        # were swept by the ordinary error unwinding; the run report gets a
+        # `resource` section from the governor's event log
+        log.error("%s", e)
+        return 4
     except BrokenPipeError:
-        # detach stdout so the interpreter's exit-time flush of the
-        # still-buffered stream doesn't print "Exception ignored" noise
+        # before the OSError backstop: BrokenPipeError IS an OSError, and a
+        # bare raise there would skip this clause entirely. Detach stdout so
+        # the interpreter's exit-time flush of the still-buffered stream
+        # doesn't print "Exception ignored" noise
         try:
             os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
         except OSError:
             pass
         return 1
+    except OSError as e:
+        if e.errno == _errno.ENOSPC:
+            # backstop for any disk write not explicitly hardened: same
+            # exit-code contract as the converted paths
+            GOVERNOR.record_event("enospc", where="unhandled")
+            log.error("disk full: %s", e)
+            return 4
+        raise
     except KeyboardInterrupt:
         log.error("interrupted")
         return 130
@@ -3544,6 +3578,12 @@ def _main_scoped(args, argv):
     """The depth-0 command body: telemetry lifecycle around the dispatch
     (runs inside this invocation's telemetry scope)."""
     trace_path, report_path, hb_s = _telemetry_config(args)
+    # arm the process-wide resource governor (dynamic budget rebalancing +
+    # memory/disk pressure sentinels; FGUMI_TPU_GOVERNOR=0 keeps every
+    # budget static). Idempotent — the thread is shared across commands.
+    from .utils.governor import GOVERNOR
+
+    GOVERNOR.maybe_start()
     tracer = hb = None
     if trace_path:
         from .observe.trace import start_trace
@@ -3579,6 +3619,7 @@ def _main_scoped(args, argv):
             from .observe.report import emit, fold_device_stats
 
             fold_device_stats()
+            GOVERNOR.fold_metrics()
             report = emit(report_path, args.command,
                           list(argv) if argv is not None else sys.argv[1:],
                           t0_unix, time.monotonic() - t0, rc, trace_path)

@@ -29,6 +29,16 @@ BGZF_EOF = bytes.fromhex(
 _HEADER = struct.Struct("<4BI2BH2BHH")  # gzip header + XLEN + BC subfield + BSIZE
 
 
+def _reraise_disk_full(exc: BaseException, fileobj):
+    """A full output disk becomes the resource clean-failure contract
+    (ResourceExhausted -> exit 4, resource section in the run report)
+    instead of an anonymous mid-write OSError traceback; every other
+    exception returns so the caller re-raises the original."""
+    from ..utils.governor import reraise_enospc
+
+    reraise_enospc(exc, "bgzf.write", path=getattr(fileobj, "name", None))
+
+
 def _block_header(bsize_minus1: int) -> bytes:
     return _HEADER.pack(
         0x1F, 0x8B, 0x08, 0x04,  # magic, deflate, FEXTRA
@@ -88,8 +98,9 @@ class BgzfWriter(io.RawIOBase):
     def write(self, data) -> int:
         try:
             return self._write(data)
-        except BaseException:
+        except BaseException as e:
             self._broken = True
+            _reraise_disk_full(e, self._f)
             raise
 
     def _write(self, data) -> int:
@@ -145,8 +156,9 @@ class BgzfWriter(io.RawIOBase):
         """
         try:
             return self._write_indexed(blob, starts)
-        except BaseException:
+        except BaseException as e:
             self._broken = True
+            _reraise_disk_full(e, self._f)
             raise
 
     def _write_indexed(self, blob, starts):
@@ -202,8 +214,9 @@ class BgzfWriter(io.RawIOBase):
                     self._coffset += len(block)
                     self._f.write(block)
                 self._buf.clear()
-        except BaseException:
+        except BaseException as e:
             self._broken = True
+            _reraise_disk_full(e, self._f)
             raise
 
     def close(self):
@@ -213,8 +226,13 @@ class BgzfWriter(io.RawIOBase):
             self.discard()
             return
         self.flush()
-        self._f.write(BGZF_EOF)
-        self._f.flush()
+        try:
+            self._f.write(BGZF_EOF)
+            self._f.flush()
+        except BaseException as e:
+            self._broken = True
+            _reraise_disk_full(e, self._f)
+            raise
         self._coffset += len(BGZF_EOF)
         if not self._counted:
             self._counted = True

@@ -24,7 +24,7 @@ _lock = threading.Lock()
 _lib = None
 _lib_failed = False
 # must equal fgumi_abi_version() in fgumi_native.cc (stale-.so guard)
-_ABI_VERSION = 13
+_ABI_VERSION = 14
 
 
 def _build() -> bool:
@@ -194,6 +194,10 @@ def _declare(lib):
     lib.fgumi_merge_open.restype = ctypes.c_void_p
     lib.fgumi_merge_open.argtypes = [ctypes.c_char_p, ctypes.c_long,
                                      ctypes.c_long]
+    lib.fgumi_merge_open2.restype = ctypes.c_void_p
+    lib.fgumi_merge_open2.argtypes = [ctypes.c_char_p, ctypes.c_long,
+                                      ctypes.c_long, ctypes.c_int,
+                                      ctypes.c_long]
     lib.fgumi_merge_next.restype = ctypes.c_long
     lib.fgumi_merge_next.argtypes = [
         ctypes.c_void_p, p, ctypes.c_long, p, ctypes.c_long,

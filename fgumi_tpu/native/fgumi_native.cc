@@ -9,10 +9,14 @@
 #include <libdeflate.h>
 
 #include <algorithm>
+#include <cerrno>
 #include <cmath>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <deque>
+#include <mutex>
 #include <string>
 #include <thread>
 #include <utility>
@@ -88,7 +92,7 @@ extern "C" {
 // ABI version for the stale-.so guard in __init__.py: bump whenever any
 // exported signature changes (a symbol probe alone cannot detect an
 // argument-list change in an existing function).
-long fgumi_abi_version() { return 13; }
+long fgumi_abi_version() { return 14; }
 
 // Candidate UMI pairs with hamming(A[i], B[j]) <= d over (n, L)/(m, L) byte
 // matrices, via the d+1-part pigeonhole (umi/assigners.py
@@ -2752,6 +2756,7 @@ constexpr long kRunEntryHeader = 6;
 
 bool write_frame(FILE* f, const uint8_t* buf, long n, int level,
                  std::vector<uint8_t>* scratch) {
+  errno = 0;  // a compression failure must not report a stale errno
   const size_t bound = libdeflate_zlib_compress_bound(
       compressor(level), static_cast<size_t>(n));
   if (scratch->size() < bound) scratch->resize(bound);
@@ -2771,14 +2776,17 @@ bool write_frame(FILE* f, const uint8_t* buf, long n, int level,
 }  // namespace
 
 // Write one sorted spill run: entries in perm order, framed and compressed.
-// Returns 0 on success, -1 on I/O or compression failure.
+// Returns 0 on success, -errno on I/O failure (so the Python layer can map
+// ENOSPC onto the resource clean-failure contract), -9999 on a
+// compression/internal failure with no meaningful errno.
 long fgumi_write_run(const uint8_t* path, const uint8_t* keys,
                      const int64_t* koff, const int32_t* klen,
                      const uint8_t* recs, const int64_t* roff,
                      const int32_t* rlen, const int64_t* perm, long n,
                      long frame_bytes, int level) {
+  errno = 0;
   FILE* f = fopen(reinterpret_cast<const char*>(path), "wb");
-  if (f == nullptr) return -1;
+  if (f == nullptr) return errno ? -errno : -9999;
   std::vector<uint8_t> frame;
   std::vector<uint8_t> scratch;
   frame.reserve(static_cast<size_t>(frame_bytes) + (64 << 10));
@@ -2805,12 +2813,24 @@ long fgumi_write_run(const uint8_t* path, const uint8_t* keys,
                      &scratch);
   }
   if (fclose(f) != 0) ok = false;
-  return ok ? 0 : -1;
+  if (ok) return 0;
+  // a failed fwrite/fclose leaves errno set (write_frame zeroes it before
+  // compressing, so a pure compression failure reports -9999, not a stale
+  // errno from an unrelated earlier syscall)
+  return errno ? -errno : -9999;
 }
 
 namespace {
 
+struct MergeState;  // fwd (prefetch pool lives on the merge state)
+
 // One spill run being merged: streams frames, exposes the current entry.
+// With a prefetch pool attached (fgumi_merge_open2) the NEXT frame's
+// read+decompress runs on a worker thread while the heap consumes the
+// current one — the reference work-steals spill decompression during the
+// merge exactly like this (fgumi-sort/src/worker_pool.rs:25-31). Heap
+// order is untouched: prefetch only changes WHEN a frame decodes, never
+// which entry is next.
 struct RunReader {
   FILE* f = nullptr;
   std::vector<uint8_t> frame;
@@ -2820,25 +2840,38 @@ struct RunReader {
   uint32_t klen = 0;
   const uint8_t* rec = nullptr;
   uint32_t rlen = 0;
+  // prefetch slot (guarded by MergeState::mu; worker owns f while pending)
+  MergeState* pf = nullptr;  // non-null once a pool is attached
+  int idx = -1;
+  std::vector<uint8_t> next_frame;
+  bool next_eof = false;
+  bool next_ok = true;
+  // 0 = nothing scheduled, 1 = queued (stealable by the merge thread),
+  // 2 = ready, 3 = decoding on a worker
+  int pf_state = 0;
 
-  bool load_frame() {
+  // Read+decompress one frame into (dst, dst_eof). Returns false on
+  // corrupt input. Thread-safe per run: only one reader (worker OR merge
+  // thread) touches f at a time.
+  bool read_frame_into(std::vector<uint8_t>* dst, bool* dst_eof) {
     uint8_t hdr[8];
+    *dst_eof = false;
     if (fread(hdr, 1, 8, f) != 8) {
-      eof = true;
+      *dst_eof = true;
       return true;  // clean EOF
     }
     const uint32_t c = read_u32(hdr);
     const uint32_t u = read_u32(hdr + 4);
     std::vector<uint8_t> comp(c);
     if (fread(comp.data(), 1, c, f) != c) return false;
-    frame.resize(u);
+    dst->resize(u);
     size_t actual = 0;
     const libdeflate_result r = libdeflate_zlib_decompress(
-        decompressor(), comp.data(), c, frame.data(), u, &actual);
-    if (r != LIBDEFLATE_SUCCESS || actual != u) return false;
-    pos = 0;
-    return true;
+        decompressor(), comp.data(), c, dst->data(), u, &actual);
+    return r == LIBDEFLATE_SUCCESS && actual == u;
   }
+
+  bool load_frame();  // defined after MergeState (uses the pool)
 
   // Advance to the next entry; false on corrupt input (eof flag on clean end).
   bool next() {
@@ -2862,6 +2895,80 @@ struct RunReader {
 struct MergeState {
   std::vector<RunReader> runs;
   std::vector<int> heap;  // indices into runs, min-heap by (key, run index)
+
+  // ---- frame prefetch pool (empty = fully synchronous merge) ----
+  std::vector<std::thread> pool;
+  std::deque<int> work;
+  std::mutex mu;
+  std::condition_variable work_cv;  // workers: work arrived / stopping
+  std::condition_variable done_cv;  // merge thread: a frame became ready
+  bool stopping = false;
+  long max_prefetch = 0;  // frame-slot budget across all runs
+  long slots = 0;         // pending + ready (unconsumed) prefetched frames
+
+  // call with mu held; silently skips when the budget is spent (the merge
+  // thread then loads that run's frame inline — bounded memory, no
+  // deadlock, identical output)
+  void schedule_locked(int i) {
+    RunReader& r = runs[static_cast<size_t>(i)];
+    if (r.pf_state != 0 || r.eof || slots >= max_prefetch) return;
+    slots += 1;
+    r.pf_state = 1;
+    work.push_back(i);
+    work_cv.notify_one();
+  }
+
+  void worker_loop() {
+    for (;;) {
+      int i;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        work_cv.wait(lk, [&] { return stopping || !work.empty(); });
+        if (stopping) return;
+        i = work.front();
+        work.pop_front();
+        // claim before decoding: the merge thread steals QUEUED (1)
+        // frames back for inline decode, but waits for DECODING (3) ones
+        runs[static_cast<size_t>(i)].pf_state = 3;
+      }
+      RunReader& r = runs[static_cast<size_t>(i)];
+      const bool ok = r.read_frame_into(&r.next_frame, &r.next_eof);
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        r.next_ok = ok;
+        r.pf_state = 2;
+        done_cv.notify_all();
+      }
+    }
+  }
+
+  void start_pool(int n_threads, long max_frames) {
+    max_prefetch = max_frames;
+    for (int i = 0; i < static_cast<int>(runs.size()); ++i) {
+      runs[static_cast<size_t>(i)].pf = this;
+      runs[static_cast<size_t>(i)].idx = i;
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      for (int i = 0; i < static_cast<int>(runs.size()); ++i) {
+        schedule_locked(i);
+      }
+    }
+    for (int t = 0; t < n_threads; ++t) {
+      pool.emplace_back([this] { worker_loop(); });
+    }
+  }
+
+  void stop_pool() {
+    if (pool.empty()) return;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stopping = true;
+      work_cv.notify_all();
+    }
+    for (std::thread& t : pool) t.join();
+    pool.clear();
+  }
 
   // (key, run index) — runs are ingest-ordered chunks, so the run-index
   // tiebreak reproduces the global ingest-ordinal total order the Python
@@ -2899,12 +3006,64 @@ struct MergeState {
   }
 };
 
+bool RunReader::load_frame() {
+  if (pf == nullptr || pf->pool.empty()) {
+    // synchronous path (fgumi_merge_open / no prefetch budget)
+    const bool ok = read_frame_into(&frame, &eof);
+    if (ok && !eof) pos = 0;
+    return ok;
+  }
+  MergeState* st = pf;
+  std::unique_lock<std::mutex> lk(st->mu);
+  if (pf_state == 1) {
+    // still queued: steal it back (reference worker_pool work-stealing) —
+    // the merge thread must never idle behind a backlog of decodes for
+    // runs it does not need yet
+    for (auto it = st->work.begin(); it != st->work.end(); ++it) {
+      if (*it == idx) {
+        st->work.erase(it);
+        break;
+      }
+    }
+    st->slots -= 1;
+    pf_state = 0;
+  }
+  if (pf_state == 0) {
+    // nothing in flight for this run (budget gate or a steal): load
+    // inline (off the lock — only this thread touches f when no prefetch
+    // is pending), then try to schedule the frame after
+    lk.unlock();
+    const bool ok = read_frame_into(&frame, &eof);
+    pos = 0;
+    if (ok && !eof) {
+      std::lock_guard<std::mutex> lk2(st->mu);
+      st->schedule_locked(idx);
+    }
+    return ok;
+  }
+  st->done_cv.wait(lk, [&] { return pf_state == 2; });
+  pf_state = 0;
+  st->slots -= 1;
+  if (!next_ok) return false;
+  frame.swap(next_frame);
+  eof = next_eof;
+  pos = 0;
+  if (!eof) st->schedule_locked(idx);
+  return true;
+}
+
 }  // namespace
 
 void fgumi_merge_close(void* handle);  // forward (used on open failure)
 
-// Open a k-way merge over '\n'-joined run paths. Returns nullptr on failure.
-void* fgumi_merge_open(const uint8_t* paths, long paths_len, long n_runs) {
+// Open a k-way merge over '\n'-joined run paths with an optional frame
+// prefetch pool: n_threads workers read+decompress each run's next frame
+// while the heap drains the current one, holding at most
+// max_prefetch_frames decoded frames beyond the per-run current ones
+// (the governor's merge-prefetch budget / frame size). Returns nullptr on
+// failure.
+void* fgumi_merge_open2(const uint8_t* paths, long paths_len, long n_runs,
+                        int n_threads, long max_prefetch_frames) {
   MergeState* st = new MergeState();
   st->runs.resize(static_cast<size_t>(n_runs));
   long start = 0;
@@ -2933,7 +3092,14 @@ void* fgumi_merge_open(const uint8_t* paths, long paths_len, long n_runs) {
       st->sift_up(st->heap.size() - 1);
     }
   }
+  if (n_threads > 0 && max_prefetch_frames > 0 && n_runs > 1) {
+    st->start_pool(n_threads, max_prefetch_frames);
+  }
   return st;
+}
+
+void* fgumi_merge_open(const uint8_t* paths, long paths_len, long n_runs) {
+  return fgumi_merge_open2(paths, paths_len, n_runs, 0, 0);
 }
 
 // Emit merged records (wire bytes, concatenated) into out, up to cap bytes
@@ -2964,6 +3130,7 @@ long fgumi_merge_next(void* handle, uint8_t* out, long cap, int32_t* rec_lens,
 
 void fgumi_merge_close(void* handle) {
   MergeState* st = static_cast<MergeState*>(handle);
+  st->stop_pool();  // join workers before their FILE*s go away
   for (RunReader& r : st->runs) {
     if (r.f != nullptr) fclose(r.f);
   }

@@ -104,6 +104,13 @@ class Heartbeat:
         rss = _rss_mb()
         if rss is not None:
             parts.append(f"rss={rss}MB")
+        # resource pressure (utils/governor.py): only worth a column when
+        # the run is actually degrading
+        import sys
+
+        gov = sys.modules.get("fgumi_tpu.utils.governor")
+        if gov is not None and gov.GOVERNOR.state != "ok":
+            parts.append(f"pressure={gov.GOVERNOR.state}")
         log.info(" ".join(parts))
 
     def stop(self):

@@ -55,6 +55,9 @@ _OPTIONAL = {
     "io": dict,         # {"bytes_read","bytes_written"}
     "records": dict,    # progress label -> count
     "faults": dict,     # fault point -> fired count
+    "resource": dict,   # governor snapshot: pressure state, events
+                        # (enospc/watermarks), budget rebalancing counters
+                        # (utils/governor.py)
     "trace_path": str,
     "hostname": str,
 }
@@ -177,6 +180,14 @@ def build_report(command: str, argv, started_unix: float, wall_s: float,
     fired = {p: n for p, n in faults.snapshot().items() if n}
     if fired:
         report["faults"] = fired
+    # resource governance: anything beyond a quiet run — a pressure
+    # transition, an ENOSPC event, admission sheds, budget rebalancing —
+    # rides along so a degraded or resource-failed run's artifact explains
+    # itself (the ISSUE 8 acceptance reads the `resource` section straight
+    # out of the report of an injected disk-full run)
+    gov = sys.modules.get("fgumi_tpu.utils.governor")
+    if gov is not None and gov.GOVERNOR.has_activity():
+        report["resource"] = gov.GOVERNOR.snapshot()
     if trace_path:
         report["trace_path"] = trace_path
     return report

@@ -661,34 +661,72 @@ class DeviceFeeder:
         self._inflight = 0  # dispatched to device, not yet resolved
         self._inflight_bytes = 0
         self._depth = None
-        self._byte_budget = None
+        self._byte_budget = None  # DynamicBudget once configured
+        self._gov_token = None
+        self.gate_wait_s = 0.0  # time the depth/byte gate held a dispatch
         self._async_copy_warned = set()  # leaf types logged once (debug)
 
-    def _config(self):
-        if self._depth is None:
-            import os
+    def _budget_resized(self):
+        # a governor grow must release a gate-blocked feeder immediately
+        with self._cv:
+            self._cv.notify_all()
 
-            try:
-                # floor 2, not 1: the OOM-recovery path resolves a failed
-                # ticket and then dispatches+resolves its two halves in
-                # order, which needs one slot of headroom past the batch
-                # a deferred-resolve caller may still hold (the class
-                # invariant above: depth >= 2 tolerates nested tickets)
-                depth = max(
-                    int(os.environ.get("FGUMI_TPU_FEEDER_DEPTH", "2")), 2)
-            except ValueError:
-                depth = 2
-            try:
-                budget = max(
-                    int(os.environ.get("FGUMI_TPU_FEEDER_BYTES",
-                                       str(256 << 20))), 1 << 20)
-            except ValueError:
-                budget = 256 << 20
-            # publish the _depth sentinel LAST: concurrent readers gate on
-            # it, so budget must already be visible when they proceed
-            self._byte_budget = budget
-            self._depth = depth
-        return self._depth, self._byte_budget
+    def _config(self):
+        # under the feeder condition (an RLock, so the feeder loop's locked
+        # call re-enters fine): first use races the unlocked readers (the
+        # depth property) against the feeder thread, and the governor
+        # registration below must happen exactly once — a double register
+        # would count a phantom 256 MiB against the global cap forever
+        with self._cv:
+            if self._depth is None:
+                import os
+
+                try:
+                    # floor 2, not 1: the OOM-recovery path resolves a
+                    # failed ticket and then dispatches+resolves its two
+                    # halves in order, which needs one slot of headroom
+                    # past the batch a deferred-resolve caller may still
+                    # hold (the class invariant above: depth >= 2
+                    # tolerates nested tickets)
+                    depth = max(
+                        int(os.environ.get("FGUMI_TPU_FEEDER_DEPTH", "2")),
+                        2)
+                except ValueError:
+                    depth = 2
+                try:
+                    budget = max(
+                        int(os.environ.get("FGUMI_TPU_FEEDER_BYTES",
+                                           str(256 << 20))), 1 << 20)
+                except ValueError:
+                    budget = 256 << 20
+                # the upload budget is a governed DynamicBudget: the env
+                # value seeds it, the ResourceGovernor may grow it when the
+                # gate is the contended queue (demand signal: gate_wait_s)
+                # or shrink it toward the floor under memory pressure
+                # (utils/governor.py)
+                from ..utils.governor import GOVERNOR, DynamicBudget
+
+                b = DynamicBudget("device.feeder", budget,
+                                  floor=min(budget, 32 << 20))
+                b.on_resize = self._budget_resized
+                # re-registering (env-driven reconfigure, per-test feeders)
+                # must not leak the previous entry: stale budgets would
+                # keep counting against the governor's global cap forever
+                GOVERNOR.unregister_budget(self._gov_token)
+                self._gov_token = GOVERNOR.register_budget(
+                    b, demand_fn=lambda: {"put_wait_s": self.gate_wait_s,
+                                          "get_wait_s": 0.0})
+                self._byte_budget = b
+                self._depth = depth
+            return self._depth, self._byte_budget.limit
+
+    def ungovern(self):
+        """Release this feeder's governor registration (tests tearing down
+        throwaway feeders; the process singleton keeps its entry)."""
+        from ..utils.governor import GOVERNOR
+
+        GOVERNOR.unregister_budget(self._gov_token)
+        self._gov_token = None
 
     @property
     def depth(self) -> int:
@@ -836,21 +874,32 @@ class DeviceFeeder:
                         self._thread = None
                         return
                     self._cv.wait()
-                depth, budget = self._config()
+                depth, _ = self._config()
                 # depth/byte gate: hold the NEXT dispatch until an earlier
                 # one resolves. Skipped in drain mode — the queue must run
                 # dry even if no resolver is coming back for stragglers.
                 # Bounded wait: a caller that died without resolving its
                 # ticket (dropped pending chunk on a crashed pipeline)
                 # must degrade to the old unpipelined behavior, never
-                # freeze every later dispatch in the process.
+                # freeze every later dispatch in the process. The byte
+                # limit is re-read every iteration: the governor may grow it
+                # mid-wait (its resize hook notifies this condition).
                 ticket = self._q[0][2]
                 deadline = None
                 while (not self._exit and self._q
                        and (self._inflight >= depth
                             or (self._inflight > 0
                                 and self._inflight_bytes
-                                + ticket.upload_bytes > budget))):
+                                + ticket.upload_bytes
+                                > self._byte_budget.limit))):
+                    # the demand signal must name the *byte budget* as the
+                    # gate, not the depth clause: growing bytes cannot
+                    # release a depth-held dispatch, and a device-bound run
+                    # waits here constantly — counting that would make the
+                    # governor inflate this budget to its ceiling for
+                    # nothing (starving genuinely byte-bound queues of the
+                    # global cap)
+                    byte_bound = self._inflight < depth
                     if deadline is None:
                         deadline = time.monotonic() + 60.0
                     left = deadline - time.monotonic()
@@ -861,7 +910,10 @@ class DeviceFeeder:
                             "dispatch ticket was likely dropped without "
                             "resolution)", self._inflight)
                         break
+                    t_wait = time.monotonic()
                     self._cv.wait(min(left, 1.0))
+                    if byte_bound:
+                        self.gate_wait_s += time.monotonic() - t_wait
                     ticket = self._q[0][2] if self._q else None
                 if not self._q:
                     continue

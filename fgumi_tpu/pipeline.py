@@ -79,42 +79,15 @@ class _Err:
 _DONE = object()
 
 
-class _ByteBudget:
-    """Bytes-in-flight governor for the input queue (the byte-accurate
-    analog of the reference's MemoryTracker hysteresis, base.rs:466-625):
-    producers block while admitting another item would exceed the limit,
-    except that one item is always admitted (an oversized batch degrades to
-    serial flow instead of deadlocking). limit <= 0 disables accounting."""
-
-    def __init__(self, limit: int):
-        self.limit = int(limit)
-        self.used = 0
-        self.peak = 0
-        self._cv = threading.Condition()
-
-    def acquire(self, n: int, stop) -> bool:
-        if self.limit <= 0:
-            return True
-        with self._cv:
-            while self.used > 0 and self.used + n > self.limit:
-                if stop.is_set():
-                    return False
-                self._cv.wait(0.1)
-            self.used += n
-            self.peak = max(self.peak, self.used)
-            return True
-
-    def release(self, n: int):
-        if self.limit <= 0:
-            return
-        with self._cv:
-            self.used -= n
-            self._cv.notify_all()
-
-    def widen(self, factor: int = 2):
-        with self._cv:
-            self.limit *= factor
-            self._cv.notify_all()
+# The input queue's bytes-in-flight governor (the byte-accurate analog of
+# the reference's MemoryTracker hysteresis, base.rs:466-625, now the shared
+# dynamic-budget primitive): producers block while admitting another item
+# would exceed the limit, except that one item is always admitted (an
+# oversized batch degrades to serial flow instead of deadlocking);
+# limit <= 0 disables accounting. run_stages registers it with the
+# process-wide ResourceGovernor so a demand-starved input queue can borrow
+# budget from idle ones (utils/governor.py).
+from .utils.governor import DynamicBudget as _ByteBudget  # noqa: E402
 
 
 class _Watchdog:
@@ -387,8 +360,25 @@ def _run_stages_impl(source_iter, process_fn, sink_fn, threads, queue_items,
     q_out = queue.Queue(maxsize=queue_items * 2)
     writer_exc = []
     counters = [0, 0, 0]  # read, processed, written
-    stop = threading.Event()  # error path: tell the reader to die promptly
-    budget = _ByteBudget(max_bytes if item_bytes is not None else 0)
+    # a StopSignal, not a bare Event: budget.acquire subscribes its
+    # condition so cancellation wakes a blocked reader immediately instead
+    # of at the next 100 ms poll tick
+    from .utils.governor import GOVERNOR, StopSignal
+
+    stop = StopSignal()  # error path: tell the reader to die promptly
+    budget = _ByteBudget("pipeline.input",
+                         max_bytes if item_bytes is not None else 0)
+    # under governance the input budget competes for the process cap with
+    # the fused-chain channels and the device feeder; its demand signal is
+    # the reader's own acquire wait (producer starved) vs the process
+    # stage's empty-queue wait (consumer starved)
+    gov_token = None
+    if budget.limit > 0:
+        gov_token = GOVERNOR.register_budget(
+            budget,
+            demand_fn=lambda: {
+                "put_wait_s": budget.wait_s,
+                "get_wait_s": stats.blocked.get("process", 0.0)})
 
     def put_in(item) -> bool:
         while not stop.is_set():
@@ -568,8 +558,17 @@ def _run_stages_impl(source_iter, process_fn, sink_fn, threads, queue_items,
                 pass
             rt.join(timeout=0.2)
         _hb.unregister_gauge(hb_token)
+        GOVERNOR.unregister_budget(gov_token)
     if writer_exc:
         raise writer_exc[0]
     if budget.limit > 0:
         stats.peak_in_flight_bytes = budget.peak
+        # used/peak/limit land in METRICS as governor.budget.* gauges so
+        # the run report can answer "was the input queue budget-bound"
+        from .observe.metrics import METRICS
+
+        p = f"governor.budget.{budget.name}"
+        METRICS.set(f"{p}.limit", budget.limit)
+        METRICS.max(f"{p}.peak", budget.peak)
+        METRICS.inc(f"{p}.wait_s", round(budget.wait_s, 6))
     return stats

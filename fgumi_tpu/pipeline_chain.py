@@ -101,9 +101,25 @@ class ChainChannel:
     """
 
     def __init__(self, name: str, max_bytes: int = None):
+        from .utils.governor import GOVERNOR, DynamicBudget
+
         self.name = name
-        self.max_bytes = (channel_bytes_budget() if max_bytes is None
-                          else int(max_bytes))
+        # the channel keeps its own byte accounting under its own condition
+        # (header + blobs + cancel state share it); the DynamicBudget is
+        # the governed *limit* holder. An explicit max_bytes (tests, tools)
+        # stays static; the default budget registers with the process-wide
+        # governor so a contended channel can borrow bytes from idle ones.
+        if max_bytes is None:
+            self._budget = DynamicBudget(f"chain.{name}",
+                                         channel_bytes_budget())
+            self._gov_token = GOVERNOR.register_budget(
+                self._budget, demand_fn=self._demand)
+        else:
+            self._budget = DynamicBudget(f"chain.{name}", int(max_bytes),
+                                         damp_s=0.0)
+            self._gov_token = None
+        # a grown budget must release producers already blocked on it
+        self._budget.on_resize = self._notify_waiters
         self._cv = threading.Condition()
         self._header = None
         self._have_header = False
@@ -125,6 +141,29 @@ class ChainChannel:
         from .observe import trace as _trace
 
         self._trace_on = _trace.tracing_enabled()
+
+    @property
+    def max_bytes(self) -> int:
+        """The current (possibly governor-adjusted) byte budget."""
+        return self._budget.limit
+
+    def _demand(self) -> dict:
+        """Live wait counters for the governor's rebalance tick: put_wait
+        growing = producer starved on this budget; get_wait growing =
+        consumer starved (budget irrelevant — a donor)."""
+        return {"put_wait_s": self.put_wait_s,
+                "get_wait_s": self.get_wait_s,
+                "used": self._bytes}
+
+    def _notify_waiters(self):
+        with self._cv:
+            self._cv.notify_all()
+
+    def _ungovern(self):
+        from .utils.governor import GOVERNOR
+
+        GOVERNOR.unregister_budget(self._gov_token)
+        self._gov_token = None
 
     # ------------------------------------------------------------- producer
 
@@ -165,11 +204,16 @@ class ChainChannel:
             self._put(blob, n)
 
     def _put(self, blob, n: int) -> None:
+        from .utils.governor import GOVERNOR
+
         t0 = time.monotonic()
         with self._cv:
             while (self._bytes > 0 and self._bytes + n > self.max_bytes
                    and not self._cancelled
                    and self._abort_reason is None):
+                # hard pressure fails the producing stage cleanly (exit 4,
+                # chain abort cascade) instead of queueing into an OOM
+                GOVERNOR.check_hard()
                 self._cv.wait(0.1)
             if self._cancelled or self._abort_reason is not None:
                 raise ChainAborted(self._reason_locked())
@@ -190,6 +234,7 @@ class ChainChannel:
         with self._cv:
             self._closed = True
             self._cv.notify_all()
+        self._ungovern()  # no more puts: stop competing for the cap
 
     def abort(self, reason: str) -> None:
         """Producer-side failure: every pending and future consumer call
@@ -201,6 +246,7 @@ class ChainChannel:
             self._blobs.clear()
             self._bytes = 0
             self._cv.notify_all()
+        self._ungovern()
 
     @property
     def has_header(self) -> bool:
@@ -256,6 +302,7 @@ class ChainChannel:
             self._blobs.clear()
             self._bytes = 0
             self._cv.notify_all()
+        self._ungovern()
 
     def _reason_locked(self) -> str:
         if self._abort_reason is not None:
@@ -271,6 +318,7 @@ class ChainChannel:
         if self._metrics_folded:
             return
         self._metrics_folded = True
+        self._ungovern()
         from .observe.metrics import METRICS
 
         p = f"pipeline.chain.{self.name}"
@@ -279,6 +327,12 @@ class ChainChannel:
         METRICS.max(f"{p}.peak_bytes", self.peak_bytes)
         METRICS.inc(f"{p}.put_wait_s", round(self.put_wait_s, 6))
         METRICS.inc(f"{p}.get_wait_s", round(self.get_wait_s, 6))
+        # final (possibly governor-adjusted) budget + resize counters, so
+        # a run report shows where the rebalancer moved bytes
+        METRICS.set(f"{p}.budget_limit", self.max_bytes)
+        if self._budget.grows or self._budget.shrinks:
+            METRICS.inc(f"{p}.budget_grows", self._budget.grows)
+            METRICS.inc(f"{p}.budget_shrinks", self._budget.shrinks)
 
 
 class ChannelBamWriter:

@@ -111,14 +111,17 @@ class ServeClient:
 
     def submit(self, argv, priority: str = protocol.DEFAULT_PRIORITY,
                argv0: str = None, tag: str = None, trace: bool = False,
-               dedupe: str = None) -> dict:
+               dedupe: str = None, client: str = None) -> dict:
         """Submit a command; returns the accepted job record. An admission
-        rejection (queue full / draining) raises ServeError with the
-        daemon's reason. ``dedupe``: idempotency key — resubmitting the
-        same key returns the original job instead of running it twice,
-        which also makes the reconnect retry safe for submits; without a
-        key, a submit whose connection resets is NOT retried (the daemon
-        may already have admitted it)."""
+        rejection (queue full / draining / over quota / resource pressure)
+        raises ServeError with the daemon's reason. ``dedupe``: idempotency
+        key — resubmitting the same key returns the original job instead of
+        running it twice, which also makes the reconnect retry safe for
+        submits; without a key, a submit whose connection resets is NOT
+        retried (the daemon may already have admitted it). ``client``:
+        submitter identity for the daemon's per-client admission quota
+        (serve --max-per-client); anonymous submits are never quota-limited.
+        """
         req = {"v": protocol.PROTOCOL_VERSION, "op": "submit",
                "argv": list(argv), "priority": priority,
                "argv0": argv0 if argv0 is not None else sys.argv[0],
@@ -127,6 +130,8 @@ class ServeClient:
             req["tag"] = tag
         if dedupe is not None:
             req["dedupe"] = dedupe
+        if client is not None:
+            req["client"] = client
         return self._checked(req, retry=dedupe is not None)["job"]
 
     def status(self, job_id: str = None, timeout: float = None) -> dict:

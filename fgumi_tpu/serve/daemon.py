@@ -55,19 +55,32 @@ class SocketBusy(RuntimeError):
     """Another live daemon already serves this socket path."""
 
 
+def _governor_pressure():
+    """The resource governor's admission verdict (None = admit).
+
+    Shedding is the serve analog of the pipeline's budget shrink: under a
+    soft watermark new jobs would only deepen the pressure, so they are
+    rejected with an explicit ``resource_pressure`` reason and a
+    ``retry_after_s`` hint while already-admitted jobs run to completion."""
+    from ..utils.governor import GOVERNOR
+
+    return GOVERNOR.admission_pressure()
+
+
 class JobService:
     def __init__(self, socket_path: str, workers: int = 2,
                  queue_limit: int = 8, report_dir: str = None,
                  max_frame_bytes: int = protocol.MAX_FRAME_BYTES,
                  keep_finished: int = 1000, journal_path: str = None,
-                 health_period_s: float = 0.0):
+                 health_period_s: float = 0.0, max_per_client: int = 0):
         self.socket_path = socket_path
         self.max_frame_bytes = max_frame_bytes
         self.report_dir = report_dir
         self.registry = JobRegistry(keep_finished=keep_finished,
                                     on_transition=self._on_transition)
         self.scheduler = Scheduler(self._execute, self.registry,
-                                   workers=workers, queue_limit=queue_limit)
+                                   workers=workers, queue_limit=queue_limit,
+                                   max_per_client=max_per_client)
         self.started_unix = time.time()
         self.journal_path = journal_path
         self.journal = None
@@ -185,7 +198,7 @@ class JobService:
         for rec in rep.jobs:
             job = Job(rec["id"], rec["argv"], rec["priority"],
                       argv0=rec["argv0"], tag=rec["tag"],
-                      trace=rec["trace"])
+                      trace=rec["trace"], client=rec.get("client"))
             if rec.get("submitted_unix"):
                 job.submitted_unix = rec["submitted_unix"]
             terminal = rec["state"] in TERMINAL
@@ -385,10 +398,17 @@ class JobService:
             return protocol.error_response(err)
         op = req["op"]
         if op == "ping":
+            extra = {}
+            if self.scheduler.max_per_client:
+                # quota surface only when the knob is armed, so the default
+                # ping (and its golden fixture) is unchanged
+                extra["max_per_client"] = self.scheduler.max_per_client
+                extra["quota"] = self.scheduler.client_quota_state()
             return protocol.ok_response(
                 tool="fgumi-tpu", pid=os.getpid(),
                 uptime_s=round(time.time() - self.started_unix, 1),
-                jobs=self.registry.counts(), **self.scheduler.depth())
+                jobs=self.registry.counts(), **self.scheduler.depth(),
+                **extra)
         if op == "submit":
             dedupe = req.get("dedupe")
             with self._dedupe_lock:
@@ -404,11 +424,24 @@ class JobService:
                             return protocol.ok_response(
                                 job=prior.to_wire(), deduped=True)
                         # job evicted from history: key is stale, reissue
+                # resource shed: under a memory/disk pressure watermark the
+                # daemon stops taking on NEW work (running jobs finish) —
+                # an explicit reason plus a Retry-After-style hint, checked
+                # after dedupe so idempotent resubmits of existing jobs
+                # still answer (they cost nothing)
+                shed = _governor_pressure()
+                if shed is not None:
+                    # the governor counts the shed; fold_metrics publishes
+                    # it as serve.shed.resource at serve-command exit
+                    return protocol.error_response(
+                        f"resource_pressure: {shed['reason']}",
+                        retry_after_s=shed["retry_after_s"])
                 job = self.registry.create(
                     req["argv"],
                     req.get("priority", protocol.DEFAULT_PRIORITY),
                     argv0=req.get("argv0"), tag=req.get("tag"),
-                    trace=bool(req.get("trace")))
+                    trace=bool(req.get("trace")),
+                    client=req.get("client"))
                 if dedupe:
                     self._dedupe[dedupe] = job.id
             # journal BEFORE admission: a crash between the two requeues a

@@ -35,17 +35,21 @@ class Job:
     """One submitted command and its lifecycle bookkeeping."""
 
     __slots__ = ("id", "argv", "argv0", "priority", "tag", "trace",
-                 "state", "submitted_unix", "started_unix", "finished_unix",
-                 "exit_status", "error", "report_path", "trace_path")
+                 "client", "state", "submitted_unix", "started_unix",
+                 "finished_unix", "exit_status", "error", "report_path",
+                 "trace_path")
 
     def __init__(self, job_id: str, argv, priority: str, argv0: str = None,
-                 tag: str = None, trace: bool = False):
+                 tag: str = None, trace: bool = False, client: str = None):
         self.id = job_id
         self.argv = list(argv)
         self.argv0 = argv0 or "fgumi-tpu"
         self.priority = priority
         self.tag = tag
         self.trace = bool(trace)
+        #: submitter identity for per-client admission quotas (protocol
+        #: "client" field; None = anonymous, never quota-limited)
+        self.client = client
         self.state = "queued"
         self.submitted_unix = time.time()
         self.started_unix = None
@@ -63,6 +67,7 @@ class Job:
             "argv": list(self.argv),
             "priority": self.priority,
             "tag": self.tag,
+            "client": self.client,
             "submitted_unix": round(self.submitted_unix, 3),
             "started_unix": (round(self.started_unix, 3)
                              if self.started_unix else None),
@@ -97,10 +102,11 @@ class JobRegistry:
         self.on_transition = on_transition
 
     def create(self, argv, priority: str, argv0: str = None,
-               tag: str = None, trace: bool = False) -> Job:
+               tag: str = None, trace: bool = False,
+               client: str = None) -> Job:
         with self._lock:
             job = Job(f"j-{self._next_id}", argv, priority, argv0=argv0,
-                      tag=tag, trace=trace)
+                      tag=tag, trace=trace, client=client)
             self._next_id += 1
             self._jobs[job.id] = job
             self._order.append(job.id)

@@ -8,7 +8,8 @@ acted on, schema-versioned like the wire protocol and the run report:
 
     {"v": 1, "ev": "submit", "t": <unix>, "id": "j-3", "argv": [...],
      "priority": "normal", "argv0": "fgumi-tpu", "tag": null,
-     "trace": false, "dedupe": "<client key or null>"}
+     "trace": false, "dedupe": "<idempotency key or null>",
+     "client": "<submitter id or null>"}
     {"v": 1, "ev": "state", "t": <unix>, "id": "j-3",
      "state": "running" | "done" | "failed" | "cancelled" | "requeued",
      "exit_status": <int or null>, "error": "<diagnostic or null>"}
@@ -125,6 +126,7 @@ def _fold(out: ReplayResult, rec: dict):
             "tag": rec.get("tag"),
             "trace": bool(rec.get("trace")),
             "dedupe": rec.get("dedupe"),
+            "client": rec.get("client"),
             "state": "queued",
             "exit_status": None,
             "error": None,
@@ -183,7 +185,8 @@ class JobJournal:
     def record_submit(self, job: Job, dedupe: str = None):
         self._append({"ev": "submit", "id": job.id, "argv": job.argv,
                       "priority": job.priority, "argv0": job.argv0,
-                      "tag": job.tag, "trace": job.trace, "dedupe": dedupe})
+                      "tag": job.tag, "trace": job.trace, "dedupe": dedupe,
+                      "client": job.client})
 
     def record_state(self, job: Job):
         self._append({"ev": "state", "id": job.id, "state": job.state,

@@ -11,7 +11,8 @@ Requests::
 
     {"v": 1, "op": "submit", "argv": ["simplex", "-i", ...],
      "priority": "normal", "argv0": "fgumi-tpu", "trace": false,
-     "tag": "optional-label", "dedupe": "optional-idempotency-key"}
+     "tag": "optional-label", "dedupe": "optional-idempotency-key",
+     "client": "optional-submitter-id"}
     {"v": 1, "op": "status"}           # all jobs
     {"v": 1, "op": "status", "id": "j-3"}
     {"v": 1, "op": "cancel", "id": "j-3"}
@@ -116,6 +117,10 @@ def validate_request(obj: dict):
         if dedupe is not None and (not isinstance(dedupe, str)
                                    or not dedupe):
             return "dedupe must be a non-empty string"
+        client = obj.get("client")
+        if client is not None and (not isinstance(client, str)
+                                   or not client):
+            return "client must be a non-empty string"
     if op in ("cancel",) and not isinstance(obj.get("id"), str):
         return f"{op} requires id: a job id string"
     if "id" in obj and obj["id"] is not None \

@@ -41,15 +41,23 @@ class Scheduler:
     one broken job cannot kill a worker."""
 
     def __init__(self, execute, registry: JobRegistry, workers: int = 2,
-                 queue_limit: int = 8):
+                 queue_limit: int = 8, max_per_client: int = 0):
         if workers < 1:
             raise ValueError("workers must be >= 1")
         if queue_limit < 0:
             raise ValueError("queue-limit must be >= 0")
+        if max_per_client < 0:
+            raise ValueError("max-per-client must be >= 0")
         self._execute = execute
         self.registry = registry
         self.workers = workers
         self.queue_limit = queue_limit
+        #: per-submitter admission quota (0 = unlimited): a client id may
+        #: hold at most this many ACTIVE (queued + running) jobs — the
+        #: first slice of multi-tenant admission (ROADMAP item 3). Jobs
+        #: submitted without a client id are anonymous and never limited.
+        self.max_per_client = max_per_client
+        self._client_active = {}  # client id -> queued + running count
         self._heap = []  # (priority rank, seq, job)
         self._seq = itertools.count()
         self._cv = threading.Condition()
@@ -77,9 +85,20 @@ class Scheduler:
 
     def submit(self, job):
         """Admit ``job`` or reject it. Returns (admitted, reason)."""
+        from ..observe.metrics import METRICS
+
         with self._cv:
             if self._draining:
                 return False, "draining: daemon is not accepting new jobs"
+            client = getattr(job, "client", None)
+            if self.max_per_client and client:
+                held = self._client_active.get(client, 0)
+                if held >= self.max_per_client:
+                    METRICS.inc("serve.quota.rejected")
+                    return False, (
+                        f"quota exceeded: client {client!r} holds {held} "
+                        f"active job(s) >= max-per-client "
+                        f"{self.max_per_client}")
             active = self._running + len(self._heap)
             capacity = self.workers + self.queue_limit
             if active >= capacity:
@@ -90,8 +109,29 @@ class Scheduler:
                     "slots)")
             heapq.heappush(self._heap,
                            (_PRIO_RANK[job.priority], next(self._seq), job))
+            if client:
+                self._client_active[client] = \
+                    self._client_active.get(client, 0) + 1
+                METRICS.inc("serve.quota.admitted")
+                METRICS.max("serve.quota.clients",
+                            len(self._client_active))
             self._cv.notify()
             return True, None
+
+    def _release_client_locked(self, job):
+        client = getattr(job, "client", None)
+        if not client:
+            return
+        held = self._client_active.get(client, 0) - 1
+        if held > 0:
+            self._client_active[client] = held
+        else:
+            self._client_active.pop(client, None)
+
+    def client_quota_state(self) -> dict:
+        """{client id: active job count} (status/debugging surface)."""
+        with self._cv:
+            return dict(self._client_active)
 
     def cancel(self, job_id: str):
         """Cancel a *queued* job. Returns (ok, reason)."""
@@ -100,6 +140,7 @@ class Scheduler:
                 if job.id == job_id:
                     del self._heap[i]
                     heapq.heapify(self._heap)
+                    self._release_client_locked(job)
                     self.registry.mark_cancelled(job)
                     return True, None
         job = self.registry.get(job_id)
@@ -186,4 +227,5 @@ class Scheduler:
             finally:
                 with self._cv:
                     self._running -= 1
+                    self._release_client_locked(job)
                     self._cv.notify_all()

@@ -23,6 +23,7 @@ import tempfile
 import zlib
 
 from ..core.overlap import parse_soft_clips_and_ref_len
+from ..utils.governor import GOVERNOR, reraise_enospc
 from ..core.template import library_lookup_from_header, unclipped_5prime
 from ..io.bam import (FLAG_FIRST, FLAG_LAST, FLAG_MATE_REVERSE,
                       FLAG_MATE_UNMAPPED, FLAG_PAIRED, FLAG_REVERSE,
@@ -168,6 +169,13 @@ _FRAME_BYTES = 4 << 20
 _ENTRY_OVERHEAD = 120
 
 
+def _pressure_spill_floor(max_bytes: int) -> int:
+    """Smallest chunk worth spilling early under memory pressure: the
+    governor's soft watermark forces spills at 1/8th of the budget (never
+    below 4 MiB — tiny runs would explode the merge fan-in)."""
+    return max(max_bytes // 8, 4 << 20)
+
+
 class _SpillRun:
     """One sorted run on disk: raw length-prefixed frames, zlib-format
     deflate-1 (native libdeflate when available — the closest analog of the
@@ -206,30 +214,66 @@ class _SpillRun:
         self._f.write(struct.pack("<II", len(payload), len(frame)))
         self._f.write(payload)
 
-    def __iter__(self):
-        from ..native import zlib_decompress
-
+    def _read_raw_frames(self):
+        """(compressed payload, uncompressed size) frames off disk."""
         with open(self.path, "rb") as f:
             while True:
                 size_b = f.read(8)
                 if len(size_b) < 8:
                     break
                 size, usize = struct.unpack("<II", size_b)
-                payload = f.read(size)
-                frame = zlib_decompress(payload, usize)
-                if frame is None:
-                    frame = zlib.decompress(payload)
-                off = 0
-                end = len(frame)
-                while off < end:
-                    klen, ordinal, rlen = struct.unpack_from("<HQI", frame, off)
-                    off += 14
-                    key = frame[off:off + klen]
-                    off += klen
-                    yield (key, ordinal, frame[off:off + rlen])
-                    off += rlen
+                yield f.read(size), usize
+
+    @staticmethod
+    def _decode_frame(payload, usize):
+        from ..native import zlib_decompress
+
+        frame = zlib_decompress(payload, usize)
+        if frame is None:
+            frame = zlib.decompress(payload)
+        return frame
+
+    def frames(self, executor=None):
+        """Decompressed frame buffers in run order. With ``executor`` the
+        NEXT frame's decompression runs on the pool while the caller
+        consumes the current one (the phase-2 merge prefetch,
+        fgumi-sort/src/worker_pool.rs:25-31 analog) — frame order, and
+        hence the k-way merge's heap order, is unchanged."""
+        raw = self._read_raw_frames()
+        if executor is None:
+            for payload, usize in raw:
+                yield self._decode_frame(payload, usize)
+            return
+        pending = None
+        for payload, usize in raw:
+            fut = executor.submit(self._decode_frame, payload, usize)
+            if pending is not None:
+                yield pending.result()
+            pending = fut
+        if pending is not None:
+            yield pending.result()
+
+    def entries(self, executor=None):
+        """(key, ordinal, record bytes) entries, optionally frame-prefetched."""
+        for frame in self.frames(executor):
+            off = 0
+            end = len(frame)
+            while off < end:
+                klen, ordinal, rlen = struct.unpack_from("<HQI", frame, off)
+                off += 14
+                key = frame[off:off + klen]
+                off += klen
+                yield (key, ordinal, frame[off:off + rlen])
+                off += rlen
+
+    def __iter__(self):
+        return self.entries()
 
     def unlink(self):
+        try:
+            self._f.close()  # a run that died mid-write still holds it open
+        except OSError:
+            pass
         try:
             os.unlink(self.path)
         except OSError:
@@ -247,17 +291,21 @@ class ExternalSorter:
     """
 
     def __init__(self, key_fn, max_bytes: int = 256 << 20, tmp_dir=None,
-                 max_records: int = None):
+                 max_records: int = None, spill_workers: int = 0):
         self.key_fn = key_fn
         self.max_bytes = max_bytes
         self.max_records = max_records  # optional extra cap (tests)
         self._tmp_dir_arg = tmp_dir
         self._tmp_dir = None
         self._own_tmp_dir = False
+        self._disk_token = None
         self._chunk = []
         self._chunk_bytes = 0
         self._runs = []
         self.n_records = 0
+        # merge-phase frame prefetch pool size (phase 1 spills run inline
+        # in this pure-Python engine; the native engine overlaps both)
+        self._spill_workers = max(int(spill_workers), 0)
 
     def add(self, rec: RawRecord):
         self.add_entry(self.key_fn(rec), rec.data)
@@ -270,10 +318,17 @@ class ExternalSorter:
                 self.max_records is not None
                 and len(self._chunk) >= self.max_records):
             self._spill()
+        elif GOVERNOR.state != "ok" \
+                and self._chunk_bytes >= _pressure_spill_floor(self.max_bytes):
+            # soft memory pressure: get bytes out of RAM early (hard
+            # pressure fails cleanly at the next check site instead)
+            GOVERNOR.check_hard()
+            self._spill()
 
     def _spill(self):
         from ..observe.metrics import METRICS
         from ..observe.trace import span
+        from ..utils import faults
 
         if self._tmp_dir is None:
             if self._tmp_dir_arg is not None:
@@ -281,13 +336,24 @@ class ExternalSorter:
             else:
                 self._tmp_dir = tempfile.mkdtemp(prefix="fgumi_sort_")
                 self._own_tmp_dir = True
+            self._disk_token = GOVERNOR.watch_path("spill", self._tmp_dir)
         METRICS.inc("sort.spills")
         METRICS.inc("sort.spill_records", len(self._chunk))
         with span("sort.spill", records=len(self._chunk)):
             self._chunk.sort()
-            run = _SpillRun(self._tmp_dir)
-            run.write(iter(self._chunk))
-        self._runs.append(run)
+            try:
+                faults.fire("sort.spill")
+                run = _SpillRun(self._tmp_dir)
+                # registered BEFORE write, like the native engine's
+                # fixed-at-submission slot: a run that dies mid-write (real
+                # ENOSPC) must still be swept by close()
+                self._runs.append(run)
+                run.write(iter(self._chunk))
+            except OSError as e:
+                # a full disk mid-spill becomes the clean-failure contract
+                # (ResourceExhausted -> exit 4, temps swept by close())
+                reraise_enospc(e, "sort.spill", path=self._tmp_dir)
+                raise
         self._chunk = []
         self._chunk_bytes = 0
 
@@ -302,11 +368,36 @@ class ExternalSorter:
             return
         self._spill()
         # global ingest ordinals make (key, ordinal) a total order, so the merged
-        # stream is identical to what a single in-memory sort would produce
+        # stream is identical to what a single in-memory sort would produce —
+        # with spill workers the next frame of each run decompresses on the
+        # pool while the heap consumes the current one (bounded by the
+        # governor's merge-prefetch budget; order unchanged)
+        n_pf = 0
+        if self._spill_workers >= 2 and len(self._runs) > 1:
+            from ..utils.governor import merge_prefetch_bytes
+
+            n_pf = min(len(self._runs),
+                       int(merge_prefetch_bytes() // _FRAME_BYTES))
+        if n_pf:
+            from concurrent.futures import ThreadPoolExecutor
+
+            ex = ThreadPoolExecutor(
+                max_workers=min(self._spill_workers, n_pf),
+                thread_name_prefix="fgumi-merge-pf")
+            try:
+                streams = [r.entries(ex if i < n_pf else None)
+                           for i, r in enumerate(self._runs)]
+                for _, _, data in heapq.merge(*streams):
+                    yield data
+            finally:
+                ex.shutdown(wait=True)
+            return
         for _, _, data in heapq.merge(*self._runs):
             yield data
 
     def close(self):
+        GOVERNOR.unwatch_path(self._disk_token)
+        self._disk_token = None
         for run in self._runs:
             run.unlink()
         self._runs = []
@@ -382,6 +473,7 @@ class NativeExternalSorter:
         self._tmp_dir_arg = tmp_dir
         self._tmp_dir = None
         self._own_tmp_dir = False
+        self._disk_token = None
         self._reset_pools()
         self._run_paths = []
         self.n_records = 0
@@ -465,6 +557,12 @@ class NativeExternalSorter:
                 self.max_records is not None
                 and self._chunk_records >= self.max_records):
             self._spill()
+        elif GOVERNOR.state != "ok" \
+                and self._chunk_bytes >= _pressure_spill_floor(self.max_bytes):
+            # soft watermark: spill early so accumulated pools stop
+            # competing with the rest of the process for RAM
+            GOVERNOR.check_hard()
+            self._spill()
 
     # ---------------------------------------------------------------- phases
 
@@ -502,18 +600,25 @@ class NativeExternalSorter:
             else:
                 self._tmp_dir = tempfile.mkdtemp(prefix="fgumi_sort_")
                 self._own_tmp_dir = True
+            self._disk_token = GOVERNOR.watch_path("spill", self._tmp_dir)
 
     def _build_run(self, path, keys_b, recs_b, spans):
         """Sort + compress + write one frozen pool to `path` (runs on a
         spill worker or inline; touches no mutable sorter state)."""
         from ..observe.metrics import METRICS
         from ..observe.trace import span
+        from ..utils import faults
 
         n = len(spans[1])
         METRICS.inc("sort.spills")
         METRICS.inc("sort.spill_records", n)
         with span("sort.spill", records=n):
-            return self._build_run_traced(path, keys_b, recs_b, spans, n)
+            try:
+                faults.fire("sort.spill")
+                return self._build_run_traced(path, keys_b, recs_b, spans, n)
+            except OSError as e:
+                reraise_enospc(e, "sort.spill", path=self._tmp_dir)
+                raise
 
     def _build_run_traced(self, path, keys_b, recs_b, spans, n):
         np = self._np
@@ -528,6 +633,13 @@ class NativeExternalSorter:
             klen.ctypes.data, recs.ctypes.data, roff.ctypes.data,
             rlen.ctypes.data, perm.ctypes.data, n, _FRAME_BYTES, 1)
         if rc != 0:
+            # the native writer reports -errno for I/O failures (so a full
+            # disk maps onto the ENOSPC clean-failure contract); any other
+            # negative value is a compression/internal failure
+            err = -int(rc)
+            if 0 < err < 256:
+                raise OSError(err, f"native spill write failed: "
+                              f"{os.strerror(err)}", path)
             raise OSError(f"native spill write failed: {path}")
 
     def _spill(self):
@@ -601,8 +713,27 @@ class NativeExternalSorter:
         import ctypes as ct
 
         paths = b"\n".join(p.encode() for p in self._run_paths)
-        h = self._lib.fgumi_merge_open(paths, len(paths),
-                                       len(self._run_paths))
+        # phase-2 merge prefetch: the spill-worker pool's thread count now
+        # reads+decompresses each run's next frame while the heap drains
+        # the current one (worker_pool.rs:25-31 analog), holding at most
+        # merge-prefetch-budget / frame-size decoded frames beyond the
+        # per-run current ones. Deterministic: heap order is untouched.
+        # >= 2 workers: with one, the merge thread steals most frames back
+        # and pays pure coordination (measured ~0.8x; >=2 measured ~1.2x
+        # on 2 cores, more with real core counts)
+        pf_threads = pf_frames = 0
+        if self._spill_workers >= 2 and len(self._run_paths) > 1:
+            from ..utils.governor import merge_prefetch_bytes
+
+            pf_frames = int(merge_prefetch_bytes() // _FRAME_BYTES)
+            pf_threads = min(self._spill_workers, len(self._run_paths))
+        if pf_frames > 0:
+            h = self._lib.fgumi_merge_open2(paths, len(paths),
+                                            len(self._run_paths),
+                                            pf_threads, pf_frames)
+        else:
+            h = self._lib.fgumi_merge_open(paths, len(paths),
+                                           len(self._run_paths))
         if not h:
             raise OSError("native merge open failed")
         try:
@@ -652,6 +783,8 @@ class NativeExternalSorter:
                 off += int(ln)
 
     def close(self):
+        GOVERNOR.unwatch_path(self._disk_token)
+        self._disk_token = None
         try:
             self._drain_spills()
         except Exception:  # noqa: BLE001 - close() must still clean up
@@ -683,8 +816,8 @@ def create_sorter(key_fn, max_bytes: int = 256 << 20, tmp_dir=None,
                   max_records: int = None, spill_workers: int = 0):
     """NativeExternalSorter when the native library is available, else the
     pure-Python ExternalSorter (identical output contract; tested against
-    each other in tests/test_sort_v2.py). spill_workers applies only to the
-    native engine (background Phase-1 spill overlap)."""
+    each other in tests/test_sort_v2.py). spill_workers overlaps Phase-1
+    spills (native engine) and Phase-2 merge frame prefetch (both)."""
     from ..native import get_lib
 
     if get_lib() is not None:
@@ -692,4 +825,5 @@ def create_sorter(key_fn, max_bytes: int = 256 << 20, tmp_dir=None,
                                     tmp_dir=tmp_dir, max_records=max_records,
                                     spill_workers=spill_workers)
     return ExternalSorter(key_fn, max_bytes=max_bytes, tmp_dir=tmp_dir,
-                          max_records=max_records)
+                          max_records=max_records,
+                          spill_workers=spill_workers)

@@ -17,9 +17,12 @@ Arm via the environment::
 - ``kind``: ``raise`` (an :class:`InjectedFault`), ``hang`` (sleep for
   ``FGUMI_TPU_FAULT_HANG_S`` seconds, default 30 — what the stall
   watchdog exists to diagnose), ``corrupt-bytes`` (deterministically flip
-  bytes in the payload passing through the point), or ``oom`` (an
+  bytes in the payload passing through the point), ``oom`` (an
   :class:`InjectedOom` whose message carries ``RESOURCE_EXHAUSTED``, the
-  XLA out-of-memory status the device retry path batch-splits on).
+  XLA out-of-memory status the device retry path batch-splits on), or
+  ``enospc`` (an ``OSError(ENOSPC)`` — a full disk exactly where a real
+  one would surface; the resource clean-failure contract converts it to
+  exit code 4, docs/resilience.md).
 - ``prob``: trigger probability per fire, drawn from a
   ``random.Random`` seeded by ``FGUMI_TPU_FAULT_SEED`` (default 0) xor
   the point name, so single-threaded runs are exactly reproducible.
@@ -53,9 +56,16 @@ FAULT_POINTS = frozenset({
     "native.batch",        # native batch-op entry (native/batch.py)
     "serve.dispatch",      # job-service worker dispatch (serve/daemon.py)
     "chain.handoff",       # fused-pipeline channel put (pipeline_chain.py)
+    "sort.spill",          # external-sort spill-run write (sort/external.py)
+                           # — arm kind `enospc` to simulate a disk filling
+                           # mid-spill; the clean-failure contract (exit 4,
+                           # temps swept, `resource` report section) must
+                           # absorb it
+    "governor.sample",     # resource-governor sampling tick
+                           # (utils/governor.py)
 })
 
-KINDS = frozenset({"raise", "hang", "corrupt-bytes", "oom"})
+KINDS = frozenset({"raise", "hang", "corrupt-bytes", "oom", "enospc"})
 
 
 class InjectedFault(RuntimeError):
@@ -175,6 +185,12 @@ def fire(point: str, data=None):
         log.warning("fault injection: injected OOM at %s", point)
         raise InjectedOom(
             f"RESOURCE_EXHAUSTED: injected out-of-memory at {point}")
+    if kind == "enospc":
+        import errno
+
+        log.warning("fault injection: injected ENOSPC at %s", point)
+        raise OSError(errno.ENOSPC,
+                      f"No space left on device (injected at {point})")
     # hang
     t = float(os.environ.get("FGUMI_TPU_FAULT_HANG_S", "30"))
     log.warning("fault injection: hanging %.1fs at %s", t, point)

@@ -235,6 +235,49 @@ def bench_chain(out):
     out["chain_rechunk_nocopy_s"] = round(_timeit(rechunk), 4)
 
 
+def bench_sort_merge(out):
+    """Spill-worker overlap (ISSUE 8 satellite): full sort wall clock —
+    ingest+spill+k-way merge, with the worker pool compressing spills
+    behind ingest and prefetching+decompressing each run's next frame
+    behind the merge heap, vs the fully synchronous path. The window is
+    the whole run because the pool moves work between phases (with
+    workers, spill compression that the sync path pays during ingest
+    drains during the merge), so either phase alone mismeasures.
+    spill_workers=3 is what the fused chain's sort stage gets at
+    --threads 4 (cli: threads - 1), so sort_merge_prefetch_speedup is
+    the --threads 4 fused-chain delta for the stage the chain serializes
+    on. Byte-identity of the two paths is pinned by
+    tests/test_governor.py; this entry records the wall win."""
+    import random
+
+    from fgumi_tpu.sort.external import create_sorter
+
+    random.seed(11)
+    entries = [(random.randbytes(16), random.randbytes(
+        random.randrange(60, 400))) for _ in range(60000)]
+
+    def run(workers):
+        t0 = time.perf_counter()
+        sorter = create_sorter(lambda r: b"", max_bytes=2 << 20,
+                               spill_workers=workers)
+        try:
+            for k, d in entries:
+                sorter.add_entry(k, d)
+            n = sum(1 for _ in sorter.sorted_records())
+            dt = time.perf_counter() - t0
+        finally:
+            sorter.close()
+        assert n == len(entries)
+        return dt
+
+    run(0)  # warm page cache so sync vs prefetch see the same I/O
+    sync_s = min(run(0) for _ in range(3))
+    pf_s = min(run(3) for _ in range(3))
+    out["sort_merge_sync_s"] = round(sync_s, 4)
+    out["sort_merge_prefetch_s"] = round(pf_s, 4)
+    out["sort_merge_prefetch_speedup"] = round(sync_s / pf_s, 3) if pf_s else 0
+
+
 def bench_host_engine(out):
     import numpy as np
 
@@ -374,6 +417,7 @@ def main():
                         bench_full_column,
                         bench_datapath,
                         bench_chain,
+                        bench_sort_merge,
                         bench_host_engine,
                         lambda o: bench_native_batch(o, bam),
                         lambda o: bench_sort_keys(o, bam),

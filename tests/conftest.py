@@ -37,3 +37,14 @@ def _reset_device_breaker():
     mod = sys.modules.get("fgumi_tpu.ops.breaker")
     if mod is not None:
         mod.BREAKER.reset()
+
+
+@pytest.fixture(autouse=True)
+def _reset_resource_governor():
+    """Same discipline for the resource governor (utils/governor.py): a
+    test that drives it into a pressure state or injects samplers must not
+    leak that into later tests' budget waits. Lazy — only when imported."""
+    yield
+    mod = sys.modules.get("fgumi_tpu.utils.governor")
+    if mod is not None:
+        mod.GOVERNOR.reset_for_tests()

@@ -162,6 +162,19 @@ def test_const_cache_lru_bound():
 
 # ------------------------------------------------------------------- feeder
 
+_test_feeders = []
+
+
+@pytest.fixture(autouse=True)
+def _ungovern_test_feeders():
+    """Throwaway feeders register a budget with the process-wide resource
+    governor on first _config; leaked entries would count against the
+    governor's global cap in every later test."""
+    yield
+    while _test_feeders:
+        _test_feeders.pop().ungovern()
+
+
 def _fresh_feeder(monkeypatch, depth=None, budget=None):
     from fgumi_tpu.ops.kernel import DeviceFeeder
 
@@ -169,7 +182,9 @@ def _fresh_feeder(monkeypatch, depth=None, budget=None):
         monkeypatch.setenv("FGUMI_TPU_FEEDER_DEPTH", str(depth))
     if budget is not None:
         monkeypatch.setenv("FGUMI_TPU_FEEDER_BYTES", str(budget))
-    return DeviceFeeder()
+    feeder = DeviceFeeder()
+    _test_feeders.append(feeder)
+    return feeder
 
 
 def test_feeder_depth_gates_dispatches(monkeypatch):

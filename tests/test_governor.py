@@ -1,0 +1,703 @@
+"""Resource governor tests (ISSUE 8).
+
+Covers the two halves of utils/governor.py — DynamicBudget (damped,
+hysteretic resizing under the acquire/release contract) and the
+process-wide ResourceGovernor (demand rebalancing, RSS/disk pressure
+sentinels, admission shedding) — plus the riders: the ENOSPC
+clean-failure contract end-to-end through the CLI, phase-2 merge
+prefetch byte-identity, fused-vs-staged byte-identity under aggressive
+rebalancing, and the serve layer's per-client quota + resource shed.
+
+Determinism discipline: no test relies on the governor *thread* — every
+scenario drives ``GOVERNOR.sample_once()`` directly with injected
+RSS/disk samplers, exactly the seam the module exposes for this.
+"""
+
+import errno
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from fgumi_tpu.cli import main as cli_main
+from fgumi_tpu.utils import faults
+from fgumi_tpu.utils.governor import (GOVERNOR, DynamicBudget,
+                                      ResourceExhausted, StopSignal,
+                                      merge_prefetch_bytes, reraise_enospc)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for var in ("FGUMI_TPU_FAULT", "FGUMI_TPU_GOVERNOR",
+                "FGUMI_TPU_MEM_BUDGET", "FGUMI_TPU_RSS_SOFT",
+                "FGUMI_TPU_RSS_HARD", "FGUMI_TPU_DISK_SOFT",
+                "FGUMI_TPU_DISK_HARD", "FGUMI_TPU_MERGE_PREFETCH",
+                "FGUMI_TPU_CHAIN_BYTES", "FGUMI_TPU_GOVERNOR_PERIOD_S"):
+        monkeypatch.delenv(var, raising=False)
+    faults.reset()
+    GOVERNOR.reset_for_tests()
+    yield
+    faults.reset()
+
+
+# ------------------------------------------------------------ DynamicBudget
+
+
+def test_budget_accounting_and_oversized_admission():
+    b = DynamicBudget("t", 100, damp_s=0.0)
+    assert b.acquire(60)
+    assert b.acquire(40)  # exactly at the limit
+    b.release(100)
+    # one item is always admitted, even over the limit (serialized flow,
+    # never deadlock)
+    assert b.acquire(10_000)
+    assert b.used == 10_000 and b.peak == 10_000
+    b.release(10_000)
+    assert b.used == 0
+
+
+def test_budget_disabled_when_limit_nonpositive():
+    b = DynamicBudget("t", 0)
+    assert b.acquire(1 << 40)
+    b.release(1 << 40)  # no-ops, no accounting
+    assert b.used == 0
+    b.grow(1 << 20)
+    assert b.limit == 0  # a disabled budget never resizes into existence
+
+
+def test_budget_blocks_then_releases():
+    b = DynamicBudget("t", 100, damp_s=0.0)
+    assert b.acquire(100)
+    got = []
+    t = threading.Thread(target=lambda: got.append(b.acquire(50)))
+    t.start()
+    time.sleep(0.05)
+    assert not got  # blocked: 100 + 50 > 100 with used > 0
+    b.release(100)
+    t.join(timeout=5)
+    assert got == [True]
+    b.release(50)
+
+
+def test_stop_signal_wakes_acquire_immediately():
+    """Satellite: cancellation is condition-variable driven, not the old
+    100 ms poll. With a StopSignal the blocked acquire waits with NO
+    timeout — the test finishing at all proves set() delivered the wakeup
+    through the subscribed condition."""
+    b = DynamicBudget("t", 100, damp_s=0.0)
+    assert b.acquire(100)
+    stop = StopSignal()
+    out = []
+
+    def blocked():
+        out.append(b.acquire(50, stop=stop))
+
+    t = threading.Thread(target=blocked)
+    t.start()
+    time.sleep(0.05)
+    assert not out
+    t0 = time.monotonic()
+    stop.set()
+    t.join(timeout=5)
+    assert out == [False]
+    assert time.monotonic() - t0 < 1.0
+    # the subscription is removed on exit: set() again must not blow up
+    stop.set()
+    b.release(100)
+
+
+def test_budget_damping_one_resize_per_window():
+    b = DynamicBudget("t", 100 << 20, damp_s=30.0)
+    assert b.grow(10 << 20) == 10 << 20  # first resize applies
+    assert b.grow(10 << 20) == 0         # damped: inside the window
+    assert b.limit == 110 << 20 and b.grows == 1
+
+
+def test_budget_hysteresis_blocks_quick_direction_flip():
+    b = DynamicBudget("t", 100 << 20, damp_s=0.05)
+    assert b.grow(10 << 20) > 0
+    time.sleep(0.08)  # past damp_s, but inside the 4x flip window
+    assert b.shrink(0.5) == 0
+    assert b.flips == 0
+    time.sleep(0.25)  # past 4 * damp_s: the flip is allowed (and counted)
+    assert b.shrink(0.5) > 0
+    assert b.flips == 1
+
+
+def test_budget_floor_and_ceiling_clamp():
+    b = DynamicBudget("t", 64 << 20, floor=16 << 20, ceiling=128 << 20,
+                      damp_s=0.0)
+    for _ in range(10):
+        b.shrink(0.1)
+    assert b.limit == 16 << 20  # never below the floor
+    for _ in range(10):
+        b.grow(1 << 30)
+    assert b.limit == 128 << 20  # never above the ceiling
+
+
+def test_widen_bypasses_damping_and_raises_ceiling():
+    """The watchdog's widen is the deadlock breaker: undamped, and allowed
+    past the rebalance ceiling (a stall escape that silently no-ops when
+    demand growth already consumed the ceiling is no escape at all)."""
+    b = DynamicBudget("t", 64 << 20, ceiling=100 << 20, damp_s=60.0)
+    assert b.grow(1 << 20) > 0   # consumes the damping window
+    b.widen(2)                   # watchdog path: undamped
+    assert b.limit == (65 << 20) * 2
+    assert b.ceiling == (65 << 20) * 2  # escape is permanent
+
+
+def test_on_resize_hook_fires_and_survives_exceptions():
+    b = DynamicBudget("t", 64 << 20, damp_s=0.0)
+    calls = []
+    b.on_resize = lambda: calls.append(1)
+    b.grow(1 << 20)
+    assert calls == [1]
+    b.on_resize = lambda: 1 / 0  # a broken hook must not kill the resize
+    b.grow(1 << 20)
+    assert b.grows == 2
+
+
+# -------------------------------------------------------------- rebalancing
+
+
+@pytest.fixture
+def fresh_gov():
+    """A private ResourceGovernor: rebalance assertions must not depend on
+    whatever budgets other tests (or the process feeder singleton) left
+    registered with the global one."""
+    from fgumi_tpu.utils.governor import ResourceGovernor
+
+    g = ResourceGovernor()
+    g._rss_fn = lambda: None
+    g._disk_fn = lambda path: None
+    return g
+
+
+def _tick(gov=GOVERNOR, n=1):
+    for _ in range(n):
+        gov.sample_once()
+
+
+def test_rebalance_moves_budget_to_hot_queue(monkeypatch, fresh_gov):
+    monkeypatch.setenv("FGUMI_TPU_MEM_BUDGET", "1G")
+    hot = DynamicBudget("hot", 32 << 20, damp_s=0.0)
+    cold = DynamicBudget("cold", 32 << 20, damp_s=0.0)
+    waits = {"hot": 0.0}
+    fresh_gov.register_budget(
+        hot, demand_fn=lambda: {"put_wait_s": waits["hot"],
+                                "get_wait_s": 0.0})
+    fresh_gov.register_budget(
+        cold, demand_fn=lambda: {"put_wait_s": 0.0, "get_wait_s": 0.5})
+    before = hot.limit
+    for _ in range(4):
+        waits["hot"] += 0.1  # producer blocked 100 ms this tick: hot
+        _tick(fresh_gov)
+    assert hot.limit > before
+    assert fresh_gov.rebalances >= 1
+    assert cold.limit == 32 << 20  # cap is roomy: no donor shrink
+    assert hot.flips == 0  # steady skew never oscillates
+
+
+def test_rebalance_steals_from_cold_under_tight_cap(monkeypatch, fresh_gov):
+    # cap == current total: the hot queue can only grow by what an idle
+    # donor gives up
+    monkeypatch.setenv("FGUMI_TPU_MEM_BUDGET", "64M")
+    hot = DynamicBudget("hot", 32 << 20, damp_s=0.0)
+    cold = DynamicBudget("cold", 32 << 20, floor=8 << 20, damp_s=0.0)
+    waits = {"hot": 0.0}
+    fresh_gov.register_budget(
+        hot, demand_fn=lambda: {"put_wait_s": waits["hot"],
+                                "get_wait_s": 0.0})
+    fresh_gov.register_budget(
+        cold, demand_fn=lambda: {"put_wait_s": 0.0, "get_wait_s": 0.0})
+    for _ in range(4):
+        waits["hot"] += 0.1
+        _tick(fresh_gov)
+    assert hot.limit > 32 << 20
+    assert cold.limit < 32 << 20
+    assert cold.limit >= cold.floor
+    assert hot.limit + cold.limit <= 64 << 20
+
+
+def test_rebalance_ignores_budgets_without_demand_fn(monkeypatch,
+                                                     fresh_gov):
+    monkeypatch.setenv("FGUMI_TPU_MEM_BUDGET", "1G")
+    b = DynamicBudget("mute", 32 << 20, damp_s=0.0)
+    fresh_gov.register_budget(b)  # no demand_fn: exempt
+    _tick(fresh_gov, 3)
+    assert b.limit == 32 << 20
+    assert fresh_gov.rebalances == 0
+
+
+def test_skewed_two_stage_pipeline_wait_drops_vs_static(monkeypatch,
+                                                        fresh_gov):
+    """The acceptance regression: a fast producer against a slow consumer
+    through a budget-bounded queue. Governed (sample_once driven), the
+    budget grows toward the contended side and the producer's cumulative
+    blocked time lands strictly below the static-budget run — without a
+    single direction flip."""
+    monkeypatch.setenv("FGUMI_TPU_MEM_BUDGET", "1G")
+    blob = 64 << 10  # 64 KiB items
+    n_items = 80
+
+    def scenario(governed: bool) -> float:
+        budget = DynamicBudget("stage", 4 * blob, ceiling=n_items * blob,
+                               damp_s=0.0)
+        tok = fresh_gov.register_budget(
+            budget, demand_fn=lambda: {"put_wait_s": budget.wait_s,
+                                       "get_wait_s": 0.0}) \
+            if governed else None
+        stop = StopSignal()
+        q = []
+        cv = threading.Condition()
+
+        def producer():
+            for _ in range(n_items):
+                budget.acquire(blob, stop=stop)
+                with cv:
+                    q.append(blob)
+                    cv.notify()
+
+        def consumer():
+            for _ in range(n_items):
+                with cv:
+                    while not q:
+                        cv.wait(1.0)
+                    n = q.pop(0)
+                time.sleep(0.002)  # the slow stage
+                budget.release(n)
+
+        threads = [threading.Thread(target=producer),
+                   threading.Thread(target=consumer)]
+        for t in threads:
+            t.start()
+        try:
+            # tick at ~50 ms so a saturated producer's per-tick wait growth
+            # clears the rebalancer's 20 ms hot threshold
+            while any(t.is_alive() for t in threads):
+                if governed:
+                    fresh_gov.sample_once()
+                time.sleep(0.05)
+        finally:
+            for t in threads:
+                t.join(timeout=10)
+        fresh_gov.unregister_budget(tok)
+        assert budget.flips == 0
+        if governed:
+            assert budget.limit > 4 * blob  # the governor moved budget in
+        return budget.wait_s
+
+    static_wait = scenario(governed=False)
+    governed_wait = scenario(governed=True)
+    assert governed_wait < static_wait
+    assert static_wait > 0.01  # the scenario actually contends
+
+
+# ---------------------------------------------------------------- sentinels
+
+
+def test_rss_watermarks_soft_then_hard(monkeypatch):
+    monkeypatch.setenv("FGUMI_TPU_RSS_SOFT", "100M")
+    monkeypatch.setenv("FGUMI_TPU_RSS_HARD", "200M")
+    rss = {"v": 50 << 20}
+    GOVERNOR._rss_fn = lambda: rss["v"]
+    b = DynamicBudget("x", 64 << 20, floor=8 << 20, damp_s=0.0)
+    tok = GOVERNOR.register_budget(b)
+    try:
+        _tick()
+        assert GOVERNOR.state == "ok"
+        rss["v"] = 150 << 20
+        _tick()
+        assert GOVERNOR.state == "soft"
+        assert b.limit < 64 << 20  # degradation: budgets shrink
+        shed = GOVERNOR.admission_pressure()
+        assert shed is not None and "rss" in shed["reason"]
+        assert shed["retry_after_s"] > 0
+        rss["v"] = 250 << 20
+        _tick()
+        assert GOVERNOR.state == "hard"
+        with pytest.raises(ResourceExhausted):
+            GOVERNOR.check_hard()
+        rss["v"] = 50 << 20
+        _tick()
+        assert GOVERNOR.state == "ok"
+        assert GOVERNOR.admission_pressure() is None
+        kinds = [ev["kind"] for ev in GOVERNOR.snapshot()["events"]]
+        assert kinds == ["pressure_soft", "pressure_hard", "pressure_ok"]
+    finally:
+        GOVERNOR.unregister_budget(tok)
+
+
+def test_disk_watermarks_via_watch_path(monkeypatch, tmp_path):
+    free = {"v": 10 << 30}
+    GOVERNOR._rss_fn = lambda: None
+    GOVERNOR._disk_fn = lambda path: free["v"]
+    tok = GOVERNOR.watch_path("spill", str(tmp_path))
+    try:
+        _tick()
+        assert GOVERNOR.state == "ok"
+        free["v"] = 256 << 20  # below the 512 MiB soft default
+        _tick()
+        assert GOVERNOR.state == "soft"
+        free["v"] = 32 << 20   # below the 64 MiB hard default
+        _tick()
+        assert GOVERNOR.state == "hard"
+        assert "spill" in GOVERNOR.hard_reason
+        snap = GOVERNOR.snapshot()
+        assert snap["disk_free_min_bytes"] == 32 << 20
+    finally:
+        GOVERNOR.unwatch_path(tok)
+
+
+def test_hard_pressure_fails_blocked_acquire(monkeypatch):
+    monkeypatch.setenv("FGUMI_TPU_RSS_HARD", "100M")
+    GOVERNOR._rss_fn = lambda: 200 << 20
+    _tick()
+    assert GOVERNOR.state == "hard"
+    b = DynamicBudget("x", 100, damp_s=0.0)
+    assert b.acquire(100)
+    # the producer that must WAIT is exactly who should die cleanly
+    with pytest.raises(ResourceExhausted):
+        b.acquire(50)
+    b.release(100)
+
+
+def test_merge_prefetch_forced_off_under_pressure(monkeypatch):
+    assert merge_prefetch_bytes() == 64 << 20
+    monkeypatch.setenv("FGUMI_TPU_MERGE_PREFETCH", "16M")
+    assert merge_prefetch_bytes() == 16 << 20
+    GOVERNOR.state = "soft"
+    assert merge_prefetch_bytes() == 0
+    GOVERNOR.state = "ok"
+    monkeypatch.setenv("FGUMI_TPU_MERGE_PREFETCH", "0")
+    assert merge_prefetch_bytes() == 0
+
+
+def test_reraise_enospc_converts_only_enospc():
+    other = OSError(errno.EIO, "io error")
+    assert reraise_enospc(other, "sort.spill") is None  # caller re-raises
+    full = OSError(errno.ENOSPC, "No space left on device")
+    with pytest.raises(ResourceExhausted) as ei:
+        reraise_enospc(full, "sort.spill", path="/tmp")
+    assert ei.value.kind == "enospc"
+    assert ei.value.__cause__ is full
+    assert any(ev["kind"] == "enospc"
+               for ev in GOVERNOR.snapshot()["events"])
+
+
+# -------------------------------------------------- ENOSPC e2e via the CLI
+
+
+@pytest.fixture(scope="module")
+def grouped_bam(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("gov_bam") / "sim.bam")
+    rc = cli_main(["simulate", "grouped-reads", "-o", path,
+                   "--num-families", "80", "--family-size", "4",
+                   "--seed", "13"])
+    assert rc == 0
+    return path
+
+
+@pytest.mark.parametrize("phase,spec", [
+    ("spill", "sort.spill:enospc:1.0:1"),
+    ("merge", "writer.compress:enospc:1.0:1"),
+])
+def test_enospc_clean_failure_contract(grouped_bam, tmp_path, monkeypatch,
+                                       phase, spec):
+    """Injected disk-full mid-spill and mid-merge: exit code 4, no partial
+    output, no stale spill temps, and the run report carries the resource
+    section (the ISSUE 8 acceptance, in-process twin of chaos_smoke)."""
+    monkeypatch.setenv("FGUMI_TPU_FAULT", spec)
+    faults.reset()
+    spill = tmp_path / "spill"
+    spill.mkdir()
+    out = tmp_path / "out.bam"
+    rpt = tmp_path / "report.json"
+    rc = cli_main(["--run-report", str(rpt), "sort", "-i", grouped_bam,
+                   "-o", str(out), "--max-records-in-ram", "50",
+                   "--tmp-dir", str(spill)])
+    assert rc == 4
+    assert not out.exists()
+    assert list(spill.iterdir()) == []  # spill runs swept
+    assert [p.name for p in tmp_path.iterdir()
+            if p.name not in ("spill", "report.json")] == []
+    report = json.loads(rpt.read_text())
+    assert report["exit_status"] == 4
+    res = report["resource"]
+    assert any(ev["kind"] == "enospc" for ev in res["events"])
+
+
+def test_enospc_during_spill_pure_python_engine(tmp_path, monkeypatch):
+    """Same contract on the pure-Python ExternalSorter (the native engine
+    is what the CLI test exercises when the lib is present)."""
+    from fgumi_tpu.sort.external import ExternalSorter
+
+    monkeypatch.setenv("FGUMI_TPU_FAULT", "sort.spill:enospc:1.0:1")
+    faults.reset()
+    s = ExternalSorter(lambda r: b"", max_bytes=1 << 30,
+                       tmp_dir=str(tmp_path), max_records=10)
+    with pytest.raises(ResourceExhausted):
+        with s:
+            for i in range(200):
+                s.add_entry(b"k%04d" % i, b"x" * 50)
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_enospc_mid_write_sweeps_partial_run(tmp_path, monkeypatch):
+    """A disk that fills AFTER the .run temp is created (the injected fault
+    fires before creation, so this is the other half): the partial run is
+    registered at submission like the native engine's slot, so close()
+    still sweeps it — no stale temp, no open handle."""
+    from fgumi_tpu.sort import external
+
+    def full_disk(self, frame):
+        raise OSError(errno.ENOSPC, "No space left on device")
+
+    monkeypatch.setattr(external._SpillRun, "_write_frame", full_disk)
+    s = external.ExternalSorter(lambda r: b"", max_bytes=1 << 30,
+                                tmp_dir=str(tmp_path), max_records=10)
+    with pytest.raises(ResourceExhausted) as ei:
+        with s:
+            for i in range(200):
+                s.add_entry(b"k%04d" % i, b"x" * 50)
+    assert ei.value.kind == "enospc"
+    assert list(tmp_path.iterdir()) == []
+
+
+# ----------------------------------------------- merge prefetch determinism
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_merge_prefetch_byte_identity(tmp_path, native):
+    """Phase-2 prefetch never reorders: spill_workers=3 yields the exact
+    record sequence of the synchronous merge, both engines."""
+    import random
+
+    from fgumi_tpu.native import get_lib
+    from fgumi_tpu.sort.external import ExternalSorter, NativeExternalSorter
+
+    if native and get_lib() is None:
+        pytest.skip("native lib unavailable")
+    cls = NativeExternalSorter if native else ExternalSorter
+    random.seed(7)
+    entries = [(random.randbytes(12), random.randbytes(80))
+               for _ in range(4000)]
+
+    def collect(workers):
+        d = tmp_path / f"{native}_{workers}"
+        d.mkdir()
+        s = cls(lambda r: b"", max_bytes=64 << 10, tmp_dir=str(d),
+                spill_workers=workers)
+        with s:
+            for k, d in entries:
+                s.add_entry(k, d)
+            return list(s.sorted_records())
+
+    assert collect(0) == collect(3)
+
+
+# ------------------------------- fused/staged identity under rebalancing
+
+
+@pytest.fixture
+def single_device(monkeypatch):
+    flags = os.environ.get("XLA_FLAGS", "")
+    monkeypatch.setenv("XLA_FLAGS", " ".join(
+        f for f in flags.split()
+        if "host_platform_device_count" not in f))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.delenv("FGUMI_TPU_COORDINATOR", raising=False)
+
+
+@pytest.fixture(scope="module")
+def fastq_inputs(tmp_path_factory):
+    d = tmp_path_factory.mktemp("gov_fq")
+    r1, r2 = str(d / "r1.fq.gz"), str(d / "r2.fq.gz")
+    rc = cli_main(["simulate", "fastq-reads", "-1", r1, "-2", r2,
+                   "--num-families", "40", "--family-size", "3",
+                   "--read-length", "60", "--seed", "29"])
+    assert rc == 0
+    return r1, r2
+
+
+@pytest.mark.parametrize("mode", ["fused", "staged"])
+def test_governed_run_byte_identical_to_ungoverned(single_device,
+                                                   fastq_inputs, tmp_path,
+                                                   monkeypatch, mode):
+    """Budgets change when bytes move, never what is written: tiny chain
+    budgets + a fast governor tick (maximally aggressive rebalancing) vs
+    FGUMI_TPU_GOVERNOR=0 — byte-identical, fused and staged."""
+    r1, r2 = fastq_inputs
+    monkeypatch.setenv("FGUMI_TPU_CHAIN_BYTES", str(1 << 20))
+    monkeypatch.setenv("FGUMI_TPU_GOVERNOR_PERIOD_S", "0.05")
+    extra = ["--no-fuse"] if mode == "staged" else []
+
+    def run(label, governed):
+        if governed:
+            monkeypatch.delenv("FGUMI_TPU_GOVERNOR", raising=False)
+        else:
+            monkeypatch.setenv("FGUMI_TPU_GOVERNOR", "0")
+        out = str(tmp_path / f"{label}.bam")
+        rc = cli_main(["pipeline", "-i", r1, r2, "-r", "8M+T", "+T",
+                       "--sample", "s", "--library", "l", "-o", out,
+                       "--filter-min-reads", "1", "--threads", "2"] + extra)
+        assert rc == 0
+        GOVERNOR.stop()  # static next run: stop the sampling thread
+        return open(out, "rb").read()
+
+    governed = run("governed", True)
+    ungoverned = run("ungoverned", False)
+    assert governed == ungoverned and len(governed) > 0
+
+
+# ------------------------------------------------------- serve: quota, shed
+
+
+def test_serve_per_client_quota():
+    from fgumi_tpu.serve.jobs import JobRegistry
+    from fgumi_tpu.serve.scheduler import Scheduler
+
+    reg = JobRegistry()
+    sched = Scheduler(lambda job: 0, reg, workers=1, queue_limit=10,
+                      max_per_client=2)
+    # workers NOT started: jobs stay queued, admission is deterministic
+    a1 = reg.create(["a"], "normal", client="alice")
+    a2 = reg.create(["b"], "normal", client="alice")
+    a3 = reg.create(["c"], "normal", client="alice")
+    assert sched.submit(a1) == (True, None)
+    assert sched.submit(a2) == (True, None)
+    admitted, reason = sched.submit(a3)
+    assert not admitted
+    assert "quota exceeded" in reason and "alice" in reason
+    # anonymous submits are never quota-limited
+    for _ in range(4):
+        job = reg.create(["x"], "normal")
+        assert sched.submit(job) == (True, None)
+    # releasing an alice slot (cancel the queued job) readmits
+    assert sched.cancel(a1.id) == (True, None)
+    assert sched.client_quota_state() == {"alice": 1}
+    assert sched.submit(a3) == (True, None)
+
+
+def test_serve_quota_released_when_job_finishes():
+    from fgumi_tpu.serve.jobs import JobRegistry
+    from fgumi_tpu.serve.scheduler import Scheduler
+
+    reg = JobRegistry()
+    done = threading.Event()
+    sched = Scheduler(lambda job: (done.wait(10), 0)[1], reg, workers=1,
+                      queue_limit=4, max_per_client=1)
+    sched.start()
+    j1 = reg.create(["a"], "normal", client="bob")
+    assert sched.submit(j1) == (True, None)
+    j2 = reg.create(["b"], "normal", client="bob")
+    admitted, reason = j_res = sched.submit(j2)
+    assert not admitted and "quota exceeded" in reason, j_res
+    done.set()
+    deadline = time.monotonic() + 10
+    while sched.client_quota_state() and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert sched.client_quota_state() == {}  # released at completion
+    assert sched.submit(reg.create(["c"], "normal", client="bob")) \
+        == (True, None)
+
+
+def test_serve_shed_under_resource_pressure(tmp_path, monkeypatch):
+    from fgumi_tpu.serve.daemon import JobService
+
+    monkeypatch.setenv("FGUMI_TPU_RSS_SOFT", "100M")
+    GOVERNOR._rss_fn = lambda: 150 << 20
+    GOVERNOR.sample_once()
+    assert GOVERNOR.state == "soft"
+    svc = JobService(str(tmp_path / "s.sock"))
+    req = {"v": 1, "op": "submit", "argv": ["sort", "-i", "x", "-o", "y"],
+           "priority": "normal"}
+    resp = svc.handle_request(dict(req))
+    assert resp["ok"] is False
+    assert resp["error"].startswith("resource_pressure:")
+    assert resp["retry_after_s"] > 0
+    assert GOVERNOR.snapshot()["shed"] >= 1
+    # status/ping still answer under pressure (only NEW work is shed)
+    assert svc.handle_request({"v": 1, "op": "ping"})["ok"]
+    # pressure clears -> admission resumes
+    GOVERNOR._rss_fn = lambda: 10 << 20
+    GOVERNOR.sample_once()
+    resp = svc.handle_request(dict(req))
+    assert resp["ok"] is True
+    assert resp["job"]["state"] == "queued"
+
+
+def test_serve_shed_answers_deduped_resubmit(tmp_path, monkeypatch):
+    """An idempotent resubmit of an EXISTING job is answered even while
+    shedding — it creates no new work."""
+    from fgumi_tpu.serve.daemon import JobService
+
+    svc = JobService(str(tmp_path / "s.sock"))
+    req = {"v": 1, "op": "submit", "argv": ["sort", "-i", "x", "-o", "y"],
+           "priority": "normal", "dedupe": "k1"}
+    first = svc.handle_request(dict(req))
+    assert first["ok"]
+    monkeypatch.setenv("FGUMI_TPU_RSS_SOFT", "100M")
+    GOVERNOR._rss_fn = lambda: 150 << 20
+    GOVERNOR.sample_once()
+    resp = svc.handle_request(dict(req))
+    assert resp["ok"] and resp["deduped"] is True
+    assert resp["job"]["id"] == first["job"]["id"]
+    # ... but a NEW dedupe key is new work: shed
+    resp = svc.handle_request({**req, "dedupe": "k2"})
+    assert not resp["ok"]
+    assert resp["error"].startswith("resource_pressure:")
+
+
+def test_journal_replay_restores_client_quota(tmp_path):
+    """The quota ledger survives a daemon crash: requeued jobs re-enter
+    admission under their journaled client id."""
+    from fgumi_tpu.serve.daemon import JobService
+
+    jpath = str(tmp_path / "wal.jsonl")
+    svc = JobService(str(tmp_path / "a.sock"), journal_path=jpath,
+                     max_per_client=2)
+    svc.recover()  # opens the journal (empty)
+    for _ in range(2):
+        resp = svc.handle_request(
+            {"v": 1, "op": "submit", "argv": ["sort", "-i", "x", "-o", "y"],
+             "priority": "normal", "client": "carol"})
+        assert resp["ok"], resp
+        assert resp["job"]["client"] == "carol"
+    svc.journal.close()
+
+    svc2 = JobService(str(tmp_path / "b.sock"), journal_path=jpath,
+                      max_per_client=2)
+    svc2.recover()
+    assert svc2.scheduler.client_quota_state() == {"carol": 2}
+    resp = svc2.handle_request(
+        {"v": 1, "op": "submit", "argv": ["sort", "-i", "x", "-o", "y"],
+         "priority": "normal", "client": "carol"})
+    assert not resp["ok"] and "quota exceeded" in resp["error"]
+    svc2.journal.close()
+
+
+# ------------------------------------------------------------ report fold
+
+
+def test_fold_metrics_publishes_governor_gauges(monkeypatch):
+    from fgumi_tpu.observe.metrics import METRICS
+
+    monkeypatch.setenv("FGUMI_TPU_MEM_BUDGET", "1G")
+    b = DynamicBudget("probe", 8 << 20, damp_s=0.0)
+    tok = GOVERNOR.register_budget(
+        b, demand_fn=lambda: {"put_wait_s": 1.0, "get_wait_s": 0.0})
+    try:
+        _tick(n=2)
+        GOVERNOR.fold_metrics()
+        snap = METRICS.snapshot()
+        assert snap["governor.samples"] == 2
+        assert snap["governor.budget.probe.limit"] == b.limit
+        assert "governor.rebalances" in snap
+        assert snap["resource.state"] == "ok"
+    finally:
+        GOVERNOR.unregister_budget(tok)

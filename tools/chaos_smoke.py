@@ -168,7 +168,82 @@ def main():
             ok &= check("report records deadline fallback + breaker "
                         "opening", False, str(e))
 
-        # 4) SIGKILL mid-write: no partial file under the final name
+        # 4) disk full (ISSUE 8): injected ENOSPC mid-spill and mid-merge
+        # both honor the resource clean-failure contract — exit 4, no
+        # partial output, no stale spill temps, and the run report records
+        # the resource event (docs/resilience.md "Resource governance")
+        big = os.path.join(tmp, "big.bam")
+        p = run(["simulate", "grouped-reads", "-o", big,
+                 "--num-families", "120", "--family-size", "4",
+                 "--seed", "17"])
+        assert p.returncode == 0, p.stderr
+        for phase, spec in (
+                ("mid-spill", "sort.spill:enospc:1.0:1"),
+                ("mid-merge", "writer.compress:enospc:1.0:1")):
+            d = os.path.join(tmp, f"enospc_{phase.replace('-', '_')}")
+            spill = os.path.join(d, "spill")
+            os.makedirs(spill)
+            out = os.path.join(d, "out.bam")
+            rpt = os.path.join(d, "report.json")
+            p = run(["--run-report", rpt, "sort", "-i", big, "-o", out,
+                     "--max-records-in-ram", "60", "--tmp-dir", spill],
+                    env={"FGUMI_TPU_FAULT": spec})
+            leftovers = [n for n in os.listdir(d)
+                         if n not in ("report.json", "spill")] \
+                + os.listdir(spill)
+            ok &= check(f"ENOSPC {phase} -> exit 4, no partial output or "
+                        "spill temps",
+                        p.returncode == 4 and not leftovers
+                        and "Traceback" not in p.stderr,
+                        f"rc={p.returncode} leftovers={leftovers}")
+            try:
+                report = __import__("json").load(open(rpt))
+                res = report.get("resource", {})
+                ok &= check(f"ENOSPC {phase} -> report records the "
+                            "resource event",
+                            report.get("exit_status") == 4
+                            and any(ev.get("kind") == "enospc"
+                                    for ev in res.get("events", [])),
+                            f"events={res.get('events')}")
+            except (OSError, ValueError) as e:
+                ok &= check(f"ENOSPC {phase} -> report records the "
+                            "resource event", False, str(e))
+
+        # 5) governed vs ungoverned byte-identity: with the governor
+        # rebalancing aggressively (tiny starting channel budgets, fast
+        # ticks) the pipeline chain's bytes land identically — budgets
+        # change WHEN bytes move, never what is written
+        gov_sim = os.path.join(tmp, "gov")
+        os.mkdir(gov_sim)
+        p = run(["simulate", "fastq-reads", "-1", "r1.fq.gz",
+                 "-2", "r2.fq.gz", "--num-families", "60",
+                 "--family-size", "3", "--read-length", "60",
+                 "--seed", "23"], cwd=gov_sim)
+        assert p.returncode == 0, p.stderr
+        gov_env = {"FGUMI_TPU_CHAIN_BYTES": str(1 << 20),
+                   "FGUMI_TPU_GOVERNOR_PERIOD_S": "0.05"}
+        for mode, extra in (("fused", []), ("staged", ["--no-fuse"])):
+            outs = {}
+            for label, env in (("governed", gov_env),
+                               ("ungoverned",
+                                {**gov_env, "FGUMI_TPU_GOVERNOR": "0"})):
+                d = os.path.join(gov_sim, f"{mode}_{label}")
+                os.mkdir(d)
+                for f in ("r1.fq.gz", "r2.fq.gz"):
+                    os.link(os.path.join(gov_sim, f), os.path.join(d, f))
+                p = run(["pipeline", "-i", "r1.fq.gz", "r2.fq.gz",
+                         "-r", "8M+T", "+T", "-o", "out.bam",
+                         "--filter-min-reads", "1", "--threads", "2",
+                         "--sample", "s", "--library", "l", *extra],
+                        env=env, cwd=d)
+                outs[label] = (open(os.path.join(d, "out.bam"), "rb").read()
+                               if p.returncode == 0 else label.encode())
+            ok &= check(f"{mode} chain: governed run byte-identical to "
+                        "FGUMI_TPU_GOVERNOR=0",
+                        outs["governed"] == outs["ungoverned"],
+                        f"{len(outs['governed'])} bytes")
+
+        # 6) SIGKILL mid-write: no partial file under the final name
         victim = os.path.join(tmp, "victim.bam")
         code = (
             "import sys, time\n"

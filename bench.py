@@ -45,7 +45,7 @@ import devprobe
 _KERNEL_BENCH = devprobe.KERNEL_BENCH
 
 _WORKER = r"""
-import json, os, sys, time
+import json, math, os, sys, time
 sys.path.insert(0, %(repo)r)
 import jax  # noqa: init the backend before timing anything
 
@@ -69,6 +69,14 @@ wall_s = None
 dstats = None
 breakdown = None
 
+def _pctl(vals, q):
+    # nearest-rank percentile s[ceil(q*n)-1]: deterministic, no numpy
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    rank = math.ceil(q * len(s))
+    return round(s[min(max(rank - 1, 0), len(s) - 1)], 5)
+
 def dispatch_breakdown():
     # Per-dispatch attribution from the DeviceStats timeline
     # (docs/observability.md "Dispatch breakdown"): pack_s = host packing
@@ -77,19 +85,32 @@ def dispatch_breakdown():
     # compute overlapped with host work), fetch_s = host time blocked
     # waiting for result bytes. Plus the constant-cache hit/upload
     # counters that prove tables cross the link once, not per dispatch.
+    # Each phase also carries p50/p99 (ISSUE 9): the round-5 post-mortem
+    # needed the TAIL of these distributions, not just the sums.
     tl = DEVICE_STATS.timeline_snapshot()
     agg = {"dispatches": len(tl), "pack_s": 0.0, "upload_s": 0.0,
            "compute_s": 0.0, "fetch_s": 0.0}
+    per = {"pack_s": [], "upload_s": [], "compute_s": [], "fetch_s": [],
+           "wall_s": []}
     for t in tl:
+        per["pack_s"].append(t.get("pack_s", 0.0))
+        per["upload_s"].append(t.get("upload_s", 0.0))
+        per["fetch_s"].append(t.get("fetch_wait_s", 0.0))
         agg["pack_s"] += t.get("pack_s", 0.0)
         agg["upload_s"] += t.get("upload_s", 0.0)
         agg["fetch_s"] += t.get("fetch_wait_s", 0.0)
         if "t_fetched" in t and "t_exec" in t:
-            agg["compute_s"] += max(
+            c = max(
                 t["t_fetched"] - t.get("fetch_wait_s", 0.0) - t["t_exec"],
                 0.0)
+            per["compute_s"].append(c)
+            agg["compute_s"] += c
+        if "t_fetched" in t and "t_dispatch" in t:
+            per["wall_s"].append(max(t["t_fetched"] - t["t_dispatch"], 0.0))
     for k in ("pack_s", "upload_s", "compute_s", "fetch_s"):
         agg[k] = round(agg[k], 4)
+    agg["percentiles"] = {k: {"p50": _pctl(v, 0.50), "p99": _pctl(v, 0.99)}
+                          for k, v in per.items()}
     agg["const_cache_hits"] = DEVICE_STATS.const_hits
     agg["const_cache_uploads"] = DEVICE_STATS.const_uploads
     # adaptive-offload stamps (ISSUE 6): per-run route counters, the cost
@@ -112,6 +133,11 @@ def dispatch_breakdown():
                             t["t_fetched"] - t["t_dispatch"], 0.0), 4)})
     if pva:
         agg["pred_vs_actual"] = pva[:64]
+        errs = [abs(p["actual_s"] - p["pred_s"]) for p in pva]
+        agg["pred_abs_err_s"] = {
+            "mean": round(sum(errs) / len(errs), 5),
+            "p50": _pctl(errs, 0.50), "p99": _pctl(errs, 0.99),
+            "samples": len(errs)}
     return agg
 
 configs = [threads] if threads == "0" else [threads, "0"]
@@ -171,6 +197,25 @@ CPU_ENV = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
            # executables come from the persistent compilation cache
            "TF_CPP_MIN_LOG_LEVEL": "3"}
 
+# Flight-recorder black boxes for every device attempt (ISSUE 9): a probe
+# or worker that wedges leaves schema'd evidence (ring + thread stacks +
+# device timeline naming the stuck dispatch) in this directory instead of
+# a bare subprocess timeout; failed attempts attach the dump paths to the
+# BENCH artifact so a chip-unreachable round is machine-diagnosable.
+FLIGHT_DIR = os.environ.get("FGUMI_TPU_FLIGHT") or tempfile.mkdtemp(
+    prefix="fgumi_bench_flight_")
+
+
+def _flight_dumps(before=()):
+    """Flight-dump files in FLIGHT_DIR beyond ``before`` (sorted paths)."""
+    try:
+        names = sorted(set(os.listdir(FLIGHT_DIR)) - set(before))
+    except OSError:
+        return []
+    return [os.path.join(FLIGHT_DIR, n) for n in names
+            if n.startswith("flight-")]
+
+
 # Device-attempt env: the dispatch-deadline/breaker layer armed tight.
 # Round 5 lost its whole bench window to two 600 s device timeouts; with a
 # deadline, a wedged dispatch is abandoned in <=90 s, the batch completes
@@ -178,7 +223,8 @@ CPU_ENV = {"JAX_PLATFORMS": "cpu", "PYTHONPATH": "",
 # deadline_fallbacks + breaker transitions instead of vanishing into a
 # subprocess timeout. An explicit FGUMI_TPU_DISPATCH_DEADLINE_S wins.
 DEVICE_ENV = {"FGUMI_TPU_DISPATCH_DEADLINE_S":
-              os.environ.get("FGUMI_TPU_DISPATCH_DEADLINE_S", "20:90")}
+              os.environ.get("FGUMI_TPU_DISPATCH_DEADLINE_S", "20:90"),
+              "FGUMI_TPU_FLIGHT": FLIGHT_DIR}
 
 
 class DeviceTrier:
@@ -216,8 +262,19 @@ class DeviceTrier:
     def probe(self):
         t = round(time.monotonic() - self.t_start, 1)  # offset into the bench
         timeout = min(self.probe_timeout, max(self._remaining(), 10))
-        res = devprobe.staged_probe(timeout)
+        before = _flight_dumps()
+        res = devprobe.staged_probe(timeout,
+                                    env_overrides={"FGUMI_TPU_FLIGHT":
+                                                   FLIGHT_DIR})
         res["t"] = t
+        if not res["ok"]:
+            # a failed probe carries whatever black boxes the attempt left
+            # behind (deadline overruns / breaker trips inside the child):
+            # the chip-unreachable record becomes machine-diagnosable
+            dumps = _flight_dumps(before=[os.path.basename(p)
+                                          for p in before])
+            if dumps:
+                res["flight_dumps"] = dumps
         self.probes.append(res)
         return res if res["ok"] else None
 
@@ -588,6 +645,12 @@ print(json.dumps(out))
     if umi_times:
         result["umi_assign_seconds"] = umi_times
     result["device_probes"] = trier.probes
+    # flight-recorder evidence trail: every black box any device attempt
+    # (probe or worker subprocess) left behind this round
+    dumps = _flight_dumps()
+    if dumps:
+        result["flight_dumps"] = dumps
+        result["flight_dump_dir"] = FLIGHT_DIR
 
     # Merge evidence captured by the in-session probe loop (devprobe.py
     # --loop): a momentary tunnel wake-up earlier in the round still yields a

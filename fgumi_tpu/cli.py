@@ -3126,6 +3126,13 @@ def _add_serve(sub):
                    help="run a tiny device canary every S seconds feeding "
                         "the wedge circuit breaker (default: "
                         "FGUMI_TPU_HEALTH_PERIOD_S, else off)")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="serve Prometheus text-format /metrics and a "
+                        "/healthz liveness endpoint on this loopback HTTP "
+                        "port (0 = an ephemeral port, logged at startup; "
+                        "unset = no listener). The scrape and the `stats` "
+                        "protocol op read the same live snapshot "
+                        "(docs/serving.md)")
     p.set_defaults(func=cmd_serve)
 
 
@@ -3148,6 +3155,10 @@ def cmd_serve(args):
         # negative value would defeat the size limit entirely
         log.error("--max-frame-bytes must be >= 1024")
         return 2
+    if args.metrics_port is not None \
+            and not 0 <= args.metrics_port <= 65535:
+        log.error("--metrics-port must be in 0..65535")
+        return 2
     if args.report_dir:
         try:
             os.makedirs(args.report_dir, exist_ok=True)
@@ -3167,7 +3178,8 @@ def cmd_serve(args):
         report_dir=args.report_dir,
         max_frame_bytes=args.max_frame_bytes or _proto.MAX_FRAME_BYTES,
         journal_path=args.journal, health_period_s=health,
-        max_per_client=args.max_per_client)
+        max_per_client=args.max_per_client,
+        metrics_port=args.metrics_port)
     # claim the socket BEFORE the device warm-up: an accidental duplicate
     # start must fail fast without touching the single-tenant chip
     try:
@@ -3176,7 +3188,14 @@ def cmd_serve(args):
         log.error("%s", e)
         return 2
     except OSError as e:
-        log.error("cannot bind %s: %s", args.socket, e)
+        # the unix socket binds first, so a failure after it was claimed
+        # can only be the --metrics-port HTTP listener
+        if service._sock is not None and args.metrics_port is not None:
+            log.error("cannot bind metrics port %d: %s",
+                      args.metrics_port, e)
+        else:
+            log.error("cannot bind %s: %s", args.socket, e)
+        service.close()
         return 2
     service.warm_up(compile_cache_dir=args.compile_cache,
                     touch_device=not args.no_warmup)
@@ -3278,6 +3297,43 @@ def cmd_submit(args):
     return rc if isinstance(rc, int) and rc else 1
 
 
+def _add_stats(sub):
+    p = sub.add_parser(
+        "stats",
+        help="Print a running serve daemon's live introspection snapshot "
+             "(scheduler/quota/journal/breaker/governor/device state + "
+             "latency histogram summaries) as JSON")
+    p.add_argument("--socket", required=True, metavar="PATH",
+                   help="daemon socket (serve --socket)")
+    p.add_argument("--section", default=None, metavar="KEY",
+                   help="print only one top-level section of the snapshot "
+                        "(e.g. latency, scheduler, breaker)")
+    p.set_defaults(func=cmd_stats)
+
+
+def cmd_stats(args):
+    import json as _json
+
+    from .serve.client import ServeClient, ServeError
+
+    client = ServeClient(args.socket)
+    try:
+        stats = client.stats()
+    except ServeError as e:
+        # includes the old-daemon rejection ("unknown op 'stats' ...")
+        # verbatim — the version-negotiation contract
+        log.error("stats: %s", e)
+        return 2
+    if args.section is not None:
+        if args.section not in stats:
+            log.error("stats: no section %r (have: %s)", args.section,
+                      ", ".join(sorted(stats)))
+            return 2
+        stats = {args.section: stats[args.section]}
+    print(_json.dumps(stats, indent=1, sort_keys=True))
+    return 0
+
+
 def _add_jobs(sub):
     p = sub.add_parser(
         "jobs", help="Inspect or manage a serve daemon's job queue")
@@ -3371,8 +3427,17 @@ def build_parser():
     parser.add_argument(
         "--heartbeat", type=float, default=None, metavar="SECONDS",
         help="log a one-line progress heartbeat (stage counters, queue "
-             "depths, device activity, RSS) every N seconds "
+             "depths, device activity, p99 dispatch wall, records/s + ETA, "
+             "RSS) every N seconds "
              "(also FGUMI_TPU_HEARTBEAT_S; 0 = off, the default)")
+    parser.add_argument(
+        "--flight-dump-dir", default=None, metavar="DIR",
+        help="write flight-recorder black boxes (ring of recent events + "
+             "all-thread stacks + metrics/device/breaker/governor "
+             "snapshots) here on unhandled exceptions, resource "
+             "exhaustion, dispatch-deadline overruns, breaker trips, and "
+             "SIGTERM (also FGUMI_TPU_FLIGHT; unset = record the ring but "
+             "never write a file)")
     parser.add_argument(
         "--shape-buckets", type=_shape_buckets_arg, default=None,
         metavar="GROWTH[:CAP]",
@@ -3405,6 +3470,7 @@ def build_parser():
     _add_serve(sub)
     _add_submit(sub)
     _add_jobs(sub)
+    _add_stats(sub)
     return parser
 
 
@@ -3441,7 +3507,12 @@ def _run_command(args):
     except ResourceExhausted as e:
         # resource hard limit (disk full, RSS hard watermark): atomic temps
         # were swept by the ordinary error unwinding; the run report gets a
-        # `resource` section from the governor's event log
+        # `resource` section from the governor's event log, and the flight
+        # recorder freezes a black box (ring + thread stacks + governor
+        # snapshot) naming what was starved
+        from .observe.flight import FLIGHT
+
+        FLIGHT.dump("resource-exhausted", exc=e)
         log.error("%s", e)
         return 4
     except BrokenPipeError:
@@ -3584,6 +3655,16 @@ def _main_scoped(args, argv):
     from .utils.governor import GOVERNOR
 
     GOVERNOR.maybe_start()
+    # flight recorder destination: the ring always records; a configured
+    # dump dir additionally turns failures into black-box files. The flag
+    # sets the process-wide destination (like the env var it mirrors) —
+    # daemon operators set it on the daemon, not per job.
+    from .observe.flight import FLIGHT, install_signal_dump
+
+    if getattr(args, "flight_dump_dir", None):
+        FLIGHT.configure(args.flight_dump_dir)
+    FLIGHT.note("command.start", command=args.command)
+    install_signal_dump()
     tracer = hb = None
     if trace_path:
         from .observe.trace import start_trace
@@ -3600,6 +3681,12 @@ def _main_scoped(args, argv):
     try:
         rc = _run_command(args)
         return rc
+    except Exception as e:
+        # anything _run_command's exit-code contract did not map is an
+        # unhandled crash: freeze a black box before unwinding (the run
+        # report below still records exit_status 1 + the dump path)
+        FLIGHT.dump("unhandled-exception", exc=e)
+        raise
     finally:
         _main_depth.reset(token)
         if hb is not None:

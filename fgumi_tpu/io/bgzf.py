@@ -11,6 +11,7 @@ blocks (BC extra subfield + EOF sentinel) so htslib/samtools can read the output
 
 import io
 import struct
+import time
 import zlib
 
 from ..observe import trace as _trace
@@ -118,16 +119,19 @@ class BgzfWriter(io.RawIOBase):
             from .. import native
 
             chunk_len = n_full * MAX_BLOCK_DATA
+            t0 = time.monotonic()
             with _trace.span("bgzf.compress", blocks=n_full) \
                     if self._trace_on else _trace.NULL_SPAN:
                 got = native.bgzf_compress_many(
                     memoryview(self._buf)[:chunk_len], self._level)
             if got is not None:
+                METRICS.observe("io.bgzf.compress_s", time.monotonic() - t0)
                 blob, _ = got
                 del self._buf[:chunk_len]
                 self._coffset += len(blob)
                 self._f.write(blob)
                 return len(data)
+        t0 = time.monotonic()
         with _trace.span("bgzf.compress", blocks=n_full) \
                 if self._trace_on else _trace.NULL_SPAN:
             while len(self._buf) >= MAX_BLOCK_DATA:
@@ -136,6 +140,7 @@ class BgzfWriter(io.RawIOBase):
                 block = compress_block(chunk, self._level)
                 self._coffset += len(block)
                 self._f.write(block)
+        METRICS.observe("io.bgzf.compress_s", time.monotonic() - t0)
         return len(data)
 
     def tell_virtual(self) -> int:
@@ -353,9 +358,12 @@ class BgzfReader:
             if not self._raw:
                 continue
             try:
+                t0 = time.monotonic()
                 with _trace.span("bgzf.decompress") \
                         if self._trace_on else _trace.NULL_SPAN:
                     decoded, consumed = native.bgzf_decompress(self._raw)
+                METRICS.observe("io.bgzf.decompress_s",
+                                time.monotonic() - t0)
             except ValueError:
                 # garbage where a member should start: let zlib report it
                 self._demote_to_zlib()
@@ -454,9 +462,12 @@ class BgzfReader:
                     self._eof = True
                 continue
             try:
+                t0 = time.monotonic()
                 with _trace.span("bgzf.decompress") \
                         if self._trace_on else _trace.NULL_SPAN:
                     decoded, consumed = native.bgzf_decompress(self._raw)
+                METRICS.observe("io.bgzf.decompress_s",
+                                time.monotonic() - t0)
             except ValueError:
                 self._demote_to_zlib()
                 data = self.read_into_available()

@@ -1,0 +1,350 @@
+"""Always-on flight recorder: a bounded ring of recent events plus a
+crash-time black-box dump.
+
+The round-5 bench lost its device win to two 600 s timeouts nobody could
+diagnose after the fact — the process died (or was killed) with all of its
+state in RAM. This module is the black box a production stack carries: a
+small, always-on ring buffer of recent *interesting* events (resolved
+device dispatches, breaker/governor/scheduler/feeder state transitions,
+every WARNING+ log line, span ends when tracing is armed), costing one
+deque append each in steady state, and a single
+:meth:`FlightRecorder.dump` that freezes everything — ring contents,
+all-thread stacks, metrics + latency summaries, DeviceStats timeline,
+breaker/governor snapshots — into one schema'd JSON file when something
+goes wrong.
+
+Dump triggers (each fires at most once per reason per process, bounded by
+:data:`MAX_DUMPS` total so a failure storm cannot fill a disk):
+
+- an unhandled exception escaping a CLI command (cli.py);
+- ``ResourceExhausted`` — the governor's hard-pressure clean failure;
+- a dispatch-deadline overrun (ops/kernel.py — the wedge signature);
+- the device circuit breaker tripping open (ops/breaker.py);
+- a fatal signal (SIGTERM, via :func:`install_signal_dump`; the serve
+  daemon's own SIGTERM drain handler supersedes this one on purpose —
+  a drained daemon is a clean exit, not a crash).
+
+Dumps are written only when a destination is configured
+(``--flight-dump-dir`` / ``FGUMI_TPU_FLIGHT``); the ring itself always
+records, so enabling dumps changes *where* evidence lands, never what was
+collected. A clean exit writes nothing.
+"""
+
+import json
+import logging
+import os
+import sys
+import threading
+import time
+import traceback
+from collections import deque
+
+log = logging.getLogger("fgumi_tpu")
+
+SCHEMA_VERSION = 1
+
+#: Ring capacity (events). Small on purpose: the ring answers "what were
+#: the last few hundred interesting things", not "everything that happened"
+#: — that is the trace's job. Override with FGUMI_TPU_FLIGHT_EVENTS.
+DEFAULT_EVENTS = 512
+
+#: Hard cap on black boxes per process: a wedge that re-fires per batch
+#: must not turn the dump dir into a disk-pressure incident of its own.
+MAX_DUMPS = 8
+
+#: How many trailing DeviceStats timeline entries ride in a dump.
+TIMELINE_TAIL = 16
+
+
+# ---------------------------------------------------------------------------
+# shared lazily-imported-singleton snapshots: one definition serves both the
+# flight dump's sections and the serve stats/metrics surfaces
+# (serve/introspect.py) so they cannot diverge
+
+
+def live_device_stats():
+    """The process-global DeviceStats, or None before ops.kernel loads."""
+    kern = sys.modules.get("fgumi_tpu.ops.kernel")
+    return getattr(kern, "DEVICE_STATS", None)
+
+
+def breaker_snapshot():
+    breaker = sys.modules.get("fgumi_tpu.ops.breaker")
+    return breaker.BREAKER.snapshot() if breaker is not None else None
+
+
+def governor_snapshot():
+    gov = sys.modules.get("fgumi_tpu.utils.governor")
+    return gov.GOVERNOR.snapshot() if gov is not None else None
+
+
+def router_snapshot():
+    router = sys.modules.get("fgumi_tpu.ops.router")
+    return router.ROUTER.snapshot() if router is not None else None
+
+
+def _ring_capacity() -> int:
+    try:
+        n = int(os.environ.get("FGUMI_TPU_FLIGHT_EVENTS",
+                               str(DEFAULT_EVENTS)))
+    except ValueError:
+        n = DEFAULT_EVENTS
+    return max(n, 16)
+
+
+class FlightRecorder:
+    """The process-wide ring + dump machinery (singleton :data:`FLIGHT`)."""
+
+    def __init__(self, capacity: int = None):
+        self._lock = threading.Lock()
+        self._ring = deque(maxlen=capacity or _ring_capacity())
+        self._t0 = time.monotonic()
+        self._dump_dir = None          # explicit --flight-dump-dir override
+        self._dumped_reasons = set()   # first dump per reason wins
+        self._dump_paths = []
+        self.events_noted = 0
+
+    # ------------------------------------------------------------ recording
+
+    def note(self, kind: str, **attrs) -> None:
+        """Append one event to the ring. Always on, deliberately cheap:
+        one dict build + one bounded deque append under a short lock."""
+        ev = {"t": round(time.monotonic() - self._t0, 4), "kind": kind,
+              "thread": threading.current_thread().name}
+        if attrs:
+            ev.update(attrs)
+        with self._lock:
+            self._ring.append(ev)
+            self.events_noted += 1
+
+    def events(self) -> list:
+        with self._lock:
+            return list(self._ring)
+
+    # ---------------------------------------------------------- destination
+
+    def configure(self, dump_dir) -> None:
+        """Set (or clear, with None) the explicit dump destination; the
+        ``FGUMI_TPU_FLIGHT`` environment is the fallback."""
+        self._dump_dir = dump_dir
+
+    def dump_dir(self):
+        return self._dump_dir or os.environ.get("FGUMI_TPU_FLIGHT") or None
+
+    def dump_paths(self) -> list:
+        """Paths of every black box written so far (run-report carriage)."""
+        with self._lock:
+            return list(self._dump_paths)
+
+    def reset(self) -> None:
+        """Test hook: clear the ring and the per-reason dump dedupe."""
+        with self._lock:
+            self._ring.clear()
+            self._dumped_reasons.clear()
+            self._dump_paths.clear()
+            self.events_noted = 0
+        self._dump_dir = None
+
+    # ------------------------------------------------------------- dumping
+
+    def dump(self, reason: str, exc: BaseException = None, **attrs):
+        """Write one black box; returns its path, or None when no dump dir
+        is configured / this reason already dumped / the cap is reached.
+
+        Never raises: a failing dump must not worsen the failure it is
+        documenting. Must NOT be called while holding a lock the snapshot
+        sections below also take (breaker/governor/DeviceStats locks)."""
+        d = self.dump_dir()
+        if not d:
+            return None
+        with self._lock:
+            if reason in self._dumped_reasons \
+                    or len(self._dump_paths) >= MAX_DUMPS:
+                return None
+            self._dumped_reasons.add(reason)
+            seq = len(self._dump_paths)
+        try:
+            obj = self._build(reason, exc, attrs)
+            safe = "".join(c if c.isalnum() or c in "-_" else "-"
+                           for c in reason)
+            path = os.path.join(d, f"flight-{os.getpid()}-{seq}-{safe}.json")
+            os.makedirs(d, exist_ok=True)
+            from ..utils.atomic import discard_output, open_output
+
+            out = open_output(path, "w")
+            try:
+                json.dump(obj, out, indent=1, default=str)
+                out.write("\n")
+            except BaseException:
+                discard_output(out)
+                raise
+            out.close()
+        except Exception as e:  # noqa: BLE001 - evidence loss != new crash
+            log.error("flight recorder: could not write black box (%s: %s)",
+                      type(e).__name__, e)
+            # a FAILED write must not consume the reason: the classic case
+            # is resource-exhausted firing while the dump dir's filesystem
+            # is the full one — a retrigger after space frees up (temps
+            # swept) should still get its black box
+            with self._lock:
+                self._dumped_reasons.discard(reason)
+            return None
+        with self._lock:
+            self._dump_paths.append(path)
+        log.warning("flight recorder: black box -> %s (%s)", path, reason)
+        return path
+
+    def _build(self, reason: str, exc, attrs) -> dict:
+        obj = {
+            "schema_version": SCHEMA_VERSION,
+            "tool": "fgumi-tpu",
+            "reason": reason,
+            "unix": round(time.time(), 3),
+            "pid": os.getpid(),
+            "argv": sys.argv,
+            "events": self.events(),
+            "threads": self._thread_stacks(),
+        }
+        if attrs:
+            obj["attrs"] = dict(attrs)
+        if exc is not None:
+            obj["exception"] = {
+                "type": type(exc).__name__,
+                "message": str(exc),
+                "traceback": traceback.format_exception(
+                    type(exc), exc, exc.__traceback__),
+            }
+        # every section below is best-effort: a half-initialized module
+        # must not take the black box down with it
+        for name, fn in (("metrics", self._metrics_section),
+                         ("device", self._device_section),
+                         ("breaker", breaker_snapshot),
+                         ("governor", governor_snapshot)):
+            try:
+                obj[name] = fn()
+            except Exception as e:  # noqa: BLE001 - keep the rest
+                obj[name] = {"error": f"{type(e).__name__}: {e}"}
+        return obj
+
+    @staticmethod
+    def _thread_stacks() -> dict:
+        """Current stack of every live thread, newest frame last."""
+        names = {t.ident: t.name for t in threading.enumerate()}
+        out = {}
+        for tid, frame in sys._current_frames().items():
+            label = f"{names.get(tid, 'unknown')}-{tid}"
+            out[label] = [ln.rstrip("\n") for ln in
+                          traceback.format_stack(frame)][-40:]
+        return out
+
+    @staticmethod
+    def _metrics_section() -> dict:
+        from .metrics import METRICS
+
+        return {"values": METRICS.snapshot(), "latency": METRICS.summaries()}
+
+    @staticmethod
+    def _device_section():
+        stats = live_device_stats()
+        if stats is None:
+            return None
+        tail = stats.timeline_snapshot()  # entries carry their true slot
+        tail = tail[-TIMELINE_TAIL:]
+        # a dispatch with no t_fetched stamp at dump time is still (or was,
+        # when abandoned) in flight: the wedge suspect list
+        wedged = [t for t in tail if "t_fetched" not in t]
+        out = {"snapshot": stats.snapshot(), "timeline_tail": tail,
+               "wedged_dispatches": wedged}
+        routing = router_snapshot()
+        if routing is not None:
+            out["routing"] = routing
+        return out
+
+
+
+#: Process-wide singleton. Flight evidence is a per-process fact: the ring
+#: deliberately spans every scope/job so a daemon dump shows the neighbour
+#: activity that a per-scope ring would hide.
+FLIGHT = FlightRecorder()
+
+
+def install_signal_dump() -> None:
+    """Dump a black box on SIGTERM before dying with the default action.
+
+    Installed by the CLI (main thread, depth-0) only when a dump dir is
+    configured. The serve daemon replaces this handler with its own drain
+    handler afterwards — a drained daemon is a clean exit, not a crash.
+    No-op off the main thread (in-process test harnesses)."""
+    if not FLIGHT.dump_dir():
+        return
+    import signal
+
+    def _on_fatal(signum, frame):
+        # the handler runs ON the interrupted thread, which may hold one
+        # of the (non-reentrant) locks the dump's snapshot sections take
+        # (metrics registry, the ring itself, DeviceStats) — dumping
+        # inline could deadlock and turn SIGTERM into a hang. A helper
+        # thread + bounded join keeps termination guaranteed: evidence is
+        # best-effort, dying is not. The thread runs under a COPY of the
+        # interrupted thread's context so the telemetry-scope proxies
+        # (METRICS/DEVICE_STATS) resolve to the running command's
+        # registries, not the process-global fallbacks.
+        import contextvars
+
+        ctx = contextvars.copy_context()
+        t = threading.Thread(
+            target=ctx.run, args=(FLIGHT.dump, "fatal-signal"),
+            kwargs={"signal": signal.Signals(signum).name},
+            name="fgumi-flight-dump", daemon=True)
+        t.start()
+        t.join(timeout=10)
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+    try:
+        signal.signal(signal.SIGTERM, _on_fatal)
+    except (ValueError, OSError):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# dump validation (tests + the telemetry smoke gate)
+
+_REQUIRED = {
+    "schema_version": int,
+    "tool": str,
+    "reason": str,
+    "unix": (int, float),
+    "pid": int,
+    "argv": list,
+    "events": list,
+    "threads": dict,
+}
+
+
+def validate_dump(obj) -> list:
+    """Structural validation of a black box; returns human-readable
+    violations (empty == valid), mirroring report.validate_report."""
+    errors = []
+    if not isinstance(obj, dict):
+        return ["flight dump is not a JSON object"]
+    for key, typ in _REQUIRED.items():
+        if key not in obj:
+            errors.append(f"missing required field {key!r}")
+        elif not isinstance(obj[key], typ):
+            errors.append(f"field {key!r} has type {type(obj[key]).__name__}")
+    if isinstance(obj.get("schema_version"), int) \
+            and obj["schema_version"] != SCHEMA_VERSION:
+        errors.append(f"schema_version {obj['schema_version']} != "
+                      f"{SCHEMA_VERSION}")
+    for ev in obj.get("events", []) if isinstance(obj.get("events"), list) \
+            else []:
+        if not isinstance(ev, dict) or "kind" not in ev or "t" not in ev:
+            errors.append(f"malformed ring event: {ev!r}")
+            break
+    if isinstance(obj.get("threads"), dict):
+        for name, stack in obj["threads"].items():
+            if not isinstance(stack, list):
+                errors.append(f"thread {name!r} stack is not a list")
+                break
+    return errors

@@ -51,6 +51,30 @@ def resolve_level(log_level: str = None, verbose: bool = False) -> int:
     return getattr(logging, name.upper())
 
 
+class FlightLogHandler(logging.Handler):
+    """Feeds every WARNING+ log record into the flight recorder's ring.
+
+    Always installed (the ring is always-on); costs one handler dispatch
+    per WARNING+ record — by definition not the hot path."""
+
+    def __init__(self):
+        super().__init__(level=logging.WARNING)
+
+    def emit(self, record):
+        try:
+            from .flight import FLIGHT
+
+            FLIGHT.note("log", level=record.levelname, logger=record.name,
+                        msg=record.getMessage()[:300])
+        except Exception:  # noqa: BLE001 - evidence must never crash logging
+            pass
+
+
+def _install_flight_handler(root):
+    if not any(isinstance(h, FlightLogHandler) for h in root.handlers):
+        root.addHandler(FlightLogHandler())
+
+
 def setup_logging(log_level: str = None, verbose: bool = False) -> int:
     """Install the elapsed/thread-aware format on the root logger.
 
@@ -59,13 +83,17 @@ def setup_logging(log_level: str = None, verbose: bool = False) -> int:
     the level is updated each call. Returns the effective level."""
     level = resolve_level(log_level, verbose)
     root = logging.getLogger()
+    _install_flight_handler(root)
     handler = None
     for h in root.handlers:
         if getattr(h, "_fgumi_observe", False):
             handler = h
             break
     if handler is None:
-        if root.handlers:
+        # the flight handler is ours and writes nowhere visible — only
+        # FOREIGN handlers mean someone else owns the logging config
+        if any(not isinstance(h, FlightLogHandler)
+               for h in root.handlers):
             # e.g. pytest or an embedding app configured logging first:
             # respect their handlers, only adjust the level
             root.setLevel(min(root.level or level, level))

@@ -41,9 +41,132 @@ these prefixes):
   BGZF reader/writer (and raw bytes for plain streams)
 - ``records.<label>`` — ProgressTracker totals per command label
 - ``faults.<point>`` — injected-fault fire counts
+
+Latency histograms (``METRICS.observe(name, seconds)``) live next to the
+counters under the same dotted names and fold into the run report's
+``latency`` section (schema v2) as ``{count, sum, p50, p90, p99, max}``
+summaries:
+
+- ``device.dispatch.{pack_s,upload_s,compute_s,fetch_s,wall_s}`` — per
+  dispatch, from the DeviceStats timeline at resolve time
+- ``device.router.pred_err_s`` — |predicted − actual| dispatch wall of the
+  offload cost model (ops/router.py), per stamped dispatch
+- ``pipeline.chain.{put_wait_s,get_wait_s}`` — per-blob backpressure waits
+  of the fused chain's channels (pipeline_chain.py)
+- ``governor.budget.wait_s`` — blocking DynamicBudget.acquire waits
+- ``sort.{spill_s,merge_frame_s}`` — external-sort spill runs and phase-2
+  merge frame decompressions
+- ``io.bgzf.{compress_s,decompress_s}`` — per BGZF (de)compress call
+- ``serve.job.{queue_wait_s,run_s,total_s}`` — daemon job latencies
+  (queued→running, running→terminal, submit→terminal)
 """
 
+import bisect
+import math
 import threading
+
+# ---------------------------------------------------------------------------
+# histograms
+
+#: Geometric bucket growth: 4 buckets per octave (~19% wide), deterministic
+#: for a given value — the same observation always lands in the same bucket
+#: on every platform, so summaries are reproducible across runs.
+HIST_GROWTH = 2.0 ** 0.25
+#: Lowest bucket upper edge (1 µs) and bucket count: edges span ~1 µs to
+#: ~1e6 s, far past any real latency; values beyond either end clamp to the
+#: boundary buckets.
+HIST_MIN = 1e-6
+HIST_BUCKETS = 164
+
+#: Inclusive upper edges of every bucket, precomputed once.
+HIST_EDGES = tuple(HIST_MIN * HIST_GROWTH ** i for i in range(HIST_BUCKETS))
+
+
+class Histogram:
+    """Deterministic log-bucketed latency histogram.
+
+    Observations land in geometric buckets (:data:`HIST_EDGES`); quantiles
+    are read as the upper edge of the bucket holding the quantile rank,
+    clamped to the exact observed max — so ``p50 <= p90 <= p99 <= max``
+    holds by construction and the error of any quantile is bounded by one
+    bucket width (~19%). Not thread-safe on its own; the owning
+    :class:`MetricsRegistry` serializes access."""
+
+    __slots__ = ("count", "total", "max", "_buckets")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+        self._buckets = {}  # bucket index -> observation count (sparse)
+
+    @staticmethod
+    def bucket_index(value: float) -> int:
+        """The (deterministic) bucket a value lands in."""
+        idx = bisect.bisect_left(HIST_EDGES, float(value))
+        return min(idx, HIST_BUCKETS - 1)
+
+    def observe(self, value) -> None:
+        v = float(value)
+        if v < 0.0 or math.isnan(v):
+            return  # a backwards clock must not poison the distribution
+        idx = self.bucket_index(v)
+        self._buckets[idx] = self._buckets.get(idx, 0) + 1
+        self.count += 1
+        self.total += v
+        if v > self.max:
+            self.max = v
+
+    def merge(self, other: "Histogram") -> None:
+        """Fold another histogram in (scope-exit publishing, shard joins)."""
+        for idx, n in other._buckets.items():
+            self._buckets[idx] = self._buckets.get(idx, 0) + n
+        self.count += other.count
+        self.total += other.total
+        if other.max > self.max:
+            self.max = other.max
+
+    def copy(self) -> "Histogram":
+        h = Histogram()
+        h.count = self.count
+        h.total = self.total
+        h.max = self.max
+        h._buckets = dict(self._buckets)
+        return h
+
+    def quantile(self, q: float) -> float:
+        """The q-quantile (0 < q <= 1) as a bucket upper edge, clamped to
+        the observed max."""
+        if not self.count:
+            return 0.0
+        rank = q * self.count
+        seen = 0
+        for idx in sorted(self._buckets):
+            seen += self._buckets[idx]
+            if seen >= rank:
+                return min(HIST_EDGES[idx], self.max)
+        return self.max
+
+    def buckets(self):
+        """``[(upper_edge_s, cumulative_count), ...]`` over non-empty
+        buckets, cumulative — the Prometheus ``le`` series shape."""
+        out = []
+        seen = 0
+        for idx in sorted(self._buckets):
+            seen += self._buckets[idx]
+            out.append((HIST_EDGES[idx], seen))
+        return out
+
+    def summary(self) -> dict:
+        """The run-report summary: count, sum, p50/p90/p99, max."""
+        return {
+            "count": self.count,
+            "sum": round(self.total, 6),
+            "p50": round(self.quantile(0.50), 6),
+            "p90": round(self.quantile(0.90), 6),
+            "p99": round(self.quantile(0.99), 6),
+            "max": round(self.max, 6),
+        }
 
 
 class MetricsRegistry:
@@ -52,6 +175,7 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._values = {}
+        self._hists = {}  # dotted name -> Histogram
 
     def inc(self, name: str, n=1):
         """Add ``n`` to a counter (creating it at 0)."""
@@ -88,6 +212,45 @@ class MetricsRegistry:
         with self._lock:
             return self._values.get(name, default)
 
+    def observe(self, name: str, value) -> None:
+        """Record one latency observation into the named histogram
+        (created on first use)."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Histogram()
+            h.observe(value)
+
+    def histogram(self, name: str):
+        """A copy of one histogram, or None."""
+        with self._lock:
+            h = self._hists.get(name)
+            return h.copy() if h is not None else None
+
+    def histograms(self) -> dict:
+        """Name-sorted ``{name: Histogram}`` copies of every histogram."""
+        with self._lock:
+            return {k: self._hists[k].copy() for k in sorted(self._hists)}
+
+    def summaries(self) -> dict:
+        """Name-sorted ``{name: {count,sum,p50,p90,p99,max}}`` — the run
+        report's ``latency`` section."""
+        with self._lock:
+            return {k: self._hists[k].summary() for k in sorted(self._hists)}
+
+    def merge_histograms(self, hists: dict) -> None:
+        """Fold ``{name: Histogram}`` in (scope-exit publishing: the
+        process-global registry accumulates every finished scope's
+        distributions, which is exactly the cumulative-since-start view a
+        long-lived daemon's /metrics endpoint wants)."""
+        with self._lock:
+            for name, h in hists.items():
+                mine = self._hists.get(name)
+                if mine is None:
+                    self._hists[name] = h.copy()
+                else:
+                    mine.merge(h)
+
     def snapshot(self) -> dict:
         """Name-sorted copy of every metric."""
         with self._lock:
@@ -96,9 +259,12 @@ class MetricsRegistry:
     def reset(self):
         with self._lock:
             self._values.clear()
+            self._hists.clear()
 
     def replace(self, mapping: dict):
-        """Overwrite this registry's whole content (scope publishing)."""
+        """Overwrite this registry's counter/gauge content (scope
+        publishing; histograms merge separately via
+        :meth:`merge_histograms`)."""
         with self._lock:
             self._values = dict(mapping)
 
@@ -140,6 +306,18 @@ class _RegistryProxy:
 
     def get(self, name: str, default=None):
         return current_registry().get(name, default)
+
+    def observe(self, name: str, value):
+        current_registry().observe(name, value)
+
+    def histogram(self, name: str):
+        return current_registry().histogram(name)
+
+    def histograms(self) -> dict:
+        return current_registry().histograms()
+
+    def summaries(self) -> dict:
+        return current_registry().summaries()
 
     def snapshot(self) -> dict:
         return current_registry().snapshot()

@@ -20,7 +20,11 @@ import json
 import os
 import sys
 
-SCHEMA_VERSION = 1
+#: v2 (ISSUE 9): adds the optional ``latency`` section — per-histogram
+#: ``{count, sum, p50, p90, p99, max}`` summaries from the latency
+#: histograms (observe/metrics.py) — and optional ``flight_dumps`` (paths
+#: of black boxes the flight recorder wrote during the run).
+SCHEMA_VERSION = 2
 
 
 def _device_stats():
@@ -58,9 +62,17 @@ _OPTIONAL = {
     "resource": dict,   # governor snapshot: pressure state, events
                         # (enospc/watermarks), budget rebalancing counters
                         # (utils/governor.py)
+    "latency": dict,    # histogram name -> {count,sum,p50,p90,p99,max}
+                        # (observe/metrics.py latency histograms; v2)
+    "flight_dumps": list,  # black-box paths the flight recorder wrote
+                           # during this run (observe/flight.py; v2)
     "trace_path": str,
     "hostname": str,
 }
+
+#: Required numeric fields of one ``latency`` summary entry, in the order
+#: the quantile-monotonicity check walks them.
+_LATENCY_FIELDS = ("count", "sum", "p50", "p90", "p99", "max")
 
 
 def validate_report(obj) -> list:
@@ -87,6 +99,21 @@ def validate_report(obj) -> list:
         for k in obj["metrics"]:
             if not isinstance(k, str) or not k:
                 errors.append(f"metrics key {k!r} is not a dotted name")
+    if isinstance(obj.get("latency"), dict):
+        for name, summ in obj["latency"].items():
+            if not isinstance(summ, dict):
+                errors.append(f"latency entry {name!r} is not an object")
+                continue
+            missing = [f for f in _LATENCY_FIELDS if not isinstance(
+                summ.get(f), (int, float)) or isinstance(summ.get(f), bool)]
+            if missing:
+                errors.append(f"latency entry {name!r} missing numeric "
+                              f"fields {missing}")
+                continue
+            if not (summ["p50"] <= summ["p90"] <= summ["p99"]
+                    <= summ["max"]):
+                errors.append(f"latency entry {name!r} quantiles are not "
+                              "ordered (p50 <= p90 <= p99 <= max)")
     return errors
 
 
@@ -188,6 +215,19 @@ def build_report(command: str, argv, started_unix: float, wall_s: float,
     gov = sys.modules.get("fgumi_tpu.utils.governor")
     if gov is not None and gov.GOVERNOR.has_activity():
         report["resource"] = gov.GOVERNOR.snapshot()
+    # latency histogram summaries (schema v2): every instrumented hot path
+    # that observed at least one sample this run — the "how slow was the
+    # tail" counterpart of the flat counters above
+    latency = METRICS.summaries()
+    if latency:
+        report["latency"] = latency
+    # black boxes written during this run (flight recorder): the report is
+    # the breadcrumb from "this run degraded" to the full evidence file
+    flight = sys.modules.get("fgumi_tpu.observe.flight")
+    if flight is not None:
+        dumps = flight.FLIGHT.dump_paths()
+        if dumps:
+            report["flight_dumps"] = dumps
     if trace_path:
         report["trace_path"] = trace_path
     return report

@@ -134,6 +134,11 @@ def publish_to_global(scope: TelemetryScope):
     from . import metrics as _metrics
 
     _metrics._GLOBAL_REGISTRY.replace(scope.metrics.snapshot())
+    # histograms MERGE instead of replacing: the global surface is the
+    # cumulative-since-process-start view (Prometheus semantics — the serve
+    # daemon's /metrics endpoint reads it), while each scope's run report
+    # still carries only its own distributions
+    _metrics._GLOBAL_REGISTRY.merge_histograms(scope.metrics.histograms())
     import sys
 
     kern = sys.modules.get("fgumi_tpu.ops.kernel")

@@ -130,6 +130,13 @@ class _Tracer:
         return tid
 
     def _complete(self, name, t0, t1, args, error=None):
+        # span ends also feed the always-on flight recorder's ring (the
+        # black box shows the last few hundred spans even when the trace
+        # buffer overflowed or was never exported)
+        from .flight import FLIGHT
+
+        FLIGHT.note("span", name=name, dur_ms=round((t1 - t0) * 1e3, 3),
+                    **({"error": error} if error else {}))
         ev = {"name": name, "ph": "X", "pid": os.getpid(),
               "ts": round((t0 - self.t_zero) * 1e6, 1),
               "dur": round((t1 - t0) * 1e6, 1)}
@@ -162,8 +169,18 @@ class _Tracer:
             return list(self._events)
 
     def to_json_obj(self):
-        obj = {"traceEvents": self.snapshot(),
-               "displayTimeUnit": "ms"}
+        events = self.snapshot()
+        if self.dropped:
+            # an explicit truncation marker INSIDE the timeline: a human in
+            # Perfetto sees where recording stopped instead of silently
+            # reading a gap as "nothing happened after this"
+            events.append({
+                "name": "trace.truncated", "ph": "i", "s": "g",
+                "pid": os.getpid(), "tid": 0,
+                "ts": round((time.monotonic() - self.t_zero) * 1e6, 1),
+                "args": {"dropped_events": self.dropped,
+                         "max_events": self.max_events}})
+        obj = {"traceEvents": events, "displayTimeUnit": "ms"}
         if self.dropped:
             obj["otherData"] = {"dropped_events": self.dropped}
         return obj
@@ -232,6 +249,12 @@ def write_trace(path: str, tracer=None):
     t = tracer if tracer is not None else _tracer
     if t is None:
         return
+    if t.dropped:
+        # overflow is an observability *defect* worth a counter: the run
+        # report says how much of the timeline is missing
+        from .metrics import METRICS
+
+        METRICS.inc("trace.dropped_events", t.dropped)
     from ..utils.atomic import discard_output, open_output
 
     out = open_output(path, "w")

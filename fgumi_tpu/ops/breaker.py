@@ -185,6 +185,12 @@ class DeviceBreaker:
         level = logging.WARNING if new == OPEN else logging.INFO
         log.log(level, "device breaker: %s -> %s (%s)", old, new, reason)
         self._stamp_metrics(new)
+        # flight-ring note only — the black-box dump happens outside this
+        # lock (the record_* callers), because dump() re-enters snapshot()
+        from ..observe.flight import FLIGHT
+
+        FLIGHT.note("breaker.transition", state=new, previous=old,
+                    reason=reason)
 
     @staticmethod
     def _stamp_metrics(state: str):
@@ -259,27 +265,44 @@ class DeviceBreaker:
             if self._score >= self._failure_threshold():
                 self._transition_locked(OPEN, reason)
 
+    def _dump_if_tripped(self, was: str):
+        """Black-box a closed/half-open -> open transition (flight
+        recorder). Called OUTSIDE the breaker lock: the dump re-enters
+        :meth:`snapshot`."""
+        with self._lock:
+            now = self._state
+        if now == OPEN and was != OPEN:
+            from ..observe.flight import FLIGHT
+
+            FLIGHT.dump("breaker-open")
+
     def record_deadline_overrun(self):
         """A dispatch blew its deadline and was abandoned: categorical
         wedge evidence — trips a closed breaker immediately."""
         with self._lock:
+            was = self._state
             self.deadline_overruns += 1
             self._failure_locked("dispatch deadline overrun",
                                  self._failure_threshold())
+        self._dump_if_tripped(was)
 
     def record_transient_failure(self):
         """A dispatch failed permanently (bounded retry exhausted, host
         fallback taken): one point toward the closed-state threshold."""
         with self._lock:
+            was = self._state
             self.transient_failures += 1
             self._failure_locked("repeated transient dispatch failures", 1)
+        self._dump_if_tripped(was)
 
     def record_canary_failure(self):
         """The health monitor's canary dispatch failed or timed out."""
         with self._lock:
+            was = self._state
             self.canary_failures += 1
             self._failure_locked("health canary failed",
                                  self._failure_threshold())
+        self._dump_if_tripped(was)
 
     # ----------------------------------------------------------- snapshot
 

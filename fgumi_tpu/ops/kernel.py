@@ -338,6 +338,10 @@ class DeviceStats:
         self.route_device = 0
         self.route_host = 0
         self.timeline = []  # per-dispatch dicts (capped; --stats report)
+        # stamps for dispatches past the timeline cap, alive only until
+        # resolve (begin_in_flight/end_in_flight; bounded)
+        self._tail_entries = {}
+        self._next_slot = 0
         self._t0 = time.monotonic()
 
     def add_retry(self):
@@ -355,6 +359,17 @@ class DeviceStats:
     def add_deadline_fallback(self):
         with self._lock:
             self.deadline_fallbacks += 1
+            n = self.deadline_fallbacks
+            dispatches = self.dispatches
+        # the wedge signature: note it in the always-on flight ring and —
+        # when a dump dir is configured — freeze a black box naming the
+        # still-unresolved dispatch(es). Outside the stats lock: the dump
+        # re-enters snapshot()/timeline_snapshot().
+        from ..observe.flight import FLIGHT
+
+        FLIGHT.note("device.deadline_fallback", count=n,
+                    dispatches=dispatches)
+        FLIGHT.dump("dispatch-deadline", deadline_fallbacks=n)
 
     def add_upload_overlap(self, dt: float):
         with self._lock:
@@ -392,50 +407,71 @@ class DeviceStats:
         with self._lock:
             self.in_flight += 1
             self.bytes_uploaded += int(upload_bytes)
-            slot = len(self.timeline)
-            if slot < 4096:
-                self.timeline.append(
-                    {"t_dispatch": round(time.monotonic() - self._t0, 4),
+            slot = self._next_slot
+            self._next_slot += 1
+            entry = {"t_dispatch": round(time.monotonic() - self._t0, 4),
                      "up_bytes": int(upload_bytes),
-                     "pack_s": round(pack_s, 4)})
+                     "pack_s": round(pack_s, 4)}
+            if slot < 4096:
+                self.timeline.append(entry)
+            elif len(self._tail_entries) < 1024:
+                # past the persistent-timeline cap, stamps live only until
+                # resolve (end_in_flight pops them) so latency histograms
+                # and router feedback keep working on arbitrarily long
+                # runs; the side map is bounded against abandon leaks
+                self._tail_entries[slot] = entry
             return slot
+
+    def _entry_locked(self, slot: int):
+        """The live entry for a slot — persistent timeline or tail map —
+        or None. Caller holds the lock."""
+        if 0 <= slot < len(self.timeline):
+            return self.timeline[slot]
+        return self._tail_entries.get(slot)
 
     def note_upload(self, slot: int, upload_s: float):
         """Record a dispatch's device_put wall time (feeder thread)."""
         with self._lock:
-            if 0 <= slot < len(self.timeline):
-                self.timeline[slot]["upload_s"] = round(upload_s, 4)
+            entry = self._entry_locked(slot)
+            if entry is not None:
+                entry["upload_s"] = round(upload_s, 4)
 
     def note_exec(self, slot: int):
         """Stamp upload+enqueue completion: the window from here to fetch
         start is device compute overlapped with host work."""
         with self._lock:
-            if 0 <= slot < len(self.timeline):
-                self.timeline[slot]["t_exec"] = round(
-                    time.monotonic() - self._t0, 4)
+            entry = self._entry_locked(slot)
+            if entry is not None:
+                entry["t_exec"] = round(time.monotonic() - self._t0, 4)
 
     def note_pred(self, slot: int, pred_s: float):
         """Stamp the cost model's predicted dispatch time (ops/router.py)
         so BENCH artifacts carry predicted vs actual per dispatch."""
         with self._lock:
-            if 0 <= slot < len(self.timeline):
-                self.timeline[slot]["pred_s"] = round(pred_s, 4)
+            entry = self._entry_locked(slot)
+            if entry is not None:
+                entry["pred_s"] = round(pred_s, 4)
 
     def timeline_entry(self, slot: int):
         """Copy of one timeline slot (router feedback at resolve time)."""
         with self._lock:
-            if 0 <= slot < len(self.timeline):
-                return dict(self.timeline[slot])
-        return None
+            entry = self._entry_locked(slot)
+            return dict(entry) if entry is not None else None
 
     def end_in_flight(self, slot: int, fetched_bytes: int, wait_s: float):
+        entry = None
         with self._lock:
             self.in_flight -= 1
-            if 0 <= slot < len(self.timeline):
-                self.timeline[slot].update(
+            live = self._entry_locked(slot)
+            if live is not None:
+                live.update(
                     t_fetched=round(time.monotonic() - self._t0, 4),
                     down_bytes=int(fetched_bytes),
                     fetch_wait_s=round(wait_s, 4))
+                entry = dict(live)
+                self._tail_entries.pop(slot, None)
+        if entry is not None:
+            _observe_dispatch_latency(entry)
 
     def in_flight_count(self) -> int:
         with self._lock:
@@ -507,9 +543,16 @@ class DeviceStats:
 
     def timeline_snapshot(self):
         """Per-dispatch device timeline for the --stats report (VERDICT r4
-        item 9): dispatch time, upload/fetch bytes, fetch wait each."""
+        item 9): dispatch time, upload/fetch bytes, fetch wait each.
+        Entries carry their ``slot``; past the persistent cap the live
+        (still-in-flight) tail-map entries are appended in slot order, so
+        a flight dump on a >4096-dispatch run still names the wedged
+        dispatch instead of showing only ancient history."""
         with self._lock:
-            return [dict(t) for t in self.timeline]
+            out = [dict(t, slot=i) for i, t in enumerate(self.timeline)]
+            out.extend(dict(self._tail_entries[s], slot=s)
+                       for s in sorted(self._tail_entries))
+            return out
 
     def load_from(self, other: "DeviceStats"):
         """Adopt another instance's counters wholesale (scope publishing:
@@ -523,12 +566,14 @@ class DeviceStats:
                 "deadline_fallbacks",
                 "upload_overlap_s", "feeder_queue_peak", "const_uploads",
                 "const_hits", "const_upload_bytes", "route_device",
-                "route_host", "_t0")}
+                "route_host", "_t0", "_next_slot")}
             timeline = [dict(t) for t in other.timeline]
+            tail = {s: dict(t) for s, t in other._tail_entries.items()}
         with self._lock:
             for k, v in state.items():
                 setattr(self, k, v)
             self.timeline = timeline
+            self._tail_entries = tail
 
     def format_summary(self, wall_s: float = None) -> str:
         s = self.snapshot()
@@ -549,6 +594,39 @@ class DeviceStats:
             parts.append(f"device fraction {self.fetch_wait_s / wall_s:.2%} "
                          f"of {wall_s:.2f}s wall")
         return "; ".join(parts)
+
+
+def _observe_dispatch_latency(entry: dict) -> None:
+    """Fold one resolved dispatch's timeline stamps into the latency
+    histograms (observe/metrics.py): per-dispatch pack/upload/compute/fetch
+    walls, the end-to-end dispatch wall, and the offload cost model's
+    predicted-vs-actual error. Called once per resolve, outside the
+    DeviceStats lock."""
+    from ..observe.metrics import METRICS
+
+    METRICS.observe("device.dispatch.pack_s", entry.get("pack_s", 0.0))
+    if "upload_s" in entry:
+        METRICS.observe("device.dispatch.upload_s", entry["upload_s"])
+    fetch_s = entry.get("fetch_wait_s", 0.0)
+    METRICS.observe("device.dispatch.fetch_s", fetch_s)
+    t_fetched = entry.get("t_fetched")
+    if t_fetched is not None and "t_exec" in entry:
+        METRICS.observe("device.dispatch.compute_s",
+                        max(t_fetched - fetch_s - entry["t_exec"], 0.0))
+    if t_fetched is not None and "t_dispatch" in entry:
+        wall = max(t_fetched - entry["t_dispatch"], 0.0)
+        METRICS.observe("device.dispatch.wall_s", wall)
+        pred = entry.get("pred_s")
+        if pred is not None:
+            METRICS.observe("device.router.pred_err_s", abs(wall - pred))
+        # always-on dispatch history for the flight ring: a black box from
+        # a run without --trace still shows the recent device activity
+        # leading up to the failure (one note per dispatch, not per record)
+        from ..observe.flight import FLIGHT
+
+        FLIGHT.note("device.dispatch", wall_s=round(wall, 4),
+                    up_bytes=entry.get("up_bytes", 0),
+                    down_bytes=entry.get("down_bytes", 0))
 
 
 #: Fallback instance used when no telemetry scope is active (library use,
@@ -790,6 +868,11 @@ class DeviceFeeder:
         with self._cv:
             ticket._abandoned = True
             completed = ticket._event.is_set()
+        from ..observe.flight import FLIGHT
+
+        FLIGHT.note("device.feeder.abandon", slot=ticket.slot,
+                    upload_bytes=ticket.upload_bytes,
+                    completed_late=completed)
         if completed:
             # raced the completion: the result exists but the caller is
             # not going to fetch it — reclaim the slot here

@@ -225,8 +225,12 @@ class ChainChannel:
             self.n_blobs += 1
             self.total_bytes += n
             self.peak_bytes = max(self.peak_bytes, self._bytes)
-            self.put_wait_s += time.monotonic() - t0
+            wait = time.monotonic() - t0
+            self.put_wait_s += wait
             self._cv.notify_all()
+        from .observe.metrics import METRICS
+
+        METRICS.observe("pipeline.chain.put_wait_s", wait)
 
     def close(self) -> None:
         """Producer EOF: the consumer drains remaining blobs, then sees end
@@ -275,6 +279,8 @@ class ChainChannel:
 
     def get(self):
         """Next blob, or None at end of stream."""
+        from .observe.metrics import METRICS
+
         t0 = time.monotonic()
         with self._cv:
             while True:
@@ -285,13 +291,18 @@ class ChainChannel:
                 if self._blobs:
                     blob = self._blobs.popleft()
                     self._bytes -= len(blob)
-                    self.get_wait_s += time.monotonic() - t0
+                    wait = time.monotonic() - t0
+                    self.get_wait_s += wait
                     self._cv.notify_all()
-                    return blob
+                    break
                 if self._closed:
                     self.get_wait_s += time.monotonic() - t0
                     return None
                 self._cv.wait(0.1)
+        # observe outside the channel lock (same discipline as put): the
+        # registry lock must not extend this CV's critical section
+        METRICS.observe("pipeline.chain.get_wait_s", wait)
+        return blob
 
     def cancel(self) -> None:
         """Consumer-side failure / early exit: every blocked or future

@@ -109,6 +109,14 @@ class ServeClient:
     def ping(self) -> dict:
         return self._checked({"v": protocol.PROTOCOL_VERSION, "op": "ping"})
 
+    def stats(self) -> dict:
+        """Live introspection snapshot (scheduler/quota/journal/breaker/
+        governor/device + latency histogram summaries). A daemon predating
+        the op answers ``unknown op 'stats'`` — surfaced verbatim as
+        ServeError, the documented clean rejection."""
+        return self._checked({"v": protocol.PROTOCOL_VERSION,
+                              "op": "stats"})["stats"]
+
     def submit(self, argv, priority: str = protocol.DEFAULT_PRIORITY,
                argv0: str = None, tag: str = None, trace: bool = False,
                dedupe: str = None, client: str = None) -> dict:

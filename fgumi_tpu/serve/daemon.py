@@ -72,7 +72,8 @@ class JobService:
                  queue_limit: int = 8, report_dir: str = None,
                  max_frame_bytes: int = protocol.MAX_FRAME_BYTES,
                  keep_finished: int = 1000, journal_path: str = None,
-                 health_period_s: float = 0.0, max_per_client: int = 0):
+                 health_period_s: float = 0.0, max_per_client: int = 0,
+                 metrics_port: int = None):
         self.socket_path = socket_path
         self.max_frame_bytes = max_frame_bytes
         self.report_dir = report_dir
@@ -86,6 +87,13 @@ class JobService:
         self.journal = None
         self.health_period_s = float(health_period_s or 0.0)
         self._monitor = None
+        #: optional loopback HTTP listener (serve --metrics-port): /metrics
+        #: Prometheus scrape + /healthz, fed by the same snapshot builder
+        #: as the `stats` op (serve/introspect.py). None = disabled.
+        self.metrics_port = metrics_port
+        self._introspection = None
+        #: journal replay accounting for the `stats` op (recover() fills it)
+        self.journal_stats = {}
         self._dedupe = {}          # dedupe key -> job id (journal-durable)
         self._dedupe_lock = threading.Lock()
         self._recovered = False
@@ -242,6 +250,8 @@ class JobService:
         METRICS.inc("serve.journal.requeued", requeued)
         if rep.truncated_bytes:
             METRICS.inc("serve.journal.truncated_bytes", rep.truncated_bytes)
+        self.journal_stats = {"replayed": rep.records, "requeued": requeued,
+                              "truncated_bytes": rep.truncated_bytes}
 
     def _sweep_report_temps(self, before_unix):
         """Remove dead-pid atomic-output temps from the report dir.
@@ -313,12 +323,20 @@ class JobService:
         return sock
 
     def bind(self):
-        """Claim the socket WITHOUT starting to serve. Raises SocketBusy.
+        """Claim the socket AND the metrics port WITHOUT starting to
+        serve. Raises SocketBusy / OSError.
 
-        Split from :meth:`start` so the CLI can fail fast on a busy socket
-        *before* paying (and disturbing) the single-tenant device warm-up."""
+        Split from :meth:`start` so the CLI can fail fast on a busy
+        socket or metrics port *before* paying (and disturbing) the
+        single-tenant device warm-up."""
         if self._sock is None:
             self._sock = self._claim_socket()
+        if self.metrics_port is not None and self._introspection is None:
+            from .introspect import IntrospectionServer
+
+            self._introspection = IntrospectionServer(self,
+                                                      self.metrics_port)
+            self._introspection.bind()  # EADDRINUSE surfaces here
 
     def start(self):
         """Bind (if not already), recover, start workers and the accept
@@ -333,6 +351,8 @@ class JobService:
             self._monitor = HealthMonitor(BREAKER,
                                           period_s=self.health_period_s)
             self._monitor.start()
+        if self._introspection is not None:
+            self._introspection.start()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, name="fgumi-serve-accept", daemon=True)
         self._accept_thread.start()
@@ -409,6 +429,13 @@ class JobService:
                 uptime_s=round(time.time() - self.started_unix, 1),
                 jobs=self.registry.counts(), **self.scheduler.depth(),
                 **extra)
+        if op == "stats":
+            # live introspection: scheduler/quota/journal/breaker/governor/
+            # device snapshots + latency histogram summaries — the same
+            # builder feeds /metrics, so the two surfaces cannot disagree
+            from .introspect import service_stats
+
+            return protocol.ok_response(stats=service_stats(self))
         if op == "submit":
             dedupe = req.get("dedupe")
             with self._dedupe_lock:
@@ -517,6 +544,8 @@ class JobService:
         self._shutdown.set()
         if self._monitor is not None:
             self._monitor.stop()
+        if self._introspection is not None:
+            self._introspection.stop()
         if self.journal is not None:
             self.journal.close()
         if self._sock is not None:

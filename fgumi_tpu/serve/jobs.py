@@ -174,6 +174,12 @@ class JobRegistry:
             job.state = new_state
 
     def _notify(self, job: Job):
+        # every job transition lands in the flight recorder's always-on
+        # ring: a daemon black box shows what the scheduler was doing
+        from ..observe.flight import FLIGHT
+
+        FLIGHT.note("serve.job", id=job.id, state=job.state,
+                    **({"error": str(job.error)[:200]} if job.error else {}))
         cb = self.on_transition
         if cb is not None:
             try:
@@ -184,9 +190,34 @@ class JobRegistry:
                 logging.getLogger("fgumi_tpu").exception(
                     "job transition hook failed for %s", job.id)
 
+    @staticmethod
+    def _observe_latency(job: Job):
+        """Fold one job's lifecycle walls into the latency histograms.
+
+        Runs on the scheduler worker thread OUTSIDE any job telemetry
+        scope, so the observations land in the process-global registry —
+        the daemon-lifetime view the ``stats`` op and ``/metrics`` expose.
+        queued→running is observed at start; running→terminal and
+        submit→terminal at finish."""
+        from ..observe.metrics import METRICS
+
+        if job.state == "running":
+            if job.started_unix and job.submitted_unix:
+                METRICS.observe("serve.job.queue_wait_s",
+                                job.started_unix - job.submitted_unix)
+            return
+        if job.state in ("done", "failed") and job.finished_unix:
+            if job.started_unix:
+                METRICS.observe("serve.job.run_s",
+                                job.finished_unix - job.started_unix)
+            if job.submitted_unix:
+                METRICS.observe("serve.job.total_s",
+                                job.finished_unix - job.submitted_unix)
+
     def mark_running(self, job: Job):
         self._transition(job, "running")
         job.started_unix = time.time()
+        self._observe_latency(job)
         self._notify(job)
 
     def mark_done(self, job: Job, exit_status: int):
@@ -198,6 +229,7 @@ class JobRegistry:
             self._transition(job, "failed")
         job.finished_unix = time.time()
         self._note_terminal(job)
+        self._observe_latency(job)
         self._notify(job)
 
     def mark_failed(self, job: Job, error: str):
@@ -206,6 +238,7 @@ class JobRegistry:
         self._transition(job, "failed")
         job.finished_unix = time.time()
         self._note_terminal(job)
+        self._observe_latency(job)
         self._notify(job)
 
     def mark_cancelled(self, job: Job):

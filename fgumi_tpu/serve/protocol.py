@@ -19,6 +19,12 @@ Requests::
     {"v": 1, "op": "drain"}            # stop admitting, keep serving status
     {"v": 1, "op": "shutdown"}         # drain, finish queued+running, exit
     {"v": 1, "op": "ping"}             # daemon liveness + config echo
+    {"v": 1, "op": "stats"}            # live introspection snapshot
+                                       # (scheduler/quota/journal/breaker/
+                                       # governor/device + latency
+                                       # histogram summaries; daemons
+                                       # predating the op reject it cleanly
+                                       # with "unknown op 'stats'")
 
 Responses are ``{"v": 1, "ok": true, ...}`` or
 ``{"v": 1, "ok": false, "error": "<reason>"}``. Submit acceptance returns
@@ -44,7 +50,8 @@ PROTOCOL_VERSION = 1
 #: daemon's memory. Override with serve --max-frame-bytes.
 MAX_FRAME_BYTES = 1 << 20
 
-OPS = frozenset({"submit", "status", "cancel", "drain", "shutdown", "ping"})
+OPS = frozenset({"submit", "status", "cancel", "drain", "shutdown", "ping",
+                 "stats"})
 
 #: Priority classes, best-first. FIFO within a class.
 PRIORITIES = ("high", "normal", "low")

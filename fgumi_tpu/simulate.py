@@ -337,6 +337,32 @@ def simulate_grouped_bam(path: str, num_families: int = 100, family_size: int = 
              "@RG\tID:A\tSM:sample\tLB:lib\n",
         ref_names=[ref_name], ref_lengths=[ref_length],
     )
+    from .utils.progress import ProgressTracker
+
+    # a fixed family-size distribution means the record total is known
+    # upfront — exactly what the heartbeat's ETA column wants
+    expected = num_families * family_size * (2 if paired else 1) \
+        if family_size_distribution == "fixed" else None
+    progress = ProgressTracker("simulate", total=expected)
+    try:
+        n_written = _write_grouped_records(
+            path, header, rng, num_families, family_size,
+            family_size_distribution, paired, read_length,
+            read_length_jitter, insert_size_mean, insert_size_sd,
+            ref_length, error_rate, base_quality, qual_jitter, qual_slope,
+            progress)
+    finally:
+        # finish() in a finally: the tracker registered a process-global
+        # heartbeat gauge + goal, which must not outlive a failed run
+        progress.finish()
+    return n_written
+
+
+def _write_grouped_records(path, header, rng, num_families, family_size,
+                           family_size_distribution, paired, read_length,
+                           read_length_jitter, insert_size_mean,
+                           insert_size_sd, ref_length, error_rate,
+                           base_quality, qual_jitter, qual_slope, progress):
     n_written = 0
     with BamWriter(path, header) as w:
         for fam in range(num_families):
@@ -402,6 +428,7 @@ def simulate_grouped_bam(path: str, num_families: int = 100, family_size: int = 
                     w.write_record_bytes(rec1)
                     w.write_record_bytes(rec2)
                     n_written += 2
+                    progress.add(2)
                 else:
                     rec = _build_mapped_record(
                         name, 0, 0, start, 60, cigar, mutate(truth_r1), quals,
@@ -409,6 +436,7 @@ def simulate_grouped_bam(path: str, num_families: int = 100, family_size: int = 
                         [(b"RG", "Z", b"A"), (b"MI", "Z", mi.encode())])
                     w.write_record_bytes(rec)
                     n_written += 1
+                    progress.add(1)
     return n_written
 
 

@@ -20,6 +20,7 @@ import os
 import re
 import struct
 import tempfile
+import time
 import zlib
 
 from ..core.overlap import parse_soft_clips_and_ref_len
@@ -227,10 +228,15 @@ class _SpillRun:
     @staticmethod
     def _decode_frame(payload, usize):
         from ..native import zlib_decompress
+        from ..observe.metrics import METRICS
 
+        t0 = time.monotonic()
         frame = zlib_decompress(payload, usize)
         if frame is None:
             frame = zlib.decompress(payload)
+        # phase-2 merge frame decode latency: the tail of this histogram is
+        # what the merge heap stalls on when the prefetch pool falls behind
+        METRICS.observe("sort.merge_frame_s", time.monotonic() - t0)
         return frame
 
     def frames(self, executor=None):
@@ -339,6 +345,7 @@ class ExternalSorter:
             self._disk_token = GOVERNOR.watch_path("spill", self._tmp_dir)
         METRICS.inc("sort.spills")
         METRICS.inc("sort.spill_records", len(self._chunk))
+        t0 = time.monotonic()
         with span("sort.spill", records=len(self._chunk)):
             self._chunk.sort()
             try:
@@ -354,6 +361,7 @@ class ExternalSorter:
                 # (ResourceExhausted -> exit 4, temps swept by close())
                 reraise_enospc(e, "sort.spill", path=self._tmp_dir)
                 raise
+        METRICS.observe("sort.spill_s", time.monotonic() - t0)
         self._chunk = []
         self._chunk_bytes = 0
 
@@ -612,13 +620,16 @@ class NativeExternalSorter:
         n = len(spans[1])
         METRICS.inc("sort.spills")
         METRICS.inc("sort.spill_records", n)
+        t0 = time.monotonic()
         with span("sort.spill", records=n):
             try:
                 faults.fire("sort.spill")
-                return self._build_run_traced(path, keys_b, recs_b, spans, n)
+                out = self._build_run_traced(path, keys_b, recs_b, spans, n)
             except OSError as e:
                 reraise_enospc(e, "sort.spill", path=self._tmp_dir)
                 raise
+        METRICS.observe("sort.spill_s", time.monotonic() - t0)
+        return out
 
     def _build_run_traced(self, path, keys_b, recs_b, spans, n):
         np = self._np

@@ -167,24 +167,38 @@ class DynamicBudget:
         sub = getattr(stop, "subscribe", None)
         t0 = time.monotonic()
         waited = False
-        with self._cv:
-            if sub is not None:
-                sub(self._cv)
-            try:
-                while self.used > 0 and self.used + n > self.limit:
-                    if stop is not None and stop.is_set():
-                        return False
-                    GOVERNOR.check_hard()
-                    waited = True
-                    self._cv.wait(None if sub is not None else 0.1)
-            finally:
+        observe_dt = None
+        try:
+            with self._cv:
                 if sub is not None:
-                    stop.unsubscribe(self._cv)
-                if waited:
-                    self.wait_s += time.monotonic() - t0
-            self.used += n
-            self.peak = max(self.peak, self.used)
-            return True
+                    sub(self._cv)
+                try:
+                    while self.used > 0 and self.used + n > self.limit:
+                        if stop is not None and stop.is_set():
+                            return False
+                        GOVERNOR.check_hard()
+                        waited = True
+                        self._cv.wait(None if sub is not None else 0.1)
+                finally:
+                    if sub is not None:
+                        stop.unsubscribe(self._cv)
+                    if waited:
+                        dt = time.monotonic() - t0
+                        self.wait_s += dt
+                        observe_dt = dt
+                self.used += n
+                self.peak = max(self.peak, self.used)
+                return True
+        finally:
+            if observe_dt is not None:
+                # blocking acquires feed the budget-wait latency histogram
+                # (per wait, not cumulative — the run report's p99 answers
+                # "how long do producers stall"). Observed OUTSIDE the
+                # budget CV: the registry lock must not extend this
+                # critical section (same discipline as ChainChannel)
+                from ..observe.metrics import METRICS
+
+                METRICS.observe("governor.budget.wait_s", observe_dt)
 
     def release(self, n: int):
         if self.limit <= 0:
@@ -488,6 +502,11 @@ class ResourceGovernor:
         with self._lock:
             self._events.append(ev)
             del self._events[:-_MAX_EVENTS]
+        # resource events (pressure transitions, ENOSPC conversions) are
+        # exactly the state changes a post-mortem black box needs
+        from ..observe.flight import FLIGHT
+
+        FLIGHT.note("governor." + kind, **info)
 
     # ---------------------------------------------------------------- pressure
 

@@ -13,12 +13,31 @@ log = logging.getLogger("fgumi_tpu")
 
 
 class ProgressTracker:
-    def __init__(self, label: str, every: int = 1_000_000):
+    def __init__(self, label: str, every: int = 1_000_000,
+                 total: int = None):
         self.label = label
         self.every = every
         self.count = 0
+        self.total = total
         self._next = every
         self._t0 = time.monotonic()
+        self._hb_token = None
+        if total:
+            # a known workload size arms the heartbeat's ETA column: the
+            # goal plus a live record gauge lets the beat print
+            # `rate=N/s eta=Ms` even for commands outside run_stages.
+            # First tracker wins — a concurrent goal holder (another
+            # daemon job) means no ETA here, not a clobbered one. The
+            # gauge token rides the goal so the ETA is computed against
+            # THIS tracker's counter, never a neighbour's
+            from ..observe import heartbeat
+
+            token = heartbeat.register_gauge(
+                lambda: {"records": self.count})
+            if heartbeat.set_goal(total, self, gauge_token=token):
+                self._hb_token = token
+            else:
+                heartbeat.unregister_gauge(token)
 
     def add(self, n: int = 1):
         self.count += n
@@ -37,6 +56,12 @@ class ProgressTracker:
         (long runs keep the info-level line). Totals also fold into the
         metrics registry so the run report carries records-processed counts.
         """
+        if self._hb_token is not None:
+            from ..observe import heartbeat
+
+            heartbeat.clear_goal(self)
+            heartbeat.unregister_gauge(self._hb_token)
+            self._hb_token = None
         if self.count <= 0:
             return
         dt = time.monotonic() - self._t0

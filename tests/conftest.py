@@ -48,3 +48,15 @@ def _reset_resource_governor():
     mod = sys.modules.get("fgumi_tpu.utils.governor")
     if mod is not None:
         mod.GOVERNOR.reset_for_tests()
+
+
+@pytest.fixture(autouse=True)
+def _reset_flight_recorder():
+    """The flight recorder (observe/flight.py) is process-global and
+    dedupes dumps per reason — a test that triggers a dump must not
+    swallow the next test's. Reset the explicit dump-dir override and the
+    dedupe state after each test; lazy like the fixtures above."""
+    yield
+    mod = sys.modules.get("fgumi_tpu.observe.flight")
+    if mod is not None:
+        mod.FLIGHT.reset()

@@ -1,0 +1,252 @@
+"""Flight-recorder tests: ring bounds, black-box dump triggers (including
+an injected device.wedge deadline overrun), dump schema validation, and the
+no-dump-on-clean-exit contract."""
+
+import json
+import logging
+import os
+import time
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.observe import flight
+from fgumi_tpu.observe.flight import (FLIGHT, MAX_DUMPS, FlightRecorder,
+                                      validate_dump)
+
+# ---------------------------------------------------------------------------
+# ring behavior
+
+
+def test_ring_is_bounded_and_keeps_newest():
+    rec = FlightRecorder(capacity=16)
+    for i in range(100):
+        rec.note("tick", i=i)
+    events = rec.events()
+    assert len(events) == 16
+    assert [e["i"] for e in events] == list(range(84, 100))
+    assert rec.events_noted == 100
+
+
+def test_note_carries_time_kind_thread_and_attrs():
+    rec = FlightRecorder(capacity=16)
+    rec.note("custom", detail="x", n=3)
+    (ev,) = rec.events()
+    assert ev["kind"] == "custom"
+    assert ev["detail"] == "x" and ev["n"] == 3
+    assert isinstance(ev["t"], float) and ev["t"] >= 0
+    assert ev["thread"]
+
+
+def test_warning_logs_land_in_the_ring():
+    from fgumi_tpu.observe.logs import setup_logging
+
+    setup_logging()  # installs the WARNING+ flight handler
+    before = len([e for e in FLIGHT.events() if e["kind"] == "log"])
+    logging.getLogger("fgumi_tpu").warning("flight-ring probe %d", 42)
+    logs = [e for e in FLIGHT.events() if e["kind"] == "log"]
+    assert len(logs) > before
+    assert any("flight-ring probe 42" in e["msg"] for e in logs)
+    assert logs[-1]["level"] in ("WARNING", "ERROR")
+
+
+# ---------------------------------------------------------------------------
+# dumping
+
+
+def test_dump_without_destination_is_none(monkeypatch):
+    monkeypatch.delenv("FGUMI_TPU_FLIGHT", raising=False)
+    rec = FlightRecorder(capacity=16)
+    assert rec.dump("nowhere") is None
+    assert rec.dump_paths() == []
+
+
+def test_dump_writes_schema_valid_black_box(tmp_path):
+    rec = FlightRecorder(capacity=16)
+    rec.configure(str(tmp_path))
+    rec.note("before-crash", step=7)
+    try:
+        raise RuntimeError("boom")
+    except RuntimeError as e:
+        path = rec.dump("unit-crash", exc=e, extra="ctx")
+    assert path is not None and os.path.exists(path)
+    obj = json.load(open(path))
+    assert validate_dump(obj) == []
+    assert obj["reason"] == "unit-crash"
+    assert obj["attrs"] == {"extra": "ctx"}
+    assert obj["exception"]["type"] == "RuntimeError"
+    assert any(e["kind"] == "before-crash" for e in obj["events"])
+    # every live thread contributed a stack, this one included
+    assert any(stack for stack in obj["threads"].values())
+    assert "metrics" in obj and "latency" in obj["metrics"]
+    # no temp residue from the atomic commit
+    assert all(".tmp." not in n for n in os.listdir(tmp_path))
+
+
+def test_dump_dedupes_per_reason_and_caps_total(tmp_path):
+    rec = FlightRecorder(capacity=16)
+    rec.configure(str(tmp_path))
+    assert rec.dump("same") is not None
+    assert rec.dump("same") is None  # first dump per reason wins
+    for i in range(MAX_DUMPS + 4):
+        rec.dump(f"r{i}")
+    assert len(rec.dump_paths()) <= MAX_DUMPS
+    assert len(os.listdir(tmp_path)) <= MAX_DUMPS
+
+
+def test_validate_dump_flags_problems():
+    assert validate_dump([]) == ["flight dump is not a JSON object"]
+    errs = validate_dump({"schema_version": "1"})
+    assert any("missing required field" in e for e in errs)
+    good = {"schema_version": flight.SCHEMA_VERSION, "tool": "fgumi-tpu",
+            "reason": "x", "unix": 1.0, "pid": 1, "argv": [],
+            "events": [{"kind": "k", "t": 0.0}], "threads": {"m": []}}
+    assert validate_dump(good) == []
+    bad = dict(good, events=[{"nope": 1}])
+    assert any("malformed ring event" in e for e in validate_dump(bad))
+
+
+# ---------------------------------------------------------------------------
+# trigger: breaker trip
+
+
+def test_breaker_trip_dumps_black_box(tmp_path, monkeypatch):
+    from fgumi_tpu.ops import breaker as breaker_mod
+
+    monkeypatch.delenv("FGUMI_TPU_BREAKER", raising=False)
+    FLIGHT.reset()
+    FLIGHT.configure(str(tmp_path))
+    breaker_mod.BREAKER.reset()
+    breaker_mod.BREAKER.record_deadline_overrun()  # categorical: trips now
+    assert breaker_mod.BREAKER.state == "open"
+    dumps = [n for n in os.listdir(tmp_path) if "breaker-open" in n]
+    assert len(dumps) == 1
+    obj = json.load(open(tmp_path / dumps[0]))
+    assert validate_dump(obj) == []
+    assert obj["breaker"]["state"] == "open"
+    # the ring recorded the transition itself
+    assert any(e["kind"] == "breaker.transition" and e["state"] == "open"
+               for e in obj["events"])
+
+
+# ---------------------------------------------------------------------------
+# trigger: resource exhaustion via the CLI exit-code path
+
+
+def test_resource_exhausted_dumps_black_box(tmp_path, monkeypatch):
+    from fgumi_tpu.cli import _run_command
+    from fgumi_tpu.utils.governor import ResourceExhausted
+
+    FLIGHT.reset()
+    FLIGHT.configure(str(tmp_path))
+
+    class _Args:
+        @staticmethod
+        def func(args):
+            raise ResourceExhausted("disk full: injected", kind="test")
+
+    assert _run_command(_Args) == 4
+    dumps = [n for n in os.listdir(tmp_path) if "resource-exhausted" in n]
+    assert len(dumps) == 1
+    obj = json.load(open(tmp_path / dumps[0]))
+    assert validate_dump(obj) == []
+    assert obj["exception"]["type"] == "ResourceExhausted"
+
+
+# ---------------------------------------------------------------------------
+# trigger: injected device.wedge -> deadline overrun (e2e on CPU jax)
+
+
+@pytest.fixture
+def kernel(monkeypatch):
+    from fgumi_tpu.native import batch as nb
+
+    if not nb.available():
+        pytest.skip("native engine unavailable")
+    monkeypatch.setenv("FGUMI_TPU_HOST_ENGINE", "0")
+    from fgumi_tpu.ops.kernel import ConsensusKernel
+    from fgumi_tpu.ops.tables import quality_tables
+
+    return ConsensusKernel(quality_tables(45, 40))
+
+
+def test_device_wedge_leaves_black_box(kernel, tmp_path, monkeypatch):
+    """The chaos signature ISSUE 9 exists for: a wedged dispatch is
+    abandoned at its deadline AND leaves a schema-valid black box naming
+    the degradation (deadline_fallbacks + the device timeline tail),
+    instead of a bare timeout."""
+    from fgumi_tpu.ops.kernel import DEVICE_STATS, pad_segments
+    from fgumi_tpu.utils import faults
+
+    rng = np.random.default_rng(0)
+    families, reads, length = 8, 3, 8
+    counts = np.full(families, reads)
+    codes = rng.integers(0, 4, size=(families * reads, length),
+                         dtype=np.uint8)
+    quals = rng.integers(5, 40, size=(families * reads, length),
+                         dtype=np.uint8)
+    starts = np.concatenate(([0], np.cumsum(counts)))
+
+    def dispatch_resolve():
+        cd, qd, seg, _st, fpad = pad_segments(codes, quals, counts)
+        ticket = kernel.device_call_segments_wire(cd, qd, seg, fpad,
+                                                  len(counts), full=True)
+        return kernel.resolve_segments_wire(ticket, codes, quals, starts)
+
+    ref = dispatch_resolve()  # warm compile outside the wedge window
+    FLIGHT.reset()
+    FLIGHT.configure(str(tmp_path))
+    monkeypatch.setenv("FGUMI_TPU_DISPATCH_DEADLINE_S", "0.2:0.4")
+    monkeypatch.setenv("FGUMI_TPU_FAULT_HANG_S", "1.5")
+    monkeypatch.setenv("FGUMI_TPU_FAULT", "device.wedge:hang:1.0:1")
+    faults.reset()
+    before = DEVICE_STATS.deadline_fallbacks
+    out = dispatch_resolve()  # wedged -> deadline -> host fallback
+    for a, b in zip(ref, out):
+        assert np.array_equal(a, b)  # degradation stays byte-identical
+    assert DEVICE_STATS.deadline_fallbacks == before + 1
+    dumps = [n for n in os.listdir(tmp_path) if "dispatch-deadline" in n]
+    assert len(dumps) == 1, os.listdir(tmp_path)
+    obj = json.load(open(tmp_path / dumps[0]))
+    assert validate_dump(obj) == []
+    assert obj["attrs"]["deadline_fallbacks"] >= 1
+    assert obj["device"]["snapshot"]["deadline_fallbacks"] >= 1
+    assert obj["device"]["timeline_tail"]  # the wedged dispatch is named
+    assert any(e["kind"] == "device.deadline_fallback"
+               for e in obj["events"])
+    time.sleep(1.6)  # let the injected hang clear before the next test
+    monkeypatch.delenv("FGUMI_TPU_FAULT")
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# clean exit writes nothing
+
+
+def test_no_dump_on_clean_cli_exit(tmp_path, monkeypatch):
+    from fgumi_tpu.cli import main as cli_main
+
+    dump_dir = tmp_path / "flight"
+    dump_dir.mkdir()
+    FLIGHT.reset()
+    monkeypatch.setenv("FGUMI_TPU_FLIGHT", str(dump_dir))
+    out = str(tmp_path / "sim.bam")
+    rc = cli_main(["simulate", "grouped-reads", "-o", out,
+                   "--num-families", "3", "--family-size", "2",
+                   "--seed", "3"])
+    assert rc == 0
+    assert list(dump_dir.iterdir()) == []  # the ring recorded; no file
+
+
+def test_run_report_carries_flight_dump_paths(tmp_path):
+    from fgumi_tpu.observe.metrics import METRICS
+    from fgumi_tpu.observe.report import build_report, validate_report
+
+    METRICS.reset()
+    FLIGHT.reset()
+    FLIGHT.configure(str(tmp_path))
+    path = FLIGHT.dump("report-breadcrumb")
+    report = build_report("sort", ["sort"], 0.0, 0.1, 1)
+    assert report["flight_dumps"] == [path]
+    assert validate_report(report) == []
+    METRICS.reset()

@@ -307,3 +307,206 @@ def test_run_stages_threaded_spans_attribute_to_stage_threads():
                  if e["ph"] == "X" and e["name"] == "pipeline.sink"}
     assert {metas[tid] for tid in read_tids} == {"fgumi-reader"}
     assert {metas[tid] for tid in sink_tids} == {"fgumi-writer"}
+
+
+# ---------------------------------------------------------------------------
+# latency histograms (ISSUE 9)
+
+
+def test_histogram_bucket_determinism():
+    from fgumi_tpu.observe.metrics import HIST_EDGES, Histogram
+
+    # the same value lands in the same bucket, every time, and boundaries
+    # are exact: a value equal to an edge belongs to that edge's bucket
+    for v in (1e-7, 1e-6, 0.00123, 0.5, 3.25, 1e7):
+        assert Histogram.bucket_index(v) == Histogram.bucket_index(v)
+    edge = HIST_EDGES[40]
+    assert Histogram.bucket_index(edge) == 40
+    assert Histogram.bucket_index(edge * 1.0001) == 41
+    # beyond either end clamps instead of raising
+    assert Histogram.bucket_index(0.0) == 0
+    assert Histogram.bucket_index(1e12) == len(HIST_EDGES) - 1
+
+
+def test_histogram_quantile_ordering_and_summary():
+    from fgumi_tpu.observe.metrics import Histogram
+
+    h = Histogram()
+    for v in (0.001, 0.002, 0.004, 0.008, 0.5):
+        for _ in range(5):
+            h.observe(v)
+    s = h.summary()
+    assert s["count"] == 25
+    assert s["p50"] <= s["p90"] <= s["p99"] <= s["max"]
+    assert s["max"] == 0.5
+    # a quantile is never below the true value's bucket lower edge nor
+    # above the observed max
+    assert 0.0005 < s["p50"] < 0.01
+    # negative and NaN observations are rejected, not binned
+    h.observe(-1.0)
+    h.observe(float("nan"))
+    assert h.count == 25
+
+
+def test_histogram_merge_sums_counts_and_keeps_max():
+    from fgumi_tpu.observe.metrics import Histogram
+
+    a, b = Histogram(), Histogram()
+    for v in (0.01, 0.02):
+        a.observe(v)
+    for v in (0.04, 8.0):
+        b.observe(v)
+    a.merge(b)
+    assert a.count == 4
+    assert a.max == 8.0
+    assert abs(a.total - 8.07) < 1e-9
+    assert a.buckets()[-1][1] == 4  # cumulative series ends at count
+
+
+def test_registry_observe_and_summaries():
+    m = MetricsRegistry()
+    m.observe("x.wait_s", 0.1)
+    m.observe("x.wait_s", 0.2)
+    m.observe("y.wait_s", 1.0)
+    summ = m.summaries()
+    assert list(summ) == ["x.wait_s", "y.wait_s"]  # name-sorted
+    assert summ["x.wait_s"]["count"] == 2
+    m.reset()
+    assert m.summaries() == {}
+
+
+def test_histogram_per_scope_isolation():
+    from fgumi_tpu.observe.scope import scoped_telemetry
+
+    with scoped_telemetry("job-a") as a:
+        METRICS.observe("iso.wait_s", 0.5)
+        with_inner = METRICS.summaries()
+    with scoped_telemetry("job-b"):
+        assert METRICS.histogram("iso.wait_s") is None
+    assert a.metrics.histogram("iso.wait_s").count == 1
+    assert "iso.wait_s" in with_inner
+
+
+def test_histogram_merge_on_scope_exit():
+    """publish_to_global MERGES scope histograms into the process-global
+    registry (cumulative daemon-lifetime view) while counters replace."""
+    from fgumi_tpu.observe import metrics as metrics_mod
+    from fgumi_tpu.observe.scope import publish_to_global, scoped_telemetry
+
+    metrics_mod._GLOBAL_REGISTRY.reset()
+    try:
+        for _ in range(2):
+            with scoped_telemetry("job") as scope:
+                METRICS.observe("merge.wait_s", 0.25)
+            publish_to_global(scope)
+        g = metrics_mod._GLOBAL_REGISTRY.histogram("merge.wait_s")
+        assert g is not None and g.count == 2  # merged, not replaced
+    finally:
+        metrics_mod._GLOBAL_REGISTRY.reset()
+
+
+def test_latency_section_in_report_and_validator():
+    from fgumi_tpu.observe.report import build_report, validate_report
+
+    METRICS.reset()
+    METRICS.observe("device.dispatch.wall_s", 0.125)
+    report = build_report("simplex", ["simplex"], 0.0, 1.0, 0)
+    try:
+        assert "latency" in report
+        entry = report["latency"]["device.dispatch.wall_s"]
+        assert entry["count"] == 1
+        assert validate_report(report) == []
+        # the validator rejects disordered quantiles
+        bad = dict(report)
+        bad["latency"] = {"x": {"count": 1, "sum": 1, "p50": 2.0,
+                                "p90": 1.0, "p99": 3.0, "max": 3.0}}
+        assert any("not ordered" in e for e in validate_report(bad))
+        bad["latency"] = {"x": {"count": 1}}
+        assert any("missing numeric" in e for e in validate_report(bad))
+    finally:
+        METRICS.reset()
+
+
+def test_trace_truncation_marker_and_metric(tmp_path):
+    """Satellite: overflow writes an explicit truncation marker into the
+    exported trace and counts trace.dropped_events in METRICS."""
+    METRICS.reset()
+    t = trace.start_trace(max_events=2)
+    for i in range(6):
+        with trace.span(f"s{i}"):
+            pass
+    out = tmp_path / "trunc.json"
+    trace.write_trace(str(out), t)
+    try:
+        obj = json.loads(out.read_text())
+        markers = [e for e in obj["traceEvents"]
+                   if e["name"] == "trace.truncated"]
+        assert len(markers) == 1
+        assert markers[0]["args"]["dropped_events"] == t.dropped > 0
+        assert METRICS.get("trace.dropped_events") == t.dropped
+    finally:
+        METRICS.reset()
+
+
+def test_heartbeat_rate_ewma_and_eta(caplog):
+    counter = {"n": 0}
+    token = hb.register_gauge(lambda: {"written": counter["n"]})
+    assert hb.set_goal(1000, "t-ewma")
+    try:
+        beat = hb.Heartbeat(0)
+        beat.beat()            # first beat: records baseline, no rate yet
+        counter["n"] = 500
+        import time as _time
+
+        _time.sleep(0.02)
+        with caplog.at_level(logging.INFO, logger="fgumi_tpu"):
+            beat.beat()
+        line = [r.message for r in caplog.records
+                if r.message.startswith("heartbeat:")][-1]
+        assert "rate=" in line and "eta=" in line
+        assert beat.rate_ewma > 0
+        assert beat.last_eta_s is not None
+        METRICS.reset()
+        beat.stop()
+        assert METRICS.get("heartbeat.records_per_s") > 0
+        assert METRICS.get("heartbeat.last_eta_s") is not None
+    finally:
+        hb.clear_goal("t-ewma")
+        hb.unregister_gauge(token)
+        METRICS.reset()
+
+
+def test_progress_tracker_total_arms_heartbeat_goal():
+    from fgumi_tpu.observe import heartbeat as hb_mod
+    from fgumi_tpu.utils.progress import ProgressTracker
+
+    p = ProgressTracker("goalcmd", every=10, total=100)
+    try:
+        assert hb_mod._goal_total() == 100
+        p.add(10)
+        states = hb_mod._gauge_states()
+        assert any(s.get("records") == 10 for _t, s in states)
+    finally:
+        p.finish()
+    assert hb_mod._goal_total() is None
+    METRICS.reset()
+
+
+def test_concurrent_goal_holders_do_not_clobber():
+    """Two live ProgressTrackers with totals (serve daemon workers): the
+    first claims the heartbeat goal, the second silently gets no ETA, and
+    the loser's finish() cannot clear the winner's goal."""
+    from fgumi_tpu.observe import heartbeat as hb_mod
+    from fgumi_tpu.utils.progress import ProgressTracker
+
+    a = ProgressTracker("job-a", total=100)
+    b = ProgressTracker("job-b", total=999)  # loses the race: no gauge/goal
+    try:
+        assert hb_mod._goal_total() == 100
+        assert b._hb_token is None
+        b.finish()  # non-holder clear is a no-op
+        assert hb_mod._goal_total() == 100
+    finally:
+        a.finish()
+    assert hb_mod._goal_total() is None
+    METRICS.reset()

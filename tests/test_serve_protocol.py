@@ -68,6 +68,13 @@ def service(tmp_path):
     svc.close()
 
 
+#: stats sections whose content depends on what the surrounding process
+#: has imported/measured (they normalize to null in the golden; their real
+#: content is covered by test_stats_op_live_sections below)
+_VOLATILE_STATS_SECTIONS = ("metrics", "latency", "device", "breaker",
+                            "governor", "router", "monitor")
+
+
 def _normalize(obj):
     """Zero the volatile fields the golden file cannot pin down."""
     if isinstance(obj, dict):
@@ -78,6 +85,8 @@ def _normalize(obj):
             elif k in ("uptime_s", "pid"):
                 out[k] = 0
             elif k in ("report_path", "trace_path"):
+                out[k] = None
+            elif k in _VOLATILE_STATS_SECTIONS and "schema_version" in obj:
                 out[k] = None
             else:
                 out[k] = _normalize(v)
@@ -204,3 +213,58 @@ def test_serve_dispatch_fault_fails_job_daemon_survives(tmp_path,
         svc.close()
         monkeypatch.delenv("FGUMI_TPU_FAULT")
         faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# live introspection: the `stats` op (ISSUE 9)
+
+
+def test_stats_op_live_sections(service):
+    """The golden pins the stable shape; this covers the live sections the
+    golden normalizes away — job-latency histograms observed on the
+    process-global registry, scheduler depth, quota state."""
+    from fgumi_tpu.observe import metrics as metrics_mod
+
+    reg = metrics_mod._GLOBAL_REGISTRY
+    reg.observe("serve.job.queue_wait_s", 0.125)
+    try:
+        resp = service.handle_request({"v": 1, "op": "stats"})
+        assert resp["ok"] is True
+        stats = resp["stats"]
+        assert stats["schema_version"] == 1
+        assert stats["scheduler"]["workers"] == 1
+        assert stats["quota"] == {} and stats["max_per_client"] == 0
+        lat = stats["latency"]["serve.job.queue_wait_s"]
+        assert lat["count"] >= 1
+        assert lat["p50"] <= lat["p90"] <= lat["p99"] <= lat["max"]
+    finally:
+        reg.reset()
+
+
+def test_stats_op_version_negotiated(service):
+    """A wrong-version stats request is rejected exactly like any other
+    op — and the error an OLD daemon gives a new client ('unknown op') is
+    pinned by the golden's unknown-op exchange, so the clean-rejection
+    contract holds in both directions."""
+    resp = service.handle_request({"v": 99, "op": "stats"})
+    assert resp["ok"] is False
+    assert "unsupported protocol version" in resp["error"]
+
+
+def test_job_latency_histograms_on_lifecycle(service):
+    """queued->running->done stamps queue-wait/run/total observations into
+    the process-global registry (the daemon-lifetime surface)."""
+    from fgumi_tpu.observe import metrics as metrics_mod
+
+    reg = metrics_mod._GLOBAL_REGISTRY
+    reg.reset()
+    try:
+        job = service.registry.create(["sort"], "normal")
+        service.registry.mark_running(job)
+        service.registry.mark_done(job, 0)
+        for name in ("serve.job.queue_wait_s", "serve.job.run_s",
+                     "serve.job.total_s"):
+            h = reg.histogram(name)
+            assert h is not None and h.count == 1, name
+    finally:
+        reg.reset()

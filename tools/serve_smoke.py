@@ -24,6 +24,13 @@ Scenarios (exit 0 when every check holds, one PASS/FAIL line each):
    and the output is byte-identical to the standalone run; an idempotent
    resubmit with the same dedupe key returns the finished job instead of
    running it twice.
+7. Live introspection (ISSUE 9): the ``stats`` protocol op and a
+   ``--metrics-port`` Prometheus ``/metrics`` scrape return CONSISTENT
+   live snapshots (job counts, histogram counts), the scrape parses as
+   text format 0.0.4, ``/healthz`` answers 200 on a healthy daemon, the
+   ``fgumi-tpu stats`` CLI verb round-trips the same payload, and job
+   outputs stay byte-identical to standalone (checks 1/4 above run on the
+   same daemon).
 
 Usage:  python tools/serve_smoke.py [--keep]
 """
@@ -98,6 +105,33 @@ def cache_entries(d):
     return sum(len(files) for _, _, files in os.walk(d))
 
 
+def free_port():
+    import socket as _socket
+
+    s = _socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def parse_prometheus(body):
+    """Minimal text-format 0.0.4 parser: {series_with_labels: float}.
+    Raises ValueError on any malformed sample line or duplicate series
+    (a real Prometheus server rejects the whole scrape on duplicates)."""
+    out = {}
+    for line in body.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.rpartition(" ")
+        if not name or not name[0].isalpha():
+            raise ValueError(f"malformed sample line: {line!r}")
+        if name in out:
+            raise ValueError(f"duplicate series: {name}")
+        out[name] = float(value)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--keep", action="store_true",
@@ -135,10 +169,12 @@ def main():
 
         # --- daemon up --------------------------------------------------
         sock = os.path.join(tmp, "serve.sock")
+        metrics_port = free_port()
         daemon = subprocess.Popen(
             [sys.executable, "-m", "fgumi_tpu", "serve", "--socket", sock,
              "--workers", "2", "--queue-limit", "0", "--report-dir", rpt,
-             "--compile-cache", cache],
+             "--compile-cache", cache, "--metrics-port",
+             str(metrics_port)],
             cwd=wd_srv, env=BASE_ENV, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
         ok &= check("daemon socket appears", wait_for_socket(sock))
@@ -208,6 +244,69 @@ def main():
         a = open(os.path.join(wd_std, "out1.bam"), "rb").read()
         b = open(os.path.join(wd_srv, "out1.bam"), "rb").read()
         ok &= check("warm rerun output still byte-identical", a == b)
+
+        # --- live introspection: stats op + /metrics + /healthz ---------
+        import urllib.request
+
+        stats = client.request({"v": 1, "op": "stats"})
+        ok &= check("stats op answers ok", stats.get("ok") is True)
+        stats = stats.get("stats", {})
+        ok &= check("stats carries scheduler/jobs/latency sections",
+                    stats.get("scheduler", {}).get("workers") == 2
+                    and "latency" in stats and "jobs" in stats)
+        done_jobs = stats.get("jobs", {}).get("done", 0)
+        ok &= check("stats counts the finished jobs", done_jobs >= 3,
+                    f"done={done_jobs}")
+        lat = stats.get("latency", {})
+        ok &= check("stats carries serve job latency histograms",
+                    lat.get("serve.job.run_s", {}).get("count", 0) >= 3
+                    and lat.get("serve.job.queue_wait_s", {})
+                    .get("count", 0) >= 3,
+                    f"latency keys={sorted(lat)[:8]}")
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics_port}/metrics",
+                timeout=10).read().decode()
+            series = parse_prometheus(body)
+            perr = None
+        except (OSError, ValueError) as e:
+            body, series, perr = "", {}, str(e)
+        ok &= check("/metrics parses as Prometheus text format",
+                    perr is None and bool(series),
+                    perr or f"{len(series)} series")
+        # the scrape and the stats op must agree on live state: job counts
+        # and histogram sample counts come from the same snapshot source
+        scraped_done = series.get('fgumi_tpu_serve_jobs{state="done"}')
+        ok &= check("/metrics agrees with stats (job counts)",
+                    scraped_done == stats.get("jobs", {}).get("done"),
+                    f"scrape={scraped_done} "
+                    f"stats={stats.get('jobs', {}).get('done')}")
+        hist_ok = all(
+            series.get(f"fgumi_tpu_{name.replace('.', '_')}_count")
+            == summ["count"] for name, summ in lat.items())
+        ok &= check("/metrics agrees with stats (histogram counts)",
+                    bool(lat) and hist_ok)
+        try:
+            resp = urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics_port}/healthz", timeout=10)
+            hz = json.loads(resp.read().decode())
+            hz_status = resp.status
+        except OSError as e:
+            hz, hz_status = {"error": str(e)}, 0
+        ok &= check("/healthz answers 200 ok on a healthy daemon",
+                    hz_status == 200 and hz.get("status") == "ok",
+                    f"{hz_status} {hz}")
+        # the CLI verb round-trips the same payload
+        p = run(["stats", "--socket", sock, "--section", "scheduler"],
+                cwd=tmp)
+        try:
+            verb = json.loads(p.stdout)
+        except ValueError:
+            verb = {}
+        ok &= check("fgumi-tpu stats verb round-trips",
+                    p.returncode == 0
+                    and verb.get("scheduler", {}).get("workers") == 2,
+                    p.stdout[:120])
 
         # --- SIGTERM drain ----------------------------------------------
         j4 = client.submit(job1, argv0=argv0)

@@ -7,11 +7,17 @@ Checks (exit 0 when every scenario holds, one PASS/FAIL line each):
 1. ``dedup --threads 4`` emits a well-formed Chrome trace-event JSON with
    complete events from >= 3 distinct threads (reader / processor / writer
    at minimum) including pipeline-stage spans, and a schema-valid run
-   report whose stage timings and record counts are non-zero.
+   report whose stage timings and record counts are non-zero — and whose
+   ``latency`` section (schema v2) carries ordered histogram summaries
+   for the BGZF hot path.
 2. ``simplex`` with the device kernel forced (FGUMI_TPU_HOST_ENGINE=0)
-   additionally records device-dispatch/fetch spans and non-zero
-   DeviceStats in the report.
-3. With both flags off, no trace/report artifacts appear.
+   additionally records device-dispatch/fetch spans, non-zero DeviceStats,
+   and per-dispatch latency histograms in the report.
+3. With both flags off, no trace/report/flight artifacts appear.
+4. Chaos wedge: an injected ``device.wedge`` hang under a tight dispatch
+   deadline exits 0 (host-engine degradation), and leaves a schema-valid
+   flight-recorder black box naming the wedged dispatch, with the dump
+   path carried in the run report's ``flight_dumps``.
 
 The in-pytest equivalents live in tests/test_observe.py and
 tests/test_run_report.py; this is the fast out-of-pytest gate, a sibling
@@ -125,6 +131,17 @@ def main():
             ok &= check("dedup report counts I/O bytes",
                         rpt.get("io", {}).get("bytes_read", 0) > 0
                         and rpt.get("io", {}).get("bytes_written", 0) > 0)
+            lat = rpt.get("latency", {})
+            ok &= check("dedup report carries BGZF latency histograms",
+                        lat.get("io.bgzf.decompress_s", {})
+                        .get("count", 0) > 0
+                        and lat.get("io.bgzf.compress_s", {})
+                        .get("count", 0) > 0,
+                        f"latency keys={sorted(lat)[:6]}")
+            ordered = all(
+                s["p50"] <= s["p90"] <= s["p99"] <= s["max"]
+                for s in lat.values())
+            ok &= check("dedup latency quantiles ordered", ordered)
 
         # 2) simplex on the device kernel: device spans + DeviceStats
         trace2 = os.path.join(tmp, "simplex.trace.json")
@@ -158,6 +175,14 @@ def main():
         if rpt and not errs:
             ok &= check("simplex report device dispatches non-zero",
                         rpt.get("device", {}).get("dispatches", 0) > 0)
+            lat = rpt.get("latency", {})
+            ok &= check("simplex report carries dispatch latency "
+                        "histograms",
+                        lat.get("device.dispatch.wall_s", {})
+                        .get("count", 0) > 0
+                        and lat.get("device.dispatch.fetch_s", {})
+                        .get("count", 0) > 0,
+                        f"latency keys={sorted(lat)[:8]}")
 
         # 3) flags off -> no artifacts
         off_dir = os.path.join(tmp, "off")
@@ -167,6 +192,62 @@ def main():
         residue = [f for f in os.listdir(off_dir) if f != "out.bam"]
         ok &= check("flags off -> no telemetry artifacts",
                     p.returncode == 0 and not residue, f"residue={residue}")
+
+        # 4) chaos wedge -> schema-valid black box + clean degradation
+        from fgumi_tpu.observe.flight import validate_dump
+
+        flight_dir = os.path.join(tmp, "flight")
+        os.mkdir(flight_dir)
+        rpt4 = os.path.join(tmp, "wedge.report.json")
+        # identical relative argv in two working dirs (the chaos knobs and
+        # the report travel via env), so @PG CL provenance bytes match and
+        # the degradation's byte-identity contract is actually testable
+        wd_ref = os.path.join(tmp, "wedge_ref")
+        wd_chaos = os.path.join(tmp, "wedge_chaos")
+        os.mkdir(wd_ref)
+        os.mkdir(wd_chaos)
+        argv4 = ["simplex", "-i", grouped, "-o", "wedge.bam",
+                 "--min-reads", "1"]
+        out4 = os.path.join(wd_chaos, "wedge.bam")
+        ref4 = os.path.join(wd_ref, "wedge.bam")
+        p = run(argv4, cwd=wd_ref)
+        assert p.returncode == 0, p.stderr
+        p = run(argv4, cwd=wd_chaos,
+                env={"FGUMI_TPU_HOST_ENGINE": "0",
+                     "FGUMI_TPU_ROUTE": "device",
+                     "FGUMI_TPU_FLIGHT": flight_dir,
+                     "FGUMI_TPU_RUN_REPORT": rpt4,
+                     "FGUMI_TPU_DISPATCH_DEADLINE_S": "0.5:1",
+                     "FGUMI_TPU_FAULT_HANG_S": "3",
+                     "FGUMI_TPU_FAULT": "device.wedge:hang:1.0:1"})
+        ok &= check("wedged run degrades cleanly (exit 0)",
+                    p.returncode == 0, f"rc={p.returncode}")
+        ok &= check("wedged run output byte-identical to clean run",
+                    os.path.exists(out4)
+                    and open(out4, "rb").read() == open(ref4, "rb").read())
+        dumps = sorted(f for f in os.listdir(flight_dir)
+                       if f.startswith("flight-"))
+        ok &= check("wedge leaves a flight-recorder black box",
+                    len(dumps) >= 1, f"dumps={dumps}")
+        if dumps:
+            obj = json.load(open(os.path.join(flight_dir, dumps[0])))
+            derrs = validate_dump(obj)
+            ok &= check("black box is schema-valid", not derrs,
+                        "; ".join(derrs[:3]))
+            ok &= check("black box names the wedged dispatch",
+                        obj.get("reason") == "dispatch-deadline"
+                        and obj.get("attrs", {})
+                        .get("deadline_fallbacks", 0) >= 1
+                        and bool((obj.get("device") or {})
+                                 .get("timeline_tail")))
+            try:
+                r4 = json.load(open(rpt4))
+            except (OSError, ValueError):
+                r4 = {}
+            ok &= check("run report carries the dump path",
+                        any(os.path.basename(d) in dumps
+                            for d in r4.get("flight_dumps", [])),
+                        str(r4.get("flight_dumps")))
     finally:
         if opts.keep:
             print("scratch kept at", tmp)

@@ -235,17 +235,36 @@ def _unmapped_consensus_header(read_group_id: str):
         ref_names=[], ref_lengths=[])
 
 
-def _build_dp_mesh(devices_arg):
+def _build_dp_mesh(devices_arg, mesh_spec=None):
     """A (dp, sp) mesh over the requested device count, or None (<=1 device).
 
-    "auto" uses every visible device; sharding is transparent — single-device
-    output is byte-identical (tests/test_mesh.py, test_cli_fast_parity.py).
-    FGUMI_TPU_SP=<k> splits the read axis over k of the devices (sequence
-    parallelism for deep families; dp = n // k), default 1 (dp-only).
+    Shape resolution, most specific wins (docs/multi-chip.md):
+
+    1. ``--mesh`` / ``FGUMI_TPU_MESH``: ``dpNxspM`` forces an exact shape
+       (validated against the live device count with a loud error),
+       ``auto`` uses every visible device, ``off`` disables the mesh.
+    2. Otherwise the legacy surface: ``--devices`` (count) +
+       ``FGUMI_TPU_SP`` (read-axis split; dp = n // sp, default sp=1).
+
+    Sharding is transparent — single-device output is byte-identical
+    (tests/test_mesh.py, tools/mesh_smoke.py). Raises
+    :class:`~fgumi_tpu.parallel.mesh.MeshConfigError` on an unsatisfiable
+    shape; commands map it to exit 2.
     """
+    from .parallel.mesh import parse_mesh_spec, publish_mesh, resolve_mesh
+
+    spec = parse_mesh_spec(mesh_spec if mesh_spec is not None
+                           else os.environ.get("FGUMI_TPU_MESH"))
+    explicit_off = ((mesh_spec is not None
+                     or os.environ.get("FGUMI_TPU_MESH") is not None)
+                    and spec is None)
+    if explicit_off:
+        return None
     # CPU pinned without a forced virtual device count => exactly one device:
-    # skip the jax import/backend init entirely (host-engine cold-start path)
-    if (os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
+    # skip the jax import/backend init entirely (host-engine cold-start
+    # path) — unless an explicit mesh shape demands validation
+    if (spec is None
+            and os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
             and "host_platform_device_count"
             not in os.environ.get("XLA_FLAGS", "")
             and not os.environ.get("FGUMI_TPU_COORDINATOR")):
@@ -253,6 +272,7 @@ def _build_dp_mesh(devices_arg):
     # multi-host: join the process group BEFORE the first backend touch so
     # jax.devices() below is the global device list (parallel/distributed.py)
     from .parallel.distributed import initialize_from_env
+    from .parallel.mesh import MeshConfigError
 
     dist = initialize_from_env()
     import jax
@@ -268,14 +288,38 @@ def _build_dp_mesh(devices_arg):
         if devices_arg not in (None, "auto") and int(devices_arg) != len(devs):
             log.warning("--devices %s ignored in multi-host mode: the mesh "
                         "uses all %d global devices", devices_arg, len(devs))
+        explicit_sp = False
+        if isinstance(spec, tuple):
+            dp_req, sp_req = spec
+            if dp_req * sp_req != len(devs):
+                raise MeshConfigError(
+                    f"FGUMI_TPU_MESH=dp{dp_req}xsp{sp_req} does not cover "
+                    f"the {len(devs)}-device process group; multi-host "
+                    "meshes always use every global device")
+            sp = sp_req
+            explicit_sp = True
         local = len(jax.local_devices())
         if local % sp != 0:
+            if explicit_sp:
+                # the --mesh contract: a forced shape is honored exactly
+                # or fails loudly — never silently rebuilt with sp=1
+                raise MeshConfigError(
+                    f"FGUMI_TPU_MESH sp={sp} does not divide the per-host "
+                    f"device count {local}; sp groups must stay on one "
+                    "host's ICI")
             log.warning("FGUMI_TPU_SP=%d does not divide the per-host "
                         "device count %d; using sp=1", sp, local)
             sp = 1
         from .parallel.distributed import make_global_mesh
 
-        return make_global_mesh(sp=sp)
+        mesh = make_global_mesh(sp=sp)
+        publish_mesh(mesh)
+        return mesh
+    if spec is not None:
+        mesh = resolve_mesh(devs, spec, sp_default=sp)
+        if mesh is not None:
+            publish_mesh(mesh)
+        return mesh
     n = len(devs) if devices_arg in (None, "auto") else int(devices_arg)
     n = max(1, min(n, len(devs)))
     if n <= 1:
@@ -286,7 +330,9 @@ def _build_dp_mesh(devices_arg):
         sp = 1
     from .parallel.mesh import make_mesh
 
-    return make_mesh(devs[:n], sp=sp)
+    mesh = make_mesh(devs[:n], sp=sp)
+    publish_mesh(mesh)
+    return mesh
 
 
 def _devices_arg(s: str):
@@ -444,7 +490,8 @@ def cmd_simplex(args, source=None, sink=None):
         # device gathers); two queues bound the in-flight working set
         queue_items = int(max(1, min(8, budget // (6 * args.batch_bytes))))
         stats = StageTimes()
-        mesh = _build_dp_mesh(getattr(args, "devices", "auto"))
+        mesh = _build_dp_mesh(getattr(args, "devices", "auto"),
+                              getattr(args, "mesh", None))
         with (BamBatchReader(args.input, target_bytes=args.batch_bytes)
               if source is None else source) as reader:
             caller = VanillaConsensusCaller(
@@ -643,7 +690,8 @@ def cmd_duplex(args):
         from .utils.progress import ProgressTracker
 
         stats_t = StageTimes()
-        mesh = _build_dp_mesh(getattr(args, "devices", "auto"))
+        mesh = _build_dp_mesh(getattr(args, "devices", "auto"),
+                              getattr(args, "mesh", None))
         fast = FastDuplexCaller(caller, b"MI", overlap_caller=oc_caller,
                                 mesh=mesh)
         # inline mode: resolve_chunk runs on this same thread in FIFO order,
@@ -893,6 +941,10 @@ def _add_codec(sub):
                    help="print per-stage pipeline timing table")
     p.add_argument("--classic", action="store_true",
                    help="force the per-molecule engine (no batch vectorization)")
+    p.add_argument("--devices", default="auto", type=_devices_arg,
+                   help="device count for data-parallel SS dispatch: auto "
+                        "(all visible) or an explicit N; 1 disables sharding "
+                        "(batch engine only)")
     _add_pipeline_compat(p)
     p.set_defaults(func=cmd_codec)
 
@@ -949,10 +1001,12 @@ def cmd_codec(args):
 
         stats_t = StageTimes()
         progress = ProgressTracker("codec")
+        mesh = _build_dp_mesh(getattr(args, "devices", "auto"),
+                              getattr(args, "mesh", None))
         with BamBatchReader(args.input,
                             target_bytes=args.batch_bytes) as reader:
             out_header = _unmapped_consensus_header(args.read_group_id)
-            fast = FastCodecCaller(caller, args.tag.encode())
+            fast = FastCodecCaller(caller, args.tag.encode(), mesh=mesh)
 
             def _process(batch):
                 progress.add(batch.n)
@@ -3446,6 +3500,13 @@ def build_parser():
              "optional ladder cap (default 2^24); bounds the XLA "
              "executable vocabulary and the padding waste "
              "(also FGUMI_TPU_SHAPE_BUCKETS; docs/device-datapath.md)")
+    parser.add_argument(
+        "--mesh", type=_mesh_arg, default=None, metavar="dpNxspM",
+        help="device mesh for sharded consensus dispatch: dpNxspM forces "
+             "an exact (data-parallel x sequence-parallel) shape validated "
+             "against the visible device count, 'auto' uses every device, "
+             "'off' disables sharding; overrides --devices/FGUMI_TPU_SP "
+             "(also FGUMI_TPU_MESH; docs/multi-chip.md)")
     sub = parser.add_subparsers(dest="command", required=True)
     _add_extract(sub)
     _add_correct(sub)
@@ -3489,11 +3550,17 @@ def _run_command(args):
     import errno as _errno
 
     from .io.errors import InputFormatError
+    from .parallel import MeshConfigError
     from .utils.faults import InjectedFault
     from .utils.governor import GOVERNOR, ResourceExhausted
 
     try:
         return args.func(args)
+    except MeshConfigError as e:
+        # an unsatisfiable --mesh/FGUMI_TPU_MESH shape: one loud line, not
+        # a traceback — a silently smaller mesh would misreport itself
+        log.error("%s", e)
+        return 2
     except (InputFormatError, EOFError) as e:
         # a diagnosed input problem (truncated/corrupt stream, torn record):
         # one line with path + offset, nonzero exit — not a traceback
@@ -3550,6 +3617,22 @@ def _shape_buckets_arg(value: str) -> str:
     except ValueError as e:
         raise _ap.ArgumentTypeError(str(e)) from None
     return value
+
+
+def _mesh_arg(value: str) -> str:
+    """argparse validator for --mesh: loud format errors at the command
+    line (the shape-vs-device-count check runs at mesh build, where the
+    live device list exists). Pure-regex parse — no jax import here."""
+    import argparse as _ap
+    import re as _re
+
+    v = value.strip().lower()
+    if v in ("", "off", "none", "0", "1", "auto") \
+            or _re.match(r"^dp\d+(xsp\d+)?$", v):
+        return value
+    raise _ap.ArgumentTypeError(
+        f"--mesh {value!r}: expected 'auto', 'off', or 'dpNxspM' "
+        f"(e.g. dp4xsp2)")
 
 
 def _apply_shape_buckets(args):

@@ -230,33 +230,11 @@ class _PendingChunk:
             winner, qual, depth, errors = kernel.resolve_hard_columns(
                 pending)
             self._assign(idxs, winner, qual, depth, errors)
-        elif self.pending[0] == "segw":
+        else:  # "segw": the wire ticket, single-device or mesh-sharded
             _, idxs, starts, codes_d, quals_d, ticket = self.pending
             winner, qual, depth, errors = kernel.resolve_segments_wire(
                 ticket, codes_d, quals_d, starts)
             self._assign(idxs, winner, qual, depth, errors)
-        elif self.pending[0] == "shard":
-            # (dp, F_local, L) packed, one family shard per device
-            _, shard_jobs, shard_starts, codes3d, quals3d, dev = self.pending
-            from ..ops.kernel import DEVICE_STATS
-
-            packed = DEVICE_STATS.fetch(dev)
-            for d, (jlist, starts_d) in enumerate(zip(shard_jobs,
-                                                      shard_starts)):
-                n = starts_d[-1]
-                winner, qual, depth, errors = kernel._finish_segments(
-                    packed[d], codes3d[d, :n], quals3d[d, :n], starts_d)
-                self._assign(jlist, winner, qual, depth, errors)
-        else:  # "shard_rows": dp x sp packed; host rows kept 2D per shard
-            _, shard_jobs, shard_starts, shard_rows, dev = self.pending
-            from ..ops.kernel import DEVICE_STATS
-
-            packed = DEVICE_STATS.fetch(dev)
-            for d, (jlist, starts_d, (c2, q2)) in enumerate(
-                    zip(shard_jobs, shard_starts, shard_rows)):
-                winner, qual, depth, errors = kernel._finish_segments(
-                    packed[d], c2, q2, starts_d)
-                self._assign(jlist, winner, qual, depth, errors)
         return fast._serialize_jobs(self.batch, self.jobs, self.blocks)
 
     def _assign(self, idxs, winner, qual, depth, errors):
@@ -284,9 +262,11 @@ class FastSimplexCaller:
 
     def __init__(self, caller: VanillaConsensusCaller, tag: bytes = b"MI",
                  overlap_caller=None, mesh=None):
-        """`mesh`: optional jax Mesh with a "dp" axis — multi-read jobs are
-        split into contiguous balanced family shards, one per device (data
-        parallel, no collectives; SURVEY §5.8). None = single device."""
+        """`mesh`: optional jax Mesh with (dp, sp) axes — multi-read jobs
+        dispatch through the shard_map-wrapped full-column wire kernels
+        (families over dp with no collectives, each shard's read rows over
+        sp with one psum combine; ops/kernel._dispatch_wire_mesh). None or
+        a 1-device mesh = the legacy single-device path, bit for bit."""
         self.caller = caller
         self.tag = tag
         self.overlap_caller = overlap_caller  # OverlappingBasesConsensusCaller
@@ -876,23 +856,21 @@ class FastSimplexCaller:
         # and the 2-bit winner output packs 4 positions per byte
         L_max = -(-int(table.cons_len[multi].max()) // 4) * 4
 
-        if self.mesh is not None:
-            starts = np.concatenate(([0], np.cumsum(counts)))
-            codes_d = np.ascontiguousarray(codes[rows_all, :L_max])
-            quals_d = np.ascontiguousarray(quals[rows_all, :L_max])
-            return (self._dispatch_sharded(multi, counts, starts, codes_d,
-                                           quals_d, L_max), blocks0)
-
         from ..ops.kernel import HOST_DISPATCH, device_path
         from ..ops.router import ROUTER
 
         N = len(rows_all)
+        mesh = self.mesh
         if kernel.host_mode():
             side = "host"
         else:
             # adaptive offload: price this batch on both sides from
-            # measured EWMAs (ops/router.py decide_batch)
-            side = ROUTER.decide_batch(kernel, N, len(multi), L_max)
+            # measured EWMAs (ops/router.py decide_batch) — the mesh size
+            # selects its own EWMA set, so an N-chip device side is priced
+            # as N chips, not as the single-device model
+            side = ROUTER.decide_batch(
+                kernel, N, len(multi), L_max,
+                devices=mesh.size if mesh is not None else 1)
         if side == "host":
             # host f64 engine path: either no device at all, or the cost
             # model priced this batch host-side — the native engine eats it
@@ -919,53 +897,38 @@ class FastSimplexCaller:
         # full-column device route (the round-6 default): the whole batch
         # crosses the link once in the 1 B/position wire layout and the
         # device resolves every column — winner/qual/depth/errors per
-        # position, no host re-walk of the dense rows at resolve time
+        # position, no host re-walk of the dense rows at resolve time.
+        # With a > 1-device mesh the same wire kernels run shard_map-
+        # wrapped over (dp, sp) (ops/kernel.pad_segments_mesh +
+        # _dispatch_wire_mesh); resolve is the identical "segw" pending —
+        # byte-identity with the single-device path is the test oracle.
         import time
 
-        from ..ops.kernel import pad_segments_gather
+        from ..ops.kernel import pad_segments_gather, pad_segments_mesh
 
         t_pack0 = time.monotonic()  # gather+pad+wire == this batch's pack
+        pred = ROUTER.last_prediction()
+        full = bool(counts.max() < 65536)
+        if mesh is not None:
+            codes_d = np.ascontiguousarray(codes[rows_all, :L_max])
+            quals_d = np.ascontiguousarray(quals[rows_all, :L_max])
+            codes_g, quals_g, seg_g, starts_p, F_loc, gather = \
+                pad_segments_mesh(codes_d, quals_d, counts, mesh)
+            ticket = kernel.device_call_segments_wire(
+                codes_g, quals_g, seg_g, F_loc, len(multi),
+                pack_t0=t_pack0, full=full,
+                pred_s=pred[0] if pred else None, mesh=mesh,
+                mesh_gather=gather)
+            return ("segw", multi, starts_p, codes_d, quals_d,
+                    ticket), blocks0
         codes_dev, quals_dev, seg_ids, starts_p, F_pad, N_real = \
             pad_segments_gather(codes, quals, rows_all, L_max, counts)
-        pred = ROUTER.last_prediction()
         ticket = kernel.device_call_segments_wire(
             codes_dev, quals_dev, seg_ids, F_pad, len(multi),
-            pack_t0=t_pack0, full=bool(counts.max() < 65536),
+            pack_t0=t_pack0, full=full,
             pred_s=pred[0] if pred else None)
         return ("segw", multi, starts_p, codes_dev[:N_real],
                 quals_dev[:N_real], ticket), blocks0
-
-    def _dispatch_sharded(self, multi, counts, starts, codes_d, quals_d,
-                          L_max):
-        """Split jobs into dp contiguous row-balanced shards, one per device.
-
-        Shards stay contiguous so each device's rows are a slice of the dense
-        layout; all shards pad to common (N_max, F_local) pow2 shapes (the
-        stacked (dp, N_max, L) array shards over the mesh's dp axis).
-        """
-        mesh = self.mesh
-        sp = dict(mesh.shape).get("sp", 1)
-        if sp > 1:
-            dp = mesh.shape["dp"]
-            jb = split_row_balanced(counts, dp)
-            shard_jobs = [multi[jb[d]:jb[d + 1]] for d in range(dp)]
-            codes4, quals4, seg3, shard_starts, _, F_loc = pack_shards_sp(
-                codes_d, quals_d, starts, jb, L_max, sp)
-            dev = self.caller.kernel.device_call_segments_dp_sp(
-                codes4, quals4, seg3, F_loc, mesh)
-            # shard resolve reads rows per dp shard from the dense 2D layout
-            shard_rows = [(codes_d[starts[jb[d]]:starts[jb[d + 1]]],
-                           quals_d[starts[jb[d]]:starts[jb[d + 1]]])
-                          for d in range(dp)]
-            return ("shard_rows", shard_jobs, shard_starts, shard_rows, dev)
-        dp = mesh.size
-        jb = split_row_balanced(counts, dp)
-        shard_jobs = [multi[jb[d]:jb[d + 1]] for d in range(dp)]
-        codes3d, quals3d, seg2d, shard_starts, _, F_loc = pack_shards(
-            codes_d, quals_d, starts, jb, L_max)
-        dev = self.caller.kernel.device_call_segments_sharded(
-            codes3d, quals3d, seg2d, F_loc, mesh)
-        return ("shard", shard_jobs, shard_starts, codes3d, quals3d, dev)
 
     # ------------------------------------------------------------------ output
 

@@ -29,12 +29,17 @@ from .codec import _ASCII_COMPLEMENT, _SS, combine_arrays
 class FastCodecCaller:
     """Batch CODEC engine wrapping a CodecConsensusCaller."""
 
-    def __init__(self, caller, tag: bytes = b"MI"):
+    def __init__(self, caller, tag: bytes = b"MI", mesh=None):
+        """`mesh`: optional jax Mesh with (dp, sp) axes — the SS device
+        pass routes through the shard_map-wrapped wire kernels and the
+        concordance combine through the sharded elementwise variant. None
+        or a 1-device mesh = the legacy single-device path, bit for bit."""
         self.caller = caller
         # device/host routing is per batch via the adaptive cost model
         # (ops/router.py; FGUMI_TPU_ROUTE / FGUMI_TPU_MAX_INFLIGHT handled
         # inside ROUTER.decide)
         self.tag = tag
+        self.mesh = mesh if mesh is not None and mesh.size > 1 else None
         self._carry = None  # (mi string, [RawRecord])
 
     # ----------------------------------------------------------------- driver
@@ -189,7 +194,8 @@ class FastCodecCaller:
 
             starts = np.concatenate(([0], np.cumsum(counts)))
             w, q_, d, e = route_and_call_segments(ss.kernel, codes2d,
-                                                  quals2d, counts, starts)
+                                                  quals2d, counts, starts,
+                                                  mesh=self.mesh)
             slots = [(v[0], v[1], v[4]) for v in vec_multi] \
                 + [(c[0], c[1], c[2]) for c in cls]
             # thresholds are elementwise: one vectorized pass over the whole
@@ -356,7 +362,7 @@ class FastCodecCaller:
             res, _side = run_adaptive_stage(
                 CODEC_COMBINE, T, comb_env,
                 lambda: codec_combine_device(b1, b2, q1, q2, d1, d2,
-                                             e1, e2),
+                                             e1, e2, mesh=self.mesh),
                 _host_combine)
         else:
             res = _host_combine()

@@ -59,9 +59,11 @@ class FastDuplexCaller:
 
     def __init__(self, caller, tag: bytes = b"MI", overlap_caller=None,
                  mesh=None):
-        """`mesh`: optional jax Mesh with a "dp" axis — multi-read SS
-        segments split into contiguous row-balanced shards, one per device
-        (same dp dispatch as the simplex caller). None = single device."""
+        """`mesh`: optional jax Mesh with (dp, sp) axes — multi-read SS
+        segments dispatch through the shard_map-wrapped full-column wire
+        kernels (same mesh compile path as the simplex caller, including
+        the resident fused strand combine). None or a 1-device mesh = the
+        legacy single-device path, bit for bit."""
         self.caller = caller
         self.ss = caller.ss
         self.kernel = caller.ss.kernel
@@ -482,17 +484,15 @@ class FastDuplexCaller:
             e16[multi] = np.minimum(e, I16_MAX).astype(np.int32)
             return tb, tq, d16, e16, codes2d, ctx
 
-        if self.mesh is not None:
-            w, q_, d, e = self._dispatch_sharded(cm, qm, counts_m,
-                                                 starts_m, L_max)
-            return finish_with(w, q_, d, e, None)
         route = "host"
         if not self.kernel.host_mode():
-            # adaptive offload: same pricing as the simplex engine
+            # adaptive offload: same pricing as the simplex engine (the
+            # mesh size selects its own cost-model EWMA set)
             from ..ops.router import ROUTER
 
-            route = ROUTER.decide_batch(self.kernel, cm.shape[0],
-                                        len(multi), L_max)
+            route = ROUTER.decide_batch(
+                self.kernel, cm.shape[0], len(multi), L_max,
+                devices=self.mesh.size if self.mesh is not None else 1)
         if route == "host":
             # no device, or the cost model priced this batch host-side:
             # the native f64 engine absorbs it concurrently
@@ -515,11 +515,15 @@ class FastDuplexCaller:
             return ("defer", resolve_cols) if defer else resolve_cols()
         # full-column wire route (round-6 default): the whole multi-seg
         # pileup crosses the link once; with the resident variant the
-        # thresholded outputs stay on device for the fused strand combine
+        # thresholded outputs stay on device for the fused strand combine.
+        # A > 1-device mesh runs the same kernels shard_map-wrapped
+        # (families over dp, read rows over sp with one psum); the
+        # resident arrays then live sharded along dp and the combine's
+        # indices are mapped through the shard-order gather below.
         import os
         import time as _time
 
-        from ..ops.kernel import pad_segments
+        from ..ops.kernel import pad_segments, pad_segments_mesh
         from ..ops.router import ROUTER
 
         comb_env = os.environ.get("FGUMI_TPU_DUPLEX_COMBINE",
@@ -527,15 +531,25 @@ class FastDuplexCaller:
         full_ok = bool(counts_m.max() < 65536)
         want_res = full_ok and comb_env != "host"
         t_pack0 = _time.monotonic()
-        cd, qd, seg_ids, _sp, F_pad = pad_segments(cm, qm, counts_m)
         pred = ROUTER.last_prediction()
-        ticket = self.kernel.device_call_segments_wire(
-            cd, qd, seg_ids, F_pad, len(multi), pack_t0=t_pack0,
-            full=full_ok,
-            resident_thresholds=(opts.min_reads,
-                                 opts.min_consensus_base_quality)
-            if want_res else None,
-            pred_s=pred[0] if pred else None)
+        res_thresholds = (opts.min_reads,
+                          opts.min_consensus_base_quality) \
+            if want_res else None
+        mesh = self.mesh
+        if mesh is not None:
+            cg, qg, seg_g, _st, F_loc, gather = pad_segments_mesh(
+                cm, qm, counts_m, mesh)
+            ticket = self.kernel.device_call_segments_wire(
+                cg, qg, seg_g, F_loc, len(multi), pack_t0=t_pack0,
+                full=full_ok, resident_thresholds=res_thresholds,
+                pred_s=pred[0] if pred else None, mesh=mesh,
+                mesh_gather=gather)
+        else:
+            cd, qd, seg_ids, _sp, F_pad = pad_segments(cm, qm, counts_m)
+            ticket = self.kernel.device_call_segments_wire(
+                cd, qd, seg_ids, F_pad, len(multi), pack_t0=t_pack0,
+                full=full_ok, resident_thresholds=res_thresholds,
+                pred_s=pred[0] if pred else None)
 
         def resolve_wire():
             w, q_, d, e, extras = self.kernel.resolve_segments_wire(
@@ -547,44 +561,13 @@ class FastDuplexCaller:
                 ctx = {"resident": extras["resident"],
                        "suspect": extras["suspect"],
                        "seg_to_multi": seg_to_multi,
-                       "override": comb_env}
+                       "override": comb_env,
+                       # mesh dispatches: multi index -> row of the
+                       # shard-ordered resident arrays
+                       "gather": extras.get("gather")}
             return finish_with(w, q_, d, e, ctx)
 
         return ("defer", resolve_wire) if defer else resolve_wire()
-
-    def _dispatch_sharded(self, cm, qm, counts_m, starts_m, L_max):
-        """dp contiguous row-balanced shards over the multi-read segments,
-        one device execution, per-shard exact resolution — the duplex twin of
-        FastSimplexCaller._dispatch_sharded (byte-identical to the
-        single-device path; tests/test_fast_duplex.py)."""
-        import jax
-
-        from .fast import pack_shards, split_row_balanced
-
-        mesh = self.mesh
-        dp = mesh.size
-        jb = split_row_balanced(counts_m, dp)
-        codes3d, quals3d, seg2d, shard_starts, n_jobs, F_loc = pack_shards(
-            cm, qm, starts_m, jb, L_max)
-        dev = self.kernel.device_call_segments_sharded(codes3d, quals3d,
-                                                       seg2d, F_loc, mesh)
-        from ..ops.kernel import DEVICE_STATS
-
-        packed = DEVICE_STATS.fetch(dev)
-        J = len(counts_m)
-        w = np.zeros((J, L_max), dtype=np.uint8)
-        q_ = np.zeros((J, L_max), dtype=np.uint8)
-        d_ = np.zeros((J, L_max), dtype=np.int64)
-        e_ = np.zeros((J, L_max), dtype=np.int64)
-        for d in range(dp):
-            if n_jobs[d] == 0:
-                continue
-            n = int(shard_starts[d][-1])
-            wd, qd, dd, ed = self.kernel._finish_segments(
-                packed[d], codes3d[d, :n], quals3d[d, :n], shard_starts[d])
-            sl = slice(int(jb[d]), int(jb[d + 1]))
-            w[sl], q_[sl], d_[sl], e_[sl] = wd, qd, dd, ed
-        return w, q_, d_, e_
 
     # ---------------------------------------------------------------- stage 2
 
@@ -816,10 +799,21 @@ class FastDuplexCaller:
                 from ..ops.kernel import duplex_combine_device
                 from ..ops.router import DUPLEX_COMBINE, run_adaptive_stage
 
+                # mesh dispatches keep the resident arrays shard-ordered
+                # on device: remap multi indices through the gather instead
+                # of paying a device-side re-shuffle (single-device: rows
+                # ARE multi order, gather is None)
+                gather = combine_ctx.get("gather")
+                a_rows = s2m[aseg[cand]]
+                b_rows = s2m[bseg[cand]]
+                if gather is not None:
+                    a_rows = gather[a_rows]
+                    b_rows = gather[b_rows]
+
                 def _device_combine():
                     ob, oq, oe = duplex_combine_device(
-                        combine_ctx["resident"], s2m[aseg[cand]],
-                        s2m[bseg[cand]], lens[cand])
+                        combine_ctx["resident"], a_rows, b_rows,
+                        lens[cand])
                     out_b[cand] = ob
                     out_q[cand] = oq
                     out_e[cand] = oe

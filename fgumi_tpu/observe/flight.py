@@ -83,6 +83,14 @@ def router_snapshot():
     return router.ROUTER.snapshot() if router is not None else None
 
 
+def mesh_snapshot():
+    """The active production mesh's {dp, sp, devices, platform}, or None
+    when no mesh was built this process (single-device / host-only)."""
+    pm = sys.modules.get("fgumi_tpu.parallel.mesh")
+    return getattr(pm, "LAST_MESH_SNAPSHOT", None) if pm is not None \
+        else None
+
+
 def _ring_capacity() -> int:
     try:
         n = int(os.environ.get("FGUMI_TPU_FLIGHT_EVENTS",
@@ -218,6 +226,7 @@ class FlightRecorder:
         # must not take the black box down with it
         for name, fn in (("metrics", self._metrics_section),
                          ("device", self._device_section),
+                         ("mesh", mesh_snapshot),
                          ("breaker", breaker_snapshot),
                          ("governor", governor_snapshot)):
             try:

@@ -175,6 +175,21 @@ def build_report(command: str, argv, started_unix: float, wall_s: float,
         report["queues"] = queues
     stats = _device_stats()
     dev = stats.snapshot() if stats is not None else {}
+    # active production mesh (parallel/mesh.py publish_mesh): the device
+    # section names the (dp, sp, devices) shape so a sharded run's artifact
+    # is distinguishable from a single-device one at a glance (ISSUE 10).
+    # Keyed off THIS scope's gauges — the process-global snapshot alone
+    # would leak one daemon job's mesh into every later job's report; it
+    # only contributes the platform label when it matches.
+    m_dp = metrics.get("device.mesh.dp")
+    if m_dp:
+        mesh_sec = {"dp": m_dp, "sp": metrics.get("device.mesh.sp", 1),
+                    "devices": metrics.get("device.mesh.devices", m_dp)}
+        pm = sys.modules.get("fgumi_tpu.parallel.mesh")
+        snap = getattr(pm, "LAST_MESH_SNAPSHOT", None) if pm else None
+        if snap and snap.get("dp") == m_dp:
+            mesh_sec["platform"] = snap.get("platform")
+        dev["mesh"] = mesh_sec
     # offload cost-model state (link/host EWMAs + last decision) rides
     # along whenever batches were routed, so a wrong crossover is
     # diagnosable from the report alone (ISSUE 6 satellite) — including
@@ -194,7 +209,7 @@ def build_report(command: str, argv, started_unix: float, wall_s: float,
                 or bsnap["deadline_overruns"]:
             dev["breaker"] = bsnap
     if dev.get("dispatches") or dev.get("route_host") \
-            or dev.get("breaker"):
+            or dev.get("breaker") or dev.get("mesh"):
         report["device"] = dev
     io_sec = {k.split(".", 1)[1]: v for k, v in metrics.items()
               if k.startswith("io.")}

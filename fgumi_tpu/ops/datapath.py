@@ -204,6 +204,19 @@ class ShapeBucketRegistry:
         """
         return self.bucket(max(j, 1), 8)
 
+    def bucket_segments_sharded(self, j: int, parts: int) -> int:
+        """Per-shard segment count for a family axis split ``parts`` ways.
+
+        Quantizes ``ceil(j / parts)`` up the SAME 8-aligned ladder as the
+        single-device ``bucket_segments``, so the global family axis rounds
+        to ``parts * F_loc`` (a multiple of the mesh's dp by construction)
+        while each shard's static jit shape comes from the one fleet-wide
+        shape vocabulary — a dp=4 run and a dp=8 run compile the same
+        per-shard executables when their shard sizes land on the same
+        ladder rung (ISSUE 10: one vocabulary across mesh sizes)."""
+        parts = max(int(parts), 1)
+        return self.bucket(max(-(-int(j) // parts), 1), 8)
+
     # ------------------------------------------------------- observation
 
     def observe(self, kind: str, *dims) -> bool:
@@ -280,9 +293,15 @@ class DeviceConstantCache:
     def _is_pending(entry) -> bool:
         return isinstance(entry, tuple) and entry and entry[0] == "pending"
 
-    def put(self, name: str, arr: np.ndarray):
+    def put(self, name: str, arr: np.ndarray, sharding=None):
         """Device-resident handle for ``arr`` (jax must be initialized —
         callers sit inside dispatch closures, after ``_ensure_jax``).
+
+        ``sharding``: optional ``jax.sharding.Sharding`` (the mesh compile
+        path passes a replicated ``NamedSharding`` so constants live on
+        every chip of the mesh); keyed into the cache alongside the
+        content, so single-device and mesh dispatches of the same tables
+        coexist without thrashing each other's residency.
 
         At-most-once per (device, content) even under concurrent misses
         (the sync dispatch paths run on arbitrary resolve workers, not
@@ -294,8 +313,15 @@ class DeviceConstantCache:
         re-read."""
         import jax
 
-        dev = jax.devices()[0]
-        key = (dev.platform, dev.id, name, *self._fingerprint(arr))
+        if sharding is not None:
+            dev = sharding
+            placement = ("mesh",
+                         tuple(sorted(d.id for d in sharding.device_set)),
+                         str(getattr(sharding, "spec", "")))
+        else:
+            dev = jax.devices()[0]
+            placement = (dev.platform, dev.id)
+        key = (*placement, name, *self._fingerprint(arr))
         from ..observe.metrics import METRICS
         from .kernel import DEVICE_STATS
 

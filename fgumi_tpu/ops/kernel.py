@@ -452,6 +452,19 @@ class DeviceStats:
             if entry is not None:
                 entry["pred_s"] = round(pred_s, 4)
 
+    def note_mesh(self, slot: int, shards: int, shard_bytes: int,
+                  psums: int):
+        """Stamp a mesh dispatch's shard geometry into the timeline: shard
+        count, upload bytes per shard, and hot-path psum count (0 on a
+        dp-only mesh — families are independent; 2 with sp > 1: the
+        contribution and observation combines)."""
+        with self._lock:
+            entry = self._entry_locked(slot)
+            if entry is not None:
+                entry["shards"] = int(shards)
+                entry["shard_up_bytes"] = int(shard_bytes)
+                entry["psums"] = int(psums)
+
     def timeline_entry(self, slot: int):
         """Copy of one timeline slot (router feedback at resolve time)."""
         with self._lock:
@@ -675,7 +688,7 @@ class DispatchTicket:
     feeder slot reclaimed whenever the wedged dispatch finally returns."""
 
     __slots__ = ("_event", "_result", "_exc", "slot", "upload_bytes",
-                 "_released", "_abandoned")
+                 "_released", "_abandoned", "mesh_gather", "mesh_devices")
 
     def __init__(self):
         self._event = threading.Event()
@@ -685,6 +698,11 @@ class DispatchTicket:
         self.upload_bytes = 0
         self._released = False
         self._abandoned = False
+        # mesh dispatches (device_call_segments_wire mesh=...): the
+        # family-order gather over the shard-ordered device output, and the
+        # mesh size the router's per-mesh cost model is keyed by
+        self.mesh_gather = None
+        self.mesh_devices = 1
 
     def _set(self, result=None, exc=None):
         self._result = result
@@ -1477,11 +1495,12 @@ def _wire_epilogue(wire, seg_ids, dict_tab, ln_error_pre_umi, num_segments):
     return _call_epilogue(contrib, obs, ln_error_pre_umi) + (obs,)
 
 
-def _packed2_epilogue(codes_packed, quals, seg_ids, correct_tab, err_tab,
-                      ln_error_pre_umi, num_segments):
-    """Shared reduction+epilogue of the 1.25 B/position fallback layout
-    (>63 distinct quals): 2-bit packed codes + sentinel quals. Device-side
-    unpack is a shift-and-mask."""
+def _packed2_terms(codes_packed, quals, correct_tab, err_tab):
+    """Per-observation lane one-hot + delta from the 1.25 B/position
+    fallback layout (>63 distinct quals): 2-bit packed codes + sentinel
+    quals, device-side unpack is a shift-and-mask. The one copy of this
+    math — shared by the single-device epilogue and the shard_map mesh
+    kernel so the two can never drift apart."""
     shifts = jnp.arange(0, 8, 2, dtype=jnp.uint8)
     c4 = (codes_packed[..., None] >> shifts) & 3
     codes = c4.reshape(codes_packed.shape[0], -1)
@@ -1491,6 +1510,14 @@ def _packed2_epilogue(codes_packed, quals, seg_ids, correct_tab, err_tab,
     one_hot = jax.nn.one_hot(codes, 4, dtype=jnp.float32)
     one_hot = one_hot * valid[..., None].astype(jnp.float32)
     delta = jnp.where(valid, delta_tab[q_idx], 0.0)
+    return one_hot, delta
+
+
+def _packed2_epilogue(codes_packed, quals, seg_ids, correct_tab, err_tab,
+                      ln_error_pre_umi, num_segments):
+    """Shared reduction+epilogue of the 1.25 B/position fallback layout."""
+    one_hot, delta = _packed2_terms(codes_packed, quals, correct_tab,
+                                    err_tab)
     row_contrib = delta[..., None] * one_hot
     contrib = jax.ops.segment_sum(row_contrib, seg_ids,
                                   num_segments=num_segments,
@@ -1625,14 +1652,11 @@ def _duplex_combine_jit(tb, tq, obs, a_idx, b_idx, lens, out_rows):
             jnp.minimum(errs, _I16_MAX)[:out_rows].astype(jnp.int32))
 
 
-@_lazy_jit(static_argnames=("out_rows",))
-def _codec_combine_jit(ba, bb, qa, qb, da, db, ea, eb, out_rows):
-    """CODEC concordance/duplex combine as a device stage.
-
-    Integer-exact twin of consensus/codec.combine_arrays (int32 select
-    arithmetic end to end) over the batch engine's concatenated position
-    arrays; inputs arrive post-oracle, so there is no suspect surface —
-    device output equals the numpy combine bit-for-bit."""
+def _codec_combine_body(ba, bb, qa, qb, da, db, ea, eb):
+    """CODEC concordance/duplex combine math (elementwise int32 select
+    arithmetic end to end) — shared by the single-device jit and the
+    shard_map mesh variant (zero collectives: every output element depends
+    only on its own index)."""
     from ..constants import NO_CALL_BASE, NO_CALL_BASE_LOWER
 
     ba = ba.astype(jnp.int32)
@@ -1686,11 +1710,38 @@ def _codec_combine_jit(ba, bb, qa, qb, da, db, ea, eb, out_rows):
     n_mask = (ba == NO_CALL_BASE) | (bb == NO_CALL_BASE)
     base = jnp.where(n_mask, NO_CALL_BASE, base)
     qual = jnp.where(n_mask, MIN_PHRED, qual)
-    sl = slice(None, out_rows)
-    return (base[sl].astype(jnp.uint8), qual[sl].astype(jnp.uint8),
-            jnp.minimum(depth, 2 * _I16_MAX)[sl].astype(jnp.int32),
-            jnp.minimum(errors, _I16_MAX)[sl].astype(jnp.int32),
-            both[sl], (a_wins | b_wins | tie)[sl])
+    return (base.astype(jnp.uint8), qual.astype(jnp.uint8),
+            jnp.minimum(depth, 2 * _I16_MAX).astype(jnp.int32),
+            jnp.minimum(errors, _I16_MAX).astype(jnp.int32),
+            both, (a_wins | b_wins | tie))
+
+
+@_lazy_jit(static_argnames=("out_rows",))
+def _codec_combine_jit(ba, bb, qa, qb, da, db, ea, eb, out_rows):
+    """CODEC concordance/duplex combine as a device stage.
+
+    Integer-exact twin of consensus/codec.combine_arrays over the batch
+    engine's concatenated position arrays; inputs arrive post-oracle, so
+    there is no suspect surface — device output equals the numpy combine
+    bit-for-bit."""
+    out = _codec_combine_body(ba, bb, qa, qb, da, db, ea, eb)
+    return tuple(o[:out_rows] for o in out)
+
+
+@_lazy_jit(static_argnames=("mesh",))
+def _codec_combine_mesh_jit(ba, bb, qa, qb, da, db, ea, eb, mesh):
+    """Mesh variant of the CODEC combine: the position axis shards over
+    every mesh axis with explicit PartitionSpec rules — purely elementwise,
+    so the shard_map body is the single-device body verbatim and the wire
+    cost is one NamedSharding upload slice per device. The host slices the
+    fetched result to the real row count (no static out_rows: a fetch
+    slice would have to respect shard boundaries for no byte win)."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(mesh.axis_names)
+    mapped = shard_map_compat(_codec_combine_body, mesh=mesh,
+                              in_specs=(spec,) * 8, out_specs=(spec,) * 6)
+    return mapped(ba, bb, qa, qb, da, db, ea, eb)
 
 
 @_lazy_jit(static_argnames=("num_segments", "out_segments"))
@@ -1879,6 +1930,133 @@ def _consensus_segments_dp_sp_jit(codes, quals, seg_ids, correct_tab,
     return mapped(codes, quals, seg_ids)
 
 
+# ---------------------------------------------------------------------------
+# Production mesh compile path (ISSUE 10): shard_map-wrapped variants of the
+# full-column wire kernels with explicit PartitionSpec rules. The host packs
+# the dense row layout into dp x sp chunks (pad_segments_mesh); each (d, s)
+# shard segment-sums its local rows' contributions over its dp shard's LOCAL
+# segment ids, one psum over "sp" combines the read-axis partials (the only
+# collective in the hot path), and the epilogue + wire packing run per dp
+# shard. Outputs concatenate over "dp" to the (dp * F_loc, ...) global
+# layout; the host's gather index (mesh_gather) restores family order at
+# resolve time. A 1-device mesh never reaches these: the callers fall back
+# to the single-device jit path (SNIPPETS [3]'s mesh-size-aware compile).
+# ---------------------------------------------------------------------------
+
+def _wire_mesh_local(wire, seg_ids, dict_tab, ln_error_pre_umi, num_local):
+    """Per-shard body of the mesh wire kernels: local segment reduction,
+    sp psum combine, shared epilogue. Returns the epilogue tuple + obs."""
+    one_hot, delta = _wire_terms(wire, dict_tab)
+    row_contrib = delta[..., None] * one_hot
+    contrib = jax.ops.segment_sum(row_contrib, seg_ids,
+                                  num_segments=num_local,
+                                  indices_are_sorted=True)
+    obs = jax.ops.segment_sum(one_hot, seg_ids, num_segments=num_local,
+                              indices_are_sorted=True)
+    contrib = jax.lax.psum(contrib, "sp")
+    obs = jax.lax.psum(obs, "sp").astype(jnp.int32)
+    return _call_epilogue(contrib, obs, ln_error_pre_umi) + (obs,)
+
+
+@_lazy_jit(static_argnames=("num_local", "mesh", "full"))
+def _consensus_segments_wire_mesh_jit(wire, seg_ids, dict_tab,
+                                      ln_error_pre_umi, num_local, mesh,
+                                      full):
+    """Mesh variant of _consensus_segments_wire_{jit,full_jit}.
+
+    wire/seg_ids: (dp * sp * N_chunk, L) / (dp * sp * N_chunk,) in the
+    chunked global layout (pad_segments_mesh), row axis sharded over every
+    mesh axis. Returns (dp * F_loc, ...) outputs sharded along dp."""
+    from jax.sharding import PartitionSpec as P
+
+    rows = P(mesh.axis_names)
+    out = P("dp")
+
+    def local(w, s):
+        winner, qual, depth, errors, suspect, _obs = _wire_mesh_local(
+            w, s, dict_tab, ln_error_pre_umi, num_local)
+        qs, wp = _pack_result_split(winner, qual, suspect, num_local)
+        if full:
+            return (qs, wp, depth.astype(jnp.uint16),
+                    errors.astype(jnp.uint16))
+        return qs, wp
+
+    mapped = shard_map_compat(local, mesh=mesh, in_specs=(rows, rows),
+                              out_specs=(out,) * (4 if full else 2))
+    return mapped(wire, seg_ids)
+
+
+@_lazy_jit(static_argnames=("num_local", "mesh"))
+def _consensus_segments_wire_resident_mesh_jit(wire, seg_ids, dict_tab,
+                                               ln_error_pre_umi, min_reads,
+                                               min_qual, num_local, mesh):
+    """Mesh variant of the resident wire kernel: full-column outputs plus
+    device-resident thresholded (tb, tq) + per-lane obs, all sharded along
+    dp in the shard-ordered (dp * F_loc, ...) layout. The fused duplex
+    combine consumes the resident arrays through the ordinary jit
+    (_duplex_combine_jit) — XLA partitions its gathers over the mesh, the
+    pjit-style half of the compile path (SNIPPETS [1]/[3])."""
+    from jax.sharding import PartitionSpec as P
+
+    rows = P(mesh.axis_names)
+    out = P("dp")
+
+    def local(w, s):
+        winner, qual, depth, errors, suspect, obs = _wire_mesh_local(
+            w, s, dict_tab, ln_error_pre_umi, num_local)
+        qs, wp = _pack_result_split(winner, qual, suspect, num_local)
+        low_depth = depth < min_reads
+        low_qual = qual < min_qual
+        tb = jnp.where(low_depth | low_qual, N_CODE,
+                       winner).astype(jnp.uint8)
+        tq = jnp.where(low_depth, 0,
+                       jnp.where(low_qual, MIN_PHRED,
+                                 qual)).astype(jnp.uint8)
+        return (qs, wp, depth.astype(jnp.uint16),
+                errors.astype(jnp.uint16), tb, tq, obs)
+
+    mapped = shard_map_compat(local, mesh=mesh, in_specs=(rows, rows),
+                              out_specs=(out,) * 7)
+    return mapped(wire, seg_ids)
+
+
+@_lazy_jit(static_argnames=("num_local", "mesh", "full"))
+def _consensus_segments_packed2_mesh_jit(codes_packed, quals, seg_ids,
+                                         correct_tab, err_tab,
+                                         ln_error_pre_umi, num_local, mesh,
+                                         full):
+    """Mesh variant of the 1.25 B/position >63-distinct-quals fallback
+    (_consensus_segments_packed2_{jit,full_jit}): same chunked row layout,
+    2-bit packed codes + sentinel quals sharded over every mesh axis."""
+    from jax.sharding import PartitionSpec as P
+
+    rows = P(mesh.axis_names)
+    out = P("dp")
+
+    def local(cp, q, s):
+        one_hot, delta = _packed2_terms(cp, q, correct_tab, err_tab)
+        row_contrib = delta[..., None] * one_hot
+        contrib = jax.ops.segment_sum(row_contrib, s,
+                                      num_segments=num_local,
+                                      indices_are_sorted=True)
+        obs = jax.ops.segment_sum(one_hot, s, num_segments=num_local,
+                                  indices_are_sorted=True)
+        contrib = jax.lax.psum(contrib, "sp")
+        obs = jax.lax.psum(obs, "sp").astype(jnp.int32)
+        winner, qual, depth, errors, suspect = _call_epilogue(
+            contrib, obs, ln_error_pre_umi)
+        qs, wp = _pack_result_split(winner, qual, suspect, num_local)
+        if full:
+            return (qs, wp, depth.astype(jnp.uint16),
+                    errors.astype(jnp.uint16))
+        return qs, wp
+
+    mapped = shard_map_compat(local, mesh=mesh,
+                              in_specs=(rows, rows, rows),
+                              out_specs=(out,) * (4 if full else 2))
+    return mapped(codes_packed, quals, seg_ids)
+
+
 @_lazy_jit
 def _consensus_batch_packed_jit(codes, quals, correct_tab, err_tab,
                                 ln_error_pre_umi):
@@ -1979,6 +2157,76 @@ def pad_segments_gather(codes: np.ndarray, quals: np.ndarray,
     seg_ids = np.full(N_pad, max(J - 1, 0), dtype=np.int32)
     seg_ids[:N] = np.repeat(np.arange(J, dtype=np.int32), counts)
     return codes_dev, quals_dev, seg_ids, starts, F_pad, N
+
+
+def pad_segments_mesh(codes2d: np.ndarray, quals2d: np.ndarray,
+                      counts: np.ndarray, mesh):
+    """Chunked global row layout for the shard_map wire kernels.
+
+    Splits the J families into dp contiguous shards (row-balanced where
+    that stays within the per-shard segment bucket, equal-count otherwise),
+    splits each shard's rows into sp contiguous chunks, and pads every
+    chunk to a common ladder-bucketed N_chunk — so the global
+    (dp * sp * N_chunk, L) array shards evenly over the mesh with
+    ``PartitionSpec(mesh.axis_names)`` and every ``jax.device_put`` lands
+    one slice per device (the overlapping per-shard upload, ISSUE 10 (b)).
+    Segment ids are LOCAL to each dp shard (0..F_loc-1, sorted within
+    every chunk; pad rows carry their chunk's last real id — all-N no-ops,
+    the pad_segments invariant). The family axis rounds to dp * F_loc with
+    F_loc from the same 8-aligned segment ladder as the single-device
+    path, one shape vocabulary across mesh sizes.
+
+    Returns (codes_g, quals_g, seg_g, starts, F_loc, gather) where
+    ``gather[j]`` is family j's row in the (dp * F_loc, ...) shard-ordered
+    device output (resolve_segments_wire applies it).
+    """
+    from ..consensus.fast import split_row_balanced
+
+    counts = np.asarray(counts, dtype=np.int64)
+    starts = np.concatenate(([0], np.cumsum(counts)))
+    J = len(counts)
+    N = int(starts[-1])
+    dp = int(mesh.shape["dp"])
+    sp = int(dict(mesh.shape).get("sp", 1))
+    L = codes2d.shape[1]
+    F_loc = SHAPE_REGISTRY.bucket_segments_sharded(J, dp)
+    jb = split_row_balanced(counts, dp) if J else np.zeros(dp + 1, np.int64)
+    if J and int(np.diff(jb).max()) > F_loc:
+        # a row-balanced split that overflows the per-shard segment bucket
+        # (deep-family skew) falls back to equal family counts: the static
+        # shape stays a function of (J, dp) only, never of the skew
+        per = -(-J // dp)
+        jb = np.minimum(np.arange(dp + 1, dtype=np.int64) * per, J)
+    n_rows = starts[jb[1:]] - starts[jb[:-1]]
+    chunk = -(-np.maximum(n_rows, 1) // sp)
+    N_chunk = _pad_rows(int(chunk.max()) if J else 1)
+    codes_g = np.full((dp * sp * N_chunk, L), N_CODE, dtype=np.uint8)
+    quals_g = np.zeros((dp * sp * N_chunk, L), dtype=np.uint8)
+    seg_g = np.zeros(dp * sp * N_chunk, dtype=np.int32)
+    gather = np.zeros(J, dtype=np.int64)
+    for d in range(dp):
+        lo_j, hi_j = int(jb[d]), int(jb[d + 1])
+        if hi_j <= lo_j:
+            continue
+        base = int(starts[lo_j])
+        n = int(starts[hi_j]) - base
+        seg_local = np.repeat(
+            np.arange(hi_j - lo_j, dtype=np.int32),
+            counts[lo_j:hi_j])
+        c = int(chunk[d])
+        for s in range(sp):
+            lo = min(s * c, n)
+            hi = min(lo + c, n)
+            m = hi - lo
+            row0 = (d * sp + s) * N_chunk
+            if m:
+                codes_g[row0:row0 + m] = codes2d[base + lo:base + hi]
+                quals_g[row0:row0 + m] = quals2d[base + lo:base + hi]
+                seg_g[row0:row0 + m] = seg_local[lo:hi]
+                seg_g[row0 + m:row0 + N_chunk] = seg_local[hi - 1]
+        gather[lo_j:hi_j] = d * F_loc + np.arange(hi_j - lo_j)
+    DEVICE_STATS.add_pad(N, dp * sp * N_chunk)
+    return codes_g, quals_g, seg_g, starts, F_loc, gather
 
 
 def _unpack_device_result(packed: np.ndarray):
@@ -2266,7 +2514,8 @@ class ConsensusKernel:
                                   seg_ids, num_segments: int, J: int,
                                   pack_t0: float = None, full: bool = False,
                                   resident_thresholds=None,
-                                  pred_s: float = None):
+                                  pred_s: float = None, mesh=None,
+                                  mesh_gather=None):
         """Async wire-format dispatch via the feeder pipeline.
 
         codes2d_padded/quals2d_padded: the full padded (N_pad, L) row layout
@@ -2290,8 +2539,24 @@ class ConsensusKernel:
         thresholded (tb, tq) + per-lane obs device-resident for the fused
         duplex combine stage (wire layout only; the rare >63-qual fallback
         ignores it and the combine runs on host). ``pred_s``: the cost
-        model's predicted dispatch seconds, stamped into the timeline."""
+        model's predicted dispatch seconds, stamped into the timeline.
+
+        ``mesh``: a live jax Mesh with > 1 device selects the shard_map
+        compile path — the inputs must be in pad_segments_mesh's chunked
+        layout with ``num_segments`` the PER-SHARD F_loc and
+        ``mesh_gather`` its family-order gather; uploads go through
+        ``jax.device_put(..., NamedSharding)`` so every device's slice
+        copies concurrently, and the device output is the shard-ordered
+        (dp * F_loc, ...) global that resolve_segments_wire re-gathers.
+        A 1-device (or None) mesh is exactly the legacy single-device
+        path — bit-for-bit, including the compiled executables."""
         t_pack0 = pack_t0 if pack_t0 is not None else time.monotonic()
+        mesh_active = mesh is not None and mesh.size > 1
+        if mesh_active:
+            return self._dispatch_wire_mesh(
+                codes2d_padded, quals2d_padded, seg_ids, num_segments, J,
+                t_pack0, full, resident_thresholds, pred_s, mesh,
+                mesh_gather)
         out_segments = _pad_out_segments(J, num_segments)
         w = build_wire(codes2d_padded, quals2d_padded, self._delta94)
         pre = self._pre
@@ -2355,6 +2620,84 @@ class ConsensusKernel:
                 lambda: device_retry_call(lambda: _dispatch(slot),
                                           "wire dispatch"),
                 upload_bytes=upload, slot=slot)
+        return ticket
+
+    def _dispatch_wire_mesh(self, codes_g, quals_g, seg_g, F_loc: int,
+                            J: int, t_pack0: float, full: bool,
+                            resident_thresholds, pred_s, mesh, mesh_gather):
+        """The mesh half of device_call_segments_wire: NamedSharding
+        uploads + the shard_map wire kernels (see the caller's docstring).
+        Split out so the single-device fast path stays exactly the legacy
+        code path when no mesh is configured."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rows_sh = NamedSharding(mesh, P(mesh.axis_names))
+        repl_sh = NamedSharding(mesh, P())
+        dp = int(mesh.shape["dp"])
+        sp = int(dict(mesh.shape).get("sp", 1))
+        pre = self._pre
+        w = build_wire(codes_g, quals_g, self._delta94)
+        if w is not None:
+            wire, dict32 = w
+            upload = wire.nbytes + seg_g.nbytes
+            resident = resident_thresholds is not None
+            kind = "segwrm" if resident else ("segwfm" if full else "segwm")
+            new = SHAPE_REGISTRY.observe(
+                kind, wire.shape[0], wire.shape[1], F_loc, dp, sp)
+            if resident:
+                mr, mq = (np.int32(resident_thresholds[0]),
+                          np.int32(resident_thresholds[1]))
+
+            def _dispatch(slot):
+                _ensure_jax()
+                t0 = time.monotonic()
+                wd = jax.device_put(wire, rows_sh)
+                sd = jax.device_put(seg_g, rows_sh)
+                dtab = CONST_CACHE.put("dict_tab", dict32,
+                                       sharding=repl_sh)
+                DEVICE_STATS.note_upload(slot, time.monotonic() - t0)
+                if resident:
+                    out = _consensus_segments_wire_resident_mesh_jit(
+                        wd, sd, dtab, pre, mr, mq, F_loc, mesh)
+                    return out[:4] + (ResidentHandles(out[4:]),)
+                return _consensus_segments_wire_mesh_jit(
+                    wd, sd, dtab, pre, F_loc, mesh, full)
+        else:
+            cp, qsent = pack_codes2(codes_g, quals_g)
+            upload = cp.nbytes + qsent.nbytes + seg_g.nbytes
+            tables_dev = self._tables_dev
+            new = SHAPE_REGISTRY.observe(
+                "segp2fm" if full else "segp2m", cp.shape[0], cp.shape[1],
+                F_loc, dp, sp)
+
+            def _dispatch(slot):
+                _ensure_jax()
+                t0 = time.monotonic()
+                cd = jax.device_put(cp, rows_sh)
+                qd = jax.device_put(qsent, rows_sh)
+                sd = jax.device_put(seg_g, rows_sh)
+                ct = CONST_CACHE.put("correct_tab", self._correct_f32,
+                                     sharding=repl_sh)
+                et = CONST_CACHE.put("err_tab", self._err_f32,
+                                     sharding=repl_sh)
+                DEVICE_STATS.note_upload(slot, time.monotonic() - t0)
+                return _consensus_segments_packed2_mesh_jit(
+                    cd, qd, sd, ct, et, pre, F_loc, mesh, full)
+        DEVICE_STATS.add_dispatch(segments_flops(
+            codes_g.shape[0], codes_g.shape[1], dp * F_loc))
+        slot = DEVICE_STATS.begin_in_flight(
+            upload, pack_s=time.monotonic() - t_pack0)
+        DEVICE_STATS.note_mesh(slot, mesh.size, upload // mesh.size,
+                               2 if sp > 1 else 0)
+        if pred_s is not None:
+            DEVICE_STATS.note_pred(slot, pred_s)
+        with SHAPE_REGISTRY.attribute_compiles(new):
+            ticket = DEVICE_FEEDER.submit(
+                lambda: device_retry_call(lambda: _dispatch(slot),
+                                          "mesh wire dispatch"),
+                upload_bytes=upload, slot=slot)
+        ticket.mesh_gather = mesh_gather
+        ticket.mesh_devices = mesh.size
         return ticket
 
     def resolve_segments_wire(self, ticket, codes2d: np.ndarray,
@@ -2422,7 +2765,8 @@ class ConsensusKernel:
                 out = self._recover_segments(failure, codes2d, quals2d,
                                              starts, _split_depth)
             if want_extras:
-                return out + ({"suspect": None, "resident": None},)
+                return out + ({"suspect": None, "resident": None,
+                               "gather": None},)
             return out
         from .breaker import BREAKER
 
@@ -2442,7 +2786,8 @@ class ConsensusKernel:
             # separately by decide()'s in_flight term, so it must not be
             # folded in here
             ROUTER.observe_device(ticket.upload_bytes, fetched, up_s,
-                                  wait_s, up_s + wait_s)
+                                  wait_s, up_s + wait_s,
+                                  devices=ticket.mesh_devices)
         J = len(starts) - 1
         if J == 0:
             L = qs.shape[-1]
@@ -2450,8 +2795,21 @@ class ConsensusKernel:
             out = (z.astype(np.uint8), z.astype(np.uint8),
                    z.astype(np.int64), z.astype(np.int64))
             if want_extras:
-                return out + ({"suspect": None, "resident": resident},)
+                return out + ({"suspect": None, "resident": resident,
+                               "gather": None},)
             return out
+        gather = ticket.mesh_gather
+        if gather is not None:
+            # mesh dispatch: the fetched global arrays are shard-ordered
+            # (dp * F_loc rows); one host gather restores family order.
+            # The resident handles stay shard-ordered ON DEVICE — the
+            # duplex combine maps its indices through ``gather`` instead
+            # of paying a device-side re-shuffle.
+            qs = qs[gather]
+            wp = wp[gather]
+            if d16 is not None:
+                d16 = d16[gather]
+                e16 = e16[gather]
         winner, qual, suspect = unpack_result_split(qs, wp, J)
         if d16 is not None:
             # full-column dispatch: the device already counted depth/errors
@@ -2490,7 +2848,8 @@ class ConsensusKernel:
                            quals2d[starts[f]:starts[f + 1]]))
         if want_extras:
             return winner, qual, depth, errors, {"suspect": suspect,
-                                                 "resident": resident}
+                                                 "resident": resident,
+                                                 "gather": gather}
         return winner, qual, depth, errors
 
     def _recover_segments(self, exc, codes2d: np.ndarray,
@@ -2819,7 +3178,11 @@ class ConsensusKernel:
 
     def device_call_segments_sharded(self, codes3d, quals3d, seg_ids2d,
                                      num_segments: int, mesh):
-        """Dispatch (dp, N, L) rows, one contiguous family shard per device."""
+        """Dispatch (dp, N, L) rows, one contiguous family shard per device.
+
+        Dryrun/test surface (``__graft_entry__.dryrun_multichip``,
+        tests/test_mesh.py): production traffic routes through the wire
+        mesh path (:meth:`_dispatch_wire_mesh`) instead."""
         dp, N, L = codes3d.shape
         DEVICE_STATS.add_dispatch(segments_flops(dp * N, L, dp * num_segments))
         SHAPE_REGISTRY.observe("shard", dp, N, L, num_segments)
@@ -2831,7 +3194,10 @@ class ConsensusKernel:
     def device_call_segments_dp_sp(self, codes4, quals4, seg3,
                                    num_segments: int, mesh):
         """Dispatch (dp, sp, N, L) rows: family shards over dp, each shard's
-        read rows over sp with a psum combine."""
+        read rows over sp with a psum combine.
+
+        Dryrun/test surface like :meth:`device_call_segments_sharded`;
+        production traffic uses the wire mesh path."""
         dp, sp, N, L = codes4.shape
         DEVICE_STATS.add_dispatch(segments_flops(dp * sp * N, L,
                                                  dp * num_segments))
@@ -2971,33 +3337,49 @@ class ConsensusKernel:
 
 
 def route_and_call_segments(kernel: "ConsensusKernel", codes2d, quals2d,
-                            counts, starts):
+                            counts, starts, mesh=None):
     """Route one dense (N, L) segment batch through the adaptive offload
     policy and resolve it synchronously: the host f64 engine, the round-5
     hard-column export (FGUMI_TPU_DEVICE_PATH=columns), or the full-column
-    wire kernel (default device route). The one shared implementation of
-    the decide -> dispatch -> resolve sequence for the synchronous callers
-    (fast_codec, the classic vanilla path); the async engines (simplex
-    pending chunks, duplex defer/resident) keep their specialized flows
-    but share ROUTER.decide_batch and the same dispatch entry points."""
+    wire kernel (default device route; sharded over ``mesh`` when one with
+    > 1 device is passed). The one shared implementation of the decide ->
+    dispatch -> resolve sequence for the synchronous callers (fast_codec,
+    the classic vanilla path); the async engines (simplex pending chunks,
+    duplex defer/resident) keep their specialized flows but share
+    ROUTER.decide_batch and the same dispatch entry points."""
     from .router import ROUTER
 
+    mesh_active = mesh is not None and mesh.size > 1
     route = "host"
     if not kernel.host_mode():
         route = ROUTER.decide_batch(kernel, codes2d.shape[0], len(counts),
-                                    codes2d.shape[1])
+                                    codes2d.shape[1],
+                                    devices=mesh.size if mesh_active else 1)
     if route == "host":
         return kernel.resolve_segments(HOST_DISPATCH, codes2d, quals2d,
                                        starts)
     if device_path() == "columns":
+        # the round-5 comparison route is single-device by design (the
+        # compact hard-column stream defeats the point of sharding); an
+        # explicit FGUMI_TPU_DEVICE_PATH=columns wins over the mesh
         pending = kernel.dispatch_hard_columns(codes2d, quals2d, starts)
         return kernel.resolve_hard_columns(pending)
     t_pack0 = time.monotonic()
-    cd, qd, seg_ids, _sp, f_pad = pad_segments(codes2d, quals2d, counts)
     pred = ROUTER.last_prediction()
+    full = bool(np.max(counts) < 65536)
+    if mesh_active:
+        cg, qg, seg_g, _st, f_loc, gather = pad_segments_mesh(
+            codes2d, quals2d, counts, mesh)
+        ticket = kernel.device_call_segments_wire(
+            cg, qg, seg_g, f_loc, len(counts), pack_t0=t_pack0, full=full,
+            pred_s=pred[0] if pred else None, mesh=mesh,
+            mesh_gather=gather)
+        return kernel.resolve_segments_wire(ticket, codes2d, quals2d,
+                                            starts)
+    cd, qd, seg_ids, _sp, f_pad = pad_segments(codes2d, quals2d, counts)
     ticket = kernel.device_call_segments_wire(
         cd, qd, seg_ids, f_pad, len(counts), pack_t0=t_pack0,
-        full=bool(np.max(counts) < 65536),
+        full=full,
         pred_s=pred[0] if pred else None)
     return kernel.resolve_segments_wire(ticket, codes2d, quals2d, starts)
 
@@ -3047,16 +3429,23 @@ def duplex_combine_device(resident: "ResidentHandles", a_idx, b_idx, lens):
     return out_b[:K], out_q[:K], out_e[:K]
 
 
-def codec_combine_device(ba, bb, qa, qb, da, db, ea, eb):
+def codec_combine_device(ba, bb, qa, qb, da, db, ea, eb, mesh=None):
     """CODEC concordance combine as a device dispatch.
 
     Same contract as consensus/codec.combine_arrays over the batch
     engine's concatenated 1-D position arrays (int32-capped inputs);
     integer-exact vs the numpy version. Raises on device failure — the
-    caller falls back to the host combine."""
+    caller falls back to the host combine. With a > 1-device ``mesh`` the
+    position axis shards over it: aligned padding keeps the global shape
+    evenly divisible, the eight operands upload as NamedSharding slices,
+    and the elementwise shard_map variant runs collective-free."""
+    import math
+
     T = len(ba)
-    T_pad = SHAPE_REGISTRY.bucket(T, 16)
-    T_out = _pad_out_segments(T, T_pad)
+    mesh_active = mesh is not None and mesh.size > 1
+    align = math.lcm(16, mesh.size) if mesh_active else 16
+    T_pad = SHAPE_REGISTRY.bucket(T, align)
+    T_out = T_pad if mesh_active else _pad_out_segments(T, T_pad)
 
     def pad(a, dtype):
         out = np.zeros(T_pad, dtype=dtype)
@@ -3066,13 +3455,25 @@ def codec_combine_device(ba, bb, qa, qb, da, db, ea, eb):
     ops = (pad(ba, np.uint8), pad(bb, np.uint8), pad(qa, np.uint8),
            pad(qb, np.uint8), pad(da, np.int32), pad(db, np.int32),
            pad(ea, np.int32), pad(eb, np.int32))
-    new = SHAPE_REGISTRY.observe("codeccomb", T_pad, T_out)
+    if mesh_active:
+        new = SHAPE_REGISTRY.observe("codeccombm", T_pad, mesh.size)
+    else:
+        new = SHAPE_REGISTRY.observe("codeccomb", T_pad, T_out)
     DEVICE_STATS.add_dispatch(T_pad * 40)
     slot = DEVICE_STATS.begin_in_flight(sum(o.nbytes for o in ops))
+    if mesh_active:
+        DEVICE_STATS.note_mesh(slot, mesh.size,
+                               sum(o.nbytes for o in ops) // mesh.size, 0)
     t0 = time.monotonic()
     try:
         def _dispatch():
             _ensure_jax()
+            if mesh_active:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                sh = NamedSharding(mesh, P(mesh.axis_names))
+                dev_ops = tuple(jax.device_put(o, sh) for o in ops)
+                return _codec_combine_mesh_jit(*dev_ops, mesh)
             return _codec_combine_jit(*ops, T_out)
 
         with SHAPE_REGISTRY.attribute_compiles(new):

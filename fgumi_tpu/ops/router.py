@@ -99,18 +99,36 @@ class OffloadRouter:
 
     def reset(self):
         with self._lock:
-            self._link_bps = _Ewma()       # upload bytes/s (device_put wall)
-            self._overhead_s = _Ewma()     # per-dispatch non-byte-scaling s
-            self._dispatch_wall_s = _Ewma()  # per-dispatch service time
-            self._host_cps = _Ewma()       # host engine cells/s
+            # device-side EWMAs are PER MESH SIZE (ISSUE 10 (c)): an N-chip
+            # mesh has its own link rate (N overlapping upload slices), its
+            # own per-dispatch overhead (shard_map relay + collectives),
+            # and its own service wall — pricing a dp4 dispatch with the
+            # 1-device EWMAs would mis-place the host/device crossover in
+            # exactly the configs the mesh exists for. Keyed by device
+            # count; entry 1 is the classic single-device model.
+            self._mesh = {1: self._new_mesh_ewmas()}
+            self._host_cps = _Ewma()       # host engine cells/s (shared)
             self._streak_side = None
             self._streak = 0
             self._last = {}                # last decision detail (snapshot)
 
+    @staticmethod
+    def _new_mesh_ewmas():
+        return {"link_bps": _Ewma(), "overhead_s": _Ewma(),
+                "dispatch_wall_s": _Ewma()}
+
+    def _mesh_ewmas(self, devices: int):
+        """The EWMA triple for one mesh size (caller holds the lock)."""
+        e = self._mesh.get(devices)
+        if e is None:
+            e = self._mesh[devices] = self._new_mesh_ewmas()
+        return e
+
     # ------------------------------------------------------------ feeding
 
     def observe_device(self, up_bytes: int, down_bytes: int,
-                       upload_s: float, other_s: float, service_s: float):
+                       upload_s: float, other_s: float, service_s: float,
+                       devices: int = 1):
         """One resolved device dispatch. ``other_s`` is the non-upload,
         non-queue remainder (host fetch wait in practice); the download
         time it contains is netted out against the link estimate before
@@ -120,17 +138,19 @@ class OffloadRouter:
         crossover. ``service_s`` is the dispatch's serial occupancy of the
         feeder+link (upload + fetch wait), NOT including queue wait —
         decide() multiplies it by the in-flight count for the queue-delay
-        term, so queue time must not be baked in twice."""
+        term, so queue time must not be baked in twice. ``devices``: the
+        mesh size this dispatch ran on (its own EWMA set)."""
         with self._lock:
+            e = self._mesh_ewmas(int(devices) if devices else 1)
             if upload_s > 1e-6 and up_bytes > 0:
-                self._link_bps.add(up_bytes / upload_s)
-            link = self._link_bps.value
+                e["link_bps"].add(up_bytes / upload_s)
+            link = e["link_bps"].value
             if other_s >= 0:
                 if link and down_bytes > 0:
                     other_s = max(other_s - down_bytes / link, 0.0)
-                self._overhead_s.add(other_s)
+                e["overhead_s"].add(other_s)
             if service_s > 0:
-                self._dispatch_wall_s.add(service_s)
+                e["dispatch_wall_s"].add(service_s)
 
     def observe_host(self, cells: int, seconds: float):
         """One host-engine batch (cells = rows * positions of the pileup)."""
@@ -149,17 +169,21 @@ class OffloadRouter:
             return DEFAULT_PROBE
 
     def decide_batch(self, kernel, n_rows: int, n_segments: int,
-                     L: int) -> str:
+                     L: int, devices: int = 1) -> str:
         """Route one consensus batch from its shape — the one place that
         knows the wire-path economics: upload is 1 B/position of dense rows
         plus 4 B/row of segment ids; the full-column fetch is 5.25 B/column
         (qual|suspect byte + 2-bit winner + uint16 depth + uint16 errors);
-        host cost scales with the pileup cells (rows x positions)."""
+        host cost scales with the pileup cells (rows x positions).
+        ``devices``: the mesh size a device route would dispatch on —
+        selects that mesh's EWMA set so auto-routing stays correct when
+        the device side is N chips."""
         return self.decide(kernel, n_rows * L + 4 * n_rows,
-                           (21 * n_segments * L) // 4, n_rows * L)
+                           (21 * n_segments * L) // 4, n_rows * L,
+                           devices=devices)
 
     def decide(self, kernel, up_bytes: int, down_bytes: int,
-               cells: int) -> str:
+               cells: int, devices: int = 1) -> str:
         """Route one batch: ``"device"`` or ``"host"``.
 
         ``kernel`` supplies the mode gates (hybrid/native availability);
@@ -217,12 +241,23 @@ class OffloadRouter:
             return self._stamp(side, why="max-inflight")
 
         with self._lock:
-            link = self._link_bps.get(self.PRIOR_LINK_BPS)
-            overhead = self._overhead_s.get(self.PRIOR_OVERHEAD_S)
+            e = self._mesh_ewmas(int(devices) if devices else 1)
+            # an unmeasured mesh size borrows the 1-device EWMAs as its
+            # prior (the link hardware is shared; only the measured
+            # sharded behavior can correct it) before the static priors
+            base = self._mesh[1]
+            link = e["link_bps"].get(
+                base["link_bps"].get(self.PRIOR_LINK_BPS))
+            overhead = e["overhead_s"].get(
+                base["overhead_s"].get(self.PRIOR_OVERHEAD_S))
             host_cps = self._host_cps.get(self.PRIOR_HOST_CELLS_PER_S)
-            wall = self._dispatch_wall_s.get(overhead)
+            wall = e["dispatch_wall_s"].get(overhead)
             host_samples = self._host_cps.samples
-            dev_samples = self._overhead_s.samples
+            # on the default 1-device path e IS base — summing would
+            # double-count and fire the probe-unmeasured branch a batch
+            # early (legacy-behavior regression)
+            dev_samples = e["overhead_s"].samples + \
+                (base["overhead_s"].samples if e is not base else 0)
         in_flight = DEVICE_STATS.in_flight_count()
         t_dev = (up_bytes + down_bytes) / link + overhead + in_flight * wall
         t_host = cells / host_cps
@@ -283,14 +318,30 @@ class OffloadRouter:
     def snapshot(self):
         """Cost-model state for run reports / bench stamps."""
         with self._lock:
+            base = self._mesh[1]
             out = {
-                "link_mbps": round(self._link_bps.get(0.0) / 1e6, 3),
-                "link_samples": self._link_bps.samples,
-                "overhead_s": round(self._overhead_s.get(0.0), 5),
-                "dispatch_wall_s": round(self._dispatch_wall_s.get(0.0), 5),
+                "link_mbps": round(base["link_bps"].get(0.0) / 1e6, 3),
+                "link_samples": base["link_bps"].samples,
+                "overhead_s": round(base["overhead_s"].get(0.0), 5),
+                "dispatch_wall_s": round(
+                    base["dispatch_wall_s"].get(0.0), 5),
                 "host_mcells_per_s": round(self._host_cps.get(0.0) / 1e6, 3),
                 "host_samples": self._host_cps.samples,
             }
+            mesh_out = {}
+            for n, e in sorted(self._mesh.items()):
+                if n == 1 or not (e["link_bps"].samples
+                                  or e["overhead_s"].samples):
+                    continue
+                mesh_out[str(n)] = {
+                    "link_mbps": round(e["link_bps"].get(0.0) / 1e6, 3),
+                    "link_samples": e["link_bps"].samples,
+                    "overhead_s": round(e["overhead_s"].get(0.0), 5),
+                    "dispatch_wall_s": round(
+                        e["dispatch_wall_s"].get(0.0), 5),
+                }
+            if mesh_out:
+                out["mesh"] = mesh_out
             if self._last:
                 out["last_decision"] = dict(self._last)
             return out

@@ -1,0 +1,12 @@
+"""Multi-chip / multi-host execution (mesh.py, distributed.py).
+
+Only :class:`MeshConfigError` lives at package level: mesh.py imports jax
+at module scope, and the CLI's top-level exception contract must be able
+to name the error class without paying the jax import on host-only runs.
+"""
+
+
+class MeshConfigError(ValueError):
+    """A mesh specification that cannot be satisfied (malformed spec or a
+    shape that does not match the live device count). CLI commands map it
+    to exit 2 with the message as the one-line diagnostic."""

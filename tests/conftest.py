@@ -51,6 +51,18 @@ def _reset_resource_governor():
 
 
 @pytest.fixture(autouse=True)
+def _reset_mesh_snapshot():
+    """publish_mesh (parallel/mesh.py) records the active mesh in a
+    process-global snapshot the run report and flight dumps read; any test
+    whose CLI run builds a mesh (--devices auto sees the 8 virtual
+    devices) must not leak it into later report-shape tests. Lazy."""
+    yield
+    mod = sys.modules.get("fgumi_tpu.parallel.mesh")
+    if mod is not None:
+        mod.LAST_MESH_SNAPSHOT = None
+
+
+@pytest.fixture(autouse=True)
 def _reset_flight_recorder():
     """The flight recorder (observe/flight.py) is process-global and
     dedupes dumps per reason — a test that triggers a dump must not

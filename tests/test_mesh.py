@@ -169,6 +169,206 @@ def test_segments_dp_sp_matches_single_device(tables, dp, sp):
             assert np.array_equal(a, b), f
 
 
+# ---------------------------------------------------------------------------
+# Production mesh compile path (ISSUE 10): the shard_map-wrapped wire kernels
+# + pad_segments_mesh + FGUMI_TPU_MESH surface. Byte-identity vs the
+# single-device wire path is the oracle throughout.
+
+
+def test_parse_mesh_spec():
+    from fgumi_tpu.parallel.mesh import MeshConfigError, parse_mesh_spec
+
+    assert parse_mesh_spec(None) is None
+    assert parse_mesh_spec("off") is None
+    assert parse_mesh_spec("0") is None
+    assert parse_mesh_spec("auto") == "auto"
+    assert parse_mesh_spec("dp4xsp2") == (4, 2)
+    assert parse_mesh_spec("DP8") == (8, 1)
+    for bad in ("banana", "dpxsp2", "sp2", "dp-1", "dp2xsp"):
+        with pytest.raises(MeshConfigError):
+            parse_mesh_spec(bad)
+
+
+def test_resolve_mesh_validates_device_count():
+    from fgumi_tpu.parallel.mesh import MeshConfigError, resolve_mesh
+
+    devs = jax.devices()
+    with pytest.raises(MeshConfigError):
+        resolve_mesh(devs, (len(devs) + 1, 2))
+    assert resolve_mesh(devs, None) is None
+    assert resolve_mesh(devs, (1, 1)) is None  # 1-device mesh = legacy path
+    m = resolve_mesh(devs, "auto")
+    assert m is not None and m.size == len(devs)
+
+
+def test_bucket_segments_sharded_one_vocabulary():
+    from fgumi_tpu.ops.datapath import SHAPE_REGISTRY
+
+    # per-shard counts come from the same 8-aligned ladder as the
+    # single-device bucket, so dp*F_loc is a multiple of dp and the static
+    # shard shapes are shared across mesh sizes that land on one rung
+    for j, dp in ((37, 4), (100, 8), (7, 2), (1, 8)):
+        f_loc = SHAPE_REGISTRY.bucket_segments_sharded(j, dp)
+        assert f_loc * dp >= j
+        assert f_loc == SHAPE_REGISTRY.bucket_segments(-(-j // dp))
+
+
+def test_pad_segments_mesh_layout(tables):
+    from fgumi_tpu.ops.kernel import pad_segments_mesh
+
+    mesh = make_mesh(jax.devices()[:8], dp=4, sp=2)
+    codes, quals, counts, starts = _ragged(17, n_fam=23, L=24)
+    cg, qg, sg, st, f_loc, gather = pad_segments_mesh(codes, quals,
+                                                      counts, mesh)
+    assert cg.shape[0] % 8 == 0  # divisible over every mesh axis
+    assert np.array_equal(st, starts)
+    assert len(gather) == len(counts)
+    assert gather.max() < 4 * f_loc
+    # every real row landed somewhere with its bytes intact: count real
+    # (non-pad) rows by code sentinel
+    assert int((cg != 4).any(axis=1).sum()) <= codes.shape[0]
+
+
+def _wire_ref(kernel, codes, quals, counts, starts):
+    from fgumi_tpu.ops.kernel import pad_segments
+
+    cd, qd, seg, _st, F_pad = pad_segments(codes, quals, counts)
+    t = kernel.device_call_segments_wire(cd, qd, seg, F_pad, len(counts),
+                                         full=True)
+    return kernel.resolve_segments_wire(t, codes, quals, starts)
+
+
+@pytest.mark.parametrize("dp,sp", [(4, 2), (8, 1), (2, 4)])
+def test_mesh_wire_byte_identity(tables, dp, sp):
+    from fgumi_tpu.ops.kernel import pad_segments_mesh
+
+    kernel = ConsensusKernel(tables)
+    kernel.set_force_device()
+    codes, quals, counts, starts = _ragged(29, n_fam=53, L=32)
+    ref = _wire_ref(kernel, codes, quals, counts, starts)
+    mesh = make_mesh(jax.devices()[:dp * sp], dp=dp, sp=sp)
+    cg, qg, sg, _st, f_loc, gather = pad_segments_mesh(codes, quals,
+                                                       counts, mesh)
+    t = kernel.device_call_segments_wire(cg, qg, sg, f_loc, len(counts),
+                                         full=True, mesh=mesh,
+                                         mesh_gather=gather)
+    got = kernel.resolve_segments_wire(t, codes, quals, starts)
+    for i in range(4):
+        assert np.array_equal(np.asarray(got[i]), np.asarray(ref[i])), i
+
+
+def test_mesh_wire_packed2_fallback(tables):
+    """>63 distinct quals: the packed2 mesh kernel, still byte-identical."""
+    from fgumi_tpu.ops.kernel import pad_segments_mesh
+
+    kernel = ConsensusKernel(tables)
+    kernel.set_force_device()
+    codes, quals, counts, starts = _ragged(31, n_fam=40, L=32)
+    quals = (np.arange(quals.size, dtype=np.int64) % 80 + 3).astype(
+        np.uint8).reshape(quals.shape)
+    ref = _wire_ref(kernel, codes, quals, counts, starts)
+    mesh = make_mesh(jax.devices()[:8], dp=4, sp=2)
+    cg, qg, sg, _st, f_loc, gather = pad_segments_mesh(codes, quals,
+                                                       counts, mesh)
+    t = kernel.device_call_segments_wire(cg, qg, sg, f_loc, len(counts),
+                                         full=True, mesh=mesh,
+                                         mesh_gather=gather)
+    got = kernel.resolve_segments_wire(t, codes, quals, starts)
+    for i in range(4):
+        assert np.array_equal(np.asarray(got[i]), np.asarray(ref[i])), i
+
+
+def test_router_per_mesh_ewmas():
+    from fgumi_tpu.ops.router import OffloadRouter
+
+    r = OffloadRouter()
+    r.observe_device(1 << 20, 1 << 10, 0.01, 0.005, 0.015, devices=1)
+    r.observe_device(1 << 20, 1 << 10, 0.001, 0.0005, 0.0015, devices=8)
+    snap = r.snapshot()
+    assert snap["link_samples"] == 1
+    assert "8" in snap["mesh"]
+    # the 8-device link EWMA is ~10x the 1-device one, learned separately
+    assert snap["mesh"]["8"]["link_mbps"] > 5 * snap["link_mbps"]
+
+
+def test_publish_mesh_gauges():
+    from fgumi_tpu.observe.metrics import METRICS
+    from fgumi_tpu.parallel import mesh as pm
+
+    # conftest's _reset_mesh_snapshot clears the process-global afterwards
+    m = make_mesh(jax.devices()[:8], dp=4, sp=2)
+    snap = pm.publish_mesh(m)
+    assert snap == {"dp": 4, "sp": 2, "devices": 8, "platform": "cpu"}
+    assert pm.LAST_MESH_SNAPSHOT == snap
+    got = METRICS.snapshot()
+    assert got["device.mesh.dp"] == 4
+    assert got["device.mesh.devices"] == 8
+
+
+def _cli_mesh_parity(tmp_path, cmd, sim_path, extra_env=()):
+    """Byte parity of one engine CLI across FGUMI_TPU_MESH settings."""
+    import os
+
+    from fgumi_tpu.cli import main
+    from fgumi_tpu.io.bam import BamReader
+
+    def run(tag, mesh):
+        out = str(tmp_path / f"{cmd}_{tag}.bam")
+        saved = {}
+        env = dict(extra_env)
+        if mesh is not None:
+            env["FGUMI_TPU_MESH"] = mesh
+        for k, v in env.items():
+            saved[k] = os.environ.get(k)
+            os.environ[k] = v
+        try:
+            assert main([cmd, "-i", sim_path, "-o", out,
+                         "--min-reads", "1"]) == 0
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        with BamReader(out) as r:
+            return [rec.data for rec in r]
+
+    single = run("single", "off")
+    for mesh in ("dp4xsp2", "dp8"):
+        assert run(mesh, mesh) == single, (cmd, mesh)
+
+
+def test_fast_duplex_mesh_byte_parity(tmp_path):
+    from fgumi_tpu.simulate import simulate_duplex_bam
+
+    sim = str(tmp_path / "dup.bam")
+    simulate_duplex_bam(sim, num_molecules=120, reads_per_strand=3, seed=13)
+    # force the device strand combine so the sharded resident path (and
+    # its gather remap) is exercised, not just priced
+    _cli_mesh_parity(tmp_path, "duplex", sim,
+                     extra_env={"FGUMI_TPU_DUPLEX_COMBINE": "device"})
+
+
+def test_fast_codec_mesh_byte_parity(tmp_path):
+    from fgumi_tpu.cli import main
+
+    sim = str(tmp_path / "codec.bam")
+    assert main(["simulate", "codec-reads", "-o", sim, "--num-molecules",
+                 "150", "--pairs-per-molecule", "2", "--read-length", "60",
+                 "--seed", "13"]) == 0
+    _cli_mesh_parity(tmp_path, "codec", sim,
+                     extra_env={"FGUMI_TPU_CODEC_COMBINE": "device"})
+
+
+def test_fast_simplex_mesh_env_byte_parity(tmp_path):
+    from fgumi_tpu.simulate import simulate_grouped_bam
+
+    sim = str(tmp_path / "sim.bam")
+    simulate_grouped_bam(sim, num_families=200, family_size=6,
+                         read_length=60, error_rate=0.02, seed=13)
+    _cli_mesh_parity(tmp_path, "simplex", sim)
+
+
 def test_fast_simplex_sp_mesh_byte_parity(tmp_path):
     """FastSimplexCaller with a dp x sp mesh must produce byte-identical
     output to the single-device engine (the --devices + FGUMI_TPU_SP path)."""

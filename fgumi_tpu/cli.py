@@ -354,6 +354,45 @@ def _parse_bool(s: str) -> bool:
     raise argparse.ArgumentTypeError(f"expected true/false, got {s!r}")
 
 
+def _add_device_filter_opts(p):
+    """--device-filter option group shared by the consensus commands: fuse
+    the consensus-read filter into the calling command (ISSUE 11). Same
+    option grammar/defaults as the standalone ``filter`` command."""
+    g = p.add_argument_group(
+        "fused filtering",
+        "fuse `filter` into this command: consensus columns stay "
+        "device-resident, per-read verdicts come from a fused mask "
+        "kernel, and only surviving records are fetched + serialized "
+        "(byte-identical to piping through `fgumi-tpu filter`)")
+    g.add_argument("--device-filter", action="store_true",
+                   help="enable the fused consensus→filter stage "
+                        "(FGUMI_TPU_DEVICE_FILTER=1 is equivalent)")
+    g.add_argument("--filter-min-reads", default="3",
+                   help="filter --min-reads (1-3 comma-separated values)")
+    g.add_argument("--filter-max-read-error-rate", default="0.025",
+                   help="filter --max-read-error-rate")
+    g.add_argument("--filter-max-base-error-rate", default="0.1",
+                   help="filter --max-base-error-rate")
+    g.add_argument("--filter-min-base-quality", type=int, default=None,
+                   help="filter --min-base-quality")
+    g.add_argument("--filter-min-mean-base-quality", type=float,
+                   default=None, help="filter --min-mean-base-quality")
+    g.add_argument("--filter-max-no-call-fraction", type=float, default=0.2,
+                   help="filter --max-no-call-fraction")
+    g.add_argument("--filter-by-template", nargs="?", const=True,
+                   default=True, type=_parse_bool,
+                   help="drop the whole template when any primary fails")
+
+
+def _log_filter_stats(stats, label: str):
+    log.info("%s filter: %d records -> kept %d, rejected %d, masked %d "
+             "bases", label, stats.total_records, stats.passed_records,
+             stats.failed_records, stats.bases_masked)
+    if stats.rejection_reasons:
+        log.info("rejections (filter): %s",
+                 dict(stats.rejection_reasons.most_common()))
+
+
 def _add_simplex(sub):
     p = sub.add_parser("simplex", help="Call simplex consensus reads over MI groups")
     p.add_argument("-i", "--input", required=True, help="grouped BAM (MI tags)")
@@ -409,6 +448,7 @@ def _add_simplex(sub):
                    help="device count for data-parallel consensus dispatch: "
                         "auto (all visible), or an explicit N; 1 disables "
                         "sharding (fast engine only)")
+    _add_device_filter_opts(p)
     _add_pipeline_compat(p)
     p.set_defaults(func=cmd_simplex)
 
@@ -466,6 +506,26 @@ def cmd_simplex(args, source=None, sink=None):
     if source is not None and not use_fast:
         log.error("simplex: fused chain requires the native batch engine")
         return 2
+    filter_stage = None
+    filter_tap = None
+    from .consensus.device_filter import device_filter_requested
+
+    if device_filter_requested(args):
+        from .consensus.device_filter import (HostFilterTap,
+                                              SimplexFilterStage,
+                                              filter_config_from_args)
+
+        try:
+            fcfg = filter_config_from_args(args)
+        except ValueError as e:
+            log.error("%s", e)
+            return 2
+        if use_fast:
+            filter_stage = SimplexFilterStage(fcfg, opts,
+                                              args.filter_by_template)
+        else:
+            # classic engine: fused in-process filtering via the record tap
+            filter_tap = HostFilterTap(fcfg, args.filter_by_template)
     oc_caller = None
     if args.consensus_call_overlapping_bases:
         from .consensus.overlapping import OverlappingBasesConsensusCaller
@@ -499,7 +559,8 @@ def cmd_simplex(args, source=None, sink=None):
                 reference=reference, ref_names=reader.header.ref_names,
                 track_rejects=args.rejects is not None)
             fast = FastSimplexCaller(caller, args.tag.encode(),
-                                     overlap_caller=oc_caller, mesh=mesh)
+                                     overlap_caller=oc_caller, mesh=mesh,
+                                     filter_stage=filter_stage)
             allow_unmapped = args.allow_unmapped
             from .utils.progress import ProgressTracker
 
@@ -548,6 +609,9 @@ def cmd_simplex(args, source=None, sink=None):
                 allow_unmapped = args.allow_unmapped
                 pregroup = lambda r: consensus_pregroup_keep(r.flag,
                                                              allow_unmapped)
+                from .consensus.device_filter import wrap_filter_writer
+
+                writer = wrap_filter_writer(writer, filter_tap)
                 for batch in iter_mi_group_batches(
                         reader, args.batch_groups, tag=args.tag.encode(),
                         record_filter=pregroup):
@@ -558,6 +622,8 @@ def cmd_simplex(args, source=None, sink=None):
                         writer.write_record_bytes(rec_bytes)
                         n_out += 1
                     rejects.drain(caller)
+                if filter_tap is not None:
+                    writer.finish()
     dt = time.monotonic() - t0
     s = caller.stats
     log.info("simplex[%s]: %d input reads -> %d consensus reads in %.2fs "
@@ -574,6 +640,10 @@ def cmd_simplex(args, source=None, sink=None):
     if kt:
         log.info("kernel fallback rate: %.4f%% (%d/%d positions)",
                  100.0 * kf / kt, kf, kt)
+    if filter_stage is not None:
+        _log_filter_stats(filter_stage.stats, "simplex")
+    elif filter_tap is not None:
+        _log_filter_stats(filter_tap.stats, "simplex")
     return 0
 
 
@@ -622,6 +692,7 @@ def _add_duplex(sub):
                         "bm/bu/bt and combined MM/ML + cu/ct tags")
     p.add_argument("--ref", default=None,
                    help="reference FASTA (required with --methylation-mode)")
+    _add_device_filter_opts(p)
     _add_pipeline_compat(p)
     p.set_defaults(func=cmd_duplex)
 
@@ -674,6 +745,13 @@ def cmd_duplex(args):
     # loop directly there
     use_fast = (nb.available() and not getattr(args, "classic", False)
                 and not args.trim and args.rejects is None)
+    from .consensus.device_filter import make_filter_tap, wrap_filter_writer
+
+    try:
+        filter_tap = make_filter_tap(args)
+    except ValueError as e:
+        log.error("%s", e)
+        return 2
     t0 = time.monotonic()
     allow_unmapped = args.allow_unmapped
     oc_caller = None
@@ -708,12 +786,15 @@ def cmd_duplex(args):
                 return fast.process_batch(batch, allow_unmapped)
 
             with BamWriter(args.output, out_header) as writer:
+                writer = wrap_filter_writer(writer, filter_tap)
                 run_stages(
                     iter(reader), _process, writer.write_serialized,
                     threads=args.threads, stats=stats_t,
                     resolve_fn=resolve_chunk, **_consensus_stage_kwargs(args))
                 for blob in fast.flush():
                     writer.write_serialized(resolve_chunk(blob))
+                if filter_tap is not None:
+                    writer.finish()
         progress.finish()
         n_out = caller.stats.consensus_reads
         if args.stats:
@@ -724,6 +805,7 @@ def cmd_duplex(args):
 
             with RejectsSink(args.rejects, reader.header) as rejects, \
                     BamWriter(args.output, out_header) as writer:
+                writer = wrap_filter_writer(writer, filter_tap)
                 n_out = 0
                 pregroup = lambda r: consensus_pregroup_keep(r.flag,
                                                              allow_unmapped)
@@ -752,6 +834,8 @@ def cmd_duplex(args):
                         writer.write_record_bytes(rec_bytes)
                         n_out += 1
                     rejects.drain(caller)
+                if filter_tap is not None:
+                    writer.finish()
     dt = time.monotonic() - t0
     s = caller.merged_stats()
     log.info("duplex[%s]: %d input reads -> %d consensus reads in %.2fs "
@@ -764,6 +848,8 @@ def cmd_duplex(args):
                  ocs.bases_disagreeing, ocs.bases_corrected)
     if s.rejected:
         log.info("rejections: %s", dict(sorted(s.rejected.items())))
+    if filter_tap is not None:
+        _log_filter_stats(filter_tap.stats, "duplex")
     return 0
 
 
@@ -945,6 +1031,7 @@ def _add_codec(sub):
                    help="device count for data-parallel SS dispatch: auto "
                         "(all visible) or an explicit N; 1 disables sharding "
                         "(batch engine only)")
+    _add_device_filter_opts(p)
     _add_pipeline_compat(p)
     p.set_defaults(func=cmd_codec)
 
@@ -986,6 +1073,13 @@ def cmd_codec(args):
     # the rejects stream (records stay array-resident); rejects -> classic
     use_fast = (nbat.available() and args.rejects is None
                 and not getattr(args, "classic", False))
+    from .consensus.device_filter import make_filter_tap, wrap_filter_writer
+
+    try:
+        filter_tap = make_filter_tap(args)
+    except ValueError as e:
+        log.error("%s", e)
+        return 2
     if not use_fast and (args.threads or args.stats):
         log.info("--threads/--stats apply to the batch engine only; this "
                  "run uses the classic per-molecule engine (%s)",
@@ -1013,11 +1107,14 @@ def cmd_codec(args):
                 return fast.process_batch(batch)
 
             with BamWriter(args.output, out_header) as writer:
+                writer = wrap_filter_writer(writer, filter_tap)
                 run_stages(iter(reader), _process, writer.write_serialized,
                            threads=args.threads, stats=stats_t,
                            **_stage_kwargs(args))
                 for chunk in fast.flush():
                     writer.write_serialized(chunk)
+                if filter_tap is not None:
+                    writer.finish()
                 n_out = caller.stats.consensus_reads_generated
         progress.finish()
         if args.stats:
@@ -1037,6 +1134,7 @@ def cmd_codec(args):
             ok = False
             try:
                 with BamWriter(args.output, out_header) as writer:
+                    writer = wrap_filter_writer(writer, filter_tap)
                     n_out = 0
                     for batch in iter_mi_group_batches(
                             reader, args.batch_groups, tag=args.tag.encode()):
@@ -1048,6 +1146,8 @@ def cmd_codec(args):
                             for rec in caller.rejected_reads:
                                 rejects_writer.write_record(rec)
                             caller.rejected_reads.clear()
+                    if filter_tap is not None:
+                        writer.finish()
                 ok = True
             finally:
                 if rejects_writer is not None:
@@ -1064,6 +1164,8 @@ def cmd_codec(args):
         log.info("duplex disagreement rate: %.6f (%d/%d)",
                  s.duplex_disagreement_rate(), s.duplex_disagreement_base_count,
                  s.consensus_duplex_bases_emitted)
+    if filter_tap is not None:
+        _log_filter_stats(filter_tap.stats, "codec")
     return 0
 
 
@@ -2827,6 +2929,13 @@ def _add_pipeline(sub):
                    help="run the classic staged path (intermediate BAMs in "
                         "a temp dir) instead of the fused in-memory chain; "
                         "output is byte-identical either way")
+    p.add_argument("--device-filter", action="store_true",
+                   help="fuse the filter stage INTO simplex (ISSUE 11): "
+                        "consensus columns stay device-resident, verdicts "
+                        "come from the fused mask kernel, and only "
+                        "surviving records are fetched + serialized — "
+                        "byte-identical records to the chained filter "
+                        "stage")
     _add_pipeline_compat(p)
     p.set_defaults(func=cmd_pipeline)
 
@@ -2851,7 +2960,7 @@ def _pipeline_stage_argvs(args, j):
     # --threads reaches every stage with threaded internals: sort's Phase-1
     # spill workers and group's reader/writer stages are deterministic
     # (byte-identical output), not just simplex
-    return [
+    stages = [
         ("extract", ["extract", "-i"] + args.input + rs +
          ["-o", j("unmapped.bam"), "--sample", args.sample,
           "--library", args.library] + lvl0 + fwd),
@@ -2860,6 +2969,20 @@ def _pipeline_stage_argvs(args, j):
         ("group", ["group", "-i", j("sorted.bam"), "-o", j("grouped.bam"),
                    "-s", args.strategy, "--allow-unmapped"] + lvl0 + thr
          + fwd),
+    ]
+    if getattr(args, "device_filter", False):
+        # fused consensus→filter (ISSUE 11): the filter stage disappears —
+        # simplex carries the filter thresholds, judges every read from
+        # the device-resident columns, and writes the FINAL output
+        stages.append(
+            ("simplex", ["simplex", "-i", j("grouped.bam"),
+                         "-o", args.output,
+                         "--min-reads", str(args.consensus_min_reads),
+                         "--allow-unmapped", "--device-filter",
+                         "--filter-min-reads", str(args.filter_min_reads)]
+             + out_lvl + thr + fwd))
+        return stages
+    stages += [
         ("simplex", ["simplex", "-i", j("grouped.bam"), "-o", j("cons.bam"),
                      "--min-reads", str(args.consensus_min_reads),
                      "--allow-unmapped"] + lvl0 + thr + fwd),
@@ -2867,6 +2990,7 @@ def _pipeline_stage_argvs(args, j):
                     "--min-reads", str(args.filter_min_reads)] + out_lvl
          + fwd),
     ]
+    return stages
 
 
 def cmd_pipeline(args):
@@ -2918,11 +3042,12 @@ def _pipeline_fused(args):
     parser = build_parser()
     ns = {name: parser.parse_args(pre + argv) for name, argv in stages}
 
+    dfilt = getattr(args, "device_filter", False)
     c1 = ChainChannel("extract.sort")
     c2 = ChainChannel("sort.group")
     c3 = ChainChannel("group.simplex")
-    c4 = ChainChannel("simplex.filter")
-    chans = [c1, c2, c3, c4]
+    c4 = None if dfilt else ChainChannel("simplex.filter")
+    chans = [c1, c2, c3] + ([] if dfilt else [c4])
 
     def _sink(chan):
         return lambda header: ChannelBamWriter(chan, header)
@@ -2940,16 +3065,21 @@ def _pipeline_fused(args):
         "group": lambda a: cmd_group(
             a, source=ChannelBatchReader(c2, writable=False),
             sink=_sink(c3)),
+        # --device-filter: simplex fuses the filter and writes the final
+        # output itself (sink=None -> the ordinary BamWriter)
         "simplex": lambda a: cmd_simplex(
             a, source=ChannelBatchReader(
                 c3, target_bytes=ns["simplex"].batch_bytes),
-            sink=_sink(c4)),
-        "filter": lambda a: cmd_filter(a, source=ChannelBatchReader(c4)),
+            sink=None if dfilt else _sink(c4)),
     }
-    ins = {"extract": [], "sort": [c1], "group": [c2], "simplex": [c3],
-           "filter": [c4]}
-    outs = {"extract": [c1], "sort": [c2], "group": [c3], "simplex": [c4],
-            "filter": []}
+    ins = {"extract": [], "sort": [c1], "group": [c2], "simplex": [c3]}
+    outs = {"extract": [c1], "sort": [c2], "group": [c3],
+            "simplex": [] if dfilt else [c4]}
+    if not dfilt:
+        calls["filter"] = lambda a: cmd_filter(a,
+                                               source=ChannelBatchReader(c4))
+        ins["filter"] = [c4]
+        outs["filter"] = []
 
     lock = _threading.Lock()
     results = {}

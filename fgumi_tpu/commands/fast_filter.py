@@ -21,7 +21,8 @@ import numpy as np
 
 from ..consensus.filter import (EXCESSIVE_ERROR_RATE, INSUFFICIENT_READS,
                                 LOW_QUALITY, PASS, TOO_MANY_NO_CALLS,
-                                FilterConfig)
+                                FilterConfig, duplex_base_mask_arrays,
+                                simplex_base_mask_arrays)
 from ..io.bam import FLAG_SECONDARY, FLAG_SUPPLEMENTARY, FLAG_UNMAPPED
 from ..native import batch as nb
 from .filter import FilterStats, _process_one
@@ -200,11 +201,8 @@ class FastFilter:
                 & (mean < cfg.min_mean_base_quality)] = _R_LOWQ
 
         # ---- per-base masks
-        mask = np.zeros((n, L), dtype=np.uint8)
         in_len = np.arange(L)[None, :] < l_seq[:, None]
         quals = self._qual_matrix(batch, rows, L)
-        if cfg.min_base_quality is not None:
-            mask |= (quals < cfg.min_base_quality) & in_len
 
         def per_base(tag):
             """(float64 (n, L) matrix, present mask) for a B:s/B:S tag;
@@ -225,19 +223,19 @@ class FastFilter:
         cd, cd_p = per_base(b"cd")
         ce, ce_p = per_base(b"ce")
         simplex_pb = ~duplex & cd_p & ce_p
-        if simplex_pb.any():
-            s = simplex_pb[:, None] & in_len
-            with np.errstate(divide="ignore", invalid="ignore"):
-                rate = np.where(cd > 0, ce / np.maximum(cd, 1), 0.0)
-            mask |= s & (cd < cfg.single_strand.min_reads)
-            mask |= s & (cd > 0) \
-                & (rate > cfg.single_strand.max_base_error_rate)
+        # one shared numeric core with the device-resident fused filter
+        # stage (consensus/filter.py array twins): quality mask everywhere,
+        # simplex depth/error masks only where per-base evidence exists
+        mask = simplex_base_mask_arrays(
+            cd, ce, quals, in_len, cfg.single_strand, cfg.min_base_quality,
+            has_per_base=simplex_pb)
         if duplex.any():
             ad, _ = per_base(b"ad")
             ae_b, _ = per_base(b"ae")
             bd, _ = per_base(b"bd")
             be_b, _ = per_base(b"be")
-            dmask = self._duplex_base_mask(ad, ae_b, bd, be_b, quals)
+            dmask = duplex_base_mask_arrays(ad, ae_b, bd, be_b, cfg.cc,
+                                            cfg.ab, cfg.ba)
             mask |= duplex[:, None] & dmask & in_len
 
         # EM-Seq/TAPS depth masking (filter.rs:952-1043): cu+ct below the
@@ -339,29 +337,6 @@ class FastFilter:
         valid = np.arange(L)[None, :] < l_seq[:, None]
         np.copyto(out, buf[np.minimum(idx, len(buf) - 1)], where=valid)
         return out
-
-    def _duplex_base_mask(self, ad, ae, bd, be, quals):
-        cfg = self.config
-        cc, ab, ba = cfg.cc, cfg.ab, cfg.ba
-        best_depth = np.maximum(ad, bd)
-        worst_depth = np.minimum(ad, bd)
-        with np.errstate(divide="ignore", invalid="ignore"):
-            ab_rate = np.where(ad > 0, ae / np.maximum(ad, 1), 0.0)
-            ba_rate = np.where(bd > 0, be / np.maximum(bd, 1), 0.0)
-        best_rate = np.minimum(ab_rate, ba_rate)
-        worst_rate = np.maximum(ab_rate, ba_rate)
-        total_depth = ad + bd
-        with np.errstate(divide="ignore", invalid="ignore"):
-            total_rate = np.where(
-                total_depth > 0,
-                (ae + be) / np.maximum(total_depth, 1), 0.0)
-        mask = (total_depth < cc.min_reads) \
-            | (total_rate > cc.max_base_error_rate)
-        mask |= (best_depth < ab.min_reads) \
-            | (best_rate > ab.max_base_error_rate)
-        mask |= (worst_depth < ba.min_reads) \
-            | (worst_rate > ba.max_base_error_rate)
-        return mask
 
     def _emit_runs(self, batch, rows, keep, emit):
         """Contiguous kept records emit as single buffer slices (records are

@@ -216,6 +216,11 @@ class _PendingChunk:
 
     def resolve(self) -> bytes:
         fast = self.fast
+        if fast.filter_stage is not None:
+            # fused consensus→filter route (ISSUE 11): verdicts from the
+            # device stats fetch (or host columns), survivors-only gather,
+            # survivors-only serialization — consensus/device_filter.py
+            return fast.filter_stage.resolve_chunk(self)
         caller = fast.caller
         kernel = caller.kernel
         if self.pending is None:
@@ -261,16 +266,21 @@ class FastSimplexCaller:
     """
 
     def __init__(self, caller: VanillaConsensusCaller, tag: bytes = b"MI",
-                 overlap_caller=None, mesh=None):
+                 overlap_caller=None, mesh=None, filter_stage=None):
         """`mesh`: optional jax Mesh with (dp, sp) axes — multi-read jobs
         dispatch through the shard_map-wrapped full-column wire kernels
         (families over dp with no collectives, each shard's read rows over
         sp with one psum combine; ops/kernel._dispatch_wire_mesh). None or
-        a 1-device mesh = the legacy single-device path, bit for bit."""
+        a 1-device mesh = the legacy single-device path, bit for bit.
+        `filter_stage`: a consensus/device_filter.SimplexFilterStage —
+        the fused consensus→filter route (--device-filter): outputs are
+        filtered before serialization, device-routed batches via the
+        fused mask kernel with survivors-only fetch."""
         self.caller = caller
         self.tag = tag
         self.overlap_caller = overlap_caller  # OverlappingBasesConsensusCaller
         self.mesh = mesh if mesh is not None and mesh.size > 1 else None
+        self.filter_stage = filter_stage
         # device/host routing is per batch via the adaptive cost model
         # (ops/router.py; FGUMI_TPU_ROUTE forces a side; the explicit
         # FGUMI_TPU_MAX_INFLIGHT escape hatch is honored inside
@@ -369,7 +379,16 @@ class FastSimplexCaller:
         recs = self.caller.call_groups([(mi_bytes.decode(), records)])
         if not recs:
             return []
-        return [b"".join(len(r).to_bytes(4, "little") + r for r in recs)]
+        return self._post_slow(
+            [b"".join(len(r).to_bytes(4, "little") + r for r in recs)])
+
+    def _post_slow(self, chunks):
+        """Fused-filter pass over slow-path record blobs (already-serialized
+        complete groups); identity when no filter stage is attached."""
+        if self.filter_stage is None or not chunks:
+            return chunks
+        out = [self.filter_stage.filter_records_blob(c) for c in chunks]
+        return [c for c in out if c]
 
     # ------------------------------------------------------------ overlap corr
 
@@ -392,7 +411,8 @@ class FastSimplexCaller:
             recs = caller.call_groups(groups)
             if not recs:
                 return []
-            return [b"".join(len(r).to_bytes(4, "little") + r for r in recs)]
+            return self._post_slow(
+                [b"".join(len(r).to_bytes(4, "little") + r for r in recs)])
 
         # batch-wide native prep over the kept records of the processed groups
         span = idx[bounds[g0]:bounds[g1]]
@@ -861,16 +881,28 @@ class FastSimplexCaller:
 
         N = len(rows_all)
         mesh = self.mesh
+        # full-column gate (uint16 depth fetch) decided BEFORE routing so
+        # the fused-filter pricing below can never be promised for a batch
+        # that would actually dispatch the ordinary full-column kernel
+        full = bool(counts.max() < 65536)
+        fused_filter = False
+        if self.filter_stage is not None and mesh is None and full:
+            from .device_filter import device_mask_enabled
+
+            fused_filter = device_mask_enabled() and device_path() == "full"
         if kernel.host_mode():
             side = "host"
         else:
             # adaptive offload: price this batch on both sides from
             # measured EWMAs (ops/router.py decide_batch) — the mesh size
             # selects its own EWMA set, so an N-chip device side is priced
-            # as N chips, not as the single-device model
+            # as N chips, not as the single-device model. A fused-filter
+            # batch is priced with its reduced fetch (stats row + keep-rate
+            # scaled survivor columns) instead of the full-column fetch.
             side = ROUTER.decide_batch(
                 kernel, N, len(multi), L_max,
-                devices=mesh.size if mesh is not None else 1)
+                devices=mesh.size if mesh is not None else 1,
+                filtered=fused_filter)
         if side == "host":
             # host f64 engine path: either no device at all, or the cost
             # model priced this batch host-side — the native engine eats it
@@ -908,7 +940,6 @@ class FastSimplexCaller:
 
         t_pack0 = time.monotonic()  # gather+pad+wire == this batch's pack
         pred = ROUTER.last_prediction()
-        full = bool(counts.max() < 65536)
         if mesh is not None:
             codes_d = np.ascontiguousarray(codes[rows_all, :L_max])
             quals_d = np.ascontiguousarray(quals[rows_all, :L_max])
@@ -923,6 +954,21 @@ class FastSimplexCaller:
                     ticket), blocks0
         codes_dev, quals_dev, seg_ids, starts_p, F_pad, N_real = \
             pad_segments_gather(codes, quals, rows_all, L_max, counts)
+        if fused_filter:
+            # fused consensus→filter dispatch: per-read stats fetch +
+            # device-resident masked columns (survivors gathered at
+            # resolve time by the filter stage)
+            ticket = kernel.device_call_segments_wire(
+                codes_dev, quals_dev, seg_ids, F_pad, len(multi),
+                pack_t0=t_pack0, full=True,
+                pred_s=pred[0] if pred else None,
+                filter_params=(
+                    np.int32(opts.min_reads),
+                    np.int32(opts.min_consensus_base_quality),
+                    table.cons_len[multi].astype(np.int32),
+                    self.filter_stage.dev_params))
+            return ("segwf", multi, starts_p, codes_dev[:N_real],
+                    quals_dev[:N_real], ticket), blocks0
         ticket = kernel.device_call_segments_wire(
             codes_dev, quals_dev, seg_ids, F_pad, len(multi),
             pack_t0=t_pack0, full=full,

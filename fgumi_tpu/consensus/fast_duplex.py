@@ -687,6 +687,9 @@ class FastDuplexCaller:
                 batch, span, gb, out_specs, seg_map, seg_len, tb, tq, d16,
                 e16, codes2d, vrows, vstarts, L_max, col, combine_ctx)
             stats.consensus_reads += K
+        elif combine_ctx is not None:
+            # nothing to combine this span: drop the resident accounting
+            combine_ctx["resident"].release()
 
         # assemble in molecule order, interleaving fallback molecules
         fb_set = set(np.nonzero(fallback)[0].tolist())
@@ -829,6 +832,10 @@ class FastDuplexCaller:
             # host combine (not a chooser sample — the cand subset is the
             # measured apples-to-apples comparison)
             combine_host(rest)
+        if combine_ctx is not None:
+            # the fused combine is done with the stage-1 resident arrays:
+            # release their device-byte accounting (ISSUE 11 satellite)
+            combine_ctx["resident"].release()
 
         passthrough = np.nonzero(kinds != 2)[0]
         for k in passthrough:

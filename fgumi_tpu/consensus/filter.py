@@ -365,6 +365,115 @@ def no_call_check(buf, max_no_call_fraction: float) -> str:
     return PASS
 
 
+# ---------------------------------------------------------------------------
+# Array-level threshold core — the one copy of the filter's numeric
+# decisions, shared by the batch host engine (commands/fast_filter.py) and
+# the device-resident fused filter stage (consensus/device_filter.py +
+# ops/kernel.py). The per-record functions above stay the semantic
+# reference; these are their vectorized twins over (n,) / (n, L) arrays.
+# ---------------------------------------------------------------------------
+
+#: integer verdict codes for the array paths (order matters only for the
+#: mapping below; the precedence is encoded in simplex_read_verdicts).
+R_PASS, R_INSUFFICIENT, R_ERROR_RATE, R_LOW_QUALITY, R_NO_CALLS = range(5)
+RESULT_NAMES = {R_PASS: PASS, R_INSUFFICIENT: INSUFFICIENT_READS,
+                R_ERROR_RATE: EXCESSIVE_ERROR_RATE,
+                R_LOW_QUALITY: LOW_QUALITY, R_NO_CALLS: TOO_MANY_NO_CALLS}
+
+
+def simplex_read_verdicts(cD, cE, qual_sum, n_after, l_seq,
+                          t: FilterThresholds,
+                          min_mean_base_quality, max_no_call_fraction):
+    """Per-read verdict codes for simplex consensus reads, from the scalar
+    per-read reductions: cD (max per-base depth, i16-clamped), cE (the
+    float32 error-rate tag value), qual_sum (sum of the PRE-mask quals over
+    the full read), n_after (N count AFTER base masking), l_seq.
+
+    Exactly filter_read -> mean-quality check -> no_call_check, in the
+    fast-filter precedence (error rate set first, then depth outranks it;
+    later checks apply only to still-passing reads)."""
+    n = len(cD)
+    res = np.full(n, R_PASS, dtype=np.int8)
+    res[np.asarray(cE, dtype=np.float64) > t.max_read_error_rate] = \
+        R_ERROR_RATE
+    res[cD < t.min_reads] = R_INSUFFICIENT
+    l_seq = np.asarray(l_seq, dtype=np.int64)
+    if min_mean_base_quality is not None:
+        mean = np.where(l_seq > 0,
+                        np.asarray(qual_sum, np.float64)
+                        / np.maximum(l_seq, 1), 0.0)
+        res[(res == R_PASS) & (mean < min_mean_base_quality)] = R_LOW_QUALITY
+    if max_no_call_fraction < 1.0:
+        frac = np.where(l_seq > 0,
+                        np.asarray(n_after, np.float64)
+                        / np.maximum(l_seq, 1), 0.0)
+        too_many = (l_seq > 0) & (frac > max_no_call_fraction)
+    else:
+        too_many = np.asarray(n_after) > max_no_call_fraction
+    res[(res == R_PASS) & too_many] = R_NO_CALLS
+    return res
+
+
+def simplex_base_mask_arrays(cd, ce, quals, in_len, t: FilterThresholds,
+                             min_base_quality, has_per_base=None):
+    """(n, L) boolean mask twin of mask_bases: quality mask everywhere,
+    depth/error masks only on rows that carry per-base evidence
+    (``has_per_base``; None = all rows). All terms honor ``in_len``."""
+    mask = np.zeros(in_len.shape, dtype=bool)
+    if min_base_quality is not None:
+        mask |= (quals < min_base_quality) & in_len
+    pb = in_len if has_per_base is None else has_per_base[:, None] & in_len
+    mask |= pb & (cd < t.min_reads)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        rate = np.where(cd > 0, ce / np.maximum(cd, 1), 0.0)
+    mask |= pb & (cd > 0) & (rate > t.max_base_error_rate)
+    return mask
+
+
+def duplex_base_mask_arrays(ad, ae, bd, be, cc: FilterThresholds,
+                            ab: FilterThresholds, ba: FilterThresholds):
+    """(n, L) boolean mask twin of mask_duplex_bases' depth/error terms
+    (quality and ss-agreement terms are composed by the caller)."""
+    best_depth = np.maximum(ad, bd)
+    worst_depth = np.minimum(ad, bd)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        ab_rate = np.where(ad > 0, ae / np.maximum(ad, 1), 0.0)
+        ba_rate = np.where(bd > 0, be / np.maximum(bd, 1), 0.0)
+    best_rate = np.minimum(ab_rate, ba_rate)
+    worst_rate = np.maximum(ab_rate, ba_rate)
+    total_depth = ad + bd
+    with np.errstate(divide="ignore", invalid="ignore"):
+        total_rate = np.where(total_depth > 0,
+                              (ae + be) / np.maximum(total_depth, 1), 0.0)
+    mask = (total_depth < cc.min_reads) | (total_rate > cc.max_base_error_rate)
+    mask |= (best_depth < ab.min_reads) | (best_rate > ab.max_base_error_rate)
+    mask |= (worst_depth < ba.min_reads) | (worst_rate > ba.max_base_error_rate)
+    return mask
+
+
+def base_error_rate_table(max_rate: float, size: int = 32768) -> np.ndarray:
+    """Exact integer reformulation of the per-base error-rate mask for the
+    device kernel: ``table[c]`` is the smallest integer error count ``e``
+    with ``float64(e) / float64(c) > max_rate`` — so the device's pure
+    integer compare ``(cd > 0) & (ce >= table[cd])`` reproduces the host's
+    f64 division bit-for-bit without any floating point on the device
+    (f64 division is monotone in the numerator, so the threshold integer is
+    well-defined). ``table[0]`` is ``size`` (the cd > 0 gate makes it
+    unreachable); entries are clamped to ``size`` (= "never masks")."""
+    c = np.arange(size, dtype=np.float64)
+    guess = np.floor(max_rate * c).astype(np.int64)
+    table = np.full(size, size, dtype=np.int64)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        # f64 division is monotone in e: test the 5 candidates around the
+        # float guess, keep the smallest that satisfies the comparison
+        for delta in (3, 2, 1, 0, -1):
+            e = np.maximum(guess + delta, 0)
+            ok = e / np.maximum(c, 1) > max_rate
+            table = np.where(ok & (e < table), e, table)
+    table[0] = size
+    return np.minimum(table, size).astype(np.int32)
+
+
 def template_passes(records, pass_flags) -> bool:
     """All primary records must pass; a template with no primaries fails
     (filter.rs:371-395)."""

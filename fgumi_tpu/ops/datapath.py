@@ -390,6 +390,101 @@ class DeviceConstantCache:
             return len(self._entries)
 
 
+class HostStagingPool:
+    """Recycled host staging buffers for the upload path (ISSUE 11).
+
+    The wire build used to mint a fresh (N_pad, L) array per dispatch; with
+    the shape-bucket ladder bounding the vocabulary of padded shapes, a
+    small keyed free-list turns that into zero per-dispatch staging
+    allocations after warm-up (the donation regression check in
+    microbench.py gates on exactly this). Buffers are released back at
+    dispatch *resolve* time — by then the device has consumed the upload
+    even on backends where ``device_put`` aliases host memory — via the
+    feeder's ``mark_resolved`` (an abandoned/wedged dispatch leaks its
+    buffer rather than risking a recycle under a still-running upload).
+
+    Bounded by ``FGUMI_TPU_STAGING_POOL`` bytes (default 64 MiB; ``0``
+    disables pooling entirely): the free list evicts oldest-first, and a
+    buffer larger than the whole budget is simply never pooled.
+    """
+
+    def __init__(self, max_bytes: int = None):
+        self._lock = threading.Lock()
+        self._max_bytes = max_bytes
+        self._free = {}          # (shape, dtype.str) -> [ndarray]
+        self._order = []         # FIFO of keys for eviction
+        self._held_bytes = 0
+        self.allocs = 0
+        self.reuses = 0
+
+    def _budget(self) -> int:
+        if self._max_bytes is None:
+            try:
+                self._max_bytes = max(
+                    int(os.environ.get("FGUMI_TPU_STAGING_POOL",
+                                       str(64 << 20))), 0)
+            except ValueError:
+                self._max_bytes = 64 << 20
+        return self._max_bytes
+
+    def acquire(self, shape, dtype) -> np.ndarray:
+        """A writable array of exactly (shape, dtype) — recycled when one
+        is free, freshly allocated (and counted) otherwise."""
+        key = (tuple(shape), np.dtype(dtype).str)
+        with self._lock:
+            lst = self._free.get(key)
+            if lst:
+                arr = lst.pop()
+                self._held_bytes -= arr.nbytes
+                # keep the FIFO in lockstep with the free lists: one entry
+                # per HELD buffer, so a steady acquire/release cycle cannot
+                # grow it without bound
+                self._order.remove(key)
+                self.reuses += 1
+                from ..observe.metrics import METRICS
+
+                METRICS.inc("device.staging.reuses")
+                return arr
+            self.allocs += 1
+        from ..observe.metrics import METRICS
+
+        METRICS.inc("device.staging.allocs")
+        return np.empty(shape, dtype=dtype)
+
+    def release(self, arr: np.ndarray):
+        """Return a buffer to the pool (drop it when over budget)."""
+        if arr is None:
+            return
+        budget = self._budget()
+        if budget <= 0 or arr.nbytes > budget:
+            return
+        key = (arr.shape, arr.dtype.str)
+        with self._lock:
+            self._free.setdefault(key, []).append(arr)
+            self._order.append(key)
+            self._held_bytes += arr.nbytes
+            while self._held_bytes > budget and self._order:
+                old = self._order.pop(0)
+                lst = self._free.get(old)
+                if lst:
+                    dropped = lst.pop(0)
+                    self._held_bytes -= dropped.nbytes
+
+    def snapshot(self):
+        with self._lock:
+            return {"allocs": self.allocs, "reuses": self.reuses,
+                    "held_bytes": self._held_bytes}
+
+    def reset(self):
+        with self._lock:
+            self._free.clear()
+            self._order.clear()
+            self._held_bytes = 0
+            self.allocs = 0
+            self.reuses = 0
+            self._max_bytes = None
+
+
 def as_device_operand(a, dtype=None):
     """``a`` itself when it is already a C-contiguous ndarray (of
     ``dtype``, when given), else one conversion copy. The dispatch paths
@@ -410,3 +505,4 @@ def as_device_operand(a, dtype=None):
 #: process-wide singletons (see module docstring).
 SHAPE_REGISTRY = ShapeBucketRegistry()
 CONST_CACHE = DeviceConstantCache()
+STAGING_POOL = HostStagingPool()

@@ -92,9 +92,13 @@ def shard_map_compat(*args, **kwargs):
     return fn(*args, **kwargs)
 
 
-def _lazy_jit(fn=None, *, static_argnames=()):
+def _lazy_jit(fn=None, *, static_argnames=(), donate_argnums=()):
     """@jax.jit that defers both the jax import and the jit wrapping to the
-    first call (same compiled-function caching afterwards)."""
+    first call (same compiled-function caching afterwards).
+    ``donate_argnums``: forwarded to jax.jit — the upload-donation variants
+    of the wire kernels pass their input-buffer argnums so XLA may reuse
+    the uploaded pages for outputs/temporaries instead of allocating fresh
+    device memory per dispatch (SNIPPETS [1]/[3] pattern)."""
     def deco(f):
         box = []
 
@@ -102,13 +106,35 @@ def _lazy_jit(fn=None, *, static_argnames=()):
         def wrapper(*a, **k):
             if not box:
                 _ensure_jax()
-                box.append(jax.jit(f, static_argnames=static_argnames)
-                           if static_argnames else jax.jit(f))
+                kwargs = {}
+                if static_argnames:
+                    kwargs["static_argnames"] = static_argnames
+                if donate_argnums:
+                    kwargs["donate_argnums"] = donate_argnums
+                box.append(jax.jit(f, **kwargs))
             return box[0](*a, **k)
 
         return wrapper
 
     return deco(fn) if fn is not None else deco
+
+
+def upload_donation_enabled() -> bool:
+    """Whether wire-upload buffers are donated to the consensus jits.
+
+    ``FGUMI_TPU_DONATE=1/0`` forces; the default (``auto``) donates on any
+    non-CPU backend — the CPU backend ignores donation with a per-call
+    warning, so auto keeps host-only runs quiet. Read per dispatch (cheap)
+    so tests can flip it between in-process runs."""
+    import os
+
+    v = os.environ.get("FGUMI_TPU_DONATE", "auto").strip().lower()
+    if v in ("1", "true", "on", "force"):
+        return True
+    if v in ("0", "false", "off"):
+        return False
+    _ensure_jax()
+    return jax.default_backend() != "cpu"
 
 from ..constants import MAX_PHRED, MIN_PHRED, N_CODE
 from .datapath import CONST_CACHE, SHAPE_REGISTRY, as_device_operand
@@ -337,6 +363,12 @@ class DeviceStats:
         # the device vs the native f64 host engine
         self.route_device = 0
         self.route_host = 0
+        # device-resident pipeline accounting (ISSUE 11): dispatches whose
+        # upload buffers were donated to XLA, and the live/peak bytes of
+        # ResidentHandles arrays pinned on the device between stages
+        self.donated_uploads = 0
+        self.resident_bytes = 0
+        self.resident_bytes_peak = 0
         self.timeline = []  # per-dispatch dicts (capped; --stats report)
         # stamps for dispatches past the timeline cap, alive only until
         # resolve (begin_in_flight/end_in_flight; bounded)
@@ -388,6 +420,28 @@ class DeviceStats:
     def add_const_hit(self):
         with self._lock:
             self.const_hits += 1
+
+    def add_donated_upload(self):
+        with self._lock:
+            self.donated_uploads += 1
+
+    def add_resident_bytes(self, n: int):
+        with self._lock:
+            self.resident_bytes += int(n)
+            if self.resident_bytes > self.resident_bytes_peak:
+                self.resident_bytes_peak = self.resident_bytes
+            now = self.resident_bytes
+        from ..observe.metrics import METRICS
+
+        METRICS.set("device.resident_bytes", now)
+
+    def release_resident_bytes(self, n: int):
+        with self._lock:
+            self.resident_bytes -= int(n)
+            now = self.resident_bytes
+        from ..observe.metrics import METRICS
+
+        METRICS.set("device.resident_bytes", now)
 
     def add_route(self, side: str):
         with self._lock:
@@ -552,6 +606,12 @@ class DeviceStats:
             if self.route_device or self.route_host:
                 out["route_device"] = self.route_device
                 out["route_host"] = self.route_host
+            if self.donated_uploads:
+                out["donated_uploads"] = self.donated_uploads
+            if self.resident_bytes_peak:
+                out["resident_bytes_peak"] = self.resident_bytes_peak
+                if self.resident_bytes:
+                    out["resident_bytes"] = self.resident_bytes
             return out
 
     def timeline_snapshot(self):
@@ -579,7 +639,8 @@ class DeviceStats:
                 "deadline_fallbacks",
                 "upload_overlap_s", "feeder_queue_peak", "const_uploads",
                 "const_hits", "const_upload_bytes", "route_device",
-                "route_host", "_t0", "_next_slot")}
+                "route_host", "donated_uploads", "resident_bytes",
+                "resident_bytes_peak", "_t0", "_next_slot")}
             timeline = [dict(t) for t in other.timeline]
             tail = {s: dict(t) for s, t in other._tail_entries.items()}
         with self._lock:
@@ -622,6 +683,12 @@ def _observe_dispatch_latency(entry: dict) -> None:
         METRICS.observe("device.dispatch.upload_s", entry["upload_s"])
     fetch_s = entry.get("fetch_wait_s", 0.0)
     METRICS.observe("device.dispatch.fetch_s", fetch_s)
+    # per-dispatch fetched bytes (ISSUE 11): makes the fused-filter
+    # "bytes-fetched reduced >= 5x" claim machine-readable from any run
+    # report (device.dispatch.fetch_bytes histogram + the bytes_fetched
+    # counter the device section already carries)
+    METRICS.observe("device.dispatch.fetch_bytes",
+                    entry.get("down_bytes", 0))
     t_fetched = entry.get("t_fetched")
     if t_fetched is not None and "t_exec" in entry:
         METRICS.observe("device.dispatch.compute_s",
@@ -688,7 +755,8 @@ class DispatchTicket:
     feeder slot reclaimed whenever the wedged dispatch finally returns."""
 
     __slots__ = ("_event", "_result", "_exc", "slot", "upload_bytes",
-                 "_released", "_abandoned", "mesh_gather", "mesh_devices")
+                 "_released", "_abandoned", "mesh_gather", "mesh_devices",
+                 "staging", "filter_mode")
 
     def __init__(self):
         self._event = threading.Event()
@@ -698,6 +766,12 @@ class DispatchTicket:
         self.upload_bytes = 0
         self._released = False
         self._abandoned = False
+        # pooled host staging buffers backing this dispatch's upload —
+        # recycled at mark_resolved (never on abandon: the wedged upload
+        # may still be reading them)
+        self.staging = None
+        # fused consensus→filter dispatch (resolve_segments_wire_filtered)
+        self.filter_mode = False
         # mesh dispatches (device_call_segments_wire mesh=...): the
         # family-order gather over the shard-ordered device output, and the
         # mesh size the router's per-mesh cost model is keyed by
@@ -756,6 +830,11 @@ class DeviceFeeder:
         self._active = False  # an item is currently executing
         self._inflight = 0  # dispatched to device, not yet resolved
         self._inflight_bytes = 0
+        # device bytes pinned by live ResidentHandles (ISSUE 11): counted
+        # against the same governed byte budget as the in-flight uploads,
+        # so resident stage-1 outputs can no longer pin HBM invisibly —
+        # a held resident narrows the gate until its consumer releases it
+        self._resident_bytes = 0
         self._depth = None
         self._byte_budget = None  # DynamicBudget once configured
         self._gov_token = None
@@ -862,6 +941,16 @@ class DeviceFeeder:
         DEVICE_STATS.note_queue_depth(depth_now)
         return ticket
 
+    def add_resident_bytes(self, n: int):
+        """Count live ResidentHandles bytes against the byte gate."""
+        with self._cv:
+            self._resident_bytes += int(n)
+
+    def release_resident_bytes(self, n: int):
+        with self._cv:
+            self._resident_bytes -= int(n)
+            self._cv.notify_all()
+
     def mark_resolved(self, ticket: DispatchTicket):
         """Release a dispatch's in-flight pipeline slot + bytes
         (idempotent; resolve paths call it in their ``finally``)."""
@@ -871,7 +960,21 @@ class DeviceFeeder:
             ticket._released = True
             self._inflight -= 1
             self._inflight_bytes -= ticket.upload_bytes
+            staging = ticket.staging
+            recycle = staging is not None and not ticket._abandoned
+            ticket.staging = None
             self._cv.notify_all()
+        if recycle:
+            # by resolve time the device has consumed the upload (the
+            # result was fetched or the dispatch failed), so the pooled
+            # staging buffers are safe to hand out again — even on
+            # backends where device_put aliases host memory. An abandoned
+            # dispatch may still be mid-upload: its buffers are leaked to
+            # the wedge instead of recycled.
+            from .datapath import STAGING_POOL
+
+            for arr in staging:
+                STAGING_POOL.release(arr)
 
     def abandon(self, ticket: DispatchTicket):
         """Give up on a dispatch that overran its deadline.
@@ -991,6 +1094,7 @@ class DeviceFeeder:
                        and (self._inflight >= depth
                             or (self._inflight > 0
                                 and self._inflight_bytes
+                                + self._resident_bytes
                                 + ticket.upload_bytes
                                 > self._byte_budget.limit))):
                     # the demand signal must name the *byte budget* as the
@@ -1043,10 +1147,13 @@ class DeviceFeeder:
                 late = ticket._abandoned
             if late:
                 # the resolver gave up at its deadline while this dispatch
-                # was running: discard the late result, reclaim the slot
+                # was running: discard the late result, reclaim the slot —
+                # including any device-resident arrays it produced, whose
+                # byte accounting would otherwise leak with the abandon
                 log.warning("device dispatch completed %.1fs after its "
                             "deadline; late result discarded",
                             time.monotonic() - t0)
+                _release_residents(result)
                 self.mark_resolved(ticket)
 
 
@@ -1527,9 +1634,8 @@ def _packed2_epilogue(codes_packed, quals, seg_ids, correct_tab, err_tab,
     return _call_epilogue(contrib, obs, ln_error_pre_umi) + (obs,)
 
 
-@_lazy_jit(static_argnames=("num_segments", "out_segments"))
-def _consensus_segments_wire_jit(wire, seg_ids, dict_tab, ln_error_pre_umi,
-                                 num_segments, out_segments):
+def _wire_split_fn(wire, seg_ids, dict_tab, ln_error_pre_umi,
+                   num_segments, out_segments):
     """Ragged-family consensus over the 1-byte wire layout with split packed
     output: (N, L) wire rows -> (out_segments, L) qs + (out_segments, L/4) wp.
     """
@@ -1538,10 +1644,8 @@ def _consensus_segments_wire_jit(wire, seg_ids, dict_tab, ln_error_pre_umi,
     return _pack_result_split(winner, qual, suspect, out_segments)
 
 
-@_lazy_jit(static_argnames=("num_segments", "out_segments"))
-def _consensus_segments_wire_full_jit(wire, seg_ids, dict_tab,
-                                      ln_error_pre_umi, num_segments,
-                                      out_segments):
+def _wire_full_fn(wire, seg_ids, dict_tab, ln_error_pre_umi, num_segments,
+                  out_segments):
     """Full-column wire kernel: winner/qual AND depth/errors per column.
 
     The device computes the integer depth/error counts it already holds as
@@ -1557,6 +1661,21 @@ def _consensus_segments_wire_full_jit(wire, seg_ids, dict_tab,
             errors[:out_segments].astype(jnp.uint16))
 
 
+# plain + upload-donation compilations of each wire-layout kernel: the
+# donated variants let XLA alias the (wire, seg_ids) upload pages for
+# outputs/temporaries instead of allocating fresh device memory per
+# dispatch; chosen per dispatch by upload_donation_enabled().
+_W_STATIC = ("num_segments", "out_segments")
+_consensus_segments_wire_jit = _lazy_jit(
+    static_argnames=_W_STATIC)(_wire_split_fn)
+_consensus_segments_wire_donated_jit = _lazy_jit(
+    static_argnames=_W_STATIC, donate_argnums=(0, 1))(_wire_split_fn)
+_consensus_segments_wire_full_jit = _lazy_jit(
+    static_argnames=_W_STATIC)(_wire_full_fn)
+_consensus_segments_wire_full_donated_jit = _lazy_jit(
+    static_argnames=_W_STATIC, donate_argnums=(0, 1))(_wire_full_fn)
+
+
 _I16_MAX = 32767  # fgbio Short tag clamp (vanilla.py I16_MAX twin)
 
 
@@ -1565,19 +1684,51 @@ class ResidentHandles:
 
     NOT a jax pytree on purpose: the feeder's fetch-overlap pass
     (copy_to_host_async over tree leaves) must never start copying these —
-    they exist precisely so their bytes never cross the link."""
+    they exist precisely so their bytes never cross the link.
 
-    __slots__ = ("arrays",)
+    Accounting (ISSUE 11 satellite): the arrays' device bytes were
+    invisible to every budget — a long duplex run could pin HBM with
+    stage-1 outputs the governor never saw. Construction now registers the
+    byte total with DeviceStats (``device.resident_bytes`` gauge + peak)
+    AND the device feeder's DynamicBudget byte gate, and every consumer
+    calls :meth:`release` when the fused stage has used (or abandoned) the
+    arrays — combine/fetch/degrade paths and the feeder's late-result
+    discard all release, so a wedge cannot leak the accounting."""
+
+    __slots__ = ("arrays", "nbytes", "_released")
 
     def __init__(self, arrays):
         self.arrays = arrays
+        self.nbytes = sum(int(getattr(a, "nbytes", 0) or 0)
+                          for a in arrays)
+        self._released = False
+        if self.nbytes:
+            DEVICE_STATS.add_resident_bytes(self.nbytes)
+            DEVICE_FEEDER.add_resident_bytes(self.nbytes)
+
+    def release(self):
+        """Drop the device arrays + their byte accounting (idempotent)."""
+        if self._released:
+            return
+        self._released = True
+        self.arrays = None
+        if self.nbytes:
+            DEVICE_STATS.release_resident_bytes(self.nbytes)
+            DEVICE_FEEDER.release_resident_bytes(self.nbytes)
 
 
-@_lazy_jit(static_argnames=("num_segments", "out_segments"))
-def _consensus_segments_wire_resident_jit(wire, seg_ids, dict_tab,
-                                          ln_error_pre_umi, min_reads,
-                                          min_qual, num_segments,
-                                          out_segments):
+def _release_residents(result):
+    """Release every ResidentHandles inside a discarded dispatch result
+    (the feeder's late-completion path after an abandon)."""
+    if isinstance(result, ResidentHandles):
+        result.release()
+    elif isinstance(result, (tuple, list)):
+        for item in result:
+            _release_residents(item)
+
+
+def _wire_resident_fn(wire, seg_ids, dict_tab, ln_error_pre_umi, min_reads,
+                      min_qual, num_segments, out_segments):
     """Full-column wire kernel + device-resident thresholded outputs.
 
     Beyond the full fetch tuple, returns (tb, tq, obs) sliced to
@@ -1602,6 +1753,94 @@ def _consensus_segments_wire_resident_jit(wire, seg_ids, dict_tab,
     return (qs, wp, d_sl.astype(jnp.uint16),
             errors[:out_segments].astype(jnp.uint16), tb, tq,
             obs[:out_segments])
+
+
+_consensus_segments_wire_resident_jit = _lazy_jit(
+    static_argnames=_W_STATIC)(_wire_resident_fn)
+_consensus_segments_wire_resident_donated_jit = _lazy_jit(
+    static_argnames=_W_STATIC, donate_argnums=(0, 1))(_wire_resident_fn)
+
+
+def _wire_filter_fn(wire, seg_ids, dict_tab, ln_error_pre_umi, min_reads_c,
+                    min_qual_c, lens, f_min_reads, f_emin_tab, f_min_base_q,
+                    f_per_base, num_segments, out_segments):
+    """Fused consensus→filter wire kernel (ISSUE 11 tentpole).
+
+    One dispatch computes the full consensus columns, applies the
+    consensus thresholds (apply_consensus_thresholds twin, as in the
+    resident kernel) AND the filter library's simplex per-base masks
+    (mask_bases twin) over them, and reduces everything the read-level
+    verdicts need to a 7-int32 stats row per read — the only thing fetched
+    home by default. The masked output columns (fb/fq), the raw
+    depth/error columns, and the pre-threshold packed winner/qual/suspect
+    words stay DEVICE-RESIDENT for the survivors-only gather
+    (:func:`ConsensusKernel.filter_gather_filtered` /
+    :meth:`ConsensusKernel.filter_resolve_suspect_rows`).
+
+    Exactness: every per-base decision here is integer arithmetic —
+    ``f_emin_tab`` (consensus/filter.base_error_rate_table) reformulates
+    the host's f64 error-rate division as a threshold-integer gather, so
+    the device mask can never disagree with ``mask_bases``. Stats columns:
+    [max d16, sum d16, sum e16, sum qual, N-after-mask, newly-masked,
+    any-suspect] with every reduction restricted to positions < lens."""
+    winner, qual, depth, errors, suspect, _obs = _wire_epilogue(
+        wire, seg_ids, dict_tab, ln_error_pre_umi, num_segments)
+    qs, wp = _pack_result_split(winner, qual, suspect, out_segments)
+    w = winner[:out_segments]
+    q = qual[:out_segments]
+    d = depth[:out_segments]
+    e = errors[:out_segments]
+    sus = suspect[:out_segments]
+    low_depth = d < min_reads_c
+    low_qual = q < min_qual_c
+    tb = jnp.where(low_depth | low_qual, N_CODE, w)
+    tq = jnp.where(low_depth, 0, jnp.where(low_qual, MIN_PHRED, q))
+    L = wire.shape[1]
+    in_len = jnp.arange(L, dtype=jnp.int32)[None, :] < lens[:, None]
+    d16 = jnp.minimum(d, _I16_MAX)
+    e16 = jnp.minimum(e, _I16_MAX)
+    fmask = (f_per_base > 0) & ((d16 < f_min_reads)
+                                | ((d16 > 0) & (e16 >= f_emin_tab[d16])))
+    fmask = fmask | ((f_min_base_q >= 0) & (tq < f_min_base_q))
+    fmask = fmask & in_len
+    fb = jnp.where(fmask, N_CODE, tb)
+    fq = jnp.where(fmask, MIN_PHRED, tq)
+    z32 = jnp.int32(0)
+    stats = jnp.stack([
+        jnp.max(jnp.where(in_len, d16, z32), axis=1),
+        jnp.sum(jnp.where(in_len, d16, z32), axis=1),
+        jnp.sum(jnp.where(in_len, e16, z32), axis=1),
+        jnp.sum(jnp.where(in_len, tq, z32), axis=1),
+        jnp.sum((in_len & (fb == N_CODE)).astype(jnp.int32), axis=1),
+        jnp.sum((fmask & (tb != N_CODE)).astype(jnp.int32), axis=1),
+        jnp.any(sus & in_len, axis=1).astype(jnp.int32),
+    ], axis=1).astype(jnp.int32)
+    return (stats, fb.astype(jnp.uint8), fq.astype(jnp.uint8),
+            d.astype(jnp.uint16), e.astype(jnp.uint16), qs, wp)
+
+
+_consensus_segments_wire_filter_jit = _lazy_jit(
+    static_argnames=_W_STATIC)(_wire_filter_fn)
+_consensus_segments_wire_filter_donated_jit = _lazy_jit(
+    static_argnames=_W_STATIC, donate_argnums=(0, 1))(_wire_filter_fn)
+
+
+@_lazy_jit(static_argnames=("out_rows",))
+def _filter_gather_jit(fb, fq, d16, e16, idx, out_rows):
+    """Survivors-only gather over the fused filter kernel's resident
+    columns: only the kept reads' masked bases/quals + depth/errors cross
+    the link (6 B/position instead of 5.25 B/position for everyone)."""
+    return (fb[idx][:out_rows], fq[idx][:out_rows],
+            d16[idx][:out_rows], e16[idx][:out_rows])
+
+
+@_lazy_jit(static_argnames=("out_rows",))
+def _filter_gather_raw_jit(qs, wp, d16, e16, idx, out_rows):
+    """Raw-column gather for suspect rows: the pre-threshold packed
+    winner/qual/suspect words + depth/errors, exactly what the ordinary
+    host completion (unpack + oracle patch) consumes."""
+    return (qs[idx][:out_rows], wp[idx][:out_rows],
+            d16[idx][:out_rows], e16[idx][:out_rows])
 
 
 @_lazy_jit(static_argnames=("out_rows",))
@@ -1744,10 +1983,9 @@ def _codec_combine_mesh_jit(ba, bb, qa, qb, da, db, ea, eb, mesh):
     return mapped(ba, bb, qa, qb, da, db, ea, eb)
 
 
-@_lazy_jit(static_argnames=("num_segments", "out_segments"))
-def _consensus_segments_packed2_jit(codes_packed, quals, seg_ids, correct_tab,
-                                    err_tab, ln_error_pre_umi, num_segments,
-                                    out_segments):
+def _packed2_split_fn(codes_packed, quals, seg_ids, correct_tab,
+                      err_tab, ln_error_pre_umi, num_segments,
+                      out_segments):
     """1.25 B/position fallback of the wire dispatch (batches with >63
     distinct quals): 2-bit packed codes + sentinel quals, split packed
     output + fetch slice."""
@@ -1757,13 +1995,10 @@ def _consensus_segments_packed2_jit(codes_packed, quals, seg_ids, correct_tab,
     return _pack_result_split(winner, qual, suspect, out_segments)
 
 
-@_lazy_jit(static_argnames=("num_segments", "out_segments"))
-def _consensus_segments_packed2_full_jit(codes_packed, quals, seg_ids,
-                                         correct_tab, err_tab,
-                                         ln_error_pre_umi, num_segments,
-                                         out_segments):
+def _packed2_full_fn(codes_packed, quals, seg_ids, correct_tab, err_tab,
+                     ln_error_pre_umi, num_segments, out_segments):
     """Full-column variant of the >63-distinct-quals fallback: same
-    on-device depth/error counts as _consensus_segments_wire_full_jit."""
+    on-device depth/error counts as the full wire kernel."""
     winner, qual, depth, errors, suspect, _obs = _packed2_epilogue(
         codes_packed, quals, seg_ids, correct_tab, err_tab,
         ln_error_pre_umi, num_segments)
@@ -1772,17 +2007,37 @@ def _consensus_segments_packed2_full_jit(codes_packed, quals, seg_ids,
             errors[:out_segments].astype(jnp.uint16))
 
 
-def build_wire(codes2d: np.ndarray, quals2d: np.ndarray, delta94: np.ndarray):
+_consensus_segments_packed2_jit = _lazy_jit(
+    static_argnames=_W_STATIC)(_packed2_split_fn)
+_consensus_segments_packed2_donated_jit = _lazy_jit(
+    static_argnames=_W_STATIC, donate_argnums=(0, 1))(_packed2_split_fn)
+_consensus_segments_packed2_full_jit = _lazy_jit(
+    static_argnames=_W_STATIC)(_packed2_full_fn)
+_consensus_segments_packed2_full_donated_jit = _lazy_jit(
+    static_argnames=_W_STATIC, donate_argnums=(0, 1))(_packed2_full_fn)
+
+
+def build_wire(codes2d: np.ndarray, quals2d: np.ndarray, delta94: np.ndarray,
+               out: np.ndarray = None):
     """Host-side wire build: (wire (N, L) uint8, dict64 (64,) f32) or None
     when the batch has more than 63 distinct quality values (fall back to
-    the packed-codes layout). delta94 = correct_f32 - err_f32 per Phred."""
+    the packed-codes layout). delta94 = correct_f32 - err_f32 per Phred.
+    ``out``: optional preallocated (N, L) uint8 staging buffer (the
+    feeder's recycled pool) filled in place instead of minting a fresh
+    array per dispatch."""
     hist = np.bincount(quals2d.ravel(), minlength=256)
     vals = np.nonzero(hist)[0]
     if len(vals) > 63:
         return None
     lut = np.full(256, 63, dtype=np.uint8)
     lut[vals] = np.arange(len(vals), dtype=np.uint8)
-    wire = (lut[quals2d] << 2) | np.minimum(codes2d, 3)
+    if out is not None:
+        np.take(lut, quals2d, out=out)
+        np.left_shift(out, 2, out=out)
+        np.bitwise_or(out, np.minimum(codes2d, 3), out=out)
+        wire = out
+    else:
+        wire = (lut[quals2d] << 2) | np.minimum(codes2d, 3)
     wire[codes2d == N_CODE] = WIRE_INVALID
     dict64 = np.zeros(64, dtype=np.float32)
     dict64[: len(vals)] = delta94[np.minimum(vals, MAX_PHRED)]
@@ -2515,7 +2770,7 @@ class ConsensusKernel:
                                   pack_t0: float = None, full: bool = False,
                                   resident_thresholds=None,
                                   pred_s: float = None, mesh=None,
-                                  mesh_gather=None):
+                                  mesh_gather=None, filter_params=None):
         """Async wire-format dispatch via the feeder pipeline.
 
         codes2d_padded/quals2d_padded: the full padded (N_pad, L) row layout
@@ -2541,6 +2796,16 @@ class ConsensusKernel:
         ignores it and the combine runs on host). ``pred_s``: the cost
         model's predicted dispatch seconds, stamped into the timeline.
 
+        ``filter_params=(min_reads, min_qual, lens_padded, DeviceFilterParams)``
+        selects the fused consensus→filter kernel (ISSUE 11): per-read
+        stats are the only default fetch, every column stays resident for
+        the survivors-only gather, and the ticket resolves through
+        :meth:`resolve_segments_wire_filtered`. Wire layout only (callers
+        must pass ``full=True``); the >63-distinct-quals fallback silently
+        dispatches the ordinary full-column kernel instead and the filter
+        runs host-side on the fetched columns (``ticket.filter_mode``
+        records which happened).
+
         ``mesh``: a live jax Mesh with > 1 device selects the shard_map
         compile path — the inputs must be in pad_segments_mesh's chunked
         layout with ``num_segments`` the PER-SHARD F_loc and
@@ -2558,39 +2823,67 @@ class ConsensusKernel:
                 t_pack0, full, resident_thresholds, pred_s, mesh,
                 mesh_gather)
         out_segments = _pad_out_segments(J, num_segments)
-        w = build_wire(codes2d_padded, quals2d_padded, self._delta94)
+        from .datapath import STAGING_POOL
+
+        staging = [STAGING_POOL.acquire(codes2d_padded.shape, np.uint8)]
+        w = build_wire(codes2d_padded, quals2d_padded, self._delta94,
+                       out=staging[0])
         pre = self._pre
         tables_dev = self._tables_dev
+        filt = filter_params is not None
         if w is not None:
             wire, dict32 = w
             upload = wire.nbytes + seg_ids.nbytes
             resident = resident_thresholds is not None
-            kind = "segwr" if resident else ("segwf" if full else "segw")
+            kind = ("segwx" if filt else "segwr" if resident
+                    else ("segwf" if full else "segw"))
             new = SHAPE_REGISTRY.observe(
                 kind, wire.shape[0], wire.shape[1], num_segments,
                 out_segments)
             if resident:
                 mr, mq = (np.int32(resident_thresholds[0]),
                           np.int32(resident_thresholds[1]))
+            if filt:
+                mr, mq, lens_j, fparams = filter_params
+                lens_pad = np.zeros(out_segments, dtype=np.int32)
+                lens_pad[:J] = lens_j
 
             def _dispatch(slot):
                 _ensure_jax()
+                donate = upload_donation_enabled()
                 t0 = time.monotonic()
                 wd = jax.device_put(wire)
                 sd = jax.device_put(seg_ids)
                 dtab = CONST_CACHE.put("dict_tab", dict32)
                 DEVICE_STATS.note_upload(slot, time.monotonic() - t0)
+                if donate:
+                    DEVICE_STATS.add_donated_upload()
+                if filt:
+                    ld = jax.device_put(lens_pad)
+                    etab = CONST_CACHE.put("filter_emin", fparams.emin_tab)
+                    fn = (_consensus_segments_wire_filter_donated_jit
+                          if donate else _consensus_segments_wire_filter_jit)
+                    out = fn(wd, sd, dtab, pre, mr, mq, ld,
+                             fparams.min_reads, etab, fparams.min_base_q,
+                             np.int32(1 if fparams.per_base else 0),
+                             num_segments, out_segments)
+                    return (out[0], ResidentHandles(out[1:]))
                 if resident:
-                    out = _consensus_segments_wire_resident_jit(
-                        wd, sd, dtab, pre, mr, mq, num_segments,
-                        out_segments)
+                    fn = (_consensus_segments_wire_resident_donated_jit
+                          if donate
+                          else _consensus_segments_wire_resident_jit)
+                    out = fn(wd, sd, dtab, pre, mr, mq, num_segments,
+                             out_segments)
                     return out[:4] + (ResidentHandles(out[4:]),)
                 if full:
-                    return _consensus_segments_wire_full_jit(
-                        wd, sd, dtab, pre, num_segments, out_segments)
-                return _consensus_segments_wire_jit(
-                    wd, sd, dtab, pre, num_segments, out_segments)
+                    fn = (_consensus_segments_wire_full_donated_jit
+                          if donate else _consensus_segments_wire_full_jit)
+                    return fn(wd, sd, dtab, pre, num_segments, out_segments)
+                fn = (_consensus_segments_wire_donated_jit if donate
+                      else _consensus_segments_wire_jit)
+                return fn(wd, sd, dtab, pre, num_segments, out_segments)
         else:
+            STAGING_POOL.release(staging.pop())
             cp, qsent = pack_codes2(codes2d_padded, quals2d_padded)
             upload = cp.nbytes + qsent.nbytes + seg_ids.nbytes
             new = SHAPE_REGISTRY.observe(
@@ -2599,14 +2892,21 @@ class ConsensusKernel:
 
             def _dispatch(slot):
                 _ensure_jax()
+                donate = upload_donation_enabled()
                 t0 = time.monotonic()
                 cd = jax.device_put(cp)
                 qd = jax.device_put(qsent)
                 sd = jax.device_put(seg_ids)
                 ct, et = tables_dev()
                 DEVICE_STATS.note_upload(slot, time.monotonic() - t0)
-                fn = (_consensus_segments_packed2_full_jit if full
-                      else _consensus_segments_packed2_jit)
+                if donate:
+                    DEVICE_STATS.add_donated_upload()
+                if full:
+                    fn = (_consensus_segments_packed2_full_donated_jit
+                          if donate else _consensus_segments_packed2_full_jit)
+                else:
+                    fn = (_consensus_segments_packed2_donated_jit
+                          if donate else _consensus_segments_packed2_jit)
                 return fn(cd, qd, sd, ct, et, pre, num_segments,
                           out_segments)
         DEVICE_STATS.add_dispatch(segments_flops(
@@ -2620,6 +2920,9 @@ class ConsensusKernel:
                 lambda: device_retry_call(lambda: _dispatch(slot),
                                           "wire dispatch"),
                 upload_bytes=upload, slot=slot)
+        ticket.filter_mode = filt and w is not None
+        if staging:
+            ticket.staging = staging
         return ticket
 
     def _dispatch_wire_mesh(self, codes_g, quals_g, seg_g, F_loc: int,
@@ -2755,7 +3058,12 @@ class ConsensusKernel:
         if failure is not None:
             # only device weather is recoverable; KeyboardInterrupt /
             # SystemExit and INVALID_ARGUMENT-class programming errors
-            # propagate (in-flight accounting above already balanced)
+            # propagate (in-flight accounting above already balanced).
+            # A resident handle that made it out before the failure is
+            # dead weight — release its byte accounting now.
+            if resident is not None:
+                resident.release()
+                resident = None
             if isinstance(failure, DeadlineExceeded):
                 out = self._deadline_fallback_segments(failure, codes2d,
                                                        quals2d, starts)
@@ -2850,7 +3158,158 @@ class ConsensusKernel:
             return winner, qual, depth, errors, {"suspect": suspect,
                                                  "resident": resident,
                                                  "gather": gather}
+        if resident is not None:
+            # no consumer is coming for the resident arrays: release
+            resident.release()
         return winner, qual, depth, errors
+
+    # ------------------------------------------- fused consensus→filter
+
+    def resolve_segments_wire_filtered(self, ticket, codes2d: np.ndarray,
+                                       quals2d: np.ndarray,
+                                       starts: np.ndarray):
+        """Resolve a ``filter_params`` wire ticket (ISSUE 11).
+
+        Returns ``("stats", stats, resident)`` on the fused path — stats
+        is the (J, 7) int32 per-read reduction fetch, resident the
+        device-side (fb, fq, d16, e16, qs, wp) columns for the
+        survivors-only gather — or ``("columns", winner, qual, depth,
+        errors)`` when the dispatch took the >63-qual fallback or degraded
+        (deadline / transient / OOM): full post-oracle columns, the
+        caller's host filter pass takes over. Byte-identity holds on every
+        branch by the same exactness contract as resolve_segments_wire."""
+        if not ticket.filter_mode:
+            out = self.resolve_segments_wire(ticket, codes2d, quals2d,
+                                             starts)
+            return ("columns",) + out
+        t0 = time.monotonic()
+        fetched = 0
+        failure = None
+        resident = None
+        tl0 = DEVICE_STATS.timeline_entry(ticket.slot)
+        deadline = dispatch_deadline_s((tl0 or {}).get("pred_s"))
+        try:
+            stats_dev, resident = ticket.wait(deadline)
+            left = None if deadline is None else \
+                max(deadline - (time.monotonic() - t0), 1.0)
+            stats = _fetch_with_deadline(stats_dev, left)
+            fetched = stats.nbytes
+        except BaseException as e:  # noqa: BLE001 - recovered below
+            failure = e
+        finally:
+            DEVICE_STATS.end_in_flight(ticket.slot, fetched,
+                                       time.monotonic() - t0)
+            if isinstance(failure, DeadlineExceeded):
+                DEVICE_FEEDER.abandon(ticket)
+            else:
+                DEVICE_FEEDER.mark_resolved(ticket)
+        if failure is not None:
+            if resident is not None:
+                resident.release()
+            if isinstance(failure, DeadlineExceeded):
+                out = self._deadline_fallback_segments(failure, codes2d,
+                                                       quals2d, starts)
+            elif not (_is_oom(failure) or _is_transient(failure)):
+                raise failure
+            else:
+                out = self._recover_segments(failure, codes2d, quals2d,
+                                             np.asarray(starts, np.int64),
+                                             0)
+            return ("columns",) + out
+        from .breaker import BREAKER
+
+        BREAKER.record_success()
+        tl = DEVICE_STATS.timeline_entry(ticket.slot)
+        if tl is not None:
+            from .router import ROUTER
+
+            up_s = tl.get("upload_s", 0.0)
+            wait_s = tl.get("fetch_wait_s", 0.0)
+            ROUTER.observe_device(ticket.upload_bytes, fetched, up_s,
+                                  wait_s, up_s + wait_s,
+                                  devices=ticket.mesh_devices)
+        J = len(starts) - 1
+        return ("stats", np.asarray(stats[:J]), resident)
+
+    def filter_resolve_suspect_rows(self, resident, rows, starts,
+                                    codes2d: np.ndarray,
+                                    quals2d: np.ndarray):
+        """Ordinary host completion of the fused route's suspect rows.
+
+        Gathers the raw packed winner/qual/suspect words + depth/errors
+        for ``rows`` (indices into the dispatch's J segments) off the
+        resident columns, then runs exactly the standard resolve tail:
+        unpack, no-call restore, f64 oracle patch over the host-side
+        dense rows. Returns post-oracle (winner, qual, depth, errors)
+        for those rows — PRE consensus-thresholds, like every resolve."""
+        _fb, _fq, d16, e16, qs_full, wp_full = resident.arrays
+        rows = np.asarray(rows, dtype=np.int64)
+        got = self._filter_gather(
+            (qs_full, wp_full, d16, e16), rows, "fgathr")
+        qs_r, wp_r, d_r, e_r = got
+        k = len(rows)
+        winner, qual, suspect = unpack_result_split(qs_r, wp_r, k)
+        depth = d_r[:k].astype(np.int32)
+        errors = e_r[:k].astype(np.int32)
+        no_call = depth == 0
+        if no_call.any():
+            winner[no_call] = N_CODE
+            qual[no_call] = MIN_PHRED
+            errors[no_call] = 0
+        self._count_suspects(suspect)
+        starts = np.asarray(starts, dtype=np.int64)
+        if suspect.any():
+            self._oracle_patch(
+                suspect, winner, qual, depth, errors,
+                lambda f: (codes2d[starts[rows[f]]:starts[rows[f] + 1]],
+                           quals2d[starts[rows[f]]:starts[rows[f] + 1]]))
+        return winner, qual, depth, errors
+
+    def filter_gather_filtered(self, resident, rows):
+        """Survivors-only gather off the fused route's resident columns:
+        (masked bases u8, masked quals u8, depth i32, errors i32) for
+        ``rows``, in row order — the only per-position bytes the fused
+        route ever fetches."""
+        fb, fq, d16, e16 = resident.arrays[:4]
+        rows = np.asarray(rows, dtype=np.int64)
+        fb_r, fq_r, d_r, e_r = self._filter_gather(
+            (fb, fq, d16, e16), rows, "fgath")
+        k = len(rows)
+        return (fb_r[:k], fq_r[:k], d_r[:k].astype(np.int32),
+                e_r[:k].astype(np.int32))
+
+    def _filter_gather(self, arrays, rows, kind: str):
+        """One synchronous gather dispatch over four resident arrays
+        (shape-bucketed index upload, sliced fetch, the usual retry +
+        accounting). Raises on device failure — the fused stage falls
+        back to the host engine for the affected rows."""
+        K = len(rows)
+        K_pad = SHAPE_REGISTRY.bucket(K, 8)
+        K_out = _pad_out_segments(K, K_pad)
+        idx = np.zeros(K_pad, dtype=np.int32)
+        idx[:K] = rows
+        L = int(arrays[0].shape[1])
+        new = SHAPE_REGISTRY.observe(kind, K_pad, L, K_out)
+        DEVICE_STATS.add_dispatch(K_pad * L * 4)
+        slot = DEVICE_STATS.begin_in_flight(idx.nbytes)
+        t0 = time.monotonic()
+        fetched = 0
+        try:
+            fn = (_filter_gather_raw_jit if kind == "fgathr"
+                  else _filter_gather_jit)
+
+            def _dispatch():
+                _ensure_jax()
+                return fn(*arrays, idx, K_out)
+
+            with SHAPE_REGISTRY.attribute_compiles(new):
+                dev = device_retry_call(_dispatch, "filter gather")
+            got = DEVICE_STATS.fetch(dev)
+            fetched = sum(g.nbytes for g in got)
+        finally:
+            DEVICE_STATS.end_in_flight(slot, fetched,
+                                       time.monotonic() - t0)
+        return got
 
     def _recover_segments(self, exc, codes2d: np.ndarray,
                           quals2d: np.ndarray, starts, split_depth: int):

@@ -108,6 +108,10 @@ class OffloadRouter:
             # count; entry 1 is the classic single-device model.
             self._mesh = {1: self._new_mesh_ewmas()}
             self._host_cps = _Ewma()       # host engine cells/s (shared)
+            # fused consensus→filter keep rate (ISSUE 11): the fraction of
+            # device-routed reads the filter keeps, which is what the fused
+            # route's fetch-bytes term scales with
+            self._filter_keep = _Ewma()
             self._streak_side = None
             self._streak = 0
             self._last = {}                # last decision detail (snapshot)
@@ -152,6 +156,14 @@ class OffloadRouter:
             if service_s > 0:
                 e["dispatch_wall_s"].add(service_s)
 
+    def observe_filter_keep(self, kept: int, total: int):
+        """One fused-filter gather: how many device-routed reads survived.
+        Feeds the keep-rate EWMA the fused route's fetch-bytes pricing
+        scales with (``decide_batch(filtered=True)``)."""
+        if total > 0:
+            with self._lock:
+                self._filter_keep.add(kept / total)
+
     def observe_host(self, cells: int, seconds: float):
         """One host-engine batch (cells = rows * positions of the pileup)."""
         if seconds > 1e-6 and cells > 0:
@@ -169,7 +181,8 @@ class OffloadRouter:
             return DEFAULT_PROBE
 
     def decide_batch(self, kernel, n_rows: int, n_segments: int,
-                     L: int, devices: int = 1) -> str:
+                     L: int, devices: int = 1,
+                     filtered: bool = False) -> str:
         """Route one consensus batch from its shape — the one place that
         knows the wire-path economics: upload is 1 B/position of dense rows
         plus 4 B/row of segment ids; the full-column fetch is 5.25 B/column
@@ -177,10 +190,18 @@ class OffloadRouter:
         host cost scales with the pileup cells (rows x positions).
         ``devices``: the mesh size a device route would dispatch on —
         selects that mesh's EWMA set so auto-routing stays correct when
-        the device side is N chips."""
-        return self.decide(kernel, n_rows * L + 4 * n_rows,
-                           (21 * n_segments * L) // 4, n_rows * L,
-                           devices=devices)
+        the device side is N chips. ``filtered``: price the fused
+        consensus→filter route's fetch instead — a 28 B/read stats row
+        plus the survivors' 6 B/position masked columns, scaled by the
+        measured keep-rate EWMA (prior 0.5)."""
+        if filtered:
+            with self._lock:
+                keep = self._filter_keep.get(0.5)
+            down = 28 * n_segments + int(keep * 6 * n_segments * L)
+        else:
+            down = (21 * n_segments * L) // 4
+        return self.decide(kernel, n_rows * L + 4 * n_rows, down,
+                           n_rows * L, devices=devices)
 
     def decide(self, kernel, up_bytes: int, down_bytes: int,
                cells: int, devices: int = 1) -> str:
@@ -328,6 +349,9 @@ class OffloadRouter:
                 "host_mcells_per_s": round(self._host_cps.get(0.0) / 1e6, 3),
                 "host_samples": self._host_cps.samples,
             }
+            if self._filter_keep.samples:
+                out["filter_keep_rate"] = round(self._filter_keep.get(0.0),
+                                                4)
             mesh_out = {}
             for n, e in sorted(self._mesh.items()):
                 if n == 1 or not (e["link_bps"].samples

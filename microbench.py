@@ -128,6 +128,155 @@ def bench_full_column(out):
             out[f"full_column_fam{fam}_device_vs_host"] = round(dth / dt, 3)
 
 
+def bench_device_filter(out):
+    """Fused consensus→filter route vs full-fetch + host filter at 3
+    family-size profiles (ISSUE 11): same consensus work on both sides;
+    the fused side fetches a 28 B/read stats row + survivors-only masked
+    columns, the host side fetches full columns and filters on host. Also
+    records the measured fetched-bytes ratio per profile — the structural
+    claim behind the route."""
+    import numpy as np
+
+    from fgumi_tpu.consensus.device_filter import SimplexFilterStage
+    from fgumi_tpu.consensus.filter import FilterConfig
+    from fgumi_tpu.ops.kernel import (DEVICE_STATS, ConsensusKernel,
+                                      pad_segments)
+    from fgumi_tpu.ops.tables import quality_tables
+
+    tabs = quality_tables(45, 40)
+    kernel = ConsensusKernel(tabs)
+    kernel.set_force_device()
+    cfg = FilterConfig.new([5], [0.025], [0.1], min_base_quality=20,
+                           min_mean_base_quality=30.0)
+
+    class _Opts:
+        min_reads = 1
+        min_consensus_base_quality = 40
+        produce_per_base_tags = True
+
+    stage = SimplexFilterStage(cfg, _Opts())
+    rng = np.random.default_rng(23)
+    L = 100
+    for fam, n_fam in ((3, 4000), (10, 1600), (30, 600)):
+        codes, quals = _family_pileup(rng, n_fam, fam, L)
+        counts = np.full(n_fam, fam, dtype=np.int64)
+        starts = (np.arange(n_fam + 1) * fam).astype(np.int64)
+        lens = np.full(n_fam, L, dtype=np.int32)
+        fp = (np.int32(1), np.int32(40), lens, stage.dev_params)
+
+        def fused():
+            cd, qd, seg, _st, F = pad_segments(codes, quals, counts)
+            t = kernel.device_call_segments_wire(cd, qd, seg, F, n_fam,
+                                                 full=True, filter_params=fp)
+            got = kernel.resolve_segments_wire_filtered(t, codes, quals,
+                                                        starts)
+            if got[0] != "stats":
+                return
+            _, st, resident = got
+            verd = stage.read_verdicts(st.astype(np.int64), lens)
+            rows = np.nonzero((verd == 0) & (st[:, 6] == 0))[0]
+            if len(rows):
+                kernel.filter_gather_filtered(resident, rows)
+            resident.release()
+
+        def full_then_host():
+            cd, qd, seg, _st, F = pad_segments(codes, quals, counts)
+            t = kernel.device_call_segments_wire(cd, qd, seg, F, n_fam,
+                                                 full=True)
+            w, q, d, e = kernel.resolve_segments_wire(t, codes, quals,
+                                                      starts)
+            from fgumi_tpu.ops import oracle
+
+            b, qq = oracle.apply_consensus_thresholds(w, q, d, 1, 40)
+            stage.host_filter_columns(b, qq, d, e, lens)
+
+        b0 = DEVICE_STATS.bytes_fetched
+        dt_f = _timeit(fused)
+        fused_bytes = DEVICE_STATS.bytes_fetched - b0
+        b0 = DEVICE_STATS.bytes_fetched
+        dt_h = _timeit(full_then_host)
+        full_bytes = DEVICE_STATS.bytes_fetched - b0
+        rows = n_fam * fam
+        out[f"device_filter_fam{fam}_fused_rows_per_sec"] = round(
+            rows / dt_f, 1)
+        out[f"device_filter_fam{fam}_hostfilter_rows_per_sec"] = round(
+            rows / dt_h, 1)
+        out[f"device_filter_fam{fam}_fetch_reduction"] = round(
+            full_bytes / max(fused_bytes, 1), 2)
+
+
+def bench_donation(out):
+    """Upload-donation regression check (ISSUE 11): after warm-up, the
+    donated wire route must mint ZERO new host staging buffers per
+    dispatch (the recycled pool serves every upload), and — on backends
+    that implement donation — the donated upload pages must be recycled
+    by XLA, observed as a stable ``unsafe_buffer_pointer`` across
+    back-to-back dispatches. The pointer check skips cleanly on the CPU
+    backend (XLA ignores donation there)."""
+    import os
+
+    import numpy as np
+
+    from fgumi_tpu.ops.datapath import STAGING_POOL
+    from fgumi_tpu.ops.kernel import ConsensusKernel, pad_segments
+    from fgumi_tpu.ops.tables import quality_tables
+
+    kernel = ConsensusKernel(quality_tables(45, 40))
+    kernel.set_force_device()
+    rng = np.random.default_rng(29)
+    codes, quals = _family_pileup(rng, 512, 4, 100)
+    counts = np.full(512, 4, dtype=np.int64)
+    starts = (np.arange(513) * 4).astype(np.int64)
+
+    os.environ["FGUMI_TPU_DONATE"] = "1"
+    try:
+        import warnings
+
+        def run_once():
+            cd, qd, seg, _st, F = pad_segments(codes, quals, counts)
+            t = kernel.device_call_segments_wire(cd, qd, seg, F, 512,
+                                                 full=True)
+            kernel.resolve_segments_wire(t, codes, quals, starts)
+
+        with warnings.catch_warnings():
+            # the cpu backend warns that donation is unimplemented —
+            # expected there; the staging-pool half still applies
+            warnings.simplefilter("ignore")
+            run_once()  # warm-up: pool + jit cache populated
+            allocs0 = STAGING_POOL.allocs
+            for _ in range(4):
+                run_once()
+            out["donation_staging_allocs_after_warmup"] = \
+                STAGING_POOL.allocs - allocs0  # acceptance: 0
+
+            import jax
+
+            if jax.default_backend() == "cpu":
+                out["donation_ptr_check"] = \
+                    "skipped (cpu backend does not implement donation)"
+            else:
+                from fgumi_tpu.ops.datapath import CONST_CACHE
+                from fgumi_tpu.ops.kernel import (
+                    _consensus_segments_wire_full_donated_jit, build_wire)
+
+                cd, qd, seg, _st, F = pad_segments(codes, quals, counts)
+                wire, dict32 = build_wire(cd, qd,
+                                          kernel._delta94)
+                dtab = CONST_CACHE.put("dict_tab", dict32)
+                ptrs = []
+                for _ in range(3):
+                    wd = jax.device_put(wire)
+                    sd = jax.device_put(seg)
+                    ptrs.append(wd.unsafe_buffer_pointer())
+                    r = _consensus_segments_wire_full_donated_jit(
+                        wd, sd, dtab, kernel._pre, F, F)
+                    jax.block_until_ready(r)
+                    del r, wd, sd
+                out["donation_ptr_stable"] = ptrs[1] == ptrs[2]
+    finally:
+        os.environ.pop("FGUMI_TPU_DONATE", None)
+
+
 def bench_datapath(out):
     """Dispatch-prep regression bench: operand preparation must be a no-op
     for the common already-contiguous case (the old unconditional
@@ -497,6 +646,8 @@ def main():
                              read_length=100, seed=17)
         for section in (bench_kernel,
                         bench_full_column,
+                        bench_device_filter,
+                        bench_donation,
                         bench_sharded,
                         bench_datapath,
                         bench_chain,

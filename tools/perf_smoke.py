@@ -15,7 +15,12 @@ Checks (exit 0 when every scenario holds, one PASS/FAIL line each):
    ``device.const_cache.*``, whose device section shows exactly one
    constant upload with repeat hits, and whose later dispatches hit the
    shape registry.
-3. ``--shape-buckets`` rejects malformed specs with a clean error.
+3. **Device-resident filter** (ISSUE 11): forced ``--device-filter``
+   output is record-identical to ``simplex | filter``; the filter-heavy
+   config's run report shows bytes-fetched reduced >= 5x vs the non-fused
+   device route; resident bytes release by exit; an injected device fault
+   degrades to the host filter cleanly and byte-identically.
+4. ``--shape-buckets`` rejects malformed specs with a clean error.
 
 Sibling of tools/telemetry_smoke.py / tools/serve_smoke.py /
 tools/chaos_smoke.py in the verify flow (.claude/skills/verify).
@@ -235,6 +240,108 @@ def full_column_scenario(tmp):
     return ok
 
 
+def _records(path):
+    from fgumi_tpu.io.bam import BamReader
+
+    with BamReader(path) as r:
+        return [bytes(rec.data) for rec in r]
+
+
+def device_filter_scenario(tmp):
+    """ISSUE 11 gates: forced ``--device-filter`` output is record-
+    identical to simplex|filter on a mixed config; on the filter-heavy
+    config the run report shows bytes-fetched reduced >= 5x vs the
+    non-fused device route; a faulting device degrades to the host filter
+    cleanly (exit 0, same records)."""
+    grouped = os.path.join(tmp, "df_grouped.bam")
+    p = run_cli(["simulate", "grouped-reads", "-o", grouped,
+                 "--num-families", "250", "--family-size", "4",
+                 "--family-size-distribution", "longtail", "--seed", "13"])
+    assert p.returncode == 0, p.stderr
+    cons = os.path.join(tmp, "df_cons.bam")
+    two_stage = os.path.join(tmp, "df_twostage.bam")
+    fused = os.path.join(tmp, "df_fused.bam")
+    filt_args = ["--filter-min-reads", "3",
+                 "--filter-min-mean-base-quality", "30",
+                 "--filter-min-base-quality", "20"]
+    dev = {"FGUMI_TPU_ROUTE": "device"}
+    p = run_cli(["simplex", "-i", grouped, "-o", cons, "--min-reads", "1"],
+                dev)
+    ok = check("simplex (reference) exits 0", p.returncode == 0)
+    p = run_cli(["filter", "-i", cons, "-o", two_stage, "-M", "3",
+                 "-q", "30", "-N", "20"])
+    ok &= check("filter (reference) exits 0", p.returncode == 0)
+    rpt = os.path.join(tmp, "df.report.json")
+    p = run_cli(["--run-report", rpt, "simplex", "-i", grouped, "-o",
+                 fused, "--min-reads", "1", "--device-filter"] + filt_args,
+                dev)
+    ok &= check("forced --device-filter exits 0", p.returncode == 0,
+                f"rc={p.returncode}")
+    if not ok:
+        return False
+    ok &= check("--device-filter records identical to simplex|filter",
+                _records(fused) == _records(two_stage))
+    report = json.load(open(rpt))
+    devsec = report.get("device", {})
+    ok &= check("resident bytes tracked and released",
+                devsec.get("resident_bytes_peak", 0) > 0
+                and "resident_bytes" not in devsec,
+                f"peak={devsec.get('resident_bytes_peak')}")
+    ok &= check("fetch-bytes histogram in the report",
+                "device.dispatch.fetch_bytes" in report.get("latency", {}))
+
+    # filter-heavy config: fixed family size 3 under min-reads 6 rejects
+    # every record — the fused route fetches stats rows only
+    heavy = os.path.join(tmp, "df_heavy.bam")
+    p = run_cli(["simulate", "grouped-reads", "-o", heavy,
+                 "--num-families", "400", "--family-size", "3",
+                 "--seed", "17"])
+    assert p.returncode == 0, p.stderr
+    rpt_full = os.path.join(tmp, "df_full.report.json")
+    p = run_cli(["--run-report", rpt_full, "simplex", "-i", heavy, "-o",
+                 os.path.join(tmp, "df_h1.bam"), "--min-reads", "1"], dev)
+    ok &= check("heavy non-fused run exits 0", p.returncode == 0)
+    rpt_fused = os.path.join(tmp, "df_fused.report.json")
+    p = run_cli(["--run-report", rpt_fused, "simplex", "-i", heavy, "-o",
+                 os.path.join(tmp, "df_h2.bam"), "--min-reads", "1",
+                 "--device-filter", "--filter-min-reads", "6"], dev)
+    ok &= check("heavy fused run exits 0", p.returncode == 0)
+    try:
+        full_b = json.load(open(rpt_full))["device"]["bytes_fetched"]
+        fused_b = json.load(open(rpt_fused))["device"]["bytes_fetched"]
+    except (OSError, KeyError, ValueError):
+        return check("fetch-bytes readable from run reports", False)
+    ok &= check("filter-heavy bytes fetched reduced >= 5x",
+                full_b >= 5 * max(fused_b, 1),
+                f"{full_b} vs {fused_b} "
+                f"({full_b / max(fused_b, 1):.1f}x)")
+    # dispatch wall p50 (PR 9 histograms): informational on the CPU
+    # platform — the hardware-evidence bar (ROADMAP item 1) reads these
+    # same keys from a real-TPU run's report
+    try:
+        p50_full = json.load(open(rpt_full))[
+            "latency"]["device.dispatch.wall_s"]["p50"]
+        p50_fused = json.load(open(rpt_fused))[
+            "latency"]["device.dispatch.wall_s"]["p50"]
+        print(f"      dispatch wall p50: full={p50_full}s "
+              f"fused={p50_fused}s (informational on CPU)")
+    except (OSError, KeyError, ValueError):
+        pass
+
+    # device weather: every dispatch faults -> host filter completes the
+    # fused stage byte-identically, exit 0
+    p = run_cli(["simplex", "-i", grouped, "-o", fused, "--min-reads", "1",
+                 "--device-filter"] + filt_args,
+                {**dev, "FGUMI_TPU_HYBRID": "1",
+                 "FGUMI_TPU_DEVICE_BACKOFF_S": "0.01",
+                 "FGUMI_TPU_FAULT": "device.dispatch:raise:1.0"})
+    ok &= check("faulting device-filter degrades cleanly (exit 0)",
+                p.returncode == 0, f"rc={p.returncode}")
+    ok &= check("degraded device-filter records identical",
+                _records(fused) == _records(two_stage))
+    return ok
+
+
 def bad_spec_scenario(tmp):
     p = run_cli(["--shape-buckets", "0.5", "sort", "-i", "x", "-o",
                  os.path.join(tmp, "never.bam")])
@@ -254,6 +361,7 @@ def main():
         ok &= two_dispatch_scenario()
         ok &= report_scenario(tmp)
         ok &= full_column_scenario(tmp)
+        ok &= device_filter_scenario(tmp)
         ok &= bad_spec_scenario(tmp)
     finally:
         if opts.keep:

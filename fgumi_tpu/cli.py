@@ -3270,10 +3270,32 @@ def _add_serve(sub):
     p = sub.add_parser(
         "serve",
         help="Run the persistent job-service daemon (warm-kernel serving)")
-    p.add_argument("--socket", required=True, metavar="PATH",
+    p.add_argument("--socket", default=None, metavar="PATH",
                    help="Unix-domain socket path to listen on (docs/"
                         "serving.md; relative job paths resolve against "
-                        "the daemon's working directory)")
+                        "the daemon's working directory). At least one of "
+                        "--socket/--tcp is required")
+    p.add_argument("--tcp", default=None, metavar="HOST:PORT",
+                   help="additionally listen on TCP (fleet operation): "
+                        "per-connection read/write deadlines "
+                        "(--io-timeout), a connection cap (--conn-cap), "
+                        "and — for any non-loopback HOST — a REQUIRED "
+                        "shared-secret handshake (--token-file or "
+                        "FGUMI_TPU_SERVE_TOKEN; the wire protocol "
+                        "executes submitted commands). Port 0 binds an "
+                        "ephemeral port. A busy port exits 2 before the "
+                        "device warm-up")
+    p.add_argument("--token-file", default=None, metavar="PATH",
+                   help="file holding the shared-secret handshake token "
+                        "for TCP connections (surrounding whitespace "
+                        "stripped; default: FGUMI_TPU_SERVE_TOKEN)")
+    p.add_argument("--conn-cap", type=int, default=None, metavar="N",
+                   help="max concurrent TCP connections; over-cap "
+                        "connects are answered with one explicit error "
+                        "frame and closed (default 64; 0 = unlimited)")
+    p.add_argument("--io-timeout", type=float, default=None, metavar="S",
+                   help="per-connection read/write deadline on TCP "
+                        "connections (default 30; 0 = none)")
     p.add_argument("--workers", type=int, default=2,
                    help="concurrent job slots (bounded worker pool)")
     p.add_argument("--queue-limit", type=int, default=8,
@@ -3305,6 +3327,25 @@ def _add_serve(sub):
                         "restart incomplete jobs are requeued in order "
                         "(docs/serving.md crash recovery). Unset = "
                         "in-memory only, the pre-journal behavior")
+    p.add_argument("--journal-dir", default=None, metavar="DIR",
+                   help="FLEET journaling: journal at DIR/<fleet-id>."
+                        "journal with an fcntl lease held for the "
+                        "daemon's lifetime. Daemons sharing DIR (one real "
+                        "filesystem) take over a dead peer's journal "
+                        "exactly once and requeue its incomplete jobs "
+                        "under their original ids (docs/serving.md "
+                        "\"Fleet operation\"). Exclusive with --journal")
+    p.add_argument("--fleet-id", default=None, metavar="NAME",
+                   help="this daemon's identity in --journal-dir "
+                        "([A-Za-z0-9._-], <=64 chars; job ids become "
+                        "<fleet-id>-j-<n> so they are fleet-unique). "
+                        "Default: derived from the socket basename or "
+                        "the TCP port")
+    p.add_argument("--lease-scan-period", type=float, default=2.0,
+                   metavar="S",
+                   help="how often the fleet lease scanner probes peer "
+                        "journals for takeover (0 = never scan; the "
+                        "daemon still recovers its own journal)")
     p.add_argument("--health-period", type=float, default=None,
                    metavar="S",
                    help="run a tiny device canary every S seconds feeding "
@@ -3320,11 +3361,37 @@ def _add_serve(sub):
     p.set_defaults(func=cmd_serve)
 
 
+def _default_fleet_id(args):
+    """A stable default identity in --journal-dir: the socket basename
+    (without extension) or the TCP port. Good enough for one-host fleets;
+    multi-host fleets should pass --fleet-id explicitly. Returns None
+    when no stable default exists (ephemeral --tcp port 0: every such
+    daemon would collide on the same lease)."""
+    import re as _re
+
+    if args.socket:
+        base = os.path.basename(args.socket)
+        base = base[:-5] if base.endswith(".sock") else base
+        base = _re.sub(r"[^A-Za-z0-9._-]", "-", base).strip("-.")
+        if base:
+            return base[:64]
+    if args.tcp:
+        port = args.tcp.rsplit(":", 1)[-1]
+        if port != "0":
+            return "tcp-" + port
+    return None
+
+
 def cmd_serve(args):
     import signal
 
+    from .serve import transport as transport_mod
     from .serve.daemon import JobService, SocketBusy
+    from .serve.journal import LeaseHeld
 
+    if not args.socket and not args.tcp:
+        log.error("serve needs --socket and/or --tcp")
+        return 2
     if args.workers < 1:
         log.error("--workers must be >= 1")
         return 2
@@ -3343,12 +3410,30 @@ def cmd_serve(args):
             and not 0 <= args.metrics_port <= 65535:
         log.error("--metrics-port must be in 0..65535")
         return 2
+    if args.journal and args.journal_dir:
+        log.error("--journal and --journal-dir are exclusive")
+        return 2
+    if args.conn_cap is not None and args.conn_cap < 0:
+        log.error("--conn-cap must be >= 0 (0 = unlimited)")
+        return 2
     if args.report_dir:
         try:
             os.makedirs(args.report_dir, exist_ok=True)
         except OSError as e:
             log.error("cannot create --report-dir %s: %s", args.report_dir, e)
             return 2
+    tcp = None
+    if args.tcp:
+        try:
+            kind, tcp = transport_mod.parse_address("tcp:" + args.tcp)
+        except ValueError as e:
+            log.error("--tcp: %s", e)
+            return 2
+    try:
+        token = transport_mod.load_token(args.token_file)
+    except (OSError, ValueError) as e:
+        log.error("--token-file: %s", e)
+        return 2
     from .ops.breaker import monitor_period_s
     from .serve import protocol as _proto
 
@@ -3357,28 +3442,55 @@ def cmd_serve(args):
     if health < 0:
         log.error("--health-period must be >= 0")
         return 2
-    service = JobService(
-        args.socket, workers=args.workers, queue_limit=args.queue_limit,
-        report_dir=args.report_dir,
-        max_frame_bytes=args.max_frame_bytes or _proto.MAX_FRAME_BYTES,
-        journal_path=args.journal, health_period_s=health,
-        max_per_client=args.max_per_client,
-        metrics_port=args.metrics_port)
-    # claim the socket BEFORE the device warm-up: an accidental duplicate
-    # start must fail fast without touching the single-tenant chip
+    fleet_id = None
+    if args.journal_dir:
+        fleet_id = args.fleet_id or _default_fleet_id(args)
+        if fleet_id is None:
+            log.error("--journal-dir with an ephemeral --tcp port has no "
+                      "stable default identity; pass --fleet-id")
+            return 2
     try:
-        service.bind()
-    except SocketBusy as e:
+        service = JobService(
+            args.socket, workers=args.workers, queue_limit=args.queue_limit,
+            report_dir=args.report_dir,
+            max_frame_bytes=args.max_frame_bytes or _proto.MAX_FRAME_BYTES,
+            journal_path=args.journal, health_period_s=health,
+            max_per_client=args.max_per_client,
+            metrics_port=args.metrics_port, tcp=tcp, auth_token=token,
+            conn_cap=(args.conn_cap if args.conn_cap is not None
+                      else transport_mod.DEFAULT_CONN_CAP),
+            io_timeout_s=(args.io_timeout if args.io_timeout is not None
+                          else transport_mod.DEFAULT_IO_TIMEOUT_S),
+            journal_dir=args.journal_dir, fleet_id=fleet_id,
+            lease_scan_period_s=args.lease_scan_period)
+    except ValueError as e:
         log.error("%s", e)
         return 2
+    # claim the listeners BEFORE the device warm-up: an accidental
+    # duplicate start must fail fast without touching the single-tenant
+    # chip — a busy TCP port or fleet lease is the same exit-2 contract
+    try:
+        service.bind()
+        service.acquire_lease()
+    except (SocketBusy, LeaseHeld) as e:
+        log.error("%s", e)
+        service.close()
+        return 2
+    except ValueError as e:
+        # a refused listener configuration (non-loopback TCP without a
+        # handshake token)
+        log.error("%s", e)
+        service.close()
+        return 2
     except OSError as e:
-        # the unix socket binds first, so a failure after it was claimed
-        # can only be the --metrics-port HTTP listener
-        if service._sock is not None and args.metrics_port is not None:
-            log.error("cannot bind metrics port %d: %s",
-                      args.metrics_port, e)
-        else:
+        if service._unix is not None and service._unix.sock is None:
             log.error("cannot bind %s: %s", args.socket, e)
+        elif args.tcp and (service._tcp_listener is None
+                           or service._tcp_listener.sock is None):
+            log.error("cannot bind tcp %s: %s", args.tcp, e)
+        else:
+            log.error("cannot bind metrics port %s: %s",
+                      args.metrics_port, e)
         service.close()
         return 2
     service.warm_up(compile_cache_dir=args.compile_cache,
@@ -3410,8 +3522,13 @@ def _add_submit(sub):
     p = sub.add_parser(
         "submit",
         help="Submit a command to a running serve daemon (warm execution)")
-    p.add_argument("--socket", required=True, metavar="PATH",
-                   help="daemon socket (serve --socket)")
+    p.add_argument("--socket", required=True, metavar="ADDR",
+                   help="daemon address: a Unix socket path (serve "
+                        "--socket), unix:PATH, or tcp:HOST:PORT (serve "
+                        "--tcp / a balance front end)")
+    p.add_argument("--token-file", default=None, metavar="PATH",
+                   help="shared-secret handshake token for TCP daemons "
+                        "(default: FGUMI_TPU_SERVE_TOKEN)")
     p.add_argument("--priority", default="normal",
                    choices=["high", "normal", "low"],
                    help="scheduling class (FIFO within a class)")
@@ -3441,8 +3558,52 @@ def _add_submit(sub):
     p.set_defaults(func=cmd_submit)
 
 
+def _submit_with_shed_retry(client, submit_kwargs: dict, wait: bool,
+                            timeout: float = None, sleep=time.sleep):
+    """Submit, honoring the governor's resource-pressure hint.
+
+    A shed (``resource_pressure`` with ``retry_after_s``) is not a
+    failure when the caller intends to wait: sleep EXACTLY the daemon's
+    hint and resubmit instead of hot-looping or giving up, bounded by
+    the overall ``timeout``. Raises the final ShedError when not waiting
+    or out of time. ``sleep`` is injectable for tests."""
+    from .serve.client import ShedError
+
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while True:
+        try:
+            return client.submit(**submit_kwargs)
+        except ShedError as e:
+            if not wait:
+                raise
+            hint = max(float(e.retry_after_s), 0.05)
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise
+                hint = min(hint, remaining)
+            log.info("submit: daemon shedding under resource pressure; "
+                     "retrying in %.1fs (%s)", hint, e)
+            sleep(hint)
+
+
+def _serve_client(args, label: str):
+    """(client, rc) for the serve-client verbs: resolves the handshake
+    token and the address; a config problem logs one line and returns
+    (None, 2)."""
+    from .serve import transport as transport_mod
+    from .serve.client import ServeClient
+
+    try:
+        token = transport_mod.load_token(args.token_file)
+        return ServeClient(args.socket, token=token), 0
+    except (OSError, ValueError) as e:
+        log.error("%s: %s", label, e)
+        return None, 2
+
+
 def cmd_submit(args):
-    from .serve.client import ServeClient, ServeError
+    from .serve.client import ServeError
 
     job_argv = list(args.job_argv)
     if job_argv and job_argv[0] == "--":
@@ -3451,11 +3612,20 @@ def cmd_submit(args):
         log.error("submit: no command given (usage: fgumi-tpu submit "
                   "--socket S <command> [args...])")
         return 2
-    client = ServeClient(args.socket)
+    client, rc = _serve_client(args, "submit")
+    if client is None:
+        return rc
+    # ONE wall-clock budget for the whole command: shed-retry sleeps and
+    # the completion wait share it, so --timeout 60 means 60, not 120
+    deadline = None if args.timeout is None \
+        else time.monotonic() + args.timeout
     try:
-        job = client.submit(job_argv, priority=args.priority, tag=args.tag,
-                            trace=args.job_trace, dedupe=args.dedupe,
-                            client=args.client)
+        job = _submit_with_shed_retry(
+            client,
+            dict(argv=job_argv, priority=args.priority, tag=args.tag,
+                 trace=args.job_trace, dedupe=args.dedupe,
+                 client=args.client),
+            wait=not args.no_wait, timeout=args.timeout)
     except ServeError as e:
         log.error("submit: %s", e)
         return 2
@@ -3465,7 +3635,10 @@ def cmd_submit(args):
         print(job["id"])
         return 0
     try:
-        job = client.wait(job["id"], timeout=args.timeout)
+        job = client.wait(
+            job["id"],
+            timeout=None if deadline is None
+            else max(deadline - time.monotonic(), 0.0))
     except ServeError as e:
         log.error("submit: %s", e)
         return 2
@@ -3481,14 +3654,130 @@ def cmd_submit(args):
     return rc if isinstance(rc, int) and rc else 1
 
 
+def _add_balance(sub):
+    p = sub.add_parser(
+        "balance",
+        help="Run the fleet balancer: a health-routed front end over N "
+             "serve daemons speaking the same wire protocol "
+             "(docs/serving.md \"Fleet operation\")")
+    p.add_argument("--listen", required=True, metavar="ADDR",
+                   help="front-end address: unix:PATH or tcp:HOST:PORT "
+                        "(non-loopback TCP requires the handshake token, "
+                        "like serve --tcp; port 0 = ephemeral)")
+    p.add_argument("--backend", action="append", required=True,
+                   metavar="ADDR", dest="backends",
+                   help="one serve daemon address (repeat per backend): "
+                        "unix:PATH or tcp:HOST:PORT")
+    p.add_argument("--token-file", default=None, metavar="PATH",
+                   help="shared-secret handshake token used BOTH for the "
+                        "front listener and toward TCP backends — a fleet "
+                        "shares one secret (default: "
+                        "FGUMI_TPU_SERVE_TOKEN)")
+    p.add_argument("--poll-period", type=float, default=1.0, metavar="S",
+                   help="health/depth poll period: each backend's `stats` "
+                        "op feeds queue-depth routing and the ejection "
+                        "breaker")
+    p.add_argument("--eject-failures", type=int, default=2, metavar="N",
+                   help="consecutive probe/request failures that eject a "
+                        "backend (closed -> open)")
+    p.add_argument("--cooldown", type=float, default=5.0, metavar="S",
+                   help="ejection cooldown before the half-open re-probe "
+                        "(doubles per consecutive re-trip, capped 8x)")
+    p.add_argument("--probes", type=int, default=2, metavar="N",
+                   help="consecutive half-open probe successes that "
+                        "re-admit a backend")
+    p.add_argument("--conn-cap", type=int, default=None, metavar="N",
+                   help="max concurrent front-end TCP connections "
+                        "(default 64)")
+    p.add_argument("--io-timeout", type=float, default=None, metavar="S",
+                   help="per-connection read/write deadline on front-end "
+                        "TCP connections (default 30; 0 = none)")
+    p.add_argument("--backend-timeout", type=float, default=30.0,
+                   metavar="S",
+                   help="per-request timeout toward a backend")
+    p.add_argument("--max-frame-bytes", type=int, default=None,
+                   help="protocol frame size cap (default 1 MiB)")
+    p.set_defaults(func=cmd_balance)
+
+
+def cmd_balance(args):
+    import signal
+
+    from .serve import protocol as _proto
+    from .serve import transport as transport_mod
+    from .serve.balancer import Balancer
+    from .serve.daemon import SocketBusy
+
+    if args.poll_period <= 0:
+        log.error("--poll-period must be > 0")
+        return 2
+    if args.eject_failures < 1 or args.probes < 1:
+        log.error("--eject-failures and --probes must be >= 1")
+        return 2
+    if args.max_frame_bytes is not None and args.max_frame_bytes < 1024:
+        log.error("--max-frame-bytes must be >= 1024")
+        return 2
+    try:
+        token = transport_mod.load_token(args.token_file)
+        for addr in [args.listen] + args.backends:
+            transport_mod.parse_address(addr)
+        balancer = Balancer(
+            args.listen, args.backends, token=token, backend_token=token,
+            max_frame_bytes=args.max_frame_bytes or _proto.MAX_FRAME_BYTES,
+            poll_period_s=args.poll_period,
+            eject_failures=args.eject_failures, cooldown_s=args.cooldown,
+            probe_successes=args.probes,
+            conn_cap=(args.conn_cap if args.conn_cap is not None
+                      else transport_mod.DEFAULT_CONN_CAP),
+            io_timeout_s=(args.io_timeout if args.io_timeout is not None
+                          else transport_mod.DEFAULT_IO_TIMEOUT_S),
+            backend_timeout_s=args.backend_timeout)
+    except (OSError, ValueError) as e:
+        log.error("balance: %s", e)
+        return 2
+    try:
+        balancer.bind()
+    except SocketBusy as e:
+        log.error("%s", e)
+        return 2
+    except OSError as e:
+        log.error("cannot bind %s: %s", args.listen, e)
+        return 2
+    balancer.start()
+
+    def _on_signal(signum, frame):
+        # SIGTERM drain contract: event-set only; the main loop below
+        # does the drain (and its logging) outside signal context
+        balancer.request_shutdown()
+
+    old = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            old[sig] = signal.signal(sig, _on_signal)
+        except (ValueError, OSError):
+            pass  # not the main thread (in-process test harness)
+    try:
+        balancer.wait_until_shutdown()
+    finally:
+        for sig, handler in old.items():
+            signal.signal(sig, handler)
+        balancer.close()
+    return 0
+
+
 def _add_stats(sub):
     p = sub.add_parser(
         "stats",
         help="Print a running serve daemon's live introspection snapshot "
-             "(scheduler/quota/journal/breaker/governor/device state + "
-             "latency histogram summaries) as JSON")
-    p.add_argument("--socket", required=True, metavar="PATH",
-                   help="daemon socket (serve --socket)")
+             "(scheduler/quota/journal/breaker/governor/device/fleet "
+             "state + latency histogram summaries) as JSON")
+    p.add_argument("--socket", required=True, metavar="ADDR",
+                   help="daemon address: a Unix socket path, unix:PATH, "
+                        "or tcp:HOST:PORT (a balance front end answers "
+                        "with per-backend health)")
+    p.add_argument("--token-file", default=None, metavar="PATH",
+                   help="shared-secret handshake token for TCP daemons "
+                        "(default: FGUMI_TPU_SERVE_TOKEN)")
     p.add_argument("--section", default=None, metavar="KEY",
                    help="print only one top-level section of the snapshot "
                         "(e.g. latency, scheduler, breaker)")
@@ -3498,9 +3787,11 @@ def _add_stats(sub):
 def cmd_stats(args):
     import json as _json
 
-    from .serve.client import ServeClient, ServeError
+    from .serve.client import ServeError
 
-    client = ServeClient(args.socket)
+    client, rc = _serve_client(args, "stats")
+    if client is None:
+        return rc
     try:
         stats = client.stats()
     except ServeError as e:
@@ -3521,8 +3812,12 @@ def cmd_stats(args):
 def _add_jobs(sub):
     p = sub.add_parser(
         "jobs", help="Inspect or manage a serve daemon's job queue")
-    p.add_argument("--socket", required=True, metavar="PATH",
-                   help="daemon socket (serve --socket)")
+    p.add_argument("--socket", required=True, metavar="ADDR",
+                   help="daemon address: a Unix socket path, unix:PATH, "
+                        "or tcp:HOST:PORT")
+    p.add_argument("--token-file", default=None, metavar="PATH",
+                   help="shared-secret handshake token for TCP daemons "
+                        "(default: FGUMI_TPU_SERVE_TOKEN)")
     g = p.add_mutually_exclusive_group()
     g.add_argument("--id", default=None, help="show one job as JSON")
     g.add_argument("--cancel", default=None, metavar="ID",
@@ -3540,9 +3835,11 @@ def _add_jobs(sub):
 def cmd_jobs(args):
     import json as _json
 
-    from .serve.client import ServeClient, ServeError
+    from .serve.client import ServeError
 
-    client = ServeClient(args.socket)
+    client, rc = _serve_client(args, "jobs")
+    if client is None:
+        return rc
     try:
         if args.ping:
             print(_json.dumps(client.ping(), indent=1, sort_keys=True))
@@ -3556,13 +3853,20 @@ def cmd_jobs(args):
             return 0
         if args.drain:
             depth = client.drain()
-            log.info("draining: %d running, %d queued",
-                     depth["running"], depth["queued"])
+            if "running" in depth:
+                log.info("draining: %d running, %d queued",
+                         depth["running"], depth["queued"])
+            else:  # a balance front answers with its own (depthless) ack
+                log.info("draining: balancer admission closed")
             return 0
         if args.shutdown:
             depth = client.shutdown()
-            log.info("shutdown requested: %d running, %d queued to finish",
-                     depth["running"], depth["queued"])
+            if "running" in depth:
+                log.info("shutdown requested: %d running, %d queued to "
+                         "finish", depth["running"], depth["queued"])
+            else:
+                log.info("shutdown requested: balancer draining and "
+                         "exiting")
             return 0
         status = client.status()
         jobs = status["jobs"]
@@ -3662,6 +3966,7 @@ def build_parser():
     _add_submit(sub)
     _add_jobs(sub)
     _add_stats(sub)
+    _add_balance(sub)
     return parser
 
 

@@ -20,12 +20,25 @@ protocol on a Unix-domain socket:
   job's metrics/trace/run-report are exactly what the standalone command
   would have produced — and its output bytes are identical too.
 - :mod:`.client` — the thin client used by ``fgumi-tpu submit`` and
-  ``fgumi-tpu jobs``; reconnects once on a reset mid-request so a daemon
-  restart doesn't surface as a raw traceback.
+  ``fgumi-tpu jobs``; retries idempotent requests across daemon restarts
+  under a capped jittered exponential backoff, and surfaces admission
+  sheds with the governor's ``retry_after_s`` hint.
+- :mod:`.transport` — the fleet transport layer: ``unix:``/``tcp:``
+  addresses, the TCP listener (per-connection deadlines, connection cap,
+  shared-secret handshake for non-loopback binds), and the frame-serving
+  loop shared by the daemon and the balancer.
+- :mod:`.balancer` — the health-routed front end (``fgumi-tpu balance``):
+  routes submits by backend queue depth, ejects unhealthy backends
+  through a closed/open/half-open breaker, and re-routes dedupe-keyed
+  submits to a surviving peer on failure.
 - :mod:`.journal` — the append-only job WAL behind ``serve --journal``:
   fsync'd submit/state records, torn-tail truncation on replay, and the
   requeue-on-restart + dedupe-key recovery semantics that make serving
-  crash-recoverable (a SIGKILL'd daemon forgets nothing).
+  crash-recoverable (a SIGKILL'd daemon forgets nothing). With ``serve
+  --journal-dir`` the journal becomes a fleet object: each daemon holds an
+  fcntl lease on its journal, and a peer (or restart) claims a dead
+  daemon's lease exactly once and requeues its incomplete jobs under
+  their original ids.
 
 Every job is byte-parity-committed: the daemon overrides provenance
 (@PG CL) with the submitting client's command line, and all execution-state

@@ -1,0 +1,776 @@
+"""Health-routed fleet balancer: one front end over N serve daemons.
+
+``fgumi-tpu balance --listen ADDR --backend ADDR ...`` speaks the same
+newline-JSON wire protocol as the daemon on its front listener (Unix or
+TCP, through the same :class:`~.transport.FrameServer` — deadlines,
+connection cap, and handshake auth included) and fans work out across the
+backends:
+
+- **Routing** — a ``submit`` goes to the healthy backend with the lowest
+  queue depth (``queued + running`` from each backend's ``stats`` op,
+  refreshed by the health loop and corrected per-submit). ``status`` /
+  ``cancel`` follow a job-id -> backend map learned at submit time, with a
+  fan-out fallback — after a lease takeover the job LIVES on a different
+  backend than the one it was submitted to, and the fan-out finds it.
+- **Health** — a background loop polls every backend's ``stats`` op.
+  Failures feed a per-backend closed/open/half-open breaker (the PR 7
+  ``DeviceBreaker`` shape): ``eject_failures`` consecutive probe failures
+  eject the backend (open), a cooldown (doubling per re-trip) moves it to
+  half-open, and ``probe_successes`` consecutive clean probes re-admit it.
+  An ejected backend receives no traffic.
+- **Failover** — a submit whose backend dies mid-request is re-routed to
+  a surviving peer when (and only when) it carries a ``dedupe`` key: the
+  key makes the retry idempotent even if the dead backend had already
+  admitted it (journal-lease takeover requeues that copy, and the dedupe
+  key arbitrates — exactly one executes). Keyless submits surface the
+  transport error verbatim; the client owns that retry decision.
+- **Backpressure** — a backend shedding under resource pressure answers
+  with ``retry_after_s``; the balancer first tries the other backends,
+  and only when EVERY healthy backend sheds does it sleep the smallest
+  hint once and retry, then propagate the shed to the client (who sleeps
+  the hint themselves — nobody hot-loops).
+
+``drain``/``shutdown`` on the front apply to the balancer itself (close
+admission; exit), never to the backends — operators stop daemons
+directly. SIGTERM is the same drain."""
+
+import logging
+import os
+import threading
+import time
+
+from . import protocol, transport
+from .client import (ServeClient, ServeError, TransportError,
+                     TransportTimeout)
+
+log = logging.getLogger("fgumi_tpu")
+
+#: breaker defaults (overridable via `fgumi-tpu balance` flags)
+EJECT_FAILURES = 2
+COOLDOWN_S = 5.0
+PROBE_SUCCESSES = 2
+MAX_COOLDOWN_FACTOR = 8
+
+#: cap on one shed-hint sleep inside the balancer — a huge hint is the
+#: client's problem to honor, not a reason to hold a connection hostage.
+MAX_SHED_SLEEP_S = 10.0
+
+
+class PeerBreaker:
+    """Closed/open/half-open ejection state machine for one backend.
+
+    The :class:`~fgumi_tpu.ops.breaker.DeviceBreaker` shape re-applied to
+    a network peer: consecutive failures eject (open), cooldown doubles
+    per re-trip (a flapping backend converges to long ejections instead
+    of oscillating), half-open admits ONE probe at a time, and
+    ``probe_successes`` consecutive clean probes re-admit. ``now`` is
+    injectable for tests."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+    def __init__(self, eject_failures: int = EJECT_FAILURES,
+                 cooldown_s: float = COOLDOWN_S,
+                 probe_successes: int = PROBE_SUCCESSES,
+                 now=time.monotonic):
+        self.eject_failures = max(int(eject_failures), 1)
+        self.cooldown_s = float(cooldown_s)
+        self.probe_successes = max(int(probe_successes), 1)
+        self._now = now
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._score = 0
+        self._opened_at = None
+        self._trips = 0
+        self._probe_inflight = False
+        self._probe_ok = 0
+        self.transitions = []  # [(t, from, to, reason)] bounded
+
+    def _advance_locked(self):
+        if self._state == self.OPEN:
+            cool = self.cooldown_s * min(2 ** max(self._trips - 1, 0),
+                                         MAX_COOLDOWN_FACTOR)
+            if self._now() - self._opened_at >= cool:
+                self._transition_locked(self.HALF_OPEN, "cooldown elapsed")
+        return self._state
+
+    def _transition_locked(self, new, reason):
+        old = self._state
+        if old == new:
+            return
+        self._state = new
+        self.transitions.append((round(self._now(), 3), old, new, reason))
+        del self.transitions[:-16]
+        if new == self.OPEN:
+            self._opened_at = self._now()
+            self._trips += 1
+        if new == self.HALF_OPEN:
+            self._probe_inflight = False
+            self._probe_ok = 0
+        if new == self.CLOSED:
+            self._score = 0
+            self._trips = 0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._advance_locked()
+
+    def allow(self) -> bool:
+        """May the next request go to this backend? half-open claims the
+        single probe slot; the matching record_* releases it."""
+        with self._lock:
+            state = self._advance_locked()
+            if state == self.CLOSED:
+                return True
+            if state == self.OPEN:
+                return False
+            if self._probe_inflight:
+                return False
+            self._probe_inflight = True
+            return True
+
+    def record_success(self):
+        with self._lock:
+            state = self._advance_locked()
+            if state == self.CLOSED:
+                self._score = 0
+                return
+            if state == self.HALF_OPEN:
+                self._probe_inflight = False
+                self._probe_ok += 1
+                if self._probe_ok >= self.probe_successes:
+                    self._transition_locked(
+                        self.CLOSED,
+                        f"{self._probe_ok} consecutive probe successes")
+
+    def record_failure(self, reason: str):
+        with self._lock:
+            state = self._advance_locked()
+            if state == self.HALF_OPEN:
+                self._probe_inflight = False
+                self._transition_locked(self.OPEN, f"probe failed: {reason}")
+                return
+            if state == self.CLOSED:
+                self._score += 1
+                if self._score >= self.eject_failures:
+                    self._transition_locked(self.OPEN, reason)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "state": self._advance_locked(),
+                "trips": self._trips,
+                "transitions": [
+                    {"t": t, "from": a, "to": b, "reason": r}
+                    for t, a, b, r in self.transitions],
+            }
+
+
+class Backend:
+    """One routed-to daemon: its client, breaker, and last known depth."""
+
+    def __init__(self, address: str, token: str = None,
+                 timeout_s: float = 30.0, breaker: PeerBreaker = None):
+        self.address = address
+        # no client-side backoff retries inside the balancer: failure must
+        # surface FAST so the breaker ejects and the submit re-routes —
+        # the balancer IS the retry layer
+        self.client = ServeClient(address, timeout=timeout_s,
+                                  retry_policy=transport.RetryPolicy.none(),
+                                  token=token)
+        self.breaker = breaker or PeerBreaker()
+        self._lock = threading.Lock()
+        self._depth = None          # queued + running; None = unknown
+        self.last_ok_unix = None
+        self.last_error = None
+
+    @property
+    def depth(self):
+        with self._lock:
+            return self._depth
+
+    def note_depth(self, depth):
+        with self._lock:
+            self._depth = depth
+
+    def note_ok(self):
+        with self._lock:
+            self.last_ok_unix = round(time.time(), 3)
+            self.last_error = None
+
+    def note_error(self, err: str):
+        with self._lock:
+            self.last_error = str(err)[:200]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "address": self.address,
+                "state": self.breaker.state,
+                "depth": self._depth,
+                "last_ok_unix": self.last_ok_unix,
+                "last_error": self.last_error,
+            }
+
+
+class Balancer:
+    """The front-end service: wire-protocol dispatch over the backends."""
+
+    def __init__(self, listen: str, backends, token: str = None,
+                 backend_token: str = None,
+                 max_frame_bytes: int = protocol.MAX_FRAME_BYTES,
+                 poll_period_s: float = 1.0,
+                 eject_failures: int = EJECT_FAILURES,
+                 cooldown_s: float = COOLDOWN_S,
+                 probe_successes: int = PROBE_SUCCESSES,
+                 conn_cap: int = transport.DEFAULT_CONN_CAP,
+                 io_timeout_s: float = transport.DEFAULT_IO_TIMEOUT_S,
+                 backend_timeout_s: float = 30.0,
+                 job_map_limit: int = 10000):
+        if not backends:
+            raise ValueError("balance needs at least one --backend")
+        self.listen_addr = listen
+        self.token = token
+        self.max_frame_bytes = max_frame_bytes
+        self.poll_period_s = float(poll_period_s)
+        self.backends = [
+            Backend(addr, token=backend_token, timeout_s=backend_timeout_s,
+                    breaker=PeerBreaker(eject_failures, cooldown_s,
+                                        probe_successes))
+            for addr in backends]
+        seen = set()
+        for b in self.backends:
+            if b.address in seen:
+                raise ValueError(f"duplicate --backend {b.address}")
+            seen.add(b.address)
+        self.started_unix = time.time()
+        self._jobs_lock = threading.Lock()
+        self._job_backend = {}      # job id -> Backend (bounded FIFO-ish)
+        #: dedupe key -> (Backend, job id | None): an idempotent resubmit
+        #: must reach the backend HOLDING the key, or a fresh backend
+        #: would execute a second copy. job id None = the key was SENT
+        #: there but the answer never arrived (timeout) — the most
+        #: dangerous state, resolved only by that backend answering or
+        #: its jobs being taken over (best-effort — a takeover moves keys
+        #: between backends, and the daemons' own maps stay the
+        #: authority)
+        self._dedupe_backend = {}
+        self._job_map_limit = int(job_map_limit)
+        self._draining = False
+        self._drain_lock = threading.Lock()
+        self._shutdown = threading.Event()
+        self._poll_stop = threading.Event()
+        self._poll_threads = []
+        kind, target = transport.parse_address(listen)
+        if kind == "unix":
+            listener = transport.UnixListener(target)
+        else:
+            host, port = target
+            listener = transport.TcpListener(
+                host, port, token=token, io_timeout_s=io_timeout_s,
+                conn_cap=conn_cap)
+        self._listener = listener
+        self._frames = transport.FrameServer(
+            self.handle_request, [listener], max_frame_bytes,
+            on_shutdown=self._shutdown.set, name="fgumi-balance")
+        self._closed = False
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def bind(self):
+        self._frames.bind()
+
+    def start(self):
+        self.bind()
+        self._frames.start()
+        self._poll_threads = []
+        for i, b in enumerate(self.backends):
+            t = threading.Thread(target=self._poll_loop, args=(b,),
+                                 name=f"fgumi-balance-health-{i}",
+                                 daemon=True)
+            t.start()
+            self._poll_threads.append(t)
+        log.info("balance: listening on %s over %d backend(s): %s",
+                 self._listener.describe(), len(self.backends),
+                 ", ".join(b.address for b in self.backends))
+
+    def request_shutdown(self):
+        self._shutdown.set()
+
+    def wait_until_shutdown(self, poll_s: float = 0.2):
+        while not self._shutdown.wait(poll_s):
+            pass
+        self.drain()
+
+    def drain(self):
+        with self._drain_lock:
+            if not self._draining:
+                log.info("balance: draining (admission closed)")
+            self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        with self._drain_lock:
+            return self._draining
+
+    def close(self, grace_s: float = 10.0):
+        if self._closed:
+            return
+        self._closed = True
+        self._shutdown.set()
+        self._poll_stop.set()
+        for t in self._poll_threads:
+            t.join(timeout=5)
+        self._frames.close()
+        # let in-flight forwards answer before the process exits
+        deadline = time.monotonic() + grace_s
+        while self._frames.live_connections() > 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if isinstance(self._listener, transport.UnixListener):
+            self._listener.unlink()
+        log.info("balance: stopped")
+
+    @property
+    def listen_port(self):
+        """Bound TCP port (ephemeral port 0 resolves after bind)."""
+        return getattr(self._listener, "port", None)
+
+    # -- health loop --------------------------------------------------------
+
+    def _poll_loop(self, b: Backend):
+        # ONE loop per backend: a hung-but-accepting backend stalls only
+        # its own probe (bounded by the probe timeout), never the other
+        # backends' depth/health cadence. First pass immediately:
+        # routing before the first period would otherwise see every
+        # depth as unknown
+        while True:
+            if b.breaker.allow():
+                self._probe(b)
+            if self._poll_stop.wait(self.poll_period_s):
+                return
+
+    def poll_backends_once(self):
+        """One sequential health sweep: refresh depth + feed every
+        breaker. Tests and the CLI's startup probe drive this; the live
+        balancer runs one independent loop per backend."""
+        for b in self.backends:
+            if not b.breaker.allow():
+                continue  # open, or half-open slot already claimed
+            self._probe(b)
+
+    def _probe(self, b: Backend):
+        was = b.breaker.state
+        try:
+            # probe timeout is NOT tied to the poll period: a DEAD
+            # backend fails instantly (connection refused), so a generous
+            # deadline costs nothing on real deaths — while a tight one
+            # ejects a live backend that is merely busy (XLA compiling a
+            # job on a loaded host), the spurious-ejection mode the
+            # timeout-failover rule exists to prevent
+            stats = b.client.stats(timeout=min(b.client.timeout, 10.0))
+            sched = stats.get("scheduler") or {}
+            b.note_depth(int(sched.get("queued", 0))
+                         + int(sched.get("running", 0)))
+            b.note_ok()
+            b.breaker.record_success()
+        except ServeError as e:
+            b.note_error(e)
+            b.breaker.record_failure(f"health probe failed: {e}")
+        self._transition_accounting(b, was)
+
+    @staticmethod
+    def _note_transition(b: Backend, was: str, now: str):
+        from ..observe.flight import FLIGHT
+
+        FLIGHT.note("balancer.backend", address=b.address, state=now,
+                    previous=was)
+        level = logging.WARNING if now == "open" else logging.INFO
+        log.log(level, "balance: backend %s %s -> %s", b.address, was, now)
+
+    # -- routing ------------------------------------------------------------
+
+    def _healthy_backends(self):
+        """Routable backends, least-loaded first (unknown depth last among
+        the healthy — it answered the breaker but never a stats poll)."""
+        out = [b for b in self.backends if b.breaker.state != "open"]
+        out.sort(key=lambda b: (b.depth is None,
+                                b.depth if b.depth is not None else 0))
+        return out
+
+    def _bounded_put_locked(self, d: dict, key, value):
+        """Insert with drop-oldest-half eviction (caller holds the jobs
+        lock). Forgotten JOB entries degrade to the fan-out fallback;
+        forgotten DEDUPE entries lose sticky routing (a resubmit of an
+        evicted key routes by load again), so that eviction is loud."""
+        if len(d) >= self._job_map_limit:
+            dropped = list(d)[:self._job_map_limit // 2]
+            for k in dropped:
+                del d[k]
+            if d is self._dedupe_backend:
+                log.warning(
+                    "balance: dedupe routing map overflowed (limit %d); "
+                    "%d oldest keys forgot their sticky backend — "
+                    "resubmits of those keys route by load and rely on "
+                    "the daemons' own dedupe maps alone",
+                    self._job_map_limit, len(dropped))
+        d[key] = value
+
+    def _remember_job(self, job_id: str, backend: Backend,
+                      dedupe: str = None):
+        with self._jobs_lock:
+            self._bounded_put_locked(self._job_backend, job_id, backend)
+            if dedupe:
+                self._bounded_put_locked(self._dedupe_backend, dedupe,
+                                         (backend, job_id))
+
+    def _remember_dedupe_pending(self, dedupe: str, backend: Backend):
+        """The key was SENT to ``backend`` but no answer arrived: it may
+        hold (and be executing) the job. Never overwrite a confirmed
+        entry with a pending one."""
+        with self._jobs_lock:
+            if dedupe not in self._dedupe_backend:
+                self._bounded_put_locked(self._dedupe_backend, dedupe,
+                                         (backend, None))
+
+    def _backend_for_job(self, job_id: str):
+        with self._jobs_lock:
+            return self._job_backend.get(job_id)
+
+    def _relocate_dedupe(self, dedupe: str, job_id: str):
+        """The key's holder is ejected: find the backend that owns the
+        job NOW (a lease takeover moves jobs — and their keys — to the
+        claimant). Returns the new holder, or None when the job is
+        nowhere reachable (unknown id, or the takeover has not happened
+        yet)."""
+        if job_id is None:
+            return None  # the original submit never answered: no handle
+        for b in self._healthy_backends():
+            try:
+                resp = self._forward(
+                    b, {"v": protocol.PROTOCOL_VERSION, "op": "status",
+                        "id": job_id})
+            except ServeError:
+                continue
+            if resp.get("ok"):
+                self._remember_job(job_id, b, dedupe=dedupe)
+                log.info("balance: dedupe key %r relocated to %s "
+                         "(takeover)", dedupe, b.address)
+                return b
+        return None
+
+    # -- request dispatch ---------------------------------------------------
+
+    def handle_request(self, req: dict) -> dict:
+        err = protocol.validate_request(req)
+        if err is not None:
+            return protocol.error_response(err)
+        op = req["op"]
+        if op == "hello":
+            return transport.hello_response("fgumi-tpu-balance",
+                                            self.token, req)
+        if op == "ping":
+            states = [b.breaker.state for b in self.backends]
+            return protocol.ok_response(
+                tool="fgumi-tpu-balance", pid=os.getpid(),
+                uptime_s=round(time.time() - self.started_unix, 1),
+                backends={"total": len(states),
+                          "healthy": sum(s != "open" for s in states)},
+                draining=self.draining)
+        if op == "stats":
+            return protocol.ok_response(stats=self.stats_snapshot())
+        if op == "submit":
+            return self._route_submit(req)
+        if op == "status":
+            return self._route_status(req)
+        if op == "cancel":
+            return self._route_cancel(req)
+        if op == "drain":
+            self.drain()
+            return protocol.ok_response(draining=True)
+        if op == "shutdown":
+            self.drain()
+            return protocol.ok_response(draining=True)
+        raise AssertionError(f"unhandled op {op}")
+
+    def stats_snapshot(self) -> dict:
+        from ..observe.metrics import METRICS
+
+        with self._jobs_lock:
+            tracked = len(self._job_backend)
+        return {
+            "schema_version": 1,
+            "tool": "fgumi-tpu-balance",
+            "pid": os.getpid(),
+            "uptime_s": round(time.time() - self.started_unix, 1),
+            "draining": self.draining,
+            "poll_period_s": self.poll_period_s,
+            "tracked_jobs": tracked,
+            "metrics": {k: v for k, v in METRICS.snapshot().items()
+                        if k.startswith(("fleet.", "serve.transport."))},
+            "backends": [
+                {**b.snapshot(), "breaker": b.breaker.snapshot()}
+                for b in self.backends],
+        }
+
+    def _forward(self, b: Backend, req: dict, claimed: bool = False) -> dict:
+        """One backend round-trip; never retried client-side (the
+        balancer IS the retry layer — failure must surface fast).
+
+        ``claimed``: the caller took the half-open probe slot
+        (``breaker.allow()``) and this request IS the probe. Unclaimed
+        read traffic (status fan-out, key relocation) feeds the breaker
+        only while it is CLOSED — cheap status successes must not close
+        a half-open breaker the real probe is still deciding, nor may a
+        stray read failure re-trip it and double the cooldown."""
+        was = b.breaker.state
+        feed = claimed or was == PeerBreaker.CLOSED
+        try:
+            resp = b.client.request(req, retry=False)
+        except TransportError as e:
+            b.note_error(e)
+            if feed:
+                b.breaker.record_failure(f"request failed: {e}")
+                self._transition_accounting(b, was)
+            raise
+        if feed:
+            b.breaker.record_success()
+            self._transition_accounting(b, was)
+        return resp
+
+    def _transition_accounting(self, b: Backend, was: str):
+        """Log/flight-note/count a breaker transition caused by forwarded
+        traffic — in BOTH directions: a submit acting as the half-open
+        probe can re-admit a backend, and the ejected/readmitted metric
+        pair must track it."""
+        now = b.breaker.state
+        if now == was:
+            return
+        self._note_transition(b, was, now)
+        from ..observe.metrics import METRICS
+
+        if was != "open" and now == "open":
+            METRICS.inc("fleet.balancer.ejected")
+        if was != "closed" and now == "closed":
+            METRICS.inc("fleet.balancer.readmitted")
+
+    def _route_submit(self, req: dict) -> dict:
+        from ..observe.metrics import METRICS
+
+        if self.draining:
+            return protocol.error_response(
+                "draining: balancer is not accepting new jobs")
+        METRICS.inc("fleet.balancer.submits")
+        dedupe = req.get("dedupe")
+        slept_hint = False
+        # route passes are bounded: each re-scan needs a state change
+        # (ejection, shed sleep) and the pathological flapping case must
+        # terminate with an explicit answer, not a spin
+        for _ in range(2 * len(self.backends) + 2):
+            candidates = self._healthy_backends()
+            holder = None
+            if dedupe:
+                with self._jobs_lock:
+                    sticky = self._dedupe_backend.get(dedupe)
+                if sticky is not None:
+                    holder, known_id = sticky
+                    if holder not in candidates:
+                        # the holder is ejected — but it may be ALIVE and
+                        # still executing (an ejection is a routing
+                        # verdict, not a death certificate). Routing the
+                        # key to a fresh backend would risk a second
+                        # execution; first see whether a takeover already
+                        # moved the job to a survivor, else refuse
+                        # explicitly — a refusal is retryable, a double
+                        # execution is not.
+                        holder = self._relocate_dedupe(dedupe, known_id)
+                        if holder is None:
+                            addr = sticky[0].address
+                            return protocol.error_response(
+                                f"backend {addr} holding dedupe key "
+                                f"{dedupe!r} is ejected and may still "
+                                "be executing it; retry once it "
+                                "recovers or its jobs are taken over")
+                    # a known key goes to its holder and NOWHERE else:
+                    # skipping past it mid-loop (probe slot taken, a
+                    # refusal) must refuse, not spill — any other
+                    # backend would execute a second copy
+                    candidates = [holder]
+            if not candidates:
+                return protocol.error_response(
+                    "no healthy backends (all "
+                    f"{len(self.backends)} ejected)")
+            sheds = []
+            failed_over = False
+            for b in candidates:
+                if not b.breaker.allow():
+                    if b is holder:
+                        return protocol.error_response(
+                            f"backend {b.address} holding dedupe key "
+                            f"{dedupe!r} is recovering (half-open probe "
+                            "in flight); retry shortly")
+                    continue  # half-open probe slot already out
+                try:
+                    # the forwarded submit is the half-open probe when the
+                    # backend is recovering — the PR 7 "the batch IS the
+                    # probe" idea applied to peers (allow() above claimed
+                    # the slot). No client-side retry: failover below is
+                    # the retry.
+                    resp = self._forward(b, req, claimed=True)
+                except ServeError as e:
+                    if not isinstance(e, TransportError):
+                        # the backend ANSWERED but refused the
+                        # conversation itself — handshake rejection
+                        # (token mismatch) or an old daemon rejecting the
+                        # hello op. The submit never reached admission,
+                        # so the next backend is safe regardless of
+                        # dedupe; the breaker hears about the misfit
+                        b.note_error(e)
+                        b.breaker.record_failure(f"request refused: {e}")
+                        if b is holder:
+                            return protocol.error_response(
+                                f"backend {b.address} holding dedupe "
+                                f"key {dedupe!r} refused the "
+                                f"conversation ({e}); not spilling the "
+                                "key elsewhere — retry once it answers")
+                        log.warning("balance: backend %s refused the "
+                                    "conversation (%s); trying the next",
+                                    b.address, e)
+                        continue
+                    if isinstance(e, TransportTimeout):
+                        # the backend may be ALIVE and still executing:
+                        # re-routing would run the job twice (the lease
+                        # takeover only arbitrates against dead
+                        # backends). Pin the key to this backend so a
+                        # RESUBMIT is refused rather than routed to a
+                        # fresh backend, and surface the timeout
+                        if dedupe is not None:
+                            self._remember_dedupe_pending(dedupe, b)
+                        return protocol.error_response(
+                            f"backend {b.address} timed out mid-submit "
+                            f"({e}); not failing over — the backend may "
+                            "still be executing it. Poll `status`, or "
+                            "retry and the balancer will hold the "
+                            "dedupe key to this backend")
+                    if dedupe is None:
+                        # the dead backend may have admitted it; without a
+                        # key a second submit could double-execute —
+                        # surface the failure, the client owns the retry
+                        return protocol.error_response(
+                            f"backend {b.address} failed mid-submit "
+                            f"({e}); resubmit with a dedupe key for "
+                            "automatic failover")
+                    METRICS.inc("fleet.balancer.rerouted")
+                    from ..observe.flight import FLIGHT
+
+                    FLIGHT.note("balancer.reroute", address=b.address,
+                                dedupe=dedupe)
+                    log.warning("balance: backend %s failed mid-submit; "
+                                "re-routing dedupe-keyed submit (%s)",
+                                b.address, e)
+                    failed_over = True
+                    continue
+                if resp.get("ok"):
+                    job = resp.get("job") or {}
+                    if job.get("id"):
+                        self._remember_job(job["id"], b, dedupe=dedupe)
+                        if not resp.get("deduped"):
+                            b.note_depth((b.depth or 0) + 1)
+                    return resp
+                reason = resp.get("error", "")
+                was_holder = b is holder
+                if was_holder:
+                    # the daemon answers a held dedupe key BEFORE any
+                    # admission check — so a shed/queue-full/refusal from
+                    # the holder proves the key is no longer held there
+                    # (job evicted from history, key reissued): this is a
+                    # fresh submit again, free to route anywhere
+                    with self._jobs_lock:
+                        self._dedupe_backend.pop(dedupe, None)
+                    holder = None
+                    failed_over = True  # state changed: re-scan unpinned
+                if "retry_after_s" in resp:
+                    sheds.append((resp["retry_after_s"], resp))
+                    continue  # pressure here; try a less loaded peer
+                if reason.startswith("queue full"):
+                    b.note_depth((b.depth or 0) + 1)  # stale depth: learn
+                    continue  # spill to the next backend
+                if was_holder:
+                    continue  # refusal from the ex-holder: others may admit
+                return resp  # real refusal (draining/quota/validation)
+            if sheds and not slept_hint:
+                # EVERY reachable backend is shedding: honor the smallest
+                # hint once (bounded), then retry the whole route — the
+                # anti-hot-loop contract, balancer side
+                hint = max(min(h for h, _ in sheds), 0.05)
+                METRICS.inc("fleet.balancer.shed_sleeps")
+                log.info("balance: all backends shedding; sleeping "
+                         "retry_after_s hint %.2fs", hint)
+                time.sleep(min(hint, MAX_SHED_SLEEP_S))
+                slept_hint = True
+                continue
+            if sheds:
+                # still shedding after one hint sleep: hand the (smallest)
+                # hint to the client verbatim
+                return min(sheds, key=lambda hr: hr[0])[1]
+            if failed_over:
+                continue  # every candidate died mid-submit: re-scan
+            return protocol.error_response(
+                "no backend admitted the job (all at capacity or "
+                "probing)")
+        return protocol.error_response(
+            "no backend admitted the job (route retries exhausted)")
+
+    def _route_status(self, req: dict) -> dict:
+        job_id = req.get("id")
+        if job_id is None:
+            # aggregate listing: every healthy backend's jobs + our depth
+            jobs = []
+            for b in self._healthy_backends():
+                try:
+                    resp = self._forward(b, req)
+                except ServeError:
+                    continue
+                if resp.get("ok"):
+                    jobs.extend(resp.get("jobs") or [])
+            return protocol.ok_response(jobs=jobs)
+        return self._routed_job_op(req, job_id)
+
+    def _route_cancel(self, req: dict) -> dict:
+        return self._routed_job_op(req, req["id"])
+
+    def _routed_job_op(self, req: dict, job_id: str) -> dict:
+        """status/cancel for one job id: mapped backend first, then fan
+        out — a lease takeover moves jobs between backends and the map
+        has no way to know. Fan-out reads never touch a half-open
+        breaker's probe slot (_forward feeds only closed breakers)."""
+        mapped = self._backend_for_job(job_id)
+        tried = []
+        last_refusal = None
+        if mapped is not None and mapped.breaker.state != "open":
+            tried.append(mapped)
+            try:
+                resp = self._forward(mapped, req)
+                if resp.get("ok"):
+                    return resp
+                # the job's own backend KNOWS it: its refusal ("job is
+                # running; never preempted" / "already cancelled") is the
+                # actionable answer — the fan-out's "unknown job" from
+                # peers must not mask it
+                last_refusal = resp
+            except ServeError:
+                pass
+        for b in self._healthy_backends():
+            if b in tried:
+                continue
+            try:
+                resp = self._forward(b, req)
+            except ServeError:
+                continue
+            if resp.get("ok"):
+                self._remember_job(job_id, b)  # learn the new home
+                return resp
+            if last_refusal is None:  # the mapped backend's answer wins
+                last_refusal = resp
+        return last_refusal or protocol.error_response(
+            f"unknown job {job_id}")

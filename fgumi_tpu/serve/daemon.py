@@ -1,11 +1,12 @@
 """The job-service daemon: socket server + warm-process job execution.
 
-One :class:`JobService` owns the Unix-domain listener, the scheduler, and
-the registry. Each admitted job is executed by re-entering the ordinary
-CLI (``cli.main``) on a worker thread — the whole point of the daemon is
-that this re-entry is *warm*: jax is imported, the persistent compile
-cache is enabled, and every jit executable compiled by an earlier job is
-still in memory, so repeated jobs skip straight to data movement.
+One :class:`JobService` owns the listeners (the Unix socket, plus an
+optional TCP listener for fleet operation), the scheduler, and the
+registry. Each admitted job is executed by re-entering the ordinary CLI
+(``cli.main``) on a worker thread — the whole point of the daemon is that
+this re-entry is *warm*: jax is imported, the persistent compile cache is
+enabled, and every jit executable compiled by an earlier job is still in
+memory, so repeated jobs skip straight to data movement.
 
 Per-job isolation rides on the context-scoped execution state introduced
 with this subsystem: the CLI gives every top-level invocation its own
@@ -15,24 +16,37 @@ submitting client's command line — so a job's output is byte-identical to
 the same command run standalone, and two concurrent jobs cannot see each
 other's counters.
 
+Transport rides on :mod:`.transport`: the frame-serving loop, per-
+connection deadlines and the connection cap on TCP, and the shared-secret
+handshake required on non-loopback binds are all enforced there; this
+module only answers validated frames.
+
+Fleet operation (``serve --journal-dir``): daemons sharing a journal
+directory each hold an fcntl lease on their own journal
+(:class:`~.journal.FleetLease`). A background scanner claims a dead peer's
+lease exactly once, requeues its incomplete jobs under their ORIGINAL ids
+(job ids are fleet-prefixed so they never collide), and renames the
+consumed journal — so a SIGKILL'd daemon's in-flight work completes on a
+survivor byte-identically with zero double-execution; dedupe keys
+arbitrate the race against a balancer re-routing the same submit.
+
 Lifecycle: ``drain`` (op) closes admission but keeps answering status;
 ``shutdown`` (op) or SIGTERM/SIGINT additionally exits once queued and
 running jobs finish. The socket file is unlinked on exit; a stale socket
 from a crashed daemon is detected (connect fails) and replaced on start.
 """
 
-import errno
 import json
 import logging
 import os
-import socket
 import threading
 import time
 
 from . import journal as journal_mod
-from . import protocol
+from . import protocol, transport
 from .jobs import TERMINAL, Job, JobRegistry
 from .scheduler import Scheduler
+from .transport import SocketBusy  # noqa: F401  (historical import path)
 
 log = logging.getLogger("fgumi_tpu")
 
@@ -49,10 +63,6 @@ def _drain_device_feeder(timeout: float = 30.0):
         return
     if not kern.DEVICE_FEEDER.drain(timeout=timeout):
         log.warning("device feeder did not drain within %.0fs", timeout)
-
-
-class SocketBusy(RuntimeError):
-    """Another live daemon already serves this socket path."""
 
 
 def _governor_pressure():
@@ -73,18 +83,42 @@ class JobService:
                  max_frame_bytes: int = protocol.MAX_FRAME_BYTES,
                  keep_finished: int = 1000, journal_path: str = None,
                  health_period_s: float = 0.0, max_per_client: int = 0,
-                 metrics_port: int = None):
+                 metrics_port: int = None, tcp=None, auth_token: str = None,
+                 conn_cap: int = transport.DEFAULT_CONN_CAP,
+                 io_timeout_s: float = transport.DEFAULT_IO_TIMEOUT_S,
+                 journal_dir: str = None, fleet_id: str = None,
+                 lease_scan_period_s: float = 2.0,
+                 lease_wait_s: float = 30.0):
+        if journal_dir and journal_path:
+            raise ValueError("--journal and --journal-dir are exclusive")
+        if journal_dir:
+            if not fleet_id:
+                raise ValueError("--journal-dir requires a fleet id")
+            journal_mod.validate_fleet_id(fleet_id)
         self.socket_path = socket_path
         self.max_frame_bytes = max_frame_bytes
         self.report_dir = report_dir
         self.registry = JobRegistry(keep_finished=keep_finished,
-                                    on_transition=self._on_transition)
+                                    on_transition=self._on_transition,
+                                    id_prefix=fleet_id if journal_dir
+                                    else "")
         self.scheduler = Scheduler(self._execute, self.registry,
                                    workers=workers, queue_limit=queue_limit,
                                    max_per_client=max_per_client)
         self.started_unix = time.time()
         self.journal_path = journal_path
         self.journal = None
+        self.journal_dir = journal_dir
+        self.fleet_id = fleet_id if journal_dir else None
+        self.lease_scan_period_s = float(lease_scan_period_s)
+        #: how long startup waits out a peer momentarily holding OUR
+        #: lease (it is consuming our predecessor's journal — one fsync'd
+        #: append per adopted job)
+        self.lease_wait_s = float(lease_wait_s)
+        self._lease = None
+        self._scanner = None
+        #: fleet accounting for the `stats` op (None-able section)
+        self.fleet_stats = None
         self.health_period_s = float(health_period_s or 0.0)
         self._monitor = None
         #: optional loopback HTTP listener (serve --metrics-port): /metrics
@@ -97,8 +131,15 @@ class JobService:
         self._dedupe = {}          # dedupe key -> job id (journal-durable)
         self._dedupe_lock = threading.Lock()
         self._recovered = False
-        self._sock = None
-        self._accept_thread = None
+        #: optional TCP listen address (host, port) beside the Unix socket
+        self.tcp = tuple(tcp) if tcp else None
+        self.auth_token = auth_token
+        self.conn_cap = conn_cap
+        self.io_timeout_s = io_timeout_s
+        self._unix = transport.UnixListener(socket_path) if socket_path \
+            else None
+        self._tcp_listener = None
+        self._frames = None
         self._shutdown = threading.Event()
         self._closed = False
 
@@ -179,6 +220,29 @@ class JobService:
 
     # -- crash recovery -----------------------------------------------------
 
+    def acquire_lease(self):
+        """Fleet mode: take the fcntl lease on this daemon's identity.
+
+        Idempotent; raises :class:`~.journal.LeaseHeld` when another live
+        daemon owns this fleet id — the CLI surfaces that as the same
+        fail-fast exit 2 a busy socket gets, BEFORE the device warm-up."""
+        if not self.journal_dir or self._lease is not None:
+            return
+        jpath, lpath = journal_mod.fleet_paths(self.journal_dir,
+                                               self.fleet_id)
+        lease = journal_mod.FleetLease(lpath)
+        lease.acquire(wait_s=self.lease_wait_s)
+        self._lease = lease
+        self.journal_path = jpath
+        self.fleet_stats = {
+            "fleet_id": self.fleet_id,
+            "journal_dir": self.journal_dir,
+            "lease": "held",
+            "lease_scan_period_s": self.lease_scan_period_s,
+            "takeovers": 0, "takeover_jobs": 0,
+            "takeover_skipped_dedupe": 0, "last_takeover": None,
+        }
+
     def recover(self):
         """Replay the journal (if configured) and requeue incomplete jobs.
 
@@ -191,58 +255,36 @@ class JobService:
         That re-run is byte-identical to a single run: atomic output
         commit (PR 1) guarantees the killed attempt published nothing.
         Also sweeps report-dir temp leftovers owned by dead pids and
-        older than the journal's last entry."""
+        older than the journal's last entry.
+
+        Fleet mode (``--journal-dir``): the daemon first takes the fcntl
+        lease on its own identity (:class:`~.journal.FleetLease`; raises
+        :class:`~.journal.LeaseHeld` if another live daemon owns this
+        fleet id), then recovers its own journal exactly as above."""
         if self._recovered:
             return
         self._recovered = True
+        self.acquire_lease()
         if not self.journal_path:
             return
         from ..observe.metrics import METRICS
 
         rep = journal_mod.replay(self.journal_path)
+        self.registry.reserve_ids(rep.max_job_num)
+        if self.journal_dir:
+            # a predecessor's journal a peer CONSUMED (takeover renamed it
+            # .claimed) replays nothing here — but the ids it minted now
+            # live on the survivor; reserve past them or this daemon would
+            # re-mint ids that already exist fleet-wide
+            claimed = self.journal_path + ".claimed"
+            if os.path.exists(claimed):
+                self.registry.reserve_ids(
+                    journal_mod.replay(claimed).max_job_num)
         self.journal = journal_mod.JobJournal(self.journal_path)
         self._sweep_report_temps(rep.last_entry_unix)
         requeued = 0
         for rec in rep.jobs:
-            job = Job(rec["id"], rec["argv"], rec["priority"],
-                      argv0=rec["argv0"], tag=rec["tag"],
-                      trace=rec["trace"], client=rec.get("client"))
-            if rec.get("submitted_unix"):
-                job.submitted_unix = rec["submitted_unix"]
-            terminal = rec["state"] in TERMINAL
-            if terminal:
-                job.state = rec["state"]
-                job.exit_status = rec["exit_status"]
-                job.error = rec["error"]
-                job.finished_unix = rec.get("finished_unix")
-            try:
-                self.registry.restore(job)
-            except ValueError:
-                continue  # duplicate record; first wins
-            if rec.get("dedupe") and rec["state"] != "cancelled":
-                # cancelled jobs never rebind their key: an
-                # admission-rejected submit releases its key on the live
-                # daemon (see the submit handler), and the journal records
-                # it only as submit+cancelled — rebinding here would answer
-                # a post-restart retry with the rejected record instead of
-                # executing it. (A user-cancelled job re-running on
-                # resubmit is the safe direction of the same rule.)
-                self._dedupe[rec["dedupe"]] = job.id
-            if not terminal:
-                self.journal.record_requeued(job.id)
-                admitted, reason = self.scheduler.submit(job)
-                if admitted:
-                    requeued += 1
-                else:  # shrunken capacity on restart: record the loss
-                    self.registry.mark_cancelled(job)
-                    if rec.get("dedupe") \
-                            and self._dedupe.get(rec["dedupe"]) == job.id:
-                        # same contract as a live admission reject: the
-                        # key is released so a retry executes instead of
-                        # being answered with the cancelled record
-                        del self._dedupe[rec["dedupe"]]
-                    log.warning("serve: could not requeue %s: %s",
-                                job.id, reason)
+            requeued += self._restore_record(rec, requeue_via_journal=False)
         if rep.records or requeued:
             log.info("serve: journal replayed %d record(s); %d job(s) "
                      "requeued", rep.records, requeued)
@@ -252,6 +294,153 @@ class JobService:
             METRICS.inc("serve.journal.truncated_bytes", rep.truncated_bytes)
         self.journal_stats = {"replayed": rep.records, "requeued": requeued,
                               "truncated_bytes": rep.truncated_bytes}
+
+    def _restore_record(self, rec: dict, requeue_via_journal: bool) -> int:
+        """Restore one replayed journal record into the live registry.
+
+        Shared by startup recovery (our own journal; the requeue is
+        implied by the journal we replay from) and fleet takeover (a
+        PEER's journal; ``requeue_via_journal=True`` writes the adopted
+        job into OUR journal so a later crash of this daemon re-recovers
+        it). Returns 1 when a job was requeued for execution."""
+        job = Job(rec["id"], rec["argv"], rec["priority"],
+                  argv0=rec["argv0"], tag=rec["tag"],
+                  trace=rec["trace"], client=rec.get("client"))
+        if rec.get("submitted_unix"):
+            job.submitted_unix = rec["submitted_unix"]
+        terminal = rec["state"] in TERMINAL
+        if terminal:
+            job.state = rec["state"]
+            job.exit_status = rec["exit_status"]
+            job.error = rec["error"]
+            job.finished_unix = rec.get("finished_unix")
+        dedupe = rec.get("dedupe")
+        if dedupe and rec["state"] != "cancelled":
+            # cancelled jobs never rebind their key: an admission-rejected
+            # submit releases its key on the live daemon (see the submit
+            # handler), and the journal records it only as
+            # submit+cancelled — rebinding here would answer a
+            # post-restart retry with the rejected record instead of
+            # executing it. (A user-cancelled job re-running on resubmit
+            # is the safe direction of the same rule.)
+            with self._dedupe_lock:
+                if requeue_via_journal:
+                    # PEER takeover: one atomic setdefault under the SAME
+                    # lock the live submit handler holds across its
+                    # check-and-bind — a balancer-re-routed submit racing
+                    # this takeover either sees our claim (and is
+                    # answered with the journal copy) or wins the key
+                    # first; never both executing.
+                    winner = self._dedupe.setdefault(dedupe, job.id)
+                else:
+                    # OUR OWN journal replay (startup, before the
+                    # listeners serve): later records rebind last-wins —
+                    # the live handler legitimately reissues a stale key
+                    # whose first job was evicted from history, and both
+                    # submits are in the journal. Nothing concurrent can
+                    # race this; supersede-cancel here would silently
+                    # drop a job the client believed admitted.
+                    self._dedupe[dedupe] = job.id
+                    winner = job.id
+            if winner != job.id and not terminal:
+                # the race the dedupe key exists to arbitrate: a balancer
+                # already re-routed this submit here (or another takeover
+                # adopted it). The journal copy must NOT run again — it is
+                # recorded as superseded, and clients polling the original
+                # id are pointed at the winning record.
+                job.state = "cancelled"
+                job.error = f"superseded by dedupe key (job {winner})"
+                job.finished_unix = time.time()
+                terminal = True
+                if self.fleet_stats is not None:
+                    self.fleet_stats["takeover_skipped_dedupe"] += 1
+        try:
+            self.registry.restore(job)
+        except ValueError:
+            return 0  # duplicate record; first wins
+        if terminal:
+            return 0
+        if requeue_via_journal and self.journal is not None:
+            self.journal.record_submit(job, dedupe)
+        if self.journal is not None:
+            self.journal.record_requeued(job.id)
+        admitted, reason = self.scheduler.submit(job)
+        if admitted:
+            return 1
+        # shrunken capacity on restart: record the loss
+        self.registry.mark_cancelled(job)
+        with self._dedupe_lock:
+            if dedupe and self._dedupe.get(dedupe) == job.id:
+                # same contract as a live admission reject: the
+                # key is released so a retry executes instead of
+                # being answered with the cancelled record
+                del self._dedupe[dedupe]
+        log.warning("serve: could not requeue %s: %s", job.id, reason)
+        return 0
+
+    # -- fleet takeover -----------------------------------------------------
+
+    def scan_for_takeovers(self) -> int:
+        """One pass over the journal dir: claim every dead peer's journal.
+
+        Returns the number of takeovers performed. Runs on the scanner
+        thread and (tests) synchronously; registry/scheduler/journal are
+        all thread-safe. A drained daemon adopts nothing — it is leaving."""
+        if not self.journal_dir or self.scheduler.draining:
+            return 0
+        from ..observe.metrics import METRICS
+
+        METRICS.inc("fleet.lease_scans")
+        claimed = 0
+        for peer_id, jpath, lpath in journal_mod.scan_peer_journals(
+                self.journal_dir, self.fleet_id):
+            fd = journal_mod.FleetLease.try_claim(lpath)
+            if fd is None:
+                continue  # the peer lives; its flock is its heartbeat
+            try:
+                if not os.path.exists(jpath):
+                    continue  # lost the race to another claimant
+                self._takeover(peer_id, jpath)
+                claimed += 1
+            except Exception:  # noqa: BLE001 - one bad journal != daemon
+                log.exception("fleet: takeover of %s failed", peer_id)
+            finally:
+                os.close(fd)
+        return claimed
+
+    def _takeover(self, peer_id: str, jpath: str):
+        """Adopt one dead peer's journal (caller holds its lease lock).
+
+        Incomplete jobs are requeued here under their ORIGINAL ids and
+        journaled into OUR journal (so this daemon crashing later loses
+        nothing); terminal jobs are restored read-only so clients polling
+        across the takeover still resolve them. The consumed journal is
+        renamed to ``.claimed`` under the lock — a second claimant or the
+        restarting peer finds nothing to replay: exactly-once by
+        construction."""
+        from ..observe.flight import FLIGHT
+        from ..observe.metrics import METRICS
+
+        rep = journal_mod.replay(jpath)
+        requeued = 0
+        for rec in rep.jobs:
+            requeued += self._restore_record(rec, requeue_via_journal=True)
+        claimed_path = journal_mod.mark_claimed(jpath)
+        METRICS.inc("fleet.takeovers")
+        METRICS.inc("fleet.takeover_jobs", requeued)
+        if self.fleet_stats is not None:
+            self.fleet_stats["takeovers"] += 1
+            self.fleet_stats["takeover_jobs"] += requeued
+            self.fleet_stats["last_takeover"] = {
+                "peer": peer_id, "requeued": requeued,
+                "records": rep.records, "t_unix": round(time.time(), 3),
+                "journal": claimed_path,
+            }
+        FLIGHT.note("fleet.takeover", peer=peer_id, requeued=requeued,
+                    records=rep.records)
+        log.warning("fleet: took over journal of dead peer %r — %d "
+                    "record(s) replayed, %d job(s) requeued under their "
+                    "original ids", peer_id, rep.records, requeued)
 
     def _sweep_report_temps(self, before_unix):
         """Remove dead-pid atomic-output temps from the report dir.
@@ -289,48 +478,33 @@ class JobService:
 
     # -- socket server ------------------------------------------------------
 
-    def _claim_socket(self):
-        """Bind the listener, replacing a *dead* daemon's socket file only.
-
-        Stale means the connect is actively refused (no listener behind the
-        file). A timeout or transient error (daemon stopped in a debugger,
-        backlog full under a client burst) is treated as BUSY — unlinking a
-        live daemon's socket would split-brain the service and that
-        daemon's exit would then delete *our* socket file."""
-        if os.path.exists(self.socket_path):
-            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            try:
-                probe.settimeout(1.0)
-                probe.connect(self.socket_path)
-            except (ConnectionRefusedError, FileNotFoundError):
-                log.info("serve: replacing stale socket %s", self.socket_path)
-                try:
-                    os.unlink(self.socket_path)
-                except FileNotFoundError:
-                    pass
-            except OSError as e:
-                raise SocketBusy(
-                    f"daemon at {self.socket_path} did not answer ({e}); "
-                    "not replacing a possibly-live socket")
-            else:
-                raise SocketBusy(
-                    f"another daemon is already serving {self.socket_path}")
-            finally:
-                probe.close()
-        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        sock.bind(self.socket_path)
-        sock.listen(16)
-        return sock
+    def _build_frames(self):
+        listeners = []
+        if self._unix is not None:
+            listeners.append(self._unix)
+        if self.tcp is not None and self._tcp_listener is None:
+            host, port = self.tcp
+            self._tcp_listener = transport.TcpListener(
+                host, port, token=self.auth_token,
+                io_timeout_s=self.io_timeout_s, conn_cap=self.conn_cap)
+        if self._tcp_listener is not None:
+            listeners.append(self._tcp_listener)
+        if not listeners:
+            raise ValueError("serve needs a --socket or a --tcp listener")
+        return transport.FrameServer(
+            self.handle_request, listeners, self.max_frame_bytes,
+            on_shutdown=self._shutdown.set, name="fgumi-serve")
 
     def bind(self):
-        """Claim the socket AND the metrics port WITHOUT starting to
+        """Claim every listener AND the metrics port WITHOUT starting to
         serve. Raises SocketBusy / OSError.
 
         Split from :meth:`start` so the CLI can fail fast on a busy
-        socket or metrics port *before* paying (and disturbing) the
-        single-tenant device warm-up."""
-        if self._sock is None:
-            self._sock = self._claim_socket()
+        socket, TCP port, or metrics port *before* paying (and
+        disturbing) the single-tenant device warm-up."""
+        if self._frames is None:
+            self._frames = self._build_frames()
+        self._frames.bind()  # busy unix socket / EADDRINUSE surface here
         if self.metrics_port is not None and self._introspection is None:
             from .introspect import IntrospectionServer
 
@@ -338,9 +512,20 @@ class JobService:
                                                       self.metrics_port)
             self._introspection.bind()  # EADDRINUSE surfaces here
 
+    @property
+    def tcp_port(self):
+        """The bound TCP port (after bind; port 0 = ephemeral resolves)."""
+        return self._tcp_listener.port if self._tcp_listener else None
+
+    def start_transport(self):
+        """Bind and serve frames WITHOUT recovery, workers, or monitors —
+        the protocol-surface harness the wire tests drive."""
+        self.bind()
+        self._frames.start()
+
     def start(self):
         """Bind (if not already), recover, start workers and the accept
-        loop. Recovery runs before the pool so requeued jobs hold their
+        loops. Recovery runs before the pool so requeued jobs hold their
         original queue positions ahead of any fresh submission."""
         self.bind()
         self.recover()
@@ -353,62 +538,17 @@ class JobService:
             self._monitor.start()
         if self._introspection is not None:
             self._introspection.start()
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name="fgumi-serve-accept", daemon=True)
-        self._accept_thread.start()
-        log.info("serve: listening on %s (%d workers, queue limit %d%s)",
-                 self.socket_path, self.scheduler.workers,
-                 self.scheduler.queue_limit,
+        if self.journal_dir and self.lease_scan_period_s > 0:
+            self._scanner = _TakeoverScanner(self, self.lease_scan_period_s)
+            self._scanner.start()
+        self._frames.start()
+        log.info("serve: listening on %s (%d workers, queue limit %d%s%s)",
+                 " + ".join(lst.describe()
+                            for lst in self._frames.listeners),
+                 self.scheduler.workers, self.scheduler.queue_limit,
                  f", journal {self.journal_path}" if self.journal_path
-                 else "")
-
-    def _accept_loop(self):
-        # keep accepting through a drain: clients must be able to poll
-        # status while queued/running jobs finish (the documented drain
-        # contract); the loop ends when close() closes the listener
-        while True:
-            try:
-                conn, _ = self._sock.accept()
-            except OSError:
-                return  # listener closed during shutdown
-            t = threading.Thread(target=self._serve_connection, args=(conn,),
-                                 name="fgumi-serve-conn", daemon=True)
-            t.start()
-
-    def _serve_connection(self, conn: socket.socket):
-        stream = conn.makefile("rb")
-        try:
-            while True:
-                try:
-                    req = protocol.read_frame(stream, self.max_frame_bytes)
-                except protocol.ProtocolError as e:
-                    self._send(conn, protocol.error_response(str(e)))
-                    return  # framing is gone; close rather than resync
-                if req is None:
-                    return
-                resp = self.handle_request(req)
-                self._send(conn, resp)
-                # arm shutdown only AFTER the reply is on the wire: the
-                # main thread exits the process once the pool quiesces,
-                # which on an idle daemon can beat this thread's sendall
-                # and reset the client mid-response
-                if req.get("op") == "shutdown" and resp.get("ok"):
-                    self._shutdown.set()
-        except OSError:
-            pass  # peer went away mid-frame; nothing to answer
-        finally:
-            try:
-                stream.close()
-            except OSError:
-                pass
-            conn.close()
-
-    @staticmethod
-    def _send(conn, resp: dict):
-        try:
-            conn.sendall(protocol.encode_frame(resp))
-        except OSError:
-            pass
+                 else "",
+                 f", fleet id {self.fleet_id}" if self.fleet_id else "")
 
     # -- request dispatch (transport-independent; tests call it directly) ---
 
@@ -417,6 +557,12 @@ class JobService:
         if err is not None:
             return protocol.error_response(err)
         op = req["op"]
+        if op == "hello":
+            # the transport layer enforces WHEN a hello is required (first
+            # frame on an auth-required listener); this answers WHETHER
+            # the offered token matches
+            return transport.hello_response("fgumi-tpu", self.auth_token,
+                                            req)
         if op == "ping":
             extra = {}
             if self.scheduler.max_per_client:
@@ -431,8 +577,9 @@ class JobService:
                 **extra)
         if op == "stats":
             # live introspection: scheduler/quota/journal/breaker/governor/
-            # device snapshots + latency histogram summaries — the same
-            # builder feeds /metrics, so the two surfaces cannot disagree
+            # device/fleet snapshots + latency histogram summaries — the
+            # same builder feeds /metrics, so the two surfaces cannot
+            # disagree
             from .introspect import service_stats
 
             return protocol.ok_response(stats=service_stats(self))
@@ -512,7 +659,7 @@ class JobService:
             self.scheduler.drain()
             return protocol.ok_response(**self.scheduler.depth())
         if op == "shutdown":
-            # drain here; the socket layer arms the exit event after the
+            # drain here; the transport layer arms the exit event after the
             # response is sent (direct handle_request callers — tests, an
             # embedding app — follow with request_shutdown themselves)
             self.scheduler.drain()
@@ -537,27 +684,54 @@ class JobService:
         _drain_device_feeder()
 
     def close(self):
-        """Tear the listener down and remove the socket file (idempotent)."""
+        """Tear the listeners down and remove the socket file (idempotent)."""
         if self._closed:
             return
         self._closed = True
         self._shutdown.set()
+        if self._scanner is not None:
+            self._scanner.stop()
         if self._monitor is not None:
             self._monitor.stop()
         if self._introspection is not None:
             self._introspection.stop()
         if self.journal is not None:
             self.journal.close()
-        if self._sock is not None:
-            try:
-                self._sock.close()
-            except OSError:
-                pass
-        try:
-            os.unlink(self.socket_path)
-        except OSError as e:
-            if e.errno != errno.ENOENT:
-                log.debug("serve: could not remove socket %s: %s",
-                          self.socket_path, e)
+        if self._frames is not None:
+            self._frames.close()
+        if self._unix is not None:
+            self._unix.unlink()
+        if self._lease is not None:
+            self._lease.release()
         log.info("serve: stopped (%s)",
                  json.dumps(self.registry.counts(), sort_keys=True))
+
+
+class _TakeoverScanner:
+    """Background loop claiming dead peers' journals (fleet mode)."""
+
+    def __init__(self, service: JobService, period_s: float):
+        self.service = service
+        self.period_s = period_s
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop,
+                                        name="fgumi-fleet-lease",
+                                        daemon=True)
+        self._thread.start()
+        log.info("fleet: lease takeover scan every %.1fs in %s",
+                 self.period_s, self.service.journal_dir)
+
+    def stop(self, timeout: float = 5.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def _loop(self):
+        while not self._stop.wait(self.period_s):
+            try:
+                self.service.scan_for_takeovers()
+            except Exception:  # noqa: BLE001 - scanner must survive
+                log.exception("fleet: takeover scan raised")

@@ -33,7 +33,9 @@ import time
 log = logging.getLogger("fgumi_tpu")
 
 #: stats payload schema (versioned like the wire protocol + run report).
-STATS_SCHEMA_VERSION = 1
+#: v2 added the ``fleet`` section (journal-lease takeover accounting;
+#: None outside --journal-dir fleet mode).
+STATS_SCHEMA_VERSION = 2
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -67,6 +69,7 @@ def service_stats(service) -> dict:
         "max_per_client": sched.max_per_client,
         "quota": sched.client_quota_state(),
         "journal": _journal_section(service),
+        "fleet": _fleet_section(service),
         "metrics": METRICS.snapshot(),
         "latency": METRICS.summaries(),
         "device": stats.snapshot() if stats is not None else None,
@@ -82,6 +85,16 @@ def _journal_section(service):
         return None
     return {"path": service.journal_path,
             **getattr(service, "journal_stats", {})}
+
+
+def _fleet_section(service):
+    """Journal-lease fleet accounting (``serve --journal-dir``): fleet id,
+    lease state, takeover history, and the live load figure the balancer
+    routes by. None on a standalone daemon."""
+    stats = getattr(service, "fleet_stats", None)
+    if stats is None:
+        return None
+    return {**stats, "active_jobs": service.scheduler.active()}
 
 
 def _monitor_section(service):

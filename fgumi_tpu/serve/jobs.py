@@ -87,7 +87,8 @@ class InvalidTransition(RuntimeError):
 class JobRegistry:
     """Thread-safe id -> :class:`Job` store enforcing the state machine."""
 
-    def __init__(self, keep_finished: int = 1000, on_transition=None):
+    def __init__(self, keep_finished: int = 1000, on_transition=None,
+                 id_prefix: str = ""):
         self._lock = threading.Lock()
         self._jobs = {}
         self._order = []  # insertion order, for stable listing
@@ -95,6 +96,10 @@ class JobRegistry:
         # of rescanning the whole history per submission
         self._finished = collections.deque()
         self._next_id = 1
+        #: fleet-mode id namespace: daemons sharing a --journal-dir mint
+        #: "<fleet-id>-j-<n>" so a takeover can requeue a peer's job under
+        #: its ORIGINAL id with no collision against the survivor's own
+        self._id_prefix = f"{id_prefix}-" if id_prefix else ""
         self._keep_finished = keep_finished
         #: called as on_transition(job) after every state change — the
         #: daemon's journal hook (fires outside the registry lock, after
@@ -105,13 +110,22 @@ class JobRegistry:
                tag: str = None, trace: bool = False,
                client: str = None) -> Job:
         with self._lock:
-            job = Job(f"j-{self._next_id}", argv, priority, argv0=argv0,
-                      tag=tag, trace=trace, client=client)
+            job = Job(f"{self._id_prefix}j-{self._next_id}", argv, priority,
+                      argv0=argv0, tag=tag, trace=trace, client=client)
             self._next_id += 1
             self._jobs[job.id] = job
             self._order.append(job.id)
             self._evict_locked()
             return job
+
+    def reserve_ids(self, max_seen: int):
+        """Never mint an id at or below ``max_seen``. Fleet restart
+        hygiene: a daemon whose journal was consumed by a peer takeover
+        (renamed ``.claimed``) replays nothing, but the ids it minted
+        before dying now LIVE on the survivor — re-minting them would
+        break the fleet-wide-unique-id invariant takeover depends on."""
+        with self._lock:
+            self._next_id = max(self._next_id, int(max_seen) + 1)
 
     def restore(self, job: Job):
         """Insert a pre-built job (journal replay): the id is preserved so

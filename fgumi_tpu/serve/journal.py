@@ -32,9 +32,11 @@ registry read-only so clients polling an old id get its final record, and
 returns the already-finished job instead of running it twice.
 """
 
+import fcntl
 import json
 import logging
 import os
+import re
 import threading
 import time
 
@@ -200,3 +202,156 @@ class JobJournal:
         with self._lock:
             if not self._f.closed:
                 self._f.close()
+
+
+# ---------------------------------------------------------------------------
+# fleet leases: journal ownership across daemons sharing a --journal-dir
+#
+# The liveness primitive is an fcntl flock held on `<fleet-id>.lease` for
+# the OWNING daemon's whole lifetime. flock dies with the process — even
+# SIGKILL — so "can I take this lock?" is an exact liveness test with no
+# heartbeat clocks to tune and no clock-skew failure mode. Takeover is
+# therefore race-free by construction: exactly one claimant can hold a dead
+# peer's lease lock while consuming its journal, and the journal is renamed
+# to `<fleet-id>.journal.claimed` under that lock, so a late second
+# claimant (or the dead daemon restarting) finds nothing to replay. All
+# daemons must share one real filesystem (flock over NFS is advisory at
+# best — docs/serving.md "Fleet operation").
+
+#: fleet ids are path-component-safe by construction.
+_FLEET_ID_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,63}$")
+
+_JOURNAL_SUFFIX = ".journal"
+_LEASE_SUFFIX = ".lease"
+_CLAIMED_SUFFIX = ".journal.claimed"
+
+
+class LeaseHeld(RuntimeError):
+    """The lease is held by a live process (reason in str())."""
+
+
+def validate_fleet_id(fleet_id: str) -> str:
+    if not isinstance(fleet_id, str) or not _FLEET_ID_RE.match(fleet_id):
+        raise ValueError(
+            f"invalid fleet id {fleet_id!r}: must match "
+            "[A-Za-z0-9][A-Za-z0-9._-]{0,63}")
+    return fleet_id
+
+
+def fleet_paths(journal_dir: str, fleet_id: str):
+    """(journal_path, lease_path) for one daemon's identity in the dir."""
+    validate_fleet_id(fleet_id)
+    return (os.path.join(journal_dir, fleet_id + _JOURNAL_SUFFIX),
+            os.path.join(journal_dir, fleet_id + _LEASE_SUFFIX))
+
+
+def scan_peer_journals(journal_dir: str, own_id: str):
+    """Unclaimed peer journals in the dir: [(peer_id, journal_path,
+    lease_path)], excluding our own identity. Sorted for deterministic
+    claim order."""
+    out = []
+    try:
+        names = os.listdir(journal_dir)
+    except OSError:
+        return out
+    for name in sorted(names):
+        if not name.endswith(_JOURNAL_SUFFIX):
+            continue
+        peer_id = name[:-len(_JOURNAL_SUFFIX)]
+        if peer_id == own_id or not _FLEET_ID_RE.match(peer_id):
+            continue
+        out.append((peer_id,
+                    os.path.join(journal_dir, name),
+                    os.path.join(journal_dir, peer_id + _LEASE_SUFFIX)))
+    return out
+
+
+class FleetLease:
+    """The flock held on ``<fleet-id>.lease`` for a daemon's lifetime.
+
+    :meth:`acquire` is how a daemon claims its own identity at startup
+    (bounded retry: a peer may hold our lock for the instant it takes to
+    claim our crashed predecessor's journal); :meth:`try_claim` is the
+    one-shot non-blocking grab a takeover scanner uses on a PEER's lease
+    — returns None while the peer lives."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fd = None
+
+    @property
+    def held(self) -> bool:
+        return self._fd is not None
+
+    def acquire(self, wait_s: float = 30.0, poll_s: float = 0.1):
+        """Take the lease or raise :class:`LeaseHeld`.
+
+        The bounded wait covers the legitimate contention window — a
+        surviving peer holds OUR lease while it consumes our
+        predecessor's journal, which is one fsync'd WAL append per
+        adopted job and can take seconds for a deep queue on a loaded
+        disk. Anything longer means a live daemon with the same fleet
+        id, which is a configuration error."""
+        if self._fd is not None:
+            return
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                    exist_ok=True)
+        fd = os.open(self.path, os.O_RDWR | os.O_CREAT, 0o600)
+        deadline = time.monotonic() + max(wait_s, 0.0)
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    os.close(fd)
+                    raise LeaseHeld(
+                        f"fleet lease {self.path} is held by a live "
+                        "process (another daemon with this fleet id?)")
+                time.sleep(poll_s)
+        # advisory breadcrumb for operators; the LOCK is the authority
+        try:
+            os.ftruncate(fd, 0)
+            os.write(fd, json.dumps(
+                {"pid": os.getpid(), "acquired_unix": round(time.time(), 3)}
+            ).encode() + b"\n")
+        except OSError:
+            pass
+        self._fd = fd
+
+    def release(self):
+        if self._fd is not None:
+            try:
+                os.close(self._fd)  # closing the fd drops the flock
+            except OSError:
+                pass
+            self._fd = None
+
+    @staticmethod
+    def try_claim(path: str):
+        """Non-blocking exclusive grab of a (peer's) lease file.
+
+        Returns an open fd HOLDING the lock when the owner is provably
+        dead (flock released by the kernel on its exit), or None while
+        the owner lives. The caller must ``os.close()`` the fd once the
+        claim work is done."""
+        try:
+            fd = os.open(path, os.O_RDWR | os.O_CREAT, 0o600)
+        except OSError:
+            return None
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return None
+        return fd
+
+
+def mark_claimed(journal_path: str) -> str:
+    """Rename a consumed peer journal to its ``.claimed`` audit name
+    (must be called while holding the peer's lease lock). A previous
+    claim artifact at the target is replaced — the newest takeover is
+    the interesting one."""
+    claimed = journal_path[:-len(_JOURNAL_SUFFIX)] + _CLAIMED_SUFFIX
+    os.replace(journal_path, claimed)
+    return claimed

@@ -21,10 +21,18 @@ Requests::
     {"v": 1, "op": "ping"}             # daemon liveness + config echo
     {"v": 1, "op": "stats"}            # live introspection snapshot
                                        # (scheduler/quota/journal/breaker/
-                                       # governor/device + latency
+                                       # governor/device/fleet + latency
                                        # histogram summaries; daemons
                                        # predating the op reject it cleanly
                                        # with "unknown op 'stats'")
+    {"v": 1, "op": "hello",            # transport handshake (fleet tier):
+     "token": "shared-secret"}         # REQUIRED as the first frame on a
+                                       # non-loopback TCP connection; on a
+                                       # Unix/loopback listener it is
+                                       # accepted but optional. Old daemons
+                                       # reject it cleanly with "unknown op
+                                       # 'hello'" — a new balancer probing
+                                       # an old daemon gets a loud answer
 
 Responses are ``{"v": 1, "ok": true, ...}`` or
 ``{"v": 1, "ok": false, "error": "<reason>"}``. Submit acceptance returns
@@ -51,7 +59,7 @@ PROTOCOL_VERSION = 1
 MAX_FRAME_BYTES = 1 << 20
 
 OPS = frozenset({"submit", "status", "cancel", "drain", "shutdown", "ping",
-                 "stats"})
+                 "stats", "hello"})
 
 #: Priority classes, best-first. FIFO within a class.
 PRIORITIES = ("high", "normal", "low")
@@ -128,6 +136,10 @@ def validate_request(obj: dict):
         if client is not None and (not isinstance(client, str)
                                    or not client):
             return "client must be a non-empty string"
+    if op == "hello":
+        token = obj.get("token")
+        if token is not None and not isinstance(token, str):
+            return "hello token must be a string"
     if op in ("cancel",) and not isinstance(obj.get("id"), str):
         return f"{op} requires id: a job id string"
     if "id" in obj and obj["id"] is not None \

@@ -199,6 +199,13 @@ class Scheduler:
                     "queue_limit": self.queue_limit,
                     "draining": self._draining}
 
+    def active(self) -> int:
+        """queued + running — the load figure fleet routing is based on
+        (the balancer reads it off the stats op; the stats `fleet`
+        section carries it directly)."""
+        with self._cv:
+            return len(self._heap) + self._running
+
     # -- worker -------------------------------------------------------------
 
     def _worker_loop(self, widx: int):

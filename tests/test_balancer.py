@@ -1,0 +1,450 @@
+"""Fleet balancer: the per-backend ejection breaker, queue-depth routing,
+dedupe-keyed failover, shed-hint backpressure, and job-id fan-out."""
+
+import time
+
+import pytest
+
+from fgumi_tpu.serve import balancer as balancer_mod
+from fgumi_tpu.serve.balancer import Balancer, PeerBreaker
+from fgumi_tpu.serve.client import ShedError, TransportError
+from fgumi_tpu.serve.daemon import JobService
+
+# ---------------------------------------------------------------------------
+# PeerBreaker units (injected clock)
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_ejects_after_consecutive_failures():
+    clk = _Clock()
+    b = PeerBreaker(eject_failures=2, cooldown_s=10, now=clk)
+    assert b.state == "closed" and b.allow()
+    b.record_failure("probe refused")
+    assert b.state == "closed"  # one failure is weather
+    b.record_success()
+    b.record_failure("probe refused")
+    assert b.state == "closed"  # success reset the score
+    b.record_failure("x")
+    b.record_failure("x")
+    assert b.state == "open" and not b.allow()
+
+
+def test_breaker_half_open_single_probe_and_readmit():
+    clk = _Clock()
+    b = PeerBreaker(eject_failures=1, cooldown_s=10, probe_successes=2,
+                    now=clk)
+    b.record_failure("dead")
+    assert b.state == "open"
+    clk.t = 10.0
+    assert b.state == "half-open"
+    assert b.allow()        # claims THE probe slot
+    assert not b.allow()    # only one outstanding probe
+    b.record_success()
+    assert b.state == "half-open"  # needs 2 consecutive
+    assert b.allow()
+    b.record_success()
+    assert b.state == "closed"
+
+
+def test_breaker_retrip_doubles_cooldown():
+    clk = _Clock()
+    b = PeerBreaker(eject_failures=1, cooldown_s=10, now=clk)
+    b.record_failure("dead")
+    clk.t = 10.0
+    assert b.allow()
+    b.record_failure("still dead")  # probe failed: reopen, trips=2
+    assert b.state == "open"
+    clk.t = 10.0 + 19.9
+    assert b.state == "open"        # cooldown doubled to 20
+    clk.t = 10.0 + 20.1
+    assert b.state == "half-open"
+
+
+# ---------------------------------------------------------------------------
+# routing over live in-process daemons (unix sockets; workers never start,
+# so queue depths are deterministic)
+
+
+@pytest.fixture
+def fleet(tmp_path):
+    svcs = []
+    for name in ("a", "b"):
+        svc = JobService(str(tmp_path / f"{name}.sock"), workers=1,
+                         queue_limit=8)
+        svc.start_transport()
+        svcs.append(svc)
+    bal = Balancer(f"unix:{tmp_path}/front.sock",
+                   [f"unix:{s.socket_path}" for s in svcs],
+                   poll_period_s=0.1, eject_failures=2, cooldown_s=0.2)
+    yield bal, svcs
+    bal.close()
+    for s in svcs:
+        s.close()
+
+
+def _submit(bal, dedupe=None):
+    req = {"v": 1, "op": "submit", "argv": ["sort", "-i", "a", "-o", "b"]}
+    if dedupe:
+        req["dedupe"] = dedupe
+    return bal.handle_request(req)
+
+
+def test_routes_submit_to_least_loaded_backend(fleet):
+    bal, (a, b) = fleet
+    # preload backend a with two jobs directly
+    for _ in range(2):
+        a.handle_request({"v": 1, "op": "submit", "argv": ["sort"]})
+    bal.poll_backends_once()
+    assert bal.backends[0].depth == 2 and bal.backends[1].depth == 0
+    resp = _submit(bal)
+    assert resp["ok"]
+    # the job landed on the empty backend
+    assert b.registry.get(resp["job"]["id"]) is not None
+    # and the balancer remembers the home for status routing
+    status = bal.handle_request({"v": 1, "op": "status",
+                                 "id": resp["job"]["id"]})
+    assert status["ok"] and status["job"]["id"] == resp["job"]["id"]
+
+
+def test_ejects_dead_backend_and_routes_to_survivor(fleet):
+    bal, (a, b) = fleet
+    bal.poll_backends_once()
+    a.close()  # SIGKILL from the balancer's perspective
+    bal.poll_backends_once()
+    bal.poll_backends_once()  # eject_failures=2 consecutive probes
+    assert bal.backends[0].breaker.state == "open"
+    resp = _submit(bal)
+    assert resp["ok"]
+    assert b.registry.get(resp["job"]["id"]) is not None
+    snap = bal.stats_snapshot()
+    assert [be["state"] for be in snap["backends"]] == ["open", "closed"]
+
+
+def test_half_open_probe_readmits_restarted_backend(fleet, tmp_path):
+    bal, (a, b) = fleet
+    bal.poll_backends_once()
+    path = a.socket_path
+    a.close()
+    bal.poll_backends_once()
+    bal.poll_backends_once()
+    assert bal.backends[0].breaker.state == "open"
+    # restart the backend on the same address
+    a2 = JobService(path, workers=1, queue_limit=8)
+    a2.start_transport()
+    try:
+        time.sleep(0.25)  # cooldown_s=0.2 elapses -> half-open
+        bal.poll_backends_once()  # probe 1 ok
+        bal.poll_backends_once()  # probe 2 ok -> closed
+        assert bal.backends[0].breaker.state == "closed"
+    finally:
+        a2.close()
+
+
+def test_dedupe_submit_reroutes_on_transport_failure(fleet, monkeypatch):
+    bal, (a, b) = fleet
+    bal.poll_backends_once()
+
+    def boom(req, retry=True, timeout=None):
+        raise TransportError("connection reset mid-submit")
+
+    # backend a looks healthy but dies on the forward; depth order makes
+    # it the first candidate
+    monkeypatch.setattr(bal.backends[0].client, "request", boom)
+    bal.backends[0].note_depth(0)
+    bal.backends[1].note_depth(1)
+    resp = _submit(bal, dedupe="k-1")
+    assert resp["ok"]
+    assert b.registry.get(resp["job"]["id"]) is not None
+    # a keyless submit through the same failure surfaces the error with
+    # the failover hint instead of risking a double execution
+    resp2 = _submit(bal)
+    assert not resp2["ok"]
+    assert "dedupe key" in resp2["error"]
+
+
+def test_timeout_never_fails_over_even_with_dedupe(fleet, monkeypatch):
+    """A request timeout means the backend may be ALIVE and still
+    executing the submit: failing over would run the job twice (lease
+    takeover only arbitrates against dead backends). The balancer must
+    surface the timeout instead."""
+    from fgumi_tpu.serve.client import TransportTimeout
+
+    bal, (a, b) = fleet
+    bal.poll_backends_once()
+
+    def hang(req, retry=True, timeout=None):
+        raise TransportTimeout("daemon did not answer within the timeout")
+
+    monkeypatch.setattr(bal.backends[0].client, "request", hang)
+    bal.backends[0].note_depth(0)
+    bal.backends[1].note_depth(1)
+    resp = _submit(bal, dedupe="k-timeout")
+    assert not resp["ok"]
+    assert "timed out mid-submit" in resp["error"]
+    # nothing landed on the other backend
+    assert not b.registry.list()
+
+
+def test_dedupe_resubmit_refused_while_holder_ejected(fleet, monkeypatch):
+    """A dedupe key pinned (pending) to a timed-out backend must be
+    REFUSED — not routed to a fresh backend — once the holder is
+    ejected: the holder may be alive and still executing."""
+    from fgumi_tpu.serve.client import TransportTimeout
+
+    bal, (a, b) = fleet
+    bal.poll_backends_once()
+
+    def hang(req, retry=True, timeout=None):
+        raise TransportTimeout("no answer")
+
+    monkeypatch.setattr(bal.backends[0].client, "request", hang)
+    bal.backends[0].note_depth(0)
+    bal.backends[1].note_depth(1)
+    first = _submit(bal, dedupe="k-pin")
+    assert not first["ok"] and "timed out mid-submit" in first["error"]
+    # eject the holder (the pinned backend), then resubmit the key
+    bal.backends[0].breaker.record_failure("x")
+    bal.backends[0].breaker.record_failure("x")
+    assert bal.backends[0].breaker.state == "open"
+    again = _submit(bal, dedupe="k-pin")
+    assert not again["ok"] and "may still be executing" in again["error"]
+    assert not b.registry.list()  # no second copy anywhere
+
+
+def test_keyed_resubmit_never_spills_past_half_open_holder(fleet):
+    """A half-open holder whose single probe slot is already claimed
+    must REFUSE the keyed resubmit — skipping past it to another
+    backend would execute a second copy."""
+    bal, (a, b) = fleet
+    bal.poll_backends_once()
+    resp = _submit(bal, dedupe="k-hold")
+    assert resp["ok"] and a.registry.get(resp["job"]["id"]) is not None
+    br = bal.backends[0].breaker
+    br.record_failure("x")
+    br.record_failure("x")
+    assert br.state == "open"
+    # walk it to half-open and claim the probe slot (the health loop's
+    # probe in real life)
+    br._now = lambda t=[0]: time.monotonic() + 3600
+    assert br.state == "half-open"
+    assert br.allow() and not br.allow()
+    again = _submit(bal, dedupe="k-hold")
+    assert not again["ok"]
+    assert "half-open probe in flight" in again["error"]
+    # the other backend never saw a copy
+    assert not b.registry.list()
+
+
+def test_dedupe_relocates_to_takeover_claimant(fleet):
+    """When the key's CONFIRMED holder is ejected but the job now lives
+    on a survivor (lease takeover), the resubmit follows the job."""
+    bal, (a, b) = fleet
+    bal.poll_backends_once()
+    # confirmed submit onto backend a
+    resp = _submit(bal, dedupe="k-move")
+    jid = resp["job"]["id"]
+    assert a.registry.get(jid) is not None
+    # simulate the takeover: the job (and its key) moved to backend b
+    b.registry.restore(a.registry.get(jid))
+    b._dedupe["k-move"] = jid
+    bal.backends[0].breaker.record_failure("dead")
+    bal.backends[0].breaker.record_failure("dead")
+    assert bal.backends[0].breaker.state == "open"
+    again = _submit(bal, dedupe="k-move")
+    assert again["ok"] and again["job"]["id"] == jid
+    assert again.get("deduped") is True
+
+
+def test_backend_refusal_tries_next_backend(fleet, monkeypatch):
+    """A backend that ANSWERS but refuses the conversation (handshake
+    rejection, old daemon without the hello op) is not a transport
+    failure: the submit never reached admission, so the next backend is
+    safe even without a dedupe key — and the refusal must never escape
+    handle_request."""
+    from fgumi_tpu.serve.client import ServeError
+
+    bal, (a, b) = fleet
+    bal.poll_backends_once()
+
+    def refuse(req, retry=True, timeout=None):
+        raise ServeError("daemon connection failed: handshake rejected: "
+                         "invalid handshake token")
+
+    monkeypatch.setattr(bal.backends[0].client, "request", refuse)
+    bal.backends[0].note_depth(0)
+    bal.backends[1].note_depth(1)
+    resp = _submit(bal)  # keyless on purpose
+    assert resp["ok"]
+    assert b.registry.get(resp["job"]["id"]) is not None
+
+
+def test_status_fan_out_finds_migrated_job(fleet):
+    """After a lease takeover the job LIVES on another backend than the
+    map says — the fan-out fallback must find it."""
+    bal, (a, b) = fleet
+    made = b.handle_request({"v": 1, "op": "submit", "argv": ["sort"]})
+    jid = made["job"]["id"]
+    assert bal._backend_for_job(jid) is None  # balancer never saw it
+    resp = bal.handle_request({"v": 1, "op": "status", "id": jid})
+    assert resp["ok"] and resp["job"]["id"] == jid
+    assert bal._backend_for_job(jid) is bal.backends[1]  # learned home
+    missing = bal.handle_request({"v": 1, "op": "status", "id": "nope-9"})
+    assert not missing["ok"] and "unknown job" in missing["error"]
+
+
+def test_read_fanout_never_drives_half_open_breaker(fleet):
+    """Cheap status fan-outs must not close (or re-trip) a half-open
+    breaker — only the claimed probe (health loop / routed submit)
+    decides re-admission."""
+    bal, (a, b) = fleet
+    made = a.handle_request({"v": 1, "op": "submit", "argv": ["sort"]})
+    jid = made["job"]["id"]
+    br = bal.backends[0].breaker
+    br.record_failure("x")
+    br.record_failure("x")
+    br._now = lambda: time.monotonic() + 3600  # cooldown elapsed
+    assert br.state == "half-open"
+    for _ in range(3):  # would close it if reads fed the breaker
+        resp = bal.handle_request({"v": 1, "op": "status", "id": jid})
+        assert resp["ok"]
+    assert br.state == "half-open"
+    # the claimed probe path still re-admits (probes=2)
+    assert br.allow()
+    br.record_success()
+    assert br.allow()
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_draining_balancer_refuses_submits(fleet):
+    bal, _ = fleet
+    bal.drain()
+    resp = _submit(bal)
+    assert not resp["ok"] and "draining" in resp["error"]
+    # status keeps answering through the drain
+    assert bal.handle_request({"v": 1, "op": "ping"})["ok"]
+
+
+def test_mapped_backend_refusal_not_masked_by_fanout(fleet):
+    """Cancelling a job its OWN backend refuses ('already cancelled')
+    must surface that reason — not a peer's 'unknown job'."""
+    bal, (a, b) = fleet
+    resp = _submit(bal)
+    jid = resp["job"]["id"]
+    first = bal.handle_request({"v": 1, "op": "cancel", "id": jid})
+    assert first["ok"]
+    again = bal.handle_request({"v": 1, "op": "cancel", "id": jid})
+    assert not again["ok"]
+    assert "already cancelled" in again["error"]
+
+
+def test_wait_tolerates_takeover_unknown_window(monkeypatch):
+    """ServeClient.wait survives the fleet-wide-unknown window (backend
+    SIGKILL'd, survivor's lease scan not yet run) and still fails on a
+    PERSISTENTLY unknown id."""
+    from fgumi_tpu.serve.client import ServeClient, ServeError
+
+    c = ServeClient("/nowhere.sock")
+    calls = {"n": 0}
+
+    def flaky_job(job_id):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise ServeError(f"unknown job {job_id}")
+        return {"id": job_id, "state": "done", "exit_status": 0}
+
+    monkeypatch.setattr(c, "job", flaky_job)
+    job = c.wait("a-j-1", poll_s=0.0, unknown_grace_s=5.0)
+    assert job["state"] == "done" and calls["n"] == 3
+
+    def always_unknown(job_id):
+        raise ServeError(f"unknown job {job_id}")
+
+    monkeypatch.setattr(c, "job", always_unknown)
+    with pytest.raises(ServeError, match="unknown job"):
+        c.wait("a-j-2", poll_s=0.0, unknown_grace_s=0.05)
+
+
+def test_cli_jobs_drain_against_balancer(fleet, tmp_path, capsys):
+    """`fgumi-tpu jobs --drain/--shutdown` must handle the balancer's
+    depthless ack (no running/queued fields) without a traceback."""
+    from fgumi_tpu.cli import main
+
+    bal, _ = fleet
+    bal.bind()
+    bal._frames.start()
+    front = bal.listen_addr
+    assert main(["jobs", "--socket", front, "--drain"]) == 0
+    assert bal.draining
+    assert main(["jobs", "--socket", front, "--shutdown"]) == 0
+
+
+def test_all_backends_shed_sleeps_hint_once(fleet, monkeypatch):
+    bal, _ = fleet
+    bal.poll_backends_once()
+    shed = {"v": 1, "ok": False,
+            "error": "resource_pressure: rss soft watermark",
+            "retry_after_s": 3.5}
+
+    for be in bal.backends:
+        monkeypatch.setattr(be.client, "request",
+                            lambda req, retry=True, _s=shed: dict(_s))
+    slept = []
+    monkeypatch.setattr(balancer_mod.time, "sleep",
+                        lambda s: slept.append(s))
+    resp = _submit(bal)
+    # exactly one hint sleep, then the shed is handed to the client
+    assert slept == [3.5]
+    assert not resp["ok"] and resp["retry_after_s"] == 3.5
+    assert "resource_pressure" in resp["error"]
+
+
+# ---------------------------------------------------------------------------
+# submit --wait shed retry (the client side of the hint contract)
+
+
+def test_submit_wait_sleeps_the_shed_hint():
+    from fgumi_tpu.cli import _submit_with_shed_retry
+
+    class FakeClient:
+        def __init__(self):
+            self.calls = 0
+
+        def submit(self, **kw):
+            self.calls += 1
+            if self.calls < 3:
+                raise ShedError("resource_pressure: disk", 2.5)
+            return {"id": "j-1", "state": "queued"}
+
+    slept = []
+    fc = FakeClient()
+    job = _submit_with_shed_retry(fc, {"argv": ["sort"]}, wait=True,
+                                  sleep=slept.append)
+    assert job["id"] == "j-1" and fc.calls == 3
+    assert slept == [2.5, 2.5]  # exactly the daemon's hint, no hot loop
+
+
+def test_submit_no_wait_propagates_shed():
+    from fgumi_tpu.cli import _submit_with_shed_retry
+
+    class AlwaysShed:
+        def submit(self, **kw):
+            raise ShedError("resource_pressure: rss", 1.0)
+
+    with pytest.raises(ShedError):
+        _submit_with_shed_retry(AlwaysShed(), {"argv": ["sort"]},
+                                wait=False, sleep=lambda s: None)
+    # and a deadline bounds the waiting variant
+    slept = []
+    with pytest.raises(ShedError):
+        _submit_with_shed_retry(AlwaysShed(), {"argv": ["sort"]},
+                                wait=True, timeout=0.0,
+                                sleep=slept.append)
+    assert slept == []

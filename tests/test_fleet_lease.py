@@ -1,0 +1,268 @@
+"""Journal-lease fleet takeover: fcntl lease arbitration, exactly-once
+claim of a dead peer's journal, requeue under original ids, and the
+dedupe-key race arbitration."""
+
+import json
+import os
+
+import pytest
+
+from fgumi_tpu.serve import journal as journal_mod
+from fgumi_tpu.serve.daemon import JobService
+from fgumi_tpu.serve.journal import FleetLease, LeaseHeld
+from fgumi_tpu.serve.jobs import Job
+
+# ---------------------------------------------------------------------------
+# lease primitives
+
+
+def test_lease_conflict_and_release(tmp_path):
+    path = str(tmp_path / "a.lease")
+    first = FleetLease(path)
+    first.acquire(wait_s=0.0)
+    second = FleetLease(path)
+    with pytest.raises(LeaseHeld):
+        second.acquire(wait_s=0.2)
+    first.release()
+    second.acquire(wait_s=0.0)  # now free
+    second.release()
+
+
+def test_try_claim_respects_live_owner(tmp_path):
+    path = str(tmp_path / "a.lease")
+    owner = FleetLease(path)
+    owner.acquire()
+    assert FleetLease.try_claim(path) is None  # owner lives
+    owner.release()
+    fd = FleetLease.try_claim(path)
+    assert fd is not None  # owner "died": the flock is claimable
+    os.close(fd)
+
+
+def test_fleet_id_validation():
+    journal_mod.validate_fleet_id("node-1.a_B")
+    for bad in ("", "a/b", "-lead", "x" * 65, None):
+        with pytest.raises(ValueError):
+            journal_mod.validate_fleet_id(bad)
+
+
+def test_scan_peer_journals_excludes_self_and_noise(tmp_path):
+    for name in ("a.journal", "b.journal", "b.lease", "c.journal.claimed",
+                 "junk.txt"):
+        (tmp_path / name).write_text("")
+    peers = journal_mod.scan_peer_journals(str(tmp_path), "a")
+    assert [p[0] for p in peers] == ["b"]
+    jpath, lpath = journal_mod.fleet_paths(str(tmp_path), "b")
+    assert peers[0][1] == jpath and peers[0][2] == lpath
+
+
+# ---------------------------------------------------------------------------
+# takeover into a live daemon
+
+
+def _write_peer_journal(journal_dir, fleet_id, jobs):
+    """A dead peer's journal: jobs = [(id, state, dedupe)]."""
+    jpath, _ = journal_mod.fleet_paths(journal_dir, fleet_id)
+    j = journal_mod.JobJournal(jpath)
+    for jid, state, dedupe in jobs:
+        job = Job(jid, ["sort", "-i", "a", "-o", "b"], "normal",
+                  argv0="fgumi-tpu")
+        j.record_submit(job, dedupe)
+        if state != "queued":
+            job.state = state
+            if state == "done":
+                job.exit_status = 0
+            j.record_state(job)
+    j.close()
+    return jpath
+
+
+@pytest.fixture
+def fleet_service(tmp_path):
+    """Daemon 'b' in a shared journal dir; scheduler workers never start,
+    so requeued jobs stay queued and assertions are deterministic."""
+    svc = JobService(str(tmp_path / "b.sock"), workers=1, queue_limit=8,
+                     journal_dir=str(tmp_path / "fleet"), fleet_id="b")
+    svc.recover()
+    yield svc, str(tmp_path / "fleet")
+    svc.close()
+
+
+def test_takeover_requeues_under_original_ids(fleet_service):
+    svc, fdir = fleet_service
+    _write_peer_journal(fdir, "a", [("a-j-1", "running", "key-1"),
+                                    ("a-j-2", "done", None)])
+    assert svc.scan_for_takeovers() == 1
+    # incomplete job requeued under its ORIGINAL id; terminal restored
+    # read-only
+    assert svc.registry.get("a-j-1").state == "queued"
+    assert svc.registry.get("a-j-2").state == "done"
+    assert svc._dedupe["key-1"] == "a-j-1"
+    # the adopted job is journaled in OUR journal: a crash of this
+    # daemon re-recovers it
+    own = journal_mod.replay(svc.journal_path)
+    assert "a-j-1" in own.by_id
+    assert own.by_id["a-j-1"]["state"] == "queued"
+    # the consumed journal is renamed: nothing left to double-claim
+    jpath, _ = journal_mod.fleet_paths(fdir, "a")
+    assert not os.path.exists(jpath)
+    assert os.path.exists(jpath + ".claimed")
+    stats = svc.fleet_stats
+    assert stats["takeovers"] == 1 and stats["takeover_jobs"] == 1
+    assert stats["last_takeover"]["peer"] == "a"
+
+
+def test_takeover_is_exactly_once(fleet_service):
+    svc, fdir = fleet_service
+    _write_peer_journal(fdir, "a", [("a-j-1", "queued", None)])
+    assert svc.scan_for_takeovers() == 1
+    assert svc.scan_for_takeovers() == 0  # journal consumed + renamed
+    # the restarting peer finds nothing to replay either
+    svc2 = JobService(None, tcp=("127.0.0.1", 0), workers=1,
+                      journal_dir=fdir, fleet_id="a")
+    try:
+        svc2.recover()
+        assert svc2.registry.get("a-j-1") is None
+        assert svc2.journal_stats["replayed"] == 0
+    finally:
+        svc2.close()
+
+
+def test_live_peer_never_claimed(fleet_service):
+    svc, fdir = fleet_service
+    _write_peer_journal(fdir, "a", [("a-j-1", "running", None)])
+    _, lpath = journal_mod.fleet_paths(fdir, "a")
+    alive = FleetLease(lpath)
+    alive.acquire()  # simulate the live peer holding its lease
+    try:
+        assert svc.scan_for_takeovers() == 0
+        assert svc.registry.get("a-j-1") is None
+    finally:
+        alive.release()
+    assert svc.scan_for_takeovers() == 1  # "peer died": now claimable
+
+
+def test_dedupe_key_arbitrates_takeover_race(fleet_service):
+    """A balancer may have re-routed the same dedupe-keyed submit to the
+    survivor before the takeover scan: the journal copy must NOT run."""
+    svc, fdir = fleet_service
+    rerouted = svc.handle_request(
+        {"v": 1, "op": "submit", "argv": ["sort", "-i", "a", "-o", "b"],
+         "dedupe": "key-X"})
+    assert rerouted["ok"]
+    winner = rerouted["job"]["id"]
+    _write_peer_journal(fdir, "a", [("a-j-9", "running", "key-X")])
+    assert svc.scan_for_takeovers() == 1
+    adopted = svc.registry.get("a-j-9")
+    assert adopted.state == "cancelled"
+    assert winner in adopted.error  # superseded-by note names the winner
+    assert svc._dedupe["key-X"] == winner
+    assert svc.fleet_stats["takeover_skipped_dedupe"] == 1
+    # and the idempotent resubmit still answers with the winner
+    again = svc.handle_request(
+        {"v": 1, "op": "submit", "argv": ["sort", "-i", "a", "-o", "b"],
+         "dedupe": "key-X"})
+    assert again["job"]["id"] == winner and again.get("deduped")
+
+
+def test_fleet_job_ids_are_prefixed(fleet_service):
+    svc, _ = fleet_service
+    resp = svc.handle_request(
+        {"v": 1, "op": "submit", "argv": ["sort", "-i", "a", "-o", "b"]})
+    assert resp["job"]["id"] == "b-j-1"
+
+
+def test_duplicate_fleet_id_fails_fast(tmp_path, fleet_service):
+    svc, fdir = fleet_service
+    dup = JobService(str(tmp_path / "b2.sock"), journal_dir=fdir,
+                     fleet_id="b", lease_wait_s=0.3)
+    with pytest.raises(LeaseHeld):
+        dup.acquire_lease()
+    dup.close()
+
+
+def test_restart_after_takeover_never_reuses_consumed_ids(fleet_service,
+                                                          tmp_path):
+    """A restarted daemon whose journal was consumed (.claimed) replays
+    nothing — but the ids it minted now live on the survivor. It must
+    reserve past them instead of re-minting a colliding a-j-1."""
+    svc, fdir = fleet_service
+    _write_peer_journal(fdir, "a", [("a-j-1", "running", None),
+                                    ("a-j-3", "queued", None)])
+    assert svc.scan_for_takeovers() == 1
+    revenant = JobService(str(tmp_path / "a.sock"), workers=1,
+                          journal_dir=fdir, fleet_id="a")
+    try:
+        revenant.recover()
+        assert revenant.journal_stats["replayed"] == 0
+        resp = revenant.handle_request(
+            {"v": 1, "op": "submit", "argv": ["sort"]})
+        # fresh ids start PAST everything the dead incarnation minted
+        assert resp["job"]["id"] == "a-j-4"
+    finally:
+        revenant.close()
+
+
+def test_own_restart_recovery_still_requeues(tmp_path):
+    """Fleet mode keeps the PR 7 own-journal restart contract: incomplete
+    jobs requeue under their original ids on the SAME identity."""
+    fdir = str(tmp_path / "fleet")
+    svc = JobService(str(tmp_path / "c.sock"), journal_dir=fdir,
+                     fleet_id="c", workers=1)
+    svc.recover()
+    svc.handle_request(
+        {"v": 1, "op": "submit", "argv": ["sort", "-i", "a", "-o", "b"],
+         "dedupe": "k"})
+    svc.close()  # releases the lease; journal stays (no takeover ran)
+    svc2 = JobService(str(tmp_path / "c.sock"), journal_dir=fdir,
+                      fleet_id="c", workers=1)
+    try:
+        svc2.recover()
+        assert svc2.registry.get("c-j-1").state == "queued"
+        assert svc2._dedupe["k"] == "c-j-1"
+    finally:
+        svc2.close()
+
+
+def test_own_replay_reissued_stale_key_requeues_last_wins(tmp_path):
+    """The live submit handler reissues a dedupe key whose first job was
+    evicted from history; both submits are in OUR journal. Startup
+    replay must rebind last-wins and requeue the later job — the
+    supersede-cancel rule applies only to PEER takeover."""
+    fdir = str(tmp_path / "fleet")
+    os.makedirs(fdir)
+    jpath, _ = journal_mod.fleet_paths(fdir, "c")
+    j = journal_mod.JobJournal(jpath)
+    first = Job("c-j-1", ["sort"], "normal", argv0="x")
+    j.record_submit(first, "key-R")
+    first.state = "done"
+    first.exit_status = 0
+    j.record_state(first)
+    second = Job("c-j-2", ["sort"], "normal", argv0="x")
+    j.record_submit(second, "key-R")  # reissued stale key
+    j.close()
+    svc = JobService(str(tmp_path / "c.sock"), workers=1,
+                     journal_dir=fdir, fleet_id="c")
+    try:
+        svc.recover()
+        assert svc.registry.get("c-j-2").state == "queued"  # NOT cancelled
+        assert svc._dedupe["key-R"] == "c-j-2"
+    finally:
+        svc.close()
+
+
+def test_journal_and_journal_dir_exclusive(tmp_path):
+    with pytest.raises(ValueError, match="exclusive"):
+        JobService(str(tmp_path / "s.sock"),
+                   journal_path=str(tmp_path / "j.jsonl"),
+                   journal_dir=str(tmp_path / "fleet"), fleet_id="x")
+
+
+def test_lease_breadcrumb_is_informational(tmp_path):
+    lease = FleetLease(str(tmp_path / "x.lease"))
+    lease.acquire()
+    try:
+        data = json.loads(open(lease.path).read())
+        assert data["pid"] == os.getpid()
+    finally:
+        lease.release()

@@ -265,12 +265,13 @@ def test_recover_releases_dedupe_key_when_requeue_rejected(tmp_path):
 def test_client_cancel_and_shutdown_never_retry(monkeypatch):
     """cancel/shutdown responses are not idempotent: a reconnect after the
     daemon already acted would surface a spurious failure. Idempotent ops
-    (status) keep the one bounded retry."""
-    from fgumi_tpu.serve import client as client_mod
+    (status) get the FULL capped-backoff policy's attempts."""
     from fgumi_tpu.serve.client import ServeClient, ServeError, _Retryable
+    from fgumi_tpu.serve.transport import RetryPolicy
 
-    monkeypatch.setattr(client_mod, "RECONNECT_DELAY_S", 0.0)
-    c = ServeClient("/nonexistent.sock")
+    c = ServeClient("/nonexistent.sock",
+                    retry_policy=RetryPolicy(attempts=3, base_s=0.0,
+                                             cap_s=0.0))
     calls = []
 
     def once(obj, timeout=None):
@@ -282,11 +283,21 @@ def test_client_cancel_and_shutdown_never_retry(monkeypatch):
         calls.clear()
         with pytest.raises(ServeError):
             op()
-        assert calls == [calls[0]]  # exactly one attempt
+        assert calls == [calls[0]]  # exactly one attempt, no retry
     calls.clear()
     with pytest.raises(ServeError):
         c.status()
-    assert len(calls) == 2  # idempotent: one reconnect attempt
+    assert len(calls) == 3  # idempotent: every policy attempt used
+    # a keyless submit is not idempotent either (the daemon may have
+    # admitted it before the reset); a dedupe-keyed one is
+    calls.clear()
+    with pytest.raises(ServeError):
+        c.submit(["sort"])
+    assert len(calls) == 1
+    calls.clear()
+    with pytest.raises(ServeError):
+        c.submit(["sort"], dedupe="k")
+    assert len(calls) == 3
 
 
 def test_daemon_sweeps_stale_report_temps(tmp_path):

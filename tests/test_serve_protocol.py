@@ -60,10 +60,18 @@ def service(tmp_path):
                      report_dir=None)
     # do NOT start scheduler workers: queued jobs stay queued, so the
     # golden conversation is deterministic
-    svc._sock = svc._claim_socket()
-    import threading
+    svc.start_transport()
+    yield svc
+    svc.close()
 
-    threading.Thread(target=svc._accept_loop, daemon=True).start()
+
+@pytest.fixture
+def tcp_service(tmp_path):
+    """Auth-required TCP daemon on an ephemeral loopback port (token
+    'golden-secret', enforced because a token is configured)."""
+    svc = JobService(None, workers=1, queue_limit=1,
+                     tcp=("127.0.0.1", 0), auth_token="golden-secret")
+    svc.start_transport()
     yield svc
     svc.close()
 
@@ -113,6 +121,49 @@ def test_golden_conversation(service):
         conn.close()
 
 
+def test_tcp_golden_conversations(tcp_service):
+    """The fleet-tier wire contract over a REAL auth-required TCP
+    listener: the handshake frame, the rejected no-token connect, the
+    rejected bad token, and version negotiation after auth — one golden
+    conversation per connection; ``closed`` pins the daemon hanging up
+    after a refusal."""
+    golden = json.load(open(GOLDEN))
+    port = tcp_service.tcp_port
+    for convo in golden["tcp_conversations"]:
+        conn = socket.create_connection(("127.0.0.1", port), timeout=10)
+        stream = conn.makefile("rb")
+        try:
+            for exchange in convo["exchanges"]:
+                conn.sendall(protocol.encode_frame(exchange["request"]))
+                resp = protocol.read_frame(stream)
+                assert _normalize(resp) == exchange["response"], \
+                    f"{convo['name']}: {exchange['name']}"
+            if convo["closed"]:
+                # the refusal hangs up: clean EOF (or a reset if the
+                # close raced our read)
+                try:
+                    assert stream.readline() == b"", convo["name"]
+                except ConnectionResetError:
+                    pass
+        finally:
+            conn.close()
+
+
+def test_tcp_client_round_trip_with_token(tcp_service):
+    """ServeClient speaks tcp: addresses and opens each connection with
+    the handshake when a token is configured; a wrong token surfaces the
+    daemon's refusal verbatim."""
+    addr = f"tcp:127.0.0.1:{tcp_service.tcp_port}"
+    good = ServeClient(addr, timeout=10, token="golden-secret")
+    assert good.ping()["tool"] == "fgumi-tpu"
+    bad = ServeClient(addr, timeout=10, token="nope")
+    with pytest.raises(ServeError, match="handshake rejected"):
+        bad.ping()
+    naked = ServeClient(addr, timeout=10)
+    with pytest.raises(ServeError, match="authentication required"):
+        naked.ping()
+
+
 def test_malformed_frame_gets_error_response(service):
     conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     conn.settimeout(10)
@@ -127,10 +178,7 @@ def test_malformed_frame_gets_error_response(service):
 def test_oversized_frame_rejected_and_connection_closed(tmp_path):
     svc = JobService(str(tmp_path / "big.sock"), workers=1,
                      max_frame_bytes=4096)
-    svc._sock = svc._claim_socket()
-    import threading
-
-    threading.Thread(target=svc._accept_loop, daemon=True).start()
+    svc.start_transport()
     try:
         conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         conn.settimeout(10)
@@ -231,7 +279,10 @@ def test_stats_op_live_sections(service):
         resp = service.handle_request({"v": 1, "op": "stats"})
         assert resp["ok"] is True
         stats = resp["stats"]
-        assert stats["schema_version"] == 1
+        from fgumi_tpu.serve.introspect import STATS_SCHEMA_VERSION
+
+        assert stats["schema_version"] == STATS_SCHEMA_VERSION
+        assert stats["fleet"] is None  # not a --journal-dir fleet member
         assert stats["scheduler"]["workers"] == 1
         assert stats["quota"] == {} and stats["max_per_client"] == 0
         lat = stats["latency"]["serve.job.queue_wait_s"]

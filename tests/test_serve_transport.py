@@ -1,0 +1,279 @@
+"""Fleet transport layer: address parsing, the capped-jittered retry
+policy, TCP serving (handshake auth, connection cap, io deadlines), and
+the busy-port exit-2 contract."""
+
+import os
+import socket
+import time
+
+import pytest
+
+from fgumi_tpu.serve import protocol, transport
+from fgumi_tpu.serve.client import ServeClient, ServeError
+from fgumi_tpu.serve.daemon import JobService
+
+# ---------------------------------------------------------------------------
+# addresses
+
+
+def test_parse_address_forms():
+    assert transport.parse_address("unix:/tmp/a.sock") == \
+        ("unix", "/tmp/a.sock")
+    assert transport.parse_address("/tmp/a.sock") == ("unix", "/tmp/a.sock")
+    assert transport.parse_address("relative.sock") == \
+        ("unix", "relative.sock")
+    assert transport.parse_address("tcp:127.0.0.1:7001") == \
+        ("tcp", ("127.0.0.1", 7001))
+    assert transport.parse_address("tcp:my.host.example:80") == \
+        ("tcp", ("my.host.example", 80))
+
+
+@pytest.mark.parametrize("bad,msg", [
+    ("", "empty"),
+    ("unix:", "without a path"),
+    ("tcp:9000", "tcp:host:port"),
+    ("tcp:host:", "integer"),
+    ("tcp:host:notaport", "integer"),
+    ("tcp:host:70000", "out of range"),
+    ("somehost:123", "ambiguous"),
+])
+def test_parse_address_rejects(bad, msg):
+    with pytest.raises(ValueError, match=msg):
+        transport.parse_address(bad)
+
+
+def test_format_address_round_trip():
+    for addr in ("unix:/tmp/x.sock", "tcp:127.0.0.1:8000"):
+        assert transport.format_address(
+            *transport.parse_address(addr)) == addr
+
+
+def test_is_loopback():
+    assert transport.is_loopback("127.0.0.1")
+    assert transport.is_loopback("localhost")
+    assert not transport.is_loopback("0.0.0.0")
+    assert not transport.is_loopback("192.168.1.10")
+    # "" binds INADDR_ANY (every interface): must hit the token gate
+    assert not transport.is_loopback("")
+
+
+# ---------------------------------------------------------------------------
+# retry policy
+
+
+def test_retry_policy_exponential_and_capped():
+    p = transport.RetryPolicy(attempts=5, base_s=0.25, cap_s=1.0,
+                              multiplier=2.0, jitter=0.0)
+    assert [p.delay_s(k) for k in (1, 2, 3, 4)] == [0.25, 0.5, 1.0, 1.0]
+
+
+def test_retry_policy_jitter_bounds():
+    lo = transport.RetryPolicy(base_s=1.0, jitter=0.5, rng=lambda: 1.0)
+    hi = transport.RetryPolicy(base_s=1.0, jitter=0.5, rng=lambda: 0.0)
+    assert lo.delay_s(1) == pytest.approx(0.5)   # full jitter: halved
+    assert hi.delay_s(1) == pytest.approx(1.0)   # no jitter drawn
+    # jittered delays always land in [1-jitter, 1] x the raw backoff
+    import random
+
+    p = transport.RetryPolicy(base_s=1.0, cap_s=1.0, jitter=0.5,
+                              rng=random.Random(7).random)
+    for k in range(1, 20):
+        assert 0.5 <= p.delay_s(k) <= 1.0
+
+
+def test_retry_policy_none_never_retries():
+    assert transport.RetryPolicy.none().attempts == 1
+    with pytest.raises(ValueError):
+        transport.RetryPolicy(attempts=0)
+    with pytest.raises(ValueError):
+        transport.RetryPolicy(jitter=2.0)
+
+
+def test_client_backoff_uses_policy_delays(monkeypatch):
+    """The client's idempotent retries sleep the policy's capped jittered
+    schedule — not a fixed constant."""
+    from fgumi_tpu.serve import client as client_mod
+
+    policy = transport.RetryPolicy(attempts=3, base_s=0.2, cap_s=1.0,
+                                   jitter=0.0)
+    c = ServeClient("/nonexistent.sock", retry_policy=policy)
+    slept = []
+    monkeypatch.setattr(client_mod.time, "sleep",
+                        lambda s: slept.append(round(s, 3)))
+    with pytest.raises(ServeError, match="cannot reach daemon"):
+        c.ping()
+    assert slept == [0.2, 0.4]
+
+
+# ---------------------------------------------------------------------------
+# tokens
+
+
+def test_load_token_file_and_env(tmp_path, monkeypatch):
+    f = tmp_path / "tok"
+    f.write_text("  s3cret\n")
+    assert transport.load_token(str(f)) == "s3cret"
+    (tmp_path / "empty").write_text("  \n")
+    with pytest.raises(ValueError, match="empty"):
+        transport.load_token(str(tmp_path / "empty"))
+    monkeypatch.setenv(transport.TOKEN_ENV, "env-secret")
+    assert transport.load_token(None) == "env-secret"
+    monkeypatch.delenv(transport.TOKEN_ENV)
+    assert transport.load_token(None) is None
+
+
+def test_non_loopback_bind_without_token_refused():
+    with pytest.raises(ValueError, match="without a handshake token"):
+        transport.TcpListener("0.0.0.0", 0, token=None)
+
+
+def test_loopback_bind_with_token_enforces_auth():
+    lst = transport.TcpListener("127.0.0.1", 0, token="s")
+    assert lst.require_auth
+    assert not transport.TcpListener("127.0.0.1", 0).require_auth
+
+
+# ---------------------------------------------------------------------------
+# TCP serving through a live daemon
+
+
+@pytest.fixture
+def tcp_service():
+    svc = JobService(None, workers=1, queue_limit=2, tcp=("127.0.0.1", 0))
+    svc.start_transport()
+    yield svc
+    svc.close()
+
+
+def test_tcp_daemon_serves_submit_and_status(tcp_service):
+    client = ServeClient(f"tcp:127.0.0.1:{tcp_service.tcp_port}",
+                         timeout=10)
+    job = client.submit(["sort", "-i", "a", "-o", "b"])
+    assert job["state"] == "queued"
+    assert client.job(job["id"])["id"] == job["id"]
+
+
+def test_tcp_connection_cap_rejected_with_reason():
+    svc = JobService(None, workers=1, tcp=("127.0.0.1", 0), conn_cap=1)
+    svc.start_transport()
+    try:
+        hold = socket.create_connection(("127.0.0.1", svc.tcp_port),
+                                        timeout=10)
+        deadline = time.monotonic() + 5
+        while svc._frames.live_connections() < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        over = socket.create_connection(("127.0.0.1", svc.tcp_port),
+                                        timeout=10)
+        resp = protocol.read_frame(over.makefile("rb"))
+        assert resp["ok"] is False
+        assert "connection limit reached" in resp["error"]
+        over.close()
+        hold.close()
+    finally:
+        svc.close()
+
+
+def test_tcp_io_deadline_closes_idle_connection():
+    svc = JobService(None, workers=1, tcp=("127.0.0.1", 0),
+                     io_timeout_s=0.3)
+    svc.start_transport()
+    try:
+        conn = socket.create_connection(("127.0.0.1", svc.tcp_port),
+                                        timeout=10)
+        t0 = time.monotonic()
+        # never send a frame: the read deadline must close us out
+        assert conn.makefile("rb").readline() == b""
+        assert time.monotonic() - t0 < 5.0
+        conn.close()
+    finally:
+        svc.close()
+
+
+def test_unix_connections_do_not_consume_tcp_cap(tmp_path):
+    """The connection cap is per listener: local Unix clients must never
+    eat the TCP listener's budget."""
+    svc = JobService(str(tmp_path / "s.sock"), workers=1,
+                     tcp=("127.0.0.1", 0), conn_cap=1)
+    svc.start_transport()
+    try:
+        hold = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        hold.connect(svc.socket_path)
+        deadline = time.monotonic() + 5
+        while svc._frames.live_connections() < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        client = ServeClient(f"tcp:127.0.0.1:{svc.tcp_port}", timeout=10)
+        assert client.ping()["tool"] == "fgumi-tpu"
+        hold.close()
+    finally:
+        svc.close()
+
+
+def test_socket_busy_duplicate_start_leaves_live_daemon_alone(tmp_path):
+    """A failed duplicate `serve` (SocketBusy) must exit 2 WITHOUT
+    unlinking the live daemon's socket on its way out."""
+    from fgumi_tpu.cli import main
+
+    svc = JobService(str(tmp_path / "dup.sock"), workers=1)
+    svc.start_transport()
+    try:
+        rc = main(["serve", "--socket", svc.socket_path, "--no-warmup"])
+        assert rc == 2
+        assert os.path.exists(svc.socket_path)
+        # the live daemon still answers
+        assert ServeClient(svc.socket_path, timeout=5).ping()["ok"]
+    finally:
+        svc.close()
+
+
+def test_busy_tcp_port_exits_2(tmp_path):
+    from fgumi_tpu.cli import main
+
+    blocker = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    try:
+        rc = main(["serve", "--tcp", f"127.0.0.1:{port}",
+                   "--socket", str(tmp_path / "s.sock"), "--no-warmup"])
+        assert rc == 2
+        # the unix socket claimed before the failure must not leak
+        assert not os.path.exists(tmp_path / "s.sock")
+    finally:
+        blocker.close()
+
+
+def test_serve_requires_some_listener():
+    from fgumi_tpu.cli import main
+
+    assert main(["serve", "--no-warmup"]) == 2
+
+
+def test_negative_conn_cap_refused(tmp_path):
+    from fgumi_tpu.cli import main
+
+    with pytest.raises(ValueError, match="conn_cap"):
+        transport.TcpListener("127.0.0.1", 0, conn_cap=-1)
+    rc = main(["serve", "--socket", str(tmp_path / "s.sock"),
+               "--conn-cap", "-1", "--no-warmup"])
+    assert rc == 2
+
+
+def test_ephemeral_tcp_fleet_needs_explicit_id(tmp_path):
+    """`--journal-dir` with only an ephemeral --tcp port has no stable
+    identity: every such daemon would collide on one lease."""
+    from fgumi_tpu.cli import main
+
+    rc = main(["serve", "--tcp", "127.0.0.1:0",
+               "--journal-dir", str(tmp_path / "fleet"), "--no-warmup"])
+    assert rc == 2
+
+
+def test_hello_on_open_listener(tcp_service):
+    """Without a configured token the hello op acknowledges auth=open —
+    the probe a balancer sends before trusting a backend."""
+    resp = tcp_service.handle_request({"v": 1, "op": "hello"})
+    assert resp["ok"] is True and resp["auth"] == "open"
+    assert protocol.validate_request(
+        {"v": 1, "op": "hello", "token": 5}) is not None

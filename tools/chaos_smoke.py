@@ -1,7 +1,9 @@
 #!/usr/bin/env python3
 """Chaos smoke: run one command under each injected fault and check the
-exit-code contract, then SIGKILL a run mid-write and check crash-safe
-commit (no partial file under the final output name).
+exit-code contract, SIGKILL one of two fleet daemons mid-job and check
+the balancer-eject + journal-lease-takeover contract (byte-identical
+completion, zero double-execution), then SIGKILL a run mid-write and
+check crash-safe commit (no partial file under the final output name).
 
 Usage:  python tools/chaos_smoke.py [--keep]
 
@@ -243,7 +245,158 @@ def main():
                         outs["governed"] == outs["ungoverned"],
                         f"{len(outs['governed'])} bytes")
 
-        # 6) SIGKILL mid-write: no partial file under the final name
+        # 6) fleet takeover (ISSUE 12): SIGKILL one of two TCP daemons
+        # mid-job; the balancer must eject it, the survivor must claim the
+        # dead daemon's journal lease and finish the job byte-identically
+        # under its original id, and the journal + dedupe audit must show
+        # exactly one execution fleet-wide
+        sys.path.insert(0, REPO)
+        from fgumi_tpu.serve.client import ServeClient, ServeError
+
+        def _free_port():
+            import socket as _socket
+
+            s = _socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            return port
+
+        fdir = os.path.join(tmp, "fleet")
+        fwd = os.path.join(fdir, "wd")
+        fstd = os.path.join(fdir, "std")
+        jdir = os.path.join(fdir, "journals")
+        for d in (fwd, fstd, jdir):
+            os.makedirs(d)
+        finp = os.path.join(fdir, "grouped.bam")
+        p = run(["simulate", "grouped-reads", "-o", finp,
+                 "--num-families", "500", "--family-size", "4",
+                 "--seed", "29"])
+        assert p.returncode == 0, p.stderr
+        fleet_job = ["simplex", "-i", finp, "-o", "out_fleet.bam",
+                     "--min-reads", "1"]
+        p = run(fleet_job, cwd=fstd, env={"FGUMI_TPU_HOST_ENGINE": "0"})
+        assert p.returncode == 0, p.stderr
+        ports = {"a": _free_port(), "b": _free_port()}
+        front = _free_port()
+        fleet_env = {**BASE_ENV, "FGUMI_TPU_HOST_ENGINE": "0"}
+        daemons = {}
+        bal = None
+        try:
+            for fid in ("a", "b"):
+                daemons[fid] = subprocess.Popen(
+                    [sys.executable, "-m", "fgumi_tpu", "serve",
+                     "--tcp", f"127.0.0.1:{ports[fid]}", "--workers", "1",
+                     "--queue-limit", "0", "--journal-dir", jdir,
+                     "--fleet-id", fid, "--lease-scan-period", "0.5"],
+                    cwd=fwd, env=fleet_env, stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT, text=True)
+            bal = subprocess.Popen(
+                [sys.executable, "-m", "fgumi_tpu", "balance",
+                 "--listen", f"tcp:127.0.0.1:{front}",
+                 "--backend", f"tcp:127.0.0.1:{ports['a']}",
+                 "--backend", f"tcp:127.0.0.1:{ports['b']}",
+                 "--poll-period", "0.3", "--eject-failures", "2",
+                 "--cooldown", "1.0"],
+                cwd=fdir, env=fleet_env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+            client = ServeClient(f"tcp:127.0.0.1:{front}", timeout=30)
+            deadline = time.monotonic() + 120
+            up = False
+            while time.monotonic() < deadline and not up:
+                try:
+                    st = client.stats()
+                    up = all(b["state"] == "closed"
+                             for b in st["backends"])
+                except ServeError:
+                    time.sleep(0.2)
+            ok &= check("fleet: balancer + both backends up", up)
+            # argv0 matching the standalone invocation (python -m
+            # fgumi_tpu) so @PG CL provenance bytes agree
+            argv0 = os.path.join(REPO, "fgumi_tpu", "__main__.py")
+            jk = client.submit(fleet_job, dedupe="chaos-fleet",
+                               argv0=argv0)
+            victim = jk["id"].split("-j-")[0]
+            seen_running = False
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                try:
+                    state = client.job(jk["id"])["state"]
+                    if state == "running":
+                        seen_running = True
+                        break
+                    if state in ("done", "failed", "cancelled"):
+                        break  # finished pre-kill: scenario void
+                except ServeError:
+                    pass
+                time.sleep(0.1)
+            ok &= check("fleet: job observed running before SIGKILL",
+                        seen_running)
+            daemons[victim].kill()
+            daemons[victim].wait(timeout=30)
+            victim_addr = f"tcp:127.0.0.1:{ports[victim]}"
+            ejected = False
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline and not ejected:
+                try:
+                    st = client.stats()
+                    ejected = any(b["address"] == victim_addr
+                                  and b["state"] == "open"
+                                  for b in st["backends"])
+                except ServeError:
+                    pass
+                time.sleep(0.2)
+            ok &= check("fleet: balancer ejects the SIGKILL'd backend",
+                        ejected)
+            final = None
+            deadline = time.monotonic() + 240
+            while time.monotonic() < deadline:
+                try:
+                    j = client.job(jk["id"])
+                    if j["state"] in ("done", "failed", "cancelled"):
+                        final = j
+                        break
+                except ServeError:
+                    pass
+                time.sleep(0.25)
+            ok &= check("fleet: job finishes under its original id via "
+                        "lease takeover",
+                        final is not None and final["state"] == "done"
+                        and final["id"] == jk["id"],
+                        str(final and final["state"]))
+            ref = open(os.path.join(fstd, "out_fleet.bam"), "rb").read()
+            got_path = os.path.join(fwd, "out_fleet.bam")
+            got = open(got_path, "rb").read() \
+                if os.path.exists(got_path) else b""
+            ok &= check("fleet: takeover output byte-identical",
+                        ref == got, f"{len(ref)} vs {len(got)} bytes")
+            # audit: one done event fleet-wide; dedupe resubmit answers
+            # with the finished job instead of running a second copy
+            done_events = 0
+            for name in os.listdir(jdir):
+                if ".journal" not in name:
+                    continue
+                for line in open(os.path.join(jdir, name)):
+                    try:
+                        rec = __import__("json").loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("id") == jk["id"] \
+                            and rec.get("state") == "done":
+                        done_events += 1
+            jk2 = client.submit(fleet_job, dedupe="chaos-fleet",
+                                argv0=argv0)
+            ok &= check("fleet: no job ran twice (journal + dedupe audit)",
+                        done_events == 1 and jk2["id"] == jk["id"]
+                        and jk2["state"] == "done",
+                        f"done_events={done_events} resubmit={jk2['id']}")
+        finally:
+            for proc in list(daemons.values()) + ([bal] if bal else []):
+                if proc is not None and proc.poll() is None:
+                    proc.kill()
+                    proc.wait(timeout=10)
+
+        # 7) SIGKILL mid-write: no partial file under the final name
         victim = os.path.join(tmp, "victim.bam")
         code = (
             "import sys, time\n"

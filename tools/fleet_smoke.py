@@ -1,0 +1,391 @@
+#!/usr/bin/env python3
+"""Fleet smoke: 2 TCP daemons + a health-routed balancer — the CI gate
+for the fleet resilience tier (ISSUE 12).
+
+Scenarios (exit 0 when every check holds, one PASS/FAIL line each):
+
+1. Fleet up: both daemons answer through the balancer's front end
+   (handshake token enforced end to end), 2/2 backends healthy.
+2. Spillover on over-capacity: with workers=1 / queue-limit=0 per
+   daemon, two concurrent submits land on DIFFERENT backends (job-id
+   fleet prefixes prove it), both outputs byte-identical to standalone
+   runs; a third concurrent submit is refused with an explicit reason.
+3. Kill-one-mid-job takeover: SIGKILL the daemon RUNNING a job. The
+   balancer ejects it (breaker open in the balancer's stats), the
+   survivor claims the dead daemon's journal lease and requeues the job
+   under its ORIGINAL id, the job completes byte-identically to a
+   standalone run, and the journal audit shows exactly ONE done event
+   fleet-wide (zero double-executions); an idempotent resubmit with the
+   same dedupe key answers with the finished job.
+4. Warm survivor: the post-takeover job on the surviving daemon reports
+   zero XLA recompilations (device.backend_compiles == 0) — scale-out
+   keeps the warm-serving economics.
+5. Eject -> re-admit: restarting the killed daemon (fresh, its journal
+   was consumed) brings its backend closed again through the balancer's
+   half-open probes.
+
+Usage:  python tools/fleet_smoke.py [--keep]
+"""
+
+import argparse
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+TOKEN = "fleet-smoke-secret"
+
+BASE_ENV = {
+    **os.environ,
+    "PYTHONPATH": REPO,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "",
+    "PALLAS_AXON_POOL_IPS": "",
+    # force the device kernel AND the device route so warm-vs-cold compile
+    # evidence exists even on a CPU-only host
+    "FGUMI_TPU_HOST_ENGINE": "0",
+    "FGUMI_TPU_ROUTE": "device",
+}
+
+
+def run(args, cwd, env=None, timeout=300):
+    return subprocess.run(
+        [sys.executable, "-m", "fgumi_tpu", *args], cwd=cwd,
+        env={**BASE_ENV, **(env or {})}, capture_output=True, text=True,
+        timeout=timeout)
+
+
+def check(name, ok, detail=""):
+    print(f"{'PASS' if ok else 'FAIL'}  {name}" + (f"  ({detail})"
+                                                   if detail else ""))
+    return ok
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def wait_for_ping(client, timeout=120):
+    from fgumi_tpu.serve.client import ServeError
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            return client.ping()
+        except ServeError:
+            time.sleep(0.2)
+    return None
+
+
+def wait_job_tolerant(client, job_id, timeout=240):
+    """Poll a job through the balancer, tolerating the takeover window
+    (the dead backend's job is briefly unknown fleet-wide until the
+    survivor's lease scan adopts it)."""
+    from fgumi_tpu.serve.client import ServeError
+    from fgumi_tpu.serve.jobs import TERMINAL
+
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            job = client.job(job_id)
+            last = job
+            if job["state"] in TERMINAL:
+                return job
+        except ServeError as e:
+            last = {"state": f"unresolved ({e})"}
+        time.sleep(0.25)
+    return last
+
+
+def backend_states(client):
+    stats = client.stats()
+    return {b["address"]: b["state"] for b in stats["backends"]}
+
+
+def wait_backend_state(client, address, state, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if backend_states(client).get(address) == state:
+                return True
+        except Exception:  # noqa: BLE001 - balancer may be briefly busy
+            pass
+        time.sleep(0.2)
+    return False
+
+
+def journal_events(jdir):
+    """Every record from every journal artifact in the fleet dir."""
+    out = []
+    for name in sorted(os.listdir(jdir)):
+        if ".journal" not in name:
+            continue
+        with open(os.path.join(jdir, name)) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                rec["_file"] = name
+                out.append(rec)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--keep", action="store_true",
+                    help="keep the scratch directory")
+    opts = ap.parse_args()
+    from fgumi_tpu.serve.client import ServeClient, ServeError
+
+    tmp = tempfile.mkdtemp(prefix="fgumi_fleet_")
+    ok = True
+    procs = {}
+    balancer = None
+    try:
+        wd_std = os.path.join(tmp, "standalone")
+        wd_fleet = os.path.join(tmp, "fleet_wd")   # BOTH daemons' cwd:
+        # relative job outputs land here no matter which daemon runs the
+        # job — the property takeover relies on
+        rpt = os.path.join(tmp, "reports")
+        jdir = os.path.join(tmp, "journals")
+        cache = os.path.join(tmp, "xla_cache")
+        for d in (wd_std, wd_fleet, rpt, jdir):
+            os.makedirs(d)
+        tok = os.path.join(tmp, "token")
+        with open(tok, "w") as f:
+            f.write(TOKEN + "\n")
+        inp = os.path.join(tmp, "grouped.bam")
+        p = run(["simulate", "grouped-reads", "-o", inp,
+                 "--num-families", "600", "--family-size", "4",
+                 "--seed", "7"], cwd=tmp)
+        assert p.returncode == 0, p.stderr
+        # the kill job gets a much larger input: by the time it runs the
+        # daemons are WARM (earlier scenarios compiled its shapes), and a
+        # sub-second job would finish before the SIGKILL lands — voiding
+        # the mid-job takeover scenario (the observed-running check below
+        # enforces this stays true)
+        inp_big = os.path.join(tmp, "grouped_big.bam")
+        p = run(["simulate", "grouped-reads", "-o", inp_big,
+                 "--num-families", "8000", "--family-size", "4",
+                 "--seed", "8"], cwd=tmp)
+        assert p.returncode == 0, p.stderr
+
+        job1 = ["simplex", "-i", inp, "-o", "out1.bam", "--min-reads", "1"]
+        job2 = ["simplex", "-i", inp, "-o", "out2.bam", "--min-reads", "1"]
+        kill_job = ["simplex", "-i", inp_big, "-o", "out_kill.bam",
+                    "--min-reads", "1"]
+        warm_job = ["simplex", "-i", inp, "-o", "out_warm.bam",
+                    "--min-reads", "1"]
+
+        # --- standalone references --------------------------------------
+        for argv in (job1, job2, kill_job, warm_job):
+            p = run(argv, cwd=wd_std)
+            assert p.returncode == 0, p.stderr
+
+        # --- fleet up: 2 daemons + balancer, all TCP + token -------------
+        ports = {"a": free_port(), "b": free_port()}
+        front = free_port()
+
+        def start_daemon(fid):
+            argv = [sys.executable, "-m", "fgumi_tpu", "serve",
+                    "--tcp", f"127.0.0.1:{ports[fid]}",
+                    "--workers", "1", "--queue-limit", "0",
+                    "--journal-dir", jdir, "--fleet-id", fid,
+                    "--lease-scan-period", "0.5",
+                    "--report-dir", rpt, "--compile-cache", cache,
+                    "--token-file", tok]
+            return subprocess.Popen(argv, cwd=wd_fleet, env=BASE_ENV,
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT, text=True)
+
+        procs["a"] = start_daemon("a")
+        procs["b"] = start_daemon("b")
+        balancer = subprocess.Popen(
+            [sys.executable, "-m", "fgumi_tpu", "balance",
+             "--listen", f"tcp:127.0.0.1:{front}",
+             "--backend", f"tcp:127.0.0.1:{ports['a']}",
+             "--backend", f"tcp:127.0.0.1:{ports['b']}",
+             "--token-file", tok, "--poll-period", "0.3",
+             "--eject-failures", "2", "--cooldown", "1.0",
+             "--probes", "2"],
+            cwd=tmp, env=BASE_ENV, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        client = ServeClient(f"tcp:127.0.0.1:{front}", timeout=30,
+                             token=TOKEN)
+        ping = wait_for_ping(client)
+        ok &= check("balancer front end answers through the token "
+                    "handshake", ping is not None
+                    and ping.get("tool") == "fgumi-tpu-balance",
+                    str(ping))
+        addr_a = f"tcp:127.0.0.1:{ports['a']}"
+        addr_b = f"tcp:127.0.0.1:{ports['b']}"
+        ok &= check("both backends healthy",
+                    wait_backend_state(client, addr_a, "closed")
+                    and wait_backend_state(client, addr_b, "closed"))
+
+        # --- spillover on over-capacity ---------------------------------
+        argv0 = os.path.join(REPO, "fgumi_tpu", "__main__.py")
+        j1 = client.submit(job1, argv0=argv0)
+        j2 = client.submit(job2, argv0=argv0)
+        prefixes = {j1["id"].split("-j-")[0], j2["id"].split("-j-")[0]}
+        ok &= check("concurrent submits spill across BOTH backends",
+                    prefixes == {"a", "b"},
+                    f"{j1['id']} / {j2['id']}")
+        over_reason = None
+        try:
+            client.submit(job1, argv0=argv0)
+        except ServeError as e:
+            over_reason = str(e)
+        ok &= check("over-capacity submit refused with an explicit reason",
+                    over_reason is not None
+                    and "no backend admitted" in over_reason,
+                    over_reason or "admitted!")
+        j1 = wait_job_tolerant(client, j1["id"])
+        j2 = wait_job_tolerant(client, j2["id"])
+        ok &= check("both spillover jobs done",
+                    j1 and j2 and j1.get("state") == "done"
+                    and j2.get("state") == "done",
+                    f"{j1 and j1.get('state')}/{j2 and j2.get('state')}")
+        for name in ("out1.bam", "out2.bam"):
+            a = open(os.path.join(wd_std, name), "rb").read()
+            b = open(os.path.join(wd_fleet, name), "rb").read()
+            ok &= check(f"{name} byte-identical to standalone", a == b,
+                        f"{len(a)} vs {len(b)} bytes")
+
+        # --- kill-one-mid-job takeover ----------------------------------
+        jk = client.submit(kill_job, argv0=argv0, dedupe="kill-fleet")
+        victim_id = jk["id"].split("-j-")[0]
+        survivor_id = "b" if victim_id == "a" else "a"
+        victim_addr = addr_a if victim_id == "a" else addr_b
+        observed_running = False
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            state = wait_job_tolerant(client, jk["id"], timeout=1)
+            s = state.get("state") if state else None
+            if s == "running":
+                observed_running = True
+                break
+            if s in ("done", "failed", "cancelled"):
+                break  # finished before the kill: the scenario is void
+        # the takeover scenario is only exercised if the SIGKILL lands
+        # MID-JOB — a pre-kill completion must fail the gate loudly, not
+        # let the later checks pass vacuously
+        ok &= check("kill job observed running before SIGKILL",
+                    observed_running,
+                    str(state and state.get("state")))
+        procs[victim_id].kill()   # SIGKILL: no drain, lease dies with it
+        procs[victim_id].wait(timeout=30)
+        ok &= check("balancer ejects the killed backend",
+                    wait_backend_state(client, victim_addr, "open"),
+                    json.dumps(backend_states(client)))
+        jk_final = wait_job_tolerant(client, jk["id"], timeout=240)
+        ok &= check("killed daemon's job finishes under its ORIGINAL id "
+                    "via lease takeover",
+                    jk_final and jk_final.get("state") == "done"
+                    and jk_final.get("id", jk["id"]) == jk["id"],
+                    str(jk_final and jk_final.get("state")))
+        a = open(os.path.join(wd_std, "out_kill.bam"), "rb").read()
+        b_path = os.path.join(wd_fleet, "out_kill.bam")
+        b = open(b_path, "rb").read() if os.path.exists(b_path) else b""
+        ok &= check("takeover output byte-identical to standalone",
+                    a == b, f"{len(a)} vs {len(b)} bytes")
+        leftovers = [n for n in os.listdir(wd_fleet) if ".tmp." in n]
+        ok &= check("no temp leftovers after takeover", not leftovers,
+                    ",".join(leftovers))
+        # zero double-execution: exactly one `done` event fleet-wide for
+        # the job, and the consumed journal was renamed .claimed
+        events = journal_events(jdir)
+        done_events = [e for e in events if e.get("id") == jk["id"]
+                       and e.get("state") == "done"]
+        ok &= check("journal audit: exactly one done event fleet-wide",
+                    len(done_events) == 1,
+                    f"{len(done_events)} done events")
+        claimed = [n for n in os.listdir(jdir)
+                   if n == f"{victim_id}.journal.claimed"]
+        ok &= check("dead daemon's journal consumed exactly once "
+                    "(renamed .claimed)", len(claimed) == 1,
+                    ",".join(sorted(os.listdir(jdir))))
+        # dedupe audit: the idempotent resubmit answers with the SAME
+        # (finished) job instead of executing a second copy
+        jk_again = client.submit(kill_job, argv0=argv0,
+                                 dedupe="kill-fleet")
+        ok &= check("dedupe resubmit answers with the recovered job",
+                    jk_again["id"] == jk["id"]
+                    and jk_again["state"] == "done",
+                    f"{jk_again['id']} ({jk_again['state']})")
+
+        # --- warm survivor: zero recompiles -----------------------------
+        jw = client.submit(warm_job, argv0=argv0)
+        ok &= check("warm job routed to the survivor",
+                    jw["id"].startswith(survivor_id + "-"), jw["id"])
+        jw = wait_job_tolerant(client, jw["id"])
+        ok &= check("warm job done", jw and jw.get("state") == "done",
+                    str(jw and (jw.get("error") or jw.get("state"))))
+        try:
+            r = json.load(open(os.path.join(rpt,
+                                            f"{jw['id']}.report.json")))
+        except (OSError, ValueError):
+            r = {}
+        # absent metric = zero observed compiles (the compile watcher
+        # only counts real backend-compile events; serve_smoke reads the
+        # same way) — dispatches > 0 proves the device path actually ran
+        compiles = r.get("metrics", {}).get("device.backend_compiles", 0)
+        dispatches = r.get("device", {}).get("dispatches", 0)
+        ok &= check("warm survivor reports zero XLA recompilations",
+                    bool(r) and compiles == 0 and dispatches > 0,
+                    f"compiles={compiles} dispatches={dispatches}")
+        a = open(os.path.join(wd_std, "out_warm.bam"), "rb").read()
+        b = open(os.path.join(wd_fleet, "out_warm.bam"), "rb").read()
+        ok &= check("warm output byte-identical to standalone", a == b)
+
+        # --- eject -> re-admit after restart ----------------------------
+        procs[victim_id] = start_daemon(victim_id)
+        ok &= check("restarted backend re-admitted via half-open probes",
+                    wait_backend_state(client, victim_addr, "closed",
+                                       timeout=90),
+                    json.dumps(backend_states(client)))
+
+        # --- clean shutdown ---------------------------------------------
+        client.shutdown()  # drains the balancer
+        rc = balancer.wait(timeout=60)
+        ok &= check("balancer exits 0 on shutdown", rc == 0, f"rc={rc}")
+        balancer = None
+        for fid, proc in procs.items():
+            direct = ServeClient(f"tcp:127.0.0.1:{ports[fid]}",
+                                 timeout=30, token=TOKEN)
+            try:
+                direct.shutdown()
+            except ServeError:
+                pass
+            rc = proc.wait(timeout=120)
+            ok &= check(f"daemon {fid} exits 0", rc == 0, f"rc={rc}")
+        procs.clear()
+    finally:
+        for proc in list(procs.values()) + ([balancer] if balancer
+                                            else []):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+        if opts.keep:
+            print("scratch kept at", tmp)
+        else:
+            shutil.rmtree(tmp, ignore_errors=True)
+    print("fleet smoke:", "OK" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

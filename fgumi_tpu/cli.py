@@ -1572,7 +1572,7 @@ def cmd_sort(args, source=None, sink=None):
                     for blob, lens in sorter.sorted_chunks_with_lens():
                         starts = np.zeros(len(lens) + 1, dtype=np.int64)
                         np.cumsum(lens, out=starts[1:])
-                        voffs = writer._w.write_indexed(blob, starts)
+                        voffs = writer.write_indexed(blob, starts)
                         buf = np.frombuffer(blob, dtype=np.uint8)
                         f = nbat.decode_fields(buf, starts[:-1])
                         cigar_off = (f["data_off"] + 32
@@ -3039,6 +3039,8 @@ def _pipeline_fused(args):
     stages = _pipeline_stage_argvs(args, lambda name: f"<fused:{name}>")
     # nested-stage flag travel, exactly like the staged driver's `pre`
     pre = ["--no-atomic-output"] if args.no_atomic_output else []
+    if args.audit_output:
+        pre.append("--audit-output")
     parser = build_parser()
     ns = {name: parser.parse_args(pre + argv) for name, argv in stages}
 
@@ -3231,6 +3233,8 @@ def _pipeline_staged(args):
     # each stage re-enters main(), which resets the atomic-commit global
     # from its own flags — so an outer --no-atomic-output must travel
     pre = ["--no-atomic-output"] if args.no_atomic_output else []
+    if args.audit_output:
+        pre.append("--audit-output")
     stages = _pipeline_stage_argvs(args, j)
     consumed = {"sort": "unmapped.bam", "group": "sorted.bam",
                 "simplex": "grouped.bam", "filter": "cons.bam"}
@@ -3903,6 +3907,14 @@ def build_parser():
              "crash-safe temp-file + atomic-rename commit (escape hatch "
              "for FIFO outputs; also FGUMI_TPU_NO_ATOMIC=1)")
     parser.add_argument(
+        "--audit-output", action="store_true",
+        help="verify every written BAM end to end (per-member BGZF "
+             "CRC32/ISIZE, BAM structure, record count and sort-key-order "
+             "digest against the writer's own tallies) BEFORE the atomic "
+             "rename publishes it; a mismatch aborts the commit with exit "
+             "5 so host-side corruption cannot ship a bad file "
+             "(also FGUMI_TPU_AUDIT_OUTPUT=1; docs/resilience.md)")
+    parser.add_argument(
         "--trace", default=None, metavar="PATH",
         help="record pipeline/IO/device spans and write a Chrome "
              "trace-event JSON loadable in Perfetto (also FGUMI_TPU_TRACE)")
@@ -3984,7 +3996,7 @@ def _run_command(args):
     """Dispatch to the subcommand with the top-level exception contract."""
     import errno as _errno
 
-    from .io.errors import InputFormatError
+    from .io.errors import InputFormatError, OutputIntegrityError
     from .parallel import MeshConfigError
     from .utils.faults import InjectedFault
     from .utils.governor import GOVERNOR, ResourceExhausted
@@ -4006,6 +4018,17 @@ def _run_command(args):
         # *clean* failure (distinct rc so the harness can tell it apart)
         log.error("%s", e)
         return 3
+    except OutputIntegrityError as e:
+        # the --audit-output pre-commit pass refuted the written file: the
+        # atomic rename was aborted (no partial/corrupt file published)
+        # and the black box carries the evidence — a distinct exit code so
+        # harnesses can tell "the output would have been wrong" from every
+        # other failure class (docs/resilience.md)
+        from .observe.flight import FLIGHT
+
+        FLIGHT.dump("output-integrity", exc=e)
+        log.error("%s", e)
+        return 5
     except ResourceExhausted as e:
         # resource hard limit (disk full, RSS hard watermark): atomic temps
         # were swept by the ordinary error unwinding; the run report gets a
@@ -4123,9 +4146,14 @@ def main(argv=None):
     depth = _main_depth.get()
     if depth == 0 or args.log_level or args.verbose:
         setup_logging(args.log_level, args.verbose)
+    from .io.bam import set_audit_output
     from .utils.atomic import set_atomic_enabled
 
     set_atomic_enabled(not args.no_atomic_output)
+    # set BOTH ways: the contextvar must not leak a previous in-process
+    # invocation's flag into this one (nested pipeline stages re-enter
+    # main() with the flag forwarded explicitly, like --no-atomic-output)
+    set_audit_output(bool(args.audit_output))
     rc = _apply_pipeline_compat(args)
     if rc:
         return rc
@@ -4220,6 +4248,15 @@ def _main_scoped(args, argv):
                          len(tracer.snapshot()), trace_path)
             except OSError as e:
                 log.error("failed to write trace %s: %s", trace_path, e)
+        # let in-flight shadow audits (ops/sentinel.py) reach their
+        # verdicts before the command exits: a divergence found by a
+        # background audit must still trip the breaker, write its black
+        # box, and land in this run's report. Cheap when nothing is
+        # pending; lazy so audit-free commands never import the module.
+        _sentinel = sys.modules.get("fgumi_tpu.ops.sentinel")
+        if _sentinel is not None and not _sentinel.SENTINEL.drain():
+            log.warning("audit sentinel: background audits still pending "
+                        "at command exit; report may undercount")
         if report_path:
             from .observe.report import emit, fold_device_stats
 

@@ -610,13 +610,195 @@ def default_compression_level() -> int:
     return DEFAULT_COMPRESSION_LEVEL if lvl is None else lvl
 
 
+_audit_output_var = contextvars.ContextVar("fgumi_tpu_audit_output",
+                                           default=False)
+
+
+def set_audit_output(enabled: bool):
+    """Arm (per invocation context) the ``--audit-output`` pre-commit
+    integrity pass for BAM outputs (cli.py global flag)."""
+    _audit_output_var.set(bool(enabled))
+
+
+def audit_output_enabled() -> bool:
+    import os
+
+    return _audit_output_var.get() or \
+        os.environ.get("FGUMI_TPU_AUDIT_OUTPUT", "").strip().lower() \
+        in ("1", "true", "on", "all")
+
+
+class _OutputTally:
+    """The writer's own record accounting for the ``--audit-output``
+    re-walk to check against: record count plus a streaming CRC32 over
+    the exact record-stream bytes (block_size prefixes + payloads, in
+    write order) as they were handed to the writer — so the audit proves
+    not just "N records survived" but "the bytes on disk are, in order,
+    the bytes the pipeline wrote": any loss, duplication, reordering, or
+    single-bit corruption between the writer's buffer and the page cache
+    flips the digest. The order-sensitivity of the chained CRC is the
+    sort-invariant check — the on-disk key sequence cannot differ from
+    the written one without flipping it."""
+
+    __slots__ = ("records", "content_crc", "header_crc")
+
+    def __init__(self):
+        self.records = 0
+        self.content_crc = 0
+        self.header_crc = 0  # CRC32 of the encoded BAM header block
+
+    def add_record(self, framed):
+        """One record WITH its 4-byte block_size prefix."""
+        self.records += 1
+        self.content_crc = zlib.crc32(framed, self.content_crc)
+
+    def add_serialized(self, blob):
+        """A block_size-prefixed record blob (the native batch
+        serializer's output)."""
+        view = memoryview(blob)
+        off = 0
+        n = len(view)
+        while off + 4 <= n:
+            size = int.from_bytes(view[off:off + 4], "little")
+            self.records += 1
+            off += 4 + size
+        if off != n:
+            # the writer itself was handed a torn blob: fail now, not at
+            # the re-walk (this is a caller bug, not disk corruption)
+            from .errors import OutputIntegrityError
+
+            raise OutputIntegrityError(
+                "serialized record blob is torn (partial block_size "
+                "prefix)")
+        self.content_crc = zlib.crc32(view, self.content_crc)
+
+    def add_indexed(self, blob, starts):
+        """A prefix-framed blob whose record boundaries the caller
+        already delimited (``starts``: cumulative offsets, one past the
+        record count) — no per-record Python walk needed; the pre-commit
+        re-walk still catches any disagreement between ``starts`` and
+        the actual framing."""
+        self.records += len(starts) - 1
+        self.content_crc = zlib.crc32(memoryview(blob), self.content_crc)
+
+
+class _BamStreamAudit:
+    """Incremental BAM structure walker over decompressed member payloads
+    (the ``--audit-output`` record-layer pass): parses magic/header/refs,
+    then counts records and CRCs their (refID, pos) keys exactly like
+    :class:`_OutputTally`; optionally checks coordinate order."""
+
+    def __init__(self, path: str, expect_coordinate: bool = False):
+        self._path = path
+        self._buf = bytearray()
+        self._state = "magic"
+        self._text_len = 0
+        self._refs_left = None
+        self.records = 0
+        self.content_crc = 0
+        self.header_crc = 0
+        self._expect_coord = expect_coordinate
+        self._last_key = None
+
+    def _fail(self, message):
+        from .errors import OutputIntegrityError
+
+        raise OutputIntegrityError(message, path=self._path)
+
+    def _eat_header(self, n: int):
+        """Consume n header-section bytes, folding them into header_crc
+        (the pre-record BAM structure is digest-checked too — a flipped
+        bit in @HD/@SQ/@PG provenance is as published as one in a read)."""
+        self.header_crc = zlib.crc32(memoryview(self._buf)[:n],
+                                     self.header_crc)
+        del self._buf[:n]
+
+    def feed(self, data):
+        self._buf += data
+        buf = self._buf
+        while True:
+            if self._state == "magic":
+                if len(buf) < 8:
+                    return
+                if bytes(buf[:4]) != BAM_MAGIC:
+                    self._fail("decompressed stream does not start with "
+                               "the BAM magic")
+                self._text_len = int.from_bytes(buf[4:8], "little")
+                self._eat_header(8)
+                self._state = "text"
+            elif self._state == "text":
+                if len(buf) < self._text_len + 4:
+                    return
+                self._refs_left = int.from_bytes(
+                    buf[self._text_len:self._text_len + 4], "little")
+                self._eat_header(self._text_len + 4)
+                self._state = "refs"
+            elif self._state == "refs":
+                if self._refs_left == 0:
+                    self._state = "records"
+                    continue
+                if len(buf) < 4:
+                    return
+                l_name = int.from_bytes(buf[:4], "little")
+                if len(buf) < 8 + l_name:
+                    return
+                self._eat_header(8 + l_name)
+                self._refs_left -= 1
+            else:  # records
+                if len(buf) < 4:
+                    return
+                size = int.from_bytes(buf[:4], "little")
+                if len(buf) < 4 + size:
+                    return
+                if size < 32:
+                    self._fail(f"record #{self.records} shorter than the "
+                               "fixed BAM record header")
+                key = bytes(buf[4:12])
+                self.records += 1
+                self.content_crc = zlib.crc32(memoryview(buf)[:4 + size],
+                                              self.content_crc)
+                if self._expect_coord:
+                    # the sorter's own key semantics (sort/keys.py):
+                    # refID unsigned (-1 = 0xFFFFFFFF, unmapped tail
+                    # last) but pos+1 — a mapped record with pos=-1
+                    # (RNAME set, POS 0) legally sorts FIRST within its
+                    # reference, so the raw unsigned pos would falsely
+                    # reject the sorter's correct output
+                    k = (int.from_bytes(key[:4], "little"),
+                         int.from_bytes(key[4:8], "little",
+                                        signed=True) + 1)
+                    if self._last_key is not None and k < self._last_key:
+                        self._fail(
+                            f"record #{self.records} out of coordinate "
+                            "order in an SO:coordinate file")
+                    self._last_key = k
+                del buf[:4 + size]
+
+    def finish(self):
+        if self._state != "records" or self._buf:
+            self._fail("decompressed stream ends mid-structure "
+                       f"(state={self._state}, {len(self._buf)} residual "
+                       "bytes)")
+
+
 class BamWriter:
-    """Sequential BAM writer over BGZF."""
+    """Sequential BAM writer over BGZF.
+
+    With ``--audit-output`` armed (and the atomic commit enabled), the
+    writer tallies every record it is handed and, at close, re-walks the
+    finished temp file — per-member BGZF CRC32/ISIZE, BAM structure,
+    record count, and sort-key-order digest against its own tallies —
+    BEFORE the atomic rename publishes it. A host-side DMA or page-cache
+    corruption therefore fails the run (exit 5) instead of shipping a bad
+    file (docs/resilience.md "Silent-corruption sentinel")."""
 
     def __init__(self, path_or_obj, header: BamHeader, level: int = None):
         if level is None:
             level = default_compression_level()
         owns = isinstance(path_or_obj, str)
+        self._audit = None
+        self._audit_coord = False
+        self._audit_path = path_or_obj if owns else None
         if owns:
             # crash-safe commit: write .<name>.tmp.<pid>, atomic-rename on
             # close so an interrupted run never leaves a torn BAM under the
@@ -624,18 +806,36 @@ class BamWriter:
             from ..utils.atomic import open_output
 
             fileobj = open_output(path_or_obj)
+            if audit_output_enabled():
+                if hasattr(fileobj, "pre_commit_check"):
+                    self._audit = _OutputTally()
+                    self._audit_coord = "SO:coordinate" in header.text
+                    fileobj.pre_commit_check = self._run_output_audit
+                else:
+                    import logging
+
+                    logging.getLogger("fgumi_tpu").debug(
+                        "--audit-output: atomic commit disabled for %s; "
+                        "no pre-rename window to audit in — skipping",
+                        path_or_obj)
         else:
             fileobj = path_or_obj
         self._w = BgzfWriter(fileobj, level=level, owns_fileobj=owns)
         try:
-            self._w.write(header.encode())
+            enc = header.encode()
+            if self._audit is not None:
+                self._audit.header_crc = zlib.crc32(enc)
+            self._w.write(enc)
         except BaseException:
             # construction failed: drop the temp eagerly rather than at GC
             self._w.discard()
             raise
 
     def write_record_bytes(self, data: bytes):
-        self._w.write(struct.pack("<I", len(data)) + data)
+        framed = struct.pack("<I", len(data)) + data
+        if self._audit is not None:
+            self._audit.add_record(framed)
+        self._w.write(framed)
 
     def write_record(self, rec: RawRecord):
         self.write_record_bytes(rec.data)
@@ -643,7 +843,75 @@ class BamWriter:
     def write_serialized(self, blob: bytes):
         """Append records already carrying their block_size prefixes
         (the native batch serializer's output)."""
+        if self._audit is not None:
+            self._audit.add_serialized(blob)
         self._w.write(blob)
+
+    def write_indexed(self, blob, starts):
+        """Append a prefix-framed record blob and return the BGZF virtual
+        offset of each ``starts`` position (the BAI/CSI builders' bulk
+        path — see :meth:`BgzfWriter.write_indexed`). Tallied like
+        write_serialized so ``--audit-output`` covers indexed sorts."""
+        if self._audit is not None:
+            self._audit.add_indexed(blob, starts)
+        return self._w.write_indexed(blob, starts)
+
+    def _run_output_audit(self, tmp_path: str):
+        """The pre-commit hook (utils/atomic.py): verify the finished
+        temp end to end; raise OutputIntegrityError to abort the rename."""
+        import logging
+        import time as _time
+
+        from ..observe.metrics import METRICS
+        from .bgzf import verify_members
+        from .errors import OutputIntegrityError
+
+        t0 = _time.monotonic()
+        walker = _BamStreamAudit(tmp_path,
+                                 expect_coordinate=self._audit_coord)
+        stats = {"members": 0, "data_bytes": 0, "eof_sentinel": False}
+        try:
+            stats = verify_members(tmp_path, sink=walker.feed)
+            walker.finish()
+            if not stats["eof_sentinel"]:
+                raise OutputIntegrityError("missing BGZF EOF sentinel",
+                                           path=tmp_path)
+            if walker.header_crc != self._audit.header_crc:
+                raise OutputIntegrityError(
+                    "BAM header digest mismatch: the header block on disk "
+                    "is not the header the writer encoded", path=tmp_path)
+            if walker.records != self._audit.records:
+                raise OutputIntegrityError(
+                    f"record count mismatch: file holds {walker.records}, "
+                    f"writer tallied {self._audit.records}", path=tmp_path)
+            if walker.content_crc != self._audit.content_crc:
+                raise OutputIntegrityError(
+                    "record-stream digest mismatch: the record bytes on "
+                    "disk are not (in order) the bytes the writer was "
+                    "handed", path=tmp_path)
+        except OutputIntegrityError as e:
+            self._note_audit(self._audit_path, False,
+                             stats_members=stats["members"],
+                             records=walker.records, error=str(e))
+            raise
+        dt = _time.monotonic() - t0
+        METRICS.observe("io.output_audit_s", dt)
+        self._note_audit(self._audit_path, True,
+                         stats_members=stats["members"],
+                         records=walker.records)
+        logging.getLogger("fgumi_tpu").info(
+            "output audit: %d BGZF members / %d records verified clean "
+            "in %.2fs", stats["members"], walker.records, dt)
+
+    @staticmethod
+    def _note_audit(path, ok, stats_members, records, error=None):
+        # record the verdict on the sentinel (run report / stats `audit`
+        # section). ops.sentinel is numpy-light — importing it here does
+        # not drag in jax for IO-only commands.
+        from ..ops.sentinel import SENTINEL
+
+        SENTINEL.note_output_audit(path or "", ok, members=stats_members,
+                                   records=records, error=error)
 
     def tell_virtual(self) -> int:
         """BGZF virtual offset of the next record (for BAI building)."""

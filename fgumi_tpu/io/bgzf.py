@@ -17,7 +17,7 @@ import zlib
 from ..observe import trace as _trace
 from ..observe.metrics import METRICS
 from ..utils import faults
-from .errors import InputFormatError
+from .errors import InputFormatError, OutputIntegrityError
 
 # Maximum uncompressed payload per BGZF block.
 MAX_BLOCK_DATA = 0xFF00
@@ -258,6 +258,96 @@ class BgzfWriter(io.RawIOBase):
 
             discard_output(self._f)
         super().close()
+
+
+def _parse_member_bsize(extra: bytes) -> int:
+    """BSIZE (total member length - 1) from a member's FEXTRA subfields,
+    or -1 when no BC subfield is present (not a BGZF member)."""
+    off = 0
+    while off + 4 <= len(extra):
+        slen = int.from_bytes(extra[off + 2: off + 4], "little")
+        if extra[off: off + 2] == b"BC" and slen == 2:
+            return int.from_bytes(extra[off + 4: off + 6], "little")
+        off += 4 + slen
+    return -1
+
+
+def verify_members(path: str, sink=None) -> dict:
+    """Re-walk a written BGZF file member by member, verifying each one
+    end to end (the ``--audit-output`` compressed-layer pass).
+
+    For every gzip member: parse the fixed header + FEXTRA BC subfield,
+    inflate the raw deflate payload with a fresh decompressor, and check
+    the member's CRC32 and ISIZE trailer against the *freshly decoded*
+    bytes — so a bit flipped anywhere between the writer's buffers and
+    the page cache (payload, trailer, or header) fails loudly instead of
+    being published. ``sink(decoded_bytes)``, when given, receives each
+    member's decompressed payload in order (the BAM record walk rides
+    this). Returns ``{"members", "data_bytes", "eof_sentinel"}``; raises
+    :class:`~fgumi_tpu.io.errors.OutputIntegrityError` naming the member
+    offset on the first inconsistency."""
+    members = 0
+    data_bytes = 0
+    last_empty = False
+    with open(path, "rb") as f:
+        offset = 0
+        while True:
+            head = f.read(12)
+            if not head:
+                break
+            if len(head) < 12 or head[:4] != b"\x1f\x8b\x08\x04":
+                raise OutputIntegrityError(
+                    "not a BGZF member header", path=path, offset=offset)
+            xlen = int.from_bytes(head[10:12], "little")
+            extra = f.read(xlen)
+            if len(extra) < xlen:
+                raise OutputIntegrityError(
+                    "truncated member header", path=path, offset=offset)
+            bsize = _parse_member_bsize(extra)
+            if bsize < 0:
+                raise OutputIntegrityError(
+                    "member has no BC subfield", path=path, offset=offset)
+            payload_len = bsize + 1 - 12 - xlen - 8
+            if payload_len < 0:
+                raise OutputIntegrityError(
+                    f"member BSIZE {bsize + 1} smaller than its own "
+                    "header", path=path, offset=offset)
+            payload = f.read(payload_len)
+            trailer = f.read(8)
+            if len(payload) < payload_len or len(trailer) < 8:
+                raise OutputIntegrityError(
+                    "truncated member (file ends mid-block)", path=path,
+                    offset=offset)
+            z = zlib.decompressobj(wbits=-15)
+            try:
+                decoded = z.decompress(payload) + z.flush()
+            except zlib.error as e:
+                raise OutputIntegrityError(
+                    f"member payload does not inflate: {e}", path=path,
+                    offset=offset) from e
+            if z.unconsumed_tail or not z.eof:
+                raise OutputIntegrityError(
+                    "member deflate stream did not terminate cleanly",
+                    path=path, offset=offset)
+            crc = int.from_bytes(trailer[:4], "little")
+            isize = int.from_bytes(trailer[4:8], "little")
+            if zlib.crc32(decoded) != crc:
+                raise OutputIntegrityError(
+                    f"member CRC32 mismatch (stored {crc:#010x}, "
+                    f"computed {zlib.crc32(decoded):#010x})", path=path,
+                    offset=offset)
+            if (len(decoded) & 0xFFFFFFFF) != isize:
+                raise OutputIntegrityError(
+                    f"member ISIZE mismatch (stored {isize}, computed "
+                    f"{len(decoded)})", path=path, offset=offset)
+            members += 1
+            data_bytes += len(decoded)
+            last_empty = len(decoded) == 0
+            if sink is not None and decoded:
+                sink(decoded)
+            offset += bsize + 1
+    return {"members": members, "data_bytes": data_bytes,
+            "eof_sentinel": last_empty}
 
 
 class BgzfReader:

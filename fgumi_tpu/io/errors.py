@@ -8,6 +8,27 @@ traceback.
 """
 
 
+class OutputIntegrityError(RuntimeError):
+    """A written output failed its pre-commit integrity audit.
+
+    Raised by the ``--audit-output`` pass (io/bam.py + io/bgzf.py) when
+    the re-walked temp file disagrees with what the writer believes it
+    wrote — a corrupt BGZF member (CRC32/ISIZE mismatch), a truncated
+    member, a record-count mismatch, or a sort-key-order mismatch against
+    the writer's own tallies. The atomic commit is aborted, so the bad
+    file is never published under its final name; the CLI maps this to
+    exit code 5 (docs/resilience.md)."""
+
+    def __init__(self, message: str, path: str = None, offset: int = None):
+        self.path = path
+        self.offset = offset
+        loc = f"{path}: " if path is not None else ""
+        suffix = f" (near byte offset {offset})" if offset is not None \
+            else ""
+        super().__init__(
+            f"{loc}output integrity audit failed: {message}{suffix}")
+
+
 class InputFormatError(ValueError):
     """Corrupt, truncated, or malformed input.
 

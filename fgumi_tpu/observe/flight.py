@@ -83,6 +83,15 @@ def router_snapshot():
     return router.ROUTER.snapshot() if router is not None else None
 
 
+def audit_snapshot():
+    """The silent-corruption sentinel's scoreboard (ops/sentinel.py), or
+    None before it loads / while it has seen nothing."""
+    sentinel = sys.modules.get("fgumi_tpu.ops.sentinel")
+    if sentinel is None or not sentinel.SENTINEL.has_activity():
+        return None
+    return sentinel.SENTINEL.snapshot()
+
+
 def mesh_snapshot():
     """The active production mesh's {dp, sp, devices, platform}, or None
     when no mesh was built this process (single-device / host-only)."""
@@ -228,7 +237,8 @@ class FlightRecorder:
                          ("device", self._device_section),
                          ("mesh", mesh_snapshot),
                          ("breaker", breaker_snapshot),
-                         ("governor", governor_snapshot)):
+                         ("governor", governor_snapshot),
+                         ("audit", audit_snapshot)):
             try:
                 obj[name] = fn()
             except Exception as e:  # noqa: BLE001 - keep the rest

@@ -30,7 +30,15 @@ import sys
 #: snapshot's ``filter_keep_rate`` — and the latency section gains the
 #: ``device.dispatch.fetch_bytes`` histogram, making the fused-filter
 #: bytes-fetched claim machine-readable from any run.
-SCHEMA_VERSION = 3
+#: v4 (ISSUE 14): optional ``audit`` section — the silent-corruption
+#: sentinel's scoreboard (sampled/clean/divergent/dropped counts,
+#: per-device attribution map, bounded ``divergence`` evidence records
+#: carrying both result buffers' sha256 digests, and the
+#: ``--audit-output`` pre-commit verification verdicts). A run whose
+#: ``audit.divergence`` is non-empty produced at least one device result
+#: the f64 oracle refutes — callers must treat that output as suspect
+#: (in sampled mode the corrupt batch was already consumed).
+SCHEMA_VERSION = 4
 
 
 def _device_stats():
@@ -70,6 +78,8 @@ _OPTIONAL = {
                         # (utils/governor.py)
     "latency": dict,    # histogram name -> {count,sum,p50,p90,p99,max}
                         # (observe/metrics.py latency histograms; v2)
+    "audit": dict,      # silent-corruption sentinel scoreboard + output
+                        # verification verdicts (ops/sentinel.py; v4)
     "flight_dumps": list,  # black-box paths the flight recorder wrote
                            # during this run (observe/flight.py; v2)
     "trace_path": str,
@@ -79,6 +89,9 @@ _OPTIONAL = {
 #: Required numeric fields of one ``latency`` summary entry, in the order
 #: the quantile-monotonicity check walks them.
 _LATENCY_FIELDS = ("count", "sum", "p50", "p90", "p99", "max")
+
+#: Required integer counters of the ``audit`` section (v4).
+_AUDIT_COUNTERS = ("sampled", "clean", "divergent", "dropped")
 
 
 def validate_report(obj) -> list:
@@ -120,6 +133,21 @@ def validate_report(obj) -> list:
                     <= summ["max"]):
                 errors.append(f"latency entry {name!r} quantiles are not "
                               "ordered (p50 <= p90 <= p99 <= max)")
+    if isinstance(obj.get("audit"), dict):
+        audit = obj["audit"]
+        for f in _AUDIT_COUNTERS:
+            v = audit.get(f)
+            if not isinstance(v, int) or isinstance(v, bool):
+                errors.append(f"audit field {f!r} is not an integer")
+        if audit.get("divergent", 0) and not audit.get("divergence"):
+            errors.append("audit.divergent > 0 but no divergence records")
+        if "divergence" in audit and not isinstance(audit["divergence"],
+                                                    list):
+            errors.append("audit.divergence is not a list")
+        if "output" in audit and not isinstance(audit["output"], list):
+            errors.append("audit.output is not a list")
+        if "devices" in audit and not isinstance(audit["devices"], dict):
+            errors.append("audit.devices is not an object")
     return errors
 
 
@@ -236,6 +264,13 @@ def build_report(command: str, argv, started_unix: float, wall_s: float,
     gov = sys.modules.get("fgumi_tpu.utils.governor")
     if gov is not None and gov.GOVERNOR.has_activity():
         report["resource"] = gov.GOVERNOR.snapshot()
+    # silent-corruption sentinel (schema v4): anything beyond a quiet run
+    # — sampled shadow audits, dropped samples, divergences, output-audit
+    # verdicts — rides along, so an SDC-touched run's artifact names the
+    # corrupt dispatch and which output to distrust (ops/sentinel.py)
+    sentinel = sys.modules.get("fgumi_tpu.ops.sentinel")
+    if sentinel is not None and sentinel.SENTINEL.has_activity():
+        report["audit"] = sentinel.SENTINEL.snapshot()
     # latency histogram summaries (schema v2): every instrumented hot path
     # that observed at least one sample this run — the "how slow was the
     # tail" counterpart of the flat counters above

@@ -34,8 +34,26 @@ Env contract (docs/resilience.md "Self-healing"):
   doubles per consecutive re-trip up to 8x).
 - ``FGUMI_TPU_BREAKER_PROBES`` — consecutive half-open successes required
   to close (default 2).
+- ``FGUMI_TPU_AUDIT_READMIT`` — audited probe dispatches required to lift
+  an ``sdc`` quarantine (default 4; ``0`` = an SDC-tripped device is
+  never re-admitted this process). See below.
 - ``FGUMI_TPU_HEALTH_PERIOD_S`` — health-monitor canary period for
   long-lived processes (the serve daemon); 0 (default) = no monitor.
+
+SDC quarantine (ISSUE 14, ops/sentinel.py): a shadow-audit divergence —
+the device returned an answer the f64 host oracle refutes — trips the
+breaker via :meth:`DeviceBreaker.record_sdc` and is categorically worse
+than a wedge: a wedged device is *slow*, a silently-corrupting device is
+*lying*, and time alone is no evidence it stopped. So unlike every other
+trip reason, the cooldown does NOT half-open the breaker back on its own:
+while quarantined, re-admission requires ``FGUMI_TPU_AUDIT_READMIT``
+probe dispatches that are themselves *fully audited* (the sentinel forces
+an inline shadow audit on every dispatch while
+:meth:`DeviceBreaker.audit_required` is true); only the sentinel's
+:meth:`DeviceBreaker.record_audit_clean` verdicts count toward closing —
+an ordinary clean resolve proves the device answered, not that it
+answered *correctly*. A fresh divergence during probing re-trips with the
+usual doubled cooldown.
 
 Like the router's EWMAs, breaker state is a per-process fact (the device
 is shared by every job in the process); the *metrics* it stamps
@@ -70,6 +88,15 @@ def _env_float(name, default):
         return max(float(os.environ.get(name, str(default))), 0.1)
     except ValueError:
         return default
+
+
+def audit_readmit_probes() -> int:
+    """Audited probe dispatches required to lift an SDC quarantine
+    (``FGUMI_TPU_AUDIT_READMIT``, default 4; 0 = never re-admit)."""
+    try:
+        return max(int(os.environ.get("FGUMI_TPU_AUDIT_READMIT", "4")), 0)
+    except ValueError:
+        return 4
 
 
 class DeviceBreaker:
@@ -116,10 +143,15 @@ class DeviceBreaker:
             self._probe_inflight = False
             self._probe_claimed_at = None
             self._probe_successes = 0
+            # SDC quarantine (ops/sentinel.py): while set, cooldown alone
+            # cannot re-admit the device — only audited-clean probes can
+            self._sdc_tripped = False
+            self._audit_probe_ok = 0
             self.transitions = []        # [(t_mono, from, to, reason)]
             self.deadline_overruns = 0
             self.transient_failures = 0
             self.canary_failures = 0
+            self.sdc_trips = 0
             self.successes = 0
 
     @property
@@ -134,7 +166,17 @@ class DeviceBreaker:
             cool = self._cooldown_s() * min(2 ** max(self._trips - 1, 0),
                                             MAX_COOLDOWN_FACTOR)
             if self._now() - self._opened_at >= cool:
-                self._transition_locked(HALF_OPEN, "cooldown elapsed")
+                if self._sdc_tripped and audit_readmit_probes() <= 0:
+                    # quarantined with re-admission disabled: the device
+                    # stays host-forced for the rest of the process — a
+                    # corrupting chip earns no automatic second chance
+                    pass
+                elif self._sdc_tripped:
+                    self._transition_locked(
+                        HALF_OPEN, "cooldown elapsed (sdc quarantine: "
+                        "re-admission requires audited probes)")
+                else:
+                    self._transition_locked(HALF_OPEN, "cooldown elapsed")
         if (self._state == HALF_OPEN and self._probe_inflight
                 and self._probe_claimed_at is not None
                 and self._now() - self._probe_claimed_at
@@ -179,6 +221,7 @@ class DeviceBreaker:
         if new == HALF_OPEN:
             self._probe_inflight = False
             self._probe_successes = 0
+            self._audit_probe_ok = 0
         if new == CLOSED:
             self._score = 0
             self._trips = 0
@@ -247,6 +290,13 @@ class DeviceBreaker:
                 return
             if state == HALF_OPEN:
                 self._probe_inflight = False
+                if self._sdc_tripped:
+                    # a clean resolve proves the probe *answered*, not that
+                    # it answered correctly — under SDC quarantine only the
+                    # sentinel's audited verdict (record_audit_clean, fed
+                    # after the inline shadow audit compares this very
+                    # probe against the f64 oracle) counts toward closing
+                    return
                 self._probe_successes += 1
                 if self._probe_successes >= self._probes_to_close():
                     self._transition_locked(
@@ -304,12 +354,63 @@ class DeviceBreaker:
                                  self._failure_threshold())
         self._dump_if_tripped(was)
 
+    # --------------------------------------------------- SDC quarantine
+
+    def record_sdc(self, detail: str = ""):
+        """The shadow audit (ops/sentinel.py) caught the device returning
+        a result the f64 host oracle refutes: silent data corruption.
+        Trips immediately from any state and arms the quarantine — the
+        cooldown alone can no longer re-admit the device (see the module
+        docstring's SDC section)."""
+        reason = "silent data corruption (audit divergence)"
+        if detail:
+            reason += f": {detail}"
+        with self._lock:
+            was = self._state
+            self.sdc_trips += 1
+            self._sdc_tripped = True
+            self._audit_probe_ok = 0
+            self._failure_locked(reason, self._failure_threshold())
+        self._dump_if_tripped(was)
+
+    def audit_required(self) -> bool:
+        """True while SDC-quarantined: every dispatch the router still
+        admits (a half-open re-admission probe) must be fully audited
+        inline — the sentinel consults this at its resolve tap."""
+        if not self.enabled():
+            return False
+        with self._lock:
+            return self._sdc_tripped
+
+    def sdc_quarantined(self) -> bool:
+        """Alias for router stamping (why=sdc-quarantine vs breaker-open)."""
+        return self.audit_required()
+
+    def record_audit_clean(self):
+        """One SDC re-admission probe came back byte-identical to the f64
+        oracle under a full inline audit (the only feedback that counts
+        toward lifting the quarantine). ``FGUMI_TPU_AUDIT_READMIT``
+        consecutive such verdicts close the breaker and clear the
+        quarantine; a divergence meanwhile re-trips via record_sdc."""
+        with self._lock:
+            if not self._sdc_tripped:
+                return
+            if self._advance_locked() != HALF_OPEN:
+                return
+            self._audit_probe_ok += 1
+            need = audit_readmit_probes()
+            if need and self._audit_probe_ok >= need:
+                self._sdc_tripped = False
+                self._transition_locked(
+                    CLOSED, f"{self._audit_probe_ok} fully-audited probes "
+                    "clean (sdc quarantine lifted)")
+
     # ----------------------------------------------------------- snapshot
 
     def snapshot(self) -> dict:
         with self._lock:
             state = self._advance_locked()
-            return {
+            out = {
                 "state": state,
                 "enabled": self.enabled(),
                 "deadline_overruns": self.deadline_overruns,
@@ -321,6 +422,11 @@ class DeviceBreaker:
                     {"t": t, "from": a, "to": b, "reason": r}
                     for t, a, b, r in self.transitions],
             }
+            if self.sdc_trips or self._sdc_tripped:
+                out["sdc_trips"] = self.sdc_trips
+                out["sdc_quarantined"] = self._sdc_tripped
+                out["audit_probe_ok"] = self._audit_probe_ok
+            return out
 
 
 class HealthMonitor:

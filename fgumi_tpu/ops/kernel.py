@@ -756,7 +756,7 @@ class DispatchTicket:
 
     __slots__ = ("_event", "_result", "_exc", "slot", "upload_bytes",
                  "_released", "_abandoned", "mesh_gather", "mesh_devices",
-                 "staging", "filter_mode")
+                 "mesh_f_loc", "staging", "filter_mode")
 
     def __init__(self):
         self._event = threading.Event()
@@ -773,10 +773,13 @@ class DispatchTicket:
         # fused consensus→filter dispatch (resolve_segments_wire_filtered)
         self.filter_mode = False
         # mesh dispatches (device_call_segments_wire mesh=...): the
-        # family-order gather over the shard-ordered device output, and the
-        # mesh size the router's per-mesh cost model is keyed by
+        # family-order gather over the shard-ordered device output, the
+        # mesh size the router's per-mesh cost model is keyed by, and the
+        # per-shard family count the audit sentinel attributes divergent
+        # rows with (shard = gather[row] // F_loc)
         self.mesh_gather = None
         self.mesh_devices = 1
+        self.mesh_f_loc = None
 
     def _set(self, result=None, exc=None):
         self._result = result
@@ -3001,6 +3004,7 @@ class ConsensusKernel:
                 upload_bytes=upload, slot=slot)
         ticket.mesh_gather = mesh_gather
         ticket.mesh_devices = mesh.size
+        ticket.mesh_f_loc = F_loc
         return ticket
 
     def resolve_segments_wire(self, ticket, codes2d: np.ndarray,
@@ -3034,6 +3038,12 @@ class ConsensusKernel:
             left = None if deadline is None else \
                 max(deadline - (time.monotonic() - t0), 1.0)
             got = _fetch_with_deadline(dev, left)
+            # SDC chaos point (ops/sentinel.py): `corrupt-result` flips
+            # bits in the fetched arrays exactly where a defective chip
+            # would have — after the device, before any host consumer
+            from ..utils import faults
+
+            got = faults.fire("device.fetch", got)
             if len(got) == 4:
                 qs, wp, d16, e16 = got
             else:
@@ -3154,6 +3164,28 @@ class ConsensusKernel:
                 suspect, winner, qual, depth, errors,
                 lambda f: (codes2d[starts[f]:starts[f + 1]],
                            quals2d[starts[f]:starts[f + 1]]))
+        # shadow-audit tap (ops/sentinel.py): a deterministic sample of
+        # clean device resolves is re-executed on the f64 host oracle and
+        # compared exactly; an inline (`all`/quarantine-probe) audit that
+        # catches a divergence hands back the oracle tuple to publish
+        # instead of the corrupt device buffers
+        from .sentinel import SENTINEL
+
+        repaired = SENTINEL.maybe_audit(
+            self, codes2d, quals2d, starts, winner, qual, depth, errors,
+            devices=ticket.mesh_devices, gather=gather,
+            f_loc=ticket.mesh_f_loc, slot=ticket.slot)
+        if repaired is not None:
+            winner, qual, depth, errors = repaired
+            if resident is not None:
+                # device-resident columns from the same dispatch are as
+                # untrustworthy as the fetched result: drop them and let
+                # the combine stage take its host path
+                resident.release()
+                resident = None
+            if want_extras:
+                return winner, qual, depth, errors, {
+                    "suspect": None, "resident": None, "gather": None}
         if want_extras:
             return winner, qual, depth, errors, {"suspect": suspect,
                                                  "resident": resident,
@@ -3689,6 +3721,9 @@ class ConsensusKernel:
             return winner, qual, depth, errors
         try:
             packed = _fetch_with_deadline(dev, dispatch_deadline_s())
+            from ..utils import faults
+
+            packed = faults.fire("device.fetch", packed)
         except DeadlineExceeded as e:
             return self._deadline_fallback_segments(e, codes2d, quals2d,
                                                     starts)
@@ -3700,7 +3735,17 @@ class ConsensusKernel:
         from .breaker import BREAKER
 
         BREAKER.record_success()  # clean resolve: resets the failure score
-        return self._finish_segments(packed, codes2d, quals2d, starts)
+        out = self._finish_segments(packed, codes2d, quals2d, starts)
+        if len(starts) - 1 > 0:
+            # shadow-audit tap (see resolve_segments_wire): classic
+            # packed-segment dispatches are sampled/audited the same way
+            from .sentinel import SENTINEL
+
+            repaired = SENTINEL.maybe_audit(
+                self, codes2d, quals2d, starts, *out)
+            if repaired is not None:
+                out = repaired
+        return out
 
     def _finish_segments(self, packed: np.ndarray, codes2d, quals2d, starts):
         J = len(starts) - 1

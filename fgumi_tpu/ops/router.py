@@ -246,7 +246,7 @@ class OffloadRouter:
 
         if forced == "device":
             if not BREAKER.allow():
-                return self._stamp("host", why="breaker-open")
+                return self._stamp("host", why=self._deny_reason(BREAKER))
             return self._stamp("device", forced=True, why="forced")
 
         env_cap = os.environ.get("FGUMI_TPU_MAX_INFLIGHT", "").strip()
@@ -258,7 +258,7 @@ class OffloadRouter:
                 else "device"
             if side == "device" and not BREAKER.allow():
                 side = "host"
-                return self._stamp(side, why="breaker-open")
+                return self._stamp(side, why=self._deny_reason(BREAKER))
             return self._stamp(side, why="max-inflight")
 
         with self._lock:
@@ -296,10 +296,19 @@ class OffloadRouter:
                 side = "host" if side == "device" else "device"
                 why = "probe-refresh"
         if side == "device" and not BREAKER.allow():
-            side, why = "host", "breaker-open"
+            side, why = "host", self._deny_reason(BREAKER)
         return self._stamp(side, why=why, t_dev=t_dev, t_host=t_host,
                            link_bps=link, host_cps=host_cps,
                            overhead_s=overhead, in_flight=in_flight)
+
+    @staticmethod
+    def _deny_reason(breaker) -> str:
+        """Why the breaker denied the device: an SDC quarantine (the
+        shadow audit caught corruption — ops/sentinel.py) is stamped
+        distinctly from an ordinary wedge/transient trip so a host-forced
+        run's artifact names the actual cause."""
+        return "sdc-quarantine" if breaker.sdc_quarantined() \
+            else "breaker-open"
 
     def _stamp(self, side, forced=False, why="", t_dev=None, t_host=None,
                link_bps=None, host_cps=None, overhead_s=None, in_flight=0):

@@ -183,6 +183,15 @@ class Backend:
         self._depth = None          # queued + running; None = unknown
         self.last_ok_unix = None
         self.last_error = None
+        # silent-corruption quarantine (ISSUE 14): a backend whose stats
+        # report audit divergences is held out of routing entirely until
+        # a later health poll sees the counters back at zero — which only
+        # a daemon restart produces, so "re-admitted on restart" is the
+        # whole contract. Forwarded traffic succeeding must NOT lift it:
+        # a submit that worked proves the backend answers, not that its
+        # device tells the truth.
+        self.sdc_hold = False
+        self.audit_divergent = 0
 
     @property
     def depth(self):
@@ -202,15 +211,32 @@ class Backend:
         with self._lock:
             self.last_error = str(err)[:200]
 
+    def note_audit(self, divergent: int):
+        """Record the latest stats poll's audit divergence count and
+        advance the sdc hold; returns ``(became_held, became_clear)``."""
+        with self._lock:
+            self.audit_divergent = int(divergent)
+            if divergent > 0 and not self.sdc_hold:
+                self.sdc_hold = True
+                return True, False
+            if divergent == 0 and self.sdc_hold:
+                self.sdc_hold = False
+                return False, True
+            return False, False
+
     def snapshot(self) -> dict:
         with self._lock:
-            return {
+            out = {
                 "address": self.address,
                 "state": self.breaker.state,
                 "depth": self._depth,
                 "last_ok_unix": self.last_ok_unix,
                 "last_error": self.last_error,
             }
+            if self.sdc_hold or self.audit_divergent:
+                out["sdc_hold"] = self.sdc_hold
+                out["audit_divergent"] = self.audit_divergent
+            return out
 
 
 class Balancer:
@@ -372,8 +398,37 @@ class Balancer:
             sched = stats.get("scheduler") or {}
             b.note_depth(int(sched.get("queued", 0))
                          + int(sched.get("running", 0)))
-            b.note_ok()
-            b.breaker.record_success()
+            # silent-corruption check (ISSUE 14): a backend whose shadow
+            # audit caught its device lying is ejected like a failed
+            # probe — and held out of routing until its audit counters
+            # read zero again, which only a restart produces
+            divergent = int((stats.get("audit") or {}).get("divergent", 0))
+            became_held, became_clear = b.note_audit(divergent)
+            if became_held:
+                from ..observe.metrics import METRICS
+
+                METRICS.inc("fleet.balancer.sdc_ejected")
+                from ..observe.flight import FLIGHT
+
+                FLIGHT.note("balancer.sdc_eject", address=b.address,
+                            divergent=divergent)
+                log.error(
+                    "balance: backend %s reports %d audit divergence(s) "
+                    "— silent data corruption; holding it out of routing "
+                    "until its counters reset (restart)",
+                    b.address, divergent)
+            if became_clear:
+                log.warning(
+                    "balance: backend %s audit counters are clean again "
+                    "(restart observed); lifting the sdc hold", b.address)
+            if divergent > 0:
+                b.note_error(f"sdc: {divergent} audit divergence(s)")
+                b.breaker.record_failure(
+                    f"backend reports {divergent} audit divergence(s) "
+                    "(silent data corruption)")
+            else:
+                b.note_ok()
+                b.breaker.record_success()
         except ServeError as e:
             b.note_error(e)
             b.breaker.record_failure(f"health probe failed: {e}")
@@ -392,8 +447,12 @@ class Balancer:
 
     def _healthy_backends(self):
         """Routable backends, least-loaded first (unknown depth last among
-        the healthy — it answered the breaker but never a stats poll)."""
-        out = [b for b in self.backends if b.breaker.state != "open"]
+        the healthy — it answered the breaker but never a stats poll).
+        SDC-held backends are excluded outright: half-open probing would
+        otherwise route real jobs onto a device known to corrupt results
+        (only the health poll's stats re-check can lift the hold)."""
+        out = [b for b in self.backends
+               if b.breaker.state != "open" and not b.sdc_hold]
         out.sort(key=lambda b: (b.depth is None,
                                 b.depth if b.depth is not None else 0))
         return out

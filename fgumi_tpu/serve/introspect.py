@@ -34,8 +34,11 @@ log = logging.getLogger("fgumi_tpu")
 
 #: stats payload schema (versioned like the wire protocol + run report).
 #: v2 added the ``fleet`` section (journal-lease takeover accounting;
-#: None outside --journal-dir fleet mode).
-STATS_SCHEMA_VERSION = 2
+#: None outside --journal-dir fleet mode). v3 added the ``audit`` section
+#: (silent-corruption sentinel scoreboard, ops/sentinel.py; None while
+#: nothing was audited) — the balancer ejects a backend whose ``audit``
+#: reports ``divergent > 0``.
+STATS_SCHEMA_VERSION = 3
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -54,8 +57,9 @@ def service_stats(service) -> dict:
     Always includes every key; sections whose subsystem was never touched
     in this process are ``None`` (e.g. ``device`` before the first kernel
     import), so clients can rely on the shape."""
-    from ..observe.flight import (breaker_snapshot, governor_snapshot,
-                                  live_device_stats, router_snapshot)
+    from ..observe.flight import (audit_snapshot, breaker_snapshot,
+                                  governor_snapshot, live_device_stats,
+                                  router_snapshot)
     from ..observe.metrics import METRICS
 
     stats = live_device_stats()
@@ -77,6 +81,7 @@ def service_stats(service) -> dict:
         "governor": governor_snapshot(),
         "monitor": _monitor_section(service),
         "router": router_snapshot(),
+        "audit": audit_snapshot(),
     }
 
 
@@ -154,6 +159,14 @@ def render_prometheus(service) -> str:
         for key, v in stats["device"].items():
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 gauge(f"device.{key}", v)
+    if stats["audit"] is not None:
+        # the silent-corruption scoreboard a fleet balancer ejects on:
+        # daemon-lifetime counters straight from the sentinel (the flat
+        # device.audit.* registry counters are the last finished job's)
+        for key in ("sampled", "clean", "divergent", "dropped"):
+            gauge(f"device.audit.{key}", stats["audit"].get(key, 0),
+                  "shadow-audit scoreboard (ops/sentinel.py)"
+                  if key == "sampled" else None)
 
     # flat counters/gauges from the SAME snapshot the stats op returns
     # (last finished job + anything written outside job scopes). Names the

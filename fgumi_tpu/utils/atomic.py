@@ -133,6 +133,11 @@ class AtomicOutputFile:
         cleanup_stale_temps(path)
         self._f = open(self._tmp, mode)
         self._done = False
+        # optional pre-commit verification hook (--audit-output,
+        # io/bam.py): called with the temp path after flush+fsync+close,
+        # BEFORE the rename — a raise aborts the commit and discards the
+        # temp, so a file that fails its own audit is never published
+        self.pre_commit_check = None
 
     # -- the file-object surface the writers actually use ------------------
     def write(self, data):
@@ -171,6 +176,8 @@ class AtomicOutputFile:
                                    errno.EBADF, errno.EROFS):
                     raise
             self._f.close()
+            if self.pre_commit_check is not None:
+                self.pre_commit_check(self._tmp)
             os.replace(self._tmp, self.name)
         except BaseException:
             # ANY commit failure (flush ENOSPC, close, rename) discards:

@@ -17,12 +17,16 @@ Arm via the environment::
 - ``kind``: ``raise`` (an :class:`InjectedFault`), ``hang`` (sleep for
   ``FGUMI_TPU_FAULT_HANG_S`` seconds, default 30 — what the stall
   watchdog exists to diagnose), ``corrupt-bytes`` (deterministically flip
-  bytes in the payload passing through the point), ``oom`` (an
-  :class:`InjectedOom` whose message carries ``RESOURCE_EXHAUSTED``, the
-  XLA out-of-memory status the device retry path batch-splits on), or
-  ``enospc`` (an ``OSError(ENOSPC)`` — a full disk exactly where a real
-  one would surface; the resource clean-failure contract converts it to
-  exit code 4, docs/resilience.md).
+  bytes in the payload passing through the point), ``corrupt-result``
+  (deterministically flip bits spread across the numpy array(s) passing
+  through the point — a silently-wrong accelerator answer, the SDC class
+  of failure the shadow-audit sentinel exists to catch; arm at
+  ``device.fetch``), ``oom`` (an :class:`InjectedOom` whose message
+  carries ``RESOURCE_EXHAUSTED``, the XLA out-of-memory status the device
+  retry path batch-halves on), or ``enospc`` (an ``OSError(ENOSPC)`` — a
+  full disk exactly where a real one would surface; the resource
+  clean-failure contract converts it to exit code 4,
+  docs/resilience.md).
 - ``prob``: trigger probability per fire, drawn from a
   ``random.Random`` seeded by ``FGUMI_TPU_FAULT_SEED`` (default 0) xor
   the point name, so single-threaded runs are exactly reproducible.
@@ -52,6 +56,12 @@ FAULT_POINTS = frozenset({
                            # kind `hang` (stall via FGUMI_TPU_FAULT_HANG_S)
                            # to simulate a dispatch that never returns; the
                            # deadline/breaker layer must absorb it
+    "device.fetch",        # fetched device result arrays at resolve time
+                           # (ops/kernel.py) — arm kind `corrupt-result`
+                           # (usually with count 1, like device.wedge) to
+                           # simulate a chip silently returning the wrong
+                           # answer; the shadow-audit sentinel
+                           # (ops/sentinel.py) must catch it
     "writer.compress",     # BGZF writer block emit (io/bgzf.py)
     "native.batch",        # native batch-op entry (native/batch.py)
     "serve.dispatch",      # job-service worker dispatch (serve/daemon.py)
@@ -65,7 +75,8 @@ FAULT_POINTS = frozenset({
                            # (utils/governor.py)
 })
 
-KINDS = frozenset({"raise", "hang", "corrupt-bytes", "oom", "enospc"})
+KINDS = frozenset({"raise", "hang", "corrupt-bytes", "corrupt-result",
+                   "oom", "enospc"})
 
 
 class InjectedFault(RuntimeError):
@@ -177,6 +188,13 @@ def fire(point: str, data=None):
             log.warning("fault injection: corrupted %d bytes at %s",
                         len(out), point)
             return out
+        if kind == "corrupt-result":
+            if data is None:
+                return None
+            out = _corrupt_result(data)
+            log.warning("fault injection: bit-flipped result arrays at %s",
+                        point)
+            return out
     # act outside the lock: a hang must not wedge every other fire()
     if kind == "raise":
         log.warning("fault injection: raising at %s", point)
@@ -206,6 +224,31 @@ def _corrupt(rng, data):
     for _ in range(min(max(len(b) // 1024, 1), 16)):
         b[rng.randrange(len(b))] ^= 0xFF
     return bytes(b)
+
+
+def _corrupt_result(data):
+    """Flip bits in numpy result array(s): a handful of XORed bytes spread
+    evenly across each array, so real (non-padding) rows are always hit
+    regardless of the dispatch's padded layout. Deterministic by
+    construction — the same arrays corrupt identically on every run.
+    Accepts a single ndarray or a tuple/list of them (the fetched device
+    result shape); non-array leaves pass through untouched."""
+    import numpy as np
+
+    def flip(a):
+        if not isinstance(a, np.ndarray) or a.size == 0:
+            return a
+        out = np.array(a, copy=True)  # writable + C-contiguous
+        flat = out.reshape(-1).view(np.uint8)
+        n = flat.size
+        k = min(max(n // 4096, 4), 64)
+        idx = (np.arange(k, dtype=np.int64) * n) // k
+        flat[idx] ^= 0xFF
+        return out
+
+    if isinstance(data, (tuple, list)):
+        return type(data)(flip(a) for a in data)
+    return flip(data)
 
 
 def snapshot():

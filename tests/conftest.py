@@ -63,6 +63,19 @@ def _reset_mesh_snapshot():
 
 
 @pytest.fixture(autouse=True)
+def _reset_audit_sentinel():
+    """The silent-corruption sentinel (ops/sentinel.py) is process-global
+    like the breaker: a test that injects a divergence must not leave its
+    counters (or queued audits holding staging buffers) for later tests'
+    run-report shapes. Lazy — only when imported."""
+    yield
+    mod = sys.modules.get("fgumi_tpu.ops.sentinel")
+    if mod is not None:
+        mod.SENTINEL.drain(timeout=10)
+        mod.SENTINEL.reset()
+
+
+@pytest.fixture(autouse=True)
 def _reset_flight_recorder():
     """The flight recorder (observe/flight.py) is process-global and
     dedupes dumps per reason — a test that triggers a dump must not

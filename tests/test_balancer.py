@@ -448,3 +448,58 @@ def test_submit_no_wait_propagates_shed():
                                 wait=True, timeout=0.0,
                                 sleep=slept.append)
     assert slept == []
+
+
+# ---------------------------------------------------------------------------
+# SDC quarantine (ISSUE 14): a backend whose stats report audit
+# divergences is ejected and held out of routing until its counters
+# read zero again (i.e. the daemon restarted)
+
+
+def _stats_with_audit(divergent):
+    return {"schema_version": 3, "scheduler": {"queued": 0, "running": 0},
+            "audit": None if divergent is None
+            else {"sampled": divergent + 3, "clean": 3,
+                  "divergent": divergent, "dropped": 0}}
+
+
+def test_sdc_backend_held_until_counters_reset(fleet):
+    bal, (a, b) = fleet
+    victim = bal.backends[0]
+    victim.client.stats = lambda timeout=None: _stats_with_audit(2)
+    bal.poll_backends_once()
+    assert victim.sdc_hold and victim.audit_divergent == 2
+    snap = victim.snapshot()
+    assert snap["sdc_hold"] and snap["audit_divergent"] == 2
+    # held out of routing entirely — submits go to the clean backend
+    assert victim not in bal._healthy_backends()
+    resp = _submit(bal)
+    assert resp["ok"]
+    assert b.registry.get(resp["job"]["id"]) is not None
+    # repeated divergent polls keep feeding the breaker toward ejection
+    bal.poll_backends_once()
+    assert victim.breaker.state == "open"
+    # a successful FORWARD must not lift the hold (answering != honest):
+    # only the health poll seeing zeroed counters does — the restart
+    victim.client.stats = lambda timeout=None: _stats_with_audit(0)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and victim.sdc_hold:
+        bal.poll_backends_once()
+        time.sleep(0.05)
+    assert not victim.sdc_hold and victim.audit_divergent == 0
+    # breaker then re-admits through its ordinary half-open probes
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and victim.breaker.state != "closed":
+        bal.poll_backends_once()
+        time.sleep(0.05)
+    assert victim.breaker.state == "closed"
+    assert victim in bal._healthy_backends()
+
+
+def test_stats_without_audit_section_is_not_held(fleet):
+    bal, (a, b) = fleet
+    victim = bal.backends[0]
+    victim.client.stats = lambda timeout=None: _stats_with_audit(None)
+    bal.poll_backends_once()
+    assert not victim.sdc_hold
+    assert victim.breaker.state == "closed"

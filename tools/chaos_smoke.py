@@ -170,6 +170,95 @@ def main():
             ok &= check("report records deadline fallback + breaker "
                         "opening", False, str(e))
 
+        # 3b) silent data corruption (ISSUE 14): corrupt-result at
+        # device.fetch with the shadow audit at `all` -> the sentinel
+        # detects the divergence within the injected dispatch's own
+        # audit, the breaker records an `sdc` trip (quarantine), the run
+        # degrades to host and still exits 0 with output byte-identical
+        # to the pure-host run (the inline audit repairs the corrupt
+        # batch with the oracle tuple it just computed), and the report
+        # carries the divergence record + both result digests
+        d = os.path.join(tmp, "sdc")
+        os.mkdir(d)
+        rpt = os.path.join(d, "report.json")
+        p = run(wedge_argv,
+                env={"FGUMI_TPU_HOST_ENGINE": "0",
+                     "FGUMI_TPU_ROUTE": "device",
+                     "FGUMI_TPU_AUDIT": "all",
+                     "FGUMI_TPU_FLIGHT": d,
+                     "FGUMI_TPU_FAULT":
+                         "device.fetch:corrupt-result:1.0:1"},
+                cwd=d)
+        got = (open(os.path.join(d, "out.bam"), "rb").read()
+               if p.returncode == 0 else b"")
+        ok &= check("corrupt-result + audit=all -> detected, degraded "
+                    "(exit 0), byte-identical to the pure host-engine run",
+                    p.returncode == 0 and got == host_ref,
+                    f"rc={p.returncode}")
+        try:
+            report = __import__("json").load(open(rpt))
+            audit = report.get("audit", {})
+            br = report.get("device", {}).get("breaker", {})
+            dump_ok = any("sdc" in os.path.basename(f)
+                          for f in report.get("flight_dumps", []))
+            ok &= check(
+                "report records the audit divergence + sdc trip + "
+                "flight dump",
+                audit.get("divergent", 0) >= 1
+                and bool(audit.get("divergence"))
+                and br.get("sdc_trips", 0) >= 1
+                and any("silent data corruption" in t.get("reason", "")
+                        for t in br.get("transitions", []))
+                and dump_ok,
+                f"divergent={audit.get('divergent')} "
+                f"sdc_trips={br.get('sdc_trips')} dump={dump_ok}")
+        except (OSError, ValueError) as e:
+            ok &= check("report records the audit divergence + sdc trip "
+                        "+ flight dump", False, str(e))
+
+        # 3c) the same corruption with the audit OFF documents the
+        # undetected baseline: the run exits 0 but silently publishes a
+        # corrupt output (differs from the clean run) with zero signal in
+        # the report — exactly the gap the sentinel closes
+        d = os.path.join(tmp, "sdc_off")
+        os.mkdir(d)
+        rpt = os.path.join(d, "report.json")
+        p = run(wedge_argv,
+                env={"FGUMI_TPU_HOST_ENGINE": "0",
+                     "FGUMI_TPU_ROUTE": "device",
+                     "FGUMI_TPU_AUDIT": "off",
+                     "FGUMI_TPU_FAULT":
+                         "device.fetch:corrupt-result:1.0:1"},
+                cwd=d)
+        got = (open(os.path.join(d, "out.bam"), "rb").read()
+               if p.returncode == 0 else b"")
+        try:
+            report = __import__("json").load(open(rpt))
+        except (OSError, ValueError):
+            report = {}
+        ok &= check("corrupt-result + audit=off -> corruption published "
+                    "UNDETECTED (exit 0, differing bytes, no audit "
+                    "section): the documented baseline",
+                    p.returncode == 0 and got != host_ref and len(got) > 0
+                    and "audit" not in report,
+                    f"rc={p.returncode} bytes={len(got)}")
+
+        # 3d) --audit-output: corruption injected below the writer's
+        # tally (BGZF layer) is refused before the atomic rename — exit
+        # 5, no file published
+        d = os.path.join(tmp, "audit_output")
+        os.mkdir(d)
+        p = run(["--audit-output", "simplex", "-i", sim, "-o", "out.bam",
+                 "--min-reads", "1"],
+                env={"FGUMI_TPU_FAULT":
+                     "writer.compress:corrupt-bytes:1.0:1"}, cwd=d)
+        leftovers = os.listdir(d)
+        ok &= check("--audit-output refuses a corrupted stream -> exit 5, "
+                    "nothing published",
+                    p.returncode == 5 and not leftovers
+                    and "Traceback" not in p.stderr,
+                    f"rc={p.returncode} leftovers={leftovers}")
+
         # 4) disk full (ISSUE 8): injected ENOSPC mid-spill and mid-merge
         # both honor the resource clean-failure contract — exit 4, no
         # partial output, no stale spill temps, and the run report records

@@ -240,6 +240,112 @@ def full_column_scenario(tmp):
     return ok
 
 
+_AUDIT_OVERHEAD = r"""
+import json, os, sys, time
+import numpy as np
+sys.path.insert(0, %(repo)r)
+os.environ["FGUMI_TPU_AUDIT"] = "off"
+from fgumi_tpu.ops.tables import quality_tables
+from fgumi_tpu.ops.kernel import ConsensusKernel, pad_segments_gather
+from fgumi_tpu.ops.sentinel import SENTINEL
+from fgumi_tpu.observe.metrics import METRICS
+
+kernel = ConsensusKernel(quality_tables(45, 40))
+kernel.set_force_device()
+rng = np.random.default_rng(7)
+J, R, L = 64, 4, 32
+codes = rng.integers(0, 4, size=(J * R, L), dtype=np.uint8)
+quals = rng.integers(20, 41, size=(J * R, L), dtype=np.uint8)
+counts = np.full(J, R, dtype=np.int64)
+rows = np.arange(J * R)
+
+def one():
+    cd, qd, seg, starts, F_pad, N = pad_segments_gather(
+        codes, quals, rows, L, counts)
+    t = kernel.device_call_segments_wire(cd, qd, seg, F_pad, J)
+    return kernel.resolve_segments_wire(t, cd[:N], qd[:N], starts)
+
+one()  # warm-up: compile outside the timed window, unaudited
+os.environ["FGUMI_TPU_AUDIT"] = "4"
+t0 = time.monotonic()
+for _ in range(16):
+    one()
+wall = time.monotonic() - t0
+SENTINEL.drain()
+tap = METRICS.histogram("device.audit.tap_s")
+snap = SENTINEL.snapshot()
+print(json.dumps({
+    "wall_s": wall,
+    "tap_sum_s": tap.total if tap else 0.0,
+    "tap_count": tap.count if tap else 0,
+    "sampled": snap["sampled"], "clean": snap["clean"],
+    "divergent": snap["divergent"],
+}))
+"""
+
+
+def audit_overhead_scenario(tmp):
+    """ISSUE 14 perf guard: the shadow-audit sentinel's resolve-thread
+    cost (sample decision + input retention; the oracle re-execution runs
+    on the background audit thread) stays under 2% of the run's wall even
+    at an aggressive 1-in-4 rate — so the default 1-in-64 is far below it
+    — measured via the PR 9 ``device.audit.tap_s`` histogram rather than
+    noisy wall-vs-wall A/B on a shared-core host. Byte-identity of
+    audited vs unaudited runs rides along."""
+    p = subprocess.run(
+        [sys.executable, "-c", _AUDIT_OVERHEAD % {"repo": REPO}],
+        cwd=REPO, env={**BASE_ENV, "FGUMI_TPU_ROUTE": "device"},
+        capture_output=True, text=True, timeout=300)
+    ok = check("audit-overhead payload exits 0", p.returncode == 0,
+               p.stderr.strip().splitlines()[-1] if p.returncode else "")
+    if not ok:
+        return False
+    out = json.loads(p.stdout.strip().splitlines()[-1])
+    ok &= check("1-in-4 sampling audited the expected dispatches",
+                out["sampled"] == 4 and out["clean"] == 4
+                and out["divergent"] == 0,
+                f"sampled={out['sampled']} clean={out['clean']}")
+    frac = out["tap_sum_s"] / out["wall_s"] if out["wall_s"] else 1.0
+    ok &= check("audit tap cost < 2% of dispatch wall "
+                "(device.audit.tap_s histogram)",
+                out["tap_count"] >= 1 and frac < 0.02,
+                f"sum={out['tap_sum_s']:.5f}s wall={out['wall_s']:.3f}s "
+                f"frac={frac:.4%}")
+    # CLI side: audited vs unaudited byte-identity + off leaves no trace
+    grouped = os.path.join(tmp, "audit_grouped.bam")
+    p = run_cli(["simulate", "grouped-reads", "-o", grouped,
+                 "--num-families", "200", "--family-size", "4",
+                 "--seed", "13"])
+    assert p.returncode == 0, p.stderr
+    out_bam = os.path.join(tmp, "audit_cons.bam")
+    rpt = os.path.join(tmp, "audit.report.json")
+    p = run_cli(["--run-report", rpt, "simplex", "-i", grouped, "-o",
+                 out_bam, "--min-reads", "1"],
+                {"FGUMI_TPU_AUDIT": "all", "FGUMI_TPU_ROUTE": "device"})
+    ok &= check("fully-audited simplex exits 0", p.returncode == 0,
+                f"rc={p.returncode}")
+    audited_bytes = open(out_bam, "rb").read()
+    report = json.load(open(rpt))
+    audit = report.get("audit", {})
+    ok &= check("report audit section carries sampled/clean counts",
+                audit.get("sampled", 0) >= 1
+                and audit.get("clean") == audit.get("sampled")
+                and audit.get("divergent") == 0,
+                f"sampled={audit.get('sampled')} "
+                f"clean={audit.get('clean')}")
+    p = run_cli(["--run-report", rpt, "simplex", "-i", grouped, "-o",
+                 out_bam, "--min-reads", "1"],
+                {"FGUMI_TPU_AUDIT": "off", "FGUMI_TPU_ROUTE": "device"})
+    ok &= check("unaudited run exits 0", p.returncode == 0)
+    ok &= check("audited vs unaudited byte-identical",
+                open(out_bam, "rb").read() == audited_bytes)
+    report = json.load(open(rpt))
+    ok &= check("FGUMI_TPU_AUDIT=off leaves zero audit traces",
+                "audit" not in report
+                and "device.audit.sampled" not in report.get("metrics", {}))
+    return ok
+
+
 def _records(path):
     from fgumi_tpu.io.bam import BamReader
 
@@ -362,6 +468,7 @@ def main():
         ok &= report_scenario(tmp)
         ok &= full_column_scenario(tmp)
         ok &= device_filter_scenario(tmp)
+        ok &= audit_overhead_scenario(tmp)
         ok &= bad_spec_scenario(tmp)
     finally:
         if opts.keep:

@@ -3362,6 +3362,15 @@ def _add_serve(sub):
                         "unset = no listener). The scrape and the `stats` "
                         "protocol op read the same live snapshot "
                         "(docs/serving.md)")
+    p.add_argument("--coalesce-window-ms", type=float, default=None,
+                   metavar="MS",
+                   help="cross-job dispatch coalescing window: while >= 2 "
+                        "jobs are running, compatible device batches from "
+                        "different jobs are held up to this long and "
+                        "merged into one launch, split back per job at "
+                        "resolve (byte-identical per job; docs/serving.md "
+                        "\"Cross-job batching\"). 0 disables; default: "
+                        "FGUMI_TPU_COALESCE_WINDOW_MS, else 2")
     p.set_defaults(func=cmd_serve)
 
 
@@ -3420,6 +3429,14 @@ def cmd_serve(args):
     if args.conn_cap is not None and args.conn_cap < 0:
         log.error("--conn-cap must be >= 0 (0 = unlimited)")
         return 2
+    if args.coalesce_window_ms is not None:
+        if args.coalesce_window_ms < 0:
+            log.error("--coalesce-window-ms must be >= 0 (0 = off)")
+            return 2
+        # the coalescer reads the env per dispatch, so the flag is just
+        # the daemon-scoped spelling of FGUMI_TPU_COALESCE_WINDOW_MS
+        os.environ["FGUMI_TPU_COALESCE_WINDOW_MS"] = \
+            str(args.coalesce_window_ms)
     if args.report_dir:
         try:
             os.makedirs(args.report_dir, exist_ok=True)

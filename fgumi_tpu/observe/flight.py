@@ -92,6 +92,18 @@ def audit_snapshot():
     return sentinel.SENTINEL.snapshot()
 
 
+def coalesce_snapshot():
+    """The cross-job dispatch coalescer's scoreboard (ops/coalesce.py),
+    or None before it loads / while it has merged nothing and is not
+    armed."""
+    coal = sys.modules.get("fgumi_tpu.ops.coalesce")
+    if coal is None:
+        return None
+    if not (coal.COALESCER.has_activity() or coal.COALESCER.armed()):
+        return None
+    return coal.COALESCER.snapshot()
+
+
 def mesh_snapshot():
     """The active production mesh's {dp, sp, devices, platform}, or None
     when no mesh was built this process (single-device / host-only)."""

@@ -451,6 +451,16 @@ class HostStagingPool:
         METRICS.inc("device.staging.allocs")
         return np.empty(shape, dtype=dtype)
 
+    def acquire_filled(self, shape, dtype, fill) -> np.ndarray:
+        """``acquire`` + constant fill: the coalescer's merged row layouts
+        (ops/coalesce.py) start as all-pad buffers (N_CODE codes / zero
+        quals) that partner blocks are copied into, so merged builds mint
+        zero fresh allocations once the shape vocabulary is warm — the
+        same recycling contract as the wire staging buffers."""
+        arr = self.acquire(shape, dtype)
+        arr.fill(fill)
+        return arr
+
     def release(self, arr: np.ndarray):
         """Return a buffer to the pool (drop it when over budget)."""
         if arr is None:

@@ -551,6 +551,15 @@ class DeviceStats:
             self.rows_real += int(real_rows)
             self.rows_padded += int(padded_rows)
 
+    def add_fetch(self, nbytes: int, wait_s: float):
+        """Credit fetch accounting without performing the device_get: the
+        coalescer fetches a merged result once and attributes each
+        partner's byte share + measured resolve wait to the partner's own
+        scope (ops/coalesce.py)."""
+        with self._lock:
+            self.fetch_wait_s += float(wait_s)
+            self.bytes_fetched += int(nbytes)
+
     def fetch(self, dev):
         """Timed jax.device_get — route every device->host fetch through
         here so fetch_wait_s captures all host time blocked on the device.
@@ -2487,6 +2496,22 @@ def pad_segments_mesh(codes2d: np.ndarray, quals2d: np.ndarray,
     return codes_g, quals_g, seg_g, starts, F_loc, gather
 
 
+class _WirePlan:
+    """One built-but-unsubmitted wire dispatch (ConsensusKernel.
+    _wire_dispatch_plan): the dispatch closure plus everything the
+    submitter needs to account/submit it — shared by the solo path and
+    the cross-job coalescer (ops/coalesce.py)."""
+
+    __slots__ = ("dispatch", "upload", "new", "staging", "filter_mode")
+
+    def __init__(self, dispatch, upload, new, staging, filter_mode):
+        self.dispatch = dispatch
+        self.upload = upload
+        self.new = new
+        self.staging = staging
+        self.filter_mode = filter_mode
+
+
 def _unpack_device_result(packed: np.ndarray):
     """(winner uint8, qual uint8, suspect bool) from the packed uint16."""
     qual = (packed & 0x7F).astype(np.uint8)
@@ -2523,6 +2548,7 @@ class ConsensusKernel:
         self._use_host = None
         self._hybrid = None
         self._delta94 = self._correct_f32 - self._err_f32
+        self._coalesce_key_cache = None
 
     def host_mode(self) -> bool:
         """True when segment dispatches should run on the native f64 host
@@ -2571,6 +2597,25 @@ class ConsensusKernel:
         run inside dispatch closures, after jax init."""
         return (CONST_CACHE.put("correct_tab", self._correct_f32),
                 CONST_CACHE.put("err_tab", self._err_f32))
+
+    def _coalesce_key(self) -> str:
+        """Constant-table content fingerprint for cross-job merge
+        compatibility (ops/coalesce.py): two kernels whose f32 quality
+        tables and pre-UMI prior are byte-identical produce identical
+        per-family results inside a merged dispatch — the wire dictionary
+        re-indexes the same delta values, and every suspect/oracle gate is
+        derived from them. Content-keyed like the constant cache, so warm
+        serve jobs with the same error rates merge across kernel
+        instances."""
+        if self._coalesce_key_cache is None:
+            import hashlib
+
+            h = hashlib.blake2b(digest_size=16)
+            h.update(self._correct_f32.tobytes())
+            h.update(self._err_f32.tobytes())
+            h.update(np.float32(self._pre).tobytes())
+            self._coalesce_key_cache = h.hexdigest()
+        return self._coalesce_key_cache
 
     def device_call(self, codes, quals):
         """Raw device outputs (winner, qual, depth, errors, suspect) as jax arrays."""
@@ -2825,6 +2870,52 @@ class ConsensusKernel:
                 codes2d_padded, quals2d_padded, seg_ids, num_segments, J,
                 t_pack0, full, resident_thresholds, pred_s, mesh,
                 mesh_gather)
+        if resident_thresholds is None and filter_params is None:
+            # cross-job coalescing seam (ops/coalesce.py): while the serve
+            # daemon's merge window is armed, compatible plain wire
+            # dispatches from concurrent jobs merge into one device launch.
+            # The CoalescedTicket resolves through the same
+            # resolve_segments_wire call — sliced back per partner there.
+            from .coalesce import COALESCER
+
+            merged = COALESCER.maybe_submit(
+                self, codes2d_padded, quals2d_padded, seg_ids,
+                num_segments, J, full=full, pack_t0=t_pack0, pred_s=pred_s)
+            if merged is not None:
+                return merged
+        plan = self._wire_dispatch_plan(
+            codes2d_padded, quals2d_padded, seg_ids, num_segments, J,
+            full=full, resident_thresholds=resident_thresholds,
+            filter_params=filter_params)
+        DEVICE_STATS.add_dispatch(segments_flops(
+            codes2d_padded.shape[0], codes2d_padded.shape[1], num_segments))
+        slot = DEVICE_STATS.begin_in_flight(
+            plan.upload, pack_s=time.monotonic() - t_pack0)
+        if pred_s is not None:
+            DEVICE_STATS.note_pred(slot, pred_s)
+        with SHAPE_REGISTRY.attribute_compiles(plan.new):
+            ticket = DEVICE_FEEDER.submit(
+                lambda: device_retry_call(lambda: plan.dispatch(slot),
+                                          "wire dispatch"),
+                upload_bytes=plan.upload, slot=slot)
+        ticket.filter_mode = plan.filter_mode
+        if plan.staging:
+            ticket.staging = plan.staging
+        return ticket
+
+    def _wire_dispatch_plan(self, codes2d_padded, quals2d_padded, seg_ids,
+                            num_segments: int, J: int, full: bool = False,
+                            resident_thresholds=None, filter_params=None):
+        """Build — but do not submit — one wire-layout dispatch.
+
+        The shared dispatch seam of the solo path and the cross-job
+        coalescer (ops/coalesce.py): the coalescer builds a merged row
+        layout and submits this plan under its own per-partner
+        accounting. Returns a :class:`_WirePlan` holding the dispatch
+        closure (runs on the feeder thread), the upload byte count for
+        the feeder's governed budget, the shape-registry new-shape flag,
+        the pooled staging buffers to recycle at resolve, and whether the
+        fused-filter kernel was actually selected."""
         out_segments = _pad_out_segments(J, num_segments)
         from .datapath import STAGING_POOL
 
@@ -2912,21 +3003,8 @@ class ConsensusKernel:
                           if donate else _consensus_segments_packed2_jit)
                 return fn(cd, qd, sd, ct, et, pre, num_segments,
                           out_segments)
-        DEVICE_STATS.add_dispatch(segments_flops(
-            codes2d_padded.shape[0], codes2d_padded.shape[1], num_segments))
-        slot = DEVICE_STATS.begin_in_flight(
-            upload, pack_s=time.monotonic() - t_pack0)
-        if pred_s is not None:
-            DEVICE_STATS.note_pred(slot, pred_s)
-        with SHAPE_REGISTRY.attribute_compiles(new):
-            ticket = DEVICE_FEEDER.submit(
-                lambda: device_retry_call(lambda: _dispatch(slot),
-                                          "wire dispatch"),
-                upload_bytes=upload, slot=slot)
-        ticket.filter_mode = filt and w is not None
-        if staging:
-            ticket.staging = staging
-        return ticket
+        return _WirePlan(_dispatch, upload, new, staging,
+                         filt and w is not None)
 
     def _dispatch_wire_mesh(self, codes_g, quals_g, seg_g, F_loc: int,
                             J: int, t_pack0: float, full: bool,
@@ -3023,7 +3101,18 @@ class ConsensusKernel:
         dispatch/fetch failure that survived the feeder's bounded retry
         degrades instead of raising: RESOURCE_EXHAUSTED batches are halved
         and re-dispatched (output order preserved), anything else falls
-        back to the native f64 host engine for this batch."""
+        back to the native f64 host engine for this batch.
+
+        A :class:`~fgumi_tpu.ops.coalesce.CoalescedTicket` (the dispatch
+        was merged with other jobs' batches) resolves through the
+        coalescer: shared fetch, this job's family slice, the identical
+        host completion below — per-partner degrade on failure."""
+        from .coalesce import COALESCER, CoalescedTicket
+
+        if isinstance(ticket, CoalescedTicket):
+            return COALESCER.resolve_partner(
+                self, ticket, codes2d, quals2d, starts,
+                split_depth=_split_depth, want_extras=want_extras)
         t0 = time.monotonic()
         fetched = 0
         failure = None
@@ -3106,6 +3195,26 @@ class ConsensusKernel:
             ROUTER.observe_device(ticket.upload_bytes, fetched, up_s,
                                   wait_s, up_s + wait_s,
                                   devices=ticket.mesh_devices)
+        return self._complete_wire_columns(
+            qs, wp, d16, e16, codes2d, quals2d, starts,
+            want_extras=want_extras, resident=resident,
+            gather=ticket.mesh_gather, devices=ticket.mesh_devices,
+            f_loc=ticket.mesh_f_loc, slot=ticket.slot)
+
+    def _complete_wire_columns(self, qs, wp, d16, e16,
+                               codes2d: np.ndarray, quals2d: np.ndarray,
+                               starts, want_extras: bool = False,
+                               resident=None, gather=None, devices: int = 1,
+                               f_loc=None, slot: int = -1, partner=None):
+        """Host completion of fetched wire columns: unpack, depth/error
+        counts, no-call restore, f64 oracle patch, shadow-audit tap.
+
+        The shared resolve tail of resolve_segments_wire and the
+        coalescer's per-partner split (ops/coalesce.py resolves each
+        partner's family slice through exactly this code, so a merged
+        job's bytes can never diverge from its solo run). ``partner``:
+        merge attribution forwarded to the audit sentinel — a divergence
+        inside a merged dispatch names the affected partner slice."""
         J = len(starts) - 1
         if J == 0:
             L = qs.shape[-1]
@@ -3116,7 +3225,6 @@ class ConsensusKernel:
                 return out + ({"suspect": None, "resident": resident,
                                "gather": None},)
             return out
-        gather = ticket.mesh_gather
         if gather is not None:
             # mesh dispatch: the fetched global arrays are shard-ordered
             # (dp * F_loc rows); one host gather restores family order.
@@ -3173,8 +3281,8 @@ class ConsensusKernel:
 
         repaired = SENTINEL.maybe_audit(
             self, codes2d, quals2d, starts, winner, qual, depth, errors,
-            devices=ticket.mesh_devices, gather=gather,
-            f_loc=ticket.mesh_f_loc, slot=ticket.slot)
+            devices=devices, gather=gather, f_loc=f_loc, slot=slot,
+            partner=partner)
         if repaired is not None:
             winner, qual, depth, errors = repaired
             if resident is not None:
@@ -3371,16 +3479,22 @@ class ConsensusKernel:
                 "device batch exhausted memory (%s); halving %d segments "
                 "into %d + %d and re-dispatching", exc, J, mid, J - mid)
             halves = []
-            for lo, hi in ((0, mid), (mid, J)):
-                row_lo, row_hi = int(starts[lo]), int(starts[hi])
-                c = codes2d[row_lo:row_hi]
-                q = quals2d[row_lo:row_hi]
-                counts = np.diff(starts[lo:hi + 1])
-                cd, qd, seg_ids, sub_starts, f_pad = pad_segments(
-                    c, q, counts)
-                ticket = self.device_call_segments_wire(
-                    cd, qd, seg_ids, f_pad, hi - lo)
-                halves.append((ticket, c, q, sub_starts))
+            from .coalesce import bypassed as _coalesce_bypassed
+
+            # halves bypass the merge window: they exist because the
+            # (possibly merged) parent OOM'd, so re-entering the window
+            # could re-merge them straight back into an over-size batch
+            with _coalesce_bypassed():
+                for lo, hi in ((0, mid), (mid, J)):
+                    row_lo, row_hi = int(starts[lo]), int(starts[hi])
+                    c = codes2d[row_lo:row_hi]
+                    q = quals2d[row_lo:row_hi]
+                    counts = np.diff(starts[lo:hi + 1])
+                    cd, qd, seg_ids, sub_starts, f_pad = pad_segments(
+                        c, q, counts)
+                    ticket = self.device_call_segments_wire(
+                        cd, qd, seg_ids, f_pad, hi - lo)
+                    halves.append((ticket, c, q, sub_starts))
             # resolve BOTH halves even if the first raises: an unresolved
             # ticket would leak its in-flight slot (and silently route
             # every later hybrid batch to the host engine)

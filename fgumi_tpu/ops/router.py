@@ -170,6 +170,19 @@ class OffloadRouter:
             with self._lock:
                 self._host_cps.add(cells / seconds)
 
+    def device_overhead_s(self, devices: int = 1) -> float:
+        """Current per-dispatch device overhead estimate: the mesh size's
+        measured EWMA, borrowing the 1-device chain (then the static
+        prior) while unmeasured. The dispatch coalescer prices its hold
+        window against this (ops/coalesce.py): merging saves ~one
+        overhead per extra partner, so holding a batch longer than one
+        overhead can only lose to just dispatching now."""
+        with self._lock:
+            e = self._mesh_ewmas(int(devices) if devices else 1)
+            base = self._mesh[1]
+            return e["overhead_s"].get(
+                base["overhead_s"].get(self.PRIOR_OVERHEAD_S))
+
     # ----------------------------------------------------------- deciding
 
     @staticmethod

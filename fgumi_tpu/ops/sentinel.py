@@ -164,7 +164,7 @@ class AuditSentinel:
 
     def maybe_audit(self, kernel, codes2d, quals2d, starts,
                     winner, qual, depth, errors, devices: int = 1,
-                    gather=None, f_loc=None, slot: int = -1):
+                    gather=None, f_loc=None, slot: int = -1, partner=None):
         """The resolve-path tap: decide, retain, and (maybe) audit.
 
         Called once per cleanly-resolved *device* dispatch with the dense
@@ -173,17 +173,24 @@ class AuditSentinel:
         for an inline audit that found a divergence, the repaired
         ``(winner, qual, depth, errors)`` oracle tuple the caller must
         publish instead. Never raises: a broken audit must not fail a
-        healthy resolve."""
+        healthy resolve.
+
+        ``partner``: merge attribution for a coalesced dispatch
+        (ops/coalesce.py) — ``{"group", "index", "partners"}`` naming this
+        job's slice of the merged launch; a divergence record carries it
+        so the operator knows which partner's output (and which merge) to
+        distrust. Each partner's resolve taps here separately, over its
+        own family slice."""
         try:
             return self._maybe_audit(kernel, codes2d, quals2d, starts,
                                      winner, qual, depth, errors,
-                                     devices, gather, f_loc, slot)
+                                     devices, gather, f_loc, slot, partner)
         except Exception:  # noqa: BLE001 - audit failure != batch failure
             log.exception("audit sentinel: tap failed; dispatch unaudited")
             return None
 
     def _maybe_audit(self, kernel, codes2d, quals2d, starts, winner, qual,
-                     depth, errors, devices, gather, f_loc, slot):
+                     depth, errors, devices, gather, f_loc, slot, partner):
         rate = audit_rate()
         from .breaker import BREAKER
 
@@ -227,7 +234,7 @@ class AuditSentinel:
             return None
         item = self._retain(kernel, codes2d, quals2d, starts, winner, qual,
                             depth, errors, devices, gather, f_loc, slot,
-                            ordinal)
+                            ordinal, partner)
         # only a FORCED (quarantine-probe) audit may later feed
         # record_audit_clean: a stale background sample taken before the
         # trip proves nothing about the quarantined device's probes
@@ -254,7 +261,8 @@ class AuditSentinel:
         return None
 
     def _retain(self, kernel, codes2d, quals2d, starts, winner, qual,
-                depth, errors, devices, gather, f_loc, slot, ordinal):
+                depth, errors, devices, gather, f_loc, slot, ordinal,
+                partner=None):
         """Copy everything the audit needs: inputs into recycled staging
         buffers (the caller may mutate or free its arrays the moment the
         resolve returns), outputs into plain copies (small)."""
@@ -277,6 +285,7 @@ class AuditSentinel:
             "f_loc": f_loc,
             "slot": slot,
             "ordinal": ordinal,
+            "partner": dict(partner) if partner else None,
         }
 
     @staticmethod
@@ -403,6 +412,10 @@ class AuditSentinel:
             "device_digest": _digest(dev),
             "host_digest": _digest(host),
         }
+        if item.get("partner"):
+            # coalesced dispatch: name the merge + the partner slice the
+            # corruption landed in (ops/coalesce.py attribution)
+            record["partner"] = item["partner"]
         from ..observe.metrics import METRICS
 
         with self._lock:

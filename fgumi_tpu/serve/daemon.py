@@ -55,9 +55,15 @@ def _drain_device_feeder(timeout: float = 30.0):
     """Run the device upload pipeline dry before the process exits.
 
     Looked up via sys.modules so a daemon that never dispatched to the
-    device doesn't pay the kernel (and jax) import at shutdown."""
+    device doesn't pay the kernel (and jax) import at shutdown. The
+    dispatch coalescer flushes first: a held merge window would otherwise
+    park one upload the feeder drain then waits out."""
     import sys
 
+    coal = sys.modules.get("fgumi_tpu.ops.coalesce")
+    if coal is not None and not coal.COALESCER.drain(timeout=timeout / 2):
+        log.warning("dispatch coalescer did not flush within %.0fs",
+                    timeout / 2)
     kern = sys.modules.get("fgumi_tpu.ops.kernel")
     if kern is None:
         return
@@ -529,6 +535,12 @@ class JobService:
         original queue positions ahead of any fresh submission."""
         self.bind()
         self.recover()
+        # arm the cross-job dispatch coalescer's serving signal: its merge
+        # window may auto-open whenever >= 2 of this daemon's jobs are
+        # running (the scheduler feeds the live count; ops/coalesce.py)
+        from ..ops.coalesce import COALESCER
+
+        COALESCER.set_serving(True)
         self.scheduler.start()
         if self.health_period_s > 0:
             from ..ops.breaker import BREAKER, HealthMonitor
@@ -689,6 +701,11 @@ class JobService:
             return
         self._closed = True
         self._shutdown.set()
+        import sys
+
+        coal = sys.modules.get("fgumi_tpu.ops.coalesce")
+        if coal is not None:
+            coal.COALESCER.set_serving(False)
         if self._scanner is not None:
             self._scanner.stop()
         if self._monitor is not None:

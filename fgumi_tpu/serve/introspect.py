@@ -37,8 +37,10 @@ log = logging.getLogger("fgumi_tpu")
 #: None outside --journal-dir fleet mode). v3 added the ``audit`` section
 #: (silent-corruption sentinel scoreboard, ops/sentinel.py; None while
 #: nothing was audited) — the balancer ejects a backend whose ``audit``
-#: reports ``divergent > 0``.
-STATS_SCHEMA_VERSION = 3
+#: reports ``divergent > 0``. v4 added the ``coalesce`` section
+#: (cross-job dispatch coalescer scoreboard, ops/coalesce.py; None while
+#: the merge window never armed and merged nothing).
+STATS_SCHEMA_VERSION = 4
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -58,8 +60,8 @@ def service_stats(service) -> dict:
     in this process are ``None`` (e.g. ``device`` before the first kernel
     import), so clients can rely on the shape."""
     from ..observe.flight import (audit_snapshot, breaker_snapshot,
-                                  governor_snapshot, live_device_stats,
-                                  router_snapshot)
+                                  coalesce_snapshot, governor_snapshot,
+                                  live_device_stats, router_snapshot)
     from ..observe.metrics import METRICS
 
     stats = live_device_stats()
@@ -82,6 +84,7 @@ def service_stats(service) -> dict:
         "monitor": _monitor_section(service),
         "router": router_snapshot(),
         "audit": audit_snapshot(),
+        "coalesce": coalesce_snapshot(),
     }
 
 
@@ -167,6 +170,17 @@ def render_prometheus(service) -> str:
             gauge(f"device.audit.{key}", stats["audit"].get(key, 0),
                   "shadow-audit scoreboard (ops/sentinel.py)"
                   if key == "sampled" else None)
+    if stats["coalesce"] is not None:
+        # cross-job dispatch coalescer scoreboard (ops/coalesce.py):
+        # daemon-lifetime merge counters; the flat device.coalesce.*
+        # registry counters are the last finished job's view
+        for key, v in stats["coalesce"].items():
+            if isinstance(v, bool):
+                gauge(f"device.coalesce.{key}", int(v))
+            elif isinstance(v, (int, float)):
+                gauge(f"device.coalesce.{key}", v,
+                      "dispatch-coalescer scoreboard (ops/coalesce.py)"
+                      if key == "merged_batches" else None)
 
     # flat counters/gauges from the SAME snapshot the stats op returns
     # (last finished job + anything written outside job scopes). Names the

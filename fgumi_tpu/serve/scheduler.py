@@ -208,6 +208,23 @@ class Scheduler:
 
     # -- worker -------------------------------------------------------------
 
+    @staticmethod
+    def _note_active_jobs(n: int):
+        """Arm/disarm the cross-job dispatch coalescer (ops/coalesce.py):
+        its merge window only opens while >= 2 jobs are actually RUNNING
+        in this process — a lone job never pays a hold. Called UNDER the
+        scheduler condition so two workers' updates cannot publish out
+        of order (a stale count would disarm the window for the lifetime
+        of both jobs, or tax a lone job with partner-less holds); the
+        coalescer's own lock nests strictly inside and never calls back.
+        Never fails a worker."""
+        try:
+            from ..ops.coalesce import COALESCER
+
+            COALESCER.set_active_jobs(n)
+        except Exception:  # noqa: BLE001 - telemetry must not kill workers
+            log.debug("coalescer active-job signal failed", exc_info=True)
+
     def _worker_loop(self, widx: int):
         while True:
             with self._cv:
@@ -215,6 +232,7 @@ class Scheduler:
                     self._cv.wait()
                 _, _, job = heapq.heappop(self._heap)
                 self._running += 1
+                self._note_active_jobs(self._running)
             try:
                 self.registry.mark_running(job)
                 rc = self._execute(job)
@@ -234,5 +252,6 @@ class Scheduler:
             finally:
                 with self._cv:
                     self._running -= 1
+                    self._note_active_jobs(self._running)
                     self._release_client_locked(job)
                     self._cv.notify_all()

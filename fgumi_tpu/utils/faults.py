@@ -65,6 +65,12 @@ FAULT_POINTS = frozenset({
     "writer.compress",     # BGZF writer block emit (io/bgzf.py)
     "native.batch",        # native batch-op entry (native/batch.py)
     "serve.dispatch",      # job-service worker dispatch (serve/daemon.py)
+    "serve.coalesce",      # merged cross-job device dispatch
+                           # (ops/coalesce.py) — fires on the feeder
+                           # thread inside every coalesced launch; arm
+                           # `raise` (or `hang`) to prove a fault inside a
+                           # merged dispatch degrades only its partners to
+                           # the host engine, byte-identically
     "chain.handoff",       # fused-pipeline channel put (pipeline_chain.py)
     "sort.spill",          # external-sort spill-run write (sort/external.py)
                            # — arm kind `enospc` to simulate a disk filling

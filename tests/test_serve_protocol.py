@@ -80,7 +80,8 @@ def tcp_service(tmp_path):
 #: has imported/measured (they normalize to null in the golden; their real
 #: content is covered by test_stats_op_live_sections below)
 _VOLATILE_STATS_SECTIONS = ("metrics", "latency", "device", "breaker",
-                            "governor", "router", "monitor", "audit")
+                            "governor", "router", "monitor", "audit",
+                            "coalesce")
 
 
 def _normalize(obj):

@@ -259,6 +259,86 @@ def main():
                     and "Traceback" not in p.stderr,
                     f"rc={p.returncode} leftovers={leftovers}")
 
+        # 3e) merged-dispatch fault (ISSUE 15): two concurrent jobs on a
+        # coalescing daemon with serve.coalesce:raise armed on EVERY
+        # merged launch — each partner degrades to the host engine over
+        # its OWN rows, outputs stay byte-identical to the fault-free
+        # standalone runs, and the daemon exits 0
+        sys.path.insert(0, REPO)
+        from fgumi_tpu.serve.client import ServeClient, ServeError
+
+        co_dir = os.path.join(tmp, "coalesce_fault")
+        co_std = os.path.join(co_dir, "std")
+        co_wd = os.path.join(co_dir, "wd")
+        for d in (co_std, co_wd):
+            os.makedirs(d)
+        co_inp = os.path.join(co_dir, "grouped.bam")
+        p = run(["simulate", "grouped-reads", "-o", co_inp,
+                 "--num-families", "400", "--family-size", "4",
+                 "--seed", "31"])
+        assert p.returncode == 0, p.stderr
+        co_jobs = [["simplex", "-i", co_inp, "-o", f"out_co{i}.bam",
+                    "--min-reads", "1", "--batch-groups", "25"]
+                   for i in range(2)]
+        for argv in co_jobs:
+            p = run(argv, cwd=co_std, env={"FGUMI_TPU_HOST_ENGINE": "0"})
+            assert p.returncode == 0, p.stderr
+        co_sock = os.path.join(co_dir, "serve.sock")
+        co_env = {**BASE_ENV, "FGUMI_TPU_HOST_ENGINE": "0",
+                  "FGUMI_TPU_ROUTE": "device",
+                  "FGUMI_TPU_COALESCE": "1",
+                  "FGUMI_TPU_FAULT": "serve.coalesce:raise:1.0",
+                  "FGUMI_TPU_DEVICE_BACKOFF_S": "0.01"}
+        dproc = subprocess.Popen(
+            [sys.executable, "-m", "fgumi_tpu", "serve", "--socket",
+             co_sock, "--workers", "2", "--coalesce-window-ms", "50"],
+            cwd=co_wd, env=co_env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        try:
+            cclient = ServeClient(co_sock, timeout=30)
+            deadline = time.monotonic() + 120
+            upc = False
+            while time.monotonic() < deadline and not upc:
+                try:
+                    cclient.ping()
+                    upc = True
+                except ServeError:
+                    time.sleep(0.2)
+            assert upc, "coalescing daemon never came up"
+            argv0 = os.path.join(REPO, "fgumi_tpu", "__main__.py")
+            handles = [cclient.submit(argv, argv0=argv0)
+                       for argv in co_jobs]
+            states = [cclient.wait(h["id"], timeout=240)["state"]
+                      for h in handles]
+            ident = True
+            for i in range(2):
+                ref = open(os.path.join(co_std, f"out_co{i}.bam"),
+                           "rb").read()
+                got_path = os.path.join(co_wd, f"out_co{i}.bam")
+                got = open(got_path, "rb").read() \
+                    if os.path.exists(got_path) else b""
+                ident &= got == ref
+            ok &= check("serve.coalesce:raise -> both jobs done, outputs "
+                        "byte-identical to fault-free standalone",
+                        states == ["done", "done"] and ident,
+                        f"states={states} identical={ident}")
+            stats = cclient.request({"v": 1, "op": "stats"}).get(
+                "stats", {})
+            coal = stats.get("coalesce") or {}
+            ok &= check("stats record the merged launches that degraded",
+                        coal.get("merged_batches", 0) >= 1
+                        and coal.get("partners", 0) >= 2,
+                        f"merged={coal.get('merged_batches')} "
+                        f"partners={coal.get('partners')}")
+            cclient.shutdown()
+            rc = dproc.wait(timeout=240)
+            ok &= check("coalescing daemon exits 0 under merged-dispatch "
+                        "faults", rc == 0, f"rc={rc}")
+        finally:
+            if dproc.poll() is None:
+                dproc.kill()
+                dproc.wait(timeout=10)
+
         # 4) disk full (ISSUE 8): injected ENOSPC mid-spill and mid-merge
         # both honor the resource clean-failure contract — exit 4, no
         # partial output, no stale spill temps, and the run report records

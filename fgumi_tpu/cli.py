@@ -3718,6 +3718,15 @@ def _add_balance(sub):
                    help="per-request timeout toward a backend")
     p.add_argument("--max-frame-bytes", type=int, default=None,
                    help="protocol frame size cap (default 1 MiB)")
+    p.add_argument("--metrics-port", type=int, default=None, metavar="PORT",
+                   help="serve the fleet Prometheus /metrics endpoint (+ a "
+                        "/healthz that goes 503 when no backend is "
+                        "routable) on this loopback HTTP port: fleet "
+                        "rollups plus every backend's cached series "
+                        "re-exported with a backend=\"ADDR\" label, from "
+                        "the same health-poll snapshot the `stats` op "
+                        "reports (0 = ephemeral; unset = no listener; "
+                        "docs/serving.md \"Fleet metrics\")")
     p.set_defaults(func=cmd_balance)
 
 
@@ -3738,6 +3747,10 @@ def cmd_balance(args):
     if args.max_frame_bytes is not None and args.max_frame_bytes < 1024:
         log.error("--max-frame-bytes must be >= 1024")
         return 2
+    if args.metrics_port is not None \
+            and not 0 <= args.metrics_port <= 65535:
+        log.error("--metrics-port must be in 0..65535")
+        return 2
     try:
         token = transport_mod.load_token(args.token_file)
         for addr in [args.listen] + args.backends:
@@ -3752,7 +3765,8 @@ def cmd_balance(args):
                       else transport_mod.DEFAULT_CONN_CAP),
             io_timeout_s=(args.io_timeout if args.io_timeout is not None
                           else transport_mod.DEFAULT_IO_TIMEOUT_S),
-            backend_timeout_s=args.backend_timeout)
+            backend_timeout_s=args.backend_timeout,
+            metrics_port=args.metrics_port)
     except (OSError, ValueError) as e:
         log.error("balance: %s", e)
         return 2
@@ -3905,6 +3919,59 @@ def cmd_jobs(args):
         return 2
 
 
+def _add_trace_merge(sub):
+    p = sub.add_parser(
+        "trace-merge",
+        help="Stitch per-process --trace files from one fleet-routed job "
+             "(client, balancer, backend) into a single Perfetto "
+             "timeline, clock-aligned on each file's wall-clock anchor "
+             "(docs/observability.md \"Fleet tracing\")")
+    p.add_argument("traces", nargs="+", metavar="TRACE.json",
+                   help="Chrome trace-event files to merge (each process's "
+                        "--trace output)")
+    p.add_argument("-o", "--output", required=True, metavar="PATH",
+                   help="merged trace file to write")
+    p.add_argument("--trace-id", default=None, metavar="HEX32",
+                   help="keep only inputs stamped with this fleet trace "
+                        "id; others are skipped (recorded under "
+                        "otherData.skipped)")
+    p.add_argument("--shift", action="append", default=None,
+                   metavar="FILE=SECONDS", dest="shifts",
+                   help="add SECONDS to FILE's timeline on top of the "
+                        "automatic anchor/handshake-offset alignment "
+                        "(FILE matches the path or its basename; repeat "
+                        "per file)")
+    p.add_argument("--force", action="store_true",
+                   help="merge even when the inputs carry different trace "
+                        "ids (default: that is an error)")
+    p.set_defaults(func=cmd_trace_merge)
+
+
+def cmd_trace_merge(args):
+    from .observe.trace_merge import (MergeError, merge_traces,
+                                      parse_shift_specs, write_merged)
+
+    try:
+        shifts = parse_shift_specs(args.shifts)
+        merged = merge_traces(args.traces, trace_id=args.trace_id,
+                              shifts=shifts, force=args.force)
+        write_merged(merged, args.output)
+    except MergeError as e:
+        log.error("trace-merge: %s", e)
+        return 2
+    except OSError as e:
+        log.error("trace-merge: cannot write %s: %s", args.output, e)
+        return 2
+    skipped = (merged.get("otherData") or {}).get("skipped") or []
+    for s in skipped:
+        log.info("trace-merge: skipped %s (trace id %s)", s["path"],
+                 s.get("trace_id"))
+    merged_from = merged["otherData"]["merged_from"]
+    log.info("trace-merge: merged %d file(s), %d event(s) -> %s",
+             len(merged_from), len(merged["traceEvents"]), args.output)
+    return 0
+
+
 def build_parser():
     parser = argparse.ArgumentParser(
         prog="fgumi-tpu",
@@ -3935,6 +4002,13 @@ def build_parser():
         "--trace", default=None, metavar="PATH",
         help="record pipeline/IO/device spans and write a Chrome "
              "trace-event JSON loadable in Perfetto (also FGUMI_TPU_TRACE)")
+    parser.add_argument(
+        "--xla-profile", default=None, metavar="DIR",
+        help="capture a one-shot jax.profiler device trace of the first "
+             "device dispatch into DIR (TensorBoard/xprof format; "
+             "FGUMI_TPU_XLA_PROFILE_NTH=N profiles the Nth dispatch "
+             "instead — N=2 skips the XLA compile); the run report "
+             "records the directory (also FGUMI_TPU_XLA_PROFILE)")
     parser.add_argument(
         "--run-report", default=None, metavar="PATH",
         help="write a schema-versioned JSON run report (wall time, "
@@ -3996,6 +4070,7 @@ def build_parser():
     _add_jobs(sub)
     _add_stats(sub)
     _add_balance(sub)
+    _add_trace_merge(sub)
     return parser
 
 
@@ -4186,12 +4261,17 @@ def main(argv=None):
     # counters. Nested stages (depth > 0 above) inherit this scope through
     # the contextvar and accumulate into it, exactly like the old global
     # registries did under the outermost reset.
-    from .observe.scope import publish_to_global, scoped_telemetry
+    from .observe.scope import (adopt_job_context, publish_to_global,
+                                scoped_telemetry)
 
     restore_buckets = None
     try:
         restore_buckets = _apply_shape_buckets(args)
         with scoped_telemetry(args.command) as scope:
+            # a serve-daemon job re-enters main() under a job_context: its
+            # job id, propagated trace ids, and upstream hop timestamps
+            # land on this scope (standalone runs: a no-op)
+            adopt_job_context(scope)
             try:
                 return _main_scoped(args, argv)
             finally:
@@ -4228,11 +4308,37 @@ def _main_scoped(args, argv):
         FLIGHT.configure(args.flight_dump_dir)
     FLIGHT.note("command.start", command=args.command)
     install_signal_dump()
+    # one-shot XLA device profile (--xla-profile): armed here, triggered
+    # by the kernel's Nth dispatch, recorded in the run report
+    xla_dir = (getattr(args, "xla_profile", None)
+               or os.environ.get("FGUMI_TPU_XLA_PROFILE") or None)
+    if xla_dir:
+        from .observe import xprof
+
+        try:
+            nth = int(os.environ.get("FGUMI_TPU_XLA_PROFILE_NTH", "1") or 1)
+        except ValueError:
+            log.warning("FGUMI_TPU_XLA_PROFILE_NTH=%s: not a number; "
+                        "profiling the first dispatch",
+                        os.environ["FGUMI_TPU_XLA_PROFILE_NTH"])
+            nth = 1
+        xprof.configure(xla_dir, nth)
     tracer = hb = None
     if trace_path:
+        from .observe.scope import current_scope
         from .observe.trace import start_trace
 
         tracer = start_trace()
+        scope = current_scope()
+        if scope is not None and (scope.trace_id or scope.job_id):
+            # fleet-routed job: the per-job trace carries the propagated
+            # context + a track-group label, so trace-merge can stitch it
+            # under the client's trace-id next to the other processes
+            tracer.set_context(
+                trace_id=scope.trace_id,
+                parent_span_id=scope.parent_span_id,
+                process_label=(f"backend {scope.job_id}" if scope.job_id
+                               else None))
     if hb_s > 0:
         from .observe.heartbeat import Heartbeat
 

@@ -112,6 +112,47 @@ def mesh_snapshot():
         else None
 
 
+def device_memory_snapshot():
+    """Live accelerator memory, summed over local devices:
+    ``{bytes_in_use, peak_bytes}`` from each device's ``memory_stats()``.
+
+    None on CPU (the CPU backend reports no memory stats), before the
+    kernel module initialized jax, or on backends predating the API —
+    so every consumer (heartbeat, stats op, /metrics, flight dumps) shows
+    the section only where it means something. Gated on the kernel's own
+    jax-ready flag: merely *asking* jax for devices would otherwise
+    initialize the backend from a telemetry path."""
+    kern = sys.modules.get("fgumi_tpu.ops.kernel")
+    if kern is None or not getattr(kern, "_jax_ready", False):
+        return None
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return None
+    try:
+        devices = jax_mod.local_devices()
+    except Exception:  # noqa: BLE001 - telemetry never raises
+        return None
+    in_use = peak = 0
+    seen = False
+    for d in devices:
+        ms = getattr(d, "memory_stats", None)
+        if ms is None:
+            continue
+        try:
+            stats = ms()
+        except Exception:  # noqa: BLE001
+            continue
+        if not stats:
+            continue  # CPU devices answer None/{}: no section
+        seen = True
+        in_use += int(stats.get("bytes_in_use", 0) or 0)
+        peak += int(stats.get("peak_bytes_in_use",
+                              stats.get("bytes_in_use", 0)) or 0)
+    if not seen:
+        return None
+    return {"bytes_in_use": in_use, "peak_bytes": peak}
+
+
 def _ring_capacity() -> int:
     try:
         n = int(os.environ.get("FGUMI_TPU_FLIGHT_EVENTS",
@@ -236,6 +277,19 @@ class FlightRecorder:
         }
         if attrs:
             obj["attrs"] = dict(attrs)
+        # a dump raised inside a daemon job names the job and its trace so
+        # the black box joins the merged timeline / journal record
+        try:
+            from .scope import current_scope
+
+            scope = current_scope()
+            if scope is not None:
+                for key in ("job_id", "trace_id"):
+                    val = getattr(scope, key, None)
+                    if val:
+                        obj[key] = val
+        except Exception:  # noqa: BLE001 - identity is optional
+            pass
         if exc is not None:
             obj["exception"] = {
                 "type": type(exc).__name__,
@@ -247,6 +301,7 @@ class FlightRecorder:
         # must not take the black box down with it
         for name, fn in (("metrics", self._metrics_section),
                          ("device", self._device_section),
+                         ("device_memory", device_memory_snapshot),
                          ("mesh", mesh_snapshot),
                          ("breaker", breaker_snapshot),
                          ("governor", governor_snapshot),

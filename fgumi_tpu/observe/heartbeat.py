@@ -218,6 +218,17 @@ class Heartbeat:
                 f" in-flight={stats.in_flight_count()}"
                 f" retries={snap.get('dispatch_retries', 0)}"
                 f" host-fallbacks={snap.get('host_fallbacks', 0)})")
+        # live accelerator memory (None on CPU backends): logged AND kept
+        # as gauges so the run report / stats op / scrape carry the same
+        # figures the heartbeat printed
+        from .flight import device_memory_snapshot
+
+        mem = device_memory_snapshot()
+        if mem is not None:
+            METRICS.set("device.memory.bytes_in_use", mem["bytes_in_use"])
+            METRICS.set("device.memory.peak_bytes", mem["peak_bytes"])
+            parts.append(f"devmem={mem['bytes_in_use'] / 1e6:.0f}MB"
+                         f"(peak {mem['peak_bytes'] / 1e6:.0f}MB)")
         # tail visibility: the p99 dispatch wall straight from the latency
         # histogram (the counter above says how MUCH, this says how SLOW)
         wall = METRICS.histogram("device.dispatch.wall_s")

@@ -19,6 +19,7 @@ by :func:`validate_report` — the same function the golden-file test and
 import json
 import os
 import sys
+import time
 
 #: v2 (ISSUE 9): adds the optional ``latency`` section — per-histogram
 #: ``{count, sum, p50, p90, p99, max}`` summaries from the latency
@@ -38,7 +39,14 @@ import sys
 #: ``audit.divergence`` is non-empty produced at least one device result
 #: the f64 oracle refutes — callers must treat that output as suspect
 #: (in sampled mode the corrupt batch was already consumed).
-SCHEMA_VERSION = 4
+#: v5 (ISSUE 17): optional ``trace_context`` (the fleet trace id / parent
+#: span / job id this run executed under, when it was a routed serve job),
+#: ``latency_decomposition`` (end-to-end attribution of where the time
+#: went — client->balancer, balancer->admit, queue, coalesce hold, device,
+#: commit, host-complete residual — components never summing past
+#: ``total_s``), and ``xla_profile_dir`` (the --xla-profile capture
+#: directory, when one was taken).
+SCHEMA_VERSION = 5
 
 
 def _device_stats():
@@ -84,7 +92,21 @@ _OPTIONAL = {
                            # during this run (observe/flight.py; v2)
     "trace_path": str,
     "hostname": str,
+    "trace_context": dict,  # fleet trace id / parent span / job id this
+                            # run executed under (observe/trace.py; v5)
+    "latency_decomposition": dict,  # end-to-end attribution: hop/queue/
+                                    # device/commit components + residual,
+                                    # summing <= total_s (v5)
+    "xla_profile_dir": str,  # --xla-profile capture directory (v5)
 }
+
+#: Components a ``latency_decomposition`` section may carry besides
+#: ``total_s`` (any subset; what was measurable for this run).
+_DECOMP_COMPONENTS = (
+    "client_to_balancer_s", "balancer_to_admit_s", "client_to_admit_s",
+    "queue_s", "coalesce_hold_s", "device_s", "commit_s",
+    "host_complete_s",
+)
 
 #: Required numeric fields of one ``latency`` summary entry, in the order
 #: the quantile-monotonicity check walks them.
@@ -148,6 +170,39 @@ def validate_report(obj) -> list:
             errors.append("audit.output is not a list")
         if "devices" in audit and not isinstance(audit["devices"], dict):
             errors.append("audit.devices is not an object")
+    if isinstance(obj.get("trace_context"), dict):
+        tc = obj["trace_context"]
+        for f in ("trace_id", "parent_span_id", "job_id"):
+            if f in tc and not isinstance(tc[f], str):
+                errors.append(f"trace_context field {f!r} is not a string")
+        unknown = set(tc) - {"trace_id", "parent_span_id", "job_id"}
+        if unknown:
+            errors.append(f"trace_context unknown fields {sorted(unknown)}")
+    if isinstance(obj.get("latency_decomposition"), dict):
+        dec = obj["latency_decomposition"]
+        total = dec.get("total_s")
+        if not isinstance(total, (int, float)) or isinstance(total, bool) \
+                or total < 0:
+            errors.append("latency_decomposition.total_s is not a "
+                          "non-negative number")
+            total = None
+        comp_sum = 0.0
+        for name, v in dec.items():
+            if name == "total_s":
+                continue
+            if name not in _DECOMP_COMPONENTS:
+                errors.append("latency_decomposition unknown component "
+                              f"{name!r}")
+            elif not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v < 0:
+                errors.append(f"latency_decomposition component {name!r} "
+                              "is not a non-negative number")
+            else:
+                comp_sum += v
+        # the attribution invariant (small epsilon for per-field rounding)
+        if total is not None and comp_sum > total + 0.005:
+            errors.append("latency_decomposition components sum "
+                          f"{comp_sum:.6f} past total_s {total:.6f}")
     return errors
 
 
@@ -171,6 +226,63 @@ def _stage_sections(metrics: dict):
             "out_max": metrics.get("pipeline.queue.out.max", 0),
         }
     return stages, queues
+
+
+def _latency_decomposition(latency: dict, wall_s: float, scope) -> dict:
+    """The v5 end-to-end attribution: where did submit-to-bytes-published
+    go? Hop legs come from the propagated wall-clock timestamps on the
+    telemetry scope (client_sent / balancer_recv / balancer_sent /
+    admitted / started — a fleet-routed job has all five, a direct submit
+    three, a plain CLI run none); in-process components are histogram sums
+    (coalesce hold, device wall, output commit); ``host_complete_s`` is
+    the residual. ``total_s`` spans client send to now when the client
+    stamped its send time, else the command wall.
+
+    Components are CAPPED in order so they can never sum past ``total_s``
+    — this section is an *attribution* of the total (shares), not a raw
+    measurement (raw sums stay in ``latency``); host clock skew or
+    overlapped device work therefore shrinks later components instead of
+    fabricating > 100% accounting. None when nothing was measurable
+    (no hops and no timed component)."""
+    hops = dict(scope.hops) if scope is not None and scope.hops else {}
+
+    def hist_sum(name):
+        summ = latency.get(name)
+        return float(summ["sum"]) if isinstance(summ, dict) else 0.0
+
+    cs = hops.get("client_sent_unix")
+    br = hops.get("balancer_recv_unix")
+    bs = hops.get("balancer_sent_unix")
+    ad = hops.get("admitted_unix")
+    st = hops.get("started_unix")
+    measured = []
+    if cs and br:
+        measured.append(("client_to_balancer_s", br - cs))
+    if bs and ad:
+        measured.append(("balancer_to_admit_s", ad - bs))
+    elif cs and ad and not br:
+        measured.append(("client_to_admit_s", ad - cs))
+    if ad and st:
+        measured.append(("queue_s", st - ad))
+    measured.append(("coalesce_hold_s",
+                     hist_sum("device.coalesce.window_wait_s")))
+    measured.append(("device_s", hist_sum("device.dispatch.wall_s")))
+    measured.append(("commit_s", hist_sum("io.commit_s")))
+    if not hops and not any(v > 0 for _, v in measured):
+        return None
+    total = (time.time() - cs) if cs else float(wall_s)
+    if total <= 0:  # client clock ahead of ours: fall back to our wall
+        total = max(float(wall_s), 0.0)
+    out = {"total_s": round(total, 6)}
+    spent = 0.0
+    for name, v in measured:
+        v = min(max(float(v), 0.0), max(total - spent, 0.0))
+        if v <= 0 and name in ("coalesce_hold_s", "device_s", "commit_s"):
+            continue  # component never armed this run: omit, not zero
+        out[name] = round(v, 6)
+        spent += v
+    out["host_complete_s"] = round(max(total - spent, 0.0), 6)
+    return out
 
 
 def build_report(command: str, argv, started_unix: float, wall_s: float,
@@ -277,6 +389,25 @@ def build_report(command: str, argv, started_unix: float, wall_s: float,
     latency = METRICS.summaries()
     if latency:
         report["latency"] = latency
+    # fleet trace context + end-to-end attribution (schema v5): a daemon
+    # job adopted its job id / trace context / hop timestamps onto the
+    # telemetry scope at entry (observe/scope.py adopt_job_context); the
+    # report is where they become a queryable artifact
+    from .scope import current_scope
+
+    scope = current_scope()
+    if scope is not None and (scope.trace_id or scope.job_id):
+        tc = {}
+        if scope.trace_id:
+            tc["trace_id"] = scope.trace_id
+        if scope.parent_span_id:
+            tc["parent_span_id"] = scope.parent_span_id
+        if scope.job_id:
+            tc["job_id"] = scope.job_id
+        report["trace_context"] = tc
+    decomposition = _latency_decomposition(latency, wall_s, scope)
+    if decomposition:
+        report["latency_decomposition"] = decomposition
     # black boxes written during this run (flight recorder): the report is
     # the breadcrumb from "this run degraded" to the full evidence file
     flight = sys.modules.get("fgumi_tpu.observe.flight")
@@ -286,6 +417,14 @@ def build_report(command: str, argv, started_unix: float, wall_s: float,
             report["flight_dumps"] = dumps
     if trace_path:
         report["trace_path"] = trace_path
+    # one-shot XLA device profile (--xla-profile): the capture directory
+    # rides along so "device time regressed" links straight to the
+    # op-level xprof timeline (observe/xprof.py; v5)
+    xprof = sys.modules.get("fgumi_tpu.observe.xprof")
+    if xprof is not None:
+        captured = xprof.captured_dir()
+        if captured:
+            report["xla_profile_dir"] = captured
     return report
 
 

@@ -31,6 +31,12 @@ _SCOPE = contextvars.ContextVar("fgumi_tpu_telemetry_scope", default=None)
 #: CL lines). The serve daemon sets this to the *client's* command line so a
 #: job's outputs are byte-identical to the same command run standalone.
 _ARGV = contextvars.ContextVar("fgumi_tpu_command_argv", default=None)
+#: Pending job context for the NEXT telemetry scope created underneath: the
+#: serve daemon re-enters ``cli.main`` per job, and main() builds the job's
+#: scope itself — this is how the daemon hands the job id, the propagated
+#: W3C-style trace context, and the upstream hop timestamps across that
+#: re-entry (same pattern as :class:`command_argv`).
+_JOB_CTX = contextvars.ContextVar("fgumi_tpu_job_context", default=None)
 
 
 class TelemetryScope:
@@ -40,7 +46,8 @@ class TelemetryScope:
     in ``ops.kernel`` and is only materialized when a kernel actually
     touches it, so numpy-free commands never pay that import."""
 
-    __slots__ = ("label", "metrics", "tracer", "_device_stats", "_lock")
+    __slots__ = ("label", "metrics", "tracer", "_device_stats", "_lock",
+                 "trace_id", "parent_span_id", "job_id", "hops")
 
     def __init__(self, label: str = None):
         from .metrics import MetricsRegistry
@@ -50,6 +57,17 @@ class TelemetryScope:
         self.tracer = None  # set by trace.start_trace inside the scope
         self._device_stats = None
         self._lock = threading.Lock()
+        #: fleet trace context (W3C-style ids propagated over the serve
+        #: protocol): set by the daemon before running a job so the run
+        #: report, the per-job trace, and every flight dump written inside
+        #: this scope carry the client-visible correlation ids
+        self.trace_id = None
+        self.parent_span_id = None
+        self.job_id = None
+        #: upstream hop wall-clock timestamps for end-to-end latency
+        #: attribution (client_sent_unix / balancer_recv_unix /
+        #: balancer_sent_unix / admitted_unix / started_unix as available)
+        self.hops = None
 
     def device_stats(self, factory):
         """This scope's DeviceStats, created on first use via ``factory``
@@ -109,6 +127,43 @@ class command_argv:
     def __exit__(self, *exc):
         _ARGV.reset(self._token)
         return False
+
+
+class job_context:
+    """Context manager naming the fleet job context for scopes created
+    inside it (the serve daemon wraps each job's ``cli.main`` re-entry).
+
+    ``trace_id``/``parent_span_id`` are the propagated W3C-style ids (or
+    None), ``hops`` the upstream wall-clock timestamps for end-to-end
+    latency attribution (``client_sent_unix`` / ``balancer_recv_unix`` /
+    ``balancer_sent_unix`` / ``admitted_unix`` / ``started_unix``)."""
+
+    def __init__(self, job_id: str = None, trace_id: str = None,
+                 parent_span_id: str = None, hops: dict = None):
+        self._ctx = {"job_id": job_id, "trace_id": trace_id,
+                     "parent_span_id": parent_span_id,
+                     "hops": dict(hops) if hops else None}
+        self._token = None
+
+    def __enter__(self):
+        self._token = _JOB_CTX.set(self._ctx)
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _JOB_CTX.reset(self._token)
+        return False
+
+
+def adopt_job_context(scope: TelemetryScope):
+    """Stamp any pending :class:`job_context` onto a fresh scope (called
+    by ``cli.main`` right after it creates the per-command scope)."""
+    ctx = _JOB_CTX.get()
+    if ctx is None:
+        return
+    scope.job_id = ctx["job_id"]
+    scope.trace_id = ctx["trace_id"]
+    scope.parent_span_id = ctx["parent_span_id"]
+    scope.hops = ctx["hops"]
 
 
 def current_argv():

@@ -21,12 +21,76 @@ Design constraints (the acceptance contract of the telemetry layer):
   override ``FGUMI_TPU_TRACE_MAX_EVENTS``); overflow drops further spans
   and reports the dropped count in the export rather than growing without
   bound on a long run.
+- **Cross-process linkage.** A W3C-style trace context (32-hex trace-id +
+  16-hex parent-span-id, carried as a ``traceparent`` string) can be
+  attached to a tracer; the export then stamps it into ``otherData`` and a
+  ``process_labels`` metadata event so ``fgumi-tpu trace-merge`` can stitch
+  per-process files from one fleet-routed job into a single timeline.
+  Every export also records a wall-clock anchor (``t_zero_unix`` paired
+  with the monotonic ``t_zero``) — the merge tool aligns per-process
+  timelines on these anchors (docs/observability.md "Fleet tracing").
 """
 
 import json
 import os
 import threading
 import time
+
+# ---------------------------------------------------------------------------
+# W3C-style trace context (trace-id + parent-span-id)
+
+#: traceparent wire format, a strict subset of W3C Trace Context:
+#: ``00-<32 hex trace-id>-<16 hex span-id>-01``. Malformed values are
+#: IGNORED by every consumer (dropped, never rejected) so a buggy or
+#: future-version peer can't fail a submission over telemetry garnish.
+_TRACEPARENT_VERSION = "00"
+
+
+def mint_trace_id() -> str:
+    """A fresh 32-hex trace id (random, collision-safe across the fleet)."""
+    return os.urandom(16).hex()
+
+
+def mint_span_id() -> str:
+    """A fresh 16-hex span id."""
+    return os.urandom(8).hex()
+
+
+def format_traceparent(trace_id: str, span_id: str) -> str:
+    """``00-<trace-id>-<span-id>-01`` (sampled flag always set: fgumi-tpu
+    traces are explicitly requested, never probabilistically sampled)."""
+    return f"{_TRACEPARENT_VERSION}-{trace_id}-{span_id}-01"
+
+
+def _is_hex(s: str, n: int) -> bool:
+    if len(s) != n:
+        return False
+    try:
+        int(s, 16)
+    except ValueError:
+        return False
+    return True
+
+
+def parse_traceparent(value):
+    """``(trace_id, span_id)`` for a well-formed traceparent, else None.
+
+    None for anything malformed — wrong type, wrong field count, non-hex,
+    all-zero ids — per the propagation contract: telemetry context is
+    best-effort garnish and must never fail a request."""
+    if not isinstance(value, str):
+        return None
+    parts = value.split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts
+    if not (_is_hex(version, 2) and _is_hex(trace_id, 32)
+            and _is_hex(span_id, 16) and _is_hex(flags, 2)):
+        return None
+    if version == "ff" or set(trace_id) == {"0"} or set(span_id) == {"0"}:
+        return None
+    return trace_id, span_id
+
 
 # ---------------------------------------------------------------------------
 # no-op fast path
@@ -112,11 +176,40 @@ class _Tracer:
             except ValueError:
                 max_events = MAX_EVENTS
         self.max_events = max_events
+        # the clock anchor pair: one monotonic zero for in-file timestamps
+        # and the wall-clock instant it corresponds to, captured
+        # back-to-back. trace-merge aligns per-process files by shifting
+        # each timeline so the anchors agree (the residual error is the
+        # few-ns gap between these two calls plus any host clock skew,
+        # correctable with the handshake offset estimate).
         self.t_zero = time.monotonic()
+        self.t_zero_unix = time.time()
+        #: W3C-style trace context (set via :meth:`set_context` when this
+        #: process's work is part of a fleet-routed job); exported so
+        #: trace-merge can group per-process files under one trace-id
+        self.trace_id = None
+        self.parent_span_id = None
+        #: human label for this process's track group in a merged timeline
+        #: (e.g. "client", "balancer", "backend j-3")
+        self.process_label = None
+        #: estimated local-minus-server wall clock skew (seconds), from
+        #: the serve handshake round trip; trace-merge subtracts it from
+        #: the anchor so cross-host timelines line up on the server clock
+        self.clock_offset_s = None
         self.dropped = 0
         self._lock = threading.Lock()
         self._events = []
         self._named_tids = set()
+
+    def set_context(self, trace_id: str = None, parent_span_id: str = None,
+                    process_label: str = None):
+        """Attach the fleet trace context (any subset; idempotent)."""
+        if trace_id is not None:
+            self.trace_id = trace_id
+        if parent_span_id is not None:
+            self.parent_span_id = parent_span_id
+        if process_label is not None:
+            self.process_label = process_label
 
     def _thread_meta_locked(self):
         """Emit a thread_name metadata event for the calling thread once."""
@@ -180,9 +273,25 @@ class _Tracer:
                 "ts": round((time.monotonic() - self.t_zero) * 1e6, 1),
                 "args": {"dropped_events": self.dropped,
                          "max_events": self.max_events}})
+        if self.process_label:
+            # a process_name metadata event labels this pid's track group
+            # when the file is merged with other processes' timelines
+            events.append({"name": "process_name", "ph": "M",
+                           "pid": os.getpid(), "tid": 0,
+                           "args": {"name": self.process_label}})
         obj = {"traceEvents": events, "displayTimeUnit": "ms"}
+        clock = {"t_zero_unix": round(self.t_zero_unix, 6)}
+        if self.clock_offset_s is not None:
+            clock["offset_estimate_s"] = round(self.clock_offset_s, 6)
+        other = {"clock": clock,
+                 "process": {"pid": os.getpid(),
+                             "label": self.process_label}}
+        if self.trace_id:
+            other["trace_context"] = {"trace_id": self.trace_id,
+                                      "parent_span_id": self.parent_span_id}
         if self.dropped:
-            obj["otherData"] = {"dropped_events": self.dropped}
+            other["dropped_events"] = self.dropped
+        obj["otherData"] = other
         return obj
 
 
@@ -208,6 +317,23 @@ def instant(name: str, **attrs):
     t = _current_tracer()
     if t is not None:
         t.instant(name, attrs or None)
+
+
+def set_trace_context(trace_id: str = None, parent_span_id: str = None,
+                      process_label: str = None):
+    """Attach the fleet trace context to the active tracer (no-op when
+    tracing is off — context is garnish, never a reason to allocate)."""
+    t = _current_tracer()
+    if t is not None:
+        t.set_context(trace_id, parent_span_id, process_label)
+
+
+def set_clock_offset(offset_s: float):
+    """Record the handshake clock-offset estimate on the active tracer
+    (no-op when tracing is off)."""
+    t = _current_tracer()
+    if t is not None:
+        t.clock_offset_s = float(offset_s)
 
 
 def start_trace(max_events: int = None):

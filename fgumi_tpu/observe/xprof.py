@@ -1,0 +1,99 @@
+"""Optional XLA device profile capture (``--xla-profile DIR``).
+
+``fgumi-tpu --xla-profile DIR <command>`` (or
+``FGUMI_TPU_XLA_PROFILE=DIR``) arms a one-shot ``jax.profiler`` trace
+around the Nth device dispatch (``FGUMI_TPU_XLA_PROFILE_NTH``, default 1
+— the first dispatch carries the XLA compile, so profiling a warm
+dispatch usually wants N=2). The capture lands in DIR in TensorBoard /
+``xprof`` format and the run report records the directory
+(``xla_profile_dir``), so a perf investigation can jump from "this run's
+device time regressed" straight to the XLA op-level timeline.
+
+Deliberately one-shot: a per-dispatch profile of a million-dispatch run
+would be gigabytes of xplane protos and a constant host tax. Zero
+overhead when off: the kernel's dispatch path checks one module flag.
+All failures are soft — a missing/old profiler API or an unwritable DIR
+logs a warning and disarms; it never fails the dispatch.
+"""
+
+import logging
+import threading
+
+log = logging.getLogger("fgumi_tpu")
+
+_lock = threading.Lock()
+_dir = None          # capture target; None = feature off
+_nth = 1             # which dispatch to profile (1-based)
+_seen = 0            # dispatches observed so far
+_active = False      # a jax.profiler trace is running
+_captured = None     # DIR once a capture completed (also: re-arm guard)
+
+
+def configure(profile_dir: str, nth: int = 1):
+    """Arm capture of the ``nth`` dispatch into ``profile_dir`` (CLI
+    entry, once per command). None disarms."""
+    global _dir, _nth, _seen, _active, _captured
+    with _lock:
+        _dir = profile_dir or None
+        _nth = max(int(nth), 1)
+        _seen = 0
+        _active = False
+        _captured = None
+
+
+def armed() -> bool:
+    """Cheap gate for the dispatch hot path (no lock: a stale read costs
+    one extra function call, never a wrong capture)."""
+    return _dir is not None and _captured is None
+
+
+def on_dispatch_begin():
+    """Called as a device dispatch is submitted; starts the profiler when
+    this is the Nth one."""
+    global _seen, _active, _dir
+    with _lock:
+        if _dir is None or _captured is not None or _active:
+            return
+        _seen += 1
+        if _seen != _nth:
+            return
+        profile_dir = _dir
+        try:
+            import jax
+
+            jax.profiler.start_trace(profile_dir)
+        except Exception as e:  # noqa: BLE001 - profiling is best-effort
+            log.warning("xla-profile: cannot start device trace in %s: %s",
+                        profile_dir, e)
+            _dir = None
+            return
+        _active = True
+        log.info("xla-profile: capturing dispatch %d into %s", _seen,
+                 profile_dir)
+
+
+def on_dispatch_end():
+    """Called after a dispatch's result was fetched; stops a running
+    capture (the profile then spans upload + compute + fetch)."""
+    global _active, _captured, _dir
+    with _lock:
+        if not _active:
+            return
+        profile_dir = _dir
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception as e:  # noqa: BLE001
+            log.warning("xla-profile: stop_trace failed: %s", e)
+            _active = False
+            _dir = None
+            return
+        _active = False
+        _captured = profile_dir
+        log.info("xla-profile: device profile written to %s", profile_dir)
+
+
+def captured_dir():
+    """The completed capture's directory (run-report rider), or None."""
+    return _captured

@@ -458,6 +458,10 @@ class DeviceStats:
     def begin_in_flight(self, upload_bytes: int, pack_s: float = 0.0) -> int:
         """Count a dispatch in flight (host->device submitted, result not
         yet fetched). Returns a timeline slot id for end_in_flight."""
+        from ..observe import xprof
+
+        if xprof.armed():  # one-shot --xla-profile capture (off: one call)
+            xprof.on_dispatch_begin()
         with self._lock:
             self.in_flight += 1
             self.bytes_uploaded += int(upload_bytes)
@@ -685,7 +689,11 @@ def _observe_dispatch_latency(entry: dict) -> None:
     walls, the end-to-end dispatch wall, and the offload cost model's
     predicted-vs-actual error. Called once per resolve, outside the
     DeviceStats lock."""
+    from ..observe import xprof
     from ..observe.metrics import METRICS
+
+    if xprof.armed():  # close an in-flight --xla-profile capture
+        xprof.on_dispatch_end()
 
     METRICS.observe("device.dispatch.pack_s", entry.get("pack_s", 0.0))
     if "upload_s" in entry:

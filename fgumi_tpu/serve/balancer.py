@@ -192,6 +192,13 @@ class Backend:
         # device tells the truth.
         self.sdc_hold = False
         self.audit_divergent = 0
+        # last full stats payload the health poll fetched, plus the wall
+        # time it landed: the balancer's /metrics endpoint and its
+        # fleet_metrics stats section re-export backend series from THIS
+        # cache, so a scrape never fans out live probes (and staleness is
+        # visible as fleet.backend.stats_age_s)
+        self._last_stats = None
+        self._last_stats_unix = None
 
     @property
     def depth(self):
@@ -206,6 +213,17 @@ class Backend:
         with self._lock:
             self.last_ok_unix = round(time.time(), 3)
             self.last_error = None
+
+    def note_stats(self, stats: dict):
+        with self._lock:
+            self._last_stats = stats
+            self._last_stats_unix = round(time.time(), 3)
+
+    def cached_stats(self):
+        """``(stats_payload, scrape_unix)`` from the last successful
+        health poll — ``(None, None)`` before the first one lands."""
+        with self._lock:
+            return self._last_stats, self._last_stats_unix
 
     def note_error(self, err: str):
         with self._lock:
@@ -252,7 +270,8 @@ class Balancer:
                  conn_cap: int = transport.DEFAULT_CONN_CAP,
                  io_timeout_s: float = transport.DEFAULT_IO_TIMEOUT_S,
                  backend_timeout_s: float = 30.0,
-                 job_map_limit: int = 10000):
+                 job_map_limit: int = 10000,
+                 metrics_port: int = None):
         if not backends:
             raise ValueError("balance needs at least one --backend")
         self.listen_addr = listen
@@ -287,6 +306,14 @@ class Balancer:
         self._shutdown = threading.Event()
         self._poll_stop = threading.Event()
         self._poll_threads = []
+        # the telemetry scope active at construction (cmd_balance's): the
+        # FrameServer's connection threads are plain threads with no
+        # contextvar inheritance, so handle_request re-enters this scope —
+        # otherwise --trace forward spans and the propagated trace context
+        # would land on a dead process-global tracer
+        from ..observe.scope import current_scope
+
+        self._telemetry_scope = current_scope()
         kind, target = transport.parse_address(listen)
         if kind == "unix":
             listener = transport.UnixListener(target)
@@ -299,16 +326,33 @@ class Balancer:
         self._frames = transport.FrameServer(
             self.handle_request, [listener], max_frame_bytes,
             on_shutdown=self._shutdown.set, name="fgumi-balance")
+        # optional fleet metrics endpoint: the daemon's IntrospectionServer
+        # with the balancer's own renderers plugged in (/metrics re-exports
+        # backend-labelled series from the health-poll cache; /healthz is
+        # 200 while at least one backend is routable)
+        self._metrics = None
+        if metrics_port is not None:
+            from .introspect import IntrospectionServer
+
+            self._metrics = IntrospectionServer(
+                self, metrics_port,
+                metrics_fn=lambda: render_fleet_prometheus(self),
+                healthz_fn=lambda: render_fleet_healthz(self))
         self._closed = False
 
     # -- lifecycle ----------------------------------------------------------
 
     def bind(self):
         self._frames.bind()
+        if self._metrics is not None:
+            # busy metrics port fails fast, before any backend traffic
+            self._metrics.bind()
 
     def start(self):
         self.bind()
         self._frames.start()
+        if self._metrics is not None:
+            self._metrics.start()
         self._poll_threads = []
         for i, b in enumerate(self.backends):
             t = threading.Thread(target=self._poll_loop, args=(b,),
@@ -347,6 +391,8 @@ class Balancer:
         self._poll_stop.set()
         for t in self._poll_threads:
             t.join(timeout=5)
+        if self._metrics is not None:
+            self._metrics.stop()
         self._frames.close()
         # let in-flight forwards answer before the process exits
         deadline = time.monotonic() + grace_s
@@ -361,6 +407,11 @@ class Balancer:
     def listen_port(self):
         """Bound TCP port (ephemeral port 0 resolves after bind)."""
         return getattr(self._listener, "port", None)
+
+    @property
+    def metrics_port(self):
+        """Bound metrics port (None without --metrics-port)."""
+        return self._metrics.port if self._metrics is not None else None
 
     # -- health loop --------------------------------------------------------
 
@@ -395,6 +446,7 @@ class Balancer:
             # job on a loaded host), the spurious-ejection mode the
             # timeout-failover rule exists to prevent
             stats = b.client.stats(timeout=min(b.client.timeout, 10.0))
+            b.note_stats(stats)
             sched = stats.get("scheduler") or {}
             b.note_depth(int(sched.get("queued", 0))
                          + int(sched.get("running", 0)))
@@ -521,6 +573,14 @@ class Balancer:
     # -- request dispatch ---------------------------------------------------
 
     def handle_request(self, req: dict) -> dict:
+        from ..observe.scope import current_scope, scoped_telemetry
+
+        if self._telemetry_scope is not None and current_scope() is None:
+            with scoped_telemetry(scope=self._telemetry_scope):
+                return self._handle_request(req)
+        return self._handle_request(req)
+
+    def _handle_request(self, req: dict) -> dict:
         err = protocol.validate_request(req)
         if err is not None:
             return protocol.error_response(err)
@@ -552,13 +612,21 @@ class Balancer:
             return protocol.ok_response(draining=True)
         raise AssertionError(f"unhandled op {op}")
 
-    def stats_snapshot(self) -> dict:
+    def stats_snapshot(self, scrape=None) -> dict:
+        """The balancer's ``stats`` op payload. v2 added ``fleet_metrics``
+        (health-poll-cache rollup: fleet depth, per-backend breaker/SDC
+        state, takeover counts, e2e latency summaries). Pass a pre-taken
+        :meth:`backend_scrape` so this payload and a concurrent
+        ``/metrics`` render derive from ONE cache read (the same-snapshot
+        rule the daemon's introspection keeps)."""
         from ..observe.metrics import METRICS
 
+        if scrape is None:
+            scrape = self.backend_scrape()
         with self._jobs_lock:
             tracked = len(self._job_backend)
         return {
-            "schema_version": 1,
+            "schema_version": 2,
             "tool": "fgumi-tpu-balance",
             "pid": os.getpid(),
             "uptime_s": round(time.time() - self.started_unix, 1),
@@ -567,9 +635,68 @@ class Balancer:
             "tracked_jobs": tracked,
             "metrics": {k: v for k, v in METRICS.snapshot().items()
                         if k.startswith(("fleet.", "serve.transport."))},
+            "fleet_metrics": self._fleet_metrics(scrape),
             "backends": [
-                {**b.snapshot(), "breaker": b.breaker.snapshot()}
-                for b in self.backends],
+                {**snap, "breaker": b.breaker.snapshot()}
+                for b, snap, _, _ in scrape],
+        }
+
+    def backend_scrape(self):
+        """One coherent read of the health loop's cache:
+        ``[(Backend, snapshot, cached_stats | None, scrape_unix | None)]``
+        in ``--backend`` order. Never touches a backend."""
+        out = []
+        for b in self.backends:
+            stats, stats_unix = b.cached_stats()
+            out.append((b, b.snapshot(), stats, stats_unix))
+        return out
+
+    @staticmethod
+    def _fleet_metrics(scrape) -> dict:
+        """Fleet rollup from one :meth:`backend_scrape`: aggregate depth,
+        healthy-backend count, takeover totals, and a per-backend
+        breakdown carrying each daemon's end-to-end
+        ``serve.job.e2e.submit_to_done_s`` summary — the fleet's
+        "p99 submit-to-bytes-published" figure, surfaced without a
+        scrape of the backends themselves."""
+        depth_total, depth_known, healthy = 0, 0, 0
+        takeovers = takeover_jobs = 0
+        per_backend = []
+        for b, snap, stats, stats_unix in scrape:
+            routable = (snap["state"] != "open"
+                        and not snap.get("sdc_hold"))
+            healthy += int(routable)
+            if snap["depth"] is not None:
+                depth_total += snap["depth"]
+                depth_known += 1
+            fleet = (stats or {}).get("fleet") or {}
+            b_takeovers = int(fleet.get("takeovers") or 0)
+            takeovers += b_takeovers
+            takeover_jobs += int(fleet.get("takeover_jobs") or 0)
+            entry = {
+                "address": snap["address"],
+                "state": snap["state"],
+                "routable": routable,
+                "depth": snap["depth"],
+                "sdc_hold": bool(snap.get("sdc_hold")),
+                "audit_divergent": int(snap.get("audit_divergent") or 0),
+                "takeovers": b_takeovers,
+                "stats_age_s": (round(time.time() - stats_unix, 1)
+                                if stats_unix else None),
+            }
+            e2e = ((stats or {}).get("latency") or {}).get(
+                "serve.job.e2e.submit_to_done_s")
+            if e2e is not None:
+                entry["submit_to_done_s"] = e2e
+            per_backend.append(entry)
+        return {
+            "backends_total": len(scrape),
+            "backends_healthy": healthy,
+            "fleet_depth": depth_total,
+            "fleet_depth_known_backends": depth_known,
+            "takeovers": takeovers,
+            "takeover_jobs": takeover_jobs,
+            "per_backend": per_backend,
         }
 
     def _forward(self, b: Backend, req: dict, claimed: bool = False) -> dict:
@@ -613,13 +740,41 @@ class Balancer:
         if was != "closed" and now == "closed":
             METRICS.inc("fleet.balancer.readmitted")
 
+    @staticmethod
+    def _stamp_submit(req: dict):
+        """Copy a submit frame and stamp the balancer hop onto the copy:
+        ``bal_recv_unix`` now, and the ``traceparent`` rewritten so its
+        parent is the balancer's own hop span (same trace-id — the chain
+        stays causally linked client -> balancer -> backend). A malformed
+        incoming traceparent is dropped, never rejected. Returns
+        ``(req_copy, (trace_id, parent_span_id, hop_span_id) | None)``;
+        the copy is the balancer's to mutate (``bal_sent_unix`` per
+        forward attempt), the caller's frame is never touched."""
+        from ..observe import trace as trace_mod
+
+        req = dict(req)
+        req["bal_recv_unix"] = round(time.time(), 6)
+        parsed = trace_mod.parse_traceparent(req.get("traceparent"))
+        if parsed is None:
+            req.pop("traceparent", None)
+            return req, None
+        trace_id, parent_span = parsed
+        hop_span = trace_mod.mint_span_id()
+        req["traceparent"] = trace_mod.format_traceparent(trace_id, hop_span)
+        trace_mod.set_trace_context(trace_id=trace_id,
+                                    parent_span_id=parent_span,
+                                    process_label="balancer")
+        return req, (trace_id, parent_span, hop_span)
+
     def _route_submit(self, req: dict) -> dict:
+        from ..observe import trace as trace_mod
         from ..observe.metrics import METRICS
 
         if self.draining:
             return protocol.error_response(
                 "draining: balancer is not accepting new jobs")
         METRICS.inc("fleet.balancer.submits")
+        req, hop_ctx = self._stamp_submit(req)
         dedupe = req.get("dedupe")
         slept_hint = False
         # route passes are bounded: each re-scan needs a state change
@@ -675,7 +830,13 @@ class Balancer:
                     # probe" idea applied to peers (allow() above claimed
                     # the slot). No client-side retry: failover below is
                     # the retry.
-                    resp = self._forward(b, req, claimed=True)
+                    req["bal_sent_unix"] = round(time.time(), 6)
+                    attrs = {"backend": b.address}
+                    if hop_ctx is not None:
+                        attrs["trace_id"] = hop_ctx[0]
+                        attrs["span_id"] = hop_ctx[2]
+                    with trace_mod.span("serve.forward", **attrs):
+                        resp = self._forward(b, req, claimed=True)
                 except ServeError as e:
                     if not isinstance(e, TransportError):
                         # the backend ANSWERED but refused the
@@ -833,3 +994,102 @@ class Balancer:
                 last_refusal = resp
         return last_refusal or protocol.error_response(
             f"unknown job {job_id}")
+
+
+# ---------------------------------------------------------------------------
+# fleet metrics endpoint (balancer --metrics-port)
+
+
+def render_fleet_prometheus(balancer: Balancer) -> str:
+    """The balancer's ``/metrics`` body: fleet rollups, the balancer's own
+    counters, and every backend's cached daemon series re-exported under
+    the SAME metric names with a ``backend="ADDR"`` label (so one Grafana
+    panel graphs ``fgumi_tpu_serve_job_e2e_submit_to_done_s`` quantiles
+    per backend). Derived from one :meth:`Balancer.backend_scrape` — the
+    identical cache read the ``stats`` op's ``fleet_metrics`` section
+    uses, so the two surfaces can never disagree — and never probes a
+    backend (staleness shows as ``fleet_backend_stats_age_s``)."""
+    from .introspect import _num, _prom_name
+
+    scrape = balancer.backend_scrape()
+    snap = balancer.stats_snapshot(scrape=scrape)
+    fleet = snap["fleet_metrics"]
+    lines = []
+
+    def gauge(dotted, value, labels="", help_text=None):
+        name = _prom_name(dotted)
+        if help_text:
+            lines.append(f"# HELP {name} {help_text}")
+            lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name}{labels} {_num(value)}")
+
+    gauge("fleet.balancer.uptime_s", snap["uptime_s"],
+          help_text="balancer uptime in seconds")
+    gauge("fleet.balancer.draining", int(bool(snap["draining"])))
+    gauge("fleet.balancer.tracked_jobs", snap["tracked_jobs"])
+    gauge("fleet.backends_total", fleet["backends_total"],
+          help_text="configured backends")
+    gauge("fleet.backends_healthy", fleet["backends_healthy"],
+          help_text="routable backends (breaker not open, no sdc hold)")
+    gauge("fleet.depth", fleet["fleet_depth"],
+          help_text="queued+running summed over backends with known depth")
+    gauge("fleet.takeovers", fleet["takeovers"],
+          help_text="journal-lease takeovers summed over the fleet")
+    gauge("fleet.takeover_jobs", fleet["takeover_jobs"])
+    # the balancer's own flat counters (routing/transport activity)
+    for dotted, v in sorted(snap["metrics"].items()):
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        lines.append(f"{_prom_name(dotted)} {_num(v)}")
+    # per-backend series, all labelled with the backend address
+    for entry, (b, _, stats, _) in zip(fleet["per_backend"], scrape):
+        label = f'{{backend="{entry["address"]}"}}'
+        gauge("fleet.backend.up", int(entry["routable"]), label)
+        gauge("fleet.backend.breaker_open",
+              int(entry["state"] == "open"), label)
+        gauge("fleet.backend.sdc_hold", int(entry["sdc_hold"]), label)
+        gauge("fleet.backend.audit_divergent",
+              entry["audit_divergent"], label)
+        gauge("fleet.backend.takeovers", entry["takeovers"], label)
+        if entry["depth"] is not None:
+            gauge("fleet.backend.depth", entry["depth"], label)
+        if entry["stats_age_s"] is not None:
+            gauge("fleet.backend.stats_age_s", entry["stats_age_s"], label)
+        if stats is None:
+            continue  # no successful poll yet: nothing cached to re-export
+        for dotted, v in sorted((stats.get("metrics") or {}).items()):
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            lines.append(f"{_prom_name(dotted)}{label} {_num(v)}")
+        for dotted, summ in sorted((stats.get("latency") or {}).items()):
+            if not isinstance(summ, dict):
+                continue
+            name = _prom_name(dotted)
+            addr = entry["address"]
+            for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+                if key in summ:
+                    lines.append(f'{name}{{backend="{addr}",'
+                                 f'quantile="{q}"}} {_num(summ[key])}')
+            if "count" in summ:
+                lines.append(f"{name}_count{label} {_num(summ['count'])}")
+            if "sum" in summ:
+                lines.append(f"{name}_sum{label} {_num(summ['sum'])}")
+    return "\n".join(lines) + "\n"
+
+
+def render_fleet_healthz(balancer: Balancer) -> tuple:
+    """``(http_status, body_dict)`` for the balancer's ``/healthz``: 200
+    while at least one backend is routable and the balancer is not
+    draining, 503 otherwise (an upstream LB can eject the front end)."""
+    scrape = balancer.backend_scrape()
+    routable = sum(1 for _, snap, _, _ in scrape
+                   if snap["state"] != "open" and not snap.get("sdc_hold"))
+    healthy = routable > 0 and not balancer.draining
+    body = {
+        "status": "ok" if healthy else "degraded",
+        "draining": balancer.draining,
+        "backends_total": len(scrape),
+        "backends_healthy": routable,
+        "uptime_s": round(time.time() - balancer.started_unix, 1),
+    }
+    return (200 if healthy else 503), body

@@ -193,7 +193,8 @@ class ServeClient:
 
     def submit(self, argv, priority: str = protocol.DEFAULT_PRIORITY,
                argv0: str = None, tag: str = None, trace: bool = False,
-               dedupe: str = None, client: str = None) -> dict:
+               dedupe: str = None, client: str = None,
+               traceparent: str = None) -> dict:
         """Submit a command; returns the accepted job record. An admission
         rejection (queue full / draining / over quota) raises ServeError
         with the daemon's reason; a resource-pressure shed raises
@@ -204,18 +205,42 @@ class ServeClient:
         retried (the daemon may already have admitted it). ``client``:
         submitter identity for the daemon's per-client admission quota
         (serve --max-per-client); anonymous submits are never quota-limited.
-        """
+
+        Trace context: every submit carries a W3C-style ``traceparent``
+        (minted here unless the caller provides one) plus its send wall
+        time, so fleet-routed jobs are causally linkable end to end; old
+        daemons ignore both fields (docs/observability.md). The minted
+        context is recorded on the returned record under ``traceparent``
+        and — when this process is itself tracing — as a ``serve.submit``
+        span tagged with the ids, so a client-side trace file merges
+        under the same trace-id as the balancer's and the backend's."""
+        from ..observe import trace as trace_mod
+
+        if traceparent is None:
+            trace_id = trace_mod.mint_trace_id()
+            span_id = trace_mod.mint_span_id()
+            traceparent = trace_mod.format_traceparent(trace_id, span_id)
+        else:
+            parsed = trace_mod.parse_traceparent(traceparent)
+            trace_id, span_id = parsed if parsed else (None, None)
         req = {"v": protocol.PROTOCOL_VERSION, "op": "submit",
                "argv": list(argv), "priority": priority,
                "argv0": argv0 if argv0 is not None else sys.argv[0],
-               "trace": bool(trace)}
+               "trace": bool(trace), "traceparent": traceparent,
+               "sent_unix": round(time.time(), 6)}
         if tag is not None:
             req["tag"] = tag
         if dedupe is not None:
             req["dedupe"] = dedupe
         if client is not None:
             req["client"] = client
-        return self._checked(req, retry=dedupe is not None)["job"]
+        if trace_id is not None:
+            trace_mod.set_trace_context(trace_id=trace_id,
+                                        process_label="client")
+        with trace_mod.span("serve.submit", trace_id=trace_id,
+                            span_id=span_id):
+            job = self._checked(req, retry=dedupe is not None)["job"]
+        return job
 
     def status(self, job_id: str = None, timeout: float = None) -> dict:
         req = {"v": protocol.PROTOCOL_VERSION, "op": "status"}

@@ -71,6 +71,32 @@ def _drain_device_feeder(timeout: float = 30.0):
         log.warning("device feeder did not drain within %.0fs", timeout)
 
 
+def _clean_traceparent(value):
+    """The traceparent to keep on a job record: the well-formed original,
+    or None. Malformed context is IGNORED, never a rejection — telemetry
+    garnish must not be able to fail a submission (protocol docstring)."""
+    from ..observe.trace import parse_traceparent
+
+    return value if parse_traceparent(value) is not None else None
+
+
+def _clean_hops(req: dict):
+    """Upstream hop timestamps from a submit frame, type-checked.
+
+    Non-numeric (or absent) values are dropped per the same
+    malformed-ignored contract as the traceparent. Returns None when no
+    usable timestamp survives, so untraced submits keep a None field."""
+    hops = {}
+    for wire, key in (("sent_unix", "client_sent_unix"),
+                      ("bal_recv_unix", "balancer_recv_unix"),
+                      ("bal_sent_unix", "balancer_sent_unix")):
+        v = req.get(wire)
+        if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                and v > 0:
+            hops[key] = float(v)
+    return hops or None
+
+
 def _governor_pressure():
     """The resource governor's admission verdict (None = admit).
 
@@ -201,18 +227,33 @@ class JobService:
     def _execute(self, job) -> int:
         """Run one job in-process; never raises (outcome on the record)."""
         from ..cli import main as cli_main
-        from ..observe.scope import command_argv
+        from ..observe.scope import command_argv, job_context
+        from ..observe.trace import parse_traceparent
         from ..utils import faults
 
         log.info("serve: job %s starting: %s", job.id, " ".join(job.argv))
         t0 = time.monotonic()
+        parsed = parse_traceparent(job.traceparent)
+        hops = dict(job.hops or {})
+        # the daemon-side lifecycle timestamps complete the hop chain the
+        # client/balancer started: the job's run report can then attribute
+        # queue wait without a round trip back to the registry
+        hops["admitted_unix"] = job.submitted_unix
+        hops["started_unix"] = job.started_unix
         try:
             # chaos point: serve.dispatch:raise proves a failed job reports
             # `failed` with a diagnostic while the daemon keeps serving
             faults.fire("serve.dispatch")
             # provenance override: outputs record the CLIENT's command line,
-            # making daemon runs byte-identical to standalone ones
-            with command_argv([job.argv0] + job.argv):
+            # making daemon runs byte-identical to standalone ones; the job
+            # context hands the propagated trace ids + hop timestamps into
+            # the telemetry scope cli.main builds for this job
+            with job_context(
+                    job_id=job.id,
+                    trace_id=parsed[0] if parsed else None,
+                    parent_span_id=parsed[1] if parsed else None,
+                    hops=hops), \
+                    command_argv([job.argv0] + job.argv):
                 rc = cli_main(self._job_argv(job))
         except BaseException as e:  # noqa: BLE001 - job outcome, not crash
             self.registry.mark_failed(job, f"{type(e).__name__}: {e}")
@@ -311,7 +352,10 @@ class JobService:
         it). Returns 1 when a job was requeued for execution."""
         job = Job(rec["id"], rec["argv"], rec["priority"],
                   argv0=rec["argv0"], tag=rec["tag"],
-                  trace=rec["trace"], client=rec.get("client"))
+                  trace=rec["trace"], client=rec.get("client"),
+                  traceparent=_clean_traceparent(rec.get("traceparent")),
+                  hops=rec.get("hops") if isinstance(rec.get("hops"), dict)
+                  else None)
         if rec.get("submitted_unix"):
             job.submitted_unix = rec["submitted_unix"]
         terminal = rec["state"] in TERMINAL
@@ -627,7 +671,9 @@ class JobService:
                     req.get("priority", protocol.DEFAULT_PRIORITY),
                     argv0=req.get("argv0"), tag=req.get("tag"),
                     trace=bool(req.get("trace")),
-                    client=req.get("client"))
+                    client=req.get("client"),
+                    traceparent=_clean_traceparent(req.get("traceparent")),
+                    hops=_clean_hops(req))
                 if dedupe:
                     self._dedupe[dedupe] = job.id
             # journal BEFORE admission: a crash between the two requeues a

@@ -39,8 +39,11 @@ log = logging.getLogger("fgumi_tpu")
 #: nothing was audited) — the balancer ejects a backend whose ``audit``
 #: reports ``divergent > 0``. v4 added the ``coalesce`` section
 #: (cross-job dispatch coalescer scoreboard, ops/coalesce.py; None while
-#: the merge window never armed and merged nothing).
-STATS_SCHEMA_VERSION = 4
+#: the merge window never armed and merged nothing). v5 added the
+#: ``device_memory`` section (live accelerator memory summed over local
+#: devices — bytes_in_use/peak_bytes from jax memory_stats(); None on
+#: CPU backends, which report no memory stats).
+STATS_SCHEMA_VERSION = 5
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -60,8 +63,10 @@ def service_stats(service) -> dict:
     in this process are ``None`` (e.g. ``device`` before the first kernel
     import), so clients can rely on the shape."""
     from ..observe.flight import (audit_snapshot, breaker_snapshot,
-                                  coalesce_snapshot, governor_snapshot,
-                                  live_device_stats, router_snapshot)
+                                  coalesce_snapshot,
+                                  device_memory_snapshot,
+                                  governor_snapshot, live_device_stats,
+                                  router_snapshot)
     from ..observe.metrics import METRICS
 
     stats = live_device_stats()
@@ -79,6 +84,7 @@ def service_stats(service) -> dict:
         "metrics": METRICS.snapshot(),
         "latency": METRICS.summaries(),
         "device": stats.snapshot() if stats is not None else None,
+        "device_memory": device_memory_snapshot(),
         "breaker": breaker_snapshot(),
         "governor": governor_snapshot(),
         "monitor": _monitor_section(service),
@@ -162,6 +168,13 @@ def render_prometheus(service) -> str:
         for key, v in stats["device"].items():
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 gauge(f"device.{key}", v)
+    if stats["device_memory"] is not None:
+        # live accelerator memory (absent on CPU backends)
+        gauge("device.memory.bytes_in_use",
+              stats["device_memory"]["bytes_in_use"],
+              "live accelerator bytes in use, summed over local devices")
+        gauge("device.memory.peak_bytes",
+              stats["device_memory"]["peak_bytes"])
     if stats["audit"] is not None:
         # the silent-corruption scoreboard a fleet balancer ejects on:
         # daemon-lifetime counters straight from the sentinel (the flat
@@ -245,11 +258,21 @@ class IntrospectionServer:
     """Loopback HTTP listener for ``/metrics`` + ``/healthz``.
 
     ``port=0`` binds an ephemeral port (tests); :attr:`port` reports the
-    bound one. Runs on one daemon thread; ``stop()`` joins it."""
+    bound one. Runs on one daemon thread; ``stop()`` joins it.
 
-    def __init__(self, service, port: int, host: str = "127.0.0.1"):
+    The renderers are pluggable so the fleet balancer can reuse the
+    listener with its own surfaces (``serve.balancer``): ``metrics_fn``
+    returns the ``/metrics`` text body, ``healthz_fn`` returns
+    ``(http_status, body_dict)``. Defaults are the daemon renderers
+    bound to ``service``."""
+
+    def __init__(self, service, port: int, host: str = "127.0.0.1",
+                 metrics_fn=None, healthz_fn=None):
         self.service = service
         self.host = host
+        self._metrics_fn = metrics_fn or \
+            (lambda: render_prometheus(service))
+        self._healthz_fn = healthz_fn or (lambda: render_healthz(service))
         self._requested_port = int(port)
         self._httpd = None
         self._thread = None
@@ -278,7 +301,7 @@ class IntrospectionServer:
     def _build_server(self):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-        service = self.service
+        metrics_fn, healthz_fn = self._metrics_fn, self._healthz_fn
 
         class _Handler(BaseHTTPRequestHandler):
             # the metrics port is an operator surface, not a log source
@@ -288,11 +311,11 @@ class IntrospectionServer:
             def do_GET(self):
                 try:
                     if self.path.split("?", 1)[0] == "/metrics":
-                        body = render_prometheus(service).encode()
+                        body = metrics_fn().encode()
                         ctype = "text/plain; version=0.0.4; charset=utf-8"
                         status = 200
                     elif self.path.split("?", 1)[0] == "/healthz":
-                        status, obj = render_healthz(service)
+                        status, obj = healthz_fn()
                         body = (json.dumps(obj, sort_keys=True) + "\n") \
                             .encode()
                         ctype = "application/json"

@@ -37,10 +37,11 @@ class Job:
     __slots__ = ("id", "argv", "argv0", "priority", "tag", "trace",
                  "client", "state", "submitted_unix", "started_unix",
                  "finished_unix", "exit_status", "error", "report_path",
-                 "trace_path")
+                 "trace_path", "traceparent", "hops")
 
     def __init__(self, job_id: str, argv, priority: str, argv0: str = None,
-                 tag: str = None, trace: bool = False, client: str = None):
+                 tag: str = None, trace: bool = False, client: str = None,
+                 traceparent: str = None, hops: dict = None):
         self.id = job_id
         self.argv = list(argv)
         self.argv0 = argv0 or "fgumi-tpu"
@@ -50,6 +51,14 @@ class Job:
         #: submitter identity for per-client admission quotas (protocol
         #: "client" field; None = anonymous, never quota-limited)
         self.client = client
+        #: propagated W3C-style trace context (already validated by the
+        #: daemon — malformed values were dropped at parse, so this is
+        #: either a well-formed traceparent string or None)
+        self.traceparent = traceparent
+        #: upstream hop wall-clock timestamps for end-to-end latency
+        #: attribution (client_sent_unix / balancer_recv_unix /
+        #: balancer_sent_unix as propagated; None when the client sent none)
+        self.hops = dict(hops) if hops else None
         self.state = "queued"
         self.submitted_unix = time.time()
         self.started_unix = None
@@ -77,6 +86,7 @@ class Job:
             "error": self.error,
             "report_path": self.report_path,
             "trace_path": self.trace_path,
+            "traceparent": self.traceparent,
         }
 
 
@@ -108,10 +118,12 @@ class JobRegistry:
 
     def create(self, argv, priority: str, argv0: str = None,
                tag: str = None, trace: bool = False,
-               client: str = None) -> Job:
+               client: str = None, traceparent: str = None,
+               hops: dict = None) -> Job:
         with self._lock:
             job = Job(f"{self._id_prefix}j-{self._next_id}", argv, priority,
-                      argv0=argv0, tag=tag, trace=trace, client=client)
+                      argv0=argv0, tag=tag, trace=trace, client=client,
+                      traceparent=traceparent, hops=hops)
             self._next_id += 1
             self._jobs[job.id] = job
             self._order.append(job.id)
@@ -227,6 +239,29 @@ class JobRegistry:
             if job.submitted_unix:
                 METRICS.observe("serve.job.total_s",
                                 job.finished_unix - job.submitted_unix)
+            # end-to-end decomposition from the propagated hop timestamps
+            # (present only when the client sent them; all clamped >= 0 —
+            # host clock skew must not poison a histogram with negatives).
+            # serve.job.e2e.submit_to_done_s is the fleet's
+            # "p99 submit-to-bytes-published" series: client send wall to
+            # job terminal, spanning every hop in between.
+            hops = job.hops or {}
+            cs = hops.get("client_sent_unix")
+            br = hops.get("balancer_recv_unix")
+            bs = hops.get("balancer_sent_unix")
+            if cs and br:
+                METRICS.observe("serve.job.e2e.client_to_balancer_s",
+                                max(br - cs, 0.0))
+            if bs and job.submitted_unix:
+                METRICS.observe("serve.job.e2e.balancer_to_admit_s",
+                                max(job.submitted_unix - bs, 0.0))
+            elif cs and not bs and job.submitted_unix:
+                # direct submit (no balancer hop): one client->admit leg
+                METRICS.observe("serve.job.e2e.client_to_admit_s",
+                                max(job.submitted_unix - cs, 0.0))
+            if cs:
+                METRICS.observe("serve.job.e2e.submit_to_done_s",
+                                max(job.finished_unix - cs, 0.0))
 
     def mark_running(self, job: Job):
         self._transition(job, "running")

@@ -9,7 +9,9 @@ acted on, schema-versioned like the wire protocol and the run report:
     {"v": 1, "ev": "submit", "t": <unix>, "id": "j-3", "argv": [...],
      "priority": "normal", "argv0": "fgumi-tpu", "tag": null,
      "trace": false, "dedupe": "<idempotency key or null>",
-     "client": "<submitter id or null>"}
+     "client": "<submitter id or null>",
+     "traceparent": "<propagated trace context or null>",
+     "hops": {"client_sent_unix": ...} | null}
     {"v": 1, "ev": "state", "t": <unix>, "id": "j-3",
      "state": "running" | "done" | "failed" | "cancelled" | "requeued",
      "exit_status": <int or null>, "error": "<diagnostic or null>"}
@@ -129,6 +131,10 @@ def _fold(out: ReplayResult, rec: dict):
             "trace": bool(rec.get("trace")),
             "dedupe": rec.get("dedupe"),
             "client": rec.get("client"),
+            # trace context survives restart AND fleet takeover: the job
+            # keeps its client-visible correlation ids wherever it lands
+            "traceparent": rec.get("traceparent"),
+            "hops": rec.get("hops"),
             "state": "queued",
             "exit_status": None,
             "error": None,
@@ -188,7 +194,8 @@ class JobJournal:
         self._append({"ev": "submit", "id": job.id, "argv": job.argv,
                       "priority": job.priority, "argv0": job.argv0,
                       "tag": job.tag, "trace": job.trace, "dedupe": dedupe,
-                      "client": job.client})
+                      "client": job.client, "traceparent": job.traceparent,
+                      "hops": job.hops})
 
     def record_state(self, job: Job):
         self._append({"ev": "state", "id": job.id, "state": job.state,

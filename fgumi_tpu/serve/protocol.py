@@ -12,7 +12,11 @@ Requests::
     {"v": 1, "op": "submit", "argv": ["simplex", "-i", ...],
      "priority": "normal", "argv0": "fgumi-tpu", "trace": false,
      "tag": "optional-label", "dedupe": "optional-idempotency-key",
-     "client": "optional-submitter-id"}
+     "client": "optional-submitter-id",
+     "traceparent": "00-<32hex>-<16hex>-01",   # optional trace context
+     "sent_unix": 1723.4,                      # client send wall time
+     "bal_recv_unix": 1723.5,                  # stamped by a balancer
+     "bal_sent_unix": 1723.5}                  # forward hop
     {"v": 1, "op": "status"}           # all jobs
     {"v": 1, "op": "status", "id": "j-3"}
     {"v": 1, "op": "cancel", "id": "j-3"}
@@ -47,6 +51,16 @@ Malformed frames (bad JSON, not an object, unknown op, missing fields) get
 an error response; oversized frames (> ``max_frame_bytes``, default 1 MiB)
 get an error response and the connection is closed — the daemon must never
 buffer unbounded garbage from a confused client.
+
+Version negotiation for the observability fields: ``traceparent`` and the
+hop timestamps are OPTIONAL submit fields under the same ``v: 1`` schema,
+because :func:`validate_request` deliberately ignores submit fields it
+does not know — an old daemon receiving them executes the job exactly as
+before (the context is garnish), and a new daemon receiving a frame
+without them runs untraced. A *malformed* traceparent (wrong shape,
+non-hex, all-zero ids) or a non-numeric hop timestamp is likewise IGNORED
+— dropped at parse, never a rejection — so telemetry can never fail a
+submission (docs/observability.md "Fleet tracing & attribution").
 """
 
 import json

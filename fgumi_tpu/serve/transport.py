@@ -36,6 +36,7 @@ import logging
 import os
 import socket
 import threading
+import time
 
 from . import protocol
 
@@ -533,7 +534,10 @@ class FrameServer:
 def hello_response(tool: str, expected_token: str, req: dict) -> dict:
     """Answer one hello frame. With a configured token, the frame's token
     must match (constant-time compare); without one the listener is open
-    and any hello is acknowledged."""
+    and any hello is acknowledged. ``server_unix`` (the server's wall
+    clock at answer time) rides along so the client can estimate the
+    host clock offset — ``fgumi-tpu trace-merge`` uses the estimate to
+    align per-host trace timelines; old clients simply ignore it."""
     import hmac
 
     token = req.get("token")
@@ -543,30 +547,60 @@ def hello_response(tool: str, expected_token: str, req: dict) -> dict:
             return protocol.error_response(
                 "invalid handshake token")
         return protocol.ok_response(tool=tool, pid=os.getpid(),
-                                    auth="token")
-    return protocol.ok_response(tool=tool, pid=os.getpid(), auth="open")
+                                    auth="token",
+                                    server_unix=round(time.time(), 6))
+    return protocol.ok_response(tool=tool, pid=os.getpid(), auth="open",
+                                server_unix=round(time.time(), 6))
+
+
+def clock_offset_estimate(hello_resp: dict, t_send: float,
+                          t_recv: float):
+    """Estimated ``local_clock - server_clock`` seconds from one
+    handshake round trip: the server stamped ``server_unix`` mid-trip, so
+    comparing it against the local midpoint bounds the skew by half the
+    RTT — plenty for aligning trace timelines (milliseconds matter,
+    microseconds don't). None when the server predates the field."""
+    server_unix = hello_resp.get("server_unix")
+    if not isinstance(server_unix, (int, float)) \
+            or isinstance(server_unix, bool):
+        return None
+    return round((t_send + t_recv) / 2.0 - float(server_unix), 6)
 
 
 def client_hello(stream, conn, token: str,
                  max_frame_bytes: int = protocol.MAX_FRAME_BYTES):
     """Client side of the handshake: send hello, require an ok answer.
     Returns the response; raises ``protocol.ProtocolError`` on a refusal
-    so the caller can surface the daemon's reason verbatim."""
+    so the caller can surface the daemon's reason verbatim. The response
+    carries ``clock_offset_s`` (local minus server wall clock, estimated
+    from the round trip) when the server stamps ``server_unix``."""
+    t_send = time.time()
     conn.sendall(protocol.encode_frame(
         {"v": protocol.PROTOCOL_VERSION, "op": "hello", "token": token}))
     resp = protocol.read_frame(stream, max_frame_bytes)
+    t_recv = time.time()
     if resp is None:
         raise protocol.ProtocolError(
             "connection closed during the handshake")
     if not resp.get("ok"):
         raise protocol.ProtocolError(
             f"handshake rejected: {resp.get('error', 'no reason given')}")
+    offset = clock_offset_estimate(resp, t_send, t_recv)
+    if offset is not None:
+        resp["clock_offset_s"] = offset
+        from ..observe import trace as trace_mod
+
+        # stamp the estimate onto the active tracer (if any): its export
+        # then carries clock.offset_estimate_s and trace-merge aligns
+        # this host's timeline onto the server's clock automatically
+        trace_mod.set_clock_offset(offset)
     return resp
 
 
 __all__ = [
     "DEFAULT_CONN_CAP", "DEFAULT_IO_TIMEOUT_S", "FrameServer", "Listener",
     "RetryPolicy", "SocketBusy", "TcpListener", "TOKEN_ENV", "UnixListener",
-    "claim_unix_socket", "client_hello", "connect", "format_address",
-    "hello_response", "is_loopback", "load_token", "parse_address",
+    "claim_unix_socket", "client_hello", "clock_offset_estimate",
+    "connect", "format_address", "hello_response", "is_loopback",
+    "load_token", "parse_address",
 ]

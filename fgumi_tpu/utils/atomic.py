@@ -27,6 +27,7 @@ import glob
 import itertools
 import logging
 import os
+import time as _time
 
 log = logging.getLogger("fgumi_tpu")
 
@@ -163,6 +164,7 @@ class AtomicOutputFile:
     def commit(self):
         if self._done:
             return
+        t0 = _time.monotonic()
         try:
             self._f.flush()
             try:
@@ -187,6 +189,14 @@ class AtomicOutputFile:
             raise
         self._done = True
         _fsync_dir(os.path.dirname(self.name) or ".")
+        # the run report's latency decomposition charges flush+fsync+rename
+        # time to its "commit" component (io.commit_s histogram sum)
+        try:
+            from ..observe.metrics import METRICS
+
+            METRICS.observe("io.commit_s", _time.monotonic() - t0)
+        except Exception:  # noqa: BLE001 - telemetry never fails a commit
+            pass
 
     def discard(self):
         """Abandon the output: close and remove the temp file."""

@@ -503,3 +503,120 @@ def test_stats_without_audit_section_is_not_held(fleet):
     bal.poll_backends_once()
     assert not victim.sdc_hold
     assert victim.breaker.state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# fleet observability (ISSUE 17): trace-context hop stamping, the
+# fleet_metrics stats section, and the aggregated /metrics endpoint
+
+
+def test_stamp_submit_rewrites_traceparent_and_stamps_hop():
+    from fgumi_tpu.observe.trace import (format_traceparent,
+                                         parse_traceparent)
+
+    tp = format_traceparent("a" * 32, "b" * 16)
+    req = {"v": 1, "op": "submit", "argv": ["sort"], "traceparent": tp,
+           "sent_unix": 1.0}
+    out, hop = Balancer._stamp_submit(req)
+    assert "traceparent" not in req or req["traceparent"] == tp  # untouched
+    assert out["bal_recv_unix"] > 0
+    trace_id, parent_span, hop_span = hop
+    assert trace_id == "a" * 32 and parent_span == "b" * 16
+    # same trace, new parent: the hop keeps the chain causally linked
+    assert parse_traceparent(out["traceparent"]) == (trace_id, hop_span)
+    assert hop_span != parent_span
+
+
+def test_stamp_submit_drops_malformed_traceparent():
+    req = {"v": 1, "op": "submit", "argv": ["sort"],
+           "traceparent": "zz-garbage"}
+    out, hop = Balancer._stamp_submit(req)
+    assert hop is None and "traceparent" not in out
+    assert out["bal_recv_unix"] > 0
+
+
+def test_routed_submit_carries_hop_stamps_to_the_backend(fleet):
+    from fgumi_tpu.observe.trace import format_traceparent
+
+    bal, (a, b) = fleet
+    bal.poll_backends_once()
+    tp = format_traceparent("c" * 32, "d" * 16)
+    resp = bal.handle_request({"v": 1, "op": "submit", "argv": ["sort"],
+                               "traceparent": tp, "sent_unix": time.time()})
+    assert resp["ok"]
+    job = (a.registry.get(resp["job"]["id"])
+           or b.registry.get(resp["job"]["id"]))
+    # the backend stored the REWRITTEN traceparent (same trace id) and
+    # the full hop timestamp set for end-to-end attribution
+    assert job.traceparent.split("-")[1] == "c" * 32
+    assert job.traceparent != tp
+    assert set(job.hops) >= {"client_sent_unix", "balancer_recv_unix",
+                             "balancer_sent_unix"}
+
+
+def test_stats_snapshot_v2_fleet_metrics_section(fleet):
+    bal, (a, b) = fleet
+    a.handle_request({"v": 1, "op": "submit", "argv": ["sort"]})
+    bal.poll_backends_once()
+    snap = bal.stats_snapshot()
+    assert snap["schema_version"] == 2
+    fm = snap["fleet_metrics"]
+    assert fm["backends_total"] == 2 and fm["backends_healthy"] == 2
+    assert fm["fleet_depth"] == 1
+    assert fm["fleet_depth_known_backends"] == 2
+    addrs = [e["address"] for e in fm["per_backend"]]
+    assert addrs == [x.address for x in bal.backends]
+    for entry in fm["per_backend"]:
+        assert entry["routable"] is True
+        assert entry["stats_age_s"] is not None  # the poll cached stats
+
+
+def test_metrics_endpoint_same_snapshot_as_stats_op(tmp_path):
+    import urllib.request
+
+    svcs = []
+    for name in ("a", "b"):
+        svc = JobService(str(tmp_path / f"m{name}.sock"), workers=1,
+                         queue_limit=8)
+        svc.start_transport()
+        svcs.append(svc)
+    bal = Balancer(f"unix:{tmp_path}/mfront.sock",
+                   [f"unix:{s.socket_path}" for s in svcs],
+                   poll_period_s=0.1, metrics_port=0)
+    try:
+        bal.bind()
+        bal.poll_backends_once()
+        port = bal.metrics_port
+        assert port  # ephemeral bind resolved
+        bal._metrics.start()
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        assert "fgumi_tpu_fleet_backends_total 2" in body
+        assert "fgumi_tpu_fleet_backends_healthy 2" in body
+        # one labeled up-series per backend, daemon series re-exported
+        # under the backend label
+        for s in svcs:
+            label = f'backend="unix:{s.socket_path}"'
+            assert f"fgumi_tpu_fleet_backend_up{{{label}}} 1" in body
+            assert f"fgumi_tpu_fleet_backend_depth{{{label}}} 0" in body
+        # the stats op agrees with the scrape (same cache, same rule)
+        fm = bal.stats_snapshot()["fleet_metrics"]
+        assert fm["backends_total"] == 2 and fm["backends_healthy"] == 2
+        code, health = balancer_mod.render_fleet_healthz(bal)
+        assert code == 200 and health["status"] == "ok"
+        assert health["backends_healthy"] == 2
+    finally:
+        bal.close()
+        for s in svcs:
+            s.close()
+
+
+def test_healthz_503_when_no_routable_backend(fleet):
+    bal, (a, b) = fleet
+    for backend in bal.backends:
+        backend.breaker.record_failure("dead")
+        backend.breaker.record_failure("dead")
+    code, body = balancer_mod.render_fleet_healthz(bal)
+    assert code == 503 and body["status"] == "degraded"
+    assert body["backends_healthy"] == 0

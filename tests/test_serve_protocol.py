@@ -79,9 +79,9 @@ def tcp_service(tmp_path):
 #: stats sections whose content depends on what the surrounding process
 #: has imported/measured (they normalize to null in the golden; their real
 #: content is covered by test_stats_op_live_sections below)
-_VOLATILE_STATS_SECTIONS = ("metrics", "latency", "device", "breaker",
-                            "governor", "router", "monitor", "audit",
-                            "coalesce")
+_VOLATILE_STATS_SECTIONS = ("metrics", "latency", "device", "device_memory",
+                            "breaker", "governor", "router", "monitor",
+                            "audit", "coalesce")
 
 
 def _normalize(obj):

@@ -1,0 +1,366 @@
+"""Fleet observability units (ISSUE 17): traceparent parse/format, tracer
+trace-context export, handshake clock-offset estimation, trace-merge clock
+alignment, report-v5 latency attribution, and flight-dump job stamping."""
+
+import json
+import os
+
+import pytest
+
+from fgumi_tpu.observe import trace as trace_mod
+from fgumi_tpu.observe.report import (SCHEMA_VERSION, build_report,
+                                      validate_report)
+from fgumi_tpu.observe.scope import TelemetryScope, scoped_telemetry
+from fgumi_tpu.observe.trace import (format_traceparent, mint_span_id,
+                                     mint_trace_id, parse_traceparent)
+from fgumi_tpu.observe.trace_merge import (MergeError, merge_traces,
+                                           parse_shift_specs, write_merged)
+from fgumi_tpu.serve.transport import clock_offset_estimate
+
+# ---------------------------------------------------------------------------
+# traceparent wire format
+
+
+def test_traceparent_round_trip():
+    tid, sid = mint_trace_id(), mint_span_id()
+    assert len(tid) == 32 and len(sid) == 16
+    assert parse_traceparent(format_traceparent(tid, sid)) == (tid, sid)
+
+
+def test_traceparent_future_version_accepted():
+    # unknown (non-ff) versions parse: the id fields are what matter
+    assert parse_traceparent(
+        "01-" + "a" * 32 + "-" + "b" * 16 + "-00") == ("a" * 32, "b" * 16)
+
+
+@pytest.mark.parametrize("bad", [
+    None,
+    17,
+    "",
+    "not a traceparent",
+    "00-" + "a" * 31 + "-" + "b" * 16 + "-01",   # short trace id
+    "00-" + "a" * 32 + "-" + "b" * 15 + "-01",   # short span id
+    "00-" + "g" * 32 + "-" + "b" * 16 + "-01",   # non-hex trace id
+    "00-" + "0" * 32 + "-" + "b" * 16 + "-01",   # all-zero trace id
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",   # all-zero span id
+    "ff-" + "a" * 32 + "-" + "b" * 16 + "-01",   # forbidden version
+    "00-" + "a" * 32 + "-" + "b" * 16,           # missing flags
+    "00-" + "a" * 32 + "-" + "b" * 16 + "-01-x",  # extra field
+])
+def test_traceparent_malformed_is_none(bad):
+    assert parse_traceparent(bad) is None
+
+
+# ---------------------------------------------------------------------------
+# tracer export carries the fleet context + clock anchor
+
+
+def test_tracer_export_carries_context_anchor_and_offset():
+    t = trace_mod._Tracer(max_events=100)
+    t.set_context(trace_id="a" * 32, parent_span_id="b" * 16,
+                  process_label="backend j-1")
+    t.clock_offset_s = 0.125
+    obj = t.to_json_obj()
+    other = obj["otherData"]
+    assert other["trace_context"] == {"trace_id": "a" * 32,
+                                      "parent_span_id": "b" * 16}
+    assert other["clock"]["offset_estimate_s"] == 0.125
+    assert isinstance(other["clock"]["t_zero_unix"], float)
+    assert other["process"]["label"] == "backend j-1"
+    # the pid's track group is labelled for the merged view
+    meta = [e for e in obj["traceEvents"] if e.get("ph") == "M"
+            and e["name"] == "process_name"]
+    assert meta and meta[0]["args"]["name"] == "backend j-1"
+
+
+def test_context_setters_are_noops_when_tracing_off():
+    assert trace_mod.tracing_enabled() is False
+    trace_mod.set_trace_context(trace_id="a" * 32)  # must not raise
+    trace_mod.set_clock_offset(1.5)
+
+
+# ---------------------------------------------------------------------------
+# handshake clock-offset estimate
+
+
+def test_clock_offset_estimate_midpoint():
+    # server clock == midpoint of the round trip: zero estimated skew
+    assert clock_offset_estimate({"server_unix": 100.5}, 100.0, 101.0) == 0.0
+    # server 2s behind the local clock
+    assert clock_offset_estimate({"server_unix": 98.5}, 100.0, 101.0) == 2.0
+
+
+def test_clock_offset_estimate_absent_or_garbage_is_none():
+    assert clock_offset_estimate({}, 1.0, 2.0) is None
+    assert clock_offset_estimate({"server_unix": "soon"}, 1.0, 2.0) is None
+
+
+# ---------------------------------------------------------------------------
+# trace-merge clock alignment
+
+
+def _trace_file(tmp_path, name, anchor, events, offset=None, trace_id=None,
+                label=None, pid=1000):
+    obj = {"traceEvents": events, "displayTimeUnit": "ms"}
+    clock = {"t_zero_unix": anchor}
+    if offset is not None:
+        clock["offset_estimate_s"] = offset
+    other = {"clock": clock, "process": {"pid": pid, "label": label}}
+    if trace_id:
+        other["trace_context"] = {"trace_id": trace_id,
+                                  "parent_span_id": None}
+    obj["otherData"] = other
+    path = str(tmp_path / name)
+    json.dump(obj, open(path, "w"))
+    return path
+
+
+def _span_ev(name, ts, pid=1000):
+    return {"name": name, "ph": "X", "pid": pid, "tid": 1,
+            "ts": ts, "dur": 50.0}
+
+
+def test_merge_aligns_anchors_and_corrects_offset(tmp_path):
+    tid = "c" * 32
+    a = _trace_file(tmp_path, "client.json", 100.0,
+                    [_span_ev("serve.submit", 10.0)], trace_id=tid,
+                    label="client", pid=1000)
+    # backend anchored 0.5s later on a clock the handshake estimated to
+    # run 0.25s AHEAD of the server: corrected anchor = 100.25
+    b = _trace_file(tmp_path, "backend.json", 100.5,
+                    [_span_ev("pipeline.process", 20.0, pid=2000)],
+                    offset=0.25, trace_id=tid, label="backend j-1", pid=2000)
+    merged = merge_traces([a, b])
+    spans = {e["name"]: e for e in merged["traceEvents"]
+             if e.get("ph") == "X"}
+    # client file anchors the reference clock: its ts are unshifted
+    assert spans["serve.submit"]["ts"] == 10.0
+    # backend shifted by (100.5 - 0.25) - 100.0 = 0.25s
+    assert spans["pipeline.process"]["ts"] == 20.0 + 250000.0
+    assert merged["otherData"]["clock"]["t_zero_unix"] == 100.0
+    assert merged["otherData"]["trace_context"] == {"trace_id": tid}
+    shifts = {m["path"]: m["shift_s"]
+              for m in merged["otherData"]["merged_from"]}
+    assert shifts[a] == 0.0 and shifts[b] == 0.25
+
+
+def test_merge_remaps_colliding_pids_and_labels_tracks(tmp_path):
+    tid = "d" * 32
+    a = _trace_file(tmp_path, "one.json", 50.0,
+                    [_span_ev("x", 1.0, pid=77)], trace_id=tid,
+                    label="client", pid=77)
+    b = _trace_file(tmp_path, "two.json", 50.0,
+                    [_span_ev("y", 2.0, pid=77)], trace_id=tid,
+                    label="balancer", pid=77)
+    merged = merge_traces([a, b])
+    pids = {e["name"]: e["pid"] for e in merged["traceEvents"]
+            if e.get("ph") == "X"}
+    assert pids["x"] == 77
+    assert pids["y"] >= 1 << 22  # remapped out of the collision
+    # both files got a process_name track label (synthesized here)
+    labels = {e["pid"]: e["args"]["name"] for e in merged["traceEvents"]
+              if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert labels[77] == "client" and labels[pids["y"]] == "balancer"
+    # metadata events are never time-shifted
+    assert all("ts" not in e for e in merged["traceEvents"]
+               if e.get("ph") == "M")
+
+
+def test_merge_conflicting_trace_ids_need_force_or_filter(tmp_path):
+    a = _trace_file(tmp_path, "a.json", 10.0, [_span_ev("x", 1.0)],
+                    trace_id="a" * 32)
+    b = _trace_file(tmp_path, "b.json", 10.0, [_span_ev("y", 1.0)],
+                    trace_id="b" * 32)
+    with pytest.raises(MergeError, match="multiple trace ids"):
+        merge_traces([a, b])
+    # --trace-id keeps the match and records the skip
+    merged = merge_traces([a, b], trace_id="a" * 32)
+    assert [m["path"] for m in merged["otherData"]["merged_from"]] == [a]
+    assert merged["otherData"]["skipped"][0]["path"] == b
+    # --force keeps them all (no trace_context claim in the merged file)
+    merged = merge_traces([a, b], force=True)
+    assert len(merged["otherData"]["merged_from"]) == 2
+    assert "trace_context" not in merged["otherData"]
+    with pytest.raises(MergeError, match="no input file matches"):
+        merge_traces([a, b], trace_id="f" * 32)
+
+
+def test_merge_user_shift_overrides_and_specs_parse(tmp_path):
+    assert parse_shift_specs(["bal.json=0.25", "x=-1.5"]) \
+        == {"bal.json": 0.25, "x": -1.5}
+    with pytest.raises(MergeError, match="not FILE=SECONDS"):
+        parse_shift_specs(["nonsense"])
+    with pytest.raises(MergeError, match="is not a number"):
+        parse_shift_specs(["f=soon"])
+    tid = "e" * 32
+    a = _trace_file(tmp_path, "a.json", 10.0, [_span_ev("x", 0.0)],
+                    trace_id=tid)
+    b = _trace_file(tmp_path, "b.json", 10.0, [_span_ev("y", 0.0, pid=2)],
+                    trace_id=tid, pid=2)
+    merged = merge_traces([a, b], shifts={"b.json": 0.5})
+    spans = {e["name"]: e["ts"] for e in merged["traceEvents"]
+             if e.get("ph") == "X"}
+    assert spans["x"] == 0.0 and spans["y"] == 500000.0
+
+
+def test_merge_rejects_non_trace_input(tmp_path):
+    bad = str(tmp_path / "not.json")
+    open(bad, "w").write("[1, 2]")
+    with pytest.raises(MergeError, match="not a Chrome trace-event"):
+        merge_traces([bad])
+    with pytest.raises(MergeError, match="no trace files"):
+        merge_traces([])
+
+
+def test_trace_merge_cli_end_to_end(tmp_path):
+    from fgumi_tpu.cli import main as cli_main
+
+    tid = "f" * 32
+    a = _trace_file(tmp_path, "client.json", 5.0, [_span_ev("x", 1.0)],
+                    trace_id=tid, label="client")
+    b = _trace_file(tmp_path, "backend.json", 5.5,
+                    [_span_ev("y", 1.0, pid=2)], trace_id=tid,
+                    label="backend", pid=2)
+    out = str(tmp_path / "merged.json")
+    assert cli_main(["trace-merge", a, b, "-o", out]) == 0
+    merged = json.load(open(out))
+    assert len(merged["otherData"]["merged_from"]) == 2
+    # unusable input is a clean rc=2, not a traceback
+    assert cli_main(["trace-merge", str(tmp_path / "absent.json"),
+                     "-o", out]) == 2
+
+
+def test_write_merged_atomic(tmp_path):
+    out = str(tmp_path / "m.json")
+    write_merged({"traceEvents": []}, out)
+    assert json.load(open(out)) == {"traceEvents": []}
+    assert all(".tmp." not in n for n in os.listdir(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# report v5: trace context + end-to-end latency attribution
+
+
+def _base_report(**extra):
+    report = {"schema_version": SCHEMA_VERSION, "tool": "fgumi-tpu",
+              "command": "sort", "argv": ["sort"], "started_unix": 1.0,
+              "wall_s": 0.5, "exit_status": 0, "pid": 1, "metrics": {}}
+    report.update(extra)
+    return report
+
+
+def test_validate_report_v5_sections_accepted():
+    report = _base_report(
+        trace_context={"trace_id": "a" * 32, "parent_span_id": "b" * 16,
+                       "job_id": "j-1"},
+        latency_decomposition={"total_s": 2.0, "queue_s": 0.5,
+                               "device_s": 1.0, "host_complete_s": 0.5},
+        xla_profile_dir="/tmp/xprof")
+    assert validate_report(report) == []
+
+
+def test_validate_report_v5_flags_bad_sections():
+    errs = validate_report(_base_report(
+        trace_context={"trace_id": 7, "surprise": "x"}))
+    assert any("'trace_id' is not a string" in e for e in errs)
+    assert any("unknown fields ['surprise']" in e for e in errs)
+    errs = validate_report(_base_report(
+        latency_decomposition={"total_s": 1.0, "warp_drive_s": 0.1}))
+    assert any("unknown component 'warp_drive_s'" in e for e in errs)
+    errs = validate_report(_base_report(
+        latency_decomposition={"total_s": 1.0, "queue_s": -0.5}))
+    assert any("non-negative" in e for e in errs)
+    # the attribution invariant: components can never exceed the total
+    errs = validate_report(_base_report(
+        latency_decomposition={"total_s": 1.0, "queue_s": 0.8,
+                               "device_s": 0.8}))
+    assert any("past total_s" in e for e in errs)
+
+
+def test_build_report_attributes_fleet_job_end_to_end():
+    import time
+
+    from fgumi_tpu.observe.metrics import METRICS
+
+    now = time.time()
+    scope = TelemetryScope("job")
+    scope.trace_id, scope.parent_span_id = "a" * 32, "b" * 16
+    scope.job_id = "j-9"
+    scope.hops = {"client_sent_unix": now - 2.0,
+                  "balancer_recv_unix": now - 1.9,
+                  "balancer_sent_unix": now - 1.85,
+                  "admitted_unix": now - 1.8,
+                  "started_unix": now - 1.5}
+    with scoped_telemetry(scope=scope):
+        METRICS.observe("device.dispatch.wall_s", 0.25)
+        METRICS.observe("io.commit_s", 0.01)
+        report = build_report("sort", ["sort"], started_unix=now - 1.5,
+                              wall_s=1.5, exit_status=0)
+    assert validate_report(report) == []
+    assert report["trace_context"] == {"trace_id": "a" * 32,
+                                       "parent_span_id": "b" * 16,
+                                       "job_id": "j-9"}
+    dec = report["latency_decomposition"]
+    # hop legs measured from the propagated wall-clock stamps
+    assert dec["client_to_balancer_s"] == pytest.approx(0.1, abs=0.01)
+    assert dec["balancer_to_admit_s"] == pytest.approx(0.05, abs=0.01)
+    assert dec["queue_s"] == pytest.approx(0.3, abs=0.01)
+    assert dec["device_s"] == pytest.approx(0.25, abs=0.01)
+    assert dec["commit_s"] == pytest.approx(0.01, abs=0.01)
+    # total spans client send -> now; the residual absorbs the rest
+    assert dec["total_s"] == pytest.approx(2.0, abs=0.25)
+    comp = sum(v for k, v in dec.items() if k != "total_s")
+    assert comp <= dec["total_s"] + 0.005
+
+
+def test_build_report_caps_attribution_at_total():
+    import time
+
+    # hop stamps from a skewed client clock claim more time than the
+    # total: capping attributes at most 100%, never fabricates
+    now = time.time()
+    scope = TelemetryScope("job")
+    scope.job_id = "j-2"
+    scope.hops = {"client_sent_unix": now - 0.1,
+                  "admitted_unix": now + 5.0,
+                  "started_unix": now + 6.0}
+    with scoped_telemetry(scope=scope):
+        report = build_report("sort", ["sort"], started_unix=now,
+                              wall_s=0.1, exit_status=0)
+    assert validate_report(report) == []
+    dec = report["latency_decomposition"]
+    comp = sum(v for k, v in dec.items() if k != "total_s")
+    assert comp <= dec["total_s"] + 0.005
+
+
+def test_build_report_no_decomposition_without_hops_or_samples():
+    from fgumi_tpu.observe.metrics import METRICS
+
+    METRICS.reset()
+    report = build_report("sort", ["sort"], started_unix=1.0, wall_s=0.5,
+                          exit_status=0)
+    assert "latency_decomposition" not in report
+    assert "trace_context" not in report
+
+
+# ---------------------------------------------------------------------------
+# flight dumps inside a job scope carry the correlation ids
+
+
+def test_flight_dump_stamps_job_and_trace_id(tmp_path):
+    from fgumi_tpu.observe.flight import FlightRecorder, validate_dump
+
+    rec = FlightRecorder(capacity=16)
+    rec.configure(str(tmp_path))
+    scope = TelemetryScope("job")
+    scope.job_id, scope.trace_id = "j-7", "a" * 32
+    with scoped_telemetry(scope=scope):
+        path = rec.dump("unit-scoped")
+    obj = json.load(open(path))
+    assert validate_dump(obj) == []
+    assert obj["job_id"] == "j-7" and obj["trace_id"] == "a" * 32
+    assert "device_memory" in obj  # None on CPU, present either way
+    # outside any scope: no identity keys at all
+    path = rec.dump("unit-unscoped")
+    obj = json.load(open(path))
+    assert "job_id" not in obj and "trace_id" not in obj

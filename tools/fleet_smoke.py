@@ -23,6 +23,13 @@ Scenarios (exit 0 when every check holds, one PASS/FAIL line each):
 5. Eject -> re-admit: restarting the killed daemon (fresh, its journal
    was consumed) brings its backend closed again through the balancer's
    half-open probes.
+6. Fleet tracing + aggregated metrics (ISSUE 17): a traced submit
+   through the balancer leaves per-process trace files whose
+   `fgumi-tpu trace-merge` stitches into ONE timeline with spans from
+   >=3 processes under one trace-id; the balancer's --metrics-port
+   /metrics endpoint re-exports both backends' labeled series and
+   agrees with the `stats` op's fleet_metrics section; the per-backend
+   end-to-end submit-to-done latency summary is surfaced fleet-side.
 
 Usage:  python tools/fleet_smoke.py [--keep]
 """
@@ -30,12 +37,14 @@ Usage:  python tools/fleet_smoke.py [--keep]
 import argparse
 import json
 import os
+import re
 import shutil
 import socket
 import subprocess
 import sys
 import tempfile
 import time
+import urllib.request
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -198,6 +207,8 @@ def main():
         # --- fleet up: 2 daemons + balancer, all TCP + token -------------
         ports = {"a": free_port(), "b": free_port()}
         front = free_port()
+        metrics_port = free_port()
+        bal_trace = os.path.join(tmp, "balancer_trace.json")
 
         def start_daemon(fid):
             argv = [sys.executable, "-m", "fgumi_tpu", "serve",
@@ -214,13 +225,14 @@ def main():
         procs["a"] = start_daemon("a")
         procs["b"] = start_daemon("b")
         balancer = subprocess.Popen(
-            [sys.executable, "-m", "fgumi_tpu", "balance",
+            [sys.executable, "-m", "fgumi_tpu", "--trace", bal_trace,
+             "balance",
              "--listen", f"tcp:127.0.0.1:{front}",
              "--backend", f"tcp:127.0.0.1:{ports['a']}",
              "--backend", f"tcp:127.0.0.1:{ports['b']}",
              "--token-file", tok, "--poll-period", "0.3",
              "--eject-failures", "2", "--cooldown", "1.0",
-             "--probes", "2"],
+             "--probes", "2", "--metrics-port", str(metrics_port)],
             cwd=tmp, env=BASE_ENV, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, text=True)
         client = ServeClient(f"tcp:127.0.0.1:{front}", timeout=30,
@@ -358,11 +370,115 @@ def main():
                                        timeout=90),
                     json.dumps(backend_states(client)))
 
+        # --- fleet tracing + aggregated metrics (ISSUE 17) ---------------
+        client_trace = os.path.join(tmp, "client_trace.json")
+        before_traces = set(os.listdir(rpt))
+        p = run(["--trace", client_trace, "submit",
+                 "--socket", f"tcp:127.0.0.1:{front}",
+                 "--token-file", tok, "--job-trace", "--",
+                 "simplex", "-i", inp, "-o", "out_traced.bam",
+                 "--min-reads", "1"], cwd=wd_fleet)
+        ok &= check("traced submit through the balancer succeeds",
+                    p.returncode == 0, (p.stdout + p.stderr)[-300:])
+        backend_traces = [n for n in os.listdir(rpt)
+                          if n.endswith(".trace.json")
+                          and n not in before_traces]
+        ok &= check("backend wrote a per-job trace",
+                    len(backend_traces) == 1, ",".join(backend_traces))
+        client_ctx = {}
+        try:
+            client_ctx = json.load(open(client_trace))["otherData"].get(
+                "trace_context") or {}
+        except (OSError, ValueError, KeyError):
+            pass
+        tid = client_ctx.get("trace_id")
+        ok &= check("client trace carries the fleet trace id", bool(tid),
+                    json.dumps(client_ctx))
+        # the traced job's run report carries the v5 end-to-end
+        # attribution: trace context + a decomposition whose components
+        # never sum past the total (capped shares, see observe/report.py)
+        job_report = {}
+        if backend_traces:
+            rpt_name = backend_traces[0].replace(".trace.json",
+                                                 ".report.json")
+            try:
+                job_report = json.load(open(os.path.join(rpt, rpt_name)))
+            except (OSError, ValueError):
+                pass
+        dec = job_report.get("latency_decomposition") or {}
+        comp = sum(v for k, v in dec.items() if k != "total_s")
+        ok &= check("run report carries the fleet latency decomposition",
+                    job_report.get("trace_context", {}).get("trace_id")
+                    == tid and "client_to_balancer_s" in dec
+                    and "queue_s" in dec and "host_complete_s" in dec
+                    and comp <= dec.get("total_s", 0) + 0.005,
+                    json.dumps(dec)[:220])
+        # the balancer cache needs one poll after the job finished before
+        # the e2e summaries appear fleet-side
+        fm = {}
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            fm = client.stats().get("fleet_metrics") or {}
+            if any(e.get("submit_to_done_s")
+                   for e in fm.get("per_backend", [])):
+                break
+            time.sleep(0.3)
+        ok &= check("fleet p99 submit-to-bytes-published surfaced per "
+                    "backend (stats op fleet_metrics)",
+                    any(e.get("submit_to_done_s", {}).get("p99")
+                        is not None for e in fm.get("per_backend", [])),
+                    json.dumps(fm.get("per_backend"))[:200])
+        metrics_body = urllib.request.urlopen(
+            f"http://127.0.0.1:{metrics_port}/metrics",
+            timeout=10).read().decode()
+        for addr in (addr_a, addr_b):
+            ok &= check(f"/metrics exports labeled series for {addr}",
+                        f'fgumi_tpu_fleet_backend_up{{backend="{addr}"}} 1'
+                        in metrics_body)
+        e2e_series = set(re.findall(
+            r'fgumi_tpu_serve_job_e2e_submit_to_done_s\{backend="([^"]+)"',
+            metrics_body))
+        ok &= check("backend e2e latency summaries re-exported on /metrics",
+                    len(e2e_series) >= 1, ",".join(sorted(e2e_series)))
+        ok &= check("/metrics consistent with the stats op "
+                    "(same-snapshot rule)",
+                    fm.get("backends_total") == 2
+                    and f"fgumi_tpu_fleet_backends_total 2" in metrics_body
+                    and f"fgumi_tpu_fleet_backends_healthy "
+                        f"{fm.get('backends_healthy')}" in metrics_body,
+                    json.dumps({k: fm.get(k) for k in
+                                ("backends_total", "backends_healthy")}))
+
         # --- clean shutdown ---------------------------------------------
         client.shutdown()  # drains the balancer
         rc = balancer.wait(timeout=60)
         ok &= check("balancer exits 0 on shutdown", rc == 0, f"rc={rc}")
         balancer = None
+
+        # --- merged fleet timeline (balancer trace flushed on exit) ------
+        merged = os.path.join(tmp, "merged_trace.json")
+        p = run(["trace-merge", client_trace, bal_trace,
+                 os.path.join(rpt, backend_traces[0]), "-o", merged,
+                 "--trace-id", tid or "0" * 32], cwd=tmp)
+        ok &= check("trace-merge stitches the fleet timeline",
+                    p.returncode == 0, (p.stdout + p.stderr)[-300:])
+        try:
+            m = json.load(open(merged))
+        except (OSError, ValueError):
+            m = {"traceEvents": [], "otherData": {}}
+        span_pids = {e["pid"] for e in m["traceEvents"]
+                     if e.get("ph") == "X"}
+        ok &= check("merged trace has spans from >=3 processes",
+                    len(span_pids) >= 3, str(sorted(span_pids)))
+        names = {e["name"] for e in m["traceEvents"] if e.get("ph") == "X"}
+        ok &= check("client, balancer and backend spans all present",
+                    "serve.submit" in names and "serve.forward" in names
+                    and "pipeline.process" in names,
+                    ",".join(sorted(names))[:200])
+        ok &= check("merged under ONE trace id",
+                    m["otherData"].get("trace_context", {}).get("trace_id")
+                    == tid and len(m["otherData"].get("merged_from", []))
+                    == 3, json.dumps(m.get("otherData", {}))[:200])
         for fid, proc in procs.items():
             direct = ServeClient(f"tcp:127.0.0.1:{ports[fid]}",
                                  timeout=30, token=TOKEN)

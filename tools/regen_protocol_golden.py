@@ -1,0 +1,101 @@
+"""Regenerate tests/data/serve_protocol_golden.json against a live daemon.
+
+Drives the checked-in request sequences (unix-socket exchanges and the
+TCP conversations) through a fresh JobService and rewrites each golden
+response with the normalized live answer. Run after an intentional wire
+change, then REVIEW THE DIFF — the golden exists to catch unintentional
+ones.
+
+    PYTHONPATH=. python tools/regen_protocol_golden.py
+"""
+
+import json
+import os
+import socket
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fgumi_tpu.serve import protocol  # noqa: E402
+from fgumi_tpu.serve.daemon import JobService  # noqa: E402
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+GOLDEN = os.path.join(HERE, os.pardir, "tests", "data",
+                      "serve_protocol_golden.json")
+
+# keep in sync with tests/test_serve_protocol.py
+_VOLATILE_STATS_SECTIONS = ("metrics", "latency", "device", "device_memory",
+                            "breaker", "governor", "router", "monitor",
+                            "audit", "coalesce")
+
+
+def _normalize(obj):
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            if k.endswith("_unix") and isinstance(v, (int, float)):
+                out[k] = 0
+            elif k in ("uptime_s", "pid"):
+                out[k] = 0
+            elif k in ("report_path", "trace_path"):
+                out[k] = None
+            elif k in _VOLATILE_STATS_SECTIONS and "schema_version" in obj:
+                out[k] = None
+            else:
+                out[k] = _normalize(v)
+        return out
+    if isinstance(obj, list):
+        return [_normalize(v) for v in obj]
+    return obj
+
+
+def regen_exchanges(golden, tmp):
+    svc = JobService(os.path.join(tmp, "serve.sock"), workers=1,
+                     queue_limit=1, report_dir=None)
+    svc.start_transport()
+    try:
+        conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        conn.settimeout(10)
+        conn.connect(svc.socket_path)
+        stream = conn.makefile("rb")
+        for exchange in golden["exchanges"]:
+            conn.sendall(protocol.encode_frame(exchange["request"]))
+            exchange["response"] = _normalize(protocol.read_frame(stream))
+        conn.close()
+    finally:
+        svc.close()
+
+
+def regen_tcp(golden, tmp):
+    svc = JobService(None, workers=1, queue_limit=1,
+                     tcp=("127.0.0.1", 0), auth_token="golden-secret")
+    svc.start_transport()
+    try:
+        for convo in golden["tcp_conversations"]:
+            conn = socket.create_connection(("127.0.0.1", svc.tcp_port),
+                                            timeout=10)
+            stream = conn.makefile("rb")
+            for exchange in convo["exchanges"]:
+                conn.sendall(protocol.encode_frame(exchange["request"]))
+                exchange["response"] = _normalize(
+                    protocol.read_frame(stream))
+            conn.close()
+    finally:
+        svc.close()
+
+
+def main():
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    with tempfile.TemporaryDirectory() as tmp:
+        regen_exchanges(golden, tmp)
+        regen_tcp(golden, tmp)
+    with open(GOLDEN, "w") as f:
+        json.dump(golden, f, indent=1)
+        f.write("\n")
+    print(f"rewrote {os.path.relpath(GOLDEN)}")
+
+
+if __name__ == "__main__":
+    main()

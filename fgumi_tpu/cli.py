@@ -393,6 +393,46 @@ def _log_filter_stats(stats, label: str):
                  dict(stats.rejection_reasons.most_common()))
 
 
+def _add_shard_opts(p):
+    """Scatter sub-job option group shared by the consensus commands (and
+    forwarded by `pipeline` to its simplex stage): process only shard K of
+    an N-way content-hash split of the grouped input (core/sharding.py;
+    docs/serving.md "Scatter/gather")."""
+    g = p.add_argument_group(
+        "scatter sharding",
+        "run as one shard of a scattered whale job (`balance --scatter` "
+        "plans these): the grouped input streams through a deterministic "
+        "content-hash family filter, and a sidecar manifest records the "
+        "kept families' global ordinals for the byte-deterministic gather "
+        "merge")
+    g.add_argument("--shard", default=None, metavar="K/N",
+                   help="keep only MI families hashing to slot K of an "
+                        "N-way split (0-based; e.g. 1/4)")
+    g.add_argument("--shard-by", choices=["umi", "coord"], default="umi",
+                   help="shard axis: umi = numeric MI value hash, coord = "
+                        "both-ends template position hash (default umi)")
+    g.add_argument("--shard-manifest", default=None, metavar="PATH",
+                   help="write the kept-family (ordinal, MI) manifest "
+                        "sidecar here (required by the gather stage)")
+    g.add_argument("--pg-argv", default=None, metavar="CMDLINE",
+                   help="record THIS command line (shlex-quoted) in output "
+                        "provenance (@PG CL) instead of the actual argv, so "
+                        "shard outputs carry the whale job's provenance and "
+                        "gather merges byte-identically")
+
+
+def _shard_filter_from_args(args):
+    """ShardFilter from the --shard option group, or None. Raises
+    ValueError (caller logs + exits 2) on a malformed spec."""
+    spec_arg = getattr(args, "shard", None)
+    if not spec_arg:
+        return None
+    from .core.sharding import ShardFilter, parse_shard_arg
+
+    spec = parse_shard_arg(spec_arg, getattr(args, "shard_by", "umi"))
+    return ShardFilter(spec, getattr(args, "shard_manifest", None))
+
+
 def _add_simplex(sub):
     p = sub.add_parser("simplex", help="Call simplex consensus reads over MI groups")
     p.add_argument("-i", "--input", required=True, help="grouped BAM (MI tags)")
@@ -449,6 +489,7 @@ def _add_simplex(sub):
                         "auto (all visible), or an explicit N; 1 disables "
                         "sharding (fast engine only)")
     _add_device_filter_opts(p)
+    _add_shard_opts(p)
     _add_pipeline_compat(p)
     p.set_defaults(func=cmd_simplex)
 
@@ -532,6 +573,11 @@ def cmd_simplex(args, source=None, sink=None):
 
         oc_caller = OverlappingBasesConsensusCaller("consensus", "consensus")
     out_header = _unmapped_consensus_header(args.read_group_id)
+    try:
+        shard = _shard_filter_from_args(args)
+    except ValueError as e:
+        log.error("%s", e)
+        return 2
 
     t0 = time.monotonic()
     if use_fast:
@@ -575,6 +621,9 @@ def cmd_simplex(args, source=None, sink=None):
                     rejects.drain(caller)
                     return out
 
+                src = iter(reader)
+                if shard is not None:
+                    src = shard.wrap_batches(src)
                 with (BamWriter(args.output, out_header) if sink is None
                       else sink(out_header)) as writer:
                     # device fetch + thresholds + serialize run as the
@@ -582,7 +631,7 @@ def cmd_simplex(args, source=None, sink=None):
                     # with ordered output; 2-3: on the writer thread), so
                     # they overlap the next batch's host prep
                     run_stages(
-                        iter(reader), _process, writer.write_serialized,
+                        src, _process, writer.write_serialized,
                         threads=args.threads, queue_items=queue_items,
                         stats=stats, resolve_fn=resolve_chunk,
                         **_consensus_stage_kwargs(args))
@@ -609,6 +658,12 @@ def cmd_simplex(args, source=None, sink=None):
                 allow_unmapped = args.allow_unmapped
                 pregroup = lambda r: consensus_pregroup_keep(r.flag,
                                                              allow_unmapped)
+                if shard is not None:
+                    # the shard gate runs FIRST: its run tracker must see
+                    # every record in stream order, including records the
+                    # pregroup would drop (ordinals count ALL families)
+                    base_keep = pregroup
+                    pregroup = lambda r: shard.record_keep(r) and base_keep(r)
                 from .consensus.device_filter import wrap_filter_writer
 
                 writer = wrap_filter_writer(writer, filter_tap)
@@ -624,6 +679,11 @@ def cmd_simplex(args, source=None, sink=None):
                     rejects.drain(caller)
                 if filter_tap is not None:
                     writer.finish()
+    if shard is not None:
+        shard.write_manifest()
+        log.info("simplex shard %s: %d/%d families kept (%d records)",
+                 args.shard, len(shard.manifest()), shard.families_seen,
+                 shard.records_kept)
     dt = time.monotonic() - t0
     s = caller.stats
     log.info("simplex[%s]: %d input reads -> %d consensus reads in %.2fs "
@@ -693,6 +753,7 @@ def _add_duplex(sub):
     p.add_argument("--ref", default=None,
                    help="reference FASTA (required with --methylation-mode)")
     _add_device_filter_opts(p)
+    _add_shard_opts(p)
     _add_pipeline_compat(p)
     p.set_defaults(func=cmd_duplex)
 
@@ -752,6 +813,11 @@ def cmd_duplex(args):
     except ValueError as e:
         log.error("%s", e)
         return 2
+    try:
+        shard = _shard_filter_from_args(args)
+    except ValueError as e:
+        log.error("%s", e)
+        return 2
     t0 = time.monotonic()
     allow_unmapped = args.allow_unmapped
     oc_caller = None
@@ -785,10 +851,13 @@ def cmd_duplex(args):
                 progress.add(batch.n)
                 return fast.process_batch(batch, allow_unmapped)
 
+            src = iter(reader)
+            if shard is not None:
+                src = shard.wrap_batches(src)
             with BamWriter(args.output, out_header) as writer:
                 writer = wrap_filter_writer(writer, filter_tap)
                 run_stages(
-                    iter(reader), _process, writer.write_serialized,
+                    src, _process, writer.write_serialized,
                     threads=args.threads, stats=stats_t,
                     resolve_fn=resolve_chunk, **_consensus_stage_kwargs(args))
                 for blob in fast.flush():
@@ -809,6 +878,11 @@ def cmd_duplex(args):
                 n_out = 0
                 pregroup = lambda r: consensus_pregroup_keep(r.flag,
                                                              allow_unmapped)
+                if shard is not None:
+                    # shard gate first: it must see every record in stream
+                    # order (same contract as the simplex classic path)
+                    base_keep = pregroup
+                    pregroup = lambda r: shard.record_keep(r) and base_keep(r)
                 batch = []
                 for group in iter_duplex_groups(reader,
                                                 record_filter=pregroup):
@@ -836,6 +910,11 @@ def cmd_duplex(args):
                     rejects.drain(caller)
                 if filter_tap is not None:
                     writer.finish()
+    if shard is not None:
+        shard.write_manifest()
+        log.info("duplex shard %s: %d/%d families kept (%d records)",
+                 args.shard, len(shard.manifest()), shard.families_seen,
+                 shard.records_kept)
     dt = time.monotonic() - t0
     s = caller.merged_stats()
     log.info("duplex[%s]: %d input reads -> %d consensus reads in %.2fs "
@@ -2936,6 +3015,7 @@ def _add_pipeline(sub):
                         "surviving records are fetched + serialized — "
                         "byte-identical records to the chained filter "
                         "stage")
+    _add_shard_opts(p)
     _add_pipeline_compat(p)
     p.set_defaults(func=cmd_pipeline)
 
@@ -2957,6 +3037,15 @@ def _pipeline_stage_argvs(args, j):
     out_lvl = ([] if args.compression_level is None
                else ["--compression-level", str(args.compression_level)])
     rs = (["-r"] + args.read_structures) if args.read_structures else []
+    # scatter sub-job: the front stages (extract/sort/group) replicate the
+    # full deterministic stream on every shard — identical MI assignment
+    # and family ordinals everywhere — and the shard filter cuts the
+    # stream down at the simplex stage, where families become independent
+    shard_fwd = []
+    if getattr(args, "shard", None):
+        shard_fwd = ["--shard", args.shard, "--shard-by", args.shard_by]
+        if args.shard_manifest:
+            shard_fwd += ["--shard-manifest", args.shard_manifest]
     # --threads reaches every stage with threaded internals: sort's Phase-1
     # spill workers and group's reader/writer stages are deterministic
     # (byte-identical output), not just simplex
@@ -2980,12 +3069,12 @@ def _pipeline_stage_argvs(args, j):
                          "--min-reads", str(args.consensus_min_reads),
                          "--allow-unmapped", "--device-filter",
                          "--filter-min-reads", str(args.filter_min_reads)]
-             + out_lvl + thr + fwd))
+             + shard_fwd + out_lvl + thr + fwd))
         return stages
     stages += [
         ("simplex", ["simplex", "-i", j("grouped.bam"), "-o", j("cons.bam"),
                      "--min-reads", str(args.consensus_min_reads),
-                     "--allow-unmapped"] + lvl0 + thr + fwd),
+                     "--allow-unmapped"] + shard_fwd + lvl0 + thr + fwd),
         ("filter", ["filter", "-i", j("cons.bam"), "-o", args.output,
                     "--min-reads", str(args.filter_min_reads)] + out_lvl
          + fwd),
@@ -3727,6 +3816,36 @@ def _add_balance(sub):
                         "the same health-poll snapshot the `stats` op "
                         "reports (0 = ephemeral; unset = no listener; "
                         "docs/serving.md \"Fleet metrics\")")
+    g = p.add_argument_group("whale scatter/gather")
+    g.add_argument("--scatter", type=int, default=0, metavar="N",
+                   help="split every submitted pipeline/simplex/duplex "
+                        "job into N dedupe-keyed shard sub-jobs fanned "
+                        "out across the backends, then k-way merge the "
+                        "shard outputs into ONE BAM byte-identical to a "
+                        "single-backend run (N >= 2; 0 = off; requires a "
+                        "filesystem shared with the backends; "
+                        "docs/serving.md \"Scatter/gather\")")
+    g.add_argument("--scatter-axis", default="umi",
+                   choices=("umi", "coord"),
+                   help="content-hash axis for the family split: the "
+                        "UMI's MI value, or the template coordinate "
+                        "(default umi; both are explicit hashes — "
+                        "deterministic across hosts and Python hash "
+                        "seeds)")
+    g.add_argument("--scatter-wal", default=None, metavar="PATH",
+                   help="fsync'd JSONL write-ahead log of whale/shard "
+                        "state: a restarted balancer resumes in-flight "
+                        "whales from it, resubmitting shards under their "
+                        "idempotent dedupe keys (unset = whales do not "
+                        "survive a balancer restart)")
+    g.add_argument("--scatter-grace", type=float, default=20.0,
+                   metavar="S",
+                   help="how long a shard job may stay unknown "
+                        "fleet-wide before the coordinator requeues it "
+                        "under an attempt-suffixed dedupe key — keep "
+                        "this LONGER than the daemons' lease-scan "
+                        "period so a journal takeover wins the race "
+                        "(default 20)")
     p.set_defaults(func=cmd_balance)
 
 
@@ -3751,6 +3870,12 @@ def cmd_balance(args):
             and not 0 <= args.metrics_port <= 65535:
         log.error("--metrics-port must be in 0..65535")
         return 2
+    if args.scatter and args.scatter < 2:
+        log.error("--scatter needs at least 2 shards (0 disables it)")
+        return 2
+    if args.scatter_grace <= 0:
+        log.error("--scatter-grace must be > 0")
+        return 2
     try:
         token = transport_mod.load_token(args.token_file)
         for addr in [args.listen] + args.backends:
@@ -3766,7 +3891,10 @@ def cmd_balance(args):
             io_timeout_s=(args.io_timeout if args.io_timeout is not None
                           else transport_mod.DEFAULT_IO_TIMEOUT_S),
             backend_timeout_s=args.backend_timeout,
-            metrics_port=args.metrics_port)
+            metrics_port=args.metrics_port,
+            scatter_shards=args.scatter, scatter_axis=args.scatter_axis,
+            scatter_wal=args.scatter_wal,
+            scatter_grace_s=args.scatter_grace)
     except (OSError, ValueError) as e:
         log.error("balance: %s", e)
         return 2
@@ -3864,6 +3992,14 @@ def _add_jobs(sub):
                    help="drain, finish queued+running jobs, then exit")
     g.add_argument("--ping", action="store_true",
                    help="print daemon liveness/config as JSON")
+    g.add_argument("--scatter", nargs="?", const="", default=None,
+                   metavar="WHALE_ID",
+                   help="print a `balance --scatter` front end's whale "
+                        "scatter section as JSON (with WHALE_ID: that "
+                        "whale's per-shard states); daemons and "
+                        "non-scatter balancers answer their documented "
+                        "refusal (docs/serving.md \"Whale "
+                        "scatter/gather\")")
     p.set_defaults(func=cmd_jobs)
 
 
@@ -3878,6 +4014,10 @@ def cmd_jobs(args):
     try:
         if args.ping:
             print(_json.dumps(client.ping(), indent=1, sort_keys=True))
+            return 0
+        if args.scatter is not None:
+            sc = client.scatter(args.scatter or None)
+            print(_json.dumps(sc, indent=1, sort_keys=True))
             return 0
         if args.id:
             print(_json.dumps(client.job(args.id), indent=1, sort_keys=True))
@@ -4094,6 +4234,18 @@ def _run_command(args):
     from .utils.governor import GOVERNOR, ResourceExhausted
 
     try:
+        pg = getattr(args, "pg_argv", None)
+        if pg:
+            # scatter sub-job provenance: @PG CL (and every other argv-
+            # derived header field) records the WHALE job's command line,
+            # so shard outputs are byte-compatible with the unsharded run.
+            # Innermost wins over the daemon's per-job command_argv wrap.
+            import shlex as _shlex
+
+            from .observe.scope import command_argv
+
+            with command_argv(_shlex.split(pg)):
+                return args.func(args)
         return args.func(args)
     except MeshConfigError as e:
         # an unsatisfiable --mesh/FGUMI_TPU_MESH shape: one loud line, not

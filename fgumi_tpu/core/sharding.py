@@ -1,0 +1,374 @@
+"""Deterministic content-hash sharding of grouped BAM streams.
+
+The scatter planner (serve/scatter.py) splits one whale job into N
+sub-jobs; every sub-job reads the SAME grouped stream and keeps only the
+MI families assigned to its shard. Assignment is a pure function of
+record content — never of Python's salted ``hash()``, the shard count's
+iteration order, or the backend the shard lands on — so a split is
+reproducible across runs, interpreters (PYTHONHASHSEED), and machines:
+
+- ``umi`` axis: splitmix64 finalizer over the family's numeric MI value.
+- ``coord`` axis: FNV-1a 64 over the 18-byte both-ends template position
+  key (tid1, tid2, biased pos1/pos2, strand pair) — the exact bytes the
+  native template-coordinate sort key packs, so records of one family
+  (which share the position key by construction of `group`) always hash
+  together.
+
+Both hashes read the packed key ``native.batch.template_coord_keys``
+already produces (fgumi_native.cc fgumi_template_coord_keys): bytes
+0-17 position, bytes 20-27 MI value u64 BE.
+
+Byte-deterministic gather needs more than a disjoint split: the merged
+output must interleave shard outputs in the exact order the unsharded
+run would have produced. Consensus callers emit families in input
+stream order, so each shard filter also records a **manifest** — the
+global family ordinal (index of the family in the full input stream)
+and MI value of every family it kept. The gather stage k-way merges the
+manifests by ordinal and, per winning entry, copies that family's
+consensus records from the owning shard's output run (zero records when
+the caller dropped the family — min-reads, filtering).
+
+Precondition: the input is a grouped stream (`group` output) where each
+family's records are adjacent and every record carries the MI tag —
+the same contract the consensus callers themselves rely on.
+"""
+
+import os
+import struct
+
+import numpy as np
+
+_FNV_OFFSET = np.uint64(0xCBF29CE484222325)
+_FNV_PRIME = np.uint64(0x100000001B3)
+
+SHARD_AXES = ("umi", "coord")
+
+
+class ShardSpec:
+    """One shard's slot in an N-way split."""
+
+    __slots__ = ("index", "count", "axis")
+
+    def __init__(self, index: int, count: int, axis: str = "umi"):
+        if count < 1:
+            raise ValueError(f"shard count must be >= 1 (got {count})")
+        if not 0 <= index < count:
+            raise ValueError(f"shard index {index} outside 0..{count - 1}")
+        if axis not in SHARD_AXES:
+            raise ValueError(f"shard axis must be one of {SHARD_AXES} "
+                             f"(got {axis!r})")
+        self.index = index
+        self.count = count
+        self.axis = axis
+
+    def __repr__(self):
+        return f"ShardSpec({self.index}/{self.count}, axis={self.axis})"
+
+
+def parse_shard_arg(value: str, axis: str = "umi") -> ShardSpec:
+    """``K/N`` (0-based K) -> ShardSpec; loud errors for the CLI."""
+    k, sep, n = value.partition("/")
+    if not sep or not k.isdigit() or not n.isdigit():
+        raise ValueError(f"--shard {value!r}: expected K/N, e.g. 0/4")
+    return ShardSpec(int(k), int(n), axis)
+
+
+def mi_value(mi) -> int:
+    """Numeric MI value, the exact parse the native key packs
+    (fgumi_native.cc): digits before '/', ASCII whitespace stripped,
+    negatives clamp to 0, saturating at u64 max; malformed/absent -> 0."""
+    if mi is None:
+        return 0
+    if isinstance(mi, bytes):
+        mi = mi.decode("ascii", "replace")
+    base = mi.split("/", 1)[0].strip(" \t\n\r\x0b\x0c")
+    negative = False
+    if base[:1] in "+-":
+        negative = base[0] == "-"
+        base = base[1:]
+    if not base or not all("0" <= c <= "9" for c in base):
+        return 0
+    if negative:
+        return 0
+    return min(int(base), (1 << 64) - 1)
+
+
+def _mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer: uniform, seed-free family hash from MI."""
+    x = x.astype(np.uint64, copy=True)
+    with np.errstate(over="ignore"):
+        x ^= x >> np.uint64(30)
+        x *= np.uint64(0xBF58476D1CE4E5B9)
+        x ^= x >> np.uint64(27)
+        x *= np.uint64(0x94D049BB133111EB)
+        x ^= x >> np.uint64(31)
+    return x
+
+
+def _fnv1a_key18(keys: np.ndarray, ko: np.ndarray) -> np.ndarray:
+    """Vectorized FNV-1a 64 over the 18 position bytes of each packed key."""
+    h = np.full(len(ko), _FNV_OFFSET, np.uint64)
+    with np.errstate(over="ignore"):
+        for b in range(18):
+            h = (h ^ keys[ko + b].astype(np.uint64)) * _FNV_PRIME
+    return h
+
+
+class ShardFilter:
+    """Streaming family-run filter over a grouped record stream.
+
+    Stateful and strictly in stream order: every record of the input
+    must pass through exactly once (``wrap_batches`` for the vectorized
+    engines, ``record_keep`` for the classic per-record engines — both
+    share one run tracker, so fast and classic runs agree bit-for-bit
+    on assignment, ordinals, and manifest)."""
+
+    def __init__(self, spec: ShardSpec, manifest_path: str = None):
+        self.spec = spec
+        self.manifest_path = manifest_path
+        self._prev_mi = None      # last record's MI value (run carry)
+        self._carry_keep = False  # keep decision of the open family
+        self._families = 0        # global family ordinal counter
+        self._man_ord = []        # per-batch kept-family ordinal arrays
+        self._man_mi = []
+        self.records_seen = 0
+        self.records_kept = 0
+
+    # -- shared run/assignment core -------------------------------------
+
+    def _assign(self, batch) -> np.ndarray:
+        """keep mask for one RecordBatch; advances run/ordinal state."""
+        from ..native import batch as nb
+
+        n = batch.n
+        keys, out_off = nb.template_coord_keys(
+            batch, np.zeros(n, np.int32))
+        ko = out_off[:-1]
+        mi = np.zeros(n, np.uint64)
+        for b in range(8):
+            mi = (mi << np.uint64(8)) | keys[ko + (20 + b)].astype(np.uint64)
+        newfam = np.empty(n, bool)
+        newfam[0] = self._prev_mi is None or mi[0] != self._prev_mi
+        if n > 1:
+            newfam[1:] = mi[1:] != mi[:-1]
+        starts = np.flatnonzero(newfam)
+        if self.spec.axis == "umi":
+            fam_hash = _mix64(mi[starts])
+        else:
+            fam_hash = _fnv1a_key18(keys, ko[starts])
+        fam_keep = (fam_hash % np.uint64(self.spec.count)) \
+            == np.uint64(self.spec.index)
+        # per-record keep: families are runs, so a cumulative family index
+        # maps each record to its family; index -1 = carry-over family
+        fam_idx = np.cumsum(newfam) - 1
+        if len(starts):
+            keep = np.where(fam_idx >= 0,
+                            fam_keep[np.maximum(fam_idx, 0)],
+                            self._carry_keep)
+        else:
+            keep = np.full(n, self._carry_keep)
+        kept = np.flatnonzero(fam_keep)
+        if len(kept):
+            self._man_ord.append((self._families + kept).astype(np.uint64))
+            self._man_mi.append(mi[starts[kept]])
+        self._families += len(starts)
+        self._prev_mi = mi[-1]
+        self._carry_keep = bool(keep[-1])
+        self.records_seen += n
+        self.records_kept += int(keep.sum())
+        return keep
+
+    # -- vectorized engines ----------------------------------------------
+
+    def wrap_batches(self, batches):
+        """Filter a RecordBatch iterator down to this shard's families.
+
+        Kept records form contiguous runs, so the filtered batch is
+        rebuilt by concatenating run slices of the wire buffer — no
+        per-record Python loop."""
+        from ..io.batch_reader import RecordBatch
+
+        for batch in batches:
+            if batch.n == 0:
+                continue
+            keep = self._assign(batch)
+            k = np.flatnonzero(keep)
+            if len(k) == batch.n:
+                yield batch
+                continue
+            if not len(k):
+                continue
+            brk = np.flatnonzero(np.diff(k) != 1)
+            run_s = np.concatenate(([0], brk + 1))
+            run_e = np.concatenate((brk, [len(k) - 1]))
+            parts = [batch.buf[batch.rec_off[k[s]]:batch.data_end[k[e]]]
+                     for s, e in zip(run_s, run_e)]
+            # copy even the single-run case: a view would pin the parent
+            # chunk for the lifetime of the (much smaller) filtered batch
+            buf = parts[0].copy() if len(parts) == 1 \
+                else np.concatenate(parts)
+            lens = batch.data_end[k] - batch.rec_off[k]
+            off = np.concatenate(([0], np.cumsum(lens)))[:-1]
+            yield RecordBatch(buf, np.ascontiguousarray(off, np.int64))
+
+    # -- classic per-record engines ---------------------------------------
+
+    def record_keep(self, rec) -> bool:
+        """Per-record gate for the classic engines (compose FIRST in a
+        record_filter chain — it must see every record in stream order).
+
+        Routes the single record through the same native key packer via
+        a one-record batch, so classic and fast assignment can never
+        drift."""
+        from ..io.batch_reader import RecordBatch
+
+        wire = struct.pack("<I", len(rec.data)) + rec.data
+        one = RecordBatch(bytearray(wire), np.zeros(1, np.int64))
+        return bool(self._assign(one)[0])
+
+    # -- manifest ---------------------------------------------------------
+
+    @property
+    def families_seen(self) -> int:
+        return self._families
+
+    def manifest(self) -> np.ndarray:
+        """(m, 2) uint64 [global family ordinal, MI value] of kept
+        families, in stream order."""
+        if not self._man_ord:
+            return np.empty((0, 2), np.uint64)
+        return np.stack([np.concatenate(self._man_ord),
+                         np.concatenate(self._man_mi)], axis=1)
+
+    def write_manifest(self, path: str = None):
+        path = path or self.manifest_path
+        if path is None:
+            return
+        write_manifest(path, self.manifest())
+
+
+def write_manifest(path: str, manifest: np.ndarray):
+    """Atomic manifest write (tmp + rename): the gather stage must never
+    see a torn sidecar after a shard job crash."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.save(f, np.ascontiguousarray(manifest, np.uint64),
+                    allow_pickle=False)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def read_manifest(path: str) -> np.ndarray:
+    arr = np.load(path, allow_pickle=False)
+    if arr.ndim != 2 or arr.shape[1] != 2 or arr.dtype != np.uint64:
+        raise ValueError(f"shard manifest {path}: expected (m, 2) uint64, "
+                         f"got {arr.dtype}{arr.shape}")
+    return arr
+
+
+class _RunCursor:
+    """Family-run reader over one shard's consensus BAM: runs of equal
+    MI value, in stream order, taken by matching the manifest entry."""
+
+    def __init__(self, reader):
+        self._records = iter(reader)
+        self._pending = None  # (mi_value, RawRecord) lookahead
+
+    def _next(self):
+        if self._pending is not None:
+            out, self._pending = self._pending, None
+            return out
+        rec = next(self._records, None)
+        if rec is None:
+            return None
+        return (mi_value(rec.get_str(b"MI")), rec)
+
+    def take(self, mi: int):
+        """Records of the next run IF its MI matches, else [] (the
+        consensus caller dropped that family)."""
+        first = self._next()
+        if first is None:
+            return []
+        if first[0] != mi:
+            self._pending = first
+            return []
+        out = [first[1]]
+        while True:
+            nxt = self._next()
+            if nxt is None:
+                return out
+            if nxt[0] != mi:
+                self._pending = nxt
+                return out
+            out.append(nxt[1])
+
+    def exhausted(self) -> bool:
+        if self._pending is not None:
+            return False
+        nxt = self._next()
+        if nxt is None:
+            return True
+        self._pending = nxt
+        return False
+
+
+def gather_shards(bam_paths, manifest_paths, out_path: str,
+                  level: int = None, progress=None) -> dict:
+    """Merge N shard consensus BAMs into the byte-deterministic whole.
+
+    Streams the per-shard manifests through the public k-way merge
+    (sort.external.merge_keyed_streams) keyed by global family ordinal;
+    each winning entry copies its family's records from the owning
+    shard's output run. Returns counters {families, records, dropped}.
+    ``progress(families_merged)`` is called periodically when given."""
+    from ..io.bam import BamWriter
+    from ..io.batch_reader import BatchedRecordReader
+    from ..sort.external import merge_keyed_streams
+
+    if len(bam_paths) != len(manifest_paths) or not bam_paths:
+        raise ValueError("gather needs one manifest per shard BAM")
+    manifests = [read_manifest(p) for p in manifest_paths]
+    readers = [BatchedRecordReader(p) for p in bam_paths]
+    stats = {"families": 0, "records": 0, "dropped": 0}
+    try:
+        header = readers[0].header
+        for i, r in enumerate(readers[1:], 1):
+            if r.header.text != header.text:
+                raise ValueError(
+                    f"shard {i} header differs from shard 0 "
+                    f"({bam_paths[i]}): scatter sub-jobs out of sync")
+        cursors = [_RunCursor(r) for r in readers]
+
+        def _entries(s, man):
+            for row in man:
+                yield int(row[0]), (s, int(row[1]))
+
+        streams = [_entries(s, man) for s, man in enumerate(manifests)]
+        with BamWriter(out_path, header, level=level) as writer:
+            for _ord, (shard, mi) in merge_keyed_streams(streams):
+                recs = cursors[shard].take(mi)
+                stats["families"] += 1
+                if not recs:
+                    stats["dropped"] += 1
+                for rec in recs:
+                    writer.write_record_bytes(rec.data)
+                stats["records"] += len(recs)
+                if progress is not None and stats["families"] % 4096 == 0:
+                    progress(stats["families"])
+        for i, cur in enumerate(cursors):
+            if not cur.exhausted():
+                raise ValueError(
+                    f"shard {i} output has families beyond its manifest "
+                    f"({bam_paths[i]}): scatter sub-jobs out of sync")
+    finally:
+        for r in readers:
+            r.close()
+    return stats

@@ -29,6 +29,11 @@ backends:
   and only when EVERY healthy backend sheds does it sleep the smallest
   hint once and retry, then propagate the shed to the client (who sleeps
   the hint themselves — nobody hot-loops).
+- **Scatter** (``--scatter N``) — a submitted ``pipeline``/``simplex``/
+  ``duplex`` whale job is split into N dedupe-keyed shard sub-jobs
+  fanned out through this same routing, tracked in the balancer's
+  scatter WAL, and gathered into one byte-deterministic BAM
+  (serve/scatter.py; docs/serving.md "Scatter/gather").
 
 ``drain``/``shutdown`` on the front apply to the balancer itself (close
 admission; exit), never to the backends — operators stop daemons
@@ -271,7 +276,11 @@ class Balancer:
                  io_timeout_s: float = transport.DEFAULT_IO_TIMEOUT_S,
                  backend_timeout_s: float = 30.0,
                  job_map_limit: int = 10000,
-                 metrics_port: int = None):
+                 metrics_port: int = None,
+                 scatter_shards: int = 0,
+                 scatter_axis: str = "umi",
+                 scatter_wal: str = None,
+                 scatter_grace_s: float = 20.0):
         if not backends:
             raise ValueError("balance needs at least one --backend")
         self.listen_addr = listen
@@ -338,6 +347,21 @@ class Balancer:
                 self, metrics_port,
                 metrics_fn=lambda: render_fleet_prometheus(self),
                 healthz_fn=lambda: render_fleet_healthz(self))
+        # whale scatter/gather (balance --scatter N): the planner/
+        # coordinator that splits recognized consensus jobs across the
+        # fleet and k-way merges the shard outputs (serve/scatter.py)
+        self._scatter = None
+        if scatter_shards:
+            from .scatter import ScatterCoordinator
+
+            self._scatter = ScatterCoordinator(
+                self, scatter_shards, axis=scatter_axis,
+                wal_path=scatter_wal, requeue_grace_s=scatter_grace_s,
+                # shard status polls are cheap frame round-trips; track
+                # them to the health-poll cadence so shard completion is
+                # noticed promptly (capped: a lazy operator poll period
+                # must not starve the gather)
+                poll_s=min(0.5, poll_period_s))
         self._closed = False
 
     # -- lifecycle ----------------------------------------------------------
@@ -360,9 +384,14 @@ class Balancer:
                                  daemon=True)
             t.start()
             self._poll_threads.append(t)
-        log.info("balance: listening on %s over %d backend(s): %s",
+        if self._scatter is not None:
+            # WAL-resumed whales start fanning out once routing is live
+            self._scatter.start()
+        log.info("balance: listening on %s over %d backend(s): %s%s",
                  self._listener.describe(), len(self.backends),
-                 ", ".join(b.address for b in self.backends))
+                 ", ".join(b.address for b in self.backends),
+                 f"; scatter {self._scatter.shards}x/{self._scatter.axis}"
+                 if self._scatter is not None else "")
 
     def request_shutdown(self):
         self._shutdown.set()
@@ -389,6 +418,10 @@ class Balancer:
         self._closed = True
         self._shutdown.set()
         self._poll_stop.set()
+        if self._scatter is not None:
+            # in-flight whales stop cleanly; their WAL state resumes them
+            # on the next start
+            self._scatter.close()
         for t in self._poll_threads:
             t.join(timeout=5)
         if self._metrics is not None:
@@ -598,11 +631,32 @@ class Balancer:
                 draining=self.draining)
         if op == "stats":
             return protocol.ok_response(stats=self.stats_snapshot())
+        if op == "scatter":
+            if self._scatter is None:
+                return protocol.error_response(
+                    "scatter is not enabled on this balancer (start it "
+                    "with `balance --scatter N`)")
+            job_id = req.get("id")
+            if job_id is None:
+                return protocol.ok_response(
+                    scatter=self._scatter.snapshot())
+            whale = self._scatter.status(job_id)
+            if whale is None:
+                return protocol.error_response(f"unknown job {job_id}")
+            return protocol.ok_response(scatter=whale)
         if op == "submit":
+            if self._scatter is not None:
+                resp = self._scatter.maybe_submit(req)
+                if resp is not None:
+                    return resp  # a whale: planned and fanned out
             return self._route_submit(req)
         if op == "status":
             return self._route_status(req)
         if op == "cancel":
+            if self._scatter is not None:
+                resp = self._scatter.cancel(req["id"])
+                if resp is not None:
+                    return resp
             return self._route_cancel(req)
         if op == "drain":
             self.drain()
@@ -615,10 +669,15 @@ class Balancer:
     def stats_snapshot(self, scrape=None) -> dict:
         """The balancer's ``stats`` op payload. v2 added ``fleet_metrics``
         (health-poll-cache rollup: fleet depth, per-backend breaker/SDC
-        state, takeover counts, e2e latency summaries). Pass a pre-taken
-        :meth:`backend_scrape` so this payload and a concurrent
-        ``/metrics`` render derive from ONE cache read (the same-snapshot
-        rule the daemon's introspection keeps)."""
+        state, takeover counts, e2e latency summaries); v3 added
+        ``scatter`` (whale scatter/gather state: per-whale shard counts
+        by planned/running/done/requeued — null when ``--scatter`` is
+        off). Pass a pre-taken :meth:`backend_scrape` so this payload and
+        a concurrent ``/metrics`` render derive from ONE cache read (the
+        same-snapshot rule the daemon's introspection keeps); the
+        ``scatter`` section is likewise taken exactly once per payload,
+        and the ``/metrics`` scatter gauges are rendered from THIS
+        payload, never a second coordinator read."""
         from ..observe.metrics import METRICS
 
         if scrape is None:
@@ -626,7 +685,9 @@ class Balancer:
         with self._jobs_lock:
             tracked = len(self._job_backend)
         return {
-            "schema_version": 2,
+            "schema_version": 3,
+            "scatter": (self._scatter.snapshot()
+                        if self._scatter is not None else None),
             "tool": "fgumi-tpu-balance",
             "pid": os.getpid(),
             "uptime_s": round(time.time() - self.started_unix, 1),
@@ -944,8 +1005,11 @@ class Balancer:
     def _route_status(self, req: dict) -> dict:
         job_id = req.get("id")
         if job_id is None:
-            # aggregate listing: every healthy backend's jobs + our depth
+            # aggregate listing: every healthy backend's jobs (+ the
+            # balancer's own whale records) + our depth
             jobs = []
+            if self._scatter is not None:
+                jobs.extend(self._scatter.list_jobs())
             for b in self._healthy_backends():
                 try:
                     resp = self._forward(b, req)
@@ -954,6 +1018,11 @@ class Balancer:
                 if resp.get("ok"):
                     jobs.extend(resp.get("jobs") or [])
             return protocol.ok_response(jobs=jobs)
+        if self._scatter is not None:
+            # whale ids live HERE, not on any backend
+            whale = self._scatter.status(job_id)
+            if whale is not None:
+                return protocol.ok_response(job=whale)
         return self._routed_job_op(req, job_id)
 
     def _route_cancel(self, req: dict) -> dict:
@@ -1036,7 +1105,24 @@ def render_fleet_prometheus(balancer: Balancer) -> str:
     gauge("fleet.takeovers", fleet["takeovers"],
           help_text="journal-lease takeovers summed over the fleet")
     gauge("fleet.takeover_jobs", fleet["takeover_jobs"])
-    # the balancer's own flat counters (routing/transport activity)
+    # whale scatter/gather gauges — from the SAME stats payload (one
+    # coordinator snapshot per render, the same-snapshot rule again)
+    scatter = snap.get("scatter")
+    gauge("fleet.scatter.enabled", int(scatter is not None),
+          help_text="1 when this balancer runs with --scatter N")
+    if scatter is not None:
+        gauge("fleet.scatter.shards_per_whale", scatter["shards"])
+        for state, n in sorted(scatter["whales"].items()):
+            gauge("fleet.scatter.whales_state",
+                  n, f'{{state="{state}"}}')
+        shard_states = {}
+        for w in scatter["jobs"]:
+            for state, n in w["shards"].items():
+                shard_states[state] = shard_states.get(state, 0) + n
+        for state, n in sorted(shard_states.items()):
+            gauge("fleet.scatter.shards_state", n, f'{{state="{state}"}}')
+    # the balancer's own flat counters (routing/transport activity —
+    # includes the fleet.scatter.* whale/shard/gather counters)
     for dotted, v in sorted(snap["metrics"].items()):
         if isinstance(v, bool) or not isinstance(v, (int, float)):
             continue

@@ -194,7 +194,7 @@ class ServeClient:
     def submit(self, argv, priority: str = protocol.DEFAULT_PRIORITY,
                argv0: str = None, tag: str = None, trace: bool = False,
                dedupe: str = None, client: str = None,
-               traceparent: str = None) -> dict:
+               traceparent: str = None, shard: dict = None) -> dict:
         """Submit a command; returns the accepted job record. An admission
         rejection (queue full / draining / over quota) raises ServeError
         with the daemon's reason; a resource-pressure shed raises
@@ -234,6 +234,10 @@ class ServeClient:
             req["dedupe"] = dedupe
         if client is not None:
             req["client"] = client
+        if shard is not None:
+            # scatter metadata (a balancer's whale fan-out stamps it; see
+            # serve/scatter.py) — old daemons ignore the field
+            req["shard"] = dict(shard)
         if trace_id is not None:
             trace_mod.set_trace_context(trace_id=trace_id,
                                         process_label="client")
@@ -241,6 +245,18 @@ class ServeClient:
                             span_id=span_id):
             job = self._checked(req, retry=dedupe is not None)["job"]
         return job
+
+    def scatter(self, job_id: str = None, timeout: float = None) -> dict:
+        """Whale scatter/gather introspection from a ``balance --scatter``
+        front end: per-shard state for one whale id, or the whole scatter
+        section without one. A daemon answers with its explicit
+        balancer-only refusal, and daemons/balancers predating the op
+        answer ``unknown op 'scatter'`` — both surfaced verbatim as
+        ServeError (the documented clean rejection)."""
+        req = {"v": protocol.PROTOCOL_VERSION, "op": "scatter"}
+        if job_id is not None:
+            req["id"] = job_id
+        return self._checked(req, timeout=timeout)["scatter"]
 
     def status(self, job_id: str = None, timeout: float = None) -> dict:
         req = {"v": protocol.PROTOCOL_VERSION, "op": "status"}

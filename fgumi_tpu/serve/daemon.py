@@ -355,7 +355,9 @@ class JobService:
                   trace=rec["trace"], client=rec.get("client"),
                   traceparent=_clean_traceparent(rec.get("traceparent")),
                   hops=rec.get("hops") if isinstance(rec.get("hops"), dict)
-                  else None)
+                  else None,
+                  shard=rec.get("shard")
+                  if isinstance(rec.get("shard"), dict) else None)
         if rec.get("submitted_unix"):
             job.submitted_unix = rec["submitted_unix"]
         terminal = rec["state"] in TERMINAL
@@ -639,6 +641,15 @@ class JobService:
             from .introspect import service_stats
 
             return protocol.ok_response(stats=service_stats(self))
+        if op == "scatter":
+            # balancer-only op: daemons EXECUTE shard sub-jobs, they never
+            # plan or gather them — an explicit refusal here (vs the
+            # version-skew "unknown op") tells the operator they pointed a
+            # scatter client at a daemon instead of a balance front end
+            return protocol.error_response(
+                "op 'scatter' is balancer-only: this is a daemon, not a "
+                "balance front end — submit whales through `fgumi-tpu "
+                "balance --scatter N` (docs/serving.md)")
         if op == "submit":
             dedupe = req.get("dedupe")
             with self._dedupe_lock:
@@ -673,7 +684,9 @@ class JobService:
                     trace=bool(req.get("trace")),
                     client=req.get("client"),
                     traceparent=_clean_traceparent(req.get("traceparent")),
-                    hops=_clean_hops(req))
+                    hops=_clean_hops(req),
+                    shard=req.get("shard")
+                    if isinstance(req.get("shard"), dict) else None)
                 if dedupe:
                     self._dedupe[dedupe] = job.id
             # journal BEFORE admission: a crash between the two requeues a

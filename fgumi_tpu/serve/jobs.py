@@ -37,11 +37,12 @@ class Job:
     __slots__ = ("id", "argv", "argv0", "priority", "tag", "trace",
                  "client", "state", "submitted_unix", "started_unix",
                  "finished_unix", "exit_status", "error", "report_path",
-                 "trace_path", "traceparent", "hops")
+                 "trace_path", "traceparent", "hops", "shard")
 
     def __init__(self, job_id: str, argv, priority: str, argv0: str = None,
                  tag: str = None, trace: bool = False, client: str = None,
-                 traceparent: str = None, hops: dict = None):
+                 traceparent: str = None, hops: dict = None,
+                 shard: dict = None):
         self.id = job_id
         self.argv = list(argv)
         self.argv0 = argv0 or "fgumi-tpu"
@@ -59,6 +60,10 @@ class Job:
         #: attribution (client_sent_unix / balancer_recv_unix /
         #: balancer_sent_unix as propagated; None when the client sent none)
         self.hops = dict(hops) if hops else None
+        #: scatter metadata stamped by a whale fan-out (protocol "shard"
+        #: field: whale id / shard index / shard count / hash axis); None
+        #: for every ordinary job
+        self.shard = dict(shard) if shard else None
         self.state = "queued"
         self.submitted_unix = time.time()
         self.started_unix = None
@@ -87,6 +92,7 @@ class Job:
             "report_path": self.report_path,
             "trace_path": self.trace_path,
             "traceparent": self.traceparent,
+            "shard": self.shard,
         }
 
 
@@ -119,11 +125,11 @@ class JobRegistry:
     def create(self, argv, priority: str, argv0: str = None,
                tag: str = None, trace: bool = False,
                client: str = None, traceparent: str = None,
-               hops: dict = None) -> Job:
+               hops: dict = None, shard: dict = None) -> Job:
         with self._lock:
             job = Job(f"{self._id_prefix}j-{self._next_id}", argv, priority,
                       argv0=argv0, tag=tag, trace=trace, client=client,
-                      traceparent=traceparent, hops=hops)
+                      traceparent=traceparent, hops=hops, shard=shard)
             self._next_id += 1
             self._jobs[job.id] = job
             self._order.append(job.id)

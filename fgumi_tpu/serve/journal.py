@@ -11,7 +11,8 @@ acted on, schema-versioned like the wire protocol and the run report:
      "trace": false, "dedupe": "<idempotency key or null>",
      "client": "<submitter id or null>",
      "traceparent": "<propagated trace context or null>",
-     "hops": {"client_sent_unix": ...} | null}
+     "hops": {"client_sent_unix": ...} | null,
+     "shard": {"whale": "w-ab12-1", "index": 0, ...} | null}
     {"v": 1, "ev": "state", "t": <unix>, "id": "j-3",
      "state": "running" | "done" | "failed" | "cancelled" | "requeued",
      "exit_status": <int or null>, "error": "<diagnostic or null>"}
@@ -135,6 +136,9 @@ def _fold(out: ReplayResult, rec: dict):
             # keeps its client-visible correlation ids wherever it lands
             "traceparent": rec.get("traceparent"),
             "hops": rec.get("hops"),
+            # scatter metadata survives too: a taken-over shard sub-job
+            # stays attributable to its whale
+            "shard": rec.get("shard"),
             "state": "queued",
             "exit_status": None,
             "error": None,
@@ -195,7 +199,7 @@ class JobJournal:
                       "priority": job.priority, "argv0": job.argv0,
                       "tag": job.tag, "trace": job.trace, "dedupe": dedupe,
                       "client": job.client, "traceparent": job.traceparent,
-                      "hops": job.hops})
+                      "hops": job.hops, "shard": job.shard})
 
     def record_state(self, job: Job):
         self._append({"ev": "state", "id": job.id, "state": job.state,

@@ -16,7 +16,11 @@ Requests::
      "traceparent": "00-<32hex>-<16hex>-01",   # optional trace context
      "sent_unix": 1723.4,                      # client send wall time
      "bal_recv_unix": 1723.5,                  # stamped by a balancer
-     "bal_sent_unix": 1723.5}                  # forward hop
+     "bal_sent_unix": 1723.5,                  # forward hop
+     "shard": {"whale": "w-ab12-1",            # optional scatter metadata:
+               "index": 0, "count": 4,         # stamped by a balancer's
+               "axis": "umi"}}                 # whale fan-out; old daemons
+                                               # ignore it (garnish)
     {"v": 1, "op": "status"}           # all jobs
     {"v": 1, "op": "status", "id": "j-3"}
     {"v": 1, "op": "cancel", "id": "j-3"}
@@ -37,6 +41,16 @@ Requests::
                                        # reject it cleanly with "unknown op
                                        # 'hello'" — a new balancer probing
                                        # an old daemon gets a loud answer
+    {"v": 1, "op": "scatter"}          # whale scatter/gather introspection
+    {"v": 1, "op": "scatter",          # (balancer-only: a `balance
+     "id": "w-ab12-1"}                 # --scatter` front end answers with
+                                       # per-shard state; daemons reject it
+                                       # explicitly — they execute shard
+                                       # sub-jobs, they never plan them —
+                                       # and daemons predating the op
+                                       # reject it cleanly with "unknown op
+                                       # 'scatter'", docs/serving.md
+                                       # "Scatter/gather")
 
 Responses are ``{"v": 1, "ok": true, ...}`` or
 ``{"v": 1, "ok": false, "error": "<reason>"}``. Submit acceptance returns
@@ -73,7 +87,7 @@ PROTOCOL_VERSION = 1
 MAX_FRAME_BYTES = 1 << 20
 
 OPS = frozenset({"submit", "status", "cancel", "drain", "shutdown", "ping",
-                 "stats", "hello"})
+                 "stats", "hello", "scatter"})
 
 #: Priority classes, best-first. FIFO within a class.
 PRIORITIES = ("high", "normal", "low")
@@ -150,6 +164,9 @@ def validate_request(obj: dict):
         if client is not None and (not isinstance(client, str)
                                    or not client):
             return "client must be a non-empty string"
+        shard = obj.get("shard")
+        if shard is not None and not isinstance(shard, dict):
+            return "shard must be an object (whale/index/count/axis)"
     if op == "hello":
         token = obj.get("token")
         if token is not None and not isinstance(token, str):

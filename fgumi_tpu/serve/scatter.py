@@ -1,0 +1,812 @@
+"""Whale-job scatter/gather: one sample distributed across the fleet.
+
+A "whale" is a single submitted ``pipeline``/``simplex``/``duplex`` job
+big enough to be worth the whole fleet. ``balance --scatter N`` arms the
+planner in the balancer: a recognized submit is split into N dedupe-keyed
+shard sub-jobs fanned out through the existing health-routed
+:meth:`~.balancer.Balancer._route_submit` path, tracked per shard in the
+balancer's scatter WAL, and finished by a gather stage that k-way merges
+the shards' ordered outputs (``core/sharding.gather_shards``, built on
+``sort/external.merge_keyed_streams``) into ONE byte-deterministic BAM —
+identical to a single-backend run regardless of shard count, backend
+assignment, or which backends died along the way.
+
+How the split stays deterministic: every shard job consumes the FULL
+grouped stream and keeps the families whose content hash (UMI ``MI`` value
+or template-coordinate bytes — both explicit, never Python's seeded
+``hash()``) lands in its bucket, writing a sidecar manifest of (global
+family ordinal, MI) pairs. The gather merges manifests by ordinal, so the
+merged record order is exactly the single-run order (docs/serving.md
+"Fleet operation > Scatter/gather").
+
+Failure semantics:
+
+- A backend dying mid-shard is the fleet's ordinary takeover: the
+  survivor's lease scan requeues the shard under its ORIGINAL id, the
+  coordinator's status poll (mapped-backend-first, then fan-out) finds it
+  again, and the dedupe key guarantees zero double-execution. The
+  coordinator only resubmits a shard itself — under an attempt-suffixed
+  dedupe key — after the id stays unknown fleet-wide past a grace window,
+  i.e. when no journal takeover exists to revive it.
+- A shard that terminally *fails* (the command itself exited nonzero)
+  fails the whale with the shard's diagnostic; re-running a
+  deterministic failure would only repeat it.
+- The gather requires the balancer to see the backends' filesystem (the
+  same shared-filesystem assumption the journal-lease takeover already
+  makes).
+
+Fairness: a whale never monopolizes the fleet — each whale's outstanding
+shard count is capped at its share of the healthy backends (at least 1),
+recomputed as whales come and go; shard sub-jobs inherit the submitter's
+``client`` identity so the daemons' per-client admission quota
+(``serve --max-per-client``) bounds a whale exactly like any other
+submitter.
+"""
+
+import json
+import logging
+import os
+import shlex
+import threading
+import time
+
+from ..core.sharding import SHARD_AXES
+from . import protocol
+
+log = logging.getLogger("fgumi_tpu")
+
+SCATTER_WAL_VERSION = 1
+
+#: whale job states reuse the daemon's lifecycle vocabulary so
+#: ``ServeClient.wait`` and ``fgumi-tpu submit`` work unchanged
+TERMINAL = frozenset(("done", "failed", "cancelled"))
+
+#: job kinds the planner recognizes (consensus commands whose output is a
+#: grouped-order BAM the manifest gather can reassemble)
+SCATTERABLE = frozenset(("pipeline", "simplex", "duplex"))
+
+#: submit-refusal substrings the shard runner treats as transient (the
+#: fleet is busy/recovering — retry) rather than fatal to the whale
+_TRANSIENT_MARKERS = (
+    "no backend admitted",
+    "no healthy backends",
+    "resource_pressure",
+    "timed out mid-submit",
+    "may still be executing",
+    "recovering (half-open",
+    "failed mid-submit",
+    "refused the conversation",
+    "queue full",
+)
+
+
+class ScatterPlan:
+    """One whale's shard decomposition (pure data; no I/O)."""
+
+    __slots__ = ("kind", "out_path", "axis", "count", "level",
+                 "shard_argvs", "shard_outs", "manifest_paths")
+
+    def __init__(self, kind, out_path, axis, count, level,
+                 shard_argvs, shard_outs, manifest_paths):
+        self.kind = kind
+        self.out_path = out_path
+        self.axis = axis
+        self.count = int(count)
+        self.level = level
+        self.shard_argvs = shard_argvs
+        self.shard_outs = shard_outs
+        self.manifest_paths = manifest_paths
+
+    def to_wire(self) -> dict:
+        return {"kind": self.kind, "out": self.out_path, "axis": self.axis,
+                "count": self.count, "level": self.level,
+                "shard_argvs": [list(a) for a in self.shard_argvs],
+                "shard_outs": list(self.shard_outs),
+                "manifests": list(self.manifest_paths)}
+
+    @classmethod
+    def from_wire(cls, d: dict):
+        return cls(d["kind"], d["out"], d["axis"], d["count"], d["level"],
+                   [list(a) for a in d["shard_argvs"]],
+                   list(d["shard_outs"]), list(d["manifests"]))
+
+
+def _flag_value(argv, *names):
+    """Value of the first ``--flag V`` / ``--flag=V`` occurrence, with
+    its index, or (None, -1)."""
+    for i, a in enumerate(argv):
+        for name in names:
+            if a == name and i + 1 < len(argv):
+                return argv[i + 1], i + 1
+            if a.startswith(name + "="):
+                return a[len(name) + 1:], i
+    return None, -1
+
+
+def shard_output_path(out_path: str, index: int, count: int) -> str:
+    """The shard sub-job's output next to the whale's final output."""
+    return f"{out_path}.s{index}of{count}.scatter.bam"
+
+
+def plan_scatter(argv, argv0: str, shards: int, axis: str):
+    """Decompose a submitted command into shard sub-job argvs.
+
+    Returns a :class:`ScatterPlan`, or None when the command is not a
+    scatterable consensus job (anything else — sort, group, simulate,
+    a job already carrying ``--shard`` — routes normally). The shard
+    argv keeps every user flag; it only rewrites ``-o`` to the shard
+    output, appends the ``--shard`` selection plus its manifest path,
+    and pins ``--pg-argv`` to the WHALE's command line so every shard
+    header (``@PG CL``) — and therefore the gathered header — is
+    byte-identical to the single-backend run's."""
+    if not argv or argv[0] not in SCATTERABLE or shards < 2:
+        return None
+    if axis not in SHARD_AXES:
+        raise ValueError(f"unknown scatter axis {axis!r} "
+                         f"(known: {', '.join(SHARD_AXES)})")
+    if _flag_value(argv, "--shard")[0] is not None:
+        return None  # already a shard sub-job: never re-scatter
+    out, out_i = _flag_value(argv, "-o", "--output")
+    if out is None or out == "-":
+        return None
+    level_s, _ = _flag_value(argv, "--compression-level")
+    try:
+        level = int(level_s) if level_s is not None else None
+    except ValueError:
+        return None  # the daemon would reject it; let it answer
+    pg = shlex.join([argv0 or "fgumi-tpu"] + list(argv))
+    shard_argvs, shard_outs, manifests = [], [], []
+    for k in range(shards):
+        s_out = shard_output_path(out, k, shards)
+        s_argv = list(argv)
+        if s_argv[out_i].startswith(("-o=", "--output=")):
+            flag = s_argv[out_i].split("=", 1)[0]
+            s_argv[out_i] = f"{flag}={s_out}"
+        else:
+            s_argv[out_i] = s_out
+        s_argv += ["--shard", f"{k}/{shards}", "--shard-by", axis,
+                   "--shard-manifest", s_out + ".manifest.npy",
+                   "--pg-argv", pg]
+        shard_argvs.append(s_argv)
+        shard_outs.append(s_out)
+        manifests.append(s_out + ".manifest.npy")
+    return ScatterPlan(argv[0], out, axis, shards, level,
+                       shard_argvs, shard_outs, manifests)
+
+
+# ---------------------------------------------------------------------------
+# scatter WAL: the balancer's durable memory of in-flight whales
+
+
+class ScatterWal:
+    """Append-only fsync'd JSONL of whale/shard state (the journal.py
+    write discipline: one line per event, torn tail truncated on replay,
+    so a balancer crash costs at most the final unacknowledged event).
+    Replay returns whale records ready to resume — every shard resubmit
+    is idempotent by its dedupe key, so resuming is always safe."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self._f = open(path, "ab")
+
+    def append(self, rec: dict):
+        rec = {"v": SCATTER_WAL_VERSION, "t": round(time.time(), 3), **rec}
+        line = json.dumps(rec, separators=(",", ":"),
+                          sort_keys=True).encode() + b"\n"
+        with self._lock:
+            if self._f.closed:
+                return
+            self._f.write(line)
+            self._f.flush()
+            try:
+                os.fsync(self._f.fileno())
+            except OSError:
+                pass
+
+    def close(self):
+        with self._lock:
+            if not self._f.closed:
+                self._f.close()
+
+    @staticmethod
+    def replay(path: str):
+        """``(whales_by_id, max_whale_num)`` folded from the WAL; the
+        file is truncated back to the last good record first."""
+        whales, max_num = {}, 0
+        if not os.path.exists(path):
+            return whales, max_num
+        good_end = 0
+        with open(path, "rb") as f:
+            while True:
+                line = f.readline()
+                if not line or not line.endswith(b"\n"):
+                    break
+                try:
+                    rec = json.loads(line)
+                    if not isinstance(rec, dict) \
+                            or rec.get("v") != SCATTER_WAL_VERSION:
+                        raise ValueError("not a scatter WAL record")
+                except ValueError as e:
+                    log.warning("scatter wal %s: undecodable record at "
+                                "byte %d (%s); truncating tail",
+                                path, good_end, e)
+                    break
+                good_end += len(line)
+                _fold_wal(whales, rec)
+                suffix = str(rec.get("id", "")).rsplit("-", 1)[-1]
+                if rec.get("ev") == "whale" and suffix.isdigit():
+                    max_num = max(max_num, int(suffix))
+            f.seek(0, os.SEEK_END)
+            total = f.tell()
+        if total > good_end:
+            with open(path, "r+b") as f:
+                f.truncate(good_end)
+            log.warning("scatter wal %s: dropped %d torn-tail byte(s)",
+                        path, total - good_end)
+        return whales, max_num
+
+
+def _fold_wal(whales: dict, rec: dict):
+    ev = rec.get("ev")
+    if ev == "whale":
+        wid = rec["id"]
+        if wid in whales:
+            return  # first submit wins
+        whales[wid] = {
+            "id": wid, "argv": list(rec.get("argv") or []),
+            "argv0": rec.get("argv0"), "priority": rec.get("priority"),
+            "tag": rec.get("tag"), "client": rec.get("client"),
+            "dedupe": rec.get("dedupe"), "plan": rec.get("plan"),
+            "state": "queued", "error": None,
+            "submitted_unix": rec.get("t"),
+            "shards": {},  # k -> {state, job_id, attempt, dedupe}
+        }
+    elif ev == "shard":
+        w = whales.get(rec.get("whale"))
+        if w is None:
+            return
+        w["shards"][int(rec["k"])] = {
+            "state": rec.get("state"), "job_id": rec.get("job_id"),
+            "attempt": int(rec.get("attempt") or 0),
+            "dedupe": rec.get("dedupe"),
+        }
+    elif ev == "whale_state":
+        w = whales.get(rec.get("id"))
+        if w is None:
+            return
+        w["state"] = rec.get("state")
+        w["error"] = rec.get("error")
+        if rec.get("state") in TERMINAL:
+            w["finished_unix"] = rec.get("t")
+
+
+# ---------------------------------------------------------------------------
+# the whale record and its coordinator
+
+
+class WhaleJob:
+    """One scattered job's balancer-side record. ``to_wire`` mimics the
+    daemon :class:`~.jobs.Job` shape so ``status``/``wait``/``submit``
+    clients need no new vocabulary; the extra ``scatter`` section carries
+    per-shard state."""
+
+    def __init__(self, whale_id: str, argv, plan: ScatterPlan,
+                 argv0=None, priority="normal", tag=None, client=None,
+                 dedupe=None):
+        self.id = whale_id
+        self.argv = list(argv)
+        self.argv0 = argv0 or "fgumi-tpu"
+        self.priority = priority
+        self.tag = tag
+        self.client = client
+        self.dedupe = dedupe
+        self.plan = plan
+        self.state = "queued"
+        self.error = None
+        self.submitted_unix = time.time()
+        self.started_unix = None
+        self.finished_unix = None
+        self.exit_status = None
+        self._lock = threading.Lock()
+        self._cancel = threading.Event()
+        #: k -> {"state", "job_id", "attempt", "dedupe", "unknown_since"}
+        self.shards = {
+            k: {"state": "planned", "job_id": None, "attempt": 0,
+                "dedupe": f"{whale_id}-s{k}", "unknown_since": None}
+            for k in range(plan.count)}
+
+    def shard_counts(self) -> dict:
+        with self._lock:
+            out = {}
+            for s in self.shards.values():
+                out[s["state"]] = out.get(s["state"], 0) + 1
+            return out
+
+    def to_wire(self) -> dict:
+        with self._lock:
+            shards = [
+                {"index": k, "state": s["state"], "job_id": s["job_id"],
+                 "attempt": s["attempt"]}
+                for k, s in sorted(self.shards.items())]
+        return {
+            "id": self.id,
+            "state": self.state,
+            "argv": list(self.argv),
+            "priority": self.priority,
+            "tag": self.tag,
+            "client": self.client,
+            "submitted_unix": round(self.submitted_unix, 3),
+            "started_unix": (round(self.started_unix, 3)
+                             if self.started_unix else None),
+            "finished_unix": (round(self.finished_unix, 3)
+                              if self.finished_unix else None),
+            "exit_status": self.exit_status,
+            "error": self.error,
+            "scatter": {"axis": self.plan.axis, "count": self.plan.count,
+                        "out": self.plan.out_path, "shards": shards},
+        }
+
+
+class ScatterCoordinator:
+    """Plans, fans out, tracks, and gathers whale jobs for a balancer.
+
+    One runner thread per in-flight whale (a whale is by definition rare
+    and heavy; the thread spends its life sleeping between status polls).
+    All backend traffic goes through the balancer's own routing —
+    ``_route_submit`` for shard fan-out (dedupe stickiness, breaker
+    ejection, shed handling included) and ``_routed_job_op`` for shard
+    status/cancel (mapped-backend-first, then fan-out, which is exactly
+    how a post-takeover shard is found again)."""
+
+    def __init__(self, balancer, shards: int, axis: str = "umi",
+                 wal_path: str = None, poll_s: float = 0.5,
+                 requeue_grace_s: float = 20.0, keep_finished: int = 100):
+        if shards < 2:
+            raise ValueError("--scatter needs at least 2 shards")
+        if axis not in SHARD_AXES:
+            raise ValueError(f"unknown scatter axis {axis!r} "
+                             f"(known: {', '.join(SHARD_AXES)})")
+        self.balancer = balancer
+        self.shards = int(shards)
+        self.axis = axis
+        self.poll_s = float(poll_s)
+        self.requeue_grace_s = float(requeue_grace_s)
+        self.keep_finished = int(keep_finished)
+        self._lock = threading.Lock()
+        self._whales = {}          # id -> WhaleJob, insertion-ordered
+        self._dedupe = {}          # whale dedupe key -> whale id
+        self._threads = {}         # id -> runner thread
+        self._next_num = 1
+        self._closed = threading.Event()
+        # per-boot id token: whale ids (and therefore shard dedupe keys)
+        # never collide with a previous balancer incarnation's even
+        # without a WAL
+        self._boot = os.urandom(2).hex()
+        self.wal = ScatterWal(wal_path) if wal_path else None
+        self._resume = []
+        if wal_path:
+            replayed, max_num = ScatterWal.replay(wal_path)
+            self._next_num = max_num + 1
+            self._restore(replayed)
+
+    # -- restart resume -----------------------------------------------------
+
+    def _restore(self, replayed: dict):
+        for wid, rec in replayed.items():
+            plan = rec.get("plan")
+            if not plan:
+                continue
+            whale = WhaleJob(wid, rec["argv"], ScatterPlan.from_wire(plan),
+                             argv0=rec.get("argv0"),
+                             priority=rec.get("priority") or "normal",
+                             tag=rec.get("tag"), client=rec.get("client"),
+                             dedupe=rec.get("dedupe"))
+            if rec.get("submitted_unix"):
+                whale.submitted_unix = rec["submitted_unix"]
+            for k, s in rec["shards"].items():
+                if int(k) in whale.shards:
+                    whale.shards[int(k)].update(
+                        state=s["state"] if s["state"] in
+                        ("done", "failed") else "planned",
+                        job_id=s.get("job_id"),
+                        attempt=s.get("attempt", 0),
+                        dedupe=s.get("dedupe")
+                        or whale.shards[int(k)]["dedupe"])
+            if rec["state"] in TERMINAL:
+                whale.state = rec["state"]
+                whale.error = rec.get("error")
+                whale.finished_unix = rec.get("finished_unix")
+                whale.exit_status = 0 if rec["state"] == "done" else 1
+            self._whales[wid] = whale
+            if whale.dedupe:
+                self._dedupe[whale.dedupe] = wid
+            if whale.state not in TERMINAL:
+                self._resume.append(wid)
+
+    def start(self):
+        """Launch runner threads for WAL-resumed whales (after the
+        balancer's transport is up — resubmits route immediately)."""
+        resumed, self._resume = self._resume, []
+        for wid in resumed:
+            log.info("scatter: resuming whale %s from the WAL", wid)
+            self._start_runner(self._whales[wid])
+
+    def close(self):
+        self._closed.set()
+        for t in list(self._threads.values()):
+            t.join(timeout=5)
+        if self.wal is not None:
+            self.wal.close()
+
+    # -- submit interception ------------------------------------------------
+
+    def maybe_submit(self, req: dict):
+        """Intercept a balancer submit: returns a response frame for a
+        whale (planned and fanned out), or None to route it normally."""
+        from ..observe.metrics import METRICS
+
+        dedupe = req.get("dedupe")
+        if dedupe:
+            with self._lock:
+                wid = self._dedupe.get(dedupe)
+                prior = self._whales.get(wid) if wid else None
+            if prior is not None:
+                METRICS.inc("fleet.scatter.deduped")
+                return protocol.ok_response(job=prior.to_wire(),
+                                            deduped=True)
+        try:
+            plan = plan_scatter(req.get("argv") or [], req.get("argv0"),
+                                self.shards, self.axis)
+        except ValueError as e:
+            return protocol.error_response(str(e))
+        if plan is None:
+            return None
+        if self.balancer.draining:
+            return protocol.error_response(
+                "draining: balancer is not accepting new jobs")
+        with self._lock:
+            whale = WhaleJob(
+                f"w-{self._boot}-{self._next_num}", req["argv"], plan,
+                argv0=req.get("argv0"),
+                priority=req.get("priority", protocol.DEFAULT_PRIORITY),
+                tag=req.get("tag"), client=req.get("client"),
+                dedupe=dedupe)
+            self._next_num += 1
+            self._whales[whale.id] = whale
+            if dedupe:
+                self._dedupe[dedupe] = whale.id
+            self._evict_locked()
+        METRICS.inc("fleet.scatter.whales")
+        if self.wal is not None:
+            self.wal.append({"ev": "whale", "id": whale.id,
+                             "argv": whale.argv, "argv0": whale.argv0,
+                             "priority": whale.priority, "tag": whale.tag,
+                             "client": whale.client, "dedupe": dedupe,
+                             "plan": plan.to_wire()})
+        log.info("scatter: whale %s = %s -> %d %s-hash shard(s)",
+                 whale.id, plan.out_path, plan.count, plan.axis)
+        self._start_runner(whale)
+        return protocol.ok_response(job=whale.to_wire())
+
+    def _evict_locked(self):
+        terminal = [w for w in self._whales.values()
+                    if w.state in TERMINAL]
+        while len(terminal) > self.keep_finished:
+            victim = terminal.pop(0)
+            del self._whales[victim.id]
+            if victim.dedupe \
+                    and self._dedupe.get(victim.dedupe) == victim.id:
+                del self._dedupe[victim.dedupe]
+
+    # -- status / cancel / introspection ------------------------------------
+
+    def status(self, job_id: str):
+        """The whale's wire record, or None for a non-whale id."""
+        with self._lock:
+            whale = self._whales.get(job_id)
+        return whale.to_wire() if whale is not None else None
+
+    def list_jobs(self):
+        with self._lock:
+            return [w.to_wire() for w in self._whales.values()]
+
+    def cancel(self, job_id: str):
+        """Cancel a whale: queued shards are cancelled on their backends,
+        running shards finish and are discarded (the daemon never
+        preempts), no gather runs. Returns the whale record, an error
+        response for a terminal whale, or None for a non-whale id."""
+        with self._lock:
+            whale = self._whales.get(job_id)
+        if whale is None:
+            return None
+        if whale.state in TERMINAL:
+            return protocol.error_response(
+                f"job {job_id} already {whale.state}")
+        whale._cancel.set()
+        with whale._lock:
+            shard_jobs = [s["job_id"] for s in whale.shards.values()
+                          if s["job_id"] and s["state"] not in
+                          ("done", "failed")]
+        for sid in shard_jobs:
+            try:
+                self.balancer._routed_job_op(
+                    {"v": protocol.PROTOCOL_VERSION, "op": "cancel",
+                     "id": sid}, sid)
+            except Exception:  # noqa: BLE001 - best-effort fan-out
+                pass
+        return protocol.ok_response(job=whale.to_wire())
+
+    def snapshot(self) -> dict:
+        """The stats op's ``scatter`` section (take ONCE per stats/
+        metrics render — the same-snapshot rule ``fleet_metrics``
+        follows)."""
+        with self._lock:
+            whales = list(self._whales.values())
+        by_state, jobs = {}, []
+        for w in whales:
+            by_state[w.state] = by_state.get(w.state, 0) + 1
+            jobs.append({"id": w.id, "state": w.state,
+                         "out": w.plan.out_path,
+                         "shards": w.shard_counts()})
+        return {"enabled": True, "shards": self.shards, "axis": self.axis,
+                "wal": self.wal.path if self.wal else None,
+                "whales": by_state, "jobs": jobs}
+
+    # -- the runner ---------------------------------------------------------
+
+    def _start_runner(self, whale: WhaleJob):
+        t = threading.Thread(target=self._run_whale, args=(whale,),
+                             name=f"fgumi-scatter-{whale.id}", daemon=True)
+        with self._lock:
+            self._threads[whale.id] = t
+        t.start()
+
+    def _fair_inflight_cap(self) -> int:
+        """One whale's allowance of concurrently outstanding shards: its
+        share of the healthy backends, floor 1 — N whales split the
+        fleet instead of the first one monopolizing it."""
+        with self._lock:
+            active = sum(1 for w in self._whales.values()
+                         if w.state not in TERMINAL) or 1
+        healthy = len(self.balancer._healthy_backends()) or 1
+        return max(1, healthy // active)
+
+    def _wal_shard(self, whale, k, shard):
+        if self.wal is not None:
+            self.wal.append({"ev": "shard", "whale": whale.id, "k": k,
+                             "attempt": shard["attempt"],
+                             "dedupe": shard["dedupe"],
+                             "job_id": shard["job_id"],
+                             "state": shard["state"]})
+
+    def _finish(self, whale: WhaleJob, state: str, error: str = None):
+        whale.state = state
+        whale.error = error
+        whale.exit_status = (0 if state == "done"
+                             else None if state == "cancelled" else 1)
+        whale.finished_unix = time.time()
+        if self.wal is not None:
+            self.wal.append({"ev": "whale_state", "id": whale.id,
+                             "state": state, "error": error})
+        if error:
+            log.error("scatter: whale %s %s: %s", whale.id, state, error)
+        else:
+            log.info("scatter: whale %s %s in %.2fs", whale.id, state,
+                     whale.finished_unix - whale.submitted_unix)
+
+    def _run_whale(self, whale: WhaleJob):
+        # runner threads are plain threads with no contextvar inheritance:
+        # re-enter the balancer's telemetry scope (the same dance its
+        # handle_request does) so fleet.scatter.* counters land in the
+        # registry the stats op snapshots, not the process-global fallback
+        from ..observe.scope import current_scope, scoped_telemetry
+
+        scope = getattr(self.balancer, "_telemetry_scope", None)
+        if scope is not None and current_scope() is None:
+            with scoped_telemetry(scope=scope):
+                self._run_whale_inner(whale)
+        else:
+            self._run_whale_inner(whale)
+
+    def _run_whale_inner(self, whale: WhaleJob):
+        try:
+            self._drive(whale)
+        except Exception as e:  # noqa: BLE001 - runner death = whale failed
+            log.exception("scatter: whale %s runner crashed", whale.id)
+            if whale.state not in TERMINAL:
+                self._finish(whale, "failed", f"scatter runner: {e}")
+        finally:
+            with self._lock:
+                self._threads.pop(whale.id, None)
+
+    def _submit_shard(self, whale: WhaleJob, k: int) -> str:
+        """One shard fan-out through the balancer's routing. Returns
+        None on success, a transient-refusal reason to retry later, or
+        raises RuntimeError on a fatal refusal."""
+        from ..observe.metrics import METRICS
+
+        shard = whale.shards[k]
+        sreq = {"v": protocol.PROTOCOL_VERSION, "op": "submit",
+                "argv": list(whale.plan.shard_argvs[k]),
+                "priority": whale.priority, "argv0": whale.argv0,
+                "trace": False, "tag": f"{whale.id}-s{k}",
+                "dedupe": shard["dedupe"],
+                "shard": {"whale": whale.id, "index": k,
+                          "count": whale.plan.count,
+                          "axis": whale.plan.axis},
+                "sent_unix": round(time.time(), 6)}
+        if whale.client is not None:
+            sreq["client"] = whale.client
+        resp = self.balancer._route_submit(sreq)
+        if resp.get("ok"):
+            job = resp.get("job") or {}
+            with whale._lock:
+                shard["job_id"] = job.get("id")
+                shard["state"] = "submitted"
+                shard["unknown_since"] = None
+            METRICS.inc("fleet.scatter.shards_submitted")
+            self._wal_shard(whale, k, shard)
+            return None
+        reason = resp.get("error", "submit refused")
+        if "retry_after_s" in resp or any(m in reason
+                                          for m in _TRANSIENT_MARKERS):
+            return reason
+        raise RuntimeError(f"shard {whale.id}-s{k} refused: {reason}")
+
+    def _poll_shard(self, whale: WhaleJob, k: int):
+        """Refresh one outstanding shard from the fleet; drives the
+        submitted/running/done/failed transitions and the lost-shard
+        requeue."""
+        from ..observe.metrics import METRICS
+
+        shard = whale.shards[k]
+        sid = shard["job_id"]
+        resp = self.balancer._routed_job_op(
+            {"v": protocol.PROTOCOL_VERSION, "op": "status", "id": sid},
+            sid)
+        if resp.get("ok"):
+            job = resp.get("job") or {}
+            state = job.get("state")
+            with whale._lock:
+                shard["unknown_since"] = None
+                if state == "running" and shard["state"] == "submitted":
+                    shard["state"] = "running"
+                elif state == "done":
+                    shard["state"] = "done"
+                elif state == "failed":
+                    shard["state"] = "failed"
+                    shard["error"] = job.get("error")
+                elif state == "cancelled":
+                    # a takeover with shrunken capacity (or an operator)
+                    # cancelled the shard out from under us: requeue it
+                    # under a FRESH dedupe key — the daemon keeps the old
+                    # key bound to the cancelled record, and a resubmit
+                    # with it would be answered deduped forever
+                    shard["attempt"] += 1
+                    shard["dedupe"] = \
+                        f"{whale.id}-s{k}-a{shard['attempt']}"
+                    shard["state"] = "requeued"
+                    shard["job_id"] = None
+            if shard["state"] == "done":
+                METRICS.inc("fleet.scatter.shards_done")
+                self._wal_shard(whale, k, shard)
+            elif shard["state"] == "failed":
+                METRICS.inc("fleet.scatter.shards_failed")
+                self._wal_shard(whale, k, shard)
+            return
+        # unknown fleet-wide: the takeover window (grace), or the shard
+        # is genuinely gone (no shared journal to revive it) — requeue
+        # under an attempt-suffixed dedupe key so the resubmit can never
+        # be answered by a stale copy of the old attempt
+        now = time.monotonic()
+        with whale._lock:
+            if shard["unknown_since"] is None:
+                shard["unknown_since"] = now
+                return
+            if now - shard["unknown_since"] < self.requeue_grace_s:
+                return
+            shard["attempt"] += 1
+            shard["dedupe"] = \
+                f"{whale.id}-s{k}-a{shard['attempt']}"
+            shard["state"] = "requeued"
+            shard["job_id"] = None
+            shard["unknown_since"] = None
+        METRICS.inc("fleet.scatter.shards_requeued")
+        self._wal_shard(whale, k, shard)
+        log.warning("scatter: shard %s-s%d lost fleet-wide for %.0fs; "
+                    "requeued as attempt %d", whale.id, k,
+                    self.requeue_grace_s, shard["attempt"])
+
+    def _drive(self, whale: WhaleJob):
+        whale.state = "running"
+        whale.started_unix = time.time()
+        backoff = self.poll_s
+        while not self._closed.is_set():
+            if whale._cancel.is_set():
+                self._finish(whale, "cancelled")
+                return
+            counts = whale.shard_counts()
+            failed = [k for k, s in whale.shards.items()
+                      if s["state"] == "failed"]
+            if failed:
+                k = failed[0]
+                self._finish(
+                    whale, "failed",
+                    f"shard {k}/{whale.plan.count} failed: "
+                    f"{whale.shards[k].get('error') or 'unknown error'}")
+                return
+            if counts.get("done", 0) == whale.plan.count:
+                break  # every shard published: gather
+            # fan out pending shards up to this whale's fair share
+            outstanding = sum(counts.get(s, 0)
+                              for s in ("submitted", "running"))
+            cap = self._fair_inflight_cap()
+            pending = [k for k in sorted(whale.shards)
+                       if whale.shards[k]["state"] in
+                       ("planned", "requeued")]
+            transient = None
+            for k in pending:
+                if outstanding >= cap:
+                    break
+                if self.balancer.draining:
+                    self._finish(whale, "failed",
+                                 "balancer draining before every shard "
+                                 "was submitted")
+                    return
+                transient = self._submit_shard(whale, k)
+                if transient is not None:
+                    break  # fleet busy: retry the rest next pass
+                outstanding += 1
+            # poll the in-flight shards
+            for k in sorted(whale.shards):
+                if whale.shards[k]["state"] in ("submitted", "running"):
+                    self._poll_shard(whale, k)
+            if transient is not None:
+                backoff = min(backoff * 1.5, 5.0)
+            else:
+                backoff = self.poll_s
+            if self._closed.wait(backoff):
+                return
+        if self._closed.is_set():
+            return
+        self._gather(whale)
+
+    def _gather(self, whale: WhaleJob):
+        """The merge stage: k-way merge of the shards' manifest-ordered
+        outputs into the whale's final BAM, committed atomically."""
+        from ..core.sharding import gather_shards
+        from ..observe.metrics import METRICS
+
+        plan = whale.plan
+        tmp = f"{plan.out_path}.scatter-gather.tmp.{os.getpid()}"
+        t0 = time.time()
+        try:
+            stats = gather_shards(plan.shard_outs, plan.manifest_paths,
+                                  tmp, level=plan.level)
+            os.replace(tmp, plan.out_path)
+        except Exception as e:  # noqa: BLE001 - surfaced on the whale
+            METRICS.inc("fleet.scatter.gather_failures")
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            # shard outputs are kept for the post-mortem
+            self._finish(whale, "failed", f"gather: {e}")
+            return
+        METRICS.inc("fleet.scatter.gathers")
+        METRICS.observe("fleet.scatter.gather_s", time.time() - t0)
+        for path in list(plan.shard_outs) + list(plan.manifest_paths):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass  # best-effort cleanup; the merged output is law
+        log.info("scatter: whale %s gathered %d famil%s (%d records, "
+                 "%d dropped) from %d shard(s) in %.2fs -> %s",
+                 whale.id, stats["families"],
+                 "y" if stats["families"] == 1 else "ies",
+                 stats["records"], stats["dropped"], plan.count,
+                 time.time() - t0, plan.out_path)
+        self._finish(whale, "done")

@@ -433,6 +433,39 @@ def merge_sorted(readers, key_fn):
         yield data
 
 
+def merge_keyed_streams(streams):
+    """Public stable k-way merge of pre-keyed ``(key, value)`` streams.
+
+    The module's merge machinery (``merge_sorted`` above, the sorters'
+    spill-run merges) was only reachable through sorter objects or took
+    whole record readers; consumers that already hold ``(key, value)``
+    pairs — the scatter/gather stage merging shard manifests by family
+    ordinal (serve/scatter.py), future partial-sort consumers — get this
+    entry instead of reaching into internals.
+
+    Contract:
+
+    - every input stream must be non-decreasing in ``key`` (keys need
+      only be mutually comparable; values are NEVER compared);
+    - the merge is **stable**: equal keys yield in stream-index order,
+      and within one stream in arrival order — enforced by a per-stream
+      sequence number, so unlike a bare ``heapq.merge`` of value tuples
+      no tie ever falls through to comparing payloads.
+
+    Yields ``(key, value)`` pairs; lazy over the inputs (streaming k-way
+    heap, O(k) open streams)."""
+    def decorate(s_idx, stream):
+        # bound through arguments, not the enclosing loop: a nested
+        # genexp would late-bind s_idx to the LAST stream index and
+        # break the stream-order tie rule
+        return ((key, s_idx, seq, value)
+                for seq, (key, value) in enumerate(stream))
+
+    decorated = [decorate(i, s) for i, s in enumerate(streams)]
+    for key, _s, _q, value in heapq.merge(*decorated):
+        yield key, value
+
+
 class NativeExternalSorter:
     """ExternalSorter with native phase internals (VERDICT r2 item 4).
 

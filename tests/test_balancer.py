@@ -559,7 +559,8 @@ def test_stats_snapshot_v2_fleet_metrics_section(fleet):
     a.handle_request({"v": 1, "op": "submit", "argv": ["sort"]})
     bal.poll_backends_once()
     snap = bal.stats_snapshot()
-    assert snap["schema_version"] == 2
+    assert snap["schema_version"] == 3
+    assert snap["scatter"] is None  # v3: present, null without --scatter
     fm = snap["fleet_metrics"]
     assert fm["backends_total"] == 2 and fm["backends_healthy"] == 2
     assert fm["fleet_depth"] == 1

@@ -1,0 +1,754 @@
+"""Whale scatter/gather: the planner's deterministic splits, the scatter
+WAL, the coordinator's shard lifecycle (fan-out, fairness, lost-shard
+requeue, cancel), and the balancer/daemon protocol surface."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fgumi_tpu.core import sharding
+from fgumi_tpu.core.sharding import (
+    SHARD_AXES,
+    ShardSpec,
+    mi_value,
+    parse_shard_arg,
+)
+from fgumi_tpu.serve import protocol
+from fgumi_tpu.serve.scatter import (
+    ScatterCoordinator,
+    ScatterPlan,
+    ScatterWal,
+    WhaleJob,
+    plan_scatter,
+    shard_output_path,
+)
+from fgumi_tpu.sort.external import merge_keyed_streams
+
+# ---------------------------------------------------------------------------
+# split determinism: explicit hashes, never Python's seeded hash()
+
+
+def _umi_buckets(mis, count):
+    return sharding._mix64(np.asarray(mis, np.uint64)) % np.uint64(count)
+
+
+def test_umi_hash_deterministic_and_disjoint_cover():
+    mis = np.arange(1, 2001, dtype=np.uint64)
+    for count in (2, 3, 5, 8):
+        a = _umi_buckets(mis, count)
+        b = _umi_buckets(mis, count)
+        assert np.array_equal(a, b)
+        assert a.min() >= 0 and a.max() < count
+        # every family lands in exactly one shard, and the union over
+        # shards is the full set: a disjoint cover by construction
+        total = sum(int((a == k).sum()) for k in range(count))
+        assert total == len(mis)
+        # a hash worth the name spreads 2000 families over every bucket
+        assert all(int((a == k).sum()) > 0 for k in range(count))
+
+
+def test_coord_hash_deterministic_over_key_bytes():
+    rng = np.random.default_rng(7)
+    keys = rng.integers(0, 256, size=400, dtype=np.uint8)
+    ko = np.arange(0, 360, 18, dtype=np.int64)
+    a = sharding._fnv1a_key18(keys, ko)
+    b = sharding._fnv1a_key18(keys, ko)
+    assert np.array_equal(a, b)
+    assert a.dtype == np.uint64
+    # position bytes differ -> hashes differ (no degenerate constant)
+    assert len(np.unique(a)) > 1
+
+
+def test_shard_assignment_survives_pythonhashseed():
+    """The split must not depend on interpreter hash randomization: the
+    same MI values bucket identically under different PYTHONHASHSEED."""
+    snippet = (
+        "import numpy as np\n"
+        "from fgumi_tpu.core import sharding\n"
+        "mis = np.arange(1, 501, dtype=np.uint64)\n"
+        "b = sharding._mix64(mis) % np.uint64(3)\n"
+        "print(','.join(map(str, b.tolist())))\n"
+    )
+    outs = []
+    for seed in ("0", "424242"):
+        env = {**os.environ, "PYTHONHASHSEED": seed,
+               "JAX_PLATFORMS": "cpu"}
+        p = subprocess.run([sys.executable, "-c", snippet], env=env,
+                           capture_output=True, text=True, timeout=120)
+        assert p.returncode == 0, p.stderr
+        outs.append(p.stdout.strip())
+    assert outs[0] == outs[1]
+
+
+def test_mi_value_parse_matches_native_key_rules():
+    assert mi_value("123") == 123
+    assert mi_value("123/A") == 123
+    assert mi_value(b" 7 ") == 7
+    assert mi_value("-5") == 0          # negatives clamp
+    assert mi_value("abc") == 0         # malformed
+    assert mi_value(None) == 0
+    assert mi_value(str(1 << 70)) == (1 << 64) - 1  # saturates at u64
+
+
+def test_parse_shard_arg():
+    spec = parse_shard_arg("1/3")
+    assert (spec.index, spec.count, spec.axis) == (1, 3, "umi")
+    assert parse_shard_arg("0/2", axis="coord").axis == "coord"
+    for bad in ("3/3", "-1/3", "x/3", "1", "1/0"):
+        with pytest.raises(ValueError):
+            parse_shard_arg(bad)
+    with pytest.raises(ValueError):
+        ShardSpec(0, 2, axis="nope")
+
+
+# ---------------------------------------------------------------------------
+# merge_keyed_streams: the public shard-merge API the gather builds on
+
+
+def test_merge_keyed_streams_orders_and_is_stable():
+    a = [(1, "a1"), (3, "a3"), (3, "a3b"), (9, "a9")]
+    b = [(1, "b1"), (2, "b2"), (9, "b9")]
+    merged = list(merge_keyed_streams([a, b]))
+    assert [k for k, _ in merged] == [1, 1, 2, 3, 3, 9, 9]
+    # equal keys: stream-index order, then arrival order within a stream
+    assert [v for _, v in merged] == ["a1", "b1", "b2", "a3", "a3b",
+                                      "a9", "b9"]
+
+
+def test_merge_keyed_streams_never_compares_values():
+    class Opaque:  # would raise if the merge fell through to payloads
+        def __lt__(self, other):
+            raise AssertionError("value compared")
+
+    x, y = Opaque(), Opaque()
+    merged = list(merge_keyed_streams([[(5, x)], [(5, y)]]))
+    assert merged[0][1] is x and merged[1][1] is y
+
+
+def test_merge_keyed_streams_is_lazy():
+    def boom():
+        yield (1, "ok")
+        raise RuntimeError("pulled too far")
+
+    gen = merge_keyed_streams([boom(), iter([(2, "b")])])
+    assert next(gen) == (1, "ok")
+    with pytest.raises(RuntimeError):
+        list(gen)
+
+
+# ---------------------------------------------------------------------------
+# the planner
+
+
+ARGV = ["simplex", "-i", "in.bam", "-o", "out.bam", "--min-reads", "2"]
+
+
+def test_plan_scatter_rewrites_output_and_pins_pg():
+    plan = plan_scatter(ARGV, "/usr/bin/fgumi-tpu", 3, "umi")
+    assert plan.kind == "simplex" and plan.count == 3
+    assert plan.out_path == "out.bam" and plan.level is None
+    for k, argv in enumerate(plan.shard_argvs):
+        s_out = shard_output_path("out.bam", k, 3)
+        assert argv[argv.index("-o") + 1] == s_out
+        assert argv[argv.index("--shard") + 1] == f"{k}/3"
+        assert argv[argv.index("--shard-by") + 1] == "umi"
+        assert argv[argv.index("--shard-manifest") + 1] == \
+            s_out + ".manifest.npy"
+        # the @PG line is pinned to the WHALE's command line, so the
+        # gathered header is byte-identical to a single-backend run
+        assert argv[argv.index("--pg-argv") + 1] == \
+            "/usr/bin/fgumi-tpu simplex -i in.bam -o out.bam --min-reads 2"
+        # user flags survive untouched
+        assert argv[argv.index("--min-reads") + 1] == "2"
+    assert plan.shard_outs == [shard_output_path("out.bam", k, 3)
+                               for k in range(3)]
+
+
+def test_plan_scatter_handles_equals_form_and_level():
+    argv = ["duplex", "-i", "in.bam", "--output=final.bam",
+            "--compression-level", "9"]
+    plan = plan_scatter(argv, None, 2, "coord")
+    assert plan.level == 9 and plan.axis == "coord"
+    assert plan.shard_argvs[1][3] == \
+        "--output=" + shard_output_path("final.bam", 1, 2)
+
+
+def test_plan_scatter_declines_unscatterable():
+    fp = plan_scatter
+    assert fp(["sort", "-i", "a", "-o", "b"], None, 3, "umi") is None
+    assert fp(ARGV, None, 1, "umi") is None             # <2 shards
+    assert fp(ARGV + ["--shard", "0/2"], None, 3, "umi") is None
+    assert fp(["simplex", "-i", "in.bam"], None, 3, "umi") is None  # no -o
+    assert fp(["simplex", "-i", "a", "-o", "-"], None, 3, "umi") is None
+    bad_level = ARGV + ["--compression-level", "fast"]
+    assert fp(bad_level, None, 3, "umi") is None  # daemon answers that one
+    assert fp([], None, 3, "umi") is None
+    with pytest.raises(ValueError):
+        fp(ARGV, None, 3, "diagonal")
+
+
+def test_plan_round_trips_through_wire():
+    plan = plan_scatter(ARGV, "fgumi-tpu", 4, "umi")
+    again = ScatterPlan.from_wire(json.loads(json.dumps(plan.to_wire())))
+    assert again.to_wire() == plan.to_wire()
+
+
+# ---------------------------------------------------------------------------
+# scatter WAL
+
+
+def _wal_events(coord_or_path):
+    path = getattr(coord_or_path, "path", coord_or_path)
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def test_wal_replay_folds_whale_lifecycle(tmp_path):
+    path = str(tmp_path / "scatter.wal")
+    wal = ScatterWal(path)
+    plan = plan_scatter(ARGV, "fgumi-tpu", 2, "umi")
+    wal.append({"ev": "whale", "id": "w-aa-3", "argv": ARGV,
+                "argv0": "fgumi-tpu", "priority": "normal", "tag": None,
+                "client": "me", "dedupe": "k1", "plan": plan.to_wire()})
+    wal.append({"ev": "shard", "whale": "w-aa-3", "k": 0, "attempt": 0,
+                "dedupe": "w-aa-3-s0", "job_id": "a-j-1",
+                "state": "done"})
+    wal.append({"ev": "shard", "whale": "w-aa-3", "k": 1, "attempt": 1,
+                "dedupe": "w-aa-3-s1-a1", "job_id": None,
+                "state": "requeued"})
+    # events for unknown whales are tolerated noise, not a crash
+    wal.append({"ev": "shard", "whale": "w-gone-9", "k": 0, "attempt": 0,
+                "dedupe": "x", "job_id": None, "state": "planned"})
+    wal.close()
+    whales, max_num = ScatterWal.replay(path)
+    assert max_num == 3
+    assert list(whales) == ["w-aa-3"]
+    w = whales["w-aa-3"]
+    assert w["client"] == "me" and w["dedupe"] == "k1"
+    assert w["state"] == "queued"  # no whale_state event yet
+    assert w["shards"][0]["state"] == "done"
+    assert w["shards"][1] == {"state": "requeued", "job_id": None,
+                              "attempt": 1, "dedupe": "w-aa-3-s1-a1"}
+
+
+def test_wal_replay_truncates_torn_tail(tmp_path):
+    path = str(tmp_path / "scatter.wal")
+    wal = ScatterWal(path)
+    wal.append({"ev": "whale", "id": "w-aa-1", "argv": ARGV,
+                "plan": plan_scatter(ARGV, None, 2, "umi").to_wire()})
+    wal.close()
+    good = os.path.getsize(path)
+    with open(path, "ab") as f:
+        f.write(b'{"v": 1, "ev": "whale", "id": "w-aa-2"')  # torn write
+    whales, max_num = ScatterWal.replay(path)
+    assert list(whales) == ["w-aa-1"] and max_num == 1
+    assert os.path.getsize(path) == good  # tail physically dropped
+
+
+def test_wal_replay_terminal_whale(tmp_path):
+    path = str(tmp_path / "scatter.wal")
+    wal = ScatterWal(path)
+    wal.append({"ev": "whale", "id": "w-aa-1", "argv": ARGV,
+                "plan": plan_scatter(ARGV, None, 2, "umi").to_wire()})
+    wal.append({"ev": "whale_state", "id": "w-aa-1", "state": "done",
+                "error": None})
+    wal.close()
+    whales, _ = ScatterWal.replay(path)
+    assert whales["w-aa-1"]["state"] == "done"
+
+
+# ---------------------------------------------------------------------------
+# the coordinator, driven against a scripted in-process balancer
+
+
+class FakeBalancer:
+    """The exact surface ScatterCoordinator touches on a Balancer:
+    ``_route_submit``, ``_routed_job_op``, ``_healthy_backends``,
+    ``draining``. Shard jobs complete instantly unless scripted."""
+
+    def __init__(self, backends=2):
+        self.draining = False
+        self.backends = backends
+        self.submits = []           # every _route_submit request
+        self.cancels = []
+        self.refuse_next = []       # queued error strings for submits
+        self.states = {}            # job id -> forced state sequence
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def _healthy_backends(self):
+        return list(range(self.backends))
+
+    def _route_submit(self, req):
+        with self._lock:
+            self.submits.append(req)
+            if self.refuse_next:
+                return protocol.error_response(self.refuse_next.pop(0))
+            self._n += 1
+            jid = f"fake-j-{self._n}"
+            self.states.setdefault(jid, ["done"])
+            return protocol.ok_response(job={"id": jid,
+                                             "state": "queued"})
+
+    def _routed_job_op(self, req, sid):
+        with self._lock:
+            if req["op"] == "cancel":
+                self.cancels.append(sid)
+                return protocol.ok_response(job={"id": sid,
+                                                 "state": "cancelled"})
+            seq = self.states.get(sid)
+            if not seq:
+                return protocol.error_response(f"unknown job {sid}")
+            state = seq.pop(0) if len(seq) > 1 else seq[0]
+            if state == "unknown":
+                return protocol.error_response(f"unknown job {sid}")
+            job = {"id": sid, "state": state}
+            if state == "failed":
+                job["error"] = "exit status 1"
+            return protocol.ok_response(job=job)
+
+
+@pytest.fixture
+def coord(tmp_path):
+    made = []
+
+    def build(bal, **kw):
+        kw.setdefault("poll_s", 0.01)
+        kw.setdefault("requeue_grace_s", 0.05)
+        c = ScatterCoordinator(bal, kw.pop("shards", 3), **kw)
+        # gather needs real shard BAMs on disk; the lifecycle tests
+        # script the fleet, so stub the merge and record the call
+        c.gathered = []
+        c._gather = lambda w: (c.gathered.append(w.id),
+                               c._finish(w, "done"))
+        made.append(c)
+        return c
+
+    yield build
+    for c in made:
+        c.close()
+
+
+def _submit_req(dedupe=None, argv=None):
+    req = {"v": 1, "op": "submit", "argv": list(argv or ARGV),
+           "argv0": "fgumi-tpu", "priority": "normal", "client": "cli-7"}
+    if dedupe:
+        req["dedupe"] = dedupe
+    return req
+
+
+def _wait_state(whale_or_coord, wid=None, want=("done",), timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        state = (whale_or_coord.status(wid)["state"]
+                 if wid else whale_or_coord.state)
+        if state in want:
+            return state
+        time.sleep(0.005)
+    raise AssertionError(f"whale never reached {want}")
+
+
+def test_whale_happy_path_fans_out_and_gathers(coord):
+    bal = FakeBalancer()
+    c = coord(bal)
+    resp = c.maybe_submit(_submit_req(dedupe="whale-k"))
+    assert resp["ok"]
+    wid = resp["job"]["id"]
+    assert wid.startswith("w-")
+    assert resp["job"]["scatter"]["count"] == 3
+    _wait_state(c, wid)
+    assert c.gathered == [wid]
+    # every shard went out exactly once, dedupe-keyed, client inherited
+    assert len(bal.submits) == 3
+    keys = sorted(s["dedupe"] for s in bal.submits)
+    assert keys == [f"{wid}-s{k}" for k in range(3)]
+    assert all(s["client"] == "cli-7" for s in bal.submits)
+    assert all(s["shard"]["whale"] == wid for s in bal.submits)
+    assert [s["shard"]["index"] for s in
+            sorted(bal.submits, key=lambda s: s["dedupe"])] == [0, 1, 2]
+    rec = c.status(wid)
+    assert rec["exit_status"] == 0
+    assert all(s["state"] == "done" for s in rec["scatter"]["shards"])
+
+
+def test_whale_dedupe_returns_original(coord):
+    c = coord(FakeBalancer())
+    first = c.maybe_submit(_submit_req(dedupe="same"))
+    again = c.maybe_submit(_submit_req(dedupe="same"))
+    assert again["deduped"] is True
+    assert again["job"]["id"] == first["job"]["id"]
+
+
+def test_non_scatterable_routes_normally(coord):
+    c = coord(FakeBalancer())
+    assert c.maybe_submit(_submit_req(argv=["sort", "-i", "a",
+                                            "-o", "b"])) is None
+    assert c.maybe_submit(_submit_req(argv=ARGV + ["--shard",
+                                                   "0/2"])) is None
+
+
+def test_draining_balancer_refuses_whales(coord):
+    bal = FakeBalancer()
+    bal.draining = True
+    resp = coord(bal).maybe_submit(_submit_req())
+    assert not resp["ok"] and "draining" in resp["error"]
+
+
+def test_failed_shard_fails_whale_with_diagnostic(coord):
+    bal = FakeBalancer()
+    c = coord(bal)
+    # every shard job this fleet mints fails terminally
+    orig = bal._route_submit
+
+    def fail_submit(req):
+        resp = orig(req)
+        if resp.get("ok"):
+            bal.states[resp["job"]["id"]] = ["failed"]
+        return resp
+
+    bal._route_submit = fail_submit
+    wid = c.maybe_submit(_submit_req())["job"]["id"]
+    _wait_state(c, wid, want=("failed",))
+    rec = c.status(wid)
+    assert "exit status 1" in rec["error"] and rec["exit_status"] == 1
+    assert c.gathered == []  # no gather over a failed scatter
+
+
+def test_transient_refusal_retries_fatal_fails(coord):
+    bal = FakeBalancer()
+    bal.refuse_next = ["queue full: depth 8"]  # transient: retried
+    c = coord(bal)
+    wid = c.maybe_submit(_submit_req())["job"]["id"]
+    _wait_state(c, wid)
+    # the refused shard was re-fanned-out on a later pass
+    assert len(bal.submits) == 4
+
+    bal2 = FakeBalancer()
+    bal2.refuse_next = ["argv[0] must be a known command"]  # fatal
+    c2 = coord(bal2)
+    wid2 = c2.maybe_submit(_submit_req())["job"]["id"]
+    _wait_state(c2, wid2, want=("failed",))
+    assert "refused" in c2.status(wid2)["error"]
+
+
+def test_lost_shard_requeued_after_grace_with_fresh_dedupe(coord):
+    bal = FakeBalancer()
+    c = coord(bal)
+    orig = bal._route_submit
+    first = {}
+
+    def vanish_first(req):
+        resp = orig(req)
+        if resp.get("ok") and not first:
+            # the first shard job vanishes fleet-wide (no takeover)
+            first["id"] = resp["job"]["id"]
+            bal.states[resp["job"]["id"]] = ["unknown", "unknown"]
+        return resp
+
+    bal._route_submit = vanish_first
+    wid = c.maybe_submit(_submit_req())["job"]["id"]
+    _wait_state(c, wid)
+    # 3 original + 1 requeue, and the requeue got an ATTEMPT-SUFFIXED
+    # dedupe key so a stale copy of attempt 0 can never answer it
+    assert len(bal.submits) == 4
+    requeued = bal.submits[3]
+    assert requeued["dedupe"].endswith("-a1")
+    shards = c.status(wid)["scatter"]["shards"]
+    assert sorted(s["attempt"] for s in shards) == [0, 0, 1]
+
+
+def test_cancelled_shard_requeued_with_fresh_dedupe(coord):
+    bal = FakeBalancer()
+    c = coord(bal)
+    orig = bal._route_submit
+    first = {}
+
+    def cancel_first(req):
+        resp = orig(req)
+        if resp.get("ok") and not first:
+            first["id"] = resp["job"]["id"]
+            bal.states[resp["job"]["id"]] = ["cancelled", "cancelled"]
+        return resp
+
+    bal._route_submit = cancel_first
+    wid = c.maybe_submit(_submit_req())["job"]["id"]
+    _wait_state(c, wid)
+    assert len(bal.submits) == 4
+    assert sum(1 for s in bal.submits
+               if s["dedupe"].endswith("-a1")) == 1
+
+
+def test_cancel_whale_fans_out_and_skips_gather(coord):
+    bal = FakeBalancer()
+    c = coord(bal)
+    # shards stay running forever until cancelled
+    orig = bal._route_submit
+
+    def runner(req):
+        resp = orig(req)
+        if resp.get("ok"):
+            bal.states[resp["job"]["id"]] = ["running"]
+        return resp
+
+    bal._route_submit = runner
+    wid = c.maybe_submit(_submit_req())["job"]["id"]
+    deadline = time.monotonic() + 5
+    while len(bal.submits) < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    resp = c.cancel(wid)
+    assert resp["ok"]
+    _wait_state(c, wid, want=("cancelled",))
+    rec = c.status(wid)
+    assert rec["exit_status"] is None  # the daemon's cancelled shape
+    assert bal.cancels  # outstanding shards were cancelled on backends
+    assert c.gathered == []
+    # terminal whales refuse a second cancel; unknown ids return None
+    assert not c.cancel(wid)["ok"]
+    assert c.cancel("nope") is None
+
+
+def test_fair_inflight_cap_splits_fleet_between_whales(coord):
+    bal = FakeBalancer(backends=4)
+    c = coord(bal)
+    assert c._fair_inflight_cap() == 4  # no whales yet: full fleet
+    plan = plan_scatter(ARGV, None, 3, "umi")
+    for i in range(2):
+        c._whales[f"w-x-{i}"] = WhaleJob(f"w-x-{i}", ARGV, plan)
+    assert c._fair_inflight_cap() == 2  # 4 backends / 2 whales
+    c._whales["w-x-2"] = WhaleJob("w-x-2", ARGV, plan)
+    assert c._fair_inflight_cap() == 1  # floor 1 even when outnumbered
+    bal.backends = 0
+    assert c._fair_inflight_cap() == 1
+
+
+def test_wal_resume_resubmits_idempotently(coord, tmp_path):
+    wal = str(tmp_path / "scatter.wal")
+    # 3 backends so the fairness cap lets all 3 shards go out at once
+    bal = FakeBalancer(backends=3)
+    # shards never finish in the first incarnation
+    orig = bal._route_submit
+
+    def runner(req):
+        resp = orig(req)
+        if resp.get("ok"):
+            bal.states[resp["job"]["id"]] = ["running"]
+        return resp
+
+    bal._route_submit = runner
+    c = ScatterCoordinator(bal, 3, wal_path=wal, poll_s=0.01,
+                           requeue_grace_s=0.05)
+    try:
+        wid = c.maybe_submit(_submit_req(dedupe="whale-k"))["job"]["id"]
+        deadline = time.monotonic() + 5
+        while len(bal.submits) < 3 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(bal.submits) == 3
+    finally:
+        c.close()  # balancer crash/restart
+
+    bal2 = FakeBalancer()
+    c2 = coord(bal2, wal_path=wal)
+    assert c2.status(wid)["state"] in ("queued", "running")
+    c2.start()  # resumes the WAL'd whale
+    _wait_state(c2, wid)
+    # resubmits reuse the ORIGINAL dedupe keys: a surviving copy of any
+    # shard wins the arbitration instead of running twice
+    assert sorted(s["dedupe"] for s in bal2.submits) == \
+        sorted(s["dedupe"] for s in bal.submits)
+    # dedupe map survives the restart too
+    again = c2.maybe_submit(_submit_req(dedupe="whale-k"))
+    assert again["deduped"] is True and again["job"]["id"] == wid
+    # and new whale ids continue past the replayed numbering
+    fresh = c2.maybe_submit(_submit_req())["job"]["id"]
+    assert int(fresh.rsplit("-", 1)[1]) > int(wid.rsplit("-", 1)[1])
+
+
+def test_snapshot_counts_whales_and_shards(coord):
+    c = coord(FakeBalancer())
+    wid = c.maybe_submit(_submit_req())["job"]["id"]
+    _wait_state(c, wid)
+    snap = c.snapshot()
+    assert snap["enabled"] is True
+    assert snap["shards"] == 3 and snap["axis"] == "umi"
+    assert snap["whales"] == {"done": 1}
+    (job,) = snap["jobs"]
+    assert job["id"] == wid and job["shards"] == {"done": 3}
+
+
+def test_coordinator_validates_config():
+    with pytest.raises(ValueError):
+        ScatterCoordinator(FakeBalancer(), 1)
+    with pytest.raises(ValueError):
+        ScatterCoordinator(FakeBalancer(), 2, axis="diagonal")
+
+
+# ---------------------------------------------------------------------------
+# protocol + daemon surface
+
+
+def test_protocol_knows_scatter_op_and_shard_field():
+    assert "scatter" in protocol.OPS
+    ok = {"v": 1, "op": "submit", "argv": ["sort"],
+          "shard": {"whale": "w-1", "index": 0, "count": 2,
+                    "axis": "umi"}}
+    assert protocol.validate_request(ok) is None
+    bad = {"v": 1, "op": "submit", "argv": ["sort"], "shard": "0/2"}
+    assert "shard" in protocol.validate_request(bad)
+
+
+def test_daemon_rejects_scatter_op_and_stores_shard(tmp_path):
+    from fgumi_tpu.serve.daemon import JobService
+
+    svc = JobService(str(tmp_path / "d.sock"), workers=1, queue_limit=4)
+    try:
+        resp = svc.handle_request({"v": 1, "op": "scatter"})
+        assert not resp["ok"] and "balancer-only" in resp["error"]
+        shard = {"whale": "w-1", "index": 1, "count": 2, "axis": "umi"}
+        resp = svc.handle_request({"v": 1, "op": "submit",
+                                   "argv": ["sort", "-i", "a", "-o", "b"],
+                                   "shard": shard})
+        assert resp["ok"] and resp["job"]["shard"] == shard
+        # plain submits carry a null shard (additive wire field)
+        resp = svc.handle_request({"v": 1, "op": "submit",
+                                   "argv": ["sort"]})
+        assert resp["ok"] and resp["job"]["shard"] is None
+    finally:
+        svc.close()
+
+
+def test_journal_replay_preserves_shard_field(tmp_path):
+    from fgumi_tpu.serve.daemon import JobService
+
+    jdir = str(tmp_path / "journals")
+    shard = {"whale": "w-9", "index": 0, "count": 3, "axis": "coord"}
+    svc = JobService(str(tmp_path / "a.sock"), workers=1, queue_limit=4,
+                     journal_dir=jdir, fleet_id="a")
+    try:
+        svc.recover()
+        jid = svc.handle_request(
+            {"v": 1, "op": "submit", "argv": ["sort", "-i", "x",
+                                              "-o", "y"],
+             "shard": shard})["job"]["id"]
+    finally:
+        svc.close()
+    svc2 = JobService(str(tmp_path / "a.sock"), workers=1, queue_limit=4,
+                      journal_dir=jdir, fleet_id="a")
+    try:
+        svc2.recover()
+        job = svc2.handle_request({"v": 1, "op": "status",
+                                   "id": jid})["job"]
+        assert job["shard"] == shard  # takeover keeps whale attribution
+    finally:
+        svc2.close()
+
+
+# ---------------------------------------------------------------------------
+# balancer surface (live in-process daemons; workers never run)
+
+
+@pytest.fixture
+def scatter_fleet(tmp_path):
+    from fgumi_tpu.serve.balancer import Balancer
+    from fgumi_tpu.serve.daemon import JobService
+
+    svcs = []
+    for name in ("a", "b"):
+        svc = JobService(str(tmp_path / f"{name}.sock"), workers=1,
+                         queue_limit=8)
+        svc.start_transport()
+        svcs.append(svc)
+    bal = Balancer(f"unix:{tmp_path}/front.sock",
+                   [f"unix:{s.socket_path}" for s in svcs],
+                   poll_period_s=0.1, scatter_shards=2,
+                   scatter_wal=str(tmp_path / "scatter.wal"))
+    yield bal, svcs
+    bal.close()
+    for s in svcs:
+        s.close()
+
+
+def test_balancer_stats_v3_carries_scatter_section(scatter_fleet):
+    bal, _ = scatter_fleet
+    bal.poll_backends_once()
+    snap = bal.stats_snapshot()
+    assert snap["schema_version"] == 3
+    assert snap["scatter"]["enabled"] is True
+    assert snap["scatter"]["shards"] == 2
+
+
+def test_balancer_scatter_op_and_whale_lifecycle(scatter_fleet, tmp_path):
+    bal, _ = scatter_fleet
+    bal.poll_backends_once()
+    snap = bal.handle_request({"v": 1, "op": "scatter"})
+    assert snap["ok"] and snap["scatter"]["whales"] == {}
+    assert not bal.handle_request({"v": 1, "op": "scatter",
+                                   "id": "w-x-9"})["ok"]
+    # a whale submit through the front door (shards queue on the
+    # backends; workers never run them, so the whale stays running)
+    out = str(tmp_path / "whale-out.bam")
+    resp = bal.handle_request(
+        {"v": 1, "op": "submit",
+         "argv": ["simplex", "-i", "in.bam", "-o", out]})
+    assert resp["ok"]
+    wid = resp["job"]["id"]
+    assert wid.startswith("w-")
+    # the whale shows in per-id status, the aggregate listing, and the
+    # scatter op; its shard sub-jobs land on the real backends
+    st = bal.handle_request({"v": 1, "op": "status", "id": wid})
+    assert st["ok"] and st["job"]["scatter"]["count"] == 2
+    listing = bal.handle_request({"v": 1, "op": "status"})
+    assert any(j["id"] == wid for j in listing["jobs"])
+    one = bal.handle_request({"v": 1, "op": "scatter", "id": wid})
+    assert one["ok"] and one["scatter"]["id"] == wid
+    # cancel through the front door reaches the whale
+    cancelled = bal.handle_request({"v": 1, "op": "cancel", "id": wid})
+    assert cancelled["ok"]
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        rec = bal.handle_request({"v": 1, "op": "status", "id": wid})
+        if rec["job"]["state"] == "cancelled":
+            break
+        time.sleep(0.01)
+    assert rec["job"]["state"] == "cancelled"
+    # non-whale submits still route normally on a scatter balancer
+    plain = bal.handle_request({"v": 1, "op": "submit", "argv": ["sort"]})
+    assert plain["ok"] and not plain["job"]["id"].startswith("w-")
+
+
+def test_balancer_without_scatter_answers_not_enabled(tmp_path):
+    from fgumi_tpu.serve.balancer import Balancer
+    from fgumi_tpu.serve.daemon import JobService
+
+    svc = JobService(str(tmp_path / "a.sock"), workers=1, queue_limit=4)
+    svc.start_transport()
+    bal = Balancer(f"unix:{tmp_path}/front.sock",
+                   [f"unix:{svc.socket_path}"], poll_period_s=0.1)
+    try:
+        resp = bal.handle_request({"v": 1, "op": "scatter"})
+        assert not resp["ok"] and "not enabled" in resp["error"]
+        assert bal.stats_snapshot()["scatter"] is None
+    finally:
+        bal.close()
+        svc.close()
+
+
+def test_jobs_cli_scatter_flag(scatter_fleet, capsys):
+    from fgumi_tpu import cli
+
+    bal, svcs = scatter_fleet
+    bal.start()
+    # the full wire path: jobs --scatter -> scatter op -> JSON on stdout
+    rc = cli.main(["jobs", "--socket", bal.listen_addr, "--scatter"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    sc = json.loads(out)
+    assert sc["enabled"] is True and sc["shards"] == 2
+    # a plain daemon answers the documented balancer-only refusal
+    rc = cli.main(["jobs", "--socket", svcs[0].socket_path, "--scatter"])
+    assert rc == 2

@@ -30,6 +30,18 @@ Scenarios (exit 0 when every check holds, one PASS/FAIL line each):
    /metrics endpoint re-exports both backends' labeled series and
    agrees with the `stats` op's fleet_metrics section; the per-backend
    end-to-end submit-to-done latency summary is surfaced fleet-side.
+7. Whale scatter/gather (ISSUE 18): a fresh 2-backend fleet behind
+   `balance --scatter 2`. Submitted pipeline/simplex/duplex jobs come
+   back as whales (`w-...` ids) whose gathered outputs are
+   byte-identical to standalone runs; SIGKILLing the backend running a
+   shard mid-flight completes the whale through the journal-lease
+   takeover with a fleet-wide audit of exactly one done event per
+   shard (zero double-execution, no coordinator requeue); the same
+   whale on both backends beats the one-backend fleet by >=1.6x
+   aggregate reads/s (enforced when >=3 CPU cores are visible; loudly
+   skipped on smaller hosts where shards must timeshare one core); the
+   stats op carries schema v3 with the scatter section and /metrics
+   exports the fleet.scatter.* gauges from the same snapshot.
 
 Usage:  python tools/fleet_smoke.py [--keep]
 """
@@ -487,6 +499,294 @@ def main():
             except ServeError:
                 pass
             rc = proc.wait(timeout=120)
+            ok &= check(f"daemon {fid} exits 0", rc == 0, f"rc={rc}")
+        procs.clear()
+
+        # ================================================================
+        # Whale scatter/gather (ISSUE 18): a FRESH fleet behind
+        # `balance --scatter 2`.
+        # ================================================================
+        wd_sstd = os.path.join(tmp, "scatter_standalone")
+        wd_sfleet = os.path.join(tmp, "scatter_fleet")  # daemons AND the
+        # scatter balancers share this cwd: the gather stage resolves the
+        # shards' relative output paths against the balancer's own cwd
+        # (the documented shared-filesystem assumption)
+        jdir2 = os.path.join(tmp, "journals_scatter")
+        for d in (wd_sstd, wd_sfleet, jdir2):
+            os.makedirs(d)
+        fq1 = os.path.join(tmp, "sc_r1.fq.gz")
+        fq2 = os.path.join(tmp, "sc_r2.fq.gz")
+        p = run(["simulate", "fastq-reads", "-1", fq1, "-2", fq2,
+                 "--num-families", "120", "--family-size", "3",
+                 "--read-length", "60", "--seed", "23"], cwd=tmp)
+        assert p.returncode == 0, p.stderr
+        dup = os.path.join(tmp, "sc_duplex.bam")
+        p = run(["simulate", "duplex-reads", "-o", dup,
+                 "--num-molecules", "180", "--reads-per-strand", "3",
+                 "--read-length", "80", "--seed", "11"], cwd=tmp)
+        assert p.returncode == 0, p.stderr
+        # the kill/perf whale is big on purpose: a shard must run for
+        # seconds so the SIGKILL lands mid-shard, and the >=1.6x scaling
+        # gate must dwarf the ~1.5s fixed gather+detection overhead
+        whale_fams = 30000
+        whale_reads = whale_fams * 6
+        inp_whale = os.path.join(tmp, "sc_whale.bam")
+        p = run(["simulate", "grouped-reads", "-o", inp_whale,
+                 "--num-families", str(whale_fams), "--family-size", "6",
+                 "--seed", "9"], cwd=tmp, timeout=600)
+        assert p.returncode == 0, p.stderr
+
+        sc_jobs = {
+            "simplex": ["simplex", "-i", inp, "-o", "out_sc_simplex.bam",
+                        "--min-reads", "1"],
+            "pipeline": ["pipeline", "-i", fq1, fq2, "-r", "8M+T", "+T",
+                         "-o", "out_sc_pipeline.bam",
+                         "--filter-min-reads", "1", "--threads", "2",
+                         "--sample", "s", "--library", "l"],
+            "duplex": ["duplex", "-i", dup, "-o", "out_sc_duplex.bam",
+                       "--min-reads", "1"],
+        }
+        sc_kill = ["simplex", "-i", inp_whale, "-o", "out_sc_kill.bam",
+                   "--min-reads", "1"]
+        for argv in list(sc_jobs.values()) + [sc_kill]:
+            p = run(argv, cwd=wd_sstd, timeout=600)
+            assert p.returncode == 0, p.stderr
+
+        # --- scatter fleet up: 2 daemons + `balance --scatter 2` --------
+        ports2 = {"c": free_port(), "d": free_port()}
+        front2 = free_port()
+        mport2 = free_port()
+
+        def start_scatter_daemon(fid):
+            argv = [sys.executable, "-m", "fgumi_tpu", "serve",
+                    "--tcp", f"127.0.0.1:{ports2[fid]}",
+                    "--workers", "1", "--queue-limit", "4",
+                    "--journal-dir", jdir2, "--fleet-id", fid,
+                    "--lease-scan-period", "0.5",
+                    "--compile-cache", cache, "--token-file", tok]
+            return subprocess.Popen(argv, cwd=wd_sfleet, env=BASE_ENV,
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT, text=True)
+
+        def start_scatter_balancer(port, fids, metrics_port=None):
+            argv = [sys.executable, "-m", "fgumi_tpu", "balance",
+                    "--listen", f"tcp:127.0.0.1:{port}"]
+            for fid in fids:
+                argv += ["--backend", f"tcp:127.0.0.1:{ports2[fid]}"]
+            argv += ["--token-file", tok, "--poll-period", "0.3",
+                     "--scatter", "2",
+                     "--scatter-wal", os.path.join(tmp, f"sc_{port}.wal")]
+            if metrics_port:
+                argv += ["--metrics-port", str(metrics_port)]
+            return subprocess.Popen(argv, cwd=wd_sfleet, env=BASE_ENV,
+                                    stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT, text=True)
+
+        procs["c"] = start_scatter_daemon("c")
+        procs["d"] = start_scatter_daemon("d")
+        procs["bal_sc"] = start_scatter_balancer(front2, ("c", "d"),
+                                                 metrics_port=mport2)
+        sclient = ServeClient(f"tcp:127.0.0.1:{front2}", timeout=30,
+                              token=TOKEN)
+        ping = wait_for_ping(sclient)
+        ok &= check("scatter balancer front end answers",
+                    ping is not None
+                    and ping.get("tool") == "fgumi-tpu-balance", str(ping))
+        addr_c = f"tcp:127.0.0.1:{ports2['c']}"
+        addr_d = f"tcp:127.0.0.1:{ports2['d']}"
+        # both backends must be HEALTHY before any whale goes in: an
+        # unknown-depth backend sorts last in routing, so a premature
+        # fan-out would stack both shards on the already-polled daemon
+        ok &= check("scatter fleet: both backends healthy",
+                    wait_backend_state(sclient, addr_c, "closed")
+                    and wait_backend_state(sclient, addr_d, "closed"))
+
+        # --- byte-identity: pipeline / simplex / duplex whales ----------
+        for name, argv in sc_jobs.items():
+            j = sclient.submit(argv, argv0=argv0)
+            is_whale = j["id"].startswith("w-")
+            rec = sclient.scatter(j["id"]) if is_whale else {}
+            nshards = len(rec.get("scatter", {}).get("shards", []))
+            j = wait_job_tolerant(sclient, j["id"], timeout=300)
+            a = open(os.path.join(wd_sstd, f"out_sc_{name}.bam"),
+                     "rb").read()
+            bp = os.path.join(wd_sfleet, f"out_sc_{name}.bam")
+            b = open(bp, "rb").read() if os.path.exists(bp) else b""
+            ok &= check(f"{name} whale scattered 2-way, gathered "
+                        "byte-identical to standalone",
+                        is_whale and nshards == 2 and j
+                        and j.get("state") == "done" and a == b,
+                        f"whale={is_whale} shards={nshards} "
+                        f"state={j and j.get('state')} "
+                        f"{len(a)} vs {len(b)} bytes")
+        leftovers = [n for n in os.listdir(wd_sfleet) if ".scatter" in n]
+        ok &= check("no shard leftovers after gathers", not leftovers,
+                    ",".join(leftovers))
+
+        # --- kill one backend MID-SHARD ---------------------------------
+        jk = sclient.submit(sc_kill, argv0=argv0, dedupe="whale-kill")
+        ok &= check("kill job accepted as a whale",
+                    jk["id"].startswith("w-"), jk["id"])
+        victim = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            rec = sclient.scatter(jk["id"]) or {}
+            running = [s for s in rec.get("scatter", {}).get("shards", [])
+                       if s["state"] == "running" and s["job_id"]]
+            if running:
+                victim = running[0]["job_id"].split("-j-")[0]
+                break
+            if rec.get("state") in ("done", "failed", "cancelled"):
+                break  # finished before the kill: the scenario is void
+            time.sleep(0.1)
+        ok &= check("a shard observed running before SIGKILL",
+                    victim in ("c", "d"),
+                    f"victim={victim} whale={rec.get('state')}")
+        if victim in ("c", "d"):
+            procs[victim].kill()  # no drain: the shard dies mid-flight
+            procs[victim].wait(timeout=30)
+        jk_final = wait_job_tolerant(sclient, jk["id"], timeout=300)
+        ok &= check("whale completes through the shard-level takeover",
+                    jk_final and jk_final.get("state") == "done",
+                    str(jk_final and (jk_final.get("error")
+                                      or jk_final.get("state"))))
+        a = open(os.path.join(wd_sstd, "out_sc_kill.bam"), "rb").read()
+        bp = os.path.join(wd_sfleet, "out_sc_kill.bam")
+        b = open(bp, "rb").read() if os.path.exists(bp) else b""
+        ok &= check("takeover whale output byte-identical to standalone",
+                    a == b, f"{len(a)} vs {len(b)} bytes")
+        # zero double-execution: the dead daemon's shard finished under
+        # its ORIGINAL job id via the journal-lease takeover (attempt
+        # stays 0 — the coordinator's requeue grace never expired) and
+        # the fleet journals carry exactly one done event per shard
+        rec = sclient.scatter(jk["id"]) or {}
+        shard_recs = rec.get("scatter", {}).get("shards", [])
+        shard_ids = [s["job_id"] for s in shard_recs]
+        ok &= check("takeover kept the ORIGINAL shard ids "
+                    "(no coordinator requeue)",
+                    len(shard_ids) == 2 and all(shard_ids)
+                    and all(s["attempt"] == 0 for s in shard_recs),
+                    json.dumps(shard_recs))
+        events = journal_events(jdir2)
+        per_shard = {sid: sum(1 for e in events if e.get("id") == sid
+                              and e.get("state") == "done")
+                     for sid in shard_ids}
+        ok &= check("journal audit: exactly one done event per shard "
+                    "(zero double-execution)",
+                    bool(per_shard)
+                    and all(v == 1 for v in per_shard.values()),
+                    json.dumps(per_shard))
+
+        # --- restart the victim, then the scaling gate ------------------
+        if victim in ("c", "d"):
+            procs[victim] = start_scatter_daemon(victim)
+        victim_addr = addr_c if victim == "c" else addr_d
+        ok &= check("killed scatter backend re-admitted",
+                    wait_backend_state(sclient, victim_addr, "closed",
+                                       timeout=90),
+                    json.dumps(backend_states(sclient)))
+        # warm round: the restarted daemon re-loads the whale shard
+        # shapes from the shared compile cache; keep that out of the
+        # timed comparison
+        jw = sclient.submit(["simplex", "-i", inp_whale, "-o",
+                             "out_sc_warm.bam", "--min-reads", "1"],
+                            argv0=argv0)
+        jw = wait_job_tolerant(sclient, jw["id"], timeout=300)
+        ok &= check("warm whale done", jw and jw.get("state") == "done",
+                    str(jw and (jw.get("error") or jw.get("state"))))
+        t0 = time.monotonic()
+        j2 = sclient.submit(["simplex", "-i", inp_whale, "-o",
+                             "out_sc_t2.bam", "--min-reads", "1"],
+                            argv0=argv0)
+        j2 = wait_job_tolerant(sclient, j2["id"], timeout=300)
+        t_two = time.monotonic() - t0
+        ok &= check("timed 2-backend whale done",
+                    j2 and j2.get("state") == "done", f"{t_two:.2f}s")
+        # the SAME whale behind a 1-backend scatter balancer: the
+        # fairness cap (healthy // whales = 1) strictly serializes the
+        # shards, so this measures one backend doing all the work
+        front1 = free_port()
+        procs["bal_sc1"] = start_scatter_balancer(front1, ("c",))
+        sclient1 = ServeClient(f"tcp:127.0.0.1:{front1}", timeout=30,
+                               token=TOKEN)
+        wait_for_ping(sclient1)
+        ok &= check("1-backend scatter balancer up, backend healthy",
+                    wait_backend_state(sclient1, addr_c, "closed"))
+        t0 = time.monotonic()
+        j1 = sclient1.submit(["simplex", "-i", inp_whale, "-o",
+                              "out_sc_t1.bam", "--min-reads", "1"],
+                             argv0=argv0)
+        j1 = wait_job_tolerant(sclient1, j1["id"], timeout=600)
+        t_one = time.monotonic() - t0
+        ok &= check("timed 1-backend whale done",
+                    j1 and j1.get("state") == "done", f"{t_one:.2f}s")
+        rps_two = whale_reads / t_two
+        rps_one = whale_reads / t_one
+        shard_fids = {s["job_id"].split("-j-")[0]
+                      for s in (sclient.scatter(j2["id"]) or {})
+                      .get("scatter", {}).get("shards", [])
+                      if s["job_id"]}
+        ok &= check("timed whale spread one shard to EACH backend",
+                    shard_fids == {"c", "d"}, str(sorted(shard_fids)))
+        cores = len(os.sched_getaffinity(0))
+        scaling = (f"{rps_two:,.0f} vs {rps_one:,.0f} reads/s "
+                   f"({t_one:.2f}s / {t_two:.2f}s = "
+                   f"{t_one / t_two:.2f}x, {cores} core(s))")
+        if cores >= 3:
+            ok &= check("2-backend fleet beats 1 backend by >=1.6x "
+                        "aggregate reads/s on the scatter workload",
+                        rps_two >= 1.6 * rps_one, scaling)
+        else:
+            # the >=1.6x gate needs parallel hardware: pinned to fewer
+            # than 3 cores (2 daemons + balancer) the shard processes
+            # timeshare ONE cpu and wall-clock cannot improve. Loud
+            # skip, never a silent pass — the spread check above still
+            # proves both backends did the work, and the bound below
+            # that timesharing overhead stays small
+            print(f"SKIP  2-backend >=1.6x scaling gate: only {cores} "
+                  f"CPU core(s) visible, shards timeshare one core  "
+                  f"({scaling})")
+            ok &= check("scatter overhead bounded on a timesharing "
+                        "host", t_two <= 1.5 * t_one + 1.0, scaling)
+
+        # --- scatter observability --------------------------------------
+        snap = sclient.stats()
+        sc = snap.get("scatter") or {}
+        ok &= check("balancer stats v3 carries the scatter section",
+                    snap.get("schema_version") == 3
+                    and sc.get("enabled") is True and sc.get("shards") == 2
+                    and sc.get("whales", {}).get("done", 0) >= 5,
+                    json.dumps({k: sc.get(k) for k in
+                                ("enabled", "shards", "whales")}))
+        metrics_body = urllib.request.urlopen(
+            f"http://127.0.0.1:{mport2}/metrics", timeout=10
+        ).read().decode()
+        ok &= check("/metrics exports the fleet.scatter.* gauges",
+                    "fgumi_tpu_fleet_scatter_enabled 1" in metrics_body
+                    and "fgumi_tpu_fleet_scatter_shards_per_whale 2"
+                    in metrics_body
+                    and 'fgumi_tpu_fleet_scatter_whales_state'
+                        '{state="done"}' in metrics_body,
+                    "\n".join(ln for ln in metrics_body.splitlines()
+                              if "scatter" in ln)[:300])
+
+        # --- scatter fleet clean shutdown -------------------------------
+        sclient1.shutdown()
+        rc = procs.pop("bal_sc1").wait(timeout=60)
+        ok &= check("1-backend scatter balancer exits 0", rc == 0,
+                    f"rc={rc}")
+        sclient.shutdown()
+        rc = procs.pop("bal_sc").wait(timeout=60)
+        ok &= check("scatter balancer exits 0 on shutdown", rc == 0,
+                    f"rc={rc}")
+        for fid in ("c", "d"):
+            direct = ServeClient(f"tcp:127.0.0.1:{ports2[fid]}",
+                                 timeout=30, token=TOKEN)
+            try:
+                direct.shutdown()
+            except ServeError:
+                pass
+            rc = procs[fid].wait(timeout=120)
             ok &= check(f"daemon {fid} exits 0", rc == 0, f"rc={rc}")
         procs.clear()
     finally:

@@ -104,6 +104,58 @@ S_MAXD, S_SUMD, S_SUME, S_QSUM, S_NAFTER, S_NEWLY, S_SUSPECT = range(7)
 STATS_COLS = 7
 
 
+def fused_stats_oracle(winner, qual, depth, errors, lens, min_reads_c,
+                       min_qual_c, params: DeviceFilterParams):
+    """Numpy twin of the fused kernel's threshold+filter epilogue
+    (ops/kernel._wire_filter_fn) over PRE-threshold (J, L) columns.
+
+    Built for the sentinel's fused-route audit (ops/sentinel.py): given
+    the f64 host oracle's winner/qual/depth/errors, re-derives the masked
+    columns and the (J, STATS_COLS) stats rows with exactly the device's
+    integer math — consensus thresholds, the emin-table per-base compare,
+    the min-base-quality compare — so any device bit flip in the fetched
+    stats (or the survivor gather) shows as an exact mismatch. The
+    suspect column is device-internal and stays 0 here; callers compare
+    it separately. Returns (stats int32, masked_bases u8, masked_quals
+    u8)."""
+    w = np.asarray(winner, dtype=np.int32)
+    q = np.asarray(qual, dtype=np.int32)
+    d = np.asarray(depth, dtype=np.int32)
+    e = np.asarray(errors, dtype=np.int32)
+    n, L = w.shape
+    lens = np.asarray(lens, dtype=np.int64)
+    low_depth = d < np.int32(min_reads_c)
+    low_qual = q < np.int32(min_qual_c)
+    tb = np.where(low_depth | low_qual, N_CODE, w)
+    tq = np.where(low_depth, 0, np.where(low_qual, MIN_PHRED, q))
+    in_len = np.arange(L, dtype=np.int64)[None, :] < lens[:, None]
+    d16 = np.minimum(d, _I16_MAX)
+    e16 = np.minimum(e, _I16_MAX)
+    per_base = bool(params.per_base)
+    if per_base:
+        fmask = (d16 < params.min_reads) \
+            | ((d16 > 0) & (e16 >= params.emin_tab[d16]))
+    else:
+        fmask = np.zeros((n, L), dtype=bool)
+    if int(params.min_base_q) >= 0:
+        fmask = fmask | (tq < params.min_base_q)
+    fmask = fmask & in_len
+    fb = np.where(fmask, N_CODE, tb).astype(np.uint8)
+    fq = np.where(fmask, MIN_PHRED, tq).astype(np.uint8)
+    stats = np.zeros((n, STATS_COLS), dtype=np.int32)
+    if L:
+        stats[:, S_MAXD] = np.max(np.where(in_len, d16, 0), axis=1)
+    stats[:, S_SUMD] = np.sum(np.where(in_len, d16, 0), axis=1,
+                              dtype=np.int32)
+    stats[:, S_SUME] = np.sum(np.where(in_len, e16, 0), axis=1,
+                              dtype=np.int32)
+    stats[:, S_QSUM] = np.sum(np.where(in_len, tq, 0), axis=1,
+                              dtype=np.int32)
+    stats[:, S_NAFTER] = np.sum(in_len & (fb == N_CODE), axis=1)
+    stats[:, S_NEWLY] = np.sum(fmask & (tb != N_CODE), axis=1)
+    return stats, fb, fq
+
+
 class SimplexFilterStage:
     """Fused filter stage for the fast simplex engine (one per run).
 

@@ -46,7 +46,12 @@ import time
 #: commit, host-complete residual — components never summing past
 #: ``total_s``), and ``xla_profile_dir`` (the --xla-profile capture
 #: directory, when one was taken).
-SCHEMA_VERSION = 5
+#: v6 (ISSUE 19): the ``device`` section may carry the kernel-backend
+#: counters ``kernel_pallas`` / ``kernel_xla`` (wire dispatches executed
+#: by the hand-tiled Pallas kernel vs the XLA-lowered oracle; absent when
+#: no wire dispatch ran), and DeviceStats timeline entries (flight dumps,
+#: ``--stats`` report) gain a per-dispatch ``kernel_backend`` stamp.
+SCHEMA_VERSION = 6
 
 
 def _device_stats():

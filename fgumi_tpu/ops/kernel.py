@@ -369,6 +369,10 @@ class DeviceStats:
         self.donated_uploads = 0
         self.resident_bytes = 0
         self.resident_bytes_peak = 0
+        # kernel-backend accounting (ISSUE 19): wire dispatches executed
+        # by the hand-tiled Pallas kernel vs the XLA-lowered oracle
+        self.kernel_pallas = 0
+        self.kernel_xla = 0
         self.timeline = []  # per-dispatch dicts (capped; --stats report)
         # stamps for dispatches past the timeline cap, alive only until
         # resolve (begin_in_flight/end_in_flight; bounded)
@@ -424,6 +428,22 @@ class DeviceStats:
     def add_donated_upload(self):
         with self._lock:
             self.donated_uploads += 1
+
+    def add_kernel_backend(self, slot: int, backend: str):
+        """Record which kernel backend ran a wire dispatch (ISSUE 19):
+        counter + timeline stamp, so a flight dump on a wedge names the
+        kernel that wedged."""
+        with self._lock:
+            if backend == "pallas":
+                self.kernel_pallas += 1
+            else:
+                self.kernel_xla += 1
+            entry = self._entry_locked(slot)
+            if entry is not None:
+                entry["kernel_backend"] = backend
+        from ..observe.metrics import METRICS
+
+        METRICS.inc(f"device.kernel.{backend}")
 
     def add_resident_bytes(self, n: int):
         with self._lock:
@@ -621,6 +641,9 @@ class DeviceStats:
                 out["route_host"] = self.route_host
             if self.donated_uploads:
                 out["donated_uploads"] = self.donated_uploads
+            if self.kernel_pallas or self.kernel_xla:
+                out["kernel_pallas"] = self.kernel_pallas
+                out["kernel_xla"] = self.kernel_xla
             if self.resident_bytes_peak:
                 out["resident_bytes_peak"] = self.resident_bytes_peak
                 if self.resident_bytes:
@@ -653,7 +676,8 @@ class DeviceStats:
                 "upload_overlap_s", "feeder_queue_peak", "const_uploads",
                 "const_hits", "const_upload_bytes", "route_device",
                 "route_host", "donated_uploads", "resident_bytes",
-                "resident_bytes_peak", "_t0", "_next_slot")}
+                "resident_bytes_peak", "kernel_pallas", "kernel_xla",
+                "_t0", "_next_slot")}
             timeline = [dict(t) for t in other.timeline]
             tail = {s: dict(t) for s, t in other._tail_entries.items()}
         with self._lock:
@@ -723,7 +747,8 @@ def _observe_dispatch_latency(entry: dict) -> None:
 
         FLIGHT.note("device.dispatch", wall_s=round(wall, 4),
                     up_bytes=entry.get("up_bytes", 0),
-                    down_bytes=entry.get("down_bytes", 0))
+                    down_bytes=entry.get("down_bytes", 0),
+                    kernel=entry.get("kernel_backend", "xla"))
 
 
 #: Fallback instance used when no telemetry scope is active (library use,
@@ -773,7 +798,7 @@ class DispatchTicket:
 
     __slots__ = ("_event", "_result", "_exc", "slot", "upload_bytes",
                  "_released", "_abandoned", "mesh_gather", "mesh_devices",
-                 "mesh_f_loc", "staging", "filter_mode")
+                 "mesh_f_loc", "staging", "filter_mode", "filter_ctx")
 
     def __init__(self):
         self._event = threading.Event()
@@ -788,7 +813,10 @@ class DispatchTicket:
         # may still be reading them)
         self.staging = None
         # fused consensus→filter dispatch (resolve_segments_wire_filtered)
+        # + its host-side filter parameters, retained so the sentinel's
+        # fused-route audit tap can rebuild the f64 oracle stats row
         self.filter_mode = False
+        self.filter_ctx = None
         # mesh dispatches (device_call_segments_wire mesh=...): the
         # family-order gather over the shard-ordered device output, the
         # mesh size the router's per-mesh cost model is keyed by, and the
@@ -2907,6 +2935,10 @@ class ConsensusKernel:
                                           "wire dispatch"),
                 upload_bytes=plan.upload, slot=slot)
         ticket.filter_mode = plan.filter_mode
+        if plan.filter_mode:
+            # retained for the sentinel's fused-route audit tap
+            # (resolve_segments_wire_filtered -> SENTINEL.maybe_audit_filter)
+            ticket.filter_ctx = filter_params
         if plan.staging:
             ticket.staging = plan.staging
         return ticket
@@ -2937,8 +2969,20 @@ class ConsensusKernel:
             wire, dict32 = w
             upload = wire.nbytes + seg_ids.nbytes
             resident = resident_thresholds is not None
-            kind = ("segwx" if filt else "segwr" if resident
-                    else ("segwf" if full else "segw"))
+            # ISSUE 19: the hand-tiled Pallas kernel covers the
+            # full-column and fused-filter wire dispatches; resident
+            # (duplex-combine), plain, packed2 and mesh stay XLA. The
+            # backend is pinned at plan-build time so the shape registry
+            # attributes compiles to the kernel that actually runs.
+            use_pallas = False
+            if filt or (full and not resident):
+                from . import pallas_kernel as _pk
+
+                use_pallas = _pk.selected_backend() == "pallas"
+            kind = (("segwxp" if use_pallas else "segwx") if filt
+                    else "segwr" if resident
+                    else (("segwfp" if use_pallas else "segwf") if full
+                          else "segw"))
             new = SHAPE_REGISTRY.observe(
                 kind, wire.shape[0], wire.shape[1], num_segments,
                 out_segments)
@@ -2952,12 +2996,29 @@ class ConsensusKernel:
 
             def _dispatch(slot):
                 _ensure_jax()
+                if use_pallas:
+                    # Pallas manages its own blocks — upload donation is
+                    # a no-op here (not counted), and the wire dictionary
+                    # rides the kernel's scalar-prefetch channel (256 B)
+                    # instead of the constant cache.
+                    from . import pallas_kernel as _pk
+
+                    t0 = time.monotonic()
+                    prep = _pk.upload(wire, seg_ids, dict32, num_segments)
+                    DEVICE_STATS.note_upload(slot, time.monotonic() - t0)
+                    DEVICE_STATS.add_kernel_backend(slot, "pallas")
+                    if filt:
+                        out = _pk.call_filter(prep, pre, mr, mq, lens_pad,
+                                              fparams, out_segments)
+                        return (out[0], ResidentHandles(out[1:]))
+                    return _pk.call_full(prep, pre, out_segments)
                 donate = upload_donation_enabled()
                 t0 = time.monotonic()
                 wd = jax.device_put(wire)
                 sd = jax.device_put(seg_ids)
                 dtab = CONST_CACHE.put("dict_tab", dict32)
                 DEVICE_STATS.note_upload(slot, time.monotonic() - t0)
+                DEVICE_STATS.add_kernel_backend(slot, "xla")
                 if donate:
                     DEVICE_STATS.add_donated_upload()
                 if filt:
@@ -3001,6 +3062,7 @@ class ConsensusKernel:
                 sd = jax.device_put(seg_ids)
                 ct, et = tables_dev()
                 DEVICE_STATS.note_upload(slot, time.monotonic() - t0)
+                DEVICE_STATS.add_kernel_backend(slot, "xla")
                 if donate:
                     DEVICE_STATS.add_donated_upload()
                 if full:
@@ -3048,6 +3110,7 @@ class ConsensusKernel:
                 dtab = CONST_CACHE.put("dict_tab", dict32,
                                        sharding=repl_sh)
                 DEVICE_STATS.note_upload(slot, time.monotonic() - t0)
+                DEVICE_STATS.add_kernel_backend(slot, "xla")
                 if resident:
                     out = _consensus_segments_wire_resident_mesh_jit(
                         wd, sd, dtab, pre, mr, mq, F_loc, mesh)
@@ -3073,6 +3136,7 @@ class ConsensusKernel:
                 et = CONST_CACHE.put("err_tab", self._err_f32,
                                      sharding=repl_sh)
                 DEVICE_STATS.note_upload(slot, time.monotonic() - t0)
+                DEVICE_STATS.add_kernel_backend(slot, "xla")
                 return _consensus_segments_packed2_mesh_jit(
                     cd, qd, sd, ct, et, pre, F_loc, mesh, full)
         DEVICE_STATS.add_dispatch(segments_flops(
@@ -3341,6 +3405,12 @@ class ConsensusKernel:
             left = None if deadline is None else \
                 max(deadline - (time.monotonic() - t0), 1.0)
             stats = _fetch_with_deadline(stats_dev, left)
+            from ..utils import faults
+
+            # fault-injection seam (tools/chaos_smoke.py): the fused
+            # route's only default fetch is the stats rows — corrupt-result
+            # SDC drills must be able to hit it like any other fetch
+            stats = faults.fire("device.fetch", stats)
             fetched = stats.nbytes
         except BaseException as e:  # noqa: BLE001 - recovered below
             failure = e
@@ -3377,7 +3447,21 @@ class ConsensusKernel:
                                   wait_s, up_s + wait_s,
                                   devices=ticket.mesh_devices)
         J = len(starts) - 1
-        return ("stats", np.asarray(stats[:J]), resident)
+        stats = np.asarray(stats[:J])
+        # fused-route audit tap (ISSUE 19, closing the PR 13 gap): the
+        # sentinel re-derives the stats rows (and, inline, the survivor
+        # gather) from the f64 host oracle. An inline divergence returns
+        # repaired pre-threshold columns — hand those to the caller's
+        # host filter pass exactly like a degraded dispatch.
+        from .sentinel import SENTINEL
+
+        repaired = SENTINEL.maybe_audit_filter(
+            self, codes2d, quals2d, starts, stats, resident,
+            ticket.filter_ctx, slot=ticket.slot)
+        if repaired is not None:
+            resident.release()
+            return ("columns",) + repaired
+        return ("stats", stats, resident)
 
     def filter_resolve_suspect_rows(self, resident, rows, starts,
                                     codes2d: np.ndarray,

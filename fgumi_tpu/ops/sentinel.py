@@ -260,6 +260,203 @@ class AuditSentinel:
         METRICS.observe("device.audit.tap_s", time.monotonic() - t0)
         return None
 
+    # ------------------------------------------- fused-filter route tap
+
+    def maybe_audit_filter(self, kernel, codes2d, quals2d, starts, stats,
+                           resident, filter_ctx, slot: int = -1):
+        """The fused consensus→filter resolve tap (ISSUE 19, closing the
+        PR 13 gap): `--device-filter` dispatches fetch only a (J, 7) i32
+        stats row, so the standard column tap never sees them.
+
+        Audits against the f64 host oracle + the numpy twin of the
+        device's integer filter epilogue
+        (consensus.device_filter.fused_stats_oracle), restricted to rows
+        whose device stats carry suspect == 0 — the guard band proves
+        those rows exact on every backend, and device-suspect rows are
+        re-resolved host-side downstream regardless (a corrupt bit that
+        turns suspect ON costs performance, never bytes; one that turns
+        it OFF exposes the row to this comparison). Inline audits
+        additionally verify the survivors-gather bytes off the resident
+        columns. Returns None, or — inline divergence — the repaired
+        pre-threshold (winner, qual, depth, errors) oracle tuple; the
+        caller then releases the resident columns and falls back to its
+        host filter pass. Never raises."""
+        try:
+            return self._maybe_audit_filter(kernel, codes2d, quals2d,
+                                            starts, stats, resident,
+                                            filter_ctx, slot)
+        except Exception:  # noqa: BLE001 - audit failure != batch failure
+            log.exception("audit sentinel: filter tap failed; dispatch "
+                          "unaudited")
+            return None
+
+    def _maybe_audit_filter(self, kernel, codes2d, quals2d, starts, stats,
+                            resident, filter_ctx, slot):
+        rate = audit_rate()
+        from .breaker import BREAKER
+
+        forced = BREAKER.audit_required()
+        if (rate <= 0 and not forced) or filter_ctx is None:
+            return None
+        from ..native import batch as nb
+
+        if not nb.available():
+            return None
+        t0 = time.monotonic()
+        with self._lock:
+            self._counter += 1
+            ordinal = self._counter
+        if not (forced or rate == 1 or ordinal % rate == 0):
+            return None
+        from ..observe.metrics import METRICS
+
+        inline = forced or rate == 1
+        with self._lock:
+            self.sampled += 1
+            self.sampled_ordinals.append(ordinal)
+            if not inline and len(self._q) >= _queue_cap():
+                self.dropped += 1
+                drop = True
+            else:
+                drop = False
+                self._device_locked(0)["sampled"] += 1
+        METRICS.inc("device.audit.sampled")
+        if drop:
+            METRICS.inc("device.audit.dropped")
+            return None
+        mr, mq, lens_j, fparams = filter_ctx
+        item = self._retain(kernel, codes2d, quals2d, starts,
+                            *(np.zeros(0, np.int32),) * 4, 1, None, None,
+                            slot, ordinal)
+        item["forced"] = forced
+        item["filter"] = {
+            "stats": np.array(stats, copy=True),
+            "mr": int(mr), "mq": int(mq),
+            "lens": np.array(lens_j, dtype=np.int64, copy=True),
+            "fparams": fparams,
+            # resident columns only ride an INLINE audit: a background
+            # sample must not race the caller's survivor gather/release
+            "resident": resident if inline else None,
+        }
+        if inline:
+            with self._lock:
+                self.inline_audits += 1
+            repaired = self._audit_filter_one(item)
+            METRICS.observe("device.audit.tap_s", time.monotonic() - t0)
+            return repaired
+        with self._lock:
+            import contextvars
+
+            self._q.append((contextvars.copy_context(), item))
+            self._ensure_thread_locked()
+            self._cv.notify_all()
+        METRICS.observe("device.audit.tap_s", time.monotonic() - t0)
+        return None
+
+    def _audit_filter_one(self, item):
+        """Oracle re-derivation of one fused-filter dispatch: stats rows
+        always; survivor-gather bytes when the resident columns rode
+        along (inline). Returns the repaired pre-threshold oracle tuple
+        on divergence, else None."""
+        try:
+            from ..consensus.device_filter import (S_SUSPECT,
+                                                   fused_stats_oracle)
+
+            fctx = item["filter"]
+            engine = item["kernel"]._host()
+            # same deliberate bypass of _host_engine_complete as
+            # _audit_one: measurement, not workload
+            w, q, d, e, _n_slow = engine.call_segments_counted(
+                item["codes"], item["quals"], item["starts"])
+            host_stats, host_fb, host_fq = fused_stats_oracle(
+                w, q, d, e, fctx["lens"], fctx["mr"], fctx["mq"],
+                fctx["fparams"])
+            dev_stats = item["stats"] = fctx["stats"]
+            trusted = dev_stats[:, S_SUSPECT] == 0
+            mask = trusted & (dev_stats[:, :S_SUSPECT]
+                              != host_stats[:, :S_SUSPECT]).any(axis=1)
+            bad_fields = ["stats"] if mask.any() else []
+            resident = fctx["resident"]
+            if resident is not None and not mask.any():
+                gmask = self._gather_divergence(
+                    item["kernel"], resident, trusted, fctx["lens"],
+                    host_fb, host_fq, d, e)
+                if gmask is None:
+                    return None  # gather weather: unaudited, no verdict
+                if gmask.any():
+                    mask = gmask
+                    bad_fields = ["gather"]
+            if not bad_fields:
+                self._verdict_clean(item)
+                return None
+            self._filter_divergent(item, host_stats, bad_fields, mask)
+            return w, q, d, e
+        finally:
+            self._release(item)
+
+    def _gather_divergence(self, kernel, resident, trusted, lens,
+                           host_fb, host_fq, host_d, host_e):
+        """Inline-only survivor-gather audit: fetch every row's masked
+        columns off the resident arrays and compare the consumed surface
+        (in-length positions of non-suspect rows) against the oracle.
+        None = gather failed (device weather), no verdict either way."""
+        J = len(lens)
+        try:
+            fb, fq, dd, ee = kernel.filter_gather_filtered(
+                resident, np.arange(J, dtype=np.int64))
+        except Exception as exc:  # noqa: BLE001 - weather, not corruption
+            log.warning("audit sentinel: survivor-gather audit skipped "
+                        "(gather failed: %s)", exc)
+            return None
+        in_len = (np.arange(host_fb.shape[1], dtype=np.int64)[None, :]
+                  < np.asarray(lens)[:, None])
+        keep = trusted[:, None] & in_len
+        diff = ((fb != host_fb) | (fq != host_fq)
+                | (dd != host_d) | (ee != host_e)) & keep
+        return diff.any(axis=1)
+
+    def _filter_divergent(self, item, host_stats, bad_fields, fam_mask):
+        """Divergence verdict for the fused-filter route: same evidence
+        chain as _verdict_divergent (record, flight note + black box,
+        SDC quarantine), with the stats rows as the compared buffers."""
+        fam_idx = np.nonzero(fam_mask)[0]
+        record = {
+            "ordinal": item["ordinal"],
+            "slot": item["slot"],
+            "route": "device-filter",
+            "families": int(len(fam_idx)),
+            "first_families": [int(f) for f in fam_idx[:8]],
+            "fields": bad_fields,
+            "devices": [0],
+            "device_digest": _digest([item["stats"]]),
+            "host_digest": _digest([host_stats]),
+        }
+        from ..observe.metrics import METRICS
+
+        with self._lock:
+            self.divergent += 1
+            self.divergences.append(record)
+            self._device_locked(0)["divergent"] += 1
+        METRICS.inc("device.audit.divergent")
+        log.error(
+            "AUDIT DIVERGENCE: fused-filter dispatch (slot %d) disagrees "
+            "with the f64 host oracle on %d/%d reads (fields: %s) — "
+            "silent data corruption; quarantining the device (device "
+            "digest %.12s..., host digest %.12s...)",
+            item["slot"], len(fam_idx), len(fam_mask),
+            ",".join(bad_fields), record["device_digest"],
+            record["host_digest"])
+        from ..observe.flight import FLIGHT
+
+        FLIGHT.note("audit.divergence", **{k: v for k, v in record.items()
+                                           if k != "first_families"})
+        from .breaker import BREAKER
+
+        BREAKER.record_sdc(
+            f"{len(fam_idx)} reads, fused-filter fields "
+            f"{','.join(bad_fields)}")
+        FLIGHT.dump("sdc-divergence", **record)
+
     def _retain(self, kernel, codes2d, quals2d, starts, winner, qual,
                 depth, errors, devices, gather, f_loc, slot, ordinal,
                 partner=None):
@@ -325,7 +522,8 @@ class AuditSentinel:
             try:
                 # the submitting resolve's context rides along so the
                 # clean/divergent metrics land in its telemetry scope
-                ctx.run(self._audit_one, item)
+                ctx.run(self._audit_filter_one if "filter" in item
+                        else self._audit_one, item)
             except Exception:  # noqa: BLE001 - worker must survive
                 log.exception("audit sentinel: background audit raised")
 

@@ -128,6 +128,65 @@ def bench_full_column(out):
             out[f"full_column_fam{fam}_device_vs_host"] = round(dth / dt, 3)
 
 
+def bench_pallas(out):
+    """Hand-tiled Pallas wire kernel vs the XLA lowering (ISSUE 19) at
+    the same 3 family-size profiles as bench_full_column: full dispatch +
+    resolve s and rows/s per backend, plus the ratio ROADMAP item 1's
+    hardware round gates on (bar >= 2x kernel compute throughput). On a
+    CPU host Pallas runs in Mosaic interpret mode — the recorded numbers
+    carry a loud ``pallas_interpreted: true`` flag and must NEVER be read
+    as silicon evidence (interpret mode is orders of magnitude slower;
+    only the parity matters there)."""
+    import numpy as np
+
+    from fgumi_tpu.ops import pallas_kernel
+    from fgumi_tpu.ops.kernel import ConsensusKernel, pad_segments
+    from fgumi_tpu.ops.tables import quality_tables
+
+    if not pallas_kernel.available():
+        out["pallas_available"] = False
+        return
+    interp = pallas_kernel.interpreted()
+    out["pallas_available"] = True
+    out["pallas_interpreted"] = interp
+    kernel = ConsensusKernel(quality_tables(45, 40))
+    kernel.set_force_device()
+    rng = np.random.default_rng(31)
+    L = 100
+    # interpret mode is ~1000x silicon: shrink the batch so CI stays fast
+    # while real hardware measures the bench_full_column-scale batches
+    scale = 20 if interp else 1
+    prev = os.environ.get("FGUMI_TPU_KERNEL")
+    try:
+        for fam, n_fam in ((3, 4000 // scale), (10, 1600 // scale),
+                           (30, 600 // scale)):
+            codes, quals = _family_pileup(rng, n_fam, fam, L)
+            counts = np.full(n_fam, fam, dtype=np.int64)
+            starts = (np.arange(n_fam + 1) * fam).astype(np.int64)
+            rows = n_fam * fam
+
+            def wire():
+                cd, qd, seg, _st, F = pad_segments(codes, quals, counts)
+                t = kernel.device_call_segments_wire(cd, qd, seg, F,
+                                                     n_fam, full=True)
+                kernel.resolve_segments_wire(t, codes, quals, starts)
+
+            for backend in ("pallas", "xla"):
+                os.environ["FGUMI_TPU_KERNEL"] = backend
+                dt = _timeit(wire)
+                out[f"pallas_fam{fam}_{backend}_s"] = round(dt, 4)
+                out[f"pallas_fam{fam}_{backend}_rows_per_sec"] = round(
+                    rows / dt, 1)
+            out[f"pallas_fam{fam}_speedup_vs_xla"] = round(
+                out[f"pallas_fam{fam}_xla_s"]
+                / out[f"pallas_fam{fam}_pallas_s"], 3)
+    finally:
+        if prev is None:
+            os.environ.pop("FGUMI_TPU_KERNEL", None)
+        else:
+            os.environ["FGUMI_TPU_KERNEL"] = prev
+
+
 def bench_device_filter(out):
     """Fused consensus→filter route vs full-fetch + host filter at 3
     family-size profiles (ISSUE 11): same consensus work on both sides;
@@ -753,6 +812,7 @@ def main():
                              read_length=100, seed=17)
         for section in (bench_kernel,
                         bench_full_column,
+                        bench_pallas,
                         bench_device_filter,
                         bench_donation,
                         bench_coalesce,

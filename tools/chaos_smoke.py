@@ -243,6 +243,60 @@ def main():
                     and "audit" not in report,
                     f"rc={p.returncode} bytes={len(got)}")
 
+        # 3e) fused-filter SDC (ISSUE 19): the same corrupt-result fault
+        # on the ``--device-filter`` route with the audit at `all` -> the
+        # stats-row audit detects the divergence inside the fused
+        # dispatch, repairs the batch with the oracle columns (the host
+        # filter finishes the stage), the breaker records the sdc trip,
+        # and the published output stays byte-identical to the clean
+        # fused run
+        filt_argv = ["--run-report", "report.json", "simplex", "-i", sim,
+                     "-o", "out.bam", "--min-reads", "1",
+                     "--device-filter", "--filter-min-reads", "2",
+                     "--filter-min-mean-base-quality", "30"]
+        d_ref = os.path.join(tmp, "sdc_filter_ref")
+        os.mkdir(d_ref)
+        p = run(filt_argv, env={"FGUMI_TPU_HOST_ENGINE": "0",
+                                "FGUMI_TPU_ROUTE": "device"}, cwd=d_ref)
+        assert p.returncode == 0, p.stderr
+        filt_ref = open(os.path.join(d_ref, "out.bam"), "rb").read()
+        d = os.path.join(tmp, "sdc_filter")
+        os.mkdir(d)
+        rpt = os.path.join(d, "report.json")
+        p = run(filt_argv,
+                env={"FGUMI_TPU_HOST_ENGINE": "0",
+                     "FGUMI_TPU_ROUTE": "device",
+                     "FGUMI_TPU_AUDIT": "all",
+                     "FGUMI_TPU_FLIGHT": d,
+                     "FGUMI_TPU_FAULT":
+                         "device.fetch:corrupt-result:1.0:1"},
+                cwd=d)
+        got = (open(os.path.join(d, "out.bam"), "rb").read()
+               if p.returncode == 0 else b"")
+        ok &= check("corrupt-result on --device-filter + audit=all -> "
+                    "detected, repaired (exit 0), byte-identical to the "
+                    "clean fused run",
+                    p.returncode == 0 and got == filt_ref,
+                    f"rc={p.returncode}")
+        try:
+            report = __import__("json").load(open(rpt))
+            audit = report.get("audit", {})
+            br = report.get("device", {}).get("breaker", {})
+            dump_ok = any("sdc" in os.path.basename(f)
+                          for f in report.get("flight_dumps", []))
+            ok &= check(
+                "device-filter report records the audit divergence + "
+                "sdc trip + flight dump",
+                audit.get("divergent", 0) >= 1
+                and br.get("sdc_trips", 0) >= 1
+                and dump_ok,
+                f"divergent={audit.get('divergent')} "
+                f"sdc_trips={br.get('sdc_trips')} dump={dump_ok}")
+        except (OSError, ValueError) as e:
+            ok &= check("device-filter report records the audit "
+                        "divergence + sdc trip + flight dump", False,
+                        str(e))
+
         # 3d) --audit-output: corruption injected below the writer's
         # tally (BGZF layer) is refused before the atomic rename — exit
         # 5, no file published

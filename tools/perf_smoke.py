@@ -20,7 +20,11 @@ Checks (exit 0 when every scenario holds, one PASS/FAIL line each):
    config's run report shows bytes-fetched reduced >= 5x vs the non-fused
    device route; resident bytes release by exit; an injected device fault
    degrades to the host filter cleanly and byte-identically.
-4. ``--shape-buckets`` rejects malformed specs with a clean error.
+4. **Pallas kernel** (ISSUE 19): forced ``FGUMI_TPU_KERNEL=pallas``
+   (Mosaic interpret mode on CPU) byte-identical to ``xla`` on the
+   simplex and ``--device-filter`` routes, backend counters in the run
+   report, clean loud fallback to XLA when the lowering is unavailable.
+5. ``--shape-buckets`` rejects malformed specs with a clean error.
 
 Sibling of tools/telemetry_smoke.py / tools/serve_smoke.py /
 tools/chaos_smoke.py in the verify flow (.claude/skills/verify).
@@ -448,6 +452,90 @@ def device_filter_scenario(tmp):
     return ok
 
 
+def pallas_scenario(tmp):
+    """ISSUE 19 gates: forced ``FGUMI_TPU_KERNEL=pallas`` (Mosaic
+    interpret mode on this CPU platform) is byte-identical to the XLA
+    kernels on both the simplex and ``--device-filter`` routes; the run
+    report's device section counts dispatches under the active backend;
+    and an unavailable Pallas lowering falls back to XLA cleanly."""
+    grouped = os.path.join(tmp, "pk_grouped.bam")
+    p = run_cli(["simulate", "grouped-reads", "-o", grouped,
+                 "--num-families", "150", "--family-size", "4",
+                 "--family-size-distribution", "longtail", "--seed", "19"])
+    assert p.returncode == 0, p.stderr
+    out_bam = os.path.join(tmp, "pk_cons.bam")
+    rpt = os.path.join(tmp, "pk.report.json")
+    dev = {"FGUMI_TPU_ROUTE": "device"}
+
+    p = run_cli(["--run-report", rpt, "simplex", "-i", grouped, "-o",
+                 out_bam, "--min-reads", "1"],
+                {**dev, "FGUMI_TPU_KERNEL": "xla"})
+    ok = check("simplex (kernel=xla) exits 0", p.returncode == 0,
+               f"rc={p.returncode}")
+    if not ok:
+        return False
+    xla_bytes = open(out_bam, "rb").read()
+    devsec = json.load(open(rpt)).get("device", {})
+    ok &= check("xla run counts kernel_xla dispatches",
+                devsec.get("kernel_xla", 0) >= 1
+                and devsec.get("kernel_pallas", 0) == 0,
+                f"xla={devsec.get('kernel_xla')} "
+                f"pallas={devsec.get('kernel_pallas')}")
+
+    p = run_cli(["--run-report", rpt, "simplex", "-i", grouped, "-o",
+                 out_bam, "--min-reads", "1"],
+                {**dev, "FGUMI_TPU_KERNEL": "pallas"})
+    ok &= check("simplex (kernel=pallas, interpret on CPU) exits 0",
+                p.returncode == 0, f"rc={p.returncode}")
+    ok &= check("pallas vs xla simplex byte-identical",
+                open(out_bam, "rb").read() == xla_bytes)
+    report = json.load(open(rpt))
+    devsec = report.get("device", {})
+    m = report.get("metrics", {})
+    ok &= check("pallas run counts kernel_pallas dispatches",
+                devsec.get("kernel_pallas", 0) >= 1,
+                f"pallas={devsec.get('kernel_pallas')} "
+                f"xla={devsec.get('kernel_xla')}")
+    ok &= check("report metrics carry device.kernel.pallas",
+                m.get("device.kernel.pallas", 0)
+                == devsec.get("kernel_pallas", -1))
+
+    # fused consensus->filter route, both backends record-identical
+    filt_args = ["--device-filter", "--filter-min-reads", "3",
+                 "--filter-min-mean-base-quality", "30",
+                 "--filter-min-base-quality", "20"]
+    fused_x = os.path.join(tmp, "pk_fused_x.bam")
+    fused_p = os.path.join(tmp, "pk_fused_p.bam")
+    p = run_cli(["simplex", "-i", grouped, "-o", fused_x,
+                 "--min-reads", "1"] + filt_args,
+                {**dev, "FGUMI_TPU_KERNEL": "xla"})
+    ok &= check("--device-filter (kernel=xla) exits 0", p.returncode == 0)
+    p = run_cli(["simplex", "-i", grouped, "-o", fused_p,
+                 "--min-reads", "1"] + filt_args,
+                {**dev, "FGUMI_TPU_KERNEL": "pallas"})
+    ok &= check("--device-filter (kernel=pallas) exits 0",
+                p.returncode == 0, f"rc={p.returncode}")
+    ok &= check("pallas vs xla --device-filter records identical",
+                _records(fused_p) == _records(fused_x))
+
+    # unavailable lowering: loud XLA fallback, same bytes, exit 0
+    p = run_cli(["--run-report", rpt, "simplex", "-i", grouped, "-o",
+                 out_bam, "--min-reads", "1"],
+                {**dev, "FGUMI_TPU_KERNEL": "pallas",
+                 "FGUMI_TPU_PALLAS_UNAVAILABLE": "1"})
+    ok &= check("unavailable pallas falls back cleanly (exit 0)",
+                p.returncode == 0, f"rc={p.returncode}")
+    ok &= check("fallback announced loudly",
+                "falling back" in p.stderr.lower())
+    devsec = json.load(open(rpt)).get("device", {})
+    ok &= check("fallback run executed on the XLA kernels",
+                devsec.get("kernel_pallas", 0) == 0
+                and devsec.get("kernel_xla", 0) >= 1)
+    ok &= check("fallback run byte-identical",
+                open(out_bam, "rb").read() == xla_bytes)
+    return ok
+
+
 def bad_spec_scenario(tmp):
     p = run_cli(["--shape-buckets", "0.5", "sort", "-i", "x", "-o",
                  os.path.join(tmp, "never.bam")])
@@ -468,6 +556,7 @@ def main():
         ok &= report_scenario(tmp)
         ok &= full_column_scenario(tmp)
         ok &= device_filter_scenario(tmp)
+        ok &= pallas_scenario(tmp)
         ok &= audit_overhead_scenario(tmp)
         ok &= bad_spec_scenario(tmp)
     finally:

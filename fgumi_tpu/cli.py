@@ -4112,6 +4112,44 @@ def cmd_trace_merge(args):
     return 0
 
 
+def _add_tune(sub):
+    p = sub.add_parser(
+        "tune",
+        help="Measure this host's device/host crossovers on a simulated "
+             "workload matrix and write a deployment profile (tuned "
+             "knobs + measured router/chooser priors, loaded via "
+             "--profile/FGUMI_TPU_PROFILE) plus a crossover atlas "
+             "(docs/performance-tuning.md \"Deployment profiles\")")
+    p.add_argument("-o", "--output", default="deploy_profile.json",
+                   metavar="PATH",
+                   help="deployment profile to write")
+    p.add_argument("--atlas", default="TUNE_ATLAS.json", metavar="PATH",
+                   help="crossover atlas to write ('' disables)")
+    p.add_argument("--quick", action="store_true",
+                   help="CI-sized sweep: the three fixed-depth crossover "
+                        "cells only, small pileups (seconds, not minutes)")
+    p.add_argument("--replay", action="append", default=None,
+                   metavar="JSON", dest="replay",
+                   help="derive the profile from recorded evidence "
+                        "instead of sweeping: run-report JSONs "
+                        "(device.routing EWMAs) and/or microbench JSONs "
+                        "(tune_cells from the --backend matrix); repeat "
+                        "per file")
+    p.set_defaults(func=cmd_tune)
+
+
+def cmd_tune(args):
+    from .tune.autotune import run_autotune
+    from .tune.profile import ProfileError
+
+    try:
+        return run_autotune(args.output, args.atlas or None,
+                            quick=args.quick, replay_paths=args.replay)
+    except ProfileError as e:
+        log.error("%s", e)
+        return 2
+
+
 def build_parser():
     parser = argparse.ArgumentParser(
         prog="fgumi-tpu",
@@ -4170,6 +4208,14 @@ def build_parser():
              "SIGTERM (also FGUMI_TPU_FLIGHT; unset = record the ring but "
              "never write a file)")
     parser.add_argument(
+        "--profile", default=None, metavar="PATH",
+        help="load a deployment profile (fgumi-tpu tune output): tuned "
+             "knob values fill any FGUMI_TPU_* vars not explicitly set "
+             "(explicit env/flags always win) and measured router/chooser "
+             "priors seed the adaptive offload machinery so the first "
+             "batch routes on the measured side of each crossover "
+             "(also FGUMI_TPU_PROFILE; docs/performance-tuning.md)")
+    parser.add_argument(
         "--shape-buckets", type=_shape_buckets_arg, default=None,
         metavar="GROWTH[:CAP]",
         help="device padded-shape bucket ladder: geometric growth factor "
@@ -4211,6 +4257,7 @@ def build_parser():
     _add_stats(sub)
     _add_balance(sub)
     _add_trace_merge(sub)
+    _add_tune(sub)
     return parser
 
 
@@ -4416,6 +4463,19 @@ def main(argv=None):
     from .observe.scope import (adopt_job_context, publish_to_global,
                                 scoped_telemetry)
 
+    # deployment profile (--profile / FGUMI_TPU_PROFILE): applied BEFORE
+    # the telemetry scope so the env knobs it fills are in place for every
+    # downstream env read, and process-once (a daemon job re-entering
+    # main() in a fresh context must not re-apply or re-warn). A bad
+    # profile is the same exit-2 contract as every other knob parse error.
+    from .tune import profile as _profile
+
+    try:
+        _profile.maybe_apply_from_env(getattr(args, "profile", None))
+    except _profile.ProfileError as e:
+        log.error("%s", e)
+        return 2
+
     restore_buckets = None
     try:
         restore_buckets = _apply_shape_buckets(args)
@@ -4450,6 +4510,11 @@ def _main_scoped(args, argv):
     from .utils.governor import GOVERNOR
 
     GOVERNOR.maybe_start()
+    # re-stamp the process's profile-application outcome into THIS
+    # invocation's scoped registry (application itself is process-once)
+    from .tune import profile as _profile
+
+    _profile.stamp_metrics()
     # flight recorder destination: the ring always records; a configured
     # dump dir additionally turns failures into black-box files. The flag
     # sets the process-wide destination (like the env var it mirrors) —

@@ -51,7 +51,14 @@ import time
 #: by the hand-tiled Pallas kernel vs the XLA-lowered oracle; absent when
 #: no wire dispatch ran), and DeviceStats timeline entries (flight dumps,
 #: ``--stats`` report) gain a per-dispatch ``kernel_backend`` stamp.
-SCHEMA_VERSION = 6
+#: v7 (ISSUE 20): ``device.routing`` gains ``prior_source`` ("cold" /
+#: "profile" / "snapshot" — where the cost model's starting EWMAs came
+#: from, so first-batch routing is attributable), the metrics section may
+#: carry ``tune.*`` gauges, and the optional top-level ``profile``
+#: section records the applied deployment profile (path, knobs applied /
+#: skipped by explicit overrides, fingerprint mismatches, whether router
+#: priors were seeded — tune/profile.py).
+SCHEMA_VERSION = 7
 
 
 def _device_stats():
@@ -103,6 +110,9 @@ _OPTIONAL = {
                                     # device/commit components + residual,
                                     # summing <= total_s (v5)
     "xla_profile_dir": str,  # --xla-profile capture directory (v5)
+    "profile": dict,  # applied deployment profile: path, knobs applied/
+                      # skipped_explicit, fingerprint mismatches, whether
+                      # router priors were seeded (tune/profile.py; v7)
 }
 
 #: Components a ``latency_decomposition`` section may carry besides
@@ -344,11 +354,14 @@ def build_report(command: str, argv, started_unix: float, wall_s: float,
     # offload cost-model state (link/host EWMAs + last decision) rides
     # along whenever batches were routed, so a wrong crossover is
     # diagnosable from the report alone (ISSUE 6 satellite) — including
-    # the all-host case, where dispatches stays 0 but route_host > 0
-    if dev.get("route_device") or dev.get("route_host"):
-        router = sys.modules.get("fgumi_tpu.ops.router")
-        if router is not None:
-            dev["routing"] = router.ROUTER.snapshot()
+    # the all-host case, where dispatches stays 0 but route_host > 0,
+    # and the seeded-but-idle case (v7: a profile/snapshot-seeded router
+    # must stamp prior_source even before its first routed batch)
+    router = sys.modules.get("fgumi_tpu.ops.router")
+    if router is not None and (dev.get("route_device")
+                               or dev.get("route_host")
+                               or router.ROUTER.prior_source != "cold"):
+        dev["routing"] = router.ROUTER.snapshot()
     # wedge circuit breaker (ops/breaker.py): anything beyond pristine
     # closed rides along, so a degraded run's artifact explains itself —
     # the ISSUE 7 acceptance reads device.breaker.state transitions +
@@ -360,7 +373,7 @@ def build_report(command: str, argv, started_unix: float, wall_s: float,
                 or bsnap["deadline_overruns"]:
             dev["breaker"] = bsnap
     if dev.get("dispatches") or dev.get("route_host") \
-            or dev.get("breaker") or dev.get("mesh"):
+            or dev.get("breaker") or dev.get("mesh") or dev.get("routing"):
         report["device"] = dev
     io_sec = {k.split(".", 1)[1]: v for k, v in metrics.items()
               if k.startswith("io.")}
@@ -430,6 +443,24 @@ def build_report(command: str, argv, started_unix: float, wall_s: float,
         captured = xprof.captured_dir()
         if captured:
             report["xla_profile_dir"] = captured
+    # applied deployment profile (tune/profile.py; v7): which knobs the
+    # profile filled vs explicit overrides, fingerprint mismatches, and
+    # whether router priors were seeded — pairs with
+    # device.routing.prior_source to make first-batch routing attributable
+    tune_prof = sys.modules.get("fgumi_tpu.tune.profile")
+    if tune_prof is not None:
+        applied = tune_prof.applied_info()
+        if applied:
+            report["profile"] = {
+                "path": applied["path"],
+                "knobs_applied": list(applied["applied"]),
+                "knobs_skipped_explicit":
+                    list(applied["skipped_explicit"]),
+                "fingerprint_mismatch":
+                    list(applied["fingerprint_mismatch"]),
+                "seeded_router": bool(applied["seeded_router"]),
+                "seeded_choosers": list(applied["seeded_choosers"]),
+            }
     return report
 
 

@@ -60,36 +60,35 @@ def parse_shape_buckets(spec):
     pow2 padding). cap: largest ladder value (>= 1024); sizes above it
     round to multiples of the cap. None/"" -> defaults.
     """
+    from ..utils.knobs import knob_error
+
+    grammar = "GROWTH[:CAP] with growth in [1.01, 2.0] and cap >= 1024"
+
+    def _err(problem):
+        return ValueError(knob_error("FGUMI_TPU_SHAPE_BUCKETS", spec,
+                                     problem, grammar))
+
     if spec is None or str(spec).strip() == "":
         return DEFAULT_GROWTH, DEFAULT_CAP
     parts = str(spec).strip().split(":")
     if len(parts) > 2:
-        raise ValueError(
-            f"FGUMI_TPU_SHAPE_BUCKETS={spec!r}: expected GROWTH[:CAP]")
+        raise _err(f"{len(parts)} ':'-separated fields")
     try:
         growth = float(parts[0])
     except ValueError:
-        raise ValueError(
-            f"FGUMI_TPU_SHAPE_BUCKETS={spec!r}: growth {parts[0]!r} "
-            f"is not a number") from None
+        raise _err(f"growth {parts[0]!r} is not a number") from None
     # 1.01 floor: growths within rounding of 1.0 degenerate into a ladder
     # with one entry per alignment step — ~1M entries built up front
     if not 1.01 <= growth <= 2.0:
-        raise ValueError(
-            f"FGUMI_TPU_SHAPE_BUCKETS={spec!r}: growth must be in "
-            f"[1.01, 2.0], got {growth}")
+        raise _err(f"growth {growth} is out of range")
     cap = DEFAULT_CAP
     if len(parts) == 2:
         try:
             cap = int(parts[1])
         except ValueError:
-            raise ValueError(
-                f"FGUMI_TPU_SHAPE_BUCKETS={spec!r}: cap {parts[1]!r} "
-                f"is not an integer") from None
+            raise _err(f"cap {parts[1]!r} is not an integer") from None
         if cap < 1024:
-            raise ValueError(
-                f"FGUMI_TPU_SHAPE_BUCKETS={spec!r}: cap must be >= 1024, "
-                f"got {cap}")
+            raise _err(f"cap {cap} is below the 1024 floor")
     return growth, cap
 
 

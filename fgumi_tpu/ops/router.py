@@ -79,6 +79,22 @@ class _Ewma:
     def get(self, default: float):
         return self.value if self.value is not None else default
 
+    def seed(self, value, samples: int = 1):
+        """Install a measured prior (tune/profile.py). ``samples`` counts
+        as real history for the decide() probe gates — a profile-seeded
+        host rate must NOT re-fire probe-unmeasured — but live ``add()``
+        measurements still converge away from it at the normal ALPHA."""
+        if value is not None:
+            self.value = float(value)
+            self.samples = max(int(samples), 1)
+
+    def export(self):
+        return {"value": self.value, "samples": self.samples}
+
+    def restore(self, state):
+        if isinstance(state, dict) and state.get("value") is not None:
+            self.seed(state["value"], state.get("samples", 1))
+
 
 class OffloadRouter:
     """Per-batch device/host routing for the consensus engines."""
@@ -99,6 +115,12 @@ class OffloadRouter:
 
     def reset(self):
         with self._lock:
+            # where the EWMAs' starting point came from: "cold" (static
+            # class priors), "profile" (tune/profile.py seeded measured
+            # priors), or "snapshot" (daemon warm-start restore). Stamped
+            # into snapshot() + device.routing telemetry so a first-batch
+            # routing decision is attributable to its prior.
+            self.prior_source = "cold"
             # device-side EWMAs are PER MESH SIZE (ISSUE 10 (c)): an N-chip
             # mesh has its own link rate (N overlapping upload slices), its
             # own per-dispatch overhead (shard_map relay + collectives),
@@ -356,6 +378,94 @@ class OffloadRouter:
         dispatch in the predicted-vs-actual timeline stamps."""
         return getattr(self._tls, "pred", None)
 
+    # ------------------------------------------------- seeding / warm start
+
+    def seed_priors(self, priors: dict, source: str = "profile") -> bool:
+        """Install measured priors from a deployment profile
+        (tune/profile.py). Only COLD EWMAs are seeded: once a live
+        measurement has landed (samples > 0) the learned state wins — this
+        also makes re-entry safe when daemon jobs re-run cli.main in fresh
+        scoped contexts. Returns True when anything was seeded."""
+        if not isinstance(priors, dict):
+            return False
+        seeded = False
+        with self._lock:
+            base = self._mesh_ewmas(1)
+            for key, ewma in (("link_mbps", base["link_bps"]),
+                              ("overhead_s", base["overhead_s"]),
+                              ("dispatch_wall_s", base["dispatch_wall_s"])):
+                v = priors.get(key)
+                if v is not None and ewma.samples == 0:
+                    ewma.seed(v * 1e6 if key == "link_mbps" else v)
+                    seeded = True
+            v = priors.get("host_mcells_per_s")
+            if v is not None and self._host_cps.samples == 0:
+                self._host_cps.seed(v * 1e6)
+                seeded = True
+            v = priors.get("filter_keep_rate")
+            if v is not None and self._filter_keep.samples == 0:
+                self._filter_keep.seed(v)
+                seeded = True
+            for n, mp in (priors.get("mesh") or {}).items():
+                try:
+                    e = self._mesh_ewmas(int(n))
+                except (TypeError, ValueError):
+                    continue
+                for key, ewma in (("link_mbps", e["link_bps"]),
+                                  ("overhead_s", e["overhead_s"]),
+                                  ("dispatch_wall_s",
+                                   e["dispatch_wall_s"])):
+                    v = mp.get(key) if isinstance(mp, dict) else None
+                    if v is not None and ewma.samples == 0:
+                        ewma.seed(v * 1e6 if key == "link_mbps" else v)
+                        seeded = True
+            if seeded and self.prior_source == "cold":
+                self.prior_source = source
+        return seeded
+
+    def export_state(self):
+        """Full EWMA state (values + sample counts, every mesh size) for
+        the daemon's warm-start snapshot — unlike the rounded snapshot()
+        this is lossless, so a restore reproduces routing exactly."""
+        with self._lock:
+            return {
+                "mesh": {str(n): {k: e[k].export() for k in e}
+                         for n, e in self._mesh.items()},
+                "host_cps": self._host_cps.export(),
+                "filter_keep": self._filter_keep.export(),
+            }
+
+    def restore_state(self, state: dict, source: str = "snapshot") -> bool:
+        """Reload an export_state() dict (daemon restart warm start).
+        Cold-EWMA-only, like seed_priors: live measurements always win."""
+        if not isinstance(state, dict):
+            return False
+        restored = False
+        with self._lock:
+            for n, me in (state.get("mesh") or {}).items():
+                try:
+                    e = self._mesh_ewmas(int(n))
+                except (TypeError, ValueError):
+                    continue
+                if not isinstance(me, dict):
+                    continue
+                for k in ("link_bps", "overhead_s", "dispatch_wall_s"):
+                    st = me.get(k)
+                    if isinstance(st, dict) and st.get("value") is not None \
+                            and e[k].samples == 0:
+                        e[k].restore(st)
+                        restored = True
+            for attr, key in ((self._host_cps, "host_cps"),
+                              (self._filter_keep, "filter_keep")):
+                st = state.get(key)
+                if isinstance(st, dict) and st.get("value") is not None \
+                        and attr.samples == 0:
+                    attr.restore(st)
+                    restored = True
+            if restored and self.prior_source == "cold":
+                self.prior_source = source
+        return restored
+
     # ----------------------------------------------------------- snapshot
 
     def snapshot(self):
@@ -363,6 +473,7 @@ class OffloadRouter:
         with self._lock:
             base = self._mesh[1]
             out = {
+                "prior_source": self.prior_source,
                 "link_mbps": round(base["link_bps"].get(0.0) / 1e6, 3),
                 "link_samples": base["link_bps"].samples,
                 "overhead_s": round(base["overhead_s"].get(0.0), 5),
@@ -413,6 +524,37 @@ class AdaptiveChooser:
         if cells > 0 and seconds >= 0:
             with self._lock:
                 self._spc[side].add(seconds / cells)
+
+    def seed(self, device_s_per_mcell=None, host_s_per_mcell=None) -> bool:
+        """Install measured seconds-per-million-cells priors (profile
+        units match snapshot()). Seeded with samples=2 so the first
+        decide() picks the measured winner instead of alternating; cold
+        sides only, so live daemons keep their learned state."""
+        seeded = False
+        with self._lock:
+            for side, v in (("device", device_s_per_mcell),
+                            ("host", host_s_per_mcell)):
+                if v is not None and self._spc[side].samples == 0:
+                    self._spc[side].seed(v / 1e6, samples=2)
+                    seeded = True
+        return seeded
+
+    def export_state(self):
+        with self._lock:
+            return {side: e.export() for side, e in self._spc.items()}
+
+    def restore_state(self, state: dict) -> bool:
+        if not isinstance(state, dict):
+            return False
+        restored = False
+        with self._lock:
+            for side in ("device", "host"):
+                st = state.get(side)
+                if isinstance(st, dict) and st.get("value") is not None \
+                        and self._spc[side].samples == 0:
+                    self._spc[side].restore(st)
+                    restored = True
+        return restored
 
     def decide(self, cells: int, override: str = "auto") -> str:
         from ..observe.metrics import METRICS

@@ -50,16 +50,19 @@ def parse_mesh_spec(spec):
         return None
     if s == "auto":
         return "auto"
+    from ..utils.knobs import knob_error
+
+    grammar = "'auto', 'off', or 'dpNxspM' (e.g. dp4xsp2) with dp, sp >= 1"
     m = _MESH_RE.match(s)
     if not m:
-        raise MeshConfigError(
-            f"FGUMI_TPU_MESH={spec!r}: expected 'auto', 'off', or "
-            f"'dpNxspM' (e.g. dp4xsp2)")
+        raise MeshConfigError(knob_error(
+            "FGUMI_TPU_MESH", spec, f"unrecognized shape {s!r}", grammar))
     dp = int(m.group(1))
     sp = int(m.group(2)) if m.group(2) else 1
     if dp < 1 or sp < 1:
-        raise MeshConfigError(
-            f"FGUMI_TPU_MESH={spec!r}: dp and sp must be >= 1")
+        raise MeshConfigError(knob_error(
+            "FGUMI_TPU_MESH", spec, f"dp={dp} sp={sp} below the >= 1 floor",
+            grammar))
     return dp, sp
 
 

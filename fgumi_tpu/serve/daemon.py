@@ -174,6 +174,11 @@ class JobService:
         self._frames = None
         self._shutdown = threading.Event()
         self._closed = False
+        #: warm-start persistence accounting for the `stats` op (ISSUE
+        #: 20): path of the routing-EWMA snapshot, whether one was
+        #: reloaded at start, and the save timestamps. None until
+        #: start() resolves the path (journal- or socket-adjacent).
+        self.routing_state = None
 
     def _on_transition(self, job):
         if self.journal is not None:
@@ -575,12 +580,112 @@ class JobService:
         self.bind()
         self._frames.start()
 
+    # ------------------------- routing warm start (ISSUE 20 satellite) ---
+
+    ROUTING_STATE_SCHEMA_VERSION = 1
+
+    def _routing_state_path(self):
+        """Journal-adjacent (the durable location the operator already
+        chose) or socket-adjacent on journal-less daemons."""
+        base = self.journal_path or self.socket_path
+        return (base + ".routing.json") if base else None
+
+    def load_routing_state(self):
+        """Reload the previous daemon's routing EWMAs so a restart does
+        not re-learn the link/host/keep-rate crossovers from priors.
+        Cold-EWMAs-only by construction (router.restore_state), so a
+        profile's seeds or live measurements are never clobbered; a
+        restored router stamps ``prior_source="snapshot"``."""
+        path = self._routing_state_path()
+        self.routing_state = {"path": path, "loaded": False,
+                              "saved_unix": None}
+        if not path or not os.path.exists(path):
+            return False
+        try:
+            with open(path) as f:
+                state = json.load(f)
+        except (OSError, ValueError) as e:
+            log.warning("serve: unreadable routing snapshot %s (%s); "
+                        "starting cold", path, e)
+            return False
+        if state.get("schema_version") != self.ROUTING_STATE_SCHEMA_VERSION:
+            log.warning("serve: routing snapshot %s has schema %s "
+                        "(want %d); starting cold", path,
+                        state.get("schema_version"),
+                        self.ROUTING_STATE_SCHEMA_VERSION)
+            return False
+        from ..observe.metrics import METRICS
+        from ..ops import router as router_mod
+
+        restored = router_mod.ROUTER.restore_state(
+            state.get("router") or {}, source="snapshot")
+        for name, chooser in (("duplex_combine",
+                               router_mod.DUPLEX_COMBINE),
+                              ("codec_combine", router_mod.CODEC_COMBINE)):
+            if chooser.restore_state(
+                    (state.get("choosers") or {}).get(name) or {}):
+                restored = True
+        self.routing_state.update(loaded=bool(restored),
+                                  saved_unix=state.get("saved_unix"))
+        if restored:
+            METRICS.inc("tune.routing_state.restored")
+            log.info("serve: warm-started routing EWMAs from %s "
+                     "(saved %s)", path, state.get("saved_unix"))
+        return restored
+
+    def save_routing_state(self):
+        """Snapshot the live routing EWMAs (router incl. keep-rate,
+        choosers, the coalescer's effective window for the record) next
+        to the journal on drain/close; crash-safe via the atomic-rename
+        writer. The coalesce window needs no restore of its own — it is
+        priced off the router's overhead EWMA, which the snapshot
+        carries."""
+        path = self._routing_state_path()
+        if not path:
+            return None
+        import sys
+
+        from ..ops import router as router_mod
+        from ..utils.atomic import discard_output, open_output
+
+        state = {
+            "schema_version": self.ROUTING_STATE_SCHEMA_VERSION,
+            "saved_unix": int(time.time()),
+            "router": router_mod.ROUTER.export_state(),
+            "choosers": {
+                "duplex_combine":
+                    router_mod.DUPLEX_COMBINE.export_state(),
+                "codec_combine": router_mod.CODEC_COMBINE.export_state(),
+            },
+        }
+        coal = sys.modules.get("fgumi_tpu.ops.coalesce")
+        if coal is not None:
+            state["coalesce_window_ms"] = round(coal.window_s() * 1e3, 3)
+        try:
+            out = open_output(path, "w")
+            try:
+                json.dump(state, out, indent=2, sort_keys=True)
+                out.write("\n")
+                out.close()
+            except BaseException:
+                discard_output(out)
+                raise
+        except OSError as e:
+            log.warning("serve: could not save routing snapshot %s: %s",
+                        path, e)
+            return None
+        if self.routing_state is not None:
+            self.routing_state["saved_unix"] = state["saved_unix"]
+        log.info("serve: routing EWMAs -> %s", path)
+        return path
+
     def start(self):
         """Bind (if not already), recover, start workers and the accept
         loops. Recovery runs before the pool so requeued jobs hold their
         original queue positions ahead of any fresh submission."""
         self.bind()
         self.recover()
+        self.load_routing_state()
         # arm the cross-job dispatch coalescer's serving signal: its merge
         # window may auto-open whenever >= 2 of this daemon's jobs are
         # running (the scheduler feeds the live count; ops/coalesce.py)
@@ -760,6 +865,10 @@ class JobService:
             return
         self._closed = True
         self._shutdown.set()
+        # persist the learned routing EWMAs for the next daemon's warm
+        # start (covers graceful drain, SIGTERM, and error teardown alike
+        # — close() is the one always-reached exit path)
+        self.save_routing_state()
         import sys
 
         coal = sys.modules.get("fgumi_tpu.ops.coalesce")

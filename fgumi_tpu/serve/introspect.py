@@ -42,8 +42,12 @@ log = logging.getLogger("fgumi_tpu")
 #: the merge window never armed and merged nothing). v5 added the
 #: ``device_memory`` section (live accelerator memory summed over local
 #: devices — bytes_in_use/peak_bytes from jax memory_stats(); None on
-#: CPU backends, which report no memory stats).
-STATS_SCHEMA_VERSION = 5
+#: CPU backends, which report no memory stats). v6 added the
+#: ``routing_state`` section (warm-start persistence of the routing
+#: EWMAs, ISSUE 20: where the daemon's routing snapshot lives, whether
+#: one was reloaded at start and when it was saved; None on daemons
+#: without a snapshot path).
+STATS_SCHEMA_VERSION = 6
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
@@ -89,6 +93,7 @@ def service_stats(service) -> dict:
         "governor": governor_snapshot(),
         "monitor": _monitor_section(service),
         "router": router_snapshot(),
+        "routing_state": getattr(service, "routing_state", None),
         "audit": audit_snapshot(),
         "coalesce": coalesce_snapshot(),
     }

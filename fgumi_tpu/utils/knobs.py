@@ -1,0 +1,21 @@
+"""One diagnostic grammar for every tunable-knob parse error.
+
+The profile-relevant knobs (``FGUMI_TPU_SHAPE_BUCKETS``, ``FGUMI_TPU_MESH``,
+``FGUMI_TPU_SP``, the DeploymentProfile fields) are parsed in four different
+modules; before ISSUE 20 each invented its own error wording, so the same
+class of mistake read differently depending on where it was caught. Every
+knob parse error now goes through :func:`knob_error`:
+
+    KNOB=<offending token>: <what is wrong>; expected <accepted grammar>
+
+All of them surface as exit 2 (``cli._run_command`` maps MeshConfigError /
+argparse type errors there; the profile loader raises
+:class:`ProfileError`, mapped the same way).
+"""
+
+
+def knob_error(knob: str, token, problem: str, grammar: str) -> str:
+    """The one true knob-diagnostic format. ``token`` is the offending
+    value exactly as the user supplied it (repr'd so whitespace and empty
+    strings survive); ``grammar`` states what would have been accepted."""
+    return f"{knob}={token!r}: {problem}; expected {grammar}"

@@ -121,11 +121,25 @@ def bench_full_column(out):
         rows = n_fam * fam
         out[f"full_column_fam{fam}_wire_s"] = round(dt, 4)
         out[f"full_column_fam{fam}_wire_rows_per_sec"] = round(rows / dt, 1)
+        # machine-readable per-cell record: the `fgumi-tpu tune --replay`
+        # input format (ISSUE 20) — same cells, structured instead of
+        # flat-keyed, stamped with the backend they ran on
+        import jax
+
+        cell = {
+            "name": f"fixed{fam}_L{L}", "distribution": "fixed",
+            "mean_depth": fam, "read_length": L, "rows": rows,
+            "backend": jax.default_backend(),
+            "device_rows_per_sec": round(rows / dt, 1),
+        }
         if host is not None:
             dth = _timeit(lambda: host.call_segments(codes, quals, starts))
             out[f"full_column_fam{fam}_host_rows_per_sec"] = round(
                 rows / dth, 1)
             out[f"full_column_fam{fam}_device_vs_host"] = round(dth / dt, 3)
+            cell["host_rows_per_sec"] = round(rows / dth, 1)
+            cell["winner"] = "device" if dt <= dth else "host"
+        out.setdefault("tune_cells", []).append(cell)
 
 
 def bench_pallas(out):
@@ -800,8 +814,73 @@ def bench_coalesce(out):
                 os.environ[k] = v
 
 
+def _parse_args(argv):
+    """Tolerates bench.py's invocation (repo root as a bare positional,
+    no flags) while adding the ISSUE 20 matrix surface."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="microbench.py",
+        description="per-kernel micro-benchmarks, one JSON dict on stdout")
+    p.add_argument("repo", nargs="?", default=None,
+                   help="repo root (bench.py passes it; standalone runs "
+                        "locate it from __file__)")
+    p.add_argument("--backend", action="append", default=None,
+                   metavar="NAME", dest="backends",
+                   help="also run the tune-cell section under this JAX "
+                        "platform (cpu, cuda, tpu, ...) in a subprocess; "
+                        "repeat per backend. Cells land in tune_cells "
+                        "stamped with their backend; an unavailable "
+                        "backend records an error instead of failing the "
+                        "run (ROADMAP item 4's CI-runnable matrix)")
+    p.add_argument("--tune-cells-only", action="store_true",
+                   help="run only the full-column tune-cell section "
+                        "(the per-backend subprocess mode)")
+    return p.parse_args(argv)
+
+
+def _bench_backend_matrix(out, backends):
+    """Per-backend tune cells via the bench_sharded subprocess recipe
+    (the platform pin must be set before jax initializes)."""
+    import subprocess
+
+    script = os.path.join(REPO, "microbench.py")
+    for backend in backends:
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = backend
+        try:
+            proc = subprocess.run(
+                [sys.executable, script, REPO, "--tune-cells-only"],
+                capture_output=True, text=True, timeout=600, env=env,
+                cwd=REPO)
+            if proc.returncode != 0:
+                raise RuntimeError("rc=%d: %s" % (
+                    proc.returncode, proc.stderr.strip()[-200:]))
+            sub = json.loads(proc.stdout.strip().splitlines()[-1])
+        except Exception as e:  # an absent backend must not fail the run
+            out[f"error_backend_{backend}"] = repr(e)[:200]
+            continue
+        have = {(c["name"], c.get("backend"))
+                for c in out.get("tune_cells", [])}
+        for cell in sub.get("tune_cells", []):
+            if (cell["name"], cell.get("backend")) not in have:
+                out.setdefault("tune_cells", []).append(cell)
+        out.setdefault("backends", []).append(backend)
+
+
 def main():
     import tempfile
+
+    args = _parse_args(sys.argv[1:])
+    if args.tune_cells_only:
+        out = {}
+        try:
+            bench_full_column(out)
+        except Exception as e:
+            out["error_bench_full_column"] = repr(e)[:200]
+        print(json.dumps(out))
+        return 0
 
     from fgumi_tpu.simulate import simulate_grouped_bam
 
@@ -830,6 +909,8 @@ def main():
             except Exception as e:  # a broken section must not hide others
                 out[f"error_{getattr(section, '__name__', 'section')}"] = \
                     repr(e)[:200]
+        if args.backends:
+            _bench_backend_matrix(out, args.backends)
     print(json.dumps(out))
     return 0
 

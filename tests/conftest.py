@@ -85,3 +85,22 @@ def _reset_flight_recorder():
     mod = sys.modules.get("fgumi_tpu.observe.flight")
     if mod is not None:
         mod.FLIGHT.reset()
+
+
+@pytest.fixture(autouse=True)
+def _reset_deployment_profile():
+    """Profile application (tune/profile.py) is process-once on purpose —
+    but a test that applies one must not make every later test's run
+    report carry a `profile` section (or leave seeded router priors
+    behind). Lazy: only when the tune module (and the router it seeds)
+    was actually touched."""
+    yield
+    mod = sys.modules.get("fgumi_tpu.tune.profile")
+    if mod is not None and mod.applied_info() is not None:
+        mod.reset_applied_for_tests()
+        router = sys.modules.get("fgumi_tpu.ops.router")
+        if router is not None:
+            router.ROUTER.reset()
+            for chooser in (router.DUPLEX_COMBINE, router.CODEC_COMBINE):
+                chooser._spc = {"device": router._Ewma(),
+                                "host": router._Ewma()}

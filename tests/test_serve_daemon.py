@@ -188,3 +188,70 @@ def test_socket_claim_rejects_live_daemon_replaces_dead(tmp_path):
     svc2 = JobService(sock, workers=1)
     svc2.start()  # replaces the dead socket without complaint
     svc2.close()
+
+
+def test_routing_state_survives_restart(tmp_path):
+    """Warm-start persistence (ISSUE 20): the daemon saves the router/
+    chooser EWMAs next to its journal on drain and a restarted daemon
+    reloads them — no cold-start routing regression after every deploy."""
+    from fgumi_tpu.ops import router as router_mod
+
+    sock = str(tmp_path / "warm.sock")
+    journal = str(tmp_path / "warm.journal")
+    router_mod.ROUTER.reset()  # earlier daemon tests fed the EWMAs
+    svc = JobService(sock, workers=1, journal_path=journal)
+    svc.start()
+    try:
+        # measured state a restart must not lose
+        router_mod.ROUTER.observe_host(2_000_000, 0.01)  # 200 Mcells/s
+        router_mod.ROUTER.observe_device(1 << 20, 4096, 0.01, 0.002,
+                                         0.01, devices=1)
+        client = ServeClient(sock, timeout=10)
+        rs = client.stats()["routing_state"]
+        assert rs["loaded"] is False  # nothing on disk yet: cold start
+    finally:
+        svc.close()
+    snap_path = journal + ".routing.json"
+    assert os.path.exists(snap_path)
+    state = json.load(open(snap_path))
+    assert state["schema_version"] == JobService.ROUTING_STATE_SCHEMA_VERSION
+    assert state["router"]["host_cps"]["value"] == pytest.approx(2e8)
+
+    # simulate the restarted process: singletons back to cold
+    router_mod.ROUTER.reset()
+    assert router_mod.ROUTER.snapshot()["host_mcells_per_s"] == 0.0
+
+    svc2 = JobService(sock, workers=1, journal_path=journal)
+    svc2.start()
+    try:
+        snap = router_mod.ROUTER.snapshot()
+        assert snap["prior_source"] == "snapshot"
+        assert snap["host_mcells_per_s"] == pytest.approx(200.0)
+        rs = ServeClient(sock, timeout=10).stats()["routing_state"]
+        assert rs["loaded"] is True
+        assert rs["path"] == snap_path
+    finally:
+        svc2.close()
+
+
+def test_corrupt_routing_snapshot_starts_cold(tmp_path):
+    """An unreadable snapshot must never block startup — warn, start
+    cold, and overwrite it with fresh state on the next drain."""
+    from fgumi_tpu.ops import router as router_mod
+
+    sock = str(tmp_path / "cold.sock")
+    journal = str(tmp_path / "cold.journal")
+    router_mod.ROUTER.reset()
+    with open(journal + ".routing.json", "w") as fh:
+        fh.write("{corrupt")
+    svc = JobService(sock, workers=1, journal_path=journal)
+    svc.start()
+    try:
+        assert router_mod.ROUTER.snapshot()["prior_source"] == "cold"
+        rs = ServeClient(sock, timeout=10).stats()["routing_state"]
+        assert rs["loaded"] is False
+    finally:
+        svc.close()
+    # the drain replaced the corrupt file with a valid snapshot
+    state = json.load(open(journal + ".routing.json"))
+    assert state["schema_version"] == JobService.ROUTING_STATE_SCHEMA_VERSION

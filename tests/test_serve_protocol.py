@@ -81,7 +81,7 @@ def tcp_service(tmp_path):
 #: content is covered by test_stats_op_live_sections below)
 _VOLATILE_STATS_SECTIONS = ("metrics", "latency", "device", "device_memory",
                             "breaker", "governor", "router", "monitor",
-                            "audit", "coalesce")
+                            "audit", "coalesce", "routing_state")
 
 
 def _normalize(obj):
